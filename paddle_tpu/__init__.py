@@ -1,0 +1,156 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the PaddlePaddle
+API surface, built on jax/XLA/Pallas.
+
+Architecture (vs. reference /root/reference, see SURVEY.md §8):
+  * Tensor        = handle over jax.Array (framework/tensor.py)
+  * autograd      = tape over jax.vjp (autograd/tape.py)
+  * op layer      = one registry of pure-jax bodies (ops/)
+  * static graph  = jax.jit tracing (jit/), StableHLO export
+  * distributed   = jax.sharding.Mesh + GSPMD (distributed/)
+  * hot kernels   = Pallas TPU (ops/pallas/)
+"""
+from __future__ import annotations
+
+import jax as _jax
+
+# Paddle's default integer dtype is int64 (python/paddle/tensor/creation.py
+# to_tensor); jax's x32 mode would silently truncate. Enable x64 — the
+# framework's own creation logic keeps float defaults at float32/bfloat16,
+# so TPU matmuls stay on the MXU.
+_jax.config.update("jax_enable_x64", True)
+
+# -- core types ------------------------------------------------------------
+from .framework import dtype as _dtype_mod
+from .framework.dtype import (  # noqa: F401
+    bool_, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
+    float64, complex64, complex128, DType)
+bool = bool_  # paddle.bool
+from .framework.tensor import Tensor, to_tensor, is_tensor  # noqa: F401
+from .framework import tensor_methods as _tensor_methods  # noqa: F401  (patches Tensor)
+from .framework.random import seed, get_rng_state, set_rng_state  # noqa: F401
+
+# -- autograd --------------------------------------------------------------
+from .autograd import no_grad, enable_grad, is_grad_enabled, \
+    set_grad_enabled, grad  # noqa: F401
+from . import autograd  # noqa: F401
+
+# -- ops into the flat namespace ------------------------------------------
+from .ops.math import (  # noqa: F401
+    add, subtract, multiply, divide, floor_divide, remainder, mod, pow,
+    matmul, scale, neg, abs, exp, expm1, log, log2, log10, log1p, sqrt,
+    rsqrt, square, sin, cos, tan, asin, acos, atan, sinh, cosh, tanh, asinh,
+    acosh, atanh, erf, erfinv, floor, ceil, round, trunc, sign, reciprocal,
+    sigmoid, digamma, lgamma, i0, frac, deg2rad, rad2deg, angle, conj, real,
+    imag, clip, maximum, minimum, fmax, fmin, atan2, hypot, lerp, stanh,
+    logit, multiplex, isnan, isinf, isfinite, nan_to_num, cumsum, cumprod,
+    cummax, cummin, logcumsumexp, addmm, inner, outer, heaviside, gcd, lcm,
+    diff, trace, kron, cross, dot, polygamma)
+from .ops.reduction import (  # noqa: F401
+    mean, amax, amin, prod, var, std, nansum, nanmean, count_nonzero,
+    logsumexp, argmax, argmin, median, nanmedian, quantile, kthvalue, mode)
+from .ops.reduction import sum_ as sum, max_ as max, min_ as min, \
+    all_ as all, any_ as any  # noqa: F401
+from .ops.manipulation import (  # noqa: F401
+    reshape, transpose, concat, stack, unstack, split, chunk, squeeze,
+    unsqueeze, flatten, tile, expand, expand_as, broadcast_to,
+    broadcast_tensors, gather, gather_nd, scatter, scatter_nd_add,
+    scatter_nd, index_select, index_sample, index_add, index_put,
+    take_along_axis, put_along_axis, flip, roll, rot90, where, nonzero,
+    masked_select, masked_fill, topk, sort, argsort, searchsorted, bucketize,
+    unique, unique_consecutive, one_hot, tril, triu, tril_indices,
+    triu_indices, diag, diagflat, diagonal, diag_embed, meshgrid, cast, pad,
+    repeat_interleave, as_strided, moveaxis, swapaxes, atleast_1d,
+    atleast_2d, atleast_3d, view, unfold, tensordot, crop, slice,
+    strided_slice, numel, shape, increment, assign, bincount, histogram)
+from .ops.manipulation import unstack as unbind  # noqa: F401
+from .ops.creation import (  # noqa: F401
+    zeros, ones, full, empty, zeros_like, ones_like, full_like, empty_like,
+    arange, linspace, logspace, eye, clone, complex, polar, rand, randn,
+    uniform, normal, gaussian, randint, randint_like, randperm, multinomial,
+    bernoulli, poisson, standard_normal, standard_gamma)
+from .ops.linalg import (  # noqa: F401
+    mm, bmm, mv, t, einsum, norm, dist, cholesky, cholesky_solve, qr, svd,
+    pinv, det, slogdet, solve, triangular_solve, lstsq, lu, eig, eigh,
+    eigvals, eigvalsh, matrix_power, matrix_rank, corrcoef, cov,
+    histogramdd, bitwise_and, bitwise_or, bitwise_xor, bitwise_not,
+    bitwise_left_shift, bitwise_right_shift)
+from .ops.linalg import inv as inverse  # noqa: F401
+from .ops.comparison import (  # noqa: F401
+    equal, not_equal, greater_than, greater_equal, less_than, less_equal,
+    equal_all, allclose, isclose, logical_and, logical_or, logical_xor,
+    logical_not, is_empty)
+
+# -- subpackages -----------------------------------------------------------
+from . import ops  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import amp  # noqa: F401
+from . import io  # noqa: F401
+from . import linalg  # noqa: F401  (namespace module below)
+from . import framework  # noqa: F401
+from .framework.io import save, load  # noqa: F401
+from . import jit  # noqa: F401
+from . import device  # noqa: F401
+from .device import set_device, get_device, CPUPlace, CUDAPlace, XPUPlace, \
+    TPUPlace  # noqa: F401
+from . import flags as _flags_mod
+from .flags import set_flags, get_flags  # noqa: F401
+
+__version__ = "0.1.0"
+
+# paddle.disable_static / enable_static compat: this framework is always
+# "dygraph" at the API level; jit.to_static provides the compiled path.
+_static_mode = False
+
+
+def disable_static(place=None):
+    global _static_mode
+    _static_mode = False
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu has no legacy static-graph mode; use paddle_tpu.jit.to_static")
+
+
+def in_dynamic_mode():
+    return not _static_mode
+
+
+def in_dynamic_or_pir_mode():
+    return True
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def is_compiled_with_distribute():
+    return True
+
+
+def is_compiled_with_tpu():
+    return True
+
+
+def get_default_dtype():
+    return _dtype_mod.dtype(_default_dtype[0])
+
+
+def set_default_dtype(d):
+    _default_dtype[0] = _dtype_mod.dtype(d).name
+
+
+_default_dtype = ["float32"]

@@ -1,0 +1,6 @@
+"""AMP package (reference: python/paddle/amp)."""
+from .auto_cast import auto_cast, amp_guard, WHITE_LIST, BLACK_LIST, amp_state
+from .grad_scaler import GradScaler, AmpScaler
+
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "AmpScaler",
+           "WHITE_LIST", "BLACK_LIST"]

@@ -1,0 +1,8 @@
+"""Autograd package (reference: python/paddle/autograd + fluid/eager)."""
+from . import tape
+from .tape import no_grad, enable_grad, is_grad_enabled, set_grad_enabled, \
+    backward, grad
+from .py_layer import PyLayer, PyLayerContext
+
+__all__ = ["no_grad", "enable_grad", "is_grad_enabled", "set_grad_enabled",
+           "backward", "grad", "PyLayer", "PyLayerContext"]

@@ -1,0 +1,112 @@
+"""PyLayer: user-defined autograd ops.
+
+Reference: python/paddle/autograd/py_layer.py + paddle/fluid/eager/pylayer/.
+The TPU equivalent of choice for *jit* code is `jax.custom_vjp`; this class
+provides the dygraph-API shape on the tape: forward runs unrecorded, a single
+GradNode is installed whose vjp calls the user's backward.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.tree_util import tree_flatten, tree_unflatten
+
+from . import tape
+
+__all__ = ["PyLayer", "PyLayerContext"]
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self._materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+    def mark_not_inplace(self, *args):
+        pass
+
+    def mark_non_differentiable(self, *args):
+        self._non_diff = args
+
+    def set_materialize_grads(self, value):
+        self._materialize_grads = bool(value)
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..framework.tensor import Tensor
+
+        ctx = PyLayerContext()
+        flat, _ = tree_flatten((args, kwargs),
+                               is_leaf=lambda x: isinstance(x, Tensor))
+        tensor_inputs = [x for x in flat if isinstance(x, Tensor)]
+        record = tape.is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+
+        with tape.no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+
+        if not record:
+            return outs
+
+        out_flat, out_treedef = tree_flatten(
+            outs, is_leaf=lambda x: isinstance(x, Tensor))
+        out_tensors = [x for x in out_flat if isinstance(x, Tensor)]
+        out_avals = [jax.ShapeDtypeStruct(t._data.shape, t._data.dtype)
+                     for t in out_tensors]
+        diff_inputs = [t for t in tensor_inputs if not t.stop_gradient]
+
+        def vjp_fn(flat_cots):
+            cot_tensors = [Tensor(c, stop_gradient=True) for c in flat_cots]
+            with tape.no_grad():
+                grads = cls.backward(
+                    ctx, *(cot_tensors if len(cot_tensors) > 1
+                           else [cot_tensors[0]]))
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            # Align returned grads with forward's tensor inputs, then filter
+            # to the differentiable subset (paddle semantics: one grad per
+            # tensor input, None allowed).
+            g_by_input = list(grads) + [None] * (len(tensor_inputs) - len(grads))
+            out = []
+            for t, g in zip(tensor_inputs, g_by_input):
+                if t.stop_gradient:
+                    continue
+                out.append(None if g is None else
+                           (g._data if isinstance(g, Tensor) else g))
+            return tuple(out)
+
+        node = tape.GradNode(cls.__name__, vjp_fn, diff_inputs, out_avals)
+        new_out_flat = []
+        i = 0
+        for x in out_flat:
+            if isinstance(x, Tensor):
+                nt = Tensor(x._data, stop_gradient=False)
+                nt._grad_node = node
+                nt._out_index = i
+                i += 1
+                new_out_flat.append(nt)
+            else:
+                new_out_flat.append(x)
+        return tree_unflatten(out_treedef, new_out_flat)
+
+
+once_differentiable = staticmethod  # compat alias used by some paddle code

@@ -1,0 +1,217 @@
+"""Tape-based reverse-mode autograd over jax ops.
+
+The reference implements dygraph autograd as a C++ GradNode DAG built by
+generated ``<op>_ad_func`` wrappers and walked by ``egr::Backward``
+(paddle/fluid/eager/backward.cc:105,439).  On TPU we get every op's VJP from
+jax (`jax.vjp`), so the tape only needs to (a) record a node per op linking
+input/output tensors, (b) run a reverse-topological sweep accumulating
+cotangents.  The tape records plain functions of jax arrays, so it works both
+eagerly and inside a `jax.jit` trace (backward() under trace yields traced
+grads — this is how the compiled training step is built).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["GradNode", "no_grad", "enable_grad", "is_grad_enabled",
+           "set_grad_enabled", "backward", "grad"]
+
+
+class _TapeState(threading.local):
+    def __init__(self):
+        self.enabled = True
+        self.next_id = 0
+
+
+_state = _TapeState()
+
+
+def is_grad_enabled() -> bool:
+    return _state.enabled
+
+
+def set_grad_enabled(mode: bool):
+    _state.enabled = bool(mode)
+
+
+class _GradModeGuard:
+    def __init__(self, mode: bool):
+        self._mode = mode
+
+    def __enter__(self):
+        self._prev = _state.enabled
+        _state.enabled = self._mode
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with _GradModeGuard(self._mode):
+                return fn(*a, **k)
+
+        return wrapper
+
+
+def no_grad(func=None):
+    """Context manager / decorator disabling tape recording (paddle.no_grad)."""
+    g = _GradModeGuard(False)
+    return g(func) if callable(func) else g
+
+
+def enable_grad(func=None):
+    g = _GradModeGuard(True)
+    return g(func) if callable(func) else g
+
+
+class GradNode:
+    """One recorded op: maps output cotangents -> input cotangents.
+
+    ``vjp_fn`` takes a tuple of output cotangents (one per output, zeros
+    filled for unused outputs) and returns a tuple of input cotangents
+    aligned with ``inputs``.
+
+    Inputs are snapshotted as (tensor, producer_node, out_index) at record
+    time: in-place APIs rebind tensor handles to new nodes, so the recorded
+    graph must not chase the live ``_grad_node`` (it may point *forward*).
+    """
+
+    __slots__ = ("id", "name", "vjp_fn", "inputs", "out_avals")
+
+    def __init__(self, name: str, vjp_fn: Callable, inputs: Sequence[Any],
+                 out_avals: Sequence[Any]):
+        self.id = _state.next_id
+        _state.next_id += 1
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs = [(t, t._grad_node, t._out_index) for t in inputs]
+        self.out_avals = list(out_avals)  # jax.ShapeDtypeStruct per output
+
+    def __repr__(self):
+        return f"<GradNode {self.name}#{self.id}>"
+
+
+def _zeros_like_aval(aval):
+    if aval.dtype == jax.dtypes.float0:
+        import numpy as np
+        return np.zeros(aval.shape, jax.dtypes.float0)
+    return jnp.zeros(aval.shape, aval.dtype)
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False, _sink=None,
+             _capture=frozenset()):
+    """Reverse sweep from ``tensors`` accumulating into leaf ``.grad``.
+
+    Mirrors ``egr::Backward`` semantics: seeds with ones for scalar outputs,
+    walks nodes in reverse creation order (a valid reverse-topological order
+    for a tape), accumulates into ``Tensor.grad`` on leaves
+    (stop_gradient=False tensors with no grad node).
+
+    When ``_sink`` (a dict) is given, leaf cotangents go into
+    ``_sink[id(tensor)]`` instead of ``.grad`` — used by :func:`grad`.
+    """
+    from ..framework.tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor) or not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+
+    # node id -> list of output cotangents (lazily filled)
+    pending: dict[int, list] = {}
+    nodes: dict[int, GradNode] = {}
+
+    def seed(t: Tensor, g):
+        if t.stop_gradient:
+            return
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {t.shape}")
+            g = jnp.ones(t._data.shape, t._data.dtype)
+        else:
+            g = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        _accumulate(t, t._grad_node, t._out_index, g)
+
+    def _accumulate(t: Tensor, node, out_index, g):
+        if _sink is not None and (node is None or id(t) in _capture):
+            prev = _sink.get(id(t))
+            _sink[id(t)] = g if prev is None else prev + g
+            if node is None:
+                return
+        elif node is None:
+            # leaf: accumulate into .grad
+            prev = t._grad
+            t._grad = g if prev is None else prev + g
+            return
+        nodes[node.id] = node
+        cots = pending.get(node.id)
+        if cots is None:
+            cots = [None] * len(node.out_avals)
+            pending[node.id] = cots
+        cots[out_index] = g if cots[out_index] is None \
+            else cots[out_index] + g
+
+    for t, g in zip(tensors, grad_tensors):
+        seed(t, g)
+
+    # Reverse creation order == reverse topological order on a tape.
+    while nodes:
+        nid = max(nodes)
+        node = nodes.pop(nid)
+        cots = pending.pop(nid)
+        cots = tuple(
+            c if c is not None else _zeros_like_aval(a)
+            for c, a in zip(cots, node.out_avals))
+        in_cots = node.vjp_fn(cots)
+        for (t, prod_node, prod_idx), g in zip(node.inputs, in_cots):
+            if t is None or g is None:
+                continue
+            if not t.stop_gradient:
+                _accumulate(t, prod_node, prod_idx, g)
+        if not retain_graph:
+            node.vjp_fn = _used_vjp
+            node.inputs = []
+
+
+def _used_vjp(*_):
+    raise RuntimeError(
+        "Trying to backward through the graph a second time; "
+        "pass retain_graph=True if you need to.")
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
+         create_graph=False, allow_unused=False):
+    """paddle.grad: grads of outputs wrt inputs without touching .grad.
+
+    Implemented as a tape sweep into a side accumulator (reference:
+    general_grad.h selective subgraph).
+    """
+    from ..framework.tensor import Tensor
+
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    sink: dict[int, Any] = {}
+    backward(outputs, grad_outputs, retain_graph=retain_graph, _sink=sink,
+             _capture=frozenset(id(t) for t in inputs))
+    results = []
+    for t in inputs:
+        g = sink.get(id(t))
+        if g is None and not allow_unused:
+            g = jnp.zeros(t._data.shape, t._data.dtype)
+        results.append(Tensor(g, stop_gradient=True) if g is not None else None)
+    return results

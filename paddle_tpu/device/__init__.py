@@ -1,0 +1,119 @@
+"""Device management (reference: python/paddle/device, paddle/phi/backends).
+
+The TPU runtime has one device class; CPUPlace/CUDAPlace etc. are accepted
+for API compatibility and map onto jax devices.  `set_device` selects the
+default jax device used for new tensors.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["set_device", "get_device", "get_all_custom_device_type",
+           "CPUPlace", "CUDAPlace", "XPUPlace", "TPUPlace", "CustomPlace",
+           "cuda", "device_count", "is_available"]
+
+_current = None
+
+
+class _Place:
+    def __init__(self, device_id=0):
+        self.device_id = int(device_id)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.device_id})"
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.device_id == other.device_id
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+
+class CPUPlace(_Place):
+    def __init__(self):
+        super().__init__(0)
+
+    def __repr__(self):
+        return "Place(cpu)"
+
+
+class CUDAPlace(_Place):
+    pass
+
+
+class XPUPlace(_Place):
+    pass
+
+
+class TPUPlace(_Place):
+    pass
+
+
+class CustomPlace(_Place):
+    def __init__(self, dev_type, device_id=0):
+        super().__init__(device_id)
+        self.dev_type = dev_type
+
+
+def set_device(device: str):
+    """Accepts 'cpu', 'tpu', 'tpu:0', also 'gpu:0' (mapped to the default
+    accelerator for source compatibility)."""
+    global _current
+    name = device.split(":")[0]
+    idx = int(device.split(":")[1]) if ":" in device else 0
+    platform = {"cpu": "cpu", "tpu": None, "gpu": None, "xpu": None}.get(name)
+    try:
+        devs = jax.devices(platform) if platform else jax.devices()
+    except RuntimeError:
+        devs = jax.devices()
+    _current = devs[idx % len(devs)]
+    jax.config.update("jax_default_device", _current)
+    return _current
+
+
+def get_device() -> str:
+    d = _current or jax.devices()[0]
+    plat = d.platform
+    name = "gpu" if plat in ("tpu", "axon") else plat  # paddle-style string
+    return f"{name}:{d.id}" if plat != "cpu" else "cpu"
+
+
+def get_all_custom_device_type():
+    return ["tpu"]
+
+
+def device_count():
+    return len(jax.devices())
+
+
+def is_available():
+    return True
+
+
+class cuda:
+    """paddle.device.cuda compat shims (map to the accelerator)."""
+
+    @staticmethod
+    def device_count():
+        return len(jax.devices())
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        d = jax.devices()[0]
+        stats = getattr(d, "memory_stats", lambda: {})() or {}
+        return stats.get("peak_bytes_in_use", 0)
+
+    @staticmethod
+    def memory_allocated(device=None):
+        d = jax.devices()[0]
+        stats = getattr(d, "memory_stats", lambda: {})() or {}
+        return stats.get("bytes_in_use", 0)
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    @staticmethod
+    def synchronize(device=None):
+        import jax
+        (jax.device_put(0) + 0).block_until_ready()

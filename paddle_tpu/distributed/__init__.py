@@ -1,0 +1,8 @@
+"""paddle.distributed namespace — TPU-native (SURVEY §8: process groups →
+mesh axes, NCCL → XLA collectives over ICI/DCN)."""
+from . import env
+from .env import get_rank, get_world_size, init_parallel_env, ParallelEnv, \
+    is_initialized
+
+__all__ = ["env", "get_rank", "get_world_size", "init_parallel_env",
+           "ParallelEnv", "is_initialized"]

@@ -1,0 +1,68 @@
+"""Distributed environment state.
+
+Reference: env vars set by the launcher (PADDLE_TRAINER_ID,
+PADDLE_TRAINERS_NUM — python/paddle/distributed/launch) + ParallelEnv
+(python/paddle/distributed/parallel.py).  On TPU, process identity comes
+from jax.distributed / the TPU runtime; single-process SPMD over all local
+devices is the common case, where rank/world refer to *processes* (hosts)
+and mesh axes handle the device-level parallelism.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["get_rank", "get_world_size", "ParallelEnv", "init_parallel_env",
+           "is_initialized"]
+
+_initialized = False
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.get_group_rank(jax.process_index())
+    return int(os.environ.get("PADDLE_TRAINER_ID", jax.process_index()))
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", jax.process_count()))
+
+
+def is_initialized():
+    return _initialized
+
+
+def init_parallel_env():
+    """reference parallel.py:978 init_parallel_env — on TPU the runtime
+    already rendezvoused (jax.distributed), so this marks state and returns
+    the default group."""
+    global _initialized
+    _initialized = True
+    from .collective import _get_or_create_default_group
+    return _get_or_create_default_group()
+
+
+class ParallelEnv:
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6170")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []

@@ -1,0 +1,57 @@
+"""Global flag system.
+
+Reference: PHI_DEFINE_EXPORTED_* registry (paddle/common/flags.h:343,
+flags.cc — ~243 env-settable flags) + paddle.set_flags/get_flags.  Here a
+plain dict registry; flags are seeded from the environment at import
+(FLAGS_xxx env vars) like the reference.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any
+
+__all__ = ["define_flag", "set_flags", "get_flags", "FLAGS"]
+
+FLAGS: dict[str, Any] = {}
+_DEFS: dict[str, tuple[type, Any, str]] = {}
+
+
+def define_flag(name: str, default, help_: str = "", type_=None):
+    t = type_ or type(default)
+    _DEFS[name] = (t, default, help_)
+    env = os.environ.get(name)
+    if env is not None:
+        if t is bool:
+            FLAGS[name] = env.lower() in ("1", "true", "yes")
+        else:
+            FLAGS[name] = t(env)
+    else:
+        FLAGS[name] = default
+    return name
+
+
+def set_flags(flags: dict):
+    for k, v in flags.items():
+        if k not in _DEFS:
+            raise ValueError(f"unknown flag {k!r}")
+        FLAGS[k] = v
+
+
+def get_flags(keys):
+    if isinstance(keys, str):
+        keys = [keys]
+    return {k: FLAGS[k] for k in keys}
+
+
+# Core flags (subset of reference paddle/common/flags.cc with same names).
+define_flag("FLAGS_check_nan_inf", False, "check op outputs for nan/inf")
+define_flag("FLAGS_check_nan_inf_level", 0, "0: abort on nan/inf; 3: log only")
+define_flag("FLAGS_benchmark", False, "sync after every op for benchmarking")
+define_flag("FLAGS_use_deterministic_algorithms", False, "determinism switch")
+define_flag("FLAGS_embedding_deterministic", 0, "deterministic embedding grad")
+define_flag("FLAGS_cudnn_deterministic", False, "compat alias on TPU")
+define_flag("FLAGS_log_level", 0, "vlog level")
+define_flag("FLAGS_allocator_strategy", "auto_growth", "compat; XLA BFC governs")
+define_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.92, "compat")
+define_flag("FLAGS_tpu_matmul_precision", "default",
+            "jax default_matmul_precision for MXU")

@@ -1,0 +1,8 @@
+from . import dtype as dtype_module
+from .dtype import *  # noqa: F401,F403
+from .tensor import Tensor, to_tensor, is_tensor
+from .random import seed, get_rng_state, set_rng_state, Generator, \
+    default_generator, split_key, trace_key_guard
+
+__all__ = ["Tensor", "to_tensor", "is_tensor", "seed", "get_rng_state",
+           "set_rng_state", "Generator"]

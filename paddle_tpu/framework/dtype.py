@@ -1,0 +1,117 @@
+"""Dtype system.
+
+Paddle exposes dtypes as ``paddle.float32``-style singletons backed by a
+``VarDesc.VarType`` enum (reference: paddle/phi/common/data_type.h,
+python/paddle/framework/dtype.py).  Here each dtype is a thin singleton over a
+numpy dtype, so it converts transparently to jax/numpy while printing as
+``paddle.float32``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+# NB: "dtype" (the coercion function) is deliberately NOT in __all__ so that
+# `from .dtype import *` in the package __init__ doesn't shadow this module's
+# attribute on the package (framework.dtype must stay the module).
+__all__ = [
+    "DType", "convert_dtype", "to_np_dtype",
+    "bool_", "uint8", "int8", "int16", "int32", "int64",
+    "float16", "bfloat16", "float32", "float64",
+    "complex64", "complex128",
+]
+
+import ml_dtypes as _ml_dtypes
+
+
+class DType:
+    """A framework dtype: named singleton over a numpy dtype."""
+
+    _registry: dict[str, "DType"] = {}
+
+    __slots__ = ("name", "np_dtype")
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype)
+        DType._registry[name] = self
+
+    def __repr__(self):
+        return f"paddle.{self.name}"
+
+    # numpy/jax interop: np.dtype(paddle.float32) works.
+    @property
+    def itemsize(self):
+        return self.np_dtype.itemsize
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        try:
+            return self.np_dtype == np.dtype(to_np_dtype(other))
+        except TypeError:
+            return NotImplemented
+
+    def __hash__(self):
+        return hash(self.name)
+
+    @property
+    def is_floating_point(self):
+        return np.issubdtype(self.np_dtype, np.floating) or self.name == "bfloat16"
+
+    @property
+    def is_integer(self):
+        return np.issubdtype(self.np_dtype, np.integer)
+
+    @property
+    def is_complex(self):
+        return np.issubdtype(self.np_dtype, np.complexfloating)
+
+
+bool_ = DType("bool", np.bool_)
+uint8 = DType("uint8", np.uint8)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", _ml_dtypes.bfloat16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+
+_ALIASES = {
+    "bool": bool_,
+    "float8_e4m3fn": None,  # populated lazily below if ml_dtypes has them
+}
+
+_NP_TO_DTYPE = {d.np_dtype: d for d in DType._registry.values()}
+
+
+def dtype(x) -> DType:
+    """Coerce anything dtype-like to a DType singleton."""
+    if isinstance(x, DType):
+        return x
+    if isinstance(x, str):
+        d = DType._registry.get(x)
+        if d is not None:
+            return d
+    npd = np.dtype(x)
+    d = _NP_TO_DTYPE.get(npd)
+    if d is None:
+        raise TypeError(f"unsupported dtype: {x!r}")
+    return d
+
+
+def to_np_dtype(x):
+    """Convert dtype-like (DType, str, np/jnp dtype) to numpy dtype."""
+    if isinstance(x, DType):
+        return x.np_dtype
+    if isinstance(x, str) and x in DType._registry:
+        return DType._registry[x].np_dtype
+    return np.dtype(x)
+
+
+def convert_dtype(x) -> str:
+    """Paddle-compat: return canonical dtype name string."""
+    return dtype(x).name

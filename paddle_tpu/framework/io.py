@@ -1,0 +1,66 @@
+"""paddle.save / paddle.load.
+
+Reference: python/paddle/framework/io.py:773,1020 — pickle-based state_dict
+I/O.  Tensors are serialized as numpy arrays inside the pickle (protocol
+compatible enough for round-tripping within this framework); `paddle.load`
+rebuilds Tensors on the default device.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .tensor import Tensor
+
+__all__ = ["save", "load"]
+
+
+class _TensorPayload:
+    """Pickle stand-in for a Tensor."""
+
+    def __init__(self, array: np.ndarray, stop_gradient: bool, name: str):
+        self.array = array
+        self.stop_gradient = stop_gradient
+        self.name = name
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(obj.numpy(), obj.stop_gradient, obj.name)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        t = Tensor(obj.array, stop_gradient=obj.stop_gradient)
+        t.name = obj.name
+        return t
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)

@@ -1,0 +1,87 @@
+"""RNG state.
+
+Reference: phi::Generator (paddle/phi/core/generator.h) — a per-device
+stateful generator seeded by `paddle.seed`.  On TPU randomness is functional
+(threaded PRNG keys), so the "generator" holds a key and splits it per call.
+For compiled training steps, a traced key can be pushed with
+:func:`trace_key_guard` — inside that scope every split derives from the
+traced key via `fold_in` with a trace-time counter, so each call site gets an
+independent stream and the whole step stays a pure function of the key.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+__all__ = ["Generator", "default_generator", "seed", "get_rng_state",
+           "set_rng_state", "split_key", "trace_key_guard"]
+
+
+class Generator:
+    def __init__(self, seed_: int = 0):
+        self._key = jax.random.key(seed_)
+        self._seed = seed_
+
+    def manual_seed(self, seed_: int):
+        self._key = jax.random.key(seed_)
+        self._seed = seed_
+        return self
+
+    seed = manual_seed
+
+    def initial_seed(self):
+        return self._seed
+
+    def get_state(self):
+        return self._key
+
+    def set_state(self, state):
+        self._key = state
+
+    def split(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+
+class _TraceState(threading.local):
+    def __init__(self):
+        self.stack = []  # list of [key, counter]
+
+
+_trace = _TraceState()
+default_generator = Generator(0)
+
+
+def seed(s: int):
+    """paddle.seed."""
+    default_generator.manual_seed(int(s))
+    return default_generator
+
+
+def get_rng_state():
+    return default_generator.get_state()
+
+
+def set_rng_state(state):
+    default_generator.set_state(state)
+
+
+@contextlib.contextmanager
+def trace_key_guard(key):
+    """Make split_key() derive from ``key`` (possibly traced) in this scope."""
+    _trace.stack.append([key, 0])
+    try:
+        yield
+    finally:
+        _trace.stack.pop()
+
+
+def split_key():
+    """One fresh PRNG key for a random op."""
+    if _trace.stack:
+        entry = _trace.stack[-1]
+        entry[1] += 1
+        return jax.random.fold_in(entry[0], entry[1])
+    return default_generator.split()

@@ -1,0 +1,286 @@
+"""The framework Tensor: a Paddle-shaped handle over a jax.Array.
+
+Reference: paddle::Tensor (paddle/phi/api/include/tensor.h:82) over
+phi::DenseTensor (paddle/phi/core/dense_tensor.h:37) with an AutogradMeta
+slot (paddle/fluid/eager/autograd_meta.h).  Here the storage is a jax.Array
+(or a jax tracer during `jit` tracing — every method stays traceable), the
+autograd slot is a tape GradNode, and device/layout/distribution all live in
+the underlying jax.Array's sharding.  Arrays are immutable; "in-place" APIs
+rebind the handle, which is semantically equivalent for a single-threaded
+dygraph program and keeps the functional core jit-compatible.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtypes
+
+__all__ = ["Tensor", "to_tensor", "is_tensor"]
+
+
+def _default_dtype_for(data):
+    """Paddle default dtype rules: python/np float64 data → float32 (the
+    framework default float), ints stay int64, bools stay bool."""
+    if isinstance(data, bool):
+        return np.bool_
+    if isinstance(data, int):
+        return np.int64
+    if isinstance(data, float):
+        return np.float32
+    arr = data if isinstance(data, np.ndarray) else None
+    if arr is None and isinstance(data, (list, tuple)):
+        arr = np.asarray(data)
+    if arr is not None and arr.dtype == np.float64:
+        return np.float32
+    return None
+
+
+class Tensor:
+    """Eager tensor handle (paddle.Tensor API shape)."""
+
+    __slots__ = ("_data", "stop_gradient", "_grad", "_grad_node", "_out_index",
+                 "name", "persistable", "trainable", "__weakref__")
+
+    _next_name_id = 0
+
+    def __init__(self, data: Any, dtype=None, place=None, stop_gradient=True,
+                 name=None):
+        if isinstance(data, Tensor):
+            data = data._data
+        if dtype is not None:
+            npd = dtypes.to_np_dtype(dtype)
+            if isinstance(data, (jax.Array, jax.core.Tracer)):
+                data = data.astype(npd) if data.dtype != npd else data
+            else:
+                data = jnp.asarray(data, dtype=npd)
+        elif not isinstance(data, (jax.Array, jax.core.Tracer)):
+            d = _default_dtype_for(data)
+            data = jnp.asarray(data, dtype=d)
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self._grad = None           # jax array or None
+        self._grad_node = None      # tape.GradNode
+        self._out_index = 0
+        self.persistable = False
+        self.trainable = not stop_gradient
+        if name is None:
+            name = f"generated_tensor_{Tensor._next_name_id}"
+            Tensor._next_name_id += 1
+        self.name = name
+
+    # ------------------------------------------------------------- metadata
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    dim = ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def dtype(self) -> dtypes.DType:
+        return dtypes.dtype(self._data.dtype)
+
+    @property
+    def place(self):
+        try:
+            return next(iter(self._data.devices()))
+        except Exception:
+            return jax.devices()[0]
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    @property
+    def grad(self):
+        if self._grad is None:
+            return None
+        return Tensor(self._grad, stop_gradient=True)
+
+    @grad.setter
+    def grad(self, value):
+        if value is None:
+            self._grad = None
+        else:
+            self._grad = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+
+    def clear_grad(self, set_to_zero=False):
+        if set_to_zero and self._grad is not None:
+            self._grad = jnp.zeros_like(self._grad)
+        else:
+            self._grad = None
+
+    clear_gradient = clear_grad
+
+    # ------------------------------------------------------------ conversion
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __jax_array__(self):
+        return self._data
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        return bool(self._data)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __index__(self):
+        return int(self.item())
+
+    def __hash__(self):
+        return id(self)
+
+    # ------------------------------------------------------------- autograd
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from ..autograd import tape
+        tape.backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def detach(self):
+        t = Tensor(self._data, stop_gradient=True)
+        t.name = self.name + ".detach"
+        return t
+
+    def detach_(self):
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def register_hook(self, hook):
+        # Gradient hooks: wrap the current node's vjp. Minimal but functional.
+        from ..autograd import tape as _tape
+        node = self._grad_node
+        if node is None:
+            raise RuntimeError("register_hook on a leaf tensor requires a grad node")
+        idx = self._out_index
+        orig = node.vjp_fn
+
+        def hooked(flat_cots):
+            cots = list(flat_cots)
+            g = hook(Tensor(cots[idx], stop_gradient=True))
+            if g is not None:
+                cots[idx] = g._data if isinstance(g, Tensor) else g
+            return orig(tuple(cots))
+
+        node.vjp_fn = hooked
+        return hook
+
+    # ----------------------------------------------------------- rebinding
+    def _rebind_(self, other: "Tensor"):
+        """In-place semantics: point this handle at another result."""
+        self._data = other._data
+        self._grad_node = other._grad_node
+        self._out_index = other._out_index
+        self.stop_gradient = self.stop_gradient and other.stop_gradient
+        return self
+
+    def copy_(self, other, blocking=True):
+        other = to_tensor(other)
+        self._data = other._data.astype(self._data.dtype)
+        return self
+
+    def set_value(self, value):
+        value = to_tensor(value)
+        self._data = jnp.broadcast_to(
+            value._data.astype(self._data.dtype), self._data.shape)
+        return self
+
+    # ------------------------------------------------------------- printing
+    def __repr__(self):
+        prefix = "Tensor(shape={}, dtype={}, stop_gradient={},\n       ".format(
+            self.shape, self.dtype.name, self.stop_gradient)
+        try:
+            body = np.array2string(self.numpy(), separator=", ", prefix="       ")
+        except Exception:
+            body = f"<traced {self._data}>"
+        return prefix + body + ")"
+
+    __str__ = __repr__
+
+    # Device movement: all no-ops / placements on TPU runtime.
+    def cpu(self):
+        return Tensor(jax.device_get(self._data), stop_gradient=self.stop_gradient)
+
+    def cuda(self, device_id=None, blocking=True):
+        return self
+
+    def to(self, *args, **kwargs):
+        # to(dtype) / to(device) / to(device, dtype)
+        dt = kwargs.get("dtype")
+        for a in args:
+            if isinstance(a, (str, dtypes.DType)) and not isinstance(a, bool):
+                try:
+                    dt = dtypes.dtype(a)
+                except TypeError:
+                    continue
+        if dt is not None:
+            return self.astype(dt)
+        return self
+
+    def pin_memory(self):
+        return self
+
+    def contiguous(self):
+        return self
+
+    def is_contiguous(self):
+        return True
+
+    @property
+    def T(self):
+        from .. import ops
+        return ops.linalg.transpose(self, list(range(self.ndim))[::-1])
+
+    @property
+    def mT(self):
+        from .. import ops
+        perm = list(range(self.ndim))
+        perm[-2], perm[-1] = perm[-1], perm[-2]
+        return ops.linalg.transpose(self, perm)
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor."""
+    if isinstance(data, Tensor):
+        if dtype is not None and dtypes.dtype(dtype) != data.dtype:
+            data = data.astype(dtype)
+        t = Tensor(data._data, stop_gradient=stop_gradient)
+        t._grad_node = data._grad_node if not stop_gradient else None
+        t._out_index = data._out_index
+        return t
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)

@@ -1,0 +1,18 @@
+"""paddle.io: Dataset / DataLoader (reference: python/paddle/io/reader.py:262,
+dataloader/).  The multi-process worker pool + shared-memory ring of the
+reference maps to a thread-based prefetcher here (TPU input pipelines are
+host-CPU bound on decode, and jax arrays are materialized on device
+asynchronously); a C++ shared-memory DataLoader core is planned in
+runtime/ (SURVEY §8)."""
+from .dataset import Dataset, IterableDataset, TensorDataset, ChainDataset, \
+    ComposeDataset, ConcatDataset, Subset, random_split
+from .sampler import Sampler, SequenceSampler, RandomSampler, \
+    BatchSampler, DistributedBatchSampler, WeightedRandomSampler, \
+    SubsetRandomSampler
+from .dataloader import DataLoader, default_collate_fn
+
+__all__ = ["Dataset", "IterableDataset", "TensorDataset", "ChainDataset",
+           "ComposeDataset", "ConcatDataset", "Subset", "random_split",
+           "Sampler", "SequenceSampler", "RandomSampler", "BatchSampler",
+           "DistributedBatchSampler", "WeightedRandomSampler",
+           "SubsetRandomSampler", "DataLoader", "default_collate_fn"]

@@ -1,0 +1,9 @@
+"""paddle.jit namespace (reference: python/paddle/jit)."""
+from .api import to_static, not_to_static, ignore_module, InputSpec, \
+    StaticFunction, enable_to_static
+from .serialization import save, load, TranslatedLayer
+from .functional import TrainStep, train_step
+
+__all__ = ["to_static", "not_to_static", "ignore_module", "InputSpec",
+           "StaticFunction", "enable_to_static", "save", "load",
+           "TranslatedLayer", "TrainStep", "train_step"]

@@ -1,0 +1,261 @@
+"""paddle.jit.to_static — the dygraph-to-static story on TPU.
+
+Reference: python/paddle/jit/api.py + dy2static/program_translator.py (AST
+rewrite + SOT bytecode tracing building a PIR program, cached per input
+spec).  Here none of that machinery is needed: every framework op is a pure
+jax function, so tracing the user's Python once with the autograd tape
+disabled yields the whole program as one jaxpr → one XLA executable.
+Control-flow rewriting (AST/SOT) is subsumed by jax tracing; data-dependent
+Python branches take the traced path per input-signature cache entry, which
+matches SOT's guard-and-specialize behavior.
+
+Training works through the tape: the jitted pure function becomes a single
+GradNode via jax.vjp (pjit's transpose is compiled+cached by XLA), so
+`loss.backward()` after a to_static forward runs one compiled backward.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import numpy as np
+from jax.tree_util import tree_flatten, tree_unflatten
+
+from ..framework.tensor import Tensor
+from ..autograd import tape
+from ..framework import random as _random
+
+__all__ = ["to_static", "not_to_static", "ignore_module", "InputSpec",
+           "StaticFunction", "enable_to_static"]
+
+
+class InputSpec:
+    """reference: python/paddle/static/input.py InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=False):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+_to_static_enabled = [True]
+
+
+def enable_to_static(flag=True):
+    _to_static_enabled[0] = bool(flag)
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+class StaticFunction:
+    def __init__(self, function, input_spec=None, build_strategy=None,
+                 backend=None, full_graph=True):
+        from ..nn.layer import Layer
+        if isinstance(function, Layer):
+            self._layer = function
+            self._function = function.forward
+        else:
+            self._layer = getattr(function, "__self__", None)
+            if self._layer is not None and not isinstance(self._layer, Layer):
+                self._layer = None
+            self._function = function
+        self._input_spec = input_spec
+        self._jit_cache: dict[Any, Any] = {}
+        functools.update_wrapper(self, self._function)
+
+    # -- helpers ------------------------------------------------------------
+    def _state(self):
+        if self._layer is None:
+            return {}, {}
+        params, bufs = {}, {}
+        for name, p in self._layer.named_parameters():
+            (params if not p.stop_gradient else bufs)[name] = p._data
+        for name, b in self._layer.named_buffers():
+            bufs["buffers." + name] = b._data
+        return params, bufs
+
+    def _make_pure(self, static_key, args_treedef, n_args, training):
+        layer = self._layer
+        fn = self._function
+
+        def pure(params, bufs, key, *flat_arrays):
+            with tape.no_grad(), _random.trace_key_guard(key):
+                if layer is not None:
+                    saved = layer.functional_state()
+                    layer.load_functional_state({**params, **{
+                        k: v for k, v in bufs.items()}})
+                try:
+                    wrapped = [Tensor(a, stop_gradient=True)
+                               for a in flat_arrays]
+                    args, kwargs = tree_unflatten(args_treedef, wrapped)
+                    out = fn(*args, **kwargs)
+                    out_flat, out_tree = tree_flatten(out, is_leaf=_is_tensor)
+                    out_arrays = [o._data if isinstance(o, Tensor) else o
+                                  for o in out_flat]
+                    new_bufs = {}
+                    if layer is not None:
+                        cur = layer.functional_state()
+                        for k in bufs:
+                            new_bufs[k] = cur.get(
+                                k, cur.get(k.replace("buffers.", ""), bufs[k]))
+                    return out_arrays, new_bufs, out_tree
+                finally:
+                    if layer is not None:
+                        layer.load_functional_state(saved)
+
+        # out_tree is static python data — hoist it via a container
+        out_tree_box = []
+
+        def pure_arrays_only(params, bufs, key, *flat_arrays):
+            out_arrays, new_bufs, out_tree = pure(params, bufs, key,
+                                                  *flat_arrays)
+            if not out_tree_box:
+                out_tree_box.append(out_tree)
+            return out_arrays, new_bufs
+
+        jitted = jax.jit(pure_arrays_only)
+        return jitted, out_tree_box
+
+    def __call__(self, *args, **kwargs):
+        if not _to_static_enabled[0]:
+            return self._function(*args, **kwargs)
+        if self._layer is not None and args and args[0] is self._layer:
+            args = args[1:]
+
+        flat, args_treedef = tree_flatten((args, kwargs), is_leaf=_is_tensor)
+        tensor_idx = [i for i, x in enumerate(flat) if isinstance(x, Tensor)]
+        tensors = [flat[i] for i in tensor_idx]
+        # static key: everything non-tensor + tensor shapes/dtypes + mode
+        training = self._layer.training if self._layer is not None else False
+        static_parts = tuple(
+            (tuple(x.shape), str(x.dtype)) if isinstance(x, Tensor)
+            else repr(x) for x in flat)
+        key = (static_parts, training)
+
+        if key not in self._jit_cache:
+            # treedef where tensor leaves stay leaves, others are baked in
+            self._jit_cache[key] = self._make_pure(key, args_treedef,
+                                                   len(flat), training)
+        jitted, out_tree_box = self._jit_cache[key]
+
+        params, bufs = self._state()
+        rng = _random.split_key()
+        flat_arrays = [x._data if isinstance(x, Tensor) else x for x in flat]
+
+        diff_tensors = [t for t in tensors if not t.stop_gradient]
+        record = tape.is_grad_enabled() and (
+            bool(params) or bool(diff_tensors))
+
+        if not record:
+            out_arrays, new_bufs = jitted(params, bufs, rng, *flat_arrays)
+            self._apply_bufs(new_bufs)
+            return self._wrap_out(out_arrays, out_tree_box[0], node=None)
+
+        # differentiate w.r.t. params and diff tensor args
+        diff_positions = [i for i, x in enumerate(flat)
+                          if isinstance(x, Tensor) and not x.stop_gradient]
+
+        def closed(p, *diff_arrays):
+            fa = list(flat_arrays)
+            for pos, a in zip(diff_positions, diff_arrays):
+                fa[pos] = a
+            return jitted(p, bufs, rng, *fa)
+
+        (out_arrays, new_bufs), raw_vjp = jax.vjp(
+            closed, params, *[flat[i]._data for i in diff_positions])
+        self._apply_bufs(new_bufs)
+
+        out_avals = [jax.ShapeDtypeStruct(np.shape(a), _tan_dtype(a))
+                     for a in out_arrays]
+        param_tensors = dict(self._layer.named_parameters()) \
+            if self._layer is not None else {}
+        diff_params = [param_tensors[k] for k in params]
+        inputs = diff_params + [flat[i] for i in diff_positions]
+
+        def vjp_fn(flat_cots):
+            cots = (list(flat_cots), _zeros_like_tree(new_bufs))
+            pgrads, *agrads = raw_vjp(cots)
+            return tuple([pgrads[k] for k in params] + list(agrads))
+
+        node = tape.GradNode(f"to_static:{self._function.__name__}", vjp_fn,
+                             inputs, out_avals)
+        return self._wrap_out(out_arrays, out_tree_box[0], node=node)
+
+    def _apply_bufs(self, new_bufs):
+        if self._layer is None or not new_bufs:
+            return
+        bufs = dict(self._layer.named_buffers())
+        params = dict(self._layer.named_parameters())
+        for k, v in new_bufs.items():
+            if k.startswith("buffers."):
+                bufs[k[len("buffers."):]]._data = v
+            elif k in params:
+                params[k]._data = v
+
+    def _wrap_out(self, out_arrays, out_tree, node):
+        wrapped = []
+        for i, a in enumerate(out_arrays):
+            diff = node is not None and _tan_dtype(a) != jax.dtypes.float0
+            t = Tensor(a, stop_gradient=not diff)
+            if diff:
+                t._grad_node = node
+                t._out_index = i
+            wrapped.append(t)
+        return tree_unflatten(out_tree, wrapped)
+
+    # concrete program access for save/export
+    def get_concrete_program(self, *args, **kwargs):
+        return self
+
+    @property
+    def code(self):
+        import inspect
+        return inspect.getsource(self._function)
+
+
+def _tan_dtype(a):
+    dt = np.result_type(a)
+    if np.issubdtype(dt, np.inexact) or dt == np.dtype("bfloat16"):
+        return dt
+    return jax.dtypes.float0
+
+
+def _zeros_like_tree(tree):
+    import jax.numpy as jnp
+    return jax.tree_util.tree_map(lambda x: jnp.zeros(x.shape, x.dtype), tree)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True, **kwargs):
+    """Decorator/wrapper: compile a function or Layer with XLA."""
+    def decorate(fn):
+        from ..nn.layer import Layer
+        if isinstance(fn, Layer):
+            static = StaticFunction(fn, input_spec, build_strategy, backend,
+                                    full_graph)
+            fn.forward = static
+            fn._static_function = static
+            return fn
+        return StaticFunction(fn, input_spec, build_strategy, backend,
+                              full_graph)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn=None):
+    if fn is None:
+        return lambda f: f
+    return fn
+
+
+def ignore_module(modules):
+    pass

@@ -1,0 +1,96 @@
+"""Compiled training step: the TPU hot path.
+
+The reference's static-graph training (Executor over a PIR program with
+fused kernels) maps to a single jitted function of
+(params, opt_state, batch, key) -> (loss, params, opt_state): forward,
+backward, and optimizer update fused into one XLA executable, parameters
+donated so updates happen in-place in HBM.
+
+`TrainStep` drives a stock `nn.Layer` + `optimizer.Optimizer` through this
+path without the user rewriting anything: it re-runs the tape under trace
+(all op bodies are pure jax) and captures the optimizer's state pytree.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..framework import random as _random
+from ..autograd import tape
+
+__all__ = ["TrainStep", "train_step"]
+
+
+class TrainStep:
+    def __init__(self, model, optimizer, loss_fn: Callable, donate=True):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self._compiled = None
+        self._donate = donate
+
+    def _build(self):
+        model, optimizer, loss_fn = self.model, self.optimizer, self.loss_fn
+
+        def step(params, bufs, opt_state, key, *batch):
+            with _random.trace_key_guard(key):
+                # load traced state into the live objects
+                saved = model.functional_state()
+                model.load_functional_state({**params, **bufs})
+                optimizer.load_opt_state(opt_state)
+                param_objs = {name: p for name, p in model.named_parameters()}
+                try:
+                    inputs = [Tensor(b, stop_gradient=True) for b in batch]
+                    with tape.enable_grad():
+                        loss = loss_fn(model, *inputs)
+                        loss.backward()
+                    optimizer.step()
+                    optimizer.clear_grad()
+                    new_params = {k: param_objs[k]._data for k in params}
+                    new_bufs = {k: v for k, v in model.functional_state().items()
+                                if k in bufs}
+                    new_opt = optimizer.opt_state()
+                    return loss._data, new_params, new_bufs, new_opt
+                finally:
+                    model.load_functional_state(saved)
+
+        donate = (0, 2) if self._donate else ()
+        return jax.jit(step, donate_argnums=donate)
+
+    def __call__(self, *batch):
+        """Run one compiled step; returns the loss Tensor."""
+        if self._compiled is None:
+            self._compiled = self._build()
+        model, optimizer = self.model, self.optimizer
+        params = {}
+        bufs = {}
+        for name, p in model.named_parameters():
+            params[name] = p._data
+        for name, b in model.named_buffers():
+            bufs["buffers." + name] = b._data
+        opt_state = optimizer.opt_state()
+        key = _random.split_key()
+        arrays = [b._data if isinstance(b, Tensor) else jnp.asarray(b)
+                  for b in batch]
+        loss, new_params, new_bufs, new_opt = self._compiled(
+            params, bufs, opt_state, key, *arrays)
+        # write results back into the live objects
+        model.load_functional_state({**new_params, **new_bufs})
+        optimizer.load_opt_state(new_opt)
+        if optimizer._lr_scheduler is not None:
+            pass  # user steps the scheduler per paddle convention
+        return Tensor(loss, stop_gradient=True)
+
+
+def train_step(model, optimizer, loss_fn):
+    """Build a compiled train step:
+
+        step = paddle_tpu.jit.train_step(model, opt,
+                    lambda m, x, y: F.cross_entropy(m(x), y))
+        loss = step(x_batch, y_batch)
+    """
+    return TrainStep(model, optimizer, loss_fn)

@@ -1,0 +1,140 @@
+"""jit.save / jit.load (reference: python/paddle/jit/api.py save/load →
+.json/.pdiparams PIR program format).  The TPU-native serialized program is
+StableHLO via jax.export: portable, versioned, loadable without the Python
+model code — the same deployment story as the reference's inference format.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+
+import jax
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..framework.dtype import to_np_dtype
+
+__all__ = ["save", "load", "TranslatedLayer"]
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Serialize layer: params (.pdiparams), StableHLO program (.stablehlo),
+    metadata (.json)."""
+    from ..nn.layer import Layer
+    from .api import StaticFunction, InputSpec
+
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+
+    if isinstance(layer, Layer):
+        fn = layer.forward
+        state = {k: np.asarray(v.numpy()) for k, v in layer.state_dict().items()}
+        model = layer
+    elif isinstance(layer, StaticFunction):
+        fn = layer
+        model = layer._layer
+        state = {k: np.asarray(v.numpy())
+                 for k, v in model.state_dict().items()} if model else {}
+    else:
+        fn = layer
+        model = None
+        state = {}
+
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(state, f, protocol=4)
+
+    meta = {"format": "paddle_tpu.stablehlo.v1"}
+    exported_ok = False
+    if input_spec:
+        try:
+            specs = [jax.ShapeDtypeStruct(
+                tuple(1 if s in (-1, None) else s for s in sp.shape),
+                to_np_dtype(sp.dtype)) for sp in input_spec]
+            was_training = model.training if model is not None else False
+            if model is not None:
+                model.eval()
+            pure = _make_eval_fn(model, fn)
+            exp = jax.export.export(jax.jit(pure))(
+                {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                 for k, v in state.items()}, *specs)
+            with open(path + ".stablehlo", "wb") as f:
+                f.write(exp.serialize())
+            meta["input_specs"] = [
+                {"shape": sp.shape, "dtype": str(sp.dtype)} for sp in input_spec]
+            exported_ok = True
+            if model is not None and was_training:
+                model.train()
+        except Exception as e:  # export is best-effort; params always saved
+            meta["export_error"] = str(e)
+    meta["exported"] = exported_ok
+    with open(path + ".json", "w") as f:
+        json.dump(meta, f)
+
+
+def _make_eval_fn(model, fn):
+    from ..autograd import tape
+
+    def pure(state, *arrays):
+        with tape.no_grad():
+            if model is not None:
+                saved = model.functional_state()
+                merged = dict(saved)
+                for k, v in state.items():
+                    if k in merged:
+                        merged[k] = v
+                    elif "buffers." + k in merged:
+                        merged["buffers." + k] = v
+                model.load_functional_state(merged)
+            try:
+                inputs = [Tensor(a, stop_gradient=True) for a in arrays]
+                call = model.forward if model is not None else fn
+                if isinstance(call, object) and hasattr(call, "_function"):
+                    call = call._function
+                out = call(*inputs)
+                if isinstance(out, (list, tuple)):
+                    return [o._data if isinstance(o, Tensor) else o for o in out]
+                return out._data if isinstance(out, Tensor) else out
+            finally:
+                if model is not None:
+                    model.load_functional_state(saved)
+
+    return pure
+
+
+class TranslatedLayer:
+    """Loaded serialized program (reference: translated_layer.py)."""
+
+    def __init__(self, exported, state):
+        self._exported = exported
+        self._state = state
+        self.training = False
+
+    def __call__(self, *args):
+        arrays = [a._data if isinstance(a, Tensor) else np.asarray(a)
+                  for a in args]
+        out = self._exported.call(self._state, *arrays)
+        if isinstance(out, (list, tuple)):
+            return [Tensor(o, stop_gradient=True) for o in out]
+        return Tensor(out, stop_gradient=True)
+
+    def eval(self):
+        return self
+
+    def state_dict(self):
+        return {k: Tensor(v) for k, v in self._state.items()}
+
+
+def load(path, **configs):
+    with open(path + ".pdiparams", "rb") as f:
+        state = pickle.load(f)
+    meta_path = path + ".json"
+    hlo_path = path + ".stablehlo"
+    if os.path.exists(hlo_path):
+        with open(hlo_path, "rb") as f:
+            exported = jax.export.deserialize(f.read())
+        return TranslatedLayer(exported, state)
+    raise FileNotFoundError(
+        f"{hlo_path} not found: model was saved without input_spec; "
+        "load params via paddle_tpu.load + set_state_dict instead")

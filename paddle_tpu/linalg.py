@@ -1,0 +1,8 @@
+"""paddle.linalg namespace (reference: python/paddle/linalg.py re-exports)."""
+from .ops.linalg import (  # noqa: F401
+    matmul, mm, bmm, mv, t, einsum, norm, vector_norm, matrix_norm, dist,
+    cholesky, cholesky_solve, qr, svd, svdvals, pca_lowrank, inv, pinv, det,
+    slogdet, solve, triangular_solve, lstsq, lu, eig, eigh, eigvals,
+    eigvalsh, matrix_power, matrix_rank, cond, corrcoef, cov,
+    householder_product, matrix_exp)
+from .ops.math import cross, dot  # noqa: F401

@@ -1,0 +1,13 @@
+"""paddle.nn namespace (reference: python/paddle/nn/__init__.py)."""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer import Layer, Parameter, ParamAttr  # noqa: F401
+from .layer_common import *  # noqa: F401,F403
+from .layer_conv import Conv1D, Conv2D, Conv3D, Conv2DTranspose  # noqa: F401
+from .layer_norm import *  # noqa: F401,F403
+from .layer_pool import *  # noqa: F401,F403
+from .layer_loss import *  # noqa: F401,F403
+from .transformer import (  # noqa: F401
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer)
+from .clip import ClipGradByNorm, ClipGradByValue, ClipGradByGlobalNorm  # noqa: F401

@@ -1,0 +1,72 @@
+"""Gradient clipping (reference: python/paddle/nn/clip.py —
+ClipGradByGlobalNorm etc., applied inside optimizer.step; the hybrid-parallel
+variant reduces the norm across mesh axes, see
+distributed/fleet/hybrid_optimizer.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["ClipGradBase", "ClipGradByValue", "ClipGradByNorm",
+           "ClipGradByGlobalNorm"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, jnp.clip(g, self.min, self.max)))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            factor = jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+            out.append((p, (g.astype(jnp.float32) * factor).astype(g.dtype)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def global_norm(self, grads):
+        sq = [jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads
+              if g is not None]
+        if not sq:
+            return jnp.asarray(0.0, jnp.float32)
+        return jnp.sqrt(sum(sq))
+
+    def __call__(self, params_grads):
+        gn = self.global_norm([g for p, g in params_grads
+                               if g is not None and getattr(p, "need_clip", True)])
+        factor = jnp.minimum(self.clip_norm / jnp.maximum(gn, 1e-12), 1.0)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, (g.astype(jnp.float32) * factor).astype(g.dtype)))
+        return out

@@ -1,0 +1,971 @@
+"""nn.functional (reference: python/paddle/nn/functional/*; phi kernels
+activation/conv/pool/norm/loss/...).  Each entry is a registered op: one
+jax-pure body, one VJP, XLA fuses the elementwise chains into the matmuls.
+Convolutions keep Paddle's NCHW/OIHW layout contract; XLA re-layouts for TPU
+internally."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.registry import op
+from ..framework.dtype import to_np_dtype
+from ..framework import random as _random
+
+# --------------------------------------------------------------- activations
+
+@op
+def relu(x, name=None):
+    return jax.nn.relu(x)
+
+
+@op
+def relu6(x, name=None):
+    return jnp.clip(x, 0, 6)
+
+
+@op
+def gelu(x, approximate=False, name=None):
+    return jax.nn.gelu(x, approximate=bool(approximate))
+
+
+@op
+def silu(x, name=None):
+    return jax.nn.silu(x)
+
+
+swish = silu
+
+
+@op
+def sigmoid(x, name=None):
+    return jax.nn.sigmoid(x)
+
+
+@op
+def tanh(x, name=None):
+    return jnp.tanh(x)
+
+
+@op
+def softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        x = x.astype(to_np_dtype(dtype))
+    return jax.nn.softmax(x, axis=axis)
+
+
+@op
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        x = x.astype(to_np_dtype(dtype))
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@op
+def softmin(x, axis=-1, name=None):
+    return jax.nn.softmax(-x, axis=axis)
+
+
+@op
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+@op
+def elu(x, alpha=1.0, name=None):
+    return jax.nn.elu(x, alpha)
+
+
+@op
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@op
+def celu(x, alpha=1.0, name=None):
+    return jax.nn.celu(x, alpha)
+
+
+@op
+def prelu(x, weight, data_format="NCHW", name=None):
+    if weight.size == 1:
+        w = weight.reshape(())
+    else:
+        c_axis = 1 if data_format == "NCHW" else x.ndim - 1
+        shape = [1] * x.ndim
+        shape[c_axis] = -1
+        w = weight.reshape(shape)
+    return jnp.where(x > 0, x, w * x)
+
+
+@op
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    if training:
+        a = jax.random.uniform(_random.split_key(), x.shape, jnp.float32,
+                               lower, upper).astype(x.dtype)
+    else:
+        a = jnp.asarray((lower + upper) / 2, x.dtype)
+    return jnp.where(x >= 0, x, a * x)
+
+
+@op
+def hardswish(x, name=None):
+    return x * jnp.clip(x + 3, 0, 6) / 6
+
+
+@op
+def hardsigmoid(x, slope=1.0 / 6.0, offset=0.5, name=None):
+    return jnp.clip(x * slope + offset, 0, 1)
+
+
+@op
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return jnp.clip(x, min, max)
+
+
+@op
+def hardshrink(x, threshold=0.5, name=None):
+    return jnp.where(jnp.abs(x) > threshold, x, jnp.zeros((), x.dtype))
+
+
+@op
+def softshrink(x, threshold=0.5, name=None):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold,
+                               jnp.zeros((), x.dtype)))
+
+
+@op
+def tanhshrink(x, name=None):
+    return x - jnp.tanh(x)
+
+
+@op
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    scaled = beta * x
+    return jnp.where(scaled > threshold, x,
+                     jnp.log1p(jnp.exp(scaled)) / beta)
+
+
+@op
+def softsign(x, name=None):
+    return jax.nn.soft_sign(x)
+
+
+@op
+def mish(x, name=None):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@op
+def glu(x, axis=-1, name=None):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+@op
+def maxout(x, groups, axis=1, name=None):
+    c = x.shape[axis]
+    shape = list(x.shape)
+    shape[axis] = c // groups
+    shape.insert(axis + 1, groups)
+    return jnp.max(x.reshape(shape), axis=axis + 1)
+
+
+@op
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    g = -jnp.log(-jnp.log(
+        jax.random.uniform(_random.split_key(), x.shape, jnp.float32) + 1e-20)
+        + 1e-20).astype(x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        y_hard = jax.nn.one_hot(jnp.argmax(y, axis=axis), x.shape[axis],
+                                axis=axis, dtype=x.dtype)
+        y = y_hard + y - jax.lax.stop_gradient(y)
+    return y
+
+
+# ------------------------------------------------------------------- linear
+
+@op
+def linear(x, weight, bias=None, name=None):
+    # paddle weight layout: [in_features, out_features]
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@op
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None:
+        mask = (x == padding_idx)[..., None]
+        out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+    return out
+
+
+@op
+def one_hot(x, num_classes, name=None):
+    return jax.nn.one_hot(x, num_classes, dtype=jnp.float32)
+
+
+@op
+def bilinear(x1, x2, weight, bias=None, name=None):
+    out = jnp.einsum("bi,oij,bj->bo", x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+# ------------------------------------------------------------------ dropout
+
+@op
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return x * (1.0 - p)
+        return x
+    if p == 1.0:
+        return jnp.zeros_like(x)
+    shape = list(x.shape)
+    if axis is not None:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+    keep = jax.random.bernoulli(_random.split_key(), 1.0 - p, tuple(shape))
+    if mode == "upscale_in_train":
+        return jnp.where(keep, x / (1.0 - p), jnp.zeros((), x.dtype))
+    return jnp.where(keep, x, jnp.zeros((), x.dtype))
+
+
+@op
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    if not training or p == 0.0:
+        return x
+    c_axis = 1 if data_format == "NCHW" else 3
+    shape = [x.shape[0], 1, 1, 1]
+    shape[c_axis] = x.shape[c_axis]
+    keep = jax.random.bernoulli(_random.split_key(), 1.0 - p, tuple(shape))
+    return jnp.where(keep, x / (1.0 - p), jnp.zeros((), x.dtype))
+
+
+@op
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    alpha_p = -1.7580993408473766
+    keep = jax.random.bernoulli(_random.split_key(), 1.0 - p, x.shape)
+    a = (1.0 / math.sqrt((alpha_p ** 2 * p + 1) * (1 - p)))
+    b = -a * alpha_p * p
+    return a * jnp.where(keep, x, jnp.asarray(alpha_p, x.dtype)) + b
+
+
+# -------------------------------------------------------------------- conv
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(i) for i in v)
+    return (int(v),) * n
+
+
+def _conv_padding(padding, nd):
+    if isinstance(padding, str):
+        return padding.upper()  # 'SAME' / 'VALID'
+    if isinstance(padding, int):
+        return [(padding, padding)] * nd
+    padding = list(padding)
+    if len(padding) == nd and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * nd:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(nd)]
+    return [tuple(p) for p in padding]
+
+
+@op
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    nd = 2
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, weight.shape,
+        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW"
+        else ("NHWC", "OIHW", "NHWC"))
+    out = jax.lax.conv_general_dilated(
+        x, weight, window_strides=_pair(stride, nd),
+        padding=_conv_padding(padding, nd),
+        rhs_dilation=_pair(dilation, nd), dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=None)
+    if bias is not None:
+        shape = [1, -1, 1, 1] if data_format == "NCHW" else [1, 1, 1, -1]
+        out = out + bias.reshape(shape)
+    return out
+
+
+@op
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, weight.shape,
+        ("NCH", "OIH", "NCH") if data_format == "NCL" else ("NHC", "OIH", "NHC"))
+    out = jax.lax.conv_general_dilated(
+        x, weight, window_strides=_pair(stride, 1),
+        padding=_conv_padding(padding, 1),
+        rhs_dilation=_pair(dilation, 1), dimension_numbers=dn,
+        feature_group_count=groups)
+    if bias is not None:
+        shape = [1, -1, 1] if data_format == "NCL" else [1, 1, -1]
+        out = out + bias.reshape(shape)
+    return out
+
+
+@op
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, weight.shape, ("NCDHW", "OIDHW", "NCDHW"))
+    out = jax.lax.conv_general_dilated(
+        x, weight, window_strides=_pair(stride, 3),
+        padding=_conv_padding(padding, 3),
+        rhs_dilation=_pair(dilation, 3), dimension_numbers=dn,
+        feature_group_count=groups)
+    if bias is not None:
+        out = out + bias.reshape([1, -1, 1, 1, 1])
+    return out
+
+
+@op
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    # paddle weight layout: [in, out/groups, kh, kw]
+    nd = 2
+    strides = _pair(stride, nd)
+    pads = _conv_padding(padding, nd)
+    if isinstance(pads, str):
+        pads_list = pads
+    else:
+        pads_list = pads
+    kh, kw = weight.shape[2], weight.shape[3]
+    dil = _pair(dilation, nd)
+    opad = _pair(output_padding, nd)
+    # Use gradient-of-conv formulation: conv_transpose in jax flips spatial dims
+    w = jnp.swapaxes(weight, 0, 1)  # [out/groups, in, kh, kw] -> IOHW->OIHW-ish
+    if isinstance(pads_list, str):
+        padding_cfg = pads_list
+    else:
+        # effective padding for transpose: k-1-p
+        padding_cfg = [
+            (dil[i] * (weight.shape[2 + i] - 1) - pads_list[i][0],
+             dil[i] * (weight.shape[2 + i] - 1) - pads_list[i][1] + opad[i])
+            for i in range(nd)]
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
+    w_flip = jnp.flip(w, axis=(2, 3))
+    if groups > 1:
+        # grouped transpose: split, run per group, concat
+        xs = jnp.split(x, groups, axis=1)
+        ws = jnp.split(w_flip, groups, axis=0)
+        outs = [jax.lax.conv_general_dilated(
+            xi, wi, window_strides=(1, 1), padding=padding_cfg,
+            lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn)
+            for xi, wi in zip(xs, ws)]
+        out = jnp.concatenate(outs, axis=1)
+    else:
+        out = jax.lax.conv_general_dilated(
+            x, w_flip, window_strides=(1, 1), padding=padding_cfg,
+            lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn)
+    if bias is not None:
+        out = out + bias.reshape([1, -1, 1, 1])
+    return out
+
+
+# ------------------------------------------------------------------- pooling
+
+@op
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    k = _pair(kernel_size)
+    s = _pair(stride if stride is not None else kernel_size)
+    pads = _conv_padding(padding, 2)
+    if data_format == "NCHW":
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        pad_cfg = [(0, 0), (0, 0)] + (pads if not isinstance(pads, str) else pads)
+    else:
+        window = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        pad_cfg = [(0, 0)] + pads + [(0, 0)]
+    neg = np.asarray(-np.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                     else np.iinfo(x.dtype).min, x.dtype)
+    out = jax.lax.reduce_window(x, neg, jax.lax.max, window, strides, pad_cfg)
+    return out
+
+
+@op
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    k = _pair(kernel_size)
+    s = _pair(stride if stride is not None else kernel_size)
+    pads = _conv_padding(padding, 2)
+    if data_format == "NCHW":
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        pad_cfg = [(0, 0), (0, 0)] + pads
+    else:
+        window = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        pad_cfg = [(0, 0)] + pads + [(0, 0)]
+    summed = jax.lax.reduce_window(x, np.zeros((), x.dtype), jax.lax.add,
+                                   window, strides, pad_cfg)
+    if divisor_override:
+        return summed / divisor_override
+    if exclusive:
+        ones = jnp.ones_like(x)
+        counts = jax.lax.reduce_window(ones, np.zeros((), x.dtype),
+                                       jax.lax.add, window, strides, pad_cfg)
+        return summed / counts
+    return summed / (k[0] * k[1])
+
+
+@op
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    k = _pair(kernel_size, 1)
+    s = _pair(stride if stride is not None else kernel_size, 1)
+    pads = _conv_padding(padding, 1)
+    neg = np.asarray(-np.inf, x.dtype)
+    return jax.lax.reduce_window(x, neg, jax.lax.max, (1, 1) + k, (1, 1) + s,
+                                 [(0, 0), (0, 0)] + pads)
+
+
+@op
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    k = _pair(kernel_size, 1)
+    s = _pair(stride if stride is not None else kernel_size, 1)
+    pads = _conv_padding(padding, 1)
+    summed = jax.lax.reduce_window(x, np.zeros((), x.dtype), jax.lax.add,
+                                   (1, 1) + k, (1, 1) + s,
+                                   [(0, 0), (0, 0)] + pads)
+    ones = jnp.ones_like(x)
+    counts = jax.lax.reduce_window(ones, np.zeros((), x.dtype), jax.lax.add,
+                                   (1, 1) + k, (1, 1) + s,
+                                   [(0, 0), (0, 0)] + pads)
+    return summed / counts if exclusive else summed / k[0]
+
+
+@op
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    out_h, out_w = _pair(output_size)
+    if data_format != "NCHW":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    n, c, h, w = x.shape
+    if h % out_h == 0 and w % out_w == 0:
+        out = x.reshape(n, c, out_h, h // out_h, out_w, w // out_w).mean((3, 5))
+    else:
+        # general: average over variable windows via cumulative sums
+        def pool_axis(a, in_s, out_s, axis):
+            starts = (np.arange(out_s) * in_s) // out_s
+            ends = ((np.arange(out_s) + 1) * in_s + out_s - 1) // out_s
+            pieces = [jnp.mean(jax.lax.slice_in_dim(a, int(st), int(en), axis=axis),
+                               axis=axis, keepdims=True)
+                      for st, en in zip(starts, ends)]
+            return jnp.concatenate(pieces, axis=axis)
+        out = pool_axis(pool_axis(x, h, out_h, 2), w, out_w, 3)
+    if data_format != "NCHW":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
+
+
+@op
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out_h, out_w = _pair(output_size)
+    n, c, h, w = x.shape
+    if h % out_h == 0 and w % out_w == 0:
+        out = x.reshape(n, c, out_h, h // out_h, out_w, w // out_w).max((3, 5))
+        return out
+    def pool_axis(a, in_s, out_s, axis):
+        starts = (np.arange(out_s) * in_s) // out_s
+        ends = ((np.arange(out_s) + 1) * in_s + out_s - 1) // out_s
+        pieces = [jnp.max(jax.lax.slice_in_dim(a, int(st), int(en), axis=axis),
+                          axis=axis, keepdims=True)
+                  for st, en in zip(starts, ends)]
+        return jnp.concatenate(pieces, axis=axis)
+    return pool_axis(pool_axis(x, h, out_h, 2), w, out_w, 3)
+
+
+@op
+def adaptive_avg_pool1d(x, output_size, name=None):
+    n, c, l = x.shape
+    out_l = int(output_size)
+    if l % out_l == 0:
+        return x.reshape(n, c, out_l, l // out_l).mean(-1)
+    starts = (np.arange(out_l) * l) // out_l
+    ends = ((np.arange(out_l) + 1) * l + out_l - 1) // out_l
+    pieces = [jnp.mean(x[..., int(st):int(en)], axis=-1, keepdims=True)
+              for st, en in zip(starts, ends)]
+    return jnp.concatenate(pieces, axis=-1)
+
+
+# ---------------------------------------------------------------- normalize
+
+@op
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    axes = tuple(range(x.ndim - len(tuple(normalized_shape)), x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@op
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """TPU-native fused rmsnorm (reference: paddle/phi/kernels/fusion
+    fused_rms_norm); a Pallas variant lives in ops/pallas/rms_norm.py."""
+    dt = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    out = (xf * jax.lax.rsqrt(var + epsilon)).astype(dt)
+    if weight is not None:
+        out = out * weight
+    return out
+
+
+@op
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    c_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    bshape = [1] * x.ndim
+    bshape[c_axis] = -1
+
+    use_batch_stats = training and not use_global_stats
+    if use_batch_stats:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        new_rm = momentum * running_mean + (1 - momentum) * mean
+        new_rv = momentum * running_var + (1 - momentum) * var
+    else:
+        mean, var = running_mean, running_var
+        new_rm, new_rv = running_mean, running_var
+
+    out = (x - mean.reshape(bshape)) * jax.lax.rsqrt(
+        var.reshape(bshape) + epsilon)
+    if weight is not None:
+        out = out * weight.reshape(bshape)
+    if bias is not None:
+        out = out + bias.reshape(bshape)
+    return out, new_rm, new_rv
+
+
+@op
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-05,
+               data_format="NCHW", name=None):
+    if data_format != "NCHW":
+        x = jnp.moveaxis(x, -1, 1)
+    n, c = x.shape[0], x.shape[1]
+    g = num_groups
+    grouped = x.reshape((n, g, c // g) + x.shape[2:])
+    axes = tuple(range(2, grouped.ndim))
+    mean = jnp.mean(grouped, axis=axes, keepdims=True)
+    var = jnp.var(grouped, axis=axes, keepdims=True)
+    out = ((grouped - mean) * jax.lax.rsqrt(var + epsilon)).reshape(x.shape)
+    bshape = [1, -1] + [1] * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(bshape)
+    if bias is not None:
+        out = out + bias.reshape(bshape)
+    if data_format != "NCHW":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+@op
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-05,
+                  data_format="NCHW", name=None):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    bshape = [1, -1] + [1] * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(bshape)
+    if bias is not None:
+        out = out + bias.reshape(bshape)
+    return out
+
+
+@op
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    sq = jnp.square(x)
+    half = size // 2
+    c = x.shape[1]
+    pads = [(0, 0), (half, size - 1 - half), (0, 0), (0, 0)]
+    padded = jnp.pad(sq, pads)
+    acc = jax.lax.reduce_window(padded, np.zeros((), x.dtype), jax.lax.add,
+                                (1, size, 1, 1), (1, 1, 1, 1),
+                                [(0, 0)] * 4)
+    return x / jnp.power(k + alpha * acc, beta)
+
+
+@op
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    n = jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=True),
+                  1.0 / p)
+    return x / jnp.maximum(n, epsilon)
+
+
+# ------------------------------------------------------------------- losses
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@op
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    n_classes = input.shape[axis]
+    logp = jax.nn.log_softmax(input, axis=axis) if use_softmax \
+        else jnp.log(jnp.clip(input, 1e-15, 1.0))
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis)
+        mask = None
+    else:
+        lab = label
+        if lab.ndim == input.ndim and lab.shape[axis] == 1:
+            lab = jnp.squeeze(lab, axis=axis)
+        if label_smoothing > 0.0:
+            onehot = jax.nn.one_hot(lab, n_classes, axis=axis, dtype=logp.dtype)
+            smoothed = onehot * (1 - label_smoothing) + label_smoothing / n_classes
+            loss = -jnp.sum(smoothed * logp, axis=axis)
+        else:
+            safe = jnp.where(lab == ignore_index, 0, lab)
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(safe, axis).astype(jnp.int32), axis=axis)
+            loss = -jnp.squeeze(picked, axis=axis)
+        mask = (lab != ignore_index)
+        loss = jnp.where(mask, loss, jnp.zeros((), loss.dtype))
+        if weight is not None:
+            w = jnp.take(weight, jnp.where(lab == ignore_index, 0, lab))
+            w = jnp.where(mask, w, jnp.zeros((), w.dtype))
+            loss = loss * w
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
+    if reduction == "mean" and not soft_label and mask is not None:
+        denom = jnp.maximum(jnp.sum(mask.astype(loss.dtype)), 1.0)
+        return jnp.sum(loss) / denom
+    return _reduce(loss, reduction)
+
+
+softmax_with_cross_entropy = cross_entropy
+
+
+@op
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    picked = jnp.take_along_axis(input, label[..., None].astype(jnp.int32),
+                                 axis=-1 if input.ndim == 2 else 1)
+    loss = -jnp.squeeze(picked, axis=-1 if input.ndim == 2 else 1)
+    mask = label != ignore_index
+    loss = jnp.where(mask, loss, jnp.zeros((), loss.dtype))
+    if weight is not None:
+        w = jnp.take(weight, jnp.where(mask, label, 0))
+        loss = loss * jnp.where(mask, w, jnp.zeros((), w.dtype))
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.sum(jnp.where(mask, w, 0))
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1)
+    return _reduce(loss, reduction)
+
+
+@op
+def mse_loss(input, label, reduction="mean", name=None):
+    return _reduce(jnp.square(input - label), reduction)
+
+
+@op
+def l1_loss(input, label, reduction="mean", name=None):
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+@op
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    d = jnp.abs(input - label)
+    loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+    return _reduce(loss, reduction)
+
+
+@op
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    eps = 1e-12
+    loss = -(label * jnp.log(jnp.clip(input, eps, 1.0)) +
+             (1 - label) * jnp.log(jnp.clip(1 - input, eps, 1.0)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@op
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    max_val = jnp.clip(-logit, 0, None)
+    if pos_weight is not None:
+        log_w = (pos_weight - 1) * label + 1
+        loss = (1 - label) * logit + log_w * (
+            jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val)
+    else:
+        loss = (1 - label) * logit + jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+sigmoid_cross_entropy_with_logits = binary_cross_entropy_with_logits
+
+
+@op
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    if log_target:
+        loss = jnp.exp(label) * (label - input)
+    else:
+        loss = jnp.where(label > 0, label * (jnp.log(jnp.clip(label, 1e-12, None))
+                                             - input),
+                         jnp.zeros((), input.dtype))
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+@op
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    loss = jnp.clip(-label * (input - other) + margin, 0, None)
+    return _reduce(loss, reduction)
+
+
+@op
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    loss = jnp.where(label == 1, input, jnp.clip(margin - input, 0, None))
+    return _reduce(loss, reduction)
+
+
+@op
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+@op
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    cos = jnp.sum(input1 * input2, axis=-1) / jnp.maximum(
+        jnp.linalg.norm(input1, axis=-1) * jnp.linalg.norm(input2, axis=-1),
+        1e-12)
+    loss = jnp.where(label == 1, 1 - cos, jnp.clip(cos - margin, 0, None))
+    return _reduce(loss, reduction)
+
+
+@op
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def dist(a, b):
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a - b) + epsilon, p),
+                                 axis=-1), 1.0 / p)
+    d_pos = dist(input, positive)
+    d_neg = dist(input, negative)
+    if swap:
+        d_neg = jnp.minimum(d_neg, dist(positive, negative))
+    return _reduce(jnp.clip(d_pos - d_neg + margin, 0, None), reduction)
+
+
+@op
+def square_error_cost(input, label):
+    return jnp.square(input - label)
+
+
+@op
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return -label * jnp.log(input + epsilon) \
+        - (1 - label) * jnp.log(1 - input + epsilon)
+
+
+# ------------------------------------------------------------- interpolate
+
+@op
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    if data_format in ("NCHW", "NCW", "NCDHW"):
+        spatial = x.shape[2:]
+        chan_first = True
+    else:
+        spatial = x.shape[1:-1]
+        chan_first = False
+    if size is None:
+        if not isinstance(scale_factor, (list, tuple)):
+            scale_factor = [scale_factor] * len(spatial)
+        size = [int(s * f) for s, f in zip(spatial, scale_factor)]
+    else:
+        if hasattr(size, "numpy"):
+            size = size.numpy().tolist()
+        size = [int(s) for s in (size if isinstance(size, (list, tuple)) else [size])]
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+    if chan_first:
+        out_shape = x.shape[:2] + tuple(size)
+    else:
+        out_shape = (x.shape[0],) + tuple(size) + (x.shape[-1],)
+    if mode != "nearest" and align_corners:
+        # jax.image.resize has no align_corners; emulate via scale_and_translate
+        out = _resize_align_corners(x, out_shape, chan_first)
+    else:
+        out = jax.image.resize(x, out_shape, jmode)
+    return out.astype(x.dtype)
+
+
+def _resize_align_corners(x, out_shape, chan_first):
+    sp_axes = list(range(2, x.ndim)) if chan_first else list(range(1, x.ndim - 1))
+    out = x
+    for ax in sp_axes:
+        in_s, out_s = x.shape[ax], out_shape[ax]
+        if in_s == out_s:
+            continue
+        idx = jnp.linspace(0.0, in_s - 1, out_s)
+        lo = jnp.floor(idx).astype(jnp.int32)
+        hi = jnp.clip(lo + 1, 0, in_s - 1)
+        w = (idx - lo).astype(x.dtype)
+        shape = [1] * out.ndim
+        shape[ax] = -1
+        w = w.reshape(shape)
+        out = jnp.take(out, lo, axis=ax) * (1 - w) + \
+            jnp.take(out, hi, axis=ax) * w
+    return out
+
+
+upsample = interpolate
+
+
+@op
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+    n, c, h, w = x.shape
+    out = x.reshape(n, c // (r * r), r, r, h, w)
+    out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
+    return out.reshape(n, c // (r * r), h * r, w * r)
+
+
+@op
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+    n, c, h, w = x.shape
+    out = x.reshape(n, c, h // r, r, w // r, r)
+    out = jnp.transpose(out, (0, 1, 3, 5, 2, 4))
+    return out.reshape(n, c * r * r, h // r, w // r)
+
+
+@op
+def unfold_(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    p = _conv_padding(paddings, 2)
+    d = _pair(dilations)
+    n, c, h, w = x.shape
+    patches = jax.lax.conv_general_dilated_patches(
+        x, k, s, p, rhs_dilation=d,
+        dimension_numbers=jax.lax.conv_dimension_numbers(
+            x.shape, (1, c) + k, ("NCHW", "OIHW", "NCHW")))
+    # [N, C*kh*kw, oh, ow] -> [N, C*kh*kw, L]
+    return patches.reshape(n, patches.shape[1], -1)
+
+
+# ------------------------------------------------------------- attention
+
+@op
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """Fused SDPA (reference: paddle fused attention / flash_attn kernels).
+    Layout: [batch, seqlen, heads, head_dim] (paddle flash_attention layout).
+    Dispatches to the Pallas flash kernel on TPU for long sequences."""
+    from ..ops.pallas import flash_attention as _fa
+    return _fa.sdpa(query, key, value, attn_mask=attn_mask,
+                    dropout_p=dropout_p, is_causal=is_causal,
+                    training=training)
+
+
+@op
+def softmax_mask_fuse_upper_triangle(x):
+    n = x.shape[-1]
+    mask = jnp.tril(jnp.ones((n, n), bool))
+    masked = jnp.where(mask, x, jnp.asarray(-1e9, x.dtype))
+    return jax.nn.softmax(masked, axis=-1)
+
+
+# --------------------------------------------------------------------- misc
+
+@op
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    k = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * prior_dist
+    return (1 - epsilon) * label + epsilon / k
+
+
+@op
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    batch = anchor.shape[0]
+    sim = jnp.matmul(anchor, positive.T)
+    lab = labels.reshape(-1, 1)
+    target = (lab == lab.T).astype(sim.dtype)
+    target = target / jnp.sum(target, axis=1, keepdims=True)
+    ce = jnp.mean(jnp.sum(-target * jax.nn.log_softmax(sim, axis=1), axis=1))
+    reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(anchor), 1)) +
+                    jnp.mean(jnp.sum(jnp.square(positive), 1))) / 2
+    return ce + reg
+
+
+@op
+def pad_sequence(sequences, padding_value=0.0, batch_first=False):
+    max_len = int(np.max([s.shape[0] for s in sequences]))
+    padded = [jnp.pad(s, [(0, max_len - s.shape[0])] + [(0, 0)] * (s.ndim - 1),
+                      constant_values=padding_value) for s in sequences]
+    out = jnp.stack(padded, axis=0)
+    return out if batch_first else jnp.swapaxes(out, 0, 1)
+
+
+@op
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    x5 = x.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    left = jnp.concatenate([x5[:, 1:, :fold], jnp.zeros_like(x5[:, :1, :fold])], 1)
+    right = jnp.concatenate([jnp.zeros_like(x5[:, :1, fold:2 * fold]),
+                             x5[:, :-1, fold:2 * fold]], 1)
+    rest = x5[:, :, 2 * fold:]
+    return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)

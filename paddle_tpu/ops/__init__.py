@@ -1,0 +1,19 @@
+"""Framework op layer: one registry over pure-jax op bodies.
+
+Replaces the reference's YAML + 4-way codegen (API/eager/static/dist —
+paddle/phi/ops/yaml, paddle/phi/api/generator/) with direct registration;
+`registry.OPS` is the introspectable op inventory.
+"""
+from . import registry
+from .registry import op, OPS
+
+from . import math
+from . import reduction
+from . import manipulation
+from . import creation
+from . import linalg
+from . import comparison
+from . import indexing
+
+__all__ = ["op", "OPS", "math", "reduction", "manipulation", "creation",
+           "linalg", "comparison", "indexing"]

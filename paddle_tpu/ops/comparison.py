@@ -1,0 +1,92 @@
+"""Comparison & logical ops (reference: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import op
+
+
+def _co(x, y):
+    if not hasattr(x, "dtype") and hasattr(y, "dtype"):
+        x = jnp.asarray(x, y.dtype) if isinstance(x, (int, float, bool)) else x
+    if not hasattr(y, "dtype") and hasattr(x, "dtype"):
+        y = jnp.asarray(y, x.dtype) if isinstance(y, (int, float, bool)) else y
+    return x, y
+
+
+@op
+def equal(x, y, name=None):
+    x, y = _co(x, y)
+    return jnp.equal(x, y)
+
+
+@op
+def not_equal(x, y, name=None):
+    x, y = _co(x, y)
+    return jnp.not_equal(x, y)
+
+
+@op
+def greater_than(x, y, name=None):
+    x, y = _co(x, y)
+    return jnp.greater(x, y)
+
+
+@op
+def greater_equal(x, y, name=None):
+    x, y = _co(x, y)
+    return jnp.greater_equal(x, y)
+
+
+@op
+def less_than(x, y, name=None):
+    x, y = _co(x, y)
+    return jnp.less(x, y)
+
+
+@op
+def less_equal(x, y, name=None):
+    x, y = _co(x, y)
+    return jnp.less_equal(x, y)
+
+
+@op
+def equal_all(x, y, name=None):
+    return jnp.array_equal(x, y)
+
+
+@op
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return jnp.allclose(x, y, rtol=float(rtol), atol=float(atol),
+                        equal_nan=equal_nan)
+
+
+@op
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return jnp.isclose(x, y, rtol=float(rtol), atol=float(atol),
+                       equal_nan=equal_nan)
+
+
+@op
+def logical_and(x, y, out=None, name=None):
+    return jnp.logical_and(x, y)
+
+
+@op
+def logical_or(x, y, out=None, name=None):
+    return jnp.logical_or(x, y)
+
+
+@op
+def logical_xor(x, y, out=None, name=None):
+    return jnp.logical_xor(x, y)
+
+
+@op
+def logical_not(x, out=None, name=None):
+    return jnp.logical_not(x)
+
+
+@op
+def is_empty(x, name=None):
+    return jnp.asarray(any(s == 0 for s in x.shape))

@@ -1,0 +1,218 @@
+"""Creation & random ops (reference: python/paddle/tensor/creation.py,
+random.py; phi kernels full/uniform/gaussian/randint/randperm).  Random ops
+draw keys from the global generator (framework/random.py) so `paddle.seed`
+reproduces, and stay traceable under a trace_key_guard."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import op
+from ..framework.dtype import to_np_dtype
+from ..framework import random as _random
+
+
+def _shape(shape):
+    if isinstance(shape, int):
+        return (shape,)
+    if hasattr(shape, "__jax_array__") or isinstance(shape, (jax.Array, np.ndarray)):
+        return tuple(int(s) for s in np.asarray(shape).reshape(-1))
+    return tuple(int(s) for s in shape)
+
+
+@op
+def zeros(shape, dtype="float32", name=None):
+    return jnp.zeros(_shape(shape), to_np_dtype(dtype or "float32"))
+
+
+@op
+def ones(shape, dtype="float32", name=None):
+    return jnp.ones(_shape(shape), to_np_dtype(dtype or "float32"))
+
+
+@op
+def full(shape, fill_value, dtype=None, name=None):
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = "bool"
+        elif isinstance(fill_value, int):
+            dtype = "int64"
+        else:
+            dtype = "float32"
+    return jnp.full(_shape(shape), fill_value, to_np_dtype(dtype))
+
+
+@op
+def empty(shape, dtype="float32", name=None):
+    return jnp.zeros(_shape(shape), to_np_dtype(dtype or "float32"))
+
+
+@op
+def zeros_like(x, dtype=None, name=None):
+    return jnp.zeros_like(x, dtype=to_np_dtype(dtype) if dtype else None)
+
+
+@op
+def ones_like(x, dtype=None, name=None):
+    return jnp.ones_like(x, dtype=to_np_dtype(dtype) if dtype else None)
+
+
+@op
+def full_like(x, fill_value, dtype=None, name=None):
+    return jnp.full_like(x, fill_value,
+                         dtype=to_np_dtype(dtype) if dtype else None)
+
+
+@op
+def empty_like(x, dtype=None, name=None):
+    return jnp.zeros_like(x, dtype=to_np_dtype(dtype) if dtype else None)
+
+
+@op
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(a):
+        return a.item() if hasattr(a, "item") else a
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = "int64" if all(isinstance(v, int) for v in (start, end, step)) \
+            else "float32"
+    return jnp.arange(start, end, step, dtype=to_np_dtype(dtype))
+
+
+@op
+def linspace(start, stop, num, dtype=None, name=None):
+    dtype = to_np_dtype(dtype or "float32")
+    return jnp.linspace(float(start), float(stop), int(num), dtype=dtype)
+
+
+@op
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    dtype = to_np_dtype(dtype or "float32")
+    return jnp.logspace(float(start), float(stop), int(num), base=float(base),
+                        dtype=dtype)
+
+
+@op
+def eye(num_rows, num_columns=None, dtype="float32", name=None):
+    return jnp.eye(int(num_rows),
+                   int(num_columns) if num_columns is not None else None,
+                   dtype=to_np_dtype(dtype or "float32"))
+
+
+@op
+def clone(x, name=None):
+    return x + jnp.zeros((), x.dtype)  # differentiable identity copy
+
+
+@op
+def complex(real, imag, name=None):
+    return jax.lax.complex(real, imag)
+
+
+@op
+def polar(abs, angle, name=None):
+    return jax.lax.complex(abs * jnp.cos(angle), abs * jnp.sin(angle))
+
+
+# ----------------------------------------------------------------- random
+@op
+def rand(shape, dtype="float32", name=None):
+    return jax.random.uniform(_random.split_key(), _shape(shape),
+                              to_np_dtype(dtype or "float32"))
+
+
+@op
+def randn(shape, dtype="float32", name=None):
+    return jax.random.normal(_random.split_key(), _shape(shape),
+                             to_np_dtype(dtype or "float32"))
+
+
+@op
+def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else _random.split_key()
+    return jax.random.uniform(key, _shape(shape), to_np_dtype(dtype or "float32"),
+                              minval=float(min), maxval=float(max))
+
+
+@op
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if hasattr(mean, "shape") and getattr(mean, "shape", ()) != ():
+        shape = mean.shape
+    elif hasattr(std, "shape") and getattr(std, "shape", ()) != ():
+        shape = std.shape
+    shape = _shape(shape) if shape is not None else ()
+    z = jax.random.normal(_random.split_key(), shape, jnp.float32)
+    return z * std + mean
+
+
+@op
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype="float32", name=None):
+    key = jax.random.key(seed) if seed else _random.split_key()
+    z = jax.random.normal(key, _shape(shape), to_np_dtype(dtype))
+    return z * std + mean
+
+
+@op
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(_random.split_key(), _shape(shape), int(low),
+                              int(high), to_np_dtype(dtype or "int64"))
+
+
+@op
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    dt = to_np_dtype(dtype) if dtype else x.dtype
+    return jax.random.randint(_random.split_key(), x.shape, int(low), int(high),
+                              dt)
+
+
+@op
+def randperm(n, dtype="int64", name=None):
+    return jax.random.permutation(_random.split_key(), int(n)).astype(
+        to_np_dtype(dtype or "int64"))
+
+
+@op
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = _random.split_key()
+    if x.ndim == 1:
+        return jax.random.choice(key, x.shape[0], (num_samples,),
+                                 replace=replacement, p=x / x.sum()).astype(jnp.int64)
+    keys = jax.random.split(key, x.shape[0])
+    def row(k, p):
+        return jax.random.choice(k, x.shape[1], (num_samples,),
+                                 replace=replacement, p=p / p.sum())
+    return jax.vmap(row)(keys, x).astype(jnp.int64)
+
+
+@op
+def bernoulli(x, name=None):
+    return jax.random.bernoulli(_random.split_key(), x).astype(x.dtype)
+
+
+@op
+def poisson(x, name=None):
+    return jax.random.poisson(_random.split_key(), x).astype(x.dtype)
+
+
+@op
+def standard_normal(shape, dtype="float32", name=None):
+    return jax.random.normal(_random.split_key(), _shape(shape),
+                             to_np_dtype(dtype or "float32"))
+
+
+@op
+def standard_gamma(x, name=None):
+    return jax.random.gamma(_random.split_key(), x).astype(x.dtype)
+
+
+@op
+def exponential_(x, lam=1.0, name=None):
+    u = jax.random.uniform(_random.split_key(), x.shape, jnp.float32)
+    return (-jnp.log1p(-u) / lam).astype(x.dtype)

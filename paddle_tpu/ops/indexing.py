@@ -1,0 +1,24 @@
+"""__getitem__/__setitem__ ops (reference: python/paddle/base/variable_index.py,
+phi set_value/slice kernels).  Implemented functionally over jnp `.at[]` —
+the tape makes both differentiable."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import op
+
+
+@op
+def getitem(x, idx):
+    if isinstance(idx, list):
+        idx = tuple(idx)
+    return x[idx]
+
+
+@op
+def setitem(x, idx, value):
+    if isinstance(idx, list):
+        idx = tuple(idx)
+    if hasattr(value, "dtype") and value.dtype != x.dtype:
+        value = value.astype(x.dtype)
+    return x.at[idx].set(value)

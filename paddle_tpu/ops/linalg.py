@@ -1,0 +1,270 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py; phi kernels
+matmul/cholesky/qr/svd/...).  Dense linalg maps to jnp.linalg (XLA custom
+calls on TPU); matmul rides the MXU."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import op
+from .math import matmul  # re-export; registered there
+from .manipulation import transpose  # re-export
+
+
+@op
+def mm(input, mat2, name=None):
+    return jnp.matmul(input, mat2)
+
+
+@op
+def bmm(x, y, name=None):
+    return jnp.matmul(x, y)
+
+
+@op
+def mv(x, vec, name=None):
+    return jnp.matmul(x, vec)
+
+
+@op
+def t(input, name=None):
+    if input.ndim < 2:
+        return input
+    return input.T
+
+
+@op
+def einsum(equation, *operands):
+    if len(operands) == 1 and isinstance(operands[0], (list, tuple)):
+        operands = tuple(operands[0])
+    return jnp.einsum(equation, *operands)
+
+
+@op
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    if p is None:
+        p = "fro" if axis is None or isinstance(axis, tuple) else 2
+    if p == "fro":
+        return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(x)), axis=axis, keepdims=keepdim))
+    if p == "nuc":
+        return jnp.sum(jnp.linalg.svd(x, compute_uv=False), axis=-1)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    ax = axis if axis is not None else tuple(range(x.ndim))
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=ax, keepdims=keepdim),
+                     1.0 / p)
+
+
+@op
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return jnp.linalg.vector_norm(x, ord=p, axis=axis, keepdims=keepdim)
+
+
+@op
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return jnp.linalg.matrix_norm(x, ord=p, keepdims=keepdim)
+
+
+@op
+def dist(x, y, p=2, name=None):
+    return jnp.linalg.norm((x - y).reshape(-1), ord=p)
+
+
+@op
+def cholesky(x, upper=False, name=None):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+
+@op
+def cholesky_solve(x, y, upper=False, name=None):
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+@op
+def qr(x, mode="reduced", name=None):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+@op
+def svd(x, full_matrices=False, name=None):
+    u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
+    return u, s, jnp.swapaxes(vh, -1, -2).conj()  # paddle returns V not Vh
+
+
+@op
+def svdvals(x, name=None):
+    return jnp.linalg.svd(x, compute_uv=False)
+
+
+@op
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    if q is None:
+        q = min(6, x.shape[-2], x.shape[-1])
+    if center:
+        x = x - x.mean(axis=-2, keepdims=True)
+    u, s, vh = jnp.linalg.svd(x, full_matrices=False)
+    return u[..., :q], s[..., :q], jnp.swapaxes(vh, -1, -2)[..., :q]
+
+
+@op
+def inv(x, name=None):
+    return jnp.linalg.inv(x)
+
+
+@op
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+@op
+def det(x, name=None):
+    return jnp.linalg.det(x)
+
+
+@op
+def slogdet(x, name=None):
+    sign, logabs = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logabs])
+
+
+@op
+def solve(x, y, name=None):
+    return jnp.linalg.solve(x, y)
+
+
+@op
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+@op
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+@op
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_, piv = jax.scipy.linalg.lu_factor(x)
+    piv = piv.astype(jnp.int32) + 1  # paddle returns 1-based pivots
+    if get_infos:
+        return lu_, piv, jnp.zeros((), jnp.int32)
+    return lu_, piv
+
+
+@op
+def eig(x, name=None):
+    w, v = jnp.linalg.eig(np.asarray(x))
+    return jnp.asarray(w), jnp.asarray(v)
+
+
+@op
+def eigh(x, UPLO="L", name=None):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+@op
+def eigvals(x, name=None):
+    return jnp.asarray(np.linalg.eigvals(np.asarray(x)))
+
+
+@op
+def eigvalsh(x, UPLO="L", name=None):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+@op
+def matrix_power(x, n, name=None):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@op
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+@op
+def cond(x, p=None, name=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+@op
+def corrcoef(x, rowvar=True, name=None):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@op
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+@op
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    hist, edges = jnp.histogramdd(x, bins=bins, range=ranges, density=density,
+                                  weights=weights)
+    return hist, list(edges)
+
+
+@op
+def householder_product(x, tau, name=None):
+    m, n = x.shape[-2], x.shape[-1]
+    eye = jnp.eye(m, dtype=x.dtype)
+    q = jnp.broadcast_to(eye, x.shape[:-2] + (m, m)).copy() if x.ndim > 2 else eye
+    def apply(q, args):
+        v_col, t = args
+        return q @ (jnp.eye(m, dtype=x.dtype) - t * jnp.outer(v_col, v_col.conj())), None
+    for i in range(n):
+        v = jnp.zeros(x.shape[:-2] + (m,), x.dtype)
+        v = v.at[..., i].set(1.0)
+        v = v.at[..., i + 1:].set(x[..., i + 1:, i])
+        H = jnp.eye(m, dtype=x.dtype) - tau[..., i, None, None] * (
+            v[..., :, None] @ v[..., None, :].conj())
+        q = q @ H
+    return q[..., :, :n]
+
+
+@op
+def matrix_exp(x, name=None):
+    return jax.scipy.linalg.expm(x)
+
+
+@op
+def bitwise_and(x, y, name=None):
+    return jnp.bitwise_and(x, y)
+
+
+@op
+def bitwise_or(x, y, name=None):
+    return jnp.bitwise_or(x, y)
+
+
+@op
+def bitwise_xor(x, y, name=None):
+    return jnp.bitwise_xor(x, y)
+
+
+@op
+def bitwise_not(x, name=None):
+    return jnp.bitwise_not(x)
+
+
+@op
+def bitwise_left_shift(x, y, is_arithmetic=True, name=None):
+    return jnp.left_shift(x, y)
+
+
+@op
+def bitwise_right_shift(x, y, is_arithmetic=True, name=None):
+    return jnp.right_shift(x, y)

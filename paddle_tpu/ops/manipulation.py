@@ -1,0 +1,594 @@
+"""Shape/layout manipulation ops (reference: python/paddle/tensor/manipulation.py,
+paddle/phi/kernels/{reshape,transpose,concat,split,gather,scatter,...}).
+All static-shape friendly: XLA requires concrete shapes, so size args coming
+in as Tensors are concretized where Paddle allows dynamic ones."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import op
+from ..framework.dtype import to_np_dtype
+
+
+def _static_ints(v):
+    """Concretize a shape-like argument (list may contain 0-d arrays)."""
+    if hasattr(v, "__jax_array__") or isinstance(v, (jax.Array, np.ndarray)):
+        return tuple(int(x) for x in np.asarray(v).reshape(-1))
+    out = []
+    for x in v:
+        out.append(int(x) if not isinstance(x, int) else x)
+    return tuple(out)
+
+
+@op
+def reshape(x, shape, name=None):
+    shape = _static_ints(shape)
+    # Paddle semantics: 0 means "copy this dim from input".
+    shape = tuple(x.shape[i] if s == 0 else s for i, s in enumerate(shape))
+    return jnp.reshape(x, shape)
+
+
+@op
+def transpose(x, perm, name=None):
+    return jnp.transpose(x, _static_ints(perm))
+
+
+@op
+def concat(x, axis=0, name=None):
+    axis = int(axis) if not isinstance(axis, int) else axis
+    return jnp.concatenate(list(x), axis=axis)
+
+
+@op
+def stack(x, axis=0, name=None):
+    return jnp.stack(list(x), axis=axis)
+
+
+@op
+def unstack(x, axis=0, num=None, name=None):
+    n = num if num is not None else x.shape[axis]
+    return [jnp.squeeze(s, axis=axis) for s in jnp.split(x, n, axis=axis)]
+
+
+@op
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(axis)
+    if isinstance(num_or_sections, int):
+        return jnp.split(x, num_or_sections, axis=axis)
+    secs = list(_static_ints(num_or_sections))
+    # Paddle allows one -1 meaning "the rest".
+    if -1 in secs:
+        known = sum(s for s in secs if s != -1)
+        secs[secs.index(-1)] = x.shape[axis] - known
+    idx = np.cumsum(secs)[:-1].tolist()
+    return jnp.split(x, idx, axis=axis)
+
+
+@op
+def chunk(x, chunks, axis=0, name=None):
+    return jnp.array_split(x, chunks, axis=int(axis))
+
+
+@op
+def squeeze(x, axis=None, name=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, (list, tuple)):
+        ax = tuple(a for a in _static_ints(axis) if x.shape[a] == 1)
+        return jnp.squeeze(x, axis=ax) if ax else x
+    axis = int(axis)
+    return jnp.squeeze(x, axis=axis) if x.shape[axis] == 1 else x
+
+
+@op
+def unsqueeze(x, axis, name=None):
+    if isinstance(axis, (list, tuple)) or hasattr(axis, "__len__"):
+        for a in sorted(_static_ints(axis)):
+            x = jnp.expand_dims(x, a)
+        return x
+    return jnp.expand_dims(x, int(axis))
+
+
+@op
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    nd = x.ndim
+    start = start_axis % nd if nd else 0
+    stop = stop_axis % nd if nd else 0
+    shape = list(x.shape[:start]) + [-1] + list(x.shape[stop + 1:])
+    return jnp.reshape(x, shape)
+
+
+@op
+def tile(x, repeat_times, name=None):
+    return jnp.tile(x, _static_ints(repeat_times))
+
+
+@op
+def expand(x, shape, name=None):
+    shape = _static_ints(shape)
+    # -1 keeps the original dim
+    nd_off = len(shape) - x.ndim
+    shape = tuple(x.shape[i - nd_off] if s == -1 else s
+                  for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, shape)
+
+
+@op
+def expand_as(x, y, name=None):
+    return jnp.broadcast_to(x, y.shape)
+
+
+@op
+def broadcast_to(x, shape, name=None):
+    return jnp.broadcast_to(x, _static_ints(shape))
+
+
+@op
+def broadcast_tensors(inputs, name=None):
+    return list(jnp.broadcast_arrays(*inputs))
+
+
+@op
+def gather(x, index, axis=0, name=None):
+    axis = int(axis)
+    return jnp.take(x, index.reshape(-1) if index.ndim > 1 else index, axis=axis)
+
+
+@op
+def gather_nd(x, index, name=None):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+@op
+def scatter(x, index, updates, overwrite=True, name=None):
+    index = index.reshape(-1)
+    if overwrite:
+        return x.at[index].set(updates)
+    z = x.at[index].set(jnp.zeros_like(updates))
+    return z.at[index].add(updates)
+
+
+@op
+def scatter_nd_add(x, index, updates, name=None):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+@op
+def scatter_nd(index, updates, shape, name=None):
+    zeros = jnp.zeros(_static_ints(shape), updates.dtype)
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return zeros.at[idx].add(updates)
+
+
+@op
+def index_select(x, index, axis=0, name=None):
+    return jnp.take(x, index, axis=int(axis))
+
+
+@op
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+@op
+def index_add(x, index, axis, value, name=None):
+    sl = [slice(None)] * x.ndim
+    sl[axis] = index
+    return x.at[tuple(sl)].add(value)
+
+
+@op
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx = tuple(indices)
+    return x.at[idx].add(value) if accumulate else x.at[idx].set(value)
+
+
+@op
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return jnp.take_along_axis(arr, indices, axis=axis)
+
+
+@op
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
+    if not hasattr(values, "shape") or values.shape != indices.shape:
+        values = jnp.broadcast_to(jnp.asarray(values, arr.dtype), indices.shape)
+    sl = jnp.take_along_axis(arr, indices, axis=axis)
+    if reduce == "assign":
+        new = values
+    elif reduce == "add":
+        new = sl + values if include_self else values
+    elif reduce in ("mul", "multiply"):
+        new = sl * values if include_self else values
+    else:
+        raise ValueError(f"unsupported reduce {reduce}")
+    # build scatter via explicit indices along axis
+    idx = [jnp.broadcast_to(
+        jnp.arange(arr.shape[d]).reshape([-1 if i == d else 1 for i in range(arr.ndim)]),
+        indices.shape) for d, i in zip(range(arr.ndim), range(arr.ndim))]
+    idx[axis] = indices
+    return arr.at[tuple(idx)].set(new)
+
+
+@op
+def flip(x, axis, name=None):
+    if isinstance(axis, int):
+        axis = [axis]
+    return jnp.flip(x, axis=tuple(_static_ints(axis)))
+
+
+@op
+def roll(x, shifts, axis=None, name=None):
+    if axis is not None and not isinstance(axis, int):
+        axis = tuple(_static_ints(axis))
+    if not isinstance(shifts, int):
+        shifts = tuple(_static_ints(shifts))
+    return jnp.roll(x, shifts, axis=axis)
+
+
+@op
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+@op
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return [i.astype(jnp.int64) for i in jnp.nonzero(condition)]
+    if hasattr(x, "dtype") and hasattr(y, "dtype") and x.dtype != y.dtype:
+        ct = jnp.promote_types(x.dtype, y.dtype)
+        x, y = x.astype(ct), y.astype(ct)
+    return jnp.where(condition, x, y)
+
+
+@op
+def nonzero(x, as_tuple=False, name=None):
+    nz = jnp.nonzero(x)
+    if as_tuple:
+        return [i.astype(jnp.int64).reshape(-1, 1) for i in nz]
+    return jnp.stack(nz, axis=1).astype(jnp.int64)
+
+
+@op
+def masked_select(x, mask, name=None):
+    # dynamic output size — host-side only (not jit-safe), like reference CPU op
+    xn = np.asarray(x)
+    mn = np.asarray(mask)
+    return jnp.asarray(xn[np.broadcast_to(mn, xn.shape)])
+
+
+@op
+def masked_fill(x, mask, value, name=None):
+    v = jnp.asarray(value, x.dtype)
+    return jnp.where(mask, v, x)
+
+
+@op
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    k = int(k)
+    if axis is None:
+        axis = -1
+    axis = int(axis)
+    if largest:
+        vals, idxs = jax.lax.top_k(jnp.moveaxis(x, axis, -1), k)
+    else:
+        vals, idxs = jax.lax.top_k(-jnp.moveaxis(x, axis, -1), k)
+        vals = -vals
+    return (jnp.moveaxis(vals, -1, axis),
+            jnp.moveaxis(idxs, -1, axis).astype(jnp.int64))
+
+
+@op
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    out = jnp.sort(x, axis=axis, stable=True)
+    return jnp.flip(out, axis=axis) if descending else out
+
+
+@op
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    out = jnp.argsort(x, axis=axis, stable=True, descending=descending)
+    return out.astype(jnp.int64)
+
+
+@op
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    if sorted_sequence.ndim == 1:
+        out = jnp.searchsorted(sorted_sequence, values, side=side)
+    else:
+        out = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(
+            sorted_sequence.reshape(-1, sorted_sequence.shape[-1]),
+            values.reshape(-1, values.shape[-1]))
+        out = out.reshape(values.shape)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@op
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    out = jnp.searchsorted(sorted_sequence, x, side="right" if right else "left")
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@op
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    # dynamic-shape: host-side like reference CPU kernel
+    xn = np.asarray(x)
+    res = np.unique(xn, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return jnp.asarray(res)
+    return tuple(jnp.asarray(r if i == 0 else r.astype(np.dtype(dtype)))
+                 for i, r in enumerate(res))
+
+
+@op
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    xn = np.asarray(x)
+    if axis is None:
+        xn = xn.reshape(-1)
+        keep = np.ones(len(xn), bool)
+        keep[1:] = xn[1:] != xn[:-1]
+        out = [jnp.asarray(xn[keep])]
+        if return_inverse:
+            out.append(jnp.asarray(np.cumsum(keep) - 1, dtype=np.dtype(dtype)))
+        if return_counts:
+            idx = np.flatnonzero(keep)
+            counts = np.diff(np.append(idx, len(xn)))
+            out.append(jnp.asarray(counts, dtype=np.dtype(dtype)))
+        return out[0] if len(out) == 1 else tuple(out)
+    raise NotImplementedError("unique_consecutive with axis")
+
+
+@op
+def one_hot(x, num_classes, name=None):
+    return jax.nn.one_hot(x, num_classes, dtype=jnp.float32)
+
+
+@op
+def tril(x, diagonal=0, name=None):
+    return jnp.tril(x, k=diagonal)
+
+
+@op
+def triu(x, diagonal=0, name=None):
+    return jnp.triu(x, k=diagonal)
+
+
+@op
+def tril_indices(row, col, offset=0, dtype="int64", name=None):
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return jnp.stack([r, c]).astype(to_np_dtype(dtype))
+
+
+@op
+def triu_indices(row, col=None, offset=0, dtype="int64", name=None):
+    if col is None:
+        col = row
+    r, c = jnp.triu_indices(row, k=offset, m=col)
+    return jnp.stack([r, c]).astype(to_np_dtype(dtype))
+
+
+@op
+def diag(x, offset=0, padding_value=0, name=None):
+    if x.ndim == 1:
+        out = jnp.diag(x, k=offset)
+        if padding_value != 0:
+            mask = jnp.diag(jnp.ones_like(x), k=offset)
+            out = jnp.where(mask.astype(bool), out,
+                            jnp.asarray(padding_value, x.dtype))
+        return out
+    return jnp.diagonal(x, offset=offset)
+
+
+@op
+def diagflat(x, offset=0, name=None):
+    return jnp.diagflat(x, k=offset)
+
+
+@op
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@op
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    base = jnp.zeros(x.shape + (x.shape[-1] + abs(offset),), x.dtype)
+    n = x.shape[-1]
+    rows = jnp.arange(n) + max(-offset, 0)
+    cols = jnp.arange(n) + max(offset, 0)
+    out_dim = n + abs(offset)
+    out = jnp.zeros(x.shape[:-1] + (out_dim, out_dim), x.dtype)
+    out = out.at[..., rows, cols].set(x)
+    # move the two new dims into requested positions
+    nd = out.ndim
+    d1, d2 = dim1 % nd, dim2 % nd
+    if (d1, d2) != (nd - 2, nd - 1):
+        out = jnp.moveaxis(out, (nd - 2, nd - 1), (d1, d2))
+    return out
+
+
+@op
+def meshgrid(*args, name=None):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return list(jnp.meshgrid(*args, indexing="ij"))
+
+
+@op
+def cast(x, dtype, name=None):
+    return x.astype(to_np_dtype(dtype))
+
+
+@op
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW",
+        pad_from_left_axis=True, name=None):
+    pad = _static_ints(pad)
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        # paddle layout: [before_0, after_0, before_1, after_1, ...]? No —
+        # paddle uses per-axis pairs from the *last* axes when len==2*spatial;
+        # full-rank form is [x0_before, x0_after, x1_before, ...]
+        pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # pad applies to spatial dims per data_format (NCHW -> last two dims)
+        k = len(pad) // 2
+        pairs = [(0, 0)] * nd
+        if data_format.endswith("C") and nd >= 3:  # NHWC-style
+            spatial = list(range(1, 1 + k))
+        else:
+            spatial = list(range(nd - k, nd))
+        for i, d in enumerate(spatial):
+            pairs[d] = (pad[2 * i], pad[2 * i + 1])
+    if mode == "constant":
+        return jnp.pad(x, pairs, constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    return jnp.pad(x, pairs, mode=jmode)
+
+
+@op
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    if hasattr(repeats, "shape") and getattr(repeats, "ndim", 0) > 0:
+        total = int(np.asarray(repeats).sum())
+        return jnp.repeat(x, repeats, axis=axis, total_repeat_length=total)
+    return jnp.repeat(x, int(repeats), axis=axis)
+
+
+@op
+def as_strided(x, shape, stride, offset=0, name=None):
+    flat = x.reshape(-1)[offset:]
+    shape = _static_ints(shape)
+    stride = _static_ints(stride)
+    idx = np.zeros(shape, dtype=np.int64)
+    for d, (s, st) in enumerate(zip(shape, stride)):
+        ix = np.arange(s) * st
+        idx += ix.reshape([-1 if i == d else 1 for i in range(len(shape))])
+    return flat[jnp.asarray(idx)]
+
+
+@op
+def moveaxis(x, source, destination, name=None):
+    return jnp.moveaxis(x, source, destination)
+
+
+@op
+def swapaxes(x, axis0, axis1, name=None):
+    return jnp.swapaxes(x, axis0, axis1)
+
+
+@op
+def atleast_1d(*inputs, name=None):
+    out = [jnp.atleast_1d(i) for i in inputs]
+    return out[0] if len(out) == 1 else out
+
+
+@op
+def atleast_2d(*inputs, name=None):
+    out = [jnp.atleast_2d(i) for i in inputs]
+    return out[0] if len(out) == 1 else out
+
+
+@op
+def atleast_3d(*inputs, name=None):
+    out = [jnp.atleast_3d(i) for i in inputs]
+    return out[0] if len(out) == 1 else out
+
+
+@op
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return jnp.reshape(x, _static_ints(shape_or_dtype))
+    return x.view(to_np_dtype(shape_or_dtype))
+
+
+@op
+def unfold(x, axis, size, step, name=None):
+    n = (x.shape[axis] - size) // step + 1
+    starts = jnp.arange(n) * step
+    def take(s):
+        return jax.lax.dynamic_slice_in_dim(x, s, size, axis)
+    out = jax.vmap(take)(starts)          # [n, ..., size at axis...]
+    return jnp.moveaxis(out, 0, axis)
+
+
+@op
+def tensordot(x, y, axes=2, name=None):
+    if hasattr(axes, "__len__") and not isinstance(axes, int):
+        axes = tuple(tuple(_static_ints(a)) if hasattr(a, "__len__") else int(a)
+                     for a in axes)
+    return jnp.tensordot(x, y, axes=axes)
+
+
+@op
+def crop(x, shape=None, offsets=None, name=None):
+    shape = _static_ints(shape)
+    offsets = _static_ints(offsets) if offsets is not None else (0,) * x.ndim
+    shape = tuple(x.shape[i] - offsets[i] if s == -1 else s
+                  for i, s in enumerate(shape))
+    return jax.lax.dynamic_slice(x, offsets, shape)
+
+
+import builtins as _builtins
+
+
+@op
+def slice(input, axes, starts, ends, name=None):
+    sl = [_builtins.slice(None)] * input.ndim
+    for ax, st, en in zip(_static_ints(axes), _static_ints(starts), _static_ints(ends)):
+        sl[ax] = _builtins.slice(st, en)
+    return input[tuple(sl)]
+
+
+@op
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    sl = [_builtins.slice(None)] * x.ndim
+    for ax, st, en, sd in zip(_static_ints(axes), _static_ints(starts),
+                              _static_ints(ends), _static_ints(strides)):
+        sl[ax] = _builtins.slice(st, en, sd)
+    return x[tuple(sl)]
+
+
+@op
+def numel(x, name=None):
+    return jnp.asarray(int(np.prod(x.shape)) if x.shape else 1, jnp.int64)
+
+
+@op
+def shape(input):
+    return jnp.asarray(input.shape, jnp.int32)
+
+
+@op
+def increment(x, value=1.0, name=None):
+    return x + jnp.asarray(value, x.dtype)
+
+
+@op
+def assign(x, output=None, name=None):
+    return jnp.asarray(x)
+
+
+@op
+def bincount(x, weights=None, minlength=0, name=None):
+    xn = np.asarray(x)
+    length = max(int(xn.max()) + 1 if xn.size else 0, minlength)
+    return jnp.bincount(jnp.asarray(xn), weights=weights, length=length)
+
+
+@op
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    lo, hi = float(min), float(max)
+    if lo == 0.0 and hi == 0.0:
+        a = np.asarray(input)
+        lo, hi = float(a.min()), float(a.max())
+    hist, _ = jnp.histogram(input.reshape(-1), bins=bins, range=(lo, hi),
+                            weights=weight, density=density)
+    return hist if density else hist.astype(jnp.int64)

@@ -1,0 +1,343 @@
+"""Elementwise & scalar math ops (reference: paddle/phi/kernels/elementwise_*,
+activation kernels; python/paddle/tensor/math.py).  Bodies are pure jax;
+broadcasting/type-promotion follow jnp which matches Paddle's numpy-style
+semantics."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import op
+
+
+def _promote_binary(x, y):
+    # Paddle promotes python scalars to tensor dtype (not float64).
+    if not hasattr(x, "dtype") and hasattr(y, "dtype"):
+        x = jnp.asarray(x, dtype=y.dtype) if isinstance(x, (int, float, bool)) else x
+    if not hasattr(y, "dtype") and hasattr(x, "dtype"):
+        y = jnp.asarray(y, dtype=x.dtype) if isinstance(y, (int, float, bool)) else y
+    return x, y
+
+
+@op
+def add(x, y, name=None):
+    x, y = _promote_binary(x, y)
+    return jnp.add(x, y)
+
+
+@op
+def subtract(x, y, name=None):
+    x, y = _promote_binary(x, y)
+    return jnp.subtract(x, y)
+
+
+@op
+def multiply(x, y, name=None):
+    x, y = _promote_binary(x, y)
+    return jnp.multiply(x, y)
+
+
+@op
+def divide(x, y, name=None):
+    x, y = _promote_binary(x, y)
+    if jnp.issubdtype(jnp.result_type(x), jnp.integer) and \
+       jnp.issubdtype(jnp.result_type(y), jnp.integer):
+        return jnp.true_divide(x, y).astype(jnp.float32)
+    return jnp.true_divide(x, y)
+
+
+@op
+def floor_divide(x, y, name=None):
+    x, y = _promote_binary(x, y)
+    return jnp.floor_divide(x, y)
+
+
+@op
+def remainder(x, y, name=None):
+    x, y = _promote_binary(x, y)
+    return jnp.remainder(x, y)
+
+
+mod = remainder
+
+
+@op
+def pow(x, y, name=None):
+    x, y = _promote_binary(x, y)
+    return jnp.power(x, y)
+
+
+@op
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+@op
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s = jnp.asarray(scale, dtype=x.dtype) if not hasattr(scale, "dtype") else scale.astype(x.dtype)
+    b = jnp.asarray(bias, dtype=x.dtype)
+    out = x * s + b if bias_after_scale else (x + b) * s
+    return out
+
+
+# --- unary ---
+def _unary(name, fn):
+    @op(name=name)
+    def _f(x, name=None, _fn=fn):
+        return _fn(x)
+    _f.__name__ = name
+    return _f
+
+
+neg = _unary("neg", jnp.negative)
+abs = _unary("abs", jnp.abs)
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", jax.lax.rsqrt)
+square = _unary("square", jnp.square)
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+floor = _unary("floor", jnp.floor)
+ceil = _unary("ceil", jnp.ceil)
+round = _unary("round", jnp.round)
+trunc = _unary("trunc", jnp.trunc)
+sign = _unary("sign", jnp.sign)
+reciprocal = _unary("reciprocal", jnp.reciprocal)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+i0 = _unary("i0", lambda x: jax.scipy.special.i0(x))
+frac = _unary("frac", lambda x: x - jnp.trunc(x))
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+angle = _unary("angle", jnp.angle)
+conj = _unary("conj", jnp.conj)
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+
+
+@op
+def clip(x, min=None, max=None, name=None):
+    lo = None if min is None else jnp.asarray(min, x.dtype if hasattr(x, "dtype") else None)
+    hi = None if max is None else jnp.asarray(max, x.dtype if hasattr(x, "dtype") else None)
+    return jnp.clip(x, lo, hi)
+
+
+@op
+def maximum(x, y, name=None):
+    x, y = _promote_binary(x, y)
+    return jnp.maximum(x, y)
+
+
+@op
+def minimum(x, y, name=None):
+    x, y = _promote_binary(x, y)
+    return jnp.minimum(x, y)
+
+
+@op
+def fmax(x, y, name=None):
+    x, y = _promote_binary(x, y)
+    return jnp.fmax(x, y)
+
+
+@op
+def fmin(x, y, name=None):
+    x, y = _promote_binary(x, y)
+    return jnp.fmin(x, y)
+
+
+@op
+def atan2(x, y, name=None):
+    x, y = _promote_binary(x, y)
+    return jnp.arctan2(x, y)
+
+
+@op
+def hypot(x, y, name=None):
+    return jnp.hypot(x, y)
+
+
+@op
+def lerp(x, y, weight, name=None):
+    return x + (y - x) * weight
+
+
+@op
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@op
+def logit(x, eps=None, name=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+@op
+def multiplex(inputs, index, name=None):
+    stacked = jnp.stack(inputs, axis=0)
+    idx = index.reshape(-1)
+    return stacked[idx, jnp.arange(stacked.shape[1])]
+
+
+@op
+def isnan(x, name=None):
+    return jnp.isnan(x)
+
+
+@op
+def isinf(x, name=None):
+    return jnp.isinf(x)
+
+
+@op
+def isfinite(x, name=None):
+    return jnp.isfinite(x)
+
+
+@op
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@op
+def cumsum(x, axis=None, dtype=None, name=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    out = jnp.cumsum(x, axis=axis)
+    return out.astype(dtype) if dtype is not None else out
+
+
+@op
+def cumprod(x, dim=None, dtype=None, name=None):
+    if dim is None:
+        x = x.reshape(-1)
+        dim = 0
+    out = jnp.cumprod(x, axis=dim)
+    return out.astype(dtype) if dtype is not None else out
+
+
+@op
+def cummax(x, axis=None, dtype="int64", name=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    vals = jax.lax.cummax(x, axis=axis)
+    import numpy as np
+    n = x.shape[axis]
+    idx = jnp.arange(n).reshape([-1 if i == axis % x.ndim else 1 for i in range(x.ndim)])
+    idx = jnp.broadcast_to(idx, x.shape)
+    is_new = x == vals
+    ind = jax.lax.cummax(jnp.where(is_new, idx, 0), axis=axis)
+    return vals, ind.astype(np.dtype(dtype))
+
+
+@op
+def cummin(x, axis=None, dtype="int64", name=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    vals = jax.lax.cummin(x, axis=axis)
+    import numpy as np
+    n = x.shape[axis]
+    idx = jnp.arange(n).reshape([-1 if i == axis % x.ndim else 1 for i in range(x.ndim)])
+    idx = jnp.broadcast_to(idx, x.shape)
+    is_new = x == vals
+    ind = jax.lax.cummax(jnp.where(is_new, idx, 0), axis=axis)
+    return vals, ind.astype(np.dtype(dtype))
+
+
+@op
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jax.lax.cumlogsumexp(x, axis=axis)
+
+
+@op
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+@op
+def inner(x, y, name=None):
+    return jnp.inner(x, y)
+
+
+@op
+def outer(x, y, name=None):
+    return jnp.outer(x, y)
+
+
+@op
+def heaviside(x, y, name=None):
+    return jnp.heaviside(x, y)
+
+
+@op
+def gcd(x, y, name=None):
+    x, y = _promote_binary(x, y)
+    return jnp.gcd(x, y)
+
+
+@op
+def lcm(x, y, name=None):
+    x, y = _promote_binary(x, y)
+    return jnp.lcm(x, y)
+
+
+@op
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    return jnp.diff(x, n=n, axis=axis, prepend=prepend, append=append)
+
+
+@op
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@op
+def kron(x, y, name=None):
+    return jnp.kron(x, y)
+
+
+@op
+def cross(x, y, axis=9, name=None):
+    if axis == 9:
+        axis = next(i for i, s in enumerate(x.shape) if s == 3)
+    return jnp.cross(x, y, axis=axis)
+
+
+@op
+def dot(x, y, name=None):
+    return jnp.sum(x * y, axis=-1)
+
+
+@op
+def polygamma(x, n, name=None):
+    return jax.scipy.special.polygamma(n, x)

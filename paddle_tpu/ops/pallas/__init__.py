@@ -1,0 +1,9 @@
+"""Pallas TPU kernels for the hot fused ops.
+
+Reference native fused kernels: paddle/phi/kernels/fusion/gpu (CUDA) and
+paddle/phi/kernels/gpu/flash_attn_kernel.cu (flashattn dynload).  Here the
+TPU equivalents are Pallas (Mosaic) kernels, with pure-XLA fallbacks used on
+CPU and for shapes where the kernel doesn't apply.
+"""
+from . import flash_attention
+from . import rms_norm

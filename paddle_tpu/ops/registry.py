@@ -1,0 +1,130 @@
+"""Op registry + dispatch.
+
+The reference declares ops in YAML (paddle/phi/ops/yaml/ops.yaml, 468 ops) and
+generates C++ APIs, eager ad_funcs, and Python-C bindings from them
+(paddle/phi/api/generator/api_gen.py, paddle/fluid/eager/auto_code_generator/).
+Here one decorator replaces the whole pipeline: an op is a pure function of
+jax arrays; the wrapper handles Tensor unwrap/wrap, AMP casting hooks, and
+autograd-tape recording (the VJP comes from `jax.vjp`, replacing per-op
+generated GradNodes).  Shape/dtype inference (InferMeta) and sharding rules
+(SPMD) are inherited from jax/XLA's own tracing and GSPMD propagation.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import numpy as np
+from jax.tree_util import tree_flatten, tree_unflatten
+
+from ..autograd import tape
+
+__all__ = ["op", "OPS", "apply_op"]
+
+# name -> public wrapper. Introspectable inventory of the op surface
+# (parity check against reference ops.yaml).
+OPS: dict[str, Callable] = {}
+
+
+def _is_tensor(x):
+    from ..framework.tensor import Tensor
+    return isinstance(x, Tensor)
+
+
+def _wrap(opname, arr, stop_gradient, node=None, index=0):
+    from ..framework.tensor import Tensor
+    t = Tensor(arr, stop_gradient=stop_gradient)
+    if node is not None:
+        t._grad_node = node
+        t._out_index = index
+    return t
+
+
+def _float0_zeros(aval):
+    if aval.dtype == jax.dtypes.float0:
+        return np.zeros(aval.shape, jax.dtypes.float0)
+    import jax.numpy as jnp
+    return jnp.zeros(aval.shape, aval.dtype)
+
+
+def op(fn=None, *, name: str | None = None):
+    """Register ``fn`` (a pure function of jax arrays) as a framework op."""
+    def deco(body):
+        opname = name or body.__name__
+
+        @functools.wraps(body)
+        def wrapper(*args, **kwargs):
+            return apply_op(opname, body, args, kwargs)
+
+        wrapper.__op_body__ = body
+        wrapper.__op_name__ = opname
+        OPS[opname] = wrapper
+        return wrapper
+
+    return deco(fn) if fn is not None else deco
+
+
+def apply_op(opname, body, args, kwargs):
+    from ..framework.tensor import Tensor
+    from ..amp.auto_cast import maybe_amp_cast
+
+    args, kwargs = maybe_amp_cast(opname, args, kwargs)
+
+    flat, treedef = tree_flatten((args, kwargs), is_leaf=_is_tensor)
+    t_idx = [i for i, x in enumerate(flat) if isinstance(x, Tensor)]
+    tensors = [flat[i] for i in t_idx]
+    arrays = [t._data for t in tensors]
+
+    record = tape.is_grad_enabled() and any(
+        not t.stop_gradient for t in tensors)
+
+    if not record:
+        flat2 = list(flat)
+        for i, a in zip(t_idx, arrays):
+            flat2[i] = a
+        a2, k2 = tree_unflatten(treedef, flat2)
+        out = body(*a2, **k2)
+        return _wrap_outputs(opname, out, node=None)
+
+    diff_tensors = [t for t in tensors if not t.stop_gradient]
+    diff_pos = [j for j, t in enumerate(tensors) if not t.stop_gradient]
+
+    def closed(*diff_arrays):
+        flat2 = list(flat)
+        sub = dict(zip(diff_pos, diff_arrays))
+        for k, (i, a) in enumerate(zip(t_idx, arrays)):
+            flat2[i] = sub.get(k, a)
+        a2, k2 = tree_unflatten(treedef, flat2)
+        return body(*a2, **k2)
+
+    out, raw_vjp = jax.vjp(closed, *[t._data for t in diff_tensors])
+
+    out_flat, out_treedef = tree_flatten(out)
+    out_avals = [jax.ShapeDtypeStruct(np.shape(a), _tangent_dtype(a))
+                 for a in out_flat]
+
+    def vjp_fn(flat_cots):
+        cots = tree_unflatten(out_treedef, list(flat_cots))
+        return raw_vjp(cots)
+
+    node = tape.GradNode(opname, vjp_fn, diff_tensors, out_avals)
+    return _wrap_outputs(opname, out, node=node)
+
+
+def _tangent_dtype(a):
+    dt = np.result_type(a)
+    if np.issubdtype(dt, np.inexact) or dt == np.dtype("bfloat16"):
+        return dt
+    return jax.dtypes.float0
+
+
+def _wrap_outputs(opname, out, node):
+    out_flat, out_treedef = tree_flatten(out)
+    wrapped = []
+    for i, a in enumerate(out_flat):
+        diff = node is not None and _tangent_dtype(a) != jax.dtypes.float0
+        wrapped.append(
+            _wrap(opname, a, stop_gradient=not diff,
+                  node=node if diff else None, index=i))
+    return tree_unflatten(out_treedef, wrapped)

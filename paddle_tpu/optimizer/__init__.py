@@ -1,0 +1,8 @@
+"""paddle.optimizer namespace."""
+from .optimizer import Optimizer
+from .optimizers import SGD, Momentum, Adam, AdamW, Adagrad, Adadelta, \
+    RMSProp, Lamb
+from . import lr
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad",
+           "Adadelta", "RMSProp", "Lamb", "lr"]

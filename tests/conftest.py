@@ -1,0 +1,11 @@
+"""Test env: force an 8-device virtual CPU mesh before jax initializes
+(SURVEY §4: distributed-vs-single-card equivalence runs on one host).
+JAX_PLATFORMS is force-overridden: the container default is the axon TPU
+backend, but unit tests must run on host CPU devices."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,78 @@
+"""OpTest harness (reference: test/legacy_test/op_test.py:418 —
+check_output against NumPy, check_grad by finite differences :148,3129)."""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import Tensor
+
+
+def check_output(op, np_ref, inputs, atol=1e-5, rtol=1e-5, kwargs=None):
+    """Run `op(*tensors, **kwargs)` and compare to np_ref(*numpy arrays)."""
+    kwargs = kwargs or {}
+    tensors = [paddle.to_tensor(a) if isinstance(a, np.ndarray) else a
+               for a in inputs]
+    out = op(*tensors, **kwargs)
+    ref = np_ref(*[a for a in inputs], **kwargs)
+    _compare(out, ref, atol, rtol, name=getattr(op, "__name__", str(op)))
+    return out
+
+
+def _compare(out, ref, atol, rtol, name):
+    if isinstance(ref, (tuple, list)):
+        assert isinstance(out, (tuple, list)), f"{name}: structure mismatch"
+        for o, r in zip(out, ref):
+            _compare(o, r, atol, rtol, name)
+        return
+    o = out.numpy() if hasattr(out, "numpy") else np.asarray(out)
+    np.testing.assert_allclose(o.astype(np.float64) if o.dtype != bool else o,
+                               np.asarray(ref).astype(np.float64)
+                               if np.asarray(ref).dtype != bool else ref,
+                               atol=atol, rtol=rtol, err_msg=name)
+
+
+def check_grad(op, inputs, kwargs=None, eps=1e-3, atol=1e-2, rtol=1e-2,
+               output_index=None):
+    """Analytic grads (tape) vs central finite differences, like the
+    reference's get_numeric_gradient."""
+    kwargs = kwargs or {}
+    np_inputs = [np.asarray(a, np.float64) for a in inputs]
+
+    def run_float(arrs):
+        ts = [paddle.to_tensor(a.astype(np.float32), stop_gradient=False)
+              for a in arrs]
+        out = op(*ts, **kwargs)
+        if output_index is not None:
+            out = out[output_index]
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        return ts, out
+
+    ts, out = run_float(np_inputs)
+    loss = out.sum() if out.size > 1 else out
+    loss.backward()
+    analytic = [t.grad.numpy().astype(np.float64) if t.grad is not None
+                else np.zeros_like(a)
+                for t, a in zip(ts, np_inputs)]
+
+    def scalar_loss(arrs):
+        ts2, out2 = run_float(arrs)
+        o = out2.numpy().astype(np.float64)
+        return o.sum()
+
+    for i, a in enumerate(np_inputs):
+        numeric = np.zeros_like(a)
+        flat = a.reshape(-1)
+        num_flat = numeric.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            up = scalar_loss(np_inputs)
+            flat[j] = orig - eps
+            down = scalar_loss(np_inputs)
+            flat[j] = orig
+            num_flat[j] = (up - down) / (2 * eps)
+        np.testing.assert_allclose(
+            analytic[i], numeric, atol=atol, rtol=rtol,
+            err_msg=f"{getattr(op,'__name__',op)} grad wrt input {i}")

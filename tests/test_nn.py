@@ -1,0 +1,264 @@
+"""Layer tests (reference: test/legacy_test layer tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+rng = np.random.RandomState(42)
+
+
+class TestLinear:
+    def test_forward(self):
+        paddle.seed(0)
+        lin = nn.Linear(4, 3)
+        x = rng.randn(2, 4).astype(np.float32)
+        out = lin(paddle.to_tensor(x))
+        ref = x @ lin.weight.numpy() + lin.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, atol=1e-5)
+
+    def test_backward(self):
+        lin = nn.Linear(4, 3)
+        x = paddle.to_tensor(rng.randn(2, 4).astype(np.float32))
+        loss = lin(x).sum()
+        loss.backward()
+        assert lin.weight.grad is not None
+        assert lin.bias.grad is not None
+        np.testing.assert_allclose(lin.bias.grad.numpy(), [2.0] * 3)
+
+    def test_state_dict(self):
+        lin = nn.Linear(4, 3)
+        sd = lin.state_dict()
+        assert set(sd.keys()) == {"weight", "bias"}
+        lin2 = nn.Linear(4, 3)
+        lin2.set_state_dict(sd)
+        np.testing.assert_allclose(lin2.weight.numpy(), lin.weight.numpy())
+
+
+class TestConv2D:
+    def test_forward_shape(self):
+        conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+        x = paddle.to_tensor(rng.randn(2, 3, 16, 16).astype(np.float32))
+        out = conv(x)
+        assert out.shape == [2, 8, 8, 8]
+
+    def test_vs_manual(self):
+        conv = nn.Conv2D(1, 1, 2, bias_attr=False)
+        x = rng.randn(1, 1, 3, 3).astype(np.float32)
+        out = conv(paddle.to_tensor(x)).numpy()
+        w = conv.weight.numpy()[0, 0]
+        expect = np.zeros((2, 2), np.float32)
+        for i in range(2):
+            for j in range(2):
+                expect[i, j] = (x[0, 0, i:i + 2, j:j + 2] * w).sum()
+        np.testing.assert_allclose(out[0, 0], expect, atol=1e-5)
+
+    def test_grad(self):
+        conv = nn.Conv2D(2, 4, 3, padding=1)
+        x = paddle.to_tensor(rng.randn(1, 2, 8, 8).astype(np.float32),
+                             stop_gradient=False)
+        conv(x).sum().backward()
+        assert conv.weight.grad is not None
+        assert x.grad is not None
+        assert x.grad.shape == [1, 2, 8, 8]
+
+    def test_groups(self):
+        conv = nn.Conv2D(4, 8, 3, groups=2)
+        x = paddle.to_tensor(rng.randn(1, 4, 8, 8).astype(np.float32))
+        assert conv(x).shape == [1, 8, 6, 6]
+
+
+class TestNorms:
+    def test_batchnorm_train_eval(self):
+        bn = nn.BatchNorm2D(3)
+        x = rng.randn(4, 3, 5, 5).astype(np.float32) * 2 + 1
+        bn.train()
+        out = bn(paddle.to_tensor(x))
+        # normalized output: per-channel mean ~0, var ~1
+        o = out.numpy()
+        np.testing.assert_allclose(o.mean(axis=(0, 2, 3)), np.zeros(3),
+                                   atol=1e-4)
+        np.testing.assert_allclose(o.var(axis=(0, 2, 3)), np.ones(3),
+                                   atol=1e-3)
+        # running stats moved toward batch stats
+        assert not np.allclose(bn._mean.numpy(), np.zeros(3))
+        bn.eval()
+        out_eval = bn(paddle.to_tensor(x))
+        assert out_eval.shape == [4, 3, 5, 5]
+
+    def test_layernorm(self):
+        ln = nn.LayerNorm(8)
+        x = rng.randn(2, 4, 8).astype(np.float32)
+        out = ln(paddle.to_tensor(x)).numpy()
+        mean = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        ref = (x - mean) / np.sqrt(var + 1e-5)
+        np.testing.assert_allclose(out, ref, atol=1e-4)
+
+    def test_rmsnorm(self):
+        rn = nn.RMSNorm(16)
+        x = rng.randn(2, 16).astype(np.float32)
+        out = rn(paddle.to_tensor(x)).numpy()
+        ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(out, ref, atol=1e-4)
+
+    def test_groupnorm(self):
+        gn = nn.GroupNorm(2, 4)
+        x = rng.randn(2, 4, 3, 3).astype(np.float32)
+        out = gn(paddle.to_tensor(x))
+        assert out.shape == [2, 4, 3, 3]
+
+
+class TestActivationsDropout:
+    def test_relu_gelu(self):
+        x = rng.randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(F.relu(paddle.to_tensor(x)).numpy(),
+                                   np.maximum(x, 0))
+        g = F.gelu(paddle.to_tensor(x)).numpy()
+        from scipy.stats import norm
+        ref = x * norm.cdf(x)
+        np.testing.assert_allclose(g, ref, atol=1e-4)
+
+    def test_softmax(self):
+        x = rng.randn(2, 5).astype(np.float32)
+        out = F.softmax(paddle.to_tensor(x), axis=-1).numpy()
+        e = np.exp(x - x.max(-1, keepdims=True))
+        np.testing.assert_allclose(out, e / e.sum(-1, keepdims=True),
+                                   atol=1e-5)
+
+    def test_dropout_train_eval(self):
+        paddle.seed(1)
+        x = paddle.ones([1000])
+        d = nn.Dropout(0.5)
+        d.train()
+        out = d(x).numpy()
+        frac_zero = (out == 0).mean()
+        assert 0.4 < frac_zero < 0.6
+        kept = out[out != 0]
+        np.testing.assert_allclose(kept, 2.0 * np.ones_like(kept))
+        d.eval()
+        np.testing.assert_allclose(d(x).numpy(), np.ones(1000))
+
+
+class TestLosses:
+    def test_cross_entropy(self):
+        logits = rng.randn(4, 5).astype(np.float32)
+        labels = np.array([0, 2, 4, 1])
+        loss = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(labels))
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = -np.log(p[np.arange(4), labels]).mean()
+        np.testing.assert_allclose(float(loss), ref, atol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = rng.randn(4, 5).astype(np.float32)
+        labels = np.array([0, -100, 4, -100])
+        loss = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(labels), ignore_index=-100)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = -np.log(p[[0, 2], [0, 4]]).mean()
+        np.testing.assert_allclose(float(loss), ref, atol=1e-5)
+
+    def test_cross_entropy_soft_label(self):
+        logits = rng.randn(3, 4).astype(np.float32)
+        soft = rng.rand(3, 4).astype(np.float32)
+        soft /= soft.sum(-1, keepdims=True)
+        loss = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(soft), soft_label=True)
+        logp = logits - logits.max(-1, keepdims=True)
+        logp = logp - np.log(np.exp(logp).sum(-1, keepdims=True))
+        ref = (-(soft * logp).sum(-1)).mean()
+        np.testing.assert_allclose(float(loss), ref, atol=1e-5)
+
+    def test_mse_l1(self):
+        a = rng.randn(3, 4).astype(np.float32)
+        b = rng.randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            float(F.mse_loss(paddle.to_tensor(a), paddle.to_tensor(b))),
+            ((a - b) ** 2).mean(), atol=1e-5)
+        np.testing.assert_allclose(
+            float(F.l1_loss(paddle.to_tensor(a), paddle.to_tensor(b))),
+            np.abs(a - b).mean(), atol=1e-6)
+
+    def test_bce_with_logits(self):
+        logit = rng.randn(4).astype(np.float32)
+        label = (rng.rand(4) > 0.5).astype(np.float32)
+        out = F.binary_cross_entropy_with_logits(
+            paddle.to_tensor(logit), paddle.to_tensor(label))
+        p = 1 / (1 + np.exp(-logit))
+        ref = -(label * np.log(p) + (1 - label) * np.log(1 - p)).mean()
+        np.testing.assert_allclose(float(out), ref, atol=1e-5)
+
+
+class TestContainersEmbedding:
+    def test_sequential(self):
+        model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        x = paddle.to_tensor(rng.randn(3, 4).astype(np.float32))
+        assert model(x).shape == [3, 2]
+        assert len(model.parameters()) == 4
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4)
+        idx = paddle.to_tensor(np.array([[1, 2], [3, 4]]))
+        out = emb(idx)
+        assert out.shape == [2, 2, 4]
+        np.testing.assert_allclose(out.numpy()[0, 0], emb.weight.numpy()[1])
+
+    def test_embedding_grad(self):
+        emb = nn.Embedding(10, 4)
+        idx = paddle.to_tensor(np.array([1, 1, 2]))
+        emb(idx).sum().backward()
+        g = emb.weight.grad.numpy()
+        np.testing.assert_allclose(g[1], 2 * np.ones(4))
+        np.testing.assert_allclose(g[2], np.ones(4))
+        np.testing.assert_allclose(g[0], np.zeros(4))
+
+    def test_pooling(self):
+        x = paddle.to_tensor(rng.randn(1, 2, 4, 4).astype(np.float32))
+        assert nn.MaxPool2D(2)(x).shape == [1, 2, 2, 2]
+        assert nn.AvgPool2D(2)(x).shape == [1, 2, 2, 2]
+        assert nn.AdaptiveAvgPool2D(1)(x).shape == [1, 2, 1, 1]
+        xm = x.numpy()
+        np.testing.assert_allclose(
+            nn.AdaptiveAvgPool2D(1)(x).numpy()[..., 0, 0],
+            xm.mean(axis=(2, 3)), atol=1e-6)
+
+    def test_named_parameters(self):
+        model = nn.Sequential(nn.Linear(2, 3), nn.Linear(3, 4))
+        names = [n for n, _ in model.named_parameters()]
+        assert names == ["0.weight", "0.bias", "1.weight", "1.bias"]
+
+
+class TestMultiHeadAttention:
+    def test_shapes(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.to_tensor(rng.randn(2, 6, 16).astype(np.float32))
+        out = mha(x)
+        assert out.shape == [2, 6, 16]
+
+    def test_matches_manual_softmax(self):
+        mha = nn.MultiHeadAttention(8, 2, dropout=0.0)
+        x = paddle.to_tensor(rng.randn(1, 3, 8).astype(np.float32))
+        out = mha(x).numpy()
+        # manual reference
+        q = (x.numpy() @ mha.q_proj.weight.numpy() + mha.q_proj.bias.numpy())
+        k = (x.numpy() @ mha.k_proj.weight.numpy() + mha.k_proj.bias.numpy())
+        v = (x.numpy() @ mha.v_proj.weight.numpy() + mha.v_proj.bias.numpy())
+        q = q.reshape(1, 3, 2, 4).transpose(0, 2, 1, 3)
+        k = k.reshape(1, 3, 2, 4).transpose(0, 2, 1, 3)
+        v = v.reshape(1, 3, 2, 4).transpose(0, 2, 1, 3)
+        s = q @ k.transpose(0, 1, 3, 2) / 2.0
+        e = np.exp(s - s.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        o = (p @ v).transpose(0, 2, 1, 3).reshape(1, 3, 8)
+        ref = o @ mha.out_proj.weight.numpy() + mha.out_proj.bias.numpy()
+        np.testing.assert_allclose(out, ref, atol=1e-4)
+
+    def test_transformer_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        x = paddle.to_tensor(rng.randn(2, 5, 16).astype(np.float32))
+        assert enc(x).shape == [2, 5, 16]

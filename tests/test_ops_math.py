@@ -1,0 +1,165 @@
+"""Op unit tests vs NumPy (reference pattern: test/legacy_test/test_*_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_output, check_grad
+
+rng = np.random.RandomState(0)
+
+
+class TestBinaryOps:
+    def test_add(self):
+        a = rng.randn(3, 4).astype(np.float32)
+        b = rng.randn(3, 4).astype(np.float32)
+        check_output(paddle.add, np.add, [a, b])
+        check_grad(paddle.add, [a, b])
+
+    def test_broadcast_add(self):
+        a = rng.randn(3, 4).astype(np.float32)
+        b = rng.randn(4).astype(np.float32)
+        check_output(paddle.add, np.add, [a, b])
+        check_grad(paddle.add, [a, b])
+
+    def test_subtract(self):
+        a = rng.randn(2, 3).astype(np.float32)
+        b = rng.randn(2, 3).astype(np.float32)
+        check_output(paddle.subtract, np.subtract, [a, b])
+
+    def test_multiply(self):
+        a = rng.randn(2, 3).astype(np.float32)
+        b = rng.randn(2, 3).astype(np.float32)
+        check_output(paddle.multiply, np.multiply, [a, b])
+        check_grad(paddle.multiply, [a, b])
+
+    def test_divide(self):
+        a = rng.randn(2, 3).astype(np.float32)
+        b = rng.rand(2, 3).astype(np.float32) + 1.0
+        check_output(paddle.divide, np.true_divide, [a, b])
+        check_grad(paddle.divide, [a, b])
+
+    def test_pow(self):
+        a = rng.rand(2, 3).astype(np.float32) + 0.5
+        check_output(lambda x: paddle.pow(x, 2.3),
+                     lambda x: np.power(x, 2.3), [a], atol=1e-4)
+
+    def test_maximum_minimum(self):
+        a = rng.randn(3, 3).astype(np.float32)
+        b = rng.randn(3, 3).astype(np.float32)
+        check_output(paddle.maximum, np.maximum, [a, b])
+        check_output(paddle.minimum, np.minimum, [a, b])
+
+    def test_scalar_ops(self):
+        a = rng.randn(3, 3).astype(np.float32)
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose((t + 2).numpy(), a + 2, rtol=1e-6)
+        np.testing.assert_allclose((2 - t).numpy(), 2 - a, rtol=1e-6)
+        np.testing.assert_allclose((t * 3).numpy(), a * 3, rtol=1e-6)
+        np.testing.assert_allclose((t / 2).numpy(), a / 2, rtol=1e-6)
+
+
+class TestUnaryOps:
+    @pytest.mark.parametrize("pname,nref", [
+        ("exp", np.exp), ("log", None), ("sqrt", None), ("tanh", np.tanh),
+        ("sin", np.sin), ("cos", np.cos), ("abs", np.abs),
+        ("floor", np.floor), ("ceil", np.ceil), ("square", np.square),
+        ("sigmoid", None),
+    ])
+    def test_unary(self, pname, nref):
+        a = (rng.rand(3, 4).astype(np.float32) + 0.5)
+        op = getattr(paddle, pname)
+        if nref is None:
+            nref = {"log": np.log, "sqrt": np.sqrt,
+                    "sigmoid": lambda x: 1 / (1 + np.exp(-x))}[pname]
+        check_output(op, nref, [a], atol=1e-5)
+
+    def test_unary_grads(self):
+        a = rng.rand(2, 3).astype(np.float32) + 0.5
+        for op in [paddle.exp, paddle.log, paddle.sqrt, paddle.tanh,
+                   paddle.sigmoid, paddle.square]:
+            check_grad(op, [a])
+
+    def test_clip(self):
+        a = rng.randn(4, 4).astype(np.float32)
+        check_output(lambda x: paddle.clip(x, -0.5, 0.5),
+                     lambda x: np.clip(x, -0.5, 0.5), [a])
+
+
+class TestMatmul:
+    def test_matmul(self):
+        a = rng.randn(3, 4).astype(np.float32)
+        b = rng.randn(4, 5).astype(np.float32)
+        check_output(paddle.matmul, np.matmul, [a, b], atol=1e-4)
+        check_grad(paddle.matmul, [a, b], atol=2e-2)
+
+    def test_matmul_transpose(self):
+        a = rng.randn(4, 3).astype(np.float32)
+        b = rng.randn(4, 5).astype(np.float32)
+        out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b),
+                            transpose_x=True)
+        np.testing.assert_allclose(out.numpy(), a.T @ b, atol=1e-4)
+
+    def test_batched(self):
+        a = rng.randn(2, 3, 4).astype(np.float32)
+        b = rng.randn(2, 4, 5).astype(np.float32)
+        check_output(paddle.matmul, np.matmul, [a, b], atol=1e-4)
+
+
+class TestReduce:
+    def test_sum_mean(self):
+        a = rng.randn(3, 4, 5).astype(np.float32)
+        check_output(lambda x: paddle.sum(x), lambda x: np.sum(x), [a],
+                     atol=1e-4)
+        check_output(lambda x: paddle.sum(x, axis=1),
+                     lambda x: np.sum(x, axis=1), [a], atol=1e-5)
+        check_output(lambda x: paddle.mean(x, axis=[0, 2], keepdim=True),
+                     lambda x: np.mean(x, axis=(0, 2), keepdims=True), [a])
+        check_grad(lambda x: paddle.mean(x, axis=1), [a[0]])
+
+    def test_max_min_argmax(self):
+        a = rng.randn(3, 5).astype(np.float32)
+        check_output(lambda x: paddle.max(x, axis=1),
+                     lambda x: np.max(x, axis=1), [a])
+        check_output(lambda x: paddle.argmax(x, axis=1),
+                     lambda x: np.argmax(x, axis=1), [a])
+
+    def test_var_std(self):
+        a = rng.randn(4, 6).astype(np.float32)
+        check_output(lambda x: paddle.var(x, axis=1),
+                     lambda x: np.var(x, axis=1, ddof=1), [a], atol=1e-5)
+        check_output(lambda x: paddle.std(x),
+                     lambda x: np.std(x, ddof=1), [a], atol=1e-5)
+
+    def test_logsumexp(self):
+        a = rng.randn(3, 4).astype(np.float32)
+        from scipy.special import logsumexp as np_lse
+        check_output(lambda x: paddle.logsumexp(x, axis=1),
+                     lambda x: np_lse(x, axis=1), [a], atol=1e-5)
+
+    def test_cumsum(self):
+        a = rng.randn(3, 4).astype(np.float32)
+        check_output(lambda x: paddle.cumsum(x, axis=1),
+                     lambda x: np.cumsum(x, axis=1), [a], atol=1e-5)
+
+
+class TestComparison:
+    def test_compare(self):
+        a = rng.randn(3, 3).astype(np.float32)
+        b = rng.randn(3, 3).astype(np.float32)
+        check_output(paddle.equal, np.equal, [a, a])
+        check_output(paddle.greater_than, np.greater, [a, b])
+        check_output(paddle.less_equal, np.less_equal, [a, b])
+
+    def test_logical(self):
+        a = rng.rand(3, 3) > 0.5
+        b = rng.rand(3, 3) > 0.5
+        check_output(paddle.logical_and, np.logical_and, [a, b])
+        check_output(paddle.logical_not, np.logical_not, [a])
+
+    def test_where(self):
+        c = rng.rand(3, 3) > 0.5
+        a = rng.randn(3, 3).astype(np.float32)
+        b = rng.randn(3, 3).astype(np.float32)
+        out = paddle.where(paddle.to_tensor(c), paddle.to_tensor(a),
+                           paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), np.where(c, a, b))

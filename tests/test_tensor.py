@@ -1,0 +1,186 @@
+"""Tensor semantics tests (reference: test/legacy_test/test_var_base.py,
+test_tensor_patch_methods)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import Tensor
+
+
+class TestTensorBasics:
+    def test_creation(self):
+        t = paddle.to_tensor([1.0, 2.0, 3.0])
+        assert t.shape == [3]
+        assert t.dtype == paddle.float32
+        assert t.stop_gradient
+
+        t2 = paddle.to_tensor([[1, 2], [3, 4]])
+        assert t2.dtype == paddle.int64
+        assert t2.shape == [2, 2]
+
+    def test_default_float32(self):
+        t = paddle.to_tensor(np.zeros((2, 2)))  # float64 numpy in
+        assert t.dtype == paddle.float32
+
+    def test_astype(self):
+        t = paddle.to_tensor([1.5, 2.5])
+        i = t.astype("int32")
+        assert i.dtype == paddle.int32
+        assert i.numpy().tolist() == [1, 2]
+
+    def test_item(self):
+        t = paddle.to_tensor(3.5)
+        assert t.item() == pytest.approx(3.5)
+        assert float(t) == pytest.approx(3.5)
+
+    def test_getitem(self):
+        a = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(t[0].numpy(), a[0])
+        np.testing.assert_allclose(t[0, 1].numpy(), a[0, 1])
+        np.testing.assert_allclose(t[:, 1:2].numpy(), a[:, 1:2])
+        np.testing.assert_allclose(t[..., -1].numpy(), a[..., -1])
+        np.testing.assert_allclose(t[t > 10].numpy(), a[a > 10])
+
+    def test_getitem_tensor_index(self):
+        a = np.arange(10, dtype=np.float32)
+        t = paddle.to_tensor(a)
+        idx = paddle.to_tensor([1, 3, 5])
+        np.testing.assert_allclose(t[idx].numpy(), a[[1, 3, 5]])
+
+    def test_setitem(self):
+        a = np.zeros((3, 3), np.float32)
+        t = paddle.to_tensor(a)
+        t[0, 0] = 5.0
+        t[1] = paddle.ones([3])
+        assert t.numpy()[0, 0] == 5.0
+        np.testing.assert_allclose(t.numpy()[1], np.ones(3))
+
+    def test_setitem_grad(self):
+        t = paddle.ones([3], dtype="float32")
+        t.stop_gradient = False
+        u = t * 2
+        u[0] = 7.0
+        u.sum().backward()
+        np.testing.assert_allclose(t.grad.numpy(), [0.0, 2.0, 2.0])
+
+    def test_inplace_ops(self):
+        t = paddle.to_tensor([1.0, 2.0])
+        t.add_(1.0)
+        np.testing.assert_allclose(t.numpy(), [2.0, 3.0])
+        t.scale_(2.0)
+        np.testing.assert_allclose(t.numpy(), [4.0, 6.0])
+
+    def test_repr(self):
+        t = paddle.to_tensor([1.0])
+        assert "Tensor" in repr(t)
+
+    def test_numel_size(self):
+        t = paddle.zeros([2, 3, 4])
+        assert t.size == 24
+        assert int(t.numel()) == 24
+        assert t.ndim == 3
+
+
+class TestAutograd:
+    def test_simple_backward(self):
+        x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+    def test_grad_accumulation(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        (x * 2).backward()
+        (x * 3).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+    def test_clear_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        (x * 2).backward()
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_no_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+
+    def test_detach(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = (x * 2).detach()
+        assert y.stop_gradient
+        z = y * 3
+        assert z.stop_gradient
+
+    def test_stop_gradient_barrier(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 2
+        y2 = y.detach()
+        w = paddle.to_tensor([1.0], stop_gradient=False)
+        (y2 * w).backward()
+        assert x.grad is None
+        np.testing.assert_allclose(w.grad.numpy(), [2.0])
+
+    def test_paddle_grad_api(self):
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = x * x
+        (gx,) = paddle.grad(y, x)
+        np.testing.assert_allclose(gx.numpy(), [6.0])
+        assert x.grad is None  # paddle.grad must not pollute .grad
+
+    def test_double_backward_error(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = (x * 2).sum()
+        y.backward()
+        with pytest.raises(RuntimeError):
+            y.backward()
+
+    def test_retain_graph(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = (x * 2).sum()
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+    def test_multi_output_op(self):
+        x = paddle.to_tensor(np.array([3.0, 1.0, 2.0], np.float32),
+                             stop_gradient=False)
+        vals, idx = paddle.topk(x, 2)
+        vals.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.0, 1.0])
+
+    def test_backward_nonscalar_with_grad(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * 3
+        y.backward(paddle.to_tensor([1.0, 10.0]))
+        np.testing.assert_allclose(x.grad.numpy(), [3.0, 30.0])
+
+    def test_register_hook(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 2
+        y.register_hook(lambda g: g * 10)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [20.0])
+
+
+class TestPyLayer:
+    def test_custom_vjp(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class Cube(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x * x
+
+            @staticmethod
+            def backward(ctx, dy):
+                (x,) = ctx.saved_tensor()
+                return dy * 3 * x * x
+
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = Cube.apply(x)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [12.0])
