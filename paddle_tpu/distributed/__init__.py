@@ -1,8 +1,35 @@
 """paddle.distributed namespace — TPU-native (SURVEY §8: process groups →
-mesh axes, NCCL → XLA collectives over ICI/DCN)."""
+mesh axes, NCCL → XLA collectives over ICI/DCN, reshard functions → GSPMD
+resharding)."""
 from . import env
 from .env import get_rank, get_world_size, init_parallel_env, ParallelEnv, \
     is_initialized
+from .mesh import ProcessMesh, get_mesh, set_mesh, auto_mesh, \
+    init_device_mesh
+from .placement import Shard, Replicate, Partial, Placement
+from .collective import Group, new_group, get_group, all_reduce, all_gather, \
+    all_gather_object, reduce_scatter, all_to_all, alltoall, broadcast, \
+    reduce, scatter, barrier, send, recv, isend, irecv, ReduceOp, wait
+from .auto_parallel.api import shard_tensor, reshard, shard_layer, \
+    shard_optimizer, dtensor_from_local, dtensor_to_local, unshard_dtensor, \
+    ShardingStage1, ShardingStage2, ShardingStage3, get_placements
+from .shard_ops import sharding_constraint, annotate
+from . import fleet
+from . import checkpoint
+from .checkpoint import save_state_dict, load_state_dict
+from .fleet.meta_parallel.parallel_wrappers import DataParallel
+from . import pipelining
 
-__all__ = ["env", "get_rank", "get_world_size", "init_parallel_env",
-           "ParallelEnv", "is_initialized"]
+__all__ = [
+    "env", "get_rank", "get_world_size", "init_parallel_env", "ParallelEnv",
+    "is_initialized", "ProcessMesh", "get_mesh", "set_mesh", "auto_mesh",
+    "init_device_mesh", "Shard", "Replicate", "Partial", "Placement",
+    "Group", "new_group", "get_group", "all_reduce", "all_gather",
+    "reduce_scatter", "all_to_all", "alltoall", "broadcast", "reduce",
+    "scatter", "barrier", "send", "recv", "ReduceOp", "wait",
+    "shard_tensor", "reshard", "shard_layer", "shard_optimizer",
+    "dtensor_from_local", "dtensor_to_local", "unshard_dtensor",
+    "ShardingStage1", "ShardingStage2", "ShardingStage3", "fleet",
+    "checkpoint", "save_state_dict", "load_state_dict", "DataParallel",
+    "sharding_constraint", "annotate", "get_placements",
+]

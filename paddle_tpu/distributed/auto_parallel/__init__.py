@@ -1,0 +1,7 @@
+from .api import shard_tensor, reshard, shard_layer, shard_optimizer, \
+    dtensor_from_local, dtensor_to_local, unshard_dtensor, ShardingStage1, \
+    ShardingStage2, ShardingStage3
+
+__all__ = ["shard_tensor", "reshard", "shard_layer", "shard_optimizer",
+           "dtensor_from_local", "dtensor_to_local", "unshard_dtensor",
+           "ShardingStage1", "ShardingStage2", "ShardingStage3"]

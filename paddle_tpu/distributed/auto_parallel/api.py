@@ -1,0 +1,234 @@
+"""Semi-automatic SPMD API (reference:
+python/paddle/distributed/auto_parallel/api.py — shard_tensor:206,
+reshard:705, shard_layer:806, shard_optimizer:1591, dtensor_from_local:619).
+
+TPU-native translation: a "DistTensor" IS a jax.Array with a NamedSharding —
+no separate runtime type. shard_tensor = device_put with a NamedSharding;
+reshard = device_put to the new sharding (XLA emits the collective:
+s→r allgather, p→r allreduce, s→s all-to-all — the reference's 12 reshard
+functions in paddle/phi/core/distributed/auto_parallel/reshard/ collapse
+into GSPMD's resharding); SPMD *rules* (infermeta/spmd_rules, 113 files)
+collapse into GSPMD propagation through jit.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..mesh import ProcessMesh, get_mesh
+from ..placement import Shard, Replicate, Partial, placements_to_spec, \
+    spec_to_placements
+from ...framework.tensor import Tensor
+
+__all__ = ["shard_tensor", "reshard", "shard_layer", "shard_optimizer",
+           "dtensor_from_local", "dtensor_to_local", "unshard_dtensor",
+           "ShardingStage1", "ShardingStage2", "ShardingStage3"]
+
+
+def _as_mesh(mesh):
+    if mesh is None:
+        mesh = get_mesh()
+    if isinstance(mesh, ProcessMesh):
+        return mesh
+    return ProcessMesh(mesh)
+
+
+def _sharding(mesh, placements, ndim):
+    spec = placements_to_spec(mesh, placements, ndim)
+    return NamedSharding(mesh.jax_mesh, spec)
+
+
+def shard_tensor(data, mesh=None, placements=None, dtype=None,
+                 stop_gradient=None):
+    """Place a tensor on the mesh with the given placements."""
+    mesh = _as_mesh(mesh)
+    t = data if isinstance(data, Tensor) else Tensor(data, dtype=dtype)
+    placements = placements or [Replicate() for _ in mesh.dim_names]
+    sh = _sharding(mesh, placements, t.ndim)
+    arr = jax.device_put(t._data, sh)
+    out = Tensor(arr, stop_gradient=t.stop_gradient
+                 if stop_gradient is None else stop_gradient)
+    out._grad_node = t._grad_node
+    out._out_index = t._out_index
+    out.name = t.name
+    return out
+
+
+def reshard(x, mesh=None, placements=None):
+    """Convert placements; XLA inserts the matching collective."""
+    mesh = _as_mesh(mesh)
+    for p in (placements or []):
+        if isinstance(p, Partial):
+            raise ValueError(
+                "reshard to Partial is not expressible at the API level on "
+                "TPU; Partial exists transiently inside shard_map regions")
+    sh = _sharding(mesh, placements or [], x.ndim)
+    arr = jax.device_put(x._data, sh)
+    out = Tensor(arr, stop_gradient=x.stop_gradient)
+    out._grad_node = x._grad_node
+    out._out_index = x._out_index
+    return out
+
+
+def get_placements(x, mesh=None):
+    """Inverse: read a tensor's placements from its jax sharding."""
+    mesh = _as_mesh(mesh)
+    sh = getattr(x._data, "sharding", None)
+    if not isinstance(sh, NamedSharding):
+        return [Replicate() for _ in mesh.dim_names]
+    return spec_to_placements(mesh, sh.spec, x.ndim)
+
+
+def shard_layer(layer, process_mesh=None, shard_fn=None,
+                input_fn=None, output_fn=None):
+    """Shard every parameter of `layer` (reference api.py:806).  Default:
+    replicate everything; `shard_fn(name, layer, mesh)` customizes."""
+    mesh = _as_mesh(process_mesh)
+
+    def default_shard(sub_name, sub_layer, m):
+        for pname, p in list(sub_layer._parameters.items()):
+            if p is None:
+                continue
+            sharded = shard_tensor(p, m,
+                                   [Replicate() for _ in m.dim_names])
+            p._data = sharded._data
+    fn = shard_fn or default_shard
+    for name, sub in layer.named_sublayers(include_self=True):
+        fn(name, sub, mesh)
+    if input_fn is not None or output_fn is not None:
+        orig_forward = layer.forward
+
+        def wrapped(*args, **kwargs):
+            if input_fn is not None:
+                args = input_fn(args, mesh)
+            out = orig_forward(*args, **kwargs)
+            if output_fn is not None:
+                out = output_fn(out, mesh)
+            return out
+        layer.forward = wrapped
+    return layer
+
+
+def dtensor_from_local(local_tensor, mesh=None, placements=None):
+    """Assemble a global sharded array from this process's local shard
+    (reference api.py:619).  Single-process SPMD: uses
+    jax.make_array_from_single_device_arrays across local devices."""
+    mesh = _as_mesh(mesh)
+    t = local_tensor if isinstance(local_tensor, Tensor) else Tensor(local_tensor)
+    placements = placements or [Replicate() for _ in mesh.dim_names]
+    # global shape: multiply sharded dims by mesh size
+    gshape = list(t._data.shape)
+    for ax, p in enumerate(placements):
+        if isinstance(p, Shard):
+            gshape[p.dim] *= mesh.shape[ax]
+    sh = _sharding(mesh, placements, len(gshape))
+    n_shards = len(mesh.process_ids)
+    local = np.asarray(t._data)
+    # replicate/tile local shards onto each device slot
+    devices = mesh.jax_mesh.devices.reshape(-1)
+    arrs = [jax.device_put(local, d) for d in devices]
+    arr = jax.make_array_from_single_device_arrays(tuple(gshape), sh, arrs)
+    return Tensor(arr, stop_gradient=t.stop_gradient)
+
+
+def dtensor_to_local(dist_tensor, mesh=None, placements=None):
+    """This process's local shard as a dense tensor."""
+    arr = dist_tensor._data
+    shards = getattr(arr, "addressable_shards", None)
+    if not shards:
+        return dist_tensor
+    return Tensor(shards[0].data, stop_gradient=dist_tensor.stop_gradient)
+
+
+def unshard_dtensor(dist_tensor):
+    """Gather to a fully replicated dense tensor."""
+    mesh = get_mesh()
+    if mesh is None:
+        return dist_tensor
+    return reshard(dist_tensor, mesh,
+                   [Replicate() for _ in mesh.dim_names])
+
+
+# ----------------------------------------------------------- optimizer
+class _ShardingStage:
+    """Configuration token (reference api.py ShardingStage1/2/3:1301+)."""
+
+    stage = 0
+
+    def __init__(self, sharding_mesh_dim=None, mesh=None):
+        self.mesh_dim = sharding_mesh_dim or "dp"
+        self.mesh = mesh
+
+
+class ShardingStage1(_ShardingStage):
+    stage = 1
+
+
+class ShardingStage2(_ShardingStage):
+    stage = 2
+
+
+class ShardingStage3(_ShardingStage):
+    stage = 3
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """Shard optimizer states over the sharding mesh dim (reference
+    api.py:1591).  On TPU, stage-1/2 = shard accumulator arrays over the
+    dp axis (GSPMD keeps updates local, grads arrive reduced); stage-3
+    additionally shards parameters.
+
+    shard_fn may be a ShardingStage instance/class, or a plain function
+    `(name, param, accumulator_array) -> array` applied to every state
+    (reference's custom shard_fn form)."""
+    if shard_fn is None:
+        cfg = ShardingStage1()
+    elif isinstance(shard_fn, _ShardingStage):
+        cfg = shard_fn
+    elif isinstance(shard_fn, type) and issubclass(shard_fn, _ShardingStage):
+        cfg = shard_fn()
+    elif callable(shard_fn):
+        def custom_acc(p, name, init=None):
+            key = optimizer._param_key(p)
+            slot = optimizer._accumulators.setdefault(key, {})
+            if name not in slot:
+                base = init if init is not None else \
+                    jax.numpy.zeros(p._data.shape, jax.numpy.float32)
+                slot[name] = shard_fn(name, p, base)
+            return slot[name]
+        optimizer._acc = custom_acc
+        return optimizer
+    else:
+        raise TypeError(f"unsupported shard_fn: {shard_fn!r}")
+    mesh = _as_mesh(cfg.mesh)
+    axis = cfg.mesh_dim if cfg.mesh_dim in mesh.dim_names else mesh.dim_names[0]
+    axis_idx = mesh.dim_names.index(axis)
+
+    def shard_state(arr):
+        # shard along the largest dim divisible by the axis size
+        size = mesh.shape[axis_idx]
+        for d, s in enumerate(arr.shape):
+            if s % size == 0 and s >= size:
+                placements = [Replicate()] * len(mesh.dim_names)
+                placements[axis_idx] = Shard(d)
+                sh = _sharding(mesh, placements, arr.ndim)
+                return jax.device_put(arr, sh)
+        return arr
+
+    optimizer._shard_state_fn = shard_state
+
+    def sharded_acc(p, name, init=None):
+        key = optimizer._param_key(p)
+        slot = optimizer._accumulators.setdefault(key, {})
+        if name not in slot:
+            base = init if init is not None else \
+                jax.numpy.zeros(p._data.shape, jax.numpy.float32)
+            slot[name] = shard_state(base)
+        return slot[name]
+
+    optimizer._acc = sharded_acc
+    if cfg.stage >= 3:
+        for p in optimizer._parameter_list:
+            p._data = shard_state(p._data)
+    return optimizer

@@ -1,0 +1,3 @@
+from .save_load import save_state_dict, load_state_dict
+
+__all__ = ["save_state_dict", "load_state_dict"]

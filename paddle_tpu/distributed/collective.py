@@ -1,0 +1,381 @@
+"""Collective communication API.
+
+Reference: python/paddle/distributed/communication/* + ProcessGroup layer
+(paddle/fluid/distributed/collective/process_group_nccl.h:37).  TPU-native
+story (SURVEY §8): a "process group" is a set of mesh axis names; inside
+jit/shard_map the collective IS the XLA op (psum/all_gather/ppermute over
+ICI); eagerly, collectives execute as tiny jitted shard_map programs over
+the group's mesh axes.  Single-device groups are identity.
+
+Two calling contexts, one API:
+  * traced (inside shard_map with the axis in scope) → jax.lax collective
+  * eager Tensor → jitted shard_map over the global mesh
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .mesh import get_mesh, ProcessMesh
+from ..framework.tensor import Tensor
+
+__all__ = ["Group", "new_group", "get_group", "all_reduce", "all_gather",
+           "all_gather_object", "reduce_scatter", "all_to_all", "broadcast",
+           "reduce", "scatter", "barrier", "send", "recv", "irecv", "isend",
+           "ReduceOp", "split", "wait", "get_world_size_group"]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communicator = one or more mesh axes (reference: new_group over
+    rank lists; here groups are axis-aligned, matching hybrid topology)."""
+
+    def __init__(self, axis_names, mesh=None, gid=0):
+        self.axis_names = tuple(axis_names) if not isinstance(axis_names, str) \
+            else (axis_names,)
+        self._mesh = mesh
+        self.id = gid
+
+    @property
+    def mesh(self):
+        return self._mesh or get_mesh()
+
+    @property
+    def nranks(self):
+        m = self.mesh
+        if m is None:
+            return 1
+        n = 1
+        for a in self.axis_names:
+            if a in m.dim_names:
+                n *= m.get_dim_size(a)
+        return n
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def rank(self):
+        return 0  # single-controller SPMD: per-device rank exists in-graph
+
+    @property
+    def process_ids(self):
+        return list(range(self.nranks))
+
+    def get_group_rank(self, rank):
+        return rank if rank < self.nranks else -1
+
+    def __repr__(self):
+        return f"Group(axes={self.axis_names}, nranks={self.nranks})"
+
+
+_groups: dict[int, Group] = {}
+_next_gid = [1]
+_default_group: Group | None = None
+
+
+def _get_or_create_default_group():
+    global _default_group
+    if _default_group is None:
+        m = get_mesh()
+        axes = tuple(m.dim_names) if m is not None else ()
+        _default_group = Group(axes, gid=0)
+        _groups[0] = _default_group
+    return _default_group
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_names=None):
+    """reference collective.py:194 new_group.  Axis-aligned groups: pass
+    axis_names; rank-list groups map onto the axis whose size matches."""
+    gid = _next_gid[0]
+    _next_gid[0] += 1
+    if axis_names is None:
+        m = get_mesh()
+        if m is not None and ranks is not None:
+            matches = [a for a in m.dim_names
+                       if m.get_dim_size(a) == len(ranks)]
+            if len(matches) > 1:
+                import warnings
+                warnings.warn(
+                    f"new_group(ranks={ranks}): multiple mesh axes "
+                    f"{matches} have size {len(ranks)}; picking "
+                    f"{matches[0]!r}. Pass axis_names= to disambiguate.")
+            if matches:
+                axis_names = (matches[0],)
+        axis_names = axis_names or (m.dim_names if m else ())
+    g = Group(axis_names, gid=gid)
+    _groups[gid] = g
+    return g
+
+
+def get_group(gid=0):
+    return _groups.get(gid) or _get_or_create_default_group()
+
+
+def _axes(group):
+    if group is None:
+        group = _get_or_create_default_group()
+    return tuple(a for a in group.axis_names
+                 if get_mesh() is not None and a in get_mesh().dim_names)
+
+
+def _eager_shardmap(fn, x, group):
+    """Run a per-shard function over the group's axes on an eager array."""
+    m = get_mesh().jax_mesh
+    axes = _axes(group)
+    sharding = getattr(x, "sharding", None)
+    spec = sharding.spec if isinstance(sharding, NamedSharding) \
+        else PartitionSpec()
+    from jax import shard_map
+    out_spec = spec  # same layout by default
+    return jax.jit(shard_map(fn, mesh=m, in_specs=(spec,),
+                             out_specs=out_spec,
+                             check_vma=False))(x)
+
+
+def _prod_reduce(x, axes):
+    # XLA has no pprod; exp∘psum∘log is numerically fragile, so gather+prod.
+    for a in axes:
+        x = jnp.prod(jax.lax.all_gather(x, a, axis=0), axis=0)
+    return x
+
+
+def _reduce_fn(op):
+    fns = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
+           ReduceOp.MIN: jax.lax.pmin, ReduceOp.PROD: _prod_reduce,
+           ReduceOp.AVG: jax.lax.psum}
+    if op not in fns:
+        raise ValueError(f"unsupported reduce op: {op!r}")
+    return fns[op]
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """In-place all-reduce over the group's mesh axes."""
+    axes = _axes(group)
+    if not axes:
+        return tensor
+    arr = tensor._data if isinstance(tensor, Tensor) else tensor
+    if isinstance(arr, jax.core.Tracer):
+        out = _reduce_fn(op)(arr, axes)
+        if op == ReduceOp.AVG:
+            out = out / np.prod([jax.lax.axis_size(a) for a in axes])
+        if isinstance(tensor, Tensor):
+            tensor._data = out
+            return tensor
+        return out
+
+    def body(x):
+        r = _reduce_fn(op)(x, axes)
+        if op == ReduceOp.AVG:
+            import numpy as _np
+            n = int(_np.prod([get_mesh().get_dim_size(a) for a in axes]))
+            r = r / n
+        return r
+    out = _eager_shardmap(body, arr, group)
+    if isinstance(tensor, Tensor):
+        tensor._data = out
+        return tensor
+    return out
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    """Gather shards from every rank (reference: all_gather fills a list).
+    Traced form returns the concatenated array."""
+    axes = _axes(group)
+    arr = tensor._data if isinstance(tensor, Tensor) else tensor
+    if not axes:
+        if tensor_list is not None:
+            tensor_list.append(Tensor(arr) if not isinstance(tensor, Tensor)
+                               else tensor)
+            return tensor_list
+        return tensor
+    def _gather_all(x):
+        for a in axes:
+            x = jax.lax.all_gather(x, a, axis=axis, tiled=True)
+        return x
+
+    if isinstance(arr, jax.core.Tracer):
+        return _gather_all(arr)
+
+    # eager: every rank's gathered result is identical → replicated output
+    def body(x):
+        return _gather_all(x)
+    m = get_mesh().jax_mesh
+    from jax import shard_map
+    sharding = getattr(arr, "sharding", None)
+    spec = sharding.spec if isinstance(sharding, NamedSharding) \
+        else PartitionSpec()
+    gathered = jax.jit(shard_map(
+        body, mesh=m, in_specs=(spec,), out_specs=PartitionSpec(),
+        check_vma=False))(arr)
+    if tensor_list is not None:
+        n = int(np.prod([get_mesh().get_dim_size(a) for a in axes]))
+        for piece in jnp.split(gathered, n, axis=axis):
+            tensor_list.append(Tensor(piece))
+        return tensor_list
+    return Tensor(gathered)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
+                   group=None, sync_op=True, axis=0):
+    axes = _axes(group)
+    src = tensor_or_tensor_list
+    if isinstance(src, (list, tuple)):
+        src = jnp.concatenate([t._data if isinstance(t, Tensor) else t
+                               for t in src], axis=axis)
+    elif isinstance(src, Tensor):
+        src = src._data
+    if not axes:
+        if isinstance(tensor, Tensor):
+            tensor._data = src
+        return tensor
+    def _scatter_all(x):
+        for a in axes:
+            x = jax.lax.psum_scatter(x, a, scatter_dimension=axis,
+                                     tiled=True)
+        return x
+
+    if isinstance(src, jax.core.Tracer):
+        return _scatter_all(src)
+
+    out = _eager_shardmap(_scatter_all, src, group)
+    if isinstance(tensor, Tensor):
+        tensor._data = out
+        return tensor
+    return Tensor(out)
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """reference: dist.alltoall — exchange the i-th chunk with rank i."""
+    axes = _axes(group)
+    if isinstance(in_tensor_list, (list, tuple)):
+        x = jnp.stack([t._data if isinstance(t, Tensor) else t
+                       for t in in_tensor_list], axis=0)
+    else:
+        x = in_tensor_list._data if isinstance(in_tensor_list, Tensor) \
+            else in_tensor_list
+    if not axes:
+        if out_tensor_list is not None:
+            out_tensor_list.extend(
+                [Tensor(s) for s in list(x)] if x.ndim else [Tensor(x)])
+            return out_tensor_list
+        return in_tensor_list
+    if isinstance(x, jax.core.Tracer):
+        return jax.lax.all_to_all(x, axes[0], split_axis=0, concat_axis=0,
+                                  tiled=True)
+    # Eager all-to-all is ill-posed under a single controller (each logical
+    # rank's output differs but hosts see one value) — the meaningful form
+    # is the traced one (MoE dispatch under shard_map). Replicated input →
+    # the exchange is the identity on the list.
+    if out_tensor_list is not None:
+        out_tensor_list.extend(
+            t if isinstance(t, Tensor) else Tensor(t)
+            for t in (in_tensor_list if isinstance(in_tensor_list,
+                                                   (list, tuple)) else [x]))
+        return out_tensor_list
+    return in_tensor_list
+
+
+alltoall = all_to_all
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """Broadcast from src rank.  Under SPMD every rank already holds the
+    replicated value, so this materializes the replicated sharding."""
+    axes = _axes(group)
+    if not axes:
+        return tensor
+    arr = tensor._data if isinstance(tensor, Tensor) else tensor
+    if isinstance(arr, jax.core.Tracer):
+        # select src's value on every rank
+        idx = jax.lax.axis_index(axes[0])
+        src_val = jax.lax.all_gather(arr, axes[0], axis=0)[src]
+        return src_val
+    m = get_mesh()
+    sh = NamedSharding(m.jax_mesh, PartitionSpec())
+    out = jax.device_put(arr, sh)
+    if isinstance(tensor, Tensor):
+        tensor._data = out
+        return tensor
+    return Tensor(out)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op=op, group=group)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """Deliver tensor_list[my_rank] (src names the sender, whose list is
+    authoritative — under a single controller every rank sees that list).
+    Traced form selects the chunk by in-graph axis_index."""
+    axes = _axes(group)
+    if tensor_list:
+        arrs = [t._data if isinstance(t, Tensor) else t for t in tensor_list]
+        if not axes:
+            tensor._data = arrs[0]
+            return tensor
+        first = arrs[0]
+        if isinstance(first, jax.core.Tracer) or any(
+                isinstance(a, jax.core.Tracer) for a in arrs):
+            stacked = jnp.stack(arrs)
+            my = jax.lax.axis_index(axes[0])
+            return jnp.take(stacked, my, axis=0)
+        # eager single-controller: the calling process is rank 0
+        tensor._data = arrs[0]
+        return tensor
+    return tensor
+
+
+def barrier(group=None):
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point send/recv is expressed as ppermute inside "
+        "shard_map on TPU (see fleet.meta_parallel pipeline); host-level "
+        "P2P is not part of the SPMD model")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point send/recv is expressed as ppermute inside "
+        "shard_map on TPU (see fleet.meta_parallel pipeline)")
+
+
+isend = send
+irecv = recv
+
+
+def split(x, num_or_sections, axis=0, group=None):
+    from ..ops.manipulation import split as _split
+    return _split(x, num_or_sections, axis)
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    arr = tensor._data if isinstance(tensor, Tensor) else tensor
+    if hasattr(arr, "block_until_ready"):
+        arr.block_until_ready()
+    return tensor
+
+
+def get_world_size_group(group=None):
+    g = group or _get_or_create_default_group()
+    return g.nranks
+
+
+def all_gather_object(object_list, obj, group=None):
+    object_list.append(obj)
+    return object_list

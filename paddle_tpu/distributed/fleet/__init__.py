@@ -1,0 +1,28 @@
+"""paddle.distributed.fleet (reference: python/paddle/distributed/fleet)."""
+from .base import fleet, init, DistributedStrategy, ParallelMode, \
+    get_hybrid_communicate_group, Fleet
+from .topology import CommunicateTopology, HybridCommunicateGroup
+from . import mp_layers
+from .mp_layers import VocabParallelEmbedding, ColumnParallelLinear, \
+    RowParallelLinear, ParallelCrossEntropy
+from . import meta_parallel
+from .hybrid_optimizer import HybridParallelOptimizer, \
+    HybridParallelGradScaler
+from .recompute import recompute, recompute_sequential
+from . import sequence_parallel_utils
+
+# top-level fleet API shape
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+worker_index = fleet.worker_index
+worker_num = fleet.worker_num
+is_first_worker = fleet.is_first_worker
+
+__all__ = ["fleet", "init", "DistributedStrategy", "ParallelMode",
+           "CommunicateTopology", "HybridCommunicateGroup",
+           "VocabParallelEmbedding", "ColumnParallelLinear",
+           "RowParallelLinear", "ParallelCrossEntropy", "meta_parallel",
+           "HybridParallelOptimizer", "HybridParallelGradScaler",
+           "recompute", "recompute_sequential", "distributed_model",
+           "distributed_optimizer", "get_hybrid_communicate_group"]

@@ -1,0 +1,128 @@
+"""Fleet basics (reference: python/paddle/distributed/fleet/fleet.py:218
+fleet.init, base/distributed_strategy.py, meta_parallel ParallelMode)."""
+from __future__ import annotations
+
+__all__ = ["ParallelMode", "DistributedStrategy", "Fleet", "fleet",
+           "init", "get_hybrid_communicate_group"]
+
+
+class ParallelMode:
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+class DistributedStrategy:
+    """reference: paddle/fluid/framework/distributed_strategy.proto:364 —
+    strategy toggles; the hybrid_configs dict carries parallel degrees."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+            "order": ["dp", "pp", "sharding", "sep", "mp"],
+            "mp_configs": {}, "pp_configs": {}, "sharding_configs": {},
+        }
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.localsgd = False
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+
+    def __repr__(self):
+        return f"DistributedStrategy(hybrid={self.hybrid_configs})"
+
+
+_hcg = None
+_fleet_initialized = False
+_strategy = None
+
+
+class Fleet:
+    """Singleton facade (reference fleet/fleet.py Fleet)."""
+
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             log_level="INFO"):
+        global _hcg, _fleet_initialized, _strategy
+        from .topology import HybridCommunicateGroup
+        strategy = strategy or DistributedStrategy()
+        _strategy = strategy
+        hc = strategy.hybrid_configs
+        _hcg = HybridCommunicateGroup(
+            dp_degree=hc.get("dp_degree", 1),
+            mp_degree=hc.get("mp_degree", 1),
+            pp_degree=hc.get("pp_degree", 1),
+            sharding_degree=hc.get("sharding_degree", 1),
+            sep_degree=hc.get("sep_degree", 1))
+        _fleet_initialized = True
+        from .. import env
+        env._initialized = True
+        return self
+
+    def is_first_worker(self):
+        from .. import env
+        return env.get_rank() == 0
+
+    def worker_index(self):
+        from .. import env
+        return env.get_rank()
+
+    def worker_num(self):
+        from .. import env
+        return env.get_world_size()
+
+    def get_hybrid_communicate_group(self):
+        return _hcg
+
+    @property
+    def strategy(self):
+        return _strategy
+
+    def distributed_model(self, model):
+        """Wrap per parallel mode (reference fleet/model.py:32)."""
+        from .meta_parallel import TensorParallel, PipelineParallel, \
+            ShardingParallel, SegmentParallel
+        from .meta_parallel.pp_layers import PipelineLayer
+        if _hcg is None:
+            return model
+        mode = _hcg.get_parallel_mode()
+        if mode == ParallelMode.PIPELINE_PARALLEL or \
+                isinstance(model, PipelineLayer):
+            return PipelineParallel(model, _hcg, _strategy)
+        if mode == ParallelMode.TENSOR_PARALLEL:
+            return TensorParallel(model, _hcg, _strategy)
+        if mode == ParallelMode.SHARDING_PARALLEL:
+            return ShardingParallel(model, _hcg, _strategy)
+        if mode == ParallelMode.SEGMENT_PARALLEL:
+            return SegmentParallel(model, _hcg, _strategy)
+        # pure DP: batch-sharded inputs under GSPMD need no wrapper
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        from .hybrid_optimizer import HybridParallelOptimizer
+        if _hcg is None:
+            return optimizer
+        return HybridParallelOptimizer(optimizer, _hcg,
+                                       strategy or _strategy)
+
+
+fleet = Fleet()
+init = fleet.init
+
+
+def get_hybrid_communicate_group():
+    return _hcg

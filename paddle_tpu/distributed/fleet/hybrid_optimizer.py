@@ -1,0 +1,81 @@
+"""HybridParallelOptimizer + grad scaler.
+
+Reference: fleet/meta_optimizers/dygraph_optimizer/
+hybrid_parallel_optimizer.py:266 — wraps the inner optimizer, fuses the
+DP/SEP gradient allreduce (:520) and makes grad clip topology-aware.  Under
+GSPMD the gradient reduction is emitted by XLA (replicated params +
+dp-sharded batch), and the global-norm clip already reduces over the full
+(global) arrays — so the wrapper's job collapses to API fidelity + making
+sure clipping happens before the inner step.
+"""
+from __future__ import annotations
+
+from ...autograd import no_grad
+
+__all__ = ["HybridParallelOptimizer", "HybridParallelGradScaler"]
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self.inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+
+    @property
+    def _parameter_list(self):
+        return self.inner_opt._parameter_list
+
+    @property
+    def _grad_clip(self):
+        return self.inner_opt._grad_clip
+
+    def get_lr(self):
+        return self.inner_opt.get_lr()
+
+    def set_lr(self, v):
+        self.inner_opt.set_lr(v)
+
+    @no_grad()
+    def step(self):
+        # grads of replicated params are already globally reduced (GSPMD);
+        # inner step applies clip + update
+        self.inner_opt.step()
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner_opt.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        return self.inner_opt.minimize(loss)
+
+    def state_dict(self):
+        return self.inner_opt.state_dict()
+
+    def set_state_dict(self, state):
+        return self.inner_opt.set_state_dict(state)
+
+    def opt_state(self):
+        return self.inner_opt.opt_state()
+
+    def load_opt_state(self, s):
+        return self.inner_opt.load_opt_state(s)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["inner_opt"], name)
+
+
+class HybridParallelGradScaler:
+    def __init__(self, scaler, hcg=None):
+        self._scaler = scaler
+        self._hcg = hcg
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_scaler"], name)
+
+    def scale(self, var):
+        return self._scaler.scale(var)
+
+    def minimize(self, optimizer, scaled_loss):
+        return self._scaler.minimize(optimizer, scaled_loss)

@@ -1,0 +1,8 @@
+from .parallel_wrappers import MetaParallelBase, TensorParallel, \
+    ShardingParallel, SegmentParallel, DataParallel
+from .pipeline_parallel import PipelineParallel
+from .pp_layers import PipelineLayer, LayerDesc, SharedLayerDesc
+
+__all__ = ["MetaParallelBase", "TensorParallel", "ShardingParallel",
+           "SegmentParallel", "DataParallel", "PipelineParallel",
+           "PipelineLayer", "LayerDesc", "SharedLayerDesc"]

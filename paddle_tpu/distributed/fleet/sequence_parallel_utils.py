@@ -1,0 +1,133 @@
+"""Sequence parallelism (Megatron-SP) utilities.
+
+Reference: fleet/utils/sequence_parallel_utils.py — ScatterOp/GatherOp/
+AllGatherOp/ReduceScatterOp PyLayers (:85-137) and
+ColumnSequenceParallelLinear (:429).  TPU-native: the scatter/gather pair is
+a pair of sharding annotations on the sequence dim over the mp axis; GSPMD
+turns the transitions into reduce-scatter / all-gather on ICI, including
+the reversed collectives in backward — identical comm volume to the
+reference's hand-placed ops.
+
+Layout contract: activations are [batch, seq, hidden] (batch-first,
+matching this framework's layers; the reference uses [s, b, h]).
+"""
+from __future__ import annotations
+
+from ...nn.layer import Layer
+from ...nn import functional as F
+from ..shard_ops import sharding_constraint
+from ..mesh import get_mesh
+
+__all__ = ["scatter", "all_gather", "identity_in_model_parallel",
+           "mark_as_sequence_parallel_parameter",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+           "GatherOp", "ScatterOp", "AllGatherOp", "ReduceScatterOp",
+           "register_sequence_parallel_allreduce_hooks"]
+
+
+def _axis():
+    m = get_mesh()
+    if m is not None and "mp" in m.dim_names:
+        return "mp"
+    return None
+
+
+def scatter(x, axis=None):
+    """Split the sequence dim across mp (reference ScatterOp)."""
+    a = axis or _axis()
+    if a is None:
+        return x
+    return sharding_constraint(x, (None, a) + (None,) * (x.ndim - 2))
+
+
+def all_gather(x, axis=None):
+    """Gather the sequence dim (reference GatherOp/AllGatherOp)."""
+    a = axis or _axis()
+    if a is None:
+        return x
+    return sharding_constraint(x, (None,) * x.ndim)
+
+
+class ScatterOp:
+    apply = staticmethod(scatter)
+
+
+class GatherOp:
+    apply = staticmethod(all_gather)
+
+
+class AllGatherOp:
+    apply = staticmethod(all_gather)
+
+
+class ReduceScatterOp:
+    apply = staticmethod(scatter)
+
+
+def identity_in_model_parallel(x):
+    return x
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    parameter.sequence_parallel = True
+
+
+def register_sequence_parallel_allreduce_hooks(model, *a, **k):
+    """Grad sync for SP params is emitted by GSPMD — kept for API parity."""
+    return model
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """reference :429 — input arrives sequence-sharded, all-gather then
+    column-parallel matmul (annotation-driven here)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        from .mp_layers import _shard_param
+        self._axis = _axis()
+        self.weight = self.create_parameter([in_features, out_features],
+                                            attr=weight_attr)
+        _shard_param(self.weight, 1, self._axis)
+        self.bias = None if has_bias is False else self.create_parameter(
+            [out_features], is_bias=True)
+        if self.bias is not None:
+            _shard_param(self.bias, 0, self._axis)
+        self.gather_output = gather_output
+
+    def forward(self, x):
+        # sequence-sharded in → gather seq, shard hidden out
+        x = all_gather(x)
+        out = F.linear(x, self.weight, self.bias)
+        if self._axis is not None and not self.gather_output:
+            out = sharding_constraint(
+                out, (None,) * (out.ndim - 1) + (self._axis,))
+        return out
+
+
+class RowSequenceParallelLinear(Layer):
+    """Row-parallel matmul whose output reduce-scatters over the sequence
+    dim (reference RowSequenceParallelLinear)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        from .mp_layers import _shard_param
+        self._axis = _axis()
+        self.weight = self.create_parameter([in_features, out_features],
+                                            attr=weight_attr)
+        _shard_param(self.weight, 0, self._axis)
+        self.bias = self.create_parameter([out_features], is_bias=True) \
+            if has_bias else None
+
+    def forward(self, x):
+        if self._axis is not None:
+            x = sharding_constraint(
+                x, (None,) * (x.ndim - 1) + (self._axis,))
+        out = F.linear(x, self.weight, None)
+        out = scatter(out)  # reduce-scatter over sequence
+        if self.bias is not None:
+            out = out + self.bias
+        return out

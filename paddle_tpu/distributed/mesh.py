@@ -1,0 +1,125 @@
+"""Process meshes.
+
+Reference: phi::distributed::ProcessMesh
+(paddle/phi/core/distributed/auto_parallel/process_mesh.h:34) + python
+dist.ProcessMesh.  On TPU a ProcessMesh is a thin wrapper over
+jax.sharding.Mesh: dim names are mesh axis names, and every sharding /
+collective below rides XLA's GSPMD over ICI/DCN.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["ProcessMesh", "get_mesh", "set_mesh", "auto_mesh",
+           "init_device_mesh"]
+
+_global_mesh: "ProcessMesh | None" = None
+
+
+class ProcessMesh:
+    """N-d logical view over the device set (dim_names ↔ mesh axes)."""
+
+    def __init__(self, mesh, dim_names=None, process_ids=None):
+        if isinstance(mesh, Mesh):
+            self._jax_mesh = mesh
+            self._shape = tuple(mesh.devices.shape)
+            self._dim_names = list(mesh.axis_names)
+            return
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self._dim_names = list(dim_names)
+        self._shape = tuple(arr.shape)
+        devices = np.asarray(jax.devices())
+        flat = arr.reshape(-1)
+        dev_grid = devices[flat].reshape(arr.shape)
+        self._jax_mesh = Mesh(dev_grid, tuple(self._dim_names))
+
+    @property
+    def jax_mesh(self) -> Mesh:
+        return self._jax_mesh
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return [d.id for d in self._jax_mesh.devices.reshape(-1)]
+
+    @property
+    def mesh(self):
+        return np.asarray(
+            [d.id for d in self._jax_mesh.devices.reshape(-1)]).reshape(
+                self._shape)
+
+    def get_dim_size(self, name):
+        return self._shape[self._dim_names.index(name)]
+
+    def get_rank_by_dim_and_process_id(self, dim, process_id):
+        axis = self._dim_names.index(dim) if isinstance(dim, str) else dim
+        coords = np.argwhere(self.mesh == process_id)
+        return int(coords[0][axis]) if len(coords) else -1
+
+    def __eq__(self, other):
+        return isinstance(other, ProcessMesh) and \
+            self._shape == other._shape and \
+            self._dim_names == other._dim_names
+
+    def __hash__(self):
+        return hash((self._shape, tuple(self._dim_names)))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self._dim_names})"
+
+    def __enter__(self):
+        self._prev = _global_mesh
+        set_mesh(self)
+        return self
+
+    def __exit__(self, *exc):
+        set_mesh(self._prev)
+        return False
+
+
+def set_mesh(mesh):
+    global _global_mesh
+    if isinstance(mesh, Mesh):
+        mesh = ProcessMesh(mesh)
+    _global_mesh = mesh
+
+
+def get_mesh() -> "ProcessMesh | None":
+    return _global_mesh
+
+
+def auto_mesh(**axis_sizes) -> ProcessMesh:
+    """Build a mesh over all devices: auto_mesh(dp=2, mp=4)."""
+    names = list(axis_sizes.keys())
+    sizes = list(axis_sizes.values())
+    n = len(jax.devices())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = n // known
+    devs = np.asarray(jax.devices()[:int(np.prod(sizes))]).reshape(sizes)
+    m = ProcessMesh(Mesh(devs, tuple(names)))
+    set_mesh(m)
+    return m
+
+
+def init_device_mesh(device_type=None, mesh_shape=(), mesh_dim_names=None):
+    """torch/paddle-shaped mesh constructor."""
+    sizes = dict(zip(mesh_dim_names or
+                     [f"d{i}" for i in range(len(mesh_shape))], mesh_shape))
+    return auto_mesh(**sizes)

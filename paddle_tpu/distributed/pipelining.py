@@ -1,0 +1,81 @@
+"""SPMD pipeline parallelism.
+
+Reference: fleet/meta_parallel/pipeline_parallel.py (1F1B:575, train_batch:
+820) + p2p_communication.py — rank-to-rank isend/irecv of activations driven
+by a host-side schedule.  XLA has no native PP (SURVEY §7 hard part (a)), so
+the TPU-native formulation is: stage weights live stacked along a leading
+dim sharded over the 'pp' mesh axis; one `lax.scan` over
+(microbatches + stages - 1) ticks runs inside `shard_map`; activations move
+stage-to-stage with `lax.ppermute` over ICI.  Differentiating through the
+scan yields the reverse (backward) pipeline automatically — the 1F1B
+interleave is then XLA's latency hiding rather than a hand-written
+schedule; `jax.checkpoint` on the stage body gives the usual
+activation-memory profile.
+
+Constraints: pipelined stages must be shape-homogeneous (e.g. transformer
+blocks); embedding/head run replicated outside the pipelined region — the
+standard TPU pipelining recipe.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["spmd_pipeline"]
+
+
+def _stage_spec(leaf):
+    return P("pp", *([None] * (leaf.ndim - 1)))
+
+
+def spmd_pipeline(stage_fn: Callable, stacked_params, microbatches, mesh,
+                  axis_name: str = "pp", remat: bool = True):
+    """Run `stage_fn(params, x) -> x` as a pipeline over `axis_name`.
+
+    stacked_params: pytree with leading dim = n_stages on every leaf
+    microbatches:  [M, mb, ...] array (replicated over pp)
+    returns:       [M, mb, ...] outputs of the final stage (replicated)
+    """
+    n_stages = mesh.shape[axis_name]
+    body = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def per_device(params, mbs):
+        params = jax.tree_util.tree_map(lambda a: a[0], params)  # my stage
+        stage = jax.lax.axis_index(axis_name)
+        m = mbs.shape[0]
+        total = m + n_stages - 1
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(carry, t):
+            state, outs = carry
+            # stage 0 ingests microbatch t (clamped; masked later)
+            inj = mbs[jnp.minimum(t, m - 1)]
+            state = jnp.where(stage == 0, inj, state)
+            state = body(params, state)
+            out_idx = t - (n_stages - 1)
+            is_out = jnp.logical_and(stage == n_stages - 1,
+                                     jnp.logical_and(out_idx >= 0,
+                                                     out_idx < m))
+            idx = jnp.clip(out_idx, 0, m - 1)
+            outs = outs.at[idx].set(jnp.where(is_out, state, outs[idx]))
+            state = jax.lax.ppermute(state, axis_name, perm)
+            return (state, outs), None
+
+        state0 = jnp.zeros_like(mbs[0])
+        outs0 = jnp.zeros_like(mbs)
+        (state, outs), _ = jax.lax.scan(tick, (state0, outs0),
+                                        jnp.arange(total))
+        # non-final stages hold zeros; psum replicates final-stage outputs
+        outs = jax.lax.psum(outs, axis_name)
+        return outs
+
+    spec_params = jax.tree_util.tree_map(_stage_spec, stacked_params)
+
+    fn = shard_map(per_device, mesh=mesh,
+                   in_specs=(spec_params, P()),
+                   out_specs=P(), check_vma=False)
+    return fn(stacked_params, microbatches)

@@ -1,0 +1,102 @@
+"""Placements (reference: paddle/phi/core/distributed/auto_parallel/
+placement_types.h — Shard/Replicate/Partial) and their mapping to
+jax PartitionSpec entries."""
+from __future__ import annotations
+
+from jax.sharding import NamedSharding, PartitionSpec
+
+__all__ = ["Placement", "Shard", "Replicate", "Partial",
+           "placements_to_spec", "spec_to_placements"]
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    def __init__(self, dim):
+        self.dim = int(dim)
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, o):
+        return isinstance(o, Shard) and o.dim == self.dim
+
+    def __hash__(self):
+        return hash(("Shard", self.dim))
+
+
+class Replicate(Placement):
+    def is_replicated(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, o):
+        return isinstance(o, Replicate)
+
+    def __hash__(self):
+        return hash("Replicate")
+
+
+class Partial(Placement):
+    """Pending-reduction state (reference partial placement; GSPMD analog:
+    values awaiting psum — representable only inside shard_map, so at the
+    API level resharding from Partial triggers the reduction)."""
+
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+    def __eq__(self, o):
+        return isinstance(o, Partial) and o.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("Partial", self.reduce_type))
+
+
+def placements_to_spec(mesh, placements, ndim):
+    """[Shard(0), Replicate()] + mesh dims -> PartitionSpec rows."""
+    entries: list = [None] * ndim
+    for axis_idx, p in enumerate(placements):
+        if isinstance(p, Shard):
+            name = mesh.dim_names[axis_idx]
+            cur = entries[p.dim]
+            if cur is None:
+                entries[p.dim] = name
+            elif isinstance(cur, tuple):
+                entries[p.dim] = cur + (name,)
+            else:
+                entries[p.dim] = (cur, name)
+    return PartitionSpec(*entries)
+
+
+def spec_to_placements(mesh, spec, ndim):
+    placements = [Replicate() for _ in mesh.dim_names]
+    for tensor_dim, entry in enumerate(spec):
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        for n in names:
+            placements[mesh.dim_names.index(n)] = Shard(tensor_dim)
+    return placements

@@ -1,0 +1,34 @@
+"""Sharding-annotation ops, tape-differentiable.
+
+The TPU replacement for the reference's identity/allreduce PyLayers
+(fleet/layers/mpu/mp_ops.py): instead of inserting explicit collectives,
+layers annotate the sharding they want and GSPMD inserts the collective in
+both forward and backward.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..ops.registry import op
+from .mesh import get_mesh
+
+__all__ = ["sharding_constraint", "annotate"]
+
+
+@op
+def sharding_constraint(x, spec_entries, mesh=None):
+    """Constrain x's sharding to PartitionSpec(*spec_entries) on the mesh.
+
+    spec_entries: tuple like (None, 'mp') — hashable/static.
+    """
+    m = mesh or (get_mesh().jax_mesh if get_mesh() is not None else None)
+    if m is None:
+        return x
+    spec = PartitionSpec(*spec_entries)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(m, spec))
+
+
+def annotate(tensor, *entries):
+    """Convenience: annotate(t, None, 'mp')."""
+    return sharding_constraint(tensor, tuple(entries))
