@@ -1,11 +1,21 @@
-"""Test env: force an 8-device virtual CPU mesh before jax initializes
+"""Test env: force an 8-device virtual CPU mesh before jax backends initialize
 (SURVEY §4: distributed-vs-single-card equivalence runs on one host).
-JAX_PLATFORMS is force-overridden: the container default is the axon TPU
-backend, but unit tests must run on host CPU devices."""
+
+The container's sitecustomize registers the axon TPU PJRT plugin and calls
+``jax.config.update("jax_platforms", "axon,cpu")`` at interpreter start, which
+takes precedence over the ``JAX_PLATFORMS`` env var.  Unit tests must run on
+host CPU devices (deterministic f32 matmuls, 8 virtual devices, no tunnel
+latency), so we override the *config* value here — conftest runs before any
+test imports jax and before backends are instantiated.
+"""
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
