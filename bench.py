@@ -1,0 +1,97 @@
+"""Benchmark: flagship Llama pretraining step throughput + MFU on the
+available chip(s).  Prints ONE JSON line.
+
+vs_baseline: the reference publishes no absolute numbers (BASELINE.md);
+the driver's north star is >=40% MFU, so vs_baseline = measured_MFU / 0.40.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# bf16 peak TFLOP/s per chip by device kind (public figures)
+PEAK_TFLOPS = {
+    "TPU v5p": 459.0, "TPU v5 lite": 197.0, "TPU v5e": 197.0,
+    "TPU v6 lite": 918.0, "TPU v6e": 918.0, "TPU v4": 275.0,
+    "TPU v3": 123.0, "TPU v2": 45.0,
+}
+
+
+def _peak_flops(kind: str) -> float:
+    for k, v in PEAK_TFLOPS.items():
+        if kind.lower().startswith(k.lower()):
+            return v * 1e12
+    return 197e12  # unknown chip: assume v5e-class
+
+
+def main():
+    from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.models import llama_hybrid as H
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    n = len(jax.devices()) if on_tpu else 1
+
+    if on_tpu:
+        # sized for one v5e chip (~16G HBM): ~0.3B params, AdamW fp32 state
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=16, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=2048,
+            dtype="bfloat16")
+        batch, seq, steps = 8, 2048, 10
+        dtype = jnp.bfloat16
+    else:  # CPU smoke mode so the bench is runnable anywhere
+        cfg = LlamaConfig(
+            vocab_size=1024, hidden_size=256, intermediate_size=512,
+            num_hidden_layers=4, num_attention_heads=8,
+            num_key_value_heads=8, max_position_embeddings=512)
+        batch, seq, steps = 4, 256, 3
+        dtype = jnp.float32
+
+    pp, dp, tp = (1, n, 1) if n > 1 else (1, 1, 1)
+    mesh = H.build_mesh(n, pp=pp, dp=dp, tp=tp)
+    params, opt = H.setup(cfg, mesh, dtype=dtype)
+    step = H.build_train_step(cfg, mesh, n_micro=1, remat=on_tpu, sp=False)
+
+    ids = jax.device_put(
+        np.random.randint(0, cfg.vocab_size, (batch, seq + 1)).astype(
+            np.int64),
+        jax.sharding.NamedSharding(mesh,
+                                   jax.sharding.PartitionSpec("dp", None)))
+
+    loss, params, opt = step(params, opt, ids)  # compile + warmup
+    loss.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, params, opt = step(params, opt, ids)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    # 6*N_params FLOPs/token (fwd+bwd) + attention term
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    attn_flops = 12 * cfg.num_hidden_layers * cfg.hidden_size * seq
+    flops_per_token = 6 * n_params + attn_flops
+    mfu = tokens_per_sec * flops_per_token / (n * _peak_flops(
+        dev.device_kind if on_tpu else "cpu"))
+    if not on_tpu:
+        mfu = 0.0
+
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec / n, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.40, 4) if on_tpu else 0.0,
+        "detail": {"mfu": round(mfu, 4), "chips": n,
+                   "device": dev.device_kind, "params": int(n_params),
+                   "loss": float(loss)},
+    }))
+
+
+if __name__ == "__main__":
+    main()

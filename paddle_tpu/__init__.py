@@ -95,6 +95,8 @@ from .device import set_device, get_device, CPUPlace, CUDAPlace, XPUPlace, \
     TPUPlace  # noqa: F401
 from . import flags as _flags_mod
 from .flags import set_flags, get_flags  # noqa: F401
+from . import vision  # noqa: F401
+from . import models  # noqa: F401
 
 __version__ = "0.1.0"
 

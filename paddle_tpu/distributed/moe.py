@@ -1,0 +1,122 @@
+"""Mixture-of-Experts with expert parallelism, TPU-native.
+
+Reference: incubate/distributed/models/moe/moe_layer.py (MoELayer :119,
+global_scatter/global_gather alltoall dispatch :263; ops
+paddle/fluid/operators/collective/global_scatter_op.cc) and the gating
+kernels number_count / limit_by_capacity / prune_gate_by_capacity
+(paddle/phi/kernels/gpu/).
+
+TPU formulation (GShard/Switch): gating produces a *dense* dispatch tensor
+with a static capacity — data-dependent token routing becomes two einsums,
+which XLA partitions into all-to-alls over the 'ep' mesh axis when expert
+tensors are sharded on their leading (expert) dim.  No dynamic shapes under
+jit (SURVEY §7 hard part (c)).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["top_k_gating", "moe_dispatch_combine", "number_count",
+           "limit_by_capacity", "prune_gate_by_capacity"]
+
+
+# -------------------------------------------------- reference gating utils
+def number_count(gate_idx, upper_range):
+    """Tokens per expert (reference number_count_kernel)."""
+    return jnp.sum(jax.nn.one_hot(gate_idx, upper_range, dtype=jnp.int32),
+                   axis=tuple(range(gate_idx.ndim)))
+
+
+def limit_by_capacity(expert_count, capacity, n_worker=1):
+    """Clamp per-expert token counts (reference limit_by_capacity_kernel)."""
+    return jnp.minimum(expert_count, capacity * n_worker)
+
+
+def prune_gate_by_capacity(gate_idx, expert_count, capacity):
+    """Mark overflow tokens' gate index as -1 (reference
+    prune_gate_by_capacity_kernel)."""
+    onehot = jax.nn.one_hot(gate_idx, expert_count.shape[-1],
+                            dtype=jnp.int32)
+    pos = jnp.cumsum(onehot, axis=0) * onehot  # 1-based position per expert
+    my_pos = jnp.sum(pos, axis=-1)
+    return jnp.where(my_pos <= capacity, gate_idx, -1)
+
+
+# ------------------------------------------------------------- GShard core
+def top_k_gating(logits, top_k=2, capacity_factor=1.25, capacity=None,
+                 train=True, noise_key=None):
+    """logits: [S, E] -> (combine [S, E, C] f32, dispatch [S, E, C] bool,
+    aux_loss scalar).  Static capacity C."""
+    s, e = logits.shape
+    if capacity is None:
+        capacity = max(4, int(math.ceil(s * top_k * capacity_factor / e)))
+    if train and noise_key is not None:
+        logits = logits + jax.random.gumbel(noise_key, logits.shape,
+                                            logits.dtype) * 1e-2
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    combine = jnp.zeros((s, e, capacity), jnp.float32)
+    dispatch = jnp.zeros((s, e, capacity), bool)
+    masked = probs
+    # position_in_expert accumulates across the k selection rounds
+    fill = jnp.zeros((e,), jnp.int32)
+    aux = 0.0
+    for _ in range(top_k):
+        idx = jnp.argmax(masked, axis=-1)                     # [S]
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)     # [S, E]
+        # Switch load-balancing loss: E * sum_e(frac_tokens_e * mean_prob_e)
+        frac = jnp.mean(onehot, axis=0)                        # [E]
+        mean_p = jnp.mean(probs, axis=0)                       # [E]
+        aux = aux + e * jnp.sum(frac * mean_p)
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot      # 0-based
+        pos = pos + fill[None, :] * onehot
+        in_cap = (pos < capacity) & (onehot > 0)
+        posc = jnp.clip(pos.astype(jnp.int32), 0, capacity - 1)
+        sel = jax.nn.one_hot(posc, capacity, dtype=jnp.float32) \
+            * in_cap[..., None]
+        gate_val = jnp.sum(probs * onehot, axis=-1, keepdims=True)
+        combine = combine + sel * gate_val[..., None]
+        dispatch = dispatch | (sel > 0)
+        fill = fill + jnp.sum(onehot * in_cap, axis=0).astype(jnp.int32)
+        masked = masked * (1.0 - onehot)
+    return combine, dispatch, aux / top_k
+
+
+def moe_dispatch_combine(x, gate_w, w1, b1, w2, b2, *, top_k=2,
+                         capacity_factor=1.25, activation=jax.nn.gelu,
+                         mesh=None, ep_axis="ep", train=True,
+                         noise_key=None):
+    """Full MoE FFN over flat tokens.
+
+    x: [S, M]; gate_w: [M, E]; w1: [E, M, F]; b1: [E, F]; w2: [E, F, M];
+    b2: [E, M].  Returns (y [S, M], aux_loss).
+
+    With `mesh` given and `ep_axis` in it, expert-stacked tensors get
+    Shard(0) constraints over ep: XLA lowers the dispatch einsum to the
+    all-to-all the reference codes as global_scatter/global_gather.
+    """
+    logits = x @ gate_w.astype(x.dtype)
+    combine, dispatch, aux = top_k_gating(
+        logits, top_k=top_k, capacity_factor=capacity_factor, train=train,
+        noise_key=noise_key)
+    combine = combine.astype(x.dtype)
+    # dispatch: [S, E, C] x [S, M] -> [E, C, M]  (the global_scatter);
+    # boolean mask — gate scaling happens only on the combine side
+    expert_in = jnp.einsum("sec,sm->ecm", dispatch.astype(x.dtype), x)
+    if mesh is not None and ep_axis in mesh.axis_names:
+        shard_e = NamedSharding(mesh, P(ep_axis, None, None))
+        expert_in = jax.lax.with_sharding_constraint(expert_in, shard_e)
+    h = activation(jnp.einsum("ecm,emf->ecf", expert_in, w1)
+                   + b1[:, None, :])
+    expert_out = jnp.einsum("ecf,efm->ecm", h, w2) + b2[:, None, :]
+    if mesh is not None and ep_axis in mesh.axis_names:
+        expert_out = jax.lax.with_sharding_constraint(
+            expert_out, NamedSharding(mesh, P(ep_axis, None, None)))
+    # combine back: the global_gather
+    y = jnp.einsum("sec,ecm->sm", combine, expert_out)
+    return y, aux.astype(jnp.float32)

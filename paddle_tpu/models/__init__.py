@@ -1,0 +1,12 @@
+"""Model zoo: flagship language models built on paddle_tpu.nn.
+
+Reference analog: the in-tree Llama test model
+(test/auto_parallel/hybrid_strategy/semi_auto_parallel_llama_model.py) plus
+the PaddleNLP model families the reference framework exists to serve.
+"""
+from .llama import (  # noqa: F401
+    LlamaConfig, LlamaRMSNorm, LlamaAttention, LlamaMLP, LlamaDecoderLayer,
+    LlamaModel, LlamaForCausalLM, LlamaPretrainingCriterion,
+    llama_tp_shard_fn)
+from .gpt import GPTConfig, GPTModel, GPTForCausalLM  # noqa: F401
+from .bert import BertConfig, BertModel, BertForSequenceClassification  # noqa: F401

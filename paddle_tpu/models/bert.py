@@ -1,0 +1,114 @@
+"""BERT encoder (reference analog: the BERT fine-tune rung of the
+benchmark ladder, BASELINE.md #3; built on paddle.nn.TransformerEncoder
+semantics — python/paddle/nn/layer/transformer.py).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .. import nn
+from ..nn import functional as F
+from .llama import flash_attention
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    num_labels: int = 2
+    dtype: str = "float32"
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, c: BertConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(c.vocab_size, c.hidden_size)
+        self.position_embeddings = nn.Embedding(c.max_position_embeddings,
+                                                c.hidden_size)
+        self.token_type_embeddings = nn.Embedding(c.type_vocab_size,
+                                                  c.hidden_size)
+        self.layer_norm = nn.LayerNorm(c.hidden_size, epsilon=c.layer_norm_eps)
+
+    def forward(self, input_ids, token_type_ids=None):
+        import paddle_tpu as P
+        s = input_ids.shape[1]
+        pos = P.arange(s, dtype="int64").unsqueeze(0)
+        e = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            e = e + self.token_type_embeddings(token_type_ids)
+        return self.layer_norm(e)
+
+
+class BertSelfAttention(nn.Layer):
+    def __init__(self, c: BertConfig):
+        super().__init__()
+        h = c.hidden_size
+        self.num_heads = c.num_attention_heads
+        self.head_dim = c.head_dim
+        self.query = nn.Linear(h, h)
+        self.key = nn.Linear(h, h)
+        self.value = nn.Linear(h, h)
+        self.dense = nn.Linear(h, h)
+        self.layer_norm = nn.LayerNorm(h, epsilon=c.layer_norm_eps)
+
+    def forward(self, x, attn_mask=None):
+        b, s, h = x.shape
+        shp = [b, s, self.num_heads, self.head_dim]
+        q = self.query(x).reshape(shp)
+        k = self.key(x).reshape(shp)
+        v = self.value(x).reshape(shp)
+        out = flash_attention(q, k, v, attn_mask=attn_mask)
+        out = self.dense(out.reshape([b, s, h]))
+        return self.layer_norm(x + out)
+
+
+class BertLayer(nn.Layer):
+    def __init__(self, c: BertConfig):
+        super().__init__()
+        self.attention = BertSelfAttention(c)
+        self.intermediate = nn.Linear(c.hidden_size, c.intermediate_size)
+        self.output = nn.Linear(c.intermediate_size, c.hidden_size)
+        self.layer_norm = nn.LayerNorm(c.hidden_size, epsilon=c.layer_norm_eps)
+
+    def forward(self, x, attn_mask=None):
+        x = self.attention(x, attn_mask=attn_mask)
+        y = self.output(F.gelu(self.intermediate(x)))
+        return self.layer_norm(x + y)
+
+
+class BertModel(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.encoder = nn.LayerList(
+            [BertLayer(config) for _ in range(config.num_hidden_layers)])
+        self.pooler = nn.Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attn_mask=None):
+        x = self.embeddings(input_ids, token_type_ids)
+        for layer in self.encoder:
+            x = layer(x, attn_mask=attn_mask)
+        pooled = F.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class BertForSequenceClassification(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.classifier = nn.Linear(config.hidden_size, config.num_labels)
+
+    def forward(self, input_ids, token_type_ids=None, attn_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attn_mask)
+        return self.classifier(pooled)

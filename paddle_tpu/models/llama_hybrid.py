@@ -1,0 +1,277 @@
+"""Hybrid-parallel (pp × dp × tp + sp) Llama pretraining step, TPU-native.
+
+Reference analog: the fleet hybrid-parallel stack —
+fleet/meta_parallel/pipeline_parallel.py (1F1B :575, train_batch :820),
+fleet/layers/mpu/mp_layers.py (Column/RowParallelLinear :336,:543),
+fleet/utils/sequence_parallel_utils.py, hybrid_parallel_optimizer.py :266.
+
+TPU formulation (SURVEY.md §7-§8): one jitted SPMD program over a
+('pp','dp','tp') mesh.
+  * tp  — GSPMD weight shardings (colwise Shard(-1) on q/k/v/gate/up,
+          rowwise on o/down); XLA inserts the mp allreduces the reference
+          codes by hand in mp_ops.py.
+  * dp  — batch dim sharded; grad allreduce is XLA's psum, replacing the
+          bucketed Reducer (fluid/distributed/collective/reducer.cc).
+  * sp  — Megatron-SP: activations outside attention carry a
+          sequence-dim sharding constraint over the tp axis, replacing the
+          scatter/allgather PyLayers in sequence_parallel_utils.py.
+  * pp  — stage-stacked weights sharded over 'pp'; a lax.scan over
+          (microbatches + stages - 1) ticks inside a shard_map that is
+          manual over 'pp' only; activations hop stages via ppermute on
+          ICI.  jax.grad through the scan IS the backward pipeline —
+          replacing the hand-written 1F1B schedule + p2p_communication.py.
+  * remat — jax.checkpoint on the per-layer body (reference:
+          fleet/recompute/recompute.py).
+
+Everything below is pure functional jax: params/opt-state pytrees, one
+donated train step.  This is the flagship path bench.py measures.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .llama import LlamaConfig, _rope_tables
+from ..ops.pallas.flash_attention import sdpa
+
+
+# ----------------------------------------------------------------- mesh
+def build_mesh(n_devices=None, pp=1, dp=1, tp=1, devices=None):
+    """('pp','dp','tp') mesh. Axis sizes must multiply to n_devices."""
+    devices = devices if devices is not None else jax.devices()
+    n = n_devices or len(devices)
+    assert pp * dp * tp == n, (pp, dp, tp, n)
+    grid = np.asarray(devices[:n]).reshape(pp, dp, tp)
+    return Mesh(grid, ("pp", "dp", "tp"))
+
+
+def default_axes(n):
+    """Factorize n into (pp, dp, tp) exercising every axis when possible."""
+    tp = 2 if n % 2 == 0 else 1
+    rem = n // tp
+    pp = 2 if rem % 2 == 0 else 1
+    dp = rem // pp
+    return pp, dp, tp
+
+
+# ------------------------------------------------------------ parameters
+def init_params(config: LlamaConfig, n_pp: int, key, dtype=jnp.float32):
+    """Params pytree. Decoder leaves are stage-stacked:
+    [n_pp, layers_per_stage, ...]."""
+    assert config.num_hidden_layers % n_pp == 0
+    lps = config.num_hidden_layers // n_pp
+    h, i = config.hidden_size, config.intermediate_size
+    hd, nh, kvh = config.head_dim, config.num_attention_heads, \
+        config.num_key_value_heads
+    ks = jax.random.split(key, 9)
+
+    def w(k, *shape, fan_in):
+        std = 1.0 / math.sqrt(fan_in)
+        return (jax.random.normal(k, (n_pp, lps) + shape, jnp.float32)
+                * std).astype(dtype)
+
+    layer = {
+        "input_ln": jnp.ones((n_pp, lps, h), dtype),
+        "q": w(ks[0], h, nh * hd, fan_in=h),
+        "k": w(ks[1], h, kvh * hd, fan_in=h),
+        "v": w(ks[2], h, kvh * hd, fan_in=h),
+        "o": w(ks[3], nh * hd, h, fan_in=nh * hd),
+        "post_ln": jnp.ones((n_pp, lps, h), dtype),
+        "gate": w(ks[4], h, i, fan_in=h),
+        "up": w(ks[5], h, i, fan_in=h),
+        "down": w(ks[6], i, h, fan_in=i),
+    }
+    emb = (jax.random.normal(ks[7], (config.vocab_size, h), jnp.float32)
+           * 0.02).astype(dtype)
+    head = (jax.random.normal(ks[8], (h, config.vocab_size), jnp.float32)
+            / math.sqrt(h)).astype(dtype)
+    return {"embed": emb, "stages": layer,
+            "norm": jnp.ones((h,), dtype), "head": head}
+
+
+def param_shardings(mesh: Mesh):
+    """NamedShardings implementing the reference TP plan + pp stacking."""
+    s = functools.partial(NamedSharding, mesh)
+    col = s(P("pp", None, None, "tp"))   # [pp, lps, in, out] col-parallel
+    row = s(P("pp", None, "tp", None))   # row-parallel
+    ln = s(P("pp", None, None))
+    return {
+        "embed": s(P(None, "tp")),
+        "stages": {"input_ln": ln, "q": col, "k": col, "v": col, "o": row,
+                   "post_ln": ln, "gate": col, "up": col, "down": row},
+        "norm": s(P(None)),
+        "head": s(P(None, "tp")),
+    }
+
+
+# ------------------------------------------------------------- layer math
+def _rms(x, w, eps):
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def _decoder_layer(lp, x, cos, sin, config: LlamaConfig):
+    """One decoder layer, functional. x: [mb, S, H]."""
+    nh, kvh, hd = (config.num_attention_heads, config.num_key_value_heads,
+                   config.head_dim)
+    b, sq, _ = x.shape
+    r = x
+    h = _rms(x, lp["input_ln"], config.rms_norm_eps)
+    q = (h @ lp["q"]).reshape(b, sq, nh, hd)
+    k = (h @ lp["k"]).reshape(b, sq, kvh, hd)
+    v = (h @ lp["v"]).reshape(b, sq, kvh, hd)
+    cosd, sind = cos[None, :, None, :].astype(q.dtype), \
+        sin[None, :, None, :].astype(q.dtype)
+
+    def rot(t):
+        half = t.shape[-1] // 2
+        return jnp.concatenate([-t[..., half:], t[..., :half]], axis=-1)
+    q, k = q * cosd + rot(q) * sind, k * cosd + rot(k) * sind
+    a = sdpa(q, k, v, is_causal=True)
+    x = r + (a.reshape(b, sq, nh * hd) @ lp["o"])
+    r = x
+    h = _rms(x, lp["post_ln"], config.rms_norm_eps)
+    ff = jax.nn.silu(h @ lp["gate"]) * (h @ lp["up"])
+    return r + ff @ lp["down"]
+
+
+def _stage_fn(stage_params, x, cos, sin, config, remat=True):
+    """Apply this stage's layers_per_stage layers (leaves [lps, ...])."""
+    body = functools.partial(_decoder_layer, cos=cos, sin=sin, config=config)
+    if remat:
+        body = jax.checkpoint(body)
+
+    def scan_body(h, lp):
+        return body(lp, h), None
+    out, _ = jax.lax.scan(scan_body, x, stage_params)
+    return out
+
+
+# --------------------------------------------------------------- pipeline
+def pipelined_trunk(stacked, mbs, cos, sin, config, mesh, remat=True):
+    """mbs: [M, mb, S, H] -> outputs of final stage, same shape.
+    Manual over 'pp' only; dp/tp/sp stay under GSPMD inside."""
+    n_pp = mesh.shape["pp"]
+    if n_pp == 1:
+        squeeze = jax.tree_util.tree_map(lambda a: a[0], stacked)
+        return jax.vmap(
+            lambda mb: _stage_fn(squeeze, mb, cos, sin, config, remat))(mbs)
+
+    def per_device(stk, mbs):
+        lp = jax.tree_util.tree_map(lambda a: a[0], stk)  # my stage
+        stage = jax.lax.axis_index("pp")
+        m = mbs.shape[0]
+        total = m + n_pp - 1
+        perm = [(i, (i + 1) % n_pp) for i in range(n_pp)]
+
+        def tick(carry, t):
+            state, outs = carry
+            inj = mbs[jnp.minimum(t, m - 1)]
+            state = jnp.where(stage == 0, inj, state)
+            state = _stage_fn(lp, state, cos, sin, config, remat)
+            oi = t - (n_pp - 1)
+            ok = jnp.logical_and(stage == n_pp - 1,
+                                 jnp.logical_and(oi >= 0, oi < m))
+            idx = jnp.clip(oi, 0, m - 1)
+            outs = outs.at[idx].set(jnp.where(ok, state, outs[idx]))
+            state = jax.lax.ppermute(state, "pp", perm)
+            return (state, outs), None
+
+        init = (jnp.zeros_like(mbs[0]), jnp.zeros_like(mbs))
+        (_, outs), _ = jax.lax.scan(tick, init, jnp.arange(total))
+        return jax.lax.psum(outs, "pp")
+
+    return jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P("pp"), stacked), P()),
+        out_specs=P(), axis_names=frozenset({"pp"}),
+        check_vma=False)(stacked, mbs)
+
+
+# ------------------------------------------------------------- train step
+def loss_fn(params, ids, config: LlamaConfig, mesh: Mesh, n_micro=1,
+            remat=True, sp=True):
+    """Next-token CE over a [B, S+1] token batch."""
+    inp, lab = ids[:, :-1], ids[:, 1:]
+    b, s = inp.shape
+    x = jnp.take(params["embed"], inp, axis=0)
+    if sp and mesh.shape["tp"] > 1 and s % mesh.shape["tp"] == 0:
+        # Megatron-SP: sequence dim sharded over tp outside attention
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P("dp", "tp", None)))
+    cos, sin = _rope_tables(s, config.head_dim, config.rope_theta)
+    mb = b // n_micro
+    mbs = x.reshape(n_micro, mb, s, x.shape[-1])
+    out = pipelined_trunk(params["stages"], mbs, cos, sin, config, mesh,
+                          remat)
+    h = out.reshape(b, s, -1)
+    h = _rms(h, params["norm"], config.rms_norm_eps)
+    logits = (h @ params["head"]).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, lab[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+class AdamWState(NamedTuple):
+    step: jax.Array
+    m: dict
+    v: dict
+
+
+def init_adamw(params):
+    z = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    return AdamWState(jnp.zeros((), jnp.int32), z,
+                      jax.tree_util.tree_map(jnp.copy, z))
+
+
+def build_train_step(config: LlamaConfig, mesh: Mesh, lr=3e-4, wd=0.01,
+                     n_micro=1, remat=True, sp=True, b1=0.9, b2=0.95,
+                     eps=1e-8):
+    """Returns jitted (params, opt, ids) -> (loss, params, opt)."""
+
+    def step(params, opt, ids):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, ids, config, mesh, n_micro, remat, sp)
+        t = opt.step + 1
+        tf = t.astype(jnp.float32)
+
+        def upd(p, g, m, v):
+            gf = g.astype(jnp.float32)
+            m = b1 * m + (1 - b1) * gf
+            v = b2 * v + (1 - b2) * jnp.square(gf)
+            mhat = m / (1 - b1 ** tf)
+            vhat = v / (1 - b2 ** tf)
+            pf = p.astype(jnp.float32)
+            pf = pf - lr * (mhat / (jnp.sqrt(vhat) + eps) + wd * pf)
+            return pf.astype(p.dtype), m, v
+
+        flat_p, td = jax.tree_util.tree_flatten(params)
+        flat_g = jax.tree_util.tree_leaves(grads)
+        flat_m = jax.tree_util.tree_leaves(opt.m)
+        flat_v = jax.tree_util.tree_leaves(opt.v)
+        out = [upd(p, g, m, v) for p, g, m, v
+               in zip(flat_p, flat_g, flat_m, flat_v)]
+        new_p = jax.tree_util.tree_unflatten(td, [o[0] for o in out])
+        new_m = jax.tree_util.tree_unflatten(td, [o[1] for o in out])
+        new_v = jax.tree_util.tree_unflatten(td, [o[2] for o in out])
+        return loss, new_p, AdamWState(t, new_m, new_v)
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def setup(config: LlamaConfig, mesh: Mesh, seed=0, dtype=jnp.float32):
+    """Init + place params and optimizer state on the mesh."""
+    params = init_params(config, mesh.shape["pp"], jax.random.key(seed),
+                         dtype)
+    sh = param_shardings(mesh)
+    params = jax.tree_util.tree_map(jax.device_put, params, sh)
+    opt = init_adamw(params)
+    return params, opt

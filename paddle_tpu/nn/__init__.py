@@ -7,6 +7,7 @@ from .layer_conv import Conv1D, Conv2D, Conv3D, Conv2DTranspose  # noqa: F401
 from .layer_norm import *  # noqa: F401,F403
 from .layer_pool import *  # noqa: F401,F403
 from .layer_loss import *  # noqa: F401,F403
+from .layer_moe import MoELayer  # noqa: F401
 from .transformer import (  # noqa: F401
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
     TransformerDecoderLayer, TransformerDecoder, Transformer)

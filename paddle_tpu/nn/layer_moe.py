@@ -1,0 +1,61 @@
+"""MoE layer (reference: incubate/distributed/models/moe/moe_layer.py
+MoELayer:119 — here over the GShard dense-dispatch core in
+distributed/moe.py, expert weights stored stacked [E, ...] so expert
+parallelism is a Shard(0) placement, not a code path)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .layer import Layer
+from ..ops.registry import op
+from ..distributed.moe import moe_dispatch_combine
+
+__all__ = ["MoELayer"]
+
+
+@op(name="moe_forward")
+def _moe_forward(x, gate_w, w1, b1, w2, b2, top_k=2, capacity_factor=1.25,
+                 mesh=None, ep_axis="ep", train=True):
+    s0 = x.shape
+    flat = x.reshape(-1, s0[-1])
+    y, aux = moe_dispatch_combine(
+        flat, gate_w, w1, b1, w2, b2, top_k=top_k,
+        capacity_factor=capacity_factor, mesh=mesh, ep_axis=ep_axis,
+        train=train)
+    return y.reshape(s0), aux
+
+
+class MoELayer(Layer):
+    """Top-k routed FFN with static capacity.
+
+    moe = MoELayer(d_model=512, d_hidden=1024, num_experts=8, top_k=2)
+    y = moe(x)           # x: [B, S, d_model]
+    moe.aux_loss         # load-balance loss of the last forward
+    """
+
+    def __init__(self, d_model, d_hidden, num_experts, top_k=2,
+                 capacity_factor=1.25, gate=None, mesh=None, ep_axis="ep",
+                 name=None):
+        super().__init__()
+        self.d_model, self.d_hidden = d_model, d_hidden
+        self.num_experts, self.top_k = num_experts, top_k
+        self.capacity_factor = capacity_factor
+        self.mesh, self.ep_axis = mesh, ep_axis
+        e = num_experts
+        self.gate_weight = self.create_parameter([d_model, e])
+        self.w1 = self.create_parameter([e, d_model, d_hidden])
+        self.b1 = self.create_parameter([e, d_hidden], is_bias=True)
+        self.w2 = self.create_parameter([e, d_hidden, d_model])
+        self.b2 = self.create_parameter([e, d_model], is_bias=True)
+        self.aux_loss = None
+
+    def forward(self, x):
+        y, aux = _moe_forward(
+            x, self.gate_weight, self.w1, self.b1, self.w2, self.b2,
+            top_k=self.top_k, capacity_factor=self.capacity_factor,
+            mesh=self.mesh.jax_mesh if hasattr(self.mesh, "jax_mesh")
+            else self.mesh,
+            ep_axis=self.ep_axis, train=self.training)
+        self.aux_loss = aux
+        return y

@@ -1,0 +1,121 @@
+"""Ring attention over an ICI mesh axis (context parallelism).
+
+Reference gap (SURVEY.md §5 long-context): Paddle in-core has only the
+`sep` topology axis + alltoall primitives; ring attention itself lives
+downstream.  Here it is first-class, in the two standard TPU forms:
+
+  * `ring_attention` — blockwise flash accumulation; kv chunks rotate
+    around the ring via `lax.ppermute` while each device keeps its q chunk.
+    Memory O(S/n) per device, exact softmax via running (m, l) rescaling —
+    the RingAttention recipe (Liu et al. '23) on XLA collectives.
+  * `ulysses_attention` — DeepSpeed-Ulysses: all_to_all trades the
+    sequence sharding for a head sharding, runs dense local attention,
+    and trades back.  Cheaper at moderate S, needs heads % n == 0.
+
+Both differentiate through the collective loop with jax.grad — the
+backward pass is the reverse ring, no hand-written schedule.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["ring_attention", "ulysses_attention"]
+
+
+def _block_attn(q, k, v, mask):
+    """One q-chunk vs one kv-chunk, fp32 flash partials.
+    q: [B,Sq,H,D], k/v: [B,Sk,H,D], mask: [Sq,Sk] bool or None.
+    Returns (acc [B,Sq,H,D] f32, m [B,Sq,H] f32, l [B,Sq,H] f32)."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    m = jnp.max(s, axis=-1)                                  # [B,H,Sq]
+    # all-masked rows: keep m finite so exp() stays 0/0-free
+    m = jnp.maximum(m, -1e30)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)                                  # [B,H,Sq]
+    acc = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return acc, jnp.moveaxis(m, 1, 2), jnp.moveaxis(l, 1, 2)  # [B,Sq,H]
+
+
+def _ring_body(q, k, v, axis_name, n, is_causal):
+    """Manual (per-device) ring attention; q,k,v local chunks [B,Sl,H,D]."""
+    idx = jax.lax.axis_index(axis_name)
+    sl = q.shape[1]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    qf = q.astype(jnp.float32)
+
+    def step(carry, i):
+        o, m, l, kc, vc = carry
+        src = (idx - i) % n  # whose chunk kc is now
+        if is_causal:
+            qpos = idx * sl + jax.lax.broadcasted_iota(jnp.int32, (sl, sl), 0)
+            kpos = src * sl + jax.lax.broadcasted_iota(jnp.int32, (sl, sl), 1)
+            mask = qpos >= kpos
+        else:
+            mask = None
+        acc, bm, bl = _block_attn(qf, kc.astype(jnp.float32),
+                                  vc.astype(jnp.float32), mask)
+        new_m = jnp.maximum(m, bm)
+        alpha = jnp.exp(m - new_m)
+        beta = jnp.exp(bm - new_m)
+        o = o * alpha[..., None] + acc * beta[..., None]
+        l = l * alpha + bl * beta
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return (o, new_m, l, kc, vc), None
+
+    b, _, h, d = q.shape
+    init = (jnp.zeros((b, sl, h, d), jnp.float32),
+            jnp.full((b, sl, h), -jnp.inf, jnp.float32),
+            jnp.zeros((b, sl, h), jnp.float32), k, v)
+    (o, m, l, _, _), _ = jax.lax.scan(
+        jax.checkpoint(step), init, jnp.arange(n))
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, axis="sep", is_causal=True):
+    """q,k,v: global [B,S,H,D] arrays, S sharded over `axis`; exact
+    softmax attention with O(S/n) memory per device."""
+    n = mesh.shape[axis]
+    if n == 1:
+        from .pallas.flash_attention import sdpa
+        return sdpa(q, k, v, is_causal=is_causal)
+    body = functools.partial(_ring_body, axis_name=axis, n=n,
+                             is_causal=is_causal)
+    spec = P(None, axis, None, None)
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, axis_names=frozenset({axis}),
+                         check_vma=False)(q, k, v)
+
+
+def ulysses_attention(q, k, v, mesh, axis="sep", is_causal=True):
+    """DeepSpeed-Ulysses: alltoall seq<->head resharding around dense local
+    attention.  Heads must divide the axis size."""
+    n = mesh.shape[axis]
+    if n == 1:
+        from .pallas.flash_attention import sdpa
+        return sdpa(q, k, v, is_causal=is_causal)
+    assert q.shape[2] % n == 0, "num_heads must be divisible by sep degree"
+
+    def body(ql, kl, vl):
+        # [B, S/n, H, D] -> [B, S, H/n, D]
+        def fwd(t):
+            return jax.lax.all_to_all(t, axis, split_axis=2, concat_axis=1,
+                                      tiled=True)
+        qg, kg, vg = fwd(ql), fwd(kl), fwd(vl)
+        from .pallas.flash_attention import sdpa
+        og = sdpa(qg, kg, vg, is_causal=is_causal)
+        return jax.lax.all_to_all(og, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    spec = P(None, axis, None, None)
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, axis_names=frozenset({axis}),
+                         check_vma=False)(q, k, v)

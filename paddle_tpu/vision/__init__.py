@@ -1,0 +1,6 @@
+"""paddle.vision equivalent (reference: python/paddle/vision — models,
+transforms, datasets; 15.8k LoC)."""
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401
+from . import datasets  # noqa: F401
+from . import ops  # noqa: F401

@@ -1,0 +1,118 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/).
+
+Zero-egress environment: the reference downloads MNIST/Cifar from servers;
+here datasets load from a local path when given one and otherwise generate a
+deterministic synthetic split with the same shapes/label space, so the
+training ladder (BASELINE.md #1/#2) runs hermetically.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeImageNet"]
+
+
+class MNIST(Dataset):
+    """28x28 grayscale digits. mode: 'train' | 'test'."""
+
+    _N = {"train": 60000, "test": 10000}
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None,
+                 synthetic_size=None):
+        self.mode = mode.lower()
+        self.transform = transform
+        if image_path and os.path.exists(image_path):
+            with gzip.open(image_path, "rb") as f:
+                _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                self.images = np.frombuffer(f.read(), np.uint8).reshape(
+                    n, rows, cols)
+            with gzip.open(label_path, "rb") as f:
+                f.read(8)
+                self.labels = np.frombuffer(f.read(), np.uint8)
+        else:
+            n = synthetic_size or 512
+            rng = np.random.RandomState(0 if self.mode == "train" else 1)
+            self.labels = rng.randint(0, 10, n).astype(np.int64)
+            # class-dependent blobs so a model can actually fit the data
+            self.images = np.zeros((n, 28, 28), np.uint8)
+            for i, y in enumerate(self.labels):
+                img = rng.rand(28, 28) * 64
+                r, c = divmod(int(y), 4)
+                img[r * 7:(r + 1) * 7 + 7, c * 7:c * 7 + 7] += 160
+                self.images[i] = np.clip(img, 0, 255).astype(np.uint8)
+
+    def __getitem__(self, idx):
+        if self.transform is not None:
+            img = self.transform(self.images[idx])
+        else:
+            img = self.images[idx].astype(np.float32)[None] / 255.0
+        return img, int(self.labels[idx])
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class _CifarBase(Dataset):
+    n_classes = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None, synthetic_size=None):
+        self.mode = mode.lower()
+        self.transform = transform
+        n = synthetic_size or 256
+        rng = np.random.RandomState(0 if self.mode == "train" else 1)
+        self.labels = rng.randint(0, self.n_classes, n).astype(np.int64)
+        self.images = rng.randint(0, 255, (n, 32, 32, 3)).astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32).transpose(2, 0, 1) / 255.0
+        return img, int(self.labels[idx])
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar10(_CifarBase):
+    n_classes = 10
+
+
+class Cifar100(_CifarBase):
+    n_classes = 100
+
+
+class FakeImageNet(Dataset):
+    """Synthetic 224x224 ImageNet-shaped stream for the ResNet50 bench."""
+
+    def __init__(self, size=1024, image_shape=(3, 224, 224), num_classes=1000,
+                 transform=None, seed=0):
+        self.size = size
+        self.image_shape = image_shape
+        self.num_classes = num_classes
+        self.transform = transform
+        self._rng = np.random.RandomState(seed)
+        self.labels = self._rng.randint(0, num_classes, size).astype(np.int64)
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(idx)
+        img = rng.rand(*self.image_shape).astype(np.float32)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, int(self.labels[idx])
+
+    def __len__(self):
+        return self.size

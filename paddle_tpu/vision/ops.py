@@ -1,0 +1,95 @@
+"""Vision ops (reference: python/paddle/vision/ops.py — yolo/roi/deform ops;
+the TPU-relevant subset as pure-jax ops)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.registry import op
+
+__all__ = ["nms", "box_iou", "roi_align", "DeformConv2D"]
+
+
+@op(name="box_iou")
+def box_iou(boxes1, boxes2):
+    """IoU matrix between [N,4] and [M,4] xyxy boxes."""
+    a1, a2 = boxes1[:, None, :], boxes2[None, :, :]
+    lt = jnp.maximum(a1[..., :2], a2[..., :2])
+    rb = jnp.minimum(a1[..., 2:], a2[..., 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area1 = (boxes1[:, 2] - boxes1[:, 0]) * (boxes1[:, 3] - boxes1[:, 1])
+    area2 = (boxes2[:, 2] - boxes2[:, 0]) * (boxes2[:, 3] - boxes2[:, 1])
+    return inter / (area1[:, None] + area2[None, :] - inter + 1e-9)
+
+
+@op(name="nms")
+def nms(boxes, iou_threshold=0.3, scores=None):
+    """Greedy NMS with static shapes (jit-safe): returns keep mask [N].
+    The reference returns kept indices (dynamic); under XLA the static
+    mask + top-k pattern is idiomatic."""
+    n = boxes.shape[0]
+    if scores is None:
+        order = jnp.arange(n)
+    else:
+        order = jnp.argsort(-scores)
+    b = boxes[order]
+    iou = box_iou.__op_body__(b, b)
+
+    def body(i, keep):
+        sup = jnp.logical_and(keep, iou[i] > iou_threshold)
+        sup = sup.at[i].set(False)
+        return jnp.where(keep[i], jnp.logical_and(keep, ~sup), keep)
+
+    keep = jax.lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+    inv = jnp.argsort(order)
+    return keep[inv]
+
+
+@op(name="roi_align")
+def roi_align(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """RoIAlign, NCHW input, boxes [K,4] xyxy in input scale; boxes_num
+    [N] gives how many of the K boxes belong to each batch image."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    n, c, h, w = x.shape
+    k = boxes.shape[0]
+    if boxes_num is None:
+        batch_idx = jnp.zeros((k,), jnp.int32)
+    else:
+        # static-shape batch index: box i belongs to the image whose
+        # cumulative box count first exceeds i
+        ends = jnp.cumsum(jnp.asarray(boxes_num))
+        batch_idx = jnp.searchsorted(ends, jnp.arange(k), side="right")
+
+    def one_roi(box, bi):
+        off = 0.5 if aligned else 0.0
+        x1, y1, x2, y2 = (box * spatial_scale) - off
+        rh = jnp.maximum(y2 - y1, 1.0)
+        rw = jnp.maximum(x2 - x1, 1.0)
+        ys = y1 + (jnp.arange(oh) + 0.5) * rh / oh
+        xs = x1 + (jnp.arange(ow) + 0.5) * rw / ow
+        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1)
+        x1i = jnp.clip(x0 + 1, 0, w - 1)
+        wy = jnp.clip(ys - y0, 0, 1)[None, :, None]
+        wx = jnp.clip(xs - x0, 0, 1)[None, None, :]
+        f = x[bi]
+        out = (f[:, y0[:, None], x0[None, :]] * (1 - wy) * (1 - wx)
+               + f[:, y1i[:, None], x0[None, :]] * wy * (1 - wx)
+               + f[:, y0[:, None], x1i[None, :]] * (1 - wy) * wx
+               + f[:, y1i[:, None], x1i[None, :]] * wy * wx)
+        return out
+
+    return jax.vmap(one_roi)(boxes, batch_idx)
+
+
+class DeformConv2D:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "DeformConv2D needs data-dependent gather patterns that map "
+            "poorly to TPU; out of scope (reference: vision/ops.py "
+            "DeformConv2D)")

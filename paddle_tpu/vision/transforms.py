@@ -1,0 +1,259 @@
+"""Image transforms (reference: python/paddle/vision/transforms/transforms.py
++ functional.py).  Operate on numpy HWC uint8/float arrays (or CHW tensors
+for Normalize with data_format='CHW'), composable before DataLoader batching.
+"""
+from __future__ import annotations
+
+import numbers
+import random
+
+import numpy as np
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "RandomCrop",
+           "CenterCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Transpose", "BrightnessTransform", "Pad", "RandomResizedCrop",
+           "to_tensor", "normalize", "resize", "hflip", "vflip",
+           "center_crop", "crop"]
+
+
+# ------------------------------------------------------------- functional
+def _as_hwc(img):
+    img = np.asarray(img)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return img
+
+
+def resize(img, size, interpolation="bilinear"):
+    img = _as_hwc(img)
+    h, w = img.shape[:2]
+    if isinstance(size, numbers.Number):
+        if h <= w:
+            oh, ow = int(size), int(size * w / h)
+        else:
+            oh, ow = int(size * h / w), int(size)
+    else:
+        oh, ow = size
+    ys = np.linspace(0, h - 1, oh)
+    xs = np.linspace(0, w - 1, ow)
+    if interpolation == "nearest":
+        out = img[np.round(ys).astype(int)[:, None],
+                  np.round(xs).astype(int)[None, :]]
+    else:  # bilinear
+        y0 = np.floor(ys).astype(int)
+        x0 = np.floor(xs).astype(int)
+        y1 = np.minimum(y0 + 1, h - 1)
+        x1 = np.minimum(x0 + 1, w - 1)
+        wy = (ys - y0)[:, None, None]
+        wx = (xs - x0)[None, :, None]
+        f = img.astype(np.float32)
+        out = (f[y0[:, None], x0[None, :]] * (1 - wy) * (1 - wx)
+               + f[y1[:, None], x0[None, :]] * wy * (1 - wx)
+               + f[y0[:, None], x1[None, :]] * (1 - wy) * wx
+               + f[y1[:, None], x1[None, :]] * wy * wx)
+        if img.dtype == np.uint8:
+            out = np.clip(out + 0.5, 0, 255).astype(np.uint8)
+    return out
+
+
+def crop(img, top, left, height, width):
+    return _as_hwc(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    img = _as_hwc(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    h, w = img.shape[:2]
+    th, tw = output_size
+    return crop(img, (h - th) // 2, (w - tw) // 2, th, tw)
+
+
+def hflip(img):
+    return _as_hwc(img)[:, ::-1]
+
+
+def vflip(img):
+    return _as_hwc(img)[::-1]
+
+
+def normalize(img, mean, std, data_format="CHW"):
+    img = np.asarray(img, np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        return (img - mean[:, None, None]) / std[:, None, None]
+    return (img - mean) / std
+
+
+def to_tensor(img, data_format="CHW"):
+    img = _as_hwc(img)
+    arr = img.astype(np.float32)
+    if img.dtype == np.uint8:
+        arr = arr / 255.0
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return arr
+
+
+# -------------------------------------------------------------- transforms
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean, mean, mean]
+        if isinstance(std, numbers.Number):
+            std = [std, std, std]
+        self.mean, self.std, self.data_format = mean, std, data_format
+
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size, self.interpolation = size, interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+def _pad_spec(padding):
+    """Paddle padding semantics → ((top, bottom), (left, right)).
+    int: all sides; (l, tb): left/right, top/bottom; (l, t, r, b)."""
+    if isinstance(padding, numbers.Number):
+        l = t = r = b = int(padding)
+    elif len(padding) == 2:
+        l, t = padding
+        r, b = l, t
+    else:
+        l, t, r, b = padding
+    return ((t, b), (l, r))
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, keys=None):
+        if isinstance(size, numbers.Number):
+            size = (int(size), int(size))
+        self.size, self.padding = size, padding
+        self.pad_if_needed = pad_if_needed
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        if self.padding:
+            (t, b), (l, r) = _pad_spec(self.padding)
+            img = np.pad(img, ((t, b), (l, r), (0, 0)))
+        th, tw = self.size
+        if self.pad_if_needed:
+            ph = max(0, th - img.shape[0])
+            pw = max(0, tw - img.shape[1])
+            if ph or pw:
+                img = np.pad(img, ((ph, ph), (pw, pw), (0, 0)))
+        h, w = img.shape[:2]
+        top = random.randint(0, h - th)
+        left = random.randint(0, w - tw)
+        return crop(img, top, left, th, tw)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return hflip(img) if random.random() < self.prob else img
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return vflip(img) if random.random() < self.prob else img
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def _apply_image(self, img):
+        return _as_hwc(img).transpose(self.order)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        factor = 1 + random.uniform(-self.value, self.value)
+        out = img.astype(np.float32) * factor
+        if img.dtype == np.uint8:
+            return np.clip(out, 0, 255).astype(np.uint8)
+        return out
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding, self.fill = padding, fill
+
+    def _apply_image(self, img):
+        (t, b), (l, r) = _pad_spec(self.padding)
+        return np.pad(_as_hwc(img), ((t, b), (l, r), (0, 0)),
+                      constant_values=self.fill)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        if isinstance(size, numbers.Number):
+            size = (int(size), int(size))
+        self.size, self.scale, self.ratio = size, scale, ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = random.uniform(*self.ratio)
+            tw = int(round((target * ar) ** 0.5))
+            th = int(round((target / ar) ** 0.5))
+            if 0 < tw <= w and 0 < th <= h:
+                top = random.randint(0, h - th)
+                left = random.randint(0, w - tw)
+                return resize(crop(img, top, left, th, tw), self.size,
+                              self.interpolation)
+        return resize(center_crop(img, min(h, w)), self.size,
+                      self.interpolation)

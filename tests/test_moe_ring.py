@@ -1,0 +1,146 @@
+"""MoE + ring/ulysses attention tests on the 8-virtual-device CPU mesh.
+
+Reference patterns: moe gating kernel tests (test/legacy_test
+test_number_count_op.py, test_limit_by_capacity_op.py) and the
+distributed-vs-single-card equivalence harness (SURVEY.md §4).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as P_
+from paddle_tpu.distributed.moe import (
+    number_count, limit_by_capacity, prune_gate_by_capacity, top_k_gating,
+    moe_dispatch_combine)
+from paddle_tpu.ops.ring_attention import ring_attention, ulysses_attention
+from paddle_tpu.ops.pallas.flash_attention import sdpa
+
+
+def test_number_count():
+    idx = jnp.array([0, 1, 1, 3, 3, 3])
+    np.testing.assert_array_equal(np.asarray(number_count(idx, 4)),
+                                  [1, 2, 0, 3])
+
+
+def test_limit_and_prune_by_capacity():
+    idx = jnp.array([0, 0, 0, 1, 2])
+    cnt = number_count(idx, 3)
+    np.testing.assert_array_equal(np.asarray(limit_by_capacity(cnt, 2)),
+                                  [2, 1, 1])
+    pruned = prune_gate_by_capacity(idx, cnt, 2)
+    np.testing.assert_array_equal(np.asarray(pruned), [0, 0, -1, 1, 2])
+
+
+def test_top_k_gating_shapes_and_mass():
+    key = jax.random.key(0)
+    logits = jax.random.normal(key, (32, 4))
+    combine, dispatch, aux = top_k_gating(logits, top_k=2,
+                                          capacity_factor=2.0, train=False)
+    s, e = logits.shape
+    assert combine.shape[0] == s and combine.shape[1] == e
+    assert dispatch.dtype == bool
+    # every token dispatched to <= top_k slots, gates <= 1
+    per_tok = np.asarray(dispatch.sum(axis=(1, 2)))
+    assert (per_tok <= 2).all() and (per_tok >= 1).all()
+    gates = np.asarray(combine.sum(axis=(1, 2)))
+    assert (gates <= 1.0 + 1e-5).all()
+    assert np.isfinite(float(aux))
+
+
+def test_moe_forward_matches_dense_single_expert():
+    """E=1 top-1 MoE with ample capacity == plain FFN."""
+    key = jax.random.key(1)
+    s, m, f = 16, 8, 32
+    x = jax.random.normal(key, (s, m))
+    gate_w = jnp.zeros((m, 1))
+    w1 = jax.random.normal(key, (1, m, f)) * 0.1
+    b1 = jnp.zeros((1, f))
+    w2 = jax.random.normal(key, (1, f, m)) * 0.1
+    b2 = jnp.zeros((1, m))
+    y, aux = moe_dispatch_combine(x, gate_w, w1, b1, w2, b2, top_k=1,
+                                  capacity_factor=1.0, train=False)
+    ref = jax.nn.gelu(x @ w1[0] + b1[0]) @ w2[0] + b2[0]
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-5)
+
+
+def test_moe_layer_grad():
+    import paddle_tpu.nn as nn
+    moe = nn.MoELayer(d_model=16, d_hidden=32, num_experts=4, top_k=2)
+    x = P_.to_tensor(np.random.randn(2, 8, 16).astype(np.float32))
+    y = moe(x)
+    assert y.shape == [2, 8, 16]
+    (y.sum() + moe.aux_loss.sum()).backward()
+    assert moe.w1.grad is not None
+    assert moe.gate_weight.grad is not None
+
+
+def test_moe_expert_parallel_matches_local():
+    """ep-sharded MoE == unsharded MoE."""
+    mesh = Mesh(np.asarray(jax.devices()).reshape(2, 4), ("dp", "ep"))
+    key = jax.random.key(2)
+    s, m, f, e = 64, 16, 32, 4
+    ks = jax.random.split(key, 5)
+    x = jax.random.normal(ks[0], (s, m))
+    gate_w = jax.random.normal(ks[1], (m, e)) * 0.5
+    w1 = jax.random.normal(ks[2], (e, m, f)) * 0.1
+    b1 = jnp.zeros((e, f))
+    w2 = jax.random.normal(ks[3], (e, f, m)) * 0.1
+    b2 = jnp.zeros((e, m))
+    y0, _ = moe_dispatch_combine(x, gate_w, w1, b1, w2, b2, train=False)
+
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp", None)))
+    w1s = jax.device_put(w1, NamedSharding(mesh, P("ep", None, None)))
+    w2s = jax.device_put(w2, NamedSharding(mesh, P("ep", None, None)))
+    fn = jax.jit(lambda *a: moe_dispatch_combine(
+        *a, mesh=mesh, ep_axis="ep", train=False)[0])
+    y1 = fn(xs, gate_w, w1s, b1, w2s, b2)
+    np.testing.assert_allclose(np.asarray(y0), np.asarray(y1), atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_dense(causal):
+    mesh = Mesh(np.asarray(jax.devices()), ("sep",))
+    key = jax.random.key(3)
+    b, s, h, d = 2, 64, 4, 16
+    q, k, v = (jax.random.normal(kk, (b, s, h, d))
+               for kk in jax.random.split(key, 3))
+    spec = NamedSharding(mesh, P(None, "sep", None, None))
+    qs, ks, vs = (jax.device_put(t, spec) for t in (q, k, v))
+    out = ring_attention(qs, ks, vs, mesh, axis="sep", is_causal=causal)
+    ref = sdpa(q, k, v, is_causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_attention_grad():
+    mesh = Mesh(np.asarray(jax.devices()), ("sep",))
+    key = jax.random.key(4)
+    b, s, h, d = 1, 32, 8, 8
+    q, k, v = (jax.random.normal(kk, (b, s, h, d))
+               for kk in jax.random.split(key, 3))
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(sdpa(q, k, v, is_causal=True) ** 2)
+
+    g_ring = jax.grad(loss_ring)(q, k, v)
+    g_ref = jax.grad(loss_ref)(q, k, v)
+    np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_ref),
+                               atol=5e-4)
+
+
+def test_ulysses_matches_dense():
+    mesh = Mesh(np.asarray(jax.devices()), ("sep",))
+    key = jax.random.key(5)
+    b, s, h, d = 2, 64, 8, 16
+    q, k, v = (jax.random.normal(kk, (b, s, h, d))
+               for kk in jax.random.split(key, 3))
+    spec = NamedSharding(mesh, P(None, "sep", None, None))
+    qs, ks, vs = (jax.device_put(t, spec) for t in (q, k, v))
+    out = ulysses_attention(qs, ks, vs, mesh, axis="sep", is_causal=True)
+    ref = sdpa(q, k, v, is_causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
