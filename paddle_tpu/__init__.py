@@ -97,6 +97,14 @@ from . import flags as _flags_mod
 from .flags import set_flags, get_flags  # noqa: F401
 from . import vision  # noqa: F401
 from . import models  # noqa: F401
+from . import metric  # noqa: F401
+from . import profiler  # noqa: F401
+from .hapi import Model, summary  # noqa: F401
+from . import hapi  # noqa: F401
+from . import distribution  # noqa: F401
+from . import fft  # noqa: F401
+from . import signal  # noqa: F401
+from . import sparse  # noqa: F401
 
 __version__ = "0.1.0"
 

@@ -1,0 +1,127 @@
+"""Numerical debugging (reference: python/paddle/amp/debugging.py —
+TensorCheckerConfig:173, enable_tensor_checker:361, check_numerics,
+collect_operator_stats:481).
+
+Two mechanisms on TPU:
+  * eager per-op scan — FLAGS_check_nan_inf hooks the op registry
+    (ops/registry.py _maybe_check_nan_inf), like the reference's
+    eager/nan_inf_utils.cc;
+  * `check_numerics(x)` — explicit, works inside jit via checkify-style
+    pure reporting (returns stats, raises eagerly).
+"""
+from __future__ import annotations
+
+import enum
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..flags import set_flags, FLAGS
+from ..framework.tensor import Tensor
+
+__all__ = ["DebugMode", "TensorCheckerConfig", "enable_tensor_checker",
+           "disable_tensor_checker", "check_numerics",
+           "enable_operator_stats_collection",
+           "disable_operator_stats_collection", "collect_operator_stats"]
+
+
+class DebugMode(enum.Enum):
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 3
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable=True, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None,
+                 skipped_op_list=None, debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = checked_op_list
+        self.skipped_op_list = skipped_op_list
+
+    def _level(self):
+        return 0 if self.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT \
+            else 3
+
+
+def enable_tensor_checker(checker_config: TensorCheckerConfig):
+    set_flags({"FLAGS_check_nan_inf": checker_config.enable,
+               "FLAGS_check_nan_inf_level": checker_config._level()})
+
+
+def disable_tensor_checker():
+    set_flags({"FLAGS_check_nan_inf": False})
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    """Count nan/inf and min/max/mean; raises on nan/inf when abort mode.
+    Returns (num_nan, num_inf, num_zero) like the reference."""
+    a = tensor._data if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    af = a.astype(jnp.float32)
+    n_nan = jnp.sum(jnp.isnan(af)).astype(jnp.int64)
+    n_inf = jnp.sum(jnp.isinf(af)).astype(jnp.int64)
+    n_zero = jnp.sum(af == 0).astype(jnp.int64)
+    if not isinstance(n_nan, jax.core.Tracer):
+        bad = int(n_nan) + int(n_inf)
+        abort = debug_mode is None or \
+            debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT
+        if bad and abort:
+            raise FloatingPointError(
+                f"[check_numerics] {op_type}:{var_name} has "
+                f"{int(n_nan)} nan, {int(n_inf)} inf "
+                f"(shape {np.shape(a)})")
+    return Tensor(n_nan), Tensor(n_inf), Tensor(n_zero)
+
+
+# --------------------------------------------------- operator stats
+_op_stats: dict | None = None
+
+
+def enable_operator_stats_collection():
+    """Count per-op calls by dtype (reference debugging.py:481)."""
+    global _op_stats
+    _op_stats = {}
+    from ..ops import registry
+
+    if getattr(registry, "_stats_hooked", False):
+        return
+    registry._stats_hooked = True
+    orig = registry.apply_op
+
+    def hooked(opname, body, args, kwargs):
+        out = orig(opname, body, args, kwargs)
+        if _op_stats is not None:
+            leaves = jax.tree_util.tree_leaves(
+                out, is_leaf=lambda x: isinstance(x, Tensor))
+            dt = str(leaves[0].dtype) if leaves else "?"
+            _op_stats[(opname, dt)] = _op_stats.get((opname, dt), 0) + 1
+        return out
+
+    registry.apply_op = hooked
+    # re-point already-registered wrappers' closure is unnecessary: all
+    # wrappers call registry.apply_op dynamically? They captured apply_op
+    # by module-global lookup inside wrapper body, so patching the module
+    # attribute is enough.
+
+
+def disable_operator_stats_collection():
+    global _op_stats
+    stats = _op_stats or {}
+    _op_stats = None
+    if stats:
+        print("<------------------ op list ------------------->")
+        for (name, dt), n in sorted(stats.items()):
+            print(f"  {name:<30} {dt:<12} calls={n}")
+    return stats
+
+
+class collect_operator_stats:
+    def __enter__(self):
+        enable_operator_stats_collection()
+        return self
+
+    def __exit__(self, *exc):
+        disable_operator_stats_collection()

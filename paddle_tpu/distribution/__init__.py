@@ -1,0 +1,5 @@
+"""paddle.distribution (reference: python/paddle/distribution/)."""
+from .distributions import (  # noqa: F401
+    Distribution, Normal, Uniform, Categorical, Bernoulli, Exponential,
+    Beta, Dirichlet, Gamma, Laplace, LogNormal, Multinomial, Poisson,
+    Geometric, Cauchy, Gumbel, StudentT, kl_divergence)

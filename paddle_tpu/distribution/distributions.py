@@ -1,0 +1,489 @@
+"""Probability distributions (reference: python/paddle/distribution/*.py —
+Distribution base with sample/log_prob/entropy/kl_divergence).
+
+Sampling threads the framework PRNG (framework/random.py) so dygraph
+sampling is reproducible under paddle.seed, and traceable under jit.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..framework import random as _random
+from ..ops.registry import op
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Exponential", "Beta", "Dirichlet", "Gamma", "Laplace",
+           "LogNormal", "Multinomial", "Poisson", "Geometric", "Cauchy",
+           "Gumbel", "StudentT", "kl_divergence"]
+
+
+def _arr(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x, jnp.float32) if not isinstance(x, jnp.ndarray) \
+        else x
+
+
+def _t(x):
+    return Tensor(x, stop_gradient=True)
+
+
+def _shape(sample_shape, *params):
+    base = jnp.broadcast_shapes(*[np.shape(p) for p in params]) \
+        if params else ()
+    return tuple(sample_shape) + tuple(base)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        import paddle_tpu as P
+        return P.exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(np.shape(self.loc))
+
+    @property
+    def mean(self):
+        return _t(jnp.broadcast_to(self.loc, jnp.broadcast_shapes(
+            np.shape(self.loc), np.shape(self.scale))))
+
+    @property
+    def variance(self):
+        return _t(jnp.broadcast_to(jnp.square(self.scale),
+                                   jnp.broadcast_shapes(
+                                       np.shape(self.loc),
+                                       np.shape(self.scale))))
+
+    def sample(self, shape=()):
+        sh = _shape(shape, self.loc, self.scale)
+        eps = jax.random.normal(_random.split_key(), sh)
+        return _t(self.loc + eps * self.scale)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _arr(value)
+        var = jnp.square(self.scale)
+        return _t(-jnp.square(v - self.loc) / (2 * var)
+                  - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return _t(0.5 + 0.5 * math.log(2 * math.pi)
+                  + jnp.log(self.scale)
+                  + jnp.zeros(np.shape(self.loc)))
+
+
+class LogNormal(Normal):
+    def sample(self, shape=()):
+        return _t(jnp.exp(super().sample(shape)._data))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        logv = jnp.log(v)
+        base = super().log_prob(_t(logv))._data
+        return _t(base - logv)
+
+    @property
+    def mean(self):
+        return _t(jnp.exp(self.loc + jnp.square(self.scale) / 2))
+
+    @property
+    def variance(self):
+        s2 = jnp.square(self.scale)
+        return _t((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def entropy(self):
+        return _t(0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+                  + self.loc + jnp.zeros(np.shape(self.scale)))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+        super().__init__(np.shape(self.low))
+
+    def sample(self, shape=()):
+        sh = _shape(shape, self.low, self.high)
+        u = jax.random.uniform(_random.split_key(), sh)
+        return _t(self.low + u * (self.high - self.low))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _arr(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return _t(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return _t(jnp.log(self.high - self.low))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is None:
+            raise ValueError("need logits or probs")
+        if logits is not None:
+            self.logits = _arr(logits)
+            if probs is not None:
+                self.probs_ = _arr(probs)
+            else:
+                self.probs_ = jax.nn.softmax(self.logits, axis=-1)
+        else:
+            self.probs_ = _arr(probs) / jnp.sum(_arr(probs), -1,
+                                                keepdims=True)
+            self.logits = jnp.log(self.probs_ + 1e-38)
+        super().__init__(np.shape(self.logits)[:-1])
+
+    def sample(self, shape=()):
+        sh = tuple(shape) + self._batch_shape
+        out = jax.random.categorical(
+            _random.split_key(), jnp.log(self.probs_ + 1e-38), shape=sh)
+        return _t(out)
+
+    def log_prob(self, value):
+        v = _arr(value).astype(jnp.int32)
+        logp = jnp.log(self.probs_ + 1e-38)
+        if logp.ndim == 1:      # value is a vector of independent draws
+            return _t(logp[v])
+        return _t(jnp.take_along_axis(logp, v[..., None], axis=-1)[..., 0])
+
+    def probs(self, value):
+        v = _arr(value).astype(jnp.int32)
+        if self.probs_.ndim == 1:
+            return _t(self.probs_[v])
+        return _t(jnp.take_along_axis(self.probs_, v[..., None],
+                                      axis=-1)[..., 0])
+
+    def entropy(self):
+        p = self.probs_
+        return _t(-jnp.sum(p * jnp.log(p + 1e-38), axis=-1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_ = _arr(probs)
+        super().__init__(np.shape(self.probs_))
+
+    def sample(self, shape=()):
+        sh = _shape(shape, self.probs_)
+        return _t(jax.random.bernoulli(_random.split_key(), self.probs_,
+                                       sh).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        p = self.probs_
+        return _t(v * jnp.log(p + 1e-38) + (1 - v) * jnp.log1p(-p + 1e-38))
+
+    def entropy(self):
+        p = self.probs_
+        return _t(-(p * jnp.log(p + 1e-38)
+                    + (1 - p) * jnp.log1p(-p + 1e-38)))
+
+    @property
+    def mean(self):
+        return _t(self.probs_)
+
+    @property
+    def variance(self):
+        return _t(self.probs_ * (1 - self.probs_))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate)
+        super().__init__(np.shape(self.rate))
+
+    def sample(self, shape=()):
+        sh = _shape(shape, self.rate)
+        return _t(jax.random.exponential(_random.split_key(), sh)
+                  / self.rate)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _t(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return _t(1.0 - jnp.log(self.rate))
+
+    @property
+    def mean(self):
+        return _t(1.0 / self.rate)
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _arr(alpha)
+        self.beta = _arr(beta)
+        super().__init__(np.shape(self.alpha))
+
+    def sample(self, shape=()):
+        sh = _shape(shape, self.alpha, self.beta)
+        return _t(jax.random.beta(_random.split_key(), self.alpha,
+                                  self.beta, sh))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        a, b = self.alpha, self.beta
+        lbeta = (jax.scipy.special.gammaln(a)
+                 + jax.scipy.special.gammaln(b)
+                 - jax.scipy.special.gammaln(a + b))
+        return _t((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - lbeta)
+
+    def entropy(self):
+        from jax.scipy.special import gammaln, digamma
+        a, b = self.alpha, self.beta
+        lbeta = gammaln(a) + gammaln(b) - gammaln(a + b)
+        return _t(lbeta - (a - 1) * digamma(a) - (b - 1) * digamma(b)
+                  + (a + b - 2) * digamma(a + b))
+
+    @property
+    def mean(self):
+        return _t(self.alpha / (self.alpha + self.beta))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _arr(concentration)
+        super().__init__(np.shape(self.concentration)[:-1],
+                         np.shape(self.concentration)[-1:])
+
+    def sample(self, shape=()):
+        sh = tuple(shape) + self._batch_shape
+        return _t(jax.random.dirichlet(_random.split_key(),
+                                       self.concentration, sh))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _arr(value)
+        c = self.concentration
+        norm = jnp.sum(gammaln(c), -1) - gammaln(jnp.sum(c, -1))
+        return _t(jnp.sum((c - 1) * jnp.log(v), -1) - norm)
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _arr(concentration)
+        self.rate = _arr(rate)
+        super().__init__(np.shape(self.concentration))
+
+    def sample(self, shape=()):
+        sh = _shape(shape, self.concentration, self.rate)
+        return _t(jax.random.gamma(_random.split_key(), self.concentration,
+                                   sh) / self.rate)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _arr(value)
+        a, r = self.concentration, self.rate
+        return _t(a * jnp.log(r) + (a - 1) * jnp.log(v) - r * v
+                  - gammaln(a))
+
+    @property
+    def mean(self):
+        return _t(self.concentration / self.rate)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(np.shape(self.loc))
+
+    def sample(self, shape=()):
+        sh = _shape(shape, self.loc, self.scale)
+        return _t(self.loc + self.scale
+                  * jax.random.laplace(_random.split_key(), sh))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _t(-jnp.abs(v - self.loc) / self.scale
+                  - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return _t(1 + jnp.log(2 * self.scale)
+                  + jnp.zeros(np.shape(self.loc)))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs_ = _arr(probs)
+        super().__init__(np.shape(self.probs_)[:-1],
+                         np.shape(self.probs_)[-1:])
+
+    def sample(self, shape=()):
+        n = self.probs_.shape[-1]
+        sh = tuple(shape) + self._batch_shape + (self.total_count,)
+        draws = jax.random.categorical(
+            _random.split_key(), jnp.log(self.probs_ + 1e-38), shape=sh)
+        return _t(jnp.sum(jax.nn.one_hot(draws, n), axis=-2))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _arr(value)
+        logp = jnp.log(self.probs_ + 1e-38)
+        return _t(gammaln(self.total_count + 1.0)
+                  - jnp.sum(gammaln(v + 1.0), -1)
+                  + jnp.sum(v * logp, -1))
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate)
+        super().__init__(np.shape(self.rate))
+
+    def sample(self, shape=()):
+        sh = _shape(shape, self.rate)
+        return _t(jax.random.poisson(_random.split_key(), self.rate,
+                                     sh).astype(jnp.float32))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _arr(value)
+        return _t(v * jnp.log(self.rate) - self.rate - gammaln(v + 1.0))
+
+    @property
+    def mean(self):
+        return _t(self.rate)
+
+
+class Geometric(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_ = _arr(probs)
+        super().__init__(np.shape(self.probs_))
+
+    def sample(self, shape=()):
+        sh = _shape(shape, self.probs_)
+        u = jax.random.uniform(_random.split_key(), sh)
+        return _t(jnp.floor(jnp.log1p(-u) / jnp.log1p(-self.probs_)))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _t(v * jnp.log1p(-self.probs_) + jnp.log(self.probs_))
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(np.shape(self.loc))
+
+    def sample(self, shape=()):
+        sh = _shape(shape, self.loc, self.scale)
+        return _t(self.loc + self.scale
+                  * jax.random.cauchy(_random.split_key(), sh))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        z = (v - self.loc) / self.scale
+        return _t(-jnp.log(math.pi * self.scale * (1 + jnp.square(z))))
+
+    def entropy(self):
+        return _t(jnp.log(4 * math.pi * self.scale))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(np.shape(self.loc))
+
+    def sample(self, shape=()):
+        sh = _shape(shape, self.loc, self.scale)
+        return _t(self.loc + self.scale
+                  * jax.random.gumbel(_random.split_key(), sh))
+
+    def log_prob(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return _t(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    @property
+    def mean(self):
+        return _t(self.loc + self.scale * np.euler_gamma)
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _arr(df)
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(np.shape(self.df),
+                                              np.shape(self.loc)))
+
+    def sample(self, shape=()):
+        sh = _shape(shape, self.df, self.loc, self.scale)
+        return _t(self.loc + self.scale
+                  * jax.random.t(_random.split_key(), self.df, sh))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _arr(value)
+        d = self.df
+        z = (v - self.loc) / self.scale
+        return _t(gammaln((d + 1) / 2) - gammaln(d / 2)
+                  - 0.5 * jnp.log(d * math.pi) - jnp.log(self.scale)
+                  - (d + 1) / 2 * jnp.log1p(jnp.square(z) / d))
+
+
+# ------------------------------------------------------------------- KL
+def kl_divergence(p, q):
+    """KL(p||q) for registered analytic pairs (reference
+    python/paddle/distribution/kl.py)."""
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        var_ratio = jnp.square(p.scale / q.scale)
+        t1 = jnp.square((p.loc - q.loc) / q.scale)
+        return _t(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        return _t(jnp.sum(p.probs_ * (jnp.log(p.probs_ + 1e-38)
+                                      - jnp.log(q.probs_ + 1e-38)), -1))
+    if isinstance(p, Uniform) and isinstance(q, Uniform):
+        return _t(jnp.log((q.high - q.low) / (p.high - p.low)))
+    if isinstance(p, Bernoulli) and isinstance(q, Bernoulli):
+        a, b = p.probs_, q.probs_
+        return _t(a * (jnp.log(a + 1e-38) - jnp.log(b + 1e-38))
+                  + (1 - a) * (jnp.log1p(-a + 1e-38)
+                               - jnp.log1p(-b + 1e-38)))
+    if isinstance(p, Exponential) and isinstance(q, Exponential):
+        r = p.rate / q.rate
+        return _t(jnp.log(r) + 1 / r - 1)
+    raise NotImplementedError(
+        f"kl_divergence not registered for "
+        f"({type(p).__name__}, {type(q).__name__})")

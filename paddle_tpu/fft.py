@@ -1,0 +1,66 @@
+"""paddle.fft (reference: python/paddle/fft.py — pocketfft-backed C++
+kernels paddle/phi/kernels/cpu/fft_kernel.cc; on TPU these lower to XLA's
+FFT HLO directly)."""
+from __future__ import annotations
+
+import jax.numpy.fft as jfft
+
+from .ops.registry import op
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+           "fft2", "ifft2", "rfft2", "irfft2",
+           "fftn", "ifftn", "rfftn", "irfftn",
+           "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _mk(name, fn, arity="1d"):
+    if arity == "1d":
+        @op(name="fft_" + name)
+        def body(x, n=None, axis=-1, norm="backward"):
+            return fn(x, n=n, axis=axis, norm=norm)
+    elif arity == "2d":
+        @op(name="fft_" + name)
+        def body(x, s=None, axes=(-2, -1), norm="backward"):
+            return fn(x, s=s, axes=axes, norm=norm)
+    else:
+        @op(name="fft_" + name)
+        def body(x, s=None, axes=None, norm="backward"):
+            return fn(x, s=s, axes=axes, norm=norm)
+    body.__name__ = name
+    return body
+
+
+fft = _mk("fft", jfft.fft)
+ifft = _mk("ifft", jfft.ifft)
+rfft = _mk("rfft", jfft.rfft)
+irfft = _mk("irfft", jfft.irfft)
+hfft = _mk("hfft", jfft.hfft)
+ihfft = _mk("ihfft", jfft.ihfft)
+fft2 = _mk("fft2", jfft.fft2, "2d")
+ifft2 = _mk("ifft2", jfft.ifft2, "2d")
+rfft2 = _mk("rfft2", jfft.rfft2, "2d")
+irfft2 = _mk("irfft2", jfft.irfft2, "2d")
+fftn = _mk("fftn", jfft.fftn, "nd")
+ifftn = _mk("ifftn", jfft.ifftn, "nd")
+rfftn = _mk("rfftn", jfft.rfftn, "nd")
+irfftn = _mk("irfftn", jfft.irfftn, "nd")
+
+
+@op(name="fftshift")
+def fftshift(x, axes=None):
+    return jfft.fftshift(x, axes=axes)
+
+
+@op(name="ifftshift")
+def ifftshift(x, axes=None):
+    return jfft.ifftshift(x, axes=axes)
+
+
+def fftfreq(n, d=1.0, dtype=None):
+    from .framework.tensor import Tensor
+    return Tensor(jfft.fftfreq(n, d), dtype=dtype)
+
+
+def rfftfreq(n, d=1.0, dtype=None):
+    from .framework.tensor import Tensor
+    return Tensor(jfft.rfftfreq(n, d), dtype=dtype)

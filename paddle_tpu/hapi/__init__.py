@@ -1,0 +1,6 @@
+"""High-level API (reference: python/paddle/hapi — Model:1472, fit:2200,
+callbacks, summary)."""
+from .model import Model  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback, ProgBarLogger, ModelCheckpoint, EarlyStopping, LRScheduler)
+from .summary import summary  # noqa: F401

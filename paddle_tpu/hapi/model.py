@@ -1,0 +1,241 @@
+"""paddle.Model (reference: python/paddle/hapi/model.py:1472 — Model over a
+Layer with prepare/fit/evaluate/predict/save/load).
+
+TPU twist: `fit` drives the compiled train-step path (jit/functional.py) —
+forward+backward+update is one XLA executable per epoch loop, matching the
+reference's intent of `Model` as the performant curated loop.
+"""
+from __future__ import annotations
+
+import os
+import warnings
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..io.dataloader import DataLoader
+from .. import framework
+from ..jit.functional import TrainStep
+from .callbacks import CallbackList, ProgBarLogger, ModelCheckpoint
+
+__all__ = ["Model"]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step = None
+        self.stop_training = False
+
+    # ------------------------------------------------------------ prepare
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, (list, tuple)) \
+                else [metrics]
+        self._amp_configs = amp_configs
+        self._train_step = None
+        return self
+
+    # ------------------------------------------------------------- batches
+    def _loss_fn(self, model, *batch):
+        n_labels = len(self._labels) if self._labels else 1
+        inputs, labels = batch[:-n_labels], batch[-n_labels:]
+        outputs = model(*inputs)
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        return self._loss(*outs, *labels)
+
+    def train_batch(self, inputs, labels=None, update=True):
+        batch = list(_as_list(inputs)) + list(_as_list(labels))
+        if not update:
+            # gradient-accumulation micro-step: eager backward, no update
+            loss = self._loss_fn(self.network,
+                                 *[_to_tensor(b) for b in batch])
+            loss.backward()
+            return [float(loss)]
+        if self._train_step is None:
+            self._train_step = TrainStep(self.network, self._optimizer,
+                                         self._loss_fn)
+        loss = self._train_step(*batch)
+        return [float(loss)]
+
+    def eval_batch(self, inputs, labels=None):
+        was_training = self.network.training
+        self.network.eval()
+        inputs = _as_list(inputs)
+        labels = _as_list(labels)
+        outputs = self.network(*[_to_tensor(t) for t in inputs])
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        loss = self._loss(*outs, *[_to_tensor(t) for t in labels]) \
+            if self._loss else None
+        metrics = []
+        for m in self._metrics:
+            res = m.compute(*outs, *[_to_tensor(t) for t in labels])
+            m.update(*[np.asarray(r._data if isinstance(r, Tensor) else r)
+                       for r in _as_list(res)])
+            metrics.append(m.accumulate())
+        if was_training:
+            self.network.train()
+        return ([float(loss)] if loss is not None else []), metrics
+
+    def predict_batch(self, inputs):
+        was_training = self.network.training
+        self.network.eval()
+        inputs = _as_list(inputs)
+        out = self.network(*[_to_tensor(t) for t in inputs])
+        if was_training:
+            self.network.train()
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return [o.numpy() for o in outs]
+
+    # ----------------------------------------------------------------- fit
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        loader = train_data if isinstance(train_data, DataLoader) else \
+            DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
+                       drop_last=drop_last, num_workers=num_workers)
+        eval_loader = None
+        if eval_data is not None:
+            eval_loader = eval_data if isinstance(eval_data, DataLoader) \
+                else DataLoader(eval_data, batch_size=batch_size)
+
+        cbks = CallbackList(callbacks, model=self, verbose=verbose,
+                            log_freq=log_freq,
+                            default_progbar=verbose > 0,
+                            save_dir=save_dir, save_freq=save_freq)
+        cbks.on_begin("train", {"epochs": epochs,
+                                "steps": _safe_len(loader),
+                                "verbose": verbose,
+                                "metrics": ["loss"] + [
+                                    m.name() for m in self._metrics]})
+        it = 0
+        self.stop_training = False
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            logs = {}
+            acc = max(1, accumulate_grad_batches)
+            for step, batch in enumerate(loader):
+                cbks.on_batch_begin("train", step, logs)
+                ins, labs = _split_batch(batch, self._labels)
+                if acc > 1:
+                    losses = self.train_batch(ins, labs, update=False)
+                    if (step + 1) % acc == 0:
+                        self._optimizer.step()
+                        self._optimizer.clear_grad()
+                else:
+                    losses = self.train_batch(ins, labs)
+                logs = {"loss": losses[0], "step": step}
+                cbks.on_batch_end("train", step, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    self.stop_training = True
+                    break
+            if self._optimizer is not None and \
+                    self._optimizer._lr_scheduler is not None:
+                self._optimizer._lr_scheduler.step()
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self._run_eval(eval_loader, cbks)
+                logs.update(eval_logs)
+            cbks.on_epoch_end(epoch, logs)
+            if self.stop_training:
+                break
+        cbks.on_end("train", logs)
+        return self
+
+    def _run_eval(self, loader, cbks=None):
+        for m in self._metrics:
+            m.reset()
+        losses, n = 0.0, 0
+        for batch in loader:
+            ins, labs = _split_batch(batch, self._labels)
+            ls, _ = self.eval_batch(ins, labs)
+            if ls:
+                losses += ls[0]
+                n += 1
+        logs = {}
+        if n:
+            logs["eval_loss"] = losses / n
+        for m in self._metrics:
+            logs["eval_" + m.name()] = m.accumulate()
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        loader = eval_data if isinstance(eval_data, DataLoader) else \
+            DataLoader(eval_data, batch_size=batch_size,
+                       num_workers=num_workers)
+        return self._run_eval(loader)
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = test_data if isinstance(test_data, DataLoader) else \
+            DataLoader(test_data, batch_size=batch_size,
+                       num_workers=num_workers)
+        outputs = []
+        for batch in loader:
+            ins, _ = _split_batch(batch, self._labels, allow_no_label=True)
+            outputs.append(self.predict_batch(ins))
+        if stack_outputs and outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs])
+                    for i in range(n_out)]
+        return outputs
+
+    # ---------------------------------------------------------------- io
+    def save(self, path, training=True):
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        framework.io.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            framework.io.save(self._optimizer.state_dict(),
+                              path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        self.network.set_state_dict(
+            framework.io.load(path + ".pdparams"))
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path):
+            self._optimizer.set_state_dict(framework.io.load(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary
+        return summary(self.network, input_size, dtypes=dtype)
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _to_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _split_batch(batch, labels_spec, allow_no_label=False):
+    batch = _as_list(batch)
+    if len(batch) == 1 and allow_no_label:
+        return batch, []
+    n_labels = len(labels_spec) if labels_spec else 1
+    return batch[:-n_labels], batch[-n_labels:]
+
+
+def _safe_len(loader):
+    try:
+        return len(loader)
+    except TypeError:
+        return None

@@ -1,0 +1,28 @@
+"""paddle.summary (reference: python/paddle/hapi/model_summary.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["summary"]
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Parameter/size report.  Returns {'total_params': n,
+    'trainable_params': n}; prints a per-layer table."""
+    rows = []
+    total, trainable = 0, 0
+    for name, p in net.named_parameters():
+        n = int(np.prod(p.shape)) if p.shape else 1
+        total += n
+        if getattr(p, "trainable", True):
+            trainable += n
+        rows.append((name, list(p.shape), n))
+    w = max((len(r[0]) for r in rows), default=20) + 2
+    print(f"{'Layer (param)':<{w}}{'Shape':<20}{'Params':>12}")
+    print("-" * (w + 32))
+    for name, shape, n in rows:
+        print(f"{name:<{w}}{str(shape):<20}{n:>12,}")
+    print("-" * (w + 32))
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    return {"total_params": total, "trainable_params": trainable}

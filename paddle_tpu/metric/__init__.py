@@ -1,0 +1,2 @@
+"""paddle.metric (reference: python/paddle/metric/metrics.py)."""
+from .metrics import Metric, Accuracy, Precision, Recall, Auc, accuracy  # noqa: F401

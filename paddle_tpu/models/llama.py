@@ -207,6 +207,9 @@ class LlamaForCausalLM(nn.Layer):
             self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
                                      bias_attr=False)
 
+        if config.dtype == "bfloat16":
+            self.bfloat16()
+
     def forward(self, input_ids, attn_mask=None):
         h = self.llama(input_ids, attn_mask=attn_mask)
         if self.lm_head is None:

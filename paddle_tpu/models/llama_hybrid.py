@@ -37,7 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .llama import LlamaConfig, _rope_tables
+from .llama import LlamaConfig, _rope_tables, apply_rotary_pos_emb
 from ..ops.pallas.flash_attention import sdpa
 
 
@@ -127,13 +127,7 @@ def _decoder_layer(lp, x, cos, sin, config: LlamaConfig):
     q = (h @ lp["q"]).reshape(b, sq, nh, hd)
     k = (h @ lp["k"]).reshape(b, sq, kvh, hd)
     v = (h @ lp["v"]).reshape(b, sq, kvh, hd)
-    cosd, sind = cos[None, :, None, :].astype(q.dtype), \
-        sin[None, :, None, :].astype(q.dtype)
-
-    def rot(t):
-        half = t.shape[-1] // 2
-        return jnp.concatenate([-t[..., half:], t[..., :half]], axis=-1)
-    q, k = q * cosd + rot(q) * sind, k * cosd + rot(k) * sind
+    q, k = apply_rotary_pos_emb(q, k, cos, sin)
     a = sdpa(q, k, v, is_causal=True)
     x = r + (a.reshape(b, sq, nh * hd) @ lp["o"])
     r = x

@@ -16,13 +16,13 @@ __all__ = ["MoELayer"]
 
 @op(name="moe_forward")
 def _moe_forward(x, gate_w, w1, b1, w2, b2, top_k=2, capacity_factor=1.25,
-                 mesh=None, ep_axis="ep", train=True):
+                 mesh=None, ep_axis="ep", train=True, noise_key=None):
     s0 = x.shape
     flat = x.reshape(-1, s0[-1])
     y, aux = moe_dispatch_combine(
         flat, gate_w, w1, b1, w2, b2, top_k=top_k,
         capacity_factor=capacity_factor, mesh=mesh, ep_axis=ep_axis,
-        train=train)
+        train=train, noise_key=noise_key)
     return y.reshape(s0), aux
 
 
@@ -51,11 +51,14 @@ class MoELayer(Layer):
         self.aux_loss = None
 
     def forward(self, x):
+        from ..framework import random as _random
+        noise_key = _random.split_key() if self.training else None
         y, aux = _moe_forward(
             x, self.gate_weight, self.w1, self.b1, self.w2, self.b2,
             top_k=self.top_k, capacity_factor=self.capacity_factor,
             mesh=self.mesh.jax_mesh if hasattr(self.mesh, "jax_mesh")
             else self.mesh,
-            ep_axis=self.ep_axis, train=self.training)
+            ep_axis=self.ep_axis, train=self.training,
+            noise_key=noise_key)
         self.aux_loss = aux
         return y

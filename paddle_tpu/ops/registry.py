@@ -121,6 +121,7 @@ def _tangent_dtype(a):
 
 def _wrap_outputs(opname, out, node):
     out_flat, out_treedef = tree_flatten(out)
+    _maybe_check_nan_inf(opname, out_flat)
     wrapped = []
     for i, a in enumerate(out_flat):
         diff = node is not None and _tangent_dtype(a) != jax.dtypes.float0
@@ -128,3 +129,30 @@ def _wrap_outputs(opname, out, node):
             _wrap(opname, a, stop_gradient=not diff,
                   node=node if diff else None, index=i))
     return tree_unflatten(out_treedef, wrapped)
+
+
+def _maybe_check_nan_inf(opname, arrays):
+    """Per-op output NaN/Inf scan (reference: FLAGS_check_nan_inf,
+    paddle/fluid/eager/nan_inf_utils.cc; level semantics from
+    paddle/common/flags.cc:60-100).  Eager path only — traced arrays are
+    skipped (the jit path uses amp.debugging.check_numerics)."""
+    from ..flags import FLAGS
+    if not FLAGS.get("FLAGS_check_nan_inf"):
+        return
+    import jax.core as jcore
+    for a in arrays:
+        if isinstance(a, jcore.Tracer):
+            return
+        dt = np.result_type(a)
+        if not (np.issubdtype(dt, np.inexact) or dt == np.dtype("bfloat16")):
+            continue
+        import jax.numpy as jnp
+        bad = int(jnp.sum(~jnp.isfinite(a.astype(jnp.float32))))
+        if bad:
+            msg = (f"Operator {opname} output contains {bad} "
+                   f"NaN/Inf value(s) (shape {np.shape(a)})")
+            if FLAGS.get("FLAGS_check_nan_inf_level", 0) >= 3:
+                import logging
+                logging.getLogger("paddle_tpu").warning(msg)
+            else:
+                raise FloatingPointError(msg)

@@ -1,0 +1,6 @@
+"""paddle.profiler (reference: python/paddle/profiler — Profiler:358,
+scheduler states:89, export_chrome_tracing:227, timer.py Benchmark)."""
+from .profiler import (  # noqa: F401
+    Profiler, ProfilerTarget, ProfilerState, RecordEvent, make_scheduler,
+    export_chrome_tracing, load_profiler_result)
+from .timer import Benchmark, benchmark  # noqa: F401

@@ -1,0 +1,188 @@
+"""Profiler over jax.profiler (reference: python/paddle/profiler/profiler.py
+— Profiler:358 with scheduler states:89; CUPTI tracers collapse into XLA's
+own TPU trace; export is TensorBoard/perfetto instead of chrome-trace JSON,
+with the same Python API shape).
+"""
+from __future__ import annotations
+
+import enum
+import os
+import tempfile
+import time
+
+import jax
+
+__all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing",
+           "load_profiler_result"]
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(*, closed, ready, record, repeat=0, skip_first=0):
+    """State machine over step numbers (reference profiler.py:89)."""
+    period = closed + ready + record
+
+    def scheduler(step):
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    """on_trace_ready callback: point the trace dir (perfetto/tensorboard
+    format on TPU) at dir_name."""
+    def handler(prof):
+        prof._export_dir = dir_name
+    return handler
+
+
+def load_profiler_result(path):
+    raise NotImplementedError(
+        "TPU traces are perfetto/tensorboard artifacts; open with "
+        "tensorboard --logdir or ui.perfetto.dev")
+
+
+class Profiler:
+    """paddle.profiler.Profiler-shaped wrapper over jax.profiler.
+
+    with Profiler(targets=[ProfilerTarget.TPU]) as p:
+        for batch in loader:
+            train_step(batch)
+            p.step()
+    """
+
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
+                 record_shapes=False, profile_memory=False, timer_only=False,
+                 emit_nvtx=False, custom_device_types=None,
+                 with_flops=False):
+        self._scheduler = scheduler if callable(scheduler) else (
+            make_scheduler(closed=0, ready=0, record=scheduler[1] or 1,
+                           skip_first=scheduler[0])
+            if isinstance(scheduler, (tuple, list)) else None)
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._export_dir = None
+        self._step = 0
+        self._recording = False
+        self._step_times = []
+        self._t_last = None
+
+    # ------------------------------------------------------------- control
+    def start(self):
+        self._t_last = time.perf_counter()
+        if self._timer_only:
+            return
+        state = self._state()
+        if state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            self._start_trace()
+
+    def stop(self):
+        if self._recording:
+            self._stop_trace()
+        if self._on_trace_ready is not None:
+            self._on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._t_last is not None:
+            self._step_times.append(now - self._t_last)
+        self._t_last = now
+        prev = self._state()
+        self._step += 1
+        cur = self._state()
+        if self._timer_only:
+            return
+        if prev != cur:
+            if cur in (ProfilerState.RECORD,
+                       ProfilerState.RECORD_AND_RETURN) and \
+                    not self._recording:
+                self._start_trace()
+            elif cur == ProfilerState.CLOSED and self._recording:
+                self._stop_trace()
+
+    def _state(self):
+        if self._scheduler is None:
+            return ProfilerState.RECORD
+        return self._scheduler(self._step)
+
+    def _start_trace(self):
+        out = self._export_dir or os.path.join(tempfile.gettempdir(),
+                                               "paddle_tpu_trace")
+        try:
+            jax.profiler.start_trace(out)
+            self._recording = True
+        except Exception:
+            self._recording = False
+
+    def _stop_trace(self):
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            self._recording = False
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # ------------------------------------------------------------- summary
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        if not self._step_times:
+            print("no profiled steps")
+            return
+        import numpy as np
+        ts = np.asarray(self._step_times) * 1e3
+        print(f"steps: {len(ts)}  avg: {ts.mean():.3f}ms  "
+              f"min: {ts.min():.3f}ms  max: {ts.max():.3f}ms")
+
+
+class RecordEvent:
+    """Named host span visible in the trace (reference
+    phi::RecordEvent / event_tracing.h) — maps to
+    jax.profiler.TraceAnnotation."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._ann = jax.profiler.TraceAnnotation(name)
+
+    def begin(self):
+        self._ann.__enter__()
+
+    def end(self):
+        self._ann.__exit__(None, None, None)
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()

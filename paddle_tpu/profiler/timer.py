@@ -1,0 +1,55 @@
+"""Throughput timer (reference: python/paddle/profiler/timer.py Benchmark)."""
+from __future__ import annotations
+
+import time
+
+__all__ = ["Benchmark", "benchmark"]
+
+
+class Benchmark:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._t0 = None
+        self._steps = 0
+        self._samples = 0
+        self._reader_cost = 0.0
+        self._batch_start = None
+
+    def begin(self):
+        self.reset()
+        self._t0 = time.perf_counter()
+        self._batch_start = self._t0
+
+    def before_reader(self):
+        self._reader_t0 = time.perf_counter()
+
+    def after_reader(self):
+        self._reader_cost += time.perf_counter() - self._reader_t0
+
+    def after_step(self, num_samples=1):
+        self._steps += 1
+        self._samples += num_samples
+
+    step = after_step
+
+    def end(self):
+        self._elapsed = time.perf_counter() - self._t0
+
+    @property
+    def ips(self):
+        el = getattr(self, "_elapsed", None) or \
+            (time.perf_counter() - self._t0)
+        return self._samples / el if el else 0.0
+
+    def report(self):
+        return {"steps": self._steps, "samples": self._samples,
+                "ips": self.ips, "reader_cost": self._reader_cost}
+
+
+_global_benchmark = Benchmark()
+
+
+def benchmark():
+    return _global_benchmark

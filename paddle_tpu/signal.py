@@ -1,0 +1,116 @@
+"""paddle.signal (reference: python/paddle/signal.py — frame/overlap_add/
+stft/istft on the fft kernels)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .ops.registry import op
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+@op(name="signal_frame")
+def frame(x, frame_length, hop_length, axis=-1):
+    """Slice overlapping frames (reference signal.frame): signal on `axis`.
+    axis=-1 -> [..., frame_length, n_frames]; axis=0 ->
+    [n_frames, frame_length, ...]."""
+    if axis in (0,) and x.ndim > 0:
+        sig_last = jnp.moveaxis(x, 0, -1)
+    else:
+        sig_last = x
+    n = sig_last.shape[-1]
+    n_frames = 1 + (n - frame_length) // hop_length
+    idx = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(frame_length)[None, :])
+    out = sig_last[..., idx]               # [..., n_frames, frame_length]
+    if axis in (-1, x.ndim - 1):
+        return jnp.swapaxes(out, -1, -2)   # [..., frame_length, n_frames]
+    # axis == 0: [n_frames, frame_length, ...]
+    return jnp.moveaxis(jnp.moveaxis(out, -1, 0), -1, 1)
+
+
+@op(name="signal_overlap_add")
+def overlap_add(x, hop_length, axis=-1):
+    """Inverse of frame.  axis=-1 input [..., frame_length, n_frames] ->
+    [..., seq]; axis=0 input [n_frames, frame_length, ...] -> [seq, ...]."""
+    if axis == 0:
+        # -> [..., frame_length, n_frames]
+        x = jnp.moveaxis(jnp.moveaxis(x, 0, -1), 0, -2)
+    fl, nf = x.shape[-2], x.shape[-1]
+    out_len = (nf - 1) * hop_length + fl
+    batch = x.shape[:-2]
+    flat = x.reshape((-1, fl, nf))
+
+    def one(sig):
+        buf = jnp.zeros((out_len,), x.dtype)
+
+        def body(i, b):
+            return jax.lax.dynamic_update_slice(
+                b, jax.lax.dynamic_slice(b, (i * hop_length,), (fl,))
+                + sig[:, i], (i * hop_length,))
+        return jax.lax.fori_loop(0, nf, body, buf)
+
+    out = jax.vmap(one)(flat).reshape(batch + (out_len,))
+    if axis == 0:
+        out = jnp.moveaxis(out, -1, 0)
+    return out
+
+
+@op(name="stft")
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        window = jnp.ones((win_length,), jnp.float32)
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        window = jnp.pad(window, (pad, n_fft - win_length - pad))
+    if center:
+        pads = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pads, mode=pad_mode)
+    frames = frame.__op_body__(x, n_fft, hop_length)   # [..., n_fft, n]
+    frames = jnp.swapaxes(frames, -1, -2) * window     # [..., n, n_fft]
+    if onesided and not jnp.iscomplexobj(x):
+        spec = jnp.fft.rfft(frames, axis=-1)
+    else:
+        spec = jnp.fft.fft(frames, axis=-1)
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    return jnp.swapaxes(spec, -1, -2)                  # [..., freq, n]
+
+
+@op(name="istft")
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        window = jnp.ones((win_length,), jnp.float32)
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        window = jnp.pad(window, (pad, n_fft - win_length - pad))
+    spec = jnp.swapaxes(x, -1, -2)                     # [..., n, freq]
+    if normalized:
+        spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    if onesided:
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+    else:
+        frames = jnp.fft.ifft(spec, axis=-1).real
+    frames = frames * window
+    sig = overlap_add.__op_body__(
+        jnp.swapaxes(frames, -1, -2), hop_length)
+    # window envelope normalization
+    env = overlap_add.__op_body__(
+        jnp.broadcast_to(jnp.square(window)[:, None],
+                         (n_fft, x.shape[-1])), hop_length)
+    sig = sig / jnp.maximum(env, 1e-11)
+    if center:
+        sig = sig[..., n_fft // 2:]
+        if length is None:
+            sig = sig[..., :sig.shape[-1] - n_fft // 2]
+    if length is not None:
+        sig = sig[..., :length]
+    return sig

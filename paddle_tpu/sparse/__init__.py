@@ -1,0 +1,14 @@
+"""paddle.sparse (reference: python/paddle/sparse + paddle/phi/kernels/sparse
+— COO/CSR tensors and ops).
+
+TPU reality: XLA has no sparse HLO; the idiomatic mapping keeps a COO/CSR
+*format* layer (indices/values arrays, static nnz) whose compute lowers to
+dense/segment-sum XLA ops — the same trade the reference's sparse GPU
+kernels make per-block.  Good for the API surface + moderate sparsity.
+"""
+from .coo import (  # noqa: F401
+    SparseCooTensor, SparseCsrTensor, sparse_coo_tensor, sparse_csr_tensor)
+from . import nn  # noqa: F401
+from .unary import (  # noqa: F401
+    sin, tanh, relu, abs, sqrt, square, log1p, neg, expm1, cast, pow)
+from .binary import add, subtract, multiply, divide, matmul, masked_matmul  # noqa: F401

@@ -1,0 +1,73 @@
+"""Sparse binary ops (reference: paddle/phi/kernels/sparse/
+elementwise_kernel.h, matmul_kernel.h)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from .coo import SparseCooTensor, SparseCsrTensor
+
+__all__ = ["add", "subtract", "multiply", "divide", "matmul",
+           "masked_matmul"]
+
+
+def _ew(x, y, fn):
+    """Same-structure elementwise via dense roundtrip (API parity; the
+    reference GPU kernels do a merge — on TPU dense is the fast path)."""
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)) and \
+            isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        d = fn(x.to_dense()._data, y.to_dense()._data)
+        return _dense_to_coo(d)
+    raise TypeError("sparse binary ops need two sparse tensors")
+
+
+def _dense_to_coo(d):
+    idx = jnp.stack(jnp.nonzero(d, size=int((d != 0).sum())))
+    vals = d[tuple(idx[i] for i in range(idx.shape[0]))]
+    return SparseCooTensor(idx, vals, list(d.shape))
+
+
+def add(x, y):
+    return _ew(x, y, jnp.add)
+
+
+def subtract(x, y):
+    return _ew(x, y, jnp.subtract)
+
+
+def multiply(x, y):
+    return _ew(x, y, jnp.multiply)
+
+
+def divide(x, y):
+    return _ew(x, y, jnp.divide)
+
+
+def matmul(x, y):
+    """sparse @ dense -> dense (reference sparse matmul_kernel)."""
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo()
+    if isinstance(x, SparseCooTensor):
+        ydat = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+        rows, cols = x.indices_[0], x.indices_[1]
+        # segment-sum over rows: TPU-friendly scatter-add
+        contrib = x.values_[:, None] * ydat[cols]
+        out = jnp.zeros((x.shape[0], ydat.shape[1]), contrib.dtype)
+        return Tensor(out.at[rows].add(contrib))
+    raise TypeError(f"expected sparse lhs, got {type(x)}")
+
+
+def masked_matmul(x, y, mask):
+    """dense @ dense evaluated only at mask's nnz (reference
+    masked_matmul_kernel — SDDMM)."""
+    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    yd = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+    if isinstance(mask, SparseCsrTensor):
+        coo = mask.to_sparse_coo()
+    else:
+        coo = mask
+    rows, cols = coo.indices_[0], coo.indices_[1]
+    vals = jnp.sum(xd[rows] * yd[:, cols].T, axis=-1)
+    if isinstance(mask, SparseCsrTensor):
+        return SparseCsrTensor(mask.crows_, mask.cols_, vals, mask.shape)
+    return SparseCooTensor(coo.indices_, vals, coo.shape)

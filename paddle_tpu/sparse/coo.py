@@ -1,0 +1,134 @@
+"""Sparse COO/CSR tensor types (reference: paddle/phi/core/sparse_coo_tensor.h,
+sparse_csr_tensor.h)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+__all__ = ["SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+           "sparse_csr_tensor"]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class SparseCooTensor:
+    """indices [ndim, nnz] + values [nnz, ...]; static nnz."""
+
+    def __init__(self, indices, values, shape, coalesced=False):
+        self.indices_ = _arr(indices).astype(jnp.int64)
+        self.values_ = _arr(values)
+        self.shape = list(shape)
+        self._coalesced = coalesced
+
+    def indices(self):
+        return Tensor(self.indices_)
+
+    def values(self):
+        return Tensor(self.values_)
+
+    @property
+    def nnz(self):
+        return self.indices_.shape[1]
+
+    @property
+    def dtype(self):
+        from ..framework.dtype import dtype as _dt
+        return _dt(str(self.values_.dtype))
+
+    def to_dense(self):
+        dense = jnp.zeros(tuple(self.shape), self.values_.dtype)
+        idx = tuple(self.indices_[i] for i in range(self.indices_.shape[0]))
+        return Tensor(dense.at[idx].add(self.values_))
+
+    def to_sparse_csr(self):
+        assert len(self.shape) == 2
+        order = jnp.lexsort((self.indices_[1], self.indices_[0]))
+        rows = self.indices_[0][order]
+        cols = self.indices_[1][order]
+        vals = self.values_[order]
+        crows = jnp.searchsorted(rows, jnp.arange(self.shape[0] + 1))
+        return SparseCsrTensor(crows, cols, vals, self.shape)
+
+    def coalesce(self):
+        nd = self.indices_.shape[0]
+        flat = jnp.zeros_like(self.indices_[0])
+        for i in range(nd):
+            flat = flat * self.shape[i] + self.indices_[i]
+        order = jnp.argsort(flat)
+        sflat, svals = flat[order], self.values_[order]
+        uniq, inv = jnp.unique(sflat, return_inverse=True,
+                               size=self.nnz, fill_value=-1)
+        summed = jnp.zeros((self.nnz,) + self.values_.shape[1:],
+                           self.values_.dtype).at[inv].add(svals)
+        new_idx = []
+        rem = uniq
+        for s in reversed(self.shape[:nd]):
+            new_idx.append(rem % s)
+            rem = rem // s
+        idx = jnp.stack(list(reversed(new_idx)))
+        keep = uniq >= 0
+        return SparseCooTensor(jnp.where(keep[None], idx, 0),
+                               jnp.where(
+                                   keep.reshape((-1,) + (1,) * (summed.ndim - 1)),
+                                   summed, 0),
+                               self.shape, coalesced=True)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz},\n"
+                f"  indices={np.asarray(self.indices_)},\n"
+                f"  values={np.asarray(self.values_)})")
+
+
+class SparseCsrTensor:
+    def __init__(self, crows, cols, values, shape):
+        self.crows_ = _arr(crows).astype(jnp.int64)
+        self.cols_ = _arr(cols).astype(jnp.int64)
+        self.values_ = _arr(values)
+        self.shape = list(shape)
+
+    def crows(self):
+        return Tensor(self.crows_)
+
+    def cols(self):
+        return Tensor(self.cols_)
+
+    def values(self):
+        return Tensor(self.values_)
+
+    @property
+    def nnz(self):
+        return self.cols_.shape[0]
+
+    def to_dense(self):
+        rows = jnp.searchsorted(self.crows_,
+                                jnp.arange(self.nnz), side="right") - 1
+        dense = jnp.zeros(tuple(self.shape), self.values_.dtype)
+        return Tensor(dense.at[rows, self.cols_].add(self.values_))
+
+    def to_sparse_coo(self, sparse_dim=2):
+        rows = jnp.searchsorted(self.crows_,
+                                jnp.arange(self.nnz), side="right") - 1
+        return SparseCooTensor(jnp.stack([rows, self.cols_]),
+                               self.values_, self.shape)
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    ind = _arr(indices)
+    val = _arr(values)
+    if shape is None:
+        mx = np.asarray(jnp.max(ind, axis=1)) + 1
+        shape = [int(v) for v in mx] + list(val.shape[1:])
+    return SparseCooTensor(ind, val, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    return SparseCsrTensor(_arr(crows), _arr(cols), _arr(values), shape)

@@ -1,0 +1,36 @@
+"""sparse.nn (reference: python/paddle/sparse/nn — ReLU, Softmax layers)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import unary
+from .coo import SparseCooTensor, SparseCsrTensor
+
+__all__ = ["ReLU", "Softmax"]
+
+
+class ReLU:
+    def __call__(self, x):
+        return unary.relu(x)
+
+
+class Softmax:
+    """Row-wise softmax over a 2-D sparse matrix's nnz (reference
+    sparse/nn/functional/activation.py softmax)."""
+
+    def __init__(self, axis=-1):
+        assert axis == -1
+
+    def __call__(self, x):
+        csr = x.to_sparse_csr() if isinstance(x, SparseCooTensor) else x
+        rows = jnp.searchsorted(csr.crows_,
+                                jnp.arange(csr.nnz), side="right") - 1
+        v = csr.values_
+        rmax = jnp.full((csr.shape[0],), -jnp.inf, v.dtype).at[rows].max(v)
+        e = jnp.exp(v - rmax[rows])
+        rsum = jnp.zeros((csr.shape[0],), v.dtype).at[rows].add(e)
+        out = SparseCsrTensor(csr.crows_, csr.cols_, e / rsum[rows],
+                              csr.shape)
+        if isinstance(x, SparseCooTensor):
+            return out.to_sparse_coo()
+        return out

@@ -1,0 +1,72 @@
+"""Sparse unary ops — apply to values, keep structure (reference:
+paddle/phi/kernels/sparse/unary_kernel.h)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .coo import SparseCooTensor, SparseCsrTensor
+
+__all__ = ["sin", "tanh", "relu", "abs", "sqrt", "square", "log1p", "neg",
+           "expm1", "cast", "pow"]
+
+
+def _map_values(x, fn):
+    if isinstance(x, SparseCooTensor):
+        return SparseCooTensor(x.indices_, fn(x.values_), x.shape,
+                               x._coalesced)
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(x.crows_, x.cols_, fn(x.values_), x.shape)
+    raise TypeError(f"expected sparse tensor, got {type(x)}")
+
+
+def sin(x):
+    return _map_values(x, jnp.sin)
+
+
+def tanh(x):
+    return _map_values(x, jnp.tanh)
+
+
+def relu(x):
+    return _map_values(x, lambda v: jnp.maximum(v, 0))
+
+
+def abs(x):
+    return _map_values(x, jnp.abs)
+
+
+def sqrt(x):
+    return _map_values(x, jnp.sqrt)
+
+
+def square(x):
+    return _map_values(x, jnp.square)
+
+
+def log1p(x):
+    return _map_values(x, jnp.log1p)
+
+
+def neg(x):
+    return _map_values(x, jnp.negative)
+
+
+def expm1(x):
+    return _map_values(x, jnp.expm1)
+
+
+def pow(x, factor):
+    return _map_values(x, lambda v: jnp.power(v, factor))
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    from ..framework.dtype import dtype as _dt
+    out = x
+    if value_dtype is not None:
+        np_dt = _dt(value_dtype).np_dtype
+        out = _map_values(out, lambda v: v.astype(np_dt))
+    if index_dtype is not None and isinstance(out, SparseCooTensor):
+        np_it = _dt(index_dtype).np_dtype
+        out = SparseCooTensor(out.indices_.astype(np_it), out.values_,
+                              out.shape, out._coalesced)
+    return out

@@ -28,7 +28,12 @@ class MNIST(Dataset):
                  synthetic_size=None):
         self.mode = mode.lower()
         self.transform = transform
-        if image_path and os.path.exists(image_path):
+        if image_path and not os.path.exists(image_path):
+            raise FileNotFoundError(f"MNIST image_path {image_path!r} does "
+                                    "not exist (no download in this env)")
+        if image_path and not label_path:
+            raise ValueError("label_path is required with image_path")
+        if image_path:
             with gzip.open(image_path, "rb") as f:
                 _, n, rows, cols = struct.unpack(">IIII", f.read(16))
                 self.images = np.frombuffer(f.read(), np.uint8).reshape(
