@@ -1,0 +1,255 @@
+// Process-shared ring buffer over POSIX shared memory.
+// TPU-native analog of the reference DataLoader's shared-memory channel
+// (paddle/phi/core/memory/allocation/mmap_allocator.cc + the mmap shm path of
+// python/paddle/io/dataloader/dataloader_iter.py): worker processes push
+// serialized batches into a shm ring; the trainer process pops them without a
+// pipe copy.  Multi-producer/multi-consumer via process-shared pthread
+// mutex + condvars stored in the shm header.
+//
+// Record layout inside the data region: u32 len | payload, with a wrap marker
+// (len == 0xFFFFFFFF) when a record would straddle the end.
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <cstdio>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x70745f72696e6701ULL;  // "pt_ring" v1
+constexpr uint32_t kWrapMarker = 0xFFFFFFFFu;
+
+struct Header {
+  uint64_t magic;
+  uint64_t capacity;     // data region bytes
+  uint64_t head;         // read offset  (consumer)
+  uint64_t tail;         // write offset (producer)
+  uint64_t used;         // bytes in use (records incl. headers)
+  uint64_t n_items;
+  pthread_mutex_t mu;
+  pthread_cond_t not_empty;
+  pthread_cond_t not_full;
+  uint32_t closed;
+  uint32_t _pad;
+};
+
+struct Ring {
+  Header* hdr;
+  char* data;
+  uint64_t map_len;
+  char name[256];
+  bool owner;
+};
+
+void abs_deadline(struct timespec* ts, int timeout_ms) {
+  clock_gettime(CLOCK_REALTIME, ts);
+  ts->tv_sec += timeout_ms / 1000;
+  ts->tv_nsec += static_cast<long>(timeout_ms % 1000) * 1000000L;
+  if (ts->tv_nsec >= 1000000000L) {
+    ts->tv_sec += 1;
+    ts->tv_nsec -= 1000000000L;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create a named ring with `capacity` data bytes.  Returns handle or null.
+void* pt_ring_create(const char* name, uint64_t capacity) {
+  ::shm_unlink(name);  // stale segment from a crashed run
+  int fd = ::shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  uint64_t map_len = sizeof(Header) + capacity;
+  if (::ftruncate(fd, static_cast<off_t>(map_len)) != 0) {
+    ::close(fd);
+    ::shm_unlink(name);
+    return nullptr;
+  }
+  void* mem =
+      ::mmap(nullptr, map_len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (mem == MAP_FAILED) {
+    ::shm_unlink(name);
+    return nullptr;
+  }
+  Header* h = static_cast<Header*>(mem);
+  std::memset(h, 0, sizeof(Header));
+  h->capacity = capacity;
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+#ifdef PTHREAD_MUTEX_ROBUST
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+#endif
+  pthread_mutex_init(&h->mu, &ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_cond_init(&h->not_empty, &ca);
+  pthread_cond_init(&h->not_full, &ca);
+  h->magic = kMagic;
+  Ring* r = new Ring();
+  r->hdr = h;
+  r->data = static_cast<char*>(mem) + sizeof(Header);
+  r->map_len = map_len;
+  std::snprintf(r->name, sizeof(r->name), "%s", name);
+  r->owner = true;
+  return r;
+}
+
+void* pt_ring_attach(const char* name) {
+  int fd = ::shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (::fstat(fd, &st) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* mem = ::mmap(nullptr, static_cast<size_t>(st.st_size),
+                     PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  Header* h = static_cast<Header*>(mem);
+  if (h->magic != kMagic) {
+    ::munmap(mem, static_cast<size_t>(st.st_size));
+    return nullptr;
+  }
+  Ring* r = new Ring();
+  r->hdr = h;
+  r->data = static_cast<char*>(mem) + sizeof(Header);
+  r->map_len = static_cast<uint64_t>(st.st_size);
+  std::snprintf(r->name, sizeof(r->name), "%s", name);
+  r->owner = false;
+  return r;
+}
+
+// Push one record.  Blocks while full (up to timeout_ms; <0 => forever).
+// Returns 0 ok, -1 timeout, -2 closed, -3 record larger than capacity.
+int pt_ring_push(void* hd, const char* buf, uint64_t len, int timeout_ms) {
+  Ring* r = static_cast<Ring*>(hd);
+  Header* h = r->hdr;
+  uint64_t need = 4 + len;
+  if (need + 4 > h->capacity) return -3;  // +4: room for a wrap marker
+  struct timespec ts;
+  if (timeout_ms >= 0) abs_deadline(&ts, timeout_ms);
+  pthread_mutex_lock(&h->mu);
+  while (!h->closed) {
+    uint64_t tail_room = h->capacity - h->tail;
+    uint64_t eff = need + (tail_room < need ? tail_room : 0);
+    if (h->capacity - h->used >= eff) break;
+    int rc = timeout_ms >= 0
+                 ? pthread_cond_timedwait(&h->not_full, &h->mu, &ts)
+                 : pthread_cond_wait(&h->not_full, &h->mu);
+    if (rc == ETIMEDOUT) {
+      pthread_mutex_unlock(&h->mu);
+      return -1;
+    }
+  }
+  if (h->closed) {
+    pthread_mutex_unlock(&h->mu);
+    return -2;
+  }
+  uint64_t tail_room = h->capacity - h->tail;
+  if (tail_room < need) {
+    // wrap: mark the remainder dead and start at 0
+    if (tail_room >= 4) {
+      uint32_t m = kWrapMarker;
+      std::memcpy(r->data + h->tail, &m, 4);
+    }
+    h->used += tail_room;
+    h->tail = 0;
+  }
+  uint32_t len32 = static_cast<uint32_t>(len);
+  std::memcpy(r->data + h->tail, &len32, 4);
+  std::memcpy(r->data + h->tail + 4, buf, len);
+  h->tail = (h->tail + need) % h->capacity;
+  h->used += need;
+  h->n_items += 1;
+  pthread_cond_signal(&h->not_empty);
+  pthread_mutex_unlock(&h->mu);
+  return 0;
+}
+
+// Pop one record into a malloc'd buffer (*out, caller frees via pt_free).
+// Returns length >= 0, -1 timeout, -2 closed-and-empty.
+int64_t pt_ring_pop(void* hd, char** out, int timeout_ms) {
+  Ring* r = static_cast<Ring*>(hd);
+  Header* h = r->hdr;
+  struct timespec ts;
+  if (timeout_ms >= 0) abs_deadline(&ts, timeout_ms);
+  pthread_mutex_lock(&h->mu);
+  while (h->n_items == 0) {
+    if (h->closed) {
+      pthread_mutex_unlock(&h->mu);
+      return -2;
+    }
+    int rc = timeout_ms >= 0
+                 ? pthread_cond_timedwait(&h->not_empty, &h->mu, &ts)
+                 : pthread_cond_wait(&h->not_empty, &h->mu);
+    if (rc == ETIMEDOUT) {
+      pthread_mutex_unlock(&h->mu);
+      return -1;
+    }
+  }
+  uint64_t head_room = h->capacity - h->head;
+  uint32_t len32;
+  if (head_room < 4) {
+    h->used -= head_room;
+    h->head = 0;
+  } else {
+    std::memcpy(&len32, r->data + h->head, 4);
+    if (len32 == kWrapMarker) {
+      h->used -= head_room;
+      h->head = 0;
+    }
+  }
+  std::memcpy(&len32, r->data + h->head, 4);
+  *out = static_cast<char*>(std::malloc(len32 ? len32 : 1));
+  std::memcpy(*out, r->data + h->head + 4, len32);
+  uint64_t need = 4 + len32;
+  h->head = (h->head + need) % h->capacity;
+  h->used -= need;
+  h->n_items -= 1;
+  pthread_cond_signal(&h->not_full);
+  pthread_mutex_unlock(&h->mu);
+  return static_cast<int64_t>(len32);
+}
+
+uint64_t pt_ring_size(void* hd) {
+  Ring* r = static_cast<Ring*>(hd);
+  pthread_mutex_lock(&r->hdr->mu);
+  uint64_t n = r->hdr->n_items;
+  pthread_mutex_unlock(&r->hdr->mu);
+  return n;
+}
+
+// Mark closed: producers stop, consumers drain then get -2.
+void pt_ring_close(void* hd) {
+  Ring* r = static_cast<Ring*>(hd);
+  pthread_mutex_lock(&r->hdr->mu);
+  r->hdr->closed = 1;
+  pthread_cond_broadcast(&r->hdr->not_empty);
+  pthread_cond_broadcast(&r->hdr->not_full);
+  pthread_mutex_unlock(&r->hdr->mu);
+}
+
+void pt_ring_free(void* hd) {
+  Ring* r = static_cast<Ring*>(hd);
+  if (!r) return;
+  bool owner = r->owner;
+  char name[256];
+  std::snprintf(name, sizeof(name), "%s", r->name);
+  ::munmap(reinterpret_cast<void*>(r->hdr), r->map_len);
+  if (owner) ::shm_unlink(name);
+  delete r;
+}
+
+}  // extern "C"

@@ -1,0 +1,363 @@
+// TCPStore: rendezvous key-value store over raw TCP sockets.
+// TPU-native analog of the reference bootstrap store
+// (paddle/phi/core/distributed/store/tcp_store.h:121, tcp_utils.cc):
+// a master rank runs the server; every rank connects as a client and uses
+// set/get/add/wait to exchange small blobs (addresses, meshes, barrier
+// counters) before jax.distributed / ICI collectives take over.
+//
+// Protocol (all little-endian):
+//   request : u8 cmd | u32 klen | key | u32 vlen | value
+//   response: u32 len | payload            (GET/ADD/WAIT)
+// Commands: 0=SET 1=GET(blocking) 2=ADD(i64 delta -> i64 new) 3=WAIT
+//           4=DELETE 5=NUM_KEYS 6=CHECK(non-blocking; 1/0)
+// Server: accept-loop thread + thread per connection; kv guarded by a mutex,
+// blocking GET/WAIT park on a condition variable.
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+enum Cmd : uint8_t {
+  kSet = 0,
+  kGet = 1,
+  kAdd = 2,
+  kWait = 3,
+  kDelete = 4,
+  kNumKeys = 5,
+  kCheck = 6,
+};
+
+bool send_all(int fd, const void* buf, size_t len) {
+  const char* p = static_cast<const char*>(buf);
+  while (len > 0) {
+    ssize_t n = ::send(fd, p, len, MSG_NOSIGNAL);
+    if (n <= 0) return false;
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t len) {
+  char* p = static_cast<char*>(buf);
+  while (len > 0) {
+    ssize_t n = ::recv(fd, p, len, 0);
+    if (n <= 0) return false;
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool send_blob(int fd, const std::string& s) {
+  uint32_t len = static_cast<uint32_t>(s.size());
+  if (!send_all(fd, &len, 4)) return false;
+  return s.empty() || send_all(fd, s.data(), s.size());
+}
+
+bool recv_blob(int fd, std::string* out) {
+  uint32_t len = 0;
+  if (!recv_all(fd, &len, 4)) return false;
+  out->resize(len);
+  return len == 0 || recv_all(fd, &(*out)[0], len);
+}
+
+struct Server {
+  int listen_fd = -1;
+  std::thread accept_thread;
+  std::atomic<bool> stop{false};
+  std::mutex mu;
+  std::condition_variable cv;
+  std::unordered_map<std::string, std::string> kv;
+  std::vector<std::thread> conns;
+  std::vector<int> conn_fds;
+  std::mutex conns_mu;
+
+  // Serve one request; false => connection done (error, peer gone, or stop).
+  bool serve_one(int fd) {
+    uint8_t cmd;
+    if (!recv_all(fd, &cmd, 1)) return false;
+    std::string key, val;
+    if (!recv_blob(fd, &key) || !recv_blob(fd, &val)) return false;
+    switch (cmd) {
+      case kSet: {
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          kv[key] = val;
+        }
+        cv.notify_all();
+        uint32_t zero = 0;
+        return send_all(fd, &zero, 4);
+      }
+      case kGet: {
+        std::string out;
+        {
+          std::unique_lock<std::mutex> lk(mu);
+          cv.wait(lk, [&] { return stop.load() || kv.count(key) != 0; });
+          if (stop.load()) return false;
+          out = kv[key];
+        }
+        return send_blob(fd, out);
+      }
+      case kAdd: {
+        int64_t delta = 0;
+        if (val.size() == 8) std::memcpy(&delta, val.data(), 8);
+        int64_t cur = 0;
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          auto it = kv.find(key);
+          if (it != kv.end() && it->second.size() == 8)
+            std::memcpy(&cur, it->second.data(), 8);
+          cur += delta;
+          std::string enc(8, '\0');
+          std::memcpy(&enc[0], &cur, 8);
+          kv[key] = enc;
+        }
+        cv.notify_all();
+        std::string out(8, '\0');
+        std::memcpy(&out[0], &cur, 8);
+        return send_blob(fd, out);
+      }
+      case kWait: {
+        {
+          std::unique_lock<std::mutex> lk(mu);
+          cv.wait(lk, [&] { return stop.load() || kv.count(key) != 0; });
+          if (stop.load()) return false;
+        }
+        std::string ok("\x01", 1);
+        return send_blob(fd, ok);
+      }
+      case kDelete: {
+        uint32_t n;
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          n = static_cast<uint32_t>(kv.erase(key));
+        }
+        return send_all(fd, &n, 4);
+      }
+      case kNumKeys: {
+        int64_t n;
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          n = static_cast<int64_t>(kv.size());
+        }
+        std::string out(8, '\0');
+        std::memcpy(&out[0], &n, 8);
+        return send_blob(fd, out);
+      }
+      case kCheck: {
+        bool has;
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          has = kv.count(key) != 0;
+        }
+        std::string out(has ? "\x01" : "\x00", 1);
+        return send_blob(fd, out);
+      }
+      default:
+        return false;
+    }
+  }
+
+  void handle(int fd) {
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    while (!stop.load() && serve_one(fd)) {
+    }
+    ::close(fd);
+  }
+
+  void accept_loop() {
+    for (;;) {
+      struct pollfd pfd = {listen_fd, POLLIN, 0};
+      int r = ::poll(&pfd, 1, 200);
+      if (stop.load()) return;
+      if (r <= 0) continue;
+      int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) continue;
+      std::lock_guard<std::mutex> lk(conns_mu);
+      conn_fds.push_back(fd);
+      conns.emplace_back([this, fd] { handle(fd); });
+    }
+  }
+};
+
+struct Client {
+  int fd = -1;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Returns server handle, or null.  port==0 picks a free port; the bound port
+// is written to *out_port.
+void* pt_store_server_start(int port, int* out_port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(fd, 128) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  if (out_port) *out_port = ntohs(addr.sin_port);
+  Server* s = new Server();
+  s->listen_fd = fd;
+  s->accept_thread = std::thread([s] { s->accept_loop(); });
+  return s;
+}
+
+void pt_store_server_stop(void* h) {
+  Server* s = static_cast<Server*>(h);
+  if (!s) return;
+  s->stop.store(true);
+  s->cv.notify_all();
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  ::close(s->listen_fd);
+  // Unblock handlers stuck in recv by shutting their sockets, then join them
+  // all before freeing the Server (no use-after-free on mu/cv/kv).
+  {
+    std::lock_guard<std::mutex> lk(s->conns_mu);
+    for (int fd : s->conn_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  for (auto& t : s->conns)
+    if (t.joinable()) t.join();
+  delete s;
+}
+
+void* pt_store_client_connect(const char* host, int port, int timeout_ms) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  for (;;) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return nullptr;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+      ::close(fd);
+      return nullptr;
+    }
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      Client* c = new Client();
+      c->fd = fd;
+      return c;
+    }
+    ::close(fd);
+    if (std::chrono::steady_clock::now() >= deadline) return nullptr;
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+}
+
+void pt_store_client_close(void* h) {
+  Client* c = static_cast<Client*>(h);
+  if (!c) return;
+  ::close(c->fd);
+  delete c;
+}
+
+namespace {
+bool send_req(Client* c, uint8_t cmd, const char* key, int klen,
+              const char* val, int vlen) {
+  if (!send_all(c->fd, &cmd, 1)) return false;
+  uint32_t kl = static_cast<uint32_t>(klen), vl = static_cast<uint32_t>(vlen);
+  if (!send_all(c->fd, &kl, 4)) return false;
+  if (klen && !send_all(c->fd, key, klen)) return false;
+  if (!send_all(c->fd, &vl, 4)) return false;
+  if (vlen && !send_all(c->fd, val, vlen)) return false;
+  return true;
+}
+}  // namespace
+
+int pt_store_set(void* h, const char* key, int klen, const char* val,
+                 int vlen) {
+  Client* c = static_cast<Client*>(h);
+  if (!send_req(c, kSet, key, klen, val, vlen)) return -1;
+  uint32_t ack;
+  return recv_all(c->fd, &ack, 4) ? 0 : -1;
+}
+
+// Blocking get; returns malloc'd buffer via *out (caller frees with pt_free),
+// length as return value, -1 on error.
+int64_t pt_store_get(void* h, const char* key, int klen, char** out) {
+  Client* c = static_cast<Client*>(h);
+  if (!send_req(c, kGet, key, klen, nullptr, 0)) return -1;
+  std::string blob;
+  if (!recv_blob(c->fd, &blob)) return -1;
+  *out = static_cast<char*>(std::malloc(blob.size() ? blob.size() : 1));
+  std::memcpy(*out, blob.data(), blob.size());
+  return static_cast<int64_t>(blob.size());
+}
+
+int64_t pt_store_add(void* h, const char* key, int klen, int64_t delta) {
+  Client* c = static_cast<Client*>(h);
+  char enc[8];
+  std::memcpy(enc, &delta, 8);
+  if (!send_req(c, kAdd, key, klen, enc, 8)) return INT64_MIN;
+  std::string blob;
+  if (!recv_blob(c->fd, &blob) || blob.size() != 8) return INT64_MIN;
+  int64_t v;
+  std::memcpy(&v, blob.data(), 8);
+  return v;
+}
+
+int pt_store_wait(void* h, const char* key, int klen) {
+  Client* c = static_cast<Client*>(h);
+  if (!send_req(c, kWait, key, klen, nullptr, 0)) return -1;
+  std::string blob;
+  return recv_blob(c->fd, &blob) ? 0 : -1;
+}
+
+int pt_store_check(void* h, const char* key, int klen) {
+  Client* c = static_cast<Client*>(h);
+  if (!send_req(c, kCheck, key, klen, nullptr, 0)) return -1;
+  std::string blob;
+  if (!recv_blob(c->fd, &blob) || blob.size() != 1) return -1;
+  return blob[0] ? 1 : 0;
+}
+
+int pt_store_delete(void* h, const char* key, int klen) {
+  Client* c = static_cast<Client*>(h);
+  if (!send_req(c, kDelete, key, klen, nullptr, 0)) return -1;
+  uint32_t n;
+  return recv_all(c->fd, &n, 4) ? static_cast<int>(n) : -1;
+}
+
+int64_t pt_store_num_keys(void* h) {
+  Client* c = static_cast<Client*>(h);
+  if (!send_req(c, kNumKeys, nullptr, 0, nullptr, 0)) return -1;
+  std::string blob;
+  if (!recv_blob(c->fd, &blob) || blob.size() != 8) return -1;
+  int64_t v;
+  std::memcpy(&v, blob.data(), 8);
+  return v;
+}
+
+void pt_free(void* p) { std::free(p); }
+
+}  // extern "C"

@@ -1,0 +1,148 @@
+// Host-side event tracer: low-overhead span recording + chrome-trace export.
+// TPU-native analog of the reference profiler's HostTracer
+// (paddle/phi/api/profiler/event_tracing.h, chrometracing_logger.cc):
+// instrumented RecordEvent spans collected in C++, exported as a
+// chrome://tracing JSON that can be merged with jax.profiler device traces.
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+namespace {
+
+struct Event {
+  std::string name;
+  uint64_t tid;
+  int64_t ts_ns;    // start, monotonic
+  int64_t dur_ns;   // span duration; -1 => instant event
+};
+
+std::mutex g_mu;
+std::vector<Event> g_events;
+std::atomic<bool> g_enabled{false};
+
+struct Open {
+  std::string name;
+  int64_t start_ns;
+};
+thread_local std::vector<Open> t_stack;
+
+int64_t now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+uint64_t tid_hash() {
+  return std::hash<std::thread::id>{}(std::this_thread::get_id()) & 0xffffff;
+}
+
+}  // namespace
+
+void pt_trace_enable(int on) { g_enabled.store(on != 0); }
+
+int pt_trace_enabled() { return g_enabled.load() ? 1 : 0; }
+
+void pt_trace_begin(const char* name) {
+  if (!g_enabled.load()) return;
+  t_stack.push_back(Open{name ? name : "", now_ns()});
+}
+
+void pt_trace_end() {
+  if (t_stack.empty()) return;
+  Open o = t_stack.back();
+  t_stack.pop_back();
+  if (!g_enabled.load()) return;
+  int64_t end = now_ns();
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_events.push_back(Event{std::move(o.name), tid_hash(), o.start_ns,
+                           end - o.start_ns});
+}
+
+void pt_trace_instant(const char* name) {
+  if (!g_enabled.load()) return;
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_events.push_back(Event{name ? name : "", tid_hash(), now_ns(), -1});
+}
+
+void pt_trace_clear() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_events.clear();
+}
+
+int64_t pt_trace_count() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  return static_cast<int64_t>(g_events.size());
+}
+
+// Export events as chrome trace JSON ("traceEvents" array).  Returns 0 on
+// success.  pid is taken from the caller so multi-process traces merge.
+int pt_trace_export(const char* path, int64_t pid) {
+  std::vector<Event> snap;
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    snap = g_events;
+  }
+  FILE* f = std::fopen(path, "w");
+  if (!f) return -1;
+  std::fputs("{\"traceEvents\":[", f);
+  bool first = true;
+  for (const auto& e : snap) {
+    if (!first) std::fputc(',', f);
+    first = false;
+    // escape name minimally (quotes + backslash)
+    std::string n;
+    n.reserve(e.name.size());
+    for (char c : e.name) {
+      if (c == '"' || c == '\\') n.push_back('\\');
+      if (static_cast<unsigned char>(c) >= 0x20) n.push_back(c);
+    }
+    if (e.dur_ns >= 0) {
+      std::fprintf(f,
+                   "{\"name\":\"%s\",\"ph\":\"X\",\"pid\":%lld,\"tid\":%llu,"
+                   "\"ts\":%.3f,\"dur\":%.3f}",
+                   n.c_str(), static_cast<long long>(pid),
+                   static_cast<unsigned long long>(e.tid), e.ts_ns / 1e3,
+                   e.dur_ns / 1e3);
+    } else {
+      std::fprintf(f,
+                   "{\"name\":\"%s\",\"ph\":\"i\",\"s\":\"t\",\"pid\":%lld,"
+                   "\"tid\":%llu,\"ts\":%.3f}",
+                   n.c_str(), static_cast<long long>(pid),
+                   static_cast<unsigned long long>(e.tid), e.ts_ns / 1e3);
+    }
+  }
+  std::fputs("]}", f);
+  std::fclose(f);
+  return 0;
+}
+
+// Fill out_ns[i] = {ts, dur} pairs for python-side statistics; returns number
+// of events copied (<= cap).  Names are returned via a packed buffer of
+// NUL-separated strings (out_names, cap bytes out_names_cap).
+int64_t pt_trace_snapshot(int64_t* out_ns, int64_t cap_pairs, char* out_names,
+                          int64_t out_names_cap) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  int64_t n = 0;
+  int64_t off = 0;
+  for (const auto& e : g_events) {
+    if (n >= cap_pairs) break;
+    int64_t need = static_cast<int64_t>(e.name.size()) + 1;
+    if (off + need > out_names_cap) break;
+    std::memcpy(out_names + off, e.name.c_str(), need);
+    off += need;
+    out_ns[2 * n] = e.ts_ns;
+    out_ns[2 * n + 1] = e.dur_ns;
+    ++n;
+  }
+  return n;
+}
+
+}  // extern "C"

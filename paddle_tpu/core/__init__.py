@@ -1,0 +1,8 @@
+"""Native runtime core (C++ via ctypes) with pure-Python fallbacks.
+
+Reference analogs: TCPStore (paddle/phi/core/distributed/store/tcp_store.h),
+DataLoader shm channel (mmap_allocator), HostTracer profiler events.
+"""
+from .native import TCPStore, ShmRing, available, load
+
+__all__ = ["TCPStore", "ShmRing", "available", "load"]

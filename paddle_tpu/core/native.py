@@ -1,0 +1,281 @@
+"""ctypes loader for the native runtime core (csrc/ -> libpaddle_tpu_core.so).
+
+TPU-native analogs of the reference's C++ runtime pieces:
+  * TCPStore       — paddle/phi/core/distributed/store/tcp_store.h:121
+  * shm ring       — mmap_allocator-based DataLoader shm channel
+  * host tracer    — paddle/phi/api/profiler/event_tracing.h (HostTracer)
+
+The library is compiled on demand with g++ (toolchain is part of the
+image); if compilation is impossible the callers fall back to pure-Python
+paths, so the framework never hard-fails on a missing compiler.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_lib = None
+_lib_lock = threading.Lock()
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_CSRC = os.path.normpath(os.path.join(_HERE, "..", "..", "csrc"))
+_SO = os.path.join(_HERE, "libpaddle_tpu_core.so")
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_SO):
+        return True
+    so_mtime = os.path.getmtime(_SO)
+    try:
+        srcs = [os.path.join(_CSRC, f) for f in os.listdir(_CSRC)
+                if f.endswith(".cc")]
+    except OSError:
+        return False  # installed without sources: use the shipped .so
+    return any(os.path.getmtime(s) > so_mtime for s in srcs)
+
+
+def _build() -> bool:
+    srcs = [os.path.join(_CSRC, f) for f in sorted(os.listdir(_CSRC))
+            if f.endswith(".cc")]
+    if not srcs:
+        return False
+    cmd = ["g++", "-O2", "-fPIC", "-std=c++17", "-pthread", "-shared",
+           *srcs, "-o", _SO, "-lrt"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError):
+        return False
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes
+    # store
+    lib.pt_store_server_start.restype = c.c_void_p
+    lib.pt_store_server_start.argtypes = [c.c_int, c.POINTER(c.c_int)]
+    lib.pt_store_server_stop.argtypes = [c.c_void_p]
+    lib.pt_store_client_connect.restype = c.c_void_p
+    lib.pt_store_client_connect.argtypes = [c.c_char_p, c.c_int, c.c_int]
+    lib.pt_store_client_close.argtypes = [c.c_void_p]
+    lib.pt_store_set.restype = c.c_int
+    lib.pt_store_set.argtypes = [c.c_void_p, c.c_char_p, c.c_int,
+                                 c.c_char_p, c.c_int]
+    lib.pt_store_get.restype = c.c_int64
+    lib.pt_store_get.argtypes = [c.c_void_p, c.c_char_p, c.c_int,
+                                 c.POINTER(c.c_void_p)]
+    lib.pt_store_add.restype = c.c_int64
+    lib.pt_store_add.argtypes = [c.c_void_p, c.c_char_p, c.c_int, c.c_int64]
+    lib.pt_store_wait.restype = c.c_int
+    lib.pt_store_wait.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
+    lib.pt_store_check.restype = c.c_int
+    lib.pt_store_check.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
+    lib.pt_store_delete.restype = c.c_int
+    lib.pt_store_delete.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
+    lib.pt_store_num_keys.restype = c.c_int64
+    lib.pt_store_num_keys.argtypes = [c.c_void_p]
+    lib.pt_free.argtypes = [c.c_void_p]
+    # ring
+    lib.pt_ring_create.restype = c.c_void_p
+    lib.pt_ring_create.argtypes = [c.c_char_p, c.c_uint64]
+    lib.pt_ring_attach.restype = c.c_void_p
+    lib.pt_ring_attach.argtypes = [c.c_char_p]
+    lib.pt_ring_push.restype = c.c_int
+    lib.pt_ring_push.argtypes = [c.c_void_p, c.c_char_p, c.c_uint64, c.c_int]
+    lib.pt_ring_pop.restype = c.c_int64
+    lib.pt_ring_pop.argtypes = [c.c_void_p, c.POINTER(c.c_void_p), c.c_int]
+    lib.pt_ring_size.restype = c.c_uint64
+    lib.pt_ring_size.argtypes = [c.c_void_p]
+    lib.pt_ring_close.argtypes = [c.c_void_p]
+    lib.pt_ring_free.argtypes = [c.c_void_p]
+    # trace
+    lib.pt_trace_enable.argtypes = [c.c_int]
+    lib.pt_trace_enabled.restype = c.c_int
+    lib.pt_trace_begin.argtypes = [c.c_char_p]
+    lib.pt_trace_instant.argtypes = [c.c_char_p]
+    lib.pt_trace_count.restype = c.c_int64
+    lib.pt_trace_export.restype = c.c_int
+    lib.pt_trace_export.argtypes = [c.c_char_p, c.c_int64]
+    return lib
+
+
+def load():
+    """Return the native library, building it if needed; None on failure."""
+    global _lib
+    if _lib is not None:
+        return _lib if _lib is not False else None
+    with _lib_lock:
+        if _lib is not None:
+            return _lib if _lib is not False else None
+        if _needs_build() and not _build():
+            _lib = False
+            return None
+        try:
+            _lib = _bind(ctypes.CDLL(_SO))
+        except OSError:
+            _lib = False
+            return None
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+class TCPStore:
+    """Rendezvous KV store (API shape of paddle.distributed's TCPStore;
+    reference tcp_store.h:121).  The master rank runs the embedded server."""
+
+    def __init__(self, host: str, port: int, is_master: bool = False,
+                 world_size: int = 1, timeout: float = 300.0):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native core unavailable (g++ build failed)")
+        self._lib = lib
+        self._server = None
+        self.host, self.port = host, int(port)
+        self.world_size = world_size
+        if is_master:
+            out_port = ctypes.c_int(0)
+            self._server = lib.pt_store_server_start(
+                self.port, ctypes.byref(out_port))
+            if not self._server:
+                raise RuntimeError(f"TCPStore: cannot bind port {port}")
+            self.port = out_port.value
+        self._client = lib.pt_store_client_connect(
+            host.encode(), self.port, int(timeout * 1000))
+        if not self._client:
+            if self._server:
+                lib.pt_store_server_stop(self._server)
+            raise RuntimeError(f"TCPStore: cannot connect {host}:{self.port}")
+
+    def set(self, key: str, value) -> None:
+        v = value if isinstance(value, bytes) else str(value).encode()
+        k = key.encode()
+        if self._lib.pt_store_set(self._client, k, len(k), v, len(v)) != 0:
+            raise RuntimeError("TCPStore.set failed")
+
+    def get(self, key: str) -> bytes:
+        k = key.encode()
+        out = ctypes.c_void_p()
+        n = self._lib.pt_store_get(self._client, k, len(k), ctypes.byref(out))
+        if n < 0:
+            raise RuntimeError("TCPStore.get failed")
+        try:
+            return ctypes.string_at(out, n)
+        finally:
+            self._lib.pt_free(out)
+
+    def add(self, key: str, delta: int) -> int:
+        k = key.encode()
+        v = self._lib.pt_store_add(self._client, k, len(k), int(delta))
+        if v == -(2 ** 63):
+            raise RuntimeError("TCPStore.add failed")
+        return v
+
+    def wait(self, keys) -> None:
+        if isinstance(keys, str):
+            keys = [keys]
+        for key in keys:
+            k = key.encode()
+            if self._lib.pt_store_wait(self._client, k, len(k)) != 0:
+                raise RuntimeError(f"TCPStore.wait({key}) failed")
+
+    def check(self, key: str) -> bool:
+        k = key.encode()
+        r = self._lib.pt_store_check(self._client, k, len(k))
+        if r < 0:
+            raise RuntimeError("TCPStore.check failed")
+        return bool(r)
+
+    def delete_key(self, key: str) -> bool:
+        k = key.encode()
+        return self._lib.pt_store_delete(self._client, k, len(k)) > 0
+
+    def num_keys(self) -> int:
+        return self._lib.pt_store_num_keys(self._client)
+
+    def barrier(self, tag: str = "default") -> None:
+        """All world_size participants arrive, then proceed.  Reusable: each
+        call on a tag is a new round (keys are round-scoped)."""
+        rounds = getattr(self, "_barrier_rounds", None)
+        if rounds is None:
+            rounds = self._barrier_rounds = {}
+        r = rounds.get(tag, 0)
+        rounds[tag] = r + 1
+        n = self.add(f"__barrier/{tag}/{r}/arrived", 1)
+        if n >= self.world_size:
+            self.set(f"__barrier/{tag}/{r}/go", b"1")
+        self.wait(f"__barrier/{tag}/{r}/go")
+
+    def close(self) -> None:
+        if getattr(self, "_client", None):
+            self._lib.pt_store_client_close(self._client)
+            self._client = None
+        if getattr(self, "_server", None):
+            self._lib.pt_store_server_stop(self._server)
+            self._server = None
+
+    def __del__(self):  # pragma: no cover - gc timing
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class ShmRing:
+    """Blocking byte-record ring over POSIX shm (create or attach)."""
+
+    def __init__(self, name: str, capacity: int = 0, create: bool = False):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native core unavailable")
+        self._lib = lib
+        self.name = name
+        if create:
+            self._h = lib.pt_ring_create(name.encode(), capacity)
+        else:
+            self._h = lib.pt_ring_attach(name.encode())
+        if not self._h:
+            raise RuntimeError(f"ShmRing: cannot open {name!r}")
+
+    def push(self, data: bytes, timeout: float | None = None) -> None:
+        t = -1 if timeout is None else int(timeout * 1000)
+        rc = self._lib.pt_ring_push(self._h, data, len(data), t)
+        if rc == -1:
+            raise TimeoutError("ShmRing.push timeout")
+        if rc == -2:
+            raise BrokenPipeError("ShmRing closed")
+        if rc == -3:
+            raise ValueError("record larger than ring capacity")
+
+    def pop(self, timeout: float | None = None) -> bytes:
+        t = -1 if timeout is None else int(timeout * 1000)
+        out = ctypes.c_void_p()
+        n = self._lib.pt_ring_pop(self._h, ctypes.byref(out), t)
+        if n == -1:
+            raise TimeoutError("ShmRing.pop timeout")
+        if n == -2:
+            raise EOFError("ShmRing closed and drained")
+        try:
+            return ctypes.string_at(out, n)
+        finally:
+            self._lib.pt_free(out)
+
+    def qsize(self) -> int:
+        return self._lib.pt_ring_size(self._h)
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.pt_ring_close(self._h)
+
+    def free(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.pt_ring_free(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover - gc timing
+        try:
+            self.free()
+        except Exception:
+            pass

@@ -19,6 +19,7 @@ from . import checkpoint
 from .checkpoint import save_state_dict, load_state_dict
 from .fleet.meta_parallel.parallel_wrappers import DataParallel
 from . import pipelining
+from .store import TCPStore, create_or_get_global_tcp_store
 
 __all__ = [
     "env", "get_rank", "get_world_size", "init_parallel_env", "ParallelEnv",
@@ -31,5 +32,6 @@ __all__ = [
     "dtensor_from_local", "dtensor_to_local", "unshard_dtensor",
     "ShardingStage1", "ShardingStage2", "ShardingStage3", "fleet",
     "checkpoint", "save_state_dict", "load_state_dict", "DataParallel",
-    "sharding_constraint", "annotate", "get_placements",
+    "sharding_constraint", "annotate", "get_placements", "TCPStore",
+    "create_or_get_global_tcp_store",
 ]

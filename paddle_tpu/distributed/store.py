@@ -1,0 +1,106 @@
+"""Bootstrap rendezvous store.
+
+Native C++ TCPStore (csrc/tcp_store.cc, reference
+paddle/phi/core/distributed/store/tcp_store.h:121) when the native core is
+available, else an in-process Python fallback with the same API — the
+fallback only supports single-process use (enough for tests and local runs
+where jax.distributed handles real rendezvous).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["TCPStore", "create_or_get_global_tcp_store"]
+
+try:
+    from ..core import TCPStore as _NativeTCPStore
+    from ..core import available as _native_available
+except Exception:  # pragma: no cover
+    _NativeTCPStore = None
+
+    def _native_available():
+        return False
+
+
+class _LocalStore:
+    """Same-process stand-in (API of tcp_store.h) when g++ is unavailable."""
+
+    def __init__(self, host="127.0.0.1", port=0, is_master=True,
+                 world_size=1, timeout=300.0):
+        self.host, self.port = host, port
+        self.world_size = world_size
+        self._kv = {}
+        self._cv = threading.Condition()
+
+    def set(self, key, value):
+        v = value if isinstance(value, bytes) else str(value).encode()
+        with self._cv:
+            self._kv[key] = v
+            self._cv.notify_all()
+
+    def get(self, key):
+        with self._cv:
+            self._cv.wait_for(lambda: key in self._kv)
+            return self._kv[key]
+
+    def add(self, key, delta):
+        with self._cv:
+            cur = int.from_bytes(self._kv.get(key, b"\0" * 8), "little",
+                                 signed=True)
+            cur += delta
+            self._kv[key] = cur.to_bytes(8, "little", signed=True)
+            self._cv.notify_all()
+            return cur
+
+    def wait(self, keys):
+        if isinstance(keys, str):
+            keys = [keys]
+        with self._cv:
+            self._cv.wait_for(lambda: all(k in self._kv for k in keys))
+
+    def check(self, key):
+        with self._cv:
+            return key in self._kv
+
+    def delete_key(self, key):
+        with self._cv:
+            return self._kv.pop(key, None) is not None
+
+    def num_keys(self):
+        with self._cv:
+            return len(self._kv)
+
+    def barrier(self, tag="default"):
+        pass  # single process
+
+    def close(self):
+        pass
+
+
+def TCPStore(host="127.0.0.1", port=0, is_master=False, world_size=1,
+             timeout=300.0):
+    """Factory matching paddle.distributed's TCPStore constructor shape."""
+    if _NativeTCPStore is not None and _native_available():
+        return _NativeTCPStore(host, port, is_master=is_master,
+                               world_size=world_size, timeout=timeout)
+    return _LocalStore(host, port, is_master, world_size, timeout)
+
+
+_global_store = None
+_global_lock = threading.Lock()
+
+
+def create_or_get_global_tcp_store():
+    """reference parallel.py:1134 — one process-wide store."""
+    global _global_store
+    with _global_lock:
+        if _global_store is None:
+            import os
+            host = os.environ.get("PADDLE_MASTER_HOST", "127.0.0.1")
+            port = int(os.environ.get("PADDLE_MASTER_PORT", "0"))
+            rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+            world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+            _global_store = TCPStore(host, port, is_master=(rank == 0),
+                                     world_size=world)
+        return _global_store

@@ -1,9 +1,10 @@
 """DataLoader (reference: python/paddle/io/reader.py:262 +
-dataloader/dataloader_iter.py).  The reference uses multi-process workers
-with a shared-memory mmap ring; here a thread-pool prefetcher feeds a bounded
-queue — on TPU hosts the input pipeline is Python/numpy-bound and device
-transfer is async, so threads + batched numpy conversion give the same
-overlap without pickling overhead.  num_workers>0 selects the threaded path.
+dataloader/dataloader_iter.py).  Like the reference, num_workers>0 with
+use_shared_memory=True runs true multi-process workers over a native
+shared-memory ring (csrc/shm_ring.cc via io/shm_workers.py) so
+decode/augment escapes the GIL; with use_shared_memory=False (or when the
+native core is unavailable) a thread-pool prefetcher feeds a bounded queue,
+which suffices when the pipeline is numpy-bound.
 """
 from __future__ import annotations
 
@@ -49,6 +50,8 @@ class DataLoader:
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
         self.prefetch_factor = max(2, prefetch_factor)
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
@@ -88,7 +91,26 @@ class DataLoader:
             for indices in self.batch_sampler:
                 yield self._fetch(indices)
             return
+        if self.use_shared_memory:
+            from . import shm_workers
+            if shm_workers.available():
+                yield from self._iter_multiprocess()
+                return
         yield from self._iter_threaded()
+
+    def _iter_multiprocess(self):
+        """Reference _DataLoaderIterMultiProcess path: fork workers, samples
+        cross process boundaries via the native shm ring; collate happens in
+        the trainer process (jax arrays must be created post-fork)."""
+        from .shm_workers import ShmWorkerPool
+        pool = ShmWorkerPool(self.dataset, self.num_workers,
+                             worker_init_fn=self.worker_init_fn)
+        try:
+            for samples in pool.run(iter(self.batch_sampler),
+                                    prefetch=self.prefetch_factor):
+                yield self.collate_fn(samples)
+        finally:
+            pool.shutdown()
 
     def _iter_threaded(self):
         """Pipelined fetch: submit up to num_workers*prefetch_factor batches

@@ -1,0 +1,171 @@
+"""Multiprocess DataLoader workers over the native shared-memory ring.
+
+Reference analog: python/paddle/io/dataloader/dataloader_iter.py's
+_DataLoaderIterMultiProcess + the mmap shm channel
+(paddle/phi/core/memory/allocation/mmap_allocator.cc).  Worker processes run
+`dataset[i]` (decode/augment — the Python-bound part) and push pickled
+sample lists into a process-shared shm ring (csrc/shm_ring.cc); the trainer
+process pops, collates, and hands batches to jax.  Workers never touch jax,
+so forking after XLA initialization is safe.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import signal
+
+from ..core.native import ShmRing, available
+
+__all__ = ["ShmWorkerPool", "available"]
+
+_SENTINEL_SEQ = 0xFFFFFFFF
+
+
+def _set_pdeathsig():  # kill worker if the trainer dies
+    try:
+        import ctypes
+        libc = ctypes.CDLL(None)
+        libc.prctl(1, signal.SIGKILL)  # PR_SET_PDEATHSIG
+    except Exception:
+        pass
+
+
+class ShmWorkerPool:
+    """Fork `num_workers` processes; feed them (seq, indices) tasks; collect
+    (seq, samples) results in order."""
+
+    def __init__(self, dataset, num_workers: int, capacity: int = 64 << 20,
+                 worker_init_fn=None):
+        self.dataset = dataset
+        self.num_workers = num_workers
+        uid = f"{os.getpid()}_{id(self):x}"
+        self._task_ring = ShmRing(f"/pt_task_{uid}", capacity=4 << 20,
+                                  create=True)
+        self._res_ring = ShmRing(f"/pt_res_{uid}", capacity=capacity,
+                                 create=True)
+        self._pids = []
+        self._worker_init_fn = worker_init_fn
+        import warnings
+        for wid in range(num_workers):
+            with warnings.catch_warnings():
+                # workers never touch jax; fork-after-XLA-init is safe here
+                warnings.simplefilter("ignore", RuntimeWarning)
+                warnings.simplefilter("ignore", DeprecationWarning)
+                pid = os.fork()
+            if pid == 0:
+                try:
+                    self._worker_main(wid)
+                finally:
+                    os._exit(0)
+            self._pids.append(pid)
+
+    # ------------------------------------------------------------- worker
+    def _worker_main(self, wid: int) -> None:
+        _set_pdeathsig()
+        task_ring = ShmRing(self._task_ring.name)
+        res_ring = ShmRing(self._res_ring.name)
+        if self._worker_init_fn is not None:
+            self._worker_init_fn(wid)
+        while True:
+            try:
+                task = task_ring.pop()
+            except (EOFError, BrokenPipeError):
+                break
+            seq, indices = pickle.loads(task)
+            if seq == _SENTINEL_SEQ:
+                break
+            try:
+                samples = [self.dataset[i] for i in indices]
+                payload = pickle.dumps((seq, samples), protocol=4)
+            except Exception as e:  # surface the error in the parent
+                try:
+                    payload = pickle.dumps((seq, e), protocol=4)
+                except Exception:
+                    payload = pickle.dumps(
+                        (seq, RuntimeError(f"worker {wid}: unpicklable "
+                                           f"exception {type(e).__name__}: "
+                                           f"{e}")), protocol=4)
+            try:
+                res_ring.push(payload)
+            except ValueError:
+                # batch pickles larger than the ring: report, don't vanish
+                res_ring.push(pickle.dumps(
+                    (seq, RuntimeError(
+                        f"worker {wid}: batch of {len(indices)} samples "
+                        f"({len(payload)} bytes pickled) exceeds the shm "
+                        f"ring capacity; lower batch_size or raise the "
+                        f"DataLoader shm capacity")), protocol=4))
+
+    # ------------------------------------------------------------- parent
+    def run(self, batch_indices_iter, prefetch: int = 4):
+        """Yield sample-lists in submission order.  `prefetch` bounds the
+        number of in-flight tasks per worker."""
+        inflight = {}
+        next_submit = 0
+        next_yield = 0
+        done_submitting = False
+        it = iter(batch_indices_iter)
+        reorder = {}
+        max_inflight = max(2, prefetch) * self.num_workers
+
+        def submit_one():
+            nonlocal next_submit, done_submitting
+            if done_submitting:
+                return False
+            try:
+                indices = next(it)
+            except StopIteration:
+                done_submitting = True
+                return False
+            self._task_ring.push(pickle.dumps((next_submit, list(indices)),
+                                              protocol=4))
+            inflight[next_submit] = True
+            next_submit += 1
+            return True
+
+        for _ in range(max_inflight):
+            if not submit_one():
+                break
+        while inflight or reorder:
+            if next_yield in reorder:
+                result = reorder.pop(next_yield)
+            else:
+                seq, result = pickle.loads(self._res_ring.pop(timeout=300))
+                inflight.pop(seq, None)
+                if seq != next_yield:
+                    reorder[seq] = result
+                    continue
+            if isinstance(result, Exception):
+                raise result
+            yield result
+            next_yield += 1
+            submit_one()
+
+    def shutdown(self) -> None:
+        for _ in self._pids:
+            try:
+                self._task_ring.push(pickle.dumps((_SENTINEL_SEQ, []),
+                                                  protocol=4), timeout=1.0)
+            except Exception:
+                pass
+        self._task_ring.close()
+        self._res_ring.close()
+        for pid in self._pids:
+            try:
+                os.waitpid(pid, 0)
+            except ChildProcessError:
+                pass
+        self._task_ring.free()
+        self._res_ring.free()
+        self._pids = []
+
+    def __del__(self):  # pragma: no cover - gc timing
+        try:
+            if self._pids:
+                for pid in self._pids:
+                    try:
+                        os.kill(pid, signal.SIGKILL)
+                    except ProcessLookupError:
+                        pass
+        except Exception:
+            pass

@@ -44,7 +44,8 @@ class TrainStep:
                 optimizer.load_opt_state(opt_state)
                 param_objs = {name: p for name, p in model.named_parameters()}
                 try:
-                    inputs = [Tensor(b, stop_gradient=True) for b in batch]
+                    inputs = jax.tree.map(
+                        lambda a: Tensor(a, stop_gradient=True), list(batch))
                     with tape.enable_grad():
                         loss = loss_fn(model, *inputs)
                         loss.backward()
@@ -74,8 +75,11 @@ class TrainStep:
             bufs["buffers." + name] = b._data
         opt_state = optimizer.opt_state()
         key = _random.split_key()
-        arrays = [b._data if isinstance(b, Tensor) else jnp.asarray(b)
-                  for b in batch]
+        # batch items may be arbitrary pytrees (tuples/dicts from a
+        # DataLoader); Tensors become raw arrays at the leaves
+        arrays = jax.tree.map(
+            lambda b: b._data if isinstance(b, Tensor) else jnp.asarray(b),
+            list(batch), is_leaf=lambda b: isinstance(b, Tensor))
         loss, new_params, new_bufs, new_opt = self._compiled(
             params, bufs, opt_state, key, *arrays)
         # write results back into the live objects

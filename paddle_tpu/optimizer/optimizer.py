@@ -25,6 +25,8 @@ class Optimizer:
             raise ValueError(
                 "parameters is required in dygraph mode (pass model.parameters())")
         self._parameter_list = list(parameters)
+        if not self._parameter_list:
+            raise ValueError("optimizer got an empty parameter list")
         self._lr = learning_rate
         self._lr_scheduler = learning_rate if isinstance(
             learning_rate, LRScheduler) else None

@@ -2,5 +2,6 @@
 scheduler states:89, export_chrome_tracing:227, timer.py Benchmark)."""
 from .profiler import (  # noqa: F401
     Profiler, ProfilerTarget, ProfilerState, RecordEvent, make_scheduler,
-    export_chrome_tracing, load_profiler_result)
+    export_chrome_tracing, load_profiler_result, enable_host_tracing,
+    export_host_trace, host_trace_event_count)
 from .timer import Benchmark, benchmark  # noqa: F401

@@ -14,7 +14,51 @@ import jax
 
 __all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
            "make_scheduler", "export_chrome_tracing",
-           "load_profiler_result"]
+           "load_profiler_result", "enable_host_tracing",
+           "export_host_trace", "host_trace_event_count"]
+
+
+_host_tracing_requested = False
+
+
+def _native():
+    try:
+        from ..core.native import load
+        return load()
+    except Exception:  # pragma: no cover
+        return None
+
+
+def enable_host_tracing(on: bool = True) -> bool:
+    global _host_tracing_requested
+    _host_tracing_requested = bool(on)
+    return _enable_host_tracing_impl(on)
+
+
+def _enable_host_tracing_impl(on: bool) -> bool:
+    """Turn on the native C++ host tracer (csrc/trace.cc — analog of the
+    reference HostTracer, event_tracing.h).  RecordEvent spans are then
+    recorded natively in addition to the jax trace annotation.  Returns
+    whether the native tracer is available."""
+    lib = _native()
+    if lib is None:
+        return False
+    lib.pt_trace_enable(1 if on else 0)
+    return True
+
+
+def export_host_trace(path: str) -> bool:
+    """Write collected host spans as chrome://tracing JSON (analog of
+    chrometracing_logger.cc)."""
+    lib = _native()
+    if lib is None:
+        return False
+    return lib.pt_trace_export(path.encode(), os.getpid()) == 0
+
+
+def host_trace_event_count() -> int:
+    lib = _native()
+    return 0 if lib is None else int(lib.pt_trace_count())
 
 
 class ProfilerTarget(enum.Enum):
@@ -173,12 +217,27 @@ class RecordEvent:
     def __init__(self, name, event_type=None):
         self.name = name
         self._ann = jax.profiler.TraceAnnotation(name)
+        self._pushed = False
 
     def begin(self):
+        # only touch (and possibly build) the native lib if host tracing was
+        # ever requested — keeps the default path free of g++ invocations
+        if _host_tracing_requested:
+            lib = _native()
+            if lib is not None and lib.pt_trace_enabled():
+                lib.pt_trace_begin(self.name.encode())
+                self._pushed = True
         self._ann.__enter__()
 
     def end(self):
         self._ann.__exit__(None, None, None)
+        if self._pushed:
+            # pop regardless of the current enabled state so the native
+            # thread-local span stack stays balanced
+            lib = _native()
+            if lib is not None:
+                lib.pt_trace_end()
+            self._pushed = False
 
     def __enter__(self):
         self.begin()

@@ -1,0 +1,169 @@
+"""Native C++ runtime core: TCPStore, shm ring, host tracer, mp DataLoader.
+
+Reference analogs: tcp_store.h:121 (rendezvous KV), mmap_allocator shm
+channel (DataLoader), event_tracing.h HostTracer.
+"""
+import json
+import os
+import pickle
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core import available
+
+pytestmark = pytest.mark.skipif(not available(),
+                                reason="native core build unavailable")
+
+
+def test_tcp_store_set_get_add():
+    from paddle_tpu.core import TCPStore
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+    try:
+        master.set("k", b"hello")
+        assert master.get("k") == b"hello"
+        assert master.check("k")
+        assert not master.check("missing")
+        assert master.add("ctr", 3) == 3
+        assert master.add("ctr", 4) == 7
+        assert master.num_keys() == 2
+        assert master.delete_key("k")
+        assert not master.check("k")
+    finally:
+        master.close()
+
+
+def test_tcp_store_two_clients_and_blocking_get():
+    from paddle_tpu.core import TCPStore
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=2)
+    try:
+        client = TCPStore("127.0.0.1", master.port, is_master=False,
+                          world_size=2)
+        got = {}
+
+        def getter():
+            got["v"] = client.get("late")  # blocks until set
+
+        t = threading.Thread(target=getter)
+        t.start()
+        import time
+        time.sleep(0.1)
+        assert t.is_alive()  # still blocked
+        master.set("late", b"now")
+        t.join(timeout=5)
+        assert got["v"] == b"now"
+
+        # barrier across the two participants
+        done = []
+
+        def arrive(s):
+            s.barrier("b1")
+            done.append(1)
+
+        ts = [threading.Thread(target=arrive, args=(s,))
+              for s in (master, client)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=5)
+        assert len(done) == 2
+        # barrier must be reusable on the same tag (round-scoped keys)
+        ts = [threading.Thread(target=arrive, args=(s,))
+              for s in (master, client)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=5)
+        assert len(done) == 4
+        client.close()
+    finally:
+        master.close()
+
+
+def test_shm_ring_roundtrip_and_wrap():
+    from paddle_tpu.core import ShmRing
+    ring = ShmRing(f"/pt_test_{os.getpid()}", capacity=1 << 16, create=True)
+    try:
+        # many records larger than capacity in aggregate => exercises wrap
+        recs = [os.urandom(np.random.randint(1, 5000)) for _ in range(200)]
+        out = []
+
+        def consumer():
+            for _ in recs:
+                out.append(ring.pop(timeout=30))
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        for r in recs:
+            ring.push(r, timeout=30)
+        t.join(timeout=30)
+        assert out == recs
+    finally:
+        ring.free()
+
+
+def test_shm_ring_cross_process():
+    from paddle_tpu.core import ShmRing
+    name = f"/pt_xproc_{os.getpid()}"
+    ring = ShmRing(name, capacity=1 << 20, create=True)
+    try:
+        pid = os.fork()
+        if pid == 0:
+            try:
+                child = ShmRing(name)
+                for i in range(50):
+                    child.push(pickle.dumps({"i": i, "a": np.arange(i)}))
+            finally:
+                os._exit(0)
+        for i in range(50):
+            obj = pickle.loads(ring.pop(timeout=30))
+            assert obj["i"] == i
+            np.testing.assert_array_equal(obj["a"], np.arange(i))
+        os.waitpid(pid, 0)
+    finally:
+        ring.free()
+
+
+def test_host_tracer_chrome_export(tmp_path):
+    from paddle_tpu import profiler as prof
+    assert prof.enable_host_tracing(True)
+    with prof.RecordEvent("outer"):
+        with prof.RecordEvent("inner"):
+            np.dot(np.ones((8, 8)), np.ones((8, 8)))
+    prof.enable_host_tracing(False)
+    assert prof.host_trace_event_count() >= 2
+    out = tmp_path / "trace.json"
+    assert prof.export_host_trace(str(out))
+    data = json.loads(out.read_text())
+    names = {e["name"] for e in data["traceEvents"]}
+    assert {"outer", "inner"} <= names
+
+
+def test_dataloader_multiprocess_matches_serial():
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class Squares(Dataset):
+        def __len__(self):
+            return 37
+
+        def __getitem__(self, i):
+            return np.asarray([i * i, i], dtype=np.int64)
+
+    ds = Squares()
+    serial = [np.asarray(b._data) for b in
+              DataLoader(ds, batch_size=5, num_workers=0)]
+    mp = [np.asarray(b._data) for b in
+          DataLoader(ds, batch_size=5, num_workers=2,
+                     use_shared_memory=True)]
+    assert len(serial) == len(mp)
+    for a, b in zip(serial, mp):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_distributed_tcp_store_factory():
+    import paddle_tpu.distributed as dist
+    s = dist.TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+    s.set("x", b"1")
+    assert s.get("x") == b"1"
+    s.close()
