@@ -207,10 +207,22 @@ def loss_fn(params, ids, config: LlamaConfig, mesh: Mesh, n_micro=1,
                           remat)
     h = out.reshape(b, s, -1)
     h = _rms(h, params["norm"], config.rms_norm_eps)
-    logits = (h @ params["head"]).astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, lab[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    # Chunked CE over the sequence dim: never materializes the full
+    # [B,S,V] fp32 logits (the usual OOM at vocab 32k+), and logsumexp's
+    # VJP re-derives softmax from the saved chunk logits instead of
+    # keeping a log_softmax copy.
+    def ce_chunk(args):
+        hc, lc = args
+        logits = (hc @ params["head"]).astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, lc[..., None], axis=-1)[..., 0]
+        return jnp.sum(lse - tgt)
+
+    n_chunks = next(c for c in (8, 7, 6, 5, 4, 3, 2, 1) if s % c == 0)
+    hs = h.reshape(b, n_chunks, s // n_chunks, h.shape[-1]).swapaxes(0, 1)
+    ls = lab.reshape(b, n_chunks, s // n_chunks).swapaxes(0, 1)
+    tot = jnp.sum(jax.lax.map(jax.checkpoint(ce_chunk), (hs, ls)))
+    return tot / (b * s)
 
 
 class AdamWState(NamedTuple):

@@ -96,7 +96,10 @@ def flatten(x, start_axis=0, stop_axis=-1, name=None):
     nd = x.ndim
     start = start_axis % nd if nd else 0
     stop = stop_axis % nd if nd else 0
-    shape = list(x.shape[:start]) + [-1] + list(x.shape[stop + 1:])
+    # static product, not -1: a -1 reshape is undefined when another dim
+    # is 0 (empty batches), while the true shape is always known here
+    mid = int(np.prod(x.shape[start:stop + 1], dtype=np.int64))
+    shape = list(x.shape[:start]) + [mid] + list(x.shape[stop + 1:])
     return jnp.reshape(x, shape)
 
 

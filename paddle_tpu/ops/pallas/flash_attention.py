@@ -1,16 +1,21 @@
-"""Flash attention for TPU.
+"""Flash attention for TPU — Pallas forward AND backward kernels.
 
-Reference: paddle/phi/kernels/gpu/flash_attn_kernel.cu (dynloaded CUDA
-flashattn); layout [batch, seqlen, num_heads, head_dim], causal flag,
-optional dense mask.  Here:
+Reference analog: paddle/phi/kernels/gpu/flash_attn_kernel.cu +
+flash_attn_grad_kernel.cu (dynloaded CUDA flashattn library); layout
+[batch, seqlen, num_heads, head_dim], causal flag, optional dense mask.
 
-  * `sdpa(...)` — public entry, Paddle flash_attention layout/semantics.
-  * On TPU with supported shapes it calls a Pallas blockwise
-    (memory-streaming) kernel; otherwise an XLA path that is already
-    fusion-friendly (one softmax, bf16 matmuls on the MXU).
+TPU formulation: a blockwise streaming kernel pair.
+  * forward: online-softmax over K/V blocks; emits out + per-row
+    log-sum-exp (lse, lane-broadcast to [B,H,S,128] per Mosaic tiling).
+  * backward: flash-style recompute — a dQ kernel streaming K/V blocks
+    and a dK/dV kernel streaming Q blocks, both re-deriving the softmax
+    from the saved lse instead of storing [S,S] probabilities.
+  * wired together with jax.custom_vjp so jax.grad never materializes
+    the quadratic score matrix (the OOM the naive path hits at 2k+ seq).
 
-The XLA fallback is numerically the flash reference: softmax in fp32,
-matmuls in input dtype.
+The XLA fallback (`_xla_sdpa`) keeps full semantics (arbitrary masks,
+dropout) and is numerically the flash reference: fp32 softmax, input
+dtype matmuls.
 """
 from __future__ import annotations
 
@@ -19,6 +24,8 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+NUM_LANES = 128
 
 
 def _xla_sdpa(q, k, v, attn_mask=None, is_causal=False, dropout_p=0.0,
@@ -48,8 +55,9 @@ def _xla_sdpa(q, k, v, attn_mask=None, is_causal=False, dropout_p=0.0,
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     if dropout_p > 0.0 and training:
         from ...framework import random as _random
-        keep = jax.random.bernoulli(key if key is not None else _random.split_key(),
-                                    1.0 - dropout_p, probs.shape)
+        keep = jax.random.bernoulli(
+            key if key is not None else _random.split_key(),
+            1.0 - dropout_p, probs.shape)
         probs = jnp.where(keep, probs / (1.0 - dropout_p),
                           jnp.zeros((), probs.dtype))
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
@@ -62,33 +70,43 @@ def sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
     use_pallas = (
         attn_mask is None and dropout_p == 0.0
         and q.shape[-1] in (64, 128, 256)
-        and q.shape[1] >= 512 and q.shape[1] % 512 == 0
-        and k.shape[1] % 512 == 0
+        and q.shape[1] >= 256 and q.shape[1] % 256 == 0
+        and k.shape[1] % 256 == 0
         and (not is_causal or q.shape[1] == k.shape[1])
         and jax.default_backend() not in ("cpu",))
     if use_pallas:
         try:
-            return _pallas_mha(q, k, v, is_causal)
+            return _pallas_sdpa(q, k, v, is_causal)
         except Exception:
             pass
     return _xla_sdpa(q, k, v, attn_mask=attn_mask, is_causal=is_causal,
                      dropout_p=dropout_p, training=training)
 
 
-# --------------------------------------------------------------------------
-# Pallas blockwise attention kernel (forward); backward falls back to XLA via
-# custom_vjp recomputation (flash-style: recompute probs per block).
-# --------------------------------------------------------------------------
+def _pallas_sdpa(q, k, v, causal):
+    """[B, S, H, D] wrapper: GQA head-repeat + layout transposes live
+    outside the custom_vjp, so their VJPs (sum over repeats / transpose)
+    are handled by jax."""
+    h, hk = q.shape[2], k.shape[2]
+    if hk != h:
+        k = jnp.repeat(k, h // hk, axis=2)
+        v = jnp.repeat(v, h // hk, axis=2)
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out = flash_mha(qt, kt, vt, causal, 1.0 / np.sqrt(q.shape[-1]))
+    return jnp.swapaxes(out, 1, 2)
 
-def _attn_forward_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, block_k,
-                         sm_scale):
+
+# ---------------------------------------------------------------- forward
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, block_k,
+                sm_scale):
     from jax.experimental import pallas as pl
 
-    q = q_ref[...].astype(jnp.float32) * sm_scale          # [bq, d]
+    q = q_ref[...].astype(jnp.float32) * jnp.float32(sm_scale)          # [bq, d]
     bq, d = q.shape
     kv_len = k_ref.shape[0]
     nblk = kv_len // block_k
-
     q_blk = pl.program_id(2)
 
     def body(i, carry):
@@ -97,8 +115,10 @@ def _attn_forward_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, block_k,
         v = v_ref[pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
         s = q @ k.T                                         # [bq, bk]
         if causal:
-            q_ids = q_blk * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-            k_ids = i * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            q_ids = q_blk * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_ids = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
             s = jnp.where(q_ids >= k_ids, s, -jnp.inf)
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
         alpha = jnp.exp(m_prev - m_cur)
@@ -111,45 +131,206 @@ def _attn_forward_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, block_k,
     m0 = jnp.full((bq,), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((bq,), jnp.float32)
     if causal:
-        # only iterate K blocks up to (and including) the diagonal
         upper = ((q_blk + 1) * bq + block_k - 1) // block_k
     else:
         upper = nblk
     acc, m, l = jax.lax.fori_loop(0, upper, body, (acc0, m0, l0))
     o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse = m + jnp.log(l)
+    lse_ref[...] = jnp.broadcast_to(lse[:, None], (bq, NUM_LANES))
 
 
-@functools.partial(jax.jit, static_argnames=("causal",))
-def _pallas_mha(q, k, v, causal):
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
     from jax.experimental import pallas as pl
 
-    b, sq, h, d = q.shape
-    sk = k.shape[1]
-    hk = k.shape[2]
-    if hk != h:
-        k = jnp.repeat(k, h // hk, axis=2)
-        v = jnp.repeat(v, h // hk, axis=2)
-    # [B, S, H, D] -> [B, H, S, D]
-    qt = jnp.swapaxes(q, 1, 2)
-    kt = jnp.swapaxes(k, 1, 2)
-    vt = jnp.swapaxes(v, 1, 2)
+    # jax 0.9.0: Mosaic lowering infinitely recurses under jax_enable_x64
+    # (the framework's global default); trace the kernel in 32-bit mode.
+    with jax.enable_x64(False):
+        return _flash_fwd_x32(q, k, v, causal, sm_scale, block_q, block_k)
 
-    block_q = min(512, sq)
-    block_k = min(512, sk)
-    sm_scale = 1.0 / np.sqrt(d)
 
-    kernel = functools.partial(_attn_forward_kernel, causal=causal,
-                               block_k=block_k, sm_scale=sm_scale)
-    out = pl.pallas_call(
+def _flash_fwd_x32(q, k, v, causal, sm_scale, block_q, block_k):
+    from jax.experimental import pallas as pl
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    kernel = functools.partial(_fwd_kernel, causal=causal, block_k=block_k,
+                               sm_scale=sm_scale)
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b, h, sq // block_q),
         in_specs=[
-            pl.BlockSpec((None, None, block_q, d), lambda b_, h_, i: (b_, h_, i, 0)),
-            pl.BlockSpec((None, None, sk, d), lambda b_, h_, i: (b_, h_, 0, 0)),
-            pl.BlockSpec((None, None, sk, d), lambda b_, h_, i: (b_, h_, 0, 0)),
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((None, None, sk, d),
+                         lambda b_, h_, i: (b_, h_, 0, 0)),
+            pl.BlockSpec((None, None, sk, d),
+                         lambda b_, h_, i: (b_, h_, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((None, None, block_q, d),
-                               lambda b_, h_, i: (b_, h_, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
-    )(qt, kt, vt)
-    return jnp.swapaxes(out, 1, 2)
+        out_specs=[
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((None, None, block_q, NUM_LANES),
+                         lambda b_, h_, i: (b_, h_, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, NUM_LANES), jnp.float32),
+        ],
+    )(q, k, v)
+    return out, lse
+
+
+# --------------------------------------------------------------- backward
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref, *,
+                   causal, block_k, sm_scale):
+    from jax.experimental import pallas as pl
+
+    q = q_ref[...].astype(jnp.float32)                      # [bq, d]
+    do = do_ref[...].astype(jnp.float32)
+    lse = lse_ref[:, 0]                                     # [bq]
+    delta = dl_ref[:, 0]
+    bq, d = q.shape
+    kv_len = k_ref.shape[0]
+    nblk = kv_len // block_k
+    q_blk = pl.program_id(2)
+
+    def body(i, dq):
+        k = k_ref[pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        s = (q @ k.T) * jnp.float32(sm_scale)                            # [bq, bk]
+        if causal:
+            q_ids = q_blk * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_ids = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_ids >= k_ids, s, -jnp.inf)
+        p = jnp.exp(s - lse[:, None])                       # masked -> 0
+        dp = do @ v.T
+        ds = p * (dp - delta[:, None]) * jnp.float32(sm_scale)
+        return dq + ds @ k
+
+    upper = ((q_blk + 1) * bq + block_k - 1) // block_k if causal else nblk
+    dq = jax.lax.fori_loop(0, upper, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[...] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dk_ref,
+                    dv_ref, *, causal, block_q, sm_scale):
+    from jax.experimental import pallas as pl
+
+    k = k_ref[...].astype(jnp.float32)                      # [bk, d]
+    v = v_ref[...].astype(jnp.float32)
+    bk, d = k.shape
+    q_len = q_ref.shape[0]
+    nblk = q_len // block_q
+    k_blk = pl.program_id(2)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.dslice(i * block_q, block_q), 0]
+        delta = dl_ref[pl.dslice(i * block_q, block_q), 0]
+        s = (q @ k.T) * jnp.float32(sm_scale)                            # [bq, bk]
+        if causal:
+            q_ids = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0)
+            k_ids = k_blk * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 1)
+            s = jnp.where(q_ids >= k_ids, s, -jnp.inf)
+        p = jnp.exp(s - lse[:, None])
+        dv = dv + p.T @ do
+        dp = do @ v.T
+        ds = p * (dp - delta[:, None]) * jnp.float32(sm_scale)
+        dk = dk + ds.T @ q
+        return dk, dv
+
+    lower = (k_blk * bk) // block_q if causal else 0
+    dk, dv = jax.lax.fori_loop(
+        lower, nblk, body,
+        (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)))
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k):
+    with jax.enable_x64(False):   # see _flash_fwd
+        return _flash_bwd_x32(q, k, v, out, lse, g, causal, sm_scale,
+                              block_q, block_k)
+
+
+def _flash_bwd_x32(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k):
+    from jax.experimental import pallas as pl
+
+    b, h, sq, d = q.shape
+    if lse.ndim == 3:   # residual stored un-broadcast ([B,H,S])
+        lse = jnp.broadcast_to(lse[..., None], (b, h, sq, NUM_LANES))
+    sk = k.shape[2]
+    delta = jnp.sum(out.astype(jnp.float32) * g.astype(jnp.float32),
+                    axis=-1)                                 # [B, H, Sq]
+    delta = jnp.broadcast_to(delta[..., None], (b, h, sq, NUM_LANES))
+
+    full = lambda s: pl.BlockSpec((None, None, s, d),
+                                  lambda b_, h_, i: (b_, h_, 0, 0))
+    full_l = pl.BlockSpec((None, None, sq, NUM_LANES),
+                          lambda b_, h_, i: (b_, h_, 0, 0))
+    blk_q = lambda: pl.BlockSpec((None, None, block_q, d),
+                                 lambda b_, h_, i: (b_, h_, i, 0))
+    blk_l = pl.BlockSpec((None, None, block_q, NUM_LANES),
+                         lambda b_, h_, i: (b_, h_, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal, block_k=block_k,
+                          sm_scale=sm_scale),
+        grid=(b, h, sq // block_q),
+        in_specs=[blk_q(), full(sk), full(sk), blk_q(), blk_l, blk_l],
+        out_specs=blk_q(),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+    )(q, k, v, g, lse, delta)
+
+    blk_k = lambda: pl.BlockSpec((None, None, block_k, d),
+                                 lambda b_, h_, i: (b_, h_, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, causal=causal, block_q=block_q,
+                          sm_scale=sm_scale),
+        grid=(b, h, sk // block_k),
+        in_specs=[full(sq), blk_k(), blk_k(), full(sq), full_l, full_l],
+        out_specs=[blk_k(), blk_k()],
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------- custom_vjp
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_mha(q, k, v, causal, sm_scale):
+    """[B, H, S, D] flash attention; differentiable, O(S) memory."""
+    out, _ = _flash_fwd(q, k, v, causal, sm_scale,
+                        *_block_sizes(q.shape[2], k.shape[2]))
+    return out
+
+
+def _block_sizes(sq, sk):
+    bq = 512 if sq % 512 == 0 else 256
+    bk = 512 if sk % 512 == 0 else 256
+    return min(bq, sq), min(bk, sk)
+
+
+def _flash_mha_fwd(q, k, v, causal, sm_scale):
+    out, lse = _flash_fwd(q, k, v, causal, sm_scale,
+                          *_block_sizes(q.shape[2], k.shape[2]))
+    # the lane broadcast is a Mosaic tiling artifact; keep 1/128 of it
+    # as the residual and re-broadcast in the backward wrapper
+    return out, (q, k, v, out, lse[..., 0])
+
+
+def _flash_mha_bwd(causal, sm_scale, res, g):
+    q, k, v, out, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, out, lse, g, causal, sm_scale,
+                            *_block_sizes(q.shape[2], k.shape[2]))
+    return dq, dk, dv
+
+
+flash_mha.defvjp(_flash_mha_fwd, _flash_mha_bwd)

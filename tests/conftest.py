@@ -14,8 +14,11 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
 
-import jax  # noqa: E402
+# PADDLE_TPU_TEST_TPU=1 keeps the real TPU visible (used to exercise the
+# pallas kernels, e.g. tests/test_flash_attention_tpu.py).
+if not os.environ.get("PADDLE_TPU_TEST_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
 
-jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,67 @@
+"""Pallas flash-attention fwd+bwd vs the XLA reference path.
+
+Runs only on a real TPU (the CPU-forced suite exercises `_xla_sdpa`);
+mirrors the reference's flash_attn vs naive-attention parity tests
+(test/legacy_test/test_flash_attention.py).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas import flash_attention as F
+
+tpu_only = pytest.mark.skipif(
+    jax.default_backend() in ("cpu",), reason="needs TPU for pallas")
+
+
+@tpu_only
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_matches_xla(dtype, causal):
+    rng = np.random.default_rng(0)
+    B, S, H, D = 2, 512, 4, 64
+    q = jnp.asarray(rng.standard_normal((B, S, H, D)), dtype)
+    k = jnp.asarray(rng.standard_normal((B, S, H, D)), dtype)
+    v = jnp.asarray(rng.standard_normal((B, S, H, D)), dtype)
+
+    out = F._pallas_sdpa(q, k, v, causal)
+    ref = F._xla_sdpa(q, k, v, is_causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        atol=5e-2 if dtype == jnp.bfloat16 else 5e-3, rtol=2e-2)
+
+    def lp(q, k, v):
+        return jnp.sum(F._pallas_sdpa(q, k, v, causal).astype(jnp.float32)
+                       ** 2)
+
+    def lr(q, k, v):
+        return jnp.sum(F._xla_sdpa(q, k, v, is_causal=causal).astype(
+            jnp.float32) ** 2)
+
+    gp = jax.grad(lp, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lr, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gr):
+        a = np.asarray(a, np.float32)
+        b = np.asarray(b, np.float32)
+        denom = np.maximum(np.abs(b).max(), 1.0)
+        assert np.abs(a - b).max() / denom < 2e-2
+
+
+@tpu_only
+def test_flash_gqa():
+    rng = np.random.default_rng(1)
+    B, S, H, HK, D = 2, 512, 8, 2, 64
+    q = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, S, HK, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, S, HK, D)), jnp.float32)
+    out = F._pallas_sdpa(q, k, v, True)
+    ref = F._xla_sdpa(q, k, v, is_causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=5e-3, rtol=2e-2)
+    gp = jax.grad(lambda k: jnp.sum(F._pallas_sdpa(q, k, v, True) ** 2))(k)
+    gr = jax.grad(lambda k: jnp.sum(F._xla_sdpa(q, k, v, is_causal=True)
+                                    ** 2))(k)
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(gr),
+                               atol=1e-2 * float(np.abs(gr).max()) + 1e-4)
