@@ -64,6 +64,45 @@ def _xla_sdpa(q, k, v, attn_mask=None, is_causal=False, dropout_p=0.0,
     return jnp.swapaxes(out, 1, 2)
 
 
+_PALLAS_OK = None   # lazily probed once per process
+
+
+def _probe_pallas():
+    """Compile+run a tiny fwd AND grad once. The bwd kernels are traced
+    outside any caller's try (when the cotangent is pulled back at
+    jit-compile time), so a bwd lowering failure would otherwise crash
+    training instead of falling back to the XLA path."""
+    global _PALLAS_OK
+    if _PALLAS_OK is None:
+        # run in a fresh thread: jax trace state is thread-local, so the
+        # probe stays eager (and catchable) even when sdpa is reached
+        # while tracing a caller's jit
+        import threading
+
+        def run():
+            global _PALLAS_OK
+            try:
+                z = jnp.zeros((1, 256, 1, 64), jnp.bfloat16)
+                # grad wrt q, k AND v so none of the three bwd kernels
+                # is dead code the jaxpr DCE could skip lowering for
+                jax.jit(jax.grad(
+                    lambda q, k, v: jnp.sum(_pallas_sdpa(q, k, v, True)
+                                            .astype(jnp.float32)),
+                    argnums=(0, 1, 2)))(z, z, z)[0].block_until_ready()
+                # the no-grad path uses the separate need_lse=False
+                # forward variant; compile that too
+                jax.jit(lambda q: _pallas_sdpa(q, z, z, True))(
+                    z).block_until_ready()
+                _PALLAS_OK = True
+            except Exception:
+                _PALLAS_OK = False
+
+        t = threading.Thread(target=run)
+        t.start()
+        t.join()
+    return _PALLAS_OK
+
+
 def sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
          training=True):
     """Paddle-layout scaled-dot-product attention: [B, S, H, D] in/out."""
@@ -73,7 +112,8 @@ def sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
         and q.shape[1] >= 256 and q.shape[1] % 256 == 0
         and k.shape[1] % 256 == 0
         and (not is_causal or q.shape[1] == k.shape[1])
-        and jax.default_backend() not in ("cpu",))
+        and jax.default_backend() not in ("cpu",)
+        and _probe_pallas())
     if use_pallas:
         try:
             return _pallas_sdpa(q, k, v, is_causal)
@@ -101,6 +141,7 @@ def _pallas_sdpa(q, k, v, causal):
 # ---------------------------------------------------------------- forward
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, block_k,
                 sm_scale):
+    # lse_ref is None for the inference-only variant (no residual needed)
     from jax.experimental import pallas as pl
 
     q = q_ref[...].astype(jnp.float32) * jnp.float32(sm_scale)          # [bq, d]
@@ -136,49 +177,52 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, block_k,
         upper = nblk
     acc, m, l = jax.lax.fori_loop(0, upper, body, (acc0, m0, l0))
     o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse = m + jnp.log(l)
-    lse_ref[...] = jnp.broadcast_to(lse[:, None], (bq, NUM_LANES))
+    if lse_ref is not None:
+        lse = m + jnp.log(l)
+        lse_ref[...] = jnp.broadcast_to(lse[:, None], (bq, NUM_LANES))
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
-    from jax.experimental import pallas as pl
-
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k,
+               need_lse=True):
     # jax 0.9.0: Mosaic lowering infinitely recurses under jax_enable_x64
     # (the framework's global default); trace the kernel in 32-bit mode.
     with jax.enable_x64(False):
-        return _flash_fwd_x32(q, k, v, causal, sm_scale, block_q, block_k)
+        return _flash_fwd_x32(q, k, v, causal, sm_scale, block_q, block_k,
+                              need_lse)
 
 
-def _flash_fwd_x32(q, k, v, causal, sm_scale, block_q, block_k):
+def _flash_fwd_x32(q, k, v, causal, sm_scale, block_q, block_k, need_lse):
     from jax.experimental import pallas as pl
 
     b, h, sq, d = q.shape
     sk = k.shape[2]
+    blk = pl.BlockSpec((None, None, block_q, d),
+                       lambda b_, h_, i: (b_, h_, i, 0))
+    out_specs = [blk]
+    out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
+    if need_lse:
+        out_specs.append(pl.BlockSpec((None, None, block_q, NUM_LANES),
+                                      lambda b_, h_, i: (b_, h_, i, 0)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((b, h, sq, NUM_LANES), jnp.float32))
     kernel = functools.partial(_fwd_kernel, causal=causal, block_k=block_k,
                                sm_scale=sm_scale)
-    out, lse = pl.pallas_call(
-        kernel,
+    res = pl.pallas_call(
+        kernel if need_lse else
+        (lambda q_ref, k_ref, v_ref, o_ref: kernel(q_ref, k_ref, v_ref,
+                                                   o_ref, None)),
         grid=(b, h, sq // block_q),
         in_specs=[
-            pl.BlockSpec((None, None, block_q, d),
-                         lambda b_, h_, i: (b_, h_, i, 0)),
+            blk,
             pl.BlockSpec((None, None, sk, d),
                          lambda b_, h_, i: (b_, h_, 0, 0)),
             pl.BlockSpec((None, None, sk, d),
                          lambda b_, h_, i: (b_, h_, 0, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((None, None, block_q, d),
-                         lambda b_, h_, i: (b_, h_, i, 0)),
-            pl.BlockSpec((None, None, block_q, NUM_LANES),
-                         lambda b_, h_, i: (b_, h_, i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((b, h, sq, NUM_LANES), jnp.float32),
-        ],
+        out_specs=out_specs if need_lse else out_specs[0],
+        out_shape=out_shape if need_lse else out_shape[0],
     )(q, k, v)
-    return out, lse
+    return res if need_lse else (res, None)
 
 
 # --------------------------------------------------------------- backward
@@ -264,8 +308,8 @@ def _flash_bwd_x32(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k):
     from jax.experimental import pallas as pl
 
     b, h, sq, d = q.shape
-    if lse.ndim == 3:   # residual stored un-broadcast ([B,H,S])
-        lse = jnp.broadcast_to(lse[..., None], (b, h, sq, NUM_LANES))
+    # the residual is stored un-broadcast ([B,H,S]); restore kernel tiling
+    lse = jnp.broadcast_to(lse[..., None], (b, h, sq, NUM_LANES))
     sk = k.shape[2]
     delta = jnp.sum(out.astype(jnp.float32) * g.astype(jnp.float32),
                     axis=-1)                                 # [B, H, Sq]
@@ -308,7 +352,8 @@ def _flash_bwd_x32(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k):
 def flash_mha(q, k, v, causal, sm_scale):
     """[B, H, S, D] flash attention; differentiable, O(S) memory."""
     out, _ = _flash_fwd(q, k, v, causal, sm_scale,
-                        *_block_sizes(q.shape[2], k.shape[2]))
+                        *_block_sizes(q.shape[2], k.shape[2]),
+                        need_lse=False)   # no-grad path: skip the residual
     return out
 
 
