@@ -105,6 +105,8 @@ from . import distribution  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401
 from . import sparse  # noqa: F401
+from . import static  # noqa: F401
+from . import inference  # noqa: F401
 
 __version__ = "0.1.0"
 
@@ -119,8 +121,10 @@ def disable_static(place=None):
 
 
 def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu has no legacy static-graph mode; use paddle_tpu.jit.to_static")
+    """Switch to static-graph building (paddle.static.*); ops applied to
+    static Variables record a Program DAG instead of executing."""
+    global _static_mode
+    _static_mode = True
 
 
 def in_dynamic_mode():

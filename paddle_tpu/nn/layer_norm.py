@@ -44,9 +44,19 @@ class _BatchNormBase(Layer):
             training=self.training, momentum=self._momentum,
             epsilon=self._epsilon, data_format=self._data_format,
             use_global_stats=self._use_global_stats)
+        from ..framework.tensor import Tensor
         if self.training and not self._use_global_stats:
-            self._mean._rebind_(new_mean.detach())
-            self._variance._rebind_(new_var.detach())
+            if isinstance(new_mean, Tensor):
+                self._mean._rebind_(new_mean.detach())
+                self._variance._rebind_(new_var.detach())
+            else:
+                # static build: record the running-stat write-back so the
+                # Executor applies it after each run (reference: the
+                # stat-update ops static batch_norm appends in-graph)
+                prog = new_mean.program
+                prog.stat_updates.append((self._mean, new_mean))
+                prog.stat_updates.append((self._variance, new_var))
+                prog.version += 1
         return out
 
     def extra_repr(self):

@@ -1,0 +1,455 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU (+ cells, RNN/BiRNN wrappers).
+
+Reference: python/paddle/nn/layer/rnn.py (SimpleRNNCell/LSTMCell/GRUCell,
+RNN :56, BiRNN, SimpleRNN/LSTM/GRU multi-layer stacks) with Paddle's
+parameter layout (weight_ih [gate_size, input_size], weight_hh
+[gate_size, hidden_size], gate order i,f,c,o for LSTM and r,z,c for GRU)
+and `sequence_length` masking semantics.
+
+TPU formulation: each full time-loop is ONE op — a `jax.lax.scan` over
+the (static-shape) time axis, so XLA compiles a single fused loop body
+instead of Python-unrolled steps; masking for variable-length sequences
+is a `where` against the carried step index (no dynamic shapes).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import functional as F
+from .layer import Layer
+from ..framework.tensor import Tensor
+from ..ops.registry import op
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
+           "SimpleRNN", "LSTM", "GRU"]
+
+
+# ----------------------------------------------------------- pure scan ops
+def _mask_step(t, seq_len, new, old):
+    """new where t < seq_len (per batch row) else old."""
+    if seq_len is None:
+        return new
+    m = (t < seq_len)[:, None]
+    return jnp.where(m, new, old)
+
+
+def _scan_rnn(step, x, init, seq_len, reverse):
+    """x: [T, B, I] time-major. step(carry, xt, t) -> (carry, yt)."""
+    T = x.shape[0]
+    ts = jnp.arange(T)
+    if reverse:
+        x = x[::-1]
+        ts = ts[::-1]
+
+    def body(carry, xt_t):
+        xt, t = xt_t
+        return step(carry, xt, t)
+
+    carry, ys = jax.lax.scan(body, init, (x, ts))
+    if reverse:
+        ys = ys[::-1]
+    return carry, ys
+
+
+@op
+def simple_rnn_scan(x, h0, w_ih, w_hh, b_ih, b_hh, seq_len=None,
+                    reverse=False, activation="tanh"):
+    act = jnp.tanh if activation == "tanh" else jax.nn.relu
+
+    def step(h, xt, t):
+        hn = act(xt @ w_ih.T + b_ih + h @ w_hh.T + b_hh)
+        hn = _mask_step(t, seq_len, hn, h)
+        y = _mask_step(t, seq_len, hn, jnp.zeros_like(hn))
+        return hn, y
+
+    h, ys = _scan_rnn(step, x, h0, seq_len, reverse)
+    return ys, h
+
+
+@op
+def lstm_scan(x, h0, c0, w_ih, w_hh, b_ih, b_hh, seq_len=None,
+              reverse=False):
+    def step(carry, xt, t):
+        h, c = carry
+        gates = xt @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        cn = f * c + i * g
+        hn = o * jnp.tanh(cn)
+        hn = _mask_step(t, seq_len, hn, h)
+        cn = _mask_step(t, seq_len, cn, c)
+        y = _mask_step(t, seq_len, hn, jnp.zeros_like(hn))
+        return (hn, cn), y
+
+    (h, c), ys = _scan_rnn(step, x, (h0, c0), seq_len, reverse)
+    return ys, h, c
+
+
+@op
+def gru_scan(x, h0, w_ih, w_hh, b_ih, b_hh, seq_len=None, reverse=False):
+    def step(h, xt, t):
+        xg = xt @ w_ih.T + b_ih
+        hg = h @ w_hh.T + b_hh
+        xr, xz, xc = jnp.split(xg, 3, axis=-1)
+        hr, hz, hc = jnp.split(hg, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        c = jnp.tanh(xc + r * hc)
+        hn = z * h + (1.0 - z) * c
+        hn = _mask_step(t, seq_len, hn, h)
+        y = _mask_step(t, seq_len, hn, jnp.zeros_like(hn))
+        return hn, y
+
+    h, ys = _scan_rnn(step, x, h0, seq_len, reverse)
+    return ys, h
+
+
+# ------------------------------------------------------------------ cells
+class RNNCellBase(Layer):
+    """Reference: python/paddle/nn/layer/rnn.py RNNCellBase (state init)."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        state_shape = self.state_shape
+        if isinstance(state_shape[0], (list, tuple)):
+            return tuple(
+                Tensor(jnp.full((batch,) + tuple(s), init_value,
+                                self._param_dtype()))
+                for s in state_shape)
+        return Tensor(jnp.full((batch,) + tuple(state_shape), init_value,
+                               self._param_dtype()))
+
+    def _param_dtype(self):
+        return self.weight_ih._data.dtype
+
+    def _make_params(self, gate_size, input_size, hidden_size, weight_ih_attr,
+                     weight_hh_attr, bias_ih_attr, bias_hh_attr):
+        from .initializer import Uniform
+        std = 1.0 / np.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [gate_size, input_size], attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [gate_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [gate_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [gate_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        if activation not in ("tanh", "relu"):
+            raise ValueError("activation must be tanh or relu")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        self._make_params(hidden_size, input_size, hidden_size,
+                          weight_ih_attr, weight_hh_attr, bias_ih_attr,
+                          bias_hh_attr)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = F.tanh if self.activation == "tanh" else F.relu
+        h = act(F.linear(inputs, self.weight_ih.t(), self.bias_ih)
+                + F.linear(states, self.weight_hh.t(), self.bias_hh))
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self._make_params(4 * hidden_size, input_size, hidden_size,
+                          weight_ih_attr, weight_hh_attr, bias_ih_attr,
+                          bias_hh_attr)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+        out = _lstm_cell_step(inputs, h, c, self.weight_ih, self.weight_hh,
+                              self.bias_ih, self.bias_hh)
+        hn, cn = out
+        return hn, (hn, cn)
+
+
+@op
+def _lstm_cell_step(x, h, c, w_ih, w_hh, b_ih, b_hh):
+    gates = x @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    cn = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    hn = jax.nn.sigmoid(o) * jnp.tanh(cn)
+    return hn, cn
+
+
+@op
+def _gru_cell_step(x, h, w_ih, w_hh, b_ih, b_hh):
+    xr, xz, xc = jnp.split(x @ w_ih.T + b_ih, 3, axis=-1)
+    hr, hz, hc = jnp.split(h @ w_hh.T + b_hh, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    c = jnp.tanh(xc + r * hc)
+    return z * h + (1.0 - z) * c
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self._make_params(3 * hidden_size, input_size, hidden_size,
+                          weight_ih_attr, weight_hh_attr, bias_ih_attr,
+                          bias_hh_attr)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h = _gru_cell_step(inputs, states, self.weight_ih, self.weight_hh,
+                           self.bias_ih, self.bias_hh)
+        return h, h
+
+
+# --------------------------------------------------------------- wrappers
+class RNN(Layer):
+    """Run a cell over a sequence (reference rnn.py:56). Python time loop
+    (arbitrary user cells can't be scanned); the SimpleRNN/LSTM/GRU stacks
+    below use the fused lax.scan ops instead."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        from ..ops.manipulation import stack
+
+        def map_states(fn, new, old):
+            if isinstance(new, (tuple, list)):
+                return type(new)(
+                    map_states(fn, n, o) for n, o in zip(new, old))
+            return fn(new, old)
+
+        time_axis = 0 if self.time_major else 1
+        T = inputs.shape[time_axis]
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        states = initial_states
+        if states is None:
+            ref = inputs if self.time_major else inputs.transpose(
+                [1, 0] + list(range(2, inputs.ndim)))
+            states = self.cell.get_initial_states(ref, batch_dim_idx=1)
+        outs = [None] * T
+        for t in steps:
+            xt = inputs[t] if self.time_major else inputs[:, t]
+            y, new_states = self.cell(xt, states, **kwargs)
+            if sequence_length is not None:
+                # padded steps: keep prior state, emit zeros (reference
+                # rnn.py mask_fn semantics)
+                mask = (sequence_length > t).astype(y.dtype).unsqueeze(-1)
+                y = y * mask
+                states = map_states(
+                    lambda n, o: n * mask + o * (1.0 - mask),
+                    new_states, states)
+            else:
+                states = new_states
+            outs[t] = y
+        outputs = stack(outs, axis=time_axis)
+        return outputs, states
+
+
+class BiRNN(Layer):
+    """Reference rnn.py BiRNN: forward + backward cells, concat outputs."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        if initial_states is None:
+            fw0 = bw0 = None
+        else:
+            fw0, bw0 = initial_states
+        out_fw, st_fw = self.rnn_fw(inputs, fw0, sequence_length, **kwargs)
+        out_bw, st_bw = self.rnn_bw(inputs, bw0, sequence_length, **kwargs)
+        from ..ops.manipulation import concat
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    """Multi-layer (optionally bidirectional) stack over the fused scan
+    ops. Parameter names follow the reference convention
+    (weight_ih_l{k}[_reverse], ...) so state_dicts line up."""
+
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None, mode=None):
+        super().__init__()
+        if mode is not None:
+            self.MODE = mode    # instance override (SimpleRNN relu)
+        if direction in ("forward",):
+            self.num_directions = 1
+        elif direction in ("bidirect", "bidirectional"):
+            self.num_directions = 2
+        else:
+            raise ValueError(f"unknown direction {direction!r}")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+
+        if self.MODE == "LSTM":
+            g = 4
+        elif self.MODE == "GRU":
+            g = 3
+        else:
+            g = 1
+        from .initializer import Uniform
+        std = 1.0 / np.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        for layer in range(num_layers):
+            for d in range(self.num_directions):
+                isz = input_size if layer == 0 else \
+                    hidden_size * self.num_directions
+                sfx = f"l{layer}" + ("_reverse" if d == 1 else "")
+                for pname, shape, attr, is_bias in (
+                        (f"weight_ih_{sfx}", [g * hidden_size, isz],
+                         weight_ih_attr, False),
+                        (f"weight_hh_{sfx}", [g * hidden_size, hidden_size],
+                         weight_hh_attr, False),
+                        (f"bias_ih_{sfx}", [g * hidden_size], bias_ih_attr,
+                         True),
+                        (f"bias_hh_{sfx}", [g * hidden_size], bias_hh_attr,
+                         True)):
+                    p = self.create_parameter(shape, attr=attr,
+                                              is_bias=is_bias,
+                                              default_initializer=init)
+                    setattr(self, pname, p)
+
+    def _scan_one(self, x, h0, params, seq_len, reverse):
+        w_ih, w_hh, b_ih, b_hh = params
+        if self.MODE == "LSTM":
+            h0, c0 = h0
+            ys, h, c = lstm_scan(x, h0, c0, w_ih, w_hh, b_ih, b_hh,
+                                 seq_len=seq_len, reverse=reverse)
+            return ys, (h, c)
+        if self.MODE == "GRU":
+            ys, h = gru_scan(x, h0, w_ih, w_hh, b_ih, b_hh,
+                             seq_len=seq_len, reverse=reverse)
+            return ys, h
+        ys, h = simple_rnn_scan(
+            x, h0, w_ih, w_hh, b_ih, b_hh, seq_len=seq_len, reverse=reverse,
+            activation="tanh" if self.MODE == "RNN_TANH" else "relu")
+        return ys, h
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..ops.manipulation import transpose as _transpose
+        x = inputs
+        if not self.time_major:
+            x = _transpose(x, [1, 0, 2])        # -> [T, B, I]
+        T, B = x.shape[0], x.shape[1]
+        L, D, H = self.num_layers, self.num_directions, self.hidden_size
+
+        is_lstm = self.MODE == "LSTM"
+        if initial_states is None:
+            z = Tensor(jnp.zeros((L * D, B, H), self.weight_ih_l0._data.dtype))
+            initial_states = (z, z) if is_lstm else z
+
+        seq_len = sequence_length
+        final_h, final_c = [], []
+        out = x
+        for layer in range(L):
+            layer_outs = []
+            for d in range(D):
+                sfx = f"l{layer}" + ("_reverse" if d == 1 else "")
+                params = tuple(getattr(self, f"{n}_{sfx}") for n in
+                               ("weight_ih", "weight_hh", "bias_ih",
+                                "bias_hh"))
+                idx = layer * D + d
+                if is_lstm:
+                    h0 = (initial_states[0][idx], initial_states[1][idx])
+                else:
+                    h0 = initial_states[idx]
+                ys, st = self._scan_one(out, h0, params, seq_len, d == 1)
+                layer_outs.append(ys)
+                if is_lstm:
+                    final_h.append(st[0])
+                    final_c.append(st[1])
+                else:
+                    final_h.append(st)
+            if D == 2:
+                from ..ops.manipulation import concat
+                out = concat(layer_outs, axis=-1)
+            else:
+                out = layer_outs[0]
+            if self.dropout > 0.0 and layer < L - 1 and self.training:
+                out = F.dropout(out, p=self.dropout, training=True)
+        from ..ops.manipulation import stack
+        h_stack = stack(final_h, axis=0)
+        if not self.time_major:
+            out = _transpose(out, [1, 0, 2])
+        if is_lstm:
+            return out, (h_stack, stack(final_c, axis=0))
+        return out, h_stack
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        super().__init__(
+            input_size, hidden_size, num_layers, direction, time_major,
+            dropout, mode="RNN_RELU" if activation == "relu" else "RNN_TANH",
+            **kwargs)
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"

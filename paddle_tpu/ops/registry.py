@@ -69,6 +69,18 @@ def apply_op(opname, body, args, kwargs):
     from ..framework.tensor import Tensor
     from ..amp.auto_cast import maybe_amp_cast
 
+    # static-graph build: a symbolic Variable flowing in means "record,
+    # don't execute" (the analog of appending a pd_op to a pir::Block;
+    # see static/graph.py).  _ever_static keeps this scan off the hot
+    # eager dispatch path in pure-dygraph processes.
+    from ..static import graph as _sgraph
+    if _sgraph._ever_static:
+        flat0, _ = tree_flatten((args, kwargs),
+                                is_leaf=lambda x: isinstance(
+                                    x, _sgraph.Variable))
+        if any(isinstance(x, _sgraph.Variable) for x in flat0):
+            return _sgraph.build_node(opname, body, args, kwargs)
+
     args, kwargs = maybe_amp_cast(opname, args, kwargs)
 
     flat, treedef = tree_flatten((args, kwargs), is_leaf=_is_tensor)

@@ -21,11 +21,14 @@ class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, multi_precision=True, name=None):
         from .lr import LRScheduler
+        import paddle_tpu
+        if parameters is None and not paddle_tpu.in_dynamic_mode():
+            parameters = []       # static mode: filled by minimize()
         if parameters is None:
             raise ValueError(
                 "parameters is required in dygraph mode (pass model.parameters())")
         self._parameter_list = list(parameters)
-        if not self._parameter_list:
+        if not self._parameter_list and paddle_tpu.in_dynamic_mode():
             raise ValueError("optimizer got an empty parameter list")
         self._lr = learning_rate
         self._lr_scheduler = learning_rate if isinstance(
@@ -115,6 +118,17 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..static import graph as _sgraph
+        if isinstance(loss, _sgraph.Variable):
+            # static build: record a train op; Executor.run computes the
+            # grads and calls step() (reference: appended optimizer ops)
+            prog = loss.program
+            prog.train_ops.append((self, loss))
+            prog.version += 1
+            if not self._parameter_list:
+                self._parameter_list = [
+                    p for p in prog.all_parameters() if not p.stop_gradient]
+            return None, None
         loss.backward()
         self.step()
         self.clear_grad()

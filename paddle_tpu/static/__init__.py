@@ -1,0 +1,178 @@
+"""paddle.static compat surface.
+
+Reference: python/paddle/static/ (Program/Executor/data/nn, 24.9k LoC).
+The TPU rebuild keeps the API shape; the execution substrate is the jax
+DAG recorder in graph.py + jit compile in executor.py (SURVEY §8: PIR +
+StandaloneExecutor collapse into jaxpr + XLA executable).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .graph import (  # noqa: F401
+    Program, Variable, program_guard, default_main_program,
+    default_startup_program, data)
+from .executor import Executor, scope_guard, global_scope  # noqa: F401
+from ..jit.api import InputSpec  # noqa: F401
+from . import nn  # noqa: F401
+
+__all__ = ["Program", "Variable", "program_guard", "default_main_program",
+           "default_startup_program", "data", "Executor", "scope_guard",
+           "global_scope", "InputSpec", "nn", "name_scope",
+           "save_inference_model", "load_inference_model", "cpu_places",
+           "device_guard"]
+
+
+class name_scope:
+    def __init__(self, prefix=None):
+        self.prefix = prefix
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *e):
+        return False
+
+
+def cpu_places(device_count=None):
+    import jax
+    n = device_count or len(jax.devices())
+    return list(range(n))
+
+
+class device_guard:
+    def __init__(self, device=None):
+        self.device = device
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *e):
+        return False
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         program=None, **kwargs):
+    """Serialize the fetched DAG slice for deployment (reference:
+    python/paddle/static/io.py save_inference_model -> .pdmodel/.pdiparams;
+    here a pickled DAG + .npz params, executable by load_inference_model)."""
+    import pickle
+
+    program = program or default_main_program()
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) else \
+        [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) else \
+        [fetch_vars]
+    params = program.all_parameters()
+    pmap = {f"p{i}": np.asarray(p._data) for i, p in enumerate(params)}
+    np.savez(path_prefix + ".pdiparams.npz", **pmap)
+
+    # swap concrete param tensors for symbolic markers before pickling
+    from ..framework.tensor import Tensor
+
+    def strip(obj, memo):
+        if isinstance(obj, Tensor):
+            for i, p in enumerate(params):
+                if obj is p:
+                    return ("__param__", i)
+            return ("__const__", np.asarray(obj._data))
+        if isinstance(obj, Variable):
+            return ("__var__", obj.name)
+        if isinstance(obj, (list, tuple)):
+            t = [strip(x, memo) for x in obj]
+            return tuple(t) if isinstance(obj, tuple) else t
+        if isinstance(obj, dict):
+            return {k: strip(v, memo) for k, v in obj.items()}
+        return obj
+
+    nodes = {}
+    for v in program.vars.values():
+        if v.source is None:
+            nodes[v.name] = {"feed": True, "shape": v.shape,
+                             "dtype": str(v.dtype)}
+        else:
+            body, args, kwargs_, n_outs = v.source
+            nodes[v.name] = {
+                "feed": False, "shape": v.shape, "dtype": str(v.dtype),
+                "body": f"{body.__module__}:{body.__qualname__}",
+                "args": strip(args, {}), "kwargs": strip(kwargs_, {}),
+                "out_index": v.out_index, "n_outs": n_outs,
+                "nid": id(v.source),   # sibling outputs share one node
+            }
+    meta = {
+        "nodes": nodes,
+        "feeds": [v.name for v in feed_vars],
+        "fetches": [v.name for v in fetch_vars],
+    }
+    with open(path_prefix + ".pdmodel.pkl", "wb") as f:
+        pickle.dump(meta, f)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Returns (program, feed_names, fetch_vars) per reference API."""
+    import importlib
+    import pickle
+
+    from ..framework.tensor import Tensor
+
+    with open(path_prefix + ".pdmodel.pkl", "rb") as f:
+        meta = pickle.load(f)
+    pz = np.load(path_prefix + ".pdiparams.npz")
+    params = [Tensor(pz[f"p{i}"], stop_gradient=True)
+              for i in range(len(pz.files))]
+
+    prog = Program()
+    made: dict[str, Variable] = {}
+
+    def restore(obj):
+        if isinstance(obj, tuple) and len(obj) == 2 and \
+                obj[0] == "__param__":
+            return params[obj[1]]
+        if isinstance(obj, tuple) and len(obj) == 2 and \
+                obj[0] == "__const__":
+            return Tensor(obj[1], stop_gradient=True)
+        if isinstance(obj, tuple) and len(obj) == 2 and obj[0] == "__var__":
+            return build_var(obj[1])
+        if isinstance(obj, (list, tuple)):
+            t = [restore(x) for x in obj]
+            return tuple(t) if isinstance(obj, tuple) else t
+        if isinstance(obj, dict):
+            return {k: restore(v) for k, v in obj.items()}
+        return obj
+
+    # sibling outputs of a multi-output op must share ONE source tuple so
+    # graph.evaluate's sibling memoization (identity-keyed) works
+    sources: dict[int, tuple] = {}
+
+    def build_var(name):
+        if name in made:
+            return made[name]
+        nd = meta["nodes"][name]
+        if nd["feed"]:
+            v = Variable(prog, nd["shape"], nd["dtype"], name=name)
+            prog.feed_vars[name] = v
+        else:
+            if nd["nid"] not in sources:
+                mod, qual = nd["body"].split(":")
+                body = importlib.import_module(mod)
+                for part in qual.split("."):
+                    body = getattr(body, part)
+                # module attrs hold the public @op wrapper under the
+                # body's name; the graph stores/executes the pure body
+                body = getattr(body, "__op_body__", body)
+                sources[nd["nid"]] = (body, restore(nd["args"]),
+                                      restore(nd["kwargs"]),
+                                      nd.get("n_outs", 1))
+            v = Variable(prog, nd["shape"], nd["dtype"], name=name,
+                         source=sources[nd["nid"]],
+                         out_index=nd["out_index"])
+        made[name] = v
+        prog.vars[name] = v
+        return v
+
+    for name in meta["nodes"]:
+        build_var(name)
+    for p in params:
+        prog._note_param(p)
+    fetch_vars = [made[n] for n in meta["fetches"]]
+    return prog, meta["feeds"], fetch_vars

@@ -1,0 +1,157 @@
+"""Static Executor over the Program DAG.
+
+Reference analog: python/paddle/base/executor.py (Executor:1234,
+run:1695, _ExecutorCache:871) driving C++ StandaloneExecutor
+(standalone_executor.cc:171).  Here "build the Plan" = compile the
+fetched DAG slice with jax.jit (cached per program version + feed
+signature); parameter updates from recorded train ops reuse the dygraph
+optimizers by handing them jax-computed grads.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import graph
+from ..framework.tensor import Tensor
+
+__all__ = ["Executor", "scope_guard", "global_scope"]
+
+
+class _Scope:
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        return self._vars.setdefault(name, None)
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+
+_global_scope = _Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+class scope_guard:
+    def __init__(self, scope):
+        self.scope = scope
+
+    def __enter__(self):
+        return self.scope
+
+    def __exit__(self, *e):
+        return False
+
+
+class Executor:
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True, scope=None):
+        feed = feed or {}
+        if program is None:
+            program = graph.default_main_program()
+        if program is graph.default_startup_program() or (
+                isinstance(program, graph.Program)
+                and not program.vars and not program.train_ops):
+            # startup: parameters were initialized eagerly at layer
+            # construction — nothing to run
+            return []
+        fetch_list = fetch_list or []
+        fetch_vars = []
+        for v in fetch_list:
+            if isinstance(v, graph.Variable):
+                fetch_vars.append(v)
+            elif isinstance(v, str):
+                if v not in program.vars:
+                    raise KeyError(f"fetch name {v!r} not in program")
+                fetch_vars.append(program.vars[v])
+            else:
+                raise TypeError(
+                    f"fetch_list entries must be Variable or name, got "
+                    f"{type(v).__name__}")
+
+        feed_arrays = {k: jnp.asarray(np.asarray(v._data if isinstance(
+            v, Tensor) else v)) for k, v in feed.items()}
+
+        if program.train_ops:
+            results = self._run_train(program, feed_arrays, fetch_vars)
+        else:
+            results = self._run_infer(program, feed_arrays, fetch_vars)
+
+        if return_numpy:
+            results = [np.asarray(r) for r in results]
+        return results
+
+    # ------------------------------------------------------------ infer
+    def _cache_key(self, program, feed_arrays, fetch_vars, train):
+        sig = tuple(sorted((k, v.shape, str(v.dtype))
+                           for k, v in feed_arrays.items()))
+        return (id(program), program.version, train,
+                tuple(v.name for v in fetch_vars), sig)
+
+    def _run_infer(self, program, feed_arrays, fetch_vars):
+        key = self._cache_key(program, feed_arrays, fetch_vars, False)
+        params = program.all_parameters()
+        stat_bufs = [b for b, _ in program.stat_updates]
+        stat_vars = [v for _, v in program.stat_updates]
+        if key not in self._cache:
+            def fn(feed, param_arrays, stat_arrays):
+                pmap = {id(p): a for p, a in zip(params, param_arrays)}
+                pmap.update(
+                    {id(b): a for b, a in zip(stat_bufs, stat_arrays)})
+                outs = graph.evaluate(fetch_vars + stat_vars, feed, pmap)
+                n = len(fetch_vars)
+                return outs[:n], outs[n:]
+            self._cache[key] = jax.jit(fn)
+        outs, stats = self._cache[key](feed_arrays,
+                                       [p._data for p in params],
+                                       [b._data for b in stat_bufs])
+        self._apply_stats(stat_bufs, stats)
+        return outs
+
+    @staticmethod
+    def _apply_stats(stat_bufs, stats):
+        # running-stat side effects (reference: the in-graph stat-update
+        # ops static batch_norm appends)
+        for b, new in zip(stat_bufs, stats):
+            b._data = new
+
+    # ------------------------------------------------------------ train
+    def _run_train(self, program, feed_arrays, fetch_vars):
+        optimizer, loss_var = program.train_ops[-1]
+        params = [p for p in program.all_parameters() if not p.stop_gradient]
+        stat_bufs = [b for b, _ in program.stat_updates]
+        stat_vars = [v for _, v in program.stat_updates]
+        key = self._cache_key(program, feed_arrays, fetch_vars, True)
+        if key not in self._cache:
+            def fwd(param_arrays, feed, stat_arrays):
+                pmap = {id(p): a for p, a in zip(params, param_arrays)}
+                pmap.update(
+                    {id(b): a for b, a in zip(stat_bufs, stat_arrays)})
+                outs = graph.evaluate([loss_var] + fetch_vars + stat_vars,
+                                      feed, pmap)
+                n = 1 + len(fetch_vars)
+                return outs[0].astype(jnp.float32).sum(), \
+                    (outs[1:n], outs[n:])
+
+            self._cache[key] = jax.jit(jax.value_and_grad(fwd, has_aux=True))
+        (loss, (fetches, stats)), grads = self._cache[key](
+            [p._data for p in params], feed_arrays,
+            [b._data for b in stat_bufs])
+        self._apply_stats(stat_bufs, stats)
+        # hand grads to the dygraph optimizer (reference: the appended
+        # optimizer ops in the static program do this in-graph)
+        for p, g in zip(params, grads):
+            p._grad = g
+        optimizer.step()
+        optimizer.clear_grad()
+        # fetches is aligned with fetch_vars (loss was outs[0], dropped)
+        return list(fetches)

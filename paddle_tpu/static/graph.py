@@ -1,0 +1,328 @@
+"""Static-graph core: Program / Variable DAG + evaluation.
+
+Reference analog: the PIR program + StandaloneExecutor pipeline
+(paddle/pir/include/core/program.h, paddle/fluid/framework/new_executor/
+standalone_executor.cc:171, python/paddle/base/framework.py Program) and
+the `paddle.static` user API (python/paddle/static/).
+
+TPU formulation: a Program is a recorded DAG of framework ops over
+symbolic `Variable`s (captured by the op registry when a Variable flows
+into an op — the analog of op capture into a pir::Block).  The executor
+evaluates fetches by compiling the DAG slice into ONE `jax.jit` program
+(cached per feed-shape signature), which is exactly the
+PirInterpreter-over-kernels role XLA plays here.  Parameters stay
+concrete `Parameter` tensors (the startup program is a no-op: eager
+init), read at call time so optimizer updates are visible.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Program", "Variable", "program_guard", "default_main_program",
+           "default_startup_program", "data", "build_node", "in_build"]
+
+_state = threading.local()
+
+# flipped once the static API is touched; lets the hot eager op path skip
+# the Variable scan entirely in pure-dygraph processes
+_ever_static = False
+
+
+def _mark_static():
+    global _ever_static
+    _ever_static = True
+
+
+def _progs():
+    if not hasattr(_state, "stack"):
+        _state.stack = []
+    return _state.stack
+
+
+class Variable:
+    """Symbolic SSA value (reference: pir::Value / base/framework.py
+    Variable)."""
+
+    _counter = 0
+
+    def __init__(self, program, shape, dtype, name=None, source=None,
+                 out_index=0):
+        Variable._counter += 1
+        self.program = program
+        self.shape = list(shape)
+        self.dtype = jnp.dtype(dtype)
+        self.name = name or f"_var_{Variable._counter}"
+        # source: None => feed slot; (body, args, kwargs, n_outs) => op node
+        self.source = source
+        self.out_index = out_index
+        self.stop_gradient = source is None
+        self.persistable = False
+
+    # --- tensor-like surface so layers/ops can treat it like a Tensor ---
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def dim(self):
+        return len(self.shape)
+
+    def astype(self, dt):
+        from ..ops.math import cast
+        return cast(self, dt)
+
+    def detach(self):
+        # symbolic values carry no tape; gradient stopping is decided by
+        # which leaves the executor differentiates
+        return self
+
+    def clone(self):
+        return self
+
+    def numpy(self):
+        raise RuntimeError(
+            "Variable has no data in static mode; fetch it via "
+            "Executor.run(fetch_list=[...])")
+
+    def __repr__(self):
+        return (f"Variable(name={self.name}, shape={self.shape}, "
+                f"dtype={self.dtype.name})")
+
+    # python operators route back into framework ops (which re-enter
+    # build_node via the registry's Variable check)
+    def _binop(self, opname, other, reverse=False):
+        from ..ops import math as O
+        fn = getattr(O, opname)
+        return fn(other, self) if reverse else fn(self, other)
+
+    def __add__(self, o):
+        return self._binop("add", o)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop("subtract", o)
+
+    def __rsub__(self, o):
+        return self._binop("subtract", o, reverse=True)
+
+    def __mul__(self, o):
+        return self._binop("multiply", o)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop("divide", o)
+
+    def __rtruediv__(self, o):
+        return self._binop("divide", o, reverse=True)
+
+    def __matmul__(self, o):
+        return self._binop("matmul", o)
+
+    def __neg__(self):
+        from ..ops.math import scale
+        return scale(self, -1.0)
+
+    def __pow__(self, o):
+        from ..ops.math import pow
+        return pow(self, o)
+
+    def __getitem__(self, idx):
+        from ..ops.indexing import getitem
+        return getitem(self, idx)
+
+
+class Program:
+    """Recorded op DAG (reference: base/framework.py Program:5706 /
+    pir::Program)."""
+
+    def __init__(self):
+        self.vars: dict[str, Variable] = {}
+        self.feed_vars: dict[str, Variable] = {}
+        self.train_ops: list = []          # [(optimizer, loss_var)]
+        self.stat_updates: list = []       # [(buffer Tensor, Variable)]
+        self.version = 0
+        self.random_seed = None
+        self._param_refs: list = []        # Parameter tensors seen in ops
+
+    def _note_param(self, p):
+        if all(p is not q for q in self._param_refs):
+            self._param_refs.append(p)
+
+    def clone(self, for_test=False):
+        import copy
+        p = Program()
+        p.vars = dict(self.vars)
+        p.feed_vars = dict(self.feed_vars)
+        p.train_ops = [] if for_test else list(self.train_ops)
+        p.stat_updates = [] if for_test else list(self.stat_updates)
+        p._param_refs = list(self._param_refs)
+        return p
+
+    def global_block(self):
+        return self
+
+    # Block-ish surface
+    @property
+    def ops(self):
+        return [v for v in self.vars.values() if v.source is not None]
+
+    def all_parameters(self):
+        return list(self._param_refs)
+
+    def list_vars(self):
+        return list(self.vars.values())
+
+
+class program_guard:
+    """Reference: paddle.static.program_guard."""
+
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        _mark_static()
+        _progs().append(self.main)
+        return self.main
+
+    def __exit__(self, *exc):
+        _progs().pop()
+        return False
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program():
+    return _progs()[-1] if _progs() else _default_main
+
+
+def default_startup_program():
+    return _default_startup
+
+
+def in_build():
+    """True when a program_guard is active (static build mode)."""
+    import paddle_tpu
+    return bool(_progs()) or not paddle_tpu.in_dynamic_mode()
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Feed placeholder (reference: python/paddle/static/input.py data)."""
+    _mark_static()
+    prog = default_main_program()
+    v = Variable(prog, [(-1 if s is None else s) for s in shape], dtype,
+                 name=name)
+    prog.vars[v.name] = v
+    prog.feed_vars[name] = v
+    prog.version += 1
+    return v
+
+
+def _placeholder_shape(shape):
+    # -1/None dims become 1 for build-time shape inference only
+    return tuple(1 if (s is None or s < 0) else int(s) for s in shape)
+
+
+def build_node(opname, body, args, kwargs):
+    """Record an op whose inputs include Variables; returns Variable(s).
+    The registry calls this instead of executing (the analog of appending
+    a pd_op to the current pir::Block)."""
+    from jax.tree_util import tree_flatten, tree_unflatten
+    from ..framework.tensor import Tensor
+
+    prog = default_main_program()
+
+    flat, treedef = tree_flatten((args, kwargs),
+                                 is_leaf=lambda x: isinstance(
+                                     x, (Variable, Tensor)))
+    # abstract stand-ins for shape/dtype inference
+    def stand_in(x):
+        if isinstance(x, Variable):
+            return jax.ShapeDtypeStruct(_placeholder_shape(x.shape), x.dtype)
+        if isinstance(x, Tensor):
+            from ..nn.layer import Parameter
+            if isinstance(x, Parameter):
+                prog._note_param(x)
+            return jax.ShapeDtypeStruct(x._data.shape, x._data.dtype)
+        return x
+
+    abstract = [stand_in(x) for x in flat]
+
+    def run_abstract(*leaves):
+        a, k = tree_unflatten(treedef, list(leaves))
+        return body(*a, **k)
+
+    dyn_idx = [i for i, x in enumerate(abstract)
+               if isinstance(x, jax.ShapeDtypeStruct)]
+    dyn = [abstract[i] for i in dyn_idx]
+
+    def fn(*dyn_vals):
+        leaves = list(abstract)
+        for i, v in zip(dyn_idx, dyn_vals):
+            leaves[i] = v
+        return run_abstract(*leaves)
+
+    out_shape = jax.eval_shape(fn, *dyn)
+    out_flat, out_treedef = tree_flatten(out_shape)
+
+    outs = []
+    node = (body, args, kwargs, len(out_flat))
+    for i, aval in enumerate(out_flat):
+        v = Variable(prog, aval.shape, aval.dtype,
+                     name=f"{opname}_{Variable._counter}",
+                     source=node, out_index=i)
+        prog.vars[v.name] = v
+        outs.append(v)
+    prog.version += 1
+    return tree_unflatten(out_treedef, outs)
+
+
+def evaluate(fetch_vars, feed, params=None):
+    """Evaluate fetch Variables given feed dict (name -> np/jax array).
+    Returns list of jax arrays.  Used by Executor (jitted there)."""
+    from jax.tree_util import tree_flatten, tree_unflatten
+    from ..framework.tensor import Tensor
+
+    env = {}
+
+    def eval_var(v):
+        if v.name in env:
+            return env[v.name]
+        if v.source is None:
+            if v.name not in feed:
+                raise KeyError(f"feed missing input {v.name!r}")
+            val = feed[v.name]
+        else:
+            body, args, kwargs, _ = v.source
+            flat, treedef = tree_flatten(
+                (args, kwargs),
+                is_leaf=lambda x: isinstance(x, (Variable, Tensor)))
+            vals = []
+            for x in flat:
+                if isinstance(x, Variable):
+                    vals.append(eval_var(x))
+                elif isinstance(x, Tensor):
+                    key = id(x)
+                    vals.append(params[key] if params and key in params
+                                else x._data)
+                else:
+                    vals.append(x)
+            a, k = tree_unflatten(treedef, vals)
+            out = body(*a, **k)
+            out_flat, _ = tree_flatten(out)
+            val = out_flat[v.out_index]
+            # memoize siblings
+            for sib in v.program.vars.values():
+                if sib.source is v.source:
+                    env[sib.name] = out_flat[sib.out_index]
+        env[v.name] = val
+        return val
+
+    return [eval_var(v) for v in fetch_vars]

@@ -1,0 +1,66 @@
+"""paddle.static.nn — graph-building layer helpers.
+
+Reference: python/paddle/static/nn/common.py (fc, batch_norm, conv2d...).
+Each helper instantiates the dygraph layer (parameters init eagerly — the
+"startup program" role) and applies it to the symbolic Variable; the op
+registry records the resulting DAG nodes.
+"""
+from __future__ import annotations
+
+from .. import nn as dynn
+
+__all__ = ["fc", "conv2d", "batch_norm", "embedding"]
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    in_features = 1
+    for s in x.shape[num_flatten_dims:]:
+        in_features *= int(s)
+    layer = dynn.Linear(in_features, size, weight_attr=weight_attr,
+                        bias_attr=bias_attr)
+    h = x
+    if len(x.shape) > num_flatten_dims + 1:
+        from ..ops.manipulation import flatten
+        h = flatten(h, start_axis=num_flatten_dims)
+    out = layer(h)
+    if activation:
+        import paddle_tpu.nn.functional as F
+        out = getattr(F, activation)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, name=None, data_format="NCHW"):
+    in_ch = int(input.shape[1 if data_format == "NCHW" else -1])
+    layer = dynn.Conv2D(in_ch, num_filters, filter_size, stride=stride,
+                        padding=padding, dilation=dilation, groups=groups,
+                        weight_attr=param_attr, bias_attr=bias_attr,
+                        data_format=data_format)
+    out = layer(input)
+    if act:
+        import paddle_tpu.nn.functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               name=None, **kwargs):
+    ch = int(input.shape[1 if data_layout == "NCHW" else -1])
+    layer = dynn.BatchNorm2D(ch, momentum=momentum, epsilon=epsilon,
+                             weight_attr=param_attr, bias_attr=bias_attr,
+                             data_format=data_layout)
+    out = layer(input)
+    if act:
+        import paddle_tpu.nn.functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    layer = dynn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                           sparse=is_sparse, weight_attr=param_attr)
+    return layer(input)

@@ -1,0 +1,56 @@
+"""paddle.inference Config/Predictor over a saved static program.
+
+Reference test style: test/cpp/inference + python predictor API examples
+(zero-copy handles, get_input_names/run/copy_to_cpu)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.inference import Config, create_predictor
+
+
+@pytest.fixture()
+def saved_model():
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 8], "float32")
+            h = static.nn.fc(x, 16, activation="relu")
+            out = static.nn.fc(h, 4)
+        exe = static.Executor()
+        path = os.path.join(tempfile.mkdtemp(), "model")
+        static.save_inference_model(path, [x], [out], exe, program=main)
+        xv = np.random.default_rng(0).standard_normal((5, 8)).astype(
+            "float32")
+        ref = exe.run(main, feed={"x": xv}, fetch_list=[out])[0]
+    finally:
+        paddle.disable_static()
+    return path, xv, ref
+
+
+def test_predictor_zero_copy(saved_model):
+    path, xv, ref = saved_model
+    config = Config(path)
+    pred = create_predictor(config)
+    names = pred.get_input_names()
+    assert names == ["x"]
+    h = pred.get_input_handle("x")
+    h.copy_from_cpu(xv)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_predictor_positional_run(saved_model):
+    path, xv, ref = saved_model
+    pred = create_predictor(Config(path))
+    outs = pred.run([xv])
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-5)
+    # second call with a different batch size retraces cleanly
+    outs2 = pred.run([xv[:2]])
+    np.testing.assert_allclose(outs2[0], ref[:2], rtol=1e-4, atol=1e-5)

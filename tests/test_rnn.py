@@ -1,0 +1,167 @@
+"""RNN family vs torch reference (same parameter layout / gate order).
+
+Mirrors the reference's numeric-vs-reference op tests
+(test/legacy_test/test_rnn_op.py etc., SURVEY §4): outputs and grads of
+SimpleRNN/LSTM/GRU checked against torch.nn counterparts with copied
+weights, plus sequence_length masking and cell/BiRNN behavior.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+torch = pytest.importorskip("torch")
+
+
+def _copy_weights(pd_rnn, th_rnn, num_layers, bidirectional):
+    dirs = 2 if bidirectional else 1
+    for layer in range(num_layers):
+        for d in range(dirs):
+            sfx = f"l{layer}" + ("_reverse" if d == 1 else "")
+            tsfx = f"l{layer}" + ("_reverse" if d == 1 else "")
+            for name in ("weight_ih", "weight_hh", "bias_ih", "bias_hh"):
+                th = getattr(th_rnn, f"{name}_{tsfx}")
+                getattr(pd_rnn, f"{name}_{sfx}").set_value(
+                    th.detach().numpy())
+
+
+@pytest.mark.parametrize("mode", ["lstm", "gru", "rnn"])
+@pytest.mark.parametrize("bidi", [False, True])
+def test_rnn_matches_torch(mode, bidi):
+    B, T, I, H, L = 3, 7, 5, 8, 2
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((B, T, I)).astype("float32")
+
+    if mode == "lstm":
+        pd = nn.LSTM(I, H, num_layers=L,
+                     direction="bidirect" if bidi else "forward")
+        th = torch.nn.LSTM(I, H, num_layers=L, batch_first=True,
+                           bidirectional=bidi)
+    elif mode == "gru":
+        pd = nn.GRU(I, H, num_layers=L,
+                    direction="bidirect" if bidi else "forward")
+        th = torch.nn.GRU(I, H, num_layers=L, batch_first=True,
+                          bidirectional=bidi)
+    else:
+        pd = nn.SimpleRNN(I, H, num_layers=L,
+                          direction="bidirect" if bidi else "forward")
+        th = torch.nn.RNN(I, H, num_layers=L, batch_first=True,
+                          bidirectional=bidi)
+    _copy_weights(pd, th, L, bidi)
+
+    out_pd, st_pd = pd(paddle.to_tensor(x))
+    out_th, st_th = th(torch.tensor(x))
+    np.testing.assert_allclose(out_pd.numpy(), out_th.detach().numpy(),
+                               atol=2e-5, rtol=1e-4)
+    if mode == "lstm":
+        np.testing.assert_allclose(st_pd[0].numpy(),
+                                   st_th[0].detach().numpy(), atol=2e-5,
+                                   rtol=1e-4)
+        np.testing.assert_allclose(st_pd[1].numpy(),
+                                   st_th[1].detach().numpy(), atol=2e-5,
+                                   rtol=1e-4)
+    else:
+        np.testing.assert_allclose(st_pd.numpy(), st_th.detach().numpy(),
+                                   atol=2e-5, rtol=1e-4)
+
+
+def test_lstm_grad_matches_torch():
+    B, T, I, H = 2, 5, 4, 6
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((B, T, I)).astype("float32")
+    pd = nn.LSTM(I, H)
+    th = torch.nn.LSTM(I, H, batch_first=True)
+    _copy_weights(pd, th, 1, False)
+
+    xt = paddle.to_tensor(x, stop_gradient=False)
+    out, _ = pd(xt)
+    loss = (out * out).sum()
+    loss.backward()
+
+    xth = torch.tensor(x, requires_grad=True)
+    out_t, _ = th(xth)
+    (out_t * out_t).sum().backward()
+
+    np.testing.assert_allclose(xt.grad.numpy(), xth.grad.numpy(),
+                               atol=2e-5, rtol=1e-4)
+    np.testing.assert_allclose(
+        pd.weight_ih_l0.grad.numpy(),
+        th.weight_ih_l0.grad.detach().numpy(), atol=2e-5, rtol=1e-4)
+
+
+def test_sequence_length_masking():
+    B, T, I, H = 3, 6, 4, 5
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((B, T, I)).astype("float32")
+    seq = np.array([6, 3, 1])
+    pd = nn.GRU(I, H)
+    out, h = pd(paddle.to_tensor(x),
+                sequence_length=paddle.to_tensor(seq))
+    o = out.numpy()
+    # steps beyond each row's length are zeroed
+    assert np.all(o[1, 3:] == 0) and np.all(o[2, 1:] == 0)
+    assert np.any(o[0, -1] != 0)
+    # final state equals the last valid step's output
+    np.testing.assert_allclose(h.numpy()[0, 1], o[1, 2], atol=1e-6)
+    np.testing.assert_allclose(h.numpy()[0, 2], o[2, 0], atol=1e-6)
+
+
+def test_cells_and_birnn():
+    B, T, I, H = 2, 4, 3, 5
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((B, T, I)).astype("float32")
+
+    cell = nn.LSTMCell(I, H)
+    y, (h, c) = cell(paddle.to_tensor(x[:, 0]))
+    assert y.shape == [B, H] and c.shape == [B, H]
+
+    rnn = nn.RNN(nn.GRUCell(I, H))
+    out, st = rnn(paddle.to_tensor(x))
+    assert out.shape == [B, T, H]
+
+    bi = nn.BiRNN(nn.SimpleRNNCell(I, H), nn.SimpleRNNCell(I, H))
+    out, (st_f, st_b) = bi(paddle.to_tensor(x))
+    assert out.shape == [B, T, 2 * H]
+
+
+def test_rnn_in_jit_train_step():
+    """RNN under the compiled train step (scan inside jit)."""
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import optimizer as opt
+
+    B, T, I, H = 4, 6, 3, 8
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((B, T, I)).astype("float32")
+    y = rng.standard_normal((B, H)).astype("float32")
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.rnn = nn.LSTM(I, H)
+            self.fc = nn.Linear(H, H)
+
+        def forward(self, inp):
+            out, _ = self.rnn(inp)
+            return self.fc(out[:, -1])
+
+    net = Net()
+    optim = opt.Adam(parameters=net.parameters(), learning_rate=1e-2)
+    step = paddle.jit.train_step(
+        net, optim, lambda m, b: F.mse_loss(m(b[0]), b[1]))
+    losses = [float(step((paddle.to_tensor(x), paddle.to_tensor(y))))
+              for _ in range(6)]
+    assert losses[-1] < losses[0]
+
+
+def test_rnn_wrapper_sequence_length():
+    B, T, I, H = 2, 5, 3, 4
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((B, T, I)).astype("float32")
+    seq = np.array([5, 2])
+    rnn = nn.RNN(nn.GRUCell(I, H))
+    out, st = rnn(paddle.to_tensor(x),
+                  sequence_length=paddle.to_tensor(seq))
+    o = out.numpy()
+    assert np.all(o[1, 2:] == 0), "padded outputs must be zero"
+    np.testing.assert_allclose(st.numpy()[1], o[1, 1], atol=1e-6)

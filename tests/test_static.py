@@ -1,0 +1,136 @@
+"""paddle.static: Program/data/Executor/minimize/save+load_inference_model.
+
+Reference test style: test/legacy_test static-graph tests (build program,
+exe.run with feed/fetch, compare to eager numpy)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def test_program_build_and_run():
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 4], "float32")
+        w = paddle.to_tensor(np.eye(4, 3, dtype="float32"))
+        y = paddle.matmul(x, w)
+        z = y + 1.0
+    exe = static.Executor()
+    exe.run(startup)
+    xv = np.arange(8, dtype="float32").reshape(2, 4)
+    (out,) = exe.run(main, feed={"x": xv}, fetch_list=[z])
+    np.testing.assert_allclose(out, xv @ np.eye(4, 3, dtype="float32") + 1)
+
+
+def test_static_fc_and_training():
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((16, 8)).astype("float32")
+    wv = rng.standard_normal((8, 1)).astype("float32")
+    yv = xv @ wv + 0.1
+
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 8], "float32")
+        y = static.data("y", [None, 1], "float32")
+        pred = static.nn.fc(x, 1)
+        loss = paddle.mean((pred - y) ** 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+
+    exe = static.Executor()
+    exe.run(startup)
+    losses = []
+    for _ in range(30):
+        (lv,) = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.2, losses[:3] + losses[-3:]
+
+
+def test_static_conv_bn():
+    main = static.Program()
+    with static.program_guard(main):
+        img = static.data("img", [None, 3, 8, 8], "float32")
+        h = static.nn.conv2d(img, num_filters=4, filter_size=3, padding=1,
+                             act="relu")
+        h = static.nn.batch_norm(h)
+    exe = static.Executor()
+    out = exe.run(main, feed={"img": np.ones((2, 3, 8, 8), "float32")},
+                  fetch_list=[h])[0]
+    assert out.shape == (2, 4, 8, 8)
+
+
+def test_save_load_inference_model():
+    rng = np.random.default_rng(1)
+    xv = rng.standard_normal((4, 6)).astype("float32")
+
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 6], "float32")
+        out = static.nn.fc(x, 3, activation="relu")
+    exe = static.Executor()
+    ref = exe.run(main, feed={"x": xv}, fetch_list=[out])[0]
+
+    path = os.path.join(tempfile.mkdtemp(), "infer")
+    static.save_inference_model(path, [x], [out], exe, program=main)
+
+    prog2, feeds, fetches = static.load_inference_model(path, exe)
+    got = exe.run(prog2, feed={feeds[0]: xv}, fetch_list=fetches)[0]
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_program_clone_for_test():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 2], "float32")
+        y = paddle.mean(x * 2.0)
+        opt = paddle.optimizer.SGD(learning_rate=0.1)
+        # no parameters: minimize on a paramless graph records the op
+    test_prog = main.clone(for_test=True)
+    assert test_prog.train_ops == []
+    exe = static.Executor()
+    out = exe.run(test_prog, feed={"x": np.ones((3, 2), "float32")},
+                  fetch_list=[y])[0]
+    np.testing.assert_allclose(out, 2.0)
+
+
+def test_fetch_by_name():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 2], "float32")
+        y = paddle.mean(x * 3.0)
+    exe = static.Executor()
+    out = exe.run(main, feed={"x": np.ones((2, 2), "float32")},
+                  fetch_list=[y.name])[0]
+    np.testing.assert_allclose(out, 3.0)
+    with pytest.raises(KeyError):
+        exe.run(main, feed={"x": np.ones((2, 2), "float32")},
+                fetch_list=["nope"])
+
+
+def test_static_batchnorm_updates_running_stats():
+    rng = np.random.default_rng(3)
+    xv = (rng.standard_normal((8, 4, 2, 2)) * 5 + 2).astype("float32")
+    main = static.Program()
+    with static.program_guard(main):
+        img = static.data("img", [None, 4, 2, 2], "float32")
+        from paddle_tpu import nn as dynn
+        bn = dynn.BatchNorm2D(4)
+        out = bn(img)
+    exe = static.Executor()
+    before = bn._mean.numpy().copy()
+    exe.run(main, feed={"img": xv}, fetch_list=[out])
+    after = bn._mean.numpy()
+    assert not np.allclose(before, after), "running mean not updated"
