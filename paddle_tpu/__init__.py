@@ -107,6 +107,12 @@ from . import signal  # noqa: F401
 from . import sparse  # noqa: F401
 from . import static  # noqa: F401
 from . import inference  # noqa: F401
+from . import quantization  # noqa: F401
+from . import audio  # noqa: F401
+from . import text  # noqa: F401
+from . import geometric  # noqa: F401
+from . import incubate  # noqa: F401
+from . import utils  # noqa: F401
 
 __version__ = "0.1.0"
 

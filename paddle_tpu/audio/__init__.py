@@ -1,0 +1,82 @@
+"""paddle.audio — audio feature extraction.
+
+Reference: python/paddle/audio/ (2.5k LoC: features/layers.py
+Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC, functional/window.py
+get_window, functional/functional.py hz_to_mel/compute_fbank_matrix/
+create_dct).  Built on the framework stft/fft ops, which lower to XLA
+FFT on TPU.
+"""
+from __future__ import annotations
+
+from . import functional  # noqa: F401
+from .features import (  # noqa: F401
+    Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC)
+
+__all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC", "backends"]
+
+
+class backends:
+    """Reference: paddle.audio.backends (soundfile IO). Gated: wave-file
+    IO via the stdlib for 16-bit PCM; soundfile is not bundled."""
+
+    @staticmethod
+    def load(filepath, frame_offset=0, num_frames=-1, normalize=True):
+        import wave
+
+        import numpy as np
+
+        with wave.open(filepath, "rb") as w:
+            if w.getsampwidth() != 2:
+                raise ValueError(
+                    f"only 16-bit PCM wav supported, got "
+                    f"{8 * w.getsampwidth()}-bit")
+            sr = w.getframerate()
+            n = w.getnframes()
+            w.setpos(frame_offset)
+            count = n - frame_offset if num_frames < 0 else num_frames
+            raw = w.readframes(count)
+            data = np.frombuffer(raw, dtype="<i2").astype("float32")
+            ch = w.getnchannels()
+            if ch > 1:
+                data = data.reshape(-1, ch).T
+            else:
+                data = data[None, :]
+        if normalize:
+            data = data / 32768.0
+        from ..framework.tensor import Tensor
+        return Tensor(data), sr
+
+    @staticmethod
+    def save(filepath, src, sample_rate, channels_first=True,
+             bits_per_sample=16):
+        import wave
+
+        import numpy as np
+
+        arr = np.asarray(src.numpy() if hasattr(src, "numpy") else src)
+        if arr.ndim == 1:
+            arr = arr[None, :]
+        if not channels_first:
+            arr = arr.T
+        pcm = np.clip(arr * 32768.0, -32768, 32767).astype("<i2")
+        with wave.open(filepath, "wb") as w:
+            w.setnchannels(pcm.shape[0])
+            w.setsampwidth(2)
+            w.setframerate(sample_rate)
+            w.writeframes(pcm.T.tobytes())
+
+    @staticmethod
+    def info(filepath):
+        import wave
+
+        class Info:
+            pass
+
+        with wave.open(filepath, "rb") as w:
+            i = Info()
+            i.sample_rate = w.getframerate()
+            i.num_channels = w.getnchannels()
+            i.num_frames = w.getnframes()
+            i.bits_per_sample = 8 * w.getsampwidth()
+        return i

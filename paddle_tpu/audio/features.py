@@ -1,0 +1,99 @@
+"""paddle.audio.features — Spectrogram/Mel/LogMel/MFCC layers.
+
+Reference: python/paddle/audio/features/layers.py.  The STFT lowers to
+XLA rfft; the mel projection is one matmul on the MXU.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import signal as _signal
+from ..nn.layer import Layer
+from ..framework.tensor import Tensor
+from . import functional as AF
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.register_buffer(
+            "window", AF.get_window(window, self.win_length, dtype=dtype))
+
+    def forward(self, x):
+        spec = _signal.stft(x, self.n_fft, hop_length=self.hop_length,
+                            win_length=self.win_length, window=self.window,
+                            center=self.center, pad_mode=self.pad_mode)
+        from ..ops.math import abs as _abs, pow as _pow
+        mag = _abs(spec)
+        if self.power != 1.0:
+            mag = _pow(mag, self.power)
+        return mag
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(
+            n_fft=n_fft, hop_length=hop_length, win_length=win_length,
+            window=window, power=power, center=center, pad_mode=pad_mode,
+            dtype=dtype)
+        self.n_mels = n_mels
+        self.register_buffer(
+            "fbank_matrix",
+            AF.compute_fbank_matrix(sr, n_fft, n_mels=n_mels, f_min=f_min,
+                                    f_max=f_max, htk=htk, norm=norm,
+                                    dtype=dtype))
+
+    def forward(self, x):
+        spec = self._spectrogram(x)          # [..., freq, time]
+        from ..ops.linalg import matmul
+        return matmul(self.fbank_matrix, spec)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, *args, ref_value=1.0, amin=1e-10, top_db=None,
+                 **kwargs):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(*args, **kwargs)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._melspectrogram(x)
+        return AF.power_to_db(mel, ref_value=self.ref_value,
+                              amin=self.amin, top_db=self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 n_mels=64, f_min=50.0, f_max=None, top_db=None,
+                 dtype="float32", **kwargs):
+        super().__init__()
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr=sr, n_fft=n_fft, hop_length=hop_length, n_mels=n_mels,
+            f_min=f_min, f_max=f_max, top_db=top_db, dtype=dtype, **kwargs)
+        self.register_buffer(
+            "dct_matrix", AF.create_dct(n_mfcc, n_mels, dtype=dtype))
+
+    def forward(self, x):
+        logmel = self._log_melspectrogram(x)  # [..., n_mels, time]
+        from ..ops.linalg import matmul
+        from ..ops.manipulation import transpose
+        nd = logmel.ndim
+        perm = list(range(nd - 2)) + [nd - 1, nd - 2]
+        out = matmul(transpose(logmel, perm), self.dct_matrix)
+        return transpose(out, perm)

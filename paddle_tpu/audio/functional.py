@@ -1,0 +1,177 @@
+"""paddle.audio.functional (reference:
+python/paddle/audio/functional/{functional,window}.py)."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..ops.registry import op
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "create_dct", "power_to_db",
+           "get_window"]
+
+
+def hz_to_mel(freq, htk=False):
+    scalar = not hasattr(freq, "__len__") and not isinstance(freq, Tensor)
+    f = np.asarray(freq.numpy() if isinstance(freq, Tensor) else freq,
+                   dtype="float64")
+    if htk:
+        mel = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        if mel.ndim:
+            log_t = f >= min_log_hz
+            mel = np.where(log_t, min_log_mel + np.log(
+                np.maximum(f, min_log_hz) / min_log_hz) / logstep, mel)
+        elif f >= min_log_hz:
+            mel = min_log_mel + math.log(f / min_log_hz) / logstep
+    return float(mel) if scalar else mel
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = not hasattr(mel, "__len__") and not isinstance(mel, Tensor)
+    m = np.asarray(mel.numpy() if isinstance(mel, Tensor) else mel,
+                   dtype="float64")
+    if htk:
+        hz = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        hz = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        if hz.ndim:
+            log_t = m >= min_log_mel
+            hz = np.where(log_t, min_log_hz * np.exp(
+                logstep * (m - min_log_mel)), hz)
+        elif m >= min_log_mel:
+            hz = min_log_hz * math.exp(logstep * (m - min_log_mel))
+    return float(hz) if scalar else hz
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    mels = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                       n_mels)
+    return Tensor(mel_to_hz(mels, htk).astype(dtype))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    return Tensor(np.linspace(0, sr / 2, 1 + n_fft // 2).astype(dtype))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """[n_mels, 1 + n_fft//2] mel filterbank (reference:
+    functional.py compute_fbank_matrix)."""
+    f_max = f_max or sr / 2.0
+    fftfreqs = np.linspace(0, sr / 2, 1 + n_fft // 2)
+    mel_f = np.asarray(
+        mel_frequencies(n_mels + 2, f_min, f_max, htk).numpy(),
+        dtype="float64")
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights *= enorm[:, None]
+    return Tensor(weights.astype(dtype))
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """[n_mels, n_mfcc] DCT-II basis (reference: functional.py
+    create_dct)."""
+    n = np.arange(n_mels, dtype="float64")
+    k = np.arange(n_mfcc, dtype="float64")[None, :]
+    dct = np.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return Tensor(dct.astype(dtype))
+
+
+@op
+def power_to_db(x, ref_value=1.0, amin=1e-10, top_db=80.0):
+    log_spec = 10.0 * (jnp.log10(jnp.maximum(amin, x))
+                       - jnp.log10(jnp.maximum(amin, ref_value)))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+    return log_spec
+
+
+_WINDOWS = {}
+
+
+def _window_fn(name):
+    def hann(M, sym):
+        return _general_cosine(M, [0.5, 0.5], sym)
+
+    def hamming(M, sym):
+        return _general_cosine(M, [0.54, 0.46], sym)
+
+    def blackman(M, sym):
+        return _general_cosine(M, [0.42, 0.5, 0.08], sym)
+
+    def bohman(M, sym):
+        n = _extend(M, sym)
+        fac = np.abs(np.linspace(-1, 1, n))
+        w = (1 - fac) * np.cos(np.pi * fac) + 1.0 / np.pi * np.sin(
+            np.pi * fac)
+        return _trunc(w, M, sym)
+
+    def rect(M, sym):
+        return np.ones(M)
+
+    def triang(M, sym):
+        n = _extend(M, sym)
+        i = np.arange(1, (n + 1) // 2 + 1)
+        if n % 2 == 0:
+            w = (2 * i - 1.0) / n
+            w = np.concatenate([w, w[::-1]])
+        else:
+            w = 2 * i / (n + 1.0)
+            w = np.concatenate([w, w[-2::-1]])
+        return _trunc(w, M, sym)
+
+    return {"hann": hann, "hamming": hamming, "blackman": blackman,
+            "bohman": bohman, "rect": rect, "boxcar": rect,
+            "triang": triang}[name]
+
+
+def _extend(M, sym):
+    return M if sym else M + 1
+
+
+def _trunc(w, M, sym):
+    return w if sym else w[:-1]
+
+
+def _general_cosine(M, a, sym):
+    n = _extend(M, sym)
+    fac = np.linspace(-np.pi, np.pi, n)
+    w = np.zeros(n)
+    for k, coeff in enumerate(a):
+        w += coeff * np.cos(k * fac)
+    return _trunc(w, M, sym)
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """Reference: window.py get_window."""
+    if isinstance(window, tuple):
+        name = window[0]
+    else:
+        name = window
+    w = _window_fn(name)(win_length, sym=not fftbins)
+    return Tensor(np.asarray(w, dtype=dtype))

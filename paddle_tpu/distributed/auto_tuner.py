@@ -1,0 +1,140 @@
+"""paddle.distributed.auto_tuner — parallel-config search.
+
+Reference: python/paddle/distributed/auto_tuner/ (tuner.py:21 Tuner,
+search.py GridSearch, prune.py:143 invalid-config pruning,
+recorder.py History sorting).
+
+TPU formulation: candidates are (pp, dp, tp, sharding stage, micro
+batch) factorizations of the chip count; pruning uses divisibility and a
+first-order HBM model (params/grads/optimizer state sharded by
+dp-sharding and tp, activations by remat policy).  run_fn measures a
+real trial (the driver typically passes a jitted train-step timing fn);
+the recorder keeps history sorted by the metric.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+
+__all__ = ["Tuner", "Recorder", "candidate_configs", "prune_invalid",
+           "estimate_hbm_bytes"]
+
+
+def candidate_configs(num_devices, model=None, max_micro=8):
+    """All (pp, dp, tp) factorizations × sharding stage × micro-batch."""
+    out = []
+    for pp in _divisors(num_devices):
+        rem = num_devices // pp
+        for dp in _divisors(rem):
+            tp = rem // dp
+            for stage in (0, 1, 2, 3):
+                for micro in (m for m in (1, 2, 4, 8) if m <= max_micro):
+                    out.append({"pp": pp, "dp": dp, "tp": tp,
+                                "sharding_stage": stage,
+                                "micro_batch": micro})
+    return out
+
+
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def estimate_hbm_bytes(cfg, num_params, hidden=4096, layers=32, seq=4096,
+                       batch=8, bytes_param=2, bytes_opt=12, remat=True):
+    """First-order per-chip HBM model (reference: the memory cost model
+    in auto_tuner/prune.py + cost/)."""
+    tp = cfg["tp"]
+    pp = cfg["pp"]
+    dp = cfg["dp"]
+    stage = cfg["sharding_stage"]
+    shard_params = tp * pp * (dp if stage >= 3 else 1)
+    shard_opt = tp * pp * (dp if stage >= 1 else 1)
+    shard_grad = tp * pp * (dp if stage >= 2 else 1)
+    p = num_params * bytes_param / shard_params
+    o = num_params * bytes_opt / shard_opt
+    g = num_params * bytes_param / shard_grad
+    mb = max(batch // (dp * cfg["micro_batch"]), 1)
+    act_per_layer = mb * seq * hidden * 2
+    acts = act_per_layer * (1 if remat else layers) * \
+        (layers // pp) / tp
+    return p + o + g + acts
+
+
+def prune_invalid(configs, num_devices, model_cfg=None, hbm_limit=None,
+                  layers=None, batch=None):
+    """Divisibility + memory pruning (reference: prune.py:143)."""
+    out = []
+    layers = layers or (model_cfg or {}).get("layers", 32)
+    batch = batch or (model_cfg or {}).get("batch", 8)
+    for c in configs:
+        if c["pp"] * c["dp"] * c["tp"] != num_devices:
+            continue
+        if layers % c["pp"]:
+            continue
+        if batch % (c["dp"] * c["micro_batch"]):
+            continue
+        if c["sharding_stage"] and c["dp"] == 1:
+            continue
+        if hbm_limit and model_cfg:
+            need = estimate_hbm_bytes(
+                c, model_cfg["num_params"],
+                hidden=model_cfg.get("hidden", 4096),
+                layers=layers, seq=model_cfg.get("seq", 4096),
+                batch=batch)
+            if need > hbm_limit:
+                continue
+        out.append(c)
+    return out
+
+
+class Recorder:
+    """Reference: recorder.py History."""
+
+    def __init__(self):
+        self.history = []
+
+    def add(self, cfg, metric, error=None):
+        self.history.append({"config": cfg, "metric": metric,
+                             "error": error})
+
+    def best(self, mode="max"):
+        ok = [h for h in self.history if h["error"] is None
+              and h["metric"] is not None]
+        if not ok:
+            return None
+        return (max if mode == "max" else min)(
+            ok, key=lambda h: h["metric"])
+
+    def save(self, path):
+        with open(path, "w") as f:
+            json.dump(self.history, f, indent=2)
+
+
+class Tuner:
+    """Grid search over pruned candidates (reference: tuner.py:21)."""
+
+    def __init__(self, num_devices, model_cfg=None, hbm_limit=None,
+                 max_trials=None, mode="max"):
+        self.num_devices = num_devices
+        self.model_cfg = model_cfg
+        self.hbm_limit = hbm_limit
+        self.max_trials = max_trials
+        self.mode = mode
+        self.recorder = Recorder()
+        cands = candidate_configs(num_devices)
+        self.candidates = prune_invalid(cands, num_devices, model_cfg,
+                                        hbm_limit)
+
+    def tune(self, run_fn):
+        """run_fn(cfg) -> metric (e.g. tokens/s); exceptions recorded as
+        failed trials (reference: the trial-job launcher)."""
+        for i, cfg in enumerate(self.candidates):
+            if self.max_trials is not None and i >= self.max_trials:
+                break
+            try:
+                metric = run_fn(cfg)
+                self.recorder.add(cfg, metric)
+            except Exception as e:     # failed trial, keep searching
+                self.recorder.add(cfg, None, error=str(e))
+        best = self.recorder.best(self.mode)
+        return best["config"] if best else None

@@ -1,0 +1,97 @@
+"""Elastic training manager.
+
+Reference: python/paddle/distributed/fleet/elastic/manager.py
+(ElasticManager:125 — etcd registration with TTL leases, scale in/out
+watch, ELASTIC_EXIT_CODE=101 signalling the launcher to relaunch).
+
+TPU formulation: the KV substrate is the framework TCPStore (csrc/
+tcp_store.cc) instead of etcd; ranks enroll with heartbeats, the manager
+detects missing heartbeats or world-size changes, and signals the
+launcher via the same dedicated exit code.  On TPU pods the coordinator
+restart + dist-checkpoint resume path replaces per-rank NCCL rebuild.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+ELASTIC_EXIT_CODE = 101
+ELASTIC_TTL = 60
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    def __init__(self, store=None, job_id=None, np=None, ttl=ELASTIC_TTL,
+                 heartbeat_interval=3):
+        from ..store import create_or_get_global_tcp_store
+
+        self.store = store if store is not None else \
+            create_or_get_global_tcp_store()
+        self.job_id = job_id or os.getenv("PADDLE_ELASTIC_JOB_ID",
+                                          "default")
+        self.np = int(np or os.getenv("PADDLE_TRAINERS_NUM", "1"))
+        self.rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        self.ttl = ttl
+        self.interval = heartbeat_interval
+        self._stop = threading.Event()
+        self._thread = None
+        self.enrolled = False
+
+    # ---------------------------------------------------------- enrol
+    def _key(self, rank):
+        return f"/elastic/{self.job_id}/{rank}"
+
+    def enroll(self):
+        self.store.set(self._key(self.rank), str(time.time()))
+        self.enrolled = True
+
+    def start_heartbeat(self):
+        self.enroll()
+
+        def beat():
+            while not self._stop.wait(self.interval):
+                self.store.set(self._key(self.rank), str(time.time()))
+
+        self._thread = threading.Thread(target=beat, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+    # ---------------------------------------------------------- watch
+    def alive_ranks(self):
+        now = time.time()
+        alive = []
+        for r in range(self.np):
+            try:
+                ts = float(self.store.get(self._key(r)))
+            except Exception:
+                continue
+            if now - ts <= self.ttl:
+                alive.append(r)
+        return alive
+
+    def health_check(self):
+        """ElasticStatus for the current gang (reference:
+        manager.py watch loop)."""
+        alive = self.alive_ranks()
+        if len(alive) == self.np:
+            return ElasticStatus.COMPLETED if self._stop.is_set() else \
+                ElasticStatus.HOLD
+        if len(alive) == 0:
+            return ElasticStatus.EXIT
+        return ElasticStatus.RESTART
+
+    def exit_for_restart(self):
+        """Signal the launcher to relaunch this gang."""
+        os._exit(ELASTIC_EXIT_CODE)

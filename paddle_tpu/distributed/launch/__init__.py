@@ -1,0 +1,121 @@
+"""paddle.distributed.launch — multi-process launcher CLI.
+
+Reference: python/paddle/distributed/launch/ (main.py CLI,
+controllers/collective.py rank env + spawn, controllers/master.py KV
+rendezvous, fleet/elastic/manager.py restart loop).
+
+TPU formulation: per-process env carries BOTH the Paddle-shaped vars
+(PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER) and the jax
+coordination-service vars (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+JAX_PROCESS_ID) so `jax.distributed.initialize()` — the TPU analog of
+ProcessGroup init over TCPStore — picks them up with no arguments.
+Elastic = watch children, restart the gang on a failed rank
+(ELASTIC_EXIT_CODE semantics from fleet/elastic/manager.py:33).
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+ELASTIC_EXIT_CODE = 101
+
+
+def build_rank_env(rank, nprocs, master, base_env=None, device_ids=None):
+    """Per-rank environment (reference: controllers/collective.py
+    build_pod -> _get_entrypoint env assembly)."""
+    env = dict(base_env if base_env is not None else os.environ)
+    env.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(nprocs),
+        "PADDLE_MASTER": master,
+        "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{6170 + rank}",
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(
+            f"127.0.0.1:{6170 + r}" for r in range(nprocs)),
+        # jax coordination service (jax.distributed.initialize reads these)
+        "JAX_COORDINATOR_ADDRESS": master,
+        "JAX_NUM_PROCESSES": str(nprocs),
+        "JAX_PROCESS_ID": str(rank),
+        "FLAGS_selected_devices": str(
+            device_ids[rank] if device_ids else rank),
+    })
+    return env
+
+
+class Launcher:
+    """Spawn + watch one local gang (reference: the launcher controller
+    loop launch/controllers/controller.py)."""
+
+    def __init__(self, cmd, nprocs, master=None, log_dir=None,
+                 max_restarts=0, elastic=False, device_ids=None):
+        self.cmd = cmd
+        self.nprocs = nprocs
+        self.master = master or "127.0.0.1:8765"
+        self.log_dir = log_dir
+        self.max_restarts = max_restarts
+        self.elastic = elastic
+        self.device_ids = device_ids
+        self.procs: list[subprocess.Popen] = []
+
+    def _spawn(self):
+        self.procs = []
+        for rank in range(self.nprocs):
+            env = build_rank_env(rank, self.nprocs, self.master,
+                                 device_ids=self.device_ids)
+            stdout = None
+            if self.log_dir:
+                os.makedirs(self.log_dir, exist_ok=True)
+                stdout = open(os.path.join(self.log_dir,
+                                           f"workerlog.{rank}"), "w")
+            p = subprocess.Popen(self.cmd, env=env, stdout=stdout,
+                                 stderr=subprocess.STDOUT if stdout
+                                 else None)
+            p._rank = rank
+            self.procs.append(p)
+
+    def _kill_all(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 10
+        for p in self.procs:
+            try:
+                p.wait(max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    def run(self):
+        restarts = 0
+        while True:
+            self._spawn()
+            code = self._watch()
+            if code == 0:
+                return 0
+            if (self.elastic or code == ELASTIC_EXIT_CODE) and \
+                    restarts < self.max_restarts:
+                restarts += 1
+                print(f"[launch] rank failure (exit {code}); elastic "
+                      f"restart {restarts}/{self.max_restarts}",
+                      file=sys.stderr)
+                continue
+            return code
+
+    def _watch(self):
+        """Poll children; on any failure kill the gang (reference:
+        watcher loop in launch/controllers/watcher.py)."""
+        while True:
+            alive = False
+            for p in self.procs:
+                code = p.poll()
+                if code is None:
+                    alive = True
+                elif code != 0:
+                    print(f"[launch] rank {p._rank} exited with {code}; "
+                          "terminating gang", file=sys.stderr)
+                    self._kill_all()
+                    return code
+            if not alive:
+                return 0
+            time.sleep(0.2)

@@ -1,0 +1,55 @@
+"""CLI entry: python -m paddle_tpu.distributed.launch [...] script.py args
+
+Reference: python/paddle/distributed/launch/main.py argument surface
+(--nnodes, --nproc_per_node, --master, --log_dir, --elastic_level,
+--max_restart) restricted to the single-host collective controller; the
+multi-host path on TPU pods is jax's coordination service with the same
+env contract (see __init__.build_rank_env).
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import Launcher
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(prog="paddle_tpu.distributed.launch")
+    ap.add_argument("--nnodes", type=str, default="1",
+                    help="node count or range (elastic)")
+    ap.add_argument("--nproc_per_node", type=int, default=None)
+    ap.add_argument("--devices", type=str, default=None,
+                    help="comma-separated device ids")
+    ap.add_argument("--master", type=str, default=None,
+                    help="coordinator host:port")
+    ap.add_argument("--rank", type=int, default=-1)
+    ap.add_argument("--log_dir", type=str, default=None)
+    ap.add_argument("--run_mode", type=str, default="collective")
+    ap.add_argument("--job_id", type=str, default="default")
+    ap.add_argument("--max_restart", type=int, default=3)
+    ap.add_argument("--elastic_level", type=int, default=-1)
+    ap.add_argument("script", type=str)
+    ap.add_argument("script_args", nargs=argparse.REMAINDER)
+    return ap.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    device_ids = None
+    if args.devices:
+        device_ids = [int(d) for d in args.devices.split(",")]
+    if args.nproc_per_node is None:
+        nprocs = len(device_ids) if device_ids else 1
+    else:
+        nprocs = args.nproc_per_node
+    cmd = [sys.executable, "-u", args.script] + args.script_args
+    launcher = Launcher(
+        cmd, nprocs, master=args.master, log_dir=args.log_dir,
+        max_restarts=args.max_restart,
+        elastic=args.elastic_level >= 0, device_ids=device_ids)
+    return launcher.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,84 @@
+"""paddle.geometric — graph message passing + segment ops.
+
+Reference: python/paddle/geometric/ (message_passing/send_recv.py
+send_u_recv/send_ue_recv, math.py segment_sum/mean/max/min; kernels
+paddle/phi/kernels/*/graph_send_recv_kernel.*, segment_pool_kernel.*).
+
+TPU formulation: all of these are jax segment reductions
+(jax.ops.segment_*) — static num_segments keeps them jit-compatible, and
+XLA lowers scatter-reduce natively.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.registry import op
+
+__all__ = ["send_u_recv", "send_ue_recv", "segment_sum", "segment_mean",
+           "segment_max", "segment_min"]
+
+
+def _num_segments(count, x):
+    if count is None:
+        raise ValueError(
+            "out_size/num_segments must be given under TPU/XLA: dynamic "
+            "segment counts would make shapes data-dependent (pass "
+            "out_size=<num nodes>)")
+    return int(count)
+
+
+@op
+def segment_sum(data, segment_ids, num_segments=None):
+    n = _num_segments(num_segments, data)
+    return jax.ops.segment_sum(data, segment_ids, num_segments=n)
+
+
+@op
+def segment_mean(data, segment_ids, num_segments=None):
+    n = _num_segments(num_segments, data)
+    tot = jax.ops.segment_sum(data, segment_ids, num_segments=n)
+    cnt = jax.ops.segment_sum(jnp.ones_like(segment_ids, jnp.float32),
+                              segment_ids, num_segments=n)
+    return tot / jnp.maximum(cnt, 1.0)[
+        (...,) + (None,) * (data.ndim - 1)]
+
+
+@op
+def segment_max(data, segment_ids, num_segments=None):
+    n = _num_segments(num_segments, data)
+    return jax.ops.segment_max(data, segment_ids, num_segments=n)
+
+
+@op
+def segment_min(data, segment_ids, num_segments=None):
+    n = _num_segments(num_segments, data)
+    return jax.ops.segment_min(data, segment_ids, num_segments=n)
+
+
+_POOLS = {"sum": segment_sum, "add": segment_sum, "mean": segment_mean,
+          "max": segment_max, "min": segment_min}
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None):
+    """Gather x[src] then segment-reduce onto dst (reference:
+    send_recv.py send_u_recv)."""
+    from ..ops.manipulation import gather
+    msgs = gather(x, src_index)
+    if out_size is None:
+        out_size = x.shape[0]
+    return _POOLS[reduce_op](msgs, dst_index, num_segments=out_size)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None):
+    """Node ⊕ edge messages then reduce (reference: send_ue_recv)."""
+    from ..ops.manipulation import gather
+    from ..ops import math as M
+    msgs = gather(x, src_index)
+    combine = {"add": M.add, "sub": M.subtract, "mul": M.multiply,
+               "div": M.divide}[message_op]
+    msgs = combine(msgs, y)
+    if out_size is None:
+        out_size = x.shape[0]
+    return _POOLS[reduce_op](msgs, dst_index, num_segments=out_size)

@@ -1,0 +1,13 @@
+"""paddle.incubate — experimental API surface.
+
+Reference: python/paddle/incubate/ (42.4k LoC; the load-bearing pieces
+are nn/functional fused ops — fused_rms_norm, fused_dropout_add,
+fused_linear, fused_rotary_position_embedding — plus asp 2:4 sparsity
+and the distributed MoE models re-exported here).
+"""
+from __future__ import annotations
+
+from . import nn  # noqa: F401
+from . import asp  # noqa: F401
+
+__all__ = ["nn", "asp"]

@@ -1,0 +1,123 @@
+"""paddle.incubate.asp — automatic structured (2:4) sparsity.
+
+Reference: python/paddle/incubate/asp/ (calculate_density, 1D/2D best
+mask algorithms asp/utils.py, prune_model, decorate masking the
+optimizer step).
+
+TPU formulation: masks are plain arrays applied after each optimizer
+step (the reference's OptimizerWithSparsityGuarantee does the same); the
+MXU has no sparse-tensor-core analog, so 2:4 here preserves the
+semantics/workflow (mask correctness, density accounting) rather than a
+kernel speedup.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["calculate_density", "create_mask", "check_mask_2d",
+           "check_mask_1d", "prune_model", "decorate", "reset_excluded_layers",
+           "set_excluded_layers"]
+
+_excluded: set = set()
+
+
+def calculate_density(x):
+    arr = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def _best_nm_mask_1d(mat, n=2, m=4):
+    """Keep the n largest |values| in every group of m along rows."""
+    rows, cols = mat.shape
+    pad = (-cols) % m
+    if pad:
+        mat = np.concatenate([mat, np.zeros((rows, pad), mat.dtype)], 1)
+    g = np.abs(mat).reshape(rows, -1, m)
+    idx = np.argsort(g, axis=-1)[..., ::-1][..., :n]
+    mask = np.zeros_like(g, dtype=bool)
+    np.put_along_axis(mask, idx, True, axis=-1)
+    mask = mask.reshape(rows, -1)[:, :cols]
+    return mask
+
+
+def create_mask(tensor, func_name="mask_1d", n=2, m=4):
+    arr = np.asarray(tensor.numpy() if hasattr(tensor, "numpy")
+                     else tensor)
+    shape = arr.shape
+    mat = arr.reshape(shape[0], -1) if arr.ndim > 1 else arr.reshape(1, -1)
+    mask = _best_nm_mask_1d(mat, n=n, m=m).reshape(shape)
+    return mask
+
+
+def check_mask_1d(mat, n=2, m=4):
+    arr = np.asarray(mat.numpy() if hasattr(mat, "numpy") else mat)
+    flat = arr.reshape(arr.shape[0], -1) if arr.ndim > 1 else \
+        arr.reshape(1, -1)
+    cols = flat.shape[1]
+    pad = (-cols) % m
+    if pad:
+        flat = np.concatenate(
+            [flat, np.zeros((flat.shape[0], pad), flat.dtype)], 1)
+    groups = flat.reshape(flat.shape[0], -1, m)
+    return bool(np.all(np.count_nonzero(groups, axis=-1) <= n))
+
+
+check_mask_2d = check_mask_1d
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _excluded.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def _prunable(model):
+    for layer in model.sublayers(include_self=True):
+        w = getattr(layer, "weight", None)
+        if w is None or w.ndim < 2 or w.name in _excluded:
+            continue
+        yield w
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply 2:4 masks to prunable weights; returns name->mask."""
+    import jax.numpy as jnp
+    masks = {}
+    for w in _prunable(model):
+        mask = create_mask(w, func_name=mask_algo, n=n, m=m)
+        w._data = w._data * jnp.asarray(mask, w._data.dtype)
+        masks[w.name] = mask
+    return masks
+
+
+class OptimizerWithSparsityGuarantee:
+    """Reference: asp.py decorate — re-applies masks after each step."""
+
+    def __init__(self, optimizer, masks=None):
+        self._inner = optimizer
+        self._masks = masks or {}
+
+    def _attach(self, model, n=2, m=4):
+        self._masks = prune_model(model, n=n, m=m)
+        self._params = {w.name: w for w in _prunable(model)}
+        return self
+
+    def step(self):
+        import jax.numpy as jnp
+        self._inner.step()
+        for name, mask in self._masks.items():
+            p = self._params.get(name)
+            if p is not None:
+                p._data = p._data * jnp.asarray(mask, p._data.dtype)
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+
+def decorate(optimizer, model=None, n=2, m=4):
+    dec = OptimizerWithSparsityGuarantee(optimizer)
+    if model is not None:
+        dec._attach(model, n=n, m=m)
+    return dec

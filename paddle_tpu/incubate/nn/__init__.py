@@ -1,0 +1,10 @@
+"""paddle.incubate.nn — fused layers + functional.
+
+Reference: python/paddle/incubate/nn/ (FusedMultiHeadAttention,
+FusedFeedForward layer classes over the fused_* functional ops)."""
+from __future__ import annotations
+
+from . import functional  # noqa: F401
+from .layers import FusedMultiHeadAttention, FusedFeedForward  # noqa: F401
+
+__all__ = ["functional", "FusedMultiHeadAttention", "FusedFeedForward"]

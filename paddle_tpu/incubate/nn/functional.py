@@ -1,0 +1,217 @@
+"""paddle.incubate.nn.functional — fused ops.
+
+Reference: python/paddle/incubate/nn/functional/ (fused_rms_norm.py,
+fused_layer_norm.py, fused_dropout_add.py, fused_linear.py,
+fused_rotary_position_embedding.py, fused_transformer.py; CUDA kernels
+in paddle/phi/kernels/fusion/).
+
+TPU formulation: the hot ones hit Pallas kernels (rms_norm, flash sdpa);
+the rest are single jax expressions XLA fuses on its own — the API shape
+is kept so incubate users port unchanged.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.registry import op
+from ...ops.pallas.rms_norm import rms_norm as _pallas_rms_norm
+
+__all__ = ["fused_rms_norm", "fused_layer_norm", "fused_dropout_add",
+           "fused_linear", "fused_linear_activation",
+           "fused_rotary_position_embedding", "fused_bias_act",
+           "fused_multi_head_attention", "fused_feedforward"]
+
+
+@op
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, bias=None, residual=None,
+                   quant_scale=-1, **kwargs):
+    if residual is not None:
+        x = x + residual
+    if bias is not None:
+        x = x + bias
+    out = _pallas_rms_norm(x, norm_weight, eps=epsilon)
+    if norm_bias is not None:
+        out = out + norm_bias
+    if residual is not None:
+        return out, x          # reference returns (out, residual_out)
+    return out
+
+
+@op
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=-1, bias=None, residual=None,
+                     **kwargs):
+    if residual is not None:
+        x = x + residual
+    if bias is not None:
+        x = x + bias
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    out = ((xf - mean) * jax.lax.rsqrt(var + epsilon)).astype(x.dtype)
+    if norm_weight is not None:
+        out = out * norm_weight
+    if norm_bias is not None:
+        out = out + norm_bias
+    if residual is not None:
+        return out, x
+    return out
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """Reference: fused_dropout_add.py — dropout(x) + y in one pass."""
+    from ...nn import functional as F
+    return F.dropout(x, p=p, training=training, mode=mode) + y
+
+
+@op
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    w = weight.T if transpose_weight else weight
+    out = x @ w
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@op
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    a = x.T if trans_x else x
+    b = y.T if trans_y else y
+    out = a @ b + bias
+    if activation == "gelu":
+        return jax.nn.gelu(out)
+    if activation == "relu":
+        return jax.nn.relu(out)
+    return out
+
+
+@op
+def fused_bias_act(x, bias=None, act_method="gelu", **kwargs):
+    if bias is not None:
+        x = x + bias
+    return {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+            "silu": jax.nn.silu, "swiglu": _swiglu}[act_method](x)
+
+
+def _swiglu(x):
+    a, b = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(a) * b
+
+
+@op
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True):
+    """Reference: fused_rotary_position_embedding.py; [B, S, H, D]."""
+    s, d = q.shape[1], q.shape[-1]
+    if sin is None or cos is None:
+        inv = 1.0 / (10000.0 ** (jnp.arange(0, d, 2, dtype=jnp.float32)
+                                 / d))
+        t = jnp.arange(s, dtype=jnp.float32)
+        freqs = jnp.outer(t, inv)
+        if use_neox_rotary_style:
+            emb = jnp.concatenate([freqs, freqs], axis=-1)
+        else:
+            emb = jnp.repeat(freqs, 2, axis=-1)
+        cos = jnp.cos(emb)[None, :, None, :]
+        sin = jnp.sin(emb)[None, :, None, :]
+    if position_ids is not None:
+        cos = jnp.squeeze(cos, (0, 2))[position_ids][:, :, None, :]
+        sin = jnp.squeeze(sin, (0, 2))[position_ids][:, :, None, :]
+
+    def rot_half(x):
+        if use_neox_rotary_style:
+            a, b = jnp.split(x, 2, axis=-1)
+            return jnp.concatenate([-b, a], axis=-1)
+        x2 = x.reshape(*x.shape[:-1], -1, 2)
+        a, b = x2[..., 0], x2[..., 1]
+        return jnp.stack([-b, a], axis=-1).reshape(x.shape)
+
+    def apply(x):
+        return (x * cos + rot_half(x) * sin).astype(x.dtype) \
+            if x is not None else None
+
+    outs = tuple(apply(t) for t in (q, k, v))
+    return outs
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5,
+                               qkv_bias=None, linear_bias=None,
+                               cache_kv=None, attn_mask=None,
+                               dropout_rate=0.0, attn_dropout_rate=0.0,
+                               ln_epsilon=1e-5, training=True, mode=None,
+                               name=None):
+    """Reference: fused_transformer.py fused_multi_head_attention —
+    (optional pre-LN) + QKV proj + flash sdpa + out proj + residual + LN.
+    qkv_weight: [3, num_heads, head_dim, embed_dim]."""
+    from ...nn import functional as F
+    from ...ops.pallas.flash_attention import sdpa as _sdpa
+    from ...ops import manipulation as M
+    from ...ops import math as Om
+    from ...ops.linalg import matmul
+
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, [x.shape[-1]], weight=pre_ln_scale,
+                         bias=pre_ln_bias, epsilon=pre_ln_epsilon)
+    three, h, hd, e = qkv_weight.shape
+    w = M.reshape(M.transpose(qkv_weight, [3, 0, 1, 2]), [e, 3 * h * hd])
+    qkv = matmul(x, w)
+    if qkv_bias is not None:
+        qkv = qkv + M.reshape(qkv_bias, [-1])
+    b, s = x.shape[0], x.shape[1]
+    qkv = M.reshape(qkv, [b, s, 3, h, hd])
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    o = F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask, dropout_p=attn_dropout_rate,
+        training=training)
+    o = M.reshape(o, [b, s, h * hd])
+    out = matmul(o, linear_weight)
+    if linear_bias is not None:
+        out = out + linear_bias
+    if dropout_rate:
+        out = F.dropout(out, p=dropout_rate, training=training)
+    out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, [out.shape[-1]], weight=ln_scale,
+                           bias=ln_bias, epsilon=ln_epsilon)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, mode=None,
+                      name=None):
+    """Reference: fused_transformer.py fused_feedforward."""
+    from ...nn import functional as F
+    from ...ops.linalg import matmul
+
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, [x.shape[-1]], weight=ln1_scale, bias=ln1_bias,
+                         epsilon=ln1_epsilon)
+    h = matmul(x, linear1_weight)
+    if linear1_bias is not None:
+        h = h + linear1_bias
+    h = getattr(F, activation)(h)
+    if dropout1_rate:
+        h = F.dropout(h, p=dropout1_rate, training=training)
+    h = matmul(h, linear2_weight)
+    if linear2_bias is not None:
+        h = h + linear2_bias
+    if dropout2_rate:
+        h = F.dropout(h, p=dropout2_rate, training=training)
+    out = residual + h
+    if not pre_layer_norm:
+        out = F.layer_norm(out, [out.shape[-1]], weight=ln2_scale,
+                           bias=ln2_bias, epsilon=ln2_epsilon)
+    return out

@@ -1,0 +1,316 @@
+"""paddle.quantization — QAT / PTQ framework.
+
+Reference: python/paddle/quantization/ (QuantConfig config.py, QAT
+qat.py, PTQ ptq.py, quanters/ FakeQuanterWithAbsMaxObserver, observers/,
+quanted layers in nn/quant/) — 3.9k LoC of the dygraph quantization
+stack (the static-graph variant lives in python/paddle/static/quantization).
+
+TPU formulation: fake-quant is a pure jax op with a straight-through
+estimator via jax.custom_vjp (reference: fake_quantize_dequantize kernels
+paddle/phi/kernels/fake_quantize_kernel.*); int8 deployment maps onto
+XLA's native int8 matmul support — `convert` keeps weights int8 +
+per-tensor scale.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer import Layer
+from ..framework.tensor import Tensor
+from ..ops.registry import op
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "quanters", "observers",
+           "fake_quant_dequant_abs_max"]
+
+
+# ------------------------------------------------------------ fake quant
+@jax.custom_vjp
+def _fqdq(x, scale, qmax):
+    q = jnp.clip(jnp.round(x / scale * qmax), -qmax, qmax)
+    return q * scale / qmax
+
+
+def _fqdq_fwd(x, scale, qmax):
+    return _fqdq(x, scale, qmax), (x, scale)
+
+
+def _fqdq_bwd(res, g):
+    x, scale = res
+    # straight-through estimator, zeroed outside the clip range
+    mask = (jnp.abs(x) <= scale).astype(g.dtype)
+    return g * mask, jnp.zeros_like(scale), None
+
+
+_fqdq.defvjp(_fqdq_fwd, _fqdq_bwd)
+
+
+@op
+def fake_quant_dequant_abs_max(x, bit_length=8, scale=None):
+    """Quantize-dequantize with abs-max scale + STE gradient."""
+    qmax = float(2 ** (bit_length - 1) - 1)
+    if scale is None:
+        scale = jnp.maximum(jnp.max(jnp.abs(x)), 1e-9).astype(jnp.float32)
+    return _fqdq(x.astype(jnp.float32), scale, qmax).astype(x.dtype)
+
+
+# -------------------------------------------------------------- observers
+class BaseObserver(Layer):
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self._scale = None
+
+    def scales(self):
+        return self._scale
+
+    def quant_axis(self):
+        return None
+
+    def zero_points(self):
+        return 0.0
+
+
+class AbsmaxObserver(BaseObserver):
+    """Running abs-max (reference: observers/abs_max.py)."""
+
+    def forward(self, x):
+        m = float(jnp.max(jnp.abs(x._data)))
+        self._scale = m if self._scale is None else max(self._scale, m)
+        return x
+
+
+class EMAObserver(BaseObserver):
+    """Exponential-moving-average abs-max (reference:
+    quanters/abs_max.py moving-average state)."""
+
+    def __init__(self, quant_bits=8, moving_rate=0.9):
+        super().__init__(quant_bits)
+        self.moving_rate = moving_rate
+
+    def forward(self, x):
+        m = float(jnp.max(jnp.abs(x._data)))
+        self._scale = m if self._scale is None else (
+            self.moving_rate * self._scale + (1 - self.moving_rate) * m)
+        return x
+
+
+class observers:
+    AbsmaxObserver = AbsmaxObserver
+    EMAObserver = EMAObserver
+
+
+# --------------------------------------------------------------- quanters
+class FakeQuanterWithAbsMaxObserver(Layer):
+    """QAT fake-quant node (reference:
+    quanters/abs_max.py FakeQuanterWithAbsMaxObserverLayer)."""
+
+    def __init__(self, moving_rate=0.9, bit_length=8, dtype="float32",
+                 name=None):
+        super().__init__()
+        self.bit_length = bit_length
+        self.moving_rate = moving_rate
+        self._scale = None
+
+    def forward(self, x):
+        m = float(jnp.max(jnp.abs(x._data)))
+        self._scale = m if self._scale is None else (
+            self.moving_rate * self._scale + (1 - self.moving_rate) * m)
+        scale = jnp.float32(max(self._scale, 1e-9))
+        return fake_quant_dequant_abs_max(x, bit_length=self.bit_length,
+                                          scale=scale)
+
+    def scales(self):
+        return self._scale
+
+
+class FakeQuanterChannelWiseAbsMaxObserver(Layer):
+    """Per-output-channel weight quanter (reference:
+    quanters/abs_max.py channel-wise variant)."""
+
+    def __init__(self, bit_length=8, quant_axis=0, **kwargs):
+        super().__init__()
+        self.bit_length = bit_length
+        self._quant_axis = quant_axis
+        self._scale = None
+
+    def forward(self, x):
+        qmax = float(2 ** (self.bit_length - 1) - 1)
+        axes = tuple(i for i in range(x.ndim) if i != self._quant_axis)
+        arr = x._data.astype(jnp.float32)
+        scale = jnp.maximum(jnp.max(jnp.abs(arr), axis=axes, keepdims=True),
+                            1e-9)
+        self._scale = np.asarray(scale).squeeze()
+        out = _fqdq(arr, scale, qmax)
+        return Tensor(out.astype(x._data.dtype),
+                      stop_gradient=x.stop_gradient)
+
+
+class quanters:
+    FakeQuanterWithAbsMaxObserver = FakeQuanterWithAbsMaxObserver
+    FakeQuanterChannelWiseAbsMaxObserver = \
+        FakeQuanterChannelWiseAbsMaxObserver
+
+
+# ----------------------------------------------------------------- config
+class QuantConfig:
+    """Reference: python/paddle/quantization/config.py."""
+
+    def __init__(self, activation=None, weight=None):
+        self._global_activation = activation
+        self._global_weight = weight
+        self._layer_configs = []       # (layer ids, act, weight)
+        self._type_configs = []        # (layer types, act, weight)
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        self._layer_configs.append((layers, activation, weight))
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) else \
+            [layer_type]
+        self._type_configs.append((tuple(types), activation, weight))
+
+    def _config_for(self, layer):
+        for layers, a, w in self._layer_configs:
+            if any(layer is l for l in layers):
+                return a, w
+        for types, a, w in self._type_configs:
+            if isinstance(layer, types):
+                return a, w
+        return self._global_activation, self._global_weight
+
+
+def _make(factory):
+    if factory is None:
+        return None
+    return factory() if callable(factory) else factory
+
+
+# --------------------------------------------------------- quanted layers
+class QuantedLayer(Layer):
+    """Wraps a leaf layer with activation/weight quant nodes (reference:
+    paddle/nn/quant/qat/ QuantedLinear/QuantedConv2D)."""
+
+    def __init__(self, inner, act_quanter, weight_quanter):
+        super().__init__()
+        self.inner = inner
+        self.activation_quanter = act_quanter
+        self.weight_quanter = weight_quanter
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        if self.weight_quanter is not None and hasattr(self.inner, "weight"):
+            w = self.inner.weight
+            qw = self.weight_quanter(w)
+            # swap the ATTRIBUTE (not w._data): qw keeps its tape node,
+            # so backward flows through the quanter's STE mask into the
+            # real Parameter; _parameters/state_dict still hold w
+            object.__setattr__(self.inner, "weight", qw)
+            try:
+                return self.inner(x)
+            finally:
+                object.__setattr__(self.inner, "weight", w)
+        return self.inner(x)
+
+
+class ConvertedLayer(Layer):
+    """Deploy form: int8 weights + scale (reference: nn/quant convert —
+    weight_quantize + int8 kernels; XLA handles int8 matmul natively)."""
+
+    def __init__(self, inner, bit_length=8):
+        super().__init__()
+        self.inner = inner
+        qmax = float(2 ** (bit_length - 1) - 1)
+        w = inner.weight._data.astype(jnp.float32)
+        scale = jnp.maximum(jnp.max(jnp.abs(w)), 1e-9)
+        self.register_buffer(
+            "qweight", Tensor(jnp.clip(jnp.round(w / scale * qmax),
+                                       -qmax, qmax).astype(jnp.int8)))
+        self.register_buffer("wscale", Tensor(scale / qmax))
+        self._wdtype = inner.weight._data.dtype
+
+    def forward(self, x):
+        w = (self.qweight._data.astype(jnp.float32)
+             * self.wscale._data).astype(self._wdtype)
+        orig = self.inner.weight._data
+        self.inner.weight._data = w
+        try:
+            return self.inner(x)
+        finally:
+            self.inner.weight._data = orig
+
+
+_QUANTABLE = ("Linear", "Conv2D", "Conv1D", "Conv3D")
+
+
+def _swap_layers(model, make_wrapper):
+    for name, sub in list(model._sub_layers.items()):
+        if type(sub).__name__ == "QuantedLayer":
+            continue
+        if type(sub).__name__ in _QUANTABLE:
+            repl = make_wrapper(sub)
+            if repl is not None:
+                # setattr, not _sub_layers[name]: Layer.__setattr__ keeps
+                # the registry AND the instance attribute in sync (a
+                # _sub_layers-only write leaves `self.fc` resolving to
+                # the original layer)
+                setattr(model, name, repl)
+        else:
+            _swap_layers(sub, make_wrapper)
+    return model
+
+
+def _quantize_model(config, model, inplace):
+    import copy
+    if not inplace:
+        model = copy.deepcopy(model)
+
+    def wrap(layer):
+        a, w = config._config_for(layer)
+        if a is None and w is None:
+            return None
+        return QuantedLayer(layer, _make(a), _make(w))
+
+    return _swap_layers(model, wrap)
+
+
+class QAT:
+    """Quantization-aware training (reference: quantization/qat.py)."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model, inplace=False):
+        return _quantize_model(self._config, model, inplace)
+
+    def convert(self, model, inplace=False):
+        return PTQ(self._config).convert(model, inplace=inplace)
+
+
+class PTQ:
+    """Post-training quantization (reference: quantization/ptq.py)."""
+
+    def __init__(self, config: QuantConfig = None):
+        self._config = config or QuantConfig(
+            activation=AbsmaxObserver, weight=AbsmaxObserver)
+
+    def quantize(self, model, inplace=False):
+        return _quantize_model(self._config, model, inplace)
+
+    def convert(self, model, inplace=False):
+        """Replace observed/quanted layers with int8-weight deploy form."""
+        import copy
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def convert_in(m):
+            for name, sub in list(m._sub_layers.items()):
+                if isinstance(sub, QuantedLayer):
+                    setattr(m, name, ConvertedLayer(sub.inner))
+                else:
+                    convert_in(sub)
+        convert_in(model)
+        return model

@@ -71,7 +71,7 @@ class Variable:
         return len(self.shape)
 
     def astype(self, dt):
-        from ..ops.math import cast
+        from ..ops.manipulation import cast
         return cast(self, dt)
 
     def detach(self):
@@ -154,13 +154,50 @@ class Program:
             self._param_refs.append(p)
 
     def clone(self, for_test=False):
-        import copy
         p = Program()
-        p.vars = dict(self.vars)
         p.feed_vars = dict(self.feed_vars)
         p.train_ops = [] if for_test else list(self.train_ops)
         p.stat_updates = [] if for_test else list(self.stat_updates)
         p._param_refs = list(self._param_refs)
+        if not for_test:
+            p.vars = dict(self.vars)
+            return p
+        # test clone: rebuild the DAG with training=False baked into node
+        # kwargs (reference: Program.clone(for_test=True) flips batch_norm
+        # to global stats / disables dropout via the is_test attribute)
+        from jax.tree_util import tree_flatten, tree_unflatten
+        from ..framework.tensor import Tensor
+
+        new_vars: dict[str, Variable] = {}
+        new_sources: dict[int, tuple] = {}
+
+        def remap_var(v):
+            if v.name in new_vars:
+                return new_vars[v.name]
+            if v.source is None:
+                nv = Variable(p, v.shape, v.dtype, name=v.name)
+            else:
+                if id(v.source) not in new_sources:
+                    body, args, kwargs, n_outs = v.source
+                    flat, td = tree_flatten(
+                        (args, kwargs),
+                        is_leaf=lambda x: isinstance(x, (Variable, Tensor)))
+                    flat = [remap_var(x) if isinstance(x, Variable) else x
+                            for x in flat]
+                    a2, k2 = tree_unflatten(td, flat)
+                    if isinstance(k2, dict) and "training" in k2:
+                        k2 = dict(k2, training=False)
+                    new_sources[id(v.source)] = (body, a2, k2, n_outs)
+                nv = Variable(p, v.shape, v.dtype, name=v.name,
+                              source=new_sources[id(v.source)],
+                              out_index=v.out_index)
+            new_vars[v.name] = nv
+            return nv
+
+        for v in self.vars.values():
+            remap_var(v)
+        p.vars = new_vars
+        p.feed_vars = {k: new_vars[k] for k in self.feed_vars}
         return p
 
     def global_block(self):

@@ -1,0 +1,106 @@
+"""paddle.text — text-domain utilities.
+
+Reference: python/paddle/text/ (datasets needing downloads are gated —
+zero-egress environment) + paddle.text.ViterbiDecoder
+(python/paddle/text/viterbi_decode.py; kernel
+paddle/phi/kernels/cpu/viterbi_decode_kernel.cc).
+
+TPU formulation: Viterbi forward recursion is one lax.scan over time
+(max-product messages), backtrace a reverse scan over the argmax trail —
+no dynamic shapes, jit-compilable.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..nn.layer import Layer
+from ..ops.registry import op
+
+__all__ = ["ViterbiDecoder", "viterbi_decode"]
+
+
+@op
+def viterbi_decode(potentials, transition, lengths,
+                   include_bos_eos_tag=True):
+    """potentials: [B, T, N] emissions; transition: [N, N];
+    lengths: [B] int.  Returns (scores [B], paths [B, T]).
+    Reference semantics: viterbi_decode_kernel.cc (with BOS/EOS rows
+    last-2/last-1 of the transition matrix when include_bos_eos_tag)."""
+    B, T, N = potentials.shape
+    trans = transition.astype(jnp.float32)
+    emis = potentials.astype(jnp.float32)
+
+    if include_bos_eos_tag:
+        bos, eos = N - 2, N - 1
+        init = emis[:, 0] + trans[bos][None, :]
+    else:
+        init = emis[:, 0]
+
+    def step(carry, t):
+        alpha, hist_dummy = carry
+        # scores[b, i, j] = alpha[b, i] + trans[i, j] + emis[b, t, j]
+        scores = alpha[:, :, None] + trans[None, :, :]
+        best_prev = jnp.argmax(scores, axis=1)            # [B, N]
+        best_score = jnp.max(scores, axis=1) + emis[:, t]
+        # masked steps (t >= length) carry alpha through unchanged
+        mask = (t < lengths)[:, None]
+        alpha_new = jnp.where(mask, best_score, alpha)
+        return (alpha_new, None), jnp.where(mask, best_prev, -1)
+
+    (alpha, _), back = jax.lax.scan(
+        step, (init, None), jnp.arange(1, T))
+    # back: [T-1, B, N] argmax trail
+    if include_bos_eos_tag:
+        alpha = alpha + trans[:, eos][None, :]
+
+    last_tag = jnp.argmax(alpha, axis=-1)                 # [B]
+    scores = jnp.max(alpha, axis=-1)
+
+    def backstep(tag, bp):
+        # bp: [B, N]; -1 rows (masked) keep current tag
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        tag_new = jnp.where(prev < 0, tag, prev)
+        return tag_new, tag
+
+    # reversed scan emits [tag_{T-1} ... tag_1]; the final carry is tag_0
+    first_tag, path_rev = jax.lax.scan(backstep, last_tag, back[::-1])
+    paths = jnp.concatenate(
+        [first_tag[:, None], path_rev[::-1].T], axis=1)   # [B, T]
+    return scores, paths.astype(jnp.int64)
+
+
+class ViterbiDecoder(Layer):
+    """Reference: paddle.text.ViterbiDecoder."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions if isinstance(transitions, Tensor) \
+            else Tensor(transitions)
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+def _dataset_stub(name):
+    class _Stub:
+        def __init__(self, *a, **k):
+            raise RuntimeError(
+                f"paddle.text.datasets.{name} downloads external data; "
+                "this environment has no egress. Point paddle_tpu.io."
+                "Dataset at a local copy instead.")
+    _Stub.__name__ = name
+    return _Stub
+
+
+class datasets:
+    Imdb = _dataset_stub("Imdb")
+    Imikolov = _dataset_stub("Imikolov")
+    Movielens = _dataset_stub("Movielens")
+    UCIHousing = _dataset_stub("UCIHousing")
+    WMT14 = _dataset_stub("WMT14")
+    WMT16 = _dataset_stub("WMT16")
+    Conll05st = _dataset_stub("Conll05st")

@@ -1,0 +1,42 @@
+"""paddle.utils — misc utilities (cpp_extension custom-op toolchain,
+deprecations, install checks).  Reference: python/paddle/utils/."""
+from __future__ import annotations
+
+from . import cpp_extension  # noqa: F401
+
+__all__ = ["cpp_extension", "run_check", "try_import", "unique_name"]
+
+
+def run_check():
+    """Reference: paddle.utils.run_check — smoke-test the install."""
+    import jax
+    import numpy as np
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.ones((2, 2), "float32"))
+    y = paddle.matmul(x, x)
+    assert float(y.sum()) == 8.0
+    dev = jax.devices()[0]
+    print(f"paddle_tpu is installed successfully! "
+          f"backend={dev.platform} device={dev.device_kind}")
+
+
+def try_import(module_name, err_msg=None):
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or f"{module_name} is not installed")
+
+
+class _UniqueName:
+    def __init__(self):
+        self._ids = {}
+
+    def generate(self, key="tmp"):
+        i = self._ids.get(key, 0)
+        self._ids[key] = i + 1
+        return f"{key}_{i}"
+
+
+unique_name = _UniqueName()

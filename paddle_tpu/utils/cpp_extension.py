@@ -1,0 +1,160 @@
+"""Custom C++ op toolchain (paddle.utils.cpp_extension).
+
+Reference: python/paddle/utils/cpp_extension/ (JIT-compiles user C++/CUDA
+into a loadable op library; registration via PD_BUILD_OP in
+paddle/fluid/framework/custom_operator.cc).
+
+TPU formulation: user C++ compiles to a shared library with the system
+toolchain (g++ -O3 -shared -fPIC — no nvcc); exported `extern "C"`
+kernels bind through ctypes and surface as framework ops whose body is a
+`jax.pure_callback`, so they compose with jit/grad-stop like any host
+callback (XLA custom-call-to-host being the TPU analog of a CPU PHI
+kernel).  The C ABI:
+
+    extern "C" void my_op(const void* x, void* out, int64_t n);
+
+operating elementwise-contiguously, or the shaped variant taking
+explicit dims.  For on-device performance the answer is Pallas, not C++
+— this path exists for host-side ops (IO, CPU preprocessing, legacy
+kernels), mirroring how the reference's custom-op path targets CPU too.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["load", "CppExtension", "get_build_directory", "CustomOpModule"]
+
+_DEFAULT_CFLAGS = ["-O3", "-std=c++17", "-fPIC", "-shared"]
+
+
+def get_build_directory():
+    d = os.environ.get("PADDLE_EXTENSION_DIR",
+                       os.path.join(tempfile.gettempdir(),
+                                    "paddle_tpu_extensions"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class CppExtension:
+    """Source bundle (API parity with reference setup() flow)."""
+
+    def __init__(self, sources, extra_compile_args=None, name=None):
+        self.sources = sources
+        self.extra_compile_args = extra_compile_args or []
+        self.name = name
+
+
+def _compile(name, sources, extra_cflags):
+    src_key = hashlib.sha1()
+    for s in sources:
+        with open(s, "rb") as f:
+            src_key.update(f.read())
+    out = os.path.join(get_build_directory(),
+                       f"{name}_{src_key.hexdigest()[:12]}.so")
+    if not os.path.exists(out):
+        cmd = ["g++"] + _DEFAULT_CFLAGS + list(extra_cflags or []) + \
+            list(sources) + ["-o", out]
+        r = subprocess.run(cmd, capture_output=True, text=True)
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"cpp_extension compile failed:\n{r.stderr}")
+    return out
+
+
+class CustomOpModule:
+    """Loaded extension; exported symbols become framework ops."""
+
+    def __init__(self, name, lib_path):
+        self.__name__ = name
+        self._lib_path = lib_path
+        self._lib = ctypes.CDLL(lib_path)
+        self._ops = {}
+
+    def elementwise_op(self, symbol, out_dtype=None):
+        """Wrap `extern "C" void f(const void* x, void* y, int64_t n)` as
+        a same-shape framework op."""
+        if symbol in self._ops:
+            return self._ops[symbol]
+        cfn = getattr(self._lib, symbol)
+        cfn.restype = None
+        cfn.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
+
+        def host_impl(x):
+            x = np.ascontiguousarray(x)
+            out = np.empty_like(
+                x, dtype=out_dtype if out_dtype else x.dtype)
+            cfn(x.ctypes.data_as(ctypes.c_void_p),
+                out.ctypes.data_as(ctypes.c_void_p),
+                ctypes.c_int64(x.size))
+            return out
+
+        from ..ops.registry import op
+
+        @op(name=f"custom_{self.__name__}_{symbol}")
+        def custom_op(x):
+            return jax.pure_callback(
+                host_impl,
+                jax.ShapeDtypeStruct(x.shape,
+                                     out_dtype or x.dtype),
+                x, vmap_method="sequential")
+
+        self._ops[symbol] = custom_op
+        return custom_op
+
+    def binary_op(self, symbol, out_dtype=None):
+        """`extern "C" void f(const void* a, const void* b, void* y,
+        int64_t n)` — same-shape binary op."""
+        if symbol in self._ops:
+            return self._ops[symbol]
+        cfn = getattr(self._lib, symbol)
+        cfn.restype = None
+        cfn.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                        ctypes.c_int64]
+
+        def host_impl(a, b):
+            a = np.ascontiguousarray(a)
+            b = np.ascontiguousarray(b)
+            out = np.empty_like(
+                a, dtype=out_dtype if out_dtype else a.dtype)
+            cfn(a.ctypes.data_as(ctypes.c_void_p),
+                b.ctypes.data_as(ctypes.c_void_p),
+                out.ctypes.data_as(ctypes.c_void_p),
+                ctypes.c_int64(a.size))
+            return out
+
+        from ..ops.registry import op
+
+        @op(name=f"custom_{self.__name__}_{symbol}")
+        def custom_op(a, b):
+            return jax.pure_callback(
+                host_impl,
+                jax.ShapeDtypeStruct(a.shape, out_dtype or a.dtype),
+                a, b, vmap_method="sequential")
+
+        self._ops[symbol] = custom_op
+        return custom_op
+
+    def raw(self, symbol):
+        return getattr(self._lib, symbol)
+
+
+def load(name, sources, extra_cflags=None, extra_cuda_cflags=None,
+         extra_ldflags=None, extra_include_paths=None, build_directory=None,
+         verbose=False):
+    """JIT-compile + load (reference: cpp_extension.load)."""
+    flags = list(extra_cflags or [])
+    for inc in extra_include_paths or []:
+        flags.append(f"-I{inc}")
+    flags += list(extra_ldflags or [])
+    lib = _compile(name, sources, flags)
+    if verbose:
+        print(f"[cpp_extension] built {lib}")
+    return CustomOpModule(name, lib)

@@ -1,0 +1,113 @@
+"""Launcher CLI + elastic manager.
+
+Reference test style: launcher-in-test subprocess harness
+(test/collective/test_communication_api_base.py:28 spawns
+`python -m paddle.distributed.launch` and checks rank env/restarts)."""
+import os
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+import numpy as np
+
+from paddle_tpu.distributed.launch import Launcher, build_rank_env
+
+
+def test_build_rank_env():
+    env = build_rank_env(2, 4, "127.0.0.1:9999", base_env={})
+    assert env["PADDLE_TRAINER_ID"] == "2"
+    assert env["PADDLE_TRAINERS_NUM"] == "4"
+    assert env["JAX_PROCESS_ID"] == "2"
+    assert env["JAX_COORDINATOR_ADDRESS"] == "127.0.0.1:9999"
+    assert len(env["PADDLE_TRAINER_ENDPOINTS"].split(",")) == 4
+
+
+def _write(dirname, name, body):
+    path = os.path.join(dirname, name)
+    with open(path, "w") as f:
+        f.write(textwrap.dedent(body))
+    return path
+
+
+def test_launcher_spawns_ranks():
+    d = tempfile.mkdtemp()
+    script = _write(d, "w.py", """
+        import os
+        print("RANK", os.environ["PADDLE_TRAINER_ID"], "OF",
+              os.environ["PADDLE_TRAINERS_NUM"], flush=True)
+    """)
+    log_dir = os.path.join(d, "logs")
+    code = Launcher([sys.executable, script], nprocs=3,
+                    log_dir=log_dir).run()
+    assert code == 0
+    seen = set()
+    for r in range(3):
+        with open(os.path.join(log_dir, f"workerlog.{r}")) as f:
+            txt = f.read()
+        assert f"RANK {r} OF 3" in txt
+        seen.add(r)
+    assert seen == {0, 1, 2}
+
+
+def test_launcher_elastic_restart():
+    d = tempfile.mkdtemp()
+    marker = os.path.join(d, "attempt")
+    script = _write(d, "w.py", f"""
+        import os, sys
+        path = {marker!r} + os.environ["PADDLE_TRAINER_ID"]
+        if not os.path.exists(path):
+            open(path, "w").close()
+            sys.exit(101)     # ELASTIC_EXIT_CODE: ask for relaunch
+        print("recovered", flush=True)
+    """)
+    code = Launcher([sys.executable, script], nprocs=2,
+                    max_restarts=2).run()
+    assert code == 0
+
+
+def test_launcher_propagates_failure():
+    d = tempfile.mkdtemp()
+    script = _write(d, "w.py", """
+        import os, sys
+        sys.exit(7 if os.environ["PADDLE_TRAINER_ID"] == "1" else 0)
+    """)
+    code = Launcher([sys.executable, script], nprocs=2).run()
+    assert code == 7
+
+
+def test_cli_main():
+    d = tempfile.mkdtemp()
+    script = _write(d, "w.py", """
+        import os
+        assert "PADDLE_TRAINER_ID" in os.environ
+    """)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo"
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", script],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+
+
+def test_elastic_manager_heartbeat():
+    from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                      ElasticStatus)
+
+    class FakeStore(dict):
+        def set(self, k, v):
+            self[k] = v
+
+        def get(self, k):
+            return self[k]
+
+    store = FakeStore()
+    m = ElasticManager(store=store, job_id="j", np=2, ttl=5)
+    m.rank = 0
+    m.enroll()
+    assert m.alive_ranks() == [0]
+    assert m.health_check() == ElasticStatus.RESTART   # rank 1 missing
+    store.set("/elastic/j/1", str(__import__("time").time()))
+    assert m.alive_ranks() == [0, 1]
+    assert m.health_check() == ElasticStatus.HOLD

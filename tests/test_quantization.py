@@ -1,0 +1,127 @@
+"""QAT/PTQ quantization (reference test style:
+test/quantization/test_quant_aware*.py — quantize, train, convert,
+check accuracy drop is bounded)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, quantization as Q
+import paddle_tpu.nn.functional as F
+
+
+def _model():
+    return nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+
+
+def test_fake_quant_op_and_ste():
+    x = paddle.to_tensor(
+        np.linspace(-2, 2, 64, dtype="float32"), stop_gradient=False)
+    y = Q.fake_quant_dequant_abs_max(x, bit_length=8)
+    err = np.abs(y.numpy() - x.numpy()).max()
+    assert err <= 2.0 / 127 + 1e-6       # quantization error bound
+    y.sum().backward()
+    g = x.grad.numpy()
+    np.testing.assert_allclose(g, np.ones_like(g))   # STE inside range
+
+
+def test_qat_quantize_and_train():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((32, 16)).astype("float32")
+    y = rng.integers(0, 4, (32,))
+
+    cfg = Q.QuantConfig(
+        activation=Q.quanters.FakeQuanterWithAbsMaxObserver,
+        weight=Q.quanters.FakeQuanterWithAbsMaxObserver)
+    model = _model()
+    qat = Q.QAT(cfg)
+    qmodel = qat.quantize(model, inplace=False)
+    # quantable leaves got wrapped
+    names = [type(l).__name__ for l in qmodel._sub_layers.values()]
+    assert names.count("QuantedLayer") == 2, names
+
+    optim = paddle.optimizer.Adam(parameters=qmodel.parameters(),
+                                  learning_rate=1e-2)
+    losses = []
+    for _ in range(10):
+        out = qmodel(paddle.to_tensor(x))
+        loss = F.cross_entropy(out, paddle.to_tensor(y))
+        loss.backward()
+        optim.step()
+        optim.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_ptq_observe_convert():
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((64, 16)).astype("float32")
+    model = _model()
+    ref = model(paddle.to_tensor(x)).numpy()
+
+    ptq = Q.PTQ(Q.QuantConfig(activation=Q.observers.AbsmaxObserver,
+                              weight=Q.observers.AbsmaxObserver))
+    qmodel = ptq.quantize(model, inplace=False)
+    for _ in range(4):                      # calibration passes
+        qmodel(paddle.to_tensor(x))
+    deployed = ptq.convert(qmodel, inplace=False)
+    # int8 weights materialized
+    leaves = [l for l in deployed._sub_layers.values()
+              if type(l).__name__ == "ConvertedLayer"]
+    assert len(leaves) == 2
+    assert leaves[0].qweight.numpy().dtype == np.int8
+    out = deployed(paddle.to_tensor(x)).numpy()
+    # bounded degradation vs fp32 reference
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.05, rel
+
+
+def test_channelwise_weight_quanter():
+    w = paddle.to_tensor(
+        np.random.default_rng(2).standard_normal((8, 4)).astype("float32"))
+    q = Q.quanters.FakeQuanterChannelWiseAbsMaxObserver(quant_axis=0)
+    out = q(w)
+    assert out.shape == [8, 4]
+    assert q._scale.shape == (8,)
+
+
+def test_qat_swaps_attribute_access():
+    """Attribute access must resolve to the wrapped layer (a _sub_layers
+    -only swap would silently run the unquantized path)."""
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    cfg = Q.QuantConfig(activation=None,
+                        weight=Q.quanters.FakeQuanterWithAbsMaxObserver)
+    net = Q.QAT(cfg).quantize(Net(), inplace=True)
+    assert type(net.fc).__name__ == "QuantedLayer"
+    out = net(paddle.to_tensor(np.ones((2, 4), "float32")))
+    assert out.shape == [2, 4]
+
+
+def test_qat_weight_grad_uses_ste():
+    """Weight grads must flow through the quanter's STE clip mask."""
+    lin = nn.Linear(2, 2)
+    w = np.array([[0.5, 10.0], [-0.5, -10.0]], "float32")
+    lin.weight.set_value(w)
+    lin.bias.set_value(np.zeros((2,), "float32"))
+
+    class SmallScaleQuanter(nn.Layer):
+        def forward(self, x):
+            return Q.fake_quant_dequant_abs_max(
+                x, bit_length=8,
+                scale=__import__("jax.numpy", fromlist=["x"]).float32(1.0))
+
+    q = Q.QuantedLayer(lin, None, SmallScaleQuanter())
+    x = paddle.to_tensor(np.ones((1, 2), "float32"))
+    out = q(x)
+    out.sum().backward()
+    g = lin.weight.grad.numpy()
+    # entries with |w| > scale (the 10.0s, column 1) must have zero grad
+    assert g[0, 1] == 0 and g[1, 1] == 0, g
+    assert g[0, 0] != 0 and g[1, 0] != 0, g
