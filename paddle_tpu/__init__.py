@@ -113,6 +113,7 @@ from . import text  # noqa: F401
 from . import geometric  # noqa: F401
 from . import incubate  # noqa: F401
 from . import utils  # noqa: F401
+from . import onnx  # noqa: F401
 
 __version__ = "0.1.0"
 

@@ -15,6 +15,9 @@ from .auto_parallel.api import shard_tensor, reshard, shard_layer, \
     ShardingStage1, ShardingStage2, ShardingStage3, get_placements
 from .shard_ops import sharding_constraint, annotate
 from . import fleet
+from . import rpc
+from . import auto_tuner
+from . import launch
 from . import checkpoint
 from .checkpoint import save_state_dict, load_state_dict
 from .fleet.meta_parallel.parallel_wrappers import DataParallel

@@ -1,0 +1,230 @@
+"""paddle.distributed.rpc — minimal RPC.
+
+Reference: python/paddle/distributed/rpc/rpc.py (init_rpc, rpc_sync,
+rpc_async, shutdown over the brpc C++ service
+paddle/fluid/distributed/rpc/).
+
+TPU formulation: a thread-per-connection TCP server with
+length-prefixed pickle frames — the host-side control plane (parameter
+serving, coordination) the reference runs over brpc; device-side
+communication stays on XLA collectives.  WorkerInfo/rank discovery
+rides the same TCPStore used for process-group bootstrap.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from concurrent.futures import Future
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
+
+
+class WorkerInfo:
+    def __init__(self, name, rank, ip, port):
+        self.name = name
+        self.rank = rank
+        self.ip = ip
+        self.port = port
+
+    def __repr__(self):
+        return (f"WorkerInfo(name={self.name}, rank={self.rank}, "
+                f"ip={self.ip}, port={self.port})")
+
+
+_state = {"server": None, "workers": {}, "name": None, "stop": None,
+          "rank": None, "store": None, "token": None}
+
+
+def _host_ip():
+    """Reachable address of this host (reference advertises the trainer
+    endpoint IP, not loopback)."""
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))   # no packets sent
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+
+
+def _send_frame(sock, obj):
+    data = pickle.dumps(obj, protocol=4)
+    sock.sendall(struct.pack("<Q", len(data)) + data)
+
+
+def _recv_frame(sock):
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("<Q", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+def _serve(server_sock, stop_event):
+    server_sock.settimeout(0.2)
+    while not stop_event.is_set():
+        try:
+            conn, _ = server_sock.accept()
+        except socket.timeout:
+            continue
+        except OSError:
+            return
+
+        def handle(c):
+            try:
+                req = _recv_frame(c)
+                token, fn, args, kwargs = req
+                if token != _state["token"]:
+                    _send_frame(c, ("err", PermissionError(
+                        "rpc auth token mismatch")))
+                    return
+                try:
+                    result = ("ok", fn(*args, **kwargs))
+                except Exception as e:      # ship the failure back
+                    result = ("err", e)
+                try:
+                    _send_frame(c, result)
+                except Exception as e:      # unpicklable result/exception
+                    _send_frame(c, ("err", RuntimeError(
+                        f"rpc result not serializable: {e}")))
+            except Exception:
+                pass
+            finally:
+                c.close()
+
+        threading.Thread(target=handle, args=(conn,), daemon=True).start()
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Start this worker's RPC server + discover peers (reference:
+    rpc.py init_rpc over TCPStore)."""
+    import os
+
+    if _state["server"] is not None:
+        shutdown()      # re-init replaces the previous server cleanly
+
+    rank = rank if rank is not None else int(
+        os.getenv("PADDLE_TRAINER_ID", "0"))
+    world_size = world_size if world_size is not None else int(
+        os.getenv("PADDLE_TRAINERS_NUM", "1"))
+
+    ip = _host_ip() if world_size > 1 else "127.0.0.1"
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind((ip if world_size > 1 else "127.0.0.1", 0))
+    srv.listen(64)
+    port = srv.getsockname()[1]
+    stop = threading.Event()
+    t = threading.Thread(target=_serve, args=(srv, stop), daemon=True)
+    t.start()
+
+    # peer discovery + shared auth token via the KV store (pickle over
+    # sockets is code execution; the token keeps strangers out)
+    from .store import create_or_get_global_tcp_store
+    store = create_or_get_global_tcp_store()
+    if rank == 0:
+        import secrets
+        token = secrets.token_hex(16)
+        store.set("/rpc/token", token)
+    else:
+        import time as _time
+        deadline0 = _time.time() + 60
+        while True:
+            try:
+                token = store.get("/rpc/token")
+                break
+            except Exception:
+                if _time.time() > deadline0:
+                    raise TimeoutError("init_rpc: no auth token from rank 0")
+                _time.sleep(0.05)
+        if isinstance(token, bytes):
+            token = token.decode()
+    _state.update(server=srv, name=name, stop=stop, rank=rank,
+                  store=store, token=token)
+    store.set(f"/rpc/{rank}", f"{name},{ip},{port}")
+    import time
+    deadline = time.time() + 60
+    workers = {}
+    while len(workers) < world_size:
+        for r in range(world_size):
+            if r in workers:
+                continue
+            try:
+                raw = store.get(f"/rpc/{r}")
+            except Exception:
+                continue
+            if isinstance(raw, bytes):
+                raw = raw.decode()
+            wname, ip, p = str(raw).split(",")
+            workers[r] = WorkerInfo(wname, r, ip, int(p))
+        if time.time() > deadline:
+            raise TimeoutError("init_rpc: peers did not register")
+        if len(workers) < world_size:
+            time.sleep(0.05)
+    _state["workers"] = {w.name: w for w in workers.values()}
+    return _state["workers"][name]
+
+
+def get_worker_info(name=None):
+    if name is None:
+        name = _state["name"]
+    return _state["workers"][name]
+
+
+def get_all_worker_infos():
+    return sorted(_state["workers"].values(), key=lambda w: w.rank)
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=120):
+    """Run fn(*args) on worker `to`, return its result."""
+    w = _state["workers"][to]
+    with socket.create_connection((w.ip, w.port), timeout=timeout) as c:
+        _send_frame(c, (_state["token"], fn, tuple(args or ()),
+                        dict(kwargs or {})))
+        status, payload = _recv_frame(c)
+    if status == "err":
+        raise payload
+    return payload
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=120):
+    """Future-returning variant (reference returns FutureWrapper)."""
+    fut: Future = Future()
+
+    def run():
+        try:
+            fut.set_result(rpc_sync(to, fn, args, kwargs, timeout))
+        except Exception as e:
+            fut.set_exception(e)
+
+    threading.Thread(target=run, daemon=True).start()
+    fut.wait = fut.result      # paddle API parity (fut.wait())
+    return fut
+
+
+def shutdown():
+    if _state["stop"] is not None:
+        _state["stop"].set()
+    if _state["server"] is not None:
+        try:
+            _state["server"].close()
+        except OSError:
+            pass
+    if _state["store"] is not None and _state["rank"] is not None:
+        try:    # drop our registration so a re-init can't find stale peers
+            _state["store"].delete_key(f"/rpc/{_state['rank']}")
+        except Exception:
+            pass
+    _state.update(server=None, workers={}, name=None, stop=None,
+                  rank=None, store=None, token=None)

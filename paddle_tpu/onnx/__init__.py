@@ -1,0 +1,20 @@
+"""paddle.onnx — ONNX export entry.
+
+Reference: python/paddle/onnx/export.py (delegates to paddle2onnx).
+Gated here: the onnx/paddle2onnx toolchain is not bundled (zero-egress
+image), and the TPU-native deployment path is `paddle.jit.save`'s
+StableHLO export (jit/serialization.py), which XLA-based runtimes load
+directly.  If `onnx` is importable we still refuse rather than emit a
+half-correct graph.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "ONNX graph conversion is not implemented (the paddle2onnx "
+        "toolchain is not bundled); use paddle_tpu.jit.save(layer, path, "
+        "input_spec=...) — its .stablehlo artifact is the TPU-native "
+        "deployment format, loadable via jax.export")

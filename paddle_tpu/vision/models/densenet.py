@@ -1,0 +1,124 @@
+"""DenseNet (reference: python/paddle/vision/models/densenet.py)."""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264"]
+
+_CFG = {
+    121: (6, 12, 24, 16, 32, 64),
+    161: (6, 12, 36, 24, 48, 96),
+    169: (6, 12, 32, 32, 32, 64),
+    201: (6, 12, 48, 32, 32, 64),
+    264: (6, 12, 64, 48, 32, 64),
+}
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, num_channels, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.bn1 = nn.BatchNorm2D(num_channels)
+        self.conv1 = nn.Conv2D(num_channels, bn_size * growth_rate, 1,
+                               bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = nn.Conv2D(bn_size * growth_rate, growth_rate, 3,
+                               padding=1, bias_attr=False)
+        self.dropout = dropout
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        y = self.conv1(self.relu(self.bn1(x)))
+        y = self.conv2(self.relu(self.bn2(y)))
+        if self.dropout:
+            import paddle_tpu.nn.functional as F
+            y = F.dropout(y, p=self.dropout, training=self.training)
+        from ...ops.manipulation import concat
+        return concat([x, y], axis=1)
+
+
+class _DenseBlock(nn.Layer):
+    def __init__(self, num_layers, num_channels, growth_rate, bn_size,
+                 dropout):
+        super().__init__()
+        self.layers = nn.LayerList([
+            _DenseLayer(num_channels + i * growth_rate, growth_rate,
+                        bn_size, dropout) for i in range(num_layers)])
+
+    def forward(self, x):
+        for layer in self.layers:
+            x = layer(x)
+        return x
+
+
+class _Transition(nn.Layer):
+    def __init__(self, num_channels, num_out):
+        super().__init__()
+        self.bn = nn.BatchNorm2D(num_channels)
+        self.conv = nn.Conv2D(num_channels, num_out, 1, bias_attr=False)
+        self.pool = nn.AvgPool2D(2, stride=2)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.bn(x))))
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        b1, b2, b3, b4, growth, init_feat = _CFG[layers]
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, init_feat, 7, stride=2, padding=3,
+                      bias_attr=False),
+            nn.BatchNorm2D(init_feat), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        ch = init_feat
+        blocks = []
+        for i, n in enumerate((b1, b2, b3, b4)):
+            blocks.append(_DenseBlock(n, ch, growth, bn_size, dropout))
+            ch += n * growth
+            if i != 3:
+                blocks.append(_Transition(ch, ch // 2))
+                ch //= 2
+        self.blocks = nn.Sequential(*blocks)
+        self.bn = nn.BatchNorm2D(ch)
+        self.relu = nn.ReLU()
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.relu(self.bn(self.blocks(self.stem(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+def _densenet(layers, pretrained=False, **kwargs):
+    return DenseNet(layers=layers, **kwargs)
+
+
+def densenet121(pretrained=False, **kwargs):
+    return _densenet(121, pretrained, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return _densenet(161, pretrained, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return _densenet(169, pretrained, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return _densenet(201, pretrained, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return _densenet(264, pretrained, **kwargs)

@@ -1,0 +1,141 @@
+"""GoogLeNet + InceptionV3 (reference:
+python/paddle/vision/models/{googlenet,inceptionv3}.py)."""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["GoogLeNet", "googlenet", "InceptionV3", "inception_v3"]
+
+
+def _bn_conv(in_ch, out_ch, k, stride=1, padding=0):
+    return nn.Sequential(
+        nn.Conv2D(in_ch, out_ch, k, stride=stride, padding=padding,
+                  bias_attr=False),
+        nn.BatchNorm2D(out_ch), nn.ReLU())
+
+
+class _Inception(nn.Layer):
+    def __init__(self, in_ch, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = _bn_conv(in_ch, c1, 1)
+        self.b2 = nn.Sequential(_bn_conv(in_ch, c3r, 1),
+                                _bn_conv(c3r, c3, 3, padding=1))
+        self.b3 = nn.Sequential(_bn_conv(in_ch, c5r, 1),
+                                _bn_conv(c5r, c5, 5, padding=2))
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, stride=1, padding=1),
+                                _bn_conv(in_ch, proj, 1))
+
+    def forward(self, x):
+        from ...ops.manipulation import concat
+        return concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)],
+                      axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            _bn_conv(3, 64, 7, stride=2, padding=3),
+            nn.MaxPool2D(3, stride=2, ceil_mode=True),
+            _bn_conv(64, 64, 1), _bn_conv(64, 192, 3, padding=1),
+            nn.MaxPool2D(3, stride=2, ceil_mode=True))
+        self.i3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, stride=2, ceil_mode=True)
+        self.i4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, stride=2, ceil_mode=True)
+        self.i5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.2)
+            self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.i3b(self.i3a(x)))
+        x = self.pool4(self.i4e(self.i4d(self.i4c(self.i4b(self.i4a(x))))))
+        x = self.i5b(self.i5a(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(x.flatten(1)))
+        # reference returns (out, aux1, aux2); aux heads are train-only
+        return x, None, None
+
+
+def googlenet(pretrained=False, **kwargs):
+    return GoogLeNet(**kwargs)
+
+
+class _InceptionA(nn.Layer):
+    def __init__(self, in_ch, pool_feat):
+        super().__init__()
+        self.b1 = _bn_conv(in_ch, 64, 1)
+        self.b5 = nn.Sequential(_bn_conv(in_ch, 48, 1),
+                                _bn_conv(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(_bn_conv(in_ch, 64, 1),
+                                _bn_conv(64, 96, 3, padding=1),
+                                _bn_conv(96, 96, 3, padding=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _bn_conv(in_ch, pool_feat, 1))
+
+    def forward(self, x):
+        from ...ops.manipulation import concat
+        return concat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)], 1)
+
+
+class _ReductionA(nn.Layer):
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b3 = _bn_conv(in_ch, 384, 3, stride=2)
+        self.b3d = nn.Sequential(_bn_conv(in_ch, 64, 1),
+                                 _bn_conv(64, 96, 3, padding=1),
+                                 _bn_conv(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        from ...ops.manipulation import concat
+        return concat([self.b3(x), self.b3d(x), self.pool(x)], 1)
+
+
+class InceptionV3(nn.Layer):
+    """Compact InceptionV3: stem + A blocks + reduction + head (the
+    reference's full B/C/D/E tower follows the same recipe)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            _bn_conv(3, 32, 3, stride=2), _bn_conv(32, 32, 3),
+            _bn_conv(32, 64, 3, padding=1), nn.MaxPool2D(3, stride=2),
+            _bn_conv(64, 80, 1), _bn_conv(80, 192, 3),
+            nn.MaxPool2D(3, stride=2))
+        self.a1 = _InceptionA(192, 32)
+        self.a2 = _InceptionA(256, 64)
+        self.a3 = _InceptionA(288, 64)
+        self.red = _ReductionA(288)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(768, num_classes)
+
+    def forward(self, x):
+        x = self.red(self.a3(self.a2(self.a1(self.stem(x)))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    return InceptionV3(**kwargs)

@@ -1,0 +1,124 @@
+"""ShuffleNetV2 (reference: python/paddle/vision/models/shufflenetv2.py)."""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+           "shufflenet_v2_x2_0", "shufflenet_v2_swish"]
+
+_STAGE_OUT = {
+    "0.25": (24, 24, 48, 96, 512), "0.33": (24, 32, 64, 128, 512),
+    "0.5": (24, 48, 96, 192, 1024), "1.0": (24, 116, 232, 464, 1024),
+    "1.5": (24, 176, 352, 704, 1024), "2.0": (24, 244, 488, 976, 2048),
+}
+
+
+def _channel_shuffle(x, groups):
+    from ...ops.manipulation import reshape, transpose
+    b, c, h, w = x.shape
+    x = reshape(x, [b, groups, c // groups, h, w])
+    x = transpose(x, [0, 2, 1, 3, 4])
+    return reshape(x, [b, c, h, w])
+
+
+def _conv_bn(in_ch, out_ch, k, stride=1, groups=1, act=None):
+    layers = [nn.Conv2D(in_ch, out_ch, k, stride=stride, padding=k // 2,
+                        groups=groups, bias_attr=False),
+              nn.BatchNorm2D(out_ch)]
+    if act == "relu":
+        layers.append(nn.ReLU())
+    elif act == "swish":
+        layers.append(nn.Swish())
+    return nn.Sequential(*layers)
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, in_ch, out_ch, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch = out_ch // 2
+        if stride == 2:
+            self.branch1 = nn.Sequential(
+                _conv_bn(in_ch, in_ch, 3, stride, groups=in_ch),
+                _conv_bn(in_ch, branch, 1, act=act))
+            in2 = in_ch
+        else:
+            self.branch1 = None
+            in2 = in_ch // 2
+        self.branch2 = nn.Sequential(
+            _conv_bn(in2, branch, 1, act=act),
+            _conv_bn(branch, branch, 3, stride, groups=branch),
+            _conv_bn(branch, branch, 1, act=act))
+
+    def forward(self, x):
+        from ...ops.manipulation import concat, split
+        if self.stride == 1:
+            x1, x2 = split(x, 2, axis=1)
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        outs = _STAGE_OUT[str(scale) if str(scale) in _STAGE_OUT
+                          else f"{scale:.2g}"]
+        self.conv1 = _conv_bn(3, outs[0], 3, stride=2, act=act)
+        self.pool1 = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        in_ch = outs[0]
+        for i, reps in enumerate((4, 8, 4)):
+            out_ch = outs[i + 1]
+            blocks = [_InvertedResidual(in_ch, out_ch, 2, act)]
+            blocks += [_InvertedResidual(out_ch, out_ch, 1, act)
+                       for _ in range(reps - 1)]
+            stages.append(nn.Sequential(*blocks))
+            in_ch = out_ch
+        self.stages = nn.Sequential(*stages)
+        self.conv5 = _conv_bn(in_ch, outs[4], 1, act=act)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(outs[4], num_classes)
+
+    def forward(self, x):
+        x = self.conv5(self.stages(self.pool1(self.conv1(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return ShuffleNetV2("0.25", **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    return ShuffleNetV2("0.33", **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return ShuffleNetV2("0.5", **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return ShuffleNetV2("1.0", **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return ShuffleNetV2("1.5", **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return ShuffleNetV2("2.0", **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    return ShuffleNetV2("1.0", act="swish", **kw)

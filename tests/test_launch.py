@@ -10,6 +10,7 @@ import tempfile
 import textwrap
 
 import numpy as np
+import pytest
 
 from paddle_tpu.distributed.launch import Launcher, build_rank_env
 
@@ -111,3 +112,22 @@ def test_elastic_manager_heartbeat():
     store.set("/elastic/j/1", str(__import__("time").time()))
     assert m.alive_ranks() == [0, 1]
     assert m.health_check() == ElasticStatus.HOLD
+
+
+def test_rpc_sync_async_roundtrip():
+    """In-process RPC loop-back (reference: test/rpc/test_rpc.py style)."""
+    from paddle_tpu.distributed import rpc
+
+    rpc.shutdown()
+    info = rpc.init_rpc("w0", rank=0, world_size=1)
+    try:
+        assert info.name == "w0"
+        assert rpc.get_worker_info().rank == 0
+        out = rpc.rpc_sync("w0", divmod, args=(7, 3))
+        assert out == (2, 1)
+        fut = rpc.rpc_async("w0", len, args=("hello",))
+        assert fut.wait() == 5
+        with pytest.raises(ZeroDivisionError):
+            rpc.rpc_sync("w0", divmod, args=(1, 0))
+    finally:
+        rpc.shutdown()

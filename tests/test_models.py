@@ -107,3 +107,28 @@ def test_vision_transforms_dataset():
     ds = MNIST(mode="train", synthetic_size=32)
     img, label = ds[0]
     assert img.shape == (1, 28, 28) and 0 <= label < 10
+
+
+def test_extended_vision_zoo():
+    """DenseNet/SqueezeNet/ShuffleNetV2/GoogLeNet/InceptionV3 forward +
+    grad (reference: test/legacy_test/test_vision_models.py style)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.vision import models as M
+
+    x = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((2, 3, 64, 64))
+        .astype("float32"))
+    for i, ctor in enumerate([
+            lambda: M.densenet121(num_classes=10),
+            lambda: M.squeezenet1_1(num_classes=10),
+            lambda: M.shufflenet_v2_x0_25(num_classes=10),
+            lambda: M.inception_v3(num_classes=10)]):
+        model = ctor()
+        out = model(x)
+        assert out.shape == [2, 10], type(model).__name__
+        if i == 1:  # grad path once (CPU backward on the big nets is slow)
+            out.sum().backward()
+
+    out, aux1, aux2 = M.googlenet(num_classes=10)(x)
+    assert out.shape == [2, 10]
