@@ -16,6 +16,7 @@ from .auto_parallel.api import shard_tensor, reshard, shard_layer, \
 from .shard_ops import sharding_constraint, annotate
 from . import fleet
 from . import rpc
+from . import ps
 from . import auto_tuner
 from . import launch
 from . import checkpoint

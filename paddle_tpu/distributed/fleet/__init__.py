@@ -10,6 +10,8 @@ from .hybrid_optimizer import HybridParallelOptimizer, \
     HybridParallelGradScaler
 from .recompute import recompute, recompute_sequential
 from . import sequence_parallel_utils
+from . import elastic
+from .elastic import ElasticManager
 
 # top-level fleet API shape
 init = fleet.init
@@ -25,4 +27,5 @@ __all__ = ["fleet", "init", "DistributedStrategy", "ParallelMode",
            "RowParallelLinear", "ParallelCrossEntropy", "meta_parallel",
            "HybridParallelOptimizer", "HybridParallelGradScaler",
            "recompute", "recompute_sequential", "distributed_model",
+           "elastic", "ElasticManager",
            "distributed_optimizer", "get_hybrid_communicate_group"]

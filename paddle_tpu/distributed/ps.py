@@ -1,0 +1,36 @@
+"""paddle.distributed.ps — parameter-server stack (documented stub).
+
+Reference: paddle/fluid/distributed/ps/ (brpc PS server/client, sparse/
+dense tables, heter PS) + python/paddle/distributed/ps/.
+
+Out of scope for the TPU rebuild (SURVEY §7: "PS stack out-of-scope for
+TPU v1 — document, stub API"): the PS architecture exists to stream
+terabyte-scale sparse embeddings through CPU parameter servers for
+recommendation workloads; on TPU the idiomatic equivalents are
+  * sharded embeddings over the mesh (`fleet.VocabParallelEmbedding`,
+    `dist.shard_tensor` with row sharding), and
+  * host-offloaded lookups via `jax.pure_callback` +
+    `utils.cpp_extension` for out-of-HBM tables.
+Every entry point raises with that guidance rather than half-working.
+"""
+from __future__ import annotations
+
+__all__ = ["PsProgramBuilder", "TheOnePSRuntime", "DistributedInfer"]
+
+_MSG = ("the brpc parameter-server stack is not part of the TPU build; "
+        "use mesh-sharded embeddings (fleet.VocabParallelEmbedding / "
+        "dist.shard_tensor) or host-offloaded tables via jax.pure_callback "
+        "(see paddle_tpu.utils.cpp_extension)")
+
+
+def _stub(name):
+    class _Stub:
+        def __init__(self, *a, **k):
+            raise NotImplementedError(f"{name}: {_MSG}")
+    _Stub.__name__ = name
+    return _Stub
+
+
+PsProgramBuilder = _stub("PsProgramBuilder")
+TheOnePSRuntime = _stub("TheOnePSRuntime")
+DistributedInfer = _stub("DistributedInfer")

@@ -196,6 +196,12 @@ def loss_fn(params, ids, config: LlamaConfig, mesh: Mesh, n_micro=1,
     inp, lab = ids[:, :-1], ids[:, 1:]
     b, s = inp.shape
     x = jnp.take(params["embed"], inp, axis=0)
+    if mesh.shape["tp"] > 1:
+        # the gather of a col-sharded [V, H/tp] table keeps tp on the
+        # hidden dim; saying so stops GSPMD's "involuntary full
+        # rematerialization" (replicate-then-reshard) of the embedding
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P("dp", None, "tp")))
     if sp and mesh.shape["tp"] > 1 and s % mesh.shape["tp"] == 0:
         # Megatron-SP: sequence dim sharded over tp outside attention
         x = jax.lax.with_sharding_constraint(
