@@ -4,6 +4,8 @@ Reference analog: the in-tree Llama test model
 (test/auto_parallel/hybrid_strategy/semi_auto_parallel_llama_model.py) plus
 the PaddleNLP model families the reference framework exists to serve.
 """
+from . import generation  # noqa: F401
+from .generation import generate, GenerationConfig  # noqa: F401
 from .llama import (  # noqa: F401
     LlamaConfig, LlamaRMSNorm, LlamaAttention, LlamaMLP, LlamaDecoderLayer,
     LlamaModel, LlamaForCausalLM, LlamaPretrainingCriterion,

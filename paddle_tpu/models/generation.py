@@ -1,0 +1,271 @@
+"""Autoregressive generation with a static-shape KV cache.
+
+Reference analog: the decoding stack the reference exposes through
+fused inference ops (paddle/phi/kernels/fusion/gpu/
+block_multi_head_attention_kernel.cu, masked_multihead_attention) and
+PaddleNLP's generate() loop.
+
+TPU formulation: the whole decode is ONE jitted program —
+  * prefill: full-sequence forward over the (right-padded) prompt fills
+    a [L, B, max_len, kvH, D] cache; prompt lengths are data, shapes are
+    static.
+  * decode: `lax.scan` over max_new_tokens, each step one-token
+    attention against the cache (dot-products on the MXU, no [S,S]
+    materialization); the per-batch cache write is a positional
+    compare-and-select (positions differ per row, so a plain
+    dynamic_update_slice does not apply).
+  * sampling: greedy / temperature / top-k / top-p, all shape-static
+    (top-p via sorted-cumsum masking).
+No Python-loop-per-token, no retrace per step, no dynamic shapes.
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .llama import LlamaConfig, _rope_tables, _rotate_half
+from .llama_hybrid import _rms
+
+__all__ = ["GenerationConfig", "generate", "build_generate_fn"]
+
+_FN_CACHE: dict = {}   # (config id, prompt_len, gen fields) -> jitted fn
+
+
+@dataclass
+class GenerationConfig:
+    max_new_tokens: int = 64
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_token_id: int | None = None
+    pad_token_id: int = 0
+    seed: int = 0
+
+
+# ------------------------------------------------------------- weight view
+def _layer_weights(state, i):
+    p = f"llama.layers.{i}."
+    return {
+        "ln1": state[p + "input_layernorm.weight"],
+        "q": state[p + "self_attn.q_proj.weight"],
+        "k": state[p + "self_attn.k_proj.weight"],
+        "v": state[p + "self_attn.v_proj.weight"],
+        "o": state[p + "self_attn.o_proj.weight"],
+        "ln2": state[p + "post_attention_layernorm.weight"],
+        "gate": state[p + "mlp.gate_proj.weight"],
+        "up": state[p + "mlp.up_proj.weight"],
+        "down": state[p + "mlp.down_proj.weight"],
+    }
+
+
+def _rope_at(cos, sin, pos):
+    """cos/sin: [max_len, D]; pos: [...] -> [..., D]"""
+    return jnp.take(cos, pos, axis=0), jnp.take(sin, pos, axis=0)
+
+
+# ---------------------------------------------------------------- prefill
+def _prefill_layer(w, x, cos, sin, mask, cfg: LlamaConfig):
+    """x: [B, S, H]; returns (out, k_cache, v_cache [B, S, kvH, D])."""
+    b, s, _ = x.shape
+    nh, kvh, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                   cfg.head_dim)
+    h = _rms(x, w["ln1"], cfg.rms_norm_eps)
+    q = (h @ w["q"]).reshape(b, s, nh, hd)
+    k = (h @ w["k"]).reshape(b, s, kvh, hd)
+    v = (h @ w["v"]).reshape(b, s, kvh, hd)
+    cos_c = cos[None, :, None, :].astype(q.dtype)
+    sin_c = sin[None, :, None, :].astype(q.dtype)
+    q = q * cos_c + _rotate_half(q) * sin_c
+    k = k * cos_c + _rotate_half(k) * sin_c
+
+    rep = nh // kvh
+    kq = jnp.repeat(k, rep, axis=2)
+    vq = jnp.repeat(v, rep, axis=2)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, kq,
+                        preferred_element_type=jnp.float32)
+    logits = logits / np.sqrt(hd)
+    causal = jnp.tril(jnp.ones((s, s), bool))
+    m = causal[None, None] & mask[:, None, None, :]
+    logits = jnp.where(m, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vq).reshape(b, s, nh * hd)
+    x = x + attn @ w["o"]
+    h = _rms(x, w["ln2"], cfg.rms_norm_eps)
+    return x + (jax.nn.silu(h @ w["gate"]) * (h @ w["up"])) @ w["down"], k, v
+
+
+# ------------------------------------------------------------ decode step
+def _decode_layer(w, x, kcache, vcache, cos1, sin1, pos, cfg: LlamaConfig):
+    """x: [B, H] one token; kcache/vcache: [B, T, kvH, D]; pos: [B]."""
+    b = x.shape[0]
+    nh, kvh, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                   cfg.head_dim)
+    h = _rms(x[:, None], w["ln1"], cfg.rms_norm_eps)[:, 0]
+    q = (h @ w["q"]).reshape(b, nh, hd)
+    k = (h @ w["k"]).reshape(b, kvh, hd)
+    v = (h @ w["v"]).reshape(b, kvh, hd)
+    cos_c = cos1[:, None, :].astype(q.dtype)
+    sin_c = sin1[:, None, :].astype(q.dtype)
+    q = q * cos_c + _rotate_half(q) * sin_c
+    k = k * cos_c + _rotate_half(k) * sin_c
+
+    # write this token's k/v at pos (per-batch positions)
+    idx = pos[:, None, None, None]
+    tpos = jnp.arange(kcache.shape[1])
+    sel = (tpos[None, :, None, None] == idx)
+    kcache = jnp.where(sel, k[:, None], kcache)
+    vcache = jnp.where(sel, v[:, None], vcache)
+
+    rep = nh // kvh
+    kq = jnp.repeat(kcache, rep, axis=2)       # [B, T, nh, D]
+    vq = jnp.repeat(vcache, rep, axis=2)
+    logits = jnp.einsum("bhd,bthd->bht", q, kq,
+                        preferred_element_type=jnp.float32) / np.sqrt(hd)
+    valid = tpos[None, None, :] <= pos[:, None, None]
+    logits = jnp.where(valid, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+    attn = jnp.einsum("bht,bthd->bhd", probs, vq).reshape(b, nh * hd)
+    x = x + attn @ w["o"]
+    h = _rms(x[:, None], w["ln2"], cfg.rms_norm_eps)[:, 0]
+    return (x + (jax.nn.silu(h @ w["gate"]) * (h @ w["up"])) @ w["down"],
+            kcache, vcache)
+
+
+# --------------------------------------------------------------- sampling
+def _sample(logits, key, gen: GenerationConfig):
+    logits = logits.astype(jnp.float32)
+    if not gen.do_sample:
+        return jnp.argmax(logits, axis=-1)
+    if gen.temperature != 1.0:
+        logits = logits / jnp.float32(max(gen.temperature, 1e-6))
+    if gen.top_k and gen.top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[..., -gen.top_k][..., None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if gen.top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative prob >= top_p
+        cutoff_idx = jnp.sum(cum < gen.top_p, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+# ------------------------------------------------------------------ main
+def build_generate_fn(config: LlamaConfig, gen: GenerationConfig,
+                      prompt_len: int):
+    """Returns jitted (state, ids[B, prompt_len], lengths[B], key) ->
+    tokens [B, prompt_len + max_new_tokens]."""
+    L = config.num_hidden_layers
+    T = prompt_len + gen.max_new_tokens
+    assert T <= config.max_position_embeddings
+
+    def run(state, ids, lengths, key):
+        b = ids.shape[0]
+        dtype = state["llama.embed_tokens.weight"].dtype
+        cos, sin = _rope_tables(T, config.head_dim, config.rope_theta)
+        cos = cos.astype(jnp.float32)
+        sin = sin.astype(jnp.float32)
+
+        # ---- prefill over the padded prompt
+        x = jnp.take(state["llama.embed_tokens.weight"], ids, axis=0)
+        pmask = jnp.arange(prompt_len)[None, :] < lengths[:, None]
+        kcaches, vcaches = [], []
+        for i in range(L):
+            w = _layer_weights(state, i)
+            x, k, v = _prefill_layer(w, x, cos[:prompt_len],
+                                     sin[:prompt_len], pmask, config)
+            pad = ((0, 0), (0, T - prompt_len), (0, 0), (0, 0))
+            kcaches.append(jnp.pad(k, pad))
+            vcaches.append(jnp.pad(v, pad))
+        kcache = jnp.stack(kcaches)            # [L, B, T, kvH, D]
+        vcache = jnp.stack(vcaches)
+
+        x = _rms(x, state["llama.norm.weight"], config.rms_norm_eps)
+        head = state.get("lm_head.weight")
+
+        def logits_of(h):
+            if head is not None:
+                return h @ head
+            return h @ state["llama.embed_tokens.weight"].T
+
+        # last real prompt token's hidden state seeds decoding
+        last = jnp.take_along_axis(
+            x, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+        key, sub = jax.random.split(key)
+        tok = _sample(logits_of(last), sub, gen)
+
+        done = jnp.zeros((b,), bool)
+        if gen.eos_token_id is not None:
+            done = done | (tok == gen.eos_token_id)
+
+        def step(carry, key_t):
+            tok, pos, kcache, vcache, done = carry
+            emb = jnp.take(state["llama.embed_tokens.weight"], tok, axis=0)
+            cos1, sin1 = _rope_at(cos, sin, pos)
+            h = emb
+            newk, newv = [], []
+            for i in range(L):
+                w = _layer_weights(state, i)
+                h, kc, vc = _decode_layer(w, h, kcache[i], vcache[i],
+                                          cos1, sin1, pos, config)
+                newk.append(kc)
+                newv.append(vc)
+            kcache = jnp.stack(newk)
+            vcache = jnp.stack(newv)
+            h = _rms(h[:, None], state["llama.norm.weight"],
+                     config.rms_norm_eps)[:, 0]
+            nxt = _sample(logits_of(h), key_t, gen)
+            if gen.eos_token_id is not None:
+                nxt = jnp.where(done, gen.pad_token_id, nxt)
+                done = done | (nxt == gen.eos_token_id)
+            return (nxt, pos + 1, kcache, vcache, done), tok
+
+        keys = jax.random.split(key, gen.max_new_tokens)
+        (tok, _, _, _, _), toks = jax.lax.scan(
+            step, (tok.astype(ids.dtype), lengths.astype(jnp.int32),
+                   kcache, vcache, done), keys)
+        # toks[t] is the token sampled after t decode steps: exactly
+        # max_new_tokens new tokens (the final carry is one beyond)
+        return jnp.concatenate([ids, toks.T.astype(ids.dtype)], axis=1)
+
+    return jax.jit(run)
+
+
+def generate(model, input_ids, max_new_tokens=64, do_sample=False,
+             temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
+             pad_token_id=0, seed=0, lengths=None):
+    """User entry: model is a LlamaForCausalLM; input_ids [B, S] (right-
+    padded if lengths given; new tokens overwrite the padded slots in the
+    cache). Returns [B, S + max_new_tokens] ids."""
+    from ..framework.tensor import Tensor
+
+    ids = input_ids._data if isinstance(input_ids, Tensor) else \
+        jnp.asarray(input_ids)
+    b, s = ids.shape
+    if lengths is None:
+        lengths_arr = jnp.full((b,), s, jnp.int32)
+    else:
+        lengths_arr = (lengths._data if isinstance(lengths, Tensor)
+                       else jnp.asarray(lengths)).astype(jnp.int32)
+    gen = GenerationConfig(
+        max_new_tokens=max_new_tokens, do_sample=do_sample,
+        temperature=temperature, top_k=top_k, top_p=top_p,
+        eos_token_id=eos_token_id, pad_token_id=pad_token_id, seed=seed)
+    state = {k: (v._data if isinstance(v, Tensor) else v)
+             for k, v in model.functional_state().items()}
+    cache_key = (id(model.config), prompt_len := s,
+                 gen.max_new_tokens, gen.do_sample, gen.temperature,
+                 gen.top_k, gen.top_p, gen.eos_token_id, gen.pad_token_id)
+    fn = _FN_CACHE.get(cache_key)
+    if fn is None:
+        fn = _FN_CACHE[cache_key] = build_generate_fn(
+            model.config, gen, prompt_len)
+    out = fn(state, ids, lengths_arr, jax.random.key(seed))
+    return Tensor(out, stop_gradient=True)

@@ -217,6 +217,11 @@ class LlamaForCausalLM(nn.Layer):
             return h.matmul(w, transpose_y=True)
         return self.lm_head(h)
 
+    def generate(self, input_ids, **kwargs):
+        """KV-cache autoregressive decoding (models/generation.py)."""
+        from .generation import generate as _generate
+        return _generate(self, input_ids, **kwargs)
+
 
 class LlamaPretrainingCriterion(nn.Layer):
     """Shifted next-token cross entropy in fp32 (reference test model's
