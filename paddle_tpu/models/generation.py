@@ -32,7 +32,14 @@ from .llama_hybrid import _rms
 
 __all__ = ["GenerationConfig", "generate", "build_generate_fn"]
 
-_FN_CACHE: dict = {}   # (config id, prompt_len, gen fields) -> jitted fn
+_FN_CACHE: dict = {}   # (config fields, prompt_len, gen fields) -> jitted fn
+_FN_CACHE_MAX = 16
+
+
+def astuple_cfg(cfg):
+    """Value-based cache key: id(cfg) can be reused after GC."""
+    import dataclasses
+    return tuple(sorted(dataclasses.asdict(cfg).items()))
 
 
 @dataclass
@@ -144,7 +151,8 @@ def _sample(logits, key, gen: GenerationConfig):
     if gen.temperature != 1.0:
         logits = logits / jnp.float32(max(gen.temperature, 1e-6))
     if gen.top_k and gen.top_k > 0:
-        kth = jnp.sort(logits, axis=-1)[..., -gen.top_k][..., None]
+        k = min(gen.top_k, logits.shape[-1])
+        kth = jnp.sort(logits, axis=-1)[..., -k][..., None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if gen.top_p < 1.0:
         sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
@@ -260,12 +268,14 @@ def generate(model, input_ids, max_new_tokens=64, do_sample=False,
         eos_token_id=eos_token_id, pad_token_id=pad_token_id, seed=seed)
     state = {k: (v._data if isinstance(v, Tensor) else v)
              for k, v in model.functional_state().items()}
-    cache_key = (id(model.config), prompt_len := s,
+    cache_key = (astuple_cfg(model.config), s,
                  gen.max_new_tokens, gen.do_sample, gen.temperature,
                  gen.top_k, gen.top_p, gen.eos_token_id, gen.pad_token_id)
     fn = _FN_CACHE.get(cache_key)
     if fn is None:
+        if len(_FN_CACHE) >= _FN_CACHE_MAX:   # bound compiled programs
+            _FN_CACHE.pop(next(iter(_FN_CACHE)))
         fn = _FN_CACHE[cache_key] = build_generate_fn(
-            model.config, gen, prompt_len)
+            model.config, gen, s)
     out = fn(state, ids, lengths_arr, jax.random.key(seed))
     return Tensor(out, stop_gradient=True)

@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.registry import op
+from ..ops.registry import op, apply_op
 from ..framework.dtype import to_np_dtype
 from ..framework import random as _random
 
@@ -897,7 +897,10 @@ def unfold_(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
     patches = jax.lax.conv_general_dilated_patches(
         x, k, s, p, rhs_dilation=d,
         dimension_numbers=jax.lax.conv_dimension_numbers(
-            x.shape, (1, c) + k, ("NCHW", "OIHW", "NCHW")))
+            x.shape, (1, c) + k, ("NCHW", "OIHW", "NCHW")),
+        # the one-hot conv must not round through bf16 on the MXU:
+        # unfold is a data movement op, values must come out bit-exact
+        precision=jax.lax.Precision.HIGHEST)
     # [N, C*kh*kw, oh, ow] -> [N, C*kh*kw, L]
     return patches.reshape(n, patches.shape[1], -1)
 
@@ -969,3 +972,34 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
                              x5[:, :-1, fold:2 * fold]], 1)
     rest = x5[:, :, 2 * fold:]
     return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """Inverse of unfold_: scatter-add [B, C*kh*kw, L] patches back to
+    [B, C, H, W] (reference: python/paddle/nn/functional/common.py fold,
+    phi fold kernels)."""
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+
+    def body(xarr):
+        b, ckk, L = xarr.shape
+        c = ckk // (kh * kw)
+        nh = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        nw = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        assert nh * nw == L, (nh, nw, L)
+        patches = xarr.reshape(b, c, kh, kw, nh, nw)
+        out = jnp.zeros((b, c, oh + 2 * ph, ow + 2 * pw), xarr.dtype)
+        # scatter-add each kernel offset's strided grid in one slice-add
+        for i in range(kh):
+            for j in range(kw):
+                hi = i * dh
+                wj = j * dw
+                out = out.at[:, :, hi:hi + nh * sh:sh,
+                             wj:wj + nw * sw:sw].add(patches[:, :, i, j])
+        return out[:, :, ph:ph + oh, pw:pw + ow]
+
+    return apply_op("fold", body, (x,), {})

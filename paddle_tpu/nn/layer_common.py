@@ -15,6 +15,7 @@ __all__ = [
     "Identity", "Upsample", "UpsamplingBilinear2D", "UpsamplingNearest2D",
     "Pad1D", "Pad2D", "Pad3D", "ZeroPad2D", "CosineSimilarity", "Bilinear",
     "Sequential", "LayerList", "ParameterList", "LayerDict",
+    "Softmax2D", "ChannelShuffle", "PairwiseDistance", "Fold",
     "ReLU", "ReLU6", "GELU", "Sigmoid", "Tanh", "Softmax", "LogSoftmax",
     "LeakyReLU", "ELU", "SELU", "CELU", "PReLU", "RReLU", "Hardswish",
     "Hardsigmoid", "Hardtanh", "Hardshrink", "Softshrink", "Tanhshrink",
@@ -422,3 +423,70 @@ class Unfold(Layer):
 
     def forward(self, x):
         return F.unfold_(x, *self.args)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW input (reference:
+    python/paddle/nn/layer/activation.py Softmax2D)."""
+
+    def forward(self, x):
+        if x.ndim not in (3, 4):
+            raise ValueError(f"Softmax2D expects 3D/4D input, got {x.ndim}D")
+        return F.softmax(x, axis=-3)
+
+
+class ChannelShuffle(Layer):
+    """Reference: python/paddle/nn/layer/vision.py ChannelShuffle."""
+
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self.groups = groups
+        self.data_format = data_format
+
+    def forward(self, x):
+        from ..ops.manipulation import reshape, transpose
+        g = self.groups
+        if self.data_format == "NCHW":
+            b, c, h, w = x.shape
+            x = reshape(x, [b, g, c // g, h, w])
+            x = transpose(x, [0, 2, 1, 3, 4])
+            return reshape(x, [b, c, h, w])
+        b, h, w, c = x.shape
+        x = reshape(x, [b, h, w, g, c // g])
+        x = transpose(x, [0, 1, 2, 4, 3])
+        return reshape(x, [b, h, w, c])
+
+
+class PairwiseDistance(Layer):
+    """Reference: python/paddle/nn/layer/distance.py."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        from ..ops.linalg import norm as _norm
+        d = x - y + self.epsilon
+        return _norm(d, p=self.p, axis=-1, keepdim=self.keepdim)
+
+
+class Fold(Layer):
+    """Inverse of Unfold: [B, C*kh*kw, L] -> [B, C, H, W] by summing
+    overlapping patches (reference: python/paddle/nn/layer/common.py
+    Fold; kernel fold_kernel)."""
+
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        from .functional import _pair
+        self.output_sizes = _pair(output_sizes)
+        self.kernel_sizes = _pair(kernel_sizes)
+        self.strides = _pair(strides)
+        self.paddings = _pair(paddings)
+        self.dilations = _pair(dilations)
+
+    def forward(self, x):
+        return F.fold(x, self.output_sizes, self.kernel_sizes,
+                      self.strides, self.paddings, self.dilations)

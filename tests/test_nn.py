@@ -262,3 +262,38 @@ class TestMultiHeadAttention:
         enc = nn.TransformerEncoder(layer, 2)
         x = paddle.to_tensor(rng.randn(2, 5, 16).astype(np.float32))
         assert enc(x).shape == [2, 5, 16]
+
+
+def test_fold_unfold_channelshuffle_softmax2d_pairwise():
+    """New layers vs torch (reference: nn/layer/common.py Fold,
+    vision.py ChannelShuffle, activation.py Softmax2D, distance.py)."""
+    import numpy as np
+    import pytest
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    import paddle_tpu.nn.functional as F
+
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 3, 8, 8)).astype("float32")
+
+    u = F.unfold_(paddle.to_tensor(x), 3, strides=2, paddings=1)
+    ut = torch.nn.functional.unfold(torch.tensor(x), 3, stride=2, padding=1)
+    np.testing.assert_array_equal(u.numpy(), ut.numpy())
+
+    f = nn.Fold((8, 8), 3, strides=2, paddings=1)(u)
+    ft = torch.nn.functional.fold(ut, (8, 8), 3, stride=2, padding=1)
+    np.testing.assert_array_equal(f.numpy(), ft.numpy())
+
+    s2 = nn.Softmax2D()(paddle.to_tensor(x))
+    assert np.abs(s2.numpy().sum(1) - 1).max() < 1e-6
+
+    cs = nn.ChannelShuffle(3)(paddle.to_tensor(x))
+    cst = torch.nn.functional.channel_shuffle(torch.tensor(x), 3)
+    np.testing.assert_array_equal(cs.numpy(), cst.numpy())
+
+    a = rng.standard_normal((4, 5)).astype("float32")
+    b = rng.standard_normal((4, 5)).astype("float32")
+    pd = nn.PairwiseDistance()(paddle.to_tensor(a), paddle.to_tensor(b))
+    pdt = torch.nn.PairwiseDistance()(torch.tensor(a), torch.tensor(b))
+    np.testing.assert_allclose(pd.numpy(), pdt.numpy(), rtol=1e-5)
