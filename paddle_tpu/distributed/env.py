@@ -36,10 +36,30 @@ def is_initialized():
 
 
 def init_parallel_env():
-    """reference parallel.py:978 init_parallel_env — on TPU the runtime
-    already rendezvoused (jax.distributed), so this marks state and returns
-    the default group."""
+    """reference parallel.py:978 init_parallel_env. Multi-process: bring
+    up jax's coordination service from the launcher env
+    (JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/JAX_PROCESS_ID set by
+    paddle_tpu.distributed.launch) — the TPU analog of TCPStore +
+    ProcessGroupNCCL init; single-process: just mark state."""
     global _initialized
+    if not _initialized:
+        nprocs = int(os.environ.get("JAX_NUM_PROCESSES",
+                                    os.environ.get("PADDLE_TRAINERS_NUM",
+                                                   "1")))
+        if nprocs > 1:
+            from jax._src import distributed as _jd
+            if getattr(_jd.global_state, "client", None) is None:
+                # not yet rendezvoused (on TPU pods the runtime may have
+                # done it already; then this is a no-op)
+                from .launch import DEFAULT_MASTER
+                jax.distributed.initialize(
+                    coordinator_address=os.environ.get(
+                        "JAX_COORDINATOR_ADDRESS",
+                        os.environ.get("PADDLE_MASTER", DEFAULT_MASTER)),
+                    num_processes=nprocs,
+                    process_id=int(os.environ.get(
+                        "JAX_PROCESS_ID",
+                        os.environ.get("PADDLE_TRAINER_ID", "0"))))
     _initialized = True
     from .collective import _get_or_create_default_group
     return _get_or_create_default_group()

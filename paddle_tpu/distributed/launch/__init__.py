@@ -21,6 +21,7 @@ import sys
 import time
 
 ELASTIC_EXIT_CODE = 101
+DEFAULT_MASTER = "127.0.0.1:8765"
 
 
 def build_rank_env(rank, nprocs, master, base_env=None, device_ids=None):
@@ -49,20 +50,23 @@ class Launcher:
     loop launch/controllers/controller.py)."""
 
     def __init__(self, cmd, nprocs, master=None, log_dir=None,
-                 max_restarts=0, elastic=False, device_ids=None):
+                 max_restarts=0, elastic=False, device_ids=None,
+                 base_env=None):
         self.cmd = cmd
         self.nprocs = nprocs
-        self.master = master or "127.0.0.1:8765"
+        self.master = master or DEFAULT_MASTER
         self.log_dir = log_dir
         self.max_restarts = max_restarts
         self.elastic = elastic
         self.device_ids = device_ids
+        self.base_env = base_env
         self.procs: list[subprocess.Popen] = []
 
     def _spawn(self):
         self.procs = []
         for rank in range(self.nprocs):
             env = build_rank_env(rank, self.nprocs, self.master,
+                                 base_env=self.base_env,
                                  device_ids=self.device_ids)
             stdout = None
             if self.log_dir:

@@ -21,11 +21,20 @@ __all__ = ["Generator", "default_generator", "seed", "get_rng_state",
 
 class Generator:
     def __init__(self, seed_: int = 0):
-        self._key = jax.random.key(seed_)
+        # key creation is deferred: materializing it at import time would
+        # initialize the XLA backend before jax.distributed.initialize
+        # can run (breaks multi-process startup)
+        self._key = None
         self._seed = seed_
 
+    def _ensure(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
+
     def manual_seed(self, seed_: int):
-        self._key = jax.random.key(seed_)
+        # stays deferred too: paddle.seed() is often the first line of a
+        # worker script, before init_parallel_env
+        self._key = None
         self._seed = seed_
         return self
 
@@ -35,12 +44,14 @@ class Generator:
         return self._seed
 
     def get_state(self):
+        self._ensure()
         return self._key
 
     def set_state(self, state):
         self._key = state
 
     def split(self):
+        self._ensure()
         self._key, sub = jax.random.split(self._key)
         return sub
 

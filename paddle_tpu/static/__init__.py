@@ -18,7 +18,7 @@ from . import nn  # noqa: F401
 
 __all__ = ["Program", "Variable", "program_guard", "default_main_program",
            "default_startup_program", "data", "Executor", "scope_guard",
-           "global_scope", "InputSpec", "nn", "name_scope",
+           "global_scope", "InputSpec", "nn", "name_scope", "save", "load",
            "save_inference_model", "load_inference_model", "cpu_places",
            "device_guard"]
 
@@ -49,6 +49,41 @@ class device_guard:
 
     def __exit__(self, *e):
         return False
+
+
+def save(program, model_path, protocol=4, **configs):
+    """Persist a Program's parameters (reference:
+    python/paddle/static/io.py save -> .pdparams/.pdopt)."""
+    import pickle
+
+    params = {f"p{i}": np.asarray(p._data)
+              for i, p in enumerate(program.all_parameters())}
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(params, f, protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """Restore parameters saved by static.save into the SAME program
+    structure (positional match, like the reference's name match)."""
+    import pickle
+
+    import jax.numpy as jnp
+
+    with open(model_path + ".pdparams", "rb") as f:
+        params = pickle.load(f)
+    n_prog = len(program.all_parameters())
+    if len(params) != n_prog:
+        raise ValueError(
+            f"checkpoint has {len(params)} parameters but the program "
+            f"has {n_prog}; static.load requires the same program "
+            "structure it was saved from")
+    for i, p in enumerate(program.all_parameters()):
+        arr = params[f"p{i}"]
+        if tuple(arr.shape) != tuple(p._data.shape):
+            raise ValueError(
+                f"param {i} shape mismatch: saved {arr.shape} vs program "
+                f"{tuple(p._data.shape)}")
+        p._data = jnp.asarray(arr, p._data.dtype)
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,

@@ -131,3 +131,48 @@ def test_rpc_sync_async_roundtrip():
             rpc.rpc_sync("w0", divmod, args=(1, 0))
     finally:
         rpc.shutdown()
+
+
+def test_multiprocess_collective_e2e(tmp_path):
+    """Launcher -> init_parallel_env -> cross-process collective, the
+    reference's CommunicationTestDistBase flow
+    (test/collective/test_communication_api_base.py:28,64) on two CPU
+    processes coordinated by jax's distributed service."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    script = _write(str(tmp_path), "worker.py", """
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        import paddle_tpu
+        import paddle_tpu.distributed as dist
+        dist.init_parallel_env()
+        assert jax.device_count() == 2, jax.device_count()
+        from jax.sharding import Mesh, PartitionSpec as P, NamedSharding
+        mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+        arr = jax.device_put(np.array([1.0, 2.0], np.float32),
+                             NamedSharding(mesh, P("dp")))
+        total = float(jax.jit(lambda a: jax.numpy.sum(a))(arr))
+        assert total == 3.0, total   # sum crosses the process boundary
+        print("COLLECTIVE_OK", flush=True)
+    """)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)            # one local device per process
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+    log_dir = str(tmp_path / "logs")
+    code = Launcher([sys.executable, script], nprocs=2,
+                    master=f"127.0.0.1:{port}", log_dir=log_dir,
+                    base_env=env).run()
+    assert code == 0
+    for r in range(2):
+        with open(os.path.join(log_dir, f"workerlog.{r}")) as f:
+            assert "COLLECTIVE_OK" in f.read()

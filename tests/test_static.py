@@ -134,3 +134,24 @@ def test_static_batchnorm_updates_running_stats():
     exe.run(main, feed={"img": xv}, fetch_list=[out])
     after = bn._mean.numpy()
     assert not np.allclose(before, after), "running mean not updated"
+
+
+def test_static_save_load_params(tmp_path):
+    rng = np.random.default_rng(7)
+    xv = rng.standard_normal((4, 6)).astype("float32")
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 6], "float32")
+        out = static.nn.fc(x, 3)
+    exe = static.Executor()
+    ref = exe.run(main, feed={"x": xv}, fetch_list=[out])[0]
+    path = str(tmp_path / "m")
+    static.save(main, path)
+    # perturb, then restore
+    for p in main.all_parameters():
+        p._data = p._data * 0
+    zeroed = exe.run(main, feed={"x": xv}, fetch_list=[out])[0]
+    assert not np.allclose(zeroed, ref)
+    static.load(main, path, exe)
+    back = exe.run(main, feed={"x": xv}, fetch_list=[out])[0]
+    np.testing.assert_allclose(back, ref, rtol=1e-6)
