@@ -114,6 +114,19 @@ from . import geometric  # noqa: F401
 from . import incubate  # noqa: F401
 from . import utils  # noqa: F401
 from . import onnx  # noqa: F401
+from . import version  # noqa: F401
+
+
+def iinfo(dtype):
+    import numpy as np
+    from .framework.dtype import to_np_dtype
+    return np.iinfo(to_np_dtype(dtype))
+
+
+def finfo(dtype):
+    import ml_dtypes
+    from .framework.dtype import to_np_dtype
+    return ml_dtypes.finfo(to_np_dtype(dtype))
 
 __version__ = "0.1.0"
 

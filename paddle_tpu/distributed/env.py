@@ -52,14 +52,20 @@ def init_parallel_env():
                 # not yet rendezvoused (on TPU pods the runtime may have
                 # done it already; then this is a no-op)
                 from .launch import DEFAULT_MASTER
+                rank_var = os.environ.get(
+                    "JAX_PROCESS_ID", os.environ.get("PADDLE_TRAINER_ID"))
+                if rank_var is None:
+                    raise RuntimeError(
+                        "multi-process init needs JAX_PROCESS_ID or "
+                        "PADDLE_TRAINER_ID per rank (set by "
+                        "paddle_tpu.distributed.launch); defaulting all "
+                        "ranks to 0 would hang the rendezvous")
                 jax.distributed.initialize(
                     coordinator_address=os.environ.get(
                         "JAX_COORDINATOR_ADDRESS",
                         os.environ.get("PADDLE_MASTER", DEFAULT_MASTER)),
                     num_processes=nprocs,
-                    process_id=int(os.environ.get(
-                        "JAX_PROCESS_ID",
-                        os.environ.get("PADDLE_TRAINER_ID", "0"))))
+                    process_id=int(rank_var))
     _initialized = True
     from .collective import _get_or_create_default_group
     return _get_or_create_default_group()

@@ -9,5 +9,7 @@ from __future__ import annotations
 
 from . import nn  # noqa: F401
 from . import asp  # noqa: F401
+from . import autotune  # noqa: F401
+from . import checkpoint  # noqa: F401
 
-__all__ = ["nn", "asp"]
+__all__ = ["nn", "asp", "autotune", "checkpoint"]

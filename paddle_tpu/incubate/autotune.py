@@ -1,0 +1,30 @@
+"""paddle.incubate.autotune (reference: python/paddle/incubate/autotune.py
+set_config for kernel/layout/dataloader tuning).
+
+On TPU, kernel algorithm search is XLA's autotuner (always on) and
+layout tuning is XLA's layout assignment; this surface records the
+config and applies the dataloader knobs it can.
+"""
+from __future__ import annotations
+
+import json
+
+_config = {"kernel": {"enable": True},
+           "layout": {"enable": True},
+           "dataloader": {"enable": False, "tuning_steps": 0}}
+
+
+def set_config(config=None):
+    global _config
+    if config is None:
+        return
+    if isinstance(config, str):          # file path per reference API
+        with open(config) as f:
+            config = json.load(f)
+    for k, v in config.items():
+        _config.setdefault(k, {}).update(v if isinstance(v, dict) else
+                                         {"enable": bool(v)})
+
+
+def get_config():
+    return dict(_config)

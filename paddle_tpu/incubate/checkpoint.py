@@ -1,0 +1,84 @@
+"""paddle.incubate.checkpoint — training auto-recovery.
+
+Reference: python/paddle/incubate/checkpoint/auto_checkpoint.py (acp:
+epoch-range contexts that snapshot program+optimizer state to durable
+storage and resume after preemption).
+
+TPU formulation: snapshots are paddle.save state dicts written every N
+steps with an atomic rename; `auto_checkpoint` resumes from the newest
+valid snapshot — the single-host analog of the elastic relaunch +
+dist-checkpoint resume path.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["AutoCheckpoint", "train_epoch_range"]
+
+
+class AutoCheckpoint:
+    def __init__(self, save_dir, model=None, optimizer=None, interval=1):
+        self.save_dir = save_dir
+        self.model = model
+        self.optimizer = optimizer
+        self.interval = interval
+        os.makedirs(save_dir, exist_ok=True)
+
+    def _path(self, step):
+        return os.path.join(self.save_dir, f"ckpt_{step}.pdparams")
+
+    def save(self, step):
+        if step % self.interval:
+            return
+        from .. import save as _save
+        payload = {"step": step}
+        if self.model is not None:
+            payload["model"] = self.model.state_dict()
+        if self.optimizer is not None:
+            payload["opt"] = self.optimizer.state_dict()
+        tmp = self._path(step) + ".tmp"
+        _save(payload, tmp)
+        os.replace(tmp, self._path(step))   # atomic: no torn snapshots
+
+    def latest_step(self):
+        steps = []
+        for f in os.listdir(self.save_dir):
+            if f.startswith("ckpt_") and f.endswith(".pdparams"):
+                try:
+                    steps.append(int(f[len("ckpt_"):-len(".pdparams")]))
+                except ValueError:
+                    pass
+        return max(steps) if steps else None
+
+    def restore(self):
+        """Returns the restored step, or None if no snapshot exists."""
+        step = self.latest_step()
+        if step is None:
+            return None
+        from .. import load as _load
+        payload = _load(self._path(step))
+        if self.model is not None and "model" in payload:
+            self.model.set_state_dict(payload["model"])
+        if self.optimizer is not None and "opt" in payload:
+            self.optimizer.set_state_dict(payload["opt"])
+        return payload["step"]
+
+
+def train_epoch_range(max_epoch, save_dir=None, model=None, optimizer=None,
+                      interval=1):
+    """Generator over epochs that resumes after the last snapshot
+    (reference acp._run_save_0 epoch-range semantics)."""
+    if save_dir is None:
+        save_dir = os.environ.get("PADDLE_AUTO_CHECKPOINT_DIR")
+    if save_dir is None:
+        # a fresh temp dir could never be found again after preemption,
+        # which is the entire point of auto-recovery
+        raise ValueError(
+            "train_epoch_range needs a stable save_dir (or "
+            "PADDLE_AUTO_CHECKPOINT_DIR) to resume from after restart")
+    acp = AutoCheckpoint(save_dir, model, optimizer, interval)
+    start = acp.restore()
+    first = 0 if start is None else start + 1
+    for epoch in range(first, max_epoch):
+        yield epoch
+        acp.save(epoch)

@@ -54,8 +54,12 @@ class device_guard:
 def save(program, model_path, protocol=4, **configs):
     """Persist a Program's parameters (reference:
     python/paddle/static/io.py save -> .pdparams/.pdopt)."""
+    import os
     import pickle
 
+    d = os.path.dirname(model_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
     params = {f"p{i}": np.asarray(p._data)
               for i, p in enumerate(program.all_parameters())}
     with open(model_path + ".pdparams", "wb") as f:
@@ -64,7 +68,8 @@ def save(program, model_path, protocol=4, **configs):
 
 def load(program, model_path, executor=None, var_list=None):
     """Restore parameters saved by static.save into the SAME program
-    structure (positional match, like the reference's name match)."""
+    structure (positional match, like the reference's name match).
+    var_list restricts the restore to those parameter tensors."""
     import pickle
 
     import jax.numpy as jnp
@@ -77,7 +82,10 @@ def load(program, model_path, executor=None, var_list=None):
             f"checkpoint has {len(params)} parameters but the program "
             f"has {n_prog}; static.load requires the same program "
             "structure it was saved from")
+    keep = None if var_list is None else {id(v) for v in var_list}
     for i, p in enumerate(program.all_parameters()):
+        if keep is not None and id(p) not in keep:
+            continue
         arr = params[f"p{i}"]
         if tuple(arr.shape) != tuple(p._data.shape):
             raise ValueError(

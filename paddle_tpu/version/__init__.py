@@ -1,0 +1,26 @@
+"""paddle.version (reference: generated python/paddle/version/__init__.py)."""
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+cuda_version = "False"
+cudnn_version = "False"
+tensorrt_version = None
+xpu_version = "False"
+
+
+def show():
+    print(f"paddle_tpu {full_version} (tpu/xla backend)")
+
+
+def cuda():
+    return False
+
+
+def cudnn():
+    return False
+
+
+def xpu():
+    return False

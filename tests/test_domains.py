@@ -198,3 +198,21 @@ def test_auto_tuner_prune_and_search():
     assert best is not None and best["tp"] == 2
     failed = [h for h in t.recorder.history if h["error"]]
     assert failed, "failed trials should be recorded"
+
+
+def test_auto_checkpoint_resume(tmp_path):
+    from paddle_tpu import nn
+    from paddle_tpu.incubate.checkpoint import train_epoch_range
+
+    model = nn.Linear(4, 4)
+    seen = []
+    for epoch in train_epoch_range(3, str(tmp_path), model=model):
+        seen.append(epoch)
+    assert seen == [0, 1, 2]
+    # resume: all epochs checkpointed, so nothing re-runs
+    again = list(train_epoch_range(3, str(tmp_path), model=model))
+    assert again == []
+    # partial: wipe the last snapshot -> resumes at 2
+    import os
+    os.remove(str(tmp_path / "ckpt_2.pdparams"))
+    assert list(train_epoch_range(3, str(tmp_path), model=model)) == [2]
