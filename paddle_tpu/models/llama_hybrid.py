@@ -237,21 +237,53 @@ class AdamWState(NamedTuple):
     v: dict
 
 
-def init_adamw(params):
-    z = jax.tree_util.tree_map(
+def _f32_zeros_like(params):
+    """fp32 buffers matching the param tree (optimizer state and grad
+    accumulators share this dtype/shape contract)."""
+    return jax.tree_util.tree_map(
         lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+
+def init_adamw(params):
+    z = _f32_zeros_like(params)
     return AdamWState(jnp.zeros((), jnp.int32), z,
                       jax.tree_util.tree_map(jnp.copy, z))
 
 
 def build_train_step(config: LlamaConfig, mesh: Mesh, lr=3e-4, wd=0.01,
                      n_micro=1, remat=True, sp=True, b1=0.9, b2=0.95,
-                     eps=1e-8):
-    """Returns jitted (params, opt, ids) -> (loss, params, opt)."""
+                     eps=1e-8, grad_accum=1):
+    """Returns jitted (params, opt, ids) -> (loss, params, opt).
+
+    grad_accum > 1 splits the batch into sequential chunks and averages
+    their grads before ONE optimizer step (reference: gradient-merge
+    pass / fleet accumulate_steps) — live activations stay bounded by
+    one chunk, trading wall-clock for a larger effective batch."""
+
+    def grad_of(params, ids):
+        if grad_accum == 1:
+            return jax.value_and_grad(loss_fn)(
+                params, ids, config, mesh, n_micro, remat, sp)
+        b = ids.shape[0]
+        assert b % grad_accum == 0, (b, grad_accum)
+        chunks = ids.reshape(grad_accum, b // grad_accum, ids.shape[1])
+
+        def acc(carry, chunk):
+            lsum, gsum = carry
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, chunk, config, mesh, n_micro, remat, sp)
+            gsum = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(jnp.float32), gsum, grads)
+            return (lsum + loss, gsum), None
+
+        (lsum, gsum), _ = jax.lax.scan(
+            acc, (jnp.float32(0.0), _f32_zeros_like(params)), chunks)
+        inv = 1.0 / grad_accum
+        return lsum * inv, jax.tree_util.tree_map(
+            lambda g: g * inv, gsum)
 
     def step(params, opt, ids):
-        loss, grads = jax.value_and_grad(loss_fn)(
-            params, ids, config, mesh, n_micro, remat, sp)
+        loss, grads = grad_of(params, ids)
         t = opt.step + 1
         tf = t.astype(jnp.float32)
 
