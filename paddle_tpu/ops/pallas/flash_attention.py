@@ -28,6 +28,25 @@ import numpy as np
 NUM_LANES = 128
 
 
+def _ab_t(a, b):
+    """a @ b.T with f32 accumulation (operands keep their dtype so bf16
+    runs the MXU at full rate)."""
+    return jax.lax.dot_general(a, b, (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _ab(a, b):
+    """a @ b with f32 accumulation."""
+    return jax.lax.dot_general(a, b, (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _at_b(a, b):
+    """a.T @ b with f32 accumulation."""
+    return jax.lax.dot_general(a, b, (((0,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
 def _xla_sdpa(q, k, v, attn_mask=None, is_causal=False, dropout_p=0.0,
               training=True, key=None):
     # [B, S, H, D] -> [B, H, S, D]
@@ -108,6 +127,7 @@ def sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
     """Paddle-layout scaled-dot-product attention: [B, S, H, D] in/out."""
     use_pallas = (
         attn_mask is None and dropout_p == 0.0
+        and q.dtype == k.dtype == v.dtype   # kernels matmul in input dtype
         and q.shape[-1] in (64, 128, 256)
         and q.shape[1] >= 256 and q.shape[1] % 256 == 0
         and k.shape[1] % 256 == 0
@@ -144,7 +164,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, block_k,
     # lse_ref is None for the inference-only variant (no residual needed)
     from jax.experimental import pallas as pl
 
-    q = q_ref[...].astype(jnp.float32) * jnp.float32(sm_scale)          # [bq, d]
+    q = q_ref[...]                                         # [bq, d]
     bq, d = q.shape
     kv_len = k_ref.shape[0]
     nblk = kv_len // block_k
@@ -152,9 +172,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, block_k,
 
     def body(i, carry):
         acc, m_prev, l_prev = carry
-        k = k_ref[pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
-        s = q @ k.T                                         # [bq, bk]
+        k = k_ref[pl.dslice(i * block_k, block_k), :]
+        v = v_ref[pl.dslice(i * block_k, block_k), :]
+        s = _ab_t(q, k) * jnp.float32(sm_scale)
         if causal:
             q_ids = q_blk * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 0)
@@ -165,7 +185,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, block_k,
         alpha = jnp.exp(m_prev - m_cur)
         p = jnp.exp(s - m_cur[:, None])
         l_cur = l_prev * alpha + jnp.sum(p, axis=1)
-        acc = acc * alpha[:, None] + p @ v
+        acc = acc * alpha[:, None] + _ab(p.astype(v.dtype), v)
         return acc, m_cur, l_cur
 
     acc0 = jnp.zeros((bq, d), jnp.float32)
@@ -230,8 +250,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref, *,
                    causal, block_k, sm_scale):
     from jax.experimental import pallas as pl
 
-    q = q_ref[...].astype(jnp.float32)                      # [bq, d]
-    do = do_ref[...].astype(jnp.float32)
+    q = q_ref[...]                                          # [bq, d]
+    do = do_ref[...]
     lse = lse_ref[:, 0]                                     # [bq]
     delta = dl_ref[:, 0]
     bq, d = q.shape
@@ -240,9 +260,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref, *,
     q_blk = pl.program_id(2)
 
     def body(i, dq):
-        k = k_ref[pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
-        s = (q @ k.T) * jnp.float32(sm_scale)                            # [bq, bk]
+        k = k_ref[pl.dslice(i * block_k, block_k), :]
+        v = v_ref[pl.dslice(i * block_k, block_k), :]
+        s = _ab_t(q, k) * jnp.float32(sm_scale)
         if causal:
             q_ids = q_blk * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 0)
@@ -250,9 +270,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref, *,
                 jnp.int32, (bq, block_k), 1)
             s = jnp.where(q_ids >= k_ids, s, -jnp.inf)
         p = jnp.exp(s - lse[:, None])                       # masked -> 0
-        dp = do @ v.T
+        dp = _ab_t(do, v)
         ds = p * (dp - delta[:, None]) * jnp.float32(sm_scale)
-        return dq + ds @ k
+        return dq + _ab(ds.astype(k.dtype), k)
 
     upper = ((q_blk + 1) * bq + block_k - 1) // block_k if causal else nblk
     dq = jax.lax.fori_loop(0, upper, body, jnp.zeros((bq, d), jnp.float32))
@@ -263,8 +283,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dk_ref,
                     dv_ref, *, causal, block_q, sm_scale):
     from jax.experimental import pallas as pl
 
-    k = k_ref[...].astype(jnp.float32)                      # [bk, d]
-    v = v_ref[...].astype(jnp.float32)
+    k = k_ref[...]                                          # [bk, d]
+    v = v_ref[...]
     bk, d = k.shape
     q_len = q_ref.shape[0]
     nblk = q_len // block_q
@@ -272,11 +292,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dk_ref,
 
     def body(i, carry):
         dk, dv = carry
-        q = q_ref[pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[pl.dslice(i * block_q, block_q), :]
+        do = do_ref[pl.dslice(i * block_q, block_q), :]
         lse = lse_ref[pl.dslice(i * block_q, block_q), 0]
         delta = dl_ref[pl.dslice(i * block_q, block_q), 0]
-        s = (q @ k.T) * jnp.float32(sm_scale)                            # [bq, bk]
+        s = _ab_t(q, k) * jnp.float32(sm_scale)
         if causal:
             q_ids = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, bk), 0)
@@ -284,10 +304,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dk_ref,
                 jnp.int32, (block_q, bk), 1)
             s = jnp.where(q_ids >= k_ids, s, -jnp.inf)
         p = jnp.exp(s - lse[:, None])
-        dv = dv + p.T @ do
-        dp = do @ v.T
+        dv = dv + _at_b(p.astype(do.dtype), do)
+        dp = _ab_t(do, v)
         ds = p * (dp - delta[:, None]) * jnp.float32(sm_scale)
-        dk = dk + ds.T @ q
+        dk = dk + _at_b(ds.astype(q.dtype), q)
         return dk, dv
 
     lower = (k_blk * bk) // block_q if causal else 0
