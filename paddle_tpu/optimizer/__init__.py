@@ -2,7 +2,9 @@
 from .optimizer import Optimizer
 from .optimizers import SGD, Momentum, Adam, AdamW, Adagrad, Adadelta, \
     RMSProp, Lamb
+from .optimizers_extra import Adamax, ASGD, NAdam, RAdam, Rprop, LBFGS
 from . import lr
 
 __all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad",
-           "Adadelta", "RMSProp", "Lamb", "lr"]
+           "Adadelta", "RMSProp", "Lamb", "Adamax", "ASGD", "NAdam",
+           "RAdam", "Rprop", "LBFGS", "lr"]

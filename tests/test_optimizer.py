@@ -155,3 +155,150 @@ class TestOptimizerState:
             for s, arr in slots.items():
                 np.testing.assert_allclose(
                     np.asarray(o2._accumulators[k][s]), np.asarray(arr))
+
+
+class TestExtraOptimizers:
+    """Adamax/ASGD/NAdam/RAdam/Rprop/LBFGS vs numpy replicas of the
+    reference kernels (paddle/phi/kernels/impl/{adamax,nadam,radam}_kernel_impl.h,
+    cpu/{rprop,asgd}_kernel.cc)."""
+
+    def _run(self, optimizer, steps=4, **kw):
+        w0 = rng.randn(1, 1).astype(np.float32)
+        m = _one_param_model(w0.copy())
+        o = optimizer(parameters=m.parameters(), **kw)
+        grads = []
+        for i in range(steps):
+            x = paddle.to_tensor(rng.randn(1, 1).astype(np.float32))
+            m(x).backward()
+            grads.append(float(x.numpy()[0, 0]))
+            o.step()
+            o.clear_grad()
+        return float(w0[0, 0]), grads, float(m.weight.numpy()[0, 0])
+
+    def test_adamax(self):
+        b1, b2, eps, lr = 0.9, 0.999, 1e-8, 0.01
+        w0, grads, w_got = self._run(opt.Adamax, learning_rate=lr,
+                                     beta1=b1, beta2=b2, epsilon=eps)
+        w, mom, u = w0, 0.0, 0.0
+        for t, g in enumerate(grads, 1):
+            mom = b1 * mom + (1 - b1) * g
+            u = max(abs(g), b2 * u + eps)
+            w -= lr / (1 - b1 ** t) * mom / u
+        np.testing.assert_allclose(w_got, w, rtol=1e-5)
+
+    def test_asgd(self):
+        lr, n = 0.1, 2
+        w0, grads, w_got = self._run(opt.ASGD, learning_rate=lr, batch_num=n)
+        w, d, ys = w0, 0.0, [0.0] * n
+        for t, g in enumerate(grads):
+            i = t % n
+            d = d - ys[i] + g
+            ys[i] = g
+            w -= lr / min(t + 1, n) * d
+        np.testing.assert_allclose(w_got, w, rtol=1e-5)
+
+    def test_nadam(self):
+        b1, b2, eps, psi, lr = 0.9, 0.999, 1e-8, 0.004, 0.01
+        w0, grads, w_got = self._run(opt.NAdam, learning_rate=lr)
+        w, m1, v, mu_prod = w0, 0.0, 0.0, 1.0
+        for t, g in enumerate(grads, 1):
+            mu_t = b1 * (1 - 0.5 * 0.96 ** (t * psi))
+            mu_t1 = b1 * (1 - 0.5 * 0.96 ** ((t + 1) * psi))
+            mu_prod *= mu_t
+            m1 = b1 * m1 + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            m_hat = mu_t1 * m1 / (1 - mu_prod * mu_t1) \
+                + (1 - mu_t) * g / (1 - mu_prod)
+            v_hat = v / (1 - b2 ** t)
+            w -= lr * m_hat / (np.sqrt(v_hat) + eps)
+        np.testing.assert_allclose(w_got, w, rtol=1e-5)
+
+    def test_radam(self):
+        b1, b2, eps, lr = 0.9, 0.999, 1e-8, 0.01
+        w0, grads, w_got = self._run(opt.RAdam, steps=6, learning_rate=lr)
+        w, m1, v = w0, 0.0, 0.0
+        rho_inf = 2 / (1 - b2) - 1
+        for t, g in enumerate(grads, 1):
+            m1 = b1 * m1 + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            rho_t = rho_inf - 2 * t * b2 ** t / (1 - b2 ** t)
+            m_hat = m1 / (1 - b1 ** t)
+            if rho_t > 5:
+                l_t = np.sqrt(1 - b2 ** t) / (np.sqrt(v) + eps)
+                r_t = np.sqrt((rho_t - 4) * (rho_t - 2) * rho_inf /
+                              ((rho_inf - 4) * (rho_inf - 2) * rho_t))
+                w -= lr * m_hat * r_t * l_t
+            else:
+                w -= lr * m_hat
+        np.testing.assert_allclose(w_got, w, rtol=1e-5)
+
+    def test_rprop(self):
+        lr = 0.01
+        w0, grads, w_got = self._run(opt.Rprop, learning_rate=lr,
+                                     learning_rate_range=(1e-5, 50.0),
+                                     etas=(0.5, 1.2))
+        w, prev, cur_lr = w0, 0.0, lr
+        for g in grads:
+            s = g * prev
+            eta = 1.2 if s > 0 else (0.5 if s < 0 else 1.0)
+            if s < 0:
+                g = 0.0
+            cur_lr = min(max(cur_lr * eta, 1e-5), 50.0)
+            prev = g
+            w -= np.sign(g) * cur_lr
+        np.testing.assert_allclose(w_got, w, rtol=1e-5)
+
+    def test_lbfgs_quadratic(self):
+        # minimize (w-3)^2: LBFGS should land near 3 in a few steps
+        m = _one_param_model(np.array([[0.0]], np.float32))
+        o = opt.LBFGS(learning_rate=1.0, max_iter=10,
+                      line_search_fn='strong_wolfe',
+                      parameters=m.parameters())
+
+        def closure():
+            o.clear_grad()
+            x = paddle.to_tensor([[1.0]])
+            loss = ((m(x) - 3.0) ** 2).sum()
+            loss.backward()
+            return loss
+
+        for _ in range(3):
+            o.step(closure)
+        np.testing.assert_allclose(m.weight.numpy(), [[3.0]], atol=1e-4)
+
+    def test_new_optimizers_traceable_under_jit(self):
+        # the jitted TrainStep bridge traces _update_param with a traced
+        # step count — every optimizer except LBFGS must compile
+        import paddle_tpu.nn.functional as F
+        for cls, kw in [(opt.Adamax, {}), (opt.ASGD, {"batch_num": 2}),
+                        (opt.NAdam, {}), (opt.RAdam, {}), (opt.Rprop, {})]:
+            m = _one_param_model(np.array([[1.0]], np.float32))
+            o = cls(learning_rate=0.01, parameters=m.parameters(), **kw)
+            step = paddle.jit.train_step(
+                m, o, lambda mod, x, y: ((mod(x) - y) ** 2).sum())
+            x = paddle.to_tensor([[1.0]])
+            y = paddle.to_tensor([[0.5]])
+            l0 = float(step(x, y))
+            l1 = float(step(x, y))
+            assert np.isfinite(l0) and np.isfinite(l1), cls.__name__
+
+    def test_lbfgs_state_roundtrip(self):
+        m = _one_param_model(np.array([[0.0]], np.float32))
+        o = opt.LBFGS(learning_rate=1.0, max_iter=3,
+                      parameters=m.parameters())
+
+        def closure():
+            o.clear_grad()
+            x = paddle.to_tensor([[1.0]])
+            loss = ((m(x) - 3.0) ** 2).sum()
+            loss.backward()
+            return loss
+
+        o.step(closure)
+        sd = o.state_dict()
+        m2 = _one_param_model(np.array(m.weight.numpy(), np.float32))
+        o2 = opt.LBFGS(learning_rate=1.0, max_iter=3,
+                       parameters=m2.parameters())
+        o2.set_state_dict(sd)
+        assert o2._state["n_iter"] == o._state["n_iter"]
+        assert len(o2._state["old_sks"]) == len(o._state["old_sks"])
