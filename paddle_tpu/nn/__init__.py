@@ -9,7 +9,9 @@ from .layer_pool import *  # noqa: F401,F403
 from .layer_loss import *  # noqa: F401,F403
 from .layer_moe import MoELayer  # noqa: F401
 from .layer_rnn import (  # noqa: F401
-    SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN, LSTM, GRU)
+    RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN,
+    LSTM, GRU)
+from .layer_extra import *  # noqa: F401,F403
 from .transformer import (  # noqa: F401
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
     TransformerDecoderLayer, TransformerDecoder, Transformer)

@@ -341,46 +341,12 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, groups=1, dilation=1,
                      output_size=None, data_format="NCHW", name=None):
-    # paddle weight layout: [in, out/groups, kh, kw]
-    nd = 2
-    strides = _pair(stride, nd)
-    pads = _conv_padding(padding, nd)
-    if isinstance(pads, str):
-        pads_list = pads
-    else:
-        pads_list = pads
-    kh, kw = weight.shape[2], weight.shape[3]
-    dil = _pair(dilation, nd)
-    opad = _pair(output_padding, nd)
-    # Use gradient-of-conv formulation: conv_transpose in jax flips spatial dims
-    w = jnp.swapaxes(weight, 0, 1)  # [out/groups, in, kh, kw] -> IOHW->OIHW-ish
-    if isinstance(pads_list, str):
-        padding_cfg = pads_list
-    else:
-        # effective padding for transpose: k-1-p
-        padding_cfg = [
-            (dil[i] * (weight.shape[2 + i] - 1) - pads_list[i][0],
-             dil[i] * (weight.shape[2 + i] - 1) - pads_list[i][1] + opad[i])
-            for i in range(nd)]
-    dn = jax.lax.conv_dimension_numbers(
-        x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
-    w_flip = jnp.flip(w, axis=(2, 3))
-    if groups > 1:
-        # grouped transpose: split, run per group, concat
-        xs = jnp.split(x, groups, axis=1)
-        ws = jnp.split(w_flip, groups, axis=0)
-        outs = [jax.lax.conv_general_dilated(
-            xi, wi, window_strides=(1, 1), padding=padding_cfg,
-            lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn)
-            for xi, wi in zip(xs, ws)]
-        out = jnp.concatenate(outs, axis=1)
-    else:
-        out = jax.lax.conv_general_dilated(
-            x, w_flip, window_strides=(1, 1), padding=padding_cfg,
-            lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn)
-    if bias is not None:
-        out = out + bias.reshape([1, -1, 1, 1])
-    return out
+    # paddle weight layout: [in, out/groups, kh, kw]; shared nd helper in
+    # functional_extra (gradient-of-conv formulation)
+    from .functional_extra import _conv_transpose_nd
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, groups, dilation, 2,
+                              ("NCHW", "OIHW", "NCHW"), output_size)
 
 
 # ------------------------------------------------------------------- pooling
@@ -399,10 +365,17 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
         window = (1,) + k + (1,)
         strides = (1,) + s + (1,)
         pad_cfg = [(0, 0)] + pads + [(0, 0)]
+    if return_mask:
+        from .functional_extra import _pool_argmax
+        if data_format != "NCHW":  # pool spatial dims, not channels
+            o, m = _pool_argmax(jnp.transpose(x, (0, 3, 1, 2)), k, s, pads)
+            return (jnp.transpose(o, (0, 2, 3, 1)),
+                    jnp.transpose(m, (0, 2, 3, 1)))
+        return _pool_argmax(x, k, s, pads)
     neg = np.asarray(-np.inf if jnp.issubdtype(x.dtype, jnp.floating)
                      else np.iinfo(x.dtype).min, x.dtype)
-    out = jax.lax.reduce_window(x, neg, jax.lax.max, window, strides, pad_cfg)
-    return out
+    return jax.lax.reduce_window(x, neg, jax.lax.max, window, strides,
+                                 pad_cfg)
 
 
 @op
@@ -438,6 +411,9 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
     k = _pair(kernel_size, 1)
     s = _pair(stride if stride is not None else kernel_size, 1)
     pads = _conv_padding(padding, 1)
+    if return_mask:
+        from .functional_extra import _pool_argmax
+        return _pool_argmax(x, k, s, pads)
     neg = np.asarray(-np.inf, x.dtype)
     return jax.lax.reduce_window(x, neg, jax.lax.max, (1, 1) + k, (1, 1) + s,
                                  [(0, 0), (0, 0)] + pads)
@@ -1003,3 +979,7 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
         return out[:, :, ph:ph + oh, pw:pw + ow]
 
     return apply_op("fold", body, (x,), {})
+
+
+# surface part 2 (3d pools, unpool, transposed convs, ctc/rnnt/... losses)
+from .functional_extra import *  # noqa: E402,F401,F403

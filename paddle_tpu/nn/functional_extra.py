@@ -1,0 +1,657 @@
+"""nn.functional, part 2 — pooling/conv/loss surface completing parity with
+python/paddle/nn/functional/{pooling,conv,loss,activation}.py.
+
+Everything is a registered framework op over pure jax bodies; window ops use
+lax.reduce_window (XLA tiles these), unpool/fractional use gather/scatter.
+CTC (reference phi/kernels/cpu/ctc_align & warpctc binding) and RNNT
+(third_party/warprnnt) are implemented natively as log-space dynamic programs
+with lax.scan — no vendor library.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.registry import op
+from ..framework import random as _random
+from .functional import _pair, _conv_padding, _reduce
+from ..ops.math_extra import unflatten  # noqa: F401  (shared op)
+
+__all__ = [
+    "max_pool3d", "avg_pool3d", "adaptive_avg_pool3d", "adaptive_max_pool1d",
+    "adaptive_max_pool3d", "lp_pool1d", "lp_pool2d", "fractional_max_pool2d",
+    "fractional_max_pool3d", "max_unpool1d", "max_unpool2d", "max_unpool3d",
+    "conv1d_transpose", "conv3d_transpose", "dropout3d",
+    "feature_alpha_dropout", "log_sigmoid", "thresholded_relu", "unflatten",
+    "gaussian_nll_loss", "poisson_nll_loss", "multi_margin_loss",
+    "multi_label_soft_margin_loss", "soft_margin_loss",
+    "triplet_margin_with_distance_loss", "ctc_loss", "rnnt_loss",
+    "hsigmoid_loss", "max_pool2d_with_index",
+]
+
+
+# ------------------------------------------------------------------ pooling
+
+def _window_cfg(k, s, pads, nd):
+    window = (1, 1) + k
+    strides = (1, 1) + s
+    # string padding ('SAME'/'VALID') passes straight through to reduce_window
+    pad_cfg = pads if isinstance(pads, str) \
+        else [(0, 0), (0, 0)] + list(pads)
+    return window, strides, pad_cfg
+
+
+@op
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW", name=None):
+    if data_format == "NDHWC":
+        out = max_pool3d.__op_body__(
+            jnp.transpose(x, (0, 4, 1, 2, 3)), kernel_size, stride, padding,
+            ceil_mode, return_mask, "NCDHW")
+        if return_mask:
+            return (jnp.transpose(out[0], (0, 2, 3, 4, 1)),
+                    jnp.transpose(out[1], (0, 2, 3, 4, 1)))
+        return jnp.transpose(out, (0, 2, 3, 4, 1))
+    k = _pair(kernel_size, 3)
+    s = _pair(stride if stride is not None else kernel_size, 3)
+    pads = _conv_padding(padding, 3)
+    if return_mask:
+        return _pool_argmax(x, k, s, pads)
+    window, strides, pad_cfg = _window_cfg(k, s, pads, 3)
+    neg = np.asarray(-np.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                     else np.iinfo(x.dtype).min, x.dtype)
+    return jax.lax.reduce_window(x, neg, jax.lax.max, window, strides,
+                                 pad_cfg)
+
+
+@op
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    if data_format == "NDHWC":
+        out = avg_pool3d.__op_body__(
+            jnp.transpose(x, (0, 4, 1, 2, 3)), kernel_size, stride, padding,
+            ceil_mode, exclusive, divisor_override, "NCDHW")
+        return jnp.transpose(out, (0, 2, 3, 4, 1))
+    k = _pair(kernel_size, 3)
+    s = _pair(stride if stride is not None else kernel_size, 3)
+    pads = _conv_padding(padding, 3)
+    window, strides, pad_cfg = _window_cfg(k, s, pads, 3)
+    summed = jax.lax.reduce_window(x, np.zeros((), x.dtype), jax.lax.add,
+                                   window, strides, pad_cfg)
+    if divisor_override:
+        return summed / divisor_override
+    if exclusive:
+        counts = jax.lax.reduce_window(jnp.ones_like(x),
+                                       np.zeros((), x.dtype), jax.lax.add,
+                                       window, strides, pad_cfg)
+        return summed / counts
+    return summed / (k[0] * k[1] * k[2])
+
+
+def _adaptive_pool_nd(x, output_size, nd, reducer):
+    """Variable-window adaptive pool over the trailing nd spatial axes."""
+    spatial = x.shape[-nd:]
+    out_sizes = _pair(output_size, nd)
+
+    def pool_axis(a, in_s, out_s, axis):
+        if in_s % out_s == 0:
+            r = in_s // out_s
+            shp = list(a.shape)
+            shp[axis:axis + 1] = [out_s, r]
+            return reducer(a.reshape(shp), axis + 1)
+        starts = (np.arange(out_s) * in_s) // out_s
+        ends = ((np.arange(out_s) + 1) * in_s + out_s - 1) // out_s
+        pieces = [reducer(jax.lax.slice_in_dim(a, int(st), int(en), axis=axis),
+                          axis, keepdims=True)
+                  for st, en in zip(starts, ends)]
+        return jnp.concatenate(pieces, axis=axis)
+
+    ax0 = x.ndim - nd
+    for i in range(nd):
+        x = pool_axis(x, spatial[i], out_sizes[i], ax0 + i)
+    return x
+
+
+@op
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool_nd(
+        x, output_size, 3,
+        lambda a, ax, keepdims=False: jnp.mean(a, axis=ax, keepdims=keepdims))
+
+
+@op
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool3d(return_mask=True) is not supported; use "
+            "max_pool3d(..., return_mask=True) for unpool indices")
+    return _adaptive_pool_nd(
+        x, output_size, 3,
+        lambda a, ax, keepdims=False: jnp.max(a, axis=ax, keepdims=keepdims))
+
+
+@op
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool1d(return_mask=True) is not supported; use "
+            "max_pool1d(..., return_mask=True) for unpool indices")
+    return _adaptive_pool_nd(
+        x, output_size, 1,
+        lambda a, ax, keepdims=False: jnp.max(a, axis=ax, keepdims=keepdims))
+
+
+@op
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    k = _pair(kernel_size, 1)
+    s = _pair(stride if stride is not None else kernel_size, 1)
+    pads = _conv_padding(padding, 1)
+    window, strides, pad_cfg = _window_cfg(k, s, pads, 1)
+    p = float(norm_type)
+    if math.isinf(p):
+        neg = np.asarray(-np.inf, x.dtype)
+        return jax.lax.reduce_window(jnp.abs(x), neg, jax.lax.max,
+                                     window, strides, pad_cfg)
+    summed = jax.lax.reduce_window(jnp.abs(x) ** p, np.zeros((), x.dtype),
+                                   jax.lax.add, window, strides, pad_cfg)
+    return summed ** (1.0 / p)
+
+
+@op
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    k = _pair(kernel_size, 2)
+    s = _pair(stride if stride is not None else kernel_size, 2)
+    pads = _conv_padding(padding, 2)
+    window, strides, pad_cfg = _window_cfg(k, s, pads, 2)
+    p = float(norm_type)
+    if math.isinf(p):
+        neg = np.asarray(-np.inf, x.dtype)
+        return jax.lax.reduce_window(jnp.abs(x), neg, jax.lax.max,
+                                     window, strides, pad_cfg)
+    summed = jax.lax.reduce_window(jnp.abs(x) ** p, np.zeros((), x.dtype),
+                                   jax.lax.add, window, strides, pad_cfg)
+    return summed ** (1.0 / p)
+
+
+def _fractional_bounds(in_s, out_s, u):
+    """Graham fractional pooling boundaries: b_i = ceil(alpha*(i+u)) clipped,
+    with windows [b_i, b_{i+1})."""
+    alpha = in_s / out_s
+    idx = np.arange(out_s + 1, dtype=np.float64)
+    b = np.ceil(alpha * (idx + u)).astype(np.int64) - int(np.ceil(alpha * u))
+    b = np.clip(b, 0, in_s)
+    b[0], b[-1] = 0, in_s
+    return b
+
+
+def _fractional_pool(x, output_size, random_u, nd):
+    out_sizes = _pair(output_size, nd)
+    if random_u is None:
+        random_u = float(jax.random.uniform(_random.split_key(), ()))
+    ax0 = x.ndim - nd
+    for i in range(nd):
+        in_s = x.shape[ax0 + i]
+        b = _fractional_bounds(in_s, out_sizes[i], random_u)
+        pieces = [jnp.max(jax.lax.slice_in_dim(
+            x, int(b[j]), int(max(b[j + 1], b[j] + 1)), axis=ax0 + i),
+            axis=ax0 + i, keepdims=True) for j in range(out_sizes[i])]
+        x = jnp.concatenate(pieces, axis=ax0 + i)
+    return x
+
+
+@op
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "fractional_max_pool2d(return_mask=True) is not supported")
+    return _fractional_pool(x, output_size, random_u, 2)
+
+
+@op
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "fractional_max_pool3d(return_mask=True) is not supported")
+    return _fractional_pool(x, output_size, random_u, 3)
+
+
+# ------------------------------------------------- max pool w/ index, unpool
+
+def _pool_argmax(x, k, s, pads):
+    """Max pool returning (values, flat spatial argmax) for the trailing
+    len(k) spatial axes (reference max_pool2d_with_index kernel)."""
+    nd = len(k)
+    if isinstance(pads, str):
+        if pads != "VALID":
+            raise ValueError("return_mask pooling supports int padding only")
+        pads = [(0, 0)] * nd
+    spatial = x.shape[-nd:]
+    pad_width = [(0, 0)] * (x.ndim - nd) + list(pads)
+    neg = np.asarray(-np.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                     else np.iinfo(x.dtype).min, x.dtype)
+    xp = jnp.pad(x, pad_width, constant_values=neg)
+    # flat index of each padded position in the ORIGINAL (unpadded) map
+    grids = jnp.meshgrid(*[jnp.arange(xp.shape[-nd + i]) - pads[i][0]
+                           for i in range(nd)], indexing="ij")
+    flat = jnp.zeros_like(grids[0])
+    for i in range(nd):
+        flat = flat * spatial[i] + jnp.clip(grids[i], 0, spatial[i] - 1)
+    flat = flat.astype(jnp.int32)
+    # gather windows: out_shape x prod(k)
+    out_sp = [ (xp.shape[-nd + i] - k[i]) // s[i] + 1 for i in range(nd)]
+    vals, idxs = [], []
+    for offs in np.ndindex(*k):
+        sl = tuple([slice(None)] * (x.ndim - nd) +
+                   [slice(offs[i], offs[i] + (out_sp[i] - 1) * s[i] + 1, s[i])
+                    for i in range(nd)])
+        vals.append(xp[sl])
+        idxs.append(jnp.broadcast_to(flat[tuple(
+            slice(offs[i], offs[i] + (out_sp[i] - 1) * s[i] + 1, s[i])
+            for i in range(nd))], xp[sl].shape))
+    v = jnp.stack(vals, axis=-1)
+    ix = jnp.stack(idxs, axis=-1)
+    amax = jnp.argmax(v, axis=-1)
+    out = jnp.take_along_axis(v, amax[..., None], axis=-1)[..., 0]
+    out_idx = jnp.take_along_axis(ix, amax[..., None], axis=-1)[..., 0]
+    return out, out_idx
+
+
+@op
+def max_pool2d_with_index(x, kernel_size, stride=None, padding=0, name=None):
+    k = _pair(kernel_size, 2)
+    s = _pair(stride if stride is not None else kernel_size, 2)
+    pads = _conv_padding(padding, 2)
+    return _pool_argmax(x, k, s, pads)
+
+
+def _max_unpool(x, indices, nd, kernel_size, stride, padding, output_size,
+                data_format):
+    if data_format in ("NLC", "NHWC", "NDHWC"):  # channels-last: recurse NCX
+        perm_in = (0, nd + 1) + tuple(range(1, nd + 1))
+        perm_out = (0,) + tuple(range(2, nd + 2)) + (1,)
+        out = _max_unpool(jnp.transpose(x, perm_in),
+                          jnp.transpose(indices, perm_in), nd, kernel_size,
+                          stride, padding, output_size, "NC" + "X" * nd)
+        return jnp.transpose(out, perm_out)
+    k = _pair(kernel_size, nd)
+    s = _pair(stride if stride is not None else kernel_size, nd)
+    p = _pair(padding, nd)
+    in_sp = x.shape[-nd:]
+    if output_size is None:
+        out_sp = [ (in_sp[i] - 1) * s[i] - 2 * p[i] + k[i] for i in range(nd)]
+    else:
+        out_sp = list(_pair(output_size, nd))[-nd:]
+    lead = x.shape[:-nd]
+    total = int(np.prod(out_sp))
+    xf = x.reshape(lead + (-1,))
+    idxf = indices.reshape(lead + (-1,)).astype(jnp.int32)
+    flat_lead = int(np.prod(lead)) if lead else 1
+    xf2 = xf.reshape(flat_lead, -1)
+    idx2 = idxf.reshape(flat_lead, -1)
+    out = jnp.zeros((flat_lead, total), x.dtype)
+    out = jax.vmap(lambda o, i, v: o.at[i].set(v))(out, idx2, xf2)
+    return out.reshape(lead + tuple(out_sp))
+
+
+@op
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, 1, kernel_size, stride, padding,
+                       output_size, data_format)
+
+
+@op
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 2, kernel_size, stride, padding,
+                       output_size, data_format)
+
+
+@op
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 3, kernel_size, stride, padding,
+                       output_size, data_format)
+
+
+# ----------------------------------------------------------- transposed conv
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       groups, dilation, nd, spec, output_size=None):
+    strides = _pair(stride, nd)
+    pads = _conv_padding(padding, nd)
+    dil = _pair(dilation, nd)
+    opad = _pair(output_padding, nd)
+    if output_size is not None and not isinstance(pads, str):
+        # paddle semantics: output_size disambiguates the strided-transpose
+        # shape; realize it as extra trailing output padding
+        want = _pair(output_size, nd)[-nd:]
+        opad = list(opad)
+        for i in range(nd):
+            default = ((x.shape[2 + i] - 1) * strides[i] - pads[i][0]
+                       - pads[i][1] + dil[i] * (weight.shape[2 + i] - 1) + 1)
+            extra = int(want[i]) - default
+            if extra < 0 or extra >= strides[i]:
+                raise ValueError(
+                    f"invalid output_size {want[i]} for dim {i}: reachable "
+                    f"range is [{default}, {default + strides[i] - 1}]")
+            opad[i] = opad[i] + extra
+        opad = tuple(opad)
+    w = jnp.swapaxes(weight, 0, 1)  # paddle [in, out/g, *k] -> [out/g, in, *k]
+    if isinstance(pads, str):
+        padding_cfg = pads
+    else:
+        padding_cfg = [
+            (dil[i] * (weight.shape[2 + i] - 1) - pads[i][0],
+             dil[i] * (weight.shape[2 + i] - 1) - pads[i][1] + opad[i])
+            for i in range(nd)]
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, spec)
+    w_flip = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+    ones = (1,) * nd
+    if groups > 1:
+        xs = jnp.split(x, groups, axis=1)
+        ws = jnp.split(w_flip, groups, axis=0)
+        outs = [jax.lax.conv_general_dilated(
+            xi, wi, window_strides=ones, padding=padding_cfg,
+            lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn)
+            for xi, wi in zip(xs, ws)]
+        out = jnp.concatenate(outs, axis=1)
+    else:
+        out = jax.lax.conv_general_dilated(
+            x, w_flip, window_strides=ones, padding=padding_cfg,
+            lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn)
+    if bias is not None:
+        out = out + bias.reshape((1, -1) + ones)
+    return out
+
+
+@op
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, groups, dilation, 1,
+                              ("NCH", "OIH", "NCH"), output_size)
+
+
+@op
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, groups, dilation, 3,
+                              ("NCDHW", "OIDHW", "NCDHW"), output_size)
+
+
+# ---------------------------------------------------------------- dropout &c
+
+@op
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    if not training or p == 0.0:
+        return x
+    c_axis = 1 if data_format == "NCDHW" else 4
+    shape = [x.shape[0], 1, 1, 1, 1]
+    shape[c_axis] = x.shape[c_axis]
+    keep = jax.random.bernoulli(_random.split_key(), 1.0 - p, tuple(shape))
+    return jnp.where(keep, x / (1.0 - p), jnp.zeros((), x.dtype))
+
+
+@op
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    alpha_p = -1.7580993408473766
+    shape = (x.shape[0], x.shape[1]) + (1,) * (x.ndim - 2)
+    keep = jax.random.bernoulli(_random.split_key(), 1.0 - p, shape)
+    a = 1.0 / math.sqrt((alpha_p ** 2 * p + 1) * (1 - p))
+    b = -a * alpha_p * p
+    return a * jnp.where(keep, x, jnp.asarray(alpha_p, x.dtype)) + b
+
+
+@op
+def log_sigmoid(x, name=None):
+    return jax.nn.log_sigmoid(x)
+
+
+@op
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return jnp.where(x > threshold, x, jnp.asarray(value, x.dtype))
+
+
+# -------------------------------------------------------------------- losses
+
+@op
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    var = jnp.maximum(variance, epsilon)
+    loss = 0.5 * (jnp.log(var) + jnp.square(input - label) / var)
+    if full:
+        loss = loss + 0.5 * math.log(2 * math.pi)
+    return _reduce(loss, reduction)
+
+
+@op
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    if log_input:
+        loss = jnp.exp(input) - label * input
+    else:
+        loss = input - label * jnp.log(input + epsilon)
+    if full:
+        # Stirling approximation for label! (only where label > 1)
+        stirling = (label * jnp.log(label) - label
+                    + 0.5 * jnp.log(2 * math.pi * label))
+        loss = loss + jnp.where(label > 1, stirling, 0.0)
+    return _reduce(loss, reduction)
+
+
+@op
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    loss = jnp.log1p(jnp.exp(-label.astype(input.dtype) * input))
+    return _reduce(loss, reduction)
+
+
+@op
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    y = label.astype(input.dtype)
+    loss = -(y * jax.nn.log_sigmoid(input)
+             + (1 - y) * jax.nn.log_sigmoid(-input))
+    if weight is not None:
+        loss = loss * weight
+    loss = jnp.mean(loss, axis=-1)
+    return _reduce(loss, reduction)
+
+
+@op
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    n, c = input.shape
+    correct = jnp.take_along_axis(input, label[:, None], axis=1)
+    m = jnp.maximum(margin - correct + input, 0.0)
+    if p != 1:
+        m = m ** p
+    if weight is not None:
+        m = m * weight[label][:, None]
+    mask = jax.nn.one_hot(label, c, dtype=input.dtype)
+    loss = jnp.sum(m * (1 - mask), axis=1) / c
+    return _reduce(loss, reduction)
+
+
+@op
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    if distance_function is None:
+        distance_function = lambda a, b: jnp.linalg.norm(a - b, axis=-1)
+    d_pos = distance_function(input, positive)
+    d_neg = distance_function(input, negative)
+    if swap:
+        d_neg = jnp.minimum(d_neg, distance_function(positive, negative))
+    loss = jnp.maximum(d_pos - d_neg + margin, 0.0)
+    return _reduce(loss, reduction)
+
+
+@op
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False, name=None):
+    """Native CTC (reference binds warpctc: paddle/phi/kernels/impl/
+    warpctc_kernel_impl.h).  log_probs [T, N, C] logits (softmax applied
+    here), labels [N, L]."""
+    lp = jax.nn.log_softmax(log_probs, axis=-1)
+    T, N, C = lp.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    neg_inf = -1e30
+
+    def per_sample(lp_n, lab, t_len, l_len):
+        ext = jnp.full((S,), blank, labels.dtype)
+        ext = ext.at[1::2].set(lab)
+        emit = lp_n[:, ext]  # [T, S]
+        same = jnp.concatenate([jnp.ones((2,), bool), ext[2:] == ext[:-2]])
+        valid_s = jnp.arange(S) < 2 * l_len + 1
+        alpha0 = jnp.full((S,), neg_inf)
+        alpha0 = alpha0.at[0].set(emit[0, 0])
+        alpha0 = alpha0.at[1].set(
+            jnp.where(l_len > 0, emit[0, 1], neg_inf))
+
+        def step(carry, inp):
+            alpha, t = carry
+            e = inp
+            a1 = jnp.concatenate([jnp.full((1,), neg_inf), alpha[:-1]])
+            a2 = jnp.concatenate([jnp.full((2,), neg_inf), alpha[:-2]])
+            a2 = jnp.where(same, neg_inf, a2)
+            new = jnp.logaddexp(jnp.logaddexp(alpha, a1), a2) + e
+            new = jnp.where(valid_s, new, neg_inf)
+            # freeze once past this sample's input length
+            new = jnp.where(t < t_len, new, alpha)
+            return (new, t + 1), None
+
+        (alpha, _), _ = jax.lax.scan(step, (alpha0, jnp.asarray(1)), emit[1:])
+        end1 = alpha[jnp.maximum(2 * l_len - 1, 0)]
+        end2 = alpha[2 * l_len]
+        ll = jnp.logaddexp(jnp.where(l_len > 0, end1, neg_inf), end2)
+        return -ll
+
+    losses = jax.vmap(per_sample, in_axes=(1, 0, 0, 0))(
+        lp, labels, input_lengths, label_lengths)
+    if reduction == "mean":
+        return jnp.mean(losses / jnp.maximum(label_lengths, 1))
+    return _reduce(losses, reduction)
+
+
+@op
+def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """Native RNN-T loss (reference binds warprnnt: phi/kernels/impl/
+    warprnnt_kernel_impl.h).  logits [N, T, U+1, C], labels [N, U].
+
+    FastEmit (gradient-level emit rescaling in warprnnt) is not applied:
+    a nonzero ``fastemit_lambda`` warns and computes the standard
+    transducer NLL.
+    """
+    if fastemit_lambda and not getattr(rnnt_loss, "_fastemit_warned", False):
+        import warnings
+        rnnt_loss._fastemit_warned = True
+        warnings.warn(
+            "rnnt_loss: fastemit_lambda is accepted for API parity but the "
+            "FastEmit gradient rescaling is not applied (standard "
+            "transducer loss computed)", stacklevel=2)
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    N, T, U1, C = lp.shape
+    U = U1 - 1
+    neg_inf = -1e30
+
+    def per_sample(lp_n, lab, t_len, u_len):
+        blank_lp = lp_n[:, :, blank]                       # [T, U+1]
+        emit_lp = jnp.take_along_axis(
+            lp_n[:, :U, :], lab[None, :, None].astype(jnp.int32),
+            axis=2)[..., 0]                                # [T, U]
+
+        u_idx = jnp.arange(U1)
+
+        def t_step(alpha_prev, inp):
+            t, blank_row, emit_row = inp
+            # alpha[t, u] from alpha[t-1, u] (blank) then left-to-right u scan
+            from_blank = alpha_prev + blank_row            # [U+1]
+
+            def u_step(carry, inp_u):
+                u, fb, em_prev = inp_u
+                val = jnp.where(u == 0, fb,
+                                jnp.logaddexp(fb, carry + em_prev))
+                return val, val
+
+            em_prev = jnp.concatenate([jnp.zeros((1,)), emit_row])  # pad u=0
+            _, alpha_t = jax.lax.scan(
+                u_step, neg_inf, (u_idx, from_blank, em_prev))
+            alpha_t = jnp.where(u_idx <= u_len, alpha_t, neg_inf)
+            alpha_t = jnp.where(t < t_len, alpha_t, alpha_prev)
+            return alpha_t, alpha_t
+
+        # alpha[0, u]: only via emits along u
+        def u0_step(carry, inp_u):
+            u, em_prev = inp_u
+            val = jnp.where(u == 0, 0.0, carry + em_prev)
+            return val, val
+
+        em_prev0 = jnp.concatenate([jnp.zeros((1,)), emit_lp[0]])
+        _, alpha0 = jax.lax.scan(u0_step, 0.0, (u_idx, em_prev0))
+        alpha0 = jnp.where(u_idx <= u_len, alpha0, neg_inf)
+
+        ts = jnp.arange(1, T)
+        # alpha[t,u] = logaddexp(alpha[t-1,u] + blank(t-1,u),
+        #                        alpha[t,u-1] + emit(t,u-1))
+        alpha_T, _ = jax.lax.scan(
+            t_step, alpha0, (ts, blank_lp[:-1], emit_lp[1:]))
+        # final: alpha[t_len-1, u_len] + blank(t_len-1, u_len)
+        ll = alpha_T[u_len] + blank_lp[jnp.maximum(t_len - 1, 0), u_len]
+        return -ll
+
+    losses = jax.vmap(per_sample)(lp, labels, logit_lengths, label_lengths)
+    return _reduce(losses, reduction)
+
+
+@op
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid over the default complete binary tree
+    (reference phi/kernels/cpu/hsigmoid_loss_kernel.cc; matrix_bit_code.h
+    encodes class c as the path of node (c + num_classes) back to root)."""
+    if path_table is not None:
+        codes = path_code
+        table = path_table
+        depth = table.shape[1]
+        rows = table.astype(jnp.int32)
+        valid = rows >= 0
+        rows = jnp.maximum(rows, 0)
+    else:
+        depth = max(int(np.ceil(np.log2(max(num_classes, 2)))) + 1, 1)
+        node = label.astype(jnp.int32) + num_classes
+        rows_l, codes_l = [], []
+        for _ in range(depth):
+            parent = node // 2
+            codes_l.append((node % 2).astype(jnp.float32))
+            rows_l.append(parent - 1)
+            node = parent
+        rows = jnp.stack(rows_l, axis=-1)
+        codes = jnp.stack(codes_l, axis=-1)
+        valid = rows >= 0
+        rows = jnp.maximum(rows, 0)
+    w = weight[rows]                       # [N, depth, D]
+    logits = jnp.einsum("nd,nkd->nk", input, w)
+    if bias is not None:
+        logits = logits + bias.reshape(-1)[rows]
+    codes = codes.astype(logits.dtype)
+    # BCE with the path bit as target: softplus(z) - code*z
+    per_node = -jax.nn.log_sigmoid((2.0 * codes - 1.0) * logits)
+    per_node = jnp.where(valid, per_node, 0.0)
+    return jnp.sum(per_node, axis=-1, keepdims=True)

@@ -1,0 +1,544 @@
+"""Layer surface part 2 — classes completing parity with
+python/paddle/nn/layer/{pooling,conv,loss,activation,common}.py."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from .layer import Layer
+from .initializer import Uniform
+from . import functional as F
+
+__all__ = [
+    "MaxPool3D", "AvgPool3D", "AdaptiveAvgPool3D", "AdaptiveMaxPool1D",
+    "AdaptiveMaxPool3D", "LPPool1D", "LPPool2D", "FractionalMaxPool2D",
+    "FractionalMaxPool3D", "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D",
+    "Conv1DTranspose", "Conv3DTranspose", "Dropout3D", "FeatureAlphaDropout",
+    "LogSigmoid", "ThresholdedReLU", "Unflatten", "ZeroPad1D", "ZeroPad3D",
+    "GaussianNLLLoss", "PoissonNLLLoss", "MultiMarginLoss",
+    "MultiLabelSoftMarginLoss", "SoftMarginLoss",
+    "TripletMarginWithDistanceLoss", "CTCLoss", "RNNTLoss", "HSigmoidLoss",
+    "AdaptiveLogSoftmaxWithLoss", "ParameterDict",
+]
+
+
+# ------------------------------------------------------------------ pooling
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 return_mask=False, data_format="NCDHW", name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, ceil_mode, return_mask,
+                     data_format)
+
+    def forward(self, x):
+        k, s, p, cm, rm, df = self.args
+        return F.max_pool3d(x, k, stride=s, padding=p, ceil_mode=cm,
+                            return_mask=rm, data_format=df)
+
+
+class AvgPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format="NCDHW",
+                 name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, ceil_mode, exclusive,
+                     divisor_override, data_format)
+
+    def forward(self, x):
+        k, s, p, cm, ex, dv, df = self.args
+        return F.avg_pool3d(x, k, stride=s, padding=p, ceil_mode=cm,
+                            exclusive=ex, divisor_override=dv, data_format=df)
+
+
+class AdaptiveAvgPool3D(Layer):
+    def __init__(self, output_size, data_format="NCDHW", name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, self.output_size,
+                                     data_format=self.data_format)
+
+
+class AdaptiveMaxPool1D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.adaptive_max_pool1d(x, self.output_size,
+                                     return_mask=self.return_mask)
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, self.output_size,
+                                     return_mask=self.return_mask)
+
+
+class LPPool1D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL", name=None):
+        super().__init__()
+        self.args = (norm_type, kernel_size, stride, padding, ceil_mode,
+                     data_format)
+
+    def forward(self, x):
+        nt, k, s, p, cm, df = self.args
+        return F.lp_pool1d(x, nt, k, stride=s, padding=p, ceil_mode=cm,
+                           data_format=df)
+
+
+class LPPool2D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__()
+        self.args = (norm_type, kernel_size, stride, padding, ceil_mode,
+                     data_format)
+
+    def forward(self, x):
+        nt, k, s, p, cm, df = self.args
+        return F.lp_pool2d(x, nt, k, stride=s, padding=p, ceil_mode=cm,
+                           data_format=df)
+
+
+class FractionalMaxPool2D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.args = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        o, k, u, rm = self.args
+        return F.fractional_max_pool2d(x, o, kernel_size=k, random_u=u,
+                                       return_mask=rm)
+
+
+class FractionalMaxPool3D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.args = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        o, k, u, rm = self.args
+        return F.fractional_max_pool3d(x, o, kernel_size=k, random_u=u,
+                                       return_mask=rm)
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, o = self.args
+        return F.max_unpool1d(x, indices, k, stride=s, padding=p,
+                              data_format=df, output_size=o)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, o = self.args
+        return F.max_unpool2d(x, indices, k, stride=s, padding=p,
+                              data_format=df, output_size=o)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, o = self.args
+        return F.max_unpool3d(x, indices, k, stride=s, padding=p,
+                              data_format=df, output_size=o)
+
+
+# ---------------------------------------------------------- transposed conv
+
+class _ConvTransposeNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, nd, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__()
+        from .functional import _pair
+        k = _pair(kernel_size, nd)
+        fan_in = in_channels * int(np.prod(k))
+        bound = 1.0 / math.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            (in_channels, out_channels // groups) + k, attr=weight_attr,
+            default_initializer=Uniform(-bound, bound))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                (out_channels,), attr=bias_attr, is_bias=True,
+                default_initializer=Uniform(-bound, bound))
+        self.args = (stride, padding, output_padding, groups, dilation,
+                     data_format)
+
+
+class Conv1DTranspose(_ConvTransposeNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, 1, stride,
+                         padding, output_padding, groups, dilation,
+                         weight_attr, bias_attr, data_format)
+
+    def forward(self, x, output_size=None):
+        s, p, op_, g, d, df = self.args
+        return F.conv1d_transpose(x, self.weight, self.bias, stride=s,
+                                  padding=p, output_padding=op_, groups=g,
+                                  dilation=d, output_size=output_size,
+                                  data_format=df)
+
+
+class Conv3DTranspose(_ConvTransposeNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride,
+                         padding, output_padding, groups, dilation,
+                         weight_attr, bias_attr, data_format)
+
+    def forward(self, x, output_size=None):
+        s, p, op_, g, d, df = self.args
+        return F.conv3d_transpose(x, self.weight, self.bias, stride=s,
+                                  padding=p, output_padding=op_, groups=g,
+                                  dilation=d, output_size=output_size,
+                                  data_format=df)
+
+
+# ------------------------------------------------------------ small layers
+
+class Dropout3D(Layer):
+    def __init__(self, p=0.5, data_format="NCDHW", name=None):
+        super().__init__()
+        self.p = p
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.dropout3d(x, p=self.p, training=self.training,
+                           data_format=self.data_format)
+
+
+class FeatureAlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, p=self.p, training=self.training)
+
+
+class LogSigmoid(Layer):
+    def forward(self, x):
+        return F.log_sigmoid(x)
+
+
+class ThresholdedReLU(Layer):
+    def __init__(self, threshold=1.0, value=0.0, name=None):
+        super().__init__()
+        self.threshold = threshold
+        self.value = value
+
+    def forward(self, x):
+        return F.thresholded_relu(x, self.threshold, self.value)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis = axis
+        self.shape = shape
+
+    def forward(self, x):
+        return F.unflatten(x, self.axis, self.shape)
+
+
+class ZeroPad1D(Layer):
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__()
+        from .layer_common import Pad1D
+        self._pad = Pad1D(padding, mode="constant", value=0.0,
+                          data_format=data_format)
+
+    def forward(self, x):
+        return self._pad(x)
+
+
+class ZeroPad3D(Layer):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__()
+        from .layer_common import Pad3D
+        self._pad = Pad3D(padding, mode="constant", value=0.0,
+                          data_format=data_format)
+
+    def forward(self, x):
+        return self._pad(x)
+
+
+# -------------------------------------------------------------------- losses
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean", name=None):
+        super().__init__()
+        self.full, self.epsilon, self.reduction = full, epsilon, reduction
+
+    def forward(self, input, label, variance):
+        return F.gaussian_nll_loss(input, label, variance, full=self.full,
+                                   epsilon=self.epsilon,
+                                   reduction=self.reduction)
+
+
+class PoissonNLLLoss(Layer):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.args = (log_input, full, epsilon, reduction)
+
+    def forward(self, input, label):
+        li, fu, ep, red = self.args
+        return F.poisson_nll_loss(input, label, log_input=li, full=fu,
+                                  epsilon=ep, reduction=red)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.args = (p, margin, weight, reduction)
+
+    def forward(self, input, label):
+        p, m, w, red = self.args
+        return F.multi_margin_loss(input, label, p=p, margin=m, weight=w,
+                                   reduction=red)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        return F.multi_label_soft_margin_loss(
+            input, label, weight=self.weight, reduction=self.reduction)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.soft_margin_loss(input, label, reduction=self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.args = (distance_function, margin, swap, reduction)
+
+    def forward(self, input, positive, negative):
+        df, m, sw, red = self.args
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative, distance_function=df, margin=m,
+            swap=sw, reduction=red)
+
+
+class CTCLoss(Layer):
+    def __init__(self, blank=0, reduction="mean", name=None):
+        super().__init__()
+        self.blank, self.reduction = blank, reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          blank=self.blank, reduction=self.reduction,
+                          norm_by_times=norm_by_times)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           blank=self.blank,
+                           fastemit_lambda=self.fastemit_lambda,
+                           reduction=self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        if num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        bound = 1.0 / math.sqrt(feature_size)
+        self.weight = self.create_parameter(
+            (num_classes - 1, feature_size), attr=weight_attr,
+            default_initializer=Uniform(-bound, bound))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                (num_classes - 1, 1), attr=bias_attr, is_bias=True,
+                default_initializer=Uniform(-bound, bound))
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               bias=self.bias, path_table=path_table,
+                               path_code=path_code)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """Efficient softmax approximation (reference
+    python/paddle/nn/layer/loss.py AdaptiveLogSoftmaxWithLoss): frequent
+    classes in a head cluster, rare classes in down-projected tail clusters."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        cutoffs = list(cutoffs)
+        if not cutoffs or cutoffs != sorted(set(cutoffs)) \
+                or cutoffs[-1] > n_classes - 1 or min(cutoffs) <= 0:
+            raise ValueError(
+                "cutoffs should be a sorted list of unique positive ints "
+                "< n_classes-1")
+        self.in_features = in_features
+        self.n_classes = n_classes
+        self.cutoffs = cutoffs + [n_classes]
+        self.div_value = div_value
+        self.n_clusters = len(self.cutoffs) - 1
+        self.head_size = self.cutoffs[0] + self.n_clusters
+        bound = 1.0 / math.sqrt(in_features)
+        self.head_weight = self.create_parameter(
+            (in_features, self.head_size),
+            default_initializer=Uniform(-bound, bound))
+        self.head_bias = self.create_parameter(
+            (self.head_size,), is_bias=True,
+            default_initializer=Uniform(-bound, bound)) \
+            if head_bias else None
+        self._tail_w1 = []
+        self._tail_w2 = []
+        for i in range(self.n_clusters):
+            hsz = max(int(in_features // (div_value ** (i + 1))), 1)
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            w1 = self.create_parameter(
+                (in_features, hsz),
+                default_initializer=Uniform(-bound, bound))
+            w2 = self.create_parameter(
+                (hsz, osz),
+                default_initializer=Uniform(-bound, bound))
+            self.add_parameter(f"tail_w1_{i}", w1)
+            self.add_parameter(f"tail_w2_{i}", w2)
+            self._tail_w1.append(w1)
+            self._tail_w2.append(w2)
+
+    def _head_log_prob(self, input):
+        head = F.linear(input, self.head_weight, self.head_bias)
+        return F.log_softmax(head, axis=-1)
+
+    def forward(self, input, label):
+        head_lp = self._head_log_prob(input)          # [N, head_size]
+        shortlist = self.cutoffs[0]
+        lab = label.astype("int32")
+        # head (frequent) classes: gather at min(label, shortlist-1); masked
+        in_head = (lab < shortlist).astype(head_lp.dtype)
+        safe_head = lab.clip(0, shortlist - 1)
+        head_take = head_lp.take_along_axis(
+            safe_head.reshape((-1, 1)), 1).reshape((-1,))
+        out = head_take * in_head
+        # tail clusters: log p = head log p of cluster + in-cluster log p
+        for i in range(self.n_clusters):
+            lo, hi = self.cutoffs[i], self.cutoffs[i + 1]
+            mask = ((lab >= lo).astype(head_lp.dtype)
+                    * (lab < hi).astype(head_lp.dtype))
+            rel = (lab - lo).clip(0, hi - lo - 1)
+            h = input.matmul(self._tail_w1[i]).matmul(self._tail_w2[i])
+            tail_lp = F.log_softmax(h, axis=-1)
+            cluster_lp = head_lp[:, shortlist + i]
+            take = tail_lp.take_along_axis(
+                rel.reshape((-1, 1)), 1).reshape((-1,))
+            out = out + (cluster_lp + take) * mask
+        loss = -(out.mean())
+        return out, loss
+
+    def log_prob(self, input):
+        import paddle_tpu
+        head_lp = self._head_log_prob(input)
+        shortlist = self.cutoffs[0]
+        pieces = [head_lp[:, :shortlist]]
+        for i in range(self.n_clusters):
+            h = input.matmul(self._tail_w1[i]).matmul(self._tail_w2[i])
+            tail_lp = F.log_softmax(h, axis=-1)
+            pieces.append(tail_lp + head_lp[:, shortlist + i].reshape((-1, 1)))
+        return paddle_tpu.concat(pieces, axis=1)
+
+    def predict(self, input):
+        return self.log_prob(input).argmax(axis=-1)
+
+
+class ParameterDict(Layer):
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters is not None:
+            self.update(parameters)
+
+    def update(self, parameters):
+        items = parameters.items() if hasattr(parameters, "items") \
+            else parameters
+        for k, v in items:
+            self.add_parameter(str(k), v)
+        return self
+
+    def __getitem__(self, key):
+        return self._parameters[key]
+
+    def __setitem__(self, key, value):
+        self.add_parameter(str(key), value)
+
+    def __contains__(self, key):
+        return key in self._parameters
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters)
+
+    def keys(self):
+        return self._parameters.keys()
+
+    def values(self):
+        return self._parameters.values()
+
+    def items(self):
+        return self._parameters.items()

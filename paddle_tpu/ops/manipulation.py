@@ -431,8 +431,10 @@ def cast(x, dtype, name=None):
 @op
 def pad(x, pad, mode="constant", value=0.0, data_format="NCHW",
         pad_from_left_axis=True, name=None):
-    pad = _static_ints(pad)
     nd = x.ndim
+    if isinstance(pad, int):  # pad every spatial dim on both sides
+        pad = [pad] * (2 * max(nd - 2, 1))
+    pad = _static_ints(pad)
     if len(pad) == 2 * nd:
         # paddle layout: [before_0, after_0, before_1, after_1, ...]? No —
         # paddle uses per-axis pairs from the *last* axes when len==2*spatial;

@@ -1,0 +1,254 @@
+"""Layer-surface part 2 tests: 3D pools, unpool, transposed convs, extra
+losses (CTC verified against torch's reference implementation)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+rng = np.random.RandomState(3)
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestPool3D:
+    def test_max_avg(self):
+        x = t(rng.randn(2, 3, 4, 8, 8).astype(np.float32))
+        assert nn.MaxPool3D(2)(x).shape == [2, 3, 2, 4, 4]
+        assert nn.AvgPool3D(2, stride=2)(x).shape == [2, 3, 2, 4, 4]
+        ref = x.numpy()[:, :, :2, :2, :2].reshape(2, 3, 1, 1, 1, -1)
+        np.testing.assert_allclose(
+            nn.MaxPool3D(2)(x).numpy()[:, :, 0, 0, 0],
+            x.numpy()[:, :, :2, :2, :2].max((2, 3, 4)), rtol=1e-6)
+
+    def test_adaptive(self):
+        x = t(rng.randn(2, 3, 6, 9, 12).astype(np.float32))
+        assert nn.AdaptiveAvgPool3D((2, 3, 4))(x).shape == [2, 3, 2, 3, 4]
+        assert nn.AdaptiveMaxPool3D(2)(x).shape == [2, 3, 2, 2, 2]
+        x1 = t(rng.randn(2, 3, 9).astype(np.float32))
+        out = nn.AdaptiveMaxPool1D(3)(x1)
+        np.testing.assert_allclose(
+            out.numpy(), x1.numpy().reshape(2, 3, 3, 3).max(-1), rtol=1e-6)
+
+    def test_lp_pool(self):
+        x = t(np.abs(rng.randn(1, 1, 4)).astype(np.float32))
+        out = nn.LPPool1D(2.0, 2, stride=2)(x)
+        expect = np.sqrt((x.numpy() ** 2).reshape(1, 1, 2, 2).sum(-1))
+        np.testing.assert_allclose(out.numpy(), expect, rtol=1e-5)
+        x2 = t(rng.randn(2, 3, 8, 8).astype(np.float32))
+        assert nn.LPPool2D(3.0, 2)(x2).shape == [2, 3, 4, 4]
+
+    def test_fractional(self):
+        x = t(rng.randn(2, 3, 9, 9).astype(np.float32))
+        out = nn.FractionalMaxPool2D(5, random_u=0.3)(x)
+        assert out.shape == [2, 3, 5, 5]
+        x3 = t(rng.randn(1, 2, 6, 6, 6).astype(np.float32))
+        assert nn.FractionalMaxPool3D(3, random_u=0.7)(x3).shape == \
+            [1, 2, 3, 3, 3]
+
+    def test_unpool_roundtrip(self):
+        x = t(rng.randn(2, 3, 8, 8).astype(np.float32))
+        out, idx = F.max_pool2d(x, 2, return_mask=True)
+        un = nn.MaxUnPool2D(2)(out, idx)
+        assert un.shape == [2, 3, 8, 8]
+        # every pooled max lands back at its argmax position
+        xn, on, idxn, unn = (a.numpy() for a in (x, out, idx, un))
+        nz = unn != 0
+        np.testing.assert_allclose(np.sort(unn[nz]), np.sort(on.ravel()))
+        # 1d and 3d shape paths
+        x1 = t(rng.randn(2, 3, 8).astype(np.float32))
+        o1, i1 = F.max_pool1d(x1, 2, return_mask=True)
+        assert nn.MaxUnPool1D(2)(o1, i1).shape == [2, 3, 8]
+        x3 = t(rng.randn(1, 2, 4, 4, 4).astype(np.float32))
+        o3, i3 = F.max_pool3d(x3, 2, return_mask=True)
+        assert nn.MaxUnPool3D(2)(o3, i3).shape == [1, 2, 4, 4, 4]
+
+
+class TestConvTranspose:
+    def test_conv1d_transpose_matches_torch(self):
+        import torch
+        x = rng.randn(2, 3, 8).astype(np.float32)
+        w = rng.randn(3, 4, 5).astype(np.float32)
+        ours = F.conv1d_transpose(t(x), t(w), stride=2, padding=1).numpy()
+        ref = torch.conv_transpose1d(torch.tensor(x), torch.tensor(w),
+                                     stride=2, padding=1).numpy()
+        np.testing.assert_allclose(ours, ref, atol=1e-4)
+
+    def test_conv3d_transpose_matches_torch(self):
+        import torch
+        x = rng.randn(1, 3, 4, 4, 4).astype(np.float32)
+        w = rng.randn(3, 2, 3, 3, 3).astype(np.float32)
+        ours = F.conv3d_transpose(t(x), t(w), stride=2).numpy()
+        ref = torch.conv_transpose3d(torch.tensor(x), torch.tensor(w),
+                                     stride=2).numpy()
+        np.testing.assert_allclose(ours, ref, atol=1e-4)
+
+    def test_layers(self):
+        x = t(rng.randn(2, 3, 8).astype(np.float32))
+        layer = nn.Conv1DTranspose(3, 4, 3, stride=2)
+        assert layer(x).shape == [2, 4, 17]
+        x3 = t(rng.randn(1, 3, 4, 4, 4).astype(np.float32))
+        layer3 = nn.Conv3DTranspose(3, 2, 3, stride=2, bias_attr=False)
+        assert layer3(x3).shape == [1, 2, 9, 9, 9]
+
+
+class TestExtraLosses:
+    def test_ctc_matches_torch(self):
+        import torch
+        T_, N, C, L = 10, 2, 5, 3
+        logits = rng.randn(T_, N, C).astype(np.float32)
+        labels = rng.randint(1, C, (N, L)).astype(np.int64)
+        ilen = np.array([10, 7], np.int64)
+        llen = np.array([3, 2], np.int64)
+        ours = F.ctc_loss(t(logits), t(labels), t(ilen), t(llen),
+                          reduction="sum").numpy()
+        ref = torch.nn.functional.ctc_loss(
+            torch.log_softmax(torch.tensor(logits), -1),
+            torch.tensor(labels), torch.tensor(ilen), torch.tensor(llen),
+            blank=0, reduction="sum").numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4)
+
+    def test_ctc_layer_grad(self):
+        T_, N, C, L = 6, 2, 4, 2
+        x = t(rng.randn(T_, N, C).astype(np.float32))
+        x.stop_gradient = False
+        loss = nn.CTCLoss()(x, t(rng.randint(1, C, (N, L)).astype(np.int64)),
+                            t(np.array([6, 6], np.int64)),
+                            t(np.array([2, 2], np.int64)))
+        loss.backward()
+        assert np.isfinite(x.grad.numpy()).all()
+
+    def test_rnnt_vs_bruteforce(self):
+        N, T_, U, C = 2, 4, 2, 4
+        logits = rng.randn(N, T_, U + 1, C).astype(np.float32)
+        labels = rng.randint(1, C, (N, U)).astype(np.int32)
+        tlen = np.array([4, 3], np.int32)
+        ulen = np.array([2, 1], np.int32)
+        lp = logits - np.log(
+            np.exp(logits - logits.max(-1, keepdims=True)).sum(
+                -1, keepdims=True)) - logits.max(-1, keepdims=True)
+
+        def brute(lpn, lab, T0, U0):
+            alpha = np.full((T0, U0 + 1), -np.inf)
+            alpha[0, 0] = 0.0
+            for u in range(1, U0 + 1):
+                alpha[0, u] = alpha[0, u - 1] + lpn[0, u - 1, lab[u - 1]]
+            for t0 in range(1, T0):
+                alpha[t0, 0] = alpha[t0 - 1, 0] + lpn[t0 - 1, 0, 0]
+                for u in range(1, U0 + 1):
+                    a = alpha[t0 - 1, u] + lpn[t0 - 1, u, 0]
+                    b = alpha[t0, u - 1] + lpn[t0, u - 1, lab[u - 1]]
+                    alpha[t0, u] = np.logaddexp(a, b)
+            return -(alpha[T0 - 1, U0] + lpn[T0 - 1, U0, 0])
+
+        expect = [brute(lp[i], labels[i], int(tlen[i]), int(ulen[i]))
+                  for i in range(N)]
+        ours = nn.RNNTLoss(reduction="none")(
+            t(logits), t(labels), t(tlen), t(ulen)).numpy()
+        np.testing.assert_allclose(ours, expect, rtol=1e-4)
+
+    def test_simple_losses(self):
+        x = t(rng.randn(4, 5).astype(np.float32))
+        y = t(rng.randn(4, 5).astype(np.float32))
+        var = t(np.abs(rng.randn(4, 5)).astype(np.float32) + 0.1)
+        assert np.isfinite(float(nn.GaussianNLLLoss()(x, y, var)))
+        lbl = t((rng.rand(4, 5) > 0.5).astype(np.float32))
+        assert np.isfinite(float(nn.MultiLabelSoftMarginLoss()(x, lbl)))
+        sgn = t(np.sign(rng.randn(4, 5)).astype(np.float32))
+        assert np.isfinite(float(nn.SoftMarginLoss()(x, sgn)))
+        assert np.isfinite(float(nn.PoissonNLLLoss()(
+            x, t(np.abs(rng.randn(4, 5)).astype(np.float32)))))
+        cls = t(rng.randint(0, 5, 4).astype(np.int64))
+        assert np.isfinite(float(nn.MultiMarginLoss()(x, cls)))
+        pos = t(rng.randn(4, 5).astype(np.float32))
+        neg = t(rng.randn(4, 5).astype(np.float32))
+        assert np.isfinite(float(nn.TripletMarginWithDistanceLoss()(
+            x, pos, neg)))
+
+    def test_poisson_nll_math(self):
+        x = np.array([[0.5, -0.2]], np.float32)
+        lab = np.array([[1.0, 2.0]], np.float32)
+        got = float(F.poisson_nll_loss(t(x), t(lab), reduction="sum"))
+        expect = float((np.exp(x) - lab * x).sum())
+        np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+    def test_hsigmoid(self):
+        m = nn.HSigmoidLoss(8, 6)
+        x = t(rng.randn(4, 8).astype(np.float32))
+        lbl = t(rng.randint(0, 6, (4,)).astype(np.int64))
+        loss = m(x, lbl)
+        assert loss.shape == [4, 1]
+        assert np.isfinite(loss.numpy()).all()
+        # gradient flows to the path weights
+        x.stop_gradient = False
+        m(x, lbl).sum().backward()
+        assert np.isfinite(x.grad.numpy()).all()
+
+    def test_adaptive_log_softmax(self):
+        m = nn.AdaptiveLogSoftmaxWithLoss(16, 20, cutoffs=[5, 10],
+                                          div_value=2.0)
+        x = t(rng.randn(8, 16).astype(np.float32))
+        lbl = t(rng.randint(0, 20, (8,)).astype(np.int64))
+        out, loss = m(x, lbl)
+        assert out.shape == [8]
+        assert np.isfinite(float(loss))
+        # full log-prob table normalizes to 1
+        lp = m.log_prob(x)
+        assert lp.shape == [8, 20]
+        np.testing.assert_allclose(np.exp(lp.numpy()).sum(-1),
+                                   np.ones(8), rtol=1e-4)
+        # out == log_prob gathered at the label
+        np.testing.assert_allclose(
+            out.numpy(),
+            np.take_along_axis(lp.numpy(), lbl.numpy()[:, None], 1)[:, 0],
+            rtol=1e-4)
+
+
+class TestSmallLayers:
+    def test_misc(self):
+        x = t(rng.randn(2, 6).astype(np.float32))
+        np.testing.assert_allclose(
+            nn.LogSigmoid()(x).numpy(),
+            np.log(1 / (1 + np.exp(-x.numpy()))), rtol=1e-5)
+        out = nn.ThresholdedReLU(1.0)(x)
+        xn = x.numpy()
+        np.testing.assert_allclose(out.numpy(), np.where(xn > 1.0, xn, 0.0))
+        assert nn.Unflatten(1, (2, 3))(x).shape == [2, 2, 3]
+
+    def test_dropout3d_feature_alpha(self):
+        x = t(np.ones((2, 3, 4, 4, 4), np.float32))
+        d = nn.Dropout3D(0.5)
+        d.train()
+        out = d(x).numpy()
+        # whole channels are either zero or scaled
+        per_chan = out.reshape(2, 3, -1)
+        for n in range(2):
+            for c in range(3):
+                vals = np.unique(per_chan[n, c])
+                assert len(vals) == 1 and (vals[0] == 0.0 or
+                                           abs(vals[0] - 2.0) < 1e-6)
+        d.eval()
+        np.testing.assert_allclose(d(x).numpy(), x.numpy())
+        f = nn.FeatureAlphaDropout(0.3)
+        f.train()
+        assert f(x).shape == x.shape
+
+    def test_zeropad(self):
+        x = t(rng.randn(1, 2, 4).astype(np.float32))
+        out = nn.ZeroPad1D([1, 2])(x)
+        assert out.shape == [1, 2, 7]
+        np.testing.assert_allclose(out.numpy()[:, :, 0], 0)
+        x3 = t(rng.randn(1, 2, 3, 3, 3).astype(np.float32))
+        assert nn.ZeroPad3D(1)(x3).shape == [1, 2, 5, 5, 5]
+
+    def test_parameter_dict(self):
+        pd = nn.ParameterDict({
+            "a": nn.Parameter(paddle.to_tensor(np.ones(3, np.float32)))})
+        pd["b"] = nn.Parameter(paddle.to_tensor(np.zeros(2, np.float32)))
+        assert "a" in pd and len(pd) == 2
+        assert set(pd.keys()) == {"a", "b"}
+        names = [n for n, _ in pd.named_parameters()]
+        assert len(names) == 2
