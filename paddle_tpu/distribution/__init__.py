@@ -2,4 +2,11 @@
 from .distributions import (  # noqa: F401
     Distribution, Normal, Uniform, Categorical, Bernoulli, Exponential,
     Beta, Dirichlet, Gamma, Laplace, LogNormal, Multinomial, Poisson,
-    Geometric, Cauchy, Gumbel, StudentT, kl_divergence)
+    Geometric, Cauchy, Gumbel, StudentT)
+from .distributions_extra import (  # noqa: F401
+    Binomial, Chi2, ContinuousBernoulli, ExponentialFamily, Independent,
+    MultivariateNormal, TransformedDistribution, LKJCholesky, register_kl,
+    kl_divergence,
+    Transform, AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
+    SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform)

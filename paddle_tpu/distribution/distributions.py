@@ -69,7 +69,9 @@ class Distribution:
         raise NotImplementedError
 
     def kl_divergence(self, other):
-        return kl_divergence(self, other)
+        # the registry-aware dispatcher (falls back to the pairs below)
+        from .distributions_extra import kl_divergence as _kl
+        return _kl(self, other)
 
 
 class Normal(Distribution):

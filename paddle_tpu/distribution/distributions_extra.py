@@ -1,0 +1,592 @@
+"""Distributions part 2 (reference: python/paddle/distribution/{binomial,
+chi2,continuous_bernoulli,multivariate_normal,independent,
+transformed_distribution,transform,lkj_cholesky,exponential_family,kl}.py):
+remaining families, the Transform machinery, and the register_kl registry.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.scipy.special import gammaln, xlogy, xlog1py
+
+from ..framework.tensor import Tensor
+from ..framework import random as _random
+from .distributions import (Distribution, Normal, Gamma, _arr, _t, _shape,
+                            kl_divergence as _base_kl)
+
+__all__ = [
+    "Binomial", "Chi2", "ContinuousBernoulli", "ExponentialFamily",
+    "Independent", "MultivariateNormal", "TransformedDistribution",
+    "LKJCholesky", "register_kl",
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+]
+
+
+# ----------------------------------------------------------------- families
+
+class ExponentialFamily(Distribution):
+    """Base carrying the Bregman-divergence entropy trick (reference
+    exponential_family.py _mean_carrier_measure contract)."""
+
+
+class Binomial(ExponentialFamily):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _arr(total_count)
+        self.probs = _arr(probs)
+        super().__init__(jnp.broadcast_shapes(np.shape(self.total_count),
+                                              np.shape(self.probs)))
+
+    @property
+    def mean(self):
+        return _t(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return _t(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        sh = _shape(shape, self.total_count, self.probs)
+        n = jnp.broadcast_to(self.total_count, sh).astype(jnp.float32)
+        p = jnp.broadcast_to(self.probs, sh)
+        out = jax.random.binomial(_random.split_key(), n, p, shape=sh)
+        return _t(out)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        n, p = self.total_count, self.probs
+        log_comb = (gammaln(n + 1) - gammaln(v + 1) - gammaln(n - v + 1))
+        return _t(log_comb + xlogy(v, p) + xlog1py(n - v, -p))
+
+    def entropy(self):
+        # sum over support (total_count is small-int use cases)
+        n = int(np.max(np.asarray(self.total_count)))
+        ks = jnp.arange(n + 1, dtype=jnp.float32)
+        shape = (n + 1,) + (1,) * max(len(self.batch_shape), 0)
+        ks = ks.reshape(shape)
+        lp = self.log_prob(_t(jnp.broadcast_to(
+            ks, (n + 1,) + tuple(self.batch_shape))))._data
+        valid = ks <= jnp.broadcast_to(self.total_count,
+                                       tuple(self.batch_shape))
+        lp = jnp.where(valid, lp, -jnp.inf)
+        p = jnp.exp(lp)
+        return _t(-jnp.sum(jnp.where(p > 0, p * lp, 0.0), axis=0))
+
+
+class Chi2(Gamma):
+    def __init__(self, df, name=None):
+        df = _arr(df)
+        super().__init__(df / 2.0, jnp.full(np.shape(df), 0.5))
+        self.df = df
+
+
+class ContinuousBernoulli(ExponentialFamily):
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = _arr(probs)
+        self._lims = lims
+        super().__init__(np.shape(self.probs))
+
+    def _outside_unstable(self):
+        lo, hi = self._lims
+        return (self.probs < lo) | (self.probs > hi)
+
+    def _log_norm(self):
+        # C(p) = 2 atanh(1-2p)/(1-2p) for p != 0.5, else 2
+        p = self.probs
+        safe = jnp.where(self._outside_unstable(), p, 0.499)
+        c = jnp.log(jnp.abs(
+            2.0 * jnp.arctanh(1.0 - 2.0 * safe) / (1.0 - 2.0 * safe)))
+        # Taylor around 1/2: log 2 + 4/3 x^2 + ... with x = p - 1/2
+        x = p - 0.5
+        taylor = math.log(2.0) + (4.0 / 3.0 + 104.0 / 45.0 * x * x) * x * x
+        return jnp.where(self._outside_unstable(), c, taylor)
+
+    @property
+    def mean(self):
+        p = self.probs
+        safe = jnp.where(self._outside_unstable(), p, 0.499)
+        m = safe / (2.0 * safe - 1.0) \
+            + 1.0 / (2.0 * jnp.arctanh(1.0 - 2.0 * safe))
+        x = p - 0.5
+        taylor = 0.5 + (1.0 / 3.0 + 16.0 / 45.0 * x * x) * x
+        return _t(jnp.where(self._outside_unstable(), m, taylor))
+
+    def sample(self, shape=()):
+        sh = _shape(shape, self.probs)
+        u = jax.random.uniform(_random.split_key(), sh)
+        return self.icdf(_t(u))
+
+    rsample = sample
+
+    def icdf(self, value):
+        u = _arr(value)
+        p = self.probs
+        safe = jnp.where(self._outside_unstable(), p, 0.49)
+        icdf = (jnp.log1p(u * (2.0 * safe - 1.0) / (1.0 - safe))
+                / (jnp.log(safe) - jnp.log1p(-safe)))
+        return _t(jnp.where(self._outside_unstable(), icdf, u))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _t(xlogy(v, self.probs) + xlog1py(1.0 - v, -self.probs)
+                  + self._log_norm())
+
+    def entropy(self):
+        # E[-log p(X)] with the closed-form mean
+        m = self.mean._data
+        return _t(-(xlogy(m, self.probs)
+                    + xlog1py(1.0 - m, -self.probs) + self._log_norm()))
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = _arr(loc)
+        given = [a is not None for a in
+                 (covariance_matrix, precision_matrix, scale_tril)]
+        if sum(given) != 1:
+            raise ValueError("exactly one of covariance_matrix / "
+                             "precision_matrix / scale_tril is required")
+        if scale_tril is not None:
+            self._tril = _arr(scale_tril)
+        elif covariance_matrix is not None:
+            self._tril = jnp.linalg.cholesky(_arr(covariance_matrix))
+        else:
+            prec = _arr(precision_matrix)
+            self._tril = jnp.linalg.cholesky(jnp.linalg.inv(prec))
+        d = self.loc.shape[-1]
+        super().__init__(np.shape(self.loc)[:-1], (d,))
+
+    @property
+    def mean(self):
+        return _t(self.loc)
+
+    @property
+    def covariance_matrix(self):
+        return _t(self._tril @ jnp.swapaxes(self._tril, -1, -2))
+
+    @property
+    def variance(self):
+        return _t(jnp.sum(jnp.square(self._tril), axis=-1))
+
+    def sample(self, shape=()):
+        sh = tuple(shape) + tuple(self.batch_shape) + tuple(self.event_shape)
+        eps = jax.random.normal(_random.split_key(), sh)
+        return _t(self.loc + jnp.einsum("...ij,...j->...i", self._tril, eps))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _arr(value)
+        diff = v - self.loc
+        L = jnp.broadcast_to(self._tril,
+                             diff.shape[:-1] + self._tril.shape[-2:])
+        sol = jax.scipy.linalg.solve_triangular(
+            L, diff[..., None], lower=True)[..., 0]
+        m = jnp.sum(jnp.square(sol), axis=-1)
+        half_logdet = jnp.sum(jnp.log(jnp.diagonal(
+            self._tril, axis1=-2, axis2=-1)), axis=-1)
+        d = self.event_shape[0]
+        return _t(-0.5 * (d * math.log(2 * math.pi) + m) - half_logdet)
+
+    def entropy(self):
+        d = self.event_shape[0]
+        half_logdet = jnp.sum(jnp.log(jnp.diagonal(
+            self._tril, axis1=-2, axis2=-1)), axis=-1)
+        return _t(0.5 * d * (1 + math.log(2 * math.pi)) + half_logdet)
+
+
+class Independent(Distribution):
+    """Reinterprets trailing batch dims of ``base`` as event dims
+    (reference independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self._rank = int(reinterpreted_batch_rank)
+        if self._rank > len(base.batch_shape):
+            raise ValueError("reinterpreted_batch_rank exceeds batch rank")
+        b = tuple(base.batch_shape)
+        cut = len(b) - self._rank
+        super().__init__(b[:cut], b[cut:] + tuple(base.event_shape))
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)._data
+        return _t(jnp.sum(lp, axis=tuple(range(lp.ndim - self._rank,
+                                               lp.ndim))))
+
+    def entropy(self):
+        e = self.base.entropy()._data
+        return _t(jnp.sum(e, axis=tuple(range(e.ndim - self._rank, e.ndim))))
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+
+class LKJCholesky(Distribution):
+    """LKJ prior over Cholesky factors of correlation matrices (reference
+    lkj_cholesky.py, onion-method sampling)."""
+
+    def __init__(self, dim, concentration=1.0, sample_method="onion",
+                 name=None):
+        if dim < 2:
+            raise ValueError("dim must be >= 2")
+        self.dim = int(dim)
+        self.concentration = _arr(concentration)
+        self.sample_method = sample_method
+        super().__init__(np.shape(self.concentration), (dim, dim))
+
+    def sample(self, shape=()):
+        d = self.dim
+        eta = jnp.broadcast_to(self.concentration,
+                               _shape(shape, self.concentration))
+        # onion method: build rows from beta marginals + uniform directions
+        sh = tuple(np.shape(eta))
+        L = jnp.zeros(sh + (d, d))
+        L = L.at[..., 0, 0].set(1.0)
+        for i in range(1, d):
+            beta_c = eta + (d - 1 - i) / 2.0
+            y = jax.random.beta(_random.split_key(), i / 2.0, beta_c, sh)
+            u = jax.random.normal(_random.split_key(), sh + (i,))
+            u = u / jnp.linalg.norm(u, axis=-1, keepdims=True)
+            w = jnp.sqrt(y)[..., None] * u
+            L = L.at[..., i, :i].set(w)
+            L = L.at[..., i, i].set(jnp.sqrt(jnp.clip(1.0 - y, 1e-38)))
+        return _t(L)
+
+    def log_prob(self, value):
+        L = _arr(value)
+        d = self.dim
+        eta = self.concentration
+        order = jnp.arange(2, d + 1, dtype=jnp.float32)
+        exponents = 2.0 * (eta - 1.0) + d - order
+        diags = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+        unnorm = jnp.sum(exponents * jnp.log(diags), axis=-1)
+        # normalization (reference lkj_cholesky.py log_normalizer)
+        dm1 = d - 1
+        alpha = eta + 0.5 * dm1
+        denom = gammaln(alpha) * dm1
+        numer = _mvlgamma(alpha - 0.5, dm1)
+        pi_const = 0.5 * dm1 * math.log(math.pi)
+        norm = pi_const + numer - denom
+        return _t(unnorm - norm)
+
+
+def _mvlgamma(a, p):
+    out = 0.25 * p * (p - 1) * math.log(math.pi)
+    for j in range(p):
+        out = out + gammaln(a - 0.5 * j)
+    return out
+
+
+# ---------------------------------------------------------------- transforms
+
+class Transform:
+    _type = "bijection"
+
+    def forward(self, x):
+        return _t(self._forward(_arr(x)))
+
+    def inverse(self, y):
+        return _t(self._inverse(_arr(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return _t(self._fldj(_arr(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        yv = _arr(y)
+        return _t(-self._fldj(self._inverse(yv)))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AbsTransform(Transform):
+    _type = "other"
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # principal branch
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _arr(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _fldj(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _fldj(self, x):
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    _type = "other"
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+
+class StickBreakingTransform(Transform):
+    def _forward(self, x):
+        offset = x.shape[-1] + 1 - jnp.arange(1, x.shape[-1] + 1)
+        z = jax.nn.sigmoid(x - jnp.log(offset.astype(x.dtype)))
+        zc = jnp.cumprod(1 - z, axis=-1)
+        lead = z * jnp.concatenate(
+            [jnp.ones_like(z[..., :1]), zc[..., :-1]], axis=-1)
+        return jnp.concatenate([lead, zc[..., -1:]], axis=-1)
+
+    def _inverse(self, y):
+        y_crop = y[..., :-1]
+        offset = y.shape[-1] - jnp.arange(1, y.shape[-1])
+        rem = 1.0 - jnp.cumsum(y_crop, axis=-1)
+        rem = jnp.concatenate([jnp.ones_like(rem[..., :1]), rem[..., :-1]],
+                              axis=-1)
+        z = y_crop / rem
+        return jnp.log(z) - jnp.log1p(-z) + jnp.log(offset.astype(y.dtype))
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        if int(np.prod(self.in_event_shape)) != \
+                int(np.prod(self.out_event_shape)):
+            raise ValueError("shapes must have the same number of elements")
+
+    def _forward(self, x):
+        lead = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.reshape(x, lead + self.out_event_shape)
+
+    def _inverse(self, y):
+        lead = y.shape[:y.ndim - len(self.out_event_shape)]
+        return jnp.reshape(y, lead + self.in_event_shape)
+
+    def _fldj(self, x):
+        lead = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(lead)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _fldj(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._fldj(x)
+            x = t._forward(x)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+
+class IndependentTransform(Transform):
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self._rank = int(reinterpreted_batch_rank)
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _fldj(self, x):
+        ld = self.base._fldj(x)
+        return jnp.sum(ld, axis=tuple(range(ld.ndim - self._rank, ld.ndim)))
+
+
+class StackTransform(Transform):
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _forward(self, x):
+        parts = [t._forward(xi) for t, xi in zip(
+            self.transforms,
+            jnp.moveaxis(x, self.axis, 0))]
+        return jnp.stack(parts, axis=self.axis)
+
+    def _inverse(self, y):
+        parts = [t._inverse(yi) for t, yi in zip(
+            self.transforms,
+            jnp.moveaxis(y, self.axis, 0))]
+        return jnp.stack(parts, axis=self.axis)
+
+    def _fldj(self, x):
+        parts = [t._fldj(xi) for t, xi in zip(
+            self.transforms, jnp.moveaxis(x, self.axis, 0))]
+        return jnp.stack(parts, axis=self.axis)
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = list(transforms)
+        event = tuple(base.event_shape)
+        for t in self.transforms:
+            event = t.forward_shape(event)
+        super().__init__(tuple(base.batch_shape), event)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        lp = 0.0
+        y = _arr(value)
+        for t in reversed(self.transforms):
+            x = t._inverse(y)
+            lp = lp - t._fldj(x)
+            y = x
+        return _t(lp + self.base.log_prob(_t(y))._data)
+
+
+# --------------------------------------------------------------- KL registry
+
+_KL_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    """Decorator registering an analytic KL(p||q) (reference kl.py
+    register_kl)."""
+    def deco(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p, q):
+    for (cp, cq), fn in _KL_REGISTRY.items():
+        if isinstance(p, cp) and isinstance(q, cq):
+            return fn(p, q)
+    return _base_kl(p, q)
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn(p, q):
+    d = p.event_shape[0]
+    half_logdet_p = jnp.sum(jnp.log(jnp.diagonal(
+        p._tril, axis1=-2, axis2=-1)), axis=-1)
+    half_logdet_q = jnp.sum(jnp.log(jnp.diagonal(
+        q._tril, axis1=-2, axis2=-1)), axis=-1)
+    M = jax.scipy.linalg.solve_triangular(q._tril, p._tril, lower=True)
+    tr = jnp.sum(jnp.square(M), axis=(-2, -1))
+    diff = q.loc - p.loc
+    sol = jax.scipy.linalg.solve_triangular(
+        q._tril, diff[..., None], lower=True)[..., 0]
+    m = jnp.sum(jnp.square(sol), axis=-1)
+    return _t(half_logdet_q - half_logdet_p + 0.5 * (tr + m - d))
+
+
+@register_kl(Independent, Independent)
+def _kl_independent(p, q):
+    if p._rank != q._rank:
+        raise NotImplementedError
+    kl = kl_divergence(p.base, q.base)._data
+    return _t(jnp.sum(kl, axis=tuple(range(kl.ndim - p._rank, kl.ndim))))

@@ -6,6 +6,9 @@ from .resnet import (  # noqa: F401
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .mobilenet import (  # noqa: F401
     MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2)
+from .mobilenetv3 import (  # noqa: F401
+    MobileNetV3Large, MobileNetV3Small, mobilenet_v3_large,
+    mobilenet_v3_small)
 from .alexnet import AlexNet, alexnet  # noqa: F401
 from .densenet import (  # noqa: F401
     DenseNet, densenet121, densenet161, densenet169, densenet201,

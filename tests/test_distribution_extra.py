@@ -1,0 +1,222 @@
+"""Distribution part-2 tests: numerics vs torch.distributions (CPU) and
+closed forms (reference test/distribution/test_distribution_*.py style)."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.distribution as D
+
+rng = np.random.RandomState(11)
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestBinomial:
+    def test_log_prob_matches_torch(self):
+        n = np.array([10.0, 10.0], np.float32)
+        p = np.array([0.3, 0.7], np.float32)
+        v = np.array([2.0, 8.0], np.float32)
+        ours = D.Binomial(t(n), t(p)).log_prob(t(v)).numpy()
+        ref = torch.distributions.Binomial(
+            torch.tensor(n), torch.tensor(p)).log_prob(
+                torch.tensor(v)).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+    def test_mean_var_sample(self):
+        d = D.Binomial(t(np.float32(20)), t(np.float32(0.4)))
+        np.testing.assert_allclose(float(d.mean), 8.0)
+        np.testing.assert_allclose(float(d.variance), 4.8, rtol=1e-6)
+        s = d.sample((500,)).numpy()
+        assert 0 <= s.min() and s.max() <= 20
+        assert abs(s.mean() - 8.0) < 1.0
+
+    def test_entropy_matches_torch(self):
+        n = np.float32(8)
+        p = np.float32(0.35)
+        ours = float(D.Binomial(t(n), t(p)).entropy())
+        ref = float(torch.distributions.Binomial(
+            torch.tensor(n), torch.tensor(p)).entropy())
+        np.testing.assert_allclose(ours, ref, rtol=1e-4)
+
+
+class TestChi2:
+    def test_log_prob_matches_torch(self):
+        df = np.array([3.0, 5.0], np.float32)
+        v = np.array([1.5, 4.0], np.float32)
+        ours = D.Chi2(t(df)).log_prob(t(v)).numpy()
+        ref = torch.distributions.Chi2(torch.tensor(df)).log_prob(
+            torch.tensor(v)).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+
+class TestContinuousBernoulli:
+    def test_log_prob_matches_torch(self):
+        p = np.array([0.2, 0.5, 0.9], np.float32)
+        v = np.array([0.1, 0.6, 0.8], np.float32)
+        ours = D.ContinuousBernoulli(t(p)).log_prob(t(v)).numpy()
+        ref = torch.distributions.ContinuousBernoulli(
+            torch.tensor(p)).log_prob(torch.tensor(v)).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+    def test_mean_matches_torch(self):
+        p = np.array([0.2, 0.5, 0.9], np.float32)
+        ours = D.ContinuousBernoulli(t(p)).mean.numpy()
+        ref = torch.distributions.ContinuousBernoulli(
+            torch.tensor(p)).mean.numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+    def test_sample_in_unit_interval(self):
+        s = D.ContinuousBernoulli(t(np.float32(0.3))).sample((200,)).numpy()
+        assert (0 <= s).all() and (s <= 1).all()
+
+
+class TestMultivariateNormal:
+    def _mats(self):
+        A = rng.randn(3, 3).astype(np.float32)
+        cov = A @ A.T + 3 * np.eye(3, dtype=np.float32)
+        loc = rng.randn(3).astype(np.float32)
+        return loc, cov
+
+    def test_log_prob_matches_torch(self):
+        loc, cov = self._mats()
+        v = rng.randn(5, 3).astype(np.float32)
+        ours = D.MultivariateNormal(
+            t(loc), covariance_matrix=t(cov)).log_prob(t(v)).numpy()
+        ref = torch.distributions.MultivariateNormal(
+            torch.tensor(loc),
+            covariance_matrix=torch.tensor(cov)).log_prob(
+                torch.tensor(v)).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4)
+
+    def test_entropy_and_kl_match_torch(self):
+        loc1, cov1 = self._mats()
+        loc2, cov2 = self._mats()
+        p = D.MultivariateNormal(t(loc1), covariance_matrix=t(cov1))
+        q = D.MultivariateNormal(t(loc2), covariance_matrix=t(cov2))
+        tp = torch.distributions.MultivariateNormal(
+            torch.tensor(loc1), covariance_matrix=torch.tensor(cov1))
+        tq = torch.distributions.MultivariateNormal(
+            torch.tensor(loc2), covariance_matrix=torch.tensor(cov2))
+        np.testing.assert_allclose(float(p.entropy()),
+                                   float(tp.entropy()), rtol=1e-4)
+        np.testing.assert_allclose(
+            float(D.kl_divergence(p, q)),
+            float(torch.distributions.kl_divergence(tp, tq)), rtol=1e-3)
+
+    def test_sample_stats(self):
+        loc, cov = self._mats()
+        d = D.MultivariateNormal(t(loc), covariance_matrix=t(cov))
+        s = d.sample((4000,)).numpy()
+        np.testing.assert_allclose(s.mean(0), loc, atol=0.3)
+        np.testing.assert_allclose(np.cov(s.T), cov, atol=0.8)
+
+
+class TestIndependent:
+    def test_log_prob_sums(self):
+        loc = rng.randn(4, 3).astype(np.float32)
+        scale = np.abs(rng.randn(4, 3)).astype(np.float32) + 0.5
+        base = D.Normal(t(loc), t(scale))
+        ind = D.Independent(base, 1)
+        v = rng.randn(4, 3).astype(np.float32)
+        np.testing.assert_allclose(
+            ind.log_prob(t(v)).numpy(),
+            base.log_prob(t(v)).numpy().sum(-1), rtol=1e-5)
+        assert ind.batch_shape == (4,) and ind.event_shape == (3,)
+
+    def test_kl(self):
+        p = D.Independent(D.Normal(t(np.zeros((2, 3), np.float32)),
+                                   t(np.ones((2, 3), np.float32))), 1)
+        q = D.Independent(D.Normal(t(np.ones((2, 3), np.float32)),
+                                   t(np.ones((2, 3), np.float32))), 1)
+        kl = D.kl_divergence(p, q).numpy()
+        np.testing.assert_allclose(kl, [1.5, 1.5], rtol=1e-5)
+
+
+class TestTransforms:
+    def test_exp_affine_roundtrip(self):
+        x = t(rng.randn(5).astype(np.float32))
+        for tr in [D.ExpTransform(), D.AffineTransform(1.0, 2.5),
+                   D.SigmoidTransform(), D.TanhTransform()]:
+            y = tr.forward(x)
+            back = tr.inverse(y)
+            np.testing.assert_allclose(back.numpy(), x.numpy(),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_log_det_jacobian(self):
+        x = np.array([0.3, -0.7], np.float32)
+        tr = D.ExpTransform()
+        np.testing.assert_allclose(
+            tr.forward_log_det_jacobian(t(x)).numpy(), x, rtol=1e-6)
+        aff = D.AffineTransform(0.0, 3.0)
+        np.testing.assert_allclose(
+            aff.forward_log_det_jacobian(t(x)).numpy(),
+            np.full(2, np.log(3.0), np.float32), rtol=1e-6)
+
+    def test_stickbreaking(self):
+        x = t(rng.randn(4).astype(np.float32))
+        tr = D.StickBreakingTransform()
+        y = tr.forward(x)
+        assert y.shape == [5]
+        np.testing.assert_allclose(y.numpy().sum(), 1.0, rtol=1e-5)
+        back = tr.inverse(y)
+        np.testing.assert_allclose(back.numpy(), x.numpy(), rtol=1e-3,
+                                   atol=1e-4)
+
+    def test_chain_and_reshape(self):
+        x = t(rng.randn(6).astype(np.float32))
+        chain = D.ChainTransform([D.AffineTransform(0.0, 2.0),
+                                  D.ExpTransform()])
+        y = chain.forward(x)
+        np.testing.assert_allclose(y.numpy(), np.exp(2 * x.numpy()),
+                                   rtol=1e-5)
+        rt = D.ReshapeTransform((6,), (2, 3))
+        assert rt.forward(x).shape == [2, 3]
+
+    def test_transformed_distribution_lognormal(self):
+        # Normal + ExpTransform == LogNormal
+        base = D.Normal(t(np.float32(0.2)), t(np.float32(0.5)))
+        td = D.TransformedDistribution(base, [D.ExpTransform()])
+        v = np.array([0.5, 1.5, 3.0], np.float32)
+        ref = torch.distributions.LogNormal(0.2, 0.5).log_prob(
+            torch.tensor(v)).numpy()
+        np.testing.assert_allclose(td.log_prob(t(v)).numpy(), ref, rtol=1e-4)
+        s = td.sample((100,)).numpy()
+        assert (s > 0).all()
+
+
+class TestLKJ:
+    def test_sample_is_cholesky_of_correlation(self):
+        d = D.LKJCholesky(4, 1.5)
+        L = d.sample().numpy()
+        C = L @ L.T
+        np.testing.assert_allclose(np.diag(C), np.ones(4), rtol=1e-5)
+        assert (np.abs(C) <= 1 + 1e-5).all()
+        # lower triangular
+        assert np.allclose(L[np.triu_indices(4, 1)], 0)
+
+    def test_log_prob_matches_torch(self):
+        L = torch.distributions.LKJCholesky(3, 2.0).sample()
+        ours = float(D.LKJCholesky(3, 2.0).log_prob(t(L.numpy())))
+        ref = float(torch.distributions.LKJCholesky(3, 2.0).log_prob(L))
+        np.testing.assert_allclose(ours, ref, rtol=1e-4)
+
+
+class TestRegisterKL:
+    def test_custom_registration(self):
+        class MyDist(D.Distribution):
+            pass
+
+        @D.register_kl(MyDist, MyDist)
+        def _kl(p, q):
+            return paddle.to_tensor(42.0)
+
+        assert float(D.kl_divergence(MyDist(), MyDist())) == 42.0
+
+    def test_fallback_still_works(self):
+        p = D.Normal(t(np.float32(0.0)), t(np.float32(1.0)))
+        q = D.Normal(t(np.float32(1.0)), t(np.float32(1.0)))
+        np.testing.assert_allclose(float(D.kl_divergence(p, q)), 0.5,
+                                   rtol=1e-6)

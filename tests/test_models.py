@@ -163,3 +163,19 @@ def test_grad_accum_matches_full_batch():
         np.testing.assert_allclose(np.asarray(a, np.float32),
                                    np.asarray(b, np.float32),
                                    atol=2e-6, rtol=1e-4)
+
+
+def test_mobilenet_v3():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.vision import models as M
+
+    x = paddle.to_tensor(
+        np.random.default_rng(1).standard_normal((2, 3, 64, 64))
+        .astype("float32"))
+    small = M.mobilenet_v3_small(num_classes=10)
+    out = small(x)
+    assert out.shape == [2, 10]
+    out.sum().backward()
+    large = M.mobilenet_v3_large(num_classes=10, scale=0.5)
+    assert large(x).shape == [2, 10]
