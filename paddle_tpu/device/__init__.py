@@ -41,6 +41,11 @@ class CUDAPlace(_Place):
     pass
 
 
+class CUDAPinnedPlace(_Place):
+    def __init__(self):
+        super().__init__(0)
+
+
 class XPUPlace(_Place):
     pass
 

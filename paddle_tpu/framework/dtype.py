@@ -80,10 +80,8 @@ float64 = DType("float64", np.float64)
 complex64 = DType("complex64", np.complex64)
 complex128 = DType("complex128", np.complex128)
 
-_ALIASES = {
-    "bool": bool_,
-    "float8_e4m3fn": None,  # populated lazily below if ml_dtypes has them
-}
+float8_e4m3fn = DType("float8_e4m3fn", _ml_dtypes.float8_e4m3fn)
+float8_e5m2 = DType("float8_e5m2", _ml_dtypes.float8_e5m2)
 
 _NP_TO_DTYPE = {d.np_dtype: d for d in DType._registry.values()}
 

@@ -9,7 +9,9 @@ from __future__ import annotations
 from .tensor import Tensor
 from .. import ops
 from ..ops import math as m, reduction as r, manipulation as mp, \
-    creation as c, linalg as lg, comparison as cmp, indexing as ix
+    creation as c, linalg as lg, comparison as cmp, indexing as ix, \
+    math_extra as mx
+from .random import split_key as _split_key
 
 # method name -> op callable taking (self, ...)
 _METHODS = dict(
@@ -83,6 +85,23 @@ _METHODS = dict(
     zeros_like=c.zeros_like, ones_like=c.ones_like, full_like=c.full_like,
     clone=c.clone, bernoulli=c.bernoulli, multinomial=c.multinomial,
     normal_=None, exponential_=None,  # filled below
+    # surface part 2 (ops/math_extra.py)
+    logaddexp=mx.logaddexp, copysign=mx.copysign, ldexp=mx.ldexp,
+    nextafter=mx.nextafter, signbit=mx.signbit, sinc=mx.sinc,
+    frexp=mx.frexp, gammaln=mx.gammaln, gammainc=mx.gammainc,
+    gammaincc=mx.gammaincc, multigammaln=mx.multigammaln, i0=m.i0,
+    i0e=mx.i0e, i1=mx.i1, i1e=mx.i1e, sgn=mx.sgn, isin=mx.isin,
+    take=mx.take, trapezoid=mx.trapezoid,
+    cumulative_trapezoid=mx.cumulative_trapezoid, vander=mx.vander,
+    renorm=mx.renorm, nanquantile=mx.nanquantile, floor_mod=mx.floor_mod,
+    reduce_as=mx.reduce_as, tensor_split=mx.tensor_split,
+    hsplit=mx.hsplit, vsplit=mx.vsplit, dsplit=mx.dsplit,
+    diagonal_scatter=mx.diagonal_scatter, select_scatter=mx.select_scatter,
+    slice_scatter=mx.slice_scatter, masked_scatter=mx.masked_scatter,
+    index_fill=mx.index_fill, reverse=mx.reverse, unflatten=mx.unflatten,
+    view_as=mx.view_as, as_complex=mx.as_complex, as_real=mx.as_real,
+    isneginf=mx.isneginf, isposinf=mx.isposinf, isreal=mx.isreal,
+    cdist=mx.cdist, polygamma=m.polygamma,
 )
 
 # in-place variants: run op then rebind handle
@@ -93,6 +112,17 @@ _INPLACE = [
     "tanh", "erfinv", "cast", "reshape", "squeeze", "unsqueeze", "flatten",
     "transpose", "tril", "triu", "lerp", "masked_fill", "scatter",
     "index_add", "index_put", "put_along_axis", "nan_to_num", "where",
+    # surface part 2
+    "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh", "asinh",
+    "acosh", "atanh", "erf", "expm1", "log2", "log10", "log1p", "digamma",
+    "lgamma", "gammaln", "gammainc", "gammaincc", "multigammaln",
+    "polygamma", "gcd", "lcm", "hypot", "ldexp", "copysign", "i0", "frac",
+    "cumsum", "cumprod", "logit", "sinc", "renorm", "index_fill",
+    "masked_scatter", "logical_and", "logical_or", "logical_xor",
+    "logical_not", "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "bitwise_left_shift", "bitwise_right_shift", "equal", "not_equal",
+    "greater_than", "greater_equal", "less_than", "less_equal", "mod",
+    "floor_mod", "t", "addmm",
 ]
 
 
@@ -147,6 +177,55 @@ def _patch():
     def exponential__(self, lam=1.0):
         return self._rebind_(c.exponential_(self, lam))
     Tensor.exponential_ = exponential__
+
+    def bernoulli_(self, p=0.5):
+        import jax
+        self._data = jax.random.bernoulli(
+            _split_key(), p, tuple(self.shape)).astype(self._data.dtype)
+        self._grad_node = None
+        self._out_index = None
+        return self
+    Tensor.bernoulli_ = bernoulli_
+
+    def cauchy_(self, loc=0, scale=1):
+        import jax, jax.numpy as jnp
+        u = jax.random.uniform(_split_key(), tuple(self.shape))
+        import math as _m
+        self._data = (loc + scale * jnp.tan(_m.pi * (u - 0.5))).astype(
+            self._data.dtype)
+        self._grad_node = None
+        self._out_index = None
+        return self
+    Tensor.cauchy_ = cauchy_
+
+    def geometric_(self, probs):
+        import jax, jax.numpy as jnp
+        u = jax.random.uniform(_split_key(), tuple(self.shape),
+                               minval=1e-7, maxval=1.0)
+        self._data = jnp.ceil(
+            jnp.log(u) / jnp.log1p(-probs)).astype(self._data.dtype)
+        self._grad_node = None
+        self._out_index = None
+        return self
+    Tensor.geometric_ = geometric_
+
+    def log_normal_(self, mean=1.0, std=2.0):
+        import jax, jax.numpy as jnp
+        eps = jax.random.normal(_split_key(), tuple(self.shape))
+        self._data = jnp.exp(mean + std * eps).astype(self._data.dtype)
+        self._grad_node = None
+        self._out_index = None
+        return self
+    Tensor.log_normal_ = log_normal_
+
+    def tolist(self):
+        import numpy as _np
+        return _np.asarray(self._data).tolist()
+    Tensor.tolist = tolist
+
+    Tensor.is_complex = mx.is_complex
+    Tensor.is_floating_point = mx.is_floating_point
+    Tensor.is_integer = mx.is_integer
 
     # ---------------- operator dunders ----------------
     Tensor.__add__ = lambda s, o: m.add(s, o)

@@ -1,0 +1,471 @@
+"""Tensor-API surface part 2 (reference: python/paddle/tensor/math.py,
+manipulation.py — the long tail of paddle.* functions: special functions,
+stack/split families, scatter variants, distances, dtype predicates).
+Pure jnp bodies registered as framework ops."""
+from __future__ import annotations
+
+import itertools
+import math as _math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.scipy import special as jsp
+
+from .registry import op
+from ..framework import random as _random
+
+__all__ = [
+    "logaddexp", "copysign", "ldexp", "nextafter", "signbit", "sinc",
+    "frexp", "gammaln", "gammainc", "gammaincc", "multigammaln", "i0e",
+    "i1", "i1e", "sgn", "isneginf", "isposinf", "isreal", "isin", "take",
+    "trapezoid", "cumulative_trapezoid", "vander", "renorm", "nanquantile",
+    "histogram_bin_edges", "floor_mod", "reduce_as", "add_n", "cdist",
+    "pdist", "hsplit", "vsplit", "dsplit", "tensor_split", "hstack",
+    "vstack", "dstack", "row_stack", "column_stack", "block_diag",
+    "cartesian_prod", "combinations", "diagonal_scatter", "select_scatter",
+    "slice_scatter", "masked_scatter", "index_fill", "reverse", "unflatten",
+    "view_as", "as_complex", "as_real", "rank", "broadcast_shape",
+    "shard_index", "log_normal", "binomial", "is_complex",
+    "is_floating_point", "is_integer",
+]
+
+
+# ------------------------------------------------------------ special/math
+
+@op
+def logaddexp(x, y, name=None):
+    return jnp.logaddexp(x, y)
+
+
+@op
+def copysign(x, y, name=None):
+    return jnp.copysign(x, y)
+
+
+@op
+def ldexp(x, y, name=None):
+    return (x * jnp.exp2(y.astype(jnp.float32))).astype(
+        jnp.result_type(x.dtype, jnp.float32)
+        if not jnp.issubdtype(x.dtype, jnp.floating) else x.dtype)
+
+
+@op
+def nextafter(x, y, name=None):
+    return jnp.nextafter(x, y)
+
+
+@op
+def signbit(x, name=None):
+    return jnp.signbit(x)
+
+
+@op
+def sinc(x, name=None):
+    return jnp.sinc(x)
+
+
+@op
+def frexp(x, name=None):
+    m, e = jnp.frexp(x)
+    return m, e.astype(x.dtype)
+
+
+@op
+def gammaln(x, name=None):
+    return jsp.gammaln(x)
+
+
+@op
+def gammainc(x, y, name=None):
+    return jsp.gammainc(x, y)
+
+
+@op
+def gammaincc(x, y, name=None):
+    return jsp.gammaincc(x, y)
+
+
+@op
+def multigammaln(x, p, name=None):
+    out = 0.25 * p * (p - 1) * _math.log(_math.pi)
+    for j in range(int(p)):
+        out = out + jsp.gammaln(x - 0.5 * j)
+    return out
+
+
+@op
+def i0e(x, name=None):
+    return jsp.i0e(x)
+
+
+@op
+def i1(x, name=None):
+    return jsp.i1(x)
+
+
+@op
+def i1e(x, name=None):
+    return jsp.i1e(x)
+
+
+@op
+def sgn(x, name=None):
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        mag = jnp.abs(x)
+        return jnp.where(mag == 0, jnp.zeros((), x.dtype), x / (mag + 1e-38))
+    return jnp.sign(x)
+
+
+@op
+def isneginf(x, name=None):
+    return jnp.isneginf(x)
+
+
+@op
+def isposinf(x, name=None):
+    return jnp.isposinf(x)
+
+
+@op
+def isreal(x, name=None):
+    return jnp.isreal(x)
+
+
+@op
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return jnp.isin(x, test_x, assume_unique=assume_unique, invert=invert)
+
+
+@op
+def take(x, index, mode="raise", name=None):
+    flat = jnp.reshape(x, (-1,))
+    idx = index
+    n = flat.shape[0]
+    if mode == "wrap":
+        idx = ((idx % n) + n) % n
+    elif mode == "clip":
+        idx = jnp.clip(idx, 0, n - 1)
+    else:  # 'raise': paddle supports negative python-style indices here
+        idx = jnp.where(idx < 0, idx + n, idx)
+    return jnp.take(flat, idx)
+
+
+@op
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return jnp.trapezoid(y, x=x, axis=axis)
+    return jnp.trapezoid(y, dx=1.0 if dx is None else dx, axis=axis)
+
+
+@op
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y0 = jax.lax.slice_in_dim(y, 0, y.shape[axis] - 1, axis=axis)
+    y1 = jax.lax.slice_in_dim(y, 1, y.shape[axis], axis=axis)
+    if x is not None:
+        if x.ndim == 1:
+            d = jnp.diff(x)
+            shape = [1] * y.ndim
+            shape[axis] = -1
+            d = d.reshape(shape)
+        else:
+            d = jnp.diff(x, axis=axis)
+        return jnp.cumsum(d * (y0 + y1) / 2.0, axis=axis)
+    step = 1.0 if dx is None else dx
+    return jnp.cumsum(step * (y0 + y1) / 2.0, axis=axis)
+
+
+@op
+def vander(x, n=None, increasing=False, name=None):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+@op
+def renorm(x, p, axis, max_norm, name=None):
+    axes = tuple(i for i in range(x.ndim) if i != axis % x.ndim)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=axes, keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * factor
+
+
+@op
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
+                name=None):
+    return jnp.nanquantile(x, q, axis=axis, keepdims=keepdim,
+                           method=interpolation)
+
+
+@op
+def histogram_bin_edges(x, bins=100, min=0, max=0, name=None):
+    r = None if (min == 0 and max == 0) else (min, max)
+    return jnp.histogram_bin_edges(jnp.reshape(x, (-1,)), bins=bins, range=r)
+
+
+@op
+def floor_mod(x, y, name=None):
+    return jnp.mod(x, y)
+
+
+@op
+def reduce_as(x, target, name=None):
+    """Sum-reduce x to target's shape (reference math.py:1624)."""
+    tshape = np.shape(target)
+    lead = x.ndim - len(tshape)
+    axes = list(range(lead))
+    for i, s in enumerate(tshape):
+        if x.shape[lead + i] != s:
+            axes.append(lead + i)
+    out = jnp.sum(x, axis=tuple(axes), keepdims=True)
+    return jnp.reshape(out, tshape)
+
+
+@op
+def add_n(inputs, name=None):
+    if not isinstance(inputs, (list, tuple)):
+        return inputs
+    out = inputs[0]
+    for t in inputs[1:]:
+        out = out + t
+    return out
+
+
+@op
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    diff = x[..., :, None, :] - y[..., None, :, :]
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(jnp.square(diff), axis=-1) + 1e-30)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(diff), axis=-1)
+    if p == 0.0:
+        return jnp.sum((diff != 0).astype(x.dtype), axis=-1)
+    return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+
+
+@op
+def pdist(x, p=2.0, name=None):
+    n = x.shape[0]
+    iu, ju = np.triu_indices(n, k=1)
+    diff = x[iu] - x[ju]
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(jnp.square(diff), axis=-1) + 1e-30)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(diff), axis=-1)
+    return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+
+
+# ------------------------------------------------------------ split / stack
+
+def _split_sections(total, num_or_sections):
+    if isinstance(num_or_sections, int):
+        return num_or_sections
+    return np.cumsum([int(s) for s in num_or_sections])[:-1].tolist()
+
+
+@op
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    if isinstance(num_or_indices, int):
+        return jnp.array_split(x, num_or_indices, axis=axis)
+    return jnp.split(x, [int(i) for i in num_or_indices], axis=axis)
+
+
+@op
+def hsplit(x, num_or_indices, name=None):
+    axis = 0 if x.ndim == 1 else 1
+    return tensor_split.__op_body__(x, num_or_indices, axis=axis)
+
+
+@op
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split.__op_body__(x, num_or_indices, axis=0)
+
+
+@op
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split.__op_body__(x, num_or_indices, axis=2)
+
+
+@op
+def hstack(x, name=None):
+    return jnp.hstack(x)
+
+
+@op
+def vstack(x, name=None):
+    return jnp.vstack(x)
+
+
+@op
+def dstack(x, name=None):
+    return jnp.dstack(x)
+
+
+@op
+def row_stack(x, name=None):
+    return jnp.vstack(x)
+
+
+@op
+def column_stack(x, name=None):
+    return jnp.column_stack(x)
+
+
+@op
+def block_diag(inputs, name=None):
+    return jax.scipy.linalg.block_diag(*inputs)
+
+
+@op
+def cartesian_prod(x, name=None):
+    grids = jnp.meshgrid(*x, indexing="ij")
+    return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+
+@op
+def combinations(x, r=2, with_replacement=False, name=None):
+    n = x.shape[0]
+    if r == 0:
+        return jnp.zeros((0,), x.dtype)
+    gen = itertools.combinations_with_replacement(range(n), r) \
+        if with_replacement else itertools.combinations(range(n), r)
+    idx = np.array(list(gen), np.int32).reshape(-1, r)
+    return x[idx]
+
+
+# ---------------------------------------------------------- scatter variants
+
+@op
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    moved = jnp.moveaxis(x, (axis1, axis2), (-2, -1))
+    n, m = moved.shape[-2], moved.shape[-1]
+    rows = jnp.arange(max(n, m))
+    if offset >= 0:
+        r, c = rows[:min(n, m - offset)], rows[:min(n, m - offset)] + offset
+    else:
+        r, c = rows[:min(n + offset, m)] - offset, rows[:min(n + offset, m)]
+    # moved[..., r, c] has the diagonal as the trailing axis; y matches it
+    out = moved.at[..., r, c].set(y)
+    return jnp.moveaxis(out, (-2, -1), (axis1, axis2))
+
+
+@op
+def select_scatter(x, values, axis, index, name=None):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].set(values)
+
+
+@op
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    idx = [slice(None)] * x.ndim
+    for ax, st, en, sr in zip(axes, starts, ends, strides):
+        idx[ax] = slice(int(st), int(en), int(sr))
+    return x.at[tuple(idx)].set(value)
+
+
+@op
+def masked_scatter(x, mask, value, name=None):
+    maskb = jnp.broadcast_to(mask.astype(bool), x.shape)
+    vflat = jnp.reshape(value, (-1,))
+    pos = jnp.cumsum(jnp.reshape(maskb, (-1,)).astype(jnp.int32)) - 1
+    pos = jnp.clip(pos, 0, vflat.shape[0] - 1)
+    picked = jnp.take(vflat, pos).reshape(x.shape)
+    return jnp.where(maskb, picked.astype(x.dtype), x)
+
+
+@op
+def index_fill(x, index, axis, value, name=None):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].set(value)
+
+
+# ----------------------------------------------------------- view-ish / misc
+
+@op
+def reverse(x, axis, name=None):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    return jnp.flip(x, axis=axes)
+
+
+@op
+def unflatten(x, axis, shape, name=None):
+    axis = axis % x.ndim
+    new_shape = (list(x.shape[:axis]) + [int(s) for s in np.asarray(shape)
+                                         .reshape(-1)]
+                 + list(x.shape[axis + 1:]))
+    return jnp.reshape(x, new_shape)
+
+
+@op
+def view_as(x, other, name=None):
+    return jnp.reshape(x, np.shape(other))
+
+
+@op
+def as_complex(x, name=None):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+@op
+def as_real(x, name=None):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+@op
+def rank(x, name=None):
+    return jnp.asarray(x.ndim, jnp.int32)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+@op
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,
+                name=None):
+    if shard_id < 0 or shard_id >= nshards:
+        raise ValueError("shard_id must be in [0, nshards)")
+    shard_size = (index_num + nshards - 1) // nshards
+    lo = shard_id * shard_size
+    hi = lo + shard_size
+    in_shard = (input >= lo) & (input < hi)
+    return jnp.where(in_shard, input - lo,
+                     jnp.asarray(ignore_value, input.dtype))
+
+
+# ----------------------------------------------------------------- random
+
+@op
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    sh = tuple(shape) if shape is not None else np.broadcast_shapes(
+        np.shape(mean), np.shape(std))
+    eps = jax.random.normal(_random.split_key(), sh)
+    return jnp.exp(mean + std * eps)
+
+
+@op
+def binomial(count, prob, name=None):
+    sh = jnp.broadcast_shapes(np.shape(count), np.shape(prob))
+    n = jnp.broadcast_to(count, sh).astype(jnp.float32)
+    p = jnp.broadcast_to(prob, sh).astype(jnp.float32)
+    out = jax.random.binomial(_random.split_key(), n, p, shape=sh)
+    return out.astype(jnp.int64)
+
+
+# ------------------------------------------------------------ dtype queries
+
+def is_complex(x):
+    import jax.numpy as jnp
+    d = x.dtype if not hasattr(x, "_data") else x._data.dtype
+    return bool(jnp.issubdtype(d, jnp.complexfloating))
+
+
+def is_floating_point(x):
+    d = x.dtype if not hasattr(x, "_data") else x._data.dtype
+    return bool(jnp.issubdtype(d, jnp.floating))
+
+
+def is_integer(x):
+    d = x.dtype if not hasattr(x, "_data") else x._data.dtype
+    return bool(jnp.issubdtype(d, jnp.integer))

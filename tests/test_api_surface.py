@@ -1,0 +1,293 @@
+"""Top-level paddle.* surface parity + numerics for the long-tail ops
+(reference: python/paddle/__init__.py __all__; tensor/math.py behaviors)."""
+import ast
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+rng = np.random.RandomState(5)
+REF = "/root/reference/python/paddle/__init__.py"
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+@pytest.mark.skipif(not os.path.exists(REF), reason="reference not mounted")
+def test_namespace_parity_with_reference():
+    tree = ast.parse(open(REF).read())
+    ref_all = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if getattr(tgt, "id", None) == "__all__":
+                    ref_all = ast.literal_eval(node.value)
+    assert ref_all, "reference __all__ not found"
+    missing = sorted(set(ref_all) - set(dir(paddle)))
+    assert not missing, f"top-level gaps vs reference: {missing}"
+
+
+class TestSpecialFunctions:
+    def test_basics(self):
+        x = np.array([0.5, 1.5], np.float32)
+        np.testing.assert_allclose(paddle.logaddexp(t(x), t(x)).numpy(),
+                                   np.logaddexp(x, x), rtol=1e-6)
+        np.testing.assert_allclose(paddle.copysign(t(x), t(-x)).numpy(), -x)
+        np.testing.assert_allclose(paddle.sinc(t(x)).numpy(), np.sinc(x),
+                                   rtol=1e-6)
+        m, e = paddle.frexp(t(np.array([8.0, 0.75], np.float32)))
+        np.testing.assert_allclose(m.numpy() * 2.0 ** e.numpy(),
+                                   [8.0, 0.75], rtol=1e-6)
+        np.testing.assert_allclose(
+            paddle.ldexp(t(np.array([3.0], np.float32)),
+                         t(np.array([2], np.int32))).numpy(), [12.0])
+        from scipy import special as sp
+        np.testing.assert_allclose(paddle.gammaln(t(x)).numpy(),
+                                   sp.gammaln(x), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.gammainc(t(x), t(x)).numpy(), sp.gammainc(x, x),
+            rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.multigammaln(t(np.array([3.0], np.float32)), 2).numpy(),
+            sp.multigammaln(3.0, 2), rtol=1e-5)
+        np.testing.assert_allclose(paddle.i1(t(x)).numpy(), sp.i1(x),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(paddle.i0e(t(x)).numpy(), sp.i0e(x),
+                                   rtol=1e-5)
+
+    def test_predicates(self):
+        x = t(np.array([1.0, -np.inf, np.inf, np.nan], np.float32))
+        np.testing.assert_array_equal(paddle.isneginf(x).numpy(),
+                                      [False, True, False, False])
+        np.testing.assert_array_equal(paddle.isposinf(x).numpy(),
+                                      [False, False, True, False])
+        assert paddle.is_floating_point(x) is True
+        assert paddle.is_integer(t(np.array([1, 2]))) is True
+        assert paddle.is_complex(t(np.array([1 + 2j]))) is True
+
+    def test_sgn_complex(self):
+        z = np.array([3 + 4j, 0 + 0j], np.complex64)
+        out = paddle.sgn(t(z)).numpy()
+        np.testing.assert_allclose(out[0], 0.6 + 0.8j, rtol=1e-5)
+        np.testing.assert_allclose(out[1], 0.0)
+
+
+class TestTakeScatter:
+    def test_take_modes(self):
+        x = t(np.arange(12).reshape(3, 4))
+        np.testing.assert_array_equal(
+            paddle.take(x, t(np.array([[0, 5], [11, -1]]))).numpy(),
+            [[0, 5], [11, 11]])
+        np.testing.assert_array_equal(
+            paddle.take(x, t(np.array([13, -2])), mode="wrap").numpy(),
+            [1, 10])
+        np.testing.assert_array_equal(
+            paddle.take(x, t(np.array([13, 500])), mode="clip").numpy(),
+            [11, 11])
+
+    def test_scatter_variants(self):
+        x = np.zeros((3, 4), np.float32)
+        y = np.ones(3, np.float32)
+        out = paddle.diagonal_scatter(t(x), t(y)).numpy()
+        np.testing.assert_array_equal(np.diag(out), y)
+        out = paddle.select_scatter(t(x), t(np.full(4, 7.0, np.float32)),
+                                    0, 1).numpy()
+        np.testing.assert_array_equal(out[1], np.full(4, 7.0))
+        out = paddle.slice_scatter(
+            t(x), t(np.full((3, 2), 5.0, np.float32)),
+            axes=[1], starts=[1], ends=[3], strides=[1]).numpy()
+        np.testing.assert_array_equal(out[:, 1:3], np.full((3, 2), 5.0))
+        mask = np.array([[True, False], [False, True]])
+        vals = np.array([10.0, 20.0, 30.0, 40.0], np.float32)
+        out = paddle.masked_scatter(
+            t(np.zeros((2, 2), np.float32)), t(mask), t(vals)).numpy()
+        np.testing.assert_array_equal(out, [[10.0, 0.0], [0.0, 20.0]])
+        out = paddle.index_fill(t(x), t(np.array([0, 2])), 0, 9.0).numpy()
+        np.testing.assert_array_equal(out[[0, 2]], np.full((2, 4), 9.0))
+
+    def test_shard_index(self):
+        x = t(np.array([[1], [6], [12], [19]], np.int64))
+        out = paddle.shard_index(x, 20, 2, 0).numpy()
+        np.testing.assert_array_equal(out, [[1], [6], [-1], [-1]])
+        out = paddle.shard_index(x, 20, 2, 1).numpy()
+        np.testing.assert_array_equal(out, [[-1], [-1], [2], [9]])
+
+
+class TestStackSplit:
+    def test_stacks(self):
+        a = np.arange(6).reshape(2, 3).astype(np.float32)
+        np.testing.assert_array_equal(
+            paddle.hstack([t(a), t(a)]).numpy(), np.hstack([a, a]))
+        np.testing.assert_array_equal(
+            paddle.vstack([t(a), t(a)]).numpy(), np.vstack([a, a]))
+        np.testing.assert_array_equal(
+            paddle.dstack([t(a), t(a)]).numpy(), np.dstack([a, a]))
+        np.testing.assert_array_equal(
+            paddle.column_stack([t(a[:, 0]), t(a[:, 1])]).numpy(),
+            np.column_stack([a[:, 0], a[:, 1]]))
+        np.testing.assert_array_equal(
+            paddle.row_stack([t(a), t(a)]).numpy(), np.vstack([a, a]))
+
+    def test_splits(self):
+        a = np.arange(24).reshape(4, 6)
+        outs = paddle.tensor_split(t(a), 3, axis=1)
+        assert len(outs) == 3 and outs[0].shape == [4, 2]
+        outs = paddle.tensor_split(t(np.arange(7)), 3)
+        assert [o.shape[0] for o in outs] == [3, 2, 2]  # uneven ok
+        outs = paddle.hsplit(t(a), 2)
+        np.testing.assert_array_equal(outs[0].numpy(), a[:, :3])
+        outs = paddle.vsplit(t(a), 2)
+        np.testing.assert_array_equal(outs[0].numpy(), a[:2])
+        a3 = np.arange(8).reshape(2, 2, 2)
+        outs = paddle.dsplit(t(a3), 2)
+        np.testing.assert_array_equal(outs[0].numpy(), a3[:, :, :1])
+
+    def test_block_diag_cartesian_combinations(self):
+        a = np.ones((2, 2), np.float32)
+        b = 2 * np.ones((1, 3), np.float32)
+        out = paddle.block_diag([t(a), t(b)]).numpy()
+        assert out.shape == (3, 5)
+        np.testing.assert_array_equal(out[:2, :2], a)
+        np.testing.assert_array_equal(out[2:, 2:], b)
+        out = paddle.cartesian_prod([t(np.array([1, 2])),
+                                     t(np.array([3, 4, 5]))]).numpy()
+        assert out.shape == (6, 2)
+        out = paddle.combinations(t(np.array([1, 2, 3])), 2).numpy()
+        np.testing.assert_array_equal(out, [[1, 2], [1, 3], [2, 3]])
+        out = paddle.combinations(t(np.array([1, 2])), 2,
+                                  with_replacement=True).numpy()
+        np.testing.assert_array_equal(out, [[1, 1], [1, 2], [2, 2]])
+
+
+class TestMathMisc:
+    def test_distances(self):
+        x = rng.randn(4, 3).astype(np.float32)
+        y = rng.randn(5, 3).astype(np.float32)
+        out = paddle.cdist(t(x), t(y)).numpy()
+        expect = np.sqrt(((x[:, None] - y[None]) ** 2).sum(-1))
+        np.testing.assert_allclose(out, expect, rtol=1e-4)
+        out = paddle.pdist(t(x)).numpy()
+        iu = np.triu_indices(4, 1)
+        expect = np.sqrt(((x[iu[0]] - x[iu[1]]) ** 2).sum(-1))
+        np.testing.assert_allclose(out, expect, rtol=1e-4)
+
+    def test_renorm(self):
+        x = rng.randn(3, 5).astype(np.float32) * 10
+        out = paddle.renorm(t(x), 2.0, 0, 1.0).numpy()
+        norms = np.sqrt((out ** 2).sum(1))
+        assert (norms <= 1.0 + 1e-4).all()
+        small = np.full((2, 2), 0.1, np.float32)
+        np.testing.assert_allclose(
+            paddle.renorm(t(small), 2.0, 0, 10.0).numpy(), small)
+
+    def test_trapezoid(self):
+        y = np.array([1.0, 2.0, 3.0], np.float32)
+        np.testing.assert_allclose(float(paddle.trapezoid(t(y))), 4.0)
+        np.testing.assert_allclose(
+            paddle.cumulative_trapezoid(t(y)).numpy(), [1.5, 4.0])
+        x = np.array([0.0, 1.0, 3.0], np.float32)
+        np.testing.assert_allclose(
+            float(paddle.trapezoid(t(y), x=t(x))),
+            np.trapezoid(y, x), rtol=1e-6)
+
+    def test_reduce_as_add_n(self):
+        x = rng.randn(2, 3, 4).astype(np.float32)
+        target = np.zeros((3, 1), np.float32)
+        out = paddle.reduce_as(t(x), t(target)).numpy()
+        np.testing.assert_allclose(out, x.sum(0).sum(-1, keepdims=True),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.add_n([t(x), t(x), t(x)]).numpy(), 3 * x, rtol=1e-6)
+
+    def test_vander_unflatten_view_as(self):
+        x = np.array([1.0, 2.0, 3.0], np.float32)
+        np.testing.assert_allclose(paddle.vander(t(x)).numpy(), np.vander(x))
+        y = t(rng.randn(2, 6).astype(np.float32))
+        assert paddle.unflatten(y, 1, [2, 3]).shape == [2, 2, 3]
+        assert paddle.view_as(y, t(np.zeros((3, 4)))).shape == [3, 4]
+
+    def test_complex_views(self):
+        x = rng.randn(3, 2).astype(np.float32)
+        z = paddle.as_complex(t(x))
+        assert paddle.is_complex(z)
+        back = paddle.as_real(z).numpy()
+        np.testing.assert_allclose(back, x)
+
+    def test_isin_rank_tolist_broadcast_shape(self):
+        x = t(np.array([1, 2, 3, 4]))
+        np.testing.assert_array_equal(
+            paddle.isin(x, t(np.array([2, 4]))).numpy(),
+            [False, True, False, True])
+        assert int(paddle.rank(t(np.zeros((2, 3))))) == 2
+        assert paddle.tolist(t(np.array([[1, 2]]))) == [[1, 2]]
+        assert paddle.broadcast_shape([2, 1, 3], [1, 4, 3]) == [2, 4, 3]
+
+    def test_random_surface(self):
+        paddle.seed(7)
+        s = paddle.binomial(t(np.float32(10)), t(np.float32(0.5)))
+        assert 0 <= int(s) <= 10
+        ln = paddle.log_normal(0.0, 0.25, [200])
+        assert (ln.numpy() > 0).all()
+        x = t(np.zeros((50,), np.float32))
+        x.bernoulli_(0.5)
+        assert set(np.unique(x.numpy())) <= {0.0, 1.0}
+        x.log_normal_(0.0, 0.5)
+        assert (x.numpy() > 0).all()
+        x.cauchy_()
+        x.geometric_(0.5)
+        assert (x.numpy() >= 1).all()
+
+
+class TestInplaceVariants:
+    def test_top_level_inplace(self):
+        x = t(np.array([1.0, 4.0], np.float32))
+        out = paddle.sqrt_(x)
+        np.testing.assert_allclose(x.numpy(), [1.0, 2.0])
+        assert out is x
+        paddle.sin_(x)
+        np.testing.assert_allclose(x.numpy(), np.sin([1.0, 2.0]), rtol=1e-6)
+        y = t(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+        paddle.t_(y)
+        np.testing.assert_allclose(y.numpy(), [[1.0, 3.0], [2.0, 4.0]])
+        z = t(np.array([1.0, -1.0], np.float32))
+        paddle.neg_(z)
+        np.testing.assert_allclose(z.numpy(), [-1.0, 1.0])
+
+    def test_method_inplace(self):
+        x = t(np.array([2.0], np.float32))
+        x.pow_(3)
+        np.testing.assert_allclose(x.numpy(), [8.0])
+        x.log2_()
+        np.testing.assert_allclose(x.numpy(), [3.0])
+
+
+class TestMiscTopLevel:
+    def test_flops_linear(self):
+        import paddle_tpu.nn as nn
+        net = nn.Linear(16, 32, bias_attr=False)
+        n = paddle.flops(net, [4, 16])
+        assert n == 2 * 4 * 16 * 32
+
+    def test_create_parameter_lazy_guard(self):
+        with paddle.LazyGuard():
+            p = paddle.create_parameter([3, 4], "float32")
+        assert p.shape == [3, 4] and not p.stop_gradient
+
+    def test_batch_reader(self):
+        r = paddle.batch(lambda: iter(range(7)), batch_size=3)
+        batches = list(r())
+        assert batches == [[0, 1, 2], [3, 4, 5], [6]]
+        r = paddle.batch(lambda: iter(range(7)), batch_size=3,
+                         drop_last=True)
+        assert list(r()) == [[0, 1, 2], [3, 4, 5]]
+
+    def test_dtype_and_places(self):
+        assert paddle.dtype("float32") is paddle.float32
+        assert paddle.float8_e4m3fn.name == "float8_e4m3fn"
+        paddle.CUDAPinnedPlace()
+        paddle.set_printoptions(precision=4)
+        paddle.disable_signal_handler()
+        paddle.check_shape([2, -1, 3])
