@@ -8,8 +8,8 @@ import jax.numpy.fft as jfft
 from .ops.registry import op
 
 __all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
-           "fft2", "ifft2", "rfft2", "irfft2",
-           "fftn", "ifftn", "rfftn", "irfftn",
+           "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+           "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
            "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
 
 
@@ -44,6 +44,40 @@ fftn = _mk("fftn", jfft.fftn, "nd")
 ifftn = _mk("ifftn", jfft.ifftn, "nd")
 rfftn = _mk("rfftn", jfft.rfftn, "nd")
 irfftn = _mk("irfftn", jfft.irfftn, "nd")
+
+
+def _hfftn_body(x, s=None, axes=None, norm="backward"):
+    # c2r over the last transform axis, c2c forward over the rest
+    # (reference python/paddle/fft.py fftn_c2r)
+    import jax.numpy as jnp
+    if axes is None:
+        axes = list(range(x.ndim)) if s is None else \
+            list(range(x.ndim - len(s), x.ndim))
+    axes = list(axes)
+    sizes = list(s) if s is not None else [None] * len(axes)
+    for ax, n_ in zip(axes[:-1], sizes[:-1]):
+        x = jfft.fft(x, n=n_, axis=ax, norm=norm)
+    return jfft.hfft(x, n=sizes[-1], axis=axes[-1], norm=norm)
+
+
+def _ihfftn_body(x, s=None, axes=None, norm="backward"):
+    if axes is None:
+        axes = list(range(x.ndim)) if s is None else \
+            list(range(x.ndim - len(s), x.ndim))
+    axes = list(axes)
+    sizes = list(s) if s is not None else [None] * len(axes)
+    x = jfft.ihfft(x, n=sizes[-1], axis=axes[-1], norm=norm)
+    for ax, n_ in zip(axes[:-1], sizes[:-1]):
+        x = jfft.ifft(x, n=n_, axis=ax, norm=norm)
+    return x
+
+
+hfftn = _mk("hfftn", _hfftn_body, "nd")
+ihfftn = _mk("ihfftn", _ihfftn_body, "nd")
+hfft2 = _mk("hfft2", lambda x, s=None, axes=(-2, -1), norm="backward":
+            _hfftn_body(x, s, axes, norm), "2d")
+ihfft2 = _mk("ihfft2", lambda x, s=None, axes=(-2, -1), norm="backward":
+             _ihfftn_body(x, s, axes, norm), "2d")
 
 
 @op(name="fftshift")

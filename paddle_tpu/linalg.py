@@ -4,5 +4,6 @@ from .ops.linalg import (  # noqa: F401
     cholesky, cholesky_solve, qr, svd, svdvals, pca_lowrank, inv, pinv, det,
     slogdet, solve, triangular_solve, lstsq, lu, eig, eigh, eigvals,
     eigvalsh, matrix_power, matrix_rank, cond, corrcoef, cov,
-    householder_product, matrix_exp)
+    householder_product, matrix_exp, cholesky_inverse, lu_unpack,
+    multi_dot, ormqr, svd_lowrank, fp8_fp8_half_gemm_fused)
 from .ops.math import cross, dot  # noqa: F401

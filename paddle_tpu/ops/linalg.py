@@ -268,3 +268,134 @@ def bitwise_left_shift(x, y, is_arithmetic=True, name=None):
 @op
 def bitwise_right_shift(x, y, is_arithmetic=True, name=None):
     return jnp.right_shift(x, y)
+
+
+# ----------------------------------------------------- surface part 2
+
+@op
+def cholesky_inverse(x, upper=False, name=None):
+    """Inverse of A from its Cholesky factor (reference
+    python/paddle/tensor/linalg.py cholesky_inverse)."""
+    n = x.shape[-1]
+    eye_ = jnp.eye(n, dtype=x.dtype)
+    z = jax.scipy.linalg.solve_triangular(x, eye_, lower=not upper)
+    # A = L L^T -> A^-1 = (L^-1)^T (L^-1);  A = U^T U -> A^-1 = U^-1 U^-T
+    if upper:
+        return jnp.matmul(z, jnp.swapaxes(z, -1, -2))
+    return jnp.matmul(jnp.swapaxes(z, -1, -2), z)
+
+
+@op
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack combined LU + 1-based pivots into P, L, U (reference
+    python/paddle/tensor/linalg.py:3456)."""
+    m, n = x.shape[-2], x.shape[-1]
+    k = min(m, n)
+    L = jnp.tril(x, -1)[..., :, :k] + jnp.eye(m, k, dtype=x.dtype)
+    U = jnp.triu(x)[..., :k, :]
+    # pivots -> permutation matrix: row swaps applied in order
+    piv = y.astype(jnp.int32) - 1
+
+    def build_p(piv1):
+        perm0 = jnp.arange(m, dtype=jnp.int32)
+
+        def swap(perm, i):
+            j = piv1[i]
+            pi, pj = perm[i], perm[j]
+            return perm.at[i].set(pj).at[j].set(pi), None
+
+        perm, _ = jax.lax.scan(swap, perm0, jnp.arange(piv1.shape[0]))
+        return jnp.eye(m, dtype=x.dtype)[perm].T
+
+    if piv.ndim == 1:
+        P = build_p(piv)
+    else:
+        P = jax.vmap(build_p)(piv.reshape(-1, piv.shape[-1])).reshape(
+            x.shape[:-2] + (m, m))
+    return P, L, U
+
+
+@op
+def multi_dot(x, name=None):
+    return jnp.linalg.multi_dot(x)
+
+
+@op
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    """Multiply y by the (full, implicit) Q of a QR given Householder
+    reflectors (reference python/paddle/tensor/linalg.py ormqr): apply
+    H_i = I - tau_i v_i v_i^T directly, never materializing Q."""
+    m = x.shape[-2]
+    k = tau.shape[-1]
+    rows = jnp.arange(m)
+
+    def reflector(i):
+        v = jnp.where(rows < i, 0.0, jnp.where(rows == i, 1.0, x[..., :, i]))
+        return v
+
+    # Q = H_0 H_1 ... H_{k-1}:  Q y applies H_{k-1} first; Q^T y applies
+    # H_0 first; right-multiplication reverses the order again.
+    out = y
+    ascending = (left and transpose) or (not left and not transpose)
+    seq = range(k) if ascending else range(k - 1, -1, -1)
+    for i in seq:
+        v = reflector(i)
+        ti = tau[..., i][..., None, None]
+        if left:
+            out = out - ti * (v[..., :, None] * jnp.einsum(
+                "...m,...mn->...n", v, out)[..., None, :])
+        else:
+            out = out - ti * (jnp.einsum(
+                "...nm,...m->...n", out, v)[..., :, None] * v[..., None, :])
+    return out
+
+
+@op
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized truncated SVD (reference python/paddle/tensor/linalg.py
+    svd_lowrank; Halko et al. subspace iteration)."""
+    from ..framework import random as _random
+    if M is not None:
+        x = x - M
+    m, n = x.shape[-2], x.shape[-1]
+    q = min(q, m, n)
+    xt = jnp.swapaxes(x, -1, -2)
+    omega = jax.random.normal(_random.split_key(),
+                              x.shape[:-2] + (n, q), dtype=x.dtype)
+    Y = jnp.matmul(x, omega)
+    Q, _ = jnp.linalg.qr(Y)
+    for _ in range(niter):
+        Z = jnp.matmul(xt, Q)
+        Qz, _ = jnp.linalg.qr(Z)
+        Y = jnp.matmul(x, Qz)
+        Q, _ = jnp.linalg.qr(Y)
+    B = jnp.matmul(jnp.swapaxes(Q, -1, -2), x)
+    u_b, s, vh = jnp.linalg.svd(B, full_matrices=False)
+    U = jnp.matmul(Q, u_b)
+    return U, s, jnp.swapaxes(vh, -1, -2)
+
+
+@op
+def fp8_fp8_half_gemm_fused(x, y, transpose_x=False, transpose_y=False,
+                            bias=None, scale=1.0, output_dtype="float16",
+                            act="identity", name=None):
+    """fp8xfp8 -> half gemm (reference tensor/linalg.py:329 binds a CUTLASS
+    kernel).  On TPU: cast fp8 operands into the MXU-native dot with a
+    bf16/f16 result dtype; XLA fuses scale/bias/act into the matmul."""
+    import ml_dtypes
+    out_np = ml_dtypes.bfloat16 if output_dtype == "bfloat16" \
+        else np.float16
+    a = jnp.swapaxes(x, -1, -2) if transpose_x else x
+    b = jnp.swapaxes(y, -1, -2) if transpose_y else y
+    out = jax.lax.dot_general(
+        a.astype(jnp.float32), b.astype(jnp.float32),
+        (((a.ndim - 1,), (b.ndim - 2,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    out = out * scale
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    if act == "relu":
+        out = jax.nn.relu(out)
+    elif act == "gelu":
+        out = jax.nn.gelu(out)
+    return out.astype(out_np)

@@ -1,0 +1,96 @@
+"""linalg/fft surface part 2 (reference: python/paddle/tensor/linalg.py
+cholesky_inverse/lu_unpack/multi_dot/ormqr/svd_lowrank/fp8 gemm; fft.py
+hfft2/hfftn/ihfft2/ihfftn)."""
+import numpy as np
+import scipy.linalg as sla
+
+import paddle_tpu as paddle
+import paddle_tpu.fft as pfft
+
+L = paddle.linalg
+rng = np.random.RandomState(9)
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestLinalgExtra:
+    def test_cholesky_inverse(self):
+        A = rng.randn(4, 4).astype(np.float32)
+        A = A @ A.T + 4 * np.eye(4, dtype=np.float32)
+        Lc = np.linalg.cholesky(A)
+        np.testing.assert_allclose(L.cholesky_inverse(t(Lc)).numpy(),
+                                   np.linalg.inv(A), rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(
+            L.cholesky_inverse(t(Lc.T.copy()), upper=True).numpy(),
+            np.linalg.inv(A), rtol=1e-3, atol=1e-4)
+
+    def test_lu_unpack_reconstructs(self):
+        A = rng.randn(5, 5).astype(np.float32)
+        lu, piv = L.lu(t(A))
+        P, Lm, U = L.lu_unpack(lu, piv)
+        np.testing.assert_allclose(P.numpy() @ Lm.numpy() @ U.numpy(), A,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_multi_dot(self):
+        mats = [rng.randn(3, 4), rng.randn(4, 5), rng.randn(5, 2)]
+        np.testing.assert_allclose(
+            L.multi_dot([t(m.astype(np.float32)) for m in mats]).numpy(),
+            mats[0] @ mats[1] @ mats[2], rtol=1e-4)
+
+    def test_ormqr_all_modes(self):
+        A = rng.randn(5, 3).astype(np.float64)
+        (hh, tau), _ = sla.qr(A, mode="raw")
+        hh = np.asarray(hh)
+        y = rng.randn(5, 2).astype(np.float64)
+        Q = sla.qr(A, mode="full")[0]
+        for left, tr in [(True, False), (True, True),
+                         (False, False), (False, True)]:
+            yy = y if left else y.T
+            ours = L.ormqr(t(hh.astype(np.float32)),
+                           t(tau.astype(np.float32)),
+                           t(yy.astype(np.float32)),
+                           left=left, transpose=tr).numpy()
+            Qm = Q.T if tr else Q
+            expect = Qm @ y if left else y.T @ Qm
+            np.testing.assert_allclose(ours, expect, rtol=1e-3, atol=1e-4)
+
+    def test_svd_lowrank(self):
+        B = (rng.randn(20, 3) @ rng.randn(3, 15)).astype(np.float32)
+        U, s, V = L.svd_lowrank(t(B), q=5)
+        np.testing.assert_allclose(
+            U.numpy() @ np.diag(s.numpy()) @ V.numpy().T, B,
+            rtol=1e-2, atol=1e-2)
+
+    def test_fp8_gemm(self):
+        x = t(rng.randn(8, 16).astype(np.float32)).astype("float8_e4m3fn")
+        y = t(rng.randn(16, 8).astype(np.float32)).astype("float8_e4m3fn")
+        out = L.fp8_fp8_half_gemm_fused(x, y, output_dtype="bfloat16",
+                                        scale=0.5, act="relu")
+        assert out.shape == [8, 8]
+        assert "bfloat16" in str(out.dtype)
+        assert (out.astype("float32").numpy() >= 0).all()
+
+
+class TestHfftFamily:
+    def test_roundtrip(self):
+        # a genuine Hermitian half-spectrum: ihfftn of a real signal;
+        # hfftn must take it back to the real signal
+        real = rng.randn(6, 8).astype(np.float32)
+        half = pfft.ihfftn(t(real)).numpy()
+        assert half.shape == (6, 5)  # last axis 8 -> 8//2+1
+        out = pfft.hfftn(t(half), s=[6, 8]).numpy()
+        assert not np.iscomplexobj(out)
+        np.testing.assert_allclose(out, real, atol=1e-3)
+        np.testing.assert_allclose(pfft.hfft2(t(half), s=[6, 8]).numpy(),
+                                   out, rtol=1e-4)
+        np.testing.assert_allclose(pfft.ihfft2(t(real)).numpy(), half,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_1d_consistency(self):
+        # hfftn over a single axis == hfft
+        sig = (rng.randn(8) + 1j * rng.randn(8)).astype(np.complex64)
+        np.testing.assert_allclose(
+            pfft.hfftn(t(sig), axes=[0]).numpy(),
+            np.fft.hfft(sig), rtol=1e-4, atol=1e-4)
