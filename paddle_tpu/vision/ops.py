@@ -7,7 +7,11 @@ import jax.numpy as jnp
 
 from ..ops.registry import op
 
-__all__ = ["nms", "box_iou", "roi_align", "DeformConv2D"]
+__all__ = ["nms", "box_iou", "roi_align", "roi_pool", "psroi_pool",
+           "box_coder", "prior_box", "yolo_box", "yolo_loss", "matrix_nms",
+           "deform_conv2d", "distribute_fpn_proposals", "generate_proposals",
+           "read_file", "decode_jpeg", "RoIAlign", "RoIPool", "PSRoIPool",
+           "DeformConv2D"]
 
 
 @op(name="box_iou")
@@ -87,9 +91,714 @@ def roi_align(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
     return jax.vmap(one_roi)(boxes, batch_idx)
 
 
-class DeformConv2D:
-    def __init__(self, *a, **k):
-        raise NotImplementedError(
-            "DeformConv2D needs data-dependent gather patterns that map "
-            "poorly to TPU; out of scope (reference: vision/ops.py "
-            "DeformConv2D)")
+def _pair2(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def _bilinear_sample(img, ys, xs):
+    """Sample img [C,H,W] at float coords ys/xs (same shape S); zeros
+    outside.  Returns [C, *S]."""
+    c, h, w = img.shape
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy = ys - y0
+    wx = xs - x0
+    out = 0.0
+    for dy, sy in ((0, 1 - wy), (1, wy)):
+        for dx, sx in ((0, 1 - wx), (1, wx)):
+            yy = y0 + dy
+            xx = x0 + dx
+            valid = ((yy >= 0) & (yy <= h - 1) & (xx >= 0) & (xx <= w - 1))
+            yi = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            xi = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            v = img[:, yi, xi] * jnp.where(valid, sy * sx, 0.0)
+            out = out + v
+    return out
+
+
+@op(name="deform_conv2d")
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2 (reference vision/ops.py:766; CUDA
+    kernel deformable_conv_kernel.cu).  TPU-native: bilinear gather of the
+    kh*kw deformed taps (one big take per corner) then an einsum onto the
+    MXU — the im2col structure XLA tiles well."""
+    sh, sw = _pair2(stride)
+    ph, pw = _pair2(padding)
+    dh, dw = _pair2(dilation)
+    n, cin, h, w = x.shape
+    cout, cpg, kh, kw = weight.shape  # cpg = cin/groups
+    _, _, oh, ow = offset.shape
+    dg = deformable_groups
+    k = kh * kw
+
+    # base sampling grid: [k, oh, ow]
+    iy = jnp.arange(oh)[:, None] * sh - ph
+    ix = jnp.arange(ow)[None, :] * sw - pw
+    ky, kx = jnp.meshgrid(jnp.arange(kh), jnp.arange(kw), indexing="ij")
+    base_y = iy[None] + (ky.reshape(-1, 1, 1) * dh)
+    base_x = ix[None] + (kx.reshape(-1, 1, 1) * dw)
+
+    off = offset.reshape(n, dg, k, 2, oh, ow)
+    ys = base_y[None, None] + off[:, :, :, 0]      # [N, dg, k, oh, ow]
+    xs = base_x[None, None] + off[:, :, :, 1]
+    if mask is not None:
+        m = mask.reshape(n, dg, k, oh, ow)
+    else:
+        m = jnp.ones((n, dg, k, oh, ow), x.dtype)
+
+    xg = x.reshape(n, dg, cin // dg, h, w)
+
+    def per_image(img_g, ys_i, xs_i, m_i):
+        # img_g [dg, cin/dg, h, w]; coords [dg, k, oh, ow]
+        def per_dg(img, yy, xx, mm):
+            patch = _bilinear_sample(img, yy, xx)   # [cin/dg, k, oh, ow]
+            return patch * mm[None]
+        return jax.vmap(per_dg)(img_g, ys_i, xs_i, m_i)
+
+    patches = jax.vmap(per_image)(xg, ys, xs, m)    # [N,dg,cin/dg,k,oh,ow]
+    patches = patches.reshape(n, cin, k, oh, ow)
+    wmat = weight.reshape(groups, cout // groups, cpg, k)
+    pg = patches.reshape(n, groups, cpg, k, oh, ow)
+    out = jnp.einsum("gock,ngckxy->ngoxy", wmat, pg)
+    out = out.reshape(n, cout, oh, ow)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+@op(name="roi_pool")
+def roi_pool(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
+             name=None):
+    """RoIPool: exact integer-bin max pooling (reference vision/ops.py:1572;
+    phi/kernels/gpu/roi_pool_kernel.cu).  Bins realized as masked maxima so
+    shapes stay static under jit."""
+    oh, ow = _pair2(output_size)
+    n, c, h, w = x.shape
+    k = boxes.shape[0]
+    if boxes_num is None:
+        batch_idx = jnp.zeros((k,), jnp.int32)
+    else:
+        ends = jnp.cumsum(jnp.asarray(boxes_num))
+        batch_idx = jnp.searchsorted(ends, jnp.arange(k), side="right")
+    ygrid = jnp.arange(h)
+    xgrid = jnp.arange(w)
+
+    def one_roi(box, bi):
+        x1 = jnp.round(box[0] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(box[1] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(box[2] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(box[3] * spatial_scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        i = jnp.arange(oh)[:, None]
+        j = jnp.arange(ow)[None, :]
+        hstart = jnp.clip(y1 + (i * rh) // oh, 0, h)
+        hend = jnp.clip(y1 + ((i + 1) * rh + oh - 1) // oh, 0, h)
+        wstart = jnp.clip(x1 + (j * rw) // ow, 0, w)
+        wend = jnp.clip(x1 + ((j + 1) * rw + ow - 1) // ow, 0, w)
+        ymask = ((ygrid[None, None, :] >= hstart[..., None])
+                 & (ygrid[None, None, :] < hend[..., None]))  # [oh,ow,h]
+        xmask = ((xgrid[None, None, :] >= wstart[..., None])
+                 & (xgrid[None, None, :] < wend[..., None]))  # [oh,ow,w]
+        mask2d = ymask[..., :, None] & xmask[..., None, :]    # [oh,ow,h,w]
+        f = x[bi]                                             # [c,h,w]
+        neg = jnp.asarray(-jnp.inf, x.dtype)
+        vals = jnp.where(mask2d[None], f[:, None, None], neg)
+        out = jnp.max(vals, axis=(-2, -1))
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    return jax.vmap(one_roi)(boxes, batch_idx)
+
+
+@op(name="psroi_pool")
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI average pooling (reference vision/ops.py:1441):
+    input channels C = out_c*oh*ow; bin (i,j) of output channel c averages
+    input channel (c*oh + i)*ow + j inside the bin."""
+    oh, ow = _pair2(output_size)
+    n, c, h, w = x.shape
+    out_c = c // (oh * ow)
+    k = boxes.shape[0]
+    if boxes_num is None:
+        batch_idx = jnp.zeros((k,), jnp.int32)
+    else:
+        ends = jnp.cumsum(jnp.asarray(boxes_num))
+        batch_idx = jnp.searchsorted(ends, jnp.arange(k), side="right")
+    ygrid = jnp.arange(h)
+    xgrid = jnp.arange(w)
+
+    def one_roi(box, bi):
+        x1 = box[0] * spatial_scale
+        y1 = box[1] * spatial_scale
+        x2 = box[2] * spatial_scale
+        y2 = box[3] * spatial_scale
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        i = jnp.arange(oh)[:, None]
+        j = jnp.arange(ow)[None, :]
+        hstart = jnp.floor(y1 + i * rh / oh).astype(jnp.int32)
+        hend = jnp.ceil(y1 + (i + 1) * rh / oh).astype(jnp.int32)
+        wstart = jnp.floor(x1 + j * rw / ow).astype(jnp.int32)
+        wend = jnp.ceil(x1 + (j + 1) * rw / ow).astype(jnp.int32)
+        hstart = jnp.clip(hstart, 0, h)
+        hend = jnp.clip(hend, 0, h)
+        wstart = jnp.clip(wstart, 0, w)
+        wend = jnp.clip(wend, 0, w)
+        ymask = ((ygrid[None, None, :] >= hstart[..., None])
+                 & (ygrid[None, None, :] < hend[..., None]))
+        xmask = ((xgrid[None, None, :] >= wstart[..., None])
+                 & (xgrid[None, None, :] < wend[..., None]))
+        mask2d = (ymask[..., :, None] & xmask[..., None, :]).astype(x.dtype)
+        f = x[bi].reshape(out_c, oh, ow, h, w)  # channel (c*oh+i)*ow+j
+        s = jnp.einsum("cxyhw,xyhw->cxy", f, mask2d)
+        cnt = jnp.maximum(jnp.sum(mask2d, axis=(-2, -1)), 1.0)
+        return s / cnt
+
+    return jax.vmap(one_roi)(boxes, batch_idx)
+
+
+@op(name="box_coder")
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    """Encode/decode boxes against priors (reference vision/ops.py:584;
+    phi/kernels/cpu/box_coder_kernel.cc)."""
+    norm = 0.0 if box_normalized else 1.0
+    pw = prior_box[:, 2] - prior_box[:, 0] + norm
+    ph = prior_box[:, 3] - prior_box[:, 1] + norm
+    px = prior_box[:, 0] + pw * 0.5
+    py = prior_box[:, 1] + ph * 0.5
+    if prior_box_var is None:
+        var = jnp.ones((4,))
+    elif isinstance(prior_box_var, (list, tuple)):
+        var = jnp.asarray(prior_box_var, jnp.float32)
+    else:
+        var = prior_box_var
+    if code_type == "encode_center_size":
+        # target [N,4], priors [M,4] -> out [N, M, 4]
+        tw = target_box[:, 2] - target_box[:, 0] + norm
+        th = target_box[:, 3] - target_box[:, 1] + norm
+        tx = target_box[:, 0] + tw * 0.5
+        ty = target_box[:, 1] + th * 0.5
+        ox = (tx[:, None] - px[None, :]) / pw[None, :]
+        oy = (ty[:, None] - py[None, :]) / ph[None, :]
+        ow_ = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+        oh_ = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+        out = jnp.stack([ox, oy, ow_, oh_], axis=-1)
+        if var.ndim == 2:
+            out = out / var[None, :, :]
+        else:
+            out = out / var.reshape(1, 1, 4)
+        return out
+    # decode: target [N,M,4]; prior index sits on target dim `axis`
+    if axis == 0:
+        px_, py_, pw_, ph_ = (a[:, None] for a in (px, py, pw, ph))
+        vshape = (-1, 1, 4) if var.ndim == 2 else (1, 1, 4)
+    else:
+        px_, py_, pw_, ph_ = (a[None, :] for a in (px, py, pw, ph))
+        vshape = (1, -1, 4) if var.ndim == 2 else (1, 1, 4)
+    v = var.reshape(vshape)
+    tx = target_box[..., 0] * v[..., 0]
+    ty = target_box[..., 1] * v[..., 1]
+    tw = target_box[..., 2] * v[..., 2]
+    th = target_box[..., 3] * v[..., 3]
+    cx = tx * pw_ + px_
+    cy = ty * ph_ + py_
+    cw = jnp.exp(tw) * pw_
+    ch = jnp.exp(th) * ph_
+    return jnp.stack([cx - cw * 0.5, cy - ch * 0.5,
+                      cx + cw * 0.5 - norm, cy + ch * 0.5 - norm], axis=-1)
+
+
+@op(name="prior_box")
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior (anchor) box generation (reference vision/ops.py:438;
+    phi/kernels/cpu/prior_box_kernel.cc)."""
+    _, _, fh, fw = input.shape
+    _, _, ih, iw = image.shape
+    step_h = steps[1] if steps[1] > 0 else ih / fh
+    step_w = steps[0] if steps[0] > 0 else iw / fw
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    import math as _m
+    boxes = []
+    for mi, ms in enumerate(min_sizes):
+        ms = float(ms)
+        if min_max_aspect_ratios_order:
+            # reference order: min box, max box, then the other ratios
+            boxes.append((ms, ms))
+            if max_sizes:
+                mx = float(max_sizes[mi])
+                boxes.append((_m.sqrt(ms * mx), _m.sqrt(ms * mx)))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                boxes.append((ms * _m.sqrt(ar), ms / _m.sqrt(ar)))
+        else:
+            for ar in ars:
+                boxes.append((ms * _m.sqrt(ar), ms / _m.sqrt(ar)))
+            if max_sizes:
+                mx = float(max_sizes[mi])
+                boxes.append((_m.sqrt(ms * mx), _m.sqrt(ms * mx)))
+    num_priors = len(boxes)
+    bw = jnp.asarray([b[0] for b in boxes]) * 0.5
+    bh = jnp.asarray([b[1] for b in boxes]) * 0.5
+    cx = (jnp.arange(fw) + offset) * step_w
+    cy = (jnp.arange(fh) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy, indexing="xy")
+    out = jnp.stack([
+        (cxg[..., None] - bw) / iw, (cyg[..., None] - bh) / ih,
+        (cxg[..., None] + bw) / iw, (cyg[..., None] + bh) / ih], axis=-1)
+    out = out.reshape(fh, fw, num_priors, 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance), (fh, fw, num_priors, 4))
+    return out, var
+
+
+@op(name="yolo_box")
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """Decode a YOLOv3 head into boxes + scores (reference
+    vision/ops.py:277; phi/kernels/gpu/yolo_box_kernel.cu)."""
+    n, c, hh, ww = x.shape
+    na = len(anchors) // 2
+    anchors_ = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+    if iou_aware:
+        ious = jax.nn.sigmoid(x[:, :na].reshape(n, na, hh, ww))
+        feats = x[:, na:].reshape(n, na, 5 + class_num, hh, ww)
+    else:
+        feats = x.reshape(n, na, 5 + class_num, hh, ww)
+    gx = jnp.arange(ww, dtype=jnp.float32)
+    gy = jnp.arange(hh, dtype=jnp.float32)
+    bx = ((jax.nn.sigmoid(feats[:, :, 0]) - 0.5) * scale_x_y + 0.5
+          + gx[None, None, None, :]) / ww
+    by = ((jax.nn.sigmoid(feats[:, :, 1]) - 0.5) * scale_x_y + 0.5
+          + gy[None, None, :, None]) / hh
+    input_h = downsample_ratio * hh
+    input_w = downsample_ratio * ww
+    bw = jnp.exp(feats[:, :, 2]) * anchors_[None, :, 0, None, None] / input_w
+    bh = jnp.exp(feats[:, :, 3]) * anchors_[None, :, 1, None, None] / input_h
+    conf = jax.nn.sigmoid(feats[:, :, 4])
+    if iou_aware:
+        conf = conf ** (1 - iou_aware_factor) * ious ** iou_aware_factor
+    probs = jax.nn.sigmoid(feats[:, :, 5:]) * conf[:, :, None]
+    keep = conf >= conf_thresh
+    imh = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    imw = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    x1 = (bx - bw / 2) * imw
+    y1 = (by - bh / 2) * imh
+    x2 = (bx + bw / 2) * imw
+    y2 = (by + bh / 2) * imh
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, imw - 1)
+        y1 = jnp.clip(y1, 0, imh - 1)
+        x2 = jnp.clip(x2, 0, imw - 1)
+        y2 = jnp.clip(y2, 0, imh - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)
+    boxes = boxes * keep[..., None]
+    boxes = boxes.reshape(n, na * hh * ww, 4)
+    scores = (probs * keep[:, :, None]).transpose(0, 1, 3, 4, 2)
+    scores = scores.reshape(n, na * hh * ww, class_num)
+    return boxes, scores
+
+
+@op(name="yolo_loss")
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, scale_x_y=1.0, name=None):
+    """YOLOv3 loss (reference vision/ops.py:69; phi/kernels/cpu/
+    yolo_loss_kernel.cc): coordinate BCE/L1 + objectness BCE with
+    ignore-region, + class BCE.  gt_box is [N,B,4] (cx,cy,w,h) normalized
+    to the input image."""
+    n, c, hh, ww = x.shape
+    na = len(anchor_mask)
+    all_anchors = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+    mask_anchors = all_anchors[jnp.asarray(anchor_mask)]
+    input_size = downsample_ratio * hh
+    feats = x.reshape(n, na, 5 + class_num, hh, ww)
+    px = jax.nn.sigmoid(feats[:, :, 0])
+    py = jax.nn.sigmoid(feats[:, :, 1])
+    pw = feats[:, :, 2]
+    ph = feats[:, :, 3]
+    pobj = feats[:, :, 4]
+    pcls = feats[:, :, 5:]
+
+    b = gt_box.shape[1]
+    if gt_score is None:
+        gt_score = jnp.ones((n, b), x.dtype)
+
+    # best anchor (over ALL anchors) for each gt by wh IoU
+    gw = gt_box[..., 2] * input_size
+    gh = gt_box[..., 3] * input_size
+    inter = (jnp.minimum(gw[..., None], all_anchors[:, 0])
+             * jnp.minimum(gh[..., None], all_anchors[:, 1]))
+    union = gw[..., None] * gh[..., None] \
+        + all_anchors[:, 0] * all_anchors[:, 1] - inter
+    best = jnp.argmax(inter / (union + 1e-9), axis=-1)       # [N,B]
+
+    gi = jnp.clip((gt_box[..., 0] * ww).astype(jnp.int32), 0, ww - 1)
+    gj = jnp.clip((gt_box[..., 1] * hh).astype(jnp.int32), 0, hh - 1)
+    valid = (gt_box[..., 2] > 0) & (gt_box[..., 3] > 0)
+
+    def bce(logit, label):
+        return jnp.maximum(logit, 0) - logit * label \
+            + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    smooth = 1.0 / class_num if use_label_smooth and class_num > 1 else 0.0
+
+    total = jnp.zeros((n,), x.dtype)
+    obj_target = jnp.zeros((n, na, hh, ww), x.dtype)
+    obj_weight = jnp.zeros((n, na, hh, ww), x.dtype)
+    for local_a, global_a in enumerate(anchor_mask):
+        sel = valid & (best == global_a)                      # [N,B]
+        wgt = sel.astype(x.dtype) * gt_score
+        tx = gt_box[..., 0] * ww - gi
+        ty = gt_box[..., 1] * hh - gj
+        tw = jnp.log(jnp.clip(gw / all_anchors[global_a, 0], 1e-9))
+        th = jnp.log(jnp.clip(gh / all_anchors[global_a, 1], 1e-9))
+        scale = 2.0 - gt_box[..., 2] * gt_box[..., 3]
+        # raw logits for x/y (sigmoid cross-entropy, like the reference
+        # kernel); raw values for w/h (L1)
+        lxa = feats[:, local_a, 0]
+        lya = feats[:, local_a, 1]
+        pwa = pw[:, local_a]
+        pha = ph[:, local_a]
+
+        def gather_pred(p):
+            return jax.vmap(lambda pm, jj, ii: pm[jj, ii])(p, gj, gi)
+
+        lx = bce(gather_pred(lxa), tx) * scale
+        ly = bce(gather_pred(lya), ty) * scale
+        lw = jnp.abs(gather_pred(pwa) - tw) * scale
+        lh = jnp.abs(gather_pred(pha) - th) * scale
+        total = total + jnp.sum((lx + ly + lw + lh) * wgt, axis=1)
+        # class loss at positive cells
+        cls_at = jax.vmap(lambda pm, jj, ii: pm[:, jj, ii].T)(
+            pcls[:, local_a], gj, gi)                        # [N,B,class]
+        onehot = jax.nn.one_hot(gt_label, class_num, dtype=x.dtype)
+        onehot = onehot * (1 - smooth) + smooth / 2
+        lcls = jnp.sum(bce(cls_at, onehot), axis=-1)
+        total = total + jnp.sum(lcls * wgt, axis=1)
+        # objectness targets
+        tgt = jnp.zeros((n, hh, ww), x.dtype)
+        tgt = jax.vmap(lambda t_, jj, ii, ww_: t_.at[jj, ii].max(ww_))(
+            tgt, gj, gi, wgt)
+        obj_target = obj_target.at[:, local_a].set(tgt)
+        obj_weight = obj_weight.at[:, local_a].set(
+            jnp.ones((n, hh, ww), x.dtype))
+
+    # ignore region: predicted boxes with IoU > thresh vs any gt
+    gx_ = jnp.arange(ww, dtype=jnp.float32)
+    gy_ = jnp.arange(hh, dtype=jnp.float32)
+    bx = (px + gx_[None, None, None, :]) / ww
+    by = (py + gy_[None, None, :, None]) / hh
+    bw_ = jnp.exp(pw) * mask_anchors[None, :, 0, None, None] / input_size
+    bh_ = jnp.exp(ph) * mask_anchors[None, :, 1, None, None] / input_size
+    pb = jnp.stack([bx - bw_ / 2, by - bh_ / 2, bx + bw_ / 2, by + bh_ / 2],
+                   axis=-1).reshape(n, -1, 4)
+    gb = jnp.stack([gt_box[..., 0] - gt_box[..., 2] / 2,
+                    gt_box[..., 1] - gt_box[..., 3] / 2,
+                    gt_box[..., 0] + gt_box[..., 2] / 2,
+                    gt_box[..., 1] + gt_box[..., 3] / 2], axis=-1)
+
+    def iou_many(pb_i, gb_i, valid_i):
+        lt = jnp.maximum(pb_i[:, None, :2], gb_i[None, :, :2])
+        rb = jnp.minimum(pb_i[:, None, 2:], gb_i[None, :, 2:])
+        whi = jnp.clip(rb - lt, 0)
+        inter_ = whi[..., 0] * whi[..., 1]
+        a1 = ((pb_i[:, 2] - pb_i[:, 0]) * (pb_i[:, 3] - pb_i[:, 1]))[:, None]
+        a2 = ((gb_i[:, 2] - gb_i[:, 0]) * (gb_i[:, 3] - gb_i[:, 1]))[None, :]
+        iou = inter_ / (a1 + a2 - inter_ + 1e-9)
+        return jnp.max(jnp.where(valid_i[None, :], iou, 0.0), axis=1)
+
+    best_iou = jax.vmap(iou_many)(pb, gb, valid)
+    ignore = (best_iou > ignore_thresh).reshape(n, na, hh, ww)
+    noobj_w = jnp.where((obj_target == 0) & ignore, 0.0, 1.0)
+    lobj = bce(pobj, obj_target) * noobj_w * obj_weight
+    total = total + jnp.sum(lobj, axis=(1, 2, 3))
+    return total
+
+
+@op(name="matrix_nms")
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (reference vision/ops.py:2358; SOLOv2 parallel decay).
+    bboxes [N, M, 4], scores [N, C, M]; returns [N, keep_top_k, 6] padded
+    (label, decayed_score, x1, y1, x2, y2) plus rois_num (and index)."""
+    n, c, m = scores.shape
+
+    def per_image(box, sc):
+        # flatten classes (skip background)
+        cls_ids = jnp.arange(c)
+        keep_cls = cls_ids != background_label
+        s = jnp.where(keep_cls[:, None], sc, 0.0)
+        s = jnp.where(s > score_threshold, s, 0.0)          # [C, M]
+        flat = s.reshape(-1)
+        topk = min(nms_top_k, flat.shape[0])
+        vals, idx = jax.lax.top_k(flat, topk)
+        cls_of = idx // m
+        box_of = idx % m
+        bsel = box[box_of]                                   # [topk, 4]
+        iou = box_iou.__op_body__(bsel, bsel)
+        same_cls = cls_of[:, None] == cls_of[None, :]
+        upper = jnp.triu(jnp.ones((topk, topk), bool), 1)
+        # pair[i, j] = iou(suppressor i, victim j) for i < j (score-sorted)
+        pair = jnp.where(same_cls & upper, iou, 0.0)
+        # compensation: each suppressor's own max overlap with its betters
+        comp = jnp.max(pair, axis=0)
+        if use_gaussian:
+            d = jnp.exp(-(jnp.square(pair) - jnp.square(comp)[:, None])
+                        / gaussian_sigma)
+        else:
+            d = (1 - pair) / jnp.clip(1 - comp[:, None], 1e-9)
+        d = jnp.where(same_cls & upper, d, 1.0)
+        decay = jnp.min(d, axis=0)
+        new_scores = vals * decay
+        new_scores = jnp.where(new_scores >= post_threshold, new_scores, 0.0)
+        kk = topk if keep_top_k < 0 else min(keep_top_k, topk)
+        fvals, fidx = jax.lax.top_k(new_scores, kk)
+        out = jnp.concatenate([
+            cls_of[fidx][:, None].astype(box.dtype),
+            fvals[:, None], bsel[fidx]], axis=1)
+        num = jnp.sum(fvals > 0).astype(jnp.int32)
+        return out, num, box_of[fidx]
+
+    outs, nums, idxs = jax.vmap(per_image)(bboxes, scores)
+    if return_index:
+        return outs, nums, idxs
+    if return_rois_num:
+        return outs, nums
+    return outs
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Assign RoIs to FPN levels (reference vision/ops.py:1175; FPN paper
+    eq.1).  Host-side post-processing — eager only."""
+    import numpy as _np
+    rois = _np.asarray(fpn_rois.numpy() if hasattr(fpn_rois, "numpy")
+                       else fpn_rois)
+    off = 1.0 if pixel_offset else 0.0
+    ws = rois[:, 2] - rois[:, 0] + off
+    hs = rois[:, 3] - rois[:, 1] + off
+    scale = _np.sqrt(_np.clip(ws * hs, 0, None))
+    lvl = _np.floor(_np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = _np.clip(lvl, min_level, max_level).astype(_np.int64)
+    from ..framework.tensor import Tensor
+    # image id per roi, so per-level counts stay per-image (usable as
+    # boxes_num for downstream roi_align)
+    if rois_num is not None:
+        rn = _np.asarray(rois_num.numpy() if hasattr(rois_num, "numpy")
+                         else rois_num).astype(_np.int64)
+        img_of = _np.repeat(_np.arange(len(rn)), rn)
+        n_img = len(rn)
+    else:
+        img_of = _np.zeros(len(rois), _np.int64)
+        n_img = 1
+    multi_rois = []
+    rois_num_per_level = []
+    order = []
+    for L in range(min_level, max_level + 1):
+        idx = _np.where(lvl == L)[0]
+        multi_rois.append(Tensor(jnp.asarray(rois[idx])))
+        per_img = _np.bincount(img_of[idx], minlength=n_img).astype(_np.int32)
+        rois_num_per_level.append(Tensor(jnp.asarray(per_img)))
+        order.append(idx)
+    order = _np.concatenate(order) if order else _np.zeros((0,), _np.int64)
+    restore = _np.argsort(order).astype(_np.int32)
+    return multi_rois, Tensor(jnp.asarray(restore)), rois_num_per_level
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    """RPN proposal generation (reference vision/ops.py:2106) — decode
+    deltas on anchors, clip, filter small, NMS.  Host-side (eager only)."""
+    import numpy as _np
+    from ..framework.tensor import Tensor
+    sc = _np.asarray(scores.numpy() if hasattr(scores, "numpy") else scores)
+    bd = _np.asarray(bbox_deltas.numpy() if hasattr(bbox_deltas, "numpy")
+                     else bbox_deltas)
+    an = _np.asarray(anchors.numpy() if hasattr(anchors, "numpy")
+                     else anchors).reshape(-1, 4)
+    va = _np.asarray(variances.numpy() if hasattr(variances, "numpy")
+                     else variances).reshape(-1, 4)
+    imgs = _np.asarray(img_size.numpy() if hasattr(img_size, "numpy")
+                       else img_size)
+    n = sc.shape[0]
+    all_rois, all_probs, nums = [], [], []
+    off = 1.0 if pixel_offset else 0.0
+    for i in range(n):
+        s = sc[i].transpose(1, 2, 0).reshape(-1)
+        d = bd[i].transpose(1, 2, 0).reshape(-1, 4)
+        order = _np.argsort(-s)[:pre_nms_top_n]
+        s = s[order]
+        d = d[order]
+        a = an[order]
+        v = va[order]
+        aw = a[:, 2] - a[:, 0] + off
+        ah = a[:, 3] - a[:, 1] + off
+        ax = a[:, 0] + aw * 0.5
+        ay = a[:, 1] + ah * 0.5
+        cx = v[:, 0] * d[:, 0] * aw + ax
+        cy = v[:, 1] * d[:, 1] * ah + ay
+        cw = _np.exp(_np.clip(v[:, 2] * d[:, 2], None, 10)) * aw
+        ch = _np.exp(_np.clip(v[:, 3] * d[:, 3], None, 10)) * ah
+        boxes = _np.stack([cx - cw / 2, cy - ch / 2,
+                           cx + cw / 2 - off, cy + ch / 2 - off], axis=1)
+        hh, ww_ = imgs[i][0], imgs[i][1]
+        boxes[:, 0] = _np.clip(boxes[:, 0], 0, ww_ - off)
+        boxes[:, 1] = _np.clip(boxes[:, 1], 0, hh - off)
+        boxes[:, 2] = _np.clip(boxes[:, 2], 0, ww_ - off)
+        boxes[:, 3] = _np.clip(boxes[:, 3], 0, hh - off)
+        keep = ((boxes[:, 2] - boxes[:, 0] + off >= min_size)
+                & (boxes[:, 3] - boxes[:, 1] + off >= min_size))
+        boxes, s = boxes[keep], s[keep]
+        keep_mask = nms(jnp.asarray(boxes), nms_thresh, jnp.asarray(s))
+        km = _np.asarray(keep_mask._data if hasattr(keep_mask, "_data")
+                         else keep_mask)
+        idx = _np.where(km)[0]
+        idx = idx[_np.argsort(-s[idx])][:post_nms_top_n]
+        all_rois.append(boxes[idx])
+        all_probs.append(s[idx])
+        nums.append(len(idx))
+    rois = Tensor(jnp.asarray(_np.concatenate(all_rois, 0)
+                              if all_rois else _np.zeros((0, 4))))
+    probs = Tensor(jnp.asarray(_np.concatenate(all_probs, 0)
+                               if all_probs else _np.zeros((0,))))
+    nums_t = Tensor(jnp.asarray(_np.asarray(nums, _np.int32)))
+    if return_rois_num:
+        return rois, probs, nums_t
+    return rois, probs
+
+
+def read_file(filename, name=None):
+    """Read raw bytes into a uint8 tensor (reference vision/ops.py
+    read_file)."""
+    import numpy as _np
+    from ..framework.tensor import Tensor
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(jnp.asarray(_np.frombuffer(data, _np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to CHW uint8 (reference vision/ops.py
+    decode_jpeg binds nvjpeg; here PIL on host)."""
+    import io as _io
+    import numpy as _np
+    from ..framework.tensor import Tensor
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise RuntimeError(
+            "decode_jpeg needs Pillow on the host (nvjpeg has no TPU "
+            "analog)") from e
+    raw = bytes(_np.asarray(x.numpy() if hasattr(x, "numpy") else x,
+                            _np.uint8))
+    img = Image.open(_io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = _np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))
+
+
+class RoIAlign:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale)
+
+
+class RoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+class PSRoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+def _deform_conv_layer():
+    """Build the DeformConv2D Layer class lazily so vision.ops has no
+    import-time dependency on nn (package init imports nn first)."""
+    import math as _m
+    from ..nn.layer import Layer
+    from ..nn.initializer import Uniform
+
+    class DeformConv2D(Layer):
+        """Deformable conv layer (reference vision/ops.py DeformConv2D)."""
+
+        def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                     padding=0, dilation=1, deformable_groups=1, groups=1,
+                     weight_attr=None, bias_attr=None):
+            super().__init__()
+            kh, kw = _pair2(kernel_size)
+            fan_in = in_channels * kh * kw
+            bound = 1.0 / _m.sqrt(fan_in)
+            self.weight = self.create_parameter(
+                (out_channels, in_channels // groups, kh, kw),
+                attr=weight_attr,
+                default_initializer=Uniform(-bound, bound))
+            self.bias = None if bias_attr is False else \
+                self.create_parameter(
+                    (out_channels,), attr=bias_attr, is_bias=True,
+                    default_initializer=Uniform(-bound, bound))
+            self.args = (stride, padding, dilation, deformable_groups,
+                         groups)
+
+        def forward(self, x, offset, mask=None):
+            s, p, d, dg, g = self.args
+            return deform_conv2d(x, offset, self.weight, self.bias,
+                                 stride=s, padding=p, dilation=d,
+                                 deformable_groups=dg, groups=g, mask=mask)
+
+    return DeformConv2D
+
+
+class _LazyDeformConv2D:
+    _cls = None
+
+    def __new__(cls, *args, **kwargs):
+        if cls._cls is None:
+            cls._cls = _deform_conv_layer()
+        return cls._cls(*args, **kwargs)
+
+
+DeformConv2D = _LazyDeformConv2D

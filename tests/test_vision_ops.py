@@ -1,0 +1,218 @@
+"""Detection-op tests (reference: test/legacy_test/test_{roi_pool,box_coder,
+prior_box,yolo_box,deformable_conv}_op.py style — hand-computed references)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+rng = np.random.RandomState(21)
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestRoiPools:
+    def test_roi_pool_exact(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        boxes = np.array([[0.0, 0.0, 3.0, 3.0]], np.float32)
+        out = V.roi_pool(t(x), t(boxes), t(np.array([1], np.int32)),
+                         output_size=2).numpy()
+        # bins rows {0,1}x{2,3}, cols {0,1}x{2,3}: maxima 5,7,13,15
+        np.testing.assert_allclose(out[0, 0], [[5.0, 7.0], [13.0, 15.0]])
+
+    def test_psroi_pool(self):
+        # C = out_c(2) * 2 * 2 = 8
+        x = rng.randn(1, 8, 6, 6).astype(np.float32)
+        boxes = np.array([[0.0, 0.0, 6.0, 6.0]], np.float32)
+        out = V.psroi_pool(t(x), t(boxes), t(np.array([1], np.int32)),
+                           output_size=2).numpy()
+        assert out.shape == (1, 2, 2, 2)
+        # bin (0,0) of out channel 0 averages input channel 0 over rows 0-2
+        np.testing.assert_allclose(out[0, 0, 0, 0],
+                                   x[0, 0, :3, :3].mean(), rtol=1e-5)
+        # bin (0,1) of out channel 1 -> input channel (1*2+0)*2+1 = 5
+        np.testing.assert_allclose(out[0, 1, 0, 1],
+                                   x[0, 5, :3, 3:].mean(), rtol=1e-5)
+
+    def test_roi_align_runs(self):
+        x = rng.randn(2, 3, 8, 8).astype(np.float32)
+        boxes = np.array([[0, 0, 4, 4], [2, 2, 6, 6], [1, 1, 7, 7]],
+                         np.float32)
+        nums = np.array([2, 1], np.int32)
+        out = V.RoIAlign(output_size=3)(t(x), t(boxes), t(nums))
+        assert out.shape == [3, 3, 3, 3]
+
+
+class TestBoxCoder:
+    def test_encode_decode_roundtrip(self):
+        priors = np.array([[1.0, 1.0, 5.0, 5.0], [2.0, 2.0, 8.0, 8.0]],
+                          np.float32)
+        var = [0.1, 0.1, 0.2, 0.2]
+        targets = np.array([[2.0, 2.0, 6.0, 6.0]], np.float32)
+        enc = V.box_coder(t(priors), var, t(targets),
+                          code_type="encode_center_size").numpy()
+        assert enc.shape == (1, 2, 4)
+        dec = V.box_coder(t(priors), var, t(enc),
+                          code_type="decode_center_size", axis=1).numpy()
+        # decoding the encoding against the same priors recovers the target
+        np.testing.assert_allclose(dec[0, 0], targets[0], rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_allclose(dec[0, 1], targets[0], rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_encode_math(self):
+        priors = np.array([[0.0, 0.0, 4.0, 4.0]], np.float32)
+        targets = np.array([[1.0, 1.0, 3.0, 3.0]], np.float32)
+        enc = V.box_coder(t(priors), None, t(targets)).numpy()
+        # pw=ph=4, px=py=2; tw=th=2, tx=ty=2 -> ox=oy=0, ow=oh=log(0.5)
+        np.testing.assert_allclose(enc[0, 0], [0, 0, np.log(0.5),
+                                               np.log(0.5)], rtol=1e-5)
+
+
+class TestPriorBox:
+    def test_shapes_and_values(self):
+        feat = t(np.zeros((1, 8, 4, 4), np.float32))
+        img = t(np.zeros((1, 3, 32, 32), np.float32))
+        boxes, var = V.prior_box(feat, img, min_sizes=[8.0],
+                                 max_sizes=[16.0], aspect_ratios=[2.0],
+                                 flip=True, clip=True)
+        # priors per cell: ar 1 + 2 + 1/2 + max-size box = 4
+        assert boxes.shape == [4, 4, 4, 4]
+        assert var.shape == [4, 4, 4, 4]
+        b = boxes.numpy()
+        assert (b >= 0).all() and (b <= 1).all()
+        # first cell center is at (0.5*8, 0.5*8) = (4, 4): min box /32
+        np.testing.assert_allclose(b[0, 0, 0],
+                                   [(4 - 4) / 32, 0, (4 + 4) / 32, 8 / 32],
+                                   atol=1e-6)
+
+
+class TestYolo:
+    def test_yolo_box_shapes_and_decode(self):
+        n, na, cls, hw = 1, 2, 3, 4
+        x = np.zeros((n, na * (5 + cls), hw, hw), np.float32)
+        img = np.array([[64, 64]], np.int32)
+        boxes, scores = V.yolo_box(t(x), t(img),
+                                   anchors=[10, 14, 23, 27], class_num=cls,
+                                   conf_thresh=0.0, downsample_ratio=16)
+        assert boxes.shape == [1, na * hw * hw, 4]
+        assert scores.shape == [1, na * hw * hw, cls]
+        # zero logits: sigmoid=0.5 -> center of cell 0 at (0.5/4)*64 = 8
+        b0 = boxes.numpy()[0, 0]
+        cx = (b0[0] + b0[2]) / 2
+        cy = (b0[1] + b0[3]) / 2
+        np.testing.assert_allclose([cx, cy], [8.0, 8.0], atol=1e-3)
+
+    def test_yolo_loss_decreases(self):
+        n, na, cls, hw = 2, 3, 4, 4
+        x = paddle.to_tensor(
+            rng.randn(n, na * (5 + cls), hw, hw).astype(np.float32) * 0.1)
+        x.stop_gradient = False
+        gt_box = np.zeros((n, 2, 4), np.float32)
+        gt_box[:, 0] = [0.5, 0.5, 0.3, 0.4]
+        gt_label = np.zeros((n, 2), np.int64)
+        anchors = [10, 13, 16, 30, 33, 23]
+        loss = V.yolo_loss(x, t(gt_box), t(gt_label), anchors,
+                           anchor_mask=[0, 1, 2], class_num=cls,
+                           ignore_thresh=0.7, downsample_ratio=8)
+        assert loss.shape == [n]
+        l0 = float(loss.sum())
+        loss.sum().backward()
+        g = x.grad.numpy()
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+        x2 = paddle.to_tensor(x.numpy() - 0.5 * g)
+        l1 = float(V.yolo_loss(x2, t(gt_box), t(gt_label), anchors,
+                               anchor_mask=[0, 1, 2], class_num=cls,
+                               ignore_thresh=0.7,
+                               downsample_ratio=8).sum())
+        assert l1 < l0
+
+
+class TestDeformConv:
+    def test_zero_offset_matches_conv(self):
+        import paddle_tpu.nn.functional as F
+        x = rng.randn(1, 3, 8, 8).astype(np.float32)
+        w = rng.randn(4, 3, 3, 3).astype(np.float32)
+        offset = np.zeros((1, 2 * 9, 6, 6), np.float32)
+        ours = V.deform_conv2d(t(x), t(offset), t(w)).numpy()
+        ref = F.conv2d(t(x), t(w)).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
+
+    def test_integer_shift_offset(self):
+        # shifting every tap by +1 column == conv on shifted input
+        import paddle_tpu.nn.functional as F
+        x = rng.randn(1, 2, 7, 7).astype(np.float32)
+        w = rng.randn(2, 2, 3, 3).astype(np.float32)
+        offset = np.zeros((1, 2 * 9, 5, 5), np.float32)
+        offset[:, 1::2] = 1.0  # dx = +1 for every kernel point
+        ours = V.deform_conv2d(t(x), t(offset), t(w)).numpy()
+        ref = F.conv2d(t(np.roll(x, -1, axis=3)), t(w)).numpy()
+        # interior columns match (roll wraps at the border)
+        np.testing.assert_allclose(ours[..., :, :4], ref[..., :, :4],
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_mask_and_layer(self):
+        x = rng.randn(2, 4, 6, 6).astype(np.float32)
+        layer = V.DeformConv2D(4, 5, 3, padding=1, deformable_groups=2)
+        offset = np.zeros((2, 2 * 2 * 9, 6, 6), np.float32)
+        mask = np.ones((2, 2 * 9, 6, 6), np.float32) * 0.5
+        out = layer(t(x), t(offset), t(mask))
+        assert out.shape == [2, 5, 6, 6]
+        out2 = layer(t(x), t(offset))
+        np.testing.assert_allclose(out.numpy() * 2 - layer.bias.numpy()
+                                   .reshape(1, -1, 1, 1),
+                                   out2.numpy(), rtol=1e-3, atol=1e-4)
+
+
+class TestMatrixNmsProposals:
+    def test_matrix_nms_decay(self):
+        boxes = np.array([[[0, 0, 10, 10], [0, 0, 10, 10],
+                           [20, 20, 30, 30]]], np.float32)
+        scores = np.zeros((1, 2, 3), np.float32)
+        scores[0, 1] = [0.9, 0.8, 0.7]  # class 1 (0 is background)
+        out, nums = V.matrix_nms(t(boxes), t(scores), score_threshold=0.1,
+                                 nms_top_k=3, keep_top_k=3)
+        o = out.numpy()[0]
+        # top box keeps its score; the disjoint box is untouched; the
+        # perfect duplicate decays to ~0 (linear decay with iou=1)
+        assert int(nums.numpy()[0]) == 2
+        np.testing.assert_allclose(o[0, 1], 0.9, rtol=1e-5)
+        np.testing.assert_allclose(o[1, 1], 0.7, rtol=1e-5)
+        np.testing.assert_allclose(o[2, 1], 0.0, atol=1e-6)
+
+    def test_generate_proposals(self):
+        n, a, hh, ww = 1, 2, 4, 4
+        scores = rng.rand(n, a, hh, ww).astype(np.float32)
+        deltas = (rng.randn(n, a * 4, hh, ww) * 0.1).astype(np.float32)
+        anchors = rng.rand(hh, ww, a, 4).astype(np.float32) * 8
+        anchors[..., 2:] += 8
+        variances = np.ones((hh, ww, a, 4), np.float32)
+        rois, probs, nums = V.generate_proposals(
+            t(scores), t(deltas), t(np.array([[32.0, 32.0]], np.float32)),
+            t(anchors), t(variances), pre_nms_top_n=16, post_nms_top_n=5,
+            return_rois_num=True)
+        assert rois.shape[1] == 4
+        assert int(nums.numpy()[0]) == rois.shape[0] <= 5
+        assert probs.shape[0] == rois.shape[0]
+
+    def test_distribute_fpn(self):
+        rois = np.array([[0, 0, 10, 10],      # small -> low level
+                         [0, 0, 300, 300]],   # large -> high level
+                        np.float32)
+        multi, restore, nums = V.distribute_fpn_proposals(
+            t(rois), 2, 5, 4, 224)
+        assert len(multi) == 4 and len(nums) == 4
+        total = sum(int(x.numpy()[0]) for x in nums)
+        assert total == 2
+        r = restore.numpy()
+        assert sorted(r.tolist()) == [0, 1]
+
+
+class TestIOOps:
+    def test_read_file_roundtrip(self, tmp_path):
+        p = tmp_path / "blob.bin"
+        data = bytes(range(256))
+        p.write_bytes(data)
+        out = V.read_file(str(p))
+        assert out.numpy().tobytes() == data
