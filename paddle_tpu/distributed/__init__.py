@@ -22,8 +22,17 @@ from . import launch
 from . import checkpoint
 from .checkpoint import save_state_dict, load_state_dict
 from .fleet.meta_parallel.parallel_wrappers import DataParallel
+from .fleet.base import ParallelMode
 from . import pipelining
 from .store import TCPStore, create_or_get_global_tcp_store
+from . import io
+from .compat import (
+    ReduceType, Strategy, DistAttr, DistModel, to_static, alltoall_single,
+    gather, broadcast_object_list, scatter_object_list,
+    destroy_process_group, get_backend, is_available,
+    gloo_init_parallel_env, gloo_barrier, gloo_release, spawn, split,
+    dtensor_from_fn, shard_dataloader, shard_scaler, InMemoryDataset,
+    QueueDataset, CountFilterEntry, ProbabilityEntry, ShowClickEntry)
 
 __all__ = [
     "env", "get_rank", "get_world_size", "init_parallel_env", "ParallelEnv",
@@ -38,4 +47,11 @@ __all__ = [
     "checkpoint", "save_state_dict", "load_state_dict", "DataParallel",
     "sharding_constraint", "annotate", "get_placements", "TCPStore",
     "create_or_get_global_tcp_store",
+    "ParallelMode", "ReduceType", "Strategy", "DistAttr", "DistModel",
+    "to_static", "alltoall_single", "gather", "broadcast_object_list",
+    "scatter_object_list", "destroy_process_group", "get_backend",
+    "is_available", "gloo_init_parallel_env", "gloo_barrier",
+    "gloo_release", "spawn", "split", "dtensor_from_fn",
+    "shard_dataloader", "shard_scaler", "InMemoryDataset", "QueueDataset",
+    "CountFilterEntry", "ProbabilityEntry", "ShowClickEntry", "io",
 ]

@@ -1,0 +1,365 @@
+"""paddle.distributed namespace completion (reference:
+python/paddle/distributed/__init__.py exports): object collectives,
+process-group introspection, spawn, auto-parallel Strategy/DistModel/
+to_static, PS-era dataset/entry configs."""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+__all__ = [
+    "ReduceType", "Strategy", "DistAttr", "DistModel", "to_static",
+    "alltoall_single", "gather", "broadcast_object_list",
+    "scatter_object_list", "destroy_process_group", "get_backend",
+    "is_available", "gloo_init_parallel_env", "gloo_barrier",
+    "gloo_release", "spawn", "split", "dtensor_from_fn", "shard_dataloader",
+    "shard_scaler", "InMemoryDataset", "QueueDataset", "CountFilterEntry",
+    "ProbabilityEntry", "ShowClickEntry",
+]
+
+
+class ReduceType:
+    """Reduce kinds for dist.reshard Partial placements (reference
+    paddle/phi/core/distributed/auto_parallel/dist_attr.h ReduceType)."""
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+class Strategy:
+    """Auto-parallel strategy bundle (reference
+    distributed/auto_parallel/strategy.py): config groups are attribute
+    namespaces with an `enable` toggle."""
+
+    class _Config:
+        def __init__(self, **defaults):
+            self.__dict__.update(defaults)
+
+    def __init__(self, config=None):
+        config = config or {}
+
+        def cfg(key, **defaults):
+            return Strategy._Config(**{**defaults, **config.get(key, {})})
+
+        self.sharding = cfg("sharding", enable=False, stage=1, degree=8)
+        self.fused_passes = cfg("fused_passes", enable=False, fused_ops=[])
+        self.gradient_merge = cfg("gradient_merge", enable=False, k_steps=1,
+                                  avg=True)
+        self.pipeline = cfg("pipeline", enable=False, schedule_mode="1F1B",
+                            micro_batch_size=1, accumulate_steps=1)
+        self.amp = cfg("amp", enable=False, dtype="bfloat16", level="O1")
+        self.recompute = cfg("recompute", enable=False)
+
+
+class DistAttr:
+    """Tensor distributed attribute: mesh + per-dim placements (reference
+    paddle/phi/core/distributed/auto_parallel/dist_attr.h TensorDistAttr)."""
+
+    def __init__(self, mesh, sharding_specs):
+        self.process_mesh = mesh
+        self.sharding_specs = list(sharding_specs)
+
+    def __repr__(self):
+        return (f"DistAttr(mesh={self.process_mesh}, "
+                f"sharding_specs={self.sharding_specs})")
+
+
+class DistModel:
+    """Static-graph-style driver over a sharded model (reference
+    distributed/auto_parallel/api.py:2110 DistModel over Engine): holds
+    model/loss/optimizer, mode switching, callable step."""
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy=None, metrics=None):
+        self.network = layer
+        self._loader = loader
+        self._loss = loss
+        self._optimizer = optimizer
+        self._strategy = strategy or Strategy()
+        self._mode = "train" if optimizer is not None else "predict"
+
+    def train(self):
+        self._mode = "train"
+        self.network.train()
+
+    def eval(self):
+        self._mode = "eval"
+        self.network.eval()
+
+    def predict(self):
+        self._mode = "predict"
+        self.network.eval()
+
+    def __call__(self, *args):
+        if self._mode == "predict" or self._loss is None:
+            return self.network(*args)
+        *inputs, label = args
+        out = self.network(*inputs)
+        loss = self._loss(out, label)
+        if self._mode == "train" and self._optimizer is not None:
+            loss.backward()
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        return loss
+
+    def state_dict(self, mode="all"):
+        state = dict(self.network.state_dict())
+        if mode in ("all", "opt") and self._optimizer is not None:
+            state.update({f"opt.{k}": v for k, v in
+                          self._optimizer.state_dict().items()})
+        return state
+
+    def set_state_dict(self, state):
+        opt_state = {k[4:]: v for k, v in state.items()
+                     if k.startswith("opt.")}
+        net_state = {k: v for k, v in state.items()
+                     if not k.startswith("opt.")}
+        self.network.set_state_dict(net_state)
+        if opt_state and self._optimizer is not None:
+            self._optimizer.set_state_dict(opt_state)
+
+    def dist_main_program(self, mode=None):
+        raise NotImplementedError(
+            "there is no per-rank Program artifact: the jitted SPMD step "
+            "is the compiled form (export via paddle.jit.save / StableHLO)")
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    """dist.to_static (reference auto_parallel/api.py:2693): wrap a sharded
+    layer into a DistModel driver."""
+    return DistModel(layer, loader, loss, optimizer, strategy)
+
+
+# --------------------------------------------------------- collectives etc.
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """Single-tensor all-to-all (reference communication/all_to_all.py
+    alltoall_single).  Single-process groups: identity copy."""
+    from .collective import all_to_all
+    n = 1 if group is None else max(len(getattr(group, "ranks", [0])), 1)
+    if n <= 1:
+        out_tensor._data = (in_tensor._data if isinstance(in_tensor, Tensor)
+                            else jnp.asarray(in_tensor))
+        return out_tensor
+    chunks = jnp.split(in_tensor._data, n, axis=0)
+    gathered = all_to_all([Tensor(c) for c in chunks], group=group)
+    out_tensor._data = jnp.concatenate(
+        [g._data for g in gathered], axis=0)
+    return out_tensor
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Gather tensors to dst (reference communication/gather.py).  Over a
+    mesh this is all_gather + keep-on-dst."""
+    from .collective import all_gather
+    from .env import get_rank
+    tensors = []
+    all_gather(tensors, tensor, group=group)
+    if get_rank() == dst and gather_list is not None:
+        gather_list.extend(tensors)
+    return gather_list
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """(reference communication/broadcast.py broadcast_object_list);
+    single-process group: already consistent."""
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    from .env import get_rank, get_world_size
+    if in_object_list:
+        n = max(get_world_size(), 1)
+        per = max(len(in_object_list) // n, 1)
+        r = get_rank()
+        out_object_list.extend(in_object_list[r * per:(r + 1) * per])
+    return out_object_list
+
+
+def destroy_process_group(group=None):
+    """(reference distributed/collective.py destroy_process_group)"""
+    from . import collective as _c
+    if group is None:
+        _c._groups.clear()
+        _c._default_group = None
+    else:
+        _c._groups.pop(getattr(group, "id", None), None)
+    return None
+
+
+def get_backend(group=None):
+    return "XCCL_TPU" if jax.default_backend() == "tpu" else "GLOO"
+
+
+def is_available():
+    return True
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """CPU-barrier env (reference parallel.py gloo_init_parallel_env) —
+    the coordination-service TCPStore plays gloo's role."""
+    from .store import create_or_get_global_tcp_store
+    create_or_get_global_tcp_store()
+
+
+def gloo_barrier():
+    from .collective import barrier
+    barrier()
+
+
+def gloo_release():
+    return None
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Launch ``func`` on nprocs local worker processes (reference
+    distributed/spawn.py).  Workers rendezvous through the same
+    env-variable contract as distributed.launch."""
+    import multiprocessing as mp
+
+    if nprocs == -1:
+        nprocs = int(os.environ.get("PADDLE_WORLD_SIZE", 1)) or 1
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        env = {"PADDLE_TRAINER_ID": str(rank),
+               "PADDLE_WORLD_SIZE": str(nprocs)}
+        p = ctx.Process(target=_spawn_worker,
+                        args=(func, args, env), daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        bad = [p.exitcode for p in procs if p.exitcode != 0]
+        if bad:
+            raise RuntimeError(f"spawned workers failed: exitcodes {bad}")
+    return procs
+
+
+def _spawn_worker(func, args, env):
+    os.environ.update(env)
+    func(*args)
+
+
+def split(x, size, num_partitions=1, operation="linear", axis=0,
+          gather_out=True, weight_attr=None, bias_attr=None, name=None):
+    """Model-parallel split op (reference distributed/collective.py split):
+    builds the corresponding fleet mp layer over the current mesh."""
+    from .fleet import mp_layers as _mp
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 1:
+            layer = _mp.ColumnParallelLinear(
+                in_f, out_f, weight_attr=weight_attr,
+                has_bias=bias_attr is not False, gather_output=gather_out)
+        else:
+            layer = _mp.RowParallelLinear(
+                in_f, out_f, weight_attr=weight_attr,
+                has_bias=bias_attr is not False,
+                input_is_parallel=False)
+        return layer(x)
+    if operation == "embedding":
+        vocab, dim = size
+        layer = _mp.VocabParallelEmbedding(vocab, dim,
+                                           weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError(f"unknown operation {operation!r}")
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    """(reference auto_parallel/api.py dtensor_from_fn): run a creation fn
+    then shard the result."""
+    from .auto_parallel.api import shard_tensor
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def shard_dataloader(dataloader, meshes, shard_dims=None, input_keys=None):
+    """(reference auto_parallel/api.py:3208): yield batches with tensors
+    sharded over the mesh's data axis."""
+    from .auto_parallel.api import shard_tensor
+    from .placement import Shard, Replicate
+    mesh = meshes[0] if isinstance(meshes, (list, tuple)) else meshes
+
+    class _ShardedLoader:
+        def __init__(self, loader):
+            self._loader = loader
+
+        def __len__(self):
+            return len(self._loader)
+
+        def __iter__(self):
+            for batch in self._loader:
+                yield jax.tree_util.tree_map(
+                    lambda t: shard_tensor(
+                        t, mesh,
+                        [Shard(0)] + [Replicate()] * 0) if isinstance(
+                            t, Tensor) else t,
+                    batch, is_leaf=lambda t: isinstance(t, Tensor))
+
+    return _ShardedLoader(dataloader)
+
+
+def shard_scaler(scaler):
+    """(reference auto_parallel/api.py shard_scaler): our GradScaler's
+    found-inf reduction already runs in the sharded step; pass-through."""
+    return scaler
+
+
+# ------------------------------------------------------ PS-era data configs
+
+class _EntryBase:
+    def __init__(self, *a):
+        self._args = a
+
+
+class CountFilterEntry(_EntryBase):
+    """Sparse-feature admission by count (reference
+    distributed/entry_attr.py)."""
+
+    def __init__(self, count_filter):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        super().__init__(count_filter)
+        self.count_filter = count_filter
+
+
+class ProbabilityEntry(_EntryBase):
+    def __init__(self, probability):
+        if not 0 <= probability <= 1:
+            raise ValueError("probability must be in [0, 1]")
+        super().__init__(probability)
+        self.probability = probability
+
+
+class ShowClickEntry(_EntryBase):
+    def __init__(self, show_name, click_name):
+        super().__init__(show_name, click_name)
+        self.show_name = show_name
+        self.click_name = click_name
+
+
+def _ps_dataset_stub(name):
+    class _Stub:
+        def __init__(self, *a, **k):
+            raise NotImplementedError(
+                f"{name} belongs to the parameter-server data path "
+                "(reference distributed/fleet/dataset); on TPU use "
+                "paddle.io.DataLoader with the shm-ring workers")
+    _Stub.__name__ = name
+    return _Stub
+
+
+InMemoryDataset = _ps_dataset_stub("InMemoryDataset")
+QueueDataset = _ps_dataset_stub("QueueDataset")

@@ -1,0 +1,48 @@
+"""paddle.distributed.io (reference: python/paddle/distributed/io.py —
+persistables save/load for static distributed programs; here thin wrappers
+over the framework state-dict IO)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["save_persistables", "load_persistables",
+           "save_inference_model_distributed", "is_persistable"]
+
+
+def is_persistable(var):
+    return getattr(var, "persistable", True)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """Save all parameters of a static Program (reference io.py
+    save_persistables)."""
+    from ..framework.io import save
+    if main_program is None:
+        from ..static import default_main_program
+        main_program = default_main_program()
+    state = {p.name: p for p in main_program.all_parameters()}
+    os.makedirs(dirname, exist_ok=True)
+    save(state, os.path.join(dirname, filename or "__params__.pdparams"))
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    from ..framework.io import load
+    if main_program is None:
+        from ..static import default_main_program
+        main_program = default_main_program()
+    state = load(os.path.join(dirname, filename or "__params__.pdparams"))
+    for p in main_program.all_parameters():
+        if p.name in state:
+            val = state[p.name]
+            p.set_value(val)
+
+
+def save_inference_model_distributed(dirname, feeded_var_names,
+                                     target_vars, executor,
+                                     main_program=None, **kwargs):
+    from ..static import save_inference_model, default_main_program
+    prog = main_program or default_main_program()
+    feed_vars = [prog.vars[n] if isinstance(n, str) else n
+                 for n in feeded_var_names]
+    path = os.path.join(dirname, "model")
+    return save_inference_model(path, feed_vars, target_vars, executor)

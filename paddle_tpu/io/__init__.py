@@ -16,3 +16,22 @@ __all__ = ["Dataset", "IterableDataset", "TensorDataset", "ChainDataset",
            "Sampler", "SequenceSampler", "RandomSampler", "BatchSampler",
            "DistributedBatchSampler", "WeightedRandomSampler",
            "SubsetRandomSampler", "DataLoader", "default_collate_fn"]
+
+
+class WorkerInfo:
+    """Info for the current DataLoader worker (reference
+    python/paddle/io/dataloader/worker.py get_worker_info)."""
+
+    def __init__(self, id, num_workers, seed, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.seed = seed
+        self.dataset = dataset
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    """Returns None in the main process, WorkerInfo inside a worker."""
+    return _worker_info

@@ -64,6 +64,11 @@ class ShmWorkerPool:
         _set_pdeathsig()
         task_ring = ShmRing(self._task_ring.name)
         res_ring = ShmRing(self._res_ring.name)
+        import paddle_tpu.io as _io
+        _io._worker_info = _io.WorkerInfo(
+            id=wid, num_workers=self.num_workers,
+            seed=getattr(self, "_base_seed", 0) + wid,
+            dataset=self.dataset)
         if self._worker_init_fn is not None:
             self._worker_init_fn(wid)
         while True:

@@ -983,3 +983,26 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
 
 # surface part 2 (3d pools, unpool, transposed convs, ctc/rnnt/... losses)
 from .functional_extra import *  # noqa: E402,F401,F403
+from .functional_extra2 import *  # noqa: E402,F401,F403
+
+# paddle-shaped aliases / in-place functional forms
+from ..ops.manipulation import pad  # noqa: E402,F401
+unfold = unfold_  # noqa: E402  (im2col; `unfold_` kept for back-compat)
+
+
+def _make_functional_inplace(fn):
+    def inplace(x, *args, **kwargs):
+        out = fn(x, *args, **kwargs)
+        return x._rebind_(out)
+    inplace.__name__ = fn.__name__ + "_"
+    return inplace
+
+
+relu_ = _make_functional_inplace(relu)
+elu_ = _make_functional_inplace(elu)
+tanh_ = _make_functional_inplace(tanh)
+softmax_ = _make_functional_inplace(softmax)
+leaky_relu_ = _make_functional_inplace(leaky_relu)
+hardtanh_ = _make_functional_inplace(hardtanh)
+from .functional_extra import thresholded_relu as _thr  # noqa: E402
+thresholded_relu_ = _make_functional_inplace(_thr)

@@ -1,0 +1,416 @@
+"""nn.functional, part 3 — vision warps, ArcFace ops, beam-search utils,
+flash-attention packed/masked entry points (reference:
+python/paddle/nn/functional/{vision,common,extension,loss,flash_attention}.py).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.registry import op
+from ..framework import random as _random
+from .functional import _reduce, scaled_dot_product_attention
+
+__all__ = [
+    "affine_grid", "grid_sample", "channel_shuffle", "zeropad2d",
+    "sequence_mask", "gather_tree", "dice_loss", "sigmoid_focal_loss",
+    "pairwise_distance", "class_center_sample", "margin_cross_entropy",
+    "adaptive_log_softmax_with_loss", "flash_attn_qkvpacked",
+    "flash_attn_varlen_qkvpacked", "flashmask_attention", "sparse_attention",
+]
+
+
+# ------------------------------------------------------------ vision warps
+
+@op
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """Affine sampling grid (reference nn/functional/vision.py:38;
+    phi/kernels/impl/affine_grid_kernel_impl.h)."""
+    out_shape = [int(s) for s in np.asarray(out_shape).reshape(-1)]
+    nd = len(out_shape) - 2  # 2 (HW) or 3 (DHW)
+
+    def axis_coords(n):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, n)
+        step = 2.0 / n
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, n)
+
+    if nd == 2:
+        n, _, h, w = out_shape
+        ys = axis_coords(h)
+        xs = axis_coords(w)
+        xg, yg = jnp.meshgrid(xs, ys, indexing="xy")
+        ones = jnp.ones_like(xg)
+        base = jnp.stack([xg, yg, ones], axis=-1)      # [H, W, 3]
+        return jnp.einsum("hwk,nck->nhwc", base, theta)
+    n, _, d, h, w = out_shape
+    zs = axis_coords(d)
+    ys = axis_coords(h)
+    xs = axis_coords(w)
+    zg, yg, xg = jnp.meshgrid(zs, ys, xs, indexing="ij")
+    ones = jnp.ones_like(xg)
+    base = jnp.stack([xg, yg, zg, ones], axis=-1)      # [D, H, W, 4]
+    return jnp.einsum("dhwk,nck->ndhwc", base, theta)
+
+
+@op
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample x at normalized grid locations (reference
+    nn/functional/vision.py:140; phi grid_sample kernels).  4-D and 5-D."""
+    nd = x.ndim - 2
+
+    def unnorm(g, size):
+        if align_corners:
+            return (g + 1.0) / 2.0 * (size - 1)
+        return ((g + 1.0) * size - 1.0) / 2.0
+
+    def reflect(v, size):
+        if align_corners:
+            span = 2 * (size - 1)
+            v = jnp.abs(v) % jnp.maximum(span, 1)
+            return jnp.where(v > size - 1, span - v, v)
+        # reflect around the -0.5 / size-0.5 pixel borders
+        span = 2 * size
+        v = jnp.abs(v + 0.5) % span
+        v = jnp.minimum(v, span - v) - 0.5
+        return jnp.clip(v, 0, size - 1)
+
+    def resolve(v, size):
+        if padding_mode == "border":
+            return jnp.clip(v, 0, size - 1), None
+        if padding_mode == "reflection":
+            return reflect(v, size), None
+        valid = (v >= -1) & (v <= size)
+        return v, valid  # zeros handled by corner validity below
+
+    sizes = x.shape[2:]
+    coords = [unnorm(grid[..., i], sizes[nd - 1 - i]) for i in range(nd)]
+    coords = coords[::-1]  # now ordered like spatial dims (d, h, w)/(h, w)
+
+    if mode == "nearest":
+        idxs = []
+        for v, size in zip(coords, sizes):
+            if padding_mode != "zeros":
+                v, _ = resolve(v, size)
+            v = jnp.round(v)
+            vi = jnp.clip(v, 0, size - 1).astype(jnp.int32)
+            idxs.append((vi, (v >= 0) & (v <= size - 1)))
+        valid = jnp.ones(idxs[0][0].shape, bool)
+        for _, vl in idxs:
+            valid &= vl
+        def gather_n(img, *ii):
+            return img[(slice(None),) + tuple(ii)]
+        out = jax.vmap(gather_n)(x, *[i for i, _ in idxs])
+        if padding_mode == "zeros":
+            out = jnp.where(
+                jnp.expand_dims(valid, 1), out, jnp.zeros((), x.dtype))
+        return out
+
+    # bilinear / trilinear: accumulate the 2^nd corners
+    lo_w = []
+    for v, size in zip(coords, sizes):
+        if padding_mode != "zeros":
+            v, _ = resolve(v, size)
+        v0 = jnp.floor(v)
+        lo_w.append((v0, v - v0))
+    out = 0.0
+    for corner in range(2 ** nd):
+        idxs, wgt, valid = [], 1.0, True
+        for axis in range(nd):
+            hi = (corner >> axis) & 1
+            v0, frac = lo_w[axis]
+            vv = v0 + hi
+            size = sizes[axis]
+            valid = valid & (vv >= 0) & (vv <= size - 1)
+            idxs.append(jnp.clip(vv, 0, size - 1).astype(jnp.int32))
+            wgt = wgt * (frac if hi else (1 - frac))
+        def gather_c(img, *ii):
+            return img[(slice(None),) + tuple(ii)]
+        vals = jax.vmap(gather_c)(x, *idxs)
+        w_eff = jnp.where(valid, wgt, 0.0) if padding_mode == "zeros" \
+            else wgt
+        out = out + vals * jnp.expand_dims(w_eff, 1)
+    return out
+
+
+@op
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        return x.reshape(n, groups, c // groups, h, w) \
+            .swapaxes(1, 2).reshape(n, c, h, w)
+    n, h, w, c = x.shape
+    return x.reshape(n, h, w, groups, c // groups) \
+        .swapaxes(3, 4).reshape(n, h, w, c)
+
+
+@op
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    from ..ops.manipulation import pad as _pad
+    return _pad.__op_body__(x, padding, mode="constant", value=0.0,
+                            data_format=data_format)
+
+
+# --------------------------------------------------------- sequence utils
+
+@op
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    if maxlen is None:
+        maxlen = int(jnp.max(x))
+    from ..framework.dtype import to_np_dtype
+    rng_ = jnp.arange(maxlen)
+    return (rng_ < x[..., None]).astype(to_np_dtype(dtype))
+
+
+@op
+def gather_tree(ids, parents, name=None):
+    """Beam-search backtrace (reference nn/functional/extension.py:149;
+    phi/kernels/cpu/gather_tree_kernel.cc).  ids/parents:
+    [max_time, batch, beam]."""
+    T = ids.shape[0]
+
+    def step(carry, inp):
+        beam_idx, t = carry, inp
+        id_t = jnp.take_along_axis(ids[t], beam_idx, axis=-1)
+        parent_t = jnp.take_along_axis(parents[t], beam_idx, axis=-1)
+        return parent_t, id_t
+
+    init = jnp.broadcast_to(jnp.arange(ids.shape[2]), ids.shape[1:])
+    _, out_rev = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+    return out_rev[::-1]
+
+
+# ----------------------------------------------------------------- losses
+
+@op
+def dice_loss(input, label, epsilon=1e-05, name=None):
+    """(reference nn/functional/loss.py:50): input [.., D] probabilities,
+    label [.., 1] class ids."""
+    d = input.shape[-1]
+    one = jax.nn.one_hot(label[..., 0], d, dtype=input.dtype)
+    reduce_dims = tuple(range(1, input.ndim))
+    inter = jnp.sum(input * one, axis=reduce_dims)
+    union = jnp.sum(input, axis=reduce_dims) + jnp.sum(one, axis=reduce_dims)
+    dice = (2 * inter + epsilon) / (union + epsilon)
+    return jnp.mean(1 - dice)
+
+
+@op
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25,
+                       gamma=2.0, reduction="sum", name=None):
+    """(reference nn/functional/loss.py:3262)."""
+    p = jax.nn.sigmoid(logit)
+    ce = jnp.maximum(logit, 0) - logit * label \
+        + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    p_t = p * label + (1 - p) * (1 - label)
+    loss = ce * ((1 - p_t) ** gamma)
+    if alpha >= 0:
+        a_t = alpha * label + (1 - alpha) * (1 - label)
+        loss = a_t * loss
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+@op
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    d = x - y + epsilon
+    return jnp.linalg.norm(jnp.abs(d), ord=p, axis=-1, keepdims=keepdim) \
+        if p != 2.0 else jnp.sqrt(
+            jnp.sum(jnp.square(d), axis=-1, keepdims=keepdim))
+
+
+# ------------------------------------------------------------- ArcFace ops
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers for partial-FC training (reference
+    nn/functional/common.py:2372; phi class_center_sample kernel).
+    Returns (remapped_label, sampled_class_indices).  Host-side sampling —
+    eager only."""
+    import numpy as _np
+    from ..framework.tensor import Tensor
+    lab = _np.asarray(label.numpy() if hasattr(label, "numpy") else label)
+    pos = _np.unique(lab)
+    n_extra = max(int(num_samples) - len(pos), 0)
+    rest = _np.setdiff1d(_np.arange(num_classes), pos)
+    rng_ = _np.random.default_rng(int(_np.abs(lab).sum()) + num_classes)
+    neg = rng_.choice(rest, size=min(n_extra, len(rest)), replace=False) \
+        if n_extra else _np.zeros((0,), lab.dtype)
+    sampled = _np.concatenate([pos, _np.sort(neg)]).astype(lab.dtype)
+    remap = {c: i for i, c in enumerate(sampled.tolist())}
+    remapped = _np.asarray([remap[int(c)] for c in lab], lab.dtype)
+    return Tensor(jnp.asarray(remapped)), Tensor(jnp.asarray(sampled))
+
+
+@op
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """ArcFace/CosFace margin softmax CE (reference nn/functional/
+    loss.py:2183; phi margin_cross_entropy kernel): logits are cos(theta),
+    target class gets cos(m1*theta + m2) - m3 before scaling."""
+    n, c = logits.shape
+    cos_t = jnp.clip(logits, -1.0, 1.0)
+    theta = jnp.arccos(cos_t)
+    target_logit = jnp.cos(margin1 * theta + margin2) - margin3
+    one = jax.nn.one_hot(label, c, dtype=logits.dtype)
+    adjusted = jnp.where(one > 0, target_logit, cos_t) * scale
+    logp = jax.nn.log_softmax(adjusted, axis=-1)
+    loss = -jnp.sum(one * logp, axis=-1, keepdims=True)
+    loss = _reduce(loss, reduction)
+    if return_softmax:
+        return loss, jnp.exp(logp)
+    return loss
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """Functional form of AdaptiveLogSoftmaxWithLoss (reference
+    nn/functional/activation.py adaptive_log_softmax_with_loss)."""
+    import paddle_tpu
+    from .functional import linear, log_softmax
+    cutoffs = list(cutoffs)
+    shortlist = cutoffs[0]
+    n_clusters = len(cutoffs) - 1 if cutoffs[-1] >= shortlist else 0
+    head_lp = log_softmax(linear(input, head_weight, head_bias), axis=-1)
+    lab = label.astype("int32")
+    in_head = (lab < shortlist).astype("float32")
+    safe = lab.clip(0, shortlist - 1)
+    out = head_lp.take_along_axis(safe.reshape((-1, 1)), 1).reshape((-1,)) \
+        * in_head
+    for i in range(len(tail_weights)):
+        lo = cutoffs[i]
+        hi = cutoffs[i + 1]
+        mask = ((lab >= lo).astype("float32")
+                * (lab < hi).astype("float32"))
+        rel = (lab - lo).clip(0, hi - lo - 1)
+        h = input
+        for w in tail_weights[i]:
+            h = h.matmul(w)
+        tail_lp = log_softmax(h, axis=-1)
+        take = tail_lp.take_along_axis(rel.reshape((-1, 1)), 1).reshape((-1,))
+        out = out + (head_lp[:, shortlist + i] + take) * mask
+    return out, -(out.mean())
+
+
+# ------------------------------------------------------- flash attn surface
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False,
+                         return_softmax=False, *, fixed_seed_offset=None,
+                         rng_name="", training=True, name=None):
+    """Packed-QKV flash attention (reference flash_attention.py:399).
+    qkv: [batch, seqlen, 3, num_heads, head_dim] -> (out, softmax)."""
+    if return_softmax:
+        raise NotImplementedError(
+            "return_softmax=True requires materializing the [S, S] matrix "
+            "the flash kernel exists to avoid")
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    out = scaled_dot_product_attention(q, k, v, dropout_p=dropout,
+                                       is_causal=causal, training=training)
+    return out, None
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q, max_seqlen_k, scale=None,
+                                dropout=0.0, causal=False,
+                                return_softmax=False, varlen_padded=True,
+                                **kw):
+    """Varlen packed flash attention (reference flash_attention.py):
+    total-token layout [total, 3, heads, dim] with cu_seqlens offsets.
+    Computed per sequence via segment masking."""
+    import paddle_tpu
+    if return_softmax:
+        raise NotImplementedError("return_softmax not supported")
+    cu = np.asarray(cu_seqlens_q.numpy() if hasattr(cu_seqlens_q, "numpy")
+                    else cu_seqlens_q).reshape(-1)
+    head_dim = int(qkv.shape[-1])
+    # sdpa scales by 1/sqrt(d); realize a custom scale by pre-scaling q
+    q_mult = (scale * math.sqrt(head_dim)) if scale is not None else 1.0
+    outs = []
+    for i in range(len(cu) - 1):
+        seg = qkv[int(cu[i]):int(cu[i + 1])]
+        q = seg[:, 0][None] * q_mult
+        k = seg[:, 1][None]
+        v = seg[:, 2][None]
+        o = scaled_dot_product_attention(q, k, v, dropout_p=dropout,
+                                         is_causal=causal)
+        outs.append(o[0])
+    return paddle_tpu.concat(outs, axis=0), None
+
+
+@op
+def flashmask_attention(query, key, value, startend_row_indices=None, *,
+                        dropout=0.0, causal=False, window_size=None,
+                        return_softmax_lse=False, return_seed_offset=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """FlashMask attention (reference flash_attention.py:1098): column-wise
+    sparse mask given as start/end row indices per key column.  Realized as
+    a dense additive bias into the fused SDPA; XLA keeps it on-chip."""
+    if return_softmax_lse or return_seed_offset:
+        raise NotImplementedError("lse/seed outputs not supported")
+    b, sq, hq, d = query.shape
+    sk = key.shape[1]
+    if startend_row_indices is None:
+        from ..ops.pallas import flash_attention as _fa
+        return _fa.sdpa(query, key, value, dropout_p=dropout,
+                        is_causal=causal, training=training)
+    idx = startend_row_indices  # [B, H or 1, Sk, k]
+    kdim = idx.shape[-1]
+    rows = jnp.arange(sq)[:, None]                      # i (query/row)
+    if causal:
+        if kdim == 1:
+            lts = idx[..., 0]                           # [B,H,Sk]
+            masked = rows[None, None] >= lts[:, :, None, :]
+        elif kdim == 2:
+            lts = idx[..., 0]
+            lte = idx[..., 1]
+            masked = ((rows[None, None] >= lts[:, :, None, :])
+                      & (rows[None, None] < lte[:, :, None, :]))
+        else:
+            raise ValueError("causal flashmask expects 1 or 2 indices")
+        cols = jnp.arange(sk)[None, :]
+        causal_mask = rows < cols                       # future masked
+        masked = masked | causal_mask[None, None]
+    else:
+        if kdim == 2:
+            lts = idx[..., 0]
+            ute = idx[..., 1]
+            masked = ((rows[None, None] >= lts[:, :, None, :])
+                      | (rows[None, None] < ute[:, :, None, :]))
+        elif kdim == 4:
+            lts, lte, uts, ute = (idx[..., i] for i in range(4))
+            masked = (((rows[None, None] >= lts[:, :, None, :])
+                       & (rows[None, None] < lte[:, :, None, :]))
+                      | ((rows[None, None] >= uts[:, :, None, :])
+                         & (rows[None, None] < ute[:, :, None, :])))
+        else:
+            raise ValueError("non-causal flashmask expects 2 or 4 indices")
+    bias = jnp.where(masked, jnp.asarray(-1e9, query.dtype),
+                     jnp.asarray(0.0, query.dtype))    # [B, H, Sq, Sk]
+    q = jnp.swapaxes(query, 1, 2)
+    k = jnp.swapaxes(key, 1, 2)
+    v = jnp.swapaxes(value, 1, 2)
+    if bias.shape[1] == 1:
+        bias = jnp.broadcast_to(bias, (b, hq, sq, sk))
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(d) + bias
+    probs = jax.nn.softmax(scores, axis=-1)
+    if dropout and training:
+        keep = jax.random.bernoulli(_random.split_key(), 1 - dropout,
+                                    probs.shape)
+        probs = jnp.where(keep, probs / (1 - dropout), 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def sparse_attention(*args, **kwargs):
+    raise NotImplementedError(
+        "sparse_attention binds a CUDA-only blocksparse kernel in the "
+        "reference (nn/functional/sparse_attention.py); use "
+        "flashmask_attention or scaled_dot_product_attention on TPU")

@@ -13,6 +13,15 @@ from .graph import (  # noqa: F401
     Program, Variable, program_guard, default_main_program,
     default_startup_program, data)
 from .executor import Executor, scope_guard, global_scope  # noqa: F401
+from .compat import (  # noqa: F401
+    append_backward, gradients, create_parameter, create_global_var,
+    accuracy, auc, ctr_metric_bundle, Print, py_func, BuildStrategy,
+    CompiledProgram, ExponentialMovingAverage, WeightNormParamAttr,
+    serialize_program, deserialize_program, serialize_persistables,
+    deserialize_persistables, save_to_file, load_from_file,
+    normalize_program, load_program_state, set_program_state, cuda_places,
+    xpu_places, IpuStrategy, IpuCompiledProgram, ipu_shard_guard,
+    set_ipu_shard)
 from ..jit.api import InputSpec  # noqa: F401
 from . import nn  # noqa: F401
 

@@ -1,0 +1,498 @@
+"""paddle.static namespace completion (reference:
+python/paddle/static/__init__.py): static autodiff surface, program
+serialization, EMA, metrics ops, py_func, device-place helpers."""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..nn.layer import ParamAttr
+from . import graph
+from .graph import Program, Variable, default_main_program
+
+__all__ = [
+    "append_backward", "gradients", "create_parameter", "create_global_var",
+    "accuracy", "auc", "ctr_metric_bundle", "Print", "py_func",
+    "BuildStrategy", "CompiledProgram", "ExponentialMovingAverage",
+    "WeightNormParamAttr", "serialize_program", "deserialize_program",
+    "serialize_persistables", "deserialize_persistables", "save_to_file",
+    "load_from_file", "normalize_program", "load_program_state",
+    "set_program_state", "cuda_places", "xpu_places", "IpuStrategy",
+    "IpuCompiledProgram", "ipu_shard_guard", "set_ipu_shard",
+]
+
+
+# ------------------------------------------------------------ autodiff
+
+def _grad_var(program, loss_var, wrt, name):
+    v = Variable(program, np.shape(wrt._data) if isinstance(wrt, Tensor)
+                 else wrt.shape,
+                 wrt._data.dtype if isinstance(wrt, Tensor) else wrt.dtype,
+                 name=name, source=("__grad__", (loss_var, wrt), {}, 1))
+    program.vars[v.name] = v
+    return v
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Static backward build (reference python/paddle/base/backward.py
+    append_backward): returns [(param, grad_var)] pairs whose grad_var is
+    fetchable from Executor.run."""
+    program = loss.program
+    params = parameter_list or [
+        p for p in program.all_parameters() if not p.stop_gradient]
+    pairs = []
+    for p in params:
+        if no_grad_set and p.name in no_grad_set:
+            continue
+        g = _grad_var(program, loss, p, f"{p.name}@GRAD")
+        pairs.append((p, g))
+    program.version += 1
+    return pairs
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Static grads of sum(targets) w.r.t. inputs (reference
+    base/backward.py gradients); target_gradients weight each target's
+    cotangent."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if target_gradients is not None:
+        import paddle_tpu as P
+        tgs = target_gradients if isinstance(
+            target_gradients, (list, tuple)) else [target_gradients]
+        targets = [P.multiply(t_, g_) if g_ is not None else t_
+                   for t_, g_ in zip(targets, tgs)]
+    loss = targets[0]
+    for extra in targets[1:]:
+        import paddle_tpu as P
+        loss = P.add(P.sum(loss), P.sum(extra))
+    outs = []
+    for x in inputs:
+        if no_grad_set and getattr(x, "name", None) in no_grad_set:
+            outs.append(None)
+            continue
+        outs.append(_grad_var(loss.program, loss, x,
+                              f"{getattr(x, 'name', 'x')}@GRAD"))
+    loss.program.version += 1
+    return outs
+
+
+# ----------------------------------------------------- vars and metrics
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..nn.layer import Layer
+    helper = Layer()
+    p = helper.create_parameter(list(shape), attr=attr, dtype=dtype,
+                                is_bias=is_bias,
+                                default_initializer=default_initializer)
+    if name:
+        p.name = name
+    default_main_program()._note_param(p)
+    return p
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    t = Tensor(jnp.full(tuple(shape), value,
+                        jnp.dtype(np.dtype(dtype))))
+    t.persistable = persistable
+    if name:
+        t.name = name
+    return t
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Top-k accuracy op (reference static/nn/metric.py accuracy)."""
+    from ..ops.registry import apply_op
+
+    def body(inp, lab):
+        topk = jax.lax.top_k(inp, k)[1]
+        lab2 = lab.reshape(-1, 1)
+        hit = jnp.any(topk == lab2, axis=1)
+        return jnp.mean(hit.astype(jnp.float32))
+
+    return apply_op("accuracy", body, (input, label), {})
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, name=None):
+    """Batch AUC (reference static/nn/metric.py auc) — exact rank-based
+    ROC-AUC over the batch."""
+    from ..ops.registry import apply_op
+
+    def body(inp, lab):
+        score = inp[:, 1] if inp.ndim == 2 and inp.shape[1] == 2 \
+            else inp.reshape(-1)
+        y = lab.reshape(-1).astype(jnp.float32)
+        order = jnp.argsort(score)
+        ranks = jnp.empty_like(order).at[order].set(
+            jnp.arange(1, score.shape[0] + 1))
+        pos = jnp.sum(y)
+        neg = y.shape[0] - pos
+        sum_rank_pos = jnp.sum(ranks * y)
+        auc_v = (sum_rank_pos - pos * (pos + 1) / 2) / \
+            jnp.maximum(pos * neg, 1.0)
+        return auc_v.astype(jnp.float32)
+
+    a = apply_op("auc", body, (input, label), {})
+    return a, [a]
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """(reference static/nn/metric.py ctr_metric_bundle): returns
+    (auc, batch_auc, [stats...]) — the sparse-PS bundle reduced to its
+    dense equivalents."""
+    a, _ = auc(input, label)
+    return a, a, [a]
+
+
+# ------------------------------------------------------------------ ops
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Debug print op (reference static/nn/common.py Print): host print
+    via jax.debug.print; identity on data."""
+    from ..ops.registry import apply_op
+
+    def body(x):
+        jax.debug.print((message or "Print") + ": {x}", x=x)
+        return x
+
+    return apply_op("print", body, (input,), {})
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-python op (reference static/nn/common.py py_func; custom-op C
+    ABI analog).  Runs func on host via pure_callback."""
+    from ..ops.registry import apply_op
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    shapes = [jax.ShapeDtypeStruct(tuple(o.shape), np.dtype(o.dtype)
+                                   if not isinstance(o, Tensor)
+                                   else o._data.dtype) for o in outs]
+
+    def body(*arrs):
+        res = jax.pure_callback(
+            lambda *a: func(*[np.asarray(x_) for x_ in a]),
+            shapes if len(shapes) > 1 else shapes[0], *arrs)
+        return res
+
+    return apply_op("py_func", body, tuple(xs), {})
+
+
+# ----------------------------------------------------------- strategies
+
+class BuildStrategy:
+    """Graph-build options (reference framework/details/build_strategy.h).
+    XLA owns fusion/memory decisions; fields are accepted and recorded."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.memory_optimize = True
+        self.reduce_strategy = 0
+        self.build_cinn_pass = False
+
+
+class CompiledProgram:
+    """(reference base/compiler.py CompiledProgram): the Executor jit-caches
+    per feed signature, so this is a recorded wrapper."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def __getattr__(self, item):
+        return getattr(self._program, item)
+
+
+class ExponentialMovingAverage:
+    """EMA of trainable parameters (reference static/ema.py): update()
+    after each step; apply()/restore() swap shadow weights in and out."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._shadow = {}
+        self._backup = {}
+        self._step = 0
+        # capture the trainable params of the program being built NOW;
+        # params register on first op capture, so keep extending lazily
+        # (reference ema.py walks the current default program)
+        self._program = default_main_program()
+        self._captured = []
+        self._recapture()
+
+    def _recapture(self):
+        seen = {id(p) for p in self._captured}
+        for p in self._program.all_parameters():
+            if not p.stop_gradient and id(p) not in seen:
+                self._captured.append(p)
+
+    def _params(self):
+        self._recapture()
+        return self._captured
+
+    def update(self):
+        self._step += 1
+        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        for p in self._params():
+            key = p.name
+            prev = self._shadow.get(key, p._data)
+            self._shadow[key] = d * prev + (1 - d) * p._data
+
+    class _Guard:
+        def __init__(self, ema, executor=None, need_restore=True):
+            self._ema = ema
+            self._need_restore = need_restore
+
+        def __enter__(self):
+            self._ema.apply_now()
+            return self
+
+        def __exit__(self, *e):
+            if self._need_restore:
+                self._ema.restore_now()
+            return False
+
+    def apply(self, executor=None, need_restore=True):
+        return ExponentialMovingAverage._Guard(self, executor, need_restore)
+
+    def apply_now(self):
+        for p in self._params():
+            if p.name in self._shadow:
+                self._backup[p.name] = p._data
+                p._data = self._shadow[p.name].astype(p._data.dtype)
+
+    def restore_now(self):
+        for p in self._params():
+            if p.name in self._backup:
+                p._data = self._backup.pop(p.name)
+
+    def restore(self, executor=None):
+        self.restore_now()
+
+
+class WeightNormParamAttr(ParamAttr):
+    """(reference static/nn/common.py WeightNormParamAttr): records the
+    weight-norm dim; applied via paddle.nn.utils.weight_norm semantics."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        super().__init__(name=name, initializer=initializer,
+                         learning_rate=learning_rate,
+                         regularizer=regularizer, trainable=trainable,
+                         need_clip=need_clip)
+        self.dim = dim
+
+
+# -------------------------------------------------------- serialization
+
+def _encode_obj(x, body_to_name, node_ids):
+    from .graph import Variable as _V
+    if isinstance(x, _V):
+        return ("__var__", x.name)
+    if isinstance(x, Tensor):
+        from ..nn.layer import Parameter
+        return ("__tensor__", np.asarray(x._data),
+                isinstance(x, Parameter), getattr(x, "name", None))
+    if isinstance(x, (list, tuple)):
+        kind = "__list__" if isinstance(x, list) else "__tuple__"
+        return (kind, [_encode_obj(e, body_to_name, node_ids) for e in x])
+    if isinstance(x, dict):
+        return ("__dict__", {k: _encode_obj(v, body_to_name, node_ids)
+                             for k, v in x.items()})
+    return ("__lit__", x)
+
+
+def _decode_obj(enc, vars_map, param_cache):
+    kind = enc[0]
+    if kind == "__var__":
+        return vars_map[enc[1]]
+    if kind == "__tensor__":
+        _, arr, is_param, pname = enc
+        key = (pname, arr.shape, str(arr.dtype))
+        if key in param_cache:
+            return param_cache[key]
+        if is_param:
+            from ..nn.layer import Parameter
+            t = Parameter(jnp.asarray(arr), name=pname)
+        else:
+            t = Tensor(jnp.asarray(arr))
+        param_cache[key] = t
+        return t
+    if kind == "__list__":
+        return [_decode_obj(e, vars_map, param_cache) for e in enc[1]]
+    if kind == "__tuple__":
+        return tuple(_decode_obj(e, vars_map, param_cache) for e in enc[1])
+    if kind == "__dict__":
+        return {k: _decode_obj(v, vars_map, param_cache)
+                for k, v in enc[1].items()}
+    return enc[1]
+
+
+def serialize_program(feed_vars=None, fetch_vars=None, program=None,
+                      **kwargs):
+    """Pickle a Program by op NAME (op bodies resolve through the registry
+    at load; reference static/io.py serialize_program serializes the
+    ProgramDesc proto the same way — by op type, not code)."""
+    from ..ops.registry import OPS
+    prog = program or default_main_program()
+    body_to_name = {id(w.__op_body__): n for n, w in OPS.items()
+                    if hasattr(w, "__op_body__")}
+    nodes = {}
+    vars_enc = {}
+    for name, v in prog.vars.items():
+        if v.source is None:
+            src = None
+        else:
+            body = v.source[0]
+            if id(v.source) not in nodes:
+                opname = body_to_name.get(id(body))
+                if opname is None:
+                    raise ValueError(
+                        f"cannot serialize op {getattr(body, '__name__', body)!r}: "
+                        "only registry ops are serializable (custom local "
+                        "bodies have no stable name)")
+                nodes[id(v.source)] = {
+                    "op": opname,
+                    "args": _encode_obj(list(v.source[1]), body_to_name,
+                                        nodes),
+                    "kwargs": _encode_obj(dict(v.source[2]), body_to_name,
+                                          nodes),
+                    "n_outs": v.source[3]}
+            src = id(v.source)
+        vars_enc[name] = {"shape": list(v.shape), "dtype": str(v.dtype),
+                          "out_index": v.out_index, "source": src}
+    payload = {"vars": vars_enc, "nodes": nodes,
+               "feed": list(prog.feed_vars.keys())}
+    return pickle.dumps(payload)
+
+
+def deserialize_program(data):
+    from ..ops.registry import OPS
+    from .graph import Program as _P, Variable as _V
+    payload = pickle.loads(data)
+    prog = _P()
+    vars_map = {}
+    for name, ve in payload["vars"].items():
+        v = _V(prog, ve["shape"], ve["dtype"], name=name, source=None,
+               out_index=ve["out_index"])
+        prog.vars[name] = v
+        vars_map[name] = v
+    param_cache = {}
+    node_cache = {}
+    for name, ve in payload["vars"].items():
+        if ve["source"] is None:
+            continue
+        nid = ve["source"]
+        if nid not in node_cache:
+            ne = payload["nodes"][nid]
+            body = OPS[ne["op"]].__op_body__
+            args = _decode_obj(ne["args"], vars_map, param_cache)
+            kwargs = _decode_obj(ne["kwargs"], vars_map, param_cache)
+            node_cache[nid] = (body, tuple(args), kwargs, ne["n_outs"])
+        vars_map[name].source = node_cache[nid]
+    for t in param_cache.values():
+        from ..nn.layer import Parameter
+        if isinstance(t, Parameter):
+            prog._note_param(t)
+    for fname in payload["feed"]:
+        if fname in vars_map:
+            prog.feed_vars[fname] = vars_map[fname]
+    return prog
+
+
+def serialize_persistables(feed_vars=None, fetch_vars=None, program=None,
+                           **kwargs):
+    prog = program or default_main_program()
+    state = {p.name: np.asarray(p._data) for p in prog.all_parameters()}
+    return pickle.dumps(state)
+
+
+def deserialize_persistables(program, data, executor=None):
+    state = pickle.loads(data)
+    for p in program.all_parameters():
+        if p.name in state:
+            p._data = jnp.asarray(state[p.name])
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """(reference static/io.py normalize_program): prune to the
+    feed->fetch slice.  Evaluation is already demand-driven from fetches,
+    so the program is returned as-is."""
+    return program
+
+
+def load_program_state(model_path, var_list=None):
+    from ..framework.io import load
+    path = model_path if model_path.endswith(".pdparams") \
+        else model_path + ".pdparams"
+    state = load(path)
+    return {k: np.asarray(v._data if isinstance(v, Tensor) else v)
+            for k, v in state.items()}
+
+
+def set_program_state(program, state_dict):
+    for p in program.all_parameters():
+        if p.name in state_dict:
+            p._data = jnp.asarray(state_dict[p.name]).astype(p._data.dtype)
+
+
+# ------------------------------------------------------------ places/IPU
+
+def cuda_places(device_ids=None):
+    from ..device import CUDAPlace
+    ids = device_ids if device_ids is not None else [0]
+    return [CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    from ..device import XPUPlace
+    ids = device_ids if device_ids is not None else [0]
+    return [XPUPlace(i) for i in ids]
+
+
+def _ipu_stub(name):
+    class _Stub:
+        def __init__(self, *a, **k):
+            raise NotImplementedError(
+                f"{name}: Graphcore IPU support has no TPU analog "
+                "(reference static/__init__.py Ipu*)")
+    _Stub.__name__ = name
+    return _Stub
+
+
+IpuStrategy = _ipu_stub("IpuStrategy")
+IpuCompiledProgram = _ipu_stub("IpuCompiledProgram")
+
+
+class ipu_shard_guard:
+    def __init__(self, index=-1, stage=-1):
+        raise NotImplementedError(
+            "ipu_shard_guard: Graphcore IPU support has no TPU analog")
+
+
+def set_ipu_shard(layer, index=-1, stage=-1):
+    raise NotImplementedError(
+        "set_ipu_shard: Graphcore IPU support has no TPU analog")

@@ -329,6 +329,43 @@ def evaluate(fetch_vars, feed, params=None):
 
     env = {}
 
+    # batch all __grad__ fetches sharing a loss into ONE jax.grad sweep
+    # (fetching N parameter grads must not cost N forward+backward passes)
+    grad_fetches = [v for v in fetch_vars
+                    if v.source is not None and v.source[0] == "__grad__"]
+    by_loss = {}
+    for v in grad_fetches:
+        loss_v, wrt = v.source[1]
+        by_loss.setdefault(id(loss_v), (loss_v, []))[1].append((v, wrt))
+    for loss_v, pairs in by_loss.values():
+        t_pairs = [(v, w) for v, w in pairs if isinstance(w, Tensor)]
+        f_pairs = [(v, w) for v, w in pairs if not isinstance(w, Tensor)]
+        if t_pairs:
+            cur = [params[id(w)] if params and id(w) in params else w._data
+                   for _, w in t_pairs]
+
+            def f_t(arrs, _loss=loss_v, _pairs=t_pairs):
+                p2 = dict(params or {})
+                p2.update({id(w): a for (_, w), a in zip(_pairs, arrs)})
+                return evaluate([_loss], feed, p2)[0] \
+                    .astype(jnp.float32).sum()
+
+            grads = jax.grad(f_t)(cur)
+            for (v, _), g in zip(t_pairs, grads):
+                env[v.name] = g
+        if f_pairs:
+            cur = [feed[w.name] for _, w in f_pairs]
+
+            def f_f(arrs, _loss=loss_v, _pairs=f_pairs):
+                f2 = dict(feed)
+                f2.update({w.name: a for (_, w), a in zip(_pairs, arrs)})
+                return evaluate([_loss], f2, params)[0] \
+                    .astype(jnp.float32).sum()
+
+            grads = jax.grad(f_f)(cur)
+            for (v, _), g in zip(f_pairs, grads):
+                env[v.name] = g
+
     def eval_var(v):
         if v.name in env:
             return env[v.name]
@@ -336,6 +373,22 @@ def evaluate(fetch_vars, feed, params=None):
             if v.name not in feed:
                 raise KeyError(f"feed missing input {v.name!r}")
             val = feed[v.name]
+        elif v.source[0] == "__grad__":
+            # static autodiff node (append_backward/gradients): grad of a
+            # scalar-summed target w.r.t. a parameter Tensor or feed var
+            _, (loss_v, wrt), _, _ = v.source
+            if isinstance(wrt, Tensor):
+                cur = params[id(wrt)] if params and id(wrt) in params \
+                    else wrt._data
+                val = jax.grad(lambda a: evaluate(
+                    [loss_v], feed,
+                    {**(params or {}), id(wrt): a})[0]
+                    .astype(jnp.float32).sum())(cur)
+            else:
+                cur = feed[wrt.name]
+                val = jax.grad(lambda a: evaluate(
+                    [loss_v], {**feed, wrt.name: a}, params)[0]
+                    .astype(jnp.float32).sum())(cur)
         else:
             body, args, kwargs, _ = v.source
             flat, treedef = tree_flatten(

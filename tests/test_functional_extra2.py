@@ -1,0 +1,212 @@
+"""nn.functional part-3 tests: affine_grid/grid_sample vs torch, ArcFace
+ops, beam-search utils, flash packed/masked entry points (reference:
+test/legacy_test/test_{affine_grid,grid_sampler,margin_cross_entropy}_op.py
+style)."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+rng = np.random.RandomState(4)
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestAffineGridSample:
+    def test_affine_grid_reference_example(self):
+        theta = t(np.array([[[-0.7, -0.4, 0.3], [0.6, 0.5, 1.5]]],
+                           np.float32))
+        g = F.affine_grid(theta, [1, 2, 3, 3], align_corners=False).numpy()
+        np.testing.assert_allclose(g[0, 0, 0], [1.0333333, 0.76666665],
+                                   rtol=1e-5)
+        np.testing.assert_allclose(g[0, 2, 2], [-0.43333334, 2.2333333],
+                                   rtol=1e-5)
+
+    @pytest.mark.parametrize("scalef,ac,pm,mode", [
+        (0.9, True, "zeros", "bilinear"),
+        (1.4, False, "border", "bilinear"),
+        (1.4, False, "reflection", "nearest"),
+        (1.7, True, "reflection", "bilinear"),
+        (1.2, False, "zeros", "nearest")])
+    def test_grid_sample_matches_torch(self, scalef, ac, pm, mode):
+        x = t(rng.randn(2, 3, 5, 5).astype(np.float32))
+        ident = t(np.tile(np.array([[[1.0, 0, 0], [0, 1, 0]]], np.float32),
+                          (2, 1, 1)))
+        gi = np.asarray(F.affine_grid(ident, [2, 3, 5, 5],
+                                      align_corners=True).numpy(),
+                        np.float32)
+        g = (gi * scalef).astype(np.float32)
+        ref = torch.nn.functional.grid_sample(
+            torch.tensor(x.numpy()), torch.tensor(g), align_corners=ac,
+            padding_mode=pm, mode=mode).numpy()
+        ours = F.grid_sample(x, t(g), align_corners=ac, padding_mode=pm,
+                             mode=mode).numpy()
+        np.testing.assert_allclose(ours, ref, atol=1e-4)
+
+    def test_grid_sample_5d(self):
+        x5 = t(rng.randn(1, 2, 4, 4, 4).astype(np.float32))
+        th5 = t(np.array([[[1.0, 0, 0, 0], [0, 1, 0, 0], [0, 0, 1, 0]]],
+                         np.float32))
+        g5 = np.asarray(F.affine_grid(th5, [1, 2, 4, 4, 4],
+                                      align_corners=True).numpy(),
+                        np.float32) * 0.8
+        ref = torch.nn.functional.grid_sample(
+            torch.tensor(x5.numpy()), torch.tensor(g5),
+            align_corners=True).numpy()
+        ours = F.grid_sample(x5, t(g5), align_corners=True).numpy()
+        np.testing.assert_allclose(ours, ref, atol=1e-4)
+
+
+class TestSequenceUtils:
+    def test_sequence_mask(self):
+        m = F.sequence_mask(t(np.array([2, 4], np.int64)), maxlen=5)
+        np.testing.assert_array_equal(
+            m.numpy(), [[1, 1, 0, 0, 0], [1, 1, 1, 1, 0]])
+        m2 = F.sequence_mask(t(np.array([1, 3], np.int64)), dtype="bool")
+        assert m2.shape == [2, 3]
+
+    def test_gather_tree_backtrace(self):
+        # time=2, batch=1, beam=2: beam 0's parent at t=1 is beam 1
+        ids = t(np.array([[[2, 5]], [[6, 1]]], np.int64))
+        parents = t(np.array([[[0, 0]], [[1, 0]]], np.int64))
+        out = F.gather_tree(ids, parents).numpy()
+        # final beam 0: path = ids[0][parent chain 1] -> [5, 6]
+        np.testing.assert_array_equal(out, [[[5, 2]], [[6, 1]]])
+
+
+class TestArcFace:
+    def test_margin_cross_entropy_reduces_to_ce(self):
+        # margins (1, 0, 0): identical to scaled softmax CE on cos
+        logits = np.clip(rng.randn(6, 8) * 0.3, -1, 1).astype(np.float32)
+        label = rng.randint(0, 8, 6).astype(np.int64)
+        loss = float(F.margin_cross_entropy(
+            t(logits), t(label), margin1=1.0, margin2=0.0, margin3=0.0,
+            scale=4.0))
+        lp = torch.log_softmax(torch.tensor(logits) * 4.0, -1)
+        expect = float(torch.nn.functional.nll_loss(lp, torch.tensor(label)))
+        np.testing.assert_allclose(loss, expect, rtol=1e-4)
+
+    def test_margin_increases_loss(self):
+        logits = np.clip(rng.randn(6, 8) * 0.3, -1, 1).astype(np.float32)
+        label = rng.randint(0, 8, 6).astype(np.int64)
+        base = float(F.margin_cross_entropy(t(logits), t(label),
+                                            margin2=0.0))
+        arc = float(F.margin_cross_entropy(t(logits), t(label),
+                                           margin2=0.5))
+        assert arc > base  # additive angular margin penalizes the target
+
+    def test_class_center_sample(self):
+        label = rng.randint(0, 20, 8).astype(np.int64)
+        remapped, sampled = F.class_center_sample(t(label), 20, 10)
+        s = sampled.numpy()
+        r = remapped.numpy()
+        assert len(s) >= len(np.unique(label))
+        # remapped labels index into sampled and recover the original
+        np.testing.assert_array_equal(s[r], label)
+
+
+class TestFlashSurface:
+    def test_qkvpacked(self):
+        qkv = t(rng.randn(2, 16, 3, 4, 8).astype(np.float32))
+        out, sm = F.flash_attn_qkvpacked(qkv, causal=True)
+        assert out.shape == [2, 16, 4, 8] and sm is None
+        ref = F.scaled_dot_product_attention(
+            t(qkv.numpy()[:, :, 0]), t(qkv.numpy()[:, :, 1]),
+            t(qkv.numpy()[:, :, 2]), is_causal=True)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-5)
+
+    def test_varlen_qkvpacked(self):
+        qkv = t(rng.randn(10, 3, 2, 4).astype(np.float32))
+        cu = t(np.array([0, 4, 10], np.int32))
+        out, _ = F.flash_attn_varlen_qkvpacked(qkv, cu, cu, 6, 6)
+        assert out.shape == [10, 2, 4]
+        # first segment independent of second
+        qkv2 = qkv.numpy().copy()
+        qkv2[4:] = rng.randn(6, 3, 2, 4).astype(np.float32)
+        out2, _ = F.flash_attn_varlen_qkvpacked(t(qkv2), cu, cu, 6, 6)
+        np.testing.assert_allclose(out.numpy()[:4], out2.numpy()[:4],
+                                   atol=1e-5)
+
+    def test_flashmask_matches_causal_sdpa(self):
+        q = t(rng.randn(1, 8, 2, 4).astype(np.float32))
+        k = t(rng.randn(1, 8, 2, 4).astype(np.float32))
+        v = t(rng.randn(1, 8, 2, 4).astype(np.float32))
+        sri = t(np.full((1, 1, 8, 1), 8, np.int32))  # no extra masking
+        out = F.flashmask_attention(q, k, v, sri, causal=True)
+        ref = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-4)
+
+    def test_flashmask_document_mask(self):
+        # two documents [0:4) and [4:8): tokens must not attend across
+        q = t(rng.randn(1, 8, 1, 4).astype(np.float32))
+        k = t(rng.randn(1, 8, 1, 4).astype(np.float32))
+        v0 = rng.randn(1, 8, 1, 4).astype(np.float32)
+        sri = np.zeros((1, 1, 8, 1), np.int32)
+        sri[0, 0, :4, 0] = 4   # cols of doc1: mask rows >= 4
+        sri[0, 0, 4:, 0] = 8
+        out0 = F.flashmask_attention(q, k, t(v0), t(sri), causal=True)
+        v1 = v0.copy()
+        v1[0, 4:] = rng.randn(4, 1, 4)  # perturb doc2 values
+        out1 = F.flashmask_attention(q, k, t(v1), t(sri), causal=True)
+        # doc1 outputs unaffected by doc2 values
+        np.testing.assert_allclose(out0.numpy()[0, :4], out1.numpy()[0, :4],
+                                   atol=1e-5)
+        assert not np.allclose(out0.numpy()[0, 4:], out1.numpy()[0, 4:])
+
+    def test_sparse_attention_gated(self):
+        with pytest.raises(NotImplementedError):
+            F.sparse_attention()
+
+
+class TestMiscLosses:
+    def test_sigmoid_focal_loss_matches_manual(self):
+        logit = rng.randn(4, 3).astype(np.float32)
+        label = (rng.rand(4, 3) > 0.5).astype(np.float32)
+        got = float(F.sigmoid_focal_loss(t(logit), t(label),
+                                         alpha=0.25, gamma=2.0))
+        p = 1 / (1 + np.exp(-logit))
+        ce = -(label * np.log(p) + (1 - label) * np.log(1 - p))
+        pt = p * label + (1 - p) * (1 - label)
+        at = 0.25 * label + 0.75 * (1 - label)
+        expect = float((at * ce * (1 - pt) ** 2).sum())
+        np.testing.assert_allclose(got, expect, rtol=1e-4)
+
+    def test_dice_channel_pairwise(self):
+        inp = t(np.abs(rng.rand(4, 6, 5)).astype(np.float32))
+        lab = t(rng.randint(0, 5, (4, 6, 1)).astype(np.int64))
+        assert np.isfinite(float(F.dice_loss(inp, lab)))
+        x = t(rng.randn(2, 4, 4, 6).astype(np.float32))
+        sh = F.channel_shuffle(t(rng.randn(2, 6, 4, 4).astype(np.float32)),
+                               3)
+        assert sh.shape == [2, 6, 4, 4]
+        d = F.pairwise_distance(t(rng.randn(3, 4).astype(np.float32)),
+                                t(rng.randn(3, 4).astype(np.float32)))
+        assert d.shape == [3]
+
+    def test_inplace_functional(self):
+        x = t(np.array([-1.0, 2.0], np.float32))
+        out = F.relu_(x)
+        assert out is x
+        np.testing.assert_allclose(x.numpy(), [0.0, 2.0])
+        F.tanh_(x)
+        np.testing.assert_allclose(x.numpy(), np.tanh([0.0, 2.0]),
+                                   rtol=1e-6)
+
+    def test_adaptive_log_softmax_functional(self):
+        import paddle_tpu.nn as nn
+        m = nn.AdaptiveLogSoftmaxWithLoss(16, 20, cutoffs=[5, 10],
+                                          div_value=2.0)
+        x = t(rng.randn(8, 16).astype(np.float32))
+        lbl = t(rng.randint(0, 20, (8,)).astype(np.int64))
+        out_l, loss_l = m(x, lbl)
+        tails = [[m._tail_w1[i], m._tail_w2[i]]
+                 for i in range(m.n_clusters)]
+        out_f, loss_f = F.adaptive_log_softmax_with_loss(
+            x, lbl, m.head_weight, tails, m.cutoffs[:-1] + [20],
+            head_bias=m.head_bias)
+        np.testing.assert_allclose(out_f.numpy(), out_l.numpy(), rtol=1e-5)
+        np.testing.assert_allclose(float(loss_f), float(loss_l), rtol=1e-5)

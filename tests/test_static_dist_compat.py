@@ -1,0 +1,212 @@
+"""static/distributed namespace completion tests (reference:
+test/legacy_test/test_backward.py, test_ema.py, test_accuracy_op.py,
+test/collective api surface)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+import paddle_tpu.distributed as dist
+
+rng = np.random.RandomState(13)
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestStaticAutodiff:
+    def _build(self):
+        paddle.enable_static()
+        prog = static.Program()
+        start = static.Program()
+        with static.program_guard(prog, start):
+            x = static.data("x", [4, 3], "float32")
+            lin = paddle.nn.Linear(3, 2)
+            y = lin(x)
+            loss = paddle.sum(y)
+        return prog, x, lin, loss
+
+    def teardown_method(self):
+        paddle.disable_static()
+
+    def test_append_backward_grads_fetchable(self):
+        prog, x, lin, loss = self._build()
+        pairs = static.append_backward(loss)
+        assert len(pairs) == 2
+        exe = static.Executor()
+        xv = rng.randn(4, 3).astype(np.float32)
+        grad_names = [g.name for _, g in pairs]
+        outs = exe.run(prog, feed={"x": xv},
+                       fetch_list=[loss] + grad_names)
+        # dLoss/dW = sum over batch of x (broadcast to [3,2])
+        expect_w = np.tile(xv.sum(0)[:, None], (1, 2))
+        got = {g: o for g, o in zip(grad_names, outs[1:])}
+        wg = got[f"{lin.weight.name}@GRAD"]
+        np.testing.assert_allclose(wg, expect_w, rtol=1e-5)
+        bg = got[f"{lin.bias.name}@GRAD"]
+        np.testing.assert_allclose(bg, np.full(2, 4.0), rtol=1e-5)
+
+    def test_gradients_wrt_input(self):
+        prog, x, lin, loss = self._build()
+        (gx,) = static.gradients([loss], [x])
+        exe = static.Executor()
+        xv = rng.randn(4, 3).astype(np.float32)
+        out = exe.run(prog, feed={"x": xv}, fetch_list=[gx])[0]
+        expect = np.tile(lin.weight.numpy().sum(1), (4, 1))
+        np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+
+class TestStaticMetricsOps:
+    def teardown_method(self):
+        paddle.disable_static()
+
+    def test_accuracy(self):
+        inp = t(np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]], np.float32))
+        lab = t(np.array([1, 0, 0], np.int64))
+        acc = float(static.accuracy(inp, lab))
+        np.testing.assert_allclose(acc, 2.0 / 3.0, rtol=1e-6)
+
+    def test_auc(self):
+        score = t(np.array([[0.9, 0.1], [0.3, 0.7], [0.6, 0.4],
+                            [0.2, 0.8]], np.float32))
+        lab = t(np.array([0, 1, 0, 1], np.int64))
+        a, _ = static.auc(score, lab)
+        np.testing.assert_allclose(float(a), 1.0)  # perfectly ranked
+
+    def test_print_and_pyfunc(self):
+        x = t(np.ones((2, 2), np.float32))
+        out = static.Print(x, message="dbg")
+        np.testing.assert_allclose(out.numpy(), x.numpy())
+        y = t(np.zeros((2, 2), np.float32))
+        res = static.py_func(lambda a: a * 3.0, x, y)
+        np.testing.assert_allclose(res.numpy(), 3 * np.ones((2, 2)))
+
+
+class TestEMAAndSerialization:
+    def teardown_method(self):
+        paddle.disable_static()
+
+    def test_ema_apply_restore(self):
+        paddle.enable_static()
+        prog = static.Program()
+        with static.program_guard(prog, static.Program()):
+            x = static.data("x", [1, 2], "float32")
+            lin = paddle.nn.Linear(2, 2)
+            lin(x)  # registers the params with the program
+            ema = static.ExponentialMovingAverage(0.5)
+        w0 = lin.weight.numpy().copy()
+        ema.update()
+        lin.weight.set_value(t(w0 * 3))
+        ema.update()
+        with ema.apply():
+            applied = lin.weight.numpy().copy()
+        restored = lin.weight.numpy()
+        np.testing.assert_allclose(restored, w0 * 3, rtol=1e-6)
+        assert not np.allclose(applied, restored)
+
+    def test_program_serialization(self, tmp_path):
+        paddle.enable_static()
+        prog = static.Program()
+        with static.program_guard(prog, static.Program()):
+            x = static.data("x", [2, 2], "float32")
+            lin = paddle.nn.Linear(2, 2)
+            y = lin(x)
+        blob = static.serialize_persistables(program=prog)
+        p = tmp_path / "persist.bin"
+        static.save_to_file(str(p), blob)
+        w_orig = lin.weight.numpy().copy()
+        lin.weight.set_value(t(np.zeros((2, 2), np.float32)))
+        static.deserialize_persistables(prog, static.load_from_file(str(p)))
+        np.testing.assert_allclose(lin.weight.numpy(), w_orig)
+
+    def test_build_strategy_compiled_program(self):
+        paddle.enable_static()
+        prog = static.Program()
+        bs = static.BuildStrategy()
+        cp = static.CompiledProgram(prog, build_strategy=bs)
+        assert cp._program is prog
+
+
+class TestDistCompat:
+    def test_strategy_and_attrs(self):
+        s = dist.Strategy({"pipeline": {"enable": True,
+                                        "micro_batch_size": 4}})
+        assert s.pipeline.enable and s.pipeline.micro_batch_size == 4
+        assert not s.sharding.enable
+        mesh = dist.ProcessMesh([[0, 1], [2, 3]], dim_names=["x", "y"])
+        da = dist.DistAttr(mesh, ["x", None])
+        assert "x" in repr(da)
+
+    def test_to_static_dist_model(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as opt
+        model = nn.Linear(4, 2)
+        o = opt.SGD(learning_rate=0.1, parameters=model.parameters())
+        dm = dist.to_static(model, loss=lambda a, b: ((a - b) ** 2).mean(),
+                            optimizer=o)
+        x = t(rng.randn(8, 4).astype(np.float32))
+        y = t(rng.randn(8, 2).astype(np.float32))
+        dm.train()
+        l0 = float(dm(x, y))
+        for _ in range(5):
+            l1 = float(dm(x, y))
+        assert l1 < l0
+        dm.eval()
+        le = dm(x, y)
+        assert np.isfinite(float(le))
+        sd = dm.state_dict()
+        assert any(k.startswith("opt.") for k in sd)
+        dm.set_state_dict(sd)
+
+    def test_object_collectives_single_process(self):
+        objs = ["a", {"b": 1}]
+        assert dist.broadcast_object_list(objs) == objs
+        out = []
+        dist.scatter_object_list(out, ["x", "y"])
+        assert out  # rank 0 gets its share
+        assert dist.is_available()
+        assert dist.get_backend() in ("GLOO", "XCCL_TPU")
+        dist.destroy_process_group()
+        assert dist.ReduceType.kRedSum == 0
+
+    def test_alltoall_single_identity(self):
+        src = t(rng.randn(4, 2).astype(np.float32))
+        dst = t(np.zeros((4, 2), np.float32))
+        dist.alltoall_single(dst, src)
+        np.testing.assert_allclose(dst.numpy(), src.numpy())
+
+    def test_dtensor_from_fn_and_entries(self):
+        mesh = dist.ProcessMesh(list(range(1)), dim_names=["dp"])
+        d = dist.dtensor_from_fn(paddle.ones, mesh, [dist.Replicate()],
+                                 [4, 4])
+        assert d.shape == [4, 4]
+        e = dist.CountFilterEntry(5)
+        assert e.count_filter == 5
+        with pytest.raises(ValueError):
+            dist.ProbabilityEntry(1.5)
+        with pytest.raises(NotImplementedError):
+            dist.InMemoryDataset()
+
+    def test_split_mp_linear(self):
+        x = t(rng.randn(4, 8).astype(np.float32))
+        out = dist.split(x, (8, 6), num_partitions=1, operation="linear",
+                         axis=1)
+        assert out.shape == [4, 6]
+        emb = dist.split(t(np.array([1, 3], np.int64)), (10, 4),
+                         operation="embedding")
+        assert emb.shape == [2, 4]
+
+    def test_shard_dataloader_passthrough(self):
+        from paddle_tpu.io import DataLoader, TensorDataset
+        xs = t(rng.randn(8, 3).astype(np.float32))
+        ys = t(rng.randn(8, 1).astype(np.float32))
+        loader = DataLoader(TensorDataset([xs, ys]), batch_size=4)
+        mesh = dist.ProcessMesh(list(range(1)), dim_names=["dp"])
+        sharded = dist.shard_dataloader(loader, [mesh])
+        batches = list(iter(sharded))
+        assert len(batches) == len(loader)
+
+    def test_io_worker_info(self):
+        import paddle_tpu.io as pio
+        assert pio.get_worker_info() is None
