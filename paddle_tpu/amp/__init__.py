@@ -4,3 +4,58 @@ from .grad_scaler import GradScaler, AmpScaler
 
 __all__ = ["auto_cast", "amp_guard", "GradScaler", "AmpScaler",
            "WHITE_LIST", "BLACK_LIST"]
+
+
+def is_bfloat16_supported(place=None):
+    """TPUs compute natively in bfloat16 (reference amp/__init__.py checks
+    CUDA compute capability)."""
+    return True
+
+
+def is_float16_supported(place=None):
+    return True  # native on TPU, emulated on the CPU backend
+
+
+def decorate(models, optimizers=None, level="O1", dtype="float16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
+    """AMP O2 decoration (reference amp/auto_cast.py decorate): cast model
+    params to the low-precision dtype; optimizers already keep fp32 master
+    weights (optimizer.py multi_precision)."""
+    from ..framework.dtype import to_np_dtype
+    import jax.numpy as jnp
+    if level not in ("O1", "O2"):
+        raise ValueError("level must be O1 or O2")
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        from ..nn import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
+                          LayerNorm, GroupNorm, InstanceNorm1D,
+                          InstanceNorm2D, InstanceNorm3D, SyncBatchNorm)
+        norm_types = (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
+                      LayerNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D,
+                      InstanceNorm3D, SyncBatchNorm)
+        excluded = []
+        for e in (excluded_layers or []):
+            excluded += [e] if not isinstance(e, type) else []
+        excluded_types = tuple(e for e in (excluded_layers or [])
+                               if isinstance(e, type))
+        np_dtype = to_np_dtype("bfloat16" if dtype == "bfloat16"
+                               else "float16")
+        for m in model_list:
+            skip_ids = set()
+            for lyr in m.sublayers(include_self=True):
+                # norm layers keep fp32 params (reference decorate keeps
+                # norms full precision), as do excluded layers
+                if isinstance(lyr, norm_types) or lyr in excluded \
+                        or (excluded_types
+                            and isinstance(lyr, excluded_types)):
+                    skip_ids |= {id(p) for p in lyr.parameters()}
+            for p in m.parameters():
+                if id(p) in skip_ids:
+                    continue
+                if jnp.issubdtype(p._data.dtype, jnp.floating):
+                    p._data = p._data.astype(np_dtype)
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers

@@ -80,3 +80,13 @@ class backends:
             i.num_frames = w.getnframes()
             i.bits_per_sample = 8 * w.getsampwidth()
         return i
+
+
+from . import datasets  # noqa: E402,F401
+
+# top-level IO aliases (reference paddle/audio/__init__.py re-exports)
+load = backends.load
+save = backends.save
+info = backends.info
+
+__all__ += ["datasets", "load", "save", "info"]

@@ -1,0 +1,20 @@
+"""paddle.audio.datasets (reference: python/paddle/audio/datasets/{tess,
+esc50}.py).  Zero-egress environment: constructors raise with guidance."""
+from __future__ import annotations
+
+__all__ = ["TESS", "ESC50"]
+
+
+def _gated(name, url_hint):
+    class _DS:
+        def __init__(self, *a, **k):
+            raise NotImplementedError(
+                f"{name} requires downloading {url_hint}; there is no "
+                "network egress here — pre-extract the archive and wrap it "
+                "with paddle.io.Dataset")
+    _DS.__name__ = name
+    return _DS
+
+
+TESS = _gated("TESS", "the Toronto emotional speech set archive")
+ESC50 = _gated("ESC50", "the ESC-50 environmental sound archive")

@@ -1,0 +1,95 @@
+"""Functional higher-order autodiff (reference: python/paddle/autograd/
+autograd.py jacobian/hessian over the eager engine).  Here they lower to
+jax.jacrev/jax.hessian directly — the reference builds these from repeated
+VJP sweeps; XLA compiles the whole sweep."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+__all__ = ["jacobian", "hessian", "saved_tensors_hooks"]
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """d(ys)/d(xs).  Two call forms (both in the reference):
+      * jacobian(func, xs): differentiate a callable
+      * jacobian(y_tensor, x_tensor): differentiate recorded tensors is NOT
+        supported here — pass the function (jax traces functionally).
+    """
+    if not callable(ys):
+        raise TypeError(
+            "jacobian(ys, xs) needs ys to be a callable here: the tape "
+            "releases intermediate jaxprs, so differentiate the function "
+            "(reference autograd.py also exposes the functional form)")
+    func = ys
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [_unwrap(x) for x in xs_list]
+
+    def wrapped(*arrs):
+        args = [Tensor(a) for a in arrs]
+        out = func(*args) if len(args) > 1 else func(args[0])
+        return _unwrap(out)
+
+    if batch_axis is None:
+        jac = jax.jacrev(wrapped, argnums=tuple(range(len(arrays))))(*arrays)
+    else:
+        if batch_axis != 0:
+            raise ValueError("batch_axis must be 0 or None")
+        jac = jax.vmap(jax.jacrev(wrapped,
+                                  argnums=tuple(range(len(arrays)))))(*arrays)
+    if isinstance(xs, (list, tuple)):
+        return [Tensor(j) for j in jac]
+    return Tensor(jac[0])
+
+
+def hessian(func, xs, batch_axis=None):
+    """d2(func)/d(xs)2 for scalar-output func (reference autograd.py
+    hessian)."""
+    if not callable(func):
+        raise TypeError("hessian needs a callable (see jacobian docstring)")
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [_unwrap(x) for x in xs_list]
+
+    def wrapped(*arrs):
+        args = [Tensor(a) for a in arrs]
+        out = func(*args) if len(args) > 1 else func(args[0])
+        return _unwrap(out).sum()
+
+    if batch_axis is None:
+        h = jax.hessian(wrapped, argnums=tuple(range(len(arrays))))(*arrays)
+    else:
+        if batch_axis != 0:
+            raise ValueError("batch_axis must be 0 or None")
+        h = jax.vmap(jax.hessian(wrapped,
+                                 argnums=tuple(range(len(arrays)))))(*arrays)
+    if isinstance(xs, (list, tuple)):
+        return [[Tensor(h[i][j]) for j in range(len(arrays))]
+                for i in range(len(arrays))]
+    return Tensor(h[0][0])
+
+
+class saved_tensors_hooks:
+    """Context manager transforming tensors saved for backward (reference
+    python/paddle/autograd/saved_tensors_hooks.py; eager
+    SavedTensorsHooks).  Registered with the tape: pack runs when an op
+    records its VJP inputs, unpack when backward consumes them."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        from . import tape
+        tape.push_saved_tensors_hooks(self.pack_hook, self.unpack_hook)
+        return self
+
+    def __exit__(self, *exc):
+        from . import tape
+        tape.pop_saved_tensors_hooks()
+        return False

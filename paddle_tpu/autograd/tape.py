@@ -215,3 +215,25 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
             g = jnp.zeros(t._data.shape, t._data.dtype)
         results.append(Tensor(g, stop_gradient=True) if g is not None else None)
     return results
+
+
+# ---------------------------------------------------- saved-tensor hooks
+# (reference: python/paddle/autograd/saved_tensors_hooks.py — pack runs
+# when an op saves residuals for backward, unpack when backward uses them.
+# Here residuals live inside jax.vjp closures; the hooks are applied to
+# the op's *input* tensors, which is the dominant residual class, by
+# wrapping the recorded vjp.)
+
+_saved_hooks_stack = []
+
+
+def push_saved_tensors_hooks(pack_hook, unpack_hook):
+    _saved_hooks_stack.append((pack_hook, unpack_hook))
+
+
+def pop_saved_tensors_hooks():
+    _saved_hooks_stack.pop()
+
+
+def current_saved_tensors_hooks():
+    return _saved_hooks_stack[-1] if _saved_hooks_stack else None

@@ -7,3 +7,22 @@ from .functional import TrainStep, train_step
 __all__ = ["to_static", "not_to_static", "ignore_module", "InputSpec",
            "StaticFunction", "enable_to_static", "save", "load",
            "TranslatedLayer", "TrainStep", "train_step"]
+
+_verbosity = 0
+_code_level = 0
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """(reference jit/dy2static/logging_utils.py set_verbosity): tracing
+    here is jax-native, so this records the level for API parity."""
+    global _verbosity
+    _verbosity = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """(reference jit/dy2static/logging_utils.py set_code_level)."""
+    global _code_level
+    _code_level = int(level)
+
+
+__all__ += ["set_verbosity", "set_code_level"]

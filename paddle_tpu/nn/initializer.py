@@ -16,7 +16,8 @@ from ..framework.dtype import to_np_dtype
 
 __all__ = ["Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
            "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
-           "Assign", "Dirac", "Orthogonal", "calculate_gain"]
+           "Assign", "Dirac", "Orthogonal", "Bilinear", "calculate_gain",
+           "set_global_initializer"]
 
 
 def _fans(shape):
@@ -191,3 +192,31 @@ class Orthogonal(Initializer):
             q = q.T
         return (self.gain * q[:rows, :cols]).reshape(tuple(shape)).astype(
             to_np_dtype(dtype))
+
+
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel init for transposed convs (reference
+    nn/initializer/Bilinear)."""
+
+    def __call__(self, shape, dtype=None):
+        shape = tuple(int(s) for s in shape)
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer expects a 4-D shape")
+        f = np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        k = np.zeros(shape, np.float32)
+        for i in range(int(np.prod(shape[2:]))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            k[:, :, y, x] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        return jnp.asarray(k).astype(to_np_dtype(dtype or "float32"))
+
+
+_global_initializer = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Default initializer override (reference nn/initializer/
+    set_global_initializer): picked up by Layer.create_parameter."""
+    global _global_initializer
+    _global_initializer = (weight_init, bias_init)

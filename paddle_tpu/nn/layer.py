@@ -85,7 +85,12 @@ class Layer:
         if attr is False:
             return None
         dtype = dtype or self._dtype
-        init = attr.initializer or default_initializer or \
+        from . import initializer as _init_mod
+        glob = _init_mod._global_initializer
+        glob_init = None
+        if glob is not None:
+            glob_init = glob[1] if is_bias else glob[0]
+        init = attr.initializer or default_initializer or glob_init or \
             (Constant(0.0) if is_bias else XavierUniform())
         data = init(shape, dtype)
         p = Parameter(data, stop_gradient=not attr.trainable, name=attr.name)

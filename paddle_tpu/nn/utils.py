@@ -1,0 +1,187 @@
+"""paddle.nn.utils (reference: python/paddle/nn/utils/{weight_norm_hook,
+spectral_norm_hook,clip_grad_norm_,clip_grad_value_,transform_parameters}.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
+           "vector_to_parameters"]
+
+
+def _norm_except_dim(w, dim):
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(w), axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize ``layer.<name>`` as g * v/||v|| (reference
+    weight_norm_hook.py): adds <name>_g and <name>_v parameters and a
+    pre-forward hook recomputing the weight."""
+    from .layer import Parameter
+    w = getattr(layer, name)
+    if dim is None:
+        dim = -1  # norm over everything
+    data = w._data
+    if dim == -1:
+        g = jnp.sqrt(jnp.sum(jnp.square(data))).reshape(1)
+    else:
+        g = _norm_except_dim(data, dim).reshape(-1)
+    g_p = Parameter(g)
+    v_p = Parameter(data)
+    layer.add_parameter(name + "_g", g_p)
+    layer.add_parameter(name + "_v", v_p)
+    if name in layer._parameters:
+        del layer._parameters[name]
+    # recompute through the tape on every forward so grads flow to v and g
+    hook = layer.register_forward_pre_hook(
+        lambda lyr, inputs: _apply_weight_norm(lyr, name, dim))
+    layer._weight_norm_hook = hook
+    layer._weight_norm_dim = dim
+    _apply_weight_norm(layer, name, dim)
+    return layer
+
+
+def _apply_weight_norm(layer, name, dim):
+    import paddle_tpu as P
+    v = getattr(layer, name + "_v")
+    g = getattr(layer, name + "_g")
+    nd = v._data.ndim
+    if dim == -1:
+        t = v * (g / P.sqrt(P.sum(v * v)))
+    else:
+        shape = [1] * nd
+        shape[dim] = -1
+        t = v * (g.reshape(shape) / P.sqrt(
+            P.sum(v * v, axis=[i for i in range(nd) if i != dim],
+                  keepdim=True)))
+    object.__setattr__(layer, name, t)
+    return None
+
+
+def remove_weight_norm(layer, name="weight"):
+    """(reference weight_norm_hook.py remove_weight_norm)"""
+    from .layer import Parameter
+    _apply_weight_norm(layer, name,
+                       getattr(layer, "_weight_norm_dim", 0))
+    w = getattr(layer, name)
+    p = Parameter(w._data)
+    del layer._parameters[name + "_g"]
+    del layer._parameters[name + "_v"]
+    if getattr(layer, "_weight_norm_hook", None) is not None:
+        layer._weight_norm_hook.remove()
+        layer._weight_norm_hook = None
+    layer.add_parameter(name, p)
+    setattr(layer, name, p)
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Spectral normalization via power iteration (reference
+    spectral_norm_hook.py): weight / sigma_max, u/v persisted as buffers."""
+    from ..framework import random as _random
+    import jax
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    mat = jnp.moveaxis(w._data, dim, 0).reshape(w._data.shape[dim], -1)
+    h, ww = mat.shape
+    u0 = jax.random.normal(_random.split_key(), (h,))
+    v0 = jax.random.normal(_random.split_key(), (ww,))
+    layer.register_buffer(name + "_u", Tensor(u0 / jnp.linalg.norm(u0)))
+    layer.register_buffer(name + "_v", Tensor(v0 / jnp.linalg.norm(v0)))
+    from .layer import Parameter
+    orig = Parameter(w._data)
+    layer.add_parameter(name + "_orig", orig)
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def pre_hook(lyr, inputs):
+        wd = orig._data
+        m = jnp.moveaxis(wd, dim, 0).reshape(wd.shape[dim], -1)
+        u = getattr(lyr, name + "_u")._data
+        v = getattr(lyr, name + "_v")._data
+        for _ in range(n_power_iterations):
+            v = m.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = m @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        lyr._buffers[name + "_u"] = Tensor(u)
+        lyr._buffers[name + "_v"] = Tensor(v)
+        sigma = u @ m @ v
+        import paddle_tpu as P
+        t = orig / float(sigma)
+        object.__setattr__(lyr, name, t)
+        return None
+
+    layer.register_forward_pre_hook(pre_hook)
+    pre_hook(layer, None)
+    return layer
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """In-place global grad-norm clip (reference clip_grad_norm_.py)."""
+    params = [parameters] if isinstance(parameters, Tensor) else \
+        list(parameters)
+    grads = [p._grad for p in params if p._grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    flat = [jnp.reshape(g._data if hasattr(g, "_data") else g, (-1,))
+            .astype(jnp.float32) for g in grads]
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.concatenate([jnp.abs(f) for f in flat]))
+    else:
+        total = jnp.sum(jnp.concatenate(
+            [jnp.abs(f) ** norm_type for f in flat])) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError("gradient norm is non-finite")
+    coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in params:
+        if p._grad is not None:
+            g = p._grad
+            arr = g._data if hasattr(g, "_data") else g
+            new = (arr.astype(jnp.float32) * coef).astype(arr.dtype)
+            if hasattr(g, "_data"):
+                g._data = new
+            else:
+                p._grad = new
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    """In-place grad value clip (reference clip_grad_value_.py)."""
+    params = [parameters] if isinstance(parameters, Tensor) else \
+        list(parameters)
+    for p in params:
+        if p._grad is not None:
+            g = p._grad
+            arr = g._data if hasattr(g, "_data") else g
+            new = jnp.clip(arr, -clip_value, clip_value)
+            if hasattr(g, "_data"):
+                g._data = new
+            else:
+                p._grad = new
+
+
+def parameters_to_vector(parameters, name=None):
+    """(reference transform_parameters.py parameters_to_vector)"""
+    params = list(parameters)
+    return Tensor(jnp.concatenate(
+        [jnp.reshape(p._data, (-1,)) for p in params]))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    """(reference transform_parameters.py vector_to_parameters)"""
+    params = list(parameters)
+    arr = vec._data if hasattr(vec, "_data") else jnp.asarray(vec)
+    off = 0
+    for p in params:
+        n = int(np.prod(p._data.shape)) if p._data.shape else 1
+        p._data = jnp.reshape(arr[off:off + n],
+                              p._data.shape).astype(p._data.dtype)
+        off += n

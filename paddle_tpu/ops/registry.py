@@ -116,9 +116,22 @@ def apply_op(opname, body, args, kwargs):
     out_avals = [jax.ShapeDtypeStruct(np.shape(a), _tangent_dtype(a))
                  for a in out_flat]
 
-    def vjp_fn(flat_cots):
-        cots = tree_unflatten(out_treedef, list(flat_cots))
-        return raw_vjp(cots)
+    hooks = tape.current_saved_tensors_hooks()
+    if hooks is not None:
+        # saved-tensors hooks (reference autograd/saved_tensors_hooks.py):
+        # pack the saved inputs now; unpack right before backward runs
+        pack, unpack = hooks
+        packed = [pack(t) for t in diff_tensors]
+
+        def vjp_fn(flat_cots):
+            for t, ticket in zip(diff_tensors, packed):
+                unpack(ticket)
+            cots = tree_unflatten(out_treedef, list(flat_cots))
+            return raw_vjp(cots)
+    else:
+        def vjp_fn(flat_cots):
+            cots = tree_unflatten(out_treedef, list(flat_cots))
+            return raw_vjp(cots)
 
     node = tape.GradNode(opname, vjp_fn, diff_tensors, out_avals)
     return _wrap_outputs(opname, out, node=node)

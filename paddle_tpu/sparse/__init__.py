@@ -10,5 +10,9 @@ from .coo import (  # noqa: F401
     SparseCooTensor, SparseCsrTensor, sparse_coo_tensor, sparse_csr_tensor)
 from . import nn  # noqa: F401
 from .unary import (  # noqa: F401
-    sin, tanh, relu, abs, sqrt, square, log1p, neg, expm1, cast, pow)
-from .binary import add, subtract, multiply, divide, matmul, masked_matmul  # noqa: F401
+    sin, tanh, relu, abs, sqrt, square, log1p, neg, expm1, cast, pow,
+    asin, asinh, atan, atanh, sinh, tan, deg2rad, rad2deg, isnan, sum,
+    transpose, reshape, slice, coalesce, is_same_shape, mask_as)
+from .binary import (  # noqa: F401
+    add, subtract, multiply, divide, matmul, masked_matmul, mv, addmm,
+    pca_lowrank)

@@ -71,3 +71,36 @@ def masked_matmul(x, y, mask):
     if isinstance(mask, SparseCsrTensor):
         return SparseCsrTensor(mask.crows_, mask.cols_, vals, mask.shape)
     return SparseCooTensor(coo.indices_, vals, coo.shape)
+
+
+def mv(x, vec, name=None):
+    """Sparse matrix x dense vector (reference sparse/binary.py mv)."""
+    from ..framework.tensor import Tensor
+    import jax.numpy as jnp
+    v = vec._data if hasattr(vec, "_data") else jnp.asarray(vec)
+    return Tensor(jnp.matmul(x.to_dense()._data, v))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x @ y) with sparse x (reference
+    sparse/binary.py addmm)."""
+    from ..framework.tensor import Tensor
+    import jax.numpy as jnp
+    inp = input._data if hasattr(input, "_data") else jnp.asarray(input)
+    yv = y._data if hasattr(y, "_data") else jnp.asarray(y)
+    return Tensor(beta * inp + alpha * jnp.matmul(x.to_dense()._data, yv))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA over a (sparse) matrix (reference
+    sparse/binary.py pca_lowrank)."""
+    from ..framework.tensor import Tensor
+    from ..ops.linalg import svd_lowrank
+    import jax.numpy as jnp
+    dense = x.to_dense() if hasattr(x, "to_dense") else x
+    d = dense._data if hasattr(dense, "_data") else jnp.asarray(dense)
+    if center:
+        d = d - jnp.mean(d, axis=0, keepdims=True)
+    if q is None:
+        q = min(6, *d.shape)
+    return svd_lowrank(Tensor(d), q=q, niter=niter)

@@ -70,3 +70,132 @@ def cast(x, index_dtype=None, value_dtype=None):
         out = SparseCooTensor(out.indices_.astype(np_it), out.values_,
                               out.shape, out._coalesced)
     return out
+
+
+def asin(x):
+    return _map_values(x, jnp.arcsin)
+
+
+def asinh(x):
+    return _map_values(x, jnp.arcsinh)
+
+
+def atan(x):
+    return _map_values(x, jnp.arctan)
+
+
+def atanh(x):
+    return _map_values(x, jnp.arctanh)
+
+
+def sinh(x):
+    return _map_values(x, jnp.sinh)
+
+
+def tan(x):
+    return _map_values(x, jnp.tan)
+
+
+def deg2rad(x):
+    return _map_values(x, jnp.deg2rad)
+
+
+def rad2deg(x):
+    return _map_values(x, jnp.rad2deg)
+
+
+def isnan(x):
+    return _map_values(x, jnp.isnan)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    """Sparse reduce-sum (reference sparse/unary.py sum): dense result
+    unless reducing nothing."""
+    from ..framework.tensor import Tensor
+    dense = x.to_dense()._data
+    out = jnp.sum(dense, axis=axis, keepdims=keepdim)
+    if dtype is not None:
+        from ..framework.dtype import to_np_dtype
+        out = out.astype(to_np_dtype(dtype))
+    return Tensor(out)
+
+
+def transpose(x, perm, name=None):
+    from .coo import SparseCooTensor
+    if isinstance(x, SparseCooTensor):
+        idx = jnp.stack([x.indices_[p] for p in perm])
+        shape = [x.shape[p] for p in perm]
+        return SparseCooTensor(idx, x.values_, shape)
+    # CSR: via COO
+    return transpose(x.to_sparse_coo(), perm).to_sparse_csr()
+
+
+def reshape(x, shape, name=None):
+    from .coo import SparseCooTensor
+    import numpy as _np
+    old_shape = x.shape
+    new_shape = list(shape)
+    numel = int(_np.prod(old_shape))
+    if -1 in new_shape:
+        known = int(_np.prod([t for t in new_shape if t != -1]))
+        new_shape[new_shape.index(-1)] = numel // max(known, 1)
+    if isinstance(x, SparseCooTensor):
+        nd = x.indices_.shape[0]
+        flat = jnp.zeros_like(x.indices_[0])
+        for i in range(nd):
+            flat = flat * old_shape[i] + x.indices_[i]
+        idx = []
+        rem = flat
+        for s in new_shape[::-1]:
+            idx.append(rem % s)
+            rem = rem // s
+        return SparseCooTensor(jnp.stack(idx[::-1]), x.values_, new_shape)
+    return reshape(x.to_sparse_coo(), shape).to_sparse_csr()
+
+
+def slice(x, axes, starts, ends, name=None):
+    """Sparse slice (reference sparse/unary.py slice): filter coordinates
+    inside the window."""
+    from .coo import SparseCooTensor
+    from ..framework.tensor import Tensor as _T
+    coo = x if isinstance(x, SparseCooTensor) else x.to_sparse_coo()
+    # static-shape unfriendly (nnz changes): computed on host
+    import numpy as _np
+    idx = _np.asarray(coo.indices_)
+    vals = _np.asarray(coo.values_)
+    keep = _np.ones(idx.shape[1], bool)
+    new_shape = list(coo.shape)
+    for ax, st, en in zip(axes, starts, ends):
+        st = st + coo.shape[ax] if st < 0 else st
+        en = min(en + coo.shape[ax] if en < 0 else en, coo.shape[ax])
+        keep &= (idx[ax] >= st) & (idx[ax] < en)
+        new_shape[ax] = en - st
+    idx = idx[:, keep].copy()
+    for ax, st, _ in zip(axes, starts, ends):
+        st = st + coo.shape[ax] if st < 0 else st
+        idx[ax] -= st
+    out = SparseCooTensor(jnp.asarray(idx), jnp.asarray(vals[keep]),
+                          new_shape)
+    return out if isinstance(x, SparseCooTensor) else out.to_sparse_csr()
+
+
+def coalesce(x, name=None):
+    return x.coalesce()
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+def mask_as(x, mask, name=None):
+    """Dense x masked by the sparsity pattern of `mask` (reference
+    sparse/unary.py mask_as)."""
+    from .coo import SparseCooTensor, SparseCsrTensor
+    dense = x._data if hasattr(x, "_data") else jnp.asarray(x)
+    if isinstance(mask, SparseCooTensor):
+        idx = tuple(mask.indices_[i] for i in range(mask.indices_.shape[0]))
+        return SparseCooTensor(mask.indices_, dense[idx], mask.shape)
+    coo = mask.to_sparse_coo()
+    idx = tuple(coo.indices_[i] for i in range(coo.indices_.shape[0]))
+    return SparseCooTensor(coo.indices_, dense[idx],
+                           coo.shape).to_sparse_csr()

@@ -40,3 +40,45 @@ class _UniqueName:
 
 
 unique_name = _UniqueName()
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Decorator marking an API deprecated (reference
+    utils/deprecated.py)."""
+    import functools
+    import warnings
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = f"API {fn.__name__} is deprecated since {since or '?'}"
+            if update_to:
+                msg += f", use {update_to} instead"
+            if reason:
+                msg += f": {reason}"
+            if level == 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed framework version (reference
+    utils/install_check.py require_version)."""
+    from .. import __version__
+
+    def as_tuple(v):
+        return tuple(int(x) for x in str(v).split(".")[:3] if x.isdigit())
+
+    cur = as_tuple(__version__)
+    if as_tuple(min_version) > cur:
+        raise Exception(
+            f"version {__version__} < required minimum {min_version}")
+    if max_version and as_tuple(max_version) < cur:
+        raise Exception(
+            f"version {__version__} > allowed maximum {max_version}")
+
+
+__all__ += ["deprecated", "require_version"]

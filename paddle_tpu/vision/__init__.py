@@ -4,3 +4,37 @@ from . import models  # noqa: F401
 from . import transforms  # noqa: F401
 from . import datasets  # noqa: F401
 from . import ops  # noqa: F401
+
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend):
+    """(reference vision/image.py set_image_backend)"""
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(f"unsupported backend {backend!r}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image file (reference vision/image.py image_load)."""
+    backend = backend or _image_backend
+    if backend == "cv2":
+        try:
+            import cv2
+            return cv2.imread(path)
+        except ImportError as e:
+            raise RuntimeError("cv2 backend needs opencv installed") from e
+    from PIL import Image
+    img = Image.open(path)
+    if backend == "tensor":
+        import numpy as np
+        from ..framework.tensor import Tensor
+        import jax.numpy as jnp
+        return Tensor(jnp.asarray(np.asarray(img)))
+    return img

@@ -15,7 +15,8 @@ import numpy as np
 
 from ..io.dataset import Dataset
 
-__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeImageNet"]
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeImageNet",
+           "DatasetFolder", "ImageFolder", "Flowers", "VOC2012"]
 
 
 class MNIST(Dataset):
@@ -121,3 +122,111 @@ class FakeImageNet(Dataset):
 
     def __len__(self):
         return self.size
+
+
+_IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                   ".tiff", ".webp")
+
+
+class DatasetFolder(Dataset):
+    """Generic folder-per-class dataset (reference
+    vision/datasets/folder.py DatasetFolder):
+    root/class_x/xxx.ext -> (sample, class_index)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        extensions = extensions or _IMG_EXTENSIONS
+        classes = sorted(
+            d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError(f"no class folders found in {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for fname in sorted(files):
+                    path = os.path.join(dirpath, fname)
+                    ok = is_valid_file(path) if is_valid_file else \
+                        fname.lower().endswith(tuple(extensions))
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f"no valid files found under {root}")
+
+    @staticmethod
+    def _default_loader(path):
+        from PIL import Image
+        with open(path, "rb") as f:
+            return Image.open(f).convert("RGB")
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat image-folder dataset, no labels (reference
+    vision/datasets/folder.py ImageFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+        self.root = root
+        self.transform = transform
+        self.loader = loader or DatasetFolder._default_loader
+        extensions = extensions or _IMG_EXTENSIONS
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                path = os.path.join(dirpath, fname)
+                ok = is_valid_file(path) if is_valid_file else \
+                    fname.lower().endswith(tuple(extensions))
+                if ok:
+                    self.samples.append(path)
+        if not self.samples:
+            raise RuntimeError(f"no valid files found under {root}")
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Flowers(Dataset):
+    """Flowers-102 (reference vision/datasets/flowers.py).  Zero-egress:
+    requires pre-downloaded files."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        raise NotImplementedError(
+            "Flowers needs its three archive files; there is no download "
+            "in this environment — place them locally and load with "
+            "DatasetFolder, or use FakeImageNet for synthetic data")
+
+
+class VOC2012(Dataset):
+    """VOC2012 segmentation (reference vision/datasets/voc2012.py).
+    Zero-egress: requires a pre-downloaded archive."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        raise NotImplementedError(
+            "VOC2012 needs its archive; there is no download in this "
+            "environment — extract it and load with DatasetFolder")

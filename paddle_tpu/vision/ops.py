@@ -15,15 +15,18 @@ __all__ = ["nms", "box_iou", "roi_align", "roi_pool", "psroi_pool",
 
 
 @op(name="box_iou")
-def box_iou(boxes1, boxes2):
-    """IoU matrix between [N,4] and [M,4] xyxy boxes."""
+def box_iou(boxes1, boxes2, offset=0.0):
+    """IoU matrix between [N,4] and [M,4] xyxy boxes; offset=1 for
+    pixel-coordinate (non-normalized) boxes."""
     a1, a2 = boxes1[:, None, :], boxes2[None, :, :]
     lt = jnp.maximum(a1[..., :2], a2[..., :2])
     rb = jnp.minimum(a1[..., 2:], a2[..., 2:])
-    wh = jnp.clip(rb - lt, 0)
+    wh = jnp.clip(rb - lt + offset, 0)
     inter = wh[..., 0] * wh[..., 1]
-    area1 = (boxes1[:, 2] - boxes1[:, 0]) * (boxes1[:, 3] - boxes1[:, 1])
-    area2 = (boxes2[:, 2] - boxes2[:, 0]) * (boxes2[:, 3] - boxes2[:, 1])
+    area1 = ((boxes1[:, 2] - boxes1[:, 0] + offset)
+             * (boxes1[:, 3] - boxes1[:, 1] + offset))
+    area2 = ((boxes2[:, 2] - boxes2[:, 0] + offset)
+             * (boxes2[:, 3] - boxes2[:, 1] + offset))
     return inter / (area1[:, None] + area2[None, :] - inter + 1e-9)
 
 
@@ -553,7 +556,9 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
         cls_of = idx // m
         box_of = idx % m
         bsel = box[box_of]                                   # [topk, 4]
-        iou = box_iou.__op_body__(bsel, bsel)
+        # pixel-coordinate boxes (normalized=False) span an extra +1
+        iou = box_iou.__op_body__(bsel, bsel,
+                                  offset=0.0 if normalized else 1.0)
         same_cls = cls_of[:, None] == cls_of[None, :]
         upper = jnp.triu(jnp.ones((topk, topk), bool), 1)
         # pair[i, j] = iou(suppressor i, victim j) for i < j (score-sorted)

@@ -257,3 +257,8 @@ class RandomResizedCrop(BaseTransform):
                               self.interpolation)
         return resize(center_crop(img, min(h, w)), self.size,
                       self.interpolation)
+
+
+# surface part 2 (color ops, warps, erasing)
+from .transforms_extra import *  # noqa: E402,F401,F403
+from .transforms_extra import adjust_saturation  # noqa: E402,F401

@@ -1,0 +1,416 @@
+"""Vision transforms part 2 (reference: python/paddle/vision/transforms/
+{transforms,functional}.py — color ops, geometric warps, erasing).
+Numpy HWC bodies like the rest of the module; warps use inverse-map
+bilinear sampling."""
+from __future__ import annotations
+
+import math
+import numbers
+import random
+
+import numpy as np
+
+from .transforms import BaseTransform, _as_hwc, _pad_spec
+
+__all__ = [
+    "adjust_brightness", "adjust_contrast", "adjust_hue", "to_grayscale",
+    "rotate", "affine", "perspective", "erase", "pad",
+    "ColorJitter", "ContrastTransform", "SaturationTransform",
+    "HueTransform", "Grayscale", "RandomRotation", "RandomAffine",
+    "RandomPerspective", "RandomErasing",
+]
+
+
+def _restore_dtype(out, ref):
+    if ref.dtype == np.uint8:
+        return np.clip(out, 0, 255).astype(np.uint8)
+    return out.astype(ref.dtype)
+
+
+def adjust_brightness(img, brightness_factor):
+    img = _as_hwc(img)
+    return _restore_dtype(img.astype(np.float32) * brightness_factor, img)
+
+
+def adjust_contrast(img, contrast_factor):
+    img = _as_hwc(img)
+    f = img.astype(np.float32)
+    gray_mean = (f @ np.array([0.299, 0.587, 0.114], np.float32)).mean() \
+        if img.shape[-1] == 3 else f.mean()
+    return _restore_dtype(gray_mean + contrast_factor * (f - gray_mean), img)
+
+
+def adjust_saturation(img, saturation_factor):
+    img = _as_hwc(img)
+    f = img.astype(np.float32)
+    gray = f @ np.array([0.299, 0.587, 0.114], np.float32)
+    gray = gray[..., None]
+    return _restore_dtype(gray + saturation_factor * (f - gray), img)
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor (in [-0.5, 0.5]) via HSV roundtrip
+    (reference transforms/functional_cv2.py adjust_hue)."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    img = _as_hwc(img)
+    f = img.astype(np.float32) / (255.0 if img.dtype == np.uint8 else 1.0)
+    r, g, b = f[..., 0], f[..., 1], f[..., 2]
+    maxc = f.max(-1)
+    minc = f.min(-1)
+    v = maxc
+    delta = maxc - minc
+    s = np.where(maxc > 0, delta / np.maximum(maxc, 1e-12), 0.0)
+    dz = np.maximum(delta, 1e-12)
+    rc = (maxc - r) / dz
+    gc = (maxc - g) / dz
+    bc = (maxc - b) / dz
+    h = np.where(r == maxc, bc - gc,
+                 np.where(g == maxc, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = (h / 6.0) % 1.0
+    h = np.where(delta == 0, 0.0, h)
+    h = (h + hue_factor) % 1.0
+    i = np.floor(h * 6.0)
+    fr = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * fr)
+    t = v * (1.0 - s * (1.0 - fr))
+    i = i.astype(np.int32) % 6
+    r2 = np.choose(i, [v, q, p, p, t, v])
+    g2 = np.choose(i, [t, v, v, q, p, p])
+    b2 = np.choose(i, [p, p, t, v, v, q])
+    out = np.stack([r2, g2, b2], axis=-1)
+    if img.dtype == np.uint8:
+        out = out * 255.0
+    return _restore_dtype(out, img)
+
+
+def to_grayscale(img, num_output_channels=1):
+    img = _as_hwc(img)
+    f = img.astype(np.float32)
+    gray = f @ np.array([0.299, 0.587, 0.114], np.float32) \
+        if img.shape[-1] == 3 else f[..., 0]
+    out = np.repeat(gray[..., None], num_output_channels, axis=-1)
+    return _restore_dtype(out, img)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    img = _as_hwc(img)
+    (t, b), (l, r) = _pad_spec(padding)
+    if padding_mode == "constant":
+        return np.pad(img, ((t, b), (l, r), (0, 0)), constant_values=fill)
+    mode = {"edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    return np.pad(img, ((t, b), (l, r), (0, 0)), mode=mode)
+
+
+def _inverse_warp(img, inv_matrix, out_shape=None, interpolation="bilinear",
+                  fill=0):
+    """Sample img at inv_matrix @ [x_out, y_out, 1] (3x3 projective)."""
+    img = _as_hwc(img)
+    h, w = img.shape[:2]
+    oh, ow = out_shape or (h, w)
+    ys, xs = np.meshgrid(np.arange(oh, dtype=np.float32),
+                         np.arange(ow, dtype=np.float32), indexing="ij")
+    ones = np.ones_like(xs)
+    pts = np.stack([xs, ys, ones], axis=0).reshape(3, -1)
+    src = inv_matrix @ pts
+    sx = src[0] / np.maximum(np.abs(src[2]), 1e-9) * np.sign(src[2])
+    sy = src[1] / np.maximum(np.abs(src[2]), 1e-9) * np.sign(src[2])
+    sx = sx.reshape(oh, ow)
+    sy = sy.reshape(oh, ow)
+    f = img.astype(np.float32)
+    if interpolation == "nearest":
+        xi = np.round(sx).astype(np.int64)
+        yi = np.round(sy).astype(np.int64)
+        valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+        out = np.full((oh, ow, img.shape[2]), float(fill), np.float32)
+        out[valid] = f[yi[valid], xi[valid]]
+    else:
+        x0 = np.floor(sx).astype(np.int64)
+        y0 = np.floor(sy).astype(np.int64)
+        wx = (sx - x0)[..., None]
+        wy = (sy - y0)[..., None]
+        out = np.zeros((oh, ow, img.shape[2]), np.float32)
+        weight_sum = np.zeros((oh, ow, 1), np.float32)
+        for dy, wgt_y in ((0, 1 - wy), (1, wy)):
+            for dx, wgt_x in ((0, 1 - wx), (1, wx)):
+                xi = x0 + dx
+                yi = y0 + dy
+                valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+                wgt = wgt_y * wgt_x
+                vals = np.zeros_like(out)
+                vals[valid] = f[yi[valid], xi[valid]]
+                out += vals * wgt * valid[..., None]
+                weight_sum += wgt * valid[..., None]
+        fillv = np.float32(fill)
+        out = np.where(weight_sum > 1e-6,
+                       out + fillv * (1 - weight_sum), fillv)
+    return _restore_dtype(out, img)
+
+
+def _affine_inv_matrix(angle, translate, scale, shear, center):
+    # positive angle = counter-clockwise on screen; array coords have y
+    # down, so negate (PIL/torchvision convention)
+    cx, cy = center
+    rot = math.radians(-angle)
+    sx = math.radians(shear[0])
+    sy = math.radians(shear[1])
+    # forward: T(center) R S Shear T(-center) T(translate)
+    a = math.cos(rot - sy) / math.cos(sy)
+    b = -math.cos(rot - sy) * math.tan(sx) / math.cos(sy) - math.sin(rot)
+    c = math.sin(rot - sy) / math.cos(sy)
+    d = -math.sin(rot - sy) * math.tan(sx) / math.cos(sy) + math.cos(rot)
+    m = np.array([[a, b, 0.0], [c, d, 0.0], [0.0, 0.0, 1.0]], np.float64)
+    m[:2, :2] *= scale
+    fwd = (np.array([[1, 0, cx + translate[0]], [0, 1, cy + translate[1]],
+                     [0, 0, 1]], np.float64)
+           @ m
+           @ np.array([[1, 0, -cx], [0, 1, -cy], [0, 0, 1]], np.float64))
+    return np.linalg.inv(fwd)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    img_np = _as_hwc(img)
+    h, w = img_np.shape[:2]
+    ctr = center or ((w - 1) * 0.5, (h - 1) * 0.5)
+    out_shape = None
+    if expand:
+        rad = math.radians(angle)
+        nw = int(abs(w * math.cos(rad)) + abs(h * math.sin(rad)) + 0.5)
+        nh = int(abs(h * math.cos(rad)) + abs(w * math.sin(rad)) + 0.5)
+        out_shape = (nh, nw)
+        inv = _affine_inv_matrix(angle, (0, 0), 1.0, (0.0, 0.0), ctr)
+        # shift so the rotated content is centered in the expanded canvas
+        shift = np.array([[1, 0, (w - nw) / 2.0], [0, 1, (h - nh) / 2.0],
+                          [0, 0, 1]], np.float64)
+        inv = inv @ shift
+    else:
+        inv = _affine_inv_matrix(angle, (0, 0), 1.0, (0.0, 0.0), ctr)
+    return _inverse_warp(img_np, inv, out_shape, interpolation, fill)
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    img_np = _as_hwc(img)
+    h, w = img_np.shape[:2]
+    if isinstance(shear, numbers.Number):
+        shear = (shear, 0.0)
+    ctr = center or ((w - 1) * 0.5, (h - 1) * 0.5)
+    inv = _affine_inv_matrix(angle, tuple(translate), scale, tuple(shear),
+                             ctr)
+    return _inverse_warp(img_np, inv, None, interpolation, fill)
+
+
+def _perspective_coeffs(startpoints, endpoints):
+    a = []
+    bvec = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        a.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        a.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        bvec += [sx, sy]
+    coef = np.linalg.solve(np.asarray(a, np.float64),
+                           np.asarray(bvec, np.float64))
+    return np.concatenate([coef, [1.0]]).reshape(3, 3)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Warp mapping startpoints -> endpoints (reference
+    transforms/functional.py perspective)."""
+    inv = _perspective_coeffs(startpoints, endpoints)
+    return _inverse_warp(_as_hwc(img), inv, None, interpolation, fill)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Erase a region with value v (reference transforms/functional.py
+    erase).  Accepts HWC/CHW numpy or Tensor."""
+    from ..framework.tensor import Tensor
+    if isinstance(img, Tensor):
+        arr = np.asarray(img.numpy()).copy()
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) \
+            and arr.shape[0] <= arr.shape[2]
+        if chw:
+            arr[:, i:i + h, j:j + w] = v
+        else:
+            arr[i:i + h, j:j + w] = v
+        import paddle_tpu
+        return paddle_tpu.to_tensor(arr)
+    arr = img if inplace else img.copy()
+    if arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[0] <= \
+            arr.shape[2]:
+        arr[:, i:i + h, j:j + w] = v
+    else:
+        arr[i:i + h, j:j + w] = v
+    return arr
+
+
+# ---------------------------------------------------------------- classes
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        if value < 0:
+            raise ValueError("contrast value should be non-negative")
+        self.value = value
+
+    def _apply_image(self, img):
+        f = 1 + random.uniform(-self.value, self.value)
+        return adjust_contrast(img, f)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        if value < 0:
+            raise ValueError("saturation value should be non-negative")
+        self.value = value
+
+    def _apply_image(self, img):
+        f = 1 + random.uniform(-self.value, self.value)
+        return adjust_saturation(img, f)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value should be in [0, 0.5]")
+        self.value = value
+
+    def _apply_image(self, img):
+        return adjust_hue(img, random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self.brightness = brightness
+        self.contrast = contrast
+        self.saturation = saturation
+        self.hue = hue
+
+    def _apply_image(self, img):
+        ops = []
+        if self.brightness:
+            ops.append(lambda im: adjust_brightness(
+                im, 1 + random.uniform(-self.brightness, self.brightness)))
+        if self.contrast:
+            ops.append(lambda im: adjust_contrast(
+                im, 1 + random.uniform(-self.contrast, self.contrast)))
+        if self.saturation:
+            ops.append(lambda im: adjust_saturation(
+                im, 1 + random.uniform(-self.saturation, self.saturation)))
+        if self.hue:
+            ops.append(lambda im: adjust_hue(
+                im, random.uniform(-self.hue, self.hue)))
+        random.shuffle(ops)
+        for op in ops:
+            img = op(img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.args = (interpolation, expand, center, fill)
+
+    def _apply_image(self, img):
+        interp, expand, center, fill = self.args
+        angle = random.uniform(*self.degrees)
+        return rotate(img, angle, interp, expand, center, fill)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.args = (interpolation, fill, center)
+
+    def _apply_image(self, img):
+        interp, fill, center = self.args
+        arr = _as_hwc(img)
+        h, w = arr.shape[:2]
+        angle = random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate:
+            tx = random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = random.uniform(*self.scale) if self.scale else 1.0
+        sh = (random.uniform(-self.shear, self.shear), 0.0) \
+            if isinstance(self.shear, numbers.Number) else \
+            ((random.uniform(*self.shear[:2]),
+              random.uniform(*self.shear[2:]) if len(self.shear) == 4
+              else 0.0) if self.shear else (0.0, 0.0))
+        return affine(arr, angle, (tx, ty), sc, sh, interp, fill, center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.args = (interpolation, fill)
+
+    def _apply_image(self, img):
+        interp, fill = self.args
+        if random.random() >= self.prob:
+            return _as_hwc(img)
+        arr = _as_hwc(img)
+        h, w = arr.shape[:2]
+        d = self.distortion_scale
+        half_h = int(h * d / 2)
+        half_w = int(w * d / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(random.randint(0, half_w), random.randint(0, half_h)),
+               (w - 1 - random.randint(0, half_w),
+                random.randint(0, half_h)),
+               (w - 1 - random.randint(0, half_w),
+                h - 1 - random.randint(0, half_h)),
+               (random.randint(0, half_w),
+                h - 1 - random.randint(0, half_h))]
+        return perspective(arr, start, end, interp, fill)
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def _apply_image(self, img):
+        if random.random() >= self.prob:
+            return img
+        from ..framework.tensor import Tensor
+        arr = np.asarray(img.numpy()) if isinstance(img, Tensor) else img
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and \
+            arr.shape[0] <= arr.shape[2]
+        h, w = (arr.shape[1], arr.shape[2]) if chw else arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = random.uniform(*self.ratio)
+            eh = int(round(math.sqrt(target / ar)))
+            ew = int(round(math.sqrt(target * ar)))
+            if eh < h and ew < w:
+                i = random.randint(0, h - eh)
+                j = random.randint(0, w - ew)
+                return erase(img, i, j, eh, ew, self.value, self.inplace)
+        return img
