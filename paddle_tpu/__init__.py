@@ -126,6 +126,7 @@ from . import incubate  # noqa: F401
 from . import utils  # noqa: F401
 from . import onnx  # noqa: F401
 from . import version  # noqa: F401
+from . import regularizer  # noqa: F401
 
 
 # -- surface part 2: misc top-level API -----------------------------------

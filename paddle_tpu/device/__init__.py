@@ -122,3 +122,134 @@ class cuda:
     def synchronize(device=None):
         import jax
         (jax.device_put(0) + 0).block_until_ready()
+
+
+class IPUPlace(_Place):
+    def __init__(self):
+        super().__init__(0)
+
+
+class Stream:
+    """Stream surface (reference device/__init__.py Stream over C++
+    streams).  XLA owns real streams; this is an ordering token whose
+    synchronize() drains the device queue."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+        self.priority = priority
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        event.synchronize()
+
+    def wait_stream(self, stream):
+        stream.synchronize()
+
+    def record_event(self, event=None):
+        event = event or Event()
+        event.record(self)
+        return event
+
+
+class Event:
+    """Event surface (reference device/__init__.py Event)."""
+
+    def __init__(self, device=None, enable_timing=False, blocking=False,
+                 interprocess=False):
+        self.device = device
+        self._recorded = False
+
+    def record(self, stream=None):
+        self._recorded = True
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+_current_stream = Stream()
+
+
+def current_stream(device=None):
+    return _current_stream
+
+
+def set_stream(stream):
+    global _current_stream
+    prev = _current_stream
+    _current_stream = stream
+    return prev
+
+
+class stream_guard:
+    def __init__(self, stream):
+        self._stream = stream
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = set_stream(self._stream)
+        return self._stream
+
+    def __exit__(self, *exc):
+        set_stream(self._prev)
+        return False
+
+
+def synchronize(device=None):
+    """Block until all queued device work completes (reference
+    device/cuda synchronize); jax effectively syncs via a trivial fetch."""
+    import jax
+    jax.block_until_ready(
+        jax.device_put(0, jax.devices()[0] if device is None else device))
+
+
+def get_all_device_type():
+    import jax
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    import jax
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+def get_cudnn_version():
+    return None  # no cuDNN on TPU
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_custom_device(device_type=None):
+    import jax
+    return any(d.platform not in ("cpu", "gpu", "tpu")
+               for d in jax.devices())
+
+
+def is_compiled_with_distribute():
+    return True  # XLA collectives are always in

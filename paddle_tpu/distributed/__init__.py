@@ -25,6 +25,8 @@ from .fleet.meta_parallel.parallel_wrappers import DataParallel
 from .fleet.base import ParallelMode
 from . import pipelining
 from .store import TCPStore, create_or_get_global_tcp_store
+from .watchdog import (CommTask, CommTaskManager, get_comm_task_manager,
+                       comm_guard)
 from . import io
 from .compat import (
     ReduceType, Strategy, DistAttr, DistModel, to_static, alltoall_single,
@@ -54,4 +56,5 @@ __all__ = [
     "gloo_release", "spawn", "split", "dtensor_from_fn",
     "shard_dataloader", "shard_scaler", "InMemoryDataset", "QueueDataset",
     "CountFilterEntry", "ProbabilityEntry", "ShowClickEntry", "io",
+    "CommTask", "CommTaskManager", "get_comm_task_manager", "comm_guard",
 ]

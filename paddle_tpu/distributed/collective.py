@@ -367,7 +367,9 @@ def split(x, num_or_sections, axis=0, group=None):
 def wait(tensor, group=None, use_calc_stream=True):
     arr = tensor._data if isinstance(tensor, Tensor) else tensor
     if hasattr(arr, "block_until_ready"):
-        arr.block_until_ready()
+        from .watchdog import comm_guard
+        with comm_guard("wait", group):
+            arr.block_until_ready()
     return tensor
 
 

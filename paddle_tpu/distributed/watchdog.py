@@ -1,0 +1,171 @@
+"""Communication watchdog (reference: paddle/phi/core/distributed/
+comm_task_manager.h:37 CommTaskManager + nccl_comm_task.cc — an async loop
+that detects hung/errored NCCL collectives and aborts with diagnostics).
+
+TPU formulation: XLA collectives can't error mid-flight the way NCCL ring
+ops can, but a *hung* collective (peer died, coordination service wedged)
+blocks the Python thread on a device fetch forever.  The watchdog is a
+host-side monitor: collectives register a CommTask around the blocking
+region; a daemon thread flags tasks that exceed their timeout, logs every
+in-flight task, and (optionally) aborts the process so the elastic
+launcher's exit-code path can relaunch (fleet/elastic.py)."""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+__all__ = ["CommTask", "CommTaskManager", "get_comm_task_manager",
+           "comm_guard"]
+
+
+class CommTask:
+    """One in-flight communication op (reference nccl_comm_task.cc
+    NCCLCommTask)."""
+
+    __slots__ = ("name", "group", "start_time", "timeout", "done",
+                 "flagged", "seq")
+
+    def __init__(self, name, group=None, timeout=None, seq=0):
+        self.name = name
+        self.group = group
+        self.start_time = time.monotonic()
+        self.timeout = timeout
+        self.done = False
+        self.flagged = False
+        self.seq = seq
+
+    def elapsed(self):
+        return time.monotonic() - self.start_time
+
+    def __repr__(self):
+        state = "done" if self.done else (
+            "HUNG" if self.flagged else "in-flight")
+        return (f"CommTask(#{self.seq} {self.name} group={self.group} "
+                f"{self.elapsed():.1f}s {state})")
+
+
+class CommTaskManager:
+    """Registry + monitor loop (reference comm_task_manager.h:55
+    CommTaskLoop).  Default timeout from FLAGS or
+    PADDLE_COMM_TIMEOUT_SECONDS (the reference reads the process-group
+    timeout); abort-on-hang mirrors FLAGS_enable_async_trace's abort
+    path via the elastic exit code so the launcher relaunches."""
+
+    ELASTIC_EXIT_CODE = 101  # fleet/elastic/manager.py contract
+
+    def __init__(self, default_timeout=None, abort_on_hang=False,
+                 poll_interval=5.0):
+        env = os.environ.get("PADDLE_COMM_TIMEOUT_SECONDS")
+        self.default_timeout = default_timeout if default_timeout is not None \
+            else (float(env) if env else 1800.0)
+        self.abort_on_hang = abort_on_hang
+        self.poll_interval = poll_interval
+        self._tasks: dict[int, CommTask] = {}
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._thread = None
+        self._stop = threading.Event()
+        self._hang_hooks = []
+
+    # ------------------------------------------------------------ tasks
+    def start_task(self, name, group=None, timeout=None):
+        with self._lock:
+            self._seq += 1
+            task = CommTask(name, group,
+                            timeout if timeout is not None
+                            else self.default_timeout, self._seq)
+            self._tasks[task.seq] = task
+        self._ensure_thread()
+        return task
+
+    def end_task(self, task):
+        task.done = True
+        with self._lock:
+            self._tasks.pop(task.seq, None)
+
+    def in_flight(self):
+        with self._lock:
+            return list(self._tasks.values())
+
+    def register_hang_hook(self, fn):
+        """fn(task) called (once per task) when a task exceeds its
+        timeout."""
+        self._hang_hooks.append(fn)
+
+    # ------------------------------------------------------------- loop
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="comm-watchdog", daemon=True)
+            self._thread.start()
+
+    def _loop(self):
+        import logging
+        log = logging.getLogger("paddle_tpu.comm_watchdog")
+        while not self._stop.wait(self.poll_interval):
+            hung = []
+            with self._lock:
+                if not self._tasks:
+                    continue
+                for task in self._tasks.values():
+                    if (not task.done and not task.flagged
+                            and task.timeout
+                            and task.elapsed() > task.timeout):
+                        task.flagged = True
+                        hung.append(task)
+            for task in hung:
+                log.error(
+                    "comm watchdog: %r exceeded its %.0fs timeout; "
+                    "in-flight tasks: %r", task, task.timeout,
+                    self.in_flight())
+                for hook in self._hang_hooks:
+                    try:
+                        hook(task)
+                    except Exception:   # noqa: BLE001 — keep watching
+                        log.exception("hang hook failed")
+                if self.abort_on_hang:
+                    log.error("comm watchdog: aborting with elastic exit "
+                              "code %d", self.ELASTIC_EXIT_CODE)
+                    os._exit(self.ELASTIC_EXIT_CODE)
+
+    def shutdown(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.poll_interval)
+            self._thread = None
+
+
+_manager = None
+_manager_lock = threading.Lock()
+
+
+def get_comm_task_manager():
+    global _manager
+    with _manager_lock:
+        if _manager is None:
+            _manager = CommTaskManager()
+        return _manager
+
+
+class comm_guard:
+    """Context manager wrapping a (potentially blocking) collective:
+        with comm_guard("all_reduce", group):
+            arr.block_until_ready()
+    """
+
+    def __init__(self, name, group=None, timeout=None):
+        self._name = name
+        self._group = group
+        self._timeout = timeout
+        self._task = None
+
+    def __enter__(self):
+        self._task = get_comm_task_manager().start_task(
+            self._name, self._group, self._timeout)
+        return self._task
+
+    def __exit__(self, *exc):
+        get_comm_task_manager().end_task(self._task)
+        return False

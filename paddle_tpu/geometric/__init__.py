@@ -82,3 +82,164 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add",
     if out_size is None:
         out_size = x.shape[0]
     return _POOLS[reduce_op](msgs, dst_index, num_segments=out_size)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Reindex node ids to a compact range (reference geometric/
+    reindex.py reindex_graph).  Host-side — eager only."""
+    import numpy as np
+    from ..framework.tensor import Tensor
+    import jax.numpy as jnp
+    xs = np.asarray(x.numpy() if hasattr(x, "numpy") else x).reshape(-1)
+    nb = np.asarray(neighbors.numpy() if hasattr(neighbors, "numpy")
+                    else neighbors).reshape(-1)
+    nodes = np.concatenate([xs, nb])
+    uniq, idx = np.unique(nodes, return_index=True)
+    order = nodes[np.sort(idx)]  # first-seen order (x first)
+    remap = {int(v): i for i, v in enumerate(order)}
+    reindex_src = np.asarray([remap[int(v)] for v in nb], np.int64)
+    cnt = np.asarray(count.numpy() if hasattr(count, "numpy")
+                     else count).reshape(-1)
+    reindex_dst = np.repeat(np.arange(len(xs), dtype=np.int64), cnt)
+    return (Tensor(jnp.asarray(reindex_src)),
+            Tensor(jnp.asarray(reindex_dst)),
+            Tensor(jnp.asarray(order)))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous variant: neighbors/count are per-edge-type lists."""
+    import numpy as np
+    from ..framework.tensor import Tensor
+    import jax.numpy as jnp
+    nbs = [np.asarray(n.numpy() if hasattr(n, "numpy") else n).reshape(-1)
+           for n in neighbors]
+    cnts = [np.asarray(c.numpy() if hasattr(c, "numpy") else c).reshape(-1)
+            for c in count]
+    merged_n = np.concatenate(nbs)
+    xs = np.asarray(x.numpy() if hasattr(x, "numpy") else x).reshape(-1)
+    nodes = np.concatenate([xs, merged_n])
+    _, idx = np.unique(nodes, return_index=True)
+    order = nodes[np.sort(idx)]
+    remap = {int(v): i for i, v in enumerate(order)}
+    srcs, dsts = [], []
+    for nb, cnt in zip(nbs, cnts):
+        srcs.append(np.asarray([remap[int(v)] for v in nb], np.int64))
+        dsts.append(np.repeat(np.arange(len(xs), dtype=np.int64), cnt))
+    return (Tensor(jnp.asarray(np.concatenate(srcs))),
+            Tensor(jnp.asarray(np.concatenate(dsts))),
+            Tensor(jnp.asarray(order)))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniform neighbor sampling over CSC (reference geometric/
+    sampling/neighbors.py sample_neighbors).  Host-side — eager only."""
+    import numpy as np
+    from ..framework.tensor import Tensor
+    import jax.numpy as jnp
+    rows = np.asarray(row.numpy() if hasattr(row, "numpy") else row)
+    cptr = np.asarray(colptr.numpy() if hasattr(colptr, "numpy")
+                      else colptr)
+    nodes = np.asarray(input_nodes.numpy() if hasattr(input_nodes, "numpy")
+                       else input_nodes).reshape(-1)
+    rng = np.random.default_rng()
+    out_n, out_c, out_e = [], [], []
+    for n in nodes:
+        beg, end = int(cptr[n]), int(cptr[n + 1])
+        neigh = rows[beg:end]
+        eid = np.arange(beg, end)
+        if 0 <= sample_size < len(neigh):
+            sel = rng.choice(len(neigh), size=sample_size, replace=False)
+            neigh = neigh[sel]
+            eid = eid[sel]
+        out_n.append(neigh)
+        out_e.append(eid)
+        out_c.append(len(neigh))
+    neighbors = Tensor(jnp.asarray(np.concatenate(out_n)
+                                   if out_n else np.zeros(0, np.int64)))
+    counts = Tensor(jnp.asarray(np.asarray(out_c, np.int32)))
+    if return_eids:
+        return neighbors, counts, Tensor(jnp.asarray(
+            np.concatenate(out_e) if out_e else np.zeros(0, np.int64)))
+    return neighbors, counts
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weighted variant (reference geometric/sampling/neighbors.py
+    weighted_sample_neighbors)."""
+    import numpy as np
+    from ..framework.tensor import Tensor
+    import jax.numpy as jnp
+    rows = np.asarray(row.numpy() if hasattr(row, "numpy") else row)
+    cptr = np.asarray(colptr.numpy() if hasattr(colptr, "numpy")
+                      else colptr)
+    w = np.asarray(edge_weight.numpy() if hasattr(edge_weight, "numpy")
+                   else edge_weight).reshape(-1)
+    nodes = np.asarray(input_nodes.numpy() if hasattr(input_nodes, "numpy")
+                       else input_nodes).reshape(-1)
+    rng = np.random.default_rng()
+    out_n, out_c, out_e = [], [], []
+    for n in nodes:
+        beg, end = int(cptr[n]), int(cptr[n + 1])
+        neigh = rows[beg:end]
+        eid = np.arange(beg, end)
+        if 0 <= sample_size < len(neigh):
+            p = w[beg:end].astype(np.float64)
+            nonzero = int((p > 0).sum())
+            if p.sum() > 0 and nonzero >= sample_size:
+                p = p / p.sum()
+                sel = rng.choice(len(neigh), size=sample_size,
+                                 replace=False, p=p)
+            elif p.sum() > 0:
+                # fewer positively-weighted neighbors than requested:
+                # take every weighted one, fill the rest uniformly
+                weighted = np.flatnonzero(p > 0)
+                rest = np.flatnonzero(p <= 0)
+                fill = rng.choice(rest, size=sample_size - nonzero,
+                                  replace=False)
+                sel = np.concatenate([weighted, fill])
+            else:
+                sel = rng.choice(len(neigh), size=sample_size,
+                                 replace=False)
+            neigh = neigh[sel]
+            eid = eid[sel]
+        out_n.append(neigh)
+        out_e.append(eid)
+        out_c.append(len(neigh))
+    neighbors = Tensor(jnp.asarray(np.concatenate(out_n)
+                                   if out_n else np.zeros(0, np.int64)))
+    counts = Tensor(jnp.asarray(np.asarray(out_c, np.int32)))
+    if return_eids:
+        return neighbors, counts, Tensor(jnp.asarray(
+            np.concatenate(out_e) if out_e else np.zeros(0, np.int64)))
+    return neighbors, counts
+
+
+def send_uv(x, y, src_index, dst_index, compute_type="add", name=None):
+    """Per-edge message from both endpoints (reference geometric/
+    message_passing/send_recv.py send_uv)."""
+    import jax.numpy as jnp
+    from ..ops.registry import apply_op
+
+    def body(xx, yy, si, di):
+        xs = xx[si]
+        ys = yy[di]
+        if compute_type in ("add",):
+            return xs + ys
+        if compute_type == "sub":
+            return xs - ys
+        if compute_type == "mul":
+            return xs * ys
+        if compute_type == "div":
+            return xs / ys
+        raise ValueError(f"unknown compute_type {compute_type!r}")
+
+    return apply_op("send_uv", body, (x, y, src_index, dst_index), {})
+
+
+__all__ += ["reindex_graph", "reindex_heter_graph", "sample_neighbors",
+            "weighted_sample_neighbors", "send_uv"]

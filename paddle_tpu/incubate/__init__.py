@@ -12,4 +12,15 @@ from . import asp  # noqa: F401
 from . import autotune  # noqa: F401
 from . import checkpoint  # noqa: F401
 
-__all__ = ["nn", "asp", "autotune", "checkpoint"]
+from .extras import (  # noqa: F401
+    LookAhead, ModelAverage, identity_loss, softmax_mask_fuse,
+    softmax_mask_fuse_upper_triangle, graph_send_recv, graph_khop_sampler,
+    graph_reindex, graph_sample_neighbors, segment_sum, segment_mean,
+    segment_max, segment_min)
+from .. import inference  # noqa: F401  (paddle.incubate.inference alias)
+
+__all__ = ["nn", "asp", "autotune", "checkpoint", "inference", "LookAhead",
+           "ModelAverage", "identity_loss", "softmax_mask_fuse",
+           "softmax_mask_fuse_upper_triangle", "graph_send_recv",
+           "graph_khop_sampler", "graph_reindex", "graph_sample_neighbors",
+           "segment_sum", "segment_mean", "segment_max", "segment_min"]

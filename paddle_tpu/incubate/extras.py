@@ -1,0 +1,256 @@
+"""incubate extras (reference: python/paddle/incubate/__init__.py —
+LookAhead/ModelAverage optimizer wrappers, graph sampling ops,
+softmax-mask fusions, identity_loss)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..optimizer.optimizer import Optimizer
+
+__all__ = ["LookAhead", "ModelAverage", "identity_loss",
+           "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
+           "graph_send_recv", "graph_khop_sampler", "graph_reindex",
+           "graph_sample_neighbors", "segment_sum", "segment_mean",
+           "segment_max", "segment_min"]
+
+
+class LookAhead(Optimizer):
+    """k-step lookahead wrapper (reference incubate/optimizer/lookahead.py):
+    slow weights interpolate toward fast weights every k steps."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha should be in [0, 1]")
+        self.alpha = alpha
+        self.k = int(k)
+        self._parameter_list = inner_optimizer._parameter_list
+        # slow weights snapshot the INITIAL params (reference lookahead.py)
+        # so the first k-step sync actually damps the fast trajectory
+        self._slow = {(p.name or str(id(p))): p._data
+                      for p in self._parameter_list}
+        self._steps = 0
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._steps += 1
+        if self._steps % self.k == 0:
+            for p in self._parameter_list:
+                key = p.name or str(id(p))
+                slow = self._slow[key]
+                slow = slow + self.alpha * (p._data - slow)
+                self._slow[key] = slow
+                p._data = slow.astype(p._data.dtype)
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        state = self.inner_optimizer.state_dict()
+        for k, v in self._slow.items():
+            state[f"{k}.slow"] = Tensor(v)
+        state["@lookahead_steps"] = self._steps
+        return state
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage(Optimizer):
+    """Running average of parameters (reference incubate/optimizer/
+    modelaverage.py): apply()/restore() swap the averaged weights in."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._parameter_list = list(parameters or [])
+        self.rate = average_window_rate
+        self.min_window = min_average_window
+        self.max_window = max_average_window
+        # block rotation bounds the window to <= 2*max_average_window
+        # (reference modelaverage.py rotates sum_1/sum_2/sum_3 the same way)
+        self._sums = {}
+        self._old_sums = {}
+        self._counts = 0
+        self._old_counts = 0
+        self._backup = {}
+
+    def step(self):
+        self._counts += 1
+        for p in self._parameter_list:
+            key = p.name or str(id(p))
+            self._sums[key] = self._sums.get(key, 0.0) + \
+                p._data.astype(jnp.float32)
+        window = max(int(self.rate * (self._counts + self._old_counts)),
+                     self.min_window)
+        window = min(window, self.max_window)
+        if self._counts >= window:
+            self._old_sums = dict(self._sums)
+            self._old_counts = self._counts
+            self._sums = {}
+            self._counts = 0
+
+    def apply(self, executor=None, need_restore=True):
+        mgr = self
+
+        class _Guard:
+            def __enter__(self):
+                mgr.apply_now()
+                return self
+
+            def __exit__(self, *e):
+                if need_restore:
+                    mgr.restore_now()
+                return False
+
+        return _Guard()
+
+    def apply_now(self):
+        total = self._counts + self._old_counts
+        if not total:
+            return
+        for p in self._parameter_list:
+            key = p.name or str(id(p))
+            s = self._sums.get(key, 0.0) + self._old_sums.get(key, 0.0)
+            if not isinstance(s, float):
+                self._backup[key] = p._data
+                p._data = (s / total).astype(p._data.dtype)
+
+    def restore_now(self):
+        for p in self._parameter_list:
+            key = p.name or str(id(p))
+            if key in self._backup:
+                p._data = self._backup.pop(key)
+
+    def restore(self, executor=None):
+        self.restore_now()
+
+    def minimize(self, loss, **kw):
+        self.step()
+
+
+def identity_loss(x, reduction="none"):
+    """Mark a loss for IPU pipelining (reference incubate identity_loss);
+    numerically reduce-or-identity."""
+    import paddle_tpu as P
+    if reduction in (0, "sum"):
+        return P.sum(x)
+    if reduction in (1, "mean"):
+        return P.mean(x)
+    return x
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """Fused masked softmax (reference incubate/operators/
+    softmax_mask_fuse.py; fused_softmax_mask kernel) — XLA fuses the
+    chain."""
+    from ..ops.registry import apply_op
+
+    def body(xx, mm):
+        return jax.nn.softmax(xx + mm, axis=-1)
+
+    return apply_op("softmax_mask_fuse", body, (x, mask), {})
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    from ..nn.functional import softmax_mask_fuse_upper_triangle as f
+    return f(x)
+
+
+# ------------------------------------------------------------- graph ops
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """(reference incubate graph_send_recv → geometric.send_u_recv)"""
+    from ..geometric import send_u_recv
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """K-hop neighbor sampling over CSC (reference incubate/operators/
+    graph_khop_sampler.py).  Host-side sampling — eager only."""
+    rng = np.random.default_rng()
+    rows = np.asarray(row.numpy() if hasattr(row, "numpy") else row)
+    cptr = np.asarray(colptr.numpy() if hasattr(colptr, "numpy")
+                      else colptr)
+    nodes = np.asarray(input_nodes.numpy() if hasattr(input_nodes, "numpy")
+                       else input_nodes).reshape(-1)
+    edge_src, edge_dst = [], []
+    frontier = nodes
+    seen = list(nodes)
+    for k in sample_sizes:
+        nxt = []
+        for n in frontier:
+            beg, end = int(cptr[n]), int(cptr[n + 1])
+            neigh = rows[beg:end]
+            if len(neigh) > k:
+                neigh = rng.choice(neigh, size=k, replace=False)
+            for m in neigh:
+                edge_src.append(int(m))
+                edge_dst.append(int(n))
+                nxt.append(int(m))
+        frontier = np.asarray(nxt, np.int64)
+        seen += nxt
+    uniq, inv = np.unique(np.asarray(seen, np.int64), return_inverse=True)
+    remap = {int(u): i for i, u in enumerate(uniq)}
+    es = np.asarray([remap[s] for s in edge_src], np.int64)
+    ed = np.asarray([remap[d] for d in edge_dst], np.int64)
+    out = (Tensor(jnp.asarray(es)), Tensor(jnp.asarray(ed)),
+           Tensor(jnp.asarray(uniq)),
+           Tensor(jnp.asarray(np.arange(len(es), dtype=np.int64))))
+    return out if return_eids else out[:3]
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    from ..geometric import reindex_graph
+    return reindex_graph(x, neighbors, count)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    from ..geometric import sample_neighbors
+    return sample_neighbors(row, colptr, input_nodes,
+                            sample_size=sample_size, return_eids=return_eids)
+
+
+def _eager_num_segments(segment_ids):
+    # concrete in eager mode; under jit callers must use the geometric
+    # API with an explicit out_size (XLA needs static shapes)
+    return int(np.asarray(
+        segment_ids.numpy() if hasattr(segment_ids, "numpy")
+        else segment_ids).max()) + 1
+
+
+def segment_sum(data, segment_ids, name=None):
+    from ..geometric import segment_sum as f
+    return f(data, segment_ids, _eager_num_segments(segment_ids))
+
+
+def segment_mean(data, segment_ids, name=None):
+    from ..geometric import segment_mean as f
+    return f(data, segment_ids, _eager_num_segments(segment_ids))
+
+
+def segment_max(data, segment_ids, name=None):
+    from ..geometric import segment_max as f
+    return f(data, segment_ids, _eager_num_segments(segment_ids))
+
+
+def segment_min(data, segment_ids, name=None):
+    from ..geometric import segment_min as f
+    return f(data, segment_ids, _eager_num_segments(segment_ids))

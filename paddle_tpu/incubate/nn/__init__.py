@@ -5,6 +5,12 @@ FusedFeedForward layer classes over the fused_* functional ops)."""
 from __future__ import annotations
 
 from . import functional  # noqa: F401
-from .layers import FusedMultiHeadAttention, FusedFeedForward  # noqa: F401
+from .layers import (FusedMultiHeadAttention, FusedFeedForward,
+    FusedLinear, FusedDropoutAdd,
+    FusedBiasDropoutResidualLayerNorm,
+    FusedTransformerEncoderLayer, FusedMultiTransformer)  # noqa: F401
 
-__all__ = ["functional", "FusedMultiHeadAttention", "FusedFeedForward"]
+__all__ = ["functional", "FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedLinear", "FusedDropoutAdd",
+           "FusedBiasDropoutResidualLayerNorm",
+           "FusedTransformerEncoderLayer", "FusedMultiTransformer"]

@@ -7,7 +7,9 @@ import numpy as np
 from ...nn.layer import Layer
 from . import functional as IF
 
-__all__ = ["FusedMultiHeadAttention", "FusedFeedForward"]
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward", "FusedLinear",
+           "FusedDropoutAdd", "FusedBiasDropoutResidualLayerNorm",
+           "FusedTransformerEncoderLayer", "FusedMultiTransformer"]
 
 
 class FusedMultiHeadAttention(Layer):
@@ -108,3 +110,122 @@ class FusedFeedForward(Layer):
             activation=self._activation, ln1_epsilon=self._epsilon,
             ln2_epsilon=self._epsilon,
             pre_layer_norm=self._normalize_before, training=self.training)
+
+
+class FusedLinear(Layer):
+    """(reference incubate/nn/layer/fused_linear.py): on TPU the fusion is
+    XLA's — one matmul+bias kernel."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        import math
+        from ...nn.initializer import Uniform
+        bound = 1.0 / math.sqrt(in_features)
+        shape = (out_features, in_features) if transpose_weight \
+            else (in_features, out_features)
+        self.weight = self.create_parameter(
+            shape, attr=weight_attr, default_initializer=Uniform(-bound,
+                                                                 bound))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (out_features,), attr=bias_attr, is_bias=True)
+        self.transpose_weight = transpose_weight
+
+    def forward(self, x):
+        w = self.weight.t() if self.transpose_weight else self.weight
+        out = x.matmul(w)
+        return out + self.bias if self.bias is not None else out
+
+
+class FusedDropoutAdd(Layer):
+    """(reference incubate/nn/layer/fused_dropout_add.py): dropout(x)+y
+    in one fused op."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        from ...nn import functional as F
+        return F.dropout(x, p=self.p, training=self.training,
+                         mode=self.mode) + y
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """(reference incubate/nn/layer/fused_transformer.py
+    FusedBiasDropoutResidualLayerNorm): LN(residual + dropout(x + bias))."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        from ...nn.initializer import Constant
+        self.linear_bias = self.create_parameter(
+            (embed_dim,), is_bias=True,
+            default_initializer=Constant(0.0))
+        self.ln_scale = self.create_parameter(
+            (embed_dim,), attr=weight_attr,
+            default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            (embed_dim,), attr=bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+        self.embed_dim = embed_dim
+
+    def forward(self, x, residual):
+        from ...nn import functional as F
+        h = F.dropout(x + self.linear_bias, p=self.dropout_rate,
+                      training=self.training)
+        return F.layer_norm(residual + h, [self.embed_dim],
+                            weight=self.ln_scale, bias=self.ln_bias,
+                            epsilon=self.epsilon)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """(reference incubate/nn/layer/fused_transformer.py
+    FusedTransformerEncoderLayer): attention + FFN with the fused building
+    blocks; on TPU the standard encoder layer already compiles to the same
+    fused program."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ...nn.transformer import TransformerEncoderLayer
+        self._layer = TransformerEncoderLayer(
+            d_model, nhead, dim_feedforward, dropout=dropout_rate,
+            activation=activation,
+            attn_dropout=attn_dropout_rate, act_dropout=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        return self._layer(src, src_mask=src_mask)
+
+
+class FusedMultiTransformer(Layer):
+    """(reference incubate/nn/layer/fused_transformer.py
+    FusedMultiTransformer — the inference-serving stacked decoder): N
+    pre-LN decoder blocks evaluated as one scanned program."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 ln_scale_attrs=None, num_layers=-1, nranks=1,
+                 trans_qkvw=True, ring_id=-1, name=None):
+        super().__init__()
+        if num_layers < 0:
+            num_layers = len(ln_scale_attrs) if ln_scale_attrs else 1
+        from ...nn.transformer import TransformerEncoderLayer
+        self.layers = [TransformerEncoderLayer(
+            embed_dim, num_heads, dim_feedforward, dropout=dropout_rate,
+            activation=activation, normalize_before=normalize_before)
+            for _ in range(num_layers)]
+        for i, lyr in enumerate(self.layers):
+            self.add_sublayer(f"layer_{i}", lyr)
+
+    def forward(self, src, attn_mask=None, caches=None, **kw):
+        out = src
+        for lyr in self.layers:
+            out = lyr(out, src_mask=attn_mask)
+        return out

@@ -147,3 +147,95 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+class DataType:
+    """Tensor dtypes of the inference API (reference
+    paddle_infer.DataType)."""
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+    FLOAT64 = 7
+    BOOL = 8
+
+
+class XpuConfig:
+    """XPU device config placeholder (reference paddle_infer.XpuConfig);
+    recorded, not acted on — there is no XPU here."""
+
+    def __init__(self):
+        self.device_id = 0
+        self.l3_size = 0
+        self.conv_autotune_level = 0
+
+
+class PredictorPool:
+    """Pool of predictors over one config (reference
+    paddle_infer.PredictorPool)."""
+
+    def __init__(self, config, size=1):
+        self._predictors = [create_predictor(config) for _ in range(size)]
+
+    def retrieve(self, idx):
+        return self._predictors[idx]
+
+
+def get_version():
+    from .. import __version__
+    return __version__
+
+
+def get_trt_compile_version():
+    return (0, 0, 0)  # no TensorRT on TPU
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def get_num_bytes_of_data_type(dtype):
+    sizes = {DataType.FLOAT32: 4, DataType.INT64: 8, DataType.INT32: 4,
+             DataType.UINT8: 1, DataType.INT8: 1, DataType.FLOAT16: 2,
+             DataType.BFLOAT16: 2, DataType.FLOAT64: 8, DataType.BOOL: 1}
+    return sizes.get(dtype, 4)
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision=None,
+                               backend=None, keep_io_types=True,
+                               black_list=None, **kwargs):
+    """Offline precision conversion (reference
+    paddle.inference.convert_to_mixed_precision): rewrites a saved
+    state dict to bf16/fp16."""
+    import numpy as np
+    import ml_dtypes
+    from ..framework.io import load, save
+    state = load(params_file)
+    target = ml_dtypes.bfloat16 if mixed_precision in (None, "bfloat16", 6) \
+        else np.float16
+    out = {}
+    for k, v in state.items():
+        arr = v.numpy() if hasattr(v, "numpy") else np.asarray(v)
+        if np.issubdtype(np.asarray(arr).dtype, np.floating):
+            arr = np.asarray(arr).astype(target)
+        out[k] = arr
+    save(out, mixed_params_file)
+    import shutil
+    if model_file != mixed_model_file:
+        shutil.copy(model_file, mixed_model_file)
+
+
+def _get_phi_kernel_name(op_name):
+    """Reference maps fluid op names to phi kernel names; here ops are
+    already registry names."""
+    return op_name
+
+
+__all__ += ["DataType", "XpuConfig", "PredictorPool", "get_version",
+            "get_trt_compile_version", "get_trt_runtime_version",
+            "get_num_bytes_of_data_type", "convert_to_mixed_precision",
+            "_get_phi_kernel_name"]

@@ -129,6 +129,8 @@ def _decoder_layer(lp, x, cos, sin, config: LlamaConfig):
     v = (h @ lp["v"]).reshape(b, sq, kvh, hd)
     q, k = apply_rotary_pos_emb(q, k, cos, sin)
     a = sdpa(q, k, v, is_causal=True)
+    from jax.ad_checkpoint import checkpoint_name as _ckpt_name
+    a = _ckpt_name(a, "attn_out")
     x = r + (a.reshape(b, sq, nh * hd) @ lp["o"])
     r = x
     h = _rms(x, lp["post_ln"], config.rms_norm_eps)
@@ -137,9 +139,16 @@ def _decoder_layer(lp, x, cos, sin, config: LlamaConfig):
 
 
 def _stage_fn(stage_params, x, cos, sin, config, remat=True):
-    """Apply this stage's layers_per_stage layers (leaves [lps, ...])."""
+    """Apply this stage's layers_per_stage layers (leaves [lps, ...]).
+    remat: True = full per-layer checkpoint; "attn" = checkpoint but keep
+    the flash-attention outputs resident (skips the most expensive
+    recompute for ~1 GB at 1B/2k/8 scale); False = no remat."""
     body = functools.partial(_decoder_layer, cos=cos, sin=sin, config=config)
-    if remat:
+    if remat == "attn":
+        body = jax.checkpoint(
+            body, policy=jax.checkpoint_policies.save_only_these_names(
+                "attn_out"))
+    elif remat:
         body = jax.checkpoint(body)
 
     def scan_body(h, lp):

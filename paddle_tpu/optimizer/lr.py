@@ -304,3 +304,42 @@ class CyclicLR(LRScheduler):
         elif self.mode == "exp_range":
             amp = amp * (self.exp_gamma ** self.last_epoch)
         return self.base_lr + amp
+
+
+class LinearLR(LRScheduler):
+    """Linear ramp from start_factor to end_factor over total_steps
+    (reference optimizer/lr.py LinearLR)."""
+
+    def __init__(self, learning_rate, total_steps, start_factor=1.0 / 3,
+                 end_factor=1.0, last_epoch=-1, verbose=False):
+        if total_steps <= 0:
+            raise ValueError("total_steps must be positive")
+        self.total_steps = total_steps
+        self.start_factor = start_factor
+        self.end_factor = end_factor
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        t = min(self.last_epoch, self.total_steps)
+        frac = self.start_factor + (self.end_factor - self.start_factor) \
+            * t / self.total_steps
+        return self.base_lr * frac
+
+
+class MultiplicativeDecay(LRScheduler):
+    """lr *= lr_lambda(epoch) each step (reference optimizer/lr.py
+    MultiplicativeDecay)."""
+
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1,
+                 verbose=False):
+        self.lr_lambda = lr_lambda
+        self._factor = 1.0
+        self._factor_epoch = 0
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        # accumulate the product incrementally: one lambda call per epoch
+        while self._factor_epoch < self.last_epoch:
+            self._factor_epoch += 1
+            self._factor *= self.lr_lambda(self._factor_epoch)
+        return self.base_lr * self._factor

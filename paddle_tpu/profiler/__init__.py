@@ -5,3 +5,54 @@ from .profiler import (  # noqa: F401
     export_chrome_tracing, load_profiler_result, enable_host_tracing,
     export_host_trace, host_trace_event_count)
 from .timer import Benchmark, benchmark  # noqa: F401
+
+__all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing",
+           "load_profiler_result", "Benchmark", "benchmark", "SortedKeys",
+           "SummaryView", "export_protobuf"]
+
+
+class SortedKeys:
+    """Summary sort keys (reference profiler/profiler_statistic.py
+    SortedKeys enum)."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView:
+    """Summary view kinds (reference profiler/profiler.py SummaryView
+    enum)."""
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def export_protobuf(dir_name=None, worker_name=None):
+    """Profiler export callback (reference profiler/profiler.py
+    export_protobuf).  The jax profiler writes TensorBoard/perfetto
+    protobufs natively; this returns the matching on_trace_ready hook."""
+    def handle(prof):
+        import jax
+        out = dir_name or "./profiler_log"
+        try:
+            jax.profiler.save_device_memory_profile(
+                f"{out}/memory.pprof")
+        except Exception:
+            pass
+        return out
+    return handle
+
+
+

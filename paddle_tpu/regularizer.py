@@ -1,0 +1,22 @@
+"""paddle.regularizer (reference: python/paddle/regularizer.py): weight
+decay specs consumed by optimizers and ParamAttr."""
+from __future__ import annotations
+
+__all__ = ["WeightDecayRegularizer", "L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+
+class L1Decay(WeightDecayRegularizer):
+    """L1 weight decay: grad += coeff * sign(param)."""
+
+
+class L2Decay(WeightDecayRegularizer):
+    """L2 weight decay: grad += coeff * param."""

@@ -18,7 +18,8 @@ from ..framework.tensor import Tensor
 from ..nn.layer import Layer
 from ..ops.registry import op
 
-__all__ = ["ViterbiDecoder", "viterbi_decode"]
+__all__ = ["ViterbiDecoder", "viterbi_decode", "datasets", "Conll05st",
+           "Imdb", "Imikolov", "Movielens", "UCIHousing", "WMT14", "WMT16"]
 
 
 @op
@@ -104,3 +105,13 @@ class datasets:
     WMT14 = _dataset_stub("WMT14")
     WMT16 = _dataset_stub("WMT16")
     Conll05st = _dataset_stub("Conll05st")
+
+
+# top-level aliases (reference python/paddle/text/__init__.py exports)
+Conll05st = datasets.Conll05st
+Imdb = datasets.Imdb
+Imikolov = datasets.Imikolov
+Movielens = datasets.Movielens
+UCIHousing = datasets.UCIHousing
+WMT14 = datasets.WMT14
+WMT16 = datasets.WMT16

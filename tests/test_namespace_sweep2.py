@@ -1,0 +1,193 @@
+"""Second namespace sweep: incubate extras, device streams, geometric
+sampling, lr schedulers, regularizer, inference helpers."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+rng = np.random.RandomState(23)
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestIncubateExtras:
+    def test_lookahead(self):
+        import paddle_tpu.optimizer as opt
+        m = nn.Linear(3, 1, bias_attr=False)
+        inner = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+        la = paddle.incubate.LookAhead(inner, alpha=0.5, k=2)
+        x = t(rng.randn(4, 3).astype(np.float32))
+        y = t(rng.randn(4, 1).astype(np.float32))
+        ls = []
+        for _ in range(6):
+            loss = ((m(x) - y) ** 2).mean()
+            loss.backward()
+            la.step()
+            la.clear_grad()
+            ls.append(float(loss))
+        assert ls[-1] < ls[0]
+
+    def test_model_average(self):
+        m = nn.Linear(2, 1, bias_attr=False)
+        ma = paddle.incubate.ModelAverage(0.15, parameters=m.parameters())
+        w_hist = []
+        for i in range(3):
+            m.weight.set_value(t(np.full((2, 1), float(i), np.float32)))
+            ma.step()
+            w_hist.append(float(i))
+        with ma.apply():
+            np.testing.assert_allclose(m.weight.numpy(),
+                                       np.mean(w_hist), rtol=1e-6)
+        np.testing.assert_allclose(m.weight.numpy(), 2.0)
+
+    def test_graph_ops(self):
+        # CSC graph: node 0 <- {1, 2}; node 1 <- {2}; node 2 <- {}
+        row = t(np.array([1, 2, 2], np.int64))
+        colptr = t(np.array([0, 2, 3, 3], np.int64))
+        nbrs, cnts = paddle.incubate.graph_sample_neighbors(
+            row, colptr, t(np.array([0, 1], np.int64)))
+        np.testing.assert_array_equal(cnts.numpy(), [2, 1])
+        np.testing.assert_array_equal(np.sort(nbrs.numpy()), [1, 2, 2])
+        es, ed, nodes = paddle.incubate.graph_khop_sampler(
+            row, colptr, t(np.array([0], np.int64)), [2])
+        assert len(es.numpy()) == 2
+        seg = paddle.incubate.segment_sum(
+            t(np.array([[1.0], [2.0], [3.0]], np.float32)),
+            t(np.array([0, 0, 1], np.int64)))
+        np.testing.assert_allclose(seg.numpy(), [[3.0], [3.0]])
+
+    def test_softmax_mask_fuse(self):
+        x = t(rng.randn(2, 4).astype(np.float32))
+        mask = t(np.where(rng.rand(2, 4) > 0.5, 0.0, -1e9)
+                 .astype(np.float32))
+        out = paddle.incubate.softmax_mask_fuse(x, mask).numpy()
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+        assert np.isfinite(
+            float(paddle.incubate.identity_loss(x, "mean")))
+
+    def test_fused_layers(self):
+        fl = paddle.incubate.nn.FusedLinear(4, 6)
+        assert fl(t(rng.randn(2, 4).astype(np.float32))).shape == [2, 6]
+        fda = paddle.incubate.nn.FusedDropoutAdd(p=0.0)
+        a = t(rng.randn(2, 3).astype(np.float32))
+        b = t(rng.randn(2, 3).astype(np.float32))
+        np.testing.assert_allclose(fda(a, b).numpy(),
+                                   a.numpy() + b.numpy(), rtol=1e-6)
+        fbd = paddle.incubate.nn.FusedBiasDropoutResidualLayerNorm(
+            8, dropout_rate=0.0)
+        out = fbd(t(rng.randn(2, 8).astype(np.float32)),
+                  t(rng.randn(2, 8).astype(np.float32)))
+        np.testing.assert_allclose(out.numpy().mean(-1), 0.0, atol=1e-5)
+        enc = paddle.incubate.nn.FusedTransformerEncoderLayer(16, 4, 32)
+        assert enc(t(rng.randn(2, 5, 16).astype(np.float32))).shape == \
+            [2, 5, 16]
+        multi = paddle.incubate.nn.FusedMultiTransformer(16, 4, 32,
+                                                         num_layers=2)
+        assert multi(t(rng.randn(2, 5, 16).astype(np.float32))).shape == \
+            [2, 5, 16]
+
+
+class TestDeviceSurface:
+    def test_streams_events(self):
+        s = paddle.device.Stream()
+        e = s.record_event()
+        assert e.query()
+        with paddle.device.stream_guard(paddle.device.Stream()):
+            assert paddle.device.current_stream() is not s
+        paddle.device.synchronize()
+        assert not paddle.device.is_compiled_with_cuda()
+        assert paddle.device.is_compiled_with_distribute()
+        assert paddle.device.get_cudnn_version() is None
+        assert paddle.device.get_all_device_type()
+        assert paddle.device.get_available_device()
+
+
+class TestGeometricSampling:
+    def test_reindex(self):
+        from paddle_tpu.geometric import reindex_graph
+        x = t(np.array([10, 20], np.int64))
+        nbrs = t(np.array([30, 10, 20], np.int64))
+        cnt = t(np.array([2, 1], np.int64))
+        src, dst, nodes = reindex_graph(x, nbrs, cnt)
+        np.testing.assert_array_equal(nodes.numpy(), [10, 20, 30])
+        np.testing.assert_array_equal(src.numpy(), [2, 0, 1])
+        np.testing.assert_array_equal(dst.numpy(), [0, 0, 1])
+
+    def test_send_uv(self):
+        from paddle_tpu.geometric import send_uv
+        x = t(np.array([[1.0], [2.0]], np.float32))
+        y = t(np.array([[10.0], [20.0]], np.float32))
+        out = send_uv(x, y, t(np.array([0, 1], np.int64)),
+                      t(np.array([1, 0], np.int64)), "add")
+        np.testing.assert_allclose(out.numpy(), [[21.0], [12.0]])
+
+    def test_weighted_sampling(self):
+        from paddle_tpu.geometric import weighted_sample_neighbors
+        row = t(np.array([1, 2], np.int64))
+        colptr = t(np.array([0, 2, 2, 2], np.int64))
+        w = t(np.array([1.0, 0.0], np.float32))
+        nbrs, cnts = weighted_sample_neighbors(
+            row, colptr, w, t(np.array([0], np.int64)), sample_size=1)
+        np.testing.assert_array_equal(nbrs.numpy(), [1])  # weight-forced
+
+
+class TestLrAndRegularizer:
+    def test_linear_lr(self):
+        import paddle_tpu.optimizer as opt
+        s = opt.lr.LinearLR(1.0, total_steps=4, start_factor=0.25)
+        vals = []
+        for _ in range(5):
+            vals.append(s())
+            s.step()
+        np.testing.assert_allclose(vals[0], 0.25)
+        np.testing.assert_allclose(vals[4], 1.0)
+
+    def test_multiplicative(self):
+        import paddle_tpu.optimizer as opt
+        s = opt.lr.MultiplicativeDecay(1.0, lambda e: 0.5)
+        s.step()
+        np.testing.assert_allclose(s(), 0.5)
+        s.step()
+        np.testing.assert_allclose(s(), 0.25)
+
+    def test_regularizer_in_optimizer(self):
+        import paddle_tpu.optimizer as opt
+        m = nn.Linear(1, 1, bias_attr=False)
+        m.weight.set_value(t(np.array([[1.0]], np.float32)))
+        o = opt.SGD(learning_rate=0.1, parameters=m.parameters(),
+                    weight_decay=paddle.regularizer.L2Decay(0.5))
+        m(t(np.array([[0.0]], np.float32))).backward()
+        o.step()
+        np.testing.assert_allclose(m.weight.numpy(), [[1.0 - 0.05]],
+                                   rtol=1e-5)
+
+
+class TestInferenceHelpers:
+    def test_helpers(self, tmp_path):
+        import paddle_tpu.inference as inf
+        assert inf.get_num_bytes_of_data_type(inf.DataType.FLOAT32) == 4
+        assert inf.get_version()
+        assert inf.get_trt_compile_version() == (0, 0, 0)
+        # mixed precision conversion of a saved state dict
+        state = {"w": np.ones((2, 2), np.float32),
+                 "step": np.array(3, np.int64)}
+        src = str(tmp_path / "m.pdparams")
+        dst = str(tmp_path / "m_bf16.pdparams")
+        paddle.save(state, src)
+        mfile = str(tmp_path / "model.json")
+        open(mfile, "w").write("{}")
+        inf.convert_to_mixed_precision(mfile, src,
+                                       str(tmp_path / "model2.json"), dst)
+        out = paddle.load(dst)
+        w = out["w"].numpy() if hasattr(out["w"], "numpy") else out["w"]
+        assert "bfloat16" in str(np.asarray(w).dtype)
+
+    def test_profiler_enums(self):
+        import paddle_tpu.profiler as prof
+        assert prof.SortedKeys.CPUTotal == 0
+        assert prof.SummaryView.OverView == 1
+        hook = prof.export_protobuf("/tmp/proflog")
+        assert callable(hook)

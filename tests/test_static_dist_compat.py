@@ -210,3 +210,41 @@ class TestDistCompat:
     def test_io_worker_info(self):
         import paddle_tpu.io as pio
         assert pio.get_worker_info() is None
+
+
+class TestCommWatchdog:
+    def test_detects_hung_task(self):
+        from paddle_tpu.distributed.watchdog import CommTaskManager
+        mgr = CommTaskManager(default_timeout=0.2, poll_interval=0.05)
+        hung = []
+        mgr.register_hang_hook(lambda task: hung.append(task.name))
+        task = mgr.start_task("all_reduce", group="dp")
+        import time
+        time.sleep(0.6)
+        assert hung == ["all_reduce"]
+        assert task.flagged
+        mgr.end_task(task)
+        assert mgr.in_flight() == []
+        mgr.shutdown()
+
+    def test_completed_task_not_flagged(self):
+        from paddle_tpu.distributed.watchdog import CommTaskManager
+        mgr = CommTaskManager(default_timeout=0.3, poll_interval=0.05)
+        hung = []
+        mgr.register_hang_hook(lambda t_: hung.append(t_))
+        with_task = mgr.start_task("broadcast")
+        mgr.end_task(with_task)
+        import time
+        time.sleep(0.5)
+        assert not hung
+        mgr.shutdown()
+
+    def test_comm_guard_wraps_wait(self):
+        import numpy as np
+        import paddle_tpu.distributed as dist
+        x = paddle.to_tensor(np.ones(4, np.float32))
+        with dist.comm_guard("custom_op") as task:
+            assert not task.done
+        assert task.done or task not in \
+            dist.get_comm_task_manager().in_flight()
+        dist.wait(x)  # exercises the guarded path
