@@ -201,10 +201,21 @@ class stream_guard:
 
 def synchronize(device=None):
     """Block until all queued device work completes (reference
-    device/cuda synchronize); jax effectively syncs via a trivial fetch."""
+    device/cuda synchronize); jax effectively syncs via a trivial fetch.
+    Accepts None, a jax Device, or a paddle-style string ('gpu:0')."""
     import jax
-    jax.block_until_ready(
-        jax.device_put(0, jax.devices()[0] if device is None else device))
+    if device is None:
+        dev = jax.devices()[0]
+    elif isinstance(device, str):
+        plat, _, idx = device.partition(":")
+        idx = int(idx) if idx else 0
+        try:
+            dev = jax.devices(plat)[idx]
+        except RuntimeError:
+            dev = jax.devices()[0]  # platform not present: sync default
+    else:
+        dev = device
+    jax.block_until_ready(jax.device_put(0, dev))
 
 
 def get_all_device_type():

@@ -271,3 +271,81 @@ def _patch():
 
 
 _patch()
+
+
+def _patch_surface2():
+    """Tensor methods part 2 (reference tensor.prototype.pyi: dtype/layout
+    introspection, sparse/dist predicates, strides)."""
+    import numpy as _np
+    import jax.numpy as _jnp
+
+    Tensor.element_size = lambda self: self._data.dtype.itemsize
+    Tensor.get_strides = lambda self: [
+        int(_np.prod(self._data.shape[i + 1:]))
+        for i in range(self._data.ndim)]
+    Tensor.strides = property(lambda self: self.get_strides())
+    Tensor.layout = property(lambda self: "NCHW")
+    Tensor.offset = lambda self: 0
+    Tensor.type = lambda self: "DenseTensor"
+    Tensor.is_dense = lambda self: True
+    Tensor.is_sparse = lambda self: False
+    Tensor.is_sparse_coo = lambda self: False
+    Tensor.is_sparse_csr = lambda self: False
+    Tensor.is_selected_rows = lambda self: False
+    Tensor.is_same_shape = lambda self, other: \
+        list(self.shape) == list(other.shape)
+    Tensor.get_tensor = lambda self: self
+    Tensor.data = property(lambda self: self,
+                           lambda self, v: self.copy_(v))
+
+    def _is_dist(self):
+        try:
+            s = self._data.sharding
+            return not s.is_fully_replicated
+        except Exception:
+            return False
+    Tensor.is_dist = _is_dist
+
+    def _placements(self):
+        from ..distributed.auto_parallel.api import get_placements
+        return get_placements(self)
+    Tensor.placements = property(_placements)
+
+    def _process_mesh(self):
+        try:
+            s = self._data.sharding
+            return getattr(s, "mesh", None)
+        except Exception:
+            return None
+    Tensor.process_mesh = property(_process_mesh)
+
+    def _num_shard(self):
+        try:
+            return len(self._data.sharding.device_set)
+        except Exception:
+            return 1
+    Tensor.num_shard = property(_num_shard)
+
+    Tensor.grad_fn = property(lambda self: self._grad_node)
+    Tensor._grad_ivar = lambda self: self.grad
+    Tensor.grad_ = property(lambda self: self.grad)
+
+    def _data_ptr(self):
+        arr = _np.asarray(self._data)
+        return arr.__array_interface__["data"][0]
+    Tensor.data_ptr = _data_ptr
+
+    def _sparse_only(name):
+        def fn(self, *a, **k):
+            raise ValueError(
+                f"Tensor.{name}() is only defined for sparse/selected-rows "
+                "tensors (paddle.sparse.SparseCooTensor / SparseCsrTensor)")
+        fn.__name__ = name
+        return fn
+
+    for n in ("rows", "cols", "crows", "nnz", "get_selected_rows",
+              "get_map_tensor", "set_vocab", "set_string_list"):
+        setattr(Tensor, n, _sparse_only(n))
+
+
+_patch_surface2()

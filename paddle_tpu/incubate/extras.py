@@ -22,12 +22,14 @@ class LookAhead(Optimizer):
     slow weights interpolate toward fast weights every k steps."""
 
     def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        super().__init__(learning_rate=inner_optimizer.get_lr(),
+                         parameters=inner_optimizer._parameter_list,
+                         name=name)
         self.inner_optimizer = inner_optimizer
         if not 0.0 <= alpha <= 1.0:
             raise ValueError("alpha should be in [0, 1]")
         self.alpha = alpha
         self.k = int(k)
-        self._parameter_list = inner_optimizer._parameter_list
         # slow weights snapshot the INITIAL params (reference lookahead.py)
         # so the first k-step sync actually damps the fast trajectory
         self._slow = {(p.name or str(id(p))): p._data
@@ -73,7 +75,8 @@ class ModelAverage(Optimizer):
     def __init__(self, average_window_rate, parameters=None,
                  min_average_window=10000, max_average_window=10000,
                  name=None):
-        self._parameter_list = list(parameters or [])
+        super().__init__(learning_rate=0.0, parameters=list(parameters or []),
+                         name=name)
         self.rate = average_window_rate
         self.min_window = min_average_window
         self.max_window = max_average_window
@@ -187,7 +190,7 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
                       else colptr)
     nodes = np.asarray(input_nodes.numpy() if hasattr(input_nodes, "numpy")
                        else input_nodes).reshape(-1)
-    edge_src, edge_dst = [], []
+    edge_src, edge_dst, edge_ids = [], [], []
     frontier = nodes
     seen = list(nodes)
     for k in sample_sizes:
@@ -195,11 +198,15 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
         for n in frontier:
             beg, end = int(cptr[n]), int(cptr[n + 1])
             neigh = rows[beg:end]
+            eids = np.arange(beg, end)
             if len(neigh) > k:
-                neigh = rng.choice(neigh, size=k, replace=False)
-            for m in neigh:
+                sel = rng.choice(len(neigh), size=k, replace=False)
+                neigh = neigh[sel]
+                eids = eids[sel]
+            for m, e in zip(neigh, eids):
                 edge_src.append(int(m))
                 edge_dst.append(int(n))
+                edge_ids.append(int(e))
                 nxt.append(int(m))
         frontier = np.asarray(nxt, np.int64)
         seen += nxt
@@ -209,7 +216,7 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
     ed = np.asarray([remap[d] for d in edge_dst], np.int64)
     out = (Tensor(jnp.asarray(es)), Tensor(jnp.asarray(ed)),
            Tensor(jnp.asarray(uniq)),
-           Tensor(jnp.asarray(np.arange(len(es), dtype=np.int64))))
+           Tensor(jnp.asarray(np.asarray(edge_ids, np.int64))))
     return out if return_eids else out[:3]
 
 

@@ -338,7 +338,11 @@ class MultiplicativeDecay(LRScheduler):
         super().__init__(learning_rate, last_epoch, verbose)
 
     def get_lr(self):
-        # accumulate the product incrementally: one lambda call per epoch
+        # accumulate the product incrementally: one lambda call per epoch;
+        # a backward epoch jump (step(epoch=n) with n < current) recomputes
+        if self.last_epoch < self._factor_epoch:
+            self._factor = 1.0
+            self._factor_epoch = 0
         while self._factor_epoch < self.last_epoch:
             self._factor_epoch += 1
             self._factor *= self.lr_lambda(self._factor_epoch)

@@ -33,13 +33,19 @@ class Optimizer:
         self._lr = learning_rate
         self._lr_scheduler = learning_rate if isinstance(
             learning_rate, LRScheduler) else None
+        self._l1_coeff = 0.0
         if isinstance(weight_decay, float):
             self._coeff = weight_decay
         elif weight_decay is None:
             self._coeff = 0.0
-        else:  # L2Decay-like object with a coeff
-            self._coeff = float(getattr(weight_decay, "_coeff",
-                                        getattr(weight_decay, "coeff", 0.0)))
+        else:  # L1Decay/L2Decay-like object with a coeff
+            coeff = float(getattr(weight_decay, "_coeff",
+                                  getattr(weight_decay, "coeff", 0.0)))
+            if type(weight_decay).__name__ == "L1Decay":
+                self._l1_coeff = coeff
+                self._coeff = 0.0
+            else:
+                self._coeff = coeff
         self._grad_clip = grad_clip
         self._multi_precision = multi_precision
         self._accumulators: dict[str, dict[str, jnp.ndarray]] = {}
@@ -94,7 +100,10 @@ class Optimizer:
         for p, g in params_grads:
             if g is None:
                 continue
-            self._update_param(p, g.astype(jnp.float32), lr)
+            g32 = g.astype(jnp.float32)
+            if self._l1_coeff:  # L1 regularization: grad += c * sign(param)
+                g32 = g32 + self._l1_coeff * jnp.sign(self._param_f32(p))
+            self._update_param(p, g32, lr)
         self._step_count += 1
 
     def _update_param(self, p, grad_f32, lr):

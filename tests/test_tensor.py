@@ -184,3 +184,56 @@ class TestPyLayer:
         y = Cube.apply(x)
         y.sum().backward()
         np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+
+class TestTensorSurface2:
+    """Methods from the reference tensor.prototype.pyi (introspection,
+    sparse/dist predicates)."""
+
+    def test_introspection(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        t = paddle.to_tensor(np.zeros((3, 4), np.float32))
+        assert t.element_size() == 4
+        assert t.get_strides() == [4, 1]
+        assert t.strides == [4, 1]
+        assert t.offset() == 0
+        assert t.type() == "DenseTensor"
+        assert t.layout == "NCHW"
+        assert t.is_dense() and not t.is_sparse()
+        assert not t.is_sparse_coo() and not t.is_sparse_csr()
+        assert not t.is_selected_rows()
+        assert t.is_same_shape(paddle.ones([3, 4]))
+        assert not t.is_same_shape(paddle.ones([4, 3]))
+        assert t.data is t
+        assert t.get_tensor() is t
+        assert t.num_shard == 1
+        assert isinstance(t.data_ptr(), int)
+
+    def test_grad_aliases_and_sparse_only(self):
+        import numpy as np
+        import pytest
+        import paddle_tpu as paddle
+        x = paddle.to_tensor(np.ones(3, np.float32))
+        x.stop_gradient = False
+        (x * x).sum().backward()
+        assert x._grad_ivar() is not None
+        with pytest.raises(ValueError):
+            x.nnz()
+        with pytest.raises(ValueError):
+            x.crows()
+
+    def test_prototype_parity(self):
+        import os
+        import re
+        import pytest
+        import paddle_tpu as paddle
+        pyi = "/root/reference/python/paddle/tensor/tensor.prototype.pyi"
+        if not os.path.exists(pyi):
+            pytest.skip("reference not mounted")
+        src = open(pyi).read()
+        methods = set(re.findall(r"^    def (\w+)\(", src, re.M))
+        t = paddle.to_tensor([1.0])
+        missing = sorted(m for m in methods - set(dir(t))
+                         if not m.startswith("__"))
+        assert not missing, missing
