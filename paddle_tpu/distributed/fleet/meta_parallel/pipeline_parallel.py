@@ -3,21 +3,32 @@
 Reference: fleet/meta_parallel/pipeline_parallel.py — train_batch:820 splits
 the batch into micro-batches and drives the 1F1B schedule (:575) with P2P
 activations.  TPU-native execution: `train_batch` compiles ONE XLA program
-(fwd pipeline scan + AD'd bwd + optimizer step); micro-batching is the scan
-dimension; stage placement is the pp mesh axis (see
-distributed/pipelining.py).  When the model's stages are not
-shape-homogeneous, falls back to microbatch gradient-accumulation on the
-replicated model (correct, no pp overlap) — same numerics either way.
+that runs the hand-scheduled 1F1B engine
+(distributed/pipeline_schedules.pipeline_1f1b_hetero) over the 'pp' mesh
+axis — the PipelineLayer's segments become per-stage `lax.switch`
+branches, activations/cotangents hop stages via ppermute, and each
+microbatch's backward starts as soon as its forward leaves the pipe.
+
+Requirements for the pipelined path (checked at compile time):
+  * a hybrid topology with pp axis size > 1, and the model is a
+    PipelineLayer whose stage count equals the pp size;
+  * every non-final segment emits one activation of a single common
+    shape/dtype (the ring payload).  Stage 0 may consume arbitrary input;
+    the final segment runs inside the loss head on the last device.
+When a model does not satisfy this, train_batch falls back to microbatch
+gradient-accumulation on the replicated model (correct numerics, no
+pipeline overlap) and says so once via warnings.warn.
 """
 from __future__ import annotations
 
-import numpy as np
+import warnings
 
 import jax
 import jax.numpy as jnp
 
 from .parallel_wrappers import MetaParallelBase
 from .pp_layers import PipelineLayer
+from ...pipeline_schedules import pipeline_1f1b_hetero
 from ....framework.tensor import Tensor
 from ....autograd import tape
 from ....framework import random as _random
@@ -56,20 +67,100 @@ class PipelineParallel(MetaParallelBase):
         target_opt.load_opt_state(new_opt)
         return Tensor(loss, stop_gradient=True)
 
+    # ---- pipelined path -------------------------------------------------
+    def _pp_mesh(self):
+        hcg = self._hcg
+        if hcg is None:
+            return None
+        pm = hcg.mesh() if callable(hcg.mesh) else hcg.mesh
+        mesh = getattr(pm, "jax_mesh", pm)
+        return mesh if "pp" in mesh.axis_names and mesh.shape["pp"] > 1 \
+            else None
+
+    def _segment_fns(self, model, n_micro, mesh, xb):
+        """Per-stage branch fns over the functional param dict, with the
+        final segment folded into the loss head.  Returns (stage_fns,
+        last_fn) or None if the stages can't form a homogeneous ring."""
+        S = mesh.shape["pp"]
+        if not isinstance(model, PipelineLayer) or \
+                model.get_num_stages() != S:
+            return None
+        cuts = model.segment_parts
+
+        def seg_run(p, h, lo, hi):
+            saved = model.functional_state()
+            model.load_functional_state(p)
+            try:
+                with tape.no_grad():
+                    for fn in model.run_function[lo:hi]:
+                        h = fn(h)
+            finally:
+                model.load_functional_state(saved)
+            return h
+
+        def make_stage(idx):
+            lo, hi = cuts[idx], cuts[idx + 1]
+
+            def branch(p, x, aux_j):
+                h = Tensor(aux_j["x"], stop_gradient=True) if idx == 0 \
+                    else Tensor(x, stop_gradient=True)
+                return seg_run(p, h, lo, hi)._data
+
+            return branch
+
+        def identity_stage(p, x, aux_j):
+            return x
+
+        def last_fn(p, y, aux_j):
+            out = seg_run(p, Tensor(y, stop_gradient=True),
+                          cuts[S - 1], cuts[S])
+            loss = model.loss(out, Tensor(aux_j["y"], stop_gradient=True))
+            return loss._data / n_micro
+
+        # ring homogeneity probe (abstract eval only): stages 0..S-2 must
+        # emit one common activation shape/dtype.  Probe failures are
+        # recorded so the fallback warning names the real cause instead
+        # of masking a genuine model bug.
+        params = {k: p._data for k, p in model.named_parameters()}
+        mb_shape = (xb.shape[0] // n_micro,) + tuple(xb.shape[1:])
+        try:
+            h = jax.eval_shape(
+                lambda p, a: make_stage(0)(p, None, {"x": a, "y": None}),
+                params, jax.ShapeDtypeStruct(mb_shape, xb.dtype))
+            shapes = {(h.shape, h.dtype)}
+            for i in range(1, S - 1):
+                h = jax.eval_shape(
+                    lambda p, x, _i=i: make_stage(_i)(p, x, None),
+                    params, h)
+                shapes.add((h.shape, h.dtype))
+            if len(shapes) != 1:
+                self._fallback_reason = (
+                    f"stage activations differ: {sorted(map(str, shapes))}")
+                return None
+        except Exception as e:
+            self._fallback_reason = (
+                f"stage probe raised {type(e).__name__}: {e}")
+            return None
+
+        stage_fns = [make_stage(i) for i in range(S - 1)] + [identity_stage]
+        return stage_fns, last_fn
+
     def _build_step(self, model, optimizer, n_micro):
         inner_opt = optimizer if hasattr(optimizer, "opt_state") else \
             optimizer.inner_opt
+        mesh = self._pp_mesh()
 
-        def step(params, opt_state, key, xb, yb):
+        def accum_step(params, opt_state, key, xb, yb):
+            """Fallback: sequential microbatch grad-accumulation."""
             with _random.trace_key_guard(key):
                 saved = model.functional_state()
                 model.load_functional_state(params)
                 inner_opt.load_opt_state(opt_state)
                 try:
-                    xs = [Tensor(m, stop_gradient=True)
-                          for m in jnp.split(xb, n_micro, axis=0)]
-                    ys = [Tensor(m, stop_gradient=True)
-                          for m in jnp.split(yb, n_micro, axis=0)]
+                    xs = [Tensor(m_, stop_gradient=True)
+                          for m_ in jnp.split(xb, n_micro, axis=0)]
+                    ys = [Tensor(m_, stop_gradient=True)
+                          for m_ in jnp.split(yb, n_micro, axis=0)]
                     total = None
                     with tape.enable_grad():
                         for xm, ym in zip(xs, ys):
@@ -88,7 +179,61 @@ class PipelineParallel(MetaParallelBase):
                 finally:
                     model.load_functional_state(saved)
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        def make_pipelined(stage_fns, last_fn):
+            def step(params, opt_state, key, xb, yb):
+                with _random.trace_key_guard(key):
+                    saved = model.functional_state()
+                    inner_opt.load_opt_state(opt_state)
+                    try:
+                        aux = {
+                            "x": xb.reshape(
+                                (n_micro, xb.shape[0] // n_micro)
+                                + xb.shape[1:]),
+                            "y": yb.reshape(
+                                (n_micro, yb.shape[0] // n_micro)
+                                + yb.shape[1:]),
+                        }
+                        loss, grads = pipeline_1f1b_hetero(
+                            stage_fns, last_fn, params, aux, mesh)
+                        model.load_functional_state(params)
+                        named = dict(model.named_parameters())
+                        with tape.no_grad():
+                            for k, p in named.items():
+                                if not p.stop_gradient:
+                                    p._grad = Tensor(grads[k],
+                                                     stop_gradient=True)
+                            inner_opt.step()
+                            inner_opt.clear_grad()
+                        new_params = {k: p._data for k, p in named.items()}
+                        return loss, new_params, inner_opt.opt_state()
+                    finally:
+                        model.load_functional_state(saved)
+
+            return step
+
+        def compile_for(xb):
+            if mesh is not None:
+                self._fallback_reason = \
+                    "model is not a PipelineLayer with pp-many stages"
+                built = self._segment_fns(model, n_micro, mesh, xb)
+                if built is not None:
+                    return make_pipelined(*built)
+                warnings.warn(
+                    "PipelineLayer can't use the 1F1B pipeline engine "
+                    f"({self._fallback_reason}); train_batch falls back "
+                    "to gradient accumulation without pipeline overlap")
+            return accum_step
+
+        compiled = {}
+
+        def dispatch(params, opt_state, key, xb, yb):
+            sig = (xb.shape, str(xb.dtype), yb.shape, str(yb.dtype))
+            if sig not in compiled:
+                compiled[sig] = jax.jit(compile_for(xb),
+                                        donate_argnums=(0, 1))
+            return compiled[sig](params, opt_state, key, xb, yb)
+
+        return dispatch
 
     def eval_batch(self, data, compute_loss=True):
         x, y = data

@@ -1,0 +1,413 @@
+"""Hand-scheduled pipeline parallelism: 1F1B and interleaved (VPP).
+
+Reference analog: fleet/meta_parallel/pipeline_parallel.py (1F1B schedule
+:575, interleaved VPP :1174) + pp_utils/p2p_communication.py, and the
+zero-bubble pass (passes/pipeline_scheduler_pass/pipeline_zero_bubble.py).
+The reference drives per-rank Python schedules exchanging activations with
+isend/irecv.  The TPU-native formulation here is a single SPMD program:
+
+  * stages live on the 'pp' mesh axis; activations/cotangents hop along the
+    ring with `lax.ppermute` (ICI neighbours);
+  * a tick does at most one forward unit AND one backward unit per device
+    ("fused-tick 1F1B");
+  * backward is MANUAL `jax.vjp` per tick — no AD through the scan — so
+    in-flight residuals are bounded by the schedule (a ring buffer of
+    ~2·pp stage inputs), not by the number of microbatches (GPipe/AD's
+    profile);
+  * the loss head runs inside the pipeline at the last stage so backward
+    for microbatch j starts the moment its forward leaves the last stage
+    — the defining property of 1F1B.
+
+Schedule (S stages, v chunks/virtual stages per device, m microbatches,
+tick t, device s):
+  forward  of chunk c, microbatch j=g·S+r  at  t = g·v·S + c·S + r + s
+  backward mirrors it:                        t_b = 2·t_last(j) - t_f
+so the last virtual stage backpropagates a microbatch in the same tick
+that computed its forward.  v=1 is plain 1F1B; v>1 is the circular
+(interleaved/VPP) variant: device s owns virtual stages {c·S+s},
+microbatches visit the ring v times and the fill/drain cost per slot
+drops by 1/v.  Activation lifetime is ≤ 2·v·S - 2 ticks, so the ring
+buffer holds 2 groups per chunk regardless of m.
+
+Bubble handling: the tick timeline splits into three statically-known
+phases — warmup ticks [0, vS-1) where no device has a backward unit,
+steady ticks, and drain ticks [mv+S-1, end) where no device has a
+forward unit.  Each phase is its own `lax.scan` whose body only contains
+the work that phase can have, so warmup costs ~a forward and drain ~a
+backward (the classic 1F1B profile) with no garbage compute and no
+data-dependent conditionals (which would deadlock GSPMD collectives
+inserted for tp/dp inside diverging branches).  Within the steady phase
+the per-stage stagger is masked arithmetic — those ticks are the
+unavoidable SPMD bubble.
+
+Zero-bubble (ZB-H1) note: splitting dx from dW to fill the drain is a
+scheduling refinement of the same engine (run the dW vjp of tick t's
+microbatch in a later otherwise-idle tick); XLA already overlaps the
+per-tick ppermute with compute, which captures part of that win.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["pipeline_1f1b", "pipeline_1f1b_hetero", "stack_stage_params"]
+
+
+def _tmap(f, *trees):
+    return jax.tree_util.tree_map(f, *trees)
+
+
+def _dyn(leaf, i):
+    return jax.lax.dynamic_index_in_dim(leaf, i, axis=0, keepdims=False)
+
+
+def stack_stage_params(layer_params_list, n_stages, n_virtual=1):
+    """Stack a list of L identical-shape per-layer pytrees into the
+    [S, v, lps, ...] layout pipeline_1f1b expects (device s owns virtual
+    stages {c*S+s : c}, reference interleaved assignment
+    pipeline_parallel.py:1174)."""
+    L = len(layer_params_list)
+    sv = n_stages * n_virtual
+    assert L % sv == 0, (L, n_stages, n_virtual)
+    lps = L // sv
+    rows = []
+    for s in range(n_stages):
+        chunks = []
+        for c in range(n_virtual):
+            k = c * n_stages + s
+            grp = layer_params_list[k * lps:(k + 1) * lps]
+            chunks.append(_tmap(lambda *xs: jnp.stack(xs), *grp))
+        rows.append(_tmap(lambda *xs: jnp.stack(xs), *chunks))
+    return _tmap(lambda *xs: jnp.stack(xs), *rows)
+
+
+def pipeline_1f1b(stage_fn: Callable, first_fn: Callable, last_fn: Callable,
+                  stacked_params, first_params, last_params, aux, mesh,
+                  axis_name: str = "pp", n_virtual: int = 1):
+    """One 1F1B forward+backward pass. Returns
+    (loss_sum, d_stacked, d_first, d_last).
+
+    stage_fn(chunk_params, x) -> x'     homogeneous trunk chunk
+    first_fn(first_params, aux_j) -> x  stage-0 input (e.g. embedding)
+    last_fn(last_params, y, aux_j) -> scalar loss for one microbatch
+    stacked_params: leaves [S, v, ...] (S = mesh pp size, v = n_virtual);
+                    see stack_stage_params.
+    first_params/last_params: replicated pytrees.
+    aux: per-microbatch inputs, leaves [m, ...] (replicated over pp).
+
+    Losses are summed over microbatches; bake any 1/(tokens) scaling into
+    last_fn so gradients match the equivalent whole-batch loss.
+    """
+    S = mesh.shape[axis_name]
+    v = int(n_virtual)
+    m = jax.tree_util.tree_leaves(aux)[0].shape[0]
+    if v > 1:
+        assert m % S == 0, \
+            f"interleaved schedule needs n_micro % pp == 0, got {m} % {S}"
+    vS = v * S
+    n_buf = 2  # groups per chunk live at once (lifetime <= 2*v*S - 2)
+    total_ticks = m * v + 2 * (S - 1) + (v - 1) * S
+    warmup_end = min(vS - 1, total_ticks)          # no bwd unit before
+    drain_start = min(m * v + S - 1, total_ticks)  # no fwd unit after
+
+    # probe shapes: one microbatch through first_fn (eval_shape only)
+    aux0 = _tmap(lambda a: jax.eval_shape(lambda x: x[0], a), aux)
+    x_shape = jax.eval_shape(first_fn, first_params, aux0)
+
+    def per_device(stk, fp, lp, aux):
+        local = _tmap(lambda a: a[0], stk)      # [v, lps, ...]
+        s = jax.lax.axis_index(axis_name)
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+        bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+        def aux_at(j):
+            return _tmap(lambda a: _dyn(a, j), aux)
+
+        def chunk_params(c):
+            return _tmap(lambda a: _dyn(a, c), local)
+
+        def mask(active, tree):
+            return _tmap(
+                lambda a: jnp.where(active, a, jnp.zeros_like(a)), tree)
+
+        def tick(carry, t, do_fwd, do_bwd, do_tail):
+            (fwd_state, bwd_state, xbuf, dstk, dfp, dlp, loss_acc) = carry
+            dy_tail = None
+
+            if do_fwd:
+                # ---- forward unit indices ---------------------------
+                q = t - s
+                g_f = q // vS
+                c_f = (q % vS) // S
+                r_f = q % S
+                j_f = g_f * S + r_f
+                f_act = jnp.logical_and(q >= 0, q < m * v)
+                jf_c = jnp.clip(j_f, 0, m - 1)
+                inject = jnp.logical_and(s == 0, c_f == 0)
+
+                x_in = jnp.where(inject, first_fn(fp, aux_at(jf_c)),
+                                 fwd_state)
+                y = stage_fn(chunk_params(c_f), x_in)
+                y = mask(f_act, y)
+
+                # save stage input for this microbatch's backward tick
+                slot_f = (g_f % n_buf) * S + r_f
+                write = jnp.where(f_act, c_f * (n_buf * S) + slot_f, 0)
+                xbuf = jax.lax.dynamic_update_index_in_dim(
+                    xbuf, jnp.where(f_act, x_in, xbuf[write]), write,
+                    axis=0)
+
+                if do_tail:
+                    # ---- loss head at the last virtual stage ---------
+                    tail_act = jnp.logical_and(
+                        f_act, jnp.logical_and(s == S - 1, c_f == v - 1))
+                    (loss_j, (dy_tail, dlp_j)) = jax.value_and_grad(
+                        lambda yy, ll: last_fn(ll, yy, aux_at(jf_c)),
+                        argnums=(0, 1))(y, lp)
+                    loss_acc = loss_acc + jnp.where(
+                        tail_act, loss_j.astype(jnp.float32), 0.0)
+                    dlp = _tmap(lambda a, g: a + g.astype(jnp.float32),
+                                dlp, mask(tail_act, dlp_j))
+                    dy_tail = mask(tail_act, dy_tail)
+            else:
+                y = jnp.zeros_like(fwd_state)
+
+            if do_bwd:
+                # ---- backward unit indices (mirror schedule) ---------
+                w = t - (2 * (S - 1) - s) - (v - 1) * S
+                g_b = w // vS
+                c_b = (v - 1) - (w % vS) // S
+                r_b = w % S
+                j_b = g_b * S + r_b
+                b_act = jnp.logical_and(w >= 0, w < m * v)
+                cb_c = jnp.clip(c_b, 0, v - 1)
+
+                # at the tail, j_b == j_f: the cotangent is this tick's
+                tail_b = jnp.logical_and(s == S - 1, c_b == v - 1)
+                dy = bwd_state
+                if dy_tail is not None:
+                    dy = jnp.where(tail_b, dy_tail, dy)
+                dy = mask(b_act, dy)
+
+                slot_b = (g_b % n_buf) * S + r_b
+                read = jnp.where(b_act, cb_c * (n_buf * S) + slot_b, 0)
+                x_saved = xbuf[read]
+
+                _, pull = jax.vjp(stage_fn, chunk_params(cb_c), x_saved)
+                dcp_j, dx = pull(dy)
+                dstk = _tmap(
+                    lambda acc, g: jax.lax.dynamic_update_index_in_dim(
+                        acc, _dyn(acc, cb_c) + g.astype(jnp.float32),
+                        cb_c, axis=0),
+                    dstk, dcp_j)
+
+                # stage-0 chunk-0 backward feeds the first_fn vjp
+                head_b = jnp.logical_and(
+                    b_act, jnp.logical_and(s == 0, c_b == 0))
+                _, pull_f = jax.vjp(
+                    lambda f: first_fn(f, aux_at(jnp.clip(j_b, 0, m - 1))),
+                    fp)
+                (dfp_j,) = pull_f(mask(head_b, dx))
+                dfp = _tmap(lambda a, g: a + g.astype(jnp.float32),
+                            dfp, dfp_j)
+            else:
+                dx = jnp.zeros_like(fwd_state)
+
+            # ---- ring communication ---------------------------------
+            fwd_state = jax.lax.ppermute(y, axis_name, fwd_perm)
+            bwd_state = jax.lax.ppermute(dx, axis_name, bwd_perm)
+            return (fwd_state, bwd_state, xbuf, dstk, dfp, dlp,
+                    loss_acc), None
+
+        x_dtype = x_shape.dtype
+        zeros_x = jnp.zeros(x_shape.shape, x_dtype)
+        carry = (
+            zeros_x,                                   # fwd activation in
+            zeros_x,                                   # bwd cotangent in
+            jnp.zeros((v * n_buf * S,) + x_shape.shape, x_dtype),
+            _tmap(lambda a: jnp.zeros(a.shape, jnp.float32), local),
+            _tmap(lambda a: jnp.zeros(a.shape, jnp.float32), fp),
+            _tmap(lambda a: jnp.zeros(a.shape, jnp.float32), lp),
+            jnp.zeros((), jnp.float32),
+        )
+        # three statically-bounded phases: fwd-only / 1F1B / bwd-only
+        # (the tail's first possible tick is vS-1 = warmup_end, so warmup
+        # provably skips the loss-head compute too)
+        for lo, hi, do_f, do_b in (
+                (0, warmup_end, True, False),
+                (warmup_end, drain_start, True, True),
+                (drain_start, total_ticks, False, True)):
+            if hi > lo:
+                carry, _ = jax.lax.scan(
+                    lambda c, t, _f=do_f, _b=do_b: tick(c, t, _f, _b,
+                                                        do_tail=_f and _b),
+                    carry, jnp.arange(lo, hi))
+        _, _, _, dstk, dfp, dlp, loss_acc = carry
+
+        # stage grads stay pp-sharded; first/last grads + loss reduce
+        loss_acc = jax.lax.psum(loss_acc, axis_name)
+        dfp = _tmap(lambda a: jax.lax.psum(a, axis_name), dfp)
+        dlp = _tmap(lambda a: jax.lax.psum(a, axis_name), dlp)
+        dstk = _tmap(lambda a: a[None], dstk)   # [1, v, lps, ...]
+        return loss_acc, dstk, dfp, dlp
+
+    stage_spec = _tmap(
+        lambda a: P(axis_name, *([None] * (a.ndim - 1))), stacked_params)
+    rep = lambda tree: _tmap(lambda a: P(*([None] * a.ndim)), tree)  # noqa
+
+    fn = jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(stage_spec, rep(first_params), rep(last_params),
+                  rep(aux)),
+        out_specs=(P(), stage_spec, rep(first_params), rep(last_params)),
+        axis_names=frozenset({axis_name}), check_vma=False)
+
+    loss, dstk, dfp, dlp = fn(stacked_params, first_params, last_params,
+                              aux)
+    # match value_and_grad's dtype contract (grads in param dtype)
+    cast = lambda g, p: _tmap(  # noqa: E731
+        lambda gg, pp: gg.astype(pp.dtype), g, p)
+    return (loss, cast(dstk, stacked_params), cast(dfp, first_params),
+            cast(dlp, last_params))
+
+
+def pipeline_1f1b_hetero(stage_fns, last_fn, params, aux, mesh,
+                         axis_name: str = "pp"):
+    """1F1B over HETEROGENEOUS stages (fleet PipelineLayer segments).
+
+    stage_fns: list of S callables; stage_fns[s](params, x, aux_j) -> h.
+      Stage 0 usually ignores x and builds its input from aux_j (the raw
+      microbatch); every stage's OUTPUT must have one common shape/dtype
+      (the ring activation).  The final segment belongs in last_fn, not
+      here — pass its slot as the identity (the builder in
+      fleet/meta_parallel does this).
+    last_fn(params, y, aux_j) -> scalar microbatch loss: the final
+      segment + loss head, run on the last device.
+    params: ONE replicated pytree; returned grads are psum'd over pp so
+      each stage's contribution (zeros elsewhere) sums to the total.
+    aux: per-microbatch inputs, leaves [m, ...] (replicated over pp).
+
+    Returns (loss_sum, grads).
+
+    Per-device compute goes through `lax.switch` on the stage index —
+    branches are traced once and only the resident stage executes at run
+    time.  Same fused-tick mirror schedule as pipeline_1f1b (v=1), same
+    three-phase bubble structure, same bounded ring buffer.
+    """
+    S = mesh.shape[axis_name]
+    assert len(stage_fns) == S, (len(stage_fns), S)
+    m = jax.tree_util.tree_leaves(aux)[0].shape[0]
+    n_buf = 2
+    total_ticks = m + 2 * (S - 1)
+    warmup_end = min(S - 1, total_ticks)
+    drain_start = min(m + S - 1, total_ticks)
+
+    aux0 = _tmap(lambda a: jax.eval_shape(lambda x: x[0], a), aux)
+    h_shape = jax.eval_shape(
+        lambda p, a: stage_fns[0](p, None, a), params, aux0)
+
+    def per_device(params, aux):
+        s = jax.lax.axis_index(axis_name)
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+        bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+        def aux_at(j):
+            return _tmap(lambda a: _dyn(a, j), aux)
+
+        def mask(active, tree):
+            return _tmap(
+                lambda a: jnp.where(active, a, jnp.zeros_like(a)), tree)
+
+        def run_stage(p, x, aux_j):
+            return jax.lax.switch(
+                s, [lambda pp_, x_, a_, _f=f: _f(pp_, x_, a_)
+                    for f in stage_fns], p, x, aux_j)
+
+        def tick(carry, t, do_fwd, do_bwd, do_tail):
+            (fwd_state, bwd_state, xbuf, dparams, loss_acc) = carry
+            dy_tail = None
+
+            if do_fwd:
+                j_f = t - s
+                f_act = jnp.logical_and(j_f >= 0, j_f < m)
+                jf_c = jnp.clip(j_f, 0, m - 1)
+                x_in = fwd_state
+                y = mask(f_act, run_stage(params, x_in, aux_at(jf_c)))
+
+                slot = jnp.where(f_act, j_f % (n_buf * S), 0)
+                xbuf = jax.lax.dynamic_update_index_in_dim(
+                    xbuf, jnp.where(f_act, x_in, xbuf[slot]), slot, axis=0)
+
+                if do_tail:
+                    tail_act = jnp.logical_and(f_act, s == S - 1)
+                    (loss_j, (dy_tail, dp_tail)) = jax.value_and_grad(
+                        lambda yy, p: last_fn(p, yy, aux_at(jf_c)),
+                        argnums=(0, 1))(y, params)
+                    loss_acc = loss_acc + jnp.where(
+                        tail_act, loss_j.astype(jnp.float32), 0.0)
+                    dparams = _tmap(
+                        lambda a, g: a + g.astype(jnp.float32),
+                        dparams, mask(tail_act, dp_tail))
+                    dy_tail = mask(tail_act, dy_tail)
+            else:
+                y = jnp.zeros_like(fwd_state)
+
+            if do_bwd:
+                j_b = t - (2 * (S - 1) - s)
+                b_act = jnp.logical_and(j_b >= 0, j_b < m)
+                jb_c = jnp.clip(j_b, 0, m - 1)
+
+                dy = bwd_state
+                if dy_tail is not None:
+                    dy = jnp.where(s == S - 1, dy_tail, dy)
+                dy = mask(b_act, dy)
+
+                slot = jnp.where(b_act, j_b % (n_buf * S), 0)
+                x_saved = xbuf[slot]
+
+                _, pull = jax.vjp(
+                    lambda p, x: run_stage(p, x, aux_at(jb_c)),
+                    params, x_saved)
+                dp_j, dx = pull(dy)
+                dparams = _tmap(lambda a, g: a + g.astype(jnp.float32),
+                                dparams, mask(b_act, dp_j))
+            else:
+                dx = jnp.zeros_like(fwd_state)
+
+            fwd_state = jax.lax.ppermute(y, axis_name, fwd_perm)
+            bwd_state = jax.lax.ppermute(dx, axis_name, bwd_perm)
+            return (fwd_state, bwd_state, xbuf, dparams, loss_acc), None
+
+        zeros_h = jnp.zeros(h_shape.shape, h_shape.dtype)
+        carry = (
+            zeros_h, zeros_h,
+            jnp.zeros((n_buf * S,) + h_shape.shape, h_shape.dtype),
+            _tmap(lambda a: jnp.zeros(a.shape, jnp.float32), params),
+            jnp.zeros((), jnp.float32),
+        )
+        for lo, hi, do_f, do_b in (
+                (0, warmup_end, True, False),
+                (warmup_end, drain_start, True, True),
+                (drain_start, total_ticks, False, True)):
+            if hi > lo:
+                carry, _ = jax.lax.scan(
+                    lambda c, t, _f=do_f, _b=do_b: tick(c, t, _f, _b,
+                                                        do_tail=_f and _b),
+                    carry, jnp.arange(lo, hi))
+        _, _, _, dparams, loss_acc = carry
+        loss_acc = jax.lax.psum(loss_acc, axis_name)
+        dparams = _tmap(lambda a: jax.lax.psum(a, axis_name), dparams)
+        return loss_acc, dparams
+
+    rep = lambda tree: _tmap(lambda a: P(*([None] * a.ndim)), tree)  # noqa
+
+    fn = jax.shard_map(
+        per_device, mesh=mesh, in_specs=(rep(params), rep(aux)),
+        out_specs=(P(), rep(params)),
+        axis_names=frozenset({axis_name}), check_vma=False)
+    loss, grads = fn(params, aux)
+    grads = _tmap(lambda g, p: g.astype(p.dtype), grads, params)
+    return loss, grads

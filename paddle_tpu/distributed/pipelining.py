@@ -69,13 +69,14 @@ def spmd_pipeline(stage_fn: Callable, stacked_params, microbatches, mesh,
         outs0 = jnp.zeros_like(mbs)
         (state, outs), _ = jax.lax.scan(tick, (state0, outs0),
                                         jnp.arange(total))
-        # non-final stages hold zeros; psum replicates final-stage outputs
-        outs = jax.lax.psum(outs, axis_name)
-        return outs
+        # only the final stage's buffer is real; keep it pp-stacked and
+        # let the caller's slice broadcast from the last stage (cheaper
+        # than psum-ing a buffer that is zeros on pp-1 stages)
+        return outs[None]
 
     spec_params = jax.tree_util.tree_map(_stage_spec, stacked_params)
 
     fn = shard_map(per_device, mesh=mesh,
                    in_specs=(spec_params, P()),
-                   out_specs=P(), check_vma=False)
-    return fn(stacked_params, microbatches)
+                   out_specs=P(axis_name), check_vma=False)
+    return fn(stacked_params, microbatches)[-1]

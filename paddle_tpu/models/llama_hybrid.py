@@ -15,11 +15,13 @@ TPU formulation (SURVEY.md §7-§8): one jitted SPMD program over a
   * sp  — Megatron-SP: activations outside attention carry a
           sequence-dim sharding constraint over the tp axis, replacing the
           scatter/allgather PyLayers in sequence_parallel_utils.py.
-  * pp  — stage-stacked weights sharded over 'pp'; a lax.scan over
-          (microbatches + stages - 1) ticks inside a shard_map that is
-          manual over 'pp' only; activations hop stages via ppermute on
-          ICI.  jax.grad through the scan IS the backward pipeline —
-          replacing the hand-written 1F1B schedule + p2p_communication.py.
+  * pp  — stage-stacked weights sharded over 'pp'; activations hop
+          stages via ppermute on ICI inside a shard_map that is manual
+          over 'pp' only.  Two schedules: "gpipe" differentiates through
+          the fill-drain scan (pipelining.py); "1f1b" (+ interleaved
+          n_virtual>1) runs the hand-scheduled engine with bounded
+          in-flight residuals (distributed/pipeline_schedules.py) —
+          replacing pipeline_parallel.py:575/:1174 + p2p_communication.
   * remat — jax.checkpoint on the per-layer body (reference:
           fleet/recompute/recompute.py).
 
@@ -38,6 +40,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .llama import LlamaConfig, _rope_tables, apply_rotary_pos_emb
+from ..distributed.pipeline_schedules import pipeline_1f1b
 from ..ops.pallas.flash_attention import sdpa
 
 
@@ -61,11 +64,15 @@ def default_axes(n):
 
 
 # ------------------------------------------------------------ parameters
-def init_params(config: LlamaConfig, n_pp: int, key, dtype=jnp.float32):
+def init_params(config: LlamaConfig, n_pp: int, key, dtype=jnp.float32,
+                n_virtual: int = 1):
     """Params pytree. Decoder leaves are stage-stacked:
-    [n_pp, layers_per_stage, ...]."""
-    assert config.num_hidden_layers % n_pp == 0
-    lps = config.num_hidden_layers // n_pp
+    [n_pp, layers_per_stage, ...] (or [n_pp, n_virtual, lps, ...] for the
+    interleaved schedule — device s owns virtual stages {c*n_pp+s})."""
+    sv = n_pp * n_virtual
+    assert config.num_hidden_layers % sv == 0
+    lps = config.num_hidden_layers // sv
+    lead = (n_pp, n_virtual, lps) if n_virtual > 1 else (n_pp, lps)
     h, i = config.hidden_size, config.intermediate_size
     hd, nh, kvh = config.head_dim, config.num_attention_heads, \
         config.num_key_value_heads
@@ -73,16 +80,16 @@ def init_params(config: LlamaConfig, n_pp: int, key, dtype=jnp.float32):
 
     def w(k, *shape, fan_in):
         std = 1.0 / math.sqrt(fan_in)
-        return (jax.random.normal(k, (n_pp, lps) + shape, jnp.float32)
+        return (jax.random.normal(k, lead + shape, jnp.float32)
                 * std).astype(dtype)
 
     layer = {
-        "input_ln": jnp.ones((n_pp, lps, h), dtype),
+        "input_ln": jnp.ones(lead + (h,), dtype),
         "q": w(ks[0], h, nh * hd, fan_in=h),
         "k": w(ks[1], h, kvh * hd, fan_in=h),
         "v": w(ks[2], h, kvh * hd, fan_in=h),
         "o": w(ks[3], nh * hd, h, fan_in=nh * hd),
-        "post_ln": jnp.ones((n_pp, lps, h), dtype),
+        "post_ln": jnp.ones(lead + (h,), dtype),
         "gate": w(ks[4], h, i, fan_in=h),
         "up": w(ks[5], h, i, fan_in=h),
         "down": w(ks[6], i, h, fan_in=i),
@@ -95,12 +102,13 @@ def init_params(config: LlamaConfig, n_pp: int, key, dtype=jnp.float32):
             "norm": jnp.ones((h,), dtype), "head": head}
 
 
-def param_shardings(mesh: Mesh):
+def param_shardings(mesh: Mesh, n_virtual: int = 1):
     """NamedShardings implementing the reference TP plan + pp stacking."""
     s = functools.partial(NamedSharding, mesh)
-    col = s(P("pp", None, None, "tp"))   # [pp, lps, in, out] col-parallel
-    row = s(P("pp", None, "tp", None))   # row-parallel
-    ln = s(P("pp", None, None))
+    pad = (None,) * (1 if n_virtual > 1 else 0)  # extra chunk dim
+    col = s(P("pp", *pad, None, None, "tp"))  # [pp,(v),lps,in,out] colwise
+    row = s(P("pp", *pad, None, "tp", None))  # row-parallel
+    ln = s(P("pp", *pad, None, None))
     return {
         "embed": s(P(None, "tp")),
         "stages": {"input_ln": ln, "q": col, "k": col, "v": col, "o": row,
@@ -189,13 +197,17 @@ def pipelined_trunk(stacked, mbs, cos, sin, config, mesh, remat=True):
 
         init = (jnp.zeros_like(mbs[0]), jnp.zeros_like(mbs))
         (_, outs), _ = jax.lax.scan(tick, init, jnp.arange(total))
-        return jax.lax.psum(outs, "pp")
+        # keep outs pp-stacked: only the last stage's row is real, and the
+        # caller slices it — a broadcast from the last stage replaces the
+        # old full-buffer psum (pp x less data on the wire)
+        return outs[None]
 
-    return jax.shard_map(
+    stacked_out = jax.shard_map(
         per_device, mesh=mesh,
         in_specs=(jax.tree_util.tree_map(lambda _: P("pp"), stacked), P()),
-        out_specs=P(), axis_names=frozenset({"pp"}),
+        out_specs=P("pp"), axis_names=frozenset({"pp"}),
         check_vma=False)(stacked, mbs)
+    return stacked_out[-1]
 
 
 # ------------------------------------------------------------- train step
@@ -222,13 +234,19 @@ def loss_fn(params, ids, config: LlamaConfig, mesh: Mesh, n_micro=1,
                           remat)
     h = out.reshape(b, s, -1)
     h = _rms(h, params["norm"], config.rms_norm_eps)
-    # Chunked CE over the sequence dim: never materializes the full
-    # [B,S,V] fp32 logits (the usual OOM at vocab 32k+), and logsumexp's
-    # VJP re-derives softmax from the saved chunk logits instead of
-    # keeping a log_softmax copy.
+    return _chunked_ce_sum(h, lab, params["head"]) / (b * s)
+
+
+def _chunked_ce_sum(h, lab, head):
+    """Summed next-token CE, chunked over the sequence dim: never
+    materializes the full [B,S,V] fp32 logits (the usual OOM at vocab
+    32k+), and logsumexp's VJP re-derives softmax from the saved chunk
+    logits instead of keeping a log_softmax copy."""
+    b, s = lab.shape
+
     def ce_chunk(args):
         hc, lc = args
-        logits = (hc @ params["head"]).astype(jnp.float32)
+        logits = (hc @ head).astype(jnp.float32)
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
         tgt = jnp.take_along_axis(logits, lc[..., None], axis=-1)[..., 0]
         return jnp.sum(lse - tgt)
@@ -236,8 +254,56 @@ def loss_fn(params, ids, config: LlamaConfig, mesh: Mesh, n_micro=1,
     n_chunks = next(c for c in (8, 7, 6, 5, 4, 3, 2, 1) if s % c == 0)
     hs = h.reshape(b, n_chunks, s // n_chunks, h.shape[-1]).swapaxes(0, 1)
     ls = lab.reshape(b, n_chunks, s // n_chunks).swapaxes(0, 1)
-    tot = jnp.sum(jax.lax.map(jax.checkpoint(ce_chunk), (hs, ls)))
-    return tot / (b * s)
+    return jnp.sum(jax.lax.map(jax.checkpoint(ce_chunk), (hs, ls)))
+
+
+def grad_1f1b(params, ids, config: LlamaConfig, mesh: Mesh, n_micro,
+              n_virtual=1, remat=True, sp=True):
+    """(loss, grads) via the hand-scheduled 1F1B / interleaved pipeline
+    (distributed/pipeline_schedules.py) instead of AD through the GPipe
+    scan.  Embedding runs at stage 0, final-norm+head+CE at the last
+    stage, so each microbatch's backward starts as soon as its forward
+    leaves the pipe — in-flight residuals are bounded by ~2*pp
+    microbatches instead of all of them.
+
+    Reference: fleet/meta_parallel/pipeline_parallel.py:575 (1F1B),
+    :1174 (interleaved VPP)."""
+    b, s_tot = ids.shape
+    s = s_tot - 1
+    assert b % n_micro == 0, (b, n_micro)
+    aux = ids.reshape(n_micro, b // n_micro, s_tot)
+    fp = {"embed": params["embed"]}
+    lp = {"norm": params["norm"], "head": params["head"]}
+    inv_tok = 1.0 / (b * s)
+    cos, sin = _rope_tables(s, config.head_dim, config.rope_theta)
+
+    def first_fn(fp, aux_j):
+        # NOTE: unlike loss_fn, no explicit with_sharding_constraint here
+        # — the XLA SPMD partitioner aborts on auto-axis constraints
+        # inside this pp-manual shard_map (jaxlib 0.9 CPU, verified).
+        # tp/dp placement of the gather follows GSPMD propagation from
+        # the tp-sharded table instead; `sp` is honored by the gpipe
+        # schedule only.
+        return jnp.take(fp["embed"], aux_j[:, :-1], axis=0)
+
+    def stage_fn(cp, x):
+        return _stage_fn(cp, x, cos, sin, config, remat)
+
+    def last_fn(lp, y, aux_j):
+        h = _rms(y, lp["norm"], config.rms_norm_eps)
+        return _chunked_ce_sum(h, aux_j[:, 1:], lp["head"]) * inv_tok
+
+    stages = params["stages"]
+    if n_virtual == 1:  # [pp, lps, ...] -> engine layout [pp, 1, lps, ...]
+        stages = jax.tree_util.tree_map(lambda a: a[:, None], stages)
+    loss, dstk, dfp, dlp = pipeline_1f1b(
+        stage_fn, first_fn, last_fn, stages, fp, lp, aux, mesh,
+        n_virtual=n_virtual)
+    if n_virtual == 1:
+        dstk = jax.tree_util.tree_map(lambda a: a[:, 0], dstk)
+    grads = {"embed": dfp["embed"], "stages": dstk,
+             "norm": dlp["norm"], "head": dlp["head"]}
+    return loss, grads
 
 
 class AdamWState(NamedTuple):
@@ -261,26 +327,43 @@ def init_adamw(params):
 
 def build_train_step(config: LlamaConfig, mesh: Mesh, lr=3e-4, wd=0.01,
                      n_micro=1, remat=True, sp=True, b1=0.9, b2=0.95,
-                     eps=1e-8, grad_accum=1):
+                     eps=1e-8, grad_accum=1, schedule="gpipe",
+                     n_virtual=1):
     """Returns jitted (params, opt, ids) -> (loss, params, opt).
+
+    schedule: "gpipe" = AD through the fill-drain scan (pipelining.py);
+    "1f1b" = hand-scheduled 1F1B (pipeline_schedules.py) with bounded
+    in-flight residuals; n_virtual > 1 selects the interleaved/VPP
+    variant of 1f1b (params must come from setup(..., n_virtual=v)).
 
     grad_accum > 1 splits the batch into sequential chunks and averages
     their grads before ONE optimizer step (reference: gradient-merge
     pass / fleet accumulate_steps) — live activations stay bounded by
     one chunk, trading wall-clock for a larger effective batch."""
+    use_1f1b = schedule == "1f1b" and mesh.shape["pp"] > 1
+    if n_virtual > 1 and not use_1f1b:
+        raise ValueError(
+            "n_virtual > 1 (interleaved/VPP) requires schedule='1f1b' "
+            f"and a pp axis > 1; got schedule={schedule!r}, "
+            f"pp={mesh.shape['pp']}")
+
+    def one_batch(params, ids):
+        if use_1f1b:
+            return grad_1f1b(params, ids, config, mesh, n_micro,
+                             n_virtual, remat, sp)
+        return jax.value_and_grad(loss_fn)(
+            params, ids, config, mesh, n_micro, remat, sp)
 
     def grad_of(params, ids):
         if grad_accum == 1:
-            return jax.value_and_grad(loss_fn)(
-                params, ids, config, mesh, n_micro, remat, sp)
+            return one_batch(params, ids)
         b = ids.shape[0]
         assert b % grad_accum == 0, (b, grad_accum)
         chunks = ids.reshape(grad_accum, b // grad_accum, ids.shape[1])
 
         def acc(carry, chunk):
             lsum, gsum = carry
-            loss, grads = jax.value_and_grad(loss_fn)(
-                params, chunk, config, mesh, n_micro, remat, sp)
+            loss, grads = one_batch(params, chunk)
             gsum = jax.tree_util.tree_map(
                 lambda a, g: a + g.astype(jnp.float32), gsum, grads)
             return (lsum + loss, gsum), None
@@ -320,11 +403,12 @@ def build_train_step(config: LlamaConfig, mesh: Mesh, lr=3e-4, wd=0.01,
     return jax.jit(step, donate_argnums=(0, 1))
 
 
-def setup(config: LlamaConfig, mesh: Mesh, seed=0, dtype=jnp.float32):
+def setup(config: LlamaConfig, mesh: Mesh, seed=0, dtype=jnp.float32,
+          n_virtual=1):
     """Init + place params and optimizer state on the mesh."""
     params = init_params(config, mesh.shape["pp"], jax.random.key(seed),
-                         dtype)
-    sh = param_shardings(mesh)
+                         dtype, n_virtual)
+    sh = param_shardings(mesh, n_virtual)
     params = jax.tree_util.tree_map(jax.device_put, params, sh)
     opt = init_adamw(params)
     return params, opt

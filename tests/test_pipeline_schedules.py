@@ -1,0 +1,263 @@
+"""1F1B / interleaved pipeline schedule tests (VERDICT r1 item 2).
+
+Reference patterns: fleet/meta_parallel/pipeline_parallel.py (1F1B :575,
+VPP :1174) exercised as distributed-vs-single-card numerical equivalence
+(SURVEY §4) on the 8-device virtual CPU mesh.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.pipeline_schedules import (
+    pipeline_1f1b, pipeline_1f1b_hetero, stack_stage_params)
+
+rng = np.random.RandomState(0)
+needs8 = pytest.mark.skipif(len(jax.devices()) < 8,
+                            reason="needs 8 virtual devices")
+
+
+def _mlp_setup(S, v, m, mb, H=16, V=29):
+    L = S * v * 2
+    ks = jax.random.split(jax.random.key(0), L + 3)
+    layers = [{"w": jax.random.normal(ks[i], (H, H)) * 0.3}
+              for i in range(L)]
+    fp = {"embed": jax.random.normal(ks[L], (V, H)) * 0.5}
+    lp = {"head": jax.random.normal(ks[L + 1], (H, V)) * 0.5}
+    ids = jax.random.randint(ks[L + 2], (m, mb, 5), 0, V)
+    lab = jax.random.randint(ks[L], (m, mb, 5), 0, V)
+    return layers, fp, lp, {"ids": ids, "lab": lab}
+
+
+def _stage_fn(cp, x):
+    def body(h, w):
+        return jnp.tanh(h @ w), None
+    out, _ = jax.lax.scan(body, x, cp["w"])
+    return out
+
+
+def _first_fn(fp, aux_j):
+    return jnp.take(fp["embed"], aux_j["ids"], axis=0)
+
+
+def _last_fn(lp, y, aux_j):
+    logits = (y @ lp["head"]).astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, aux_j["lab"][..., None],
+                              axis=-1)[..., 0]
+    return jnp.sum(lse - tgt) / aux_j["lab"].size
+
+
+def _reference(layers, fp, lp, aux):
+    m = aux["ids"].shape[0]
+
+    def loss(layers, fp, lp):
+        tot = 0.0
+        for j in range(m):
+            aux_j = {k: a[j] for k, a in aux.items()}
+            x = _first_fn(fp, aux_j)
+            for wd in layers:
+                x = jnp.tanh(x @ wd["w"])
+            tot = tot + _last_fn(lp, x, aux_j)
+        return tot
+
+    return jax.value_and_grad(loss, argnums=(0, 1, 2))(layers, fp, lp)
+
+
+@needs8
+class Test1F1BEngine:
+    @pytest.mark.parametrize("S,v,m", [(4, 1, 4), (2, 1, 5), (4, 2, 8),
+                                       (2, 3, 4)])
+    def test_matches_sequential_ad(self, S, v, m):
+        layers, fp, lp, aux = _mlp_setup(S, v, m, mb=3)
+        ref_l, ref_g = _reference(layers, fp, lp, aux)
+
+        mesh = Mesh(np.array(jax.devices()[:S]), ("pp",))
+        stk = stack_stage_params(layers, S, v)
+        loss, dstk, dfp, dlp = pipeline_1f1b(
+            _stage_fn, _first_fn, _last_fn, stk, fp, lp, aux, mesh,
+            n_virtual=v)
+
+        np.testing.assert_allclose(float(loss), float(ref_l), rtol=2e-5)
+        lps = len(layers) // (S * v)
+        for i, g_ref in enumerate(ref_g[0]):
+            k, r = divmod(i, lps)
+            c, s = k // S, k % S
+            np.testing.assert_allclose(dstk["w"][s, c, r], g_ref["w"],
+                                       rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(dfp["embed"], ref_g[1]["embed"],
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(dlp["head"], ref_g[2]["head"],
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_activation_buffer_is_bounded(self):
+        """1F1B property: the per-device stage-input ring holds 2*v*S
+        microbatches regardless of m (GPipe/AD would hold all m)."""
+        from paddle_tpu.distributed import pipeline_schedules as ps
+        S, v = 2, 1
+        layers, fp, lp, aux = _mlp_setup(S, v, m=12, mb=3)
+        mesh = Mesh(np.array(jax.devices()[:S]), ("pp",))
+        stk = stack_stage_params(layers, S, v)
+
+        captured = {}
+        orig = jnp.zeros
+
+        # the ring buffer is the only (k,) + x_shape zeros alloc
+        def probe(shape, dtype=None, **kw):
+            if isinstance(shape, tuple) and len(shape) == 4:
+                captured.setdefault("slots", shape[0])
+            return orig(shape, dtype, **kw)
+
+        ps.jnp.zeros = probe
+        try:
+            pipeline_1f1b(_stage_fn, _first_fn, _last_fn, stk, fp, lp,
+                          aux, mesh, n_virtual=v)
+        finally:
+            ps.jnp.zeros = orig
+        assert captured["slots"] == 2 * v * S  # not m = 12
+
+
+@needs8
+class TestLlamaHybrid1F1B:
+    def test_1f1b_matches_gpipe(self):
+        from paddle_tpu.models.llama import llama_tiny
+        from paddle_tpu.models import llama_hybrid as H
+
+        cfg = llama_tiny(num_hidden_layers=8, hidden_size=64,
+                         intermediate_size=128, vocab_size=128,
+                         num_attention_heads=4, num_key_value_heads=4)
+        mesh = H.build_mesh(8, pp=4, dp=2, tp=1)
+        ids = jnp.asarray(rng.randint(0, 128, (8, 33)), dtype=jnp.int64)
+
+        losses = {}
+        for sched in ("gpipe", "1f1b"):
+            params, opt = H.setup(cfg, mesh)
+            step = H.build_train_step(cfg, mesh, n_micro=4, sp=False,
+                                      schedule=sched)
+            out = []
+            for _ in range(2):
+                loss, params, opt = step(params, opt, ids)
+                out.append(float(loss))
+            losses[sched] = out
+        np.testing.assert_allclose(losses["gpipe"], losses["1f1b"],
+                                   rtol=2e-4)
+
+    def test_interleaved_with_tp(self):
+        from paddle_tpu.models.llama import llama_tiny
+        from paddle_tpu.models import llama_hybrid as H
+
+        cfg = llama_tiny(num_hidden_layers=8, hidden_size=64,
+                         intermediate_size=128, vocab_size=128,
+                         num_attention_heads=4, num_key_value_heads=4)
+        mesh = H.build_mesh(8, pp=2, dp=2, tp=2)
+        params, opt = H.setup(cfg, mesh, n_virtual=2)
+        step = H.build_train_step(cfg, mesh, n_micro=4, sp=False,
+                                  schedule="1f1b", n_virtual=2)
+        ids = jnp.asarray(rng.randint(0, 128, (8, 33)), dtype=jnp.int64)
+        losses = []
+        for _ in range(3):
+            loss, params, opt = step(params, opt, ids)
+            losses.append(float(loss))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+
+
+@needs8
+class TestFleetPipelineParallel:
+    def _build(self, n_layers=8, width=16):
+        from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import (
+            LayerDesc, PipelineLayer)
+        paddle.seed(7)
+        descs = [LayerDesc(nn.Linear, width, width) for _ in range(n_layers)]
+
+        def loss_fn(out, label):
+            return ((out - label) ** 2).mean()
+
+        return PipelineLayer(descs, num_stages=4, loss_fn=loss_fn)
+
+    def test_train_batch_actually_pipelines(self, recwarn):
+        from paddle_tpu.distributed.fleet import fleet, DistributedStrategy
+        from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel \
+            import PipelineParallel
+        import paddle_tpu.optimizer as opt
+
+        s = DistributedStrategy()
+        s.hybrid_configs["pp_degree"] = 4
+        s.hybrid_configs["dp_degree"] = 2
+        s.pipeline_configs["accumulate_steps"] = 4
+        fleet.init(is_collective=True, strategy=s)
+        hcg = fleet.get_hybrid_communicate_group()
+
+        model = self._build()
+        ref_state = {k: np.asarray(p._data)
+                     for k, p in model.named_parameters()}
+        o = opt.SGD(learning_rate=0.1, parameters=model.parameters())
+        pp = PipelineParallel(model, hcg, s)
+
+        x = rng.randn(8, 16).astype(np.float32)
+        y = rng.randn(8, 16).astype(np.float32)
+        loss = pp.train_batch((paddle.to_tensor(x), paddle.to_tensor(y)), o)
+
+        # no homogeneity fallback warning -> the 1F1B engine compiled
+        assert not any("falls back" in str(w.message) for w in recwarn.list)
+
+        # reference: sequential microbatch grad-accumulation + SGD
+        import paddle_tpu.nn.functional  # noqa
+        ref_model = self._build()
+        for k, p in ref_model.named_parameters():
+            p._data = jnp.asarray(ref_state[k])
+        ref_o = opt.SGD(learning_rate=0.1,
+                        parameters=ref_model.parameters())
+        total = 0.0
+        for i in range(4):
+            xm = paddle.to_tensor(x[i * 2:(i + 1) * 2])
+            ym = paddle.to_tensor(y[i * 2:(i + 1) * 2])
+            out = ref_model(xm)
+            l_ = ref_model.loss(out, ym) / 4
+            l_.backward()
+            total += float(l_)
+        ref_o.step()
+        ref_o.clear_grad()
+
+        np.testing.assert_allclose(float(loss), total, rtol=1e-4)
+        got = dict(model.named_parameters())
+        for k, p in ref_model.named_parameters():
+            np.testing.assert_allclose(np.asarray(got[k]._data),
+                                       np.asarray(p._data), atol=1e-5,
+                                       err_msg=k)
+
+    def test_heterogeneous_fallback_warns(self, recwarn):
+        """Non-homogeneous stages: correct numerics via grad-accum, loud
+        warning (VERDICT r1: 'wire PipelineLayer into the engine or fail
+        loudly')."""
+        from paddle_tpu.distributed.fleet import fleet, DistributedStrategy
+        from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel \
+            import PipelineParallel
+        from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import (
+            LayerDesc, PipelineLayer)
+        import paddle_tpu.optimizer as opt
+
+        s = DistributedStrategy()
+        s.hybrid_configs["pp_degree"] = 4
+        s.hybrid_configs["dp_degree"] = 2
+        s.pipeline_configs["accumulate_steps"] = 2
+        fleet.init(is_collective=True, strategy=s)
+        hcg = fleet.get_hybrid_communicate_group()
+
+        paddle.seed(3)
+        widths = [16, 24, 8, 12, 16, 16, 16, 16]
+        descs = [LayerDesc(nn.Linear, 16 if i == 0 else widths[i - 1],
+                           widths[i]) for i in range(8)]
+        model = PipelineLayer(descs, num_stages=4,
+                              loss_fn=lambda o, t: (o ** 2).mean())
+        o = opt.SGD(learning_rate=0.1, parameters=model.parameters())
+        pp = PipelineParallel(model, hcg, s)
+        x = rng.randn(4, 16).astype(np.float32)
+        y = rng.randn(4, 16).astype(np.float32)
+        loss = pp.train_batch((paddle.to_tensor(x), paddle.to_tensor(y)), o)
+        assert np.isfinite(float(loss))
+        assert any("falls back" in str(w.message) for w in recwarn.list)
