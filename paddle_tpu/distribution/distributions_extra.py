@@ -64,8 +64,17 @@ class Binomial(ExponentialFamily):
         return _t(log_comb + xlogy(v, p) + xlog1py(n - v, -p))
 
     def entropy(self):
-        # sum over support (total_count is small-int use cases)
-        n = int(np.max(np.asarray(self.total_count)))
+        # Exact sum over the support: O(max(total_count)) memory and
+        # requires a CONCRETE total_count (np.max on the value), so this
+        # cannot run under jit/tracing — by design for the small-count
+        # use cases the reference targets.
+        try:
+            n = int(np.max(np.asarray(self.total_count)))
+        except (jax.errors.TracerArrayConversionError,
+                jax.errors.ConcretizationTypeError) as e:
+            raise ValueError(
+                "Binomial.entropy() enumerates the support and needs a "
+                "concrete total_count; call it outside jit") from e
         ks = jnp.arange(n + 1, dtype=jnp.float32)
         shape = (n + 1,) + (1,) * max(len(self.batch_shape), 0)
         ks = ks.reshape(shape)

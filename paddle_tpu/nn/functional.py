@@ -351,12 +351,35 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
 
 # ------------------------------------------------------------------- pooling
 
+def _ceil_pads(pads, spatial, k, s):
+    """ceil_mode: extend the high-side padding so the last partial window
+    is emitted (reference phi/kernels/funcs/pooling.h ceil output size;
+    like the reference, a window that would start entirely inside the
+    padding is NOT emitted).  Max pools pad with -inf and avg/lp pools
+    pad with zeros + exclusive counts, so the extra region never
+    distorts in-window values."""
+    if isinstance(pads, str):
+        return pads
+    out = []
+    for i, (lo, hi) in enumerate(pads):
+        n_out = -(-(spatial[i] + lo + hi - k[i]) // s[i]) + 1  # ceil
+        # drop trailing windows that start past the real input
+        while n_out > 1 and (n_out - 1) * s[i] >= spatial[i] + lo:
+            n_out -= 1
+        extra = max(0, (n_out - 1) * s[i] + k[i] - (spatial[i] + lo + hi))
+        out.append((lo, hi + extra))
+    return out
+
+
 @op
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
     k = _pair(kernel_size)
     s = _pair(stride if stride is not None else kernel_size)
     pads = _conv_padding(padding, 2)
+    if ceil_mode:
+        spatial = x.shape[2:4] if data_format == "NCHW" else x.shape[1:3]
+        pads = _ceil_pads(pads, spatial, k, s)
     if data_format == "NCHW":
         window = (1, 1) + k
         strides = (1, 1) + s
@@ -385,6 +408,9 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     k = _pair(kernel_size)
     s = _pair(stride if stride is not None else kernel_size)
     pads = _conv_padding(padding, 2)
+    if ceil_mode:
+        spatial = x.shape[2:4] if data_format == "NCHW" else x.shape[1:3]
+        pads = _ceil_pads(pads, spatial, k, s)
     if data_format == "NCHW":
         window = (1, 1) + k
         strides = (1, 1) + s
@@ -411,6 +437,8 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
     k = _pair(kernel_size, 1)
     s = _pair(stride if stride is not None else kernel_size, 1)
     pads = _conv_padding(padding, 1)
+    if ceil_mode:
+        pads = _ceil_pads(pads, x.shape[2:3], k, s)
     if return_mask:
         from .functional_extra import _pool_argmax
         return _pool_argmax(x, k, s, pads)
@@ -425,6 +453,8 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
     k = _pair(kernel_size, 1)
     s = _pair(stride if stride is not None else kernel_size, 1)
     pads = _conv_padding(padding, 1)
+    if ceil_mode:
+        pads = _ceil_pads(pads, x.shape[2:3], k, s)
     summed = jax.lax.reduce_window(x, np.zeros((), x.dtype), jax.lax.add,
                                    (1, 1) + k, (1, 1) + s,
                                    [(0, 0), (0, 0)] + pads)

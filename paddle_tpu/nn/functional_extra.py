@@ -17,7 +17,7 @@ import numpy as np
 
 from ..ops.registry import op
 from ..framework import random as _random
-from .functional import _pair, _conv_padding, _reduce
+from .functional import _pair, _conv_padding, _reduce, _ceil_pads
 from ..ops.math_extra import unflatten  # noqa: F401  (shared op)
 
 __all__ = [
@@ -58,6 +58,8 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     k = _pair(kernel_size, 3)
     s = _pair(stride if stride is not None else kernel_size, 3)
     pads = _conv_padding(padding, 3)
+    if ceil_mode:
+        pads = _ceil_pads(pads, x.shape[2:5], k, s)
     if return_mask:
         return _pool_argmax(x, k, s, pads)
     window, strides, pad_cfg = _window_cfg(k, s, pads, 3)
@@ -79,6 +81,8 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     k = _pair(kernel_size, 3)
     s = _pair(stride if stride is not None else kernel_size, 3)
     pads = _conv_padding(padding, 3)
+    if ceil_mode:
+        pads = _ceil_pads(pads, x.shape[2:5], k, s)
     window, strides, pad_cfg = _window_cfg(k, s, pads, 3)
     summed = jax.lax.reduce_window(x, np.zeros((), x.dtype), jax.lax.add,
                                    window, strides, pad_cfg)
@@ -151,6 +155,8 @@ def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
     k = _pair(kernel_size, 1)
     s = _pair(stride if stride is not None else kernel_size, 1)
     pads = _conv_padding(padding, 1)
+    if ceil_mode:
+        pads = _ceil_pads(pads, x.shape[2:3], k, s)
     window, strides, pad_cfg = _window_cfg(k, s, pads, 1)
     p = float(norm_type)
     if math.isinf(p):
@@ -168,6 +174,8 @@ def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
     k = _pair(kernel_size, 2)
     s = _pair(stride if stride is not None else kernel_size, 2)
     pads = _conv_padding(padding, 2)
+    if ceil_mode:
+        pads = _ceil_pads(pads, x.shape[2:4], k, s)
     window, strides, pad_cfg = _window_cfg(k, s, pads, 2)
     p = float(norm_type)
     if math.isinf(p):

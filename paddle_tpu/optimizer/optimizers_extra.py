@@ -48,11 +48,22 @@ class Adamax(Optimizer):
 class ASGD(Optimizer):
     """Stochastic Average Gradient (the reference calls it ASGD): keeps the
     last gradient seen at each of ``batch_num`` ring slots and steps with the
-    running sum d/min(step, n)."""
+    running sum d/min(step, n).
+
+    Memory note: the ring buffer costs ``batch_num`` fp32 copies of EVERY
+    parameter on device (mirroring the reference design,
+    python/paddle/optimizer/asgd.py:240) — with large ``batch_num`` this
+    dwarfs the params themselves; a warning is emitted past 64."""
 
     def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
                  weight_decay=None, grad_clip=None, multi_precision=True,
                  name=None):
+        if batch_num is not None and batch_num > 64:
+            import warnings
+            warnings.warn(
+                f"ASGD allocates batch_num={batch_num} fp32 copies of "
+                "every parameter for its gradient ring buffer "
+                f"(~{batch_num}x param memory)")
         if batch_num is None or batch_num <= 0:
             raise ValueError("batch_num should be greater than 0")
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
@@ -364,113 +375,113 @@ class LBFGS(Optimizer):
         return loss
 
 
-def _cubic_interpolate(x1, f1, g1, x2, f2, g2, bounds=None):
-    if bounds is not None:
-        xmin_bound, xmax_bound = bounds
-    else:
-        xmin_bound, xmax_bound = (x1, x2) if x1 <= x2 else (x2, x1)
-    d1 = g1 + g2 - 3 * (f1 - f2) / (x1 - x2)
-    d2_square = d1 ** 2 - g1 * g2
-    if d2_square >= 0:
-        d2 = d2_square ** 0.5
-        if x1 <= x2:
-            min_pos = x2 - (x2 - x1) * ((g2 + d2 - d1) / (g2 - g1 + 2 * d2))
-        else:
-            min_pos = x1 - (x1 - x2) * ((g1 + d2 - d1) / (g1 - g2 + 2 * d2))
-        return min(max(min_pos, xmin_bound), xmax_bound)
-    return (xmin_bound + xmax_bound) / 2.0
+def _hermite_min(a, fa, sa, b, fb, sb, lo, hi):
+    """Minimizer of the cubic Hermite interpolant through (a, fa, sa) and
+    (b, fb, sb), clamped to [lo, hi].
+
+    Derivation: parametrize tau in [0, 1] over the (a, b) span h = b - a,
+    p(tau) = c0 + c1*tau + c2*tau^2 + c3*tau^3 with
+      c0 = fa, c1 = h*sa,
+      c2 = 3*(fb - fa) - h*(2*sa + sb),
+      c3 = h*(sa + sb) - 2*(fb - fa),
+    and take the p'(tau) = 0 root with p'' > 0; bisect when the
+    interpolant has no interior minimum."""
+    h = b - a
+    if h == 0.0:
+        return max(lo, min(hi, a))
+    df = fb - fa
+    c1 = h * sa
+    c2 = 3.0 * df - h * (2.0 * sa + sb)
+    c3 = h * (sa + sb) - 2.0 * df
+    cand = None
+    if abs(c3) > 1e-20:
+        disc = c2 * c2 - 3.0 * c3 * c1
+        if disc >= 0.0:
+            # root with positive curvature: p'' = 2 c2 + 6 c3 tau > 0
+            r = disc ** 0.5
+            tau = (-c2 + r) / (3.0 * c3)
+            if 2.0 * c2 + 6.0 * c3 * tau < 0.0:
+                tau = (-c2 - r) / (3.0 * c3)
+            cand = a + tau * h
+    elif abs(c2) > 1e-20 and c2 > 0.0:
+        cand = a + (-c1 / (2.0 * c2)) * h
+    if cand is None or not (lo <= cand <= hi):
+        cand = 0.5 * (lo + hi)
+    return cand
 
 
 def _strong_wolfe(obj_func, t, d, f, g, gtd, c1=1e-4, c2=0.9,
                   tolerance_change=1e-9, max_ls=25):
-    d_norm = float(jnp.max(jnp.abs(d)))
-    g = jnp.asarray(g)
-    f_new, g_new = obj_func(t)
-    ls_func_evals = 1
-    gtd_new = float(jnp.dot(g_new, d))
+    """Strong-Wolfe line search along direction d.
 
-    t_prev, f_prev, g_prev, gtd_prev = 0.0, f, g, gtd
-    done = False
-    ls_iter = 0
-    while ls_iter < max_ls:
-        if f_new > (f + c1 * t * gtd) or (ls_iter > 1 and f_new >= f_prev):
-            bracket = [t_prev, t]
-            bracket_f = [f_prev, f_new]
-            bracket_g = [g_prev, g_new]
-            bracket_gtd = [gtd_prev, gtd_new]
-            break
-        if abs(gtd_new) <= -c2 * gtd:
-            bracket = [t, t]
-            bracket_f = [f_new, f_new]
-            bracket_g = [g_new, g_new]
-            bracket_gtd = [gtd_new, gtd_new]
-            done = True
-            break
-        if gtd_new >= 0:
-            bracket = [t_prev, t]
-            bracket_f = [f_prev, f_new]
-            bracket_g = [g_prev, g_new]
-            bracket_gtd = [gtd_prev, gtd_new]
-            break
-        min_step = t + 0.01 * (t - t_prev)
-        max_step = t * 10
-        tmp = t
-        t = _cubic_interpolate(t_prev, f_prev, gtd_prev, t, f_new, gtd_new,
-                               bounds=(min_step, max_step))
-        t_prev, f_prev, g_prev, gtd_prev = tmp, f_new, g_new, gtd_new
-        f_new, g_new = obj_func(t)
-        ls_func_evals += 1
-        gtd_new = float(jnp.dot(g_new, d))
-        ls_iter += 1
-    else:
-        bracket = [0.0, t]
-        bracket_f = [f, f_new]
-        bracket_g = [g, g_new]
-        bracket_gtd = [gtd, gtd_new]
+    Two phases (Nocedal & Wright, Alg. 3.5/3.6 shape): an expansion walk
+    that either accepts the trial, brackets a minimum, or grows the step;
+    then a zoom on the bracket using the Hermite-cubic candidate with a
+    central-interval safeguard.  obj_func(step) -> (value, flat_grad).
+    Returns (value, flat_grad, step, n_evals)."""
+    scale = float(jnp.max(jnp.abs(d)))
 
-    insuf_progress = False
-    low_pos, high_pos = (0, 1) if bracket_f[0] <= bracket_f[-1] else (1, 0)
-    while not done and ls_iter < max_ls:
-        if abs(bracket[1] - bracket[0]) * d_norm < tolerance_change:
+    def probe(step):
+        val, grad = obj_func(step)
+        return val, grad, float(jnp.dot(grad, d))
+
+    def armijo_ok(step, val):
+        return val <= f + c1 * step * gtd
+
+    def curvature_ok(slope):
+        return abs(slope) <= -c2 * gtd
+
+    evals = 0
+    prev = (0.0, f, jnp.asarray(g), gtd)   # (step, value, grad, slope)
+    cur_v, cur_g, cur_s = probe(t)
+    evals += 1
+    cur = (t, cur_v, cur_g, cur_s)
+
+    span = None
+    for k in range(max_ls):
+        st, v, gr, sl = cur
+        if not armijo_ok(st, v) or (k > 0 and v >= prev[1]):
+            span = (prev, cur)        # overshot: minimum is inside
             break
-        t = _cubic_interpolate(bracket[0], bracket_f[0], bracket_gtd[0],
-                               bracket[1], bracket_f[1], bracket_gtd[1])
-        eps = 0.1 * (max(bracket) - min(bracket))
-        if min(max(bracket) - t, t - min(bracket)) < eps:
-            if insuf_progress or t >= max(bracket) or t <= min(bracket):
-                if abs(t - max(bracket)) < abs(t - min(bracket)):
-                    t = max(bracket) - eps
-                else:
-                    t = min(bracket) + eps
-                insuf_progress = False
-            else:
-                insuf_progress = True
+        if curvature_ok(sl):
+            return v, gr, st, evals   # Wolfe pair satisfied outright
+        if sl >= 0.0:
+            span = (cur, prev)        # slope flipped: bracketed
+            break
+        # still descending: extrapolate beyond the current step
+        grow = _hermite_min(prev[0], prev[1], prev[3], st, v, sl,
+                            st + 0.1 * (st - prev[0]), 4.0 * st)
+        prev = cur
+        nv, ng, ns = probe(grow)
+        evals += 1
+        cur = (grow, nv, ng, ns)
+    if span is None:
+        # expansion exhausted: fall back to the best endpoint seen
+        span = ((0.0, f, jnp.asarray(g), gtd), cur)
+
+    lo, hi = span if span[0][1] <= span[1][1] else (span[1], span[0])
+    while evals < max_ls and not curvature_ok(lo[3]):
+        width = abs(hi[0] - lo[0])
+        if width * scale < tolerance_change:
+            break
+        a, b = (lo, hi) if lo[0] < hi[0] else (hi, lo)
+        cand = _hermite_min(a[0], a[1], a[3], b[0], b[1], b[3],
+                            a[0], b[0])
+        # keep the trial inside the central 80% of the bracket so the
+        # interval provably shrinks (bisect otherwise)
+        margin = 0.1 * width
+        if not (a[0] + margin <= cand <= b[0] - margin):
+            cand = 0.5 * (a[0] + b[0])
+        nv, ng, ns = probe(cand)
+        evals += 1
+        trial = (cand, nv, ng, ns)
+        if not armijo_ok(cand, nv) or nv >= lo[1]:
+            hi = trial                # sufficient-decrease side shrinks
         else:
-            insuf_progress = False
-        f_new, g_new = obj_func(t)
-        ls_func_evals += 1
-        gtd_new = float(jnp.dot(g_new, d))
-        ls_iter += 1
-        if f_new > (f + c1 * t * gtd) or f_new >= bracket_f[low_pos]:
-            bracket[high_pos] = t
-            bracket_f[high_pos] = f_new
-            bracket_g[high_pos] = g_new
-            bracket_gtd[high_pos] = gtd_new
-            low_pos, high_pos = (0, 1) if bracket_f[0] <= bracket_f[1] \
-                else (1, 0)
-        else:
-            if abs(gtd_new) <= -c2 * gtd:
-                done = True
-            elif gtd_new * (bracket[high_pos] - bracket[low_pos]) >= 0:
-                bracket[high_pos] = bracket[low_pos]
-                bracket_f[high_pos] = bracket_f[low_pos]
-                bracket_g[high_pos] = bracket_g[low_pos]
-                bracket_gtd[high_pos] = bracket_gtd[low_pos]
-            bracket[low_pos] = t
-            bracket_f[low_pos] = f_new
-            bracket_g[low_pos] = g_new
-            bracket_gtd[low_pos] = gtd_new
-    t = bracket[low_pos]
-    f_new = bracket_f[low_pos]
-    g_new = bracket_g[low_pos]
-    return f_new, g_new, t, ls_func_evals
+            if curvature_ok(ns):
+                lo = trial
+                break
+            if ns * (hi[0] - lo[0]) >= 0.0:
+                hi = lo               # minimum is on the other side
+            lo = trial
+    return lo[1], lo[2], lo[0], evals

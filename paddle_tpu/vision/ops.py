@@ -7,7 +7,7 @@ import jax.numpy as jnp
 
 from ..ops.registry import op
 
-__all__ = ["nms", "box_iou", "roi_align", "roi_pool", "psroi_pool",
+__all__ = ["nms", "nms_mask", "box_iou", "roi_align", "roi_pool", "psroi_pool",
            "box_coder", "prior_box", "yolo_box", "yolo_loss", "matrix_nms",
            "deform_conv2d", "distribute_fpn_proposals", "generate_proposals",
            "read_file", "decode_jpeg", "RoIAlign", "RoIPool", "PSRoIPool",
@@ -30,11 +30,10 @@ def box_iou(boxes1, boxes2, offset=0.0):
     return inter / (area1[:, None] + area2[None, :] - inter + 1e-9)
 
 
-@op(name="nms")
-def nms(boxes, iou_threshold=0.3, scores=None):
-    """Greedy NMS with static shapes (jit-safe): returns keep mask [N].
-    The reference returns kept indices (dynamic); under XLA the static
-    mask + top-k pattern is idiomatic."""
+def nms_mask(boxes, iou_threshold=0.3, scores=None, category_idxs=None):
+    """Greedy NMS as a static-shape keep mask [N] (jit-safe; the XLA
+    idiom for in-graph NMS).  With category_idxs, overlaps across
+    different categories never suppress (batched/categorical NMS)."""
     n = boxes.shape[0]
     if scores is None:
         order = jnp.arange(n)
@@ -42,6 +41,9 @@ def nms(boxes, iou_threshold=0.3, scores=None):
         order = jnp.argsort(-scores)
     b = boxes[order]
     iou = box_iou.__op_body__(b, b)
+    if category_idxs is not None:
+        cats = jnp.asarray(category_idxs)[order]
+        iou = jnp.where(cats[:, None] == cats[None, :], iou, 0.0)
 
     def body(i, keep):
         sup = jnp.logical_and(keep, iou[i] > iou_threshold)
@@ -51,6 +53,25 @@ def nms(boxes, iou_threshold=0.3, scores=None):
     keep = jax.lax.fori_loop(0, n, body, jnp.ones((n,), bool))
     inv = jnp.argsort(order)
     return keep[inv]
+
+
+@op(name="nms")
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy NMS returning kept box INDICES, score-descending when
+    scores are given — the reference contract
+    (python/paddle/vision/ops.py:1934 nms), including categorical NMS
+    (category_idxs/categories) and top_k.  The result length is
+    data-dependent, so this is an eager op; inside jit use `nms_mask`."""
+    if categories is not None and category_idxs is None:
+        raise ValueError("category_idxs is required when categories is set")
+    keep = nms_mask(boxes, iou_threshold, scores, category_idxs)
+    idx = jnp.where(keep)[0]
+    if scores is not None:
+        idx = idx[jnp.argsort(-jnp.asarray(scores)[idx])]
+    if top_k is not None:
+        idx = idx[:top_k]
+    return idx
 
 
 @op(name="roi_align")
@@ -71,25 +92,32 @@ def roi_align(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
         ends = jnp.cumsum(jnp.asarray(boxes_num))
         batch_idx = jnp.searchsorted(ends, jnp.arange(k), side="right")
 
+    # grid points per bin (reference roi_align_kernel.cu:113-127 averages
+    # a roi_bin_grid of sampling_ratio^2 samples; its adaptive
+    # ceil(roi/pooled) rule is data-dependent, which XLA's static shapes
+    # can't express — we use 2, the adaptive value for the typical
+    # roi ≈ 2x output case)
+    g = sampling_ratio if sampling_ratio > 0 else 2
+
     def one_roi(box, bi):
         off = 0.5 if aligned else 0.0
         x1, y1, x2, y2 = (box * spatial_scale) - off
-        rh = jnp.maximum(y2 - y1, 1.0)
-        rw = jnp.maximum(x2 - x1, 1.0)
-        ys = y1 + (jnp.arange(oh) + 0.5) * rh / oh
-        xs = x1 + (jnp.arange(ow) + 0.5) * rw / ow
-        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
-        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
-        y1i = jnp.clip(y0 + 1, 0, h - 1)
-        x1i = jnp.clip(x0 + 1, 0, w - 1)
-        wy = jnp.clip(ys - y0, 0, 1)[None, :, None]
-        wx = jnp.clip(xs - x0, 0, 1)[None, None, :]
-        f = x[bi]
-        out = (f[:, y0[:, None], x0[None, :]] * (1 - wy) * (1 - wx)
-               + f[:, y1i[:, None], x0[None, :]] * wy * (1 - wx)
-               + f[:, y0[:, None], x1i[None, :]] * (1 - wy) * wx
-               + f[:, y1i[:, None], x1i[None, :]] * wy * wx)
-        return out
+        rh = y2 - y1
+        rw = x2 - x1
+        if not aligned:
+            # legacy path only: force ROIs to at least one pixel
+            rh = jnp.maximum(rh, 1.0)
+            rw = jnp.maximum(rw, 1.0)
+        # sample positions: bin j, grid point p -> (j + (p+.5)/g) bins in
+        frac = (jnp.arange(g) + 0.5) / g
+        ys = y1 + (jnp.arange(oh)[:, None] + frac[None, :]).reshape(-1) \
+            * (rh / oh)
+        xs = x1 + (jnp.arange(ow)[:, None] + frac[None, :]).reshape(-1) \
+            * (rw / ow)
+        grid_y = jnp.broadcast_to(ys[:, None], (oh * g, ow * g))
+        grid_x = jnp.broadcast_to(xs[None, :], (oh * g, ow * g))
+        smp = _bilinear_sample(x[bi], grid_y, grid_x)     # [C, oh*g, ow*g]
+        return smp.reshape(c, oh, g, ow, g).mean(axis=(2, 4))
 
     return jax.vmap(one_roi)(boxes, batch_idx)
 
@@ -675,7 +703,8 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
         keep = ((boxes[:, 2] - boxes[:, 0] + off >= min_size)
                 & (boxes[:, 3] - boxes[:, 1] + off >= min_size))
         boxes, s = boxes[keep], s[keep]
-        keep_mask = nms(jnp.asarray(boxes), nms_thresh, jnp.asarray(s))
+        keep_mask = nms_mask(jnp.asarray(boxes), nms_thresh,
+                             jnp.asarray(s))
         km = _np.asarray(keep_mask._data if hasattr(keep_mask, "_data")
                          else keep_mask)
         idx = _np.where(km)[0]
