@@ -7,28 +7,52 @@ current placements and re-slices, so training resumes on a *different*
 mesh/parallel config.
 
 TPU-native implementation on orbax-style principles: each process writes
-the shards it owns (`addressable_shards`) + a metadata.json with
-global shape / dtype / shard index maps; load assembles requested slices
-from whichever saved shards overlap and device_puts into the target
-sharding.  Single-controller runs write all shards.
+the shards it owns (`addressable_shards`) + a metadata fragment; after an
+ALL-rank barrier the coordinator merges fragments into metadata.json (a
+second barrier holds everyone until the merged metadata exists).  Load
+never materializes a full global tensor: for every *target* shard it
+reads only the saved shards that overlap that slice
+(`jax.make_array_from_callback` pulls exactly the local slices), so peak
+host memory is ~one target shard + one saved-rank payload file.
 """
 from __future__ import annotations
 
 import json
 import os
 import pickle
+from collections import OrderedDict
 
 import jax
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec
 
 from ...framework.tensor import Tensor
-from ..mesh import get_mesh
-from ..placement import placements_to_spec
 
 __all__ = ["save_state_dict", "load_state_dict"]
 
 _META = "metadata.json"
+_PAYLOAD_CACHE_FILES = 2   # bound host memory to ~2 rank files at once
+
+
+def _rank():
+    """Process rank: launcher env (PADDLE_TRAINER_ID) under
+    paddle.distributed.launch, else jax.process_index()."""
+    r = os.environ.get("PADDLE_TRAINER_ID")
+    if r is not None:
+        return int(r)
+    return jax.process_index()
+
+
+def _barrier(tag):
+    """Cross-PROCESS barrier: rendezvous TCPStore under the paddle
+    launcher, jax's coordination service under jax-native multi-host,
+    no-op single-process (collective.barrier is only a device sync, not
+    a process barrier)."""
+    if int(os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1:
+        from ..store import create_or_get_global_tcp_store
+        create_or_get_global_tcp_store().barrier(tag=f"ckpt/{tag}")
+    elif jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(f"ckpt/{tag}")
 
 
 def _shard_index(index_tuple, shape):
@@ -45,7 +69,7 @@ def save_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, unique_id=None, async_save=False):
     os.makedirs(path, exist_ok=True)
     meta = {"tensors": {}}
-    rank = jax.process_index()
+    rank = _rank()
     shard_file = os.path.join(path, f"shard_{rank}.pkl")
     payload = {}
     for name, t in _flatten_state(state_dict).items():
@@ -72,14 +96,15 @@ def save_state_dict(state_dict, path, process_group=None,
         meta["tensors"][name] = entry
     with open(shard_file, "wb") as f:
         pickle.dump(payload, f, protocol=4)
-    # every rank writes its metadata fragment; the coordinator merges all
-    # fragments present (multi-host runs share the checkpoint dir, matching
-    # the reference's global Metadata written after a barrier)
     with open(os.path.join(path, f"meta_{rank}.json"), "w") as f:
         json.dump(meta, f)
+
+    # EVERY rank reaches this barrier before the coordinator merges, so no
+    # fragment can be missed (reference save_state_dict.py:145 barriers
+    # before writing the global Metadata); a second barrier keeps fast
+    # ranks from returning before metadata.json exists.
+    _barrier("fragments")
     if rank == coordinator_rank:
-        from ..collective import barrier
-        barrier()
         merged = {"tensors": {}}
         import glob
         for frag in sorted(glob.glob(os.path.join(path, "meta_*.json"))):
@@ -93,27 +118,63 @@ def save_state_dict(state_dict, path, process_group=None,
                     if not any(e["index"] == sh["index"]
                                for e in tgt["shards"]):
                         tgt["shards"].append(sh)
-        with open(os.path.join(path, _META), "w") as f:
+        tmp = os.path.join(path, _META + ".tmp")
+        with open(tmp, "w") as f:
             json.dump(merged, f)
+        os.replace(tmp, os.path.join(path, _META))
+    _barrier("metadata")
+
+
+class _PayloadReader:
+    """Reads saved shard payloads with a small LRU over rank files, so
+    host memory stays ~one rank file regardless of checkpoint size."""
+
+    def __init__(self, path):
+        self.path = path
+        self.cache = OrderedDict()
+
+    def __call__(self, fname):
+        srank = fname.split("@")[1].split(":")[0]
+        pfile = os.path.join(self.path, f"shard_{srank}.pkl")
+        if pfile not in self.cache:
+            if len(self.cache) >= _PAYLOAD_CACHE_FILES:
+                self.cache.popitem(last=False)
+            with open(pfile, "rb") as f:
+                self.cache[pfile] = pickle.load(f)
+        else:
+            self.cache.move_to_end(pfile)
+        return self.cache[pfile][fname]
+
+
+def _read_slice(entry, bounds, dtype, reader):
+    """Assemble ONLY the [(start, stop), ...] `bounds` slice of a saved
+    tensor from whichever saved shards overlap it (reference
+    load_state_dict.py:467 computes the same overlaps rank-locally)."""
+    sizes = tuple(b - a for a, b in bounds)
+    out = np.zeros(sizes, dtype)
+    for sh in entry["shards"]:
+        inter = [(max(a, sa), min(b, sb))
+                 for (a, b), (sa, sb) in zip(bounds, sh["index"])]
+        if any(a >= b for a, b in inter):
+            continue
+        src = reader(sh["file"])
+        src_idx = tuple(slice(a - sa, b - sa) for (a, b), (sa, _sb)
+                        in zip(inter, sh["index"]))
+        dst_idx = tuple(slice(a - ta, b - ta) for (a, b), (ta, _tb)
+                        in zip(inter, bounds))
+        out[dst_idx] = np.asarray(src[src_idx], dtype)
+    return out
 
 
 def load_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, unique_id=None,
                     offload=False):
-    """Fill `state_dict`'s tensors in place, re-slicing saved shards to the
-    current placements (reference load_state_dict.py:467)."""
+    """Fill `state_dict`'s tensors in place, re-slicing saved shards to
+    the current placements.  Only the slices needed by this process's
+    addressable target shards are ever read/assembled on host."""
     with open(os.path.join(path, _META)) as f:
         meta = json.load(f)
-    # load all shard payloads lazily per file
-    payload_cache: dict[str, dict] = {}
-
-    def get_payload(fname):
-        srank = fname.split("@")[1].split(":")[0]
-        pfile = os.path.join(path, f"shard_{srank}.pkl")
-        if pfile not in payload_cache:
-            with open(pfile, "rb") as f:
-                payload_cache[pfile] = pickle.load(f)
-        return payload_cache[pfile][fname]
+    reader = _PayloadReader(path)
 
     flat = _flatten_state(state_dict)
     for name, t in flat.items():
@@ -121,23 +182,25 @@ def load_state_dict(state_dict, path, process_group=None,
             continue
         entry = meta["tensors"][name]
         gshape = tuple(entry["shape"])
-        dtype = np.dtype(entry["dtype"])
         if tuple(t.shape) != gshape and isinstance(t, Tensor):
             raise ValueError(
-                f"{name}: saved global shape {gshape} != target {tuple(t.shape)}")
-        # assemble the full array from saved shards, then re-place with the
-        # target's sharding (XLA slices per-device; only the local slices
-        # materialize on devices)
-        full = np.zeros(gshape, dtype)
-        for sh in entry["shards"]:
-            idx = tuple(slice(a, b) for a, b in sh["index"])
-            full[idx] = get_payload(sh["file"])
-        if isinstance(t, Tensor):
-            target_sharding = getattr(t._data, "sharding", None)
-            arr = jax.device_put(full.astype(np.dtype(t._data.dtype)),
-                                 target_sharding) \
-                if target_sharding is not None else jax.numpy.asarray(full)
-            t._data = arr
+                f"{name}: saved global shape {gshape} != "
+                f"target {tuple(t.shape)}")
+        if not isinstance(t, Tensor):
+            continue
+        tgt_dtype = np.dtype(t._data.dtype)
+        sharding = getattr(t._data, "sharding", None)
+        if sharding is not None:
+            def cb(idx, _e=entry, _d=tgt_dtype, _g=gshape):
+                bounds = _shard_index(idx, _g) if idx else \
+                    [(0, d) for d in _g]
+                return _read_slice(_e, bounds, _d, reader)
+
+            t._data = jax.make_array_from_callback(gshape, sharding, cb)
+        else:
+            full = _read_slice(entry, [(0, d) for d in gshape],
+                               tgt_dtype, reader)
+            t._data = jax.numpy.asarray(full)
     return state_dict
 
 

@@ -97,8 +97,18 @@ def create_or_get_global_tcp_store():
     with _global_lock:
         if _global_store is None:
             import os
-            host = os.environ.get("PADDLE_MASTER_HOST", "127.0.0.1")
-            port = int(os.environ.get("PADDLE_MASTER_PORT", "0"))
+            host = os.environ.get("PADDLE_MASTER_HOST")
+            port = os.environ.get("PADDLE_MASTER_PORT")
+            if (host is None or port is None) and \
+                    os.environ.get("PADDLE_MASTER"):
+                # PADDLE_MASTER is the jax coordination endpoint; the KV
+                # store deterministically claims the next port so every
+                # rank agrees without extra configuration
+                mh, _, mp = os.environ["PADDLE_MASTER"].partition(":")
+                host = host or mh
+                port = port or str(int(mp) + 1)
+            host = host or "127.0.0.1"
+            port = int(port or "0")
             rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
             world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
             _global_store = TCPStore(host, port, is_master=(rank == 0),

