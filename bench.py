@@ -1,11 +1,20 @@
-"""Benchmark: flagship Llama pretraining step throughput + MFU on the
-available chip(s).  Prints ONE JSON line.
+"""Benchmark ladder (BASELINE.md #1-#5) on the available chip(s).
+
+Prints ONE JSON line per metric, flagship Llama first:
+  llama_train_tokens_per_sec_per_chip   (ladder #4-lite, MFU vs 40% target)
+  resnet50_train_images_per_sec_per_chip (ladder #2, conv/BN/AMP)
+  bert_base_train_examples_per_sec_per_chip (ladder #3, encoder/AdamW)
+  moe_train_tokens_per_sec_per_chip     (ladder #5, gating+dispatch)
+  lenet_eager_steps_per_sec             (ladder #1, dygraph dispatch vs jit)
 
 vs_baseline: the reference publishes no absolute numbers (BASELINE.md);
-the driver's north star is >=40% MFU, so vs_baseline = measured_MFU / 0.40.
+where MFU is defined the north star is >=40% MFU so vs_baseline =
+measured_MFU / 0.40; for LeNet it is the eager/jit throughput ratio
+(dygraph dispatch efficiency).
 """
 from __future__ import annotations
 
+import gc
 import json
 import time
 
@@ -28,14 +37,24 @@ def _peak_flops(kind: str) -> float:
     return 197e12  # unknown chip: assume v5e-class
 
 
-def main():
+def _env():
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    return dev, on_tpu, (len(jax.devices()) if on_tpu else 1)
+
+
+def _emit(metric, value, unit, vs_baseline, detail):
+    print(json.dumps({
+        "metric": metric, "value": round(float(value), 2), "unit": unit,
+        "vs_baseline": round(float(vs_baseline), 4), "detail": detail,
+    }), flush=True)
+
+
+def bench_llama():
     from paddle_tpu.models.llama import LlamaConfig
     from paddle_tpu.models import llama_hybrid as H
 
-    dev = jax.devices()[0]
-    on_tpu = dev.platform == "tpu"
-    n = len(jax.devices()) if on_tpu else 1
-
+    dev, on_tpu, n = _env()
     if on_tpu:
         # ~1B params saturates the MXU on one v5e chip (~16G HBM) with
         # bf16 params + fp32 AdamW state + flash attention + chunked CE
@@ -88,15 +107,239 @@ def main():
     if not on_tpu:
         mfu = 0.0
 
-    print(json.dumps({
-        "metric": "llama_train_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec / n, 2),
-        "unit": "tokens/s/chip",
-        "vs_baseline": round(mfu / 0.40, 4) if on_tpu else 0.0,
-        "detail": {"mfu": round(mfu, 4), "chips": n,
-                   "device": dev.device_kind, "params": int(n_params),
-                   "loss": loss_val},
-    }))
+    _emit("llama_train_tokens_per_sec_per_chip", tokens_per_sec / n,
+          "tokens/s/chip", mfu / 0.40 if on_tpu else 0.0,
+          {"mfu": round(mfu, 4), "chips": n, "device": dev.device_kind,
+           "params": int(n_params), "loss": loss_val})
+
+
+def bench_resnet50():
+    """Ladder #2: ResNet50 + AMP O1 (conv/BN/momentum on the MXU)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.vision.models import resnet50
+
+    dev, on_tpu, _ = _env()
+    n = 1  # runs on one device; per-chip numbers divide by what is used
+    batch, steps = (128, 10) if on_tpu else (4, 2)
+    hw = 224 if on_tpu else 32
+
+    model = resnet50(num_classes=1000)
+    model.train()
+    o = opt.Momentum(learning_rate=0.1, momentum=0.9,
+                     parameters=model.parameters())
+
+    def loss_fn(m, x, y):
+        with paddle.amp.auto_cast(enable=on_tpu, level="O1"):
+            out = m(x)
+        return F.cross_entropy(out, y)
+
+    step = paddle.jit.train_step(model, o, loss_fn)
+    x = paddle.to_tensor(
+        np.random.randn(batch, 3, hw, hw).astype(np.float32))
+    y = paddle.to_tensor(
+        np.random.randint(0, 1000, (batch,)).astype(np.int64))
+    float(step(x, y))                      # compile
+    for _ in range(2):
+        loss = step(x, y)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, y)
+    loss_val = float(loss)
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = batch * steps / dt
+    # ResNet50 fwd ~4.1 GFLOPs/image at 224^2; train ~3x fwd
+    flops_per_img = 3 * 4.1e9 * (hw / 224) ** 2
+    mfu = imgs_per_sec * flops_per_img / (n * _peak_flops(dev.device_kind))
+    if not on_tpu:
+        mfu = 0.0
+    _emit("resnet50_train_images_per_sec_per_chip", imgs_per_sec / n,
+          "images/s/chip", mfu / 0.40 if on_tpu else 0.0,
+          {"mfu": round(mfu, 4), "batch": batch, "amp": "O1" if on_tpu
+           else "off", "device": dev.device_kind, "loss": loss_val})
+
+
+def bench_bert():
+    """Ladder #3: BERT-base fine-tune shape (encoder + AdamW)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.models.bert import BertConfig, \
+        BertForSequenceClassification
+
+    dev, on_tpu, _ = _env()
+    n = 1  # single-device bench
+    if on_tpu:
+        cfg = BertConfig()                         # base: 12L/768H
+        batch, seq, steps = 32, 384, 10
+    else:
+        cfg = BertConfig(vocab_size=512, hidden_size=128,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         intermediate_size=256)
+        batch, seq, steps = 2, 64, 2
+
+    model = BertForSequenceClassification(cfg)
+    model.train()
+    o = opt.AdamW(learning_rate=3e-5, parameters=model.parameters())
+
+    def loss_fn(m, ids, y):
+        with paddle.amp.auto_cast(enable=on_tpu, level="O1"):
+            logits = m(ids)
+        return F.cross_entropy(logits, y)
+
+    step = paddle.jit.train_step(model, o, loss_fn)
+    ids = paddle.to_tensor(
+        np.random.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64))
+    y = paddle.to_tensor(
+        np.random.randint(0, cfg.num_labels, (batch,)).astype(np.int64))
+    float(step(ids, y))
+    for _ in range(2):
+        loss = step(ids, y)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids, y)
+    loss_val = float(loss)
+    dt = time.perf_counter() - t0
+
+    ex_per_sec = batch * steps / dt
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    flops_per_ex = 6 * n_params * seq \
+        + 12 * cfg.num_hidden_layers * cfg.hidden_size * seq * seq
+    mfu = ex_per_sec * flops_per_ex / (n * _peak_flops(dev.device_kind))
+    if not on_tpu:
+        mfu = 0.0
+    _emit("bert_base_train_examples_per_sec_per_chip", ex_per_sec / n,
+          "examples/s/chip", mfu / 0.40 if on_tpu else 0.0,
+          {"mfu": round(mfu, 4), "seq": seq, "batch": batch,
+           "params": int(n_params), "device": dev.device_kind,
+           "loss": loss_val})
+
+
+def bench_moe():
+    """Ladder #5: MoE LM (gating + dense-dispatch einsums) on this chip."""
+    from paddle_tpu.models import moe_llm as M
+
+    dev, on_tpu, _ = _env()
+    n = 1  # single-device bench (mesh is built with 1 device below)
+    if on_tpu:
+        # dense GShard dispatch holds a [tokens, E, capacity] one-hot per
+        # batch row; 4x512 keeps that under HBM on one v5e
+        cfg = M.MoEConfig(vocab_size=32000, hidden_size=1024,
+                          moe_intermediate_size=1408, num_hidden_layers=8,
+                          num_attention_heads=16, num_key_value_heads=16,
+                          num_experts=8, top_k=2, dtype="bfloat16")
+        batch, seq, steps = 4, 512, 10
+    else:
+        cfg = M.moe_tiny()
+        batch, seq, steps = 2, 64, 2
+
+    mesh = M.build_mesh(1, dp=1, ep=1)
+    params = M.setup(cfg, mesh)
+    step = M.build_train_step(cfg, mesh)
+    ids = jnp.asarray(
+        np.random.randint(0, cfg.vocab_size, (batch, seq + 1)), jnp.int64)
+    loss, params = step(params, ids)
+    float(loss)
+    for _ in range(2):
+        loss, params = step(params, ids)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, params = step(params, ids)
+    loss_val = float(loss)
+    dt = time.perf_counter() - t0
+
+    tok_per_sec = batch * seq * steps / dt
+    # active params per token: top_k of num_experts expert FFNs
+    leaves = jax.tree_util.tree_leaves(params)
+    total = sum(x.size for x in leaves)
+    expert = sum(x.size for x in leaves if x.ndim >= 3 and
+                 x.shape[-3:-2] == (cfg.num_experts,))
+    active = total - expert + expert * cfg.top_k // cfg.num_experts
+    mfu = tok_per_sec * 6 * active / (n * _peak_flops(dev.device_kind))
+    if not on_tpu:
+        mfu = 0.0
+    _emit("moe_train_tokens_per_sec_per_chip", tok_per_sec / n,
+          "tokens/s/chip", mfu / 0.40 if on_tpu else 0.0,
+          {"mfu_active": round(mfu, 4), "params_total": int(total),
+           "params_active_per_tok": int(active),
+           "experts": cfg.num_experts, "top_k": cfg.top_k,
+           "device": dev.device_kind, "loss": loss_val})
+
+
+def bench_lenet():
+    """Ladder #1: LeNet dygraph (eager tape) vs one-program jit steps/s —
+    the per-op dispatch overhead number (reference hot-path goal,
+    paddle/phi/README.md §1.2)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.vision.models import LeNet
+
+    dev, on_tpu, _ = _env()
+    batch = 64
+    steps = 30 if on_tpu else 10
+    x_np = np.random.randn(batch, 1, 28, 28).astype(np.float32)
+    y_np = np.random.randint(0, 10, (batch,)).astype(np.int64)
+
+    def make():
+        paddle.seed(0)
+        m = LeNet()
+        m.train()
+        return m, opt.SGD(learning_rate=0.01, parameters=m.parameters())
+
+    # eager (dygraph) loop
+    model, o = make()
+    x, y = paddle.to_tensor(x_np), paddle.to_tensor(y_np)
+    for _ in range(3):
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+    float(loss)
+    eager_sps = steps / (time.perf_counter() - t0)
+
+    # compiled
+    model, o = make()
+    step = paddle.jit.train_step(
+        model, o, lambda m, a, b: F.cross_entropy(m(a), b))
+    float(step(x, y))
+    for _ in range(3):
+        loss = step(x, y)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, y)
+    float(loss)
+    jit_sps = steps / (time.perf_counter() - t0)
+
+    _emit("lenet_eager_steps_per_sec", eager_sps, "steps/s",
+          eager_sps / jit_sps,
+          {"jit_steps_per_sec": round(jit_sps, 2), "batch": batch,
+           "device": dev.device_kind,
+           "note": "vs_baseline = eager/jit ratio (dispatch overhead)"})
+
+
+def main():
+    for fn in (bench_llama, bench_resnet50, bench_bert, bench_moe,
+               bench_lenet):
+        try:
+            fn()
+        except Exception as e:  # keep the rest of the ladder running
+            _emit(fn.__name__ + "_error", 0.0, "error", 0.0,
+                  {"error": f"{type(e).__name__}: {e}"})
+        gc.collect()
 
 
 if __name__ == "__main__":
