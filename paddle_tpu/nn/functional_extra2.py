@@ -351,8 +351,10 @@ def flashmask_attention(query, key, value, startend_row_indices=None, *,
                         fixed_seed_offset=None, rng_name="", training=True,
                         name=None):
     """FlashMask attention (reference flash_attention.py:1098): column-wise
-    sparse mask given as start/end row indices per key column.  Realized as
-    a dense additive bias into the fused SDPA; XLA keeps it on-chip."""
+    sparse mask given as start/end row indices per key column.  Routed to
+    the Pallas interval-mask kernels (ops/pallas/flash_mask.py) — O(S)
+    mask memory, no [S,S] score matrix; the dense fallback below covers
+    CPU/odd shapes/dropout."""
     if return_softmax_lse or return_seed_offset:
         raise NotImplementedError("lse/seed outputs not supported")
     b, sq, hq, d = query.shape
@@ -363,6 +365,40 @@ def flashmask_attention(query, key, value, startend_row_indices=None, *,
                         is_causal=causal, training=training)
     idx = startend_row_indices  # [B, H or 1, Sk, k]
     kdim = idx.shape[-1]
+
+    # kernel path: translate the reference encoding into mask_vecs
+    # [B, H|1, nvec, Sk] (intervals of MASKED rows per key column)
+    vecs = None
+    moved = jnp.moveaxis(jnp.asarray(idx), -1, 2)       # [B, H, k, Sk]
+    if causal and kdim == 1:
+        lts = moved[:, :, 0]
+        vecs = jnp.stack([lts, jnp.full_like(lts, sq)], axis=2)
+    elif causal and kdim == 2:
+        vecs = moved
+    elif not causal and kdim == 2:
+        lts, ute = moved[:, :, 0], moved[:, :, 1]
+        vecs = jnp.stack([lts, jnp.full_like(lts, sq),
+                          jnp.zeros_like(lts), ute], axis=2)
+    elif not causal and kdim == 4:
+        vecs = moved
+    if vecs is not None and window_size is not None and causal:
+        # causal sliding window: column j masked for rows > j + left
+        left = window_size if isinstance(window_size, int) else \
+            window_size[0]
+        col = jnp.broadcast_to(jnp.arange(sk, dtype=vecs.dtype),
+                               vecs.shape[:2] + (sk,))
+        vecs = jnp.concatenate(
+            [vecs, jnp.stack([col + left + 1,
+                              jnp.full_like(col, sq)], axis=2)], axis=2)
+    if window_size is not None and not causal:
+        raise NotImplementedError(
+            "flashmask_attention window_size requires causal=True "
+            "(the reference's sliding windows are causal)")
+    if vecs is not None:
+        from ..ops.pallas import flash_attention as _fa
+        return _fa.sdpa(query, key, value, dropout_p=dropout,
+                        is_causal=causal, training=training,
+                        flashmask=vecs.astype(jnp.int32))
     rows = jnp.arange(sq)[:, None]                      # i (query/row)
     if causal:
         if kdim == 1:

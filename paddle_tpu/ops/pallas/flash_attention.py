@@ -123,22 +123,66 @@ def _probe_pallas():
 
 
 def sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
-         training=True):
-    """Paddle-layout scaled-dot-product attention: [B, S, H, D] in/out."""
-    use_pallas = (
-        attn_mask is None and dropout_p == 0.0
+         training=True, flashmask=None):
+    """Paddle-layout scaled-dot-product attention: [B, S, H, D] in/out.
+
+    Masked inputs route to the Pallas kernels where the mask is
+    expressible without the [S, S] score matrix:
+      * flashmask: column-interval mask_vecs [B|1, H|1, 2|4, Sk] int32
+        (see ops.pallas.flash_mask) — O(S) memory;
+      * a bool key-padding attn_mask [B, 1|H, 1, Sk] auto-converts to
+        flashmask;
+      * a floating attn_mask [B|1, H|1, Sq, Sk] becomes the dense-bias
+        kernel (streamed blockwise, no softmax residuals).
+    Anything else (dropout, arbitrary bool masks, odd shapes) falls back
+    to the XLA path."""
+    shapes_ok = (
+        dropout_p == 0.0
         and q.dtype == k.dtype == v.dtype   # kernels matmul in input dtype
         and q.shape[-1] in (64, 128, 256)
         and q.shape[1] >= 256 and q.shape[1] % 256 == 0
         and k.shape[1] % 256 == 0
         and (not is_causal or q.shape[1] == k.shape[1])
-        and jax.default_backend() not in ("cpu",)
-        and _probe_pallas())
-    if use_pallas:
+        and jax.default_backend() not in ("cpu",))
+
+    mask_vecs = flashmask
+    bias = None
+    if attn_mask is not None and mask_vecs is None and shapes_ok:
+        am = jnp.asarray(attn_mask)
+        if (am.dtype == jnp.bool_ and am.ndim == 4 and am.shape[2] == 1
+                and am.shape[-1] == k.shape[1]):
+            # key-padding mask (per-batch or per-head): columns allowed
+            # for all rows or none
+            from .flash_mask import padding_mask_to_intervals
+            mask_vecs = padding_mask_to_intervals(am[:, :, 0, :],
+                                                  q.shape[1])
+        elif (jnp.issubdtype(am.dtype, jnp.floating) and am.ndim == 4
+                and am.shape[-2:] == (q.shape[1], k.shape[1])):
+            bias = am
+
+    if shapes_ok and (attn_mask is None or mask_vecs is not None
+                      or bias is not None) and _probe_pallas():
         try:
+            if mask_vecs is not None:
+                return _pallas_sdpa_masked(q, k, v, mask_vecs, is_causal)
+            if bias is not None:
+                return _pallas_sdpa_biased(q, k, v, bias, is_causal)
             return _pallas_sdpa(q, k, v, is_causal)
         except Exception:
             pass
+    if attn_mask is None and flashmask is not None:
+        # keep flashmask semantics on the fallback path (dense, O(S^2)).
+        # Additive -1e9 (not bool -inf) keeps fully-masked rows finite;
+        # zeroing them afterwards matches the kernel's convention.
+        from .flash_mask import dense_mask_from_intervals
+        allowed = dense_mask_from_intervals(flashmask, q.shape[1],
+                                            k.shape[1])
+        bias = jnp.where(allowed, 0.0, -1e9).astype(jnp.float32)
+        out = _xla_sdpa(q, k, v, attn_mask=bias, is_causal=is_causal,
+                        dropout_p=dropout_p, training=training)
+        row_ok = jnp.any(allowed, axis=-1)            # [B|1, H|1, Sq]
+        row_ok = jnp.swapaxes(row_ok, 1, 2)[..., None]  # [B,Sq,H|1,1]
+        return jnp.where(row_ok, out, jnp.zeros((), out.dtype))
     return _xla_sdpa(q, k, v, attn_mask=attn_mask, is_causal=is_causal,
                      dropout_p=dropout_p, training=training)
 
@@ -147,14 +191,39 @@ def _pallas_sdpa(q, k, v, causal):
     """[B, S, H, D] wrapper: GQA head-repeat + layout transposes live
     outside the custom_vjp, so their VJPs (sum over repeats / transpose)
     are handled by jax."""
+    qt, kt, vt = _gqa_bhsd(q, k, v)
+    out = flash_mha(qt, kt, vt, causal, 1.0 / np.sqrt(q.shape[-1]))
+    return jnp.swapaxes(out, 1, 2)
+
+
+def _gqa_bhsd(q, k, v):
     h, hk = q.shape[2], k.shape[2]
     if hk != h:
         k = jnp.repeat(k, h // hk, axis=2)
         v = jnp.repeat(v, h // hk, axis=2)
-    qt = jnp.swapaxes(q, 1, 2)
-    kt = jnp.swapaxes(k, 1, 2)
-    vt = jnp.swapaxes(v, 1, 2)
-    out = flash_mha(qt, kt, vt, causal, 1.0 / np.sqrt(q.shape[-1]))
+    return (jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+            jnp.swapaxes(v, 1, 2))
+
+
+def _pallas_sdpa_masked(q, k, v, mask_vecs, causal):
+    from .flash_mask import flash_mha_masked
+    h, hm = q.shape[2], mask_vecs.shape[1]
+    if hm not in (1, h):                 # per-kv-head mask under GQA
+        mask_vecs = jnp.repeat(mask_vecs, h // hm, axis=1)
+    qt, kt, vt = _gqa_bhsd(q, k, v)
+    out = flash_mha_masked(qt, kt, vt, mask_vecs, causal,
+                           1.0 / np.sqrt(q.shape[-1]))
+    return jnp.swapaxes(out, 1, 2)
+
+
+def _pallas_sdpa_biased(q, k, v, bias, causal):
+    from .flash_mask import flash_mha_biased
+    h, hb = q.shape[2], bias.shape[1]
+    if hb not in (1, h):
+        bias = jnp.repeat(bias, h // hb, axis=1)
+    qt, kt, vt = _gqa_bhsd(q, k, v)
+    out = flash_mha_biased(qt, kt, vt, bias, causal,
+                           1.0 / np.sqrt(q.shape[-1]))
     return jnp.swapaxes(out, 1, 2)
 
 

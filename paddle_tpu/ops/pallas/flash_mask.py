@@ -1,0 +1,543 @@
+"""Masked flash attention variants — flashmask intervals + dense bias.
+
+Reference analog: paddle's flashmask_attention
+(python/paddle/nn/functional/flash_attention.py, kernel surface
+paddle/phi/kernels/gpu/flash_attn_kernel.cu) — an O(S) column-interval
+encoding of attention masks (padding, sliding window, packed documents,
+causal documents) so masked training never materializes the [S, S]
+score matrix; plus a dense additive-bias path for ALiBi/relative-pos
+biases.
+
+TPU formulation (kernels in flash_attention.py style):
+  * flashmask: the reference's column-interval encoding — for kv column
+    j, query rows in [lts[j], lte[j]) are MASKED (and, non-causal, also
+    [uts[j], ute[j])).  Passed as ONE stacked int32 array
+    mask_vecs [B|1, H|1, nvec, Sk] with nvec = 2 (one interval) or
+    4 (two intervals) — O(S) memory.  Fully-masked rows produce zero
+    output and lse = -inf, and the backward treats them as zero-grad.
+  * bias: an additive [B|1, H|1, Sq, Sk] term streamed blockwise into
+    the logits; dbias is produced by a separate kernel pass so XLA can
+    DCE it when the bias is a constant (ALiBi).
+
+Both compose with `causal`.  See `sdpa` in flash_attention.py for the
+dispatch rules and the bool-mask -> flashmask auto-conversion.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .flash_attention import _ab, _ab_t, _at_b, NUM_LANES
+
+__all__ = ["flash_mha_masked", "flash_mha_biased", "padding_mask_to_intervals",
+           "sliding_window_intervals", "segment_intervals"]
+
+
+# ------------------------------------------------------------ mask helpers
+def padding_mask_to_intervals(key_mask, sq):
+    """[B, Sk] or [B, H, Sk] bool key-padding mask -> mask_vecs
+    [B, 1|H, 2, Sk]: masked columns exclude every query row ([0, sq)),
+    valid columns none ([sq, sq))."""
+    key_mask = jnp.asarray(key_mask)
+    if key_mask.ndim == 2:
+        key_mask = key_mask[:, None, :]
+    lts = jnp.where(key_mask, jnp.int32(sq), jnp.int32(0))
+    lte = jnp.full_like(lts, sq)
+    return jnp.stack([lts, lte], axis=2)
+
+
+def sliding_window_intervals(sk, window, batch=1):
+    """Causal sliding-window attention (combine with causal=True): row r
+    attends keys [r - window, r] — paddle's window convention (window+1
+    keys incl. the diagonal), so column j masks rows > j + window."""
+    j = jnp.arange(sk, dtype=jnp.int32)
+    lts = jnp.broadcast_to(j + jnp.int32(window) + 1, (batch, 1, sk))
+    lte = jnp.full_like(lts, sk)
+    return jnp.stack([lts, lte], axis=2)
+
+
+def segment_intervals(segment_ids, causal=True):
+    """[B, S] int segment ids (contiguous packing) -> mask_vecs keeping
+    attention within each segment (reference flashmask 'document mask').
+    causal=True yields nvec=2 (rows past the segment are already masked
+    by the triangle); causal=False yields nvec=4."""
+    seg = jnp.asarray(segment_ids)
+    b, s = seg.shape
+    pos = jnp.arange(s, dtype=jnp.int32)
+    same = seg[:, :, None] == seg[:, None, :]          # [B, S, S] bool
+    # per-column segment bounds — the O(S^2) bool is a transient XLA
+    # fusion; the kernel inputs stay O(S)
+    first = jnp.min(jnp.where(same, pos[None, :, None], s), axis=1)
+    last1 = jnp.max(jnp.where(same, pos[None, :, None], -1), axis=1) + 1
+    lts = last1.astype(jnp.int32)          # mask rows at/after seg end
+    lte = jnp.full_like(lts, s)
+    if causal:
+        vec = jnp.stack([lts, lte], axis=1)
+    else:
+        uts = jnp.zeros_like(lts)          # mask rows before seg start
+        ute = first.astype(jnp.int32)
+        vec = jnp.stack([lts, lte, uts, ute], axis=1)
+    return vec[:, None]
+
+
+def _mask_spec(mask_vecs, sk):
+    """BlockSpec for [B|1, H|1, nvec, Sk] mask arrays (broadcast-aware)."""
+    from jax.experimental import pallas as pl
+    bb, hb, nvec = mask_vecs.shape[:3]
+
+    def imap(b_, h_, i):
+        return (b_ if bb > 1 else 0, h_ if hb > 1 else 0, 0, 0)
+
+    return pl.BlockSpec((None, None, nvec, sk), imap)
+
+
+def _bias_spec(bias, block_q, sk, blocked=True):
+    from jax.experimental import pallas as pl
+    bb, hb = bias.shape[0], bias.shape[1]
+
+    def imap(b_, h_, i):
+        return (b_ if bb > 1 else 0, h_ if hb > 1 else 0,
+                i if blocked else 0, 0)
+
+    return pl.BlockSpec((None, None, block_q if blocked else bias.shape[2],
+                         sk), imap)
+
+
+def _safe(m):
+    return jnp.where(jnp.isfinite(m), m, jnp.zeros_like(m))
+
+
+def _mask_block(s, mask_ref, q_ids, col0, ncols, nvec):
+    """Apply the [lts,lte(,uts,ute)) masked-intervals for columns
+    [col0, col0+ncols) to the score block s."""
+    from jax.experimental import pallas as pl
+    for i in range(nvec // 2):
+        start = mask_ref[2 * i, pl.dslice(col0, ncols)]
+        end = mask_ref[2 * i + 1, pl.dslice(col0, ncols)]
+        hit = jnp.logical_and(q_ids >= start[None, :],
+                              q_ids < end[None, :])
+        s = jnp.where(hit, -jnp.inf, s)
+    return s
+
+
+# ---------------------------------------------------------------- forward
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, block_k, sm_scale,
+                nvec, has_bias, need_lse):
+    from jax.experimental import pallas as pl
+
+    it = iter(rest)
+    mask_ref = next(it) if nvec else None
+    bias_ref = next(it) if has_bias else None
+    o_ref = next(it)
+    lse_ref = next(it) if need_lse else None
+
+    q = q_ref[...]                                         # [bq, d]
+    bq, d = q.shape
+    kv_len = k_ref.shape[0]
+    nblk = kv_len // block_k
+    q_blk = pl.program_id(2)
+
+    def body(i, carry):
+        acc, m_prev, l_prev = carry
+        k = k_ref[pl.dslice(i * block_k, block_k), :]
+        v = v_ref[pl.dslice(i * block_k, block_k), :]
+        s = _ab_t(q, k) * jnp.float32(sm_scale)
+        if has_bias:
+            s = s + bias_ref[:, pl.dslice(i * block_k, block_k)].astype(
+                jnp.float32)
+        q_ids = q_blk * bq + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 0)
+        if causal:
+            k_ids = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_ids >= k_ids, s, -jnp.inf)
+        if nvec:
+            s = _mask_block(s, mask_ref, q_ids, i * block_k, block_k, nvec)
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        # fully-masked-so-far rows: keep the exp argument finite
+        alpha = jnp.where(jnp.isfinite(m_cur),
+                          jnp.exp(m_prev - m_cur), 1.0)
+        p = jnp.exp(s - _safe(m_cur)[:, None])
+        l_cur = l_prev * alpha + jnp.sum(p, axis=1)
+        acc = acc * alpha[:, None] + _ab(p.astype(v.dtype), v)
+        return acc, m_cur, l_cur
+
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    if causal:
+        upper = ((q_blk + 1) * bq + block_k - 1) // block_k
+    else:
+        upper = nblk
+    acc, m, l = jax.lax.fori_loop(0, upper, body, (acc0, m0, l0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[...] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    if lse_ref is not None:
+        lse = jnp.where(l == 0.0, -jnp.inf, m + jnp.log(l_safe))
+        lse_ref[...] = jnp.broadcast_to(lse[:, None], (bq, NUM_LANES))
+
+
+def _masked_fwd(q, k, v, mask_vecs, bias, causal, sm_scale, block_q,
+                block_k, need_lse=True, interpret=False):
+    from jax.experimental import pallas as pl
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    nvec = mask_vecs.shape[2] if mask_vecs is not None else 0
+    has_bias = bias is not None
+    blk = pl.BlockSpec((None, None, block_q, d),
+                       lambda b_, h_, i: (b_, h_, i, 0))
+    in_specs = [
+        blk,
+        pl.BlockSpec((None, None, sk, d), lambda b_, h_, i: (b_, h_, 0, 0)),
+        pl.BlockSpec((None, None, sk, d), lambda b_, h_, i: (b_, h_, 0, 0)),
+    ]
+    args = [q, k, v]
+    if nvec:
+        in_specs.append(_mask_spec(mask_vecs, sk))
+        args.append(mask_vecs)
+    if has_bias:
+        in_specs.append(_bias_spec(bias, block_q, sk))
+        args.append(bias)
+    out_specs = [blk]
+    out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
+    if need_lse:
+        out_specs.append(pl.BlockSpec((None, None, block_q, NUM_LANES),
+                                      lambda b_, h_, i: (b_, h_, i, 0)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((b, h, sq, NUM_LANES), jnp.float32))
+    kernel = functools.partial(_fwd_kernel, causal=causal, block_k=block_k,
+                               sm_scale=sm_scale, nvec=nvec,
+                               has_bias=has_bias, need_lse=need_lse)
+    with jax.enable_x64(False):   # see flash_attention._flash_fwd
+        res = pl.pallas_call(
+            kernel, grid=(b, h, sq // block_q),
+            in_specs=in_specs,
+            out_specs=out_specs if need_lse else out_specs[0],
+            out_shape=out_shape if need_lse else out_shape[0],
+            interpret=interpret,
+        )(*args)
+    return res if need_lse else (res, None)
+
+
+# --------------------------------------------------------------- backward
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, *rest,
+                   causal, block_k, sm_scale, nvec, has_bias):
+    from jax.experimental import pallas as pl
+
+    it = iter(rest)
+    mask_ref = next(it) if nvec else None
+    bias_ref = next(it) if has_bias else None
+    dq_ref = next(it)
+
+    q = q_ref[...]
+    do = do_ref[...]
+    lse = _safe(lse_ref[:, 0])
+    delta = dl_ref[:, 0]
+    bq, d = q.shape
+    kv_len = k_ref.shape[0]
+    nblk = kv_len // block_k
+    q_blk = pl.program_id(2)
+
+    def body(i, dq):
+        k = k_ref[pl.dslice(i * block_k, block_k), :]
+        v = v_ref[pl.dslice(i * block_k, block_k), :]
+        s = _ab_t(q, k) * jnp.float32(sm_scale)
+        if has_bias:
+            s = s + bias_ref[:, pl.dslice(i * block_k, block_k)].astype(
+                jnp.float32)
+        q_ids = q_blk * bq + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 0)
+        if causal:
+            k_ids = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_ids >= k_ids, s, -jnp.inf)
+        if nvec:
+            s = _mask_block(s, mask_ref, q_ids, i * block_k, block_k, nvec)
+        p = jnp.exp(s - lse[:, None])                       # masked -> 0
+        dp = _ab_t(do, v)
+        ds = p * (dp - delta[:, None]) * jnp.float32(sm_scale)
+        return dq + _ab(ds.astype(k.dtype), k)
+
+    upper = ((q_blk + 1) * bq + block_k - 1) // block_k if causal else nblk
+    dq = jax.lax.fori_loop(0, upper, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[...] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, *rest,
+                    causal, block_q, sm_scale, nvec, has_bias):
+    from jax.experimental import pallas as pl
+
+    it = iter(rest)
+    mask_ref = next(it) if nvec else None
+    bias_ref = next(it) if has_bias else None
+    dk_ref = next(it)
+    dv_ref = next(it)
+
+    k = k_ref[...]
+    v = v_ref[...]
+    bk, d = k.shape
+    q_len = q_ref.shape[0]
+    nblk = q_len // block_q
+    k_blk = pl.program_id(2)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[pl.dslice(i * block_q, block_q), :]
+        do = do_ref[pl.dslice(i * block_q, block_q), :]
+        lse = _safe(lse_ref[pl.dslice(i * block_q, block_q), 0])
+        delta = dl_ref[pl.dslice(i * block_q, block_q), 0]
+        s = _ab_t(q, k) * jnp.float32(sm_scale)
+        if has_bias:
+            s = s + bias_ref[pl.dslice(i * block_q, block_q),
+                             pl.dslice(k_blk * bk, bk)].astype(jnp.float32)
+        q_ids = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, bk), 0)
+        if causal:
+            k_ids = k_blk * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 1)
+            s = jnp.where(q_ids >= k_ids, s, -jnp.inf)
+        if nvec:
+            # this kernel's block covers k columns [k_blk*bk, k_blk*bk+bk)
+            s = _mask_block(s, mask_ref, q_ids, 0, bk, nvec)
+        p = jnp.exp(s - lse[:, None])
+        dv = dv + _at_b(p.astype(do.dtype), do)
+        dp = _ab_t(do, v)
+        ds = p * (dp - delta[:, None]) * jnp.float32(sm_scale)
+        dk = dk + _at_b(ds.astype(q.dtype), q)
+        return dk, dv
+
+    lower = (k_blk * bk) // block_q if causal else 0
+    dk, dv = jax.lax.fori_loop(
+        lower, nblk, body,
+        (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)))
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_dbias_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, *rest,
+                      causal, block_k, sm_scale, nvec):
+    """ds per q block, written to a [block_q, Sk] dbias row; its own
+    pallas_call so constant-bias training DCEs the whole pass."""
+    from jax.experimental import pallas as pl
+
+    it = iter(rest)
+    mask_ref = next(it) if nvec else None
+    bias_ref = next(it)
+    dbias_ref = next(it)
+
+    q = q_ref[...]
+    do = do_ref[...]
+    lse = _safe(lse_ref[:, 0])
+    delta = dl_ref[:, 0]
+    bq, d = q.shape
+    kv_len = k_ref.shape[0]
+    nblk = kv_len // block_k
+    q_blk = pl.program_id(2)
+    dbias_ref[...] = jnp.zeros_like(dbias_ref)
+
+    def body(i, _):
+        k = k_ref[pl.dslice(i * block_k, block_k), :]
+        v = v_ref[pl.dslice(i * block_k, block_k), :]
+        s = _ab_t(q, k) * jnp.float32(sm_scale)
+        s = s + bias_ref[:, pl.dslice(i * block_k, block_k)].astype(
+            jnp.float32)
+        q_ids = q_blk * bq + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 0)
+        if causal:
+            k_ids = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_ids >= k_ids, s, -jnp.inf)
+        if nvec:
+            s = _mask_block(s, mask_ref, q_ids, i * block_k, block_k, nvec)
+        p = jnp.exp(s - lse[:, None])
+        dp = _ab_t(do, v)
+        ds = p * (dp - delta[:, None])
+        dbias_ref[:, pl.dslice(i * block_k, block_k)] = \
+            ds.astype(dbias_ref.dtype)
+        return 0
+
+    upper = ((q_blk + 1) * bq + block_k - 1) // block_k if causal else nblk
+    jax.lax.fori_loop(0, upper, body, 0)
+
+
+def _masked_bwd(q, k, v, out, lse, g, mask_vecs, bias, causal, sm_scale,
+                block_q, block_k, need_dbias, interpret=False):
+    from jax.experimental import pallas as pl
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    nvec = mask_vecs.shape[2] if mask_vecs is not None else 0
+    has_bias = bias is not None
+    lse_b = jnp.broadcast_to(lse[..., None], (b, h, sq, NUM_LANES))
+    delta = jnp.sum(out.astype(jnp.float32) * g.astype(jnp.float32),
+                    axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (b, h, sq, NUM_LANES))
+
+    full = lambda s: pl.BlockSpec((None, None, s, d),          # noqa: E731
+                                  lambda b_, h_, i: (b_, h_, 0, 0))
+    full_l = pl.BlockSpec((None, None, sq, NUM_LANES),
+                          lambda b_, h_, i: (b_, h_, 0, 0))
+    blk_q = pl.BlockSpec((None, None, block_q, d),
+                         lambda b_, h_, i: (b_, h_, i, 0))
+    blk_l = pl.BlockSpec((None, None, block_q, NUM_LANES),
+                         lambda b_, h_, i: (b_, h_, i, 0))
+
+    tail_specs = []
+    tail_args = []
+    if nvec:
+        tail_specs.append(_mask_spec(mask_vecs, sk))
+        tail_args.append(mask_vecs)
+
+    with jax.enable_x64(False):
+        dq = pl.pallas_call(
+            functools.partial(
+                _bwd_dq_kernel, causal=causal, block_k=block_k,
+                sm_scale=sm_scale, nvec=nvec, has_bias=has_bias),
+            grid=(b, h, sq // block_q),
+            in_specs=[blk_q, full(sk), full(sk), blk_q, blk_l, blk_l]
+            + tail_specs
+            + ([_bias_spec(bias, block_q, sk)] if has_bias else []),
+            out_specs=blk_q,
+            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+            interpret=interpret,
+        )(q, k, v, g, lse_b, delta,
+          *(tail_args + ([bias] if has_bias else [])))
+
+        blk_k = pl.BlockSpec((None, None, block_k, d),
+                             lambda b_, h_, i: (b_, h_, i, 0))
+        kv_tail_specs = []
+        if nvec:
+            bb, hb = mask_vecs.shape[0], mask_vecs.shape[1]
+            kv_tail_specs.append(pl.BlockSpec(
+                (None, None, nvec, block_k),
+                lambda b_, h_, i, _bb=bb, _hb=hb:
+                (b_ if _bb > 1 else 0, h_ if _hb > 1 else 0, 0, i)))
+        dk, dv = pl.pallas_call(
+            functools.partial(
+                _bwd_dkv_kernel, causal=causal, block_q=block_q,
+                sm_scale=sm_scale, nvec=nvec, has_bias=has_bias),
+            grid=(b, h, sk // block_k),
+            in_specs=[full(sq), blk_k, blk_k, full(sq), full_l, full_l]
+            + kv_tail_specs
+            + ([_bias_spec(bias, block_q, sk, blocked=False)]
+               if has_bias else []),
+            out_specs=[blk_k, blk_k],
+            out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                       jax.ShapeDtypeStruct(v.shape, v.dtype)],
+            interpret=interpret,
+        )(q, k, v, g, lse_b, delta,
+          *(tail_args + ([bias] if has_bias else [])))
+
+        dbias = None
+        if need_dbias:
+            dbias_full = pl.pallas_call(
+                functools.partial(
+                    _bwd_dbias_kernel, causal=causal, block_k=block_k,
+                    sm_scale=sm_scale, nvec=nvec),
+                grid=(b, h, sq // block_q),
+                in_specs=[blk_q, full(sk), full(sk), blk_q, blk_l, blk_l]
+                + tail_specs + [_bias_spec(bias, block_q, sk)],
+                out_specs=pl.BlockSpec((None, None, block_q, sk),
+                                       lambda b_, h_, i: (b_, h_, i, 0)),
+                out_shape=jax.ShapeDtypeStruct((b, h, sq, sk),
+                                               jnp.float32),
+                interpret=interpret,
+            )(q, k, v, g, lse_b, delta, *(tail_args + [bias]))
+            # reduce over broadcast dims back to the bias shape
+            red = []
+            if bias.shape[0] == 1 and b > 1:
+                red.append(0)
+            if bias.shape[1] == 1 and h > 1:
+                red.append(1)
+            dbias = (jnp.sum(dbias_full, axis=tuple(red), keepdims=True)
+                     if red else dbias_full).astype(bias.dtype)
+    return dq, dk, dv, dbias
+
+
+# ------------------------------------------------------------- custom_vjp
+_INTERPRET = False   # set True in tests to run the kernels anywhere
+
+
+def _blocks(sq, sk):
+    from .flash_attention import _block_sizes
+    return _block_sizes(sq, sk)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def flash_mha_masked(q, k, v, mask_vecs, causal, sm_scale):
+    """[B, H, S, D] flash attention with the flashmask column-interval
+    encoding (mask_vecs [B|1, H|1, 2 or 4, Sk] int32); differentiable,
+    O(S) mask memory."""
+    out, _ = _masked_fwd(q, k, v, mask_vecs, None, causal, sm_scale,
+                         *_blocks(q.shape[2], k.shape[2]), need_lse=False,
+                         interpret=_INTERPRET)
+    return out
+
+
+def _masked_vjp_fwd(q, k, v, mask_vecs, causal, sm_scale):
+    out, lse = _masked_fwd(q, k, v, mask_vecs, None, causal, sm_scale,
+                           *_blocks(q.shape[2], k.shape[2]),
+                           interpret=_INTERPRET)
+    return out, (q, k, v, mask_vecs, out, lse[..., 0])
+
+
+def _masked_vjp_bwd(causal, sm_scale, res, g):
+    q, k, v, mask_vecs, out, lse = res
+    dq, dk, dv, _ = _masked_bwd(q, k, v, out, lse, g, mask_vecs, None,
+                                causal, sm_scale,
+                                *_blocks(q.shape[2], k.shape[2]),
+                                need_dbias=False, interpret=_INTERPRET)
+    return dq, dk, dv, None
+
+
+flash_mha_masked.defvjp(_masked_vjp_fwd, _masked_vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def flash_mha_biased(q, k, v, bias, causal, sm_scale):
+    """[B, H, S, D] flash attention with a dense additive bias
+    [B|1, H|1, Sq, Sk]; differentiable (dbias materializes a
+    [B,H,Sq,Sk] f32 transient only when the bias needs a gradient)."""
+    out, _ = _masked_fwd(q, k, v, None, bias, causal, sm_scale,
+                         *_blocks(q.shape[2], k.shape[2]), need_lse=False,
+                         interpret=_INTERPRET)
+    return out
+
+
+def _biased_vjp_fwd(q, k, v, bias, causal, sm_scale):
+    out, lse = _masked_fwd(q, k, v, None, bias, causal, sm_scale,
+                           *_blocks(q.shape[2], k.shape[2]),
+                           interpret=_INTERPRET)
+    return out, (q, k, v, bias, out, lse[..., 0])
+
+
+def _biased_vjp_bwd(causal, sm_scale, res, g):
+    q, k, v, bias, out, lse = res
+    dq, dk, dv, dbias = _masked_bwd(q, k, v, out, lse, g, None, bias,
+                                    causal, sm_scale,
+                                    *_blocks(q.shape[2], k.shape[2]),
+                                    need_dbias=True, interpret=_INTERPRET)
+    return dq, dk, dv, dbias
+
+
+flash_mha_biased.defvjp(_biased_vjp_fwd, _biased_vjp_bwd)
+
+
+def dense_mask_from_intervals(mask_vecs, sq, sk):
+    """Dense bool mask (True = attend) equivalent to mask_vecs — the
+    O(S^2) fallback used when the Pallas path is unavailable."""
+    vec = jnp.asarray(mask_vecs)
+    b, h, nvec, _ = vec.shape
+    r = jnp.arange(sq)[:, None]
+    allowed = jnp.ones((b, h, sq, sk), bool)
+    for i in range(nvec // 2):
+        start = vec[:, :, 2 * i][:, :, None, :]
+        end = vec[:, :, 2 * i + 1][:, :, None, :]
+        allowed = jnp.logical_and(
+            allowed, ~jnp.logical_and(r[None, None] >= start,
+                                      r[None, None] < end))
+    return allowed
