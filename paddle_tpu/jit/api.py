@@ -68,6 +68,7 @@ class StaticFunction:
             self._function = function
         self._input_spec = input_spec
         self._jit_cache: dict[Any, Any] = {}
+        self._converted = False
         functools.update_wrapper(self, self._function)
 
     # -- helpers ------------------------------------------------------------
@@ -81,19 +82,26 @@ class StaticFunction:
             bufs["buffers." + name] = b._data
         return params, bufs
 
-    def _make_pure(self, static_key, args_treedef, n_args, training):
+    def _make_pure(self, static_key, args_treedef, static_flat,
+                   tensor_idx, training):
         layer = self._layer
         fn = self._function
 
-        def pure(params, bufs, key, *flat_arrays):
+        def pure(params, bufs, key, *tensor_arrays):
             with tape.no_grad(), _random.trace_key_guard(key):
                 if layer is not None:
                     saved = layer.functional_state()
                     layer.load_functional_state({**params, **{
                         k: v for k, v in bufs.items()}})
                 try:
-                    wrapped = [Tensor(a, stop_gradient=True)
-                               for a in flat_arrays]
+                    # tensor leaves are traced; every other leaf is baked
+                    # in statically (it is part of the cache key), so
+                    # python-valued branches stay plain python — the
+                    # guard-and-specialize behavior the reference's SOT
+                    # gives via bytecode guards
+                    wrapped = list(static_flat)
+                    for pos, a in zip(tensor_idx, tensor_arrays):
+                        wrapped[pos] = Tensor(a, stop_gradient=True)
                     args, kwargs = tree_unflatten(args_treedef, wrapped)
                     out = fn(*args, **kwargs)
                     out_flat, out_tree = tree_flatten(out, is_leaf=_is_tensor)
@@ -113,9 +121,9 @@ class StaticFunction:
         # out_tree is static python data — hoist it via a container
         out_tree_box = []
 
-        def pure_arrays_only(params, bufs, key, *flat_arrays):
+        def pure_arrays_only(params, bufs, key, *tensor_arrays):
             out_arrays, new_bufs, out_tree = pure(params, bufs, key,
-                                                  *flat_arrays)
+                                                  *tensor_arrays)
             if not out_tree_box:
                 out_tree_box.append(out_tree)
             return out_arrays, new_bufs
@@ -126,50 +134,95 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         if not _to_static_enabled[0]:
             return self._function(*args, **kwargs)
+        try:
+            return self._call_impl(args, kwargs)
+        except jax.errors.TracerBoolConversionError as e:
+            # tensor-dependent Python control flow: rewrite if/while onto
+            # lax.cond/lax.while_loop (reference dy2static transformers)
+            # and retrace
+            if self._converted:
+                raise
+            self._convert_control_flow(e)
+            return self._call_impl(args, kwargs)
+
+    def _convert_control_flow(self, cause):
+        import inspect as _inspect
+        from .dy2static import convert_to_static_callable, \
+            Dy2StUnsupportedError
+        fn = self._function
+        try:
+            if _inspect.ismethod(fn):
+                conv = convert_to_static_callable(fn.__func__)
+                obj = fn.__self__
+
+                def bound(*a, **k):
+                    return conv(obj, *a, **k)
+                functools.update_wrapper(bound, fn.__func__)
+                self._function = bound
+            else:
+                self._function = convert_to_static_callable(fn)
+        except Dy2StUnsupportedError:
+            raise
+        except Exception as e:
+            raise cause from e
+        self._converted = True
+        self._jit_cache.clear()
+
+    def _call_impl(self, args, kwargs):
         if self._layer is not None and args and args[0] is self._layer:
             args = args[1:]
 
         flat, args_treedef = tree_flatten((args, kwargs), is_leaf=_is_tensor)
-        tensor_idx = [i for i, x in enumerate(flat) if isinstance(x, Tensor)]
-        tensors = [flat[i] for i in tensor_idx]
-        # static key: everything non-tensor + tensor shapes/dtypes + mode
+        # tensors AND array-likes are traced (dynamic); only simple python
+        # values — whose repr IS their identity — are baked statically
+        def _dynamic(x):
+            return isinstance(x, Tensor) or isinstance(x, (np.ndarray,
+                                                           jax.Array))
+        tensor_idx = [i for i, x in enumerate(flat) if _dynamic(x)]
+        tensors = [flat[i] if isinstance(flat[i], Tensor)
+                   else Tensor(flat[i], stop_gradient=True)
+                   for i in tensor_idx]
+        # static key: structure + baked values + dynamic shapes + mode
         training = self._layer.training if self._layer is not None else False
         static_parts = tuple(
-            (tuple(x.shape), str(x.dtype)) if isinstance(x, Tensor)
-            else repr(x) for x in flat)
-        key = (static_parts, training)
+            (tuple(np.shape(x._data if isinstance(x, Tensor) else x)),
+             str(np.result_type(x._data if isinstance(x, Tensor) else x)))
+            if _dynamic(x) else repr(x) for x in flat)
+        key = (args_treedef, static_parts, training)
 
         if key not in self._jit_cache:
-            # treedef where tensor leaves stay leaves, others are baked in
-            self._jit_cache[key] = self._make_pure(key, args_treedef,
-                                                   len(flat), training)
+            static_flat = [None if _dynamic(x) else x for x in flat]
+            self._jit_cache[key] = self._make_pure(
+                key, args_treedef, static_flat, tensor_idx, training)
         jitted, out_tree_box = self._jit_cache[key]
 
         params, bufs = self._state()
         rng = _random.split_key()
-        flat_arrays = [x._data if isinstance(x, Tensor) else x for x in flat]
+        tensor_arrays = [t._data for t in tensors]
 
         diff_tensors = [t for t in tensors if not t.stop_gradient]
         record = tape.is_grad_enabled() and (
             bool(params) or bool(diff_tensors))
 
         if not record:
-            out_arrays, new_bufs = jitted(params, bufs, rng, *flat_arrays)
+            out_arrays, new_bufs = jitted(params, bufs, rng,
+                                          *tensor_arrays)
             self._apply_bufs(new_bufs)
             return self._wrap_out(out_arrays, out_tree_box[0], node=None)
 
         # differentiate w.r.t. params and diff tensor args
-        diff_positions = [i for i, x in enumerate(flat)
-                          if isinstance(x, Tensor) and not x.stop_gradient]
+        diff_positions = [i for i, t_ in enumerate(tensors)
+                          if not t_.stop_gradient]
 
         def closed(p, *diff_arrays):
-            fa = list(flat_arrays)
+            fa = list(tensor_arrays)
             for pos, a in zip(diff_positions, diff_arrays):
                 fa[pos] = a
             return jitted(p, bufs, rng, *fa)
 
         (out_arrays, new_bufs), raw_vjp = jax.vjp(
-            closed, params, *[flat[i]._data for i in diff_positions])
+            closed, params,
+            *[tensor_arrays[i] for i in diff_positions])
         self._apply_bufs(new_bufs)
 
         out_avals = [jax.ShapeDtypeStruct(np.shape(a), _tan_dtype(a))
@@ -177,7 +230,7 @@ class StaticFunction:
         param_tensors = dict(self._layer.named_parameters()) \
             if self._layer is not None else {}
         diff_params = [param_tensors[k] for k in params]
-        inputs = diff_params + [flat[i] for i in diff_positions]
+        inputs = diff_params + [tensors[i] for i in diff_positions]
 
         def vjp_fn(flat_cots):
             cots = (list(flat_cots), _zeros_like_tree(new_bufs))

@@ -1,0 +1,20 @@
+"""dy2static: control-flow conversion for to_static.
+
+Reference package: python/paddle/jit/dy2static/ (AST transformers +
+convert_operators).  The SOT bytecode JIT is unnecessary on this
+architecture (jax traces Python directly; see jit/api.py), but
+tensor-dependent `if`/`while` still need real conversion — provided
+here by convert_operators over lax.cond/lax.while_loop and a
+source-level transformer engaged when plain tracing fails.
+"""
+from .convert_operators import (  # noqa: F401
+    convert_ifelse, convert_while_loop, convert_logical_and,
+    convert_logical_or, convert_logical_not, convert_len, convert_shape,
+    to_static_variable)
+from .transformer import (  # noqa: F401
+    convert_to_static_callable, Dy2StUnsupportedError)
+
+__all__ = ["convert_ifelse", "convert_while_loop", "convert_logical_and",
+           "convert_logical_or", "convert_logical_not", "convert_len",
+           "convert_shape", "to_static_variable",
+           "convert_to_static_callable", "Dy2StUnsupportedError"]

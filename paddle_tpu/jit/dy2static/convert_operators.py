@@ -1,0 +1,221 @@
+"""Control-flow conversion primitives for to_static.
+
+Reference: python/paddle/jit/dy2static/convert_operators.py —
+convert_ifelse / convert_while_loop / convert_logical_* route
+tensor-dependent Python control flow into graph ops (cond_op/while_op).
+TPU-native: the same API shape lowers onto `lax.cond` /
+`lax.while_loop`, the XLA-compilable control-flow primitives; concrete
+(non-traced) predicates keep plain Python semantics.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor
+
+__all__ = ["convert_ifelse", "convert_while_loop", "convert_logical_and",
+           "convert_logical_or", "convert_logical_not", "convert_len",
+           "convert_shape", "to_static_variable", "UndefinedVar", "UNDEF"]
+
+
+class UndefinedVar:
+    """Placeholder for names not yet bound when a converted statement
+    runs (reference dy2static/utils.py UndefinedVar).  Any real use
+    raises; it can still ride through a cond/while carry as a dummy."""
+
+    def __init__(self, name="<var>"):
+        self.name = name
+
+    def _die(self, *_a, **_k):
+        raise NameError(
+            f"variable {self.name!r} is used before being assigned on "
+            "every path of a converted tensor-dependent if/while")
+
+    __add__ = __radd__ = __sub__ = __rsub__ = __mul__ = __rmul__ = _die
+    __truediv__ = __rtruediv__ = __matmul__ = __getitem__ = _die
+    __call__ = __bool__ = __float__ = __int__ = __iter__ = _die
+    __lt__ = __le__ = __gt__ = __ge__ = _die
+
+
+UNDEF = UndefinedVar()
+
+
+def _is_traced(x):
+    if isinstance(x, Tensor):
+        x = x._data
+    return isinstance(x, jax.core.Tracer)
+
+
+def _pred_value(pred):
+    if isinstance(pred, Tensor):
+        pred = pred._data
+    return pred
+
+
+def _pack(vals):
+    """Tensors/scalars -> arrays; UndefinedVar -> dummy scalar (its spec
+    entry keeps the sentinel so _unpack restores it untouched)."""
+    arrs, spec = [], []
+    for v in vals:
+        if isinstance(v, UndefinedVar):
+            arrs.append(jnp.zeros((), jnp.float32))
+            spec.append(v)
+        elif isinstance(v, Tensor):
+            arrs.append(v._data)
+            spec.append("tensor")
+        elif isinstance(v, (bool, int, float)) or _is_traced(v) or \
+                hasattr(v, "dtype"):
+            arrs.append(jnp.asarray(v))
+            spec.append("array")
+        else:
+            raise TypeError(
+                f"control-flow carried value of type {type(v).__name__} "
+                "cannot cross a lax.cond/while_loop boundary; only "
+                "tensors and numeric scalars can")
+    return tuple(arrs), spec
+
+
+def _unpack(arrs, spec):
+    out = []
+    for a, s in zip(arrs, spec):
+        out.append(s if isinstance(s, UndefinedVar)
+                   else Tensor(a, stop_gradient=True))
+    return tuple(out)
+
+
+def convert_ifelse(pred, true_fn, false_fn, vars_tuple):
+    """`out_vars = convert_ifelse(pred, tfn, ffn, vars)` — reference
+    convert_operators.py convert_ifelse.  true_fn/false_fn take and
+    return the tuple of carried variables."""
+    p = _pred_value(pred)
+    if not _is_traced(p):
+        return true_fn(vars_tuple) if bool(p) else false_fn(vars_tuple)
+
+    arrs, spec = _pack(vars_tuple)
+    out_specs = {}
+
+    def wrap(fn, tag):
+        def run(arrs):
+            out = fn(_unpack(arrs, spec))
+            if not isinstance(out, tuple):
+                out = (out,)
+            out_arrs, out_spec = _pack(out)
+            out_specs[tag] = out_spec
+            return out_arrs
+        return run
+
+    pred_arr = jnp.reshape(jnp.asarray(p), ()).astype(bool)
+
+    def _undef_mismatch():
+        for a, b in zip(out_specs.get("t", ()), out_specs.get("f", ())):
+            if isinstance(a, UndefinedVar) != isinstance(b, UndefinedVar):
+                return a.name if isinstance(a, UndefinedVar) else b.name
+        return None
+
+    try:
+        out_arrs = jax.lax.cond(pred_arr, wrap(true_fn, "t"),
+                                wrap(false_fn, "f"), arrs)
+    except TypeError as e:
+        name = _undef_mismatch()
+        if name is not None:
+            raise NameError(
+                f"variable {name!r} is assigned in only one branch of a "
+                "tensor-dependent if; assign it on both paths (or "
+                "before the if) so the converted lax.cond has a value "
+                "either way") from e
+        raise
+    name = _undef_mismatch()
+    if name is not None:
+        raise NameError(
+            f"variable {name!r} is assigned in only one branch of a "
+            "tensor-dependent if; assign it on both paths (or before "
+            "the if) so the converted lax.cond has a value either way")
+    return _unpack(out_arrs, out_specs["t"])
+
+
+def convert_while_loop(cond_fn, body_fn, vars_tuple):
+    """`out_vars = convert_while_loop(cond, body, vars)` — reference
+    convert_operators.py convert_while_loop over lax.while_loop."""
+    probe = cond_fn(vars_tuple)
+    p = _pred_value(probe)
+    if not _is_traced(p) and not any(
+            _is_traced(v) for v in vars_tuple):
+        # fully concrete: plain Python loop
+        while bool(_pred_value(cond_fn(vars_tuple))):
+            vars_tuple = body_fn(vars_tuple)
+            if not isinstance(vars_tuple, tuple):
+                vars_tuple = (vars_tuple,)
+        return vars_tuple
+
+    arrs, spec = _pack(vars_tuple)
+    out_spec_box = []
+
+    def cond(arrs):
+        c = _pred_value(cond_fn(_unpack(arrs, spec)))
+        return jnp.reshape(jnp.asarray(c), ()).astype(bool)
+
+    def body(arrs):
+        out = body_fn(_unpack(arrs, spec))
+        if not isinstance(out, tuple):
+            out = (out,)
+        out_arrs, out_spec = _pack(out)
+        if not out_spec_box:
+            out_spec_box.append(out_spec)
+        return out_arrs
+
+    try:
+        out_arrs = jax.lax.while_loop(cond, body, arrs)
+    except TypeError as e:
+        undef = [sp.name for sp in spec if isinstance(sp, UndefinedVar)]
+        if undef:
+            raise NameError(
+                f"variables {undef} are first assigned inside a "
+                "tensor-dependent while; initialize them before the loop "
+                "so the converted lax.while_loop carry is well-typed")                 from e
+        raise
+    return _unpack(out_arrs, out_spec_box[0] if out_spec_box else spec)
+
+
+def convert_logical_and(x_fn, y_fn):
+    x = x_fn()
+    xv = _pred_value(x)
+    if not _is_traced(xv):
+        return y_fn() if bool(xv) else x
+    y = _pred_value(y_fn())
+    return Tensor(jnp.logical_and(jnp.asarray(xv).astype(bool),
+                                  jnp.asarray(y).astype(bool)),
+                  stop_gradient=True)
+
+
+def convert_logical_or(x_fn, y_fn):
+    x = x_fn()
+    xv = _pred_value(x)
+    if not _is_traced(xv):
+        return x if bool(xv) else y_fn()
+    y = _pred_value(y_fn())
+    return Tensor(jnp.logical_or(jnp.asarray(xv).astype(bool),
+                                 jnp.asarray(y).astype(bool)),
+                  stop_gradient=True)
+
+
+def convert_logical_not(x):
+    xv = _pred_value(x)
+    if not _is_traced(xv):
+        return not bool(xv)
+    return Tensor(jnp.logical_not(jnp.asarray(xv).astype(bool)),
+                  stop_gradient=True)
+
+
+def convert_len(x):
+    if isinstance(x, Tensor):
+        return x.shape[0]
+    return len(x)
+
+
+def convert_shape(x):
+    return x.shape
+
+
+def to_static_variable(x):
+    return x

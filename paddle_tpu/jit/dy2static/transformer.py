@@ -1,0 +1,330 @@
+"""AST rewrite of tensor-dependent `if`/`while` into convert_* calls.
+
+Reference: python/paddle/jit/dy2static/transformers/
+{ifelse_transformer,loop_transformer,logical_transformer}.py — source-to-
+source rewriting so data-dependent Python control flow becomes graph ops.
+Here the rewrite targets convert_ifelse/convert_while_loop
+(lax.cond / lax.while_loop).
+
+Engaged lazily: to_static first traces the function as-is (plain Python
+control flow on concrete values is fine, and is the fast path); only
+when tracing raises jax's TracerBoolConversionError does StaticFunction
+rebuild the callable through this transformer and retry.
+
+Supported: `if`/`elif`/`else` and `while` whose carried variables are
+assigned names (including aug-assign) defined before the statement;
+`and`/`or`/`not` inside the tests.  Unsupported (loud errors, matching
+the reference's error classes): `return`/`break`/`continue` inside a
+converted branch or loop body, and carried values that are neither
+tensors nor numeric scalars.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+
+__all__ = ["convert_to_static_callable", "Dy2StUnsupportedError"]
+
+_PREFIX = "__d2s_"
+
+
+class Dy2StUnsupportedError(RuntimeError):
+    pass
+
+
+class _NameCollector(ast.NodeVisitor):
+    """Names stored anywhere within a statement body."""
+
+    def __init__(self):
+        self.stores = []
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)) and \
+                node.id not in self.stores and \
+                not node.id.startswith(_PREFIX):
+            self.stores.append(node.id)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):  # don't descend into nested defs
+        if node.name not in self.stores and \
+                not node.name.startswith(_PREFIX):
+            self.stores.append(node.name)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _stored_names(stmts):
+    c = _NameCollector()
+    for s in stmts:
+        c.visit(s)
+    return c.stores
+
+
+class _BanControlEscape(ast.NodeVisitor):
+    """Constructs a converted body can't express get loud errors:
+    return anywhere, break/continue not owned by a nested loop, and
+    attribute/subscript stores (lax.cond traces BOTH branches, so such
+    side effects would run unconditionally)."""
+
+    def __init__(self, what):
+        self.what = what
+        self._loops = 0
+
+    def _ban(self, node, kind):
+        raise Dy2StUnsupportedError(
+            f"to_static: {kind} inside a tensor-dependent {self.what} "
+            "is not convertible to lax control flow; restructure the "
+            "function (reference dy2static raises the same class of "
+            "error for unsupported rewrites)")
+
+    def visit_Return(self, node):
+        self._ban(node, "`return`")
+
+    def visit_Break(self, node):
+        if not self._loops:
+            self._ban(node, "`break`")
+
+    def visit_Continue(self, node):
+        if not self._loops:
+            self._ban(node, "`continue`")
+
+    def _visit_loop(self, node):
+        self._loops += 1
+        self.generic_visit(node)
+        self._loops -= 1
+
+    visit_While = _visit_loop
+    visit_For = _visit_loop
+
+    def _check_store_target(self, tgt):
+        if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+            self._ban(tgt, "attribute/subscript assignment (a side "
+                           "effect both lax.cond branches would run)")
+        for child in ast.iter_child_nodes(tgt):
+            self._check_store_target(child)
+
+    def visit_Assign(self, node):
+        for tgt in node.targets:
+            self._check_store_target(tgt)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._check_store_target(node.target)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        pass  # nested functions own their control flow
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _guards(carried, uid):
+    """`try: g = name  except (NameError, UnboundLocalError): g = UNDEF`
+    per carried name — names first assigned inside the converted body
+    enter the carry as UndefinedVar placeholders (reference
+    dy2static/utils.py UndefinedVar)."""
+    stmts, in_names = [], []
+    for j, n in enumerate(carried):
+        g = f"{_PREFIX}g{uid}_{j}"
+        in_names.append(g)
+        stmts.append(ast.Try(
+            body=[ast.Assign(targets=[ast.Name(id=g, ctx=ast.Store())],
+                             value=ast.Name(id=n, ctx=ast.Load()))],
+            handlers=[ast.ExceptHandler(
+                type=ast.Tuple(
+                    elts=[ast.Name(id="NameError", ctx=ast.Load()),
+                          ast.Name(id="UnboundLocalError",
+                                   ctx=ast.Load())], ctx=ast.Load()),
+                name=None,
+                body=[ast.Assign(
+                    targets=[ast.Name(id=g, ctx=ast.Store())],
+                    value=ast.Call(
+                        func=ast.Name(id=f"{_PREFIX}undef",
+                                      ctx=ast.Load()),
+                        args=[ast.Constant(value=n)], keywords=[]))])],
+            orelse=[], finalbody=[]))
+    return stmts, in_names
+
+
+def _names_load(names):
+    return [ast.Name(id=n, ctx=ast.Load()) for n in names]
+
+
+def _names_store(names):
+    return [ast.Name(id=n, ctx=ast.Store()) for n in names]
+
+
+def _tuple(elts, ctx):
+    return ast.Tuple(elts=elts, ctx=ctx)
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.counter = 0
+
+    def _uid(self):
+        self.counter += 1
+        return self.counter
+
+    # ---- if / elif / else ------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        carried = sorted(set(_stored_names(node.body)
+                             + _stored_names(node.orelse)))
+        for stmts in (node.body, node.orelse):
+            for s in stmts:
+                _BanControlEscape("branch").visit(s)
+        uid = self._uid()
+        var_arg = f"{_PREFIX}vars"
+        carry_tuple_store = _tuple(_names_store(carried), ast.Store())
+        carry_tuple_load = _tuple(_names_load(carried), ast.Load())
+        guard_stmts, in_names = _guards(carried, uid)
+        carry_tuple_in = _tuple(_names_load(in_names), ast.Load())
+
+        def branch_fn(name, stmts):
+            body = []
+            if carried:
+                body.append(ast.Assign(
+                    targets=[carry_tuple_store],
+                    value=ast.Name(id=var_arg, ctx=ast.Load())))
+            body.extend(stmts or [ast.Pass()])
+            body.append(ast.Return(value=carry_tuple_load))
+            return ast.FunctionDef(
+                name=name,
+                args=ast.arguments(posonlyargs=[], args=[
+                    ast.arg(arg=var_arg)], kwonlyargs=[], kw_defaults=[],
+                    defaults=[]),
+                body=body, decorator_list=[])
+
+        tname = f"{_PREFIX}true_{uid}"
+        fname = f"{_PREFIX}false_{uid}"
+        call = ast.Call(
+            func=ast.Name(id=f"{_PREFIX}convert_ifelse", ctx=ast.Load()),
+            args=[node.test,
+                  ast.Name(id=tname, ctx=ast.Load()),
+                  ast.Name(id=fname, ctx=ast.Load()),
+                  carry_tuple_in],
+            keywords=[])
+        assign = ast.Assign(targets=[carry_tuple_store], value=call) \
+            if carried else ast.Expr(value=call)
+        return [branch_fn(tname, node.body),
+                branch_fn(fname, node.orelse)] + guard_stmts + [assign]
+
+    # ---- while -----------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse:
+            raise Dy2StUnsupportedError(
+                "to_static: while/else is not convertible")
+        for s in node.body:
+            _BanControlEscape("loop body").visit(s)
+        # carry = names stored in the body; read-only names resolve via
+        # the nested functions' natural closure over the outer locals
+        carried = sorted(set(_stored_names(node.body)))
+        uid = self._uid()
+        var_arg = f"{_PREFIX}vars"
+        carry_store = _tuple(_names_store(carried), ast.Store())
+        carry_load = _tuple(_names_load(carried), ast.Load())
+        guard_stmts, in_names = _guards(carried, uid)
+        carry_in = _tuple(_names_load(in_names), ast.Load())
+
+        def make_fn(name, body_stmts, ret):
+            body = [ast.Assign(targets=[carry_store],
+                               value=ast.Name(id=var_arg, ctx=ast.Load()))]
+            body.extend(body_stmts)
+            body.append(ast.Return(value=ret))
+            return ast.FunctionDef(
+                name=name,
+                args=ast.arguments(posonlyargs=[], args=[
+                    ast.arg(arg=var_arg)], kwonlyargs=[], kw_defaults=[],
+                    defaults=[]),
+                body=body, decorator_list=[])
+
+        cname = f"{_PREFIX}cond_{uid}"
+        bname = f"{_PREFIX}body_{uid}"
+        call = ast.Call(
+            func=ast.Name(id=f"{_PREFIX}convert_while", ctx=ast.Load()),
+            args=[ast.Name(id=cname, ctx=ast.Load()),
+                  ast.Name(id=bname, ctx=ast.Load()),
+                  carry_in],
+            keywords=[])
+        return [make_fn(cname, [], node.test),
+                make_fn(bname, list(node.body), carry_load)] \
+            + guard_stmts + [ast.Assign(targets=[carry_store], value=call)]
+
+    # ---- boolean operators in tests --------------------------------------
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        op = f"{_PREFIX}logical_and" if isinstance(node.op, ast.And) \
+            else f"{_PREFIX}logical_or"
+        out = node.values[0]
+        for nxt in node.values[1:]:
+            out = ast.Call(
+                func=ast.Name(id=op, ctx=ast.Load()),
+                args=[ast.Lambda(
+                    args=ast.arguments(posonlyargs=[], args=[],
+                                       kwonlyargs=[], kw_defaults=[],
+                                       defaults=[]), body=out),
+                    ast.Lambda(
+                    args=ast.arguments(posonlyargs=[], args=[],
+                                       kwonlyargs=[], kw_defaults=[],
+                                       defaults=[]), body=nxt)],
+                keywords=[])
+        return out
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(
+                func=ast.Name(id=f"{_PREFIX}logical_not", ctx=ast.Load()),
+                args=[node.operand], keywords=[])
+        return node
+
+
+def convert_to_static_callable(fn):
+    """Rebuild `fn` with tensor-dependent if/while rewritten onto
+    convert_ifelse/convert_while_loop.  Raises Dy2StUnsupportedError when
+    the source can't be obtained or uses unsupported constructs."""
+    from . import convert_operators as co
+
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError) as e:
+        raise Dy2StUnsupportedError(
+            f"to_static: source for {fn!r} unavailable for control-flow "
+            "conversion") from e
+    tree = ast.parse(src)
+    fdef = tree.body[0]
+    # strip decorators (e.g. @to_static) so exec defines the plain fn
+    if isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        fdef.decorator_list = []
+    new_tree = _ControlFlowTransformer().visit(tree)
+    ast.fix_missing_locations(new_tree)
+
+    glb = dict(getattr(fn, "__globals__", {}))
+    if fn.__closure__:
+        # freeze free variables as globals (reference rewrites closures
+        # similarly; values are captured at conversion time)
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                glb[name] = cell.cell_contents
+            except ValueError as e:
+                raise Dy2StUnsupportedError(
+                    f"to_static: free variable {name!r} of {fn.__name__} "
+                    "is unbound; cannot convert") from e
+    glb[f"{_PREFIX}undef"] = co.UndefinedVar
+    glb[f"{_PREFIX}convert_ifelse"] = co.convert_ifelse
+    glb[f"{_PREFIX}convert_while"] = co.convert_while_loop
+    glb[f"{_PREFIX}logical_and"] = co.convert_logical_and
+    glb[f"{_PREFIX}logical_or"] = co.convert_logical_or
+    glb[f"{_PREFIX}logical_not"] = co.convert_logical_not
+
+    code = compile(new_tree, filename=f"<dy2static {fn.__name__}>",
+                   mode="exec")
+    ns = {}
+    exec(code, glb, ns)
+    new_fn = ns[fn.__name__]
+    functools.update_wrapper(new_fn, fn)
+    return new_fn
