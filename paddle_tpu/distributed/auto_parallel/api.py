@@ -175,9 +175,19 @@ class ShardingStage3(_ShardingStage):
 
 def shard_optimizer(optimizer, shard_fn=None):
     """Shard optimizer states over the sharding mesh dim (reference
-    api.py:1591).  On TPU, stage-1/2 = shard accumulator arrays over the
-    dp axis (GSPMD keeps updates local, grads arrive reduced); stage-3
-    additionally shards parameters.
+    api.py:1591; fleet analogs group_sharded_optimizer_stage2.py /
+    group_sharded_stage3.py).  TPU mapping of the ZeRO ladder:
+
+      stage 1 — optimizer state sharded over the axis; grads stay
+        replicated (allreduce), each device updates with its state shard.
+      stage 2 — + gradients resharded onto the state sharding before the
+        update (XLA lowers the replicated-grad -> sharded-grad transition
+        as the reduce-scatter the reference codes by hand), and the
+        updated shards gather back into the replicated parameter.
+      stage 3 — + parameters live sharded; every consumer op's GSPMD
+        gather materializes the full weight transiently (the reference's
+        param broadcast/release in group_sharded_stage3.py:1 maps to
+        XLA's allgather + buffer lifetime).
 
     shard_fn may be a ShardingStage instance/class, or a plain function
     `(name, param, accumulator_array) -> array` applied to every state
@@ -228,7 +238,28 @@ def shard_optimizer(optimizer, shard_fn=None):
         return slot[name]
 
     optimizer._acc = sharded_acc
+    if cfg.stage >= 2:
+        optimizer._grad_transform = shard_state
+        optimizer._param_restore = lambda p, arr: (
+            jax.device_put(arr, p._data.sharding)
+            if getattr(p._data, "sharding", None) is not None else arr)
+        # params must be mesh-committed so the sharded-grad update math
+        # has one device set.  Only single-device params are (re)placed —
+        # an existing mesh sharding (e.g. tensor-parallel weights) is
+        # preserved; stage 3 shards params itself below, so skip the
+        # transient full-replication there
+        if cfg.stage == 2:
+            rep = [Replicate()] * len(mesh.dim_names)
+            for p in optimizer._parameter_list:
+                sh = getattr(p._data, "sharding", None)
+                if isinstance(sh, NamedSharding) and \
+                        sh.mesh.devices.size == mesh.jax_mesh.devices.size:
+                    continue
+                p._data = jax.device_put(
+                    p._data, _sharding(mesh, rep, p._data.ndim))
     if cfg.stage >= 3:
+        # parameters live sharded; lazily-created master weights inherit
+        # the sharding from p._data.astype
         for p in optimizer._parameter_list:
             p._data = shard_state(p._data)
     return optimizer

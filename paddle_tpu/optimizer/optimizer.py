@@ -97,10 +97,15 @@ class Optimizer:
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
         lr = self.get_lr()
+        gt = getattr(self, "_grad_transform", None)
         for p, g in params_grads:
             if g is None:
                 continue
             g32 = g.astype(jnp.float32)
+            if gt is not None:
+                # sharding-stage>=2: reduce-scatter semantics — the grad
+                # becomes dp-sharded so update math runs on shards only
+                g32 = gt(g32)
             if self._l1_coeff:  # L1 regularization: grad += c * sign(param)
                 g32 = g32 + self._l1_coeff * jnp.sign(self._param_f32(p))
             self._update_param(p, g32, lr)
@@ -113,7 +118,14 @@ class Optimizer:
         key = self._param_key(p)
         if key in self._master_weights:
             self._master_weights[key] = new_f32
-        p._data = new_f32.astype(p._data.dtype)
+        out = new_f32.astype(p._data.dtype)
+        restore = getattr(self, "_param_restore", None)
+        if restore is not None:
+            # sharding-stage 2: updated shards gather back to the param's
+            # own layout (replicated); stage 3 params are sharded so this
+            # is a no-op placement
+            out = restore(p, out)
+        p._data = out
 
     def _param_f32(self, p):
         master = self._get_master(p)
