@@ -4,6 +4,8 @@ All static-shape friendly: XLA requires concrete shapes, so size args coming
 in as Tensors are concretized where Paddle allows dynamic ones."""
 from __future__ import annotations
 
+import builtins as _builtins
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -179,7 +181,8 @@ def index_sample(x, index):
 
 @op
 def index_add(x, index, axis, value, name=None):
-    sl = [slice(None)] * x.ndim
+    # NB: the module-level `slice` op shadows the builtin here
+    sl = [_builtins.slice(None)] * x.ndim
     sl[axis] = index
     return x.at[tuple(sl)].add(value)
 
@@ -198,8 +201,16 @@ def take_along_axis(arr, indices, axis, broadcast=True, name=None):
 @op
 def put_along_axis(arr, indices, values, axis, reduce="assign",
                    include_self=True, broadcast=True, name=None):
+    axis = axis % arr.ndim
+    if broadcast:
+        # paddle semantics: indices broadcast against arr on every dim
+        # except `axis`
+        tgt = list(arr.shape)
+        tgt[axis] = indices.shape[axis]
+        indices = jnp.broadcast_to(indices, tgt)
     if not hasattr(values, "shape") or values.shape != indices.shape:
-        values = jnp.broadcast_to(jnp.asarray(values, arr.dtype), indices.shape)
+        values = jnp.broadcast_to(jnp.asarray(values, arr.dtype),
+                                  indices.shape)
     sl = jnp.take_along_axis(arr, indices, axis=axis)
     if reduce == "assign":
         new = values
@@ -209,11 +220,17 @@ def put_along_axis(arr, indices, values, axis, reduce="assign",
         new = sl * values if include_self else values
     else:
         raise ValueError(f"unsupported reduce {reduce}")
-    # build scatter via explicit indices along axis
-    idx = [jnp.broadcast_to(
-        jnp.arange(arr.shape[d]).reshape([-1 if i == d else 1 for i in range(arr.ndim)]),
-        indices.shape) for d, i in zip(range(arr.ndim), range(arr.ndim))]
-    idx[axis] = indices
+    # scatter via explicit per-dim index grids (the axis dim carries the
+    # user indices; other dims are their own coordinates)
+    idx = []
+    for d in range(arr.ndim):
+        if d == axis:
+            idx.append(indices)
+        else:
+            shp = [1] * arr.ndim
+            shp[d] = arr.shape[d]
+            idx.append(jnp.broadcast_to(
+                jnp.arange(arr.shape[d]).reshape(shp), indices.shape))
     return arr.at[tuple(idx)].set(new)
 
 
@@ -541,7 +558,6 @@ def crop(x, shape=None, offsets=None, name=None):
     return jax.lax.dynamic_slice(x, offsets, shape)
 
 
-import builtins as _builtins
 
 
 @op
