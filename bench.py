@@ -271,6 +271,64 @@ def bench_moe():
            "device": dev.device_kind, "loss": loss_val})
 
 
+def bench_decode():
+    """Serving-path rung: KV-cache decode tokens/s (VERDICT r1 item 9;
+    reference block_multi_head_attention_kernel.cu).  The shipped path
+    is the fused-XLA kv-head-major formulation; vs_baseline compares it
+    against the Pallas block-cache kernel (kept opt-in — see
+    ops/pallas/decode_attention.py for the measured tradeoff)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models import generation as G
+    from paddle_tpu.ops.pallas import decode_attention as DA
+
+    dev, on_tpu, _ = _env()
+    n = 1
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=16, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=2048,
+            dtype="bfloat16")
+        batch, prompt, new = 8, 128, 128
+    else:
+        cfg = LlamaConfig(vocab_size=256, hidden_size=128,
+                          intermediate_size=256, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=4,
+                          max_position_embeddings=512)
+        batch, prompt, new = 2, 8, 8
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    ids = paddle.to_tensor(
+        np.random.randint(0, cfg.vocab_size, (batch, prompt)).astype(
+            np.int64))
+
+    def run():
+        G._FN_CACHE.clear()
+        out = G.generate(model, ids, max_new_tokens=new)
+        float(np.asarray(out._data[0, -1]))       # compile + fetch
+        t0 = time.perf_counter()
+        out = G.generate(model, ids, max_new_tokens=new)
+        float(np.asarray(out._data[0, -1]))
+        return batch * new / (time.perf_counter() - t0)
+
+    tps_default = run()
+    saved = DA.PALLAS_DECODE
+    DA.PALLAS_DECODE = True                        # opt-in kernel path
+    try:
+        tps_kernel = run()
+    finally:
+        DA.PALLAS_DECODE = saved
+    _emit("llama_decode_tokens_per_sec_per_chip", tps_default,
+          "tokens/s/chip",
+          tps_default / max(tps_kernel, 1e-9),
+          {"pallas_kernel_tokens_per_sec": round(tps_kernel, 2),
+           "batch": batch, "new_tokens": new, "device": dev.device_kind,
+           "note": "vs_baseline = shipped(XLA-fused)/pallas ratio"})
+
+
 def bench_lenet():
     """Ladder #1: LeNet dygraph (eager tape) vs one-program jit steps/s —
     the per-op dispatch overhead number (reference hot-path goal,
@@ -333,7 +391,7 @@ def bench_lenet():
 
 def main():
     for fn in (bench_llama, bench_resnet50, bench_bert, bench_moe,
-               bench_lenet):
+               bench_decode, bench_lenet):
         try:
             fn()
         except Exception as e:  # keep the rest of the ladder running

@@ -7,8 +7,8 @@ PaddleNLP's generate() loop.
 
 TPU formulation: the whole decode is ONE jitted program —
   * prefill: full-sequence forward over the (right-padded) prompt fills
-    a [L, B, max_len, kvH, D] cache; prompt lengths are data, shapes are
-    static.
+    a kv-head-major [L, B, kvH, T, D] cache; prompt lengths are data,
+    shapes are static.
   * decode: `lax.scan` over max_new_tokens, each step one-token
     attention against the cache (dot-products on the MXU, no [S,S]
     materialization); the per-batch cache write is a positional
@@ -108,7 +108,8 @@ def _prefill_layer(w, x, cos, sin, mask, cfg: LlamaConfig):
 
 # ------------------------------------------------------------ decode step
 def _decode_layer(w, x, kcache, vcache, cos1, sin1, pos, cfg: LlamaConfig):
-    """x: [B, H] one token; kcache/vcache: [B, T, kvH, D]; pos: [B]."""
+    """x: [B, H] one token; kcache/vcache: [B, kvH, T, D] (kv-head-major,
+    the decode kernel's tiling-friendly layout); pos: [B]."""
     b = x.shape[0]
     nh, kvh, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
                    cfg.head_dim)
@@ -123,20 +124,15 @@ def _decode_layer(w, x, kcache, vcache, cos1, sin1, pos, cfg: LlamaConfig):
 
     # write this token's k/v at pos (per-batch positions)
     idx = pos[:, None, None, None]
-    tpos = jnp.arange(kcache.shape[1])
-    sel = (tpos[None, :, None, None] == idx)
-    kcache = jnp.where(sel, k[:, None], kcache)
-    vcache = jnp.where(sel, v[:, None], vcache)
+    tpos = jnp.arange(kcache.shape[2])
+    sel = (tpos[None, None, :, None] == idx)          # [B, 1, T, 1]
+    kcache = jnp.where(sel, k[:, :, None], kcache)
+    vcache = jnp.where(sel, v[:, :, None], vcache)
 
-    rep = nh // kvh
-    kq = jnp.repeat(kcache, rep, axis=2)       # [B, T, nh, D]
-    vq = jnp.repeat(vcache, rep, axis=2)
-    logits = jnp.einsum("bhd,bthd->bht", q, kq,
-                        preferred_element_type=jnp.float32) / np.sqrt(hd)
-    valid = tpos[None, None, :] <= pos[:, None, None]
-    logits = jnp.where(valid, logits, -jnp.inf)
-    probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
-    attn = jnp.einsum("bht,bthd->bhd", probs, vq).reshape(b, nh * hd)
+    # blockwise cache attention kernel (ops/pallas/decode_attention.py);
+    # transparently falls back to the einsum path off-TPU
+    from ..ops.pallas.decode_attention import decode_attention
+    attn = decode_attention(q, kcache, vcache, pos).reshape(b, nh * hd)
     x = x + attn @ w["o"]
     h = _rms(x[:, None], w["ln2"], cfg.rms_norm_eps)[:, 0]
     return (x + (jax.nn.silu(h @ w["gate"]) * (h @ w["up"])) @ w["down"],
@@ -173,6 +169,13 @@ def build_generate_fn(config: LlamaConfig, gen: GenerationConfig,
     L = config.num_hidden_layers
     T = prompt_len + gen.max_new_tokens
     assert T <= config.max_position_embeddings
+    from ..ops.pallas import decode_attention as _DA
+    if _DA.PALLAS_DECODE or _DA._INTERPRET:
+        # the block-cache kernel needs a 128-aligned cache; the pos mask
+        # ignores the tail slots (rope rows past max_position_embeddings
+        # exist but are never addressed).  The default XLA path skips
+        # this so tiny caches don't pay for unused slots.
+        T = -(-T // 128) * 128
 
     def run(state, ids, lengths, key):
         b = ids.shape[0]
@@ -189,10 +192,11 @@ def build_generate_fn(config: LlamaConfig, gen: GenerationConfig,
             w = _layer_weights(state, i)
             x, k, v = _prefill_layer(w, x, cos[:prompt_len],
                                      sin[:prompt_len], pmask, config)
-            pad = ((0, 0), (0, T - prompt_len), (0, 0), (0, 0))
-            kcaches.append(jnp.pad(k, pad))
-            vcaches.append(jnp.pad(v, pad))
-        kcache = jnp.stack(kcaches)            # [L, B, T, kvH, D]
+            # kv-head-major cache layout [B, kvH, T, D]
+            pad = ((0, 0), (0, 0), (0, T - prompt_len), (0, 0))
+            kcaches.append(jnp.pad(k.swapaxes(1, 2), pad))
+            vcaches.append(jnp.pad(v.swapaxes(1, 2), pad))
+        kcache = jnp.stack(kcaches)            # [L, B, kvH, T, D]
         vcache = jnp.stack(vcaches)
 
         x = _rms(x, state["llama.norm.weight"], config.rms_norm_eps)
@@ -268,9 +272,11 @@ def generate(model, input_ids, max_new_tokens=64, do_sample=False,
         eos_token_id=eos_token_id, pad_token_id=pad_token_id, seed=seed)
     state = {k: (v._data if isinstance(v, Tensor) else v)
              for k, v in model.functional_state().items()}
+    from ..ops.pallas import decode_attention as _DA
     cache_key = (astuple_cfg(model.config), s,
                  gen.max_new_tokens, gen.do_sample, gen.temperature,
-                 gen.top_k, gen.top_p, gen.eos_token_id, gen.pad_token_id)
+                 gen.top_k, gen.top_p, gen.eos_token_id, gen.pad_token_id,
+                 _DA.PALLAS_DECODE or _DA._INTERPRET)
     fn = _FN_CACHE.get(cache_key)
     if fn is None:
         if len(_FN_CACHE) >= _FN_CACHE_MAX:   # bound compiled programs

@@ -86,6 +86,28 @@ def _xla_sdpa(q, k, v, attn_mask=None, is_causal=False, dropout_p=0.0,
 _PALLAS_OK = None   # lazily probed once per process
 
 
+def run_probe(fn):
+    """Compile+run `fn` once in a FRESH THREAD and report success.  jax
+    trace state is thread-local, so the probe stays eager (and
+    catchable) even when reached while tracing a caller's jit.  Shared
+    by every pallas kernel family's availability gate."""
+    import threading
+
+    box = {}
+
+    def run():
+        try:
+            fn()
+            box["ok"] = True
+        except Exception:
+            box["ok"] = False
+
+    t = threading.Thread(target=run)
+    t.start()
+    t.join()
+    return box.get("ok", False)   # thread died on BaseException -> no
+
+
 def _probe_pallas():
     """Compile+run a tiny fwd AND grad once. The bwd kernels are traced
     outside any caller's try (when the cotangent is pulled back at
@@ -93,32 +115,20 @@ def _probe_pallas():
     training instead of falling back to the XLA path."""
     global _PALLAS_OK
     if _PALLAS_OK is None:
-        # run in a fresh thread: jax trace state is thread-local, so the
-        # probe stays eager (and catchable) even when sdpa is reached
-        # while tracing a caller's jit
-        import threading
+        def smoke():
+            z = jnp.zeros((1, 256, 1, 64), jnp.bfloat16)
+            # grad wrt q, k AND v so none of the three bwd kernels is
+            # dead code the jaxpr DCE could skip lowering for
+            jax.jit(jax.grad(
+                lambda q, k, v: jnp.sum(_pallas_sdpa(q, k, v, True)
+                                        .astype(jnp.float32)),
+                argnums=(0, 1, 2)))(z, z, z)[0].block_until_ready()
+            # the no-grad path uses the separate need_lse=False forward
+            # variant; compile that too
+            jax.jit(lambda q: _pallas_sdpa(q, z, z, True))(
+                z).block_until_ready()
 
-        def run():
-            global _PALLAS_OK
-            try:
-                z = jnp.zeros((1, 256, 1, 64), jnp.bfloat16)
-                # grad wrt q, k AND v so none of the three bwd kernels
-                # is dead code the jaxpr DCE could skip lowering for
-                jax.jit(jax.grad(
-                    lambda q, k, v: jnp.sum(_pallas_sdpa(q, k, v, True)
-                                            .astype(jnp.float32)),
-                    argnums=(0, 1, 2)))(z, z, z)[0].block_until_ready()
-                # the no-grad path uses the separate need_lse=False
-                # forward variant; compile that too
-                jax.jit(lambda q: _pallas_sdpa(q, z, z, True))(
-                    z).block_until_ready()
-                _PALLAS_OK = True
-            except Exception:
-                _PALLAS_OK = False
-
-        t = threading.Thread(target=run)
-        t.start()
-        t.join()
+        _PALLAS_OK = run_probe(smoke)
     return _PALLAS_OK
 
 

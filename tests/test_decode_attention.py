@@ -1,0 +1,74 @@
+"""Decode-step cache attention kernel (VERDICT r1 item 9).
+
+Interpret-mode Pallas vs the XLA einsum reference on CPU; the compiled
+path is exercised on TPU by test_flash_attention_tpu-style gating in
+bench.py's decode rung.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas import decode_attention as DA
+
+rng = np.random.RandomState(0)
+
+
+@pytest.fixture(autouse=True)
+def _interpret():
+    from paddle_tpu.models import generation as G
+    DA._INTERPRET = True
+    G._FN_CACHE.clear()       # _INTERPRET is baked in at trace time
+    yield
+    DA._INTERPRET = False
+    G._FN_CACHE.clear()
+
+
+@pytest.mark.parametrize("nh,kvh", [(4, 4), (8, 2)])
+def test_matches_xla_reference(nh, kvh):
+    B, T, D = 2, 256, 64
+    q = jnp.asarray(rng.randn(B, nh, D).astype(np.float32)) * 0.4
+    k = jnp.asarray(rng.randn(B, kvh, T, D).astype(np.float32)) * 0.4
+    v = jnp.asarray(rng.randn(B, kvh, T, D).astype(np.float32)) * 0.4
+    pos = jnp.asarray([37, 201], jnp.int32)
+
+    got = DA.decode_attention(q, k, v, pos)
+    ref = DA._xla_decode(q, k, v, pos)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5)
+
+
+def test_respects_per_batch_positions():
+    """Entries beyond pos must not influence the output."""
+    B, T, nh, D = 1, 256, 2, 64
+    q = jnp.asarray(rng.randn(B, nh, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, nh, T, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, nh, T, D).astype(np.float32))
+    pos = jnp.asarray([10], jnp.int32)
+    out1 = DA.decode_attention(q, k, v, pos)
+    # trash the cache past pos: output must be identical
+    k2 = k.at[:, :, 11:].set(99.0)
+    v2 = v.at[:, :, 11:].set(-99.0)
+    out2 = DA.decode_attention(q, k2, v2, pos)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
+                               atol=1e-6)
+
+
+def test_generation_uses_kernel_consistently():
+    """End-to-end generate on CPU (fallback path) stays deterministic
+    after the decode-kernel wiring."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import llama_tiny, LlamaForCausalLM
+    from paddle_tpu.models.generation import generate
+
+    paddle.seed(0)
+    cfg = llama_tiny(num_hidden_layers=2, hidden_size=64,
+                     intermediate_size=128, vocab_size=128,
+                     num_attention_heads=4, num_key_value_heads=4,
+                     max_position_embeddings=128)
+    model = LlamaForCausalLM(cfg)
+    ids = paddle.to_tensor(rng.randint(0, 128, (2, 8)).astype(np.int64))
+    out1 = generate(model, ids, max_new_tokens=4)
+    out2 = generate(model, ids, max_new_tokens=4)
+    np.testing.assert_array_equal(np.asarray(out1._data),
+                                  np.asarray(out2._data))
