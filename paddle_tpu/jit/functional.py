@@ -34,6 +34,12 @@ class TrainStep:
         self._donate = donate
 
     def _build(self):
+        return jax.jit(self._pure_step(), donate_argnums=(
+            (0, 2) if self._donate else ()))
+
+    def _pure_step(self):
+        """The unjitted (params, bufs, opt_state, key, *batch) ->
+        (loss, params, bufs, opt_state) function — scannable."""
         model, optimizer, loss_fn = self.model, self.optimizer, self.loss_fn
 
         def step(params, bufs, opt_state, key, *batch):
@@ -59,8 +65,56 @@ class TrainStep:
                 finally:
                     model.load_functional_state(saved)
 
-        donate = (0, 2) if self._donate else ()
-        return jax.jit(step, donate_argnums=donate)
+        return step
+
+    def multi_step(self, n):
+        """Compile an n-step training scan: ONE device dispatch runs n
+        optimizer steps on the same batch argument (pass fresh batches
+        per call for real epochs).  This amortizes per-dispatch latency
+        — essential on tunneled/remote device transports where each
+        dispatch costs tens of ms — mirroring how the reference's
+        Executor replays a whole program per run call.
+
+            many = paddle.jit.train_step(model, opt, loss_fn).multi_step(10)
+            loss = many(x, y)     # 10 steps, one dispatch
+        """
+        pure = self._pure_step()
+
+        def many(params, bufs, opt_state, key, *batch):
+            keys = jax.random.split(key, n)
+            # step 1 runs unrolled: it materializes lazily-created
+            # optimizer accumulators so the scan carry is structure-stable
+            loss0, p, b_, o = pure(params, bufs, opt_state, keys[0],
+                                   *batch)
+            if n == 1:
+                return loss0, p, b_, o
+
+            def body(carry, k):
+                p, b_, o = carry
+                loss, p2, b2, o2 = pure(p, b_, o, k, *batch)
+                return (p2, b2, o2), loss
+
+            (p, b_, o), losses = jax.lax.scan(body, (p, b_, o), keys[1:])
+            return losses[-1], p, b_, o
+
+        jitted = jax.jit(many, donate_argnums=(
+            (0, 2) if self._donate else ()))
+        outer = self
+
+        def run(*batch):
+            params = {k: p._data for k, p in
+                      outer.model.named_parameters()}
+            bufs = {"buffers." + k: b._data
+                    for k, b in outer.model.named_buffers()}
+            opt_state = outer.optimizer.opt_state()
+            key = _random.split_key()
+            loss, new_params, new_bufs, new_opt = jitted(
+                params, bufs, opt_state, key, *_as_arrays(batch))
+            outer.model.load_functional_state({**new_params, **new_bufs})
+            outer.optimizer.load_opt_state(new_opt)
+            return Tensor(loss, stop_gradient=True)
+
+        return run
 
     def __call__(self, *batch):
         """Run one compiled step; returns the loss Tensor."""
@@ -77,9 +131,7 @@ class TrainStep:
         key = _random.split_key()
         # batch items may be arbitrary pytrees (tuples/dicts from a
         # DataLoader); Tensors become raw arrays at the leaves
-        arrays = jax.tree.map(
-            lambda b: b._data if isinstance(b, Tensor) else jnp.asarray(b),
-            list(batch), is_leaf=lambda b: isinstance(b, Tensor))
+        arrays = _as_arrays(batch)
         loss, new_params, new_bufs, new_opt = self._compiled(
             params, bufs, opt_state, key, *arrays)
         # write results back into the live objects
@@ -88,6 +140,12 @@ class TrainStep:
         if optimizer._lr_scheduler is not None:
             pass  # user steps the scheduler per paddle convention
         return Tensor(loss, stop_gradient=True)
+
+
+def _as_arrays(batch):
+    return jax.tree.map(
+        lambda b: b._data if isinstance(b, Tensor) else jnp.asarray(b),
+        list(batch), is_leaf=lambda b: isinstance(b, Tensor))
 
 
 def train_step(model, optimizer, loss_fn):
