@@ -13,6 +13,18 @@ TPU formulation: a blockwise streaming kernel pair.
   * wired together with jax.custom_vjp so jax.grad never materializes
     the quadratic score matrix (the OOM the naive path hits at 2k+ seq).
 
+Arbitrary sequence lengths: the wrapper pads Sq/Sk up to block multiples
+and bakes the REAL lengths into the kernels as static constants; tail
+K columns are masked in-kernel, padded Q rows produce finite garbage
+that is sliced off (their cotangents are zero in backward, so they
+contribute nothing to dK/dV).  Sq != Sk causal uses the reference's
+bottom-right alignment (row i sees keys <= i + Sk - Sq); rows with no
+visible key (Sq > Sk) emit zeros, matching the flash contract.
+
+Grouped-query attention runs in-kernel: the K/V BlockSpec index map
+sends q-head h to kv-head h // group, so K/V are never materialized at
+q-head width.  dK/dV are emitted per q-head and group-summed outside.
+
 The XLA fallback (`_xla_sdpa`) keeps full semantics (arbitrary masks,
 dropout) and is numerically the flash reference: fp32 softmax, input
 dtype matmuls.
@@ -26,6 +38,12 @@ import jax.numpy as jnp
 import numpy as np
 
 NUM_LANES = 128
+# Finite stand-in for -inf so blockwise max/exp arithmetic never forms
+# (-inf) - (-inf): masked logits underflow exp() to exactly 0.
+MASK_VAL = -0.7 * float(np.finfo(np.float32).max)
+# lse sentinel for rows with no visible key: exp(s - BIG) == 0 for any
+# representable s, so backward treats the whole row as zero-probability.
+LSE_INVALID = float(np.finfo(np.float32).max) * 0.5
 
 
 def _ab_t(a, b):
@@ -62,16 +80,31 @@ def _xla_sdpa(q, k, v, attn_mask=None, is_causal=False, dropout_p=0.0,
         vh = jnp.repeat(vh, rep, axis=1)
     logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
                         preferred_element_type=jnp.float32) * scale
+    masked = None
     if is_causal:
         sq, sk = logits.shape[-2], logits.shape[-1]
         cmask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
         logits = jnp.where(cmask, logits, -jnp.inf)
+        masked = jnp.broadcast_to(cmask, logits.shape)
     if attn_mask is not None:
         if attn_mask.dtype == jnp.bool_:
             logits = jnp.where(attn_mask, logits, -jnp.inf)
+            am = jnp.broadcast_to(attn_mask, logits.shape)
+            masked = am if masked is None else masked & am
         else:
             logits = logits + attn_mask.astype(logits.dtype)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if masked is not None:
+        # rows with no visible key softmax over all -inf -> NaN in BOTH
+        # directions (the softmax VJP turns NaN*0 cotangents into NaN);
+        # rewrite those rows to finite logits first, then zero the probs,
+        # so forward AND backward match the flash kernels' zero-row
+        # convention
+        row_ok = jnp.any(masked, axis=-1, keepdims=True)
+        logits = jnp.where(row_ok, logits, jnp.zeros((), logits.dtype))
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        probs = jnp.where(row_ok, probs, jnp.zeros((), probs.dtype))
+    else:
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     if dropout_p > 0.0 and training:
         from ...framework import random as _random
         keep = jax.random.bernoulli(
@@ -116,20 +149,34 @@ def _probe_pallas():
     global _PALLAS_OK
     if _PALLAS_OK is None:
         def smoke():
-            z = jnp.zeros((1, 256, 1, 64), jnp.bfloat16)
+            # ragged seq (tail-masked) + GQA (2 q heads per kv head) +
+            # causal: exercises every generalized code path
+            q = jnp.zeros((1, 320, 2, 64), jnp.bfloat16)
+            z = jnp.zeros((1, 320, 1, 64), jnp.bfloat16)
             # grad wrt q, k AND v so none of the three bwd kernels is
             # dead code the jaxpr DCE could skip lowering for
             jax.jit(jax.grad(
                 lambda q, k, v: jnp.sum(_pallas_sdpa(q, k, v, True)
                                         .astype(jnp.float32)),
-                argnums=(0, 1, 2)))(z, z, z)[0].block_until_ready()
+                argnums=(0, 1, 2)))(q, z, z)[0].block_until_ready()
             # the no-grad path uses the separate need_lse=False forward
             # variant; compile that too
             jax.jit(lambda q: _pallas_sdpa(q, z, z, True))(
-                z).block_until_ready()
+                q).block_until_ready()
 
         _PALLAS_OK = run_probe(smoke)
     return _PALLAS_OK
+
+
+def _pad_len(s, mult=256):
+    return max(mult, -(-s // mult) * mult)
+
+
+def _pad_seq(x, target):
+    s = x.shape[1]
+    if s == target:
+        return x
+    return jnp.pad(x, ((0, 0), (0, target - s), (0, 0), (0, 0)))
 
 
 def sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
@@ -144,15 +191,14 @@ def sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
         flashmask;
       * a floating attn_mask [B|1, H|1, Sq, Sk] becomes the dense-bias
         kernel (streamed blockwise, no softmax residuals).
-    Anything else (dropout, arbitrary bool masks, odd shapes) falls back
-    to the XLA path."""
+    Sequence lengths are arbitrary (>= 128): inputs are padded to block
+    multiples and the tails masked in-kernel.  Anything else (dropout,
+    arbitrary bool masks, tiny shapes) falls back to the XLA path."""
     shapes_ok = (
         dropout_p == 0.0
         and q.dtype == k.dtype == v.dtype   # kernels matmul in input dtype
         and q.shape[-1] in (64, 128, 256)
-        and q.shape[1] >= 256 and q.shape[1] % 256 == 0
-        and k.shape[1] % 256 == 0
-        and (not is_causal or q.shape[1] == k.shape[1])
+        and q.shape[1] >= 128 and k.shape[1] >= 128
         and jax.default_backend() not in ("cpu",))
 
     mask_vecs = flashmask
@@ -179,7 +225,7 @@ def sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
                 return _pallas_sdpa_biased(q, k, v, bias, is_causal)
             return _pallas_sdpa(q, k, v, is_causal)
         except Exception:
-            pass
+            _warn_fallback_once()
     if attn_mask is None and flashmask is not None:
         # keep flashmask semantics on the fallback path (dense, O(S^2)).
         # Additive -1e9 (not bool -inf) keeps fully-masked rows finite;
@@ -197,56 +243,116 @@ def sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
                      dropout_p=dropout_p, training=training)
 
 
+_WARNED_FALLBACK = False
+
+
+def _warn_fallback_once():
+    """A pallas trace/compile failure silently degrading to the XLA path
+    is a perf bug magnet (advisor r2): surface it once."""
+    global _WARNED_FALLBACK
+    if not _WARNED_FALLBACK:
+        _WARNED_FALLBACK = True
+        import logging
+        import traceback
+        logging.getLogger("paddle_tpu").warning(
+            "pallas flash-attention raised at trace time; falling back "
+            "to the XLA path for this and similar calls:\n%s",
+            traceback.format_exc())
+
+
 def _pallas_sdpa(q, k, v, causal):
-    """[B, S, H, D] wrapper: GQA head-repeat + layout transposes live
-    outside the custom_vjp, so their VJPs (sum over repeats / transpose)
-    are handled by jax."""
-    qt, kt, vt = _gqa_bhsd(q, k, v)
-    out = flash_mha(qt, kt, vt, causal, 1.0 / np.sqrt(q.shape[-1]))
-    return jnp.swapaxes(out, 1, 2)
-
-
-def _gqa_bhsd(q, k, v):
-    h, hk = q.shape[2], k.shape[2]
-    if hk != h:
-        k = jnp.repeat(k, h // hk, axis=2)
-        v = jnp.repeat(v, h // hk, axis=2)
-    return (jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
-            jnp.swapaxes(v, 1, 2))
+    """[B, S, H, D] wrapper: pads seqlens to block multiples and
+    transposes to [B, H, S, D]; the pad/slice VJPs (zero-pad the
+    cotangent / slice the grad) are handled by jax outside custom_vjp."""
+    sq, sk = q.shape[1], k.shape[1]
+    sq_p, sk_p = _pad_len(sq), _pad_len(sk)
+    qt = jnp.swapaxes(_pad_seq(q, sq_p), 1, 2)
+    kt = jnp.swapaxes(_pad_seq(k, sk_p), 1, 2)
+    vt = jnp.swapaxes(_pad_seq(v, sk_p), 1, 2)
+    out = flash_mha(qt, kt, vt, causal, 1.0 / np.sqrt(q.shape[-1]), sq, sk)
+    return jnp.swapaxes(out, 1, 2)[:, :sq]
 
 
 def _pallas_sdpa_masked(q, k, v, mask_vecs, causal):
-    from .flash_mask import flash_mha_masked
+    from .flash_mask import flash_mha_masked, pad_intervals
+    sq, sk = q.shape[1], k.shape[1]
+    sq_p, sk_p = _pad_len(sq), _pad_len(sk)
     h, hm = q.shape[2], mask_vecs.shape[1]
     if hm not in (1, h):                 # per-kv-head mask under GQA
         mask_vecs = jnp.repeat(mask_vecs, h // hm, axis=1)
-    qt, kt, vt = _gqa_bhsd(q, k, v)
+    mask_vecs = pad_intervals(mask_vecs, sk_p, sq_p)
+    qt = jnp.swapaxes(_pad_seq(q, sq_p), 1, 2)
+    kt = jnp.swapaxes(_pad_seq(k, sk_p), 1, 2)
+    vt = jnp.swapaxes(_pad_seq(v, sk_p), 1, 2)
     out = flash_mha_masked(qt, kt, vt, mask_vecs, causal,
-                           1.0 / np.sqrt(q.shape[-1]))
-    return jnp.swapaxes(out, 1, 2)
+                           1.0 / np.sqrt(q.shape[-1]), sq, sk)
+    return jnp.swapaxes(out, 1, 2)[:, :sq]
 
 
 def _pallas_sdpa_biased(q, k, v, bias, causal):
     from .flash_mask import flash_mha_biased
+    sq, sk = q.shape[1], k.shape[1]
+    sq_p, sk_p = _pad_len(sq), _pad_len(sk)
     h, hb = q.shape[2], bias.shape[1]
     if hb not in (1, h):
         bias = jnp.repeat(bias, h // hb, axis=1)
-    qt, kt, vt = _gqa_bhsd(q, k, v)
+    if (sq_p, sk_p) != (sq, sk):
+        # padded K columns masked via the bias itself (finite large-neg)
+        bias = jnp.pad(bias, ((0, 0), (0, 0), (0, sq_p - sq),
+                              (0, sk_p - sk)), constant_values=-1e9)
+    qt = jnp.swapaxes(_pad_seq(q, sq_p), 1, 2)
+    kt = jnp.swapaxes(_pad_seq(k, sk_p), 1, 2)
+    vt = jnp.swapaxes(_pad_seq(v, sk_p), 1, 2)
     out = flash_mha_biased(qt, kt, vt, bias, causal,
-                           1.0 / np.sqrt(q.shape[-1]))
-    return jnp.swapaxes(out, 1, 2)
+                           1.0 / np.sqrt(q.shape[-1]), sq, sk)
+    return jnp.swapaxes(out, 1, 2)[:, :sq]
+
+
+def _visible(q_ids, k_ids, causal, sk_real, ko):
+    """The mask every kernel shares: tail K columns are invisible, and
+    causal visibility is bottom-right aligned (offset ko = sk - sq)."""
+    vis = k_ids < sk_real
+    if causal:
+        vis &= k_ids <= q_ids + ko
+    return vis
+
+
+def _q_trip_count(q_blk, bq, block_k, causal, sq_real, sk_real):
+    """K-block trip count for a Q-block program (fwd/dq/dbias grids):
+    skips the padded K tail, the causal upper triangle, and — when the
+    whole Q block is padding — everything."""
+    nblk = -(-sk_real // block_k)
+    if causal:
+        ko = sk_real - sq_real
+        upper = jnp.clip(
+            (q_blk * bq + bq + ko + block_k - 1) // block_k, 0, nblk)
+    else:
+        upper = nblk
+    return jnp.where(q_blk * bq >= sq_real, 0, upper)
+
+
+def _k_trip_bounds(k_blk, bk, block_q, causal, sq_real, sk_real):
+    """(lower, upper) Q-block bounds for a K-block program (dkv grid):
+    skips the causal lower triangle, the padded Q tail (zero cotangent),
+    and fully-padded K blocks."""
+    nblk = -(-sq_real // block_q)
+    if causal:
+        ko = sk_real - sq_real
+        lower = jnp.clip((k_blk * bk - ko) // block_q, 0, nblk)
+    else:
+        lower = 0
+    return jnp.where(k_blk * bk >= sk_real, nblk, lower), nblk
 
 
 # ---------------------------------------------------------------- forward
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, block_k,
-                sm_scale):
+                sm_scale, sq_real, sk_real):
     # lse_ref is None for the inference-only variant (no residual needed)
     from jax.experimental import pallas as pl
 
     q = q_ref[...]                                         # [bq, d]
     bq, d = q.shape
-    kv_len = k_ref.shape[0]
-    nblk = kv_len // block_k
+    ko = sk_real - sq_real
     q_blk = pl.program_id(2)
 
     def body(i, carry):
@@ -254,12 +360,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, block_k,
         k = k_ref[pl.dslice(i * block_k, block_k), :]
         v = v_ref[pl.dslice(i * block_k, block_k), :]
         s = _ab_t(q, k) * jnp.float32(sm_scale)
-        if causal:
-            q_ids = q_blk * bq + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 0)
-            k_ids = i * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
-            s = jnp.where(q_ids >= k_ids, s, -jnp.inf)
+        q_ids = q_blk * bq + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 0)
+        k_ids = i * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        s = jnp.where(_visible(q_ids, k_ids, causal, sk_real, ko),
+                      s, MASK_VAL)
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
         alpha = jnp.exp(m_prev - m_cur)
         p = jnp.exp(s - m_cur[:, None])
@@ -268,35 +374,42 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, block_k,
         return acc, m_cur, l_cur
 
     acc0 = jnp.zeros((bq, d), jnp.float32)
-    m0 = jnp.full((bq,), -jnp.inf, jnp.float32)
+    m0 = jnp.full((bq,), MASK_VAL, jnp.float32)
     l0 = jnp.zeros((bq,), jnp.float32)
-    if causal:
-        upper = ((q_blk + 1) * bq + block_k - 1) // block_k
-    else:
-        upper = nblk
+    upper = _q_trip_count(q_blk, bq, block_k, causal, sq_real, sk_real)
     acc, m, l = jax.lax.fori_loop(0, upper, body, (acc0, m0, l0))
-    o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
+    # rows with no visible key (causal with sq > sk, or padded rows when
+    # upper == 0): m stayed at MASK_VAL -> emit zeros, poison-free
+    row_ok = (m > MASK_VAL * 0.5) & (l > 0.0)
+    o_ref[...] = jnp.where(row_ok[:, None], acc / jnp.where(
+        row_ok, l, 1.0)[:, None], 0.0).astype(o_ref.dtype)
     if lse_ref is not None:
-        lse = m + jnp.log(l)
+        lse = jnp.where(row_ok, m + jnp.log(jnp.where(row_ok, l, 1.0)),
+                        LSE_INVALID)
         lse_ref[...] = jnp.broadcast_to(lse[:, None], (bq, NUM_LANES))
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k,
-               need_lse=True):
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, sq_real,
+               sk_real, need_lse=True):
     # jax 0.9.0: Mosaic lowering infinitely recurses under jax_enable_x64
     # (the framework's global default); trace the kernel in 32-bit mode.
     with jax.enable_x64(False):
         return _flash_fwd_x32(q, k, v, causal, sm_scale, block_q, block_k,
-                              need_lse)
+                              sq_real, sk_real, need_lse)
 
 
-def _flash_fwd_x32(q, k, v, causal, sm_scale, block_q, block_k, need_lse):
+def _flash_fwd_x32(q, k, v, causal, sm_scale, block_q, block_k, sq_real,
+                   sk_real, need_lse):
     from jax.experimental import pallas as pl
 
     b, h, sq, d = q.shape
+    hk = k.shape[1]
+    g = h // hk                           # q heads per kv head (GQA)
     sk = k.shape[2]
     blk = pl.BlockSpec((None, None, block_q, d),
                        lambda b_, h_, i: (b_, h_, i, 0))
+    kv = pl.BlockSpec((None, None, sk, d),
+                      lambda b_, h_, i: (b_, h_ // g, 0, 0))
     out_specs = [blk]
     out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
     if need_lse:
@@ -305,19 +418,14 @@ def _flash_fwd_x32(q, k, v, causal, sm_scale, block_q, block_k, need_lse):
         out_shape.append(
             jax.ShapeDtypeStruct((b, h, sq, NUM_LANES), jnp.float32))
     kernel = functools.partial(_fwd_kernel, causal=causal, block_k=block_k,
-                               sm_scale=sm_scale)
+                               sm_scale=sm_scale, sq_real=sq_real,
+                               sk_real=sk_real)
     res = pl.pallas_call(
         kernel if need_lse else
         (lambda q_ref, k_ref, v_ref, o_ref: kernel(q_ref, k_ref, v_ref,
                                                    o_ref, None)),
         grid=(b, h, sq // block_q),
-        in_specs=[
-            blk,
-            pl.BlockSpec((None, None, sk, d),
-                         lambda b_, h_, i: (b_, h_, 0, 0)),
-            pl.BlockSpec((None, None, sk, d),
-                         lambda b_, h_, i: (b_, h_, 0, 0)),
-        ],
+        in_specs=[blk, kv, kv],
         out_specs=out_specs if need_lse else out_specs[0],
         out_shape=out_shape if need_lse else out_shape[0],
     )(q, k, v)
@@ -326,7 +434,7 @@ def _flash_fwd_x32(q, k, v, causal, sm_scale, block_q, block_k, need_lse):
 
 # --------------------------------------------------------------- backward
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref, *,
-                   causal, block_k, sm_scale):
+                   causal, block_k, sm_scale, sq_real, sk_real):
     from jax.experimental import pallas as pl
 
     q = q_ref[...]                                          # [bq, d]
@@ -334,39 +442,38 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref, *,
     lse = lse_ref[:, 0]                                     # [bq]
     delta = dl_ref[:, 0]
     bq, d = q.shape
-    kv_len = k_ref.shape[0]
-    nblk = kv_len // block_k
+    ko = sk_real - sq_real
     q_blk = pl.program_id(2)
 
     def body(i, dq):
         k = k_ref[pl.dslice(i * block_k, block_k), :]
         v = v_ref[pl.dslice(i * block_k, block_k), :]
         s = _ab_t(q, k) * jnp.float32(sm_scale)
-        if causal:
-            q_ids = q_blk * bq + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 0)
-            k_ids = i * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
-            s = jnp.where(q_ids >= k_ids, s, -jnp.inf)
+        q_ids = q_blk * bq + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 0)
+        k_ids = i * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        s = jnp.where(_visible(q_ids, k_ids, causal, sk_real, ko),
+                      s, MASK_VAL)
         p = jnp.exp(s - lse[:, None])                       # masked -> 0
         dp = _ab_t(do, v)
         ds = p * (dp - delta[:, None]) * jnp.float32(sm_scale)
         return dq + _ab(ds.astype(k.dtype), k)
 
-    upper = ((q_blk + 1) * bq + block_k - 1) // block_k if causal else nblk
+    upper = _q_trip_count(q_blk, bq, block_k, causal, sq_real, sk_real)
     dq = jax.lax.fori_loop(0, upper, body, jnp.zeros((bq, d), jnp.float32))
     dq_ref[...] = dq.astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dk_ref,
-                    dv_ref, *, causal, block_q, sm_scale):
+                    dv_ref, *, causal, block_q, sm_scale, sq_real, sk_real):
     from jax.experimental import pallas as pl
 
     k = k_ref[...]                                          # [bk, d]
     v = v_ref[...]
     bk, d = k.shape
     q_len = q_ref.shape[0]
-    nblk = q_len // block_q
+    ko = sk_real - sq_real
     k_blk = pl.program_id(2)
 
     def body(i, carry):
@@ -376,12 +483,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dk_ref,
         lse = lse_ref[pl.dslice(i * block_q, block_q), 0]
         delta = dl_ref[pl.dslice(i * block_q, block_q), 0]
         s = _ab_t(q, k) * jnp.float32(sm_scale)
-        if causal:
-            q_ids = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, bk), 0)
-            k_ids = k_blk * bk + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, bk), 1)
-            s = jnp.where(q_ids >= k_ids, s, -jnp.inf)
+        q_ids = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, bk), 0)
+        k_ids = k_blk * bk + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, bk), 1)
+        s = jnp.where(_visible(q_ids, k_ids, causal, sk_real, ko),
+                      s, MASK_VAL)
         p = jnp.exp(s - lse[:, None])
         dv = dv + _at_b(p.astype(do.dtype), do)
         dp = _ab_t(do, v)
@@ -389,7 +496,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dk_ref,
         dk = dk + _at_b(ds.astype(q.dtype), q)
         return dk, dv
 
-    lower = (k_blk * bk) // block_q if causal else 0
+    lower, nblk = _k_trip_bounds(k_blk, bk, block_q, causal, sq_real,
+                                 sk_real)
     dk, dv = jax.lax.fori_loop(
         lower, nblk, body,
         (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)))
@@ -397,16 +505,20 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dk_ref,
     dv_ref[...] = dv.astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k):
+def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
+               sq_real, sk_real):
     with jax.enable_x64(False):   # see _flash_fwd
         return _flash_bwd_x32(q, k, v, out, lse, g, causal, sm_scale,
-                              block_q, block_k)
+                              block_q, block_k, sq_real, sk_real)
 
 
-def _flash_bwd_x32(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k):
+def _flash_bwd_x32(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
+                   sq_real, sk_real):
     from jax.experimental import pallas as pl
 
     b, h, sq, d = q.shape
+    hk = k.shape[1]
+    grp = h // hk
     # the residual is stored un-broadcast ([B,H,S]); restore kernel tiling
     lse = jnp.broadcast_to(lse[..., None], (b, h, sq, NUM_LANES))
     sk = k.shape[2]
@@ -416,6 +528,8 @@ def _flash_bwd_x32(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k):
 
     full = lambda s: pl.BlockSpec((None, None, s, d),
                                   lambda b_, h_, i: (b_, h_, 0, 0))
+    full_kv = pl.BlockSpec((None, None, sk, d),
+                           lambda b_, h_, i: (b_, h_ // grp, 0, 0))
     full_l = pl.BlockSpec((None, None, sq, NUM_LANES),
                           lambda b_, h_, i: (b_, h_, 0, 0))
     blk_q = lambda: pl.BlockSpec((None, None, block_q, d),
@@ -425,33 +539,46 @@ def _flash_bwd_x32(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k):
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, block_k=block_k,
-                          sm_scale=sm_scale),
+                          sm_scale=sm_scale, sq_real=sq_real,
+                          sk_real=sk_real),
         grid=(b, h, sq // block_q),
-        in_specs=[blk_q(), full(sk), full(sk), blk_q(), blk_l, blk_l],
+        in_specs=[blk_q(), full_kv, full_kv, blk_q(), blk_l, blk_l],
         out_specs=blk_q(),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
     )(q, k, v, g, lse, delta)
 
     blk_k = lambda: pl.BlockSpec((None, None, block_k, d),
                                  lambda b_, h_, i: (b_, h_, i, 0))
+    kv_blk = pl.BlockSpec((None, None, block_k, d),
+                          lambda b_, h_, i: (b_, h_ // grp, i, 0))
+    # dK/dV are emitted per Q head (grid over h) and group-summed below;
+    # K/V themselves are read at kv-head width via the index map
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal, block_q=block_q,
-                          sm_scale=sm_scale),
+                          sm_scale=sm_scale, sq_real=sq_real,
+                          sk_real=sk_real),
         grid=(b, h, sk // block_k),
-        in_specs=[full(sq), blk_k(), blk_k(), full(sq), full_l, full_l],
+        in_specs=[full(sq), kv_blk, kv_blk, full(sq), full_l, full_l],
         out_specs=[blk_k(), blk_k()],
-        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
-                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((b, h, sk, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, h, sk, d), v.dtype)],
     )(q, k, v, g, lse, delta)
+    if grp > 1:
+        dk = dk.reshape(b, hk, grp, sk, d).sum(axis=2)
+        dv = dv.reshape(b, hk, grp, sk, d).sum(axis=2)
     return dq, dk, dv
 
 
 # ------------------------------------------------------------- custom_vjp
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def flash_mha(q, k, v, causal, sm_scale):
-    """[B, H, S, D] flash attention; differentiable, O(S) memory."""
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_mha(q, k, v, causal, sm_scale, sq_real, sk_real):
+    """[B, H, S, D] flash attention; differentiable, O(S) memory.
+    S dims must be block multiples (the sdpa wrapper pads); sq_real /
+    sk_real are the true lengths baked into the kernels for masking.
+    K/V may carry fewer heads than Q (GQA) — no repeat happens."""
     out, _ = _flash_fwd(q, k, v, causal, sm_scale,
                         *_block_sizes(q.shape[2], k.shape[2]),
+                        sq_real, sk_real,
                         need_lse=False)   # no-grad path: skip the residual
     return out
 
@@ -462,18 +589,20 @@ def _block_sizes(sq, sk):
     return min(bq, sq), min(bk, sk)
 
 
-def _flash_mha_fwd(q, k, v, causal, sm_scale):
+def _flash_mha_fwd(q, k, v, causal, sm_scale, sq_real, sk_real):
     out, lse = _flash_fwd(q, k, v, causal, sm_scale,
-                          *_block_sizes(q.shape[2], k.shape[2]))
+                          *_block_sizes(q.shape[2], k.shape[2]),
+                          sq_real, sk_real)
     # the lane broadcast is a Mosaic tiling artifact; keep 1/128 of it
     # as the residual and re-broadcast in the backward wrapper
     return out, (q, k, v, out, lse[..., 0])
 
 
-def _flash_mha_bwd(causal, sm_scale, res, g):
+def _flash_mha_bwd(causal, sm_scale, sq_real, sk_real, res, g):
     q, k, v, out, lse = res
     dq, dk, dv = _flash_bwd(q, k, v, out, lse, g, causal, sm_scale,
-                            *_block_sizes(q.shape[2], k.shape[2]))
+                            *_block_sizes(q.shape[2], k.shape[2]),
+                            sq_real, sk_real)
     return dq, dk, dv
 
 

@@ -30,10 +30,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .flash_attention import _ab, _ab_t, _at_b, NUM_LANES
+from .flash_attention import (_ab, _ab_t, _at_b, _visible,
+                              _q_trip_count, _k_trip_bounds, NUM_LANES)
 
 __all__ = ["flash_mha_masked", "flash_mha_biased", "padding_mask_to_intervals",
-           "sliding_window_intervals", "segment_intervals"]
+           "sliding_window_intervals", "segment_intervals", "pad_intervals"]
 
 
 # ------------------------------------------------------------ mask helpers
@@ -83,6 +84,17 @@ def segment_intervals(segment_ids, causal=True):
     return vec[:, None]
 
 
+def pad_intervals(mask_vecs, sk_padded, sq_padded):
+    """Extend mask_vecs [B|1, H|1, nvec, Sk] to a padded key length.
+    Tail values are irrelevant — every kernel masks k_ids >= sk_real
+    itself — only the padded SHAPE matters for the BlockSpecs."""
+    vec = jnp.asarray(mask_vecs)
+    pad = sk_padded - vec.shape[-1]
+    if pad <= 0:
+        return vec
+    return jnp.pad(vec, ((0, 0), (0, 0), (0, 0), (0, pad)))
+
+
 def _mask_spec(mask_vecs, sk):
     """BlockSpec for [B|1, H|1, nvec, Sk] mask arrays (broadcast-aware)."""
     from jax.experimental import pallas as pl
@@ -125,7 +137,7 @@ def _mask_block(s, mask_ref, q_ids, col0, ncols, nvec):
 
 # ---------------------------------------------------------------- forward
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, block_k, sm_scale,
-                nvec, has_bias, need_lse):
+                nvec, has_bias, need_lse, sq_real, sk_real):
     from jax.experimental import pallas as pl
 
     it = iter(rest)
@@ -136,8 +148,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, block_k, sm_scale,
 
     q = q_ref[...]                                         # [bq, d]
     bq, d = q.shape
-    kv_len = k_ref.shape[0]
-    nblk = kv_len // block_k
+    ko = sk_real - sq_real              # bottom-right causal alignment
     q_blk = pl.program_id(2)
 
     def body(i, carry):
@@ -150,10 +161,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, block_k, sm_scale,
                 jnp.float32)
         q_ids = q_blk * bq + jax.lax.broadcasted_iota(
             jnp.int32, (bq, block_k), 0)
-        if causal:
-            k_ids = i * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
-            s = jnp.where(q_ids >= k_ids, s, -jnp.inf)
+        k_ids = i * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        s = jnp.where(_visible(q_ids, k_ids, causal, sk_real, ko),
+                      s, -jnp.inf)
         if nvec:
             s = _mask_block(s, mask_ref, q_ids, i * block_k, block_k, nvec)
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
@@ -168,10 +179,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, block_k, sm_scale,
     acc0 = jnp.zeros((bq, d), jnp.float32)
     m0 = jnp.full((bq,), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((bq,), jnp.float32)
-    if causal:
-        upper = ((q_blk + 1) * bq + block_k - 1) // block_k
-    else:
-        upper = nblk
+    upper = _q_trip_count(q_blk, bq, block_k, causal, sq_real, sk_real)
     acc, m, l = jax.lax.fori_loop(0, upper, body, (acc0, m0, l0))
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o_ref[...] = (acc / l_safe[:, None]).astype(o_ref.dtype)
@@ -181,20 +189,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, block_k, sm_scale,
 
 
 def _masked_fwd(q, k, v, mask_vecs, bias, causal, sm_scale, block_q,
-                block_k, need_lse=True, interpret=False):
+                block_k, sq_real, sk_real, need_lse=True, interpret=False):
     from jax.experimental import pallas as pl
 
     b, h, sq, d = q.shape
+    g = h // k.shape[1]                  # q heads per kv head (GQA)
     sk = k.shape[2]
     nvec = mask_vecs.shape[2] if mask_vecs is not None else 0
     has_bias = bias is not None
     blk = pl.BlockSpec((None, None, block_q, d),
                        lambda b_, h_, i: (b_, h_, i, 0))
-    in_specs = [
-        blk,
-        pl.BlockSpec((None, None, sk, d), lambda b_, h_, i: (b_, h_, 0, 0)),
-        pl.BlockSpec((None, None, sk, d), lambda b_, h_, i: (b_, h_, 0, 0)),
-    ]
+    kv = pl.BlockSpec((None, None, sk, d),
+                      lambda b_, h_, i: (b_, h_ // g, 0, 0))
+    in_specs = [blk, kv, kv]
     args = [q, k, v]
     if nvec:
         in_specs.append(_mask_spec(mask_vecs, sk))
@@ -211,7 +218,8 @@ def _masked_fwd(q, k, v, mask_vecs, bias, causal, sm_scale, block_q,
             jax.ShapeDtypeStruct((b, h, sq, NUM_LANES), jnp.float32))
     kernel = functools.partial(_fwd_kernel, causal=causal, block_k=block_k,
                                sm_scale=sm_scale, nvec=nvec,
-                               has_bias=has_bias, need_lse=need_lse)
+                               has_bias=has_bias, need_lse=need_lse,
+                               sq_real=sq_real, sk_real=sk_real)
     with jax.enable_x64(False):   # see flash_attention._flash_fwd
         res = pl.pallas_call(
             kernel, grid=(b, h, sq // block_q),
@@ -225,7 +233,8 @@ def _masked_fwd(q, k, v, mask_vecs, bias, causal, sm_scale, block_q,
 
 # --------------------------------------------------------------- backward
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, *rest,
-                   causal, block_k, sm_scale, nvec, has_bias):
+                   causal, block_k, sm_scale, nvec, has_bias, sq_real,
+                   sk_real):
     from jax.experimental import pallas as pl
 
     it = iter(rest)
@@ -238,8 +247,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, *rest,
     lse = _safe(lse_ref[:, 0])
     delta = dl_ref[:, 0]
     bq, d = q.shape
-    kv_len = k_ref.shape[0]
-    nblk = kv_len // block_k
+    ko = sk_real - sq_real
     q_blk = pl.program_id(2)
 
     def body(i, dq):
@@ -251,10 +259,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, *rest,
                 jnp.float32)
         q_ids = q_blk * bq + jax.lax.broadcasted_iota(
             jnp.int32, (bq, block_k), 0)
-        if causal:
-            k_ids = i * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
-            s = jnp.where(q_ids >= k_ids, s, -jnp.inf)
+        k_ids = i * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        s = jnp.where(_visible(q_ids, k_ids, causal, sk_real, ko),
+                      s, -jnp.inf)
         if nvec:
             s = _mask_block(s, mask_ref, q_ids, i * block_k, block_k, nvec)
         p = jnp.exp(s - lse[:, None])                       # masked -> 0
@@ -262,13 +270,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, *rest,
         ds = p * (dp - delta[:, None]) * jnp.float32(sm_scale)
         return dq + _ab(ds.astype(k.dtype), k)
 
-    upper = ((q_blk + 1) * bq + block_k - 1) // block_k if causal else nblk
+    upper = _q_trip_count(q_blk, bq, block_k, causal, sq_real, sk_real)
     dq = jax.lax.fori_loop(0, upper, body, jnp.zeros((bq, d), jnp.float32))
     dq_ref[...] = dq.astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, *rest,
-                    causal, block_q, sm_scale, nvec, has_bias):
+                    causal, block_q, sm_scale, nvec, has_bias, sq_real,
+                    sk_real):
     from jax.experimental import pallas as pl
 
     it = iter(rest)
@@ -280,8 +289,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, *rest,
     k = k_ref[...]
     v = v_ref[...]
     bk, d = k.shape
-    q_len = q_ref.shape[0]
-    nblk = q_len // block_q
+    ko = sk_real - sq_real
     k_blk = pl.program_id(2)
 
     def body(i, carry):
@@ -296,10 +304,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, *rest,
                              pl.dslice(k_blk * bk, bk)].astype(jnp.float32)
         q_ids = i * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, bk), 0)
-        if causal:
-            k_ids = k_blk * bk + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, bk), 1)
-            s = jnp.where(q_ids >= k_ids, s, -jnp.inf)
+        k_ids = k_blk * bk + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, bk), 1)
+        s = jnp.where(_visible(q_ids, k_ids, causal, sk_real, ko),
+                      s, -jnp.inf)
         if nvec:
             # this kernel's block covers k columns [k_blk*bk, k_blk*bk+bk)
             s = _mask_block(s, mask_ref, q_ids, 0, bk, nvec)
@@ -310,7 +318,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, *rest,
         dk = dk + _at_b(ds.astype(q.dtype), q)
         return dk, dv
 
-    lower = (k_blk * bk) // block_q if causal else 0
+    lower, nblk = _k_trip_bounds(k_blk, bk, block_q, causal, sq_real,
+                                 sk_real)
     dk, dv = jax.lax.fori_loop(
         lower, nblk, body,
         (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)))
@@ -319,7 +328,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, *rest,
 
 
 def _bwd_dbias_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, *rest,
-                      causal, block_k, sm_scale, nvec):
+                      causal, block_k, sm_scale, nvec, sq_real, sk_real):
     """ds per q block, written to a [block_q, Sk] dbias row; its own
     pallas_call so constant-bias training DCEs the whole pass."""
     from jax.experimental import pallas as pl
@@ -334,8 +343,7 @@ def _bwd_dbias_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, *rest,
     lse = _safe(lse_ref[:, 0])
     delta = dl_ref[:, 0]
     bq, d = q.shape
-    kv_len = k_ref.shape[0]
-    nblk = kv_len // block_k
+    ko = sk_real - sq_real
     q_blk = pl.program_id(2)
     dbias_ref[...] = jnp.zeros_like(dbias_ref)
 
@@ -347,10 +355,10 @@ def _bwd_dbias_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, *rest,
             jnp.float32)
         q_ids = q_blk * bq + jax.lax.broadcasted_iota(
             jnp.int32, (bq, block_k), 0)
-        if causal:
-            k_ids = i * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
-            s = jnp.where(q_ids >= k_ids, s, -jnp.inf)
+        k_ids = i * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        s = jnp.where(_visible(q_ids, k_ids, causal, sk_real, ko),
+                      s, -jnp.inf)
         if nvec:
             s = _mask_block(s, mask_ref, q_ids, i * block_k, block_k, nvec)
         p = jnp.exp(s - lse[:, None])
@@ -360,15 +368,18 @@ def _bwd_dbias_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, *rest,
             ds.astype(dbias_ref.dtype)
         return 0
 
-    upper = ((q_blk + 1) * bq + block_k - 1) // block_k if causal else nblk
+    upper = _q_trip_count(q_blk, bq, block_k, causal, sq_real, sk_real)
     jax.lax.fori_loop(0, upper, body, 0)
 
 
 def _masked_bwd(q, k, v, out, lse, g, mask_vecs, bias, causal, sm_scale,
-                block_q, block_k, need_dbias, interpret=False):
+                block_q, block_k, sq_real, sk_real, need_dbias,
+                interpret=False):
     from jax.experimental import pallas as pl
 
     b, h, sq, d = q.shape
+    hk = k.shape[1]
+    grp = h // hk
     sk = k.shape[2]
     nvec = mask_vecs.shape[2] if mask_vecs is not None else 0
     has_bias = bias is not None
@@ -379,6 +390,8 @@ def _masked_bwd(q, k, v, out, lse, g, mask_vecs, bias, causal, sm_scale,
 
     full = lambda s: pl.BlockSpec((None, None, s, d),          # noqa: E731
                                   lambda b_, h_, i: (b_, h_, 0, 0))
+    full_kv = pl.BlockSpec((None, None, sk, d),
+                           lambda b_, h_, i: (b_, h_ // grp, 0, 0))
     full_l = pl.BlockSpec((None, None, sq, NUM_LANES),
                           lambda b_, h_, i: (b_, h_, 0, 0))
     blk_q = pl.BlockSpec((None, None, block_q, d),
@@ -396,9 +409,10 @@ def _masked_bwd(q, k, v, out, lse, g, mask_vecs, bias, causal, sm_scale,
         dq = pl.pallas_call(
             functools.partial(
                 _bwd_dq_kernel, causal=causal, block_k=block_k,
-                sm_scale=sm_scale, nvec=nvec, has_bias=has_bias),
+                sm_scale=sm_scale, nvec=nvec, has_bias=has_bias,
+                sq_real=sq_real, sk_real=sk_real),
             grid=(b, h, sq // block_q),
-            in_specs=[blk_q, full(sk), full(sk), blk_q, blk_l, blk_l]
+            in_specs=[blk_q, full_kv, full_kv, blk_q, blk_l, blk_l]
             + tail_specs
             + ([_bias_spec(bias, block_q, sk)] if has_bias else []),
             out_specs=blk_q,
@@ -409,6 +423,8 @@ def _masked_bwd(q, k, v, out, lse, g, mask_vecs, bias, causal, sm_scale,
 
         blk_k = pl.BlockSpec((None, None, block_k, d),
                              lambda b_, h_, i: (b_, h_, i, 0))
+        kv_blk = pl.BlockSpec((None, None, block_k, d),
+                              lambda b_, h_, i: (b_, h_ // grp, i, 0))
         kv_tail_specs = []
         if nvec:
             bb, hb = mask_vecs.shape[0], mask_vecs.shape[1]
@@ -416,30 +432,36 @@ def _masked_bwd(q, k, v, out, lse, g, mask_vecs, bias, causal, sm_scale,
                 (None, None, nvec, block_k),
                 lambda b_, h_, i, _bb=bb, _hb=hb:
                 (b_ if _bb > 1 else 0, h_ if _hb > 1 else 0, 0, i)))
+        # dK/dV emitted per Q head (grid over h), group-summed below
         dk, dv = pl.pallas_call(
             functools.partial(
                 _bwd_dkv_kernel, causal=causal, block_q=block_q,
-                sm_scale=sm_scale, nvec=nvec, has_bias=has_bias),
+                sm_scale=sm_scale, nvec=nvec, has_bias=has_bias,
+                sq_real=sq_real, sk_real=sk_real),
             grid=(b, h, sk // block_k),
-            in_specs=[full(sq), blk_k, blk_k, full(sq), full_l, full_l]
+            in_specs=[full(sq), kv_blk, kv_blk, full(sq), full_l, full_l]
             + kv_tail_specs
             + ([_bias_spec(bias, block_q, sk, blocked=False)]
                if has_bias else []),
             out_specs=[blk_k, blk_k],
-            out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
-                       jax.ShapeDtypeStruct(v.shape, v.dtype)],
+            out_shape=[jax.ShapeDtypeStruct((b, h, sk, d), k.dtype),
+                       jax.ShapeDtypeStruct((b, h, sk, d), v.dtype)],
             interpret=interpret,
         )(q, k, v, g, lse_b, delta,
           *(tail_args + ([bias] if has_bias else [])))
+        if grp > 1:
+            dk = dk.reshape(b, hk, grp, sk, d).sum(axis=2)
+            dv = dv.reshape(b, hk, grp, sk, d).sum(axis=2)
 
         dbias = None
         if need_dbias:
             dbias_full = pl.pallas_call(
                 functools.partial(
                     _bwd_dbias_kernel, causal=causal, block_k=block_k,
-                    sm_scale=sm_scale, nvec=nvec),
+                    sm_scale=sm_scale, nvec=nvec,
+                    sq_real=sq_real, sk_real=sk_real),
                 grid=(b, h, sq // block_q),
-                in_specs=[blk_q, full(sk), full(sk), blk_q, blk_l, blk_l]
+                in_specs=[blk_q, full_kv, full_kv, blk_q, blk_l, blk_l]
                 + tail_specs + [_bias_spec(bias, block_q, sk)],
                 out_specs=pl.BlockSpec((None, None, block_q, sk),
                                        lambda b_, h_, i: (b_, h_, i, 0)),
@@ -467,29 +489,41 @@ def _blocks(sq, sk):
     return _block_sizes(sq, sk)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def flash_mha_masked(q, k, v, mask_vecs, causal, sm_scale):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def flash_mha_masked(q, k, v, mask_vecs, causal, sm_scale, sq_real=None,
+                     sk_real=None):
     """[B, H, S, D] flash attention with the flashmask column-interval
     encoding (mask_vecs [B|1, H|1, 2 or 4, Sk] int32); differentiable,
-    O(S) mask memory."""
+    O(S) mask memory.  S dims must be block multiples (the sdpa wrapper
+    pads and extends mask_vecs via pad_intervals); sq_real/sk_real are
+    the true lengths.  K/V may carry fewer heads than Q (GQA)."""
+    sq_real = sq_real if sq_real is not None else q.shape[2]
+    sk_real = sk_real if sk_real is not None else k.shape[2]
     out, _ = _masked_fwd(q, k, v, mask_vecs, None, causal, sm_scale,
-                         *_blocks(q.shape[2], k.shape[2]), need_lse=False,
+                         *_blocks(q.shape[2], k.shape[2]),
+                         sq_real, sk_real, need_lse=False,
                          interpret=_INTERPRET)
     return out
 
 
-def _masked_vjp_fwd(q, k, v, mask_vecs, causal, sm_scale):
+def _masked_vjp_fwd(q, k, v, mask_vecs, causal, sm_scale, sq_real,
+                    sk_real):
+    sq_real = sq_real if sq_real is not None else q.shape[2]
+    sk_real = sk_real if sk_real is not None else k.shape[2]
     out, lse = _masked_fwd(q, k, v, mask_vecs, None, causal, sm_scale,
                            *_blocks(q.shape[2], k.shape[2]),
-                           interpret=_INTERPRET)
+                           sq_real, sk_real, interpret=_INTERPRET)
     return out, (q, k, v, mask_vecs, out, lse[..., 0])
 
 
-def _masked_vjp_bwd(causal, sm_scale, res, g):
+def _masked_vjp_bwd(causal, sm_scale, sq_real, sk_real, res, g):
     q, k, v, mask_vecs, out, lse = res
+    sq_real = sq_real if sq_real is not None else q.shape[2]
+    sk_real = sk_real if sk_real is not None else k.shape[2]
     dq, dk, dv, _ = _masked_bwd(q, k, v, out, lse, g, mask_vecs, None,
                                 causal, sm_scale,
                                 *_blocks(q.shape[2], k.shape[2]),
+                                sq_real, sk_real,
                                 need_dbias=False, interpret=_INTERPRET)
     return dq, dk, dv, None
 
@@ -497,29 +531,40 @@ def _masked_vjp_bwd(causal, sm_scale, res, g):
 flash_mha_masked.defvjp(_masked_vjp_fwd, _masked_vjp_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def flash_mha_biased(q, k, v, bias, causal, sm_scale):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def flash_mha_biased(q, k, v, bias, causal, sm_scale, sq_real=None,
+                     sk_real=None):
     """[B, H, S, D] flash attention with a dense additive bias
     [B|1, H|1, Sq, Sk]; differentiable (dbias materializes a
-    [B,H,Sq,Sk] f32 transient only when the bias needs a gradient)."""
+    [B,H,Sq,Sk] f32 transient only when the bias needs a gradient).
+    S dims must be block multiples (the sdpa wrapper pads the bias with
+    -1e9 on the key tail); sq_real/sk_real are the true lengths."""
+    sq_real = sq_real if sq_real is not None else q.shape[2]
+    sk_real = sk_real if sk_real is not None else k.shape[2]
     out, _ = _masked_fwd(q, k, v, None, bias, causal, sm_scale,
-                         *_blocks(q.shape[2], k.shape[2]), need_lse=False,
+                         *_blocks(q.shape[2], k.shape[2]),
+                         sq_real, sk_real, need_lse=False,
                          interpret=_INTERPRET)
     return out
 
 
-def _biased_vjp_fwd(q, k, v, bias, causal, sm_scale):
+def _biased_vjp_fwd(q, k, v, bias, causal, sm_scale, sq_real, sk_real):
+    sq_real = sq_real if sq_real is not None else q.shape[2]
+    sk_real = sk_real if sk_real is not None else k.shape[2]
     out, lse = _masked_fwd(q, k, v, None, bias, causal, sm_scale,
                            *_blocks(q.shape[2], k.shape[2]),
-                           interpret=_INTERPRET)
+                           sq_real, sk_real, interpret=_INTERPRET)
     return out, (q, k, v, bias, out, lse[..., 0])
 
 
-def _biased_vjp_bwd(causal, sm_scale, res, g):
+def _biased_vjp_bwd(causal, sm_scale, sq_real, sk_real, res, g):
     q, k, v, bias, out, lse = res
+    sq_real = sq_real if sq_real is not None else q.shape[2]
+    sk_real = sk_real if sk_real is not None else k.shape[2]
     dq, dk, dv, dbias = _masked_bwd(q, k, v, out, lse, g, None, bias,
                                     causal, sm_scale,
                                     *_blocks(q.shape[2], k.shape[2]),
+                                    sq_real, sk_real,
                                     need_dbias=True, interpret=_INTERPRET)
     return dq, dk, dv, dbias
 
