@@ -89,8 +89,43 @@ def trace_key_guard(key):
         _trace.stack.pop()
 
 
+class _WatchState(threading.local):
+    def __init__(self):
+        self.active = False
+        self.used = False
+
+
+_watch = _WatchState()
+
+
+class _WatchResult:
+    __slots__ = ("used",)
+
+    def __init__(self):
+        self.used = False
+
+
+@contextlib.contextmanager
+def watch_rng_use():
+    """Record whether split_key() fires inside the scope.  Used by the
+    eager dispatch cache (ops/registry.py): an op body that consumes
+    eager randomness at trace time would bake the key into the cached
+    executable and replay the same stream forever — such ops must stay
+    on the uncached path."""
+    prev = (_watch.active, _watch.used)
+    _watch.active, _watch.used = True, False
+    res = _WatchResult()
+    try:
+        yield res
+    finally:
+        res.used = _watch.used
+        _watch.active, _watch.used = prev
+
+
 def split_key():
     """One fresh PRNG key for a random op."""
+    if _watch.active:
+        _watch.used = True
     if _trace.stack:
         entry = _trace.stack[-1]
         entry[1] += 1

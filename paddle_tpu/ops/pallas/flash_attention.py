@@ -280,7 +280,7 @@ def _pallas_sdpa_masked(q, k, v, mask_vecs, causal):
     h, hm = q.shape[2], mask_vecs.shape[1]
     if hm not in (1, h):                 # per-kv-head mask under GQA
         mask_vecs = jnp.repeat(mask_vecs, h // hm, axis=1)
-    mask_vecs = pad_intervals(mask_vecs, sk_p, sq_p)
+    mask_vecs = pad_intervals(mask_vecs, sk_p)
     qt = jnp.swapaxes(_pad_seq(q, sq_p), 1, 2)
     kt = jnp.swapaxes(_pad_seq(k, sk_p), 1, 2)
     vt = jnp.swapaxes(_pad_seq(v, sk_p), 1, 2)

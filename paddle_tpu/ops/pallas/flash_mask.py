@@ -84,7 +84,7 @@ def segment_intervals(segment_ids, causal=True):
     return vec[:, None]
 
 
-def pad_intervals(mask_vecs, sk_padded, sq_padded):
+def pad_intervals(mask_vecs, sk_padded):
     """Extend mask_vecs [B|1, H|1, nvec, Sk] to a padded key length.
     Tail values are irrelevant — every kernel masks k_ids >= sk_real
     itself — only the padded SHAPE matters for the BlockSpecs."""

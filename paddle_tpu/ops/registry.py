@@ -48,8 +48,11 @@ def _float0_zeros(aval):
     return jnp.zeros(aval.shape, aval.dtype)
 
 
-def op(fn=None, *, name: str | None = None):
-    """Register ``fn`` (a pure function of jax arrays) as a framework op."""
+def op(fn=None, *, name: str | None = None, external: bool = False):
+    """Register ``fn`` (a pure function of jax arrays) as a framework op.
+    external=True marks runtime-registered ops from outside the framework
+    op surface (custom C extensions, user plugins): they are exempt from
+    registry-wide invariants like the FD gradient sweep."""
     def deco(body):
         opname = name or body.__name__
 
@@ -59,10 +62,155 @@ def op(fn=None, *, name: str | None = None):
 
         wrapper.__op_body__ = body
         wrapper.__op_name__ = opname
+        wrapper.__op_external__ = external
         OPS[opname] = wrapper
         return wrapper
 
     return deco(fn) if fn is not None else deco
+
+
+# --------------------------------------------------- eager dispatch cache
+# The reference's whole PHI design goal is a lean eager hot path
+# (paddle/phi/README.md §1.2): its generated ad_funcs dispatch straight
+# into precompiled kernels.  Here the analog is caching a jitted
+# (forward, vjp) pair per (op, input signature): steady-state dygraph
+# training stops re-tracing `jax.vjp` on every op call.
+EAGER_CACHE_ENABLED = True
+_EAGER_CACHE: dict = {}           # signature -> jitted callable
+_EAGER_CACHE_MAX = 4096
+_UNCACHEABLE: set = set()         # ops that consume eager RNG / fail trace
+
+
+class _Unhashable(Exception):
+    pass
+
+
+def _static_fingerprint(x):
+    """Hashable key for a non-array leaf baked into a cached trace."""
+    if isinstance(x, (str, int, float, bool, complex, bytes, type(None))):
+        # type tag: True == 1 == 1.0 hash-equal, but an op whose static
+        # scalar drives output dtype must not share their cache entry
+        return (type(x).__name__, x)
+    if isinstance(x, (list, tuple)):
+        return (type(x).__name__,) + tuple(_static_fingerprint(i) for i in x)
+    if isinstance(x, dict):
+        return tuple(sorted((k, _static_fingerprint(v))
+                            for k, v in x.items()))
+    if isinstance(x, np.dtype):
+        return ("npdt", str(x))
+    from ..framework.dtype import DType
+    if isinstance(x, DType):
+        return ("pdt", x.name)
+    if isinstance(x, slice):
+        return ("sl", x.start, x.stop, x.step)
+    raise _Unhashable(type(x))
+
+
+def _dtype_str(a):
+    # robust to typed PRNG-key arrays, whose dtype numpy can't interpret
+    dt = getattr(a, "dtype", None)
+    return str(dt) if dt is not None else str(np.result_type(a))
+
+
+def _is_dynamic_leaf(x):
+    """Leaves whose VALUES change call-to-call: device/host arrays."""
+    return isinstance(x, (jax.Array, np.ndarray, np.generic))
+
+
+# one shared jitted applier for cached vjp Partials: the Partial is a
+# pytree (residual leaves + jaxpr-bearing treedef), so jit caches one
+# backward executable per op signature
+@jax.jit
+def _apply_cached_vjp(vjp_fn, cots):
+    return vjp_fn(cots)
+
+
+def _eager_cached_call(opname, body, flat, treedef, t_idx, diff_flags,
+                       record):
+    """Dispatch via the per-signature jitted executable (build on miss).
+
+    flat/treedef: the op's flattened (args, kwargs) with Tensors as
+    leaves; t_idx/diff_flags: tensor positions and their requires-grad.
+    Returns (out, raw_vjp|None) or None when this call is uncacheable.
+    """
+    from ..framework.tensor import Tensor
+
+    dyn_pos = []          # positions in flat fed at call time
+    dyn_vals = []
+    # treedef is part of the signature: identical leaves can hide
+    # different kwarg names / nesting (clip(min=) vs clip(max=))
+    sig_parts = [opname, record, treedef]
+    try:
+        for i, x in enumerate(flat):
+            if isinstance(x, Tensor):
+                a = x._data
+                if isinstance(a, jax.core.Tracer):
+                    return None            # traced context: normal path
+                diff = diff_flags.get(i, False)
+                dyn_pos.append(i)
+                dyn_vals.append(a)
+                sig_parts.append(("t", np.shape(a), _dtype_str(a), diff))
+            elif _is_dynamic_leaf(x):
+                if isinstance(x, jax.core.Tracer):
+                    return None
+                dyn_pos.append(i)
+                dyn_vals.append(x)
+                sig_parts.append(("a", np.shape(x), _dtype_str(x)))
+            else:
+                sig_parts.append(("s", _static_fingerprint(x)))
+    except _Unhashable:
+        return None
+    sig = tuple(sig_parts)
+
+    fn = _EAGER_CACHE.get(sig)
+    if fn is None:
+        diff_idx = [j for j, p in enumerate(dyn_pos)
+                    if diff_flags.get(p, False)]
+        static_flat = [None if i in set(dyn_pos) else v
+                       for i, v in enumerate(flat)]
+
+        def run(dyn):
+            def closed(*diff_vals):
+                d2 = list(dyn)
+                for j, v in zip(diff_idx, diff_vals):
+                    d2[j] = v
+                flat2 = list(static_flat)
+                for p, v in zip(dyn_pos, d2):
+                    flat2[p] = v
+                a2, k2 = tree_unflatten(treedef, flat2)
+                return body(*a2, **k2)
+
+            if not record:
+                return closed(*[dyn[j] for j in diff_idx]), None
+            return jax.vjp(closed, *[dyn[j] for j in diff_idx])
+
+        fn = jax.jit(run)
+        # first call doubles as the trace probe: eager-RNG use or a
+        # trace failure (data-dependent python control flow) marks the
+        # op uncacheable and falls back to the normal path.  The
+        # generator key is snapshotted because a body that splits it
+        # under trace stores a tracer back into the generator — restore
+        # and discard the traced result so the eager rerun draws the
+        # stream the op would have seen without the probe.
+        from ..framework import random as _random
+        gen = _random.default_generator
+        key_before = gen._key
+        try:
+            with _random.watch_rng_use() as w:
+                result = fn(tuple(dyn_vals))
+            if w.used:
+                _UNCACHEABLE.add(opname)
+                gen._key = key_before
+                return None
+        except Exception:
+            _UNCACHEABLE.add(opname)
+            gen._key = key_before
+            return None
+        if len(_EAGER_CACHE) >= _EAGER_CACHE_MAX:
+            _EAGER_CACHE.pop(next(iter(_EAGER_CACHE)))
+        _EAGER_CACHE[sig] = fn
+        return result
+    return fn(tuple(dyn_vals))
 
 
 def apply_op(opname, body, args, kwargs):
@@ -91,6 +239,19 @@ def apply_op(opname, body, args, kwargs):
     record = tape.is_grad_enabled() and any(
         not t.stop_gradient for t in tensors)
 
+    if EAGER_CACHE_ENABLED and opname not in _UNCACHEABLE:
+        diff_flags = {i: (record and not flat[i].stop_gradient)
+                      for i in t_idx}
+        cached = _eager_cached_call(opname, body, flat, treedef, t_idx,
+                                    diff_flags, record)
+        if cached is not None:
+            out, raw_vjp = cached
+            if not record:
+                return _wrap_outputs(opname, out, node=None)
+            return _record_node(opname, out, raw_vjp,
+                                [flat[i] for i in t_idx
+                                 if diff_flags[i]], jitted_vjp=True)
+
     if not record:
         flat2 = list(flat)
         for i, a in zip(t_idx, arrays):
@@ -111,10 +272,20 @@ def apply_op(opname, body, args, kwargs):
         return body(*a2, **k2)
 
     out, raw_vjp = jax.vjp(closed, *[t._data for t in diff_tensors])
+    return _record_node(opname, out, raw_vjp, diff_tensors)
 
+
+def _record_node(opname, out, raw_vjp, diff_tensors, jitted_vjp=False):
+    """Attach a GradNode running ``raw_vjp`` at backward time.
+    jitted_vjp: the vjp came out of a cached jit as a tree_util.Partial —
+    apply it through the shared jitted applier so backward replays a
+    compiled executable instead of interpreting the jaxpr per op."""
     out_flat, out_treedef = tree_flatten(out)
     out_avals = [jax.ShapeDtypeStruct(np.shape(a), _tangent_dtype(a))
                  for a in out_flat]
+
+    apply_vjp = ((lambda cots: _apply_cached_vjp(raw_vjp, cots))
+                 if jitted_vjp else raw_vjp)
 
     hooks = tape.current_saved_tensors_hooks()
     if hooks is not None:
@@ -127,11 +298,11 @@ def apply_op(opname, body, args, kwargs):
             for t, ticket in zip(diff_tensors, packed):
                 unpack(ticket)
             cots = tree_unflatten(out_treedef, list(flat_cots))
-            return raw_vjp(cots)
+            return apply_vjp(cots)
     else:
         def vjp_fn(flat_cots):
             cots = tree_unflatten(out_treedef, list(flat_cots))
-            return raw_vjp(cots)
+            return apply_vjp(cots)
 
     node = tape.GradNode(opname, vjp_fn, diff_tensors, out_avals)
     return _wrap_outputs(opname, out, node=node)

@@ -98,6 +98,10 @@ class Optimizer:
             params_grads = self._grad_clip(params_grads)
         lr = self.get_lr()
         gt = getattr(self, "_grad_transform", None)
+        if gt is None and params_grads and self._try_fused_step(
+                params_grads, lr):
+            self._step_count += 1
+            return
         for p, g in params_grads:
             if g is None:
                 continue
@@ -110,6 +114,93 @@ class Optimizer:
                 g32 = g32 + self._l1_coeff * jnp.sign(self._param_f32(p))
             self._update_param(p, g32, lr)
         self._step_count += 1
+
+    # ----------------------------------------------------- fused eager step
+    # Eager per-param updates dispatch 2-5 device ops per parameter; the
+    # reference fuses them (phi multi_tensor_adam / fused kernels).  The
+    # TPU analog: replay the subclass's _update_param math for ALL params
+    # under one cached jit, with lr/step passed as traced scalars so
+    # schedulers and Adam bias correction stay step-accurate.
+    def _try_fused_step(self, params_grads, lr):
+        import jax
+
+        if getattr(self, "_fused_step_broken", False):
+            return False
+        if "_acc" in self.__dict__ or hasattr(self, "_shard_state_fn") \
+                or getattr(self, "_param_restore", None) is not None:
+            # sharded-state optimizers (shard_optimizer stages) place
+            # accumulators with device_put; inside a jit that placement
+            # becomes advisory and XLA replicates — keep the eager loop
+            return False
+        if any(g is None for _, g in params_grads):
+            return False
+        ps = [p for p, _ in params_grads]
+        gs = [g for _, g in params_grads]
+        if any(isinstance(x._data if hasattr(x, "_data") else x,
+                          jax.core.Tracer) for x in ps + gs):
+            return False          # traced context (train_step): legacy path
+        keys = [self._param_key(p) for p in ps]
+        accs_in = {k: dict(self._accumulators.get(k, {})) for k in keys}
+        masters_in = {k: self._master_weights[k] for k in keys
+                      if k in self._master_weights}
+        sig = (tuple((str(p._data.dtype), p._data.shape) for p in ps),
+               tuple((str(g.dtype), g.shape) for g in gs),
+               tuple((k, tuple(sorted(v))) for k, v in accs_in.items()),
+               tuple(sorted(masters_in)))
+        cache = self.__dict__.setdefault("_fused_step_cache", {})
+        fn = cache.get(sig)
+        if fn is None:
+            opt = self
+
+            def run(pvals, gvals, accs, masters, lr_arr, prev_steps):
+                saved = ([p._data for p in ps], opt._accumulators,
+                         opt._master_weights, opt._step_count)
+                try:
+                    for p, pv in zip(ps, pvals):
+                        p._data = pv
+                    opt._accumulators = {k: dict(v)
+                                         for k, v in accs.items()}
+                    opt._master_weights = dict(masters)
+                    opt._step_count = prev_steps
+                    for p, g in zip(ps, gvals):
+                        g32 = g.astype(jnp.float32)
+                        if opt._l1_coeff:
+                            g32 = g32 + opt._l1_coeff * jnp.sign(
+                                opt._param_f32(p))
+                        opt._update_param(p, g32, lr_arr)
+                    new_p = [p._data for p in ps]
+                    new_accs = {k: dict(opt._accumulators.get(k, {}))
+                                for k in keys}
+                    new_masters = {k: opt._master_weights[k] for k in keys
+                                   if k in opt._master_weights}
+                    return new_p, new_accs, new_masters
+                finally:
+                    (pd, opt._accumulators, opt._master_weights,
+                     opt._step_count) = saved[0], saved[1], saved[2], \
+                        saved[3]
+                    for p, pv in zip(ps, pd):
+                        p._data = pv
+
+            fn = jax.jit(run)
+        try:
+            new_p, new_accs, new_masters = fn(
+                [p._data for p in ps], gs, accs_in, masters_in,
+                jnp.float32(lr), jnp.int32(self._step_count))
+        except Exception:
+            # subclass math not traceable (host-side control flow, e.g.
+            # line searches): permanently take the legacy loop
+            self._fused_step_broken = True
+            return False
+        cache[sig] = fn
+        for p, nv in zip(ps, new_p):
+            key = self._param_key(p)
+            if key in new_masters:
+                self._master_weights[key] = new_masters[key]
+            p._data = nv
+        for k, v in new_accs.items():
+            if v:
+                self._accumulators[k] = v
+        return True
 
     def _update_param(self, p, grad_f32, lr):
         raise NotImplementedError

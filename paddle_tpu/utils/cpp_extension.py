@@ -98,7 +98,7 @@ class CustomOpModule:
 
         from ..ops.registry import op
 
-        @op(name=f"custom_{self.__name__}_{symbol}")
+        @op(name=f"custom_{self.__name__}_{symbol}", external=True)
         def custom_op(x):
             return jax.pure_callback(
                 host_impl,
@@ -132,7 +132,7 @@ class CustomOpModule:
 
         from ..ops.registry import op
 
-        @op(name=f"custom_{self.__name__}_{symbol}")
+        @op(name=f"custom_{self.__name__}_{symbol}", external=True)
         def custom_op(a, b):
             return jax.pure_callback(
                 host_impl,
