@@ -226,13 +226,13 @@ def bench_moe():
     dev, on_tpu, _ = _env()
     n = 1  # single-device bench (mesh is built with 1 device below)
     if on_tpu:
-        # dense GShard dispatch holds a [tokens, E, capacity] one-hot per
-        # batch row; 4x512 keeps that under HBM on one v5e
+        # sort-based dispatch (no [tokens, E, capacity] one-hot) freed
+        # the HBM that used to cap this rung at 4x512
         cfg = M.MoEConfig(vocab_size=32000, hidden_size=1024,
                           moe_intermediate_size=1408, num_hidden_layers=8,
                           num_attention_heads=16, num_key_value_heads=16,
                           num_experts=8, top_k=2, dtype="bfloat16")
-        batch, seq, steps = 4, 512, 10
+        batch, seq, steps = 16, 512, 10
     else:
         cfg = M.moe_tiny()
         batch, seq, steps = 2, 64, 2

@@ -22,7 +22,8 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 __all__ = ["top_k_gating", "moe_dispatch_combine", "number_count",
-           "limit_by_capacity", "prune_gate_by_capacity"]
+           "limit_by_capacity", "prune_gate_by_capacity",
+           "sort_dispatch_combine"]
 
 
 # -------------------------------------------------- reference gating utils
@@ -51,72 +52,227 @@ def prune_gate_by_capacity(gate_idx, expert_count, capacity):
 def top_k_gating(logits, top_k=2, capacity_factor=1.25, capacity=None,
                  train=True, noise_key=None):
     """logits: [S, E] -> (combine [S, E, C] f32, dispatch [S, E, C] bool,
-    aux_loss scalar).  Static capacity C."""
+    aux_loss scalar).  Static capacity C.  Shares the gating front-end
+    (_topk_choices) with the sort dispatch so the two formulations can
+    never desynchronize on noise/aux/tie semantics."""
     s, e = logits.shape
-    if capacity is None:
-        capacity = max(4, int(math.ceil(s * top_k * capacity_factor / e)))
-    if train and noise_key is not None:
-        logits = logits + jax.random.gumbel(noise_key, logits.shape,
-                                            logits.dtype) * 1e-2
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    capacity = _capacity(s, top_k, capacity_factor, e, capacity)
+    idx, gv, aux = _topk_choices(logits, top_k, train, noise_key)
 
     combine = jnp.zeros((s, e, capacity), jnp.float32)
     dispatch = jnp.zeros((s, e, capacity), bool)
-    masked = probs
     # position_in_expert accumulates across the k selection rounds
     fill = jnp.zeros((e,), jnp.int32)
-    aux = 0.0
-    for _ in range(top_k):
-        idx = jnp.argmax(masked, axis=-1)                     # [S]
-        onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)     # [S, E]
-        # Switch load-balancing loss: E * sum_e(frac_tokens_e * mean_prob_e)
-        frac = jnp.mean(onehot, axis=0)                        # [E]
-        mean_p = jnp.mean(probs, axis=0)                       # [E]
-        aux = aux + e * jnp.sum(frac * mean_p)
+    for r in range(top_k):
+        onehot = jax.nn.one_hot(idx[:, r], e, dtype=jnp.float32)  # [S, E]
         pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot      # 0-based
         pos = pos + fill[None, :] * onehot
         in_cap = (pos < capacity) & (onehot > 0)
         posc = jnp.clip(pos.astype(jnp.int32), 0, capacity - 1)
         sel = jax.nn.one_hot(posc, capacity, dtype=jnp.float32) \
             * in_cap[..., None]
-        gate_val = jnp.sum(probs * onehot, axis=-1, keepdims=True)
-        combine = combine + sel * gate_val[..., None]
+        combine = combine + sel * gv[:, r, None, None]
         dispatch = dispatch | (sel > 0)
         fill = fill + jnp.sum(onehot * in_cap, axis=0).astype(jnp.int32)
-        masked = masked * (1.0 - onehot)
-    return combine, dispatch, aux / top_k
+    return combine, dispatch, aux
+
+
+def _topk_choices(logits, top_k, train, noise_key):
+    """Shared gating front-end: per-token expert ids [S, K] (descending
+    prob, ties to the lower index like iterated argmax), gate values
+    [S, K] f32, and the Switch load-balancing aux loss."""
+    if train and noise_key is not None:
+        logits = logits + jax.random.gumbel(noise_key, logits.shape,
+                                            logits.dtype) * 1e-2
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    e = probs.shape[-1]
+    gv, idx = jax.lax.top_k(probs, top_k)                 # [S, K] each
+    frac = jnp.mean(jax.nn.one_hot(idx, e, dtype=jnp.float32), axis=0)
+    mean_p = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(frac * mean_p[None, :], axis=-1).mean()
+    return idx, gv, aux
+
+
+def _capacity(s, top_k, capacity_factor, e, capacity):
+    if capacity is not None:
+        return capacity
+    return max(4, int(math.ceil(s * top_k * capacity_factor / e)))
+
+
+# The hand-written VJPs below keep BOTH directions pure gathers: XLA's
+# TPU row-scatter runs ~13x slower than the equivalent gather (measured
+# v5e), and autodiff of a gather emits exactly that scatter.  Index
+# arrays ride along as regular (None-cotangent) arguments so they stay
+# jit-safe.
+
+@jax.custom_vjp
+def _gather_dispatch(x, ft_slot, svalid, dest, keep, inv):
+    """Token rows [S, M] -> expert buffer [E*C, M].
+
+    ft_slot[slot] = token index feeding that slot (composed through the
+    sorted order), svalid[slot] = slot actually filled; dest[entry] =
+    slot fed by sorted entry (clipped), keep[entry] = entry in capacity,
+    inv[flat k-major entry] = its sorted position."""
+    return jnp.where(svalid[:, None], x[ft_slot], 0)
+
+
+def _gather_dispatch_fwd(x, ft_slot, svalid, dest, keep, inv):
+    out = _gather_dispatch(x, ft_slot, svalid, dest, keep, inv)
+    # zero-width carrier keeps x's shape/dtype in the residuals as a
+    # jax type (saving x itself would pin the whole activation)
+    xref = jnp.zeros((x.shape[0], 0), x.dtype)
+    return out, (xref, dest, keep, inv)
+
+
+def _gather_dispatch_bwd(res, dbuf):
+    xref, dest, keep, inv = res
+    s = xref.shape[0]
+    m = dbuf.shape[-1]
+    k = inv.shape[0] // s
+    dent = dbuf[dest] * keep[:, None].astype(dbuf.dtype)  # [N, M] gather
+    dx = jnp.sum(dent[inv].reshape(k, s, m), axis=0)      # inverse gather
+    return (dx.astype(xref.dtype), None, None, None, None, None)
+
+
+_gather_dispatch.defvjp(_gather_dispatch_fwd, _gather_dispatch_bwd)
+
+
+@jax.custom_vjp
+def _gather_combine(flat, gv_s, ft_s, ft_slot, svalid, sidx, dest, keep,
+                    inv, sref):
+    """Expert rows [E*C, M] * gate values -> token rows [S, M].
+    sref is a [S] int8 shape-carrier so S stays static under tracing."""
+    m = flat.shape[-1]
+    s = sref.shape[0]
+    k = inv.shape[0] // s
+    back = flat[dest] * (gv_s * keep.astype(gv_s.dtype))[:, None]
+    return jnp.sum(back[inv].reshape(k, s, m), axis=0)
+
+
+def _gather_combine_fwd(flat, gv_s, ft_s, ft_slot, svalid, sidx, dest,
+                        keep, inv, sref):
+    out = _gather_combine(flat, gv_s, ft_s, ft_slot, svalid, sidx, dest,
+                          keep, inv, sref)
+    return out, (flat, gv_s, ft_s, ft_slot, svalid, sidx, dest, keep)
+
+
+def _gather_combine_bwd(res, dy):
+    flat, gv_s, ft_s, ft_slot, svalid, sidx, dest, keep = res
+    # slot gets its gradient from the unique sorted entry that fills it
+    dflat = jnp.where(svalid[:, None],
+                      gv_s[sidx, None] * dy[ft_slot].astype(flat.dtype), 0)
+    # gate-value grad: <expert row, token cotangent> per entry
+    dgv = keep.astype(gv_s.dtype) * jnp.sum(
+        flat[dest].astype(jnp.float32)
+        * dy[ft_s].astype(jnp.float32), axis=-1).astype(gv_s.dtype)
+    return (dflat, dgv, None, None, None, None, None, None, None, None)
+
+
+_gather_combine.defvjp(_gather_combine_fwd, _gather_combine_bwd)
+
+
+def sort_dispatch_combine(x, idx, gv, e, capacity, ffn):
+    """Sort-based dispatch/combine (reference global_scatter/
+    global_gather, paddle/fluid/operators/collective/global_scatter_op.cc
+    — without the dense [S, E, C] one-hot the GShard formulation
+    materializes).
+
+    x: [S, M] tokens; idx/gv: [S, K] expert choices (k-major priority:
+    all first choices fill capacity before any second choice, matching
+    the reference's round-by-round position accounting); ffn maps
+    [E, C, M] -> [E, C, M].  Returns y [S, M].
+
+    TPU formulation: after a stable sort by expert id, each expert's
+    in-capacity entries are a CONTIGUOUS run of the sorted order, so the
+    expert buffer is a plain gather rows_sorted[starts[e] + c] — and the
+    custom VJPs keep the backward pure gathers too.  Static shapes
+    throughout; overflow tokens contribute zero (SURVEY §7 hard part (c)).
+    """
+    s, m = x.shape
+    k = idx.shape[1]
+    n = s * k
+    fe = idx.T.reshape(n)                  # k-major: round 0 first
+    ft = jnp.tile(jnp.arange(s, dtype=jnp.int32), k)
+    gvf = gv.T.reshape(n)
+    order = jnp.argsort(fe, stable=True)   # preserves (round, token) order
+    fe_s = fe[order]
+    ft_s = ft[order]
+    gv_s = gvf[order].astype(x.dtype)
+    counts = jnp.zeros((e,), jnp.int32).at[fe].add(1)
+    starts = jnp.cumsum(counts) - counts   # exclusive prefix
+    pos = jnp.arange(n, dtype=jnp.int32) - starts[fe_s]  # rank in expert
+    keep = pos < capacity
+    dest = jnp.where(keep, fe_s * capacity + pos, 0)     # clipped slot
+    inv = jnp.argsort(order)               # flat entry -> sorted position
+
+    # slot -> sorted entry: in-capacity entries of expert e are sorted
+    # positions [starts[e], starts[e] + min(count_e, C))
+    slots = jnp.arange(e * capacity, dtype=jnp.int32)
+    se, sc = slots // capacity, slots % capacity
+    svalid = sc < jnp.minimum(counts, capacity)[se]
+    sidx = jnp.clip(starts[se] + sc, 0, n - 1)
+    ft_slot = ft_s[sidx]
+
+    expert_in = _gather_dispatch(x, ft_slot, svalid, dest, keep, inv)
+    expert_out = ffn(expert_in.reshape(e, capacity, m))
+    flat = expert_out.reshape(e * capacity, m)
+    return _gather_combine(flat, gv_s, ft_s, ft_slot, svalid, sidx, dest,
+                           keep, inv, jnp.zeros((s,), jnp.int8))
 
 
 def moe_dispatch_combine(x, gate_w, w1, b1, w2, b2, *, top_k=2,
                          capacity_factor=1.25, activation=jax.nn.gelu,
                          mesh=None, ep_axis="ep", train=True,
-                         noise_key=None):
+                         noise_key=None, dispatch_mode="sort"):
     """Full MoE FFN over flat tokens.
 
     x: [S, M]; gate_w: [M, E]; w1: [E, M, F]; b1: [E, F]; w2: [E, F, M];
     b2: [E, M].  Returns (y [S, M], aux_loss).
 
+    dispatch_mode "sort" (default) routes tokens with a stable sort +
+    scatter/gather — O(S*K*M) data movement; "dense" keeps the GShard
+    one-hot einsum formulation ([S, E, C] transient) as the reference
+    implementation the equivalence tests compare against.
+
     With `mesh` given and `ep_axis` in it, expert-stacked tensors get
-    Shard(0) constraints over ep: XLA lowers the dispatch einsum to the
-    all-to-all the reference codes as global_scatter/global_gather.
+    Shard(0) constraints over ep: XLA lowers the dispatch movement to
+    the all-to-all the reference codes as global_scatter/global_gather.
     """
     logits = x @ gate_w.astype(x.dtype)
+    s, e = logits.shape
+    cap = _capacity(s, top_k, capacity_factor, e, None)
+    ep_sharded = mesh is not None and ep_axis in mesh.axis_names
+
+    def constrain(t):
+        if ep_sharded:
+            spec = P(ep_axis, *([None] * (t.ndim - 1)))
+            return jax.lax.with_sharding_constraint(
+                t, NamedSharding(mesh, spec))
+        return t
+
+    def ffn(expert_in):
+        expert_in = constrain(expert_in)
+        h = activation(jnp.einsum("ecm,emf->ecf", expert_in, w1)
+                       + b1[:, None, :])
+        return constrain(jnp.einsum("ecf,efm->ecm", h, w2)
+                         + b2[:, None, :])
+
+    if dispatch_mode == "sort":
+        idx, gv, aux = _topk_choices(logits, top_k, train, noise_key)
+        y = sort_dispatch_combine(x, idx, gv, e, cap, ffn)
+        return y, aux.astype(jnp.float32)
+    if dispatch_mode != "dense":
+        raise ValueError(
+            f"dispatch_mode must be 'sort' or 'dense', got {dispatch_mode!r}")
+
     combine, dispatch, aux = top_k_gating(
-        logits, top_k=top_k, capacity_factor=capacity_factor, train=train,
-        noise_key=noise_key)
+        logits, top_k=top_k, capacity_factor=capacity_factor,
+        capacity=cap, train=train, noise_key=noise_key)
     combine = combine.astype(x.dtype)
     # dispatch: [S, E, C] x [S, M] -> [E, C, M]  (the global_scatter);
     # boolean mask — gate scaling happens only on the combine side
     expert_in = jnp.einsum("sec,sm->ecm", dispatch.astype(x.dtype), x)
-    if mesh is not None and ep_axis in mesh.axis_names:
-        shard_e = NamedSharding(mesh, P(ep_axis, None, None))
-        expert_in = jax.lax.with_sharding_constraint(expert_in, shard_e)
-    h = activation(jnp.einsum("ecm,emf->ecf", expert_in, w1)
-                   + b1[:, None, :])
-    expert_out = jnp.einsum("ecf,efm->ecm", h, w2) + b2[:, None, :]
-    if mesh is not None and ep_axis in mesh.axis_names:
-        expert_out = jax.lax.with_sharding_constraint(
-            expert_out, NamedSharding(mesh, P(ep_axis, None, None)))
+    expert_out = ffn(expert_in)
     # combine back: the global_gather
     y = jnp.einsum("sec,ecm->sm", combine, expert_out)
     return y, aux.astype(jnp.float32)

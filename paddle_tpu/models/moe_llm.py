@@ -27,7 +27,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .llama import _rope_tables, apply_rotary_pos_emb
-from .llama_hybrid import _rms
+from .llama_hybrid import _rms, _chunked_ce_sum
 from ..ops.pallas.flash_attention import sdpa
 from ..distributed.moe import moe_dispatch_combine
 
@@ -174,10 +174,8 @@ def loss_fn(params, ids, config: MoEConfig, mesh: Mesh):
     (x, aux_total), _ = jax.lax.scan(body, (x, jnp.float32(0.0)),
                                      params["layers"])
     h = _rms(x, params["norm"], config.rms_norm_eps)
-    logits = (h @ params["head"]).astype(jnp.float32)
-    lse = jax.scipy.special.logsumexp(logits, axis=-1)
-    tgt = jnp.take_along_axis(logits, lab[..., None], axis=-1)[..., 0]
-    ce = jnp.mean(lse - tgt)
+    # chunked CE: never materialize the [B,S,V] fp32 logits
+    ce = _chunked_ce_sum(h, lab, params["head"]) / (b * s)
     return ce + config.aux_loss_weight * aux_total / config.num_hidden_layers
 
 

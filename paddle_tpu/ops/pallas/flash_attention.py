@@ -433,14 +433,17 @@ def _flash_fwd_x32(q, k, v, causal, sm_scale, block_q, block_k, sq_real,
 
 
 # --------------------------------------------------------------- backward
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref, *,
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref, *,
                    causal, block_k, sm_scale, sq_real, sk_real):
     from jax.experimental import pallas as pl
 
     q = q_ref[...]                                          # [bq, d]
     do = do_ref[...]
     lse = lse_ref[:, 0]                                     # [bq]
-    delta = dl_ref[:, 0]
+    # delta = rowsum(out * dout), derived in-kernel from the streamed
+    # blocks instead of a separate materialized [B,H,S,128] pass
+    delta = jnp.sum(o_ref[...].astype(jnp.float32)
+                    * do.astype(jnp.float32), axis=1)
     bq, d = q.shape
     ko = sk_real - sq_real
     q_blk = pl.program_id(2)
@@ -465,7 +468,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref, *,
     dq_ref[...] = dq.astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dk_ref,
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref,
                     dv_ref, *, causal, block_q, sm_scale, sq_real, sk_real):
     from jax.experimental import pallas as pl
 
@@ -481,7 +484,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dk_ref,
         q = q_ref[pl.dslice(i * block_q, block_q), :]
         do = do_ref[pl.dslice(i * block_q, block_q), :]
         lse = lse_ref[pl.dslice(i * block_q, block_q), 0]
-        delta = dl_ref[pl.dslice(i * block_q, block_q), 0]
+        delta = jnp.sum(
+            o_ref[pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
+            * do.astype(jnp.float32), axis=1)
         s = _ab_t(q, k) * jnp.float32(sm_scale)
         q_ids = i * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, bk), 0)
@@ -519,12 +524,10 @@ def _flash_bwd_x32(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
     b, h, sq, d = q.shape
     hk = k.shape[1]
     grp = h // hk
-    # the residual is stored un-broadcast ([B,H,S]); restore kernel tiling
-    lse = jnp.broadcast_to(lse[..., None], (b, h, sq, NUM_LANES))
     sk = k.shape[2]
-    delta = jnp.sum(out.astype(jnp.float32) * g.astype(jnp.float32),
-                    axis=-1)                                 # [B, H, Sq]
-    delta = jnp.broadcast_to(delta[..., None], (b, h, sq, NUM_LANES))
+    # restore the kernels' lane tiling (transient, freed per layer);
+    # delta is derived in-kernel from the out/dout streams
+    lse = jnp.broadcast_to(lse[..., None], (b, h, sq, NUM_LANES))
 
     full = lambda s: pl.BlockSpec((None, None, s, d),
                                   lambda b_, h_, i: (b_, h_, 0, 0))
@@ -542,10 +545,10 @@ def _flash_bwd_x32(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
                           sm_scale=sm_scale, sq_real=sq_real,
                           sk_real=sk_real),
         grid=(b, h, sq // block_q),
-        in_specs=[blk_q(), full_kv, full_kv, blk_q(), blk_l, blk_l],
+        in_specs=[blk_q(), full_kv, full_kv, blk_q(), blk_q(), blk_l],
         out_specs=blk_q(),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-    )(q, k, v, g, lse, delta)
+    )(q, k, v, g, out, lse)
 
     blk_k = lambda: pl.BlockSpec((None, None, block_k, d),
                                  lambda b_, h_, i: (b_, h_, i, 0))
@@ -558,11 +561,11 @@ def _flash_bwd_x32(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
                           sm_scale=sm_scale, sq_real=sq_real,
                           sk_real=sk_real),
         grid=(b, h, sk // block_k),
-        in_specs=[full(sq), kv_blk, kv_blk, full(sq), full_l, full_l],
+        in_specs=[full(sq), kv_blk, kv_blk, full(sq), full(sq), full_l],
         out_specs=[blk_k(), blk_k()],
         out_shape=[jax.ShapeDtypeStruct((b, h, sk, d), k.dtype),
                    jax.ShapeDtypeStruct((b, h, sk, d), v.dtype)],
-    )(q, k, v, g, lse, delta)
+    )(q, k, v, g, out, lse)
     if grp > 1:
         dk = dk.reshape(b, hk, grp, sk, d).sum(axis=2)
         dv = dv.reshape(b, hk, grp, sk, d).sum(axis=2)
@@ -594,7 +597,8 @@ def _flash_mha_fwd(q, k, v, causal, sm_scale, sq_real, sk_real):
                           *_block_sizes(q.shape[2], k.shape[2]),
                           sq_real, sk_real)
     # the lane broadcast is a Mosaic tiling artifact; keep 1/128 of it
-    # as the residual and re-broadcast in the backward wrapper
+    # as the residual (holding it whole would pin 128x fp32 activation
+    # memory per layer) and re-broadcast transiently in the backward
     return out, (q, k, v, out, lse[..., 0])
 
 
