@@ -182,26 +182,41 @@ def load_state_dict(state_dict, path, process_group=None,
             continue
         entry = meta["tensors"][name]
         gshape = tuple(entry["shape"])
-        if tuple(t.shape) != gshape and isinstance(t, Tensor):
+        is_tensor = isinstance(t, Tensor)
+        is_array = isinstance(t, jax.Array)
+        if not (is_tensor or is_array):
+            continue
+        if tuple(t.shape) != gshape:
             raise ValueError(
                 f"{name}: saved global shape {gshape} != "
                 f"target {tuple(t.shape)}")
-        if not isinstance(t, Tensor):
-            continue
-        tgt_dtype = np.dtype(t._data.dtype)
-        sharding = getattr(t._data, "sharding", None)
+        arr = t._data if is_tensor else t
+        tgt_dtype = np.dtype(arr.dtype)
+        sharding = getattr(arr, "sharding", None)
         if sharding is not None:
             def cb(idx, _e=entry, _d=tgt_dtype, _g=gshape):
                 bounds = _shard_index(idx, _g) if idx else \
                     [(0, d) for d in _g]
                 return _read_slice(_e, bounds, _d, reader)
 
-            t._data = jax.make_array_from_callback(gshape, sharding, cb)
+            new = jax.make_array_from_callback(gshape, sharding, cb)
         else:
-            full = _read_slice(entry, [(0, d) for d in gshape],
-                               tgt_dtype, reader)
-            t._data = jax.numpy.asarray(full)
+            new = jax.numpy.asarray(_read_slice(
+                entry, [(0, d) for d in gshape], tgt_dtype, reader))
+        if is_tensor:
+            t._data = new                   # fill the Tensor in place
+        else:
+            # raw jax.Array targets are immutable: rebind in the dict
+            _set_by_path(state_dict, name, new)
     return state_dict
+
+
+def _set_by_path(state, dotted, value):
+    keys = dotted.split(".")
+    node = state
+    for k in keys[:-1]:
+        node = node[k]
+    node[keys[-1]] = value
 
 
 def _flatten_state(state, prefix=""):

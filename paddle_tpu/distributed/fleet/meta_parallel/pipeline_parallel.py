@@ -85,6 +85,13 @@ class PipelineParallel(MetaParallelBase):
         if not isinstance(model, PipelineLayer) or \
                 model.get_num_stages() != S:
             return None
+        v = getattr(model, "get_num_virtual_stages", lambda: 1)()
+        if v > 1 and n_micro % S != 0:
+            self._fallback_reason = (
+                f"interleaved VPP needs n_micro % pp == 0, got "
+                f"{n_micro} % {S}")
+            return None
+        nseg = S * v
         cuts = model.segment_parts
 
         def seg_run(p, h, lo, hi):
@@ -113,7 +120,7 @@ class PipelineParallel(MetaParallelBase):
 
         def last_fn(p, y, aux_j):
             out = seg_run(p, Tensor(y, stop_gradient=True),
-                          cuts[S - 1], cuts[S])
+                          cuts[nseg - 1], cuts[nseg])
             loss = model.loss(out, Tensor(aux_j["y"], stop_gradient=True))
             return loss._data / n_micro
 
@@ -128,7 +135,7 @@ class PipelineParallel(MetaParallelBase):
                 lambda p, a: make_stage(0)(p, None, {"x": a, "y": None}),
                 params, jax.ShapeDtypeStruct(mb_shape, xb.dtype))
             shapes = {(h.shape, h.dtype)}
-            for i in range(1, S - 1):
+            for i in range(1, nseg - 1):
                 h = jax.eval_shape(
                     lambda p, x, _i=i: make_stage(_i)(p, x, None),
                     params, h)
@@ -142,8 +149,9 @@ class PipelineParallel(MetaParallelBase):
                 f"stage probe raised {type(e).__name__}: {e}")
             return None
 
-        stage_fns = [make_stage(i) for i in range(S - 1)] + [identity_stage]
-        return stage_fns, last_fn
+        stage_fns = [make_stage(i) for i in range(nseg - 1)] \
+            + [identity_stage]
+        return stage_fns, last_fn, v
 
     def _build_step(self, model, optimizer, n_micro):
         inner_opt = optimizer if hasattr(optimizer, "opt_state") else \
@@ -179,7 +187,7 @@ class PipelineParallel(MetaParallelBase):
                 finally:
                     model.load_functional_state(saved)
 
-        def make_pipelined(stage_fns, last_fn):
+        def make_pipelined(stage_fns, last_fn, n_virtual=1):
             def step(params, opt_state, key, xb, yb):
                 with _random.trace_key_guard(key):
                     saved = model.functional_state()
@@ -194,7 +202,8 @@ class PipelineParallel(MetaParallelBase):
                                 + yb.shape[1:]),
                         }
                         loss, grads = pipeline_1f1b_hetero(
-                            stage_fns, last_fn, params, aux, mesh)
+                            stage_fns, last_fn, params, aux, mesh,
+                            n_virtual=n_virtual)
                         model.load_functional_state(params)
                         named = dict(model.named_parameters())
                         with tape.no_grad():

@@ -84,6 +84,7 @@ class PipelineLayer(Layer):
         self._loss_fn = loss_fn
         self._num_stages = num_stages or (
             topology.get_dim("pipe") if topology else 1)
+        self._num_virtual = int(num_virtual_pipeline_stages or 1)
         self._seg_method = seg_method
         self._recompute_interval = recompute_interval
         self._layers_desc = list(layers)
@@ -118,17 +119,27 @@ class PipelineLayer(Layer):
                 self.run_function.append(l)
         self.layers = layer_list
 
-        cuts = SegmentLayers(self._layers_desc, self._num_stages,
+        # VPP segments the model into num_stages * num_virtual parts;
+        # device s owns chunks {c*S+s} (reference interleaved assignment
+        # pipeline_parallel.py:1174, pp_layers _get_virtual segmentation)
+        cuts = SegmentLayers(self._layers_desc,
+                             self._num_stages * self._num_virtual,
                              seg_method).do_segment()
         self.segment_parts = cuts
 
     def get_num_stages(self):
         return self._num_stages
 
+    def get_num_virtual_stages(self):
+        return self._num_virtual
+
     def get_stage_from_index(self, idx):
-        for s in range(self._num_stages):
-            if self.segment_parts[s] <= idx < self.segment_parts[s + 1]:
-                return s
+        # VPP: segment k = chunk (k // S) resident on device k % S
+        # (interleaved assignment); v=1 reduces to the plain mapping
+        nseg = self._num_stages * self._num_virtual
+        for k in range(nseg):
+            if self.segment_parts[k] <= idx < self.segment_parts[k + 1]:
+                return k % self._num_stages
         return self._num_stages - 1
 
     def forward(self, x):

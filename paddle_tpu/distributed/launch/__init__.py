@@ -24,25 +24,176 @@ ELASTIC_EXIT_CODE = 101
 DEFAULT_MASTER = "127.0.0.1:8765"
 
 
-def build_rank_env(rank, nprocs, master, base_env=None, device_ids=None):
+def build_rank_env(rank, nprocs, master, base_env=None, device_ids=None,
+                   rank_base=0, world=None, coordinator=None, node_ip=None,
+                   endpoints=None):
     """Per-rank environment (reference: controllers/collective.py
-    build_pod -> _get_entrypoint env assembly)."""
+    build_pod -> _get_entrypoint env assembly).
+
+    rank is the LOCAL rank; with multi-node, rank_base/world carry the
+    node's global offset and total process count, `coordinator` is the
+    jax coordination-service address (always the --master host, where
+    global rank 0 lives), and `endpoints` is the GLOBAL per-rank
+    endpoint list (ports keyed by global rank so co-located nodes never
+    collide)."""
     env = dict(base_env if base_env is not None else os.environ)
+    world = world if world is not None else nprocs
+    grank = rank_base + rank
+    ip = node_ip or "127.0.0.1"
+    if endpoints is None:
+        endpoints = [f"{ip}:{6170 + g}" for g in range(world)]
     env.update({
-        "PADDLE_TRAINER_ID": str(rank),
-        "PADDLE_TRAINERS_NUM": str(nprocs),
+        "PADDLE_TRAINER_ID": str(grank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_LOCAL_RANK": str(rank),
         "PADDLE_MASTER": master,
-        "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{6170 + rank}",
-        "PADDLE_TRAINER_ENDPOINTS": ",".join(
-            f"127.0.0.1:{6170 + r}" for r in range(nprocs)),
+        "PADDLE_CURRENT_ENDPOINT": endpoints[grank],
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
         # jax coordination service (jax.distributed.initialize reads these)
-        "JAX_COORDINATOR_ADDRESS": master,
-        "JAX_NUM_PROCESSES": str(nprocs),
-        "JAX_PROCESS_ID": str(rank),
+        "JAX_COORDINATOR_ADDRESS": coordinator or master,
+        "JAX_NUM_PROCESSES": str(world),
+        "JAX_PROCESS_ID": str(grank),
         "FLAGS_selected_devices": str(
             device_ids[rank] if device_ids else rank),
     })
     return env
+
+
+def parse_nnodes(spec):
+    """'2' -> (2, 2); '2:4' -> (2, 4) (reference main.py --nnodes range
+    form for elastic node membership)."""
+    s = str(spec)
+    if ":" in s:
+        lo, hi = s.split(":")
+        return int(lo), int(hi)
+    return int(s), int(s)
+
+
+class NodeRendezvous:
+    """Cross-node rendezvous over the TCPStore — the TPU-native analog of
+    the reference's etcd master (launch/controllers/master.py:87,191:
+    each node registers under the job, the sorted registration order
+    assigns node ranks, and a generation counter lets elastic re-form the
+    world).  The store lives on the node launched with --rank 0 (or the
+    first to bind when ranks are auto)."""
+
+    # Port map relative to --master host:P (convention shared with
+    # store.create_or_get_global_tcp_store): P = jax coordination
+    # service (global rank 0 process), P+1 = the workers' KV store,
+    # P+2 = this launcher-level node rendezvous.
+    STORE_PORT_OFFSET = 2
+
+    def __init__(self, master, nnodes_min, nnodes_max, job_id="default",
+                 host_store=None, timeout=120.0):
+        from ..store import TCPStore
+        self.master = master
+        host, port = master.rsplit(":", 1)
+        self.host, self.port = host, int(port) + self.STORE_PORT_OFFSET
+        self.min, self.max = nnodes_min, nnodes_max
+        self.job = job_id
+        self.timeout = timeout
+        from ..store import _LocalStore
+        if host_store is None:
+            # auto: race to bind; the loser becomes a client
+            try:
+                self.store = TCPStore(self.host, self.port, is_master=True,
+                                      world_size=nnodes_max)
+                self.is_host = True
+            except Exception:
+                self.store = TCPStore(self.host, self.port, is_master=False)
+                self.is_host = False
+        else:
+            self.store = TCPStore(self.host, self.port,
+                                  is_master=host_store,
+                                  world_size=nnodes_max)
+            self.is_host = host_store
+        if nnodes_max > 1 and isinstance(self.store, _LocalStore):
+            # the in-process fallback cannot cross machines: every node
+            # would become master of a private dict and hang the job
+            raise RuntimeError(
+                "multi-node launch requires the native TCPStore "
+                "(csrc/tcp_store.cc); the python fallback is "
+                "single-process only")
+
+    def generation(self):
+        key = f"job/{self.job}/gen"
+        if not self.store.check(key):   # get() BLOCKS on missing keys
+            return 0
+        v = self.store.get(key)
+        if isinstance(v, bytes) and len(v) == 8:
+            # counters live in the store's add() wire format (8-byte LE)
+            return int.from_bytes(v, "little", signed=True)
+        return int(v)
+
+    def bump_generation(self):
+        """Ask every node launcher to re-form the world (elastic)."""
+        return self.store.add(f"job/{self.job}/gen", 1)
+
+    def register(self, nproc, node_ip="127.0.0.1", node_rank=-1):
+        """Blocking: returns (gen, node_rank, nnodes, node_infos).
+
+        Node rank 0 is ALWAYS the store host (the --master machine), so
+        global JAX rank 0 runs where the coordination service address
+        points; other nodes take explicit --rank or arrival order.  The
+        HOST alone commits the world size (one decider — concurrent
+        deadline races cannot produce nodes with different worlds);
+        a straggler landing outside the committed world fails loudly."""
+        deadline = time.time() + self.timeout
+        while True:                    # restart at a newer generation if
+            gen = self.generation()    # peers bump while we wait
+            pre = f"job/{self.job}/g{gen}"
+            if self.is_host:
+                me = 0
+            elif node_rank > 0:
+                me = node_rank
+            else:
+                me = int(self.store.add(f"{pre}/clients", 1))  # 1-based
+            self.store.set(f"{pre}/node/{me}", f"{node_ip}|{nproc}")
+            self.store.add(f"{pre}/count", 1)
+
+            if self.is_host:
+                while self.generation() == gen:
+                    n = int(self.store.add(f"{pre}/count", 0))
+                    if n >= self.max or (n >= self.min
+                                         and time.time() > deadline):
+                        break
+                    if time.time() > deadline:
+                        raise TimeoutError(
+                            f"rendezvous: {n}/{self.min} nodes after "
+                            f"{self.timeout}s (job={self.job} gen={gen})")
+                    time.sleep(0.2)
+                n = min(int(self.store.add(f"{pre}/count", 0)), self.max)
+                self.store.set(f"{pre}/world", str(n))
+            else:
+                while self.generation() == gen:
+                    if self.store.check(f"{pre}/world"):
+                        break
+                    if time.time() > deadline:
+                        raise TimeoutError(
+                            f"rendezvous: no world commit from the "
+                            f"master after {self.timeout}s "
+                            f"(job={self.job} gen={gen})")
+                    time.sleep(0.2)
+                if self.generation() != gen:
+                    time.sleep(0.2)
+                    continue           # re-register at the new generation
+                n = int(self.store.get(f"{pre}/world"))
+            if self.generation() == gen:
+                break
+            time.sleep(0.2)            # gen moved: re-register there
+        if me >= n:
+            raise RuntimeError(
+                f"node rank {me} is outside the committed world of {n} "
+                f"nodes (job={self.job} gen={gen}); this node arrived "
+                "after membership closed — relaunch to join the next "
+                "generation")
+        infos = []
+        for r in range(n):
+            self.store.wait([f"job/{self.job}/g{gen}/node/{r}"])
+            ip, np_ = self.store.get(
+                f"job/{self.job}/g{gen}/node/{r}").decode().split("|")
+            infos.append((ip, int(np_)))
+        return gen, me, n, infos
 
 
 class Launcher:
@@ -51,7 +202,9 @@ class Launcher:
 
     def __init__(self, cmd, nprocs, master=None, log_dir=None,
                  max_restarts=0, elastic=False, device_ids=None,
-                 base_env=None):
+                 base_env=None, nnodes="1", node_rank=-1,
+                 job_id="default", node_ip="127.0.0.1",
+                 rendezvous_timeout=120.0):
         self.cmd = cmd
         self.nprocs = nprocs
         self.master = master or DEFAULT_MASTER
@@ -60,23 +213,74 @@ class Launcher:
         self.elastic = elastic
         self.device_ids = device_ids
         self.base_env = base_env
+        self.nnodes_min, self.nnodes_max = parse_nnodes(nnodes)
+        self.node_rank = node_rank
+        self.job_id = job_id
+        self.node_ip = node_ip
+        self.rendezvous_timeout = rendezvous_timeout
+        self.rdzv: NodeRendezvous | None = None
+        self.gen = 0
         self.procs: list[subprocess.Popen] = []
 
+    @property
+    def multi_node(self):
+        return self.nnodes_max > 1
+
+    def _rendezvous(self):
+        """Form (or re-form) the node gang; compute this node's global
+        rank window.  jax coordination rides the --master address, so
+        the world that comes out of this is exactly what
+        init_parallel_env's jax.distributed.initialize expects."""
+        if self.rdzv is None:
+            host_store = True if self.node_rank == 0 else (
+                None if self.node_rank < 0 else False)
+            self.rdzv = NodeRendezvous(
+                self.master, self.nnodes_min, self.nnodes_max,
+                job_id=self.job_id, host_store=host_store,
+                timeout=self.rendezvous_timeout)
+        gen, me, nnodes, infos = self.rdzv.register(
+            self.nprocs, self.node_ip, node_rank=self.node_rank)
+        self.gen = gen
+        self._node_rank_now = me
+        self._world = sum(np_ for _, np_ in infos)
+        self._rank_base = sum(np_ for _, np_ in infos[:me])
+        eps, g = [], 0
+        for ip_, np_ in infos:
+            for _ in range(np_):
+                eps.append(f"{ip_}:{6170 + g}")
+                g += 1
+        self._endpoints = eps
+        print(f"[launch] node {me}/{nnodes} (gen {gen}): global ranks "
+              f"[{self._rank_base}, {self._rank_base + self.nprocs})"
+              f" of {self._world}", file=sys.stderr)
+
     def _spawn(self):
+        if self.multi_node:
+            self._rendezvous()
+            rank_base, world = self._rank_base, self._world
+        else:
+            rank_base, world = 0, self.nprocs
         self.procs = []
         for rank in range(self.nprocs):
             env = build_rank_env(rank, self.nprocs, self.master,
                                  base_env=self.base_env,
-                                 device_ids=self.device_ids)
+                                 device_ids=self.device_ids,
+                                 rank_base=rank_base, world=world,
+                                 coordinator=self.master,
+                                 node_ip=self.node_ip,
+                                 endpoints=getattr(self, "_endpoints",
+                                                   None))
+            # which elastic world incarnation this process belongs to
+            env["PADDLE_JOB_GENERATION"] = str(self.gen)
             stdout = None
             if self.log_dir:
                 os.makedirs(self.log_dir, exist_ok=True)
-                stdout = open(os.path.join(self.log_dir,
-                                           f"workerlog.{rank}"), "w")
+                stdout = open(os.path.join(
+                    self.log_dir, f"workerlog.{rank_base + rank}"), "w")
             p = subprocess.Popen(self.cmd, env=env, stdout=stdout,
                                  stderr=subprocess.STDOUT if stdout
                                  else None)
-            p._rank = rank
+            p._rank = rank_base + rank
             self.procs.append(p)
 
     def _kill_all(self):
@@ -90,6 +294,8 @@ class Launcher:
             except subprocess.TimeoutExpired:
                 p.kill()
 
+    RESTART_SENTINEL = -9999   # another node asked for a world re-form
+
     def run(self):
         restarts = 0
         while True:
@@ -97,18 +303,29 @@ class Launcher:
             code = self._watch()
             if code == 0:
                 return 0
+            if code == self.RESTART_SENTINEL:
+                # peer-initiated re-form (doesn't count against local
+                # restarts: the failing node accounts for its own)
+                print("[launch] peer node requested re-rendezvous; "
+                      "restarting gang", file=sys.stderr)
+                continue
             if (self.elastic or code == ELASTIC_EXIT_CODE) and \
                     restarts < self.max_restarts:
                 restarts += 1
                 print(f"[launch] rank failure (exit {code}); elastic "
                       f"restart {restarts}/{self.max_restarts}",
                       file=sys.stderr)
+                if self.multi_node and self.rdzv is not None:
+                    self.rdzv.bump_generation()   # pull peers along
                 continue
             return code
 
     def _watch(self):
         """Poll children; on any failure kill the gang (reference:
-        watcher loop in launch/controllers/watcher.py)."""
+        watcher loop in launch/controllers/watcher.py).  Multi-node:
+        also watch the rendezvous generation — a peer bumping it means
+        the world must re-form (reference elastic/manager.py watch)."""
+        last_gen_check = time.time()
         while True:
             alive = False
             for p in self.procs:
@@ -122,4 +339,10 @@ class Launcher:
                     return code
             if not alive:
                 return 0
+            if self.multi_node and self.rdzv is not None and \
+                    time.time() - last_gen_check > 1.0:
+                last_gen_check = time.time()
+                if self.rdzv.generation() != self.gen:
+                    self._kill_all()
+                    return self.RESTART_SENTINEL
             time.sleep(0.2)

@@ -2,9 +2,12 @@
 
 Reference: python/paddle/distributed/launch/main.py argument surface
 (--nnodes, --nproc_per_node, --master, --log_dir, --elastic_level,
---max_restart) restricted to the single-host collective controller; the
-multi-host path on TPU pods is jax's coordination service with the same
-env contract (see __init__.build_rank_env).
+--max_restart).  --nnodes > 1 (or a min:max range) runs the TCPStore
+node rendezvous (see __init__.NodeRendezvous): every node launches this
+same command pointing --master at one reachable host; node ranks, the
+global JAX process world, and elastic re-forms are negotiated there,
+and workers land in jax.distributed.initialize via the env contract
+(__init__.build_rank_env).
 """
 from __future__ import annotations
 
@@ -23,7 +26,10 @@ def parse_args(argv=None):
                     help="comma-separated device ids")
     ap.add_argument("--master", type=str, default=None,
                     help="coordinator host:port")
-    ap.add_argument("--rank", type=int, default=-1)
+    ap.add_argument("--rank", type=int, default=-1,
+                    help="node rank (-1: auto via rendezvous order)")
+    ap.add_argument("--host", type=str, default=None,
+                    help="this node's reachable IP")
     ap.add_argument("--log_dir", type=str, default=None)
     ap.add_argument("--run_mode", type=str, default="collective")
     ap.add_argument("--job_id", type=str, default="default")
@@ -47,7 +53,9 @@ def main(argv=None):
     launcher = Launcher(
         cmd, nprocs, master=args.master, log_dir=args.log_dir,
         max_restarts=args.max_restart,
-        elastic=args.elastic_level >= 0, device_ids=device_ids)
+        elastic=args.elastic_level >= 0, device_ids=device_ids,
+        nnodes=args.nnodes, node_rank=args.rank, job_id=args.job_id,
+        node_ip=args.host or "127.0.0.1")
     return launcher.run()
 
 

@@ -53,7 +53,32 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["pipeline_1f1b", "pipeline_1f1b_hetero", "stack_stage_params"]
+__all__ = ["pipeline_1f1b", "pipeline_1f1b_hetero", "stack_stage_params",
+           "schedule_grid"]
+
+
+def schedule_grid(S, m, zero_bubble=False):
+    """Pure-Python model of the fused-tick schedule: grid[s][t] is the
+    set of unit types device s runs at tick t ('F', 'B' = dx, 'W' = dW).
+
+    1F1B fuses W with B; zero-bubble (ZB-H1,
+    passes/pipeline_scheduler_pass/pipeline_zero_bubble.py) defers each
+    device's LAST s microbatches' W units into its tail idle window
+    [T-s, T) — exactly the drain ticks that device would otherwise
+    spend idle — so the grid has strictly fewer idle (device, tick)
+    slots.  Tests and the executable engine share this placement."""
+    T = m + 2 * (S - 1)
+    grid = [[set() for _ in range(T)] for _ in range(S)]
+    for s in range(S):
+        for j in range(m):
+            grid[s][j + s].add("F")
+            tb = j + 2 * (S - 1) - s
+            grid[s][tb].add("B")
+            if zero_bubble and j >= m - s:
+                grid[s][T - (m - j)].add("W")     # deferred into tail idle
+            else:
+                grid[s][tb].add("W")              # fused with B
+    return grid
 
 
 def _tmap(f, *trees):
@@ -86,9 +111,21 @@ def stack_stage_params(layer_params_list, n_stages, n_virtual=1):
 
 def pipeline_1f1b(stage_fn: Callable, first_fn: Callable, last_fn: Callable,
                   stacked_params, first_params, last_params, aux, mesh,
-                  axis_name: str = "pp", n_virtual: int = 1):
+                  axis_name: str = "pp", n_virtual: int = 1,
+                  zero_bubble: bool = False):
     """One 1F1B forward+backward pass. Returns
     (loss_sum, d_stacked, d_first, d_last).
+
+    zero_bubble=True (v=1 only) runs the ZB-H1 unit placement from
+    `schedule_grid`: the backward tick computes dx immediately but
+    defers the dW of each device's last s microbatches into that
+    device's tail idle ticks, filling the drain (reference
+    passes/pipeline_scheduler_pass/pipeline_zero_bubble.py).  Gradients
+    are bit-identical to 1F1B.  NOTE: on this lockstep-SPMD engine the
+    win is the schedule-grid fill (and the reference's selectable-pass
+    parity), not wall clock — every device traces the same per-tick
+    program, so drain ticks already cost a full backward; the deferred
+    dW re-runs the stage forward for those s microbatches.
 
     stage_fn(chunk_params, x) -> x'     homogeneous trunk chunk
     first_fn(first_params, aux_j) -> x  stage-0 input (e.g. embedding)
@@ -107,6 +144,9 @@ def pipeline_1f1b(stage_fn: Callable, first_fn: Callable, last_fn: Callable,
     if v > 1:
         assert m % S == 0, \
             f"interleaved schedule needs n_micro % pp == 0, got {m} % {S}"
+    if zero_bubble:
+        assert v == 1, "zero_bubble composes with v=1 (ZB-H1)"
+        assert m >= S, f"zero_bubble needs n_micro >= pp, got {m} < {S}"
     vS = v * S
     n_buf = 2  # groups per chunk live at once (lifetime <= 2*v*S - 2)
     total_ticks = m * v + 2 * (S - 1) + (v - 1) * S
@@ -133,8 +173,9 @@ def pipeline_1f1b(stage_fn: Callable, first_fn: Callable, last_fn: Callable,
             return _tmap(
                 lambda a: jnp.where(active, a, jnp.zeros_like(a)), tree)
 
-        def tick(carry, t, do_fwd, do_bwd, do_tail):
-            (fwd_state, bwd_state, xbuf, dstk, dfp, dlp, loss_acc) = carry
+        def tick(carry, t, do_fwd, do_bwd, do_tail, do_w=False):
+            (fwd_state, bwd_state, xbuf, dstk, dfp, dlp, loss_acc,
+             carry_w) = carry
             dy_tail = None
 
             if do_fwd:
@@ -198,6 +239,21 @@ def pipeline_1f1b(stage_fn: Callable, first_fn: Callable, last_fn: Callable,
 
                 _, pull = jax.vjp(stage_fn, chunk_params(cb_c), x_saved)
                 dcp_j, dx = pull(dy)
+                if zero_bubble:
+                    # ZB-H1: the last s microbatches' dW defers to the
+                    # tail idle window; stash (x, dy) for the W unit
+                    defer = jnp.logical_and(b_act, j_b >= m - s)
+                    k_w = jnp.where(defer, j_b - (m - s), 0)
+                    wq_x = jax.lax.dynamic_update_index_in_dim(
+                        carry_w[0], jnp.where(defer, x_saved,
+                                              carry_w[0][k_w]),
+                        k_w, axis=0)
+                    wq_dy = jax.lax.dynamic_update_index_in_dim(
+                        carry_w[1], jnp.where(defer, dy,
+                                              carry_w[1][k_w]),
+                        k_w, axis=0)
+                    carry_w = (wq_x, wq_dy)
+                    dcp_j = mask(jnp.logical_not(defer), dcp_j)
                 dstk = _tmap(
                     lambda acc, g: jax.lax.dynamic_update_index_in_dim(
                         acc, _dyn(acc, cb_c) + g.astype(jnp.float32),
@@ -216,14 +272,34 @@ def pipeline_1f1b(stage_fn: Callable, first_fn: Callable, last_fn: Callable,
             else:
                 dx = jnp.zeros_like(fwd_state)
 
+            if do_w and zero_bubble:
+                # ---- deferred dW unit (drain ticks [T-s, T)) ---------
+                back = total_ticks - t            # in [1, s] when active
+                w_act = jnp.logical_and(back <= s, back >= 1)
+                j_w = m - back
+                k_w = jnp.where(w_act, j_w - (m - s), 0)
+                x_w = carry_w[0][k_w]
+                dy_w = mask(w_act, carry_w[1][k_w])
+                _, pull_w = jax.vjp(
+                    lambda p: stage_fn(p, x_w), chunk_params(0))
+                (dcp_w,) = pull_w(dy_w)
+                dstk = _tmap(
+                    lambda acc, g: jax.lax.dynamic_update_index_in_dim(
+                        acc, _dyn(acc, 0) + g.astype(jnp.float32),
+                        0, axis=0),
+                    dstk, dcp_w)
+
             # ---- ring communication ---------------------------------
             fwd_state = jax.lax.ppermute(y, axis_name, fwd_perm)
             bwd_state = jax.lax.ppermute(dx, axis_name, bwd_perm)
             return (fwd_state, bwd_state, xbuf, dstk, dfp, dlp,
-                    loss_acc), None
+                    loss_acc, carry_w), None
 
         x_dtype = x_shape.dtype
         zeros_x = jnp.zeros(x_shape.shape, x_dtype)
+        wq = (jnp.zeros((max(S - 1, 1),) + x_shape.shape, x_dtype),
+              jnp.zeros((max(S - 1, 1),) + x_shape.shape, x_dtype)) \
+            if zero_bubble else (jnp.zeros((1, 1)), jnp.zeros((1, 1)))
         carry = (
             zeros_x,                                   # fwd activation in
             zeros_x,                                   # bwd cotangent in
@@ -232,20 +308,23 @@ def pipeline_1f1b(stage_fn: Callable, first_fn: Callable, last_fn: Callable,
             _tmap(lambda a: jnp.zeros(a.shape, jnp.float32), fp),
             _tmap(lambda a: jnp.zeros(a.shape, jnp.float32), lp),
             jnp.zeros((), jnp.float32),
+            wq,                                        # deferred-W stash
         )
         # three statically-bounded phases: fwd-only / 1F1B / bwd-only
         # (the tail's first possible tick is vS-1 = warmup_end, so warmup
-        # provably skips the loss-head compute too)
+        # provably skips the loss-head compute too; deferred W units all
+        # live inside the drain window)
         for lo, hi, do_f, do_b in (
                 (0, warmup_end, True, False),
                 (warmup_end, drain_start, True, True),
                 (drain_start, total_ticks, False, True)):
             if hi > lo:
                 carry, _ = jax.lax.scan(
-                    lambda c, t, _f=do_f, _b=do_b: tick(c, t, _f, _b,
-                                                        do_tail=_f and _b),
+                    lambda c, t, _f=do_f, _b=do_b: tick(
+                        c, t, _f, _b, do_tail=_f and _b,
+                        do_w=(not _f) and _b),
                     carry, jnp.arange(lo, hi))
-        _, _, _, dstk, dfp, dlp, loss_acc = carry
+        _, _, _, dstk, dfp, dlp, loss_acc, _ = carry
 
         # stage grads stay pp-sharded; first/last grads + loss reduce
         loss_acc = jax.lax.psum(loss_acc, axis_name)
@@ -275,35 +354,44 @@ def pipeline_1f1b(stage_fn: Callable, first_fn: Callable, last_fn: Callable,
 
 
 def pipeline_1f1b_hetero(stage_fns, last_fn, params, aux, mesh,
-                         axis_name: str = "pp"):
-    """1F1B over HETEROGENEOUS stages (fleet PipelineLayer segments).
+                         axis_name: str = "pp", n_virtual: int = 1):
+    """1F1B over HETEROGENEOUS stages (fleet PipelineLayer segments),
+    with interleaved VPP when n_virtual > 1.
 
-    stage_fns: list of S callables; stage_fns[s](params, x, aux_j) -> h.
-      Stage 0 usually ignores x and builds its input from aux_j (the raw
-      microbatch); every stage's OUTPUT must have one common shape/dtype
-      (the ring activation).  The final segment belongs in last_fn, not
-      here — pass its slot as the identity (the builder in
-      fleet/meta_parallel does this).
+    stage_fns: list of S*n_virtual callables;
+      stage_fns[k](params, x, aux_j) -> h for segment k in model order.
+      Device s owns virtual chunks {c*S+s : c} (reference interleaved
+      assignment pipeline_parallel.py:1174).  Segment 0 usually ignores
+      x and builds its input from aux_j (the raw microbatch); every
+      segment's OUTPUT must have one common shape/dtype (the ring
+      activation).  The FINAL segment belongs in last_fn, not here —
+      pass its slot as the identity (the builder in fleet/meta_parallel
+      does this).
     last_fn(params, y, aux_j) -> scalar microbatch loss: the final
-      segment + loss head, run on the last device.
+      segment + loss head, run on the last device's last chunk.
     params: ONE replicated pytree; returned grads are psum'd over pp so
       each stage's contribution (zeros elsewhere) sums to the total.
     aux: per-microbatch inputs, leaves [m, ...] (replicated over pp).
 
     Returns (loss_sum, grads).
 
-    Per-device compute goes through `lax.switch` on the stage index —
-    branches are traced once and only the resident stage executes at run
-    time.  Same fused-tick mirror schedule as pipeline_1f1b (v=1), same
-    three-phase bubble structure, same bounded ring buffer.
+    Per-device compute goes through `lax.switch` on the segment index —
+    branches are traced once and only the resident segment executes at
+    run time.  Same fused-tick mirror schedule, three-phase bubble
+    structure, and bounded ring buffer as pipeline_1f1b.
     """
     S = mesh.shape[axis_name]
-    assert len(stage_fns) == S, (len(stage_fns), S)
+    v = int(n_virtual)
+    assert len(stage_fns) == S * v, (len(stage_fns), S, v)
     m = jax.tree_util.tree_leaves(aux)[0].shape[0]
+    if v > 1:
+        assert m % S == 0, \
+            f"interleaved schedule needs n_micro % pp == 0, got {m} % {S}"
+    vS = v * S
     n_buf = 2
-    total_ticks = m + 2 * (S - 1)
-    warmup_end = min(S - 1, total_ticks)
-    drain_start = min(m + S - 1, total_ticks)
+    total_ticks = m * v + 2 * (S - 1) + (v - 1) * S
+    warmup_end = min(vS - 1, total_ticks)
+    drain_start = min(m * v + S - 1, total_ticks)
 
     aux0 = _tmap(lambda a: jax.eval_shape(lambda x: x[0], a), aux)
     h_shape = jax.eval_shape(
@@ -321,28 +409,36 @@ def pipeline_1f1b_hetero(stage_fns, last_fn, params, aux, mesh,
             return _tmap(
                 lambda a: jnp.where(active, a, jnp.zeros_like(a)), tree)
 
-        def run_stage(p, x, aux_j):
+        def run_stage(c, p, x, aux_j):
+            # resident segment for chunk c on this device: c*S + s
             return jax.lax.switch(
-                s, [lambda pp_, x_, a_, _f=f: _f(pp_, x_, a_)
-                    for f in stage_fns], p, x, aux_j)
+                c * S + s, [lambda pp_, x_, a_, _f=f: _f(pp_, x_, a_)
+                            for f in stage_fns], p, x, aux_j)
 
         def tick(carry, t, do_fwd, do_bwd, do_tail):
             (fwd_state, bwd_state, xbuf, dparams, loss_acc) = carry
             dy_tail = None
 
             if do_fwd:
-                j_f = t - s
-                f_act = jnp.logical_and(j_f >= 0, j_f < m)
+                q = t - s
+                g_f = q // vS
+                c_f = jnp.clip((q % vS) // S, 0, v - 1)
+                r_f = q % S
+                j_f = g_f * S + r_f
+                f_act = jnp.logical_and(q >= 0, q < m * v)
                 jf_c = jnp.clip(j_f, 0, m - 1)
                 x_in = fwd_state
-                y = mask(f_act, run_stage(params, x_in, aux_at(jf_c)))
+                y = mask(f_act, run_stage(c_f, params, x_in, aux_at(jf_c)))
 
-                slot = jnp.where(f_act, j_f % (n_buf * S), 0)
+                slot_f = (g_f % n_buf) * S + r_f
+                write = jnp.where(f_act, c_f * (n_buf * S) + slot_f, 0)
                 xbuf = jax.lax.dynamic_update_index_in_dim(
-                    xbuf, jnp.where(f_act, x_in, xbuf[slot]), slot, axis=0)
+                    xbuf, jnp.where(f_act, x_in, xbuf[write]), write,
+                    axis=0)
 
                 if do_tail:
-                    tail_act = jnp.logical_and(f_act, s == S - 1)
+                    tail_act = jnp.logical_and(
+                        f_act, jnp.logical_and(s == S - 1, c_f == v - 1))
                     (loss_j, (dy_tail, dp_tail)) = jax.value_and_grad(
                         lambda yy, p: last_fn(p, yy, aux_at(jf_c)),
                         argnums=(0, 1))(y, params)
@@ -356,20 +452,26 @@ def pipeline_1f1b_hetero(stage_fns, last_fn, params, aux, mesh,
                 y = jnp.zeros_like(fwd_state)
 
             if do_bwd:
-                j_b = t - (2 * (S - 1) - s)
-                b_act = jnp.logical_and(j_b >= 0, j_b < m)
+                w = t - (2 * (S - 1) - s) - (v - 1) * S
+                g_b = w // vS
+                c_b = jnp.clip((v - 1) - (w % vS) // S, 0, v - 1)
+                r_b = w % S
+                j_b = g_b * S + r_b
+                b_act = jnp.logical_and(w >= 0, w < m * v)
                 jb_c = jnp.clip(j_b, 0, m - 1)
 
+                tail_b = jnp.logical_and(s == S - 1, c_b == v - 1)
                 dy = bwd_state
                 if dy_tail is not None:
-                    dy = jnp.where(s == S - 1, dy_tail, dy)
+                    dy = jnp.where(tail_b, dy_tail, dy)
                 dy = mask(b_act, dy)
 
-                slot = jnp.where(b_act, j_b % (n_buf * S), 0)
-                x_saved = xbuf[slot]
+                slot_b = (g_b % n_buf) * S + r_b
+                read = jnp.where(b_act, c_b * (n_buf * S) + slot_b, 0)
+                x_saved = xbuf[read]
 
                 _, pull = jax.vjp(
-                    lambda p, x: run_stage(p, x, aux_at(jb_c)),
+                    lambda p, x: run_stage(c_b, p, x, aux_at(jb_c)),
                     params, x_saved)
                 dp_j, dx = pull(dy)
                 dparams = _tmap(lambda a, g: a + g.astype(jnp.float32),
@@ -384,7 +486,7 @@ def pipeline_1f1b_hetero(stage_fns, last_fn, params, aux, mesh,
         zeros_h = jnp.zeros(h_shape.shape, h_shape.dtype)
         carry = (
             zeros_h, zeros_h,
-            jnp.zeros((n_buf * S,) + h_shape.shape, h_shape.dtype),
+            jnp.zeros((v * n_buf * S,) + h_shape.shape, h_shape.dtype),
             _tmap(lambda a: jnp.zeros(a.shape, jnp.float32), params),
             jnp.zeros((), jnp.float32),
         )
