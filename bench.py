@@ -288,7 +288,7 @@ def bench_decode():
         cfg = LlamaConfig(
             vocab_size=32000, hidden_size=2048, intermediate_size=5632,
             num_hidden_layers=16, num_attention_heads=16,
-            num_key_value_heads=16, max_position_embeddings=2048,
+            num_key_value_heads=16, max_position_embeddings=4096,
             dtype="bfloat16")
         batch, prompt, new = 8, 128, 128
     else:
@@ -327,6 +327,40 @@ def bench_decode():
           {"pallas_kernel_tokens_per_sec": round(tps_kernel, 2),
            "batch": batch, "new_tokens": new, "device": dev.device_kind,
            "note": "vs_baseline = shipped(XLA-fused)/pallas ratio"})
+
+    # ---- ragged serving: paged (block-table) cache vs dense cache ----
+    # the scenario the reference's block_multi_head_attention exists
+    # for: one long context + short requests; dense pays batch*max_len
+    # everywhere, paged pays each sequence's own pages
+    if on_tpu:
+        prompt_r, new_r = 2048, 64
+        lens = np.array([2048, 160, 96, 224, 128, 192, 96, 160],
+                        np.int64)[:batch]
+        ids_r = paddle.to_tensor(np.random.randint(
+            0, cfg.vocab_size, (batch, prompt_r)).astype(np.int64))
+        lens_t = paddle.to_tensor(lens)
+
+        def run_ragged(**kw):
+            G._FN_CACHE.clear()
+            out = G.generate(m_, ids_r, max_new_tokens=new_r,
+                             lengths=lens_t, **kw)
+            float(np.asarray(out._data[0, -1]))
+            t0 = time.perf_counter()
+            out = G.generate(m_, ids_r, max_new_tokens=new_r,
+                             lengths=lens_t, **kw)
+            float(np.asarray(out._data[0, -1]))
+            return batch * new_r / (time.perf_counter() - t0)
+
+        m_ = model
+        tps_dense = run_ragged()
+        tps_paged = run_ragged(cache="paged", page_size=128)
+        _emit("llama_paged_ragged_tokens_per_sec_per_chip", tps_paged,
+              "tokens/s/chip", tps_paged / max(tps_dense, 1e-9),
+              {"dense_tokens_per_sec": round(tps_dense, 2),
+               "batch": batch, "prompt": prompt_r, "new_tokens": new_r,
+               "lengths": lens.tolist(), "device": dev.device_kind,
+               "note": "vs_baseline = paged/dense on the ragged batch "
+                       "(>1: block-table cache wins)"})
 
 
 def bench_lenet():

@@ -212,11 +212,22 @@ def load_state_dict(state_dict, path, process_group=None,
 
 
 def _set_by_path(state, dotted, value):
-    keys = dotted.split(".")
-    node = state
-    for k in keys[:-1]:
-        node = node[k]
-    node[keys[-1]] = value
+    """Rebind a flattened name.  Dots are ambiguous — they join nesting
+    levels AND appear inside flat keys ("llama.norm.weight") — so walk
+    by consuming the LONGEST key present at each level."""
+    node, rest = state, dotted
+    while True:
+        if rest in node and not isinstance(node[rest], dict):
+            node[rest] = value
+            return
+        parts = rest.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            k = ".".join(parts[:i])
+            if k in node and isinstance(node[k], dict):
+                node, rest = node[k], ".".join(parts[i:])
+                break
+        else:
+            raise KeyError(dotted)
 
 
 def _flatten_state(state, prefix=""):

@@ -90,17 +90,11 @@ def _prefill_layer(w, x, cos, sin, mask, cfg: LlamaConfig):
     q = q * cos_c + _rotate_half(q) * sin_c
     k = k * cos_c + _rotate_half(k) * sin_c
 
-    rep = nh // kvh
-    kq = jnp.repeat(k, rep, axis=2)
-    vq = jnp.repeat(v, rep, axis=2)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, kq,
-                        preferred_element_type=jnp.float32)
-    logits = logits / np.sqrt(hd)
-    causal = jnp.tril(jnp.ones((s, s), bool))
-    m = causal[None, None] & mask[:, None, None, :]
-    logits = jnp.where(m, logits, -jnp.inf)
-    probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
-    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vq).reshape(b, s, nh * hd)
+    # flash path: causal + key-padding mask, GQA in-kernel, O(S) memory
+    # (the naive [B,H,S,S] fp32 logits OOM long-prompt prefill)
+    from ..ops.pallas.flash_attention import sdpa
+    attn = sdpa(q, k, v, attn_mask=mask[:, None, None, :],
+                is_causal=True).reshape(b, s, nh * hd)
     x = x + attn @ w["o"]
     h = _rms(x, w["ln2"], cfg.rms_norm_eps)
     return x + (jax.nn.silu(h @ w["gate"]) * (h @ w["up"])) @ w["down"], k, v
@@ -139,6 +133,46 @@ def _decode_layer(w, x, kcache, vcache, cos1, sin1, pos, cfg: LlamaConfig):
             kcache, vcache)
 
 
+# ------------------------------------------------------- paged decode step
+def _decode_layer_paged(w, x, kpool, vpool, table, cos1, sin1, pos,
+                        cfg: LlamaConfig):
+    """Paged-cache decode layer: pools [P, kvH, ps, D], table
+    [B, max_pages]; pos [B] is the CURRENT token's position.  The
+    write targets page table[b, pos // ps] slot pos % ps — always a
+    real reserved page; reads go through the paged kernel (reference
+    block_multi_head_attention_kernel.cu)."""
+    b = x.shape[0]
+    nh, kvh, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                   cfg.head_dim)
+    ps = kpool.shape[2]
+    h = _rms(x[:, None], w["ln1"], cfg.rms_norm_eps)[:, 0]
+    q = (h @ w["q"]).reshape(b, nh, hd)
+    k = (h @ w["k"]).reshape(b, kvh, hd)
+    v = (h @ w["v"]).reshape(b, kvh, hd)
+    cos_c = cos1[:, None, :].astype(q.dtype)
+    sin_c = sin1[:, None, :].astype(q.dtype)
+    q = q * cos_c + _rotate_half(q) * sin_c
+    k = k * cos_c + _rotate_half(k) * sin_c
+
+    page = jnp.take_along_axis(table, (pos // ps)[:, None], axis=1)[:, 0]
+    off = pos % ps
+    heads = jnp.arange(kvh)
+    kpool = kpool.at[page[:, None], heads[None, :], off[:, None]].set(k)
+    vpool = vpool.at[page[:, None], heads[None, :], off[:, None]].set(v)
+
+    from ..ops.pallas.paged_attention import (paged_attention,
+                                              paged_attention_xla,
+                                              _INTERPRET)
+    fn = paged_attention if (
+        jax.default_backend() not in ("cpu",) or _INTERPRET) \
+        else paged_attention_xla
+    attn = fn(q, kpool, vpool, table, pos + 1).reshape(b, nh * hd)
+    x = x + attn @ w["o"]
+    h = _rms(x[:, None], w["ln2"], cfg.rms_norm_eps)[:, 0]
+    return (x + (jax.nn.silu(h @ w["gate"]) * (h @ w["up"])) @ w["down"],
+            kpool, vpool)
+
+
 # --------------------------------------------------------------- sampling
 def _sample(logits, key, gen: GenerationConfig):
     logits = logits.astype(jnp.float32)
@@ -159,6 +193,100 @@ def _sample(logits, key, gen: GenerationConfig):
         cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     return jax.random.categorical(key, logits, axis=-1)
+
+
+# ------------------------------------------------------------ paged main
+def build_generate_fn_paged(config: LlamaConfig, gen: GenerationConfig,
+                            prompt_len: int, page_size: int,
+                            num_pages: int, max_pages: int):
+    """Paged-cache generate: jitted (state, ids, lengths, key, table) ->
+    tokens.  Pools are allocated inside (zeros) with static shapes from
+    the PagedPool reservation; HBM scales with sum(len+new), not
+    B * max_len (reference block_multi_head_attention serving path)."""
+    L = config.num_hidden_layers
+    kvh, hd = config.num_key_value_heads, config.head_dim
+    T = prompt_len + gen.max_new_tokens
+    assert T <= config.max_position_embeddings
+    ps = page_size
+    prompt_pages = -(-prompt_len // ps)
+
+    def run(state, ids, lengths, key, table):
+        b = ids.shape[0]
+        dtype = state["llama.embed_tokens.weight"].dtype
+        rope_len = max(T, prompt_pages * ps)
+        cos, sin = _rope_tables(rope_len, config.head_dim,
+                                config.rope_theta)
+        cos = cos.astype(jnp.float32)
+        sin = sin.astype(jnp.float32)
+
+        kpool = jnp.zeros((L, num_pages, kvh, ps, hd), dtype)
+        vpool = jnp.zeros((L, num_pages, kvh, ps, hd), dtype)
+
+        # ---- prefill over the padded prompt, paging k/v into the pool
+        x = jnp.take(state["llama.embed_tokens.weight"], ids, axis=0)
+        pmask = jnp.arange(prompt_len)[None, :] < lengths[:, None]
+        spad = prompt_pages * ps - prompt_len
+        for i in range(L):
+            w = _layer_weights(state, i)
+            x, k, v = _prefill_layer(w, x, cos[:prompt_len],
+                                     sin[:prompt_len], pmask, config)
+            kp = jnp.pad(k, ((0, 0), (0, spad), (0, 0), (0, 0)))
+            vp = jnp.pad(v, ((0, 0), (0, spad), (0, 0), (0, 0)))
+            for p in range(prompt_pages):
+                rows_k = kp[:, p * ps:(p + 1) * ps].swapaxes(1, 2)
+                rows_v = vp[:, p * ps:(p + 1) * ps].swapaxes(1, 2)
+                kpool = kpool.at[i, table[:, p]].set(rows_k)
+                vpool = vpool.at[i, table[:, p]].set(rows_v)
+
+        x = _rms(x, state["llama.norm.weight"], config.rms_norm_eps)
+        head = state.get("lm_head.weight")
+
+        def logits_of(h):
+            if head is not None:
+                return h @ head
+            return h @ state["llama.embed_tokens.weight"].T
+
+        last = jnp.take_along_axis(
+            x, (lengths - 1)[:, None, None].astype(jnp.int32),
+            axis=1)[:, 0]
+        key, sub = jax.random.split(key)
+        tok = _sample(logits_of(last), sub, gen)
+
+        done = jnp.zeros((b,), bool)
+        if gen.eos_token_id is not None:
+            done = done | (tok == gen.eos_token_id)
+
+        def step(carry, key_t):
+            tok, pos, kpool, vpool, done = carry
+            emb = jnp.take(state["llama.embed_tokens.weight"], tok,
+                           axis=0)
+            cos1, sin1 = _rope_at(cos, sin, pos)
+            h = emb
+            kps, vps = [], []
+            for i in range(L):
+                w = _layer_weights(state, i)
+                h, kp_, vp_ = _decode_layer_paged(
+                    w, h, kpool[i], vpool[i], table, cos1, sin1, pos,
+                    config)
+                kps.append(kp_)
+                vps.append(vp_)
+            kpool = jnp.stack(kps)
+            vpool = jnp.stack(vps)
+            h = _rms(h[:, None], state["llama.norm.weight"],
+                     config.rms_norm_eps)[:, 0]
+            nxt = _sample(logits_of(h), key_t, gen)
+            if gen.eos_token_id is not None:
+                nxt = jnp.where(done, gen.pad_token_id, nxt)
+                done = done | (nxt == gen.eos_token_id)
+            return (nxt, pos + 1, kpool, vpool, done), tok
+
+        keys = jax.random.split(key, gen.max_new_tokens)
+        (tok, _, _, _, _), toks = jax.lax.scan(
+            step, (tok.astype(ids.dtype), lengths.astype(jnp.int32),
+                   kpool, vpool, done), keys)
+        return jnp.concatenate([ids, toks.T.astype(ids.dtype)], axis=1)
+
+    return jax.jit(run)
 
 
 # ------------------------------------------------------------------ main
@@ -252,20 +380,28 @@ def build_generate_fn(config: LlamaConfig, gen: GenerationConfig,
 
 def generate(model, input_ids, max_new_tokens=64, do_sample=False,
              temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
-             pad_token_id=0, seed=0, lengths=None):
+             pad_token_id=0, seed=0, lengths=None, cache="dense",
+             page_size=128):
     """User entry: model is a LlamaForCausalLM; input_ids [B, S] (right-
     padded if lengths given; new tokens overwrite the padded slots in the
-    cache). Returns [B, S + max_new_tokens] ids."""
+    cache). Returns [B, S + max_new_tokens] ids.
+
+    cache="paged" serves from a block-table pool (reference
+    block_multi_head_attention): HBM and attention reads scale with each
+    sequence's OWN length instead of the batch max — the win on ragged
+    batches."""
     from ..framework.tensor import Tensor
 
     ids = input_ids._data if isinstance(input_ids, Tensor) else \
         jnp.asarray(input_ids)
     b, s = ids.shape
     if lengths is None:
-        lengths_arr = jnp.full((b,), s, jnp.int32)
+        lengths_np = np.full((b,), s, np.int32)
     else:
-        lengths_arr = (lengths._data if isinstance(lengths, Tensor)
-                       else jnp.asarray(lengths)).astype(jnp.int32)
+        lengths_np = np.asarray(
+            lengths._data if isinstance(lengths, Tensor) else lengths,
+            np.int32)
+    lengths_arr = jnp.asarray(lengths_np)
     gen = GenerationConfig(
         max_new_tokens=max_new_tokens, do_sample=do_sample,
         temperature=temperature, top_k=top_k, top_p=top_p,
@@ -273,6 +409,28 @@ def generate(model, input_ids, max_new_tokens=64, do_sample=False,
     state = {k: (v._data if isinstance(v, Tensor) else v)
              for k, v in model.functional_state().items()}
     from ..ops.pallas import decode_attention as _DA
+
+    if cache == "paged":
+        from ..ops.pallas.paged_attention import PagedPool
+        pool = PagedPool(lengths_np, gen.max_new_tokens,
+                         page_size=page_size,
+                         min_table_width=-(-s // page_size))
+        cache_key = ("paged", astuple_cfg(model.config), s,
+                     gen.max_new_tokens, gen.do_sample, gen.temperature,
+                     gen.top_k, gen.top_p, gen.eos_token_id,
+                     gen.pad_token_id, pool.page_size, pool.num_pages,
+                     pool.max_pages)
+        fn = _FN_CACHE.get(cache_key)
+        if fn is None:
+            if len(_FN_CACHE) >= _FN_CACHE_MAX:
+                _FN_CACHE.pop(next(iter(_FN_CACHE)))
+            fn = _FN_CACHE[cache_key] = build_generate_fn_paged(
+                model.config, gen, s, pool.page_size, pool.num_pages,
+                pool.max_pages)
+        out = fn(state, ids, lengths_arr, jax.random.key(seed),
+                 jnp.asarray(pool.table))
+        return Tensor(out, stop_gradient=True)
+
     cache_key = (astuple_cfg(model.config), s,
                  gen.max_new_tokens, gen.do_sample, gen.temperature,
                  gen.top_k, gen.top_p, gen.eos_token_id, gen.pad_token_id,
