@@ -14,6 +14,8 @@ from .auto_parallel.api import shard_tensor, reshard, shard_layer, \
     shard_optimizer, dtensor_from_local, dtensor_to_local, unshard_dtensor, \
     ShardingStage1, ShardingStage2, ShardingStage3, get_placements
 from .shard_ops import sharding_constraint, annotate
+from .debug import (debug_shardings, ShardingReport,
+                    sharding_rules, OpShardRule)
 from . import fleet
 from . import rpc
 from . import ps
@@ -47,7 +49,8 @@ __all__ = [
     "dtensor_from_local", "dtensor_to_local", "unshard_dtensor",
     "ShardingStage1", "ShardingStage2", "ShardingStage3", "fleet",
     "checkpoint", "save_state_dict", "load_state_dict", "DataParallel",
-    "sharding_constraint", "annotate", "get_placements", "TCPStore",
+    "sharding_constraint", "annotate", "debug_shardings",
+    "ShardingReport", "sharding_rules", "OpShardRule", "get_placements", "TCPStore",
     "create_or_get_global_tcp_store",
     "ParallelMode", "ReduceType", "Strategy", "DistAttr", "DistModel",
     "to_static", "alltoall_single", "gather", "broadcast_object_list",

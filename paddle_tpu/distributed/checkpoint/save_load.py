@@ -105,8 +105,20 @@ def save_state_dict(state_dict, path, process_group=None,
     # ranks from returning before metadata.json exists.
     _barrier("fragments")
     if rank == coordinator_rank:
-        merged = {"tensors": {}}
+        # a reused directory may hold fragments/payloads from an older,
+        # larger world or a failed save whose shard entries point at
+        # stale payload files; the coordinator knows this save's world
+        # size and removes anything outside it before merging
         import glob
+        import re as _re
+        world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        world = max(world, jax.process_count())
+        for f in glob.glob(os.path.join(path, "meta_*.json")) \
+                + glob.glob(os.path.join(path, "shard_*.pkl")):
+            m = _re.search(r"_(\d+)\.(?:json|pkl)$", f)
+            if m and int(m.group(1)) >= world:
+                os.remove(f)
+        merged = {"tensors": {}}
         for frag in sorted(glob.glob(os.path.join(path, "meta_*.json"))):
             with open(frag) as f:
                 m = json.load(f)

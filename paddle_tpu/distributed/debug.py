@@ -1,0 +1,190 @@
+"""Sharding inspection + per-op rule pinning.
+
+Reference analog: the 113 per-op SPMD rule files
+(paddle/phi/infermeta/spmd_rules/matmul.cc ...) give every reference op
+deliberate, INSPECTABLE placement semantics.  On TPU, placement comes
+from GSPMD propagation — correct by construction but silent: a
+regression in a constraint upstream can quietly re-shard half the model.
+This module restores the two capabilities the rule files provide:
+
+  * `debug_shardings(fn, *args)` — compile and report, from the
+    SPMD-PARTITIONED module: every instruction's per-shard (local)
+    shape, the parameter/output shardings (which survive partitioning),
+    and the collective inventory (all-reduce/all-gather/...).  Tests pin
+    "what sharding did op X get" through its local shape — a [16,128]
+    matmul tiled dp=2 x tp=4 MUST appear as a [8,32] dot — and pin
+    "no surprise collectives" directly (the inspection surface);
+  * `sharding_rules({...})` / `pin_rule` — a per-op override that runs a
+    registry op under `jax.shard_map` with EXPLICIT in/out specs, for
+    the ops GSPMD gets wrong (the rule surface).
+"""
+from __future__ import annotations
+
+import contextlib
+import re
+import threading
+from dataclasses import dataclass
+
+import jax
+from jax.sharding import PartitionSpec
+
+__all__ = ["debug_shardings", "ShardingReport", "sharding_rules",
+           "OpShardRule"]
+
+# HLO text: `%name = bf16[8,128]{1,0} dot(...), sharding={devices=[2,1]0,1}`
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*"
+    r"(?P<type>[\w\[\],{}:()\s]*?)\s*"
+    r"(?P<kind>[\w\-]+)\((?P<rest>.*)$")
+_SHARD_RE = re.compile(r"sharding=\{([^}]*)\}")
+_SHAPE_RE = re.compile(r"^\(?\s*([a-z0-9]+)\[([\d,]*)\]")
+
+
+@dataclass
+class Instruction:
+    name: str          # HLO instruction name, e.g. dot.42
+    kind: str          # HLO opcode, e.g. dot / gather / custom-call
+    shape: str         # result type text, e.g. bf16[256,512]
+    sharding: str      # sharding annotation text ('' = none recorded)
+
+    def __repr__(self):
+        sh = self.sharding or "<default>"
+        return f"{self.name}: {self.kind} {self.shape} sharding={sh}"
+
+
+_COLLECTIVES = ("all-reduce", "all-gather", "all-to-all",
+                "collective-permute", "reduce-scatter")
+
+
+class ShardingReport(list):
+    """List[Instruction] with query helpers for tests/debugging."""
+
+    def find(self, kind=None, name=None):
+        out = ShardingReport(
+            i for i in self
+            if (kind is None or i.kind == kind)
+            and (name is None or name in i.name))
+        return out
+
+    def shardings(self, kind=None, name=None):
+        return [i.sharding for i in self.find(kind, name)]
+
+    def local_shapes(self, kind=None, name=None):
+        """Per-shard result shapes — the partitioned module's direct
+        record of how each op was tiled."""
+        return [i.shape for i in self.find(kind, name)]
+
+    def collectives(self):
+        """The communication GSPMD inserted: what to pin in regression
+        tests ('this step has exactly one tp all-reduce')."""
+        return ShardingReport(i for i in self
+                              if i.kind in _COLLECTIVES)
+
+    def summary(self, max_rows=40):
+        rows = [repr(i) for i in self
+                if i.sharding or i.kind in _COLLECTIVES][:max_rows]
+        more = len(self) - len(rows)
+        return "\n".join(rows + ([f"... +{more} more"] if more > 0
+                                  else []))
+
+
+def debug_shardings(fn, *args, static_argnums=(), **kwargs):
+    """Compile `fn(*args, **kwargs)` and return a ShardingReport of every
+    HLO instruction in the OPTIMIZED module, with the sharding XLA/GSPMD
+    assigned to it.  `fn` may already be jitted.
+
+        rep = dist.debug_shardings(train_step, params, batch)
+        assert "devices=[1,8]" in rep.find(kind="dot")[0].sharding
+    """
+    jitted = fn if hasattr(fn, "lower") else jax.jit(
+        fn, static_argnums=static_argnums)
+    compiled = jitted.lower(*args, **kwargs).compile()
+    report = ShardingReport()
+    for mod_text in [compiled.as_text()]:
+        for line in mod_text.splitlines():
+            m = _INSTR_RE.match(line)
+            if not m or "=" not in line:
+                continue
+            sh = _SHARD_RE.search(line)
+            ty = m.group("type").strip()
+            sm = _SHAPE_RE.match(ty)
+            report.append(Instruction(
+                name=m.group("name"), kind=m.group("kind"),
+                shape=(f"{sm.group(1)}[{sm.group(2)}]" if sm else ty),
+                sharding=sh.group(1) if sh else ""))
+    return report
+
+
+# ------------------------------------------------------------- pin rules
+@dataclass
+class OpShardRule:
+    """Explicit placement for one registry op: run its body under
+    shard_map(mesh, in_specs, out_specs).  in_specs: one PartitionSpec
+    per ARRAY input in flat order (non-array args stay closed over);
+    out_specs: a spec or pytree of specs matching the op's outputs."""
+    mesh: object
+    in_specs: tuple
+    out_specs: object
+    check_vma: bool = False
+
+
+class _RuleState(threading.local):
+    def __init__(self):
+        self.rules = {}
+
+
+_state = _RuleState()
+
+
+def get_pinned_rule(opname):
+    return _state.rules.get(opname)
+
+
+@contextlib.contextmanager
+def sharding_rules(rules):
+    """Pin per-op placements for the ops GSPMD propagates wrongly:
+
+        rule = dist.OpShardRule(mesh, in_specs=(P(None, "tp"), P("tp")),
+                                out_specs=P(None))
+        with dist.sharding_rules({"embedding": rule}):
+            loss = train_step(...)
+
+    Inside the scope, every dispatch of the named ops runs its body
+    under jax.shard_map with the given specs — GSPMD cannot re-decide
+    those ops' placement (reference: the per-op rule files under
+    paddle/phi/infermeta/spmd_rules/)."""
+    saved = dict(_state.rules)
+    _state.rules.update(rules)
+    try:
+        yield
+    finally:
+        _state.rules = saved
+
+
+def apply_rule(rule: OpShardRule, body, args, kwargs):
+    """Run `body(*args, **kwargs)` under the rule's shard_map; arrays in
+    flat order consume rule.in_specs, everything else is closed over."""
+    from jax.tree_util import tree_flatten, tree_unflatten
+    import numpy as np
+
+    flat, treedef = tree_flatten((args, kwargs))
+    arr_pos = [i for i, x in enumerate(flat)
+               if isinstance(x, (jax.Array, np.ndarray))
+               or hasattr(x, "aval")]
+    if len(arr_pos) != len(rule.in_specs):
+        raise ValueError(
+            f"OpShardRule: {len(rule.in_specs)} in_specs for "
+            f"{len(arr_pos)} array inputs")
+
+    def inner(arrays):
+        flat2 = list(flat)
+        for p, a in zip(arr_pos, arrays):
+            flat2[p] = a
+        a2, k2 = tree_unflatten(treedef, flat2)
+        return body(*a2, **k2)
+
+    mesh = getattr(rule.mesh, "jax_mesh", rule.mesh)
+    return jax.shard_map(
+        inner, mesh=mesh, in_specs=(tuple(rule.in_specs),),
+        out_specs=rule.out_specs, check_vma=rule.check_vma)(
+            tuple(flat[p] for p in arr_pos))

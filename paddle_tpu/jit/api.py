@@ -136,8 +136,12 @@ class StaticFunction:
             return self._function(*args, **kwargs)
         try:
             return self._call_impl(args, kwargs)
-        except jax.errors.TracerBoolConversionError as e:
-            # tensor-dependent Python control flow: rewrite if/while onto
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.TracerIntegerConversionError,
+                jax.errors.TracerArrayConversionError) as e:
+            # tensor-dependent Python control flow: bool tests (`if t:`),
+            # `range(traced_n)` (integer/array conversion inside the
+            # iterator protocol) — rewrite if/while/for onto
             # lax.cond/lax.while_loop (reference dy2static transformers)
             # and retrace
             if self._converted:

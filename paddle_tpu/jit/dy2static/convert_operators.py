@@ -84,6 +84,56 @@ def _unpack(arrs, spec):
     return tuple(out)
 
 
+
+
+def _resolve_guarded_slots(arrs, spec, branch_fns, allow_all=False):
+    """Slots holding the return/break machinery's value registers
+    (__rbc_*) may be assigned on only SOME paths; every READ of them is
+    flag-guarded by construction, so the unassigned side can carry a
+    typed zero.  Abstractly probe the branch fns and seed such slots
+    with zeros of the assigned side's aval (reference return_transformer
+    RETURN_NO_VALUE placeholder).
+
+    allow_all=True (the while/for path) extends this to USER names first
+    assigned inside the loop body — e.g. a desugared nested for-range's
+    target, whose prolog init lives inside the outer loop's body.  The
+    reference loop_transformer fills such names with typed placeholders
+    the same way; the cost is that a ZERO-trip loop leaves them 0 rather
+    than raising NameError.  `if` branches keep the loud error for user
+    names (assign-on-both-paths is the readable contract there)."""
+    guarded = [j for j, sp in enumerate(spec)
+               if isinstance(sp, UndefinedVar)
+               and (allow_all or str(sp.name).startswith("__rbc_"))]
+    if not guarded:
+        return arrs, spec
+    probes = []
+    for fn in branch_fns:
+        mask_box = []
+
+        def run(arrs_, _fn=fn, _box=mask_box):
+            out = _fn(_unpack(arrs_, spec))
+            if not isinstance(out, tuple):
+                out = (out,)
+            oa, osp = _pack(out)
+            # concrete at trace time; must not ride eval_shape's outputs
+            _box.append([isinstance(x, UndefinedVar) for x in osp])
+            return oa
+        try:
+            oa_shapes = jax.eval_shape(run, arrs)
+        except Exception:
+            return arrs, spec          # let the real call surface errors
+        probes.append((oa_shapes, mask_box[0]))
+    arrs = list(arrs)
+    spec = list(spec)
+    for j in guarded:
+        assigned = [sh[j] for sh, mask in probes if not mask[j]]
+        if assigned:
+            aval = assigned[0]
+            arrs[j] = jnp.zeros(aval.shape, aval.dtype)
+            spec[j] = "array"
+    return tuple(arrs), spec
+
+
 def convert_ifelse(pred, true_fn, false_fn, vars_tuple):
     """`out_vars = convert_ifelse(pred, tfn, ffn, vars)` — reference
     convert_operators.py convert_ifelse.  true_fn/false_fn take and
@@ -93,6 +143,7 @@ def convert_ifelse(pred, true_fn, false_fn, vars_tuple):
         return true_fn(vars_tuple) if bool(p) else false_fn(vars_tuple)
 
     arrs, spec = _pack(vars_tuple)
+    arrs, spec = _resolve_guarded_slots(arrs, spec, (true_fn, false_fn))
     out_specs = {}
 
     def wrap(fn, tag):
@@ -149,6 +200,8 @@ def convert_while_loop(cond_fn, body_fn, vars_tuple):
         return vars_tuple
 
     arrs, spec = _pack(vars_tuple)
+    arrs, spec = _resolve_guarded_slots(arrs, spec, (body_fn,),
+                                        allow_all=True)
     out_spec_box = []
 
     def cond(arrs):

@@ -160,6 +160,253 @@ def _tuple(elts, ctx):
     return ast.Tuple(elts=elts, ctx=ctx)
 
 
+# ---------------------------------------------------------- early exits
+# Reference: dy2static/transformers/{return,break_continue,loop}
+# _transformer.py — `return`/`break`/`continue` become flag variables +
+# guarded remainders, and `for t in range(...)` desugars to `while`, so
+# the control-flow conversion below only ever sees straight-line
+# if/while bodies.  Flags are ordinary carried names (no _PREFIX, so the
+# carry collector threads them through lax.cond/while_loop).
+
+_RET_F, _RET_V = "__rbc_ret_f", "__rbc_ret_v"
+
+
+def _assign(name, value):
+    return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                      value=value)
+
+
+def _const(v):
+    return ast.Constant(value=v)
+
+
+class _EscapeScan(ast.NodeVisitor):
+    """Does this statement set an escape flag at the CURRENT level?
+    (returns anywhere outside nested defs; break/continue outside
+    nested loops)."""
+
+    def __init__(self):
+        self.found = False
+        self._loops = 0
+
+    def visit_Return(self, node):
+        self.found = True
+
+    def visit_Break(self, node):
+        if not self._loops:
+            self.found = True
+
+    def visit_Continue(self, node):
+        if not self._loops:
+            self.found = True
+
+    def _loop(self, node):
+        self._loops += 1
+        self.generic_visit(node)
+        self._loops -= 1
+
+    visit_While = _loop
+    visit_For = _loop
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _may_escape(stmt):
+    s = _EscapeScan()
+    s.visit(stmt)
+    return s.found
+
+
+class _HasReturn(ast.NodeVisitor):
+    def __init__(self):
+        self.found = False
+
+    def visit_Return(self, node):
+        self.found = True
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _not_any(flags):
+    """`not (f1 or f2 or ...)` — converted to convert_logical_* later."""
+    test = ast.BoolOp(op=ast.Or(),
+                      values=_names_load(flags)) if len(flags) > 1 \
+        else ast.Name(id=flags[0], ctx=ast.Load())
+    return ast.UnaryOp(op=ast.Not(), operand=test)
+
+
+class _EarlyExitRewriter:
+    """Rewrite one function body; self.uses_return reports whether the
+    return machinery was installed."""
+
+    def __init__(self):
+        self.n_loops = 0
+        self.uses_return = False
+
+    def run(self, fdef):
+        h = _HasReturn()
+        for s in fdef.body:
+            h.visit(s)
+        self.uses_return = h.found
+        body = self._block(list(fdef.body), loop_flags=())
+        if self.uses_return:
+            # ret_v is NOT pre-initialized: None cannot cross a lax.cond
+            # carry; the UndefinedVar guard machinery threads "unset"
+            # through converted branches, and the epilogue maps a still-
+            # unset slot back to python None (fall-off-the-end path)
+            body = [_assign(_RET_F, _const(False))] + body
+            epilogue = ast.parse(textwrap.dedent(f"""
+                try:
+                    __rbc_out = {_RET_V}
+                except (NameError, UnboundLocalError):
+                    __rbc_out = None
+                if isinstance(__rbc_out, {_PREFIX}undef):
+                    __rbc_out = None
+                return __rbc_out
+            """)).body
+            body.extend(epilogue)
+        fdef.body = body
+        return fdef
+
+    # ---- statement lists: guard everything after a possible escape
+    def _block(self, stmts, loop_flags):
+        out = []
+        for i, s in enumerate(stmts):
+            escapes = _may_escape(s)
+            out.extend(self._stmt(s, loop_flags))
+            if escapes and i + 1 < len(stmts):
+                rest = self._block(stmts[i + 1:], loop_flags)
+                flags = list(loop_flags)
+                if self.uses_return:
+                    flags.append(_RET_F)
+                out.append(ast.If(test=_not_any(flags), body=rest,
+                                  orelse=[]))
+                break
+        return out
+
+    def _stmt(self, s, loop_flags):
+        if isinstance(s, ast.Return):
+            return [_assign(_RET_V, s.value if s.value is not None
+                            else _const(None)),
+                    _assign(_RET_F, _const(True))]
+        if isinstance(s, ast.Break):
+            if not loop_flags:
+                raise Dy2StUnsupportedError(
+                    "to_static: `break` outside any loop")
+            return [_assign(loop_flags[0], _const(True))]
+        if isinstance(s, ast.Continue):
+            if not loop_flags:
+                raise Dy2StUnsupportedError(
+                    "to_static: `continue` outside any loop")
+            return [_assign(loop_flags[1], _const(True))]
+        if isinstance(s, ast.While):
+            return self._while(s)
+        if isinstance(s, ast.For):
+            return self._for(s, loop_flags)
+        if isinstance(s, ast.If):
+            s.body = self._block(s.body, loop_flags)
+            s.orelse = self._block(s.orelse, loop_flags)
+            return [s]
+        if isinstance(s, (ast.With, ast.Try)):
+            for attr in ("body", "orelse", "finalbody"):
+                blk = getattr(s, attr, None)
+                if blk:
+                    setattr(s, attr, self._block(blk, loop_flags))
+            return [s]
+        return [s]
+
+    def _loop_body(self, node_body, brk, cont):
+        """Shared while/for body: reset continue, run the rewritten body
+        with this loop's flags as the innermost escape context."""
+        body = [_assign(cont, _const(False))]
+        body.extend(self._block(node_body, loop_flags=(brk, cont)))
+        return body
+
+    def _cond_with_flags(self, test, brk):
+        flags = [brk] + ([_RET_F] if self.uses_return else [])
+        return ast.BoolOp(op=ast.And(), values=[_not_any(flags), test])
+
+    def _while(self, node):
+        if node.orelse:
+            raise Dy2StUnsupportedError(
+                "to_static: while/else is not convertible")
+        self.n_loops += 1
+        brk = f"__rbc_brk{self.n_loops}"
+        cont = f"__rbc_cont{self.n_loops}"
+        new = ast.While(test=self._cond_with_flags(node.test, brk),
+                        body=self._loop_body(node.body, brk, cont),
+                        orelse=[])
+        return [_assign(brk, _const(False)),
+                _assign(cont, _const(False)), new]
+
+    def _for(self, node, loop_flags):
+        """`for i in range(...)` -> while (traced bounds become
+        lax.while_loop); any other iterable keeps the python loop
+        (static unroll under trace) with flag-guarded body."""
+        if node.orelse:
+            raise Dy2StUnsupportedError(
+                "to_static: for/else is not convertible")
+        self.n_loops += 1
+        brk = f"__rbc_brk{self.n_loops}"
+        cont = f"__rbc_cont{self.n_loops}"
+        is_range = (isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range"
+                    and not node.iter.keywords
+                    and 1 <= len(node.iter.args) <= 3
+                    and isinstance(node.target, ast.Name))
+        prolog = [_assign(brk, _const(False)),
+                  _assign(cont, _const(False))]
+        if is_range:
+            uid = self.n_loops      # snapshot: _loop_body may nest loops
+            a = node.iter.args
+            start = _const(0) if len(a) == 1 else a[0]
+            stop = a[0] if len(a) == 1 else a[1]
+            step = a[2] if len(a) == 3 else _const(1)
+            if not (isinstance(step, ast.Constant)
+                    and isinstance(step.value, int) and step.value != 0):
+                raise Dy2StUnsupportedError(
+                    "to_static: for-range needs a non-zero constant "
+                    "int step")
+            i = node.target.id
+            ctr = f"__rbc_i{uid}"
+            cmp_op = ast.Lt() if step.value > 0 else ast.Gt()
+            test = ast.Compare(
+                left=ast.Name(id=ctr, ctx=ast.Load()), ops=[cmp_op],
+                comparators=[ast.Name(id=f"__rbc_stop{uid}",
+                                      ctx=ast.Load())])
+            # an internal counter drives the loop; the user's variable is
+            # assigned from it at the TOP of each iteration, so after the
+            # loop (or a break) it holds the last ENTERED value — python
+            # for-semantics, not one-step-high
+            body = [_assign(i, ast.Name(id=ctr, ctx=ast.Load()))]
+            body.extend(self._loop_body(node.body, brk, cont))
+            body.append(ast.AugAssign(
+                target=ast.Name(id=ctr, ctx=ast.Store()), op=ast.Add(),
+                value=_const(step.value)))
+            return prolog + [
+                _assign(ctr, start),
+                # prolog init types the lax.while carry; each iteration
+                # re-assigns from the counter (python for-semantics)
+                _assign(i, ast.Name(id=ctr, ctx=ast.Load())),
+                _assign(f"__rbc_stop{uid}", stop),
+                ast.While(test=self._cond_with_flags(test, brk),
+                          body=body, orelse=[])]
+        # generic iterable: python-level loop, flag-guarded iterations
+        guard_flags = [brk] + ([_RET_F] if self.uses_return else [])
+        body = [ast.If(test=_not_any(guard_flags),
+                       body=self._loop_body(node.body, brk, cont),
+                       orelse=[])]
+        return prolog + [ast.For(target=node.target, iter=node.iter,
+                                 body=body, orelse=[])]
+
+
 class _ControlFlowTransformer(ast.NodeTransformer):
     def __init__(self):
         self.counter = 0
@@ -300,6 +547,9 @@ def convert_to_static_callable(fn):
     # strip decorators (e.g. @to_static) so exec defines the plain fn
     if isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         fdef.decorator_list = []
+        # pass 1: return/break/continue -> flags, for-range -> while
+        _EarlyExitRewriter().run(fdef)
+    # pass 2: tensor-dependent if/while -> lax control flow
     new_tree = _ControlFlowTransformer().visit(tree)
     ast.fix_missing_locations(new_tree)
 

@@ -562,17 +562,14 @@ def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
     """Native RNN-T loss (reference binds warprnnt: phi/kernels/impl/
     warprnnt_kernel_impl.h).  logits [N, T, U+1, C], labels [N, U].
 
-    FastEmit (gradient-level emit rescaling in warprnnt) is not applied:
-    a nonzero ``fastemit_lambda`` warns and computes the standard
-    transducer NLL.
+    FastEmit (arXiv:2010.11148): the emission branches of the lattice
+    get their gradients scaled by (1 + lambda) while the loss VALUE and
+    the blank-branch gradients stay those of the standard transducer
+    NLL — warprnnt's gradient-level rescaling.  Realized functionally as
+    loss + lambda * (M - stop_gradient(M)) where M recomputes the NLL
+    with the blank lattice probabilities detached, so d(M) flows only
+    through the emit branches.
     """
-    if fastemit_lambda and not getattr(rnnt_loss, "_fastemit_warned", False):
-        import warnings
-        rnnt_loss._fastemit_warned = True
-        warnings.warn(
-            "rnnt_loss: fastemit_lambda is accepted for API parity but the "
-            "FastEmit gradient rescaling is not applied (standard "
-            "transducer loss computed)", stacklevel=2)
     lp = jax.nn.log_softmax(logits, axis=-1)
     N, T, U1, C = lp.shape
     U = U1 - 1
@@ -583,7 +580,24 @@ def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
         emit_lp = jnp.take_along_axis(
             lp_n[:, :U, :], lab[None, :, None].astype(jnp.int32),
             axis=2)[..., 0]                                # [T, U]
+        nll = _transducer_nll(blank_lp, emit_lp, t_len, u_len, U1,
+                              neg_inf)
+        if fastemit_lambda:
+            m = _transducer_nll(jax.lax.stop_gradient(blank_lp),
+                                emit_lp, t_len, u_len, U1, neg_inf)
+            nll = nll + fastemit_lambda * (m - jax.lax.stop_gradient(m))
+        return nll
 
+    losses = jax.vmap(per_sample)(lp, labels, logit_lengths,
+                                  label_lengths)
+    return _reduce(losses, reduction)
+
+
+def _transducer_nll(blank_lp, emit_lp, t_len, u_len, U1, neg_inf):
+    """One sample's transducer negative log-likelihood from the lattice
+    log-probs blank_lp [T, U+1] / emit_lp [T, U]."""
+    T = blank_lp.shape[0]
+    if T:
         u_idx = jnp.arange(U1)
 
         def t_step(alpha_prev, inp):
@@ -622,9 +636,6 @@ def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
         # final: alpha[t_len-1, u_len] + blank(t_len-1, u_len)
         ll = alpha_T[u_len] + blank_lp[jnp.maximum(t_len - 1, 0), u_len]
         return -ll
-
-    losses = jax.vmap(per_sample)(lp, labels, logit_lengths, label_lengths)
-    return _reduce(losses, reduction)
 
 
 @op

@@ -174,8 +174,9 @@ def decode_attention(q, kcache, vcache, pos):
         try:
             return _pallas_decode(q, kcache, vcache, pos, block_t)
         except Exception:
-            pass
-    return _xla_decode(q, kcache, vcache, pos)
+            from .flash_attention import _warn_fallback_once
+            _warn_fallback_once()   # advisor r2: silent kernel loss is
+    return _xla_decode(q, kcache, vcache, pos)   # a perf-bug magnet
 
 
 def _xla_decode(q, kcache, vcache, pos):

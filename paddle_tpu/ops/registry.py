@@ -213,6 +213,14 @@ def _eager_cached_call(opname, body, flat, treedef, t_idx, diff_flags,
     return fn(tuple(dyn_vals))
 
 
+def _pinned_rule(opname):
+    import sys
+    mod = sys.modules.get("paddle_tpu.distributed.debug")
+    if mod is None or not mod._state.rules:   # zero-cost until used
+        return None
+    return mod.get_pinned_rule(opname)
+
+
 def apply_op(opname, body, args, kwargs):
     from ..framework.tensor import Tensor
     from ..amp.auto_cast import maybe_amp_cast
@@ -231,6 +239,17 @@ def apply_op(opname, body, args, kwargs):
 
     args, kwargs = maybe_amp_cast(opname, args, kwargs)
 
+    # pinned SPMD rule (distributed.debug.sharding_rules): run the body
+    # under shard_map with explicit specs; context-dependent, so the
+    # eager cache is bypassed for the op while a rule is active
+    rule = _pinned_rule(opname)
+    if rule is not None:
+        from ..distributed.debug import apply_rule
+        orig_body = body
+
+        def body(*a, **k):  # noqa: F811 — deliberate shadow
+            return apply_rule(rule, orig_body, a, k)
+
     flat, treedef = tree_flatten((args, kwargs), is_leaf=_is_tensor)
     t_idx = [i for i, x in enumerate(flat) if isinstance(x, Tensor)]
     tensors = [flat[i] for i in t_idx]
@@ -239,7 +258,8 @@ def apply_op(opname, body, args, kwargs):
     record = tape.is_grad_enabled() and any(
         not t.stop_gradient for t in tensors)
 
-    if EAGER_CACHE_ENABLED and opname not in _UNCACHEABLE:
+    if EAGER_CACHE_ENABLED and rule is None \
+            and opname not in _UNCACHEABLE:
         diff_flags = {i: (record and not flat[i].stop_gradient)
                       for i in t_idx}
         cached = _eager_cached_call(opname, body, flat, treedef, t_idx,

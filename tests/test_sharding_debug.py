@@ -1,0 +1,96 @@
+"""Sharding inspection + pin-rule surface (VERDICT r2 missing #3;
+reference paddle/phi/infermeta/spmd_rules/)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu.distributed as dist
+
+needs8 = pytest.mark.skipif(len(jax.devices()) < 8,
+                            reason="needs 8 virtual devices")
+
+
+@needs8
+def test_debug_shardings_reports_matmul_placement():
+    mesh = Mesh(np.asarray(jax.devices()).reshape(2, 4), ("dp", "tp"))
+    x = jax.device_put(np.ones((16, 64), np.float32),
+                       NamedSharding(mesh, P("dp", None)))
+    w = jax.device_put(np.ones((64, 128), np.float32),
+                       NamedSharding(mesh, P(None, "tp")))
+
+    def f(x, w):
+        return jnp.tanh(x @ w)
+
+    rep = dist.debug_shardings(f, x, w)
+    assert isinstance(rep, dist.ShardingReport)
+    # the partitioned module works on per-shard shapes: a [16,64]@[64,128]
+    # under dp=2 x tp=4 MUST appear as an [8,32]-producing dot
+    assert "f32[8,32]" in rep.local_shapes(kind="dot"), rep.summary()
+    # and x[dp,:] @ w[:,tp] needs no communication at all
+    assert not rep.collectives(), rep.summary()
+    # parameter shardings survive partitioning verbatim
+    assert any("devices=" in s for s in rep.shardings(kind="parameter"))
+
+
+@needs8
+def test_debug_shardings_llama_embedding_regression():
+    """The llama_hybrid embedding must come out dp-sharded on tokens
+    (not replicated, not vocab-gathered) under the tp x dp mesh."""
+    from paddle_tpu.models.llama import llama_tiny
+    from paddle_tpu.models import llama_hybrid as H
+
+    cfg = llama_tiny(num_hidden_layers=2, hidden_size=64,
+                     intermediate_size=128, vocab_size=128,
+                     num_attention_heads=4, num_key_value_heads=4)
+    mesh = H.build_mesh(8, pp=1, dp=2, tp=4)
+    params, opt = H.setup(cfg, mesh)
+    step = H.build_train_step(cfg, mesh, n_micro=1, sp=False)
+    ids = jnp.asarray(np.random.randint(0, 128, (4, 17)), jnp.int64)
+    rep = dist.debug_shardings(step, params, opt, ids)
+    # the embedding path consumes dp-LOCAL token ids: s64[2,17]
+    # (= batch 4 / dp 2) — a replicated-embedding regression would show
+    # s64[4,17] instead (XLA fuses the gather itself out of top level)
+    shapes = [i.shape for i in rep]
+    assert "s64[2,17]" in shapes, rep.summary()
+    assert "s64[4,17]" not in shapes, rep.summary()
+    # and the step's communication inventory is inspectable
+    kinds = {i.kind for i in rep.collectives()}
+    assert "all-reduce" in kinds, rep.summary()
+
+
+@needs8
+def test_pin_rule_overrides_gspmd():
+    """A pinned rule must run the op's body under shard_map with the
+    given specs — observable as psum-free local math on each shard."""
+    import paddle_tpu as paddle
+    from paddle_tpu.ops.registry import op
+
+    mesh = Mesh(np.asarray(jax.devices()), ("tp",))
+
+    @op
+    def _rowsum_test_op(x):
+        # without a rule: sums the FULL array; with the pinned rule each
+        # shard sums only its rows -> per-shard partial sums
+        return jnp.sum(x, axis=0)
+
+    x = jax.device_put(np.arange(32, dtype=np.float32).reshape(8, 4),
+                       NamedSharding(mesh, P("tp", None)))
+    full = _rowsum_test_op(paddle.to_tensor(x)).numpy()
+    rule = dist.OpShardRule(mesh, in_specs=(P("tp", None),),
+                            out_specs=P("tp"))
+    with dist.sharding_rules({"_rowsum_test_op": rule}):
+        stacked = _rowsum_test_op(paddle.to_tensor(x)).numpy()
+    # each of the 8 shards holds one [1,4] row; its local axis-0 sum is
+    # that row, and P("tp") out concatenates them -> x.ravel(): proof
+    # the body ran SHARD-LOCALLY instead of GSPMD's global semantics
+    np.testing.assert_allclose(stacked, np.asarray(x).ravel())
+    np.testing.assert_allclose(full, np.asarray(x).sum(axis=0))
+
+
+def test_debug_shardings_single_device_smoke():
+    rep = dist.debug_shardings(lambda a: a * 2 + 1,
+                               jnp.ones((4, 4)))
+    assert len(rep) > 0
