@@ -424,8 +424,13 @@ def bench_lenet():
 
 
 def main():
-    for fn in (bench_llama, bench_resnet50, bench_bert, bench_moe,
-               bench_decode, bench_lenet):
+    # the eager-dispatch rung goes FIRST: it measures per-op
+    # Python+dispatch latency, which degrades (measured 29 -> 16
+    # steps/s) once the other rungs' compiled executables and buffers
+    # live in the process; a subprocess instead would contend with the
+    # parent's device session on the tunneled transport
+    for fn in (bench_lenet, bench_llama, bench_resnet50, bench_bert,
+               bench_moe, bench_decode):
         try:
             fn()
         except Exception as e:  # keep the rest of the ladder running
