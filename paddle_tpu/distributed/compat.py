@@ -74,9 +74,17 @@ class DistAttr:
 
 
 class DistModel:
-    """Static-graph-style driver over a sharded model (reference
-    distributed/auto_parallel/api.py:2110 DistModel over Engine): holds
-    model/loss/optimizer, mode switching, callable step."""
+    """Compiled driver over a sharded model (reference
+    distributed/auto_parallel/api.py:2110 DistModel over Engine +
+    static/engine.py's partition/plan pipeline).
+
+    The d2s bridge, TPU-native: calling the DistModel compiles ONE XLA
+    program per mode+signature — forward, loss, backward and optimizer
+    update fused — whose distribution GSPMD plans from the parameters'
+    and inputs' shardings (shard_layer/shard_tensor placements flow
+    straight into the compiled step; the reference's completion/
+    partitioner/cost-model pipeline is the compiler's job here).
+    Parameters keep their mesh placements across steps."""
 
     def __init__(self, layer, loader=None, loss=None, optimizer=None,
                  strategy=None, metrics=None):
@@ -86,6 +94,8 @@ class DistModel:
         self._optimizer = optimizer
         self._strategy = strategy or Strategy()
         self._mode = "train" if optimizer is not None else "predict"
+        self._train_step = None
+        self._eval_jit = {}
 
     def train(self):
         self._mode = "train"
@@ -99,17 +109,72 @@ class DistModel:
         self._mode = "predict"
         self.network.eval()
 
+    def _compiled_train(self):
+        if self._train_step is None:
+            from ..jit.functional import TrainStep
+            loss_fn = self._loss
+
+            def step_loss(m, *batch):
+                return loss_fn(m(*batch[:-1]), batch[-1])
+
+            self._train_step = TrainStep(self.network, self._optimizer,
+                                         step_loss)
+        return self._train_step
+
+    def _compiled_eval(self, args):
+        """Cached jitted forward(+loss) over the functional state."""
+        import jax
+        from ..framework.tensor import Tensor
+        from ..framework import random as _random
+        from ..jit.functional import _as_arrays
+
+        arrays = _as_arrays(args)
+        sig = (self._mode, tuple(
+            (tuple(a.shape), str(a.dtype))
+            for a in jax.tree_util.tree_leaves(arrays)))
+        fn = self._eval_jit.get(sig)
+        if fn is None:
+            model, loss_fn, mode = self.network, self._loss, self._mode
+
+            @jax.jit
+            def run(state, key, *batch):
+                with _random.trace_key_guard(key):
+                    saved = model.functional_state()
+                    model.load_functional_state(state)
+                    try:
+                        ins = jax.tree_util.tree_map(
+                            lambda a: Tensor(a, stop_gradient=True),
+                            list(batch))
+                        # train-without-optimizer and eval both return
+                        # the loss; predict returns the raw outputs
+                        if mode != "predict" and loss_fn is not None:
+                            out = loss_fn(model(*ins[:-1]),
+                                          ins[-1])._data
+                        else:
+                            out = jax.tree_util.tree_map(
+                                lambda t: t._data, model(*ins),
+                                is_leaf=lambda t: isinstance(t, Tensor))
+                        # buffer mutations (BN running stats in train
+                        # mode) must survive the jit boundary
+                        new_bufs = {k: v for k, v in
+                                    model.functional_state().items()
+                                    if k.startswith("buffers.")}
+                        return out, new_bufs
+                    finally:
+                        model.load_functional_state(saved)
+
+            fn = self._eval_jit[sig] = run
+        state = dict(self.network.functional_state())
+        out, new_bufs = fn(state, _random.split_key(), *arrays)
+        self.network.load_functional_state(new_bufs)
+        return jax.tree_util.tree_map(
+            lambda a: Tensor(a, stop_gradient=True), out)
+
     def __call__(self, *args):
-        if self._mode == "predict" or self._loss is None:
-            return self.network(*args)
-        *inputs, label = args
-        out = self.network(*inputs)
-        loss = self._loss(out, label)
-        if self._mode == "train" and self._optimizer is not None:
-            loss.backward()
-            self._optimizer.step()
-            self._optimizer.clear_grad()
-        return loss
+        if self._mode == "train" and self._optimizer is not None \
+                and self._loss is not None:
+            return self._compiled_train()(*args)
+        return self._compiled_eval(args)
 
     def state_dict(self, mode="all"):
         state = dict(self.network.state_dict())
