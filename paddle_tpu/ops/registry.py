@@ -221,6 +221,28 @@ def _pinned_rule(opname):
     return mod.get_pinned_rule(opname)
 
 
+def _enforce_note(e, opname, flat):
+    """PADDLE_ENFORCE-style context (reference paddle/phi/core/enforce.h:
+    errors carry the failing op + a summary of its inputs): annotate any
+    exception escaping op dispatch via PEP-678 notes — the exception
+    class and control flow are untouched, so jax's tracer-conversion
+    errors (which dy2static relies on) still propagate intact."""
+    try:
+        descs = []
+        for x in flat:
+            a = getattr(x, "_data", x)
+            if hasattr(a, "shape") and hasattr(a, "dtype"):
+                descs.append(f"{getattr(a, 'dtype', '?')}{list(np.shape(a))}")
+            if len(descs) >= 6:
+                descs.append("...")
+                break
+        e.add_note(f"[paddle_tpu] raised while running op "
+                   f"'{opname}' (tensor inputs: {', '.join(descs) or 'none'})")
+    except Exception:
+        pass
+    return e
+
+
 def apply_op(opname, body, args, kwargs):
     from ..framework.tensor import Tensor
     from ..amp.auto_cast import maybe_amp_cast
@@ -262,8 +284,11 @@ def apply_op(opname, body, args, kwargs):
             and opname not in _UNCACHEABLE:
         diff_flags = {i: (record and not flat[i].stop_gradient)
                       for i in t_idx}
-        cached = _eager_cached_call(opname, body, flat, treedef, t_idx,
-                                    diff_flags, record)
+        try:
+            cached = _eager_cached_call(opname, body, flat, treedef,
+                                        t_idx, diff_flags, record)
+        except Exception as e:
+            raise _enforce_note(e, opname, flat)
         if cached is not None:
             out, raw_vjp = cached
             if not record:
@@ -277,7 +302,10 @@ def apply_op(opname, body, args, kwargs):
         for i, a in zip(t_idx, arrays):
             flat2[i] = a
         a2, k2 = tree_unflatten(treedef, flat2)
-        out = body(*a2, **k2)
+        try:
+            out = body(*a2, **k2)
+        except Exception as e:
+            raise _enforce_note(e, opname, flat)
         return _wrap_outputs(opname, out, node=None)
 
     diff_tensors = [t for t in tensors if not t.stop_gradient]
@@ -291,7 +319,10 @@ def apply_op(opname, body, args, kwargs):
         a2, k2 = tree_unflatten(treedef, flat2)
         return body(*a2, **k2)
 
-    out, raw_vjp = jax.vjp(closed, *[t._data for t in diff_tensors])
+    try:
+        out, raw_vjp = jax.vjp(closed, *[t._data for t in diff_tensors])
+    except Exception as e:
+        raise _enforce_note(e, opname, flat)
     return _record_node(opname, out, raw_vjp, diff_tensors)
 
 

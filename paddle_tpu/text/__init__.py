@@ -86,22 +86,27 @@ class ViterbiDecoder(Layer):
                               self.include_bos_eos_tag)
 
 
+from .datasets_impl import (UCIHousing, Imdb, Imikolov, Movielens,
+                            MovieInfo, UserInfo)
+
+
 def _dataset_stub(name):
     class _Stub:
         def __init__(self, *a, **k):
             raise RuntimeError(
-                f"paddle.text.datasets.{name} downloads external data; "
-                "this environment has no egress. Point paddle_tpu.io."
-                "Dataset at a local copy instead.")
+                f"paddle.text.datasets.{name} needs its BPE/SRL archive "
+                "layout; pass data through paddle_tpu.io.Dataset, or use "
+                "the implemented local-file datasets (UCIHousing/Imdb/"
+                "Imikolov/Movielens with data_file=)")
     _Stub.__name__ = name
     return _Stub
 
 
 class datasets:
-    Imdb = _dataset_stub("Imdb")
-    Imikolov = _dataset_stub("Imikolov")
-    Movielens = _dataset_stub("Movielens")
-    UCIHousing = _dataset_stub("UCIHousing")
+    Imdb = Imdb
+    Imikolov = Imikolov
+    Movielens = Movielens
+    UCIHousing = UCIHousing
     WMT14 = _dataset_stub("WMT14")
     WMT16 = _dataset_stub("WMT16")
     Conll05st = _dataset_stub("Conll05st")

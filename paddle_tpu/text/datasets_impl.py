@@ -1,0 +1,284 @@
+"""paddle.text datasets over LOCAL archives.
+
+Reference: python/paddle/text/datasets/{uci_housing,imdb,imikolov,
+movielens}.py.  This environment has no egress, so `download=True`
+without a `data_file` raises; given the reference's own archive format
+on disk (`data_file=`), parsing and item semantics match the reference:
+
+  UCIHousing  — whitespace floats, 14 per row; features mean-centered /
+                range-scaled on the FULL data; 80/20 train/test split.
+  Imdb        — aclImdb tar; vocabulary from train+test docs with
+                frequency > cutoff, sorted by (-freq, word), '<unk>'
+                last; items (word ids, [label]) with pos=0, neg=1.
+  Imikolov    — PTB simple-examples tar; vocab from train+valid with
+                freq > min_word_freq (plus '<s>'/'<e>' markers, '<unk>'
+                last); NGRAM windows or SEQ (src, trg) pairs.
+  Movielens   — ml-1m zip; user (id, gender, age-bucket, job) + movie
+                (id, category ids, title-word ids) + [rating*2-5],
+                random train/test split by `test_ratio`.
+"""
+from __future__ import annotations
+
+import collections
+import re
+import string
+import tarfile
+import zipfile
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["UCIHousing", "Imdb", "Imikolov", "Movielens",
+           "MovieInfo", "UserInfo"]
+
+_AGE_TABLE = [1, 18, 25, 35, 45, 50, 56]
+
+
+def _need_file(data_file, name):
+    if data_file is None:
+        raise RuntimeError(
+            f"paddle.text.datasets.{name}: this environment has no "
+            "egress to download the archive; pass data_file= pointing "
+            "at a local copy (same archive the reference downloads)")
+    return data_file
+
+
+class UCIHousing(Dataset):
+    """reference uci_housing.py; data_file: the whitespace-float file."""
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        assert mode.lower() in ("train", "test"), mode
+        self.mode = mode.lower()
+        self.data_file = _need_file(data_file, "UCIHousing")
+        self._load()
+
+    def _load(self, feature_num=14, ratio=0.8):
+        data = np.fromfile(self.data_file, sep=" ")
+        data = data.reshape(data.shape[0] // feature_num, feature_num)
+        maxs, mins, avgs = (data.max(axis=0), data.min(axis=0),
+                            data.mean(axis=0))
+        for i in range(feature_num - 1):
+            data[:, i] = (data[:, i] - avgs[i]) / (maxs[i] - mins[i])
+        offset = int(data.shape[0] * ratio)
+        self.data = data[:offset] if self.mode == "train" else data[offset:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return (row[:-1].astype(np.float32), row[-1:].astype(np.float32))
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(Dataset):
+    """reference imdb.py; data_file: the aclImdb tar archive."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True):
+        assert mode.lower() in ("train", "test"), mode
+        self.mode = mode.lower()
+        self.data_file = _need_file(data_file, "Imdb")
+        self.word_idx = self._build_dict(cutoff)
+        self._load()
+
+    def _tokenize(self, pattern):
+        docs = []
+        strip = string.punctuation.encode("latin-1")
+        with tarfile.open(self.data_file) as tarf:
+            for tf in tarf:
+                if pattern.match(tf.name):
+                    raw = tarf.extractfile(tf).read().rstrip(b"\n\r")
+                    docs.append(raw.translate(None, strip).lower().split())
+        return docs
+
+    def _build_dict(self, cutoff):
+        freq = collections.defaultdict(int)
+        allp = re.compile(r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$")
+        for doc in self._tokenize(allp):
+            for w in doc:
+                freq[w] += 1
+        kept = sorted(((w, c) for w, c in freq.items() if c > cutoff),
+                      key=lambda x: (-x[1], x[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load(self):
+        unk = self.word_idx["<unk>"]
+        self.docs, self.labels = [], []
+        for label, kind in ((0, "pos"), (1, "neg")):
+            pat = re.compile(rf"aclImdb/{self.mode}/{kind}/.*\.txt$")
+            for doc in self._tokenize(pat):
+                self.docs.append([self.word_idx.get(w, unk) for w in doc])
+                self.labels.append(label)
+
+    def __getitem__(self, idx):
+        return np.array(self.docs[idx]), np.array([self.labels[idx]])
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """reference imikolov.py; data_file: the PTB simple-examples tar."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50, download=True):
+        assert data_type.upper() in ("NGRAM", "SEQ"), data_type
+        assert mode.lower() in ("train", "test"), mode
+        self.data_type = data_type.upper()
+        self.window_size = window_size
+        self.mode = "train" if mode.lower() == "train" else "valid"
+        self.data_file = _need_file(data_file, "Imikolov")
+        self.word_idx = self._build_dict(min_word_freq)
+        self._load()
+
+    @staticmethod
+    def _count(f, freq):
+        for line in f:
+            for w in line.strip().split():
+                freq[w] += 1
+            freq[b"<s>"] += 1
+            freq[b"<e>"] += 1
+        return freq
+
+    def _build_dict(self, cutoff):
+        with tarfile.open(self.data_file) as tf:
+            freq = collections.defaultdict(int)
+            self._count(tf.extractfile(
+                "./simple-examples/data/ptb.train.txt"), freq)
+            self._count(tf.extractfile(
+                "./simple-examples/data/ptb.valid.txt"), freq)
+        freq.pop(b"<unk>", None)
+        kept = sorted(((w, c) for w, c in freq.items() if c > cutoff),
+                      key=lambda x: (-x[1], x[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        word_idx[b"<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load(self):
+        unk = self.word_idx[b"<unk>"]
+        self.data = []
+        name = {"train": "train", "valid": "valid"}[self.mode]
+        with tarfile.open(self.data_file) as tf:
+            f = tf.extractfile(f"./simple-examples/data/ptb.{name}.txt")
+            for line in f:
+                if self.data_type == "NGRAM":
+                    assert self.window_size > -1, "Invalid gram length"
+                    toks = [b"<s>", *line.strip().split(), b"<e>"]
+                    if len(toks) >= self.window_size:
+                        ids = [self.word_idx.get(w, unk) for w in toks]
+                        for i in range(self.window_size, len(ids) + 1):
+                            self.data.append(
+                                tuple(ids[i - self.window_size:i]))
+                else:
+                    ids = [self.word_idx.get(w, unk)
+                           for w in line.strip().split()]
+                    src = [self.word_idx[b"<s>"], *ids]
+                    trg = [*ids, self.word_idx[b"<e>"]]
+                    self.data.append((src, trg))
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class MovieInfo:
+    """reference movielens.py MovieInfo."""
+
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self, categories_dict, movie_title_dict):
+        return [[self.index],
+                [categories_dict[c] for c in self.categories],
+                [movie_title_dict[w.lower()] for w in self.title.split()]]
+
+    def __repr__(self):
+        return (f"<MovieInfo id({self.index}), title({self.title}), "
+                f"categories({self.categories})>")
+
+
+class UserInfo:
+    """reference movielens.py UserInfo."""
+
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = _AGE_TABLE.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [[self.index], [0 if self.is_male else 1], [self.age],
+                [self.job_id]]
+
+    def __repr__(self):
+        return (f"<UserInfo id({self.index}), age({_AGE_TABLE[self.age]}),"
+                f" job({self.job_id})>")
+
+
+class Movielens(Dataset):
+    """reference movielens.py; data_file: the ml-1m zip archive."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        assert mode.lower() in ("train", "test"), mode
+        self.mode = mode.lower()
+        self.test_ratio = test_ratio
+        self.rand_seed = rand_seed
+        self.data_file = _need_file(data_file, "Movielens")
+        np.random.seed(rand_seed)
+        self._load_meta()
+        self._load()
+
+    def _load_meta(self):
+        pattern = re.compile(r"^(.*)\((\d+)\)$")
+        self.movie_info, self.user_info = {}, {}
+        title_words, categories = set(), set()
+        with zipfile.ZipFile(self.data_file) as z:
+            with z.open("ml-1m/movies.dat") as f:
+                for line in f:
+                    mid, title, cats = line.decode(
+                        "latin").strip().split("::")
+                    cats = cats.split("|")
+                    categories.update(cats)
+                    title = pattern.match(title).group(1)
+                    self.movie_info[int(mid)] = MovieInfo(mid, cats, title)
+                    title_words.update(w.lower() for w in title.split())
+            self.movie_title_dict = {w: i for i, w in
+                                     enumerate(title_words)}
+            self.categories_dict = {c: i for i, c in enumerate(categories)}
+            with z.open("ml-1m/users.dat") as f:
+                for line in f:
+                    uid, gender, age, job, _ = line.decode(
+                        "latin").strip().split("::")
+                    self.user_info[int(uid)] = UserInfo(uid, gender, age,
+                                                        job)
+
+    def _load(self):
+        self.data = []
+        is_test = self.mode == "test"
+        with zipfile.ZipFile(self.data_file) as z:
+            with z.open("ml-1m/ratings.dat") as f:
+                for line in f:
+                    if (np.random.random() < self.test_ratio) != is_test:
+                        continue
+                    uid, mid, rating, _ = line.decode(
+                        "latin").strip().split("::")
+                    rating = float(rating) * 2 - 5.0
+                    self.data.append(
+                        self.user_info[int(uid)].value()
+                        + self.movie_info[int(mid)].value(
+                            self.categories_dict, self.movie_title_dict)
+                        + [[rating]])
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)

@@ -43,3 +43,29 @@ def test_gate_flags_regression(tmp_path, monkeypatch):
     fake_baseline.write_text(json.dumps(
         {"device": "other chip", "ops": {"matmul_2kx2k": 1e-9}}))
     assert op_bench.main(["--check"]) == 0          # device mismatch skip
+
+
+def test_op_errors_carry_enforce_context():
+    """PADDLE_ENFORCE analog (reference phi/core/enforce.h): exceptions
+    escaping op dispatch are annotated with the op name and tensor
+    input signatures, on both eager paths."""
+    import numpy as np
+    import pytest
+    import paddle_tpu as paddle
+    from paddle_tpu.autograd import tape
+
+    def notes_of(exc):
+        return "\n".join(getattr(exc, "__notes__", []) or [])
+
+    with pytest.raises(Exception) as ei:
+        paddle.matmul(paddle.ones([3, 4]), paddle.ones([5, 6]))
+    assert "op 'matmul'" in notes_of(ei.value)
+    assert "float32[3, 4]" in notes_of(ei.value)
+
+    # recorded (vjp) path too
+    x = paddle.to_tensor(np.ones((3, 4), np.float32),
+                         stop_gradient=False)
+    with pytest.raises(Exception) as ei:
+        with tape.enable_grad():
+            paddle.matmul(x, paddle.ones([5, 6]))
+    assert "op 'matmul'" in notes_of(ei.value)

@@ -1,0 +1,119 @@
+"""paddle.text datasets over local archives (reference
+python/paddle/text/datasets/): parsing + item semantics, synthesized
+archives standing in for the reference downloads (zero-egress env)."""
+import os
+import tarfile
+import zipfile
+import io
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import text
+
+
+def test_uci_housing(tmp_path):
+    rng = np.random.RandomState(0)
+    data = rng.rand(50, 14)
+    f = tmp_path / "housing.data"
+    with open(f, "w") as fh:
+        for row in data:
+            fh.write(" ".join(f"{v:.6f}" for v in row) + "\n")
+    tr = text.UCIHousing(data_file=str(f), mode="train")
+    te = text.UCIHousing(data_file=str(f), mode="test")
+    assert len(tr) == 40 and len(te) == 10
+    x, y = tr[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    # feature normalization: mean-centered, range-scaled (reference
+    # uci_housing.py _load_data)
+    allx = np.stack([tr[i][0] for i in range(len(tr))]
+                    + [te[i][0] for i in range(len(te))])
+    ref = (data[:, :-1] - data[:, :-1].mean(0)) / (
+        data[:, :-1].max(0) - data[:, :-1].min(0))
+    np.testing.assert_allclose(allx, ref, atol=1e-5)
+
+
+def _imdb_archive(path):
+    docs = {
+        "aclImdb/train/pos/0.txt": b"good good movie, truly great!",
+        "aclImdb/train/neg/0.txt": b"bad movie. terrible terrible",
+        "aclImdb/test/pos/0.txt": b"good fun",
+        "aclImdb/test/neg/0.txt": b"bad bad bad",
+    }
+    with tarfile.open(path, "w:gz") as tf:
+        for name, content in docs.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(content)
+            tf.addfile(info, io.BytesIO(content))
+
+
+def test_imdb(tmp_path):
+    f = tmp_path / "aclImdb.tgz"
+    _imdb_archive(str(f))
+    ds = text.Imdb(data_file=str(f), mode="train", cutoff=1)
+    # vocab: words with freq > 1 across ALL splits, (-freq, word) order,
+    # <unk> last: good(4), bad(5), movie(2), terrible(2)
+    # byte tokens + the reference's str "<unk>" sentinel key
+    assert set(ds.word_idx) == {b"bad", b"good", b"movie", b"terrible",
+                                "<unk>"}
+    assert ds.word_idx[b"bad"] == 0 and ds.word_idx[b"good"] == 1
+    assert len(ds) == 2
+    doc0, label0 = ds[0]
+    assert label0[0] == 0                 # pos first
+    unk = ds.word_idx["<unk>"]
+    assert list(doc0) == [1, 1, ds.word_idx[b"movie"], unk, unk]
+
+
+def _ptb_archive(path):
+    train = b"the cat sat\nthe cat ran\n"
+    valid = b"the dog sat\n"
+    with tarfile.open(path, "w:gz") as tf:
+        for name, content in (
+                ("./simple-examples/data/ptb.train.txt", train),
+                ("./simple-examples/data/ptb.valid.txt", valid)):
+            info = tarfile.TarInfo(name)
+            info.size = len(content)
+            tf.addfile(info, io.BytesIO(content))
+
+
+def test_imikolov_ngram_and_seq(tmp_path):
+    f = tmp_path / "ptb.tgz"
+    _ptb_archive(str(f))
+    ds = text.Imikolov(data_file=str(f), data_type="NGRAM", window_size=2,
+                       mode="train", min_word_freq=0)
+    assert len(ds) > 0
+    for gram in [ds[i] for i in range(len(ds))]:
+        assert len(gram) == 2
+    seq = text.Imikolov(data_file=str(f), data_type="SEQ", mode="train",
+                        min_word_freq=0)
+    src, trg = seq[0]
+    assert src[0] == seq.word_idx[b"<s>"]
+    assert trg[-1] == seq.word_idx[b"<e>"]
+    assert list(src[1:]) == list(trg[:-1])
+
+
+def test_movielens(tmp_path):
+    f = tmp_path / "ml.zip"
+    with zipfile.ZipFile(str(f), "w") as z:
+        z.writestr("ml-1m/movies.dat",
+                   "1::Toy Story (1995)::Animation|Comedy\n"
+                   "2::Jumanji (1995)::Adventure\n")
+        z.writestr("ml-1m/users.dat",
+                   "1::M::25::7::12345\n2::F::35::2::54321\n")
+        z.writestr("ml-1m/ratings.dat",
+                   "1::1::5::964982703\n2::2::3::964982703\n"
+                   "1::2::4::964982703\n")
+    ds = text.Movielens(data_file=str(f), mode="train", test_ratio=0.0)
+    assert len(ds) == 3
+    item = ds[0]
+    # (uid, gender, age, job, mov_id, categories, title_words, rating)
+    assert len(item) == 8
+    assert item[7][0] == 5.0 * 2 - 5.0
+    assert ds.user_info[2].is_male is False
+    assert ds.movie_info[1].title == "Toy Story "
+
+
+def test_wmt_stub_raises_clearly():
+    with pytest.raises(RuntimeError, match="data_file"):
+        text.WMT14()
