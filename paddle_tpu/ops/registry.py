@@ -232,10 +232,10 @@ def _enforce_note(e, opname, flat):
         for x in flat:
             a = getattr(x, "_data", x)
             if hasattr(a, "shape") and hasattr(a, "dtype"):
+                if len(descs) >= 6:      # truncate only when more remain
+                    descs.append("...")
+                    break
                 descs.append(f"{getattr(a, 'dtype', '?')}{list(np.shape(a))}")
-            if len(descs) >= 6:
-                descs.append("...")
-                break
         e.add_note(f"[paddle_tpu] raised while running op "
                    f"'{opname}' (tensor inputs: {', '.join(descs) or 'none'})")
     except Exception:
@@ -273,6 +273,17 @@ def apply_op(opname, body, args, kwargs):
             return apply_rule(rule, orig_body, a, k)
 
     flat, treedef = tree_flatten((args, kwargs), is_leaf=_is_tensor)
+    # ONE annotation point for every dispatch path below: anything that
+    # escapes gains the op/input context note
+    try:
+        return _dispatch(opname, body, flat, treedef, rule)
+    except Exception as e:
+        raise _enforce_note(e, opname, flat)
+
+
+def _dispatch(opname, body, flat, treedef, rule):
+    from ..framework.tensor import Tensor
+
     t_idx = [i for i, x in enumerate(flat) if isinstance(x, Tensor)]
     tensors = [flat[i] for i in t_idx]
     arrays = [t._data for t in tensors]
@@ -284,11 +295,8 @@ def apply_op(opname, body, args, kwargs):
             and opname not in _UNCACHEABLE:
         diff_flags = {i: (record and not flat[i].stop_gradient)
                       for i in t_idx}
-        try:
-            cached = _eager_cached_call(opname, body, flat, treedef,
-                                        t_idx, diff_flags, record)
-        except Exception as e:
-            raise _enforce_note(e, opname, flat)
+        cached = _eager_cached_call(opname, body, flat, treedef,
+                                    t_idx, diff_flags, record)
         if cached is not None:
             out, raw_vjp = cached
             if not record:
@@ -302,10 +310,7 @@ def apply_op(opname, body, args, kwargs):
         for i, a in zip(t_idx, arrays):
             flat2[i] = a
         a2, k2 = tree_unflatten(treedef, flat2)
-        try:
-            out = body(*a2, **k2)
-        except Exception as e:
-            raise _enforce_note(e, opname, flat)
+        out = body(*a2, **k2)
         return _wrap_outputs(opname, out, node=None)
 
     diff_tensors = [t for t in tensors if not t.stop_gradient]
@@ -319,10 +324,7 @@ def apply_op(opname, body, args, kwargs):
         a2, k2 = tree_unflatten(treedef, flat2)
         return body(*a2, **k2)
 
-    try:
-        out, raw_vjp = jax.vjp(closed, *[t._data for t in diff_tensors])
-    except Exception as e:
-        raise _enforce_note(e, opname, flat)
+    out, raw_vjp = jax.vjp(closed, *[t._data for t in diff_tensors])
     return _record_node(opname, out, raw_vjp, diff_tensors)
 
 

@@ -79,37 +79,43 @@ class Imdb(Dataset):
         assert mode.lower() in ("train", "test"), mode
         self.mode = mode.lower()
         self.data_file = _need_file(data_file, "Imdb")
-        self.word_idx = self._build_dict(cutoff)
-        self._load()
+        by_split = self._tokenize_all()      # ONE decompression pass
+        self.word_idx = self._build_dict(cutoff, by_split)
+        self._load(by_split)
 
-    def _tokenize(self, pattern):
-        docs = []
+    def _tokenize_all(self):
+        """One pass over the archive: docs keyed by (split, kind) — the
+        dict build and both label passes reuse it (the real aclImdb tar
+        holds ~100k members; re-scanning per pass triples load time)."""
+        pat = re.compile(r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
         strip = string.punctuation.encode("latin-1")
+        by_split = collections.defaultdict(list)
         with tarfile.open(self.data_file) as tarf:
             for tf in tarf:
-                if pattern.match(tf.name):
+                m = pat.match(tf.name)
+                if m:
                     raw = tarf.extractfile(tf).read().rstrip(b"\n\r")
-                    docs.append(raw.translate(None, strip).lower().split())
-        return docs
+                    by_split[m.groups()].append(
+                        raw.translate(None, strip).lower().split())
+        return by_split
 
-    def _build_dict(self, cutoff):
+    def _build_dict(self, cutoff, by_split):
         freq = collections.defaultdict(int)
-        allp = re.compile(r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$")
-        for doc in self._tokenize(allp):
-            for w in doc:
-                freq[w] += 1
+        for docs in by_split.values():
+            for doc in docs:
+                for w in doc:
+                    freq[w] += 1
         kept = sorted(((w, c) for w, c in freq.items() if c > cutoff),
                       key=lambda x: (-x[1], x[0]))
         word_idx = {w: i for i, (w, _) in enumerate(kept)}
         word_idx["<unk>"] = len(word_idx)
         return word_idx
 
-    def _load(self):
+    def _load(self, by_split):
         unk = self.word_idx["<unk>"]
         self.docs, self.labels = [], []
         for label, kind in ((0, "pos"), (1, "neg")):
-            pat = re.compile(rf"aclImdb/{self.mode}/{kind}/.*\.txt$")
-            for doc in self._tokenize(pat):
+            for doc in by_split.get((self.mode, kind), []):
                 self.docs.append([self.word_idx.get(w, unk) for w in doc])
                 self.labels.append(label)
 
@@ -232,7 +238,9 @@ class Movielens(Dataset):
         self.test_ratio = test_ratio
         self.rand_seed = rand_seed
         self.data_file = _need_file(data_file, "Movielens")
-        np.random.seed(rand_seed)
+        # per-instance stream: reseeding the GLOBAL numpy RNG would
+        # clobber the user's reproducibility state
+        self._rng = np.random.RandomState(rand_seed)
         self._load_meta()
         self._load()
 
@@ -266,7 +274,8 @@ class Movielens(Dataset):
         with zipfile.ZipFile(self.data_file) as z:
             with z.open("ml-1m/ratings.dat") as f:
                 for line in f:
-                    if (np.random.random() < self.test_ratio) != is_test:
+                    if (self._rng.random_sample() < self.test_ratio) \
+                            != is_test:
                         continue
                     uid, mid, rating, _ = line.decode(
                         "latin").strip().split("::")
