@@ -168,7 +168,10 @@ def _probe_pallas():
     return _PALLAS_OK
 
 
-def _pad_len(s, mult=256):
+def _pad_len(s, mult=128):
+    """Pad to a lane-tileable length: 128-multiples suffice for Mosaic
+    (block sizes need not be powers of two — seq 384 runs unpadded with
+    384-wide blocks instead of paying 33% padding to reach 512)."""
     return max(mult, -(-s // mult) * mult)
 
 
@@ -587,9 +590,14 @@ def flash_mha(q, k, v, causal, sm_scale, sq_real, sk_real):
 
 
 def _block_sizes(sq, sk):
-    bq = 512 if sq % 512 == 0 else 256
-    bk = 512 if sk % 512 == 0 else 256
-    return min(bq, sq), min(bk, sk)
+    """Largest 128-multiple divisor <= 512 per axis (the padded lengths
+    are 128-multiples, so 128 always divides)."""
+    def pick(n):
+        for b in (512, 384, 256, 128):
+            if n % b == 0:
+                return b
+        return 128
+    return min(pick(sq), sq), min(pick(sk), sk)
 
 
 def _flash_mha_fwd(q, k, v, causal, sm_scale, sq_real, sk_real):
