@@ -14,6 +14,9 @@ Timing methodology: each case runs inside one jitted lax.scan chain (a
 data dependency threads iterations) and cost is the T(n2)-T(n1) delta —
 host-fetch and dispatch latency cancel, which is essential on tunneled
 TPU transports where a single fetch costs ~100ms (see BASELINE.md).
+Run --check on an otherwise-idle host: heavy concurrent CPU load can
+skew the calibration pass and produce a false 2-3x reading (observed
+once against a full pytest run; re-run confirms).
 """
 from __future__ import annotations
 
