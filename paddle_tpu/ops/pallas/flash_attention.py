@@ -117,6 +117,7 @@ def _xla_sdpa(q, k, v, attn_mask=None, is_causal=False, dropout_p=0.0,
 
 
 _PALLAS_OK = None   # lazily probed once per process
+_INTERPRET = False  # tests: run the kernels anywhere via interpret mode
 
 
 def run_probe(fn):
@@ -431,6 +432,7 @@ def _flash_fwd_x32(q, k, v, causal, sm_scale, block_q, block_k, sq_real,
         in_specs=[blk, kv, kv],
         out_specs=out_specs if need_lse else out_specs[0],
         out_shape=out_shape if need_lse else out_shape[0],
+        interpret=_INTERPRET,
     )(q, k, v)
     return res if need_lse else (res, None)
 
@@ -551,6 +553,7 @@ def _flash_bwd_x32(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
         in_specs=[blk_q(), full_kv, full_kv, blk_q(), blk_q(), blk_l],
         out_specs=blk_q(),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=_INTERPRET,
     )(q, k, v, g, out, lse)
 
     blk_k = lambda: pl.BlockSpec((None, None, block_k, d),
@@ -568,6 +571,7 @@ def _flash_bwd_x32(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
         out_specs=[blk_k(), blk_k()],
         out_shape=[jax.ShapeDtypeStruct((b, h, sk, d), k.dtype),
                    jax.ShapeDtypeStruct((b, h, sk, d), v.dtype)],
+        interpret=_INTERPRET,
     )(q, k, v, g, out, lse)
     if grp > 1:
         dk = dk.reshape(b, hk, grp, sk, d).sum(axis=2)
