@@ -53,7 +53,8 @@ class GradScaler:
             if p._grad is None:
                 continue
             g = p._grad * inv
-            if not bool(jnp.all(jnp.isfinite(g))):
+            vals = g.values if hasattr(g, "values") else g  # RowSparseGrad
+            if not bool(jnp.all(jnp.isfinite(vals))):
                 found = True
             p._grad = g
         self._found_inf = found

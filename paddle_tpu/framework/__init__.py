@@ -3,6 +3,8 @@ from .dtype import *  # noqa: F401,F403
 from .tensor import Tensor, to_tensor, is_tensor
 from .random import seed, get_rng_state, set_rng_state, Generator, \
     default_generator, split_key, trace_key_guard
+from .selected_rows import RowSparseGrad, merge_rows, rowsparse_all_gather
 
 __all__ = ["Tensor", "to_tensor", "is_tensor", "seed", "get_rng_state",
-           "set_rng_state", "Generator"]
+           "set_rng_state", "Generator", "RowSparseGrad", "merge_rows",
+           "rowsparse_all_gather"]

@@ -1,0 +1,144 @@
+"""Row-sparse gradients — the TPU-native SelectedRows.
+
+The reference stores huge-vocab embedding gradients as a ``SelectedRows``
+(row indices + value rows, ``paddle/phi/core/selected_rows.h``) with
+dedicated kernels (``paddle/phi/kernels/selected_rows/``): the [V, D]
+dense gradient is never materialized, and optimizers apply updates to the
+touched rows only (``adam_kernel.cc`` lazy mode, sgd SelectedRows branch).
+
+TPU formulation: a :class:`RowSparseGrad` pytree of ``rows [N] int32`` +
+``values [N, D] `` with a *static* N (= number of lookups), so it is legal
+under ``jit``.  Duplicate rows are allowed and mean "sum" (exactly
+SelectedRows semantics).  ``merged()`` is the jit-safe analog of the
+reference ``merge_selected_rows`` kernel: after it, rows are unique (dup
+slots carry an out-of-range sentinel row and zero values, which every
+consumer drops via scatter ``mode='drop'``).
+
+The autograd tape carries RowSparseGrad cotangents natively: accumulation
+is ``__add__`` (sparse+sparse = concat, sparse+dense = densify), leaves
+hold it in ``Tensor._grad``, optimizers consume it row-wise (SGD always;
+Adam/AdamW when ``lazy_mode=True``) and densify otherwise.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["RowSparseGrad", "merge_rows", "rowsparse_all_gather"]
+
+
+@jax.tree_util.register_pytree_node_class
+class RowSparseGrad:
+    """rows: [N] int32 indices into dim 0; values: [N, *tail]; shape: dense."""
+
+    def __init__(self, rows, values, dense_shape):
+        self.rows = rows
+        self.values = values
+        self.dense_shape = tuple(int(s) for s in dense_shape)
+
+    # ------------------------------------------------------------ pytree
+    def tree_flatten(self):
+        return (self.rows, self.values), self.dense_shape
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux)
+
+    # ------------------------------------------------------- array-likes
+    @property
+    def shape(self):
+        return self.dense_shape
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def astype(self, dt):
+        return RowSparseGrad(self.rows, self.values.astype(dt),
+                             self.dense_shape)
+
+    def __mul__(self, s):
+        return RowSparseGrad(self.rows, self.values * s, self.dense_shape)
+
+    __rmul__ = __mul__
+
+    def __add__(self, other):
+        if other is None:
+            return self
+        if isinstance(other, RowSparseGrad):
+            if other.dense_shape != self.dense_shape:
+                raise ValueError(
+                    f"RowSparseGrad shape mismatch: {self.dense_shape} vs "
+                    f"{other.dense_shape}")
+            return RowSparseGrad(
+                jnp.concatenate([self.rows, other.rows]),
+                jnp.concatenate([self.values, other.values]),
+                self.dense_shape)
+        # dense on either side densifies (reference: sum over
+        # SelectedRows+DenseTensor yields dense)
+        return self.to_dense().astype(
+            jnp.result_type(self.dtype, other.dtype)) + other
+
+    __radd__ = __add__
+
+    def __repr__(self):
+        return (f"RowSparseGrad(rows={self.rows.shape}, "
+                f"values={self.values.shape}, dense={self.dense_shape})")
+
+    # ------------------------------------------------------------- kernels
+    def to_dense(self):
+        """Dense [V, D] equivalent (scatter-add; duplicate rows sum)."""
+        buf = jnp.zeros(self.dense_shape, self.values.dtype)
+        return buf.at[self.rows].add(self.values, mode="drop")
+
+    def merged(self):
+        """jit-safe merge_selected_rows: unique rows, dup slots zeroed.
+
+        Sorts rows, segment-sums duplicate runs into the run's first slot,
+        and marks the other slots with the out-of-range sentinel ``V`` so
+        scatters with ``mode='drop'`` ignore them.  N is unchanged (static
+        shapes under jit); consumers never index by sentinel rows.
+        """
+        v_sentinel = self.dense_shape[0]
+        order = jnp.argsort(self.rows)
+        r = self.rows[order]
+        v = self.values[order]
+        first = jnp.concatenate(
+            [jnp.ones((1,), bool), r[1:] != r[:-1]]) if r.shape[0] else \
+            jnp.ones((0,), bool)
+        # run id per slot; segment-sum values into the run's first position
+        run = jnp.cumsum(first.astype(jnp.int32)) - 1
+        summed = jax.ops.segment_sum(v, run, num_segments=max(r.shape[0], 1))
+        rows_out = jnp.where(first, r, v_sentinel) if r.shape[0] else r
+        # each run's first slot keeps the run sum; dup slots zero
+        vals_out = jnp.where(_bmask(first, v.ndim), summed[run],
+                             0).astype(v.dtype)
+        return RowSparseGrad(rows_out, vals_out, self.dense_shape)
+
+    def _sq_norm(self):
+        """Sum of squares of the DENSE equivalent (merges duplicates)."""
+        m = self.merged()
+        return jnp.sum(jnp.square(m.values.astype(jnp.float32)))
+
+def _bmask(mask, ndim):
+    return mask.reshape(mask.shape + (1,) * (ndim - 1))
+
+
+def merge_rows(g: RowSparseGrad) -> RowSparseGrad:
+    """Functional alias of :meth:`RowSparseGrad.merged` (reference
+    ``merge_selected_rows`` op)."""
+    return g.merged()
+
+
+def rowsparse_all_gather(g: RowSparseGrad, axis_name: str) -> RowSparseGrad:
+    """Data-parallel reduction of a row-sparse grad: concatenate every
+    rank's (rows, values) — the SelectedRows analog of allreduce (the
+    reference DP reducer allgathers SelectedRows rows/values rather than
+    densifying, ``python/paddle/distributed/parallel.py`` sparse branch).
+
+    Call inside ``shard_map``/``pmap`` with a bound ``axis_name``.  The
+    result's N is world_size * N_local (static).
+    """
+    rows = jax.lax.all_gather(g.rows, axis_name, tiled=True)
+    values = jax.lax.all_gather(g.values, axis_name, tiled=True)
+    return RowSparseGrad(rows, values, g.dense_shape)

@@ -104,20 +104,33 @@ class Tensor:
 
     @property
     def grad(self):
+        from .selected_rows import RowSparseGrad
+        if isinstance(self._grad, RowSparseGrad):
+            return self._grad  # row-sparse grads surface as-is
         if self._grad is None:
             return None
         return Tensor(self._grad, stop_gradient=True)
 
     @grad.setter
     def grad(self, value):
+        from .selected_rows import RowSparseGrad
         if value is None:
             self._grad = None
+        elif isinstance(value, RowSparseGrad):
+            self._grad = value
         else:
             self._grad = value._data if isinstance(value, Tensor) else jnp.asarray(value)
 
     def clear_grad(self, set_to_zero=False):
+        from .selected_rows import RowSparseGrad
         if set_to_zero and self._grad is not None:
-            self._grad = jnp.zeros_like(self._grad)
+            if isinstance(self._grad, RowSparseGrad):
+                # keep the row-sparse form: never materialize [V, D]
+                g = self._grad
+                self._grad = RowSparseGrad(
+                    g.rows, jnp.zeros_like(g.values), g.dense_shape)
+            else:
+                self._grad = jnp.zeros_like(self._grad)
         else:
             self._grad = None
 

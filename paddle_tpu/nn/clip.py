@@ -25,6 +25,11 @@ class ClipGradByValue(ClipGradBase):
         for p, g in params_grads:
             if g is None or not getattr(p, "need_clip", True):
                 out.append((p, g))
+            elif hasattr(g, "_sq_norm"):  # RowSparseGrad: clip value rows
+                m = g.merged()
+                out.append((p, type(g)(m.rows,
+                                       jnp.clip(m.values, self.min, self.max),
+                                       m.dense_shape)))
             else:
                 out.append((p, jnp.clip(g, self.min, self.max)))
         return out
@@ -40,7 +45,8 @@ class ClipGradByNorm(ClipGradBase):
             if g is None or not getattr(p, "need_clip", True):
                 out.append((p, g))
                 continue
-            n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            n = jnp.sqrt(g._sq_norm() if hasattr(g, "_sq_norm") else
+                         jnp.sum(jnp.square(g.astype(jnp.float32))))
             factor = jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-12), 1.0)
             out.append((p, (g.astype(jnp.float32) * factor).astype(g.dtype)))
         return out
@@ -53,8 +59,11 @@ class ClipGradByGlobalNorm(ClipGradBase):
         self.group_name = group_name
 
     def global_norm(self, grads):
-        sq = [jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads
-              if g is not None]
+        # RowSparseGrad contributes the norm of its dense equivalent
+        # (duplicate rows merged first)
+        sq = [g._sq_norm() if hasattr(g, "_sq_norm")
+              else jnp.sum(jnp.square(g.astype(jnp.float32)))
+              for g in grads if g is not None]
         if not sq:
             return jnp.asarray(0.0, jnp.float32)
         return jnp.sqrt(sum(sq))

@@ -199,13 +199,76 @@ def linear(x, weight, bias=None, name=None):
     return out
 
 
-@op
-def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+@op(name="embedding")
+def _embedding_dense(x, weight, padding_idx=None, name=None):
     out = jnp.take(weight, x, axis=0)
     if padding_idx is not None:
         mask = (x == padding_idx)[..., None]
         out = jnp.where(mask, jnp.zeros((), out.dtype), out)
     return out
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Embedding lookup.  With ``sparse=True`` the weight gradient is
+    recorded as a :class:`~paddle_tpu.framework.selected_rows.RowSparseGrad`
+    (rows + value rows, never the dense [V, D] scatter) — the TPU analog of
+    the reference's SelectedRows embedding grad
+    (paddle/phi/kernels/selected_rows/, embedding_grad sparse branch in
+    paddle/phi/ops/yaml/backward.yaml).  Consumed row-wise by SGD always
+    and Adam/AdamW under ``lazy_mode=True``; other optimizers densify.
+    """
+    if sparse:
+        from ..autograd import tape as _tape
+        from ..framework.tensor import Tensor as _T
+        w_is_tensor = isinstance(weight, _T)
+        from ..static.graph import Variable as _V
+        static = isinstance(x, _V) or isinstance(weight, _V)
+        if (w_is_tensor and not static and _tape.is_grad_enabled()
+                and not weight.stop_gradient):
+            return _sparse_embedding_apply(x, weight, padding_idx)
+    return _embedding_dense(x, weight, padding_idx=padding_idx)
+
+
+_sparse_embedding_layer = None
+
+
+def _sparse_embedding_apply(x, weight, padding_idx):
+    global _sparse_embedding_layer
+    from ..framework.tensor import Tensor
+
+    if _sparse_embedding_layer is None:
+        from ..autograd.py_layer import PyLayer
+        from ..framework.selected_rows import RowSparseGrad
+
+        class _SparseEmbedding(PyLayer):
+            @staticmethod
+            def forward(ctx, x_t, w_t, padding_idx):
+                xi = x_t._data if isinstance(x_t, Tensor) \
+                    else jnp.asarray(x_t)
+                w = w_t._data
+                ctx._xi, ctx._wshape, ctx._pad = xi, w.shape, padding_idx
+                out = jnp.take(w, xi, axis=0)
+                if padding_idx is not None:
+                    out = jnp.where((xi == padding_idx)[..., None],
+                                    jnp.zeros((), out.dtype), out)
+                return Tensor(out, stop_gradient=False)
+
+            @staticmethod
+            def backward(ctx, dout):
+                d = dout._data if isinstance(dout, Tensor) else dout
+                xi = ctx._xi
+                rows = xi.reshape(-1).astype(jnp.int32)
+                vals = d.reshape((rows.shape[0],) + d.shape[xi.ndim:])
+                if ctx._pad is not None:
+                    vals = jnp.where((rows == ctx._pad)[:, None],
+                                     jnp.zeros((), vals.dtype), vals)
+                return None, RowSparseGrad(rows, vals, ctx._wshape)
+
+        _sparse_embedding_layer = _SparseEmbedding
+
+    x_t = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x),
+                                                 stop_gradient=True)
+    return _sparse_embedding_layer.apply(x_t, weight, padding_idx)
 
 
 @op

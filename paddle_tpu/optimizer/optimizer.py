@@ -102,10 +102,18 @@ class Optimizer:
                 params_grads, lr):
             self._step_count += 1
             return
+        from ..framework.selected_rows import RowSparseGrad
         for p, g in params_grads:
             if g is None:
                 continue
             g32 = g.astype(jnp.float32)
+            if isinstance(g32, RowSparseGrad) and gt is None \
+                    and not self._l1_coeff:
+                self._update_param_rowsparse(p, g32, lr)
+                continue
+            if isinstance(g32, RowSparseGrad):
+                # sharded-grad transforms / L1 operate on dense math
+                g32 = g32.to_dense()
             if gt is not None:
                 # sharding-stage>=2: reduce-scatter semantics — the grad
                 # becomes dp-sharded so update math runs on shards only
@@ -132,7 +140,9 @@ class Optimizer:
             # accumulators with device_put; inside a jit that placement
             # becomes advisory and XLA replicates — keep the eager loop
             return False
-        if any(g is None for _, g in params_grads):
+        from ..framework.selected_rows import RowSparseGrad
+        if any(g is None or isinstance(g, RowSparseGrad)
+               for _, g in params_grads):
             return False
         ps = [p for p, _ in params_grads]
         gs = [g for _, g in params_grads]
@@ -204,6 +214,20 @@ class Optimizer:
 
     def _update_param(self, p, grad_f32, lr):
         raise NotImplementedError
+
+    def _update_param_rowsparse(self, p, g, lr):
+        """Apply a RowSparseGrad.  Base behavior: densify (with a one-time
+        note) — SGD and lazy Adam/AdamW override with true row updates
+        (reference: sgd SelectedRows kernel + adam lazy_mode,
+        paddle/phi/kernels/selected_rows/)."""
+        if not getattr(type(self), "_rs_densify_warned", False):
+            import logging
+            logging.getLogger("paddle_tpu").info(
+                "%s has no row-sparse update; densifying embedding grad "
+                "(use SGD or Adam/AdamW(lazy_mode=True) for row updates)",
+                type(self).__name__)
+            type(self)._rs_densify_warned = True
+        self._update_param(p, g.to_dense(), lr)
 
     def _write_back(self, p, new_f32):
         key = self._param_key(p)

@@ -25,6 +25,18 @@ class SGD(Optimizer):
             g = g + self._coeff * x
         self._write_back(p, x - lr * g)
 
+    def _update_param_rowsparse(self, p, g, lr):
+        # reference sgd SelectedRows kernel (sgd_kernel.cc DenseParam+
+        # SparseGrad branch): update touched rows only; L2 decay applies
+        # to touched rows (regularizer-on-rows semantics)
+        x = self._param_f32(p)
+        m = g.merged()
+        vals = m.values.astype(jnp.float32)
+        if self._coeff:
+            vals = vals + self._coeff * jnp.take(x, m.rows, axis=0,
+                                                 mode="clip")
+        self._write_back(p, x.at[m.rows].add(-lr * vals, mode="drop"))
+
 
 class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
@@ -59,6 +71,7 @@ class Adam(Optimizer):
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        self._lazy_mode = bool(lazy_mode)
 
     def _update_param(self, p, g, lr):
         x = self._param_f32(p)
@@ -74,6 +87,34 @@ class Adam(Optimizer):
         mhat = m / (1 - self._beta1 ** t)
         vhat = v / (1 - self._beta2 ** t)
         self._write_back(p, x - lr * mhat / (jnp.sqrt(vhat) + self._epsilon))
+
+    def _update_param_rowsparse(self, p, g, lr):
+        # reference adam lazy_mode (adam_kernel SelectedRows branch):
+        # moments decay and the param moves ONLY on touched rows; untouched
+        # rows are exactly unchanged.  Without lazy_mode, densify (the
+        # reference's non-lazy sparse adam also updates every row).
+        if not self._lazy_mode:
+            return super()._update_param_rowsparse(p, g, lr)
+        x = self._param_f32(p)
+        mg = g.merged()
+        rows = mg.rows
+        vals = mg.values.astype(jnp.float32)
+        if self._coeff:
+            vals = vals + self._coeff * jnp.take(x, rows, axis=0,
+                                                 mode="clip")
+        m = self._acc(p, "moment1")
+        v = self._acc(p, "moment2")
+        t = self._step_count + 1
+        mr = self._beta1 * jnp.take(m, rows, axis=0, mode="clip") \
+            + (1 - self._beta1) * vals
+        vr = self._beta2 * jnp.take(v, rows, axis=0, mode="clip") \
+            + (1 - self._beta2) * jnp.square(vals)
+        self._set_acc(p, "moment1", m.at[rows].set(mr, mode="drop"))
+        self._set_acc(p, "moment2", v.at[rows].set(vr, mode="drop"))
+        mhat = mr / (1 - self._beta1 ** t)
+        vhat = vr / (1 - self._beta2 ** t)
+        upd = lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        self._write_back(p, x.at[rows].add(-upd, mode="drop"))
 
 
 class AdamW(Adam):
@@ -106,6 +147,36 @@ class AdamW(Adam):
         mhat = m / (1 - self._beta1 ** t)
         vhat = v / (1 - self._beta2 ** t)
         self._write_back(p, x - lr * mhat / (jnp.sqrt(vhat) + self._epsilon))
+
+    def _update_param_rowsparse(self, p, g, lr):
+        # lazy AdamW: decoupled decay also restricted to touched rows so
+        # untouched rows stay bit-identical (lazy contract)
+        if not self._lazy_mode:
+            return Optimizer._update_param_rowsparse(self, p, g, lr)
+        x = self._param_f32(p)
+        if self._lr_ratio is not None:
+            lr = lr * self._lr_ratio(p)
+        mg = g.merged()
+        rows = mg.rows
+        vals = mg.values.astype(jnp.float32)
+        xr = jnp.take(x, rows, axis=0, mode="clip")
+        if self._wd and (self._apply_decay_fun is None or
+                         self._apply_decay_fun(p.name)):
+            # param rows decay before the adam move (reference kernel order)
+            x = x.at[rows].add(-lr * self._wd * xr, mode="drop")
+        m = self._acc(p, "moment1")
+        v = self._acc(p, "moment2")
+        t = self._step_count + 1
+        mr = self._beta1 * jnp.take(m, rows, axis=0, mode="clip") \
+            + (1 - self._beta1) * vals
+        vr = self._beta2 * jnp.take(v, rows, axis=0, mode="clip") \
+            + (1 - self._beta2) * jnp.square(vals)
+        self._set_acc(p, "moment1", m.at[rows].set(mr, mode="drop"))
+        self._set_acc(p, "moment2", v.at[rows].set(vr, mode="drop"))
+        mhat = mr / (1 - self._beta1 ** t)
+        vhat = vr / (1 - self._beta2 ** t)
+        upd = lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        self._write_back(p, x.at[rows].add(-upd, mode="drop"))
 
 
 class Adagrad(Optimizer):
