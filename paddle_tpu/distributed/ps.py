@@ -1,36 +1,423 @@
-"""paddle.distributed.ps — parameter-server stack (documented stub).
+"""paddle.distributed.ps — parameter-server stack, TPU-native formulation.
 
-Reference: paddle/fluid/distributed/ps/ (brpc PS server/client, sparse/
-dense tables, heter PS) + python/paddle/distributed/ps/.
+Reference: paddle/fluid/distributed/ps/ (brpc PS: dense/sparse tables with
+per-row optimizers, pull/push RPC, `the_one_ps.py` runtime) +
+python/paddle/distributed/ps/.  The reference streams terabyte-scale
+sparse embeddings through CPU servers for recommendation workloads;
+trainers pull the rows a batch touches and push row gradients back
+(async SGD).
 
-Out of scope for the TPU rebuild (SURVEY §7: "PS stack out-of-scope for
-TPU v1 — document, stub API"): the PS architecture exists to stream
-terabyte-scale sparse embeddings through CPU parameter servers for
-recommendation workloads; on TPU the idiomatic equivalents are
-  * sharded embeddings over the mesh (`fleet.VocabParallelEmbedding`,
-    `dist.shard_tensor` with row sharding), and
-  * host-offloaded lookups via `jax.pure_callback` +
-    `utils.cpp_extension` for out-of-HBM tables.
-Every entry point raises with that guidance rather than half-working.
+TPU formulation: the *device* math stays jax (lookups/backprop produce
+:class:`~paddle_tpu.framework.selected_rows.RowSparseGrad` row grads —
+never a dense [V, D] buffer), while tables live host-side in numpy on PS
+processes reachable over :mod:`paddle_tpu.distributed.rpc` (the brpc
+analog).  Row optimizers run on the server exactly like the reference's
+sparse SGD/Adagrad rules (paddle/fluid/distributed/ps/table/
+memory_sparse_table.cc, sparse_sgd_rule.cc).
+
+Scale note: one table shards across multiple servers by row hash
+(reference: `shard_num` in the table config) — :class:`PsClient` routes
+pull/push per shard.
 """
 from __future__ import annotations
 
-__all__ = ["PsProgramBuilder", "TheOnePSRuntime", "DistributedInfer"]
+import threading
 
-_MSG = ("the brpc parameter-server stack is not part of the TPU build; "
-        "use mesh-sharded embeddings (fleet.VocabParallelEmbedding / "
-        "dist.shard_tensor) or host-offloaded tables via jax.pure_callback "
-        "(see paddle_tpu.utils.cpp_extension)")
+import numpy as np
 
+from . import rpc
 
-def _stub(name):
-    class _Stub:
-        def __init__(self, *a, **k):
-            raise NotImplementedError(f"{name}: {_MSG}")
-    _Stub.__name__ = name
-    return _Stub
+__all__ = ["SparseTable", "DenseTable", "PsServer", "PsClient",
+           "DistributedLookup", "PsProgramBuilder", "TheOnePSRuntime",
+           "DistributedInfer"]
 
 
-PsProgramBuilder = _stub("PsProgramBuilder")
-TheOnePSRuntime = _stub("TheOnePSRuntime")
-DistributedInfer = _stub("DistributedInfer")
+# ---------------------------------------------------------------- tables
+class SparseTable:
+    """Host-side sparse embedding table with lazy row init and a per-row
+    optimizer rule (reference memory_sparse_table + sparse_sgd_rule)."""
+
+    def __init__(self, dim, initializer="normal", init_scale=0.01,
+                 optimizer="sgd", lr=0.01, seed=0, adagrad_eps=1e-6):
+        self.dim = int(dim)
+        self._rows: dict[int, np.ndarray] = {}
+        self._acc: dict[int, np.ndarray] = {}   # adagrad accumulator
+        self._rng = np.random.default_rng(seed)
+        self._init = initializer
+        self._scale = float(init_scale)
+        self._opt = optimizer
+        self._lr = float(lr)
+        self._eps = float(adagrad_eps)
+        self._lock = threading.Lock()
+
+    def _row(self, r):
+        v = self._rows.get(r)
+        if v is None:
+            if self._init == "zeros":
+                v = np.zeros(self.dim, np.float32)
+            else:
+                v = (self._rng.standard_normal(self.dim) *
+                     self._scale).astype(np.float32)
+            self._rows[r] = v
+        return v
+
+    def pull(self, rows):
+        rows = np.asarray(rows, np.int64).reshape(-1)
+        if len(rows) == 0:
+            return np.empty((0, self.dim), np.float32)
+        with self._lock:
+            return np.stack([self._row(int(r)) for r in rows])
+
+    def push(self, rows, grads, lr=None):
+        """Apply row gradients with the table's optimizer rule (server-side
+        update — reference sparse_sgd_rule.cc / sparse_adagrad)."""
+        rows = np.asarray(rows, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(len(rows), self.dim)
+        lr = self._lr if lr is None else float(lr)
+        with self._lock:
+            for r, g in zip(rows, grads):
+                r = int(r)
+                v = self._row(r)
+                if self._opt == "adagrad":
+                    acc = self._acc.get(r)
+                    if acc is None:
+                        acc = np.zeros(self.dim, np.float32)
+                    acc += g * g
+                    self._acc[r] = acc
+                    v -= lr * g / (np.sqrt(acc) + self._eps)
+                else:
+                    v -= lr * g
+
+    def state(self):
+        # deep-copy: pushes mutate rows in place, a snapshot must not alias
+        with self._lock:
+            return {"rows": {k: v.copy() for k, v in self._rows.items()},
+                    "acc": {k: v.copy() for k, v in self._acc.items()}}
+
+    def load_state(self, st):
+        with self._lock:
+            self._rows = {int(k): np.array(v, np.float32)
+                          for k, v in st["rows"].items()}
+            self._acc = {int(k): np.array(v, np.float32)
+                         for k, v in st.get("acc", {}).items()}
+
+    def __len__(self):
+        return len(self._rows)
+
+
+class DenseTable:
+    """Whole-parameter table (reference dense table: trainers pull the full
+    value, push summed grads)."""
+
+    def __init__(self, value, lr=0.01):
+        self.value = np.asarray(value, np.float32)
+        self._lr = float(lr)
+        self._lock = threading.Lock()
+
+    def pull(self):
+        with self._lock:
+            return self.value.copy()
+
+    def push(self, grad, lr=None):
+        with self._lock:
+            self.value -= (self._lr if lr is None else float(lr)) \
+                * np.asarray(grad, np.float32)
+
+
+# ------------------------------------------------------------ server side
+_SERVER: "PsServer | None" = None
+
+
+class PsServer:
+    """Table host.  Call :meth:`serve` after ``rpc.init_rpc`` — the
+    module-level handlers below then execute in this process via the rpc
+    layer (reference BrpcPsServer::Start)."""
+
+    def __init__(self):
+        self.tables: dict[str, SparseTable | DenseTable] = {}
+
+    def add_sparse_table(self, name, dim, **kw):
+        self.tables[name] = SparseTable(dim, **kw)
+        return self.tables[name]
+
+    def add_dense_table(self, name, value, **kw):
+        self.tables[name] = DenseTable(value, **kw)
+        return self.tables[name]
+
+    def serve(self):
+        global _SERVER
+        _SERVER = self
+
+    def stop(self):
+        global _SERVER
+        if _SERVER is self:
+            _SERVER = None
+
+
+def _srv():
+    if _SERVER is None:
+        raise RuntimeError("no PsServer serving in this process "
+                           "(call PsServer().serve() after init_rpc)")
+    return _SERVER
+
+
+# module-level handlers: rpc pickles the function object by reference, so
+# these run on the callee process against its _SERVER
+def _handle_pull_sparse(table, rows):
+    return _srv().tables[table].pull(rows)
+
+
+def _handle_push_sparse(table, rows, grads, lr=None):
+    _srv().tables[table].push(rows, grads, lr)
+    return True
+
+
+def _handle_pull_dense(table):
+    return _srv().tables[table].pull()
+
+
+def _handle_push_dense(table, grad, lr=None):
+    _srv().tables[table].push(grad, lr)
+    return True
+
+
+def _handle_table_len(table):
+    return len(_srv().tables[table])
+
+
+def _handle_dim(table):
+    return _srv().tables[table].dim
+
+
+def _handle_ready(tables):
+    """True when this process serves and has every named table (worker
+    startup gate — reference the_one_ps init_server/init_worker order)."""
+    return _SERVER is not None and all(t in _SERVER.tables for t in tables)
+
+
+def _handle_save(table):
+    return _srv().tables[table].state()
+
+
+def _handle_load(table, st):
+    _srv().tables[table].load_state(st)
+    return True
+
+
+# ------------------------------------------------------------ client side
+class PsClient:
+    """Trainer-side handle (reference BrpcPsClient): pull/push against one
+    server, or shard by row hash across several (``servers=[...]``)."""
+
+    def __init__(self, server=None, servers=None):
+        if servers is None:
+            servers = [server if server is not None else "ps0"]
+        self.servers = list(servers)
+
+    # -------- sparse
+    def _shard(self, rows):
+        rows = np.asarray(rows, np.int64).reshape(-1)
+        return rows % len(self.servers)
+
+    def wait_server_ready(self, tables=(), timeout=60):
+        """Block until every server process serves the named tables
+        (reference: trainers wait for init_server before init_worker)."""
+        import time as _time
+        deadline = _time.monotonic() + timeout
+        for srv in self.servers:
+            while not rpc.rpc_sync(srv, _handle_ready, args=(list(tables),)):
+                if _time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"PS {srv} not ready with tables {tables} "
+                        f"after {timeout}s")
+                _time.sleep(0.05)
+
+    def pull_sparse(self, table, rows):
+        rows = np.asarray(rows, np.int64).reshape(-1)
+        if len(self.servers) == 1:
+            return rpc.rpc_sync(self.servers[0], _handle_pull_sparse,
+                                args=(table, rows))
+        sh = self._shard(rows)
+        futs = [(i, srv, rpc.rpc_async(srv, _handle_pull_sparse,
+                                       args=(table, rows[sh == i])))
+                for i, srv in enumerate(self.servers) if (sh == i).any()]
+        out = None
+        for i, srv, f in futs:
+            part = f.result()
+            if out is None:
+                out = np.empty((len(rows), part.shape[1]), np.float32)
+            out[sh == i] = part
+        if out is None:   # empty row set
+            out = np.empty((0, self.dim(table)), np.float32)
+        return out
+
+    def push_sparse(self, table, rows, grads, lr=None):
+        rows = np.asarray(rows, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32)
+        if len(self.servers) == 1:
+            return rpc.rpc_sync(self.servers[0], _handle_push_sparse,
+                                args=(table, rows, grads, lr))
+        sh = self._shard(rows)
+        futs = []
+        for i, srv in enumerate(self.servers):
+            m = sh == i
+            if m.any():
+                futs.append(rpc.rpc_async(
+                    srv, _handle_push_sparse,
+                    args=(table, rows[m], grads[m], lr)))
+        for f in futs:
+            f.result()
+        return True
+
+    def dim(self, table):
+        return rpc.rpc_sync(self.servers[0], _handle_dim, args=(table,))
+
+    # -------- dense
+    def pull_dense(self, table):
+        return rpc.rpc_sync(self.servers[0], _handle_pull_dense,
+                            args=(table,))
+
+    def push_dense(self, table, grad, lr=None):
+        return rpc.rpc_sync(self.servers[0], _handle_push_dense,
+                            args=(table, np.asarray(grad, np.float32), lr))
+
+    def table_len(self, table):
+        return sum(rpc.rpc_sync(s, _handle_table_len, args=(table,))
+                   for s in self.servers)
+
+    def save(self, table):
+        return [rpc.rpc_sync(s, _handle_save, args=(table,))
+                for s in self.servers]
+
+    def load(self, table, states):
+        """Restore a saved table.  Rows are re-sharded by the CURRENT row
+        hash, so a snapshot from N servers loads correctly into M servers
+        (otherwise rows land on shards the router never reads)."""
+        merged_rows, merged_acc = {}, {}
+        for st in states:
+            merged_rows.update({int(k): v for k, v in st["rows"].items()})
+            merged_acc.update({int(k): v for k, v in
+                               st.get("acc", {}).items()})
+        n = len(self.servers)
+        for i, s in enumerate(self.servers):
+            part = {"rows": {k: v for k, v in merged_rows.items()
+                             if k % n == i},
+                    "acc": {k: v for k, v in merged_acc.items()
+                            if k % n == i}}
+            rpc.rpc_sync(s, _handle_load, args=(table, part))
+
+
+# ----------------------------------------------------------- device bridge
+class DistributedLookup:
+    """PS-backed embedding lookup for device math.
+
+    forward: pull the batch's unique rows to the device and gather
+    locally; backward row grads come out of the framework's sparse
+    embedding path (RowSparseGrad) and :meth:`apply_grad` pushes them to
+    the servers — the reference's pull_sparse → forward →
+    push_sparse_grad trainer loop (python/paddle/distributed/ps/
+    the_one_ps.py, worker side).
+    """
+
+    def __init__(self, client, table, dim):
+        self.client = client
+        self.table = table
+        self.dim = dim
+        self._w = None
+        self._uniq = None
+
+    def __call__(self, ids):
+        import jax.numpy as jnp
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+        from ..framework.tensor import Tensor
+
+        ids_np = np.asarray(ids._data if isinstance(ids, Tensor) else ids)
+        uniq, inv = np.unique(ids_np.reshape(-1), return_inverse=True)
+        vals = self.client.pull_sparse(self.table, uniq)      # [U, D]
+        w = Tensor(jnp.asarray(vals), stop_gradient=False)
+        local_ids = paddle.to_tensor(inv.reshape(ids_np.shape))
+        out = F.embedding(local_ids, w, sparse=True)
+        self._w, self._uniq = w, uniq
+        return out
+
+    def apply_grad(self, lr=None):
+        """Push the recorded row grads of the last forward to the PS."""
+        g = None if self._w is None else self._w._grad
+        if g is None:
+            return
+        m = g.merged()
+        rows_l = np.asarray(m.rows)
+        vals = np.asarray(m.values, np.float32)
+        keep = rows_l < len(self._uniq)   # drop merge sentinels
+        self.client.push_sparse(self.table, self._uniq[rows_l[keep]],
+                                vals[keep], lr)
+        self._w._grad = None
+
+
+# --------------------------------------------------- reference-shaped glue
+class TheOnePSRuntime:
+    """Minimal `the_one_ps` runtime shape: role-driven server/worker setup
+    over rpc (reference python/paddle/distributed/ps/the_one_ps.py)."""
+
+    def __init__(self, role, rank, world_size, master_endpoint=None):
+        if role not in ("server", "worker"):
+            raise ValueError(f"role must be server|worker, got {role}")
+        self.role = role
+        self.name = f"ps{rank}" if role == "server" else f"trainer{rank}"
+        rpc.init_rpc(self.name, rank=rank, world_size=world_size,
+                     master_endpoint=master_endpoint)
+        self.server = PsServer() if role == "server" else None
+        if self.server is not None:
+            self.server.serve()
+
+    def client(self, servers=("ps0",)):
+        return PsClient(servers=list(servers))
+
+    def shutdown(self):
+        if self.server is not None:
+            self.server.stop()
+        rpc.shutdown()
+
+
+class PsProgramBuilder:
+    """Reference PsProgramBuilder splits a static program into worker/PS
+    parts; here the split is explicit (DistributedLookup on workers,
+    tables on servers), so the builder materializes table specs on the
+    right role and hands workers a client."""
+
+    def __init__(self, runtime: TheOnePSRuntime):
+        self.runtime = runtime
+
+    def build(self, tables: dict):
+        if self.runtime.role == "server":
+            for name, spec in tables.items():
+                if spec.get("type", "sparse") == "sparse":
+                    self.runtime.server.add_sparse_table(
+                        name, spec["dim"],
+                        **{k: v for k, v in spec.items()
+                           if k not in ("type", "dim")})
+                else:
+                    self.runtime.server.add_dense_table(
+                        name, spec["value"],
+                        **{k: v for k, v in spec.items()
+                           if k not in ("type", "value")})
+            return self.runtime.server
+        client = self.runtime.client()
+        client.wait_server_ready(list(tables))
+        return client
+
+
+class DistributedInfer:
+    """Inference-side pull-only view (reference DistributedInfer wraps the
+    trainer program to pull the latest params before infer)."""
+
+    def __init__(self, client: PsClient):
+        self.client = client
+
+    def lookup(self, table, ids):
+        import jax.numpy as jnp
+        ids_np = np.asarray(ids)
+        uniq, inv = np.unique(ids_np.reshape(-1), return_inverse=True)
+        vals = self.client.pull_sparse(table, uniq)
+        return jnp.asarray(vals)[inv].reshape(ids_np.shape + (-1,))

@@ -1,0 +1,123 @@
+"""Parameter-server stack (reference: paddle/fluid/distributed/ps/ +
+python/paddle/distributed/ps/the_one_ps.py).  Loop-back rpc in-process,
+mirroring tests/test_launch.py::test_rpc_sync_async_roundtrip."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import rpc
+from paddle_tpu.distributed import ps
+
+
+@pytest.fixture()
+def loopback_ps():
+    rpc.shutdown()
+    rpc.init_rpc("ps0", rank=0, world_size=1)
+    server = ps.PsServer()
+    server.serve()
+    try:
+        yield server
+    finally:
+        server.stop()
+        rpc.shutdown()
+
+
+def test_sparse_table_pull_push_rules():
+    t = ps.SparseTable(dim=3, initializer="zeros", optimizer="sgd", lr=0.5)
+    v = t.pull([4, 9])
+    assert v.shape == (2, 3) and np.all(v == 0)
+    t.push([4, 4], np.ones((2, 3), np.float32))     # dup rows both apply
+    assert np.allclose(t.pull([4])[0], -1.0)        # 2 * 0.5 * 1
+    assert len(t) == 2
+
+    ta = ps.SparseTable(dim=2, initializer="zeros", optimizer="adagrad",
+                        lr=1.0)
+    ta.push([1], np.full((1, 2), 2.0, np.float32))
+    # adagrad: acc=4, update = 2/sqrt(4) = 1
+    assert np.allclose(ta.pull([1])[0], -1.0, atol=1e-5)
+
+
+def test_ps_client_roundtrip(loopback_ps):
+    loopback_ps.add_sparse_table("emb", dim=4, initializer="zeros", lr=0.1)
+    loopback_ps.add_dense_table("w", np.ones((2, 2), np.float32), lr=1.0)
+    c = ps.PsClient("ps0")
+
+    vals = c.pull_sparse("emb", [7, 3, 7])
+    assert vals.shape == (3, 4)
+    c.push_sparse("emb", [7], np.ones((1, 4), np.float32))
+    assert np.allclose(c.pull_sparse("emb", [7])[0], -0.1)
+    assert c.table_len("emb") == 2
+
+    w = c.pull_dense("w")
+    c.push_dense("w", np.full((2, 2), 0.5, np.float32))
+    assert np.allclose(c.pull_dense("w"), w - 0.5)
+
+    st = c.save("emb")
+    c.push_sparse("emb", [7], np.ones((1, 4), np.float32))
+    c.load("emb", st)
+    assert np.allclose(c.pull_sparse("emb", [7])[0], -0.1)
+
+
+def test_distributed_lookup_trains(loopback_ps):
+    loopback_ps.add_sparse_table("emb", dim=4, init_scale=0.1, lr=0.2)
+    c = ps.PsClient("ps0")
+    lk = ps.DistributedLookup(c, "emb", 4)
+    ids = np.array([[5, 9], [5, 2]], np.int64)
+
+    losses = []
+    for _ in range(5):
+        out = lk(ids)                     # pull + device gather
+        loss = (out * out).sum()
+        loss.backward()
+        lk.apply_grad()                   # push row grads
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert c.table_len("emb") == 3        # only touched rows exist
+
+
+def test_snapshot_is_isolated_and_empty_pull_ok():
+    t = ps.SparseTable(dim=2, initializer="zeros", lr=1.0)
+    t.push([3], np.ones((1, 2), np.float32))
+    st = t.state()
+    t.push([3], np.ones((1, 2), np.float32))     # must not corrupt st
+    t.load_state(st)
+    assert np.allclose(t.pull([3])[0], -1.0)
+    assert t.pull([]).shape == (0, 2)
+
+
+def test_sharded_client_two_servers_loopback(loopback_ps):
+    # both "shards" are this process's server — routing math still runs
+    loopback_ps.add_sparse_table("emb", dim=2, initializer="zeros", lr=1.0)
+    c = ps.PsClient(servers=["ps0", "ps0"])
+    c.wait_server_ready(["emb"], timeout=5)
+    rows = np.array([0, 1, 2, 3], np.int64)
+    vals = c.pull_sparse("emb", rows)
+    assert vals.shape == (4, 2)
+    c.push_sparse("emb", rows, np.ones((4, 2), np.float32))
+    assert np.allclose(c.pull_sparse("emb", rows), -1.0)
+    assert c.pull_sparse("emb", []).shape == (0, 2)
+    assert c.dim("emb") == 2
+    # save from 2 "shards", reload through a 1-shard client: rows re-shard
+    st = c.save("emb")
+    c1 = ps.PsClient(servers=["ps0"])
+    c1.load("emb", st)
+    assert np.allclose(c1.pull_sparse("emb", rows), -1.0)
+
+
+def test_the_one_ps_runtime_and_builder():
+    rpc.shutdown()
+    rt = ps.TheOnePSRuntime("server", rank=0, world_size=1)
+    try:
+        builder = ps.PsProgramBuilder(rt)
+        srv = builder.build({"emb": {"type": "sparse", "dim": 2,
+                                     "initializer": "zeros"},
+                             "w": {"type": "dense",
+                                   "value": np.zeros(3, np.float32)}})
+        assert set(srv.tables) == {"emb", "w"}
+        # same process doubles as worker via loop-back (single-node test)
+        c = ps.PsClient("ps0")
+        infer = ps.DistributedInfer(c)
+        out = infer.lookup("emb", np.array([[1, 1]]))
+        assert out.shape == (1, 2, 2)
+    finally:
+        rt.shutdown()
