@@ -1,0 +1,374 @@
+// C inference API for paddle_tpu (reference: paddle/fluid/inference/capi_exp/
+// pd_config.h / pd_predictor.h / pd_tensor.h — PD_ConfigCreate,
+// PD_PredictorCreate, PD_PredictorGetInputHandle, PD_TensorCopyFromCpuFloat,
+// PD_PredictorRun, PD_TensorCopyToCpuFloat ...).
+//
+// The reference's C API fronts its native AnalysisPredictor.  Here the
+// predictor runtime IS the Python package (each Run = one cached XLA
+// executable), so the C ABI embeds CPython and drives
+// paddle_tpu.inference.{Config,Predictor}.  Deploy model files come from
+// paddle.static.save_inference_model / jit.save, same as the reference.
+//
+// Build: make -f Makefile inference  (links -lpython3.12).
+// Thread model: calls must come from one thread at a time (the reference
+// predictor is also single-stream per handle); the embedded interpreter is
+// initialized once on first PD_ConfigCreate.
+//
+// No Go wrapper is shipped: the reference's Go API is a cgo shim over this
+// same C surface and there is no Go toolchain in this image.
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "pd_inference_c.h"
+
+struct PD_Config {
+  std::string model_path;
+  std::string params_path;
+};
+
+struct PD_Predictor {
+  PyObject* pred;  // paddle_tpu.inference.Predictor
+};
+
+struct PD_Tensor {
+  PyObject* pred;        // owned ref (handles outlive PD_PredictorDestroy)
+  std::string name;
+  bool is_input;
+  std::vector<int32_t> dims;
+};
+
+static bool g_inited = false;
+static PyThreadState* g_main_ts = nullptr;
+
+namespace {
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() { st = PyGILState_Ensure(); }
+  ~Gil() { PyGILState_Release(st); }
+};
+
+void ensure_python() {
+  if (g_inited) return;
+  g_inited = true;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // we created the interpreter and hold its GIL: release it so Gil{}
+    // works uniformly from any caller thread.  When the host process
+    // already runs Python (ctypes / embedding), its GIL state is not
+    // ours to touch — Gil{} alone suffices.
+    g_main_ts = PyEval_SaveThread();
+  }
+}
+
+// fetch attr chain like "paddle_tpu.inference" -> module object (new ref)
+PyObject* import_mod(const char* name) {
+  PyObject* m = PyImport_ImportModule(name);
+  if (!m) PyErr_Print();
+  return m;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ----------------------------------------------------------------- config
+PD_Config* PD_ConfigCreate() {
+  ensure_python();
+  return new PD_Config();
+}
+
+void PD_ConfigSetModel(PD_Config* c, const char* model_path,
+                       const char* params_path) {
+  c->model_path = model_path ? model_path : "";
+  c->params_path = params_path ? params_path : "";
+}
+
+const char* PD_ConfigGetModelDir(PD_Config* c) {
+  return c->model_path.c_str();
+}
+
+void PD_ConfigDestroy(PD_Config* c) { delete c; }
+
+// -------------------------------------------------------------- predictor
+PD_Predictor* PD_PredictorCreate(PD_Config* c) {
+  // reference semantics: PD_PredictorCreate consumes the config — on
+  // every exit path, success or failure
+  ensure_python();
+  Gil gil;
+  PyObject* pred = nullptr;
+  PyObject* mod = import_mod("paddle_tpu.inference");
+  if (mod) {
+    PyObject* cfg = PyObject_CallMethod(mod, "Config", "ss",
+                                        c->model_path.c_str(),
+                                        c->params_path.c_str());
+    if (!cfg) {
+      PyErr_Print();
+    } else {
+      pred = PyObject_CallMethod(mod, "create_predictor", "O", cfg);
+      if (!pred) PyErr_Print();
+      Py_DECREF(cfg);
+    }
+    Py_DECREF(mod);
+  }
+  PD_ConfigDestroy(c);
+  if (!pred) return nullptr;
+  PD_Predictor* p = new PD_Predictor();
+  p->pred = pred;
+  return p;
+}
+
+static size_t name_list_size(PyObject* pred, const char* method) {
+  PyObject* names = PyObject_CallMethod(pred, method, nullptr);
+  if (!names) {
+    PyErr_Print();
+    return 0;
+  }
+  size_t n = PyList_Size(names);
+  Py_DECREF(names);
+  return n;
+}
+
+size_t PD_PredictorGetInputNum(PD_Predictor* p) {
+  Gil gil;
+  return name_list_size(p->pred, "get_input_names");
+}
+
+size_t PD_PredictorGetOutputNum(PD_Predictor* p) {
+  Gil gil;
+  return name_list_size(p->pred, "get_output_names");
+}
+
+// separate buffers so an input-name and an output-name pointer can be
+// alive at once (e.g. both as printf arguments); each stays valid until
+// the next call of the SAME function on this thread
+static thread_local std::string g_in_name_buf;
+static thread_local std::string g_out_name_buf;
+
+static const char* name_at(PyObject* pred, const char* method, size_t i,
+                           std::string* buf) {
+  PyObject* names = PyObject_CallMethod(pred, method, nullptr);
+  if (!names) {
+    PyErr_Print();
+    return nullptr;
+  }
+  PyObject* it = PyList_GetItem(names, (Py_ssize_t)i);  // borrowed
+  if (!it) {
+    PyErr_Clear();  // out-of-range index must not poison the next call
+    Py_DECREF(names);
+    return nullptr;
+  }
+  *buf = PyUnicode_AsUTF8(it);
+  Py_DECREF(names);
+  return buf->c_str();
+}
+
+const char* PD_PredictorGetInputName(PD_Predictor* p, size_t i) {
+  Gil gil;
+  return name_at(p->pred, "get_input_names", i, &g_in_name_buf);
+}
+
+const char* PD_PredictorGetOutputName(PD_Predictor* p, size_t i) {
+  Gil gil;
+  return name_at(p->pred, "get_output_names", i, &g_out_name_buf);
+}
+
+PD_Tensor* PD_PredictorGetInputHandle(PD_Predictor* p, const char* name) {
+  Gil gil;
+  PD_Tensor* t = new PD_Tensor();
+  Py_INCREF(p->pred);
+  t->pred = p->pred;
+  t->name = name;
+  t->is_input = true;
+  return t;
+}
+
+PD_Tensor* PD_PredictorGetOutputHandle(PD_Predictor* p, const char* name) {
+  Gil gil;
+  PD_Tensor* t = new PD_Tensor();
+  Py_INCREF(p->pred);
+  t->pred = p->pred;
+  t->name = name;
+  t->is_input = false;
+  return t;
+}
+
+int PD_PredictorRun(PD_Predictor* p) {
+  Gil gil;
+  PyObject* r = PyObject_CallMethod(p->pred, "run", nullptr);
+  if (!r) {
+    PyErr_Print();
+    return 0;
+  }
+  Py_DECREF(r);
+  return 1;
+}
+
+void PD_PredictorDestroy(PD_Predictor* p) {
+  {
+    Gil gil;
+    Py_XDECREF(p->pred);
+  }
+  delete p;
+}
+
+// ------------------------------------------------------------------ tensor
+void PD_TensorReshape(PD_Tensor* t, size_t ndims, const int32_t* dims) {
+  t->dims.assign(dims, dims + ndims);
+}
+
+static int copy_from_cpu(PD_Tensor* t, const void* data, const char* npdtype,
+                         size_t itemsize) {
+  Gil gil;
+  size_t n = 1;
+  for (int32_t d : t->dims) n *= (size_t)d;
+  PyObject* np = import_mod("numpy");
+  if (!np) return 0;
+  PyObject* dims = PyTuple_New(t->dims.size());
+  for (size_t i = 0; i < t->dims.size(); ++i)
+    PyTuple_SetItem(dims, i, PyLong_FromLong(t->dims[i]));
+  // numpy.frombuffer(bytes, dtype).reshape(dims).copy()
+  PyObject* bytes =
+      PyBytes_FromStringAndSize((const char*)data, (Py_ssize_t)(n * itemsize));
+  PyObject* flat =
+      PyObject_CallMethod(np, "frombuffer", "Os", bytes, npdtype);
+  Py_DECREF(bytes);
+  Py_DECREF(np);
+  if (!flat) {
+    PyErr_Print();
+    Py_DECREF(dims);
+    return 0;
+  }
+  PyObject* arr = PyObject_CallMethod(flat, "reshape", "O", dims);
+  Py_DECREF(flat);
+  Py_DECREF(dims);
+  if (!arr) {
+    PyErr_Print();
+    return 0;
+  }
+  PyObject* handle =
+      PyObject_CallMethod(t->pred, "get_input_handle", "s", t->name.c_str());
+  if (!handle) {
+    PyErr_Print();
+    Py_DECREF(arr);
+    return 0;
+  }
+  PyObject* r = PyObject_CallMethod(handle, "copy_from_cpu", "O", arr);
+  Py_DECREF(arr);
+  Py_DECREF(handle);
+  if (!r) {
+    PyErr_Print();
+    return 0;
+  }
+  Py_DECREF(r);
+  return 1;
+}
+
+int PD_TensorCopyFromCpuFloat(PD_Tensor* t, const float* data) {
+  return copy_from_cpu(t, data, "float32", 4);
+}
+
+int PD_TensorCopyFromCpuInt64(PD_Tensor* t, const int64_t* data) {
+  return copy_from_cpu(t, data, "int64", 8);
+}
+
+int PD_TensorCopyFromCpuInt32(PD_Tensor* t, const int32_t* data) {
+  return copy_from_cpu(t, data, "int32", 4);
+}
+
+// output helpers: fetch np array (C-contiguous float32/int) for the fetch var
+static PyObject* fetch_output(PD_Tensor* t, const char* npdtype) {
+  PyObject* handle =
+      PyObject_CallMethod(t->pred, "get_output_handle", "s", t->name.c_str());
+  if (!handle) {
+    PyErr_Print();
+    return nullptr;
+  }
+  PyObject* arr = PyObject_CallMethod(handle, "copy_to_cpu", nullptr);
+  Py_DECREF(handle);
+  if (!arr) {
+    PyErr_Print();
+    return nullptr;
+  }
+  PyObject* np = import_mod("numpy");
+  PyObject* cast = PyObject_CallMethod(
+      np, "ascontiguousarray", "Os", arr, npdtype);
+  Py_DECREF(np);
+  Py_DECREF(arr);
+  if (!cast) PyErr_Print();
+  return cast;
+}
+
+int PD_TensorGetShape(PD_Tensor* t, size_t* ndims, int32_t* dims) {
+  Gil gil;
+  // handle.shape() reads the stored array's dims — no data copy/cast
+  const char* getter =
+      t->is_input ? "get_input_handle" : "get_output_handle";
+  PyObject* handle =
+      PyObject_CallMethod(t->pred, getter, "s", t->name.c_str());
+  if (!handle) {
+    PyErr_Print();
+    return 0;
+  }
+  PyObject* shape = PyObject_CallMethod(handle, "shape", nullptr);
+  Py_DECREF(handle);
+  if (!shape) {
+    PyErr_Print();
+    return 0;
+  }
+  PyObject* seq = PySequence_Fast(shape, "shape not a sequence");
+  Py_DECREF(shape);
+  if (!seq) {
+    PyErr_Print();
+    return 0;
+  }
+  *ndims = (size_t)PySequence_Fast_GET_SIZE(seq);
+  for (size_t i = 0; i < *ndims; ++i)
+    dims[i] = (int32_t)PyLong_AsLong(
+        PySequence_Fast_GET_ITEM(seq, (Py_ssize_t)i));
+  Py_DECREF(seq);
+  return 1;
+}
+
+static int copy_to_cpu(PD_Tensor* t, void* out, const char* npdtype,
+                       size_t itemsize) {
+  Gil gil;
+  PyObject* arr = fetch_output(t, npdtype);
+  if (!arr) return 0;
+  PyObject* bytes = PyObject_CallMethod(arr, "tobytes", nullptr);
+  Py_DECREF(arr);
+  if (!bytes) {
+    PyErr_Print();
+    return 0;
+  }
+  char* buf = nullptr;
+  Py_ssize_t len = 0;
+  PyBytes_AsStringAndSize(bytes, &buf, &len);
+  std::memcpy(out, buf, (size_t)len);
+  Py_DECREF(bytes);
+  (void)itemsize;
+  return 1;
+}
+
+int PD_TensorCopyToCpuFloat(PD_Tensor* t, float* out) {
+  return copy_to_cpu(t, out, "float32", 4);
+}
+
+int PD_TensorCopyToCpuInt64(PD_Tensor* t, int64_t* out) {
+  return copy_to_cpu(t, out, "int64", 8);
+}
+
+void PD_TensorDestroy(PD_Tensor* t) {
+  {
+    Gil gil;
+    Py_XDECREF(t->pred);
+  }
+  delete t;
+}
+
+}  // extern "C"

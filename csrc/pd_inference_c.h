@@ -1,0 +1,59 @@
+/* C inference API for paddle_tpu.
+ *
+ * Reference surface: paddle/fluid/inference/capi_exp/pd_inference_api.h
+ * (PD_Config* / PD_Predictor* / PD_Tensor* families).  Link against
+ * libpaddle_tpu_infer.so (build: `make -C csrc inference`); the library
+ * embeds CPython and drives the paddle_tpu.inference predictor, whose
+ * Run is one cached XLA executable.
+ *
+ * Calls must come from one thread at a time.  PD_PredictorCreate consumes
+ * the config (reference semantics).
+ */
+#ifndef PD_INFERENCE_C_H
+#define PD_INFERENCE_C_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct PD_Config PD_Config;
+typedef struct PD_Predictor PD_Predictor;
+typedef struct PD_Tensor PD_Tensor;
+
+/* config */
+PD_Config* PD_ConfigCreate(void);
+void PD_ConfigSetModel(PD_Config*, const char* model_path,
+                       const char* params_path);
+const char* PD_ConfigGetModelDir(PD_Config*);
+void PD_ConfigDestroy(PD_Config*);
+
+/* predictor */
+PD_Predictor* PD_PredictorCreate(PD_Config*);      /* consumes config */
+size_t PD_PredictorGetInputNum(PD_Predictor*);
+size_t PD_PredictorGetOutputNum(PD_Predictor*);
+/* returned pointers stay valid until the next call of the same function
+ * on the same thread */
+const char* PD_PredictorGetInputName(PD_Predictor*, size_t i);
+const char* PD_PredictorGetOutputName(PD_Predictor*, size_t i);
+PD_Tensor* PD_PredictorGetInputHandle(PD_Predictor*, const char* name);
+PD_Tensor* PD_PredictorGetOutputHandle(PD_Predictor*, const char* name);
+int PD_PredictorRun(PD_Predictor*);                /* 1 = ok */
+void PD_PredictorDestroy(PD_Predictor*);
+
+/* tensors */
+void PD_TensorReshape(PD_Tensor*, size_t ndims, const int32_t* dims);
+int PD_TensorCopyFromCpuFloat(PD_Tensor*, const float* data);
+int PD_TensorCopyFromCpuInt64(PD_Tensor*, const int64_t* data);
+int PD_TensorCopyFromCpuInt32(PD_Tensor*, const int32_t* data);
+int PD_TensorGetShape(PD_Tensor*, size_t* ndims, int32_t* dims);
+int PD_TensorCopyToCpuFloat(PD_Tensor*, float* out);
+int PD_TensorCopyToCpuInt64(PD_Tensor*, int64_t* out);
+void PD_TensorDestroy(PD_Tensor*);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* PD_INFERENCE_C_H */
