@@ -23,21 +23,29 @@ _CSRC = os.path.normpath(os.path.join(_HERE, "..", "..", "csrc"))
 _SO = os.path.join(_HERE, "libpaddle_tpu_core.so")
 
 
+# the runtime-core sources only (csrc/Makefile SRCS) — csrc also holds
+# separately-built libraries (inference_capi.cc links libpython) that
+# must NOT be globbed into this .so
+_CORE_SRCS = ("tcp_store.cc", "shm_ring.cc", "trace.cc")
+
+
+def _core_srcs():
+    srcs = [os.path.join(_CSRC, f) for f in _CORE_SRCS]
+    return [s for s in srcs if os.path.exists(s)]
+
+
 def _needs_build() -> bool:
     if not os.path.exists(_SO):
         return True
     so_mtime = os.path.getmtime(_SO)
-    try:
-        srcs = [os.path.join(_CSRC, f) for f in os.listdir(_CSRC)
-                if f.endswith(".cc")]
-    except OSError:
+    srcs = _core_srcs()
+    if not srcs:
         return False  # installed without sources: use the shipped .so
     return any(os.path.getmtime(s) > so_mtime for s in srcs)
 
 
 def _build() -> bool:
-    srcs = [os.path.join(_CSRC, f) for f in sorted(os.listdir(_CSRC))
-            if f.endswith(".cc")]
+    srcs = _core_srcs()
     if not srcs:
         return False
     cmd = ["g++", "-O2", "-fPIC", "-std=c++17", "-pthread", "-shared",

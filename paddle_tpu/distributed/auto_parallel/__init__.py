@@ -1,7 +1,9 @@
 from .api import shard_tensor, reshard, shard_layer, shard_optimizer, \
     dtensor_from_local, dtensor_to_local, unshard_dtensor, ShardingStage1, \
     ShardingStage2, ShardingStage3
+from .planner import ChipSpec, ModelSpec, Plan, Planner, plan_parallel
 
 __all__ = ["shard_tensor", "reshard", "shard_layer", "shard_optimizer",
            "dtensor_from_local", "dtensor_to_local", "unshard_dtensor",
-           "ShardingStage1", "ShardingStage2", "ShardingStage3"]
+           "ShardingStage1", "ShardingStage2", "ShardingStage3",
+           "ChipSpec", "ModelSpec", "Plan", "Planner", "plan_parallel"]

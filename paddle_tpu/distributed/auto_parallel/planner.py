@@ -1,0 +1,231 @@
+"""Analytical parallelism planner — the static cost-model pass.
+
+Reference: python/paddle/distributed/auto_parallel/static/{cost/,
+planner_v2.py, tuner/parallel_tuner.py} — per-op compute/comm cost
+models driving a search over distributed attributes, so a plan exists
+BEFORE anything runs.  (`distributed/auto_tuner.py` is the measured
+complement: it times real trials; this module ranks candidates
+analytically and can seed/prune that search.)
+
+TPU formulation (the scaling-book roofline): a config's step time is
+  max(compute, HBM streaming) + TP collectives (ride ICI) + DP grad
+  sync (overlappable) and a pipeline-bubble multiplier; memory is the
+sharded params/optimizer/activation sum.  Chip numbers come from
+:class:`ChipSpec` presets (v5e / v5p measured-or-nominal values) so the
+same model spec plans differently on different parts — exactly the
+role of the reference's cluster description
+(auto_parallel/static/cluster.py).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+from ..auto_tuner import candidate_configs
+
+__all__ = ["ChipSpec", "ModelSpec", "Plan", "Planner", "plan_parallel"]
+
+
+@dataclass(frozen=True)
+class ChipSpec:
+    """One accelerator chip (reference cluster.py device description)."""
+    name: str = "tpu-v5e"
+    flops: float = 197e12           # bf16 peak
+    hbm_bytes: float = 16e9
+    hbm_bw: float = 819e9
+    ici_bw: float = 186e9           # per-direction per-link
+    mfu_ceiling: float = 0.6        # achievable fraction on big matmuls
+
+    @classmethod
+    def v5e(cls):
+        return cls()
+
+    @classmethod
+    def v5p(cls):
+        return cls(name="tpu-v5p", flops=459e12, hbm_bytes=95e9,
+                   hbm_bw=2765e9, ici_bw=600e9)
+
+
+@dataclass(frozen=True)
+class ModelSpec:
+    """Decoder-LM shape (enough to derive params/flops/bytes; the
+    reference cost model walks the program — here the program IS this
+    uniform stack, SURVEY §7 ladder rung 4)."""
+    num_layers: int = 32
+    hidden: int = 4096
+    intermediate: int = 11008
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    vocab: int = 32000
+    seq: int = 4096
+    global_batch: int = 64          # sequences per step
+
+    @property
+    def head_dim(self):
+        return self.hidden // self.num_heads
+
+    def params(self) -> float:
+        h, f = self.hidden, self.intermediate
+        kv = self.num_kv_heads * self.head_dim
+        per_layer = (h * h + 2 * h * kv + h * h      # q, k, v, o
+                     + 3 * h * f                     # swiglu w1/w3/w2
+                     + 2 * h)                        # norms
+        return (self.num_layers * per_layer
+                + 2 * self.vocab * self.hidden)      # embed + head
+
+    def step_flops(self) -> float:
+        """6·P·tokens + attention quadratic term."""
+        tokens = self.global_batch * self.seq
+        attn = (self.num_layers * 12 * self.global_batch
+                * self.num_heads * self.seq ** 2 * self.head_dim) / 2
+        return 6.0 * self.params() * tokens + attn
+
+
+@dataclass
+class Plan:
+    cfg: dict
+    step_ms: float
+    hbm_gb: float
+    breakdown: dict = field(default_factory=dict)
+
+    @property
+    def valid(self):
+        return math.isfinite(self.step_ms)
+
+    def __repr__(self):
+        c = self.cfg
+        return (f"Plan(dp={c['dp']} tp={c['tp']} pp={c['pp']} "
+                f"stage={c['sharding_stage']} micro={c['micro_batch']} "
+                f"~{self.step_ms:.1f} ms, {self.hbm_gb:.1f} GB/chip)")
+
+
+class Planner:
+    """Rank every (dp, tp, pp, sharding, micro) factorization by the
+    analytical step time; reject configs whose per-chip memory exceeds
+    HBM (reference planner_v2 + prune rules)."""
+
+    def __init__(self, model: ModelSpec, chip: ChipSpec | None = None,
+                 remat=True):
+        self.model = model
+        self.chip = chip or ChipSpec.v5e()
+        self.remat = remat
+
+    # ------------------------------------------------------------ memory
+    def hbm_bytes(self, cfg) -> float:
+        m, c = self.model, cfg
+        tp, pp, dp = c["tp"], c["pp"], c["dp"]
+        stage = c["sharding_stage"]
+        p_local = m.params() / (tp * pp)
+        # params bf16 + grads f32 + adam m/v f32 (+ master f32)
+        bytes_param = 2.0
+        bytes_grad = 4.0 / (dp if stage >= 2 else 1)
+        bytes_opt = 12.0 / (dp if stage >= 1 else 1)
+        if stage >= 3:
+            bytes_param = 2.0 / dp + 2.0   # sharded store + gathered live
+        fixed = p_local * (bytes_param + bytes_grad + bytes_opt)
+        # activations: micro-batch slice resident per pp stage; remat
+        # keeps ~2 live tensors per layer, else ~12 (attn+mlp residuals)
+        tokens_local = m.global_batch * m.seq / (dp * c["micro_batch"])
+        live_layers = m.num_layers / pp * (1 if not self.remat else
+                                           1.0 / max(1, m.num_layers // pp))
+        per_tok = m.hidden * 2.0 * (2 if self.remat else 12)
+        act = tokens_local * per_tok * max(1.0, live_layers) \
+            * (c["micro_batch"] if not self.remat else 1)
+        return fixed + act
+
+    # ------------------------------------------------------------- time
+    def step_time_ms(self, cfg) -> tuple[float, dict]:
+        m, ch, c = self.model, self.chip, cfg
+        tp, pp, dp = c["tp"], c["pp"], c["dp"]
+        n = tp * pp * dp
+        # compute: per-chip flops at the achievable ceiling, derated when
+        # tp slices matmuls thin (N/tp < 1024 starves the MXU)
+        eff = ch.mfu_ceiling
+        n_min = min(m.hidden, m.intermediate) / tp
+        if n_min < 1024:
+            # thin matmuls starve the MXU lanes (measured v5e behavior)
+            eff *= max(0.05, n_min / 1024)
+        t_compute = m.step_flops() / (n * ch.flops * eff)
+        # hbm streaming floor: params read once per micro-batch pass —
+        # a roofline bound, overlapped with compute (max, not sum)
+        t_hbm = (m.params() / (tp * pp)) * 2 * c["micro_batch"] / ch.hbm_bw
+        t_compute = max(t_compute, t_hbm)
+        # TP: 2 allreduces per layer fwd (+2 bwd) over activations
+        tokens_local = m.global_batch * m.seq / dp
+        ar_bytes = tokens_local * m.hidden * 2.0
+        t_tp = 0.0
+        if tp > 1:
+            per_ar = 2 * (tp - 1) / tp * ar_bytes / ch.ici_bw
+            t_tp = 4 * m.num_layers / pp * per_ar
+        # DP grad sync: reduce-scatter+allgather of local shard grads,
+        # largely overlapped with bwd compute (0.3 exposed)
+        t_dp = 0.0
+        if dp > 1:
+            sync = 2 * (dp - 1) / dp * (m.params() / (tp * pp)) * 2 \
+                / ch.ici_bw
+            t_dp = 0.3 * sync
+        # PP bubble multiplier (1F1B): (pp-1)/micro extra idle
+        micro = c["micro_batch"]
+        bubble = 1.0 + (pp - 1) / max(micro, 1)
+        total = (t_compute + t_tp) * bubble + t_dp
+        return total * 1e3, {
+            "compute_ms": t_compute * 1e3, "tp_ms": t_tp * 1e3,
+            "dp_ms": t_dp * 1e3, "hbm_ms": t_hbm * 1e3,
+            "bubble_x": bubble}
+
+    # ------------------------------------------------------------- plan
+    def plan(self, num_devices, top_k=5) -> list[Plan]:
+        out = []
+        for cfg in candidate_configs(num_devices):
+            if cfg["pp"] > self.model.num_layers:
+                continue
+            if self.model.num_heads % cfg["tp"] \
+                    or self.model.num_kv_heads % cfg["tp"]:
+                # GQA: k/v projections shard by kv head, not query head
+                continue
+            if self.model.global_batch % (cfg["dp"] * cfg["micro_batch"]):
+                continue
+            hbm = self.hbm_bytes(cfg)
+            if hbm > self.chip.hbm_bytes:
+                continue
+            ms, br = self.step_time_ms(cfg)
+            out.append(Plan(cfg, ms, hbm / 1e9, br))
+        out.sort(key=lambda p: p.step_ms)
+        return out[:top_k]
+
+    def best(self, num_devices) -> Plan:
+        plans = self.plan(num_devices, top_k=1)
+        if not plans:
+            raise ValueError(
+                f"no valid parallel config for {num_devices} devices: "
+                f"model does not fit {self.chip.name} HBM under any "
+                f"candidate (try more devices, remat, or sharding)")
+        return plans[0]
+
+    def to_strategy(self, plan: Plan):
+        """Materialize a fleet DistributedStrategy from a plan
+        (reference: planner writes dist attrs; here degrees drive
+        fleet.init / build_mesh)."""
+        from ..fleet.base import DistributedStrategy
+
+        s = DistributedStrategy()
+        s.hybrid_configs["dp_degree"] = plan.cfg["dp"]
+        s.hybrid_configs["mp_degree"] = plan.cfg["tp"]
+        s.hybrid_configs["pp_degree"] = plan.cfg["pp"]
+        stage = plan.cfg["sharding_stage"]
+        if stage:
+            s.sharding = True
+            s.sharding_configs = {"stage": stage,
+                                  "degree": plan.cfg["dp"]}
+            s.hybrid_configs["sharding_degree"] = plan.cfg["dp"]
+        if plan.cfg["pp"] > 1:
+            s.pipeline = True
+        s.pipeline_configs["accumulate_steps"] = plan.cfg["micro_batch"]
+        s.recompute = self.remat
+        return s
+
+
+def plan_parallel(model: ModelSpec, num_devices, chip: ChipSpec = None,
+                  remat=True, top_k=5):
+    """One-call surface: ranked plans for a model on N chips."""
+    return Planner(model, chip, remat=remat).plan(num_devices, top_k)

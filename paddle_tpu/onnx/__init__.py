@@ -1,20 +1,64 @@
-"""paddle.onnx — ONNX export entry.
+"""paddle.onnx — native ONNX export.
 
-Reference: python/paddle/onnx/export.py (delegates to paddle2onnx).
-Gated here: the onnx/paddle2onnx toolchain is not bundled (zero-egress
-image), and the TPU-native deployment path is `paddle.jit.save`'s
-StableHLO export (jit/serialization.py), which XLA-based runtimes load
-directly.  If `onnx` is importable we still refuse rather than emit a
-half-correct graph.
+Reference: python/paddle/onnx/export.py (delegates to the external
+paddle2onnx converter over a serialized inference program).  Here the
+conversion is in-tree: the layer is traced into a static Program
+(static/graph.py records the op DAG), each framework op maps onto ONNX
+operators (export.py), and the ModelProto is serialized with a
+hand-rolled protobuf wire writer (wire.py) — the `onnx` package is not
+bundled in this image and is not required.  Unsupported ops raise
+``OnnxUnsupportedError`` naming the op; a silently wrong graph is never
+emitted.  (The TPU-native deployment path remains ``paddle.jit.save``'s
+StableHLO artifact; ONNX export serves non-XLA runtimes.)
 """
 from __future__ import annotations
 
-__all__ = ["export"]
+from .export import OnnxUnsupportedError, export_program
+
+__all__ = ["export", "export_program", "OnnxUnsupportedError"]
 
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
-    raise NotImplementedError(
-        "ONNX graph conversion is not implemented (the paddle2onnx "
-        "toolchain is not bundled); use paddle_tpu.jit.save(layer, path, "
-        "input_spec=...) — its .stablehlo artifact is the TPU-native "
-        "deployment format, loadable via jax.export")
+def export(layer, path, input_spec=None, opset_version=17, **configs):
+    """Trace ``layer`` with ``input_spec`` and write ``path``+'.onnx'.
+
+    Reference signature: python/paddle/onnx/export.py.  The layer is
+    captured in eval mode (dropout off, batch-norm on global stats),
+    matching the reference's export of the inference program.
+    """
+    from .. import enable_static, disable_static
+    from ..static import Program, program_guard, data
+
+    if input_spec is None:
+        raise ValueError("paddle.onnx.export requires input_spec "
+                         "(list of paddle.static.InputSpec)")
+    if opset_version != 17:
+        raise ValueError(
+            f"paddle.onnx.export emits opset-17 operator semantics "
+            f"(LayerNormalization >= 17, attribute-form ReduceMean <= 17); "
+            f"got opset_version={opset_version}")
+    for spec in input_spec:
+        if any(d is None or d < 0 for d in spec.shape):
+            raise ValueError(
+                f"dynamic dims in input_spec {spec.shape}: this exporter "
+                "is static-shape (shapes are baked at trace time, like "
+                "jax.export) — pass concrete dims and re-export per "
+                "shape bucket")
+    was_training = getattr(layer, "training", False)
+    if hasattr(layer, "eval"):
+        layer.eval()
+    enable_static()
+    try:
+        prog = Program()
+        with program_guard(prog):
+            feeds = []
+            for i, spec in enumerate(input_spec):
+                feeds.append(data(spec.name or f"x{i}", list(spec.shape),
+                                  str(spec.dtype)))
+            out = layer(*feeds)
+        fetches = list(out) if isinstance(out, (list, tuple)) else [out]
+        return export_program(feeds, fetches, path,
+                              name=type(layer).__name__)
+    finally:
+        disable_static()
+        if was_training and hasattr(layer, "train"):
+            layer.train()

@@ -1,0 +1,356 @@
+"""paddle.onnx.export — static Program / Layer → ONNX file.
+
+Reference: the reference exports through the external paddle2onnx
+converter (python/paddle/onnx/export.py calls p2o over a serialized
+inference program).  Here the converter is native: the recorded static
+Program DAG (static/graph.py) maps op-by-op onto ONNX operators and the
+file is serialized with the in-tree protobuf wire writer (wire.py /
+proto.py) — no external packages.
+
+Supported ops cover the deploy-side surface (linear/conv/pool/norm/
+activation/shape ops).  Anything else raises a loud
+``OnnxUnsupportedError`` naming the op — never a silently wrong graph.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import proto
+from .proto import NP2ONNX
+
+__all__ = ["export_program", "OnnxUnsupportedError"]
+
+
+class OnnxUnsupportedError(NotImplementedError):
+    pass
+
+
+def _opname_of(var):
+    # build_node names outputs f"{opname}_{counter}"
+    return var.name.rsplit("_", 1)[0]
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return [int(x) for x in v]
+    return [int(v)] * n
+
+
+def _pads(padding, nd=2):
+    """paddle padding (int | [p1, p2] | [(lo, hi), ...]) -> onnx pads
+    [x1_begin, x2_begin, ..., x1_end, x2_end, ...]."""
+    if isinstance(padding, int):
+        return [padding] * (2 * nd)
+    padding = list(padding)
+    if all(isinstance(p, int) for p in padding) and len(padding) == nd:
+        return padding + padding
+    lohi = [tuple(p) for p in padding]
+    return [p[0] for p in lohi] + [p[1] for p in lohi]
+
+
+class _Exporter:
+    def __init__(self):
+        self.nodes: list[bytes] = []
+        self.initializers: list[bytes] = []
+        self._init_names: dict[int, str] = {}
+        self._var_names: dict[str, str] = {}
+        self._tmp = 0
+
+    # ------------------------------------------------------------ names
+    def tmp(self, base):
+        self._tmp += 1
+        return f"{base}__{self._tmp}"
+
+    def ref(self, x):
+        """ONNX name for a Variable / Parameter / python constant."""
+        from ..framework.tensor import Tensor
+        from ..static.graph import Variable
+
+        if isinstance(x, Variable):
+            return self._var_names[x.name]
+        if isinstance(x, Tensor):
+            key = id(x)
+            if key not in self._init_names:
+                name = x.name or f"param_{len(self._init_names)}"
+                self._init_names[key] = name
+                self.initializers.append(
+                    proto.tensor(name, np.asarray(x._data)))
+            return self._init_names[key]
+        # literal -> constant initializer
+        arr = np.asarray(x)
+        name = self.tmp("const")
+        self.initializers.append(proto.tensor(name, arr))
+        return name
+
+    def const(self, arr, base="c"):
+        name = self.tmp(base)
+        self.initializers.append(proto.tensor(name, np.asarray(arr)))
+        return name
+
+    def emit(self, op_type, inputs, outputs, **attrs):
+        self.nodes.append(proto.node(op_type, inputs, outputs,
+                                     name=self.tmp(op_type), **attrs))
+
+    # ------------------------------------------------------------- walk
+    def export(self, feed_vars, fetch_vars, name="paddle_tpu"):
+        from ..static.graph import Variable
+
+        for v in feed_vars:
+            self._var_names[v.name] = v.name
+
+        done = set()
+
+        def visit(v: Variable):
+            if v.name in self._var_names:
+                return
+            if v.source is None:
+                raise OnnxUnsupportedError(
+                    f"variable {v.name} has no source and is not a feed")
+            src_id = id(v.source)
+            if src_id in done:
+                return
+            # visit producers first
+            from jax.tree_util import tree_flatten
+            from ..framework.tensor import Tensor
+            body, args, kwargs, n_outs = v.source
+            flat, _ = tree_flatten(
+                (args, kwargs),
+                is_leaf=lambda x: isinstance(x, (Variable, Tensor)))
+            for x in flat:
+                if isinstance(x, Variable):
+                    visit(x)
+            done.add(src_id)
+            self._emit_op(v, body, args, kwargs, n_outs)
+
+        for v in fetch_vars:
+            visit(v)
+
+        inputs = [proto.value_info(v.name, v.shape,
+                                   NP2ONNX[np.dtype(v.dtype)])
+                  for v in feed_vars]
+        outputs = [proto.value_info(self._var_names[v.name], v.shape,
+                                    NP2ONNX[np.dtype(v.dtype)])
+                   for v in fetch_vars]
+        g = proto.graph(self.nodes, name, inputs, outputs,
+                        self.initializers)
+        return proto.model(g)
+
+    # ------------------------------------------------------ op emitters
+    def _emit_op(self, out_var, body, args, kwargs, n_outs):
+        from ..static.graph import Variable
+
+        opname = _opname_of(out_var)
+        prog = out_var.program
+        outs = [w for w in prog.vars.values() if w.source is out_var.source]
+        outs.sort(key=lambda w: w.out_index)
+        out_names = []
+        for w in outs:
+            nm = w.name
+            self._var_names[w.name] = nm
+            out_names.append(nm)
+        self._cur_outs = outs   # static shapes for shape-op emitters
+
+        fn = getattr(self, f"_op_{opname}", None)
+        if fn is None:
+            raise OnnxUnsupportedError(
+                f"op '{opname}' has no ONNX mapping (paddle_tpu.onnx "
+                f"supports: "
+                f"{sorted(m[4:] for m in dir(self) if m.startswith('_op_'))})")
+        fn(args, kwargs, out_names)
+
+    # elementwise / activations ------------------------------------------
+    def _binop(self, onnx_op, args, out_names):
+        self.emit(onnx_op, [self.ref(args[0]), self.ref(args[1])],
+                  out_names)
+
+    def _op_add(self, a, k, o):
+        self._binop("Add", a, o)
+
+    def _op_subtract(self, a, k, o):
+        self._binop("Sub", a, o)
+
+    def _op_multiply(self, a, k, o):
+        self._binop("Mul", a, o)
+
+    def _op_divide(self, a, k, o):
+        self._binop("Div", a, o)
+
+    def _op_relu(self, a, k, o):
+        self.emit("Relu", [self.ref(a[0])], o)
+
+    def _op_sigmoid(self, a, k, o):
+        self.emit("Sigmoid", [self.ref(a[0])], o)
+
+    def _op_tanh(self, a, k, o):
+        self.emit("Tanh", [self.ref(a[0])], o)
+
+    def _op_softmax(self, a, k, o):
+        axis = k.get("axis", a[1] if len(a) > 1 else -1)
+        self.emit("Softmax", [self.ref(a[0])], o, axis=int(axis))
+
+    def _op_cast(self, a, k, o):
+        dt = k.get("dtype", a[1] if len(a) > 1 else "float32")
+        from ..framework.dtype import to_np_dtype
+        self.emit("Cast", [self.ref(a[0])], o,
+                  to=NP2ONNX[np.dtype(to_np_dtype(dt))])
+
+    # linear algebra ------------------------------------------------------
+    def _op_matmul(self, a, k, o):
+        if k.get("transpose_x") or k.get("transpose_y"):
+            raise OnnxUnsupportedError("matmul transpose_x/y")
+        self._binop("MatMul", a, o)
+
+    def _op_linear(self, a, k, o):
+        x, w = a[0], a[1]
+        bias = k.get("bias", a[2] if len(a) > 2 else None)
+        if bias is None:
+            self.emit("MatMul", [self.ref(x), self.ref(w)], o)
+        else:
+            mm = self.tmp("linear_mm")
+            self.emit("MatMul", [self.ref(x), self.ref(w)], [mm])
+            self.emit("Add", [mm, self.ref(bias)], o)
+
+    # conv / pool ---------------------------------------------------------
+    def _op_conv2d(self, a, k, o):
+        x, w = a[0], a[1]
+        bias = k.get("bias", a[2] if len(a) > 2 else None)
+        if k.get("data_format", "NCHW") != "NCHW":
+            raise OnnxUnsupportedError("conv2d NHWC export")
+        ins = [self.ref(x), self.ref(w)]
+        if bias is not None:
+            ins.append(self.ref(bias))
+        self.emit("Conv", ins, o,
+                  strides=_pair(k.get("stride", 1)),
+                  pads=_pads(k.get("padding", 0)),
+                  dilations=_pair(k.get("dilation", 1)),
+                  group=int(k.get("groups", 1)))
+
+    def _pool(self, onnx_op, a, k, o, extra=None):
+        ksize = _pair(k.get("kernel_size", a[1] if len(a) > 1 else 2))
+        stride = k.get("stride")
+        stride = ksize if stride is None else _pair(stride)
+        attrs = dict(kernel_shape=ksize, strides=stride,
+                     pads=_pads(k.get("padding", 0)),
+                     ceil_mode=int(bool(k.get("ceil_mode", False))))
+        if extra:
+            attrs.update(extra)
+        self.emit(onnx_op, [self.ref(a[0])], o, **attrs)
+
+    def _op_max_pool2d(self, a, k, o):
+        self._pool("MaxPool", a, k, o)
+
+    def _op_avg_pool2d(self, a, k, o):
+        self._pool("AveragePool", a, k, o,
+                   extra={"count_include_pad":
+                          int(not k.get("exclusive", True))})
+
+    def _op_adaptive_avg_pool2d(self, a, k, o):
+        osz = k.get("output_size", a[1] if len(a) > 1 else 1)
+        if _pair(osz) != [1, 1]:
+            raise OnnxUnsupportedError("adaptive_avg_pool2d output != 1")
+        self.emit("GlobalAveragePool", [self.ref(a[0])], o)
+
+    # shape ops -----------------------------------------------------------
+    def _op_flatten(self, a, k, o):
+        # ONNX Flatten is strictly 2-D-out; paddle's (start, stop) form
+        # is a Reshape to the statically known output shape
+        shp = self.const(
+            np.asarray(self._cur_outs[0].shape, np.int64), "flat_shape")
+        self.emit("Reshape", [self.ref(a[0]), shp], o)
+
+    def _op_reshape(self, a, k, o):
+        shape = k.get("shape", a[1])
+        shp = self.const(np.asarray(list(shape), np.int64), "shape")
+        self.emit("Reshape", [self.ref(a[0]), shp], o)
+
+    def _op_transpose(self, a, k, o):
+        perm = k.get("perm", a[1])
+        self.emit("Transpose", [self.ref(a[0])], o,
+                  perm=[int(p) for p in perm])
+
+    def _op_concat(self, a, k, o):
+        xs = a[0]
+        axis = int(k.get("axis", a[1] if len(a) > 1 else 0))
+        self.emit("Concat", [self.ref(x) for x in xs], o, axis=axis)
+
+    def _op_mean(self, a, k, o):
+        # opset <= 17: axes is an ATTRIBUTE (moved to an input in 18)
+        axis = k.get("axis", a[1] if len(a) > 1 else None)
+        keep = bool(k.get("keepdim", False))
+        if axis is None:
+            self.emit("ReduceMean", [self.ref(a[0])], o,
+                      keepdims=int(keep))
+        else:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            self.emit("ReduceMean", [self.ref(a[0])], o,
+                      axes=[int(x) for x in axes], keepdims=int(keep))
+
+    def _op_embedding(self, a, k, o):
+        x, w = a[0], a[1]
+        if k.get("padding_idx") is not None:
+            raise OnnxUnsupportedError("embedding padding_idx export")
+        self.emit("Gather", [self.ref(w), self.ref(x)], o, axis=0)
+
+    # norm / dropout ------------------------------------------------------
+    def _op_batch_norm(self, a, k, o):
+        # inference form only (Program.clone(for_test=True) bakes
+        # training=False); outputs: (y, new_rm, new_rv) — rm/rv pass
+        # through untouched at inference
+        training = k.get("training", a[5] if len(a) > 5 else False)
+        if training:
+            raise OnnxUnsupportedError(
+                "batch_norm training=True (export in eval mode)")
+        x, rm, rv = a[0], a[1], a[2]
+        w = k.get("weight", a[3] if len(a) > 3 else None)
+        b = k.get("bias", a[4] if len(a) > 4 else None)
+        eps = float(k.get("epsilon", a[7] if len(a) > 7 else 1e-5))
+        c = np.asarray(rm._data if hasattr(rm, "_data") else rm).shape[0]
+        wn = self.ref(w) if w is not None else \
+            self.const(np.ones(c, np.float32), "bn_w")
+        bn = self.ref(b) if b is not None else \
+            self.const(np.zeros(c, np.float32), "bn_b")
+        self.emit("BatchNormalization",
+                  [self.ref(x), wn, bn, self.ref(rm), self.ref(rv)],
+                  [o[0]], epsilon=eps)
+        # rm/rv outputs: identity passthrough keeps the graph closed
+        for i, src in ((1, rm), (2, rv)):
+            if i < len(o):
+                self.emit("Identity", [self.ref(src)], [o[i]])
+
+    def _op_layer_norm(self, a, k, o):
+        x = a[0]
+        w = k.get("weight", a[2] if len(a) > 2 else None)
+        b = k.get("bias", a[3] if len(a) > 3 else None)
+        eps = float(k.get("epsilon", a[4] if len(a) > 4 else 1e-5))
+        norm_shape = k.get("normalized_shape", a[1] if len(a) > 1 else None)
+        if isinstance(norm_shape, int):
+            norm_dims, nd = [norm_shape], 1
+        else:
+            norm_dims = [int(d) for d in norm_shape]
+            nd = len(norm_dims)
+        # ONNX Scale (input 2) is REQUIRED: synthesize ones when paddle
+        # had no weight, so a provided bias is never silently dropped
+        scale = self.ref(w) if w is not None else self.const(
+            np.ones(norm_dims, np.float32), "ln_scale")
+        ins = [self.ref(x), scale]
+        if b is not None:
+            ins.append(self.ref(b))
+        self.emit("LayerNormalization", ins, o[:1], axis=-nd, epsilon=eps)
+
+    def _op_dropout(self, a, k, o):
+        training = k.get("training", True)
+        if training:
+            raise OnnxUnsupportedError(
+                "dropout training=True (clone the program for_test)")
+        self.emit("Identity", [self.ref(a[0])], o[:1])
+
+
+def export_program(feed_vars, fetch_vars, path, name="paddle_tpu"):
+    """Serialize the program slice producing ``fetch_vars`` to
+    ``path`` (binary ONNX ModelProto).  Returns the path."""
+    if not path.endswith(".onnx"):
+        path = path + ".onnx"
+    blob = _Exporter().export(feed_vars, fetch_vars, name=name)
+    with open(path, "wb") as f:
+        f.write(blob)
+    return path

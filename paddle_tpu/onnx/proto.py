@@ -1,0 +1,116 @@
+"""ONNX proto message builders over the wire encoder.
+
+Field numbers follow the public onnx/onnx.proto schema (stable across
+IR versions).  Only the message subset `export` emits is implemented.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .wire import (field_bytes, field_float, field_string, field_varint,
+                   varint)
+
+# TensorProto.DataType
+FLOAT, INT64, INT32, BOOL, DOUBLE = 1, 7, 6, 9, 11
+UINT8, INT8, FLOAT16, BFLOAT16 = 2, 3, 10, 16
+
+NP2ONNX = {
+    np.dtype(np.float32): FLOAT, np.dtype(np.int64): INT64,
+    np.dtype(np.int32): INT32, np.dtype(np.bool_): BOOL,
+    np.dtype(np.float64): DOUBLE, np.dtype(np.uint8): UINT8,
+    np.dtype(np.int8): INT8, np.dtype(np.float16): FLOAT16,
+}
+
+# AttributeProto.AttributeType
+A_FLOAT, A_INT, A_STRING, A_TENSOR, A_FLOATS, A_INTS, A_STRINGS = \
+    1, 2, 3, 4, 6, 7, 8
+
+
+def tensor(name: str, arr: np.ndarray) -> bytes:
+    """TensorProto: dims=1, data_type=2, name=8, raw_data=9."""
+    arr = np.ascontiguousarray(arr)
+    out = b"".join(field_varint(1, int(d)) for d in arr.shape)
+    out += field_varint(2, NP2ONNX[arr.dtype])
+    out += field_string(8, name)
+    out += field_bytes(9, arr.tobytes())
+    return out
+
+
+def attribute(name: str, value) -> bytes:
+    """AttributeProto: name=1, f=2, i=3, s=4, t=5, floats=7, ints=8,
+    type=20."""
+    out = field_string(1, name)
+    if isinstance(value, bool):
+        out += field_varint(3, int(value)) + field_varint(20, A_INT)
+    elif isinstance(value, int):
+        out += field_varint(3, value) + field_varint(20, A_INT)
+    elif isinstance(value, float):
+        out += field_float(2, value) + field_varint(20, A_FLOAT)
+    elif isinstance(value, str):
+        out += field_bytes(4, value.encode()) + field_varint(20, A_STRING)
+    elif isinstance(value, np.ndarray):
+        out += field_bytes(5, tensor(name + "_t", value))
+        out += field_varint(20, A_TENSOR)
+    elif isinstance(value, (list, tuple)):
+        if value and isinstance(value[0], float):
+            for v in value:
+                out += field_float(7, v)
+            out += field_varint(20, A_FLOATS)
+        else:
+            for v in value:
+                out += field_varint(8, int(v))
+            out += field_varint(20, A_INTS)
+    else:
+        raise TypeError(f"attribute {name}: {type(value)}")
+    return out
+
+
+def node(op_type: str, inputs, outputs, name="", **attrs) -> bytes:
+    """NodeProto: input=1, output=2, name=3, op_type=4, attribute=5."""
+    out = b"".join(field_string(1, i) for i in inputs)
+    out += b"".join(field_string(2, o) for o in outputs)
+    if name:
+        out += field_string(3, name)
+    out += field_string(4, op_type)
+    for k, v in attrs.items():
+        out += field_bytes(5, attribute(k, v))
+    return out
+
+
+def value_info(name: str, shape, elem_type=FLOAT) -> bytes:
+    """ValueInfoProto{name=1, type=2{tensor_type=1{elem_type=1,
+    shape=2{dim=1{dim_value=1|dim_param=2}}}}}."""
+    dims = b""
+    for d in shape:
+        if d is None or (isinstance(d, int) and d < 0):
+            dim = field_string(2, "batch")
+        else:
+            dim = field_varint(1, int(d))
+        dims += field_bytes(1, dim)
+    shape_p = field_bytes(2, dims)
+    ttype = field_varint(1, elem_type) + shape_p
+    tp = field_bytes(1, ttype)
+    return field_string(1, name) + field_bytes(2, tp)
+
+
+def graph(nodes, name, inputs, outputs, initializers) -> bytes:
+    """GraphProto: node=1, name=2, initializer=5, input=11, output=12."""
+    out = b"".join(field_bytes(1, n) for n in nodes)
+    out += field_string(2, name)
+    out += b"".join(field_bytes(5, t) for t in initializers)
+    out += b"".join(field_bytes(11, i) for i in inputs)
+    out += b"".join(field_bytes(12, o) for o in outputs)
+    return out
+
+
+def model(graph_bytes: bytes, opset: int = 17,
+          producer: str = "paddle_tpu") -> bytes:
+    """ModelProto: ir_version=1, producer_name=2, producer_version=3,
+    graph=7, opset_import=8{domain=1, version=2}."""
+    opset_p = field_string(1, "") + field_varint(2, opset)
+    out = field_varint(1, 8)               # IR version 8
+    out += field_string(2, producer)
+    out += field_string(3, "0.0")
+    out += field_bytes(7, graph_bytes)
+    out += field_bytes(8, opset_p)
+    return out

