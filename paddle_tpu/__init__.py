@@ -121,6 +121,7 @@ from . import inference  # noqa: F401
 from . import quantization  # noqa: F401
 from . import audio  # noqa: F401
 from . import text  # noqa: F401
+from . import strings  # noqa: F401
 from . import geometric  # noqa: F401
 from . import incubate  # noqa: F401
 from . import utils  # noqa: F401

@@ -1,0 +1,112 @@
+"""String-tensor ops.
+
+Reference: paddle/phi/kernels/strings/ (strings_empty_kernel.cc,
+strings_lower_upper_kernel.h, case_utils.h, unicode.cc) + the op schema
+paddle/phi/ops/yaml/strings_ops.yaml — four ops over a StringTensor:
+``empty``, ``empty_like``, ``lower(x, use_utf8_encoding)``,
+``upper(x, use_utf8_encoding)``.
+
+TPU formulation: strings have no device representation (the reference's
+GPU kernels also serialize through pinned host memory); a StringTensor
+here is an N-d numpy object array of ``str`` living host-side, feeding
+tokenizers whose OUTPUT (ids) is what reaches the TPU.  Case mapping:
+``use_utf8_encoding=False`` converts ASCII bytes only (reference
+AsciiCaseConverter); ``True`` applies unicode case mapping via Python's
+str.  Divergence note: Python performs FULL case mapping (one-to-many:
+``'ß'.upper() == 'SS'``), while the reference's UTF8CaseConverter maps
+codepoint-to-codepoint from its own tables and leaves such characters
+unchanged — full mapping is the Unicode-correct behavior, so it is kept
+deliberately.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["StringTensor", "empty", "empty_like", "lower", "upper"]
+
+
+class StringTensor:
+    """N-d tensor of python strings (reference phi::StringTensor)."""
+
+    def __init__(self, data, name=None):
+        if isinstance(data, StringTensor):
+            data = data._data
+        # copy: normalization must not mutate the caller's buffer, and
+        # the tensor must not alias it
+        arr = np.array(data, dtype=object)
+        flat = arr.reshape(-1)
+        for i, v in enumerate(flat):
+            if not isinstance(v, str):
+                flat[i] = "" if v is None else str(v)
+        self._data = flat.reshape(arr.shape)
+        self.name = name
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def dtype(self):
+        return "pstring"
+
+    def numpy(self):
+        return self._data
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        return out if isinstance(out, str) else StringTensor(out)
+
+    def __eq__(self, other):
+        o = other._data if isinstance(other, StringTensor) else other
+        return bool(np.array_equal(self._data, np.asarray(o, object)))
+
+    __hash__ = object.__hash__   # identity; __eq__ is whole-tensor
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, data={self._data!r})"
+
+
+def empty(shape, name=None):
+    """StringTensor of empty strings (strings_empty kernel)."""
+    arr = np.empty(tuple(int(s) for s in shape), dtype=object)
+    arr[...] = ""
+    return StringTensor(arr, name=name)
+
+
+def empty_like(x, name=None):
+    """Same-shape empty StringTensor (strings_empty_like kernel)."""
+    return empty(x.shape if isinstance(x, StringTensor)
+                 else np.asarray(x, object).shape, name=name)
+
+
+def _case_map(x, fn_unicode, fn_ascii, use_utf8_encoding):
+    if not isinstance(x, StringTensor):
+        x = StringTensor(x)
+    out = np.empty(x._data.shape, dtype=object)
+    src = x._data.reshape(-1)
+    dst = out.reshape(-1)
+    for i, s in enumerate(src):
+        dst[i] = fn_unicode(s) if use_utf8_encoding else fn_ascii(s)
+    return StringTensor(out)
+
+
+def _ascii_lower(s: str) -> str:
+    return "".join(chr(ord(c) + 32) if "A" <= c <= "Z" else c for c in s)
+
+
+def _ascii_upper(s: str) -> str:
+    return "".join(chr(ord(c) - 32) if "a" <= c <= "z" else c for c in s)
+
+
+def lower(x, use_utf8_encoding=False, name=None):
+    """strings_lower: ASCII-only by default, full unicode with
+    ``use_utf8_encoding=True`` (reference strings_lower_upper_kernel.h)."""
+    return _case_map(x, str.lower, _ascii_lower, use_utf8_encoding)
+
+
+def upper(x, use_utf8_encoding=False, name=None):
+    """strings_upper (see :func:`lower`)."""
+    return _case_map(x, str.upper, _ascii_upper, use_utf8_encoding)

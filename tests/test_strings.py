@@ -1,0 +1,53 @@
+"""String-tensor ops (reference: paddle/phi/kernels/strings/ +
+paddle/phi/ops/yaml/strings_ops.yaml)."""
+import numpy as np
+
+from paddle_tpu import strings
+
+
+def test_empty_and_empty_like():
+    t = strings.empty([2, 3])
+    assert t.shape == [2, 3] and t.dtype == "pstring"
+    assert all(v == "" for v in t.numpy().reshape(-1))
+    u = strings.empty_like(t)
+    assert u.shape == t.shape
+
+
+def test_ascii_case_conversion_leaves_unicode_alone():
+    t = strings.StringTensor([["Hello WORLD", "Straße"], ["ÀÉÎ", "a1B2"]])
+    lo = strings.lower(t)                      # ascii mode
+    assert lo[0, 0] == "hello world"
+    assert lo[0, 1] == "straße"                # ß untouched in ascii
+    assert lo[1, 0] == "ÀÉÎ"                   # non-ascii untouched
+    assert lo[1, 1] == "a1b2"
+    up = strings.upper(t)
+    assert up[0, 0] == "HELLO WORLD"
+    assert up[1, 1] == "A1B2"
+
+
+def test_utf8_case_conversion():
+    t = strings.StringTensor(["ÀÉÎ", "Straße"])
+    lo = strings.lower(t, use_utf8_encoding=True)
+    assert lo[0] == "àéî"
+    up = strings.upper(t, use_utf8_encoding=True)
+    assert up[0] == "ÀÉÎ"
+    assert up[1] == "STRASSE"                  # unicode ß -> SS
+
+
+def test_string_tensor_coercion_and_shape():
+    src = np.array([1, None, "x"], dtype=object)
+    t = strings.StringTensor(src)
+    assert t.tolist() == ["1", "", "x"]
+    assert src[0] == 1 and src[1] is None     # caller buffer untouched
+    assert not np.shares_memory(t.numpy(), src)
+    assert strings.lower(["AbC"])[0] == "abc"  # raw lists accepted
+
+
+def test_copy_ctor_namespace_and_hash():
+    import paddle_tpu
+    assert paddle_tpu.strings is strings       # reachable namespace
+    t = strings.StringTensor(["a", "B"])
+    u = strings.StringTensor(t)                # copy, not repr-wrap
+    assert u.shape == [2] and u.tolist() == ["a", "B"]
+    assert u == t
+    assert isinstance(hash(t), int)            # usable in sets/dicts
