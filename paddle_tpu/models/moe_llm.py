@@ -31,8 +31,9 @@ from .llama_hybrid import _rms, _chunked_ce_sum
 from ..ops.pallas.flash_attention import sdpa
 from ..distributed.moe import moe_dispatch_combine
 
-__all__ = ["MoEConfig", "moe_tiny", "qwen2_moe_a14b", "init_params",
-           "param_shardings", "build_mesh", "build_train_step", "setup"]
+__all__ = ["MoEConfig", "moe_tiny", "qwen2_moe_a14b", "deepseek_moe_16b",
+           "init_params", "param_shardings", "build_mesh",
+           "build_train_step", "setup"]
 
 
 @dataclass
@@ -45,6 +46,7 @@ class MoEConfig:
     num_key_value_heads: int = 16
     num_experts: int = 8
     top_k: int = 2
+    num_shared_experts: int = 0     # DeepSeekMoE: always-on dense experts
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.01
     max_position_embeddings: int = 4096
@@ -67,12 +69,28 @@ def moe_tiny(**kw) -> MoEConfig:
 
 
 def qwen2_moe_a14b() -> MoEConfig:
-    """Qwen2-57B-A14B-shaped config (reference family)."""
+    """Qwen2-57B-A14B-shaped config (reference family).  Qwen2-MoE also
+    carries a shared expert (shared_expert_intermediate_size) — modeled
+    here as num_shared_experts * moe_intermediate_size."""
     return MoEConfig(
         vocab_size=151936, hidden_size=3584, moe_intermediate_size=2560,
         num_hidden_layers=28, num_attention_heads=28,
         num_key_value_heads=4, num_experts=64, top_k=8,
+        num_shared_experts=8,
         max_position_embeddings=8192, dtype="bfloat16")
+
+
+def deepseek_moe_16b() -> MoEConfig:
+    """DeepSeekMoE-16B-shaped config: fine-grained routed experts plus
+    2 shared experts that every token passes through (the DeepSeekMoE
+    architecture; reference ships the family through its MoE layer +
+    incubate/distributed/models/moe)."""
+    return MoEConfig(
+        vocab_size=102400, hidden_size=2048, moe_intermediate_size=1408,
+        num_hidden_layers=28, num_attention_heads=16,
+        num_key_value_heads=16, num_experts=64, top_k=6,
+        num_shared_experts=2,
+        max_position_embeddings=4096, dtype="bfloat16")
 
 
 def build_mesh(n_devices=None, dp=1, ep=1, devices=None):
@@ -110,6 +128,12 @@ def init_params(config: MoEConfig, key, dtype=jnp.float32):
             "b1": jnp.zeros((L, E, f), dtype),
             "w2": w(ks[7], E, f, h, fan_in=f),
             "b2": jnp.zeros((L, E, h), dtype),
+            **({"sw1": w(jax.random.fold_in(ks[9], 1), h,
+                         config.num_shared_experts * f, fan_in=h),
+                "sw2": w(jax.random.fold_in(ks[9], 2),
+                         config.num_shared_experts * f, h,
+                         fan_in=config.num_shared_experts * f)}
+               if config.num_shared_experts else {}),
         },
         "norm": jnp.ones((h,), dtype),
         "head": (jax.random.normal(ks[8], (h, config.vocab_size),
@@ -118,19 +142,34 @@ def init_params(config: MoEConfig, key, dtype=jnp.float32):
     }
 
 
-def param_shardings(mesh: Mesh):
+def param_shardings(mesh: Mesh, config: MoEConfig | None = None,
+                    params=None):
+    """Sharding tree matching ``init_params``.  Pass the same ``config``
+    (or the params tree itself) — presets with shared experts
+    (qwen2_moe_a14b, deepseek_moe_16b) carry sw1/sw2 leaves that a
+    config-less call cannot know about."""
     s = functools.partial(NamedSharding, mesh)
     rep2 = s(P(None, None))
     rep3 = s(P(None, None, None))
     exp = s(P(None, "ep", None, None))     # [L, E, ...] expert-sharded
+    layers = {
+        "input_ln": rep2, "q": rep3, "k": rep3, "v": rep3, "o": rep3,
+        "post_ln": rep2, "gate": rep3,
+        "w1": exp, "b1": s(P(None, "ep", None)), "w2": exp,
+        "b2": s(P(None, "ep", None)),
+    }
+    shared = (config is not None and config.num_shared_experts) or \
+        (params is not None and "sw1" in params.get("layers", {}))
+    if shared:
+        # shared experts run on EVERY token, so their weights shard the
+        # inner (S*f) dim over ep, tensor-parallel style: GSPMD makes the
+        # second matmul a partial-sum + allreduce and each chip stores
+        # 1/ep of the biggest dense tensors in the model
+        layers["sw1"] = s(P(None, None, "ep"))
+        layers["sw2"] = s(P(None, "ep", None))
     return {
         "embed": rep2,
-        "layers": {
-            "input_ln": rep2, "q": rep3, "k": rep3, "v": rep3, "o": rep3,
-            "post_ln": rep2, "gate": rep3,
-            "w1": exp, "b1": s(P(None, "ep", None)), "w2": exp,
-            "b2": s(P(None, "ep", None)),
-        },
+        "layers": layers,
         "norm": s(P(None)),
         "head": rep2,
     }
@@ -161,6 +200,10 @@ def _layer(lp, x, cos, sin, config: MoEConfig, mesh):
         flat, lp["gate"], lp["w1"], lp["b1"], lp["w2"], lp["b2"],
         top_k=config.top_k, capacity_factor=config.capacity_factor,
         activation=jax.nn.silu, mesh=mesh, ep_axis="ep")
+    if config.num_shared_experts:
+        # DeepSeekMoE / Qwen2-MoE shared experts: a dense FFN every token
+        # passes through, added to the routed output (no gating)
+        y = y + jax.nn.silu(flat @ lp["sw1"]) @ lp["sw2"]
     return r + y.reshape(b, sq, hdim), aux
 
 
@@ -203,4 +246,4 @@ def setup(config: MoEConfig, mesh: Mesh, seed=0, dtype=None):
         dtype = jnp.dtype(config.dtype)    # honor the config preset
     params = init_params(config, jax.random.key(seed), dtype)
     return jax.tree_util.tree_map(jax.device_put, params,
-                                  param_shardings(mesh))
+                                  param_shardings(mesh, config))

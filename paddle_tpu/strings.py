@@ -37,9 +37,21 @@ class StringTensor:
         flat = arr.reshape(-1)
         for i, v in enumerate(flat):
             if not isinstance(v, str):
+                if isinstance(v, (list, tuple, np.ndarray)):
+                    raise ValueError(
+                        "ragged string data: all rows must have the same "
+                        f"length (element {i} is a {type(v).__name__})")
                 flat[i] = "" if v is None else str(v)
         self._data = flat.reshape(arr.shape)
         self.name = name
+
+    @classmethod
+    def _adopt(cls, arr, name=None):
+        """Internal: wrap an all-str object array without copy/rescan."""
+        t = cls.__new__(cls)
+        t._data = arr
+        t.name = name
+        return t
 
     @property
     def shape(self):
@@ -90,7 +102,7 @@ def _case_map(x, fn_unicode, fn_ascii, use_utf8_encoding):
     dst = out.reshape(-1)
     for i, s in enumerate(src):
         dst[i] = fn_unicode(s) if use_utf8_encoding else fn_ascii(s)
-    return StringTensor(out)
+    return StringTensor._adopt(out)
 
 
 def _ascii_lower(s: str) -> str:

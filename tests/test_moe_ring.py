@@ -243,3 +243,32 @@ class TestSortDispatch:
                      + moe_dispatch_combine(*args, top_k=2, train=False,
                                             dispatch_mode="dense")[1]),
             rtol=1e-4)
+
+
+def test_shared_experts_deepseek_style():
+    """DeepSeekMoE/Qwen2-MoE shared experts: dense always-on FFN added to
+    the routed output (reference families; SURVEY ladder rung 5)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.models import moe_llm as M
+
+    cfg = M.moe_tiny(num_shared_experts=2)
+    mesh = M.build_mesh(1, dp=1, ep=1)
+    params = M.setup(cfg, mesh)
+    assert "sw1" in params["layers"] and "sw2" in params["layers"]
+    f = cfg.moe_intermediate_size
+    assert params["layers"]["sw1"].shape == (
+        cfg.num_hidden_layers, cfg.hidden_size, 2 * f)
+
+    step = M.build_train_step(cfg, mesh, lr=1e-2)
+    ids = jnp.asarray(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (2, 33)))
+    l0, params = step(params, ids)
+    for _ in range(4):
+        ln, params = step(params, ids)
+    assert float(ln) < float(l0)
+
+    # config without shared experts has no sw params (exact pytree match)
+    p0 = M.setup(M.moe_tiny(), mesh)
+    assert "sw1" not in p0["layers"]

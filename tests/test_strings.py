@@ -51,3 +51,9 @@ def test_copy_ctor_namespace_and_hash():
     assert u.shape == [2] and u.tolist() == ["a", "B"]
     assert u == t
     assert isinstance(hash(t), int)            # usable in sets/dicts
+
+
+def test_ragged_input_raises():
+    import pytest
+    with pytest.raises(ValueError, match="ragged"):
+        strings.StringTensor([["a", "b"], ["c"]])
