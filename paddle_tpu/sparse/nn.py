@@ -1,12 +1,16 @@
-"""sparse.nn (reference: python/paddle/sparse/nn — ReLU, Softmax layers)."""
+"""sparse.nn (reference: python/paddle/sparse/nn — ReLU/Softmax plus the
+point-cloud stack: Conv3D/SubmConv3D/BatchNorm/MaxPool3D)."""
 from __future__ import annotations
 
 import jax.numpy as jnp
 
 from . import unary
 from .coo import SparseCooTensor, SparseCsrTensor
+from .conv import (Conv3D, SubmConv3D, BatchNorm, MaxPool3D,  # noqa: F401
+                   conv3d, subm_conv3d)
 
-__all__ = ["ReLU", "Softmax"]
+__all__ = ["ReLU", "Softmax", "Conv3D", "SubmConv3D", "BatchNorm",
+           "MaxPool3D"]
 
 
 class ReLU:

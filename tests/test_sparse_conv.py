@@ -1,0 +1,149 @@
+"""Sparse 3D convolutions vs dense reference (reference:
+paddle/phi/kernels/sparse/gpu/conv_kernel.cu rulebook gather-GEMM-scatter,
+python/paddle/sparse/nn/layer/conv.py)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+from paddle_tpu.sparse.conv import conv3d, subm_conv3d
+
+
+def _random_sparse(rng, B=2, D=6, H=6, W=6, C=3, nnz=20):
+    sites = set()
+    while len(sites) < nnz:
+        sites.add((rng.integers(B), rng.integers(D), rng.integers(H),
+                   rng.integers(W)))
+    coords = np.asarray(sorted(sites), np.int64)      # [nnz, 4]
+    vals = rng.standard_normal((len(coords), C)).astype(np.float32)
+    x = sparse.SparseCooTensor(coords.T, vals, [B, D, H, W, C])
+    dense = np.zeros((B, D, H, W, C), np.float32)
+    dense[tuple(coords.T)] = vals
+    return x, dense
+
+
+def _dense_conv(dense, w, b, stride, padding):
+    out = jax.lax.conv_general_dilated(
+        jnp.asarray(dense), jnp.asarray(w), (stride,) * 3,
+        [(padding, padding)] * 3,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+    if b is not None:
+        out = out + b
+    return np.asarray(out)
+
+
+def test_conv3d_matches_dense_everywhere_active():
+    rng = np.random.default_rng(0)
+    x, dense = _random_sparse(rng, C=3, nnz=25)
+    w = rng.standard_normal((3, 3, 3, 3, 5)).astype(np.float32) * 0.3
+    b = rng.standard_normal(5).astype(np.float32)
+
+    y = conv3d(x, w, b, stride=1, padding=1)
+    ref = _dense_conv(dense, w, b, 1, 1)
+    got = np.asarray(y.to_dense().numpy())
+    # active output sites match the dense conv; inactive sites are
+    # zero+bias in dense but absent in sparse — compare on active set
+    oc = np.asarray(y.indices_).T
+    for bnum, d, h, wd in oc:
+        np.testing.assert_allclose(got[bnum, d, h, wd],
+                                   ref[bnum, d, h, wd],
+                                   rtol=1e-4, atol=1e-4)
+    assert y.shape == [2, 6, 6, 6, 5]
+
+
+def test_conv3d_stride2_shapes_and_values():
+    rng = np.random.default_rng(1)
+    x, dense = _random_sparse(rng, D=8, H=8, W=8, C=2, nnz=15)
+    w = rng.standard_normal((2, 2, 2, 2, 4)).astype(np.float32) * 0.3
+    y = conv3d(x, w, None, stride=2, padding=0)
+    ref = _dense_conv(dense, w, None, 2, 0)
+    assert y.shape == [2, 4, 4, 4, 4]
+    oc = np.asarray(y.indices_).T
+    got = np.asarray(y.to_dense().numpy())
+    for bnum, d, h, wd in oc:
+        np.testing.assert_allclose(got[bnum, d, h, wd],
+                                   ref[bnum, d, h, wd],
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_subm_conv_preserves_site_set():
+    rng = np.random.default_rng(2)
+    x, dense = _random_sparse(rng, C=4, nnz=18)
+    w = rng.standard_normal((3, 3, 3, 4, 4)).astype(np.float32) * 0.3
+    y = subm_conv3d(x, w)
+    # output sites == input sites (submanifold contract)
+    np.testing.assert_array_equal(np.asarray(y.indices_),
+                                  np.asarray(x.indices_))
+    # each active site's value equals dense conv restricted to active
+    # inputs (which is what dense conv computes at that site anyway)
+    ref = _dense_conv(dense, w, None, 1, 1)
+    oc = np.asarray(y.indices_).T
+    got = np.asarray(y.to_dense().numpy())
+    for bnum, d, h, wd in oc:
+        np.testing.assert_allclose(got[bnum, d, h, wd],
+                                   ref[bnum, d, h, wd],
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_subm_conv_rejects_stride():
+    rng = np.random.default_rng(3)
+    x, _ = _random_sparse(rng)
+    w = np.zeros((3, 3, 3, 3, 3), np.float32)
+    import pytest
+    with pytest.raises(ValueError, match="stride 1"):
+        subm_conv3d(x, w, stride=2)
+
+
+def test_layers_batchnorm_pool_pipeline():
+    rng = np.random.default_rng(4)
+    x, _ = _random_sparse(rng, C=3, nnz=22)
+    conv = sparse.nn.SubmConv3D(3, 8, 3)
+    bn = sparse.nn.BatchNorm(8)
+    pool = sparse.nn.MaxPool3D(2)
+    y = pool(bn(conv(x)))
+    assert y.shape[0] == 2 and y.shape[1:4] == [3, 3, 3]
+    v = np.asarray(y.values_)
+    assert np.isfinite(v).all()
+    # bn normalized: per-channel stats of the conv output near 0/1
+    z = bn(conv(x))
+    zv = np.asarray(z.values_, np.float64)
+    assert abs(zv.mean(axis=0)).max() < 1e-4
+    # eval mode uses running stats
+    bn.eval()
+    z2 = bn(conv(x))
+    assert np.isfinite(np.asarray(z2.values_)).all()
+
+
+def test_overlapping_maxpool_covers_all_windows():
+    # kernel 3 stride 2: a site belongs to SEVERAL windows; every one
+    # must see it (review r3 finding: single-window assignment bug)
+    coords = np.array([[0, 2, 2, 2]], np.int64).T
+    vals = np.array([[5.0]], np.float32)
+    x = sparse.SparseCooTensor(coords, vals, [1, 6, 6, 6, 1])
+    y = sparse.nn.MaxPool3D(3, stride=2)(x)
+    oc = {tuple(c) for c in np.asarray(y.indices_).T}
+    # windows starting at 0 and 2 in each dim cover position 2
+    assert oc == {(0, a, b, c) for a in (0, 1) for b in (0, 1)
+                  for c in (0, 1)}
+    assert np.allclose(np.asarray(y.values_), 5.0)
+
+
+def test_layers_trainable_and_seeded():
+    import paddle_tpu as paddle
+    paddle.seed(11)
+    c1 = sparse.nn.SubmConv3D(3, 4, 3)
+    c2 = sparse.nn.SubmConv3D(3, 4, 3)
+    # stacked same-config layers must differ (symmetry breaking)
+    assert not np.allclose(np.asarray(c1.weight._data),
+                           np.asarray(c2.weight._data))
+    paddle.seed(11)
+    c3 = sparse.nn.SubmConv3D(3, 4, 3)
+    np.testing.assert_array_equal(np.asarray(c1.weight._data),
+                                  np.asarray(c3.weight._data))
+    bn = sparse.nn.BatchNorm(4)
+    assert len(bn.parameters()) == 2
+    assert not bn.parameters()[0].stop_gradient
+    import pytest
+    with pytest.raises(ValueError, match="stride 1"):
+        sparse.nn.SubmConv3D(3, 4, 3, stride=2)
