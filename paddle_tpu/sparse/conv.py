@@ -9,9 +9,11 @@ TPU formulation: the rulebook (per-kernel-offset lists of (input_site,
 output_site) pairs) is built HOST-side from the concrete COO indices —
 eager sparse tensors carry concrete coordinates, exactly like the
 reference's rulebook build on device — and the arithmetic runs on
-device as one gather + batched matmul + scatter-add per kernel offset
-(K³ MXU matmuls of [pairs_k, Cin] x [Cin, Cout]; no dense voxel grid is
-ever materialized).
+device as one gather + matmul + scatter-add per kernel offset (K³ MXU
+matmuls of [pairs_k, Cin] x [Cin, Cout]; no dense voxel grid is ever
+materialized).  The device math is pure in (values, weight, bias) with
+the index arrays as constants, so layer calls record ONE tape GradNode
+via ``jax.vjp`` and the whole conv→bn→pool pipeline trains.
 
 SubmConv3D keeps the output site set equal to the input's (submanifold
 semantics — the standard choice in point-cloud backbones); Conv3D
@@ -21,6 +23,7 @@ kernel offsets, with stride).
 from __future__ import annotations
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from .coo import SparseCooTensor
@@ -29,8 +32,71 @@ __all__ = ["subm_conv3d", "conv3d", "SubmConv3D", "Conv3D",
            "BatchNorm", "MaxPool3D"]
 
 
+# ------------------------------------------------------------ tape glue
+def _taped(fn, tensors, *arrays):
+    """Run ``fn(*tensor_datas, *arrays)`` with autograd-tape recording:
+    grads flow back to ``tensors`` through ``jax.vjp`` (the rulebook
+    index arrays ride along as non-differentiable constants).  Returns a
+    framework Tensor."""
+    from ..autograd import tape
+    from ..framework.tensor import Tensor
+
+    datas = [t._data for t in tensors]
+    if not (tape.is_grad_enabled()
+            and any(not t.stop_gradient for t in tensors)):
+        return Tensor(fn(*datas, *arrays))
+    out, vjp = jax.vjp(lambda *ds: fn(*ds, *arrays), *datas)
+    out_t = Tensor(out, stop_gradient=False)
+
+    def vjp_fn(cots):
+        return tuple(vjp(cots[0]))
+
+    out_t._grad_node = tape.GradNode(
+        "sparse_op", vjp_fn, tensors,
+        [jax.ShapeDtypeStruct(out.shape, out.dtype)])
+    out_t._out_index = 0
+    return out_t
+
+
+def _as_value_tensor(x: SparseCooTensor):
+    """The taped value carrier for a sparse tensor (leaf inputs get a
+    stop-gradient wrapper; outputs of sparse layers carry their taped
+    Tensor in ``_values_t`` so the chain stays connected)."""
+    from ..framework.tensor import Tensor
+
+    vt = getattr(x, "_values_t", None)
+    if vt is not None:
+        return vt
+    return Tensor(x.values_, stop_gradient=True)
+
+
+def _with_values(coords_t, values_t, shape):
+    out = SparseCooTensor(coords_t, values_t._data, shape, coalesced=True)
+    out._values_t = values_t
+    return out
+
+
+# ------------------------------------------------------------- planning
 def _triple(v):
     return (v, v, v) if isinstance(v, int) else tuple(int(x) for x in v)
+
+
+def _ensure_coalesced(x: SparseCooTensor):
+    # duplicate coordinates would collapse onto one rulebook output row;
+    # sum duplicates first (reference rulebook assumes unique sites).
+    # Concrete host-side merge — NOT SparseCooTensor.coalesce(), whose
+    # jit-safe static-nnz padding would inject a phantom site at the
+    # origin.
+    if getattr(x, "_coalesced", False):
+        return x
+    coords = np.asarray(x.indices_).T
+    uniq, inv = np.unique(coords, axis=0, return_inverse=True)
+    if len(uniq) == len(coords):
+        return x
+    vals = jnp.zeros((len(uniq),) + x.values_.shape[1:],
+                     x.values_.dtype).at[jnp.asarray(inv)].add(x.values_)
+    return SparseCooTensor(jnp.asarray(uniq.T), vals, x.shape,
+                           coalesced=True)
 
 
 def _host_coords(x: SparseCooTensor):
@@ -38,89 +104,35 @@ def _host_coords(x: SparseCooTensor):
     return np.asarray(x.indices_).T
 
 
-def _rulebook(in_coords, out_coords, kernel, stride, padding, dilation):
-    """Per-offset (in_idx, out_idx) pair lists.
+def _coords_array(seen):
+    """Insertion-ordered site dict -> [n, 4] int64 array."""
+    out = np.asarray(list(seen), np.int64)
+    return out.reshape(-1, 4) if out.size else out.reshape(0, 4)
 
-    out = (in + pad - off*dil) / stride for each kernel offset; a pair
-    exists when the shifted input site lands exactly on an output site.
-    """
+
+def _plan_subm(coords, kernel, dilation):
+    """Rulebook with output sites == input sites ('same' padding)."""
     kd, kh, kw = kernel
-    sd, sh, sw = stride
-    pd, ph, pw = padding
     dd, dh, dw = dilation
-    out_lut = {tuple(c): i for i, c in enumerate(map(tuple, out_coords))}
+    pd, ph, pw = ((kd - 1) // 2 * dd, (kh - 1) // 2 * dh,
+                  (kw - 1) // 2 * dw)
+    lut = {tuple(c): i for i, c in enumerate(map(tuple, coords))}
     book = []
     for od in range(kd):
         for oh in range(kh):
             for ow in range(kw):
                 pairs = []
-                for i, (b, d, h, w) in enumerate(in_coords):
-                    zd = d + pd - od * dd
-                    zh = h + ph - oh * dh
-                    zw = w + pw - ow * dw
-                    if zd % sd or zh % sh or zw % sw:
-                        continue
-                    j = out_lut.get((b, zd // sd, zh // sh, zw // sw))
+                for i, (b, d, h, w) in enumerate(coords):
+                    j = lut.get((b, d + pd - od * dd, h + ph - oh * dh,
+                                 w + pw - ow * dw))
                     if j is not None:
                         pairs.append((i, j))
                 book.append(np.asarray(pairs, np.int64).reshape(-1, 2))
     return book
 
 
-def _apply_rulebook(x, book, weight, bias, out_coords, out_spatial):
-    w = jnp.asarray(weight)          # [kd, kh, kw, Cin, Cout]
-    cout = w.shape[-1]
-    n_out = len(out_coords)
-    out = jnp.zeros((n_out, cout), x.values_.dtype)
-    wk = w.reshape(-1, w.shape[-2], cout)
-    for k, pairs in enumerate(book):
-        if len(pairs) == 0:
-            continue
-        gathered = x.values_[jnp.asarray(pairs[:, 0])]       # [p, Cin]
-        contrib = gathered @ wk[k].astype(gathered.dtype)    # MXU matmul
-        out = out.at[jnp.asarray(pairs[:, 1])].add(contrib)
-    if bias is not None:
-        out = out + jnp.asarray(bias).astype(out.dtype)
-    shape = [x.shape[0], *out_spatial, cout]
-    return SparseCooTensor(jnp.asarray(out_coords.T), out, shape,
-                           coalesced=True)
-
-
-def subm_conv3d(x: SparseCooTensor, weight, bias=None, stride=1,
-                padding=0, dilation=1, key=None):
-    """Submanifold sparse conv: output sites == input sites (reference
-    SubmConv3d; stride must be 1 — same contract as the reference)."""
-    stride = _triple(stride)
-    if stride != (1, 1, 1):
-        raise ValueError("subm_conv3d requires stride 1 "
-                         "(submanifold semantics); use conv3d")
-    kernel = jnp.asarray(weight).shape[:3]
-    coords = _host_coords(x)
-    pad = tuple((k - 1) // 2 * d for k, d in
-                zip(kernel, _triple(dilation)))
-    if padding != 0 and _triple(padding) != pad:
-        raise ValueError(f"subm_conv3d implies 'same' padding {pad}")
-    book = _rulebook(coords, coords, kernel, (1, 1, 1), pad,
-                     _triple(dilation))
-    return _apply_rulebook(x, book, weight, bias, coords, x.shape[1:4])
-
-
-def conv3d(x: SparseCooTensor, weight, bias=None, stride=1, padding=0,
-           dilation=1, key=None):
-    """Standard sparse conv: the output site set is every voxel any
-    kernel tap reaches (reference Conv3d)."""
-    stride = _triple(stride)
-    padding = _triple(padding)
-    dilation = _triple(dilation)
-    kernel = tuple(jnp.asarray(weight).shape[:3])
-    coords = _host_coords(x)
-    spatial = x.shape[1:4]
-    out_spatial = tuple(
-        (spatial[i] + 2 * padding[i]
-         - dilation[i] * (kernel[i] - 1) - 1) // stride[i] + 1
-        for i in range(3))
-
-    # one pass: enumerate output sites AND the per-offset rulebook
+def _plan_conv(coords, kernel, stride, padding, dilation, out_spatial):
+    """One pass: output sites AND the per-offset rulebook."""
     seen = {}
     book = [[] for _ in range(kernel[0] * kernel[1] * kernel[2])]
     for i, (b, d, h, w) in enumerate(coords):
@@ -143,13 +155,79 @@ def conv3d(x: SparseCooTensor, weight, bias=None, stride=1, padding=0,
                                                 len(seen))
                             book[k].append((i, j))
                     k += 1
-    out_coords = np.asarray(sorted(seen, key=seen.get), np.int64)
-    if out_coords.size == 0:
-        out_coords = out_coords.reshape(0, 4)
     book = [np.asarray(p, np.int64).reshape(-1, 2) for p in book]
-    return _apply_rulebook(x, book, weight, bias, out_coords, out_spatial)
+    return book, _coords_array(seen)
 
 
+def _conv_fn(book, n_out):
+    """Pure device math: (values [nnz, Cin], w [kd,kh,kw,Cin,Cout],
+    bias?) -> [n_out, Cout].  Differentiable in all three."""
+    def fn(values, w, b=None):
+        cout = w.shape[-1]
+        wk = w.reshape(-1, w.shape[-2], cout)
+        out = jnp.zeros((n_out, cout), values.dtype)
+        for k, pairs in enumerate(book):
+            if len(pairs) == 0:
+                continue
+            gathered = values[jnp.asarray(pairs[:, 0])]
+            contrib = gathered @ wk[k].astype(gathered.dtype)
+            out = out.at[jnp.asarray(pairs[:, 1])].add(contrib)
+        if b is not None:
+            out = out + b.astype(out.dtype)
+        return out
+    return fn
+
+
+# ----------------------------------------------------------- functional
+def subm_conv3d(x: SparseCooTensor, weight, bias=None, stride=1,
+                padding=0, dilation=1):
+    """Submanifold sparse conv: output sites == input sites (reference
+    SubmConv3d; stride must be 1 — same contract as the reference)."""
+    if _triple(stride) != (1, 1, 1):
+        raise ValueError("subm_conv3d requires stride 1 "
+                         "(submanifold semantics); use conv3d")
+    x = _ensure_coalesced(x)
+    kernel = tuple(np.shape(weight)[:3])
+    dilation = _triple(dilation)
+    pad = tuple((k - 1) // 2 * d for k, d in zip(kernel, dilation))
+    if padding != 0 and _triple(padding) != pad:
+        raise ValueError(f"subm_conv3d implies 'same' padding {pad}")
+    coords = _host_coords(x)
+    book = _plan_subm(coords, kernel, dilation)
+    fn = _conv_fn(book, len(coords))
+    out = fn(jnp.asarray(x.values_), jnp.asarray(weight),
+             None if bias is None else jnp.asarray(bias))
+    shape = [x.shape[0], *x.shape[1:4], int(np.shape(weight)[-1])]
+    return SparseCooTensor(jnp.asarray(coords.T), out, shape,
+                           coalesced=True)
+
+
+def conv3d(x: SparseCooTensor, weight, bias=None, stride=1, padding=0,
+           dilation=1):
+    """Standard sparse conv: the output site set is every voxel any
+    kernel tap reaches (reference Conv3d)."""
+    x = _ensure_coalesced(x)
+    stride = _triple(stride)
+    padding = _triple(padding)
+    dilation = _triple(dilation)
+    kernel = tuple(np.shape(weight)[:3])
+    coords = _host_coords(x)
+    spatial = x.shape[1:4]
+    out_spatial = tuple(
+        (spatial[i] + 2 * padding[i]
+         - dilation[i] * (kernel[i] - 1) - 1) // stride[i] + 1
+        for i in range(3))
+    book, out_coords = _plan_conv(coords, kernel, stride, padding,
+                                  dilation, out_spatial)
+    fn = _conv_fn(book, len(out_coords))
+    out = fn(jnp.asarray(x.values_), jnp.asarray(weight),
+             None if bias is None else jnp.asarray(bias))
+    shape = [x.shape[0], *out_spatial, int(np.shape(weight)[-1])]
+    return SparseCooTensor(jnp.asarray(out_coords.T), out, shape,
+                           coalesced=True)
+
+
+# --------------------------------------------------------------- layers
 class _ConvBase:
     def __init__(self, in_channels, out_channels, kernel_size, stride=1,
                  padding=0, dilation=1, groups=1, padding_mode="zeros",
@@ -158,6 +236,11 @@ class _ConvBase:
 
         if groups != 1:
             raise NotImplementedError("sparse conv groups != 1")
+        if data_format != "NDHWC":
+            raise ValueError("sparse conv supports NDHWC only "
+                             "(reference contract)")
+        if padding_mode != "zeros":
+            raise NotImplementedError("sparse conv padding_mode != zeros")
         k = _triple(kernel_size)
         fan_in = in_channels * k[0] * k[1] * k[2]
         # repo initializer infra: keys come from the global generator so
@@ -172,17 +255,27 @@ class _ConvBase:
         if bias_attr is not False:
             self.bias = Tensor(jnp.zeros((out_channels,)),
                                stop_gradient=False)
+        self._kernel = k
         self._stride = stride
         self._padding = padding
-        self._dilation = dilation
+        self._dilation = _triple(dilation)
 
     def parameters(self):
         return [self.weight] + ([self.bias] if self.bias is not None
                                 else [])
 
-    def _wb(self):
-        b = None if self.bias is None else self.bias._data
-        return self.weight._data, b
+    def _run(self, x, book, out_coords, out_spatial):
+        vin = _as_value_tensor(x)
+        tensors = [vin, self.weight]
+        fn = _conv_fn(book, len(out_coords))
+        if self.bias is not None:
+            tensors.append(self.bias)
+            vout = _taped(lambda v, w, b: fn(v, w, b), tensors)
+        else:
+            vout = _taped(lambda v, w: fn(v, w), tensors)
+        cout = self.weight._data.shape[-1]
+        shape = [x.shape[0], *out_spatial, int(cout)]
+        return _with_values(jnp.asarray(out_coords.T), vout, shape)
 
 
 class SubmConv3D(_ConvBase):
@@ -190,15 +283,15 @@ class SubmConv3D(_ConvBase):
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
-        # the constructor must not accept configs the math ignores
         if _triple(self._stride) != (1, 1, 1):
             raise ValueError("SubmConv3D requires stride 1 "
                              "(submanifold semantics); use Conv3D")
 
     def __call__(self, x):
-        w, b = self._wb()
-        return subm_conv3d(x, w, b, stride=1, padding=self._padding,
-                           dilation=self._dilation)
+        x = _ensure_coalesced(x)
+        coords = _host_coords(x)
+        book = _plan_subm(coords, self._kernel, self._dilation)
+        return self._run(x, book, coords, x.shape[1:4])
 
     forward = __call__
 
@@ -207,16 +300,27 @@ class Conv3D(_ConvBase):
     """reference python/paddle/sparse/nn/layer/conv.py Conv3D."""
 
     def __call__(self, x):
-        w, b = self._wb()
-        return conv3d(x, w, b, stride=self._stride,
-                      padding=self._padding, dilation=self._dilation)
+        x = _ensure_coalesced(x)
+        stride = _triple(self._stride)
+        padding = _triple(self._padding)
+        coords = _host_coords(x)
+        spatial = x.shape[1:4]
+        out_spatial = tuple(
+            (spatial[i] + 2 * padding[i]
+             - self._dilation[i] * (self._kernel[i] - 1) - 1)
+            // stride[i] + 1 for i in range(3))
+        book, out_coords = _plan_conv(coords, self._kernel, stride,
+                                      padding, self._dilation,
+                                      out_spatial)
+        return self._run(x, book, out_coords, out_spatial)
 
     forward = __call__
 
 
 class BatchNorm:
     """Sparse batch norm: normalizes over the nnz values per channel
-    (reference python/paddle/sparse/nn/layer/norm.py BatchNorm)."""
+    (reference python/paddle/sparse/nn/layer/norm.py BatchNorm).
+    Trainable affine; grads flow through the batch statistics."""
 
     def __init__(self, num_features, momentum=0.9, epsilon=1e-5):
         from ..framework.tensor import Tensor
@@ -224,7 +328,6 @@ class BatchNorm:
         self.num_features = num_features
         self._momentum = momentum
         self._eps = epsilon
-        # trainable affine (matches the dense BatchNorm layers)
         self.weight = Tensor(jnp.ones((num_features,)),
                              stop_gradient=False)
         self.bias = Tensor(jnp.zeros((num_features,)),
@@ -237,20 +340,37 @@ class BatchNorm:
         return [self.weight, self.bias]
 
     def __call__(self, x: SparseCooTensor):
-        v = x.values_.astype(jnp.float32)
-        if self.training:
-            m = v.mean(axis=0)
-            var = jnp.maximum(v.var(axis=0), 0.0)
-            self._mean = self._momentum * self._mean + \
-                (1 - self._momentum) * m
-            self._var = self._momentum * self._var + \
-                (1 - self._momentum) * var
+        vin = _as_value_tensor(x)
+        if x.nnz == 0:
+            # no values: stats are undefined; pass through untouched
+            # (and never poison the running estimates with NaN)
+            return x
+        training = self.training
+        eps = self._eps
+        if training:
+            mean, var = None, None
         else:
-            m, var = self._mean, self._var
-        out = (v - m) * jnp.reciprocal(jnp.sqrt(var + self._eps))
-        out = out * self.weight._data + self.bias._data
-        return SparseCooTensor(x.indices_, out.astype(x.values_.dtype),
-                               x.shape, coalesced=x._coalesced)
+            mean, var = self._mean, self._var
+
+        def fn(v, w, b):
+            vf = v.astype(jnp.float32)
+            if training:
+                m = vf.mean(axis=0)
+                s2 = jnp.maximum(vf.var(axis=0), 0.0)
+            else:
+                m, s2 = mean, var
+            out = (vf - m) * jnp.reciprocal(jnp.sqrt(s2 + eps))
+            return (out * w + b).astype(v.dtype)
+
+        vout = _taped(fn, [vin, self.weight, self.bias])
+        if training:
+            vf = np.asarray(vin._data, np.float32)
+            self._mean = self._momentum * self._mean + \
+                (1 - self._momentum) * jnp.asarray(vf.mean(axis=0))
+            self._var = self._momentum * self._var + \
+                (1 - self._momentum) * jnp.asarray(
+                    np.maximum(vf.var(axis=0), 0.0))
+        return _with_values(x.indices_, vout, x.shape)
 
     def eval(self):
         self.training = False
@@ -272,6 +392,7 @@ class MaxPool3D:
         self._padding = _triple(padding)
 
     def __call__(self, x: SparseCooTensor):
+        x = _ensure_coalesced(x)
         kernel, stride, padding = self._kernel, self._stride, self._padding
         coords = _host_coords(x)
         spatial = x.shape[1:4]
@@ -295,17 +416,21 @@ class MaxPool3D:
                     for zw in windows(w, 2):
                         j = seen.setdefault((b, zd, zh, zw), len(seen))
                         pairs.append((i, j))
-        out_coords = np.asarray(sorted(seen, key=seen.get), np.int64)
-        if out_coords.size == 0:
-            out_coords = out_coords.reshape(0, 4)
+        out_coords = _coords_array(seen)
         pairs = np.asarray(pairs, np.int64).reshape(-1, 2)
+        n_out = len(out_coords)
+
+        def fn(v):
+            c = v.shape[-1]
+            out = jnp.full((n_out, c), -jnp.inf, v.dtype)
+            if len(pairs):
+                out = out.at[jnp.asarray(pairs[:, 1])].max(
+                    v[jnp.asarray(pairs[:, 0])])
+            return out
+
+        vout = _taped(fn, [_as_value_tensor(x)])
         c = x.values_.shape[-1]
-        out = jnp.full((len(out_coords), c), -jnp.inf, x.values_.dtype)
-        if len(pairs):
-            out = out.at[jnp.asarray(pairs[:, 1])].max(
-                x.values_[jnp.asarray(pairs[:, 0])])
-        shape = [x.shape[0], *out_spatial, c]
-        return SparseCooTensor(jnp.asarray(out_coords.T), out, shape,
-                               coalesced=True)
+        shape = [x.shape[0], *out_spatial, int(c)]
+        return _with_values(jnp.asarray(out_coords.T), vout, shape)
 
     forward = __call__

@@ -28,7 +28,10 @@ class SparseCooTensor:
         return Tensor(self.indices_)
 
     def values(self):
-        return Tensor(self.values_)
+        # sparse-layer outputs carry their taped Tensor so a loss built
+        # from .values() backprops into the layer parameters
+        vt = getattr(self, "_values_t", None)
+        return vt if vt is not None else Tensor(self.values_)
 
     @property
     def nnz(self):
