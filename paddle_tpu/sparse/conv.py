@@ -70,8 +70,9 @@ def _as_value_tensor(x: SparseCooTensor):
     return Tensor(x.values_, stop_gradient=True)
 
 
-def _with_values(coords_t, values_t, shape):
-    out = SparseCooTensor(coords_t, values_t._data, shape, coalesced=True)
+def _with_values(coords_t, values_t, shape, coalesced=True):
+    out = SparseCooTensor(coords_t, values_t._data, shape,
+                          coalesced=coalesced)
     out._values_t = values_t
     return out
 
@@ -92,6 +93,7 @@ def _ensure_coalesced(x: SparseCooTensor):
     coords = np.asarray(x.indices_).T
     uniq, inv = np.unique(coords, axis=0, return_inverse=True)
     if len(uniq) == len(coords):
+        x._coalesced = True       # cache: the scan proved no duplicates
         return x
     vals = jnp.zeros((len(uniq),) + x.values_.shape[1:],
                      x.values_.dtype).at[jnp.asarray(inv)].add(x.values_)
@@ -129,6 +131,24 @@ def _plan_subm(coords, kernel, dilation):
                         pairs.append((i, j))
                 book.append(np.asarray(pairs, np.int64).reshape(-1, 2))
     return book
+
+
+def _conv_plan(x, kernel, stride, padding, dilation):
+    """Shared Conv3D planning: (book, out_coords, out_spatial) from a
+    coalesced sparse input — used by both the functional and layer
+    paths so the output-shape arithmetic lives once."""
+    stride = _triple(stride)
+    padding = _triple(padding)
+    dilation = _triple(dilation)
+    coords = _host_coords(x)
+    spatial = x.shape[1:4]
+    out_spatial = tuple(
+        (spatial[i] + 2 * padding[i]
+         - dilation[i] * (kernel[i] - 1) - 1) // stride[i] + 1
+        for i in range(3))
+    book, out_coords = _plan_conv(coords, kernel, stride, padding,
+                                  dilation, out_spatial)
+    return book, out_coords, out_spatial
 
 
 def _plan_conv(coords, kernel, stride, padding, dilation, out_spatial):
@@ -207,18 +227,9 @@ def conv3d(x: SparseCooTensor, weight, bias=None, stride=1, padding=0,
     """Standard sparse conv: the output site set is every voxel any
     kernel tap reaches (reference Conv3d)."""
     x = _ensure_coalesced(x)
-    stride = _triple(stride)
-    padding = _triple(padding)
-    dilation = _triple(dilation)
     kernel = tuple(np.shape(weight)[:3])
-    coords = _host_coords(x)
-    spatial = x.shape[1:4]
-    out_spatial = tuple(
-        (spatial[i] + 2 * padding[i]
-         - dilation[i] * (kernel[i] - 1) - 1) // stride[i] + 1
-        for i in range(3))
-    book, out_coords = _plan_conv(coords, kernel, stride, padding,
-                                  dilation, out_spatial)
+    book, out_coords, out_spatial = _conv_plan(x, kernel, stride,
+                                               padding, dilation)
     fn = _conv_fn(book, len(out_coords))
     out = fn(jnp.asarray(x.values_), jnp.asarray(weight),
              None if bias is None else jnp.asarray(bias))
@@ -286,6 +297,12 @@ class SubmConv3D(_ConvBase):
         if _triple(self._stride) != (1, 1, 1):
             raise ValueError("SubmConv3D requires stride 1 "
                              "(submanifold semantics); use Conv3D")
+        same = tuple((k - 1) // 2 * d for k, d in
+                     zip(self._kernel, self._dilation))
+        if self._padding != 0 and _triple(self._padding) != same:
+            raise ValueError(
+                f"SubmConv3D implies 'same' padding {same}; "
+                f"got {self._padding}")
 
     def __call__(self, x):
         x = _ensure_coalesced(x)
@@ -301,17 +318,8 @@ class Conv3D(_ConvBase):
 
     def __call__(self, x):
         x = _ensure_coalesced(x)
-        stride = _triple(self._stride)
-        padding = _triple(self._padding)
-        coords = _host_coords(x)
-        spatial = x.shape[1:4]
-        out_spatial = tuple(
-            (spatial[i] + 2 * padding[i]
-             - self._dilation[i] * (self._kernel[i] - 1) - 1)
-            // stride[i] + 1 for i in range(3))
-        book, out_coords = _plan_conv(coords, self._kernel, stride,
-                                      padding, self._dilation,
-                                      out_spatial)
+        book, out_coords, out_spatial = _conv_plan(
+            x, self._kernel, self._stride, self._padding, self._dilation)
         return self._run(x, book, out_coords, out_spatial)
 
     forward = __call__
@@ -364,13 +372,17 @@ class BatchNorm:
 
         vout = _taped(fn, [vin, self.weight, self.bias])
         if training:
-            vf = np.asarray(vin._data, np.float32)
+            # running-stat update stays on device (no host round-trip);
+            # the taped fn recomputes the same stats so their GRADIENT
+            # contribution flows — passing precomputed stats in would
+            # silently drop the dmean/dvar terms of the BN backward
+            vf = vin._data.astype(jnp.float32)
             self._mean = self._momentum * self._mean + \
-                (1 - self._momentum) * jnp.asarray(vf.mean(axis=0))
+                (1 - self._momentum) * vf.mean(axis=0)
             self._var = self._momentum * self._var + \
-                (1 - self._momentum) * jnp.asarray(
-                    np.maximum(vf.var(axis=0), 0.0))
-        return _with_values(x.indices_, vout, x.shape)
+                (1 - self._momentum) * jnp.maximum(vf.var(axis=0), 0.0)
+        return _with_values(x.indices_, vout, x.shape,
+                            coalesced=getattr(x, "_coalesced", False))
 
     def eval(self):
         self.training = False

@@ -209,3 +209,18 @@ def test_layers_trainable_and_seeded():
     import pytest
     with pytest.raises(ValueError, match="stride 1"):
         sparse.nn.SubmConv3D(3, 4, 3, stride=2)
+
+
+def test_bn_preserves_uncoalesced_flag_and_padding_validated():
+    import pytest
+    # BN passthrough must not falsely mark dup-coord outputs coalesced
+    coords = np.array([[0, 1, 1, 1], [0, 1, 1, 1]], np.int64).T
+    vals = np.array([[1.0, 0.0], [2.0, 0.0]], np.float32)
+    x = sparse.SparseCooTensor(coords, vals, [1, 4, 4, 4, 2])
+    bn = sparse.nn.BatchNorm(2)
+    w = np.zeros((1, 1, 1, 2, 1), np.float32)
+    w[0, 0, 0, :, 0] = 1.0
+    y = subm_conv3d(bn(x), w)          # conv must still merge the dups
+    assert y.nnz == 1
+    with pytest.raises(ValueError, match="'same' padding"):
+        sparse.nn.SubmConv3D(2, 2, 3, padding=2)
