@@ -1,0 +1,139 @@
+"""paddle.nn.quant — weight-only quantization for serving.
+
+Reference: python/paddle/nn/quant/quantized_linear.py (weight_quantize,
+weight_dequantize, weight_only_linear, llm_int8_linear,
+apply_per_channel_scale backed by CUTLASS mixed-dtype GEMMs,
+paddle/phi/kernels/gpu/weight_only_linear_kernel.cu).
+
+TPU formulation: weights store as int8 (int4 as int8 values in [-7, 7]
+— the MXU has no nibble path, so the win is HBM: int8 halves weight
+traffic and XLA fuses the dequant (cast * scale) into the matmul
+prologue).  Per-channel (group_size=-1) or grouped (64/128) symmetric
+scales, matching the reference's quantization math; there is no `arch`
+parameter — there is one target.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.registry import op
+
+__all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
+           "llm_int8_linear", "apply_per_channel_scale"]
+
+_BOUNDS = {"weight_only_int8": 127.0, "weight_only_int4": 7.0,
+           "llm.int8": 127.0}
+
+
+def _check(algo, group_size):
+    if algo not in _BOUNDS:
+        raise ValueError(
+            f"algo must be one of {sorted(_BOUNDS)}, got {algo!r}")
+    if group_size not in (-1, 64, 128):
+        raise ValueError(f"group_size must be -1, 64 or 128, "
+                         f"got {group_size}")
+
+
+@op
+def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
+    """[K, N] float weight -> (int8 quantized [K, N], scales).
+
+    Per-channel: scales [N]; grouped: scales [K/group, N].  Symmetric
+    (no zero point), like the reference kernels.
+    """
+    _check(algo, group_size)
+    bound = _BOUNDS[algo]
+    xf = x.astype(jnp.float32)
+    k, n = xf.shape
+    if group_size == -1:
+        absmax = jnp.max(jnp.abs(xf), axis=0)              # [N]
+        scale = jnp.maximum(absmax / bound, 1e-8)
+        q = jnp.clip(jnp.round(xf / scale), -bound, bound)
+    else:
+        if k % group_size:
+            raise ValueError(f"K={k} not divisible by group {group_size}")
+        g = xf.reshape(k // group_size, group_size, n)
+        absmax = jnp.max(jnp.abs(g), axis=1)               # [K/g, N]
+        scale = jnp.maximum(absmax / bound, 1e-8)
+        q = jnp.clip(jnp.round(g / scale[:, None, :]), -bound, bound)
+        q = q.reshape(k, n)
+    return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+
+@op
+def weight_dequantize(x, scale, algo="weight_only_int8", group_size=-1):
+    """Inverse of :func:`weight_quantize` (reference weight_dequantize)."""
+    _check(algo, group_size)
+    xf = x.astype(jnp.float32)
+    k, n = xf.shape
+    if group_size == -1:
+        return xf * scale
+    if k % group_size:
+        raise ValueError(f"K={k} not divisible by group {group_size}")
+    return (xf.reshape(k // group_size, group_size, n)
+            * scale[:, None, :]).reshape(k, n)
+
+
+@op
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1):
+    """x [.., K] @ dequant(weight [K, N]) + bias.
+
+    The dequant is a cast+scale XLA fuses into the matmul read — the
+    stored int8 weight is what halves HBM traffic on the decode path
+    (reference weight_only_linear_kernel.cu's mixed-dtype GEMM role).
+    """
+    if weight_dtype not in ("int8", "int4"):
+        raise ValueError(f"weight_dtype must be int8|int4, "
+                         f"got {weight_dtype!r}")
+    if weight_scale is None:
+        raise ValueError("weight_only_linear requires weight_scale")
+    algo = "weight_only_int8" if weight_dtype == "int8" \
+        else "weight_only_int4"
+    w = weight_dequantize.__op_body__(weight, weight_scale, algo,
+                                      group_size).astype(x.dtype)
+    out = x @ w
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@op
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold=6.0):
+    """LLM.int8: activation columns whose absmax exceeds `threshold` run
+    in the activation dtype against the DEQUANTIZED weight rows; the
+    rest run int8 (reference llm_int8_linear / llm_int8_matmul_kernel).
+    On TPU both branches lower to one masked matmul pair — the fidelity
+    point is the outlier split, which this reproduces exactly.
+    """
+    if weight_scale is None:
+        raise ValueError("llm_int8_linear requires weight_scale")
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf.reshape(-1, xf.shape[-1])), axis=0)
+    outlier = absmax > threshold                           # [K]
+    w = weight.astype(jnp.float32) * weight_scale          # [K, N]
+    # inlier path: quantize activations to int8 per-tensor, int8 x int8
+    x_in = jnp.where(outlier, 0.0, xf)
+    a_scale = jnp.maximum(jnp.max(jnp.abs(x_in)) / 127.0, 1e-8)
+    xq = jnp.clip(jnp.round(x_in / a_scale), -127, 127)
+    inlier_out = (xq @ jnp.where(outlier[:, None], 0.0,
+                                 weight.astype(jnp.float32))) \
+        * a_scale * weight_scale
+    # outlier path: full precision on the few outlier columns
+    x_out = jnp.where(outlier, xf, 0.0)
+    outlier_out = x_out @ jnp.where(outlier[:, None], w, 0.0)
+    out = (inlier_out + outlier_out).astype(x.dtype)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@op
+def apply_per_channel_scale(x, scales):
+    """Pre-quant activation smoothing: x / scales per channel (reference
+    apply_per_channel_scale_kernel — activations divide by the smoothing
+    scale that was folded into the weights)."""
+    return (x.astype(jnp.float32) / scales.astype(jnp.float32)) \
+        .astype(x.dtype)

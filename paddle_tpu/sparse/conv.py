@@ -210,7 +210,7 @@ def subm_conv3d(x: SparseCooTensor, weight, bias=None, stride=1,
     kernel = tuple(np.shape(weight)[:3])
     dilation = _triple(dilation)
     pad = tuple((k - 1) // 2 * d for k, d in zip(kernel, dilation))
-    if padding != 0 and _triple(padding) != pad:
+    if _triple(padding) not in ((0, 0, 0), pad):
         raise ValueError(f"subm_conv3d implies 'same' padding {pad}")
     coords = _host_coords(x)
     book = _plan_subm(coords, kernel, dilation)
@@ -299,7 +299,7 @@ class SubmConv3D(_ConvBase):
                              "(submanifold semantics); use Conv3D")
         same = tuple((k - 1) // 2 * d for k, d in
                      zip(self._kernel, self._dilation))
-        if self._padding != 0 and _triple(self._padding) != same:
+        if _triple(self._padding) not in ((0, 0, 0), same):
             raise ValueError(
                 f"SubmConv3D implies 'same' padding {same}; "
                 f"got {self._padding}")

@@ -1,0 +1,92 @@
+"""Weight-only quantization (reference:
+python/paddle/nn/quant/quantized_linear.py + the CUTLASS mixed-dtype
+GEMM kernels paddle/phi/kernels/gpu/weight_only_linear_kernel.cu)."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn.quant import (apply_per_channel_scale, llm_int8_linear,
+                                 weight_dequantize, weight_only_linear,
+                                 weight_quantize)
+
+rng = np.random.default_rng(0)
+
+
+def _w(k=64, n=32):
+    return paddle.to_tensor(rng.standard_normal((k, n)).astype(np.float32))
+
+
+def test_int8_roundtrip_error_bounded():
+    w = _w()
+    q, scale = weight_quantize(w, algo="weight_only_int8")
+    assert str(q.numpy().dtype) == "int8"
+    assert scale.shape == [32]
+    back = weight_dequantize(q, scale)
+    err = np.abs(back.numpy() - w.numpy()).max()
+    # per-channel symmetric int8: error <= scale/2 per channel
+    assert err <= float(scale.numpy().max()) * 0.5 + 1e-6
+
+
+def test_int4_range_and_groupwise():
+    w = _w(k=128)
+    q, scale = weight_quantize(w, algo="weight_only_int4", group_size=64)
+    qn = q.numpy()
+    assert qn.min() >= -7 and qn.max() <= 7
+    assert scale.shape == [2, 32]
+    back = weight_dequantize(q, scale, algo="weight_only_int4",
+                             group_size=64)
+    # int4 is coarse: relative error bounded by half an lsb per group
+    assert np.abs(back.numpy() - w.numpy()).max() <= \
+        float(scale.numpy().max()) * 0.5 + 1e-6
+
+
+def test_weight_only_linear_close_to_dense():
+    w = _w()
+    x = paddle.to_tensor(rng.standard_normal((4, 64)).astype(np.float32))
+    b = paddle.to_tensor(rng.standard_normal((32,)).astype(np.float32))
+    q, scale = weight_quantize(w)
+    out = weight_only_linear(x, q, b, scale)
+    ref = x.numpy() @ w.numpy() + b.numpy()
+    # int8 per-channel keeps matmul error small relative to magnitudes
+    denom = np.abs(ref).mean() + 1e-6
+    assert np.abs(out.numpy() - ref).mean() / denom < 0.02
+
+
+def test_weight_only_linear_group_and_int4():
+    w = _w(k=128)
+    x = paddle.to_tensor(rng.standard_normal((2, 128)).astype(np.float32))
+    q, scale = weight_quantize(w, algo="weight_only_int4",
+                               group_size=128)
+    out = weight_only_linear(x, q, None, scale, weight_dtype="int4",
+                             group_size=128)
+    ref = x.numpy() @ w.numpy()
+    denom = np.abs(ref).mean() + 1e-6
+    assert np.abs(out.numpy() - ref).mean() / denom < 0.12
+
+
+def test_llm_int8_outlier_split():
+    w = _w()
+    q, scale = weight_quantize(w, algo="weight_only_int8")
+    x_np = rng.standard_normal((4, 64)).astype(np.float32)
+    x_np[:, 7] *= 50.0                       # one outlier channel
+    x = paddle.to_tensor(x_np)
+    out = llm_int8_linear(x, q, None, scale, threshold=6.0)
+    ref = x_np @ weight_dequantize(q, scale).numpy()
+    denom = np.abs(ref).mean() + 1e-6
+    # outlier channel in full precision keeps the error small even with
+    # a 50x activation spike
+    assert np.abs(out.numpy() - ref).mean() / denom < 0.05
+
+
+def test_apply_per_channel_scale_and_validation():
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    s = paddle.to_tensor(np.array([1.0, 2.0, 4.0, 8.0], np.float32))
+    y = apply_per_channel_scale(x, s)
+    np.testing.assert_allclose(y.numpy()[0], [1, 0.5, 0.25, 0.125])
+    with pytest.raises(ValueError, match="algo"):
+        weight_quantize(_w(), algo="int3")
+    with pytest.raises(ValueError, match="group_size"):
+        weight_quantize(_w(), group_size=32)
+    with pytest.raises(ValueError, match="weight_scale"):
+        weight_only_linear(x, x, None, None)
