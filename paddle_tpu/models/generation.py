@@ -30,7 +30,8 @@ import numpy as np
 from .llama import LlamaConfig, _rope_tables, _rotate_half
 from .llama_hybrid import _rms
 
-__all__ = ["GenerationConfig", "generate", "build_generate_fn"]
+__all__ = ["GenerationConfig", "generate", "build_generate_fn",
+           "quantize_state"]
 
 _FN_CACHE: dict = {}   # (config fields, prompt_len, gen fields) -> jitted fn
 _FN_CACHE_MAX = 16
@@ -55,6 +56,50 @@ class GenerationConfig:
 
 
 # ------------------------------------------------------------- weight view
+def _mm(h, w):
+    """Matmul against a raw weight or a weight-only-quantized
+    ``(int8 values, per-channel scale)`` pair (nn.quant formulation).
+
+    The quantized path issues a mixed-dtype dot (bf16 activations
+    against the int8 weight) with the per-output-channel scale applied
+    on the result.  Measured reality on the v5e (recorded in scratch
+    r3): the decode matmuls are not bandwidth-bound enough for int8
+    streaming to pay — XLA upconverts in-loop and the quantized decode
+    runs SLOWER than dense bf16 (a Pallas int8-tile kernel recovers
+    only ~11%).  weight_quant therefore buys model MEMORY (int8 halves
+    weight HBM; "int4" stores as int8 too — no nibble path — so it is
+    accuracy-lossier at the SAME footprint, kept for deploy-pipeline
+    parity) and reference parity (weight_only_linear_kernel.cu), not
+    throughput; bench honesty over marketing."""
+    if isinstance(w, tuple):
+        q, scale = w
+        out = jax.lax.dot_general(h, q, (((h.ndim - 1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        return (out * scale).astype(h.dtype)
+    return h @ w
+
+
+_QUANT_KEYS = ("self_attn.q_proj.weight", "self_attn.k_proj.weight",
+               "self_attn.v_proj.weight", "self_attn.o_proj.weight",
+               "mlp.gate_proj.weight", "mlp.up_proj.weight",
+               "mlp.down_proj.weight")
+
+
+def quantize_state(state, algo="weight_only_int8"):
+    """Replace every matmul weight in a generation state dict with its
+    (int8, scale) pair (embeddings stay dense: they are gathers, not
+    matmuls).  The reference analog is converting a deploy model through
+    weight_quantize before serving (python/paddle/nn/quant)."""
+    from ..nn.quant import weight_quantize
+
+    out = dict(state)
+    for name, arr in state.items():
+        if name.endswith(_QUANT_KEYS) or name == "lm_head.weight":
+            q, scale = weight_quantize.__op_body__(arr, algo)
+            out[name] = (q, scale)
+    return out
+
+
 def _layer_weights(state, i):
     p = f"llama.layers.{i}."
     return {
@@ -82,9 +127,9 @@ def _prefill_layer(w, x, cos, sin, mask, cfg: LlamaConfig):
     nh, kvh, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
                    cfg.head_dim)
     h = _rms(x, w["ln1"], cfg.rms_norm_eps)
-    q = (h @ w["q"]).reshape(b, s, nh, hd)
-    k = (h @ w["k"]).reshape(b, s, kvh, hd)
-    v = (h @ w["v"]).reshape(b, s, kvh, hd)
+    q = _mm(h, w["q"]).reshape(b, s, nh, hd)
+    k = _mm(h, w["k"]).reshape(b, s, kvh, hd)
+    v = _mm(h, w["v"]).reshape(b, s, kvh, hd)
     cos_c = cos[None, :, None, :].astype(q.dtype)
     sin_c = sin[None, :, None, :].astype(q.dtype)
     q = q * cos_c + _rotate_half(q) * sin_c
@@ -95,9 +140,10 @@ def _prefill_layer(w, x, cos, sin, mask, cfg: LlamaConfig):
     from ..ops.pallas.flash_attention import sdpa
     attn = sdpa(q, k, v, attn_mask=mask[:, None, None, :],
                 is_causal=True).reshape(b, s, nh * hd)
-    x = x + attn @ w["o"]
+    x = x + _mm(attn, w["o"])
     h = _rms(x, w["ln2"], cfg.rms_norm_eps)
-    return x + (jax.nn.silu(h @ w["gate"]) * (h @ w["up"])) @ w["down"], k, v
+    return (x + _mm(jax.nn.silu(_mm(h, w["gate"])) * _mm(h, w["up"]),
+                    w["down"]), k, v)
 
 
 # ------------------------------------------------------------ decode step
@@ -108,9 +154,9 @@ def _decode_layer(w, x, kcache, vcache, cos1, sin1, pos, cfg: LlamaConfig):
     nh, kvh, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
                    cfg.head_dim)
     h = _rms(x[:, None], w["ln1"], cfg.rms_norm_eps)[:, 0]
-    q = (h @ w["q"]).reshape(b, nh, hd)
-    k = (h @ w["k"]).reshape(b, kvh, hd)
-    v = (h @ w["v"]).reshape(b, kvh, hd)
+    q = _mm(h, w["q"]).reshape(b, nh, hd)
+    k = _mm(h, w["k"]).reshape(b, kvh, hd)
+    v = _mm(h, w["v"]).reshape(b, kvh, hd)
     cos_c = cos1[:, None, :].astype(q.dtype)
     sin_c = sin1[:, None, :].astype(q.dtype)
     q = q * cos_c + _rotate_half(q) * sin_c
@@ -127,9 +173,10 @@ def _decode_layer(w, x, kcache, vcache, cos1, sin1, pos, cfg: LlamaConfig):
     # transparently falls back to the einsum path off-TPU
     from ..ops.pallas.decode_attention import decode_attention
     attn = decode_attention(q, kcache, vcache, pos).reshape(b, nh * hd)
-    x = x + attn @ w["o"]
+    x = x + _mm(attn, w["o"])
     h = _rms(x[:, None], w["ln2"], cfg.rms_norm_eps)[:, 0]
-    return (x + (jax.nn.silu(h @ w["gate"]) * (h @ w["up"])) @ w["down"],
+    return (x + _mm(jax.nn.silu(_mm(h, w["gate"])) * _mm(h, w["up"]),
+                    w["down"]),
             kcache, vcache)
 
 
@@ -146,9 +193,9 @@ def _decode_layer_paged(w, x, kpool, vpool, table, cos1, sin1, pos,
                    cfg.head_dim)
     ps = kpool.shape[2]
     h = _rms(x[:, None], w["ln1"], cfg.rms_norm_eps)[:, 0]
-    q = (h @ w["q"]).reshape(b, nh, hd)
-    k = (h @ w["k"]).reshape(b, kvh, hd)
-    v = (h @ w["v"]).reshape(b, kvh, hd)
+    q = _mm(h, w["q"]).reshape(b, nh, hd)
+    k = _mm(h, w["k"]).reshape(b, kvh, hd)
+    v = _mm(h, w["v"]).reshape(b, kvh, hd)
     cos_c = cos1[:, None, :].astype(q.dtype)
     sin_c = sin1[:, None, :].astype(q.dtype)
     q = q * cos_c + _rotate_half(q) * sin_c
@@ -167,9 +214,10 @@ def _decode_layer_paged(w, x, kpool, vpool, table, cos1, sin1, pos,
         jax.default_backend() not in ("cpu",) or _INTERPRET) \
         else paged_attention_xla
     attn = fn(q, kpool, vpool, table, pos + 1).reshape(b, nh * hd)
-    x = x + attn @ w["o"]
+    x = x + _mm(attn, w["o"])
     h = _rms(x[:, None], w["ln2"], cfg.rms_norm_eps)[:, 0]
-    return (x + (jax.nn.silu(h @ w["gate"]) * (h @ w["up"])) @ w["down"],
+    return (x + _mm(jax.nn.silu(_mm(h, w["gate"])) * _mm(h, w["up"]),
+                    w["down"]),
             kpool, vpool)
 
 
@@ -243,7 +291,7 @@ def build_generate_fn_paged(config: LlamaConfig, gen: GenerationConfig,
 
         def logits_of(h):
             if head is not None:
-                return h @ head
+                return _mm(h, head)
             return h @ state["llama.embed_tokens.weight"].T
 
         last = jnp.take_along_axis(
@@ -332,7 +380,7 @@ def build_generate_fn(config: LlamaConfig, gen: GenerationConfig,
 
         def logits_of(h):
             if head is not None:
-                return h @ head
+                return _mm(h, head)
             return h @ state["llama.embed_tokens.weight"].T
 
         # last real prompt token's hidden state seeds decoding
@@ -381,7 +429,7 @@ def build_generate_fn(config: LlamaConfig, gen: GenerationConfig,
 def generate(model, input_ids, max_new_tokens=64, do_sample=False,
              temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
              pad_token_id=0, seed=0, lengths=None, cache="dense",
-             page_size=128):
+             page_size=128, weight_quant=None):
     """User entry: model is a LlamaForCausalLM; input_ids [B, S] (right-
     padded if lengths given; new tokens overwrite the padded slots in the
     cache). Returns [B, S + max_new_tokens] ids.
@@ -408,6 +456,27 @@ def generate(model, input_ids, max_new_tokens=64, do_sample=False,
         eos_token_id=eos_token_id, pad_token_id=pad_token_id, seed=seed)
     state = {k: (v._data if isinstance(v, Tensor) else v)
              for k, v in model.functional_state().items()}
+    if weight_quant is not None:
+        if weight_quant not in ("int8", "int4"):
+            raise ValueError(f"weight_quant must be int8|int4, "
+                             f"got {weight_quant!r}")
+        # quantize once per (model weights, algo): serving loops call
+        # generate() per request and must not re-quantize every call.
+        # Keyed by identity of the source arrays (held strongly in the
+        # cache, so ids cannot be reused); rebinding any weight (a
+        # training step) misses and re-quantizes.
+        cache = getattr(model, "_wq_cache", None)
+        src = {k: v for k, v in state.items()
+               if k.endswith(_QUANT_KEYS) or k == "lm_head.weight"}
+        if (cache is not None and cache["algo"] == weight_quant
+                and cache["src"].keys() == src.keys()
+                and all(cache["src"][k] is v for k, v in src.items())):
+            qstate = cache["state"]
+        else:
+            qstate = quantize_state(state, f"weight_only_{weight_quant}")
+            model._wq_cache = {"algo": weight_quant, "src": src,
+                               "state": qstate}
+        state = dict(state, **{k: qstate[k] for k in src})
     from ..ops.pallas import decode_attention as _DA
 
     if cache == "paged":
@@ -419,7 +488,7 @@ def generate(model, input_ids, max_new_tokens=64, do_sample=False,
                      gen.max_new_tokens, gen.do_sample, gen.temperature,
                      gen.top_k, gen.top_p, gen.eos_token_id,
                      gen.pad_token_id, pool.page_size, pool.num_pages,
-                     pool.max_pages)
+                     pool.max_pages, weight_quant)
         fn = _FN_CACHE.get(cache_key)
         if fn is None:
             if len(_FN_CACHE) >= _FN_CACHE_MAX:
@@ -434,7 +503,7 @@ def generate(model, input_ids, max_new_tokens=64, do_sample=False,
     cache_key = (astuple_cfg(model.config), s,
                  gen.max_new_tokens, gen.do_sample, gen.temperature,
                  gen.top_k, gen.top_p, gen.eos_token_id, gen.pad_token_id,
-                 _DA.PALLAS_DECODE or _DA._INTERPRET)
+                 _DA.PALLAS_DECODE or _DA._INTERPRET, weight_quant)
     fn = _FN_CACHE.get(cache_key)
     if fn is None:
         if len(_FN_CACHE) >= _FN_CACHE_MAX:   # bound compiled programs

@@ -95,3 +95,46 @@ def test_bf16_generation_matches_forward():
     out = G.generate(m, paddle.to_tensor(ids), max_new_tokens=4)
     ref = _reference_greedy(m, ids, 4)
     np.testing.assert_array_equal(out.numpy(), ref)
+
+
+def test_weight_only_quantized_generate():
+    """weight_quant='int8' serving path: runs the same one-program
+    generate with (int8, scale) weight leaves and stays close to the
+    dense greedy trajectory (reference: deploy models converted through
+    nn.quant weight_quantize before serving)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models import generation as G
+
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=4,
+                      max_position_embeddings=128)
+    paddle.seed(3)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 128, (2, 12)).astype(np.int64))
+
+    dense = G.generate(model, ids, max_new_tokens=8).numpy()
+    q8 = G.generate(model, ids, max_new_tokens=8,
+                    weight_quant="int8").numpy()
+    assert q8.shape == dense.shape
+    # int8 per-channel is near-lossless at init scale: the first GENERATED
+    # token matches exactly, the rest nearly always
+    np.testing.assert_array_equal(q8[:, 12], dense[:, 12])
+    agree = (q8[:, 12:] == dense[:, 12:]).mean()
+    assert agree >= 0.75, (agree, q8[:, 12:], dense[:, 12:])
+    # second call with unchanged weights reuses the cached quant state
+    c1 = model._wq_cache["state"]
+    G.generate(model, ids, max_new_tokens=8, weight_quant="int8")
+    assert model._wq_cache["state"] is c1
+
+    q4 = G.generate(model, ids, max_new_tokens=8,
+                    weight_quant="int4").numpy()
+    assert q4.shape == dense.shape
+
+    import pytest
+    with pytest.raises(ValueError, match="weight_quant"):
+        G.generate(model, ids, max_new_tokens=4, weight_quant="int2")
