@@ -50,6 +50,42 @@ def _emit(metric, value, unit, vs_baseline, detail):
     }), flush=True)
 
 
+def _llama_throughput(cfg, mesh, batch, seq, steps, dtype, on_tpu, dev,
+                      dp_shard=False):
+    """Shared llama-rung core: setup -> compile -> warmup -> timed steps.
+    Returns (tokens/s, mfu, loss).  Timing notes: host fetch (not
+    block_until_ready — the tunneled axon backend can report readiness
+    early); warmup absorbs the slow first post-compile steps."""
+    from paddle_tpu.models import llama_hybrid as H
+
+    params, opt = H.setup(cfg, mesh, dtype=dtype)
+    step = H.build_train_step(cfg, mesh, n_micro=1, remat=on_tpu, sp=False)
+    ids_np = np.random.randint(0, cfg.vocab_size,
+                               (batch, seq + 1)).astype(np.int64)
+    if dp_shard:
+        ids = jax.device_put(ids_np, jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec("dp", None)))
+    else:
+        ids = jnp.asarray(ids_np)
+    loss, params, opt = step(params, opt, ids)
+    float(loss)
+    for _ in range(3):
+        loss, params, opt = step(params, opt, ids)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, params, opt = step(params, opt, ids)
+    loss_val = float(loss)
+    dt = time.perf_counter() - t0
+
+    tps = batch * seq * steps / dt
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    attn_flops = 12 * cfg.num_hidden_layers * cfg.hidden_size * seq
+    mfu = tps * (6 * n_params + attn_flops) / _peak_flops(
+        dev.device_kind if on_tpu else "cpu")
+    return tps, (mfu if on_tpu else 0.0), loss_val, n_params
+
+
 def bench_llama():
     from paddle_tpu.models.llama import LlamaConfig
     from paddle_tpu.models import llama_hybrid as H
@@ -75,148 +111,48 @@ def bench_llama():
 
     pp, dp, tp = (1, n, 1) if n > 1 else (1, 1, 1)
     mesh = H.build_mesh(n, pp=pp, dp=dp, tp=tp)
-    params, opt = H.setup(cfg, mesh, dtype=dtype)
-    step = H.build_train_step(cfg, mesh, n_micro=1, remat=on_tpu, sp=False)
-
-    ids = jax.device_put(
-        np.random.randint(0, cfg.vocab_size, (batch, seq + 1)).astype(
-            np.int64),
-        jax.sharding.NamedSharding(mesh,
-                                   jax.sharding.PartitionSpec("dp", None)))
-
-    loss, params, opt = step(params, opt, ids)  # compile
-    float(loss)
-    for _ in range(3):  # warmup: first post-compile steps run slow on
-        loss, params, opt = step(params, opt, ids)  # the tunneled chip
-    float(loss)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss, params, opt = step(params, opt, ids)
-    # host fetch, not block_until_ready: the tunneled axon backend can
-    # report readiness before the queued chain has actually executed
-    loss_val = float(loss)
-    dt = time.perf_counter() - t0
-
-    tokens_per_sec = batch * seq * steps / dt
-    # 6*N_params FLOPs/token (fwd+bwd) + attention term
-    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
-    attn_flops = 12 * cfg.num_hidden_layers * cfg.hidden_size * seq
-    flops_per_token = 6 * n_params + attn_flops
-    mfu = tokens_per_sec * flops_per_token / (n * _peak_flops(
-        dev.device_kind if on_tpu else "cpu"))
-    if not on_tpu:
-        mfu = 0.0
-
-    _emit("llama_train_tokens_per_sec_per_chip", tokens_per_sec / n,
+    tps, mfu, loss_val, n_params = _llama_throughput(
+        cfg, mesh, batch, seq, steps, dtype, on_tpu, dev, dp_shard=n > 1)
+    _emit("llama_train_tokens_per_sec_per_chip", tps / n,
           "tokens/s/chip", mfu / 0.40 if on_tpu else 0.0,
           {"mfu": round(mfu, 4), "chips": n, "device": dev.device_kind,
            "params": int(n_params), "loss": loss_val})
 
 
-def bench_resnet50():
-    """Ladder #2: ResNet50 + AMP O1 (conv/BN/momentum on the MXU)."""
-    import paddle_tpu as paddle
-    import paddle_tpu.nn.functional as F
-    import paddle_tpu.optimizer as opt
-    from paddle_tpu.vision.models import resnet50
+def bench_longctx():
+    """Long-context rung: the SAME 0.95B llama trained at seq 8192 on one
+    chip — runs on the grid-streamed flash kernels (VMEM-independent of
+    sequence length), the single-chip face of the long-context story
+    (ring/Ulysses attention covers the multi-chip face)."""
+    from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.models import llama_hybrid as H
 
-    dev, on_tpu, _ = _env()
-    n = 1  # runs on one device; per-chip numbers divide by what is used
-    batch, steps = (128, 3) if on_tpu else (4, 1)
-    hw = 224 if on_tpu else 32
-
-    model = resnet50(num_classes=1000)
-    model.train()
-    o = opt.Momentum(learning_rate=0.1, momentum=0.9,
-                     parameters=model.parameters())
-
-    def loss_fn(m, x, y):
-        with paddle.amp.auto_cast(enable=on_tpu, level="O1"):
-            out = m(x)
-        return F.cross_entropy(out, y)
-
-    # one dispatch per `chunk` steps: per-dispatch transport latency
-    # (tens of ms on tunneled devices) must not masquerade as step time
-    chunk = 10 if on_tpu else 2
-    step = paddle.jit.train_step(model, o, loss_fn).multi_step(chunk)
-    x = paddle.to_tensor(
-        np.random.randn(batch, 3, hw, hw).astype(np.float32))
-    y = paddle.to_tensor(
-        np.random.randint(0, 1000, (batch,)).astype(np.int64))
-    float(step(x, y))                      # compile (chunk steps)
-    float(step(x, y))
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step(x, y)
-    loss_val = float(loss)
-    dt = time.perf_counter() - t0
-
-    imgs_per_sec = batch * steps * chunk / dt
-    # ResNet50 fwd ~4.1 GFLOPs/image at 224^2; train ~3x fwd
-    flops_per_img = 3 * 4.1e9 * (hw / 224) ** 2
-    mfu = imgs_per_sec * flops_per_img / (n * _peak_flops(dev.device_kind))
-    if not on_tpu:
-        mfu = 0.0
-    _emit("resnet50_train_images_per_sec_per_chip", imgs_per_sec / n,
-          "images/s/chip", mfu / 0.40 if on_tpu else 0.0,
-          {"mfu": round(mfu, 4), "batch": batch, "amp": "O1" if on_tpu
-           else "off", "device": dev.device_kind, "loss": loss_val})
-
-
-def bench_bert():
-    """Ladder #3: BERT-base fine-tune shape (encoder + AdamW)."""
-    import paddle_tpu as paddle
-    import paddle_tpu.nn.functional as F
-    import paddle_tpu.optimizer as opt
-    from paddle_tpu.models.bert import BertConfig, \
-        BertForSequenceClassification
-
-    dev, on_tpu, _ = _env()
-    n = 1  # single-device bench
+    dev, on_tpu, n = _env()
     if on_tpu:
-        cfg = BertConfig()                         # base: 12L/768H
-        batch, seq, steps = 32, 384, 3
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=16, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=8192,
+            dtype="bfloat16")
+        batch, seq, steps = 1, 8192, 8
+        dtype = jnp.bfloat16
     else:
-        cfg = BertConfig(vocab_size=512, hidden_size=128,
-                         num_hidden_layers=2, num_attention_heads=4,
-                         intermediate_size=256)
-        batch, seq, steps = 2, 64, 1
+        cfg = LlamaConfig(
+            vocab_size=1024, hidden_size=256, intermediate_size=512,
+            num_hidden_layers=4, num_attention_heads=8,
+            num_key_value_heads=8, max_position_embeddings=1024)
+        batch, seq, steps = 1, 512, 2
+        dtype = jnp.float32
 
-    model = BertForSequenceClassification(cfg)
-    model.train()
-    o = opt.AdamW(learning_rate=3e-5, parameters=model.parameters())
-
-    def loss_fn(m, ids, y):
-        with paddle.amp.auto_cast(enable=on_tpu, level="O1"):
-            logits = m(ids)
-        return F.cross_entropy(logits, y)
-
-    chunk = 10 if on_tpu else 2
-    step = paddle.jit.train_step(model, o, loss_fn).multi_step(chunk)
-    ids = paddle.to_tensor(
-        np.random.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64))
-    y = paddle.to_tensor(
-        np.random.randint(0, cfg.num_labels, (batch,)).astype(np.int64))
-    float(step(ids, y))
-    float(step(ids, y))
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step(ids, y)
-    loss_val = float(loss)
-    dt = time.perf_counter() - t0
-
-    ex_per_sec = batch * steps * chunk / dt
-    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
-    flops_per_ex = 6 * n_params * seq \
-        + 12 * cfg.num_hidden_layers * cfg.hidden_size * seq * seq
-    mfu = ex_per_sec * flops_per_ex / (n * _peak_flops(dev.device_kind))
-    if not on_tpu:
-        mfu = 0.0
-    _emit("bert_base_train_examples_per_sec_per_chip", ex_per_sec / n,
-          "examples/s/chip", mfu / 0.40 if on_tpu else 0.0,
+    mesh = H.build_mesh(1, pp=1, dp=1, tp=1)
+    tps, mfu, loss_val, _np_ = _llama_throughput(
+        cfg, mesh, batch, seq, steps, dtype, on_tpu, dev)
+    _emit("llama_longctx8k_tokens_per_sec_per_chip", tps,
+          "tokens/s/chip", mfu / 0.40 if on_tpu else 0.0,
           {"mfu": round(mfu, 4), "seq": seq, "batch": batch,
-           "params": int(n_params), "device": dev.device_kind,
-           "loss": loss_val})
+           "device": dev.device_kind, "loss": loss_val,
+           "note": "seq-8192 single-chip training on the streamed "
+                   "flash kernels"})
 
 
 def bench_moe():
@@ -430,7 +366,7 @@ def main():
     # live in the process; a subprocess instead would contend with the
     # parent's device session on the tunneled transport
     for fn in (bench_lenet, bench_llama, bench_resnet50, bench_bert,
-               bench_moe, bench_decode):
+               bench_moe, bench_decode, bench_longctx):
         try:
             fn()
         except Exception as e:  # keep the rest of the ladder running

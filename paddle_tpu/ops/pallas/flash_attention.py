@@ -220,6 +220,15 @@ def sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
                 and am.shape[-2:] == (q.shape[1], k.shape[1])):
             bias = am
 
+    long_seq = max(q.shape[1], k.shape[1]) > _STREAM_SEQ
+    if shapes_ok and long_seq and (mask_vecs is not None
+                                   or bias is not None):
+        # the masked kernels hold full K/V (and Q/dO/O in bwd) in VMEM —
+        # past ~4k they exceed the Mosaic scoped-VMEM budget at the
+        # CALLER's compile time (uncatchable here); the chunked-XLA
+        # online-softmax path is O(S) memory at any length
+        return _xla_sdpa_streamed(q, k, v, is_causal, bias=bias,
+                                  mask_vecs=mask_vecs)
     if shapes_ok and (attn_mask is None or mask_vecs is not None
                       or bias is not None) and _probe_pallas():
         try:
@@ -245,6 +254,84 @@ def sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
         return jnp.where(row_ok, out, jnp.zeros((), out.dtype))
     return _xla_sdpa(q, k, v, attn_mask=attn_mask, is_causal=is_causal,
                      dropout_p=dropout_p, training=training)
+
+
+def _xla_sdpa_streamed(q, k, v, is_causal, bias=None, mask_vecs=None,
+                       chunk=512):
+    """O(S)-memory masked attention in plain XLA: lax.scan over key
+    chunks with the online-softmax recurrence.  The long-sequence
+    fallback for the MASKED kernels (flash_mask.py holds full K/V in
+    VMEM and exceeds the Mosaic scoped-VMEM budget past ~4k; the dense
+    [Sq, Sk] fallback explodes HBM instead).  Supports float bias
+    [B|1, H|1, Sq, Sk] and flashmask interval vecs [B|1, H|1, 2|4, Sk];
+    per-chunk slices keep every transient at [B, H, Sq, chunk]."""
+    qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32)   # [B, H, Sq, D]
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    b, hq, sq, d = qh.shape
+    hk = kh.shape[1]
+    if hq != hk:                                      # GQA
+        rep = hq // hk
+        kh = jnp.repeat(kh, rep, axis=1)
+        vh = jnp.repeat(vh, rep, axis=1)
+    sk = kh.shape[2]
+    scale = 1.0 / np.sqrt(d)
+    pad = (-sk) % chunk
+    if pad:
+        kh = jnp.pad(kh, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        vh = jnp.pad(vh, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        if bias is not None:
+            bias = jnp.pad(bias, ((0, 0), (0, 0), (0, 0), (0, pad)))
+        if mask_vecs is not None:
+            mask_vecs = jnp.pad(mask_vecs,
+                                ((0, 0), (0, 0), (0, 0), (0, pad)))
+    nc = kh.shape[2] // chunk
+    ko = sk - sq
+    q_ids = jnp.arange(sq)[:, None]                  # [Sq, 1]
+
+    def step(carry, c):
+        m_prev, l_prev, acc = carry
+        c0 = c * chunk
+        kc = jax.lax.dynamic_slice_in_dim(kh, c0, chunk, axis=2)
+        vc = jax.lax.dynamic_slice_in_dim(vh, c0, chunk, axis=2)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh,
+                       kc.astype(jnp.float32)) * scale
+        k_ids = c0 + jnp.arange(chunk)[None, :]      # [1, chunk]
+        ok = k_ids < sk                               # padded tail
+        if is_causal:
+            ok = ok & (k_ids <= q_ids + ko)
+        if bias is not None:
+            s = s + jax.lax.dynamic_slice_in_dim(
+                bias, c0, chunk, axis=3).astype(jnp.float32)
+        if mask_vecs is not None:
+            from .flash_mask import dense_mask_from_intervals
+            vec_c = jax.lax.dynamic_slice_in_dim(mask_vecs, c0, chunk,
+                                                 axis=3)
+            # interval semantics are per-COLUMN (row bounds in the vec
+            # entries), so column slicing composes exactly
+            allowed = dense_mask_from_intervals(vec_c, sq, chunk)
+            s = jnp.where(allowed, s, MASK_VAL)
+        s = jnp.where(ok[None, None], s, MASK_VAL)
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur[..., None])
+        l_cur = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vc.astype(jnp.float32))
+        # pin carry dtypes: the framework's global x64 mode promotes
+        # somewhere in the reductions
+        return (m_cur.astype(jnp.float32), l_cur.astype(jnp.float32),
+                acc.astype(jnp.float32)), None
+
+    m0 = jnp.full((b, hq, sq), MASK_VAL, jnp.float32)
+    l0 = jnp.zeros((b, hq, sq), jnp.float32)
+    acc0 = jnp.zeros((b, hq, sq, d), jnp.float32)
+    (m, l, acc), _ = jax.lax.scan(step, (m0, l0, acc0),
+                                  jnp.arange(nc))
+    row_ok = (m > MASK_VAL * 0.5) & (l > 0.0)
+    out = jnp.where(row_ok[..., None],
+                    acc / jnp.where(row_ok, l, 1.0)[..., None], 0.0)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
 _WARNED_FALLBACK = False
@@ -406,6 +493,12 @@ def _flash_fwd_x32(q, k, v, causal, sm_scale, block_q, block_k, sq_real,
                    sk_real, need_lse):
     from jax.experimental import pallas as pl
 
+    if _stream_wanted(k.shape[2]):
+        # whole-K/V VMEM residency would exceed scoped VMEM: stream the
+        # key blocks through the grid instead
+        return _flash_fwd_stream(q, k, v, causal, sm_scale, block_q,
+                                 block_k, sq_real, sk_real, need_lse)
+
     b, h, sq, d = q.shape
     hk = k.shape[1]
     g = h // hk                           # q heads per kv head (GQA)
@@ -435,6 +528,311 @@ def _flash_fwd_x32(q, k, v, causal, sm_scale, block_q, block_k, sq_real,
         interpret=_INTERPRET,
     )(q, k, v)
     return res if need_lse else (res, None)
+
+
+# -------------------------------------------- streamed (long-seq) variants
+# The block kernels above hold one full non-blocked operand in VMEM (K/V
+# for fwd+dq, Q/dO/O for dkv) — ideal below ~4k tokens, beyond Mosaic's
+# scoped-VMEM limit past it (measured: seq 8192 bwd needs 20.75M of the
+# 16M budget).  The streamed variants below iterate that operand through
+# an inner GRID dimension instead, carrying the online-softmax state /
+# gradient accumulators across grid steps in f32 VMEM scratch, so VMEM
+# use is independent of sequence length — the flash recurrence proper.
+_STREAM_SEQ = 4096     # switch point (full-VMEM path is faster below it)
+_FORCE_STREAM = False  # tests: exercise the streamed path at tiny shapes
+
+
+def _stream_wanted(s):
+    return _FORCE_STREAM or s > _STREAM_SEQ
+
+
+def _fwd_kernel_stream(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
+                       m_ref, l_ref, *, causal, sm_scale, sq_real,
+                       sk_real, nk):
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    bq, d = q_ref.shape
+    bk = k_ref.shape[0]
+    ko = sk_real - sq_real
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, MASK_VAL)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q_lo = i * bq
+    k_lo = j * bk
+    vis = (q_lo < sq_real) & (k_lo < sk_real)
+    if causal:
+        vis = vis & (q_lo + bq - 1 + ko >= k_lo)
+
+    @pl.when(vis)
+    def _compute():
+        q = q_ref[...]
+        k = k_ref[...]
+        v = v_ref[...]
+        s = _ab_t(q, k) * jnp.float32(sm_scale)
+        q_ids = q_lo + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_ids = k_lo + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(_visible(q_ids, k_ids, causal, sk_real, ko),
+                      s, MASK_VAL)
+        m_prev = m_ref[:, 0]
+        l_prev = l_ref[:, 0]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur[:, None])
+        l_cur = l_prev * alpha + jnp.sum(p, axis=1)
+        acc_ref[...] = acc_ref[...] * alpha[:, None] \
+            + _ab(p.astype(v.dtype), v).astype(jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_cur[:, None], m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_cur[:, None], l_ref.shape)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        m = m_ref[:, 0]
+        l = l_ref[:, 0]
+        row_ok = (m > MASK_VAL * 0.5) & (l > 0.0)
+        o_ref[...] = jnp.where(
+            row_ok[:, None],
+            acc_ref[...] / jnp.where(row_ok, l, 1.0)[:, None],
+            0.0).astype(o_ref.dtype)
+        if lse_ref is not None:
+            lse = jnp.where(row_ok, m + jnp.log(jnp.where(row_ok, l, 1.0)),
+                            LSE_INVALID)
+            lse_ref[...] = jnp.broadcast_to(lse[:, None], lse_ref.shape)
+
+
+def _flash_fwd_stream(q, k, v, causal, sm_scale, block_q, block_k,
+                      sq_real, sk_real, need_lse):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    hk = k.shape[1]
+    g = h // hk
+    sk = k.shape[2]
+    nk = sk // block_k
+    blk = pl.BlockSpec((None, None, block_q, d),
+                       lambda b_, h_, i, j: (b_, h_, i, 0))
+    if causal:
+        # clamp j so causally-invisible cells re-request the previous
+        # block: Mosaic elides the repeated DMA (pl.when skips compute,
+        # but NOT the fetch — without the clamp the upper triangle costs
+        # ~2x K/V HBM traffic)
+        ko = sk_real - sq_real
+
+        def _kv_idx(b_, h_, i, j):
+            jmax = jnp.clip((i * block_q + block_q - 1 + ko) // block_k,
+                            0, nk - 1)
+            return (b_, h_ // g, jnp.minimum(j, jmax), 0)
+    else:
+        def _kv_idx(b_, h_, i, j):
+            return (b_, h_ // g, j, 0)
+    kv = pl.BlockSpec((None, None, block_k, d), _kv_idx)
+    out_specs = [blk]
+    out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
+    if need_lse:
+        out_specs.append(pl.BlockSpec(
+            (None, None, block_q, NUM_LANES),
+            lambda b_, h_, i, j: (b_, h_, i, 0)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((b, h, sq, NUM_LANES), jnp.float32))
+    kernel = functools.partial(_fwd_kernel_stream, causal=causal,
+                               sm_scale=sm_scale, sq_real=sq_real,
+                               sk_real=sk_real, nk=nk)
+    res = pl.pallas_call(
+        kernel if need_lse else
+        (lambda q_ref, k_ref, v_ref, o_ref, acc, m, l:
+         kernel(q_ref, k_ref, v_ref, o_ref, None, acc, m, l)),
+        grid=(b, h, sq // block_q, nk),
+        in_specs=[blk, kv, kv],
+        out_specs=out_specs if need_lse else out_specs[0],
+        out_shape=out_shape if need_lse else out_shape[0],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32),
+                        pltpu.VMEM((block_q, NUM_LANES), jnp.float32),
+                        pltpu.VMEM((block_q, NUM_LANES), jnp.float32)],
+        interpret=_INTERPRET,
+    )(q, k, v)
+    return res if need_lse else (res, None)
+
+
+def _bwd_dq_kernel_stream(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                          dq_ref, acc_ref, delta_ref, *, causal, sm_scale,
+                          sq_real, sk_real, nk):
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    bq, d = q_ref.shape
+    bk = k_ref.shape[0]
+    ko = sk_real - sq_real
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        # delta depends only on the q block: compute once, not nk times
+        delta = jnp.sum(o_ref[...].astype(jnp.float32)
+                        * do_ref[...].astype(jnp.float32), axis=1)
+        delta_ref[...] = jnp.broadcast_to(delta[:, None], delta_ref.shape)
+
+    q_lo = i * bq
+    k_lo = j * bk
+    vis = (q_lo < sq_real) & (k_lo < sk_real)
+    if causal:
+        vis = vis & (q_lo + bq - 1 + ko >= k_lo)
+
+    @pl.when(vis)
+    def _compute():
+        q = q_ref[...]
+        do = do_ref[...]
+        lse = lse_ref[:, 0]
+        delta = delta_ref[:, 0]
+        k = k_ref[...]
+        v = v_ref[...]
+        s = _ab_t(q, k) * jnp.float32(sm_scale)
+        q_ids = q_lo + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_ids = k_lo + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(_visible(q_ids, k_ids, causal, sk_real, ko),
+                      s, MASK_VAL)
+        p = jnp.exp(s - lse[:, None])
+        dp = _ab_t(do, v)
+        ds = p * (dp - delta[:, None]) * jnp.float32(sm_scale)
+        acc_ref[...] = acc_ref[...] + \
+            _ab(ds.astype(k.dtype), k).astype(jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[...] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel_stream(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                           dk_ref, dv_ref, dk_acc, dv_acc, *, causal,
+                           sm_scale, sq_real, sk_real, nq):
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(2)   # k block
+    j = pl.program_id(3)   # q block
+    bk, d = k_ref.shape
+    bq = q_ref.shape[0]
+    ko = sk_real - sq_real
+
+    @pl.when(j == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    q_lo = j * bq
+    k_lo = i * bk
+    vis = (q_lo < sq_real) & (k_lo < sk_real)
+    if causal:
+        vis = vis & (q_lo + bq - 1 + ko >= k_lo)
+
+    @pl.when(vis)
+    def _compute():
+        k = k_ref[...]
+        v = v_ref[...]
+        q = q_ref[...]
+        do = do_ref[...]
+        lse = lse_ref[:, 0]
+        delta = jnp.sum(o_ref[...].astype(jnp.float32)
+                        * do.astype(jnp.float32), axis=1)
+        s = _ab_t(q, k) * jnp.float32(sm_scale)
+        q_ids = q_lo + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_ids = k_lo + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(_visible(q_ids, k_ids, causal, sk_real, ko),
+                      s, MASK_VAL)
+        p = jnp.exp(s - lse[:, None])
+        dv_acc[...] = dv_acc[...] + \
+            _at_b(p.astype(do.dtype), do).astype(jnp.float32)
+        dp = _ab_t(do, v)
+        ds = p * (dp - delta[:, None]) * jnp.float32(sm_scale)
+        dk_acc[...] = dk_acc[...] + \
+            _at_b(ds.astype(q.dtype), q).astype(jnp.float32)
+
+    @pl.when(j == nq - 1)
+    def _finalize():
+        dk_ref[...] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_stream(q, k, v, out, lse, g, causal, sm_scale, block_q,
+                      block_k, sq_real, sk_real):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    hk = k.shape[1]
+    grp = h // hk
+    sk = k.shape[2]
+    nk = sk // block_k
+    nq = sq // block_q
+    lse = jnp.broadcast_to(lse[..., None], (b, h, sq, NUM_LANES))
+
+    blk_q4 = pl.BlockSpec((None, None, block_q, d),
+                          lambda b_, h_, i, j: (b_, h_, i, 0))
+    blk_l4 = pl.BlockSpec((None, None, block_q, NUM_LANES),
+                          lambda b_, h_, i, j: (b_, h_, i, 0))
+    ko = sk_real - sq_real
+    if causal:
+        # DMA-elision clamp, see _flash_fwd_stream
+        def _kv_idx4(b_, h_, i, j):
+            jmax = jnp.clip((i * block_q + block_q - 1 + ko) // block_k,
+                            0, nk - 1)
+            return (b_, h_ // grp, jnp.minimum(j, jmax), 0)
+    else:
+        def _kv_idx4(b_, h_, i, j):
+            return (b_, h_ // grp, j, 0)
+    kv4 = pl.BlockSpec((None, None, block_k, d), _kv_idx4)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel_stream, causal=causal,
+                          sm_scale=sm_scale, sq_real=sq_real,
+                          sk_real=sk_real, nk=nk),
+        grid=(b, h, nq, nk),
+        in_specs=[blk_q4, kv4, kv4, blk_q4, blk_q4, blk_l4],
+        out_specs=blk_q4,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32),
+                        pltpu.VMEM((block_q, NUM_LANES), jnp.float32)],
+        interpret=_INTERPRET,
+    )(q, k, v, g, out, lse)
+
+    blk_k4 = pl.BlockSpec((None, None, block_k, d),
+                          lambda b_, h_, i, j: (b_, h_, i, 0))
+    kv_i4 = pl.BlockSpec((None, None, block_k, d),
+                         lambda b_, h_, i, j: (b_, h_ // grp, i, 0))
+    if causal:
+        # mirror clamp on the q side: cells below the k-block's first
+        # visible q block re-request the previous q/do/o/lse blocks
+        def _q_clamp(j, i):
+            jmin = jnp.clip((i * block_k - ko) // block_q, 0, nq - 1)
+            return jnp.maximum(j, jmin)
+    else:
+        def _q_clamp(j, i):
+            return j
+    q_j4 = pl.BlockSpec((None, None, block_q, d),
+                        lambda b_, h_, i, j: (b_, h_, _q_clamp(j, i), 0))
+    l_j4 = pl.BlockSpec((None, None, block_q, NUM_LANES),
+                        lambda b_, h_, i, j: (b_, h_, _q_clamp(j, i), 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel_stream, causal=causal,
+                          sm_scale=sm_scale, sq_real=sq_real,
+                          sk_real=sk_real, nq=nq),
+        grid=(b, h, sk // block_k, nq),
+        in_specs=[q_j4, kv_i4, kv_i4, q_j4, q_j4, l_j4],
+        out_specs=[blk_k4, blk_k4],
+        out_shape=[jax.ShapeDtypeStruct((b, h, sk, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, h, sk, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=_INTERPRET,
+    )(q, k, v, g, out, lse)
+    if grp > 1:
+        dk = dk.reshape(b, hk, grp, sk, d).sum(axis=2)
+        dv = dv.reshape(b, hk, grp, sk, d).sum(axis=2)
+    return dq, dk, dv
 
 
 # --------------------------------------------------------------- backward
@@ -525,6 +923,10 @@ def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
 def _flash_bwd_x32(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
                    sq_real, sk_real):
     from jax.experimental import pallas as pl
+
+    if _stream_wanted(max(q.shape[2], k.shape[2])):
+        return _flash_bwd_stream(q, k, v, out, lse, g, causal, sm_scale,
+                                 block_q, block_k, sq_real, sk_real)
 
     b, h, sq, d = q.shape
     hk = k.shape[1]
