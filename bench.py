@@ -119,6 +119,112 @@ def bench_llama():
            "params": int(n_params), "loss": loss_val})
 
 
+def bench_resnet50():
+    """Ladder #2: ResNet50 + AMP O1 (conv/BN/momentum on the MXU)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.vision.models import resnet50
+
+    dev, on_tpu, _ = _env()
+    n = 1  # runs on one device; per-chip numbers divide by what is used
+    batch, steps = (128, 3) if on_tpu else (4, 1)
+    hw = 224 if on_tpu else 32
+
+    model = resnet50(num_classes=1000)
+    model.train()
+    o = opt.Momentum(learning_rate=0.1, momentum=0.9,
+                     parameters=model.parameters())
+
+    def loss_fn(m, x, y):
+        with paddle.amp.auto_cast(enable=on_tpu, level="O1"):
+            out = m(x)
+        return F.cross_entropy(out, y)
+
+    # one dispatch per `chunk` steps: per-dispatch transport latency
+    # (tens of ms on tunneled devices) must not masquerade as step time
+    chunk = 10 if on_tpu else 2
+    step = paddle.jit.train_step(model, o, loss_fn).multi_step(chunk)
+    x = paddle.to_tensor(
+        np.random.randn(batch, 3, hw, hw).astype(np.float32))
+    y = paddle.to_tensor(
+        np.random.randint(0, 1000, (batch,)).astype(np.int64))
+    float(step(x, y))                      # compile (chunk steps)
+    float(step(x, y))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, y)
+    loss_val = float(loss)
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = batch * steps * chunk / dt
+    # ResNet50 fwd ~4.1 GFLOPs/image at 224^2; train ~3x fwd
+    flops_per_img = 3 * 4.1e9 * (hw / 224) ** 2
+    mfu = imgs_per_sec * flops_per_img / (n * _peak_flops(dev.device_kind))
+    if not on_tpu:
+        mfu = 0.0
+    _emit("resnet50_train_images_per_sec_per_chip", imgs_per_sec / n,
+          "images/s/chip", mfu / 0.40 if on_tpu else 0.0,
+          {"mfu": round(mfu, 4), "batch": batch, "amp": "O1" if on_tpu
+           else "off", "device": dev.device_kind, "loss": loss_val})
+
+
+def bench_bert():
+    """Ladder #3: BERT-base fine-tune shape (encoder + AdamW)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.models.bert import BertConfig, \
+        BertForSequenceClassification
+
+    dev, on_tpu, _ = _env()
+    n = 1  # single-device bench
+    if on_tpu:
+        cfg = BertConfig()                         # base: 12L/768H
+        batch, seq, steps = 32, 384, 3
+    else:
+        cfg = BertConfig(vocab_size=512, hidden_size=128,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         intermediate_size=256)
+        batch, seq, steps = 2, 64, 1
+
+    model = BertForSequenceClassification(cfg)
+    model.train()
+    o = opt.AdamW(learning_rate=3e-5, parameters=model.parameters())
+
+    def loss_fn(m, ids, y):
+        with paddle.amp.auto_cast(enable=on_tpu, level="O1"):
+            logits = m(ids)
+        return F.cross_entropy(logits, y)
+
+    chunk = 10 if on_tpu else 2
+    step = paddle.jit.train_step(model, o, loss_fn).multi_step(chunk)
+    ids = paddle.to_tensor(
+        np.random.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64))
+    y = paddle.to_tensor(
+        np.random.randint(0, cfg.num_labels, (batch,)).astype(np.int64))
+    float(step(ids, y))
+    float(step(ids, y))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids, y)
+    loss_val = float(loss)
+    dt = time.perf_counter() - t0
+
+    ex_per_sec = batch * steps * chunk / dt
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    flops_per_ex = 6 * n_params * seq \
+        + 12 * cfg.num_hidden_layers * cfg.hidden_size * seq * seq
+    mfu = ex_per_sec * flops_per_ex / (n * _peak_flops(dev.device_kind))
+    if not on_tpu:
+        mfu = 0.0
+    _emit("bert_base_train_examples_per_sec_per_chip", ex_per_sec / n,
+          "examples/s/chip", mfu / 0.40 if on_tpu else 0.0,
+          {"mfu": round(mfu, 4), "seq": seq, "batch": batch,
+           "params": int(n_params), "device": dev.device_kind,
+           "loss": loss_val})
+
+
 def bench_longctx():
     """Long-context rung: the SAME 0.95B llama trained at seq 8192 on one
     chip — runs on the grid-streamed flash kernels (VMEM-independent of
