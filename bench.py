@@ -51,7 +51,7 @@ def _emit(metric, value, unit, vs_baseline, detail):
 
 
 def _llama_throughput(cfg, mesh, batch, seq, steps, dtype, on_tpu, dev,
-                      dp_shard=False):
+                      dp_shard=False, n_chips=1):
     """Shared llama-rung core: setup -> compile -> warmup -> timed steps.
     Returns (tokens/s, mfu, loss).  Timing notes: host fetch (not
     block_until_ready — the tunneled axon backend can report readiness
@@ -81,8 +81,9 @@ def _llama_throughput(cfg, mesh, batch, seq, steps, dtype, on_tpu, dev,
     tps = batch * seq * steps / dt
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
     attn_flops = 12 * cfg.num_hidden_layers * cfg.hidden_size * seq
-    mfu = tps * (6 * n_params + attn_flops) / _peak_flops(
-        dev.device_kind if on_tpu else "cpu")
+    # tps is TOTAL tokens/s across the mesh; peak scales with chip count
+    mfu = tps * (6 * n_params + attn_flops) / (
+        n_chips * _peak_flops(dev.device_kind if on_tpu else "cpu"))
     return tps, (mfu if on_tpu else 0.0), loss_val, n_params
 
 
@@ -112,7 +113,8 @@ def bench_llama():
     pp, dp, tp = (1, n, 1) if n > 1 else (1, 1, 1)
     mesh = H.build_mesh(n, pp=pp, dp=dp, tp=tp)
     tps, mfu, loss_val, n_params = _llama_throughput(
-        cfg, mesh, batch, seq, steps, dtype, on_tpu, dev, dp_shard=n > 1)
+        cfg, mesh, batch, seq, steps, dtype, on_tpu, dev, dp_shard=n > 1,
+        n_chips=n)
     _emit("llama_train_tokens_per_sec_per_chip", tps / n,
           "tokens/s/chip", mfu / 0.40 if on_tpu else 0.0,
           {"mfu": round(mfu, 4), "chips": n, "device": dev.device_kind,

@@ -465,13 +465,13 @@ def generate(model, input_ids, max_new_tokens=64, do_sample=False,
         # Keyed by identity of the source arrays (held strongly in the
         # cache, so ids cannot be reused); rebinding any weight (a
         # training step) misses and re-quantizes.
-        cache = getattr(model, "_wq_cache", None)
+        wq_cache = getattr(model, "_wq_cache", None)
         src = {k: v for k, v in state.items()
                if k.endswith(_QUANT_KEYS) or k == "lm_head.weight"}
-        if (cache is not None and cache["algo"] == weight_quant
-                and cache["src"].keys() == src.keys()
-                and all(cache["src"][k] is v for k, v in src.items())):
-            qstate = cache["state"]
+        if (wq_cache is not None and wq_cache["algo"] == weight_quant
+                and wq_cache["src"].keys() == src.keys()
+                and all(wq_cache["src"][k] is v for k, v in src.items())):
+            qstate = wq_cache["state"]
         else:
             qstate = quantize_state(state, f"weight_only_{weight_quant}")
             model._wq_cache = {"algo": weight_quant, "src": src,

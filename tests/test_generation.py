@@ -138,3 +138,36 @@ def test_weight_only_quantized_generate():
     import pytest
     with pytest.raises(ValueError, match="weight_quant"):
         G.generate(model, ids, max_new_tokens=4, weight_quant="int2")
+
+
+def test_weight_quant_with_paged_cache():
+    """cache='paged' + weight_quant must serve from the paged block-table
+    pool (a local-variable shadow of the `cache` argument used to
+    silently reroute this combination to the dense path)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models import generation as G
+
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=4,
+                      max_position_embeddings=256)
+    paddle.seed(5)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    ids = paddle.to_tensor(
+        np.random.RandomState(1).randint(0, 128, (2, 12)).astype(np.int64))
+
+    G._FN_CACHE.clear()
+    out = G.generate(model, ids, max_new_tokens=6, cache="paged",
+                     weight_quant="int8").numpy()
+    paged_keys = [k for k in G._FN_CACHE if k[0] == "paged"]
+    assert paged_keys, "paged+quant generate never built the paged program"
+    assert paged_keys[0][-1] == "int8"
+    dense_q = G.generate(model, ids, max_new_tokens=6,
+                         weight_quant="int8").numpy()
+    assert out.shape == dense_q.shape
+    # same quantized weights, same greedy decode: first generated token
+    # matches across cache layouts
+    np.testing.assert_array_equal(out[:, 12], dense_q[:, 12])
