@@ -12,6 +12,7 @@ generated GradNodes).  Shape/dtype inference (InferMeta) and sharding rules
 from __future__ import annotations
 
 import functools
+import time as _time
 from typing import Callable
 
 import jax
@@ -19,6 +20,7 @@ import numpy as np
 from jax.tree_util import tree_flatten, tree_unflatten
 
 from ..autograd import tape
+from ..profiler import statistic as _stat
 
 __all__ = ["op", "OPS", "apply_op"]
 
@@ -276,9 +278,27 @@ def apply_op(opname, body, args, kwargs):
     # ONE annotation point for every dispatch path below: anything that
     # escapes gains the op/input context note
     try:
+        if _stat.ENABLED:
+            t0 = _time.perf_counter()
+            out = _dispatch(opname, body, flat, treedef, rule)
+            _profile_span(opname, t0, out)
+            return out
         return _dispatch(opname, body, flat, treedef, rule)
     except Exception as e:
         raise _enforce_note(e, opname, flat)
+
+
+def _profile_span(opname, t0, out):
+    """Close a profiler-statistics span over this dispatch: synchronize
+    the outputs first so the span covers execution, not async dispatch
+    (the reference op summary's CUDA-event-synchronized semantics)."""
+    flat, _ = tree_flatten(out, is_leaf=_is_tensor)
+    arrs = [x._data for x in flat if _is_tensor(x)]
+    try:
+        jax.block_until_ready(arrs)
+    except Exception:
+        pass
+    _stat.record_span(opname, _time.perf_counter() - t0, "op")
 
 
 def _dispatch(opname, body, flat, treedef, rule):

@@ -4,25 +4,13 @@ from .profiler import (  # noqa: F401
     Profiler, ProfilerTarget, ProfilerState, RecordEvent, make_scheduler,
     export_chrome_tracing, load_profiler_result, enable_host_tracing,
     export_host_trace, host_trace_event_count)
+from .statistic import SortedKeys, EventSummary  # noqa: F401
 from .timer import Benchmark, benchmark  # noqa: F401
 
 __all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
            "make_scheduler", "export_chrome_tracing",
            "load_profiler_result", "Benchmark", "benchmark", "SortedKeys",
            "SummaryView", "export_protobuf"]
-
-
-class SortedKeys:
-    """Summary sort keys (reference profiler/profiler_statistic.py
-    SortedKeys enum)."""
-    CPUTotal = 0
-    CPUAvg = 1
-    CPUMax = 2
-    CPUMin = 3
-    GPUTotal = 4
-    GPUAvg = 5
-    GPUMax = 6
-    GPUMin = 7
 
 
 class SummaryView:

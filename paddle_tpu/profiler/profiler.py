@@ -136,23 +136,37 @@ class Profiler:
         self._recording = False
         self._step_times = []
         self._t_last = None
+        self._stats_on = False      # whether THIS profiler enabled the
+                                    # global op-stats collection
 
     # ------------------------------------------------------------- control
     def start(self):
+        from . import statistic
         self._t_last = time.perf_counter()
         if self._timer_only:
             return
+        statistic.reset()
         state = self._state()
         if state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
             self._start_trace()
+            statistic.enable_collection()
+            self._stats_on = True
 
     def stop(self):
+        if self._stats_on:
+            # only the profiler that ENABLED collection may disable it —
+            # a timer-only or never-recording profiler must not flip the
+            # global flag out from under a recording one
+            from . import statistic
+            statistic.disable_collection()
+            self._stats_on = False
         if self._recording:
             self._stop_trace()
         if self._on_trace_ready is not None:
             self._on_trace_ready(self)
 
     def step(self, num_samples=None):
+        from . import statistic
         now = time.perf_counter()
         if self._t_last is not None:
             self._step_times.append(now - self._t_last)
@@ -167,8 +181,13 @@ class Profiler:
                        ProfilerState.RECORD_AND_RETURN) and \
                     not self._recording:
                 self._start_trace()
+                statistic.enable_collection()
+                self._stats_on = True
             elif cur == ProfilerState.CLOSED and self._recording:
                 self._stop_trace()
+                if self._stats_on:
+                    statistic.disable_collection()
+                    self._stats_on = False
 
     def _state(self):
         if self._scheduler is None:
@@ -200,13 +219,24 @@ class Profiler:
     # ------------------------------------------------------------- summary
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
-        if not self._step_times:
-            print("no profiled steps")
-            return
-        import numpy as np
-        ts = np.asarray(self._step_times) * 1e3
-        print(f"steps: {len(ts)}  avg: {ts.mean():.3f}ms  "
-              f"min: {ts.min():.3f}ms  max: {ts.max():.3f}ms")
+        """Print the overview + op-level summary table (reference
+        profiler_statistic._build_table).  `sorted_by` is a
+        statistic.SortedKeys; returns the table string too, so callers
+        can post-process (the reference prints only)."""
+        from . import statistic
+        out = []
+        if self._step_times:
+            import numpy as np
+            ts = np.asarray(self._step_times) * 1e3
+            out.append(f"steps: {len(ts)}  avg: {ts.mean():.3f}ms  "
+                       f"min: {ts.min():.3f}ms  max: {ts.max():.3f}ms")
+        if statistic.op_summary():
+            out.append(statistic.gen_summary_table(
+                sorted_by=sorted_by or statistic.SortedKeys.CPUTotal,
+                time_unit=time_unit, op_detail=op_detail))
+        text = "\n".join(out) if out else "no profiled steps"
+        print(text)
+        return text
 
 
 class RecordEvent:
@@ -220,6 +250,7 @@ class RecordEvent:
         self._pushed = False
 
     def begin(self):
+        self._t0 = time.perf_counter()
         # only touch (and possibly build) the native lib if host tracing was
         # ever requested — keeps the default path free of g++ invocations
         if _host_tracing_requested:
@@ -231,6 +262,10 @@ class RecordEvent:
 
     def end(self):
         self._ann.__exit__(None, None, None)
+        from . import statistic
+        if statistic.ENABLED:
+            statistic.record_span(self.name,
+                                  time.perf_counter() - self._t0, "user")
         if self._pushed:
             # pop regardless of the current enabled state so the native
             # thread-local span stack stays balanced
