@@ -57,25 +57,20 @@ class GenerationConfig:
 
 # ------------------------------------------------------------- weight view
 def _mm(h, w):
-    """Matmul against a raw weight or a weight-only-quantized
-    ``(int8 values, per-channel scale)`` pair (nn.quant formulation).
+    """Matmul against a raw weight, a legacy ``(int8, scale)`` pair, or
+    a :class:`~paddle_tpu.ops.pallas.quant_matmul.QuantizedWeight`.
 
-    The quantized path issues a mixed-dtype dot (bf16 activations
-    against the int8 weight) with the per-output-channel scale applied
-    on the result.  Measured reality on the v5e (recorded in scratch
-    r3): the decode matmuls are not bandwidth-bound enough for int8
-    streaming to pay — XLA upconverts in-loop and the quantized decode
-    runs SLOWER than dense bf16 (a Pallas int8-tile kernel recovers
-    only ~11%).  weight_quant therefore buys model MEMORY (int8 halves
-    weight HBM; "int4" stores as int8 too — no nibble path — so it is
-    accuracy-lossier at the SAME footprint, kept for deploy-pipeline
-    parity) and reference parity (weight_only_linear_kernel.cu), not
-    throughput; bench honesty over marketing."""
-    if isinstance(w, tuple):
-        q, scale = w
-        out = jax.lax.dot_general(h, q, (((h.ndim - 1,), (0,)), ((), ())),
-                                  preferred_element_type=jnp.float32)
-        return (out * scale).astype(h.dtype)
+    The quantized path runs the Pallas weight-only GEMV kernel at
+    decode shapes (int8 tiles stream HBM->VMEM, dequant in-register,
+    per-channel scale fused on the f32 accumulator — the reference
+    weight_only_gemv.cu role); prefill-shaped calls and off-TPU
+    backends take the XLA dequant-into-matmul path inside
+    weight_only_matmul."""
+    from ..ops.pallas.quant_matmul import QuantizedWeight, weight_only_matmul
+    if isinstance(w, tuple):        # legacy (int8, scale) pair
+        w = QuantizedWeight(w[0], w[1], kind="int8")
+    if isinstance(w, QuantizedWeight):
+        return weight_only_matmul(h, w)
     return h @ w
 
 
@@ -86,17 +81,22 @@ _QUANT_KEYS = ("self_attn.q_proj.weight", "self_attn.k_proj.weight",
 
 
 def quantize_state(state, algo="weight_only_int8"):
-    """Replace every matmul weight in a generation state dict with its
-    (int8, scale) pair (embeddings stay dense: they are gathers, not
-    matmuls).  The reference analog is converting a deploy model through
-    weight_quantize before serving (python/paddle/nn/quant)."""
+    """Replace every matmul weight in a generation state dict with a
+    :class:`QuantizedWeight` (embeddings stay dense: they are gathers,
+    not matmuls).  int4 weights are nibble-packed [K/2, N] — a quarter
+    of the bf16 HBM footprint.  The reference analog is converting a
+    deploy model through weight_quantize before serving
+    (python/paddle/nn/quant)."""
     from ..nn.quant import weight_quantize
+    from ..ops.pallas.quant_matmul import QuantizedWeight
 
+    kind = "int4" if algo.endswith("int4") else "int8"
     out = dict(state)
     for name, arr in state.items():
         if name.endswith(_QUANT_KEYS) or name == "lm_head.weight":
             q, scale = weight_quantize.__op_body__(arr, algo)
-            out[name] = (q, scale)
+            out[name] = QuantizedWeight(q, scale, kind=kind,
+                                        k=arr.shape[0])
     return out
 
 

@@ -5,12 +5,15 @@ weight_dequantize, weight_only_linear, llm_int8_linear,
 apply_per_channel_scale backed by CUTLASS mixed-dtype GEMMs,
 paddle/phi/kernels/gpu/weight_only_linear_kernel.cu).
 
-TPU formulation: weights store as int8 (int4 as int8 values in [-7, 7]
-— the MXU has no nibble path, so the win is HBM: int8 halves weight
-traffic and XLA fuses the dequant (cast * scale) into the matmul
-prologue).  Per-channel (group_size=-1) or grouped (64/128) symmetric
-scales, matching the reference's quantization math; there is no `arch`
-parameter — there is one target.
+TPU formulation: int8 weights store as int8; int4 weights store
+nibble-PACKED [K/2, N] (row 2k in the low nibble — the reference's
+pack-along-K layout), so the HBM win is real: int8 halves and int4
+quarters weight traffic.  The decode-shaped matmul runs the Pallas
+weight-only GEMV kernel (ops/pallas/quant_matmul.py — the reference
+weight_only_gemv.cu role); elsewhere XLA fuses the dequant
+(cast * scale) into the matmul prologue.  Per-channel (group_size=-1)
+or grouped (64/128) symmetric scales, matching the reference's
+quantization math; there is no `arch` parameter — there is one target.
 """
 from __future__ import annotations
 
@@ -37,15 +40,20 @@ def _check(algo, group_size):
 
 @op
 def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
-    """[K, N] float weight -> (int8 quantized [K, N], scales).
+    """[K, N] float weight -> (quantized values, scales).
 
-    Per-channel: scales [N]; grouped: scales [K/group, N].  Symmetric
-    (no zero point), like the reference kernels.
+    int8: values [K, N] int8.  int4: values nibble-PACKED [K/2, N] int8
+    (reference weight_quantize's pack-along-K layout — row 2k in the
+    low nibble, row 2k+1 in the high).  Per-channel: scales [N];
+    grouped: scales [K/group, N].  Symmetric (no zero point), like the
+    reference kernels.
     """
     _check(algo, group_size)
     bound = _BOUNDS[algo]
     xf = x.astype(jnp.float32)
     k, n = xf.shape
+    if algo == "weight_only_int4" and k % 2:
+        raise ValueError(f"int4 packing needs even K, got {k}")
     if group_size == -1:
         absmax = jnp.max(jnp.abs(xf), axis=0)              # [N]
         scale = jnp.maximum(absmax / bound, 1e-8)
@@ -58,13 +66,21 @@ def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
         scale = jnp.maximum(absmax / bound, 1e-8)
         q = jnp.clip(jnp.round(g / scale[:, None, :]), -bound, bound)
         q = q.reshape(k, n)
-    return q.astype(jnp.int8), scale.astype(jnp.float32)
+    q = q.astype(jnp.int8)
+    if algo == "weight_only_int4":
+        from ..ops.pallas.quant_matmul import pack_int4
+        q = pack_int4(q)
+    return q, scale.astype(jnp.float32)
 
 
 @op
 def weight_dequantize(x, scale, algo="weight_only_int8", group_size=-1):
-    """Inverse of :func:`weight_quantize` (reference weight_dequantize)."""
+    """Inverse of :func:`weight_quantize` (reference weight_dequantize) —
+    for int4 the input is the packed [K/2, N] layout."""
     _check(algo, group_size)
+    if algo == "weight_only_int4":
+        from ..ops.pallas.quant_matmul import unpack_int4
+        x = unpack_int4(x)
     xf = x.astype(jnp.float32)
     k, n = xf.shape
     if group_size == -1:
@@ -78,11 +94,12 @@ def weight_dequantize(x, scale, algo="weight_only_int8", group_size=-1):
 @op
 def weight_only_linear(x, weight, bias=None, weight_scale=None,
                        weight_dtype="int8", arch=None, group_size=-1):
-    """x [.., K] @ dequant(weight [K, N]) + bias.
+    """x [.., K] @ dequant(weight) + bias (int4 weights arrive packed
+    [K/2, N], as :func:`weight_quantize` returns them).
 
-    The dequant is a cast+scale XLA fuses into the matmul read — the
-    stored int8 weight is what halves HBM traffic on the decode path
-    (reference weight_only_linear_kernel.cu's mixed-dtype GEMM role).
+    Per-channel scales route through the Pallas weight-only GEMV kernel
+    (reference weight_only_linear_kernel.cu's mixed-dtype GEMM role) at
+    decode shapes; grouped scales dequantize into the matmul prologue.
     """
     if weight_dtype not in ("int8", "int4"):
         raise ValueError(f"weight_dtype must be int8|int4, "
@@ -91,9 +108,17 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
         raise ValueError("weight_only_linear requires weight_scale")
     algo = "weight_only_int8" if weight_dtype == "int8" \
         else "weight_only_int4"
-    w = weight_dequantize.__op_body__(weight, weight_scale, algo,
-                                      group_size).astype(x.dtype)
-    out = x @ w
+    if group_size == -1:
+        from ..ops.pallas.quant_matmul import (QuantizedWeight,
+                                               weight_only_matmul)
+        k = weight.shape[0] * (2 if weight_dtype == "int4" else 1)
+        out = weight_only_matmul(
+            x, QuantizedWeight(weight, weight_scale, kind=weight_dtype,
+                               k=k))
+    else:
+        w = weight_dequantize.__op_body__(weight, weight_scale, algo,
+                                          group_size).astype(x.dtype)
+        out = x @ w
     if bias is not None:
         out = out + bias
     return out

@@ -31,8 +31,12 @@ def test_int8_roundtrip_error_bounded():
 def test_int4_range_and_groupwise():
     w = _w(k=128)
     q, scale = weight_quantize(w, algo="weight_only_int4", group_size=64)
-    qn = q.numpy()
-    assert qn.min() >= -7 and qn.max() <= 7
+    # int4 stores nibble-PACKED along K (reference layout): [K/2, N]
+    assert q.shape == [64, 32]
+    from paddle_tpu.ops.pallas.quant_matmul import unpack_int4
+    un = np.asarray(unpack_int4(q.numpy()))
+    assert un.shape == (128, 32)
+    assert un.min() >= -7 and un.max() <= 7
     assert scale.shape == [2, 32]
     back = weight_dequantize(q, scale, algo="weight_only_int4",
                              group_size=64)
