@@ -315,96 +315,105 @@ def bench_moe():
            "device": dev.device_kind, "loss": loss_val})
 
 
-def bench_decode():
-    """Serving-path rung: KV-cache decode tokens/s (VERDICT r1 item 9;
-    reference block_multi_head_attention_kernel.cu).  The shipped path
-    is the fused-XLA kv-head-major formulation; vs_baseline compares it
-    against the Pallas block-cache kernel (kept opt-in — see
-    ops/pallas/decode_attention.py for the measured tradeoff)."""
+def _decode_model():
+    """Shared decode/paged rung model (built fresh per rung so one
+    rung's failure cannot poison the other's state)."""
     import paddle_tpu as paddle
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
-    from paddle_tpu.models import generation as G
-    from paddle_tpu.ops.pallas import decode_attention as DA
 
     dev, on_tpu, _ = _env()
-    n = 1
     if on_tpu:
         cfg = LlamaConfig(
             vocab_size=32000, hidden_size=2048, intermediate_size=5632,
             num_hidden_layers=16, num_attention_heads=16,
             num_key_value_heads=16, max_position_embeddings=4096,
             dtype="bfloat16")
-        batch, prompt, new = 8, 128, 128
+        batch = 8
     else:
         cfg = LlamaConfig(vocab_size=256, hidden_size=128,
                           intermediate_size=256, num_hidden_layers=2,
                           num_attention_heads=4, num_key_value_heads=4,
                           max_position_embeddings=512)
-        batch, prompt, new = 2, 8, 8
+        batch = 2
 
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)
     model.eval()
+    return model, cfg, batch, dev, on_tpu
+
+
+def bench_decode():
+    """Serving-path rung: KV-cache decode tokens/s (VERDICT r1 item 9;
+    reference block_multi_head_attention_kernel.cu).  Emits the dense
+    bf16 number plus the weight_quant="int8" number — the rung VERDICT
+    r3 #1 gates on (quant decode must BEAT dense, not just match)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import generation as G
+
+    model, cfg, batch, dev, on_tpu = _decode_model()
+    prompt, new = (128, 128) if on_tpu else (8, 8)
     ids = paddle.to_tensor(
         np.random.randint(0, cfg.vocab_size, (batch, prompt)).astype(
             np.int64))
 
-    def run():
+    def run(**kw):
         G._FN_CACHE.clear()
-        out = G.generate(model, ids, max_new_tokens=new)
+        out = G.generate(model, ids, max_new_tokens=new, **kw)
         float(np.asarray(out._data[0, -1]))       # compile + fetch
         t0 = time.perf_counter()
-        out = G.generate(model, ids, max_new_tokens=new)
+        out = G.generate(model, ids, max_new_tokens=new, **kw)
         float(np.asarray(out._data[0, -1]))
         return batch * new / (time.perf_counter() - t0)
 
-    tps_default = run()
-    saved = DA.PALLAS_DECODE
-    DA.PALLAS_DECODE = True                        # opt-in kernel path
-    try:
-        tps_kernel = run()
-    finally:
-        DA.PALLAS_DECODE = saved
-    _emit("llama_decode_tokens_per_sec_per_chip", tps_default,
-          "tokens/s/chip",
-          tps_default / max(tps_kernel, 1e-9),
-          {"pallas_kernel_tokens_per_sec": round(tps_kernel, 2),
+    tps_dense = run()
+    tps_int8 = run(weight_quant="int8")
+    _emit("llama_decode_tokens_per_sec_per_chip", tps_dense,
+          "tokens/s/chip", tps_int8 / max(tps_dense, 1e-9),
+          {"int8_weight_quant_tokens_per_sec": round(tps_int8, 2),
            "batch": batch, "new_tokens": new, "device": dev.device_kind,
-           "note": "vs_baseline = shipped(XLA-fused)/pallas ratio"})
+           "note": "vs_baseline = int8-weight-quant/dense decode ratio "
+                   "(>1: the weight-only kernel wins)"})
 
-    # ---- ragged serving: paged (block-table) cache vs dense cache ----
-    # the scenario the reference's block_multi_head_attention exists
-    # for: one long context + short requests; dense pays batch*max_len
-    # everywhere, paged pays each sequence's own pages
-    if on_tpu:
-        prompt_r, new_r = 2048, 64
-        lens = np.array([2048, 160, 96, 224, 128, 192, 96, 160],
-                        np.int64)[:batch]
-        ids_r = paddle.to_tensor(np.random.randint(
-            0, cfg.vocab_size, (batch, prompt_r)).astype(np.int64))
-        lens_t = paddle.to_tensor(lens)
 
-        def run_ragged(**kw):
-            G._FN_CACHE.clear()
-            out = G.generate(m_, ids_r, max_new_tokens=new_r,
-                             lengths=lens_t, **kw)
-            float(np.asarray(out._data[0, -1]))
-            t0 = time.perf_counter()
-            out = G.generate(m_, ids_r, max_new_tokens=new_r,
-                             lengths=lens_t, **kw)
-            float(np.asarray(out._data[0, -1]))
-            return batch * new_r / (time.perf_counter() - t0)
+def bench_paged():
+    """Ragged serving: paged (block-table) cache vs dense cache — the
+    scenario the reference's block_multi_head_attention exists for: one
+    long context + short requests; dense pays batch*max_len everywhere,
+    paged pays each sequence's own pages.  Split from bench_decode so a
+    transport flake in one cannot take out the other (VERDICT r3 weak #1)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import generation as G
 
-        m_ = model
-        tps_dense = run_ragged()
-        tps_paged = run_ragged(cache="paged", page_size=128)
-        _emit("llama_paged_ragged_tokens_per_sec_per_chip", tps_paged,
-              "tokens/s/chip", tps_paged / max(tps_dense, 1e-9),
-              {"dense_tokens_per_sec": round(tps_dense, 2),
-               "batch": batch, "prompt": prompt_r, "new_tokens": new_r,
-               "lengths": lens.tolist(), "device": dev.device_kind,
-               "note": "vs_baseline = paged/dense on the ragged batch "
-                       "(>1: block-table cache wins)"})
+    if not _env()[1]:
+        return  # the ragged-batch scenario only means anything on the chip
+    model, cfg, batch, dev, on_tpu = _decode_model()
+    prompt_r, new_r = 2048, 64
+    lens = np.array([2048, 160, 96, 224, 128, 192, 96, 160],
+                    np.int64)[:batch]
+    ids_r = paddle.to_tensor(np.random.randint(
+        0, cfg.vocab_size, (batch, prompt_r)).astype(np.int64))
+    lens_t = paddle.to_tensor(lens)
+
+    def run_ragged(**kw):
+        G._FN_CACHE.clear()
+        out = G.generate(model, ids_r, max_new_tokens=new_r,
+                         lengths=lens_t, **kw)
+        float(np.asarray(out._data[0, -1]))
+        t0 = time.perf_counter()
+        out = G.generate(model, ids_r, max_new_tokens=new_r,
+                         lengths=lens_t, **kw)
+        float(np.asarray(out._data[0, -1]))
+        return batch * new_r / (time.perf_counter() - t0)
+
+    tps_dense = run_ragged()
+    tps_paged = run_ragged(cache="paged", page_size=128)
+    _emit("llama_paged_ragged_tokens_per_sec_per_chip", tps_paged,
+          "tokens/s/chip", tps_paged / max(tps_dense, 1e-9),
+          {"dense_tokens_per_sec": round(tps_dense, 2),
+           "batch": batch, "prompt": prompt_r, "new_tokens": new_r,
+           "lengths": lens.tolist(), "device": dev.device_kind,
+           "note": "vs_baseline = paged/dense on the ragged batch "
+                   "(>1: block-table cache wins)"})
 
 
 def bench_lenet():
@@ -474,12 +483,25 @@ def main():
     # live in the process; a subprocess instead would contend with the
     # parent's device session on the tunneled transport
     for fn in (bench_lenet, bench_llama, bench_resnet50, bench_bert,
-               bench_moe, bench_decode, bench_longctx):
-        try:
-            fn()
-        except Exception as e:  # keep the rest of the ladder running
-            _emit(fn.__name__ + "_error", 0.0, "error", 0.0,
-                  {"error": f"{type(e).__name__}: {e}"})
+               bench_moe, bench_decode, bench_paged, bench_longctx):
+        # one retry per rung: the tunneled transport flakes (~1/run in
+        # round 3 it ate the whole decode+paged rung — VERDICT r3 weak
+        # #1); a real failure reproduces, a transport hiccup does not
+        for attempt in (0, 1):
+            try:
+                fn()
+                break
+            except Exception as e:
+                if attempt == 0:
+                    print(json.dumps(
+                        {"retry": fn.__name__,
+                         "error": f"{type(e).__name__}: {e}"[:300]}),
+                        flush=True)
+                    gc.collect()
+                    time.sleep(5.0)
+                    continue
+                _emit(fn.__name__ + "_error", 0.0, "error", 0.0,
+                      {"error": f"{type(e).__name__}: {e}"})
         gc.collect()
 
 
