@@ -169,6 +169,53 @@ def _probe_pallas():
     return _PALLAS_OK
 
 
+_MASKED_STREAM_OK = None
+
+
+def _probe_masked_stream():
+    """Compile+run the STREAMED masked/biased kernels (fwd and grad)
+    once at tiny forced-stream shapes, so the long-seq masked dispatch
+    can trust them (their Mosaic compile happens at the caller's jit
+    compile, where failure is uncatchable)."""
+    global _MASKED_STREAM_OK
+    if _MASKED_STREAM_OK is None:
+        from . import flash_mask as FM
+
+        def smoke():
+            global _FORCE_STREAM
+            saved = _FORCE_STREAM
+            _FORCE_STREAM = True
+            try:
+                q = jnp.zeros((1, 256, 2, 64), jnp.bfloat16)
+                kv = jnp.zeros((1, 256, 1, 64), jnp.bfloat16)
+                vec = jnp.zeros((1, 1, 2, 256), jnp.int32)
+                bias = jnp.zeros((1, 1, 256, 256), jnp.float32)
+                sc = 0.125
+
+                def loss_m(q, k, v):
+                    return jnp.sum(FM.flash_mha_masked(
+                        jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                        jnp.swapaxes(v, 1, 2), vec, True, sc)
+                        .astype(jnp.float32))
+
+                jax.jit(jax.grad(loss_m, argnums=(0, 1, 2)))(
+                    q, kv, kv)[0].block_until_ready()
+
+                def loss_b(q, k, v, bias):
+                    return jnp.sum(FM.flash_mha_biased(
+                        jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                        jnp.swapaxes(v, 1, 2), bias, False, sc)
+                        .astype(jnp.float32))
+
+                jax.jit(jax.grad(loss_b, argnums=(0, 1, 2, 3)))(
+                    q, kv, kv, bias)[0].block_until_ready()
+            finally:
+                _FORCE_STREAM = saved
+
+        _MASKED_STREAM_OK = run_probe(smoke)
+    return _MASKED_STREAM_OK
+
+
 def _pad_len(s, mult=128):
     """Pad to a lane-tileable length: 128-multiples suffice for Mosaic
     (block sizes need not be powers of two — seq 384 runs unpadded with
@@ -221,24 +268,30 @@ def sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
             bias = am
 
     long_seq = max(q.shape[1], k.shape[1]) > _STREAM_SEQ
-    if shapes_ok and long_seq and (mask_vecs is not None
-                                   or bias is not None):
-        # the masked kernels hold full K/V (and Q/dO/O in bwd) in VMEM —
-        # past ~4k they exceed the Mosaic scoped-VMEM budget at the
-        # CALLER's compile time (uncatchable here); the chunked-XLA
-        # online-softmax path is O(S) memory at any length
-        return _xla_sdpa_streamed(q, k, v, is_causal, bias=bias,
-                                  mask_vecs=mask_vecs)
     if shapes_ok and (attn_mask is None or mask_vecs is not None
                       or bias is not None) and _probe_pallas():
-        try:
-            if mask_vecs is not None:
-                return _pallas_sdpa_masked(q, k, v, mask_vecs, is_causal)
-            if bias is not None:
-                return _pallas_sdpa_biased(q, k, v, bias, is_causal)
-            return _pallas_sdpa(q, k, v, is_causal)
-        except Exception:
-            _warn_fallback_once()
+        masked = mask_vecs is not None or bias is not None
+        # past _STREAM_SEQ the masked kernels switch to their streamed
+        # variants (inner-grid K/V iteration, VMEM independent of S);
+        # gate them behind their own compile probe so a Mosaic failure
+        # at the CALLER's jit-compile can't crash training
+        stream_ok = (not (masked and long_seq)) or _probe_masked_stream()
+        if stream_ok:
+            try:
+                if mask_vecs is not None:
+                    return _pallas_sdpa_masked(q, k, v, mask_vecs,
+                                               is_causal)
+                if bias is not None:
+                    return _pallas_sdpa_biased(q, k, v, bias, is_causal)
+                return _pallas_sdpa(q, k, v, is_causal)
+            except Exception:
+                _warn_fallback_once()
+    if shapes_ok and long_seq and (mask_vecs is not None
+                                   or bias is not None):
+        # masked long-seq with the kernels unavailable: the chunked-XLA
+        # online-softmax path keeps O(S) forward memory at any length
+        return _xla_sdpa_streamed(q, k, v, is_causal, bias=bias,
+                                  mask_vecs=mask_vecs)
     if attn_mask is None and flashmask is not None:
         # keep flashmask semantics on the fallback path (dense, O(S^2)).
         # Additive -1e9 (not bool -inf) keeps fully-masked rows finite;
@@ -260,11 +313,12 @@ def _xla_sdpa_streamed(q, k, v, is_causal, bias=None, mask_vecs=None,
                        chunk=512):
     """O(S)-memory masked attention in plain XLA: lax.scan over key
     chunks with the online-softmax recurrence.  The long-sequence
-    fallback for the MASKED kernels (flash_mask.py holds full K/V in
-    VMEM and exceeds the Mosaic scoped-VMEM budget past ~4k; the dense
-    [Sq, Sk] fallback explodes HBM instead).  Supports float bias
-    [B|1, H|1, Sq, Sk] and flashmask interval vecs [B|1, H|1, 2|4, Sk];
-    per-chunk slices keep every transient at [B, H, Sq, chunk]."""
+    masked fallback when the streamed Pallas kernels are unavailable.
+    Supports float bias [B|1, H|1, Sq, Sk] and flashmask interval vecs
+    [B|1, H|1, 2|4, Sk]; per-chunk slices keep every transient at
+    [B, H, Sq, chunk].  The step is jax.checkpoint-ed: without it the
+    scan saves per-chunk s/p residuals for backward — O(Sq*Sk) total,
+    the very blowup this path exists to avoid (advisor r3)."""
     qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32)   # [B, H, Sq, D]
     kh = jnp.swapaxes(k, 1, 2)
     vh = jnp.swapaxes(v, 1, 2)
@@ -326,7 +380,7 @@ def _xla_sdpa_streamed(q, k, v, is_causal, bias=None, mask_vecs=None,
     m0 = jnp.full((b, hq, sq), MASK_VAL, jnp.float32)
     l0 = jnp.zeros((b, hq, sq), jnp.float32)
     acc0 = jnp.zeros((b, hq, sq, d), jnp.float32)
-    (m, l, acc), _ = jax.lax.scan(step, (m0, l0, acc0),
+    (m, l, acc), _ = jax.lax.scan(jax.checkpoint(step), (m0, l0, acc0),
                                   jnp.arange(nc))
     row_ok = (m > MASK_VAL * 0.5) & (l > 0.0)
     out = jnp.where(row_ok[..., None],
@@ -546,6 +600,35 @@ def _stream_wanted(s):
     return _FORCE_STREAM or s > _STREAM_SEQ
 
 
+def causal_kv_clamp(block_q, block_k, ko, nk, causal):
+    """Clamp the kv-block grid index j for a q-block program: causally
+    invisible cells re-request the PREVIOUS block so Mosaic elides the
+    repeated DMA (pl.when skips compute, but NOT the fetch — without
+    the clamp the upper triangle costs ~2x K/V HBM traffic).  Shared by
+    every streamed-grid BlockSpec (plain/masked/biased, fwd/dq)."""
+    if not causal:
+        return lambda i, j: j
+
+    def f(i, j):
+        jmax = jnp.clip((i * block_q + block_q - 1 + ko) // block_k,
+                        0, nk - 1)
+        return jnp.minimum(j, jmax)
+    return f
+
+
+def causal_q_clamp(block_q, block_k, ko, nq, causal):
+    """Mirror clamp for a k-block program's q-side fetches (dkv grid):
+    cells below the k block's first visible q block re-request the
+    previous q/do/o/lse blocks."""
+    if not causal:
+        return lambda i, j: j
+
+    def f(i, j):
+        jmin = jnp.clip((i * block_k - ko) // block_q, 0, nq - 1)
+        return jnp.maximum(j, jmin)
+    return f
+
+
 def _fwd_kernel_stream(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
                        m_ref, l_ref, *, causal, sm_scale, sq_real,
                        sk_real, nk):
@@ -615,23 +698,11 @@ def _flash_fwd_stream(q, k, v, causal, sm_scale, block_q, block_k,
     g = h // hk
     sk = k.shape[2]
     nk = sk // block_k
+    jc = causal_kv_clamp(block_q, block_k, sk_real - sq_real, nk, causal)
     blk = pl.BlockSpec((None, None, block_q, d),
                        lambda b_, h_, i, j: (b_, h_, i, 0))
-    if causal:
-        # clamp j so causally-invisible cells re-request the previous
-        # block: Mosaic elides the repeated DMA (pl.when skips compute,
-        # but NOT the fetch — without the clamp the upper triangle costs
-        # ~2x K/V HBM traffic)
-        ko = sk_real - sq_real
-
-        def _kv_idx(b_, h_, i, j):
-            jmax = jnp.clip((i * block_q + block_q - 1 + ko) // block_k,
-                            0, nk - 1)
-            return (b_, h_ // g, jnp.minimum(j, jmax), 0)
-    else:
-        def _kv_idx(b_, h_, i, j):
-            return (b_, h_ // g, j, 0)
-    kv = pl.BlockSpec((None, None, block_k, d), _kv_idx)
+    kv = pl.BlockSpec((None, None, block_k, d),
+                      lambda b_, h_, i, j: (b_, h_ // g, jc(i, j), 0))
     out_specs = [blk]
     out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
     if need_lse:
@@ -771,21 +842,14 @@ def _flash_bwd_stream(q, k, v, out, lse, g, causal, sm_scale, block_q,
     nq = sq // block_q
     lse = jnp.broadcast_to(lse[..., None], (b, h, sq, NUM_LANES))
 
+    ko = sk_real - sq_real
+    jc = causal_kv_clamp(block_q, block_k, ko, nk, causal)
     blk_q4 = pl.BlockSpec((None, None, block_q, d),
                           lambda b_, h_, i, j: (b_, h_, i, 0))
     blk_l4 = pl.BlockSpec((None, None, block_q, NUM_LANES),
                           lambda b_, h_, i, j: (b_, h_, i, 0))
-    ko = sk_real - sq_real
-    if causal:
-        # DMA-elision clamp, see _flash_fwd_stream
-        def _kv_idx4(b_, h_, i, j):
-            jmax = jnp.clip((i * block_q + block_q - 1 + ko) // block_k,
-                            0, nk - 1)
-            return (b_, h_ // grp, jnp.minimum(j, jmax), 0)
-    else:
-        def _kv_idx4(b_, h_, i, j):
-            return (b_, h_ // grp, j, 0)
-    kv4 = pl.BlockSpec((None, None, block_k, d), _kv_idx4)
+    kv4 = pl.BlockSpec((None, None, block_k, d),
+                       lambda b_, h_, i, j: (b_, h_ // grp, jc(i, j), 0))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel_stream, causal=causal,
                           sm_scale=sm_scale, sq_real=sq_real,
@@ -803,19 +867,11 @@ def _flash_bwd_stream(q, k, v, out, lse, g, causal, sm_scale, block_q,
                           lambda b_, h_, i, j: (b_, h_, i, 0))
     kv_i4 = pl.BlockSpec((None, None, block_k, d),
                          lambda b_, h_, i, j: (b_, h_ // grp, i, 0))
-    if causal:
-        # mirror clamp on the q side: cells below the k-block's first
-        # visible q block re-request the previous q/do/o/lse blocks
-        def _q_clamp(j, i):
-            jmin = jnp.clip((i * block_k - ko) // block_q, 0, nq - 1)
-            return jnp.maximum(j, jmin)
-    else:
-        def _q_clamp(j, i):
-            return j
+    qc = causal_q_clamp(block_q, block_k, ko, nq, causal)
     q_j4 = pl.BlockSpec((None, None, block_q, d),
-                        lambda b_, h_, i, j: (b_, h_, _q_clamp(j, i), 0))
+                        lambda b_, h_, i, j: (b_, h_, qc(i, j), 0))
     l_j4 = pl.BlockSpec((None, None, block_q, NUM_LANES),
-                        lambda b_, h_, i, j: (b_, h_, _q_clamp(j, i), 0))
+                        lambda b_, h_, i, j: (b_, h_, qc(i, j), 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel_stream, causal=causal,
                           sm_scale=sm_scale, sq_real=sq_real,

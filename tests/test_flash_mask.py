@@ -15,10 +15,15 @@ rng = np.random.RandomState(0)
 B, H, S, D = 2, 2, 256, 64
 
 
-@pytest.fixture(autouse=True)
-def _interpret_mode():
+@pytest.fixture(autouse=True, params=["block", "stream"])
+def _interpret_mode(request):
+    """Every case runs twice: against the whole-K/V block kernels and
+    against the grid-streamed long-seq variants (VERDICT r3 #2) forced
+    on at these tiny shapes."""
     FM._INTERPRET = True
+    FA._FORCE_STREAM = request.param == "stream"
     yield
+    FA._FORCE_STREAM = False
     FM._INTERPRET = False
 
 
@@ -151,6 +156,34 @@ class TestFlashBias:
 
         g1 = jax.grad(loss_flash)(k)
         g2 = jax.grad(loss_ref)(k)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   atol=5e-4)
+
+    @pytest.mark.parametrize("bshape", [(1, "H"), ("B", 1), (1, 1),
+                                        ("B", "H")])
+    def test_dbias_broadcast_shapes(self, bshape):
+        """Every broadcast combo of the bias's leading dims: the
+        streamed dbias kernel reduces b/h in-kernel and its grid order
+        depends on WHICH dims broadcast (the (1, H) case caught a
+        non-consecutive accumulation-group bug)."""
+        q, k, v = _qkv()
+        bb = B if bshape[0] == "B" else 1
+        hb = H if bshape[1] == "H" else 1
+        bias = jnp.asarray(rng.randn(bb, hb, S, S).astype(np.float32)) * 0.1
+
+        def loss_flash(bias):
+            out = FM.flash_mha_biased(_bhsd(q), _bhsd(k), _bhsd(v), bias,
+                                      True, 1.0 / np.sqrt(D))
+            return jnp.sum(out ** 2)
+
+        def loss_ref(bias):
+            out = FA._xla_sdpa(q, k, v, attn_mask=jnp.broadcast_to(
+                bias, (B, H, S, S)), is_causal=True)
+            return jnp.sum(out ** 2)
+
+        g1 = jax.grad(loss_flash)(bias)
+        g2 = jax.grad(loss_ref)(bias)
+        assert g1.shape == bias.shape
         np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
                                    atol=5e-4)
 
