@@ -55,12 +55,14 @@ def build_mesh(n_devices=None, pp=1, dp=1, tp=1, devices=None):
 
 
 def default_axes(n):
-    """Factorize n into (pp, dp, tp) exercising every axis when possible."""
-    tp = 2 if n % 2 == 0 else 1
-    rem = n // tp
-    pp = 2 if rem % 2 == 0 else 1
-    dp = rem // pp
-    return pp, dp, tp
+    """Factorize n into the most BALANCED (pp, dp, tp) triple — every
+    axis exercised when possible (8 -> 2x2x2, 64 -> 4x4x4, the v5p-64
+    shape of BASELINE.json's north star)."""
+    pp = max(d for d in range(1, int(round(n ** (1 / 3))) + 1)
+             if n % d == 0)
+    rem = n // pp
+    tp = max(d for d in range(1, int(rem ** 0.5) + 1) if rem % d == 0)
+    return pp, rem // tp, tp
 
 
 # ------------------------------------------------------------ parameters
@@ -265,7 +267,7 @@ def _chunked_ce_sum(h, lab, head):
 
 
 def grad_1f1b(params, ids, config: LlamaConfig, mesh: Mesh, n_micro,
-              n_virtual=1, remat=True, sp=True):
+              n_virtual=1, remat=True, sp=True, zero_bubble=False):
     """(loss, grads) via the hand-scheduled 1F1B / interleaved pipeline
     (distributed/pipeline_schedules.py) instead of AD through the GPipe
     scan.  Embedding runs at stage 0, final-norm+head+CE at the last
@@ -305,7 +307,7 @@ def grad_1f1b(params, ids, config: LlamaConfig, mesh: Mesh, n_micro,
         stages = jax.tree_util.tree_map(lambda a: a[:, None], stages)
     loss, dstk, dfp, dlp = pipeline_1f1b(
         stage_fn, first_fn, last_fn, stages, fp, lp, aux, mesh,
-        n_virtual=n_virtual)
+        n_virtual=n_virtual, zero_bubble=zero_bubble)
     if n_virtual == 1:
         dstk = jax.tree_util.tree_map(lambda a: a[:, 0], dstk)
     grads = {"embed": dfp["embed"], "stages": dstk,
@@ -335,29 +337,32 @@ def init_adamw(params):
 def build_train_step(config: LlamaConfig, mesh: Mesh, lr=3e-4, wd=0.01,
                      n_micro=1, remat=True, sp=True, b1=0.9, b2=0.95,
                      eps=1e-8, grad_accum=1, schedule="gpipe",
-                     n_virtual=1):
+                     n_virtual=1, zero1=False):
     """Returns jitted (params, opt, ids) -> (loss, params, opt).
 
     schedule: "gpipe" = AD through the fill-drain scan (pipelining.py);
     "1f1b" = hand-scheduled 1F1B (pipeline_schedules.py) with bounded
-    in-flight residuals; n_virtual > 1 selects the interleaved/VPP
-    variant of 1f1b (params must come from setup(..., n_virtual=v)).
+    in-flight residuals; "zb" = 1F1B with the ZB-H1 deferred-dW unit
+    placement (zero_bubble=True, composes with VPP); n_virtual > 1
+    selects the interleaved/VPP variant (params must come from
+    setup(..., n_virtual=v)).
 
     grad_accum > 1 splits the batch into sequential chunks and averages
     their grads before ONE optimizer step (reference: gradient-merge
     pass / fleet accumulate_steps) — live activations stay bounded by
     one chunk, trading wall-clock for a larger effective batch."""
-    use_1f1b = schedule == "1f1b" and mesh.shape["pp"] > 1
+    use_1f1b = schedule in ("1f1b", "zb") and mesh.shape["pp"] > 1
     if n_virtual > 1 and not use_1f1b:
         raise ValueError(
             "n_virtual > 1 (interleaved/VPP) requires schedule='1f1b' "
-            f"and a pp axis > 1; got schedule={schedule!r}, "
+            f"or 'zb' and a pp axis > 1; got schedule={schedule!r}, "
             f"pp={mesh.shape['pp']}")
 
     def one_batch(params, ids):
         if use_1f1b:
             return grad_1f1b(params, ids, config, mesh, n_micro,
-                             n_virtual, remat, sp)
+                             n_virtual, remat, sp,
+                             zero_bubble=schedule == "zb")
         return jax.value_and_grad(loss_fn)(
             params, ids, config, mesh, n_micro, remat, sp)
 
@@ -386,22 +391,49 @@ def build_train_step(config: LlamaConfig, mesh: Mesh, lr=3e-4, wd=0.01,
         t = opt.step + 1
         tf = t.astype(jnp.float32)
 
-        def upd(p, g, m, v):
+        def upd(p, g, m, v, osh=None, psh=None):
             gf = g.astype(jnp.float32)
             m = b1 * m + (1 - b1) * gf
             v = b2 * v + (1 - b2) * jnp.square(gf)
+            if osh is not None:
+                # ZeRO-1: keep the fp32 state dp-sharded through the
+                # update (each dp rank updates only its slice; GSPMD
+                # shards the surrounding arithmetic to match)
+                m = jax.lax.with_sharding_constraint(m, osh)
+                v = jax.lax.with_sharding_constraint(v, osh)
             mhat = m / (1 - b1 ** tf)
             vhat = v / (1 - b2 ** tf)
             pf = p.astype(jnp.float32)
             pf = pf - lr * (mhat / (jnp.sqrt(vhat) + eps) + wd * pf)
-            return pf.astype(p.dtype), m, v
+            new_p = pf.astype(p.dtype)
+            if psh is not None:
+                # pin the updated param BACK to its own sharding: mixing
+                # dp-sharded m/v into the update would otherwise let
+                # GSPMD return dp-sharded params, violating the stage-1
+                # contract (params stay replicated over dp) and forcing
+                # a recompile + per-step all-gathers on the next call
+                new_p = jax.lax.with_sharding_constraint(new_p, psh)
+            return new_p, m, v
 
         flat_p, td = jax.tree_util.tree_flatten(params)
         flat_g = jax.tree_util.tree_leaves(grads)
         flat_m = jax.tree_util.tree_leaves(opt.m)
         flat_v = jax.tree_util.tree_leaves(opt.v)
-        out = [upd(p, g, m, v) for p, g, m, v
-               in zip(flat_p, flat_g, flat_m, flat_v)]
+        if zero1:
+            flat_osh = jax.tree_util.tree_leaves(
+                zero1_shardings(params, mesh, n_virtual))
+            psh_tree = param_shardings(mesh, n_virtual)
+            flat_psh = [
+                NamedSharding(mesh, P(*(list(sh.spec)
+                                        + [None] * (p.ndim
+                                                    - len(sh.spec)))))
+                for p, sh in zip(
+                    flat_p, jax.tree_util.tree_leaves(psh_tree))]
+        else:
+            flat_osh = [None] * len(flat_p)
+            flat_psh = [None] * len(flat_p)
+        out = [upd(p, g, m, v, osh, psh) for p, g, m, v, osh, psh
+               in zip(flat_p, flat_g, flat_m, flat_v, flat_osh, flat_psh)]
         new_p = jax.tree_util.tree_unflatten(td, [o[0] for o in out])
         new_m = jax.tree_util.tree_unflatten(td, [o[1] for o in out])
         new_v = jax.tree_util.tree_unflatten(td, [o[2] for o in out])
@@ -410,12 +442,41 @@ def build_train_step(config: LlamaConfig, mesh: Mesh, lr=3e-4, wd=0.01,
     return jax.jit(step, donate_argnums=(0, 1))
 
 
+def zero1_shardings(params, mesh, n_virtual=1):
+    """ZeRO-1 (sharding stage 1, reference fleet DygraphShardingOptimizer):
+    optimizer-state shardings = the param sharding with the first
+    dp-divisible unsharded axis re-sharded over 'dp', so each dp rank
+    holds 1/dp of the fp32 m/v state.  Params/grads stay dp-replicated —
+    GSPMD inserts the gather on read, which is exactly stage 1."""
+    base = param_shardings(mesh, n_virtual)
+    dp = mesh.shape["dp"]
+
+    def one(p, sh):
+        spec = list(sh.spec) + [None] * (p.ndim - len(sh.spec))
+        if dp > 1:
+            for ax in range(p.ndim):
+                if spec[ax] is None and p.shape[ax] % dp == 0:
+                    spec[ax] = "dp"
+                    break
+        return NamedSharding(mesh, P(*spec))
+
+    return jax.tree_util.tree_map(one, params, base)
+
+
 def setup(config: LlamaConfig, mesh: Mesh, seed=0, dtype=jnp.float32,
-          n_virtual=1):
-    """Init + place params and optimizer state on the mesh."""
+          n_virtual=1, zero1=False):
+    """Init + place params and optimizer state on the mesh.
+    zero1=True places AdamW m/v dp-sharded (pair with
+    build_train_step(zero1=True))."""
     params = init_params(config, mesh.shape["pp"], jax.random.key(seed),
                          dtype, n_virtual)
     sh = param_shardings(mesh, n_virtual)
     params = jax.tree_util.tree_map(jax.device_put, params, sh)
     opt = init_adamw(params)
+    if zero1:
+        osh = zero1_shardings(params, mesh, n_virtual)
+        opt = AdamWState(
+            opt.step,
+            jax.tree_util.tree_map(jax.device_put, opt.m, osh),
+            jax.tree_util.tree_map(jax.device_put, opt.v, osh))
     return params, opt

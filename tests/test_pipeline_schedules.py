@@ -358,8 +358,87 @@ class TestZeroBubble:
             assert count(g1, u) == count(gz, u) == S * m
 
     @needs8
+    @pytest.mark.parametrize("S,v,m", [(2, 2, 4), (2, 3, 6)])
+    def test_zero_bubble_composes_with_vpp(self, S, v, m):
+        """VERDICT r3 #5: the v == 1 restriction is lifted — ZB-H1 under
+        interleaved VPP still matches sequential AD exactly."""
+        layers, fp, lp, aux = _mlp_setup(S, v, m, mb=2)
+        stk = stack_stage_params(layers, S, v)
+        mesh = Mesh(np.asarray(jax.devices()[:S]), ("pp",))
+        lz, dzs, dzf, dzl = jax.jit(
+            lambda stk, fp, lp, aux: pipeline_1f1b(
+                _stage_fn, _first_fn, _last_fn, stk, fp, lp, aux, mesh,
+                n_virtual=v, zero_bubble=True))(stk, fp, lp, aux)
+        ref_l, (ref_dl, ref_dfp, ref_dlp) = _reference(layers, fp, lp, aux)
+        np.testing.assert_allclose(float(lz), float(ref_l), rtol=2e-5)
+        got = [np.asarray(l) for l in jax.tree_util.tree_leaves(dzs)]
+        exp = stack_stage_params(ref_dl, S, v)
+        for a, b in zip(got, jax.tree_util.tree_leaves(exp)):
+            np.testing.assert_allclose(a, np.asarray(b), atol=2e-4)
+        np.testing.assert_allclose(np.asarray(dzf["embed"]),
+                                   np.asarray(ref_dfp["embed"]), atol=2e-4)
+        np.testing.assert_allclose(np.asarray(dzl["head"]),
+                                   np.asarray(ref_dlp["head"]), atol=2e-4)
+
+    @needs8
+    def test_zero_bubble_no_forward_recompute_in_drain(self):
+        """VERDICT r3 #5: the deferred-dW unit replays the stashed
+        pullback — the DRAIN phase's program must contain exactly as
+        many stage forwards as plain 1F1B's drain (the bwd unit's vjp),
+        not one more (the old recompute).  The stage's tanh only
+        appears in FORWARD traces (its vjp reuses the saved output), so
+        counting tanh eqns in the last scan's body is a forward
+        counter."""
+        S, m = 4, 6
+        layers, fp, lp, aux = _mlp_setup(S, 1, m, mb=2)
+        stk = stack_stage_params(layers, S, 1)
+        mesh = Mesh(np.asarray(jax.devices()[:S]), ("pp",))
+
+        def inner_jaxprs(eqn):
+            out = []
+            for v_ in eqn.params.values():
+                if hasattr(v_, "eqns"):                    # raw Jaxpr
+                    out.append(v_)
+                elif hasattr(v_, "jaxpr") and hasattr(v_.jaxpr, "eqns"):
+                    out.append(v_.jaxpr)                   # ClosedJaxpr
+            return out
+
+        def scans_in(jaxpr, out):
+            for eqn in jaxpr.eqns:
+                if eqn.primitive.name == "scan":
+                    out.append(eqn.params["jaxpr"].jaxpr)
+                    continue          # only OUTERMOST scans per level
+                for inner in inner_jaxprs(eqn):
+                    scans_in(inner, out)
+            return out
+
+        def count_prim(jaxpr, name):
+            n = 0
+            for eqn in jaxpr.eqns:
+                if eqn.primitive.name == name:
+                    n += 1
+                for inner in inner_jaxprs(eqn):
+                    n += count_prim(inner, name)
+            return n
+
+        def drain_tanhs(zero_bubble):
+            jx = jax.make_jaxpr(
+                lambda stk, fp, lp, aux: pipeline_1f1b(
+                    _stage_fn, _first_fn, _last_fn, stk, fp, lp, aux,
+                    mesh, zero_bubble=zero_bubble))(stk, fp, lp, aux)
+            scans = scans_in(jx.jaxpr, [])
+            # top-level phases are the OUTERMOST scans; the drain phase
+            # is the last one
+            assert scans, "no scans found"
+            return count_prim(scans[-1], "tanh")
+
+        assert drain_tanhs(True) == drain_tanhs(False)
+
+    @needs8
     @pytest.mark.parametrize("S,m", [(4, 4), (2, 5)])
     def test_zero_bubble_matches_1f1b_grads(self, S, m):
+        """Bit-parity with plain 1F1B — including m % S != 0, the case
+        that stresses the deferred-stash ring indexing."""
         layers, fp, lp, aux = _mlp_setup(S, 1, m, mb=2)
         stk = stack_stage_params(layers, S, 1)
         mesh = Mesh(np.asarray(jax.devices()[:S]), ("pp",))
