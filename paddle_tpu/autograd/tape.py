@@ -85,7 +85,8 @@ class GradNode:
     graph must not chase the live ``_grad_node`` (it may point *forward*).
     """
 
-    __slots__ = ("id", "name", "vjp_fn", "inputs", "out_avals")
+    __slots__ = ("id", "name", "vjp_fn", "inputs", "out_avals",
+                 "raw_vjp", "out_treedef")
 
     def __init__(self, name: str, vjp_fn: Callable, inputs: Sequence[Any],
                  out_avals: Sequence[Any]):
@@ -95,6 +96,8 @@ class GradNode:
         self.vjp_fn = vjp_fn
         self.inputs = [(t, t._grad_node, t._out_index) for t in inputs]
         self.out_avals = list(out_avals)  # jax.ShapeDtypeStruct per output
+        self.raw_vjp = None        # tree_util.Partial when fusable
+        self.out_treedef = None
 
     def __repr__(self):
         return f"<GradNode {self.name}#{self.id}>"
@@ -105,6 +108,162 @@ def _zeros_like_aval(aval):
         import numpy as np
         return np.zeros(aval.shape, jax.dtypes.float0)
     return jnp.zeros(aval.shape, aval.dtype)
+
+
+# ------------------------------------------------------- fused backward
+# One dispatch per GradNode is the dygraph tax on a tunneled transport
+# (~0.5 ms each).  For the common case — every node carries a cached-jit
+# vjp Partial, no hooks, plain .grad accumulation — the WHOLE reverse
+# sweep retraces into one jitted executable, cached by the tape's
+# structural signature (the graph repeats every step in a training loop).
+_FUSED_BW_CACHE: dict = {}
+_FUSED_BW_MAX = 128
+FUSED_BACKWARD = True
+
+
+def _try_fused_backward(tensors, grad_tensors, retain_graph):
+    """Returns True when the sweep ran fused; False -> caller runs the
+    per-node path."""
+    from jax.tree_util import tree_flatten, tree_unflatten
+
+    # ---- plan: walk the graph symbolically (no vjp execution) --------
+    plan_nodes = []            # GradNode, reverse-topo order
+    nodes: dict[int, GradNode] = {}
+    seeds = []                 # (node, out_index, seed_array)
+
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient:
+            continue
+        if isinstance(t._data, jax.core.Tracer):
+            return False       # inside an outer trace: per-node path
+        node = t._grad_node
+        if node is None:
+            return False       # direct-leaf seed: per-node path handles
+        if g is None:
+            if t.size != 1:
+                return False   # error path: per-node code raises it
+            g = jnp.ones(t._data.shape, t._data.dtype)
+        else:
+            g = g._data if hasattr(g, "_data") else jnp.asarray(g)
+        seeds.append((node, t._out_index, g))
+        nodes[node.id] = node
+
+    if not seeds:
+        return False
+    order: list[int] = []
+    walk = dict(nodes)
+    while walk:
+        nid = max(walk)
+        node = walk.pop(nid)
+        if node.raw_vjp is None or node.vjp_fn is _used_vjp:
+            return False       # hooks / non-cached vjp / reused graph
+        plan_nodes.append(node)
+        order.append(nid)
+        for (t, prod, _pi) in node.inputs:
+            if t is not None and not t.stop_gradient and prod is not None:
+                walk[prod.id] = prod
+
+    # leaves in deterministic discovery order
+    leaves = []                # Tensor objects
+    leaf_slot: dict[int, int] = {}
+    for node in plan_nodes:
+        for (t, prod, _pi) in node.inputs:
+            if t is not None and not t.stop_gradient and prod is None \
+                    and id(t) not in leaf_slot:
+                leaf_slot[id(t)] = len(leaves)
+                leaves.append(t)
+
+    id2pos = {nid: i for i, nid in enumerate(order)}
+
+    # ---- signature + dynamic inputs ----------------------------------
+    sig_parts = []
+    res_leaves_all = []        # flat residual leaves, per node
+    res_trees = []
+    for node in plan_nodes:
+        rl, rt = tree_flatten(node.raw_vjp)
+        res_leaves_all.append(tuple(rl))
+        res_trees.append(rt)
+        links = tuple(
+            ("x",) if t is None or t.stop_gradient else
+            (("l", leaf_slot[id(t)]) if prod is None
+             else ("n", id2pos[prod.id], pi))
+            for (t, prod, pi) in node.inputs)
+        sig_parts.append((
+            node.name, rt, node.out_treedef,
+            tuple((tuple(a.shape), str(a.dtype)) for a in node.out_avals),
+            tuple((tuple(l.shape), str(l.dtype)) for l in rl),
+            links))
+    sig = (tuple(sig_parts),
+           tuple((id2pos[n.id], oi, tuple(g.shape), str(g.dtype))
+                 for n, oi, g in seeds),
+           len(leaves))
+
+    leaf_avals = tuple(
+        (tuple(t._data.shape), str(t._data.dtype)) for t in leaves)
+    sig = sig + (leaf_avals,)
+    fn = _FUSED_BW_CACHE.get(sig)
+    if fn is None:
+        plan_meta = [(list(node.out_avals), tree, node.out_treedef,
+                      links)
+                     for node, tree, links in zip(
+                         plan_nodes, res_trees,
+                         [sp[-1] for sp in sig_parts])]
+        seed_meta = [(id2pos[n.id], oi) for n, oi, _g in seeds]
+        n_leaves = len(leaves)
+
+        def fused(all_res, seed_vals):
+            from ..ops.registry import _apply_cached_vjp
+
+            pend = [[None] * len(m[0]) for m in plan_meta]
+            leaf_out = [None] * n_leaves
+
+            def add(slot, g):
+                if g is None:
+                    return
+                kind = slot[0]
+                if kind == "l":
+                    i = slot[1]
+                    leaf_out[i] = g if leaf_out[i] is None \
+                        else leaf_out[i] + g
+                elif kind == "n":
+                    _, pos, oi = slot
+                    pend[pos][oi] = g if pend[pos][oi] is None \
+                        else pend[pos][oi] + g
+
+            for (pos, oi), g in zip(seed_meta, seed_vals):
+                pend[pos][oi] = g if pend[pos][oi] is None \
+                    else pend[pos][oi] + g
+
+            for pos, (avals, rtree, otree, links) in enumerate(plan_meta):
+                cots = tuple(
+                    c if c is not None else _zeros_like_aval(a)
+                    for c, a in zip(pend[pos], avals))
+                raw = tree_unflatten(rtree, list(all_res[pos]))
+                in_cots = _apply_cached_vjp(
+                    raw, tree_unflatten(otree, list(cots)))
+                for slot, g in zip(links, in_cots):
+                    if slot[0] != "x":
+                        add(slot, g)
+            return [g if g is not None else jnp.zeros(s, d)
+                    for g, (s, d) in zip(leaf_out, leaf_avals)]
+
+        fn = jax.jit(fused)
+        if len(_FUSED_BW_CACHE) >= _FUSED_BW_MAX:
+            _FUSED_BW_CACHE.pop(next(iter(_FUSED_BW_CACHE)))
+        _FUSED_BW_CACHE[sig] = fn
+
+    try:
+        grads = fn(tuple(res_leaves_all), tuple(g for _n, _oi, g in seeds))
+    except Exception:
+        return False
+    for t, g in zip(leaves, grads):
+        t._grad = g if t._grad is None else t._grad + g
+    if not retain_graph:
+        for node in plan_nodes:
+            node.vjp_fn = _used_vjp
+            node.raw_vjp = None
+            node.inputs = []
+    return True
 
 
 def backward(tensors, grad_tensors=None, retain_graph=False, _sink=None,
@@ -127,6 +286,10 @@ def backward(tensors, grad_tensors=None, retain_graph=False, _sink=None,
         grad_tensors = [None] * len(tensors)
     elif isinstance(grad_tensors, Tensor) or not isinstance(grad_tensors, (list, tuple)):
         grad_tensors = [grad_tensors]
+
+    if (FUSED_BACKWARD and _sink is None
+            and _try_fused_backward(tensors, grad_tensors, retain_graph)):
+        return
 
     # node id -> list of output cotangents (lazily filled)
     pending: dict[int, list] = {}

@@ -207,7 +207,8 @@ class Tensor:
             return orig(tuple(cots))
 
         node.vjp_fn = hooked
-        return hook
+        node.raw_vjp = None   # python hook: opt this graph out of the
+        return hook           # fused-backward replay (tape.py)
 
     # ----------------------------------------------------------- rebinding
     def _rebind_(self, other: "Tensor"):

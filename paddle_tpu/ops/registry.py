@@ -378,6 +378,12 @@ def _record_node(opname, out, raw_vjp, diff_tensors, jitted_vjp=False):
             return apply_vjp(cots)
 
     node = tape.GradNode(opname, vjp_fn, diff_tensors, out_avals)
+    if jitted_vjp and hooks is None:
+        # expose the raw vjp Partial + treedef for the fused-backward
+        # replay (tape._try_fused_backward): the whole reverse sweep
+        # retraces into ONE executable instead of one dispatch per node
+        node.raw_vjp = raw_vjp
+        node.out_treedef = out_treedef
     return _wrap_outputs(opname, out, node=node)
 
 
