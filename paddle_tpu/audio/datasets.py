@@ -1,20 +1,146 @@
-"""paddle.audio.datasets (reference: python/paddle/audio/datasets/{tess,
-esc50}.py).  Zero-egress environment: constructors raise with guidance."""
+"""paddle.audio.datasets — TESS + ESC50 over a LOCAL pre-extracted
+archive dir (reference: python/paddle/audio/datasets/{tess,esc50,
+dataset}.py; this is a zero-egress environment, so `data_dir` replaces
+the reference's DATA_HOME download — same layout, same folds, same
+label lists, same feat_type pipeline)."""
 from __future__ import annotations
 
-__all__ = ["TESS", "ESC50"]
+import os
+
+__all__ = ["TESS", "ESC50", "AudioClassificationDataset"]
 
 
-def _gated(name, url_hint):
-    class _DS:
-        def __init__(self, *a, **k):
-            raise NotImplementedError(
-                f"{name} requires downloading {url_hint}; there is no "
-                "network egress here — pre-extract the archive and wrap it "
-                "with paddle.io.Dataset")
-    _DS.__name__ = name
-    return _DS
+class AudioClassificationDataset:
+    """Base: (waveform-or-feature, label) records (reference
+    audio/datasets/dataset.py AudioClassificationDataset)."""
+
+    _FEATS = ("raw", "spectrogram", "melspectrogram",
+              "logmelspectrogram", "mfcc")
+
+    def __init__(self, files, labels, feat_type="raw", sample_rate=None,
+                 **feat_config):
+        if feat_type not in self._FEATS:
+            raise RuntimeError(f"Unknown feat_type: {feat_type}, it must "
+                               f"be one in {list(self._FEATS)}")
+        self.files = files
+        self.labels = labels
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self.feat_config = feat_config
+
+    def _feat_layer(self, sr):
+        # one feature layer per sample rate: the mel filterbank / DCT
+        # matrices are sr-dependent and expensive to rebuild per item
+        cache = self.__dict__.setdefault("_feat_layers", {})
+        layer = cache.get(sr)
+        if layer is None:
+            from . import features
+            cls = {"spectrogram": features.Spectrogram,
+                   "melspectrogram": features.MelSpectrogram,
+                   "logmelspectrogram": features.LogMelSpectrogram,
+                   "mfcc": features.MFCC}[self.feat_type]
+            kw = dict(self.feat_config)
+            if self.feat_type != "spectrogram":
+                kw.setdefault("sr", sr)
+            layer = cache[sr] = cls(**kw)
+        return layer
+
+    def __getitem__(self, idx):
+        from .. import to_tensor
+        from . import load
+
+        waveform, sr = load(self.files[idx])
+        self.sample_rate = sr
+        wav = to_tensor(waveform, dtype="float32")
+        if len(wav.shape) == 2:
+            wav = wav[0]
+        if self.feat_type == "raw":
+            return wav, self.labels[idx]
+        feat = self._feat_layer(sr)(wav.unsqueeze(0))
+        return feat[0], self.labels[idx]
+
+    def __len__(self):
+        return len(self.files)
 
 
-TESS = _gated("TESS", "the Toronto emotional speech set archive")
-ESC50 = _gated("ESC50", "the ESC-50 environmental sound archive")
+def _wav_walk(root):
+    out = []
+    for dirpath, _, files in sorted(os.walk(root)):
+        for f in sorted(files):
+            if f.endswith(".wav"):
+                out.append(os.path.join(dirpath, f))
+    return out
+
+
+def _need_dir(data_dir, name, hint):
+    if data_dir is None or not os.path.isdir(data_dir):
+        raise NotImplementedError(
+            f"{name} requires downloading {hint}; there is no network "
+            f"egress here — pre-extract the archive and pass "
+            f"data_dir=<extracted dir>")
+    return data_dir
+
+
+class TESS(AudioClassificationDataset):
+    """Toronto emotional speech set (reference audio/datasets/tess.py):
+    2800 wavs named <speaker>_<word>_<emotion>.wav; labels from the
+    filename, deterministic interleaved folds."""
+
+    label_list = ["angry", "disgust", "fear", "happy", "neutral",
+                  "ps", "sad"]
+    audio_path = "TESS_Toronto_emotional_speech_set"
+
+    def __init__(self, mode="train", n_folds=5, split=1, feat_type="raw",
+                 data_dir=None, **kwargs):
+        assert isinstance(n_folds, int) and n_folds >= 1, n_folds
+        assert split in range(1, n_folds + 1), (split, n_folds)
+        data_dir = _need_dir(data_dir, "TESS",
+                             "the Toronto emotional speech set archive")
+        sub = os.path.join(data_dir, self.audio_path)
+        wav_files = _wav_walk(sub if os.path.isdir(sub) else data_dir)
+        if not wav_files:
+            raise RuntimeError(f"no .wav files under {data_dir}")
+        files, labels = [], []
+        for idx, path in enumerate(wav_files):
+            emotion = os.path.basename(path)[:-4].split("_")[-1].lower()
+            if emotion not in self.label_list:
+                continue
+            fold = idx % n_folds + 1
+            keep = fold != split if mode == "train" else fold == split
+            if keep:
+                files.append(path)
+                labels.append(self.label_list.index(emotion))
+        super().__init__(files, labels, feat_type, **kwargs)
+
+
+class ESC50(AudioClassificationDataset):
+    """ESC-50 environmental sounds (reference audio/datasets/esc50.py):
+    meta/esc50.csv assigns each of 2000 wavs a fold and target."""
+
+    meta = os.path.join("meta", "esc50.csv")
+    audio_path = "audio"
+    prefix = "ESC-50-master"
+
+    def __init__(self, mode="train", split=1, feat_type="raw",
+                 data_dir=None, **kwargs):
+        assert split in range(1, 6), split
+        data_dir = _need_dir(data_dir, "ESC50",
+                             "the ESC-50 environmental sound archive")
+        base = data_dir
+        if os.path.isdir(os.path.join(data_dir, self.prefix)):
+            base = os.path.join(data_dir, self.prefix)
+        meta_path = os.path.join(base, self.meta)
+        if not os.path.isfile(meta_path):
+            raise RuntimeError(f"missing {meta_path}")
+        files, labels = [], []
+        with open(meta_path) as rf:
+            for line in rf.readlines()[1:]:
+                parts = line.strip().split(",")
+                fname, fold, target = parts[0], int(parts[1]), \
+                    int(parts[2])
+                keep = fold != split if mode == "train" else fold == split
+                if keep:
+                    files.append(os.path.join(base, self.audio_path,
+                                              fname))
+                    labels.append(target)
+        super().__init__(files, labels, feat_type, **kwargs)

@@ -209,24 +209,98 @@ class ImageFolder(Dataset):
         return len(self.samples)
 
 
+def _need_local(path, name, what):
+    if path is None or not os.path.exists(path):
+        raise NotImplementedError(
+            f"{name} requires downloading {what}; there is no network "
+            f"egress here — pre-download it and pass the local path")
+    return path
+
+
 class Flowers(Dataset):
-    """Flowers-102 (reference vision/datasets/flowers.py).  Zero-egress:
-    requires pre-downloaded files."""
+    """Flowers-102 over the three LOCAL archive files (reference
+    vision/datasets/flowers.py — same tar/mat layout, same
+    MODE_FLAG_MAP split semantics; `download` is accepted for API
+    parity but files must already exist)."""
+
+    MODE_FLAG_MAP = {"train": "tstid", "test": "trnid", "valid": "valid"}
 
     def __init__(self, data_file=None, label_file=None, setid_file=None,
                  mode="train", transform=None, download=True, backend=None):
-        raise NotImplementedError(
-            "Flowers needs its three archive files; there is no download "
-            "in this environment — place them locally and load with "
-            "DatasetFolder, or use FakeImageNet for synthetic data")
+        import tarfile
+
+        import scipy.io as scio
+
+        assert mode.lower() in self.MODE_FLAG_MAP, mode
+        flag = self.MODE_FLAG_MAP[mode.lower()]
+        data_file = _need_local(data_file, "Flowers",
+                                "the 102flowers.tgz image archive")
+        label_file = _need_local(label_file, "Flowers",
+                                 "imagelabels.mat")
+        setid_file = _need_local(setid_file, "Flowers", "setid.mat")
+        self.transform = transform
+        self._tar = tarfile.open(data_file)
+        self._names = {os.path.basename(m.name): m
+                       for m in self._tar.getmembers()
+                       if m.name.endswith(".jpg")}
+        self.labels = scio.loadmat(label_file)["labels"][0]
+        self.indexes = scio.loadmat(setid_file)[flag][0]
+
+    def __getitem__(self, idx):
+        from PIL import Image
+
+        index = int(self.indexes[idx])
+        label = int(self.labels[index - 1])
+        member = self._names[f"image_{index:05d}.jpg"]
+        img = Image.open(self._tar.extractfile(member)).convert("RGB")
+        img = np.asarray(img)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([label])
+
+    def __len__(self):
+        return len(self.indexes)
 
 
 class VOC2012(Dataset):
-    """VOC2012 segmentation (reference vision/datasets/voc2012.py).
-    Zero-egress: requires a pre-downloaded archive."""
+    """VOC2012 segmentation over a LOCAL archive (reference
+    vision/datasets/voc2012.py: members from the tar; mode selects
+    ImageSets/Segmentation/{train,val,trainval}.txt)."""
+
+    MODE_FLAG_MAP = {"train": "trainval", "test": "train", "valid": "val"}
 
     def __init__(self, data_file=None, mode="train", transform=None,
                  download=True, backend=None):
-        raise NotImplementedError(
-            "VOC2012 needs its archive; there is no download in this "
-            "environment — extract it and load with DatasetFolder")
+        import tarfile
+
+        assert mode.lower() in self.MODE_FLAG_MAP, mode
+        flag = self.MODE_FLAG_MAP[mode.lower()]
+        data_file = _need_local(data_file, "VOC2012",
+                                "the VOCtrainval archive")
+        self.transform = transform
+        self._tar = tarfile.open(data_file)
+        members = {m.name: m for m in self._tar.getmembers()}
+        list_member = next(
+            m for n, m in members.items()
+            if n.endswith(f"ImageSets/Segmentation/{flag}.txt"))
+        base = list_member.name.rsplit("ImageSets/", 1)[0]
+        names = self._tar.extractfile(list_member).read().decode() \
+            .split()
+        self._pairs = [
+            (members[f"{base}JPEGImages/{n}.jpg"],
+             members[f"{base}SegmentationClass/{n}.png"])
+            for n in names]
+
+    def __getitem__(self, idx):
+        from PIL import Image
+
+        im, lm = self._pairs[idx]
+        img = np.asarray(Image.open(self._tar.extractfile(im))
+                         .convert("RGB"))
+        label = np.asarray(Image.open(self._tar.extractfile(lm)))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self._pairs)
