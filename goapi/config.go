@@ -1,0 +1,53 @@
+// Package paddle wraps the paddle_tpu C inference ABI for Go callers.
+//
+// Reference surface: paddle/fluid/inference/goapi/config.go — the cgo
+// wrapper over the capi_exp PD_Config family.  Build requirements: a Go
+// toolchain and libpaddle_tpu_infer.so (make -C ../csrc inference);
+// point CGO_LDFLAGS at the build dir, e.g.
+//
+//	CGO_CFLAGS="-I../csrc" CGO_LDFLAGS="-L../csrc -lpaddle_tpu_infer" go test ./...
+package paddle
+
+// #cgo CFLAGS: -I${SRCDIR}/../csrc
+// #cgo LDFLAGS: -L${SRCDIR}/../csrc -lpaddle_tpu_infer -Wl,-rpath,${SRCDIR}/../csrc
+// #include <stdlib.h>
+// #include "pd_inference_c.h"
+import "C"
+
+import (
+	"runtime"
+	"unsafe"
+)
+
+// Config configures a Predictor (reference goapi Config).  A Config is
+// consumed by NewPredictor — do not reuse it afterwards.
+type Config struct {
+	c *C.PD_Config
+}
+
+// NewConfig creates an empty config.
+func NewConfig() *Config {
+	cfg := &Config{c: C.PD_ConfigCreate()}
+	runtime.SetFinalizer(cfg, func(f *Config) {
+		if f.c != nil {
+			C.PD_ConfigDestroy(f.c)
+			f.c = nil
+		}
+	})
+	return cfg
+}
+
+// SetModel points the config at a jit.save'd model directory (the
+// paramsPath may be empty — paddle_tpu bundles params with the model).
+func (cfg *Config) SetModel(modelPath, paramsPath string) {
+	mp := C.CString(modelPath)
+	pp := C.CString(paramsPath)
+	defer C.free(unsafe.Pointer(mp))
+	defer C.free(unsafe.Pointer(pp))
+	C.PD_ConfigSetModel(cfg.c, mp, pp)
+}
+
+// ModelDir returns the configured model path.
+func (cfg *Config) ModelDir() string {
+	return C.GoString(C.PD_ConfigGetModelDir(cfg.c))
+}

@@ -1,0 +1,90 @@
+package paddle
+
+// Reference: paddle/fluid/inference/goapi/predictor.go — the cgo
+// wrapper over PD_Predictor.
+
+// #include "pd_inference_c.h"
+// #include <stdlib.h>
+import "C"
+
+import (
+	"fmt"
+	"unsafe"
+)
+
+// Predictor runs a saved paddle_tpu inference model; each Run is one
+// cached XLA executable underneath.
+type Predictor struct {
+	p *C.PD_Predictor
+}
+
+// NewPredictor builds a predictor.  CONSUMES the config (reference
+// semantics) — the config must not be touched afterwards.
+func NewPredictor(cfg *Config) (*Predictor, error) {
+	p := C.PD_PredictorCreate(cfg.c)
+	cfg.c = nil // consumed
+	if p == nil {
+		return nil, fmt.Errorf("paddle: PD_PredictorCreate failed")
+	}
+	return &Predictor{p: p}, nil
+}
+
+// GetInputNum returns the number of model inputs.
+func (pred *Predictor) GetInputNum() int {
+	return int(C.PD_PredictorGetInputNum(pred.p))
+}
+
+// GetOutputNum returns the number of model outputs.
+func (pred *Predictor) GetOutputNum() int {
+	return int(C.PD_PredictorGetOutputNum(pred.p))
+}
+
+// GetInputNames lists input names in declaration order.
+func (pred *Predictor) GetInputNames() []string {
+	n := pred.GetInputNum()
+	out := make([]string, n)
+	for i := 0; i < n; i++ {
+		out[i] = C.GoString(C.PD_PredictorGetInputName(pred.p, C.size_t(i)))
+	}
+	return out
+}
+
+// GetOutputNames lists output names.
+func (pred *Predictor) GetOutputNames() []string {
+	n := pred.GetOutputNum()
+	out := make([]string, n)
+	for i := 0; i < n; i++ {
+		out[i] = C.GoString(C.PD_PredictorGetOutputName(pred.p, C.size_t(i)))
+	}
+	return out
+}
+
+// GetInputHandle returns the named input tensor handle.
+func (pred *Predictor) GetInputHandle(name string) *Tensor {
+	cn := C.CString(name)
+	defer C.free(unsafe.Pointer(cn))
+	return &Tensor{t: C.PD_PredictorGetInputHandle(pred.p, cn)}
+}
+
+// GetOutputHandle returns the named output tensor handle.
+func (pred *Predictor) GetOutputHandle(name string) *Tensor {
+	cn := C.CString(name)
+	defer C.free(unsafe.Pointer(cn))
+	return &Tensor{t: C.PD_PredictorGetOutputHandle(pred.p, cn)}
+}
+
+// Run executes the model on the bound inputs.
+func (pred *Predictor) Run() error {
+	if C.PD_PredictorRun(pred.p) != 1 {
+		return fmt.Errorf("paddle: PD_PredictorRun failed")
+	}
+	return nil
+}
+
+// Destroy releases the predictor (tensor handles stay valid).
+func (pred *Predictor) Destroy() {
+	if pred.p != nil {
+		C.PD_PredictorDestroy(pred.p)
+		pred.p = nil
+	}
+}

@@ -1,0 +1,95 @@
+package paddle
+
+// Reference: paddle/fluid/inference/goapi/tensor.go — PD_Tensor I/O.
+
+// #include "pd_inference_c.h"
+import "C"
+
+import (
+	"fmt"
+	"unsafe"
+)
+
+// Tensor is a named input/output binding of a Predictor.
+type Tensor struct {
+	t *C.PD_Tensor
+}
+
+// Reshape sets the tensor's shape before CopyFromCpu.
+func (t *Tensor) Reshape(shape []int32) {
+	if len(shape) == 0 {
+		return
+	}
+	C.PD_TensorReshape(t.t, C.size_t(len(shape)),
+		(*C.int32_t)(unsafe.Pointer(&shape[0])))
+}
+
+// Shape returns the current tensor shape.
+func (t *Tensor) Shape() []int32 {
+	var n C.size_t
+	dims := make([]int32, 16)
+	C.PD_TensorGetShape(t.t, &n,
+		(*C.int32_t)(unsafe.Pointer(&dims[0])))
+	return dims[:int(n)]
+}
+
+func (t *Tensor) numel() int {
+	n := 1
+	for _, d := range t.Shape() {
+		n *= int(d)
+	}
+	return n
+}
+
+// CopyFromCpu writes host data ([]float32, []int32 or []int64) into the
+// tensor (reference tensor.go CopyFromCpu).
+func (t *Tensor) CopyFromCpu(data interface{}) error {
+	switch v := data.(type) {
+	case []float32:
+		if C.PD_TensorCopyFromCpuFloat(t.t,
+			(*C.float)(unsafe.Pointer(&v[0]))) != 1 {
+			return fmt.Errorf("paddle: CopyFromCpu(float32) failed")
+		}
+	case []int64:
+		if C.PD_TensorCopyFromCpuInt64(t.t,
+			(*C.int64_t)(unsafe.Pointer(&v[0]))) != 1 {
+			return fmt.Errorf("paddle: CopyFromCpu(int64) failed")
+		}
+	case []int32:
+		if C.PD_TensorCopyFromCpuInt32(t.t,
+			(*C.int32_t)(unsafe.Pointer(&v[0]))) != 1 {
+			return fmt.Errorf("paddle: CopyFromCpu(int32) failed")
+		}
+	default:
+		return fmt.Errorf("paddle: unsupported CopyFromCpu type %T", data)
+	}
+	return nil
+}
+
+// CopyToCpu reads the tensor back into []float32 or []int64 sized by
+// Shape().
+func (t *Tensor) CopyToCpu(data interface{}) error {
+	switch v := data.(type) {
+	case []float32:
+		if C.PD_TensorCopyToCpuFloat(t.t,
+			(*C.float)(unsafe.Pointer(&v[0]))) != 1 {
+			return fmt.Errorf("paddle: CopyToCpu(float32) failed")
+		}
+	case []int64:
+		if C.PD_TensorCopyToCpuInt64(t.t,
+			(*C.int64_t)(unsafe.Pointer(&v[0]))) != 1 {
+			return fmt.Errorf("paddle: CopyToCpu(int64) failed")
+		}
+	default:
+		return fmt.Errorf("paddle: unsupported CopyToCpu type %T", data)
+	}
+	return nil
+}
+
+// Destroy releases the tensor handle.
+func (t *Tensor) Destroy() {
+	if t.t != nil {
+		C.PD_TensorDestroy(t.t)
+		t.t = nil
+	}
+}
