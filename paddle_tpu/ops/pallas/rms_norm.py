@@ -29,18 +29,26 @@ def _pallas_rms(x2d, w, eps):
     while n % block:
         block //= 2
     block = max(block, 1)
-    return pl.pallas_call(
-        functools.partial(_rms_kernel, eps=eps),
-        grid=(n // block,),
-        in_specs=[pl.BlockSpec((block, d), lambda i: (i, 0)),
-                  pl.BlockSpec((d,), lambda i: (0,))],
-        out_specs=pl.BlockSpec((block, d), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((n, d), x2d.dtype),
-    )(x2d, w)
+    with jax.enable_x64(False):   # see flash_attention._flash_fwd
+        return pl.pallas_call(
+            functools.partial(_rms_kernel, eps=eps),
+            grid=(n // block,),
+            in_specs=[pl.BlockSpec((block, d), lambda i: (i, 0)),
+                      pl.BlockSpec((1, d), lambda i: (0, 0))],
+            out_specs=pl.BlockSpec((block, d), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((n, d), x2d.dtype),
+        )(x2d, w.reshape(1, d))
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
 def rms_norm(x, weight, eps=1e-6):
-    """[..., d] fused rmsnorm; weight [d]."""
+    """[..., d] fused rmsnorm; weight [d].  Differentiable: the forward
+    runs the Pallas kernel on TPU, the backward is the closed-form
+    jnp vjp (XLA fuses it into one pass)."""
+    return _rms_fwd_impl(x, weight, eps)
+
+
+def _rms_fwd_impl(x, weight, eps):
     if jax.default_backend() == "cpu" or x.shape[-1] % 128:
         xf = x.astype(jnp.float32)
         var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
@@ -48,3 +56,25 @@ def rms_norm(x, weight, eps=1e-6):
     shape = x.shape
     out = _pallas_rms(x.reshape(-1, shape[-1]), weight, eps)
     return out.reshape(shape)
+
+
+def _rms_vjp_fwd(x, weight, eps):
+    return _rms_fwd_impl(x, weight, eps), (x, weight)
+
+
+def _rms_vjp_bwd(eps, res, g):
+    x, w = res
+    xf = x.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    wf = w.astype(jnp.float32)
+    d = xf.shape[-1]
+    r = jax.lax.rsqrt(jnp.mean(jnp.square(xf), axis=-1,
+                               keepdims=True) + eps)
+    gw = gf * wf                                       # [..., d]
+    dx = (gw * r - xf * (jnp.sum(gw * xf, axis=-1, keepdims=True)
+                         * (r ** 3) / d)).astype(x.dtype)
+    dw = jnp.sum((xf * r * gf).reshape(-1, d), axis=0).astype(w.dtype)
+    return dx, dw
+
+
+rms_norm.defvjp(_rms_vjp_fwd, _rms_vjp_bwd)

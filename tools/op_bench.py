@@ -176,13 +176,13 @@ def _cases():
         from paddle_tpu.ops.pallas import quant_matmul as QM
         q8 = jnp.asarray(rs.randint(-127, 128, (hK, hN)), jnp.int8)
         sc = jnp.asarray(rs.rand(hN).astype(np.float32) * 0.01)
-        w8 = QM.QuantizedWeight(q8, sc, kind="int8")
-        w4 = QM.QuantizedWeight(QM.pack_int4(
+        wq8 = QM.QuantizedWeight(q8, sc, kind="int8")
+        wq4 = QM.QuantizedWeight(QM.pack_int4(
             jnp.clip(q8, -8, 7)), sc, kind="int4", k=hK)
         cases["wo_int8_gemv"] = (
-            lambda h: QM.weight_only_matmul(h, w8), (hvec,))
+            lambda h: QM.weight_only_matmul(h, wq8), (hvec,))
         cases["wo_int4_gemv"] = (
-            lambda h: QM.weight_only_matmul(h, w4), (hvec,))
+            lambda h: QM.weight_only_matmul(h, wq4), (hvec,))
 
     # ---- norms fwd + bwd ---------------------------------------------
     xn = jax.random.normal(key, (4096, 2048) if on_tpu else (64, 64), dt)
