@@ -408,10 +408,13 @@ def bench_decode():
         G._FN_CACHE.clear()
         out = G.generate(model, ids, max_new_tokens=new, **kw)
         float(np.asarray(out._data[0, -1]))       # compile + fetch
-        t0 = time.perf_counter()
-        out = G.generate(model, ids, max_new_tokens=new, **kw)
-        float(np.asarray(out._data[0, -1]))
-        return batch * new / (time.perf_counter() - t0)
+        best = 0.0
+        for _ in range(2):   # best-of-2: tunnel service windows swing ~6%
+            t0 = time.perf_counter()
+            out = G.generate(model, ids, max_new_tokens=new, **kw)
+            float(np.asarray(out._data[0, -1]))
+            best = max(best, batch * new / (time.perf_counter() - t0))
+        return best
 
     tps_dense = run()
     tps_int8 = run(weight_quant="int8")

@@ -84,25 +84,53 @@ def quantize_state(state, algo="weight_only_int8"):
     """Replace every matmul weight in a generation state dict with a
     :class:`QuantizedWeight` (embeddings stay dense: they are gathers,
     not matmuls).  int4 weights are nibble-packed [K/2, N] — a quarter
-    of the bf16 HBM footprint.  The reference analog is converting a
+    of the bf16 HBM footprint.
+
+    q/k/v and gate/up are quantized FUSED (columns concatenated before
+    per-output-channel quantization — bit-identical to separate, since
+    the scale is per column) so the decode loop issues one GEMV kernel
+    where it issued three: at B=8 decode shapes the launch count, not
+    the flops, is the cost.  The reference analog is converting a
     deploy model through weight_quantize before serving
     (python/paddle/nn/quant)."""
     from ..nn.quant import weight_quantize
     from ..ops.pallas.quant_matmul import QuantizedWeight
 
     kind = "int4" if algo.endswith("int4") else "int8"
+
+    def quant(arr):
+        q, scale = weight_quantize.__op_body__(arr, algo)
+        return QuantizedWeight(q, scale, kind=kind, k=arr.shape[0])
+
     out = dict(state)
+    fused = set()
+    for name in state:
+        p, _, leaf = name.rpartition(".self_attn.q_proj.weight")
+        if leaf == "" and p:
+            pre = p + ".self_attn."
+            out[pre + "qkv_fused.weight"] = quant(jnp.concatenate(
+                [state[pre + "q_proj.weight"],
+                 state[pre + "k_proj.weight"],
+                 state[pre + "v_proj.weight"]], axis=1))
+            fused |= {pre + "q_proj.weight", pre + "k_proj.weight",
+                      pre + "v_proj.weight"}
+        p, _, leaf = name.rpartition(".mlp.gate_proj.weight")
+        if leaf == "" and p:
+            pre = p + ".mlp."
+            out[pre + "gateup_fused.weight"] = quant(jnp.concatenate(
+                [state[pre + "gate_proj.weight"],
+                 state[pre + "up_proj.weight"]], axis=1))
+            fused |= {pre + "gate_proj.weight", pre + "up_proj.weight"}
     for name, arr in state.items():
-        if name.endswith(_QUANT_KEYS) or name == "lm_head.weight":
-            q, scale = weight_quantize.__op_body__(arr, algo)
-            out[name] = QuantizedWeight(q, scale, kind=kind,
-                                        k=arr.shape[0])
+        if (name.endswith(_QUANT_KEYS) or name == "lm_head.weight") \
+                and name not in fused:
+            out[name] = quant(arr)
     return out
 
 
 def _layer_weights(state, i):
     p = f"llama.layers.{i}."
-    return {
+    w = {
         "ln1": state[p + "input_layernorm.weight"],
         "q": state[p + "self_attn.q_proj.weight"],
         "k": state[p + "self_attn.k_proj.weight"],
@@ -113,6 +141,31 @@ def _layer_weights(state, i):
         "up": state[p + "mlp.up_proj.weight"],
         "down": state[p + "mlp.down_proj.weight"],
     }
+    if p + "self_attn.qkv_fused.weight" in state:   # quantized serving
+        w["qkv"] = state[p + "self_attn.qkv_fused.weight"]
+    if p + "mlp.gateup_fused.weight" in state:
+        w["gateup"] = state[p + "mlp.gateup_fused.weight"]
+    return w
+
+
+def _qkv_proj(w, h, nh, kvh, hd):
+    """(q, k, v) projections — one fused GEMV when the quantized state
+    provides it, three matmuls otherwise."""
+    if "qkv" in w:
+        qkv = _mm(h, w["qkv"])
+        return (qkv[..., :nh * hd], qkv[..., nh * hd:(nh + kvh) * hd],
+                qkv[..., (nh + kvh) * hd:])
+    return _mm(h, w["q"]), _mm(h, w["k"]), _mm(h, w["v"])
+
+
+def _ffn(w, h):
+    if "gateup" in w:
+        gu = _mm(h, w["gateup"])
+        half = gu.shape[-1] // 2
+        return _mm(jax.nn.silu(gu[..., :half]) * gu[..., half:],
+                   w["down"])
+    return _mm(jax.nn.silu(_mm(h, w["gate"])) * _mm(h, w["up"]),
+               w["down"])
 
 
 def _rope_at(cos, sin, pos):
@@ -127,9 +180,10 @@ def _prefill_layer(w, x, cos, sin, mask, cfg: LlamaConfig):
     nh, kvh, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
                    cfg.head_dim)
     h = _rms(x, w["ln1"], cfg.rms_norm_eps)
-    q = _mm(h, w["q"]).reshape(b, s, nh, hd)
-    k = _mm(h, w["k"]).reshape(b, s, kvh, hd)
-    v = _mm(h, w["v"]).reshape(b, s, kvh, hd)
+    qp, kp, vp = _qkv_proj(w, h, nh, kvh, hd)
+    q = qp.reshape(b, s, nh, hd)
+    k = kp.reshape(b, s, kvh, hd)
+    v = vp.reshape(b, s, kvh, hd)
     cos_c = cos[None, :, None, :].astype(q.dtype)
     sin_c = sin[None, :, None, :].astype(q.dtype)
     q = q * cos_c + _rotate_half(q) * sin_c
@@ -142,8 +196,7 @@ def _prefill_layer(w, x, cos, sin, mask, cfg: LlamaConfig):
                 is_causal=True).reshape(b, s, nh * hd)
     x = x + _mm(attn, w["o"])
     h = _rms(x, w["ln2"], cfg.rms_norm_eps)
-    return (x + _mm(jax.nn.silu(_mm(h, w["gate"])) * _mm(h, w["up"]),
-                    w["down"]), k, v)
+    return (x + _ffn(w, h), k, v)
 
 
 # ------------------------------------------------------------ decode step
@@ -154,20 +207,23 @@ def _decode_layer(w, x, kcache, vcache, cos1, sin1, pos, cfg: LlamaConfig):
     nh, kvh, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
                    cfg.head_dim)
     h = _rms(x[:, None], w["ln1"], cfg.rms_norm_eps)[:, 0]
-    q = _mm(h, w["q"]).reshape(b, nh, hd)
-    k = _mm(h, w["k"]).reshape(b, kvh, hd)
-    v = _mm(h, w["v"]).reshape(b, kvh, hd)
+    qp, kp, vp = _qkv_proj(w, h, nh, kvh, hd)
+    q = qp.reshape(b, nh, hd)
+    k = kp.reshape(b, kvh, hd)
+    v = vp.reshape(b, kvh, hd)
     cos_c = cos1[:, None, :].astype(q.dtype)
     sin_c = sin1[:, None, :].astype(q.dtype)
     q = q * cos_c + _rotate_half(q) * sin_c
     k = k * cos_c + _rotate_half(k) * sin_c
 
-    # write this token's k/v at pos (per-batch positions)
-    idx = pos[:, None, None, None]
-    tpos = jnp.arange(kcache.shape[2])
-    sel = (tpos[None, None, :, None] == idx)          # [B, 1, T, 1]
-    kcache = jnp.where(sel, k[:, :, None], kcache)
-    vcache = jnp.where(sel, v[:, :, None], vcache)
+    # write this token's k/v at pos (per-batch positions).  A scatter —
+    # NOT a compare-select over the whole cache: jnp.where materializes
+    # a full cache copy per layer per step (~268 MB of HBM traffic at
+    # the bench shapes), while .at[].set lowers to an in-place update
+    # of one token row on the donated scan carry
+    b_ids = jnp.arange(b)
+    kcache = kcache.at[b_ids, :, pos, :].set(k, mode="drop")
+    vcache = vcache.at[b_ids, :, pos, :].set(v, mode="drop")
 
     # blockwise cache attention kernel (ops/pallas/decode_attention.py);
     # transparently falls back to the einsum path off-TPU
@@ -175,9 +231,7 @@ def _decode_layer(w, x, kcache, vcache, cos1, sin1, pos, cfg: LlamaConfig):
     attn = decode_attention(q, kcache, vcache, pos).reshape(b, nh * hd)
     x = x + _mm(attn, w["o"])
     h = _rms(x[:, None], w["ln2"], cfg.rms_norm_eps)[:, 0]
-    return (x + _mm(jax.nn.silu(_mm(h, w["gate"])) * _mm(h, w["up"]),
-                    w["down"]),
-            kcache, vcache)
+    return (x + _ffn(w, h), kcache, vcache)
 
 
 # ------------------------------------------------------- paged decode step
@@ -193,9 +247,10 @@ def _decode_layer_paged(w, x, kpool, vpool, table, cos1, sin1, pos,
                    cfg.head_dim)
     ps = kpool.shape[2]
     h = _rms(x[:, None], w["ln1"], cfg.rms_norm_eps)[:, 0]
-    q = _mm(h, w["q"]).reshape(b, nh, hd)
-    k = _mm(h, w["k"]).reshape(b, kvh, hd)
-    v = _mm(h, w["v"]).reshape(b, kvh, hd)
+    qp, kp, vp = _qkv_proj(w, h, nh, kvh, hd)
+    q = qp.reshape(b, nh, hd)
+    k = kp.reshape(b, kvh, hd)
+    v = vp.reshape(b, kvh, hd)
     cos_c = cos1[:, None, :].astype(q.dtype)
     sin_c = sin1[:, None, :].astype(q.dtype)
     q = q * cos_c + _rotate_half(q) * sin_c
@@ -476,7 +531,11 @@ def generate(model, input_ids, max_new_tokens=64, do_sample=False,
             qstate = quantize_state(state, f"weight_only_{weight_quant}")
             model._wq_cache = {"algo": weight_quant, "src": src,
                                "state": qstate}
-        state = dict(state, **{k: qstate[k] for k in src})
+        # carry the quantized leaves AND the fused qkv/gateup entries
+        state = dict(state, **{k: v for k, v in qstate.items()
+                               if k in src
+                               or k.endswith(("qkv_fused.weight",
+                                              "gateup_fused.weight"))})
     from ..ops.pallas import decode_attention as _DA
 
     if cache == "paged":

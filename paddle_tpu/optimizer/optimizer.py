@@ -193,9 +193,14 @@ class Optimizer:
 
             fn = jax.jit(run)
         try:
+            import numpy as _np
+
+            # numpy scalars, NOT jnp: jnp.float32(lr) is an eager
+            # device_put dispatch per step; as np scalars the transfer
+            # rides the jitted call itself
             new_p, new_accs, new_masters = fn(
                 [p._data for p in ps], gs, accs_in, masters_in,
-                jnp.float32(lr), jnp.int32(self._step_count))
+                _np.float32(lr), _np.int32(self._step_count))
         except Exception:
             # subclass math not traceable (host-side control flow, e.g.
             # line searches): permanently take the legacy loop
