@@ -130,7 +130,10 @@ def bench_resnet50():
 
     dev, on_tpu, _ = _env()
     n = 1  # runs on one device; per-chip numbers divide by what is used
-    batch, steps = (128, 3) if on_tpu else (4, 1)
+    # batch 512: conv MXU efficiency grows with N on this chip (measured
+    # r4: 1.47x img/s over batch 128, landing the rung at its own
+    # raw-jax ceiling — tools/platform_ceiling.py)
+    batch, steps = (512, 3) if on_tpu else (4, 1)
     hw = 224 if on_tpu else 32
 
     model = resnet50(num_classes=1000)
