@@ -51,8 +51,12 @@ def _chain_time(step, x0, iters=None, reps=3, target=0.6):
             def body(x, _):
                 return step(x), None
             x, _ = jax.lax.scan(body, x, None, length=n)
-            leaf = jax.tree_util.tree_leaves(x)[0]
-            return jnp.sum(leaf.astype(jnp.float32))
+            # reduce over EVERY leaf: depending on one leaf lets XLA
+            # dead-code the whole chain when that leaf happens to be a
+            # fixed point (observed: summing an unused-BN param turned
+            # the resnet probe into a no-op reading 115 PF/s)
+            return sum(jnp.sum(l.astype(jnp.float32))
+                       for l in jax.tree_util.tree_leaves(x))
         return run
 
     # every timed call gets FRESH input values: the relay memoizes
