@@ -324,10 +324,13 @@ def bench_moe():
     n = 1  # single-device bench (mesh is built with 1 device below)
     if on_tpu:
         # sort-based dispatch (no [tokens, E, capacity] one-hot) freed
-        # the HBM that used to cap this rung at 4x512
+        # the HBM that used to cap this rung at 4x512.  head_dim 128
+        # (8 heads), matching DeepSeekMoE/Qwen2-MoE: D=64 halves the
+        # MXU contraction in the flash kernel (measured r4: the D=64
+        # attention cost 2.2x the D=128 one at identical flops)
         cfg = M.MoEConfig(vocab_size=32000, hidden_size=1024,
                           moe_intermediate_size=1408, num_hidden_layers=8,
-                          num_attention_heads=16, num_key_value_heads=16,
+                          num_attention_heads=8, num_key_value_heads=8,
                           num_experts=8, top_k=2, dtype="bfloat16")
         batch, seq, steps = 16, 512, 10
     else:
