@@ -1,0 +1,89 @@
+"""Sequential segment tracing (layer_common._try_segment_forward): a
+pure Sequential runs its forward as ONE cached dispatch.  These tests
+pin the invalidation rules the code-review flagged as hazards."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import layer_common as LC
+
+
+@pytest.fixture(autouse=True)
+def _on():
+    LC.SEGMENT_FORWARD = True
+    yield
+    LC.SEGMENT_FORWARD = True
+
+
+def _x():
+    return paddle.to_tensor(np.random.RandomState(0).rand(4, 8)
+                            .astype(np.float32))
+
+
+def test_segment_matches_per_layer_path():
+    paddle.seed(0)
+    seq = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    x = _x()
+    out_seg = seq(x)
+    assert "_seg_cache" in seq.__dict__ and seq._seg_cache[1]  # pure
+    LC.SEGMENT_FORWARD = False
+    out_ref = seq(x)
+    np.testing.assert_allclose(np.asarray(out_seg._data),
+                               np.asarray(out_ref._data), rtol=1e-6)
+
+
+def test_grads_flow_through_segment():
+    paddle.seed(1)
+    seq = nn.Sequential(nn.Linear(8, 8), nn.Sigmoid(), nn.Linear(8, 2))
+    x = _x()
+    seq(x).sum().backward()
+    for p in seq.parameters():
+        assert p.grad is not None, p.name
+
+
+def test_weight_reassignment_invalidates():
+    paddle.seed(2)
+    seq = nn.Sequential(nn.Linear(8, 8))
+    x = _x()
+    out1 = np.asarray(seq(x)._data)
+    # replace the weight OBJECT (not in-place): must retrace
+    new_w = paddle.to_tensor(np.zeros((8, 8), np.float32))
+    new_w.stop_gradient = False
+    seq[0].weight = new_w
+    out2 = np.asarray(seq(x)._data)
+    assert not np.allclose(out1, out2)
+    np.testing.assert_allclose(out2,
+                               np.broadcast_to(
+                                   np.asarray(seq[0].bias._data), (4, 8)))
+
+
+def test_forward_hook_registration_invalidates():
+    paddle.seed(3)
+    seq = nn.Sequential(nn.Linear(8, 8), nn.ReLU())
+    x = _x()
+    seq(x)
+    fired = []
+    seq[0].register_forward_post_hook(
+        lambda layer, inp, out: fired.append(1) or None)
+    seq(x)
+    assert fired, "post-hook never fired after registration"
+
+
+def test_impure_layers_fall_back():
+    paddle.seed(4)
+    seq = nn.Sequential(nn.Linear(8, 8), nn.Dropout(0.5), nn.Linear(8, 2))
+    seq.train()
+    x = _x()
+    seq(x)          # Dropout (RNG) is not in the pure set
+    assert seq._seg_cache[1] is False
+
+
+def test_added_sublayer_invalidates():
+    paddle.seed(5)
+    seq = nn.Sequential(nn.Linear(8, 8))
+    x = _x()
+    out1 = np.asarray(seq(x)._data)
+    seq.add_sublayer("relu", nn.ReLU())
+    out2 = np.asarray(seq(x)._data)
+    np.testing.assert_allclose(out2, np.maximum(out1, 0.0), rtol=1e-6)
