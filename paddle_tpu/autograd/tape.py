@@ -16,6 +16,7 @@ from typing import Any, Callable, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 __all__ = ["GradNode", "no_grad", "enable_grad", "is_grad_enabled",
            "set_grad_enabled", "backward", "grad"]
@@ -86,7 +87,7 @@ class GradNode:
     """
 
     __slots__ = ("id", "name", "vjp_fn", "inputs", "out_avals",
-                 "raw_vjp", "out_treedef")
+                 "raw_vjp", "out_treedef", "fwd_closed")
 
     def __init__(self, name: str, vjp_fn: Callable, inputs: Sequence[Any],
                  out_avals: Sequence[Any]):
@@ -98,6 +99,7 @@ class GradNode:
         self.out_avals = list(out_avals)  # jax.ShapeDtypeStruct per output
         self.raw_vjp = None        # tree_util.Partial when fusable
         self.out_treedef = None
+        self.fwd_closed = None     # re-runnable fwd for create_graph=True
 
     def __repr__(self):
         return f"<GradNode {self.name}#{self.id}>"
@@ -263,6 +265,7 @@ def _try_fused_backward(tensors, grad_tensors, retain_graph):
             node.vjp_fn = _used_vjp
             node.raw_vjp = None
             node.inputs = []
+            node.fwd_closed = None
     return True
 
 
@@ -347,6 +350,7 @@ def backward(tensors, grad_tensors=None, retain_graph=False, _sink=None,
         if not retain_graph:
             node.vjp_fn = _used_vjp
             node.inputs = []
+            node.fwd_closed = None
 
 
 def _used_vjp(*_):
@@ -355,12 +359,175 @@ def _used_vjp(*_):
         "pass retain_graph=True if you need to.")
 
 
-def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
-         create_graph=False, allow_unused=False):
+# ------------------------------------------------- higher-order autograd
+# The reference implements double/triple backward as dedicated
+# *_double_grad / *_triple_grad ops (34 + 19 entries in
+# paddle/phi/ops/yaml/backward.yaml:4) driven by grad(create_graph=True)
+# (python/paddle/base/dygraph/base.py:656,690).  Here every registry op
+# stores a re-runnable forward closure (registry._make_closed), so the
+# create_graph sweep re-linearises each node with `jax.vjp` — the grad of
+# the grad falls out of jax's own transpose rules, to arbitrary order
+# (the replay node stores its OWN closure, so triple grad recurses).
+
+
+def _replay_differentiable(node: GradNode, cot_ts: list):
+    """Run one node's backward as a *recorded*, differentiable op.
+
+    cot_ts: flat output-cotangent Tensors (one per out_aval).  Returns
+    input-cotangent Tensors aligned with ``node.inputs``; when any diff
+    input feeds them, they carry a new GradNode whose vjp comes from
+    ``jax.vjp`` of the replay — so the result is differentiable w.r.t.
+    both the op's original inputs (via residual recompute) and the
+    incoming cotangents (the linear part).
+    """
+    from jax.tree_util import tree_flatten, tree_unflatten
+    from ..framework.tensor import Tensor
+    from ..ops.registry import _tangent_dtype
+
+    if node.fwd_closed is None or node.out_treedef is None:
+        raise NotImplementedError(
+            f"grad(..., create_graph=True) through op '{node.name}' is not "
+            "supported: the node has no re-differentiable forward closure "
+            "(custom GradNodes — PyLayer / to_static / recompute / "
+            "sparse-conv — and eager-RNG ops like dropout). Restructure the "
+            "double-grad region to use framework ops, or compute it under "
+            "jax.grad directly.")
+
+    in_items = list(node.inputs)          # (tensor, producer, out_index)
+    in_arrs0 = [t._data for (t, _p, _i) in in_items]
+    # float0 cotangents (integer outputs) travel as raw numpy zeros, not
+    # Tensors — they are never differentiable
+    cot_arrs0 = [getattr(c, "_data", c) for c in cot_ts]
+    fwd = node.fwd_closed
+    otree = node.out_treedef
+
+    def _inexact(a):
+        return _tangent_dtype(a) != jax.dtypes.float0
+
+    diff = [("i", k) for k, (t, _p, _ix) in enumerate(in_items)
+            if not t.stop_gradient and _inexact(t._data)]
+    diff += [("c", k) for k, c in enumerate(cot_ts)
+             if isinstance(c, Tensor) and not c.stop_gradient
+             and _inexact(c._data)]
+
+    def gop(*darrs):
+        ia, ca = list(in_arrs0), list(cot_arrs0)
+        for (kind, k), a in zip(diff, darrs):
+            (ia if kind == "i" else ca)[k] = a
+        _out, vjp = jax.vjp(fwd, *ia)
+        return tuple(vjp(tree_unflatten(otree, ca)))
+
+    darrs = [(in_arrs0 if kind == "i" else cot_arrs0)[k]
+             for (kind, k) in diff]
+    if diff and is_grad_enabled():
+        out, raw_vjp = jax.vjp(gop, *darrs)
+    else:
+        out, raw_vjp = gop(*darrs), None
+
+    out_flat, out_tree2 = tree_flatten(out)
+    nnode = None
+    if raw_vjp is not None:
+        out_avals = [jax.ShapeDtypeStruct(np.shape(a), _tangent_dtype(a))
+                     for a in out_flat]
+
+        def vjp_fn(flat_cots):
+            return raw_vjp(tree_unflatten(out_tree2, list(flat_cots)))
+
+        diff_ts = [in_items[k][0] if kind == "i" else cot_ts[k]
+                   for (kind, k) in diff]
+        nnode = GradNode(f"grad[{node.name}]", vjp_fn, diff_ts, out_avals)
+        # the original inputs' producers were snapshotted at forward-record
+        # time; the live _grad_node may have been rebound by in-place APIs
+        # since — restore the snapshot
+        for j, (kind, k) in enumerate(diff):
+            if kind == "i":
+                nnode.inputs[j] = in_items[k]
+        nnode.fwd_closed = gop
+        nnode.out_treedef = out_tree2
+
+    res = []
+    for i, a in enumerate(out_flat):
+        diffable = nnode is not None and _tangent_dtype(a) != jax.dtypes.float0
+        t = Tensor(a, stop_gradient=not diffable)
+        if diffable:
+            t._grad_node = nnode
+            t._out_index = i
+        res.append(t)
+    return res
+
+
+def _backward_create_graph(tensors, grad_tensors, _sink, _capture,
+                           retain_graph):
+    """The grad(create_graph=True) sweep: cotangents flow as *recorded*
+    Tensors and every node replay is itself differentiable."""
+    from ..framework.tensor import Tensor
+
+    pending: dict[int, list] = {}
+    nodes: dict[int, GradNode] = {}
+
+    def _acc_pair(a, b):
+        return b if a is None else a + b      # Tensor __add__: recorded
+
+    def _accumulate(t, node, out_index, g):
+        if node is None or id(t) in _capture:
+            prev = _sink.get(id(t))
+            _sink[id(t)] = _acc_pair(prev, g)
+            if node is None:
+                return
+        nodes[node.id] = node
+        cots = pending.get(node.id)
+        if cots is None:
+            cots = [None] * len(node.out_avals)
+            pending[node.id] = cots
+        cots[out_index] = _acc_pair(cots[out_index], g)
+
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient:
+            continue
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {t.shape}")
+            g = Tensor(jnp.ones(t._data.shape, t._data.dtype),
+                       stop_gradient=True)
+        elif not isinstance(g, Tensor):
+            g = Tensor(jnp.asarray(g), stop_gradient=True)
+        _accumulate(t, t._grad_node, t._out_index, g)
+
+    while nodes:
+        nid = max(nodes)
+        node = nodes.pop(nid)
+        cots = pending.pop(nid)
+        def _zero_cot(a):
+            z = _zeros_like_aval(a)
+            return z if a.dtype == jax.dtypes.float0 \
+                else Tensor(z, stop_gradient=True)
+
+        cot_ts = [c if c is not None else _zero_cot(a)
+                  for c, a in zip(cots, node.out_avals)]
+        in_cots = _replay_differentiable(node, cot_ts)
+        for (t, prod_node, prod_idx), g in zip(node.inputs, in_cots):
+            if t is None or g is None:
+                continue
+            if not t.stop_gradient:
+                _accumulate(t, prod_node, prod_idx, g)
+        if not retain_graph:
+            node.vjp_fn = _used_vjp
+            node.inputs = []
+            node.fwd_closed = None
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
     """paddle.grad: grads of outputs wrt inputs without touching .grad.
 
     Implemented as a tape sweep into a side accumulator (reference:
-    general_grad.h selective subgraph).
+    general_grad.h selective subgraph; create_graph semantics from
+    python/paddle/base/dygraph/base.py:656,690 — retain_graph defaults to
+    the create_graph value, and with create_graph=True the returned grads
+    are themselves recorded for higher-order differentiation).
     """
     from ..framework.tensor import Tensor
 
@@ -368,15 +535,47 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
         outputs = [outputs]
     if isinstance(inputs, Tensor):
         inputs = [inputs]
+    if not only_inputs:
+        raise NotImplementedError("only_inputs=False is not supported "
+                                  "(matches the reference deprecation)")
+    if retain_graph is None:
+        retain_graph = create_graph
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif isinstance(grad_outputs, Tensor) or not isinstance(
+            grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+
+    ngv = []
+    if no_grad_vars:
+        if isinstance(no_grad_vars, Tensor):
+            no_grad_vars = [no_grad_vars]
+        for t in no_grad_vars:
+            if not t.stop_gradient:
+                ngv.append(t)
+                t.stop_gradient = True
     sink: dict[int, Any] = {}
-    backward(outputs, grad_outputs, retain_graph=retain_graph, _sink=sink,
-             _capture=frozenset(id(t) for t in inputs))
+    try:
+        if create_graph:
+            with enable_grad():
+                _backward_create_graph(
+                    outputs, grad_outputs, sink,
+                    frozenset(id(t) for t in inputs), retain_graph)
+        else:
+            backward(outputs, grad_outputs, retain_graph=retain_graph,
+                     _sink=sink, _capture=frozenset(id(t) for t in inputs))
+    finally:
+        for t in ngv:
+            t.stop_gradient = False
     results = []
     for t in inputs:
         g = sink.get(id(t))
         if g is None and not allow_unused:
             g = jnp.zeros(t._data.shape, t._data.dtype)
-        results.append(Tensor(g, stop_gradient=True) if g is not None else None)
+            g = Tensor(g, stop_gradient=True)
+        elif g is not None and not isinstance(g, Tensor):
+            g = Tensor(g, stop_gradient=True)
+        results.append(g)
     return results
 
 

@@ -301,6 +301,29 @@ def _profile_span(opname, t0, out):
     _stat.record_span(opname, _time.perf_counter() - t0, "op")
 
 
+def _make_closed(body, flat, treedef, diff_positions):
+    """Snapshot a pure re-runnable forward closure of the diff arrays.
+
+    Captures input *arrays* (not Tensor handles — in-place APIs rebind
+    them) so ``create_graph=True`` backward can re-linearise the op
+    (``jax.vjp`` of this closure) to build higher-order grads.  The
+    argument order matches the node's recorded diff inputs: ascending
+    flat position of the differentiable tensor args.
+    """
+    from ..framework.tensor import Tensor
+
+    base = [x._data if isinstance(x, Tensor) else x for x in flat]
+
+    def closed(*diff_arrays):
+        flat2 = list(base)
+        for p, a in zip(diff_positions, diff_arrays):
+            flat2[p] = a
+        a2, k2 = tree_unflatten(treedef, flat2)
+        return body(*a2, **k2)
+
+    return closed
+
+
 def _dispatch(opname, body, flat, treedef, rule):
     from ..framework.tensor import Tensor
 
@@ -321,9 +344,12 @@ def _dispatch(opname, body, flat, treedef, rule):
             out, raw_vjp = cached
             if not record:
                 return _wrap_outputs(opname, out, node=None)
-            return _record_node(opname, out, raw_vjp,
-                                [flat[i] for i in t_idx
-                                 if diff_flags[i]], jitted_vjp=True)
+            diff_positions = [i for i in t_idx if diff_flags[i]]
+            return _record_node(
+                opname, out, raw_vjp,
+                [flat[i] for i in diff_positions], jitted_vjp=True,
+                fwd_closed=_make_closed(body, flat, treedef,
+                                        diff_positions))
 
     if not record:
         flat2 = list(flat)
@@ -344,11 +370,19 @@ def _dispatch(opname, body, flat, treedef, rule):
         a2, k2 = tree_unflatten(treedef, flat2)
         return body(*a2, **k2)
 
-    out, raw_vjp = jax.vjp(closed, *[t._data for t in diff_tensors])
-    return _record_node(opname, out, raw_vjp, diff_tensors)
+    from ..framework import random as _random
+    with _random.watch_rng_use() as w:
+        out, raw_vjp = jax.vjp(closed, *[t._data for t in diff_tensors])
+    # an op that drew eager RNG (dropout) can't be re-linearised — its
+    # replay would redraw the stream; leave fwd_closed unset so
+    # create_graph=True fails loudly instead of silently diverging
+    fwd = None if w.used else _make_closed(
+        body, flat, treedef, [t_idx[j] for j in diff_pos])
+    return _record_node(opname, out, raw_vjp, diff_tensors, fwd_closed=fwd)
 
 
-def _record_node(opname, out, raw_vjp, diff_tensors, jitted_vjp=False):
+def _record_node(opname, out, raw_vjp, diff_tensors, jitted_vjp=False,
+                 fwd_closed=None):
     """Attach a GradNode running ``raw_vjp`` at backward time.
     jitted_vjp: the vjp came out of a cached jit as a tree_util.Partial —
     apply it through the shared jitted applier so backward replays a
@@ -378,12 +412,13 @@ def _record_node(opname, out, raw_vjp, diff_tensors, jitted_vjp=False):
             return apply_vjp(cots)
 
     node = tape.GradNode(opname, vjp_fn, diff_tensors, out_avals)
+    node.fwd_closed = fwd_closed      # create_graph=True re-linearisation
+    node.out_treedef = out_treedef
     if jitted_vjp and hooks is None:
-        # expose the raw vjp Partial + treedef for the fused-backward
-        # replay (tape._try_fused_backward): the whole reverse sweep
-        # retraces into ONE executable instead of one dispatch per node
+        # expose the raw vjp Partial for the fused-backward replay
+        # (tape._try_fused_backward): the whole reverse sweep retraces
+        # into ONE executable instead of one dispatch per node
         node.raw_vjp = raw_vjp
-        node.out_treedef = out_treedef
     return _wrap_outputs(opname, out, node=node)
 
 

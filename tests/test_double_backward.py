@@ -1,0 +1,156 @@
+"""Higher-order autograd: grad(..., create_graph=True).
+
+Reference semantics: python/paddle/base/dygraph/base.py:656,690
+(create_graph records the backward pass; retain_graph defaults to the
+create_graph value) realised via *_double_grad/*_triple_grad ops
+(paddle/phi/ops/yaml/backward.yaml).  Here each tape node stores a
+re-runnable forward closure and the create_graph sweep re-linearises it
+with jax.vjp, so higher-order grads come from jax's transpose rules.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_double_grad_cubic():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x ** 3
+    (g,) = paddle.grad(y, x, create_graph=True)
+    assert not g.stop_gradient
+    np.testing.assert_allclose(float(g), 12.0, rtol=1e-6)
+    (g2,) = paddle.grad(g, x)
+    np.testing.assert_allclose(float(g2), 12.0, rtol=1e-6)  # 6x at x=2
+
+
+def test_triple_grad():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x ** 3
+    (g,) = paddle.grad(y, x, create_graph=True)
+    (g2,) = paddle.grad(g, x, create_graph=True)
+    (g3,) = paddle.grad(g2, x)
+    np.testing.assert_allclose(float(g3), 6.0, rtol=1e-6)
+
+
+def test_mixed_partials():
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    y = paddle.to_tensor(5.0, stop_gradient=False)
+    z = x * y + x ** 2
+    (gx,) = paddle.grad(z, x, create_graph=True)
+    np.testing.assert_allclose(float(gx), 11.0, rtol=1e-6)     # y + 2x
+    (gxx,) = paddle.grad(gx, x, retain_graph=True)
+    (gxy,) = paddle.grad(gx, y)
+    np.testing.assert_allclose(float(gxx), 2.0, rtol=1e-6)
+    np.testing.assert_allclose(float(gxy), 1.0, rtol=1e-6)
+
+
+def test_double_grad_vector_elementwise():
+    xv = np.linspace(-1.5, 1.5, 7).astype(np.float32)
+    x = paddle.to_tensor(xv, stop_gradient=False)
+    y = paddle.sin(x).sum()
+    (g,) = paddle.grad(y, x, create_graph=True)
+    (g2,) = paddle.grad(g.sum(), x)
+    np.testing.assert_allclose(g2.numpy(), -np.sin(xv), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_double_grad_through_matmul():
+    # f(x) = sum((x @ w)^2); df/dx = 2 (x@w) w^T;
+    # d/dw [sum(df/dx)] checks the cross second derivative
+    rng = np.random.RandomState(0)
+    xv = rng.randn(3, 4).astype(np.float32)
+    wv = rng.randn(4, 2).astype(np.float32)
+    x = paddle.to_tensor(xv, stop_gradient=False)
+    w = paddle.to_tensor(wv, stop_gradient=False)
+    y = (x @ w).pow(2).sum()
+    (gx,) = paddle.grad(y, x, create_graph=True)
+    (gw2,) = paddle.grad(gx.sum(), w)
+
+    # numeric reference via finite differences on h(w) = sum_x df/dx
+    def h(wm):
+        return (2.0 * (xv @ wm) @ wm.T).sum()
+
+    num = np.zeros_like(wv)
+    eps = 1e-3
+    for i in range(wv.shape[0]):
+        for j in range(wv.shape[1]):
+            wp = wv.copy(); wp[i, j] += eps
+            wm = wv.copy(); wm[i, j] -= eps
+            num[i, j] = (h(wp) - h(wm)) / (2 * eps)
+    np.testing.assert_allclose(gw2.numpy(), num, rtol=1e-2, atol=1e-2)
+
+
+def test_gradient_penalty_training_step_decreases():
+    """WGAN-GP shape: the penalty loss is a function of grad-of-output,
+    and .backward() through it must reach the parameters."""
+    paddle.seed(0)
+    D = nn.Sequential(nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 1))
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=D.parameters())
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(25):
+        x = paddle.to_tensor(rng.randn(8, 4).astype("float32"),
+                             stop_gradient=False)
+        d = D(x)
+        (gx,) = paddle.grad(d.sum(), x, create_graph=True)
+        gp = ((gx.pow(2).sum(axis=1).sqrt() - 1.0) ** 2).mean()
+        gp.backward()
+        for p in D.parameters():
+            assert p.grad is not None
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(gp))
+    assert losses[-1] < losses[0]
+
+
+def test_create_graph_false_grads_not_recorded():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x ** 3
+    (g,) = paddle.grad(y, x)
+    assert g.stop_gradient
+    (g2,) = paddle.grad(g, x, allow_unused=True)
+    assert g2 is None  # disconnected, not silently zero
+
+
+def test_retain_graph_defaults_to_create_graph():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x ** 3
+    # create_graph=True implies retain_graph: two sweeps over y both work
+    (g,) = paddle.grad(y, x, create_graph=True)
+    (g_again,) = paddle.grad(y, x, create_graph=True)
+    np.testing.assert_allclose(float(g), float(g_again))
+    # create_graph=False consumes: second sweep errors
+    z = x ** 2
+    paddle.grad(z, x)
+    with pytest.raises(RuntimeError):
+        paddle.grad(z, x)
+
+
+def test_no_grad_vars():
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    y = paddle.to_tensor(5.0, stop_gradient=False)
+    z = x * y
+    (gx,) = paddle.grad(z, x, no_grad_vars=[y])
+    np.testing.assert_allclose(float(gx), 5.0)
+    assert not y.stop_gradient  # restored
+
+
+def test_create_graph_through_rng_op_raises():
+    x = paddle.to_tensor(np.ones((4, 4), np.float32), stop_gradient=False)
+    y = nn.functional.dropout(x, p=0.5, training=True).sum()
+    with pytest.raises((NotImplementedError, RuntimeError)):
+        (g,) = paddle.grad(y, x, create_graph=True)
+        paddle.grad(g.sum(), x)
+
+
+def test_grad_outputs_seed_double_backward():
+    # seed the first grad with a recorded tensor: d/ds [s * 3x^2] = 3x^2
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    s = paddle.to_tensor(4.0, stop_gradient=False)
+    y = x ** 3
+    (g,) = paddle.grad(y, x, grad_outputs=s, create_graph=True)
+    np.testing.assert_allclose(float(g), 48.0)       # s * 3x^2
+    (gs,) = paddle.grad(g, s)
+    np.testing.assert_allclose(float(gs), 12.0)      # 3x^2
