@@ -1,0 +1,325 @@
+"""Static-graph control flow: cond / while_loop / case / switch_case /
+static_pylayer.
+
+Reference: python/paddle/static/nn/control_flow.py (cond:1509,
+while_loop:682, case:961, switch_case:1084, static_pylayer:1303) — the
+reference records dedicated PIR control-flow ops
+(paddle/fluid/pir/dialect/operator/ir/control_flow_op.cc) whose regions
+hold sub-blocks.  TPU formulation: each branch/body is traced into a
+sub-``Program`` (the region analog); the outer program records ONE node
+whose evaluation lowers to ``jax.lax.cond`` / ``jax.lax.while_loop`` /
+``jax.custom_vjp`` at executor-jit time, with captured outer Variables
+bound by name through ``evaluate(env0=...)``.  Everything stays a single
+XLA program — no host round-trips per branch.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax.tree_util import tree_flatten, tree_unflatten
+
+from . import graph
+from .graph import Program, Variable, program_guard, default_main_program
+
+__all__ = ["cond", "while_loop", "case", "switch_case", "static_pylayer",
+           "Print"]
+
+
+def _is_leaf(x):
+    from ..framework.tensor import Tensor
+    return isinstance(x, (Variable, Tensor))
+
+
+def _leaf_meta(x):
+    """(shape, dtype) of an output leaf for building the outer Variable."""
+    from ..framework.tensor import Tensor
+    if isinstance(x, Variable):
+        return list(x.shape), x.dtype
+    if isinstance(x, Tensor):
+        return list(x._data.shape), x._data.dtype
+    a = np.asarray(x)
+    return list(a.shape), a.dtype
+
+
+def _node_ref_leaves(source):
+    """Flat Variable/Tensor leaves a recorded node references (generic
+    nodes flatten their args; control-flow nodes carry an explicit list in
+    the third slot)."""
+    tag = source[0]
+    if isinstance(tag, str) and tag.startswith("__"):
+        if tag == "__grad__":
+            return [x for x in source[1] if _is_leaf(x)]
+        return list(source[2] or [])
+    _body, args, kwargs, _n = source
+    flat, _ = tree_flatten((args, kwargs), is_leaf=_is_leaf)
+    return [x for x in flat if _is_leaf(x)]
+
+
+def _collect_externals(subs, exclude=()):
+    """Outer-scope Variables referenced by nodes of the sub-programs.
+    These are evaluated in the enclosing scope and bound by name inside
+    the branch (the region's capture list)."""
+    excl = {id(x) for x in exclude}
+    ext, seen = [], set()
+
+    def note(x):
+        if isinstance(x, Variable) and id(x) not in seen \
+                and id(x) not in excl and all(x.program is not s for s in subs):
+            seen.add(id(x))
+            ext.append(x)
+
+    for sub in subs:
+        for v in sub.vars.values():
+            if v.source is None:
+                continue
+            for leaf in _node_ref_leaves(v.source):
+                note(leaf)
+    return ext
+
+
+def _merge_params(sub, outer):
+    for p in sub._param_refs:
+        outer._note_param(p)
+
+
+def _trace_subgraph(fn, args=()):
+    """Run ``fn(*args)`` recording into a fresh sub-Program; returns
+    (sub, flat_output_leaves, out_treedef)."""
+    sub = Program()
+    with program_guard(sub):
+        outs = fn(*args)
+    flat, treedef = tree_flatten(outs, is_leaf=_is_leaf)
+    return sub, flat, treedef
+
+
+def _record_ctrl(tag, payload, ref_leaves, out_metas, treedef, prog=None):
+    """Append one control-flow node to the outer program and return its
+    output Variables unflattened."""
+    prog = prog or default_main_program()
+    node = (tag, payload, list(ref_leaves), len(out_metas))
+    outs = []
+    for i, (shape, dtype) in enumerate(out_metas):
+        v = Variable(prog, shape, dtype,
+                     name=f"{tag.strip('_')}_{Variable._counter}",
+                     source=node, out_index=i)
+        v.stop_gradient = False
+        prog.vars[v.name] = v
+        outs.append(v)
+    prog.version += 1
+    return tree_unflatten(treedef, outs)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """Branch on a boolean scalar (reference control_flow.py:1509).
+
+    Both branches are traced as sub-programs and must return structures
+    with matching shapes/dtypes (the same constraint the reference
+    enforces via select_input); lowering is ``jax.lax.cond``.
+    """
+    if not isinstance(pred, Variable) and not graph._progs():
+        # dygraph: plain python branch (reference does the same)
+        flag = bool(pred.item() if hasattr(pred, "item") else pred)
+        fn = true_fn if flag else false_fn
+        return fn() if fn is not None else None
+
+    sub_t, flat_t, tree_t = _trace_subgraph(true_fn or (lambda: None))
+    sub_f, flat_f, tree_f = _trace_subgraph(false_fn or (lambda: None))
+    if tree_t != tree_f:
+        raise ValueError(
+            "cond: true_fn and false_fn must return the same structure; "
+            f"got {tree_t} vs {tree_f}")
+    if not flat_t:
+        return None
+    metas_t = [_leaf_meta(x) for x in flat_t]
+    metas_f = [_leaf_meta(x) for x in flat_f]
+    for (st, dt), (sf, df) in zip(metas_t, metas_f):
+        if [max(s, 1) for s in st] != [max(s, 1) for s in sf] \
+                or jnp.dtype(dt) != jnp.dtype(df):
+            raise ValueError(
+                "cond: branch outputs must match in shape and dtype; got "
+                f"{st}/{dt} vs {sf}/{df}")
+
+    prog = default_main_program()
+    _merge_params(sub_t, prog)
+    _merge_params(sub_f, prog)
+    ext = _collect_externals([sub_t, sub_f])
+    refs = [x for x in [pred] if _is_leaf(x)] + ext
+    payload = (pred, flat_t, flat_f, ext)
+    return _record_ctrl("__cond__", payload, refs, metas_t, tree_t, prog)
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """Static while loop (reference control_flow.py:682); lowering is
+    ``jax.lax.while_loop``, so the carry must keep shapes/dtypes — the
+    same invariance the reference demands of its loop-carried variables.
+
+    Note: like ``jax.lax.while_loop``, the lowered loop is forward-only;
+    training through a data-dependent-trip-count loop needs a bounded
+    ``lax.scan`` formulation (use dy2static's converters for that).
+    """
+    if not graph._progs() and not any(
+            isinstance(x, Variable)
+            for x in tree_flatten(loop_vars, is_leaf=_is_leaf)[0]):
+        # dygraph: honest python loop
+        vals = loop_vars
+        while True:
+            c = cond_fn(*vals)
+            if not bool(np.asarray(c.numpy() if hasattr(c, "numpy") else c)):
+                break
+            vals = body_fn(*vals)
+            if not isinstance(vals, (list, tuple)):
+                vals = [vals]
+        return vals
+
+    init_flat, init_tree = tree_flatten(loop_vars, is_leaf=_is_leaf)
+    metas = [_leaf_meta(x) for x in init_flat]
+
+    # one shared set of carry placeholders feeds BOTH traces so env0
+    # name-binding hits them identically
+    phprog = Program()
+    phs = []
+    for shape, dtype in metas:
+        ph = Variable(phprog, shape, dtype)
+        ph.stop_gradient = False
+        phprog.vars[ph.name] = ph
+        phs.append(ph)
+    carried = tree_unflatten(init_tree, phs)
+    if not isinstance(carried, (list, tuple)):
+        carried = [carried]
+
+    sub_c, flat_c, _ = _trace_subgraph(lambda: cond_fn(*carried))
+    if len(flat_c) != 1:
+        raise ValueError("while_loop: cond must return one boolean scalar")
+    sub_b, flat_b, tree_b = _trace_subgraph(lambda: body_fn(*carried))
+    if len(flat_b) != len(init_flat):
+        raise ValueError(
+            f"while_loop: body returned {len(flat_b)} values for "
+            f"{len(init_flat)} loop_vars")
+    for (s0, d0), x in zip(metas, flat_b):
+        s1, d1 = _leaf_meta(x)
+        if [max(s, 1) for s in s0] != [max(s, 1) for s in s1] \
+                or jnp.dtype(d0) != jnp.dtype(d1):
+            raise ValueError(
+                "while_loop: loop_vars must keep shape/dtype across the "
+                f"body; got {s0}/{d0} -> {s1}/{d1}")
+
+    prog = default_main_program()
+    _merge_params(sub_c, prog)
+    _merge_params(sub_b, prog)
+    ext = _collect_externals([sub_c, sub_b], exclude=phs)
+    refs = [x for x in init_flat if _is_leaf(x)] + ext
+    payload = (flat_c[0], flat_b, phs, init_flat, ext)
+    return _record_ctrl("__while__", payload, refs, metas, init_tree, prog)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First-match-wins chain (reference control_flow.py:961), desugared
+    into nested ``cond`` records."""
+    if not pred_fn_pairs:
+        raise ValueError("case: pred_fn_pairs must be non-empty")
+    pairs = list(pred_fn_pairs)
+    if default is None:
+        # reference semantics: the last pair's fn becomes the default
+        # (and its pred is dropped — control_flow.py case pops it)
+        _, default = pairs.pop()
+
+    def build(i):
+        if i >= len(pairs):
+            return default()
+        pred, fn = pairs[i]
+        return cond(pred, fn, lambda: build(i + 1))
+
+    return build(0)
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Integer dispatch (reference control_flow.py:1084), desugared into
+    an equality-cond chain (small fan-out; XLA folds it into a select
+    tree)."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        fns = list(branch_fns)
+        if fns and callable(fns[0]):
+            items = list(enumerate(fns))
+        else:
+            items = [(int(k), f) for k, f in fns]
+    if default is None:
+        default = items.pop()[1]
+
+    from ..ops.registry import apply_op
+
+    def build(i):
+        if i >= len(items):
+            return default()
+        idx, fn = items[i]
+        eq = apply_op("equal",
+                      lambda a, b: jnp.equal(a, jnp.asarray(b, a.dtype)),
+                      (branch_index, idx), {})
+        return cond(eq, fn, lambda: build(i + 1))
+
+    return build(0)
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    """Custom-gradient region (reference control_flow.py:1303).
+
+    forward_fn(*inputs) is traced as a sub-program; backward_fn receives
+    one grad per forward output and must return one grad per input.
+    Lowering wraps the region in ``jax.custom_vjp``.
+    """
+    inputs = list(inputs)
+    in_metas = [_leaf_meta(x) for x in inputs]
+
+    phprog = Program()
+    in_phs = []
+    for shape, dtype in in_metas:
+        ph = Variable(phprog, shape, dtype)
+        ph.stop_gradient = False
+        phprog.vars[ph.name] = ph
+        in_phs.append(ph)
+
+    sub_f, flat_f, tree_f = _trace_subgraph(lambda: forward_fn(*in_phs))
+    out_metas = [_leaf_meta(x) for x in flat_f]
+
+    bwd_outs, g_phs, sub_b = None, [], None
+    if backward_fn is not None:
+        g_phs = []
+        for shape, dtype in out_metas:
+            ph = Variable(phprog, shape, dtype)
+            ph.stop_gradient = False
+            phprog.vars[ph.name] = ph
+            g_phs.append(ph)
+        sub_b, bwd_outs, _ = _trace_subgraph(lambda: backward_fn(*g_phs))
+        if len(bwd_outs) != len(inputs):
+            raise ValueError(
+                f"static_pylayer: backward_fn returned {len(bwd_outs)} "
+                f"grads for {len(inputs)} inputs")
+
+    prog = default_main_program()
+    _merge_params(sub_f, prog)
+    subs = [sub_f]
+    if sub_b is not None:
+        _merge_params(sub_b, prog)
+        subs.append(sub_b)
+    ext = _collect_externals(subs, exclude=in_phs + g_phs)
+    refs = [x for x in inputs if _is_leaf(x)] + ext
+    payload = (flat_f, in_phs, inputs, bwd_outs, g_phs, ext)
+    return _record_ctrl("__pylayer__", payload, refs, out_metas, tree_f,
+                        prog)
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """reference: python/paddle/static/nn/control_flow.py Print — debug
+    passthrough via jax.debug.print at executor time."""
+    import jax
+
+    def body(x):
+        jax.debug.print((message or "") + " {}", x)
+        return x
+
+    from ..ops.registry import apply_op
+    return apply_op("print", body, (input,), {})

@@ -103,17 +103,21 @@ class Executor:
         stat_bufs = [b for b, _ in program.stat_updates]
         stat_vars = [v for _, v in program.stat_updates]
         if key not in self._cache:
-            def fn(feed, param_arrays, stat_arrays):
+            def fn(feed, param_arrays, stat_arrays, rng_key):
+                from ..framework import random as _random
                 pmap = {id(p): a for p, a in zip(params, param_arrays)}
                 pmap.update(
                     {id(b): a for b, a in zip(stat_bufs, stat_arrays)})
-                outs = graph.evaluate(fetch_vars + stat_vars, feed, pmap)
+                with _random.trace_key_guard(rng_key):
+                    outs = graph.evaluate(fetch_vars + stat_vars, feed, pmap)
                 n = len(fetch_vars)
                 return outs[:n], outs[n:]
             self._cache[key] = jax.jit(fn)
+        from ..framework import random as _random
         outs, stats = self._cache[key](feed_arrays,
                                        [p._data for p in params],
-                                       [b._data for b in stat_bufs])
+                                       [b._data for b in stat_bufs],
+                                       _random.default_generator.split())
         self._apply_stats(stat_bufs, stats)
         return outs
 
@@ -132,20 +136,25 @@ class Executor:
         stat_vars = [v for _, v in program.stat_updates]
         key = self._cache_key(program, feed_arrays, fetch_vars, True)
         if key not in self._cache:
-            def fwd(param_arrays, feed, stat_arrays):
+            def fwd(param_arrays, feed, stat_arrays, rng_key):
+                from ..framework import random as _random
                 pmap = {id(p): a for p, a in zip(params, param_arrays)}
                 pmap.update(
                     {id(b): a for b, a in zip(stat_bufs, stat_arrays)})
-                outs = graph.evaluate([loss_var] + fetch_vars + stat_vars,
-                                      feed, pmap)
+                with _random.trace_key_guard(rng_key):
+                    outs = graph.evaluate(
+                        [loss_var] + fetch_vars + stat_vars, feed, pmap)
                 n = 1 + len(fetch_vars)
                 return outs[0].astype(jnp.float32).sum(), \
                     (outs[1:n], outs[n:])
 
-            self._cache[key] = jax.jit(jax.value_and_grad(fwd, has_aux=True))
+            self._cache[key] = jax.jit(
+                jax.value_and_grad(fwd, has_aux=True))
+        from ..framework import random as _random
         (loss, (fetches, stats)), grads = self._cache[key](
             [p._data for p in params], feed_arrays,
-            [b._data for b in stat_bufs])
+            [b._data for b in stat_bufs],
+            _random.default_generator.split())
         self._apply_stats(stat_bufs, stats)
         # hand grads to the dygraph optimizer (reference: the appended
         # optimizer ops in the static program do this in-graph)

@@ -135,6 +135,22 @@ class Variable:
         from ..ops import manipulation as O
         return O.transpose(self, perm)
 
+    def _cmp(self, opname, other):
+        from ..ops import comparison as C
+        return getattr(C, opname)(self, other)
+
+    def __lt__(self, o):
+        return self._cmp("less_than", o)
+
+    def __le__(self, o):
+        return self._cmp("less_equal", o)
+
+    def __gt__(self, o):
+        return self._cmp("greater_than", o)
+
+    def __ge__(self, o):
+        return self._cmp("greater_equal", o)
+
     def __neg__(self):
         from ..ops.math import scale
         return scale(self, -1.0)
@@ -318,7 +334,12 @@ def build_node(opname, body, args, kwargs):
             leaves[i] = v
         return run_abstract(*leaves)
 
-    out_shape = jax.eval_shape(fn, *dyn)
+    # shape inference must not advance (or trace-poison) the global RNG:
+    # an RNG-consuming body under eval_shape would store a traced key
+    # back into the generator — give split_key a scoped throwaway key
+    from ..framework import random as _random
+    with _random.trace_key_guard(jax.random.PRNGKey(0)):
+        out_shape = jax.eval_shape(fn, *dyn)
     out_flat, out_treedef = tree_flatten(out_shape)
 
     outs = []
@@ -333,13 +354,18 @@ def build_node(opname, body, args, kwargs):
     return tree_unflatten(out_treedef, outs)
 
 
-def evaluate(fetch_vars, feed, params=None):
+def evaluate(fetch_vars, feed, params=None, env0=None):
     """Evaluate fetch Variables given feed dict (name -> np/jax array).
-    Returns list of jax arrays.  Used by Executor (jitted there)."""
+    Returns list of jax arrays.  Used by Executor (jitted there).
+
+    env0: preset name->array bindings — control-flow branch bodies use it
+    to bind their captured outer Variables / loop-carry placeholders to
+    already-evaluated (possibly traced) values.
+    """
     from jax.tree_util import tree_flatten, tree_unflatten
     from ..framework.tensor import Tensor
 
-    env = {}
+    env = dict(env0) if env0 else {}
 
     # batch all __grad__ fetches sharing a loss into ONE jax.grad sweep
     # (fetching N parameter grads must not cost N forward+backward passes)
@@ -378,6 +404,30 @@ def evaluate(fetch_vars, feed, params=None):
             for (v, _), g in zip(f_pairs, grads):
                 env[v.name] = g
 
+    def _leafvals(leaves, env0b):
+        """Resolve mixed Variable/Tensor/const leaves inside a control-flow
+        region: Variables share ONE evaluate call (memoized sub-env)."""
+        vs = [x for x in leaves if isinstance(x, Variable)]
+        vals = evaluate(vs, feed, params, env0b) if vs else []
+        it = iter(vals)
+        out = []
+        for x in leaves:
+            if isinstance(x, Variable):
+                out.append(next(it))
+            elif isinstance(x, Tensor):
+                out.append(params[id(x)] if params and id(x) in params
+                           else x._data)
+            else:
+                out.append(jnp.asarray(x))
+        return out
+
+    def _outer_leaf(x):
+        if isinstance(x, Variable):
+            return eval_var(x)
+        if isinstance(x, Tensor):
+            return params[id(x)] if params and id(x) in params else x._data
+        return jnp.asarray(x)
+
     def eval_var(v):
         if v.name in env:
             return env[v.name]
@@ -385,6 +435,81 @@ def evaluate(fetch_vars, feed, params=None):
             if v.name not in feed:
                 raise KeyError(f"feed missing input {v.name!r}")
             val = feed[v.name]
+        elif v.source[0] == "__cond__":
+            # region lowering: jax.lax.cond over the traced branch
+            # subgraphs (control_flow.py); captured outer Variables are
+            # evaluated HERE (memoized in this env) and bound by name
+            pred, flat_t, flat_f, ext = v.source[1]
+            pred_val = jnp.reshape(_outer_leaf(pred), ()).astype(bool)
+            env0b = {e.name: eval_var(e) for e in ext}
+
+            def mk(outs):
+                return lambda _: tuple(_leafvals(outs, env0b))
+
+            res = jax.lax.cond(pred_val, mk(flat_t), mk(flat_f), 0)
+            for sib in v.program.vars.values():
+                if sib.source is v.source:
+                    env[sib.name] = res[sib.out_index]
+            val = res[v.out_index]
+        elif v.source[0] == "__while__":
+            cond_out, body_outs, phs, init_leaves, ext = v.source[1]
+            env0b = {e.name: eval_var(e) for e in ext}
+            init = tuple(jnp.asarray(_outer_leaf(x)) for x in init_leaves)
+
+            def cond_f(carry):
+                e = dict(env0b)
+                e.update({p.name: c for p, c in zip(phs, carry)})
+                return jnp.reshape(
+                    _leafvals([cond_out], e)[0], ()).astype(bool)
+
+            def body_f(carry):
+                e = dict(env0b)
+                e.update({p.name: c for p, c in zip(phs, carry)})
+                return tuple(jnp.asarray(r).astype(c.dtype)
+                             for r, c in zip(_leafvals(body_outs, e),
+                                             carry))
+
+            res = jax.lax.while_loop(cond_f, body_f, init)
+            for sib in v.program.vars.values():
+                if sib.source is v.source:
+                    env[sib.name] = res[sib.out_index]
+            val = res[v.out_index]
+        elif v.source[0] == "__pylayer__":
+            flat_f, in_phs, input_leaves, bwd_outs, g_phs, ext = v.source[1]
+            env0b = {e.name: eval_var(e) for e in ext}
+            ins = tuple(_outer_leaf(x) for x in input_leaves)
+            exts = tuple(env0b[e.name] for e in ext)
+
+            def run_fwd(xs, es):
+                e = {n.name: a for n, a in zip(ext, es)}
+                e.update({p.name: x for p, x in zip(in_phs, xs)})
+                return tuple(_leafvals(flat_f, e))
+
+            if bwd_outs is None:
+                res = run_fwd(ins, exts)
+            else:
+                def f(xs, es):
+                    return run_fwd(xs, es)
+
+                f = jax.custom_vjp(f)
+
+                def fwd_rule(xs, es):
+                    return run_fwd(xs, es), (xs, es)
+
+                def bwd_rule(resid, gs):
+                    xs, es = resid
+                    e = {n.name: a for n, a in zip(ext, es)}
+                    e.update({p.name: g for p, g in zip(g_phs, gs)})
+                    dins = tuple(_leafvals(bwd_outs, e))
+                    dexts = tuple(jnp.zeros_like(a) for a in es)
+                    return (dins, dexts)
+
+                f.defvjp(fwd_rule, bwd_rule)
+                res = f(ins, exts)
+            for sib in v.program.vars.values():
+                if sib.source is v.source:
+                    env[sib.name] = res[sib.out_index]
+            val = res[v.out_index]
         elif v.source[0] == "__grad__":
             # static autodiff node (append_backward/gradients): grad of a
             # scalar-summed target w.r.t. a parameter Tensor or feed var

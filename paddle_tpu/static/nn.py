@@ -1,15 +1,42 @@
-"""paddle.static.nn — graph-building layer helpers.
+"""paddle.static.nn — graph-building layer helpers + static control flow.
 
-Reference: python/paddle/static/nn/common.py (fc, batch_norm, conv2d...).
-Each helper instantiates the dygraph layer (parameters init eagerly — the
-"startup program" role) and applies it to the symbolic Variable; the op
-registry records the resulting DAG nodes.
+Reference: python/paddle/static/nn/__init__.py (30 symbols: common.py
+layer helpers + control_flow.py cond/while_loop/case/switch_case/
+static_pylayer + sequence_lod.py sequence_* ops).
+
+Each layer helper instantiates the dygraph layer (parameters init
+eagerly — the "startup program" role) and applies it to the symbolic
+Variable; the op registry records the resulting DAG nodes.  The
+sequence_* family operates on padded dense batches ``[N, T, ...]`` —
+the TPU formulation of the reference's LoD ragged tensors (static
+shapes; ragged boundaries travel as explicit length/mask arguments
+where they matter).
 """
 from __future__ import annotations
 
-from .. import nn as dynn
+import numpy as np
 
-__all__ = ["fc", "conv2d", "batch_norm", "embedding"]
+from .. import nn as dynn
+from .control_flow import (Print, case, cond, static_pylayer, switch_case,
+                           while_loop)
+from .compat import py_func
+
+__all__ = [
+    "fc", "batch_norm", "bilinear_tensor_product", "embedding", "case",
+    "cond", "static_pylayer", "conv2d", "conv2d_transpose", "conv3d",
+    "conv3d_transpose", "data_norm", "deform_conv2d", "group_norm",
+    "instance_norm", "layer_norm", "nce", "prelu", "py_func", "row_conv",
+    "spectral_norm", "switch_case", "while_loop", "sparse_embedding",
+    "sequence_conv", "sequence_softmax", "sequence_pool",
+    "sequence_first_step", "sequence_last_step", "sequence_expand",
+]
+
+
+def _act(out, activation):
+    if activation:
+        import paddle_tpu.nn.functional as F
+        out = getattr(F, activation)(out)
+    return out
 
 
 def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
@@ -23,11 +50,7 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
     if len(x.shape) > num_flatten_dims + 1:
         from ..ops.manipulation import flatten
         h = flatten(h, start_axis=num_flatten_dims)
-    out = layer(h)
-    if activation:
-        import paddle_tpu.nn.functional as F
-        out = getattr(F, activation)(out)
-    return out
+    return _act(layer(h), activation)
 
 
 def conv2d(input, num_filters, filter_size, stride=1, padding=0,
@@ -38,11 +61,50 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0,
                         padding=padding, dilation=dilation, groups=groups,
                         weight_attr=param_attr, bias_attr=bias_attr,
                         data_format=data_format)
-    out = layer(input)
-    if act:
-        import paddle_tpu.nn.functional as F
-        out = getattr(F, act)(out)
-    return out
+    return _act(layer(input), act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCHW"):
+    """reference: python/paddle/static/nn/common.py conv2d_transpose."""
+    if filter_size is None:
+        raise ValueError("conv2d_transpose: filter_size is required "
+                         "(output_size-only inference not supported)")
+    in_ch = int(input.shape[1 if data_format == "NCHW" else -1])
+    layer = dynn.Conv2DTranspose(
+        in_ch, num_filters, filter_size, stride=stride, padding=padding,
+        dilation=dilation, groups=groups, weight_attr=param_attr,
+        bias_attr=bias_attr, data_format=data_format)
+    out = layer(input, output_size=output_size) \
+        if output_size is not None else layer(input)
+    return _act(out, act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCDHW"):
+    in_ch = int(input.shape[1 if data_format == "NCDHW" else -1])
+    layer = dynn.Conv3D(in_ch, num_filters, filter_size, stride=stride,
+                        padding=padding, dilation=dilation, groups=groups,
+                        weight_attr=param_attr, bias_attr=bias_attr,
+                        data_format=data_format)
+    return _act(layer(input), act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCDHW"):
+    if filter_size is None:
+        raise ValueError("conv3d_transpose: filter_size is required")
+    in_ch = int(input.shape[1 if data_format == "NCDHW" else -1])
+    layer = dynn.Conv3DTranspose(
+        in_ch, num_filters, filter_size, stride=stride, padding=padding,
+        dilation=dilation, groups=groups, weight_attr=param_attr,
+        bias_attr=bias_attr, data_format=data_format)
+    return _act(layer(input), act)
 
 
 def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,
@@ -52,15 +114,316 @@ def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,
     layer = dynn.BatchNorm2D(ch, momentum=momentum, epsilon=epsilon,
                              weight_attr=param_attr, bias_attr=bias_attr,
                              data_format=data_layout)
-    out = layer(input)
-    if act:
-        import paddle_tpu.nn.functional as F
-        out = getattr(F, act)(out)
-    return out
+    return _act(layer(input), act)
 
 
-def embedding(input, size, is_sparse=False, padding_idx=None,
-              param_attr=None, dtype="float32"):
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    """reference: common.py group_norm."""
+    ch = int(input.shape[1 if data_layout == "NCHW" else -1])
+    layer = dynn.GroupNorm(groups, ch, epsilon=epsilon,
+                           weight_attr=param_attr, bias_attr=bias_attr,
+                           data_format=data_layout)
+    return _act(layer(input), act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    """reference: common.py instance_norm (2-D spatial input)."""
+    ch = int(input.shape[1])
+    cls = {3: dynn.InstanceNorm1D, 4: dynn.InstanceNorm2D,
+           5: dynn.InstanceNorm3D}[len(input.shape)]
+    layer = cls(ch, epsilon=epsilon, weight_attr=param_attr,
+                bias_attr=bias_attr)
+    return layer(input)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    """reference: common.py layer_norm — normalizes over
+    input.shape[begin_norm_axis:]."""
+    normalized_shape = [int(s) for s in input.shape[begin_norm_axis:]]
+    layer = dynn.LayerNorm(normalized_shape, epsilon=epsilon,
+                           weight_attr=param_attr if scale else False,
+                           bias_attr=bias_attr if shift else False)
+    return _act(layer(input), act)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """reference: common.py data_norm — normalization by accumulated
+    batch statistics without learned affine (CTR models).  Dense
+    formulation: standardize each feature by batch mean/std."""
+    from ..ops import reduction as R
+    from ..ops.math import sqrt
+
+    mean = R.mean(input, axis=0, keepdim=True)
+    var = R.var(input, axis=0, unbiased=False, keepdim=True)
+    out = (input - mean) / sqrt(var + epsilon)
+    return _act(out, act)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """reference: common.py bilinear_tensor_product: out_k = x W_k y^T."""
+    layer = dynn.Bilinear(int(x.shape[-1]), int(y.shape[-1]), size,
+                          weight_attr=param_attr, bias_attr=bias_attr)
+    return _act(layer(x, y), act)
+
+
+def deform_conv2d(input, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None,
+                  name=None):
+    """reference: common.py deform_conv2d → vision.ops.deform_conv2d
+    engine with an eagerly initialized weight."""
+    from .compat import create_parameter
+    from ..vision.ops import deform_conv2d as _dc
+
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    in_ch = int(input.shape[1])
+    weight = create_parameter(
+        [num_filters, in_ch // groups, ks[0], ks[1]], "float32",
+        attr=param_attr)
+    bias = create_parameter([num_filters], "float32", attr=bias_attr,
+                            is_bias=True) if bias_attr is not False else None
+    return _dc(input, offset, weight, bias=bias, stride=stride,
+               padding=padding, dilation=dilation,
+               deformable_groups=deformable_groups, groups=groups,
+               mask=mask)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
     layer = dynn.Embedding(size[0], size[1], padding_idx=padding_idx,
                            sparse=is_sparse, weight_attr=param_attr)
     return layer(input)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32", slot=None):
+    """reference: common.py sparse_embedding (parameter-server lookup
+    table).  TPU formulation: a dense embedding whose gradient flows as
+    rows (SelectedRows analog); the PS path shards it via
+    distributed.ps tables."""
+    return embedding(input, size, is_sparse=True, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype)
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """reference: common.py nce — noise-contrastive estimation loss.
+    TPU formulation: uniform negative sampling with a fixed sample count
+    (static shapes), logistic loss over [pos | negs] logits."""
+    from .compat import create_parameter
+    from ..ops.registry import apply_op
+    import jax
+    import jax.numpy as jnp
+
+    dim = int(input.shape[-1])
+    k = int(num_neg_samples or 10)
+    weight = create_parameter([num_total_classes, dim], "float32",
+                              attr=param_attr)
+    bias = create_parameter([num_total_classes], "float32", attr=bias_attr,
+                            is_bias=True)
+
+    def body(x, lab, w, b):
+        from ..framework import random as _random
+        lab = lab.reshape((-1,))
+        n = x.shape[0]
+        negs = jax.random.randint(_random.split_key(), (n, k), 0,
+                                  num_total_classes)
+        pos_logit = jnp.einsum("nd,nd->n", x, w[lab]) + b[lab]
+        neg_logit = jnp.einsum("nd,nkd->nk", x, w[negs]) + b[negs]
+        # log-sigmoid losses: positive attracted, negatives repelled
+        pos_loss = jax.nn.softplus(-pos_logit)
+        neg_loss = jax.nn.softplus(neg_logit).sum(axis=1)
+        return (pos_loss + neg_loss).reshape((-1, 1))
+
+    return apply_op("nce", body, (input, label, weight, bias), {})
+
+
+def prelu(x, mode, param_attr=None, data_format="NCHW", name=None):
+    """reference: common.py prelu — modes all/channel/element."""
+    if mode == "all":
+        layer = dynn.PReLU(num_parameters=1, weight_attr=param_attr,
+                           data_format=data_format)
+        return layer(x)
+    if mode == "channel":
+        num = int(x.shape[1 if data_format == "NCHW" else -1])
+        layer = dynn.PReLU(num_parameters=num, weight_attr=param_attr,
+                           data_format=data_format)
+        return layer(x)
+    if mode == "element":
+        # per-element slope, weight shaped like one sample
+        from .compat import create_parameter
+        from ..ops.registry import apply_op
+        import jax.numpy as jnp
+
+        alpha = create_parameter([int(s) for s in x.shape[1:]], "float32",
+                                 attr=param_attr)
+
+        def body(v, a):
+            return jnp.where(v >= 0, v, a * v)
+
+        return apply_op("prelu_element", body, (x, alpha), {})
+    raise ValueError(f"prelu: unknown mode {mode!r}")
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """reference: common.py row_conv (lookahead convolution over the
+    time axis of [N, T, D] batches — the LoD form collapses to padded
+    dense here)."""
+    from .compat import create_parameter
+    from ..ops.registry import apply_op
+    import jax.numpy as jnp
+
+    d = int(input.shape[-1])
+    w = create_parameter([future_context_size + 1, d], "float32",
+                         attr=param_attr)
+
+    def body(x, wt):
+        outs = jnp.zeros_like(x)
+        T = x.shape[1]
+        for i in range(future_context_size + 1):
+            shifted = jnp.pad(x[:, i:, :], ((0, 0), (0, i), (0, 0)))
+            outs = outs + shifted * wt[i]
+        return outs
+
+    return _act(apply_op("row_conv", body, (input, w), {}), act)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """reference: common.py spectral_norm — returns W / sigma_max(W)
+    estimated by power iteration (stateless static form: fresh u/v)."""
+    from ..ops.registry import apply_op
+    import jax.numpy as jnp
+
+    def body(w):
+        perm = [dim] + [i for i in range(w.ndim) if i != dim]
+        m = jnp.transpose(w, perm).reshape((w.shape[dim], -1))
+        u = jnp.ones((m.shape[0],), m.dtype) / np.sqrt(m.shape[0])
+        v = None
+        for _ in range(max(1, power_iters)):
+            v = m.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = m @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ (m @ v)
+        return w / (sigma + eps)
+
+    return apply_op("spectral_norm_static", body, (weight,), {})
+
+
+# ------------------------------------------------------- sequence family
+# reference: python/paddle/static/nn/sequence_lod.py.  LoD ragged rows
+# become padded dense [N, T, ...] batches on TPU (static shapes).
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, param_attr=None,
+                  bias_attr=None, act=None, name=None):
+    """reference: sequence_lod.py sequence_conv over [N, T, D]."""
+    from .compat import create_parameter
+    from ..ops.registry import apply_op
+    import jax
+    import jax.numpy as jnp
+
+    d = int(input.shape[-1])
+    w = create_parameter([filter_size * d, num_filters], "float32",
+                         attr=param_attr)
+    b = create_parameter([num_filters], "float32", attr=bias_attr,
+                         is_bias=True) if bias_attr is not False else None
+
+    start = -(filter_size // 2) if padding_start is None else padding_start
+
+    def body(x, wt, bt=None):
+        n, t, _ = x.shape
+        cols = []
+        for i in range(filter_size):
+            off = start + i
+            if off < 0:
+                s = jnp.pad(x[:, :t + off, :],
+                            ((0, 0), (-off, 0), (0, 0)))
+            elif off > 0:
+                s = jnp.pad(x[:, off:, :], ((0, 0), (0, off), (0, 0)))
+            else:
+                s = x
+            cols.append(s)
+        col = jnp.concatenate(cols, axis=-1)        # [N, T, k*D]
+        out = col @ wt
+        if bt is not None:
+            out = out + bt
+        return out
+
+    args = (input, w) if b is None else (input, w, b)
+    return _act(apply_op("sequence_conv", body, args, {}), act)
+
+
+def sequence_softmax(input, use_cudnn=False, name=None):
+    """softmax over the time axis of [N, T] / [N, T, 1]."""
+    from ..ops.registry import apply_op
+    import jax
+
+    def body(x):
+        axis = 1 if x.ndim > 1 else 0
+        return jax.nn.softmax(x, axis=axis)
+
+    return apply_op("sequence_softmax", body, (input,), {})
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0):
+    """reference: sequence_lod.py sequence_pool over the time axis:
+    average/sum/sqrt/max/last/first."""
+    from ..ops.registry import apply_op
+    import jax.numpy as jnp
+
+    pt = pool_type.lower()
+
+    def body(x):
+        if pt == "average":
+            return x.mean(axis=1)
+        if pt == "sum":
+            return x.sum(axis=1)
+        if pt == "sqrt":
+            return x.sum(axis=1) / np.sqrt(x.shape[1])
+        if pt == "max":
+            return x.max(axis=1)
+        if pt == "last":
+            return x[:, -1]
+        if pt == "first":
+            return x[:, 0]
+        raise ValueError(f"sequence_pool: unknown pool_type {pool_type!r}")
+
+    return apply_op("sequence_pool", body, (input,), {})
+
+
+def sequence_first_step(input):
+    return sequence_pool(input, "first")
+
+
+def sequence_last_step(input):
+    return sequence_pool(input, "last")
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """reference: sequence_lod.py sequence_expand — broadcast x rows to
+    y's time length (dense padded form: tile along axis 1)."""
+    from ..ops.registry import apply_op
+    import jax.numpy as jnp
+
+    def body(a, bref):
+        t = bref.shape[1]
+        if a.ndim == 2:
+            a = a[:, None, :]
+        return jnp.broadcast_to(a, (a.shape[0], t, a.shape[-1]))
+
+    return apply_op("sequence_expand", body, (x, y), {})

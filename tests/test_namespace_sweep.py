@@ -49,6 +49,7 @@ class TestSubNamespaceParity:
             (R + "nn/initializer/__init__.py", paddle_tpu.nn.initializer),
             (R + "autograd/__init__.py", paddle_tpu.autograd),
             (R + "static/__init__.py", paddle_tpu.static),
+            (R + "static/nn/__init__.py", paddle_tpu.static.nn),
             (R + "io/__init__.py", paddle_tpu.io),
             (R + "distributed/__init__.py", paddle_tpu.distributed),
             (R + "nn/functional/__init__.py", paddle_tpu.nn.functional),

@@ -1,0 +1,350 @@
+"""Static-graph control flow + the rest of paddle.static.nn.
+
+Reference: python/paddle/static/nn/control_flow.py (cond:1509,
+while_loop:682, case:961, switch_case:1084, static_pylayer:1303) and
+common.py layer helpers.  Lowering: lax.cond / lax.while_loop /
+jax.custom_vjp at executor-jit time (static/control_flow.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+import paddle_tpu.static.nn as snn
+
+rng = np.random.RandomState(7)
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def _exe():
+    return static.Executor()
+
+
+class TestCond:
+    def test_both_branches(self):
+        m = static.Program()
+        with static.program_guard(m):
+            x = static.data("x", [4], "float32")
+            flag = static.data("flag", [1], "bool")
+            out = snn.cond(flag, lambda: x * 2.0, lambda: x + 10.0)
+        exe = _exe()
+        xv = np.arange(4, dtype=np.float32)
+        r_t = exe.run(m, feed={"x": xv, "flag": np.array([True])},
+                      fetch_list=[out])[0]
+        r_f = exe.run(m, feed={"x": xv, "flag": np.array([False])},
+                      fetch_list=[out])[0]
+        np.testing.assert_allclose(r_t, xv * 2.0)
+        np.testing.assert_allclose(r_f, xv + 10.0)
+
+    def test_matches_eager_twin(self):
+        def compute(xv, flag):
+            return xv * 3.0 + 1.0 if flag else xv ** 2
+
+        m = static.Program()
+        with static.program_guard(m):
+            x = static.data("x", [3], "float32")
+            f = static.data("f", [1], "bool")
+            out = snn.cond(f, lambda: x * 3.0 + 1.0, lambda: x ** 2)
+        exe = _exe()
+        xv = rng.randn(3).astype(np.float32)
+        for flag in (True, False):
+            got = exe.run(m, feed={"x": xv, "f": np.array([flag])},
+                          fetch_list=[out])[0]
+            np.testing.assert_allclose(got, compute(xv, flag), rtol=1e-6)
+
+    def test_nested_cond(self):
+        m = static.Program()
+        with static.program_guard(m):
+            x = static.data("x", [2], "float32")
+            a = static.data("a", [1], "bool")
+            b = static.data("b", [1], "bool")
+            out = snn.cond(
+                a,
+                lambda: snn.cond(b, lambda: x * 2.0, lambda: x * 3.0),
+                lambda: x * 5.0)
+        exe = _exe()
+        xv = np.ones(2, np.float32)
+        for av, bv, scale in [(True, True, 2), (True, False, 3),
+                              (False, True, 5)]:
+            r = exe.run(m, feed={"x": xv, "a": np.array([av]),
+                                 "b": np.array([bv])}, fetch_list=[out])[0]
+            np.testing.assert_allclose(r, xv * scale)
+
+    def test_tuple_outputs(self):
+        m = static.Program()
+        with static.program_guard(m):
+            x = static.data("x", [2], "float32")
+            f = static.data("f", [1], "bool")
+            a, b = snn.cond(f, lambda: (x + 1.0, x * 2.0),
+                            lambda: (x - 1.0, x / 2.0))
+        exe = _exe()
+        xv = np.array([2.0, 4.0], np.float32)
+        ra, rb = exe.run(m, feed={"x": xv, "f": np.array([True])},
+                         fetch_list=[a, b])
+        np.testing.assert_allclose(ra, xv + 1)
+        np.testing.assert_allclose(rb, xv * 2)
+
+    def test_mismatched_branches_raise(self):
+        m = static.Program()
+        with static.program_guard(m):
+            x = static.data("x", [4], "float32")
+            f = static.data("f", [1], "bool")
+            with pytest.raises(ValueError):
+                snn.cond(f, lambda: x, lambda: (x, x))
+
+    def test_training_through_cond(self):
+        m = static.Program()
+        with static.program_guard(m):
+            x = static.data("x", [4, 3], "float32")
+            f = static.data("f", [1], "bool")
+            h = snn.fc(x, 8, activation="relu")
+            out = snn.cond(f, lambda: snn.fc(h, 2),
+                           lambda: h[:, :2] * 0.0)
+            loss = paddle.sum(out * out)
+            opt = paddle.optimizer.SGD(learning_rate=0.05)
+            opt.minimize(loss)
+        exe = _exe()
+        feed = {"x": rng.randn(4, 3).astype(np.float32),
+                "f": np.array([True])}
+        l0 = exe.run(m, feed=feed, fetch_list=[loss])[0]
+        for _ in range(10):
+            l1 = exe.run(m, feed=feed, fetch_list=[loss])[0]
+        assert float(l1) < float(l0)
+
+    def test_dygraph_fallback(self):
+        paddle.disable_static()
+        try:
+            x = paddle.to_tensor([1.0, 2.0])
+            out = snn.cond(paddle.to_tensor([True]),
+                           lambda: x * 2, lambda: x)
+            np.testing.assert_allclose(out.numpy(), [2.0, 4.0])
+        finally:
+            paddle.enable_static()
+
+
+class TestWhileLoop:
+    def test_counter(self):
+        m = static.Program()
+        with static.program_guard(m):
+            i = static.data("i", [1], "int32")
+            s = static.data("s", [1], "float32")
+            i2, s2 = snn.while_loop(lambda i, s: i < 5,
+                                    lambda i, s: [i + 1, s * 2.0], [i, s])
+        exe = _exe()
+        ri, rs = exe.run(m, feed={"i": np.array([0], np.int32),
+                                  "s": np.array([1.0], np.float32)},
+                         fetch_list=[i2, s2])
+        assert int(ri[0]) == 5
+        np.testing.assert_allclose(rs, [32.0])
+
+    def test_matches_eager_twin(self):
+        m = static.Program()
+        with static.program_guard(m):
+            x = static.data("x", [3], "float32")
+            n = static.data("n", [1], "int32")
+            i0 = static.data("i0", [1], "int32")
+            _, out = snn.while_loop(
+                lambda i, v: i < n,
+                lambda i, v: [i + 1, v * 1.5 + 1.0], [i0, x])
+        exe = _exe()
+        xv = rng.randn(3).astype(np.float32)
+        ref = xv.copy()
+        for _ in range(4):
+            ref = ref * 1.5 + 1.0
+        got = exe.run(m, feed={"x": xv, "n": np.array([4], np.int32),
+                               "i0": np.array([0], np.int32)},
+                      fetch_list=[out])[1 - 1]
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_shape_change_raises(self):
+        m = static.Program()
+        with static.program_guard(m):
+            x = static.data("xs", [3], "float32")
+            i = static.data("is", [1], "int32")
+            with pytest.raises(ValueError):
+                snn.while_loop(lambda i, v: i < 2,
+                               lambda i, v: [i + 1, v[:2]], [i, x])
+
+
+class TestCaseSwitch:
+    def test_case_first_match_wins(self):
+        m = static.Program()
+        with static.program_guard(m):
+            a = static.data("a", [1], "float32")
+            x = static.data("x", [2], "float32")
+            out = snn.case([(a > 2.0, lambda: x * 100.0),
+                            (a > 1.0, lambda: x * 10.0)],
+                           default=lambda: x)
+        exe = _exe()
+        xv = np.ones(2, np.float32)
+        for av, scale in [(3.0, 100.0), (1.5, 10.0), (0.5, 1.0)]:
+            r = exe.run(m, feed={"a": np.array([av], np.float32), "x": xv},
+                        fetch_list=[out])[0]
+            np.testing.assert_allclose(r, xv * scale)
+
+    def test_switch_case(self):
+        m = static.Program()
+        with static.program_guard(m):
+            idx = static.data("idx", [1], "int32")
+            x = static.data("x", [3], "float32")
+            out = snn.switch_case(idx, {0: lambda: x * 0.0,
+                                        1: lambda: x + 1.0,
+                                        2: lambda: x * 10.0})
+        exe = _exe()
+        xv = np.ones(3, np.float32)
+        for k, want in [(0, xv * 0), (1, xv + 1), (2, xv * 10),
+                        (7, xv * 10)]:     # out-of-range -> default (last)
+            r = exe.run(m, feed={"idx": np.array([k], np.int32), "x": xv},
+                        fetch_list=[out])[0]
+            np.testing.assert_allclose(r, want)
+
+
+class TestStaticPyLayer:
+    def test_forward_and_custom_backward(self):
+        m = static.Program()
+        with static.program_guard(m):
+            x = static.data("x", [2], "float32")
+            x.stop_gradient = False
+            out = snn.static_pylayer(lambda v: v * v, [x],
+                                     backward_fn=lambda dy: dy * 7.0)
+            (g,) = static.gradients([out], [x])
+        exe = _exe()
+        ro, rg = exe.run(m, feed={"x": np.array([2.0, 3.0], np.float32)},
+                         fetch_list=[out, g])
+        np.testing.assert_allclose(ro, [4.0, 9.0])
+        np.testing.assert_allclose(rg, [7.0, 7.0])   # custom, not 2x
+
+    def test_forward_only(self):
+        m = static.Program()
+        with static.program_guard(m):
+            x = static.data("x", [3], "float32")
+            out = snn.static_pylayer(lambda v: v + 5.0, [x])
+        exe = _exe()
+        r = exe.run(m, feed={"x": np.zeros(3, np.float32)},
+                    fetch_list=[out])[0]
+        np.testing.assert_allclose(r, np.full(3, 5.0))
+
+
+class TestStaticNnLayers:
+    def _run(self, build, feeds):
+        m = static.Program()
+        with static.program_guard(m):
+            vars_, out = build()
+        exe = _exe()
+        return exe.run(m, feed=feeds, fetch_list=[out])[0]
+
+    def test_layer_norm(self):
+        xv = rng.randn(4, 6).astype(np.float32)
+
+        def build():
+            x = static.data("x", [4, 6], "float32")
+            return [x], snn.layer_norm(x, begin_norm_axis=1)
+
+        r = self._run(build, {"x": xv})
+        ref = (xv - xv.mean(1, keepdims=True)) / np.sqrt(
+            xv.var(1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(r, ref, rtol=1e-4, atol=1e-4)
+
+    def test_group_instance_norm_shapes(self):
+        xv = rng.randn(2, 8, 5, 5).astype(np.float32)
+
+        def build_g():
+            x = static.data("x", [2, 8, 5, 5], "float32")
+            return [x], snn.group_norm(x, groups=4)
+
+        def build_i():
+            x = static.data("x", [2, 8, 5, 5], "float32")
+            return [x], snn.instance_norm(x)
+
+        assert self._run(build_g, {"x": xv}).shape == xv.shape
+        assert self._run(build_i, {"x": xv}).shape == xv.shape
+
+    def test_conv2d_transpose_shape(self):
+        xv = rng.randn(1, 3, 8, 8).astype(np.float32)
+
+        def build():
+            x = static.data("x", [1, 3, 8, 8], "float32")
+            return [x], snn.conv2d_transpose(x, 6, filter_size=2, stride=2)
+
+        assert self._run(build, {"x": xv}).shape == (1, 6, 16, 16)
+
+    def test_sequence_family(self):
+        xv = rng.randn(2, 5, 3).astype(np.float32)
+
+        def build(fn):
+            def b():
+                x = static.data("x", [2, 5, 3], "float32")
+                return [x], fn(x)
+            return b
+
+        np.testing.assert_allclose(
+            self._run(build(lambda x: snn.sequence_pool(x, "sum")),
+                      {"x": xv}), xv.sum(1), rtol=1e-5)
+        np.testing.assert_allclose(
+            self._run(build(snn.sequence_first_step), {"x": xv}), xv[:, 0],
+            rtol=1e-6)
+        np.testing.assert_allclose(
+            self._run(build(snn.sequence_last_step), {"x": xv}), xv[:, -1],
+            rtol=1e-6)
+        sm = self._run(build(snn.sequence_softmax), {"x": xv})
+        np.testing.assert_allclose(sm.sum(1), np.ones((2, 3)), rtol=1e-5)
+        out = self._run(build(
+            lambda x: snn.sequence_conv(x, 4, filter_size=3)), {"x": xv})
+        assert out.shape == (2, 5, 4)
+
+    def test_spectral_norm_value(self):
+        wv = (5 * rng.randn(6, 4)).astype(np.float32)
+
+        def build():
+            w = static.data("w", [6, 4], "float32")
+            return [w], snn.spectral_norm(w, power_iters=30)
+
+        r = self._run(build, {"w": wv})
+        s = np.linalg.svd(r, compute_uv=False)
+        np.testing.assert_allclose(s[0], 1.0, rtol=0.05)
+
+    def test_bilinear_row_prelu_nce_shapes(self):
+        m = static.Program()
+        with static.program_guard(m):
+            x = static.data("x", [3, 4], "float32")
+            y = static.data("y", [3, 5], "float32")
+            out = snn.bilinear_tensor_product(x, y, 6)
+            seq = static.data("seq", [2, 7, 4], "float32")
+            rc = snn.row_conv(seq, 2)
+            pr = snn.prelu(x, mode="all")
+            lab = static.data("lab", [3, 1], "int64")
+            loss = snn.nce(x, lab, num_total_classes=11, num_neg_samples=3)
+            dn = snn.data_norm(x)
+        exe = _exe()
+        feeds = {"x": rng.randn(3, 4).astype(np.float32),
+                 "y": rng.randn(3, 5).astype(np.float32),
+                 "seq": rng.randn(2, 7, 4).astype(np.float32),
+                 "lab": rng.randint(0, 11, (3, 1)).astype(np.int64)}
+        ro, rr, rp, rl, rd = exe.run(m, feed=feeds,
+                                     fetch_list=[out, rc, pr, loss, dn])
+        assert ro.shape == (3, 6)
+        assert rr.shape == (2, 7, 4)
+        assert rp.shape == (3, 4)
+        assert rl.shape == (3, 1)
+        np.testing.assert_allclose(rd.mean(0), 0.0, atol=1e-5)
+
+    def test_namespace_complete(self):
+        import ast
+        import os
+        path = "/root/reference/python/paddle/static/nn/__init__.py"
+        if not os.path.exists(path):
+            pytest.skip("no reference")
+        ref = []
+        for node in ast.walk(ast.parse(open(path).read())):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if getattr(t, "id", None) == "__all__":
+                        ref = ast.literal_eval(node.value)
+        missing = sorted(set(ref) - set(dir(snn)))
+        assert not missing, missing
