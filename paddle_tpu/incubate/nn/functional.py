@@ -122,17 +122,13 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
         cos = jnp.squeeze(cos, (0, 2))[position_ids][:, :, None, :]
         sin = jnp.squeeze(sin, (0, 2))[position_ids][:, :, None, :]
 
-    def rot_half(x):
-        if use_neox_rotary_style:
-            a, b = jnp.split(x, 2, axis=-1)
-            return jnp.concatenate([-b, a], axis=-1)
-        x2 = x.reshape(*x.shape[:-1], -1, 2)
-        a, b = x2[..., 0], x2[..., 1]
-        return jnp.stack([-b, a], axis=-1).reshape(x.shape)
+    from .serving import _rot_half
 
     def apply(x):
-        return (x * cos + rot_half(x) * sin).astype(x.dtype) \
-            if x is not None else None
+        if x is None:
+            return None
+        return (x * cos
+                + _rot_half(x, use_neox_rotary_style) * sin).astype(x.dtype)
 
     outs = tuple(apply(t) for t in (q, k, v))
     return outs
@@ -215,3 +211,35 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
         out = F.layer_norm(out, [out.shape[-1]], weight=ln2_scale,
                            bias=ln2_bias, epsilon=ln2_epsilon)
     return out
+
+
+# ------------------------------------------------- serving fused-op surface
+# (block_multihead_attention etc. — see serving.py for the engines)
+from .serving import (swiglu, fused_matmul_bias, blha_get_max_len,  # noqa: E402,F401
+                      variable_length_memory_efficient_attention,
+                      masked_multihead_attention,
+                      block_multihead_attention, fused_moe,
+                      fused_multi_transformer)
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-05, training=True,
+        mode="upscale_in_train", name=None):
+    """reference fused_transformer.py fused_bias_dropout_residual_layer_norm:
+    layer_norm(residual + dropout(x + bias))."""
+    import paddle_tpu.nn.functional as F
+    h = x if bias is None else x + bias
+    h = F.dropout(h, p=dropout_rate, training=training, mode=mode)
+    out = residual + h
+    w = ln_scale
+    b = ln_bias
+    return F.layer_norm(out, [int(out.shape[-1])], weight=w, bias=b,
+                        epsilon=ln_epsilon)
+
+
+__all__ += ["swiglu", "fused_matmul_bias", "blha_get_max_len",
+            "variable_length_memory_efficient_attention",
+            "masked_multihead_attention", "block_multihead_attention",
+            "fused_moe", "fused_multi_transformer",
+            "fused_bias_dropout_residual_layer_norm"]

@@ -1,0 +1,605 @@
+"""Serving fused-op surface (the production LLM-inference ops).
+
+Reference: python/paddle/incubate/nn/functional/
+  block_multihead_attention.py:34, masked_multihead_attention.py,
+  fused_moe.py, swiglu.py, fused_matmul_bias.py, blha_get_max_len.py,
+  variable_length_memory_efficient_attention.py, fused_transformer.py:976
+(CUDA kernels under paddle/phi/kernels/fusion/gpu/).
+
+TPU formulation: the engines already exist in-repo — the paged
+block-table cache + Pallas paged/decode kernels
+(ops/pallas/paged_attention.py, decode_attention.py), the sort-based
+MoE dispatch (distributed/moe.py), Pallas rms_norm — and these
+functions give them the reference-shaped serving API so PaddleNLP-style
+inference code ports unchanged.  Static shapes throughout: ragged
+batches travel as padded arrays + explicit length/offset tensors (the
+same protocol the reference's packed-token kernels use).
+
+Quantized-cache / shift / smooth knobs raise NotImplementedError
+loudly — nothing silently computes an unquantized answer under a quant
+flag.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.registry import apply_op
+
+__all__ = [
+    "swiglu", "fused_matmul_bias", "blha_get_max_len",
+    "variable_length_memory_efficient_attention",
+    "masked_multihead_attention", "block_multihead_attention",
+    "fused_moe", "fused_multi_transformer",
+]
+
+
+def _reject(**kwargs):
+    bad = [k for k, v in kwargs.items() if v is not None]
+    if bad:
+        raise NotImplementedError(
+            f"arguments not supported on the TPU backend: {bad} "
+            "(quantized-cache/shift/smooth serving knobs)")
+
+
+# ------------------------------------------------------------- primitives
+def swiglu(x, y=None, name=None):
+    """reference swiglu.py: silu(x) * y; with y=None, x splits in half."""
+    def body(a, b=None):
+        if b is None:
+            a, b = jnp.split(a, 2, axis=-1)
+        return jax.nn.silu(a) * b
+
+    args = (x,) if y is None else (x, y)
+    return apply_op("swiglu", body, args, {})
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False,
+                      transpose_y=False, name=None):
+    """reference fused_matmul_bias.py (cublasLt epilogue fusion — XLA
+    fuses the bias add on TPU)."""
+    def body(a, b, c=None):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = a @ b
+        return out if c is None else out + c
+
+    args = (x, y) if bias is None else (x, y, bias)
+    return apply_op("fused_matmul_bias", body, args, {})
+
+
+def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size):
+    """reference blha_get_max_len.py: (max encoder len, max decoder len)
+    this step — the sizing scalars block_multihead_attention consumes."""
+    def body(enc, dec):
+        return (jnp.max(enc).reshape((1,)).astype(jnp.int32),
+                jnp.max(dec).reshape((1,)).astype(jnp.int32))
+
+    return apply_op("blha_get_max_len", body,
+                    (seq_lens_encoder, seq_lens_decoder), {})
+
+
+def variable_length_memory_efficient_attention(
+        query, key, value, seq_lens, kv_seq_lens, mask=None, scale=None,
+        causal=False, pre_cache_length=0):
+    """reference variable_length_memory_efficient_attention.py (cutlass
+    memory-efficient kernel): padded [B, H, S, D] attention with
+    per-sequence valid lengths."""
+    def body(q, k, v, ql, kl, m=None):
+        b, nh, s, d = q.shape
+        kvh, sk = k.shape[1], k.shape[2]
+        rep = nh // kvh
+        kq = jnp.repeat(k, rep, axis=1) if rep > 1 else k
+        vq = jnp.repeat(v, rep, axis=1) if rep > 1 else v
+        sm = (1.0 / np.sqrt(d)) if scale is None else scale
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, kq,
+                            preferred_element_type=jnp.float32) * sm
+        qpos = jnp.arange(s)[None, :, None]
+        kpos = jnp.arange(sk)[None, None, :]
+        ok = (qpos < ql.reshape(-1, 1, 1)) & (kpos < kl.reshape(-1, 1, 1))
+        if causal:
+            ok = ok & (kpos <= qpos + pre_cache_length)
+        logits = jnp.where(ok[:, None], logits, -jnp.inf)
+        if m is not None:
+            logits = logits + m.astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)
+        # fully-masked query rows give NaN rows; zero them (padding)
+        probs = jnp.where(jnp.isfinite(probs), probs, 0.0)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), vq)
+
+    args = (query, key, value, seq_lens, kv_seq_lens)
+    if mask is not None:
+        args = args + (mask,)
+    return apply_op("variable_length_memory_efficient_attention", body,
+                    args, {})
+
+
+# ------------------------------------------------------- rotary embedding
+def _rot_half(x, neox):
+    """The rotate-half map — ONE copy of the neox-vs-interleaved
+    convention, shared with fused_rotary_position_embedding."""
+    if neox:
+        a, b = jnp.split(x, 2, axis=-1)
+        return jnp.concatenate([-b, a], axis=-1)
+    x2 = x.reshape(*x.shape[:-1], -1, 2)
+    a, b = x2[..., 0], x2[..., 1]
+    return jnp.stack([-b, a], axis=-1).reshape(x.shape)
+
+
+def _apply_rope(x, cos, sin, neox):
+    """x [..., hd]; cos/sin broadcastable [..., hd]."""
+    return x * cos + _rot_half(x, neox) * sin
+
+
+def _rope_tables(rope_emb, hd):
+    """Accept the reference's [2, b?, S, 1, hd] (or any reshapeable)
+    rotary table; returns (cos [S, hd], sin [S, hd])."""
+    r = jnp.asarray(rope_emb)
+    r = r.reshape(2, -1, hd)
+    return r[0], r[1]
+
+
+# --------------------------------------------------- masked MHA (decode)
+def masked_multihead_attention(
+        x, cache_kv=None, bias=None, src_mask=None, cum_offsets=None,
+        sequence_lengths=None, rotary_tensor=None, beam_cache_offset=None,
+        qkv_out_scale=None, out_shift=None, out_smooth=None, seq_len=1,
+        rotary_emb_dims=0, use_neox_rotary_style=False,
+        compute_dtype="default", out_scale=-1, quant_round_type=1,
+        quant_max_bound=127.0, quant_min_bound=-127.0):
+    """reference masked_multihead_attention.py: one decode step over a
+    dense [2, B, kvh, T, hd] cache.  Writes this step's k/v at each
+    sequence's position and attends over the visible prefix (the
+    decode-GEMV Pallas kernel when mask-free).  Returns
+    (out [B, nh*hd], updated cache_kv)."""
+    _reject(qkv_out_scale=qkv_out_scale, out_shift=out_shift,
+            out_smooth=out_smooth, beam_cache_offset=beam_cache_offset,
+            cum_offsets=cum_offsets)
+    if cache_kv is None:
+        raise ValueError("masked_multihead_attention requires cache_kv")
+    if sequence_lengths is None:
+        raise ValueError(
+            "masked_multihead_attention on TPU requires sequence_lengths "
+            "(the cache write position per sequence); the reference "
+            "tracks it kernel-side, here it must be explicit")
+
+    def body(xq, cache, b_=None, m_=None, lens=None, rot=None):
+        kvh, t, hd = cache.shape[2], cache.shape[3], cache.shape[4]
+        bsz = xq.shape[0]
+        nh = (xq.shape[1] - 2 * kvh * hd) // hd
+        if b_ is not None:
+            xq = xq + b_.reshape(1, -1)
+        q = xq[:, :nh * hd].reshape(bsz, nh, hd)
+        k = xq[:, nh * hd:(nh + kvh) * hd].reshape(bsz, kvh, hd)
+        v = xq[:, (nh + kvh) * hd:].reshape(bsz, kvh, hd)
+        pos = (lens.reshape(-1).astype(jnp.int32) if lens is not None
+               else jnp.zeros((bsz,), jnp.int32))
+        if rot is not None:
+            cos_t, sin_t = _rope_tables(rot, hd)
+            cos = cos_t[pos][:, None, :]
+            sin = sin_t[pos][:, None, :]
+            q = _apply_rope(q, cos, sin, use_neox_rotary_style)
+            k = _apply_rope(k, cos, sin, use_neox_rotary_style)
+        bi = jnp.arange(bsz)[:, None]
+        hi = jnp.arange(kvh)[None, :]
+        kc = cache[0].at[bi, hi, pos[:, None]].set(k)
+        vc = cache[1].at[bi, hi, pos[:, None]].set(v)
+        if m_ is None:
+            from ...ops.pallas.decode_attention import decode_attention
+            out = decode_attention(q, kc, vc, pos)
+        else:
+            rep = nh // kvh
+            kq = jnp.repeat(kc, rep, axis=1)
+            vq = jnp.repeat(vc, rep, axis=1)
+            logits = jnp.einsum("bhd,bhtd->bht", q, kq,
+                                preferred_element_type=jnp.float32) \
+                / np.sqrt(hd)
+            tpos = jnp.arange(t)
+            ok = tpos[None, None, :] <= pos[:, None, None]
+            logits = jnp.where(ok, logits, -jnp.inf)
+            logits = logits + m_.reshape(bsz, 1, -1).astype(jnp.float32)
+            probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+            out = jnp.einsum("bht,bhtd->bhd", probs, vq)
+        return (out.reshape(bsz, nh * hd),
+                jnp.stack([kc, vc], axis=0))
+
+    # optional tensors travel positionally; a None stays a static leaf
+    return apply_op("masked_multihead_attention", body,
+                    (x, cache_kv, bias, src_mask, sequence_lengths,
+                     rotary_tensor), {})
+
+
+# ------------------------------------------------ block MHA (paged cache)
+def block_multihead_attention(
+        qkv, key_cache, value_cache, seq_lens_encoder, seq_lens_decoder,
+        seq_lens_this_time, padding_offsets, cum_offsets, cu_seqlens_q,
+        cu_seqlens_k, block_tables, pre_key_cache=None,
+        pre_value_cache=None, cache_k_quant_scales=None,
+        cache_v_quant_scales=None, cache_k_dequant_scales=None,
+        cache_v_dequant_scales=None, qkv_out_scale=None, qkv_bias=None,
+        out_shift=None, out_smooth=None, max_enc_len_this_time=None,
+        max_dec_len_this_time=None, rope_emb=None, mask=None,
+        tgt_mask=None, max_seq_len=-1, block_size=64, use_neox_style=False,
+        use_dynamic_cachekv_quant=False, quant_round_type=1,
+        quant_max_bound=127.0, quant_min_bound=-127.0, out_scale=-1,
+        compute_dtype="default", rope_theta=10000.0):
+    """reference block_multihead_attention.py:34 (the PaddleNLP serving
+    attention): packed variable-length tokens + paged block-table KV
+    caches, one op for mixed prefill/decode batches.
+
+    TPU formulation: tokens scatter to a padded [B, T, ...] layout via
+    ``padding_offsets`` (static T = max_seq_len), this step's k/v
+    scatter into the block pools through ``block_tables``, and every
+    query attends its sequence's visible prefix gathered from the
+    updated pools — all static shapes, jit-compatible.  The pool layout
+    [max_block_num, kv_heads, block_size, head_dim] is exactly
+    ops/pallas/paged_attention.py's; the pure-decode fast path in
+    models/generation.py uses that kernel directly.
+
+    Returns (out [token_num, nh*hd], qkv, key_cache, value_cache).
+    """
+    _reject(pre_key_cache=pre_key_cache, pre_value_cache=pre_value_cache,
+            cache_k_quant_scales=cache_k_quant_scales,
+            cache_v_quant_scales=cache_v_quant_scales,
+            cache_k_dequant_scales=cache_k_dequant_scales,
+            cache_v_dequant_scales=cache_v_dequant_scales,
+            qkv_out_scale=qkv_out_scale, out_shift=out_shift,
+            out_smooth=out_smooth, tgt_mask=tgt_mask)
+
+    def body(qkv_, kc, vc, dec_lens, this_lens, pad_off, tables,
+             b_=None, rope=None, m_=None):
+        tok = qkv_.shape[0]
+        nblocks, kvh, bs, hd = kc.shape
+        nh = (qkv_.shape[1] - 2 * kvh * hd) // hd
+        bsz = this_lens.shape[0]
+        T = max_seq_len if max_seq_len > 0 else tok
+        if b_ is not None:
+            qkv_ = qkv_ + b_.reshape(1, -1)
+        q = qkv_[:, :nh * hd].reshape(tok, nh, hd)
+        k = qkv_[:, nh * hd:(nh + kvh) * hd].reshape(tok, kvh, hd)
+        v = qkv_[:, (nh + kvh) * hd:].reshape(tok, kvh, hd)
+        dec = dec_lens.reshape(-1).astype(jnp.int32)
+        this = this_lens.reshape(-1).astype(jnp.int32)
+
+        # packed -> padded (reference get_padding_offset protocol:
+        # padded_index = token_index + padding_offsets[token_index])
+        pidx = jnp.arange(tok) + pad_off.reshape(-1).astype(jnp.int32)
+
+        def to_padded(a):
+            buf = jnp.zeros((bsz * T,) + a.shape[1:], a.dtype)
+            return buf.at[pidx].set(a, mode="drop") \
+                .reshape(bsz, T, *a.shape[1:])
+
+        qp, kp, vp = to_padded(q), to_padded(k), to_padded(v)
+        p_in_seq = jnp.arange(T)[None, :]
+        valid = p_in_seq < this[:, None]                   # [B, T]
+        cache_pos = dec[:, None] + p_in_seq                # absolute pos
+
+        if rope is not None:
+            cos_t, sin_t = _rope_tables(rope, hd)
+            cp = jnp.clip(cache_pos, 0, cos_t.shape[0] - 1)
+            cos = cos_t[cp][:, :, None, :]
+            sin = sin_t[cp][:, :, None, :]
+            qp = _apply_rope(qp, cos, sin, use_neox_style)
+            kp = _apply_rope(kp, cos, sin, use_neox_style)
+
+        # k/v scatter into the pools through the block tables
+        blk = jnp.take_along_axis(
+            tables.astype(jnp.int32),
+            jnp.clip(cache_pos // bs, 0, tables.shape[1] - 1), axis=1)
+        slot = (blk * bs + cache_pos % bs).reshape(-1)
+        slot = jnp.where(valid.reshape(-1), slot, nblocks * bs)  # dropped
+
+        def write(pool, new):
+            flat = pool.transpose(0, 2, 1, 3).reshape(-1, kvh, hd)
+            flat = flat.at[slot].set(new.reshape(-1, kvh, hd),
+                                     mode="drop")
+            return flat.reshape(nblocks, bs, kvh, hd).transpose(0, 2, 1, 3)
+
+        kc2, vc2 = write(kc, kp), write(vc, vp)
+
+        # every query attends its sequence's prefix from the pools
+        maxp = tables.shape[1]
+        kb = kc2[tables.astype(jnp.int32)] \
+            .transpose(0, 2, 1, 3, 4).reshape(bsz, kvh, maxp * bs, hd)
+        vb = vc2[tables.astype(jnp.int32)] \
+            .transpose(0, 2, 1, 3, 4).reshape(bsz, kvh, maxp * bs, hd)
+        rep = nh // kvh
+        qg = qp.reshape(bsz, T, kvh, rep, hd)
+        logits = jnp.einsum("btgrd,bgsd->btgrs", qg, kb,
+                            preferred_element_type=jnp.float32) \
+            / np.sqrt(hd)
+        spos = jnp.arange(maxp * bs)[None, None, :]
+        ok = spos <= cache_pos[:, :, None]                 # [B, T, S]
+        ok = ok & valid[:, :, None]
+        logits = jnp.where(ok[:, :, None, None, :], logits, -jnp.inf)
+        if m_ is not None:
+            logits = logits + m_.astype(jnp.float32).reshape(
+                bsz, 1, 1, 1, -1)
+        probs = jax.nn.softmax(logits, axis=-1)
+        probs = jnp.where(jnp.isfinite(probs), probs, 0.0)
+        outp = jnp.einsum("btgrs,bgsd->btgrd", probs.astype(qp.dtype), vb)
+        out = outp.reshape(bsz * T, nh * hd)[pidx]
+        return out, qkv_, kc2, vc2
+
+    args = (qkv, key_cache, value_cache, seq_lens_decoder,
+            seq_lens_this_time, padding_offsets, block_tables,
+            qkv_bias, rope_emb, mask)
+    return apply_op("block_multihead_attention", body, args, {})
+
+
+# ----------------------------------------------------------------- MoE
+def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
+              ffn1_scale=None, ffn2_bias=None, ffn2_scale=None,
+              quant_method="None", moe_topk=2, norm_topk_prob=True):
+    """reference fused_moe.py: x [B, S, D], gate scores [B, S, E],
+    expert weights ffn1 [E, D, F*2] (gated: silu(u) * v halves when
+    F*2 == 2 * ffn2-in, plain gelu otherwise), ffn2 [E, F, D].
+
+    Delegates to the sort-based dropless dispatch engine
+    (distributed/moe.py sort_dispatch_combine) — tokens route as pure
+    gathers, no capacity loss (capacity = token count).
+    """
+    if quant_method not in ("None", None, "none"):
+        raise NotImplementedError(
+            f"fused_moe quant_method={quant_method!r} is not supported; "
+            "use weight-only quant via models/generation.quantize_state")
+    _reject(ffn1_scale=ffn1_scale, ffn2_scale=ffn2_scale)
+
+    def body(x_, gates, w1, w2, b1=None, b2=None):
+        from ...distributed.moe import sort_dispatch_combine
+
+        lead = x_.shape[:-1]
+        d = x_.shape[-1]
+        e, _, f2 = w1.shape
+        fin = w2.shape[1]
+        xt = x_.reshape(-1, d)
+        gl = gates.reshape(-1, e).astype(jnp.float32)
+        s = xt.shape[0]
+        gv, idx = jax.lax.top_k(jax.nn.softmax(gl, axis=-1), moe_topk)
+        if norm_topk_prob:
+            gv = gv / jnp.sum(gv, axis=-1, keepdims=True)
+
+        gated = f2 == 2 * fin
+
+        def ffn(expert_in):                    # [E, C, D] -> [E, C, D]
+            h = jnp.einsum("ecd,edf->ecf", expert_in, w1)
+            if b1 is not None:
+                h = h + b1.reshape(e, 1, f2)
+            if gated:
+                u, g = jnp.split(h, 2, axis=-1)
+                h = jax.nn.silu(u) * g
+            else:
+                h = jax.nn.gelu(h)
+            out = jnp.einsum("ecf,efd->ecd", h, w2)
+            if b2 is not None:
+                out = out + b2.reshape(e, 1, d)
+            return out
+
+        y = sort_dispatch_combine(xt, idx.astype(jnp.int32),
+                                  gv.astype(xt.dtype), e, s, ffn)
+        return y.reshape(*lead, d)
+
+    return apply_op("fused_moe", body,
+                    (x, gate_weight, ffn1_weight, ffn2_weight,
+                     ffn1_bias, ffn2_bias), {})
+
+
+# -------------------------------------------------- fused_multi_transformer
+def fused_multi_transformer(
+        x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
+        linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+        ffn1_biases, ffn2_weights, ffn2_biases, pre_layer_norm=True,
+        epsilon=1e-05, residual_alpha=1.0, cache_kvs=None,
+        beam_offset=None, pre_caches=None, seq_lens=None, rotary_embs=None,
+        time_step=None, attn_mask=None, dropout_rate=0.0,
+        rotary_emb_dims=0, activation="gelu", training=False,
+        mode="upscale_in_train", trans_qkvw=True, ring_id=-1,
+        norm_type="layernorm", use_neox_rotary_style=False,
+        gqa_group_size=-1, name=None):
+    """reference fused_transformer.py:976: the stacked serving decoder —
+    N pre-LN blocks, dense [2, B, kvh, T, hd] caches, one op.
+
+    Prefill (time_step=None): causal self-attention over [B, S, D],
+    caches filled for positions [0, S).  Decode (time_step given): one
+    token per sequence appended at ``time_step`` and attended against
+    the prefix.  Returns (out, cache_kvs) when caches are given, else
+    out — matching the reference contract.
+    """
+    _reject(beam_offset=beam_offset, pre_caches=pre_caches)
+    if not pre_layer_norm:
+        raise NotImplementedError(
+            "fused_multi_transformer: only pre_layer_norm=True (the "
+            "reference serving configuration) is supported")
+    if ring_id != -1:
+        raise NotImplementedError(
+            "fused_multi_transformer ring_id: wrap in shard_map / use "
+            "distributed.fleet tensor parallel instead")
+
+    n_layers = len(qkv_weights)
+    decode = time_step is not None
+
+    def norm(h, w, b):
+        hf = h.astype(jnp.float32)
+        if norm_type == "rmsnorm":
+            hf = hf * jax.lax.rsqrt(
+                jnp.mean(hf * hf, axis=-1, keepdims=True) + epsilon)
+        else:
+            mu = jnp.mean(hf, axis=-1, keepdims=True)
+            var = jnp.var(hf, axis=-1, keepdims=True)
+            hf = (hf - mu) * jax.lax.rsqrt(var + epsilon)
+        out = hf.astype(h.dtype) * w
+        return out + b if b is not None else out
+
+    def act_fn(h):
+        if activation in ("swiglu", "geglu"):
+            u, g = jnp.split(h, 2, axis=-1)
+            return (jax.nn.silu(u) if activation == "swiglu"
+                    else jax.nn.gelu(u)) * g
+        return getattr(jax.nn, activation)(h)
+
+    def body(x_, *flat):
+        it = iter(flat)
+
+        def take(lst):
+            return [next(it) if w is not None else None for w in lst]
+
+        lns = take(ln_scales)
+        lnb = take(ln_biases or [None] * n_layers)
+        qkvw = take(qkv_weights)
+        qkvb = take(qkv_biases or [None] * n_layers)
+        outw = take(linear_weights)
+        outb = take(linear_biases or [None] * n_layers)
+        flns = take(ffn_ln_scales)
+        flnb = take(ffn_ln_biases or [None] * n_layers)
+        f1w = take(ffn1_weights)
+        f1b = take(ffn1_biases or [None] * n_layers)
+        f2w = take(ffn2_weights)
+        f2b = take(ffn2_biases or [None] * n_layers)
+        caches = take(cache_kvs) if cache_kvs is not None else None
+        lens = next(it) if seq_lens is not None else None
+        ts = next(it) if time_step is not None else None
+        am = next(it) if attn_mask is not None else None
+        rot = next(it) if rotary_embs is not None else None
+
+        bsz, s, d = x_.shape
+        new_caches = []
+        h = x_
+        for i in range(n_layers):
+            resid = h
+            hn = norm(h, lns[i], lnb[i])
+            w = qkvw[i]
+            # reference layout: [3, nh, hd, D] when trans_qkvw else
+            # [D, 3, nh, hd] (fused_transformer.py qkv_weight docs)
+            if w.ndim == 4:
+                nh, hd = ((w.shape[1], w.shape[2]) if trans_qkvw
+                          else (w.shape[2], w.shape[3]))
+                w2d = (w.reshape(-1, d) if trans_qkvw
+                       else w.reshape(d, -1).T)
+            elif caches is not None:
+                kvh0, hd = caches[i].shape[2], caches[i].shape[4]
+                w2d = w.reshape(-1, d) if trans_qkvw else w.T
+                nh = (w2d.shape[0] - 2 * kvh0 * hd) // hd
+            else:
+                raise ValueError(
+                    "fused_multi_transformer: pass 4-D qkv weights "
+                    "([3, nh, hd, D]) or caches so head shape is known")
+            qkv_ = hn.reshape(-1, d) @ w2d.T
+            if qkvb[i] is not None:
+                qkv_ = qkv_ + qkvb[i].reshape(1, -1)
+            width = w2d.shape[0]
+            if caches is not None:
+                kvh, hd = caches[i].shape[2], caches[i].shape[4]
+                nh = (width - 2 * kvh * hd) // hd
+            else:
+                kvh = nh
+            qkv3 = qkv_.reshape(bsz, s, width)
+            q = qkv3[..., :nh * hd].reshape(bsz, s, nh, hd)
+            k = qkv3[..., nh * hd:(nh + kvh) * hd] \
+                .reshape(bsz, s, kvh, hd)
+            v = qkv3[..., (nh + kvh) * hd:].reshape(bsz, s, kvh, hd)
+
+            if decode:
+                pos = (lens.reshape(-1).astype(jnp.int32)
+                       if lens is not None
+                       else jnp.full((bsz,), ts.reshape(()),
+                                     dtype=jnp.int32))
+            else:
+                pos = None
+            if rot is not None:
+                cos_t, sin_t = _rope_tables(rot, hd)
+                if decode:
+                    cos = cos_t[pos][:, None, None, :]
+                    sin = sin_t[pos][:, None, None, :]
+                else:
+                    cos = cos_t[None, :s, None, :]
+                    sin = sin_t[None, :s, None, :]
+                q = _apply_rope(q, cos, sin, use_neox_rotary_style)
+                k = _apply_rope(k, cos, sin, use_neox_rotary_style)
+
+            rep = nh // kvh
+            if decode:
+                cache = caches[i]
+                bi = jnp.arange(bsz)[:, None]
+                hi = jnp.arange(kvh)[None, :]
+                kc = cache[0].at[bi, hi, pos[:, None]].set(
+                    k.reshape(bsz, kvh, hd))
+                vc = cache[1].at[bi, hi, pos[:, None]].set(
+                    v.reshape(bsz, kvh, hd))
+                new_caches.append(jnp.stack([kc, vc], axis=0))
+                from ...ops.pallas.decode_attention import decode_attention
+                attn = decode_attention(
+                    q.reshape(bsz, nh, hd), kc, vc, pos) \
+                    .reshape(bsz, 1, nh * hd)
+            else:
+                if caches is not None:
+                    cache = caches[i]
+                    t = cache.shape[3]
+                    kc = cache[0].at[:, :, :s].set(
+                        k.transpose(0, 2, 1, 3))
+                    vc = cache[1].at[:, :, :s].set(
+                        v.transpose(0, 2, 1, 3))
+                    new_caches.append(jnp.stack([kc, vc], axis=0))
+                kq = jnp.repeat(k, rep, axis=2) if rep > 1 else k
+                vq = jnp.repeat(v, rep, axis=2) if rep > 1 else v
+                logits = jnp.einsum(
+                    "bqhd,bkhd->bhqk", q, kq,
+                    preferred_element_type=jnp.float32) / np.sqrt(hd)
+                qpos = jnp.arange(s)[:, None]
+                kpos = jnp.arange(s)[None, :]
+                logits = jnp.where(kpos <= qpos, logits, -jnp.inf)
+                if am is not None:
+                    logits = logits + am.astype(jnp.float32)
+                if lens is not None:
+                    ok = jnp.arange(s)[None, None, None, :] \
+                        < lens.reshape(-1, 1, 1, 1)
+                    logits = jnp.where(ok, logits, -jnp.inf)
+                probs = jax.nn.softmax(logits, axis=-1)
+                probs = jnp.where(jnp.isfinite(probs), probs, 0.0)
+                attn = jnp.einsum("bhqk,bkhd->bqhd",
+                                  probs.astype(q.dtype), vq) \
+                    .reshape(bsz, s, nh * hd)
+
+            proj = attn.reshape(-1, nh * hd) @ outw[i].reshape(
+                nh * hd, d)
+            if outb[i] is not None:
+                proj = proj + outb[i].reshape(1, -1)
+            proj = proj.reshape(bsz, s, d)
+            if training and dropout_rate > 0.0:
+                from ...framework import random as _random
+                keep = jax.random.bernoulli(
+                    _random.split_key(), 1.0 - dropout_rate, proj.shape)
+                proj = jnp.where(keep, proj / (1.0 - dropout_rate), 0.0) \
+                    if mode == "upscale_in_train" \
+                    else jnp.where(keep, proj, 0.0)
+            h = resid * residual_alpha + proj
+
+            resid = h
+            hn = norm(h, flns[i], flnb[i])
+            f1 = hn.reshape(-1, d) @ f1w[i].reshape(d, -1)
+            if f1b[i] is not None:
+                f1 = f1 + f1b[i].reshape(1, -1)
+            f1 = act_fn(f1)
+            f2 = f1 @ f2w[i].reshape(f1.shape[-1], d)
+            if f2b[i] is not None:
+                f2 = f2 + f2b[i].reshape(1, -1)
+            h = resid * residual_alpha + f2.reshape(bsz, s, d)
+        if caches is not None:
+            return h, new_caches
+        return h
+
+    flat_args = [x]
+    for lst in (ln_scales, ln_biases or [], qkv_weights, qkv_biases or [],
+                linear_weights, linear_biases or [], ffn_ln_scales,
+                ffn_ln_biases or [], ffn1_weights, ffn1_biases or [],
+                ffn2_weights, ffn2_biases or []):
+        flat_args += [w for w in lst if w is not None]
+    if cache_kvs is not None:
+        flat_args += [c for c in cache_kvs if c is not None]
+    for extra in (seq_lens, time_step, attn_mask, rotary_embs):
+        if extra is not None:
+            flat_args.append(extra)
+    return apply_op("fused_multi_transformer", body, tuple(flat_args), {})

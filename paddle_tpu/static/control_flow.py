@@ -55,10 +55,12 @@ def _node_ref_leaves(source):
     return [x for x in flat if _is_leaf(x)]
 
 
-def _collect_externals(subs, exclude=()):
-    """Outer-scope Variables referenced by nodes of the sub-programs.
-    These are evaluated in the enclosing scope and bound by name inside
-    the branch (the region's capture list)."""
+def _collect_externals(subs, exclude=(), extra_leaves=()):
+    """Outer-scope Variables referenced by nodes of the sub-programs —
+    plus the branch OUTPUT leaves (a branch may return a captured outer
+    Variable directly, with no op recorded inside the region).  These
+    are evaluated in the enclosing scope and bound by name inside the
+    branch (the region's capture list)."""
     excl = {id(x) for x in exclude}
     ext, seen = [], set()
 
@@ -74,6 +76,8 @@ def _collect_externals(subs, exclude=()):
                 continue
             for leaf in _node_ref_leaves(v.source):
                 note(leaf)
+    for leaf in extra_leaves:
+        note(leaf)
     return ext
 
 
@@ -142,7 +146,8 @@ def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
     prog = default_main_program()
     _merge_params(sub_t, prog)
     _merge_params(sub_f, prog)
-    ext = _collect_externals([sub_t, sub_f])
+    ext = _collect_externals([sub_t, sub_f],
+                             extra_leaves=list(flat_t) + list(flat_f))
     refs = [x for x in [pred] if _is_leaf(x)] + ext
     payload = (pred, flat_t, flat_f, ext)
     return _record_ctrl("__cond__", payload, refs, metas_t, tree_t, prog)
@@ -206,7 +211,8 @@ def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
     prog = default_main_program()
     _merge_params(sub_c, prog)
     _merge_params(sub_b, prog)
-    ext = _collect_externals([sub_c, sub_b], exclude=phs)
+    ext = _collect_externals([sub_c, sub_b], exclude=phs,
+                             extra_leaves=list(flat_c) + list(flat_b))
     refs = [x for x in init_flat if _is_leaf(x)] + ext
     payload = (flat_c[0], flat_b, phs, init_flat, ext)
     return _record_ctrl("__while__", payload, refs, metas, init_tree, prog)
@@ -302,7 +308,9 @@ def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
     if sub_b is not None:
         _merge_params(sub_b, prog)
         subs.append(sub_b)
-    ext = _collect_externals(subs, exclude=in_phs + g_phs)
+    ext = _collect_externals(subs, exclude=in_phs + g_phs,
+                             extra_leaves=list(flat_f)
+                             + list(bwd_outs or []))
     refs = [x for x in inputs if _is_leaf(x)] + ext
     payload = (flat_f, in_phs, inputs, bwd_outs, g_phs, ext)
     return _record_ctrl("__pylayer__", payload, refs, out_metas, tree_f,

@@ -263,13 +263,16 @@ def prelu(x, mode, param_attr=None, data_format="NCHW", name=None):
                            data_format=data_format)
         return layer(x)
     if mode == "element":
-        # per-element slope, weight shaped like one sample
+        # per-element slope, weight shaped like one sample; reference
+        # initializes every slope to 0.25 (common.py prelu)
         from .compat import create_parameter
         from ..ops.registry import apply_op
+        from ..nn import initializer as I
         import jax.numpy as jnp
 
-        alpha = create_parameter([int(s) for s in x.shape[1:]], "float32",
-                                 attr=param_attr)
+        alpha = create_parameter(
+            [int(s) for s in x.shape[1:]], "float32", attr=param_attr,
+            default_initializer=I.Constant(0.25))
 
         def body(v, a):
             return jnp.where(v >= 0, v, a * v)

@@ -53,6 +53,8 @@ class TestSubNamespaceParity:
             (R + "io/__init__.py", paddle_tpu.io),
             (R + "distributed/__init__.py", paddle_tpu.distributed),
             (R + "nn/functional/__init__.py", paddle_tpu.nn.functional),
+            (R + "incubate/nn/functional/__init__.py",
+             paddle_tpu.incubate.nn.functional),
         ]
         problems = {}
         for path, mod in checks:

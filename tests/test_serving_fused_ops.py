@@ -1,0 +1,394 @@
+"""Serving fused-op surface parity tests.
+
+Reference: python/paddle/incubate/nn/functional/
+(block_multihead_attention.py:34, masked_multihead_attention.py,
+fused_moe.py, swiglu.py, fused_matmul_bias.py, blha_get_max_len.py,
+variable_length_memory_efficient_attention.py, fused_transformer.py:976).
+Each op is checked against a composed-op NumPy reference implementing
+the documented semantics.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.nn.functional as F
+
+rng = np.random.RandomState(3)
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def _softmax(x, axis=-1):
+    x = x - x.max(axis=axis, keepdims=True)
+    e = np.exp(x)
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def _silu(x):
+    return x / (1.0 + np.exp(-x))
+
+
+class TestSimpleOps:
+    def test_swiglu_two_arg(self):
+        x = rng.randn(4, 8).astype(np.float32)
+        y = rng.randn(4, 8).astype(np.float32)
+        out = F.swiglu(t(x), t(y)).numpy()
+        np.testing.assert_allclose(out, _silu(x) * y, rtol=1e-5)
+
+    def test_swiglu_split(self):
+        x = rng.randn(4, 8).astype(np.float32)
+        out = F.swiglu(t(x)).numpy()
+        np.testing.assert_allclose(out, _silu(x[:, :4]) * x[:, 4:],
+                                   rtol=1e-5)
+
+    def test_fused_matmul_bias(self):
+        x = rng.randn(3, 4).astype(np.float32)
+        y = rng.randn(4, 5).astype(np.float32)
+        b = rng.randn(5).astype(np.float32)
+        out = F.fused_matmul_bias(t(x), t(y), t(b)).numpy()
+        np.testing.assert_allclose(out, x @ y + b, rtol=1e-5)
+        out2 = F.fused_matmul_bias(t(x.T), t(y), t(b),
+                                   transpose_x=True).numpy()
+        np.testing.assert_allclose(out2, x @ y + b, rtol=1e-5)
+
+    def test_blha_get_max_len(self):
+        enc = np.array([[3], [0], [7]], np.int32)
+        dec = np.array([[0], [5], [2]], np.int32)
+        me, md = F.blha_get_max_len(t(enc), t(dec), t(np.zeros((3,))))
+        assert int(me.numpy()[0]) == 7
+        assert int(md.numpy()[0]) == 5
+
+    def test_fused_bias_dropout_residual_layer_norm(self):
+        x = rng.randn(2, 6).astype(np.float32)
+        res = rng.randn(2, 6).astype(np.float32)
+        w = np.ones(6, np.float32)
+        b = np.zeros(6, np.float32)
+        out = F.fused_bias_dropout_residual_layer_norm(
+            t(x), t(res), ln_scale=t(w), ln_bias=t(b), dropout_rate=0.0,
+            training=False).numpy()
+        h = x + res
+        ref = (h - h.mean(-1, keepdims=True)) / np.sqrt(
+            h.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+class TestVariableLengthAttention:
+    def test_matches_naive(self):
+        b, nh, s, d = 2, 4, 8, 16
+        q = rng.randn(b, nh, s, d).astype(np.float32)
+        k = rng.randn(b, nh, s, d).astype(np.float32)
+        v = rng.randn(b, nh, s, d).astype(np.float32)
+        ql = np.array([5, 8], np.int32)
+        kl = np.array([5, 8], np.int32)
+        out = F.variable_length_memory_efficient_attention(
+            t(q), t(k), t(v), t(ql), t(kl)).numpy()
+        for bi in range(b):
+            L, Lk = ql[bi], kl[bi]
+            logits = np.einsum("hqd,hkd->hqk", q[bi, :, :L],
+                               k[bi, :, :Lk]) / np.sqrt(d)
+            ref = np.einsum("hqk,hkd->hqd", _softmax(logits),
+                            v[bi, :, :Lk])
+            np.testing.assert_allclose(out[bi, :, :L], ref, rtol=1e-4,
+                                       atol=1e-4)
+
+    def test_causal_gqa(self):
+        b, nh, kvh, s, d = 1, 4, 2, 6, 8
+        q = rng.randn(b, nh, s, d).astype(np.float32)
+        k = rng.randn(b, kvh, s, d).astype(np.float32)
+        v = rng.randn(b, kvh, s, d).astype(np.float32)
+        lens = np.array([s], np.int32)
+        out = F.variable_length_memory_efficient_attention(
+            t(q), t(k), t(v), t(lens), t(lens), causal=True).numpy()
+        kk = np.repeat(k, 2, axis=1)
+        vv = np.repeat(v, 2, axis=1)
+        logits = np.einsum("bhqd,bhkd->bhqk", q, kk) / np.sqrt(d)
+        cmask = np.tril(np.ones((s, s), bool))
+        logits = np.where(cmask, logits, -np.inf)
+        ref = np.einsum("bhqk,bhkd->bhqd", _softmax(logits), vv)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+class TestMaskedMultiheadAttention:
+    def _naive(self, x, cache, lens, nh, kvh, hd):
+        b = x.shape[0]
+        q = x[:, :nh * hd].reshape(b, nh, hd)
+        k = x[:, nh * hd:(nh + kvh) * hd].reshape(b, kvh, hd)
+        v = x[:, (nh + kvh) * hd:].reshape(b, kvh, hd)
+        kc, vc = cache[0].copy(), cache[1].copy()
+        outs = []
+        for bi in range(b):
+            p = lens[bi]
+            kc[bi, :, p] = k[bi]
+            vc[bi, :, p] = v[bi]
+            rep = nh // kvh
+            kk = np.repeat(kc[bi, :, :p + 1], rep, axis=0)
+            vv = np.repeat(vc[bi, :, :p + 1], rep, axis=0)
+            logits = np.einsum("hd,htd->ht", q[bi], kk) / np.sqrt(hd)
+            outs.append(np.einsum("ht,htd->hd", _softmax(logits), vv))
+        return np.stack(outs).reshape(b, nh * hd), kc, vc
+
+    def test_decode_step_parity(self):
+        b, nh, kvh, tmax, hd = 3, 4, 2, 16, 8
+        x = rng.randn(b, (nh + 2 * kvh) * hd).astype(np.float32)
+        cache = rng.randn(2, b, kvh, tmax, hd).astype(np.float32)
+        lens = np.array([5, 0, 11], np.int32)
+        out, new_cache = F.masked_multihead_attention(
+            t(x), cache_kv=t(cache), sequence_lengths=t(lens.reshape(-1, 1)))
+        ref_out, ref_kc, ref_vc = self._naive(x, cache, lens, nh, kvh, hd)
+        np.testing.assert_allclose(out.numpy(), ref_out, rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_allclose(new_cache.numpy()[0], ref_kc, rtol=1e-5)
+        np.testing.assert_allclose(new_cache.numpy()[1], ref_vc, rtol=1e-5)
+
+    def test_quant_knobs_raise(self):
+        with pytest.raises(NotImplementedError):
+            F.masked_multihead_attention(
+                t(np.zeros((1, 12), np.float32)),
+                cache_kv=t(np.zeros((2, 1, 1, 4, 4), np.float32)),
+                qkv_out_scale=t(np.ones(4, np.float32)))
+
+
+class TestBlockMultiheadAttention:
+    def _setup(self, lens_enc, lens_dec, lens_now, nh=4, kvh=2, hd=8,
+               block_size=4, max_seq=16):
+        b = len(lens_now)
+        tok = int(sum(lens_now))
+        pages_per_seq = max_seq // block_size
+        nblocks = b * pages_per_seq + 1
+        tables = np.arange(b * pages_per_seq, dtype=np.int32) \
+            .reshape(b, pages_per_seq)
+        kc = np.zeros((nblocks, kvh, block_size, hd), np.float32)
+        vc = np.zeros((nblocks, kvh, block_size, hd), np.float32)
+        # pre-fill cache for decode sequences
+        for bi in range(b):
+            for p in range(lens_dec[bi]):
+                blk = tables[bi, p // block_size]
+                kc[blk, :, p % block_size] = rng.randn(kvh, hd)
+                vc[blk, :, p % block_size] = rng.randn(kvh, hd)
+        qkv = rng.randn(tok, (nh + 2 * kvh) * hd).astype(np.float32)
+        # padding offsets: padded_idx = i + pad_off[i]
+        pad_off = np.zeros(tok, np.int32)
+        cum = 0
+        for bi in range(b):
+            for p in range(lens_now[bi]):
+                i = cum + p
+                pad_off[i] = bi * max_seq + p - i
+            cum += lens_now[bi]
+        cu_q = np.cumsum([0] + list(lens_now)).astype(np.int32)
+        return (b, tok, qkv, kc, vc, tables, pad_off, cu_q, nh, kvh, hd,
+                block_size, max_seq)
+
+    def _naive(self, qkv, kc, vc, tables, lens_dec, lens_now, nh, kvh, hd,
+               bs):
+        tok = qkv.shape[0]
+        b = len(lens_now)
+        q = qkv[:, :nh * hd].reshape(tok, nh, hd)
+        k = qkv[:, nh * hd:(nh + kvh) * hd].reshape(tok, kvh, hd)
+        v = qkv[:, (nh + kvh) * hd:].reshape(tok, kvh, hd)
+        kc, vc = kc.copy(), vc.copy()
+        out = np.zeros((tok, nh, hd), np.float32)
+        i = 0
+        for bi in range(b):
+            for p in range(lens_now[bi]):
+                cpos = lens_dec[bi] + p
+                blk = tables[bi, cpos // bs]
+                kc[blk, :, cpos % bs] = k[i]
+                vc[blk, :, cpos % bs] = v[i]
+                # gather prefix 0..cpos
+                kk = np.zeros((kvh, cpos + 1, hd), np.float32)
+                vv = np.zeros((kvh, cpos + 1, hd), np.float32)
+                for s in range(cpos + 1):
+                    bblk = tables[bi, s // bs]
+                    kk[:, s] = kc[bblk, :, s % bs]
+                    vv[:, s] = vc[bblk, :, s % bs]
+                rep = nh // kvh
+                kk = np.repeat(kk, rep, axis=0)
+                vv = np.repeat(vv, rep, axis=0)
+                logits = np.einsum("hd,htd->ht", q[i], kk) / np.sqrt(hd)
+                out[i] = np.einsum("ht,htd->hd", _softmax(logits), vv)
+                i += 1
+        return out.reshape(tok, nh * hd), kc, vc
+
+    def _run(self, lens_enc, lens_dec, lens_now):
+        (b, tok, qkv, kc, vc, tables, pad_off, cu_q, nh, kvh, hd, bs,
+         max_seq) = self._setup(lens_enc, lens_dec, lens_now)
+        out, _, kc2, vc2 = F.block_multihead_attention(
+            t(qkv), t(kc), t(vc),
+            t(np.array(lens_enc, np.int32).reshape(-1, 1)),
+            t(np.array(lens_dec, np.int32).reshape(-1, 1)),
+            t(np.array(lens_now, np.int32).reshape(-1, 1)),
+            t(pad_off), t(np.zeros(b, np.int32)), t(cu_q), t(cu_q),
+            t(tables), max_seq_len=max_seq, block_size=bs)
+        ref_out, ref_kc, ref_vc = self._naive(
+            qkv, kc, vc, tables, lens_dec, lens_now, nh, kvh, hd, bs)
+        np.testing.assert_allclose(out.numpy(), ref_out, rtol=1e-3,
+                                   atol=1e-3)
+        np.testing.assert_allclose(kc2.numpy()[:-1], ref_kc[:-1],
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(vc2.numpy()[:-1], ref_vc[:-1],
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_prefill(self):
+        self._run([5, 7], [0, 0], [5, 7])
+
+    def test_decode(self):
+        self._run([0, 0, 0], [3, 9, 1], [1, 1, 1])
+
+    def test_mixed_prefill_decode(self):
+        self._run([4, 0], [0, 6], [4, 1])
+
+
+class TestFusedMoe:
+    def test_parity_with_dense_reference(self):
+        b, s, d, e, f = 2, 6, 16, 4, 32
+        x = rng.randn(b, s, d).astype(np.float32)
+        gates = rng.randn(b, s, e).astype(np.float32)
+        w1 = (rng.randn(e, d, 2 * f) / np.sqrt(d)).astype(np.float32)
+        w2 = (rng.randn(e, f, d) / np.sqrt(f)).astype(np.float32)
+        b1 = rng.randn(e, 1, 2 * f).astype(np.float32)
+        b2 = rng.randn(e, 1, d).astype(np.float32)
+        out = F.fused_moe(t(x), t(gates), t(w1), t(w2), t(b1), None,
+                          t(b2), None, "None", 2, True).numpy()
+
+        probs = _softmax(gates.reshape(-1, e))
+        order = np.argsort(-probs, axis=-1)[:, :2]
+        xt = x.reshape(-1, d)
+        ref = np.zeros_like(xt)
+        for i in range(xt.shape[0]):
+            pv = probs[i, order[i]]
+            pv = pv / pv.sum()
+            for j, ei in enumerate(order[i]):
+                h = xt[i] @ w1[ei] + b1[ei, 0]
+                u, g = h[:f], h[f:]
+                h = _silu(u) * g
+                ref[i] += pv[j] * (h @ w2[ei] + b2[ei, 0])
+        np.testing.assert_allclose(out.reshape(-1, d), ref, rtol=2e-3,
+                                   atol=2e-3)
+
+    def test_quant_method_raises(self):
+        with pytest.raises(NotImplementedError):
+            F.fused_moe(t(np.zeros((1, 2, 4), np.float32)),
+                        t(np.zeros((1, 2, 2), np.float32)),
+                        t(np.zeros((2, 4, 8), np.float32)),
+                        t(np.zeros((2, 4, 4), np.float32)),
+                        quant_method="weight_only_int8")
+
+
+class TestFusedMultiTransformer:
+    def _weights(self, n_layers, d, nh, hd, ffn):
+        ws = {}
+        ws["ln_s"] = [np.ones(d, np.float32) for _ in range(n_layers)]
+        ws["ln_b"] = [np.zeros(d, np.float32) for _ in range(n_layers)]
+        ws["qkv_w"] = [(rng.randn(3, nh, hd, d) / np.sqrt(d))
+                       .astype(np.float32) for _ in range(n_layers)]
+        ws["qkv_b"] = [np.zeros(3 * nh * hd, np.float32)
+                       for _ in range(n_layers)]
+        ws["out_w"] = [(rng.randn(nh * hd, d) / np.sqrt(d))
+                       .astype(np.float32) for _ in range(n_layers)]
+        ws["out_b"] = [np.zeros(d, np.float32) for _ in range(n_layers)]
+        ws["fln_s"] = [np.ones(d, np.float32) for _ in range(n_layers)]
+        ws["fln_b"] = [np.zeros(d, np.float32) for _ in range(n_layers)]
+        ws["f1_w"] = [(rng.randn(d, ffn) / np.sqrt(d)).astype(np.float32)
+                      for _ in range(n_layers)]
+        ws["f1_b"] = [np.zeros(ffn, np.float32) for _ in range(n_layers)]
+        ws["f2_w"] = [(rng.randn(ffn, d) / np.sqrt(ffn))
+                      .astype(np.float32) for _ in range(n_layers)]
+        ws["f2_b"] = [np.zeros(d, np.float32) for _ in range(n_layers)]
+        return ws
+
+    def _naive(self, x, ws, n_layers, nh, hd):
+        def ln(h):
+            mu = h.mean(-1, keepdims=True)
+            var = h.var(-1, keepdims=True)
+            return (h - mu) / np.sqrt(var + 1e-5)
+
+        def gelu(v):
+            from scipy.special import erf
+            return v * 0.5 * (1 + erf(v / np.sqrt(2)))
+
+        b, s, d = x.shape
+        h = x.copy()
+        for i in range(n_layers):
+            resid = h
+            hn = ln(h)
+            w2d = ws["qkv_w"][i].reshape(-1, d)
+            qkv = hn @ w2d.T + ws["qkv_b"][i]
+            q = qkv[..., :nh * hd].reshape(b, s, nh, hd)
+            k = qkv[..., nh * hd:2 * nh * hd].reshape(b, s, nh, hd)
+            v = qkv[..., 2 * nh * hd:].reshape(b, s, nh, hd)
+            logits = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+            cmask = np.tril(np.ones((s, s), bool))
+            logits = np.where(cmask, logits, -np.inf)
+            attn = np.einsum("bhqk,bkhd->bqhd", _softmax(logits), v) \
+                .reshape(b, s, nh * hd)
+            h = resid + attn @ ws["out_w"][i] + ws["out_b"][i]
+            resid = h
+            hn = ln(h)
+            f = gelu(hn @ ws["f1_w"][i] + ws["f1_b"][i])
+            h = resid + f @ ws["f2_w"][i] + ws["f2_b"][i]
+        return h
+
+    def test_prefill_parity(self):
+        n_layers, d, nh, hd, ffn = 2, 16, 2, 8, 32
+        b, s = 2, 5
+        ws = self._weights(n_layers, d, nh, hd, ffn)
+        x = rng.randn(b, s, d).astype(np.float32)
+        out = F.fused_multi_transformer(
+            t(x), [t(w) for w in ws["ln_s"]], [t(w) for w in ws["ln_b"]],
+            [t(w) for w in ws["qkv_w"]], [t(w) for w in ws["qkv_b"]],
+            [t(w) for w in ws["out_w"]], [t(w) for w in ws["out_b"]],
+            [t(w) for w in ws["fln_s"]], [t(w) for w in ws["fln_b"]],
+            [t(w) for w in ws["f1_w"]], [t(w) for w in ws["f1_b"]],
+            [t(w) for w in ws["f2_w"]], [t(w) for w in ws["f2_b"]])
+        ref = self._naive(x, ws, n_layers, nh, hd)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=2e-3, atol=2e-3)
+
+    def test_decode_consistent_with_prefill(self):
+        """Prefill s tokens in one call; then decode token s given the
+        caches — must equal prefilling s+1 tokens directly."""
+        n_layers, d, nh, hd, ffn = 2, 16, 2, 8, 32
+        b, s, tmax = 1, 4, 8
+        ws = self._weights(n_layers, d, nh, hd, ffn)
+        x = rng.randn(b, s + 1, d).astype(np.float32)
+
+        def args(xx, caches=None, **kw):
+            return F.fused_multi_transformer(
+                t(xx), [t(w) for w in ws["ln_s"]],
+                [t(w) for w in ws["ln_b"]],
+                [t(w) for w in ws["qkv_w"]], [t(w) for w in ws["qkv_b"]],
+                [t(w) for w in ws["out_w"]], [t(w) for w in ws["out_b"]],
+                [t(w) for w in ws["fln_s"]], [t(w) for w in ws["fln_b"]],
+                [t(w) for w in ws["f1_w"]], [t(w) for w in ws["f1_b"]],
+                [t(w) for w in ws["f2_w"]], [t(w) for w in ws["f2_b"]],
+                cache_kvs=caches, **kw)
+
+        caches = [t(np.zeros((2, b, nh, tmax, hd), np.float32))
+                  for _ in range(n_layers)]
+        out_pre, caches2 = args(x[:, :s], caches)
+        out_dec, _ = args(
+            x[:, s:s + 1], caches2,
+            time_step=t(np.array(s, np.int32)),
+            seq_lens=t(np.full((b,), s, np.int32)))
+        out_full = args(x)
+        np.testing.assert_allclose(
+            np.asarray(out_dec.numpy())[:, 0],
+            np.asarray(out_full.numpy())[:, s], rtol=2e-3, atol=2e-3)
+
+
+def test_namespace_complete():
+    import ast
+    import os
+    path = ("/root/reference/python/paddle/incubate/nn/functional/"
+            "__init__.py")
+    if not os.path.exists(path):
+        pytest.skip("no reference")
+    ref = []
+    for node in ast.walk(ast.parse(open(path).read())):
+        if isinstance(node, ast.Assign):
+            for tg in node.targets:
+                if getattr(tg, "id", None) == "__all__":
+                    ref = ast.literal_eval(node.value)
+    missing = sorted(set(ref) - set(dir(F)))
+    assert not missing, missing
