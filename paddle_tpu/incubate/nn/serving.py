@@ -134,11 +134,26 @@ def _apply_rope(x, cos, sin, neox):
     return x * cos + _rot_half(x, neox) * sin
 
 
-def _rope_tables(rope_emb, hd):
-    """Accept the reference's [2, b?, S, 1, hd] (or any reshapeable)
-    rotary table; returns (cos [S, hd], sin [S, hd])."""
+def _rope_tables(rope_emb, hd, neox=False):
+    """Normalize a reference-shaped rotary table to (cos [S, hd],
+    sin [S, hd]).  Accepted: any layout that squeezes to [2, S, hd] or
+    [2, S, hd//2] (half tables tile per the neox/interleaved style) —
+    the reference's [2, 1, S, 1, hd(/2)] serving layouts included.
+    Anything else (per-batch tables, no leading cos/sin axis) raises
+    loudly rather than silently mis-rotating."""
     r = jnp.asarray(rope_emb)
-    r = r.reshape(2, -1, hd)
+    shape = [s for s in r.shape if s != 1]
+    r = r.reshape(shape)
+    if r.ndim != 3 or r.shape[0] != 2 \
+            or r.shape[-1] not in (hd, hd // 2):
+        raise NotImplementedError(
+            f"rotary table of shape {list(jnp.asarray(rope_emb).shape)} "
+            f"is not supported: expected a layout squeezing to "
+            f"[2, S, {hd}] or [2, S, {hd // 2}] (per-batch rotary "
+            "tables have no TPU lowering here)")
+    if r.shape[-1] == hd // 2:
+        r = (jnp.concatenate([r, r], axis=-1) if neox
+             else jnp.repeat(r, 2, axis=-1))
     return r[0], r[1]
 
 
@@ -158,6 +173,10 @@ def masked_multihead_attention(
     _reject(qkv_out_scale=qkv_out_scale, out_shift=out_shift,
             out_smooth=out_smooth, beam_cache_offset=beam_cache_offset,
             cum_offsets=cum_offsets)
+    if out_scale != -1:
+        raise NotImplementedError(
+            "masked_multihead_attention out_scale (int8 output "
+            "quantization) is not supported on the TPU backend")
     if cache_kv is None:
         raise ValueError("masked_multihead_attention requires cache_kv")
     if sequence_lengths is None:
@@ -178,7 +197,7 @@ def masked_multihead_attention(
         pos = (lens.reshape(-1).astype(jnp.int32) if lens is not None
                else jnp.zeros((bsz,), jnp.int32))
         if rot is not None:
-            cos_t, sin_t = _rope_tables(rot, hd)
+            cos_t, sin_t = _rope_tables(rot, hd, use_neox_rotary_style)
             cos = cos_t[pos][:, None, :]
             sin = sin_t[pos][:, None, :]
             q = _apply_rope(q, cos, sin, use_neox_rotary_style)
@@ -248,6 +267,10 @@ def block_multihead_attention(
             cache_v_dequant_scales=cache_v_dequant_scales,
             qkv_out_scale=qkv_out_scale, out_shift=out_shift,
             out_smooth=out_smooth, tgt_mask=tgt_mask)
+    if out_scale != -1 or use_dynamic_cachekv_quant:
+        raise NotImplementedError(
+            "block_multihead_attention quantized output / dynamic cache-"
+            "KV quant is not supported on the TPU backend")
 
     def body(qkv_, kc, vc, dec_lens, this_lens, pad_off, tables,
              b_=None, rope=None, m_=None):
@@ -279,7 +302,7 @@ def block_multihead_attention(
         cache_pos = dec[:, None] + p_in_seq                # absolute pos
 
         if rope is not None:
-            cos_t, sin_t = _rope_tables(rope, hd)
+            cos_t, sin_t = _rope_tables(rope, hd, use_neox_style)
             cp = jnp.clip(cache_pos, 0, cos_t.shape[0] - 1)
             cos = cos_t[cp][:, :, None, :]
             sin = sin_t[cp][:, :, None, :]
@@ -510,7 +533,8 @@ def fused_multi_transformer(
             else:
                 pos = None
             if rot is not None:
-                cos_t, sin_t = _rope_tables(rot, hd)
+                cos_t, sin_t = _rope_tables(rot, hd,
+                                             use_neox_rotary_style)
                 if decode:
                     cos = cos_t[pos][:, None, None, :]
                     sin = sin_t[pos][:, None, None, :]

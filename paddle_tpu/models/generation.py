@@ -393,54 +393,66 @@ def build_generate_fn_paged(config: LlamaConfig, gen: GenerationConfig,
 
 
 # ------------------------------------------------------------------ main
+def _cache_len(prompt_len, max_new_tokens):
+    """Padded cache length: the block-cache kernel needs 128 alignment
+    (rope rows past max_position_embeddings exist but are never
+    addressed); the XLA path skips it so tiny caches stay tiny."""
+    from ..ops.pallas import decode_attention as _DA
+    T = prompt_len + max_new_tokens
+    if _DA.PALLAS_DECODE or _DA._INTERPRET:
+        T = -(-T // 128) * 128
+    return T
+
+
+def _prefill_prompt(state, ids, lengths, cos, sin, config, prompt_len, T):
+    """Shared prompt prefill (greedy + beam paths): returns
+    (last [B, D] hidden of each prompt's final real token, logits_of,
+    kcache [L, B, kvH, T, D], vcache)."""
+    L = config.num_hidden_layers
+    x = jnp.take(state["llama.embed_tokens.weight"], ids, axis=0)
+    pmask = jnp.arange(prompt_len)[None, :] < lengths[:, None]
+    kcaches, vcaches = [], []
+    for i in range(L):
+        w = _layer_weights(state, i)
+        x, k, v = _prefill_layer(w, x, cos[:prompt_len],
+                                 sin[:prompt_len], pmask, config)
+        # kv-head-major cache layout [B, kvH, T, D]
+        pad = ((0, 0), (0, 0), (0, T - prompt_len), (0, 0))
+        kcaches.append(jnp.pad(k.swapaxes(1, 2), pad))
+        vcaches.append(jnp.pad(v.swapaxes(1, 2), pad))
+    kcache = jnp.stack(kcaches)
+    vcache = jnp.stack(vcaches)
+
+    x = _rms(x, state["llama.norm.weight"], config.rms_norm_eps)
+    head = state.get("lm_head.weight")
+
+    def logits_of(h):
+        if head is not None:
+            return _mm(h, head)
+        return h @ state["llama.embed_tokens.weight"].T
+
+    last = jnp.take_along_axis(
+        x, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    return last, logits_of, kcache, vcache
+
+
 def build_generate_fn(config: LlamaConfig, gen: GenerationConfig,
                       prompt_len: int):
     """Returns jitted (state, ids[B, prompt_len], lengths[B], key) ->
     tokens [B, prompt_len + max_new_tokens]."""
     L = config.num_hidden_layers
-    T = prompt_len + gen.max_new_tokens
-    assert T <= config.max_position_embeddings
-    from ..ops.pallas import decode_attention as _DA
-    if _DA.PALLAS_DECODE or _DA._INTERPRET:
-        # the block-cache kernel needs a 128-aligned cache; the pos mask
-        # ignores the tail slots (rope rows past max_position_embeddings
-        # exist but are never addressed).  The default XLA path skips
-        # this so tiny caches don't pay for unused slots.
-        T = -(-T // 128) * 128
+    T = _cache_len(prompt_len, gen.max_new_tokens)
+    assert prompt_len + gen.max_new_tokens \
+        <= config.max_position_embeddings
 
     def run(state, ids, lengths, key):
         b = ids.shape[0]
-        dtype = state["llama.embed_tokens.weight"].dtype
         cos, sin = _rope_tables(T, config.head_dim, config.rope_theta)
         cos = cos.astype(jnp.float32)
         sin = sin.astype(jnp.float32)
 
-        # ---- prefill over the padded prompt
-        x = jnp.take(state["llama.embed_tokens.weight"], ids, axis=0)
-        pmask = jnp.arange(prompt_len)[None, :] < lengths[:, None]
-        kcaches, vcaches = [], []
-        for i in range(L):
-            w = _layer_weights(state, i)
-            x, k, v = _prefill_layer(w, x, cos[:prompt_len],
-                                     sin[:prompt_len], pmask, config)
-            # kv-head-major cache layout [B, kvH, T, D]
-            pad = ((0, 0), (0, 0), (0, T - prompt_len), (0, 0))
-            kcaches.append(jnp.pad(k.swapaxes(1, 2), pad))
-            vcaches.append(jnp.pad(v.swapaxes(1, 2), pad))
-        kcache = jnp.stack(kcaches)            # [L, B, kvH, T, D]
-        vcache = jnp.stack(vcaches)
-
-        x = _rms(x, state["llama.norm.weight"], config.rms_norm_eps)
-        head = state.get("lm_head.weight")
-
-        def logits_of(h):
-            if head is not None:
-                return _mm(h, head)
-            return h @ state["llama.embed_tokens.weight"].T
-
-        # last real prompt token's hidden state seeds decoding
-        last = jnp.take_along_axis(
-            x, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+        last, logits_of, kcache, vcache = _prefill_prompt(
+            state, ids, lengths, cos, sin, config, prompt_len, T)
         key, sub = jax.random.split(key)
         tok = _sample(logits_of(last), sub, gen)
 
@@ -481,10 +493,123 @@ def build_generate_fn(config: LlamaConfig, gen: GenerationConfig,
     return jax.jit(run)
 
 
+def build_generate_fn_beam(config: LlamaConfig, gen: GenerationConfig,
+                           prompt_len: int, num_beams: int):
+    """Beam-search decoding with the KV cache (reference
+    nn/decode.py BeamSearchDecoder semantics over the serving engine):
+    fixed-shape [B, K, V] top-k merge per step under jax.lax.scan, beam
+    ancestry resolved by a gather_tree backtrace — no ragged hypothesis
+    sets, everything jits.  Finished beams emit only eos with log-prob 0
+    (score freezes), matching the reference's noend mask."""
+    L = config.num_hidden_layers
+    K = num_beams
+    T = _cache_len(prompt_len, gen.max_new_tokens)
+    assert prompt_len + gen.max_new_tokens \
+        <= config.max_position_embeddings
+    eos = gen.eos_token_id
+
+    def run(state, ids, lengths, key):
+        b = ids.shape[0]
+        cos, sin = _rope_tables(T, config.head_dim, config.rope_theta)
+        cos = cos.astype(jnp.float32)
+        sin = sin.astype(jnp.float32)
+
+        last, logits_of, kcache, vcache = _prefill_prompt(
+            state, ids, lengths, cos, sin, config, prompt_len, T)
+        lp0 = jax.nn.log_softmax(
+            logits_of(last).astype(jnp.float32), axis=-1)   # [B, V]
+        V = lp0.shape[-1]
+        # first step: top-K over the vocab seeds the beams
+        log_probs, tok = jax.lax.top_k(lp0, K)              # [B, K]
+        done = jnp.zeros((b, K), bool)
+        if eos is not None:
+            done = done | (tok == eos)
+
+        # beams share the prefill cache: expand to [L, B*K, kvh, T, D]
+        def expand(c):
+            return jnp.repeat(c, K, axis=1)
+
+        kcache, vcache = expand(kcache), expand(vcache)
+        noend = jnp.full((V,), -1e9, jnp.float32)
+        if eos is not None:
+            noend = noend.at[eos].set(0.0)
+
+        def step(carry, _):
+            tok, pos, kcache, vcache, log_probs, done = carry
+            flat_tok = tok.reshape(b * K)
+            emb = jnp.take(state["llama.embed_tokens.weight"], flat_tok,
+                           axis=0)
+            posf = jnp.repeat(pos, K)
+            cos1, sin1 = _rope_at(cos, sin, posf)
+            h = emb
+            newk, newv = [], []
+            for i in range(L):
+                w = _layer_weights(state, i)
+                h, kc, vc = _decode_layer(w, h, kcache[i], vcache[i],
+                                          cos1, sin1, posf, config)
+                newk.append(kc)
+                newv.append(vc)
+            kcache = jnp.stack(newk)
+            vcache = jnp.stack(newv)
+            h = _rms(h[:, None], state["llama.norm.weight"],
+                     config.rms_norm_eps)[:, 0]
+            step_lp = jax.nn.log_softmax(
+                logits_of(h).astype(jnp.float32), axis=-1) \
+                .reshape(b, K, V)
+            # finished beams: only eos continues, at zero cost
+            step_lp = jnp.where(done[:, :, None], noend[None, None, :],
+                                step_lp)
+            cand = (log_probs[:, :, None] + step_lp).reshape(b, K * V)
+            log_probs, flat_idx = jax.lax.top_k(cand, K)     # [B, K]
+            parent = flat_idx // V
+            nxt = flat_idx % V
+
+            # reorder beam state by ancestry
+            gidx = (jnp.arange(b)[:, None] * K + parent).reshape(-1)
+            kcache = kcache[:, gidx]
+            vcache = vcache[:, gidx]
+            done = jnp.take_along_axis(done, parent, axis=1)
+            if eos is not None:
+                nxt = jnp.where(done, gen.pad_token_id, nxt)
+                done = done | (nxt == eos)
+            return ((nxt, pos + 1, kcache, vcache, log_probs, done),
+                    (tok, parent))
+
+        init = (tok.astype(jnp.int32), lengths.astype(jnp.int32),
+                kcache, vcache, log_probs, done)
+        (tok, _, _, _, log_probs, _), (toks, parents) = jax.lax.scan(
+            step, init, None, length=gen.max_new_tokens - 1)
+        # toks[t]: tokens in time-t beam order; scan's parent_j maps
+        # time-(j+1) beams to time-j beams, so toks[t] pairs with
+        # parents[t-1] — the seed row (t=0) has identity ancestry
+        toks = jnp.concatenate([toks, tok[None]], axis=0)   # [N, B, K]
+        parents = jnp.concatenate(
+            [jnp.broadcast_to(jnp.arange(K), (1, b, K)), parents], axis=0)
+
+        # backtrace ancestry (nn.functional gather_tree semantics)
+        def bt(carry, inp):
+            beam = carry
+            t_tok, t_par = inp
+            out = jnp.take_along_axis(t_tok, beam, axis=-1)
+            beam = jnp.take_along_axis(t_par, beam, axis=-1)
+            return beam, out
+
+        init_beam = jnp.broadcast_to(jnp.arange(K), (b, K))
+        _, seq_rev = jax.lax.scan(bt, init_beam,
+                                  (toks[::-1], parents[::-1]))
+        seqs = seq_rev[::-1]                                # [N, B, K]
+        best = jnp.argmax(log_probs, axis=-1)               # [B]
+        best_seq = jnp.take_along_axis(
+            seqs, best[None, :, None], axis=2)[:, :, 0].T   # [B, N]
+        return jnp.concatenate([ids, best_seq.astype(ids.dtype)], axis=1)
+
+    return jax.jit(run)
+
+
 def generate(model, input_ids, max_new_tokens=64, do_sample=False,
              temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
              pad_token_id=0, seed=0, lengths=None, cache="dense",
-             page_size=128, weight_quant=None):
+             page_size=128, weight_quant=None, num_beams=1):
     """User entry: model is a LlamaForCausalLM; input_ids [B, S] (right-
     padded if lengths given; new tokens overwrite the padded slots in the
     cache). Returns [B, S + max_new_tokens] ids.
@@ -492,7 +617,11 @@ def generate(model, input_ids, max_new_tokens=64, do_sample=False,
     cache="paged" serves from a block-table pool (reference
     block_multi_head_attention): HBM and attention reads scale with each
     sequence's OWN length instead of the batch max — the win on ragged
-    batches."""
+    batches.
+
+    num_beams > 1 runs beam search (reference nn/decode.py semantics)
+    with the dense KV cache — a fixed-shape [B, K, V] top-k merge per
+    scanned step."""
     from ..framework.tensor import Tensor
 
     ids = input_ids._data if isinstance(input_ids, Tensor) else \
@@ -537,6 +666,27 @@ def generate(model, input_ids, max_new_tokens=64, do_sample=False,
                                or k.endswith(("qkv_fused.weight",
                                               "gateup_fused.weight"))})
     from ..ops.pallas import decode_attention as _DA
+
+    if num_beams > 1:
+        if do_sample:
+            raise ValueError("num_beams > 1 requires do_sample=False "
+                             "(beam search is deterministic)")
+        if cache == "paged":
+            raise NotImplementedError(
+                "beam search currently uses the dense cache "
+                "(paged-beam reordering needs per-beam block tables)")
+        cache_key = ("beam", astuple_cfg(model.config), s,
+                     gen.max_new_tokens, num_beams, gen.eos_token_id,
+                     gen.pad_token_id,
+                     _DA.PALLAS_DECODE or _DA._INTERPRET, weight_quant)
+        fn = _FN_CACHE.get(cache_key)
+        if fn is None:
+            if len(_FN_CACHE) >= _FN_CACHE_MAX:
+                _FN_CACHE.pop(next(iter(_FN_CACHE)))
+            fn = _FN_CACHE[cache_key] = build_generate_fn_beam(
+                model.config, gen, s, num_beams)
+        out = fn(state, ids, lengths_arr, jax.random.key(seed))
+        return Tensor(out, stop_gradient=True)
 
     if cache == "paged":
         from ..ops.pallas.paged_attention import PagedPool

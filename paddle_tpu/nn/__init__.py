@@ -15,6 +15,7 @@ from .layer_extra import *  # noqa: F401,F403
 from .transformer import (  # noqa: F401
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
     TransformerDecoderLayer, TransformerDecoder, Transformer)
+from .decode import Decoder, BeamSearchDecoder, dynamic_decode  # noqa: F401
 from . import utils  # noqa: F401
 from . import quant  # noqa: F401
 from .clip import ClipGradByNorm, ClipGradByValue, ClipGradByGlobalNorm  # noqa: F401

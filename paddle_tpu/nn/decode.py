@@ -1,0 +1,294 @@
+"""Beam-search decoding: Decoder / BeamSearchDecoder / dynamic_decode.
+
+Reference: python/paddle/nn/decode.py (BeamSearchDecoder:161,
+dynamic_decode:1238).  Semantics mirrored exactly: scores are summed
+log-softmax probabilities, finished beams emit only end_token with
+log-prob 0 (so their score freezes), top-k runs over the flattened
+[beam_size * vocab] candidates, and finalize back-tracks the beam
+ancestry with gather_tree.
+
+TPU formulation: every step is fixed-shape tensor work ([B, K, V]
+top-k merge — no ragged hypotheses sets), so the loop body jits; the
+eager loop stops early on all-finished exactly like the reference's
+imperative path.
+"""
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from . import functional as F
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+
+def _map_structure(fn, *structs):
+    import jax
+    return jax.tree_util.tree_map(
+        fn, *structs, is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _flatten(struct):
+    import jax
+    return jax.tree_util.tree_flatten(
+        struct, is_leaf=lambda x: isinstance(x, Tensor))[0]
+
+
+class Decoder:
+    """Base decoder interface for dynamic_decode (reference decode.py:50):
+    initialize() -> (input, state, finished); step() -> (output, state,
+    next_input, finished); optional finalize()."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """reference decode.py:161 — wraps a cell; each step scores
+    candidates and keeps the top ``beam_size`` hypotheses per batch."""
+
+    OutputWrapper = collections.namedtuple(
+        "OutputWrapper", ("scores", "predicted_ids", "parent_ids"))
+    StateWrapper = collections.namedtuple(
+        "StateWrapper", ("cell_states", "log_probs", "finished", "lengths"))
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.kinf = 1e9
+
+    # ----------------------------------------------------- shape helpers
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[batch, ...] -> [batch * beam_size, ...] by tiling each batch
+        entry (for encoder outputs used inside cell.call)."""
+        import paddle_tpu as paddle
+        x = paddle.unsqueeze(x, [1])
+        tiles = [1, beam_size] + [1] * (len(x.shape) - 2)
+        x = paddle.tile(x, tiles)
+        return paddle.reshape(x, [-1] + list(x.shape[2:]))
+
+    def _expand_to_beam_size(self, x):
+        import paddle_tpu as paddle
+        x = paddle.unsqueeze(x, [1])
+        tiles = [1, self.beam_size] + [1] * (len(x.shape) - 2)
+        return paddle.tile(x, tiles)
+
+    def _merge_batch_beams(self, x):
+        import paddle_tpu as paddle
+        return paddle.reshape(x, [-1] + list(x.shape[2:]))
+
+    def _split_batch_beams(self, x):
+        import paddle_tpu as paddle
+        return paddle.reshape(x, [-1, self.beam_size] + list(x.shape[1:]))
+
+    def _gather(self, x, indices, batch_size):
+        """Per-batch gather along the beam axis."""
+        import paddle_tpu as paddle
+        batch_pos = paddle.tile(
+            paddle.unsqueeze(paddle.arange(0, batch_size, 1,
+                                           dtype=indices.dtype), [1]),
+            [1, self.beam_size])
+        coords = paddle.stack([batch_pos, indices], axis=2)
+        return paddle.gather_nd(x, coords)
+
+    # ------------------------------------------------------------- steps
+    def initialize(self, initial_cell_states):
+        import paddle_tpu as paddle
+        state = _flatten(initial_cell_states)[0]
+        self.batch_size = int(state.shape[0])
+
+        init_cell_states = _map_structure(self._expand_to_beam_size,
+                                          initial_cell_states)
+        init_inputs = paddle.full([self.batch_size, self.beam_size],
+                                  self.start_token, "int64")
+        log_probs = paddle.tile(
+            paddle.to_tensor(
+                np.array([[0.0] + [-self.kinf] * (self.beam_size - 1)],
+                         dtype="float32")),
+            [self.batch_size, 1])
+        init_finished = paddle.full([self.batch_size, self.beam_size],
+                                    False, "bool")
+        init_lengths = paddle.zeros_like(init_inputs)
+        if self.embedding_fn is not None:
+            init_inputs = self.embedding_fn(init_inputs)
+        return (init_inputs,
+                self.StateWrapper(init_cell_states, log_probs,
+                                  init_finished, init_lengths),
+                init_finished)
+
+    def _mask_probs(self, probs, finished):
+        """Finished beams: only end_token continues, with log-prob 0."""
+        import paddle_tpu as paddle
+        noend = np.full((self.vocab_size,), -self.kinf, "float32")
+        noend[self.end_token] = 0.0
+        noend_t = paddle.to_tensor(noend)
+        fin = paddle.cast(finished, probs.dtype)
+        return probs * (1.0 - fin.unsqueeze([2])) \
+            + noend_t.reshape([1, 1, -1]) * fin.unsqueeze([2])
+
+    def _beam_search_step(self, time, logits, next_cell_states, beam_state):
+        import paddle_tpu as paddle
+        self.vocab_size = int(logits.shape[-1])
+
+        step_log_probs = paddle.log(F.softmax(logits))
+        step_log_probs = self._mask_probs(step_log_probs,
+                                          beam_state.finished)
+        log_probs = step_log_probs + beam_state.log_probs.unsqueeze([2])
+        scores = paddle.reshape(log_probs,
+                                [-1, self.beam_size * self.vocab_size])
+        topk_scores, topk_indices = paddle.topk(scores, k=self.beam_size)
+        beam_indices = topk_indices // self.vocab_size
+        token_indices = topk_indices % self.vocab_size
+        next_log_probs = self._gather(scores, topk_indices,
+                                      self.batch_size)
+        next_cell_states = _map_structure(
+            lambda x: self._gather(x, beam_indices, self.batch_size),
+            next_cell_states)
+        next_finished = self._gather(beam_state.finished, beam_indices,
+                                     self.batch_size)
+        next_lengths = self._gather(beam_state.lengths, beam_indices,
+                                    self.batch_size)
+        next_lengths = next_lengths + paddle.cast(
+            paddle.logical_not(next_finished), next_lengths.dtype)
+        next_finished = paddle.logical_or(
+            next_finished,
+            paddle.equal(token_indices,
+                         paddle.full([1], self.end_token, "int64")))
+
+        return (self.OutputWrapper(topk_scores, token_indices,
+                                   beam_indices),
+                self.StateWrapper(next_cell_states, next_log_probs,
+                                  next_finished, next_lengths))
+
+    def step(self, time, inputs, states, **kwargs):
+        inputs = _map_structure(self._merge_batch_beams, inputs)
+        cell_states = _map_structure(self._merge_batch_beams,
+                                     states.cell_states)
+        cell_outputs, next_cell_states = self.cell(inputs, cell_states,
+                                                   **kwargs)
+        cell_outputs = _map_structure(self._split_batch_beams,
+                                      cell_outputs)
+        next_cell_states = _map_structure(self._split_batch_beams,
+                                          next_cell_states)
+        if self.output_fn is not None:
+            cell_outputs = self.output_fn(cell_outputs)
+
+        beam_search_output, beam_search_state = self._beam_search_step(
+            time, cell_outputs, next_cell_states, states)
+        finished = beam_search_state.finished
+        sample_ids = beam_search_output.predicted_ids
+        if self.embedding_fn is not None:
+            next_inputs = self.embedding_fn(sample_ids)
+        else:
+            next_inputs = sample_ids
+        return beam_search_output, beam_search_state, next_inputs, finished
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        """Back-track beam ancestry (gather_tree) to materialize the
+        predicted token sequences [time, batch, beam]."""
+        predicted_ids = F.gather_tree(outputs.predicted_ids,
+                                      outputs.parent_ids)
+        return predicted_ids, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """reference decode.py:1238 — run decoder.step until every sequence
+    finishes or max_step_num is reached; stack per-step outputs."""
+    import paddle_tpu as paddle
+
+    initial_inputs, initial_states, initial_finished = \
+        decoder.initialize(inits)
+    inputs, states, finished = (initial_inputs, initial_states,
+                                paddle.cast(initial_finished, "bool"))
+    cond = paddle.logical_not(paddle.all(finished))
+    sequence_lengths = paddle.cast(paddle.zeros_like(finished), "int64")
+    outputs_list = None
+    step_idx = 0
+
+    while bool(cond.numpy()) and (max_step_num is None
+                                  or step_idx <= max_step_num):
+        time = paddle.to_tensor(np.array([step_idx], "int64"))
+        (step_outputs, next_states, next_inputs,
+         next_finished) = decoder.step(time, inputs, states, **kwargs)
+        if not decoder.tracks_own_finished:
+            next_finished = paddle.logical_or(next_finished, finished)
+        # reference: every beam still running at this step's start gets
+        # length = step+1 (lengths freeze only once finished)
+        next_sequence_lengths = paddle.where(
+            paddle.logical_not(finished),
+            paddle.full_like(sequence_lengths, step_idx + 1),
+            sequence_lengths)
+        if impute_finished:
+            float_mask = paddle.cast(finished, "float32")
+
+            def _impute(new, old):
+                if new.dtype not in (old.dtype,):
+                    return new
+                m = float_mask
+                while len(m.shape) < len(new.shape):
+                    m = m.unsqueeze([-1])
+                m = paddle.cast(m, new.dtype) \
+                    if "float" in str(new.dtype) else None
+                if m is None:
+                    return new
+                return new * (1.0 - m) + old * m
+
+            next_states = _map_structure(_impute, next_states, states)
+
+        flat_out = _flatten(step_outputs)
+        if outputs_list is None:
+            outputs_list = [[o] for o in flat_out]
+        else:
+            for acc, o in zip(outputs_list, flat_out):
+                acc.append(o)
+        inputs, states, finished = next_inputs, next_states, next_finished
+        sequence_lengths = next_sequence_lengths
+        cond = paddle.logical_not(paddle.all(finished))
+        step_idx += 1
+
+    import jax
+    _, treedef = jax.tree_util.tree_flatten(
+        step_outputs, is_leaf=lambda x: isinstance(x, Tensor))
+    stacked = [paddle.stack(acc, axis=0) for acc in outputs_list]
+    final_outputs = jax.tree_util.tree_unflatten(treedef, stacked)
+    final_states = states
+
+    if hasattr(decoder, "finalize") and not is_test:
+        try:
+            final_outputs, final_states = decoder.finalize(
+                final_outputs, final_states, sequence_lengths)
+        except NotImplementedError:
+            pass
+
+    if not output_time_major:
+        final_outputs = _map_structure(
+            lambda x: paddle.transpose(
+                x, [1, 0] + list(range(2, len(x.shape)))),
+            final_outputs)
+
+    return ((final_outputs, final_states, sequence_lengths)
+            if return_length else (final_outputs, final_states))

@@ -1,0 +1,210 @@
+"""Beam search: nn.BeamSearchDecoder + dynamic_decode + generate(num_beams).
+
+Reference: python/paddle/nn/decode.py (BeamSearchDecoder:161,
+dynamic_decode:1238).  Parity is checked against a NumPy beam-search
+reference implementing the documented semantics (log-softmax score
+accumulation, noend masking of finished beams, flattened K*V top-k).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+rng = np.random.RandomState(11)
+
+
+def _log_softmax(x):
+    x = x - x.max(axis=-1, keepdims=True)
+    return x - np.log(np.exp(x).sum(axis=-1, keepdims=True))
+
+
+def numpy_beam_search(step_logits_fn, state0, batch, beam, vocab, steps,
+                      start_token, end_token):
+    """Reference beam search over a deterministic cell:
+    step_logits_fn(tokens [N], states) -> (logits [N, V], new_states)."""
+    KINF = 1e9
+    tokens = np.full((batch, beam), start_token, np.int64)
+    log_probs = np.tile(np.array([[0.0] + [-KINF] * (beam - 1)], "f"),
+                        (batch, 1))
+    finished = np.zeros((batch, beam), bool)
+    states = state0                              # [batch*beam, ...]
+    hist_tok, hist_par = [], []
+    for _ in range(steps):
+        logits, states = step_logits_fn(tokens.reshape(-1), states)
+        step_lp = _log_softmax(logits.reshape(batch, beam, vocab))
+        noend = np.full((vocab,), -KINF, "f")
+        noend[end_token] = 0.0
+        step_lp = np.where(finished[:, :, None], noend[None, None, :],
+                           step_lp)
+        cand = (log_probs[:, :, None] + step_lp).reshape(batch, -1)
+        idx = np.argsort(-cand, axis=-1, kind="stable")[:, :beam]
+        log_probs = np.take_along_axis(cand, idx, axis=-1)
+        parent = idx // vocab
+        tokens = idx % vocab
+        finished = np.take_along_axis(finished, parent, axis=-1)
+        states = states.reshape(batch, beam, -1)
+        states = np.take_along_axis(
+            states, parent[:, :, None], axis=1).reshape(batch * beam, -1)
+        finished = finished | (tokens == end_token)
+        hist_tok.append(tokens.copy())
+        hist_par.append(parent.copy())
+    return hist_tok, hist_par, log_probs
+
+
+class _ToyCell(nn.Layer):
+    """Deterministic 'cell': logits depend on (input embedding, state)."""
+
+    def __init__(self, vocab, hidden):
+        super().__init__()
+        r = np.random.RandomState(5)
+        self.emb_w = paddle.to_tensor(
+            r.randn(vocab, hidden).astype("float32"))
+        self.w = paddle.to_tensor(r.randn(hidden, hidden)
+                                  .astype("float32") / np.sqrt(hidden))
+        self.state_shape = (hidden,)
+
+    def get_initial_states(self, batch_ref, **kw):
+        return paddle.zeros([batch_ref.shape[0], self.w.shape[0]])
+
+    def forward(self, inputs, states):
+        h = paddle.tanh(inputs @ self.w + states)
+        return h, h
+
+
+class TestDynamicDecodeBeam:
+    def test_matches_numpy_reference(self):
+        vocab, hidden, batch, beam, steps = 12, 8, 2, 3, 6
+        cell = _ToyCell(vocab, hidden)
+        emb = lambda ids: paddle.gather(  # noqa: E731
+            paddle.to_tensor(cell.emb_w.numpy()), ids.reshape([-1])) \
+            .reshape(list(ids.shape) + [hidden])
+        out_w = np.random.RandomState(6).randn(hidden, vocab) \
+            .astype("float32")
+        out_fn = lambda h: h @ paddle.to_tensor(out_w)   # noqa: E731
+
+        decoder = nn.BeamSearchDecoder(cell, start_token=0, end_token=1,
+                                       beam_size=beam, embedding_fn=emb,
+                                       output_fn=out_fn)
+        enc = paddle.zeros([batch, hidden])
+        outs, _states, lens = nn.dynamic_decode(
+            decoder, inits=cell.get_initial_states(enc),
+            max_step_num=steps - 1, return_length=True)
+
+        # numpy twin of the same cell
+        emb_np = cell.emb_w.numpy()
+        w_np = cell.w.numpy()
+
+        def step_fn(tokens, states):
+            h = np.tanh(emb_np[tokens] @ w_np + states)
+            return h @ out_w, h
+
+        toks, pars, lp = numpy_beam_search(
+            step_fn, np.zeros((batch * beam, hidden), "f"), batch, beam,
+            vocab, steps, 0, 1)
+
+        # backtrace the numpy history (gather_tree) and compare
+        beam_idx = np.tile(np.arange(beam), (batch, 1))
+        ref_rows = []
+        for t in range(steps - 1, -1, -1):
+            ref_rows.append(np.take_along_axis(toks[t], beam_idx, -1))
+            beam_idx = np.take_along_axis(pars[t], beam_idx, -1)
+        ref = np.stack(ref_rows[::-1], axis=0)       # [T, batch, beam]
+        got = outs.numpy()                           # [batch, T, beam]
+        np.testing.assert_array_equal(got.transpose(1, 0, 2), ref)
+
+    def test_finished_beams_freeze(self):
+        """A vocab where end_token dominates: all beams finish fast and
+        lengths stop growing."""
+        vocab, hidden, batch, beam = 6, 4, 2, 2
+
+        class EndCell(_ToyCell):
+            def forward(self, inputs, states):
+                h, s = super().forward(inputs, states)
+                return h, s
+
+        cell = EndCell(vocab, hidden)
+        bias = np.zeros(vocab, "f")
+        bias[1] = 50.0                                # end_token wins
+
+        out_fn = lambda h: h @ paddle.to_tensor(      # noqa: E731
+            np.zeros((hidden, vocab), "f")) + paddle.to_tensor(bias)
+        emb = lambda ids: paddle.gather(              # noqa: E731
+            paddle.to_tensor(cell.emb_w.numpy()), ids.reshape([-1])) \
+            .reshape(list(ids.shape) + [hidden])
+        decoder = nn.BeamSearchDecoder(cell, start_token=0, end_token=1,
+                                       beam_size=beam, embedding_fn=emb,
+                                       output_fn=out_fn)
+        enc = paddle.zeros([batch, hidden])
+        outs, _s, lens = nn.dynamic_decode(
+            decoder, inits=cell.get_initial_states(enc), max_step_num=9,
+            return_length=True)
+        assert int(outs.numpy().shape[1]) <= 3   # stopped early
+        assert (lens.numpy() <= 2).all()
+
+    def test_tile_beam_merge_with_batch(self):
+        x = paddle.to_tensor(rng.randn(2, 5).astype("float32"))
+        y = nn.BeamSearchDecoder.tile_beam_merge_with_batch(x, 3)
+        assert y.shape == [6, 5]
+        np.testing.assert_allclose(y.numpy()[0], y.numpy()[2])
+        np.testing.assert_allclose(y.numpy()[3], x.numpy()[1])
+
+
+class TestGenerateBeams:
+    def _model(self):
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        cfg = LlamaConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=128)
+        paddle.seed(7)
+        return LlamaForCausalLM(cfg)
+
+    def test_beam_beats_or_matches_greedy_logprob(self):
+        """Beam-1 must equal greedy; beam-4's sequence log-prob must be
+        >= greedy's (the whole point of beam search)."""
+        from paddle_tpu.models import generation as G
+        m = self._model()
+        ids = paddle.to_tensor(
+            rng.randint(2, 60, (2, 5)).astype("int64"))
+        greedy = G.generate(m, ids, max_new_tokens=6)
+        beam1 = G.generate(m, ids, max_new_tokens=6, num_beams=1)
+        np.testing.assert_array_equal(greedy.numpy(), beam1.numpy())
+
+        beam4 = G.generate(m, ids, max_new_tokens=6, num_beams=4)
+        assert beam4.numpy().shape == greedy.numpy().shape
+
+        def seq_logprob(model, ids_np, full_np):
+            # score continuation tokens under teacher forcing
+            x = paddle.to_tensor(full_np[:, :-1])
+            logits = model(x)
+            lp = np.asarray(
+                paddle.nn.functional.log_softmax(logits, axis=-1).numpy())
+            tot = np.zeros(ids_np.shape[0])
+            for b in range(ids_np.shape[0]):
+                for t in range(ids_np.shape[1] - 1, full_np.shape[1] - 1):
+                    tot[b] += lp[b, t, full_np[b, t + 1]]
+            return tot
+
+        g_lp = seq_logprob(m, ids.numpy(), greedy.numpy())
+        b_lp = seq_logprob(m, ids.numpy(), beam4.numpy())
+        assert (b_lp >= g_lp - 1e-3).all(), (b_lp, g_lp)
+
+    def test_beam_respects_eos_padding(self):
+        from paddle_tpu.models import generation as G
+        m = self._model()
+        ids = paddle.to_tensor(rng.randint(2, 60, (1, 4)).astype("int64"))
+        out = G.generate(m, ids, max_new_tokens=8, num_beams=3,
+                         eos_token_id=3, pad_token_id=0)
+        seq = out.numpy()[0, 4:]
+        hit = np.where(seq == 3)[0]
+        if hit.size:                      # everything after eos is pad
+            assert (seq[hit[0] + 1:] == 0).all()
+
+    def test_beam_rejects_sampling(self):
+        from paddle_tpu.models import generation as G
+        m = self._model()
+        ids = paddle.to_tensor(rng.randint(2, 60, (1, 4)).astype("int64"))
+        with pytest.raises(ValueError):
+            G.generate(m, ids, max_new_tokens=4, num_beams=2,
+                       do_sample=True)
