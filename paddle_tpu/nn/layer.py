@@ -224,6 +224,20 @@ class Layer:
         raise NotImplementedError
 
     def __call__(self, *inputs, **kwargs):
+        # Eager segment tracing (reference hot-path goal, phi/README.md
+        # §1.2): a composite layer whose tree is hook/buffer-free runs
+        # its WHOLE forward as one cached-jit dispatch — the dygraph
+        # dispatch-count lever on a tunneled transport.  Purity is
+        # enforced dynamically: the first dispatch doubles as a probe
+        # (eager-RNG use or a trace failure falls back to per-op
+        # forever).  See _segment_call.
+        if self._sub_layers and not self._forward_pre_hooks \
+                and not self._forward_post_hooks:
+            from . import layer_common as _lc
+            if _lc.SEGMENT_FORWARD:
+                out = self._segment_call(inputs, kwargs)
+                if out is not NotImplemented:
+                    return out
         for hook in self._forward_pre_hooks.values():
             out = hook(self, inputs)
             if out is not None:
@@ -234,6 +248,117 @@ class Layer:
             if out is not None:
                 outputs = out
         return outputs
+
+    # --------------------------------------------- eager segment tracing
+    def _segment_call(self, inputs, kwargs):
+        """Run forward as ONE recorded op keyed on (structure
+        fingerprint, input signature).  Returns NotImplemented when the
+        segment path doesn't apply (traced input, AMP, hooks/buffers
+        anywhere in the tree, unhashable statics, known-impure).
+
+        Invalidation contract (tests/test_segment_forward.py): layer
+        add/replace, hook registration, param REASSIGNMENT (the Tensor
+        object changes — in-place optimizer updates do not), and
+        train/eval flips all change the fingerprint and retrace.  Known
+        limit (same as the reference's guard-free fast path): mutating a
+        plain config attribute (e.g. a stored scale) after the first
+        call is baked into the traced body.
+        """
+        import jax
+        from jax.tree_util import tree_flatten, tree_unflatten
+
+        from ..framework.tensor import Tensor
+        from ..amp.auto_cast import _state as _amp_state
+        from . import layer_common as _lc
+
+        flat_in, treedef = tree_flatten(
+            (inputs, kwargs), is_leaf=lambda t: isinstance(t, Tensor))
+        t_set = {i for i, v in enumerate(flat_in)
+                 if isinstance(v, Tensor)}
+        if not t_set or _amp_state.enabled:
+            return NotImplemented
+        from ..ops import registry as _reg
+        for i, v in enumerate(flat_in):
+            if i in t_set:
+                if isinstance(v._data, jax.core.Tracer):
+                    return NotImplemented
+            else:
+                try:
+                    _reg._static_fingerprint(v)
+                except _reg._Unhashable:
+                    return NotImplemented
+
+        layers = list(self.sublayers(include_self=True))
+        for l in layers:
+            if l._buffers or l._forward_pre_hooks \
+                    or l._forward_post_hooks:
+                return NotImplemented
+        fp = tuple(
+            (type(l).__name__, id(l), l.training,
+             tuple(id(p) for p in l._parameters.values()))
+            for l in layers)
+        # keyed by fingerprint so ALTERNATING structures (the classic
+        # train()/eval() flip per epoch) reuse their traces instead of
+        # minting a new segment name + full recompile per flip
+        seg_map = self.__dict__.setdefault("_seg_cache_map", {})
+        cached = seg_map.get(fp)
+        if cached is None:
+            if len(seg_map) >= 8:
+                seg_map.pop(next(iter(seg_map)))
+            # `layers` held strongly so fingerprinted ids can't be
+            # recycled by a freed-and-replaced sublayer
+            cached = (fp, True,
+                      f"segment_{type(self).__name__}_"
+                      f"{next(_lc._SEG_IDS)}",
+                      list(self.parameters()), layers)
+            seg_map[fp] = cached
+        self.__dict__["_seg_cache"] = cached   # latest, for tests/debug
+        _, pure, name, ps, _keep = cached
+        if not pure:
+            return NotImplemented
+
+        n_in = len(flat_in)
+
+        def body(*vals):
+            from ..autograd import tape as _tape
+            leaf_vals, pvals = vals[:n_in], vals[n_in:]
+            saved = [p._data for p in ps]
+            try:
+                for p, v in zip(ps, pvals):
+                    p._data = v
+                flat2 = [Tensor(v, stop_gradient=True) if i in t_set
+                         else v for i, v in enumerate(leaf_vals)]
+                a2, k2 = tree_unflatten(treedef, flat2)
+                with _tape.no_grad():
+                    out = self.forward(*a2, **k2)
+                out_flat, out_tree = tree_flatten(
+                    out, is_leaf=lambda t: isinstance(t, Tensor))
+                return tree_unflatten(
+                    out_tree,
+                    [t._data if isinstance(t, Tensor) else t
+                     for t in out_flat])
+            finally:
+                for p, v in zip(ps, saved):
+                    p._data = v
+
+        try:
+            out = _reg.apply_op(name, body, tuple(flat_in) + tuple(ps),
+                                {})
+        except Exception:
+            # forward not traceable as one op (data-dependent python,
+            # non-array outputs, ...): per-op path from now on
+            impure = (fp, False, name, ps, layers)
+            seg_map[fp] = impure
+            self.__dict__["_seg_cache"] = impure
+            return NotImplemented
+        if name in _reg._UNCACHEABLE:
+            # the probe saw eager RNG: this forward is not replayable
+            # from a cached trace — mark impure (per-op from now on);
+            # THIS call's output is already correct (fresh trace)
+            impure = (fp, False, name, ps, layers)
+            seg_map[fp] = impure
+            self.__dict__["_seg_cache"] = impure
+        return out
 
     def register_forward_pre_hook(self, hook):
         handle = _HookRemoveHelper(self._forward_pre_hooks)

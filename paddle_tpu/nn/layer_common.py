@@ -215,18 +215,13 @@ class Bilinear(Layer):
 
 # ------------------------------------------------------------- containers
 
-# Eager segment tracing (reference hot-path goal, phi/README.md §1.2):
-# a Sequential whose layers are all PURE (stateless given params — no
-# buffers, no RNG, no train/eval behavior split) runs its whole forward
-# as ONE cached-jit dispatch instead of one per op, and records ONE
-# GradNode.  On a tunneled transport each eager dispatch costs ~0.5 ms,
-# so this is the dygraph forward's dispatch-count lever.
+# Eager segment tracing toggle (reference hot-path goal, phi/README.md
+# §1.2).  The machinery is GENERAL now — Layer._segment_call (layer.py)
+# runs ANY hook/buffer-free composite layer's forward as ONE cached-jit
+# dispatch with dynamic purity probing (eager-RNG / untraceable python
+# falls back per-op).  On a tunneled transport each eager dispatch costs
+# ~0.5 ms, so this is the dygraph forward's dispatch-count lever.
 SEGMENT_FORWARD = True
-_PURE_TYPE_NAMES = frozenset({
-    "Linear", "Conv2D", "ReLU", "ReLU6", "Sigmoid", "Tanh", "GELU",
-    "LeakyReLU", "Softmax", "MaxPool2D", "AvgPool2D", "Flatten",
-    "Sequential",
-})
 _SEG_IDS = iter(range(1, 1 << 62))
 
 
@@ -244,79 +239,9 @@ class Sequential(Layer):
                     self.add_sublayer(str(i), layer)
 
     def forward(self, x):
-        if SEGMENT_FORWARD:
-            out = self._try_segment_forward(x)
-            if out is not NotImplemented:
-                return out
         for layer in self._sub_layers.values():
             x = layer(x)
         return x
-
-    # ------------------------------------------------- segment tracing
-    def _segment_pure(self):
-        for l in self.sublayers(include_self=True):
-            if (type(l).__name__ not in _PURE_TYPE_NAMES
-                    or type(l).__module__.rpartition(".")[0]
-                    != __name__.rpartition(".")[0]     # our nn only
-                    or l._buffers
-                    or l._forward_pre_hooks or l._forward_post_hooks):
-                return False
-        return True
-
-    def _try_segment_forward(self, x):
-        import jax
-
-        from ..amp.auto_cast import _state as _amp_state
-        from ..framework.tensor import Tensor
-
-        if not isinstance(x, Tensor) or isinstance(x._data,
-                                                   jax.core.Tracer):
-            return NotImplemented
-        if _amp_state.enabled:
-            # AMP applies white/black-list casts PER OP; a fused segment
-            # would flatten that policy — keep the per-op path
-            return NotImplemented
-        # structure fingerprint: layer additions/replacements, hook
-        # registration, and param REASSIGNMENT (layer.weight = new — the
-        # Tensor object changes; in-place optimizer updates do not)
-        # invalidate the cached trace.  Known limit: mutating a layer's
-        # config attribute (e.g. pool stride) after the first call is
-        # not detected — configs are baked into the traced body.
-        fp = tuple(
-            (type(l).__name__, id(l), len(l._forward_pre_hooks),
-             len(l._forward_post_hooks),
-             tuple(id(p) for p in l._parameters.values()))
-            for l in self.sublayers(include_self=True))
-        cached = self.__dict__.get("_seg_cache")
-        if cached is None or cached[0] != fp:
-            pure = self._segment_pure()
-            # param order is the replay contract; the unique segment op
-            # name pins the dispatch cache to THIS instance + structure
-            cached = (fp, pure,
-                      f"sequential_segment_{next(_SEG_IDS)}",
-                      list(self.parameters()))
-            self.__dict__["_seg_cache"] = cached
-        _, pure, name, ps = cached
-        if not pure:
-            return NotImplemented
-
-        def body(xv, *pvals):
-            from ..autograd import tape as _tape
-            saved = [p._data for p in ps]
-            try:
-                for p, v in zip(ps, pvals):
-                    p._data = v
-                with _tape.no_grad():
-                    h = Tensor(xv, stop_gradient=True)
-                    for layer in self._sub_layers.values():
-                        h = layer(h)
-                return h._data
-            finally:
-                for p, v in zip(ps, saved):
-                    p._data = v
-
-        from ..ops.registry import apply_op
-        return apply_op(name, body, (x, *ps), {})
 
     def __getitem__(self, idx):
         if isinstance(idx, slice):

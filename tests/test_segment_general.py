@@ -1,0 +1,212 @@
+"""General segment tracing (Layer._segment_call): ANY hook/buffer-free
+composite layer — hand-written forward included — runs as one cached
+dispatch.  Reference hot-path goal: phi/README.md §1.2 (dygraph is the
+default UX; its dispatch must be lean)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import layer_common as LC
+
+
+@pytest.fixture(autouse=True)
+def _on():
+    LC.SEGMENT_FORWARD = True
+    yield
+    LC.SEGMENT_FORWARD = True
+
+
+class Block(nn.Layer):
+    """Hand-written forward: residual MLP (not a Sequential)."""
+
+    def __init__(self, d=8):
+        super().__init__()
+        self.fc1 = nn.Linear(d, 2 * d)
+        self.fc2 = nn.Linear(2 * d, d)
+        self.act = nn.GELU()
+
+    def forward(self, x):
+        h = self.fc2(self.act(self.fc1(x)))
+        return x + h
+
+
+def _x(n=4, d=8, seed=0):
+    return paddle.to_tensor(
+        np.random.RandomState(seed).rand(n, d).astype(np.float32))
+
+
+def test_custom_forward_segments_and_matches():
+    paddle.seed(0)
+    blk = Block()
+    x = _x()
+    out_seg = blk(x)
+    assert "_seg_cache" in blk.__dict__ and blk._seg_cache[1]
+    LC.SEGMENT_FORWARD = False
+    out_ref = blk(x)
+    np.testing.assert_allclose(np.asarray(out_seg._data),
+                               np.asarray(out_ref._data), rtol=1e-6)
+
+
+def test_grads_flow_through_custom_segment():
+    paddle.seed(1)
+    blk = Block()
+    x = _x(seed=2)
+    x.stop_gradient = False
+    blk(x).sum().backward()
+    for p in blk.parameters():
+        assert p.grad is not None, p.name
+    assert x.grad is not None
+
+
+def test_weight_reassignment_invalidates_general():
+    paddle.seed(2)
+    blk = Block()
+    x = _x(seed=3)
+    out1 = np.asarray(blk(x)._data)
+    w = np.asarray(blk.fc2.weight._data)
+    new_w = paddle.to_tensor(np.zeros_like(w))
+    new_w.stop_gradient = False
+    blk.fc2.weight = new_w
+    out2 = np.asarray(blk(x)._data)
+    assert not np.allclose(out1, out2)
+
+
+def test_hook_registration_disables_segment():
+    paddle.seed(3)
+    blk = Block()
+    x = _x(seed=4)
+    blk(x)
+    fired = []
+    blk.fc1.register_forward_post_hook(
+        lambda layer, inp, out: fired.append(1) or None)
+    blk(x)
+    assert fired, "post-hook must fire after registration"
+
+
+def test_train_eval_flip_invalidates():
+    class DropBlock(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 8)
+            self.drop = nn.Dropout(0.9)
+
+        def forward(self, x):
+            return self.drop(self.fc(x))
+
+    paddle.seed(4)
+    blk = DropBlock()
+    blk.eval()                      # dropout identity: pure, segments
+    x = _x(seed=5)
+    out_eval = blk(x)
+    assert blk._seg_cache[1]
+    blk.train()                     # RNG now fires: probe -> impure
+    out_train = blk(x)
+    assert blk._seg_cache[1] is False
+    assert not np.allclose(np.asarray(out_eval._data),
+                           np.asarray(out_train._data))
+    # per-op dropout still draws fresh masks per call
+    out_train2 = blk(x)
+    assert not np.allclose(np.asarray(out_train._data),
+                           np.asarray(out_train2._data))
+
+
+def test_buffered_layer_falls_back():
+    class BNBlock(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 8)
+            self.bn = nn.BatchNorm1D(8)
+
+        def forward(self, x):
+            return self.bn(self.fc(x))
+
+    paddle.seed(5)
+    blk = BNBlock()
+    x = _x(seed=6)
+    m0 = np.asarray(blk.bn._mean._data).copy()
+    blk(x)
+    assert "_seg_cache" not in blk.__dict__   # gate: buffers present
+    assert not np.allclose(np.asarray(blk.bn._mean._data), m0)
+
+
+def test_untraceable_forward_falls_back():
+    class HostBlock(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 8)
+
+        def forward(self, x):
+            y = self.fc(x)
+            if float(y.sum().numpy()) > -1e9:   # host read: untraceable
+                return y * 2.0
+            return y
+
+    paddle.seed(6)
+    blk = HostBlock()
+    x = _x(seed=7)
+    out = blk(x)
+    assert blk._seg_cache[1] is False
+    LC.SEGMENT_FORWARD = False
+    np.testing.assert_allclose(np.asarray(out._data),
+                               np.asarray(blk(x)._data), rtol=1e-6)
+
+
+def test_transformer_encoder_block_segments():
+    """The VERDICT's named target: a BERT-style encoder block with a
+    hand-written forward segments (eval mode: dropouts identity)."""
+    paddle.seed(7)
+    enc = nn.TransformerEncoderLayer(d_model=16, nhead=4,
+                                     dim_feedforward=32)
+    enc.eval()
+    x = paddle.to_tensor(
+        np.random.RandomState(8).rand(2, 5, 16).astype(np.float32))
+    out = enc(x)
+    if "_seg_cache" in enc.__dict__:
+        assert out.shape == [2, 5, 16]
+        LC.SEGMENT_FORWARD = False
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   np.asarray(enc(x)._data), rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_train_eval_flip_reuses_traces():
+    """Alternating fingerprints (train/eval per epoch) must reuse their
+    cached segment, not mint a new name + recompile per flip."""
+    paddle.seed(9)
+    blk = Block()
+    x = _x(seed=10)
+    blk.eval()
+    blk(x)
+    name_eval = blk._seg_cache[2]
+    blk.train()
+    blk(x)
+    name_train = blk._seg_cache[2]
+    blk.eval()
+    blk(x)
+    assert blk._seg_cache[2] == name_eval
+    blk.train()
+    blk(x)
+    assert blk._seg_cache[2] == name_train
+
+
+def test_dispatch_count_drops():
+    """The point of the whole exercise: one dispatch, not one per op."""
+    from paddle_tpu.ops import registry as R
+    paddle.seed(8)
+    blk = Block()
+    x = _x(seed=9)
+    blk(x)                          # build the trace
+    calls = []
+    orig = R._dispatch
+
+    def counting(opname, *a, **k):
+        calls.append(opname)
+        return orig(opname, *a, **k)
+
+    R._dispatch = counting
+    try:
+        blk(x)
+    finally:
+        R._dispatch = orig
+    assert len(calls) == 1 and calls[0].startswith("segment_"), calls
