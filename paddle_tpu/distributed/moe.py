@@ -106,73 +106,76 @@ def _capacity(s, top_k, capacity_factor, e, capacity):
 # jit-safe.
 
 @jax.custom_vjp
-def _gather_dispatch(x, ft_slot, svalid, dest, keep, inv):
+def _gather_dispatch(x, ft_slot, svalid, dest, keep):
     """Token rows [S, M] -> expert buffer [E*C, M].
 
-    ft_slot[slot] = token index feeding that slot (composed through the
-    sorted order), svalid[slot] = slot actually filled; dest[entry] =
-    slot fed by sorted entry (clipped), keep[entry] = entry in capacity,
-    inv[flat k-major entry] = its sorted position."""
+    ft_slot[slot] = token index feeding that slot, svalid[slot] = slot
+    actually filled; dest[k-major entry] = slot fed by that entry
+    (dump slot when dropped), keep[entry] = entry in capacity."""
     return jnp.where(svalid[:, None], x[ft_slot], 0)
 
 
-def _gather_dispatch_fwd(x, ft_slot, svalid, dest, keep, inv):
-    out = _gather_dispatch(x, ft_slot, svalid, dest, keep, inv)
+def _gather_dispatch_fwd(x, ft_slot, svalid, dest, keep):
+    out = _gather_dispatch(x, ft_slot, svalid, dest, keep)
     # zero-width carrier keeps x's shape/dtype in the residuals as a
     # jax type (saving x itself would pin the whole activation)
     xref = jnp.zeros((x.shape[0], 0), x.dtype)
-    return out, (xref, dest, keep, inv)
+    return out, (xref, dest, keep)
 
 
 def _gather_dispatch_bwd(res, dbuf):
-    xref, dest, keep, inv = res
+    xref, dest, keep = res
     s = xref.shape[0]
     m = dbuf.shape[-1]
-    k = inv.shape[0] // s
-    dent = dbuf[dest] * keep[:, None].astype(dbuf.dtype)  # [N, M] gather
-    dx = jnp.sum(dent[inv].reshape(k, s, m), axis=0)      # inverse gather
-    return (dx.astype(xref.dtype), None, None, None, None, None)
+    k = dest.shape[0] // s
+    # dest is k-major entry order, so the reshape IS the per-round split
+    dent = dbuf[jnp.minimum(dest, dbuf.shape[0] - 1)] \
+        * keep[:, None].astype(dbuf.dtype)                # [N, M] gather
+    dx = jnp.sum(dent.reshape(k, s, m), axis=0)
+    return (dx.astype(xref.dtype), None, None, None, None)
 
 
 _gather_dispatch.defvjp(_gather_dispatch_fwd, _gather_dispatch_bwd)
 
 
 @jax.custom_vjp
-def _gather_combine(flat, gv_s, ft_s, ft_slot, svalid, sidx, dest, keep,
-                    inv, sref):
+def _gather_combine(flat, gvf, ft, ft_slot, gv_slot, svalid, dest, keep,
+                    sref):
     """Expert rows [E*C, M] * gate values -> token rows [S, M].
     sref is a [S] int8 shape-carrier so S stays static under tracing."""
     m = flat.shape[-1]
     s = sref.shape[0]
-    k = inv.shape[0] // s
-    back = flat[dest] * (gv_s * keep.astype(gv_s.dtype))[:, None]
-    return jnp.sum(back[inv].reshape(k, s, m), axis=0)
+    k = dest.shape[0] // s
+    back = flat[jnp.minimum(dest, flat.shape[0] - 1)] \
+        * (gvf * keep.astype(gvf.dtype))[:, None]
+    return jnp.sum(back.reshape(k, s, m), axis=0)
 
 
-def _gather_combine_fwd(flat, gv_s, ft_s, ft_slot, svalid, sidx, dest,
-                        keep, inv, sref):
-    out = _gather_combine(flat, gv_s, ft_s, ft_slot, svalid, sidx, dest,
-                          keep, inv, sref)
-    return out, (flat, gv_s, ft_s, ft_slot, svalid, sidx, dest, keep)
+def _gather_combine_fwd(flat, gvf, ft, ft_slot, gv_slot, svalid, dest,
+                        keep, sref):
+    out = _gather_combine(flat, gvf, ft, ft_slot, gv_slot, svalid, dest,
+                          keep, sref)
+    return out, (flat, gvf, ft, ft_slot, gv_slot, svalid, dest, keep)
 
 
 def _gather_combine_bwd(res, dy):
-    flat, gv_s, ft_s, ft_slot, svalid, sidx, dest, keep = res
-    # slot gets its gradient from the unique sorted entry that fills it
+    flat, gvf, ft, ft_slot, gv_slot, svalid, dest, keep = res
+    # slot gets its gradient from the unique entry that fills it
     dflat = jnp.where(svalid[:, None],
-                      gv_s[sidx, None] * dy[ft_slot].astype(flat.dtype), 0)
+                      gv_slot[:, None] * dy[ft_slot].astype(flat.dtype),
+                      0)
     # gate-value grad: <expert row, token cotangent> per entry
-    dgv = keep.astype(gv_s.dtype) * jnp.sum(
-        flat[dest].astype(jnp.float32)
-        * dy[ft_s].astype(jnp.float32), axis=-1).astype(gv_s.dtype)
-    return (dflat, dgv, None, None, None, None, None, None, None, None)
+    dgv = keep.astype(gvf.dtype) * jnp.sum(
+        flat[jnp.minimum(dest, flat.shape[0] - 1)].astype(jnp.float32)
+        * dy[ft].astype(jnp.float32), axis=-1).astype(gvf.dtype)
+    return (dflat, dgv, None, None, None, None, None, None, None)
 
 
 _gather_combine.defvjp(_gather_combine_fwd, _gather_combine_bwd)
 
 
 def sort_dispatch_combine(x, idx, gv, e, capacity, ffn):
-    """Sort-based dispatch/combine (reference global_scatter/
+    """Counting-sort dispatch/combine (reference global_scatter/
     global_gather, paddle/fluid/operators/collective/global_scatter_op.cc
     — without the dense [S, E, C] one-hot the GShard formulation
     materializes).
@@ -182,42 +185,47 @@ def sort_dispatch_combine(x, idx, gv, e, capacity, ffn):
     the reference's round-by-round position accounting); ffn maps
     [E, C, M] -> [E, C, M].  Returns y [S, M].
 
-    TPU formulation: after a stable sort by expert id, each expert's
-    in-capacity entries are a CONTIGUOUS run of the sorted order, so the
-    expert buffer is a plain gather rows_sorted[starts[e] + c] — and the
-    custom VJPs keep the backward pure gathers too.  Static shapes
-    throughout; overflow tokens contribute zero (SURVEY §7 hard part (c)).
+    TPU formulation: the expert alphabet is tiny, so the dispatch
+    permutation comes from a COUNTING sort — a one-hot cumsum gives each
+    entry its rank within its expert and one small int scatter inverts
+    slot -> entry.  (The previous formulation's two [S*K] argsorts cost
+    ~0.85 ms/layer on v5e — 20x this whole front-end — and forced an
+    extra inverse-permutation gather in both directions.)  Dispatch,
+    combine, and both backward paths are pure gathers; static shapes
+    throughout; overflow tokens contribute zero (SURVEY §7 hard
+    part (c)).
     """
     s, m = x.shape
     k = idx.shape[1]
     n = s * k
     fe = idx.T.reshape(n)                  # k-major: round 0 first
     ft = jnp.tile(jnp.arange(s, dtype=jnp.int32), k)
-    gvf = gv.T.reshape(n)
-    order = jnp.argsort(fe, stable=True)   # preserves (round, token) order
-    fe_s = fe[order]
-    ft_s = ft[order]
-    gv_s = gvf[order].astype(x.dtype)
-    counts = jnp.zeros((e,), jnp.int32).at[fe].add(1)
-    starts = jnp.cumsum(counts) - counts   # exclusive prefix
-    pos = jnp.arange(n, dtype=jnp.int32) - starts[fe_s]  # rank in expert
+    gvf = gv.T.reshape(n).astype(x.dtype)
+
+    onehot = jax.nn.one_hot(fe, e, dtype=jnp.int32)          # [N, E]
+    pos = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=1) - 1
     keep = pos < capacity
-    dest = jnp.where(keep, fe_s * capacity + pos, 0)     # clipped slot
-    inv = jnp.argsort(order)               # flat entry -> sorted position
+    # dump slot e*capacity catches dropped entries; sliced off below
+    dest = jnp.where(keep, fe * capacity + pos, e * capacity)
 
-    # slot -> sorted entry: in-capacity entries of expert e are sorted
-    # positions [starts[e], starts[e] + min(count_e, C))
-    slots = jnp.arange(e * capacity, dtype=jnp.int32)
-    se, sc = slots // capacity, slots % capacity
-    svalid = sc < jnp.minimum(counts, capacity)[se]
-    sidx = jnp.clip(starts[se] + sc, 0, n - 1)
-    ft_slot = ft_s[sidx]
+    # slot -> entry: each kept entry owns a unique slot, so one int
+    # scatter inverts the map.  The dump slot e*capacity is IN range of
+    # the +1-sized target (dropped entries legitimately land there,
+    # last-writer-wins); the [:e*capacity] slice — not mode="drop" —
+    # is what discards it.
+    entry_of_slot = jnp.full((e * capacity + 1,), n, jnp.int32) \
+        .at[dest].set(jnp.arange(n, dtype=jnp.int32),
+                      mode="drop")[:e * capacity]
+    svalid = entry_of_slot < n
+    eos = jnp.minimum(entry_of_slot, n - 1)
+    ft_slot = ft[eos]
+    gv_slot = jnp.where(svalid, gvf[eos], 0)
 
-    expert_in = _gather_dispatch(x, ft_slot, svalid, dest, keep, inv)
+    expert_in = _gather_dispatch(x, ft_slot, svalid, dest, keep)
     expert_out = ffn(expert_in.reshape(e, capacity, m))
     flat = expert_out.reshape(e * capacity, m)
-    return _gather_combine(flat, gv_s, ft_s, ft_slot, svalid, sidx, dest,
-                           keep, inv, jnp.zeros((s,), jnp.int8))
+    return _gather_combine(flat, gvf, ft, ft_slot, gv_slot, svalid, dest,
+                           keep, jnp.zeros((s,), jnp.int8))
 
 
 def moe_dispatch_combine(x, gate_w, w1, b1, w2, b2, *, top_k=2,

@@ -215,13 +215,26 @@ def loss_fn(params, ids, config: MoEConfig, mesh: Mesh):
         x, NamedSharding(mesh, P("dp", None, None)))
     cos, sin = _rope_tables(s, config.head_dim, config.rope_theta)
 
-    def body(carry, lp):
-        h, aux = carry
-        h, a = _layer(lp, h, cos, sin, config, mesh)
-        return (h, aux + a), None
+    # UNROLLED layer loop for shallow stacks: lax.scan over stacked
+    # weights cost ~2 ms/layer on v5e (stacked-xs slicing + dxs
+    # accumulation in the backward) — the same-session A/B measured
+    # 86.5 ms (scan) vs 71.0 ms (unrolled) for the 8-layer bench config.
+    # Deep configs (Qwen2-MoE/DeepSeekMoE at 28 layers) keep the scan:
+    # there the unrolled fwd+bwd HLO's compile time dominates.
+    if config.num_hidden_layers <= 16:
+        aux_total = jnp.float32(0.0)
+        for i in range(config.num_hidden_layers):
+            lp = jax.tree_util.tree_map(lambda a: a[i], params["layers"])
+            x, a = _layer(lp, x, cos, sin, config, mesh)
+            aux_total = aux_total + a
+    else:
+        def body(carry, lp):
+            h, aux = carry
+            h, a = _layer(lp, h, cos, sin, config, mesh)
+            return (h, aux + a), None
 
-    (x, aux_total), _ = jax.lax.scan(body, (x, jnp.float32(0.0)),
-                                     params["layers"])
+        (x, aux_total), _ = jax.lax.scan(body, (x, jnp.float32(0.0)),
+                                         params["layers"])
     h = _rms(x, params["norm"], config.rms_norm_eps)
     # chunked CE: never materialize the [B,S,V] fp32 logits
     ce = _chunked_ce_sum(h, lab, params["head"]) / (b * s)

@@ -230,6 +230,14 @@ def _pad_seq(x, target):
     return jnp.pad(x, ((0, 0), (0, target - s), (0, 0), (0, 0)))
 
 
+# below this max-seq, plain unmasked sdpa routes to XLA's fused
+# attention instead of the flash kernel.  Default OFF: the isolated
+# S=512 microbench favors XLA 2.4x, but the end-to-end MoE-step A/B
+# (same session, route toggled) measured the XLA path 13 ms SLOWER in
+# the full scanned program — only an in-context A/B decides this knob.
+_SHORT_SEQ_XLA = 0
+
+
 def sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
          training=True, flashmask=None):
     """Paddle-layout scaled-dot-product attention: [B, S, H, D] in/out.
@@ -266,6 +274,18 @@ def sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
         elif (jnp.issubdtype(am.dtype, jnp.floating) and am.ndim == 4
                 and am.shape[-2:] == (q.shape[1], k.shape[1])):
             bias = am
+
+    # short-sequence route: below ~1024 the flash grid is too small to
+    # pipeline and XLA's fused attention wins (measured on v5e, hd=128:
+    # S=512 f+b 0.87 ms vs 2.14 ms pallas; pallas wins 2-5x from 1024 up)
+    if (shapes_ok and attn_mask is None and mask_vecs is None
+            and max(q.shape[1], k.shape[1]) < _SHORT_SEQ_XLA
+            and q.shape[2] % k.shape[2] == 0):
+        try:
+            return jax.nn.dot_product_attention(q, k, v,
+                                                is_causal=is_causal)
+        except Exception:
+            pass
 
     long_seq = max(q.shape[1], k.shape[1]) > _STREAM_SEQ
     if shapes_ok and (attn_mask is None or mask_vecs is not None

@@ -105,6 +105,99 @@ def _cases():
                                          jnp.asarray(seg_ids),
                                          num_segments=4), [x],
            [P("dp", None, None)])
+    # ---- round-5 growth toward the reference's 136-file suite
+    # (test/auto_parallel/): pad/roll/broadcast/norm family/strided
+    # slice/embedding-grad/MoE dispatch under dp x ep ------------------
+    yield ("pad_sharded_batch",
+           lambda a: jnp.pad(a, ((0, 0), (2, 3), (1, 1))), [x],
+           [P("dp", None, None)])
+    yield ("pad_on_THE_sharded_axis",
+           lambda a: jnp.pad(a, ((2, 2), (0, 0), (0, 0))), [x],
+           [P("dp", None, None)])
+    yield ("roll_sharded_axis",
+           lambda a: jnp.roll(a, 3, axis=0), [x], [P("dp", None, None)])
+    yield ("roll_unsharded_axis",
+           lambda a: jnp.roll(a, 5, axis=-1), [x],
+           [P("dp", None, "tp")])
+    bias_row = rng.standard_normal((1, 1, h)).astype(np.float32)
+    yield ("where_with_broadcast",
+           lambda a, c: jnp.where(a > 0, a + c, c - a), [x, bias_row],
+           [P("dp", None, "tp"), P()])
+    yield ("strided_slice_sharded",
+           lambda a: a[::2, 1:-1:3, ::4], [x], [P("dp", None, None)])
+    yield ("flip_sharded",
+           lambda a: jnp.flip(a, axis=1), [x], [P("dp", None, "tp")])
+
+    # embedding GRAD under dp (the RowSparse path): d/dE of a take
+    def emb_grad(e, i):
+        return jax.grad(
+            lambda ee: jnp.take(ee, i, axis=0).astype(jnp.float32).sum()
+            * 1e-3)(e)
+    yield ("embedding_grad_dp_rows", emb_grad, [emb, ids],
+           [P(None, None), P("dp", None)])
+    yield ("embedding_grad_vocab_sharded", emb_grad, [emb, ids],
+           [P("tp", None), P("dp", None)])
+
+    # normalization family on the sharded batch axis
+    def batch_norm_train(a, gg):
+        mu = a.mean(axis=(0, 1), keepdims=True)
+        var = a.var(axis=(0, 1), keepdims=True)
+        return (a - mu) * jax.lax.rsqrt(var + 1e-5) * gg
+    yield ("batch_norm_stats_over_dp", batch_norm_train, [x, g],
+           [P("dp", None, None), P()])
+
+    def group_norm(a, gg):
+        grp = a.reshape(b, s, 4, h // 4)
+        mu = grp.mean(axis=(1, 3), keepdims=True)
+        var = grp.var(axis=(1, 3), keepdims=True)
+        return ((grp - mu) * jax.lax.rsqrt(var + 1e-5)) \
+            .reshape(b, s, h) * gg
+    yield ("group_norm_dp_batch", group_norm, [x, g],
+           [P("dp", None, None), P()])
+
+    def rms_norm(a, gg):
+        return a * jax.lax.rsqrt(
+            (a * a).mean(-1, keepdims=True) + 1e-6) * gg
+    yield ("rms_norm_tp_hidden", rms_norm, [x, g],
+           [P("dp", None, "tp"), P()])
+
+    # MoE dispatch/combine under a dp x ep mesh (the moe_gate_dispatch
+    # spmd-rule analog): tokens dp-sharded, expert weights ep-sharded
+    def moe_block(a, w1e, w2e):
+        from paddle_tpu.distributed.moe import (_topk_choices,
+                                                sort_dispatch_combine)
+        flat = a.reshape(b * s, h)
+        logits = (flat @ w1e[:, :, 0].T).astype(jnp.float32)[:, :4]
+
+        def ffn(buf):
+            hmid = jnp.einsum("ecm,emf->ecf", buf, w1e)
+            return jnp.einsum("ecf,efm->ecm", jax.nn.silu(hmid), w2e)
+
+        idx, gv, _aux = _topk_choices(logits, 2, False, None)
+        y = sort_dispatch_combine(flat, idx, gv, 4, b * s, ffn)
+        return y.reshape(b, s, h)
+    w1e = (rng.standard_normal((4, h, 32)) * 0.1).astype(np.float32)
+    w2e = (rng.standard_normal((4, 32, h)) * 0.1).astype(np.float32)
+    yield ("moe_dispatch_dp_ep", moe_block, [x, w1e, w2e],
+           [P("dp", None, None), P("tp", None, None),
+            P("tp", None, None)])
+
+    # gather with batch-major indices (paged-attention table pattern)
+    tbl = rng.integers(0, 16, (b, 4))
+    pool = rng.standard_normal((16, h)).astype(np.float32)
+    yield ("gather_block_table",
+           lambda p_: p_[jnp.asarray(tbl)], [pool], [P()])
+    yield ("dynamic_slice_sharded",
+           lambda a: jax.lax.dynamic_slice(a, (2, 0, 0), (4, s, h)), [x],
+           [P("dp", None, None)])
+    yield ("transpose_cross_shard",
+           lambda a: jnp.swapaxes(a, 0, 2), [x], [P("dp", None, "tp")])
+    yield ("broadcast_outer_product",
+           lambda a, gg: a[..., None] * gg[None, None, None, :], [x, g],
+           [P("dp", None, None), P()])
+    yield ("stack_resharded",
+           lambda a: jnp.stack([a, 2.0 * a], axis=1), [x],
+           [P("dp", None, "tp")])
 
 
 @pytest.mark.parametrize("name,fn,arrs,specs",
