@@ -123,6 +123,19 @@ PD_Predictor* PD_PredictorCreate(PD_Config* c) {
   return p;
 }
 
+PD_Predictor* PD_PredictorClone(PD_Predictor* p) {
+  // reference Predictor::Clone: share weights/executables, private IO
+  Gil gil;
+  PyObject* cl = PyObject_CallMethod(p->pred, "clone", nullptr);
+  if (!cl) {
+    PyErr_Print();
+    return nullptr;
+  }
+  PD_Predictor* q = new PD_Predictor();
+  q->pred = cl;
+  return q;
+}
+
 static size_t name_list_size(PyObject* pred, const char* method) {
   PyObject* names = PyObject_CallMethod(pred, method, nullptr);
   if (!names) {
@@ -304,8 +317,7 @@ static PyObject* fetch_output(PD_Tensor* t, const char* npdtype) {
   return cast;
 }
 
-int PD_TensorGetShape(PD_Tensor* t, size_t* ndims, int32_t* dims) {
-  Gil gil;
+static PyObject* tensor_shape_seq(PD_Tensor* t) {
   // handle.shape() reads the stored array's dims — no data copy/cast
   const char* getter =
       t->is_input ? "get_input_handle" : "get_output_handle";
@@ -313,20 +325,33 @@ int PD_TensorGetShape(PD_Tensor* t, size_t* ndims, int32_t* dims) {
       PyObject_CallMethod(t->pred, getter, "s", t->name.c_str());
   if (!handle) {
     PyErr_Print();
-    return 0;
+    return nullptr;
   }
   PyObject* shape = PyObject_CallMethod(handle, "shape", nullptr);
   Py_DECREF(handle);
   if (!shape) {
     PyErr_Print();
-    return 0;
+    return nullptr;
   }
   PyObject* seq = PySequence_Fast(shape, "shape not a sequence");
   Py_DECREF(shape);
-  if (!seq) {
-    PyErr_Print();
-    return 0;
-  }
+  if (!seq) PyErr_Print();
+  return seq;
+}
+
+int PD_TensorGetRank(PD_Tensor* t, size_t* ndims) {
+  Gil gil;
+  PyObject* seq = tensor_shape_seq(t);
+  if (!seq) return 0;
+  *ndims = (size_t)PySequence_Fast_GET_SIZE(seq);
+  Py_DECREF(seq);
+  return 1;
+}
+
+int PD_TensorGetShape(PD_Tensor* t, size_t* ndims, int32_t* dims) {
+  Gil gil;
+  PyObject* seq = tensor_shape_seq(t);
+  if (!seq) return 0;
   *ndims = (size_t)PySequence_Fast_GET_SIZE(seq);
   for (size_t i = 0; i < *ndims; ++i)
     dims[i] = (int32_t)PyLong_AsLong(

@@ -41,6 +41,8 @@ const char* PD_PredictorGetOutputName(PD_Predictor*, size_t i);
 PD_Tensor* PD_PredictorGetInputHandle(PD_Predictor*, const char* name);
 PD_Tensor* PD_PredictorGetOutputHandle(PD_Predictor*, const char* name);
 int PD_PredictorRun(PD_Predictor*);                /* 1 = ok */
+/* weight-sharing clone (reference Predictor::Clone); NULL on failure */
+PD_Predictor* PD_PredictorClone(PD_Predictor*);
 void PD_PredictorDestroy(PD_Predictor*);
 
 /* tensors */
@@ -48,6 +50,9 @@ void PD_TensorReshape(PD_Tensor*, size_t ndims, const int32_t* dims);
 int PD_TensorCopyFromCpuFloat(PD_Tensor*, const float* data);
 int PD_TensorCopyFromCpuInt64(PD_Tensor*, const int64_t* data);
 int PD_TensorCopyFromCpuInt32(PD_Tensor*, const int32_t* data);
+/* two-phase shape query (reference PD_OneDimArrayInt32 pattern):
+   GetRank first, then GetShape with a dims buffer of that capacity */
+int PD_TensorGetRank(PD_Tensor*, size_t* ndims);   /* 1 = ok */
 int PD_TensorGetShape(PD_Tensor*, size_t* ndims, int32_t* dims);
 int PD_TensorCopyToCpuFloat(PD_Tensor*, float* out);
 int PD_TensorCopyToCpuInt64(PD_Tensor*, int64_t* out);

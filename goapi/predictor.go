@@ -81,6 +81,17 @@ func (pred *Predictor) Run() error {
 	return nil
 }
 
+// Clone creates a predictor sharing the loaded weights and compiled
+// executables with this one; only the I/O buffers are private
+// (reference goapi predictor.go Clone).
+func (pred *Predictor) Clone() (*Predictor, error) {
+	p := C.PD_PredictorClone(pred.p)
+	if p == nil {
+		return nil, fmt.Errorf("paddle: PD_PredictorClone failed")
+	}
+	return &Predictor{p: p}, nil
+}
+
 // Destroy releases the predictor (tensor handles stay valid).
 func (pred *Predictor) Destroy() {
 	if pred.p != nil {

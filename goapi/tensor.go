@@ -24,12 +24,19 @@ func (t *Tensor) Reshape(shape []int32) {
 		(*C.int32_t)(unsafe.Pointer(&shape[0])))
 }
 
-// Shape returns the current tensor shape.
+// Shape returns the current tensor shape.  Two-phase query (rank
+// first) so any rank is safe — the C side writes ndims entries
+// unconditionally into the buffer we size here.
 func (t *Tensor) Shape() []int32 {
 	var n C.size_t
-	dims := make([]int32, 16)
-	C.PD_TensorGetShape(t.t, &n,
-		(*C.int32_t)(unsafe.Pointer(&dims[0])))
+	if C.PD_TensorGetRank(t.t, &n) != 1 || n == 0 {
+		return nil
+	}
+	dims := make([]int32, int(n))
+	if C.PD_TensorGetShape(t.t, &n,
+		(*C.int32_t)(unsafe.Pointer(&dims[0]))) != 1 {
+		return nil
+	}
 	return dims[:int(n)]
 }
 

@@ -21,7 +21,15 @@ worker_index = fleet.worker_index
 worker_num = fleet.worker_num
 is_first_worker = fleet.is_first_worker
 
-__all__ = ["fleet", "init", "DistributedStrategy", "ParallelMode",
+from .role_maker import (Role, PaddleCloudRoleMaker,  # noqa: F401
+                         UserDefinedRoleMaker, UtilBase, DataGenerator,
+                         MultiSlotDataGenerator,
+                         MultiSlotStringDataGenerator)
+
+__all__ = ["Role", "PaddleCloudRoleMaker", "UserDefinedRoleMaker",
+           "UtilBase", "DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator",
+           "fleet", "init", "DistributedStrategy", "ParallelMode",
            "CommunicateTopology", "HybridCommunicateGroup",
            "VocabParallelEmbedding", "ColumnParallelLinear",
            "RowParallelLinear", "ParallelCrossEntropy", "meta_parallel",

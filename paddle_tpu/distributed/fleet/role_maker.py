@@ -1,0 +1,192 @@
+"""PS-era role makers + data generators (compat surface).
+
+Reference: python/paddle/distributed/fleet/base/role_maker.py:395
+(PaddleCloudRoleMaker reads the PADDLE_* cluster env the launcher
+exports; UserDefinedRoleMaker takes an explicit server/worker layout)
+and data_generator/data_generator.py (line-protocol generators feeding
+the PS InMemoryDataset).  TPU formulation: roles map onto the jax
+distributed process grid (distributed/launcher rendezvous) and the PS
+tables live in distributed/ps.py; these classes keep the reference API
+so recommendation-stack scripts run.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+__all__ = ["Role", "PaddleCloudRoleMaker", "UserDefinedRoleMaker",
+           "DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator", "UtilBase"]
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class PaddleCloudRoleMaker:
+    """reference role_maker.py:395 — role/rank/size from the launcher's
+    PADDLE_* environment (our launcher exports the same names)."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        self._is_collective = is_collective
+        self._kwargs = kwargs
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._size = len(eps.split(",")) if eps else int(
+            os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self._servers = [s for s in os.environ.get(
+            "PADDLE_PSERVERS_IP_PORT_LIST", "").split(",") if s]
+        self._role = (Role.SERVER
+                      if os.environ.get("TRAINING_ROLE", "TRAINER")
+                      .upper() == "PSERVER" else Role.WORKER)
+
+    def _is_worker(self):
+        return self._role == Role.WORKER
+
+    def _is_server(self):
+        return self._role == Role.SERVER
+
+    def _worker_index(self):
+        return self._rank
+
+    def _worker_num(self):
+        return self._size
+
+    def _server_num(self):
+        return len(self._servers)
+
+    def _get_pserver_endpoints(self):
+        return list(self._servers)
+
+    def _role_id(self):
+        return self._rank
+
+    def _node_num(self):
+        return max(1, self._size)
+
+    def to_string(self):
+        return (f"role={self._role} rank={self._rank} "
+                f"workers={self._size} servers={len(self._servers)}")
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """reference role_maker.py UserDefinedRoleMaker: explicit layout."""
+
+    def __init__(self, is_collective=False, init_gloo=False, **kwargs):
+        super().__init__(is_collective=is_collective)
+        self._kwargs = kwargs
+        self._rank = int(kwargs.get("current_id", 0))
+        self._role = kwargs.get("role", Role.WORKER)
+        self._size = int(kwargs.get("worker_num", 1))
+        self._servers = list(kwargs.get("server_endpoints", []))
+
+
+class UtilBase:
+    """reference fleet/base/util_factory.py surface: small collective
+    helpers over the active communication group."""
+
+    def __init__(self, role_maker=None):
+        self._role_maker = role_maker
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+        from .. import collective
+
+        try:
+            import paddle_tpu as paddle
+            t = paddle.to_tensor(np.asarray(input))
+            collective.all_reduce(t)
+            return np.asarray(t.numpy())
+        except Exception:
+            return np.asarray(input)
+
+    def barrier(self, comm_world="worker"):
+        from .. import collective
+        try:
+            collective.barrier()
+        except Exception:
+            pass
+
+    def get_file_shard(self, files):
+        rm = self._role_maker or PaddleCloudRoleMaker()
+        n, i = rm._worker_num(), rm._worker_index()
+        return files[i::n]
+
+    def print_on_rank(self, message, rank_id=0):
+        rm = self._role_maker or PaddleCloudRoleMaker()
+        if rm._worker_index() == rank_id:
+            print(message)
+
+
+class DataGenerator:
+    """reference data_generator.py:25 — subclasses implement
+    generate_sample(line) yielding (slot_name, values) pairs; run_from_
+    stdin/memory emit the PS line protocol."""
+
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "implement generate_sample(line) -> iterator of "
+            "[(slot_name, values), ...]")
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for s in samples:
+                yield s
+        return local_iter
+
+    def _gen_str(self, line):
+        raise NotImplementedError
+
+    def run_from_stdin(self):
+        for line in sys.stdin:
+            gen = self.generate_sample(line)
+            if gen is None:
+                continue
+            for record in gen():
+                sys.stdout.write(self._gen_str(record))
+
+    def run_from_memory(self):
+        out = []
+        batch = []
+        for sample in self.generate_sample(None)():
+            batch.append(sample)
+            if len(batch) == self.batch_size_:
+                for r in self.generate_batch(batch)():
+                    out.append(self._gen_str(r))
+                batch = []
+        if batch:
+            for r in self.generate_batch(batch)():
+                out.append(self._gen_str(r))
+        for s in out:
+            sys.stdout.write(s)
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """reference data_generator.py:285: 'slot:n v0 ... vn-1 ...' lines."""
+
+    def _gen_str(self, line):
+        parts = []
+        for name, values in line:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    def _gen_str(self, line):
+        parts = []
+        for name, values in line:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts) + "\n"

@@ -8,6 +8,13 @@ autograd slot is a tape GradNode, and device/layout/distribution all live in
 the underlying jax.Array's sharding.  Arrays are immutable; "in-place" APIs
 rebind the handle, which is semantically equivalent for a single-threaded
 dygraph program and keeps the functional core jit-compatible.
+
+Aliasing policy (documented divergence — README "Compatibility policy"):
+reference Paddle's reshape/view/slice results alias their base, so later
+in-place mutation of the base shows through the view.  Here views are
+value snapshots: after ``b = a.reshape(...)``, ``a[0] = 7`` rebinds ``a``
+and ``b`` keeps the old values.  Re-derive views after mutating the base
+when porting code that relies on write-through aliasing.
 """
 from __future__ import annotations
 

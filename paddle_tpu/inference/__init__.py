@@ -144,6 +144,21 @@ class Predictor:
             self._outputs[v.name] = o
         return outs if inputs is not None else None
 
+    def clone(self):
+        """reference Predictor::Clone (goapi predictor.go Clone): a new
+        predictor sharing the loaded weights and compiled executables —
+        only the I/O buffers are private, so clones are safe to use
+        from different request contexts."""
+        p = object.__new__(Predictor)
+        p.config = self.config
+        p._program = self._program
+        p._feed_names = list(self._feed_names)
+        p._fetch_vars = self._fetch_vars
+        p._exe = self._exe
+        p._inputs = {}
+        p._outputs = {}
+        return p
+
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)

@@ -90,9 +90,13 @@ def quantize_state(state, algo="weight_only_int8"):
     per-output-channel quantization — bit-identical to separate, since
     the scale is per column) so the decode loop issues one GEMV kernel
     where it issued three: at B=8 decode shapes the launch count, not
-    the flops, is the cost.  The reference analog is converting a
-    deploy model through weight_quantize before serving
-    (python/paddle/nn/quant)."""
+    the flops, is the cost.  Contract: the per-projection q/k/v and
+    gate/up keys are ALSO quantized individually, so every matmul key
+    in the returned dict is a QuantizedWeight — consumers reading the
+    per-projection keys directly (instead of the *_fused entries the
+    decode loop prefers) still get the quantized path.  The reference
+    analog is converting a deploy model through weight_quantize before
+    serving (python/paddle/nn/quant)."""
     from ..nn.quant import weight_quantize
     from ..ops.pallas.quant_matmul import QuantizedWeight
 
@@ -103,7 +107,6 @@ def quantize_state(state, algo="weight_only_int8"):
         return QuantizedWeight(q, scale, kind=kind, k=arr.shape[0])
 
     out = dict(state)
-    fused = set()
     for name in state:
         p, _, leaf = name.rpartition(".self_attn.q_proj.weight")
         if leaf == "" and p:
@@ -112,18 +115,17 @@ def quantize_state(state, algo="weight_only_int8"):
                 [state[pre + "q_proj.weight"],
                  state[pre + "k_proj.weight"],
                  state[pre + "v_proj.weight"]], axis=1))
-            fused |= {pre + "q_proj.weight", pre + "k_proj.weight",
-                      pre + "v_proj.weight"}
         p, _, leaf = name.rpartition(".mlp.gate_proj.weight")
         if leaf == "" and p:
             pre = p + ".mlp."
             out[pre + "gateup_fused.weight"] = quant(jnp.concatenate(
                 [state[pre + "gate_proj.weight"],
                  state[pre + "up_proj.weight"]], axis=1))
-            fused |= {pre + "gate_proj.weight", pre + "up_proj.weight"}
     for name, arr in state.items():
-        if (name.endswith(_QUANT_KEYS) or name == "lm_head.weight") \
-                and name not in fused:
+        if name.endswith(_QUANT_KEYS) or name == "lm_head.weight":
+            # fused members included: the returned state is UNIFORMLY
+            # quantized (r4 advisor: a consumer reading q_proj.weight
+            # directly must not silently run dense)
             out[name] = quant(arr)
     return out
 

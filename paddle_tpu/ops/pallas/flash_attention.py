@@ -169,16 +169,23 @@ def _probe_pallas():
     return _PALLAS_OK
 
 
-_MASKED_STREAM_OK = None
+_MASKED_STREAM_OK: dict = {}
 
 
-def _probe_masked_stream():
+def _probe_masked_stream(hd=64, nvec=2):
     """Compile+run the STREAMED masked/biased kernels (fwd and grad)
-    once at tiny forced-stream shapes, so the long-seq masked dispatch
-    can trust them (their Mosaic compile happens at the caller's jit
-    compile, where failure is uncatchable)."""
-    global _MASKED_STREAM_OK
-    if _MASKED_STREAM_OK is None:
+    once per (head_dim, mask-vec arity) at the PRODUCTION block
+    configuration, so the long-seq masked dispatch can trust them
+    (their Mosaic compile happens at the caller's jit compile, where
+    failure is uncatchable).
+
+    Probe shapes derive from the call site (r4 advisor: a S=256/nvec=2
+    smoke test left S>4k nvec=4 hd=128 failures to surface at the
+    caller): S=512 selects the same 512-wide blocks _block_sizes picks
+    for every long padded sequence, and hd/nvec come in from the
+    dispatch."""
+    key = (int(hd), int(nvec))
+    if key not in _MASKED_STREAM_OK:
         from . import flash_mask as FM
 
         def smoke():
@@ -186,10 +193,11 @@ def _probe_masked_stream():
             saved = _FORCE_STREAM
             _FORCE_STREAM = True
             try:
-                q = jnp.zeros((1, 256, 2, 64), jnp.bfloat16)
-                kv = jnp.zeros((1, 256, 1, 64), jnp.bfloat16)
-                vec = jnp.zeros((1, 1, 2, 256), jnp.int32)
-                bias = jnp.zeros((1, 1, 256, 256), jnp.float32)
+                s = 512          # -> 512-blocks, the long-seq config
+                q = jnp.zeros((1, s, 2, hd), jnp.bfloat16)
+                kv = jnp.zeros((1, s, 1, hd), jnp.bfloat16)
+                vec = jnp.zeros((1, 1, nvec, s), jnp.int32)
+                bias = jnp.zeros((1, 1, s, s), jnp.float32)
                 sc = 0.125
 
                 def loss_m(q, k, v):
@@ -212,8 +220,8 @@ def _probe_masked_stream():
             finally:
                 _FORCE_STREAM = saved
 
-        _MASKED_STREAM_OK = run_probe(smoke)
-    return _MASKED_STREAM_OK
+        _MASKED_STREAM_OK[key] = run_probe(smoke)
+    return _MASKED_STREAM_OK[key]
 
 
 def _pad_len(s, mult=128):
@@ -295,7 +303,9 @@ def sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
         # variants (inner-grid K/V iteration, VMEM independent of S);
         # gate them behind their own compile probe so a Mosaic failure
         # at the CALLER's jit-compile can't crash training
-        stream_ok = (not (masked and long_seq)) or _probe_masked_stream()
+        stream_ok = (not (masked and long_seq)) or _probe_masked_stream(
+            hd=q.shape[-1],
+            nvec=(mask_vecs.shape[2] if mask_vecs is not None else 2))
         if stream_ok:
             try:
                 if mask_vecs is not None:

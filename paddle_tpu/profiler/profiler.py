@@ -145,9 +145,13 @@ class Profiler:
         self._t_last = time.perf_counter()
         if self._timer_only:
             return
-        statistic.reset()
         state = self._state()
         if state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            # reset only when THIS profiler will actually record — a
+            # CLOSED-state start() must not wipe the global op-stats a
+            # concurrently recording profiler is accumulating (mirrors
+            # the _stats_on guard in stop())
+            statistic.reset()
             self._start_trace()
             statistic.enable_collection()
             self._stats_on = True
@@ -180,6 +184,10 @@ class Profiler:
             if cur in (ProfilerState.RECORD,
                        ProfilerState.RECORD_AND_RETURN) and \
                     not self._recording:
+                # scheduler-delayed recording starts HERE, not in
+                # start(): reset now so a previous profiler's op-stats
+                # don't merge into this run's summary
+                statistic.reset()
                 self._start_trace()
                 statistic.enable_collection()
                 self._stats_on = True

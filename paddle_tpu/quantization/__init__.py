@@ -21,7 +21,9 @@ from ..nn.layer import Layer
 from ..framework.tensor import Tensor
 from ..ops.registry import op
 
-__all__ = ["QuantConfig", "QAT", "PTQ", "quanters", "observers",
+from .base_quanter import BaseQuanter, QuanterFactory, quanter  # noqa: F401
+
+__all__ = ["BaseQuanter", "quanter", "QuantConfig", "QAT", "PTQ", "quanters", "observers",
            "fake_quant_dequant_abs_max"]
 
 

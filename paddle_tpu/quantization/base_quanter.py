@@ -1,0 +1,128 @@
+"""BaseQuanter + the quanter factory decorator.
+
+Reference: python/paddle/quantization/base_quanter.py:29 (abstract
+quanter Layer: forward simulates quantization, scales/zero_points
+expose the learned parameters) and factory.py:78 (the ``quanter``
+decorator wraps a BaseQuanter subclass in a QuanterFactory so configs
+hold (class, args) pairs and instantiate per observed layer).
+"""
+from __future__ import annotations
+
+import abc
+
+import numpy as np
+
+from ..nn.layer import Layer
+
+__all__ = ["BaseQuanter", "QuanterFactory", "quanter"]
+
+
+class BaseQuanter(Layer, metaclass=abc.ABCMeta):
+    """Abstract simulated-quantization layer (reference
+    base_quanter.py:29): forward fake-quantizes its input; scales /
+    zero_points expose the quantization parameters."""
+
+    def __init__(self):
+        super().__init__()
+
+    @abc.abstractmethod
+    def forward(self, input):
+        ...
+
+    @abc.abstractmethod
+    def scales(self):
+        """Quantization scales: Tensor or ndarray, or None."""
+        ...
+
+    @abc.abstractmethod
+    def zero_points(self):
+        """Quantization zero points: Tensor or ndarray, or None."""
+        ...
+
+    def quant_axis(self):
+        """Channel axis for per-channel quantization (-1 = per-tensor)."""
+        return -1
+
+    def bit_length(self):
+        return 8
+
+
+class _ClassWithArguments(metaclass=abc.ABCMeta):
+    def __init__(self, *args, **kwargs):
+        self._args = args
+        self._kwargs = kwargs
+
+    @property
+    def args(self):
+        return self._args
+
+    @property
+    def kwargs(self):
+        return self._kwargs
+
+    @abc.abstractmethod
+    def _get_class(self):
+        ...
+
+    def __str__(self):
+        args_str = ",".join(
+            [str(a) for a in self.args]
+            + [f"{k}={v}" for k, v in self.kwargs.items()])
+        return f"{self.__class__.__name__}({args_str})"
+
+    __repr__ = __str__
+
+
+class QuanterFactory(_ClassWithArguments):
+    """Holds (quanter class, ctor args); ``_instance(layer)`` builds the
+    concrete quanter for one observed layer (reference factory.py)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.partial_class = None
+
+    def _get_class(self):
+        return self.partial_class
+
+    def _instance(self, layer) -> BaseQuanter:
+        return self.partial_class(layer, *self.args, **self.kwargs) \
+            if _wants_layer(self.partial_class) \
+            else self.partial_class(*self.args, **self.kwargs)
+
+
+def _wants_layer(cls):
+    import inspect
+    try:
+        params = list(inspect.signature(cls.__init__).parameters)
+        return len(params) > 1 and params[1] == "layer"
+    except (TypeError, ValueError):
+        return False
+
+
+def quanter(class_name: str):
+    """Class decorator (reference factory.py:78): registers a
+    BaseQuanter subclass and synthesizes a same-module QuanterFactory
+    subclass named ``class_name`` whose instances carry the ctor args::
+
+        @quanter("MyQuanter")
+        class MyQuanterLayer(BaseQuanter): ...
+
+        q_config = QuantConfig(activation=MyQuanter(bits=8), ...)
+    """
+    def wrapper(cls):
+        import sys
+
+        def factory_init(self, *args, **kwargs):
+            QuanterFactory.__init__(self, *args, **kwargs)
+            self.partial_class = cls
+
+        factory = type(class_name, (QuanterFactory,),
+                       {"__init__": factory_init})
+        mod = sys.modules[cls.__module__]
+        setattr(mod, class_name, factory)
+        # visible from paddle.quantization like the reference
+        from . import __dict__ as _pkg
+        _pkg.setdefault(class_name, factory)
+        return cls
+
+    return wrapper

@@ -353,11 +353,13 @@ def rawjax_moe_step():
         return loss, p
 
     tok_dense = timed_step(jax.jit(dense_step, donate_argnums=(0,)))
-    _emit("rawjax_moe_step_tok_per_sec", tok_full / 1e3,
-          {"unit": "ktok/s", "perfect_dispatch_ktok_s":
-           round(tok_dense / 1e3, 1),
-           "routing_overhead_frac":
-           round(1 - tok_full / tok_dense, 4)})
+    # throughput probe: its own key (NOT _emit's "tflops" field)
+    print(json.dumps({
+        "probe": "rawjax_moe_step", "ktok_per_sec":
+        round(tok_full / 1e3, 1),
+        "perfect_dispatch_ktok_s": round(tok_dense / 1e3, 1),
+        "routing_overhead_frac": round(1 - tok_full / tok_dense, 4)}),
+        flush=True)
 
 
 def main():
