@@ -23,10 +23,39 @@ def jacobian(ys, xs, batch_axis=None):
         supported here — pass the function (jax traces functionally).
     """
     if not callable(ys):
-        raise TypeError(
-            "jacobian(ys, xs) needs ys to be a callable here: the tape "
-            "releases intermediate jaxprs, so differentiate the function "
-            "(reference autograd.py also exposes the functional form)")
+        # recorded-tensor form (reference autograd.py's eager form):
+        # one tape sweep per output element via grad(retain_graph=True).
+        # O(y.size) sweeps — fine for the small outputs jacobians of
+        # recorded graphs are used for; bounded loudly.
+        from . import tape
+
+        y = ys
+        if not isinstance(y, Tensor) or y._grad_node is None:
+            raise TypeError(
+                "jacobian(ys, xs): ys must be a callable or a RECORDED "
+                "Tensor (created under the tape from xs)")
+        if y.size > 512:
+            raise ValueError(
+                f"jacobian over a recorded tensor runs one backward "
+                f"sweep per output element; y.size={y.size} is too "
+                "large — use the callable form (jax.jacrev compiles "
+                "the whole sweep)")
+        xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+        yf = y.flatten()
+        rows = []
+        for i in range(int(y.size)):
+            gs = tape.grad([yf[i]], list(xs_list), retain_graph=True,
+                           allow_unused=False)
+            rows.append([g._data.reshape(-1) for g in gs])
+        jacs = []
+        for j in range(len(xs_list)):
+            mat = jnp.stack([rows[i][j] for i in range(len(rows))])
+            jacs.append(Tensor(
+                mat.reshape(tuple(y.shape) + tuple(xs_list[j].shape)),
+                stop_gradient=True))
+        if isinstance(xs, (list, tuple)):
+            return jacs
+        return jacs[0]
     func = ys
     xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
     arrays = [_unwrap(x) for x in xs_list]

@@ -145,6 +145,17 @@ def test_create_graph_through_rng_op_raises():
         paddle.grad(g.sum(), x)
 
 
+def test_jacobian_on_recorded_tensor():
+    """The tape form of autograd.jacobian (reference eager form) —
+    possible now that retained graphs re-sweep correctly."""
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32),
+                         stop_gradient=False)
+    y = x * x
+    j = paddle.autograd.jacobian(y, x)
+    np.testing.assert_allclose(j.numpy(), np.diag([2.0, 4.0, 6.0]),
+                               rtol=1e-6)
+
+
 def test_grad_outputs_seed_double_backward():
     # seed the first grad with a recorded tensor: d/ds [s * 3x^2] = 3x^2
     x = paddle.to_tensor(2.0, stop_gradient=False)
