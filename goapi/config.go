@@ -15,6 +15,7 @@ package paddle
 import "C"
 
 import (
+	"fmt"
 	"runtime"
 	"unsafe"
 )
@@ -23,6 +24,16 @@ import (
 // consumed by NewPredictor — do not reuse it afterwards.
 type Config struct {
 	c *C.PD_Config
+
+	// recorded generic knobs (no TPU-side action needed)
+	progFile      string
+	paramsFile    string
+	optimCacheDir string
+	mathThreads   int
+	irOptim       bool
+	memoryOptim   bool
+	profile       bool
+	glogOff       bool
 }
 
 // NewConfig creates an empty config.
@@ -50,4 +61,76 @@ func (cfg *Config) SetModel(modelPath, paramsPath string) {
 // ModelDir returns the configured model path.
 func (cfg *Config) ModelDir() string {
 	return C.GoString(C.PD_ConfigGetModelDir(cfg.c))
+}
+
+// ---- generic knobs (reference config.go surface; GPU/TRT/MKLDNN
+// settings have no TPU analog and live off this wrapper — see README).
+// These are recorded on the Go side: XLA already runs the optimization
+// and memory planning the reference gates behind them.
+
+// SetModelDir points at an uncombined model directory.
+func (cfg *Config) SetModelDir(dir string) { cfg.SetModel(dir, "") }
+
+// SetProgFile sets the program (model) file path.
+func (cfg *Config) SetProgFile(model string) {
+	cfg.progFile = model
+	cfg.SetModel(model, cfg.paramsFile)
+}
+
+// SetParamsFile sets the combined-params file path.
+func (cfg *Config) SetParamsFile(params string) {
+	cfg.paramsFile = params
+	cfg.SetModel(cfg.progFile, params)
+}
+
+// ProgFile returns the configured program file.
+func (cfg *Config) ProgFile() string { return cfg.progFile }
+
+// ParamsFile returns the configured params file.
+func (cfg *Config) ParamsFile() string { return cfg.paramsFile }
+
+// SetOptimCacheDir records the optimization-cache directory (XLA's
+// compilation cache is process-level here).
+func (cfg *Config) SetOptimCacheDir(dir string) { cfg.optimCacheDir = dir }
+
+// SetCpuMathLibraryNumThreads records the host math thread count.
+func (cfg *Config) SetCpuMathLibraryNumThreads(n int) { cfg.mathThreads = n }
+
+// CpuMathLibraryNumThreads returns the recorded thread count.
+func (cfg *Config) CpuMathLibraryNumThreads() int32 {
+	return int32(cfg.mathThreads)
+}
+
+// SwitchIrOptim toggles graph optimization (XLA always optimizes; the
+// flag is recorded for API parity).
+func (cfg *Config) SwitchIrOptim(x bool) { cfg.irOptim = x }
+
+// IrOptim reports the recorded flag.
+func (cfg *Config) IrOptim() bool { return cfg.irOptim }
+
+// EnableMemoryOptim toggles memory reuse (XLA buffer donation governs
+// this here).
+func (cfg *Config) EnableMemoryOptim(x bool) { cfg.memoryOptim = x }
+
+// MemoryOptimEnabled reports the recorded flag.
+func (cfg *Config) MemoryOptimEnabled() bool { return cfg.memoryOptim }
+
+// EnableProfile turns on runtime profiling (recorded).
+func (cfg *Config) EnableProfile() { cfg.profile = true }
+
+// ProfileEnabled reports the recorded flag.
+func (cfg *Config) ProfileEnabled() bool { return cfg.profile }
+
+// DisableGlogInfo silences info logging (recorded).
+func (cfg *Config) DisableGlogInfo() { cfg.glogOff = true }
+
+// GlogInfoDisabled reports the recorded flag.
+func (cfg *Config) GlogInfoDisabled() bool { return cfg.glogOff }
+
+// Summary renders the config state (reference Summary()).
+func (cfg *Config) Summary() string {
+	return fmt.Sprintf(
+		"model: %s; params: %s; ir_optim: %v; memory_optim: %v; "+
+			"math_threads: %d", cfg.ModelDir(), cfg.paramsFile,
+		cfg.irOptim, cfg.memoryOptim, cfg.mathThreads)
 }
