@@ -51,6 +51,8 @@ func NewConfig() *Config {
 // SetModel points the config at a jit.save'd model directory (the
 // paramsPath may be empty — paddle_tpu bundles params with the model).
 func (cfg *Config) SetModel(modelPath, paramsPath string) {
+	cfg.progFile = modelPath
+	cfg.paramsFile = paramsPath
 	mp := C.CString(modelPath)
 	pp := C.CString(paramsPath)
 	defer C.free(unsafe.Pointer(mp))
@@ -68,8 +70,11 @@ func (cfg *Config) ModelDir() string {
 // These are recorded on the Go side: XLA already runs the optimization
 // and memory planning the reference gates behind them.
 
-// SetModelDir points at an uncombined model directory.
-func (cfg *Config) SetModelDir(dir string) { cfg.SetModel(dir, "") }
+// SetModelDir points at an uncombined model directory (params path
+// kept: the setters compose — each updates only its own slot).
+func (cfg *Config) SetModelDir(dir string) {
+	cfg.SetModel(dir, cfg.paramsFile)
+}
 
 // SetProgFile sets the program (model) file path.
 func (cfg *Config) SetProgFile(model string) {

@@ -51,6 +51,10 @@ define_flag("FLAGS_use_deterministic_algorithms", False, "determinism switch")
 define_flag("FLAGS_embedding_deterministic", 0, "deterministic embedding grad")
 define_flag("FLAGS_cudnn_deterministic", False, "compat alias on TPU")
 define_flag("FLAGS_log_level", 0, "vlog level")
+define_flag("FLAGS_strict_view_semantics", False,
+            "error on in-place mutation with live views (the aliasing "
+            "policy divergence becomes loud; README 'Compatibility "
+            "policy')")
 define_flag("FLAGS_allocator_strategy", "auto_growth", "compat; XLA BFC governs")
 define_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.92, "compat")
 define_flag("FLAGS_tpu_matmul_precision", "default",

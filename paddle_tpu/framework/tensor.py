@@ -29,6 +29,59 @@ from . import dtype as dtypes
 __all__ = ["Tensor", "to_tensor", "is_tensor"]
 
 
+# ------------------------------------------------- strict view semantics
+# The documented aliasing-policy divergence (README "Compatibility
+# policy"): views are value snapshots here, not aliases.  With
+# FLAGS_strict_view_semantics=1 the hazard becomes an ERROR instead of a
+# silent divergence — mutating a tensor while a linked view/base is
+# alive raises, pointing at the policy.  Near-zero overhead when off
+# (one dict get per view-method call; no imports, no tracking).
+import weakref as _weakref
+
+from ..flags import FLAGS as _FLAGS
+
+
+def _strict_views_on():
+    return bool(_FLAGS.get("FLAGS_strict_view_semantics", False))
+
+
+def _link_view(base, view):
+    """Record the view relation so either side's in-place mutation can
+    be flagged while the other is alive.  Views link to their ROOT base
+    (chains like a.reshape(...)[1:3] stay linked to `a` even after the
+    intermediate dies — transitive aliasing is what the reference
+    shares storage across)."""
+    if base is view:
+        return view
+    root = base
+    # _views layout: (root_weakref, [peer_weakrefs]) — tensors that are
+    # themselves views carry their root in slot 0 (None for true bases)
+    if base._views is not None and base._views[0] is not None:
+        rt = base._views[0]()
+        if rt is not None:
+            root = rt
+    view._views = (_weakref.ref(root),
+                   [] if view._views is None else view._views[1])
+    if root._views is None:
+        root._views = (None, [])
+    root._views[1].append(_weakref.ref(view))
+    view._views[1].append(_weakref.ref(root))
+    return view
+
+
+def _check_view_mutation(t):
+    if t._views is None or not _strict_views_on():
+        return
+    if any(r() is not None for r in t._views[1]):
+        raise RuntimeError(
+            "FLAGS_strict_view_semantics: in-place mutation of a tensor "
+            "with live views (or of a view whose base is alive). "
+            "Reference Paddle aliases storage here; paddle_tpu views are "
+            "value snapshots (README 'Compatibility policy') — re-derive "
+            "the view after mutating, or drop the strict flag to accept "
+            "snapshot semantics.")
+
+
 def _default_dtype_for(data):
     """Paddle default dtype rules: python/np float64 data → float32 (the
     framework default float), ints stay int64, bools stay bool."""
@@ -50,7 +103,7 @@ class Tensor:
     """Eager tensor handle (paddle.Tensor API shape)."""
 
     __slots__ = ("_data", "stop_gradient", "_grad", "_grad_node", "_out_index",
-                 "name", "persistable", "trainable", "__weakref__")
+                 "name", "persistable", "trainable", "_views", "__weakref__")
 
     _next_name_id = 0
 
@@ -72,6 +125,7 @@ class Tensor:
         self._grad = None           # jax array or None
         self._grad_node = None      # tape.GradNode
         self._out_index = 0
+        self._views = None          # strict-view-mode link list
         self.persistable = False
         self.trainable = not stop_gradient
         if name is None:
@@ -220,6 +274,7 @@ class Tensor:
     # ----------------------------------------------------------- rebinding
     def _rebind_(self, other: "Tensor"):
         """In-place semantics: point this handle at another result."""
+        _check_view_mutation(self)
         self._data = other._data
         self._grad_node = other._grad_node
         self._out_index = other._out_index
@@ -227,11 +282,13 @@ class Tensor:
         return self
 
     def copy_(self, other, blocking=True):
+        _check_view_mutation(self)
         other = to_tensor(other)
         self._data = other._data.astype(self._data.dtype)
         return self
 
     def set_value(self, value):
+        _check_view_mutation(self)
         value = to_tensor(value)
         self._data = jnp.broadcast_to(
             value._data.astype(self._data.dtype), self._data.shape)

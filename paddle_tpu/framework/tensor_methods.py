@@ -349,3 +349,41 @@ def _patch_surface2():
 
 
 _patch_surface2()
+
+
+def _patch_strict_views():
+    """Wrap the view-creating methods so FLAGS_strict_view_semantics can
+    link base<->view and turn write-through-aliasing hazards into errors
+    (tensor.py _link_view / _check_view_mutation; README policy).  The
+    flag gate runs BEFORE _link_view so the off-path costs one dict get."""
+    from .tensor import _link_view, _strict_views_on
+
+    for name in ("reshape", "view", "view_as", "squeeze", "unsqueeze",
+                 "flatten", "detach"):
+        orig = getattr(Tensor, name, None)
+        if orig is None:
+            continue
+
+        def _mk(orig):
+            def method(self, *args, **kwargs):
+                out = orig(self, *args, **kwargs)
+                if _strict_views_on() and isinstance(out, Tensor):
+                    _link_view(self, out)
+                return out
+            method.__name__ = getattr(orig, "__name__", "view_method")
+            return method
+
+        setattr(Tensor, name, _mk(orig))
+
+    orig_gi = Tensor.__getitem__
+
+    def _getitem_linked(self, idx):
+        out = orig_gi(self, idx)
+        if _strict_views_on() and isinstance(out, Tensor):
+            _link_view(self, out)
+        return out
+
+    Tensor.__getitem__ = _getitem_linked
+
+
+_patch_strict_views()

@@ -1,0 +1,66 @@
+"""FLAGS_strict_view_semantics: the documented aliasing-policy
+divergence (README 'Compatibility policy') becomes an error instead of
+a silent snapshot when opted in."""
+import gc
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+@pytest.fixture()
+def strict():
+    paddle.set_flags({"FLAGS_strict_view_semantics": True})
+    yield
+    paddle.set_flags({"FLAGS_strict_view_semantics": False})
+
+
+def test_default_snapshot_semantics_documented():
+    a = paddle.zeros([2, 2])
+    b = a.reshape([4])
+    a[0] = 7.0
+    # the divergence the README documents: b keeps the old values
+    assert float(b.numpy()[0]) == 0.0
+
+
+def test_strict_base_mutation_raises(strict):
+    a = paddle.zeros([2, 2])
+    b = a.reshape([4])  # noqa: F841 — live view
+    with pytest.raises(RuntimeError, match="strict_view_semantics"):
+        a[0] = 7.0
+
+
+def test_strict_view_mutation_raises(strict):
+    a = paddle.zeros([4])
+    c = a[1:3]
+    with pytest.raises(RuntimeError, match="strict_view_semantics"):
+        c.set_value(paddle.ones([2]))
+
+
+def test_strict_allows_mutation_after_views_die(strict):
+    a = paddle.zeros([2, 2])
+    b = a.reshape([4])
+    del b
+    gc.collect()
+    a[0] = 3.0
+    np.testing.assert_allclose(a.numpy()[0], [3.0, 3.0])
+
+
+def test_transitive_chain_links_to_root(strict):
+    """b = a.reshape(...); c = b[...]; del b — mutating a must STILL
+    error while c lives (reference aliasing is transitive)."""
+    a = paddle.zeros([2, 2])
+    b = a.reshape([4])
+    c = b[1:3]  # noqa: F841 — grandchild view
+    del b
+    gc.collect()
+    with pytest.raises(RuntimeError, match="strict_view_semantics"):
+        a[0] = 7.0
+
+
+def test_strict_off_is_zero_cost_path():
+    a = paddle.zeros([2, 2])
+    assert a._views is None          # no tracking when the flag is off
+    b = a.reshape([4])
+    assert a._views is None and b._views is None
