@@ -148,6 +148,14 @@ def _decoder_layer(lp, x, cos, sin, config: LlamaConfig):
     return r + ff @ lp["down"]
 
 
+# Unroll the stage's layer loop instead of lax.scan.  The MoE-rung A/B
+# measured ~2 ms/layer of scan stacked-weight overhead (BASELINE.md r5);
+# default OFF here pending a same-session A/B on the 1B flagship (the
+# scan is the known-good shipping config; flip via env to trial).
+UNROLL_STAGE = __import__("os").environ.get(
+    "PADDLE_TPU_UNROLL_STAGE", "0") == "1"
+
+
 def _stage_fn(stage_params, x, cos, sin, config, remat=True):
     """Apply this stage's layers_per_stage layers (leaves [lps, ...]).
     remat: True = full per-layer checkpoint; "attn" = checkpoint but keep
@@ -160,6 +168,14 @@ def _stage_fn(stage_params, x, cos, sin, config, remat=True):
                 "attn_out"))
     elif remat:
         body = jax.checkpoint(body)
+
+    lps = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+    if UNROLL_STAGE and lps <= 32:
+        h = x
+        for i in range(lps):
+            lp = jax.tree_util.tree_map(lambda a: a[i], stage_params)
+            h = body(lp, h)
+        return h
 
     def scan_body(h, lp):
         return body(lp, h), None
