@@ -174,6 +174,31 @@ def _gather_combine_bwd(res, dy):
 _gather_combine.defvjp(_gather_combine_fwd, _gather_combine_bwd)
 
 
+
+def _count_rank(idx, gv, e, dtype):
+    """Counting-sort front-end shared by the capacity and tile-aligned
+    dispatches: k-major flatten + per-expert rank via one-hot cumsum
+    (round-0 choices rank before round-1, matching the reference's
+    round-by-round position accounting)."""
+    s, k = idx.shape
+    n = s * k
+    fe = idx.T.reshape(n)                  # k-major: round 0 first
+    ft = jnp.tile(jnp.arange(s, dtype=jnp.int32), k)
+    gvf = gv.T.reshape(n).astype(dtype)
+    onehot = jax.nn.one_hot(fe, e, dtype=jnp.int32)
+    pos = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=1) - 1
+    counts = jnp.sum(onehot, axis=0)
+    return n, fe, ft, gvf, pos, counts
+
+
+def _slot_views(entry_of_slot, ft, gvf, n):
+    """Slot-side maps from the inverted permutation: validity, feeding
+    token, gate value."""
+    svalid = entry_of_slot < n
+    eos = jnp.minimum(entry_of_slot, n - 1)
+    return svalid, ft[eos], jnp.where(svalid, gvf[eos], 0)
+
+
 def sort_dispatch_combine(x, idx, gv, e, capacity, ffn):
     """Counting-sort dispatch/combine (reference global_scatter/
     global_gather, paddle/fluid/operators/collective/global_scatter_op.cc
@@ -196,14 +221,7 @@ def sort_dispatch_combine(x, idx, gv, e, capacity, ffn):
     part (c)).
     """
     s, m = x.shape
-    k = idx.shape[1]
-    n = s * k
-    fe = idx.T.reshape(n)                  # k-major: round 0 first
-    ft = jnp.tile(jnp.arange(s, dtype=jnp.int32), k)
-    gvf = gv.T.reshape(n).astype(x.dtype)
-
-    onehot = jax.nn.one_hot(fe, e, dtype=jnp.int32)          # [N, E]
-    pos = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=1) - 1
+    n, fe, ft, gvf, pos, _counts = _count_rank(idx, gv, e, x.dtype)
     keep = pos < capacity
     # dump slot e*capacity catches dropped entries; sliced off below
     dest = jnp.where(keep, fe * capacity + pos, e * capacity)
@@ -216,15 +234,56 @@ def sort_dispatch_combine(x, idx, gv, e, capacity, ffn):
     entry_of_slot = jnp.full((e * capacity + 1,), n, jnp.int32) \
         .at[dest].set(jnp.arange(n, dtype=jnp.int32),
                       mode="drop")[:e * capacity]
-    svalid = entry_of_slot < n
-    eos = jnp.minimum(entry_of_slot, n - 1)
-    ft_slot = ft[eos]
-    gv_slot = jnp.where(svalid, gvf[eos], 0)
+    svalid, ft_slot, gv_slot = _slot_views(entry_of_slot, ft, gvf, n)
 
     expert_in = _gather_dispatch(x, ft_slot, svalid, dest, keep)
     expert_out = ffn(expert_in.reshape(e, capacity, m))
     flat = expert_out.reshape(e * capacity, m)
     return _gather_combine(flat, gvf, ft, ft_slot, gv_slot, svalid, dest,
+                           keep, jnp.zeros((s,), jnp.int8))
+
+
+def grouped_dispatch_ffn(x, idx, gv, e, w1, b1, w2, b2, gated=False,
+                         use_kernel=None):
+    """DROPLESS dispatch + grouped expert FFN (megablocks-style; the
+    reference's fused_moe/CUTLASS-grouped-GEMM analog).
+
+    Tokens counting-sort into a TILE-aligned buffer: each expert's rows
+    round up to the 128-row tile, so every row tile belongs to one
+    expert and ``ops.pallas.grouped_ffn`` computes both expert GEMMs
+    fused with the expert selected per tile.  No capacity factor, no
+    dropped tokens; padding waste <= E*127 rows.
+
+    x [S, M]; idx/gv [S, K]; w1 [E, M, F(*2)]; w2 [E, F, M].
+    Returns y [S, M].
+    """
+    from ..ops.pallas.grouped_ffn import (TILE, _INTERPRET, grouped_ffn,
+                                          grouped_ffn_xla)
+
+    s, m = x.shape
+    n, fe, ft, gvf, pos, counts = _count_rank(idx, gv, e, x.dtype)
+    padded = -(-counts // TILE) * TILE
+    off = jnp.cumsum(padded) - padded      # tile-aligned expert starts
+    r = (-(-n // TILE) + e) * TILE         # static row bound
+
+    dest = (off[fe] + pos).astype(jnp.int32)   # dropless: always kept
+    entry_of_slot = jnp.full((r,), n, jnp.int32) \
+        .at[dest].set(jnp.arange(n, dtype=jnp.int32))
+    svalid, ft_slot, gv_slot = _slot_views(entry_of_slot, ft, gvf, n)
+    keep = jnp.ones((n,), bool)
+
+    # tile -> expert: experts own contiguous tile runs starting at off
+    tile_starts = jnp.arange(r // TILE, dtype=jnp.int32) * TILE
+    emap = jnp.clip(
+        jnp.searchsorted(off, tile_starts, side="right") - 1, 0, e - 1)
+
+    x_buf = _gather_dispatch(x, ft_slot, svalid, dest, keep)
+    if use_kernel is None:
+        # the kernel lowers via Mosaic: TPU (or interpret mode) only
+        use_kernel = _INTERPRET or jax.default_backend() == "tpu"
+    fn = grouped_ffn if use_kernel else grouped_ffn_xla
+    out = fn(x_buf, w1, b1, w2, b2, emap, gated)
+    return _gather_combine(out, gvf, ft, ft_slot, gv_slot, svalid, dest,
                            keep, jnp.zeros((s,), jnp.int8))
 
 
@@ -269,9 +328,24 @@ def moe_dispatch_combine(x, gate_w, w1, b1, w2, b2, *, top_k=2,
         idx, gv, aux = _topk_choices(logits, top_k, train, noise_key)
         y = sort_dispatch_combine(x, idx, gv, e, cap, ffn)
         return y, aux.astype(jnp.float32)
+    if dispatch_mode == "grouped":
+        # dropless tile-aligned grouped GEMM (no capacity, no drops);
+        # single-device formulation — the per-tile expert gather inside
+        # the kernel cannot cross ep shards
+        if ep_sharded:
+            raise NotImplementedError(
+                "dispatch_mode='grouped' is single-device; use 'sort' "
+                "under an ep-sharded mesh")
+        if activation is not jax.nn.silu:
+            raise NotImplementedError(
+                "the grouped kernel implements the silu FFN "
+                "(gated=True for swiglu via grouped_dispatch_ffn)")
+        idx, gv, aux = _topk_choices(logits, top_k, train, noise_key)
+        y = grouped_dispatch_ffn(x, idx, gv, e, w1, b1, w2, b2)
+        return y, aux.astype(jnp.float32)
     if dispatch_mode != "dense":
-        raise ValueError(
-            f"dispatch_mode must be 'sort' or 'dense', got {dispatch_mode!r}")
+        raise ValueError(f"dispatch_mode must be 'sort', 'grouped' or "
+                         f"'dense', got {dispatch_mode!r}")
 
     combine, dispatch, aux = top_k_gating(
         logits, top_k=top_k, capacity_factor=capacity_factor,

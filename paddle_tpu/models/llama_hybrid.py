@@ -152,8 +152,9 @@ def _decoder_layer(lp, x, cos, sin, config: LlamaConfig):
 # measured ~2 ms/layer of scan stacked-weight overhead (BASELINE.md r5);
 # default OFF here pending a same-session A/B on the 1B flagship (the
 # scan is the known-good shipping config; flip via env to trial).
-UNROLL_STAGE = __import__("os").environ.get(
-    "PADDLE_TPU_UNROLL_STAGE", "0") == "1"
+import os as _os
+
+UNROLL_STAGE = _os.environ.get("PADDLE_TPU_UNROLL_STAGE", "0") == "1"
 
 
 def _stage_fn(stage_params, x, cos, sin, config, remat=True):
