@@ -137,6 +137,105 @@ def test_no_grad_vars():
     assert not y.stop_gradient  # restored
 
 
+def _fd_check(loss_fn, x, gx2, seed=5, eps=1e-3, rtol=3e-2):
+    """Directional finite-difference of d/dx [sum(dloss/dx)] against the
+    analytic second-order grad gx2."""
+    v = np.random.RandomState(seed).randn(*x.shape).astype("float32")
+    vt = paddle.to_tensor(v)
+
+    def first_grad_sum(xv):
+        xt = paddle.to_tensor(xv, stop_gradient=False)
+        (g,) = paddle.grad(loss_fn(xt), xt, create_graph=True)
+        return float(g.sum())
+
+    num = (first_grad_sum(x.numpy() + eps * v)
+           - first_grad_sum(x.numpy() - eps * v)) / (2 * eps)
+    ana = float((gx2 * vt).sum())
+    np.testing.assert_allclose(num, ana, rtol=rtol, atol=1e-3)
+
+
+def test_double_grad_through_conv2d():
+    """d/dx [sum(dy/dx)] for a conv layer, finite-difference checked."""
+    paddle.seed(11)
+    conv = nn.Conv2D(2, 3, 3, padding=1)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(1, 2, 6, 6).astype("float32"),
+        stop_gradient=False)
+
+    def loss(xt):
+        return conv(xt).pow(2).sum()
+
+    (gx,) = paddle.grad(loss(x), x, create_graph=True)
+    (gxx,) = paddle.grad(gx.sum(), x)
+    _fd_check(loss, x, gxx)
+
+
+def test_double_grad_through_layernorm():
+    """NB: for a layer-norm loss, d/dx of the PLAIN grad-sum is
+    identically zero (shift invariance — verified equal in pure jax);
+    the probe must weight the first grad to break the invariance."""
+    paddle.seed(12)
+    ln = nn.LayerNorm([8])
+    x = paddle.to_tensor(
+        np.random.RandomState(1).randn(4, 8).astype("float32"),
+        stop_gradient=False)
+    wv = np.random.RandomState(9).randn(4, 8).astype("float32")
+    wt = paddle.to_tensor(wv)
+
+    def loss(xt):
+        return (ln(xt) ** 3).sum()
+
+    (gx,) = paddle.grad(loss(x), x, create_graph=True)
+    (gxx,) = paddle.grad((gx * wt).sum(), x)
+    assert float(paddle.abs(gxx).sum()) > 0
+
+    # finite-difference the weighted grad-sum
+    def wsum(xv):
+        xt = paddle.to_tensor(xv, stop_gradient=False)
+        (g,) = paddle.grad(loss(xt), xt, create_graph=True)
+        return float((g * wt).sum())
+
+    v = np.random.RandomState(5).randn(4, 8).astype("float32")
+    eps = 1e-3
+    num = (wsum(x.numpy() + eps * v) - wsum(x.numpy() - eps * v)) \
+        / (2 * eps)
+    ana = float((gxx * paddle.to_tensor(v)).sum())
+    np.testing.assert_allclose(num, ana, rtol=3e-2, atol=1e-3)
+
+
+def test_double_grad_through_segment_traced_layer():
+    """create_graph must work when the forward ran as ONE segment op
+    (the segment op carries a fwd_closed like any registry op) — and
+    the second-order grads must MATCH the per-op path's."""
+    from paddle_tpu.nn import layer_common as LC
+    prev = LC.SEGMENT_FORWARD
+    try:
+        paddle.seed(13)
+        blk = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+        xv = np.random.RandomState(2).randn(3, 4).astype("float32")
+
+        def run_once(segment_on):
+            LC.SEGMENT_FORWARD = segment_on
+            blk.__dict__.pop("_seg_cache", None)
+            blk.__dict__.pop("_seg_cache_map", None)
+            x = paddle.to_tensor(xv, stop_gradient=False)
+            (gx,) = paddle.grad(blk(x).sum(), x, create_graph=True)
+            gp = (gx ** 2).sum()
+            grads = paddle.grad(gp, list(blk.parameters()),
+                                allow_unused=True)
+            return [None if g is None else g.numpy() for g in grads]
+
+        seg = run_once(True)
+        assert blk._seg_cache[1]          # the segment path really ran
+        ref = run_once(False)
+        for a, b in zip(seg, ref):
+            assert (a is None) == (b is None)
+            if a is not None:
+                np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+    finally:
+        LC.SEGMENT_FORWARD = prev
+
+
 def test_create_graph_through_rng_op_raises():
     x = paddle.to_tensor(np.ones((4, 4), np.float32), stop_gradient=False)
     y = nn.functional.dropout(x, p=0.5, training=True).sum()

@@ -1,0 +1,55 @@
+"""Same-session ResNet rung A/B: dispatch-chunk length 25/50/100 vs the
+platform ceiling's with-BN raw-jax number (run in the same session)."""
+import time
+
+import jax
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+from paddle_tpu.vision.models import resnet50
+
+batch, hw = 128, 224
+
+
+def rung(chunk, steps=2):
+    paddle.seed(0)
+    model = resnet50(num_classes=1000)
+    model.train()
+    o = opt.Momentum(learning_rate=0.1, momentum=0.9,
+                     parameters=model.parameters())
+
+    def loss_fn(m, x, y):
+        with paddle.amp.auto_cast(enable=True, level="O1"):
+            out = m(x)
+        return F.cross_entropy(out, y)
+
+    step = paddle.jit.train_step(model, o, loss_fn).multi_step(chunk)
+    x = paddle.to_tensor(
+        np.random.randn(batch, 3, hw, hw).astype(np.float32))
+    y = paddle.to_tensor(
+        np.random.randint(0, 1000, (batch,)).astype(np.int64))
+    float(step(x, y))
+    float(step(x, y))
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = step(x, y)
+        float(loss)
+        best = min(best, time.perf_counter() - t0)
+    ips = batch * steps * chunk / best
+    print(f"chunk={chunk}: {ips:,.0f} img/s", flush=True)
+    return ips
+
+
+if __name__ == "__main__":
+    for chunk in (25, 50, 100):
+        rung(chunk)
+    # same-session ceiling
+    import subprocess
+    import sys
+    print("running same-session ceiling (with BN)...", flush=True)
+    import tools.platform_ceiling as PC
+    PC.rawjax_resnet(with_bn=True)
