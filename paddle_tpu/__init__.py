@@ -19,6 +19,9 @@ import jax as _jax
 # so TPU matmuls stay on the MXU.
 _jax.config.update("jax_enable_x64", True)
 
+from . import jax_compat as _jax_compat
+_jax_compat.install()
+
 # -- core types ------------------------------------------------------------
 from .framework import dtype as _dtype_mod
 from .framework.dtype import (  # noqa: F401
@@ -109,6 +112,7 @@ from .flags import set_flags, get_flags  # noqa: F401
 from . import vision  # noqa: F401
 from . import models  # noqa: F401
 from . import metric  # noqa: F401
+from . import observability  # noqa: F401
 from . import profiler  # noqa: F401
 from .hapi import Model, summary  # noqa: F401
 from . import hapi  # noqa: F401

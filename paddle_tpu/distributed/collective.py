@@ -22,6 +22,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from .mesh import get_mesh, ProcessMesh
 from ..framework.tensor import Tensor
+from .. import observability as _obs
 
 __all__ = ["Group", "new_group", "get_group", "all_reduce", "all_gather",
            "all_gather_object", "reduce_scatter", "all_to_all", "broadcast",
@@ -131,6 +132,47 @@ def _axes(group):
                  if get_mesh() is not None and a in get_mesh().dim_names)
 
 
+# telemetry for the eager collective path (traced collectives live
+# inside XLA programs and are profiled by the device tracer): call +
+# payload-byte counters per collective kind, and a RecordEvent span so
+# host traces show where collective time goes
+_M_COLL_CALLS = _obs.counter(
+    "collective_calls_total", "eager collective invocations", ("op",))
+_M_COLL_BYTES = _obs.counter(
+    "collective_bytes_total", "payload bytes entering eager collectives",
+    ("op",))
+
+
+def _payload_bytes(arr):
+    try:
+        n = int(np.prod(np.shape(arr)) or 1)
+        dt = getattr(arr, "dtype", None)
+        return n * (np.dtype(dt).itemsize if dt is not None else 0)
+    except Exception:
+        return 0
+
+
+class _collective_span:
+    """Span + counters around one eager collective."""
+
+    def __init__(self, name, arr=None):
+        self._name = name
+        _M_COLL_CALLS.labels(name).inc()
+        b = _payload_bytes(arr) if arr is not None else 0
+        if b:
+            _M_COLL_BYTES.labels(name).inc(b)
+        from ..profiler import RecordEvent
+        self._ev = RecordEvent(f"collective:{name}")
+
+    def __enter__(self):
+        self._ev.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self._ev.end()
+        return False
+
+
 def _eager_shardmap(fn, x, group):
     """Run a per-shard function over the group's axes on an eager array."""
     m = get_mesh().jax_mesh
@@ -183,7 +225,8 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
             n = int(_np.prod([get_mesh().get_dim_size(a) for a in axes]))
             r = r / n
         return r
-    out = _eager_shardmap(body, arr, group)
+    with _collective_span("all_reduce", arr):
+        out = _eager_shardmap(body, arr, group)
     if isinstance(tensor, Tensor):
         tensor._data = out
         return tensor
@@ -217,9 +260,10 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
     sharding = getattr(arr, "sharding", None)
     spec = sharding.spec if isinstance(sharding, NamedSharding) \
         else PartitionSpec()
-    gathered = jax.jit(shard_map(
-        body, mesh=m, in_specs=(spec,), out_specs=PartitionSpec(),
-        check_vma=False))(arr)
+    with _collective_span("all_gather", arr):
+        gathered = jax.jit(shard_map(
+            body, mesh=m, in_specs=(spec,), out_specs=PartitionSpec(),
+            check_vma=False))(arr)
     if tensor_list is not None:
         n = int(np.prod([get_mesh().get_dim_size(a) for a in axes]))
         for piece in jnp.split(gathered, n, axis=axis):
@@ -250,7 +294,8 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
     if isinstance(src, jax.core.Tracer):
         return _scatter_all(src)
 
-    out = _eager_shardmap(_scatter_all, src, group)
+    with _collective_span("reduce_scatter", src):
+        out = _eager_shardmap(_scatter_all, src, group)
     if isinstance(tensor, Tensor):
         tensor._data = out
         return tensor
@@ -305,7 +350,8 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
         return src_val
     m = get_mesh()
     sh = NamedSharding(m.jax_mesh, PartitionSpec())
-    out = jax.device_put(arr, sh)
+    with _collective_span("broadcast", arr):
+        out = jax.device_put(arr, sh)
     if isinstance(tensor, Tensor):
         tensor._data = out
         return tensor
@@ -339,7 +385,8 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
 
 
 def barrier(group=None):
-    (jax.device_put(0) + 0).block_until_ready()
+    with _collective_span("barrier"):
+        (jax.device_put(0) + 0).block_until_ready()
 
 
 def send(tensor, dst=0, group=None, sync_op=True):

@@ -15,8 +15,22 @@ import os
 import threading
 import time
 
+from .. import observability as _obs
+
 __all__ = ["CommTask", "CommTaskManager", "get_comm_task_manager",
            "comm_guard"]
+
+_M_TASKS = _obs.counter(
+    "comm_tasks_total", "communication tasks registered with the watchdog")
+_M_IN_FLIGHT = _obs.gauge(
+    "comm_tasks_in_flight", "comm tasks currently inside their blocking "
+    "region")
+_M_FLAGGED = _obs.gauge(
+    "comm_hung_tasks", "comm tasks currently flagged as hung (exceeded "
+    "timeout, not yet finished)")
+_M_HANGS = _obs.counter(
+    "comm_hangs_total", "comm tasks that ever exceeded their timeout",
+    ("name",))
 
 
 class CommTask:
@@ -56,9 +70,10 @@ class CommTaskManager:
 
     def __init__(self, default_timeout=None, abort_on_hang=False,
                  poll_interval=5.0):
-        env = os.environ.get("PADDLE_COMM_TIMEOUT_SECONDS")
-        self.default_timeout = default_timeout if default_timeout is not None \
-            else (float(env) if env else 1800.0)
+        # None = resolve per-task from env/flag at start_task time, so
+        # paddle.set_flags({"FLAGS_comm_timeout_seconds": ...}) applies
+        # to a manager that already exists
+        self._default_timeout = default_timeout
         self.abort_on_hang = abort_on_hang
         self.poll_interval = poll_interval
         self._tasks: dict[int, CommTask] = {}
@@ -68,6 +83,23 @@ class CommTaskManager:
         self._stop = threading.Event()
         self._hang_hooks = []
 
+    @property
+    def default_timeout(self):
+        if self._default_timeout is not None:
+            return self._default_timeout
+        env = os.environ.get("PADDLE_COMM_TIMEOUT_SECONDS")
+        if env:
+            return float(env)
+        try:
+            from ..flags import FLAGS
+            return float(FLAGS.get("FLAGS_comm_timeout_seconds", 1800.0))
+        except Exception:   # pragma: no cover — flags always importable
+            return 1800.0
+
+    @default_timeout.setter
+    def default_timeout(self, v):
+        self._default_timeout = v
+
     # ------------------------------------------------------------ tasks
     def start_task(self, name, group=None, timeout=None):
         with self._lock:
@@ -76,6 +108,8 @@ class CommTaskManager:
                             timeout if timeout is not None
                             else self.default_timeout, self._seq)
             self._tasks[task.seq] = task
+            _M_TASKS.inc()
+            _M_IN_FLIGHT.set(len(self._tasks))
         self._ensure_thread()
         return task
 
@@ -83,6 +117,14 @@ class CommTaskManager:
         task.done = True
         with self._lock:
             self._tasks.pop(task.seq, None)
+            _M_IN_FLIGHT.set(len(self._tasks))
+            _M_FLAGGED.set(sum(1 for t in self._tasks.values()
+                               if t.flagged))
+
+    def flagged_count(self):
+        """Number of currently in-flight tasks flagged as hung."""
+        with self._lock:
+            return sum(1 for t in self._tasks.values() if t.flagged)
 
     def in_flight(self):
         with self._lock:
@@ -115,7 +157,11 @@ class CommTaskManager:
                             and task.elapsed() > task.timeout):
                         task.flagged = True
                         hung.append(task)
+                if hung:
+                    _M_FLAGGED.set(sum(1 for t in self._tasks.values()
+                                       if t.flagged))
             for task in hung:
+                _M_HANGS.labels(task.name).inc()
                 log.error(
                     "comm watchdog: %r exceeded its %.0fs timeout; "
                     "in-flight tasks: %r", task, task.timeout,

@@ -59,3 +59,12 @@ define_flag("FLAGS_allocator_strategy", "auto_growth", "compat; XLA BFC governs"
 define_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.92, "compat")
 define_flag("FLAGS_tpu_matmul_precision", "default",
             "jax default_matmul_precision for MXU")
+define_flag("FLAGS_metrics_dir", "",
+            "directory observability.dump() writes metrics.prom/"
+            "metrics.json/retraces.json into (empty: no dump)")
+define_flag("FLAGS_host_trace", False,
+            "enable the native host tracer at import "
+            "(profiler.enable_host_tracing)")
+define_flag("FLAGS_comm_timeout_seconds", 1800.0,
+            "default CommTask timeout for the comm watchdog "
+            "(PADDLE_COMM_TIMEOUT_SECONDS env overrides)")

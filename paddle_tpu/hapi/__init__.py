@@ -2,5 +2,6 @@
 callbacks, summary)."""
 from .model import Model  # noqa: F401
 from .callbacks import (  # noqa: F401
-    Callback, ProgBarLogger, ModelCheckpoint, EarlyStopping, LRScheduler)
+    Callback, ProgBarLogger, ModelCheckpoint, EarlyStopping, LRScheduler,
+    MetricsLogger)
 from .summary import summary  # noqa: F401

@@ -7,7 +7,7 @@ import time
 import numpy as np
 
 __all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
-           "EarlyStopping", "LRScheduler"]
+           "EarlyStopping", "LRScheduler", "MetricsLogger"]
 
 
 class Callback:
@@ -102,7 +102,8 @@ class ProgBarLogger(Callback):
         if self.verbose:
             dt = time.time() - self._t_epoch
             extras = " ".join(f"{k}: {v:.4f}" for k, v in (logs or {}).items()
-                              if isinstance(v, (int, float)) and k != "step")
+                              if isinstance(v, (int, float))
+                              and k not in ("step", "batch_size"))
             print(f"Epoch {epoch + 1} done in {dt:.1f}s {extras}")
 
 
@@ -152,6 +153,95 @@ class EarlyStopping(Callback):
             self.wait += 1
             if self.wait >= self.patience and self.model is not None:
                 self.model.stop_training = True
+
+
+def _host_rss_bytes():
+    """Current host RSS (linux /proc; fallback: peak RSS from
+    getrusage)."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    try:
+        import resource
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except Exception:
+        return 0
+
+
+class MetricsLogger(Callback):
+    """Emit training telemetry into the observability registry: per-step
+    wall time (histogram + last-value gauge), samples/sec, cumulative
+    step/sample counters, per-device memory (device.memory_stats) and
+    host RSS.  On train end the registry is dumped to FLAGS_metrics_dir
+    (observability.dump) so a run leaves a readable artifact —
+    `python tools/metrics_report.py <dir>`.
+
+    memory_freq: poll device memory / RSS every N steps (it is a host
+    round-trip per device; step timing itself is free)."""
+
+    def __init__(self, memory_freq=1, dump_on_train_end=True):
+        super().__init__()
+        from .. import observability as obs
+        self._obs = obs
+        self.memory_freq = max(1, int(memory_freq))
+        self.dump_on_train_end = dump_on_train_end
+        self._h_step = obs.histogram(
+            "hapi_step_seconds", "train step wall time")
+        self._g_step = obs.gauge(
+            "hapi_last_step_seconds", "most recent train step wall time")
+        self._g_sps = obs.gauge(
+            "hapi_samples_per_second", "throughput of the most recent "
+            "train step")
+        self._c_steps = obs.counter(
+            "hapi_steps_total", "train steps completed")
+        self._c_samples = obs.counter(
+            "hapi_samples_total", "samples consumed by train steps")
+        self._g_mem = obs.gauge(
+            "device_bytes_in_use", "live device memory", ("device",))
+        self._g_peak = obs.gauge(
+            "device_peak_bytes_in_use", "peak device memory", ("device",))
+        self._g_rss = obs.gauge("host_rss_bytes", "host process RSS")
+        self._t0 = None
+
+    def on_train_batch_begin(self, step, logs=None):
+        self._t0 = time.perf_counter()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self._t0 is None:
+            return
+        dt = time.perf_counter() - self._t0
+        self._h_step.observe(dt)
+        self._g_step.set(dt)
+        self._c_steps.inc()
+        n = (logs or {}).get("batch_size")
+        if n:
+            self._c_samples.inc(n)
+            if dt > 0:
+                self._g_sps.set(n / dt)
+        if int(self._c_steps.value) % self.memory_freq == 0:
+            self._poll_memory()
+
+    def _poll_memory(self):
+        import jax
+        for d in jax.devices():
+            stats = getattr(d, "memory_stats", lambda: {})() or {}
+            key = f"{d.platform}:{d.id}"
+            if "bytes_in_use" in stats:
+                self._g_mem.labels(key).set(stats["bytes_in_use"])
+            if "peak_bytes_in_use" in stats:
+                self._g_peak.labels(key).set(stats["peak_bytes_in_use"])
+        rss = _host_rss_bytes()
+        if rss:
+            self._g_rss.set(rss)
+
+    def on_train_end(self, logs=None):
+        self._poll_memory()
+        if self.dump_on_train_end:
+            self._obs.dump()    # no-op unless FLAGS_metrics_dir is set
 
 
 class LRScheduler(Callback):

@@ -133,7 +133,8 @@ class Model:
                         self._optimizer.clear_grad()
                 else:
                     losses = self.train_batch(ins, labs)
-                logs = {"loss": losses[0], "step": step}
+                logs = {"loss": losses[0], "step": step,
+                        "batch_size": _batch_len(ins)}
                 cbks.on_batch_end("train", step, logs)
                 it += 1
                 if num_iters is not None and it >= num_iters:
@@ -232,6 +233,15 @@ def _split_batch(batch, labels_spec, allow_no_label=False):
         return batch, []
     n_labels = len(labels_spec) if labels_spec else 1
     return batch[:-n_labels], batch[-n_labels:]
+
+
+def _batch_len(inputs):
+    """Leading-dim size of the first input (samples/sec accounting)."""
+    ins = _as_list(inputs)
+    if not ins:
+        return None
+    shape = np.shape(getattr(ins[0], "_data", ins[0]))
+    return int(shape[0]) if shape else None
 
 
 def _safe_len(loader):

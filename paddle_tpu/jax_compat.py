@@ -1,0 +1,33 @@
+"""Compatibility shims over the installed jax version.
+
+The framework targets the modern jax surface (``jax.shard_map`` with the
+``check_vma`` kwarg).  Older runtimes ship the same primitive as
+``jax.experimental.shard_map.shard_map`` with the kwarg spelled
+``check_rep`` — install a forwarding wrapper onto the ``jax`` module so
+both ``jax.shard_map(...)`` and ``from jax import shard_map`` resolve
+everywhere (module attribute assignment covers both forms)."""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def install():
+    if hasattr(jax, "shard_map"):
+        return
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    @functools.wraps(_shard_map)
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None,
+                  axis_names=None, **kw):
+        if check_vma is not None and "check_rep" not in kw:
+            kw["check_rep"] = check_vma
+        if axis_names is not None and "auto" not in kw:
+            # modern axis_names = the manually-mapped axes; the old API
+            # spells the complement as auto
+            kw["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kw)
+
+    jax.shard_map = shard_map

@@ -1,0 +1,139 @@
+"""Unified runtime telemetry (reference analogs: paddle/fluid/platform/
+monitor.h StatRegistry, profiler chrome-trace counter events, and the
+CommTaskManager hang diagnostics).
+
+One process-wide :class:`MetricsRegistry` owns every runtime metric:
+
+  * the eager dispatch cache (ops/registry.py) — hit / miss / eviction /
+    uncacheable counters and a **retrace log** of (op, abstract input
+    signature) for every cache miss, the jax recompilation-visibility
+    pain point;
+  * collectives (distributed/collective.py) — per-collective payload
+    bytes + call counts, watchdog hang gauges;
+  * hapi training (hapi/callbacks.MetricsLogger) — step wall time,
+    samples/sec, device memory, host RSS.
+
+Export: ``dump()`` writes Prometheus text + JSON (+ the retrace log)
+into ``FLAGS_metrics_dir``; ``tools/metrics_report.py`` pretty-prints a
+dump.  While a profiler records, counter changes are sampled on the
+same perf_counter clock as RecordEvent spans so
+``profiler.export_host_trace`` can merge "C"-phase counter tracks into
+the chrome trace.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from .registry import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry, default_registry)
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "default_registry", "counter", "gauge", "histogram",
+           "retrace_log", "RetraceLog", "dump", "reset",
+           "enable_event_sampling", "chrome_counter_events"]
+
+
+def counter(name, help_="", labelnames=()):
+    return default_registry().counter(name, help_, labelnames)
+
+
+def gauge(name, help_="", labelnames=()):
+    return default_registry().gauge(name, help_, labelnames)
+
+
+def histogram(name, help_="", labelnames=(), buckets=None):
+    from .registry import DEFAULT_BUCKETS
+    return default_registry().histogram(
+        name, help_, labelnames, buckets=buckets or DEFAULT_BUCKETS)
+
+
+def enable_event_sampling(on=True):
+    default_registry().enable_event_sampling(on)
+
+
+def chrome_counter_events(pid=None):
+    return default_registry().chrome_counter_events(pid)
+
+
+class RetraceLog:
+    """Record of every eager-cache miss that built a new executable:
+    op name + abstract input signature (shapes/dtypes/statics — never
+    values).  The analog of jax's ``jax_log_compiles`` made queryable:
+    a retrace storm (same op, ever-changing signatures) shows up as one
+    op with many entries instead of a silently slow step."""
+
+    MAX_ENTRIES = 10_000
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: dict[tuple, dict] = {}
+        self._dropped = 0
+
+    def record(self, op: str, signature: str):
+        key = (op, signature)
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None:
+                e["count"] += 1
+                e["last_time"] = time.time()
+                return
+            if len(self._entries) >= self.MAX_ENTRIES:
+                self._dropped += 1
+                return
+            self._entries[key] = {
+                "op": op, "signature": signature, "count": 1,
+                "first_time": time.time(), "last_time": time.time()}
+
+    def entries(self) -> list[dict]:
+        with self._lock:
+            return [dict(e) for e in self._entries.values()]
+
+    def by_op(self) -> dict[str, int]:
+        """op -> number of distinct signatures (retrace-storm ranking)."""
+        out: dict[str, int] = {}
+        for e in self.entries():
+            out[e["op"]] = out.get(e["op"], 0) + 1
+        return out
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+            self._dropped = 0
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+
+retrace_log = RetraceLog()
+
+
+def reset():
+    """Drop all metrics + retrace entries (tests / between runs)."""
+    default_registry().reset()
+    retrace_log.clear()
+
+
+def dump(dir_=None) -> str | None:
+    """Write the registry as ``metrics.prom`` + ``metrics.json`` and the
+    retrace log as ``retraces.json`` into ``dir_`` (default:
+    ``FLAGS_metrics_dir``).  Returns the directory, or None when no
+    directory is configured."""
+    if dir_ is None:
+        from ..flags import FLAGS
+        dir_ = FLAGS.get("FLAGS_metrics_dir") or None
+    if not dir_:
+        return None
+    os.makedirs(dir_, exist_ok=True)
+    reg = default_registry()
+    with open(os.path.join(dir_, "metrics.prom"), "w") as f:
+        f.write(reg.to_prometheus())
+    with open(os.path.join(dir_, "metrics.json"), "w") as f:
+        f.write(reg.to_json(indent=2))
+    with open(os.path.join(dir_, "retraces.json"), "w") as f:
+        json.dump({"entries": retrace_log.entries(),
+                   "by_op": retrace_log.by_op()}, f, indent=2)
+    return dir_

@@ -21,6 +21,7 @@ from jax.tree_util import tree_flatten, tree_unflatten
 
 from ..autograd import tape
 from ..profiler import statistic as _stat
+from .. import observability as _obs
 
 __all__ = ["op", "OPS", "apply_op"]
 
@@ -81,6 +82,43 @@ EAGER_CACHE_ENABLED = True
 _EAGER_CACHE: dict = {}           # signature -> jitted callable
 _EAGER_CACHE_MAX = 4096
 _UNCACHEABLE: set = set()         # ops that consume eager RNG / fail trace
+
+# cache observability: pre-bound children so the hit path pays one lock
+# + one float add (see observability/registry.py); the retrace log makes
+# a recompilation storm visible (op + abstract signature per miss)
+_M_HITS = _obs.counter(
+    "eager_cache_hits_total", "eager dispatch cache hits")
+_M_MISSES = _obs.counter(
+    "eager_cache_misses_total",
+    "eager dispatch cache misses that traced a new executable")
+_M_EVICTIONS = _obs.counter(
+    "eager_cache_evictions_total", "eager dispatch cache evictions")
+_M_UNCACHEABLE = _obs.counter(
+    "eager_cache_uncacheable_total",
+    "dispatches that could not use the eager cache", ("reason",))
+_M_SIZE = _obs.gauge(
+    "eager_cache_size", "live entries in the eager dispatch cache")
+_M_RETRACES = _obs.counter(
+    "eager_cache_retraces_total",
+    "new-signature traces per op (retrace-log entries)", ("op",))
+
+
+def _sig_repr(sig_parts):
+    """Human-readable abstract signature for the retrace log: shapes,
+    dtypes, diff flags, and static fingerprints — never values."""
+    out = []
+    for p in sig_parts:
+        if not isinstance(p, tuple):
+            continue
+        if p[0] == "t":
+            _, shape, dt, diff = p
+            out.append(f"{dt}{list(shape)}{'∂' if diff else ''}")
+        elif p[0] == "a":
+            _, shape, dt = p
+            out.append(f"{dt}{list(shape)}")
+        elif p[0] == "s":
+            out.append(f"s:{p[1]!r}")
+    return ", ".join(out)
 
 
 class _Unhashable(Exception):
@@ -161,6 +199,7 @@ def _eager_cached_call(opname, body, flat, treedef, t_idx, diff_flags,
             else:
                 sig_parts.append(("s", _static_fingerprint(x)))
     except _Unhashable:
+        _M_UNCACHEABLE.labels("unhashable-static").inc()
         return None
     sig = tuple(sig_parts)
 
@@ -202,16 +241,24 @@ def _eager_cached_call(opname, body, flat, treedef, t_idx, diff_flags,
                 result = fn(tuple(dyn_vals))
             if w.used:
                 _UNCACHEABLE.add(opname)
+                _M_UNCACHEABLE.labels("eager-rng").inc()
                 gen._key = key_before
                 return None
         except Exception:
             _UNCACHEABLE.add(opname)
+            _M_UNCACHEABLE.labels("trace-failure").inc()
             gen._key = key_before
             return None
         if len(_EAGER_CACHE) >= _EAGER_CACHE_MAX:
             _EAGER_CACHE.pop(next(iter(_EAGER_CACHE)))
+            _M_EVICTIONS.inc()
         _EAGER_CACHE[sig] = fn
+        _M_MISSES.inc()
+        _M_RETRACES.labels(opname).inc()
+        _obs.retrace_log.record(opname, _sig_repr(sig_parts))
+        _M_SIZE.set(len(_EAGER_CACHE))
         return result
+    _M_HITS.inc()
     return fn(tuple(dyn_vals))
 
 

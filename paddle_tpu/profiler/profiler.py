@@ -49,11 +49,33 @@ def _enable_host_tracing_impl(on: bool) -> bool:
 
 def export_host_trace(path: str) -> bool:
     """Write collected host spans as chrome://tracing JSON (analog of
-    chrometracing_logger.cc)."""
+    chrometracing_logger.cc).  Sampled observability counters (metric
+    changes recorded while a profiler was recording) are merged in as
+    "C"-phase events — the native tracer and the registry both stamp
+    CLOCK_MONOTONIC (steady_clock / perf_counter), so spans and counter
+    tracks line up on one timeline."""
+    from .. import observability as _obs
+    counters = _obs.chrome_counter_events(os.getpid())
     lib = _native()
     if lib is None:
-        return False
-    return lib.pt_trace_export(path.encode(), os.getpid()) == 0
+        if not counters:
+            return False
+        import json
+        with open(path, "w") as f:
+            json.dump({"traceEvents": counters}, f)
+        return True
+    ok = lib.pt_trace_export(path.encode(), os.getpid()) == 0
+    if ok and counters:
+        import json
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            doc.setdefault("traceEvents", []).extend(counters)
+            with open(path, "w") as f:
+                json.dump(doc, f)
+        except (OSError, ValueError):    # leave the native export as-is
+            pass
+    return ok
 
 
 def host_trace_event_count() -> int:
@@ -203,6 +225,7 @@ class Profiler:
         return self._scheduler(self._step)
 
     def _start_trace(self):
+        from .. import observability as _obs
         out = self._export_dir or os.path.join(tempfile.gettempdir(),
                                                "paddle_tpu_trace")
         try:
@@ -210,12 +233,16 @@ class Profiler:
             self._recording = True
         except Exception:
             self._recording = False
+        # counter tracks sample over the same recording window
+        _obs.enable_event_sampling(self._recording)
 
     def _stop_trace(self):
+        from .. import observability as _obs
         try:
             jax.profiler.stop_trace()
         finally:
             self._recording = False
+            _obs.enable_event_sampling(False)
 
     def __enter__(self):
         self.start()
@@ -288,3 +315,18 @@ class RecordEvent:
 
     def __exit__(self, *exc):
         self.end()
+
+
+# FLAGS_host_trace=1 in the environment turns the native host tracer on
+# at import (the reference's FLAGS_enable_host_event_recorder_hook env
+# seeding) — failures (no g++ in a stripped container) stay soft.
+def _seed_host_tracing_from_flags():
+    from ..flags import FLAGS
+    if FLAGS.get("FLAGS_host_trace"):
+        try:
+            enable_host_tracing(True)
+        except Exception:   # pragma: no cover
+            pass
+
+
+_seed_host_tracing_from_flags()

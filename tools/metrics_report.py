@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Pretty-print an observability dump (observability.dump() output).
+
+Usage:
+    python tools/metrics_report.py <dump-dir | metrics.json> [--prom]
+
+Reads metrics.json (+ retraces.json when present) from the dump
+directory FLAGS_metrics_dir pointed at, and renders counters, gauges,
+histograms, and the retrace log as aligned tables.  --prom cats the
+raw Prometheus text instead (what a scraper would see).
+
+Works standalone — no paddle_tpu / jax import, so it can run against a
+dump copied off a training host.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _load(path):
+    if os.path.isdir(path):
+        json_path = os.path.join(path, "metrics.json")
+        retr_path = os.path.join(path, "retraces.json")
+        prom_path = os.path.join(path, "metrics.prom")
+    else:
+        json_path = path
+        retr_path = os.path.join(os.path.dirname(path), "retraces.json")
+        prom_path = os.path.join(os.path.dirname(path), "metrics.prom")
+    if not os.path.exists(json_path):
+        sys.exit(f"metrics_report: no metrics.json at {json_path!r} "
+                 f"(set FLAGS_metrics_dir and rerun, or pass the dump dir)")
+    with open(json_path) as f:
+        metrics = json.load(f)
+    retraces = None
+    if os.path.exists(retr_path):
+        with open(retr_path) as f:
+            retraces = json.load(f)
+    return metrics, retraces, prom_path
+
+
+def _fmt_value(v):
+    if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return f"{v:.6g}" if isinstance(v, float) else str(v)
+
+
+def _fmt_labels(labels):
+    return ",".join(f"{k}={v}" for k, v in labels.items()) if labels else "-"
+
+
+def _table(rows, headers):
+    widths = [max(len(str(r[i])) for r in rows + [headers])
+              for i in range(len(headers))]
+    def line(r):
+        return "  ".join(str(c).ljust(w) for c, w in zip(r, widths)).rstrip()
+    out = [line(headers), line(["-" * w for w in widths])]
+    out += [line(r) for r in rows]
+    return "\n".join(out)
+
+
+def _histogram_block(name, entry):
+    lines = [f"histogram {name}"]
+    for s in entry["series"]:
+        lbl = _fmt_labels(s.get("labels", {}))
+        count, total = s.get("count", 0), s.get("sum", 0.0)
+        avg = total / count if count else 0.0
+        lines.append(f"  [{lbl}] count={count} sum={total:.6g} "
+                     f"avg={avg:.6g}")
+        prev = 0
+        for le, c in s.get("buckets", []):
+            if c == prev:
+                continue        # only show populated buckets
+            le_s = "+Inf" if le == "+Inf" else f"{le:g}"
+            bar = "#" * min(40, int(40 * (c - prev) / max(1, count)))
+            lines.append(f"    le={le_s:>8}: {c - prev:>8}  {bar}")
+            prev = c
+    return "\n".join(lines)
+
+
+def report(metrics, retraces):
+    simple_rows = {"counter": [], "gauge": []}
+    hist_blocks = []
+    for name, entry in sorted(metrics.items()):
+        kind = entry.get("type")
+        if kind == "histogram":
+            hist_blocks.append(_histogram_block(name, entry))
+            continue
+        for s in entry.get("series", []):
+            simple_rows[kind].append(
+                (name, _fmt_labels(s.get("labels", {})),
+                 _fmt_value(s.get("value", 0))))
+    out = []
+    for kind, title in (("counter", "Counters"), ("gauge", "Gauges")):
+        if simple_rows[kind]:
+            out += [title, _table(simple_rows[kind],
+                                  ("name", "labels", "value")), ""]
+    if hist_blocks:
+        out += ["Histograms"] + hist_blocks + [""]
+    if retraces and retraces.get("entries"):
+        entries = sorted(retraces["entries"],
+                         key=lambda e: (-e["count"], e["op"]))
+        out += ["Retrace log (one row per new eager-cache signature)",
+                _table([(e["op"], e["count"], e["signature"])
+                        for e in entries],
+                       ("op", "hits", "abstract signature")), ""]
+        by_op = retraces.get("by_op") or {}
+        storms = {k: v for k, v in by_op.items() if v > 3}
+        if storms:
+            out.append("retrace storms (>3 distinct signatures): " +
+                       ", ".join(f"{k}={v}"
+                                 for k, v in sorted(storms.items())))
+    return "\n".join(out).rstrip() or "empty dump"
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="dump directory or metrics.json path")
+    ap.add_argument("--prom", action="store_true",
+                    help="print the raw Prometheus text export")
+    args = ap.parse_args(argv)
+    metrics, retraces, prom_path = _load(args.path)
+    if args.prom:
+        if not os.path.exists(prom_path):
+            sys.exit(f"metrics_report: no metrics.prom at {prom_path!r}")
+        with open(prom_path) as f:
+            print(f.read(), end="")
+        return 0
+    print(report(metrics, retraces))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
