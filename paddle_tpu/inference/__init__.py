@@ -164,6 +164,15 @@ def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
 
 
+def create_engine(model, **kwargs):
+    """Predictor-style entry to the continuous-batching LLM serving
+    engine (paddle_tpu/serving/): one engine serves many concurrent
+    generation requests over a shared paged KV pool.  See
+    :func:`paddle_tpu.serving.create_engine` for the knobs."""
+    from ..serving import create_engine as _create
+    return _create(model, **kwargs)
+
+
 class DataType:
     """Tensor dtypes of the inference API (reference
     paddle_infer.DataType)."""
@@ -190,12 +199,29 @@ class XpuConfig:
 
 class PredictorPool:
     """Pool of predictors over one config (reference
-    paddle_infer.PredictorPool)."""
+    paddle_infer.PredictorPool).
+
+    Pool members are clones of one base predictor: they share the loaded
+    weights, program, and executor compile cache (one jit executable per
+    feed signature for the WHOLE pool), with private I/O buffers — the
+    reference Clone() contract.  Building N independent predictors would
+    reload and recompile N times."""
 
     def __init__(self, config, size=1):
-        self._predictors = [create_predictor(config) for _ in range(size)]
+        if size < 1:
+            raise ValueError(f"PredictorPool size must be >= 1, got {size}")
+        base = create_predictor(config)
+        self._predictors = [base] + [base.clone() for _ in range(size - 1)]
+
+    def size(self):
+        return len(self._predictors)
 
     def retrieve(self, idx):
+        if not 0 <= idx < len(self._predictors):
+            raise IndexError(
+                f"PredictorPool.retrieve({idx}): pool holds "
+                f"{len(self._predictors)} predictors (valid indices "
+                f"0..{len(self._predictors) - 1})")
         return self._predictors[idx]
 
 
@@ -219,27 +245,131 @@ def get_num_bytes_of_data_type(dtype):
     return sizes.get(dtype, 4)
 
 
+def _walk_refs(obj, params, vars_):
+    """Collect ("__param__", i) indices and ("__var__", name) references
+    from a pickled node's stripped args/kwargs tree."""
+    if isinstance(obj, tuple) and len(obj) == 2:
+        if obj[0] == "__param__":
+            params.add(obj[1])
+            return
+        if obj[0] == "__var__":
+            vars_.add(obj[1])
+            return
+    if isinstance(obj, (list, tuple)):
+        for x in obj:
+            _walk_refs(x, params, vars_)
+    elif isinstance(obj, dict):
+        for x in obj.values():
+            _walk_refs(x, params, vars_)
+
+
+def _io_and_named_params(model_file):
+    """From a saved .pdmodel.pkl: (io_param_indices, param_index ->
+    names of the graph vars whose op consumes it).  io params are the
+    ones the feed-consuming and fetch-producing ops read — keeping them
+    fp32 keeps the model's I/O tensors fp32 (dtype promotion: an fp32
+    operand makes the op compute/emit fp32)."""
+    import pickle
+    with open(model_file, "rb") as f:
+        meta = pickle.load(f)
+    feeds = set(meta.get("feeds", ()))
+    node_params: dict[str, set] = {}
+    node_vars: dict[str, set] = {}
+    for name, nd in meta["nodes"].items():
+        p, v = set(), set()
+        if not nd.get("feed"):
+            _walk_refs(nd.get("args"), p, v)
+            _walk_refs(nd.get("kwargs"), p, v)
+        node_params[name] = p
+        node_vars[name] = v
+    io = set()
+    for name in meta.get("fetches", ()):
+        io |= node_params.get(name, set())
+    for name, v in node_vars.items():
+        if v & feeds:
+            io |= node_params[name]
+    names: dict[int, set] = {}
+    for name, p in node_params.items():
+        for i in p:
+            names.setdefault(i, set()).add(name)
+    return io, names
+
+
 def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
                                mixed_params_file, mixed_precision=None,
                                backend=None, keep_io_types=True,
                                black_list=None, **kwargs):
     """Offline precision conversion (reference
-    paddle.inference.convert_to_mixed_precision): rewrites a saved
-    state dict to bf16/fp16."""
-    import numpy as np
+    paddle.inference.convert_to_mixed_precision): rewrites saved
+    parameters to bf16/fp16.
+
+    Handles both artifact formats: ``save_inference_model`` output
+    (``.pdiparams.npz`` + ``.pdmodel.pkl``) and plain ``paddle.save``
+    state-dict pickles.
+
+    ``black_list``: parameter/tensor names kept at their original dtype.
+    Entries match state-dict keys, npz keys (``p<i>``), or — for the
+    inference-model format — the graph-var names of ops consuming the
+    parameter (the reference's op-level blacklist).
+
+    ``keep_io_types``: ``True`` keeps the parameters of feed-consuming
+    and fetch-producing ops fp32, so model inputs/outputs stay fp32
+    (requires the graph in ``model_file``; a plain state dict has no
+    I/O notion and True is a no-op there).  A collection is treated as
+    explicit tensor names to keep, same matching as ``black_list``."""
+    import shutil
+
     import ml_dtypes
-    from ..framework.io import load, save
-    state = load(params_file)
+    import numpy as np
+
     target = ml_dtypes.bfloat16 if mixed_precision in (None, "bfloat16", 6) \
         else np.float16
-    out = {}
-    for k, v in state.items():
-        arr = v.numpy() if hasattr(v, "numpy") else np.asarray(v)
-        if np.issubdtype(np.asarray(arr).dtype, np.floating):
-            arr = np.asarray(arr).astype(target)
-        out[k] = arr
-    save(out, mixed_params_file)
-    import shutil
+    black = set(black_list or ())
+    keep_names = set() if isinstance(keep_io_types, bool) \
+        else set(keep_io_types)
+
+    def convert(arr):
+        arr = np.asarray(arr)
+        if np.issubdtype(arr.dtype, np.floating) \
+                and arr.dtype == np.float32:
+            return arr.astype(target)
+        return arr
+
+    try:                                    # inference-model npz format?
+        pz = np.load(params_file)
+        is_npz = True
+    except Exception:
+        is_npz = False
+
+    if is_npz:
+        from .. import static as _static
+        io_params, consumer_names = _io_and_named_params(model_file) \
+            if keep_io_types is True or black or keep_names \
+            else (set(), {})
+        n = _static._npz_param_count(pz)
+        out = {}
+        for i in range(n):
+            key = f"p{i}"
+            arr = _static._npz_unpack(pz, key)
+            matched = ({key} | consumer_names.get(i, set()))
+            keep = bool(matched & black) or bool(matched & keep_names) \
+                or (keep_io_types is True and i in io_params)
+            out[key] = np.asarray(arr) if keep else convert(arr)
+        # np.savez appends .npz only when the name lacks it — either way
+        # the artifact lands at the caller's requested path
+        np.savez(mixed_params_file, **_static._npz_pack(out))
+    else:                                   # paddle.save state dict
+        from ..framework.io import load, save
+        state = load(params_file)
+        out = {}
+        for k, v in state.items():
+            arr = v.numpy() if hasattr(v, "numpy") else np.asarray(v)
+            if k in black or k in keep_names:
+                out[k] = np.asarray(arr)
+            else:
+                out[k] = convert(arr)
+        save(out, mixed_params_file)
+
     if model_file != mixed_model_file:
         shutil.copy(model_file, mixed_model_file)
 
@@ -250,7 +380,8 @@ def _get_phi_kernel_name(op_name):
     return op_name
 
 
-__all__ += ["DataType", "XpuConfig", "PredictorPool", "get_version",
+__all__ += ["DataType", "XpuConfig", "PredictorPool", "create_engine",
+            "get_version",
             "get_trt_compile_version", "get_trt_runtime_version",
             "get_num_bytes_of_data_type", "convert_to_mixed_precision",
             "_get_phi_kernel_name"]

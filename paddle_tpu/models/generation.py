@@ -264,13 +264,9 @@ def _decode_layer_paged(w, x, kpool, vpool, table, cos1, sin1, pos,
     kpool = kpool.at[page[:, None], heads[None, :], off[:, None]].set(k)
     vpool = vpool.at[page[:, None], heads[None, :], off[:, None]].set(v)
 
-    from ..ops.pallas.paged_attention import (paged_attention,
-                                              paged_attention_xla,
-                                              _INTERPRET)
-    fn = paged_attention if (
-        jax.default_backend() not in ("cpu",) or _INTERPRET) \
-        else paged_attention_xla
-    attn = fn(q, kpool, vpool, table, pos + 1).reshape(b, nh * hd)
+    from ..ops.pallas.paged_attention import select_paged_attention
+    attn = select_paged_attention()(
+        q, kpool, vpool, table, pos + 1).reshape(b, nh * hd)
     x = x + _mm(attn, w["o"])
     h = _rms(x[:, None], w["ln2"], cfg.rms_norm_eps)[:, 0]
     return (x + _mm(jax.nn.silu(_mm(h, w["gate"])) * _mm(h, w["up"]),

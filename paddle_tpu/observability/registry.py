@@ -24,11 +24,18 @@ import threading
 import time
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "default_registry"]
+           "default_registry", "SERVING_LATENCY_BUCKETS"]
 
 # Prometheus-conventional default buckets (seconds-scale latencies).
 DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                    0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+# Serving-latency buckets (TTFT / per-output-token): finer sub-ms floor
+# than DEFAULT_BUCKETS — a decode step is tens of µs on-chip — while the
+# tail still resolves multi-second queueing delays.
+SERVING_LATENCY_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
 
 
 def _fmt_labels(labelnames, labelvalues):

@@ -31,9 +31,20 @@ import numpy as np
 
 from .flash_attention import NUM_LANES
 
-__all__ = ["paged_attention", "PagedPool"]
+__all__ = ["paged_attention", "PagedPool", "select_paged_attention"]
 
 _INTERPRET = False
+
+
+def select_paged_attention():
+    """The paged-attention callable for the active backend: the Pallas
+    scalar-prefetch kernel on TPU (or under interpret mode), the
+    dense-gather XLA reference on CPU.  Single chooser shared by the
+    one-shot paged generate and the serving engine so both always take
+    the same numeric path."""
+    if jax.default_backend() not in ("cpu",) or _INTERPRET:
+        return paged_attention
+    return paged_attention_xla
 
 
 def _paged_kernel(table_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,

@@ -1,0 +1,32 @@
+"""paddle_tpu.serving — continuous-batching LLM inference engine.
+
+Orca-style iteration-level scheduling over the paged KV machinery
+(ops/pallas/paged_attention.py + models/generation.py), the layer that
+turns "can run a batch" into "can serve traffic": requests are
+admitted, interleaved, streamed, and cancelled between single-token
+decode steps of ONE jitted program.
+
+    from paddle_tpu.serving import create_engine, GenerationConfig
+    engine = create_engine(model, max_slots=8, page_size=64)
+    req = engine.submit(prompt_ids, GenerationConfig(max_new_tokens=32))
+    for tok in req.stream():
+        ...
+
+Modules:
+  * request.py       — request lifecycle + streaming
+  * block_manager.py — KV-page free list / block tables / backpressure
+  * scheduler.py     — FCFS admission, iteration-level eviction, drain
+  * engine.py        — the jitted prefill/decode driver
+
+Reference analog: the block_multi_head_attention serving path +
+paddle_infer predictors, restructured as a vLLM/Orca-style engine.
+"""
+from __future__ import annotations
+
+from .block_manager import BlockManager  # noqa: F401
+from .engine import Engine, create_engine  # noqa: F401
+from .request import GenerationConfig, Request, RequestState  # noqa: F401
+from .scheduler import Scheduler  # noqa: F401
+
+__all__ = ["BlockManager", "Engine", "GenerationConfig", "Request",
+           "RequestState", "Scheduler", "create_engine"]

@@ -1,0 +1,112 @@
+"""KV-page allocator for the serving engine.
+
+Reference analog: the block tables fed to
+block_multi_head_attention_kernel.cu — each sequence owns a list of
+fixed-size pages in one shared pool, so HBM scales with the tokens
+actually resident, not batch * max_len.
+
+Unlike :class:`~paddle_tpu.ops.pallas.paged_attention.PagedPool` (which
+reserves pages for ONE static batch up front), this manager serves a
+changing request population: pages cycle through a free list as
+requests are admitted and evicted, and an allocation that does not fit
+returns ``None`` — backpressure the scheduler turns into queueing,
+never an exception out of the engine.
+
+The dump-page convention matches the paged kernel's contract: page id
+``num_pages`` is a shared scratch page that absorbs writes through
+table padding; it is never handed to a sequence.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import observability as _obs
+
+__all__ = ["BlockManager"]
+
+_M_PAGES_IN_USE = _obs.gauge(
+    "serving_pages_in_use", "KV pages currently owned by live sequences")
+_M_PAGES_TOTAL = _obs.gauge(
+    "serving_pages_total", "allocatable KV pages in the engine pool")
+
+
+class BlockManager:
+    """Free-list page allocator + per-sequence block tables.
+
+    ``num_pages`` is the number of *allocatable* pages; the pool arrays
+    the engine builds must hold ``num_pages + 1`` rows (the extra row is
+    the dump page, :attr:`dump_page`).
+    """
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages < 1:
+            raise ValueError(f"num_pages must be >= 1, got {num_pages}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.dump_page = self.num_pages       # pool row past the real pages
+        # FIFO reuse keeps page churn spread across the pool
+        self._free: list[int] = list(range(self.num_pages))
+        self._tables: dict[int, list[int]] = {}   # seq id -> owned pages
+        _M_PAGES_TOTAL.set(self.num_pages)
+        _M_PAGES_IN_USE.set(0)
+
+    # ------------------------------------------------------------- sizing
+    def pages_needed(self, prompt_len: int, max_new_tokens: int) -> int:
+        """Pages a request reserves for its whole lifetime (prompt +
+        every token it may generate) — admission is all-or-nothing, so
+        an admitted request can never hit pool exhaustion mid-decode."""
+        return -(-(int(prompt_len) + int(max_new_tokens)) // self.page_size)
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.num_pages - len(self._free)
+
+    def can_allocate(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    # --------------------------------------------------------- alloc/free
+    def allocate(self, seq_id: int, n: int):
+        """Reserve ``n`` pages for ``seq_id``.  Returns the page-id list,
+        or ``None`` when the pool cannot satisfy the request
+        (backpressure — the caller keeps the request queued)."""
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id} already owns pages")
+        if n > len(self._free):
+            return None
+        pages, self._free = self._free[:n], self._free[n:]
+        self._tables[seq_id] = pages
+        _M_PAGES_IN_USE.set(self.pages_in_use)
+        return list(pages)
+
+    def free_seq(self, seq_id: int):
+        """Return ``seq_id``'s pages to the free list (idempotent)."""
+        pages = self._tables.pop(seq_id, None)
+        if pages:
+            self._free.extend(pages)
+        _M_PAGES_IN_USE.set(self.pages_in_use)
+
+    def pages_of(self, seq_id: int):
+        return list(self._tables.get(seq_id, ()))
+
+    # ------------------------------------------------------------- tables
+    def table_row(self, seq_id: int, width: int) -> np.ndarray:
+        """The sequence's block-table row, dump-padded to ``width``
+        (the engine's static table shape)."""
+        pages = self._tables.get(seq_id, ())
+        if len(pages) > width:
+            raise ValueError(
+                f"sequence {seq_id} owns {len(pages)} pages, table width "
+                f"is only {width}")
+        row = np.full((width,), self.dump_page, np.int32)
+        row[:len(pages)] = pages
+        return row
+
+    def empty_row(self, width: int) -> np.ndarray:
+        """An all-dump row (idle slots write/read only the dump page)."""
+        return np.full((width,), self.dump_page, np.int32)

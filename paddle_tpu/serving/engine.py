@@ -1,0 +1,436 @@
+"""Continuous-batching inference engine over the paged KV pool.
+
+The design that turns the paged kernels into a serving system (Orca's
+iteration-level scheduling over vLLM-style PagedAttention, mapped onto
+the reference block_multi_head_attention serving path):
+
+  * ONE jitted single-token decode step over a fixed number of decode
+    slots and one shared page pool.  Slot occupancy, positions, and
+    block tables are *data* (int32 arrays), never shapes — admitting or
+    evicting a request between steps re-traces nothing.  The step
+    reuses ``_decode_layer_paged`` from ``models/generation.py``
+    verbatim, so engine numerics match the one-shot
+    ``build_generate_fn_paged`` token for token under greedy decoding.
+  * prefill-on-admit: an admitted request's prompt runs through
+    ``_prefill_layer`` (padded to a page-multiple bucket; one trace per
+    bucket) and pages its KV straight into the shared pool; the token
+    sampled from the prompt's last logits is the request's first output
+    (its TTFT mark).
+  * idle slots park on the dump page (table row all-dump, pos 0): their
+    lockstep writes land in scratch, their outputs are discarded
+    host-side — no masking inside the program.
+
+Sampling is host-side per request (greedy = argmax of the step's f32
+logits, matching ``_sample``'s greedy branch exactly; stochastic
+requests draw from a per-request numpy RNG so results do not depend on
+batch composition).  Set ``emit_logits=True`` at engine construction to
+serve ``do_sample`` requests — the step then returns the [slots, V]
+logits each iteration.
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import observability as _obs
+from ..models.generation import (GenerationConfig, _decode_layer_paged,
+                                 _layer_weights, _mm, _prefill_layer,
+                                 _rope_at)
+from ..models.llama import LlamaConfig, _rope_tables
+from ..models.llama_hybrid import _rms
+from .block_manager import BlockManager
+from .request import Request, RequestState
+from .scheduler import Scheduler
+
+__all__ = ["Engine", "create_engine"]
+
+_M_STEP_TRACES = _obs.counter(
+    "serving_decode_step_traces_total",
+    "decode-step jit traces — continuous batching keeps this at 1 per "
+    "engine; growth means admissions are re-tracing")
+_M_PREFILL_TRACES = _obs.counter(
+    "serving_prefill_traces_total",
+    "prefill jit traces (one per prompt-length bucket)", ("bucket",))
+_M_STEPS = _obs.counter(
+    "serving_decode_steps_total", "engine decode iterations")
+_M_TOKENS = _obs.counter(
+    "serving_tokens_total", "tokens emitted to requests")
+_M_REQUESTS = _obs.counter(
+    "serving_requests_total", "finished requests", ("outcome",))
+
+
+def _serving_hists():
+    buckets = _obs.registry.SERVING_LATENCY_BUCKETS
+    ttft = _obs.histogram(
+        "serving_ttft_seconds", "request arrival -> first token",
+        buckets=buckets)
+    tpot = _obs.histogram(
+        "serving_tpot_seconds", "inter-token latency during decode",
+        buckets=buckets)
+    e2e = _obs.histogram(
+        "serving_e2e_seconds", "request arrival -> completion",
+        buckets=buckets)
+    return ttft, tpot, e2e
+
+
+class Engine:
+    """Drives admission, prefill, and the shared decode step.
+
+    Static shapes (fixed at construction — the no-retrace contract):
+    ``max_slots`` decode slots, ``table_width`` pages per sequence,
+    ``num_pages (+ dump)`` pool rows, and the per-bucket prefill widths.
+    Everything per-request is data.
+    """
+
+    def __init__(self, model=None, *, config: LlamaConfig = None,
+                 state: dict | None = None, max_slots: int = 4,
+                 page_size: int = 64, num_pages: int | None = None,
+                 max_model_len: int | None = None,
+                 emit_logits: bool = False, clock=time.monotonic):
+        if model is not None:
+            from ..framework.tensor import Tensor
+            config = model.config
+            state = {k: (v._data if isinstance(v, Tensor) else v)
+                     for k, v in model.functional_state().items()}
+        if config is None or state is None:
+            raise ValueError("pass a model, or both config= and state=")
+        self.config = config
+        self.state = state
+        self.max_slots = int(max_slots)
+        self.page_size = int(page_size)
+        self.max_model_len = int(max_model_len
+                                 or config.max_position_embeddings)
+        if self.max_model_len > config.max_position_embeddings:
+            raise ValueError(
+                f"max_model_len {self.max_model_len} exceeds the model's "
+                f"max_position_embeddings {config.max_position_embeddings}")
+        self.table_width = -(-self.max_model_len // self.page_size)
+        if num_pages is None:       # full residency: every slot can run
+            num_pages = self.max_slots * self.table_width  # at max length
+        self.emit_logits = bool(emit_logits)
+        self._clock = clock
+
+        self.blocks = BlockManager(num_pages, self.page_size)
+        self.scheduler = Scheduler(self.blocks, self.max_slots)
+        self.scheduler._finalize = self._finalize
+
+        L = config.num_hidden_layers
+        kvh, hd = config.num_key_value_heads, config.head_dim
+        dtype = state["llama.embed_tokens.weight"].dtype
+        pool_rows = self.blocks.num_pages + 1        # + dump page
+        self.kpool = jnp.zeros((L, pool_rows, kvh, self.page_size, hd),
+                               dtype)
+        self.vpool = jnp.zeros((L, pool_rows, kvh, self.page_size, hd),
+                               dtype)
+        rope_len = self.table_width * self.page_size
+        cos, sin = _rope_tables(rope_len, hd, config.rope_theta)
+        self._cos = cos.astype(jnp.float32)
+        self._sin = sin.astype(jnp.float32)
+
+        # host-side slot state (shipped to device each step; tiny)
+        self.table = np.tile(self.blocks.empty_row(self.table_width),
+                             (self.max_slots, 1))
+        self._pos = np.zeros((self.max_slots,), np.int32)
+        self._tok = np.zeros((self.max_slots,), np.int32)
+
+        self.decode_traces = 0      # python-side mirror of _M_STEP_TRACES
+        self._rngs: dict[int, np.random.Generator] = {}
+        self._ttft, self._tpot, self._e2e = _serving_hists()
+        self._pages_hist = _obs.histogram(
+            "serving_pages_in_use_hist",
+            "pages-in-use sampled at each decode step",
+            buckets=_pages_buckets(self.blocks.num_pages))
+
+        self._step_fn = jax.jit(self._build_step(), donate_argnums=(1, 2))
+        self._prefill_fns: dict[int, object] = {}   # bucket -> jitted fn
+
+    # ------------------------------------------------------ jitted bodies
+    def _build_step(self):
+        cfg = self.config
+        L = cfg.num_hidden_layers
+        emit_logits = self.emit_logits
+        engine = self
+
+        def step(state, kpool, vpool, table, pos, tok, cos, sin):
+            # python body runs at trace time only: a second execution of
+            # this line means an admission/eviction re-traced the step
+            engine.decode_traces += 1
+            _M_STEP_TRACES.inc()
+            emb = jnp.take(state["llama.embed_tokens.weight"], tok, axis=0)
+            cos1, sin1 = _rope_at(cos, sin, pos)
+            h = emb
+            kps, vps = [], []
+            for i in range(L):
+                w = _layer_weights(state, i)
+                h, kp_, vp_ = _decode_layer_paged(
+                    w, h, kpool[i], vpool[i], table, cos1, sin1, pos, cfg)
+                kps.append(kp_)
+                vps.append(vp_)
+            kpool = jnp.stack(kps)
+            vpool = jnp.stack(vps)
+            h = _rms(h[:, None], state["llama.norm.weight"],
+                     cfg.rms_norm_eps)[:, 0]
+            logits = _logits_of(state, h).astype(jnp.float32)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (kpool, vpool, nxt,
+                    logits if emit_logits else jnp.zeros((), jnp.float32))
+
+        return step
+
+    def _prefill_fn(self, bucket: int):
+        fn = self._prefill_fns.get(bucket)
+        if fn is not None:
+            return fn
+        cfg = self.config
+        L = cfg.num_hidden_layers
+        ps = self.page_size
+        n_pages = bucket // ps
+
+        def prefill(state, ids, length, table_row, kpool, vpool, cos, sin):
+            _M_PREFILL_TRACES.labels(str(bucket)).inc()
+            x = jnp.take(state["llama.embed_tokens.weight"], ids, axis=0)
+            pmask = jnp.arange(bucket)[None, :] < length
+            for i in range(L):
+                w = _layer_weights(state, i)
+                x, k, v = _prefill_layer(w, x, cos[:bucket], sin[:bucket],
+                                         pmask, cfg)
+                for p in range(n_pages):
+                    rows_k = k[0, p * ps:(p + 1) * ps].swapaxes(0, 1)
+                    rows_v = v[0, p * ps:(p + 1) * ps].swapaxes(0, 1)
+                    kpool = kpool.at[i, table_row[p]].set(rows_k)
+                    vpool = vpool.at[i, table_row[p]].set(rows_v)
+            x = _rms(x, state["llama.norm.weight"], cfg.rms_norm_eps)
+            last = jnp.take_along_axis(
+                x, (length - 1)[:, None, None].astype(jnp.int32),
+                axis=1)[:, 0]
+            logits = _logits_of(state, last).astype(jnp.float32)
+            return kpool, vpool, logits
+
+        fn = jax.jit(prefill, donate_argnums=(4, 5))
+        self._prefill_fns[bucket] = fn
+        return fn
+
+    # ----------------------------------------------------------- intake
+    def submit(self, prompt, gen: GenerationConfig | None = None, *,
+               deadline: float | None = None, on_token=None,
+               arrival_time: float | None = None) -> Request:
+        req = Request(prompt, gen, deadline=deadline, on_token=on_token,
+                      arrival_time=(self._clock() if arrival_time is None
+                                    else arrival_time))
+        total = req.prompt.size + req.gen.max_new_tokens
+        if total > self.max_model_len:
+            raise ValueError(
+                f"prompt ({req.prompt.size}) + max_new_tokens "
+                f"({req.gen.max_new_tokens}) = {total} exceeds "
+                f"max_model_len {self.max_model_len}")
+        need = self.blocks.pages_needed(req.prompt.size,
+                                        req.gen.max_new_tokens)
+        if need > self.blocks.num_pages:
+            raise ValueError(
+                f"request needs {need} KV pages but the pool only has "
+                f"{self.blocks.num_pages}; it could never be admitted "
+                "(raise num_pages or lower max_new_tokens)")
+        if req.gen.do_sample and not self.emit_logits:
+            raise ValueError(
+                "do_sample requests need an engine built with "
+                "emit_logits=True (host-side sampling reads the logits)")
+        req._engine = self
+        self.scheduler.submit(req)
+        return req
+
+    # -------------------------------------------------------- main loop
+    def step(self) -> bool:
+        """One engine iteration: evict/admit (scheduler pass), prefill
+        admissions, then one lockstep decode step over the active slots.
+        Returns whether any work happened."""
+        now = self._clock()
+        admitted = self.scheduler.schedule(now)
+        for slot, req in admitted:
+            self._prefill(slot, req)
+        active = [i for i, r in enumerate(self.scheduler.slots)
+                  if r is not None and r.state == RequestState.DECODE]
+        if active:
+            self._decode(active)
+        return bool(admitted) or bool(active)
+
+    def run_until_complete(self, max_steps: int | None = None):
+        """Drive step() until no live or queued work remains."""
+        steps = 0
+        while self.scheduler.has_work():
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                raise RuntimeError(
+                    f"engine did not quiesce within {max_steps} steps")
+
+    def drain(self):
+        """Graceful drain: stop admitting; finish what is running.
+        Queued requests stay queued until :meth:`resume`."""
+        self.scheduler.drain()
+        while self.scheduler.active_count:
+            self.step()
+
+    def resume(self):
+        self.scheduler.resume()
+
+    # ----------------------------------------------------------- prefill
+    def _prefill(self, slot: int, req: Request):
+        ps = self.page_size
+        plen = req.prompt.size
+        bucket = -(-plen // ps) * ps
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :plen] = req.prompt
+        row = self.blocks.table_row(req.id, self.table_width)
+        fn = self._prefill_fn(bucket)
+        self.kpool, self.vpool, logits = fn(
+            self.state, jnp.asarray(ids),
+            jnp.asarray([plen], jnp.int32),
+            jnp.asarray(row[:bucket // ps]),
+            self.kpool, self.vpool, self._cos, self._sin)
+        tok = self._pick_token(req, np.asarray(logits)[0])
+        now = self._clock()
+        self._ttft.observe(now - req.arrival_time)
+        self.table[slot] = row
+        self._pos[slot] = plen
+        self._tok[slot] = tok
+        req.state = RequestState.DECODE
+        self._emit(slot, req, tok, now)
+
+    # ------------------------------------------------------------ decode
+    def _decode(self, active: list[int]):
+        self.kpool, self.vpool, nxt, logits = self._step_fn(
+            self.state, self.kpool, self.vpool,
+            jnp.asarray(self.table), jnp.asarray(self._pos),
+            jnp.asarray(self._tok), self._cos, self._sin)
+        _M_STEPS.inc()
+        self._pages_hist.observe(self.blocks.pages_in_use)
+        nxt = np.asarray(nxt)
+        logits = np.asarray(logits) if self.emit_logits else None
+        now = self._clock()
+        for slot in active:
+            req = self.scheduler.slots[slot]
+            if req.gen.do_sample:
+                tok = self._pick_token(req, logits[slot])
+            else:
+                tok = int(nxt[slot])
+            prev = req.last_token_at
+            if prev is not None:
+                self._tpot.observe(now - prev)
+            self._pos[slot] += 1
+            self._tok[slot] = tok
+            self._emit(slot, req, tok, now)
+
+    def _emit(self, slot: int, req: Request, tok: int, now: float):
+        req._emit(tok, now)
+        _M_TOKENS.inc()
+        eos = req.gen.eos_token_id
+        if req.num_generated >= req.gen.max_new_tokens:
+            self._finalize(req, "length", now)
+            self.scheduler.evict(slot, "finished", now)
+            self._park(slot)
+        elif eos is not None and tok == eos:
+            self._finalize(req, "eos", now)
+            self.scheduler.evict(slot, "finished", now)
+            self._park(slot)
+
+    def _park(self, slot: int):
+        """Return a slot to the idle state: all writes/reads go to the
+        dump page until the next admission."""
+        self.table[slot] = self.blocks.empty_row(self.table_width)
+        self._pos[slot] = 0
+        self._tok[slot] = 0
+
+    # --------------------------------------------------------- sampling
+    def _pick_token(self, req: Request, logits: np.ndarray) -> int:
+        g = req.gen
+        if not g.do_sample:
+            return int(np.argmax(logits))
+        rng = self._rngs.get(req.id)
+        if rng is None:
+            rng = self._rngs[req.id] = np.random.default_rng(
+                (g.seed, req.id))
+        logits = logits.astype(np.float64)
+        if g.temperature != 1.0:
+            logits = logits / max(g.temperature, 1e-6)
+        if g.top_k and g.top_k > 0:
+            k = min(g.top_k, logits.size)
+            kth = np.sort(logits)[-k]
+            logits = np.where(logits < kth, -np.inf, logits)
+        if g.top_p < 1.0:
+            order = np.argsort(logits)[::-1]
+            probs = _softmax(logits[order])
+            cum = np.cumsum(probs)
+            cutoff_idx = int(np.sum(cum < g.top_p))
+            cutoff = logits[order[min(cutoff_idx, logits.size - 1)]]
+            logits = np.where(logits < cutoff, -np.inf, logits)
+        return int(rng.choice(logits.size, p=_softmax(logits)))
+
+    # -------------------------------------------------------- lifecycle
+    def _finalize(self, req: Request, reason: str, now: float):
+        if req.is_finished():
+            return
+        req.finish_reason = reason
+        req.state = RequestState.CANCELLED \
+            if reason in ("cancelled", "deadline") else RequestState.DONE
+        req.finished_at = now
+        self._rngs.pop(req.id, None)
+        self._e2e.observe(now - req.arrival_time)
+        _M_REQUESTS.labels(reason).inc()
+
+    # -------------------------------------------------------------- info
+    def stats(self) -> dict:
+        return {
+            "queued": len(self.scheduler.queue),
+            "active": self.scheduler.active_count,
+            "pages_in_use": self.blocks.pages_in_use,
+            "pages_total": self.blocks.num_pages,
+            "decode_traces": self.decode_traces,
+            "prefill_buckets": sorted(self._prefill_fns),
+        }
+
+
+def _softmax(x):
+    x = x - np.max(x[np.isfinite(x)]) if np.isfinite(x).any() else x
+    e = np.exp(np.where(np.isfinite(x), x, -np.inf))
+    return e / e.sum()
+
+
+def _logits_of(state, h):
+    head = state.get("lm_head.weight")
+    if head is not None:
+        return _mm(h, head)
+    return h @ state["llama.embed_tokens.weight"].T
+
+
+def _pages_buckets(num_pages):
+    """Integer page-count buckets spanning the pool (pages-in-use is a
+    count, not a latency; the default ms-scale buckets would collapse)."""
+    n = max(num_pages, 1)
+    edges = sorted({max(1, round(n * f))
+                    for f in (0.125, 0.25, 0.375, 0.5, 0.625, 0.75,
+                              0.875, 1.0)})
+    return tuple(float(e) for e in edges)
+
+
+def create_engine(model, *, max_slots: int = 4, page_size: int = 64,
+                  num_pages: int | None = None,
+                  max_model_len: int | None = None,
+                  emit_logits: bool = False, clock=time.monotonic
+                  ) -> Engine:
+    """`create_predictor`-style entry point: build a continuous-batching
+    engine over a LlamaForCausalLM (or any model exposing ``config`` and
+    ``functional_state()`` with the llama state-dict layout).
+
+    Example::
+
+        engine = create_engine(model, max_slots=8, page_size=64)
+        req = engine.submit([1, 2, 3], GenerationConfig(max_new_tokens=32))
+        for tok in req.stream():
+            ...
+    """
+    return Engine(model, max_slots=max_slots, page_size=page_size,
+                  num_pages=num_pages, max_model_len=max_model_len,
+                  emit_logits=emit_logits, clock=clock)

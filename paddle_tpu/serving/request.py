@@ -1,0 +1,127 @@
+"""Request lifecycle for the continuous-batching engine.
+
+A request moves QUEUED -> PREFILL -> DECODE -> DONE (or CANCELLED from
+any live state).  Tokens stream out as they are sampled: consumers can
+poll :attr:`output_tokens`, register an ``on_token`` callback, or pull
+from :meth:`stream` (which drives the attached engine when it runs dry,
+so a plain ``for tok in req.stream():`` serves the request end to end).
+"""
+from __future__ import annotations
+
+import enum
+import itertools
+import threading
+import time
+
+import numpy as np
+
+from ..models.generation import GenerationConfig
+
+__all__ = ["Request", "RequestState", "GenerationConfig"]
+
+_ids = itertools.count()
+_ids_lock = threading.Lock()
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"
+    PREFILL = "prefill"
+    DECODE = "decode"
+    DONE = "done"
+    CANCELLED = "cancelled"
+
+
+class Request:
+    """One generation request.
+
+    ``gen`` is a per-request :class:`GenerationConfig` — each request
+    chooses its own ``max_new_tokens`` / ``eos_token_id`` / sampling
+    knobs; the engine batches them anyway (iteration-level scheduling:
+    the batch composition is a per-step decision, not a compile-time
+    one)."""
+
+    def __init__(self, prompt, gen: GenerationConfig | None = None, *,
+                 deadline: float | None = None, on_token=None,
+                 arrival_time: float | None = None):
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        gen = gen or GenerationConfig()
+        if gen.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        with _ids_lock:
+            self.id = next(_ids)
+        self.prompt = prompt
+        self.gen = gen
+        self.deadline = deadline          # absolute, on the engine clock
+        self.on_token = on_token
+        self.state = RequestState.QUEUED
+        self.cancel_requested = False
+        self.finish_reason: str | None = None   # length|eos|cancelled|deadline
+        self.output_tokens: list[int] = []
+
+        # timing (engine clock): TTFT = first_token_at - arrival_time
+        self.arrival_time = time.monotonic() if arrival_time is None \
+            else arrival_time
+        self.admitted_at: float | None = None
+        self.first_token_at: float | None = None
+        self.last_token_at: float | None = None
+        self.finished_at: float | None = None
+
+        self._engine = None               # set by Engine.submit
+
+    # ------------------------------------------------------------- status
+    @property
+    def num_generated(self) -> int:
+        return len(self.output_tokens)
+
+    def is_finished(self) -> bool:
+        return self.state in (RequestState.DONE, RequestState.CANCELLED)
+
+    def cancel(self):
+        """Request cancellation.  Queued requests drop at the next
+        scheduling pass; running requests are evicted at the next
+        iteration boundary (their pages return to the pool)."""
+        if not self.is_finished():
+            self.cancel_requested = True
+
+    # ---------------------------------------------------------- streaming
+    def stream(self):
+        """Yield output tokens in order.  When no token is pending and
+        the request is attached to an engine, drives ``engine.step()``
+        until the next token lands (or the request finishes)."""
+        i = 0
+        while True:
+            while i < len(self.output_tokens):
+                yield self.output_tokens[i]
+                i += 1
+            if self.is_finished():
+                return
+            if self._engine is None:
+                return
+            if not self._engine.step() and not self.is_finished() \
+                    and i >= len(self.output_tokens):
+                raise RuntimeError(
+                    f"engine made no progress while request {self.id} is "
+                    f"{self.state.value} (drained engine?)")
+
+    def result(self) -> list[int]:
+        """Block (by driving the attached engine) until finished; returns
+        the generated tokens."""
+        for _ in self.stream():
+            pass
+        return list(self.output_tokens)
+
+    # ------------------------------------------------- engine-side hooks
+    def _emit(self, token: int, now: float):
+        self.output_tokens.append(int(token))
+        if self.first_token_at is None:
+            self.first_token_at = now
+        self.last_token_at = now
+        if self.on_token is not None:
+            self.on_token(self, int(token))
+
+    def __repr__(self):
+        return (f"Request(id={self.id}, state={self.state.value}, "
+                f"prompt_len={self.prompt.size}, "
+                f"generated={self.num_generated}/{self.gen.max_new_tokens})")

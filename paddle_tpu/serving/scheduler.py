@@ -1,0 +1,145 @@
+"""Iteration-level FCFS scheduler (Orca-style continuous batching).
+
+Each engine iteration the scheduler (1) evicts finished / cancelled /
+past-deadline sequences so their pages and slot free immediately,
+(2) admits queued requests FCFS into free decode slots, reserving their
+whole page budget up front (all-or-nothing: an admitted request can
+never exhaust the pool mid-decode), and (3) reports backpressure when
+the head of the queue cannot be placed.  Admission order is strict
+FCFS — a head request that does not fit blocks the queue rather than
+being overtaken (no starvation of large requests).
+"""
+from __future__ import annotations
+
+from collections import deque
+
+from .. import observability as _obs
+from .block_manager import BlockManager
+from .request import Request, RequestState
+
+__all__ = ["Scheduler"]
+
+_M_QUEUE_DEPTH = _obs.gauge(
+    "serving_queue_depth", "requests waiting for a decode slot")
+_M_ACTIVE = _obs.gauge(
+    "serving_active_slots", "decode slots occupied by live sequences")
+_M_ADMITTED = _obs.counter(
+    "serving_admissions_total", "requests admitted into decode slots")
+_M_EVICTED = _obs.counter(
+    "serving_evictions_total", "sequences evicted from decode slots",
+    ("reason",))
+_M_BACKPRESSURE = _obs.counter(
+    "serving_backpressure_total",
+    "scheduling passes where the queue head could not be placed",
+    ("reason",))
+
+
+class Scheduler:
+    def __init__(self, blocks: BlockManager, max_slots: int):
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        self.blocks = blocks
+        self.max_slots = int(max_slots)
+        self.slots: list[Request | None] = [None] * self.max_slots
+        self.queue: deque[Request] = deque()
+        self.draining = False
+        self._finalize = None      # engine callback: (req, reason, now)
+
+    # ------------------------------------------------------------ intake
+    def submit(self, req: Request):
+        self.queue.append(req)
+        _M_QUEUE_DEPTH.set(len(self.queue))
+
+    def drain(self):
+        """Stop admitting; running sequences finish, queued ones wait
+        (resume() re-opens admission)."""
+        self.draining = True
+
+    def resume(self):
+        self.draining = False
+
+    def has_work(self) -> bool:
+        if any(r is not None for r in self.slots):
+            return True
+        return bool(self.queue) and not self.draining
+
+    @property
+    def active_count(self) -> int:
+        return sum(r is not None for r in self.slots)
+
+    # ---------------------------------------------------------- one pass
+    def schedule(self, now: float) -> list[tuple[int, Request]]:
+        """One scheduling pass: evict dead sequences, expire deadlines,
+        admit FCFS.  Returns the newly admitted ``(slot, request)``
+        pairs — the engine prefills them before the next decode step."""
+        # 1) iteration-level eviction of cancelled / expired residents
+        for i, req in enumerate(self.slots):
+            if req is None:
+                continue
+            if req.cancel_requested:
+                self.evict(i, "cancelled", now)
+            elif req.deadline is not None and now > req.deadline:
+                req.cancel_requested = True
+                self.evict(i, "deadline", now)
+
+        # 2) drop queued requests that were cancelled or expired
+        kept = deque()
+        for req in self.queue:
+            if req.cancel_requested:
+                self._finish(req, "cancelled", now)
+            elif req.deadline is not None and now > req.deadline:
+                self._finish(req, "deadline", now)
+            else:
+                kept.append(req)
+        self.queue = kept
+
+        # 3) FCFS admission
+        admitted: list[tuple[int, Request]] = []
+        while self.queue and not self.draining:
+            free = [i for i, r in enumerate(self.slots) if r is None]
+            if not free:
+                _M_BACKPRESSURE.labels("slots").inc()
+                break
+            head = self.queue[0]
+            need = self.blocks.pages_needed(head.prompt.size,
+                                            head.gen.max_new_tokens)
+            pages = self.blocks.allocate(head.id, need)
+            if pages is None:
+                # pool exhausted: the head waits (and blocks the queue —
+                # strict FCFS), surfaced as backpressure, not an error
+                _M_BACKPRESSURE.labels("pages").inc()
+                break
+            self.queue.popleft()
+            slot = free[0]
+            self.slots[slot] = head
+            head.state = RequestState.PREFILL
+            head.admitted_at = now
+            _M_ADMITTED.inc()
+            admitted.append((slot, head))
+
+        _M_QUEUE_DEPTH.set(len(self.queue))
+        _M_ACTIVE.set(self.active_count)
+        return admitted
+
+    # ---------------------------------------------------------- eviction
+    def evict(self, slot: int, reason: str, now: float):
+        """Free a slot and its pages; finalizes the request unless it
+        already finished (reason 'finished' keeps its finish_reason)."""
+        req = self.slots[slot]
+        if req is None:
+            return
+        self.slots[slot] = None
+        self.blocks.free_seq(req.id)
+        _M_EVICTED.labels(reason).inc()
+        _M_ACTIVE.set(self.active_count)
+        if not req.is_finished():
+            self._finish(req, reason, now)
+
+    def _finish(self, req: Request, reason: str, now: float):
+        if self._finalize is not None:
+            self._finalize(req, reason, now)
+        else:                       # standalone scheduler (tests)
+            req.finish_reason = reason
+            req.state = RequestState.CANCELLED \
+                if reason in ("cancelled", "deadline") else RequestState.DONE
+            req.finished_at = now

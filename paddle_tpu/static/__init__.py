@@ -103,6 +103,36 @@ def load(program, model_path, executor=None, var_list=None):
         p._data = jnp.asarray(arr, p._data.dtype)
 
 
+def _npz_pack(arrays):
+    """npz-safe view of a param dict: numpy cannot round-trip extension
+    dtypes (a bfloat16 array reloads as void bytes), so such arrays are
+    stored as same-width uint bit patterns plus a ``<name>.dtype`` tag
+    that :func:`_npz_unpack` uses to view them back."""
+    out = {}
+    for name, arr in arrays.items():
+        arr = np.asarray(arr)
+        if arr.dtype.kind == "V":           # ml_dtypes (bfloat16, fp8…)
+            out[name] = arr.view(f"uint{arr.dtype.itemsize * 8}")
+            out[name + ".dtype"] = np.asarray(arr.dtype.name)
+        else:
+            out[name] = arr
+    return out
+
+
+def _npz_unpack(pz, name):
+    arr = pz[name]
+    tag = name + ".dtype"
+    if tag in pz.files:
+        import ml_dtypes  # noqa: F401  (registers the dtype names)
+        arr = arr.view(np.dtype(str(pz[tag])))
+    return arr
+
+
+def _npz_param_count(pz):
+    import re
+    return sum(1 for k in pz.files if re.fullmatch(r"p\d+", k))
+
+
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
                          program=None, **kwargs):
     """Serialize the fetched DAG slice for deployment (reference:
@@ -117,7 +147,7 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
         [fetch_vars]
     params = program.all_parameters()
     pmap = {f"p{i}": np.asarray(p._data) for i, p in enumerate(params)}
-    np.savez(path_prefix + ".pdiparams.npz", **pmap)
+    np.savez(path_prefix + ".pdiparams.npz", **_npz_pack(pmap))
 
     # swap concrete param tensors for symbolic markers before pickling
     from ..framework.tensor import Tensor
@@ -170,8 +200,8 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
     with open(path_prefix + ".pdmodel.pkl", "rb") as f:
         meta = pickle.load(f)
     pz = np.load(path_prefix + ".pdiparams.npz")
-    params = [Tensor(pz[f"p{i}"], stop_gradient=True)
-              for i in range(len(pz.files))]
+    params = [Tensor(_npz_unpack(pz, f"p{i}"), stop_gradient=True)
+              for i in range(_npz_param_count(pz))]
 
     prog = Program()
     made: dict[str, Variable] = {}

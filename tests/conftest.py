@@ -22,3 +22,11 @@ if not os.environ.get("PADDLE_TPU_TEST_TPU"):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    # tier-1 deselects with -m 'not slow'; register the marker so pytest
+    # does not warn it unknown
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running test excluded from the tier-1 gate")

@@ -54,3 +54,106 @@ def test_predictor_positional_run(saved_model):
     # second call with a different batch size retraces cleanly
     outs2 = pred.run([xv[:2]])
     np.testing.assert_allclose(outs2[0], ref[:2], rtol=1e-4, atol=1e-5)
+
+
+def test_predictor_pool_shares_compiled_executable(saved_model):
+    from paddle_tpu.inference import PredictorPool
+
+    path, xv, ref = saved_model
+    pool = PredictorPool(Config(path), size=3)
+    assert pool.size() == 3
+    base = pool.retrieve(0)
+    for i in range(3):
+        p = pool.retrieve(i)
+        # reference Clone() contract: shared weights + executor, so the
+        # whole pool compiles each feed signature once
+        assert p._exe is base._exe
+        np.testing.assert_allclose(p.run([xv])[0], ref, rtol=1e-5)
+    assert len(base._exe._cache) == 1
+    # private I/O buffers: writing one member's handle leaves siblings'
+    # buffers untouched
+    pool.retrieve(1).get_input_handle("x").copy_from_cpu(xv * 2.0)
+    np.testing.assert_allclose(pool.retrieve(2)._inputs["x"], xv)
+
+
+def test_predictor_pool_retrieve_errors(saved_model):
+    from paddle_tpu.inference import PredictorPool
+
+    path, _, _ = saved_model
+    pool = PredictorPool(Config(path), size=2)
+    for bad in (2, -1, 7):
+        with pytest.raises(IndexError, match=r"pool holds 2 predictors"):
+            pool.retrieve(bad)
+    with pytest.raises(ValueError):
+        PredictorPool(Config(path), size=0)
+
+
+@pytest.fixture()
+def saved_deep_model():
+    """Three stacked fc layers: the middle one's parameters touch
+    neither the feed nor the fetch, so keep_io_types=True must convert
+    them while keeping the first/last layers fp32."""
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 8], "float32")
+            h1 = static.nn.fc(x, 16, activation="relu")
+            h2 = static.nn.fc(h1, 16, activation="relu")
+            out = static.nn.fc(h2, 4)
+        exe = static.Executor()
+        path = os.path.join(tempfile.mkdtemp(), "deep")
+        static.save_inference_model(path, [x], [out], exe, program=main)
+        xv = np.random.default_rng(3).standard_normal((4, 8)).astype(
+            "float32")
+        ref = exe.run(main, feed={"x": xv}, fetch_list=[out])[0]
+    finally:
+        paddle.disable_static()
+    return path, xv, ref
+
+
+def _param_dtypes(params_file):
+    from paddle_tpu import static as _static
+
+    pz = np.load(params_file)
+    return [_static._npz_unpack(pz, f"p{i}").dtype.name
+            for i in range(_static._npz_param_count(pz))]
+
+
+def test_convert_to_mixed_precision_keep_io_types(saved_deep_model,
+                                                  tmp_path):
+    from paddle_tpu.inference import convert_to_mixed_precision
+
+    path, xv, ref = saved_deep_model
+    mixed = str(tmp_path / "mixed")
+    convert_to_mixed_precision(
+        path + ".pdmodel.pkl", path + ".pdiparams.npz",
+        mixed + ".pdmodel.pkl", mixed + ".pdiparams.npz",
+        keep_io_types=True)
+    # params are (w, b) per fc in creation order: only the middle layer
+    # is free of feed/fetch contact -> only p2/p3 convert
+    assert _param_dtypes(mixed + ".pdiparams.npz") == [
+        "float32", "float32", "bfloat16", "bfloat16",
+        "float32", "float32"]
+    out = create_predictor(Config(mixed)).run([xv])[0]
+    assert out.dtype == np.float32
+    np.testing.assert_allclose(out, ref, rtol=0.05, atol=0.05)
+
+
+def test_convert_to_mixed_precision_black_list(saved_deep_model,
+                                               tmp_path):
+    from paddle_tpu.inference import convert_to_mixed_precision
+
+    path, xv, ref = saved_deep_model
+    mixed = str(tmp_path / "mixed")
+    # keep_io_types=False converts everything EXCEPT the blacklist;
+    # npz keys (p<i>) are accepted as blacklist names
+    convert_to_mixed_precision(
+        path + ".pdmodel.pkl", path + ".pdiparams.npz",
+        mixed + ".pdmodel.pkl", mixed + ".pdiparams.npz",
+        keep_io_types=False, black_list={"p0", "p3"})
+    assert _param_dtypes(mixed + ".pdiparams.npz") == [
+        "float32", "bfloat16", "bfloat16", "float32",
+        "bfloat16", "bfloat16"]
+    out = create_predictor(Config(mixed)).run([xv])[0]
+    np.testing.assert_allclose(out, ref, rtol=0.05, atol=0.05)

@@ -80,6 +80,77 @@ def _histogram_block(name, entry):
     return "\n".join(lines)
 
 
+def _hist_stats(entry):
+    """(count, sum, avg, approx-p50, approx-p99) over all series of a
+    histogram entry — percentile = upper edge of the cumulative bucket
+    that crosses the rank (what a Prometheus quantile would report)."""
+    buckets: dict = {}
+    count, total = 0, 0.0
+    for s in entry.get("series", []):
+        count += s.get("count", 0)
+        total += s.get("sum", 0.0)
+        prev = 0
+        for le, c in s.get("buckets", []):
+            buckets[le] = buckets.get(le, 0) + (c - prev)
+            prev = c
+    if not count:
+        return 0, 0.0, 0.0, None, None
+
+    def pct(q):
+        rank, acc = q * count, 0
+        for le, c in sorted(buckets.items(),
+                            key=lambda kv: float("inf")
+                            if kv[0] == "+Inf" else kv[0]):
+            acc += c
+            if acc >= rank:
+                return le
+        return "+Inf"
+
+    return count, total, total / count, pct(0.5), pct(0.99)
+
+
+def _serving_section(metrics):
+    """Serving-engine summary: TTFT/TPOT latency lines + the throughput
+    and pressure counters the engine exports (serving_* namespace)."""
+    if not any(k.startswith("serving_") for k in metrics):
+        return None
+    lines = ["Serving"]
+    for name, title in (("serving_ttft_seconds", "TTFT"),
+                        ("serving_tpot_seconds", "TPOT"),
+                        ("serving_e2e_seconds", "E2E")):
+        if name not in metrics:
+            continue
+        count, _, avg, p50, p99 = _hist_stats(metrics[name])
+        if not count:
+            lines.append(f"  {title:<5} no samples")
+            continue
+        fmt = lambda v: "+Inf" if v == "+Inf" else f"{float(v) * 1e3:g}ms"
+        lines.append(f"  {title:<5} n={count} avg={avg * 1e3:.3g}ms "
+                     f"p50<={fmt(p50)} p99<={fmt(p99)}")
+    rows = []
+    for name in ("serving_tokens_total", "serving_decode_steps_total",
+                 "serving_admissions_total", "serving_evictions_total",
+                 "serving_backpressure_total", "serving_requests_total",
+                 "serving_decode_step_traces_total",
+                 "serving_queue_depth", "serving_active_slots",
+                 "serving_pages_in_use", "serving_pages_total"):
+        entry = metrics.get(name)
+        if not entry or entry.get("type") == "histogram":
+            continue
+        for s in entry.get("series", []):
+            rows.append((name, _fmt_labels(s.get("labels", {})),
+                         _fmt_value(s.get("value", 0))))
+    if rows:
+        lines.append(_table(rows, ("name", "labels", "value")))
+    back = metrics.get("serving_backpressure_total")
+    if back:
+        events = sum(s.get("value", 0) for s in back.get("series", []))
+        if events:
+            lines.append(f"  backpressure events: {_fmt_value(events)} "
+                         f"(queue blocked on pages/slots)")
+    return "\n".join(lines)
+
+
 def report(metrics, retraces):
     simple_rows = {"counter": [], "gauge": []}
     hist_blocks = []
@@ -99,6 +170,9 @@ def report(metrics, retraces):
                                   ("name", "labels", "value")), ""]
     if hist_blocks:
         out += ["Histograms"] + hist_blocks + [""]
+    serving = _serving_section(metrics)
+    if serving:
+        out += [serving, ""]
     if retraces and retraces.get("entries"):
         entries = sorted(retraces["entries"],
                          key=lambda e: (-e["count"], e["op"]))
