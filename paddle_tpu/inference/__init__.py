@@ -355,9 +355,10 @@ def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
             keep = bool(matched & black) or bool(matched & keep_names) \
                 or (keep_io_types is True and i in io_params)
             out[key] = np.asarray(arr) if keep else convert(arr)
-        # np.savez appends .npz only when the name lacks it — either way
-        # the artifact lands at the caller's requested path
-        np.savez(mixed_params_file, **_static._npz_pack(out))
+        # write through a handle: np.savez(path) appends '.npz' when the
+        # name lacks that suffix, which would move the artifact
+        with open(mixed_params_file, "wb") as f:
+            np.savez(f, **_static._npz_pack(out))
     else:                                   # paddle.save state dict
         from ..framework.io import load, save
         state = load(params_file)

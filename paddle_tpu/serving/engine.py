@@ -116,6 +116,12 @@ class Engine:
         self.blocks = BlockManager(num_pages, self.page_size)
         self.scheduler = Scheduler(self.blocks, self.max_slots)
         self.scheduler._finalize = self._finalize
+        # every eviction parks its slot — not just the length/eos path in
+        # _emit.  A cancel/deadline eviction inside scheduler.schedule()
+        # would otherwise leave the slot's table/pos pointing at freed
+        # pages, and the lockstep decode step (which writes KV for every
+        # slot) would corrupt them once reallocated to a new request.
+        self.scheduler._on_evict = self._park
 
         L = config.num_hidden_layers
         kvh, hd = config.num_key_value_heads, config.head_dim
@@ -330,11 +336,9 @@ class Engine:
         if req.num_generated >= req.gen.max_new_tokens:
             self._finalize(req, "length", now)
             self.scheduler.evict(slot, "finished", now)
-            self._park(slot)
         elif eos is not None and tok == eos:
             self._finalize(req, "eos", now)
             self.scheduler.evict(slot, "finished", now)
-            self._park(slot)
 
     def _park(self, slot: int):
         """Return a slot to the idle state: all writes/reads go to the
@@ -366,6 +370,11 @@ class Engine:
             cutoff_idx = int(np.sum(cum < g.top_p))
             cutoff = logits[order[min(cutoff_idx, logits.size - 1)]]
             logits = np.where(logits < cutoff, -np.inf, logits)
+        if not np.isfinite(logits).any():
+            raise ValueError(
+                f"request {req.id}: no finite logits to sample from — "
+                "the model emitted non-finite logits (or top_k/top_p "
+                "masked every candidate)")
         return int(rng.choice(logits.size, p=_softmax(logits)))
 
     # -------------------------------------------------------- lifecycle

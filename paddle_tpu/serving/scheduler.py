@@ -44,6 +44,7 @@ class Scheduler:
         self.queue: deque[Request] = deque()
         self.draining = False
         self._finalize = None      # engine callback: (req, reason, now)
+        self._on_evict = None      # engine callback: (slot,) — park it
 
     # ------------------------------------------------------------ intake
     def submit(self, req: Request):
@@ -130,6 +131,8 @@ class Scheduler:
             return
         self.slots[slot] = None
         self.blocks.free_seq(req.id)
+        if self._on_evict is not None:
+            self._on_evict(slot)
         _M_EVICTED.labels(reason).inc()
         _M_ACTIVE.set(self.active_count)
         if not req.is_finished():

@@ -140,6 +140,22 @@ def test_convert_to_mixed_precision_keep_io_types(saved_deep_model,
     np.testing.assert_allclose(out, ref, rtol=0.05, atol=0.05)
 
 
+def test_convert_to_mixed_precision_exact_output_path(saved_deep_model,
+                                                      tmp_path):
+    from paddle_tpu.inference import convert_to_mixed_precision
+
+    path, _, _ = saved_deep_model
+    # a params name without the '.npz' suffix must land at exactly that
+    # path (np.savez(path) would silently append '.npz' and move it)
+    mixed = str(tmp_path / "mixed")
+    convert_to_mixed_precision(
+        path + ".pdmodel.pkl", path + ".pdiparams.npz",
+        mixed + ".pdmodel.pkl", mixed + ".params")
+    assert os.path.exists(mixed + ".params")
+    assert not os.path.exists(mixed + ".params.npz")
+    assert "bfloat16" in _param_dtypes(mixed + ".params")
+
+
 def test_convert_to_mixed_precision_black_list(saved_deep_model,
                                                tmp_path):
     from paddle_tpu.inference import convert_to_mixed_precision

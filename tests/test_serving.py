@@ -267,6 +267,55 @@ def test_engine_cancel_and_deadline(tiny_model):
     assert not eng.scheduler.has_work()
 
 
+def test_engine_scheduler_eviction_parks_slot(tiny_model):
+    """Regression: cancel/deadline evictions happen inside
+    scheduler.schedule(), not the _emit length/eos path.  The freed slot
+    must be parked on the dump page immediately — the lockstep decode
+    step writes KV for EVERY slot, so a stale slot would keep writing
+    into its freed pages and corrupt them once reallocated to a request
+    admitted into a different slot."""
+    solo = create_engine(tiny_model, max_slots=1, page_size=8,
+                         max_model_len=64)
+    ref = solo.submit(np.arange(1, 10), GenerationConfig(max_new_tokens=8))
+    solo.run_until_complete(max_steps=50)
+
+    eng = create_engine(tiny_model, max_slots=3, page_size=8,
+                        num_pages=12, max_model_len=64)
+    dump = eng.blocks.num_pages
+    a = eng.submit(np.arange(1, 6), GenerationConfig(max_new_tokens=40))
+    b = eng.submit(np.arange(1, 6), GenerationConfig(max_new_tokens=2))
+    d = eng.submit(np.arange(1, 6), GenerationConfig(max_new_tokens=30))
+    eng.step()                  # all three admitted; b finishes (slot 1)
+    assert b.state == RequestState.DONE
+    d.cancel()
+    eng.step()                  # scheduler evicts d from slot 2
+    assert d.state == RequestState.CANCELLED
+    # slot 2 parks even though nothing was admitted into it
+    assert eng.table[2].tolist() == [dump] * eng.table_width
+    assert eng._pos[2] == 0 and eng._tok[2] == 0
+    # e lands in slot 1 (freed by b) but reuses d's freed pages; a stale
+    # slot 2 would keep writing garbage KV into them while e decodes
+    e = eng.submit(np.arange(1, 10), GenerationConfig(max_new_tokens=8))
+    eng.step()
+    assert eng.scheduler.slots[1] is e
+    assert set(eng.blocks.pages_of(e.id)) & set(range(7, 12))
+    eng.run_until_complete(max_steps=200)
+    assert a.state == RequestState.DONE and a.num_generated == 40
+    assert e.output_tokens == ref.output_tokens, \
+        "reallocated pages were corrupted by a stale (unparked) slot"
+
+
+def test_pick_token_all_masked_logits_clear_error(tiny_model):
+    eng = create_engine(tiny_model, max_slots=1, page_size=8,
+                        max_model_len=64, emit_logits=True)
+    req = Request(np.arange(1, 4),
+                  GenerationConfig(max_new_tokens=2, do_sample=True))
+    with pytest.raises(ValueError, match="finite logits"):
+        eng._pick_token(req, np.full(128, -np.inf))
+    with pytest.raises(ValueError, match="finite logits"):
+        eng._pick_token(req, np.full(128, np.nan))
+
+
 def test_engine_drain_and_resume(tiny_model):
     eng = create_engine(tiny_model, max_slots=1, page_size=8,
                         max_model_len=64)
