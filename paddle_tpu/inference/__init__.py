@@ -167,8 +167,12 @@ def create_predictor(config: Config) -> Predictor:
 def create_engine(model, **kwargs):
     """Predictor-style entry to the continuous-batching LLM serving
     engine (paddle_tpu/serving/): one engine serves many concurrent
-    generation requests over a shared paged KV pool.  See
-    :func:`paddle_tpu.serving.create_engine` for the knobs."""
+    generation requests over a shared paged KV pool.  Key knobs:
+    ``enable_prefix_cache=True`` reuses resident KV pages across
+    requests with shared prompt prefixes (prefill runs only the uncached
+    suffix); ``sync_interval=N`` lets the greedy decode loop run N
+    device steps per host sync.  See
+    :func:`paddle_tpu.serving.create_engine` for the full list."""
     from ..serving import create_engine as _create
     return _create(model, **kwargs)
 

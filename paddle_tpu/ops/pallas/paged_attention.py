@@ -31,7 +31,8 @@ import numpy as np
 
 from .flash_attention import NUM_LANES
 
-__all__ = ["paged_attention", "PagedPool", "select_paged_attention"]
+__all__ = ["paged_attention", "PagedPool", "select_paged_attention",
+           "gather_kv_pages"]
 
 _INTERPRET = False
 
@@ -139,6 +140,19 @@ def paged_attention(q, kpool, vpool, table, lens):
     return out.reshape(b, nh, d)
 
 
+def gather_kv_pages(pool, table):
+    """Materialize a block table's pages token-major: ``pool``
+    [P, kvH, page_size, D], ``table`` [..., W] int32 page ids
+    (dump-padded) -> [..., W * page_size, kvH, D].  The dense-gather
+    building block shared by :func:`paged_attention_xla` and the serving
+    engine's cached prefill (which attends suffix queries over the
+    resident prefix pages it gathers here)."""
+    kvh, ps, d = pool.shape[1:]
+    g = pool[table]                            # [..., W, kvh, ps, d]
+    g = jnp.swapaxes(g, -3, -2)                # [..., W, ps, kvh, d]
+    return g.reshape(table.shape[:-1] + (table.shape[-1] * ps, kvh, d))
+
+
 def paged_attention_xla(q, kpool, vpool, table, lens):
     """Dense-gather reference (identical numerics): materializes each
     sequence's pages — O(B * max_pages * page_size) HBM — used off-TPU
@@ -146,11 +160,9 @@ def paged_attention_xla(q, kpool, vpool, table, lens):
     b, nh, d = q.shape
     kvh, ps = kpool.shape[1], kpool.shape[2]
     rep = nh // kvh
-    # [B, max_pages, kvh, ps, D] -> [B, kvh, max_pages*ps, D]
-    kb = kpool[table].transpose(0, 2, 1, 3, 4).reshape(
-        b, kvh, table.shape[1] * ps, d)
-    vb = vpool[table].transpose(0, 2, 1, 3, 4).reshape(
-        b, kvh, table.shape[1] * ps, d)
+    # [B, W*ps, kvh, D] -> [B, kvh, W*ps, D]
+    kb = gather_kv_pages(kpool, table).transpose(0, 2, 1, 3)
+    vb = gather_kv_pages(vpool, table).transpose(0, 2, 1, 3)
     kq = jnp.repeat(kb, rep, axis=1)
     vq = jnp.repeat(vb, rep, axis=1)
     logits = jnp.einsum("bhd,bhtd->bht", q, kq,

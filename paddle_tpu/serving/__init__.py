@@ -7,16 +7,26 @@ admitted, interleaved, streamed, and cancelled between single-token
 decode steps of ONE jitted program.
 
     from paddle_tpu.serving import create_engine, GenerationConfig
-    engine = create_engine(model, max_slots=8, page_size=64)
+    engine = create_engine(model, max_slots=8, page_size=64,
+                           enable_prefix_cache=True, sync_interval=8)
     req = engine.submit(prompt_ids, GenerationConfig(max_new_tokens=32))
     for tok in req.stream():
         ...
 
+``enable_prefix_cache=True`` adds automatic prefix caching (vLLM-style
+hash-chained page reuse + copy-on-write tails + LRU eviction): prompts
+sharing page-aligned prefixes skip prefill for the shared part and are
+charged pages only for their uncached suffix.  ``sync_interval=N``
+batches host synchronization on the greedy path: decode state lives on
+device and the host drains a sampled-token ring once every N steps.
+
 Modules:
   * request.py       — request lifecycle + streaming
-  * block_manager.py — KV-page free list / block tables / backpressure
+  * block_manager.py — KV pages: free list / block tables / prefix
+                       cache (refcounts, chain index, CoW, LRU)
   * scheduler.py     — FCFS admission, iteration-level eviction, drain
   * engine.py        — the jitted prefill/decode driver
+                       (device-resident state, deferred host sync)
 
 Reference analog: the block_multi_head_attention serving path +
 paddle_infer predictors, restructured as a vLLM/Orca-style engine.

@@ -1,4 +1,4 @@
-"""KV-page allocator for the serving engine.
+"""KV-page allocator + automatic prefix cache for the serving engine.
 
 Reference analog: the block tables fed to
 block_multi_head_attention_kernel.cu — each sequence owns a list of
@@ -12,11 +12,36 @@ requests are admitted and evicted, and an allocation that does not fit
 returns ``None`` — backpressure the scheduler turns into queueing,
 never an exception out of the engine.
 
+With ``enable_prefix_cache=True`` the manager additionally runs
+automatic prefix caching (vLLM's hash-based PagedAttention reuse /
+SGLang's RadixAttention, restructured as a chain index over pages):
+
+  * every page holding a **page_size-aligned full chunk** of a prompt
+    is registered in a chain index keyed ``(parent page, token chunk)``
+    — exact-match keys, so a recycled parent id can never alias a stale
+    chain (children are detached before a parent is ever reused);
+  * a later request walks its prompt chunk-by-chunk down the chain and
+    **shares** every page it matches (refcount++), paying pages only
+    for the unmatched suffix — admission is charged for *new* pages
+    only, which is what raises effective pool capacity;
+  * the **partially-filled tail page** of a prompt is indexed with its
+    token content; a new request whose suffix extends a matching tail
+    gets a **copy-on-write** source: the engine copies the page's KV
+    rows into the request's own tail page and recomputes only from the
+    divergence point (the shared copy is never written);
+  * when a sequence releases its pages, registered pages with refcount
+    0 park in an **LRU** side pool instead of the free list; under
+    pressure the allocator evicts LRU pages leaf-first (a page is only
+    evicted once no cached chain or tail hangs off it), so the free
+    list is a floor, not a ceiling, on allocatable pages.
+
 The dump-page convention matches the paged kernel's contract: page id
 ``num_pages`` is a shared scratch page that absorbs writes through
 table padding; it is never handed to a sequence.
 """
 from __future__ import annotations
+
+from collections import OrderedDict
 
 import numpy as np
 
@@ -28,17 +53,36 @@ _M_PAGES_IN_USE = _obs.gauge(
     "serving_pages_in_use", "KV pages currently owned by live sequences")
 _M_PAGES_TOTAL = _obs.gauge(
     "serving_pages_total", "allocatable KV pages in the engine pool")
+_M_PREFIX_PAGES = _obs.counter(
+    "serving_prefix_cache_pages_total",
+    "full-chunk prefix-cache lookups by result", ("result",))
+_M_PREFIX_TOKENS = _obs.counter(
+    "serving_prefix_cached_tokens_total",
+    "prompt tokens whose prefill was skipped via the prefix cache")
+_M_PREFIX_EVICT = _obs.counter(
+    "serving_prefix_cache_evictions_total",
+    "cached refcount-0 pages evicted (LRU, leaf-first) under pressure")
+_M_PREFIX_COW = _obs.counter(
+    "serving_prefix_cache_cow_total",
+    "copy-on-write page copies for partially-filled tail pages")
+_M_CACHED_PAGES = _obs.gauge(
+    "serving_prefix_cached_pages",
+    "pages currently registered in the prefix index (incl. shared)")
+
+_ROOT = -1          # chain parent of the first chunk of every prompt
 
 
 class BlockManager:
-    """Free-list page allocator + per-sequence block tables.
+    """Free-list page allocator + per-sequence block tables (+ optional
+    prefix cache).
 
     ``num_pages`` is the number of *allocatable* pages; the pool arrays
     the engine builds must hold ``num_pages + 1`` rows (the extra row is
     the dump page, :attr:`dump_page`).
     """
 
-    def __init__(self, num_pages: int, page_size: int):
+    def __init__(self, num_pages: int, page_size: int,
+                 enable_prefix_cache: bool = False):
         if num_pages < 1:
             raise ValueError(f"num_pages must be >= 1, got {num_pages}")
         if page_size < 1:
@@ -46,9 +90,26 @@ class BlockManager:
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
         self.dump_page = self.num_pages       # pool row past the real pages
+        self.prefix_cache = bool(enable_prefix_cache)
         # FIFO reuse keeps page churn spread across the pool
         self._free: list[int] = list(range(self.num_pages))
         self._tables: dict[int, list[int]] = {}   # seq id -> owned pages
+        self._ref: dict[int, int] = {}            # page -> live-seq refs
+        self._meta: dict[int, dict] = {}          # seq id -> prefill plan
+        # prefix-cache state.  Chain index: (parent page, chunk) -> page;
+        # tail index: parent page -> {page: partial-chunk tokens}.
+        self._index: dict[tuple, int] = {}
+        self._key_of: dict[int, tuple] = {}       # page -> its chain key
+        self._tails: dict[int, dict[int, tuple]] = {}
+        self._tail_parent: dict[int, int] = {}    # tail page -> parent
+        self._children: dict[int, set] = {}       # page -> cached children
+        self._lru: OrderedDict[int, None] = OrderedDict()
+        # python-side mirrors of the serving_prefix_* metrics (stats())
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_evictions = 0
+        self.cow_copies = 0
+        self.cached_tokens = 0
         _M_PAGES_TOTAL.set(self.num_pages)
         _M_PAGES_IN_USE.set(0)
 
@@ -64,11 +125,18 @@ class BlockManager:
         return len(self._free)
 
     @property
+    def cached_pages(self) -> int:
+        """Pages registered in the prefix index (shared or parked)."""
+        return len(self._key_of) + len(self._tail_parent)
+
+    @property
     def pages_in_use(self) -> int:
-        return self.num_pages - len(self._free)
+        """Pages owned by live sequences.  Cached refcount-0 pages in
+        the LRU side pool are reusable, so they do not count."""
+        return self.num_pages - len(self._free) - len(self._lru)
 
     def can_allocate(self, n: int) -> bool:
-        return n <= len(self._free)
+        return n <= len(self._free) + len(self._lru)
 
     # --------------------------------------------------------- alloc/free
     def allocate(self, seq_id: int, n: int):
@@ -77,22 +145,203 @@ class BlockManager:
         (backpressure — the caller keeps the request queued)."""
         if seq_id in self._tables:
             raise ValueError(f"sequence {seq_id} already owns pages")
-        if n > len(self._free):
+        pages = self._acquire(n)
+        if pages is None:
             return None
-        pages, self._free = self._free[:n], self._free[n:]
+        for p in pages:
+            self._ref[p] = 1
         self._tables[seq_id] = pages
+        self._meta[seq_id] = {"cached_len": 0, "cow_src": None}
         _M_PAGES_IN_USE.set(self.pages_in_use)
         return list(pages)
 
+    def allocate_seq(self, seq_id: int, prompt, max_new_tokens: int):
+        """Admission entry point: match ``prompt`` against the prefix
+        cache, share matched pages, and reserve fresh pages for the
+        suffix only.  Returns the sequence's full page list (shared
+        prefix first) or ``None`` on backpressure.  The prefill plan
+        (``cached_len``, ``cow_src``) is retrievable via
+        :meth:`seq_meta` until :meth:`free_seq`."""
+        if not self.prefix_cache:
+            return self.allocate(seq_id,
+                                 self.pages_needed(len(prompt),
+                                                   max_new_tokens))
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id} already owns pages")
+        prompt = tuple(int(t) for t in np.asarray(prompt).reshape(-1))
+        plen = len(prompt)
+        ps = self.page_size
+        total = self.pages_needed(plen, max_new_tokens)
+        full = plen // ps
+
+        # walk the chain index chunk by chunk
+        matched: list[int] = []
+        parent = _ROOT
+        for c in range(full):
+            page = self._index.get((parent, prompt[c * ps:(c + 1) * ps]))
+            if page is None:
+                break
+            matched.append(page)
+            parent = page
+        if matched and len(matched) * ps >= plen:
+            # full-prompt hit: drop the last match so at least one token
+            # still runs through the model (its logits seed decoding)
+            matched.pop()
+            parent = matched[-1] if matched else _ROOT
+        m = len(matched)
+        self.prefix_hits += m
+        self.prefix_misses += full - m
+        if m:
+            _M_PREFIX_PAGES.labels("hit").inc(m)
+        if full - m:
+            _M_PREFIX_PAGES.labels("miss").inc(full - m)
+
+        # protect the matched chain, then acquire the suffix pages (the
+        # acquire may LRU-evict; refcounted pages are never candidates)
+        for p in matched:
+            self._incref(p)
+        fresh = self._acquire(total - m)
+        if fresh is None:
+            for p in matched:
+                self._decref(p)
+            _M_PAGES_IN_USE.set(self.pages_in_use)
+            return None
+        for p in fresh:
+            self._ref[p] = 1
+
+        # copy-on-write probe AFTER acquiring (the acquire could have
+        # evicted a tail candidate): longest common prefix between the
+        # prompt's remainder and a cached partial tail under `parent`
+        cached_len = m * ps
+        cow_src = None
+        rem = prompt[m * ps:]
+        best_cp = 0
+        for page, toks in self._tails.get(parent, {}).items():
+            cp = 0
+            for a, b in zip(rem, toks):
+                if a != b:
+                    break
+                cp += 1
+            # cap so at least one prompt token is left to recompute
+            cp = min(cp, plen - m * ps - 1)
+            if cp > best_cp:
+                best_cp, cow_src = cp, page
+        if cow_src is not None:
+            cached_len += best_cp
+            self.cow_copies += 1
+            _M_PREFIX_COW.inc()
+
+        self.cached_tokens += cached_len
+        if cached_len:
+            _M_PREFIX_TOKENS.inc(cached_len)
+
+        pages = matched + fresh
+        self._tables[seq_id] = pages
+        self._meta[seq_id] = {"cached_len": cached_len, "cow_src": cow_src}
+
+        # register this prompt's fresh full chunks (chain through any
+        # page an identical chunk already cached)
+        for c in range(m, full):
+            key = (parent, prompt[c * ps:(c + 1) * ps])
+            existing = self._index.get(key)
+            if existing is not None:
+                parent = existing
+                continue
+            page = pages[c]
+            self._index[key] = page
+            self._key_of[page] = key
+            self._children.setdefault(parent, set()).add(page)
+            parent = page
+        # register the partial tail (its prompt-token content is final:
+        # decode writes only to later slots of the page)
+        off = plen - full * ps
+        if off > 0:
+            tail_toks = prompt[full * ps:]
+            tails = self._tails.setdefault(parent, {})
+            if tail_toks not in tails.values():
+                page = pages[full]
+                tails[page] = tail_toks
+                self._tail_parent[page] = parent
+                self._children.setdefault(parent, set()).add(page)
+        _M_CACHED_PAGES.set(self.cached_pages)
+        _M_PAGES_IN_USE.set(self.pages_in_use)
+        return list(pages)
+
+    def seq_meta(self, seq_id: int) -> dict:
+        """The prefill plan recorded at admission: ``cached_len`` tokens
+        already resident (prefill runs only the suffix) and ``cow_src``,
+        the tail page to copy-on-write from (or None)."""
+        return dict(self._meta.get(seq_id,
+                                   {"cached_len": 0, "cow_src": None}))
+
     def free_seq(self, seq_id: int):
-        """Return ``seq_id``'s pages to the free list (idempotent)."""
+        """Release ``seq_id``'s pages (idempotent).  Registered pages
+        whose refcount hits 0 park in the LRU pool (still matchable);
+        unregistered pages return to the free list."""
         pages = self._tables.pop(seq_id, None)
+        self._meta.pop(seq_id, None)
         if pages:
-            self._free.extend(pages)
+            for p in pages:
+                self._decref(p)
         _M_PAGES_IN_USE.set(self.pages_in_use)
 
     def pages_of(self, seq_id: int):
         return list(self._tables.get(seq_id, ()))
+
+    # ------------------------------------------------- refcount internals
+    def _incref(self, page: int):
+        self._ref[page] = self._ref.get(page, 0) + 1
+        self._lru.pop(page, None)
+
+    def _decref(self, page: int):
+        n = self._ref.get(page, 0) - 1
+        if n > 0:
+            self._ref[page] = n
+            return
+        self._ref.pop(page, None)
+        if page in self._key_of or page in self._tail_parent:
+            self._lru[page] = None       # parked, still matchable
+        else:
+            self._free.append(page)
+
+    def _acquire(self, n: int):
+        """Take ``n`` pages: free list first, then LRU eviction of
+        cached refcount-0 pages (leaf-first, so a chain parent is never
+        recycled while children could still match through it)."""
+        got: list[int] = []
+        while len(got) < n:
+            if self._free:
+                got.append(self._free.pop(0))
+            elif self._lru and self._evict_one():
+                continue
+            else:
+                # rollback: nothing partially held on failure
+                self._free = got + self._free
+                return None
+        return got
+
+    def _evict_one(self) -> bool:
+        for page in self._lru:            # oldest first
+            if self._children.get(page):
+                continue                  # not a leaf yet
+            self._lru.pop(page)
+            self._unregister(page)
+            self._free.append(page)
+            self.prefix_evictions += 1
+            _M_PREFIX_EVICT.inc()
+            _M_CACHED_PAGES.set(self.cached_pages)
+            return True
+        return False
+
+    def _unregister(self, page: int):
+        key = self._key_of.pop(page, None)
+        if key is not None:
+            self._index.pop(key, None)
+            self._children.get(key[0], set()).discard(page)
+        parent = self._tail_parent.pop(page, None)
+        if parent is not None:
+            self._tails.get(parent, {}).pop(page, None)
+            self._children.get(parent, set()).discard(page)
 
     # ------------------------------------------------------------- tables
     def table_row(self, seq_id: int, width: int) -> np.ndarray:

@@ -15,7 +15,19 @@ the reference block_multi_head_attention serving path):
     ``_prefill_layer`` (padded to a page-multiple bucket; one trace per
     bucket) and pages its KV straight into the shared pool; the token
     sampled from the prompt's last logits is the request's first output
-    (its TTFT mark).
+    (its TTFT mark).  With ``enable_prefix_cache=True`` the admission
+    only reserves pages for (and prefills) the prompt's UNCACHED
+    suffix: shared prefix pages come straight from the
+    :class:`BlockManager` chain index, a matching partial tail page is
+    copied (copy-on-write) on device, and the suffix runs through a
+    cached-prefill jit that attends over the resident prefix KV.
+  * device-resident decode state: ``table``/``pos``/``tok``, the active
+    mask, and a ``[sync_interval, slots]`` sampled-token ring live on
+    device and are donated through the step — a steady-state decode
+    iteration uploads nothing and downloads nothing.  The host fetches
+    the ring once every ``sync_interval`` steps (greedy path) and the
+    ``[slots, V]`` logits only when an active request actually samples;
+    admissions and evictions patch single slot rows in place.
   * idle slots park on the dump page (table row all-dump, pos 0): their
     lockstep writes land in scratch, their outputs are discarded
     host-side — no masking inside the program.
@@ -24,8 +36,9 @@ Sampling is host-side per request (greedy = argmax of the step's f32
 logits, matching ``_sample``'s greedy branch exactly; stochastic
 requests draw from a per-request numpy RNG so results do not depend on
 batch composition).  Set ``emit_logits=True`` at engine construction to
-serve ``do_sample`` requests — the step then returns the [slots, V]
-logits each iteration.
+serve ``do_sample`` requests — any active sampling request forces a
+per-step sync (the host must feed the sampled token back before the
+next step), so ``sync_interval`` only pays off on greedy traffic.
 """
 from __future__ import annotations
 
@@ -38,9 +51,10 @@ import numpy as np
 from .. import observability as _obs
 from ..models.generation import (GenerationConfig, _decode_layer_paged,
                                  _layer_weights, _mm, _prefill_layer,
-                                 _rope_at)
-from ..models.llama import LlamaConfig, _rope_tables
+                                 _qkv_proj, _rope_at)
+from ..models.llama import LlamaConfig, _rope_tables, _rotate_half
 from ..models.llama_hybrid import _rms
+from ..ops.pallas.paged_attention import gather_kv_pages
 from .block_manager import BlockManager
 from .request import Request, RequestState
 from .scheduler import Scheduler
@@ -60,6 +74,13 @@ _M_TOKENS = _obs.counter(
     "serving_tokens_total", "tokens emitted to requests")
 _M_REQUESTS = _obs.counter(
     "serving_requests_total", "finished requests", ("outcome",))
+_M_HOST_SYNCS = _obs.counter(
+    "serving_host_syncs_total",
+    "device->host transfers on the serving hot path: 'ring' = sampled-"
+    "token ring fetch (one per sync_interval decode steps on the greedy "
+    "path), 'logits' = [slots, V] logits fetch (only when an active "
+    "request samples), 'prefill' = first-token logits at admission",
+    ("kind",))
 
 
 def _serving_hists():
@@ -81,15 +102,17 @@ class Engine:
 
     Static shapes (fixed at construction — the no-retrace contract):
     ``max_slots`` decode slots, ``table_width`` pages per sequence,
-    ``num_pages (+ dump)`` pool rows, and the per-bucket prefill widths.
-    Everything per-request is data.
+    ``num_pages (+ dump)`` pool rows, ``sync_interval`` ring rows, and
+    the per-bucket prefill widths.  Everything per-request is data.
     """
 
     def __init__(self, model=None, *, config: LlamaConfig = None,
                  state: dict | None = None, max_slots: int = 4,
                  page_size: int = 64, num_pages: int | None = None,
                  max_model_len: int | None = None,
-                 emit_logits: bool = False, clock=time.monotonic):
+                 emit_logits: bool = False,
+                 enable_prefix_cache: bool = False,
+                 sync_interval: int = 1, clock=time.monotonic):
         if model is not None:
             from ..framework.tensor import Tensor
             config = model.config
@@ -111,9 +134,16 @@ class Engine:
         if num_pages is None:       # full residency: every slot can run
             num_pages = self.max_slots * self.table_width  # at max length
         self.emit_logits = bool(emit_logits)
+        self.enable_prefix_cache = bool(enable_prefix_cache)
+        self.sync_interval = int(sync_interval)
+        if self.sync_interval < 1:
+            raise ValueError(
+                f"sync_interval must be >= 1, got {sync_interval}")
         self._clock = clock
 
-        self.blocks = BlockManager(num_pages, self.page_size)
+        self.blocks = BlockManager(
+            num_pages, self.page_size,
+            enable_prefix_cache=self.enable_prefix_cache)
         self.scheduler = Scheduler(self.blocks, self.max_slots)
         self.scheduler._finalize = self._finalize
         # every eviction parks its slot — not just the length/eos path in
@@ -131,18 +161,35 @@ class Engine:
                                dtype)
         self.vpool = jnp.zeros((L, pool_rows, kvh, self.page_size, hd),
                                dtype)
-        rope_len = self.table_width * self.page_size
-        cos, sin = _rope_tables(rope_len, hd, config.rope_theta)
+        self._rope_len = self.table_width * self.page_size
+        cos, sin = _rope_tables(self._rope_len, hd, config.rope_theta)
         self._cos = cos.astype(jnp.float32)
         self._sin = sin.astype(jnp.float32)
 
-        # host-side slot state (shipped to device each step; tiny)
+        # host-side mirrors of the slot state (bookkeeping + targeted
+        # device patches on admit/evict; NEVER re-uploaded per step)
         self.table = np.tile(self.blocks.empty_row(self.table_width),
                              (self.max_slots, 1))
         self._pos = np.zeros((self.max_slots,), np.int32)
         self._tok = np.zeros((self.max_slots,), np.int32)
+        self._active = np.zeros((self.max_slots,), np.int32)
+        # ... and the device-resident truth the decode step runs on
+        self._table_dev = jnp.asarray(self.table)
+        self._pos_dev = jnp.asarray(self._pos)
+        self._tok_dev = jnp.asarray(self._tok)
+        self._active_dev = jnp.asarray(self._active)
+        self._ring_dev = jnp.zeros((self.sync_interval, self.max_slots),
+                                   jnp.int32)
+        self._ridx_dev = jnp.zeros((), jnp.int32)
+        self._ring_cursor = 0           # host mirror of _ridx_dev
+        # ring rows the host has not consumed yet:
+        # [(ring row, [(slot, request), ...]), ...] in decode order
+        self._pending: list[tuple[int, list]] = []
+        self._last_logits = None        # device handle, fetched lazily
 
         self.decode_traces = 0      # python-side mirror of _M_STEP_TRACES
+        self.host_syncs = 0         # ring fetches (1 per sync_interval)
+        self.logit_fetches = 0      # [slots, V] transfers (sampling only)
         self._rngs: dict[int, np.random.Generator] = {}
         self._ttft, self._tpot, self._e2e = _serving_hists()
         self._pages_hist = _obs.histogram(
@@ -150,29 +197,45 @@ class Engine:
             "pages-in-use sampled at each decode step",
             buckets=_pages_buckets(self.blocks.num_pages))
 
-        self._step_fn = jax.jit(self._build_step(), donate_argnums=(1, 2))
+        # donate everything the step rewrites: pools, pos/tok, the ring
+        # and its cursor — steady-state decode double-buffers nothing
+        self._step_fn = jax.jit(self._build_step(),
+                                donate_argnums=(1, 2, 4, 5, 7, 8))
         self._prefill_fns: dict[int, object] = {}   # bucket -> jitted fn
+        self._prefill_cached_fns: dict[int, object] = {}
+        # CoW page copy: src/dst are data — one trace for the engine
+        self._copy_page_fn = jax.jit(
+            lambda kp, vp, src, dst: (kp.at[:, dst].set(kp[:, src]),
+                                      vp.at[:, dst].set(vp[:, src])),
+            donate_argnums=(0, 1))
 
     # ------------------------------------------------------ jitted bodies
     def _build_step(self):
         cfg = self.config
         L = cfg.num_hidden_layers
         emit_logits = self.emit_logits
+        rope_len = self._rope_len
         engine = self
 
-        def step(state, kpool, vpool, table, pos, tok, cos, sin):
+        def step(state, kpool, vpool, table, pos, tok, active, ring,
+                 ridx, cos, sin):
             # python body runs at trace time only: a second execution of
             # this line means an admission/eviction re-traced the step
             engine.decode_traces += 1
             _M_STEP_TRACES.inc()
+            # a finished slot keeps decoding until the next host sync
+            # (deferred-sync overrun); clamp so its rope/table lookups
+            # stay in range — overrun writes land in the slot's own
+            # reserved tail or the dump page, never another sequence
+            posc = jnp.minimum(pos, rope_len - 1)
             emb = jnp.take(state["llama.embed_tokens.weight"], tok, axis=0)
-            cos1, sin1 = _rope_at(cos, sin, pos)
+            cos1, sin1 = _rope_at(cos, sin, posc)
             h = emb
             kps, vps = [], []
             for i in range(L):
                 w = _layer_weights(state, i)
                 h, kp_, vp_ = _decode_layer_paged(
-                    w, h, kpool[i], vpool[i], table, cos1, sin1, pos, cfg)
+                    w, h, kpool[i], vpool[i], table, cos1, sin1, posc, cfg)
                 kps.append(kp_)
                 vps.append(vp_)
             kpool = jnp.stack(kps)
@@ -181,7 +244,12 @@ class Engine:
                      cfg.rms_norm_eps)[:, 0]
             logits = _logits_of(state, h).astype(jnp.float32)
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return (kpool, vpool, nxt,
+            act = active.astype(bool)
+            pos2 = pos + active                 # idle slots stay parked
+            tok2 = jnp.where(act, nxt, tok)     # greedy chains on device
+            ring2 = ring.at[ridx].set(nxt)
+            ridx2 = (ridx + 1) % ring.shape[0]
+            return (kpool, vpool, pos2, tok2, ring2, ridx2,
                     logits if emit_logits else jnp.zeros((), jnp.float32))
 
         return step
@@ -215,8 +283,69 @@ class Engine:
             logits = _logits_of(state, last).astype(jnp.float32)
             return kpool, vpool, logits
 
+        # kpool/vpool donation: prefill updates the pool in place instead
+        # of double-buffering the engine's whole KV footprint per admit
         fn = jax.jit(prefill, donate_argnums=(4, 5))
         self._prefill_fns[bucket] = fn
+        return fn
+
+    def _prefill_cached_fn(self, bucket: int):
+        """Suffix prefill for a prompt whose first ``cached_len`` tokens
+        are already resident in the pool (shared prefix pages and/or a
+        CoW-copied tail).  One trace per suffix bucket: the prefix
+        length, table row, and positions are all data."""
+        fn = self._prefill_cached_fns.get(bucket)
+        if fn is not None:
+            return fn
+        cfg = self.config
+        L = cfg.num_hidden_layers
+        kvh = cfg.num_key_value_heads
+        ps = self.page_size
+        W = self.table_width
+        dump = self.blocks.dump_page
+        rope_len = self._rope_len
+
+        def prefill(state, ids, length, cached_len, row, kpool, vpool,
+                    cos, sin):
+            _M_PREFILL_TRACES.labels(f"cached:{bucket}").inc()
+            x = jnp.take(state["llama.embed_tokens.weight"], ids, axis=0)
+            j = jnp.arange(bucket)
+            absp = cached_len + j               # absolute positions
+            posc = jnp.minimum(absp, rope_len - 1)
+            cos_s = jnp.take(cos, posc, axis=0)
+            sin_s = jnp.take(sin, posc, axis=0)
+            # suffix queries see: resident prefix keys (< cached_len),
+            # then causal within the (padded) suffix
+            t_pre = jnp.arange(W * ps)
+            pre_ok = jnp.broadcast_to(t_pre[None, :] < cached_len,
+                                      (bucket, W * ps))
+            suf_ok = (j[None, :] <= j[:, None]) & (j[None, :] < length[0])
+            mask = jnp.concatenate([pre_ok, suf_ok], axis=1)[None, None]
+            # per-token write targets (padding lands on the dump page)
+            valid = j < length[0]
+            page_w = jnp.where(valid,
+                               row[jnp.minimum(absp // ps, W - 1)], dump)
+            off = absp % ps
+            heads = jnp.arange(kvh)
+            for i in range(L):
+                w = _layer_weights(state, i)
+                kpre = gather_kv_pages(kpool[i], row)
+                vpre = gather_kv_pages(vpool[i], row)
+                x, k, v = _prefill_layer_cached(
+                    w, x, kpre[None], vpre[None], cos_s, sin_s, mask, cfg)
+                kpool = kpool.at[i, page_w[:, None], heads[None, :],
+                                 off[:, None]].set(k[0])
+                vpool = vpool.at[i, page_w[:, None], heads[None, :],
+                                 off[:, None]].set(v[0])
+            x = _rms(x, state["llama.norm.weight"], cfg.rms_norm_eps)
+            last = jnp.take_along_axis(
+                x, (length - 1)[:, None, None].astype(jnp.int32),
+                axis=1)[:, 0]
+            logits = _logits_of(state, last).astype(jnp.float32)
+            return kpool, vpool, logits
+
+        fn = jax.jit(prefill, donate_argnums=(5, 6))
+        self._prefill_cached_fns[bucket] = fn
         return fn
 
     # ----------------------------------------------------------- intake
@@ -286,48 +415,109 @@ class Engine:
     def _prefill(self, slot: int, req: Request):
         ps = self.page_size
         plen = req.prompt.size
-        bucket = -(-plen // ps) * ps
-        ids = np.zeros((1, bucket), np.int32)
-        ids[0, :plen] = req.prompt
+        meta = self.blocks.seq_meta(req.id)
+        cached = int(meta["cached_len"])
         row = self.blocks.table_row(req.id, self.table_width)
-        fn = self._prefill_fn(bucket)
-        self.kpool, self.vpool, logits = fn(
-            self.state, jnp.asarray(ids),
-            jnp.asarray([plen], jnp.int32),
-            jnp.asarray(row[:bucket // ps]),
-            self.kpool, self.vpool, self._cos, self._sin)
+        if meta["cow_src"] is not None:
+            # copy-on-write: duplicate the matching tail page into this
+            # request's own tail before any of its writes land there
+            self.kpool, self.vpool = self._copy_page_fn(
+                self.kpool, self.vpool,
+                jnp.asarray(meta["cow_src"], jnp.int32),
+                jnp.asarray(int(row[cached // ps]), jnp.int32))
+        if cached == 0:
+            bucket = -(-plen // ps) * ps
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :plen] = req.prompt
+            fn = self._prefill_fn(bucket)
+            self.kpool, self.vpool, logits = fn(
+                self.state, jnp.asarray(ids),
+                jnp.asarray([plen], jnp.int32),
+                jnp.asarray(row[:bucket // ps]),
+                self.kpool, self.vpool, self._cos, self._sin)
+        else:
+            suffix = plen - cached
+            bucket = -(-suffix // ps) * ps
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :suffix] = req.prompt[cached:]
+            fn = self._prefill_cached_fn(bucket)
+            self.kpool, self.vpool, logits = fn(
+                self.state, jnp.asarray(ids),
+                jnp.asarray([suffix], jnp.int32),
+                jnp.asarray(cached, jnp.int32), jnp.asarray(row),
+                self.kpool, self.vpool, self._cos, self._sin)
+        req.num_cached_tokens = cached
+        _M_HOST_SYNCS.labels("prefill").inc()
         tok = self._pick_token(req, np.asarray(logits)[0])
         now = self._clock()
         self._ttft.observe(now - req.arrival_time)
         self.table[slot] = row
         self._pos[slot] = plen
         self._tok[slot] = tok
+        self._active[slot] = 1
+        self._push_slot(slot)
         req.state = RequestState.DECODE
         self._emit(slot, req, tok, now)
 
     # ------------------------------------------------------------ decode
     def _decode(self, active: list[int]):
-        self.kpool, self.vpool, nxt, logits = self._step_fn(
-            self.state, self.kpool, self.vpool,
-            jnp.asarray(self.table), jnp.asarray(self._pos),
-            jnp.asarray(self._tok), self._cos, self._sin)
+        reqs = [(s, self.scheduler.slots[s]) for s in active]
+        (self.kpool, self.vpool, self._pos_dev, self._tok_dev,
+         self._ring_dev, self._ridx_dev, logits) = self._step_fn(
+            self.state, self.kpool, self.vpool, self._table_dev,
+            self._pos_dev, self._tok_dev, self._active_dev,
+            self._ring_dev, self._ridx_dev, self._cos, self._sin)
         _M_STEPS.inc()
         self._pages_hist.observe(self.blocks.pages_in_use)
-        nxt = np.asarray(nxt)
-        logits = np.asarray(logits) if self.emit_logits else None
-        now = self._clock()
         for slot in active:
-            req = self.scheduler.slots[slot]
-            if req.gen.do_sample:
-                tok = self._pick_token(req, logits[slot])
-            else:
-                tok = int(nxt[slot])
-            prev = req.last_token_at
-            if prev is not None:
-                self._tpot.observe(now - prev)
-            self._pos[slot] += 1
-            self._tok[slot] = tok
-            self._emit(slot, req, tok, now)
+            self._pos[slot] += 1            # mirror of pos + active
+        self._pending.append((self._ring_cursor, reqs))
+        self._ring_cursor = (self._ring_cursor + 1) % self.sync_interval
+        self._last_logits = logits if self.emit_logits else None
+        # any active sampling request needs its token fed back before
+        # the next step, so sampling degrades to a per-step sync
+        eff = 1 if any(r.gen.do_sample for _, r in reqs) \
+            else self.sync_interval
+        if len(self._pending) >= eff:
+            self._sync()
+
+    def _sync(self):
+        """Drain the device token ring: ONE [sync_interval, slots] int32
+        transfer covers every decode step since the previous sync."""
+        ring = np.asarray(self._ring_dev)
+        self.host_syncs += 1
+        _M_HOST_SYNCS.labels("ring").inc()
+        logits_np = None
+        now = self._clock()
+        n_rows = len(self._pending)
+        corrections = []
+        for row_i, (ridx, entries) in enumerate(self._pending):
+            for slot, req in entries:
+                if req.is_finished() or req.state != RequestState.DECODE:
+                    continue        # evicted/finished: overrun discarded
+                tok = int(ring[ridx, slot])
+                if req.gen.do_sample:
+                    # sampling rows only exist under eff-interval 1, so
+                    # the step's logits handle is always the right row
+                    if logits_np is None:
+                        logits_np = np.asarray(self._last_logits)
+                        self.logit_fetches += 1
+                        _M_HOST_SYNCS.labels("logits").inc()
+                    tok = self._pick_token(req, logits_np[slot])
+                    if tok != int(ring[ridx, slot]):
+                        corrections.append((slot, tok))
+                prev = req.last_token_at
+                if prev is not None:
+                    # batched sync: spread the interval over the tokens
+                    # it covers so TPOT keeps per-token semantics
+                    self._tpot.observe((now - prev) / (n_rows - row_i))
+                self._tok[slot] = tok
+                self._emit(slot, req, tok, now)
+        self._pending.clear()
+        if corrections:
+            idx = jnp.asarray([s for s, _ in corrections], jnp.int32)
+            val = jnp.asarray([t for _, t in corrections], jnp.int32)
+            self._tok_dev = self._tok_dev.at[idx].set(val)
 
     def _emit(self, slot: int, req: Request, tok: int, now: float):
         req._emit(tok, now)
@@ -346,6 +536,18 @@ class Engine:
         self.table[slot] = self.blocks.empty_row(self.table_width)
         self._pos[slot] = 0
         self._tok[slot] = 0
+        self._active[slot] = 0
+        self._push_slot(slot)
+
+    def _push_slot(self, slot: int):
+        """Patch ONE slot's row of the device-resident decode state from
+        the host mirrors (admission / eviction only — never per step)."""
+        self._table_dev = self._table_dev.at[slot].set(
+            jnp.asarray(self.table[slot]))
+        self._pos_dev = self._pos_dev.at[slot].set(int(self._pos[slot]))
+        self._tok_dev = self._tok_dev.at[slot].set(int(self._tok[slot]))
+        self._active_dev = self._active_dev.at[slot].set(
+            int(self._active[slot]))
 
     # --------------------------------------------------------- sampling
     def _pick_token(self, req: Request, logits: np.ndarray) -> int:
@@ -391,14 +593,56 @@ class Engine:
 
     # -------------------------------------------------------------- info
     def stats(self) -> dict:
+        b = self.blocks
         return {
             "queued": len(self.scheduler.queue),
             "active": self.scheduler.active_count,
-            "pages_in_use": self.blocks.pages_in_use,
-            "pages_total": self.blocks.num_pages,
+            "pages_in_use": b.pages_in_use,
+            "pages_total": b.num_pages,
             "decode_traces": self.decode_traces,
             "prefill_buckets": sorted(self._prefill_fns),
+            "cached_prefill_buckets": sorted(self._prefill_cached_fns),
+            "prefix_hits": b.prefix_hits,
+            "prefix_misses": b.prefix_misses,
+            "prefix_evictions": b.prefix_evictions,
+            "cow_copies": b.cow_copies,
+            "cached_tokens": b.cached_tokens,
+            "cached_pages": b.cached_pages,
+            "host_syncs": self.host_syncs,
+            "logit_fetches": self.logit_fetches,
         }
+
+
+def _prefill_layer_cached(w, x, kpre, vpre, cos_s, sin_s, mask,
+                          cfg: LlamaConfig):
+    """One transformer layer of suffix prefill against a resident
+    prefix: ``x`` [1, S, H] suffix hidden, ``kpre``/``vpre``
+    [1, Tpre, kvH, D] prefix KV gathered from the pool (keys already
+    rotary-encoded at their absolute positions, exactly as prefill and
+    decode wrote them), ``mask`` [1, 1, S, Tpre+S] bool.  Returns
+    (out, k_suffix, v_suffix) — mirror of ``_prefill_layer``."""
+    b, s, _ = x.shape
+    nh, kvh, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                   cfg.head_dim)
+    h = _rms(x, w["ln1"], cfg.rms_norm_eps)
+    qp, kp, vp = _qkv_proj(w, h, nh, kvh, hd)
+    q = qp.reshape(b, s, nh, hd)
+    k = kp.reshape(b, s, kvh, hd)
+    v = vp.reshape(b, s, kvh, hd)
+    cos_c = cos_s[None, :, None, :].astype(q.dtype)
+    sin_c = sin_s[None, :, None, :].astype(q.dtype)
+    q = q * cos_c + _rotate_half(q) * sin_c
+    k = k * cos_c + _rotate_half(k) * sin_c
+
+    from ..ops.pallas.flash_attention import sdpa
+    kcat = jnp.concatenate([kpre.astype(k.dtype), k], axis=1)
+    vcat = jnp.concatenate([vpre.astype(v.dtype), v], axis=1)
+    attn = sdpa(q, kcat, vcat, attn_mask=mask,
+                is_causal=False).reshape(b, s, nh * hd)
+    x = x + _mm(attn, w["o"])
+    h = _rms(x, w["ln2"], cfg.rms_norm_eps)
+    from ..models.generation import _ffn
+    return (x + _ffn(w, h), k, v)
 
 
 def _softmax(x):
@@ -427,19 +671,31 @@ def _pages_buckets(num_pages):
 def create_engine(model, *, max_slots: int = 4, page_size: int = 64,
                   num_pages: int | None = None,
                   max_model_len: int | None = None,
-                  emit_logits: bool = False, clock=time.monotonic
+                  emit_logits: bool = False,
+                  enable_prefix_cache: bool = False,
+                  sync_interval: int = 1, clock=time.monotonic
                   ) -> Engine:
     """`create_predictor`-style entry point: build a continuous-batching
     engine over a LlamaForCausalLM (or any model exposing ``config`` and
     ``functional_state()`` with the llama state-dict layout).
 
+    ``enable_prefix_cache=True`` turns on automatic prefix caching:
+    prompts sharing page-aligned prefixes reuse resident KV pages and
+    prefill only their uncached suffix.  ``sync_interval=N`` lets the
+    greedy decode loop run N device steps between host syncs (tokens
+    stream out in bursts of N — lower sync overhead, higher streaming
+    latency; sampling requests force per-step syncs regardless).
+
     Example::
 
-        engine = create_engine(model, max_slots=8, page_size=64)
+        engine = create_engine(model, max_slots=8, page_size=64,
+                               enable_prefix_cache=True, sync_interval=8)
         req = engine.submit([1, 2, 3], GenerationConfig(max_new_tokens=32))
         for tok in req.stream():
             ...
     """
     return Engine(model, max_slots=max_slots, page_size=page_size,
                   num_pages=num_pages, max_model_len=max_model_len,
-                  emit_logits=emit_logits, clock=clock)
+                  emit_logits=emit_logits,
+                  enable_prefix_cache=enable_prefix_cache,
+                  sync_interval=sync_interval, clock=clock)

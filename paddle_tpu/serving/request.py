@@ -5,6 +5,9 @@ any live state).  Tokens stream out as they are sampled: consumers can
 poll :attr:`output_tokens`, register an ``on_token`` callback, or pull
 from :meth:`stream` (which drives the attached engine when it runs dry,
 so a plain ``for tok in req.stream():`` serves the request end to end).
+With ``sync_interval > 1`` tokens surface in bursts of up to
+``sync_interval`` — the host only observes the device token ring at
+sync points, trading streaming latency for fewer device round-trips.
 """
 from __future__ import annotations
 
@@ -59,6 +62,9 @@ class Request:
         self.cancel_requested = False
         self.finish_reason: str | None = None   # length|eos|cancelled|deadline
         self.output_tokens: list[int] = []
+        # prompt tokens served from the engine's prefix cache at
+        # admission (0 with caching off); set by Engine._prefill
+        self.num_cached_tokens = 0
 
         # timing (engine clock): TTFT = first_token_at - arrival_time
         self.arrival_time = time.monotonic() if arrival_time is None \

@@ -102,9 +102,11 @@ class Scheduler:
                 _M_BACKPRESSURE.labels("slots").inc()
                 break
             head = self.queue[0]
-            need = self.blocks.pages_needed(head.prompt.size,
-                                            head.gen.max_new_tokens)
-            pages = self.blocks.allocate(head.id, need)
+            # prefix-cache-aware reservation: shared prefix pages are
+            # refcounted, only the uncached suffix is charged against
+            # the pool — with caching off this is the plain page count
+            pages = self.blocks.allocate_seq(head.id, head.prompt,
+                                             head.gen.max_new_tokens)
             if pages is None:
                 # pool exhausted: the head waits (and blocks the queue —
                 # strict FCFS), surfaced as backpressure, not an error

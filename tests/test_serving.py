@@ -72,6 +72,72 @@ class TestBlockManager:
             bm.table_row(7, width=1)
 
 
+# ---------------------------------------------------------- prefix cache
+class TestPrefixCacheBlockManager:
+    def test_chain_match_refcounts_and_lru_park(self):
+        bm = BlockManager(num_pages=16, page_size=4,
+                          enable_prefix_cache=True)
+        A = tuple(range(100, 112))              # 12 tokens = 3 full chunks
+        a = bm.allocate_seq(0, A, max_new_tokens=4)
+        assert len(a) == 4                      # 16 tokens -> 4 pages
+        assert bm.seq_meta(0) == {"cached_len": 0, "cow_src": None}
+        bm.free_seq(0)
+        # the 3 registered chunk pages park in the LRU (still matchable);
+        # the unregistered decode page went back to the free list
+        assert bm.cached_pages == 3
+        assert bm.pages_in_use == 0
+        b = bm.allocate_seq(1, A, max_new_tokens=4)
+        # full-prompt hit drops the LAST chunk so one token still runs
+        # through the model (its logits seed decoding)
+        assert bm.seq_meta(1)["cached_len"] == 8
+        assert b[:2] == a[:2]                   # shared chain pages
+        # misses: 3 cold chunks at seq 0's admission + the dropped one
+        assert bm.prefix_hits == 2 and bm.prefix_misses == 4
+        bm.free_seq(1)
+        assert bm.pages_in_use == 0             # refcounts back to 0
+
+    def test_cow_tail_match(self):
+        bm = BlockManager(num_pages=8, page_size=4,
+                          enable_prefix_cache=True)
+        a = bm.allocate_seq(0, (1, 2, 3, 4, 5, 6), max_new_tokens=2)
+        bm.free_seq(0)
+        # B shares the full chunk and 1 of 2 tail tokens -> chain hit +
+        # copy-on-write from A's tail page
+        b = bm.allocate_seq(1, (1, 2, 3, 4, 5, 9), max_new_tokens=2)
+        meta = bm.seq_meta(1)
+        assert b[0] == a[0]                     # shared chunk page
+        assert meta["cached_len"] == 5          # 4 (chunk) + 1 (tail lcp)
+        assert meta["cow_src"] == a[1]          # A's tail page
+        assert bm.cow_copies == 1
+
+    def test_eviction_leaf_first_under_pressure(self):
+        bm = BlockManager(num_pages=4, page_size=4,
+                          enable_prefix_cache=True)
+        bm.allocate_seq(0, tuple(range(50, 62)), max_new_tokens=4)
+        bm.free_seq(0)
+        assert bm.cached_pages == 3 and bm.free_pages == 1
+        assert bm.can_allocate(4)               # LRU pages are reclaimable
+        # a disjoint prompt needs all 4 pages: 1 free + 3 LRU evictions
+        pages = bm.allocate_seq(1, tuple(range(200, 212)),
+                                max_new_tokens=4)
+        assert pages is not None and len(pages) == 4
+        assert bm.prefix_evictions == 3
+        assert bm.cached_pages == 3             # seq 1's chunks registered
+
+    def test_backpressure_rolls_back_matched_refs(self):
+        bm = BlockManager(num_pages=4, page_size=4,
+                          enable_prefix_cache=True)
+        A = tuple(range(10, 18))                # 2 chunks
+        bm.allocate_seq(0, A, max_new_tokens=4)     # 3 pages, still live
+        # same prefix, but the suffix does not fit -> None, and the
+        # matched pages' refcounts roll back to A's alone
+        assert bm.allocate_seq(1, A + tuple(range(90, 98)),
+                               max_new_tokens=8) is None
+        assert bm.pages_of(1) == []
+        bm.free_seq(0)
+        assert bm.pages_in_use == 0
+
+
 # ------------------------------------------------------------- scheduler
 class TestScheduler:
     def _req(self, plen, n_new, **kw):
@@ -363,6 +429,147 @@ def test_engine_sampling_per_request_rng(tiny_model):
     assert greedy.num_generated == sampled.num_generated == 6
     assert all(0 <= t < 128 for t in sampled.output_tokens)
     assert eng.decode_traces == 1   # sampling is host-side: same trace
+
+
+def _greedy_outputs(model, prompts, n_new, **engine_kw):
+    eng = create_engine(model, **engine_kw)
+    reqs = [eng.submit(p, GenerationConfig(max_new_tokens=n))
+            for p, n in zip(prompts, n_new)]
+    eng.run_until_complete(max_steps=500)
+    assert all(r.state == RequestState.DONE for r in reqs)
+    return eng, [r.output_tokens for r in reqs]
+
+
+def test_engine_prefix_cache_parity_and_cow_divergence(tiny_model,
+                                                       tmp_path):
+    """The ISSUE acceptance invariant: greedy decode is token-for-token
+    identical with prefix caching on vs. off, including two requests
+    that share a 19-token prefix and diverge in the last prompt token
+    (chain hit on 2 full pages + copy-on-write off the shared tail
+    page), and again with deferred host sync (sync_interval=4)."""
+    obs.reset()
+    model = tiny_model
+    a = np.arange(1, 21).astype(np.int32)       # 20 tokens, ps=8
+    b = a.copy()
+    b[19] = 99                                  # diverge at token 19
+    prompts, n_new = [a, b], [6, 6]
+    kw = dict(max_slots=2, page_size=8, max_model_len=64)
+
+    _, ref = _greedy_outputs(model, prompts, n_new, **kw)
+    eng, got = _greedy_outputs(model, prompts, n_new,
+                               enable_prefix_cache=True, **kw)
+    assert got == ref, "prefix caching changed greedy output"
+    # b matched a's two full chunk pages (a registered them at its own
+    # admission in the same scheduling pass) and CoW'd the shared tail
+    st = eng.stats()
+    assert st["prefix_hits"] == 2 and st["cow_copies"] == 1
+    assert st["cached_tokens"] == 19
+    assert st["pages_in_use"] == 0              # refcounts back to 0
+    assert st["cached_pages"] > 0               # ...but still matchable
+    assert eng.decode_traces == 1
+
+    # same workload again, submitted AFTER the first pair finished
+    # (matches against LRU-parked pages) and with deferred host sync
+    eng2, got2 = _greedy_outputs(model, prompts, n_new,
+                                 enable_prefix_cache=True,
+                                 sync_interval=4, **kw)
+    assert got2 == ref, "deferred host sync changed greedy output"
+    c = eng2.submit(a, GenerationConfig(max_new_tokens=6))
+    eng2.run_until_complete(max_steps=200)
+    assert c.output_tokens == ref[0]
+    assert c.num_cached_tokens == 19    # CoW cap: >=1 token recomputes
+    assert eng2.decode_traces == 1
+
+    # the new metrics render in the serving report
+    out_dir = obs.dump(str(tmp_path / "m"))
+    with open(os.path.join(out_dir, "metrics.json")) as f:
+        metrics = json.load(f)
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import metrics_report
+        text = metrics_report.report(metrics, None)
+    finally:
+        sys.path.pop(0)
+    assert "prefix-cache page hit rate" in text
+    assert "serving_host_syncs_total" in text
+
+
+def test_engine_prefix_cache_eviction_under_pressure(tiny_model):
+    """Cached refcount-0 pages are reclaimed (LRU, leaf-first) when a
+    disjoint request needs the pool — and the evicted-cache request
+    still decodes correctly."""
+    model = tiny_model
+    a = np.arange(1, 17).astype(np.int32)       # 2 full pages, ps=8
+    d = np.arange(40, 64).astype(np.int32)      # disjoint, 3 pages
+    kw = dict(max_slots=1, page_size=8, num_pages=4, max_model_len=32)
+    _, ref = _greedy_outputs(model, [a, d], [8, 8], **kw)
+
+    eng = create_engine(model, enable_prefix_cache=True, **kw)
+    ra = eng.submit(a, GenerationConfig(max_new_tokens=8))
+    eng.run_until_complete(max_steps=100)
+    assert eng.stats()["cached_pages"] == 2     # a's chunks parked
+    rd = eng.submit(d, GenerationConfig(max_new_tokens=8))
+    eng.run_until_complete(max_steps=100)
+    assert [ra.output_tokens, rd.output_tokens] == ref
+    st = eng.stats()
+    assert st["prefix_evictions"] >= 1          # pool forced eviction
+    assert eng.decode_traces == 1
+
+
+def test_engine_sync_interval_host_syncs_and_logits_skip(tiny_model):
+    """Device-resident decode: the host drains the token ring once per
+    sync_interval greedy steps, and the [slots, vocab] logits transfer
+    is skipped entirely unless an active request samples."""
+    model = tiny_model
+    p = np.arange(1, 10).astype(np.int32)
+    kw = dict(max_slots=2, page_size=8, max_model_len=64,
+              emit_logits=True)
+    _, ref = _greedy_outputs(model, [p], [9], **kw)
+    eng, got = _greedy_outputs(model, [p], [9], sync_interval=4, **kw)
+    assert got == ref
+    # 8 decode steps (the 9th token comes from prefill) = 2 ring drains
+    assert eng.host_syncs == 2
+    # all-greedy: emit_logits=True must not pull logits to the host
+    assert eng.logit_fetches == 0
+
+    # a sampling request forces per-step syncs + logits fetches
+    rs = eng.submit(p, GenerationConfig(max_new_tokens=4,
+                                        do_sample=True, seed=5))
+    eng.run_until_complete(max_steps=100)
+    assert rs.num_generated == 4
+    assert eng.logit_fetches >= 3               # one per sampled step
+    assert eng.decode_traces == 1
+
+
+def test_engine_prefix_cache_staggered_no_retrace(tiny_model):
+    """Admissions/evictions with caching enabled (shared-prefix
+    workload, staggered arrivals, deferred sync) never retrace the
+    decode step."""
+    model = tiny_model
+    rng = np.random.default_rng(3)
+    shared = rng.integers(0, 128, 16).astype(np.int32)
+    prompts = [np.concatenate([shared,
+                               rng.integers(0, 128, int(n)).astype(
+                                   np.int32)])
+               for n in rng.integers(2, 9, 6)]
+    n_new = [int(n) for n in rng.integers(3, 8, 6)]
+    eng = create_engine(model, max_slots=2, page_size=8,
+                        max_model_len=64, enable_prefix_cache=True,
+                        sync_interval=3)
+    reqs, pending, steps = [], list(zip(prompts, n_new)), 0
+    while pending or eng.scheduler.has_work():
+        if pending:
+            pp, nn = pending.pop(0)
+            reqs.append(eng.submit(pp, GenerationConfig(
+                max_new_tokens=nn)))
+        eng.step()
+        steps += 1
+        assert steps < 500
+    assert all(r.state == RequestState.DONE for r in reqs)
+    assert eng.decode_traces == 1
+    st = eng.stats()
+    assert st["prefix_hits"] > 0                # the shared prefix hit
+    assert st["pages_in_use"] == 0
 
 
 @pytest.mark.slow

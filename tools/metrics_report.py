@@ -132,6 +132,12 @@ def _serving_section(metrics):
                  "serving_admissions_total", "serving_evictions_total",
                  "serving_backpressure_total", "serving_requests_total",
                  "serving_decode_step_traces_total",
+                 "serving_host_syncs_total",
+                 "serving_prefix_cache_pages_total",
+                 "serving_prefix_cached_tokens_total",
+                 "serving_prefix_cache_evictions_total",
+                 "serving_prefix_cache_cow_total",
+                 "serving_prefix_cached_pages",
                  "serving_queue_depth", "serving_active_slots",
                  "serving_pages_in_use", "serving_pages_total"):
         entry = metrics.get(name)
@@ -142,6 +148,31 @@ def _serving_section(metrics):
                          _fmt_value(s.get("value", 0))))
     if rows:
         lines.append(_table(rows, ("name", "labels", "value")))
+    prefix = metrics.get("serving_prefix_cache_pages_total")
+    if prefix:
+        hits = misses = 0
+        for s in prefix.get("series", []):
+            if s.get("labels", {}).get("result") == "hit":
+                hits += s.get("value", 0)
+            else:
+                misses += s.get("value", 0)
+        if hits + misses:
+            lines.append(
+                f"  prefix-cache page hit rate: "
+                f"{100.0 * hits / (hits + misses):.1f}% "
+                f"({_fmt_value(hits)}/{_fmt_value(hits + misses)} "
+                f"full-chunk lookups)")
+    syncs = metrics.get("serving_host_syncs_total")
+    steps = metrics.get("serving_decode_steps_total")
+    if syncs and steps:
+        ring = sum(s.get("value", 0) for s in syncs.get("series", [])
+                   if s.get("labels", {}).get("kind") == "ring")
+        n_steps = sum(s.get("value", 0)
+                      for s in steps.get("series", []))
+        if ring and n_steps:
+            lines.append(f"  host syncs: {_fmt_value(ring)} ring fetches "
+                         f"over {_fmt_value(n_steps)} decode steps "
+                         f"({n_steps / ring:.1f} steps/sync)")
     back = metrics.get("serving_backpressure_total")
     if back:
         events = sum(s.get("value", 0) for s in back.get("series", []))

@@ -10,8 +10,15 @@ Usage:
     python tools/serve_bench.py [--requests 16] [--max-slots 4]
         [--page-size 16] [--arrival-gap-ms 5]
         [--prompt-len 8 24] [--new-tokens 4 24]
+        [--shared-prefix-len 0] [--sync-interval 1]
+        [--prefix-cache | --no-prefix-cache]
         [--layers 2 --hidden 64 --vocab 128]
         [--metrics-dir /tmp/serve_metrics] [--seed 0]
+
+``--shared-prefix-len N`` prepends one common N-token prefix to every
+prompt (the system-prompt / few-shot pattern prefix caching targets);
+with ``--prefix-cache`` (default on) the report adds the prefix-cache
+page hit rate, pages saved, and host-sync counts next to TTFT/TPOT.
 
 The model is a randomly initialized tiny llama (this benchmarks the
 ENGINE — scheduling, paging, dispatch — not the matmuls); sizes are
@@ -56,16 +63,22 @@ def run_bench(args):
     engine = create_engine(model, max_slots=args.max_slots,
                            page_size=args.page_size,
                            num_pages=args.num_pages,
-                           max_model_len=args.max_model_len)
+                           max_model_len=args.max_model_len,
+                           enable_prefix_cache=args.prefix_cache,
+                           sync_interval=args.sync_interval)
 
     plo, phi = args.prompt_len
     nlo, nhi = args.new_tokens
+    shared = rng.integers(0, args.vocab,
+                          args.shared_prefix_len).astype(np.int32)
     workload = []
     for i in range(args.requests):
+        suffix = rng.integers(0, args.vocab,
+                              int(rng.integers(plo, phi + 1))).astype(
+                                  np.int32)
         workload.append((
             i * args.arrival_gap_ms / 1e3,
-            rng.integers(0, args.vocab,
-                         int(rng.integers(plo, phi + 1))).astype(np.int32),
+            np.concatenate([shared, suffix]) if shared.size else suffix,
             int(rng.integers(nlo, nhi + 1))))
 
     t0 = time.monotonic()
@@ -105,7 +118,21 @@ def run_bench(args):
               f"{_percentile(tpots, 0.95) * 1e3:.2f} ms")
     print(f"  decode-step traces   {stats['decode_traces']} "
           f"(continuous batching wants exactly 1)")
-    print(f"  prefill buckets      {stats['prefill_buckets']}")
+    print(f"  prefill buckets      {stats['prefill_buckets']}"
+          + (f" cached={stats['cached_prefill_buckets']}"
+             if stats['cached_prefill_buckets'] else ""))
+    lookups = stats["prefix_hits"] + stats["prefix_misses"]
+    hit_rate = stats["prefix_hits"] / lookups if lookups else 0.0
+    if args.prefix_cache:
+        print(f"  prefix cache         hit rate {hit_rate * 100:.1f}% "
+              f"({stats['prefix_hits']}/{lookups} page lookups), "
+              f"{stats['prefix_hits']} pages saved, "
+              f"{stats['cached_tokens']} prompt tokens skipped, "
+              f"{stats['cow_copies']} CoW copies, "
+              f"{stats['prefix_evictions']} evictions")
+    print(f"  host syncs           {stats['host_syncs']} ring "
+          f"(~1/{args.sync_interval} per token) + "
+          f"{stats['logit_fetches']} logits fetches")
 
     if args.metrics_dir:
         out = obs.dump(args.metrics_dir)
@@ -113,7 +140,11 @@ def run_bench(args):
               f"(render: python tools/metrics_report.py {out})")
     return {"requests": len(reqs), "tokens": toks, "wall_s": wall,
             "throughput": toks / wall, "ttft_s": ttfts, "tpot_s": tpots,
-            "decode_traces": stats["decode_traces"]}
+            "decode_traces": stats["decode_traces"],
+            "prefix_hit_rate": hit_rate,
+            "pages_saved": stats["prefix_hits"],
+            "host_syncs": stats["host_syncs"],
+            "logit_fetches": stats["logit_fetches"]}
 
 
 def main(argv=None):
@@ -128,6 +159,14 @@ def main(argv=None):
                     metavar=("LO", "HI"))
     ap.add_argument("--new-tokens", type=int, nargs=2, default=(4, 24),
                     metavar=("LO", "HI"))
+    ap.add_argument("--shared-prefix-len", type=int, default=0,
+                    help="common prompt prefix prepended to every "
+                         "request (exercises the prefix cache)")
+    ap.add_argument("--sync-interval", type=int, default=1,
+                    help="greedy decode steps per host sync")
+    ap.add_argument("--prefix-cache",
+                    action=argparse.BooleanOptionalAction, default=True,
+                    help="automatic prefix caching over the KV pool")
     ap.add_argument("--layers", type=int, default=2)
     ap.add_argument("--hidden", type=int, default=64)
     ap.add_argument("--vocab", type=int, default=128)
