@@ -96,13 +96,25 @@ class UtilBase:
         import numpy as np
         from .. import collective
 
+        ops = {"sum": collective.ReduceOp.SUM,
+               "max": collective.ReduceOp.MAX,
+               "min": collective.ReduceOp.MIN}
+        if mode not in ops:
+            raise ValueError(
+                f"all_reduce mode must be one of {sorted(ops)}, "
+                f"got {mode!r}")
         try:
             import paddle_tpu as paddle
             t = paddle.to_tensor(np.asarray(input))
-            collective.all_reduce(t)
+            collective.all_reduce(t, op=ops[mode])
             return np.asarray(t.numpy())
-        except Exception:
-            return np.asarray(input)
+        except Exception as e:
+            # a swallowed failure here silently returns the UN-reduced
+            # local value — every rank then proceeds with a different
+            # number, which is far worse than failing
+            raise RuntimeError(
+                f"fleet util all_reduce(mode={mode!r}, "
+                f"comm_world={comm_world!r}) failed: {e}") from e
 
     def barrier(self, comm_world="worker"):
         from .. import collective

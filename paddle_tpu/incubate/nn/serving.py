@@ -143,6 +143,11 @@ def _rope_tables(rope_emb, hd, neox=False):
     loudly rather than silently mis-rotating."""
     r = jnp.asarray(rope_emb)
     shape = [s for s in r.shape if s != 1]
+    # squeezing ALL size-1 dims would also collapse a legitimate
+    # single-position table ([2, 1, hd] layouts: S == 1, the first
+    # decode step) down to [2, hd] — keep a sequence axis in that case
+    if len(shape) == 2 and shape[0] == 2:
+        shape = [2, 1, shape[1]]
     r = r.reshape(shape)
     if r.ndim != 3 or r.shape[0] != 2 \
             or r.shape[-1] not in (hd, hd // 2):

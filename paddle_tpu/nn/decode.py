@@ -204,9 +204,22 @@ class BeamSearchDecoder(Decoder):
     def finalize(self, outputs, final_states, sequence_lengths):
         """Back-track beam ancestry (gather_tree) to materialize the
         predicted token sequences [time, batch, beam]."""
+        if outputs.predicted_ids.shape[0] == 0:
+            # zero decode steps: no ancestry to backtrack, and
+            # gather_tree cannot consume an empty time axis
+            return outputs.predicted_ids, final_states
         predicted_ids = F.gather_tree(outputs.predicted_ids,
                                       outputs.parent_ids)
         return predicted_ids, final_states
+
+    def empty_outputs(self):
+        """Zero-step output structure (time dimension 0, time-major) for
+        dynamic_decode's zero-iteration path."""
+        import paddle_tpu as paddle
+        shp = [0, self.batch_size, self.beam_size]
+        return self.OutputWrapper(paddle.zeros(shp, "float32"),
+                                  paddle.zeros(shp, "int64"),
+                                  paddle.zeros(shp, "int64"))
 
     @property
     def tracks_own_finished(self):
@@ -270,19 +283,42 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
         cond = paddle.logical_not(paddle.all(finished))
         step_idx += 1
 
-    import jax
-    _, treedef = jax.tree_util.tree_flatten(
-        step_outputs, is_leaf=lambda x: isinstance(x, Tensor))
-    stacked = [paddle.stack(acc, axis=0) for acc in outputs_list]
-    final_outputs = jax.tree_util.tree_unflatten(treedef, stacked)
-    final_states = states
+    if outputs_list is None:
+        # zero iterations (every beam already finished at initialize, or
+        # max_step_num < 0): there are no step outputs to stack — return
+        # explicitly empty outputs (time dimension 0) instead of tripping
+        # a NameError on the never-assigned per-step locals.  Nothing ran,
+        # so there is no beam ancestry to finalize either.
+        empty = getattr(decoder, "empty_outputs", None)
+        if empty is None:
+            raise ValueError(
+                "dynamic_decode ran zero decode steps (all sequences "
+                "finished at initialize, or max_step_num < 0) and "
+                f"{type(decoder).__name__} does not implement "
+                "empty_outputs(); cannot synthesize an empty output "
+                "structure")
+        final_outputs = empty()
+        final_states = states
+        if hasattr(decoder, "finalize") and not is_test:
+            try:
+                final_outputs, final_states = decoder.finalize(
+                    final_outputs, final_states, sequence_lengths)
+            except NotImplementedError:
+                pass
+    else:
+        import jax
+        _, treedef = jax.tree_util.tree_flatten(
+            step_outputs, is_leaf=lambda x: isinstance(x, Tensor))
+        stacked = [paddle.stack(acc, axis=0) for acc in outputs_list]
+        final_outputs = jax.tree_util.tree_unflatten(treedef, stacked)
+        final_states = states
 
-    if hasattr(decoder, "finalize") and not is_test:
-        try:
-            final_outputs, final_states = decoder.finalize(
-                final_outputs, final_states, sequence_lengths)
-        except NotImplementedError:
-            pass
+        if hasattr(decoder, "finalize") and not is_test:
+            try:
+                final_outputs, final_states = decoder.finalize(
+                    final_outputs, final_states, sequence_lengths)
+            except NotImplementedError:
+                pass
 
     if not output_time_major:
         final_outputs = _map_structure(

@@ -27,6 +27,11 @@ Modules:
   * scheduler.py     — FCFS admission, iteration-level eviction, drain
   * engine.py        — the jitted prefill/decode driver
                        (device-resident state, deferred host sync)
+  * server.py        — OpenAI-compatible HTTP front-end (SSE streaming,
+                       backpressure, graceful drain) over one engine
+  * router.py        — multi-replica router: prefix-affinity routing,
+                       health probing + circuit breaking, bounded retry
+  * client.py        — stdlib blocking/streaming HTTP client
 
 Reference analog: the block_multi_head_attention serving path +
 paddle_infer predictors, restructured as a vLLM/Orca-style engine.
@@ -34,9 +39,17 @@ paddle_infer predictors, restructured as a vLLM/Orca-style engine.
 from __future__ import annotations
 
 from .block_manager import BlockManager  # noqa: F401
+from .client import ServingClient, ServingHTTPError  # noqa: F401
 from .engine import Engine, create_engine  # noqa: F401
 from .request import GenerationConfig, Request, RequestState  # noqa: F401
+from .router import (  # noqa: F401
+    NoReplicaAvailable, Replica, Router, RouterServer)
 from .scheduler import Scheduler  # noqa: F401
+from .server import (  # noqa: F401
+    BackpressureError, DrainingError, EngineWorker, ServingServer, serve)
 
-__all__ = ["BlockManager", "Engine", "GenerationConfig", "Request",
-           "RequestState", "Scheduler", "create_engine"]
+__all__ = ["BackpressureError", "BlockManager", "DrainingError", "Engine",
+           "EngineWorker", "GenerationConfig", "NoReplicaAvailable",
+           "Replica", "Request", "RequestState", "Router", "RouterServer",
+           "Scheduler", "ServingClient", "ServingHTTPError",
+           "ServingServer", "create_engine", "serve"]

@@ -1,0 +1,168 @@
+"""Blocking/streaming HTTP client for the serving front-end.
+
+Stdlib-only (``http.client``) counterpart of ``server.py``'s wire
+protocol, used by tests, ``tools/serve_bench.py --http``, and the
+router's programmatic path::
+
+    client = ServingClient("127.0.0.1:8000")
+    out = client.completion([1, 2, 3], max_tokens=8)
+    out["choices"][0]["token_ids"]
+
+    for ev in client.completion([1, 2, 3], max_tokens=8, stream=True):
+        ev["choices"][0]["token_ids"]   # one token per SSE event
+
+Transport failures (connection refused/reset before a response) raise
+``OSError`` subclasses — the router retries those on another replica.
+An HTTP-level error (429 backpressure, 503 draining, 400 validation)
+raises :class:`ServingHTTPError` carrying status, parsed body, and any
+``Retry-After`` — the replica answered, so the router does NOT retry.
+"""
+from __future__ import annotations
+
+import http.client
+import json
+
+__all__ = ["ServingClient", "ServingHTTPError"]
+
+
+class ServingHTTPError(Exception):
+    """Non-2xx HTTP response from a serving endpoint."""
+
+    def __init__(self, status: int, body, retry_after: float | None = None):
+        self.status = int(status)
+        self.body = body
+        self.retry_after = retry_after
+        msg = body
+        if isinstance(body, dict):
+            msg = (body.get("error") or {}).get("message", body)
+        super().__init__(f"HTTP {status}: {msg}")
+
+
+def _parse_address(address) -> tuple[str, int]:
+    if isinstance(address, (tuple, list)):
+        return str(address[0]), int(address[1])
+    addr = str(address)
+    for scheme in ("http://", "https://"):
+        if addr.startswith(scheme):
+            addr = addr[len(scheme):]
+    addr = addr.rstrip("/")
+    host, _, port = addr.rpartition(":")
+    if not host:
+        raise ValueError(f"address must be host:port, got {address!r}")
+    return host, int(port)
+
+
+class ServingClient:
+    """One serving endpoint (a replica, or a router front-end)."""
+
+    def __init__(self, address, timeout: float = 60.0):
+        self.host, self.port = _parse_address(address)
+        self.address = f"{self.host}:{self.port}"
+        self.timeout = float(timeout)
+
+    def _connect(self) -> http.client.HTTPConnection:
+        return http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout)
+
+    # ------------------------------------------------------ plain JSON
+    def request(self, method: str, path: str, body: dict | None = None):
+        """One JSON round trip; raises ServingHTTPError on non-2xx."""
+        conn = self._connect()
+        try:
+            payload = None if body is None else json.dumps(body).encode()
+            conn.request(method, path, body=payload,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            raw = resp.read()
+            return self._decode(resp, raw)
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _decode(resp, raw: bytes):
+        try:
+            parsed = json.loads(raw.decode() or "null")
+        except (ValueError, UnicodeDecodeError):
+            parsed = raw.decode(errors="replace")
+        if not 200 <= resp.status < 300:
+            ra = resp.headers.get("Retry-After")
+            raise ServingHTTPError(resp.status, parsed,
+                                   retry_after=float(ra) if ra else None)
+        return parsed
+
+    # ----------------------------------------------------- completions
+    def completion(self, prompt, *, max_tokens: int = 16,
+                   stream: bool = False, timeout: float | None = None,
+                   **gen_kw):
+        """POST /v1/completions.  Blocking: the parsed response dict.
+        ``stream=True``: a generator of parsed SSE events (one token
+        per event; closing the generator drops the connection, which
+        cancels the request server-side)."""
+        body = {"prompt": [int(t) for t in prompt],
+                "max_tokens": int(max_tokens), "stream": bool(stream)}
+        if timeout is not None:
+            body["timeout"] = float(timeout)
+        body.update(gen_kw)
+        if not stream:
+            return self.request("POST", "/v1/completions", body)
+        return self._stream_completion(body)
+
+    def _stream_completion(self, body: dict):
+        conn = self._connect()
+        try:
+            conn.request("POST", "/v1/completions",
+                         body=json.dumps(body).encode(),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            if resp.status != 200:
+                self._decode(resp, resp.read())     # raises
+        except BaseException:
+            conn.close()
+            raise
+        return self._iter_sse(conn, resp)
+
+    @staticmethod
+    def _iter_sse(conn, resp):
+        try:
+            while True:
+                line = resp.readline()
+                if not line:            # server closed the stream
+                    return
+                line = line.strip()
+                if not line.startswith(b"data:"):
+                    continue
+                data = line[len(b"data:"):].strip()
+                if data == b"[DONE]":
+                    return
+                yield json.loads(data.decode())
+        finally:
+            conn.close()
+
+    def completion_tokens(self, prompt, **kw) -> list[int]:
+        """Blocking completion, returning just the generated token ids."""
+        out = self.completion(prompt, **kw)
+        return list(out["choices"][0]["token_ids"])
+
+    # ------------------------------------------------------- utilities
+    def healthz(self) -> dict:
+        return self.request("GET", "/healthz")
+
+    def metrics_text(self) -> str:
+        conn = self._connect()
+        try:
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            raw = resp.read()
+            if resp.status != 200:
+                raise ServingHTTPError(resp.status,
+                                       raw.decode(errors="replace"))
+            return raw.decode()
+        finally:
+            conn.close()
+
+    def drain(self, timeout: float | None = None) -> dict:
+        body = {} if timeout is None else {"timeout": timeout}
+        return self.request("POST", "/drain", body)
+
+    def resume(self) -> dict:
+        return self.request("POST", "/resume")

@@ -1,0 +1,509 @@
+"""Multi-replica router: prefix-affinity load balancing + health circuit.
+
+The scaling layer above ``server.py``: N independent engine replicas
+(each its own process or in-process server), one front door.
+
+  * **Prefix-affinity routing** — requests whose prompts share the same
+    page-aligned leading chunk rendezvous-hash to the same replica, so
+    the PR-3 prefix cache keeps its hit rate under multi-replica
+    scale-out (a shared system prompt's KV pages stay hot on ONE
+    replica instead of being rebuilt on all of them).  Prompts shorter
+    than a page, or whose affinity target is down, fall back to the
+    least-loaded replica.
+  * **Health probing + circuit breaking** — a prober hits each
+    replica's ``/healthz``; ``fail_threshold`` consecutive failures
+    open the circuit (replica leaves rotation), and the replica is
+    re-admitted after ``cooldown_s`` (or immediately on a successful
+    probe).  Request-level transport failures count toward the same
+    circuit.
+  * **Bounded retry** — a transport failure *before any response
+    bytes* (connection refused/reset at send) is idempotent to retry:
+    the router retries on up to ``max_retries`` other replicas.
+    HTTP-level answers (429 backpressure, 400 validation) are never
+    retried — the replica spoke.
+
+Use programmatically (:meth:`Router.completion`) or as an HTTP
+front-end (:meth:`Router.serve` — same wire protocol as ``server.py``,
+so :class:`~paddle_tpu.serving.ServingClient` points at either).
+"""
+from __future__ import annotations
+
+import hashlib
+import http.client
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from .. import observability as _obs
+from .client import ServingClient, ServingHTTPError
+
+__all__ = ["NoReplicaAvailable", "Replica", "Router", "RouterServer"]
+
+_M_REQS = _obs.counter(
+    "router_requests_total",
+    "requests routed, by replica and outcome ('ok', 'error', or "
+    "'http_<status>' when the replica answered non-2xx)",
+    ("replica", "outcome"))
+_M_RETRIES = _obs.counter(
+    "router_retries_total",
+    "requests retried on another replica after an idempotent "
+    "transport failure")
+_M_UP = _obs.gauge(
+    "router_replica_up",
+    "1 = replica in rotation, 0 = circuit open", ("replica",))
+_M_PROBES = _obs.counter(
+    "router_probes_total", "health probes", ("replica", "result"))
+_M_PICKS = _obs.counter(
+    "router_picks_total",
+    "replica selection path: 'affinity' (prefix hash target), "
+    "'least_loaded' (no page-aligned prefix, or target down)",
+    ("kind",))
+
+
+class NoReplicaAvailable(RuntimeError):
+    """Every replica is excluded or circuit-open."""
+
+
+class Replica:
+    """One backend endpoint + its circuit-breaker state."""
+
+    def __init__(self, address):
+        self.address = ServingClient(address).address   # normalized
+        self.fails = 0              # consecutive probe/request failures
+        self.down_until = 0.0       # monotonic; 0 = in rotation
+        self.inflight = 0
+        self.last_error: str | None = None
+        self.stats: dict = {}       # last /healthz payload
+        _M_UP.labels(self.address).set(1)
+
+    def available(self, now: float) -> bool:
+        return now >= self.down_until
+
+    def snapshot(self, now: float) -> dict:
+        return {"address": self.address,
+                "up": self.available(now),
+                "fails": self.fails,
+                "inflight": self.inflight,
+                "cooldown_remaining_s": max(0.0, self.down_until - now),
+                "last_error": self.last_error}
+
+
+class Router:
+    """Load balancer over N serving replicas.
+
+    ``addresses`` are ``host:port`` strings.  ``page_size`` must match
+    the replicas' engine page size — the affinity key is the prompt's
+    first ``affinity_pages`` full pages, so only page-aligned sharing
+    (what the prefix cache can actually reuse) influences routing.
+    """
+
+    def __init__(self, addresses, *, page_size: int = 64,
+                 affinity_pages: int = 1, fail_threshold: int = 3,
+                 cooldown_s: float = 2.0, max_retries: int = 1,
+                 probe_interval_s: float = 1.0,
+                 probe_timeout_s: float = 2.0,
+                 request_timeout_s: float = 120.0,
+                 clock=time.monotonic):
+        if not addresses:
+            raise ValueError("router needs at least one replica address")
+        if fail_threshold < 1:
+            raise ValueError("fail_threshold must be >= 1")
+        self.replicas = [Replica(a) for a in addresses]
+        self.page_size = int(page_size)
+        self.affinity_pages = int(affinity_pages)
+        self.fail_threshold = int(fail_threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.max_retries = int(max_retries)
+        self.probe_interval_s = float(probe_interval_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.request_timeout_s = float(request_timeout_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._probe_stop = threading.Event()
+        self._probe_thread: threading.Thread | None = None
+
+    # ------------------------------------------------------- selection
+    def _affinity_key(self, prompt) -> bytes | None:
+        ids = np.asarray(prompt, np.int32).reshape(-1)
+        aligned = (ids.size // self.page_size) * self.page_size
+        take = min(aligned, self.affinity_pages * self.page_size)
+        if take <= 0:
+            return None
+        return hashlib.sha1(ids[:take].tobytes()).digest()
+
+    @staticmethod
+    def _rendezvous_score(key: bytes, address: str) -> int:
+        h = hashlib.sha1(key + address.encode()).digest()
+        return int.from_bytes(h[:8], "big")
+
+    def pick(self, prompt, exclude=()) -> Replica:
+        """Choose a replica for this prompt.  Raises
+        :class:`NoReplicaAvailable` when nothing is in rotation."""
+        now = self._clock()
+        with self._lock:
+            avail = [r for r in self.replicas
+                     if r not in exclude and r.available(now)]
+            if not avail:
+                raise NoReplicaAvailable(
+                    "no replica available: "
+                    + ", ".join(f"{r.address} "
+                                f"(fails={r.fails}, "
+                                f"excluded={r in exclude})"
+                                for r in self.replicas))
+            key = self._affinity_key(prompt)
+            if key is not None:
+                # rendezvous over the FULL replica set (stable as
+                # replicas flap), honored only while the winner is up
+                winner = max(self.replicas,
+                             key=lambda r: self._rendezvous_score(
+                                 key, r.address))
+                if winner in avail:
+                    _M_PICKS.labels("affinity").inc()
+                    return winner
+            chosen = min(avail, key=lambda r: (r.inflight, r.address))
+            _M_PICKS.labels("least_loaded").inc()
+            return chosen
+
+    # --------------------------------------------------------- circuit
+    def _mark_success(self, rep: Replica):
+        with self._lock:
+            rep.fails = 0
+            rep.down_until = 0.0
+            rep.last_error = None
+        _M_UP.labels(rep.address).set(1)
+
+    def _mark_failure(self, rep: Replica, err: BaseException):
+        with self._lock:
+            rep.fails += 1
+            rep.last_error = repr(err)
+            if rep.fails >= self.fail_threshold:
+                rep.down_until = self._clock() + self.cooldown_s
+                opened = True
+            else:
+                opened = False
+        if opened:
+            _M_UP.labels(rep.address).set(0)
+
+    # --------------------------------------------------------- probing
+    def probe_once(self):
+        """One health sweep over every replica (the prober thread calls
+        this every ``probe_interval_s``; tests call it directly)."""
+        for rep in self.replicas:
+            try:
+                st = ServingClient(
+                    rep.address,
+                    timeout=self.probe_timeout_s).healthz()
+                rep.stats = st
+                self._mark_success(rep)
+                _M_PROBES.labels(rep.address, "ok").inc()
+            except Exception as e:      # refused, reset, timeout, 5xx
+                self._mark_failure(rep, e)
+                _M_PROBES.labels(rep.address, "fail").inc()
+
+    def start_probing(self) -> "Router":
+        if self._probe_thread is None:
+            def loop():
+                while not self._probe_stop.wait(self.probe_interval_s):
+                    self.probe_once()
+            self._probe_thread = threading.Thread(
+                target=loop, name="router-prober", daemon=True)
+            self._probe_thread.start()
+        return self
+
+    def stop(self):
+        self._probe_stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=5.0)
+            self._probe_thread = None
+
+    # ------------------------------------------------------ completion
+    def completion(self, prompt, *, stream: bool = False, **kw):
+        """Route one completion.  Transport failures before any
+        response bytes retry on up to ``max_retries`` other replicas;
+        HTTP answers (429/503/400...) propagate as ServingHTTPError."""
+        tried: list[Replica] = []
+        last_exc: BaseException | None = None
+        for attempt in range(self.max_retries + 1):
+            try:
+                rep = self.pick(prompt, exclude=tried)
+            except NoReplicaAvailable:
+                if last_exc is None:
+                    raise
+                raise NoReplicaAvailable(
+                    "all retry candidates failed "
+                    f"(last: {last_exc!r})") from last_exc
+            client = ServingClient(rep.address,
+                                   timeout=self.request_timeout_s)
+            with self._lock:
+                rep.inflight += 1
+            try:
+                if stream:
+                    # connection + status check happen before the
+                    # generator is returned, so a refused/reset replica
+                    # still lands in the retry path below
+                    events = client.completion(prompt, stream=True, **kw)
+                    return self._stream_through(rep, events)
+                out = client.completion(prompt, **kw)
+            except ServingHTTPError as e:
+                # the replica ANSWERED — it is alive; never retried
+                with self._lock:
+                    rep.inflight -= 1
+                self._mark_success(rep)
+                _M_REQS.labels(rep.address, f"http_{e.status}").inc()
+                raise
+            except OSError as e:
+                with self._lock:
+                    rep.inflight -= 1
+                self._mark_failure(rep, e)
+                _M_REQS.labels(rep.address, "error").inc()
+                tried.append(rep)
+                last_exc = e
+                if attempt < self.max_retries:
+                    _M_RETRIES.inc()
+                continue
+            with self._lock:
+                rep.inflight -= 1
+            self._mark_success(rep)
+            _M_REQS.labels(rep.address, "ok").inc()
+            return out
+        raise NoReplicaAvailable(
+            f"request failed on {len(tried)} replica(s) "
+            f"(last: {last_exc!r})") from last_exc
+
+    def _stream_through(self, rep: Replica, events):
+        """Wrap a replica's SSE stream: success/failure feeds the
+        circuit, inflight releases when the stream ends.  A mid-stream
+        transport failure is NOT retried (bytes already flowed — the
+        request is no longer idempotent)."""
+        def gen():
+            ok = True
+            try:
+                for ev in events:
+                    yield ev
+            except OSError as e:
+                ok = False
+                self._mark_failure(rep, e)
+                _M_REQS.labels(rep.address, "error").inc()
+                raise
+            finally:
+                with self._lock:
+                    rep.inflight -= 1
+                if ok:
+                    self._mark_success(rep)
+                    _M_REQS.labels(rep.address, "ok").inc()
+        return gen()
+
+    # ------------------------------------------------------------ info
+    def stats(self) -> dict:
+        now = self._clock()
+        reps = [r.snapshot(now) for r in self.replicas]
+        return {"replicas": reps,
+                "up": sum(1 for r in reps if r["up"]),
+                "total": len(reps)}
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0,
+              start: bool = True) -> "RouterServer":
+        server = RouterServer(self, host, port)
+        if start:
+            self.start_probing()
+            server.start()
+        return server
+
+
+# ------------------------------------------------------------ HTTP proxy
+class RouterServer(ThreadingHTTPServer):
+    """HTTP front-end over a :class:`Router` — the same wire protocol
+    as ``server.py``, so clients cannot tell a router from a replica:
+    ``POST /v1/completions`` proxies to the picked replica (SSE relayed
+    chunk-by-chunk, so a client disconnect at the router propagates to
+    the replica as a cancel), ``/drain``/``/resume`` broadcast to every
+    replica, ``/healthz`` reports per-replica circuit state."""
+
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, router: Router, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.router = router
+        self._serve_thread: threading.Thread | None = None
+        super().__init__((host, port), _RouterHandler)
+
+    @property
+    def address(self) -> str:
+        return f"{self.server_address[0]}:{self.server_address[1]}"
+
+    def start(self) -> "RouterServer":
+        self._serve_thread = threading.Thread(
+            target=self.serve_forever, name=f"router:{self.address}",
+            daemon=True)
+        self._serve_thread.start()
+        return self
+
+    def stop(self):
+        self.router.stop()
+        self.shutdown()
+        if self._serve_thread is not None:
+            self._serve_thread.join(timeout=5.0)
+        self.server_close()
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server: RouterServer
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _json(self, code: int, obj: dict, headers=()):
+        body = json.dumps(obj).encode()
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in headers:
+                self.send_header(k, str(v))
+            self.end_headers()
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError,
+                ConnectionAbortedError):
+            pass
+
+    def do_GET(self):
+        router = self.server.router
+        if self.path == "/healthz":
+            st = router.stats()
+            st["status"] = "ok" if st["up"] else "unavailable"
+            self._json(200 if st["up"] else 503, st)
+        elif self.path == "/metrics":
+            text = _obs.default_registry().to_prometheus().encode()
+            try:
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(text)))
+                self.end_headers()
+                self.wfile.write(text)
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+        else:
+            self._json(404, {"error": {"message": f"no route {self.path}",
+                                       "code": 404}})
+
+    def do_POST(self):
+        if self.path == "/v1/completions":
+            self._proxy_completion()
+        elif self.path in ("/drain", "/resume"):
+            self._broadcast(self.path)
+        else:
+            self._json(404, {"error": {"message": f"no route {self.path}",
+                                       "code": 404}})
+
+    def _broadcast(self, path: str):
+        results = {}
+        for rep in self.server.router.replicas:
+            try:
+                results[rep.address] = ServingClient(
+                    rep.address,
+                    timeout=self.server.router.request_timeout_s
+                ).request("POST", path, {})
+            except Exception as e:
+                results[rep.address] = {"error": repr(e)}
+        self._json(200, {"replicas": results})
+
+    def _proxy_completion(self):
+        router = self.server.router
+        try:
+            n = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(n) if n > 0 else b"{}"
+            body = json.loads(raw.decode() or "{}")
+            prompt = body.get("prompt")
+            if prompt is None or isinstance(prompt, str):
+                raise ValueError("'prompt' must be a list of token ids")
+        except (ValueError, json.JSONDecodeError) as e:
+            return self._json(400, {"error": {"message": str(e),
+                                              "code": 400}})
+
+        tried: list[Replica] = []
+        last_exc: BaseException | None = None
+        for attempt in range(router.max_retries + 1):
+            try:
+                rep = router.pick(prompt, exclude=tried)
+            except NoReplicaAvailable as e:
+                return self._json(
+                    503, {"error": {"message": str(last_exc or e),
+                                    "type": "overloaded_error",
+                                    "code": 503}},
+                    headers=[("Retry-After", f"{router.cooldown_s:g}")])
+            host, _, port = rep.address.rpartition(":")
+            conn = http.client.HTTPConnection(
+                host, int(port), timeout=router.request_timeout_s)
+            with router._lock:
+                rep.inflight += 1
+            try:
+                conn.request("POST", "/v1/completions", raw,
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+            except OSError as e:
+                conn.close()
+                with router._lock:
+                    rep.inflight -= 1
+                router._mark_failure(rep, e)
+                _M_REQS.labels(rep.address, "error").inc()
+                tried.append(rep)
+                last_exc = e
+                if attempt < router.max_retries:
+                    _M_RETRIES.inc()
+                continue
+            try:
+                self._relay(rep, resp)
+            finally:
+                conn.close()
+                with router._lock:
+                    rep.inflight -= 1
+            return
+        self._json(503, {"error": {"message": f"request failed on "
+                                              f"{len(tried)} replica(s) "
+                                              f"(last: {last_exc!r})",
+                                   "type": "overloaded_error",
+                                   "code": 503}},
+                   headers=[("Retry-After", f"{router.cooldown_s:g}")])
+
+    def _relay(self, rep: Replica, resp):
+        """Stream the replica's response back verbatim.  Closing the
+        upstream connection on OUR client's disconnect is what turns a
+        router-side hangup into a replica-side cancel."""
+        router = self.server.router
+        streaming = "text/event-stream" in (
+            resp.headers.get("Content-Type") or "")
+        try:
+            self.send_response(resp.status)
+            for key in ("Content-Type", "Retry-After"):
+                if resp.headers.get(key):
+                    self.send_header(key, resp.headers[key])
+            if streaming:
+                self.send_header("Connection", "close")
+                self.close_connection = True
+                self.end_headers()
+                while True:
+                    line = resp.readline()
+                    if not line:
+                        break
+                    self.wfile.write(line)
+                    if line == b"\n":
+                        self.wfile.flush()
+            else:
+                payload = resp.read()
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+        except (BrokenPipeError, ConnectionResetError,
+                ConnectionAbortedError):
+            _M_REQS.labels(rep.address, "client_cancelled").inc()
+            return
+        router._mark_success(rep)
+        outcome = "ok" if 200 <= resp.status < 300 \
+            else f"http_{resp.status}"
+        _M_REQS.labels(rep.address, outcome).inc()

@@ -1,0 +1,606 @@
+"""HTTP serving front-end for the continuous-batching engine.
+
+Turns an in-process :class:`~paddle_tpu.serving.Engine` into a network
+service with zero new dependencies (stdlib ``http.server`` only):
+
+  * ``POST /v1/completions`` — OpenAI-compatible completion endpoint
+    over token ids (this layer has no tokenizer): blocking JSON or
+    ``"stream": true`` SSE (``data: {...}`` chunks, terminated by
+    ``data: [DONE]``).  Per-request ``timeout`` wires straight into the
+    engine's deadline/cancel machinery; a client that disconnects
+    mid-stream cancels its request at the next iteration boundary.
+  * admission control — when the scheduler's queue is full the server
+    answers ``429`` with a ``Retry-After`` header (backpressure is a
+    protocol answer, never a hang or a 500); while draining it answers
+    ``503``.
+  * ``GET /healthz`` (engine stats + drain state), ``GET /metrics``
+    (the observability registry's Prometheus export), ``POST /drain`` /
+    ``POST /resume`` (rolling restarts), and graceful drain on SIGTERM:
+    in-flight streams finish, queued requests are failed fast, then the
+    listener closes.
+
+Threading model: the engine stays single-threaded.  One
+:class:`EngineWorker` thread owns it and drives ``engine.step()``;
+HTTP handler threads (``ThreadingHTTPServer``) only ever call
+``worker.submit()`` under the worker lock and then consume tokens from
+a per-request ``queue.Queue`` fed by the engine thread through the
+request's ``on_token`` callback.
+"""
+from __future__ import annotations
+
+import json
+import queue
+import signal
+import threading
+import time
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from .. import observability as _obs
+from .engine import Engine
+from .request import GenerationConfig, Request
+
+__all__ = ["BackpressureError", "DrainingError", "EngineWorker",
+           "ServingServer", "serve"]
+
+_M_HTTP_REQS = _obs.counter(
+    "serving_http_requests_total", "HTTP requests by route and status",
+    ("route", "code"))
+_M_HTTP_REJECT = _obs.counter(
+    "serving_http_rejections_total",
+    "completions rejected before admission: 'backpressure' -> 429, "
+    "'draining' -> 503, 'invalid' -> 400", ("reason",))
+_M_HTTP_INFLIGHT = _obs.gauge(
+    "serving_http_inflight",
+    "completion requests currently held by handler threads")
+_M_HTTP_CANCELS = _obs.counter(
+    "serving_http_stream_cancels_total",
+    "SSE streams cancelled by client disconnect")
+
+
+def _http_latency_hist():
+    return _obs.histogram(
+        "serving_http_request_seconds",
+        "completion handler wall time (request read -> response end)",
+        buckets=_obs.registry.SERVING_LATENCY_BUCKETS)
+
+
+class BackpressureError(RuntimeError):
+    """Admission queue full — surfaces as HTTP 429 + Retry-After."""
+
+
+class DrainingError(RuntimeError):
+    """Server is draining — surfaces as HTTP 503."""
+
+
+class EngineWorker:
+    """Owns an :class:`Engine` and drives it from ONE background thread.
+
+    The engine is single-threaded by design (jitted step, host-side
+    slot mirrors), so every touch goes through :attr:`lock`: the worker
+    thread holds it across ``engine.step()``, handler threads hold it
+    for the (cheap) ``submit()``.  Token delivery back to handlers is
+    lock-free — the engine thread runs each request's ``on_token``
+    callback, which pushes into that handler's private queue.
+    """
+
+    def __init__(self, engine: Engine, *, max_queue: int = 64,
+                 idle_wait: float = 0.005):
+        self.engine = engine
+        self.max_queue = int(max_queue)
+        self.lock = threading.RLock()
+        self._wake = threading.Condition(self.lock)
+        self._stop = False
+        self._started = False
+        self._idle_wait = float(idle_wait)
+        # recent Request objects, newest last (introspection + tests)
+        self.requests: deque[Request] = deque(maxlen=512)
+        self._thread = threading.Thread(
+            target=self._loop, name="engine-worker", daemon=True)
+
+    # --------------------------------------------------------- lifecycle
+    def start(self) -> "EngineWorker":
+        if not self._started:
+            self._started = True
+            self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0):
+        with self._wake:
+            self._stop = True
+            self._wake.notify_all()
+        if self._started:
+            self._thread.join(timeout=timeout)
+
+    def _loop(self):
+        while True:
+            with self._wake:
+                if self._stop:
+                    return
+                if not self.engine.scheduler.has_work():
+                    self._wake.wait(self._idle_wait)
+                    continue
+                self.engine.step()
+
+    # ------------------------------------------------------------ intake
+    @property
+    def draining(self) -> bool:
+        return self.engine.scheduler.draining
+
+    def submit(self, prompt, gen: GenerationConfig | None = None, *,
+               timeout_s: float | None = None, on_token=None) -> Request:
+        """Thread-safe admission with backpressure: raises
+        :class:`DrainingError` / :class:`BackpressureError` instead of
+        queueing unboundedly; ``timeout_s`` becomes an absolute engine
+        deadline (the existing cancel machinery enforces it)."""
+        with self._wake:
+            if self.engine.scheduler.draining:
+                raise DrainingError(
+                    "server is draining; not admitting new requests")
+            if len(self.engine.scheduler.queue) >= self.max_queue:
+                raise BackpressureError(
+                    f"admission queue full ({self.max_queue} waiting)")
+            deadline = (None if timeout_s is None
+                        else self.engine._clock() + float(timeout_s))
+            req = self.engine.submit(prompt, gen, deadline=deadline,
+                                     on_token=on_token)
+            self.requests.append(req)
+            self._wake.notify_all()
+        return req
+
+    # ------------------------------------------------------------- drain
+    def drain(self, timeout: float | None = None) -> bool:
+        """Graceful drain: stop admitting, let in-flight sequences run
+        to completion, then fail the never-admitted queued requests fast
+        (their handlers would otherwise wait on a queue that drain will
+        never schedule).  Returns False if ``timeout`` elapsed first."""
+        with self.lock:
+            self.engine.scheduler.drain()
+        t0 = time.monotonic()
+        while True:
+            with self.lock:
+                if self.engine.scheduler.active_count == 0:
+                    break
+            if timeout is not None and time.monotonic() - t0 > timeout:
+                return False
+            time.sleep(0.002)
+        with self.lock:
+            now = self.engine._clock()
+            while self.engine.scheduler.queue:
+                r = self.engine.scheduler.queue.popleft()
+                self.engine.scheduler._finish(r, "cancelled", now)
+        return True
+
+    def resume(self):
+        with self._wake:
+            self.engine.scheduler.resume()
+            self._wake.notify_all()
+
+    # -------------------------------------------------------------- info
+    def stats(self) -> dict:
+        with self.lock:
+            st = self.engine.stats()
+            st["draining"] = self.engine.scheduler.draining
+            st["max_queue"] = self.max_queue
+        return st
+
+
+# --------------------------------------------------------------- protocol
+def _parse_completion(body: dict):
+    """Validate a /v1/completions body -> (prompt, gen, stream,
+    timeout_s).  Raises ValueError with a client-facing message."""
+    if not isinstance(body, dict):
+        raise ValueError("request body must be a JSON object")
+    prompt = body.get("prompt")
+    if prompt is None:
+        raise ValueError("missing 'prompt' (a list of token ids)")
+    if isinstance(prompt, str):
+        raise ValueError(
+            "text prompts are not supported — this server speaks token "
+            "ids (pass 'prompt' as a list of ints)")
+    prompt = np.asarray(prompt, np.int32).reshape(-1)
+    temperature = float(body.get("temperature", 1.0))
+    do_sample = body.get("do_sample")
+    if do_sample is None:
+        # OpenAI semantics: temperature 0 means greedy.  Sampling stays
+        # opt-in ('do_sample' or an explicit non-default temperature)
+        # because it needs an engine built with emit_logits=True.
+        do_sample = "temperature" in body and temperature > 0.0
+    gen = GenerationConfig(
+        max_new_tokens=int(body.get("max_tokens", 16)),
+        do_sample=bool(do_sample),
+        temperature=temperature if temperature > 0 else 1.0,
+        top_k=int(body.get("top_k", 0)),
+        top_p=float(body.get("top_p", 1.0)),
+        eos_token_id=(None if body.get("eos_token_id") is None
+                      else int(body["eos_token_id"])),
+        seed=int(body.get("seed", 0)))
+    timeout_s = body.get("timeout")
+    if timeout_s is not None:
+        timeout_s = float(timeout_s)
+        if timeout_s <= 0:
+            raise ValueError("'timeout' must be > 0 seconds")
+    return prompt, gen, bool(body.get("stream", False)), timeout_s
+
+
+_FINISH_REASON = {"length": "length", "eos": "stop",
+                  "cancelled": "cancelled", "deadline": "timeout"}
+
+
+def _finish_reason(req: Request) -> str | None:
+    if req.finish_reason is None:
+        return None
+    return _FINISH_REASON.get(req.finish_reason, req.finish_reason)
+
+
+def _completion_json(model_name: str, req: Request) -> dict:
+    plen = int(req.prompt.size)
+    return {
+        "id": f"cmpl-{req.id}",
+        "object": "text_completion",
+        "created": int(time.time()),
+        "model": model_name,
+        "choices": [{
+            "index": 0,
+            "text": " ".join(str(t) for t in req.output_tokens),
+            "token_ids": list(req.output_tokens),
+            "finish_reason": _finish_reason(req),
+        }],
+        "usage": {"prompt_tokens": plen,
+                  "completion_tokens": req.num_generated,
+                  "total_tokens": plen + req.num_generated},
+        "num_cached_tokens": req.num_cached_tokens,
+    }
+
+
+def _chunk_json(model_name: str, req: Request, tok: int | None,
+                final: bool) -> dict:
+    return {
+        "id": f"cmpl-{req.id}",
+        "object": "text_completion.chunk",
+        "model": model_name,
+        "choices": [{
+            "index": 0,
+            "text": "" if tok is None else f"{tok} ",
+            "token_ids": [] if tok is None else [int(tok)],
+            "finish_reason": _finish_reason(req) if final else None,
+        }],
+    }
+
+
+# ----------------------------------------------------------------- server
+class ServingServer(ThreadingHTTPServer):
+    """Threaded HTTP front door over one :class:`EngineWorker`.
+
+    ``port=0`` binds an ephemeral port (tests); :attr:`address` reports
+    the bound ``host:port``.  ``start()`` spawns both the engine worker
+    and the accept loop; ``stop()`` is the graceful SIGTERM path —
+    drain (finish in-flight streams), then close the listener.
+    """
+
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, worker: EngineWorker, host: str = "127.0.0.1",
+                 port: int = 0, *, retry_after_s: float = 1.0,
+                 hard_timeout_s: float = 600.0,
+                 model_name: str = "paddle-tpu"):
+        self.worker = worker
+        self.retry_after_s = float(retry_after_s)
+        self.hard_timeout_s = float(hard_timeout_s)
+        self.model_name = model_name
+        self._latency = _http_latency_hist()
+        self._serve_thread: threading.Thread | None = None
+        super().__init__((host, port), _Handler)
+
+    @property
+    def address(self) -> str:
+        return f"{self.server_address[0]}:{self.server_address[1]}"
+
+    def start(self) -> "ServingServer":
+        self.worker.start()
+        self._serve_thread = threading.Thread(
+            target=self.serve_forever, name=f"http:{self.address}",
+            daemon=True)
+        self._serve_thread.start()
+        return self
+
+    def stop(self, *, drain_timeout: float | None = None):
+        """Graceful shutdown: drain in-flight work, then close."""
+        self.worker.drain(timeout=drain_timeout)
+        self.shutdown()
+        if self._serve_thread is not None:
+            self._serve_thread.join(timeout=5.0)
+        self.worker.stop()
+        self.server_close()
+
+    def install_signal_handlers(self,
+                                sigs=(signal.SIGTERM, signal.SIGINT)):
+        """SIGTERM/SIGINT => graceful drain-then-exit.  Only callable
+        from the main thread (signal module restriction)."""
+        def _graceful(signum, frame):
+            threading.Thread(target=self.stop, daemon=True).start()
+        for s in sigs:
+            signal.signal(s, _graceful)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server: ServingServer
+
+    def log_message(self, fmt, *args):      # metrics, not stderr noise
+        pass
+
+    # ----------------------------------------------------------- helpers
+    def _json(self, code: int, obj: dict, route: str, headers=()):
+        body = json.dumps(obj).encode()
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in headers:
+                self.send_header(k, str(v))
+            self.end_headers()
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError,
+                ConnectionAbortedError):
+            pass
+        _M_HTTP_REQS.labels(route, str(code)).inc()
+
+    def _error(self, code: int, message: str, route: str, *,
+               etype: str = "invalid_request_error", headers=()):
+        self._json(code, {"error": {"message": message, "type": etype,
+                                    "code": code}}, route,
+                   headers=headers)
+
+    def _read_body(self) -> dict:
+        n = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(n) if n > 0 else b"{}"
+        return json.loads(raw.decode() or "{}")
+
+    # ------------------------------------------------------------ routes
+    def do_GET(self):
+        if self.path == "/healthz":
+            st = self.worker_stats()
+            st["status"] = "draining" if st["draining"] else "ok"
+            self._json(200, st, "/healthz")
+        elif self.path == "/metrics":
+            text = _obs.default_registry().to_prometheus().encode()
+            try:
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(text)))
+                self.end_headers()
+                self.wfile.write(text)
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+            _M_HTTP_REQS.labels("/metrics", "200").inc()
+        else:
+            self._error(404, f"no route {self.path}", self.path)
+
+    def worker_stats(self) -> dict:
+        return self.server.worker.stats()
+
+    def do_POST(self):
+        if self.path == "/v1/completions":
+            self._completions()
+        elif self.path == "/drain":
+            try:
+                body = self._read_body()
+            except (ValueError, json.JSONDecodeError):
+                body = {}
+            ok = self.server.worker.drain(timeout=body.get("timeout"))
+            self._json(200 if ok else 504, {"drained": ok}, "/drain")
+        elif self.path == "/resume":
+            self.server.worker.resume()
+            self._json(200, {"resumed": True}, "/resume")
+        else:
+            self._error(404, f"no route {self.path}", self.path)
+
+    # ------------------------------------------------------- completions
+    def _completions(self):
+        route = "/v1/completions"
+        t0 = time.monotonic()
+        try:
+            body = self._read_body()
+        except (ValueError, json.JSONDecodeError):
+            _M_HTTP_REJECT.labels("invalid").inc()
+            return self._error(400, "invalid JSON body", route)
+        try:
+            prompt, gen, stream, timeout_s = _parse_completion(body)
+        except (ValueError, TypeError) as e:
+            _M_HTTP_REJECT.labels("invalid").inc()
+            return self._error(400, str(e), route)
+
+        toks: queue.Queue = queue.Queue()
+        try:
+            req = self.server.worker.submit(
+                prompt, gen, timeout_s=timeout_s,
+                on_token=lambda r, t: toks.put(int(t)))
+        except DrainingError as e:
+            _M_HTTP_REJECT.labels("draining").inc()
+            return self._error(
+                503, str(e), route, etype="overloaded_error",
+                headers=[("Retry-After", f"{self.server.retry_after_s:g}")])
+        except BackpressureError as e:
+            _M_HTTP_REJECT.labels("backpressure").inc()
+            return self._error(
+                429, str(e), route, etype="overloaded_error",
+                headers=[("Retry-After", f"{self.server.retry_after_s:g}")])
+        except (ValueError, TypeError) as e:   # engine-side validation
+            _M_HTTP_REJECT.labels("invalid").inc()
+            return self._error(400, str(e), route)
+
+        hard_deadline = t0 + (timeout_s or self.server.hard_timeout_s) \
+            + 5.0
+        _M_HTTP_INFLIGHT.inc()
+        try:
+            if stream:
+                self._stream(req, toks, route, hard_deadline)
+            else:
+                self._blocking(req, toks, route, hard_deadline)
+        finally:
+            _M_HTTP_INFLIGHT.dec()
+            self.server._latency.observe(time.monotonic() - t0)
+
+    def _wait_token(self, req: Request, toks: queue.Queue,
+                    hard_deadline: float):
+        """Next token, or None when the request is finished and its
+        queue is fully drained.  The hard deadline is a backstop for a
+        wedged engine — the per-request timeout normally fires first
+        through the engine's own deadline eviction."""
+        while True:
+            try:
+                return toks.get(timeout=0.05)
+            except queue.Empty:
+                # on_token runs BEFORE finalize, so once is_finished()
+                # is observed every token is already in the queue
+                if req.is_finished() and toks.empty():
+                    return None
+                if time.monotonic() > hard_deadline:
+                    req.cancel()
+                    return None
+
+    def _blocking(self, req: Request, toks: queue.Queue, route: str,
+                  hard_deadline: float):
+        while self._wait_token(req, toks, hard_deadline) is not None:
+            pass
+        if not req.is_finished():       # hard-timeout backstop tripped
+            return self._error(504, "request timed out server-side",
+                               route, etype="timeout_error")
+        self._json(200, _completion_json(self.server.model_name, req),
+                   route)
+
+    def _stream(self, req: Request, toks: queue.Queue, route: str,
+                hard_deadline: float):
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Connection", "close")
+            self.end_headers()
+        except (BrokenPipeError, ConnectionResetError,
+                ConnectionAbortedError):
+            req.cancel()
+            _M_HTTP_CANCELS.inc()
+            return
+        _M_HTTP_REQS.labels(route, "200").inc()
+        self.close_connection = True
+        name = self.server.model_name
+        try:
+            while True:
+                tok = self._wait_token(req, toks, hard_deadline)
+                if tok is None:
+                    break
+                self._send_event(_chunk_json(name, req, tok, False))
+            self._send_event(_chunk_json(name, req, None, True))
+            self.wfile.write(b"data: [DONE]\n\n")
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError,
+                ConnectionAbortedError):
+            # client went away mid-stream: cancel so the engine frees
+            # the slot/pages at the next iteration boundary
+            req.cancel()
+            _M_HTTP_CANCELS.inc()
+
+    def _send_event(self, obj: dict):
+        self.wfile.write(b"data: " + json.dumps(obj).encode() + b"\n\n")
+        # flush per event: SSE latency AND prompt disconnect detection
+        self.wfile.flush()
+
+
+def serve(model=None, *, engine: Engine | None = None,
+          host: str = "127.0.0.1", port: int = 0, max_queue: int = 64,
+          retry_after_s: float = 1.0, model_name: str = "paddle-tpu",
+          start: bool = True, **engine_kw) -> ServingServer:
+    """One-call server bring-up::
+
+        server = serve(model, port=8000, max_slots=8,
+                       enable_prefix_cache=True)
+        print("listening on", server.address)
+
+    Pass either a model (``engine_kw`` forwards to
+    :func:`~paddle_tpu.serving.create_engine`) or a prebuilt
+    ``engine=``.  With ``start=False`` the caller wires signals and
+    starts the server itself.
+    """
+    if engine is None:
+        if model is None:
+            raise ValueError("pass a model or engine=")
+        from .engine import create_engine
+        engine = create_engine(model, **engine_kw)
+    elif engine_kw:
+        raise ValueError(f"engine= given; unexpected {sorted(engine_kw)}")
+    worker = EngineWorker(engine, max_queue=max_queue)
+    server = ServingServer(worker, host, port,
+                           retry_after_s=retry_after_s,
+                           model_name=model_name)
+    if start:
+        server.start()
+    return server
+
+
+def _main(argv=None):
+    """Demo entry point: serve a randomly initialized tiny llama (no
+    checkpoint needed) — the curl-able counterpart of
+    tools/serve_bench.py::
+
+        python -m paddle_tpu.serving.server --port 8000
+        curl -s localhost:8000/v1/completions -d \\
+            '{"prompt": [1,2,3], "max_tokens": 8}'
+    """
+    import argparse
+
+    ap = argparse.ArgumentParser(description=_main.__doc__.splitlines()[0])
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--max-slots", type=int, default=4)
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--max-queue", type=int, default=64)
+    ap.add_argument("--max-model-len", type=int, default=256)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--vocab", type=int, default=128)
+    ap.add_argument("--prefix-cache",
+                    action=argparse.BooleanOptionalAction, default=True)
+    ap.add_argument("--sync-interval", type=int, default=1)
+    ap.add_argument("--emit-logits", action="store_true",
+                    help="enable do_sample requests")
+    args = ap.parse_args(argv)
+
+    import paddle_tpu as paddle
+    from ..models.llama import LlamaForCausalLM, llama_tiny
+    paddle.seed(0)
+    cfg = llama_tiny(num_hidden_layers=args.layers,
+                     hidden_size=args.hidden,
+                     intermediate_size=2 * args.hidden,
+                     vocab_size=args.vocab, num_attention_heads=4,
+                     num_key_value_heads=2,
+                     max_position_embeddings=args.max_model_len)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    server = serve(model, host=args.host, port=args.port,
+                   max_queue=args.max_queue, max_slots=args.max_slots,
+                   page_size=args.page_size,
+                   max_model_len=args.max_model_len,
+                   emit_logits=args.emit_logits,
+                   enable_prefix_cache=args.prefix_cache,
+                   sync_interval=args.sync_interval, start=False)
+    server.install_signal_handlers()
+    server.start()
+    print(f"serving on http://{server.address} "
+          f"(SIGTERM drains gracefully)")
+    try:
+        while server._serve_thread.is_alive():
+            server._serve_thread.join(timeout=1.0)
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(_main())

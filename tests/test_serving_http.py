@@ -1,0 +1,602 @@
+"""HTTP serving stack: server (SSE, backpressure, drain), router
+(prefix affinity, circuit breaking, bounded retry), client — plus the
+PR's satellite fixes (fleet all_reduce modes, rotary S==1 tables,
+dynamic_decode zero-iteration).
+
+The acceptance contracts asserted here:
+  * streamed completion tokens are byte-identical to a direct
+    ``Engine.submit`` greedy run (the HTTP layer adds transport only),
+  * backpressure is a protocol answer: 429 + Retry-After, never a hang
+    or a 500; draining answers 503,
+  * a client disconnect mid-stream cancels the request (slot + pages
+    free at the next iteration boundary),
+  * drain finishes in-flight streams before the listener closes,
+  * a 2-replica router on a shared-prefix workload keeps the
+    prefix-cache page hit rate no worse than a single replica
+    (prefix-affinity routing), and circuit-broken replicas leave and
+    re-enter rotation.
+"""
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+from paddle_tpu.serving import (DrainingError, EngineWorker,
+                                GenerationConfig, NoReplicaAvailable,
+                                Router, ServingClient, ServingHTTPError,
+                                ServingServer, create_engine, serve)
+
+PAGE = 16
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(11)
+    cfg = llama_tiny(vocab_size=128, hidden_size=64,
+                     intermediate_size=128)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+@pytest.fixture(scope="module")
+def server(tiny_model):
+    srv = serve(tiny_model, max_slots=4, page_size=PAGE, num_pages=128,
+                max_model_len=256, enable_prefix_cache=True)
+    yield srv
+    srv.stop(drain_timeout=5.0)
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    return ServingClient(server.address)
+
+
+@pytest.fixture(scope="module")
+def direct_engine(tiny_model):
+    return create_engine(tiny_model, max_slots=4, page_size=PAGE,
+                         num_pages=128, max_model_len=256,
+                         enable_prefix_cache=True)
+
+
+def _stream_tokens(events):
+    toks, final = [], None
+    for ev in events:
+        got = ev["choices"][0]["token_ids"]
+        toks.extend(got)
+        if ev["choices"][0]["finish_reason"] is not None:
+            assert got == [], "finish chunk must carry no tokens"
+            final = ev["choices"][0]["finish_reason"]
+    return toks, final
+
+
+def _free_dead_port() -> str:
+    """An address that refuses connections (bound then closed)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return f"127.0.0.1:{port}"
+
+
+PROMPT = list(range(1, 20))
+
+
+# ------------------------------------------------------------ HTTP server
+class TestServingServer:
+    def test_healthz_and_metrics(self, client):
+        st = client.healthz()
+        assert st["status"] == "ok" and st["pages_total"] == 128
+        text = client.metrics_text()
+        assert "serving_http_requests_total" in text
+        assert "serving_queue_depth" in text
+
+    def test_blocking_matches_direct_engine(self, client, direct_engine):
+        out = client.completion(PROMPT, max_tokens=8)
+        req = direct_engine.submit(np.array(PROMPT, np.int32),
+                                   GenerationConfig(max_new_tokens=8))
+        direct_engine.run_until_complete()
+        assert out["choices"][0]["token_ids"] == list(req.output_tokens)
+        assert out["choices"][0]["finish_reason"] == "length"
+        assert out["usage"] == {"prompt_tokens": len(PROMPT),
+                                "completion_tokens": 8,
+                                "total_tokens": len(PROMPT) + 8}
+
+    def test_stream_matches_blocking(self, client):
+        blocking = client.completion(PROMPT, max_tokens=8)
+        toks, final = _stream_tokens(
+            client.completion(PROMPT, max_tokens=8, stream=True))
+        assert toks == blocking["choices"][0]["token_ids"]
+        assert final == "length"
+
+    def test_eos_maps_to_stop(self, client, direct_engine):
+        # find a prompt whose greedy continuation emits some token, then
+        # declare THAT token to be eos — finish_reason becomes "stop"
+        probe = client.completion(PROMPT, max_tokens=1)
+        eos = probe["choices"][0]["token_ids"][0]
+        out = client.completion(PROMPT, max_tokens=8, eos_token_id=eos)
+        assert out["choices"][0]["finish_reason"] == "stop"
+        assert len(out["choices"][0]["token_ids"]) < 8
+
+    def test_invalid_requests_are_400(self, client):
+        with pytest.raises(ServingHTTPError) as ei:
+            client.request("POST", "/v1/completions",
+                           {"prompt": "text prompt", "max_tokens": 4})
+        assert ei.value.status == 400
+        assert "token ids" in str(ei.value)
+        with pytest.raises(ServingHTTPError) as ei:
+            client.request("POST", "/v1/completions", {"max_tokens": 4})
+        assert ei.value.status == 400
+        with pytest.raises(ServingHTTPError) as ei:
+            client.completion(PROMPT, max_tokens=4, timeout=-1)
+        assert ei.value.status == 400
+        with pytest.raises(ServingHTTPError) as ei:
+            client.request("GET", "/nope")
+        assert ei.value.status == 404
+
+    def test_request_timeout_maps_to_timeout(self, client):
+        out = client.completion(PROMPT, max_tokens=200, timeout=0.05)
+        assert out["choices"][0]["finish_reason"] == "timeout"
+        assert len(out["choices"][0]["token_ids"]) < 200
+
+    def test_backpressure_is_429_never_a_hang(self, tiny_model):
+        """Queue full => immediate 429 + Retry-After.  The worker
+        thread is deliberately NOT running, so the first request stays
+        queued and the second must be rejected, not block."""
+        engine = create_engine(tiny_model, max_slots=2, page_size=PAGE,
+                               num_pages=32, max_model_len=64)
+        worker = EngineWorker(engine, max_queue=1)
+        srv = ServingServer(worker, retry_after_s=2.5)
+        accept = threading.Thread(target=srv.serve_forever, daemon=True)
+        accept.start()
+        cl = ServingClient(srv.address, timeout=30.0)
+        first_out = {}
+
+        def first():
+            first_out["resp"] = cl.completion(PROMPT, max_tokens=2)
+
+        t = threading.Thread(target=first, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 5.0
+        while not engine.scheduler.queue:         # first request queued
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+
+        t0 = time.monotonic()
+        with pytest.raises(ServingHTTPError) as ei:
+            cl.completion(PROMPT, max_tokens=2)
+        assert ei.value.status == 429
+        assert ei.value.retry_after == 2.5
+        assert time.monotonic() - t0 < 5.0        # answered, not hung
+
+        worker.start()                # let the queued request finish
+        t.join(timeout=30.0)
+        assert not t.is_alive()
+        assert first_out["resp"]["choices"][0]["finish_reason"] == \
+            "length"
+        srv.shutdown()
+        accept.join(timeout=5.0)
+        worker.stop()
+        srv.server_close()
+
+    def test_stream_cancel_on_client_disconnect(self, server, client):
+        events = client.completion(PROMPT, max_tokens=200, stream=True)
+        got = [next(events), next(events)]
+        assert got[0]["choices"][0]["token_ids"]
+        req = server.worker.requests[-1]
+        events.close()                      # client hangs up mid-stream
+        deadline = time.monotonic() + 10.0
+        while not req.is_finished():
+            assert time.monotonic() < deadline, \
+                "disconnect did not cancel the request"
+            time.sleep(0.01)
+        assert req.finish_reason == "cancelled"
+        assert req.num_generated < 200
+        # slot + pages actually freed
+        deadline = time.monotonic() + 5.0
+        while server.worker.stats()["active"]:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+
+    def test_drain_finishes_inflight_then_503(self, server, client):
+        stream_out = {}
+
+        def consume():
+            stream_out["toks"], stream_out["final"] = _stream_tokens(
+                client.completion(PROMPT, max_tokens=48, stream=True))
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 10.0
+        while not server.worker.stats()["active"]:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        try:
+            assert client.drain() == {"drained": True}
+            t.join(timeout=30.0)
+            assert not t.is_alive()
+            # the in-flight stream ran to completion, not cancelled
+            assert stream_out["final"] == "length"
+            assert len(stream_out["toks"]) == 48
+            assert client.healthz()["status"] == "draining"
+            with pytest.raises(ServingHTTPError) as ei:
+                client.completion(PROMPT, max_tokens=2)
+            assert ei.value.status == 503
+        finally:
+            client.resume()
+        out = client.completion(PROMPT, max_tokens=2)
+        assert out["choices"][0]["finish_reason"] == "length"
+
+    def test_worker_drain_fails_queued_requests_fast(self, tiny_model):
+        engine = create_engine(tiny_model, max_slots=2, page_size=PAGE,
+                               num_pages=32, max_model_len=64)
+        worker = EngineWorker(engine, max_queue=8)   # never started
+        reqs = [worker.submit(np.array(PROMPT, np.int32),
+                              GenerationConfig(max_new_tokens=4))
+                for _ in range(2)]
+        assert worker.drain(timeout=5.0)
+        for r in reqs:
+            assert r.is_finished() and r.finish_reason == "cancelled"
+        with pytest.raises(DrainingError):
+            worker.submit(np.array(PROMPT, np.int32),
+                          GenerationConfig(max_new_tokens=4))
+
+
+# ----------------------------------------------------------------- router
+class TestRouter:
+    def test_affinity_key_is_page_aligned(self):
+        r = Router(["127.0.0.1:1", "127.0.0.1:2"], page_size=PAGE)
+        assert r._affinity_key(list(range(PAGE - 1))) is None
+        base = list(range(PAGE)) + [99]
+        k1 = r._affinity_key(base)
+        k2 = r._affinity_key(list(range(PAGE)) + [7, 8, 9])
+        assert k1 is not None and k1 == k2      # suffix doesn't matter
+        assert r._affinity_key([5] + list(range(PAGE - 1))) != k1
+
+    def test_pick_is_sticky_for_shared_prefixes(self):
+        r = Router(["127.0.0.1:1", "127.0.0.1:2", "127.0.0.1:3"],
+                   page_size=PAGE)
+        shared = list(range(40, 40 + 2 * PAGE))
+        picks = {r.pick(shared + [s]).address for s in range(10)}
+        assert len(picks) == 1                  # one affinity target
+        # short prompt: least-loaded fallback, not a hash target
+        r.replicas[0].inflight = 5
+        r.replicas[1].inflight = 1
+        r.replicas[2].inflight = 3
+        assert r.pick([1, 2, 3]).address == r.replicas[1].address
+
+    def test_circuit_break_and_readmit(self, server):
+        now = [0.0]
+        dead = _free_dead_port()
+        r = Router([server.address, dead], page_size=PAGE,
+                   fail_threshold=2, cooldown_s=5.0,
+                   probe_timeout_s=0.5, clock=lambda: now[0])
+        live_rep, dead_rep = r.replicas
+        r.probe_once()
+        assert live_rep.available(now[0]) and dead_rep.fails == 1
+        assert dead_rep.available(now[0])        # below threshold
+        r.probe_once()
+        assert dead_rep.fails == 2
+        assert not dead_rep.available(now[0])    # circuit open
+        st = r.stats()
+        assert st["up"] == 1 and st["total"] == 2
+        # every pick avoids the broken replica (even its affinity wins)
+        for s in range(8):
+            assert r.pick(list(range(2 * PAGE)) + [s]) is live_rep
+        now[0] = 5.5                             # cooldown elapsed
+        assert dead_rep.available(now[0])        # re-admitted
+        r.probe_once()                           # still dead: re-opens
+        assert not dead_rep.available(now[0])
+        # a replica that comes BACK is re-admitted via probe success
+        live_rep.fails = 1
+        r.probe_once()
+        assert live_rep.fails == 0 and live_rep.available(now[0])
+        with pytest.raises(NoReplicaAvailable):
+            r.pick([1, 2, 3], exclude=[live_rep])
+
+    def test_transport_failure_retries_on_other_replica(self, server):
+        dead = _free_dead_port()
+        r = Router([dead, server.address], page_size=PAGE,
+                   max_retries=1, request_timeout_s=30.0)
+        dead_rep, live_rep = r.replicas
+        live_rep.inflight = 1          # force least-loaded onto dead
+        out = r.completion([1, 2, 3], max_tokens=4)
+        assert len(out["choices"][0]["token_ids"]) == 4
+        assert dead_rep.fails >= 1
+        assert live_rep.inflight == 1  # retry path balanced its +1/-1
+        # streaming takes the same retry path (fails before any bytes)
+        toks, final = _stream_tokens(
+            r.completion([1, 2, 3], max_tokens=4, stream=True))
+        assert len(toks) == 4 and final == "length"
+
+    def test_http_answer_is_never_retried(self, server, client):
+        r = Router([server.address], page_size=PAGE,
+                   request_timeout_s=30.0)
+        rep = r.replicas[0]
+        assert client.drain() == {"drained": True}
+        try:
+            with pytest.raises(ServingHTTPError) as ei:
+                r.completion(PROMPT, max_tokens=2)
+            assert ei.value.status == 503
+            # the replica ANSWERED: alive, no circuit strike
+            assert rep.fails == 0 and rep.inflight == 0
+        finally:
+            client.resume()
+
+    def test_all_replicas_down_raises(self):
+        r = Router([_free_dead_port(), _free_dead_port()],
+                   page_size=PAGE, max_retries=1, fail_threshold=1,
+                   request_timeout_s=2.0)
+        with pytest.raises(NoReplicaAvailable):
+            r.completion([1, 2, 3], max_tokens=2)
+
+    def test_prefix_affinity_preserves_hit_rate(self, tiny_model):
+        """Acceptance: 2 replicas behind the router keep the
+        prefix-cache page hit rate no worse than a single replica on a
+        shared-prefix workload (affinity sends the whole prefix family
+        to ONE replica instead of splitting its cache)."""
+        rng = np.random.default_rng(3)
+        shared = rng.integers(2, 120, 2 * PAGE).astype(np.int32)
+        workload = [np.concatenate(
+            [shared, rng.integers(2, 120, int(rng.integers(4, 10)))
+             .astype(np.int32)]) for _ in range(8)]
+
+        def run(send):
+            for prompt in workload:
+                send([int(t) for t in prompt])
+
+        def hit_rate(servers):
+            hits = sum(s.worker.stats()["prefix_hits"] for s in servers)
+            miss = sum(s.worker.stats()["prefix_misses"]
+                       for s in servers)
+            return hits / (hits + miss) if hits + miss else 0.0
+
+        kw = dict(max_slots=4, page_size=PAGE, num_pages=128,
+                  max_model_len=256, enable_prefix_cache=True)
+        single = serve(tiny_model, **kw)
+        try:
+            cl = ServingClient(single.address)
+            run(lambda p: cl.completion(p, max_tokens=2))
+            single_rate = hit_rate([single])
+        finally:
+            single.stop(drain_timeout=5.0)
+
+        pair = [serve(tiny_model, **kw) for _ in range(2)]
+        router = Router([s.address for s in pair], page_size=PAGE)
+        try:
+            run(lambda p: router.completion(p, max_tokens=2))
+            pair_rate = hit_rate(pair)
+        finally:
+            router.stop()
+            for s in pair:
+                s.stop(drain_timeout=5.0)
+        assert single_rate > 0.5        # the workload shares pages
+        assert pair_rate >= single_rate - 1e-9
+
+    def test_router_http_proxy(self, server, client):
+        router = Router([server.address], page_size=PAGE,
+                        request_timeout_s=30.0)
+        proxy = router.serve()
+        try:
+            pc = ServingClient(proxy.address)
+            st = pc.healthz()
+            assert st["up"] == 1 and st["status"] == "ok"
+            want = client.completion(PROMPT, max_tokens=6)
+            out = pc.completion(PROMPT, max_tokens=6)
+            assert out["choices"][0]["token_ids"] == \
+                want["choices"][0]["token_ids"]
+            toks, final = _stream_tokens(
+                pc.completion(PROMPT, max_tokens=6, stream=True))
+            assert toks == want["choices"][0]["token_ids"]
+            assert final == "length"
+            with pytest.raises(ServingHTTPError) as ei:
+                pc.request("GET", "/nope")
+            assert ei.value.status == 404
+            with pytest.raises(ServingHTTPError) as ei:
+                pc.request("POST", "/v1/completions",
+                           {"prompt": "text", "max_tokens": 2})
+            assert ei.value.status == 400
+            text = pc.metrics_text()
+            assert "router_requests_total" in text
+            assert "router_replica_up" in text
+        finally:
+            proxy.stop()
+
+    def test_router_http_proxy_503_when_all_down(self):
+        router = Router([_free_dead_port()], page_size=PAGE,
+                        max_retries=0, fail_threshold=1,
+                        request_timeout_s=2.0)
+        proxy = router.serve()
+        try:
+            pc = ServingClient(proxy.address)
+            with pytest.raises(ServingHTTPError) as ei:
+                pc.completion(PROMPT, max_tokens=2)
+            assert ei.value.status == 503
+            assert ei.value.retry_after is not None
+        finally:
+            proxy.stop()
+
+
+# ------------------------------------------------------- satellite fixes
+class TestFleetAllReduce:
+    def test_modes(self):
+        from paddle_tpu.distributed import collective
+        from paddle_tpu.distributed.fleet.role_maker import UtilBase
+        util = UtilBase()
+        # the single-controller collective replicates host input across
+        # the active mesh, so sum scales by world size (1 when an
+        # earlier test hasn't installed a mesh) while max/min don't
+        ws = collective.get_world_size_group()
+        np.testing.assert_allclose(
+            util.all_reduce(np.array([1.0, 2.0]), "sum"),
+            np.array([1.0, 2.0]) * ws)
+        np.testing.assert_array_equal(
+            util.all_reduce([3, 7], "max"), [3, 7])
+        np.testing.assert_array_equal(
+            util.all_reduce([3, 7], "min"), [3, 7])
+
+    def test_invalid_mode_raises(self):
+        from paddle_tpu.distributed.fleet.role_maker import UtilBase
+        with pytest.raises(ValueError, match="mode"):
+            UtilBase().all_reduce([1], mode="prod")
+
+
+class TestRopeTablesSinglePosition:
+    def test_s1_serving_layout_keeps_sequence_axis(self):
+        from paddle_tpu.incubate.nn.serving import _rope_tables
+        hd = 8
+        # the reference serving layout [2, 1, S, 1, hd] at S == 1 (first
+        # decode step) squeezes to [2, hd] — must NOT be rejected
+        table = np.random.RandomState(0).randn(2, 1, 1, 1, hd) \
+            .astype("float32")
+        cos, sin = _rope_tables(table, hd)
+        assert cos.shape == (1, hd) and sin.shape == (1, hd)
+        np.testing.assert_allclose(np.asarray(cos),
+                                   table[0].reshape(1, hd))
+
+    def test_s1_half_table_tiles(self):
+        from paddle_tpu.incubate.nn.serving import _rope_tables
+        hd = 8
+        half = np.arange(2 * hd // 2, dtype="float32") \
+            .reshape(2, 1, 1, 1, hd // 2)
+        cos, sin = _rope_tables(half, hd, neox=True)
+        assert cos.shape == (1, hd)
+        np.testing.assert_array_equal(
+            np.asarray(cos)[0, :hd // 2], np.asarray(cos)[0, hd // 2:])
+        cos_i, _ = _rope_tables(half, hd, neox=False)
+        np.testing.assert_array_equal(np.asarray(cos_i)[0, ::2],
+                                      np.asarray(cos_i)[0, 1::2])
+
+    def test_multi_position_still_works_and_bad_shapes_raise(self):
+        from paddle_tpu.incubate.nn.serving import _rope_tables
+        hd = 8
+        cos, _ = _rope_tables(np.ones((2, 1, 5, 1, hd), "float32"), hd)
+        assert cos.shape == (5, hd)
+        with pytest.raises(NotImplementedError):
+            _rope_tables(np.ones((3, 4, hd), "float32"), hd)
+
+
+class TestDynamicDecodeZeroIterations:
+    class _ToyCell:
+        """Minimal deterministic RNN cell (mirror of the beam-search
+        test cell) — enough surface for BeamSearchDecoder."""
+
+        def __init__(self, vocab, hidden):
+            r = np.random.RandomState(5)
+            self.emb_w = paddle.to_tensor(
+                r.randn(vocab, hidden).astype("float32"))
+            self.w = paddle.to_tensor(
+                r.randn(hidden, hidden).astype("float32")
+                / np.sqrt(hidden))
+            self.state_shape = (hidden,)
+
+        def get_initial_states(self, batch_ref, **kw):
+            return paddle.zeros([batch_ref.shape[0], self.w.shape[0]])
+
+        def __call__(self, inputs, states):
+            h = paddle.tanh(inputs @ self.w + states)
+            return h, h
+
+    def _decoder(self, batch=2, beam=3, vocab=12, hidden=8):
+        import paddle_tpu.nn as nn
+        cell = self._ToyCell(vocab, hidden)
+        emb = lambda ids: paddle.gather(      # noqa: E731
+            paddle.to_tensor(cell.emb_w.numpy()),
+            ids.reshape([-1])).reshape(list(ids.shape) + [hidden])
+        out_w = np.random.RandomState(6).randn(hidden, vocab) \
+            .astype("float32")
+        dec = nn.BeamSearchDecoder(
+            cell, start_token=0, end_token=1, beam_size=beam,
+            embedding_fn=emb,
+            output_fn=lambda h: h @ paddle.to_tensor(out_w))
+        return dec, cell, paddle.zeros([batch, hidden])
+
+    def test_negative_max_step_num_returns_empty(self):
+        import paddle_tpu.nn as nn
+        dec, cell, enc = self._decoder()
+        outs, _states, lens = nn.dynamic_decode(
+            dec, inits=cell.get_initial_states(enc), max_step_num=-1,
+            return_length=True)
+        assert list(outs.shape) == [2, 0, 3]     # [batch, 0, beam]
+        assert not lens.numpy().any()
+        outs_tm, _ = nn.dynamic_decode(
+            dec, inits=cell.get_initial_states(enc), max_step_num=-1,
+            output_time_major=True)
+        assert list(outs_tm.shape) == [0, 2, 3]
+
+    def test_is_test_returns_empty_output_structure(self):
+        import paddle_tpu.nn as nn
+        dec, cell, enc = self._decoder()
+        outs, _ = nn.dynamic_decode(
+            dec, inits=cell.get_initial_states(enc), max_step_num=-1,
+            is_test=True)
+        assert list(outs.predicted_ids.shape) == [2, 0, 3]
+        assert list(outs.parent_ids.shape) == [2, 0, 3]
+
+    def test_decoder_without_empty_outputs_raises_clearly(self):
+        import paddle_tpu.nn as nn
+
+        class _AllDoneDecoder:
+            tracks_own_finished = True
+
+            def initialize(self, inits):
+                return (paddle.zeros([2]), paddle.zeros([2]),
+                        paddle.ones([2], "bool"))
+
+            def step(self, *a, **kw):
+                raise AssertionError("step must not run")
+
+        with pytest.raises(ValueError, match="empty_outputs"):
+            nn.dynamic_decode(_AllDoneDecoder())
+
+
+# ------------------------------------------------- serve_bench --http
+class TestServeBenchHTTP:
+    def _args(self, **over):
+        import argparse
+        base = dict(requests=4, max_slots=2, page_size=PAGE,
+                    num_pages=64, arrival_gap_ms=1.0, prompt_len=(4, 8),
+                    new_tokens=(2, 4), shared_prefix_len=PAGE,
+                    sync_interval=1, prefix_cache=True, layers=1,
+                    hidden=32, vocab=64, max_model_len=64,
+                    metrics_dir="", seed=0, http=True, replicas=2)
+        base.update(over)
+        return argparse.Namespace(**base)
+
+    def test_http_bench_smoke(self):
+        import importlib.util
+        import os
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "serve_bench", os.path.join(repo, "tools", "serve_bench.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        res = mod.run_http_bench(self._args())
+        assert res["requests"] == 4
+        assert res["tokens"] >= 4 * 2
+        assert res["router"]["up"] == 2
+        assert res["prefix_hit_rate"] > 0.0
+
+    @pytest.mark.slow
+    def test_http_bench_cli(self):
+        import os
+        import subprocess
+        import sys
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        out = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools", "serve_bench.py"),
+             "--http", "--replicas", "2", "--requests", "6",
+             "--shared-prefix-len", "32", "--page-size", "16",
+             "--prompt-len", "4", "8", "--new-tokens", "2", "4",
+             "--max-slots", "2", "--layers", "1", "--hidden", "32",
+             "--vocab", "64", "--max-model-len", "64"],
+            capture_output=True, text=True, timeout=600,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert out.returncode == 0, out.stderr
+        assert "serve_bench --http: 6 requests over 2 replica(s)" \
+            in out.stdout
+        assert "throughput" in out.stdout

@@ -182,6 +182,68 @@ def _serving_section(metrics):
     return "\n".join(lines)
 
 
+def _http_section(metrics):
+    """HTTP front-end + router summary (serving_http_* / router_*):
+    request rate by route/status, rejects (429/503), stream cancels,
+    per-replica routing outcomes and circuit state."""
+    if not any(k.startswith(("serving_http_", "router_"))
+               for k in metrics):
+        return None
+    lines = ["HTTP / router"]
+    lat = metrics.get("serving_http_request_seconds")
+    if lat:
+        count, _, avg, p50, p99 = _hist_stats(lat)
+        if count:
+            fmt = lambda v: "+Inf" if v == "+Inf" \
+                else f"{float(v) * 1e3:g}ms"
+            lines.append(f"  request latency n={count} "
+                         f"avg={avg * 1e3:.3g}ms "
+                         f"p50<={fmt(p50)} p99<={fmt(p99)}")
+    rows = []
+    for name in ("serving_http_requests_total",
+                 "serving_http_rejections_total",
+                 "serving_http_stream_cancels_total",
+                 "serving_http_inflight",
+                 "router_requests_total", "router_retries_total",
+                 "router_picks_total", "router_probes_total",
+                 "router_replica_up"):
+        entry = metrics.get(name)
+        if not entry or entry.get("type") == "histogram":
+            continue
+        for s in entry.get("series", []):
+            rows.append((name, _fmt_labels(s.get("labels", {})),
+                         _fmt_value(s.get("value", 0))))
+    if rows:
+        lines.append(_table(rows, ("name", "labels", "value")))
+    rej = metrics.get("serving_http_rejections_total")
+    if rej:
+        total = {s.get("labels", {}).get("reason", "?"):
+                 s.get("value", 0) for s in rej.get("series", [])}
+        if total:
+            lines.append("  rejections: " + ", ".join(
+                f"{k}={_fmt_value(v)}"
+                for k, v in sorted(total.items()))
+                + "  (backpressure→429, draining→503, invalid→400)")
+    up = metrics.get("router_replica_up")
+    if up:
+        n_up = sum(1 for s in up.get("series", [])
+                   if s.get("value", 0) >= 1)
+        n_all = len(up.get("series", []))
+        lines.append(f"  replicas in rotation: {n_up}/{n_all}")
+    picks = metrics.get("router_picks_total")
+    if picks:
+        by_kind = {s.get("labels", {}).get("kind", "?"):
+                   s.get("value", 0) for s in picks.get("series", [])}
+        total = sum(by_kind.values())
+        if total:
+            aff = by_kind.get("affinity", 0)
+            lines.append(f"  affinity routing: "
+                         f"{100.0 * aff / total:.1f}% of picks "
+                         f"({_fmt_value(aff)}/{_fmt_value(total)}) hit "
+                         f"the prefix-hash target")
+    return "\n".join(lines)
+
+
 def report(metrics, retraces):
     simple_rows = {"counter": [], "gauge": []}
     hist_blocks = []
@@ -204,6 +266,9 @@ def report(metrics, retraces):
     serving = _serving_section(metrics)
     if serving:
         out += [serving, ""]
+    http = _http_section(metrics)
+    if http:
+        out += [http, ""]
     if retraces and retraces.get("entries"):
         entries = sorted(retraces["entries"],
                          key=lambda e: (-e["count"], e["op"]))

@@ -20,6 +20,12 @@ prompt (the system-prompt / few-shot pattern prefix caching targets);
 with ``--prefix-cache`` (default on) the report adds the prefix-cache
 page hit rate, pages saved, and host-sync counts next to TTFT/TPOT.
 
+``--http [--replicas N]`` drives the real serving stack instead of the
+in-process engine loop: N HTTP replicas (each its own engine + worker
+thread) behind a prefix-affinity Router, with streaming clients over
+localhost.  TTFT/TPOT then include HTTP + SSE overhead, and the report
+adds per-replica request counts and the aggregate prefix hit rate.
+
 The model is a randomly initialized tiny llama (this benchmarks the
 ENGINE — scheduling, paging, dispatch — not the matmuls); sizes are
 flags so the same harness scales up on real hardware.
@@ -67,19 +73,7 @@ def run_bench(args):
                            enable_prefix_cache=args.prefix_cache,
                            sync_interval=args.sync_interval)
 
-    plo, phi = args.prompt_len
-    nlo, nhi = args.new_tokens
-    shared = rng.integers(0, args.vocab,
-                          args.shared_prefix_len).astype(np.int32)
-    workload = []
-    for i in range(args.requests):
-        suffix = rng.integers(0, args.vocab,
-                              int(rng.integers(plo, phi + 1))).astype(
-                                  np.int32)
-        workload.append((
-            i * args.arrival_gap_ms / 1e3,
-            np.concatenate([shared, suffix]) if shared.size else suffix,
-            int(rng.integers(nlo, nhi + 1))))
+    workload = _build_workload(args, rng, np)
 
     t0 = time.monotonic()
     pending = list(workload)
@@ -147,6 +141,127 @@ def run_bench(args):
             "logit_fetches": stats["logit_fetches"]}
 
 
+def _build_workload(args, rng, np):
+    plo, phi = args.prompt_len
+    nlo, nhi = args.new_tokens
+    shared = rng.integers(0, args.vocab,
+                          args.shared_prefix_len).astype(np.int32)
+    workload = []
+    for i in range(args.requests):
+        suffix = rng.integers(0, args.vocab,
+                              int(rng.integers(plo, phi + 1))).astype(
+                                  np.int32)
+        workload.append((
+            i * args.arrival_gap_ms / 1e3,
+            np.concatenate([shared, suffix]) if shared.size else suffix,
+            int(rng.integers(nlo, nhi + 1))))
+    return workload
+
+
+def run_http_bench(args):
+    """End-to-end benchmark over the HTTP serving stack: N replica
+    servers behind a Router, streaming SSE clients over localhost."""
+    import threading
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import observability as obs
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.serving import Router, serve
+
+    rng = np.random.default_rng(args.seed)
+    paddle.seed(args.seed)
+    cfg = llama_tiny(num_hidden_layers=args.layers, hidden_size=args.hidden,
+                     intermediate_size=2 * args.hidden,
+                     vocab_size=args.vocab,
+                     num_attention_heads=4, num_key_value_heads=2,
+                     max_position_embeddings=args.max_model_len)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+
+    servers = [serve(model, max_slots=args.max_slots,
+                     page_size=args.page_size,
+                     num_pages=args.num_pages,
+                     max_model_len=args.max_model_len,
+                     enable_prefix_cache=args.prefix_cache,
+                     sync_interval=args.sync_interval)
+               for _ in range(args.replicas)]
+    router = Router([s.address for s in servers],
+                    page_size=args.page_size)
+    workload = _build_workload(args, rng, np)
+
+    results = [None] * len(workload)
+    t0 = time.monotonic()
+
+    def drive(i, at, prompt, n_new):
+        time.sleep(max(0.0, at - (time.monotonic() - t0)))
+        sent = time.monotonic()
+        first = last = None
+        n_toks = 0
+        for ev in router.completion([int(t) for t in prompt],
+                                    max_tokens=n_new, stream=True):
+            got = ev["choices"][0]["token_ids"]
+            if got:
+                n_toks += len(got)
+                last = time.monotonic()
+                if first is None:
+                    first = last
+        results[i] = (sent, first, last, n_toks)
+
+    threads = [threading.Thread(target=drive, args=(i, at, p, n),
+                                daemon=True)
+               for i, (at, p, n) in enumerate(workload)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+
+    toks = sum(r[3] for r in results if r)
+    ttfts = [r[1] - r[0] for r in results if r and r[1] is not None]
+    tpots = [(r[2] - r[1]) / (r[3] - 1) for r in results
+             if r and r[3] > 1]
+
+    rstats = router.stats()
+    hits = misses = 0
+    for srv in servers:
+        st = srv.worker.stats()
+        hits += st["prefix_hits"]
+        misses += st["prefix_misses"]
+    lookups = hits + misses
+    hit_rate = hits / lookups if lookups else 0.0
+
+    print(f"serve_bench --http: {len(results)} requests over "
+          f"{args.replicas} replica(s), {toks} tokens, {wall:.3f}s wall")
+    print(f"  throughput      {toks / wall:10.1f} tok/s")
+    if ttfts:
+        print(f"  TTFT   mean/p50/p95  {np.mean(ttfts) * 1e3:8.2f} / "
+              f"{_percentile(ttfts, 0.5) * 1e3:.2f} / "
+              f"{_percentile(ttfts, 0.95) * 1e3:.2f} ms")
+    if tpots:
+        print(f"  TPOT   mean/p50/p95  {np.mean(tpots) * 1e3:8.2f} / "
+              f"{_percentile(tpots, 0.5) * 1e3:.2f} / "
+              f"{_percentile(tpots, 0.95) * 1e3:.2f} ms")
+    for rep in rstats["replicas"]:
+        print(f"  replica {rep['address']}  up={rep['up']} "
+              f"fails={rep['fails']} inflight={rep['inflight']}")
+    if args.prefix_cache:
+        print(f"  prefix cache         hit rate {hit_rate * 100:.1f}% "
+              f"({hits}/{lookups} page lookups across replicas)")
+
+    router.stop()
+    for srv in servers:
+        srv.stop(drain_timeout=5.0)
+    if args.metrics_dir:
+        out = obs.dump(args.metrics_dir)
+        print(f"  metrics dump         {out} "
+              f"(render: python tools/metrics_report.py {out})")
+    return {"requests": len(results), "tokens": toks, "wall_s": wall,
+            "throughput": toks / wall, "ttft_s": ttfts, "tpot_s": tpots,
+            "prefix_hit_rate": hit_rate, "router": rstats}
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--requests", type=int, default=16)
@@ -171,10 +286,19 @@ def main(argv=None):
     ap.add_argument("--hidden", type=int, default=64)
     ap.add_argument("--vocab", type=int, default=128)
     ap.add_argument("--max-model-len", type=int, default=128)
+    ap.add_argument("--http", action="store_true",
+                    help="drive the real HTTP stack (replica servers + "
+                         "router + SSE clients) instead of the "
+                         "in-process engine loop")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="replica server count for --http")
     ap.add_argument("--metrics-dir", default="")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
-    run_bench(args)
+    if args.http:
+        run_http_bench(args)
+    else:
+        run_bench(args)
     return 0
 
 
