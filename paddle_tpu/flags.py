@@ -68,3 +68,21 @@ define_flag("FLAGS_host_trace", False,
 define_flag("FLAGS_comm_timeout_seconds", 1800.0,
             "default CommTask timeout for the comm watchdog "
             "(PADDLE_COMM_TIMEOUT_SECONDS env overrides)")
+define_flag("FLAGS_trace_buffer_size", 4096,
+            "tracing: capacity of the per-process finished-span ring "
+            "(observability.tracing.Tracer)")
+define_flag("FLAGS_flight_recorder_size", 512,
+            "capacity of the engine flight-recorder event ring "
+            "(dumped by /debug/flight and the serving watchdog)")
+define_flag("FLAGS_serving_watchdog_seconds", 0.0,
+            "serving watchdog: seconds of zero decode-loop progress "
+            "with active slots before a hang dump (0 disables)")
+define_flag("FLAGS_serving_slo_ttft_ms", 0.0,
+            "SLO target for time-to-first-token, ms (0 disables)")
+define_flag("FLAGS_serving_slo_tpot_ms", 0.0,
+            "SLO target for per-output-token latency, ms (0 disables)")
+define_flag("FLAGS_serving_slo_e2e_ms", 0.0,
+            "SLO target for request end-to-end latency, ms (0 disables)")
+define_flag("FLAGS_serving_slo_objective", 0.99,
+            "SLO objective (fraction of requests that must meet each "
+            "target) — burn rate = violation rate / (1 - objective)")

@@ -29,11 +29,17 @@ import time
 
 from .registry import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, default_registry)
+from .tracing import (  # noqa: F401
+    FlightRecorder, Span, SpanContext, Tracer, flight_recorder,
+    format_traceparent, parse_traceparent, tracer)
 
-__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+__all__ = ["Counter", "FlightRecorder", "Gauge", "Histogram",
+           "MetricsRegistry", "Span", "SpanContext", "Tracer",
            "default_registry", "counter", "gauge", "histogram",
-           "retrace_log", "RetraceLog", "dump", "reset",
-           "enable_event_sampling", "chrome_counter_events"]
+           "retrace_log", "RetraceLog", "dump", "reset", "flight",
+           "enable_event_sampling", "chrome_counter_events",
+           "flight_recorder", "format_traceparent", "parse_traceparent",
+           "tracer"]
 
 
 def counter(name, help_="", labelnames=()):
@@ -56,6 +62,12 @@ def enable_event_sampling(on=True):
 
 def chrome_counter_events(pid=None):
     return default_registry().chrome_counter_events(pid)
+
+
+def flight(category, event, **attrs):
+    """Record one engine flight-recorder event (bounded ring; see
+    tracing.FlightRecorder).  Hot-path safe: one deque append."""
+    flight_recorder().record(category, event, **attrs)
 
 
 class RetraceLog:
@@ -112,16 +124,21 @@ retrace_log = RetraceLog()
 
 
 def reset():
-    """Drop all metrics + retrace entries (tests / between runs)."""
+    """Drop all metrics + retrace entries + spans + flight events
+    (tests / between runs)."""
     default_registry().reset()
     retrace_log.clear()
+    tracer().reset()
+    flight_recorder().clear()
 
 
 def dump(dir_=None) -> str | None:
-    """Write the registry as ``metrics.prom`` + ``metrics.json`` and the
-    retrace log as ``retraces.json`` into ``dir_`` (default:
-    ``FLAGS_metrics_dir``).  Returns the directory, or None when no
-    directory is configured."""
+    """Write the registry as ``metrics.prom`` + ``metrics.json``, the
+    retrace log as ``retraces.json``, the span ring as ``trace.json``
+    (chrome://tracing-loadable, with a parallel ``spans`` list for
+    programmatic consumers), and the flight-recorder ring as
+    ``flight.json`` into ``dir_`` (default: ``FLAGS_metrics_dir``).
+    Returns the directory, or None when no directory is configured."""
     if dir_ is None:
         from ..flags import FLAGS
         dir_ = FLAGS.get("FLAGS_metrics_dir") or None
@@ -136,4 +153,15 @@ def dump(dir_=None) -> str | None:
     with open(os.path.join(dir_, "retraces.json"), "w") as f:
         json.dump({"entries": retrace_log.entries(),
                    "by_op": retrace_log.by_op()}, f, indent=2)
+    tr = tracer()
+    with open(os.path.join(dir_, "trace.json"), "w") as f:
+        json.dump({"traceEvents": (tr.chrome_events()
+                                   + chrome_counter_events()),
+                   "spans": [s.to_dict() for s in tr.spans()],
+                   "recorded": tr.spans_recorded,
+                   "dropped": tr.spans_dropped}, f, indent=2)
+    fr = flight_recorder()
+    with open(os.path.join(dir_, "flight.json"), "w") as f:
+        json.dump({"capacity": fr.capacity, "events": fr.snapshot()},
+                  f, indent=2)
     return dir_

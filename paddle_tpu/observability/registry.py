@@ -51,6 +51,12 @@ def _escape(v):
         "\n", r"\n")
 
 
+def _escape_help(v):
+    # HELP docstrings escape only backslash and newline (the text
+    # exposition format; quotes stay literal there)
+    return str(v).replace("\\", r"\\").replace("\n", r"\n")
+
+
 class _Child:
     """One (metric, labelvalues) time series."""
 
@@ -316,10 +322,17 @@ class MetricsRegistry:
 
     # ------------------------------------------------------------ export
     def to_prometheus(self) -> str:
+        """Prometheus text exposition (format version 0.0.4).
+        Conformance contract (tested by the text-format lint in
+        tests/test_tracing.py): exactly one ``# HELP`` then one
+        ``# TYPE`` line per family, in that order, before its samples;
+        every histogram series exports a ``+Inf`` bucket whose
+        cumulative count equals ``_count``, and both ``_sum`` and
+        ``_count`` are present.  Serve with
+        ``Content-Type: text/plain; version=0.0.4``."""
         lines = []
         for name, m in sorted(self._metrics.items()):
-            if m.help:
-                lines.append(f"# HELP {name} {m.help}")
+            lines.append(f"# HELP {name} {_escape_help(m.help)}".rstrip())
             lines.append(f"# TYPE {name} {m.kind}")
             for labelvalues, child in sorted(m._series()):
                 lbl = _fmt_labels(m.labelnames, labelvalues)

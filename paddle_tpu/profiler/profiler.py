@@ -8,6 +8,7 @@ from __future__ import annotations
 import enum
 import os
 import tempfile
+import threading
 import time
 
 import jax
@@ -49,28 +50,32 @@ def _enable_host_tracing_impl(on: bool) -> bool:
 
 def export_host_trace(path: str) -> bool:
     """Write collected host spans as chrome://tracing JSON (analog of
-    chrometracing_logger.cc).  Sampled observability counters (metric
-    changes recorded while a profiler was recording) are merged in as
-    "C"-phase events — the native tracer and the registry both stamp
-    CLOCK_MONOTONIC (steady_clock / perf_counter), so spans and counter
-    tracks line up on one timeline."""
+    chrometracing_logger.cc).  Three sources merge onto one timeline —
+    the native tracer, the metrics registry's sampled counters, and the
+    observability span ring (request/engine spans from the serving
+    stack) all stamp CLOCK_MONOTONIC (steady_clock / perf_counter).
+    Span events carry the real OS tid of the thread that ran them plus
+    "M"-phase thread_name metadata, so the engine worker, HTTP handler,
+    and router threads render as separate named rows."""
     from .. import observability as _obs
-    counters = _obs.chrome_counter_events(os.getpid())
+    pid = os.getpid()
+    extras = _obs.chrome_counter_events(pid)
+    extras += _obs.tracer().chrome_events(pid)
     lib = _native()
     if lib is None:
-        if not counters:
+        if not extras:
             return False
         import json
         with open(path, "w") as f:
-            json.dump({"traceEvents": counters}, f)
+            json.dump({"traceEvents": extras}, f)
         return True
-    ok = lib.pt_trace_export(path.encode(), os.getpid()) == 0
-    if ok and counters:
+    ok = lib.pt_trace_export(path.encode(), pid) == 0
+    if ok and extras:
         import json
         try:
             with open(path) as f:
                 doc = json.load(f)
-            doc.setdefault("traceEvents", []).extend(counters)
+            doc.setdefault("traceEvents", []).extend(extras)
             with open(path, "w") as f:
                 json.dump(doc, f)
         except (OSError, ValueError):    # leave the native export as-is
@@ -277,37 +282,50 @@ class Profiler:
 class RecordEvent:
     """Named host span visible in the trace (reference
     phi::RecordEvent / event_tracing.h) — maps to
-    jax.profiler.TraceAnnotation."""
+    jax.profiler.TraceAnnotation.
+
+    One instance may be shared across threads (module-level RecordEvents
+    wrapping collectives under the threaded serving server), so all
+    per-use state — start time, the TraceAnnotation, the native-stack
+    pushed flag — lives in a threading.local; concurrent begin()/end()
+    pairs on different threads never clobber each other."""
 
     def __init__(self, name, event_type=None):
         self.name = name
-        self._ann = jax.profiler.TraceAnnotation(name)
-        self._pushed = False
+        self._tls = threading.local()
 
     def begin(self):
-        self._t0 = time.perf_counter()
+        tls = self._tls
+        tls.t0 = time.perf_counter()
+        tls.pushed = False
         # only touch (and possibly build) the native lib if host tracing was
         # ever requested — keeps the default path free of g++ invocations
         if _host_tracing_requested:
             lib = _native()
             if lib is not None and lib.pt_trace_enabled():
                 lib.pt_trace_begin(self.name.encode())
-                self._pushed = True
-        self._ann.__enter__()
+                tls.pushed = True
+        tls.ann = jax.profiler.TraceAnnotation(self.name)
+        tls.ann.__enter__()
 
     def end(self):
-        self._ann.__exit__(None, None, None)
+        tls = self._tls
+        t0 = getattr(tls, "t0", None)
+        if t0 is None:          # end() without begin() on this thread
+            return
+        tls.t0 = None
+        tls.ann.__exit__(None, None, None)
         from . import statistic
         if statistic.ENABLED:
-            statistic.record_span(self.name,
-                                  time.perf_counter() - self._t0, "user")
-        if self._pushed:
+            dt = time.perf_counter() - t0
+            statistic.record_span(self.name, dt, "user")
+        if tls.pushed:
             # pop regardless of the current enabled state so the native
             # thread-local span stack stays balanced
             lib = _native()
             if lib is not None:
                 lib.pt_trace_end()
-            self._pushed = False
+            tls.pushed = False
 
     def __enter__(self):
         self.begin()

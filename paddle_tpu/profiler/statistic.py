@@ -19,6 +19,7 @@ summary.
 from __future__ import annotations
 
 import enum
+import threading
 from dataclasses import dataclass, field
 
 __all__ = ["SortedKeys", "EventSummary", "enable_collection",
@@ -65,6 +66,11 @@ class EventSummary:
 
 ENABLED = False
 _STATS: dict[tuple[str, str], EventSummary] = {}
+# RecordEvent spans close from whatever thread ran them — under the
+# threaded serving server that means concurrent record_span calls, so
+# the aggregate map needs a lock (EventSummary.add is a read-modify-
+# write of three fields).
+_STATS_LOCK = threading.Lock()
 
 
 def enable_collection(on: bool = True):
@@ -81,19 +87,22 @@ def collection_enabled() -> bool:
 
 
 def reset():
-    _STATS.clear()
+    with _STATS_LOCK:
+        _STATS.clear()
 
 
 def record_span(name: str, dt: float, kind: str = "op"):
     key = (kind, name)
-    s = _STATS.get(key)
-    if s is None:
-        s = _STATS[key] = EventSummary(name=name, kind=kind)
-    s.add(dt)
+    with _STATS_LOCK:
+        s = _STATS.get(key)
+        if s is None:
+            s = _STATS[key] = EventSummary(name=name, kind=kind)
+        s.add(dt)
 
 
 def op_summary() -> list[EventSummary]:
-    return list(_STATS.values())
+    with _STATS_LOCK:
+        return list(_STATS.values())
 
 
 _UNITS = {"s": 1.0, "ms": 1e3, "us": 1e6, "ns": 1e9}

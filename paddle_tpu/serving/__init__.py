@@ -32,6 +32,16 @@ Modules:
   * router.py        — multi-replica router: prefix-affinity routing,
                        health probing + circuit breaking, bounded retry
   * client.py        — stdlib blocking/streaming HTTP client
+  * watchdog.py      — stalled-decode-loop detector (flight-recorder +
+                       thread-stack hang dumps)
+  * slo.py           — per-request TTFT/TPOT/E2E SLO verdicts and
+                       burn-rate gauges
+
+Every request is traced end to end (observability.tracing): the client,
+router, server, and engine each open spans under ONE trace id carried
+in the W3C ``traceparent`` header; ``GET /debug/trace`` on any replica
+or router returns a chrome://tracing-loadable JSON of recent spans,
+``GET /debug/flight`` the engine flight-recorder ring.
 
 Reference analog: the block_multi_head_attention serving path +
 paddle_infer predictors, restructured as a vLLM/Orca-style engine.
@@ -47,9 +57,12 @@ from .router import (  # noqa: F401
 from .scheduler import Scheduler  # noqa: F401
 from .server import (  # noqa: F401
     BackpressureError, DrainingError, EngineWorker, ServingServer, serve)
+from .slo import SLOConfig, SLOTracker  # noqa: F401
+from .watchdog import Watchdog  # noqa: F401
 
 __all__ = ["BackpressureError", "BlockManager", "DrainingError", "Engine",
            "EngineWorker", "GenerationConfig", "NoReplicaAvailable",
            "Replica", "Request", "RequestState", "Router", "RouterServer",
-           "Scheduler", "ServingClient", "ServingHTTPError",
-           "ServingServer", "create_engine", "serve"]
+           "SLOConfig", "SLOTracker", "Scheduler", "ServingClient",
+           "ServingHTTPError", "ServingServer", "Watchdog",
+           "create_engine", "serve"]

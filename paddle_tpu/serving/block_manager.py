@@ -152,6 +152,8 @@ class BlockManager:
             self._ref[p] = 1
         self._tables[seq_id] = pages
         self._meta[seq_id] = {"cached_len": 0, "cow_src": None}
+        _obs.flight("blocks", "alloc_seq", seq=seq_id, pages=len(pages),
+                    shared=0, cached_tokens=0, cow=False)
         _M_PAGES_IN_USE.set(self.pages_in_use)
         return list(pages)
 
@@ -238,6 +240,9 @@ class BlockManager:
         pages = matched + fresh
         self._tables[seq_id] = pages
         self._meta[seq_id] = {"cached_len": cached_len, "cow_src": cow_src}
+        _obs.flight("blocks", "alloc_seq", seq=seq_id, pages=len(pages),
+                    shared=m, cached_tokens=cached_len,
+                    cow=cow_src is not None)
 
         # register this prompt's fresh full chunks (chain through any
         # page an identical chunk already cached)
@@ -328,6 +333,7 @@ class BlockManager:
             self._unregister(page)
             self._free.append(page)
             self.prefix_evictions += 1
+            _obs.flight("blocks", "page_evict", page=page)
             _M_PREFIX_EVICT.inc()
             _M_CACHED_PAGES.set(self.cached_pages)
             return True

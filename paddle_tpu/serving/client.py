@@ -22,6 +22,8 @@ from __future__ import annotations
 import http.client
 import json
 
+from ..observability import tracing as _tracing
+
 __all__ = ["ServingClient", "ServingHTTPError"]
 
 
@@ -65,13 +67,16 @@ class ServingClient:
                                           timeout=self.timeout)
 
     # ------------------------------------------------------ plain JSON
-    def request(self, method: str, path: str, body: dict | None = None):
+    def request(self, method: str, path: str, body: dict | None = None,
+                headers: dict | None = None):
         """One JSON round trip; raises ServingHTTPError on non-2xx."""
         conn = self._connect()
         try:
             payload = None if body is None else json.dumps(body).encode()
-            conn.request(method, path, body=payload,
-                         headers={"Content-Type": "application/json"})
+            hdrs = {"Content-Type": "application/json"}
+            if headers:
+                hdrs.update(headers)
+            conn.request(method, path, body=payload, headers=hdrs)
             resp = conn.getresponse()
             raw = resp.read()
             return self._decode(resp, raw)
@@ -103,26 +108,45 @@ class ServingClient:
         if timeout is not None:
             body["timeout"] = float(timeout)
         body.update(gen_kw)
+        # every completion opens a "client.completion" span (nesting
+        # under the caller's current span, e.g. router.request) and
+        # carries its context to the server as a traceparent header —
+        # the client end of the distributed trace
+        span = _tracing.tracer().start_span(
+            "client.completion",
+            attributes={"address": self.address, "stream": bool(stream)})
+        hdrs = {_tracing.TRACEPARENT_HEADER:
+                _tracing.format_traceparent(span.context)}
         if not stream:
-            return self.request("POST", "/v1/completions", body)
-        return self._stream_completion(body)
+            try:
+                return self.request("POST", "/v1/completions", body,
+                                    headers=hdrs)
+            finally:
+                span.end()
+        try:
+            return self._stream_completion(body, hdrs, span)
+        except BaseException:
+            span.end()
+            raise
 
-    def _stream_completion(self, body: dict):
+    def _stream_completion(self, body: dict, headers: dict, span=None):
         conn = self._connect()
         try:
+            hdrs = {"Content-Type": "application/json"}
+            hdrs.update(headers)
             conn.request("POST", "/v1/completions",
-                         body=json.dumps(body).encode(),
-                         headers={"Content-Type": "application/json"})
+                         body=json.dumps(body).encode(), headers=hdrs)
             resp = conn.getresponse()
             if resp.status != 200:
                 self._decode(resp, resp.read())     # raises
         except BaseException:
             conn.close()
             raise
-        return self._iter_sse(conn, resp)
+        return self._iter_sse(conn, resp, span)
 
     @staticmethod
-    def _iter_sse(conn, resp):
+    def _iter_sse(conn, resp, span=None):
+        n = 0
         try:
             while True:
                 line = resp.readline()
@@ -134,9 +158,13 @@ class ServingClient:
                 data = line[len(b"data:"):].strip()
                 if data == b"[DONE]":
                     return
+                n += 1
                 yield json.loads(data.decode())
         finally:
             conn.close()
+            if span is not None:        # span covers the full stream
+                span.set_attribute("events", n)
+                span.end()
 
     def completion_tokens(self, prompt, **kw) -> list[int]:
         """Blocking completion, returning just the generated token ids."""

@@ -74,6 +74,10 @@ _M_TOKENS = _obs.counter(
     "serving_tokens_total", "tokens emitted to requests")
 _M_REQUESTS = _obs.counter(
     "serving_requests_total", "finished requests", ("outcome",))
+_M_FINISH = _obs.counter(
+    "serving_finish_total",
+    "finished requests by finish_reason "
+    "(length|eos|cancelled|deadline)", ("reason",))
 _M_HOST_SYNCS = _obs.counter(
     "serving_host_syncs_total",
     "device->host transfers on the serving hot path: 'ring' = sampled-"
@@ -112,7 +116,8 @@ class Engine:
                  max_model_len: int | None = None,
                  emit_logits: bool = False,
                  enable_prefix_cache: bool = False,
-                 sync_interval: int = 1, clock=time.monotonic):
+                 sync_interval: int = 1, clock=time.monotonic,
+                 slo=None):
         if model is not None:
             from ..framework.tensor import Tensor
             config = model.config
@@ -190,6 +195,15 @@ class Engine:
         self.decode_traces = 0      # python-side mirror of _M_STEP_TRACES
         self.host_syncs = 0         # ring fetches (1 per sync_interval)
         self.logit_fetches = 0      # [slots, V] transfers (sampling only)
+        # monotonically increasing iteration counter.  The serving
+        # watchdog reads it lock-free (comparing against active_count)
+        # to detect a wedged decode loop — never reset.
+        self.progress = 0
+        self.slo = slo              # optional slo.SLOTracker
+        # open "engine.decode_segment" span covering the device steps
+        # since the last host sync (None between segments)
+        self._seg_span = None
+        self._seg_steps = 0
         self._rngs: dict[int, np.random.Generator] = {}
         self._ttft, self._tpot, self._e2e = _serving_hists()
         self._pages_hist = _obs.histogram(
@@ -351,7 +365,12 @@ class Engine:
     # ----------------------------------------------------------- intake
     def submit(self, prompt, gen: GenerationConfig | None = None, *,
                deadline: float | None = None, on_token=None,
-               arrival_time: float | None = None) -> Request:
+               arrival_time: float | None = None, trace=None) -> Request:
+        """``trace`` is an optional tracing.SpanContext (or Span) the
+        request's root span is parented under — the server passes the
+        extracted ``traceparent`` here so the engine-side spans join the
+        caller's distributed trace.  Without it the root span inherits
+        the submitting thread's current span, if any."""
         req = Request(prompt, gen, deadline=deadline, on_token=on_token,
                       arrival_time=(self._clock() if arrival_time is None
                                     else arrival_time))
@@ -373,6 +392,22 @@ class Engine:
                 "do_sample requests need an engine built with "
                 "emit_logits=True (host-side sampling reads the logits)")
         req._engine = self
+        # spans only after every validation — a rejected submit must not
+        # leave dangling open spans
+        tr = _obs.tracer()
+        attrs = {"req": req.id, "prompt_len": int(req.prompt.size),
+                 "max_new_tokens": int(req.gen.max_new_tokens)}
+        req.trace_parent = trace
+        if trace is not None:
+            req.root_span = tr.start_span("request", parent=trace,
+                                          attributes=attrs)
+        else:
+            req.root_span = tr.start_span("request", attributes=attrs)
+        req.queue_span = tr.start_span("scheduler.queue_wait",
+                                       parent=req.root_span)
+        _obs.flight("engine", "submit", req=req.id,
+                    prompt_len=int(req.prompt.size),
+                    trace=req.root_span.trace_id)
         self.scheduler.submit(req)
         return req
 
@@ -389,6 +424,7 @@ class Engine:
                   if r is not None and r.state == RequestState.DECODE]
         if active:
             self._decode(active)
+        self.progress += 1          # watchdog heartbeat
         return bool(admitted) or bool(active)
 
     def run_until_complete(self, max_steps: int | None = None):
@@ -413,6 +449,10 @@ class Engine:
 
     # ----------------------------------------------------------- prefill
     def _prefill(self, slot: int, req: Request):
+        if req.queue_span is not None:      # queue wait ends at admission
+            req.queue_span.end()
+            req.queue_span = None
+        t0 = time.perf_counter()
         ps = self.page_size
         plen = req.prompt.size
         meta = self.blocks.seq_meta(req.id)
@@ -451,6 +491,19 @@ class Engine:
         tok = self._pick_token(req, np.asarray(logits)[0])
         now = self._clock()
         self._ttft.observe(now - req.arrival_time)
+        _obs.tracer().record_span(
+            "engine.prefill", t0, time.perf_counter(),
+            parent=req.root_span,
+            attributes={"req": req.id, "slot": slot, "bucket": bucket,
+                        "cached_tokens": cached,
+                        "kind": "cached_suffix" if cached else "full",
+                        "cow": meta["cow_src"] is not None})
+        if req.root_span is not None:
+            req.decode_span = _obs.tracer().start_span(
+                "engine.decode", parent=req.root_span,
+                attributes={"req": req.id, "slot": slot})
+        _obs.flight("engine", "prefill", req=req.id, slot=slot,
+                    bucket=bucket, cached=cached)
         self.table[slot] = row
         self._pos[slot] = plen
         self._tok[slot] = tok
@@ -461,6 +514,14 @@ class Engine:
 
     # ------------------------------------------------------------ decode
     def _decode(self, active: list[int]):
+        if self._seg_span is None:
+            # one span per host-sync interval, NOT per device step —
+            # segments are the engine's visible unit of decode work
+            self._seg_span = _obs.tracer().start_span(
+                "engine.decode_segment", parent=None,
+                attributes={"slots": len(active)})
+            self._seg_steps = 0
+        self._seg_steps += 1
         reqs = [(s, self.scheduler.slots[s]) for s in active]
         (self.kpool, self.vpool, self._pos_dev, self._tok_dev,
          self._ring_dev, self._ridx_dev, logits) = self._step_fn(
@@ -487,6 +548,15 @@ class Engine:
         ring = np.asarray(self._ring_dev)
         self.host_syncs += 1
         _M_HOST_SYNCS.labels("ring").inc()
+        if self._seg_span is not None:
+            # the ring fetch above blocked on the device — the segment
+            # span ends here, covering dispatch through host sync
+            self._seg_span.set_attribute("steps", self._seg_steps)
+            self._seg_span.end()
+            self._seg_span = None
+        _obs.flight("engine", "host_sync", rows=len(self._pending),
+                    steps=self._seg_steps)
+        sample_t0 = None
         logits_np = None
         now = self._clock()
         n_rows = len(self._pending)
@@ -500,6 +570,7 @@ class Engine:
                     # sampling rows only exist under eff-interval 1, so
                     # the step's logits handle is always the right row
                     if logits_np is None:
+                        sample_t0 = time.perf_counter()
                         logits_np = np.asarray(self._last_logits)
                         self.logit_fetches += 1
                         _M_HOST_SYNCS.labels("logits").inc()
@@ -514,6 +585,12 @@ class Engine:
                 self._tok[slot] = tok
                 self._emit(slot, req, tok, now)
         self._pending.clear()
+        if sample_t0 is not None:
+            # host-side sampling for this sync: logits fetch + per-
+            # request pick (argmax/top-k/top-p) + any device feedback
+            _obs.tracer().record_span(
+                "engine.sample", sample_t0, time.perf_counter(),
+                attributes={"corrections": len(corrections)})
         if corrections:
             idx = jnp.asarray([s for s, _ in corrections], jnp.int32)
             val = jnp.asarray([t for _, t in corrections], jnp.int32)
@@ -590,6 +667,30 @@ class Engine:
         self._rngs.pop(req.id, None)
         self._e2e.observe(now - req.arrival_time)
         _M_REQUESTS.labels(reason).inc()
+        _M_FINISH.labels(reason).inc()
+        if self.slo is not None:
+            self.slo.observe(req, now)
+        _obs.flight("engine", "finish", req=req.id, reason=reason,
+                    generated=req.num_generated)
+        if req.queue_span is not None:      # dropped while still queued
+            req.queue_span.set_attribute("dropped", True)
+            req.queue_span.end()
+            req.queue_span = None
+        if req.decode_span is not None:
+            req.decode_span.set_attribute("generated", req.num_generated)
+            req.decode_span.end()
+            req.decode_span = None
+        if req.root_span is not None:
+            rs = req.root_span
+            rs.set_attribute("finish_reason", reason)
+            rs.set_attribute("generated", req.num_generated)
+            rs.set_attribute("cached_tokens", req.num_cached_tokens)
+            if reason == "deadline" and req.deadline is not None:
+                # how far past its deadline the request was when the
+                # scheduler finally evicted it (engine clock)
+                rs.set_attribute("deadline_overrun_s",
+                                 round(now - req.deadline, 6))
+            rs.end()
 
     # -------------------------------------------------------------- info
     def stats(self) -> dict:
@@ -610,6 +711,8 @@ class Engine:
             "cached_pages": b.cached_pages,
             "host_syncs": self.host_syncs,
             "logit_fetches": self.logit_fetches,
+            "progress": self.progress,
+            "slo": self.slo.stats() if self.slo is not None else None,
         }
 
 
@@ -673,8 +776,8 @@ def create_engine(model, *, max_slots: int = 4, page_size: int = 64,
                   max_model_len: int | None = None,
                   emit_logits: bool = False,
                   enable_prefix_cache: bool = False,
-                  sync_interval: int = 1, clock=time.monotonic
-                  ) -> Engine:
+                  sync_interval: int = 1, clock=time.monotonic,
+                  slo=None) -> Engine:
     """`create_predictor`-style entry point: build a continuous-batching
     engine over a LlamaForCausalLM (or any model exposing ``config`` and
     ``functional_state()`` with the llama state-dict layout).
@@ -698,4 +801,4 @@ def create_engine(model, *, max_slots: int = 4, page_size: int = 64,
                   num_pages=num_pages, max_model_len=max_model_len,
                   emit_logits=emit_logits,
                   enable_prefix_cache=enable_prefix_cache,
-                  sync_interval=sync_interval, clock=clock)
+                  sync_interval=sync_interval, clock=clock, slo=slo)

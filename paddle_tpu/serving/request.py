@@ -66,6 +66,16 @@ class Request:
         # admission (0 with caching off); set by Engine._prefill
         self.num_cached_tokens = 0
 
+        # tracing (observability.tracing): the engine opens a root
+        # "request" span per request — parented under the caller's
+        # traceparent when one arrived over HTTP — plus child spans for
+        # the queue wait and the decode phase.  All None when tracing
+        # is not in play (engine-only tests, bare Request objects).
+        self.trace_parent = None          # SpanContext from the caller
+        self.root_span = None
+        self.queue_span = None
+        self.decode_span = None
+
         # timing (engine clock): TTFT = first_token_at - arrival_time
         self.arrival_time = time.monotonic() if arrival_time is None \
             else arrival_time

@@ -223,7 +223,18 @@ class Router:
     def completion(self, prompt, *, stream: bool = False, **kw):
         """Route one completion.  Transport failures before any
         response bytes retry on up to ``max_retries`` other replicas;
-        HTTP answers (429/503/400...) propagate as ServingHTTPError."""
+        HTTP answers (429/503/400...) propagate as ServingHTTPError.
+
+        Opens a ``router.request`` span covering pick + retry; the
+        :class:`ServingClient` call inside nests under it (contextvar)
+        and carries the trace to the replica as a traceparent header."""
+        with _obs.tracer().start_span(
+                "router.request",
+                attributes={"stream": bool(stream)}) as span:
+            return self._completion_traced(span, prompt, stream=stream,
+                                           **kw)
+
+    def _completion_traced(self, span, prompt, *, stream, **kw):
         tried: list[Replica] = []
         last_exc: BaseException | None = None
         for attempt in range(self.max_retries + 1):
@@ -235,6 +246,8 @@ class Router:
                 raise NoReplicaAvailable(
                     "all retry candidates failed "
                     f"(last: {last_exc!r})") from last_exc
+            span.set_attribute("replica", rep.address)
+            span.set_attribute("attempts", attempt + 1)
             client = ServingClient(rep.address,
                                    timeout=self.request_timeout_s)
             with self._lock:
@@ -263,6 +276,8 @@ class Router:
                 last_exc = e
                 if attempt < self.max_retries:
                     _M_RETRIES.inc()
+                    span.add_event("retry", replica=rep.address,
+                                   error=repr(e))
                 continue
             with self._lock:
                 rep.inflight -= 1
@@ -388,6 +403,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 self.wfile.write(text)
             except (BrokenPipeError, ConnectionResetError):
                 pass
+        elif self.path == "/debug/trace":
+            self._json(200, {"traceEvents":
+                             (_obs.tracer().chrome_events()
+                              + _obs.chrome_counter_events())})
         else:
             self._json(404, {"error": {"message": f"no route {self.path}",
                                        "code": 404}})
@@ -414,6 +433,16 @@ class _RouterHandler(BaseHTTPRequestHandler):
         self._json(200, {"replicas": results})
 
     def _proxy_completion(self):
+        # join the client's trace (or start one) and hand OUR span id
+        # downstream: client -> router -> replica becomes one trace
+        parent = _obs.parse_traceparent(self.headers.get("traceparent"))
+        span = _obs.tracer().start_span(
+            "router.request", parent=parent,
+            attributes={"proxy": True, "remote": parent is not None})
+        with span:
+            self._proxy_completion_traced(span)
+
+    def _proxy_completion_traced(self, span):
         router = self.server.router
         try:
             n = int(self.headers.get("Content-Length") or 0)
@@ -423,20 +452,27 @@ class _RouterHandler(BaseHTTPRequestHandler):
             if prompt is None or isinstance(prompt, str):
                 raise ValueError("'prompt' must be a list of token ids")
         except (ValueError, json.JSONDecodeError) as e:
+            span.set_attribute("status", 400)
             return self._json(400, {"error": {"message": str(e),
                                               "code": 400}})
 
+        upstream_headers = {
+            "Content-Type": "application/json",
+            "traceparent": _obs.format_traceparent(span.context)}
         tried: list[Replica] = []
         last_exc: BaseException | None = None
         for attempt in range(router.max_retries + 1):
             try:
                 rep = router.pick(prompt, exclude=tried)
             except NoReplicaAvailable as e:
+                span.set_attribute("status", 503)
                 return self._json(
                     503, {"error": {"message": str(last_exc or e),
                                     "type": "overloaded_error",
                                     "code": 503}},
                     headers=[("Retry-After", f"{router.cooldown_s:g}")])
+            span.set_attribute("replica", rep.address)
+            span.set_attribute("attempts", attempt + 1)
             host, _, port = rep.address.rpartition(":")
             conn = http.client.HTTPConnection(
                 host, int(port), timeout=router.request_timeout_s)
@@ -444,7 +480,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 rep.inflight += 1
             try:
                 conn.request("POST", "/v1/completions", raw,
-                             {"Content-Type": "application/json"})
+                             upstream_headers)
                 resp = conn.getresponse()
             except OSError as e:
                 conn.close()
@@ -456,14 +492,18 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 last_exc = e
                 if attempt < router.max_retries:
                     _M_RETRIES.inc()
+                    span.add_event("retry", replica=rep.address,
+                                   error=repr(e))
                 continue
             try:
+                span.set_attribute("status", resp.status)
                 self._relay(rep, resp)
             finally:
                 conn.close()
                 with router._lock:
                     rep.inflight -= 1
             return
+        span.set_attribute("status", 503)
         self._json(503, {"error": {"message": f"request failed on "
                                               f"{len(tried)} replica(s) "
                                               f"(last: {last_exc!r})",

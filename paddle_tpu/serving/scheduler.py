@@ -87,8 +87,12 @@ class Scheduler:
         kept = deque()
         for req in self.queue:
             if req.cancel_requested:
+                _obs.flight("scheduler", "queue_drop", req=req.id,
+                            reason="cancelled")
                 self._finish(req, "cancelled", now)
             elif req.deadline is not None and now > req.deadline:
+                _obs.flight("scheduler", "queue_drop", req=req.id,
+                            reason="deadline")
                 self._finish(req, "deadline", now)
             else:
                 kept.append(req)
@@ -100,6 +104,8 @@ class Scheduler:
             free = [i for i, r in enumerate(self.slots) if r is None]
             if not free:
                 _M_BACKPRESSURE.labels("slots").inc()
+                _obs.flight("scheduler", "backpressure", reason="slots",
+                            head=self.queue[0].id, queued=len(self.queue))
                 break
             head = self.queue[0]
             # prefix-cache-aware reservation: shared prefix pages are
@@ -111,6 +117,8 @@ class Scheduler:
                 # pool exhausted: the head waits (and blocks the queue —
                 # strict FCFS), surfaced as backpressure, not an error
                 _M_BACKPRESSURE.labels("pages").inc()
+                _obs.flight("scheduler", "backpressure", reason="pages",
+                            head=self.queue[0].id, queued=len(self.queue))
                 break
             self.queue.popleft()
             slot = free[0]
@@ -118,6 +126,11 @@ class Scheduler:
             head.state = RequestState.PREFILL
             head.admitted_at = now
             _M_ADMITTED.inc()
+            _obs.flight("scheduler", "admit", req=head.id, slot=slot,
+                        pages=len(pages), queued=len(self.queue))
+            if head.root_span is not None:
+                head.root_span.add_event("scheduler.admit", slot=slot,
+                                         pages=len(pages))
             admitted.append((slot, head))
 
         _M_QUEUE_DEPTH.set(len(self.queue))
@@ -136,6 +149,11 @@ class Scheduler:
         if self._on_evict is not None:
             self._on_evict(slot)
         _M_EVICTED.labels(reason).inc()
+        _obs.flight("scheduler", "evict", req=req.id, slot=slot,
+                    reason=reason, generated=req.num_generated)
+        if req.root_span is not None:
+            req.root_span.add_event("scheduler.evict", slot=slot,
+                                    reason=reason)
         _M_ACTIVE.set(self.active_count)
         if not req.is_finished():
             self._finish(req, reason, now)

@@ -41,6 +41,7 @@ import numpy as np
 from .. import observability as _obs
 from .engine import Engine
 from .request import GenerationConfig, Request
+from .watchdog import Watchdog
 
 __all__ = ["BackpressureError", "DrainingError", "EngineWorker",
            "ServingServer", "serve"]
@@ -97,6 +98,7 @@ class EngineWorker:
         self._idle_wait = float(idle_wait)
         # recent Request objects, newest last (introspection + tests)
         self.requests: deque[Request] = deque(maxlen=512)
+        self._stall_until = 0.0     # inject_stall test hook
         self._thread = threading.Thread(
             target=self._loop, name="engine-worker", daemon=True)
 
@@ -119,10 +121,26 @@ class EngineWorker:
             with self._wake:
                 if self._stop:
                     return
+                now = time.monotonic()
+                if now < self._stall_until:
+                    # inject_stall in effect: hold the loop without
+                    # stepping — active slots persist while progress
+                    # freezes, which is exactly the watchdog's trigger
+                    self._wake.wait(min(self._stall_until - now, 0.05))
+                    continue
                 if not self.engine.scheduler.has_work():
                     self._wake.wait(self._idle_wait)
                     continue
                 self.engine.step()
+
+    def inject_stall(self, seconds: float):
+        """TEST HOOK: wedge the decode loop for ``seconds`` — the worker
+        thread keeps running but stops calling ``engine.step()``, so an
+        in-flight request sits in its slot making zero progress (the
+        condition the serving watchdog exists to catch)."""
+        with self._wake:
+            self._stall_until = time.monotonic() + float(seconds)
+            self._wake.notify_all()
 
     # ------------------------------------------------------------ intake
     @property
@@ -130,11 +148,15 @@ class EngineWorker:
         return self.engine.scheduler.draining
 
     def submit(self, prompt, gen: GenerationConfig | None = None, *,
-               timeout_s: float | None = None, on_token=None) -> Request:
+               timeout_s: float | None = None, on_token=None,
+               trace=None) -> Request:
         """Thread-safe admission with backpressure: raises
         :class:`DrainingError` / :class:`BackpressureError` instead of
         queueing unboundedly; ``timeout_s`` becomes an absolute engine
-        deadline (the existing cancel machinery enforces it)."""
+        deadline (the existing cancel machinery enforces it).  ``trace``
+        (a tracing.SpanContext) parents the engine-side request spans —
+        the handler passes its ``server.request`` span context so the
+        trace survives the hop onto the engine thread."""
         with self._wake:
             if self.engine.scheduler.draining:
                 raise DrainingError(
@@ -145,7 +167,7 @@ class EngineWorker:
             deadline = (None if timeout_s is None
                         else self.engine._clock() + float(timeout_s))
             req = self.engine.submit(prompt, gen, deadline=deadline,
-                                     on_token=on_token)
+                                     on_token=on_token, trace=trace)
             self.requests.append(req)
             self._wake.notify_all()
         return req
@@ -286,11 +308,17 @@ class ServingServer(ThreadingHTTPServer):
     def __init__(self, worker: EngineWorker, host: str = "127.0.0.1",
                  port: int = 0, *, retry_after_s: float = 1.0,
                  hard_timeout_s: float = 600.0,
-                 model_name: str = "paddle-tpu"):
+                 model_name: str = "paddle-tpu",
+                 watchdog_s: float | None = None):
         self.worker = worker
         self.retry_after_s = float(retry_after_s)
         self.hard_timeout_s = float(hard_timeout_s)
         self.model_name = model_name
+        if watchdog_s is None:
+            from ..flags import FLAGS
+            watchdog_s = float(
+                FLAGS.get("FLAGS_serving_watchdog_seconds") or 0.0)
+        self.watchdog = Watchdog(worker.engine, watchdog_s)
         self._latency = _http_latency_hist()
         self._serve_thread: threading.Thread | None = None
         super().__init__((host, port), _Handler)
@@ -301,6 +329,7 @@ class ServingServer(ThreadingHTTPServer):
 
     def start(self) -> "ServingServer":
         self.worker.start()
+        self.watchdog.start()       # no-op when watchdog_s <= 0
         self._serve_thread = threading.Thread(
             target=self.serve_forever, name=f"http:{self.address}",
             daemon=True)
@@ -309,6 +338,7 @@ class ServingServer(ThreadingHTTPServer):
 
     def stop(self, *, drain_timeout: float | None = None):
         """Graceful shutdown: drain in-flight work, then close."""
+        self.watchdog.stop()
         self.worker.drain(timeout=drain_timeout)
         self.shutdown()
         if self._serve_thread is not None:
@@ -365,6 +395,7 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/healthz":
             st = self.worker_stats()
             st["status"] = "draining" if st["draining"] else "ok"
+            st["watchdog"] = self.server.watchdog.state()
             self._json(200, st, "/healthz")
         elif self.path == "/metrics":
             text = _obs.default_registry().to_prometheus().encode()
@@ -378,6 +409,18 @@ class _Handler(BaseHTTPRequestHandler):
             except (BrokenPipeError, ConnectionResetError):
                 pass
             _M_HTTP_REQS.labels("/metrics", "200").inc()
+        elif self.path == "/debug/flight":
+            fr = _obs.flight_recorder()
+            self._json(200, {"capacity": fr.capacity,
+                             "events": fr.snapshot(),
+                             "watchdog": self.server.watchdog.state()},
+                       "/debug/flight")
+        elif self.path == "/debug/trace":
+            # curl -s :port/debug/trace > t.json  ->  chrome://tracing
+            self._json(200, {"traceEvents":
+                             (_obs.tracer().chrome_events()
+                              + _obs.chrome_counter_events())},
+                       "/debug/trace")
         else:
             self._error(404, f"no route {self.path}", self.path)
 
@@ -402,37 +445,56 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ------------------------------------------------------- completions
     def _completions(self):
+        # join the caller's distributed trace (W3C traceparent) — or
+        # start a fresh one when the request arrived untraced
+        parent = _obs.parse_traceparent(self.headers.get("traceparent"))
+        span = _obs.tracer().start_span(
+            "server.request", parent=parent,
+            attributes={"route": "/v1/completions",
+                        "model": self.server.model_name,
+                        "remote": parent is not None})
+        with span:
+            self._completions_traced(span)
+
+    def _completions_traced(self, span):
         route = "/v1/completions"
         t0 = time.monotonic()
         try:
             body = self._read_body()
         except (ValueError, json.JSONDecodeError):
             _M_HTTP_REJECT.labels("invalid").inc()
+            span.set_attribute("status", 400)
             return self._error(400, "invalid JSON body", route)
         try:
             prompt, gen, stream, timeout_s = _parse_completion(body)
         except (ValueError, TypeError) as e:
             _M_HTTP_REJECT.labels("invalid").inc()
+            span.set_attribute("status", 400)
             return self._error(400, str(e), route)
+        span.set_attribute("stream", stream)
 
         toks: queue.Queue = queue.Queue()
         try:
             req = self.server.worker.submit(
-                prompt, gen, timeout_s=timeout_s,
+                prompt, gen, timeout_s=timeout_s, trace=span.context,
                 on_token=lambda r, t: toks.put(int(t)))
         except DrainingError as e:
             _M_HTTP_REJECT.labels("draining").inc()
+            span.set_attribute("status", 503)
             return self._error(
                 503, str(e), route, etype="overloaded_error",
                 headers=[("Retry-After", f"{self.server.retry_after_s:g}")])
         except BackpressureError as e:
             _M_HTTP_REJECT.labels("backpressure").inc()
+            span.set_attribute("status", 429)
             return self._error(
                 429, str(e), route, etype="overloaded_error",
                 headers=[("Retry-After", f"{self.server.retry_after_s:g}")])
         except (ValueError, TypeError) as e:   # engine-side validation
             _M_HTTP_REJECT.labels("invalid").inc()
+            span.set_attribute("status", 400)
             return self._error(400, str(e), route)
+        span.set_attribute("req", req.id)
 
         hard_deadline = t0 + (timeout_s or self.server.hard_timeout_s) \
             + 5.0
@@ -442,6 +504,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._stream(req, toks, route, hard_deadline)
             else:
                 self._blocking(req, toks, route, hard_deadline)
+            if req.finish_reason is not None:
+                span.set_attribute("finish_reason", req.finish_reason)
         finally:
             _M_HTTP_INFLIGHT.dec()
             self.server._latency.observe(time.monotonic() - t0)
@@ -490,21 +554,26 @@ class _Handler(BaseHTTPRequestHandler):
         _M_HTTP_REQS.labels(route, "200").inc()
         self.close_connection = True
         name = self.server.model_name
-        try:
-            while True:
-                tok = self._wait_token(req, toks, hard_deadline)
-                if tok is None:
-                    break
-                self._send_event(_chunk_json(name, req, tok, False))
-            self._send_event(_chunk_json(name, req, None, True))
-            self.wfile.write(b"data: [DONE]\n\n")
-            self.wfile.flush()
-        except (BrokenPipeError, ConnectionResetError,
-                ConnectionAbortedError):
-            # client went away mid-stream: cancel so the engine frees
-            # the slot/pages at the next iteration boundary
-            req.cancel()
-            _M_HTTP_CANCELS.inc()
+        sent = 0
+        with _obs.tracer().start_span("server.stream") as ss:
+            try:
+                while True:
+                    tok = self._wait_token(req, toks, hard_deadline)
+                    if tok is None:
+                        break
+                    self._send_event(_chunk_json(name, req, tok, False))
+                    sent += 1
+                self._send_event(_chunk_json(name, req, None, True))
+                self.wfile.write(b"data: [DONE]\n\n")
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError,
+                    ConnectionAbortedError):
+                # client went away mid-stream: cancel so the engine
+                # frees the slot/pages at the next iteration boundary
+                req.cancel()
+                ss.set_attribute("cancelled", True)
+                _M_HTTP_CANCELS.inc()
+            ss.set_attribute("tokens", sent)
 
     def _send_event(self, obj: dict):
         self.wfile.write(b"data: " + json.dumps(obj).encode() + b"\n\n")
@@ -515,7 +584,8 @@ class _Handler(BaseHTTPRequestHandler):
 def serve(model=None, *, engine: Engine | None = None,
           host: str = "127.0.0.1", port: int = 0, max_queue: int = 64,
           retry_after_s: float = 1.0, model_name: str = "paddle-tpu",
-          start: bool = True, **engine_kw) -> ServingServer:
+          watchdog_s: float | None = None, start: bool = True,
+          **engine_kw) -> ServingServer:
     """One-call server bring-up::
 
         server = serve(model, port=8000, max_slots=8,
@@ -525,19 +595,27 @@ def serve(model=None, *, engine: Engine | None = None,
     Pass either a model (``engine_kw`` forwards to
     :func:`~paddle_tpu.serving.create_engine`) or a prebuilt
     ``engine=``.  With ``start=False`` the caller wires signals and
-    starts the server itself.
+    starts the server itself.  ``watchdog_s`` arms the decode-loop
+    watchdog (default: ``FLAGS_serving_watchdog_seconds``; 0 off), and
+    when the ``FLAGS_serving_slo_*`` targets are set the engine gets an
+    :class:`~paddle_tpu.serving.slo.SLOTracker` automatically.
     """
     if engine is None:
         if model is None:
             raise ValueError("pass a model or engine=")
         from .engine import create_engine
+        if "slo" not in engine_kw:
+            from .slo import SLOConfig, SLOTracker
+            slo_cfg = SLOConfig.from_flags()
+            if slo_cfg.enabled:
+                engine_kw["slo"] = SLOTracker(slo_cfg)
         engine = create_engine(model, **engine_kw)
     elif engine_kw:
         raise ValueError(f"engine= given; unexpected {sorted(engine_kw)}")
     worker = EngineWorker(engine, max_queue=max_queue)
     server = ServingServer(worker, host, port,
                            retry_after_s=retry_after_s,
-                           model_name=model_name)
+                           model_name=model_name, watchdog_s=watchdog_s)
     if start:
         server.start()
     return server

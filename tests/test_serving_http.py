@@ -582,21 +582,28 @@ class TestServeBenchHTTP:
         assert res["prefix_hit_rate"] > 0.0
 
     @pytest.mark.slow
-    def test_http_bench_cli(self):
+    def test_http_bench_cli(self, tmp_path):
+        import json
         import os
         import subprocess
         import sys
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        trace_path = tmp_path / "bench_trace.json"
         out = subprocess.run(
             [sys.executable, os.path.join(repo, "tools", "serve_bench.py"),
              "--http", "--replicas", "2", "--requests", "6",
              "--shared-prefix-len", "32", "--page-size", "16",
              "--prompt-len", "4", "8", "--new-tokens", "2", "4",
              "--max-slots", "2", "--layers", "1", "--hidden", "32",
-             "--vocab", "64", "--max-model-len", "64"],
+             "--vocab", "64", "--max-model-len", "64",
+             "--trace", str(trace_path)],
             capture_output=True, text=True, timeout=600,
             env={**os.environ, "JAX_PLATFORMS": "cpu"})
         assert out.returncode == 0, out.stderr
         assert "serve_bench --http: 6 requests over 2 replica(s)" \
             in out.stdout
         assert "throughput" in out.stdout
+        assert "chrome trace" in out.stdout
+        doc = json.loads(trace_path.read_text())
+        names = {e.get("name") for e in doc["traceEvents"]}
+        assert {"server.request", "request", "engine.prefill"} <= names

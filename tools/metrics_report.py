@@ -4,10 +4,15 @@
 Usage:
     python tools/metrics_report.py <dump-dir | metrics.json> [--prom]
 
-Reads metrics.json (+ retraces.json when present) from the dump
-directory FLAGS_metrics_dir pointed at, and renders counters, gauges,
-histograms, and the retrace log as aligned tables.  --prom cats the
-raw Prometheus text instead (what a scraper would see).
+Reads metrics.json (+ retraces.json / trace.json / flight.json when
+present) from the dump directory FLAGS_metrics_dir pointed at, and
+renders counters, gauges, histograms, SLO verdicts, finish reasons,
+the span-trace summary, and the retrace log as aligned tables.  --prom
+cats the raw Prometheus text instead (what a scraper would see).
+
+Every section is optional: a dump produced by an older build (no SLO
+counters, no trace.json) renders the sections it has and silently
+skips the rest — this tool must never crash on a missing key.
 
 Works standalone — no paddle_tpu / jax import, so it can run against a
 dump copied off a training host.
@@ -20,25 +25,32 @@ import os
 import sys
 
 
+def _read_json(path):
+    """Side-file loader: missing or corrupt files (older dumps, partial
+    writes) degrade to None instead of killing the report."""
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
 def _load(path):
-    if os.path.isdir(path):
-        json_path = os.path.join(path, "metrics.json")
-        retr_path = os.path.join(path, "retraces.json")
-        prom_path = os.path.join(path, "metrics.prom")
-    else:
-        json_path = path
-        retr_path = os.path.join(os.path.dirname(path), "retraces.json")
-        prom_path = os.path.join(os.path.dirname(path), "metrics.prom")
+    dir_ = path if os.path.isdir(path) else os.path.dirname(path)
+    json_path = (os.path.join(path, "metrics.json")
+                 if os.path.isdir(path) else path)
+    prom_path = os.path.join(dir_, "metrics.prom")
     if not os.path.exists(json_path):
         sys.exit(f"metrics_report: no metrics.json at {json_path!r} "
                  f"(set FLAGS_metrics_dir and rerun, or pass the dump dir)")
     with open(json_path) as f:
         metrics = json.load(f)
-    retraces = None
-    if os.path.exists(retr_path):
-        with open(retr_path) as f:
-            retraces = json.load(f)
-    return metrics, retraces, prom_path
+    retraces = _read_json(os.path.join(dir_, "retraces.json"))
+    trace = _read_json(os.path.join(dir_, "trace.json"))
+    flight = _read_json(os.path.join(dir_, "flight.json"))
+    return metrics, retraces, trace, flight, prom_path
 
 
 def _fmt_value(v):
@@ -244,7 +256,95 @@ def _http_section(metrics):
     return "\n".join(lines)
 
 
-def report(metrics, retraces):
+def _slo_section(metrics):
+    """SLO verdicts (serving_slo_requests_total / serving_slo_burn_rate)
+    + finish reasons (serving_finish_total) + watchdog stalls."""
+    names = ("serving_slo_requests_total", "serving_slo_burn_rate",
+             "serving_finish_total", "serving_watchdog_stalls_total")
+    if not any(n in metrics for n in names):
+        return None
+    lines = ["SLO / request outcomes"]
+    slo = metrics.get("serving_slo_requests_total")
+    if slo:
+        per_dim: dict = {}
+        for s in slo.get("series", []):
+            lbl = s.get("labels", {})
+            dim = lbl.get("dimension", "?")
+            good, bad = per_dim.setdefault(dim, [0, 0])
+            if lbl.get("result") == "good":
+                good += s.get("value", 0)
+            else:
+                bad += s.get("value", 0)
+            per_dim[dim] = [good, bad]
+        burn = {}
+        for s in (metrics.get("serving_slo_burn_rate") or {}).get(
+                "series", []):
+            burn[s.get("labels", {}).get("dimension", "?")] = \
+                s.get("value", 0.0)
+        for dim in sorted(per_dim):
+            good, bad = per_dim[dim]
+            total = good + bad
+            if not total:
+                continue
+            line = (f"  {dim:<5} {_fmt_value(good)}/{_fmt_value(total)} "
+                    f"good ({100.0 * good / total:.1f}%)")
+            if dim in burn:
+                line += f"  burn-rate {burn[dim]:.3g}"
+            lines.append(line + ("  << violating" if bad else ""))
+    finish = metrics.get("serving_finish_total")
+    if finish:
+        by_reason = {s.get("labels", {}).get("reason", "?"):
+                     s.get("value", 0)
+                     for s in finish.get("series", [])}
+        if by_reason:
+            lines.append("  finish reasons: " + ", ".join(
+                f"{k}={_fmt_value(v)}" for k, v in sorted(
+                    by_reason.items())))
+    stalls = metrics.get("serving_watchdog_stalls_total")
+    if stalls:
+        n = sum(s.get("value", 0) for s in stalls.get("series", []))
+        if n:
+            lines.append(f"  watchdog stalls: {_fmt_value(n)} "
+                         f"(see watchdog_*.json hang dumps)")
+    return "\n".join(lines) if len(lines) > 1 else None
+
+
+def _tracing_section(trace, flight):
+    """Span-ring + flight-recorder summary from trace.json /
+    flight.json — absent files (older dumps) produce no section."""
+    lines = []
+    if isinstance(trace, dict) and trace.get("spans"):
+        spans = [s for s in trace["spans"] if isinstance(s, dict)]
+        by_name: dict = {}
+        traces = set()
+        for s in spans:
+            n, d = by_name.setdefault(s.get("name", "?"), [0, 0.0])
+            by_name[s.get("name", "?")] = [n + 1,
+                                           d + (s.get("duration_s") or 0.0)]
+            if s.get("trace_id"):
+                traces.add(s["trace_id"])
+        lines.append(f"  {len(spans)} spans across {len(traces)} traces "
+                     f"(recorded={trace.get('recorded', len(spans))} "
+                     f"dropped={trace.get('dropped', 0)})")
+        rows = [(name, n, f"{1e3 * d / n:.3g}ms")
+                for name, (n, d) in sorted(by_name.items())]
+        lines.append(_table(rows, ("span", "count", "avg")))
+    if isinstance(flight, dict) and flight.get("events"):
+        evs = [e for e in flight["events"] if isinstance(e, dict)]
+        by_cat: dict = {}
+        for e in evs:
+            key = f"{e.get('category', '?')}.{e.get('event', '?')}"
+            by_cat[key] = by_cat.get(key, 0) + 1
+        lines.append(f"  flight ring: {len(evs)} events "
+                     f"(capacity {flight.get('capacity', '?')}): " +
+                     ", ".join(f"{k}={v}"
+                               for k, v in sorted(by_cat.items())))
+    if not lines:
+        return None
+    return "\n".join(["Tracing"] + lines)
+
+
+def report(metrics, retraces, trace=None, flight=None):
     simple_rows = {"counter": [], "gauge": []}
     hist_blocks = []
     for name, entry in sorted(metrics.items()):
@@ -269,6 +369,12 @@ def report(metrics, retraces):
     http = _http_section(metrics)
     if http:
         out += [http, ""]
+    slo = _slo_section(metrics)
+    if slo:
+        out += [slo, ""]
+    tracing = _tracing_section(trace, flight)
+    if tracing:
+        out += [tracing, ""]
     if retraces and retraces.get("entries"):
         entries = sorted(retraces["entries"],
                          key=lambda e: (-e["count"], e["op"]))
@@ -291,14 +397,14 @@ def main(argv=None):
     ap.add_argument("--prom", action="store_true",
                     help="print the raw Prometheus text export")
     args = ap.parse_args(argv)
-    metrics, retraces, prom_path = _load(args.path)
+    metrics, retraces, trace, flight, prom_path = _load(args.path)
     if args.prom:
         if not os.path.exists(prom_path):
             sys.exit(f"metrics_report: no metrics.prom at {prom_path!r}")
         with open(prom_path) as f:
             print(f.read(), end="")
         return 0
-    print(report(metrics, retraces))
+    print(report(metrics, retraces, trace, flight))
     return 0
 
 

@@ -24,7 +24,12 @@ page hit rate, pages saved, and host-sync counts next to TTFT/TPOT.
 in-process engine loop: N HTTP replicas (each its own engine + worker
 thread) behind a prefix-affinity Router, with streaming clients over
 localhost.  TTFT/TPOT then include HTTP + SSE overhead, and the report
-adds per-replica request counts and the aggregate prefix hit rate.
+adds per-replica latency percentiles (grouped by which replica served
+each stream), request counts, and the aggregate prefix hit rate.
+
+``--trace out.json`` writes a chrome://tracing-loadable timeline of the
+run: request/queue/prefill/decode spans and gauge counters, merged with
+the native host profile when one is active (profiler.export_host_trace).
 
 The model is a randomly initialized tiny llama (this benchmarks the
 ENGINE — scheduling, paging, dispatch — not the matmuls); sizes are
@@ -46,6 +51,23 @@ def _percentile(vals, q):
     vals = sorted(vals)
     idx = min(len(vals) - 1, int(round(q * (len(vals) - 1))))
     return vals[idx]
+
+
+def _per_replica_latency(results):
+    """Group --http results by the replica that served each stream:
+    ``{replica_name: (ttfts, tpots, n_requests)}``."""
+    out: dict = {}
+    for r in results:
+        if not r or r[4] is None:
+            continue
+        sent, first, last, n_toks, replica = r
+        ttfts, tpots, n = out.setdefault(replica, ([], [], 0))
+        out[replica] = (ttfts, tpots, n + 1)
+        if first is not None:
+            ttfts.append(first - sent)
+        if n_toks > 1:
+            tpots.append((last - first) / (n_toks - 1))
+    return out
 
 
 def run_bench(args):
@@ -132,6 +154,7 @@ def run_bench(args):
         out = obs.dump(args.metrics_dir)
         print(f"  metrics dump         {out} "
               f"(render: python tools/metrics_report.py {out})")
+    _export_trace(args)
     return {"requests": len(reqs), "tokens": toks, "wall_s": wall,
             "throughput": toks / wall, "ttft_s": ttfts, "tpot_s": tpots,
             "decode_traces": stats["decode_traces"],
@@ -139,6 +162,17 @@ def run_bench(args):
             "pages_saved": stats["prefix_hits"],
             "host_syncs": stats["host_syncs"],
             "logit_fetches": stats["logit_fetches"]}
+
+
+def _export_trace(args):
+    if not getattr(args, "trace", None):
+        return
+    from paddle_tpu import profiler
+    if profiler.export_host_trace(args.trace):
+        print(f"  chrome trace         {args.trace} "
+              f"(load in chrome://tracing or https://ui.perfetto.dev)")
+    else:
+        print(f"  chrome trace         FAILED to write {args.trace}")
 
 
 def _build_workload(args, rng, np):
@@ -180,13 +214,16 @@ def run_http_bench(args):
     model = LlamaForCausalLM(cfg)
     model.eval()
 
+    # each replica announces itself via the SSE "model" field, so the
+    # client side can attribute every stream to the replica that ran it
     servers = [serve(model, max_slots=args.max_slots,
                      page_size=args.page_size,
                      num_pages=args.num_pages,
                      max_model_len=args.max_model_len,
                      enable_prefix_cache=args.prefix_cache,
-                     sync_interval=args.sync_interval)
-               for _ in range(args.replicas)]
+                     sync_interval=args.sync_interval,
+                     model_name=f"replica-{i}")
+               for i in range(args.replicas)]
     router = Router([s.address for s in servers],
                     page_size=args.page_size)
     workload = _build_workload(args, rng, np)
@@ -199,15 +236,17 @@ def run_http_bench(args):
         sent = time.monotonic()
         first = last = None
         n_toks = 0
+        replica = None
         for ev in router.completion([int(t) for t in prompt],
                                     max_tokens=n_new, stream=True):
+            replica = ev.get("model", replica)
             got = ev["choices"][0]["token_ids"]
             if got:
                 n_toks += len(got)
                 last = time.monotonic()
                 if first is None:
                     first = last
-        results[i] = (sent, first, last, n_toks)
+        results[i] = (sent, first, last, n_toks, replica)
 
     threads = [threading.Thread(target=drive, args=(i, at, p, n),
                                 daemon=True)
@@ -243,6 +282,21 @@ def run_http_bench(args):
         print(f"  TPOT   mean/p50/p95  {np.mean(tpots) * 1e3:8.2f} / "
               f"{_percentile(tpots, 0.5) * 1e3:.2f} / "
               f"{_percentile(tpots, 0.95) * 1e3:.2f} ms")
+    per_replica = _per_replica_latency(results)
+    for name in sorted(per_replica):
+        r_ttft, r_tpot, n = per_replica[name]
+
+        def pcts(vals):
+            return (f"{_percentile(vals, 0.5) * 1e3:.2f}/"
+                    f"{_percentile(vals, 0.95) * 1e3:.2f}/"
+                    f"{_percentile(vals, 0.99) * 1e3:.2f}")
+
+        line = f"  {name:<12} n={n}"
+        if r_ttft:
+            line += f"  TTFT p50/p95/p99 {pcts(r_ttft)} ms"
+        if r_tpot:
+            line += f"  TPOT p50/p95/p99 {pcts(r_tpot)} ms"
+        print(line)
     for rep in rstats["replicas"]:
         print(f"  replica {rep['address']}  up={rep['up']} "
               f"fails={rep['fails']} inflight={rep['inflight']}")
@@ -257,9 +311,13 @@ def run_http_bench(args):
         out = obs.dump(args.metrics_dir)
         print(f"  metrics dump         {out} "
               f"(render: python tools/metrics_report.py {out})")
+    _export_trace(args)
     return {"requests": len(results), "tokens": toks, "wall_s": wall,
             "throughput": toks / wall, "ttft_s": ttfts, "tpot_s": tpots,
-            "prefix_hit_rate": hit_rate, "router": rstats}
+            "prefix_hit_rate": hit_rate, "router": rstats,
+            "per_replica": {k: {"ttft_s": v[0], "tpot_s": v[1],
+                                "requests": v[2]}
+                            for k, v in per_replica.items()}}
 
 
 def main(argv=None):
@@ -293,6 +351,9 @@ def main(argv=None):
     ap.add_argument("--replicas", type=int, default=1,
                     help="replica server count for --http")
     ap.add_argument("--metrics-dir", default="")
+    ap.add_argument("--trace", default="",
+                    help="write a chrome://tracing JSON of the run's "
+                         "request/prefill/decode spans to this path")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
     if args.http:
