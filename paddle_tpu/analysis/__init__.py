@@ -1,0 +1,26 @@
+"""Repo-native static analysis (pure AST, stdlib only).
+
+Three analyzer families guard the invariants PRs 1–5 made load-bearing:
+
+* :mod:`.jit_safety` — host syncs / python branches inside jitted
+  bodies, donated-buffer reuse (the no-retrace and donation invariants
+  of the serving engine);
+* :mod:`.lock_discipline` — lock-order cycles, unlocked shared writes,
+  blocking calls under a lock (the threaded serving/observability
+  stack);
+* :mod:`.flags_metrics` — FLAGS_* registration, flag help, metric
+  naming/unit-suffix conventions;
+* :mod:`.clocks` — durations/deadlines must use monotonic clocks.
+
+Entry points: ``tools/lint.py`` (CLI with committed baseline) and
+:func:`paddle_tpu.analysis.run` (library).  Analyzers never import the
+code they check.
+"""
+from .baseline import load_baseline, partition, save_baseline
+from .core import Finding, SourceFile
+from .reporters import render_json, render_text
+from .runner import ALL_RULES, iter_files, run
+
+__all__ = ["Finding", "SourceFile", "run", "iter_files", "ALL_RULES",
+           "render_text", "render_json", "load_baseline",
+           "save_baseline", "partition"]

@@ -1,0 +1,65 @@
+"""Baseline load/save/compare.
+
+The committed baseline (``tools/lint_baseline.json``) records the
+accepted pre-existing findings by *fingerprint* — a hash of
+(rule, path, message) that deliberately excludes the line number, so
+editing code above a known finding does not resurrect it.  The gate
+fails only on findings whose fingerprint count exceeds the baselined
+count: fixing one of two identical findings stays green, adding a third
+fails.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from .core import Finding
+
+__all__ = ["load_baseline", "save_baseline", "partition"]
+
+_VERSION = 1
+
+
+def load_baseline(path: str) -> dict[str, int]:
+    """fingerprint -> accepted count.  Missing file = empty baseline."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    counts: dict[str, int] = {}
+    for entry in data.get("findings", []):
+        fp = entry["fingerprint"]
+        counts[fp] = counts.get(fp, 0) + 1
+    return counts
+
+
+def save_baseline(path: str, findings: list[Finding]) -> None:
+    """Every finding, with rule id + location, human-reviewable."""
+    data = {
+        "version": _VERSION,
+        "comment": "Accepted pre-existing lint findings. Regenerate "
+                   "deliberately with `python tools/lint.py "
+                   "--update-baseline`; never hand-edit counts.",
+        "findings": [f.to_dict() for f in
+                     sorted(findings,
+                            key=lambda f: (f.path, f.line, f.rule))],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2)
+        f.write("\n")
+
+
+def partition(findings: list[Finding],
+              baseline: dict[str, int]) -> tuple[list[Finding],
+                                                 list[Finding]]:
+    """(new, baselined).  Within one fingerprint, the first N
+    occurrences (source order) are baselined, the excess is new."""
+    remaining = dict(baseline)
+    new, old = [], []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        if remaining.get(f.fingerprint, 0) > 0:
+            remaining[f.fingerprint] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old

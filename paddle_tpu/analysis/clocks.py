@@ -1,0 +1,116 @@
+"""Clock-discipline analyzer: durations must use monotonic clocks.
+
+``wall-clock-duration``
+    ``time.time()`` jumps under NTP slew and manual clock changes, so
+    any *difference* or *deadline comparison* built from it is wrong by
+    construction: spans shrink or go negative, timeouts fire early or
+    never.  The repo measures durations with ``time.perf_counter()``
+    (host spans, metrics) or ``time.monotonic()`` (deadlines); wall
+    time is reserved for absolute "created at" stamps
+    (``int(time.time())`` in API payloads) and cross-process
+    timestamps, which this rule does not flag.
+
+Flagged shapes, per function scope:
+
+* ``time.time() - t0`` / ``time.time() + 60`` — arithmetic directly on
+  a wall-clock sample;
+* ``while time.time() < deadline`` — comparison on a sample;
+* ``now = time.time(); ... now - ts`` — arithmetic/comparison on a
+  local name bound to a wall-clock sample in the same scope.
+
+Legitimate cross-process wall-clock comparisons (e.g. TTL checks on
+heartbeats written by another host) carry an explicit
+``# tpu-lint: disable=wall-clock-duration`` suppression.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, SourceFile, call_name, expr_text
+
+__all__ = ["analyze"]
+
+RULES = {
+    "wall-clock-duration": "duration/deadline computed from time.time() "
+                           "instead of a monotonic clock",
+}
+
+_WALL_CALLS = ("time.time", "_time.time")
+
+
+def analyze(src: SourceFile) -> list[Finding]:
+    if ".time()" not in src.text:   # cheap pre-gate: no wall samples
+        return []
+    findings: list[Finding] = []
+    seen_lines: set[int] = set()
+    for scope in _scopes(src.tree):
+        wall_names = _wall_assigned_names(scope)
+        for node in _scoped_nodes(scope):
+            expr = None
+            if isinstance(node, ast.BinOp) and \
+                    isinstance(node.op, (ast.Add, ast.Sub)):
+                if _is_wall(node.left, wall_names) or \
+                        _is_wall(node.right, wall_names):
+                    expr = node
+            elif isinstance(node, ast.Compare):
+                operands = [node.left] + list(node.comparators)
+                if any(_is_wall(o, wall_names) for o in operands):
+                    expr = node
+            if expr is not None and expr.lineno not in seen_lines:
+                seen_lines.add(expr.lineno)
+                findings.append(Finding(
+                    "wall-clock-duration", src.path, expr.lineno,
+                    f"`{expr_text(expr)}` computes a duration/deadline "
+                    "from time.time(), which jumps under NTP slew",
+                    hint="use time.monotonic() for deadlines or "
+                         "time.perf_counter() for measured spans; keep "
+                         "time.time() only for absolute 'created' "
+                         "stamps"))
+    return src.filter(findings)
+
+
+def _scopes(tree):
+    """Module plus every function, each yielded once as a scope root."""
+    yield tree
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _scoped_nodes(scope):
+    """Descendants of a scope, pruning nested function bodies — they
+    are their own scope (yielded separately by :func:`_scopes`)."""
+    for child in ast.iter_child_nodes(scope):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            continue
+        yield child
+        yield from _scoped_nodes(child)
+
+
+def _wall_assigned_names(scope) -> set:
+    """Local names bound directly to a ``time.time()`` sample."""
+    names = set()
+    for node in _scoped_nodes(scope):
+        value = None
+        targets = []
+        if isinstance(node, ast.Assign):
+            value, targets = node.value, node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            value, targets = node.value, [node.target]
+        if value is None:
+            continue
+        if isinstance(value, ast.Call) and \
+                call_name(value) in _WALL_CALLS:
+            for tgt in targets:
+                names.add(expr_text(tgt))
+    return names
+
+
+def _is_wall(node, wall_names) -> bool:
+    if isinstance(node, ast.Call) and call_name(node) in _WALL_CALLS:
+        return True
+    if isinstance(node, (ast.Name, ast.Attribute)) and \
+            expr_text(node) in wall_names:
+        return True
+    return False

@@ -1,0 +1,127 @@
+"""Shared infrastructure for the repo-native static analyzers.
+
+Everything here is stdlib-only and pure-AST: a :class:`Finding` record
+(rule id, severity, location, message, fix hint), per-file source
+loading with inline ``# tpu-lint: disable=RULE`` suppressions, and the
+small AST helpers (dotted-name resolution, expression rendering) every
+analyzer shares.  Analyzers never import the code they check — a file
+that would crash on import (missing accelerator, heavy deps) still
+lints fine.
+"""
+from __future__ import annotations
+
+import ast
+import hashlib
+import re
+from dataclasses import dataclass, field
+
+__all__ = ["Finding", "SourceFile", "dotted_name", "expr_text",
+           "call_name", "SEVERITIES"]
+
+SEVERITIES = ("error", "warning")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpu-lint:\s*disable=([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)")
+
+
+@dataclass
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str
+    path: str               # repo-relative, posix separators
+    line: int
+    message: str
+    severity: str = "error"
+    hint: str = ""
+    col: int = 0
+
+    @property
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    @property
+    def fingerprint(self) -> str:
+        """Stable identity for baseline matching.  Deliberately excludes
+        the line number — adding code above a known finding must not
+        turn it into a "new" one."""
+        raw = f"{self.rule}|{self.path}|{self.message}"
+        return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "severity": self.severity, "message": self.message,
+                "hint": self.hint, "fingerprint": self.fingerprint}
+
+    def render(self) -> str:
+        text = f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+        if self.hint:
+            text += f"  (fix: {self.hint})"
+        return text
+
+
+@dataclass
+class SourceFile:
+    """A parsed source file plus its suppression map."""
+
+    path: str               # repo-relative display path
+    text: str
+    tree: ast.Module
+    # line -> set of rule ids suppressed on that line ("all" wildcard)
+    suppressions: dict[int, set] = field(default_factory=dict)
+
+    @classmethod
+    def load(cls, abspath: str, relpath: str) -> "SourceFile":
+        with open(abspath, encoding="utf-8") as f:
+            text = f.read()
+        tree = ast.parse(text, filename=relpath)
+        return cls(relpath, text, tree, _suppression_map(text))
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        rules = self.suppressions.get(line)
+        return bool(rules) and ("all" in rules or rule in rules)
+
+    def filter(self, findings: list[Finding]) -> list[Finding]:
+        return [f for f in findings
+                if not self.suppressed(f.rule, f.line)]
+
+
+def _suppression_map(text: str) -> dict[int, set]:
+    """``# tpu-lint: disable=rule-a,rule-b`` suppresses its own line;
+    on a standalone comment line it suppresses the next line instead
+    (so a suppression can sit above a long statement)."""
+    out: dict[int, set] = {}
+    for i, raw in enumerate(text.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(raw)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        target = i + 1 if raw.lstrip().startswith("#") else i
+        out.setdefault(target, set()).update(rules)
+    return out
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> str | None:
+    """Dotted name of a call's callee (``jax.jit``, ``self.fn``...)."""
+    return dotted_name(call.func)
+
+
+def expr_text(node: ast.AST) -> str:
+    """Canonical text of an expression — used to compare 'the same
+    buffer' across statements (``self.kpool`` == ``self.kpool``)."""
+    try:
+        return ast.unparse(node)
+    except Exception:               # pragma: no cover - defensive
+        return ast.dump(node)

@@ -1,0 +1,251 @@
+"""Flags / metrics conformance analyzer.
+
+Flags (the ``paddle_tpu.flags`` registry is the single source of truth;
+definitions are parsed from ``flags.py`` itself, never imported):
+
+``flag-undefined``
+    A ``"FLAGS_*"`` string constant used anywhere in code — a
+    ``FLAGS[...]`` / ``FLAGS.get(...)`` read, a ``set_flags`` key, or
+    an env-dict export like ``{"FLAGS_selected_devices": ...}`` — that
+    no ``define_flag`` call registers.  A typo'd flag name otherwise
+    reads as permanently-default and fails silently.
+
+``flag-missing-help``
+    ``define_flag`` without non-empty help text.  ~243 flags in the
+    reference all carry help; ours do too.
+
+``flag-duplicate``
+    The same flag name registered by two ``define_flag`` calls.
+
+Metrics (names are a public scrape interface; Prometheus conventions):
+
+``metric-name``
+    Registration with a literal name that is not ``[a-z][a-z0-9_]*`` or
+    does not start with one of the repo's subsystem prefixes
+    (``serving_``, ``router_``, ``eager_``, ``hapi_``, ``device_``,
+    ``host_``, ``comm_``, ``collective_``, ``obs_``).
+
+``metric-suffix``
+    Unit-suffix conventions: counters end ``_total``; histograms end
+    ``_seconds`` or ``_bytes``; gauges must NOT end ``_total`` (that
+    suffix promises monotonicity to every PromQL ``rate()`` user).
+
+``metric-duplicate``
+    The same metric name registered with two different kinds — the
+    registry raises at runtime; this catches it before any process
+    does.
+
+Metric rules only apply outside ``tests/`` (tests register throwaway
+names on private registries deliberately); flag rules apply everywhere.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding, SourceFile, call_name
+
+__all__ = ["FlagsMetricsAnalyzer", "collect_flag_defs"]
+
+RULES = {
+    "flag-undefined": "FLAGS_* name used but never define_flag-registered",
+    "flag-missing-help": "define_flag without help text",
+    "flag-duplicate": "flag registered twice",
+    "metric-name": "metric name violates naming conventions",
+    "metric-suffix": "metric name violates unit-suffix conventions "
+                     "(_total/_seconds/_bytes)",
+    "metric-duplicate": "metric name registered with two different kinds",
+}
+
+_FLAG_RE = re.compile(r"^FLAGS_[A-Za-z0-9_]+$")
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+METRIC_PREFIXES = ("serving_", "router_", "eager_", "hapi_", "device_",
+                   "host_", "comm_", "collective_", "obs_")
+
+_HISTO_SUFFIXES = ("_seconds", "_bytes")
+
+
+def collect_flag_defs(src: SourceFile):
+    """(name, has_help, lineno) for every ``define_flag`` call."""
+    out = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node) or ""
+        if name.rsplit(".", 1)[-1] != "define_flag":
+            continue
+        if not node.args or not isinstance(node.args[0], ast.Constant) \
+                or not isinstance(node.args[0].value, str):
+            continue
+        flag = node.args[0].value
+        help_arg = node.args[2] if len(node.args) > 2 else None
+        for kw in node.keywords:
+            if kw.arg == "help_":
+                help_arg = kw.value
+        has_help = not (help_arg is None or
+                        (isinstance(help_arg, ast.Constant) and
+                         not str(help_arg.value).strip()))
+        out.append((flag, has_help, node.lineno))
+    return out
+
+
+class FlagsMetricsAnalyzer:
+    """Stateful across files: flag registry + seen metric kinds."""
+
+    def __init__(self, flag_defs=None):
+        # flag name -> (has_help, "path:line")
+        self.flags: dict[str, tuple] = dict(flag_defs or {})
+        # metric name -> (kind, "path:line")
+        self.metrics: dict[str, tuple] = {}
+
+    def check(self, src: SourceFile) -> list[Finding]:
+        findings: list[Finding] = []
+        if "FLAGS_" in src.text:        # cheap pre-gates
+            def_lines = self._check_definitions(src, findings)
+            self._check_flag_reads(src, findings, def_lines)
+        if not _is_test_path(src.path) and any(
+                k + "(" in src.text
+                for k in ("counter", "gauge", "histogram")):
+            self._check_metrics(src, findings)
+        return src.filter(findings)
+
+    # ------------------------------------------------------------- flags
+    def _check_definitions(self, src, findings) -> set:
+        """Validate define_flag sites; returns the AST positions of the
+        name constants so the read scan skips them."""
+        def_positions = set()
+        help_by_name = {f: h for f, h, _ln in collect_flag_defs(src)}
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = (call_name(node) or "").rsplit(".", 1)[-1]
+            if cname != "define_flag" or not node.args:
+                continue
+            arg0 = node.args[0]
+            if not (isinstance(arg0, ast.Constant) and
+                    isinstance(arg0.value, str)):
+                continue
+            def_positions.add((arg0.lineno, arg0.col_offset))
+            flag = arg0.value
+            loc = f"{src.path}:{node.lineno}"
+            if flag in self.flags:
+                findings.append(Finding(
+                    "flag-duplicate", src.path, node.lineno,
+                    f"flag {flag!r} already registered at "
+                    f"{self.flags[flag][1]}",
+                    hint="drop one of the registrations"))
+                continue
+            has_help = help_by_name.get(flag, False)
+            self.flags[flag] = (has_help, loc)
+            if not has_help:
+                findings.append(Finding(
+                    "flag-missing-help", src.path, node.lineno,
+                    f"flag {flag!r} registered without help text",
+                    hint="every flag carries help; it is the only "
+                         "documentation set_flags users see"))
+        return def_positions
+
+    def _check_flag_reads(self, src, findings, def_positions):
+        doc_positions = _docstring_positions(src.tree)
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Constant) and
+                    isinstance(node.value, str) and
+                    _FLAG_RE.match(node.value)):
+                continue
+            pos = (node.lineno, node.col_offset)
+            if pos in def_positions or pos in doc_positions:
+                continue
+            if node.value not in self.flags:
+                findings.append(Finding(
+                    "flag-undefined", src.path, node.lineno,
+                    f"{node.value!r} is read/exported but never "
+                    "registered with define_flag — a typo here fails "
+                    "silently as the default value",
+                    hint="register it in paddle_tpu/flags.py (or fix "
+                         "the name)"))
+
+    # ----------------------------------------------------------- metrics
+    def _check_metrics(self, src, findings):
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = (call_name(node) or "").rsplit(".", 1)[-1]
+            if cname not in ("counter", "gauge", "histogram"):
+                continue
+            if not node.args:
+                continue
+            arg0 = node.args[0]
+            if not (isinstance(arg0, ast.Constant) and
+                    isinstance(arg0.value, str)):
+                continue
+            name = arg0.value
+            kind = cname
+            loc = f"{src.path}:{node.lineno}"
+            prior = self.metrics.get(name)
+            if prior is not None and prior[0] != kind:
+                findings.append(Finding(
+                    "metric-duplicate", src.path, node.lineno,
+                    f"metric {name!r} registered as {kind} here but as "
+                    f"{prior[0]} at {prior[1]} — the registry will "
+                    "raise at runtime",
+                    hint="rename one of them"))
+            elif prior is None:
+                self.metrics[name] = (kind, loc)
+            if not _NAME_RE.match(name):
+                findings.append(Finding(
+                    "metric-name", src.path, node.lineno,
+                    f"metric name {name!r} is not snake_case "
+                    "([a-z][a-z0-9_]*)",
+                    hint="prometheus-conventional lowercase snake_case"))
+                continue
+            if not name.startswith(METRIC_PREFIXES):
+                findings.append(Finding(
+                    "metric-name", src.path, node.lineno,
+                    f"metric {name!r} lacks a subsystem prefix "
+                    f"(one of {', '.join(METRIC_PREFIXES)})",
+                    hint="prefix it with its owning subsystem"))
+            self._check_suffix(src, findings, node, name, kind)
+
+    def _check_suffix(self, src, findings, node, name, kind):
+        if kind == "counter" and not name.endswith("_total"):
+            findings.append(Finding(
+                "metric-suffix", src.path, node.lineno,
+                f"counter {name!r} must end in `_total`",
+                hint="prometheus counters carry the _total suffix"))
+        elif kind == "histogram" and \
+                not name.endswith(_HISTO_SUFFIXES):
+            findings.append(Finding(
+                "metric-suffix", src.path, node.lineno,
+                f"histogram {name!r} must end in a unit suffix "
+                "(`_seconds` or `_bytes`)",
+                hint="name the unit; dashboards and recording rules "
+                     "key off it"))
+        elif kind == "gauge" and name.endswith("_total"):
+            findings.append(Finding(
+                "metric-suffix", src.path, node.lineno,
+                f"gauge {name!r} must not end in `_total` — that "
+                "suffix promises a monotonic counter to rate()/"
+                "increase() users",
+                hint="drop the suffix or use `_count`/a capacity name"))
+
+
+def _is_test_path(path: str) -> bool:
+    parts = path.replace("\\", "/").split("/")
+    if "lint_fixtures" in parts:    # linter's own fixtures: full checks
+        return False
+    return "tests" in parts or parts[-1].startswith("test_")
+
+
+def _docstring_positions(tree) -> set:
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            body = getattr(node, "body", [])
+            if body and isinstance(body[0], ast.Expr) and \
+                    isinstance(body[0].value, ast.Constant) and \
+                    isinstance(body[0].value.value, str):
+                c = body[0].value
+                out.add((c.lineno, c.col_offset))
+    return out

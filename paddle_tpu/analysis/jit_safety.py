@@ -1,0 +1,655 @@
+"""Jit-safety analyzer: host syncs, traced branches, donated reuse.
+
+Resolves every function handed to ``jax.jit`` in a module — named
+functions, lambdas, decorated defs, and factory patterns like
+``jax.jit(self._build_step())`` where a method returns a closure — and
+checks the *traced body* for the failure modes that only surface at
+runtime as a hang or a silent retrace:
+
+``jit-host-sync``
+    Calls that force a device->host transfer or only run at trace time:
+    ``np.asarray``/``np.array`` on traced values, ``.item()`` /
+    ``.block_until_ready()`` / ``jax.device_get`` on traced values,
+    ``print``, and ``time.*`` (a ``time.time()`` inside a jitted body
+    samples the clock ONCE at trace time — it measures nothing), plus
+    ``float()/int()/bool()`` casts of traced values (each is a
+    blocking concretization).
+
+``jit-traced-branch``
+    Python ``if`` / ``while`` / ternary on a traced value — a
+    ``TracerBoolConversionError`` at best, a silent per-value retrace
+    via static_argnums at worst.  Branching on shapes/dtypes/ndim is
+    static and allowed.
+
+``jit-donated-reuse``
+    A buffer passed at a ``donate_argnums`` position is dead after the
+    call; reading it again aliases freed device memory.  The check
+    flags call sites where a donated argument is used later in the
+    function without first being rebound (typically from the call's own
+    results).
+
+Tracedness is a per-function taint: parameters (minus static_argnums)
+and anything derived from them or from ``jnp.*`` results.  Shape/dtype
+attribute reads (``x.shape``, ``x.ndim``, ``x.dtype``, ``len(x)``)
+launder the taint — branching on those is legitimate.  Helper functions
+called from a jitted body that are defined in the same module are
+analyzed transitively (depth-bounded).
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, SourceFile, call_name, expr_text
+
+__all__ = ["analyze"]
+
+RULES = {
+    "jit-host-sync": "host sync / trace-time-only call inside a jitted "
+                     "function",
+    "jit-traced-branch": "python control flow on a traced value inside "
+                         "a jitted function",
+    "jit-donated-reuse": "donated buffer used after the jit call "
+                         "without rebinding",
+}
+
+# calls that are wrong inside a jitted body regardless of their argument
+_ALWAYS_BAD_CALLS = {
+    "print": "runs at trace time only — use jax.debug.print",
+    "time.time": "samples the clock once at trace time",
+    "time.monotonic": "samples the clock once at trace time",
+    "time.perf_counter": "samples the clock once at trace time",
+    "time.sleep": "blocks tracing, never the compiled step",
+}
+
+# calls that are host syncs when applied to a traced value
+_TAINTED_BAD_CALLS = {
+    "np.asarray": "forces a device->host transfer mid-program",
+    "np.array": "forces a device->host transfer mid-program",
+    "numpy.asarray": "forces a device->host transfer mid-program",
+    "numpy.array": "forces a device->host transfer mid-program",
+    "jax.device_get": "forces a device->host transfer mid-program",
+}
+
+_TAINTED_BAD_METHODS = {
+    "item": "concretizes a traced value (blocking transfer)",
+    "block_until_ready": "host sync inside the traced program",
+    "tolist": "concretizes a traced value (blocking transfer)",
+}
+
+_CASTS = {"float", "int", "bool"}
+
+# attribute reads that yield static (trace-time) values: branching on
+# them is fine and must not propagate taint
+_SHAPE_ATTRS = {"shape", "ndim", "dtype", "size", "maxlen"}
+_UNTAINT_CALLS = {"len", "range", "isinstance", "getattr", "hasattr",
+                  "enumerate", "zip"}
+
+_MAX_DEPTH = 2
+
+
+def analyze(src: SourceFile) -> list[Finding]:
+    if "jit" not in src.text:       # cheap pre-gate: nothing to resolve
+        return []
+    mod = _ModuleIndex(src)
+    findings: list[Finding] = []
+    for jit in mod.jit_calls:
+        body = mod.resolve_target(jit)
+        if body is not None and id(body.node) not in mod.analyzed:
+            mod.analyzed.add(id(body.node))
+            findings.extend(_check_traced_body(src, mod, body, depth=0))
+    findings.extend(_check_donated_reuse(src, mod))
+    seen, unique = set(), []
+    for f in findings:
+        key = (f.rule, f.path, f.line, f.message)
+        if key not in seen:
+            seen.add(key)
+            unique.append(f)
+    return src.filter(unique)
+
+
+# --------------------------------------------------------------- indexing
+class _JitCall:
+    """One ``jax.jit(...)`` call site and its surroundings."""
+
+    def __init__(self, call, enclosing_func, enclosing_class):
+        self.call = call
+        self.func = enclosing_func          # FunctionDef | None
+        self.cls = enclosing_class          # ClassDef | None
+        self.donate = _donate_argnums(call)
+        self.static = _static_argnums(call)
+
+
+class _Resolved:
+    """A function body to be treated as traced."""
+
+    def __init__(self, node, params, static_idx):
+        self.node = node                    # FunctionDef | Lambda
+        self.params = params                # ordered param names
+        self.static_idx = static_idx        # set of static positions
+
+
+class _ModuleIndex:
+    """Scopes, defs, and jit bindings of one module."""
+
+    def __init__(self, src: SourceFile):
+        self.src = src
+        self.jit_calls: list[_JitCall] = []
+        self.analyzed: set[int] = set()
+        # (class name | None, func name) -> FunctionDef
+        self.defs: dict[tuple, ast.AST] = {}
+        # nested defs: id(parent FunctionDef) -> {name: FunctionDef}
+        self.nested: dict[int, dict] = {}
+        # jit bindings for the donated-reuse check
+        self.attr_donate: dict[str, tuple] = {}     # self.X = jax.jit(..)
+        self.factory_donate: dict[str, tuple] = {}  # def F(): return jit
+        self.decorated_donate: dict[str, tuple] = {}
+        self.module_donate: dict[str, tuple] = {}   # X = jax.jit(..)
+        self._walk(src.tree, None, None)
+        self._index_bindings()
+
+    def _walk(self, node, func, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                self._walk(child, None, child)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                self.defs[(cls.name if cls else None, child.name)] = child
+                if func is not None:
+                    self.nested.setdefault(id(func), {})[child.name] = \
+                        child
+                for dec in child.decorator_list:
+                    if (isinstance(dec, ast.Call)
+                            and call_name(dec) in ("jax.jit", "jit")) or \
+                            (not isinstance(dec, ast.Call)
+                             and expr_text(dec) in ("jax.jit", "jit")):
+                        call = dec if isinstance(dec, ast.Call) else None
+                        donate = _donate_argnums(call) if call else ()
+                        static = _static_argnums(call) if call else set()
+                        self.decorated_donate[child.name] = donate
+                        jc = _JitCall(call or ast.Call(
+                            func=ast.Name(id="jit", ctx=ast.Load()),
+                            args=[], keywords=[]), func, cls)
+                        jc._decorated = child
+                        jc.static = static
+                        self.jit_calls.append(jc)
+                self._walk(child, child, cls)
+            else:
+                for sub in ast.walk(child):
+                    if isinstance(sub, ast.Call) and \
+                            call_name(sub) in ("jax.jit", "jit"):
+                        self.jit_calls.append(_JitCall(sub, func, cls))
+                    elif isinstance(sub, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef,
+                                          ast.Lambda)):
+                        break
+
+    # -------------------------------------------------------- resolution
+    def resolve_target(self, jit: _JitCall) -> _Resolved | None:
+        dec = getattr(jit, "_decorated", None)
+        if dec is not None:
+            return _resolved_from_def(dec, jit.static)
+        if not jit.call.args:
+            return None
+        return self._resolve_expr(jit.call.args[0], jit)
+
+    def _resolve_expr(self, target, jit: _JitCall, depth=0):
+        if depth > 3:
+            return None
+        if isinstance(target, ast.Lambda):
+            params = [a.arg for a in target.args.args]
+            return _Resolved(target, params, jit.static)
+        if isinstance(target, ast.Name):
+            fn = self._lookup(target.id, jit)
+            if fn is not None:
+                return _resolved_from_def(fn, jit.static)
+            return None
+        if isinstance(target, ast.Call):
+            # factory pattern: jax.jit(self._build_step())
+            name = call_name(target)
+            if name is None:
+                return None
+            base = name.split(".")[-1]
+            fn = self._lookup(base, jit)
+            if fn is None and name.startswith("self.") and jit.cls:
+                fn = self.defs.get((jit.cls.name, base))
+            if fn is None:
+                return None
+            inner = self._returned_function(fn)
+            if inner is not None:
+                return _resolved_from_def(inner, jit.static)
+        return None
+
+    def _lookup(self, name, jit: _JitCall):
+        if jit.func is not None:
+            fn = self.nested.get(id(jit.func), {}).get(name)
+            if fn is not None:
+                return fn
+        if jit.cls is not None:
+            fn = self.defs.get((jit.cls.name, name))
+            if fn is not None:
+                return fn
+        return self.defs.get((None, name))
+
+    def _returned_function(self, fn):
+        """The FunctionDef/Lambda a factory returns, if statically
+        resolvable."""
+        locals_ = self.nested.get(id(fn), {})
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            v = node.value
+            if isinstance(v, ast.Lambda):
+                return v
+            if isinstance(v, ast.Name) and v.id in locals_:
+                return locals_[v.id]
+            if isinstance(v, ast.Call) and \
+                    call_name(v) in ("jax.jit", "jit") and v.args:
+                inner = v.args[0]
+                if isinstance(inner, ast.Lambda):
+                    return inner
+                if isinstance(inner, ast.Name) and inner.id in locals_:
+                    return locals_[inner.id]
+        return None
+
+    # ---------------------------------------------- donated-reuse bindings
+    def _index_bindings(self):
+        for jit in self.jit_calls:
+            donate = jit.donate
+            if not donate:
+                continue
+            stmt = getattr(jit, "_decorated", None)
+            if stmt is not None:
+                continue
+            parent = _assign_parent(self.src.tree, jit.call)
+            if parent is None:
+                continue
+            for tgt in getattr(parent, "targets", []) or \
+                    ([parent.target] if isinstance(
+                        parent, (ast.AnnAssign, ast.AugAssign)) else []):
+                text = expr_text(tgt)
+                if text.startswith("self."):
+                    self.attr_donate[text[5:]] = donate
+                elif isinstance(tgt, ast.Name) and jit.func is None:
+                    self.module_donate[tgt.id] = donate
+                elif isinstance(tgt, ast.Name) and jit.func is not None:
+                    # a local jit binding; if the enclosing function
+                    # returns it, the function is a jit factory
+                    for node in ast.walk(jit.func):
+                        if isinstance(node, ast.Return) and \
+                                isinstance(node.value, ast.Name) and \
+                                node.value.id == tgt.id:
+                            self.factory_donate[jit.func.name] = donate
+            # `return jax.jit(...)` directly
+            ret = _return_parent(self.src.tree, jit.call)
+            if ret is not None and jit.func is not None:
+                self.factory_donate[jit.func.name] = donate
+
+
+def _resolved_from_def(fn, static):
+    if isinstance(fn, ast.Lambda):
+        return _Resolved(fn, [a.arg for a in fn.args.args], static)
+    params = [a.arg for a in fn.args.args
+              if a.arg not in ("self", "cls")]
+    return _Resolved(fn, params, static)
+
+
+def _donate_argnums(call) -> tuple:
+    for kw in call.keywords if call is not None else ():
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            v = kw.value
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = []
+                for e in v.elts:
+                    if isinstance(e, ast.Constant):
+                        out.append(e.value)
+                return tuple(out)
+            if isinstance(v, ast.Constant):
+                return (v.value,)
+            return ()               # dynamic (conditional) — skip check
+    return ()
+
+
+def _static_argnums(call) -> set:
+    for kw in call.keywords if call is not None else ():
+        if kw.arg == "static_argnums":
+            v = kw.value
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return {e.value for e in v.elts
+                        if isinstance(e, ast.Constant)}
+            if isinstance(v, ast.Constant):
+                return {v.value}
+    return set()
+
+
+def _assign_parent(tree, call):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)) and \
+                getattr(node, "value", None) is call:
+            return node
+    return None
+
+
+def _return_parent(tree, call):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Return) and node.value is call:
+            return node
+    return None
+
+
+# ------------------------------------------------------------ taint check
+def _check_traced_body(src, mod: _ModuleIndex, body: _Resolved,
+                       depth: int) -> list[Finding]:
+    findings: list[Finding] = []
+    node = body.node
+    stmts = node.body if isinstance(node, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)) \
+        else [ast.Expr(value=node.body)]
+    tainted = {p for i, p in enumerate(body.params)
+               if i not in body.static_idx}
+    # two propagation passes: handles use-before-def across loop bodies
+    for _ in range(2):
+        for stmt in stmts:
+            _propagate(stmt, tainted)
+
+    for sub in ast.walk(node if isinstance(node, ast.Lambda)
+                        else ast.Module(body=stmts, type_ignores=[])):
+        if isinstance(sub, ast.Call):
+            findings.extend(_check_call(src, mod, sub, tainted, depth))
+        elif isinstance(sub, (ast.If, ast.While)):
+            if _branch_tainted(sub.test, tainted):
+                kind = "if" if isinstance(sub, ast.If) else "while"
+                findings.append(Finding(
+                    "jit-traced-branch", src.path, sub.lineno,
+                    f"python `{kind}` on traced value "
+                    f"`{expr_text(sub.test)}` inside a jitted function",
+                    hint="use jnp.where / lax.cond / lax.while_loop, or "
+                         "mark the driver static"))
+        elif isinstance(sub, ast.IfExp):
+            if _branch_tainted(sub.test, tainted):
+                findings.append(Finding(
+                    "jit-traced-branch", src.path, sub.lineno,
+                    f"ternary on traced value `{expr_text(sub.test)}` "
+                    "inside a jitted function",
+                    hint="use jnp.where / lax.cond"))
+    return findings
+
+
+def _check_call(src, mod, call, tainted, depth) -> list[Finding]:
+    name = call_name(call)
+    out: list[Finding] = []
+    loc = call.lineno
+    if name in _ALWAYS_BAD_CALLS:
+        out.append(Finding(
+            "jit-host-sync", src.path, loc,
+            f"`{name}(...)` inside a jitted function: "
+            f"{_ALWAYS_BAD_CALLS[name]}",
+            hint="move it outside the traced body"))
+        return out
+    if name in _TAINTED_BAD_CALLS and call.args and \
+            _is_tainted(call.args[0], tainted):
+        out.append(Finding(
+            "jit-host-sync", src.path, loc,
+            f"`{name}({expr_text(call.args[0])})` on a traced value: "
+            f"{_TAINTED_BAD_CALLS[name]}",
+            hint="keep the value on device (jnp) or return it and "
+                 "convert outside the jit"))
+        return out
+    if name in _CASTS and call.args and \
+            _is_tainted(call.args[0], tainted):
+        out.append(Finding(
+            "jit-host-sync", src.path, loc,
+            f"`{name}({expr_text(call.args[0])})` concretizes a traced "
+            "value (blocking host sync)",
+            hint="use .astype / jnp casts, or compute it outside the "
+                 "jitted body"))
+        return out
+    if isinstance(call.func, ast.Attribute) and \
+            call.func.attr in _TAINTED_BAD_METHODS and \
+            _is_tainted(call.func.value, tainted):
+        out.append(Finding(
+            "jit-host-sync", src.path, loc,
+            f"`.{call.func.attr}()` on traced value "
+            f"`{expr_text(call.func.value)}`: "
+            f"{_TAINTED_BAD_METHODS[call.func.attr]}",
+            hint="return the array and concretize outside the jit"))
+        return out
+    # transitive: same-module helper called with traced arguments — only
+    # the positions that actually receive a traced value are tainted
+    # (config objects etc. passed alongside stay static)
+    if depth < _MAX_DEPTH and name is not None and "." not in name:
+        fn = mod.defs.get((None, name))
+        if fn is not None and id(fn) not in mod.analyzed:
+            traced_pos = {i for i, a in enumerate(call.args)
+                          if _is_tainted(a, tainted)}
+            if traced_pos:
+                mod.analyzed.add(id(fn))
+                nparams = len(fn.args.args)
+                static = set(range(nparams)) - traced_pos
+                out.extend(_check_traced_body(
+                    src, mod, _resolved_from_def(fn, static),
+                    depth + 1))
+    return out
+
+
+def _propagate(stmt, tainted: set):
+    """One pass of name-level taint propagation through a statement."""
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.Assign):
+            if _is_tainted(node.value, tainted):
+                for tgt in node.targets:
+                    _taint_target(tgt, tainted)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            if node.value is not None and \
+                    _is_tainted(node.value, tainted):
+                _taint_target(node.target, tainted)
+        elif isinstance(node, ast.For):
+            if _is_tainted(node.iter, tainted):
+                _taint_target(node.target, tainted)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda)):
+            continue
+
+
+def _taint_target(tgt, tainted: set):
+    for node in ast.walk(tgt):
+        if isinstance(node, ast.Name):
+            tainted.add(node.id)
+
+
+def _branch_tainted(test, tainted: set) -> bool:
+    """Tainted-for-branching: identity/membership tests (``x is None``,
+    ``k in params``) inspect pytree *structure* or dict *keys*, both
+    static at trace time, so they never make a branch illegal."""
+    if isinstance(test, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+            for op in test.ops):
+        return False
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _branch_tainted(test.operand, tainted)
+    if isinstance(test, ast.BoolOp):
+        return any(_branch_tainted(v, tainted) for v in test.values)
+    return _is_tainted(test, tainted)
+
+
+def _is_tainted(expr, tainted: set) -> bool:
+    """Does this expression carry a traced value?  Shape/dtype reads and
+    their derivations are static and do not count."""
+    return _taint_of(expr, tainted)
+
+
+def _taint_of(node, tainted) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    if isinstance(node, ast.Attribute):
+        if node.attr in _SHAPE_ATTRS:
+            return False            # x.shape / x.ndim are static
+        return _taint_of(node.value, tainted)
+    if isinstance(node, ast.Call):
+        name = call_name(node)
+        if name in _UNTAINT_CALLS:
+            return False            # len(x), range(...), isinstance(..)
+        base = (name or "").split(".")[0]
+        if base in ("jnp", "lax", "jax"):
+            return True             # jnp.* results are traced
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _SHAPE_ATTRS:
+            return False
+        return any(_taint_of(a, tainted) for a in node.args) or \
+            any(_taint_of(kw.value, tainted) for kw in node.keywords) or \
+            _taint_of(node.func, tainted)
+    if isinstance(node, ast.Subscript):
+        if isinstance(node.value, ast.Attribute) and \
+                node.value.attr in _SHAPE_ATTRS:
+            return False            # x.shape[0]
+        return _taint_of(node.value, tainted) or \
+            _taint_of(node.slice, tainted)
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return any(_taint_of(e, tainted) for e in node.elts)
+    if isinstance(node, ast.Dict):
+        return any(_taint_of(v, tainted)
+                   for v in node.values if v is not None)
+    if isinstance(node, ast.BoolOp):
+        return any(_taint_of(v, tainted) for v in node.values)
+    if isinstance(node, ast.BinOp):
+        return _taint_of(node.left, tainted) or \
+            _taint_of(node.right, tainted)
+    if isinstance(node, ast.UnaryOp):
+        return _taint_of(node.operand, tainted)
+    if isinstance(node, ast.Compare):
+        return _taint_of(node.left, tainted) or \
+            any(_taint_of(c, tainted) for c in node.comparators)
+    if isinstance(node, ast.IfExp):
+        return _taint_of(node.body, tainted) or \
+            _taint_of(node.orelse, tainted)
+    if isinstance(node, ast.Starred):
+        return _taint_of(node.value, tainted)
+    if isinstance(node, (ast.JoinedStr, ast.FormattedValue)):
+        return False
+    return False
+
+
+# -------------------------------------------------------- donated reuse
+def _check_donated_reuse(src, mod: _ModuleIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    nested_ids = {id(f) for locals_ in mod.nested.values()
+                  for f in locals_.values()}
+    for (cls, name), fn in mod.defs.items():
+        if id(fn) in nested_ids:
+            continue        # covered by the walk of its enclosing def
+        findings.extend(_reuse_in_function(src, mod, fn))
+    return findings
+
+
+def _reuse_in_function(src, mod, fn) -> list[Finding]:
+    out: list[Finding] = []
+    # local jit bindings, flow-sensitive: (name, line) -> donate tuple,
+    # so `fn = self._prefill_fn(b)` and a later `fn = ...cached_fn(b)`
+    # each govern only the calls between them
+    local_binds: dict[str, list] = {}       # name -> [(line, donate)]
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign) or \
+                not isinstance(node.value, ast.Call):
+            continue
+        call = node.value
+        cname = call_name(call) or ""
+        donate = None
+        if cname in ("jax.jit", "jit"):
+            donate = _donate_argnums(call)
+        else:
+            base = cname.split(".")[-1]
+            if cname.startswith("self.") and \
+                    base in mod.factory_donate:
+                donate = mod.factory_donate[base]
+            elif base in mod.factory_donate and "." not in cname:
+                donate = mod.factory_donate[base]
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                local_binds.setdefault(tgt.id, []).append(
+                    (node.lineno, donate or ()))
+    for binds in local_binds.values():
+        binds.sort()
+
+    for call in ast.walk(fn):
+        if not isinstance(call, ast.Call):
+            continue
+        cname = call_name(call)
+        if cname is None:
+            continue
+        donate = None
+        if cname.startswith("self.") and cname[5:] in mod.attr_donate:
+            donate = mod.attr_donate[cname[5:]]
+        elif cname in local_binds:
+            # the binding in effect at this call site: the last
+            # assignment on a line at or before it
+            for line, d in local_binds[cname]:
+                if line <= call.lineno:
+                    donate = d
+        elif cname in mod.decorated_donate:
+            donate = mod.decorated_donate[cname]
+        elif cname in mod.module_donate:
+            donate = mod.module_donate[cname]
+        if not donate:
+            continue
+        out.extend(_reuse_at_call(src, fn, call, donate))
+    return out
+
+
+def _reuse_at_call(src, fn, call, donate) -> list[Finding]:
+    out: list[Finding] = []
+    rebound = _rebound_targets(fn, call)
+    for idx in donate:
+        if not isinstance(idx, int) or idx >= len(call.args):
+            continue
+        arg = call.args[idx]
+        if not isinstance(arg, (ast.Name, ast.Attribute)):
+            continue                # temporaries cannot be reused later
+        text = expr_text(arg)
+        if text in rebound:
+            continue
+        use = _first_use_after(fn, call, text)
+        if use is not None and isinstance(use.ctx, ast.Load):
+            out.append(Finding(
+                "jit-donated-reuse", src.path, use.lineno,
+                f"`{text}` was donated to `{call_name(call)}` at "
+                f"{src.path.rsplit('/', 1)[-1]}:{call.lineno} "
+                f"(donate_argnums index {idx}) and is read again "
+                "without being rebound",
+                hint="rebind it from the call's results "
+                     "(`x, ... = fn(x, ...)`) or drop it from "
+                     "donate_argnums"))
+    return out
+
+
+def _rebound_targets(fn, call) -> set:
+    """Expression texts assigned by the statement containing `call`."""
+    for stmt in ast.walk(fn):
+        if isinstance(stmt, ast.Assign):
+            contains = any(n is call for n in ast.walk(stmt.value))
+            if contains:
+                texts = set()
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Tuple):
+                        texts.update(expr_text(e) for e in tgt.elts)
+                    else:
+                        texts.add(expr_text(tgt))
+                return texts
+    return set()
+
+
+def _first_use_after(fn, call, text):
+    """First Name/Attribute node matching `text` positioned strictly
+    after the call expression, in source order."""
+    end = (call.end_lineno or call.lineno,
+           call.end_col_offset or call.col_offset)
+    best = None
+    for node in ast.walk(fn):
+        if not isinstance(node, (ast.Name, ast.Attribute)):
+            continue
+        pos = (node.lineno, node.col_offset)
+        if pos <= end:
+            continue
+        if expr_text(node) != text:
+            continue
+        if best is None or pos < (best.lineno, best.col_offset):
+            best = node
+    return best

@@ -1,0 +1,476 @@
+"""Lock-discipline analyzer: ordering cycles, unlocked shared writes,
+blocking calls under a lock.
+
+The serving stack is threaded (engine worker, router prober, watchdog,
+SSE handlers) and every subsystem guards its state with attribute locks
+(``self._lock = threading.Lock()``).  This analyzer reconstructs, per
+module, which locks exist, where they are held (``with self._lock:``
+scopes and paired ``.acquire()``/``.release()`` calls), and checks:
+
+``lock-order-cycle``
+    A lock-acquisition graph edge A->B is recorded whenever B is
+    acquired while A is held.  Any strongly-connected component (A->B
+    and B->A, or longer rings) is a potential ABBA deadlock.
+    ``threading.Condition(existing_lock)`` is treated as an alias of
+    its underlying lock, and reentrant re-acquisition of the same
+    RLock/Condition is not an edge.
+
+``lock-unlocked-write``
+    Within a class that owns at least one lock, an attribute written
+    both inside a lock scope and outside any lock scope (excluding
+    ``__init__``, where the object is not yet shared) is a data race:
+    the unlocked sites are flagged.
+
+``lock-blocking-call``
+    Calls that can block indefinitely while a lock is held starve every
+    other thread contending for it: ``time.sleep``, socket/HTTP
+    connects, ``Event.wait``, ``Condition.wait`` on a *different* lock
+    than the one held (waiting on the condition you hold through the
+    condition itself is the normal pattern and is fine),
+    ``.block_until_ready()`` / ``jax.device_get`` / ``np.asarray`` on
+    device values, and ``Thread.join``.
+
+Only ``.acquire()``/``.release()`` on *resolved lock objects* count —
+unrelated methods that happen to be called ``_acquire`` (e.g. the block
+manager's page allocator) are ignored.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, SourceFile, call_name, expr_text
+
+__all__ = ["analyze"]
+
+RULES = {
+    "lock-order-cycle": "locks acquired in inconsistent order "
+                        "(potential ABBA deadlock)",
+    "lock-unlocked-write": "attribute written both inside and outside "
+                           "the class's lock scopes",
+    "lock-blocking-call": "call that can block indefinitely made while "
+                          "holding a lock",
+}
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+_REENTRANT_CTORS = {"RLock", "Condition"}
+_EVENT_CTORS = {"Event"}
+
+# dotted call names that block regardless of their arguments
+_BLOCKING_CALLS = {
+    "time.sleep": "sleeps with the lock held",
+    "socket.create_connection": "network connect with the lock held",
+    "urllib.request.urlopen": "HTTP round-trip with the lock held",
+    "requests.get": "HTTP round-trip with the lock held",
+    "requests.post": "HTTP round-trip with the lock held",
+    "requests.request": "HTTP round-trip with the lock held",
+    "jax.device_get": "device->host transfer with the lock held",
+}
+
+_BLOCKING_METHODS = {
+    "block_until_ready": "device sync with the lock held",
+    "getresponse": "HTTP read with the lock held",
+    "recv": "socket read with the lock held",
+}
+
+_DEVICE_HINTS = ("_dev", "device")
+
+
+class _LockInfo:
+    __slots__ = ("key", "ctor", "alias_of")
+
+    def __init__(self, key, ctor, alias_of=None):
+        self.key = key              # canonical id, e.g. "Router._lock"
+        self.ctor = ctor            # "Lock" | "RLock" | ...
+        self.alias_of = alias_of    # canonical key of underlying lock
+
+
+class _ModuleLocks:
+    """Lock/event inventory for one module."""
+
+    def __init__(self, tree):
+        # "Class.attr" or bare module-global name -> _LockInfo
+        self.locks: dict[str, _LockInfo] = {}
+        self.events: set[str] = set()           # "Class.attr" keys
+        # lock attr name -> class names defining it (cross-object lookup)
+        self.attr_owners: dict[str, list] = {}
+        self._collect(tree)
+
+    def _collect(self, tree):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign) and \
+                            isinstance(sub.value, ast.Call):
+                        self._maybe_lock(node.name, sub)
+            elif isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        ctor = _ctor_of(node.value)
+                        if ctor in _LOCK_CTORS:
+                            self.locks[tgt.id] = _LockInfo(tgt.id, ctor)
+
+    def _maybe_lock(self, cls, assign):
+        ctor = _ctor_of(assign.value)
+        for tgt in assign.targets:
+            text = expr_text(tgt)
+            if not text.startswith("self."):
+                continue
+            attr = text[5:]
+            key = f"{cls}.{attr}"
+            if ctor in _LOCK_CTORS:
+                alias = None
+                if ctor == "Condition" and assign.value.args:
+                    inner = expr_text(assign.value.args[0])
+                    if inner.startswith("self."):
+                        alias = f"{cls}.{inner[5:]}"
+                self.locks[key] = _LockInfo(key, ctor, alias)
+                self.attr_owners.setdefault(attr, []).append(cls)
+            elif ctor in _EVENT_CTORS:
+                self.events.add(key)
+
+    # ------------------------------------------------------- resolution
+    def resolve(self, expr, cls) -> _LockInfo | None:
+        """The lock an expression refers to, or None."""
+        text = expr_text(expr)
+        if text.startswith("self.") and cls:
+            info = self.locks.get(f"{cls}.{text[5:]}")
+            if info is not None:
+                return info
+        if isinstance(expr, ast.Name):
+            return self.locks.get(text)
+        if isinstance(expr, ast.Attribute):
+            owners = self.attr_owners.get(expr.attr, [])
+            if len(owners) == 1 and not text.startswith("self."):
+                return self.locks.get(f"{owners[0]}.{expr.attr}")
+        return None
+
+    def canonical(self, info: _LockInfo) -> str:
+        seen = set()
+        while info.alias_of and info.alias_of not in seen:
+            seen.add(info.key)
+            nxt = self.locks.get(info.alias_of)
+            if nxt is None:
+                break
+            info = nxt
+        return info.key
+
+    def is_event(self, expr, cls) -> bool:
+        text = expr_text(expr)
+        return bool(text.startswith("self.") and cls and
+                    f"{cls}.{text[5:]}" in self.events)
+
+
+def _ctor_of(call) -> str | None:
+    name = call_name(call)
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+def analyze(src: SourceFile) -> list[Finding]:
+    # cheap pre-gate: no lock constructor text, no resolvable locks
+    if not any(ctor + "(" in src.text
+               for ctor in _LOCK_CTORS | _EVENT_CTORS):
+        return []
+    locks = _ModuleLocks(src.tree)
+    findings: list[Finding] = []
+    edges: dict[tuple, tuple] = {}       # (outer, inner) -> first site
+    writes: dict[tuple, dict] = {}       # (cls, attr) -> {...}
+
+    for cls, fn in _methods(src.tree):
+        clsname = cls.name if cls else None
+        v = _ScopeVisitor(src, locks, clsname, fn, edges, writes,
+                          findings)
+        v.visit_block(fn.body, [])
+
+    findings.extend(_cycle_findings(src, edges))
+    findings.extend(_write_findings(src, writes))
+    return src.filter(findings)
+
+
+def _methods(tree):
+    """(class | None, function) pairs, outermost functions only —
+    nested closures are visited as part of their parent's body."""
+    def walk(node, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield from walk(child, child)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                yield cls, child
+            else:
+                yield from walk(child, cls)
+    yield from walk(tree, None)
+
+
+class _ScopeVisitor:
+    """Statement-ordered traversal of one function tracking held locks."""
+
+    def __init__(self, src, locks, cls, fn, edges, writes, findings):
+        self.src = src
+        self.locks = locks
+        self.cls = cls
+        self.fn = fn
+        self.edges = edges
+        self.writes = writes
+        self.findings = findings
+
+    # `held` is an ordered list of (canonical_key, ctor) for the current
+    # path; acquire/release pairs mutate a copy scoped to the block.
+    def visit_block(self, stmts, held):
+        held = list(held)
+        for stmt in stmts:
+            held = self.visit_stmt(stmt, held)
+        return held
+
+    def visit_stmt(self, stmt, held):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested closure: runs later, not under the current locks —
+            # but its own with-scopes still count, with an empty stack
+            self.visit_block(stmt.body, [])
+            return held
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            inner = list(held)
+            acquired = []
+            for item in stmt.items:
+                ctx = item.context_expr
+                info = self.locks.resolve(ctx, self.cls)
+                if info is not None:
+                    key = self.locks.canonical(info)
+                    self._record_acquire(key, info, inner, ctx)
+                    inner.append((key, info.ctor))
+                    acquired.append(key)
+            self.visit_block(stmt.body, inner)
+            return held
+        if isinstance(stmt, (ast.If,)):
+            self._scan_expr(stmt.test, held)
+            self.visit_block(stmt.body, held)
+            self.visit_block(stmt.orelse, held)
+            return held
+        if isinstance(stmt, (ast.While, ast.For)):
+            if isinstance(stmt, ast.While):
+                self._scan_expr(stmt.test, held)
+            else:
+                self._scan_expr(stmt.iter, held)
+            self.visit_block(stmt.body, held)
+            self.visit_block(stmt.orelse, held)
+            return held
+        if isinstance(stmt, ast.Try):
+            held = self.visit_block(stmt.body, held)
+            for h in stmt.handlers:
+                self.visit_block(h.body, held)
+            self.visit_block(stmt.orelse, held)
+            held = self.visit_block(stmt.finalbody, held)
+            return held
+
+        # leaf statement: explicit acquire()/release(), writes, calls
+        held = self._handle_acquire_release(stmt, held)
+        self._record_writes(stmt, held)
+        self._scan_stmt_exprs(stmt, held)
+        return held
+
+    # ----------------------------------------------------- acquire pairs
+    def _handle_acquire_release(self, stmt, held):
+        for call in ast.walk(stmt):
+            if not isinstance(call, ast.Call) or \
+                    not isinstance(call.func, ast.Attribute):
+                continue
+            if call.func.attr not in ("acquire", "release"):
+                continue
+            info = self.locks.resolve(call.func.value, self.cls)
+            if info is None:
+                continue
+            key = self.locks.canonical(info)
+            if call.func.attr == "acquire":
+                self._record_acquire(key, info, held, call.func.value)
+                held = held + [(key, info.ctor)]
+            else:
+                held = [h for h in held if h[0] != key] if \
+                    any(h[0] == key for h in held) else held
+        return held
+
+    def _record_acquire(self, key, info, held, site):
+        for outer_key, _ in held:
+            if outer_key == key:
+                continue            # reentrant; RLock/Condition fine
+            edge = (outer_key, key)
+            if edge not in self.edges:
+                self.edges[edge] = (self.src.path, site.lineno)
+
+    # --------------------------------------------------- attribute writes
+    def _record_writes(self, stmt, held):
+        if self.cls is None or self.fn.name == "__init__":
+            return
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        elif isinstance(stmt, ast.Expr):
+            return
+        my_locks_held = any(k.startswith(self.cls + ".")
+                            for k, _ in held)
+        for tgt in targets:
+            for node in ast.walk(tgt):
+                if isinstance(node, ast.Attribute) and \
+                        isinstance(node.value, ast.Name) and \
+                        node.value.id == "self":
+                    attr = node.attr
+                    ck = (self.cls, attr)
+                    if f"{self.cls}.{attr}" in self.locks.locks or \
+                            f"{self.cls}.{attr}" in self.locks.events:
+                        continue
+                    rec = self.writes.setdefault(
+                        ck, {"locked": [], "unlocked": []})
+                    rec["locked" if my_locks_held else
+                        "unlocked"].append((self.src.path, node.lineno))
+
+    # ----------------------------------------------------- blocking calls
+    def _scan_stmt_exprs(self, stmt, held):
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                self._check_call(node, held)
+
+    def _scan_expr(self, expr, held):
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self._check_call(node, held)
+
+    def _check_call(self, call, held):
+        if not held:
+            return
+        name = call_name(call)
+        held_keys = [k for k, _ in held]
+        if name in _BLOCKING_CALLS:
+            self._blocking(call, name, _BLOCKING_CALLS[name], held_keys)
+            return
+        if not isinstance(call.func, ast.Attribute):
+            return
+        attr = call.func.attr
+        recv = call.func.value
+        if attr in _BLOCKING_METHODS:
+            self._blocking(call, f".{attr}()",
+                           _BLOCKING_METHODS[attr], held_keys)
+            return
+        if attr == "wait":
+            self._check_wait(call, recv, held, held_keys)
+            return
+        if attr == "join":
+            rt = expr_text(recv).lower()
+            if "thread" in rt or "proc" in rt or "worker" in rt:
+                self._blocking(call, f"{expr_text(recv)}.join()",
+                               "joins a thread with the lock held",
+                               held_keys)
+            return
+        if name in ("np.asarray", "np.array", "numpy.asarray",
+                    "numpy.array") and call.args:
+            at = expr_text(call.args[0]).lower()
+            if any(h in at for h in _DEVICE_HINTS):
+                self._blocking(
+                    call, f"{name}({expr_text(call.args[0])})",
+                    "device->host transfer with the lock held",
+                    held_keys)
+
+    def _check_wait(self, call, recv, held, held_keys):
+        info = self.locks.resolve(recv, self.cls)
+        if info is not None and info.ctor == "Condition":
+            own = self.locks.canonical(info)
+            others = [k for k in held_keys if k != own]
+            if others:
+                self._blocking(
+                    call, f"{expr_text(recv)}.wait()",
+                    f"waits on {own} while still holding "
+                    f"{', '.join(sorted(set(others)))}", held_keys)
+            return
+        if self.locks.is_event(recv, self.cls):
+            self._blocking(call, f"{expr_text(recv)}.wait()",
+                           "waits on an event with the lock held",
+                           held_keys)
+
+    def _blocking(self, call, what, why, held_keys):
+        self.findings.append(Finding(
+            "lock-blocking-call", self.src.path, call.lineno,
+            f"{what} while holding {', '.join(sorted(set(held_keys)))}: "
+            f"{why}",
+            hint="move the blocking call outside the lock scope, or "
+                 "snapshot state under the lock and release first"))
+
+
+# ------------------------------------------------------------- reporting
+def _cycle_findings(src, edges) -> list[Finding]:
+    graph: dict[str, set] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+    out = []
+    reported = set()
+    for a in sorted(graph):
+        for b in sorted(graph[a]):
+            if a in graph.get(b, ()):       # 2-cycle (ABBA)
+                key = frozenset((a, b))
+                if key in reported:
+                    continue
+                reported.add(key)
+                path, line = edges[(a, b)]
+                other = edges[(b, a)]
+                out.append(Finding(
+                    "lock-order-cycle", path, line,
+                    f"lock order cycle: {a} -> {b} here but "
+                    f"{b} -> {a} at {other[0]}:{other[1]} "
+                    "(potential ABBA deadlock)",
+                    hint="pick one global order for these locks and "
+                         "acquire them in that order everywhere"))
+    # longer rings: DFS back-edge detection over the remaining graph
+    out.extend(_long_cycles(graph, edges, reported))
+    return out
+
+
+def _long_cycles(graph, edges, reported) -> list[Finding]:
+    out = []
+    seen_cycles = set(reported)
+    for start in sorted(graph):
+        stack, on_path = [(start, iter(sorted(graph.get(start, ()))))], \
+            [start]
+        while stack:
+            node, it = stack[-1]
+            adv = next(it, None)
+            if adv is None:
+                stack.pop()
+                on_path.pop()
+                continue
+            if adv in on_path:
+                cyc = on_path[on_path.index(adv):] + [adv]
+                key = frozenset(cyc)
+                if len(key) > 2 and key not in seen_cycles:
+                    seen_cycles.add(key)
+                    path, line = edges[(node, adv)]
+                    out.append(Finding(
+                        "lock-order-cycle", path, line,
+                        "lock order cycle: " + " -> ".join(cyc) +
+                        " (potential deadlock ring)",
+                        hint="pick one global order for these locks"))
+                continue
+            if len(stack) > 8:      # bound pathological graphs
+                stack.pop()
+                on_path.pop()
+                continue
+            stack.append((adv, iter(sorted(graph.get(adv, ())))))
+            on_path.append(adv)
+    return out
+
+
+def _write_findings(src, writes) -> list[Finding]:
+    out = []
+    for (cls, attr), rec in sorted(writes.items()):
+        if not rec["locked"] or not rec["unlocked"]:
+            continue
+        l_path, l_line = rec["locked"][0]
+        for path, line in rec["unlocked"]:
+            out.append(Finding(
+                "lock-unlocked-write", path, line,
+                f"`self.{attr}` of {cls} is written here without the "
+                f"lock, but under the lock at {l_path}:{l_line} — "
+                "racy if both paths run concurrently",
+                hint=f"take the {cls} lock around this write, or "
+                     "document single-threaded ownership with a "
+                     "suppression"))
+    return out

@@ -1,0 +1,37 @@
+"""Text and JSON rendering of findings."""
+from __future__ import annotations
+
+import json
+
+from .core import Finding
+
+__all__ = ["render_text", "render_json"]
+
+
+def render_text(findings: list[Finding], baselined: int = 0) -> str:
+    """One line per finding, sorted by location, plus a summary line."""
+    lines = [f.render() for f in
+             sorted(findings, key=lambda f: (f.path, f.line, f.rule))]
+    by_rule: dict[str, int] = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    summary = (f"{len(findings)} finding"
+               f"{'' if len(findings) == 1 else 's'}")
+    if by_rule:
+        summary += " (" + ", ".join(
+            f"{n} {r}" for r, n in sorted(by_rule.items())) + ")"
+    if baselined:
+        summary += f"; {baselined} baselined finding" \
+                   f"{'' if baselined == 1 else 's'} suppressed"
+    lines.append(summary)
+    return "\n".join(lines)
+
+
+def render_json(findings: list[Finding], baselined: int = 0) -> str:
+    return json.dumps(
+        {"findings": [f.to_dict() for f in
+                      sorted(findings,
+                             key=lambda f: (f.path, f.line, f.rule))],
+         "count": len(findings),
+         "baselined": baselined},
+        indent=2) + "\n"

@@ -1,0 +1,108 @@
+"""Drive all analyzers over a file set.
+
+File discovery skips ``__pycache__``, hidden directories, and
+``lint_fixtures`` (deliberately-bad snippets used to test the linter
+itself).  ``paddle_tpu/flags.py`` is always consulted for flag
+definitions — pre-parsed when it is outside the analyzed paths, or
+ordered first when inside them — so ``flag-undefined`` sees the full
+registry no matter which subset of the repo is linted.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from . import clocks, flags_metrics, jit_safety, lock_discipline
+from .core import Finding, SourceFile
+
+__all__ = ["ALL_RULES", "run", "iter_files"]
+
+ALL_RULES: dict[str, str] = {}
+ALL_RULES.update(jit_safety.RULES)
+ALL_RULES.update(lock_discipline.RULES)
+ALL_RULES.update(flags_metrics.RULES)
+ALL_RULES.update(clocks.RULES)
+ALL_RULES["parse-error"] = "file failed to parse"
+
+_SKIP_DIRS = {"__pycache__", "lint_fixtures", ".git"}
+
+_FLAGS_REL = "paddle_tpu/flags.py"
+
+
+def iter_files(paths, root):
+    """(abspath, repo-relative posix path) pairs, deterministic order,
+    flags.py first so its definitions precede every read site."""
+    out = []
+    seen = set()
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(root, p)
+        ap = os.path.normpath(ap)
+        if os.path.isfile(ap):
+            _add(out, seen, ap, root)
+        elif os.path.isdir(ap):
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d not in _SKIP_DIRS and not d.startswith("."))
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        _add(out, seen, os.path.join(dirpath, fn), root)
+    out.sort(key=lambda pair: (pair[1] != _FLAGS_REL, pair[1]))
+    return out
+
+
+def _add(out, seen, abspath, root):
+    rel = os.path.relpath(abspath, root).replace(os.sep, "/")
+    if rel not in seen:
+        seen.add(rel)
+        out.append((abspath, rel))
+
+
+def run(paths, root=None, rules=None) -> list[Finding]:
+    """All findings (suppressions already applied) for the given paths,
+    optionally restricted to a rule-id subset."""
+    root = os.path.abspath(root or os.getcwd())
+    files = iter_files(paths, root)
+
+    flag_defs = {}
+    if not any(rel == _FLAGS_REL for _, rel in files):
+        flags_abs = os.path.join(root, _FLAGS_REL)
+        if os.path.exists(flags_abs):
+            try:
+                fsrc = SourceFile.load(flags_abs, _FLAGS_REL)
+            except SyntaxError:
+                fsrc = None
+            if fsrc is not None:
+                for name, has_help, line in \
+                        flags_metrics.collect_flag_defs(fsrc):
+                    flag_defs.setdefault(
+                        name, (has_help, f"{_FLAGS_REL}:{line}"))
+    fm = flags_metrics.FlagsMetricsAnalyzer(flag_defs)
+
+    findings: list[Finding] = []
+    for abspath, rel in files:
+        try:
+            src = SourceFile.load(abspath, rel)
+        except SyntaxError as e:
+            findings.append(Finding(
+                "parse-error", rel, e.lineno or 1,
+                f"syntax error: {e.msg}",
+                hint="fix the syntax error"))
+            continue
+        except (OSError, UnicodeDecodeError) as e:
+            findings.append(Finding(
+                "parse-error", rel, 1, f"unreadable: {e}",
+                hint="fix file encoding/permissions"))
+            continue
+        findings.extend(jit_safety.analyze(src))
+        findings.extend(lock_discipline.analyze(src))
+        findings.extend(fm.check(src))
+        findings.extend(clocks.analyze(src))
+
+    if rules is not None:
+        wanted = set(rules)
+        unknown = wanted - set(ALL_RULES)
+        if unknown:
+            raise ValueError(f"unknown rule ids: {sorted(unknown)}")
+        findings = [f for f in findings if f.rule in wanted]
+    return findings

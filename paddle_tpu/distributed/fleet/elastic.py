@@ -77,6 +77,9 @@ class ElasticManager:
                 ts = float(self.store.get(self._key(r)))
             except Exception:
                 continue
+            # cross-process comparison: heartbeats are written by OTHER
+            # hosts, so wall clock is the only shared timebase here
+            # tpu-lint: disable=wall-clock-duration
             if now - ts <= self.ttl:
                 alive.append(r)
         return alive

@@ -138,7 +138,7 @@ class NodeRendezvous:
         HOST alone commits the world size (one decider — concurrent
         deadline races cannot produce nodes with different worlds);
         a straggler landing outside the committed world fails loudly."""
-        deadline = time.time() + self.timeout
+        deadline = time.monotonic() + self.timeout
         while True:                    # restart at a newer generation if
             gen = self.generation()    # peers bump while we wait
             pre = f"job/{self.job}/g{gen}"
@@ -155,9 +155,9 @@ class NodeRendezvous:
                 while self.generation() == gen:
                     n = int(self.store.add(f"{pre}/count", 0))
                     if n >= self.max or (n >= self.min
-                                         and time.time() > deadline):
+                                         and time.monotonic() > deadline):
                         break
-                    if time.time() > deadline:
+                    if time.monotonic() > deadline:
                         raise TimeoutError(
                             f"rendezvous: {n}/{self.min} nodes after "
                             f"{self.timeout}s (job={self.job} gen={gen})")
@@ -168,7 +168,7 @@ class NodeRendezvous:
                 while self.generation() == gen:
                     if self.store.check(f"{pre}/world"):
                         break
-                    if time.time() > deadline:
+                    if time.monotonic() > deadline:
                         raise TimeoutError(
                             f"rendezvous: no world commit from the "
                             f"master after {self.timeout}s "
@@ -287,10 +287,10 @@ class Launcher:
         for p in self.procs:
             if p.poll() is None:
                 p.send_signal(signal.SIGTERM)
-        deadline = time.time() + 10
+        deadline = time.monotonic() + 10
         for p in self.procs:
             try:
-                p.wait(max(0.1, deadline - time.time()))
+                p.wait(max(0.1, deadline - time.monotonic()))
             except subprocess.TimeoutExpired:
                 p.kill()
 
@@ -325,7 +325,7 @@ class Launcher:
         watcher loop in launch/controllers/watcher.py).  Multi-node:
         also watch the rendezvous generation — a peer bumping it means
         the world must re-form (reference elastic/manager.py watch)."""
-        last_gen_check = time.time()
+        last_gen_check = time.monotonic()
         while True:
             alive = False
             for p in self.procs:
@@ -340,8 +340,8 @@ class Launcher:
             if not alive:
                 return 0
             if self.multi_node and self.rdzv is not None and \
-                    time.time() - last_gen_check > 1.0:
-                last_gen_check = time.time()
+                    time.monotonic() - last_gen_check > 1.0:
+                last_gen_check = time.monotonic()
                 if self.rdzv.generation() != self.gen:
                     self._kill_all()
                     return self.RESTART_SENTINEL

@@ -58,6 +58,9 @@ class SparseTable:
             else:
                 v = (self._rng.standard_normal(self.dim) *
                      self._scale).astype(np.float32)
+            # caller holds self._lock (pull/push/state all enter _row
+            # under it); _row itself stays lock-free to avoid RLock cost
+            # tpu-lint: disable=lock-unlocked-write
             self._rows[r] = v
         return v
 

@@ -139,13 +139,13 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
         store.set("/rpc/token", token)
     else:
         import time as _time
-        deadline0 = _time.time() + 60
+        deadline0 = _time.monotonic() + 60
         while True:
             try:
                 token = store.get("/rpc/token")
                 break
             except Exception:
-                if _time.time() > deadline0:
+                if _time.monotonic() > deadline0:
                     raise TimeoutError("init_rpc: no auth token from rank 0")
                 _time.sleep(0.05)
         if isinstance(token, bytes):
@@ -154,7 +154,7 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
                   store=store, token=token)
     store.set(f"/rpc/{rank}", f"{name},{ip},{port}")
     import time
-    deadline = time.time() + 60
+    deadline = time.monotonic() + 60
     workers = {}
     while len(workers) < world_size:
         for r in range(world_size):
@@ -168,7 +168,7 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
                 raw = raw.decode()
             wname, ip, p = str(raw).split(",")
             workers[r] = WorkerInfo(wname, r, ip, int(p))
-        if time.time() > deadline:
+        if time.monotonic() > deadline:
             raise TimeoutError("init_rpc: peers did not register")
         if len(workers) < world_size:
             time.sleep(0.05)

@@ -16,25 +16,66 @@ FLAGS: dict[str, Any] = {}
 _DEFS: dict[str, tuple[type, Any, str]] = {}
 
 
+_BOOL_TRUE = ("1", "true", "yes")
+_BOOL_FALSE = ("0", "false", "no")
+
+
+def _coerce(name: str, value, t: type):
+    """Coerce ``value`` to the registered flag type, loudly.
+
+    Bools are strict: only the canonical spellings parse — ``"2"`` or
+    ``"on"`` raise instead of silently becoming False (the pre-fix
+    behavior), and non-bool truthy objects are rejected rather than
+    cast.  Other types go through the constructor (so ``"4096"`` is a
+    fine int), with failures re-raised as a flag-specific error.
+    """
+    if t is bool:
+        if isinstance(value, bool):
+            return value
+        if isinstance(value, str):
+            low = value.strip().lower()
+            if low in _BOOL_TRUE:
+                return True
+            if low in _BOOL_FALSE:
+                return False
+        raise ValueError(
+            f"flag {name!r} is a bool; got {value!r} (accepted: "
+            f"{'/'.join(_BOOL_TRUE)} or {'/'.join(_BOOL_FALSE)})")
+    # bool-as-number for int/float flags is almost always a mistake
+    # (checked first: bool IS an int subclass, isinstance would pass it)
+    if isinstance(value, bool):
+        raise TypeError(
+            f"flag {name!r} expects {t.__name__}, got bool {value!r}")
+    if isinstance(value, t):
+        return value
+    try:
+        return t(value)
+    except (TypeError, ValueError) as e:
+        raise TypeError(
+            f"flag {name!r} expects {t.__name__}, got "
+            f"{type(value).__name__} {value!r}: {e}") from None
+
+
 def define_flag(name: str, default, help_: str = "", type_=None):
     t = type_ or type(default)
     _DEFS[name] = (t, default, help_)
     env = os.environ.get(name)
     if env is not None:
-        if t is bool:
-            FLAGS[name] = env.lower() in ("1", "true", "yes")
-        else:
-            FLAGS[name] = t(env)
+        FLAGS[name] = _coerce(name, env, t)
     else:
         FLAGS[name] = default
     return name
 
 
 def set_flags(flags: dict):
+    # validate the whole batch before mutating: a bad entry must not
+    # leave a half-applied update behind
+    coerced = {}
     for k, v in flags.items():
         if k not in _DEFS:
             raise ValueError(f"unknown flag {k!r}")
-        FLAGS[k] = v
+        coerced[k] = _coerce(k, v, _DEFS[k][0])
+    FLAGS.update(coerced)
 
 
 def get_flags(keys):
@@ -83,6 +124,10 @@ define_flag("FLAGS_serving_slo_tpot_ms", 0.0,
             "SLO target for per-output-token latency, ms (0 disables)")
 define_flag("FLAGS_serving_slo_e2e_ms", 0.0,
             "SLO target for request end-to-end latency, ms (0 disables)")
+define_flag("FLAGS_selected_devices", "",
+            "device ordinal(s) this process should use; exported into "
+            "child env by distributed.launch (reference "
+            "FLAGS_selected_gpus/xpus analogue)")
 define_flag("FLAGS_serving_slo_objective", 0.99,
             "SLO objective (fraction of requests that must meet each "
             "target) — burn rate = violation rate / (1 - objective)")

@@ -82,12 +82,12 @@ class ProgBarLogger(Callback):
 
     def on_train_begin(self, logs=None):
         self.epochs = (self.params or {}).get("epochs")
-        self._t0 = time.time()
+        self._t0 = time.perf_counter()
 
     def on_epoch_begin(self, epoch, logs=None):
         self.epoch = epoch
         self._steps = 0
-        self._t_epoch = time.time()
+        self._t_epoch = time.perf_counter()
 
     def on_train_batch_end(self, step, logs=None):
         self._steps += 1
@@ -100,7 +100,7 @@ class ProgBarLogger(Callback):
 
     def on_epoch_end(self, epoch, logs=None):
         if self.verbose:
-            dt = time.time() - self._t_epoch
+            dt = time.perf_counter() - self._t_epoch
             extras = " ".join(f"{k}: {v:.4f}" for k, v in (logs or {}).items()
                               if isinstance(v, (int, float))
                               and k not in ("step", "batch_size"))

@@ -90,14 +90,15 @@ class RetraceLog:
             e = self._entries.get(key)
             if e is not None:
                 e["count"] += 1
-                e["last_time"] = time.time()
+                e["last_time"] = time.perf_counter()
                 return
             if len(self._entries) >= self.MAX_ENTRIES:
                 self._dropped += 1
                 return
             self._entries[key] = {
                 "op": op, "signature": signature, "count": 1,
-                "first_time": time.time(), "last_time": time.time()}
+                "first_time": time.perf_counter(),
+                "last_time": time.perf_counter()}
 
     def entries(self) -> list[dict]:
         with self._lock:

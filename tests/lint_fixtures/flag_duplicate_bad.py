@@ -1,0 +1,5 @@
+"""BAD: the same flag registered twice (flag-duplicate)."""
+from paddle_tpu.flags import define_flag
+
+define_flag("FLAGS_fixture_retries", 3, "fixture retry budget")
+define_flag("FLAGS_fixture_retries", 5, "fixture retry budget, again")

@@ -1,0 +1,5 @@
+"""GOOD twin: each flag registered exactly once."""
+from paddle_tpu.flags import define_flag
+
+define_flag("FLAGS_fixture_retries", 3, "fixture retry budget")
+define_flag("FLAGS_fixture_backoff_s", 0.5, "fixture retry backoff")

@@ -1,0 +1,4 @@
+"""BAD: flag registered without help text (flag-missing-help)."""
+from paddle_tpu.flags import define_flag
+
+define_flag("FLAGS_fixture_quiet_mode", False)

@@ -1,0 +1,5 @@
+"""GOOD twin: help text present."""
+from paddle_tpu.flags import define_flag
+
+define_flag("FLAGS_fixture_quiet_mode", False,
+            "suppress fixture chatter (lint fixture only)")

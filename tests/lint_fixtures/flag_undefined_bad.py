@@ -1,0 +1,7 @@
+"""BAD: typo'd flag name reads as permanently-default
+(flag-undefined)."""
+from paddle_tpu.flags import FLAGS
+
+
+def buffer_size():
+    return FLAGS.get("FLAGS_trace_buffer_sz", 0)

@@ -1,0 +1,6 @@
+"""GOOD twin: the registered spelling."""
+from paddle_tpu.flags import FLAGS
+
+
+def buffer_size():
+    return FLAGS.get("FLAGS_trace_buffer_size", 0)

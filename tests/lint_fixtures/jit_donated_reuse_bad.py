@@ -1,0 +1,14 @@
+"""BAD: donated buffer read after the jit call (jit-donated-reuse)."""
+import jax
+
+
+def _accumulate(buf, x):
+    return buf + x
+
+
+step = jax.jit(_accumulate, donate_argnums=(0,))
+
+
+def run(buf, x):
+    out = step(buf, x)
+    return out + buf        # buf's device memory was donated away

@@ -1,0 +1,14 @@
+"""GOOD twin: the donated buffer is rebound from the call result."""
+import jax
+
+
+def _accumulate(buf, x):
+    return buf + x
+
+
+step = jax.jit(_accumulate, donate_argnums=(0,))
+
+
+def run(buf, x):
+    buf = step(buf, x)
+    return buf

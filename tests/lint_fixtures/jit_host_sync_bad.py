@@ -1,0 +1,11 @@
+"""BAD: host sync inside a jitted function (jit-host-sync)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step(x):
+    y = jnp.sum(x * x)
+    np.asarray(y)           # device->host transfer mid-trace
+    return y

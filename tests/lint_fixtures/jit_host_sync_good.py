@@ -1,0 +1,13 @@
+"""GOOD twin: concretize outside the jitted body."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step(x):
+    return jnp.sum(x * x)
+
+
+def host_value(x):
+    return np.asarray(step(x))

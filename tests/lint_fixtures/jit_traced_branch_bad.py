@@ -1,0 +1,10 @@
+"""BAD: python branch on a traced value (jit-traced-branch)."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def clamp_positive(x):
+    if x > 0:               # TracerBoolConversionError at runtime
+        return x
+    return jnp.zeros_like(x)

@@ -1,0 +1,14 @@
+"""BAD: sleeping while holding the lock (lock-blocking-call)."""
+import threading
+import time
+
+
+class Prober:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.probes = 0
+
+    def probe(self):
+        with self._lock:
+            time.sleep(0.1)     # every other thread stalls here
+            self.probes += 1

@@ -1,0 +1,14 @@
+"""GOOD twin: block outside, take the lock only for the update."""
+import threading
+import time
+
+
+class Prober:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.probes = 0
+
+    def probe(self):
+        time.sleep(0.1)
+        with self._lock:
+            self.probes += 1

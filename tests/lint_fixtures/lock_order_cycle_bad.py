@@ -1,0 +1,18 @@
+"""BAD: two lock orders across methods (lock-order-cycle)."""
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self.lock_src = threading.Lock()
+        self.lock_dst = threading.Lock()
+
+    def forward(self):
+        with self.lock_src:
+            with self.lock_dst:
+                pass
+
+    def backward(self):
+        with self.lock_dst:
+            with self.lock_src:     # opposite order: ABBA deadlock
+                pass

@@ -1,0 +1,18 @@
+"""GOOD twin: one global order, both paths follow it."""
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self.lock_src = threading.Lock()
+        self.lock_dst = threading.Lock()
+
+    def forward(self):
+        with self.lock_src:
+            with self.lock_dst:
+                pass
+
+    def backward(self):
+        with self.lock_src:
+            with self.lock_dst:
+                pass

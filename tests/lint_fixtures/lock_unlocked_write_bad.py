@@ -1,0 +1,16 @@
+"""BAD: attribute written with and without the lock
+(lock-unlocked-write)."""
+import threading
+
+
+class HitCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0
+
+    def bump(self):
+        with self._lock:
+            self.hits += 1
+
+    def reset(self):
+        self.hits = 0       # races with bump()'s locked increment

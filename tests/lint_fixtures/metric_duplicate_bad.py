@@ -1,0 +1,5 @@
+"""BAD: one name, two metric kinds (metric-duplicate)."""
+from paddle_tpu import observability as obs
+
+H = obs.histogram("serving_fixture_wait_seconds", "queue wait")
+G = obs.gauge("serving_fixture_wait_seconds", "queue wait, but a gauge")

@@ -1,0 +1,5 @@
+"""GOOD twin: distinct names per kind."""
+from paddle_tpu import observability as obs
+
+H = obs.histogram("serving_fixture_wait_seconds", "queue wait")
+G = obs.gauge("serving_fixture_waiting", "requests currently waiting")

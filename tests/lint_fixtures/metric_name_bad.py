@@ -1,0 +1,4 @@
+"""BAD: metric without a subsystem prefix (metric-name)."""
+from paddle_tpu import observability as obs
+
+REQS = obs.counter("fixture_requests_total", "requests served")

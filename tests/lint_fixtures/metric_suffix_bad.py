@@ -1,0 +1,4 @@
+"""BAD: counter without the _total unit suffix (metric-suffix)."""
+from paddle_tpu import observability as obs
+
+REQS = obs.counter("serving_fixture_requests", "requests served")

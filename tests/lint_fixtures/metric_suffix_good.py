@@ -1,0 +1,4 @@
+"""GOOD twin: counter carries _total."""
+from paddle_tpu import observability as obs
+
+REQS = obs.counter("serving_fixture_requests_total", "requests served")

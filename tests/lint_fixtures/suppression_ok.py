@@ -1,0 +1,7 @@
+"""A known-bad pattern silenced by an inline suppression comment."""
+import time
+
+
+def elapsed_since(t0):
+    # tpu-lint: disable=wall-clock-duration
+    return time.time() - t0

@@ -1,0 +1,6 @@
+"""BAD: duration measured with the wall clock (wall-clock-duration)."""
+import time
+
+
+def elapsed_since(t0):
+    return time.time() - t0

@@ -1,0 +1,10 @@
+"""GOOD twin: monotonic clock for durations, wall clock for stamps."""
+import time
+
+
+def elapsed_since(t0):
+    return time.perf_counter() - t0
+
+
+def created_stamp():
+    return int(time.time())

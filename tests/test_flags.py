@@ -1,0 +1,115 @@
+"""Unit tests for paddle_tpu.flags: set_flags type coercion/validation
+and strict bool env parsing."""
+from __future__ import annotations
+
+import pytest
+
+from paddle_tpu import flags as F
+from paddle_tpu.flags import FLAGS, define_flag, get_flags, set_flags
+
+# throwaway names registered inside individual tests; suppressed because
+# they are deliberately absent from paddle_tpu/flags.py
+# tpu-lint: disable=flag-undefined
+_ENV_INT = "FLAGS_test_env_seed_int"
+# tpu-lint: disable=flag-undefined
+_ENV_BOOL = "FLAGS_test_env_seed_bool"
+
+
+@pytest.fixture
+def restore_flags():
+    saved_flags = dict(FLAGS)
+    saved_defs = dict(F._DEFS)
+    yield
+    FLAGS.clear()
+    FLAGS.update(saved_flags)
+    F._DEFS.clear()
+    F._DEFS.update(saved_defs)
+
+
+# ------------------------------------------------------------- coercion
+def test_set_flags_coerces_string_to_int(restore_flags):
+    set_flags({"FLAGS_trace_buffer_size": "8192"})
+    assert FLAGS["FLAGS_trace_buffer_size"] == 8192
+    assert isinstance(FLAGS["FLAGS_trace_buffer_size"], int)
+
+
+def test_set_flags_coerces_int_to_float(restore_flags):
+    set_flags({"FLAGS_comm_timeout_seconds": 60})
+    assert FLAGS["FLAGS_comm_timeout_seconds"] == 60.0
+    assert isinstance(FLAGS["FLAGS_comm_timeout_seconds"], float)
+
+
+def test_set_flags_rejects_junk_with_flag_name_in_error(restore_flags):
+    with pytest.raises(TypeError, match="FLAGS_trace_buffer_size"):
+        set_flags({"FLAGS_trace_buffer_size": "not-a-number"})
+
+
+def test_set_flags_rejects_bool_for_numeric_flag(restore_flags):
+    with pytest.raises(TypeError, match="expects int, got bool"):
+        set_flags({"FLAGS_trace_buffer_size": True})
+
+
+def test_set_flags_rejects_unknown_flag():
+    with pytest.raises(ValueError, match="unknown flag"):
+        # tpu-lint: disable=flag-undefined
+        set_flags({"FLAGS_no_such_flag_anywhere": 1})
+
+
+def test_set_flags_bad_batch_is_atomic(restore_flags):
+    before = FLAGS["FLAGS_trace_buffer_size"]
+    with pytest.raises(TypeError):
+        set_flags({"FLAGS_trace_buffer_size": "1024",
+                   "FLAGS_comm_timeout_seconds": "junk"})
+    # the good entry must not have been applied
+    assert FLAGS["FLAGS_trace_buffer_size"] == before
+
+
+# ----------------------------------------------------------- bool rules
+@pytest.mark.parametrize("text,expected", [
+    ("1", True), ("true", True), ("yes", True), ("TRUE", True),
+    ("0", False), ("false", False), ("no", False), (" False ", False),
+])
+def test_set_flags_bool_canonical_spellings(restore_flags, text,
+                                            expected):
+    set_flags({"FLAGS_check_nan_inf": text})
+    assert FLAGS["FLAGS_check_nan_inf"] is expected
+
+
+@pytest.mark.parametrize("text", ["2", "on", "off", "y", "enabled", ""])
+def test_set_flags_bool_rejects_noncanonical(restore_flags, text):
+    with pytest.raises(ValueError, match="FLAGS_check_nan_inf"):
+        set_flags({"FLAGS_check_nan_inf": text})
+
+
+def test_set_flags_bool_rejects_truthy_objects(restore_flags):
+    with pytest.raises(ValueError):
+        set_flags({"FLAGS_check_nan_inf": [1]})
+
+
+# ---------------------------------------------------------- env seeding
+def test_define_flag_seeds_and_coerces_from_env(restore_flags,
+                                                monkeypatch):
+    monkeypatch.setenv(_ENV_INT, "123")
+    define_flag(_ENV_INT, 7, "throwaway (test only)")
+    assert FLAGS[_ENV_INT] == 123
+
+
+def test_define_flag_rejects_bad_bool_env_loudly(restore_flags,
+                                                 monkeypatch):
+    monkeypatch.setenv(_ENV_BOOL, "on")
+    with pytest.raises(ValueError, match="accepted"):
+        define_flag(_ENV_BOOL, False, "throwaway (test only)")
+
+
+def test_get_flags_single_key_and_list():
+    assert get_flags("FLAGS_log_level") == \
+        {"FLAGS_log_level": FLAGS["FLAGS_log_level"]}
+    got = get_flags(["FLAGS_log_level", "FLAGS_benchmark"])
+    assert set(got) == {"FLAGS_log_level", "FLAGS_benchmark"}
+
+
+def test_selected_devices_flag_is_registered():
+    # distributed.launch exports this into child env; it must be in the
+    # registry so flag-undefined stays meaningful
+    assert "FLAGS_selected_devices" in FLAGS
+    assert F._DEFS["FLAGS_selected_devices"][2]    # has help text

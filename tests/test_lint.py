@@ -1,0 +1,179 @@
+"""Tier-1 tests for the paddle_tpu.analysis static-analysis suite.
+
+Three layers:
+
+* fixture tests — every ``tests/lint_fixtures/*_bad.py`` trips exactly
+  its one rule and every ``*_good.py`` twin trips none;
+* gate test — the whole repo lints clean against the committed
+  ``tools/lint_baseline.json`` (no NEW findings) and finishes well
+  inside the 10s budget;
+* CLI tests — ``tools/lint.py`` exit codes and the baseline workflow,
+  driven in-process.
+"""
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
+BASELINE = os.path.join(REPO, "tools", "lint_baseline.json")
+
+sys.path.insert(0, REPO)
+
+from paddle_tpu.analysis import (ALL_RULES, Finding, load_baseline,  # noqa: E402
+                                 partition, run)
+
+
+def _lint_main():
+    """tools/lint.py's main(), loaded in-process (tools/ is not a
+    package)."""
+    spec = importlib.util.spec_from_file_location(
+        "_tpu_lint_cli", os.path.join(REPO, "tools", "lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main
+
+
+def _fixture_cases():
+    bad, good = [], []
+    for name in sorted(os.listdir(FIXTURES)):
+        if not name.endswith(".py"):
+            continue
+        if name.endswith("_bad.py"):
+            rule = name[:-len("_bad.py")].replace("_", "-")
+            bad.append((name, rule))
+        else:
+            good.append(name)
+    return bad, good
+
+
+_BAD, _GOOD = _fixture_cases()
+
+
+def test_fixture_corpus_is_complete():
+    # one bad fixture per rule (parse-error is synthesized by the
+    # runner, not a fixture), plus a good twin for each
+    covered = {rule for _, rule in _BAD}
+    assert covered == set(ALL_RULES) - {"parse-error"}
+    assert "suppression_ok.py" in _GOOD
+
+
+@pytest.mark.parametrize("name,rule", _BAD, ids=[n for n, _ in _BAD])
+def test_bad_fixture_trips_exactly_its_rule(name, rule):
+    findings = run([os.path.join(FIXTURES, name)], root=REPO)
+    assert findings, f"{name} tripped nothing"
+    assert {f.rule for f in findings} == {rule}, \
+        [f.render() for f in findings]
+
+
+@pytest.mark.parametrize("name", _GOOD)
+def test_good_fixture_trips_nothing(name):
+    findings = run([os.path.join(FIXTURES, name)], root=REPO)
+    assert not findings, [f.render() for f in findings]
+
+
+def test_inline_suppression_is_honored():
+    # suppression_ok.py is wall_clock_duration_bad.py plus the disable
+    # comment; without suppressions it would trip
+    path = os.path.join(FIXTURES, "suppression_ok.py")
+    assert "tpu-lint: disable=wall-clock-duration" in \
+        open(path).read()
+    assert run([path], root=REPO) == []
+
+
+# ------------------------------------------------------------------ gate
+def test_repo_lints_clean_against_baseline():
+    t0 = time.perf_counter()
+    findings = run(["paddle_tpu", "tools", "tests"], root=REPO)
+    elapsed = time.perf_counter() - t0
+    new, baselined = partition(findings, load_baseline(BASELINE))
+    assert not new, "NEW lint findings:\n" + \
+        "\n".join(f.render() for f in new)
+    assert elapsed < 10.0, f"lint took {elapsed:.1f}s (budget 10s)"
+
+
+def test_baseline_entries_carry_rule_and_location():
+    data = json.load(open(BASELINE))
+    assert data["findings"], "baseline exists but is empty"
+    for entry in data["findings"]:
+        assert entry["rule"] in ALL_RULES
+        assert entry["path"] and isinstance(entry["line"], int)
+        assert entry["fingerprint"]
+
+
+def test_runner_skips_fixture_directory():
+    findings = run(["tests"], root=REPO)
+    assert not any("lint_fixtures" in f.path for f in findings)
+
+
+def test_fingerprint_is_line_number_free():
+    a = Finding("metric-suffix", "x/y.py", 10, "msg")
+    b = Finding("metric-suffix", "x/y.py", 99, "msg")
+    c = Finding("metric-name", "x/y.py", 10, "msg")
+    assert a.fingerprint == b.fingerprint
+    assert a.fingerprint != c.fingerprint
+
+
+def test_rule_subset_filter():
+    path = os.path.join(FIXTURES, "wall_clock_duration_bad.py")
+    assert run([path], root=REPO, rules=["wall-clock-duration"])
+    assert run([path], root=REPO, rules=["jit-host-sync"]) == []
+    with pytest.raises(ValueError):
+        run([path], root=REPO, rules=["no-such-rule"])
+
+
+# ------------------------------------------------------------------- CLI
+def test_cli_default_run_is_green(capsys):
+    assert _lint_main()([]) == 0
+    assert "baselined" in capsys.readouterr().out
+
+
+def test_cli_list_rules(capsys):
+    assert _lint_main()(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ALL_RULES:
+        assert rule in out
+
+
+def test_cli_unknown_rule_is_usage_error(capsys):
+    assert _lint_main()(["--rules", "no-such-rule"]) == 2
+
+
+def test_cli_baseline_workflow(tmp_path, capsys):
+    bad = tmp_path / "span.py"
+    bad.write_text("import time\n\n\n"
+                   "def elapsed(t0):\n"
+                   "    return time.time() - t0\n")
+    bl = tmp_path / "baseline.json"
+    main = _lint_main()
+    # new finding, no baseline -> fail
+    assert main([str(bad), "--baseline", str(bl)]) == 1
+    # accept it deliberately
+    assert main([str(bad), "--baseline", str(bl),
+                 "--update-baseline"]) == 0
+    assert bl.exists()
+    # same finding is now baselined -> pass
+    assert main([str(bad), "--baseline", str(bl)]) == 0
+    # a second, different violation is still NEW -> fail
+    bad.write_text(bad.read_text() +
+                   "\n\ndef deadline():\n"
+                   "    return time.time() + 60\n")
+    assert main([str(bad), "--baseline", str(bl)]) == 1
+    # --no-baseline reports everything regardless
+    assert main([str(bad), "--baseline", str(bl),
+                 "--no-baseline"]) == 1
+
+
+def test_cli_json_output(capsys):
+    path = os.path.join(FIXTURES, "metric_suffix_bad.py")
+    rc = _lint_main()([path, "--json", "--no-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    data = json.loads(out)
+    assert [f["rule"] for f in data["findings"]] == ["metric-suffix"]

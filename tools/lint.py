@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""tpu-lint: run the paddle_tpu static-analysis suite.
+
+Usage:
+    python tools/lint.py [paths...]          # default: paddle_tpu tools
+    python tools/lint.py --json              # machine-readable output
+    python tools/lint.py --update-baseline   # accept current findings
+    python tools/lint.py --list-rules        # rule ids + descriptions
+    python tools/lint.py --rules jit-host-sync,lock-order-cycle ...
+
+Exit status is 0 when every finding is covered by the committed
+baseline (tools/lint_baseline.json), 1 when there are NEW findings, and
+2 on usage errors.  Suppress a single site inline with
+``# tpu-lint: disable=RULE`` (same line, or a standalone comment line
+directly above).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+from paddle_tpu.analysis import (ALL_RULES, load_baseline, partition,  # noqa: E402
+                                 render_json, render_text, run,
+                                 save_baseline)
+
+DEFAULT_PATHS = ["paddle_tpu", "tools"]
+DEFAULT_BASELINE = os.path.join(_REPO_ROOT, "tools",
+                                "lint_baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="lint.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to lint "
+                         f"(default: {' '.join(DEFAULT_PATHS)})")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as JSON")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule-id subset to run")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: tools/"
+                         "lint_baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline; report every finding")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write the current findings as the new "
+                         "baseline and exit 0")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="list rule ids and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        width = max(len(r) for r in ALL_RULES)
+        for rule in sorted(ALL_RULES):
+            print(f"{rule:<{width}}  {ALL_RULES[rule]}")
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+    paths = args.paths or DEFAULT_PATHS
+
+    try:
+        findings = run(paths, root=_REPO_ROOT, rules=rules)
+    except ValueError as e:
+        print(f"lint.py: {e}", file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        save_baseline(args.baseline, findings)
+        print(f"wrote {len(findings)} finding"
+              f"{'' if len(findings) == 1 else 's'} to "
+              f"{os.path.relpath(args.baseline, _REPO_ROOT)}")
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    new, baselined = partition(findings, baseline)
+
+    if args.json:
+        sys.stdout.write(render_json(new, baselined=len(baselined)))
+    else:
+        print(render_text(new, baselined=len(baselined)))
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
