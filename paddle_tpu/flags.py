@@ -131,3 +131,10 @@ define_flag("FLAGS_selected_devices", "",
 define_flag("FLAGS_serving_slo_objective", 0.99,
             "SLO objective (fraction of requests that must meet each "
             "target) — burn rate = violation rate / (1 - objective)")
+define_flag("FLAGS_resource_peak_tflops", 0.0,
+            "peak accelerator TFLOP/s for the resource tracker's MFU "
+            "estimate (0: look the device kind up in the built-in "
+            "table; unknown devices report mfu=null)")
+define_flag("FLAGS_resource_memory_poll_steps", 16,
+            "sample device memory_stats()/host RSS every N engine host "
+            "syncs (a host round-trip per device; 0 disables polling)")

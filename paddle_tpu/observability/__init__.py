@@ -34,12 +34,12 @@ from .tracing import (  # noqa: F401
     format_traceparent, parse_traceparent, tracer)
 
 __all__ = ["Counter", "FlightRecorder", "Gauge", "Histogram",
-           "MetricsRegistry", "Span", "SpanContext", "Tracer",
-           "default_registry", "counter", "gauge", "histogram",
+           "MetricsRegistry", "ResourceTracker", "Span", "SpanContext",
+           "Tracer", "default_registry", "counter", "gauge", "histogram",
            "retrace_log", "RetraceLog", "dump", "reset", "flight",
            "enable_event_sampling", "chrome_counter_events",
            "flight_recorder", "format_traceparent", "parse_traceparent",
-           "tracer"]
+           "resource_tracker", "tracer"]
 
 
 def counter(name, help_="", labelnames=()):
@@ -125,20 +125,22 @@ retrace_log = RetraceLog()
 
 
 def reset():
-    """Drop all metrics + retrace entries + spans + flight events
-    (tests / between runs)."""
+    """Drop all metrics + retrace entries + spans + flight events +
+    resource accounting (tests / between runs)."""
     default_registry().reset()
     retrace_log.clear()
     tracer().reset()
     flight_recorder().clear()
+    resource_tracker().reset()
 
 
 def dump(dir_=None) -> str | None:
     """Write the registry as ``metrics.prom`` + ``metrics.json``, the
     retrace log as ``retraces.json``, the span ring as ``trace.json``
     (chrome://tracing-loadable, with a parallel ``spans`` list for
-    programmatic consumers), and the flight-recorder ring as
-    ``flight.json`` into ``dir_`` (default: ``FLAGS_metrics_dir``).
+    programmatic consumers), the flight-recorder ring as
+    ``flight.json``, and the resource tracker's snapshot as
+    ``resources.json`` into ``dir_`` (default: ``FLAGS_metrics_dir``).
     Returns the directory, or None when no directory is configured."""
     if dir_ is None:
         from ..flags import FLAGS
@@ -165,4 +167,11 @@ def dump(dir_=None) -> str | None:
     with open(os.path.join(dir_, "flight.json"), "w") as f:
         json.dump({"capacity": fr.capacity, "events": fr.snapshot()},
                   f, indent=2)
+    with open(os.path.join(dir_, "resources.json"), "w") as f:
+        json.dump(resource_tracker().snapshot(), f, indent=2)
     return dir_
+
+
+# imported last: resources.py reads `retrace_log` and the registry the
+# lines above set up
+from .resources import ResourceTracker, resource_tracker  # noqa: E402,F401

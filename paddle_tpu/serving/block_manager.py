@@ -68,6 +68,18 @@ _M_PREFIX_COW = _obs.counter(
 _M_CACHED_PAGES = _obs.gauge(
     "serving_prefix_cached_pages",
     "pages currently registered in the prefix index (incl. shared)")
+_M_PAGES_FREE = _obs.gauge(
+    "serving_pages_free", "KV pages on the free list (parked cached "
+    "pages are reusable but counted separately)")
+_M_FRAG = _obs.gauge(
+    "serving_page_fragmentation_ratio",
+    "fraction of idle pages (free + parked cached) the largest waiting "
+    "request cannot use (0: nothing waiting or all idle pages usable; "
+    "1: the queue head cannot be placed at all)")
+_M_PAGES_ALLOC = _obs.counter(
+    "serving_pages_allocated_total",
+    "fresh page acquisitions (free-list pops + LRU evictions; shared "
+    "prefix-cache pages are not re-acquired)")
 
 _ROOT = -1          # chain parent of the first chunk of every prompt
 
@@ -110,8 +122,9 @@ class BlockManager:
         self.prefix_evictions = 0
         self.cow_copies = 0
         self.cached_tokens = 0
+        self.pages_allocated = 0    # mirror of serving_pages_allocated_total
         _M_PAGES_TOTAL.set(self.num_pages)
-        _M_PAGES_IN_USE.set(0)
+        self._update_pool_gauges()
 
     # ------------------------------------------------------------- sizing
     def pages_needed(self, prompt_len: int, max_new_tokens: int) -> int:
@@ -154,7 +167,7 @@ class BlockManager:
         self._meta[seq_id] = {"cached_len": 0, "cow_src": None}
         _obs.flight("blocks", "alloc_seq", seq=seq_id, pages=len(pages),
                     shared=0, cached_tokens=0, cow=False)
-        _M_PAGES_IN_USE.set(self.pages_in_use)
+        self._update_pool_gauges()
         return list(pages)
 
     def allocate_seq(self, seq_id: int, prompt, max_new_tokens: int):
@@ -206,7 +219,7 @@ class BlockManager:
         if fresh is None:
             for p in matched:
                 self._decref(p)
-            _M_PAGES_IN_USE.set(self.pages_in_use)
+            self._update_pool_gauges()
             return None
         for p in fresh:
             self._ref[p] = 1
@@ -269,7 +282,7 @@ class BlockManager:
                 self._tail_parent[page] = parent
                 self._children.setdefault(parent, set()).add(page)
         _M_CACHED_PAGES.set(self.cached_pages)
-        _M_PAGES_IN_USE.set(self.pages_in_use)
+        self._update_pool_gauges()
         return list(pages)
 
     def seq_meta(self, seq_id: int) -> dict:
@@ -288,10 +301,87 @@ class BlockManager:
         if pages:
             for p in pages:
                 self._decref(p)
-        _M_PAGES_IN_USE.set(self.pages_in_use)
+        self._update_pool_gauges()
 
     def pages_of(self, seq_id: int):
         return list(self._tables.get(seq_id, ()))
+
+    # --------------------------------------------------- pool accounting
+    def _update_pool_gauges(self):
+        _M_PAGES_IN_USE.set(self.pages_in_use)
+        _M_PAGES_FREE.set(len(self._free))
+
+    def pool_accounting(self) -> dict:
+        """Exact pool census from three independent structures.  Every
+        allocatable page is in exactly one of: referenced by a live
+        sequence (``live``), parked refcount-0 in the prefix LRU
+        (``cached``), or on the free list (``free``) — ``leak`` is the
+        shortfall and must be 0 (asserted by tests, surfaced here so a
+        future accounting bug shows up in /debug/resources, not as a
+        slow pool shrink)."""
+        live = len(self._ref)
+        cached = len(self._lru)
+        free = len(self._free)
+        return {"live": live, "cached": cached, "free": free,
+                "total": self.num_pages,
+                "allocated_total": self.pages_allocated,
+                "leak": self.num_pages - (live + cached + free)}
+
+    def _reclaimable(self) -> int:
+        """Parked LRU pages an allocator under pressure could actually
+        recycle: leaf-first eviction frees a parked page only once every
+        cached child is gone, so a parked parent whose children include
+        a *live* page is pinned.  Computed as a leaf-peeling fixpoint
+        (peel parked pages whose cached children are all already
+        peeled)."""
+        parked = set(self._lru)
+        reclaimed: set[int] = set()
+        changed = True
+        while changed:
+            changed = False
+            for page in parked - reclaimed:
+                kids = self._children.get(page, set())
+                # children outside `parked` are live (refcounted) and pin
+                # this page; parked children must peel first
+                if all(k in reclaimed for k in kids):
+                    reclaimed.add(page)
+                    changed = True
+        return len(reclaimed)
+
+    def fragmentation(self, need: int | None = None) -> float:
+        """Fraction of *idle* pages (free + parked cached) that cannot
+        serve a waiting request of ``need`` pages.  0.0 when nothing is
+        waiting or every idle page is usable; 1.0 when the request
+        cannot be placed at all even after evicting every reclaimable
+        parked page."""
+        idle = len(self._free) + len(self._lru)
+        if not need or idle == 0:
+            return 0.0
+        usable = len(self._free) + self._reclaimable()
+        if need <= usable:
+            unusable = idle - usable      # pinned parked pages only
+        else:
+            unusable = idle               # request can't be placed
+        return unusable / idle
+
+    def record_fragmentation(self, need: int | None) -> float:
+        """Compute :meth:`fragmentation` for the queue head's demand and
+        publish it on the ``serving_page_fragmentation_ratio`` gauge."""
+        ratio = self.fragmentation(need)
+        _M_FRAG.set(ratio)
+        return ratio
+
+    def seq_footprint(self, seq_id: int) -> dict:
+        """Per-request page footprint: total pages in the block table,
+        split into ``shared`` (refcount > 1, also held by another live
+        sequence or chain) and ``exclusive``, plus the admission plan's
+        ``cached_len`` tokens."""
+        pages = self._tables.get(seq_id, ())
+        shared = sum(1 for p in pages if self._ref.get(p, 0) > 1)
+        meta = self._meta.get(seq_id, {})
+        return {"pages": len(pages), "shared": shared,
+                "exclusive": len(pages) - shared,
+                "cached_len": int(meta.get("cached_len", 0))}
 
     # ------------------------------------------------- refcount internals
     def _incref(self, page: int):
@@ -323,6 +413,9 @@ class BlockManager:
                 # rollback: nothing partially held on failure
                 self._free = got + self._free
                 return None
+        if got:
+            self.pages_allocated += len(got)
+            _M_PAGES_ALLOC.inc(len(got))
         return got
 
     def _evict_one(self) -> bool:

@@ -49,6 +49,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import observability as _obs
+from ..flags import FLAGS
+from ..observability.resources import record_compile, resource_tracker
 from ..models.generation import (GenerationConfig, _decode_layer_paged,
                                  _layer_weights, _mm, _prefill_layer,
                                  _qkv_proj, _rope_at)
@@ -85,6 +87,12 @@ _M_HOST_SYNCS = _obs.counter(
     "path), 'logits' = [slots, V] logits fetch (only when an active "
     "request samples), 'prefill' = first-token logits at admission",
     ("kind",))
+_M_PHASE_SECONDS = _obs.counter(
+    "serving_step_phase_seconds_total",
+    "engine wall seconds by phase: 'prefill' jit calls (incl. CoW "
+    "copies), 'decode' step dispatch, 'host_sync' blocking ring "
+    "fetches — the resource tracker's tokens/s and MFU denominator",
+    ("phase",))
 
 
 def _serving_hists():
@@ -193,8 +201,13 @@ class Engine:
         self._last_logits = None        # device handle, fetched lazily
 
         self.decode_traces = 0      # python-side mirror of _M_STEP_TRACES
+        self.decode_steps = 0       # mirror of serving_decode_steps_total
         self.host_syncs = 0         # ring fetches (1 per sync_interval)
         self.logit_fetches = 0      # [slots, V] transfers (sampling only)
+        # per-phase wall seconds (mirror of serving_step_phase_seconds_
+        # total; resource_snapshot() reports them per engine)
+        self.timings = {"prefill_s": 0.0, "decode_s": 0.0,
+                        "host_sync_s": 0.0}
         # monotonically increasing iteration counter.  The serving
         # watchdog reads it lock-free (comparing against active_count)
         # to detect a wedged decode loop — never reset.
@@ -222,6 +235,18 @@ class Engine:
             lambda kp, vp, src, dst: (kp.at[:, dst].set(kp[:, src]),
                                       vp.at[:, dst].set(vp[:, src])),
             donate_argnums=(0, 1))
+        self._copy_page_compiled = False    # compile-ledger first-call
+
+        # resource tracker: model size + device kind feed the MFU
+        # estimate (tokens/s * 2 * n_params / peak_flops)
+        n_params = sum(int(np.prod(v.shape))
+                       for v in state.values() if hasattr(v, "shape"))
+        try:
+            device_kind = jax.devices()[0].device_kind
+        except Exception:
+            device_kind = None
+        resource_tracker().set_model(n_params=n_params,
+                                     device_kind=device_kind)
 
     # ------------------------------------------------------ jitted bodies
     def _build_step(self):
@@ -461,36 +486,53 @@ class Engine:
         if meta["cow_src"] is not None:
             # copy-on-write: duplicate the matching tail page into this
             # request's own tail before any of its writes land there
+            cow_fresh = not self._copy_page_compiled
+            cow_t0 = time.perf_counter()
             self.kpool, self.vpool = self._copy_page_fn(
                 self.kpool, self.vpool,
                 jnp.asarray(meta["cow_src"], jnp.int32),
                 jnp.asarray(int(row[cached // ps]), jnp.int32))
+            if cow_fresh:
+                self._copy_page_compiled = True
+                record_compile("copy_page", cow_t0,
+                               signature=f"pool={self.kpool.shape}")
         if cached == 0:
             bucket = -(-plen // ps) * ps
             ids = np.zeros((1, bucket), np.int32)
             ids[0, :plen] = req.prompt
+            jit_fresh = bucket not in self._prefill_fns
             fn = self._prefill_fn(bucket)
+            jit_t0 = time.perf_counter()
             self.kpool, self.vpool, logits = fn(
                 self.state, jnp.asarray(ids),
                 jnp.asarray([plen], jnp.int32),
                 jnp.asarray(row[:bucket // ps]),
                 self.kpool, self.vpool, self._cos, self._sin)
+            if jit_fresh:
+                record_compile(f"prefill[{bucket}]", jit_t0,
+                               signature=f"ids=[1,{bucket}]")
         else:
             suffix = plen - cached
             bucket = -(-suffix // ps) * ps
             ids = np.zeros((1, bucket), np.int32)
             ids[0, :suffix] = req.prompt[cached:]
+            jit_fresh = bucket not in self._prefill_cached_fns
             fn = self._prefill_cached_fn(bucket)
+            jit_t0 = time.perf_counter()
             self.kpool, self.vpool, logits = fn(
                 self.state, jnp.asarray(ids),
                 jnp.asarray([suffix], jnp.int32),
                 jnp.asarray(cached, jnp.int32), jnp.asarray(row),
                 self.kpool, self.vpool, self._cos, self._sin)
+            if jit_fresh:
+                record_compile(f"prefill_cached[{bucket}]", jit_t0,
+                               signature=f"ids=[1,{bucket}]")
         req.num_cached_tokens = cached
         _M_HOST_SYNCS.labels("prefill").inc()
         tok = self._pick_token(req, np.asarray(logits)[0])
         now = self._clock()
         self._ttft.observe(now - req.arrival_time)
+        self._note_phase("prefill", time.perf_counter() - t0)
         _obs.tracer().record_span(
             "engine.prefill", t0, time.perf_counter(),
             parent=req.root_span,
@@ -523,11 +565,20 @@ class Engine:
             self._seg_steps = 0
         self._seg_steps += 1
         reqs = [(s, self.scheduler.slots[s]) for s in active]
+        traces_before = self.decode_traces
+        step_t0 = time.perf_counter()
         (self.kpool, self.vpool, self._pos_dev, self._tok_dev,
          self._ring_dev, self._ridx_dev, logits) = self._step_fn(
             self.state, self.kpool, self.vpool, self._table_dev,
             self._pos_dev, self._tok_dev, self._active_dev,
             self._ring_dev, self._ridx_dev, self._cos, self._sin)
+        if self.decode_traces != traces_before:
+            record_compile(
+                "decode_step", step_t0,
+                signature=f"slots={self.max_slots} "
+                          f"ring={self.sync_interval}")
+        self._note_phase("decode", time.perf_counter() - step_t0)
+        self.decode_steps += 1
         _M_STEPS.inc()
         self._pages_hist.observe(self.blocks.pages_in_use)
         for slot in active:
@@ -545,9 +596,15 @@ class Engine:
     def _sync(self):
         """Drain the device token ring: ONE [sync_interval, slots] int32
         transfer covers every decode step since the previous sync."""
+        sync_t0 = time.perf_counter()
         ring = np.asarray(self._ring_dev)
+        sync_s = time.perf_counter() - sync_t0
         self.host_syncs += 1
+        self._note_phase("host_sync", sync_s)
         _M_HOST_SYNCS.labels("ring").inc()
+        poll = int(FLAGS.get("FLAGS_resource_memory_poll_steps") or 0)
+        if poll > 0 and self.host_syncs % poll == 0:
+            resource_tracker().sample_memory()
         if self._seg_span is not None:
             # the ring fetch above blocked on the device — the segment
             # span ends here, covering dispatch through host sync
@@ -555,7 +612,7 @@ class Engine:
             self._seg_span.end()
             self._seg_span = None
         _obs.flight("engine", "host_sync", rows=len(self._pending),
-                    steps=self._seg_steps)
+                    steps=self._seg_steps, sync_s=round(sync_s, 6))
         sample_t0 = None
         logits_np = None
         now = self._clock()
@@ -596,9 +653,19 @@ class Engine:
             val = jnp.asarray([t for _, t in corrections], jnp.int32)
             self._tok_dev = self._tok_dev.at[idx].set(val)
 
+    def _note_phase(self, phase: str, seconds: float):
+        """Charge engine wall time to a phase: the per-engine mirror,
+        the serving_step_phase_seconds_total counter, and the process
+        tracker's throughput denominator."""
+        seconds = max(float(seconds), 0.0)
+        self.timings[phase + "_s"] += seconds
+        _M_PHASE_SECONDS.labels(phase).inc(seconds)
+        resource_tracker().note_phase(phase, seconds)
+
     def _emit(self, slot: int, req: Request, tok: int, now: float):
         req._emit(tok, now)
         _M_TOKENS.inc()
+        resource_tracker().note_tokens(1)
         eos = req.gen.eos_token_id
         if req.num_generated >= req.gen.max_new_tokens:
             self._finalize(req, "length", now)
@@ -668,6 +735,7 @@ class Engine:
         self._e2e.observe(now - req.arrival_time)
         _M_REQUESTS.labels(reason).inc()
         _M_FINISH.labels(reason).inc()
+        resource_tracker().note_finish(reason, req.num_generated)
         if self.slo is not None:
             self.slo.observe(req, now)
         _obs.flight("engine", "finish", req=req.id, reason=reason,
@@ -711,8 +779,44 @@ class Engine:
             "cached_pages": b.cached_pages,
             "host_syncs": self.host_syncs,
             "logit_fetches": self.logit_fetches,
+            "decode_steps": self.decode_steps,
+            "pages_allocated": b.pages_allocated,
+            "timings": {k: round(v, 6) for k, v in self.timings.items()},
             "progress": self.progress,
             "slo": self.slo.stats() if self.slo is not None else None,
+        }
+
+    def resource_snapshot(self) -> dict:
+        """Engine-local half of ``GET /debug/resources``: the exact
+        pool census (live/cached/free with a leak check), per-resident-
+        request page footprints, fragmentation against the queue head,
+        and the phase timing breakdown.  The process-wide tracker
+        snapshot (memory/compiles/goodput) complements it."""
+        b = self.blocks
+        head_need = None
+        if self.scheduler.queue:
+            head = self.scheduler.queue[0]
+            head_need = b.pages_needed(head.prompt.size,
+                                       head.gen.max_new_tokens)
+        requests = {}
+        for slot, req in enumerate(self.scheduler.slots):
+            if req is not None:
+                fp = b.seq_footprint(req.id)
+                fp["slot"] = slot
+                requests[str(req.id)] = fp
+        pool = b.pool_accounting()
+        pool["fragmentation_ratio"] = round(b.fragmentation(head_need), 6)
+        return {
+            "pool": pool,
+            "requests": requests,
+            "timings": {k: round(v, 6) for k, v in self.timings.items()},
+            "counters": {
+                "decode_steps": self.decode_steps,
+                "decode_traces": self.decode_traces,
+                "host_syncs": self.host_syncs,
+                "logit_fetches": self.logit_fetches,
+                "pages_allocated": b.pages_allocated,
+            },
         }
 
 

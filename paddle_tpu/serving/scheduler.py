@@ -135,6 +135,14 @@ class Scheduler:
 
         _M_QUEUE_DEPTH.set(len(self.queue))
         _M_ACTIVE.set(self.active_count)
+        # fragmentation against the queue head's demand: idle pages the
+        # blocked request cannot use (0.0 when nothing waits)
+        head_need = None
+        if self.queue:
+            head = self.queue[0]
+            head_need = self.blocks.pages_needed(head.prompt.size,
+                                                 head.gen.max_new_tokens)
+        self.blocks.record_fragmentation(head_need)
         return admitted
 
     # ---------------------------------------------------------- eviction

@@ -14,7 +14,9 @@ service with zero new dependencies (stdlib ``http.server`` only):
     protocol answer, never a hang or a 500); while draining it answers
     ``503``.
   * ``GET /healthz`` (engine stats + drain state), ``GET /metrics``
-    (the observability registry's Prometheus export), ``POST /drain`` /
+    (the observability registry's Prometheus export),
+    ``GET /debug/resources`` (resource-tracker snapshot + engine pool
+    census), ``POST /drain`` /
     ``POST /resume`` (rolling restarts), and graceful drain on SIGTERM:
     in-flight streams finish, queued requests are failed fast, then the
     listener closes.
@@ -421,6 +423,15 @@ class _Handler(BaseHTTPRequestHandler):
                              (_obs.tracer().chrome_events()
                               + _obs.chrome_counter_events())},
                        "/debug/trace")
+        elif self.path == "/debug/resources":
+            # process tracker (memory/compiles/goodput/throughput) plus
+            # the engine-local pool census; the engine half walks
+            # scheduler state, so it runs under the worker lock
+            snap = _obs.resource_tracker().snapshot()
+            worker = self.server.worker
+            with worker.lock:
+                snap["engine"] = worker.engine.resource_snapshot()
+            self._json(200, snap, "/debug/resources")
         else:
             self._error(404, f"no route {self.path}", self.path)
 

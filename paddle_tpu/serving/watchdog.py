@@ -122,6 +122,12 @@ class Watchdog:
         the engine), thread stacks (interpreter-level), and plain
         attribute reads — NOT ``engine.stats()``, which walks scheduler
         structures the stuck thread may be mutating."""
+        try:
+            # tracker takes only its own lock (+ registry read-back) —
+            # safe against the wedged engine, same as the flight ring
+            resources = _obs.resource_tracker().snapshot()
+        except Exception:
+            resources = None
         report = {
             "stalled_for_s": round(stalled_for, 3),
             "progress": progress,
@@ -129,6 +135,7 @@ class Watchdog:
             "threads": self._thread_stacks(),
             "flight": {"capacity": _obs.flight_recorder().capacity,
                        "events": _obs.flight_recorder().snapshot()},
+            "resources": resources,
         }
         dir_ = self._dump_dir
         if dir_ is None:

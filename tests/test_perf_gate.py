@@ -1,0 +1,127 @@
+"""Perf-regression gate (tools/perf_gate.py).
+
+The gate runs small deterministic serve scenarios and compares
+efficiency *counters* (never wall time) against the committed
+tools/perf_baseline.json.  Tier-1 runs the cheap ``steady_decode``
+scenario end-to-end: exit 0 against the committed baseline, exit 1
+with the forced-extra-retrace injection, exit 2 on usage errors, and
+an --update-baseline round trip in a temp file.
+"""
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, "tools", "perf_baseline.json")
+
+
+def _gate():
+    spec = importlib.util.spec_from_file_location(
+        "_tpu_perf_gate_cli", os.path.join(REPO, "tools", "perf_gate.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def gate():
+    return _gate()
+
+
+def test_committed_baseline_covers_every_scenario(gate):
+    doc = json.loads(open(BASELINE).read())
+    assert doc["version"] == 1
+    assert sorted(doc["scenarios"]) == sorted(gate.SCENARIOS)
+    # every baselined counter has a comparison direction
+    for counters in doc["scenarios"].values():
+        for name in counters:
+            assert name in gate.DIRECTIONS, name
+
+
+def test_list_scenarios_exits_zero(gate, capsys):
+    assert gate.main(["--list-scenarios"]) == 0
+    out = capsys.readouterr().out
+    for name in gate.SCENARIOS:
+        assert name in out
+
+
+def test_unknown_scenario_is_usage_error(gate, capsys):
+    assert gate.main(["--scenarios", "nope"]) == 2
+    assert "unknown scenario" in capsys.readouterr().err
+
+
+def test_missing_baseline_is_usage_error(gate, tmp_path, capsys):
+    rc = gate.main(["--scenarios", "steady_decode",
+                    "--baseline", str(tmp_path / "absent.json")])
+    assert rc == 2
+    assert "--update-baseline" in capsys.readouterr().err
+
+
+def test_gate_passes_against_committed_baseline(gate, capsys):
+    """steady_decode's counters must match the committed baseline —
+    the same check CI runs over all scenarios."""
+    rc = gate.main(["--scenarios", "steady_decode", "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["regressions"] == []
+    sd = doc["scenarios"]["steady_decode"]
+    assert sd["decode_traces"] == 1
+    assert sd["goodput_ratio"] == 1.0
+    committed = json.loads(open(BASELINE).read())["scenarios"]
+    assert sd == committed["steady_decode"]
+
+
+def test_injected_retrace_fails_the_gate(gate, capsys):
+    rc = gate.main(["--scenarios", "steady_decode", "--inject-retrace"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "REGRESSION steady_decode.decode_traces" in out
+
+
+def test_update_baseline_round_trip(gate, tmp_path, capsys):
+    path = str(tmp_path / "baseline.json")
+    assert gate.main(["--scenarios", "steady_decode",
+                      "--update-baseline", "--baseline", path]) == 0
+    doc = json.loads(open(path).read())
+    assert doc["version"] == 1
+    assert set(doc["scenarios"]) == {"steady_decode"}
+    # deterministic counters: a second run gates clean vs its own write,
+    # reusing the fresh baseline without touching the engines again
+    results = {"steady_decode": dict(doc["scenarios"]["steady_decode"])}
+    regressions, improvements = gate.compare(
+        results, gate.load_baseline(path))
+    assert regressions == [] and improvements == []
+
+
+def test_compare_directions(gate):
+    baseline = {"s": {"decode_traces": 2, "prefix_hit_rate": 0.5,
+                      "cow_copies": 1}}
+    # equal on every axis -> clean
+    reg, imp = gate.compare({"s": {"decode_traces": 2,
+                                   "prefix_hit_rate": 0.5,
+                                   "cow_copies": 1}}, baseline)
+    assert reg == [] and imp == []
+    # improvements pass but are reported
+    reg, imp = gate.compare({"s": {"decode_traces": 1,
+                                   "prefix_hit_rate": 0.75,
+                                   "cow_copies": 1}}, baseline)
+    assert reg == []
+    assert {(e["scenario"], e["counter"]) for e in imp} == {
+        ("s", "decode_traces"), ("s", "prefix_hit_rate")}
+    # regressions on each direction, including exact-mismatch downward
+    reg, _ = gate.compare({"s": {"decode_traces": 3,
+                                 "prefix_hit_rate": 0.25,
+                                 "cow_copies": 0}}, baseline)
+    assert {e["counter"] for e in reg} == {"decode_traces",
+                                           "prefix_hit_rate",
+                                           "cow_copies"}
+    # a counter the baseline has never seen fails closed
+    reg, _ = gate.compare({"s": {"decode_traces": 2,
+                                 "prefix_hit_rate": 0.5,
+                                 "cow_copies": 1,
+                                 "new_counter": 7}},
+                          baseline)
+    assert any(e["counter"] == "new_counter" and "baseline" in e["why"]
+               for e in reg)

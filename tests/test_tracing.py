@@ -729,8 +729,10 @@ class TestMetricsReport:
             "type": "counter", "help": "", "series":
             [{"labels": {}, "value": 12.0}]}}
         (tmp_path / "metrics.json").write_text(json.dumps(old))
-        metrics, retraces, trace, flight, _ = mod._load(str(tmp_path))
+        metrics, retraces, trace, flight, resources, _ = \
+            mod._load(str(tmp_path))
         assert retraces is None and trace is None and flight is None
+        assert resources is None
         text = mod.report(metrics, retraces, trace, flight)
         assert "serving_tokens_total" in text
         assert "SLO" not in text and "Tracing" not in text
@@ -741,7 +743,7 @@ class TestMetricsReport:
         (tmp_path / "metrics.json").write_text("{}")
         (tmp_path / "trace.json").write_text("{not json")
         (tmp_path / "flight.json").write_text("")
-        _, _, trace, flight, _ = mod._load(str(tmp_path))
+        _, _, trace, flight, _, _ = mod._load(str(tmp_path))
         assert trace is None and flight is None
 
     def test_renders_slo_and_tracing_sections(self, tmp_path):

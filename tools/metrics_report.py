@@ -50,7 +50,8 @@ def _load(path):
     retraces = _read_json(os.path.join(dir_, "retraces.json"))
     trace = _read_json(os.path.join(dir_, "trace.json"))
     flight = _read_json(os.path.join(dir_, "flight.json"))
-    return metrics, retraces, trace, flight, prom_path
+    resources = _read_json(os.path.join(dir_, "resources.json"))
+    return metrics, retraces, trace, flight, resources, prom_path
 
 
 def _fmt_value(v):
@@ -344,7 +345,90 @@ def _tracing_section(trace, flight):
     return "\n".join(["Tracing"] + lines)
 
 
-def report(metrics, retraces, trace=None, flight=None):
+def _fmt_bytes(n):
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return str(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.4g}{unit}"
+        n /= 1024
+    return f"{n:.4g}TiB"
+
+
+def _resources_section(resources):
+    """Resource-observatory summary from resources.json (HBM peak,
+    pool census + fragmentation, compile seconds by jit, goodput,
+    tokens/s + MFU) — older dumps without the file produce no section,
+    and partial payloads render what they have."""
+    if not isinstance(resources, dict):
+        return None
+    lines = ["Resources"]
+    mem = resources.get("memory") or {}
+    for dev, entry in sorted((mem.get("devices") or {}).items()):
+        if not isinstance(entry, dict):
+            continue
+        parts = []
+        if "bytes_in_use" in entry:
+            parts.append(f"in-use {_fmt_bytes(entry['bytes_in_use'])}")
+        if "peak_bytes_in_use" in entry:
+            parts.append(f"peak {_fmt_bytes(entry['peak_bytes_in_use'])}")
+        if parts:
+            lines.append(f"  {dev}: " + ", ".join(parts))
+    if mem.get("host_rss_bytes"):
+        lines.append(f"  host RSS: {_fmt_bytes(mem['host_rss_bytes'])} "
+                     f"({mem.get('samples', 0)} samples)")
+    pool = resources.get("pool") or {}
+    if pool.get("total"):
+        lines.append(
+            f"  KV pool: {_fmt_value(pool.get('in_use', 0))} in use / "
+            f"{_fmt_value(pool.get('cached', 0))} cached / "
+            f"{_fmt_value(pool.get('free', 0))} free of "
+            f"{_fmt_value(pool['total'])} pages, fragmentation "
+            f"{100.0 * float(pool.get('fragmentation_ratio') or 0):.1f}%")
+    comp = resources.get("compiles") or {}
+    jits = comp.get("jits") or {}
+    if jits:
+        rows = [(name, e.get("count", 0), f"{e.get('seconds', 0):.3g}s")
+                for name, e in sorted(
+                    jits.items(),
+                    key=lambda kv: -(kv[1].get("seconds") or 0))
+                if isinstance(e, dict)]
+        lines.append(f"  {comp.get('total_compiles', len(rows))} jit "
+                     f"compiles, {comp.get('total_seconds', 0):.3g}s "
+                     "estimated (first-call timings)")
+        lines.append(_table(rows, ("jit", "compiles", "seconds")))
+    eager = comp.get("eager_by_op") or {}
+    storms = {k: v for k, v in eager.items() if v > 3}
+    if storms:
+        lines.append("  eager retrace storms: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(storms.items())))
+    good = resources.get("goodput") or {}
+    if good.get("ratio") is not None:
+        useful = good.get("useful_tokens", 0)
+        wasted = good.get("wasted_tokens", 0)
+        lines.append(
+            f"  goodput: {100.0 * float(good['ratio']):.1f}% "
+            f"({_fmt_value(useful)} useful / {_fmt_value(wasted)} "
+            "wasted tokens)")
+        finishes = good.get("finishes") or {}
+        if finishes:
+            lines.append("  finishes: " + ", ".join(
+                f"{k}={_fmt_value(v)}"
+                for k, v in sorted(finishes.items())))
+    tput = resources.get("throughput") or {}
+    if tput.get("tokens"):
+        line = (f"  throughput: {_fmt_value(tput['tokens'])} tokens, "
+                f"{tput.get('tokens_per_s', 0):.4g} tok/s")
+        if tput.get("mfu") is not None:
+            line += (f", MFU {100.0 * float(tput['mfu']):.2f}% "
+                     f"({tput.get('device_kind', '?')})")
+        lines.append(line)
+    return "\n".join(lines) if len(lines) > 1 else None
+
+
+def report(metrics, retraces, trace=None, flight=None, resources=None):
     simple_rows = {"counter": [], "gauge": []}
     hist_blocks = []
     for name, entry in sorted(metrics.items()):
@@ -375,6 +459,9 @@ def report(metrics, retraces, trace=None, flight=None):
     tracing = _tracing_section(trace, flight)
     if tracing:
         out += [tracing, ""]
+    res = _resources_section(resources)
+    if res:
+        out += [res, ""]
     if retraces and retraces.get("entries"):
         entries = sorted(retraces["entries"],
                          key=lambda e: (-e["count"], e["op"]))
@@ -397,14 +484,15 @@ def main(argv=None):
     ap.add_argument("--prom", action="store_true",
                     help="print the raw Prometheus text export")
     args = ap.parse_args(argv)
-    metrics, retraces, trace, flight, prom_path = _load(args.path)
+    metrics, retraces, trace, flight, resources, prom_path = \
+        _load(args.path)
     if args.prom:
         if not os.path.exists(prom_path):
             sys.exit(f"metrics_report: no metrics.prom at {prom_path!r}")
         with open(prom_path) as f:
             print(f.read(), end="")
         return 0
-    print(report(metrics, retraces, trace, flight))
+    print(report(metrics, retraces, trace, flight, resources))
     return 0
 
 

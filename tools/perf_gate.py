@@ -1,0 +1,343 @@
+#!/usr/bin/env python
+"""perf-gate: deterministic serving-efficiency regression gate.
+
+Runs small serve scenarios on a tiny model and gates on **counters**
+(retraces, host syncs per step, logits transfers, pages per token,
+prefix hit rate, goodput ratio) — never wall time, so the gate is
+stable on CPU under tier-1.
+
+Usage:
+    python tools/perf_gate.py                    # gate vs committed baseline
+    python tools/perf_gate.py --json             # machine-readable output
+    python tools/perf_gate.py --update-baseline  # accept current counters
+    python tools/perf_gate.py --scenarios steady_decode,prefix_cache
+    python tools/perf_gate.py --list-scenarios
+
+Exit status mirrors tools/lint.py: 0 when every counter is within its
+baseline (counters may *improve*: fewer retraces / higher hit rate pass
+and are reported as improvements — tighten with ``--update-baseline``),
+1 on a regression or a counter with no baseline entry, 2 on usage
+errors (unknown scenario, missing baseline file).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+DEFAULT_BASELINE = os.path.join(_REPO_ROOT, "tools",
+                                "perf_baseline.json")
+
+# comparison direction per counter: "low" = current <= baseline passes,
+# "high" = current >= baseline passes, "exact" = must match
+DIRECTIONS = {
+    "decode_traces": "low",
+    "prefill_compiles": "low",
+    "host_syncs": "low",
+    "host_syncs_per_decode_step": "low",
+    "logits_fetches": "low",
+    "pages_per_token": "low",
+    "pages_allocated": "low",
+    "cow_copies": "exact",
+    "prefix_hit_rate": "high",
+    "cached_tokens": "high",
+    "steps_per_sync": "high",
+    "goodput_ratio": "high",
+}
+
+
+def _force_cpu():
+    """The gate's counters are platform-independent, but CPU is the
+    only backend tier-1 guarantees — never touch an accelerator."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass            # backend already initialized (e.g. under pytest)
+
+
+def _engine(**kw):
+    """Fresh tiny model + engine per scenario: counters are read from
+    the engine's own python mirrors, so scenarios never see each
+    other's (or the host process's) metrics."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import create_engine
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                      intermediate_size=64, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=128)
+    return create_engine(LlamaForCausalLM(cfg), **kw)
+
+
+def _gen(max_new_tokens):
+    from paddle_tpu.models.generation import GenerationConfig
+    return GenerationConfig(max_new_tokens=max_new_tokens)
+
+
+def _goodput(reqs) -> float:
+    useful = sum(r.num_generated for r in reqs
+                 if r.finish_reason in ("length", "eos"))
+    total = sum(r.num_generated for r in reqs)
+    return round(useful / total, 6) if total else 1.0
+
+
+def _reinject_retrace(eng):
+    """Test hook: rebuild the decode-step jit so the next decode call
+    traces again — the exact regression serving_decode_step_traces_total
+    exists to catch."""
+    import jax
+    eng._step_fn = jax.jit(eng._build_step(),
+                           donate_argnums=(1, 2, 4, 5, 7, 8))
+
+
+def scenario_steady_decode(inject_retrace=False) -> dict:
+    """Greedy decode across two admission waves: the decode step must
+    trace ONCE for the engine's lifetime, each step costs exactly one
+    host sync (sync_interval=1), and no logits ever cross the wire."""
+    eng = _engine(max_slots=2, page_size=4, sync_interval=1)
+    reqs = [eng.submit([1, 2, 3, 4, 5, 6], _gen(8)),
+            eng.submit([3, 4, 5, 6, 7, 8], _gen(8))]
+    eng.run_until_complete(max_steps=400)
+    if inject_retrace:
+        _reinject_retrace(eng)
+    reqs.append(eng.submit([5, 6, 7, 8, 9, 10, 11], _gen(8)))
+    eng.run_until_complete(max_steps=400)
+    tokens = sum(r.num_generated for r in reqs)
+    return {
+        "decode_traces": eng.decode_traces,
+        "prefill_compiles": (len(eng._prefill_fns)
+                             + len(eng._prefill_cached_fns)),
+        "host_syncs_per_decode_step": round(
+            eng.host_syncs / max(eng.decode_steps, 1), 6),
+        "logits_fetches": eng.logit_fetches,
+        "pages_per_token": round(
+            eng.blocks.pages_allocated / max(tokens, 1), 6),
+        "goodput_ratio": _goodput(reqs),
+    }
+
+
+def scenario_prefix_cache() -> dict:
+    """A second wave sharing a 12-token (3-page) prefix must hit the
+    chain index for every shared chunk, pay pages only for its suffix,
+    and CoW exactly once for the tail that diverges after one token."""
+    eng = _engine(max_slots=2, page_size=4, sync_interval=1,
+                  enable_prefix_cache=True)
+    prefix = list(range(1, 13))
+    reqs = [eng.submit(prefix + [20, 21], _gen(4))]
+    eng.run_until_complete(max_steps=200)
+    reqs.append(eng.submit(prefix + [20, 25], _gen(4)))   # CoW tail
+    reqs.append(eng.submit(prefix + [30, 31], _gen(4)))   # fresh tail
+    eng.run_until_complete(max_steps=200)
+    b = eng.blocks
+    lookups = b.prefix_hits + b.prefix_misses
+    return {
+        "prefix_hit_rate": round(b.prefix_hits / max(lookups, 1), 6),
+        "cached_tokens": b.cached_tokens,
+        "pages_allocated": b.pages_allocated,
+        "cow_copies": b.cow_copies,
+        "goodput_ratio": _goodput(reqs),
+    }
+
+
+def scenario_deferred_sync() -> dict:
+    """sync_interval=4 greedy decode must amortize the ring fetch over
+    4 device steps — host syncs are the serving scalability ceiling."""
+    eng = _engine(max_slots=2, page_size=4, sync_interval=4)
+    reqs = [eng.submit([1, 2, 3, 4, 5, 6], _gen(8)),
+            eng.submit([2, 3, 4, 5, 6, 7], _gen(8))]
+    eng.run_until_complete(max_steps=400)
+    del reqs
+    return {
+        "steps_per_sync": round(
+            eng.decode_steps / max(eng.host_syncs, 1), 6),
+        "host_syncs": eng.host_syncs,
+        "decode_traces": eng.decode_traces,
+    }
+
+
+def scenario_goodput_cancel() -> dict:
+    """A client cancel after 3 streamed tokens wastes exactly those 3
+    tokens; the surviving request's 8 are useful — ratio 8/11.  Counted
+    from request outcomes (no wall clocks, no deadlines)."""
+    eng = _engine(max_slots=2, page_size=4, sync_interval=1)
+
+    def cancel_after_3(req, tok):
+        if req.num_generated >= 3:
+            req.cancel()
+
+    reqs = [eng.submit([1, 2, 3, 4, 5, 6], _gen(8)),
+            eng.submit([2, 3, 4, 5, 6, 7], _gen(8),
+                       on_token=cancel_after_3)]
+    eng.run_until_complete(max_steps=400)
+    return {
+        "goodput_ratio": _goodput(reqs),
+        "decode_traces": eng.decode_traces,
+        "logits_fetches": eng.logit_fetches,
+    }
+
+
+SCENARIOS = {
+    "steady_decode": scenario_steady_decode,
+    "prefix_cache": scenario_prefix_cache,
+    "deferred_sync": scenario_deferred_sync,
+    "goodput_cancel": scenario_goodput_cancel,
+}
+
+
+def run_scenarios(names, inject_retrace=False) -> dict:
+    results = {}
+    for name in names:
+        fn = SCENARIOS[name]
+        if name == "steady_decode":
+            results[name] = fn(inject_retrace=inject_retrace)
+        else:
+            results[name] = fn()
+    return results
+
+
+def compare(results: dict, baseline: dict):
+    """Direction-aware comparison.  Returns (regressions,
+    improvements); a counter with no baseline entry is a regression
+    (the gate must be told, via --update-baseline, that it exists)."""
+    regressions, improvements = [], []
+    for scen in sorted(results):
+        base_scen = baseline.get(scen, {})
+        for name in sorted(results[scen]):
+            cur = results[scen][name]
+            entry = {"scenario": scen, "counter": name, "current": cur,
+                     "direction": DIRECTIONS.get(name, "exact")}
+            if name not in base_scen:
+                entry["baseline"] = None
+                entry["why"] = "no baseline entry"
+                regressions.append(entry)
+                continue
+            ref = base_scen[name]
+            entry["baseline"] = ref
+            d = entry["direction"]
+            if d == "low":
+                if cur > ref:
+                    regressions.append(entry)
+                elif cur < ref:
+                    improvements.append(entry)
+            elif d == "high":
+                if cur < ref:
+                    regressions.append(entry)
+                elif cur > ref:
+                    improvements.append(entry)
+            else:
+                if cur != ref:
+                    regressions.append(entry)
+    return regressions, improvements
+
+
+def load_baseline(path: str) -> dict | None:
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return data.get("scenarios", {})
+
+
+def save_baseline(path: str, results: dict):
+    with open(path, "w") as f:
+        json.dump({"version": 1, "scenarios": results}, f, indent=2,
+                  sort_keys=True)
+        f.write("\n")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="perf_gate.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--scenarios", default=None,
+                    help="comma-separated scenario subset "
+                         f"(default: {' '.join(sorted(SCENARIOS))})")
+    ap.add_argument("--json", action="store_true",
+                    help="emit results as JSON")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: tools/"
+                         "perf_baseline.json)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write the current counters as the new "
+                         "baseline and exit 0")
+    ap.add_argument("--list-scenarios", action="store_true",
+                    help="list scenario names and exit")
+    ap.add_argument("--inject-retrace", action="store_true",
+                    help="test hook: force an extra decode-step trace "
+                         "in steady_decode (the gate must exit 1)")
+    args = ap.parse_args(argv)
+
+    if args.list_scenarios:
+        width = max(len(s) for s in SCENARIOS)
+        for name in sorted(SCENARIOS):
+            print(f"{name:<{width}}  {SCENARIOS[name].__doc__.splitlines()[0]}")
+        return 0
+
+    if args.scenarios:
+        names = [s.strip() for s in args.scenarios.split(",")
+                 if s.strip()]
+        unknown = [s for s in names if s not in SCENARIOS]
+        if unknown:
+            print(f"perf_gate.py: unknown scenario(s): "
+                  f"{', '.join(unknown)} (have: "
+                  f"{', '.join(sorted(SCENARIOS))})", file=sys.stderr)
+            return 2
+    else:
+        names = sorted(SCENARIOS)
+
+    _force_cpu()
+    results = run_scenarios(names,
+                            inject_retrace=args.inject_retrace)
+
+    if args.update_baseline:
+        # subset runs only refresh the scenarios they ran
+        merged = load_baseline(args.baseline) or {}
+        merged.update(results)
+        save_baseline(args.baseline, merged)
+        print(f"wrote {len(merged)} scenario"
+              f"{'' if len(merged) == 1 else 's'} to "
+              f"{os.path.relpath(args.baseline, _REPO_ROOT)}")
+        return 0
+
+    baseline = load_baseline(args.baseline)
+    if baseline is None:
+        print(f"perf_gate.py: no baseline at {args.baseline} — run "
+              "with --update-baseline first", file=sys.stderr)
+        return 2
+
+    regressions, improvements = compare(results, baseline)
+    if args.json:
+        sys.stdout.write(json.dumps(
+            {"scenarios": results, "regressions": regressions,
+             "improvements": improvements}, indent=2, sort_keys=True))
+        sys.stdout.write("\n")
+    else:
+        for e in regressions:
+            print(f"REGRESSION {e['scenario']}.{e['counter']}: "
+                  f"{e['current']} vs baseline {e['baseline']} "
+                  f"(want {e['direction']})"
+                  + (f" — {e['why']}" if "why" in e else ""))
+        for e in improvements:
+            print(f"improved {e['scenario']}.{e['counter']}: "
+                  f"{e['current']} vs baseline {e['baseline']} "
+                  "(tighten with --update-baseline)")
+        n_counters = sum(len(v) for v in results.values())
+        print(f"{len(names)} scenario{'' if len(names) == 1 else 's'}, "
+              f"{n_counters} counters: "
+              f"{len(regressions)} regression"
+              f"{'' if len(regressions) == 1 else 's'}, "
+              f"{len(improvements)} improvement"
+              f"{'' if len(improvements) == 1 else 's'}")
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
