@@ -8,6 +8,10 @@ Three analyzer families guard the invariants PRs 1–5 made load-bearing:
 * :mod:`.lock_discipline` — lock-order cycles, unlocked shared writes,
   blocking calls under a lock (the threaded serving/observability
   stack);
+* :mod:`.interlock` — the interprocedural extension of lock discipline
+  (held locks propagated through same-class method calls) plus thread
+  lifecycle rules (unjoined threads, silent thread excepts, callbacks
+  invoked under a lock);
 * :mod:`.flags_metrics` — FLAGS_* registration, flag help, metric
   naming/unit-suffix conventions;
 * :mod:`.clocks` — durations/deadlines must use monotonic clocks.
@@ -16,11 +20,12 @@ Entry points: ``tools/lint.py`` (CLI with committed baseline) and
 :func:`paddle_tpu.analysis.run` (library).  Analyzers never import the
 code they check.
 """
-from .baseline import load_baseline, partition, save_baseline
+from .baseline import (load_baseline, load_baseline_entries, partition,
+                       save_baseline)
 from .core import Finding, SourceFile
 from .reporters import render_json, render_text
 from .runner import ALL_RULES, iter_files, run
 
 __all__ = ["Finding", "SourceFile", "run", "iter_files", "ALL_RULES",
            "render_text", "render_json", "load_baseline",
-           "save_baseline", "partition"]
+           "load_baseline_entries", "save_baseline", "partition"]

@@ -15,34 +15,49 @@ import os
 
 from .core import Finding
 
-__all__ = ["load_baseline", "save_baseline", "partition"]
+__all__ = ["load_baseline", "load_baseline_entries", "save_baseline",
+           "partition"]
 
 _VERSION = 1
 
 
 def load_baseline(path: str) -> dict[str, int]:
     """fingerprint -> accepted count.  Missing file = empty baseline."""
-    if not os.path.exists(path):
-        return {}
-    with open(path, encoding="utf-8") as f:
-        data = json.load(f)
     counts: dict[str, int] = {}
-    for entry in data.get("findings", []):
+    for entry in load_baseline_entries(path):
         fp = entry["fingerprint"]
         counts[fp] = counts.get(fp, 0) + 1
     return counts
 
 
-def save_baseline(path: str, findings: list[Finding]) -> None:
-    """Every finding, with rule id + location, human-reviewable."""
+def load_baseline_entries(path: str) -> list[dict]:
+    """The raw finding entries (rule/path/line/message/fingerprint and
+    an optional hand-written ``why`` justification).  Missing file =
+    empty list."""
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return list(data.get("findings", []))
+
+
+def save_baseline(path: str, findings) -> None:
+    """Every finding, with rule id + location, human-reviewable.
+    Accepts :class:`Finding` objects and raw baseline entry dicts
+    interchangeably (the merge path re-saves entries it kept), and
+    preserves any ``why`` justification keys on dict entries."""
+    entries = [f.to_dict() if isinstance(f, Finding) else dict(f)
+               for f in findings]
     data = {
         "version": _VERSION,
         "comment": "Accepted pre-existing lint findings. Regenerate "
                    "deliberately with `python tools/lint.py "
-                   "--update-baseline`; never hand-edit counts.",
-        "findings": [f.to_dict() for f in
-                     sorted(findings,
-                            key=lambda f: (f.path, f.line, f.rule))],
+                   "--update-baseline`; never hand-edit counts. "
+                   "`why` keys are hand-written justifications and "
+                   "survive --update-baseline by fingerprint.",
+        "findings": sorted(entries,
+                           key=lambda e: (e["path"], e["line"],
+                                          e["rule"])),
     }
     with open(path, "w", encoding="utf-8") as f:
         json.dump(data, f, indent=2)

@@ -1,0 +1,493 @@
+"""Interprocedural lock analysis + thread-lifecycle rules.
+
+:mod:`.lock_discipline` is deliberately intraprocedural: it sees a lock
+held across statements of one method but not across a method call.  The
+serving stack's real locking, however, is layered — a public method
+takes ``self.lock`` and delegates to private helpers — so this pass
+re-analyzes same-class callees with the caller's held-lock set
+propagated in (call depth <= 2, mirroring jit_safety's helper
+analysis), and reports only the *delta* the intraprocedural pass cannot
+see, under the same rule ids:
+
+``lock-order-cycle``     an edge recorded inside a callee while the
+                         caller holds another lock closes ABBA rings no
+                         single method body shows;
+``lock-unlocked-write``  a helper's writes count as locked when its
+                         call site holds the class lock — and race with
+                         call paths that do not;
+``lock-blocking-call``   a sleep/join/network call in a callee blocks
+                         whatever lock the caller is holding.
+
+Three new rules ride on the same module scan:
+
+``thread-unjoined``      a ``threading.Thread`` that is started but
+                         whose handle is never joined anywhere in the
+                         module (or is discarded at the start site):
+                         no shutdown path can wait for it;
+``thread-bare-except``   a thread target swallowing exceptions silently
+                         (``except Exception: pass``) — the thread
+                         stays "alive" while its work is dead;
+``callback-under-lock``  a stored user callback (``on_token``-style
+                         attribute) invoked while holding a lock: user
+                         code that re-enters the subsystem deadlocks on
+                         the very lock it was called under.
+
+Private helpers that have same-class callers are analyzed only through
+those callers (a lone entry-point traversal would misclassify their
+writes as unlocked); public and uncalled-private methods are entry
+points.  Call chains rooted at ``__init__`` never record writes — the
+object is not shared yet.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from . import lock_discipline as _ld
+from .core import Finding, SourceFile, call_name, dotted_name, expr_text
+
+__all__ = ["analyze"]
+
+RULES = {
+    "thread-unjoined": "thread started but never joined on any "
+                       "shutdown path",
+    "thread-bare-except": "thread target swallows exceptions silently",
+    "callback-under-lock": "stored user callback invoked while holding "
+                           "a lock",
+}
+
+_MAX_DEPTH = 2          # caller -> callee -> callee's callee, then stop
+
+_CALLBACK_RE = re.compile(
+    r"^_?(on_[a-z0-9_]+|[a-z0-9_]*_(callback|cb|hook))$")
+
+
+def analyze(src: SourceFile) -> list[Finding]:
+    has_locks = any(ctor + "(" in src.text
+                    for ctor in _ld._LOCK_CTORS | _ld._EVENT_CTORS
+                    | set(_ld._FACTORY_CTORS))
+    has_threads = "Thread(" in src.text
+    if not (has_locks or has_threads):
+        return []
+    findings: list[Finding] = []
+    if has_threads:
+        findings.extend(_thread_rules(src))
+    if has_locks:
+        findings.extend(_interprocedural(src))
+    return src.filter(_dedupe(findings))
+
+
+def _dedupe(findings):
+    seen, out = set(), []
+    for f in findings:
+        key = (f.fingerprint, f.line)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out
+
+
+# =================================================== interprocedural pass
+def _interprocedural(src: SourceFile) -> list[Finding]:
+    locks = _ld._ModuleLocks(src.tree)
+    pairs = list(_ld._methods(src.tree))
+
+    # same-class method index + which methods are called via self.m()
+    methods: dict[str, dict] = {}
+    for cls, fn in pairs:
+        if cls is not None:
+            methods.setdefault(cls.name, {}).setdefault(fn.name, fn)
+    called: dict[str, set] = {}
+    for cls, fn in pairs:
+        if cls is None:
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                name = call_name(node) or ""
+                if name.startswith("self.") and "." not in name[5:]:
+                    called.setdefault(cls.name, set()).add(name[5:])
+
+    # --- intraprocedural baseline, for the delta ---
+    base_edges: dict[tuple, tuple] = {}
+    base_writes: dict[tuple, dict] = {}
+    base_findings: list[Finding] = []
+    for cls, fn in pairs:
+        v = _ld._ScopeVisitor(src, locks, cls.name if cls else None, fn,
+                              base_edges, base_writes, base_findings)
+        v.visit_block(fn.body, [])
+    base_cycle_fps = {f.fingerprint
+                      for f in _ld._cycle_findings(src, base_edges)}
+    base_racy = {pair for pair, rec in base_writes.items()
+                 if rec["locked"] and rec["unlocked"]}
+    base_lines = {(f.rule, f.line) for f in base_findings}
+
+    # --- interprocedural traversal: entries with propagation ---
+    # seed the edge map with the intraprocedural edges so shared edges
+    # keep their sites (and cycle messages/fingerprints line up)
+    edges = dict(base_edges)
+    writes: dict[tuple, dict] = {}
+    extra: list[Finding] = []
+    visited: set = set()
+    seen_callbacks: set = set()
+    for cls, fn in pairs:
+        clsname = cls.name if cls else None
+        if clsname and fn.name.startswith("_") and \
+                not fn.name.startswith("__") and \
+                fn.name in called.get(clsname, set()):
+            continue            # helper: analyzed through its callers
+        v = _InterVisitor(src, locks, clsname, fn, edges, writes, extra,
+                          methods, visited, base_lines, seen_callbacks,
+                          init_chain=(fn.name == "__init__"))
+        v.visit_block(fn.body, [])
+
+    out: list[Finding] = []
+    for f in _ld._cycle_findings(src, edges):
+        if f.fingerprint not in base_cycle_fps:
+            out.append(f)
+    out.extend(_inter_write_findings(src, writes, base_racy))
+    out.extend(extra)
+    return out
+
+
+class _InterVisitor(_ld._ScopeVisitor):
+    """_ScopeVisitor that descends into ``self.m(...)`` callees carrying
+    the current held-lock set, and checks callback-under-lock."""
+
+    def __init__(self, src, locks, cls, fn, edges, writes, findings,
+                 methods, visited, base_lines, seen_callbacks,
+                 chain=(), inherited=frozenset(), init_chain=False):
+        super().__init__(src, locks, cls, fn, edges, writes, findings)
+        self.methods = methods
+        self.visited = visited
+        self.base_lines = base_lines
+        self.seen_callbacks = seen_callbacks
+        self.chain = chain              # ("Cls.caller", ...) call path
+        self.inherited = inherited      # lock keys held at method entry
+        self.init_chain = init_chain
+
+    def _record_writes(self, stmt, held):
+        if self.init_chain:
+            return                      # object not shared during init
+        super()._record_writes(stmt, held)
+
+    def _check_call(self, call, held):
+        if held:
+            self._check_callback(call, held)
+            super()._check_call(call, held)
+        self._descend(call, held)
+
+    def _blocking(self, call, what, why, held_keys):
+        if not self.chain:
+            return                      # intra pass reports these
+        if ("lock-blocking-call", call.lineno) in self.base_lines:
+            return                      # callee's own lock: intra saw it
+        if not (self.inherited & set(held_keys)):
+            return
+        via = " -> ".join(self.chain + (f"{self.cls}.{self.fn.name}",))
+        self.findings.append(Finding(
+            "lock-blocking-call", self.src.path, call.lineno,
+            f"{what} while holding "
+            f"{', '.join(sorted(set(held_keys)))} (held across the "
+            f"call chain {via}): {why}",
+            hint="move the blocking call outside the lock scope, or "
+                 "release in the caller before delegating"))
+
+    def _check_callback(self, call, held):
+        func = call.func
+        if not isinstance(func, ast.Attribute) or \
+                not _CALLBACK_RE.match(func.attr):
+            return
+        recv = func.value
+        if isinstance(recv, ast.Name) and recv.id == "self" and \
+                func.attr in self.methods.get(self.cls or "", {}):
+            return                      # a real method, not a stored cb
+        key = (call.lineno, func.attr)
+        if key in self.seen_callbacks:
+            return
+        self.seen_callbacks.add(key)
+        held_keys = sorted({k for k, _ in held})
+        self.findings.append(Finding(
+            "callback-under-lock", self.src.path, call.lineno,
+            f"user callback `{expr_text(func)}` invoked while holding "
+            f"{', '.join(held_keys)} — callback code that re-enters "
+            "this subsystem deadlocks on that lock",
+            hint="snapshot the callback and its arguments under the "
+                 "lock, release, then invoke"))
+
+    def _descend(self, call, held):
+        if len(self.chain) >= _MAX_DEPTH or self.cls is None:
+            return
+        func = call.func
+        if not (isinstance(func, ast.Attribute) and
+                isinstance(func.value, ast.Name) and
+                func.value.id == "self"):
+            return
+        target = self.methods.get(self.cls, {}).get(func.attr)
+        if target is None or target is self.fn:
+            return
+        key = (id(target), frozenset(k for k, _ in held))
+        if key in self.visited:
+            return
+        self.visited.add(key)
+        sub = _InterVisitor(
+            self.src, self.locks, self.cls, target, self.edges,
+            self.writes, self.findings, self.methods, self.visited,
+            self.base_lines, self.seen_callbacks,
+            chain=self.chain + (f"{self.cls}.{self.fn.name}",),
+            inherited=frozenset(k for k, _ in held),
+            init_chain=self.init_chain)
+        sub.visit_block(target.body, list(held))
+
+
+def _inter_write_findings(src, writes, base_racy) -> list[Finding]:
+    out = []
+    for (cls, attr), rec in sorted(writes.items()):
+        if (cls, attr) in base_racy:
+            continue                    # intra pass already reports it
+        if not rec["locked"] or not rec["unlocked"]:
+            continue
+        l_path, l_line = rec["locked"][0]
+        for path, line in rec["unlocked"]:
+            if (path, line) == (l_path, l_line):
+                where = ("reached both with and without the lock "
+                         "through different callers")
+            else:
+                where = (f"written under the lock at {l_path}:{l_line} "
+                         "(lock taken by a calling method)")
+            out.append(Finding(
+                "lock-unlocked-write", path, line,
+                f"`self.{attr}` of {cls} is written here without the "
+                f"lock, but {where} — racy if both paths run "
+                "concurrently",
+                hint=f"take the {cls} lock on every path that reaches "
+                     "this write, or document single-threaded "
+                     "ownership with a suppression"))
+    return out
+
+
+# ===================================================== thread lifecycle
+def _thread_rules(src: SourceFile) -> list[Finding]:
+    findings: list[Finding] = []
+    pairs = list(_ld._methods(src.tree))
+    methods: dict[str, dict] = {}
+    for cls, fn in pairs:
+        if cls is not None:
+            methods.setdefault(cls.name, {}).setdefault(fn.name, fn)
+    module_fns = {fn.name: fn for cls, fn in pairs if cls is None}
+
+    joined = _joined_names(src.tree)
+    started_attrs = _started_attrs(src.tree)
+
+    targets: list = []          # FunctionDef bodies that run on a thread
+    target_ids: set = set()
+
+    for cls, fn in pairs:
+        clsname = cls.name if cls else None
+        for stmt in ast.walk(fn):
+            # inline fire-and-forget: Thread(...).start()
+            if isinstance(stmt, ast.Expr) and \
+                    isinstance(stmt.value, ast.Call) and \
+                    isinstance(stmt.value.func, ast.Attribute) and \
+                    stmt.value.func.attr == "start" and \
+                    isinstance(stmt.value.func.value, ast.Call) and \
+                    _is_thread_ctor(stmt.value.func.value):
+                findings.append(Finding(
+                    "thread-unjoined", src.path, stmt.lineno,
+                    "thread is started inline and its handle "
+                    "discarded — it can never be joined, so no "
+                    "shutdown path can wait for it",
+                    hint="bind the Thread to an attribute and join it "
+                         "on the shutdown path"))
+                _note_target(stmt.value.func.value, clsname, fn, methods,
+                             module_fns, targets, target_ids)
+                continue
+            if not isinstance(stmt, ast.Assign) or \
+                    not isinstance(stmt.value, ast.Call) or \
+                    not _is_thread_ctor(stmt.value):
+                continue
+            _note_target(stmt.value, clsname, fn, methods, module_fns,
+                         targets, target_ids)
+            for tgt in stmt.targets:
+                text = expr_text(tgt)
+                if text.startswith("self."):
+                    attr = text.split(".", 1)[1]
+                    if attr in started_attrs and attr not in joined:
+                        findings.append(Finding(
+                            "thread-unjoined", src.path, stmt.lineno,
+                            f"thread bound to `self.{attr}` is started "
+                            "but never joined anywhere in this module",
+                            hint="join the handle on the shutdown "
+                                 "path (stop()/close())"))
+                elif isinstance(tgt, ast.Name):
+                    f = _local_thread_finding(src, fn, tgt.id,
+                                              stmt.lineno)
+                    if f is not None:
+                        findings.append(f)
+
+    # run() methods of Thread subclasses also execute on a thread
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ClassDef) and any(
+                (dotted_name(b) or "").rsplit(".", 1)[-1] == "Thread"
+                for b in node.bases):
+            run = methods.get(node.name, {}).get("run")
+            if run is not None and id(run) not in target_ids:
+                target_ids.add(id(run))
+                targets.append(run)
+
+    for fn in targets:
+        findings.extend(_bare_except_findings(src, fn))
+    return findings
+
+
+def _is_thread_ctor(call) -> bool:
+    name = call_name(call) or ""
+    return name.rsplit(".", 1)[-1] == "Thread"
+
+
+def _note_target(call, clsname, fn, methods, module_fns, targets,
+                 target_ids):
+    """Resolve the thread target function, when statically visible."""
+    expr = None
+    for kw in call.keywords:
+        if kw.arg == "target":
+            expr = kw.value
+    if expr is None and call.args:
+        expr = call.args[0]
+    if expr is None:
+        return
+    resolved = None
+    text = expr_text(expr)
+    if text.startswith("self.") and clsname:
+        resolved = methods.get(clsname, {}).get(text[5:])
+    elif isinstance(expr, ast.Name):
+        for sub in ast.walk(fn):        # nested def in the same function
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and sub.name == expr.id:
+                resolved = sub
+                break
+        if resolved is None:
+            resolved = module_fns.get(expr.id)
+    if resolved is not None and id(resolved) not in target_ids:
+        target_ids.add(id(resolved))
+        targets.append(resolved)
+
+
+def _joined_names(tree) -> set:
+    """Attribute names (last segment) that receive a ``.join()`` call
+    anywhere in the module, with one level of local-alias resolution
+    (``t = self._thread; t.join()`` marks ``_thread``)."""
+    joined: set = set()
+    aliases: dict[str, str] = {}        # local name -> aliased attr
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Attribute) and \
+                len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            aliases[node.targets[0].id] = node.value.attr
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "join":
+            recv = node.func.value
+            if isinstance(recv, ast.Attribute):
+                joined.add(recv.attr)
+            elif isinstance(recv, ast.Name):
+                joined.add(recv.id)
+                if recv.id in aliases:
+                    joined.add(aliases[recv.id])
+    return joined
+
+
+def _started_attrs(tree) -> set:
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "start" and \
+                isinstance(node.func.value, ast.Attribute):
+            out.add(node.func.value.attr)
+    return out
+
+
+def _local_thread_finding(src, fn, name, lineno):
+    started = joined = escaped = False
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id == name:
+            if node.func.attr == "start":
+                started = True
+            elif node.func.attr == "join":
+                joined = True
+        elif isinstance(node, ast.Call):
+            if any(isinstance(a, ast.Name) and a.id == name
+                   for a in node.args):
+                escaped = True          # handed off; managed elsewhere
+        elif isinstance(node, ast.Return) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == name:
+            escaped = True
+        elif isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == name:
+            escaped = True              # stored; attr rules take over
+    if started and not joined and not escaped:
+        return Finding(
+            "thread-unjoined", src.path, lineno,
+            f"thread `{name}` is started in {fn.name}() but never "
+            "joined there (and its handle does not escape)",
+            hint="join it before returning, or retain the handle for "
+                 "a shutdown path")
+    return None
+
+
+def _own_body_nodes(fn):
+    """fn's statements, not descending into nested defs — a nested def
+    is analyzed as its own thread target when something runs it."""
+    todo = list(fn.body)
+    while todo:
+        node = todo.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if not isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                todo.append(child)
+
+
+def _bare_except_findings(src, fn) -> list[Finding]:
+    out = []
+    for node in _own_body_nodes(fn):
+        if not isinstance(node, ast.Try):
+            continue
+        for handler in node.handlers:
+            if _is_broad(handler) and _is_silent(handler):
+                out.append(Finding(
+                    "thread-bare-except", src.path, handler.lineno,
+                    f"thread target {fn.name}() swallows exceptions "
+                    "silently — the thread keeps running (or dies) "
+                    "with no trace of what went wrong",
+                    hint="log the exception (traceback.print_exc() / "
+                         "logger) or re-raise; silence kills "
+                         "liveness debugging"))
+    return out
+
+
+def _is_broad(handler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    return any((dotted_name(e) or "").rsplit(".", 1)[-1] in
+               ("Exception", "BaseException") for e in elts)
+
+
+def _is_silent(handler) -> bool:
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if isinstance(stmt, ast.Expr) and \
+                isinstance(stmt.value, ast.Constant):
+            continue
+        return False
+    return True

@@ -56,6 +56,11 @@ _LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
 _REENTRANT_CTORS = {"RLock", "Condition"}
 _EVENT_CTORS = {"Event"}
 
+# paddle_tpu.sanitizer factories return (possibly instrumented) locks;
+# a `self._lock = make_lock(...)` must stay visible to this pass
+_FACTORY_CTORS = {"make_lock": "Lock", "make_rlock": "RLock",
+                  "make_condition": "Condition"}
+
 # dotted call names that block regardless of their arguments
 _BLOCKING_CALLS = {
     "time.sleep": "sleeps with the lock held",
@@ -108,11 +113,13 @@ class _ModuleLocks:
                 for tgt in node.targets:
                     if isinstance(tgt, ast.Name):
                         ctor = _ctor_of(node.value)
+                        ctor = _FACTORY_CTORS.get(ctor, ctor)
                         if ctor in _LOCK_CTORS:
                             self.locks[tgt.id] = _LockInfo(tgt.id, ctor)
 
     def _maybe_lock(self, cls, assign):
         ctor = _ctor_of(assign.value)
+        ctor = _FACTORY_CTORS.get(ctor, ctor)
         for tgt in assign.targets:
             text = expr_text(tgt)
             if not text.startswith("self."):
@@ -170,7 +177,8 @@ def _ctor_of(call) -> str | None:
 def analyze(src: SourceFile) -> list[Finding]:
     # cheap pre-gate: no lock constructor text, no resolvable locks
     if not any(ctor + "(" in src.text
-               for ctor in _LOCK_CTORS | _EVENT_CTORS):
+               for ctor in _LOCK_CTORS | _EVENT_CTORS
+               | set(_FACTORY_CTORS)):
         return []
     locks = _ModuleLocks(src.tree)
     findings: list[Finding] = []

@@ -6,27 +6,43 @@ itself).  ``paddle_tpu/flags.py`` is always consulted for flag
 definitions — pre-parsed when it is outside the analyzed paths, or
 ordered first when inside them — so ``flag-undefined`` sees the full
 registry no matter which subset of the repo is linted.
+
+Per-file result cache (``.lint_cache/`` under the lint root): each
+file's findings are keyed on its content hash, the analyzer sources'
+hash, and a rolling hash of the cross-file analyzer state (the
+flag/metric registries accumulated by the files before it) — so a warm
+repo-wide run skips parsing entirely, while editing any file, any
+analyzer, or anything that shifts an earlier file's flag/metric
+contributions recomputes exactly what that change can affect.  Cached
+findings are per-file and unfiltered, so the ``rules`` subset never
+needs to be part of the key.
 """
 from __future__ import annotations
 
 import ast
+import hashlib
+import json
 import os
 
-from . import clocks, flags_metrics, jit_safety, lock_discipline
-from .core import Finding, SourceFile
+from . import clocks, flags_metrics, interlock, jit_safety, \
+    lock_discipline
+from .core import Finding, SourceFile, _suppression_map
 
 __all__ = ["ALL_RULES", "run", "iter_files"]
 
 ALL_RULES: dict[str, str] = {}
 ALL_RULES.update(jit_safety.RULES)
 ALL_RULES.update(lock_discipline.RULES)
+ALL_RULES.update(interlock.RULES)
 ALL_RULES.update(flags_metrics.RULES)
 ALL_RULES.update(clocks.RULES)
 ALL_RULES["parse-error"] = "file failed to parse"
 
-_SKIP_DIRS = {"__pycache__", "lint_fixtures", ".git"}
+_SKIP_DIRS = {"__pycache__", "lint_fixtures", ".git", ".lint_cache"}
 
 _FLAGS_REL = "paddle_tpu/flags.py"
+
+_FINDING_FIELDS = ("rule", "path", "line", "message", "severity", "hint")
 
 
 def iter_files(paths, root):
@@ -58,7 +74,70 @@ def _add(out, seen, abspath, root):
         out.append((abspath, rel))
 
 
-def run(paths, root=None, rules=None) -> list[Finding]:
+# ----------------------------------------------------------------- cache
+_ANALYSIS_DIR = os.path.dirname(os.path.abspath(__file__))
+_version_cache: str | None = None
+
+
+def _analyzers_version() -> str:
+    """Hash of the analyzer sources themselves — editing any analyzer
+    invalidates every cached result."""
+    global _version_cache
+    if _version_cache is None:
+        h = hashlib.sha1()
+        for fn in sorted(os.listdir(_ANALYSIS_DIR)):
+            if fn.endswith(".py"):
+                h.update(fn.encode())
+                with open(os.path.join(_ANALYSIS_DIR, fn), "rb") as f:
+                    h.update(f.read())
+        _version_cache = h.hexdigest()
+    return _version_cache
+
+
+class _Cache:
+    """One JSON file per linted source file; best-effort (any I/O or
+    decode problem silently degrades to a recompute)."""
+
+    def __init__(self, dir_):
+        self.dir = dir_
+        try:
+            os.makedirs(dir_, exist_ok=True)
+            self.ok = True
+        except OSError:
+            self.ok = False
+
+    def _path(self, rel):
+        name = hashlib.sha1(rel.encode()).hexdigest()[:24]
+        return os.path.join(self.dir, name + ".json")
+
+    def get(self, rel, key):
+        if not self.ok:
+            return None
+        try:
+            with open(self._path(rel), encoding="utf-8") as f:
+                ent = json.load(f)
+        except (OSError, ValueError):
+            return None
+        return ent if ent.get("key") == key else None
+
+    def put(self, rel, key, findings, flags, metrics, contrib):
+        if not self.ok:
+            return
+        ent = {"key": key, "rel": rel,
+               "findings": [{k: getattr(f, k) for k in _FINDING_FIELDS}
+                            for f in findings],
+               "flags": flags, "metrics": metrics, "contrib": contrib}
+        path = self._path(rel)
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(ent, f)
+            os.replace(tmp, path)
+        except OSError:
+            pass
+
+
+def run(paths, root=None, rules=None, cache=True) -> list[Finding]:
     """All findings (suppressions already applied) for the given paths,
     optionally restricted to a rule-id subset."""
     root = os.path.abspath(root or os.getcwd())
@@ -79,25 +158,78 @@ def run(paths, root=None, rules=None) -> list[Finding]:
                         name, (has_help, f"{_FLAGS_REL}:{line}"))
     fm = flags_metrics.FlagsMetricsAnalyzer(flag_defs)
 
+    cache_obj = _Cache(os.path.join(root, ".lint_cache")) if cache \
+        else None
+    # rolling hash of the cross-file analyzer state: seeded with the
+    # analyzer version + pre-parsed flag defs, advanced per file by its
+    # flag/metric contributions (cached or fresh)
+    state = hashlib.sha1(_analyzers_version().encode()) if cache_obj \
+        else None
+    if state is not None:
+        state.update(repr(sorted(flag_defs.items())).encode())
+
     findings: list[Finding] = []
     for abspath, rel in files:
         try:
-            src = SourceFile.load(abspath, rel)
-        except SyntaxError as e:
-            findings.append(Finding(
-                "parse-error", rel, e.lineno or 1,
-                f"syntax error: {e.msg}",
-                hint="fix the syntax error"))
-            continue
+            with open(abspath, encoding="utf-8") as f:
+                text = f.read()
         except (OSError, UnicodeDecodeError) as e:
             findings.append(Finding(
                 "parse-error", rel, 1, f"unreadable: {e}",
                 hint="fix file encoding/permissions"))
             continue
-        findings.extend(jit_safety.analyze(src))
-        findings.extend(lock_discipline.analyze(src))
-        findings.extend(fm.check(src))
-        findings.extend(clocks.analyze(src))
+
+        key = None
+        if cache_obj is not None:
+            key = hashlib.sha1(
+                "\x00".join((rel,
+                             hashlib.sha1(text.encode()).hexdigest(),
+                             state.hexdigest())).encode()).hexdigest()
+            ent = cache_obj.get(rel, key)
+            if ent is not None:
+                findings.extend(
+                    Finding(**{k: d[k] for k in _FINDING_FIELDS})
+                    for d in ent["findings"])
+                for name, v in ent["flags"].items():
+                    fm.flags.setdefault(name, tuple(v))
+                for name, v in ent["metrics"].items():
+                    fm.metrics.setdefault(name, tuple(v))
+                state.update(ent["contrib"].encode())
+                continue
+
+        try:
+            tree = ast.parse(text, filename=rel)
+        except SyntaxError as e:
+            pe = Finding(
+                "parse-error", rel, e.lineno or 1,
+                f"syntax error: {e.msg}",
+                hint="fix the syntax error")
+            findings.append(pe)
+            if cache_obj is not None:
+                cache_obj.put(rel, key, [pe], {}, {}, "")
+            continue
+        src = SourceFile(rel, text, tree, _suppression_map(text))
+
+        before_flags = set(fm.flags)
+        before_metrics = set(fm.metrics)
+        file_findings: list[Finding] = []
+        file_findings.extend(jit_safety.analyze(src))
+        file_findings.extend(lock_discipline.analyze(src))
+        file_findings.extend(interlock.analyze(src))
+        file_findings.extend(fm.check(src))
+        file_findings.extend(clocks.analyze(src))
+        findings.extend(file_findings)
+
+        if cache_obj is not None:
+            new_flags = {k: list(fm.flags[k]) for k in fm.flags
+                         if k not in before_flags}
+            new_metrics = {k: list(fm.metrics[k]) for k in fm.metrics
+                           if k not in before_metrics}
+            contrib = repr((sorted(new_flags.items()),
+                            sorted(new_metrics.items())))
+            cache_obj.put(rel, key, file_findings, new_flags,
+                          new_metrics, contrib)
+            state.update(contrib.encode())
 
     if rules is not None:
         wanted = set(rules)

@@ -35,7 +35,7 @@ class WorkerInfo:
 
 
 _state = {"server": None, "workers": {}, "name": None, "stop": None,
-          "rank": None, "store": None, "token": None}
+          "rank": None, "store": None, "token": None, "thread": None}
 
 
 def _host_ip():
@@ -98,11 +98,15 @@ def _serve(server_sock, stop_event):
                 except Exception as e:      # unpicklable result/exception
                     _send_frame(c, ("err", RuntimeError(
                         f"rpc result not serializable: {e}")))
-            except Exception:
-                pass
-            finally:
-                c.close()
+            except Exception:   # tpu-lint: disable=thread-bare-except
+                pass            # malformed/hostile peer frames are
+            finally:            # dropped by design; real call failures
+                c.close()       # were already shipped back as ("err",)
 
+        # per-connection handlers are fire-and-forget by design: the
+        # server cannot enumerate them, and closing the listener (plus
+        # each handler's own socket close) is the shutdown path
+        # tpu-lint: disable=thread-unjoined
         threading.Thread(target=handle, args=(conn,), daemon=True).start()
 
 
@@ -128,6 +132,7 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     stop = threading.Event()
     t = threading.Thread(target=_serve, args=(srv, stop), daemon=True)
     t.start()
+    _state["thread"] = t        # joined in shutdown()
 
     # peer discovery + shared auth token via the KV store (pickle over
     # sockets is code execution; the token keeps strangers out)
@@ -208,7 +213,9 @@ def rpc_async(to, fn, args=None, kwargs=None, timeout=120):
         except Exception as e:
             fut.set_exception(e)
 
-    threading.Thread(target=run, daemon=True).start()
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    fut._thread = t            # retained so callers can join if needed
     fut.wait = fut.result      # paddle API parity (fut.wait())
     return fut
 
@@ -221,10 +228,12 @@ def shutdown():
             _state["server"].close()
         except OSError:
             pass
+    if _state["thread"] is not None:
+        _state["thread"].join(timeout=5.0)
     if _state["store"] is not None and _state["rank"] is not None:
         try:    # drop our registration so a re-init can't find stale peers
             _state["store"].delete_key(f"/rpc/{_state['rank']}")
         except Exception:
             pass
     _state.update(server=None, workers={}, name=None, stop=None,
-                  rank=None, store=None, token=None)
+                  rank=None, store=None, token=None, thread=None)

@@ -138,3 +138,10 @@ define_flag("FLAGS_resource_peak_tflops", 0.0,
 define_flag("FLAGS_resource_memory_poll_steps", 16,
             "sample device memory_stats()/host RSS every N engine host "
             "syncs (a host round-trip per device; 0 disables polling)")
+define_flag("FLAGS_sanitizer", False,
+            "enable the runtime concurrency sanitizer: serving/"
+            "observability locks become instrumented wrappers that "
+            "track held-lock stacks, detect runtime ABBA inversions "
+            "and lockset-empty shared accesses (Eraser-style), and "
+            "export a lock-wait graph for watchdog hang dumps; zero "
+            "overhead when off (plain threading primitives)")

@@ -24,8 +24,9 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
+
+from ..sanitizer import make_lock
 
 from .registry import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, default_registry)
@@ -80,7 +81,7 @@ class RetraceLog:
     MAX_ENTRIES = 10_000
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("RetraceLog._lock")
         self._entries: dict[tuple, dict] = {}
         self._dropped = 0
 

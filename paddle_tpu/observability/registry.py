@@ -20,8 +20,9 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
+
+from ..sanitizer import make_lock
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "default_registry", "SERVING_LATENCY_BUCKETS"]
@@ -65,7 +66,7 @@ class _Child:
     def __init__(self, metric, labelvalues):
         self._metric = metric
         self._labelvalues = labelvalues
-        self._lock = threading.Lock()
+        self._lock = make_lock(f"{metric.name}.child")
         self._value = 0.0
 
     @property
@@ -170,7 +171,7 @@ class _Metric:
         self.labelnames = tuple(labelnames)
         self._registry = registry
         self._children: dict[tuple, _Child] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock(f"{name}.metric")
         if not self.labelnames:
             # pre-bind the unlabeled series so bare .inc()/.set() is one
             # attribute hop, no dict lookup on the hot path
@@ -246,10 +247,10 @@ class MetricsRegistry:
 
     def __init__(self):
         self._metrics: dict[str, _Metric] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("Registry._lock")
         self._sampling = False
         self._events: list[tuple[float, str, tuple, float]] = []
-        self._events_lock = threading.Lock()
+        self._events_lock = make_lock("Registry._events_lock")
 
     # ------------------------------------------------------- constructors
     def _get_or_create(self, kind, name, help_, labelnames, **kw):

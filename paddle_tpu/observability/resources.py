@@ -35,9 +35,9 @@ lock, never an engine lock.
 """
 from __future__ import annotations
 
-import threading
 import time
 
+from ..sanitizer import make_lock
 from .registry import default_registry
 
 __all__ = ["CompileLedger", "ResourceTracker", "resource_tracker"]
@@ -97,7 +97,7 @@ class CompileLedger:
     direction for a cost ledger)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("CompileLedger._lock")
         self._jits: dict[str, dict] = {}
 
     def record(self, jit: str, seconds: float, signature: str = ""):
@@ -176,7 +176,7 @@ class ResourceTracker:
     lock — watchdog-safe by construction."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("ResourceTracker._lock")
         self.compiles = CompileLedger()
         self._reset_state()
 

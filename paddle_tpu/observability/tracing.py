@@ -34,6 +34,8 @@ import time
 from collections import deque
 from typing import NamedTuple
 
+from ..sanitizer import make_lock
+
 __all__ = ["Span", "SpanContext", "Tracer", "FlightRecorder",
            "tracer", "flight_recorder", "format_traceparent",
            "parse_traceparent", "TRACEPARENT_HEADER"]
@@ -193,7 +195,7 @@ class Tracer:
                 max_spans = 4096
         self.max_spans = int(max_spans)
         self._spans: deque[Span] = deque(maxlen=self.max_spans)
-        self._lock = threading.Lock()
+        self._lock = make_lock("Tracer._lock")
         self.spans_dropped = 0
         self.spans_recorded = 0
 
@@ -313,7 +315,7 @@ class FlightRecorder:
                 capacity = 512
         self.capacity = int(capacity)
         self._ring: deque[dict] = deque(maxlen=self.capacity)
-        self._lock = threading.Lock()
+        self._lock = make_lock("FlightRecorder._lock")
         self._seq = itertools.count()
 
     def record(self, category: str, event: str, **attrs):

@@ -13,17 +13,17 @@ from __future__ import annotations
 
 import enum
 import itertools
-import threading
 import time
 
 import numpy as np
 
 from ..models.generation import GenerationConfig
+from ..sanitizer import make_lock
 
 __all__ = ["Request", "RequestState", "GenerationConfig"]
 
 _ids = itertools.count()
-_ids_lock = threading.Lock()
+_ids_lock = make_lock("request._ids_lock")
 
 
 class RequestState(enum.Enum):

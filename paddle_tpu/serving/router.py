@@ -38,6 +38,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from .. import observability as _obs
+from ..sanitizer import make_lock
 from .client import ServingClient, ServingHTTPError
 
 __all__ = ["NoReplicaAvailable", "Replica", "Router", "RouterServer"]
@@ -121,7 +122,7 @@ class Router:
         self.probe_timeout_s = float(probe_timeout_s)
         self.request_timeout_s = float(request_timeout_s)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = make_lock("Router._lock")
         self._probe_stop = threading.Event()
         self._probe_thread: threading.Thread | None = None
 

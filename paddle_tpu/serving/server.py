@@ -41,6 +41,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from .. import observability as _obs
+from ..sanitizer import make_condition, make_rlock
 from .engine import Engine
 from .request import GenerationConfig, Request
 from .watchdog import Watchdog
@@ -93,8 +94,8 @@ class EngineWorker:
                  idle_wait: float = 0.005):
         self.engine = engine
         self.max_queue = int(max_queue)
-        self.lock = threading.RLock()
-        self._wake = threading.Condition(self.lock)
+        self.lock = make_rlock("EngineWorker.lock")
+        self._wake = make_condition(self.lock, name="EngineWorker._wake")
         self._stop = False
         self._started = False
         self._idle_wait = float(idle_wait)
@@ -323,6 +324,7 @@ class ServingServer(ThreadingHTTPServer):
         self.watchdog = Watchdog(worker.engine, watchdog_s)
         self._latency = _http_latency_hist()
         self._serve_thread: threading.Thread | None = None
+        self._stop_thread: threading.Thread | None = None
         super().__init__((host, port), _Handler)
 
     @property
@@ -351,9 +353,17 @@ class ServingServer(ThreadingHTTPServer):
     def install_signal_handlers(self,
                                 sigs=(signal.SIGTERM, signal.SIGINT)):
         """SIGTERM/SIGINT => graceful drain-then-exit.  Only callable
-        from the main thread (signal module restriction)."""
+        from the main thread (signal module restriction).  The handler
+        must return immediately, so stop() runs on its own thread; the
+        handle is retained (``_stop_thread``) so the foreground path
+        can join it, and a second signal during a drain is a no-op
+        instead of racing a second stop() against the first."""
         def _graceful(signum, frame):
-            threading.Thread(target=self.stop, daemon=True).start()
+            if self._stop_thread is not None:
+                return          # already draining; don't stack stops
+            self._stop_thread = threading.Thread(
+                target=self.stop, name="server-shutdown", daemon=True)
+            self._stop_thread.start()
         for s in sigs:
             signal.signal(s, _graceful)
 
@@ -687,6 +697,8 @@ def _main(argv=None):
             server._serve_thread.join(timeout=1.0)
     except KeyboardInterrupt:
         server.stop()
+    if server._stop_thread is not None:     # signal-driven shutdown:
+        server._stop_thread.join(timeout=30.0)  # let the drain finish
     return 0
 
 

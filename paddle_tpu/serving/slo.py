@@ -21,11 +21,11 @@ violation when those targets are set — it never met any latency bar.
 """
 from __future__ import annotations
 
-import threading
 from collections import deque
 from dataclasses import dataclass
 
 from .. import observability as _obs
+from ..sanitizer import make_lock
 
 __all__ = ["SLOConfig", "SLOTracker"]
 
@@ -75,7 +75,7 @@ class SLOTracker:
                 f"objective must be in (0, 1), got {config.objective}")
         self.config = config
         self.window = int(window)
-        self._lock = threading.Lock()
+        self._lock = make_lock("SLOTracker._lock")
         self._recent: dict[str, deque] = {
             d: deque(maxlen=self.window) for d in ("ttft", "tpot", "e2e")}
         # python-side mirrors (stats()/tests without registry spelunking)

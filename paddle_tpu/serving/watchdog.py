@@ -34,6 +34,7 @@ import time
 import traceback
 
 from .. import observability as _obs
+from ..sanitizer import make_lock
 
 __all__ = ["Watchdog"]
 
@@ -63,7 +64,7 @@ class Watchdog:
         self._clock = clock
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
-        self._lock = threading.Lock()   # guards only watchdog state
+        self._lock = make_lock("Watchdog._lock")  # guards only watchdog state
         self._last_progress = -1
         self._last_change: float | None = None
         self._tripped = False           # latched for the current episode
@@ -128,6 +129,15 @@ class Watchdog:
             resources = _obs.resource_tracker().snapshot()
         except Exception:
             resources = None
+        try:
+            # who holds / waits on every sanitized lock right now; with
+            # FLAGS_sanitizer off there are no instrumented locks and
+            # this is an empty graph.  Reads only the sanitizer's own
+            # bookkeeping lock — a wedged engine cannot block it.
+            from ..sanitizer import lock_wait_graph
+            lock_graph = lock_wait_graph()
+        except Exception:
+            lock_graph = None
         report = {
             "stalled_for_s": round(stalled_for, 3),
             "progress": progress,
@@ -136,6 +146,7 @@ class Watchdog:
             "flight": {"capacity": _obs.flight_recorder().capacity,
                        "events": _obs.flight_recorder().snapshot()},
             "resources": resources,
+            "lock_wait_graph": lock_graph,
         }
         dir_ = self._dump_dir
         if dir_ is None:

@@ -1,0 +1,13 @@
+"""BAD: user-supplied callback invoked under the lock
+(callback-under-lock)."""
+import threading
+
+
+class Emitter:
+    def __init__(self, on_token=None):
+        self._lock = threading.Lock()
+        self.on_token = on_token
+
+    def emit(self, tok):
+        with self._lock:
+            self.on_token(tok)      # arbitrary user code under _lock

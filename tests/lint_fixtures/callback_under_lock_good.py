@@ -1,0 +1,13 @@
+"""GOOD twin: snapshot the callback under the lock, call it outside."""
+import threading
+
+
+class Emitter:
+    def __init__(self, on_token=None):
+        self._lock = threading.Lock()
+        self.on_token = on_token
+
+    def emit(self, tok):
+        with self._lock:
+            cb = self.on_token
+        cb(tok)

@@ -1,0 +1,22 @@
+"""BAD: callee blocks while the caller holds the lock
+(lock-blocking-call).
+
+``_fetch`` looks innocent in isolation — the sleep only serializes
+everything because ``refresh`` calls it with ``_lock`` held.
+"""
+import threading
+import time
+
+
+class Refresher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = None
+
+    def refresh(self):
+        with self._lock:
+            self.value = self._fetch()
+
+    def _fetch(self):
+        time.sleep(0.1)             # stalls every lock waiter
+        return 42

@@ -1,0 +1,18 @@
+"""GOOD twin: fetch outside the lock, publish under it."""
+import threading
+import time
+
+
+class Refresher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = None
+
+    def refresh(self):
+        fresh = self._fetch()
+        with self._lock:
+            self.value = fresh
+
+    def _fetch(self):
+        time.sleep(0.1)
+        return 42

@@ -1,0 +1,29 @@
+"""BAD: ABBA only visible through a call chain (lock-order-cycle).
+
+No single method nests the two locks, so the intraprocedural pass sees
+nothing; ``submit -> _flush`` acquires a then b while
+``drain -> _push`` acquires b then a.
+"""
+import threading
+
+
+class Pipeline:
+    def __init__(self):
+        self.lock_a = threading.Lock()
+        self.lock_b = threading.Lock()
+
+    def submit(self):
+        with self.lock_a:
+            self._flush()
+
+    def _flush(self):
+        with self.lock_b:
+            pass
+
+    def drain(self):
+        with self.lock_b:
+            self._push()
+
+    def _push(self):
+        with self.lock_a:
+            pass

@@ -1,0 +1,24 @@
+"""GOOD twin: both call chains acquire in the same a -> b order."""
+import threading
+
+
+class Pipeline:
+    def __init__(self):
+        self.lock_a = threading.Lock()
+        self.lock_b = threading.Lock()
+
+    def submit(self):
+        with self.lock_a:
+            self._flush()
+
+    def _flush(self):
+        with self.lock_b:
+            pass
+
+    def drain(self):
+        with self.lock_a:
+            self._push()
+
+    def _push(self):
+        with self.lock_b:
+            pass

@@ -1,0 +1,25 @@
+"""BAD: helper's write is locked via one caller, bare via another
+(lock-unlocked-write).
+
+``_bump`` never takes the lock itself, so intraprocedurally every
+write looks uniformly unlocked and the pass stays quiet; the chain
+``record -> _bump`` makes the same line a locked write, exposing the
+race with ``fast_path``.
+"""
+import threading
+
+
+class Stats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def _bump(self):
+        self.count += 1
+
+    def record(self):
+        with self._lock:
+            self._bump()
+
+    def fast_path(self):
+        self._bump()                # races with record()

@@ -1,0 +1,19 @@
+"""GOOD twin: every path into the helper holds the lock."""
+import threading
+
+
+class Stats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def _bump(self):
+        self.count += 1
+
+    def record(self):
+        with self._lock:
+            self._bump()
+
+    def fast_path(self):
+        with self._lock:
+            self._bump()

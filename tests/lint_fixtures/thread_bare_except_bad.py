@@ -1,0 +1,20 @@
+"""BAD: thread target swallows every exception (thread-bare-except)."""
+import threading
+
+
+def worker(q):
+    while True:
+        item = q.get()
+        if item is None:
+            return
+        try:
+            item()
+        except Exception:
+            pass                    # error vanishes with the thread
+
+
+def main(q):
+    t = threading.Thread(target=worker, args=(q,))
+    t.start()
+    q.put(None)
+    t.join()

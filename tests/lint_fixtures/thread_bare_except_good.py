@@ -1,0 +1,23 @@
+"""GOOD twin: the handler records the failure before continuing."""
+import logging
+import threading
+
+log = logging.getLogger(__name__)
+
+
+def worker(q):
+    while True:
+        item = q.get()
+        if item is None:
+            return
+        try:
+            item()
+        except Exception:
+            log.exception("task failed")
+
+
+def main(q):
+    t = threading.Thread(target=worker, args=(q,))
+    t.start()
+    q.put(None)
+    t.join()

@@ -1,0 +1,15 @@
+"""BAD: started thread is never joined (thread-unjoined)."""
+import threading
+
+
+class Poller:
+    def start(self):
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def stop(self):
+        self._stopping = True       # forgets self._t.join()
+
+    def _run(self):
+        while not getattr(self, "_stopping", False):
+            pass

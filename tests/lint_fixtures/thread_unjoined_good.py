@@ -1,0 +1,16 @@
+"""GOOD twin: shutdown joins the worker thread."""
+import threading
+
+
+class Poller:
+    def start(self):
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def stop(self):
+        self._stopping = True
+        self._t.join(timeout=5.0)
+
+    def _run(self):
+        while not getattr(self, "_stopping", False):
+            pass

@@ -46,8 +46,10 @@ def _fixture_cases():
         if not name.endswith(".py"):
             continue
         if name.endswith("_bad.py"):
-            rule = name[:-len("_bad.py")].replace("_", "-")
-            bad.append((name, rule))
+            # `rule__variant_bad.py` names an extra fixture for `rule`
+            # (e.g. lock_order_cycle__interproc_bad.py)
+            stem = name[:-len("_bad.py")].split("__")[0]
+            bad.append((name, stem.replace("_", "-")))
         else:
             good.append(name)
     return bad, good
@@ -128,6 +130,34 @@ def test_rule_subset_filter():
         run([path], root=REPO, rules=["no-such-rule"])
 
 
+def test_interproc_fixtures_invisible_to_intra_pass():
+    # the acceptance bar for paddle_tpu.analysis.interlock: the plain
+    # lock_discipline pass must see NOTHING in these fixtures, while
+    # the full runner (which adds the interprocedural pass) trips the
+    # rule — proving the cross-method cases are genuinely new coverage
+    from paddle_tpu.analysis import lock_discipline
+    from paddle_tpu.analysis.core import SourceFile
+    for name, rule in _BAD:
+        if "__interproc" not in name:
+            continue
+        path = os.path.join(FIXTURES, name)
+        src = SourceFile.load(path, os.path.relpath(path, REPO))
+        assert lock_discipline.analyze(src) == [], name
+        assert {f.rule for f in run([path], root=REPO)} == {rule}
+
+
+def test_lint_cache_warm_run_is_fast():
+    run(["paddle_tpu", "tools", "tests"], root=REPO)        # prime
+    t0 = time.perf_counter()
+    warm = run(["paddle_tpu", "tools", "tests"], root=REPO)
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 2.0, f"warm lint took {elapsed:.1f}s (budget 2s)"
+    cold = run(["paddle_tpu", "tools", "tests"], root=REPO,
+               cache=False)
+    assert sorted((f.fingerprint, f.line) for f in warm) == \
+        sorted((f.fingerprint, f.line) for f in cold)
+
+
 # ------------------------------------------------------------------- CLI
 def test_cli_default_run_is_green(capsys):
     assert _lint_main()([]) == 0
@@ -168,6 +198,59 @@ def test_cli_baseline_workflow(tmp_path, capsys):
     # --no-baseline reports everything regardless
     assert main([str(bad), "--baseline", str(bl),
                  "--no-baseline"]) == 1
+
+
+def test_cli_update_baseline_merges_unlisted_rules(tmp_path, capsys):
+    # --rules X --update-baseline must only rewrite X's entries;
+    # everything else in the baseline survives (merge, not clobber —
+    # same contract as perf_gate.py)
+    bad = tmp_path / "mixed.py"
+    bad.write_text(
+        "import threading\n"
+        "import time\n\n\n"
+        "def elapsed(t0):\n"
+        "    return time.time() - t0\n\n\n"
+        "def worker():\n"
+        "    try:\n"
+        "        time.sleep(0)\n"
+        "    except Exception:\n"
+        "        pass\n\n\n"
+        "def main():\n"
+        "    t = threading.Thread(target=worker)\n"
+        "    t.start()\n"
+        "    t.join()\n")
+    bl = tmp_path / "baseline.json"
+    main = _lint_main()
+    assert main([str(bad), "--baseline", str(bl),
+                 "--update-baseline"]) == 0
+    rules_in = {e["rule"] for e in json.load(open(bl))["findings"]}
+    assert rules_in == {"wall-clock-duration", "thread-bare-except"}
+    # rerun restricted to one rule: the other rule's entry must survive
+    assert main([str(bad), "--baseline", str(bl),
+                 "--rules", "wall-clock-duration",
+                 "--update-baseline"]) == 0
+    rules_after = {e["rule"] for e in json.load(open(bl))["findings"]}
+    assert rules_after == {"wall-clock-duration", "thread-bare-except"}
+    assert main([str(bad), "--baseline", str(bl)]) == 0
+
+
+def test_cli_update_baseline_preserves_why(tmp_path, capsys):
+    bad = tmp_path / "span.py"
+    bad.write_text("import time\n\n\n"
+                   "def elapsed(t0):\n"
+                   "    return time.time() - t0\n")
+    bl = tmp_path / "baseline.json"
+    main = _lint_main()
+    assert main([str(bad), "--baseline", str(bl),
+                 "--update-baseline"]) == 0
+    data = json.load(open(bl))
+    data["findings"][0]["why"] = "duration math is the point here"
+    bl.write_text(json.dumps(data))
+    # justifications are keyed by fingerprint and must survive a rerun
+    assert main([str(bad), "--baseline", str(bl),
+                 "--update-baseline"]) == 0
+    entry = json.load(open(bl))["findings"][0]
+    assert entry["why"] == "duration math is the point here"
 
 
 def test_cli_json_output(capsys):

@@ -23,7 +23,8 @@ import sys
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO_ROOT)
 
-from paddle_tpu.analysis import (ALL_RULES, load_baseline, partition,  # noqa: E402
+from paddle_tpu.analysis import (ALL_RULES, load_baseline,  # noqa: E402
+                                 load_baseline_entries, partition,
                                  render_json, render_text, run,
                                  save_baseline)
 
@@ -50,7 +51,12 @@ def main(argv=None) -> int:
                     help="ignore the baseline; report every finding")
     ap.add_argument("--update-baseline", action="store_true",
                     help="write the current findings as the new "
-                         "baseline and exit 0")
+                         "baseline and exit 0; with --rules, entries "
+                         "for unlisted rules are kept (merge, not "
+                         "clobber)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="bypass the per-file result cache "
+                         "(.lint_cache/)")
     ap.add_argument("--list-rules", action="store_true",
                     help="list rule ids and exit")
     args = ap.parse_args(argv)
@@ -67,15 +73,27 @@ def main(argv=None) -> int:
     paths = args.paths or DEFAULT_PATHS
 
     try:
-        findings = run(paths, root=_REPO_ROOT, rules=rules)
+        findings = run(paths, root=_REPO_ROOT, rules=rules,
+                       cache=not args.no_cache)
     except ValueError as e:
         print(f"lint.py: {e}", file=sys.stderr)
         return 2
 
     if args.update_baseline:
-        save_baseline(args.baseline, findings)
-        print(f"wrote {len(findings)} finding"
-              f"{'' if len(findings) == 1 else 's'} to "
+        old = load_baseline_entries(args.baseline)
+        # with a rule filter active, this run only saw `rules` —
+        # entries for every other rule must survive (merge semantics,
+        # mirroring perf_gate.py's --update-baseline)
+        kept = [e for e in old if rules is not None
+                and e.get("rule") not in set(rules)]
+        why = {e["fingerprint"]: e["why"] for e in old if e.get("why")}
+        entries = kept + [f.to_dict() for f in findings]
+        for e in entries:
+            if e["fingerprint"] in why:
+                e["why"] = why[e["fingerprint"]]
+        save_baseline(args.baseline, entries)
+        print(f"wrote {len(entries)} finding"
+              f"{'' if len(entries) == 1 else 's'} to "
               f"{os.path.relpath(args.baseline, _REPO_ROOT)}")
         return 0
 
