@@ -138,6 +138,12 @@ define_flag("FLAGS_resource_peak_tflops", 0.0,
 define_flag("FLAGS_resource_memory_poll_steps", 16,
             "sample device memory_stats()/host RSS every N engine host "
             "syncs (a host round-trip per device; 0 disables polling)")
+define_flag("FLAGS_serving_mesh_tp", 1,
+            "serving tensor-parallel mesh size: shard attention heads, "
+            "the FFN hidden dim, and the paged KV pool across the "
+            "first N local devices (1 = single-chip; create_engine/"
+            "serve --mesh overrides; CPU testing needs XLA_FLAGS="
+            "--xla_force_host_platform_device_count=N)")
 define_flag("FLAGS_sanitizer", False,
             "enable the runtime concurrency sanitizer: serving/"
             "observability locks become instrumented wrappers that "

@@ -192,12 +192,23 @@ class ResourceTracker:
             self._phase_s: dict[str, float] = {}
             self._n_params = 0
             self._device_kind: str | None = None
+            self._mesh: dict[str, dict] = {}
 
     # ----------------------------------------------------------- feeding
     def set_model(self, *, n_params: int, device_kind: str | None):
         with self._lock:
             self._n_params = int(n_params)
             self._device_kind = device_kind
+
+    def set_mesh(self, positions: dict[str, dict]):
+        """Register the serving mesh layout: device key ("platform:id",
+        matching :meth:`sample_memory`'s keys) -> axis-position dict
+        (e.g. ``{"tp": 2}``).  Memory samples and snapshots annotate
+        those devices with their mesh position, and every mesh device
+        appears in the memory section even when its backend exports no
+        ``memory_stats()`` (CPU) — per-device coverage is the point."""
+        with self._lock:
+            self._mesh = {str(k): dict(v) for k, v in positions.items()}
 
     def note_phase(self, phase: str, seconds: float):
         """Accumulate engine wall time by phase (prefill / decode /
@@ -257,6 +268,11 @@ class ResourceTracker:
         if rss:
             m["rss"].set(rss)
         with self._lock:
+            # mesh devices always appear, stats or not (CPU backends
+            # export none); positions annotate whatever was sampled
+            for key, pos in self._mesh.items():
+                entry = devices.setdefault(key, {})
+                entry["mesh"] = dict(pos)
             self._devices = devices
             self._rss = rss
             self._mem_samples += 1
@@ -268,6 +284,10 @@ class ResourceTracker:
         retrace log — safe while an engine is wedged."""
         with self._lock:
             devices = {k: dict(v) for k, v in self._devices.items()}
+            # mesh registration shows up even before the first memory
+            # poll — /debug/resources must cover every mesh device
+            for key, pos in self._mesh.items():
+                devices.setdefault(key, {})["mesh"] = dict(pos)
             rss, samples = self._rss, self._mem_samples
             useful, wasted = self._useful, self._wasted
             finishes = dict(self._finishes)

@@ -37,15 +37,40 @@ __all__ = ["paged_attention", "PagedPool", "select_paged_attention",
 _INTERPRET = False
 
 
-def select_paged_attention():
+def select_paged_attention(tp_axis: str | None = None):
     """The paged-attention callable for the active backend: the Pallas
     scalar-prefetch kernel on TPU (or under interpret mode), the
     dense-gather XLA reference on CPU.  Single chooser shared by the
     one-shot paged generate and the serving engine so both always take
-    the same numeric path."""
+    the same numeric path.
+
+    ``tp_axis`` selects the head-parallel path for callers running
+    inside a ``shard_map`` over a tensor-parallel mesh axis: the pools
+    are sharded on the KV-head axis, so each device's q heads attend
+    their own KV heads' pages with the full sequence visible locally —
+    softmax is per-head and the page gather is head-local, so the SAME
+    per-shard kernel applies with NO collective inside attention (the
+    axis name is only used to validate the caller's context).  The
+    wrapper additionally checks that the LOCAL head counts still divide
+    (nh/tp grouped onto kvh/tp), which holds whenever tp divides both —
+    the runner's ``validate_tp`` contract."""
     if jax.default_backend() not in ("cpu",) or _INTERPRET:
-        return paged_attention
-    return paged_attention_xla
+        base = paged_attention
+    else:
+        base = paged_attention_xla
+    if tp_axis is None:
+        return base
+
+    def head_parallel(q, kpool, vpool, table, lens):
+        nh_l, kvh_l = q.shape[1], kpool.shape[1]
+        if kvh_l == 0 or nh_l % kvh_l:
+            raise ValueError(
+                f"head-parallel paged attention: local q heads {nh_l} "
+                f"do not group onto local KV heads {kvh_l} — the tp "
+                "size must divide both head counts")
+        return base(q, kpool, vpool, table, lens)
+
+    return head_parallel
 
 
 def _paged_kernel(table_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,

@@ -25,8 +25,11 @@ Modules:
   * block_manager.py — KV pages: free list / block tables / prefix
                        cache (refcounts, chain index, CoW, LRU)
   * scheduler.py     — FCFS admission, iteration-level eviction, drain
-  * engine.py        — the jitted prefill/decode driver
-                       (device-resident state, deferred host sync)
+  * engine.py        — the prefill/decode driver (host scheduling,
+                       deferred host sync) over a parallel.ModelRunner
+  * parallel/        — mesh-aware ModelRunner: tensor-parallel weight
+                       placement, head-sharded KV pools, and every
+                       jitted program (tp=1 == exact single-chip path)
   * server.py        — OpenAI-compatible HTTP front-end (SSE streaming,
                        backpressure, graceful drain) over one engine
   * router.py        — multi-replica router: prefix-affinity routing,
@@ -51,6 +54,7 @@ from __future__ import annotations
 from .block_manager import BlockManager  # noqa: F401
 from .client import ServingClient, ServingHTTPError  # noqa: F401
 from .engine import Engine, create_engine  # noqa: F401
+from .parallel import ModelRunner, parse_mesh  # noqa: F401
 from .request import GenerationConfig, Request, RequestState  # noqa: F401
 from .router import (  # noqa: F401
     NoReplicaAvailable, Replica, Router, RouterServer)
@@ -61,8 +65,9 @@ from .slo import SLOConfig, SLOTracker  # noqa: F401
 from .watchdog import Watchdog  # noqa: F401
 
 __all__ = ["BackpressureError", "BlockManager", "DrainingError", "Engine",
-           "EngineWorker", "GenerationConfig", "NoReplicaAvailable",
-           "Replica", "Request", "RequestState", "Router", "RouterServer",
-           "SLOConfig", "SLOTracker", "Scheduler", "ServingClient",
-           "ServingHTTPError", "ServingServer", "Watchdog",
-           "create_engine", "serve"]
+           "EngineWorker", "GenerationConfig", "ModelRunner",
+           "NoReplicaAvailable", "Replica", "Request", "RequestState",
+           "Router", "RouterServer", "SLOConfig", "SLOTracker",
+           "Scheduler", "ServingClient", "ServingHTTPError",
+           "ServingServer", "Watchdog", "create_engine", "parse_mesh",
+           "serve"]

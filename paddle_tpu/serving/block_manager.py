@@ -327,6 +327,27 @@ class BlockManager:
                 "allocated_total": self.pages_allocated,
                 "leak": self.num_pages - (live + cached + free)}
 
+    def pool_bytes(self, *, num_layers: int, num_kv_heads: int,
+                   head_dim: int, dtype_itemsize: int,
+                   tp: int = 1) -> dict:
+        """KV pool sizing for the engine's pool arrays, head-sharded
+        over a tp-way mesh.  The pool the runner builds is
+        ``2 * [L, num_pages+1, kvh, page_size, hd]`` (k + v, one extra
+        dump row); sharding along the head axis divides exactly that by
+        ``tp`` per device, while the page table (and this manager's
+        whole accounting) stays host-side and mesh-agnostic — the same
+        page ids address every shard."""
+        if tp < 1 or num_kv_heads % tp:
+            raise ValueError(
+                f"tp={tp} must be >= 1 and divide num_kv_heads="
+                f"{num_kv_heads} (the pool shards along the head axis)")
+        rows = self.num_pages + 1           # + dump page
+        total = (2 * num_layers * rows * num_kv_heads * self.page_size
+                 * head_dim * dtype_itemsize)
+        return {"total_bytes": total,
+                "per_device_bytes": total // tp,
+                "rows": rows, "tp": tp}
+
     def _reclaimable(self) -> int:
         """Parked LRU pages an allocator under pressure could actually
         recycle: leaf-first eviction frees a parked page only once every

@@ -32,6 +32,15 @@ the reference block_multi_head_attention serving path):
     lockstep writes land in scratch, their outputs are discarded
     host-side — no masking inside the program.
 
+The device half of all of this — weight placement, the KV pools, the
+decode state, and the jitted programs themselves — lives in a
+:class:`~paddle_tpu.serving.parallel.ModelRunner` (the engine never
+owns a jit directly).  The runner optionally spans a tensor-parallel
+mesh (``mesh=`` / ``FLAGS_serving_mesh_tp``): heads and the FFN hidden
+dim shard across the ``tp`` axis, the pool shards along the head axis,
+and the engine's host-side page table and scheduling stay mesh-
+agnostic.  ``tp=1`` is exactly the single-chip programs.
+
 Sampling is host-side per request (greedy = argmax of the step's f32
 logits, matching ``_sample``'s greedy branch exactly; stochastic
 requests draw from a per-request numpy RNG so results do not depend on
@@ -45,31 +54,20 @@ from __future__ import annotations
 import time
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from .. import observability as _obs
 from ..flags import FLAGS
-from ..observability.resources import record_compile, resource_tracker
-from ..models.generation import (GenerationConfig, _decode_layer_paged,
-                                 _layer_weights, _mm, _prefill_layer,
-                                 _qkv_proj, _rope_at)
-from ..models.llama import LlamaConfig, _rope_tables, _rotate_half
-from ..models.llama_hybrid import _rms
-from ..ops.pallas.paged_attention import gather_kv_pages
+from ..observability.resources import resource_tracker
+from ..models.generation import GenerationConfig
+from ..models.llama import LlamaConfig
 from .block_manager import BlockManager
+from .parallel import ModelRunner, parse_mesh
 from .request import Request, RequestState
 from .scheduler import Scheduler
 
 __all__ = ["Engine", "create_engine"]
 
-_M_STEP_TRACES = _obs.counter(
-    "serving_decode_step_traces_total",
-    "decode-step jit traces — continuous batching keeps this at 1 per "
-    "engine; growth means admissions are re-tracing")
-_M_PREFILL_TRACES = _obs.counter(
-    "serving_prefill_traces_total",
-    "prefill jit traces (one per prompt-length bucket)", ("bucket",))
 _M_STEPS = _obs.counter(
     "serving_decode_steps_total", "engine decode iterations")
 _M_TOKENS = _obs.counter(
@@ -125,7 +123,7 @@ class Engine:
                  emit_logits: bool = False,
                  enable_prefix_cache: bool = False,
                  sync_interval: int = 1, clock=time.monotonic,
-                 slo=None):
+                 slo=None, mesh=None):
         if model is not None:
             from ..framework.tensor import Tensor
             config = model.config
@@ -153,6 +151,9 @@ class Engine:
             raise ValueError(
                 f"sync_interval must be >= 1, got {sync_interval}")
         self._clock = clock
+        if mesh is None:
+            mesh = int(FLAGS.get("FLAGS_serving_mesh_tp") or 1)
+        self.tp = parse_mesh(mesh)
 
         self.blocks = BlockManager(
             num_pages, self.page_size,
@@ -169,15 +170,21 @@ class Engine:
         L = config.num_hidden_layers
         kvh, hd = config.num_key_value_heads, config.head_dim
         dtype = state["llama.embed_tokens.weight"].dtype
-        pool_rows = self.blocks.num_pages + 1        # + dump page
-        self.kpool = jnp.zeros((L, pool_rows, kvh, self.page_size, hd),
-                               dtype)
-        self.vpool = jnp.zeros((L, pool_rows, kvh, self.page_size, hd),
-                               dtype)
-        self._rope_len = self.table_width * self.page_size
-        cos, sin = _rope_tables(self._rope_len, hd, config.rope_theta)
-        self._cos = cos.astype(jnp.float32)
-        self._sin = sin.astype(jnp.float32)
+        # head-sharded pool sizing: the BlockManager knows how many
+        # bytes each mesh position holds, the runner reports it
+        sizing = self.blocks.pool_bytes(
+            num_layers=L, num_kv_heads=kvh, head_dim=hd,
+            dtype_itemsize=int(np.dtype(dtype).itemsize), tp=self.tp)
+        # the device half: mesh, weight placement, pools, decode state,
+        # and every jitted program live behind the runner seam
+        self.runner = ModelRunner(
+            config, state, tp=self.tp, max_slots=self.max_slots,
+            page_size=self.page_size, table_width=self.table_width,
+            num_pages=self.blocks.num_pages,
+            dump_page=self.blocks.dump_page,
+            sync_interval=self.sync_interval,
+            emit_logits=self.emit_logits,
+            per_device_pool_bytes=sizing["per_device_bytes"])
 
         # host-side mirrors of the slot state (bookkeeping + targeted
         # device patches on admit/evict; NEVER re-uploaded per step)
@@ -186,21 +193,12 @@ class Engine:
         self._pos = np.zeros((self.max_slots,), np.int32)
         self._tok = np.zeros((self.max_slots,), np.int32)
         self._active = np.zeros((self.max_slots,), np.int32)
-        # ... and the device-resident truth the decode step runs on
-        self._table_dev = jnp.asarray(self.table)
-        self._pos_dev = jnp.asarray(self._pos)
-        self._tok_dev = jnp.asarray(self._tok)
-        self._active_dev = jnp.asarray(self._active)
-        self._ring_dev = jnp.zeros((self.sync_interval, self.max_slots),
-                                   jnp.int32)
-        self._ridx_dev = jnp.zeros((), jnp.int32)
-        self._ring_cursor = 0           # host mirror of _ridx_dev
+        self._ring_cursor = 0           # host mirror of the ring index
         # ring rows the host has not consumed yet:
         # [(ring row, [(slot, request), ...]), ...] in decode order
         self._pending: list[tuple[int, list]] = []
         self._last_logits = None        # device handle, fetched lazily
 
-        self.decode_traces = 0      # python-side mirror of _M_STEP_TRACES
         self.decode_steps = 0       # mirror of serving_decode_steps_total
         self.host_syncs = 0         # ring fetches (1 per sync_interval)
         self.logit_fetches = 0      # [slots, V] transfers (sampling only)
@@ -224,19 +222,6 @@ class Engine:
             "pages-in-use sampled at each decode step",
             buckets=_pages_buckets(self.blocks.num_pages))
 
-        # donate everything the step rewrites: pools, pos/tok, the ring
-        # and its cursor — steady-state decode double-buffers nothing
-        self._step_fn = jax.jit(self._build_step(),
-                                donate_argnums=(1, 2, 4, 5, 7, 8))
-        self._prefill_fns: dict[int, object] = {}   # bucket -> jitted fn
-        self._prefill_cached_fns: dict[int, object] = {}
-        # CoW page copy: src/dst are data — one trace for the engine
-        self._copy_page_fn = jax.jit(
-            lambda kp, vp, src, dst: (kp.at[:, dst].set(kp[:, src]),
-                                      vp.at[:, dst].set(vp[:, src])),
-            donate_argnums=(0, 1))
-        self._copy_page_compiled = False    # compile-ledger first-call
-
         # resource tracker: model size + device kind feed the MFU
         # estimate (tokens/s * 2 * n_params / peak_flops)
         n_params = sum(int(np.prod(v.shape))
@@ -248,144 +233,28 @@ class Engine:
         resource_tracker().set_model(n_params=n_params,
                                      device_kind=device_kind)
 
-    # ------------------------------------------------------ jitted bodies
-    def _build_step(self):
-        cfg = self.config
-        L = cfg.num_hidden_layers
-        emit_logits = self.emit_logits
-        rope_len = self._rope_len
-        engine = self
+    # ------------------------------------------------ runner delegation
+    # python-side mirror of serving_decode_step_traces_total: counted at
+    # trace time inside the runner's step body (the no-retrace contract)
+    @property
+    def decode_traces(self) -> int:
+        return self.runner.decode_traces
 
-        def step(state, kpool, vpool, table, pos, tok, active, ring,
-                 ridx, cos, sin):
-            # python body runs at trace time only: a second execution of
-            # this line means an admission/eviction re-traced the step
-            engine.decode_traces += 1
-            _M_STEP_TRACES.inc()
-            # a finished slot keeps decoding until the next host sync
-            # (deferred-sync overrun); clamp so its rope/table lookups
-            # stay in range — overrun writes land in the slot's own
-            # reserved tail or the dump page, never another sequence
-            posc = jnp.minimum(pos, rope_len - 1)
-            emb = jnp.take(state["llama.embed_tokens.weight"], tok, axis=0)
-            cos1, sin1 = _rope_at(cos, sin, posc)
-            h = emb
-            kps, vps = [], []
-            for i in range(L):
-                w = _layer_weights(state, i)
-                h, kp_, vp_ = _decode_layer_paged(
-                    w, h, kpool[i], vpool[i], table, cos1, sin1, posc, cfg)
-                kps.append(kp_)
-                vps.append(vp_)
-            kpool = jnp.stack(kps)
-            vpool = jnp.stack(vps)
-            h = _rms(h[:, None], state["llama.norm.weight"],
-                     cfg.rms_norm_eps)[:, 0]
-            logits = _logits_of(state, h).astype(jnp.float32)
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            act = active.astype(bool)
-            pos2 = pos + active                 # idle slots stay parked
-            tok2 = jnp.where(act, nxt, tok)     # greedy chains on device
-            ring2 = ring.at[ridx].set(nxt)
-            ridx2 = (ridx + 1) % ring.shape[0]
-            return (kpool, vpool, pos2, tok2, ring2, ridx2,
-                    logits if emit_logits else jnp.zeros((), jnp.float32))
+    @property
+    def kpool(self):
+        return self.runner.kpool
 
-        return step
+    @property
+    def vpool(self):
+        return self.runner.vpool
 
-    def _prefill_fn(self, bucket: int):
-        fn = self._prefill_fns.get(bucket)
-        if fn is not None:
-            return fn
-        cfg = self.config
-        L = cfg.num_hidden_layers
-        ps = self.page_size
-        n_pages = bucket // ps
+    @property
+    def _prefill_fns(self):
+        return self.runner._prefill_fns
 
-        def prefill(state, ids, length, table_row, kpool, vpool, cos, sin):
-            _M_PREFILL_TRACES.labels(str(bucket)).inc()
-            x = jnp.take(state["llama.embed_tokens.weight"], ids, axis=0)
-            pmask = jnp.arange(bucket)[None, :] < length
-            for i in range(L):
-                w = _layer_weights(state, i)
-                x, k, v = _prefill_layer(w, x, cos[:bucket], sin[:bucket],
-                                         pmask, cfg)
-                for p in range(n_pages):
-                    rows_k = k[0, p * ps:(p + 1) * ps].swapaxes(0, 1)
-                    rows_v = v[0, p * ps:(p + 1) * ps].swapaxes(0, 1)
-                    kpool = kpool.at[i, table_row[p]].set(rows_k)
-                    vpool = vpool.at[i, table_row[p]].set(rows_v)
-            x = _rms(x, state["llama.norm.weight"], cfg.rms_norm_eps)
-            last = jnp.take_along_axis(
-                x, (length - 1)[:, None, None].astype(jnp.int32),
-                axis=1)[:, 0]
-            logits = _logits_of(state, last).astype(jnp.float32)
-            return kpool, vpool, logits
-
-        # kpool/vpool donation: prefill updates the pool in place instead
-        # of double-buffering the engine's whole KV footprint per admit
-        fn = jax.jit(prefill, donate_argnums=(4, 5))
-        self._prefill_fns[bucket] = fn
-        return fn
-
-    def _prefill_cached_fn(self, bucket: int):
-        """Suffix prefill for a prompt whose first ``cached_len`` tokens
-        are already resident in the pool (shared prefix pages and/or a
-        CoW-copied tail).  One trace per suffix bucket: the prefix
-        length, table row, and positions are all data."""
-        fn = self._prefill_cached_fns.get(bucket)
-        if fn is not None:
-            return fn
-        cfg = self.config
-        L = cfg.num_hidden_layers
-        kvh = cfg.num_key_value_heads
-        ps = self.page_size
-        W = self.table_width
-        dump = self.blocks.dump_page
-        rope_len = self._rope_len
-
-        def prefill(state, ids, length, cached_len, row, kpool, vpool,
-                    cos, sin):
-            _M_PREFILL_TRACES.labels(f"cached:{bucket}").inc()
-            x = jnp.take(state["llama.embed_tokens.weight"], ids, axis=0)
-            j = jnp.arange(bucket)
-            absp = cached_len + j               # absolute positions
-            posc = jnp.minimum(absp, rope_len - 1)
-            cos_s = jnp.take(cos, posc, axis=0)
-            sin_s = jnp.take(sin, posc, axis=0)
-            # suffix queries see: resident prefix keys (< cached_len),
-            # then causal within the (padded) suffix
-            t_pre = jnp.arange(W * ps)
-            pre_ok = jnp.broadcast_to(t_pre[None, :] < cached_len,
-                                      (bucket, W * ps))
-            suf_ok = (j[None, :] <= j[:, None]) & (j[None, :] < length[0])
-            mask = jnp.concatenate([pre_ok, suf_ok], axis=1)[None, None]
-            # per-token write targets (padding lands on the dump page)
-            valid = j < length[0]
-            page_w = jnp.where(valid,
-                               row[jnp.minimum(absp // ps, W - 1)], dump)
-            off = absp % ps
-            heads = jnp.arange(kvh)
-            for i in range(L):
-                w = _layer_weights(state, i)
-                kpre = gather_kv_pages(kpool[i], row)
-                vpre = gather_kv_pages(vpool[i], row)
-                x, k, v = _prefill_layer_cached(
-                    w, x, kpre[None], vpre[None], cos_s, sin_s, mask, cfg)
-                kpool = kpool.at[i, page_w[:, None], heads[None, :],
-                                 off[:, None]].set(k[0])
-                vpool = vpool.at[i, page_w[:, None], heads[None, :],
-                                 off[:, None]].set(v[0])
-            x = _rms(x, state["llama.norm.weight"], cfg.rms_norm_eps)
-            last = jnp.take_along_axis(
-                x, (length - 1)[:, None, None].astype(jnp.int32),
-                axis=1)[:, 0]
-            logits = _logits_of(state, last).astype(jnp.float32)
-            return kpool, vpool, logits
-
-        fn = jax.jit(prefill, donate_argnums=(5, 6))
-        self._prefill_cached_fns[bucket] = fn
-        return fn
+    @property
+    def _prefill_cached_fns(self):
+        return self.runner._prefill_cached_fns
 
     # ----------------------------------------------------------- intake
     def submit(self, prompt, gen: GenerationConfig | None = None, *,
@@ -486,47 +355,19 @@ class Engine:
         if meta["cow_src"] is not None:
             # copy-on-write: duplicate the matching tail page into this
             # request's own tail before any of its writes land there
-            cow_fresh = not self._copy_page_compiled
-            cow_t0 = time.perf_counter()
-            self.kpool, self.vpool = self._copy_page_fn(
-                self.kpool, self.vpool,
-                jnp.asarray(meta["cow_src"], jnp.int32),
-                jnp.asarray(int(row[cached // ps]), jnp.int32))
-            if cow_fresh:
-                self._copy_page_compiled = True
-                record_compile("copy_page", cow_t0,
-                               signature=f"pool={self.kpool.shape}")
+            self.runner.copy_page(int(meta["cow_src"]),
+                                  int(row[cached // ps]))
         if cached == 0:
             bucket = -(-plen // ps) * ps
             ids = np.zeros((1, bucket), np.int32)
             ids[0, :plen] = req.prompt
-            jit_fresh = bucket not in self._prefill_fns
-            fn = self._prefill_fn(bucket)
-            jit_t0 = time.perf_counter()
-            self.kpool, self.vpool, logits = fn(
-                self.state, jnp.asarray(ids),
-                jnp.asarray([plen], jnp.int32),
-                jnp.asarray(row[:bucket // ps]),
-                self.kpool, self.vpool, self._cos, self._sin)
-            if jit_fresh:
-                record_compile(f"prefill[{bucket}]", jit_t0,
-                               signature=f"ids=[1,{bucket}]")
+            logits = self.runner.prefill(ids, plen, row)
         else:
             suffix = plen - cached
             bucket = -(-suffix // ps) * ps
             ids = np.zeros((1, bucket), np.int32)
             ids[0, :suffix] = req.prompt[cached:]
-            jit_fresh = bucket not in self._prefill_cached_fns
-            fn = self._prefill_cached_fn(bucket)
-            jit_t0 = time.perf_counter()
-            self.kpool, self.vpool, logits = fn(
-                self.state, jnp.asarray(ids),
-                jnp.asarray([suffix], jnp.int32),
-                jnp.asarray(cached, jnp.int32), jnp.asarray(row),
-                self.kpool, self.vpool, self._cos, self._sin)
-            if jit_fresh:
-                record_compile(f"prefill_cached[{bucket}]", jit_t0,
-                               signature=f"ids=[1,{bucket}]")
+            logits = self.runner.prefill_cached(ids, suffix, cached, row)
         req.num_cached_tokens = cached
         _M_HOST_SYNCS.labels("prefill").inc()
         tok = self._pick_token(req, np.asarray(logits)[0])
@@ -565,18 +406,8 @@ class Engine:
             self._seg_steps = 0
         self._seg_steps += 1
         reqs = [(s, self.scheduler.slots[s]) for s in active]
-        traces_before = self.decode_traces
         step_t0 = time.perf_counter()
-        (self.kpool, self.vpool, self._pos_dev, self._tok_dev,
-         self._ring_dev, self._ridx_dev, logits) = self._step_fn(
-            self.state, self.kpool, self.vpool, self._table_dev,
-            self._pos_dev, self._tok_dev, self._active_dev,
-            self._ring_dev, self._ridx_dev, self._cos, self._sin)
-        if self.decode_traces != traces_before:
-            record_compile(
-                "decode_step", step_t0,
-                signature=f"slots={self.max_slots} "
-                          f"ring={self.sync_interval}")
+        logits = self.runner.decode_step()
         self._note_phase("decode", time.perf_counter() - step_t0)
         self.decode_steps += 1
         _M_STEPS.inc()
@@ -597,7 +428,7 @@ class Engine:
         """Drain the device token ring: ONE [sync_interval, slots] int32
         transfer covers every decode step since the previous sync."""
         sync_t0 = time.perf_counter()
-        ring = np.asarray(self._ring_dev)
+        ring = self.runner.fetch_ring()
         sync_s = time.perf_counter() - sync_t0
         self.host_syncs += 1
         self._note_phase("host_sync", sync_s)
@@ -649,9 +480,7 @@ class Engine:
                 "engine.sample", sample_t0, time.perf_counter(),
                 attributes={"corrections": len(corrections)})
         if corrections:
-            idx = jnp.asarray([s for s, _ in corrections], jnp.int32)
-            val = jnp.asarray([t for _, t in corrections], jnp.int32)
-            self._tok_dev = self._tok_dev.at[idx].set(val)
+            self.runner.correct_tokens(corrections)
 
     def _note_phase(self, phase: str, seconds: float):
         """Charge engine wall time to a phase: the per-engine mirror,
@@ -686,12 +515,9 @@ class Engine:
     def _push_slot(self, slot: int):
         """Patch ONE slot's row of the device-resident decode state from
         the host mirrors (admission / eviction only — never per step)."""
-        self._table_dev = self._table_dev.at[slot].set(
-            jnp.asarray(self.table[slot]))
-        self._pos_dev = self._pos_dev.at[slot].set(int(self._pos[slot]))
-        self._tok_dev = self._tok_dev.at[slot].set(int(self._tok[slot]))
-        self._active_dev = self._active_dev.at[slot].set(
-            int(self._active[slot]))
+        self.runner.push_slot(slot, self.table[slot],
+                              int(self._pos[slot]), int(self._tok[slot]),
+                              int(self._active[slot]))
 
     # --------------------------------------------------------- sampling
     def _pick_token(self, req: Request, logits: np.ndarray) -> int:
@@ -781,6 +607,7 @@ class Engine:
             "logit_fetches": self.logit_fetches,
             "decode_steps": self.decode_steps,
             "pages_allocated": b.pages_allocated,
+            "mesh_tp": self.tp,
             "timings": {k: round(v, 6) for k, v in self.timings.items()},
             "progress": self.progress,
             "slo": self.slo.stats() if self.slo is not None else None,
@@ -790,8 +617,9 @@ class Engine:
         """Engine-local half of ``GET /debug/resources``: the exact
         pool census (live/cached/free with a leak check), per-resident-
         request page footprints, fragmentation against the queue head,
-        and the phase timing breakdown.  The process-wide tracker
-        snapshot (memory/compiles/goodput) complements it."""
+        per-mesh-device memory from the runner, and the phase timing
+        breakdown.  The process-wide tracker snapshot (memory/compiles/
+        goodput) complements it."""
         b = self.blocks
         head_need = None
         if self.scheduler.queue:
@@ -809,6 +637,7 @@ class Engine:
         return {
             "pool": pool,
             "requests": requests,
+            "mesh": self.runner.mesh_info(),
             "timings": {k: round(v, 6) for k, v in self.timings.items()},
             "counters": {
                 "decode_steps": self.decode_steps,
@@ -820,49 +649,10 @@ class Engine:
         }
 
 
-def _prefill_layer_cached(w, x, kpre, vpre, cos_s, sin_s, mask,
-                          cfg: LlamaConfig):
-    """One transformer layer of suffix prefill against a resident
-    prefix: ``x`` [1, S, H] suffix hidden, ``kpre``/``vpre``
-    [1, Tpre, kvH, D] prefix KV gathered from the pool (keys already
-    rotary-encoded at their absolute positions, exactly as prefill and
-    decode wrote them), ``mask`` [1, 1, S, Tpre+S] bool.  Returns
-    (out, k_suffix, v_suffix) — mirror of ``_prefill_layer``."""
-    b, s, _ = x.shape
-    nh, kvh, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
-                   cfg.head_dim)
-    h = _rms(x, w["ln1"], cfg.rms_norm_eps)
-    qp, kp, vp = _qkv_proj(w, h, nh, kvh, hd)
-    q = qp.reshape(b, s, nh, hd)
-    k = kp.reshape(b, s, kvh, hd)
-    v = vp.reshape(b, s, kvh, hd)
-    cos_c = cos_s[None, :, None, :].astype(q.dtype)
-    sin_c = sin_s[None, :, None, :].astype(q.dtype)
-    q = q * cos_c + _rotate_half(q) * sin_c
-    k = k * cos_c + _rotate_half(k) * sin_c
-
-    from ..ops.pallas.flash_attention import sdpa
-    kcat = jnp.concatenate([kpre.astype(k.dtype), k], axis=1)
-    vcat = jnp.concatenate([vpre.astype(v.dtype), v], axis=1)
-    attn = sdpa(q, kcat, vcat, attn_mask=mask,
-                is_causal=False).reshape(b, s, nh * hd)
-    x = x + _mm(attn, w["o"])
-    h = _rms(x, w["ln2"], cfg.rms_norm_eps)
-    from ..models.generation import _ffn
-    return (x + _ffn(w, h), k, v)
-
-
 def _softmax(x):
     x = x - np.max(x[np.isfinite(x)]) if np.isfinite(x).any() else x
     e = np.exp(np.where(np.isfinite(x), x, -np.inf))
     return e / e.sum()
-
-
-def _logits_of(state, h):
-    head = state.get("lm_head.weight")
-    if head is not None:
-        return _mm(h, head)
-    return h @ state["llama.embed_tokens.weight"].T
 
 
 def _pages_buckets(num_pages):
@@ -881,7 +671,7 @@ def create_engine(model, *, max_slots: int = 4, page_size: int = 64,
                   emit_logits: bool = False,
                   enable_prefix_cache: bool = False,
                   sync_interval: int = 1, clock=time.monotonic,
-                  slo=None) -> Engine:
+                  slo=None, mesh=None) -> Engine:
     """`create_predictor`-style entry point: build a continuous-batching
     engine over a LlamaForCausalLM (or any model exposing ``config`` and
     ``functional_state()`` with the llama state-dict layout).
@@ -892,6 +682,13 @@ def create_engine(model, *, max_slots: int = 4, page_size: int = 64,
     greedy decode loop run N device steps between host syncs (tokens
     stream out in bursts of N — lower sync overhead, higher streaming
     latency; sampling requests force per-step syncs regardless).
+
+    ``mesh`` selects the tensor-parallel mesh: an int / ``"tp=N"`` /
+    1-tuple tp size (default: ``FLAGS_serving_mesh_tp``).  ``tp>1``
+    shards attention heads, the FFN hidden dim, and the paged KV pool
+    across the first N local devices; greedy outputs are token-exact
+    against ``tp=1``.  For CPU testing export
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` first.
 
     Example::
 
@@ -905,4 +702,5 @@ def create_engine(model, *, max_slots: int = 4, page_size: int = 64,
                   num_pages=num_pages, max_model_len=max_model_len,
                   emit_logits=emit_logits,
                   enable_prefix_cache=enable_prefix_cache,
-                  sync_interval=sync_interval, clock=clock, slo=slo)
+                  sync_interval=sync_interval, clock=clock, slo=slo,
+                  mesh=mesh)

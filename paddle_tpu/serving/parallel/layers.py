@@ -1,0 +1,132 @@
+"""Tensor-parallel transformer layers for the serving runner.
+
+Per-shard mirrors of ``models/generation._decode_layer_paged``,
+``_prefill_layer``, and ``serving/engine._prefill_layer_cached``,
+written to run inside a ``shard_map`` over the ``tp`` mesh axis:
+
+  * q/k/v, gate, and up are column-sharded — each device projects its
+    own ``nh/tp`` query heads, ``kvh/tp`` KV heads, and ``I/tp`` FFN
+    columns, so local head counts come from the weight shard shapes;
+  * attention over the paged pool is head-parallel (each head's softmax
+    sees its full sequence locally — the pool is sharded on the head
+    axis, not the token axis), so no collective runs inside attention;
+  * o and down are row-sharded; their partial products are the ONLY two
+    all-reduce points per layer (``psum`` over ``tp``), exactly where
+    Megatron-style TP places them.
+
+Fused/quantized weight paths are intentionally absent: the runner
+rejects fused states for ``tp>1`` up front, so these bodies only see
+plain per-projection arrays.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...models.llama import _rotate_half
+from ...models.llama_hybrid import _rms
+from ...ops.pallas.paged_attention import gather_kv_pages, \
+    select_paged_attention
+
+__all__ = ["decode_layer_paged_tp", "prefill_layer_tp",
+           "prefill_layer_cached_tp"]
+
+
+def _local_qkv(w, h, hd):
+    """Project with the local weight shards; head counts are derived
+    from the shard widths (``nh_local = nh / tp`` etc.)."""
+    q, k, v = h @ w["q"], h @ w["k"], h @ w["v"]
+    return q, k, v, q.shape[-1] // hd, k.shape[-1] // hd
+
+
+def _ffn_tp(w, h, axis):
+    """Column-sharded gate/up, row-sharded down: the partial down
+    product is one of the layer's two all-reduces."""
+    part = (jax.nn.silu(h @ w["gate"]) * (h @ w["up"])) @ w["down"]
+    return jax.lax.psum(part, axis)
+
+
+def decode_layer_paged_tp(w, x, kpool, vpool, table, cos1, sin1, pos,
+                          cfg, axis):
+    """Per-shard paged decode layer: ``x`` [B, H] replicated, pools
+    [P, kvH/tp, ps, D] local, ``table``/``pos`` replicated.  Returns
+    (out replicated, kpool, vpool local) — mirror of
+    ``_decode_layer_paged`` with the o/down all-reduces."""
+    b = x.shape[0]
+    hd = cfg.head_dim
+    ps = kpool.shape[2]
+    h = _rms(x[:, None], w["ln1"], cfg.rms_norm_eps)[:, 0]
+    qp, kp, vp, nh_l, kvh_l = _local_qkv(w, h, hd)
+    q = qp.reshape(b, nh_l, hd)
+    k = kp.reshape(b, kvh_l, hd)
+    v = vp.reshape(b, kvh_l, hd)
+    cos_c = cos1[:, None, :].astype(q.dtype)
+    sin_c = sin1[:, None, :].astype(q.dtype)
+    q = q * cos_c + _rotate_half(q) * sin_c
+    k = k * cos_c + _rotate_half(k) * sin_c
+
+    page = jnp.take_along_axis(table, (pos // ps)[:, None], axis=1)[:, 0]
+    off = pos % ps
+    heads = jnp.arange(kvh_l)
+    kpool = kpool.at[page[:, None], heads[None, :], off[:, None]].set(k)
+    vpool = vpool.at[page[:, None], heads[None, :], off[:, None]].set(v)
+
+    attn = select_paged_attention(tp_axis=axis)(
+        q, kpool, vpool, table, pos + 1).reshape(b, nh_l * hd)
+    x = x + jax.lax.psum(attn @ w["o"], axis)
+    h = _rms(x[:, None], w["ln2"], cfg.rms_norm_eps)[:, 0]
+    return x + _ffn_tp(w, h, axis), kpool, vpool
+
+
+def prefill_layer_tp(w, x, cos, sin, mask, cfg, axis):
+    """Per-shard prefill layer: ``x`` [B, S, H] replicated; returns
+    (out replicated, k/v caches [B, S, kvH/tp, D] local)."""
+    b, s, _ = x.shape
+    hd = cfg.head_dim
+    h = _rms(x, w["ln1"], cfg.rms_norm_eps)
+    qp, kp, vp, nh_l, kvh_l = _local_qkv(w, h, hd)
+    q = qp.reshape(b, s, nh_l, hd)
+    k = kp.reshape(b, s, kvh_l, hd)
+    v = vp.reshape(b, s, kvh_l, hd)
+    cos_c = cos[None, :, None, :].astype(q.dtype)
+    sin_c = sin[None, :, None, :].astype(q.dtype)
+    q = q * cos_c + _rotate_half(q) * sin_c
+    k = k * cos_c + _rotate_half(k) * sin_c
+
+    from ...ops.pallas.flash_attention import sdpa
+    attn = sdpa(q, k, v, attn_mask=mask[:, None, None, :],
+                is_causal=True).reshape(b, s, nh_l * hd)
+    x = x + jax.lax.psum(attn @ w["o"], axis)
+    h = _rms(x, w["ln2"], cfg.rms_norm_eps)
+    return x + _ffn_tp(w, h, axis), k, v
+
+
+def prefill_layer_cached_tp(w, x, kpool, vpool, row, cos_s, sin_s, mask,
+                            cfg, axis):
+    """Per-shard cached-suffix prefill layer: suffix queries attend the
+    resident prefix gathered from the LOCAL pool shard (prefix keys for
+    this device's heads live on this device) concatenated with the
+    suffix's own k/v.  Mirror of ``engine._prefill_layer_cached`` plus
+    the o/down all-reduces; returns (out, k_suffix, v_suffix local)."""
+    b, s, _ = x.shape
+    hd = cfg.head_dim
+    h = _rms(x, w["ln1"], cfg.rms_norm_eps)
+    qp, kp, vp, nh_l, kvh_l = _local_qkv(w, h, hd)
+    q = qp.reshape(b, s, nh_l, hd)
+    k = kp.reshape(b, s, kvh_l, hd)
+    v = vp.reshape(b, s, kvh_l, hd)
+    cos_c = cos_s[None, :, None, :].astype(q.dtype)
+    sin_c = sin_s[None, :, None, :].astype(q.dtype)
+    q = q * cos_c + _rotate_half(q) * sin_c
+    k = k * cos_c + _rotate_half(k) * sin_c
+
+    kpre = gather_kv_pages(kpool, row)[None]
+    vpre = gather_kv_pages(vpool, row)[None]
+    from ...ops.pallas.flash_attention import sdpa
+    kcat = jnp.concatenate([kpre.astype(k.dtype), k], axis=1)
+    vcat = jnp.concatenate([vpre.astype(v.dtype), v], axis=1)
+    attn = sdpa(q, kcat, vcat, attn_mask=mask,
+                is_causal=False).reshape(b, s, nh_l * hd)
+    x = x + jax.lax.psum(attn @ w["o"], axis)
+    h = _rms(x, w["ln2"], cfg.rms_norm_eps)
+    return x + _ffn_tp(w, h, axis), k, v

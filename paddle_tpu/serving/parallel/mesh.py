@@ -1,0 +1,76 @@
+"""Serving-mesh construction and validation.
+
+One axis (``tp``) is enough for the serving runner: attention heads and
+the FFN hidden dimension shard along it, everything per-token stays
+replicated.  ``parse_mesh`` accepts every knob spelling the CLI and
+``create_engine`` take (``4``, ``"4"``, ``"tp=4"``, ``(4,)``) so the
+flag, the server argument, and the Python API agree on one parser.
+"""
+from __future__ import annotations
+
+__all__ = ["parse_mesh", "validate_tp", "mesh_devices", "TP_AXIS"]
+
+TP_AXIS = "tp"
+
+
+def parse_mesh(mesh) -> int:
+    """Normalize a mesh knob to the tp size.
+
+    Accepted: ``None`` (-> 1), an int, ``"4"``, ``"tp=4"``, and a
+    1-tuple/list ``(4,)`` (the ISSUE's ``mesh_shape=(1,)`` spelling).
+    """
+    if mesh is None:
+        return 1
+    if isinstance(mesh, (tuple, list)):
+        if len(mesh) != 1:
+            raise ValueError(
+                f"serving mesh has a single tp axis; got shape {mesh!r}")
+        mesh = mesh[0]
+    if isinstance(mesh, str):
+        s = mesh.strip().lower()
+        if s.startswith("tp="):
+            s = s[3:]
+        try:
+            mesh = int(s)
+        except ValueError:
+            raise ValueError(
+                f"cannot parse mesh spec {mesh!r}; expected an int, "
+                f"'tp=N', or a 1-tuple") from None
+    tp = int(mesh)
+    if tp < 1:
+        raise ValueError(f"mesh tp size must be >= 1, got {tp}")
+    return tp
+
+
+def validate_tp(config, tp: int) -> None:
+    """The head-sharded layout's divisibility contract, checked loudly
+    at engine construction instead of as a shape error mid-trace."""
+    if tp == 1:
+        return
+    nh = config.num_attention_heads
+    kvh = config.num_key_value_heads
+    inter = config.intermediate_size
+    for what, n in (("num_attention_heads", nh),
+                    ("num_key_value_heads", kvh),
+                    ("intermediate_size", inter)):
+        if n % tp:
+            raise ValueError(
+                f"tp={tp} must divide {what}={n} (attention heads and "
+                "the FFN hidden dim shard along the tp axis)")
+
+
+def mesh_devices(tp: int):
+    """The first ``tp`` local devices, validated against what the
+    backend actually exposes (on CPU: set ``XLA_FLAGS=--xla_force_"
+    "host_platform_device_count=N`` before jax initializes)."""
+    import jax
+
+    devices = jax.devices()
+    if len(devices) < tp:
+        raise ValueError(
+            f"mesh tp={tp} needs {tp} devices but the "
+            f"{devices[0].platform if devices else '?'} backend exposes "
+            f"{len(devices)} (for CPU testing set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={tp} before jax "
+            "initializes)")
+    return devices[:tp]

@@ -1,0 +1,616 @@
+"""Mesh-aware model runner: the engine's device half.
+
+The :class:`ModelRunner` owns everything that lives on (or is traced
+for) the accelerator side of the serving engine: the weights and their
+placement, the paged KV pools, the rope tables, the device-resident
+decode state (table/pos/tok/active + the sampled-token ring), and the
+four jit families — decode step, per-bucket prefill, per-bucket cached
+prefill, and the CoW page copy.  The engine keeps the host half:
+scheduler, block manager, host mirrors, sampling, and request
+lifecycle.
+
+Two construction modes, selected by ``tp``:
+
+``tp == 1``
+    The exact single-chip programs the engine owned before the runner
+    seam existed — no mesh, no ``shard_map``, no ``device_put`` — so
+    ``mesh_shape=(1,)`` reduces bit-for-bit to the previous behavior.
+
+``tp > 1``
+    A 1-axis ``jax.sharding.Mesh`` over the first ``tp`` devices.
+    q/k/v/gate/up are column-sharded and o/down row-sharded with
+    ``NamedSharding``; embeddings, norms, and the LM head are
+    replicated; the KV pools shard along the head axis
+    (``[L, pages+1, kvh/tp, page_size, hd]`` per device) so the
+    BlockManager's page table stays host-side and mesh-agnostic.  All
+    four jit families run as ``shard_map`` computations whose only
+    collectives are the attention-output and FFN-down ``psum``s
+    (see ``layers.py``).
+
+The engine's serving invariants carry over unchanged: slot occupancy /
+positions / tables are data (ONE decode trace per engine lifetime —
+``decode_traces`` counts them), the decode state is donated through the
+step, and admissions/evictions patch single slot rows in place.
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import observability as _obs
+from ...observability.resources import record_compile, resource_tracker
+from ...models.generation import (_decode_layer_paged, _ffn,
+                                  _layer_weights, _mm, _prefill_layer,
+                                  _qkv_proj, _rope_at)
+from ...models.llama import _rope_tables, _rotate_half
+from ...models.llama_hybrid import _rms
+from ...ops.pallas.paged_attention import gather_kv_pages
+from .layers import (decode_layer_paged_tp, prefill_layer_cached_tp,
+                     prefill_layer_tp)
+from .mesh import TP_AXIS, mesh_devices, validate_tp
+
+__all__ = ["ModelRunner"]
+
+_M_STEP_TRACES = _obs.counter(
+    "serving_decode_step_traces_total",
+    "decode-step jit traces — continuous batching keeps this at 1 per "
+    "engine; growth means admissions are re-tracing")
+_M_PREFILL_TRACES = _obs.counter(
+    "serving_prefill_traces_total",
+    "prefill jit traces (one per prompt-length bucket)", ("bucket",))
+
+# weight suffixes sharded on tp: columns for the input-side projections
+# (each device owns nh/tp query heads, kvh/tp KV heads, I/tp FFN
+# columns), rows for the output-side projections whose partial products
+# the layer all-reduces
+_COL_SHARDED = ("self_attn.q_proj.weight", "self_attn.k_proj.weight",
+                "self_attn.v_proj.weight", "mlp.gate_proj.weight",
+                "mlp.up_proj.weight")
+_ROW_SHARDED = ("self_attn.o_proj.weight", "mlp.down_proj.weight")
+_FUSED_KEYS = ("self_attn.qkv_fused.weight", "mlp.gateup_fused.weight")
+
+
+class ModelRunner:
+    """Device-side serving runner (see module docstring).
+
+    The engine talks to it through a narrow seam: :meth:`decode_step`,
+    :meth:`prefill`, :meth:`prefill_cached`, :meth:`copy_page`,
+    :meth:`push_slot`, :meth:`fetch_ring`, :meth:`correct_tokens`.
+    """
+
+    def __init__(self, config, state: dict, *, tp: int = 1,
+                 max_slots: int, page_size: int, table_width: int,
+                 num_pages: int, dump_page: int, sync_interval: int = 1,
+                 emit_logits: bool = False,
+                 per_device_pool_bytes: int | None = None):
+        self.config = config
+        self.tp = int(tp)
+        self.max_slots = int(max_slots)
+        self.page_size = int(page_size)
+        self.table_width = int(table_width)
+        self.num_pages = int(num_pages)
+        self.dump_page = int(dump_page)
+        self.sync_interval = int(sync_interval)
+        self.emit_logits = bool(emit_logits)
+        validate_tp(config, self.tp)
+
+        L = config.num_hidden_layers
+        kvh, hd = config.num_key_value_heads, config.head_dim
+        dtype = state["llama.embed_tokens.weight"].dtype
+        pool_rows = self.num_pages + 1               # + dump page
+        pool_shape = (L, pool_rows, kvh, self.page_size, hd)
+        self._rope_len = self.table_width * self.page_size
+        cos, sin = _rope_tables(self._rope_len, hd, config.rope_theta)
+        cos = cos.astype(jnp.float32)
+        sin = sin.astype(jnp.float32)
+        table0 = np.full((self.max_slots, self.table_width),
+                         self.dump_page, np.int32)
+
+        if self.tp == 1:
+            self.mesh = None
+            self.devices = list(jax.devices()[:1]) if jax.devices() else []
+            self.state = state
+            self.kpool = jnp.zeros(pool_shape, dtype)
+            self.vpool = jnp.zeros(pool_shape, dtype)
+            self._cos, self._sin = cos, sin
+            self._table_dev = jnp.asarray(table0)
+            self._pos_dev = jnp.zeros((self.max_slots,), jnp.int32)
+            self._tok_dev = jnp.zeros((self.max_slots,), jnp.int32)
+            self._active_dev = jnp.zeros((self.max_slots,), jnp.int32)
+            self._ring_dev = jnp.zeros(
+                (self.sync_interval, self.max_slots), jnp.int32)
+            self._ridx_dev = jnp.zeros((), jnp.int32)
+        else:
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec
+            self._check_state_shardable(state)
+            self.devices = mesh_devices(self.tp)
+            self.mesh = Mesh(np.asarray(self.devices), (TP_AXIS,))
+            self._pool_pspec = PartitionSpec(
+                None, None, TP_AXIS, None, None)
+            rep = NamedSharding(self.mesh, PartitionSpec())
+            self.state = {
+                k: jax.device_put(
+                    v, NamedSharding(self.mesh, self._spec_for(k)))
+                for k, v in state.items()}
+            pool_sh = NamedSharding(self.mesh, self._pool_pspec)
+            self.kpool = jax.device_put(jnp.zeros(pool_shape, dtype),
+                                        pool_sh)
+            self.vpool = jax.device_put(jnp.zeros(pool_shape, dtype),
+                                        pool_sh)
+            self._cos = jax.device_put(cos, rep)
+            self._sin = jax.device_put(sin, rep)
+            self._table_dev = jax.device_put(jnp.asarray(table0), rep)
+            self._pos_dev = jax.device_put(
+                jnp.zeros((self.max_slots,), jnp.int32), rep)
+            self._tok_dev = jax.device_put(
+                jnp.zeros((self.max_slots,), jnp.int32), rep)
+            self._active_dev = jax.device_put(
+                jnp.zeros((self.max_slots,), jnp.int32), rep)
+            self._ring_dev = jax.device_put(
+                jnp.zeros((self.sync_interval, self.max_slots),
+                          jnp.int32), rep)
+            self._ridx_dev = jax.device_put(
+                jnp.zeros((), jnp.int32), rep)
+
+        self.decode_traces = 0      # python mirror of _M_STEP_TRACES
+        self._step_fn = self._make_step_fn()
+        self._prefill_fns: dict[int, object] = {}   # bucket -> jitted fn
+        self._prefill_cached_fns: dict[int, object] = {}
+        self._copy_page_fn = self._make_copy_page_fn()
+        self._copy_page_compiled = False    # compile-ledger first-call
+
+        # per-device footprint estimates + mesh-position registration for
+        # the resource snapshot (CPU devices export no memory_stats, so
+        # /debug/resources reports these alongside whatever stats exist)
+        itemsize = jnp.dtype(dtype).itemsize
+        pool_total = 2 * int(np.prod(pool_shape)) * itemsize
+        self._pool_bytes_per_device = (
+            int(per_device_pool_bytes) if per_device_pool_bytes
+            else pool_total // self.tp)
+        sharded = sum(
+            int(np.prod(v.shape)) * jnp.dtype(v.dtype).itemsize
+            for k, v in state.items()
+            if k.endswith(_COL_SHARDED) or k.endswith(_ROW_SHARDED))
+        replicated = sum(
+            int(np.prod(v.shape)) * jnp.dtype(v.dtype).itemsize
+            for k, v in state.items() if hasattr(v, "shape")) - sharded
+        self._weight_bytes_per_device = sharded // self.tp + replicated
+        resource_tracker().set_mesh({
+            f"{d.platform}:{d.id}": {TP_AXIS: i}
+            for i, d in enumerate(self.devices)})
+
+    # ----------------------------------------------------------- placement
+    @staticmethod
+    def _spec_for(key: str):
+        from jax.sharding import PartitionSpec
+        if key.endswith(_COL_SHARDED):
+            return PartitionSpec(None, TP_AXIS)
+        if key.endswith(_ROW_SHARDED):
+            return PartitionSpec(TP_AXIS, None)
+        return PartitionSpec()      # embeddings / norms / lm_head
+
+    def _check_state_shardable(self, state: dict):
+        for k, v in state.items():
+            if k.endswith(_FUSED_KEYS):
+                raise ValueError(
+                    f"state has fused weight {k!r}: fused/quantized "
+                    "serving states are single-chip only (tp=1) — the "
+                    "tp>1 runner shards the per-projection q/k/v and "
+                    "gate/up weights individually")
+            if not isinstance(v, (np.ndarray, jnp.ndarray)):
+                raise ValueError(
+                    f"state[{k!r}] is {type(v).__name__}, not an array: "
+                    "quantized weights cannot be head-sharded; serve "
+                    "them with tp=1")
+
+    def _state_specs(self):
+        return {k: self._spec_for(k) for k in self.state}
+
+    # ------------------------------------------------------- jitted bodies
+    def _make_step_fn(self):
+        if self.tp == 1:
+            return jax.jit(self._build_step(),
+                           donate_argnums=(1, 2, 4, 5, 7, 8))
+        from jax.sharding import PartitionSpec as P
+        pool = self._pool_pspec
+        mapped = jax.shard_map(
+            self._build_step_tp(), mesh=self.mesh,
+            in_specs=(self._state_specs(), pool, pool, P(), P(), P(),
+                      P(), P(), P(), P(), P()),
+            out_specs=(pool, pool, P(), P(), P(), P(), P()),
+            check_vma=False)
+        return jax.jit(mapped, donate_argnums=(1, 2, 4, 5, 7, 8))
+
+    def _build_step(self):
+        cfg = self.config
+        L = cfg.num_hidden_layers
+        emit_logits = self.emit_logits
+        rope_len = self._rope_len
+        runner = self
+
+        def step(state, kpool, vpool, table, pos, tok, active, ring,
+                 ridx, cos, sin):
+            # python body runs at trace time only: a second execution of
+            # this line means an admission/eviction re-traced the step
+            runner.decode_traces += 1
+            _M_STEP_TRACES.inc()
+            # a finished slot keeps decoding until the next host sync
+            # (deferred-sync overrun); clamp so its rope/table lookups
+            # stay in range — overrun writes land in the slot's own
+            # reserved tail or the dump page, never another sequence
+            posc = jnp.minimum(pos, rope_len - 1)
+            emb = jnp.take(state["llama.embed_tokens.weight"], tok,
+                           axis=0)
+            cos1, sin1 = _rope_at(cos, sin, posc)
+            h = emb
+            kps, vps = [], []
+            for i in range(L):
+                w = _layer_weights(state, i)
+                h, kp_, vp_ = _decode_layer_paged(
+                    w, h, kpool[i], vpool[i], table, cos1, sin1, posc,
+                    cfg)
+                kps.append(kp_)
+                vps.append(vp_)
+            kpool = jnp.stack(kps)
+            vpool = jnp.stack(vps)
+            h = _rms(h[:, None], state["llama.norm.weight"],
+                     cfg.rms_norm_eps)[:, 0]
+            logits = _logits_of(state, h).astype(jnp.float32)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            act = active.astype(bool)
+            pos2 = pos + active                 # idle slots stay parked
+            tok2 = jnp.where(act, nxt, tok)     # greedy chains on device
+            ring2 = ring.at[ridx].set(nxt)
+            ridx2 = (ridx + 1) % ring.shape[0]
+            return (kpool, vpool, pos2, tok2, ring2, ridx2,
+                    logits if emit_logits
+                    else jnp.zeros((), jnp.float32))
+
+        return step
+
+    def _build_step_tp(self):
+        """The shard_map body: same step, per-shard layers.  Everything
+        except the pools is replicated; the head-parallel layers psum at
+        the o/down projections, so the post-norm logits (and therefore
+        the argmax'd next token and the ring) are device-invariant."""
+        cfg = self.config
+        L = cfg.num_hidden_layers
+        emit_logits = self.emit_logits
+        rope_len = self._rope_len
+        runner = self
+
+        def step(state, kpool, vpool, table, pos, tok, active, ring,
+                 ridx, cos, sin):
+            runner.decode_traces += 1
+            _M_STEP_TRACES.inc()
+            posc = jnp.minimum(pos, rope_len - 1)
+            emb = jnp.take(state["llama.embed_tokens.weight"], tok,
+                           axis=0)
+            cos1, sin1 = _rope_at(cos, sin, posc)
+            h = emb
+            kps, vps = [], []
+            for i in range(L):
+                w = _layer_weights(state, i)
+                h, kp_, vp_ = decode_layer_paged_tp(
+                    w, h, kpool[i], vpool[i], table, cos1, sin1, posc,
+                    cfg, TP_AXIS)
+                kps.append(kp_)
+                vps.append(vp_)
+            kpool = jnp.stack(kps)
+            vpool = jnp.stack(vps)
+            h = _rms(h[:, None], state["llama.norm.weight"],
+                     cfg.rms_norm_eps)[:, 0]
+            logits = _logits_of(state, h).astype(jnp.float32)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            act = active.astype(bool)
+            pos2 = pos + active
+            tok2 = jnp.where(act, nxt, tok)
+            ring2 = ring.at[ridx].set(nxt)
+            ridx2 = (ridx + 1) % ring.shape[0]
+            return (kpool, vpool, pos2, tok2, ring2, ridx2,
+                    logits if emit_logits
+                    else jnp.zeros((), jnp.float32))
+
+        return step
+
+    def _make_copy_page_fn(self):
+        if self.tp == 1:
+            # CoW page copy: src/dst are data — one trace for the engine
+            return jax.jit(
+                lambda kp, vp, src, dst: (kp.at[:, dst].set(kp[:, src]),
+                                          vp.at[:, dst].set(vp[:, src])),
+                donate_argnums=(0, 1))
+        from jax.sharding import PartitionSpec as P
+        pool = self._pool_pspec
+        # per-shard copy: a page holds every local head's rows, so the
+        # CoW duplicate is collective-free
+        mapped = jax.shard_map(
+            lambda kp, vp, src, dst: (kp.at[:, dst].set(kp[:, src]),
+                                      vp.at[:, dst].set(vp[:, src])),
+            mesh=self.mesh, in_specs=(pool, pool, P(), P()),
+            out_specs=(pool, pool), check_vma=False)
+        return jax.jit(mapped, donate_argnums=(0, 1))
+
+    def _prefill_fn(self, bucket: int):
+        fn = self._prefill_fns.get(bucket)
+        if fn is not None:
+            return fn
+        cfg = self.config
+        L = cfg.num_hidden_layers
+        ps = self.page_size
+        n_pages = bucket // ps
+        tp = self.tp
+
+        def prefill(state, ids, length, table_row, kpool, vpool, cos,
+                    sin):
+            _M_PREFILL_TRACES.labels(str(bucket)).inc()
+            x = jnp.take(state["llama.embed_tokens.weight"], ids, axis=0)
+            pmask = jnp.arange(bucket)[None, :] < length
+            for i in range(L):
+                w = _layer_weights(state, i)
+                if tp == 1:
+                    x, k, v = _prefill_layer(w, x, cos[:bucket],
+                                             sin[:bucket], pmask, cfg)
+                else:
+                    x, k, v = prefill_layer_tp(w, x, cos[:bucket],
+                                               sin[:bucket], pmask, cfg,
+                                               TP_AXIS)
+                for p in range(n_pages):
+                    rows_k = k[0, p * ps:(p + 1) * ps].swapaxes(0, 1)
+                    rows_v = v[0, p * ps:(p + 1) * ps].swapaxes(0, 1)
+                    kpool = kpool.at[i, table_row[p]].set(rows_k)
+                    vpool = vpool.at[i, table_row[p]].set(rows_v)
+            x = _rms(x, state["llama.norm.weight"], cfg.rms_norm_eps)
+            last = jnp.take_along_axis(
+                x, (length - 1)[:, None, None].astype(jnp.int32),
+                axis=1)[:, 0]
+            logits = _logits_of(state, last).astype(jnp.float32)
+            return kpool, vpool, logits
+
+        # kpool/vpool donation: prefill updates the pool in place instead
+        # of double-buffering the engine's whole KV footprint per admit
+        if tp == 1:
+            fn = jax.jit(prefill, donate_argnums=(4, 5))
+        else:
+            from jax.sharding import PartitionSpec as P
+            pool = self._pool_pspec
+            mapped = jax.shard_map(
+                prefill, mesh=self.mesh,
+                in_specs=(self._state_specs(), P(), P(), P(), pool,
+                          pool, P(), P()),
+                out_specs=(pool, pool, P()), check_vma=False)
+            fn = jax.jit(mapped, donate_argnums=(4, 5))
+        self._prefill_fns[bucket] = fn
+        return fn
+
+    def _prefill_cached_fn(self, bucket: int):
+        """Suffix prefill for a prompt whose first ``cached_len`` tokens
+        are already resident in the pool (shared prefix pages and/or a
+        CoW-copied tail).  One trace per suffix bucket: the prefix
+        length, table row, and positions are all data."""
+        fn = self._prefill_cached_fns.get(bucket)
+        if fn is not None:
+            return fn
+        cfg = self.config
+        L = cfg.num_hidden_layers
+        kvh_l = cfg.num_key_value_heads // self.tp
+        ps = self.page_size
+        W = self.table_width
+        dump = self.dump_page
+        rope_len = self._rope_len
+        tp = self.tp
+
+        def prefill(state, ids, length, cached_len, row, kpool, vpool,
+                    cos, sin):
+            _M_PREFILL_TRACES.labels(f"cached:{bucket}").inc()
+            x = jnp.take(state["llama.embed_tokens.weight"], ids, axis=0)
+            j = jnp.arange(bucket)
+            absp = cached_len + j               # absolute positions
+            posc = jnp.minimum(absp, rope_len - 1)
+            cos_s = jnp.take(cos, posc, axis=0)
+            sin_s = jnp.take(sin, posc, axis=0)
+            # suffix queries see: resident prefix keys (< cached_len),
+            # then causal within the (padded) suffix
+            t_pre = jnp.arange(W * ps)
+            pre_ok = jnp.broadcast_to(t_pre[None, :] < cached_len,
+                                      (bucket, W * ps))
+            suf_ok = (j[None, :] <= j[:, None]) & (j[None, :] < length[0])
+            mask = jnp.concatenate([pre_ok, suf_ok], axis=1)[None, None]
+            # per-token write targets (padding lands on the dump page)
+            valid = j < length[0]
+            page_w = jnp.where(valid,
+                               row[jnp.minimum(absp // ps, W - 1)], dump)
+            off = absp % ps
+            heads = jnp.arange(kvh_l)
+            for i in range(L):
+                w = _layer_weights(state, i)
+                if tp == 1:
+                    kpre = gather_kv_pages(kpool[i], row)
+                    vpre = gather_kv_pages(vpool[i], row)
+                    x, k, v = _prefill_layer_cached(
+                        w, x, kpre[None], vpre[None], cos_s, sin_s,
+                        mask, cfg)
+                else:
+                    x, k, v = prefill_layer_cached_tp(
+                        w, x, kpool[i], vpool[i], row, cos_s, sin_s,
+                        mask, cfg, TP_AXIS)
+                kpool = kpool.at[i, page_w[:, None], heads[None, :],
+                                 off[:, None]].set(k[0])
+                vpool = vpool.at[i, page_w[:, None], heads[None, :],
+                                 off[:, None]].set(v[0])
+            x = _rms(x, state["llama.norm.weight"], cfg.rms_norm_eps)
+            last = jnp.take_along_axis(
+                x, (length - 1)[:, None, None].astype(jnp.int32),
+                axis=1)[:, 0]
+            logits = _logits_of(state, last).astype(jnp.float32)
+            return kpool, vpool, logits
+
+        if tp == 1:
+            fn = jax.jit(prefill, donate_argnums=(5, 6))
+        else:
+            from jax.sharding import PartitionSpec as P
+            pool = self._pool_pspec
+            mapped = jax.shard_map(
+                prefill, mesh=self.mesh,
+                in_specs=(self._state_specs(), P(), P(), P(), P(), pool,
+                          pool, P(), P()),
+                out_specs=(pool, pool, P()), check_vma=False)
+            fn = jax.jit(mapped, donate_argnums=(5, 6))
+        self._prefill_cached_fns[bucket] = fn
+        return fn
+
+    # ------------------------------------------------------------ the seam
+    def decode_step(self):
+        """One lockstep decode step over every slot.  Returns the step's
+        [slots, V] logits handle when the runner emits logits, else
+        None.  First call after a (re)trace lands in the compile
+        ledger."""
+        traces_before = self.decode_traces
+        t0 = time.perf_counter()
+        (self.kpool, self.vpool, self._pos_dev, self._tok_dev,
+         self._ring_dev, self._ridx_dev, logits) = self._step_fn(
+            self.state, self.kpool, self.vpool, self._table_dev,
+            self._pos_dev, self._tok_dev, self._active_dev,
+            self._ring_dev, self._ridx_dev, self._cos, self._sin)
+        if self.decode_traces != traces_before:
+            sig = f"slots={self.max_slots} ring={self.sync_interval}"
+            if self.tp > 1:
+                sig += f" tp={self.tp}"
+            record_compile("decode_step", t0, signature=sig)
+        return logits if self.emit_logits else None
+
+    def prefill(self, ids: np.ndarray, plen: int, row: np.ndarray):
+        """Full-prompt prefill: pages the prompt's KV into the pool and
+        returns the last-token logits handle.  ``ids`` is the
+        [1, bucket] padded prompt."""
+        bucket = ids.shape[1]
+        fresh = bucket not in self._prefill_fns
+        fn = self._prefill_fn(bucket)
+        t0 = time.perf_counter()
+        self.kpool, self.vpool, logits = fn(
+            self.state, jnp.asarray(ids),
+            jnp.asarray([plen], jnp.int32),
+            jnp.asarray(row[:bucket // self.page_size]),
+            self.kpool, self.vpool, self._cos, self._sin)
+        if fresh:
+            record_compile(f"prefill[{bucket}]", t0,
+                           signature=f"ids=[1,{bucket}]")
+        return logits
+
+    def prefill_cached(self, ids: np.ndarray, suffix_len: int,
+                       cached_len: int, row: np.ndarray):
+        """Cached-suffix prefill against the resident prefix pages."""
+        bucket = ids.shape[1]
+        fresh = bucket not in self._prefill_cached_fns
+        fn = self._prefill_cached_fn(bucket)
+        t0 = time.perf_counter()
+        self.kpool, self.vpool, logits = fn(
+            self.state, jnp.asarray(ids),
+            jnp.asarray([suffix_len], jnp.int32),
+            jnp.asarray(cached_len, jnp.int32), jnp.asarray(row),
+            self.kpool, self.vpool, self._cos, self._sin)
+        if fresh:
+            record_compile(f"prefill_cached[{bucket}]", t0,
+                           signature=f"ids=[1,{bucket}]")
+        return logits
+
+    def copy_page(self, src: int, dst: int):
+        """Copy-on-write page duplicate (head-local on the mesh)."""
+        fresh = not self._copy_page_compiled
+        t0 = time.perf_counter()
+        self.kpool, self.vpool = self._copy_page_fn(
+            self.kpool, self.vpool, jnp.asarray(src, jnp.int32),
+            jnp.asarray(dst, jnp.int32))
+        if fresh:
+            self._copy_page_compiled = True
+            record_compile("copy_page", t0,
+                           signature=f"pool={self.kpool.shape}")
+
+    def push_slot(self, slot: int, row: np.ndarray, pos: int, tok: int,
+                  active: int):
+        """Patch ONE slot's row of the device-resident decode state
+        (admission / eviction only — never per step)."""
+        self._table_dev = self._table_dev.at[slot].set(jnp.asarray(row))
+        self._pos_dev = self._pos_dev.at[slot].set(int(pos))
+        self._tok_dev = self._tok_dev.at[slot].set(int(tok))
+        self._active_dev = self._active_dev.at[slot].set(int(active))
+
+    def fetch_ring(self) -> np.ndarray:
+        """The host sync: ONE [sync_interval, slots] int32 transfer."""
+        return np.asarray(self._ring_dev)
+
+    def correct_tokens(self, corrections: list[tuple[int, int]]):
+        """Push host-side sampling picks back into the device token
+        state before the next step."""
+        idx = jnp.asarray([s for s, _ in corrections], jnp.int32)
+        val = jnp.asarray([t for _, t in corrections], jnp.int32)
+        self._tok_dev = self._tok_dev.at[idx].set(val)
+
+    def reinject_step(self):
+        """Rebuild the decode-step jit (perf-gate hook: forces a fresh
+        trace so retrace detection can be exercised deterministically)."""
+        self._step_fn = self._make_step_fn()
+
+    # ---------------------------------------------------------------- info
+    def mesh_info(self) -> dict:
+        """Per-device memory keyed by mesh position: footprint estimates
+        (KV pool shard + weight shard/replica bytes) merged with live
+        ``memory_stats()`` where the backend exports them."""
+        devices = []
+        for i, d in enumerate(self.devices):
+            entry = {
+                "device": f"{d.platform}:{d.id}", TP_AXIS: i,
+                "kv_pool_bytes": self._pool_bytes_per_device,
+                "weight_bytes": self._weight_bytes_per_device,
+            }
+            try:
+                stats = d.memory_stats() or {}
+            except Exception:
+                stats = {}
+            if "bytes_in_use" in stats:
+                entry["bytes_in_use"] = int(stats["bytes_in_use"])
+            if "peak_bytes_in_use" in stats:
+                entry["peak_bytes_in_use"] = int(
+                    stats["peak_bytes_in_use"])
+            devices.append(entry)
+        return {"tp": self.tp, "axis": TP_AXIS, "devices": devices}
+
+
+def _prefill_layer_cached(w, x, kpre, vpre, cos_s, sin_s, mask, cfg):
+    """One transformer layer of suffix prefill against a resident
+    prefix: ``x`` [1, S, H] suffix hidden, ``kpre``/``vpre``
+    [1, Tpre, kvH, D] prefix KV gathered from the pool (keys already
+    rotary-encoded at their absolute positions, exactly as prefill and
+    decode wrote them), ``mask`` [1, 1, S, Tpre+S] bool.  Returns
+    (out, k_suffix, v_suffix) — mirror of ``_prefill_layer``."""
+    b, s, _ = x.shape
+    nh, kvh, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                   cfg.head_dim)
+    h = _rms(x, w["ln1"], cfg.rms_norm_eps)
+    qp, kp, vp = _qkv_proj(w, h, nh, kvh, hd)
+    q = qp.reshape(b, s, nh, hd)
+    k = kp.reshape(b, s, kvh, hd)
+    v = vp.reshape(b, s, kvh, hd)
+    cos_c = cos_s[None, :, None, :].astype(q.dtype)
+    sin_c = sin_s[None, :, None, :].astype(q.dtype)
+    q = q * cos_c + _rotate_half(q) * sin_c
+    k = k * cos_c + _rotate_half(k) * sin_c
+
+    from ...ops.pallas.flash_attention import sdpa
+    kcat = jnp.concatenate([kpre.astype(k.dtype), k], axis=1)
+    vcat = jnp.concatenate([vpre.astype(v.dtype), v], axis=1)
+    attn = sdpa(q, kcat, vcat, attn_mask=mask,
+                is_causal=False).reshape(b, s, nh * hd)
+    x = x + _mm(attn, w["o"])
+    h = _rms(x, w["ln2"], cfg.rms_norm_eps)
+    return (x + _ffn(w, h), k, v)
+
+
+def _logits_of(state, h):
+    head = state.get("lm_head.weight")
+    if head is not None:
+        return _mm(h, head)
+    return h @ state["llama.embed_tokens.weight"].T

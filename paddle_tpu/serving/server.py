@@ -668,6 +668,11 @@ def _main(argv=None):
     ap.add_argument("--sync-interval", type=int, default=1)
     ap.add_argument("--emit-logits", action="store_true",
                     help="enable do_sample requests")
+    ap.add_argument("--mesh", default=None,
+                    help="tensor-parallel mesh size (e.g. 4 or tp=4); "
+                    "default FLAGS_serving_mesh_tp.  CPU testing: "
+                    "export XLA_FLAGS=--xla_force_host_platform_"
+                    "device_count=N first")
     args = ap.parse_args(argv)
 
     import paddle_tpu as paddle
@@ -687,7 +692,8 @@ def _main(argv=None):
                    max_model_len=args.max_model_len,
                    emit_logits=args.emit_logits,
                    enable_prefix_cache=args.prefix_cache,
-                   sync_interval=args.sync_interval, start=False)
+                   sync_interval=args.sync_interval, mesh=args.mesh,
+                   start=False)
     server.install_signal_handlers()
     server.start()
     print(f"serving on http://{server.address} "

@@ -374,6 +374,13 @@ def _resources_section(resources):
             parts.append(f"in-use {_fmt_bytes(entry['bytes_in_use'])}")
         if "peak_bytes_in_use" in entry:
             parts.append(f"peak {_fmt_bytes(entry['peak_bytes_in_use'])}")
+        if isinstance(entry.get("mesh"), dict):
+            # mesh position from the serving runner — present even on
+            # backends (CPU) that export no memory_stats, so every mesh
+            # device shows a per-device line
+            parts.append("mesh " + ",".join(
+                f"{axis}={pos}"
+                for axis, pos in sorted(entry["mesh"].items())))
         if parts:
             lines.append(f"  {dev}: " + ", ".join(parts))
     if mem.get("host_rss_bytes"):

@@ -47,13 +47,23 @@ DIRECTIONS = {
     "cached_tokens": "high",
     "steps_per_sync": "high",
     "goodput_ratio": "high",
+    "host_syncs_delta_vs_tp1": "exact",
+    "pages_per_token_delta_vs_tp1": "exact",
+    "mesh_tp": "exact",
 }
 
 
 def _force_cpu():
     """The gate's counters are platform-independent, but CPU is the
-    only backend tier-1 guarantees — never touch an accelerator."""
+    only backend tier-1 guarantees — never touch an accelerator.  The
+    tp_decode scenario additionally needs >= 2 host devices, so ask XLA
+    for 8 before the backend initializes (a no-op once it has — under
+    pytest the conftest already forced the same count)."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
     try:
         import jax
         jax.config.update("jax_platforms", "cpu")
@@ -92,9 +102,7 @@ def _reinject_retrace(eng):
     """Test hook: rebuild the decode-step jit so the next decode call
     traces again — the exact regression serving_decode_step_traces_total
     exists to catch."""
-    import jax
-    eng._step_fn = jax.jit(eng._build_step(),
-                           donate_argnums=(1, 2, 4, 5, 7, 8))
+    eng.runner.reinject_step()
 
 
 def scenario_steady_decode(inject_retrace=False) -> dict:
@@ -183,11 +191,54 @@ def scenario_goodput_cancel() -> dict:
     }
 
 
+def scenario_tp_decode() -> dict:
+    """Tensor-parallel decode on a tp=2 host-device mesh, same workload
+    twice (tp=1 then tp=2) with an admit + a mid-decode cancel-eviction
+    in wave two: the mesh must keep ONE decode trace across admit/evict,
+    and pay exactly the single-chip host-sync and page bills (the
+    ``*_delta_vs_tp1`` counters gate at 0)."""
+
+    def drive(tp):
+        eng = _engine(max_slots=2, page_size=4, sync_interval=1, mesh=tp)
+
+        def cancel_after_3(req, tok):
+            if req.num_generated >= 3:
+                req.cancel()
+
+        reqs = [eng.submit([1, 2, 3, 4, 5, 6], _gen(8)),
+                eng.submit([3, 4, 5, 6, 7, 8], _gen(8))]
+        eng.run_until_complete(max_steps=400)
+        reqs.append(eng.submit([5, 6, 7, 8, 9, 10, 11], _gen(8)))
+        reqs.append(eng.submit([2, 4, 6, 8], _gen(8),
+                               on_token=cancel_after_3))
+        eng.run_until_complete(max_steps=400)
+        return eng, reqs
+
+    e1, _ = drive(1)
+    e2, reqs = drive(2)
+    tokens = sum(r.num_generated for r in reqs)
+    ppt = round(e2.blocks.pages_allocated / max(tokens, 1), 6)
+    ppt1 = round(e1.blocks.pages_allocated / max(tokens, 1), 6)
+    return {
+        "mesh_tp": e2.tp,
+        "decode_traces": e2.decode_traces,
+        "prefill_compiles": (len(e2._prefill_fns)
+                             + len(e2._prefill_cached_fns)),
+        "host_syncs_per_decode_step": round(
+            e2.host_syncs / max(e2.decode_steps, 1), 6),
+        "host_syncs_delta_vs_tp1": e2.host_syncs - e1.host_syncs,
+        "pages_per_token_delta_vs_tp1": round(ppt - ppt1, 6),
+        "logits_fetches": e2.logit_fetches,
+        "goodput_ratio": _goodput(reqs),
+    }
+
+
 SCENARIOS = {
     "steady_decode": scenario_steady_decode,
     "prefix_cache": scenario_prefix_cache,
     "deferred_sync": scenario_deferred_sync,
     "goodput_cancel": scenario_goodput_cancel,
+    "tp_decode": scenario_tp_decode,
 }
 
 

@@ -83,7 +83,8 @@ def run_bench(args):
     cfg = llama_tiny(num_hidden_layers=args.layers, hidden_size=args.hidden,
                      intermediate_size=2 * args.hidden,
                      vocab_size=args.vocab,
-                     num_attention_heads=4, num_key_value_heads=2,
+                     num_attention_heads=args.heads,
+                     num_key_value_heads=args.kv_heads,
                      max_position_embeddings=args.max_model_len)
     model = LlamaForCausalLM(cfg)
     model.eval()
@@ -93,7 +94,8 @@ def run_bench(args):
                            num_pages=args.num_pages,
                            max_model_len=args.max_model_len,
                            enable_prefix_cache=args.prefix_cache,
-                           sync_interval=args.sync_interval)
+                           sync_interval=args.sync_interval,
+                           mesh=args.mesh)
 
     workload = _build_workload(args, rng, np)
 
@@ -209,7 +211,8 @@ def run_http_bench(args):
     cfg = llama_tiny(num_hidden_layers=args.layers, hidden_size=args.hidden,
                      intermediate_size=2 * args.hidden,
                      vocab_size=args.vocab,
-                     num_attention_heads=4, num_key_value_heads=2,
+                     num_attention_heads=args.heads,
+                     num_key_value_heads=args.kv_heads,
                      max_position_embeddings=args.max_model_len)
     model = LlamaForCausalLM(cfg)
     model.eval()
@@ -354,6 +357,17 @@ def main(argv=None):
     ap.add_argument("--trace", default="",
                     help="write a chrome://tracing JSON of the run's "
                          "request/prefill/decode spans to this path")
+    ap.add_argument("--mesh", default=None,
+                    help="tensor-parallel mesh size for the in-process "
+                         "engine (e.g. 4 or tp=4; default FLAGS_serving_"
+                         "mesh_tp).  CPU: export XLA_FLAGS=--xla_force_"
+                         "host_platform_device_count=N first.  tp>1 "
+                         "needs head counts divisible by tp — pass "
+                         "--heads/--kv-heads accordingly")
+    ap.add_argument("--heads", type=int, default=4,
+                    help="attention heads of the bench model")
+    ap.add_argument("--kv-heads", type=int, default=2,
+                    help="KV heads of the bench model")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
     if args.http:
