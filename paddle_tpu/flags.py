@@ -144,6 +144,12 @@ define_flag("FLAGS_serving_mesh_tp", 1,
             "first N local devices (1 = single-chip; create_engine/"
             "serve --mesh overrides; CPU testing needs XLA_FLAGS="
             "--xla_force_host_platform_device_count=N)")
+define_flag("FLAGS_serving_spec_k", 0,
+            "speculative decoding draft length: the serving engine's "
+            "prompt-lookup (n-gram) drafter proposes up to K tokens per "
+            "slot and one verify step scores all K+1 positions (0 = "
+            "off; greedy outputs are identical either way; "
+            "create_engine/serve --spec-k overrides)")
 define_flag("FLAGS_sanitizer", False,
             "enable the runtime concurrency sanitizer: serving/"
             "observability locks become instrumented wrappers that "

@@ -27,6 +27,10 @@ Modules:
   * scheduler.py     — FCFS admission, iteration-level eviction, drain
   * engine.py        — the prefill/decode driver (host scheduling,
                        deferred host sync) over a parallel.ModelRunner
+  * spec.py          — speculative decoding: prompt-lookup (n-gram)
+                       drafter + acceptance bookkeeping; the runner's
+                       verify program scores k+1 positions per step
+                       with bit-identical greedy outputs
   * parallel/        — mesh-aware ModelRunner: tensor-parallel weight
                        placement, head-sharded KV pools, and every
                        jitted program (tp=1 == exact single-chip path)
@@ -62,12 +66,13 @@ from .scheduler import Scheduler  # noqa: F401
 from .server import (  # noqa: F401
     BackpressureError, DrainingError, EngineWorker, ServingServer, serve)
 from .slo import SLOConfig, SLOTracker  # noqa: F401
+from .spec import NgramProposer, SpecStats  # noqa: F401
 from .watchdog import Watchdog  # noqa: F401
 
 __all__ = ["BackpressureError", "BlockManager", "DrainingError", "Engine",
            "EngineWorker", "GenerationConfig", "ModelRunner",
-           "NoReplicaAvailable", "Replica", "Request", "RequestState",
-           "Router", "RouterServer", "SLOConfig", "SLOTracker",
-           "Scheduler", "ServingClient", "ServingHTTPError",
-           "ServingServer", "Watchdog", "create_engine", "parse_mesh",
-           "serve"]
+           "NgramProposer", "NoReplicaAvailable", "Replica", "Request",
+           "RequestState", "Router", "RouterServer", "SLOConfig",
+           "SLOTracker", "Scheduler", "ServingClient",
+           "ServingHTTPError", "ServingServer", "SpecStats", "Watchdog",
+           "create_engine", "parse_mesh", "serve"]

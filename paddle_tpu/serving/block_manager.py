@@ -41,7 +41,7 @@ table padding; it is never handed to a sequence.
 """
 from __future__ import annotations
 
-from collections import OrderedDict
+from collections import OrderedDict, deque
 
 import numpy as np
 
@@ -103,11 +103,16 @@ class BlockManager:
         self.page_size = int(page_size)
         self.dump_page = self.num_pages       # pool row past the real pages
         self.prefix_cache = bool(enable_prefix_cache)
-        # FIFO reuse keeps page churn spread across the pool
-        self._free: list[int] = list(range(self.num_pages))
+        # FIFO reuse keeps page churn spread across the pool; a deque
+        # makes both ends O(1) (popping the head of a plain list shifts
+        # the whole tail on every acquisition)
+        self._free: deque[int] = deque(range(self.num_pages))
         self._tables: dict[int, list[int]] = {}   # seq id -> owned pages
         self._ref: dict[int, int] = {}            # page -> live-seq refs
         self._meta: dict[int, dict] = {}          # seq id -> prefill plan
+        # committed-token ledger (speculative append/rollback): seq id ->
+        # {"committed", "floor", "capacity"} token counts
+        self._commit: dict[int, dict] = {}
         # prefix-cache state.  Chain index: (parent page, chunk) -> page;
         # tail index: parent page -> {page: partial-chunk tokens}.
         self._index: dict[tuple, int] = {}
@@ -165,6 +170,8 @@ class BlockManager:
             self._ref[p] = 1
         self._tables[seq_id] = pages
         self._meta[seq_id] = {"cached_len": 0, "cow_src": None}
+        self._commit[seq_id] = {"committed": 0, "floor": 0,
+                                "capacity": n * self.page_size}
         _obs.flight("blocks", "alloc_seq", seq=seq_id, pages=len(pages),
                     shared=0, cached_tokens=0, cow=False)
         self._update_pool_gauges()
@@ -178,9 +185,13 @@ class BlockManager:
         (``cached_len``, ``cow_src``) is retrievable via
         :meth:`seq_meta` until :meth:`free_seq`."""
         if not self.prefix_cache:
-            return self.allocate(seq_id,
-                                 self.pages_needed(len(prompt),
-                                                   max_new_tokens))
+            pages = self.allocate(seq_id,
+                                  self.pages_needed(len(prompt),
+                                                    max_new_tokens))
+            if pages is not None:
+                c = self._commit[seq_id]
+                c["committed"] = c["floor"] = len(prompt)
+            return pages
         if seq_id in self._tables:
             raise ValueError(f"sequence {seq_id} already owns pages")
         prompt = tuple(int(t) for t in np.asarray(prompt).reshape(-1))
@@ -253,6 +264,8 @@ class BlockManager:
         pages = matched + fresh
         self._tables[seq_id] = pages
         self._meta[seq_id] = {"cached_len": cached_len, "cow_src": cow_src}
+        self._commit[seq_id] = {"committed": plen, "floor": plen,
+                                "capacity": total * ps}
         _obs.flight("blocks", "alloc_seq", seq=seq_id, pages=len(pages),
                     shared=m, cached_tokens=cached_len,
                     cow=cow_src is not None)
@@ -298,6 +311,7 @@ class BlockManager:
         unregistered pages return to the free list."""
         pages = self._tables.pop(seq_id, None)
         self._meta.pop(seq_id, None)
+        self._commit.pop(seq_id, None)
         if pages:
             for p in pages:
                 self._decref(p)
@@ -305,6 +319,67 @@ class BlockManager:
 
     def pages_of(self, seq_id: int):
         return list(self._tables.get(seq_id, ()))
+
+    # ------------------------------------- committed tokens (speculative)
+    # Pages are reserved all-or-nothing at admission, so speculative
+    # decoding never allocates mid-flight; what moves is the
+    # committed-token ledger.  A verify step appends all k+1 proposed
+    # positions, then rolls the rejected suffix back, so the ledger
+    # charges pages (ceil(committed / page_size)) for ACCEPTED tokens
+    # only.  Page ids never move and refcounts are untouched, which is
+    # what keeps CoW/prefix-cache sharing safe under rollback: a
+    # rejected position's stale KV sits past the sequence's visible
+    # length (`lens = pos + 1` masks it) until a later append overwrites
+    # it in place.
+
+    def committed_tokens(self, seq_id: int) -> int:
+        """Tokens durably owned by ``seq_id`` (prompt + accepted)."""
+        return int(self._commit.get(seq_id, {}).get("committed", 0))
+
+    def committed_pages(self, seq_id: int) -> int:
+        """Pages charged for the committed tokens — the accepted-only
+        page charge the speculative path reports against the all-or-
+        nothing reservation."""
+        c = self.committed_tokens(seq_id)
+        return -(-c // self.page_size)
+
+    def append(self, seq_id: int, n: int) -> int:
+        """Advance ``seq_id``'s committed-token count by ``n`` (the
+        multi-token path: a verify step appends all k+1 proposed
+        positions at once).  Raises if the sequence is unknown or the
+        append would overrun its admission reservation — admitted
+        requests can never legally hit this.  Returns the new count."""
+        c = self._commit.get(seq_id)
+        if c is None:
+            raise ValueError(f"sequence {seq_id} owns no pages")
+        if n < 0:
+            raise ValueError(f"append of {n} tokens (use rollback)")
+        if c["committed"] + n > c["capacity"]:
+            raise ValueError(
+                f"sequence {seq_id}: appending {n} tokens overruns the "
+                f"reservation ({c['committed']} committed, capacity "
+                f"{c['capacity']})")
+        c["committed"] += n
+        return c["committed"]
+
+    def rollback(self, seq_id: int, n: int) -> int:
+        """Retreat ``seq_id``'s committed-token count by ``n`` rejected
+        speculative positions.  Raises if that would drop below the
+        admission content (the prompt) — rollback can only undo
+        speculation, never durable tokens, so prefix-cache chunks
+        registered at admission stay valid.  Returns the new count."""
+        c = self._commit.get(seq_id)
+        if c is None:
+            raise ValueError(f"sequence {seq_id} owns no pages")
+        if n < 0:
+            raise ValueError(f"rollback of {n} tokens (use append)")
+        if c["committed"] - n < c["floor"]:
+            raise ValueError(
+                f"sequence {seq_id}: rolling back {n} tokens drops below "
+                f"the admission content ({c['committed']} committed, "
+                f"floor {c['floor']})")
+        c["committed"] -= n
+        return c["committed"]
 
     # --------------------------------------------------- pool accounting
     def _update_pool_gauges(self):
@@ -402,7 +477,9 @@ class BlockManager:
         meta = self._meta.get(seq_id, {})
         return {"pages": len(pages), "shared": shared,
                 "exclusive": len(pages) - shared,
-                "cached_len": int(meta.get("cached_len", 0))}
+                "cached_len": int(meta.get("cached_len", 0)),
+                "committed_tokens": self.committed_tokens(seq_id),
+                "committed_pages": self.committed_pages(seq_id)}
 
     # ------------------------------------------------- refcount internals
     def _incref(self, page: int):
@@ -427,12 +504,13 @@ class BlockManager:
         got: list[int] = []
         while len(got) < n:
             if self._free:
-                got.append(self._free.pop(0))
+                got.append(self._free.popleft())
             elif self._lru and self._evict_one():
                 continue
             else:
-                # rollback: nothing partially held on failure
-                self._free = got + self._free
+                # rollback: nothing partially held on failure (restore
+                # FIFO order at the head of the deque)
+                self._free.extendleft(reversed(got))
                 return None
         if got:
             self.pages_allocated += len(got)

@@ -123,7 +123,7 @@ class Engine:
                  emit_logits: bool = False,
                  enable_prefix_cache: bool = False,
                  sync_interval: int = 1, clock=time.monotonic,
-                 slo=None, mesh=None):
+                 slo=None, mesh=None, spec_k: int | None = None):
         if model is not None:
             from ..framework.tensor import Tensor
             config = model.config
@@ -154,6 +154,18 @@ class Engine:
         if mesh is None:
             mesh = int(FLAGS.get("FLAGS_serving_mesh_tp") or 1)
         self.tp = parse_mesh(mesh)
+        if spec_k is None:
+            spec_k = int(FLAGS.get("FLAGS_serving_spec_k") or 0)
+        self.spec_k = int(spec_k)
+        if self.spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        if self.spec_k:
+            from .spec import NgramProposer, SpecStats
+            self._proposer = NgramProposer(self.spec_k)
+            self._spec = SpecStats()
+        else:
+            self._proposer = None
+            self._spec = None
 
         self.blocks = BlockManager(
             num_pages, self.page_size,
@@ -183,7 +195,7 @@ class Engine:
             num_pages=self.blocks.num_pages,
             dump_page=self.blocks.dump_page,
             sync_interval=self.sync_interval,
-            emit_logits=self.emit_logits,
+            emit_logits=self.emit_logits, spec_k=self.spec_k,
             per_device_pool_bytes=sizing["per_device_bytes"])
 
         # host-side mirrors of the slot state (bookkeeping + targeted
@@ -194,9 +206,11 @@ class Engine:
         self._tok = np.zeros((self.max_slots,), np.int32)
         self._active = np.zeros((self.max_slots,), np.int32)
         self._ring_cursor = 0           # host mirror of the ring index
-        # ring rows the host has not consumed yet:
-        # [(ring row, [(slot, request), ...]), ...] in decode order
-        self._pending: list[tuple[int, list]] = []
+        # ring rows the host has not consumed yet, in decode order:
+        # [(ring row, [(slot, request), ...], drafts-or-None), ...] —
+        # the third element is the verify step's {slot: draft tokens}
+        # (a verify row syncs immediately, so it is always solitary)
+        self._pending: list[tuple[int, list, dict | None]] = []
         self._last_logits = None        # device handle, fetched lazily
 
         self.decode_steps = 0       # mirror of serving_decode_steps_total
@@ -393,6 +407,10 @@ class Engine:
         self._active[slot] = 1
         self._push_slot(slot)
         req.state = RequestState.DECODE
+        if self._proposer is not None:
+            # seed the drafter with the prompt; emitted tokens extend
+            # the history through _emit
+            self._proposer.register(req.id, req.prompt)
         self._emit(slot, req, tok, now)
 
     # ------------------------------------------------------------ decode
@@ -406,6 +424,10 @@ class Engine:
             self._seg_steps = 0
         self._seg_steps += 1
         reqs = [(s, self.scheduler.slots[s]) for s in active]
+        drafts = self._propose(reqs)
+        if drafts:
+            self._decode_spec(reqs, drafts)
+            return
         step_t0 = time.perf_counter()
         logits = self.runner.decode_step()
         self._note_phase("decode", time.perf_counter() - step_t0)
@@ -414,7 +436,7 @@ class Engine:
         self._pages_hist.observe(self.blocks.pages_in_use)
         for slot in active:
             self._pos[slot] += 1            # mirror of pos + active
-        self._pending.append((self._ring_cursor, reqs))
+        self._pending.append((self._ring_cursor, reqs, None))
         self._ring_cursor = (self._ring_cursor + 1) % self.sync_interval
         self._last_logits = logits if self.emit_logits else None
         # any active sampling request needs its token fed back before
@@ -423,6 +445,58 @@ class Engine:
             else self.sync_interval
         if len(self._pending) >= eff:
             self._sync()
+
+    def _propose(self, reqs) -> dict:
+        """Collect this step's drafts: ``{slot: [tokens]}``.  Empty —
+        take the plain step — when speculation is off, when unsynced
+        ring rows are outstanding (the drafter indexes only tokens the
+        host has seen; a verify step always syncs immediately, so the
+        mirrors it needs are exact), or when an active request samples
+        (greedy verification only, for now)."""
+        if (self._proposer is None or self._pending
+                or any(r.gen.do_sample for _, r in reqs)):
+            return {}
+        drafts = {}
+        for slot, req in reqs:
+            # cap so even a fully-accepted draft commits at most the
+            # tokens the request may still emit (rem), keeping every KV
+            # write inside the admission reservation
+            cap = req.gen.max_new_tokens - req.num_generated - 1
+            if cap <= 0:
+                continue
+            ds = self._proposer.propose(req.id, cap)
+            if ds:
+                drafts[slot] = ds
+        return drafts
+
+    def _decode_spec(self, reqs, drafts: dict):
+        """One verify step: upload the draft grid, score k+1 positions
+        per slot, then sync immediately — acceptance needs the ring row
+        before the next proposal anyway, and the step commits up to k+1
+        tokens, so the sync amortizes exactly like deferred plain
+        steps."""
+        draft_arr = np.zeros((self.max_slots, self.spec_k), np.int32)
+        dlen = np.zeros((self.max_slots,), np.int32)
+        for slot, ds in drafts.items():
+            draft_arr[slot, :len(ds)] = ds
+            dlen[slot] = len(ds)
+        step_t0 = time.perf_counter()
+        self.runner.verify_step(draft_arr, dlen)
+        self._note_phase("decode", time.perf_counter() - step_t0)
+        self.decode_steps += 1
+        _M_STEPS.inc()
+        self._spec.record_step()
+        self._pages_hist.observe(self.blocks.pages_in_use)
+        # speculative multi-token append: charge the whole candidate
+        # span now; the rejected suffix rolls back at the sync below
+        for slot, req in reqs:
+            ds = drafts.get(slot)
+            if ds:
+                self.blocks.append(req.id, len(ds) + 1)
+        self._pending.append((self._ring_cursor, reqs, drafts))
+        self._ring_cursor = (self._ring_cursor + 1) % self.sync_interval
+        self._last_logits = None
+        self._sync()
 
     def _sync(self):
         """Drain the device token ring: ONE [sync_interval, slots] int32
@@ -436,10 +510,33 @@ class Engine:
         poll = int(FLAGS.get("FLAGS_resource_memory_poll_steps") or 0)
         if poll > 0 and self.host_syncs % poll == 0:
             resource_tracker().sample_memory()
+        # wide-ring rows: [slots, k+1] candidate grids (speculation on);
+        # narrow rows: [slots] sampled tokens.  Re-derive each verify
+        # row's acceptance from the drafts the host already holds — the
+        # same integer comparison the device ran, no extra transfer.
+        wide = ring.ndim == 3
+        accepted: dict[int, tuple[int, int]] = {}
+        for ridx, entries, drafts in self._pending:
+            if drafts is None:
+                continue
+            for slot, req in entries:
+                if req.is_finished() or req.state != RequestState.DECODE:
+                    continue
+                a = 0
+                for j, d in enumerate(drafts.get(slot, ())):
+                    if int(ring[ridx, slot, j]) != int(d):
+                        break
+                    a += 1
+                accepted[slot] = (len(drafts.get(slot, ())), a)
         if self._seg_span is not None:
             # the ring fetch above blocked on the device — the segment
             # span ends here, covering dispatch through host sync
             self._seg_span.set_attribute("steps", self._seg_steps)
+            if accepted:
+                self._seg_span.set_attribute(
+                    "spec_proposed", sum(p for p, _ in accepted.values()))
+                self._seg_span.set_attribute(
+                    "spec_accepted", sum(a for _, a in accepted.values()))
             self._seg_span.end()
             self._seg_span = None
         _obs.flight("engine", "host_sync", rows=len(self._pending),
@@ -449,11 +546,16 @@ class Engine:
         now = self._clock()
         n_rows = len(self._pending)
         corrections = []
-        for row_i, (ridx, entries) in enumerate(self._pending):
+        for row_i, (ridx, entries, drafts) in enumerate(self._pending):
             for slot, req in entries:
                 if req.is_finished() or req.state != RequestState.DECODE:
                     continue        # evicted/finished: overrun discarded
-                tok = int(ring[ridx, slot])
+                if drafts is not None:
+                    self._accept(slot, req, ring[ridx, slot],
+                                 *accepted[slot], now)
+                    continue
+                tok = raw = int(ring[ridx, slot, 0]) if wide \
+                    else int(ring[ridx, slot])
                 if req.gen.do_sample:
                     # sampling rows only exist under eff-interval 1, so
                     # the step's logits handle is always the right row
@@ -463,7 +565,7 @@ class Engine:
                         self.logit_fetches += 1
                         _M_HOST_SYNCS.labels("logits").inc()
                     tok = self._pick_token(req, logits_np[slot])
-                    if tok != int(ring[ridx, slot]):
+                    if tok != raw:
                         corrections.append((slot, tok))
                 prev = req.last_token_at
                 if prev is not None:
@@ -482,6 +584,33 @@ class Engine:
         if corrections:
             self.runner.correct_tokens(corrections)
 
+    def _accept(self, slot: int, req: Request, row, proposed: int,
+                a: int, now: float):
+        """Commit one verify-row slot: roll back the rejected draft
+        suffix (the ledger then charges pages for accepted tokens
+        only), advance the pos mirror by the accepted prefix + the
+        correction/bonus token, and emit those ``a + 1`` tokens in
+        order — stopping at max_new/EOS exactly where sequential decode
+        would have stopped."""
+        if proposed:
+            self.blocks.rollback(req.id, proposed - a)
+            self._spec.record(proposed, a)
+        self._pos[slot] += a + 1        # mirror of pos + (acc+1)*active
+        prev = req.last_token_at
+        dt = None if prev is None else (now - prev) / (a + 1)
+        for j in range(a + 1):
+            tok = int(row[j])
+            if dt is not None:
+                # one verify step emitted a+1 tokens: spread the
+                # interval so TPOT keeps per-token semantics
+                self._tpot.observe(dt)
+            self._tok[slot] = tok
+            # drafted slots were charged up front at dispatch;
+            # ride-along slots (no draft) charge per emit as usual
+            self._emit(slot, req, tok, now, charge=proposed == 0)
+            if req.is_finished():
+                break
+
     def _note_phase(self, phase: str, seconds: float):
         """Charge engine wall time to a phase: the per-engine mirror,
         the serving_step_phase_seconds_total counter, and the process
@@ -491,10 +620,18 @@ class Engine:
         _M_PHASE_SECONDS.labels(phase).inc(seconds)
         resource_tracker().note_phase(phase, seconds)
 
-    def _emit(self, slot: int, req: Request, tok: int, now: float):
+    def _emit(self, slot: int, req: Request, tok: int, now: float,
+              charge: bool = True):
         req._emit(tok, now)
         _M_TOKENS.inc()
         resource_tracker().note_tokens(1)
+        if charge:
+            # committed-token ledger: one durable token per emit (the
+            # speculative path charges its whole span at dispatch and
+            # rolls the rejected suffix back instead)
+            self.blocks.append(req.id, 1)
+        if self._proposer is not None:
+            self._proposer.extend(req.id, tok)
         eos = req.gen.eos_token_id
         if req.num_generated >= req.gen.max_new_tokens:
             self._finalize(req, "length", now)
@@ -558,6 +695,8 @@ class Engine:
             if reason in ("cancelled", "deadline") else RequestState.DONE
         req.finished_at = now
         self._rngs.pop(req.id, None)
+        if self._proposer is not None:
+            self._proposer.drop(req.id)
         self._e2e.observe(now - req.arrival_time)
         _M_REQUESTS.labels(reason).inc()
         _M_FINISH.labels(reason).inc()
@@ -589,7 +728,12 @@ class Engine:
     # -------------------------------------------------------------- info
     def stats(self) -> dict:
         b = self.blocks
+        spec = {"spec_k": self.spec_k,
+                "verify_traces": self.runner.verify_traces}
+        if self._spec is not None:
+            spec.update(self._spec.snapshot())
         return {
+            **spec,
             "queued": len(self.scheduler.queue),
             "active": self.scheduler.active_count,
             "pages_in_use": b.pages_in_use,
@@ -671,7 +815,8 @@ def create_engine(model, *, max_slots: int = 4, page_size: int = 64,
                   emit_logits: bool = False,
                   enable_prefix_cache: bool = False,
                   sync_interval: int = 1, clock=time.monotonic,
-                  slo=None, mesh=None) -> Engine:
+                  slo=None, mesh=None,
+                  spec_k: int | None = None) -> Engine:
     """`create_predictor`-style entry point: build a continuous-batching
     engine over a LlamaForCausalLM (or any model exposing ``config`` and
     ``functional_state()`` with the llama state-dict layout).
@@ -682,6 +827,13 @@ def create_engine(model, *, max_slots: int = 4, page_size: int = 64,
     greedy decode loop run N device steps between host syncs (tokens
     stream out in bursts of N — lower sync overhead, higher streaming
     latency; sampling requests force per-step syncs regardless).
+
+    ``spec_k=K`` (default ``FLAGS_serving_spec_k``) turns on
+    speculative decoding: a host-side prompt-lookup (n-gram) drafter
+    proposes up to K tokens per slot and one jitted verify step scores
+    all K+1 positions, committing the longest matching prefix plus a
+    correction token.  Greedy outputs are token-for-token identical to
+    ``spec_k=0``; the win is tokens-per-step > 1 on repetitive text.
 
     ``mesh`` selects the tensor-parallel mesh: an int / ``"tp=N"`` /
     1-tuple tp size (default: ``FLAGS_serving_mesh_tp``).  ``tp>1``
@@ -703,4 +855,4 @@ def create_engine(model, *, max_slots: int = 4, page_size: int = 64,
                   emit_logits=emit_logits,
                   enable_prefix_cache=enable_prefix_cache,
                   sync_interval=sync_interval, clock=clock, slo=slo,
-                  mesh=mesh)
+                  mesh=mesh, spec_k=spec_k)

@@ -57,7 +57,12 @@ __all__ = ["ModelRunner"]
 _M_STEP_TRACES = _obs.counter(
     "serving_decode_step_traces_total",
     "decode-step jit traces — continuous batching keeps this at 1 per "
-    "engine; growth means admissions are re-tracing")
+    "engine (2 with speculative decoding: the plain step + the verify "
+    "program); growth means admissions are re-tracing")
+_M_VERIFY_TRACES = _obs.counter(
+    "serving_spec_verify_traces_total",
+    "verify-program jit traces — exactly 1 per speculative engine; "
+    "growth means drafts are leaking into shapes")
 _M_PREFILL_TRACES = _obs.counter(
     "serving_prefill_traces_total",
     "prefill jit traces (one per prompt-length bucket)", ("bucket",))
@@ -84,7 +89,7 @@ class ModelRunner:
     def __init__(self, config, state: dict, *, tp: int = 1,
                  max_slots: int, page_size: int, table_width: int,
                  num_pages: int, dump_page: int, sync_interval: int = 1,
-                 emit_logits: bool = False,
+                 emit_logits: bool = False, spec_k: int = 0,
                  per_device_pool_bytes: int | None = None):
         self.config = config
         self.tp = int(tp)
@@ -95,6 +100,7 @@ class ModelRunner:
         self.dump_page = int(dump_page)
         self.sync_interval = int(sync_interval)
         self.emit_logits = bool(emit_logits)
+        self.spec_k = int(spec_k)
         validate_tp(config, self.tp)
 
         L = config.num_hidden_layers
@@ -108,6 +114,13 @@ class ModelRunner:
         sin = sin.astype(jnp.float32)
         table0 = np.full((self.max_slots, self.table_width),
                          self.dump_page, np.int32)
+        # with speculation the ring rows are WIDE ([slots, k+1]: a verify
+        # step deposits every candidate token; the plain step uses column
+        # 0) so the host sync stays ONE transfer either way
+        ring_shape = ((self.sync_interval, self.max_slots)
+                      if self.spec_k == 0 else
+                      (self.sync_interval, self.max_slots,
+                       self.spec_k + 1))
 
         if self.tp == 1:
             self.mesh = None
@@ -120,8 +133,7 @@ class ModelRunner:
             self._pos_dev = jnp.zeros((self.max_slots,), jnp.int32)
             self._tok_dev = jnp.zeros((self.max_slots,), jnp.int32)
             self._active_dev = jnp.zeros((self.max_slots,), jnp.int32)
-            self._ring_dev = jnp.zeros(
-                (self.sync_interval, self.max_slots), jnp.int32)
+            self._ring_dev = jnp.zeros(ring_shape, jnp.int32)
             self._ridx_dev = jnp.zeros((), jnp.int32)
         else:
             from jax.sharding import Mesh, NamedSharding, PartitionSpec
@@ -150,13 +162,15 @@ class ModelRunner:
             self._active_dev = jax.device_put(
                 jnp.zeros((self.max_slots,), jnp.int32), rep)
             self._ring_dev = jax.device_put(
-                jnp.zeros((self.sync_interval, self.max_slots),
-                          jnp.int32), rep)
+                jnp.zeros(ring_shape, jnp.int32), rep)
             self._ridx_dev = jax.device_put(
                 jnp.zeros((), jnp.int32), rep)
 
         self.decode_traces = 0      # python mirror of _M_STEP_TRACES
+        self.verify_traces = 0      # python mirror of _M_VERIFY_TRACES
         self._step_fn = self._make_step_fn()
+        self._verify_fn = (self._make_verify_fn() if self.spec_k
+                           else None)
         self._prefill_fns: dict[int, object] = {}   # bucket -> jitted fn
         self._prefill_cached_fns: dict[int, object] = {}
         self._copy_page_fn = self._make_copy_page_fn()
@@ -229,6 +243,7 @@ class ModelRunner:
         L = cfg.num_hidden_layers
         emit_logits = self.emit_logits
         rope_len = self._rope_len
+        wide_ring = self.spec_k > 0
         runner = self
 
         def step(state, kpool, vpool, table, pos, tok, active, ring,
@@ -263,7 +278,8 @@ class ModelRunner:
             act = active.astype(bool)
             pos2 = pos + active                 # idle slots stay parked
             tok2 = jnp.where(act, nxt, tok)     # greedy chains on device
-            ring2 = ring.at[ridx].set(nxt)
+            ring2 = (ring.at[ridx, :, 0].set(nxt) if wide_ring
+                     else ring.at[ridx].set(nxt))
             ridx2 = (ridx + 1) % ring.shape[0]
             return (kpool, vpool, pos2, tok2, ring2, ridx2,
                     logits if emit_logits
@@ -280,6 +296,7 @@ class ModelRunner:
         L = cfg.num_hidden_layers
         emit_logits = self.emit_logits
         rope_len = self._rope_len
+        wide_ring = self.spec_k > 0
         runner = self
 
         def step(state, kpool, vpool, table, pos, tok, active, ring,
@@ -308,13 +325,118 @@ class ModelRunner:
             act = active.astype(bool)
             pos2 = pos + active
             tok2 = jnp.where(act, nxt, tok)
-            ring2 = ring.at[ridx].set(nxt)
+            ring2 = (ring.at[ridx, :, 0].set(nxt) if wide_ring
+                     else ring.at[ridx].set(nxt))
             ridx2 = (ridx + 1) % ring.shape[0]
             return (kpool, vpool, pos2, tok2, ring2, ridx2,
                     logits if emit_logits
                     else jnp.zeros((), jnp.float32))
 
         return step
+
+    def _make_verify_fn(self):
+        if self.tp == 1:
+            return jax.jit(self._build_verify(tp=False),
+                           donate_argnums=(1, 2, 4, 5, 7, 8))
+        from jax.sharding import PartitionSpec as P
+        pool = self._pool_pspec
+        mapped = jax.shard_map(
+            self._build_verify(tp=True), mesh=self.mesh,
+            in_specs=(self._state_specs(), pool, pool, P(), P(), P(),
+                      P(), P(), P(), P(), P(), P(), P()),
+            out_specs=(pool, pool, P(), P(), P(), P()),
+            check_vma=False)
+        return jax.jit(mapped, donate_argnums=(1, 2, 4, 5, 7, 8))
+
+    def _build_verify(self, *, tp: bool):
+        """The speculative verify program: score ``k+1`` candidate
+        positions per slot in ONE step.
+
+        The ``[slots, k+1]`` token grid (the slot's current token +
+        its ``k`` draft tokens) flattens to a ``[slots*(k+1)]`` batch
+        that runs the SAME paged decode layer as the plain step — every
+        row writes its token's KV at ``pos + j`` first, then attends
+        with ``lens = pos + j + 1``, so row ``j`` sees exactly the
+        prefix a sequential decode would have seen (its own slot's
+        writes ``j' <= j``; later rows' writes sit past ``lens`` and
+        rejected rows' stale KV is masked the same way until a later
+        step overwrites it in place — KV rollback is free).  Acceptance
+        is computed on device: the longest prefix where the draft
+        matches the target argmax, ``+1`` for the correction/bonus
+        token, advances pos/tok; the full candidate row lands in the
+        wide ring for the host to re-derive the same acceptance without
+        an extra transfer.  Slots with no draft (``dlen == 0``) reduce
+        exactly to the plain step.  Shapes depend only on
+        ``(slots, k)`` — drafts and their lengths are data, so this
+        traces ONCE; with the plain step that makes ``decode_traces``
+        exactly 2 for a speculative engine.
+
+        A draft-model proposer or parallel sampling (n>1) later reuses
+        this program unchanged: both only change how the ``draft`` grid
+        is filled on the host, not how it is scored."""
+        cfg = self.config
+        L = cfg.num_hidden_layers
+        rope_len = self._rope_len
+        k = self.spec_k
+        M = k + 1
+        runner = self
+
+        def verify(state, kpool, vpool, table, pos, tok, active, ring,
+                   ridx, draft, dlen, cos, sin):
+            # trace-time counters, exactly like the plain step body
+            runner.decode_traces += 1
+            runner.verify_traces += 1
+            _M_STEP_TRACES.inc()
+            _M_VERIFY_TRACES.inc()
+            S = tok.shape[0]
+            # [S, M] candidate grid: column 0 is the slot's current
+            # token (the plain step's input), columns 1..k its drafts
+            grid = jnp.concatenate([tok[:, None], draft], axis=1)
+            offs = jnp.arange(M, dtype=jnp.int32)
+            pos_f = (pos[:, None] + offs[None, :]).reshape(-1)
+            posc = jnp.minimum(pos_f, rope_len - 1)
+            tok_f = grid.reshape(-1)
+            table_f = jnp.repeat(table, M, axis=0)
+            emb = jnp.take(state["llama.embed_tokens.weight"], tok_f,
+                           axis=0)
+            cos1, sin1 = _rope_at(cos, sin, posc)
+            h = emb
+            kps, vps = [], []
+            for i in range(L):
+                w = _layer_weights(state, i)
+                if tp:
+                    h, kp_, vp_ = decode_layer_paged_tp(
+                        w, h, kpool[i], vpool[i], table_f, cos1, sin1,
+                        posc, cfg, TP_AXIS)
+                else:
+                    h, kp_, vp_ = _decode_layer_paged(
+                        w, h, kpool[i], vpool[i], table_f, cos1, sin1,
+                        posc, cfg)
+                kps.append(kp_)
+                vps.append(vp_)
+            kpool = jnp.stack(kps)
+            vpool = jnp.stack(vps)
+            h = _rms(h[:, None], state["llama.norm.weight"],
+                     cfg.rms_norm_eps)[:, 0]
+            logits = _logits_of(state, h).astype(jnp.float32)
+            y = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            y = y.reshape(S, M)
+            # longest matching prefix: draft[:, j] proposed what the
+            # target's own argmax y[:, j] confirms (or not)
+            m = ((draft == y[:, :k]) &
+                 (offs[None, :k] < dlen[:, None])).astype(jnp.int32)
+            # cast back: cumprod/sum promote to int64 under x64, which
+            # would change pos2's dtype and re-trace the plain step
+            acc = jnp.cumprod(m, axis=1).sum(axis=1).astype(jnp.int32)
+            commit = (acc + 1) * active                     # [S]; idle: 0
+            pos2 = pos + commit
+            tok_new = jnp.take_along_axis(y, acc[:, None], axis=1)[:, 0]
+            tok2 = jnp.where(active.astype(bool), tok_new, tok)
+            ring2 = ring.at[ridx].set(y)
+            ridx2 = (ridx + 1) % ring.shape[0]
+            return kpool, vpool, pos2, tok2, ring2, ridx2
+
+        return verify
 
     def _make_copy_page_fn(self):
         if self.tp == 1:
@@ -481,6 +603,33 @@ class ModelRunner:
                 sig += f" tp={self.tp}"
             record_compile("decode_step", t0, signature=sig)
         return logits if self.emit_logits else None
+
+    def verify_step(self, draft: np.ndarray, dlen: np.ndarray):
+        """One speculative verify step: ``draft`` [slots, k] int32
+        candidate tokens, ``dlen`` [slots] int32 drafted counts (0 =
+        the slot takes the plain-step path inside the program).  The
+        uploads are data — shapes are fixed at construction, so this
+        traces once.  Acceptance happens on device (pos/tok advance by
+        the accepted count + 1); the host re-derives it from the wide
+        ring row at the next sync."""
+        if self._verify_fn is None:
+            raise RuntimeError("runner built with spec_k=0 has no "
+                               "verify program")
+        traces_before = self.verify_traces
+        t0 = time.perf_counter()
+        (self.kpool, self.vpool, self._pos_dev, self._tok_dev,
+         self._ring_dev, self._ridx_dev) = self._verify_fn(
+            self.state, self.kpool, self.vpool, self._table_dev,
+            self._pos_dev, self._tok_dev, self._active_dev,
+            self._ring_dev, self._ridx_dev,
+            jnp.asarray(draft, jnp.int32), jnp.asarray(dlen, jnp.int32),
+            self._cos, self._sin)
+        if self.verify_traces != traces_before:
+            sig = (f"slots={self.max_slots} k={self.spec_k} "
+                   f"ring={self.sync_interval}")
+            if self.tp > 1:
+                sig += f" tp={self.tp}"
+            record_compile("verify_step", t0, signature=sig)
 
     def prefill(self, ids: np.ndarray, plen: int, row: np.ndarray):
         """Full-prompt prefill: pages the prompt's KV into the pool and

@@ -666,6 +666,10 @@ def _main(argv=None):
     ap.add_argument("--prefix-cache",
                     action=argparse.BooleanOptionalAction, default=True)
     ap.add_argument("--sync-interval", type=int, default=1)
+    ap.add_argument("--spec-k", type=int, default=None,
+                    help="speculative decoding draft length (0 = off; "
+                    "default FLAGS_serving_spec_k); greedy outputs are "
+                    "identical either way")
     ap.add_argument("--emit-logits", action="store_true",
                     help="enable do_sample requests")
     ap.add_argument("--mesh", default=None,
@@ -693,7 +697,7 @@ def _main(argv=None):
                    emit_logits=args.emit_logits,
                    enable_prefix_cache=args.prefix_cache,
                    sync_interval=args.sync_interval, mesh=args.mesh,
-                   start=False)
+                   spec_k=args.spec_k, start=False)
     server.install_signal_handlers()
     server.start()
     print(f"serving on http://{server.address} "

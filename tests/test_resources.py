@@ -276,12 +276,15 @@ class TestBlockManagerAccounting:
         bm.allocate_seq(1, A, max_new_tokens=4)
         fp = bm.seq_footprint(1)
         assert fp == {"pages": 4, "shared": 2, "exclusive": 2,
-                      "cached_len": 8}
+                      "cached_len": 8, "committed_tokens": 12,
+                      "committed_pages": 3}
         bm.free_seq(0)
         fp = bm.seq_footprint(1)
         assert fp["shared"] == 0 and fp["exclusive"] == 4
         assert bm.seq_footprint(99) == {"pages": 0, "shared": 0,
-                                        "exclusive": 0, "cached_len": 0}
+                                        "exclusive": 0, "cached_len": 0,
+                                        "committed_tokens": 0,
+                                        "committed_pages": 0}
 
 
 # ------------------------------------------------- engine integration

@@ -559,10 +559,11 @@ class TestServeBenchHTTP:
     def _args(self, **over):
         import argparse
         base = dict(requests=4, max_slots=2, page_size=PAGE,
-                    num_pages=64, arrival_gap_ms=1.0, prompt_len=(4, 8),
-                    new_tokens=(2, 4), shared_prefix_len=PAGE,
-                    sync_interval=1, prefix_cache=True, layers=1,
-                    hidden=32, vocab=64, max_model_len=64,
+                    num_pages=64, arrival_gap_ms=1.0, arrival="uniform",
+                    prompt_len=(4, 8), new_tokens=(2, 4),
+                    shared_prefix_len=PAGE, sync_interval=1,
+                    prefix_cache=True, spec_k=0, layers=1, hidden=32,
+                    heads=4, kv_heads=2, vocab=64, max_model_len=64,
                     metrics_dir="", seed=0, http=True, replicas=2)
         base.update(over)
         return argparse.Namespace(**base)

@@ -195,6 +195,48 @@ def _serving_section(metrics):
     return "\n".join(lines)
 
 
+def _spec_section(metrics):
+    """Speculative-decoding summary (serving_spec_* namespace): draft
+    outcomes, acceptance rate, and the tokens-committed-per-verify-step
+    distribution.  Dumps from builds without speculation (or runs with
+    spec_k=0) have none of these keys and produce no section."""
+    if not any(k.startswith("serving_spec_") for k in metrics):
+        return None
+    lines = ["Speculative decoding"]
+    by_result = {}
+    for s in (metrics.get("serving_spec_tokens_total") or {}).get(
+            "series", []):
+        by_result[s.get("labels", {}).get("result", "?")] = \
+            s.get("value", 0)
+    proposed = by_result.get("proposed", 0)
+    if proposed:
+        lines.append(
+            f"  drafts: {_fmt_value(by_result.get('accepted', 0))} "
+            f"accepted / {_fmt_value(by_result.get('rejected', 0))} "
+            f"rejected of {_fmt_value(proposed)} proposed "
+            f"({100.0 * by_result.get('accepted', 0) / proposed:.1f}% "
+            f"acceptance)")
+    steps = sum(s.get("value", 0)
+                for s in (metrics.get("serving_spec_verify_steps_total")
+                          or {}).get("series", []))
+    per_step = metrics.get("serving_spec_tokens_per_step")
+    if per_step:
+        count, total, avg, p50, _ = _hist_stats(per_step)
+        if count:
+            lines.append(
+                f"  verify steps: {_fmt_value(steps)} device steps, "
+                f"{_fmt_value(total)} tokens committed "
+                f"({avg:.2f} tokens/step, p50<={_fmt_value(p50)})")
+    traces = sum(s.get("value", 0)
+                 for s in (metrics.get("serving_spec_verify_traces_total")
+                           or {}).get("series", []))
+    if traces:
+        lines.append(f"  verify program traces: {_fmt_value(traces)} "
+                     f"(the no-retrace contract wants exactly 1 per "
+                     f"engine)")
+    return "\n".join(lines) if len(lines) > 1 else None
+
+
 def _http_section(metrics):
     """HTTP front-end + router summary (serving_http_* / router_*):
     request rate by route/status, rejects (429/503), stream cancels,
@@ -457,6 +499,9 @@ def report(metrics, retraces, trace=None, flight=None, resources=None):
     serving = _serving_section(metrics)
     if serving:
         out += [serving, ""]
+    spec = _spec_section(metrics)
+    if spec:
+        out += [spec, ""]
     http = _http_section(metrics)
     if http:
         out += [http, ""]

@@ -50,6 +50,17 @@ DIRECTIONS = {
     "host_syncs_delta_vs_tp1": "exact",
     "pages_per_token_delta_vs_tp1": "exact",
     "mesh_tp": "exact",
+    # speculative decoding: the verify program must be its own single
+    # trace beside the plain step (exactly 2 decode traces, 1 verify
+    # trace), commit more than one token per device step on repetitive
+    # text, keep the drafter's acceptance above its floor, and stay
+    # bit-identical to the plain engine (parity gates at exactly 1)
+    "spec_decode_traces": "exact",
+    "verify_traces": "exact",
+    "tokens_per_decode_step": "high",
+    "acceptance_rate": "high",
+    "decode_steps_saved_vs_plain": "high",
+    "greedy_parity_vs_plain": "exact",
 }
 
 
@@ -233,12 +244,57 @@ def scenario_tp_decode() -> dict:
     }
 
 
+def scenario_spec_decode() -> dict:
+    """Speculative decoding on repetitive text: the same greedy
+    workload runs with spec_k=0 and spec_k=4, and the spec engine must
+    emit identical tokens while committing > 1 token per device step
+    (the tentpole win), tracing exactly two decode programs (plain +
+    verify) across two admission waves, and spending strictly fewer
+    device steps and host syncs than the plain engine — counters only,
+    no wall clocks.  Single slot, so tokens/step measures speculation
+    rather than batching (concurrent slots would inflate it even with
+    spec_k=0)."""
+
+    def drive(spec_k):
+        eng = _engine(max_slots=1, page_size=4, sync_interval=1,
+                      spec_k=spec_k)
+        # prompts whose greedy continuations collapse into repeats —
+        # the n-gram drafter's best case, deterministic under seed 0
+        reqs = [eng.submit([5, 6, 5, 6, 5, 6], _gen(12))]
+        eng.run_until_complete(max_steps=400)
+        # second wave: admission after a finished request must not
+        # retrace either the plain or the verify program
+        reqs.append(eng.submit([3, 4, 3, 4, 3, 4], _gen(12)))
+        eng.run_until_complete(max_steps=400)
+        return eng, reqs
+
+    plain, ref_reqs = drive(0)
+    eng, reqs = drive(4)
+    st = eng.stats()
+    tokens = sum(r.num_generated for r in reqs)
+    return {
+        "greedy_parity_vs_plain": int(
+            [r.output_tokens for r in reqs]
+            == [r.output_tokens for r in ref_reqs]),
+        "spec_decode_traces": eng.decode_traces,
+        "verify_traces": st["verify_traces"],
+        "tokens_per_decode_step": round(
+            tokens / max(eng.decode_steps, 1), 6),
+        "acceptance_rate": round(st["spec_acceptance_rate"], 6),
+        "decode_steps_saved_vs_plain": (plain.decode_steps
+                                        - eng.decode_steps),
+        "host_syncs": eng.host_syncs,
+        "goodput_ratio": _goodput(reqs),
+    }
+
+
 SCENARIOS = {
     "steady_decode": scenario_steady_decode,
     "prefix_cache": scenario_prefix_cache,
     "deferred_sync": scenario_deferred_sync,
     "goodput_cancel": scenario_goodput_cancel,
     "tp_decode": scenario_tp_decode,
+    "spec_decode": scenario_spec_decode,
 }
 
 

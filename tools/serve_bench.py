@@ -9,11 +9,23 @@ observability dump for tools/metrics_report.py.
 Usage:
     python tools/serve_bench.py [--requests 16] [--max-slots 4]
         [--page-size 16] [--arrival-gap-ms 5]
+        [--arrival uniform|bursty|heavytail]
         [--prompt-len 8 24] [--new-tokens 4 24]
-        [--shared-prefix-len 0] [--sync-interval 1]
+        [--shared-prefix-len 0] [--sync-interval 1] [--spec-k 0]
         [--prefix-cache | --no-prefix-cache]
         [--layers 2 --hidden 64 --vocab 128]
         [--metrics-dir /tmp/serve_metrics] [--seed 0]
+
+``--arrival`` shapes the open-loop schedule while keeping the mean
+inter-arrival at ``--arrival-gap-ms``: ``uniform`` is the constant-gap
+default, ``bursty`` drops requests in back-to-back groups (queueing
+spikes), ``heavytail`` draws Pareto inter-arrivals (rare long lulls,
+dense clumps).  Tail latency (p99 TTFT/TPOT) is reported per run so the
+three patterns can be compared at identical offered load.
+
+``--spec-k K`` turns on speculative decoding (prompt-lookup drafting +
+one K+1-position verify step); greedy outputs are identical, only the
+step count changes.
 
 ``--shared-prefix-len N`` prepends one common N-token prefix to every
 prompt (the system-prompt / few-shot pattern prefix caching targets);
@@ -95,7 +107,7 @@ def run_bench(args):
                            max_model_len=args.max_model_len,
                            enable_prefix_cache=args.prefix_cache,
                            sync_interval=args.sync_interval,
-                           mesh=args.mesh)
+                           mesh=args.mesh, spec_k=args.spec_k)
 
     workload = _build_workload(args, rng, np)
 
@@ -125,15 +137,17 @@ def run_bench(args):
     stats = engine.stats()
 
     print(f"serve_bench: {len(reqs)} requests, {toks} tokens, "
-          f"{wall:.3f}s wall")
+          f"{wall:.3f}s wall ({args.arrival} arrivals)")
     print(f"  throughput      {toks / wall:10.1f} tok/s")
-    print(f"  TTFT   mean/p50/p95  {np.mean(ttfts) * 1e3:8.2f} / "
+    print(f"  TTFT   mean/p50/p95/p99  {np.mean(ttfts) * 1e3:8.2f} / "
           f"{_percentile(ttfts, 0.5) * 1e3:.2f} / "
-          f"{_percentile(ttfts, 0.95) * 1e3:.2f} ms")
+          f"{_percentile(ttfts, 0.95) * 1e3:.2f} / "
+          f"{_percentile(ttfts, 0.99) * 1e3:.2f} ms")
     if tpots:
-        print(f"  TPOT   mean/p50/p95  {np.mean(tpots) * 1e3:8.2f} / "
+        print(f"  TPOT   mean/p50/p95/p99  {np.mean(tpots) * 1e3:8.2f} / "
               f"{_percentile(tpots, 0.5) * 1e3:.2f} / "
-              f"{_percentile(tpots, 0.95) * 1e3:.2f} ms")
+              f"{_percentile(tpots, 0.95) * 1e3:.2f} / "
+              f"{_percentile(tpots, 0.99) * 1e3:.2f} ms")
     print(f"  decode-step traces   {stats['decode_traces']} "
           f"(continuous batching wants exactly 1)")
     print(f"  prefill buckets      {stats['prefill_buckets']}"
@@ -151,6 +165,13 @@ def run_bench(args):
     print(f"  host syncs           {stats['host_syncs']} ring "
           f"(~1/{args.sync_interval} per token) + "
           f"{stats['logit_fetches']} logits fetches")
+    if args.spec_k:
+        steps = stats["decode_steps"]
+        print(f"  spec decode          k={args.spec_k}: "
+              f"{stats['spec_accepted']}/{stats['spec_proposed']} drafts "
+              f"accepted ({stats['spec_acceptance_rate'] * 100:.1f}%), "
+              f"{stats['spec_verify_steps']} verify steps, "
+              f"{toks / steps if steps else 0.0:.2f} tokens/decode-step")
 
     if args.metrics_dir:
         out = obs.dump(args.metrics_dir)
@@ -158,6 +179,7 @@ def run_bench(args):
               f"(render: python tools/metrics_report.py {out})")
     _export_trace(args)
     return {"requests": len(reqs), "tokens": toks, "wall_s": wall,
+            "arrival": args.arrival, "spec_k": args.spec_k,
             "throughput": toks / wall, "ttft_s": ttfts, "tpot_s": tpots,
             "decode_traces": stats["decode_traces"],
             "prefix_hit_rate": hit_rate,
@@ -177,18 +199,42 @@ def _export_trace(args):
         print(f"  chrome trace         FAILED to write {args.trace}")
 
 
+def _arrival_times(args, rng):
+    """Arrival offsets (seconds) for each request.  Every pattern keeps
+    the mean inter-arrival at ``--arrival-gap-ms`` so runs differ only
+    in burstiness, not offered load."""
+    gap = args.arrival_gap_ms / 1e3
+    n = args.requests
+    if args.arrival == "uniform":
+        return [i * gap for i in range(n)]
+    if args.arrival == "bursty":
+        # back-to-back groups of 4, bursts spaced to preserve the rate
+        burst = 4
+        return [(i // burst) * burst * gap for i in range(n)]
+    # heavytail: Pareto (alpha=1.5) inter-arrivals scaled to mean gap —
+    # E[pareto+1] = alpha/(alpha-1), so multiply by (alpha-1)/alpha
+    alpha = 1.5
+    gaps = (rng.pareto(alpha, n) + 1.0) * gap * (alpha - 1.0) / alpha
+    t, out = 0.0, []
+    for g in gaps:
+        out.append(t)
+        t += float(g)
+    return out
+
+
 def _build_workload(args, rng, np):
     plo, phi = args.prompt_len
     nlo, nhi = args.new_tokens
     shared = rng.integers(0, args.vocab,
                           args.shared_prefix_len).astype(np.int32)
+    arrivals = _arrival_times(args, rng)
     workload = []
     for i in range(args.requests):
         suffix = rng.integers(0, args.vocab,
                               int(rng.integers(plo, phi + 1))).astype(
                                   np.int32)
         workload.append((
-            i * args.arrival_gap_ms / 1e3,
+            arrivals[i],
             np.concatenate([shared, suffix]) if shared.size else suffix,
             int(rng.integers(nlo, nhi + 1))))
     return workload
@@ -225,6 +271,7 @@ def run_http_bench(args):
                      max_model_len=args.max_model_len,
                      enable_prefix_cache=args.prefix_cache,
                      sync_interval=args.sync_interval,
+                     spec_k=args.spec_k,
                      model_name=f"replica-{i}")
                for i in range(args.replicas)]
     router = Router([s.address for s in servers],
@@ -275,16 +322,19 @@ def run_http_bench(args):
     hit_rate = hits / lookups if lookups else 0.0
 
     print(f"serve_bench --http: {len(results)} requests over "
-          f"{args.replicas} replica(s), {toks} tokens, {wall:.3f}s wall")
+          f"{args.replicas} replica(s), {toks} tokens, {wall:.3f}s wall "
+          f"({args.arrival} arrivals)")
     print(f"  throughput      {toks / wall:10.1f} tok/s")
     if ttfts:
-        print(f"  TTFT   mean/p50/p95  {np.mean(ttfts) * 1e3:8.2f} / "
+        print(f"  TTFT   mean/p50/p95/p99  {np.mean(ttfts) * 1e3:8.2f} / "
               f"{_percentile(ttfts, 0.5) * 1e3:.2f} / "
-              f"{_percentile(ttfts, 0.95) * 1e3:.2f} ms")
+              f"{_percentile(ttfts, 0.95) * 1e3:.2f} / "
+              f"{_percentile(ttfts, 0.99) * 1e3:.2f} ms")
     if tpots:
-        print(f"  TPOT   mean/p50/p95  {np.mean(tpots) * 1e3:8.2f} / "
+        print(f"  TPOT   mean/p50/p95/p99  {np.mean(tpots) * 1e3:8.2f} / "
               f"{_percentile(tpots, 0.5) * 1e3:.2f} / "
-              f"{_percentile(tpots, 0.95) * 1e3:.2f} ms")
+              f"{_percentile(tpots, 0.95) * 1e3:.2f} / "
+              f"{_percentile(tpots, 0.99) * 1e3:.2f} ms")
     per_replica = _per_replica_latency(results)
     for name in sorted(per_replica):
         r_ttft, r_tpot, n = per_replica[name]
@@ -316,6 +366,7 @@ def run_http_bench(args):
               f"(render: python tools/metrics_report.py {out})")
     _export_trace(args)
     return {"requests": len(results), "tokens": toks, "wall_s": wall,
+            "arrival": args.arrival, "spec_k": args.spec_k,
             "throughput": toks / wall, "ttft_s": ttfts, "tpot_s": tpots,
             "prefix_hit_rate": hit_rate, "router": rstats,
             "per_replica": {k: {"ttft_s": v[0], "tpot_s": v[1],
@@ -331,6 +382,11 @@ def main(argv=None):
     ap.add_argument("--num-pages", type=int, default=None,
                     help="pool size (default: full residency)")
     ap.add_argument("--arrival-gap-ms", type=float, default=5.0)
+    ap.add_argument("--arrival", default="uniform",
+                    choices=("uniform", "bursty", "heavytail"),
+                    help="arrival pattern at the same mean rate: "
+                         "constant gap, back-to-back groups of 4, or "
+                         "Pareto inter-arrivals")
     ap.add_argument("--prompt-len", type=int, nargs=2, default=(8, 24),
                     metavar=("LO", "HI"))
     ap.add_argument("--new-tokens", type=int, nargs=2, default=(4, 24),
@@ -340,6 +396,9 @@ def main(argv=None):
                          "request (exercises the prefix cache)")
     ap.add_argument("--sync-interval", type=int, default=1,
                     help="greedy decode steps per host sync")
+    ap.add_argument("--spec-k", type=int, default=0,
+                    help="speculative decoding draft length (0 = off); "
+                         "greedy outputs are identical either way")
     ap.add_argument("--prefix-cache",
                     action=argparse.BooleanOptionalAction, default=True,
                     help="automatic prefix caching over the KV pool")
