@@ -150,6 +150,24 @@ define_flag("FLAGS_serving_spec_k", 0,
             "slot and one verify step scores all K+1 positions (0 = "
             "off; greedy outputs are identical either way; "
             "create_engine/serve --spec-k overrides)")
+define_flag("FLAGS_serving_fault_plan", "",
+            "deterministic fault injection plan for the serving stack "
+            "(chaos testing): comma-separated entries 'site@N' (inject "
+            "on the Nth check of that site), 'site~P' (inject with "
+            "probability P per check, seeded), plus 'seed=S'; entries "
+            "take ':key=value' params (e.g. 'nan_logits@2:slot=1'). "
+            "Empty = no injection and zero overhead (no plan object is "
+            "built; every site guards on 'faults is not None')")
+define_flag("FLAGS_serving_max_recoveries", 3,
+            "EngineSupervisor restart budget: runner rebuild + in-flight "
+            "re-prefill recoveries allowed per process before escalating "
+            "to drain (in-flight requests then finish with "
+            "finish_reason='error' and the worker stops admitting)")
+define_flag("FLAGS_serving_shed_burn_rate", 0.0,
+            "shed load with 429 when any SLO dimension's burn rate "
+            "(violation rate / error budget, slo.py) reaches this "
+            "threshold — backpressure kicks in before the queue is "
+            "full (0 disables; needs SLO targets configured)")
 define_flag("FLAGS_sanitizer", False,
             "enable the runtime concurrency sanitizer: serving/"
             "observability locks become instrumented wrappers that "

@@ -43,6 +43,12 @@ Modules:
                        thread-stack hang dumps)
   * slo.py           — per-request TTFT/TPOT/E2E SLO verdicts and
                        burn-rate gauges
+  * faults.py        — deterministic fault injection (seedable
+                       FaultPlan firing named faults at existing seams;
+                       zero overhead when off)
+  * supervisor.py    — engine self-healing: step-failure/stall recovery
+                       via runner rebuild + in-flight replay, bounded
+                       restart budget, escalate-to-drain
 
 Every request is traced end to end (observability.tracing): the client,
 router, server, and engine each open spans under ONE trace id carried
@@ -57,7 +63,10 @@ from __future__ import annotations
 
 from .block_manager import BlockManager  # noqa: F401
 from .client import ServingClient, ServingHTTPError  # noqa: F401
-from .engine import Engine, create_engine  # noqa: F401
+from .engine import (  # noqa: F401
+    Engine, NonFiniteLogitsError, create_engine)
+from .faults import (  # noqa: F401
+    FaultPlan, InjectedFault, fault_plan_from_flags)
 from .parallel import ModelRunner, parse_mesh  # noqa: F401
 from .request import GenerationConfig, Request, RequestState  # noqa: F401
 from .router import (  # noqa: F401
@@ -67,12 +76,15 @@ from .server import (  # noqa: F401
     BackpressureError, DrainingError, EngineWorker, ServingServer, serve)
 from .slo import SLOConfig, SLOTracker  # noqa: F401
 from .spec import NgramProposer, SpecStats  # noqa: F401
+from .supervisor import EngineSupervisor  # noqa: F401
 from .watchdog import Watchdog  # noqa: F401
 
 __all__ = ["BackpressureError", "BlockManager", "DrainingError", "Engine",
-           "EngineWorker", "GenerationConfig", "ModelRunner",
-           "NgramProposer", "NoReplicaAvailable", "Replica", "Request",
-           "RequestState", "Router", "RouterServer", "SLOConfig",
-           "SLOTracker", "Scheduler", "ServingClient",
+           "EngineSupervisor", "EngineWorker", "FaultPlan",
+           "GenerationConfig", "InjectedFault", "ModelRunner",
+           "NgramProposer", "NoReplicaAvailable", "NonFiniteLogitsError",
+           "Replica", "Request", "RequestState", "Router", "RouterServer",
+           "SLOConfig", "SLOTracker", "Scheduler", "ServingClient",
            "ServingHTTPError", "ServingServer", "SpecStats", "Watchdog",
-           "create_engine", "parse_mesh", "serve"]
+           "create_engine", "fault_plan_from_flags", "parse_mesh",
+           "serve"]

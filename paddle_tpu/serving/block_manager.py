@@ -94,7 +94,7 @@ class BlockManager:
     """
 
     def __init__(self, num_pages: int, page_size: int,
-                 enable_prefix_cache: bool = False):
+                 enable_prefix_cache: bool = False, faults=None):
         if num_pages < 1:
             raise ValueError(f"num_pages must be >= 1, got {num_pages}")
         if page_size < 1:
@@ -103,6 +103,7 @@ class BlockManager:
         self.page_size = int(page_size)
         self.dump_page = self.num_pages       # pool row past the real pages
         self.prefix_cache = bool(enable_prefix_cache)
+        self.faults = faults                  # chaos harness (None = off)
         # FIFO reuse keeps page churn spread across the pool; a deque
         # makes both ends O(1) (popping the head of a plain list shifts
         # the whole tail on every acquisition)
@@ -320,6 +321,92 @@ class BlockManager:
     def pages_of(self, seq_id: int):
         return list(self._tables.get(seq_id, ()))
 
+    # ---------------------------------------------------------- recovery
+    def flush_prefix_cache(self) -> int:
+        """Invalidate every prefix-cache registration and free the
+        parked LRU pages.  Called when the device KV pool is rebuilt
+        (engine recovery): the chain index describes KV *content* that
+        no longer exists, so any future match would share garbage.
+        Live sequences keep their tables/refcounts — their content is
+        regenerated by replay — but their pages are unregistered, so a
+        later free sends them to the free list, not the LRU.  Returns
+        the number of registrations dropped."""
+        dropped = len(self._key_of) + len(self._tail_parent)
+        for page in self._lru:
+            self._free.append(page)
+        self._lru.clear()
+        self._index.clear()
+        self._key_of.clear()
+        self._tails.clear()
+        self._tail_parent.clear()
+        self._children.clear()
+        _M_CACHED_PAGES.set(self.cached_pages)
+        self._update_pool_gauges()
+        if dropped:
+            _obs.flight("blocks", "prefix_flush", dropped=dropped)
+        return dropped
+
+    def replay_plan(self, seq_id: int, tokens) -> dict:
+        """Prefill plan for re-running ``seq_id``'s committed ``tokens``
+        through the model after a runner rebuild (the sequence still
+        owns its pages; only device KV content was lost).
+
+        Walks the chain index like admission, but a chunk only counts
+        as cached when the index maps it to **this sequence's own
+        page** — sharers hold identical page ids, so once one of them
+        has replayed, the others' leading chunks match and their
+        replay prefills only the unshared suffix.  The replayed full
+        chunks are (re-)registered on the sequence's own pages; partial
+        tails are not re-registered (past the prompt they contain
+        generated tokens, which admission-time tail matching must never
+        see).  Returns ``{"cached_len", "hits", "misses"}``; at least
+        one token is always left to recompute."""
+        pages = self._tables.get(seq_id)
+        if pages is None:
+            raise ValueError(f"sequence {seq_id} owns no pages")
+        if not self.prefix_cache:
+            return {"cached_len": 0, "hits": 0, "misses": 0}
+        tokens = tuple(int(t) for t in np.asarray(tokens).reshape(-1))
+        ps = self.page_size
+        full = len(tokens) // ps
+        matched = 0
+        parent = _ROOT
+        for c in range(full):
+            page = self._index.get((parent, tokens[c * ps:(c + 1) * ps]))
+            if page is None or page != pages[c]:
+                break
+            matched += 1
+            parent = page
+        cached_len = min(matched * ps, len(tokens) - 1)
+        self.prefix_hits += matched
+        self.prefix_misses += full - matched
+        if matched:
+            _M_PREFIX_PAGES.labels("hit").inc(matched)
+        if full - matched:
+            _M_PREFIX_PAGES.labels("miss").inc(full - matched)
+        if cached_len:
+            self.cached_tokens += cached_len
+            _M_PREFIX_TOKENS.inc(cached_len)
+        # re-register the chunks this replay regenerates, chaining
+        # through any page an identical chunk already re-cached
+        for c in range(matched, full):
+            key = (parent, tokens[c * ps:(c + 1) * ps])
+            existing = self._index.get(key)
+            if existing is not None:
+                parent = existing
+                continue
+            page = pages[c]
+            if page in self._key_of:      # already carries another key
+                parent = page
+                continue
+            self._index[key] = page
+            self._key_of[page] = key
+            self._children.setdefault(parent, set()).add(page)
+            parent = page
+        _M_CACHED_PAGES.set(self.cached_pages)
+        return {"cached_len": cached_len, "hits": matched,
+                "misses": full - matched}
+
     # ------------------------------------- committed tokens (speculative)
     # Pages are reserved all-or-nothing at admission, so speculative
     # decoding never allocates mid-flight; what moves is the
@@ -501,6 +588,9 @@ class BlockManager:
         """Take ``n`` pages: free list first, then LRU eviction of
         cached refcount-0 pages (leaf-first, so a chain parent is never
         recycled while children could still match through it)."""
+        if (n > 0 and self.faults is not None
+                and self.faults.check("page_alloc", need=n) is not None):
+            return None        # synthetic device-OOM -> backpressure
         got: list[int] = []
         while len(got) < n:
             if self._free:

@@ -16,11 +16,21 @@ Transport failures (connection refused/reset before a response) raise
 An HTTP-level error (429 backpressure, 503 draining, 400 validation)
 raises :class:`ServingHTTPError` carrying status, parsed body, and any
 ``Retry-After`` — the replica answered, so the router does NOT retry.
+
+With ``retries > 0`` the client itself retries **429/503** answers
+(backpressure / draining / SLO shedding — the retryable overload
+family) with jittered exponential backoff, honoring the server's
+``Retry-After`` as a lower bound on each sleep.  Attempts are bounded
+and each attempt keeps the per-request ``timeout``; the default
+``retries=0`` preserves fail-fast semantics for the router, which does
+its own replica-level retrying.
 """
 from __future__ import annotations
 
 import http.client
 import json
+import random
+import time
 
 from ..observability import tracing as _tracing
 
@@ -54,22 +64,69 @@ def _parse_address(address) -> tuple[str, int]:
     return host, int(port)
 
 
-class ServingClient:
-    """One serving endpoint (a replica, or a router front-end)."""
+_RETRYABLE = (429, 503)         # backpressure / draining / shedding
 
-    def __init__(self, address, timeout: float = 60.0):
+
+class ServingClient:
+    """One serving endpoint (a replica, or a router front-end).
+
+    ``retries`` bounds how many times a 429/503 answer is retried
+    (0 = fail fast); sleeps grow as jittered exponential backoff from
+    ``backoff_s`` capped at ``backoff_max_s``, never below the server's
+    ``Retry-After``.  ``rng`` pins the jitter for deterministic tests.
+    """
+
+    def __init__(self, address, timeout: float = 60.0, *,
+                 retries: int = 0, backoff_s: float = 0.05,
+                 backoff_max_s: float = 2.0, rng=None):
         self.host, self.port = _parse_address(address)
         self.address = f"{self.host}:{self.port}"
         self.timeout = float(timeout)
+        self.retries = int(retries)
+        self.backoff_s = float(backoff_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self._rng = rng if rng is not None else random.Random()
 
     def _connect(self) -> http.client.HTTPConnection:
         return http.client.HTTPConnection(self.host, self.port,
                                           timeout=self.timeout)
 
+    # --------------------------------------------------------- backoff
+    def _retry_delay(self, attempt: int,
+                     retry_after: float | None) -> float:
+        """Jittered exponential backoff (50-100% of the exponential
+        step), floored at the server's Retry-After when it sent one."""
+        base = min(self.backoff_max_s, self.backoff_s * (2 ** attempt))
+        delay = base * (0.5 + 0.5 * self._rng.random())
+        if retry_after:
+            delay = max(delay, float(retry_after))
+        return delay
+
+    def _with_retries(self, fn):
+        """Run ``fn()`` with the 429/503 retry policy.  Each attempt is
+        a fresh connection with the full per-attempt timeout; transport
+        errors are never retried here (the router owns those)."""
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except ServingHTTPError as e:
+                if e.status not in _RETRYABLE or attempt >= self.retries:
+                    raise
+                time.sleep(self._retry_delay(attempt, e.retry_after))
+                attempt += 1
+
     # ------------------------------------------------------ plain JSON
     def request(self, method: str, path: str, body: dict | None = None,
                 headers: dict | None = None):
-        """One JSON round trip; raises ServingHTTPError on non-2xx."""
+        """One JSON round trip; raises ServingHTTPError on non-2xx
+        (retrying 429/503 first when ``retries > 0``)."""
+        return self._with_retries(
+            lambda: self._request_once(method, path, body, headers))
+
+    def _request_once(self, method: str, path: str,
+                      body: dict | None = None,
+                      headers: dict | None = None):
         conn = self._connect()
         try:
             payload = None if body is None else json.dumps(body).encode()
@@ -124,7 +181,11 @@ class ServingClient:
             finally:
                 span.end()
         try:
-            return self._stream_completion(body, hdrs, span)
+            # the retry policy covers the connect + status check (a 429
+            # raises before any event flows); once streaming, failures
+            # are mid-stream and no longer retryable here
+            return self._with_retries(
+                lambda: self._stream_completion(body, hdrs, span))
         except BaseException:
             span.end()
             raise

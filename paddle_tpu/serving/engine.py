@@ -62,11 +62,12 @@ from ..observability.resources import resource_tracker
 from ..models.generation import GenerationConfig
 from ..models.llama import LlamaConfig
 from .block_manager import BlockManager
+from .faults import InjectedFault, fault_plan_from_flags
 from .parallel import ModelRunner, parse_mesh
 from .request import Request, RequestState
 from .scheduler import Scheduler
 
-__all__ = ["Engine", "create_engine"]
+__all__ = ["Engine", "NonFiniteLogitsError", "create_engine"]
 
 _M_STEPS = _obs.counter(
     "serving_decode_steps_total", "engine decode iterations")
@@ -77,7 +78,14 @@ _M_REQUESTS = _obs.counter(
 _M_FINISH = _obs.counter(
     "serving_finish_total",
     "finished requests by finish_reason "
-    "(length|eos|cancelled|deadline)", ("reason",))
+    "(length|eos|cancelled|deadline|error)", ("reason",))
+_M_RECOVERY = _obs.counter(
+    "serving_recovery_total",
+    "self-healing events: 'quarantine' = one request failed in place "
+    "(finish_reason='error', batch kept running), 'rebuild' = runner "
+    "rebuilt + in-flight requests replayed, 'stall' = rebuild declared "
+    "by the watchdog, 'drain' = restart budget exhausted, escalated",
+    ("kind",))
 _M_HOST_SYNCS = _obs.counter(
     "serving_host_syncs_total",
     "device->host transfers on the serving hot path: 'ring' = sampled-"
@@ -91,6 +99,13 @@ _M_PHASE_SECONDS = _obs.counter(
     "copies), 'decode' step dispatch, 'host_sync' blocking ring "
     "fetches — the resource tracker's tokens/s and MFU denominator",
     ("phase",))
+
+
+class NonFiniteLogitsError(ValueError):
+    """A request's logits hold no usable probability mass (NaN/Inf from
+    the model, or top_k/top_p masked every candidate).  A per-request
+    failure: the engine quarantines the offending request
+    (finish_reason='error') and keeps the rest of the batch running."""
 
 
 def _serving_hists():
@@ -123,7 +138,8 @@ class Engine:
                  emit_logits: bool = False,
                  enable_prefix_cache: bool = False,
                  sync_interval: int = 1, clock=time.monotonic,
-                 slo=None, mesh=None, spec_k: int | None = None):
+                 slo=None, mesh=None, spec_k: int | None = None,
+                 faults=None):
         if model is not None:
             from ..framework.tensor import Tensor
             config = model.config
@@ -166,10 +182,14 @@ class Engine:
         else:
             self._proposer = None
             self._spec = None
+        # chaos harness: None (the default when FLAGS_serving_fault_plan
+        # is empty) keeps every injection site to a single None test
+        self.faults = fault_plan_from_flags() if faults is None else faults
 
         self.blocks = BlockManager(
             num_pages, self.page_size,
-            enable_prefix_cache=self.enable_prefix_cache)
+            enable_prefix_cache=self.enable_prefix_cache,
+            faults=self.faults)
         self.scheduler = Scheduler(self.blocks, self.max_slots)
         self.scheduler._finalize = self._finalize
         # every eviction parks its slot — not just the length/eos path in
@@ -188,15 +208,18 @@ class Engine:
             num_layers=L, num_kv_heads=kvh, head_dim=hd,
             dtype_itemsize=int(np.dtype(dtype).itemsize), tp=self.tp)
         # the device half: mesh, weight placement, pools, decode state,
-        # and every jitted program live behind the runner seam
-        self.runner = ModelRunner(
-            config, state, tp=self.tp, max_slots=self.max_slots,
+        # and every jitted program live behind the runner seam.  The
+        # kwargs are kept so recover() can rebuild an identical runner
+        # after a poisoned step (fresh pools, same static shapes).
+        self._runner_kw = dict(
+            tp=self.tp, max_slots=self.max_slots,
             page_size=self.page_size, table_width=self.table_width,
             num_pages=self.blocks.num_pages,
             dump_page=self.blocks.dump_page,
             sync_interval=self.sync_interval,
             emit_logits=self.emit_logits, spec_k=self.spec_k,
             per_device_pool_bytes=sizing["per_device_bytes"])
+        self.runner = ModelRunner(config, state, **self._runner_kw)
 
         # host-side mirrors of the slot state (bookkeeping + targeted
         # device patches on admit/evict; NEVER re-uploaded per step)
@@ -216,6 +239,10 @@ class Engine:
         self.decode_steps = 0       # mirror of serving_decode_steps_total
         self.host_syncs = 0         # ring fetches (1 per sync_interval)
         self.logit_fetches = 0      # [slots, V] transfers (sampling only)
+        # self-healing mirrors of serving_recovery_total
+        self.recoveries = 0         # runner rebuilds (recover() calls)
+        self.quarantines = 0        # requests failed in place
+        self.replayed_requests = 0  # in-flight requests re-prefilled
         # per-phase wall seconds (mirror of serving_step_phase_seconds_
         # total; resource_snapshot() reports them per engine)
         self.timings = {"prefill_s": 0.0, "decode_s": 0.0,
@@ -366,25 +393,39 @@ class Engine:
         meta = self.blocks.seq_meta(req.id)
         cached = int(meta["cached_len"])
         row = self.blocks.table_row(req.id, self.table_width)
-        if meta["cow_src"] is not None:
-            # copy-on-write: duplicate the matching tail page into this
-            # request's own tail before any of its writes land there
-            self.runner.copy_page(int(meta["cow_src"]),
-                                  int(row[cached // ps]))
-        if cached == 0:
-            bucket = -(-plen // ps) * ps
-            ids = np.zeros((1, bucket), np.int32)
-            ids[0, :plen] = req.prompt
-            logits = self.runner.prefill(ids, plen, row)
-        else:
-            suffix = plen - cached
-            bucket = -(-suffix // ps) * ps
-            ids = np.zeros((1, bucket), np.int32)
-            ids[0, :suffix] = req.prompt[cached:]
-            logits = self.runner.prefill_cached(ids, suffix, cached, row)
-        req.num_cached_tokens = cached
-        _M_HOST_SYNCS.labels("prefill").inc()
-        tok = self._pick_token(req, np.asarray(logits)[0])
+        try:
+            if meta["cow_src"] is not None:
+                # copy-on-write: duplicate the matching tail page into
+                # this request's own tail before any writes land there
+                self.runner.copy_page(int(meta["cow_src"]),
+                                      int(row[cached // ps]))
+            if cached == 0:
+                bucket = -(-plen // ps) * ps
+                ids = np.zeros((1, bucket), np.int32)
+                ids[0, :plen] = req.prompt
+                logits = self.runner.prefill(ids, plen, row)
+            else:
+                suffix = plen - cached
+                bucket = -(-suffix // ps) * ps
+                ids = np.zeros((1, bucket), np.int32)
+                ids[0, :suffix] = req.prompt[cached:]
+                logits = self.runner.prefill_cached(ids, suffix, cached,
+                                                    row)
+            req.num_cached_tokens = cached
+            _M_HOST_SYNCS.labels("prefill").inc()
+            logits_row = np.asarray(logits)[0]
+            if (self.faults is not None
+                    and self.faults.check("nan_logits", req=req.id,
+                                          slot=slot,
+                                          phase="prefill") is not None):
+                logits_row = np.full_like(logits_row, np.nan)
+            tok = self._pick_token(req, logits_row)
+        except Exception as e:
+            # a failed prefill kills ONE request, never the process:
+            # pages release, the slot parks, the batch keeps running
+            self._note_phase("prefill", time.perf_counter() - t0)
+            self._quarantine(slot, req, e, self._clock())
+            return
         now = self._clock()
         self._ttft.observe(now - req.arrival_time)
         self._note_phase("prefill", time.perf_counter() - t0)
@@ -415,6 +456,17 @@ class Engine:
 
     # ------------------------------------------------------------ decode
     def _decode(self, active: list[int]):
+        if self.faults is not None:
+            f = self.faults.check("slow_step", step=self.decode_steps)
+            if f is not None:
+                time.sleep(float(f.get("seconds", 0.05)))
+            # raise BEFORE any dispatch: the pools are never half-
+            # donated, so recovery sees a consistent host mirror
+            if self.faults.check("step_raise",
+                                 step=self.decode_steps) is not None:
+                raise InjectedFault(
+                    f"injected poisoned decode step "
+                    f"(step {self.decode_steps})")
         if self._seg_span is None:
             # one span per host-sync interval, NOT per device step —
             # segments are the engine's visible unit of decode work
@@ -564,7 +616,19 @@ class Engine:
                         logits_np = np.asarray(self._last_logits)
                         self.logit_fetches += 1
                         _M_HOST_SYNCS.labels("logits").inc()
-                    tok = self._pick_token(req, logits_np[slot])
+                    row_logits = logits_np[slot]
+                    if (self.faults is not None
+                            and self.faults.check(
+                                "nan_logits", req=req.id, slot=slot,
+                                phase="decode") is not None):
+                        row_logits = np.full_like(row_logits, np.nan)
+                    try:
+                        tok = self._pick_token(req, row_logits)
+                    except NonFiniteLogitsError as e:
+                        # fail ONLY the offending request — the other
+                        # slots in this sync keep their tokens
+                        self._quarantine(slot, req, e, now)
+                        continue
                     if tok != raw:
                         corrections.append((slot, tok))
                 prev = req.last_token_at
@@ -660,6 +724,13 @@ class Engine:
     def _pick_token(self, req: Request, logits: np.ndarray) -> int:
         g = req.gen
         if not g.do_sample:
+            # argmax over NaN silently returns the NaN's index (NaN
+            # propagates as the max) — poisoned logits must fail the
+            # request loudly, not emit a garbage token
+            if np.isnan(logits).any() or not np.isfinite(logits).any():
+                raise NonFiniteLogitsError(
+                    f"request {req.id}: non-finite logits from the "
+                    "model (greedy decode)")
             return int(np.argmax(logits))
         rng = self._rngs.get(req.id)
         if rng is None:
@@ -680,7 +751,7 @@ class Engine:
             cutoff = logits[order[min(cutoff_idx, logits.size - 1)]]
             logits = np.where(logits < cutoff, -np.inf, logits)
         if not np.isfinite(logits).any():
-            raise ValueError(
+            raise NonFiniteLogitsError(
                 f"request {req.id}: no finite logits to sample from — "
                 "the model emitted non-finite logits (or top_k/top_p "
                 "masked every candidate)")
@@ -725,6 +796,121 @@ class Engine:
                                  round(now - req.deadline, 6))
             rs.end()
 
+    # -------------------------------------------------------- self-healing
+    def _quarantine(self, slot: int, req: Request, why, now: float):
+        """Fail ONE request in place: finish_reason='error', pages
+        released, slot parked — the batch keeps running.  The failure
+        detail lands on ``req.error`` for the server's error payload."""
+        req.error = str(why)
+        self.quarantines += 1
+        _M_RECOVERY.labels("quarantine").inc()
+        _obs.flight("engine", "quarantine", req=req.id, slot=slot,
+                    error=str(why)[:160])
+        self._finalize(req, "error", now)
+        self.scheduler.evict(slot, "error", now)
+
+    def recover(self) -> dict:
+        """Rebuild the ModelRunner after a poisoned step and replay
+        every in-flight request.
+
+        The BlockManager is entirely host-side, so page ownership, block
+        tables, and the committed-token ledger all survive — only the
+        device KV *content* is gone.  Each DECODE-state request re-runs
+        its committed tokens (prompt + generated so far, minus the last
+        token, which re-enters as the next decode input) through the
+        prefill path; the prefix-cache chain is flushed first (it
+        described dead KV) and re-registered by the replays themselves,
+        so sequences sharing prefix pages replay the shared part once.
+        Requests that cannot be replayed are quarantined.  Typically
+        called by the :class:`~.supervisor.EngineSupervisor`, not
+        user code."""
+        now = self._clock()
+        t0 = time.perf_counter()
+        if self._seg_span is not None:
+            self._seg_span.set_attribute("aborted", True)
+            self._seg_span.end()
+            self._seg_span = None
+        self._seg_steps = 0
+        # drop un-synced device state: the ring rows and logits handle
+        # belong to the dead runner (the pos mirrors they would have
+        # advanced are recomputed from request state below)
+        self._pending.clear()
+        self._ring_cursor = 0
+        self._last_logits = None
+        flushed = self.blocks.flush_prefix_cache()
+        self.runner = ModelRunner(self.config, self.state,
+                                  **self._runner_kw)
+        replayed = 0
+        for slot, req in enumerate(self.scheduler.slots):
+            if req is None:
+                self._park(slot)        # sync the fresh decode state
+                continue
+            if req.state != RequestState.DECODE or not req.output_tokens:
+                self._quarantine(slot, req,
+                                 "not replayable at runner rebuild", now)
+                continue
+            try:
+                self._replay(slot, req)
+                replayed += 1
+                self.replayed_requests += 1
+            except Exception as e:
+                self._quarantine(slot, req, f"replay failed: {e}", now)
+        self.recoveries += 1
+        _obs.flight("engine", "recover", replayed=replayed,
+                    flushed_cached_pages=flushed)
+        _obs.tracer().record_span(
+            "engine.recover", t0, time.perf_counter(),
+            attributes={"replayed": replayed,
+                        "flushed_cached_pages": flushed})
+        return {"replayed": replayed, "flushed_cached_pages": flushed}
+
+    def _replay(self, slot: int, req: Request):
+        """Re-prefill one in-flight request's committed tokens into the
+        rebuilt runner.  Restores the decode invariant exactly: device
+        KV covers positions ``0..pos-1`` where ``pos = prompt +
+        generated - 1``, and the last generated token re-enters as the
+        next step's input — decode then continues token-for-token as if
+        the fault never happened (greedy parity is asserted in tests)."""
+        t0 = time.perf_counter()
+        tokens = [int(t) for t in req.prompt] + list(req.output_tokens)
+        ids_all = tokens[:-1]
+        n = len(ids_all)
+        plan = self.blocks.replay_plan(req.id, ids_all)
+        cached = int(plan["cached_len"])
+        row = self.blocks.table_row(req.id, self.table_width)
+        ps = self.page_size
+        if cached == 0:
+            bucket = -(-n // ps) * ps
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :n] = ids_all
+            self.runner.prefill(ids, n, row)
+        else:
+            suffix = n - cached
+            bucket = -(-suffix // ps) * ps
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :suffix] = ids_all[cached:]
+            self.runner.prefill_cached(ids, suffix, cached, row)
+        # the replay's logits are discarded (the last token is already
+        # known), so no host sync happens here
+        drift = self.blocks.committed_tokens(req.id) - len(tokens)
+        if drift > 0:
+            # a fault between a speculative dispatch and its sync left
+            # uncommitted draft positions charged — roll them back
+            self.blocks.rollback(req.id, drift)
+        self.table[slot] = row
+        self._pos[slot] = n
+        self._tok[slot] = tokens[-1]
+        self._active[slot] = 1
+        self._push_slot(slot)
+        self._note_phase("prefill", time.perf_counter() - t0)
+        _obs.tracer().record_span(
+            "engine.replay", t0, time.perf_counter(),
+            parent=req.root_span,
+            attributes={"req": req.id, "slot": slot, "tokens": n,
+                        "cached_tokens": cached})
+        _obs.flight("engine", "replay", req=req.id, slot=slot,
+                    tokens=n, cached=cached)
+
     # -------------------------------------------------------------- info
     def stats(self) -> dict:
         b = self.blocks
@@ -755,6 +941,11 @@ class Engine:
             "timings": {k: round(v, 6) for k, v in self.timings.items()},
             "progress": self.progress,
             "slo": self.slo.stats() if self.slo is not None else None,
+            "recoveries": self.recoveries,
+            "quarantines": self.quarantines,
+            "replayed_requests": self.replayed_requests,
+            "faults_injected": (dict(self.faults.injected)
+                                if self.faults is not None else {}),
         }
 
     def resource_snapshot(self) -> dict:
@@ -789,6 +980,8 @@ class Engine:
                 "host_syncs": self.host_syncs,
                 "logit_fetches": self.logit_fetches,
                 "pages_allocated": b.pages_allocated,
+                "recoveries": self.recoveries,
+                "quarantines": self.quarantines,
             },
         }
 
@@ -816,7 +1009,7 @@ def create_engine(model, *, max_slots: int = 4, page_size: int = 64,
                   enable_prefix_cache: bool = False,
                   sync_interval: int = 1, clock=time.monotonic,
                   slo=None, mesh=None,
-                  spec_k: int | None = None) -> Engine:
+                  spec_k: int | None = None, faults=None) -> Engine:
     """`create_predictor`-style entry point: build a continuous-batching
     engine over a LlamaForCausalLM (or any model exposing ``config`` and
     ``functional_state()`` with the llama state-dict layout).
@@ -855,4 +1048,4 @@ def create_engine(model, *, max_slots: int = 4, page_size: int = 64,
                   emit_logits=emit_logits,
                   enable_prefix_cache=enable_prefix_cache,
                   sync_interval=sync_interval, clock=clock, slo=slo,
-                  mesh=mesh, spec_k=spec_k)
+                  mesh=mesh, spec_k=spec_k, faults=faults)

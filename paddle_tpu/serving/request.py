@@ -60,7 +60,12 @@ class Request:
         self.on_token = on_token
         self.state = RequestState.QUEUED
         self.cancel_requested = False
-        self.finish_reason: str | None = None   # length|eos|cancelled|deadline
+        # length|eos|cancelled|deadline|error
+        self.finish_reason: str | None = None
+        # human-readable failure detail when finish_reason == "error"
+        # (quarantined by the engine: non-finite logits, replay failure,
+        # recovery budget exhausted, ...)
+        self.error: str | None = None
         self.output_tokens: list[int] = []
         # prompt tokens served from the engine's prefix cache at
         # admission (0 with caching off); set by Engine._prefill

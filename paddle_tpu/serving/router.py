@@ -52,6 +52,12 @@ _M_RETRIES = _obs.counter(
     "router_retries_total",
     "requests retried on another replica after an idempotent "
     "transport failure")
+_M_FAILOVERS = _obs.counter(
+    "router_failovers_total",
+    "mid-stream failovers: a replica died after response bytes flowed "
+    "and the stream was resumed on a healthy replica by re-submitting "
+    "prompt + delivered tokens (idempotent requests only: greedy, or "
+    "sampled with an explicit seed)")
 _M_UP = _obs.gauge(
     "router_replica_up",
     "1 = replica in rotation, 0 = circuit open", ("replica",))
@@ -125,6 +131,7 @@ class Router:
         self._lock = make_lock("Router._lock")
         self._probe_stop = threading.Event()
         self._probe_thread: threading.Thread | None = None
+        self.failovers = 0          # mirror of router_failovers_total
 
     # ------------------------------------------------------- selection
     def _affinity_key(self, prompt) -> bytes | None:
@@ -139,6 +146,20 @@ class Router:
     def _rendezvous_score(key: bytes, address: str) -> int:
         h = hashlib.sha1(key + address.encode()).digest()
         return int.from_bytes(h[:8], "big")
+
+    @staticmethod
+    def resumable(kw: dict) -> bool:
+        """Whether a request may be re-dispatched after tokens flowed:
+        greedy requests resume exactly (same prompt prefix -> same
+        continuation); sampled requests only when the caller pinned an
+        explicit seed (best effort — the replica mixes the request id
+        into its RNG stream, so the resumed suffix is *a* valid sample,
+        not bit-identical to the lost one)."""
+        do_sample = kw.get("do_sample")
+        if do_sample is None:
+            do_sample = ("temperature" in kw
+                         and float(kw.get("temperature") or 0.0) > 0.0)
+        return (not do_sample) or (kw.get("seed") is not None)
 
     def pick(self, prompt, exclude=()) -> Replica:
         """Choose a replica for this prompt.  Raises
@@ -259,7 +280,9 @@ class Router:
                     # generator is returned, so a refused/reset replica
                     # still lands in the retry path below
                     events = client.completion(prompt, stream=True, **kw)
-                    return self._stream_through(rep, events)
+                    return self._stream_through(rep, events,
+                                                prompt=prompt, kw=kw,
+                                                tried=tried)
                 out = client.completion(prompt, **kw)
             except ServingHTTPError as e:
                 # the replica ANSWERED — it is alive; never retried
@@ -289,36 +312,117 @@ class Router:
             f"request failed on {len(tried)} replica(s) "
             f"(last: {last_exc!r})") from last_exc
 
-    def _stream_through(self, rep: Replica, events):
+    def _stream_through(self, rep: Replica, events, *, prompt=None,
+                        kw=None, tried=None):
         """Wrap a replica's SSE stream: success/failure feeds the
-        circuit, inflight releases when the stream ends.  A mid-stream
-        transport failure is NOT retried (bytes already flowed — the
-        request is no longer idempotent)."""
+        circuit, inflight releases when the stream ends.
+
+        Mid-stream death of the replica — a transport error, or the
+        stream ending before the final (finish_reason-bearing) chunk —
+        **fails over** when the request is :meth:`resumable`: the
+        router re-submits ``prompt + delivered tokens`` (with
+        ``max_tokens`` reduced accordingly) to a healthy replica and
+        keeps yielding, so the consumer sees one complete token
+        sequence.  Non-resumable streams keep the old semantics: the
+        error (or truncation) surfaces to the caller."""
+        kw = dict(kw or {})
+        can_resume = prompt is not None and self.resumable(kw)
+        max_tokens = int(kw.get("max_tokens", 16))
+        tried = list(tried or [])
+
         def gen():
-            ok = True
-            try:
-                for ev in events:
-                    yield ev
-            except OSError as e:
-                ok = False
-                self._mark_failure(rep, e)
-                _M_REQS.labels(rep.address, "error").inc()
-                raise
-            finally:
+            cur_rep, cur_events = rep, events
+            delivered: list[int] = []
+            failovers_left = self.max_retries
+            while True:
+                finished = False
+                err: BaseException | None = None
+                try:
+                    try:
+                        for ev in cur_events:
+                            ch = ev["choices"][0]
+                            delivered.extend(
+                                int(t) for t in (ch.get("token_ids")
+                                                 or ()))
+                            if ch.get("finish_reason") is not None:
+                                finished = True
+                            yield ev
+                    except OSError as e:
+                        err = e
+                except BaseException:
+                    # GeneratorExit (consumer closed the stream) or an
+                    # error thrown in: release inflight and propagate
+                    with self._lock:
+                        cur_rep.inflight -= 1
+                    raise
                 with self._lock:
-                    rep.inflight -= 1
-                if ok:
-                    self._mark_success(rep)
-                    _M_REQS.labels(rep.address, "ok").inc()
+                    cur_rep.inflight -= 1
+                if err is None and finished:
+                    self._mark_success(cur_rep)
+                    _M_REQS.labels(cur_rep.address, "ok").inc()
+                    return
+                # the replica died mid-stream (transport error, or EOF
+                # before the final chunk — a hangup surfaces as a clean
+                # close on the client side)
+                if err is None:
+                    err = ConnectionError(
+                        "stream ended before the final chunk")
+                self._mark_failure(cur_rep, err)
+                _M_REQS.labels(cur_rep.address, "error").inc()
+                tried.append(cur_rep)
+                if not can_resume or failovers_left <= 0:
+                    raise err
+                remaining = max_tokens - len(delivered)
+                if remaining <= 0:
+                    return      # every token was already delivered
+                resume_prompt = [int(t) for t in prompt] + delivered
+                resume_kw = dict(kw, max_tokens=remaining,
+                                 resume_from=len(delivered))
+                switched = False
+                while failovers_left > 0 and not switched:
+                    failovers_left -= 1
+                    try:
+                        nxt = self.pick(resume_prompt, exclude=tried)
+                    except NoReplicaAvailable:
+                        break
+                    client = ServingClient(
+                        nxt.address, timeout=self.request_timeout_s)
+                    with self._lock:
+                        nxt.inflight += 1
+                    try:
+                        cur_events = client.completion(resume_prompt,
+                                                       stream=True,
+                                                       **resume_kw)
+                    except (OSError, ServingHTTPError) as e:
+                        with self._lock:
+                            nxt.inflight -= 1
+                        self._mark_failure(nxt, e)
+                        _M_REQS.labels(nxt.address, "error").inc()
+                        tried.append(nxt)
+                        continue
+                    switched = True
+                if not switched:
+                    raise err
+                with self._lock:
+                    self.failovers += 1
+                _M_FAILOVERS.inc()
+                _obs.flight("router", "failover",
+                            from_=cur_rep.address, to=nxt.address,
+                            delivered=len(delivered),
+                            remaining=remaining)
+                cur_rep = nxt
         return gen()
 
     # ------------------------------------------------------------ info
     def stats(self) -> dict:
         now = self._clock()
         reps = [r.snapshot(now) for r in self.replicas]
+        with self._lock:
+            failovers = self.failovers
         return {"replicas": reps,
                 "up": sum(1 for r in reps if r["up"]),
-                "total": len(reps)}
+                "total": len(reps),
+                "failovers": failovers}
 
     def serve(self, host: str = "127.0.0.1", port: int = 0,
               start: bool = True) -> "RouterServer":
@@ -498,7 +602,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 continue
             try:
                 span.set_attribute("status", resp.status)
-                self._relay(rep, resp)
+                self._relay(rep, resp, body=body, tried=tried + [rep],
+                            headers=upstream_headers)
             finally:
                 conn.close()
                 with router._lock:
@@ -512,10 +617,17 @@ class _RouterHandler(BaseHTTPRequestHandler):
                                    "code": 503}},
                    headers=[("Retry-After", f"{router.cooldown_s:g}")])
 
-    def _relay(self, rep: Replica, resp):
+    def _relay(self, rep: Replica, resp, *, body=None, tried=None,
+               headers=None):
         """Stream the replica's response back verbatim.  Closing the
         upstream connection on OUR client's disconnect is what turns a
-        router-side hangup into a replica-side cancel."""
+        router-side hangup into a replica-side cancel.
+
+        When the UPSTREAM dies mid-SSE (read error, or EOF before
+        ``[DONE]``) and the request is :meth:`Router.resumable`, the
+        relay fails over: it re-POSTs ``prompt + delivered tokens`` to
+        a healthy replica and keeps relaying that stream, so the
+        downstream client receives one complete token sequence."""
         router = self.server.router
         streaming = "text/event-stream" in (
             resp.headers.get("Content-Type") or "")
@@ -528,13 +640,6 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 self.send_header("Connection", "close")
                 self.close_connection = True
                 self.end_headers()
-                while True:
-                    line = resp.readline()
-                    if not line:
-                        break
-                    self.wfile.write(line)
-                    if line == b"\n":
-                        self.wfile.flush()
             else:
                 payload = resp.read()
                 self.send_header("Content-Length", str(len(payload)))
@@ -544,7 +649,160 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 ConnectionAbortedError):
             _M_REQS.labels(rep.address, "client_cancelled").inc()
             return
-        router._mark_success(rep)
-        outcome = "ok" if 200 <= resp.status < 300 \
-            else f"http_{resp.status}"
-        _M_REQS.labels(rep.address, outcome).inc()
+        if not streaming:
+            router._mark_success(rep)
+            outcome = "ok" if 200 <= resp.status < 300 \
+                else f"http_{resp.status}"
+            _M_REQS.labels(rep.address, outcome).inc()
+            return
+        self._relay_stream(rep, resp, body=body, tried=tried,
+                           headers=headers)
+
+    def _relay_stream(self, rep: Replica, resp, *, body, tried, headers):
+        router = self.server.router
+        body = body or {}
+        can_resume = bool(body.get("prompt")) and router.resumable(body)
+        max_tokens = int(body.get("max_tokens", 16))
+        prompt = [int(t) for t in (body.get("prompt") or [])]
+        delivered: list[int] = []
+        tried = list(tried or [])
+        failovers_left = router.max_retries
+        cur_rep, cur_resp = rep, resp
+        extra_conns: list = []      # failover connections we opened
+        extra_reps: list = []       # ... and their inflight holds
+        try:
+            while True:
+                done = False
+                upstream_err: BaseException | None = None
+                while True:
+                    try:
+                        line = cur_resp.readline()
+                    except (OSError, http.client.HTTPException) as e:
+                        upstream_err = e
+                        break
+                    if not line:
+                        break           # upstream closed
+                    s = line.strip()
+                    if s.startswith(b"data:"):
+                        data = s[len(b"data:"):].strip()
+                        if data == b"[DONE]":
+                            done = True
+                        else:
+                            try:
+                                ev = json.loads(data.decode())
+                                ch = ev["choices"][0]
+                                delivered.extend(
+                                    int(t) for t in (ch.get("token_ids")
+                                                     or ()))
+                            except (ValueError, KeyError, TypeError,
+                                    IndexError):
+                                pass
+                    try:
+                        self.wfile.write(line)
+                        if line == b"\n":
+                            self.wfile.flush()
+                    except (OSError, ValueError):
+                        # OUR client went away: stop, upstream conn
+                        # close (in the caller) cancels the replica side
+                        _M_REQS.labels(cur_rep.address,
+                                       "client_cancelled").inc()
+                        return
+                    if done:
+                        break
+                if done and upstream_err is None:
+                    router._mark_success(cur_rep)
+                    _M_REQS.labels(cur_rep.address, "ok").inc()
+                    return
+                # upstream died mid-stream
+                if not can_resume or failovers_left <= 0:
+                    # cannot resume: keep the pre-failover behavior —
+                    # the truncated stream simply ends (transport
+                    # errors still feed the circuit)
+                    if upstream_err is not None:
+                        router._mark_failure(cur_rep, upstream_err)
+                        _M_REQS.labels(cur_rep.address, "error").inc()
+                    else:
+                        router._mark_success(cur_rep)
+                        _M_REQS.labels(cur_rep.address, "ok").inc()
+                    return
+                err = upstream_err or ConnectionError(
+                    "upstream stream ended before [DONE]")
+                router._mark_failure(cur_rep, err)
+                _M_REQS.labels(cur_rep.address, "error").inc()
+                tried.append(cur_rep)
+                remaining = max_tokens - len(delivered)
+                if remaining <= 0:
+                    # every token made it out; synthesize the final
+                    # frame the dead replica never sent
+                    self._finish_stream()
+                    return
+                resume = dict(body, prompt=prompt + delivered,
+                              max_tokens=remaining,
+                              resume_from=len(delivered))
+                raw = json.dumps(resume).encode()
+                switched = False
+                while failovers_left > 0 and not switched:
+                    failovers_left -= 1
+                    try:
+                        nxt = router.pick(resume["prompt"],
+                                          exclude=tried)
+                    except NoReplicaAvailable:
+                        break
+                    host, _, port = nxt.address.rpartition(":")
+                    conn = http.client.HTTPConnection(
+                        host, int(port),
+                        timeout=router.request_timeout_s)
+                    with router._lock:
+                        nxt.inflight += 1
+                    extra_conns.append(conn)
+                    extra_reps.append(nxt)
+                    try:
+                        conn.request("POST", "/v1/completions", raw,
+                                     headers or {"Content-Type":
+                                                 "application/json"})
+                        r2 = conn.getresponse()
+                    except OSError as e:
+                        router._mark_failure(nxt, e)
+                        _M_REQS.labels(nxt.address, "error").inc()
+                        tried.append(nxt)
+                        continue
+                    if r2.status != 200 or "text/event-stream" not in (
+                            r2.headers.get("Content-Type") or ""):
+                        # the replica answered (alive) but refused the
+                        # resume — give up, the stream stays truncated
+                        _M_REQS.labels(
+                            nxt.address, f"http_{r2.status}").inc()
+                        return
+                    switched = True
+                if not switched:
+                    return              # truncated — nothing healthy
+                with router._lock:
+                    router.failovers += 1
+                _M_FAILOVERS.inc()
+                _obs.flight("router", "failover",
+                            from_=cur_rep.address, to=nxt.address,
+                            delivered=len(delivered),
+                            remaining=remaining)
+                cur_rep, cur_resp = nxt, r2
+        finally:
+            for conn in extra_conns:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            with router._lock:
+                for r in extra_reps:
+                    r.inflight -= 1
+
+    def _finish_stream(self):
+        """Synthesized stream tail: the dead replica delivered every
+        token but not the final frame."""
+        final = {"object": "text_completion.chunk",
+                 "choices": [{"index": 0, "text": "", "token_ids": [],
+                              "finish_reason": "length"}]}
+        try:
+            self.wfile.write(b"data: " + json.dumps(final).encode()
+                             + b"\n\ndata: [DONE]\n\n")
+            self.wfile.flush()
+        except (OSError, ValueError):
+            pass

@@ -33,6 +33,7 @@ from __future__ import annotations
 import json
 import queue
 import signal
+import socket
 import threading
 import time
 from collections import deque
@@ -41,9 +42,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from .. import observability as _obs
+from ..flags import FLAGS
 from ..sanitizer import make_condition, make_rlock
 from .engine import Engine
 from .request import GenerationConfig, Request
+from .supervisor import EngineSupervisor
 from .watchdog import Watchdog
 
 __all__ = ["BackpressureError", "DrainingError", "EngineWorker",
@@ -62,6 +65,10 @@ _M_HTTP_INFLIGHT = _obs.gauge(
 _M_HTTP_CANCELS = _obs.counter(
     "serving_http_stream_cancels_total",
     "SSE streams cancelled by client disconnect")
+_M_SLO_SHED = _obs.counter(
+    "serving_slo_shed_total",
+    "admissions refused (429) because an SLO dimension's burn rate "
+    "crossed FLAGS_serving_shed_burn_rate")
 
 
 def _http_latency_hist():
@@ -91,8 +98,12 @@ class EngineWorker:
     """
 
     def __init__(self, engine: Engine, *, max_queue: int = 64,
-                 idle_wait: float = 0.005):
+                 idle_wait: float = 0.005,
+                 supervisor: EngineSupervisor | None = None):
         self.engine = engine
+        # every step goes through the supervisor: a poisoned step costs
+        # a runner rebuild + replay, not the worker thread
+        self.supervisor = supervisor or EngineSupervisor(engine)
         self.max_queue = int(max_queue)
         self.lock = make_rlock("EngineWorker.lock")
         self._wake = make_condition(self.lock, name="EngineWorker._wake")
@@ -134,7 +145,7 @@ class EngineWorker:
                 if not self.engine.scheduler.has_work():
                     self._wake.wait(self._idle_wait)
                     continue
-                self.engine.step()
+                self.supervisor.step()
 
     def inject_stall(self, seconds: float):
         """TEST HOOK: wedge the decode loop for ``seconds`` — the worker
@@ -167,6 +178,19 @@ class EngineWorker:
             if len(self.engine.scheduler.queue) >= self.max_queue:
                 raise BackpressureError(
                     f"admission queue full ({self.max_queue} waiting)")
+            # SLO-driven shedding: refuse BEFORE the queue fills when
+            # the live burn rate says admitted requests are already
+            # missing their targets (429 + Retry-After, like queue-full)
+            shed = float(FLAGS.get("FLAGS_serving_shed_burn_rate") or 0.0)
+            if shed > 0 and self.engine.slo is not None:
+                burn = self.engine.slo.max_burn_rate()
+                if burn >= shed:
+                    _M_SLO_SHED.inc()
+                    _obs.flight("server", "slo_shed", burn=round(burn, 3),
+                                threshold=shed)
+                    raise BackpressureError(
+                        f"SLO burn rate {burn:.2f} at/over shed "
+                        f"threshold {shed:g}")
             deadline = (None if timeout_s is None
                         else self.engine._clock() + float(timeout_s))
             req = self.engine.submit(prompt, gen, deadline=deadline,
@@ -209,6 +233,7 @@ class EngineWorker:
             st = self.engine.stats()
             st["draining"] = self.engine.scheduler.draining
             st["max_queue"] = self.max_queue
+        st["supervisor"] = self.supervisor.stats()
         return st
 
 
@@ -251,7 +276,8 @@ def _parse_completion(body: dict):
 
 
 _FINISH_REASON = {"length": "length", "eos": "stop",
-                  "cancelled": "cancelled", "deadline": "timeout"}
+                  "cancelled": "cancelled", "deadline": "timeout",
+                  "error": "error"}
 
 
 def _finish_reason(req: Request) -> str | None:
@@ -277,6 +303,7 @@ def _completion_json(model_name: str, req: Request) -> dict:
                   "completion_tokens": req.num_generated,
                   "total_tokens": plen + req.num_generated},
         "num_cached_tokens": req.num_cached_tokens,
+        **({"error": req.error} if req.error else {}),
     }
 
 
@@ -322,6 +349,9 @@ class ServingServer(ThreadingHTTPServer):
             watchdog_s = float(
                 FLAGS.get("FLAGS_serving_watchdog_seconds") or 0.0)
         self.watchdog = Watchdog(worker.engine, watchdog_s)
+        # stall -> self-healing: the watchdog flags the supervisor, the
+        # engine thread performs the recovery at its next step
+        self.watchdog.on_stall = worker.supervisor.note_stall
         self._latency = _http_latency_hist()
         self._serve_thread: threading.Thread | None = None
         self._stop_thread: threading.Thread | None = None
@@ -480,6 +510,15 @@ class _Handler(BaseHTTPRequestHandler):
     def _completions_traced(self, span):
         route = "/v1/completions"
         t0 = time.monotonic()
+        faults = self.server.worker.engine.faults
+        if faults is not None and \
+                faults.check("conn_reset", route=route) is not None:
+            # synthetic peer reset before any response bytes: the client
+            # sees RemoteDisconnected, the router's pre-response retry
+            # path re-dispatches to another replica
+            span.set_attribute("fault", "conn_reset")
+            self._drop_connection()
+            return
         try:
             body = self._read_body()
         except (ValueError, json.JSONDecodeError):
@@ -567,14 +606,14 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Cache-Control", "no-cache")
             self.send_header("Connection", "close")
             self.end_headers()
-        except (BrokenPipeError, ConnectionResetError,
-                ConnectionAbortedError):
+        except (OSError, ValueError):
             req.cancel()
             _M_HTTP_CANCELS.inc()
             return
         _M_HTTP_REQS.labels(route, "200").inc()
         self.close_connection = True
         name = self.server.model_name
+        faults = self.server.worker.engine.faults
         sent = 0
         with _obs.tracer().start_span("server.stream") as ss:
             try:
@@ -584,11 +623,22 @@ class _Handler(BaseHTTPRequestHandler):
                         break
                     self._send_event(_chunk_json(name, req, tok, False))
                     sent += 1
+                    if faults is not None and faults.check(
+                            "stream_hangup", sent=sent,
+                            req=req.id) is not None:
+                        # synthetic mid-SSE hangup: hard-shutdown the
+                        # socket so the NEXT write fails exactly like a
+                        # real peer reset (the except below takes the
+                        # cancel path, freeing the slot and its pages)
+                        ss.set_attribute("fault", "stream_hangup")
+                        self._drop_connection()
                 self._send_event(_chunk_json(name, req, None, True))
                 self.wfile.write(b"data: [DONE]\n\n")
                 self.wfile.flush()
-            except (BrokenPipeError, ConnectionResetError,
-                    ConnectionAbortedError):
+            except (OSError, ValueError):
+                # OSError covers the peer-reset family (BrokenPipe/
+                # ConnectionReset/ConnectionAborted/EBADF); ValueError is
+                # "write to closed file" after an injected hangup
                 # client went away mid-stream: cancel so the engine
                 # frees the slot/pages at the next iteration boundary
                 req.cancel()
@@ -600,6 +650,17 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(b"data: " + json.dumps(obj).encode() + b"\n\n")
         # flush per event: SSE latency AND prompt disconnect detection
         self.wfile.flush()
+
+    def _drop_connection(self):
+        """Fault-injection helper: kill the client connection like a
+        dying process would.  ``shutdown`` (not ``close``) — rfile/wfile
+        hold the fd alive through socket refcounting, so a plain close
+        would leave writes silently succeeding."""
+        self.close_connection = True
+        try:
+            self.connection.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
 
 
 def serve(model=None, *, engine: Engine | None = None,

@@ -120,6 +120,17 @@ class SLOTracker:
             rate = (sum(win) / len(win)) if win else 0.0
         return rate / budget
 
+    def max_burn_rate(self) -> float:
+        """Worst burn rate across the configured dimensions — the load-
+        shedding signal (``FLAGS_serving_shed_burn_rate``).  0.0 when no
+        dimension has a target or nothing finished yet."""
+        cfg = self.config
+        dims = [d for d, t in (("ttft", cfg.ttft_s), ("tpot", cfg.tpot_s),
+                               ("e2e", cfg.e2e_s)) if t > 0]
+        if not dims:
+            return 0.0
+        return max(self.burn_rate(d) for d in dims)
+
     def stats(self) -> dict:
         with self._lock:
             return {"targets": {"ttft_s": self.config.ttft_s,

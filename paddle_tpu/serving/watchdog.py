@@ -70,6 +70,10 @@ class Watchdog:
         self._tripped = False           # latched for the current episode
         self.stalls = 0                 # python-side mirror of _M_STALLS
         self.last_dump_path: str | None = None
+        # optional stall callback (EngineSupervisor.note_stall): invoked
+        # once per episode, AFTER the dump, from the watchdog thread —
+        # the callee must only flag state, never touch the engine
+        self.on_stall = None
 
     # --------------------------------------------------------- detection
     def check(self, now: float | None = None) -> bool:
@@ -105,6 +109,11 @@ class Watchdog:
         _obs.flight("watchdog", "stall", progress=progress,
                     active=active, stalled_for=round(stalled_for, 3))
         self.last_dump_path = self._dump(progress, active, stalled_for, n)
+        if self.on_stall is not None:
+            try:
+                self.on_stall()
+            except Exception:       # a broken callback must not break
+                traceback.print_exc()   # stall detection itself
         return True
 
     def state(self) -> dict:

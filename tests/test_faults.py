@@ -1,0 +1,494 @@
+"""Chaos matrix: fault injection + self-healing serving.
+
+Every fault site crosses one of three outcomes — **recovered** (runner
+rebuilt, in-flight requests replayed with greedy outputs identical to
+an unfaulted run), **quarantined** (only the offending request finishes
+with ``finish_reason="error"``, the batch keeps running), or
+**failed-over** (the router re-dispatches a mid-stream request to a
+healthy replica and the client still receives the complete token
+sequence).  After every scenario the pool census must show ``leak == 0``
+— fault handling may never lose a page.
+
+Also here: the FaultPlan spec grammar, the supervisor's restart budget
+escalating to drain, SLO-burn-rate load shedding, and the client's
+jittered 429/503 backoff.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+from paddle_tpu.serving import (EngineSupervisor, FaultPlan,
+                                GenerationConfig, InjectedFault,
+                                NonFiniteLogitsError, Router,
+                                ServingClient, ServingHTTPError,
+                                create_engine, serve)
+
+
+def _engine(**kw):
+    """Fresh tiny model + engine; paddle.seed(0) gives every call
+    identical weights, the basis of all the parity assertions here."""
+    paddle.seed(0)
+    cfg = llama_tiny(vocab_size=64, hidden_size=32, intermediate_size=64,
+                     num_attention_heads=4, num_key_value_heads=2,
+                     max_position_embeddings=128)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    return create_engine(model, max_slots=2, page_size=4, num_pages=64,
+                         **kw)
+
+
+def _gen(n, **kw):
+    return GenerationConfig(max_new_tokens=n, **kw)
+
+
+def _drive(sup, reqs, max_steps=500):
+    steps = 0
+    while not all(r.is_finished() for r in reqs) and steps < max_steps:
+        sup.step()
+        steps += 1
+    assert all(r.is_finished() for r in reqs), "supervised loop stuck"
+
+
+def _leak(eng):
+    return eng.blocks.pool_accounting()["leak"]
+
+
+P1 = [1, 2, 3, 4, 5, 6, 7, 8]
+P2 = [1, 2, 3, 4, 5, 6, 9, 10]
+
+
+# ---------------------------------------------------------------- plan
+class TestFaultPlan:
+    def test_at_fires_on_nth_matching_check(self):
+        plan = FaultPlan().add("x", at=2)
+        assert plan.check("x") is None
+        assert plan.check("x") is not None
+        assert plan.check("x") is None          # times=1: once only
+        assert plan.injected == {"x": 1}
+
+    def test_times_extends_window(self):
+        plan = FaultPlan().add("x", at=2, times=2)
+        fires = [plan.check("x") is not None for _ in range(5)]
+        assert fires == [False, True, True, False, False]
+
+    def test_match_filter_counts_only_matching_ctx(self):
+        plan = FaultPlan().add("x", at=1, slot=1)
+        assert plan.check("x", slot=0) is None   # filtered, not counted
+        got = plan.check("x", slot=1)
+        assert got is not None and got["slot"] == 1
+
+    def test_behavior_params_ride_along(self):
+        plan = FaultPlan().add("slow", at=1, seconds=0.25)
+        assert plan.check("slow")["seconds"] == 0.25
+
+    def test_probabilistic_is_seed_deterministic(self):
+        a = FaultPlan(seed=3).add("x", p=0.5)
+        b = FaultPlan(seed=3).add("x", p=0.5)
+        seq_a = [a.check("x") is not None for _ in range(32)]
+        seq_b = [b.check("x") is not None for _ in range(32)]
+        assert seq_a == seq_b and any(seq_a) and not all(seq_a)
+
+    def test_from_spec_grammar(self):
+        plan = FaultPlan.from_spec(
+            "seed=7, step_raise@3, slow_step~0.5:seconds=0.01, "
+            "nan_logits@1:slot=1:phase=decode")
+        st = plan.stats()
+        assert st["seed"] == 7
+        by_site = {e["site"]: e for e in st["entries"]}
+        assert by_site["step_raise"]["at"] == 3
+        assert by_site["slow_step"]["p"] == 0.5
+        assert by_site["slow_step"]["params"] == {"seconds": 0.01}
+        assert by_site["nan_logits"]["params"] == {"slot": 1,
+                                                   "phase": "decode"}
+
+    def test_from_spec_rejects_bad_entries(self):
+        with pytest.raises(ValueError):
+            FaultPlan.from_spec("step_raise")        # no @N or ~P
+        with pytest.raises(ValueError):
+            FaultPlan().add("x", at=1, p=0.5)        # both rules
+        with pytest.raises(ValueError):
+            FaultPlan().add("x")                     # neither rule
+        with pytest.raises(ValueError):
+            FaultPlan().add("x", at=0)               # 1-based
+
+
+# ------------------------------------------------- engine self-healing
+class TestEngineRecovery:
+    def test_poisoned_step_recovers_all_inflight_with_parity(self):
+        """Tentpole contract (a): a poisoned decode step rebuilds the
+        runner ONCE and replays both in-flight requests (the shared
+        prefix through the prefix cache) with greedy outputs identical
+        to an unfaulted run."""
+        ref_eng = _engine(enable_prefix_cache=True)
+        refs = [ref_eng.submit(P1, _gen(10)), ref_eng.submit(P2, _gen(10))]
+        ref_eng.run_until_complete(max_steps=400)
+        ref_out = [list(r.output_tokens) for r in refs]
+
+        plan = FaultPlan(seed=0).add("step_raise", at=5)
+        eng = _engine(enable_prefix_cache=True, faults=plan)
+        sup = EngineSupervisor(eng, max_recoveries=3)
+        reqs = [eng.submit(P1, _gen(10)), eng.submit(P2, _gen(10))]
+        _drive(sup, reqs)
+
+        assert [list(r.output_tokens) for r in reqs] == ref_out
+        assert [r.finish_reason for r in reqs] == ["length", "length"]
+        assert eng.recoveries == 1 and eng.replayed_requests == 2
+        assert eng.quarantines == 0
+        assert plan.injected == {"step_raise": 1}
+        assert _leak(eng) == 0
+
+    def test_stall_recovery_declared_by_watchdog_flag(self):
+        """A watchdog-declared stall takes the same rebuild+replay path
+        (kind='stall'), driven here deterministically via note_stall."""
+        ref_eng = _engine()
+        ref = ref_eng.submit(P1, _gen(12))
+        ref_eng.run_until_complete(max_steps=400)
+
+        eng = _engine()
+        sup = EngineSupervisor(eng, max_recoveries=3)
+        req = eng.submit(P1, _gen(12))
+        for _ in range(4):
+            sup.step()
+        sup.note_stall()                 # what watchdog.on_stall calls
+        _drive(sup, [req])
+
+        assert list(req.output_tokens) == list(ref.output_tokens)
+        assert eng.recoveries == 1
+        assert sup.stats()["last_error"].startswith("stall")
+        assert _leak(eng) == 0
+
+    def test_budget_exhausted_escalates_to_drain(self):
+        plan = FaultPlan(seed=0).add("step_raise", at=2, times=50)
+        eng = _engine(faults=plan)
+        sup = EngineSupervisor(eng, max_recoveries=2)
+        req = eng.submit(P1, _gen(30))
+        _drive(sup, [req], max_steps=200)
+
+        assert req.finish_reason == "error"
+        assert "recovery budget exhausted" in req.error
+        assert sup.escalated and eng.scheduler.draining
+        assert sup.stats()["recoveries"] == 2
+        assert _leak(eng) == 0
+
+    def test_recover_failure_escalates(self):
+        """If the rebuild itself dies the supervisor must drain, not
+        crash the worker loop."""
+        plan = FaultPlan(seed=0).add("step_raise", at=3)
+        eng = _engine(faults=plan)
+        sup = EngineSupervisor(eng, max_recoveries=3)
+        req = eng.submit(P1, _gen(10))
+
+        def broken_recover():
+            raise RuntimeError("device gone for good")
+        eng.recover = broken_recover
+        _drive(sup, [req], max_steps=200)
+        assert req.finish_reason == "error"
+        assert sup.escalated
+        assert _leak(eng) == 0
+
+    def test_page_alloc_fault_backpressures_then_admits(self):
+        """Synthetic device-OOM on page acquisition: the admission is
+        deferred (backpressure), not failed — once the fault is
+        consumed the request completes normally."""
+        plan = FaultPlan(seed=0).add("page_alloc", at=1)
+        eng = _engine(faults=plan)
+        req = eng.submit(P1, _gen(4))
+        eng.run_until_complete(max_steps=200)
+        assert req.finish_reason == "length"
+        assert plan.injected == {"page_alloc": 1}
+        assert _leak(eng) == 0
+
+    def test_slow_step_injects_latency(self):
+        plan = FaultPlan(seed=0).add("slow_step", at=1, seconds=0.05)
+        eng = _engine(faults=plan)
+        req = eng.submit(P1, _gen(4))
+        t0 = time.perf_counter()
+        eng.run_until_complete(max_steps=200)
+        assert time.perf_counter() - t0 >= 0.05
+        assert req.finish_reason == "length"
+        assert plan.injected == {"slow_step": 1}
+
+    def test_faults_surface_in_stats(self):
+        plan = FaultPlan(seed=0).add("step_raise", at=2)
+        eng = _engine(faults=plan)
+        sup = EngineSupervisor(eng, max_recoveries=3)
+        req = eng.submit(P1, _gen(6))
+        _drive(sup, [req])
+        st = eng.stats()
+        assert st["faults_injected"] == {"step_raise": 1}
+        assert st["recoveries"] == 1
+        snap = eng.resource_snapshot()
+        assert snap["counters"]["recoveries"] == 1
+
+
+# ------------------------------------------------- non-finite logits
+class TestNonFiniteLogits:
+    def test_nan_slot_quarantined_healthy_slot_survives(self):
+        """Satellite (a): one NaN logits row fails ONLY the offending
+        request; the healthy slot keeps decoding to completion."""
+        plan = FaultPlan(seed=0).add("nan_logits", at=1, slot=0,
+                                     phase="decode")
+        eng = _engine(emit_logits=True, faults=plan)
+        sup = EngineSupervisor(eng)
+        bad = eng.submit(P1, _gen(10, do_sample=True, seed=7))
+        good = eng.submit(P2, _gen(10, do_sample=True, seed=8))
+        _drive(sup, [bad, good])
+
+        assert bad.finish_reason == "error"
+        assert "logits" in bad.error
+        assert good.finish_reason == "length"
+        assert good.num_generated == 10
+        assert eng.quarantines == 1 and eng.recoveries == 0
+        assert _leak(eng) == 0
+
+    def test_nan_prefill_greedy_quarantined(self):
+        """The greedy path must also detect NaN (np.argmax would
+        silently return the NaN index) — at prefill, only the poisoned
+        admission fails."""
+        plan = FaultPlan(seed=0).add("nan_logits", at=1, slot=0,
+                                     phase="prefill")
+        eng = _engine(faults=plan)
+        sup = EngineSupervisor(eng)
+        bad = eng.submit(P1, _gen(6))
+        good = eng.submit(P2, _gen(6))
+        _drive(sup, [bad, good])
+        assert bad.finish_reason == "error"
+        assert good.finish_reason == "length"
+        assert good.num_generated == 6
+        assert eng.quarantines == 1
+        assert _leak(eng) == 0
+
+    def test_nonfinite_error_is_a_valueerror(self):
+        # compatibility: pre-existing callers catch ValueError
+        assert issubclass(NonFiniteLogitsError, ValueError)
+        assert issubclass(InjectedFault, RuntimeError)
+
+
+# ------------------------------------------------------ router failover
+@pytest.fixture(scope="module")
+def replica_pair():
+    def model():
+        paddle.seed(0)
+        cfg = llama_tiny(vocab_size=64, hidden_size=32,
+                         intermediate_size=64, num_attention_heads=4,
+                         num_key_value_heads=2,
+                         max_position_embeddings=128)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        return m
+
+    s1 = serve(model(), max_slots=2, page_size=4, num_pages=64,
+               watchdog_s=0, emit_logits=True)
+    s2 = serve(model(), max_slots=2, page_size=4, num_pages=64,
+               watchdog_s=0, emit_logits=True)
+    yield s1, s2
+    s1.stop(drain_timeout=5.0)
+    s2.stop(drain_timeout=5.0)
+
+
+class TestRouterFailover:
+    PROMPT = P1
+    N = 12
+
+    def _setup(self, replica_pair, plan):
+        s1, s2 = replica_pair
+        ref = ServingClient(s1.address).completion_tokens(
+            self.PROMPT, max_tokens=self.N)
+        router = Router([s1.address, s2.address], page_size=4,
+                        max_retries=1)
+        victim = router.pick(self.PROMPT)    # rendezvous winner
+        servers = {s1.address: s1, s2.address: s2}
+        servers[victim.address].worker.engine.faults = plan
+        return router, ref, servers
+
+    def _clear(self, servers):
+        for s in servers.values():
+            s.worker.engine.faults = None
+
+    def _assert_no_leaks(self, servers):
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with_work = False
+            for s in servers.values():
+                with s.worker.lock:
+                    if s.worker.engine.scheduler.active_count:
+                        with_work = True
+            if not with_work:
+                break
+            time.sleep(0.02)
+        for s in servers.values():
+            with s.worker.lock:
+                assert s.worker.engine.blocks.pool_accounting()[
+                    "leak"] == 0
+
+    def test_stream_hangup_fails_over_programmatic(self, replica_pair):
+        """Tentpole contract (b): the victim replica hangs up mid-SSE;
+        the router resumes on the healthy replica and the consumer
+        still receives the complete greedy sequence."""
+        plan = FaultPlan(seed=0).add("stream_hangup", at=1, sent=3)
+        router, ref, servers = self._setup(replica_pair, plan)
+        try:
+            toks = []
+            for ev in router.completion(self.PROMPT, stream=True,
+                                        max_tokens=self.N):
+                toks.extend(ev["choices"][0]["token_ids"])
+            assert toks == ref
+            assert router.failovers == 1
+            assert plan.injected == {"stream_hangup": 1}
+            assert router.stats()["failovers"] == 1
+        finally:
+            self._clear(servers)
+        self._assert_no_leaks(servers)
+
+    def test_stream_hangup_fails_over_http_proxy(self, replica_pair):
+        plan = FaultPlan(seed=0).add("stream_hangup", at=1, sent=3)
+        router, ref, servers = self._setup(replica_pair, plan)
+        rs = router.serve()
+        try:
+            toks = []
+            for ev in ServingClient(rs.address).completion(
+                    self.PROMPT, stream=True, max_tokens=self.N):
+                toks.extend(ev["choices"][0]["token_ids"])
+            assert toks == ref
+            assert router.failovers == 1
+            assert plan.injected == {"stream_hangup": 1}
+        finally:
+            self._clear(servers)
+            rs.stop()
+        self._assert_no_leaks(servers)
+
+    def test_sampled_unpinned_stream_does_not_fail_over(self,
+                                                        replica_pair):
+        """A sampled request without an explicit seed is not idempotent
+        — the truncated stream surfaces instead of a silent re-roll on
+        another replica."""
+        plan = FaultPlan(seed=0).add("stream_hangup", at=1, sent=2)
+        router, _, servers = self._setup(replica_pair, plan)
+        try:
+            before = router.failovers
+            toks = []
+            with pytest.raises(OSError):
+                for ev in router.completion(self.PROMPT, stream=True,
+                                            max_tokens=self.N,
+                                            do_sample=True,
+                                            temperature=0.8):
+                    toks.extend(ev["choices"][0]["token_ids"])
+            assert router.failovers == before
+            assert 0 < len(toks) < self.N
+        finally:
+            self._clear(servers)
+        self._assert_no_leaks(servers)
+
+    def test_conn_reset_retries_before_response(self, replica_pair):
+        """A reset before any response bytes takes the existing
+        idempotent pre-response retry (not the failover path)."""
+        plan = FaultPlan(seed=0).add("conn_reset", at=1)
+        router, _, servers = self._setup(replica_pair, plan)
+        try:
+            before = router.failovers
+            out = router.completion(self.PROMPT, max_tokens=6)
+            assert out["choices"][0]["finish_reason"] == "length"
+            assert len(out["choices"][0]["token_ids"]) == 6
+            assert plan.injected == {"conn_reset": 1}
+            assert router.failovers == before
+        finally:
+            self._clear(servers)
+        self._assert_no_leaks(servers)
+
+    def test_resumable_classification(self):
+        assert Router.resumable({})                          # greedy
+        assert Router.resumable({"do_sample": False})
+        assert Router.resumable({"do_sample": True, "seed": 3})
+        assert not Router.resumable({"do_sample": True})
+        assert not Router.resumable({"temperature": 0.7})
+        assert Router.resumable({"temperature": 0.7, "seed": 1})
+
+
+# ----------------------------------------------------- client backoff
+class TestClientBackoff:
+    def test_retries_429_with_jittered_backoff(self, monkeypatch):
+        sleeps = []
+        monkeypatch.setattr(time, "sleep", sleeps.append)
+        import random
+        client = ServingClient("127.0.0.1:1", retries=3, backoff_s=0.1,
+                               backoff_max_s=1.0, rng=random.Random(0))
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] <= 2:
+                raise ServingHTTPError(429, {}, retry_after=None)
+            return "ok"
+
+        assert client._with_retries(flaky) == "ok"
+        assert calls["n"] == 3 and len(sleeps) == 2
+        # jittered exponential: in (50%, 100%] of 0.1 then 0.2
+        assert 0.05 <= sleeps[0] <= 0.1
+        assert 0.1 <= sleeps[1] <= 0.2
+
+    def test_honors_retry_after_as_floor(self, monkeypatch):
+        sleeps = []
+        monkeypatch.setattr(time, "sleep", sleeps.append)
+        client = ServingClient("127.0.0.1:1", retries=1, backoff_s=0.01)
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise ServingHTTPError(503, {}, retry_after=0.5)
+            return "ok"
+
+        assert client._with_retries(flaky) == "ok"
+        assert sleeps == [pytest.approx(0.5)] or sleeps[0] >= 0.5
+
+    def test_attempts_bounded_and_non_retryable_raises(self,
+                                                       monkeypatch):
+        monkeypatch.setattr(time, "sleep", lambda s: None)
+        client = ServingClient("127.0.0.1:1", retries=2, backoff_s=0.001)
+        calls = {"n": 0}
+
+        def always_429():
+            calls["n"] += 1
+            raise ServingHTTPError(429, {})
+
+        with pytest.raises(ServingHTTPError):
+            client._with_retries(always_429)
+        assert calls["n"] == 3                  # 1 + 2 retries
+
+        def bad_request():
+            raise ServingHTTPError(400, {})
+
+        calls["n"] = 0
+        with pytest.raises(ServingHTTPError):
+            client._with_retries(bad_request)
+
+    def test_default_is_fail_fast(self):
+        client = ServingClient("127.0.0.1:1")
+        assert client.retries == 0
+
+
+# ------------------------------------------------------- SLO shedding
+class TestSLOShedding:
+    def test_max_burn_rate_over_configured_dims(self):
+        from paddle_tpu.serving import SLOConfig, SLOTracker
+        trk = SLOTracker(SLOConfig(ttft_s=0.001, e2e_s=10.0,
+                                   objective=0.9))
+        assert trk.max_burn_rate() == 0.0
+
+        class R:
+            first_token_at = None
+            last_token_at = None
+            num_generated = 0
+            arrival_time = 0.0
+        trk.observe(R(), 1.0)       # ttft violation, e2e good
+        assert trk.max_burn_rate() == pytest.approx(
+            trk.burn_rate("ttft"))
+        assert trk.max_burn_rate() > trk.burn_rate("e2e")
+
+    def test_disabled_tracker_rate_is_zero(self):
+        from paddle_tpu.serving import SLOConfig, SLOTracker
+        trk = SLOTracker(SLOConfig())
+        assert trk.max_burn_rate() == 0.0

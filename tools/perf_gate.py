@@ -61,6 +61,17 @@ DIRECTIONS = {
     "acceptance_rate": "high",
     "decode_steps_saved_vs_plain": "high",
     "greedy_parity_vs_plain": "exact",
+    # fault recovery: one injected poisoned step must cost exactly one
+    # rebuild, replay every in-flight request (sharing the prefix cache
+    # on the way back in), keep greedy outputs identical to the
+    # unfaulted run, and hand back every page
+    "recoveries": "exact",
+    "quarantines": "exact",
+    "replayed_requests": "exact",
+    "recovered_parity": "exact",
+    "leaked_pages": "exact",
+    "faults_injected": "exact",
+    "replay_cached_tokens": "high",
 }
 
 
@@ -288,6 +299,51 @@ def scenario_spec_decode() -> dict:
     }
 
 
+def scenario_fault_recovery() -> dict:
+    """A poisoned decode step mid-batch under the engine supervisor:
+    exactly one runner rebuild, both in-flight requests replayed (the
+    shared prompt prefix rides back in through the prefix cache), token
+    outputs identical to an unfaulted run, and a clean pool census.
+    The unfaulted drive doubles as the zero-overhead control — it runs
+    the same supervised loop with fault injection off."""
+    from paddle_tpu.serving import EngineSupervisor, FaultPlan
+
+    prefix = list(range(1, 13))
+
+    def drive(plan):
+        eng = _engine(max_slots=2, page_size=4, sync_interval=1,
+                      enable_prefix_cache=True, faults=plan)
+        sup = EngineSupervisor(eng, max_recoveries=3)
+        reqs = [eng.submit(prefix + [20, 21], _gen(8)),
+                eng.submit(prefix + [20, 25], _gen(8))]
+        steps = 0
+        while not all(r.is_finished() for r in reqs) and steps < 400:
+            sup.step()
+            steps += 1
+        return eng, reqs
+
+    ref_eng, ref_reqs = drive(None)
+    plan = FaultPlan(seed=0)
+    plan.add("step_raise", at=5)
+    eng, reqs = drive(plan)
+    return {
+        "recoveries": eng.recoveries,
+        "quarantines": eng.quarantines,
+        "replayed_requests": eng.replayed_requests,
+        "recovered_parity": int([r.output_tokens for r in reqs]
+                                == [r.output_tokens for r in ref_reqs]),
+        "leaked_pages": eng.blocks.pool_accounting()["leak"],
+        "faults_injected": plan.injected.get("step_raise", 0),
+        # cache-served prompt tokens ABOVE the unfaulted run = what the
+        # replay path got back from the prefix cache instead of
+        # recomputing
+        "replay_cached_tokens": (eng.blocks.cached_tokens
+                                 - ref_eng.blocks.cached_tokens),
+        "decode_traces": eng.decode_traces,
+        "goodput_ratio": _goodput(reqs),
+    }
+
+
 SCENARIOS = {
     "steady_decode": scenario_steady_decode,
     "prefix_cache": scenario_prefix_cache,
@@ -295,6 +351,7 @@ SCENARIOS = {
     "goodput_cancel": scenario_goodput_cancel,
     "tp_decode": scenario_tp_decode,
     "spec_decode": scenario_spec_decode,
+    "fault_recovery": scenario_fault_recovery,
 }
 
 

@@ -109,6 +109,22 @@ def run_bench(args):
                            sync_interval=args.sync_interval,
                            mesh=args.mesh, spec_k=args.spec_k)
 
+    # --chaos SEED: seed a probabilistic fault plan (poisoned steps,
+    # synthetic OOM, slow steps) and drive through the self-healing
+    # supervisor — the run then reports availability alongside latency
+    chaos = getattr(args, "chaos", None)
+    supervisor = None
+    if chaos is not None:
+        from paddle_tpu.serving import EngineSupervisor, FaultPlan
+        plan = FaultPlan(seed=int(chaos))
+        plan.add("step_raise", p=0.01)
+        plan.add("page_alloc", p=0.01)
+        plan.add("slow_step", p=0.02, seconds=0.002)
+        engine.faults = plan
+        engine.blocks.faults = plan
+        supervisor = EngineSupervisor(engine)
+    step = engine.step if supervisor is None else supervisor.step
+
     workload = _build_workload(args, rng, np)
 
     t0 = time.monotonic()
@@ -122,7 +138,7 @@ def run_bench(args):
             _, prompt, n_new = pending.pop(0)
             reqs.append(engine.submit(
                 prompt, GenerationConfig(max_new_tokens=n_new)))
-        if not engine.step() and pending:
+        if not step() and pending:
             time.sleep(min(1e-3, max(0.0, pending[0][0] - now)))
     wall = time.monotonic() - t0
 
@@ -173,6 +189,26 @@ def run_bench(args):
               f"{stats['spec_verify_steps']} verify steps, "
               f"{toks / steps if steps else 0.0:.2f} tokens/decode-step")
 
+    chaos_out = {}
+    if supervisor is not None:
+        ok = sum(1 for r in reqs if r.finish_reason in ("length", "eos"))
+        availability = ok / len(reqs) if reqs else 1.0
+        leak = engine.blocks.pool_accounting()["leak"]
+        print(f"  chaos (seed {chaos})  availability "
+              f"{availability * 100:.1f}% ({ok}/{len(reqs)}), "
+              f"{engine.recoveries} recoveries, "
+              f"{engine.quarantines} quarantines, "
+              f"faults {dict(engine.faults.injected)}, leak {leak}")
+        print(f"  p99 under faults     TTFT "
+              f"{_percentile(ttfts, 0.99) * 1e3:.2f} ms, TPOT "
+              f"{_percentile(tpots, 0.99) * 1e3:.2f} ms")
+        chaos_out = {"chaos_seed": int(chaos),
+                     "availability": availability,
+                     "recoveries": engine.recoveries,
+                     "quarantines": engine.quarantines,
+                     "faults_injected": dict(engine.faults.injected),
+                     "leaked_pages": leak}
+
     if args.metrics_dir:
         out = obs.dump(args.metrics_dir)
         print(f"  metrics dump         {out} "
@@ -185,7 +221,7 @@ def run_bench(args):
             "prefix_hit_rate": hit_rate,
             "pages_saved": stats["prefix_hits"],
             "host_syncs": stats["host_syncs"],
-            "logit_fetches": stats["logit_fetches"]}
+            "logit_fetches": stats["logit_fetches"], **chaos_out}
 
 
 def _export_trace(args):
@@ -428,6 +464,12 @@ def main(argv=None):
     ap.add_argument("--kv-heads", type=int, default=2,
                     help="KV heads of the bench model")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--chaos", type=int, default=None, metavar="SEED",
+                    help="inject a seeded probabilistic fault plan "
+                         "(poisoned steps, synthetic OOM, slow steps) "
+                         "and drive through the self-healing "
+                         "supervisor; reports availability and p99 "
+                         "TTFT/TPOT under faults (in-process mode only)")
     args = ap.parse_args(argv)
     if args.http:
         run_http_bench(args)
