@@ -168,6 +168,19 @@ define_flag("FLAGS_serving_shed_burn_rate", 0.0,
             "(violation rate / error budget, slo.py) reaches this "
             "threshold — backpressure kicks in before the queue is "
             "full (0 disables; needs SLO targets configured)")
+define_flag("FLAGS_obs_timeseries_interval_s", 0.0,
+            "fleet-observability sampler: seconds between time-series "
+            "ticks (each tick samples the registered serving counters/"
+            "gauges into bounded rings and evaluates the alert rules; "
+            "0 disables — no store or sampler thread is built and the "
+            "serving path pays zero overhead)")
+define_flag("FLAGS_obs_timeseries_capacity", 512,
+            "fleet-observability time-series ring capacity: points "
+            "kept per series (older samples fall off the ring)")
+define_flag("FLAGS_obs_fleet_window", 32,
+            "recent time-series points each replica publishes per "
+            "series in its GET /debug/fleet summary (the router and "
+            "the dashboard consume these windows)")
 define_flag("FLAGS_sanitizer", False,
             "enable the runtime concurrency sanitizer: serving/"
             "observability locks become instrumented wrappers that "

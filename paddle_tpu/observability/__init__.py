@@ -29,18 +29,26 @@ import time
 from ..sanitizer import make_lock
 
 from .registry import (  # noqa: F401
-    Counter, Gauge, Histogram, MetricsRegistry, default_registry)
+    Counter, Gauge, Histogram, MetricsRegistry, bucket_quantiles,
+    default_registry, merge_series_buckets, quantile_from_buckets)
 from .tracing import (  # noqa: F401
     FlightRecorder, Span, SpanContext, Tracer, flight_recorder,
     format_traceparent, parse_traceparent, tracer)
+from .timeseries import (  # noqa: F401
+    AlertRule, Series, TimeSeriesStore, default_rules, metric_value,
+    serving_sources)
 
-__all__ = ["Counter", "FlightRecorder", "Gauge", "Histogram",
-           "MetricsRegistry", "ResourceTracker", "Span", "SpanContext",
-           "Tracer", "default_registry", "counter", "gauge", "histogram",
-           "retrace_log", "RetraceLog", "dump", "reset", "flight",
-           "enable_event_sampling", "chrome_counter_events",
-           "flight_recorder", "format_traceparent", "parse_traceparent",
-           "resource_tracker", "tracer"]
+__all__ = ["AlertRule", "Counter", "FlightRecorder", "Gauge",
+           "Histogram", "MetricsRegistry", "ResourceTracker", "Series",
+           "Span", "SpanContext", "TimeSeriesStore", "Tracer",
+           "bucket_quantiles", "merge_series_buckets",
+           "quantile_from_buckets",
+           "default_registry", "default_rules", "counter", "gauge",
+           "histogram", "metric_value", "retrace_log", "RetraceLog",
+           "dump", "reset", "flight", "enable_event_sampling",
+           "chrome_counter_events", "flight_recorder",
+           "format_traceparent", "parse_traceparent",
+           "resource_tracker", "serving_sources", "tracer"]
 
 
 def counter(name, help_="", labelnames=()):

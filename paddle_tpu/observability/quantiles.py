@@ -1,0 +1,69 @@
+"""Bucket-quantile estimation over Prometheus-style cumulative buckets.
+
+One canonical implementation of "which bucket upper bound crosses the
+q-rank" shared by the registry's :class:`Histogram` (``quantile()``),
+``tools/metrics_report.py``, and ``tools/fleet_dashboard.py``.  The
+estimator is intentionally the conservative Prometheus answer: the
+*upper edge* of the cumulative bucket that crosses ``q * count`` (a
+``histogram_quantile()`` over the same buckets reports the same edge
+for a fully-populated bucket), so p50/p95/p99 read as "at most X".
+
+This module is deliberately import-free: the standalone tools load it
+by file path (``importlib.util.spec_from_file_location``) so they keep
+their no-paddle_tpu/no-jax contract while sharing the arithmetic.
+Buckets are ``(le, cumulative_count)`` pairs with ``le`` a float or
+the string ``"+Inf"`` — exactly what ``_HistogramChild.snapshot()``
+and a ``metrics.json`` dump carry.
+"""
+from __future__ import annotations
+
+__all__ = ["bucket_quantiles", "merge_series_buckets",
+           "quantile_from_buckets"]
+
+_INF = float("inf")
+
+
+def _le_key(le):
+    return _INF if le == "+Inf" else float(le)
+
+
+def quantile_from_buckets(buckets, count, q):
+    """Upper bucket edge at quantile ``q`` (0 < q <= 1) from cumulative
+    ``(le, count)`` pairs totalling ``count`` observations.  Returns a
+    float, the string ``"+Inf"`` when the rank lands in the overflow
+    bucket, or None when the histogram is empty."""
+    if not count or not buckets:
+        return None
+    rank = q * count
+    for le, cum in sorted(buckets, key=lambda kv: _le_key(kv[0])):
+        if cum >= rank:
+            return le
+    return "+Inf"
+
+
+def bucket_quantiles(buckets, count, qs=(0.5, 0.95, 0.99)):
+    """``{q: estimate}`` for each requested quantile (one sort, shared
+    by every q)."""
+    return {q: quantile_from_buckets(buckets, count, q) for q in qs}
+
+
+def merge_series_buckets(series):
+    """Merge the per-labelset series of one histogram family into one
+    cumulative bucket list: takes dicts bearing ``buckets`` /
+    ``count`` / ``sum`` (snapshot() output or metrics.json series
+    entries) and returns ``(buckets, count, sum)``.  Series with
+    mismatched bucket edges merge on the union of edges."""
+    per_le: dict = {}
+    count, total = 0, 0.0
+    for s in series:
+        count += s.get("count", 0)
+        total += s.get("sum", 0.0)
+        prev = 0
+        for le, cum in s.get("buckets", []):
+            per_le[le] = per_le.get(le, 0) + (cum - prev)
+            prev = cum
+    acc, merged = 0, []
+    for le in sorted(per_le, key=_le_key):
+        acc += per_le[le]
+        merged.append((le, acc))
+    return merged, count, total

@@ -23,9 +23,13 @@ import os
 import time
 
 from ..sanitizer import make_lock
+from .quantiles import (  # noqa: F401  (re-export: one canonical impl)
+    bucket_quantiles, merge_series_buckets, quantile_from_buckets)
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "default_registry", "SERVING_LATENCY_BUCKETS"]
+           "bucket_quantiles", "default_registry",
+           "merge_series_buckets", "quantile_from_buckets",
+           "SERVING_LATENCY_BUCKETS"]
 
 # Prometheus-conventional default buckets (seconds-scale latencies).
 DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
@@ -158,6 +162,17 @@ class _HistogramChild(_Child):
             cum.append((le, acc))
         return {"buckets": cum, "sum": s, "count": total}
 
+    def quantile(self, q):
+        """Bucket-quantile estimate (upper bucket edge crossing the
+        q-rank; see quantiles.quantile_from_buckets).  None when empty,
+        ``"+Inf"`` when the rank lands in the overflow bucket."""
+        snap = self.snapshot()
+        return quantile_from_buckets(snap["buckets"], snap["count"], q)
+
+    def quantiles(self, qs=(0.5, 0.95, 0.99)):
+        snap = self.snapshot()
+        return bucket_quantiles(snap["buckets"], snap["count"], qs)
+
 
 class _Metric:
     """A named metric family; children are one per labelvalues tuple."""
@@ -206,7 +221,7 @@ class _Metric:
     # delegate the unlabeled fast path
     def __getattr__(self, item):
         if item in ("inc", "dec", "set", "observe", "value", "count",
-                    "sum", "snapshot"):
+                    "sum", "snapshot", "quantile", "quantiles"):
             d = self.__dict__.get("_default")
             if d is None:
                 raise ValueError(

@@ -41,6 +41,7 @@ table padding; it is never handed to a sequence.
 """
 from __future__ import annotations
 
+import hashlib
 from collections import OrderedDict, deque
 
 import numpy as np
@@ -488,6 +489,24 @@ class BlockManager:
                 "total": self.num_pages,
                 "allocated_total": self.pages_allocated,
                 "leak": self.num_pages - (live + cached + free)}
+
+    def prefix_digest(self, max_entries: int = 64) -> dict:
+        """Compact cached-chain summary for the fleet plane: the sha1
+        digest (first 16 hex chars) of every *root-level* cached chunk,
+        hashed over the same int32 token bytes as the router's
+        affinity key — so the router can match an incoming prompt's
+        first page-aligned chunk against a replica's published digests
+        and estimate its expected prefix-hit rate without shipping
+        token ids over the wire."""
+        roots = sorted(
+            hashlib.sha1(np.asarray(chunk, np.int32).tobytes())
+            .hexdigest()[:16]
+            for (parent, chunk) in self._index if parent == _ROOT)
+        return {"page_size": self.page_size,
+                "roots": roots[:max_entries],
+                "dropped": max(0, len(roots) - max_entries),
+                "cached_pages": self.cached_pages,
+                "cached_tokens": self.cached_tokens}
 
     def pool_bytes(self, *, num_layers: int, num_kv_heads: int,
                    head_dim: int, dtype_itemsize: int,

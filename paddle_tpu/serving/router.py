@@ -68,6 +68,17 @@ _M_PICKS = _obs.counter(
     "replica selection path: 'affinity' (prefix hash target), "
     "'least_loaded' (no page-aligned prefix, or target down)",
     ("kind",))
+_M_FLEET = _obs.counter(
+    "router_fleet_collections_total",
+    "/debug/fleet summary fetches piggybacked on the health sweep "
+    "('fail' degrades the cluster view, never the circuit)",
+    ("replica", "result"))
+_M_EXPECTED_HIT = _obs.gauge(
+    "router_expected_prefix_hit_rate",
+    "last expected-prefix-hit-rate estimate per replica: 1.0 when the "
+    "prompt's root chunk digest is in the replica's published prefix "
+    "digest, else the replica's observed hit rate as a prior",
+    ("replica",))
 
 
 class NoReplicaAvailable(RuntimeError):
@@ -84,6 +95,8 @@ class Replica:
         self.inflight = 0
         self.last_error: str | None = None
         self.stats: dict = {}       # last /healthz payload
+        self.fleet: dict | None = None  # last /debug/fleet summary
+        self.fleet_at = 0.0         # monotonic collection time
         _M_UP.labels(self.address).set(1)
 
     def available(self, now: float) -> bool:
@@ -212,18 +225,31 @@ class Router:
     # --------------------------------------------------------- probing
     def probe_once(self):
         """One health sweep over every replica (the prober thread calls
-        this every ``probe_interval_s``; tests call it directly)."""
+        this every ``probe_interval_s``; tests call it directly).  Each
+        healthy probe also piggybacks a ``/debug/fleet`` summary fetch
+        on the same sweep — the replica just answered /healthz, so a
+        fleet failure (e.g. an older build without the route) only
+        degrades the cluster view, never the circuit."""
         for rep in self.replicas:
+            client = ServingClient(rep.address,
+                                   timeout=self.probe_timeout_s)
             try:
-                st = ServingClient(
-                    rep.address,
-                    timeout=self.probe_timeout_s).healthz()
+                st = client.healthz()
                 rep.stats = st
                 self._mark_success(rep)
                 _M_PROBES.labels(rep.address, "ok").inc()
             except Exception as e:      # refused, reset, timeout, 5xx
                 self._mark_failure(rep, e)
                 _M_PROBES.labels(rep.address, "fail").inc()
+                rep.fleet = None        # stale census must not linger
+                continue
+            try:
+                rep.fleet = client.request("GET", "/debug/fleet")
+                rep.fleet_at = self._clock()
+                _M_FLEET.labels(rep.address, "ok").inc()
+            except Exception:
+                rep.fleet = None
+                _M_FLEET.labels(rep.address, "fail").inc()
 
     def start_probing(self) -> "Router":
         if self._probe_thread is None:
@@ -413,6 +439,87 @@ class Router:
                 cur_rep = nxt
         return gen()
 
+    # ------------------------------------------------------- fleet view
+    def _root_chunk_digest(self, prompt) -> str | None:
+        """sha1 (16 hex chars) of the prompt's first full page chunk —
+        the exact hash replicas publish for their root-level cached
+        chunks (BlockManager.prefix_digest), so digest equality means
+        the replica already holds this prompt's leading KV pages."""
+        ids = np.asarray(prompt, np.int32).reshape(-1)
+        if ids.size < self.page_size:
+            return None
+        return hashlib.sha1(
+            ids[:self.page_size].tobytes()).hexdigest()[:16]
+
+    def prefix_hit_estimate(self, prompt) -> dict:
+        """Per-replica expected-prefix-hit-rate estimate for a prompt:
+        1.0 when the prompt's root chunk digest appears in the
+        replica's published prefix digest, else the replica's observed
+        hit rate as a prior (0.0 with no summary).  This is the routing
+        signal cluster-scale KV scheduling consumes; estimates are also
+        recorded on ``router_expected_prefix_hit_rate{replica}``."""
+        digest = self._root_chunk_digest(prompt)
+        out = {}
+        for rep in self.replicas:
+            prefix = (rep.fleet or {}).get("prefix") or {}
+            published = (prefix.get("roots") or []
+                         if prefix.get("page_size") == self.page_size
+                         else [])
+            if digest is not None and digest in published:
+                est = 1.0
+            else:
+                est = float(prefix.get("hit_rate") or 0.0)
+            out[rep.address] = round(est, 6)
+            _M_EXPECTED_HIT.labels(rep.address).set(est)
+        return out
+
+    def fleet(self) -> dict:
+        """Aggregate cluster view over the last collected per-replica
+        summaries — served by the router's own ``GET /debug/fleet``."""
+        now = self._clock()
+        replicas, alerts = {}, []
+        pages = {"total": 0, "live": 0, "cached": 0, "free": 0}
+        slots = {"active": 0, "max": 0, "free": 0}
+        queue_depth, burn_max, summaries = 0, 0.0, 0
+        digests: set = set()
+        for rep in self.replicas:
+            entry = rep.snapshot(now)
+            fl = rep.fleet
+            if fl:
+                summaries += 1
+                entry["summary"] = fl
+                entry["summary_age_s"] = round(
+                    max(0.0, now - rep.fleet_at), 3)
+                pool = fl.get("pool") or {}
+                for k in pages:
+                    pages[k] += int(pool.get(k) or 0)
+                for k in slots:
+                    slots[k] += int((fl.get("slots") or {}).get(k) or 0)
+                queue_depth += int((fl.get("queue") or {}).get("depth")
+                                   or 0)
+                burn_max = max(burn_max, float(
+                    (fl.get("slo") or {}).get("max_burn_rate") or 0.0))
+                for a in (fl.get("alerts") or {}).get("firing") or []:
+                    alerts.append({"replica": rep.address, **a})
+                prefix = fl.get("prefix") or {}
+                digests.update(prefix.get("roots") or [])
+                entry["expected_prefix_hit_rate"] = prefix.get(
+                    "hit_rate")
+            replicas[rep.address] = entry
+        with self._lock:
+            failovers = self.failovers
+        return {"kind": "router", "replicas": replicas,
+                "failovers": failovers,
+                "cluster": {
+                    "replicas": len(self.replicas),
+                    "up": sum(1 for r in replicas.values() if r["up"]),
+                    "summaries": summaries,
+                    "pages": pages, "slots": slots,
+                    "queue_depth": queue_depth,
+                    "max_burn_rate": round(burn_max, 6),
+                    "alerts_firing": alerts,
+                    "prefix_digests": len(digests)}}
+
     # ------------------------------------------------------------ info
     def stats(self) -> dict:
         now = self._clock()
@@ -470,6 +577,16 @@ class RouterServer(ThreadingHTTPServer):
         self.server_close()
 
 
+_ROUTER_DEBUG_INDEX = {
+    "/debug/": "this index",
+    "/debug/trace": "chrome-trace spans + counter tracks for the "
+                    "router process",
+    "/debug/fleet": "aggregate cluster view: per-replica summaries, "
+                    "pooled page/slot/queue census, max SLO burn "
+                    "rate, firing alerts",
+}
+
+
 class _RouterHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server: RouterServer
@@ -512,6 +629,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self._json(200, {"traceEvents":
                              (_obs.tracer().chrome_events()
                               + _obs.chrome_counter_events())})
+        elif self.path == "/debug/fleet":
+            self._json(200, router.fleet())
+        elif self.path in ("/debug", "/debug/"):
+            self._json(200, {"endpoints": _ROUTER_DEBUG_INDEX})
         else:
             self._json(404, {"error": {"message": f"no route {self.path}",
                                        "code": 404}})

@@ -339,19 +339,32 @@ class ServingServer(ThreadingHTTPServer):
                  port: int = 0, *, retry_after_s: float = 1.0,
                  hard_timeout_s: float = 600.0,
                  model_name: str = "paddle-tpu",
-                 watchdog_s: float | None = None):
+                 watchdog_s: float | None = None,
+                 timeseries_interval_s: float | None = None):
         self.worker = worker
         self.retry_after_s = float(retry_after_s)
         self.hard_timeout_s = float(hard_timeout_s)
         self.model_name = model_name
         if watchdog_s is None:
-            from ..flags import FLAGS
             watchdog_s = float(
                 FLAGS.get("FLAGS_serving_watchdog_seconds") or 0.0)
         self.watchdog = Watchdog(worker.engine, watchdog_s)
         # stall -> self-healing: the watchdog flags the supervisor, the
         # engine thread performs the recovery at its next step
         self.watchdog.on_stall = worker.supervisor.note_stall
+        # fleet telemetry: with the interval unset NOTHING is built —
+        # no store, no sampler thread, no per-request cost beyond the
+        # `is not None` tests below (the faults/sanitizer contract)
+        if timeseries_interval_s is None:
+            timeseries_interval_s = float(
+                FLAGS.get("FLAGS_obs_timeseries_interval_s") or 0.0)
+        self._ts_interval = float(timeseries_interval_s)
+        self.timeseries = None
+        if self._ts_interval > 0:
+            store = _obs.serving_sources(_obs.TimeSeriesStore())
+            for rule in _obs.default_rules():
+                store.add_rule(rule)
+            self.timeseries = store
         self._latency = _http_latency_hist()
         self._serve_thread: threading.Thread | None = None
         self._stop_thread: threading.Thread | None = None
@@ -364,6 +377,8 @@ class ServingServer(ThreadingHTTPServer):
     def start(self) -> "ServingServer":
         self.worker.start()
         self.watchdog.start()       # no-op when watchdog_s <= 0
+        if self.timeseries is not None:
+            self.timeseries.start_sampling(self._ts_interval)
         self._serve_thread = threading.Thread(
             target=self.serve_forever, name=f"http:{self.address}",
             daemon=True)
@@ -373,6 +388,8 @@ class ServingServer(ThreadingHTTPServer):
     def stop(self, *, drain_timeout: float | None = None):
         """Graceful shutdown: drain in-flight work, then close."""
         self.watchdog.stop()
+        if self.timeseries is not None:
+            self.timeseries.stop()
         self.worker.drain(timeout=drain_timeout)
         self.shutdown()
         if self._serve_thread is not None:
@@ -396,6 +413,90 @@ class ServingServer(ThreadingHTTPServer):
             self._stop_thread.start()
         for s in sigs:
             signal.signal(s, _graceful)
+
+    def fleet_summary(self) -> dict:
+        """Compact replica summary for ``GET /debug/fleet``: pool
+        census + fragmentation, cached-chain digest, slots/queue
+        headroom, SLO burn rates, spec acceptance, recovery counts,
+        firing alerts, and recent time-series windows.  The engine half
+        walks scheduler state, so it runs under the worker lock; the
+        telemetry half reads the store lock-free."""
+        worker = self.worker
+        with worker.lock:
+            eng = worker.engine
+            b = eng.blocks
+            pool = b.pool_accounting()
+            head_need = None
+            if eng.scheduler.queue:
+                head = eng.scheduler.queue[0]
+                head_need = b.pages_needed(head.prompt.size,
+                                           head.gen.max_new_tokens)
+            pool["fragmentation_ratio"] = round(
+                b.fragmentation(head_need), 6)
+            prefix = b.prefix_digest()
+            lookups = b.prefix_hits + b.prefix_misses
+            prefix["hits"] = b.prefix_hits
+            prefix["misses"] = b.prefix_misses
+            prefix["hit_rate"] = (round(b.prefix_hits / lookups, 6)
+                                  if lookups else None)
+            active = eng.scheduler.active_count
+            slots = {"active": active, "max": eng.scheduler.max_slots,
+                     "free": eng.scheduler.max_slots - active}
+            queue = {"depth": len(eng.scheduler.queue),
+                     "max": worker.max_queue}
+            slo = None
+            if eng.slo is not None:
+                slo = {"burn_rates": {
+                           d: round(r, 6)
+                           for d, r in eng.slo.burn_rates().items()},
+                       "max_burn_rate": round(eng.slo.max_burn_rate(),
+                                              6)}
+            spec = {"spec_k": eng.spec_k}
+            if eng._spec is not None:
+                spec.update(eng._spec.snapshot())
+            recovery = {"recoveries": eng.recoveries,
+                        "quarantines": eng.quarantines,
+                        "replayed_requests": eng.replayed_requests}
+            draining = eng.scheduler.draining
+        # raw cumulative latency buckets, not quantiles: consumers
+        # (dashboard, router) merge buckets ACROSS replicas and then
+        # estimate — averaging per-replica quantiles would be wrong
+        latency = {}
+        reg = _obs.default_registry()
+        for key, mname in (("ttft", "serving_ttft_seconds"),
+                           ("e2e", "serving_e2e_seconds")):
+            fam = reg.get(mname)
+            if fam is None:
+                continue
+            merged, count, total = _obs.merge_series_buckets(
+                [child.snapshot() for _, child in fam._series()])
+            if count:
+                latency[key] = {"buckets": merged, "count": count,
+                                "sum": round(total, 9)}
+        ts = self.timeseries
+        return {"kind": "replica", "model": self.model_name,
+                "address": self.address, "draining": draining,
+                "pool": pool, "prefix": prefix, "slots": slots,
+                "queue": queue, "slo": slo, "spec": spec,
+                "recovery": recovery, "latency": latency,
+                "watchdog": self.watchdog.state(),
+                "alerts": ({"firing": ts.firing(),
+                            "fired_total": ts.alerts_fired,
+                            "ticks": ts.ticks}
+                           if ts is not None else None),
+                "series": ts.windows() if ts is not None else {}}
+
+
+# one-line descriptions for GET /debug/ — operators stop guessing paths
+_DEBUG_INDEX = {
+    "/debug/": "this index",
+    "/debug/trace": "span ring + sampled counter tracks "
+                    "(chrome://tracing loadable)",
+    "/debug/flight": "flight-recorder event ring + watchdog state",
+    "/debug/resources": "resource-tracker snapshot + engine pool census",
+    "/debug/fleet": "compact replica summary: pool census, prefix "
+                    "digest, burn rates, alerts, series windows",
+}
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -438,6 +539,10 @@ class _Handler(BaseHTTPRequestHandler):
             st = self.worker_stats()
             st["status"] = "draining" if st["draining"] else "ok"
             st["watchdog"] = self.server.watchdog.state()
+            ts = self.server.timeseries
+            if ts is not None:
+                st["alerts"] = {"firing": ts.firing(),
+                                "fired_total": ts.alerts_fired}
             self._json(200, st, "/healthz")
         elif self.path == "/metrics":
             text = _obs.default_registry().to_prometheus().encode()
@@ -472,6 +577,10 @@ class _Handler(BaseHTTPRequestHandler):
             with worker.lock:
                 snap["engine"] = worker.engine.resource_snapshot()
             self._json(200, snap, "/debug/resources")
+        elif self.path == "/debug/fleet":
+            self._json(200, self.server.fleet_summary(), "/debug/fleet")
+        elif self.path in ("/debug", "/debug/"):
+            self._json(200, {"endpoints": _DEBUG_INDEX}, "/debug/")
         else:
             self._error(404, f"no route {self.path}", self.path)
 
@@ -666,8 +775,9 @@ class _Handler(BaseHTTPRequestHandler):
 def serve(model=None, *, engine: Engine | None = None,
           host: str = "127.0.0.1", port: int = 0, max_queue: int = 64,
           retry_after_s: float = 1.0, model_name: str = "paddle-tpu",
-          watchdog_s: float | None = None, start: bool = True,
-          **engine_kw) -> ServingServer:
+          watchdog_s: float | None = None,
+          timeseries_interval_s: float | None = None,
+          start: bool = True, **engine_kw) -> ServingServer:
     """One-call server bring-up::
 
         server = serve(model, port=8000, max_slots=8,
@@ -678,7 +788,10 @@ def serve(model=None, *, engine: Engine | None = None,
     :func:`~paddle_tpu.serving.create_engine`) or a prebuilt
     ``engine=``.  With ``start=False`` the caller wires signals and
     starts the server itself.  ``watchdog_s`` arms the decode-loop
-    watchdog (default: ``FLAGS_serving_watchdog_seconds``; 0 off), and
+    watchdog (default: ``FLAGS_serving_watchdog_seconds``; 0 off),
+    ``timeseries_interval_s`` arms the fleet-telemetry sampler
+    (default: ``FLAGS_obs_timeseries_interval_s``; 0 off — nothing is
+    built), and
     when the ``FLAGS_serving_slo_*`` targets are set the engine gets an
     :class:`~paddle_tpu.serving.slo.SLOTracker` automatically.
     """
@@ -697,7 +810,8 @@ def serve(model=None, *, engine: Engine | None = None,
     worker = EngineWorker(engine, max_queue=max_queue)
     server = ServingServer(worker, host, port,
                            retry_after_s=retry_after_s,
-                           model_name=model_name, watchdog_s=watchdog_s)
+                           model_name=model_name, watchdog_s=watchdog_s,
+                           timeseries_interval_s=timeseries_interval_s)
     if start:
         server.start()
     return server

@@ -120,6 +120,14 @@ class SLOTracker:
             rate = (sum(win) / len(win)) if win else 0.0
         return rate / budget
 
+    def burn_rates(self) -> dict:
+        """Per-dimension burn rate for every configured dimension —
+        the fleet summary block /debug/fleet publishes per replica."""
+        cfg = self.config
+        return {d: self.burn_rate(d)
+                for d, t in (("ttft", cfg.ttft_s), ("tpot", cfg.tpot_s),
+                             ("e2e", cfg.e2e_s)) if t > 0}
+
     def max_burn_rate(self) -> float:
         """Worst burn rate across the configured dimensions — the load-
         shedding signal (``FLAGS_serving_shed_burn_rate``).  0.0 when no
@@ -132,10 +140,12 @@ class SLOTracker:
         return max(self.burn_rate(d) for d in dims)
 
     def stats(self) -> dict:
+        burn = {d: round(r, 6) for d, r in self.burn_rates().items()}
         with self._lock:
             return {"targets": {"ttft_s": self.config.ttft_s,
                                 "tpot_s": self.config.tpot_s,
                                 "e2e_s": self.config.e2e_s,
                                 "objective": self.config.objective},
                     "good": dict(self.good),
-                    "violations": dict(self.violations)}
+                    "violations": dict(self.violations),
+                    "burn_rates": burn}

@@ -1,0 +1,209 @@
+"""Fleet observability e2e: replica summaries, router aggregation,
+debug index endpoints, prefix-hit-rate estimates, and the alert path
+(injected fault -> firing alert on /healthz + flight-recorder event).
+
+Two in-process replicas share one metrics registry, so registry-backed
+series reflect the process rather than one replica (documented in
+timeseries.py); the assertions here stick to per-replica engine
+censuses (pool/slots/queue, which come from engine state) and
+process-level alert behavior.
+"""
+import time
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+from paddle_tpu.serving import (FaultPlan, Router, ServingClient,
+                                SLOConfig, SLOTracker, serve)
+
+PAGE = 4
+PROMPT = [1, 2, 3, 4, 5, 6, 7, 8]
+
+
+def _model():
+    paddle.seed(0)
+    cfg = llama_tiny(vocab_size=64, hidden_size=32, intermediate_size=64,
+                     num_attention_heads=4, num_key_value_heads=2,
+                     max_position_embeddings=128)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _serve(**kw):
+    kw.setdefault("slo", SLOTracker(SLOConfig(e2e_s=30.0)))
+    return serve(_model(), max_slots=2, page_size=PAGE, num_pages=64,
+                 watchdog_s=0, timeseries_interval_s=0.02,
+                 enable_prefix_cache=True, **kw)
+
+
+@pytest.fixture()
+def fleet():
+    s1, s2 = _serve(), _serve()
+    router = Router([s1.address, s2.address], page_size=PAGE)
+    yield router, s1, s2
+    s1.stop(drain_timeout=5.0)
+    s2.stop(drain_timeout=5.0)
+
+
+def _wait(cond, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = cond()
+        if got:
+            return got
+        time.sleep(interval)
+    return cond()
+
+
+# ------------------------------------------------------ replica payload
+class TestReplicaSummary:
+    def test_debug_index_lists_fleet(self, fleet):
+        _, s1, _ = fleet
+        idx = ServingClient(s1.address).request("GET", "/debug/")
+        eps = idx["endpoints"]
+        assert {"/debug/", "/debug/trace", "/debug/flight",
+                "/debug/resources", "/debug/fleet"} <= set(eps)
+        assert all(isinstance(v, str) and v for v in eps.values())
+
+    def test_fleet_payload_census(self, fleet):
+        _, s1, _ = fleet
+        c = ServingClient(s1.address)
+        c.completion_tokens(PROMPT, max_tokens=6)
+        fl = c.request("GET", "/debug/fleet")
+        assert fl["kind"] == "replica" and fl["address"] == s1.address
+        pool = fl["pool"]
+        assert pool["total"] == 64 and pool["leak"] == 0
+        assert pool["live"] + pool["cached"] + pool["free"] == 64
+        assert 0.0 <= pool["fragmentation_ratio"] <= 1.0
+        assert fl["slots"] == {"active": 0, "max": 2, "free": 2}
+        assert fl["queue"]["depth"] == 0
+        # prefix digest: the finished prompt's root chunk is cached
+        prefix = fl["prefix"]
+        assert prefix["page_size"] == PAGE
+        assert len(prefix["roots"]) == 1 and prefix["dropped"] == 0
+        assert prefix["misses"] >= 1
+        # SLO burn rates ride along (e2e target configured)
+        assert "e2e" in fl["slo"]["burn_rates"]
+        assert fl["slo"]["max_burn_rate"] >= 0.0
+        assert fl["recovery"] == {"recoveries": 0, "quarantines": 0,
+                                  "replayed_requests": 0}
+        # sampler is armed: series windows appear within a few ticks
+        series = _wait(lambda: c.request("GET", "/debug/fleet")["series"])
+        assert {"tokens", "tok_s", "pages_free", "queue_depth"} \
+            <= set(series)
+        assert fl["latency"]["e2e"]["count"] >= 1
+
+    def test_healthz_surfaces_alert_block(self, fleet):
+        _, s1, _ = fleet
+        st = ServingClient(s1.address).request("GET", "/healthz")
+        assert "alerts" in st
+        assert set(st["alerts"]) == {"firing", "fired_total"}
+
+
+# --------------------------------------------------- router aggregation
+class TestRouterFleet:
+    def test_cluster_view_is_consistent(self, fleet):
+        router, s1, s2 = fleet
+        ServingClient(s1.address).completion_tokens(PROMPT, max_tokens=4)
+        router.probe_once()
+        view = router.fleet()
+        assert view["kind"] == "router"
+        cl = view["cluster"]
+        assert cl["replicas"] == 2 and cl["up"] == 2
+        assert cl["summaries"] == 2
+        assert set(view["replicas"]) == {s1.address, s2.address}
+        # the cluster census is exactly the sum of the replica censuses
+        pools = [view["replicas"][a]["summary"]["pool"]
+                 for a in (s1.address, s2.address)]
+        assert cl["pages"]["total"] == sum(p["total"] for p in pools)
+        assert cl["pages"]["free"] == sum(p["free"] for p in pools)
+        assert cl["pages"]["cached"] == sum(p["cached"] for p in pools)
+        assert cl["slots"]["max"] == 4
+        assert cl["queue_depth"] == 0
+        # both replicas publish burn rates into one payload
+        for a in (s1.address, s2.address):
+            summary = view["replicas"][a]["summary"]
+            assert "e2e" in summary["slo"]["burn_rates"]
+        assert cl["max_burn_rate"] == max(
+            view["replicas"][a]["summary"]["slo"]["max_burn_rate"]
+            for a in (s1.address, s2.address))
+        assert cl["prefix_digests"] >= 1
+
+    def test_http_fleet_and_index(self, fleet):
+        router, s1, s2 = fleet
+        router.probe_once()
+        rs = router.serve()
+        try:
+            c = ServingClient(rs.address)
+            view = c.request("GET", "/debug/fleet")
+            assert view["kind"] == "router"
+            assert set(view["replicas"]) == {s1.address, s2.address}
+            idx = c.request("GET", "/debug/")
+            assert "/debug/fleet" in idx["endpoints"]
+        finally:
+            rs.stop()
+
+    def test_failed_collection_degrades_view_not_circuit(self, fleet):
+        router, s1, s2 = fleet
+        router.probe_once()
+        s2.stop(drain_timeout=5.0)
+        router.probe_once()     # s2 down: health fails, fleet cleared
+        view = router.fleet()
+        entry = view["replicas"][s2.address]
+        assert entry.get("summary") is None
+        assert view["cluster"]["summaries"] == 1
+        assert view["cluster"]["pages"]["total"] == 64
+
+    def test_prefix_hit_estimate_from_digest(self, fleet):
+        router, s1, s2 = fleet
+        # seed the prompt's KV pages on its rendezvous winner
+        winner = router.pick(PROMPT).address
+        router.completion(PROMPT, max_tokens=4)
+        router.probe_once()
+        est = router.prefix_hit_estimate(PROMPT)
+        assert est[winner] == 1.0       # digest matched: pages are hot
+        other = s2.address if winner == s1.address else s1.address
+        assert est[other] < 1.0
+        # estimates land on the gauge the scheduler will read
+        assert obs.default_registry().get(
+            "router_expected_prefix_hit_rate").labels(winner).value \
+            == 1.0
+        # short prompts have no full page chunk -> prior only
+        est = router.prefix_hit_estimate(PROMPT[:2])
+        assert all(v < 1.0 for v in est.values())
+
+
+# -------------------------------------------------------- alert path
+class TestAlertPath:
+    def test_fault_fires_alert_on_healthz_and_flight(self, fleet):
+        """The ISSUE acceptance path: an injected fault quarantines a
+        request, the sampler's recovery_surge rule fires, and the
+        alert is visible on /healthz AND in the flight recorder."""
+        _, s1, _ = fleet
+        plan = FaultPlan(seed=0)
+        plan.add("nan_logits", at=1, slot=0, phase="prefill")
+        s1.worker.engine.faults = plan
+        try:
+            c = ServingClient(s1.address)
+            out = c.completion(PROMPT, max_tokens=4)
+            assert out["choices"][0]["finish_reason"] == "error"
+            assert s1.worker.engine.quarantines == 1
+
+            def firing():
+                st = c.request("GET", "/healthz")
+                return [a for a in st["alerts"]["firing"]
+                        if a["rule"] == "recovery_surge"]
+
+            alerts = _wait(firing)
+            assert alerts, "recovery_surge never surfaced on /healthz"
+            assert alerts[0]["series"] == "recoveries"
+            events = [e for e in obs.flight_recorder().snapshot()
+                      if e.get("category") == "alert"
+                      and e.get("event") == "fire"
+                      and e.get("rule") == "recovery_surge"]
+            assert events and events[0]["series"] == "recoveries"
+        finally:
+            s1.worker.engine.faults = None

@@ -1,0 +1,375 @@
+"""Fleet telemetry units: bounded-ring time series, fake-clock sampler
+ticks, anomaly alert rules (fire/clear transitions, registry counters,
+flight-recorder stamps), the bucket-quantile estimator shared by the
+registry and the standalone tools, and the metrics_report fault
+section.  Everything here drives explicit ``tick(now)`` — no sleeps
+except the one sampler-thread lifecycle test, which polls a bounded
+deadline."""
+import importlib.util
+import os
+import time
+
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import (AlertRule, Series, TimeSeriesStore,
+                                      default_rules, metric_value,
+                                      serving_sources)
+from paddle_tpu.observability.quantiles import (bucket_quantiles,
+                                                merge_series_buckets,
+                                                quantile_from_buckets)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    obs.reset()
+    yield
+    obs.reset()
+
+
+# -------------------------------------------------------------- series
+class TestSeries:
+    def test_ring_drops_oldest(self):
+        s = Series("x", capacity=3)
+        for t in range(5):
+            s.add(t, t * 10)
+        assert s.points() == [(2.0, 20.0), (3.0, 30.0), (4.0, 40.0)]
+        assert len(s) == 3 and s.last() == (4.0, 40.0)
+
+    def test_capacity_floor(self):
+        with pytest.raises(ValueError):
+            Series("x", capacity=1)
+
+    def test_window_filters_trailing(self):
+        s = Series("x")
+        for t in (0, 5, 9, 10):
+            s.add(t, t)
+        assert s.points(window_s=5, now=10) == [(5.0, 5.0), (9.0, 9.0),
+                                                (10.0, 10.0)]
+
+    def test_delta_and_rate(self):
+        s = Series("x")
+        s.add(0, 100)
+        s.add(10, 160)
+        assert s.delta() == 60
+        assert s.rate() == 6.0
+        assert Series("y").rate() is None          # empty
+        one = Series("z")
+        one.add(1, 1)
+        assert one.delta() is None                 # < 2 points
+
+    def test_rate_zero_elapsed_is_none(self):
+        s = Series("x")
+        s.add(1, 1)
+        s.add(1, 5)
+        assert s.rate() is None
+
+    def test_rate_points_per_interval(self):
+        s = Series("x")
+        for t, v in ((0, 0), (1, 2), (2, 6)):
+            s.add(t, v)
+        assert s.rate_points() == [(1.0, 2.0), (2.0, 4.0)]
+
+
+# -------------------------------------------------------- metric_value
+class TestMetricValue:
+    def test_unregistered_is_none(self):
+        assert metric_value("nope_total") is None
+
+    def test_sums_series_with_label_filter(self):
+        c = obs.counter("obs_mv_test_total", "t", ("kind",))
+        c.labels("a").inc(3)
+        c.labels("b").inc(4)
+        assert metric_value("obs_mv_test_total") == 7
+        assert metric_value("obs_mv_test_total", {"kind": "a"}) == 3
+
+    def test_histogram_is_none(self):
+        h = obs.histogram("obs_mv_h_seconds", "t")
+        h.observe(1.0)
+        assert metric_value("obs_mv_h_seconds") is None
+
+
+# --------------------------------------------------------------- store
+class TestStore:
+    def test_tick_samples_sources_on_fake_clock(self):
+        now = [0.0]
+        st = TimeSeriesStore(capacity=8, clock=lambda: now[0])
+        vals = iter([1.0, 2.0, 3.0])
+        st.add_source("v", lambda: next(vals))
+        for t in (1.0, 2.0, 3.0):
+            now[0] = t
+            st.tick()
+        assert st.series["v"].points() == [(1.0, 1.0), (2.0, 2.0),
+                                           (3.0, 3.0)]
+        assert st.ticks == 3 and st.samples == 3
+
+    def test_none_and_raising_sources_skip_sample(self):
+        st = TimeSeriesStore(capacity=8, clock=lambda: 0.0)
+        st.add_source("none", lambda: None)
+
+        def boom():
+            raise RuntimeError("broken source")
+
+        st.add_source("boom", boom)
+        assert st.tick(1.0) == 0
+        assert len(st.series["none"]) == 0 and len(st.series["boom"]) == 0
+        assert st.ticks == 1 and st.samples == 0
+
+    def test_add_metric_reads_registry_back(self):
+        c = obs.counter("obs_store_test_total", "t")
+        st = TimeSeriesStore(capacity=8)
+        st.add_metric("obs_store_test_total", "mine")
+        c.inc(5)
+        st.tick(1.0)
+        c.inc(2)
+        st.tick(2.0)
+        assert st.series["mine"].points() == [(1.0, 5.0), (2.0, 7.0)]
+        assert st.series["mine"].rate() == 2.0
+
+    def test_add_rate_derives_per_second(self):
+        st = TimeSeriesStore(capacity=8)
+        tokens = iter([0.0, 10.0, 30.0])
+        st.add_source("tokens", lambda: next(tokens))
+        st.add_rate("tok_s", of="tokens")
+        for t in (1.0, 2.0, 3.0):
+            st.tick(t)
+        assert st.series["tok_s"].points() == [(2.0, 10.0), (3.0, 20.0)]
+
+    def test_duplicate_and_missing_base_raise(self):
+        st = TimeSeriesStore(capacity=8)
+        st.add_source("a", lambda: 1)
+        with pytest.raises(ValueError):
+            st.add_source("a", lambda: 2)
+        with pytest.raises(ValueError):
+            st.add_rate("a", of="a")        # name taken
+        with pytest.raises(ValueError):
+            st.add_rate("r", of="missing")
+
+    def test_windows_and_state(self):
+        st = TimeSeriesStore(capacity=16)
+        st.add_source("v", lambda: 1.25)
+        for t in range(6):
+            st.tick(float(t))
+        win = st.windows(n=3)
+        assert win == {"v": [[3.0, 1.25], [4.0, 1.25], [5.0, 1.25]]}
+        state = st.state()
+        assert state["ticks"] == 6 and state["series"] == ["v"]
+        assert state["firing"] == []
+
+    def test_sampler_thread_lifecycle(self):
+        st = TimeSeriesStore(capacity=8)
+        st.add_source("v", lambda: 1.0)
+        assert st.start_sampling(0) is st and st._sampler is None
+        st.start_sampling(0.005)
+        deadline = time.monotonic() + 5.0
+        while st.ticks == 0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert st.ticks > 0
+        st.stop()
+        assert st._sampler is None
+        settled = st.ticks
+        time.sleep(0.02)
+        assert st.ticks == settled      # really stopped
+
+
+# --------------------------------------------------------------- rules
+def _alert_events():
+    return [e for e in obs.flight_recorder().snapshot()
+            if e.get("category") == "alert"]
+
+
+class TestAlertRule:
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            AlertRule("r", "s")                         # no threshold
+        with pytest.raises(ValueError):
+            AlertRule("r", "s", above=1, below=0)       # both
+        with pytest.raises(ValueError):
+            AlertRule("r", "s", above=1, kind="wat")
+        with pytest.raises(ValueError):
+            AlertRule("r", "s", above=1, when=("s", "!=", 0))
+
+    def test_value_rule_fire_and_clear(self):
+        st = TimeSeriesStore(capacity=8)
+        vals = iter([0.5, 0.1, 0.4])
+        st.add_source("acc", lambda: next(vals))
+        st.add_rule(AlertRule("drop", "acc", below=0.2, min_samples=1))
+        st.tick(1.0)
+        assert st.firing() == [] and st.alerts_fired == 0
+        st.tick(2.0)
+        firing = st.firing()
+        assert [f["rule"] for f in firing] == ["drop"]
+        assert firing[0]["value"] == 0.1
+        assert firing[0]["condition"] == "value(acc) < 0.2"
+        assert st.alerts_fired == 1
+        assert metric_value("obs_alerts_total", {"rule": "drop"}) == 1
+        assert metric_value("obs_alert_firing", {"rule": "drop"}) == 1
+        st.tick(3.0)
+        assert st.firing() == []
+        assert metric_value("obs_alert_firing", {"rule": "drop"}) == 0
+        assert st.alerts_fired == 1     # clear is not a new fire
+        kinds = [e["event"] for e in _alert_events()]
+        assert kinds == ["fire", "clear"]
+
+    def test_rate_rule_with_window(self):
+        st = TimeSeriesStore(capacity=32)
+        vals = iter([0, 0, 10, 20, 20, 20, 20])
+        st.add_source("frag", lambda: float(next(vals)))
+        st.add_rule(AlertRule("climb", "frag", kind="rate", above=1.0,
+                              window_s=3.0, min_samples=2))
+        fired = []
+        for t in range(1, 8):
+            st.tick(float(t))
+            fired.append(bool(st.firing()))
+        # rate over the trailing 3s window: climbing from t=3, flat
+        # again once the climb ages out of the window at t=7
+        assert fired == [False, False, True, True, True, True, False]
+
+    def test_when_gate_suppresses(self):
+        st = TimeSeriesStore(capacity=8)
+        st.add_source("tok", lambda: 0.0)
+        active = [0.0]
+        st.add_source("slots", lambda: active[0])
+        st.add_rule(AlertRule("collapse", "tok", kind="rate", below=0.5,
+                              min_samples=2,
+                              when=("slots", ">", 0)))
+        st.tick(1.0)
+        st.tick(2.0)
+        assert st.firing() == []        # gate closed: no active slots
+        active[0] = 1.0
+        st.tick(3.0)
+        assert [f["rule"] for f in st.firing()] == ["collapse"]
+
+    def test_min_samples_floor_for_rate(self):
+        r = AlertRule("r", "s", above=0, kind="rate", min_samples=1)
+        assert r.min_samples == 2
+        assert AlertRule("v", "s", above=0, min_samples=1).min_samples \
+            == 1
+
+    def test_missing_series_never_fires(self):
+        st = TimeSeriesStore(capacity=8)
+        st.add_rule(AlertRule("ghost", "nope", above=0, min_samples=1))
+        st.tick(1.0)
+        assert st.firing() == [] and st.alerts_fired == 0
+
+    def test_duplicate_rule_name_raises(self):
+        st = TimeSeriesStore(capacity=8)
+        st.add_rule(AlertRule("r", "s", above=0))
+        with pytest.raises(ValueError):
+            st.add_rule(AlertRule("r", "s", below=0))
+
+
+# ------------------------------------------------- serving preset
+class TestServingPreset:
+    def test_sources_and_rules_register(self):
+        st = serving_sources(TimeSeriesStore(capacity=8))
+        for rule in default_rules(shed_burn_rate=2.0):
+            st.add_rule(rule)
+        assert {"tokens", "tok_s", "queue_depth", "pages_free",
+                "fragmentation", "acceptance_rate",
+                "prefix_hit_rate", "burn_rate_max"} <= set(st.series)
+        assert {r.name for r in st.rules} == {
+            "tok_s_collapse", "fragmentation_climb", "acceptance_drop",
+            "burn_rate_breach", "recovery_surge"}
+        # fresh registry: most sources resolve to None -> tick is safe
+        st.tick(1.0)
+        assert st.ticks == 1
+
+    def test_burn_rate_breach_uses_shed_line(self):
+        st = TimeSeriesStore(capacity=8)
+        burn = [0.0]
+        st.add_source("burn_rate_max", lambda: burn[0])
+        rule = [r for r in default_rules(shed_burn_rate=3.0)
+                if r.name == "burn_rate_breach"][0]
+        st.add_rule(rule)
+        st.tick(1.0)
+        burn[0] = 3.5
+        st.tick(2.0)
+        assert [f["rule"] for f in st.firing()] == ["burn_rate_breach"]
+
+
+# ----------------------------------------------------------- quantiles
+class TestQuantiles:
+    BUCKETS = [(0.1, 2), (0.5, 6), (1.0, 9), ("+Inf", 10)]
+
+    def test_quantile_from_buckets(self):
+        assert quantile_from_buckets(self.BUCKETS, 10, 0.5) == 0.5
+        assert quantile_from_buckets(self.BUCKETS, 10, 0.9) == 1.0
+        assert quantile_from_buckets(self.BUCKETS, 10, 1.0) == "+Inf"
+        assert quantile_from_buckets([], 0, 0.5) is None
+
+    def test_bucket_quantiles(self):
+        qs = bucket_quantiles(self.BUCKETS, 10, (0.5, 0.99))
+        assert qs == {0.5: 0.5, 0.99: "+Inf"}
+
+    def test_merge_series_buckets_union_of_edges(self):
+        merged, count, total = merge_series_buckets([
+            {"buckets": [(1.0, 2), ("+Inf", 3)], "count": 3, "sum": 4.0},
+            {"buckets": [(0.5, 1), ("+Inf", 2)], "count": 2, "sum": 1.0},
+        ])
+        assert count == 5 and total == 5.0
+        assert merged == [(0.5, 1), (1.0, 3), ("+Inf", 5)]
+
+    def test_registry_histogram_quantile(self):
+        h = obs.histogram("obs_q_seconds", "t", buckets=(1.0, 2.0, 4.0))
+        for v in (0.5, 1.5, 1.6, 3.0):
+            h.observe(v)
+        assert h.quantile(0.5) == 2.0
+        assert h.quantiles((0.5, 0.99)) == {0.5: 2.0, 0.99: 4.0}
+        empty = obs.histogram("obs_q2_seconds", "t")
+        assert empty.quantile(0.5) is None
+
+    def test_labeled_histogram_quantile_per_child(self):
+        h = obs.histogram("obs_q3_seconds", "t", ("route",),
+                          buckets=(1.0, 2.0))
+        h.labels("a").observe(0.5)
+        h.labels("b").observe(1.5)
+        assert h.labels("a").quantile(0.5) == 1.0
+        assert h.labels("b").quantile(0.5) == 2.0
+
+
+# ------------------------------------------------ metrics_report shim
+class TestMetricsReport:
+    def test_hist_stats_uses_shared_estimator(self):
+        mod = _load_tool("metrics_report")
+        assert mod._QUANTILES is not None
+        entry = {"series": [
+            {"buckets": [(0.1, 1), (1.0, 4), ("+Inf", 4)],
+             "count": 4, "sum": 2.0}]}
+        count, total, avg, p50, p99 = mod._hist_stats(entry)
+        assert (count, total, avg) == (4, 2.0, 0.5)
+        assert p50 == 1.0 and p99 == 1.0
+        assert mod._hist_stats({"series": []}) == (0, 0.0, 0.0, None,
+                                                   None)
+
+    def test_fault_section_renders_and_degrades(self):
+        mod = _load_tool("metrics_report")
+        assert mod._faults_section({}) is None      # old dump: no keys
+        metrics = {
+            "serving_fault_injected_total": {"type": "counter", "series": [
+                {"labels": {"site": "step_raise"}, "value": 2}]},
+            "serving_recovery_total": {"type": "counter", "series": [
+                {"labels": {"kind": "quarantine"}, "value": 1},
+                {"labels": {"kind": "rebuild"}, "value": 2}]},
+            "router_failovers_total": {"type": "counter", "series": [
+                {"labels": {}, "value": 1}]},
+        }
+        text = mod._faults_section(metrics)
+        assert text.startswith("Fault tolerance")
+        assert "step_raise" in text and "quarantine" in text
+        assert "2 faults injected" in text
+        assert "3 recoveries" in text
+        assert "1 requests quarantined" in text
+        assert "1 mid-stream failovers" in text
+        # and the full report wires it in without crashing
+        assert "Fault tolerance" in mod.report(metrics, None)

@@ -1,0 +1,303 @@
+#!/usr/bin/env python
+"""Live terminal dashboard over a serving fleet.
+
+Usage:
+    python tools/fleet_dashboard.py <host:port> [--interval 2] [--once]
+
+Point it at a router or a single replica — both serve
+``GET /debug/fleet`` (kind "router" aggregates per-replica summaries;
+kind "replica" is one server's own census).  Renders:
+
+  * an alert banner (firing anomaly rules, tagged per replica under a
+    router);
+  * the cluster / replica census: slots, queue, KV-page pool +
+    fragmentation, SLO burn rates, spec acceptance, recovery counts;
+  * latency quantiles (p50/p95/p99) estimated from the published
+    cumulative buckets — merged ACROSS replicas before estimating,
+    which is why replicas publish raw buckets and not quantiles;
+  * sparkline history from each replica's recent time-series windows
+    (requires ``FLAGS_obs_timeseries_interval_s`` on the replicas).
+
+``--once`` prints a single deterministic frame and exits 0 (what the
+tier-1 smoke test drives); the default is a live loop that redraws
+every ``--interval`` seconds until Ctrl-C.
+
+Works standalone — no paddle_tpu / jax import.  The bucket-quantile
+estimator is shared with the library by loading
+``paddle_tpu/observability/quantiles.py`` by file path (the module is
+deliberately import-free to make that possible).
+"""
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import sys
+import time
+
+_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def _load_quantiles():
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "paddle_tpu", "observability",
+                        "quantiles.py")
+    try:
+        spec = importlib.util.spec_from_file_location("_pt_quantiles",
+                                                      path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    except Exception:
+        return None
+
+
+_QUANTILES = _load_quantiles()
+
+
+def fetch(address: str, path: str = "/debug/fleet", timeout: float = 5.0):
+    host, _, port = address.partition(":")
+    conn = http.client.HTTPConnection(host, int(port or 80),
+                                      timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        body = resp.read()
+        if resp.status != 200:
+            raise RuntimeError(f"GET {path} -> {resp.status}")
+        return json.loads(body)
+    finally:
+        conn.close()
+
+
+def spark(values, width: int = 24) -> str:
+    """Unicode sparkline of the last ``width`` values; flat series
+    render as a flat mid-line, empty series as '-'."""
+    vals = [float(v) for v in values][-width:]
+    if not vals:
+        return "-"
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return _BLOCKS[3] * len(vals)
+    scale = (len(_BLOCKS) - 1) / (hi - lo)
+    return "".join(_BLOCKS[int((v - lo) * scale)] for v in vals)
+
+
+def _fmt(v, digits: int = 3) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, bool):
+        return "y" if v else "n"
+    if isinstance(v, float):
+        if v == int(v) and abs(v) < 1e12:
+            return str(int(v))
+        return f"{v:.{digits}g}"
+    return str(v)
+
+
+def _fmt_pct(v) -> str:
+    return "-" if v is None else f"{100.0 * float(v):.1f}%"
+
+
+def _fmt_ms(v) -> str:
+    if v is None:
+        return "-"
+    if v == "+Inf":
+        return "+Inf"
+    return f"{float(v) * 1e3:g}ms"
+
+
+def _table(rows, headers) -> str:
+    widths = [max(len(str(r[i])) for r in rows + [headers])
+              for i in range(len(headers))]
+
+    def line(r):
+        return "  ".join(str(c).ljust(w)
+                         for c, w in zip(r, widths)).rstrip()
+
+    return "\n".join([line(headers),
+                      line(["-" * w for w in widths])]
+                     + [line(r) for r in rows])
+
+
+def _alert_banner(alerts) -> list[str]:
+    if not alerts:
+        return []
+    lines = [f"!! {len(alerts)} ALERT{'S' if len(alerts) > 1 else ''} "
+             f"FIRING"]
+    for a in alerts:
+        where = f"[{a['replica']}] " if a.get("replica") else ""
+        lines.append(f"  {where}{a.get('rule', '?')}: "
+                     f"{a.get('condition', '')} "
+                     f"(value={_fmt(a.get('value'))})")
+    return lines
+
+
+def _latency_lines(latency, indent: str = "  ") -> list[str]:
+    """p50/p95/p99 per dimension from raw cumulative buckets, via the
+    shared estimator.  ``latency`` maps dim -> {buckets, count, sum} or
+    dim -> list of those (router view: one per replica, merged here)."""
+    if not latency or _QUANTILES is None:
+        return []
+    lines = []
+    for dim, snaps in sorted(latency.items()):
+        if isinstance(snaps, dict):
+            snaps = [snaps]
+        merged, count, total = _QUANTILES.merge_series_buckets(snaps)
+        if not count:
+            continue
+        qs = _QUANTILES.bucket_quantiles(merged, count,
+                                         (0.5, 0.95, 0.99))
+        lines.append(
+            f"{indent}{dim:<5} n={count} avg={total / count * 1e3:.3g}ms"
+            f" p50<={_fmt_ms(qs[0.5])} p95<={_fmt_ms(qs[0.95])}"
+            f" p99<={_fmt_ms(qs[0.99])}")
+    return ["Latency (bucket-estimated)"] + lines if lines else []
+
+
+def _series_lines(series, names=None) -> list[str]:
+    """Sparklines for selected series windows ({name: [[t, v], ...]})."""
+    if not series:
+        return []
+    names = names or ("tok_s", "queue_depth", "active_slots",
+                      "pages_free", "fragmentation", "burn_rate_max",
+                      "acceptance_rate", "prefix_hit_rate")
+    lines = []
+    for name in names:
+        pts = series.get(name)
+        if not pts:
+            continue
+        vals = [p[1] for p in pts if p[1] is not None]
+        if not vals:
+            continue
+        lines.append(f"  {name:<16} {spark(vals)}  last={_fmt(vals[-1])}")
+    return ["History"] + lines if lines else []
+
+
+def _replica_row(address, up, fl):
+    pool = (fl or {}).get("pool") or {}
+    slots = (fl or {}).get("slots") or {}
+    queue = (fl or {}).get("queue") or {}
+    slo = (fl or {}).get("slo") or {}
+    spec = (fl or {}).get("spec") or {}
+    rec = (fl or {}).get("recovery") or {}
+    series = (fl or {}).get("series") or {}
+    tok = series.get("tok_s")
+    tok_s = tok[-1][1] if tok else None
+    return (address,
+            "up" if up else "DOWN",
+            f"{_fmt(slots.get('active'))}/{_fmt(slots.get('max'))}",
+            _fmt(queue.get("depth")),
+            f"{_fmt(pool.get('free'))}/{_fmt(pool.get('total'))}",
+            _fmt_pct(pool.get("fragmentation_ratio")),
+            _fmt(slo.get("max_burn_rate")),
+            _fmt(tok_s),
+            _fmt_pct(spec.get("spec_acceptance_rate"))
+            if spec.get("spec_proposed") else "-",
+            _fmt(rec.get("recoveries")))
+
+
+_REPLICA_HEADERS = ("replica", "state", "slots", "queue",
+                    "pages free", "frag", "burn", "tok/s",
+                    "accept", "recov")
+
+
+def render_router(payload) -> str:
+    cluster = payload.get("cluster") or {}
+    out = [f"FLEET  replicas={cluster.get('up', '?')}/"
+           f"{cluster.get('replicas', '?')} up  "
+           f"summaries={cluster.get('summaries', 0)}  "
+           f"failovers={payload.get('failovers', 0)}"]
+    out += _alert_banner(cluster.get("alerts_firing") or [])
+    pages = cluster.get("pages") or {}
+    slots = cluster.get("slots") or {}
+    out.append(
+        f"  slots {_fmt(slots.get('active'))}/{_fmt(slots.get('max'))}"
+        f"  queue={_fmt(cluster.get('queue_depth'))}"
+        f"  pages free={_fmt(pages.get('free'))}/"
+        f"{_fmt(pages.get('total'))}"
+        f" (live={_fmt(pages.get('live'))}"
+        f" cached={_fmt(pages.get('cached'))})"
+        f"  max burn={_fmt(cluster.get('max_burn_rate'))}"
+        f"  prefix digests={_fmt(cluster.get('prefix_digests'))}")
+    replicas = payload.get("replicas") or {}
+    rows, latency = [], {}
+    for addr, entry in sorted(replicas.items()):
+        fl = entry.get("summary")
+        rows.append(_replica_row(addr, entry.get("up"), fl))
+        for dim, snap in ((fl or {}).get("latency") or {}).items():
+            latency.setdefault(dim, []).append(snap)
+    if rows:
+        out += ["", _table(rows, _REPLICA_HEADERS)]
+    lat = _latency_lines(latency)
+    if lat:
+        out += [""] + lat
+    for addr, entry in sorted(replicas.items()):
+        hist = _series_lines((entry.get("summary") or {}).get("series"))
+        if hist:
+            out += ["", f"[{addr}]"] + hist[1:]
+    return "\n".join(out)
+
+
+def render_replica(payload) -> str:
+    out = [f"REPLICA {payload.get('address', '?')}  "
+           f"model={payload.get('model', '?')}"
+           + ("  DRAINING" if payload.get("draining") else "")]
+    alerts = (payload.get("alerts") or {}).get("firing") or []
+    out += _alert_banner(alerts)
+    out += ["", _table([_replica_row(payload.get("address", "?"),
+                                     not payload.get("draining"),
+                                     payload)],
+                       _REPLICA_HEADERS)]
+    prefix = payload.get("prefix") or {}
+    out.append(f"  prefix cache: {_fmt(prefix.get('cached_pages'))} "
+               f"pages held, {_fmt(prefix.get('cached_tokens'))} tokens"
+               f" served from cache, hit rate "
+               f"{_fmt_pct(prefix.get('hit_rate'))} "
+               f"({_fmt(len(prefix.get('roots') or []))} root chains)")
+    rec = payload.get("recovery") or {}
+    if any(rec.values()):
+        out.append(f"  recovery: {_fmt(rec.get('recoveries'))} rebuilds,"
+                   f" {_fmt(rec.get('quarantines'))} quarantines,"
+                   f" {_fmt(rec.get('replayed_requests'))} replays")
+    lat = _latency_lines(payload.get("latency"))
+    if lat:
+        out += [""] + lat
+    hist = _series_lines(payload.get("series"))
+    if hist:
+        out += [""] + hist
+    return "\n".join(out)
+
+
+def render(payload) -> str:
+    if payload.get("kind") == "router":
+        return render_router(payload)
+    return render_replica(payload)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("address", help="router or replica host:port")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period for the live loop (seconds)")
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit (deterministic; "
+                         "what the smoke test runs)")
+    args = ap.parse_args(argv)
+    if args.once:
+        print(render(fetch(args.address)))
+        return 0
+    try:
+        while True:
+            frame = render(fetch(args.address))
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,8 +6,9 @@ Usage:
 
 Reads metrics.json (+ retraces.json / trace.json / flight.json when
 present) from the dump directory FLAGS_metrics_dir pointed at, and
-renders counters, gauges, histograms, SLO verdicts, finish reasons,
-the span-trace summary, and the retrace log as aligned tables.  --prom
+renders counters, gauges, histograms, SLO verdicts, fault-tolerance
+events, finish reasons, the span-trace summary, and the retrace log
+as aligned tables.  --prom
 cats the raw Prometheus text instead (what a scraper would see).
 
 Every section is optional: a dump produced by an older build (no SLO
@@ -93,13 +94,46 @@ def _histogram_block(name, entry):
     return "\n".join(lines)
 
 
+def _load_quantiles():
+    """Shared bucket-quantile estimator
+    (paddle_tpu/observability/quantiles.py) loaded by file path — the
+    module is deliberately import-free so this tool keeps its
+    no-paddle_tpu/no-jax contract.  None when the tool was copied off
+    the repo without it (older dumps still render; see _hist_stats)."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "paddle_tpu", "observability",
+                        "quantiles.py")
+    try:
+        spec = importlib.util.spec_from_file_location("_pt_quantiles",
+                                                      path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    except Exception:
+        return None
+
+
+_QUANTILES = _load_quantiles()
+
+
 def _hist_stats(entry):
     """(count, sum, avg, approx-p50, approx-p99) over all series of a
     histogram entry — percentile = upper edge of the cumulative bucket
-    that crosses the rank (what a Prometheus quantile would report)."""
+    that crosses the rank (what a Prometheus quantile would report).
+    Delegates to the shared quantiles helper when available."""
+    series = entry.get("series", [])
+    if _QUANTILES is not None:
+        buckets, count, total = _QUANTILES.merge_series_buckets(series)
+        if not count:
+            return 0, 0.0, 0.0, None, None
+        return (count, total, total / count,
+                _QUANTILES.quantile_from_buckets(buckets, count, 0.5),
+                _QUANTILES.quantile_from_buckets(buckets, count, 0.99))
+    # standalone fallback: same arithmetic, no file dependency
     buckets: dict = {}
     count, total = 0, 0.0
-    for s in entry.get("series", []):
+    for s in series:
         count += s.get("count", 0)
         total += s.get("sum", 0.0)
         prev = 0
@@ -297,6 +331,55 @@ def _http_section(metrics):
                          f"({_fmt_value(aff)}/{_fmt_value(total)}) hit "
                          f"the prefix-hash target")
     return "\n".join(lines)
+
+
+def _faults_section(metrics):
+    """Fault-tolerance summary (chaos harness + self-healing +
+    router failover): fault injections by site, recovery events by
+    kind, quarantined requests, mid-stream failovers.  Dumps from
+    builds without the fault layer have none of these keys and
+    produce no section."""
+    injected = metrics.get("serving_fault_injected_total")
+    recovery = metrics.get("serving_recovery_total")
+    failovers = metrics.get("router_failovers_total")
+    if not (injected or recovery or failovers):
+        return None
+    lines = ["Fault tolerance"]
+    rows = []
+    for s in (injected or {}).get("series", []):
+        rows.append(("serving_fault_injected_total",
+                     _fmt_labels(s.get("labels", {})),
+                     _fmt_value(s.get("value", 0))))
+    by_kind: dict = {}
+    for s in (recovery or {}).get("series", []):
+        kind = s.get("labels", {}).get("kind", "?")
+        by_kind[kind] = by_kind.get(kind, 0) + s.get("value", 0)
+        rows.append(("serving_recovery_total",
+                     _fmt_labels(s.get("labels", {})),
+                     _fmt_value(s.get("value", 0))))
+    n_failovers = sum(s.get("value", 0)
+                      for s in (failovers or {}).get("series", []))
+    if failovers:
+        rows.append(("router_failovers_total", "-",
+                     _fmt_value(n_failovers)))
+    if rows:
+        lines.append(_table(rows, ("name", "labels", "value")))
+    total_inj = sum(s.get("value", 0)
+                    for s in (injected or {}).get("series", []))
+    summary = []
+    if total_inj:
+        summary.append(f"{_fmt_value(total_inj)} faults injected")
+    if by_kind:
+        summary.append(f"{_fmt_value(sum(by_kind.values()))} recoveries")
+    if by_kind.get("quarantine"):
+        summary.append(f"{_fmt_value(by_kind['quarantine'])} requests "
+                       f"quarantined")
+    if n_failovers:
+        summary.append(f"{_fmt_value(n_failovers)} mid-stream "
+                       f"failovers")
+    if summary:
+        lines.append("  " + ", ".join(summary))
+    return "\n".join(lines) if len(lines) > 1 else None
 
 
 def _slo_section(metrics):
@@ -505,6 +588,9 @@ def report(metrics, retraces, trace=None, flight=None, resources=None):
     http = _http_section(metrics)
     if http:
         out += [http, ""]
+    faults = _faults_section(metrics)
+    if faults:
+        out += [faults, ""]
     slo = _slo_section(metrics)
     if slo:
         out += [slo, ""]

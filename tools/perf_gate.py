@@ -72,6 +72,16 @@ DIRECTIONS = {
     "leaked_pages": "exact",
     "faults_injected": "exact",
     "replay_cached_tokens": "high",
+    # telemetry: the sampler must be deterministic under a fake clock
+    # (exact ticks/samples/alerts) and free under the control run
+    # (exactly zero extra host syncs / decode traces)
+    "sampler_ticks": "exact",
+    "samples_taken": "exact",
+    "series_tracked": "exact",
+    "alert_rules": "exact",
+    "alerts_fired": "exact",
+    "host_syncs_delta_vs_off": "exact",
+    "decode_traces_delta_vs_off": "exact",
 }
 
 
@@ -344,6 +354,73 @@ def scenario_fault_recovery() -> dict:
     }
 
 
+def scenario_telemetry() -> dict:
+    """Fake-clock sampler determinism: the same faulted workload runs
+    twice — with a ticking TimeSeriesStore + the default alert rules,
+    and without — gating that the sampler takes an exact number of
+    samples, fires exactly the expected alerts, and adds ZERO host
+    syncs / decode traces over the sampler-off control (the
+    zero-overhead contract of FLAGS_obs_timeseries_interval_s).
+    Sources read engine python mirrors, not the process registry, so
+    the scenario is isolated no matter which scenarios ran before."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.serving import EngineSupervisor, FaultPlan
+
+    prompt = list(range(1, 9))
+
+    def drive(with_store):
+        plan = FaultPlan(seed=0)
+        plan.add("nan_logits", at=1, slot=0, phase="prefill")
+        eng = _engine(max_slots=2, page_size=4, sync_interval=1,
+                      faults=plan)
+        sup = EngineSupervisor(eng, max_recoveries=3)
+        store = None
+        fake = [0.0]
+        reqs = []
+        if with_store:
+            store = obs.TimeSeriesStore(capacity=256,
+                                        clock=lambda: fake[0])
+            store.add_source("tokens", lambda: float(
+                sum(r.num_generated for r in reqs)))
+            store.add_source("active_slots",
+                             lambda: float(eng.scheduler.active_count))
+            store.add_source("fragmentation",
+                             lambda: eng.blocks.fragmentation())
+            store.add_source("recoveries", lambda: float(
+                eng.recoveries + eng.quarantines))
+            store.add_rate("tok_s", of="tokens")
+            for rule in obs.default_rules(shed_burn_rate=1.0):
+                store.add_rule(rule)
+            store.tick()        # t=0 baseline before any fault
+        reqs += [eng.submit(prompt + [20], _gen(8)),
+                 eng.submit(prompt + [25], _gen(8))]
+        steps = 0
+        while not all(r.is_finished() for r in reqs) and steps < 400:
+            sup.step()
+            steps += 1
+            if store is not None:
+                fake[0] += 1.0
+                store.tick()
+        return eng, store
+
+    eng_off, _ = drive(False)
+    eng_on, store = drive(True)
+    return {
+        "sampler_ticks": store.ticks,
+        "samples_taken": store.samples,
+        "series_tracked": len(store.windows(n=1)),
+        "alert_rules": len(store.rules),
+        "alerts_fired": store.alerts_fired,
+        "quarantines": eng_on.quarantines,
+        "leaked_pages": eng_on.blocks.pool_accounting()["leak"],
+        # the zero-overhead contract: sampling adds no device work
+        "host_syncs_delta_vs_off": eng_on.host_syncs
+        - eng_off.host_syncs,
+        "decode_traces_delta_vs_off": eng_on.decode_traces
+        - eng_off.decode_traces,
+    }
+
+
 SCENARIOS = {
     "steady_decode": scenario_steady_decode,
     "prefix_cache": scenario_prefix_cache,
@@ -352,6 +429,7 @@ SCENARIOS = {
     "tp_decode": scenario_tp_decode,
     "spec_decode": scenario_spec_decode,
     "fault_recovery": scenario_fault_recovery,
+    "telemetry": scenario_telemetry,
 }
 
 
