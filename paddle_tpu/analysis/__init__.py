@@ -14,7 +14,16 @@ Three analyzer families guard the invariants PRs 1–5 made load-bearing:
   invoked under a lock);
 * :mod:`.flags_metrics` — FLAGS_* registration, flag help, metric
   naming/unit-suffix conventions;
-* :mod:`.clocks` — durations/deadlines must use monotonic clocks.
+* :mod:`.clocks` — durations/deadlines must use monotonic clocks;
+* :mod:`.effects` — paired effects (pages/ledger, gauge inc/dec,
+  span begin/end) must release on every outgoing path, including
+  exception edges;
+* :mod:`.dtype_flow` — dtype flow inside resolved jitted bodies:
+  promoting reductions without a cast-back, weak python scalars on
+  narrow operands, wide ``np.*`` constants;
+* :mod:`.shard_safety` — collectives only inside ``shard_map``-mapped
+  functions on axis names the mapping binds; PartitionSpec axes
+  validated against the mesh.
 
 Entry points: ``tools/lint.py`` (CLI with committed baseline) and
 :func:`paddle_tpu.analysis.run` (library).  Analyzers never import the

@@ -1,0 +1,372 @@
+"""Dtype-flow analyzer for jitted bodies: promotion & weak-scalar lint.
+
+PR 10 shipped a real retrace bug: inside the speculative-decode verify
+step, ``jnp.cumprod(m).sum()`` on an int32 mask silently promotes to
+int64 when ``jax_enable_x64`` is set, changing the traced avals between
+hosts and forcing a recompile that only the perf-gate trace counter
+caught.  This analyzer rejects that class at lint time.
+
+It reuses ``jit_safety``'s jit-target resolution — named functions,
+lambdas, decorated defs, factory closures (``jax.jit(self._build_step())``)
+and shard_map/partial wrappers (``jax.jit(jax.shard_map(step, ...))``)
+— then runs a forward abstract dtype pass over each resolved body.
+Dtypes are tracked as lattice strings (``bool``/``int32``/...); any
+expression whose dtype cannot be proven stays unknown and is never
+flagged, so the pass under-approximates rather than guesses.
+
+Rules:
+
+``jit-dtype-promotion``
+    A reducing op (``sum``/``prod``/``cumsum``/``cumprod``) over a
+    provably narrow operand (bool/int8/int16/int32) with no ``.astype``
+    cast on the result expression — the result widens to the default
+    int under x64, shifting avals and retracing.
+
+``jit-weak-scalar``
+    A Python float scalar combined (``+ - * **``) with a provably
+    narrow-int traced operand — weak-type promotion turns the result
+    float (float64 under x64); also an int literal too large for int32
+    combined with an int32 operand.
+
+``jit-np-constant``
+    ``np.array``/``np.arange``/... creating a *constant* (untainted
+    args — tainted ones are already ``jit-host-sync``) inside a traced
+    body without a narrow dtype: numpy defaults to float64/int64 on
+    host, baking wide constants into the program.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, SourceFile, call_name, expr_text
+from .jit_safety import (_ModuleIndex, _is_tainted, _propagate,
+                         _resolved_from_def)
+
+__all__ = ["analyze"]
+
+RULES = {
+    "jit-dtype-promotion": "narrow-int reduction inside a jitted body "
+                           "with no cast-back (int64 under x64)",
+    "jit-weak-scalar": "python scalar weak-promoting a narrow traced "
+                       "operand inside a jitted body",
+    "jit-np-constant": "np.* constant without a narrow dtype inside a "
+                       "jitted body (float64/int64 on host)",
+}
+
+_NARROW = {"bool", "int8", "int16", "int32"}
+_NARROW_INT = {"int8", "int16", "int32"}
+
+_REDUCTIONS = {"sum", "prod", "cumsum", "cumprod"}
+_REDUCTION_CALLS = {f"jnp.{r}" for r in _REDUCTIONS} | \
+    {f"jax.numpy.{r}" for r in _REDUCTIONS}
+
+_NP_CTORS = {"array", "asarray", "ones", "zeros", "full", "arange",
+             "linspace", "eye", "empty"}
+# positional index of the dtype argument, where it is plausibly used
+_NP_DTYPE_POS = {"array": 1, "asarray": 1, "zeros": 1, "ones": 1,
+                 "empty": 1, "full": 2}
+
+_WEAK_OPS = (ast.Add, ast.Sub, ast.Mult, ast.Pow)
+
+_INT32_MAX = 2 ** 31 - 1
+
+_DTYPE_NAMES = {"bool", "bool_", "int8", "int16", "int32", "int64",
+                "uint8", "uint16", "uint32", "uint64", "float16",
+                "bfloat16", "float32", "float64"}
+
+
+def analyze(src: SourceFile) -> list[Finding]:
+    if "jit" not in src.text:       # cheap pre-gate: nothing to resolve
+        return []
+    mod = _ModuleIndex(src)
+    findings: list[Finding] = []
+    done: set[int] = set()
+    for jit in mod.jit_calls:
+        body = mod.resolve_target(jit)
+        if body is None or id(body.node) in done:
+            continue
+        done.add(id(body.node))
+        _BodyCheck(src, body, findings).run()
+    seen, unique = set(), []
+    for f in findings:
+        key = (f.rule, f.line, f.message)
+        if key not in seen:
+            seen.add(key)
+            unique.append(f)
+    return src.filter(unique)
+
+
+def _dtype_name(node) -> str | None:
+    """The dtype a dtype-position expression denotes, if literal."""
+    if isinstance(node, ast.Attribute) and node.attr in _DTYPE_NAMES:
+        return node.attr.rstrip("_")
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+            and node.value in _DTYPE_NAMES:
+        return node.value
+    if isinstance(node, ast.Name):
+        if node.id == "bool":
+            return "bool"
+        if node.id == "float":
+            return "float64"        # python float == double
+        if node.id == "int":
+            return "int64"
+    return None
+
+
+class _BodyCheck:
+    def __init__(self, src, body, findings):
+        self.src = src
+        self.body = body
+        self.findings = findings
+        node = body.node
+        self.fn_name = node.name if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)) else "<lambda>"
+        self.stmts = node.body if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+            else [ast.Expr(value=node.body)]
+        self.tainted = {p for i, p in enumerate(body.params)
+                        if i not in body.static_idx}
+        for _ in range(2):
+            for stmt in self.stmts:
+                _propagate(stmt, self.tainted)
+
+    def run(self):
+        self._scan(self.stmts, {})
+
+    # ------------------------------------------------------ statement walk
+    def _scan(self, stmts, env):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.If):
+                self._check(stmt.test, env)
+                e1, e2 = dict(env), dict(env)
+                self._scan(stmt.body, e1)
+                self._scan(stmt.orelse, e2)
+                for k in set(e1) | set(e2):
+                    v1, v2 = e1.get(k), e2.get(k)
+                    env[k] = v1 if v1 == v2 else None
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                head = stmt.test if isinstance(stmt, ast.While) \
+                    else stmt.iter
+                self._check(head, env)
+                self._scan(stmt.body, env)
+                self._scan(stmt.orelse, env)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._check(item.context_expr, env)
+                self._scan(stmt.body, env)
+                continue
+            if isinstance(stmt, ast.Try) or (hasattr(ast, "TryStar") and
+                                             isinstance(stmt,
+                                                        ast.TryStar)):
+                self._scan(stmt.body, env)
+                for h in stmt.handlers:
+                    self._scan(h.body, env)
+                self._scan(stmt.orelse, env)
+                self._scan(stmt.finalbody, env)
+                continue
+            self._check(stmt, env)
+            self._bind(stmt, env)
+
+    def _bind(self, stmt, env):
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            tgt = stmt.targets[0]
+            if isinstance(tgt, ast.Name):
+                env[tgt.id] = self._dtype_of(stmt.value, env)
+            elif isinstance(tgt, ast.Tuple):
+                for e in tgt.elts:
+                    if isinstance(e, ast.Name):
+                        env[e.id] = None
+        elif isinstance(stmt, ast.AnnAssign) and \
+                isinstance(stmt.target, ast.Name) and \
+                stmt.value is not None:
+            env[stmt.target.id] = self._dtype_of(stmt.value, env)
+        elif isinstance(stmt, ast.AugAssign) and \
+                isinstance(stmt.target, ast.Name):
+            env[stmt.target.id] = None
+
+    # ------------------------------------------------------ expression pass
+    def _check(self, root, env):
+        if root is None:
+            return
+        parents: dict[int, ast.AST] = {}
+        nodes = []
+        stack = [root]
+        while stack:
+            n = stack.pop()
+            nodes.append(n)
+            for c in ast.iter_child_nodes(n):
+                if isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                    continue
+                parents[id(c)] = n
+                stack.append(c)
+        for n in nodes:
+            if isinstance(n, ast.Call):
+                self._check_reduction(n, env, parents)
+                self._check_np_constant(n)
+            elif isinstance(n, ast.BinOp):
+                self._check_weak_scalar(n, env)
+
+    def _check_reduction(self, call, env, parents):
+        operand = None
+        if isinstance(call.func, ast.Attribute) and \
+                call.func.attr in _REDUCTIONS:
+            name = call_name(call)
+            if name in _REDUCTION_CALLS:
+                operand = call.args[0] if call.args else None
+            elif name is None or not name.startswith(("np.", "numpy.")):
+                operand = call.func.value      # method form m.cumprod()
+        if operand is None:
+            return
+        dt = self._dtype_of(operand, env)
+        if dt not in _NARROW:
+            return
+        if self._cast_ancestor(call, parents):
+            return
+        red = call.func.attr
+        self.findings.append(Finding(
+            "jit-dtype-promotion", self.src.path, call.lineno,
+            f"`{red}` over {dt} operand `{expr_text(operand)}` in "
+            f"`{self.fn_name}` promotes to the default int width under "
+            "jax_enable_x64 — avals shift between hosts and the step "
+            "retraces",
+            hint="cast the result back explicitly, e.g. "
+                 "`.astype(jnp.int32)` on the reduction chain"))
+
+    @staticmethod
+    def _cast_ancestor(call, parents) -> bool:
+        n = parents.get(id(call))
+        while n is not None:
+            if isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Attribute) and \
+                    n.func.attr == "astype":
+                return True
+            n = parents.get(id(n))
+        return False
+
+    def _check_weak_scalar(self, binop, env):
+        if not isinstance(binop.op, _WEAK_OPS):
+            return
+        for const, other in ((binop.left, binop.right),
+                             (binop.right, binop.left)):
+            if not isinstance(const, ast.Constant):
+                continue
+            v = const.value
+            dt = self._dtype_of(other, env)
+            if isinstance(v, float) and dt in _NARROW_INT:
+                self.findings.append(Finding(
+                    "jit-weak-scalar", self.src.path, binop.lineno,
+                    f"python float `{v}` combined with {dt} operand "
+                    f"`{expr_text(other)}` in `{self.fn_name}` "
+                    "weak-promotes the result to float "
+                    "(float64 under x64)",
+                    hint="cast the operand first "
+                         "(`x.astype(jnp.float32)`) or use "
+                         "`jnp.float32(scalar)`"))
+                return
+            if isinstance(v, int) and not isinstance(v, bool) and \
+                    abs(v) > _INT32_MAX and dt == "int32":
+                self.findings.append(Finding(
+                    "jit-weak-scalar", self.src.path, binop.lineno,
+                    f"int literal `{v}` does not fit int32; combining "
+                    f"it with `{expr_text(other)}` in `{self.fn_name}` "
+                    "forces int64",
+                    hint="use an in-range constant or widen the "
+                         "operand deliberately"))
+                return
+
+    def _check_np_constant(self, call):
+        name = call_name(call) or ""
+        if not name.startswith(("np.", "numpy.")):
+            return
+        ctor = name.split(".")[-1]
+        if ctor not in _NP_CTORS:
+            return
+        if any(_is_tainted(a, self.tainted) for a in call.args):
+            return                  # that is jit-host-sync, not this rule
+        dt_node = None
+        for kw in call.keywords:
+            if kw.arg == "dtype":
+                dt_node = kw.value
+        pos = _NP_DTYPE_POS.get(ctor)
+        if dt_node is None and pos is not None and len(call.args) > pos:
+            dt_node = call.args[pos]
+        if dt_node is None:
+            detail = "with no dtype (numpy defaults to float64/int64 " \
+                     "on host)"
+        else:
+            dt = _dtype_name(dt_node)
+            if dt is None or ("64" not in dt):
+                return              # explicitly narrow (or unknowable)
+            detail = f"with dtype {dt}"
+        self.findings.append(Finding(
+            "jit-np-constant", self.src.path, call.lineno,
+            f"`{name}(...)` constant {detail} inside jitted "
+            f"`{self.fn_name}` bakes a wide host constant into the "
+            "traced program",
+            hint="pass dtype=jnp.float32/jnp.int32, or build the "
+                 "constant with jnp.*"))
+
+    # ---------------------------------------------------- dtype evaluation
+    def _dtype_of(self, node, env) -> str | None:
+        if isinstance(node, ast.Name):
+            return env.get(node.id)
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, bool):
+                return "bool"
+            return None             # weak python scalars stay unknown
+        if isinstance(node, ast.Compare):
+            return "bool"
+        if isinstance(node, ast.BoolOp):
+            return "bool"
+        if isinstance(node, ast.UnaryOp):
+            if isinstance(node.op, ast.Not):
+                return "bool"
+            return self._dtype_of(node.operand, env)
+        if isinstance(node, ast.Subscript):
+            return self._dtype_of(node.value, env)
+        if isinstance(node, ast.BinOp):
+            lt = self._dtype_of(node.left, env)
+            rt = self._dtype_of(node.right, env)
+            if lt == rt:
+                return lt
+            return None
+        if isinstance(node, ast.IfExp):
+            a = self._dtype_of(node.body, env)
+            b = self._dtype_of(node.orelse, env)
+            return a if a == b else None
+        if isinstance(node, ast.Call):
+            return self._call_dtype(node, env)
+        return None
+
+    def _call_dtype(self, call, env) -> str | None:
+        if isinstance(call.func, ast.Attribute):
+            attr = call.func.attr
+            if attr == "astype" and call.args:
+                return _dtype_name(call.args[0])
+            if attr in _REDUCTIONS:
+                name = call_name(call)
+                if name in _REDUCTION_CALLS and call.args:
+                    inner = self._dtype_of(call.args[0], env)
+                else:
+                    inner = self._dtype_of(call.func.value, env)
+                if inner in _NARROW:
+                    return "int64"  # the promotion this pass flags
+                return inner
+        name = call_name(call) or ""
+        for kw in call.keywords:
+            if kw.arg == "dtype":
+                return _dtype_name(kw.value)
+        base = name.split(".")[-1]
+        if name.startswith(("jnp.", "jax.numpy.")) and \
+                base in ("zeros", "ones", "empty") and len(call.args) > 1:
+            return _dtype_name(call.args[1])
+        if name.startswith(("jnp.", "jax.numpy.")) and \
+                base == "full" and len(call.args) > 2:
+            return _dtype_name(call.args[2])
+        return None

@@ -1,0 +1,427 @@
+"""Paired-effect analyzer: an acquire must reach its release on every
+outgoing path — early returns, explicit raises, AND the implicit
+exception edge of any call made while the effect is held.
+
+The serving engine is built on effect pairs whose imbalance is invisible
+to tests until load: BlockManager pages (``allocate``/``allocate_seq``
+vs ``free_seq``, committed-token ledger ``append`` vs ``rollback``),
+inflight gauges (``.inc()`` vs ``.dec()``), and tracing spans
+(``start_span`` vs ``.end()``).  Cross-function ownership transfer is
+the repo's normal protocol (the scheduler allocates, ``evict`` frees),
+so this analyzer only arms an acquire when the *same function* also
+contains the matching release — the bug class is "cleanup written, but
+only on the happy path".
+
+Checking runs as abstract execution over the function's statement tree
+with exception edges: every call made while an effect is held may
+raise, and the raise edge must pass a ``finally`` that releases, or a
+handler (which is then itself checked).  ``with``-statement use and
+``finally``-releases are recognized as safe; a tracked span that
+escapes the function (stored, returned, passed to a call, captured by
+a closure) transfers ownership and stops being tracked.
+
+Rules:
+
+``effect-leak-on-raise``
+    Pages/ledger acquired and released in one function, with an outgoing
+    path (raise edge, early return, fallthrough) that skips the release.
+
+``gauge-unpaired``
+    ``X.inc()`` with a matching ``X.dec()`` in the same function that
+    some path skips — the gauge drifts up under errors/cancellation.
+
+``span-unclosed``
+    A locally-bound span (``s = ...start_span(...)``) that some path
+    abandons without ``s.end()`` — open spans pin the tracer ring and
+    report infinite durations.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, SourceFile, expr_text
+
+__all__ = ["analyze"]
+
+RULES = {
+    "effect-leak-on-raise": "pages/ledger acquire whose same-function "
+                            "release is skipped on some outgoing path",
+    "gauge-unpaired": "gauge .inc() whose matching .dec() is skipped "
+                      "on some outgoing path",
+    "span-unclosed": "locally-bound span not .end()ed on every "
+                     "outgoing path",
+}
+
+_PAGE_ACQUIRES = {"allocate", "allocate_seq"}
+_PAGE_RELEASES = {"free_seq", "rollback"}
+# `.append` is only a ledger acquire on a block-manager/ledger receiver
+# (plain list.append is everywhere)
+_LEDGER_HINTS = ("blocks", "ledger")
+
+_HINTS = {
+    "effect-leak-on-raise": "release in a `finally`, or free on the "
+                            "error path before re-raising",
+    "gauge-unpaired": "put the .dec() in a `finally` so errors and "
+                      "early returns restore the gauge",
+    "span-unclosed": "use `with span:` or end it in a `finally`",
+}
+
+
+def analyze(src: SourceFile) -> list[Finding]:
+    text = src.text
+    if ("start_span" not in text and ".inc()" not in text
+            and "allocate" not in text and ".append(" not in text):
+        return []                   # cheap pre-gate: nothing paired
+    findings: list[Finding] = []
+    for fn in _functions(src.tree):
+        if _is_generator(fn):
+            continue                # generator lifetime ≠ call lifetime
+        if fn.name.startswith("test_"):
+            continue                # tests leak/hold deliberately to
+            # assert on census behavior; a failing assert aborts anyway
+        _FunctionCheck(src, fn, findings).run()
+    seen, unique = set(), []
+    for f in findings:
+        key = (f.rule, f.line, f.message)
+        if key not in seen:
+            seen.add(key)
+            unique.append(f)
+    return src.filter(unique)
+
+
+def _functions(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _pruned_walk(node):
+    """Descendants of a statement, not descending into nested defs."""
+    stack = list(ast.iter_child_nodes(node))
+    yield node
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _is_generator(fn) -> bool:
+    return any(isinstance(n, (ast.Yield, ast.YieldFrom))
+               for n in _pruned_walk(fn) if n is not fn)
+
+
+def _has_call(node) -> bool:
+    return any(isinstance(n, ast.Call) for n in _pruned_walk(node))
+
+
+class _Effect:
+    __slots__ = ("kind", "key", "line", "text", "release")
+
+    def __init__(self, kind, key, line, text, release):
+        self.kind = kind            # "pages" | "gauge" | "span"
+        self.key = key              # (kind, identity-text)
+        self.line = line
+        self.text = text            # acquire expression, for the message
+        self.release = release      # release spelling, for the message
+
+
+class _Frame:
+    """One enclosing ``try`` during abstract execution."""
+
+    __slots__ = ("finally_releases", "catches", "raised_held")
+
+    def __init__(self, finally_releases, catches):
+        self.finally_releases = finally_releases   # keys released
+        self.catches = catches                     # has any handler
+        self.raised_held = {}       # key -> effect held at a raise edge
+
+
+_RULE_OF = {"pages": "effect-leak-on-raise", "gauge": "gauge-unpaired",
+            "span": "span-unclosed"}
+
+
+class _FunctionCheck:
+    def __init__(self, src, fn, findings):
+        self.src = src
+        self.fn = fn
+        self.findings = findings
+        self.reported: set = set()
+        # same-function release inventory: an acquire is only armed when
+        # its release exists somewhere in this function
+        self.page_recvs: set = set()
+        self.gauge_recvs: set = set()
+        for node in _pruned_walk(fn):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute):
+                if node.func.attr in _PAGE_RELEASES:
+                    self.page_recvs.add(expr_text(node.func.value))
+                elif node.func.attr == "dec":
+                    self.gauge_recvs.add(expr_text(node.func.value))
+
+    def run(self):
+        held = self._run(self.fn.body, {}, [])
+        if held:
+            for eff in held.values():
+                self._leak(eff, "when the function returns")
+
+    # ------------------------------------------------------- execution
+    def _run(self, stmts, held, frames):
+        for stmt in stmts:
+            held = self._stmt(stmt, held, frames)
+            if held is None:
+                return None
+        return held
+
+    def _stmt(self, stmt, held, frames):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            for key in self._escaped_spans(stmt, held):
+                held = _without(held, key)      # closure capture
+            return held
+        if isinstance(stmt, ast.If):
+            self._maybe_raise(stmt.test, held, frames, ())
+            a = self._run(stmt.body, dict(held), frames)
+            b = self._run(stmt.orelse, dict(held), frames)
+            return _merge(a, b)
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            head = stmt.test if isinstance(stmt, ast.While) else stmt.iter
+            self._maybe_raise(head, held, frames, ())
+            body_rel = self._releases_in(stmt.body)
+            out = self._run(stmt.body, dict(held), frames)
+            # forgiving may-release: a release inside the loop counts
+            after = {k: v for k, v in held.items() if k not in body_rel}
+            if out:
+                for k, v in out.items():
+                    after.setdefault(k, v)
+            if stmt.orelse:
+                after = _merge(after,
+                               self._run(stmt.orelse, dict(after),
+                                         frames)) or after
+            return after
+        if isinstance(stmt, ast.Try) or (hasattr(ast, "TryStar") and
+                                         isinstance(stmt, ast.TryStar)):
+            return self._try(stmt, held, frames)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return self._with(stmt, held, frames)
+        if isinstance(stmt, ast.Return):
+            held = dict(held)
+            if stmt.value is not None:
+                for key in self._escaped_spans(stmt.value, held):
+                    held.pop(key, None)         # returned: caller owns it
+                self._maybe_raise(stmt.value, held, frames, ())
+            self._normal_exit(held, frames, "on an early return")
+            return None
+        if isinstance(stmt, ast.Raise):
+            self._exceptional(held, frames, "on a raise")
+            return None
+        if isinstance(stmt, (ast.Break, ast.Continue)):
+            return held             # stays inside the function
+        return self._leaf(stmt, held, frames)
+
+    def _leaf(self, stmt, held, frames):
+        # escapes first: a span handed to a call transfers ownership,
+        # so the handoff itself must not count as a risky raise site
+        for key in self._escaped_spans(stmt, held):
+            held = _without(held, key)          # ownership transferred
+        rel = self._releases_in([stmt])
+        if held:
+            risky = {k: v for k, v in held.items() if k not in rel}
+            if risky and _has_call(stmt):
+                self._exceptional(risky, frames, "on an exception path")
+        if rel:
+            held = {k: v for k, v in held.items() if k not in rel}
+        return self._acquires(stmt, held)
+
+    # -------------------------------------------------------- acquires
+    def _acquires(self, stmt, held):
+        call, target = None, None
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.value, ast.Call):
+            call, target = stmt.value, stmt.targets[0]
+        elif isinstance(stmt, ast.Expr) and \
+                isinstance(stmt.value, ast.Call):
+            call = stmt.value
+        if call is None or not isinstance(call.func, ast.Attribute):
+            # a span var rebound to a non-span value is simply dropped
+            if isinstance(stmt, ast.Assign):
+                held = self._rebound(stmt, held)
+            return held
+        attr = call.func.attr
+        recv = expr_text(call.func.value)
+        eff = None
+        if attr == "start_span" and isinstance(target, ast.Name):
+            key = ("span", target.id)
+            if key in held:         # overwritten while still open
+                self._leak(held[key], "before being overwritten")
+                held = _without(held, key)
+            eff = _Effect("span", key, stmt.lineno,
+                          f"{target.id} = ...start_span(...)", ".end()")
+        elif attr in _PAGE_ACQUIRES and recv in self.page_recvs:
+            eff = _Effect("pages", ("pages", recv), stmt.lineno,
+                          f"{recv}.{attr}(...)", "free_seq/rollback")
+        elif attr == "append" and recv in self.page_recvs and \
+                any(h in recv for h in _LEDGER_HINTS):
+            eff = _Effect("pages", ("pages", recv), stmt.lineno,
+                          f"{recv}.append(...)", "rollback")
+        elif attr == "inc" and recv in self.gauge_recvs and \
+                target is None:
+            eff = _Effect("gauge", ("gauge", recv), stmt.lineno,
+                          f"{recv}.inc()", ".dec()")
+        if eff is not None and eff.key not in held:
+            held = dict(held)
+            held[eff.key] = eff
+        elif target is not None:
+            held = self._rebound(stmt, held)
+        return held
+
+    def _rebound(self, stmt, held):
+        for tgt in getattr(stmt, "targets", ()):
+            if isinstance(tgt, ast.Name):
+                key = ("span", tgt.id)
+                if key in held:
+                    self._leak(held[key], "before being overwritten")
+                    held = _without(held, key)
+        return held
+
+    # ------------------------------------------- structured statements
+    def _try(self, stmt, held, frames):
+        fr = _Frame(self._releases_in(stmt.finalbody),
+                    bool(stmt.handlers))
+        body_out = self._run(stmt.body, dict(held), frames + [fr])
+        if stmt.orelse and body_out is not None:
+            body_out = self._run(stmt.orelse, body_out, frames + [fr])
+        outs = [body_out]
+        # handler exits still pass through this try's finally
+        hframes = frames + [_Frame(fr.finally_releases, False)]
+        for h in stmt.handlers:
+            entry = dict(held)
+            entry.update(fr.raised_held)
+            outs.append(self._run(h.body, entry, hframes))
+        merged = None
+        for o in outs:
+            merged = _merge(merged, o)
+        if merged is None:
+            self._run(stmt.finalbody, {}, frames)
+            return None
+        return self._run(stmt.finalbody, merged, frames)
+
+    def _with(self, stmt, held, frames):
+        for item in stmt.items:
+            ce = item.context_expr
+            self._maybe_raise(ce, held, frames, ())
+            if isinstance(ce, ast.Name) and ("span", ce.id) in held:
+                held = _without(held, ("span", ce.id))   # __exit__ ends
+            else:
+                for key in self._escaped_spans(ce, held):
+                    held = _without(held, key)
+        return self._run(stmt.body, held, frames)
+
+    # ------------------------------------------------------- exit edges
+    def _maybe_raise(self, node, held, frames, released):
+        if not held or node is None:
+            return
+        risky = {k: v for k, v in held.items() if k not in released}
+        if risky and _has_call(node):
+            self._exceptional(risky, frames, "on an exception path")
+
+    def _exceptional(self, held, frames, why):
+        remaining = dict(held)
+        for fr in reversed(frames):
+            remaining = {k: v for k, v in remaining.items()
+                         if k not in fr.finally_releases}
+            if not remaining:
+                return
+            if fr.catches:
+                fr.raised_held.update(remaining)
+                return              # handler path is checked separately
+        for eff in remaining.values():
+            self._leak(eff, why)
+
+    def _normal_exit(self, held, frames, why):
+        protected = set()
+        for fr in frames:
+            protected |= fr.finally_releases
+        for key, eff in held.items():
+            if key not in protected:
+                self._leak(eff, why)
+
+    # --------------------------------------------------------- plumbing
+    def _releases_in(self, stmts) -> set:
+        out = set()
+        for stmt in stmts:
+            for node in _pruned_walk(stmt):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute):
+                    recv, attr = node.func.value, node.func.attr
+                    if attr == "end" and isinstance(recv, ast.Name):
+                        out.add(("span", recv.id))
+                    elif attr == "dec":
+                        out.add(("gauge", expr_text(recv)))
+                    elif attr in _PAGE_RELEASES:
+                        out.add(("pages", expr_text(recv)))
+                elif isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        ce = item.context_expr
+                        if isinstance(ce, ast.Name):
+                            out.add(("span", ce.id))
+        return out
+
+    def _escaped_spans(self, node, held) -> set:
+        names = {key[1]: key for key, eff in held.items()
+                 if eff.kind == "span"}
+        if not names:
+            return set()
+        out: set = set()
+
+        def visit(n):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                for sub in ast.walk(n):     # closure capture escapes
+                    if isinstance(sub, ast.Name) and sub.id in names:
+                        out.add(names[sub.id])
+                return
+            if isinstance(n, ast.Attribute) and \
+                    isinstance(n.value, ast.Name):
+                return                      # span.end() / span.context
+            if isinstance(n, ast.Name) and n.id in names and \
+                    isinstance(n.ctx, ast.Load):
+                out.add(names[n.id])
+                return
+            for c in ast.iter_child_nodes(n):
+                visit(c)
+
+        visit(node)
+        return out
+
+    def _leak(self, eff: _Effect, why: str):
+        if (eff.key, eff.line) in self.reported:
+            return
+        self.reported.add((eff.key, eff.line))
+        rule = _RULE_OF[eff.kind]
+        noun = {"pages": "acquire", "gauge": "gauge increment",
+                "span": "span"}[eff.kind]
+        self.findings.append(Finding(
+            rule, self.src.path, eff.line,
+            f"{noun} `{eff.text}` in `{self.fn.name}` is not released "
+            f"by `{eff.release}` {why}",
+            hint=_HINTS[rule]))
+
+
+def _merge(a, b):
+    if a is None:
+        return None if b is None else dict(b)
+    if b is None:
+        return a
+    out = dict(a)
+    for k, v in b.items():
+        out.setdefault(k, v)
+    return out
+
+
+def _without(held, key):
+    out = dict(held)
+    out.pop(key, None)
+    return out

@@ -86,6 +86,14 @@ _UNTAINT_CALLS = {"len", "range", "isinstance", "getattr", "hasattr",
 
 _MAX_DEPTH = 2
 
+# wrappers that forward tracing to their first argument: resolving
+# through them lets `jax.jit(jax.shard_map(step, ...))` and the local
+# `mapped = jax.shard_map(...); return jax.jit(mapped)` idiom reach the
+# real body
+_WRAPPER_CALLS = {"jax.shard_map", "shard_map",
+                  "jax.experimental.shard_map.shard_map",
+                  "functools.partial", "partial"}
+
 
 def analyze(src: SourceFile) -> list[Finding]:
     if "jit" not in src.text:       # cheap pre-gate: nothing to resolve
@@ -202,10 +210,19 @@ class _ModuleIndex:
             fn = self._lookup(target.id, jit)
             if fn is not None:
                 return _resolved_from_def(fn, jit.static)
+            # local wrapper binding: `mapped = jax.shard_map(step, ...)`
+            # then `jax.jit(mapped, ...)`
+            bound = self._local_assign(target.id, jit)
+            if bound is not None and isinstance(bound, ast.Call) and \
+                    call_name(bound) in _WRAPPER_CALLS and bound.args:
+                return self._resolve_expr(bound.args[0], jit, depth + 1)
             return None
         if isinstance(target, ast.Call):
-            # factory pattern: jax.jit(self._build_step())
             name = call_name(target)
+            # transparent wrappers: jax.jit(jax.shard_map(step, ...))
+            if name in _WRAPPER_CALLS and target.args:
+                return self._resolve_expr(target.args[0], jit, depth + 1)
+            # factory pattern: jax.jit(self._build_step())
             if name is None:
                 return None
             base = name.split(".")[-1]
@@ -229,6 +246,21 @@ class _ModuleIndex:
             if fn is not None:
                 return fn
         return self.defs.get((None, name))
+
+    def _local_assign(self, name, jit: _JitCall):
+        """The value last assigned to `name` in the jit call's enclosing
+        function, if it is a plain single-target assignment."""
+        if jit.func is None:
+            return None
+        found = None
+        for node in ast.walk(jit.func):
+            if isinstance(node, ast.Assign) and \
+                    len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name) and \
+                    node.targets[0].id == name and \
+                    node.lineno <= jit.call.lineno:
+                found = node.value
+        return found
 
     def _returned_function(self, fn):
         """The FunctionDef/Lambda a factory returns, if statically

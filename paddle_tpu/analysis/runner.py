@@ -24,8 +24,8 @@ import hashlib
 import json
 import os
 
-from . import clocks, flags_metrics, interlock, jit_safety, \
-    lock_discipline
+from . import clocks, dtype_flow, effects, flags_metrics, interlock, \
+    jit_safety, lock_discipline, shard_safety
 from .core import Finding, SourceFile, _suppression_map
 
 __all__ = ["ALL_RULES", "run", "iter_files"]
@@ -36,6 +36,9 @@ ALL_RULES.update(lock_discipline.RULES)
 ALL_RULES.update(interlock.RULES)
 ALL_RULES.update(flags_metrics.RULES)
 ALL_RULES.update(clocks.RULES)
+ALL_RULES.update(effects.RULES)
+ALL_RULES.update(dtype_flow.RULES)
+ALL_RULES.update(shard_safety.RULES)
 ALL_RULES["parse-error"] = "file failed to parse"
 
 _SKIP_DIRS = {"__pycache__", "lint_fixtures", ".git", ".lint_cache"}
@@ -218,6 +221,9 @@ def run(paths, root=None, rules=None, cache=True) -> list[Finding]:
         file_findings.extend(interlock.analyze(src))
         file_findings.extend(fm.check(src))
         file_findings.extend(clocks.analyze(src))
+        file_findings.extend(effects.analyze(src))
+        file_findings.extend(dtype_flow.analyze(src))
+        file_findings.extend(shard_safety.analyze(src))
         findings.extend(file_findings)
 
         if cache_obj is not None:

@@ -219,9 +219,10 @@ class Tracer:
                     parent=None, attributes: dict | None = None) -> Span:
         """Record an already-measured interval (RecordEvent capture,
         sampling sections) without the context-manager machinery."""
+        start_f, end_f = float(start), float(end)    # before the span
         span = self.start_span(name, parent=parent, attributes=attributes)
-        span.start = float(start)
-        span.end(float(end))
+        span.start = start_f
+        span.end(end_f)
         return span
 
     def current_span(self) -> Span | None:

@@ -172,8 +172,12 @@ class ServingClient:
         span = _tracing.tracer().start_span(
             "client.completion",
             attributes={"address": self.address, "stream": bool(stream)})
-        hdrs = {_tracing.TRACEPARENT_HEADER:
-                _tracing.format_traceparent(span.context)}
+        try:
+            hdrs = {_tracing.TRACEPARENT_HEADER:
+                    _tracing.format_traceparent(span.context)}
+        except BaseException:
+            span.end()
+            raise
         if not stream:
             try:
                 return self.request("POST", "/v1/completions", body,

@@ -340,10 +340,17 @@ class Engine:
             req.root_span = tr.start_span("request", attributes=attrs)
         req.queue_span = tr.start_span("scheduler.queue_wait",
                                        parent=req.root_span)
-        _obs.flight("engine", "submit", req=req.id,
-                    prompt_len=int(req.prompt.size),
-                    trace=req.root_span.trace_id)
-        self.scheduler.submit(req)
+        try:
+            _obs.flight("engine", "submit", req=req.id,
+                        prompt_len=int(req.prompt.size),
+                        trace=req.root_span.trace_id)
+            self.scheduler.submit(req)
+        except BaseException:
+            # a rejected submit (queue full, shutdown race) must not
+            # leave the request's spans open in the tracer ring
+            req.queue_span.end()
+            req.root_span.end()
+            raise
         return req
 
     # -------------------------------------------------------- main loop

@@ -1,0 +1,8 @@
+"""BAD: literal-axis collective with no shard_map mapping it
+(collective-outside-shardmap)."""
+import jax
+
+
+@jax.jit
+def reduce_loss(local_loss):
+    return jax.lax.psum(local_loss, "tp")   # "tp" is unbound under jit

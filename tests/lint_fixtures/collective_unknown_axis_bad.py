@@ -1,0 +1,13 @@
+"""BAD: collective on an axis the mapping shard_map does not bind
+(collective-unknown-axis)."""
+import jax
+from jax.sharding import PartitionSpec as P
+
+
+def all_reduce(xs, mesh):
+    def body(x):
+        return jax.lax.psum(x, "dp")        # mapping binds only "tp"
+
+    return jax.shard_map(body, mesh=mesh, in_specs=P("tp"),
+                         out_specs=P("tp"),
+                         axis_names=frozenset({"tp"}))(xs)

@@ -1,0 +1,12 @@
+"""GOOD twin: the collective uses the axis the mapping binds."""
+import jax
+from jax.sharding import PartitionSpec as P
+
+
+def all_reduce(xs, mesh):
+    def body(x):
+        return jax.lax.psum(x, "tp")
+
+    return jax.shard_map(body, mesh=mesh, in_specs=P("tp"),
+                         out_specs=P("tp"),
+                         axis_names=frozenset({"tp"}))(xs)

@@ -1,0 +1,8 @@
+"""BAD: pages freed only on the happy path (effect-leak-on-raise)."""
+
+
+def prefill(blocks, model, req):
+    pages = blocks.allocate_seq(req.id, req.prompt_len)
+    out = model.forward(req.prompt, pages)      # may raise: pages leak
+    blocks.free_seq(req.id)
+    return out

@@ -1,0 +1,9 @@
+"""GOOD twin: the pages are freed on every outgoing path."""
+
+
+def prefill(blocks, model, req):
+    pages = blocks.allocate_seq(req.id, req.prompt_len)
+    try:
+        return model.forward(req.prompt, pages)
+    finally:
+        blocks.free_seq(req.id)

@@ -1,0 +1,11 @@
+"""BAD: gauge inc'd but the dec is skipped on early return
+(gauge-unpaired)."""
+
+
+def admit(gauge_inflight, queue, req):
+    gauge_inflight.inc()
+    if queue.full():
+        return None             # inflight never comes back down
+    queue.put(req)
+    gauge_inflight.dec()
+    return req

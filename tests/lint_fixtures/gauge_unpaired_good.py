@@ -1,0 +1,12 @@
+"""GOOD twin: the dec runs in a finally, so every path restores it."""
+
+
+def admit(gauge_inflight, queue, req):
+    gauge_inflight.inc()
+    try:
+        if queue.full():
+            return None
+        queue.put(req)
+        return req
+    finally:
+        gauge_inflight.dec()

@@ -1,0 +1,23 @@
+"""BAD variant: the PR-10 speculative-verify promotion, factory form.
+
+Lifted from the speculative-decoding verify step: the acceptance-mask
+``cumprod().sum()`` promoted to int64 under ``jax_enable_x64``, shifting
+the traced avals between hosts and silently retracing every step — only
+the perf-gate trace counter caught it.  The jit target here is a factory
+closure (``jax.jit(build_verify())``), the same shape the runner uses.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def build_verify():
+    def verify(tokens, draft, active):
+        ok = (draft == tokens[:, None]).astype(jnp.int32)
+        m = ok * active[:, None].astype(jnp.int32)
+        acc = jnp.cumprod(m, axis=1).sum(axis=1)    # int64 under x64
+        return acc
+
+    return verify
+
+
+verify_fn = jax.jit(build_verify())

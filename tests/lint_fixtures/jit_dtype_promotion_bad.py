@@ -1,0 +1,9 @@
+"""BAD: narrow-int reduction with no cast-back (jit-dtype-promotion)."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def accepted_counts(draft, out):
+    m = (draft == out).astype(jnp.int32)
+    return jnp.cumprod(m, axis=1).sum(axis=1)   # int64 under x64

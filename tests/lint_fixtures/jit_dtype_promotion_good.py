@@ -1,0 +1,9 @@
+"""GOOD twin: the reduction chain casts back to int32 explicitly."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def accepted_counts(draft, out):
+    m = (draft == out).astype(jnp.int32)
+    return jnp.cumprod(m, axis=1).sum(axis=1).astype(jnp.int32)

@@ -1,0 +1,10 @@
+"""BAD: numpy constant with host-default dtype inside a jitted body
+(jit-np-constant)."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def add_bias(x):
+    bias = np.arange(8)         # int64 on host, baked into the trace
+    return x + bias

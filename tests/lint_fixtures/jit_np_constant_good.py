@@ -1,0 +1,9 @@
+"""GOOD twin: the constant carries a narrow dtype explicitly."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def add_bias(x):
+    bias = np.arange(8, dtype=np.int32)
+    return x + bias

@@ -1,0 +1,9 @@
+"""BAD: python float weak-promotes an int32 operand (jit-weak-scalar)."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step_penalty(active):
+    mask = active.astype(jnp.int32)
+    return mask * 0.5 + 1       # float (float64 under x64)

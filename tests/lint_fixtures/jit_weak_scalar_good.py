@@ -1,0 +1,9 @@
+"""GOOD twin: the operand is cast before mixing with the scalar."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step_penalty(active):
+    mask = active.astype(jnp.float32)
+    return mask * 0.5 + 1

@@ -1,0 +1,8 @@
+"""BAD: span abandoned when the handler raises (span-unclosed)."""
+
+
+def handle_request(tracer, handler, req):
+    span = tracer.start_span("server.request")
+    resp = handler(req)         # may raise: the span never ends
+    span.end()
+    return resp

@@ -1,0 +1,7 @@
+"""GOOD twin: the span is a context manager, closed on every path."""
+
+
+def handle_request(tracer, handler, req):
+    span = tracer.start_span("server.request")
+    with span:
+        return handler(req)

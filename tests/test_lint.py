@@ -15,6 +15,8 @@ from __future__ import annotations
 import importlib.util
 import json
 import os
+import shutil
+import subprocess
 import sys
 import time
 
@@ -30,14 +32,18 @@ from paddle_tpu.analysis import (ALL_RULES, Finding, load_baseline,  # noqa: E40
                                  partition, run)
 
 
-def _lint_main():
-    """tools/lint.py's main(), loaded in-process (tools/ is not a
+def _load_tool(name):
+    """A tools/*.py module, loaded in-process (tools/ is not a
     package)."""
     spec = importlib.util.spec_from_file_location(
-        "_tpu_lint_cli", os.path.join(REPO, "tools", "lint.py"))
+        f"_tpu_{name}_cli", os.path.join(REPO, "tools", f"{name}.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    return mod.main
+    return mod
+
+
+def _lint_main():
+    return _load_tool("lint").main
 
 
 def _fixture_cases():
@@ -158,6 +164,97 @@ def test_lint_cache_warm_run_is_fast():
         sorted((f.fingerprint, f.line) for f in cold)
 
 
+# ------------------------------------------------- new-analyzer semantics
+def _findings_for(tmp_path, source):
+    p = tmp_path / "snippet.py"
+    p.write_text(source)
+    return run([str(p)], root=str(tmp_path), cache=False)
+
+
+def test_effects_span_overwrite_is_flagged(tmp_path):
+    fs = _findings_for(tmp_path, (
+        "def f(tracer, work):\n"
+        "    span = tracer.start_span('a')\n"
+        "    span = tracer.start_span('b')\n"
+        "    span.end()\n"))
+    assert {f.rule for f in fs} == {"span-unclosed"}
+
+
+def test_effects_span_handoff_transfers_ownership(tmp_path):
+    # passing the span to a call (or closing over it) hands it off —
+    # the callee owns the .end(); the handoff must not be flagged
+    fs = _findings_for(tmp_path, (
+        "def f(tracer, sink, work):\n"
+        "    span = tracer.start_span('a')\n"
+        "    sink.attach(span)\n"
+        "    work()\n"))
+    assert fs == [], [f.render() for f in fs]
+
+
+def test_effects_handler_reraise_still_leaks(tmp_path):
+    # an except that re-raises without releasing is still a leak path
+    fs = _findings_for(tmp_path, (
+        "def f(gauge, work):\n"
+        "    gauge.inc()\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"
+        "        raise\n"
+        "    gauge.dec()\n"))
+    assert {f.rule for f in fs} == {"gauge-unpaired"}
+
+
+def test_effects_cross_function_transfer_is_silent(tmp_path):
+    # the scheduler-allocates / evict-frees ownership protocol: no
+    # release in the same function means the acquire is never armed
+    fs = _findings_for(tmp_path, (
+        "def schedule(blocks, req, model):\n"
+        "    blocks.allocate_seq(req.id, req.len)\n"
+        "    model.forward(req)\n"))
+    assert fs == [], [f.render() for f in fs]
+
+
+def test_resolver_sees_shard_map_wrapper(tmp_path):
+    # `mapped = jax.shard_map(step, ...); jax.jit(mapped)` — the TP
+    # runner's idiom — must resolve through to the real body
+    fs = _findings_for(tmp_path, (
+        "import jax\n"
+        "import numpy as np\n\n\n"
+        "def build(mesh, specs):\n"
+        "    def step(x):\n"
+        "        np.asarray(x)\n"
+        "        return x\n"
+        "    mapped = jax.shard_map(step, mesh=mesh, in_specs=specs,\n"
+        "                           out_specs=specs)\n"
+        "    return jax.jit(mapped, donate_argnums=(0,))\n"))
+    assert {f.rule for f in fs} == {"jit-host-sync"}
+
+
+def test_dtype_flow_fixed_runner_site_stays_clean():
+    # the PR-10 cumprod().sum() site, as fixed in-tree with
+    # .astype(jnp.int32), must not re-trip the promotion rule
+    fs = run(["paddle_tpu/serving/parallel/runner.py"], root=REPO)
+    assert not any(f.rule == "jit-dtype-promotion" for f in fs), \
+        [f.render() for f in fs]
+
+
+def test_shard_safety_scan_body_inherits_mapping(tmp_path):
+    # a def handed by reference to lax.scan from a mapped body runs in
+    # the mapped context (the llama_hybrid pipeline shape)
+    fs = _findings_for(tmp_path, (
+        "import jax\n\n\n"
+        "def trunk(xs, mesh):\n"
+        "    def per_device(x):\n"
+        "        def tick(carry, t):\n"
+        "            return jax.lax.ppermute(carry, 'pp', [(0, 1)]), t\n"
+        "        out, _ = jax.lax.scan(tick, x, None)\n"
+        "        return out\n"
+        "    return jax.shard_map(per_device, mesh=mesh,\n"
+        "                         in_specs=None, out_specs=None,\n"
+        "                         axis_names=frozenset({'pp'}))(xs)\n"))
+    assert fs == [], [f.render() for f in fs]
+
+
 # ------------------------------------------------------------------- CLI
 def test_cli_default_run_is_green(capsys):
     assert _lint_main()([]) == 0
@@ -260,3 +357,93 @@ def test_cli_json_output(capsys):
     assert rc == 1
     data = json.loads(out)
     assert [f["rule"] for f in data["findings"]] == ["metric-suffix"]
+
+
+# ------------------------------------------------------- --changed mode
+_GIT = shutil.which("git") is not None
+
+
+def _git(repo, *argv):
+    subprocess.run(["git", "-C", str(repo)] + list(argv), check=True,
+                   capture_output=True)
+
+
+@pytest.fixture
+def lint_repo(tmp_path, monkeypatch):
+    """A tiny git repo with one clean committed file, and tools/lint.py
+    re-rooted onto it."""
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "config", "user.email", "lint@test")
+    _git(tmp_path, "config", "user.name", "lint test")
+    (tmp_path / "clean.py").write_text(
+        "import time\n\n\ndef stamp():\n    return int(time.time())\n")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-q", "-m", "seed")
+    mod = _load_tool("lint")
+    monkeypatch.setattr(mod, "_REPO_ROOT", str(tmp_path))
+    return tmp_path, mod
+
+
+@pytest.mark.skipif(not _GIT, reason="needs git on PATH")
+def test_cli_changed_lints_only_diffed_files(lint_repo, capsys):
+    repo, mod = lint_repo
+    # clean tree: nothing differs from HEAD
+    assert mod.main([".", "--changed", "--no-baseline"]) == 0
+    assert "no .py files changed" in capsys.readouterr().out
+    # regress a committed file AND drop in an untracked bad file: both
+    # must be picked up; the clean committed file must not be linted
+    (repo / "clean.py").write_text(
+        "import time\n\n\ndef elapsed(t0):\n"
+        "    return time.time() - t0\n")
+    (repo / "fresh.py").write_text(
+        "import time\n\n\ndef deadline():\n"
+        "    return time.time() + 60\n")
+    assert mod.main([".", "--changed", "--no-baseline"]) == 1
+    out = capsys.readouterr().out
+    assert "clean.py" in out and "fresh.py" in out
+    # scoping still applies: a subdir scope excludes top-level files
+    sub = repo / "pkg"
+    sub.mkdir()
+    (sub / "ok.py").write_text("X = 1\n")
+    assert mod.main(["pkg", "--changed", "--no-baseline"]) == 0
+
+
+@pytest.mark.skipif(not _GIT, reason="needs git on PATH")
+def test_cli_changed_explicit_ref_and_cache(lint_repo, capsys):
+    repo, mod = lint_repo
+    (repo / "clean.py").write_text(
+        "import time\n\n\ndef elapsed(t0):\n"
+        "    return time.time() - t0\n")
+    _git(repo, "add", "-A")
+    _git(repo, "commit", "-q", "-m", "regress")
+    # vs HEAD the tree is clean; vs the first commit it is not
+    assert mod.main([".", "--changed", "--no-baseline"]) == 0
+    capsys.readouterr()
+    assert mod.main([".", "--changed", "HEAD~1", "--no-baseline"]) == 1
+    # warm .lint_cache run reports the same finding set
+    first = capsys.readouterr().out
+    assert mod.main([".", "--changed", "HEAD~1", "--no-baseline"]) == 1
+    assert capsys.readouterr().out == first
+    assert (repo / ".lint_cache").is_dir()
+
+
+@pytest.mark.skipif(not _GIT, reason="needs git on PATH")
+def test_cli_changed_bad_ref_is_usage_error(lint_repo, capsys):
+    repo, mod = lint_repo
+    assert mod.main([".", "--changed", "no-such-ref"]) == 2
+
+
+# ----------------------------------------------------------- check gate
+def test_check_cli_runs_lint_gate(capsys):
+    # lint-only pass over the repo (perf gate exercised by its own
+    # tier-1 tests; subprocessing it here would double its runtime)
+    assert _load_tool("check").main(["--no-perf"]) == 0
+    out = capsys.readouterr().out
+    assert "lint" in out and "all gates passed" in out
+
+
+def test_check_cli_propagates_failure(capsys):
+    # a failing step (lint usage error: bogus ref) fails the gate
+    assert _load_tool("check").main(
+        ["--no-perf", "--changed", "no-such-ref-anywhere"]) == 1
+    assert "FAIL" in capsys.readouterr().out

@@ -682,7 +682,8 @@ class TestServeBenchTrace:
                     sync_interval=1, prefix_cache=True, layers=1,
                     hidden=32, vocab=64, max_model_len=64,
                     metrics_dir="", trace="", seed=0, http=False,
-                    replicas=1)
+                    replicas=1, heads=4, kv_heads=2, mesh=None,
+                    spec_k=0, arrival="uniform")
         base.update(over)
         return SimpleNamespace(**base)
 

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""check: the one-shot local gate — lint + perf gate (+ optional tests).
+
+Runs each gate as a subprocess, prints a one-line verdict per step, and
+exits with a single combined status, so a pre-push hook is just::
+
+    python tools/check.py            # lint + perf gate
+    python tools/check.py --changed  # lint only files != HEAD (fast)
+    python tools/check.py --tests    # also run the fast pytest subset
+    python tools/check.py --no-perf  # lint only (e.g. on a laptop)
+
+Exit status: 0 when every selected step passes, 1 when any fails, 2 on
+usage errors.  Steps always all run (a lint failure does not hide a
+perf regression).  The pytest subset defaults to the analysis suite's
+own tests — pass an argument to ``--tests`` to run something else,
+e.g. ``--tests tests/test_serving.py``.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOLS = os.path.join(_REPO_ROOT, "tools")
+
+DEFAULT_TESTS = "tests/test_lint.py"
+
+
+def _step(name: str, cmd: list[str], env=None) -> tuple[str, int, float]:
+    t0 = time.perf_counter()
+    proc = subprocess.run(cmd, cwd=_REPO_ROOT, env=env)
+    return name, proc.returncode, time.perf_counter() - t0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="check.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="REF",
+                    help="pass through to lint.py --changed: lint only "
+                         "files differing from REF (default HEAD)")
+    ap.add_argument("--no-perf", action="store_true",
+                    help="skip the perf gate (tools/perf_gate.py)")
+    ap.add_argument("--tests", nargs="?", const=DEFAULT_TESTS,
+                    default=None, metavar="TARGET",
+                    help="also run a fast pytest subset "
+                         f"(default: {DEFAULT_TESTS})")
+    args = ap.parse_args(argv)
+
+    py = sys.executable
+    steps = []
+
+    lint_cmd = [py, os.path.join(_TOOLS, "lint.py")]
+    if args.changed is not None:
+        lint_cmd += ["--changed", args.changed]
+    steps.append(("lint", lint_cmd, None))
+
+    if not args.no_perf:
+        steps.append(("perf-gate",
+                      [py, os.path.join(_TOOLS, "perf_gate.py")], None))
+
+    if args.tests is not None:
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")   # the gate must not
+        # depend on an accelerator being free on the dev machine
+        steps.append(("pytest",
+                      [py, "-m", "pytest", "-q", "-p",
+                       "no:cacheprovider"] + args.tests.split(),
+                      env))
+
+    results = [_step(name, cmd, env) for name, cmd, env in steps]
+
+    print("\n" + "-" * 56)
+    failed = False
+    for name, rc, dt in results:
+        verdict = "ok" if rc == 0 else f"FAIL (exit {rc})"
+        print(f"  {name:<10} {verdict:<14} {dt:6.1f}s")
+        failed = failed or rc != 0
+    print("-" * 56)
+    print("check: " + ("FAILED" if failed else "all gates passed"))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

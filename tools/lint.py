@@ -7,6 +7,8 @@ Usage:
     python tools/lint.py --update-baseline   # accept current findings
     python tools/lint.py --list-rules        # rule ids + descriptions
     python tools/lint.py --rules jit-host-sync,lock-order-cycle ...
+    python tools/lint.py --changed           # only files != HEAD
+    python tools/lint.py --changed main      # only files != main
 
 Exit status is 0 when every finding is covered by the committed
 baseline (tools/lint_baseline.json), 1 when there are NEW findings, and
@@ -33,6 +35,38 @@ DEFAULT_BASELINE = os.path.join(_REPO_ROOT, "tools",
                                 "lint_baseline.json")
 
 
+def _changed_files(ref: str, scope_paths) -> list[str]:
+    """Repo-relative .py files differing from ``ref`` (plus untracked),
+    restricted to the lint scope.  The full baseline still applies —
+    unused entries are harmless."""
+    import subprocess
+    changed: set[str] = set()
+    cmds = [["git", "-C", _REPO_ROOT, "diff", "--name-only", ref, "--"],
+            ["git", "-C", _REPO_ROOT, "ls-files", "--others",
+             "--exclude-standard"]]
+    for cmd in cmds:
+        try:
+            out = subprocess.run(cmd, capture_output=True, text=True,
+                                 check=True).stdout
+        except (OSError, subprocess.CalledProcessError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            raise RuntimeError(
+                f"--changed needs git ({detail.strip()})") from e
+        changed.update(l.strip() for l in out.splitlines() if l.strip())
+    scope = [p.rstrip("/").replace(os.sep, "/") for p in scope_paths]
+    everything = any(s in (".", "") for s in scope)
+    out_paths = []
+    for rel in sorted(changed):
+        if not rel.endswith(".py"):
+            continue
+        if not everything and not any(
+                rel == s or rel.startswith(s + "/") for s in scope):
+            continue
+        if os.path.exists(os.path.join(_REPO_ROOT, rel)):
+            out_paths.append(rel)   # deleted files have nothing to lint
+    return out_paths
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="lint.py", description=__doc__,
@@ -57,6 +91,13 @@ def main(argv=None) -> int:
     ap.add_argument("--no-cache", action="store_true",
                     help="bypass the per-file result cache "
                          "(.lint_cache/)")
+    ap.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="REF",
+                    help="lint only .py files that differ from the "
+                         "given git ref (default HEAD), plus untracked "
+                         "ones, restricted to the selected paths — "
+                         "with the warm cache this is the sub-second "
+                         "pre-commit loop")
     ap.add_argument("--list-rules", action="store_true",
                     help="list rule ids and exit")
     args = ap.parse_args(argv)
@@ -71,6 +112,16 @@ def main(argv=None) -> int:
     if args.rules:
         rules = [r.strip() for r in args.rules.split(",") if r.strip()]
     paths = args.paths or DEFAULT_PATHS
+
+    if args.changed is not None:
+        try:
+            paths = _changed_files(args.changed, paths)
+        except RuntimeError as e:
+            print(f"lint.py: {e}", file=sys.stderr)
+            return 2
+        if not paths:
+            print(f"no .py files changed vs {args.changed}")
+            return 0
 
     try:
         findings = run(paths, root=_REPO_ROOT, rules=rules,
