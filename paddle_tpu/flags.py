@@ -181,6 +181,28 @@ define_flag("FLAGS_obs_fleet_window", 32,
             "recent time-series points each replica publishes per "
             "series in its GET /debug/fleet summary (the router and "
             "the dashboard consume these windows)")
+define_flag("FLAGS_serving_prefill_chunk", 0,
+            "chunked prefill: split admission prefill into chunks of at "
+            "most N prompt tokens, interleaved with decode steps so one "
+            "long prompt cannot stall every decoding slot's TPOT (chunk "
+            "K attends chunks 1..K-1 through the cached-prefill jit — "
+            "no new traced program; 0 = whole-prompt prefill; "
+            "create_engine/serve --prefill-chunk overrides)")
+define_flag("FLAGS_serving_preempt", True,
+            "priority preempt-and-swap: when a higher-priority request "
+            "cannot be placed, evict the lowest-priority most-recently-"
+            "admitted resident, spill its exclusive KV pages to host "
+            "RAM, and re-queue it for a parity-preserving resume "
+            "(False = strict FCFS within the priority order)")
+define_flag("FLAGS_serving_shed_max_priority", 0,
+            "burn-rate load shedding only rejects requests with "
+            "priority <= this class (higher classes are admitted even "
+            "while shedding; used with FLAGS_serving_shed_burn_rate)")
+define_flag("FLAGS_serving_host_pages", 4096,
+            "capacity of the BlockManager host-RAM spill tier in KV "
+            "pages: preempted requests' exclusive pages park here "
+            "(content-addressed, LRU) and unpark on resume without "
+            "recomputing prefill (0 disables spilling to host)")
 define_flag("FLAGS_sanitizer", False,
             "enable the runtime concurrency sanitizer: serving/"
             "observability locks become instrumented wrappers that "

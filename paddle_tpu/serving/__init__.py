@@ -20,13 +20,24 @@ charged pages only for their uncached suffix.  ``sync_interval=N``
 batches host synchronization on the greedy path: decode state lives on
 device and the host drains a sampled-token ring once every N steps.
 
+Under overload the stack degrades in a defined order instead of all at
+once: long prefills chunk in behind decode
+(``FLAGS_serving_prefill_chunk``), low-priority decoding residents
+preempt-and-swap their KV to a pinned-host tier and resume with greedy
+token-for-token parity (``FLAGS_serving_preempt``; ``submit`` takes
+``priority=``), and burn-rate shedding 429s the lowest queued class —
+see README "Overload handling".
+
 Modules:
   * request.py       — request lifecycle + streaming
   * block_manager.py — KV pages: free list / block tables / prefix
                        cache (refcounts, chain index, CoW, LRU)
-  * scheduler.py     — FCFS admission, iteration-level eviction, drain
+  * scheduler.py     — priority admission (FIFO within class),
+                       iteration-level eviction, preempt-and-swap
+                       victim selection, drain
   * engine.py        — the prefill/decode driver (host scheduling,
-                       deferred host sync) over a parallel.ModelRunner
+                       deferred host sync, chunked prefill, preempted-
+                       KV spill/restore) over a parallel.ModelRunner
   * spec.py          — speculative decoding: prompt-lookup (n-gram)
                        drafter + acceptance bookkeeping; the runner's
                        verify program scores k+1 positions per step

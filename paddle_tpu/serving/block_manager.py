@@ -81,6 +81,21 @@ _M_PAGES_ALLOC = _obs.counter(
     "serving_pages_allocated_total",
     "fresh page acquisitions (free-list pops + LRU evictions; shared "
     "prefix-cache pages are not re-acquired)")
+_M_SPILLED = _obs.counter(
+    "serving_spilled_pages_total",
+    "KV pages copied device -> host RAM when a resident was preempted "
+    "for a higher-priority request")
+_M_RESTORED = _obs.counter(
+    "serving_restored_pages_total",
+    "host-parked KV pages copied back to device on preempted-request "
+    "resume (prefill skipped for those positions)")
+_M_SPILL_BYTES = _obs.counter(
+    "serving_spill_bytes_total",
+    "bytes of KV copied device -> host by preemption spills")
+_M_HOST_PARKED = _obs.gauge(
+    "serving_host_spill_pages",
+    "KV pages currently parked in the host-RAM spill tier "
+    "(content-addressed, LRU-bounded by FLAGS_serving_host_pages)")
 
 _ROOT = -1          # chain parent of the first chunk of every prompt
 
@@ -95,13 +110,18 @@ class BlockManager:
     """
 
     def __init__(self, num_pages: int, page_size: int,
-                 enable_prefix_cache: bool = False, faults=None):
+                 enable_prefix_cache: bool = False, faults=None,
+                 host_pages: int | None = None):
         if num_pages < 1:
             raise ValueError(f"num_pages must be >= 1, got {num_pages}")
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if host_pages is None:
+            from ..flags import FLAGS
+            host_pages = int(FLAGS.get("FLAGS_serving_host_pages") or 0)
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
+        self.host_pages = max(int(host_pages), 0)
         self.dump_page = self.num_pages       # pool row past the real pages
         self.prefix_cache = bool(enable_prefix_cache)
         self.faults = faults                  # chaos harness (None = off)
@@ -123,6 +143,16 @@ class BlockManager:
         self._tail_parent: dict[int, int] = {}    # tail page -> parent
         self._children: dict[int, set] = {}       # page -> cached children
         self._lru: OrderedDict[int, None] = OrderedDict()
+        # host spill tier (preempt-and-swap): content-addressed KV page
+        # copies keyed by the sha1 of the absolute token prefix they
+        # cover — under greedy causal attention a page's KV depends only
+        # on that prefix, so any sequence sharing it can unpark the copy
+        self._host: OrderedDict[str, tuple] = OrderedDict()
+        # chunked-prefill publish deferral: when the engine will prefill
+        # an admission in chunks of this many tokens, allocate_seq skips
+        # chain registration (the pages hold no KV yet) and the engine
+        # calls publish_seq once the last chunk has landed (0 = off)
+        self.defer_publish = 0
         # python-side mirrors of the serving_prefix_* metrics (stats())
         self.prefix_hits = 0
         self.prefix_misses = 0
@@ -130,6 +160,9 @@ class BlockManager:
         self.cow_copies = 0
         self.cached_tokens = 0
         self.pages_allocated = 0    # mirror of serving_pages_allocated_total
+        self.spilled_pages = 0      # mirror of serving_spilled_pages_total
+        self.restored_pages = 0     # mirror of serving_restored_pages_total
+        self.spill_bytes = 0        # mirror of serving_spill_bytes
         _M_PAGES_TOTAL.set(self.num_pages)
         self._update_pool_gauges()
 
@@ -263,9 +296,17 @@ class BlockManager:
         if cached_len:
             _M_PREFIX_TOKENS.inc(cached_len)
 
+        # chunked admissions defer registration: the fresh pages hold no
+        # KV until their chunk runs, and a concurrent admission matching
+        # them in the meantime would attend over unwritten pages —
+        # publish_seq re-runs the registration after the last chunk
+        deferred = bool(self.defer_publish
+                        and plen - cached_len > self.defer_publish)
+
         pages = matched + fresh
         self._tables[seq_id] = pages
-        self._meta[seq_id] = {"cached_len": cached_len, "cow_src": cow_src}
+        self._meta[seq_id] = {"cached_len": cached_len, "cow_src": cow_src,
+                              "deferred": deferred}
         self._commit[seq_id] = {"committed": plen, "floor": plen,
                                 "capacity": total * ps}
         _obs.flight("blocks", "alloc_seq", seq=seq_id, pages=len(pages),
@@ -275,6 +316,8 @@ class BlockManager:
         # register this prompt's fresh full chunks (chain through any
         # page an identical chunk already cached)
         for c in range(m, full):
+            if deferred:
+                break
             key = (parent, prompt[c * ps:(c + 1) * ps])
             existing = self._index.get(key)
             if existing is not None:
@@ -288,7 +331,7 @@ class BlockManager:
         # register the partial tail (its prompt-token content is final:
         # decode writes only to later slots of the page)
         off = plen - full * ps
-        if off > 0:
+        if off > 0 and not deferred:
             tail_toks = prompt[full * ps:]
             tails = self._tails.setdefault(parent, {})
             if tail_toks not in tails.values():
@@ -300,12 +343,62 @@ class BlockManager:
         self._update_pool_gauges()
         return list(pages)
 
+    def publish_seq(self, seq_id: int, tokens):
+        """Deferred chain registration for a chunk-prefilled admission.
+
+        :meth:`allocate_seq` skips chain/tail registration when the
+        engine will prefill in chunks (``meta["deferred"]``); the
+        engine calls this once the last chunk has landed, passing
+        exactly the token prefix whose KV is now device-resident.
+        Idempotent and a no-op for non-deferred sequences."""
+        meta = self._meta.get(seq_id)
+        pages = self._tables.get(seq_id)
+        if (not self.prefix_cache or not meta or not pages
+                or not meta.pop("deferred", False)):
+            return
+        toks = tuple(int(t) for t in np.asarray(tokens).reshape(-1))
+        ps = self.page_size
+        full = min(len(toks) // ps, len(pages))
+        parent = _ROOT
+        for c in range(full):
+            key = (parent, toks[c * ps:(c + 1) * ps])
+            existing = self._index.get(key)
+            if existing is not None:
+                parent = existing
+                continue
+            page = pages[c]
+            if page in self._key_of or page in self._tail_parent:
+                parent = page         # already carries another key
+                continue
+            self._index[key] = page
+            self._key_of[page] = key
+            self._children.setdefault(parent, set()).add(page)
+            parent = page
+        off = len(toks) - full * ps
+        if off > 0 and full < len(pages):
+            page = pages[full]
+            tail_toks = toks[full * ps:]
+            tails = self._tails.setdefault(parent, {})
+            if (tail_toks not in tails.values()
+                    and page not in self._key_of
+                    and page not in self._tail_parent):
+                tails[page] = tail_toks
+                self._tail_parent[page] = parent
+                self._children.setdefault(parent, set()).add(page)
+        _obs.flight("blocks", "publish_seq", seq=seq_id,
+                    chunks=full, tail=off)
+        _M_CACHED_PAGES.set(self.cached_pages)
+
     def seq_meta(self, seq_id: int) -> dict:
         """The prefill plan recorded at admission: ``cached_len`` tokens
         already resident (prefill runs only the suffix) and ``cow_src``,
         the tail page to copy-on-write from (or None)."""
-        return dict(self._meta.get(seq_id,
-                                   {"cached_len": 0, "cow_src": None}))
+        meta = self._meta.get(seq_id)
+        if meta is None:
+            return {"cached_len": 0, "cow_src": None}
+        # "deferred" is internal publish bookkeeping, not plan state
+        return {"cached_len": meta["cached_len"],
+                "cow_src": meta["cow_src"]}
 
     def free_seq(self, seq_id: int):
         """Release ``seq_id``'s pages (idempotent).  Registered pages
@@ -408,6 +501,120 @@ class BlockManager:
         return {"cached_len": cached_len, "hits": matched,
                 "misses": full - matched}
 
+    # ------------------------------------ host spill tier (preemption)
+    def spill_digest(self, tokens, chunk: int) -> str:
+        """Content address of page ``chunk``'s KV: sha1 over the int32
+        bytes of the absolute token prefix the page covers.  Greedy
+        causal attention makes KV a pure function of that prefix, so
+        the digest is valid across sequences and across preempt/resume
+        cycles of the same request."""
+        ps = self.page_size
+        data = np.asarray(tokens, np.int32).reshape(-1)[:(chunk + 1) * ps]
+        return hashlib.sha1(data.tobytes()).hexdigest()
+
+    def spill_plan(self, seq_id: int, tokens) -> list:
+        """``(page, digest)`` pairs worth copying to host before
+        ``seq_id`` is preempted: exclusive (refcount-1) pages holding a
+        *complete* chunk of committed KV.  After a sync the device KV
+        covers positions ``0..len(tokens)-2`` (the last emitted token's
+        KV is written by the next decode step), so chunk ``c`` is
+        complete iff ``(c+1)*page_size <= len(tokens)-1``.  Shared
+        pages are skipped — they stay matchable through the chain
+        index; pages whose digest is already parked are skipped too
+        (content-addressed: the copy exists)."""
+        if self.host_pages <= 0:
+            return []
+        pages = self._tables.get(seq_id)
+        if not pages:
+            return []
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        full = max(0, (toks.size - 1) // self.page_size)
+        plan = []
+        for c in range(min(full, len(pages))):
+            page = pages[c]
+            if self._ref.get(page, 0) != 1:
+                continue
+            digest = self.spill_digest(toks, c)
+            if digest in self._host:
+                self._host.move_to_end(digest)
+                continue
+            plan.append((page, digest))
+        return plan
+
+    def host_put(self, digest: str, k, v):
+        """Park one page's KV in the host tier (LRU-bounded)."""
+        k = np.asarray(k)
+        v = np.asarray(v)
+        self._host[digest] = (k, v)
+        self._host.move_to_end(digest)
+        while len(self._host) > self.host_pages:
+            self._host.popitem(last=False)
+        nbytes = k.nbytes + v.nbytes
+        self.spilled_pages += 1
+        self.spill_bytes += nbytes
+        _M_SPILLED.inc()
+        _M_SPILL_BYTES.inc(nbytes)
+        _M_HOST_PARKED.set(len(self._host))
+
+    def host_probe(self, digest: str) -> bool:
+        return digest in self._host
+
+    @property
+    def host_parked(self) -> int:
+        """Pages currently parked in the host spill tier."""
+        return len(self._host)
+
+    def host_get(self, digest: str):
+        """The parked ``(k, v)`` for ``digest`` (LRU-touched), or None."""
+        entry = self._host.get(digest)
+        if entry is not None:
+            self._host.move_to_end(digest)
+        return entry
+
+    def host_discard(self, digests):
+        """Drop parked entries (failed-spill abort path)."""
+        for d in digests:
+            self._host.pop(d, None)
+        _M_HOST_PARKED.set(len(self._host))
+
+    def note_restored(self, n: int = 1):
+        """Account ``n`` host-parked pages copied back to device."""
+        self.restored_pages += n
+        _M_RESTORED.inc(n)
+
+    def release_preempted(self, seq_id: int, tokens):
+        """Release a preempted sequence's pages after its exclusive KV
+        was spilled to host.  With the prefix cache on, the complete
+        committed chunks are first (re-)registered in the chain index —
+        replay_plan-style, on the sequence's own pages — so they park
+        in the LRU instead of the free list and the resume admission
+        matches them without recomputing.  Partial tails are never
+        registered (past the prompt they hold generated tokens, which
+        admission-time tail matching must not see)."""
+        if self.prefix_cache and seq_id in self._tables:
+            pages = self._tables[seq_id]
+            toks = tuple(int(t)
+                         for t in np.asarray(tokens).reshape(-1))
+            ps = self.page_size
+            full = min(max(0, (len(toks) - 1) // ps), len(pages))
+            parent = _ROOT
+            for c in range(full):
+                key = (parent, toks[c * ps:(c + 1) * ps])
+                existing = self._index.get(key)
+                if existing is not None:
+                    parent = existing
+                    continue
+                page = pages[c]
+                if page in self._key_of:  # already carries another key
+                    parent = page
+                    continue
+                self._index[key] = page
+                self._key_of[page] = key
+                self._children.setdefault(parent, set()).add(page)
+                parent = page
+            _M_CACHED_PAGES.set(self.cached_pages)
+        self.free_seq(seq_id)
+
     # ------------------------------------- committed tokens (speculative)
     # Pages are reserved all-or-nothing at admission, so speculative
     # decoding never allocates mid-flight; what moves is the
@@ -488,6 +695,7 @@ class BlockManager:
         return {"live": live, "cached": cached, "free": free,
                 "total": self.num_pages,
                 "allocated_total": self.pages_allocated,
+                "host_parked": len(self._host),
                 "leak": self.num_pages - (live + cached + free)}
 
     def prefix_digest(self, max_entries: int = 64) -> dict:

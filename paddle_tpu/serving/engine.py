@@ -99,6 +99,11 @@ _M_PHASE_SECONDS = _obs.counter(
     "copies), 'decode' step dispatch, 'host_sync' blocking ring "
     "fetches — the resource tracker's tokens/s and MFU denominator",
     ("phase",))
+_M_CHUNKS = _obs.counter(
+    "serving_prefill_chunks_total",
+    "chunked-prefill jit calls: admission prefill split into "
+    "FLAGS_serving_prefill_chunk-token pieces interleaved with decode "
+    "steps (chunk K attends chunks 1..K-1 via the cached-prefill jit)")
 
 
 class NonFiniteLogitsError(ValueError):
@@ -139,7 +144,8 @@ class Engine:
                  enable_prefix_cache: bool = False,
                  sync_interval: int = 1, clock=time.monotonic,
                  slo=None, mesh=None, spec_k: int | None = None,
-                 faults=None):
+                 prefill_chunk: int | None = None,
+                 preempt: bool | None = None, faults=None):
         if model is not None:
             from ..framework.tensor import Tensor
             config = model.config
@@ -182,6 +188,13 @@ class Engine:
         else:
             self._proposer = None
             self._spec = None
+        if prefill_chunk is None:
+            prefill_chunk = int(
+                FLAGS.get("FLAGS_serving_prefill_chunk") or 0)
+        self.prefill_chunk = max(int(prefill_chunk), 0)
+        if preempt is None:
+            preempt = bool(FLAGS.get("FLAGS_serving_preempt"))
+        self.preempt = bool(preempt)
         # chaos harness: None (the default when FLAGS_serving_fault_plan
         # is empty) keeps every injection site to a single None test
         self.faults = fault_plan_from_flags() if faults is None else faults
@@ -190,8 +203,21 @@ class Engine:
             num_pages, self.page_size,
             enable_prefix_cache=self.enable_prefix_cache,
             faults=self.faults)
-        self.scheduler = Scheduler(self.blocks, self.max_slots)
+        # chunked admissions must not be cache-matchable until their KV
+        # has actually been written: the scheduler admits every queue
+        # head before the engine runs any prefill, so eager registration
+        # would let a same-pass admission attend over unwritten pages.
+        # allocate_seq defers registration past this many fresh tokens
+        # and the engine publishes after the last chunk lands.
+        self.blocks.defer_publish = self.prefill_chunk
+        self.scheduler = Scheduler(self.blocks, self.max_slots,
+                                   clock=self._clock,
+                                   preempt_enabled=self.preempt)
         self.scheduler._finalize = self._finalize
+        # preempt-and-swap: the scheduler picks the victim, the engine
+        # owns the device side (spill exclusive KV pages to the host
+        # tier, release the pages, park the slot)
+        self.scheduler._preempt = self._preempt
         # every eviction parks its slot — not just the length/eos path in
         # _emit.  A cancel/deadline eviction inside scheduler.schedule()
         # would otherwise leave the slot's table/pos pointing at freed
@@ -239,6 +265,17 @@ class Engine:
         self.decode_steps = 0       # mirror of serving_decode_steps_total
         self.host_syncs = 0         # ring fetches (1 per sync_interval)
         self.logit_fetches = 0      # [slots, V] transfers (sampling only)
+        # chunked prefill: in-flight admission prefills advanced one
+        # chunk per engine step — {slot: state dict} (see _begin_chunks)
+        self._chunking: dict[int, dict] = {}
+        self.prefill_chunks = 0     # mirror of serving_prefill_chunks_total
+        self.preemptions = 0        # successful preempt-and-swap spills
+        self.spill_aborts = 0       # preemptions aborted by a failed spill
+        # overload-degradation witness: the most prompt tokens prefilled
+        # between two decode steps — bounded by prefill_chunk when
+        # chunking is on, by the longest prompt when it is off
+        self._prefill_since_decode = 0
+        self.max_prefill_gap = 0
         # self-healing mirrors of serving_recovery_total
         self.recoveries = 0         # runner rebuilds (recover() calls)
         self.quarantines = 0        # requests failed in place
@@ -300,13 +337,17 @@ class Engine:
     # ----------------------------------------------------------- intake
     def submit(self, prompt, gen: GenerationConfig | None = None, *,
                deadline: float | None = None, on_token=None,
-               arrival_time: float | None = None, trace=None) -> Request:
+               arrival_time: float | None = None, trace=None,
+               priority: int = 0) -> Request:
         """``trace`` is an optional tracing.SpanContext (or Span) the
         request's root span is parented under — the server passes the
         extracted ``traceparent`` here so the engine-side spans join the
         caller's distributed trace.  Without it the root span inherits
-        the submitting thread's current span, if any."""
+        the submitting thread's current span, if any.  ``priority``
+        sets the scheduling class: higher admits first and (with
+        preemption enabled) may preempt lower-priority residents."""
         req = Request(prompt, gen, deadline=deadline, on_token=on_token,
+                      priority=priority,
                       arrival_time=(self._clock() if arrival_time is None
                                     else arrival_time))
         total = req.prompt.size + req.gen.max_new_tokens
@@ -360,14 +401,27 @@ class Engine:
         Returns whether any work happened."""
         now = self._clock()
         admitted = self.scheduler.schedule(now)
+        # chunk states registered by THIS step's admissions already ran
+        # their first chunk inside _prefill — snapshot the in-flight set
+        # first so each prefill advances exactly one chunk per step
+        inflight = list(self._chunking)
         for slot, req in admitted:
             self._prefill(slot, req)
+        advanced = 0
+        for slot in inflight:
+            if slot in self._chunking:      # evicted states drop out
+                self._advance_chunk(slot)
+                advanced += 1
         active = [i for i, r in enumerate(self.scheduler.slots)
                   if r is not None and r.state == RequestState.DECODE]
         if active:
             self._decode(active)
+        else:
+            # gap witness: nothing was decoding, so this step's prefill
+            # work starved no resident — the stall meter restarts
+            self._prefill_since_decode = 0
         self.progress += 1          # watchdog heartbeat
-        return bool(admitted) or bool(active)
+        return bool(admitted) or bool(active) or bool(advanced)
 
     def run_until_complete(self, max_steps: int | None = None):
         """Drive step() until no live or queued work remains."""
@@ -394,12 +448,33 @@ class Engine:
         if req.queue_span is not None:      # queue wait ends at admission
             req.queue_span.end()
             req.queue_span = None
+        if req.num_generated:
+            # re-admission of a preempted request: rebuild device KV
+            # from the prefix cache + host spill tier + a re-prefill of
+            # the remainder; no token is emitted
+            self._resume(slot, req)
+            return
         t0 = time.perf_counter()
         ps = self.page_size
         plen = req.prompt.size
         meta = self.blocks.seq_meta(req.id)
         cached = int(meta["cached_len"])
         row = self.blocks.table_row(req.id, self.table_width)
+        if self.prefill_chunk and plen - cached > self.prefill_chunk:
+            # chunked admission: CoW once up front, then one chunk per
+            # engine step so decoding slots keep stepping in between
+            try:
+                if meta["cow_src"] is not None:
+                    self.runner.copy_page(int(meta["cow_src"]),
+                                          int(row[cached // ps]))
+            except Exception as e:
+                self._note_phase("prefill", time.perf_counter() - t0)
+                self._quarantine(slot, req, e, self._clock())
+                return
+            req.num_cached_tokens = cached
+            self._note_phase("prefill", time.perf_counter() - t0)
+            self._begin_chunks(slot, req, req.prompt, cached, row)
+            return
         try:
             if meta["cow_src"] is not None:
                 # copy-on-write: duplicate the matching tail page into
@@ -419,6 +494,7 @@ class Engine:
                 logits = self.runner.prefill_cached(ids, suffix, cached,
                                                     row)
             req.num_cached_tokens = cached
+            self._note_gap(plen - cached)
             _M_HOST_SYNCS.labels("prefill").inc()
             logits_row = np.asarray(logits)[0]
             if (self.faults is not None
@@ -461,6 +537,265 @@ class Engine:
             self._proposer.register(req.id, req.prompt)
         self._emit(slot, req, tok, now)
 
+    # --------------------------------------------------- chunked prefill
+    def _note_gap(self, tokens: int):
+        """Account ``tokens`` prompt tokens prefilled since the last
+        decode step — the overload-degradation witness: chunking bounds
+        this by ``prefill_chunk``; without it one long prompt stalls
+        every decoding slot for its whole length."""
+        self._prefill_since_decode += int(tokens)
+        if self._prefill_since_decode > self.max_prefill_gap:
+            self.max_prefill_gap = self._prefill_since_decode
+
+    def _begin_chunks(self, slot: int, req: Request, ids_all, done: int,
+                      row, *, resume_tok: int | None = None):
+        """Arm chunked prefill for ``slot`` and run its first chunk:
+        ``ids_all`` past position ``done`` pages in ``prefill_chunk``
+        tokens at a time, one chunk per engine step.  ``resume_tok``
+        marks a preempted-request resume — the final chunk's logits are
+        discarded and decode re-enters with that token instead of
+        sampling a new one."""
+        self._chunking[slot] = {
+            "req": req, "ids": np.asarray(ids_all, np.int32).reshape(-1),
+            "done": int(done), "row": row, "resume_tok": resume_tok,
+            "chunks": 0, "t0": time.perf_counter()}
+        self._advance_chunk(slot)
+
+    def _advance_chunk(self, slot: int):
+        """Run ONE prefill chunk for an in-flight admission.  Chunk K
+        attends chunks 1..K-1 through the existing cached-prefill jit
+        (arbitrary non-aligned boundaries — no new traced program
+        shapes); intermediate chunks never fetch logits, so they cost
+        no host sync.  Between chunks the engine keeps decoding and
+        ``progress`` keeps heartbeating, so a long prompt neither
+        stalls resident TPOT nor trips the watchdog."""
+        st = self._chunking[slot]
+        req = st["req"]
+        ids_all = st["ids"]
+        n = int(ids_all.size)
+        done = st["done"]
+        this = min(self.prefill_chunk, n - done)
+        last = done + this >= n
+        ps = self.page_size
+        t0 = time.perf_counter()
+        try:
+            bucket = -(-this // ps) * ps
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :this] = ids_all[done:done + this]
+            if done == 0:
+                logits = self.runner.prefill(ids, this, st["row"])
+            else:
+                logits = self.runner.prefill_cached(ids, this, done,
+                                                    st["row"])
+            st["chunks"] += 1
+            self.prefill_chunks += 1
+            _M_CHUNKS.inc()
+            self._note_gap(this)
+            if not last:
+                st["done"] = done + this
+                self._note_phase("prefill", time.perf_counter() - t0)
+                _obs.flight("engine", "prefill_chunk", req=req.id,
+                            slot=slot, done=done + this, total=n)
+                return
+            if st["resume_tok"] is None:
+                # admission: the first output token samples from the
+                # final chunk's last-position logits
+                _M_HOST_SYNCS.labels("prefill").inc()
+                logits_row = np.asarray(logits)[0]
+                if (self.faults is not None
+                        and self.faults.check(
+                            "nan_logits", req=req.id, slot=slot,
+                            phase="prefill") is not None):
+                    logits_row = np.full_like(logits_row, np.nan)
+                tok = self._pick_token(req, logits_row)
+            else:
+                # resume: the last emitted token re-enters as the next
+                # decode input; the replay logits are discarded
+                tok = int(st["resume_tok"])
+        except Exception as e:
+            self._note_phase("prefill", time.perf_counter() - t0)
+            self._chunking.pop(slot, None)
+            self._quarantine(slot, req, e, self._clock())
+            return
+        self._chunking.pop(slot, None)
+        # the full chunked prefix is device-resident now — register it
+        # in the prefix-cache chain (deferred at allocate_seq)
+        self.blocks.publish_seq(req.id, ids_all)
+        now = self._clock()
+        self._note_phase("prefill", time.perf_counter() - t0)
+        _obs.tracer().record_span(
+            "engine.prefill", st["t0"], time.perf_counter(),
+            parent=req.root_span,
+            attributes={"req": req.id, "slot": slot,
+                        "chunks": st["chunks"],
+                        "cached_tokens": req.num_cached_tokens,
+                        "kind": "chunked",
+                        "resume": st["resume_tok"] is not None})
+        _obs.flight("engine", "prefill", req=req.id, slot=slot,
+                    chunks=st["chunks"], cached=req.num_cached_tokens)
+        self._enter_decode(slot, req, st["row"], n, tok, now)
+        if st["resume_tok"] is None:
+            self._ttft.observe(now - req.arrival_time)
+            if self._proposer is not None:
+                self._proposer.register(req.id, req.prompt)
+            self._emit(slot, req, tok, now)
+        elif self._proposer is not None:
+            self._proposer.register(req.id, np.append(ids_all, tok))
+
+    def _enter_decode(self, slot: int, req: Request, row, pos: int,
+                      tok: int, now: float):
+        """Flip an admitted request into decode: patch the slot mirrors
+        + the device row, open the decode span."""
+        self.table[slot] = row
+        self._pos[slot] = pos
+        self._tok[slot] = tok
+        self._active[slot] = 1
+        self._push_slot(slot)
+        req.state = RequestState.DECODE
+        if req.root_span is not None:
+            req.decode_span = _obs.tracer().start_span(
+                "engine.decode", parent=req.root_span,
+                attributes={"req": req.id, "slot": slot})
+
+    # -------------------------------------------------------- preemption
+    def _preempt(self, slot: int) -> bool:
+        """Scheduler callback behind preempt-and-swap: spill ``slot``'s
+        exclusive committed KV pages to the BlockManager host tier,
+        release its pages (complete chunks re-register in the prefix-
+        cache chain when the cache is on), and park the slot.  Returns
+        False — victim untouched, preemption aborted — when a page copy
+        fails (the ``spill_fail`` chaos site); parked copies from the
+        aborted attempt are discarded, so the pool census stays exact."""
+        req = self.scheduler.slots[slot]
+        if req is None or req.state != RequestState.DECODE:
+            return False
+        t0 = time.perf_counter()
+        tokens = req.resume_tokens()
+        parked: list[str] = []
+        for page, digest in self.blocks.spill_plan(req.id, tokens):
+            if (self.faults is not None
+                    and self.faults.check("spill_fail", req=req.id,
+                                          page=page) is not None):
+                self.blocks.host_discard(parked)
+                self.spill_aborts += 1
+                _obs.flight("engine", "spill_abort", req=req.id,
+                            slot=slot, page=page,
+                            parked_dropped=len(parked))
+                return False
+            k, v = self.runner.read_page(page)
+            self.blocks.host_put(digest, k, v)
+            parked.append(digest)
+        self.blocks.release_preempted(req.id, tokens)
+        self._park(slot)
+        self.preemptions += 1
+        if self._proposer is not None:
+            self._proposer.drop(req.id)  # resume re-registers history
+        if req.decode_span is not None:
+            req.decode_span.set_attribute("preempted", True)
+            req.decode_span.set_attribute("generated", req.num_generated)
+            req.decode_span.end()
+            req.decode_span = None
+        if req.root_span is not None:
+            # back to the queue: a fresh queue-wait span covers the
+            # time until re-admission
+            req.queue_span = _obs.tracer().start_span(
+                "scheduler.queue_wait", parent=req.root_span,
+                attributes={"resume": True})
+        _obs.tracer().record_span(
+            "engine.preempt_spill", t0, time.perf_counter(),
+            parent=req.root_span,
+            attributes={"req": req.id, "slot": slot,
+                        "pages": len(parked)})
+        _obs.flight("engine", "preempt_spill", req=req.id, slot=slot,
+                    pages=len(parked))
+        return True
+
+    def _resume(self, slot: int, req: Request):
+        """Re-admit a preempted request.  Its effective prompt is
+        prompt + generated-so-far; device KV rebuilds from, in order,
+        the prefix-cache match recorded at allocate_seq, the host spill
+        tier (page-granular, content-addressed), and a re-prefill of
+        whatever remains — then decode continues with the last emitted
+        token as the next input, token-for-token identical to an
+        uninterrupted greedy run (parity asserted in tests)."""
+        t0 = time.perf_counter()
+        ps = self.page_size
+        tokens = req.resume_tokens()
+        ids_all = tokens[:-1]
+        n = int(ids_all.size)
+        meta = self.blocks.seq_meta(req.id)
+        cached = min(int(meta["cached_len"]), n)
+        row = self.blocks.table_row(req.id, self.table_width)
+        restored = 0
+        try:
+            if meta["cow_src"] is not None:
+                # tail CoW page from the admission match: duplicate it
+                # before any writes land (same rule as fresh admission)
+                self.runner.copy_page(int(meta["cow_src"]),
+                                      int(row[cached // ps]))
+            else:
+                # host-tier unpark: extend coverage page by page past
+                # the cache match while parked complete chunks exist
+                while cached % ps == 0 and cached + ps <= n:
+                    c = cached // ps
+                    entry = self.blocks.host_get(
+                        self.blocks.spill_digest(tokens, c))
+                    if entry is None:
+                        break
+                    self.runner.write_page(int(row[c]), *entry)
+                    self.blocks.note_restored()
+                    restored += 1
+                    cached += ps
+        except Exception as e:
+            self._note_phase("prefill", time.perf_counter() - t0)
+            self._quarantine(slot, req, e, self._clock())
+            return
+        suffix = n - cached
+        tok = int(tokens[-1])
+        if self.prefill_chunk and suffix > self.prefill_chunk:
+            # a long replay suffix chunks exactly like a long prompt —
+            # resumes must not reintroduce the TPOT stall either
+            self._note_phase("prefill", time.perf_counter() - t0)
+            _obs.flight("engine", "resume", req=req.id, slot=slot,
+                        tokens=n, cached=cached, restored=restored,
+                        chunked=True)
+            self._begin_chunks(slot, req, ids_all, cached, row,
+                               resume_tok=tok)
+            return
+        try:
+            if suffix > 0:
+                bucket = -(-suffix // ps) * ps
+                ids = np.zeros((1, bucket), np.int32)
+                ids[0, :suffix] = ids_all[cached:]
+                if cached == 0:
+                    self.runner.prefill(ids, suffix, row)
+                else:
+                    self.runner.prefill_cached(ids, suffix, cached, row)
+                self._note_gap(suffix)
+            # the resume logits are discarded (the last token is
+            # already known) — no host sync happens here
+        except Exception as e:
+            self._note_phase("prefill", time.perf_counter() - t0)
+            self._quarantine(slot, req, e, self._clock())
+            return
+        # allocate_seq defers on plen while the chunk test above uses
+        # the replay suffix, so a resume can be deferred yet single-shot
+        # — publish here too (no-op when registration wasn't deferred)
+        self.blocks.publish_seq(req.id, ids_all)
+        now = self._clock()
+        self._note_phase("prefill", time.perf_counter() - t0)
+        self._enter_decode(slot, req, row, n, tok, now)
+        if self._proposer is not None:
+            self._proposer.register(req.id, tokens)
+        _obs.tracer().record_span(
+            "engine.resume", t0, time.perf_counter(),
+            parent=req.root_span,
+            attributes={"req": req.id, "slot": slot, "tokens": n,
+                        "cached_tokens": cached,
+                        "restored_pages": restored})
+        _obs.flight("engine", "resume", req=req.id, slot=slot,
+                    tokens=n, cached=cached, restored=restored)
+
     # ------------------------------------------------------------ decode
     def _decode(self, active: list[int]):
         if self.faults is not None:
@@ -491,6 +826,7 @@ class Engine:
         logits = self.runner.decode_step()
         self._note_phase("decode", time.perf_counter() - step_t0)
         self.decode_steps += 1
+        self._prefill_since_decode = 0      # gap witness: decode ran
         _M_STEPS.inc()
         self._pages_hist.observe(self.blocks.pages_in_use)
         for slot in active:
@@ -543,6 +879,7 @@ class Engine:
         self.runner.verify_step(draft_arr, dlen)
         self._note_phase("decode", time.perf_counter() - step_t0)
         self.decode_steps += 1
+        self._prefill_since_decode = 0      # gap witness: decode ran
         _M_STEPS.inc()
         self._spec.record_step()
         self._pages_hist.observe(self.blocks.pages_in_use)
@@ -714,6 +1051,9 @@ class Engine:
     def _park(self, slot: int):
         """Return a slot to the idle state: all writes/reads go to the
         dump page until the next admission."""
+        # an eviction mid-chunked-prefill abandons the chunk state (the
+        # pages are gone; the request was finalized by the scheduler)
+        self._chunking.pop(slot, None)
         self.table[slot] = self.blocks.empty_row(self.table_width)
         self._pos[slot] = 0
         self._tok[slot] = 0
@@ -944,6 +1284,15 @@ class Engine:
             "logit_fetches": self.logit_fetches,
             "decode_steps": self.decode_steps,
             "pages_allocated": b.pages_allocated,
+            "prefill_chunk": self.prefill_chunk,
+            "prefill_chunks": self.prefill_chunks,
+            "max_prefill_gap": self.max_prefill_gap,
+            "preemptions": self.preemptions,
+            "spill_aborts": self.spill_aborts,
+            "spilled_pages": b.spilled_pages,
+            "restored_pages": b.restored_pages,
+            "spill_bytes": b.spill_bytes,
+            "host_parked_pages": b.host_parked,
             "mesh_tp": self.tp,
             "timings": {k: round(v, 6) for k, v in self.timings.items()},
             "progress": self.progress,
@@ -989,6 +1338,10 @@ class Engine:
                 "pages_allocated": b.pages_allocated,
                 "recoveries": self.recoveries,
                 "quarantines": self.quarantines,
+                "prefill_chunks": self.prefill_chunks,
+                "preemptions": self.preemptions,
+                "spilled_pages": b.spilled_pages,
+                "restored_pages": b.restored_pages,
             },
         }
 
@@ -1016,7 +1369,9 @@ def create_engine(model, *, max_slots: int = 4, page_size: int = 64,
                   enable_prefix_cache: bool = False,
                   sync_interval: int = 1, clock=time.monotonic,
                   slo=None, mesh=None,
-                  spec_k: int | None = None, faults=None) -> Engine:
+                  spec_k: int | None = None,
+                  prefill_chunk: int | None = None,
+                  preempt: bool | None = None, faults=None) -> Engine:
     """`create_predictor`-style entry point: build a continuous-batching
     engine over a LlamaForCausalLM (or any model exposing ``config`` and
     ``functional_state()`` with the llama state-dict layout).
@@ -1034,6 +1389,16 @@ def create_engine(model, *, max_slots: int = 4, page_size: int = 64,
     all K+1 positions, committing the longest matching prefix plus a
     correction token.  Greedy outputs are token-for-token identical to
     ``spec_k=0``; the win is tokens-per-step > 1 on repetitive text.
+
+    ``prefill_chunk=N`` (default ``FLAGS_serving_prefill_chunk``)
+    splits admission prefill into N-token chunks interleaved with
+    decode steps — one long prompt can no longer stall every decoding
+    slot's TPOT; greedy outputs are token-for-token identical to
+    whole-prompt prefill.  ``preempt`` (default
+    ``FLAGS_serving_preempt``) enables priority preempt-and-swap:
+    when a higher-priority ``submit(..., priority=...)`` cannot be
+    placed, the lowest-priority most-recently-admitted resident spills
+    its KV to host RAM and re-queues for a parity-preserving resume.
 
     ``mesh`` selects the tensor-parallel mesh: an int / ``"tp=N"`` /
     1-tuple tp size (default: ``FLAGS_serving_mesh_tp``).  ``tp>1``
@@ -1055,4 +1420,5 @@ def create_engine(model, *, max_slots: int = 4, page_size: int = 64,
                   emit_logits=emit_logits,
                   enable_prefix_cache=enable_prefix_cache,
                   sync_interval=sync_interval, clock=clock, slo=slo,
-                  mesh=mesh, spec_k=spec_k, faults=faults)
+                  mesh=mesh, spec_k=spec_k, prefill_chunk=prefill_chunk,
+                  preempt=preempt, faults=faults)

@@ -32,6 +32,11 @@ site             seam
                  response bytes (connection reset)
 ``stream_hangup``  HTTP server: kill the socket mid-SSE after
                  ``sent`` streamed tokens (dead replica mid-stream)
+``spill_fail``   engine preemption: fail the device→host KV page copy
+                 of a preempt-and-swap spill — the preemption must
+                 abort cleanly (victim keeps its device pages and
+                 slot; pool census leak stays 0; params: ``req``,
+                 ``page`` match filters)
 ===============  ====================================================
 
 Every firing increments ``serving_fault_injected_total{site}`` and

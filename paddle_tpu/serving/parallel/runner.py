@@ -678,6 +678,32 @@ class ModelRunner:
             record_compile("copy_page", t0,
                            signature=f"pool={self.kpool.shape}")
 
+    def read_page(self, page: int):
+        """Device -> host copy of one KV page: ``(k, v)`` numpy arrays
+        of shape [L, kvh, page_size, hd] (full heads — shards gather
+        transparently on the mesh).  Preemption-spill only: this is a
+        host sync per call, never on the steady decode path."""
+        return (np.asarray(self.kpool[:, page]),
+                np.asarray(self.vpool[:, page]))
+
+    def write_page(self, page: int, k, v):
+        """Host -> device copy of one KV page (preempted-request resume
+        unparking a host-tier copy).  Eager per-call dispatch is fine —
+        this runs once per restored page at admission, not per step."""
+        kpool = self.kpool.at[:, page].set(
+            jnp.asarray(k, self.kpool.dtype))
+        vpool = self.vpool.at[:, page].set(
+            jnp.asarray(v, self.vpool.dtype))
+        if self.mesh is not None:
+            # pin the result back to the head-sharded pool layout so the
+            # next shard_map program sees the sharding it was traced for
+            from jax.sharding import NamedSharding
+            sh = NamedSharding(self.mesh, self._pool_pspec)
+            kpool = jax.device_put(kpool, sh)
+            vpool = jax.device_put(vpool, sh)
+        self.kpool = kpool
+        self.vpool = vpool
+
     def push_slot(self, slot: int, row: np.ndarray, pos: int, tok: int,
                   active: int):
         """Patch ONE slot's row of the device-resident decode state

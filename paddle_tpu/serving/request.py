@@ -45,7 +45,7 @@ class Request:
 
     def __init__(self, prompt, gen: GenerationConfig | None = None, *,
                  deadline: float | None = None, on_token=None,
-                 arrival_time: float | None = None):
+                 arrival_time: float | None = None, priority: int = 0):
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
@@ -57,6 +57,12 @@ class Request:
         self.prompt = prompt
         self.gen = gen
         self.deadline = deadline          # absolute, on the engine clock
+        # scheduling class: higher admits first and may preempt lower
+        # residents (server maps low/normal/high -> -1/0/1; any int works)
+        self.priority = int(priority)
+        # times this request was preempted (evicted for a higher class
+        # and re-queued for resume)
+        self.preemptions = 0
         self.on_token = on_token
         self.state = RequestState.QUEUED
         self.cancel_requested = False
@@ -85,6 +91,11 @@ class Request:
         self.arrival_time = time.monotonic() if arrival_time is None \
             else arrival_time
         self.admitted_at: float | None = None
+        # FIFO stamp assigned by the scheduler at FIRST submit; a
+        # preempted victim keeps it, so it re-queues ahead of later
+        # arrivals of its class (Request ids are construction order,
+        # which is not necessarily submission order)
+        self.arrival_seq: int | None = None
         self.first_token_at: float | None = None
         self.last_token_at: float | None = None
         self.finished_at: float | None = None
@@ -98,6 +109,19 @@ class Request:
 
     def is_finished(self) -> bool:
         return self.state in (RequestState.DONE, RequestState.CANCELLED)
+
+    def resume_tokens(self) -> np.ndarray:
+        """Prompt + tokens generated so far — the effective prompt a
+        preempted request re-prefills from on re-admission."""
+        if not self.output_tokens:
+            return self.prompt
+        return np.concatenate(
+            [self.prompt, np.asarray(self.output_tokens, np.int32)])
+
+    @property
+    def remaining_new_tokens(self) -> int:
+        """Generation budget left after any already-emitted tokens."""
+        return max(self.gen.max_new_tokens - self.num_generated, 1)
 
     def cancel(self):
         """Request cancellation.  Queued requests drop at the next

@@ -68,7 +68,18 @@ _M_HTTP_CANCELS = _obs.counter(
 _M_SLO_SHED = _obs.counter(
     "serving_slo_shed_total",
     "admissions refused (429) because an SLO dimension's burn rate "
-    "crossed FLAGS_serving_shed_burn_rate")
+    "crossed FLAGS_serving_shed_burn_rate, by priority class (only "
+    "classes <= FLAGS_serving_shed_max_priority are shed)", ("class",))
+
+# wire-level priority classes <-> scheduler integers; arbitrary ints
+# are also accepted in request bodies for finer-grained fleets
+_PRIORITY_NAMES = {"low": -1, "normal": 0, "high": 1}
+_PRIORITY_CLASS = {v: k for k, v in _PRIORITY_NAMES.items()}
+
+
+def _priority_class(priority: int) -> str:
+    """Metric label for a priority int (named classes stay readable)."""
+    return _PRIORITY_CLASS.get(int(priority), str(int(priority)))
 
 
 def _http_latency_hist():
@@ -112,6 +123,9 @@ class EngineWorker:
         self._idle_wait = float(idle_wait)
         # recent Request objects, newest last (introspection + tests)
         self.requests: deque[Request] = deque(maxlen=512)
+        # burn-rate sheds by priority class (mirror of
+        # serving_slo_shed_total; /debug/fleet's scheduling block)
+        self.shed_by_class: dict[str, int] = {}
         self._stall_until = 0.0     # inject_stall test hook
         self._thread = threading.Thread(
             target=self._loop, name="engine-worker", daemon=True)
@@ -163,14 +177,18 @@ class EngineWorker:
 
     def submit(self, prompt, gen: GenerationConfig | None = None, *,
                timeout_s: float | None = None, on_token=None,
-               trace=None) -> Request:
+               trace=None, priority: int = 0) -> Request:
         """Thread-safe admission with backpressure: raises
         :class:`DrainingError` / :class:`BackpressureError` instead of
         queueing unboundedly; ``timeout_s`` becomes an absolute engine
         deadline (the existing cancel machinery enforces it).  ``trace``
         (a tracing.SpanContext) parents the engine-side request spans —
         the handler passes its ``server.request`` span context so the
-        trace survives the hop onto the engine thread."""
+        trace survives the hop onto the engine thread.  ``priority``
+        is the scheduling class: burn-rate shedding only rejects
+        classes <= ``FLAGS_serving_shed_max_priority``, and higher
+        classes may preempt lower residents inside the engine."""
+        priority = int(priority)
         with self._wake:
             if self.engine.scheduler.draining:
                 raise DrainingError(
@@ -180,21 +198,30 @@ class EngineWorker:
                     f"admission queue full ({self.max_queue} waiting)")
             # SLO-driven shedding: refuse BEFORE the queue fills when
             # the live burn rate says admitted requests are already
-            # missing their targets (429 + Retry-After, like queue-full)
+            # missing their targets (429 + Retry-After, like queue-full).
+            # Only the shedable classes are refused — high-priority
+            # traffic keeps flowing and relies on preemption for room.
             shed = float(FLAGS.get("FLAGS_serving_shed_burn_rate") or 0.0)
-            if shed > 0 and self.engine.slo is not None:
+            shed_max = int(
+                FLAGS.get("FLAGS_serving_shed_max_priority") or 0)
+            if shed > 0 and self.engine.slo is not None \
+                    and priority <= shed_max:
                 burn = self.engine.slo.max_burn_rate()
                 if burn >= shed:
-                    _M_SLO_SHED.inc()
+                    cls = _priority_class(priority)
+                    _M_SLO_SHED.labels(cls).inc()
+                    self.shed_by_class[cls] = \
+                        self.shed_by_class.get(cls, 0) + 1
                     _obs.flight("server", "slo_shed", burn=round(burn, 3),
-                                threshold=shed)
+                                threshold=shed, priority=priority)
                     raise BackpressureError(
                         f"SLO burn rate {burn:.2f} at/over shed "
                         f"threshold {shed:g}")
             deadline = (None if timeout_s is None
                         else self.engine._clock() + float(timeout_s))
             req = self.engine.submit(prompt, gen, deadline=deadline,
-                                     on_token=on_token, trace=trace)
+                                     on_token=on_token, trace=trace,
+                                     priority=priority)
             self.requests.append(req)
             self._wake.notify_all()
         return req
@@ -238,9 +265,30 @@ class EngineWorker:
 
 
 # --------------------------------------------------------------- protocol
+def _parse_priority(value) -> int:
+    """Priority from a body field or header: a named class
+    (low/normal/high) or any int.  Raises ValueError otherwise."""
+    if isinstance(value, str):
+        name = value.strip().lower()
+        if name in _PRIORITY_NAMES:
+            return _PRIORITY_NAMES[name]
+        try:
+            return int(name)
+        except ValueError:
+            raise ValueError(
+                f"invalid 'priority' {value!r}: use low/normal/high "
+                "or an integer") from None
+    if isinstance(value, bool) or not isinstance(value, int):
+        raise ValueError(
+            f"invalid 'priority' {value!r}: use low/normal/high or "
+            "an integer")
+    return int(value)
+
+
 def _parse_completion(body: dict):
     """Validate a /v1/completions body -> (prompt, gen, stream,
-    timeout_s).  Raises ValueError with a client-facing message."""
+    timeout_s, priority).  Raises ValueError with a client-facing
+    message."""
     if not isinstance(body, dict):
         raise ValueError("request body must be a JSON object")
     prompt = body.get("prompt")
@@ -272,7 +320,9 @@ def _parse_completion(body: dict):
         timeout_s = float(timeout_s)
         if timeout_s <= 0:
             raise ValueError("'timeout' must be > 0 seconds")
-    return prompt, gen, bool(body.get("stream", False)), timeout_s
+    priority = _parse_priority(body.get("priority", 0))
+    return prompt, gen, bool(body.get("stream", False)), timeout_s, \
+        priority
 
 
 _FINISH_REASON = {"length": "length", "eos": "stop",
@@ -457,6 +507,16 @@ class ServingServer(ThreadingHTTPServer):
             recovery = {"recoveries": eng.recoveries,
                         "quarantines": eng.quarantines,
                         "replayed_requests": eng.replayed_requests}
+            scheduling = {"prefill_chunk": eng.prefill_chunk,
+                          "prefill_chunks": eng.prefill_chunks,
+                          "max_prefill_gap": eng.max_prefill_gap,
+                          "preemptions": eng.preemptions,
+                          "spill_aborts": eng.spill_aborts,
+                          "spilled_pages": b.spilled_pages,
+                          "restored_pages": b.restored_pages,
+                          "spill_bytes": b.spill_bytes,
+                          "host_parked_pages": b.host_parked,
+                          "shed_by_class": dict(worker.shed_by_class)}
             draining = eng.scheduler.draining
         # raw cumulative latency buckets, not quantiles: consumers
         # (dashboard, router) merge buckets ACROSS replicas and then
@@ -478,7 +538,8 @@ class ServingServer(ThreadingHTTPServer):
                 "address": self.address, "draining": draining,
                 "pool": pool, "prefix": prefix, "slots": slots,
                 "queue": queue, "slo": slo, "spec": spec,
-                "recovery": recovery, "latency": latency,
+                "recovery": recovery, "scheduling": scheduling,
+                "latency": latency,
                 "watchdog": self.watchdog.state(),
                 "alerts": ({"firing": ts.firing(),
                             "fired_total": ts.alerts_fired,
@@ -635,17 +696,26 @@ class _Handler(BaseHTTPRequestHandler):
             span.set_attribute("status", 400)
             return self._error(400, "invalid JSON body", route)
         try:
-            prompt, gen, stream, timeout_s = _parse_completion(body)
+            prompt, gen, stream, timeout_s, priority = \
+                _parse_completion(body)
+            # the X-Priority header overrides the body (gateways tag
+            # traffic classes without rewriting payloads)
+            hdr = self.headers.get("X-Priority")
+            if hdr is not None:
+                priority = _parse_priority(hdr)
         except (ValueError, TypeError) as e:
             _M_HTTP_REJECT.labels("invalid").inc()
             span.set_attribute("status", 400)
             return self._error(400, str(e), route)
         span.set_attribute("stream", stream)
+        if priority:
+            span.set_attribute("priority", priority)
 
         toks: queue.Queue = queue.Queue()
         try:
             req = self.server.worker.submit(
                 prompt, gen, timeout_s=timeout_s, trace=span.context,
+                priority=priority,
                 on_token=lambda r, t: toks.put(int(t)))
         except DrainingError as e:
             _M_HTTP_REJECT.labels("draining").inc()
@@ -841,6 +911,15 @@ def _main(argv=None):
     ap.add_argument("--prefix-cache",
                     action=argparse.BooleanOptionalAction, default=True)
     ap.add_argument("--sync-interval", type=int, default=1)
+    ap.add_argument("--prefill-chunk", type=int, default=None,
+                    help="chunked prefill: at most N prompt tokens per "
+                    "engine step (0 = whole prompt; default "
+                    "FLAGS_serving_prefill_chunk)")
+    ap.add_argument("--preempt",
+                    action=argparse.BooleanOptionalAction, default=None,
+                    help="priority preempt-and-swap (default "
+                    "FLAGS_serving_preempt); requests pick a class via "
+                    "body 'priority' or the X-Priority header")
     ap.add_argument("--spec-k", type=int, default=None,
                     help="speculative decoding draft length (0 = off; "
                     "default FLAGS_serving_spec_k); greedy outputs are "
@@ -872,7 +951,9 @@ def _main(argv=None):
                    emit_logits=args.emit_logits,
                    enable_prefix_cache=args.prefix_cache,
                    sync_interval=args.sync_interval, mesh=args.mesh,
-                   spec_k=args.spec_k, start=False)
+                   spec_k=args.spec_k,
+                   prefill_chunk=args.prefill_chunk,
+                   preempt=args.preempt, start=False)
     server.install_signal_handlers()
     server.start()
     print(f"serving on http://{server.address} "

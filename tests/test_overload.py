@@ -1,0 +1,635 @@
+"""Graceful degradation under overload (ISSUE 14).
+
+Covers the three overload mechanisms end to end: chunked admission
+prefill (bounded decode gaps, greedy parity, no new traced shapes),
+priority preempt-and-swap through the BlockManager host spill tier
+(token-for-token parity for a preempted-spilled-resumed request, clean
+aborts under spill_fail injection, leak-free churn), and the priority
+scheduler itself (class ordering, victim selection, the drain-deadline
+has_work regression).  Plus the tooling seams: server priority
+parsing, serve_bench --priority-mix, and the metrics_report
+Scheduling section.
+"""
+import json
+import os
+import sys
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+from paddle_tpu.serving import (BlockManager, FaultPlan, GenerationConfig,
+                                Request, RequestState, Scheduler,
+                                create_engine)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ----------------------------------------------------- priority scheduler
+class TestPriorityScheduler:
+    def _req(self, plen, n_new, **kw):
+        return Request(np.arange(1, plen + 1),
+                       GenerationConfig(max_new_tokens=n_new), **kw)
+
+    def test_priority_order_fifo_within_class(self):
+        sched = Scheduler(BlockManager(num_pages=64, page_size=4), 3)
+        lo = self._req(4, 2, priority=-1)
+        n1 = self._req(4, 2)
+        hi = self._req(4, 2, priority=1)
+        n2 = self._req(4, 2)
+        for r in (lo, n1, hi, n2):
+            sched.submit(r)
+        admitted = [r for _, r in sched.schedule(now=0.0)]
+        # high first, then the normals in arrival order, low still queued
+        assert admitted == [hi, n1, n2]
+        assert list(sched.queue) == [lo]
+
+    def test_all_default_priority_is_plain_fcfs(self):
+        sched = Scheduler(BlockManager(num_pages=64, page_size=4), 2)
+        reqs = [self._req(4, 2) for _ in range(4)]
+        for r in reqs:
+            sched.submit(r)
+        admitted = [r for _, r in sched.schedule(now=0.0)]
+        assert admitted == reqs[:2]
+        assert list(sched.queue) == reqs[2:]
+
+    def test_preempt_picks_lowest_class_most_recent(self):
+        sched = Scheduler(BlockManager(num_pages=64, page_size=4), 2)
+        preempted = []
+        sched._preempt = lambda slot: preempted.append(slot) or True
+        lo_old = self._req(4, 4, priority=-1)
+        lo_new = self._req(4, 4, priority=-1)
+        sched.submit(lo_old)
+        sched.schedule(now=0.0)         # lo_old admitted first (older)
+        sched.submit(lo_new)
+        sched.schedule(now=1.0)
+        lo_old.state = lo_new.state = RequestState.DECODE
+        hi = self._req(4, 4, priority=1)
+        sched.submit(hi)
+        sched.schedule(now=2.0)
+        # victim = lowest class, most recently admitted = lo_new
+        assert preempted == [1]
+        assert hi.state == RequestState.PREFILL
+        assert lo_new.state == RequestState.QUEUED
+        assert lo_new.preemptions == 1
+        # the victim re-queued ahead of later arrivals of its class
+        assert list(sched.queue) == [lo_new]
+
+    def test_preempt_callback_false_leaves_victim(self):
+        sched = Scheduler(BlockManager(num_pages=64, page_size=4), 1)
+        sched._preempt = lambda slot: False
+        lo = self._req(4, 4, priority=-1)
+        sched.submit(lo)
+        sched.schedule(now=0.0)
+        lo.state = RequestState.DECODE
+        hi = self._req(4, 4, priority=1)
+        sched.submit(hi)
+        sched.schedule(now=1.0)
+        assert lo.state == RequestState.DECODE and lo.preemptions == 0
+        assert hi.state == RequestState.QUEUED
+
+    def test_preempt_never_targets_equal_or_higher_class(self):
+        sched = Scheduler(BlockManager(num_pages=64, page_size=4), 1)
+        sched._preempt = lambda slot: True
+        a = self._req(4, 4)
+        sched.submit(a)
+        sched.schedule(now=0.0)
+        a.state = RequestState.DECODE
+        b = self._req(4, 4)             # same class: no preemption
+        sched.submit(b)
+        sched.schedule(now=1.0)
+        assert a.state == RequestState.DECODE
+        assert b.state == RequestState.QUEUED
+
+    def test_has_work_drain_deadline_regression(self):
+        """Regression (satellite a): under drain, a queued request past
+        its deadline must keep has_work() True so the engine keeps
+        stepping and schedule() can expire it — previously has_work()
+        reported False for a non-empty queue under drain and queued
+        deadlines never fired."""
+        clock = [0.0]
+        sched = Scheduler(BlockManager(num_pages=16, page_size=4), 1,
+                          clock=lambda: clock[0])
+        queued = self._req(4, 2, deadline=5.0)
+        sched.submit(queued)
+        sched.drain()
+        assert not sched.has_work()     # queued, waiting for resume: idle
+        clock[0] = 10.0                 # deadline passed while draining
+        assert sched.has_work()
+        sched.schedule(now=clock[0])
+        assert queued.state == RequestState.CANCELLED
+        assert queued.finish_reason == "deadline"
+        assert not sched.queue
+        assert not sched.has_work()
+
+    def test_has_work_drain_cancel(self):
+        sched = Scheduler(BlockManager(num_pages=16, page_size=4), 1)
+        queued = self._req(4, 2)
+        sched.submit(queued)
+        sched.drain()
+        assert not sched.has_work()
+        queued.cancel()
+        assert sched.has_work()
+        sched.schedule(now=0.0)
+        assert queued.finish_reason == "cancelled"
+
+
+# ------------------------------------------------------- host spill tier
+class TestHostSpillTier:
+    def test_spill_digest_is_content_addressed(self):
+        bm = BlockManager(num_pages=8, page_size=4)
+        toks = list(range(1, 13))
+        d0 = bm.spill_digest(toks, 0)
+        assert d0 == bm.spill_digest(toks, 0)
+        assert d0 == bm.spill_digest(toks[:4] + [99, 98], 0)  # same chunk
+        assert d0 != bm.spill_digest(toks, 1)
+        assert d0 != bm.spill_digest([2] + toks[1:], 0)
+
+    def test_host_tier_lru_bound_probe_discard(self):
+        bm = BlockManager(num_pages=8, page_size=4, host_pages=2)
+        k = np.zeros((2, 4, 2, 8), np.float32)
+        bm.host_put("a", k, k)
+        bm.host_put("b", k, k)
+        assert bm.host_parked == 2
+        got = bm.host_get("a")          # get = LRU touch: "b" is oldest
+        assert got is not None and np.array_equal(got[0], k)
+        bm.host_put("c", k, k)          # bound 2: evicts LRU ("b")
+        assert bm.host_parked == 2
+        assert bm.host_probe("a") and bm.host_probe("c")
+        assert not bm.host_probe("b")
+        assert bm.host_get("missing") is None
+        bm.host_discard(["a", "c", "never-stored"])
+        assert bm.host_parked == 0
+        assert bm.pool_accounting()["host_parked"] == 0
+
+
+# ------------------------------------------------------------ engine runs
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(0)
+    cfg = llama_tiny(vocab_size=64, hidden_size=32, intermediate_size=64,
+                     num_hidden_layers=2, num_attention_heads=4,
+                     num_key_value_heads=2, max_position_embeddings=128)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def _engine(model, **kw):
+    kw.setdefault("page_size", 4)
+    kw.setdefault("sync_interval", 1)
+    kw.setdefault("max_model_len", 128)
+    return create_engine(model, **kw)
+
+
+def _run(eng, subs, steps_between=0):
+    """Submit (prompt, n_new[, priority]) tuples with optional engine
+    steps between submissions; drive to completion; return requests."""
+    reqs = []
+    for sub in subs:
+        prompt, n_new = sub[0], sub[1]
+        pri = sub[2] if len(sub) > 2 else 0
+        reqs.append(eng.submit(prompt, GenerationConfig(
+            max_new_tokens=n_new), priority=pri))
+        for _ in range(steps_between):
+            eng.step()
+    eng.run_until_complete(max_steps=600)
+    return reqs
+
+
+class TestChunkedPrefill:
+    def test_chunk_parity_and_counters_cache_off(self, tiny_model):
+        prompt = list(range(1, 41))
+        ref = _engine(tiny_model, max_slots=2,
+                      enable_prefix_cache=False, prefill_chunk=0)
+        (r_ref,) = _run(ref, [(prompt, 8)])
+        eng = _engine(tiny_model, max_slots=2,
+                      enable_prefix_cache=False, prefill_chunk=8)
+        (r,) = _run(eng, [(prompt, 8)])
+        assert r.finish_reason == "length"
+        assert r.output_tokens == r_ref.output_tokens
+        assert eng.prefill_chunks == 5          # 40 tokens / chunk 8
+        assert eng.decode_traces == 1
+        assert ref.prefill_chunks == 0
+
+    def test_gap_bounded_behind_decoding_resident(self, tiny_model):
+        """The head-of-line-blocking witness: a 40-token admission
+        behind a decoding resident stalls decode for the full prompt
+        unchunked, but only ever for one chunk with chunking on."""
+        long_prompt = list(range(1, 41))
+
+        def drive(chunk):
+            eng = _engine(tiny_model, max_slots=2,
+                          enable_prefix_cache=False, prefill_chunk=chunk)
+            short, longr = _run(eng, [([1, 2, 3, 4, 5, 6], 16),
+                                      (long_prompt, 4)],
+                                steps_between=3)
+            assert short.finish_reason == "length"
+            assert longr.finish_reason == "length"
+            return eng, longr
+
+        chunked, r_c = drive(8)
+        plain, r_p = drive(0)
+        assert r_c.output_tokens == r_p.output_tokens
+        assert plain.max_prefill_gap == 40      # whole prompt, one stall
+        assert chunked.max_prefill_gap == 8     # never more than a chunk
+
+    def test_chunk_parity_cache_on_shared_prefix(self, tiny_model):
+        """Chunked admissions publish into the prefix cache only after
+        their last chunk lands: a same-pass sibling must NOT match the
+        still-unwritten pages (parity), while a later arrival matches
+        the full shared prefix once it has been published."""
+        prefix = list(range(1, 21))             # 5 full pages
+        subs = [(prefix + [30, 31, 32, 33], 6),
+                (prefix + [40, 41, 42, 43], 6)]
+        ref = _engine(tiny_model, max_slots=2, enable_prefix_cache=True,
+                      prefill_chunk=0)
+        ref_reqs = _run(ref, subs)
+        # same scheduler pass: the second admission would match pages
+        # whose chunks haven't run yet — deferred publish forbids it
+        eng = _engine(tiny_model, max_slots=2, enable_prefix_cache=True,
+                      prefill_chunk=8)
+        reqs = _run(eng, subs)
+        assert [r.output_tokens for r in reqs] == \
+            [r.output_tokens for r in ref_reqs]
+        assert eng.blocks.cached_tokens == 0    # nothing matchable yet
+        assert eng.blocks.pool_accounting()["leak"] == 0
+        # staggered: the second wave arrives after the first finished
+        # its chunks, so the published prefix is live and matchable
+        ref2 = _engine(tiny_model, max_slots=2,
+                       enable_prefix_cache=True, prefill_chunk=0)
+        ref2_reqs = _run(ref2, subs, steps_between=6)
+        eng2 = _engine(tiny_model, max_slots=2,
+                       enable_prefix_cache=True, prefill_chunk=8)
+        reqs2 = _run(eng2, subs, steps_between=6)
+        assert [r.output_tokens for r in reqs2] == \
+            [r.output_tokens for r in ref2_reqs]
+        assert eng2.blocks.cached_tokens >= 20  # second wave hit prefix
+        assert eng2.blocks.pool_accounting()["leak"] == 0
+
+    def test_chunking_adds_no_prefill_programs(self, tiny_model):
+        """Every chunk rides the existing bucketed prefill programs:
+        two long admissions of different lengths compile at most one
+        fresh-prefill and one cached-prefill program (bucket == chunk),
+        and the decode step still traces once."""
+        eng = _engine(tiny_model, max_slots=2,
+                      enable_prefix_cache=False, prefill_chunk=8)
+        _run(eng, [(list(range(1, 41)), 4)])
+        n_after_first = (len(eng._prefill_fns)
+                         + len(eng._prefill_cached_fns))
+        _run(eng, [(list(range(3, 27)), 4)])    # 24 tokens: 3 chunks
+        n_after_second = (len(eng._prefill_fns)
+                          + len(eng._prefill_cached_fns))
+        assert n_after_first == n_after_second <= 2
+        assert eng.decode_traces == 1
+
+
+class TestPreemptAndSwap:
+    def _overload(self, model, *, cache, mesh=None, faults=None,
+                  chunk=0):
+        """Two low-priority residents decode for a few steps, then a
+        high-priority submit arrives with both slots taken.  Returns
+        (engine, [lo_a, lo_b, hi])."""
+        eng = _engine(model, max_slots=2, enable_prefix_cache=cache,
+                      preempt=True, mesh=mesh, faults=faults,
+                      prefill_chunk=chunk)
+        lo_a = eng.submit([1, 2, 3, 4, 5, 6],
+                          GenerationConfig(max_new_tokens=8))
+        lo_b = eng.submit([3, 4, 5, 6, 7, 8],
+                          GenerationConfig(max_new_tokens=8))
+        for _ in range(4):
+            eng.step()
+        hi = eng.submit([5, 6, 7, 8, 9, 10],
+                        GenerationConfig(max_new_tokens=8), priority=1)
+        eng.run_until_complete(max_steps=600)
+        return eng, [lo_a, lo_b, hi]
+
+    def _reference(self, model, *, cache, mesh=None):
+        ref = _engine(model, max_slots=3, enable_prefix_cache=cache,
+                      mesh=mesh)
+        return _run(ref, [([1, 2, 3, 4, 5, 6], 8),
+                          ([3, 4, 5, 6, 7, 8], 8),
+                          ([5, 6, 7, 8, 9, 10], 8)])
+
+    def _check_parity(self, reqs, ref_reqs):
+        assert all(r.finish_reason == "length" for r in reqs)
+        assert [r.output_tokens for r in reqs] == \
+            [r.output_tokens for r in ref_reqs]
+
+    def test_preempt_spill_resume_parity_cache_off(self, tiny_model):
+        eng, reqs = self._overload(tiny_model, cache=False)
+        self._check_parity(reqs, self._reference(tiny_model, cache=False))
+        assert eng.preemptions == 1
+        # exactly one of the two low-priority residents was preempted
+        # (same-pass admissions share admitted_at, so the tiebreak
+        # falls to slot order — which one is an implementation detail)
+        assert sorted(r.preemptions for r in reqs) == [0, 0, 1]
+        # with no prefix cache only the host tier can carry the KV back
+        assert eng.blocks.spilled_pages == 2
+        assert eng.blocks.restored_pages == 2
+        assert eng.blocks.spill_bytes > 0
+        assert eng.blocks.pool_accounting()["leak"] == 0
+        assert eng.decode_traces == 1
+
+    def test_preempt_parity_cache_on(self, tiny_model):
+        eng, reqs = self._overload(tiny_model, cache=True)
+        self._check_parity(reqs, self._reference(tiny_model, cache=True))
+        assert eng.preemptions == 1
+        assert eng.blocks.pool_accounting()["leak"] == 0
+        assert eng.decode_traces == 1
+
+    def test_preempt_parity_chunked_resume(self, tiny_model):
+        """Preemption composes with chunked prefill: the resume
+        re-prefill itself runs in chunks."""
+        eng, reqs = self._overload(tiny_model, cache=False, chunk=4)
+        self._check_parity(reqs, self._reference(tiny_model, cache=False))
+        assert eng.preemptions == 1
+        assert eng.blocks.pool_accounting()["leak"] == 0
+
+    def test_preempt_parity_tp2(self, tiny_model):
+        eng, reqs = self._overload(tiny_model, cache=False, mesh=2)
+        self._check_parity(reqs,
+                           self._reference(tiny_model, cache=False,
+                                           mesh=2))
+        assert eng.tp == 2
+        assert eng.preemptions == 1
+        assert eng.blocks.spilled_pages == 2
+        assert eng.blocks.restored_pages == 2
+        assert eng.blocks.pool_accounting()["leak"] == 0
+        assert eng.decode_traces == 1
+
+    def test_spill_fail_permanent_abort_clean(self, tiny_model):
+        """spill_fail on every attempt: no preemption ever lands, the
+        victim keeps its pages and finishes untouched, nothing leaks
+        and nothing is left parked (satellite b)."""
+        plan = FaultPlan(seed=0)
+        plan.add("spill_fail", p=1.0)
+        eng, reqs = self._overload(tiny_model, cache=False, faults=plan)
+        self._check_parity(reqs, self._reference(tiny_model, cache=False))
+        assert eng.preemptions == 0
+        assert eng.spill_aborts >= 1
+        assert all(r.preemptions == 0 for r in reqs)
+        assert eng.blocks.spilled_pages == 0
+        assert eng.blocks.host_parked == 0
+        assert eng.blocks.pool_accounting()["leak"] == 0
+        assert plan.injected["spill_fail"] >= 1
+
+    def test_spill_fail_once_retry_succeeds(self, tiny_model):
+        """A single injected spill failure aborts that preemption
+        cleanly; the scheduler's next pass retries and succeeds."""
+        plan = FaultPlan(seed=0)
+        plan.add("spill_fail", at=1)
+        eng, reqs = self._overload(tiny_model, cache=False, faults=plan)
+        self._check_parity(reqs, self._reference(tiny_model, cache=False))
+        assert eng.spill_aborts == 1
+        assert eng.preemptions == 1
+        assert eng.blocks.pool_accounting()["leak"] == 0
+
+    def test_churn_leak_free_and_reconciles(self, tiny_model):
+        """Repeated preempt -> spill -> re-admit churn: three waves of
+        high-priority arrivals against two long-running low-priority
+        residents.  Every request completes at full length, the pool
+        census balances, and per-request preemption counts reconcile
+        with the engine total."""
+        eng = _engine(tiny_model, max_slots=2,
+                      enable_prefix_cache=False, preempt=True)
+        lows = [eng.submit([1, 2, 3, 4, 5, 6],
+                           GenerationConfig(max_new_tokens=24)),
+                eng.submit([3, 4, 5, 6, 7, 8],
+                           GenerationConfig(max_new_tokens=24))]
+        highs = []
+        for wave in range(3):
+            for _ in range(4):
+                eng.step()
+            highs.append(eng.submit([9 + wave, 10, 11, 12],
+                                    GenerationConfig(max_new_tokens=3),
+                                    priority=1))
+            for _ in range(8):
+                eng.step()
+        eng.run_until_complete(max_steps=800)
+        reqs = lows + highs
+        assert all(r.finish_reason == "length" for r in reqs)
+        assert all(r.num_generated == r.gen.max_new_tokens for r in reqs)
+        assert eng.preemptions >= 2
+        assert eng.preemptions == sum(r.preemptions for r in reqs)
+        acct = eng.blocks.pool_accounting()
+        assert acct["leak"] == 0
+        # content-addressed host tier: an already-parked digest is
+        # skipped by later spill plans yet restores on every resume, so
+        # restored can legitimately exceed spilled under churn
+        assert eng.blocks.spilled_pages >= 1
+        assert eng.blocks.restored_pages >= 1
+        assert eng.decode_traces == 1
+        # uninterrupted reference for the two churned residents
+        ref = _engine(tiny_model, max_slots=2,
+                      enable_prefix_cache=False)
+        ref_reqs = _run(ref, [([1, 2, 3, 4, 5, 6], 24),
+                              ([3, 4, 5, 6, 7, 8], 24)])
+        assert [r.output_tokens for r in lows] == \
+            [r.output_tokens for r in ref_reqs]
+
+    def test_preempt_disabled_is_strict_fcfs(self, tiny_model):
+        eng = _engine(tiny_model, max_slots=2,
+                      enable_prefix_cache=False, preempt=False)
+        lo_a = eng.submit([1, 2, 3, 4, 5, 6],
+                          GenerationConfig(max_new_tokens=8))
+        lo_b = eng.submit([3, 4, 5, 6, 7, 8],
+                          GenerationConfig(max_new_tokens=8))
+        for _ in range(4):
+            eng.step()
+        hi = eng.submit([5, 6, 7, 8, 9, 10],
+                        GenerationConfig(max_new_tokens=8), priority=1)
+        eng.run_until_complete(max_steps=600)
+        assert eng.preemptions == 0
+        assert lo_a.preemptions == lo_b.preemptions == 0
+        assert hi.finish_reason == "length"
+
+
+# --------------------------------------------------------- server seam
+class TestServerPriority:
+    def test_parse_priority(self):
+        from paddle_tpu.serving import server as srv
+        assert srv._parse_priority(0) == 0
+        assert srv._parse_priority(3) == 3
+        assert srv._parse_priority("high") == 1
+        assert srv._parse_priority("normal") == 0
+        assert srv._parse_priority("low") == -1
+        assert srv._parse_priority("-2") == -2
+        for bad in (True, 1.5, "urgent", None):
+            with pytest.raises(ValueError):
+                srv._parse_priority(bad)
+
+    def test_priority_class_names(self):
+        from paddle_tpu.serving import server as srv
+        assert srv._priority_class(1) == "high"
+        assert srv._priority_class(0) == "normal"
+        assert srv._priority_class(-1) == "low"
+        assert srv._priority_class(7) == "7"
+
+
+# --------------------------------------------------- bench + report seams
+class TestServeBenchOverload:
+    def test_parse_priority_mix(self):
+        mod = _load_tool("serve_bench")
+        mix = mod._parse_priority_mix("hi:0.2,lo:0.8")
+        assert mix == [(1, pytest.approx(0.2)), (-1, pytest.approx(0.8))]
+        assert mod._parse_priority_mix("") is None
+        mix = mod._parse_priority_mix("2:1,normal:3")  # bare int class
+        assert mix == [(2, pytest.approx(0.25)), (0, pytest.approx(0.75))]
+        with pytest.raises(ValueError):
+            mod._parse_priority_mix("hi:0,lo:0")
+
+    def test_assign_priorities_deterministic(self):
+        mod = _load_tool("serve_bench")
+        mix = mod._parse_priority_mix("hi:0.5,lo:0.5")
+        a = mod._assign_priorities(mix, np.random.default_rng(3), 32)
+        b = mod._assign_priorities(mix, np.random.default_rng(3), 32)
+        assert a == b
+        assert set(a) <= {1, -1} and len(set(a)) == 2
+        assert mod._assign_priorities(None, np.random.default_rng(3),
+                                      4) == [0, 0, 0, 0]
+
+    def _args(self, **over):
+        base = dict(requests=4, max_slots=2, page_size=4, num_pages=64,
+                    arrival_gap_ms=1.0, prompt_len=(4, 8),
+                    new_tokens=(2, 4), shared_prefix_len=0,
+                    sync_interval=1, prefix_cache=False, layers=1,
+                    hidden=32, vocab=64, max_model_len=64,
+                    metrics_dir="", trace="", seed=0, http=False,
+                    replicas=1, heads=4, kv_heads=2, mesh=None,
+                    spec_k=0, arrival="uniform")
+        base.update(over)
+        return SimpleNamespace(**base)
+
+    def test_run_bench_priority_mix_per_class(self):
+        mod = _load_tool("serve_bench")
+        res = mod.run_bench(self._args(
+            requests=6, priority_mix="hi:0.5,lo:0.5", prefill_chunk=8,
+            preempt=True))
+        per = res["per_class"]
+        assert set(per) <= {"high", "low"}
+        assert sum(d["requests"] for d in per.values()) == 6
+        for d in per.values():
+            assert len(d["ttft_s"]) == d["requests"]
+        assert res["decode_traces"] == 1
+        assert "preemptions" in res and "prefill_chunks" in res
+
+    def test_run_bench_old_namespace_still_works(self):
+        # callers that predate the overload args (hand-built Namespace)
+        mod = _load_tool("serve_bench")
+        res = mod.run_bench(self._args())
+        assert res["requests"] == 4
+        assert set(res["per_class"]) == {"normal"}
+        assert res["per_class"]["normal"]["requests"] == 4
+        assert res["preemptions"] == 0
+
+    def test_overload_baseline_cli(self, capsys):
+        mod = _load_tool("serve_bench")
+        rc = mod.main(["--requests", "6", "--max-slots", "2",
+                       "--prompt-len", "4", "8", "--new-tokens", "2",
+                       "4", "--layers", "1", "--hidden", "32",
+                       "--vocab", "64", "--max-model-len", "64",
+                       "--no-prefix-cache", "--priority-mix",
+                       "hi:0.4,lo:0.6", "--prefill-chunk", "8",
+                       "--preempt", "--overload-baseline"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "FCFS baseline" in out
+        assert "overload comparison" in out
+        assert "class high" in out and "class low" in out
+
+
+class TestMetricsReportScheduling:
+    @staticmethod
+    def _counter(value, labels=None):
+        return {"type": "counter",
+                "series": [{"labels": labels or {}, "value": value}]}
+
+    def test_scheduling_section_renders(self):
+        mod = _load_tool("metrics_report")
+        metrics = {
+            "serving_prefill_chunks_total": self._counter(13),
+            "serving_preemptions_total": self._counter(2),
+            "serving_spilled_pages_total": self._counter(4),
+            "serving_restored_pages_total": self._counter(4),
+            "serving_spill_bytes_total": self._counter(4096),
+            "serving_slo_shed_total": {
+                "type": "counter",
+                "series": [{"labels": {"class": "low"}, "value": 3},
+                           {"labels": {"class": "normal"}, "value": 1}]},
+        }
+        sec = mod._scheduling_section(metrics)
+        assert sec is not None and sec.startswith("Scheduling / overload")
+        assert "13 chunks" in sec
+        assert "preemptions: 2" in sec
+        assert "4 pages spilled" in sec
+        assert "low=3" in sec and "normal=1" in sec
+        # and the composed report includes it
+        assert "Scheduling / overload" in mod.report(metrics, {})
+
+    def test_old_dumps_have_no_section(self):
+        mod = _load_tool("metrics_report")
+        assert mod._scheduling_section({}) is None
+        old = {"serving_admissions_total": self._counter(5)}
+        assert mod._scheduling_section(old) is None
+        assert "Scheduling / overload" not in mod.report(old, {})
+
+    def test_bench_dump_renders_scheduling(self, tmp_path):
+        """End to end: a priority-mix bench run's dump renders a
+        Scheduling section through the real CLI."""
+        import subprocess
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "serve_bench.py"),
+             "--requests", "6", "--max-slots", "2", "--prompt-len",
+             "4", "8", "--new-tokens", "2", "4", "--layers", "1",
+             "--hidden", "32", "--vocab", "64", "--max-model-len",
+             "64", "--no-prefix-cache", "--priority-mix",
+             "hi:0.4,lo:0.6", "--prefill-chunk", "4", "--preempt",
+             "--metrics-dir", str(tmp_path)],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"}, timeout=240)
+        assert out.returncode == 0, out.stderr
+        assert "class " in out.stdout
+        report = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "metrics_report.py"),
+             str(tmp_path)],
+            capture_output=True, text=True, timeout=60)
+        assert report.returncode == 0, report.stderr
+        assert "Scheduling / overload" in report.stdout
+        assert "chunked prefill" in report.stdout
+
+
+# ------------------------------------------------------ /debug/fleet seam
+def test_fleet_summary_scheduling_block(tiny_model):
+    from paddle_tpu.serving import serve
+    eng = _engine(tiny_model, max_slots=2, prefill_chunk=8,
+                  enable_prefix_cache=False)
+    srv = serve(engine=eng, watchdog_s=0, timeseries_interval_s=0)
+    try:
+        summary = srv.fleet_summary()
+    finally:
+        srv.stop(drain_timeout=2.0)
+    sched = summary["scheduling"]
+    assert sched["prefill_chunk"] == 8
+    for key in ("prefill_chunks", "max_prefill_gap", "preemptions",
+                "spill_aborts", "spilled_pages", "restored_pages",
+                "spill_bytes", "host_parked_pages", "shed_by_class"):
+        assert key in sched
+    # renders through the dashboard's replica view without error
+    dash = _load_tool("fleet_dashboard")
+    payload = dict(summary, address="x:1", model="m", kind="replica")
+    text = dash.render_replica(payload)
+    assert "REPLICA" in text

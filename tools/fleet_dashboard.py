@@ -262,6 +262,18 @@ def render_replica(payload) -> str:
         out.append(f"  recovery: {_fmt(rec.get('recoveries'))} rebuilds,"
                    f" {_fmt(rec.get('quarantines'))} quarantines,"
                    f" {_fmt(rec.get('replayed_requests'))} replays")
+    sched = payload.get("scheduling") or {}
+    if any(v for k, v in sched.items() if k != "prefill_chunk"):
+        line = (f"  overload: {_fmt(sched.get('prefill_chunks'))} "
+                f"prefill chunks (max gap "
+                f"{_fmt(sched.get('max_prefill_gap'))} tok), "
+                f"{_fmt(sched.get('preemptions'))} preemptions, "
+                f"{_fmt(sched.get('host_parked_pages'))} pages parked")
+        shed = sched.get("shed_by_class") or {}
+        if shed:
+            line += ", shed " + ", ".join(
+                f"{k}={_fmt(v)}" for k, v in sorted(shed.items()))
+        out.append(line)
     lat = _latency_lines(payload.get("latency"))
     if lat:
         out += [""] + lat

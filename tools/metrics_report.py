@@ -382,6 +382,50 @@ def _faults_section(metrics):
     return "\n".join(lines) if len(lines) > 1 else None
 
 
+def _scheduling_section(metrics):
+    """Overload-handling summary: chunked prefill, priority
+    preempt-and-swap (host KV spill tier), and SLO shedding by class.
+    Dumps from builds without the overload layer have none of these
+    keys and produce no section."""
+    names = ("serving_prefill_chunks_total", "serving_preemptions_total",
+             "serving_spilled_pages_total", "serving_restored_pages_total",
+             "serving_slo_shed_total")
+    if not any(n in metrics for n in names):
+        return None
+
+    def total(name):
+        return sum(s.get("value", 0)
+                   for s in (metrics.get(name) or {}).get("series", []))
+
+    lines = ["Scheduling / overload"]
+    chunks = total("serving_prefill_chunks_total")
+    if chunks:
+        lines.append(f"  chunked prefill: {_fmt_value(chunks)} chunks "
+                     f"interleaved with decode")
+    preempts = total("serving_preemptions_total")
+    spilled = total("serving_spilled_pages_total")
+    restored = total("serving_restored_pages_total")
+    if preempts or spilled:
+        line = f"  preemptions: {_fmt_value(preempts)}"
+        if spilled:
+            line += (f", {_fmt_value(spilled)} pages spilled to host / "
+                     f"{_fmt_value(restored)} restored "
+                     f"({_fmt_value(total('serving_spill_bytes_total'))} bytes)")
+        parked = total("serving_host_spill_pages")
+        if parked:
+            line += f", {_fmt_value(parked)} still parked"
+        lines.append(line)
+    shed = metrics.get("serving_slo_shed_total")
+    if shed:
+        by_cls = {s.get("labels", {}).get("class", "?"): s.get("value", 0)
+                  for s in shed.get("series", [])}
+        if any(by_cls.values()):
+            lines.append("  shed (429) by class: " + ", ".join(
+                f"{k}={_fmt_value(v)}" for k, v in sorted(
+                    by_cls.items())))
+    return "\n".join(lines) if len(lines) > 1 else None
+
+
 def _slo_section(metrics):
     """SLO verdicts (serving_slo_requests_total / serving_slo_burn_rate)
     + finish reasons (serving_finish_total) + watchdog stalls."""
@@ -591,6 +635,9 @@ def report(metrics, retraces, trace=None, flight=None, resources=None):
     faults = _faults_section(metrics)
     if faults:
         out += [faults, ""]
+    sched = _scheduling_section(metrics)
+    if sched:
+        out += [sched, ""]
     slo = _slo_section(metrics)
     if slo:
         out += [slo, ""]

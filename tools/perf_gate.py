@@ -72,6 +72,21 @@ DIRECTIONS = {
     "leaked_pages": "exact",
     "faults_injected": "exact",
     "replay_cached_tokens": "high",
+    # overload degradation: preempt-and-swap must spill and restore an
+    # exact page count with zero spill failures, keep the preempted
+    # request's greedy output identical to an uninterrupted run, and
+    # hand back every page; chunked prefill must split a long admission
+    # into an exact chunk count and bound the longest decode-free
+    # prefill burst (the head-of-line-blocking witness) — all without
+    # a single new decode trace
+    "preemptions": "exact",
+    "spill_aborts": "exact",
+    "spilled_pages": "exact",
+    "restored_pages": "exact",
+    "preempt_parity": "exact",
+    "prefill_chunks": "exact",
+    "chunk_parity": "exact",
+    "max_prefill_gap": "low",
     # telemetry: the sampler must be deterministic under a fake clock
     # (exact ticks/samples/alerts) and free under the control run
     # (exactly zero extra host syncs / decode traces)
@@ -421,6 +436,77 @@ def scenario_telemetry() -> dict:
     }
 
 
+def scenario_overload_degrade() -> dict:
+    """Graceful degradation under overload, counters only.
+
+    Preempt half: two low-priority residents fill both slots and
+    decode for a while; a high-priority submit must preempt the
+    most-recently-admitted one — spilling its full KV pages to the
+    host tier (exact page count, zero aborts), re-queueing it, and
+    restoring the parked pages on resume.  The preempted request's
+    greedy tokens must equal an uninterrupted run's (parity gates at
+    exactly 1) and the pool census must balance.
+
+    Chunk half: a 40-token prompt admitted behind a decoding resident
+    with prefill_chunk=8 must prefill in exactly 5 chunks, and the
+    longest run of prefill tokens with no intervening decode step
+    (max_prefill_gap, the head-of-line-blocking witness) must stay at
+    the chunk size instead of the full prompt length.  Both halves
+    reuse the existing decode/prefill programs — decode_traces gates
+    at 1 per engine."""
+    # --- preempt-and-swap (prefix cache off: spills, not cache, must
+    # carry the KV back) ---
+    eng = _engine(max_slots=2, page_size=4, sync_interval=1,
+                  enable_prefix_cache=False, preempt=True)
+    lo_a = eng.submit([1, 2, 3, 4, 5, 6], _gen(8))
+    lo_b = eng.submit([3, 4, 5, 6, 7, 8], _gen(8))
+    for _ in range(4):              # both residents mid-decode
+        eng.step()
+    hi = eng.submit([5, 6, 7, 8, 9, 10], _gen(8), priority=1)
+    eng.run_until_complete(max_steps=400)
+    reqs = [lo_a, lo_b, hi]
+
+    ref = _engine(max_slots=3, page_size=4, sync_interval=1,
+                  enable_prefix_cache=False)
+    ref_reqs = [ref.submit([1, 2, 3, 4, 5, 6], _gen(8)),
+                ref.submit([3, 4, 5, 6, 7, 8], _gen(8)),
+                ref.submit([5, 6, 7, 8, 9, 10], _gen(8))]
+    ref.run_until_complete(max_steps=400)
+
+    # --- chunked prefill (long admission behind a decoding resident) ---
+    long_prompt = list(range(1, 41))
+    eng2 = _engine(max_slots=2, page_size=4, sync_interval=1,
+                   enable_prefix_cache=False, prefill_chunk=8)
+    short = eng2.submit([1, 2, 3, 4, 5, 6], _gen(16))
+    for _ in range(3):              # short request is decoding
+        eng2.step()
+    chunked = eng2.submit(long_prompt, _gen(4))
+    eng2.run_until_complete(max_steps=400)
+
+    ref2 = _engine(max_slots=2, page_size=4, sync_interval=1,
+                   enable_prefix_cache=False, prefill_chunk=0)
+    ref2_req = ref2.submit(long_prompt, _gen(4))
+    ref2.run_until_complete(max_steps=400)
+
+    return {
+        "preemptions": eng.preemptions,
+        "spill_aborts": eng.spill_aborts,
+        "spilled_pages": eng.blocks.spilled_pages,
+        "restored_pages": eng.blocks.restored_pages,
+        "preempt_parity": int(
+            [r.output_tokens for r in reqs]
+            == [r.output_tokens for r in ref_reqs]),
+        "leaked_pages": (eng.blocks.pool_accounting()["leak"]
+                         + eng2.blocks.pool_accounting()["leak"]),
+        "decode_traces": max(eng.decode_traces, eng2.decode_traces),
+        "prefill_chunks": eng2.prefill_chunks,
+        "max_prefill_gap": eng2.max_prefill_gap,
+        "chunk_parity": int(chunked.output_tokens
+                            == ref2_req.output_tokens),
+        "goodput_ratio": _goodput(reqs + [short, chunked]),
+    }
+
+
 SCENARIOS = {
     "steady_decode": scenario_steady_decode,
     "prefix_cache": scenario_prefix_cache,
@@ -430,6 +516,7 @@ SCENARIOS = {
     "spec_decode": scenario_spec_decode,
     "fault_recovery": scenario_fault_recovery,
     "telemetry": scenario_telemetry,
+    "overload_degrade": scenario_overload_degrade,
 }
 
 

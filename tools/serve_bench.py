@@ -27,6 +27,15 @@ three patterns can be compared at identical offered load.
 one K+1-position verify step); greedy outputs are identical, only the
 step count changes.
 
+``--priority-mix hi:0.2,lo:0.8`` assigns each request a priority class
+drawn from the given weights (hi/high -> 1, normal -> 0, lo/low -> -1,
+or any bare int), and the report adds per-class p50/p99 TTFT/TPOT
+lines.  Combine with ``--prefill-chunk N`` (chunked admission prefill)
+and ``--preempt`` (priority preempt-and-swap) to exercise the overload
+path; ``--overload-baseline`` re-runs the identical workload on an
+FCFS engine (no chunking, no preemption) in the same invocation and
+prints a per-class tail-latency comparison.
+
 ``--shared-prefix-len N`` prepends one common N-token prefix to every
 prompt (the system-prompt / few-shot pattern prefix caching targets);
 with ``--prefix-cache`` (default on) the report adds the prefix-cache
@@ -63,6 +72,90 @@ def _percentile(vals, q):
     vals = sorted(vals)
     idx = min(len(vals) - 1, int(round(q * (len(vals) - 1))))
     return vals[idx]
+
+
+# priority-mix class names <-> engine priority ints (mirrors the
+# server's low/normal/high vocabulary; bare ints pass through)
+_MIX_NAMES = {"hi": 1, "high": 1, "normal": 0, "mid": 0,
+              "lo": -1, "low": -1}
+_CLASS_NAMES = {1: "high", 0: "normal", -1: "low"}
+
+
+def _parse_priority_mix(spec):
+    """``"hi:0.2,lo:0.8"`` -> ``[(priority, weight), ...]`` with the
+    weights normalised to sum to 1.  Empty spec -> None."""
+    if not spec:
+        return None
+    out = []
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, w = part.partition(":")
+        name = name.strip().lower()
+        pri = _MIX_NAMES.get(name)
+        if pri is None:
+            pri = int(name)
+        out.append((pri, float(w) if w else 1.0))
+    if not out:
+        return None
+    total = sum(w for _, w in out)
+    if total <= 0:
+        raise ValueError(f"--priority-mix {spec!r}: weights must be > 0")
+    return [(p, w / total) for p, w in out]
+
+
+def _assign_priorities(mix, rng, n):
+    """One priority per request, drawn from the mix weights with the
+    bench rng (same seed -> same assignment).  No mix -> all zeros."""
+    if not mix:
+        return [0] * n
+    out = []
+    for _ in range(n):
+        u = rng.random()
+        acc = 0.0
+        pri = mix[-1][0]
+        for p, w in mix:
+            acc += w
+            if u < acc:
+                pri = p
+                break
+        out.append(pri)
+    return out
+
+
+def _class_label(pri):
+    return _CLASS_NAMES.get(pri, str(pri))
+
+
+def _per_class_latency(samples):
+    """``samples``: iterable of (priority, ttft_or_None, tpot_or_None)
+    -> ``{label: {"ttft_s": [...], "tpot_s": [...], "requests": n}}``."""
+    out = {}
+    for pri, ttft, tpot in samples:
+        d = out.setdefault(_class_label(pri),
+                           {"ttft_s": [], "tpot_s": [], "requests": 0})
+        d["requests"] += 1
+        if ttft is not None:
+            d["ttft_s"].append(ttft)
+        if tpot is not None:
+            d["tpot_s"].append(tpot)
+    return out
+
+
+def _print_per_class(per_class):
+    for label in sorted(per_class):
+        d = per_class[label]
+        line = f"  class {label:<8} n={d['requests']}"
+        if d["ttft_s"]:
+            line += (f"  TTFT p50/p99 "
+                     f"{_percentile(d['ttft_s'], 0.5) * 1e3:.2f}/"
+                     f"{_percentile(d['ttft_s'], 0.99) * 1e3:.2f} ms")
+        if d["tpot_s"]:
+            line += (f"  TPOT p50/p99 "
+                     f"{_percentile(d['tpot_s'], 0.5) * 1e3:.2f}/"
+                     f"{_percentile(d['tpot_s'], 0.99) * 1e3:.2f} ms")
+        print(line)
 
 
 def _per_replica_latency(results):
@@ -107,7 +200,10 @@ def run_bench(args):
                            max_model_len=args.max_model_len,
                            enable_prefix_cache=args.prefix_cache,
                            sync_interval=args.sync_interval,
-                           mesh=args.mesh, spec_k=args.spec_k)
+                           mesh=args.mesh, spec_k=args.spec_k,
+                           prefill_chunk=getattr(args, "prefill_chunk",
+                                                 None),
+                           preempt=getattr(args, "preempt", None))
 
     # --chaos SEED: seed a probabilistic fault plan (poisoned steps,
     # synthetic OOM, slow steps) and drive through the self-healing
@@ -120,26 +216,30 @@ def run_bench(args):
         plan.add("step_raise", p=0.01)
         plan.add("page_alloc", p=0.01)
         plan.add("slow_step", p=0.02, seconds=0.002)
+        plan.add("spill_fail", p=0.05)
         engine.faults = plan
         engine.blocks.faults = plan
         supervisor = EngineSupervisor(engine)
     step = engine.step if supervisor is None else supervisor.step
 
     workload = _build_workload(args, rng, np)
+    mix = _parse_priority_mix(getattr(args, "priority_mix", ""))
+    priorities = _assign_priorities(mix, rng, len(workload))
 
     t0 = time.monotonic()
-    pending = list(workload)
+    pending = list(enumerate(workload))
     reqs = []
     # open-loop driver: submit what has "arrived", run one iteration,
     # repeat — admissions interleave with decode exactly as in a server
     while pending or engine.scheduler.has_work():
         now = time.monotonic() - t0
-        while pending and pending[0][0] <= now:
-            _, prompt, n_new = pending.pop(0)
+        while pending and pending[0][1][0] <= now:
+            i, (_, prompt, n_new) = pending.pop(0)
             reqs.append(engine.submit(
-                prompt, GenerationConfig(max_new_tokens=n_new)))
+                prompt, GenerationConfig(max_new_tokens=n_new),
+                priority=priorities[i]))
         if not step() and pending:
-            time.sleep(min(1e-3, max(0.0, pending[0][0] - now)))
+            time.sleep(min(1e-3, max(0.0, pending[0][1][0] - now)))
     wall = time.monotonic() - t0
 
     toks = sum(r.num_generated for r in reqs)
@@ -189,6 +289,28 @@ def run_bench(args):
               f"{stats['spec_verify_steps']} verify steps, "
               f"{toks / steps if steps else 0.0:.2f} tokens/decode-step")
 
+    def _req_samples():
+        for r in reqs:
+            ttft = (r.first_token_at - r.arrival_time
+                    if r.first_token_at is not None else None)
+            tpot = ((r.last_token_at - r.first_token_at)
+                    / (r.num_generated - 1)
+                    if r.num_generated > 1 else None)
+            yield getattr(r, "priority", 0), ttft, tpot
+
+    per_class = _per_class_latency(_req_samples())
+    if mix:
+        _print_per_class(per_class)
+    if (stats.get("prefill_chunk") or stats.get("preemptions")
+            or stats.get("spill_aborts")):
+        print(f"  scheduling           chunk={stats['prefill_chunk']}: "
+              f"{stats['prefill_chunks']} prefill chunks "
+              f"(max decode gap {stats['max_prefill_gap']} tok), "
+              f"{stats['preemptions']} preemptions "
+              f"({stats['spill_aborts']} aborted), "
+              f"{stats['spilled_pages']}/{stats['restored_pages']} pages "
+              f"spilled/restored ({stats['spill_bytes']} bytes)")
+
     chaos_out = {}
     if supervisor is not None:
         ok = sum(1 for r in reqs if r.finish_reason in ("length", "eos"))
@@ -207,7 +329,8 @@ def run_bench(args):
                      "recoveries": engine.recoveries,
                      "quarantines": engine.quarantines,
                      "faults_injected": dict(engine.faults.injected),
-                     "leaked_pages": leak}
+                     "leaked_pages": leak,
+                     "spill_aborts": engine.spill_aborts}
 
     if args.metrics_dir:
         out = obs.dump(args.metrics_dir)
@@ -221,7 +344,53 @@ def run_bench(args):
             "prefix_hit_rate": hit_rate,
             "pages_saved": stats["prefix_hits"],
             "host_syncs": stats["host_syncs"],
-            "logit_fetches": stats["logit_fetches"], **chaos_out}
+            "logit_fetches": stats["logit_fetches"],
+            "per_class": per_class,
+            "prefill_chunks": stats["prefill_chunks"],
+            "max_prefill_gap": stats["max_prefill_gap"],
+            "preemptions": stats["preemptions"],
+            "spill_aborts": stats["spill_aborts"],
+            "spilled_pages": stats["spilled_pages"],
+            "restored_pages": stats["restored_pages"], **chaos_out}
+
+
+def run_overload_compare(args):
+    """--overload-baseline: run the configured engine, then the same
+    seeded workload (identical arrivals, prompts, priorities) on an
+    FCFS engine with chunking and preemption off, and print the
+    per-class tail-latency comparison.  Returns (configured, fcfs)."""
+    import copy
+
+    res = run_bench(args)
+    base_args = copy.copy(args)
+    base_args.prefill_chunk = 0
+    base_args.preempt = False
+    print("\n--- FCFS baseline: same workload, prefill-chunk 0, "
+          "no preemption ---")
+    ref = run_bench(base_args)
+
+    print("\noverload comparison (configured vs FCFS baseline):")
+    labels = sorted(set(res.get("per_class", {}))
+                    | set(ref.get("per_class", {})))
+    rows = [(f"class {lab}",
+             res["per_class"].get(lab, {}),
+             ref["per_class"].get(lab, {})) for lab in labels]
+    rows.append(("overall",
+                 {"ttft_s": res["ttft_s"], "tpot_s": res["tpot_s"]},
+                 {"ttft_s": ref["ttft_s"], "tpot_s": ref["tpot_s"]}))
+    for name, a, b in rows:
+        for metric in ("ttft_s", "tpot_s"):
+            va, vb = a.get(metric, []), b.get(metric, [])
+            if not va or not vb:
+                continue
+            pa = _percentile(va, 0.99) * 1e3
+            pb = _percentile(vb, 0.99) * 1e3
+            tag = metric[:4].upper()
+            print(f"  {name:<14} p99 {tag} {pa:8.2f} ms vs "
+                  f"{pb:8.2f} ms FCFS "
+                  f"({'-' if pa <= pb else '+'}"
+                  f"{abs(pa - pb) / pb * 100 if pb else 0.0:.1f}%)")
+    return res, ref
 
 
 def _export_trace(args):
@@ -313,8 +482,11 @@ def run_http_bench(args):
     router = Router([s.address for s in servers],
                     page_size=args.page_size)
     workload = _build_workload(args, rng, np)
+    mix = _parse_priority_mix(getattr(args, "priority_mix", ""))
+    priorities = _assign_priorities(mix, rng, len(workload))
 
     results = [None] * len(workload)
+    rejected = [False] * len(workload)
     t0 = time.monotonic()
 
     def drive(i, at, prompt, n_new):
@@ -323,15 +495,21 @@ def run_http_bench(args):
         first = last = None
         n_toks = 0
         replica = None
-        for ev in router.completion([int(t) for t in prompt],
-                                    max_tokens=n_new, stream=True):
-            replica = ev.get("model", replica)
-            got = ev["choices"][0]["token_ids"]
-            if got:
-                n_toks += len(got)
-                last = time.monotonic()
-                if first is None:
-                    first = last
+        try:
+            for ev in router.completion([int(t) for t in prompt],
+                                        max_tokens=n_new, stream=True,
+                                        priority=priorities[i]):
+                replica = ev.get("model", replica)
+                got = ev["choices"][0]["token_ids"]
+                if got:
+                    n_toks += len(got)
+                    last = time.monotonic()
+                    if first is None:
+                        first = last
+        except Exception:
+            # shed (429) or replica failure — counted, not fatal
+            rejected[i] = True
+            return
         results[i] = (sent, first, last, n_toks, replica)
 
     threads = [threading.Thread(target=drive, args=(i, at, p, n),
@@ -371,6 +549,17 @@ def run_http_bench(args):
               f"{_percentile(tpots, 0.5) * 1e3:.2f} / "
               f"{_percentile(tpots, 0.95) * 1e3:.2f} / "
               f"{_percentile(tpots, 0.99) * 1e3:.2f} ms")
+    per_class = _per_class_latency(
+        (priorities[i],
+         r[1] - r[0] if r[1] is not None else None,
+         (r[2] - r[1]) / (r[3] - 1) if r[3] > 1 else None)
+        for i, r in enumerate(results) if r)
+    if mix:
+        _print_per_class(per_class)
+    n_rejected = sum(rejected)
+    if n_rejected:
+        print(f"  rejected             {n_rejected} requests "
+              f"(shed or replica failure)")
     per_replica = _per_replica_latency(results)
     for name in sorted(per_replica):
         r_ttft, r_tpot, n = per_replica[name]
@@ -405,6 +594,7 @@ def run_http_bench(args):
             "arrival": args.arrival, "spec_k": args.spec_k,
             "throughput": toks / wall, "ttft_s": ttfts, "tpot_s": tpots,
             "prefix_hit_rate": hit_rate, "router": rstats,
+            "per_class": per_class, "rejected": n_rejected,
             "per_replica": {k: {"ttft_s": v[0], "tpot_s": v[1],
                                 "requests": v[2]}
                             for k, v in per_replica.items()}}
@@ -463,6 +653,28 @@ def main(argv=None):
                     help="attention heads of the bench model")
     ap.add_argument("--kv-heads", type=int, default=2,
                     help="KV heads of the bench model")
+    ap.add_argument("--priority-mix", default="", metavar="SPEC",
+                    help="per-request priority classes drawn from "
+                         "weighted spec, e.g. hi:0.2,lo:0.8 "
+                         "(hi/high=1, normal=0, lo/low=-1, or bare "
+                         "ints); adds per-class p50/p99 TTFT/TPOT")
+    ap.add_argument("--prefill-chunk", type=int, default=None,
+                    help="split admission prefill into chunks of this "
+                         "many tokens, interleaved with decode steps "
+                         "(0 = single-shot; default FLAGS_serving_"
+                         "prefill_chunk)")
+    ap.add_argument("--preempt",
+                    action=argparse.BooleanOptionalAction, default=None,
+                    help="priority preempt-and-swap: spill a lower-"
+                         "priority resident's KV to host RAM to admit "
+                         "a higher class (default FLAGS_serving_"
+                         "preempt)")
+    ap.add_argument("--overload-baseline", action="store_true",
+                    help="after the configured run, re-run the "
+                         "identical workload on an FCFS engine "
+                         "(prefill-chunk 0, no preemption) and print "
+                         "a per-class tail-latency comparison "
+                         "(in-process mode only)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--chaos", type=int, default=None, metavar="SEED",
                     help="inject a seeded probabilistic fault plan "
@@ -473,6 +685,8 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if args.http:
         run_http_bench(args)
+    elif args.overload_baseline:
+        run_overload_compare(args)
     else:
         run_bench(args)
     return 0
