@@ -181,6 +181,28 @@ define_flag("FLAGS_obs_fleet_window", 32,
             "recent time-series points each replica publishes per "
             "series in its GET /debug/fleet summary (the router and "
             "the dashboard consume these windows)")
+define_flag("FLAGS_obs_profile_interval_s", 0.0,
+            "continuous sampling profiler: seconds between stack "
+            "sweeps (each sweep walks sys._current_frames and "
+            "aggregates phase-attributed per-thread stacks; serve "
+            "them via GET /debug/profile or dump() profile.json; "
+            "0 disables — no profiler or sweep thread is built and "
+            "the serving path pays zero overhead)")
+define_flag("FLAGS_obs_capture_dir", "",
+            "directory for alert-triggered diagnostic capture bundles "
+            "(capture_<n>.json: profile window, flight ring, resource "
+            "snapshot, lock-wait graph, series windows; empty falls "
+            "back to FLAGS_metrics_dir; with neither set bundles stay "
+            "in the bounded in-memory ring behind GET /debug/captures)")
+define_flag("FLAGS_obs_capture_min_interval_s", 60.0,
+            "per-rule rate limit for diagnostic captures: a rule that "
+            "re-fires within this many seconds of its last capture is "
+            "counted (obs_captures_rate_limited_total) but captures "
+            "no new bundle — a flapping alert cannot fill a disk")
+define_flag("FLAGS_obs_capture_max", 8,
+            "diagnostic-capture retention: bundles kept on disk and "
+            "in the in-memory ring; writing bundle N+1 deletes the "
+            "oldest capture_<n>.json")
 define_flag("FLAGS_serving_prefill_chunk", 0,
             "chunked prefill: split admission prefill into chunks of at "
             "most N prompt tokens, interleaved with decode steps so one "

@@ -230,11 +230,16 @@ class Layer:
         # dispatch-count lever on a tunneled transport.  Purity is
         # enforced dynamically: the first dispatch doubles as a probe
         # (eager-RNG use or a trace failure falls back to per-op
-        # forever).  See _segment_call.
+        # forever).  Eligibility is per CLASS: framework-defined types
+        # auto-segment, user subclasses opt in with
+        # ``segment_forward = True`` (their forward may read mutable
+        # Python state the probe cannot see).  See _segment_call and
+        # layer_common.segment_eligible.
         if self._sub_layers and not self._forward_pre_hooks \
                 and not self._forward_post_hooks:
             from . import layer_common as _lc
-            if _lc.SEGMENT_FORWARD:
+            if _lc.SEGMENT_FORWARD \
+                    and _lc.segment_eligible(type(self)):
                 out = self._segment_call(inputs, kwargs)
                 if out is not NotImplemented:
                     return out

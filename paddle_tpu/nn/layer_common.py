@@ -216,13 +216,38 @@ class Bilinear(Layer):
 # ------------------------------------------------------------- containers
 
 # Eager segment tracing toggle (reference hot-path goal, phi/README.md
-# §1.2).  The machinery is GENERAL now — Layer._segment_call (layer.py)
-# runs ANY hook/buffer-free composite layer's forward as ONE cached-jit
+# §1.2).  The machinery is GENERAL — Layer._segment_call (layer.py)
+# runs a hook/buffer-free composite layer's forward as ONE cached-jit
 # dispatch with dynamic purity probing (eager-RNG / untraceable python
 # falls back per-op).  On a tunneled transport each eager dispatch costs
 # ~0.5 ms, so this is the dygraph forward's dispatch-count lever.
+#
+# Auto-segmenting by DEFAULT applies only to framework-defined layer
+# types (classes living under the paddle_tpu package): a user
+# subclass's hand-written forward may read mutable Python state that
+# the purity probe cannot see, which would be baked into the first
+# trace and silently replayed stale.  User subclasses opt in per class
+# with ``segment_forward = True`` (and a framework type can opt out
+# with ``segment_forward = False``); the decision is cached per class.
 SEGMENT_FORWARD = True
 _SEG_IDS = iter(range(1, 1 << 62))
+_SEG_ELIGIBLE: dict = {}        # class -> cached eligibility
+
+
+def segment_eligible(cls) -> bool:
+    """Is ``cls`` allowed to auto-segment?  An explicit class-level
+    ``segment_forward`` attribute anywhere in the MRO wins; otherwise
+    only framework-defined types (``paddle_tpu.*`` modules) qualify."""
+    cached = _SEG_ELIGIBLE.get(cls)
+    if cached is None:
+        flag = getattr(cls, "segment_forward", None)
+        if flag is not None:
+            cached = bool(flag)
+        else:
+            cached = ((cls.__module__ or "").split(".", 1)[0]
+                      == "paddle_tpu")
+        _SEG_ELIGIBLE[cls] = cached
+    return cached
 
 
 class Sequential(Layer):

@@ -37,10 +37,17 @@ from .tracing import (  # noqa: F401
 from .timeseries import (  # noqa: F401
     AlertRule, Series, TimeSeriesStore, default_rules, metric_value,
     serving_sources)
+from .profiling import (  # noqa: F401
+    SamplingProfiler, active_profiler, set_active_profiler)
+from .capture import (  # noqa: F401
+    DiagnosticCapture, active_capture, set_active_capture)
 
-__all__ = ["AlertRule", "Counter", "FlightRecorder", "Gauge",
-           "Histogram", "MetricsRegistry", "ResourceTracker", "Series",
+__all__ = ["AlertRule", "Counter", "DiagnosticCapture",
+           "FlightRecorder", "Gauge",
+           "Histogram", "MetricsRegistry", "ResourceTracker",
+           "SamplingProfiler", "Series",
            "Span", "SpanContext", "TimeSeriesStore", "Tracer",
+           "active_capture", "active_profiler",
            "bucket_quantiles", "merge_series_buckets",
            "quantile_from_buckets",
            "default_registry", "default_rules", "counter", "gauge",
@@ -48,7 +55,8 @@ __all__ = ["AlertRule", "Counter", "FlightRecorder", "Gauge",
            "dump", "reset", "flight", "enable_event_sampling",
            "chrome_counter_events", "flight_recorder",
            "format_traceparent", "parse_traceparent",
-           "resource_tracker", "serving_sources", "tracer"]
+           "resource_tracker", "serving_sources",
+           "set_active_capture", "set_active_profiler", "tracer"]
 
 
 def counter(name, help_="", labelnames=()):
@@ -141,6 +149,8 @@ def reset():
     tracer().reset()
     flight_recorder().clear()
     resource_tracker().reset()
+    set_active_profiler(None)
+    set_active_capture(None)
 
 
 def dump(dir_=None) -> str | None:
@@ -150,7 +160,9 @@ def dump(dir_=None) -> str | None:
     programmatic consumers), the flight-recorder ring as
     ``flight.json``, and the resource tracker's snapshot as
     ``resources.json`` into ``dir_`` (default: ``FLAGS_metrics_dir``).
-    Returns the directory, or None when no directory is configured."""
+    When a continuous profiler / diagnostic capture is active, adds
+    ``profile.json`` / ``captures.json``.  Returns the directory, or
+    None when no directory is configured."""
     if dir_ is None:
         from ..flags import FLAGS
         dir_ = FLAGS.get("FLAGS_metrics_dir") or None
@@ -178,6 +190,16 @@ def dump(dir_=None) -> str | None:
                   f, indent=2)
     with open(os.path.join(dir_, "resources.json"), "w") as f:
         json.dump(resource_tracker().snapshot(), f, indent=2)
+    # side-files new in PR 15 — written only when the subsystems are
+    # live, so pre-profiling dumps keep their exact shape
+    prof = active_profiler()
+    if prof is not None:
+        with open(os.path.join(dir_, "profile.json"), "w") as f:
+            json.dump(prof.snapshot(), f, indent=2)
+    cap = active_capture()
+    if cap is not None:
+        with open(os.path.join(dir_, "captures.json"), "w") as f:
+            json.dump(cap.index(), f, indent=2)
     return dir_
 
 

@@ -18,8 +18,10 @@ adds history and judgement on top of the same registry:
     gate (e.g. "tok/s collapsed *while slots were active*").  Each
     fire/clear transition bumps ``obs_alerts_total{rule}``, flips
     ``obs_alert_firing{rule}``, and stamps an ``alert`` event into the
-    flight recorder; firing rules surface on ``/healthz`` and in the
-    ``/debug/fleet`` replica summary.
+    flight recorder; a clear -> firing edge additionally invokes the
+    store's optional ``on_fire`` hook (capture.DiagnosticCapture
+    snapshots its evidence bundle there); firing rules surface on
+    ``/healthz`` and in the ``/debug/fleet`` replica summary.
 
 Sampling reads values *back from the metrics registry* (the same
 watchdog-safe pattern as resources._pool_from_registry) — never from
@@ -244,6 +246,11 @@ class TimeSeriesStore:
         self.alerts_fired = 0
         self._sampler: threading.Thread | None = None
         self._sampler_stop = threading.Event()
+        # optional fire-transition hook (DiagnosticCapture.attach):
+        # called as on_fire(rule_name, info_dict) once per clear ->
+        # firing edge, exception-fused.  None (the default) costs one
+        # attribute test — the usual zero-overhead-off contract.
+        self.on_fire = None
 
     # ------------------------------------------------------ registration
     def add_source(self, name: str, fn) -> Series:
@@ -323,18 +330,25 @@ class TimeSeriesStore:
             was = rule.name in self._firing
             if firing and not was:
                 value = rule.measure(self, now)
-                with self._lock:
-                    self.alerts_fired += 1
-                    self._firing[rule.name] = {
-                        "rule": rule.name, "series": rule.series,
+                info = {"rule": rule.name, "series": rule.series,
                         "since": now, "value": value,
                         "condition": rule.describe()["condition"],
                         "help": rule.help}
+                with self._lock:
+                    self.alerts_fired += 1
+                    self._firing[rule.name] = info
                 _M_ALERTS.labels(rule.name).inc()
                 _M_FIRING.labels(rule.name).set(1)
                 flight_recorder().record(
                     "alert", "fire", rule=rule.name, series=rule.series,
                     value=value, threshold=rule.threshold)
+                hook = self.on_fire
+                if hook is not None:
+                    try:
+                        hook(rule.name, dict(info))
+                    except Exception:
+                        pass    # evidence capture must never break
+                                # the alert evaluation that fired it
             elif firing and was:
                 with self._lock:
                     self._firing[rule.name]["value"] = \

@@ -67,6 +67,16 @@ in the W3C ``traceparent`` header; ``GET /debug/trace`` on any replica
 or router returns a chrome://tracing-loadable JSON of recent spans,
 ``GET /debug/flight`` the engine flight-recorder ring.
 
+The engine additionally publishes ``current_phase`` (prefill /
+prefill_chunk / decode / verify / host_sync / idle) as a plain
+attribute at the same seams that charge
+``serving_step_phase_seconds_total``, feeding the phase-attributed
+sampling profiler (``FLAGS_obs_profile_interval_s``;
+``GET /debug/profile?seconds=N`` on a replica, fanned out by the
+router).  Alert fires snapshot evidence bundles via
+``observability.capture`` — ``GET /debug/captures`` lists them (see
+README "Continuous profiling & diagnostic capture").
+
 Reference analog: the block_multi_head_attention serving path +
 paddle_infer predictors, restructured as a vLLM/Orca-style engine.
 """

@@ -288,6 +288,13 @@ class Engine:
         # watchdog reads it lock-free (comparing against active_count)
         # to detect a wedged decode loop — never reset.
         self.progress = 0
+        # what the engine is doing RIGHT NOW, published for the
+        # sampling profiler (observability/profiling.py): prefill /
+        # prefill_chunk / decode / verify / host_sync / idle.  A plain
+        # attribute store at each section entry — read lock-free from
+        # the sampler thread, same contract as the watchdog's
+        # ``progress`` reads; costs nothing when no profiler runs.
+        self.current_phase = "idle"
         self.slo = slo              # optional slo.SLOTracker
         # open "engine.decode_segment" span covering the device steps
         # since the last host sync (None between segments)
@@ -420,6 +427,7 @@ class Engine:
             # gap witness: nothing was decoding, so this step's prefill
             # work starved no resident — the stall meter restarts
             self._prefill_since_decode = 0
+        self.current_phase = "idle"
         self.progress += 1          # watchdog heartbeat
         return bool(admitted) or bool(active) or bool(advanced)
 
@@ -454,6 +462,7 @@ class Engine:
             # the remainder; no token is emitted
             self._resume(slot, req)
             return
+        self.current_phase = "prefill"
         t0 = time.perf_counter()
         ps = self.page_size
         plen = req.prompt.size
@@ -577,6 +586,7 @@ class Engine:
         this = min(self.prefill_chunk, n - done)
         last = done + this >= n
         ps = self.page_size
+        self.current_phase = "prefill_chunk"
         t0 = time.perf_counter()
         try:
             bucket = -(-this // ps) * ps
@@ -718,6 +728,7 @@ class Engine:
         whatever remains — then decode continues with the last emitted
         token as the next input, token-for-token identical to an
         uninterrupted greedy run (parity asserted in tests)."""
+        self.current_phase = "prefill"
         t0 = time.perf_counter()
         ps = self.page_size
         tokens = req.resume_tokens()
@@ -798,6 +809,7 @@ class Engine:
 
     # ------------------------------------------------------------ decode
     def _decode(self, active: list[int]):
+        self.current_phase = "decode"
         if self.faults is not None:
             f = self.faults.check("slow_step", step=self.decode_steps)
             if f is not None:
@@ -870,6 +882,7 @@ class Engine:
         before the next proposal anyway, and the step commits up to k+1
         tokens, so the sync amortizes exactly like deferred plain
         steps."""
+        self.current_phase = "verify"
         draft_arr = np.zeros((self.max_slots, self.spec_k), np.int32)
         dlen = np.zeros((self.max_slots,), np.int32)
         for slot, ds in drafts.items():
@@ -897,6 +910,7 @@ class Engine:
     def _sync(self):
         """Drain the device token ring: ONE [sync_interval, slots] int32
         transfer covers every decode step since the previous sync."""
+        self.current_phase = "host_sync"
         sync_t0 = time.perf_counter()
         ring = self.runner.fetch_ring()
         sync_s = time.perf_counter() - sync_t0
@@ -1218,6 +1232,7 @@ class Engine:
         generated - 1``, and the last generated token re-enters as the
         next step's input — decode then continues token-for-token as if
         the fault never happened (greedy parity is asserted in tests)."""
+        self.current_phase = "prefill"
         t0 = time.perf_counter()
         tokens = [int(t) for t in req.prompt] + list(req.output_tokens)
         ids_all = tokens[:-1]

@@ -584,6 +584,11 @@ _ROUTER_DEBUG_INDEX = {
     "/debug/fleet": "aggregate cluster view: per-replica summaries, "
                     "pooled page/slot/queue census, max SLO burn "
                     "rate, firing alerts",
+    "/debug/profile": "fan out ?seconds=N to every replica and "
+                      "aggregate the phase-attributed profile "
+                      "snapshots per replica",
+    "/debug/captures": "fan out to every replica and aggregate the "
+                       "diagnostic-capture indexes per replica",
 }
 
 
@@ -631,11 +636,69 @@ class _RouterHandler(BaseHTTPRequestHandler):
                               + _obs.chrome_counter_events())})
         elif self.path == "/debug/fleet":
             self._json(200, router.fleet())
+        elif self.path.split("?", 1)[0] == "/debug/profile":
+            self._fanout_profile()
+        elif self.path.split("?", 1)[0] == "/debug/captures":
+            self._json(200, {"kind": "router",
+                             "replicas": self._fanout_get(
+                                 "/debug/captures")})
         elif self.path in ("/debug", "/debug/"):
             self._json(200, {"endpoints": _ROUTER_DEBUG_INDEX})
         else:
             self._json(404, {"error": {"message": f"no route {self.path}",
                                        "code": 404}})
+
+    def _fanout_get(self, path: str, timeout: float | None = None):
+        """GET ``path`` on every replica, one entry per replica
+        address; a failing replica degrades to an error record, same
+        shape as the POST broadcast."""
+        router = self.server.router
+        results = {}
+        for rep in router.replicas:
+            try:
+                results[rep.address] = ServingClient(
+                    rep.address,
+                    timeout=timeout or router.request_timeout_s
+                ).request("GET", path)
+            except Exception as e:
+                results[rep.address] = {"error": repr(e)}
+        return results
+
+    def _fanout_profile(self):
+        """``GET /debug/profile?seconds=N``: each replica blocks for
+        the whole N-second window, so the fan-out runs on one thread
+        per replica and joins — every replica samples the SAME window
+        and the router handler's wall time stays ~N, not N x fleet."""
+        from urllib.parse import parse_qs, urlparse
+        q = parse_qs(urlparse(self.path).query)
+        try:
+            seconds = float(q.get("seconds", ["1.0"])[0])
+        except ValueError:
+            self._json(400, {"error": {"message":
+                                       "seconds must be a number",
+                                       "code": 400}})
+            return
+        router = self.server.router
+        path = (f"/debug/profile?seconds={seconds:g}&format=json")
+        timeout = max(router.request_timeout_s, seconds + 10.0)
+        results = {}
+
+        def one(rep):
+            try:
+                results[rep.address] = ServingClient(
+                    rep.address, timeout=timeout).request("GET", path)
+            except Exception as e:
+                results[rep.address] = {"error": repr(e)}
+
+        threads = [threading.Thread(target=one, args=(rep,),
+                                    daemon=True)
+                   for rep in router.replicas]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=timeout + 5.0)
+        self._json(200, {"kind": "router", "seconds": seconds,
+                         "replicas": results})
 
     def do_POST(self):
         if self.path == "/v1/completions":

@@ -16,7 +16,10 @@ service with zero new dependencies (stdlib ``http.server`` only):
   * ``GET /healthz`` (engine stats + drain state), ``GET /metrics``
     (the observability registry's Prometheus export),
     ``GET /debug/resources`` (resource-tracker snapshot + engine pool
-    census), ``POST /drain`` /
+    census), ``GET /debug/profile`` (on-demand phase-attributed
+    sampling-profiler window, folded / chrome / json),
+    ``GET /debug/captures`` (alert-triggered diagnostic capture
+    bundles), ``POST /drain`` /
     ``POST /resume`` (rolling restarts), and graceful drain on SIGTERM:
     in-flight streams finish, queued requests are failed fast, then the
     listener closes.
@@ -390,7 +393,8 @@ class ServingServer(ThreadingHTTPServer):
                  hard_timeout_s: float = 600.0,
                  model_name: str = "paddle-tpu",
                  watchdog_s: float | None = None,
-                 timeseries_interval_s: float | None = None):
+                 timeseries_interval_s: float | None = None,
+                 profile_interval_s: float | None = None):
         self.worker = worker
         self.retry_after_s = float(retry_after_s)
         self.hard_timeout_s = float(hard_timeout_s)
@@ -415,6 +419,24 @@ class ServingServer(ThreadingHTTPServer):
             for rule in _obs.default_rules():
                 store.add_rule(rule)
             self.timeseries = store
+        # continuous phase-attributed profiling — same contract: with
+        # the interval unset no profiler object or sweep thread exists
+        if profile_interval_s is None:
+            profile_interval_s = float(
+                FLAGS.get("FLAGS_obs_profile_interval_s") or 0.0)
+        self._profile_interval = float(profile_interval_s)
+        self.profiler = None
+        if self._profile_interval > 0:
+            self.profiler = _obs.set_active_profiler(
+                _obs.SamplingProfiler(self._profile_interval,
+                                      phases=self._engine_phases))
+        # alert-triggered diagnostic capture rides the timeseries
+        # store's fire hook: no alerts -> no capture object either
+        self.capture = None
+        if self.timeseries is not None:
+            self.capture = _obs.set_active_capture(
+                _obs.DiagnosticCapture(profiler=self.profiler)
+                .attach(self.timeseries))
         self._latency = _http_latency_hist()
         self._serve_thread: threading.Thread | None = None
         self._stop_thread: threading.Thread | None = None
@@ -424,11 +446,22 @@ class ServingServer(ThreadingHTTPServer):
     def address(self) -> str:
         return f"{self.server_address[0]}:{self.server_address[1]}"
 
+    def _engine_phases(self) -> dict:
+        """Thread-ident -> phase map for the sampling profiler: the
+        engine worker thread reports ``engine.current_phase``.  Plain
+        attribute reads, lock-free — the watchdog contract."""
+        t = self.worker._thread
+        if t is None or t.ident is None:
+            return {}
+        return {t.ident: self.worker.engine.current_phase}
+
     def start(self) -> "ServingServer":
         self.worker.start()
         self.watchdog.start()       # no-op when watchdog_s <= 0
         if self.timeseries is not None:
             self.timeseries.start_sampling(self._ts_interval)
+        if self.profiler is not None:
+            self.profiler.start_sampling()
         self._serve_thread = threading.Thread(
             target=self.serve_forever, name=f"http:{self.address}",
             daemon=True)
@@ -440,6 +473,8 @@ class ServingServer(ThreadingHTTPServer):
         self.watchdog.stop()
         if self.timeseries is not None:
             self.timeseries.stop()
+        if self.profiler is not None:
+            self.profiler.stop()
         self.worker.drain(timeout=drain_timeout)
         self.shutdown()
         if self._serve_thread is not None:
@@ -545,6 +580,10 @@ class ServingServer(ThreadingHTTPServer):
                             "fired_total": ts.alerts_fired,
                             "ticks": ts.ticks}
                            if ts is not None else None),
+                "profiling": (self.profiler.stats()
+                              if self.profiler is not None else None),
+                "captures": (self.capture.index()
+                             if self.capture is not None else None),
                 "series": ts.windows() if ts is not None else {}}
 
 
@@ -557,6 +596,10 @@ _DEBUG_INDEX = {
     "/debug/resources": "resource-tracker snapshot + engine pool census",
     "/debug/fleet": "compact replica summary: pool census, prefix "
                     "digest, burn rates, alerts, series windows",
+    "/debug/profile": "sample a phase-attributed profile window: "
+                      "?seconds=N&format=folded|chrome|json",
+    "/debug/captures": "alert-triggered diagnostic capture index + "
+                       "retained evidence bundles",
 }
 
 
@@ -640,10 +683,71 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(200, snap, "/debug/resources")
         elif self.path == "/debug/fleet":
             self._json(200, self.server.fleet_summary(), "/debug/fleet")
+        elif self.path.split("?", 1)[0] == "/debug/profile":
+            self._profile()
+        elif self.path.split("?", 1)[0] == "/debug/captures":
+            cap = self.server.capture
+            if cap is None:
+                self._error(
+                    404, "diagnostic capture disabled (set "
+                    "FLAGS_obs_timeseries_interval_s > 0)",
+                    "/debug/captures")
+            else:
+                self._json(200, {"kind": "replica", "index": cap.index(),
+                                 "recent": cap.recent()},
+                           "/debug/captures")
         elif self.path in ("/debug", "/debug/"):
             self._json(200, {"endpoints": _DEBUG_INDEX}, "/debug/")
         else:
             self._error(404, f"no route {self.path}", self.path)
+
+    def _profile(self):
+        """``GET /debug/profile?seconds=N[&format=...]``: sample a
+        fresh phase-attributed window from THIS handler thread (the
+        continuous profiler, when armed, keeps running independently)
+        and render it folded (flamegraph text, the default), as a
+        chrome-trace merge with the span ring, or as the JSON snapshot
+        (what the router fan-out aggregates)."""
+        from urllib.parse import parse_qs, urlparse
+        q = parse_qs(urlparse(self.path).query)
+        try:
+            seconds = float(q.get("seconds", ["1.0"])[0])
+        except ValueError:
+            self._error(400, "seconds must be a number",
+                        "/debug/profile")
+            return
+        fmt = q.get("format", ["folded"])[0]
+        if fmt not in ("folded", "chrome", "json"):
+            self._error(400, f"unknown format {fmt!r} (folded | "
+                        "chrome | json)", "/debug/profile")
+            return
+        interval = (self.server._profile_interval
+                    if self.server._profile_interval > 0 else 0.01)
+        prof = _obs.SamplingProfiler(
+            interval, phases=self.server._engine_phases)
+        prof.profile_for(seconds)
+        if fmt == "json":
+            self._json(200, dict(prof.snapshot(), kind="replica"),
+                       "/debug/profile")
+            return
+        if fmt == "chrome":
+            self._json(200, {"traceEvents":
+                             (_obs.tracer().chrome_events()
+                              + prof.chrome_events()),
+                             "stats": prof.stats()},
+                       "/debug/profile")
+            return
+        text = (prof.folded() + "\n").encode()
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(text)))
+            self.end_headers()
+            self.wfile.write(text)
+        except (BrokenPipeError, ConnectionResetError,
+                ConnectionAbortedError):
+            pass
+        _M_HTTP_REQS.labels("/debug/profile", "200").inc()
 
     def worker_stats(self) -> dict:
         return self.server.worker.stats()
@@ -847,6 +951,7 @@ def serve(model=None, *, engine: Engine | None = None,
           retry_after_s: float = 1.0, model_name: str = "paddle-tpu",
           watchdog_s: float | None = None,
           timeseries_interval_s: float | None = None,
+          profile_interval_s: float | None = None,
           start: bool = True, **engine_kw) -> ServingServer:
     """One-call server bring-up::
 
@@ -861,7 +966,10 @@ def serve(model=None, *, engine: Engine | None = None,
     watchdog (default: ``FLAGS_serving_watchdog_seconds``; 0 off),
     ``timeseries_interval_s`` arms the fleet-telemetry sampler
     (default: ``FLAGS_obs_timeseries_interval_s``; 0 off — nothing is
-    built), and
+    built; with it on, alert fires also trigger diagnostic captures),
+    ``profile_interval_s`` arms the continuous phase-attributed
+    sampling profiler (default: ``FLAGS_obs_profile_interval_s``;
+    0 off — nothing is built), and
     when the ``FLAGS_serving_slo_*`` targets are set the engine gets an
     :class:`~paddle_tpu.serving.slo.SLOTracker` automatically.
     """
@@ -881,7 +989,8 @@ def serve(model=None, *, engine: Engine | None = None,
     server = ServingServer(worker, host, port,
                            retry_after_s=retry_after_s,
                            model_name=model_name, watchdog_s=watchdog_s,
-                           timeseries_interval_s=timeseries_interval_s)
+                           timeseries_interval_s=timeseries_interval_s,
+                           profile_interval_s=profile_interval_s)
     if start:
         server.start()
     return server

@@ -48,6 +48,12 @@ REPLICA_PAYLOAD = {
                "fired_total": 1, "ticks": 9},
     "series": {"tok_s": [[1, 0.0], [2, 4.0], [3, 8.0]],
                "queue_depth": [[1, 0], [2, 3], [3, 3]]},
+    "profiling": {"interval_s": 0.01, "samples": 120,
+                  "observations": 110, "distinct_stacks": 7,
+                  "dropped": 0},
+    "captures": {"captures": 2, "rate_limited": 1,
+                 "by_rule": {"slo_burn": 2}, "min_interval_s": 60.0,
+                 "max_captures": 8, "dir": "", "retained": []},
 }
 
 
@@ -80,6 +86,24 @@ class TestRender:
         assert "2 quarantines" in text
         assert "p50<=" in text and "ttft" in text
         assert "tok_s" in text          # sparkline history
+        assert "diagnostics: profiler 120 sweeps @ 0.01s" in text
+        assert "captures 2 written / 1 rate-limited" in text
+        assert "slo_burn=2" in text
+
+    def test_replica_without_diagnostics_has_no_line(self):
+        old = {k: v for k, v in REPLICA_PAYLOAD.items()
+               if k not in ("profiling", "captures")}
+        assert "diagnostics:" not in dash.render(old)
+
+    def test_router_frame_carries_diagnostics(self):
+        payload = {"kind": "router", "failovers": 0,
+                   "cluster": {"replicas": 1, "up": 1, "summaries": 1,
+                               "alerts_firing": []},
+                   "replicas": {"127.0.0.1:9": {
+                       "up": True, "summary": REPLICA_PAYLOAD}}}
+        text = dash.render(payload)
+        assert "[127.0.0.1:9]" in text
+        assert "diagnostics: profiler 120 sweeps" in text
 
     def test_router_frame_merges_latency_across_replicas(self):
         r1 = dict(REPLICA_PAYLOAD)
@@ -136,7 +160,8 @@ class TestOnceSmoke:
         m = LlamaForCausalLM(cfg)
         m.eval()
         server = serve(m, max_slots=2, page_size=4, num_pages=64,
-                       watchdog_s=0, timeseries_interval_s=0.02)
+                       watchdog_s=0, timeseries_interval_s=0.02,
+                       profile_interval_s=0.02)
         router = Router([server.address], page_size=4)
         router.probe_once()
         rs = router.serve()
@@ -150,6 +175,9 @@ class TestOnceSmoke:
                     capture_output=True, text=True, timeout=60)
                 assert proc.returncode == 0, proc.stderr
                 assert marker in proc.stdout
+                # profiler + capture recorder are armed on the replica,
+                # so both frames carry the diagnostics line
+                assert "diagnostics: profiler" in proc.stdout
         finally:
             rs.stop()
             server.stop(drain_timeout=5.0)

@@ -501,16 +501,15 @@ class TestServeBenchOverload:
                                       4) == [0, 0, 0, 0]
 
     def _args(self, **over):
+        # bench_args() builds defaults from the REAL parser, so this
+        # helper can never silently miss a newly added bench flag
+        mod = _load_tool("serve_bench")
         base = dict(requests=4, max_slots=2, page_size=4, num_pages=64,
                     arrival_gap_ms=1.0, prompt_len=(4, 8),
-                    new_tokens=(2, 4), shared_prefix_len=0,
-                    sync_interval=1, prefix_cache=False, layers=1,
-                    hidden=32, vocab=64, max_model_len=64,
-                    metrics_dir="", trace="", seed=0, http=False,
-                    replicas=1, heads=4, kv_heads=2, mesh=None,
-                    spec_k=0, arrival="uniform")
+                    new_tokens=(2, 4), prefix_cache=False, layers=1,
+                    hidden=32, vocab=64, max_model_len=64)
         base.update(over)
-        return SimpleNamespace(**base)
+        return mod.bench_args(**base)
 
     def test_run_bench_priority_mix_per_class(self):
         mod = _load_tool("serve_bench")

@@ -1,0 +1,557 @@
+"""Continuous profiling + alert-triggered diagnostic capture.
+
+Profiler units run on a fake clock with an event-parked worker thread
+(the sampler never profiles its own thread, so single-threaded sweeps
+observe nothing).  Capture units drive ``on_alert`` directly; the e2e
+test wires the real chain — engine FaultPlan ``slow_step`` marker ->
+TimeSeriesStore rule -> ``store.on_fire`` -> DiagnosticCapture -> disk.
+HTTP tests cover ``GET /debug/profile`` / ``GET /debug/captures`` on a
+replica and the router fan-out, and the zero-overhead-off contract:
+with the flags unset no profiler or capture object exists at all.
+"""
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+from paddle_tpu.serving import (FaultPlan, GenerationConfig, Router,
+                                ServingClient, create_engine, serve)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    obs.reset()
+    yield
+    obs.reset()
+
+
+def _camp(ready, release):
+    """Worker body with a recognizable frame for stack assertions."""
+    ready.set()
+    release.wait(timeout=30.0)
+
+
+@pytest.fixture
+def parked_thread():
+    """A live thread parked in ``_camp`` for the sampler to observe."""
+    ready, release = threading.Event(), threading.Event()
+    t = threading.Thread(target=_camp, args=(ready, release),
+                         name="parked", daemon=True)
+    t.start()
+    assert ready.wait(timeout=10.0)
+    yield t
+    release.set()
+    t.join(timeout=10.0)
+
+
+def _tiny():
+    paddle.seed(0)
+    cfg = llama_tiny(vocab_size=64, hidden_size=32, intermediate_size=64,
+                     num_attention_heads=4, num_key_value_heads=2,
+                     max_position_embeddings=128)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+# ------------------------------------------------------------- profiler
+class TestSamplingProfiler:
+    def test_sweep_skips_self_and_observes_worker(self, parked_thread):
+        prof = obs.SamplingProfiler(0.01)
+        seen = prof.sample(1.0)
+        assert seen >= 1
+        stats = prof.stats()
+        assert stats["samples"] == 1 and stats["started_at"] == 1.0
+        folded = prof.folded()
+        assert any("test_profiling.py:_camp" in line
+                   for line in folded.splitlines())
+        # the sweeping thread never appears in its own table
+        me = threading.current_thread().name
+        assert not any(line.split(";")[1] == me
+                       for line in folded.splitlines())
+
+    def test_phase_attribution_via_callable(self, parked_thread):
+        ident = parked_thread.ident
+        prof = obs.SamplingProfiler(0.01,
+                                    phases=lambda: {ident: "decode"})
+        for t in (1.0, 2.0, 3.0):
+            prof.sample(t)
+        by_phase = prof.by_phase()
+        assert by_phase.get("decode", 0) >= 3
+        top = prof.top_stacks(5)
+        assert top and top[0]["phase"] == "decode"
+        assert top[0]["thread"] == "parked"
+        # folded lines carry phase;thread as the first two segments
+        line = prof.folded().splitlines()[0]
+        assert line.startswith("decode;parked;")
+        assert line.rsplit(" ", 1)[1].isdigit()
+
+    def test_unmapped_threads_fall_to_other(self, parked_thread):
+        prof = obs.SamplingProfiler(0.01, phases=lambda: {})
+        prof.sample(1.0)
+        assert "other" in prof.by_phase()
+
+    def test_broken_phase_source_never_kills_sweep(self, parked_thread):
+        def boom():
+            raise RuntimeError("phase source down")
+        prof = obs.SamplingProfiler(0.01, phases=boom)
+        assert prof.sample(1.0) >= 1
+        assert "other" in prof.by_phase()
+
+    def test_max_stacks_bounds_table_and_counts_drops(self, parked_thread):
+        prof = obs.SamplingProfiler(0.01, max_stacks=1)
+        ident = parked_thread.ident
+        # two sweeps under two phases -> two distinct keys for the same
+        # stack; the second must drop, not grow the table
+        prof._phases = lambda: {ident: "a"}
+        prof.sample(1.0)
+        prof._phases = lambda: {ident: "b"}
+        prof.sample(2.0)
+        stats = prof.stats()
+        assert stats["distinct_stacks"] == 1
+        assert stats["dropped"] >= 1 and prof.dropped == stats["dropped"]
+
+    def test_chrome_events_share_microsecond_timebase(self, parked_thread):
+        prof = obs.SamplingProfiler(0.01)
+        prof.sample(2.5)
+        evs = prof.chrome_events(pid=7)
+        assert evs
+        ev = evs[0]
+        assert ev["ph"] == "i" and ev["ts"] == 2.5e6 and ev["pid"] == 7
+        assert ev["cat"] == "profile" and ev["args"]["leaf"]
+
+    def test_snapshot_reset_round_trip(self, parked_thread):
+        prof = obs.SamplingProfiler(0.01)
+        prof.sample(1.0)
+        snap = prof.snapshot(top=3)
+        assert set(snap) == {"stats", "by_phase", "top_stacks"}
+        json.dumps(snap)            # bundle must be JSON-serializable
+        prof.reset()
+        s = prof.stats()
+        assert s["samples"] == 0 and s["distinct_stacks"] == 0
+        assert prof.folded() == "" and prof.chrome_events() == []
+
+    def test_start_sampling_noop_for_nonpositive_interval(self):
+        prof = obs.SamplingProfiler(0.0)
+        assert prof.start_sampling() is prof
+        assert prof._thread is None     # watchdog no-op contract
+
+    def test_sampler_thread_lifecycle(self, parked_thread):
+        prof = obs.SamplingProfiler(0.005)
+        prof.start_sampling()
+        deadline = time.monotonic() + 10.0
+        while prof.samples < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        prof.stop()
+        assert prof.samples >= 3
+        assert prof._thread is None
+
+    def test_profile_for_caps_window(self, parked_thread):
+        prof = obs.SamplingProfiler(0.005)
+        prof.profile_for(0.05)
+        assert prof.samples >= 1
+        assert prof.MAX_SECONDS == 60.0
+
+    def test_active_profiler_registration(self):
+        assert obs.active_profiler() is None
+        p = obs.set_active_profiler(obs.SamplingProfiler(0.01))
+        assert obs.active_profiler() is p
+        obs.reset()
+        assert obs.active_profiler() is None
+
+
+# -------------------------------------------------------------- capture
+class TestDiagnosticCapture:
+    def test_bundle_fields_and_disk_write(self, tmp_path, parked_thread):
+        prof = obs.SamplingProfiler(0.01)
+        prof.sample(1.0)
+        cap = obs.DiagnosticCapture(dir_=str(tmp_path),
+                                    min_interval_s=60.0, max_captures=4,
+                                    profiler=prof, clock=lambda: 0.0)
+        bundle = cap.on_alert("burn", {"value": 2.5}, now=10.0)
+        assert bundle is not None
+        assert bundle["rule"] == "burn" and bundle["capture"] == 1
+        assert bundle["alert"] == {"value": 2.5}
+        assert bundle["captured_at"] == 10.0
+        assert bundle["profile"]["stats"]["samples"] == 1
+        assert "events" in bundle["flight"]
+        path = tmp_path / "capture_1.json"
+        assert bundle["path"] == str(path)
+        doc = json.loads(path.read_text())
+        assert doc["rule"] == "burn"
+
+    def test_rate_limit_per_rule(self):
+        cap = obs.DiagnosticCapture(dir_=None, min_interval_s=30.0,
+                                    max_captures=4, clock=lambda: 0.0)
+        assert cap.on_alert("burn", now=0.0) is not None
+        assert cap.on_alert("burn", now=10.0) is None   # inside window
+        assert cap.on_alert("frag", now=10.0) is not None  # other rule
+        assert cap.on_alert("burn", now=31.0) is not None  # expired
+        assert cap.captures == 3 and cap.rate_limited == 1
+        assert cap.by_rule == {"burn": 2, "frag": 1}
+
+    def test_retention_evicts_oldest_file(self, tmp_path):
+        cap = obs.DiagnosticCapture(dir_=str(tmp_path),
+                                    min_interval_s=0.0, max_captures=2)
+        for i in range(4):
+            assert cap.on_alert("burn", now=float(i)) is not None
+        files = sorted(f for f in os.listdir(tmp_path)
+                       if f.startswith("capture_"))
+        assert files == ["capture_3.json", "capture_4.json"]
+        # the in-memory ring is bounded the same way
+        assert [b["capture"] for b in cap.recent()] == [3, 4]
+        idx = cap.index()
+        assert idx["captures"] == 4
+        assert [b["capture"] for b in idx["retained"]] == [3, 4]
+
+    def test_no_dir_keeps_memory_only_bundles(self):
+        cap = obs.DiagnosticCapture(dir_=None, min_interval_s=0.0,
+                                    max_captures=4)
+        b = cap.on_alert("burn", now=0.0)
+        assert b is not None and b["path"] is None
+        assert cap.index()["dir"] is None
+
+    def test_broken_profiler_degrades_field_not_capture(self):
+        class Boom:
+            def snapshot(self):
+                raise RuntimeError("down")
+        cap = obs.DiagnosticCapture(dir_=None, min_interval_s=0.0,
+                                    max_captures=2, profiler=Boom())
+        b = cap.on_alert("burn", now=0.0)
+        assert b is not None and b["profile"] is None
+
+    def test_store_fire_transition_triggers_capture(self):
+        fake = [0.0]
+        store = obs.TimeSeriesStore(capacity=64, clock=lambda: fake[0])
+        level = [0.0]
+        store.add_source("pressure", lambda: level[0])
+        store.add_rule(obs.AlertRule("pressure_high", "pressure",
+                                     above=1.0, min_samples=1))
+        cap = obs.DiagnosticCapture(dir_=None, min_interval_s=3600.0,
+                                    max_captures=2,
+                                    clock=lambda: fake[0])
+        assert cap.attach(store) is cap and store.on_fire == cap.on_alert
+        store.tick()                        # below threshold: no fire
+        assert cap.captures == 0
+        fake[0] = 1.0
+        level[0] = 5.0
+        store.tick()                        # clear -> firing: capture
+        assert cap.captures == 1
+        b = cap.recent()[0]
+        assert b["rule"] == "pressure_high"
+        assert b["alert"]["value"] == 5.0
+        assert "pressure" in (b["series"] or {})
+        fake[0] = 2.0
+        store.tick()                        # still firing: no new edge
+        assert cap.captures == 1
+
+    def test_active_capture_registration(self):
+        assert obs.active_capture() is None
+        c = obs.set_active_capture(obs.DiagnosticCapture(
+            dir_=None, min_interval_s=1.0, max_captures=1))
+        assert obs.active_capture() is c
+        obs.reset()
+        assert obs.active_capture() is None
+
+    def test_dump_writes_side_files_only_when_armed(self, tmp_path):
+        obs.dump(str(tmp_path / "off"))
+        assert not (tmp_path / "off" / "profile.json").exists()
+        assert not (tmp_path / "off" / "captures.json").exists()
+        prof = obs.set_active_profiler(obs.SamplingProfiler(0.01))
+        cap = obs.set_active_capture(obs.DiagnosticCapture(
+            dir_=None, min_interval_s=0.0, max_captures=2,
+            profiler=prof))
+        cap.on_alert("burn", now=0.0)
+        obs.dump(str(tmp_path / "on"))
+        prof_doc = json.loads(
+            (tmp_path / "on" / "profile.json").read_text())
+        assert set(prof_doc) == {"stats", "by_phase", "top_stacks"}
+        cap_doc = json.loads(
+            (tmp_path / "on" / "captures.json").read_text())
+        assert cap_doc["captures"] == 1
+
+
+# ------------------------------------------------- engine phases + e2e
+class TestEnginePhases:
+    def test_phase_seam_publication(self):
+        eng = create_engine(_tiny(), max_slots=2, page_size=4,
+                            num_pages=64, sync_interval=1)
+        assert eng.current_phase == "idle"
+        phases = set()
+        req = eng.submit([1, 2, 3, 4, 5, 6],
+                         GenerationConfig(max_new_tokens=4))
+        orig_prefill, orig_decode = eng._prefill, eng._decode
+
+        def spy_prefill(*a, **kw):
+            out = orig_prefill(*a, **kw)
+            phases.add(eng.current_phase)
+            return out
+
+        def spy_decode(*a, **kw):
+            out = orig_decode(*a, **kw)
+            phases.add(eng.current_phase)
+            return out
+
+        eng._prefill, eng._decode = spy_prefill, spy_decode
+        steps = 0
+        while not req.is_finished() and steps < 200:
+            eng.step()
+            steps += 1
+        # sync_interval=1: _sync tail-call overwrites decode/prefill by
+        # the time the spy reads it; the seams it DID pass through are
+        # what matters, and step() always parks back at idle
+        assert "host_sync" in phases
+        assert eng.current_phase == "idle"
+
+    def test_slow_step_alert_captures_evidence(self, tmp_path):
+        """The full chain: injected slow_step marker -> series source ->
+        rule fire -> on_fire hook -> bundle on disk, exactly once."""
+        plan = FaultPlan(seed=0)
+        plan.add("slow_step", at=2, seconds=0.0)
+        eng = create_engine(_tiny(), max_slots=2, page_size=4,
+                            num_pages=64, sync_interval=1, faults=plan)
+        fake = [0.0]
+        store = obs.TimeSeriesStore(capacity=64, clock=lambda: fake[0])
+        store.add_source("slow_steps", lambda: float(
+            plan.injected.get("slow_step", 0)))
+        store.add_rule(obs.AlertRule("slow_step_injected", "slow_steps",
+                                     above=0, min_samples=1))
+        prof = obs.SamplingProfiler(0.0)
+        cap = obs.DiagnosticCapture(dir_=str(tmp_path),
+                                    min_interval_s=3600.0,
+                                    max_captures=4, profiler=prof,
+                                    clock=lambda: fake[0]).attach(store)
+        req = eng.submit([1, 2, 3, 4, 5, 6],
+                         GenerationConfig(max_new_tokens=6))
+        steps = 0
+        while not req.is_finished() and steps < 200:
+            eng.step()
+            steps += 1
+            fake[0] += 1.0
+            prof.sample(fake[0])
+            store.tick()
+        assert req.is_finished()
+        assert plan.injected.get("slow_step") == 1
+        assert store.alerts_fired == 1
+        assert cap.captures == 1 and cap.rate_limited == 0
+        doc = json.loads((tmp_path / "capture_1.json").read_text())
+        assert doc["rule"] == "slow_step_injected"
+        assert doc["series"]["slow_steps"][-1][1] == 1.0
+        # the full evidence set: flight ring, resource census, and the
+        # sanitizer's lock-wait graph ride along with the profile
+        assert doc["flight"]["events"]
+        assert "pool" in doc["resources"]
+        assert isinstance(doc["lock_wait_graph"], dict)
+        # the profile is snapshotted AT fire time, mid-run — between
+        # the fault landing and the workload finishing
+        assert 1 <= doc["profile"]["stats"]["samples"] <= steps
+
+
+# ------------------------------------------------------- HTTP surfaces
+class TestHTTPProfileAndCaptures:
+    @pytest.fixture(scope="class")
+    def server(self):
+        srv = serve(_tiny(), max_slots=2, page_size=4, num_pages=64,
+                    max_model_len=128, watchdog_s=0,
+                    timeseries_interval_s=0.02, profile_interval_s=0.02)
+        yield srv
+        srv.stop(drain_timeout=5.0)
+
+    def test_debug_index_lists_new_routes(self, server):
+        doc = ServingClient(server.address).request("GET", "/debug")
+        eps = doc["endpoints"]
+        assert "/debug/profile" in eps and "/debug/captures" in eps
+
+    def test_profile_json_window(self, server):
+        cl = ServingClient(server.address, timeout=30.0)
+        cl.completion_tokens([1, 2, 3, 4], max_tokens=4)
+        doc = cl.request(
+            "GET", "/debug/profile?seconds=0.2&format=json")
+        assert doc["kind"] == "replica"
+        assert doc["stats"]["samples"] >= 1
+        # the engine worker thread is attributed by name
+        threads = {s["thread"] for s in doc["top_stacks"]}
+        assert any(t == "engine-worker" for t in threads)
+
+    def test_profile_folded_default(self, server):
+        cl = ServingClient(server.address, timeout=30.0)
+        body = cl.request("GET", "/debug/profile?seconds=0.1")
+        assert isinstance(body, str) and ";" in body
+        first = body.splitlines()[0]
+        assert first.rsplit(" ", 1)[1].isdigit()
+
+    def test_profile_chrome_format(self, server):
+        cl = ServingClient(server.address, timeout=30.0)
+        doc = cl.request(
+            "GET", "/debug/profile?seconds=0.1&format=chrome")
+        assert "traceEvents" in doc
+        assert any(ev.get("cat") == "profile"
+                   for ev in doc["traceEvents"])
+
+    def test_profile_bad_params_are_400(self, server):
+        cl = ServingClient(server.address)
+        for q in ("seconds=nope", "format=bogus"):
+            with pytest.raises(Exception) as err:
+                cl.request("GET", f"/debug/profile?{q}")
+            assert "400" in str(err.value)
+
+    def test_captures_index_served(self, server):
+        doc = ServingClient(server.address).request(
+            "GET", "/debug/captures")
+        assert doc["kind"] == "replica"
+        # the live server's default alert rules may legitimately have
+        # fired during earlier tests — assert shape, not quiet
+        idx = doc["index"]
+        assert idx["captures"] >= 0 and idx["max_captures"] >= 1
+        assert len(doc["recent"]) == len(idx["retained"])
+
+    def test_fleet_summary_carries_diagnostics(self, server):
+        doc = ServingClient(server.address).request(
+            "GET", "/debug/fleet")
+        assert doc["profiling"]["interval_s"] == 0.02
+        assert doc["captures"]["max_captures"] >= 1
+
+
+class TestZeroOverheadOff:
+    def test_default_serve_builds_no_profiler_or_capture(self):
+        srv = serve(_tiny(), max_slots=2, page_size=4, num_pages=64,
+                    max_model_len=128, watchdog_s=0)
+        try:
+            assert srv.profiler is None and srv.capture is None
+            assert obs.active_profiler() is None
+            assert obs.active_capture() is None
+            doc = ServingClient(srv.address).request(
+                "GET", "/debug/fleet")
+            assert doc["profiling"] is None and doc["captures"] is None
+            with pytest.raises(Exception) as err:
+                ServingClient(srv.address).request(
+                    "GET", "/debug/captures")
+            assert "404" in str(err.value)
+        finally:
+            srv.stop(drain_timeout=5.0)
+
+    def test_store_without_hook_is_unaffected(self):
+        fake = [0.0]
+        store = obs.TimeSeriesStore(capacity=64, clock=lambda: fake[0])
+        store.add_source("x", lambda: 5.0)
+        store.add_rule(obs.AlertRule("x_high", "x", above=1.0,
+                                     min_samples=1))
+        assert store.on_fire is None
+        store.tick()
+        assert store.alerts_fired == 1      # fires fine with no hook
+
+
+class TestRouterFanout:
+    def test_profile_and_captures_fan_out(self):
+        servers = [serve(_tiny(), max_slots=2, page_size=4,
+                         num_pages=64, max_model_len=128, watchdog_s=0,
+                         timeseries_interval_s=0.02,
+                         profile_interval_s=0.02) for _ in range(2)]
+        router = Router([s.address for s in servers], page_size=4)
+        router.probe_once()
+        rs = router.serve()
+        try:
+            cl = ServingClient(rs.address, timeout=60.0)
+            doc = cl.request("GET", "/debug/profile?seconds=0.2")
+            assert doc["kind"] == "router" and doc["seconds"] == 0.2
+            assert set(doc["replicas"]) == {s.address for s in servers}
+            for rep in doc["replicas"].values():
+                assert rep.get("kind") == "replica", rep
+                assert rep["stats"]["samples"] >= 1
+            caps = cl.request("GET", "/debug/captures")
+            assert caps["kind"] == "router"
+            assert set(caps["replicas"]) == {s.address
+                                             for s in servers}
+            for rep in caps["replicas"].values():
+                assert rep["index"]["captures"] == 0
+            with pytest.raises(Exception) as err:
+                cl.request("GET", "/debug/profile?seconds=nope")
+            assert "400" in str(err.value)
+        finally:
+            rs.stop()
+            for s in servers:
+                s.stop(drain_timeout=5.0)
+
+
+# --------------------------------------------------- CLI tool surfaces
+class TestServeBenchProfile:
+    def _args(self, mod, **over):
+        base = dict(requests=3, max_slots=2, page_size=4, num_pages=64,
+                    arrival_gap_ms=1.0, prompt_len=(4, 8),
+                    new_tokens=(2, 4), layers=1, hidden=32, vocab=64,
+                    max_model_len=64)
+        base.update(over)
+        return mod.bench_args(**base)
+
+    def test_bench_args_defaults_track_parser(self):
+        mod = _load_tool("serve_bench")
+        args = mod.bench_args()
+        # every parser default is present; a few spot checks
+        assert args.requests and args.profile == ""
+        assert mod.bench_args(requests=9).requests == 9
+
+    def test_bench_args_rejects_unknown_names(self):
+        mod = _load_tool("serve_bench")
+        with pytest.raises(TypeError):
+            mod.bench_args(reqests=9)       # typo must fail loudly
+
+    def test_profile_flag_writes_folded_file(self, tmp_path):
+        mod = _load_tool("serve_bench")
+        out = tmp_path / "bench.folded"
+        res = mod.run_bench(self._args(mod, profile=str(out)))
+        assert res["requests"] == 3
+        assert res["profile_path"] == str(out)
+        assert res["profile_samples"] >= 1
+        assert isinstance(res["profile_by_phase"], dict)
+        text = out.read_text()
+        assert text.strip()
+        line = text.splitlines()[0]
+        assert line.rsplit(" ", 1)[1].isdigit()
+        # and the report tool renders it
+        report = _load_tool("profile_report")
+        snap = report.load(str(out))
+        assert snap["stats"]["observations"] >= 1
+
+
+class TestProfileReportTool:
+    def test_parse_folded_tolerates_garbage(self):
+        mod = _load_tool("profile_report")
+        stacks = mod.parse_folded(
+            "decode;main;a.py:f;b.py:g 3\n\nnot-a-count x\n"
+            "prefill;main;a.py:f 2\n")
+        assert (("decode", "main", "a.py:f", "b.py:g"), 3) in stacks
+        assert len(stacks) == 2
+
+    def test_render_sections(self, capsys):
+        mod = _load_tool("profile_report")
+        snap = mod.folded_to_snapshot(mod.parse_folded(
+            "decode;main;a.py:f;b.py:g 3\nprefill;main;a.py:f 2\n"))
+        mod.render(snap)
+        text = capsys.readouterr().out
+        assert "samples by phase" in text
+        assert "b.py:g" in text and "decode" in text
+
+    def test_cli_round_trip(self, tmp_path, capsys):
+        mod = _load_tool("profile_report")
+        p = tmp_path / "x.folded"
+        p.write_text("decode;main;a.py:f 4\n")
+        assert mod.main([str(p), "--phase", "decode"]) == 0
+        out = capsys.readouterr().out
+        assert "decode" in out and "a.py:f" in out
+        assert mod.main([str(tmp_path / "missing.folded")]) == 2

@@ -433,7 +433,7 @@ class TestDumpAndReport:
         assert doc["compiles"]["jits"]["decode_step"]["count"] == 1
 
         mod = _load_tool("metrics_report")
-        metrics, retraces, trace, flight, resources, _ = \
+        metrics, retraces, trace, flight, resources, *_ = \
             mod._load(str(tmp_path))
         assert resources["goodput"]["useful_tokens"] == 8
         text = mod.report(metrics, retraces, trace=trace, flight=flight,
@@ -447,9 +447,9 @@ class TestDumpAndReport:
         obs.dump(str(tmp_path))
         os.remove(tmp_path / "resources.json")
         mod = _load_tool("metrics_report")
-        *_, resources, _ = mod._load(str(tmp_path))
+        resources = mod._load(str(tmp_path))[4]
         assert resources is None
-        metrics, retraces, trace, flight, resources, _ = \
+        metrics, retraces, trace, flight, resources, *_ = \
             mod._load(str(tmp_path))
         text = mod.report(metrics, retraces, trace=trace, flight=flight,
                           resources=resources)

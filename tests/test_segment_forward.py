@@ -87,3 +87,67 @@ def test_added_sublayer_invalidates():
     seq.add_sublayer("relu", nn.ReLU())
     out2 = np.asarray(seq(x)._data)
     np.testing.assert_allclose(out2, np.maximum(out1, 0.0), rtol=1e-6)
+
+
+# ------------------------------------------------- per-class eligibility
+# ADVICE r5 regression: auto-segmenting defaults to framework-defined
+# layer types only; a user subclass's hand-written forward may read
+# mutable Python state the purity probe cannot see, so it must opt in.
+
+
+class _UserScale(nn.Layer):
+    """User subclass whose forward reads a mutable python attribute —
+    exactly the stale-replay hazard the default must NOT bake in."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(8, 8)
+        self.scale = 1.0
+
+    def forward(self, x):
+        return self.fc(x) * self.scale
+
+
+class _OptedIn(_UserScale):
+    segment_forward = True
+
+
+def test_user_subclass_does_not_segment_by_default():
+    paddle.seed(6)
+    blk = _UserScale()
+    x = _x()
+    out1 = np.asarray(blk(x)._data)
+    assert "_seg_cache" not in blk.__dict__     # gate: not eligible
+    # the mutable attribute is honored on every call, never baked in
+    blk.scale = 2.0
+    out2 = np.asarray(blk(x)._data)
+    np.testing.assert_allclose(out2, out1 * 2.0, rtol=1e-6)
+
+
+def test_user_subclass_opts_in_per_class():
+    paddle.seed(7)
+    blk = _OptedIn()
+    x = _x()
+    blk(x)
+    assert "_seg_cache" in blk.__dict__ and blk._seg_cache[1]
+
+
+def test_framework_type_can_opt_out():
+    prev = LC._SEG_ELIGIBLE.pop(nn.Sequential, None)
+    nn.Sequential.segment_forward = False
+    try:
+        paddle.seed(8)
+        seq = nn.Sequential(nn.Linear(8, 8))
+        seq(_x())
+        assert "_seg_cache" not in seq.__dict__
+    finally:
+        del nn.Sequential.segment_forward
+        LC._SEG_ELIGIBLE.pop(nn.Sequential, None)
+        if prev is not None:
+            LC._SEG_ELIGIBLE[nn.Sequential] = prev
+
+
+def test_framework_types_stay_eligible():
+    assert LC.segment_eligible(nn.Sequential)
+    assert not LC.segment_eligible(_UserScale)
+    assert LC.segment_eligible(_OptedIn)

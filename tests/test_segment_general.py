@@ -1,7 +1,10 @@
-"""General segment tracing (Layer._segment_call): ANY hook/buffer-free
+"""General segment tracing (Layer._segment_call): a hook/buffer-free
 composite layer — hand-written forward included — runs as one cached
-dispatch.  Reference hot-path goal: phi/README.md §1.2 (dygraph is the
-default UX; its dispatch must be lean)."""
+dispatch.  Framework-defined types auto-segment; the user subclasses
+here opt in per class with ``segment_forward = True`` (the default-off
+side is covered by tests/test_segment_forward.py).  Reference hot-path
+goal: phi/README.md §1.2 (dygraph is the default UX; its dispatch must
+be lean)."""
 import numpy as np
 import pytest
 
@@ -19,6 +22,8 @@ def _on():
 
 class Block(nn.Layer):
     """Hand-written forward: residual MLP (not a Sequential)."""
+
+    segment_forward = True          # user subclass: opt in per class
 
     def __init__(self, d=8):
         super().__init__()
@@ -86,6 +91,8 @@ def test_hook_registration_disables_segment():
 
 def test_train_eval_flip_invalidates():
     class DropBlock(nn.Layer):
+        segment_forward = True
+
         def __init__(self):
             super().__init__()
             self.fc = nn.Linear(8, 8)
@@ -113,6 +120,8 @@ def test_train_eval_flip_invalidates():
 
 def test_buffered_layer_falls_back():
     class BNBlock(nn.Layer):
+        segment_forward = True
+
         def __init__(self):
             super().__init__()
             self.fc = nn.Linear(8, 8)
@@ -132,6 +141,8 @@ def test_buffered_layer_falls_back():
 
 def test_untraceable_forward_falls_back():
     class HostBlock(nn.Layer):
+        segment_forward = True
+
         def __init__(self):
             super().__init__()
             self.fc = nn.Linear(8, 8)

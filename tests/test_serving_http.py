@@ -556,19 +556,8 @@ class TestDynamicDecodeZeroIterations:
 
 # ------------------------------------------------- serve_bench --http
 class TestServeBenchHTTP:
-    def _args(self, **over):
-        import argparse
-        base = dict(requests=4, max_slots=2, page_size=PAGE,
-                    num_pages=64, arrival_gap_ms=1.0, arrival="uniform",
-                    prompt_len=(4, 8), new_tokens=(2, 4),
-                    shared_prefix_len=PAGE, sync_interval=1,
-                    prefix_cache=True, spec_k=0, layers=1, hidden=32,
-                    heads=4, kv_heads=2, vocab=64, max_model_len=64,
-                    metrics_dir="", seed=0, http=True, replicas=2)
-        base.update(over)
-        return argparse.Namespace(**base)
-
-    def test_http_bench_smoke(self):
+    @staticmethod
+    def _load_bench():
         import importlib.util
         import os
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -576,6 +565,21 @@ class TestServeBenchHTTP:
             "serve_bench", os.path.join(repo, "tools", "serve_bench.py"))
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
+        return mod
+
+    def _args(self, **over):
+        # bench_args() builds defaults from the REAL parser, so this
+        # helper can never silently miss a newly added bench flag
+        base = dict(requests=4, max_slots=2, page_size=PAGE,
+                    num_pages=64, arrival_gap_ms=1.0, prompt_len=(4, 8),
+                    new_tokens=(2, 4), shared_prefix_len=PAGE, layers=1,
+                    hidden=32, vocab=64, max_model_len=64, http=True,
+                    replicas=2)
+        base.update(over)
+        return self._load_bench().bench_args(**base)
+
+    def test_http_bench_smoke(self):
+        mod = self._load_bench()
         res = mod.run_http_bench(self._args())
         assert res["requests"] == 4
         assert res["tokens"] >= 4 * 2

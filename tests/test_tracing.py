@@ -676,16 +676,15 @@ class TestFinishReason:
 # ------------------------------------------------------ CLI tool surface
 class TestServeBenchTrace:
     def _args(self, **over):
+        # bench_args() builds defaults from the REAL parser, so this
+        # helper can never silently miss a newly added bench flag
+        mod = _load_tool("serve_bench")
         base = dict(requests=3, max_slots=2, page_size=PAGE,
                     num_pages=64, arrival_gap_ms=1.0, prompt_len=(4, 8),
-                    new_tokens=(2, 4), shared_prefix_len=0,
-                    sync_interval=1, prefix_cache=True, layers=1,
-                    hidden=32, vocab=64, max_model_len=64,
-                    metrics_dir="", trace="", seed=0, http=False,
-                    replicas=1, heads=4, kv_heads=2, mesh=None,
-                    spec_k=0, arrival="uniform")
+                    new_tokens=(2, 4), layers=1, hidden=32, vocab=64,
+                    max_model_len=64)
         base.update(over)
-        return SimpleNamespace(**base)
+        return mod.bench_args(**base)
 
     def test_trace_flag_writes_loadable_chrome_trace(self, tmp_path):
         mod = _load_tool("serve_bench")
@@ -730,7 +729,7 @@ class TestMetricsReport:
             "type": "counter", "help": "", "series":
             [{"labels": {}, "value": 12.0}]}}
         (tmp_path / "metrics.json").write_text(json.dumps(old))
-        metrics, retraces, trace, flight, resources, _ = \
+        metrics, retraces, trace, flight, resources, *_ = \
             mod._load(str(tmp_path))
         assert retraces is None and trace is None and flight is None
         assert resources is None
@@ -744,7 +743,7 @@ class TestMetricsReport:
         (tmp_path / "metrics.json").write_text("{}")
         (tmp_path / "trace.json").write_text("{not json")
         (tmp_path / "flight.json").write_text("")
-        _, _, trace, flight, _, _ = mod._load(str(tmp_path))
+        _, _, trace, flight, *_ = mod._load(str(tmp_path))
         assert trace is None and flight is None
 
     def test_renders_slo_and_tracing_sections(self, tmp_path):

@@ -15,6 +15,10 @@ kind "replica" is one server's own census).  Renders:
   * latency quantiles (p50/p95/p99) estimated from the published
     cumulative buckets — merged ACROSS replicas before estimating,
     which is why replicas publish raw buckets and not quantiles;
+  * a diagnostics line per replica: continuous-profiler sweep counts
+    and alert-triggered capture tallies (requires
+    ``FLAGS_obs_profile_interval_s`` /
+    ``FLAGS_obs_timeseries_interval_s`` on the replicas);
   * sparkline history from each replica's recent time-series windows
     (requires ``FLAGS_obs_timeseries_interval_s`` on the replicas).
 
@@ -176,6 +180,32 @@ def _series_lines(series, names=None) -> list[str]:
     return ["History"] + lines if lines else []
 
 
+def _diagnostics_line(fl, indent: str = "  ") -> list[str]:
+    """Profiler + alert-evidence capture line from a replica's
+    fleet_summary ("profiling" / "captures" keys).  Replicas that
+    predate the profiling subsystem — or run with it off — publish
+    neither key and produce no line."""
+    prof = (fl or {}).get("profiling") or {}
+    caps = (fl or {}).get("captures") or {}
+    parts = []
+    if prof:
+        parts.append(
+            f"profiler {_fmt(prof.get('samples'))} sweeps @ "
+            f"{_fmt(prof.get('interval_s'))}s "
+            f"({_fmt(prof.get('distinct_stacks'))} stacks, "
+            f"{_fmt(prof.get('dropped'))} dropped)")
+    if caps:
+        part = (f"captures {_fmt(caps.get('captures'))} written / "
+                f"{_fmt(caps.get('rate_limited'))} rate-limited")
+        by_rule = caps.get("by_rule") or {}
+        if by_rule:
+            part += " (" + ", ".join(
+                f"{k}={_fmt(v)}"
+                for k, v in sorted(by_rule.items())) + ")"
+        parts.append(part)
+    return [indent + "diagnostics: " + ", ".join(parts)] if parts else []
+
+
 def _replica_row(address, up, fl):
     pool = (fl or {}).get("pool") or {}
     slots = (fl or {}).get("slots") or {}
@@ -235,9 +265,11 @@ def render_router(payload) -> str:
     if lat:
         out += [""] + lat
     for addr, entry in sorted(replicas.items()):
-        hist = _series_lines((entry.get("summary") or {}).get("series"))
-        if hist:
-            out += ["", f"[{addr}]"] + hist[1:]
+        fl = entry.get("summary") or {}
+        diag = _diagnostics_line(fl)
+        hist = _series_lines(fl.get("series"))
+        if diag or hist:
+            out += ["", f"[{addr}]"] + diag + (hist[1:] if hist else [])
     return "\n".join(out)
 
 
@@ -262,6 +294,7 @@ def render_replica(payload) -> str:
         out.append(f"  recovery: {_fmt(rec.get('recoveries'))} rebuilds,"
                    f" {_fmt(rec.get('quarantines'))} quarantines,"
                    f" {_fmt(rec.get('replayed_requests'))} replays")
+    out += _diagnostics_line(payload)
     sched = payload.get("scheduling") or {}
     if any(v for k, v in sched.items() if k != "prefill_chunk"):
         line = (f"  overload: {_fmt(sched.get('prefill_chunks'))} "

@@ -4,11 +4,12 @@
 Usage:
     python tools/metrics_report.py <dump-dir | metrics.json> [--prom]
 
-Reads metrics.json (+ retraces.json / trace.json / flight.json when
-present) from the dump directory FLAGS_metrics_dir pointed at, and
-renders counters, gauges, histograms, SLO verdicts, fault-tolerance
-events, finish reasons, the span-trace summary, and the retrace log
-as aligned tables.  --prom
+Reads metrics.json (+ retraces.json / trace.json / flight.json /
+resources.json / profile.json / captures.json when present) from the
+dump directory FLAGS_metrics_dir pointed at, and renders counters,
+gauges, histograms, SLO verdicts, fault-tolerance events, finish
+reasons, the span-trace summary, the sampling-profiler + diagnostic-
+capture summary, and the retrace log as aligned tables.  --prom
 cats the raw Prometheus text instead (what a scraper would see).
 
 Every section is optional: a dump produced by an older build (no SLO
@@ -52,7 +53,10 @@ def _load(path):
     trace = _read_json(os.path.join(dir_, "trace.json"))
     flight = _read_json(os.path.join(dir_, "flight.json"))
     resources = _read_json(os.path.join(dir_, "resources.json"))
-    return metrics, retraces, trace, flight, resources, prom_path
+    profile = _read_json(os.path.join(dir_, "profile.json"))
+    captures = _read_json(os.path.join(dir_, "captures.json"))
+    return (metrics, retraces, trace, flight, resources, profile,
+            captures, prom_path)
 
 
 def _fmt_value(v):
@@ -604,7 +608,76 @@ def _resources_section(resources):
     return "\n".join(lines) if len(lines) > 1 else None
 
 
-def report(metrics, retraces, trace=None, flight=None, resources=None):
+def _profiling_section(profile, captures, metrics):
+    """Sampling-profiler + diagnostic-capture summary from
+    profile.json / captures.json (with obs_captures_total from
+    metrics.json as a fallback when the side-files are absent) —
+    dumps that predate the profiling subsystem have none of these
+    keys and produce no section."""
+    lines = ["Profiling"]
+    if isinstance(profile, dict):
+        stats = profile.get("stats") or {}
+        if stats:
+            lines.append(
+                f"  sampler: {_fmt_value(stats.get('samples', 0))} "
+                f"sweeps, {_fmt_value(stats.get('observations', 0))} "
+                f"stack observations, "
+                f"{_fmt_value(stats.get('distinct_stacks', 0))} "
+                f"distinct stacks, "
+                f"{_fmt_value(stats.get('dropped', 0))} dropped "
+                f"(interval {float(stats.get('interval_s') or 0):g}s)")
+        by_phase = profile.get("by_phase") or {}
+        if by_phase:
+            total = sum(by_phase.values()) or 1
+            lines.append("  samples by phase: " + ", ".join(
+                f"{ph}={_fmt_value(n)} ({100.0 * n / total:.0f}%)"
+                for ph, n in sorted(by_phase.items(),
+                                    key=lambda kv: -kv[1])))
+        tops = profile.get("top_stacks") or []
+        if tops:
+            leaves = {}
+            for ent in tops:
+                if not isinstance(ent, dict):
+                    continue
+                stack = ent.get("stack") or []
+                leaf = stack[-1] if stack else "(no frames)"
+                leaves[leaf] = (leaves.get(leaf, 0)
+                                + int(ent.get("count") or 0))
+            hot = sorted(leaves.items(), key=lambda kv: -kv[1])[:5]
+            lines.append("  hottest frames (self time): " + ", ".join(
+                f"{f}={n}" for f, n in hot))
+    by_rule = None
+    if isinstance(captures, dict):
+        lines.append(
+            f"  captures: {_fmt_value(captures.get('captures', 0))} "
+            f"written, {_fmt_value(captures.get('rate_limited', 0))} "
+            f"rate-limited (min interval "
+            f"{float(captures.get('min_interval_s') or 0):g}s, keep "
+            f"{_fmt_value(captures.get('max_captures', 0))}, dir "
+            f"{captures.get('dir') or '-'})")
+        by_rule = captures.get("by_rule") or None
+        for b in captures.get("retained") or []:
+            if isinstance(b, dict):
+                lines.append(
+                    f"    capture_{b.get('capture', '?')}: rule "
+                    f"{b.get('rule', '?')} -> "
+                    f"{b.get('path') or '(memory only)'}")
+    if by_rule is None:
+        # older in-memory-only path: fall back to the counter family
+        by_rule = {}
+        entry = (metrics or {}).get("obs_captures_total") or {}
+        for s in entry.get("series", []):
+            rule = (s.get("labels") or {}).get("rule", "-")
+            by_rule[rule] = by_rule.get(rule, 0) + int(
+                s.get("value") or 0)
+    if by_rule:
+        lines.append("  captures by rule: " + ", ".join(
+            f"{k}={_fmt_value(v)}" for k, v in sorted(by_rule.items())))
+    return "\n".join(lines) if len(lines) > 1 else None
+
+
+def report(metrics, retraces, trace=None, flight=None, resources=None,
+           profile=None, captures=None):
     simple_rows = {"counter": [], "gauge": []}
     hist_blocks = []
     for name, entry in sorted(metrics.items()):
@@ -647,6 +720,9 @@ def report(metrics, retraces, trace=None, flight=None, resources=None):
     res = _resources_section(resources)
     if res:
         out += [res, ""]
+    prof = _profiling_section(profile, captures, metrics)
+    if prof:
+        out += [prof, ""]
     if retraces and retraces.get("entries"):
         entries = sorted(retraces["entries"],
                          key=lambda e: (-e["count"], e["op"]))
@@ -669,15 +745,16 @@ def main(argv=None):
     ap.add_argument("--prom", action="store_true",
                     help="print the raw Prometheus text export")
     args = ap.parse_args(argv)
-    metrics, retraces, trace, flight, resources, prom_path = \
-        _load(args.path)
+    (metrics, retraces, trace, flight, resources, profile, captures,
+     prom_path) = _load(args.path)
     if args.prom:
         if not os.path.exists(prom_path):
             sys.exit(f"metrics_report: no metrics.prom at {prom_path!r}")
         with open(prom_path) as f:
             print(f.read(), end="")
         return 0
-    print(report(metrics, retraces, trace, flight, resources))
+    print(report(metrics, retraces, trace, flight, resources,
+                 profile, captures))
     return 0
 
 

@@ -97,6 +97,18 @@ DIRECTIONS = {
     "alerts_fired": "exact",
     "host_syncs_delta_vs_off": "exact",
     "decode_traces_delta_vs_off": "exact",
+    # profiling: the sampler must sweep exactly once per driven step
+    # with zero stack-table drops, the injected slow_step alert must
+    # produce exactly one on-disk capture (a second fire inside the
+    # rate-limit window is rejected, not written), and arming the
+    # whole stack must add ZERO host syncs / decode traces over the
+    # bare control (the zero-overhead-off contract of
+    # FLAGS_obs_profile_interval_s / FLAGS_obs_capture_*)
+    "captures_written": "exact",
+    "capture_files": "exact",
+    "capture_rate_limited": "exact",
+    "profile_samples_delta_vs_steps": "exact",
+    "profile_dropped": "exact",
 }
 
 
@@ -436,6 +448,84 @@ def scenario_telemetry() -> dict:
     }
 
 
+def scenario_profiling() -> dict:
+    """Alert-triggered diagnostic capture + sampling profiler,
+    counters only, fake clocks throughout.  The same slow-step-marked
+    workload runs twice — bare, and with the full PR-15 stack armed
+    (TimeSeriesStore + a deterministic slow_steps alert rule +
+    DiagnosticCapture into a throwaway dir + a SamplingProfiler swept
+    inline once per step).  Gates: the alert fires exactly once, the
+    capture lands exactly once on disk, a second on_alert inside the
+    rate-limit window is rejected (not written), the profiler takes
+    exactly one sweep per driven step with zero drops, and the armed
+    run adds ZERO host syncs / decode traces over the bare control."""
+    import tempfile
+    from paddle_tpu import observability as obs
+    from paddle_tpu.serving import FaultPlan
+
+    prompt = list(range(1, 9))
+
+    def drive(with_obs, tmp=None):
+        plan = FaultPlan(seed=0)
+        # marker fault: the injected-count drives the alert; a zero
+        # sleep keeps the gate fast and the workload byte-identical
+        plan.add("slow_step", at=3, seconds=0.0)
+        eng = _engine(max_slots=2, page_size=4, sync_interval=1,
+                      faults=plan)
+        store = prof = cap = None
+        fake = [0.0]
+        if with_obs:
+            store = obs.TimeSeriesStore(capacity=256,
+                                        clock=lambda: fake[0])
+            store.add_source("slow_steps", lambda: float(
+                plan.injected.get("slow_step", 0)))
+            store.add_rule(obs.AlertRule(
+                "slow_step_injected", "slow_steps", above=0,
+                min_samples=1,
+                help_="deterministic capture trigger for the gate"))
+            prof = obs.SamplingProfiler(0.0)   # inline sweeps only
+            cap = obs.DiagnosticCapture(
+                dir_=tmp, min_interval_s=3600.0, max_captures=4,
+                profiler=prof, clock=lambda: fake[0])
+            cap.attach(store)
+            store.tick()        # t=0 baseline before the fault lands
+        reqs = [eng.submit(prompt + [20], _gen(8)),
+                eng.submit(prompt + [25], _gen(8))]
+        steps = 0
+        while not all(r.is_finished() for r in reqs) and steps < 400:
+            eng.step()
+            steps += 1
+            if store is not None:
+                fake[0] += 1.0
+                prof.sample(fake[0])
+                store.tick()
+        return eng, store, prof, cap, steps
+
+    eng_off, *_ = drive(False)
+    with tempfile.TemporaryDirectory() as tmp:
+        eng_on, store, prof, cap, steps = drive(True, tmp)
+        # a second fire inside the rate-limit window: rejected exactly
+        cap.on_alert("slow_step_injected", {"value": 1.0},
+                     now=float(steps))
+        files = len([f for f in os.listdir(tmp)
+                     if f.startswith("capture_")])
+    return {
+        "alerts_fired": store.alerts_fired,
+        "captures_written": cap.captures,
+        "capture_files": files,
+        "capture_rate_limited": cap.rate_limited,
+        "profile_samples_delta_vs_steps": prof.samples - steps,
+        "profile_dropped": prof.dropped,
+        "leaked_pages": eng_on.blocks.pool_accounting()["leak"],
+        # the zero-overhead contract: the armed stack adds no device
+        # work over the bare control
+        "host_syncs_delta_vs_off": eng_on.host_syncs
+        - eng_off.host_syncs,
+        "decode_traces_delta_vs_off": eng_on.decode_traces
+        - eng_off.decode_traces,
+    }
+
+
 def scenario_overload_degrade() -> dict:
     """Graceful degradation under overload, counters only.
 
@@ -517,6 +607,7 @@ SCENARIOS = {
     "fault_recovery": scenario_fault_recovery,
     "telemetry": scenario_telemetry,
     "overload_degrade": scenario_overload_degrade,
+    "profiling": scenario_profiling,
 }
 
 

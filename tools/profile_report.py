@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Render a sampling-profiler capture as readable tables.
+
+Usage:
+    python tools/profile_report.py <bench.folded | profile.json | dump-dir>
+        [--top N] [--phase PHASE]
+
+Accepts any of the three shapes the profiler produces:
+
+* a ``.folded`` file (``serve_bench --profile out.folded`` or the
+  text body of ``GET /debug/profile``) — semicolon-joined stacks, one
+  per line, trailing sample count;
+* a ``profile.json`` side-file from ``observability.dump()`` (the
+  ``SamplingProfiler.snapshot()`` dict);
+* a dump directory containing ``profile.json``.
+
+Renders per-phase sample totals, the top-N leaf frames by self time
+(where the engine actually spends its wall clock), and the heaviest
+whole stacks.  ``--phase decode`` narrows every table to one phase.
+
+Works standalone — no paddle_tpu / jax import, so it can run against a
+capture copied off a serving host.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def parse_folded(text):
+    """``phase;thread;f1;f2 count`` lines -> list of (stack, count).
+
+    ``stack`` keeps the folded segments as a tuple, root-first, with
+    stack[0] the phase and stack[1] the thread name.  Malformed lines
+    (truncated writes, stray blank lines) are skipped, never fatal.
+    """
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        stack, _, count = line.rpartition(" ")
+        if not stack:
+            continue
+        try:
+            n = int(count)
+        except ValueError:
+            continue
+        out.append((tuple(stack.split(";")), n))
+    return out
+
+
+def folded_to_snapshot(stacks, top=50):
+    """Lift folded (stack, count) pairs into the snapshot() dict shape
+    so one rendering path serves both input formats."""
+    by_phase = {}
+    total = 0
+    for stack, n in stacks:
+        phase = stack[0] if stack else "other"
+        by_phase[phase] = by_phase.get(phase, 0) + n
+        total += n
+    top_stacks = [{"phase": s[0] if s else "other",
+                   "thread": s[1] if len(s) > 1 else "?",
+                   "stack": list(s[2:]), "count": n}
+                  for s, n in sorted(stacks, key=lambda kv: -kv[1])[:top]]
+    return {"stats": {"observations": total,
+                      "distinct_stacks": len(stacks)},
+            "by_phase": by_phase, "top_stacks": top_stacks}
+
+
+def leaf_self_time(snapshot, phase=None):
+    """Aggregate sample counts by LEAF frame (self time): the frame on
+    top of the stack owns the sample."""
+    leaves = {}
+    for ent in snapshot.get("top_stacks") or []:
+        if phase and ent.get("phase") != phase:
+            continue
+        stack = ent.get("stack") or []
+        leaf = stack[-1] if stack else "(no frames)"
+        leaves[leaf] = leaves.get(leaf, 0) + int(ent.get("count") or 0)
+    return sorted(leaves.items(), key=lambda kv: -kv[1])
+
+
+def _bar(n, total, width=24):
+    if total <= 0:
+        return ""
+    return "#" * max(1, int(round(width * n / total))) if n else ""
+
+
+def render(snapshot, top=20, phase=None, out=sys.stdout):
+    stats = snapshot.get("stats") or {}
+    total = int(stats.get("observations") or 0)
+    print("== profile ==", file=out)
+    for k in ("interval_s", "samples", "observations", "distinct_stacks",
+              "dropped"):
+        if k in stats:
+            print(f"  {k:<16} {stats[k]}", file=out)
+
+    by_phase = snapshot.get("by_phase") or {}
+    if by_phase:
+        print("\n== samples by phase ==", file=out)
+        for ph, n in sorted(by_phase.items(), key=lambda kv: -kv[1]):
+            if phase and ph != phase:
+                continue
+            pct = 100.0 * n / total if total else 0.0
+            print(f"  {ph:<14} {n:>8}  {pct:5.1f}%  {_bar(n, total)}",
+                  file=out)
+
+    leaves = leaf_self_time(snapshot, phase=phase)
+    if leaves:
+        print("\n== top frames by self time ==", file=out)
+        for leaf, n in leaves[:top]:
+            pct = 100.0 * n / total if total else 0.0
+            print(f"  {n:>8}  {pct:5.1f}%  {leaf}", file=out)
+
+    shown = 0
+    print("\n== hottest stacks ==", file=out)
+    for ent in snapshot.get("top_stacks") or []:
+        if phase and ent.get("phase") != phase:
+            continue
+        if shown >= top:
+            break
+        shown += 1
+        head = (f"  [{ent.get('count', 0)}] {ent.get('phase', '?')}"
+                f" / {ent.get('thread', '?')}")
+        print(head, file=out)
+        for frame in ent.get("stack") or []:
+            print(f"      {frame}", file=out)
+    if not shown:
+        print("  (no stacks captured)", file=out)
+
+
+def load(path):
+    """Path -> snapshot dict.  Accepts .folded, profile.json, or a
+    dump directory holding profile.json."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "profile.json")
+    with open(path) as f:
+        text = f.read()
+    if path.endswith(".json"):
+        return json.loads(text)
+    try:
+        doc = json.loads(text)
+        if isinstance(doc, dict) and "by_phase" in doc:
+            return doc
+    except ValueError:
+        pass
+    return folded_to_snapshot(parse_folded(text))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help=".folded file, profile.json, or "
+                                 "observability dump directory")
+    ap.add_argument("--top", type=int, default=20,
+                    help="rows per table (default 20)")
+    ap.add_argument("--phase", default="",
+                    help="narrow every table to one phase "
+                         "(prefill/decode/verify/host_sync/idle)")
+    args = ap.parse_args(argv)
+    try:
+        snap = load(args.path)
+    except (OSError, ValueError) as e:
+        print(f"profile_report: cannot read {args.path}: {e}",
+              file=sys.stderr)
+        return 2
+    render(snap, top=args.top, phase=args.phase or None)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

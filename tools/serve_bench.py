@@ -52,6 +52,12 @@ each stream), request counts, and the aggregate prefix hit rate.
 run: request/queue/prefill/decode spans and gauge counters, merged with
 the native host profile when one is active (profiler.export_host_trace).
 
+``--profile out.folded`` samples a phase-attributed host profile of the
+run (stacks split by the engine's published phase: prefill /
+prefill_chunk / decode / verify / host_sync / idle) and writes folded
+stacks — flamegraph.pl / speedscope input, rendered by
+``tools/profile_report.py``.
+
 The model is a randomly initialized tiny llama (this benchmarks the
 ENGINE — scheduling, paging, dispatch — not the matmuls); sizes are
 flags so the same harness scales up on real hardware.
@@ -61,6 +67,7 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import threading
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
@@ -222,6 +229,16 @@ def run_bench(args):
         supervisor = EngineSupervisor(engine)
     step = engine.step if supervisor is None else supervisor.step
 
+    # --profile out.folded: continuous phase-attributed sampling of
+    # the bench (this driver thread runs the engine, so its stacks
+    # split by engine.current_phase); folded stacks land at the path
+    profiler = None
+    if getattr(args, "profile", None):
+        bench_ident = threading.get_ident()
+        profiler = obs.SamplingProfiler(
+            0.005, phases=lambda: {bench_ident: engine.current_phase})
+        profiler.start_sampling()
+
     workload = _build_workload(args, rng, np)
     mix = _parse_priority_mix(getattr(args, "priority_mix", ""))
     priorities = _assign_priorities(mix, rng, len(workload))
@@ -332,12 +349,28 @@ def run_bench(args):
                      "leaked_pages": leak,
                      "spill_aborts": engine.spill_aborts}
 
+    profile_out = {}
+    if profiler is not None:
+        profiler.stop()
+        with open(args.profile, "w") as f:
+            f.write(profiler.folded() + "\n")
+        by_phase = profiler.by_phase()
+        top = ", ".join(f"{k}={v}" for k, v in
+                        list(by_phase.items())[:4])
+        print(f"  profile              {profiler.samples} samples -> "
+              f"{args.profile} (render: python tools/profile_report.py "
+              f"{args.profile}; phases: {top})")
+        profile_out = {"profile_path": args.profile,
+                       "profile_samples": profiler.samples,
+                       "profile_by_phase": by_phase}
+
     if args.metrics_dir:
         out = obs.dump(args.metrics_dir)
         print(f"  metrics dump         {out} "
               f"(render: python tools/metrics_report.py {out})")
     _export_trace(args)
-    return {"requests": len(reqs), "tokens": toks, "wall_s": wall,
+    return {**profile_out,
+            "requests": len(reqs), "tokens": toks, "wall_s": wall,
             "arrival": args.arrival, "spec_k": args.spec_k,
             "throughput": toks / wall, "ttft_s": ttfts, "tpot_s": tpots,
             "decode_traces": stats["decode_traces"],
@@ -365,6 +398,7 @@ def run_overload_compare(args):
     base_args = copy.copy(args)
     base_args.prefill_chunk = 0
     base_args.preempt = False
+    base_args.profile = ""      # the configured run owns the profile
     print("\n--- FCFS baseline: same workload, prefill-chunk 0, "
           "no preemption ---")
     ref = run_bench(base_args)
@@ -600,7 +634,10 @@ def run_http_bench(args):
                             for k, v in per_replica.items()}}
 
 
-def main(argv=None):
+def _build_parser() -> argparse.ArgumentParser:
+    """THE bench argument parser — the single source of defaults.
+    ``bench_args()`` derives embedder/test Namespaces from it, so a
+    newly added flag can never be missing from a hand-built one."""
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--requests", type=int, default=16)
     ap.add_argument("--max-slots", type=int, default=4)
@@ -682,7 +719,33 @@ def main(argv=None):
                          "and drive through the self-healing "
                          "supervisor; reports availability and p99 "
                          "TTFT/TPOT under faults (in-process mode only)")
-    args = ap.parse_args(argv)
+    ap.add_argument("--profile", default="", metavar="OUT.folded",
+                    help="sample a phase-attributed host profile of "
+                         "the run (observability.SamplingProfiler) and "
+                         "write folded stacks to this path — feed to "
+                         "flamegraph.pl / speedscope or "
+                         "tools/profile_report.py (in-process mode "
+                         "only)")
+    return ap
+
+
+def bench_args(**overrides) -> argparse.Namespace:
+    """Default bench Namespace built from the REAL parser
+    (``parse_args([])``), with keyword overrides by attribute name
+    (``prefill_chunk=8``, not ``--prefill-chunk``).  Tests and
+    embedders use this instead of hand-building a Namespace, so a
+    newly added bench flag can never silently be missing (the PR 10 /
+    PR 13 breakage class).  Unknown names raise."""
+    args = _build_parser().parse_args([])
+    for k, v in overrides.items():
+        if not hasattr(args, k):
+            raise TypeError(f"bench_args(): unknown bench arg {k!r}")
+        setattr(args, k, v)
+    return args
+
+
+def main(argv=None):
+    args = _build_parser().parse_args(argv)
     if args.http:
         run_http_bench(args)
     elif args.overload_baseline:
