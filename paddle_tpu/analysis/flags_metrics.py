@@ -35,6 +35,17 @@ Metrics (names are a public scrape interface; Prometheus conventions):
     registry raises at runtime; this catches it before any process
     does.
 
+``metric-unbounded-label``
+    A ``.labels(...)`` argument tainted from a request/header-derived
+    string (``*.headers.get(...)``, ``*.headers[...]``) without first
+    passing through a bounding map.  Every distinct label value
+    allocates a metric child forever, so a caller-controlled string is
+    an unbounded-cardinality (memory + scrape-size) leak.  Taint flows
+    through plain name assignment, ``str()``, string passthroughs
+    (``.strip()``/``.lower()``/...), f-strings, concatenation, and
+    ``or``-defaults; any other call — a table lookup, a canonicalizer —
+    bounds the value and clears it.
+
 Metric rules only apply outside ``tests/`` (tests register throwaway
 names on private registries deliberately); flag rules apply everywhere.
 """
@@ -55,6 +66,8 @@ RULES = {
     "metric-suffix": "metric name violates unit-suffix conventions "
                      "(_total/_seconds/_bytes)",
     "metric-duplicate": "metric name registered with two different kinds",
+    "metric-unbounded-label": "metric label fed from a request/header "
+                              "string without a bounding map",
 }
 
 _FLAG_RE = re.compile(r"^FLAGS_[A-Za-z0-9_]+$")
@@ -108,6 +121,8 @@ class FlagsMetricsAnalyzer:
                 k + "(" in src.text
                 for k in ("counter", "gauge", "histogram")):
             self._check_metrics(src, findings)
+        if not _is_test_path(src.path) and ".labels(" in src.text:
+            self._check_label_taint(src, findings)
         return src.filter(findings)
 
     # ------------------------------------------------------------- flags
@@ -228,6 +243,116 @@ class FlagsMetricsAnalyzer:
                 "suffix promises a monotonic counter to rate()/"
                 "increase() users",
                 hint="drop the suffix or use `_count`/a capacity name"))
+
+    # ------------------------------------------------- label cardinality
+    def _check_label_taint(self, src, findings):
+        """Flag ``.labels(x)`` where ``x`` is a request/header-derived
+        string that never passed through a bounding call."""
+        scopes = [src.tree] + [
+            n for n in ast.walk(src.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for scope in scopes:
+            tainted: set[str] = set()
+            for stmt in _flat_statements(getattr(scope, "body", [])):
+                for node in _stmt_exprs(stmt):
+                    if not (isinstance(node, ast.Call) and
+                            isinstance(node.func, ast.Attribute) and
+                            node.func.attr == "labels"):
+                        continue
+                    args = list(node.args) + \
+                        [kw.value for kw in node.keywords]
+                    if any(_label_tainted(a, tainted) for a in args):
+                        findings.append(Finding(
+                            "metric-unbounded-label", src.path,
+                            node.lineno,
+                            "metric label fed from a request/header-"
+                            "derived string — every distinct value "
+                            "allocates a label child forever "
+                            "(unbounded cardinality)",
+                            hint="route the value through a bounding "
+                                 "map (an LRU table / canonicalizer) "
+                                 "before .labels()"))
+                # assignments update taint AFTER this statement's
+                # .labels sites were judged with the prior state
+                target = None
+                if isinstance(stmt, ast.Assign) and \
+                        len(stmt.targets) == 1 and \
+                        isinstance(stmt.targets[0], ast.Name):
+                    target = stmt.targets[0].id
+                elif isinstance(stmt, ast.AnnAssign) and \
+                        isinstance(stmt.target, ast.Name) and \
+                        stmt.value is not None:
+                    target = stmt.target.id
+                if target is not None:
+                    if _label_tainted(stmt.value, tainted):
+                        tainted.add(target)
+                    else:       # re-binding to a clean value sanitizes
+                        tainted.discard(target)
+
+
+# string methods that pass a tainted value through unchanged (still the
+# caller-controlled string, just cosmetically normalized)
+_PASSTHROUGH = ("strip", "lstrip", "rstrip", "lower", "upper",
+                "title", "casefold")
+
+
+def _label_tainted(node, tainted: set) -> bool:
+    """True when ``node`` evaluates to a request/header-derived string
+    that no bounding call has been applied to."""
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    if isinstance(node, ast.Subscript):        # req.headers["X-Tenant"]
+        return isinstance(node.value, ast.Attribute) and \
+            node.value.attr == "headers"
+    if isinstance(node, ast.BoolOp):           # hdr or "anon": still hdr
+        return any(_label_tainted(v, tainted) for v in node.values)
+    if isinstance(node, ast.IfExp):
+        return _label_tainted(node.body, tainted) or \
+            _label_tainted(node.orelse, tainted)
+    if isinstance(node, ast.BinOp):            # "t:" + hdr, hdr % x
+        return _label_tainted(node.left, tainted) or \
+            _label_tainted(node.right, tainted)
+    if isinstance(node, ast.JoinedStr):        # f"tenant:{hdr}"
+        return any(_label_tainted(v.value, tainted)
+                   for v in node.values
+                   if isinstance(v, ast.FormattedValue))
+    if isinstance(node, ast.Call):
+        cname = call_name(node) or ""
+        if cname.endswith("headers.get"):      # self.headers.get(...)
+            return True
+        tail = cname.rsplit(".", 1)[-1]
+        if tail == "str" and node.args:
+            return _label_tainted(node.args[0], tainted)
+        if tail in _PASSTHROUGH and isinstance(node.func, ast.Attribute):
+            return _label_tainted(node.func.value, tainted)
+        return False    # any other call bounds the value (table lookup)
+    return False
+
+
+def _flat_statements(body) -> list:
+    """Statements of a scope in source order, descending into control
+    flow but never into nested def/class bodies (their own scopes)."""
+    out = []
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        out.append(stmt)
+        for field in ("body", "orelse", "finalbody"):
+            out.extend(_flat_statements(getattr(stmt, field, [])))
+        for handler in getattr(stmt, "handlers", []):
+            out.extend(_flat_statements(handler.body))
+    return out
+
+
+def _stmt_exprs(stmt):
+    """Every expression node belonging to ``stmt`` itself (nested
+    statements are visited on their own _flat_statements turn)."""
+    for child in ast.iter_child_nodes(stmt):
+        if isinstance(child, (ast.stmt, ast.excepthandler)) or \
+                type(child).__name__ == "match_case":
+            continue
+        yield from ast.walk(child)
 
 
 def _is_test_path(path: str) -> bool:

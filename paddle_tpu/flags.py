@@ -225,6 +225,27 @@ define_flag("FLAGS_serving_host_pages", 4096,
             "pages: preempted requests' exclusive pages park here "
             "(content-addressed, LRU) and unpark on resume without "
             "recomputing prefill (0 disables spilling to host)")
+define_flag("FLAGS_serving_usage_meter", False,
+            "per-request cost attribution + tenant usage metering: "
+            "build a UsageMeter (observability/usage.py) that tracks "
+            "queue/prefill/decode/speculation costs, KV page-seconds "
+            "(device + host spill tier), and per-tenant rollups behind "
+            "GET /debug/usage and serving_usage_* metrics; off (the "
+            "default) builds no meter and the serving path pays only "
+            "is-not-None tests")
+define_flag("FLAGS_serving_usage_max_tenants", 64,
+            "LRU bound on distinct tenant labels the usage meter "
+            "tracks: admitting tenant N+1 folds the least-recently-"
+            "seen tenant's aggregates and metric series into the "
+            "(evicted) rollup, so hostile clients cycling X-Tenant "
+            "values cannot explode the metrics registry")
+define_flag("FLAGS_serving_fair_share", False,
+            "fair-share admission/preemption bias: when burn-rate "
+            "shedding fires, only the heaviest-page-second tenant's "
+            "requests are shed within the shed-eligible class, and "
+            "preemption victim selection prefers that tenant's "
+            "residents within the lowest priority class (requires "
+            "FLAGS_serving_usage_meter; off = zero behavior change)")
 define_flag("FLAGS_sanitizer", False,
             "enable the runtime concurrency sanitizer: serving/"
             "observability locks become instrumented wrappers that "

@@ -41,13 +41,17 @@ from .profiling import (  # noqa: F401
     SamplingProfiler, active_profiler, set_active_profiler)
 from .capture import (  # noqa: F401
     DiagnosticCapture, active_capture, set_active_capture)
+from .usage import (  # noqa: F401
+    TenantTable, UsageMeter, active_usage, merge_usage, request_ledger,
+    set_active_usage)
 
 __all__ = ["AlertRule", "Counter", "DiagnosticCapture",
            "FlightRecorder", "Gauge",
            "Histogram", "MetricsRegistry", "ResourceTracker",
            "SamplingProfiler", "Series",
-           "Span", "SpanContext", "TimeSeriesStore", "Tracer",
-           "active_capture", "active_profiler",
+           "Span", "SpanContext", "TenantTable", "TimeSeriesStore",
+           "Tracer", "UsageMeter",
+           "active_capture", "active_profiler", "active_usage",
            "bucket_quantiles", "merge_series_buckets",
            "quantile_from_buckets",
            "default_registry", "default_rules", "counter", "gauge",
@@ -55,8 +59,10 @@ __all__ = ["AlertRule", "Counter", "DiagnosticCapture",
            "dump", "reset", "flight", "enable_event_sampling",
            "chrome_counter_events", "flight_recorder",
            "format_traceparent", "parse_traceparent",
+           "merge_usage", "request_ledger",
            "resource_tracker", "serving_sources",
-           "set_active_capture", "set_active_profiler", "tracer"]
+           "set_active_capture", "set_active_profiler",
+           "set_active_usage", "tracer"]
 
 
 def counter(name, help_="", labelnames=()):
@@ -151,6 +157,7 @@ def reset():
     resource_tracker().reset()
     set_active_profiler(None)
     set_active_capture(None)
+    set_active_usage(None)
 
 
 def dump(dir_=None) -> str | None:
@@ -160,9 +167,10 @@ def dump(dir_=None) -> str | None:
     programmatic consumers), the flight-recorder ring as
     ``flight.json``, and the resource tracker's snapshot as
     ``resources.json`` into ``dir_`` (default: ``FLAGS_metrics_dir``).
-    When a continuous profiler / diagnostic capture is active, adds
-    ``profile.json`` / ``captures.json``.  Returns the directory, or
-    None when no directory is configured."""
+    When a continuous profiler / diagnostic capture / usage meter is
+    active, adds ``profile.json`` / ``captures.json`` /
+    ``usage.json``.  Returns the directory, or None when no directory
+    is configured."""
     if dir_ is None:
         from ..flags import FLAGS
         dir_ = FLAGS.get("FLAGS_metrics_dir") or None
@@ -200,6 +208,10 @@ def dump(dir_=None) -> str | None:
     if cap is not None:
         with open(os.path.join(dir_, "captures.json"), "w") as f:
             json.dump(cap.index(), f, indent=2)
+    meter = active_usage()
+    if meter is not None:
+        with open(os.path.join(dir_, "usage.json"), "w") as f:
+            json.dump(meter.snapshot(), f, indent=2)
     return dir_
 
 

@@ -218,6 +218,38 @@ class _Metric:
     def _series(self):
         return list(self._children.items())
 
+    def fold_label(self, labelname, value, into):
+        """Bounded-cardinality eviction: move every child series whose
+        ``labelname`` equals ``value`` into the series with that label
+        replaced by ``into`` (values summed, originals dropped), so the
+        family's grand total is preserved while the evicted label value
+        disappears from the scrape.  Returns the number of series
+        folded; a no-op when the family has no such label."""
+        if labelname not in self.labelnames:
+            return 0
+        idx = self.labelnames.index(labelname)
+        value, into = str(value), str(into)
+        if value == into:
+            return 0
+        with self._lock:
+            doomed = [lv for lv in self._children if lv[idx] == value]
+            for lv in doomed:
+                child = self._children.pop(lv)
+                dest_lv = lv[:idx] + (into,) + lv[idx + 1:]
+                dest = self._children.get(dest_lv)
+                if dest is None:
+                    dest = self.child_cls(self, dest_lv)
+                    self._children[dest_lv] = dest
+                self._fold_child(child, dest)
+        return len(doomed)
+
+    @staticmethod
+    def _fold_child(child, dest):
+        with child._lock:
+            v = child._value
+        with dest._lock:
+            dest._value += v
+
     # delegate the unlabeled fast path
     def __getattr__(self, item):
         if item in ("inc", "dec", "set", "observe", "value", "count",
@@ -249,6 +281,16 @@ class Histogram(_Metric):
                  buckets=DEFAULT_BUCKETS):
         self.buckets = tuple(sorted(float(b) for b in buckets))
         super().__init__(name, help_, labelnames, registry)
+
+    @staticmethod
+    def _fold_child(child, dest):
+        with child._lock:
+            counts, s, n = list(child._counts), child._sum, child._count
+        with dest._lock:
+            for i, c in enumerate(counts):
+                dest._counts[i] += c
+            dest._sum += s
+            dest._count += n
 
 
 _METRIC_CLS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}

@@ -153,6 +153,11 @@ class BlockManager:
         # chain registration (the pages hold no KV yet) and the engine
         # calls publish_seq once the last chunk has landed (0 = off)
         self.defer_publish = 0
+        # usage meter (observability.usage.UsageMeter) fed page
+        # hold/release and host-tier eviction events for the
+        # page-seconds ledger; None (the default) costs one attribute
+        # test per allocation — the engine wires it when metering is on
+        self.usage = None
         # python-side mirrors of the serving_prefix_* metrics (stats())
         self.prefix_hits = 0
         self.prefix_misses = 0
@@ -209,6 +214,8 @@ class BlockManager:
                                 "capacity": n * self.page_size}
         _obs.flight("blocks", "alloc_seq", seq=seq_id, pages=len(pages),
                     shared=0, cached_tokens=0, cow=False)
+        if self.usage is not None:
+            self.usage.on_hold(seq_id, pages, fresh=len(pages))
         self._update_pool_gauges()
         return list(pages)
 
@@ -312,6 +319,8 @@ class BlockManager:
         _obs.flight("blocks", "alloc_seq", seq=seq_id, pages=len(pages),
                     shared=m, cached_tokens=cached_len,
                     cow=cow_src is not None)
+        if self.usage is not None:
+            self.usage.on_hold(seq_id, pages, fresh=len(fresh))
 
         # register this prompt's fresh full chunks (chain through any
         # page an identical chunk already cached)
@@ -408,6 +417,8 @@ class BlockManager:
         self._meta.pop(seq_id, None)
         self._commit.pop(seq_id, None)
         if pages:
+            if self.usage is not None:
+                self.usage.on_release(seq_id, pages)
             for p in pages:
                 self._decref(p)
         self._update_pool_gauges()
@@ -548,7 +559,9 @@ class BlockManager:
         self._host[digest] = (k, v)
         self._host.move_to_end(digest)
         while len(self._host) > self.host_pages:
-            self._host.popitem(last=False)
+            dropped, _ = self._host.popitem(last=False)
+            if self.usage is not None:
+                self.usage.on_host_evict(dropped)
         nbytes = k.nbytes + v.nbytes
         self.spilled_pages += 1
         self.spill_bytes += nbytes
@@ -574,7 +587,9 @@ class BlockManager:
     def host_discard(self, digests):
         """Drop parked entries (failed-spill abort path)."""
         for d in digests:
-            self._host.pop(d, None)
+            if self._host.pop(d, None) is not None \
+                    and self.usage is not None:
+                self.usage.on_host_evict(d)
         _M_HOST_PARKED.set(len(self._host))
 
     def note_restored(self, n: int = 1):

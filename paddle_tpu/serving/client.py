@@ -155,15 +155,19 @@ class ServingClient:
     # ----------------------------------------------------- completions
     def completion(self, prompt, *, max_tokens: int = 16,
                    stream: bool = False, timeout: float | None = None,
-                   **gen_kw):
+                   tenant: str | None = None, **gen_kw):
         """POST /v1/completions.  Blocking: the parsed response dict.
         ``stream=True``: a generator of parsed SSE events (one token
         per event; closing the generator drops the connection, which
-        cancels the request server-side)."""
+        cancels the request server-side).  ``tenant`` tags the request
+        for the server's usage meter (body field; the X-Tenant header
+        overrides it at the server)."""
         body = {"prompt": [int(t) for t in prompt],
                 "max_tokens": int(max_tokens), "stream": bool(stream)}
         if timeout is not None:
             body["timeout"] = float(timeout)
+        if tenant is not None:
+            body["tenant"] = str(tenant)
         body.update(gen_kw)
         # every completion opens a "client.completion" span (nesting
         # under the caller's current span, e.g. router.request) and
@@ -239,6 +243,11 @@ class ServingClient:
     # ------------------------------------------------------- utilities
     def healthz(self) -> dict:
         return self.request("GET", "/healthz")
+
+    def usage(self) -> dict:
+        """``GET /debug/usage`` — the per-tenant usage table (replica)
+        or the raw-merged cluster table (router)."""
+        return self.request("GET", "/debug/usage")
 
     def metrics_text(self) -> str:
         conn = self._connect()

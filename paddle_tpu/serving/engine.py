@@ -145,7 +145,7 @@ class Engine:
                  sync_interval: int = 1, clock=time.monotonic,
                  slo=None, mesh=None, spec_k: int | None = None,
                  prefill_chunk: int | None = None,
-                 preempt: bool | None = None, faults=None):
+                 preempt: bool | None = None, faults=None, usage=None):
         if model is not None:
             from ..framework.tensor import Tensor
             config = model.config
@@ -224,6 +224,20 @@ class Engine:
         # pages, and the lockstep decode step (which writes KV for every
         # slot) would corrupt them once reallocated to a new request.
         self.scheduler._on_evict = self._park
+        # per-request cost attribution (observability.usage): every
+        # call site below is a single ``is not None`` test, so the
+        # default (no meter) adds zero work to the serving path
+        self.usage = usage
+        if usage is not None:
+            if usage._clock is None:
+                usage._clock = self._clock   # page-seconds on engine clock
+            self.blocks.usage = usage        # page hold/release + host tier
+            self.scheduler.usage = usage     # fair-share victim selection
+            if slo is not None:
+                slo.verdict_hook = usage.slo_verdict
+            # the process-active meter: obs.dump() writes usage.json
+            # from it (last engine built wins, like the profiler)
+            _obs.set_active_usage(usage)
 
         L = config.num_hidden_layers
         kvh, hd = config.num_key_value_heads, config.head_dim
@@ -345,16 +359,18 @@ class Engine:
     def submit(self, prompt, gen: GenerationConfig | None = None, *,
                deadline: float | None = None, on_token=None,
                arrival_time: float | None = None, trace=None,
-               priority: int = 0) -> Request:
+               priority: int = 0, tenant: str | None = None) -> Request:
         """``trace`` is an optional tracing.SpanContext (or Span) the
         request's root span is parented under — the server passes the
         extracted ``traceparent`` here so the engine-side spans join the
         caller's distributed trace.  Without it the root span inherits
         the submitting thread's current span, if any.  ``priority``
         sets the scheduling class: higher admits first and (with
-        preemption enabled) may preempt lower-priority residents."""
+        preemption enabled) may preempt lower-priority residents.
+        ``tenant`` is the billing dimension for the usage meter
+        (HTTP ``X-Tenant`` / body field; default ``"anon"``)."""
         req = Request(prompt, gen, deadline=deadline, on_token=on_token,
-                      priority=priority,
+                      priority=priority, tenant=tenant,
                       arrival_time=(self._clock() if arrival_time is None
                                     else arrival_time))
         total = req.prompt.size + req.gen.max_new_tokens
@@ -392,6 +408,10 @@ class Engine:
             _obs.flight("engine", "submit", req=req.id,
                         prompt_len=int(req.prompt.size),
                         trace=req.root_span.trace_id)
+            if self.usage is not None:
+                # register BEFORE the scheduler sees the request so any
+                # admission-time page holds already attribute to it
+                self.usage.on_submit(req)
             self.scheduler.submit(req)
         except BaseException:
             # a rejected submit (queue full, shutdown race) must not
@@ -456,6 +476,12 @@ class Engine:
         if req.queue_span is not None:      # queue wait ends at admission
             req.queue_span.end()
             req.queue_span = None
+        if req.admitted_at is not None:
+            # ledger: queue-wait seconds — every wait (first admission
+            # and each preemption re-queue) sums into the same field
+            req.queue_seconds += max(
+                0.0, req.admitted_at - req._queued_since)
+            req._queued_since = req.admitted_at
         if req.num_generated:
             # re-admission of a preempted request: rebuild device KV
             # from the prefix cache + host spill tier + a re-prefill of
@@ -481,6 +507,8 @@ class Engine:
                 self._quarantine(slot, req, e, self._clock())
                 return
             req.num_cached_tokens = cached
+            req.prefill_cached_tokens += cached
+            req.prefill_computed_tokens += plen - cached
             self._note_phase("prefill", time.perf_counter() - t0)
             self._begin_chunks(slot, req, req.prompt, cached, row)
             return
@@ -503,6 +531,8 @@ class Engine:
                 logits = self.runner.prefill_cached(ids, suffix, cached,
                                                     row)
             req.num_cached_tokens = cached
+            req.prefill_cached_tokens += cached
+            req.prefill_computed_tokens += plen - cached
             self._note_gap(plen - cached)
             _M_HOST_SYNCS.labels("prefill").inc()
             logits_row = np.asarray(logits)[0]
@@ -599,6 +629,7 @@ class Engine:
                                                     st["row"])
             st["chunks"] += 1
             self.prefill_chunks += 1
+            req.prefill_chunks += 1
             _M_CHUNKS.inc()
             self._note_gap(this)
             if not last:
@@ -694,10 +725,19 @@ class Engine:
                 return False
             k, v = self.runner.read_page(page)
             self.blocks.host_put(digest, k, v)
+            # ledger: charged per page parked, mirroring host_put's
+            # global counters (an abort on a LATER page keeps both)
+            req.spilled_pages += 1
+            req.spill_bytes += k.nbytes + v.nbytes
+            if self.usage is not None:
+                self.usage.on_host_park(req, digest)
             parked.append(digest)
         self.blocks.release_preempted(req.id, tokens)
         self._park(slot)
         self.preemptions += 1
+        # back to the queue: the ledger's queue-wait anchor restarts so
+        # queue_seconds sums this wait too
+        req._queued_since = self._clock()
         if self._proposer is not None:
             self._proposer.drop(req.id)  # resume re-registers history
         if req.decode_span is not None:
@@ -731,10 +771,18 @@ class Engine:
         self.current_phase = "prefill"
         t0 = time.perf_counter()
         ps = self.page_size
+        if self.usage is not None:
+            # this request is no longer waiting on its parked pages —
+            # per-request host-tier accrual stops here (the tenant keeps
+            # paying until the digests fall out of the host LRU)
+            self.usage.on_host_release(req)
         tokens = req.resume_tokens()
         ids_all = tokens[:-1]
         n = int(ids_all.size)
         meta = self.blocks.seq_meta(req.id)
+        # ledger: the uncapped match length is what allocate_seq added
+        # to the global cached_tokens counter for this resume
+        req.prefill_cached_tokens += int(meta["cached_len"])
         cached = min(int(meta["cached_len"]), n)
         row = self.blocks.table_row(req.id, self.table_width)
         restored = 0
@@ -755,6 +803,9 @@ class Engine:
                         break
                     self.runner.write_page(int(row[c]), *entry)
                     self.blocks.note_restored()
+                    req.restored_pages += 1
+                    req.restore_bytes += (entry[0].nbytes
+                                          + entry[1].nbytes)
                     restored += 1
                     cached += ps
         except Exception as e:
@@ -763,6 +814,9 @@ class Engine:
             return
         suffix = n - cached
         tok = int(tokens[-1])
+        # ledger: the re-prefilled remainder runs on device (chunked or
+        # single-shot alike)
+        req.prefill_computed_tokens += suffix
         if self.prefill_chunk and suffix > self.prefill_chunk:
             # a long replay suffix chunks exactly like a long prompt —
             # resumes must not reintroduce the TPOT stall either
@@ -1017,6 +1071,8 @@ class Engine:
         if proposed:
             self.blocks.rollback(req.id, proposed - a)
             self._spec.record(proposed, a)
+            req.spec_proposed_tokens += proposed
+            req.spec_accepted_tokens += a
         self._pos[slot] += a + 1        # mirror of pos + (acc+1)*active
         prev = req.last_token_at
         dt = None if prev is None else (now - prev) / (a + 1)
@@ -1135,6 +1191,10 @@ class Engine:
         resource_tracker().note_finish(reason, req.num_generated)
         if self.slo is not None:
             self.slo.observe(req, now)
+        if self.usage is not None:
+            # after slo.observe so per-tenant verdicts land first; the
+            # page-seconds accumulator folds when the pages release
+            self.usage.on_finish(req, reason, now)
         _obs.flight("engine", "finish", req=req.id, reason=reason,
                     generated=req.num_generated)
         if req.queue_span is not None:      # dropped while still queued
@@ -1239,6 +1299,11 @@ class Engine:
         n = len(ids_all)
         plan = self.blocks.replay_plan(req.id, ids_all)
         cached = int(plan["cached_len"])
+        # ledger: recovery replays re-run committed tokens; the cache
+        # match mirrors replay_plan's global cached_tokens bump
+        req.replays += 1
+        req.prefill_cached_tokens += cached
+        req.prefill_computed_tokens += n - cached
         row = self.blocks.table_row(req.id, self.table_width)
         ps = self.page_size
         if cached == 0:
@@ -1386,7 +1451,8 @@ def create_engine(model, *, max_slots: int = 4, page_size: int = 64,
                   slo=None, mesh=None,
                   spec_k: int | None = None,
                   prefill_chunk: int | None = None,
-                  preempt: bool | None = None, faults=None) -> Engine:
+                  preempt: bool | None = None, faults=None,
+                  usage=None) -> Engine:
     """`create_predictor`-style entry point: build a continuous-batching
     engine over a LlamaForCausalLM (or any model exposing ``config`` and
     ``functional_state()`` with the llama state-dict layout).
@@ -1436,4 +1502,4 @@ def create_engine(model, *, max_slots: int = 4, page_size: int = 64,
                   enable_prefix_cache=enable_prefix_cache,
                   sync_interval=sync_interval, clock=clock, slo=slo,
                   mesh=mesh, spec_k=spec_k, prefill_chunk=prefill_chunk,
-                  preempt=preempt, faults=faults)
+                  preempt=preempt, faults=faults, usage=usage)

@@ -45,7 +45,8 @@ class Request:
 
     def __init__(self, prompt, gen: GenerationConfig | None = None, *,
                  deadline: float | None = None, on_token=None,
-                 arrival_time: float | None = None, priority: int = 0):
+                 arrival_time: float | None = None, priority: int = 0,
+                 tenant: str | None = None):
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
@@ -77,6 +78,30 @@ class Request:
         # admission (0 with caching off); set by Engine._prefill
         self.num_cached_tokens = 0
 
+        # ------------------------------------------------ cost ledger
+        # Per-request cost attribution (observability.usage): plain
+        # counters the engine bumps unconditionally at the seams that
+        # already update the global mirrors, so summed ledgers equal
+        # the global counters exactly on deterministic workloads.
+        # Billing tenant (HTTP X-Tenant header / body field / submit
+        # kwarg; "" and None canonicalize to "anon").
+        self.tenant = str(tenant).strip() if tenant else "anon"
+        self.queue_seconds = 0.0          # admission + resume re-queues
+        self.prefill_computed_tokens = 0  # prompt tokens run on device
+        self.prefill_cached_tokens = 0    # skipped via prefix cache/CoW
+        self.prefill_chunks = 0           # chunked-prefill chunks run
+        self.spec_proposed_tokens = 0     # draft tokens proposed
+        self.spec_accepted_tokens = 0     # draft tokens accepted
+        self.pages_allocated = 0          # fresh pool acquisitions
+        self.spilled_pages = 0            # pages copied to host on
+        self.spill_bytes = 0              # ... preemption, and back on
+        self.restored_pages = 0           # ... resume
+        self.restore_bytes = 0
+        self.replays = 0                  # recovery replays
+        # KV residency, folded in by the UsageMeter (0.0 when off)
+        self.page_seconds = 0.0
+        self.host_page_seconds = 0.0
+
         # tracing (observability.tracing): the engine opens a root
         # "request" span per request — parented under the caller's
         # traceparent when one arrived over HTTP — plus child spans for
@@ -90,6 +115,9 @@ class Request:
         # timing (engine clock): TTFT = first_token_at - arrival_time
         self.arrival_time = time.monotonic() if arrival_time is None \
             else arrival_time
+        # queue-wait anchor for the cost ledger: reset to "now" on a
+        # preemption re-queue so queue_seconds sums every wait
+        self._queued_since = self.arrival_time
         self.admitted_at: float | None = None
         # FIFO stamp assigned by the scheduler at FIRST submit; a
         # preempted victim keeps it, so it re-queues ahead of later

@@ -520,6 +520,17 @@ class Router:
                     "alerts_firing": alerts,
                     "prefix_digests": len(digests)}}
 
+    def usage(self) -> dict:
+        """Merged per-tenant usage table across the replicas' last
+        collected fleet summaries — raw-merge discipline (counters
+        sum, never averaged), the same rule as the latency-bucket
+        merge.  A probe failure nulls the dead replica's summary, so
+        a stale table never double-counts into the cluster view."""
+        merged = _obs.merge_usage(
+            (rep.fleet or {}).get("usage") for rep in self.replicas)
+        merged["kind"] = "router"
+        return merged
+
     # ------------------------------------------------------------ info
     def stats(self) -> dict:
         now = self._clock()
@@ -589,6 +600,8 @@ _ROUTER_DEBUG_INDEX = {
                       "snapshots per replica",
     "/debug/captures": "fan out to every replica and aggregate the "
                        "diagnostic-capture indexes per replica",
+    "/debug/usage": "per-tenant usage table raw-merged across the "
+                    "replicas' last collected summaries",
 }
 
 
@@ -642,6 +655,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self._json(200, {"kind": "router",
                              "replicas": self._fanout_get(
                                  "/debug/captures")})
+        elif self.path == "/debug/usage":
+            self._json(200, router.usage())
         elif self.path in ("/debug", "/debug/"):
             self._json(200, {"endpoints": _ROUTER_DEBUG_INDEX})
         else:
@@ -748,6 +763,11 @@ class _RouterHandler(BaseHTTPRequestHandler):
         upstream_headers = {
             "Content-Type": "application/json",
             "traceparent": _obs.format_traceparent(span.context)}
+        # gateway tags ride through to the replica (priority class and
+        # usage-meter billing tenant)
+        for key in ("X-Priority", "X-Tenant"):
+            if self.headers.get(key):
+                upstream_headers[key] = self.headers[key]
         tried: list[Replica] = []
         last_exc: BaseException | None = None
         for attempt in range(router.max_retries + 1):

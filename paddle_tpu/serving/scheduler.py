@@ -65,6 +65,10 @@ class Scheduler:
         self._finalize = None      # engine callback: (req, reason, now)
         self._on_evict = None      # engine callback: (slot,) — park it
         self._preempt = None       # engine callback: (slot,) -> bool
+        # usage meter (observability.usage), wired by the engine when
+        # metering is on; with FLAGS_serving_fair_share it biases
+        # victim selection toward the heaviest-page-second tenant
+        self.usage = None
 
     # ------------------------------------------------------------ intake
     @staticmethod
@@ -208,10 +212,15 @@ class Scheduler:
     def _try_preempt(self, head: Request, now: float) -> bool:
         """Make room for ``head`` by preempting a lower-priority DECODE
         resident: lowest class first, most-recently-admitted within the
-        class (it has the least sunk work).  The engine callback spills
-        the victim's exclusive pages to host RAM and parks the slot; a
-        False return (spill failed / no engine) leaves the victim
-        untouched.  On success the victim is re-queued for resume."""
+        class (it has the least sunk work).  With
+        ``FLAGS_serving_fair_share`` set and a usage meter wired, the
+        heaviest-page-second tenant's residents are preferred within
+        the lowest class — the tenant that consumed the most KV
+        residency pays for the displacement first.  The engine callback
+        spills the victim's exclusive pages to host RAM and parks the
+        slot; a False return (spill failed / no engine) leaves the
+        victim untouched.  On success the victim is re-queued for
+        resume."""
         if not self.preempt_enabled or self._preempt is None:
             return False
         victims = [(i, r) for i, r in enumerate(self.slots)
@@ -219,9 +228,16 @@ class Scheduler:
                    and r.priority < head.priority]
         if not victims:
             return False
+        heavy = None
+        if self.usage is not None:
+            from ..flags import FLAGS
+            if FLAGS.get("FLAGS_serving_fair_share"):
+                heavy = self.usage.heaviest_tenant()
         slot, victim = min(
-            victims, key=lambda ir: (ir[1].priority,
-                                     -(ir[1].admitted_at or 0.0)))
+            victims, key=lambda ir: (
+                ir[1].priority,
+                0 if getattr(ir[1], "tenant", None) == heavy else 1,
+                -(ir[1].admitted_at or 0.0)))
         if not self._preempt(slot):
             return False
         self.slots[slot] = None

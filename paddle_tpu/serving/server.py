@@ -180,7 +180,8 @@ class EngineWorker:
 
     def submit(self, prompt, gen: GenerationConfig | None = None, *,
                timeout_s: float | None = None, on_token=None,
-               trace=None, priority: int = 0) -> Request:
+               trace=None, priority: int = 0,
+               tenant: str | None = None) -> Request:
         """Thread-safe admission with backpressure: raises
         :class:`DrainingError` / :class:`BackpressureError` instead of
         queueing unboundedly; ``timeout_s`` becomes an absolute engine
@@ -190,7 +191,11 @@ class EngineWorker:
         trace survives the hop onto the engine thread.  ``priority``
         is the scheduling class: burn-rate shedding only rejects
         classes <= ``FLAGS_serving_shed_max_priority``, and higher
-        classes may preempt lower residents inside the engine."""
+        classes may preempt lower residents inside the engine.
+        ``tenant`` is the usage-meter billing dimension; with
+        ``FLAGS_serving_fair_share`` set and a meter wired, burn-rate
+        shedding only refuses the heaviest-page-second tenant's
+        requests within the shedable classes."""
         priority = int(priority)
         with self._wake:
             if self.engine.scheduler.draining:
@@ -210,7 +215,7 @@ class EngineWorker:
             if shed > 0 and self.engine.slo is not None \
                     and priority <= shed_max:
                 burn = self.engine.slo.max_burn_rate()
-                if burn >= shed:
+                if burn >= shed and self._should_shed(tenant):
                     cls = _priority_class(priority)
                     _M_SLO_SHED.labels(cls).inc()
                     self.shed_by_class[cls] = \
@@ -224,10 +229,32 @@ class EngineWorker:
                         else self.engine._clock() + float(timeout_s))
             req = self.engine.submit(prompt, gen, deadline=deadline,
                                      on_token=on_token, trace=trace,
-                                     priority=priority)
+                                     priority=priority, tenant=tenant)
             self.requests.append(req)
             self._wake.notify_all()
         return req
+
+    def _should_shed(self, tenant) -> bool:
+        """Fair-share gate for burn-rate shedding: with
+        ``FLAGS_serving_fair_share`` set and a usage meter wired, only
+        the heaviest-page-second tenant's requests are refused — the
+        tenant that consumed the most KV residency absorbs the overload
+        first.  Everything sheds (the pre-existing behavior) when the
+        flag or the meter is off, or no tenant has any history yet."""
+        meter = self.engine.usage
+        if meter is None:
+            return True
+        name = meter.tenants.canonical(tenant)
+        if FLAGS.get("FLAGS_serving_fair_share"):
+            heavy = meter.heaviest_tenant()
+            if heavy is not None and name != heavy:
+                return False
+        # lock order is worker.lock -> meter._lock everywhere (the
+        # engine's own meter calls nest the same way) and the meter
+        # never calls back into the worker, so this cannot deadlock
+        # tpu-lint: disable=callback-under-lock
+        meter.on_shed(name)
+        return True
 
     # ------------------------------------------------------------- drain
     def drain(self, timeout: float | None = None) -> bool:
@@ -288,10 +315,22 @@ def _parse_priority(value) -> int:
     return int(value)
 
 
+def _parse_tenant(value) -> str | None:
+    """Tenant id from a body field or the X-Tenant header: any
+    non-empty string (whitespace-stripped); None / "" mean unset (the
+    engine canonicalizes to "anon")."""
+    if value is None:
+        return None
+    if not isinstance(value, str):
+        raise ValueError(
+            f"invalid 'tenant' {value!r}: must be a string")
+    return value.strip() or None
+
+
 def _parse_completion(body: dict):
     """Validate a /v1/completions body -> (prompt, gen, stream,
-    timeout_s, priority).  Raises ValueError with a client-facing
-    message."""
+    timeout_s, priority, tenant).  Raises ValueError with a
+    client-facing message."""
     if not isinstance(body, dict):
         raise ValueError("request body must be a JSON object")
     prompt = body.get("prompt")
@@ -324,8 +363,9 @@ def _parse_completion(body: dict):
         if timeout_s <= 0:
             raise ValueError("'timeout' must be > 0 seconds")
     priority = _parse_priority(body.get("priority", 0))
+    tenant = _parse_tenant(body.get("tenant"))
     return prompt, gen, bool(body.get("stream", False)), timeout_s, \
-        priority
+        priority, tenant
 
 
 _FINISH_REASON = {"length": "length", "eos": "stop",
@@ -339,8 +379,20 @@ def _finish_reason(req: Request) -> str | None:
     return _FINISH_REASON.get(req.finish_reason, req.finish_reason)
 
 
-def _completion_json(model_name: str, req: Request) -> dict:
+def _usage_json(req: Request) -> dict:
+    """The enriched OpenAI-style ``usage`` block: token totals plus the
+    per-request cost ledger highlights (cached prompt split, queue
+    wait, speculation yield)."""
     plen = int(req.prompt.size)
+    return {"prompt_tokens": plen,
+            "completion_tokens": req.num_generated,
+            "total_tokens": plen + req.num_generated,
+            "prompt_tokens_cached": req.num_cached_tokens,
+            "queue_ms": round(req.queue_seconds * 1e3, 3),
+            "spec_accepted_tokens": req.spec_accepted_tokens}
+
+
+def _completion_json(model_name: str, req: Request) -> dict:
     return {
         "id": f"cmpl-{req.id}",
         "object": "text_completion",
@@ -352,9 +404,8 @@ def _completion_json(model_name: str, req: Request) -> dict:
             "token_ids": list(req.output_tokens),
             "finish_reason": _finish_reason(req),
         }],
-        "usage": {"prompt_tokens": plen,
-                  "completion_tokens": req.num_generated,
-                  "total_tokens": plen + req.num_generated},
+        "usage": _usage_json(req),
+        # deprecated (one release): moved into usage.prompt_tokens_cached
         "num_cached_tokens": req.num_cached_tokens,
         **({"error": req.error} if req.error else {}),
     }
@@ -362,7 +413,7 @@ def _completion_json(model_name: str, req: Request) -> dict:
 
 def _chunk_json(model_name: str, req: Request, tok: int | None,
                 final: bool) -> dict:
-    return {
+    out = {
         "id": f"cmpl-{req.id}",
         "object": "text_completion.chunk",
         "model": model_name,
@@ -373,6 +424,11 @@ def _chunk_json(model_name: str, req: Request, tok: int | None,
             "finish_reason": _finish_reason(req) if final else None,
         }],
     }
+    if final:
+        # the final SSE chunk mirrors the blocking response's usage
+        # block, so streaming clients get the same cost attribution
+        out["usage"] = _usage_json(req)
+    return out
 
 
 # ----------------------------------------------------------------- server
@@ -552,6 +608,8 @@ class ServingServer(ThreadingHTTPServer):
                           "spill_bytes": b.spill_bytes,
                           "host_parked_pages": b.host_parked,
                           "shed_by_class": dict(worker.shed_by_class)}
+            usage = (eng.usage.snapshot()
+                     if eng.usage is not None else None)
             draining = eng.scheduler.draining
         # raw cumulative latency buckets, not quantiles: consumers
         # (dashboard, router) merge buckets ACROSS replicas and then
@@ -574,7 +632,7 @@ class ServingServer(ThreadingHTTPServer):
                 "pool": pool, "prefix": prefix, "slots": slots,
                 "queue": queue, "slo": slo, "spec": spec,
                 "recovery": recovery, "scheduling": scheduling,
-                "latency": latency,
+                "usage": usage, "latency": latency,
                 "watchdog": self.watchdog.state(),
                 "alerts": ({"firing": ts.firing(),
                             "fired_total": ts.alerts_fired,
@@ -600,6 +658,8 @@ _DEBUG_INDEX = {
                       "?seconds=N&format=folded|chrome|json",
     "/debug/captures": "alert-triggered diagnostic capture index + "
                        "retained evidence bundles",
+    "/debug/usage": "per-tenant usage table (tokens, page-seconds, "
+                    "goodput) + the page-seconds conservation check",
 }
 
 
@@ -696,6 +756,19 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(200, {"kind": "replica", "index": cap.index(),
                                  "recent": cap.recent()},
                            "/debug/captures")
+        elif self.path == "/debug/usage":
+            worker = self.server.worker
+            meter = worker.engine.usage
+            if meter is None:
+                self._error(
+                    404, "usage metering disabled (set "
+                    "FLAGS_serving_usage_meter or pass usage= to the "
+                    "engine)", "/debug/usage")
+            else:
+                with worker.lock:
+                    snap = meter.snapshot()
+                self._json(200, dict(snap, kind="replica"),
+                           "/debug/usage")
         elif self.path in ("/debug", "/debug/"):
             self._json(200, {"endpoints": _DEBUG_INDEX}, "/debug/")
         else:
@@ -800,13 +873,17 @@ class _Handler(BaseHTTPRequestHandler):
             span.set_attribute("status", 400)
             return self._error(400, "invalid JSON body", route)
         try:
-            prompt, gen, stream, timeout_s, priority = \
+            prompt, gen, stream, timeout_s, priority, tenant = \
                 _parse_completion(body)
-            # the X-Priority header overrides the body (gateways tag
-            # traffic classes without rewriting payloads)
+            # the X-Priority / X-Tenant headers override the body
+            # (gateways tag traffic classes and billing dimensions
+            # without rewriting payloads)
             hdr = self.headers.get("X-Priority")
             if hdr is not None:
                 priority = _parse_priority(hdr)
+            hdr = self.headers.get("X-Tenant")
+            if hdr is not None:
+                tenant = _parse_tenant(hdr) or tenant
         except (ValueError, TypeError) as e:
             _M_HTTP_REJECT.labels("invalid").inc()
             span.set_attribute("status", 400)
@@ -814,12 +891,14 @@ class _Handler(BaseHTTPRequestHandler):
         span.set_attribute("stream", stream)
         if priority:
             span.set_attribute("priority", priority)
+        if tenant:
+            span.set_attribute("tenant", tenant)
 
         toks: queue.Queue = queue.Queue()
         try:
             req = self.server.worker.submit(
                 prompt, gen, timeout_s=timeout_s, trace=span.context,
-                priority=priority,
+                priority=priority, tenant=tenant,
                 on_token=lambda r, t: toks.put(int(t)))
         except DrainingError as e:
             _M_HTTP_REJECT.labels("draining").inc()
@@ -982,6 +1061,11 @@ def serve(model=None, *, engine: Engine | None = None,
             slo_cfg = SLOConfig.from_flags()
             if slo_cfg.enabled:
                 engine_kw["slo"] = SLOTracker(slo_cfg)
+        if "usage" not in engine_kw \
+                and FLAGS.get("FLAGS_serving_usage_meter"):
+            from ..observability.usage import UsageMeter
+            engine_kw["usage"] = UsageMeter(max_tenants=int(
+                FLAGS.get("FLAGS_serving_usage_max_tenants") or 64))
         engine = create_engine(model, **engine_kw)
     elif engine_kw:
         raise ValueError(f"engine= given; unexpected {sorted(engine_kw)}")

@@ -81,6 +81,10 @@ class SLOTracker:
         # python-side mirrors (stats()/tests without registry spelunking)
         self.good: dict[str, int] = {d: 0 for d in self._recent}
         self.violations: dict[str, int] = {d: 0 for d in self._recent}
+        # optional per-verdict mirror, called as (req, dimension, ok)
+        # alongside each _check — the usage meter wires it to attribute
+        # SLO verdicts to the request's tenant (None = off)
+        self.verdict_hook = None
 
     def observe(self, req, now: float):
         cfg = self.config
@@ -94,11 +98,17 @@ class SLOTracker:
         e2e = now - req.arrival_time
         if cfg.ttft_s > 0:
             # no first token at all = the request never met ANY bar
-            self._check("ttft", ttft is not None and ttft <= cfg.ttft_s)
+            self._verdict(req, "ttft",
+                          ttft is not None and ttft <= cfg.ttft_s)
         if cfg.tpot_s > 0 and tpot is not None:
-            self._check("tpot", tpot <= cfg.tpot_s)
+            self._verdict(req, "tpot", tpot <= cfg.tpot_s)
         if cfg.e2e_s > 0:
-            self._check("e2e", e2e <= cfg.e2e_s)
+            self._verdict(req, "e2e", e2e <= cfg.e2e_s)
+
+    def _verdict(self, req, dim: str, ok: bool):
+        self._check(dim, ok)
+        if self.verdict_hook is not None:
+            self.verdict_hook(req, dim, ok)
 
     def _check(self, dim: str, ok: bool):
         budget = max(1.0 - self.config.objective, 1e-9)

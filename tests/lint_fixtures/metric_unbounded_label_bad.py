@@ -1,0 +1,11 @@
+"""BAD: request-header string fed straight to a metric label
+(metric-unbounded-label)."""
+from paddle_tpu import observability as obs
+
+REQS = obs.counter("serving_fixture_requests_total", "requests served",
+                   ("tenant",))
+
+
+def handle(self):
+    tenant = self.headers.get("X-Tenant") or "anon"
+    REQS.labels(tenant.strip()).inc()

@@ -1,0 +1,11 @@
+"""GOOD twin: the header value passes through a bounding map (an LRU
+canonicalizer) before it becomes a label."""
+from paddle_tpu import observability as obs
+
+REQS = obs.counter("serving_fixture_requests_total", "requests served",
+                   ("tenant",))
+
+
+def handle(self, table):
+    tenant = table.canonical(self.headers.get("X-Tenant") or "anon")
+    REQS.labels(tenant).inc()

@@ -8,6 +8,7 @@ import sys
 
 import paddle_tpu as paddle
 from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+from paddle_tpu.observability.usage import UsageMeter
 from paddle_tpu.serving import Router, ServingClient, serve
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -54,6 +55,17 @@ REPLICA_PAYLOAD = {
     "captures": {"captures": 2, "rate_limited": 1,
                  "by_rule": {"slo_burn": 2}, "min_interval_s": 60.0,
                  "max_captures": 8, "dir": "", "retained": []},
+    "usage": {"tenants": {
+                  "teamA": {"requests": 3, "decode_tokens": 24,
+                            "page_seconds": 5.5, "host_page_seconds": 0.5,
+                            "preemptions": 1, "shed": 0,
+                            "slo": {"e2e": {"good": 3, "violation": 0}}},
+                  "anon": {"requests": 1, "decode_tokens": 4,
+                           "page_seconds": 0.25,
+                           "host_page_seconds": 0.0,
+                           "preemptions": 0, "shed": 2, "slo": {}}},
+              "evicted_tenants": 0, "live_requests": 0,
+              "conservation": {"device_delta": 0.0, "host_delta": 0.0}},
 }
 
 
@@ -89,11 +101,47 @@ class TestRender:
         assert "diagnostics: profiler 120 sweeps @ 0.01s" in text
         assert "captures 2 written / 1 rate-limited" in text
         assert "slo_burn=2" in text
+        # tenant cost table, heaviest page-second bill first
+        assert "Tenants (page-seconds ledger)" in text
+        assert text.index("teamA") < text.index("anon")
+        assert "device_delta=0" in text and "host_delta=0" in text
 
     def test_replica_without_diagnostics_has_no_line(self):
         old = {k: v for k, v in REPLICA_PAYLOAD.items()
                if k not in ("profiling", "captures")}
         assert "diagnostics:" not in dash.render(old)
+
+    def test_replica_without_usage_meter_has_no_tenant_table(self):
+        old = {k: v for k, v in REPLICA_PAYLOAD.items() if k != "usage"}
+        assert "Tenants" not in dash.render(old)
+
+    def test_router_frame_merges_usage_across_replicas(self):
+        r2 = dict(REPLICA_PAYLOAD, address="127.0.0.1:10")
+        payload = {"kind": "router", "failovers": 0,
+                   "cluster": {"replicas": 2, "up": 2, "summaries": 2,
+                               "alerts_firing": []},
+                   "replicas": {
+                       "127.0.0.1:9": {"up": True,
+                                       "summary": REPLICA_PAYLOAD},
+                       "127.0.0.1:10": {"up": True, "summary": r2}}}
+        text = dash.render(payload)
+        assert "raw-merged over 2 replicas" in text
+        # counters sum raw: 3 + 3 requests for teamA, 2 + 2 sheds
+        row = next(l for l in text.splitlines()
+                   if l.startswith("teamA"))
+        assert "6" in row.split() and "48" in row.split()
+
+    def test_router_usage_skips_meterless_replicas(self):
+        bare = {k: v for k, v in REPLICA_PAYLOAD.items()
+                if k != "usage"}
+        payload = {"kind": "router", "failovers": 0,
+                   "cluster": {"replicas": 2, "up": 2, "summaries": 2,
+                               "alerts_firing": []},
+                   "replicas": {
+                       "127.0.0.1:9": {"up": True,
+                                       "summary": REPLICA_PAYLOAD},
+                       "127.0.0.1:10": {"up": True, "summary": bare}}}
+        assert "raw-merged over 1 replica" in dash.render(payload)
 
     def test_router_frame_carries_diagnostics(self):
         payload = {"kind": "router", "failovers": 0,
@@ -161,13 +209,13 @@ class TestOnceSmoke:
         m.eval()
         server = serve(m, max_slots=2, page_size=4, num_pages=64,
                        watchdog_s=0, timeseries_interval_s=0.02,
-                       profile_interval_s=0.02)
+                       profile_interval_s=0.02, usage=UsageMeter())
         router = Router([server.address], page_size=4)
         router.probe_once()
         rs = router.serve()
         try:
             ServingClient(server.address).completion_tokens(
-                [1, 2, 3, 4], max_tokens=4)
+                [1, 2, 3, 4], max_tokens=4, tenant="teamA")
             for addr, marker in ((server.address, "REPLICA"),
                                  (rs.address, "FLEET")):
                 proc = subprocess.run(
@@ -178,6 +226,10 @@ class TestOnceSmoke:
                 # profiler + capture recorder are armed on the replica,
                 # so both frames carry the diagnostics line
                 assert "diagnostics: profiler" in proc.stdout
+                # the usage meter is armed, so both frames carry the
+                # per-tenant cost table with the request's tenant
+                assert "page-seconds ledger" in proc.stdout
+                assert "teamA" in proc.stdout
         finally:
             rs.stop()
             server.stop(drain_timeout=5.0)

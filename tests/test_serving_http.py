@@ -104,7 +104,13 @@ class TestServingServer:
         assert out["choices"][0]["finish_reason"] == "length"
         assert out["usage"] == {"prompt_tokens": len(PROMPT),
                                 "completion_tokens": 8,
-                                "total_tokens": len(PROMPT) + 8}
+                                "total_tokens": len(PROMPT) + 8,
+                                "prompt_tokens_cached": 0,
+                                "queue_ms": out["usage"]["queue_ms"],
+                                "spec_accepted_tokens": 0}
+        assert out["usage"]["queue_ms"] >= 0
+        # deprecated top-level mirror, kept one release
+        assert out["num_cached_tokens"] == 0
 
     def test_stream_matches_blocking(self, client):
         blocking = client.completion(PROMPT, max_tokens=8)

@@ -1,0 +1,490 @@
+"""Per-request cost attribution + tenant usage metering (ISSUE 16).
+
+Covers the ledger end to end: exact CoW-proportional page-second
+charging against a fake clock, the conservation law (charged ==
+pool integral) across preempt -> spill -> resume and across
+prefix-cache sharing, the host-tier parked-page track, LRU tenant
+bounding with totals conserved across eviction, fair-share victim
+selection, router merge correctness with a dead replica's stale
+table nulled, the enriched /v1/completions usage block on the final
+SSE chunk, and the metrics_report Usage section.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.flags import FLAGS
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+from paddle_tpu.observability.usage import (EVICTED_TENANT, TenantTable,
+                                            UsageMeter, merge_usage,
+                                            request_ledger)
+from paddle_tpu.serving import (BlockManager, GenerationConfig, Request,
+                                RequestState, Router, Scheduler,
+                                ServingClient, create_engine, serve)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class _FakeReq:
+    """The minimal Request surface the meter touches — lets the unit
+    tests drive the hooks on an exact fake clock."""
+    _next = iter(range(10_000))
+
+    def __init__(self, tenant=None, finished=False):
+        self.id = next(self._next)
+        self.tenant = tenant
+        self.queue_seconds = 0.0
+        self.prefill_computed_tokens = 0
+        self.prefill_cached_tokens = 0
+        self.prefill_chunks = 0
+        self.num_generated = 0
+        self.spec_proposed_tokens = 0
+        self.spec_accepted_tokens = 0
+        self.pages_allocated = 0
+        self.page_seconds = 0.0
+        self.host_page_seconds = 0.0
+        self.spilled_pages = 0
+        self.spill_bytes = 0
+        self.restored_pages = 0
+        self.restore_bytes = 0
+        self.preemptions = 0
+        self.replays = 0
+        self._finished = finished
+
+    def is_finished(self):
+        return self._finished
+
+
+def _meter(**kw):
+    clock = [0.0]
+    kw.setdefault("clock", lambda: clock[0])
+    return UsageMeter(**kw), clock
+
+
+# --------------------------------------------- CoW-proportional charging
+class TestCowProportionalCharging:
+    def test_shared_page_splits_charge_exactly(self):
+        """Two holders of one CoW page pay 1/2 each; the exclusive
+        pages bill their sole holder in full; the sum equals the pool
+        integral (pages-live x dt) exactly."""
+        meter, clock = _meter()
+        ra, rb = _FakeReq("teamA"), _FakeReq("teamB")
+        meter.on_submit(ra)
+        meter.on_submit(rb)
+        meter.on_hold(ra.id, [1, 2], fresh=2)         # t=0: exclusive
+        clock[0] = 1.0
+        meter.on_hold(rb.id, [1])                     # share page 1
+        clock[0] = 3.0
+        meter.on_release(ra.id, [1, 2])
+        meter.on_release(rb.id, [1])
+        # ra: page1 1s exclusive + 2s shared at 1/2, page2 3s = 5.0
+        # rb: 2s shared at 1/2 = 1.0; pool integral 2 pages x 3s = 6.0
+        assert ra.page_seconds == pytest.approx(5.0)
+        assert rb.page_seconds == pytest.approx(1.0)
+        cons = meter.conservation()
+        assert cons["device_page_seconds"] == pytest.approx(6.0)
+        assert cons["device_delta"] == 0
+        assert cons["live_pages"] == 0
+
+    def test_three_way_share_and_staggered_release(self):
+        meter, clock = _meter()
+        reqs = [_FakeReq(t) for t in ("a", "b", "c")]
+        for r in reqs:
+            meter.on_submit(r)
+        for r in reqs:
+            meter.on_hold(r.id, [7])                  # t=0: 3 holders
+        clock[0] = 3.0
+        meter.on_release(reqs[0].id, [7])             # 2 holders left
+        clock[0] = 5.0
+        meter.on_release(reqs[1].id, [7])             # exclusive now
+        clock[0] = 6.0
+        meter.on_release(reqs[2].id, [7])
+        assert reqs[0].page_seconds == pytest.approx(1.0)   # 3s / 3
+        assert reqs[1].page_seconds == pytest.approx(2.0)   # 1 + 2/2
+        assert reqs[2].page_seconds == pytest.approx(3.0)   # 1 + 1 + 1
+        cons = meter.conservation()
+        assert cons["device_page_seconds"] == pytest.approx(6.0)
+        assert cons["device_delta"] == 0
+
+    def test_unregistered_seq_charges_anon(self):
+        """BlockManager-only drivers (no engine) still conserve: the
+        charge folds into the default tenant."""
+        meter, clock = _meter()
+        meter.on_hold(99, [1])
+        clock[0] = 2.0
+        meter.on_release(99, [1])
+        snap = meter.snapshot()
+        assert snap["tenants"]["anon"]["page_seconds"] == \
+            pytest.approx(2.0)
+        assert snap["conservation"]["device_delta"] == 0
+
+
+# -------------------------------------------------------- host spill tier
+class TestHostTierCharging:
+    def test_tenant_pays_until_host_eviction(self):
+        """The request's ledger stops at resume (on_host_release); the
+        tenant track keeps paying until the host tier drops the copy."""
+        meter, clock = _meter()
+        req = _FakeReq("teamA")
+        meter.on_submit(req)
+        meter.on_host_park(req, "d1")
+        meter.on_host_park(req, "d2")
+        clock[0] = 2.0
+        meter.on_host_release(req)                   # resumed
+        assert req.host_page_seconds == pytest.approx(4.0)  # 2 x 2s
+        clock[0] = 5.0
+        meter.on_host_evict("d1")
+        meter.on_host_evict("d2")
+        snap = meter.snapshot()
+        row = snap["tenants"]["teamA"]
+        assert row["host_page_seconds"] == pytest.approx(10.0)
+        assert req.host_page_seconds == pytest.approx(4.0)   # unchanged
+        assert snap["conservation"]["host_delta"] == 0
+        assert snap["conservation"]["host_parked"] == 0
+
+
+# ------------------------------------------------------ LRU tenant bound
+class TestTenantLRUBound:
+    def test_cardinality_bounded_and_totals_conserved(self):
+        meter, _ = _meter(max_tenants=2)
+        reqs = [_FakeReq(f"t{i}", finished=True) for i in range(4)]
+        for r in reqs:
+            meter.on_submit(r)
+            r.num_generated = 5
+            meter.on_finish(r, "length")
+        assert len(meter.tenants) == 2
+        snap = meter.snapshot()
+        # t0/t1 folded into the rollup; t2/t3 live; nothing lost
+        assert snap["evicted_tenants"] == 2
+        assert set(snap["tenants"]) == {"t2", "t3", EVICTED_TENANT}
+        assert snap["tenants"][EVICTED_TENANT]["requests"] == 2
+        assert snap["tenants"][EVICTED_TENANT]["decode_tokens"] == 10
+        total = sum(r["decode_tokens"] for r in snap["tenants"].values())
+        assert total == 20
+
+    def test_late_charge_never_resurrects_evicted_label(self):
+        table = TenantTable(capacity=1)
+        table.resolve("old")
+        table.resolve("new")                          # evicts "old"
+        row = table.charge_row("old")
+        assert row is table.overflow
+        assert "old" not in table
+
+    def test_canonicalization(self):
+        assert TenantTable.canonical(None) == "anon"
+        assert TenantTable.canonical("  ") == "anon"
+        assert TenantTable.canonical(" teamA ") == "teamA"
+
+
+# ------------------------------------------- engine-integrated conservation
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(0)
+    cfg = llama_tiny(vocab_size=64, hidden_size=32, intermediate_size=64,
+                     num_hidden_layers=2, num_attention_heads=4,
+                     num_key_value_heads=2, max_position_embeddings=128)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def _engine(model, **kw):
+    kw.setdefault("page_size", 4)
+    kw.setdefault("sync_interval", 1)
+    kw.setdefault("max_model_len", 128)
+    return create_engine(model, **kw)
+
+
+class TestEngineConservation:
+    def test_preempt_spill_resume_conserves_page_seconds(self, tiny_model):
+        """The acceptance invariant: across admit -> preempt -> spill
+        -> resume -> finish, summed charges equal the pool integral
+        (device AND host), and the scalar ledgers sum to the engine's
+        global counters."""
+        meter = UsageMeter()
+        eng = _engine(tiny_model, max_slots=2, enable_prefix_cache=False,
+                      preempt=True, usage=meter)
+        lo_a = eng.submit(list(range(1, 7)),
+                          GenerationConfig(max_new_tokens=8),
+                          tenant="teamA")
+        lo_b = eng.submit(list(range(3, 9)),
+                          GenerationConfig(max_new_tokens=8),
+                          tenant="teamB")
+        for _ in range(4):
+            eng.step()
+        hi = eng.submit(list(range(5, 11)),
+                        GenerationConfig(max_new_tokens=8), priority=1,
+                        tenant="teamC")
+        eng.run_until_complete(max_steps=400)
+        reqs = [lo_a, lo_b, hi]
+        assert all(r.finish_reason == "length" for r in reqs)
+        ledgers = [request_ledger(r) for r in reqs]
+        assert sum(l["preemptions"] for l in ledgers) == eng.preemptions
+        assert eng.preemptions >= 1
+        assert sum(l["spilled_pages"] for l in ledgers) == \
+            eng.blocks.spilled_pages
+        assert sum(l["restored_pages"] for l in ledgers) == \
+            eng.blocks.restored_pages
+        assert sum(l["spill_bytes"] for l in ledgers) == \
+            eng.blocks.spill_bytes
+        assert eng.blocks.spilled_pages > 0
+        snap = meter.snapshot()
+        cons = snap["conservation"]
+        assert cons["device_delta"] == 0
+        assert cons["host_delta"] == 0
+        assert cons["live_pages"] == 0
+        assert snap["live_requests"] == 0
+        # tenant rows reproduce the per-request ledgers exactly
+        for field in ("prefill_computed_tokens", "prefill_cached_tokens",
+                      "decode_tokens", "spilled_pages", "restored_pages",
+                      "spill_bytes", "preemptions", "pages_allocated"):
+            assert sum(row[field] for row in snap["tenants"].values()) \
+                == sum(l[field] for l in ledgers), field
+        assert sum(row["page_seconds"]
+                   for row in snap["tenants"].values()) == \
+            pytest.approx(cons["device_page_seconds"])
+
+    def test_cow_shared_prefix_conserves_mid_run_and_after(self,
+                                                           tiny_model):
+        """Prefix-cache CoW sharing: the second request rides the
+        first's cached pages; charges stay conserved while holders
+        overlap (mid-run) and after completion."""
+        meter = UsageMeter()
+        eng = _engine(tiny_model, max_slots=2, enable_prefix_cache=True,
+                      usage=meter)
+        prompt = list(range(1, 13))                   # 3 full pages
+        r1 = eng.submit(prompt, GenerationConfig(max_new_tokens=6),
+                        tenant="teamA")
+        for _ in range(3):
+            eng.step()
+        r2 = eng.submit(prompt, GenerationConfig(max_new_tokens=6),
+                        tenant="teamB")
+        for _ in range(2):
+            eng.step()
+        assert meter.conservation()["device_delta"] == 0   # mid-run
+        eng.run_until_complete(max_steps=200)
+        assert r2.prefill_cached_tokens > 0            # sharing engaged
+        snap = meter.snapshot()
+        assert snap["conservation"]["device_delta"] == 0
+        assert eng.blocks.pool_accounting()["leak"] == 0
+        # both tenants were billed residency
+        assert snap["tenants"]["teamA"]["page_seconds"] > 0
+        assert snap["tenants"]["teamB"]["page_seconds"] > 0
+
+
+# ------------------------------------------------------ fair-share victim
+class TestFairShareVictim:
+    def _req(self, plen, n_new, **kw):
+        return Request(np.arange(1, plen + 1),
+                       GenerationConfig(max_new_tokens=n_new), **kw)
+
+    def _setup(self):
+        meter, clock = _meter()
+        sched = Scheduler(BlockManager(num_pages=64, page_size=4), 2)
+        sched.usage = meter
+        preempted = []
+        sched._preempt = lambda slot: preempted.append(slot) or True
+        heavy = self._req(4, 4, priority=-1, tenant="whale")
+        light = self._req(4, 4, priority=-1, tenant="minnow")
+        sched.submit(heavy)
+        sched.schedule(now=0.0)                       # whale admitted first
+        sched.submit(light)
+        sched.schedule(now=1.0)                       # minnow most recent
+        heavy.state = light.state = RequestState.DECODE
+        meter.on_submit(heavy)
+        meter.on_submit(light)
+        meter.on_hold(heavy.id, [1, 2, 3])            # whale's big bill
+        meter.on_hold(light.id, [4])
+        clock[0] = 10.0
+        return sched, meter, preempted, heavy, light
+
+    def test_flag_off_picks_most_recent(self, monkeypatch):
+        monkeypatch.setitem(FLAGS, "FLAGS_serving_fair_share", False)
+        sched, _, preempted, heavy, light = self._setup()
+        sched.submit(self._req(4, 4, priority=1))
+        sched.schedule(now=11.0)
+        assert preempted == [1]                       # minnow's slot
+        assert light.preemptions == 1 and heavy.preemptions == 0
+
+    def test_flag_on_picks_heaviest_tenant(self, monkeypatch):
+        monkeypatch.setitem(FLAGS, "FLAGS_serving_fair_share", True)
+        sched, _, preempted, heavy, light = self._setup()
+        sched.submit(self._req(4, 4, priority=1))
+        sched.schedule(now=11.0)
+        assert preempted == [0]                       # whale's slot
+        assert heavy.preemptions == 1 and light.preemptions == 0
+
+
+# ------------------------------------------------------------ router merge
+_SNAP_A = {"tenants": {"teamA": {"requests": 2, "decode_tokens": 10,
+                                 "page_seconds": 1.5,
+                                 "host_page_seconds": 0.0, "shed": 0,
+                                 "slo": {"e2e": {"good": 2,
+                                                 "violation": 0}}}},
+           "evicted_tenants": 0, "live_requests": 1,
+           "conservation": {"device_delta": 0.0, "host_delta": 0.0}}
+_SNAP_B = {"tenants": {"teamA": {"requests": 1, "decode_tokens": 4,
+                                 "page_seconds": 0.5,
+                                 "host_page_seconds": 0.25, "shed": 1,
+                                 "slo": {"e2e": {"good": 0,
+                                                 "violation": 1}}},
+                       "teamB": {"requests": 3, "decode_tokens": 12,
+                                 "page_seconds": 2.0,
+                                 "host_page_seconds": 0.0, "shed": 0,
+                                 "slo": {}}},
+           "evicted_tenants": 1, "live_requests": 0,
+           "conservation": {"device_delta": 0.0, "host_delta": 0.0}}
+
+
+class TestRouterMerge:
+    def test_merge_usage_sums_raw_and_skips_dead(self):
+        m = merge_usage([_SNAP_A, None, _SNAP_B])
+        assert m["replicas"] == 2                     # None skipped
+        assert m["tenants"]["teamA"]["requests"] == 3
+        assert m["tenants"]["teamA"]["decode_tokens"] == 14
+        assert m["tenants"]["teamA"]["page_seconds"] == pytest.approx(2.0)
+        # slo verdict table recurses, never averages
+        assert m["tenants"]["teamA"]["slo"]["e2e"] == \
+            {"good": 2, "violation": 1}
+        assert m["tenants"]["teamB"]["requests"] == 3
+        assert m["evicted_tenants"] == 1
+        assert m["live_requests"] == 1
+
+    def test_dead_replica_stale_table_is_nulled(self):
+        """The prober nulls rep.fleet on probe failure; the router's
+        merged table must drop the dead replica's contribution rather
+        than serving its stale census."""
+        router = Router(["127.0.0.1:1", "127.0.0.1:2"])
+        router.replicas[0].fleet = {"usage": _SNAP_A}
+        router.replicas[1].fleet = {"usage": _SNAP_B}
+        m = router.usage()
+        assert m["kind"] == "router" and m["replicas"] == 2
+        assert m["tenants"]["teamA"]["requests"] == 3
+        router.replicas[1].fleet = None    # what _probe_all does on fail
+        m = router.usage()
+        assert m["replicas"] == 1
+        assert m["tenants"]["teamA"]["requests"] == 2
+        assert "teamB" not in m["tenants"]
+
+
+# ----------------------------------------------- end-to-end HTTP (2 replicas)
+class TestUsageHTTP:
+    def test_two_replica_router_merge_consistency(self, tiny_model):
+        s1 = serve(tiny_model, max_slots=2, page_size=4, num_pages=64,
+                   watchdog_s=0, usage=UsageMeter())
+        s2 = serve(tiny_model, max_slots=2, page_size=4, num_pages=64,
+                   watchdog_s=0, usage=UsageMeter())
+        router = Router([s1.address, s2.address], page_size=4)
+        router.probe_once()
+        rs = router.serve()
+        try:
+            rclient = ServingClient(rs.address)
+            for i in range(6):
+                rclient.completion_tokens(
+                    [1, 2, 3, 4 + i], max_tokens=4,
+                    tenant="teamA" if i % 2 else "teamB")
+            router.probe_once()           # refresh the fleet summaries
+            merged = rclient.usage()
+            tables = [ServingClient(s.address).usage()
+                      for s in (s1, s2)]
+            assert merged["kind"] == "router"
+            assert merged["replicas"] == 2
+            names = set(merged["tenants"])
+            assert names == {"teamA", "teamB"}
+            for name in names:
+                for field in ("requests", "finished", "decode_tokens",
+                              "prefill_computed_tokens"):
+                    want = sum(t["tenants"].get(name, {}).get(field, 0)
+                               for t in tables)
+                    assert merged["tenants"][name][field] == want, \
+                        (name, field)
+            # every request landed somewhere and nothing double-counted
+            assert sum(r["tenants"][n]["requests"]
+                       for r in (merged,) for n in names) == 6
+            # both replica tables are conserved individually
+            for t in tables:
+                assert t["conservation"]["device_delta"] == 0
+            # final SSE chunk mirrors the blocking usage block
+            events = list(ServingClient(s1.address).completion(
+                [1, 2, 3, 4], max_tokens=3, stream=True, tenant="teamA"))
+            final = events[-1]
+            assert "usage" in final
+            assert final["usage"]["completion_tokens"] == 3
+            assert final["usage"]["queue_ms"] >= 0
+            assert "spec_accepted_tokens" in final["usage"]
+            assert "prompt_tokens_cached" in final["usage"]
+        finally:
+            rs.stop()
+            s1.stop(drain_timeout=5.0)
+            s2.stop(drain_timeout=5.0)
+
+
+# -------------------------------------------------- metrics_report section
+class TestMetricsReportUsage:
+    def test_usage_section_renders_and_ranks(self):
+        mod = _load_tool("metrics_report")
+        usage = {"tenants": {
+                     "small": {"requests": 1, "finished": 1,
+                               "goodput_requests": 1,
+                               "prefill_computed_tokens": 4,
+                               "prefill_cached_tokens": 0,
+                               "decode_tokens": 2, "page_seconds": 0.5,
+                               "host_page_seconds": 0.0,
+                               "queue_seconds": 0.0, "preemptions": 0,
+                               "shed": 0},
+                     "whale": {"requests": 8, "finished": 8,
+                               "goodput_requests": 6,
+                               "prefill_computed_tokens": 60,
+                               "prefill_cached_tokens": 20,
+                               "decode_tokens": 64, "page_seconds": 9.0,
+                               "host_page_seconds": 1.0,
+                               "queue_seconds": 0.25, "preemptions": 2,
+                               "shed": 1}},
+                 "evicted_tenants": 3, "live_requests": 0,
+                 "conservation": {"device_delta": 0.0,
+                                  "host_delta": 0.0}}
+        text = mod.report({}, None, usage=usage)
+        assert "Usage / tenants" in text
+        # heaviest page-second bill first
+        assert text.index("whale") < text.index("small")
+        assert "75%" in text                      # whale goodput 6/8
+        assert "20/84" in text                    # cache savings line
+        assert "3 folded into the (evicted) rollup" in text
+        assert "device_delta=0 host_delta=0" in text
+
+    def test_old_dump_without_usage_json_renders_fine(self, tmp_path):
+        import json
+        mod = _load_tool("metrics_report")
+        (tmp_path / "metrics.json").write_text(json.dumps(
+            {"serving_tokens_total": {
+                "type": "counter", "help": "",
+                "series": [{"labels": {}, "value": 3.0}]}}))
+        loaded = mod._load(str(tmp_path))
+        usage = loaded[7]
+        assert usage is None
+        text = mod.report(loaded[0], loaded[1], usage=usage)
+        assert "serving_tokens_total" in text
+        assert "Usage / tenants" not in text
+
+    def test_usage_json_roundtrip_through_load(self, tmp_path):
+        import json
+        mod = _load_tool("metrics_report")
+        (tmp_path / "metrics.json").write_text("{}")
+        (tmp_path / "usage.json").write_text(json.dumps(
+            {"tenants": {"teamA": {"requests": 1, "finished": 1,
+                                   "page_seconds": 1.0}},
+             "evicted_tenants": 0, "live_requests": 0}))
+        loaded = mod._load(str(tmp_path))
+        text = mod.report(loaded[0], loaded[1], usage=loaded[7])
+        assert "Usage / tenants" in text and "teamA" in text

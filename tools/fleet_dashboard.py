@@ -15,6 +15,9 @@ kind "replica" is one server's own census).  Renders:
   * latency quantiles (p50/p95/p99) estimated from the published
     cumulative buckets — merged ACROSS replicas before estimating,
     which is why replicas publish raw buckets and not quantiles;
+  * the per-tenant cost table (page-seconds ledger) when the replicas
+    run a usage meter (``FLAGS_serving_usage_meter``) — raw-merged
+    across replicas under a router, heaviest bill first;
   * a diagnostics line per replica: continuous-profiler sweep counts
     and alert-triggered capture tallies (requires
     ``FLAGS_obs_profile_interval_s`` /
@@ -206,6 +209,72 @@ def _diagnostics_line(fl, indent: str = "  ") -> list[str]:
     return [indent + "diagnostics: " + ", ".join(parts)] if parts else []
 
 
+def _merge_usage(snaps):
+    """Raw-merge per-replica usage snapshots: per-tenant counters sum,
+    nested dicts (the slo verdict table) recurse, never averaging — a
+    standalone copy of the ``merge_usage`` discipline from
+    ``paddle_tpu.observability.usage``, kept here so the dashboard
+    keeps its no-paddle_tpu/no-jax contract.  Returns the merged
+    snapshot plus how many replicas actually published one (metering
+    off / dead replicas are skipped, same as the router's own merge)."""
+    def merge_row(dst, src):
+        for k, v in src.items():
+            if isinstance(v, dict):
+                merge_row(dst.setdefault(k, {}), v)
+            elif isinstance(v, (int, float)):
+                dst[k] = dst.get(k, 0) + v
+            else:
+                dst.setdefault(k, v)
+
+    tenants: dict = {}
+    merged = 0
+    for snap in snaps:
+        if not isinstance(snap, dict) or not snap.get("tenants"):
+            continue
+        merged += 1
+        for name, row in snap["tenants"].items():
+            merge_row(tenants.setdefault(name, {}), row)
+    return {"tenants": tenants}, merged
+
+
+def _usage_lines(usage, title="Tenants (page-seconds ledger)",
+                 top: int = 8) -> list[str]:
+    """Per-tenant cost table from a usage-meter snapshot (the
+    fleet_summary ``usage`` key) — replicas running without a meter
+    publish none and produce no block.  Heaviest page-second bill
+    (device + host) first: the first row is the fair-share target."""
+    tenants = (usage or {}).get("tenants") or {}
+    if not tenants:
+        return []
+
+    def bill(kv):
+        row = kv[1]
+        return -(float(row.get("page_seconds") or 0)
+                 + float(row.get("host_page_seconds") or 0))
+
+    ranked = sorted(tenants.items(), key=bill)
+    rows = [(name,
+             _fmt(row.get("requests")),
+             _fmt(row.get("decode_tokens")),
+             f"{float(row.get('page_seconds') or 0):.4g}",
+             f"{float(row.get('host_page_seconds') or 0):.4g}",
+             _fmt(row.get("preemptions")),
+             _fmt(row.get("shed")))
+            for name, row in ranked[:top]]
+    lines = [title, _table(rows, ("tenant", "reqs", "decode", "page-s",
+                                  "host-s", "preempt", "shed"))]
+    if len(ranked) > top:
+        lines.append(f"  (+{len(ranked) - top} more tenants)")
+    cons = (usage or {}).get("conservation")
+    if isinstance(cons, dict):
+        lines.append(
+            f"  conservation: "
+            f"device_delta={_fmt(cons.get('device_delta'))} "
+            f"host_delta={_fmt(cons.get('host_delta'))} "
+            f"(both must be 0)")
+    return lines
+
+
 def _replica_row(address, up, fl):
     pool = (fl or {}).get("pool") or {}
     slots = (fl or {}).get("slots") or {}
@@ -264,6 +333,14 @@ def render_router(payload) -> str:
     lat = _latency_lines(latency)
     if lat:
         out += [""] + lat
+    merged, n_meters = _merge_usage(
+        (entry.get("summary") or {}).get("usage")
+        for entry in replicas.values())
+    use = _usage_lines(
+        merged, title=f"Tenants (page-seconds ledger, raw-merged over "
+                      f"{n_meters} replica{'s' if n_meters != 1 else ''})")
+    if use:
+        out += [""] + use
     for addr, entry in sorted(replicas.items()):
         fl = entry.get("summary") or {}
         diag = _diagnostics_line(fl)
@@ -307,6 +384,9 @@ def render_replica(payload) -> str:
             line += ", shed " + ", ".join(
                 f"{k}={_fmt(v)}" for k, v in sorted(shed.items()))
         out.append(line)
+    use = _usage_lines(payload.get("usage"))
+    if use:
+        out += [""] + use
     lat = _latency_lines(payload.get("latency"))
     if lat:
         out += [""] + lat

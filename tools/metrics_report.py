@@ -5,12 +5,13 @@ Usage:
     python tools/metrics_report.py <dump-dir | metrics.json> [--prom]
 
 Reads metrics.json (+ retraces.json / trace.json / flight.json /
-resources.json / profile.json / captures.json when present) from the
-dump directory FLAGS_metrics_dir pointed at, and renders counters,
-gauges, histograms, SLO verdicts, fault-tolerance events, finish
-reasons, the span-trace summary, the sampling-profiler + diagnostic-
-capture summary, and the retrace log as aligned tables.  --prom
-cats the raw Prometheus text instead (what a scraper would see).
+resources.json / profile.json / captures.json / usage.json when
+present) from the dump directory FLAGS_metrics_dir pointed at, and
+renders counters, gauges, histograms, SLO verdicts, fault-tolerance
+events, finish reasons, the span-trace summary, the sampling-profiler
++ diagnostic-capture summary, the per-tenant usage ledger, and the
+retrace log as aligned tables.  --prom cats the raw Prometheus text
+instead (what a scraper would see).
 
 Every section is optional: a dump produced by an older build (no SLO
 counters, no trace.json) renders the sections it has and silently
@@ -55,8 +56,9 @@ def _load(path):
     resources = _read_json(os.path.join(dir_, "resources.json"))
     profile = _read_json(os.path.join(dir_, "profile.json"))
     captures = _read_json(os.path.join(dir_, "captures.json"))
+    usage = _read_json(os.path.join(dir_, "usage.json"))
     return (metrics, retraces, trace, flight, resources, profile,
-            captures, prom_path)
+            captures, usage, prom_path)
 
 
 def _fmt_value(v):
@@ -676,8 +678,76 @@ def _profiling_section(profile, captures, metrics):
     return "\n".join(lines) if len(lines) > 1 else None
 
 
+def _usage_section(usage):
+    """Per-tenant cost table from usage.json (page-seconds ledger) —
+    dumps produced without a usage meter (or by older builds) have no
+    file and produce no section.  Rows sort by total page-second bill
+    (device + host) so the heaviest tenant — the fair-share target —
+    is the first line."""
+    if not isinstance(usage, dict):
+        return None
+    tenants = usage.get("tenants") or {}
+    if not tenants:
+        return None
+    lines = ["Usage / tenants"]
+
+    def bill(kv):
+        row = kv[1]
+        return -(float(row.get("page_seconds") or 0)
+                 + float(row.get("host_page_seconds") or 0))
+
+    rows = []
+    for name, row in sorted(tenants.items(), key=bill):
+        finished = row.get("finished", 0)
+        good = row.get("goodput_requests", 0)
+        rows.append((
+            name,
+            _fmt_value(row.get("requests", 0)),
+            f"{100.0 * good / finished:.0f}%" if finished else "-",
+            _fmt_value(row.get("prefill_computed_tokens", 0)),
+            _fmt_value(row.get("prefill_cached_tokens", 0)),
+            _fmt_value(row.get("decode_tokens", 0)),
+            f"{float(row.get('page_seconds') or 0):.4g}",
+            f"{float(row.get('host_page_seconds') or 0):.4g}",
+            f"{float(row.get('queue_seconds') or 0):.4g}",
+            _fmt_value(row.get("preemptions", 0)),
+            _fmt_value(row.get("shed", 0)),
+        ))
+    lines.append(_table(rows, ("tenant", "reqs", "good", "computed",
+                               "cached", "decode", "page-s", "host-s",
+                               "queue-s", "preempt", "shed")))
+    computed = sum(r.get("prefill_computed_tokens", 0)
+                   for r in tenants.values())
+    cached = sum(r.get("prefill_cached_tokens", 0)
+                 for r in tenants.values())
+    if computed + cached:
+        lines.append(
+            f"  prefill cache savings: {_fmt_value(cached)}/"
+            f"{_fmt_value(computed + cached)} prompt tokens served "
+            f"from cache ({100.0 * cached / (computed + cached):.1f}%)")
+    lines.append(
+        f"  {len(tenants)} tenants tracked "
+        f"({_fmt_value(usage.get('evicted_tenants', 0))} folded into "
+        f"the {EVICTED_TENANT} rollup), "
+        f"{_fmt_value(usage.get('live_requests', 0))} requests still "
+        f"live at dump time")
+    cons = usage.get("conservation")
+    if isinstance(cons, dict):
+        lines.append(
+            f"  page-seconds conservation: "
+            f"device_delta={_fmt_value(cons.get('device_delta', 0))} "
+            f"host_delta={_fmt_value(cons.get('host_delta', 0))} "
+            f"(both must be 0; charged == pool integral)")
+    return "\n".join(lines)
+
+
+# mirrors paddle_tpu.observability.usage.EVICTED_TENANT — hardcoded so
+# this tool keeps its no-paddle_tpu/no-jax contract
+EVICTED_TENANT = "(evicted)"
+
+
 def report(metrics, retraces, trace=None, flight=None, resources=None,
-           profile=None, captures=None):
+           profile=None, captures=None, usage=None):
     simple_rows = {"counter": [], "gauge": []}
     hist_blocks = []
     for name, entry in sorted(metrics.items()):
@@ -723,6 +793,9 @@ def report(metrics, retraces, trace=None, flight=None, resources=None,
     prof = _profiling_section(profile, captures, metrics)
     if prof:
         out += [prof, ""]
+    use = _usage_section(usage)
+    if use:
+        out += [use, ""]
     if retraces and retraces.get("entries"):
         entries = sorted(retraces["entries"],
                          key=lambda e: (-e["count"], e["op"]))
@@ -746,7 +819,7 @@ def main(argv=None):
                     help="print the raw Prometheus text export")
     args = ap.parse_args(argv)
     (metrics, retraces, trace, flight, resources, profile, captures,
-     prom_path) = _load(args.path)
+     usage, prom_path) = _load(args.path)
     if args.prom:
         if not os.path.exists(prom_path):
             sys.exit(f"metrics_report: no metrics.prom at {prom_path!r}")
@@ -754,7 +827,7 @@ def main(argv=None):
             print(f.read(), end="")
         return 0
     print(report(metrics, retraces, trace, flight, resources,
-                 profile, captures))
+                 profile, captures, usage))
     return 0
 
 

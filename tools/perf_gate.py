@@ -109,6 +109,25 @@ DIRECTIONS = {
     "capture_rate_limited": "exact",
     "profile_samples_delta_vs_steps": "exact",
     "profile_dropped": "exact",
+    # usage metering: every per-request ledger field must sum exactly
+    # to the matching engine/pool global (attribution is accounting,
+    # not sampling), the page-seconds conservation identity must hold
+    # at 0 for both tiers, the preemption spill must bill the victim's
+    # tenant alone, outputs must be bit-identical to the meter-off run,
+    # and arming the meter must add ZERO host syncs / decode traces
+    "ledger_computed_tokens": "exact",
+    "ledger_cached_delta": "exact",
+    "ledger_decode_delta": "exact",
+    "ledger_spilled_delta": "exact",
+    "ledger_restored_delta": "exact",
+    "ledger_spill_bytes_minus_restore_bytes": "exact",
+    "ledger_preemptions_delta": "exact",
+    "victim_tenant_spilled_pages": "exact",
+    "bystander_spilled_pages": "exact",
+    "page_seconds_conservation_delta": "exact",
+    "host_page_seconds_conservation_delta": "exact",
+    "tenants_tracked": "exact",
+    "usage_parity_vs_off": "exact",
 }
 
 
@@ -597,6 +616,86 @@ def scenario_overload_degrade() -> dict:
     }
 
 
+def scenario_usage_meter() -> dict:
+    """Per-request cost attribution + tenant metering, counters only.
+
+    The same 3-tenant preempt-and-swap workload (two low-priority
+    residents, then a high-priority arrival that preempts one of them)
+    runs twice — bare, and with a UsageMeter wired in.  Gates: every per-request ledger field sums exactly to
+    the matching engine/pool global (computed/cached prefill split,
+    decode tokens, spilled/restored pages, spill bytes == restore
+    bytes, preemptions), the page-seconds conservation identity holds
+    at delta == 0 on both the device and host tiers, the spill bills
+    the preempted tenant alone (bystanders at 0), greedy outputs are
+    bit-identical to the meter-off run, and arming the meter adds ZERO
+    host syncs / decode traces (the zero-overhead-off contract of the
+    ``usage is not None`` seams)."""
+    from paddle_tpu.observability.usage import UsageMeter, request_ledger
+
+    def drive(meter):
+        eng = _engine(max_slots=2, page_size=4, sync_interval=1,
+                      enable_prefix_cache=False, preempt=True,
+                      usage=meter)
+        lo_a = eng.submit([1, 2, 3, 4, 5, 6], _gen(8), tenant="teamA")
+        lo_b = eng.submit([3, 4, 5, 6, 7, 8], _gen(8), tenant="teamB")
+        for _ in range(4):              # both residents mid-decode
+            eng.step()
+        hi = eng.submit([5, 6, 7, 8, 9, 10], _gen(8), priority=1,
+                        tenant="teamC")
+        eng.run_until_complete(max_steps=400)
+        return eng, [lo_a, lo_b, hi]
+
+    eng_off, ref_reqs = drive(None)
+    meter = UsageMeter()
+    eng, reqs = drive(meter)
+    snap = meter.snapshot()
+    rows = snap["tenants"]
+    cons = snap["conservation"]
+    ledgers = [request_ledger(r) for r in reqs]
+
+    def total(field):
+        return sum(led[field] for led in ledgers)
+
+    # both low residents admit in the same scheduler pass (identical
+    # admitted_at), so slot order breaks the tie: slot 0 == teamA
+    victim = rows.get("teamA", {})
+    bystanders = (rows.get("teamB", {}).get("spilled_pages", 0)
+                  + rows.get("teamC", {}).get("spilled_pages", 0))
+    return {
+        "preemptions": eng.preemptions,
+        "spill_aborts": eng.spill_aborts,
+        "spilled_pages": eng.blocks.spilled_pages,
+        "restored_pages": eng.blocks.restored_pages,
+        "ledger_computed_tokens": total("prefill_computed_tokens"),
+        "ledger_cached_delta": (total("prefill_cached_tokens")
+                                - eng.blocks.cached_tokens),
+        "ledger_decode_delta": (
+            sum(r.get("decode_tokens", 0) for r in rows.values())
+            - sum(r.num_generated for r in reqs)),
+        "ledger_spilled_delta": (total("spilled_pages")
+                                 - eng.blocks.spilled_pages),
+        "ledger_restored_delta": (total("restored_pages")
+                                  - eng.blocks.restored_pages),
+        "ledger_spill_bytes_minus_restore_bytes": (
+            total("spill_bytes") - total("restore_bytes")),
+        "ledger_preemptions_delta": (total("preemptions")
+                                     - eng.preemptions),
+        "victim_tenant_spilled_pages": victim.get("spilled_pages", 0),
+        "bystander_spilled_pages": bystanders,
+        "page_seconds_conservation_delta": cons["device_delta"],
+        "host_page_seconds_conservation_delta": cons["host_delta"],
+        "tenants_tracked": len(rows),
+        "usage_parity_vs_off": int(
+            [r.output_tokens for r in reqs]
+            == [r.output_tokens for r in ref_reqs]),
+        "leaked_pages": eng.blocks.pool_accounting()["leak"],
+        "host_syncs_delta_vs_off": eng.host_syncs - eng_off.host_syncs,
+        "decode_traces_delta_vs_off": (eng.decode_traces
+                                       - eng_off.decode_traces),
+        "goodput_ratio": _goodput(reqs),
+    }
+
+
 SCENARIOS = {
     "steady_decode": scenario_steady_decode,
     "prefix_cache": scenario_prefix_cache,
@@ -608,6 +707,7 @@ SCENARIOS = {
     "telemetry": scenario_telemetry,
     "overload_degrade": scenario_overload_degrade,
     "profiling": scenario_profiling,
+    "usage_meter": scenario_usage_meter,
 }
 
 

@@ -36,6 +36,14 @@ path; ``--overload-baseline`` re-runs the identical workload on an
 FCFS engine (no chunking, no preemption) in the same invocation and
 prints a per-class tail-latency comparison.
 
+``--tenants teamA:0.5,teamB:0.3,free:0.2`` draws a tenant label per
+request from the given weights, wires a usage meter into the engine,
+and prints the per-tenant cost table (computed/cached/decode tokens,
+KV page-seconds by tier, queue seconds, preemptions, sheds) plus the
+page-seconds conservation check.  Works in both the in-process and
+``--http`` modes (the HTTP path carries the tenant in the request body
+and merges the per-replica tables).
+
 ``--shared-prefix-len N`` prepends one common N-token prefix to every
 prompt (the system-prompt / few-shot pattern prefix caching targets);
 with ``--prefix-cache`` (default on) the report adds the prefix-cache
@@ -135,6 +143,76 @@ def _class_label(pri):
     return _CLASS_NAMES.get(pri, str(pri))
 
 
+def _parse_tenant_mix(spec):
+    """``"teamA:0.5,teamB:0.5"`` -> ``[(name, weight), ...]`` with the
+    weights normalised to sum to 1.  Empty spec -> None."""
+    if not spec:
+        return None
+    out = []
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, w = part.partition(":")
+        name = name.strip()
+        if not name:
+            continue
+        out.append((name, float(w) if w else 1.0))
+    if not out:
+        return None
+    total = sum(w for _, w in out)
+    if total <= 0:
+        raise ValueError(f"--tenants {spec!r}: weights must be > 0")
+    return [(n, w / total) for n, w in out]
+
+
+def _assign_tenants(mix, rng, n):
+    """One tenant label per request, drawn from the mix weights with
+    the bench rng (same seed -> same assignment).  No mix -> None."""
+    if not mix:
+        return [None] * n
+    out = []
+    for _ in range(n):
+        u = rng.random()
+        acc = 0.0
+        name = mix[-1][0]
+        for t, w in mix:
+            acc += w
+            if u < acc:
+                name = t
+                break
+        out.append(name)
+    return out
+
+
+def _print_tenant_table(usage):
+    """Per-tenant cost table from a UsageMeter snapshot (or a
+    merge_usage result — conservation is then absent and skipped)."""
+    tenants = usage.get("tenants") or {}
+    if not tenants:
+        return
+    print("  tenant cost table (page-seconds ledger):")
+    print(f"    {'tenant':<12} {'reqs':>5} {'good':>5} {'computed':>9} "
+          f"{'cached':>7} {'decode':>7} {'page-s':>9} {'host-s':>8} "
+          f"{'queue-s':>8} {'preempt':>7} {'shed':>5}")
+    for name in sorted(tenants):
+        row = tenants[name]
+        print(f"    {name:<12} {row['requests']:>5} "
+              f"{row['goodput_requests']:>5} "
+              f"{row['prefill_computed_tokens']:>9} "
+              f"{row['prefill_cached_tokens']:>7} "
+              f"{row['decode_tokens']:>7} "
+              f"{row['page_seconds']:>9.4f} "
+              f"{row['host_page_seconds']:>8.4f} "
+              f"{row['queue_seconds']:>8.4f} "
+              f"{row['preemptions']:>7} {row['shed']:>5}")
+    cons = usage.get("conservation")
+    if cons:
+        print(f"    conservation         device_delta="
+              f"{cons['device_delta']} host_delta={cons['host_delta']} "
+              f"(both must be 0)")
+
+
 def _per_class_latency(samples):
     """``samples``: iterable of (priority, ttft_or_None, tpot_or_None)
     -> ``{label: {"ttft_s": [...], "tpot_s": [...], "requests": n}}``."""
@@ -201,6 +279,12 @@ def run_bench(args):
     model = LlamaForCausalLM(cfg)
     model.eval()
 
+    tenant_mix = _parse_tenant_mix(getattr(args, "tenants", ""))
+    usage_meter = None
+    if tenant_mix:
+        from paddle_tpu.observability.usage import UsageMeter
+        usage_meter = UsageMeter()
+
     engine = create_engine(model, max_slots=args.max_slots,
                            page_size=args.page_size,
                            num_pages=args.num_pages,
@@ -210,7 +294,8 @@ def run_bench(args):
                            mesh=args.mesh, spec_k=args.spec_k,
                            prefill_chunk=getattr(args, "prefill_chunk",
                                                  None),
-                           preempt=getattr(args, "preempt", None))
+                           preempt=getattr(args, "preempt", None),
+                           usage=usage_meter)
 
     # --chaos SEED: seed a probabilistic fault plan (poisoned steps,
     # synthetic OOM, slow steps) and drive through the self-healing
@@ -242,6 +327,7 @@ def run_bench(args):
     workload = _build_workload(args, rng, np)
     mix = _parse_priority_mix(getattr(args, "priority_mix", ""))
     priorities = _assign_priorities(mix, rng, len(workload))
+    tenants = _assign_tenants(tenant_mix, rng, len(workload))
 
     t0 = time.monotonic()
     pending = list(enumerate(workload))
@@ -254,7 +340,7 @@ def run_bench(args):
             i, (_, prompt, n_new) = pending.pop(0)
             reqs.append(engine.submit(
                 prompt, GenerationConfig(max_new_tokens=n_new),
-                priority=priorities[i]))
+                priority=priorities[i], tenant=tenants[i]))
         if not step() and pending:
             time.sleep(min(1e-3, max(0.0, pending[0][1][0] - now)))
     wall = time.monotonic() - t0
@@ -328,6 +414,12 @@ def run_bench(args):
               f"{stats['spilled_pages']}/{stats['restored_pages']} pages "
               f"spilled/restored ({stats['spill_bytes']} bytes)")
 
+    usage_out = {}
+    if usage_meter is not None:
+        snap = usage_meter.snapshot()
+        _print_tenant_table(snap)
+        usage_out = {"usage": snap}
+
     chaos_out = {}
     if supervisor is not None:
         ok = sum(1 for r in reqs if r.finish_reason in ("length", "eos"))
@@ -384,7 +476,8 @@ def run_bench(args):
             "preemptions": stats["preemptions"],
             "spill_aborts": stats["spill_aborts"],
             "spilled_pages": stats["spilled_pages"],
-            "restored_pages": stats["restored_pages"], **chaos_out}
+            "restored_pages": stats["restored_pages"],
+            **usage_out, **chaos_out}
 
 
 def run_overload_compare(args):
@@ -502,6 +595,14 @@ def run_http_bench(args):
     model = LlamaForCausalLM(cfg)
     model.eval()
 
+    tenant_mix = _parse_tenant_mix(getattr(args, "tenants", ""))
+
+    def _replica_kw():
+        if not tenant_mix:
+            return {}
+        from paddle_tpu.observability.usage import UsageMeter
+        return {"usage": UsageMeter()}      # one meter per replica
+
     # each replica announces itself via the SSE "model" field, so the
     # client side can attribute every stream to the replica that ran it
     servers = [serve(model, max_slots=args.max_slots,
@@ -511,13 +612,14 @@ def run_http_bench(args):
                      enable_prefix_cache=args.prefix_cache,
                      sync_interval=args.sync_interval,
                      spec_k=args.spec_k,
-                     model_name=f"replica-{i}")
+                     model_name=f"replica-{i}", **_replica_kw())
                for i in range(args.replicas)]
     router = Router([s.address for s in servers],
                     page_size=args.page_size)
     workload = _build_workload(args, rng, np)
     mix = _parse_priority_mix(getattr(args, "priority_mix", ""))
     priorities = _assign_priorities(mix, rng, len(workload))
+    tenants = _assign_tenants(tenant_mix, rng, len(workload))
 
     results = [None] * len(workload)
     rejected = [False] * len(workload)
@@ -532,7 +634,8 @@ def run_http_bench(args):
         try:
             for ev in router.completion([int(t) for t in prompt],
                                         max_tokens=n_new, stream=True,
-                                        priority=priorities[i]):
+                                        priority=priorities[i],
+                                        tenant=tenants[i]):
                 replica = ev.get("model", replica)
                 got = ev["choices"][0]["token_ids"]
                 if got:
@@ -616,6 +719,14 @@ def run_http_bench(args):
         print(f"  prefix cache         hit rate {hit_rate * 100:.1f}% "
               f"({hits}/{lookups} page lookups across replicas)")
 
+    usage_out = {}
+    if tenant_mix:
+        from paddle_tpu.observability.usage import merge_usage
+        merged = merge_usage(srv.worker.engine.usage.snapshot()
+                             for srv in servers)
+        _print_tenant_table(merged)
+        usage_out = {"usage": merged}
+
     router.stop()
     for srv in servers:
         srv.stop(drain_timeout=5.0)
@@ -631,7 +742,8 @@ def run_http_bench(args):
             "per_class": per_class, "rejected": n_rejected,
             "per_replica": {k: {"ttft_s": v[0], "tpot_s": v[1],
                                 "requests": v[2]}
-                            for k, v in per_replica.items()}}
+                            for k, v in per_replica.items()},
+            **usage_out}
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -695,6 +807,13 @@ def _build_parser() -> argparse.ArgumentParser:
                          "weighted spec, e.g. hi:0.2,lo:0.8 "
                          "(hi/high=1, normal=0, lo/low=-1, or bare "
                          "ints); adds per-class p50/p99 TTFT/TPOT")
+    ap.add_argument("--tenants", default="", metavar="SPEC",
+                    help="per-request tenant labels drawn from a "
+                         "weighted spec, e.g. teamA:0.5,teamB:0.3,"
+                         "free:0.2; wires a usage meter into the "
+                         "engine and prints the per-tenant cost table "
+                         "(page-seconds ledger) with the conservation "
+                         "check")
     ap.add_argument("--prefill-chunk", type=int, default=None,
                     help="split admission prefill into chunks of this "
                          "many tokens, interleaved with decode steps "
