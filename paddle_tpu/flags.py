@@ -253,3 +253,19 @@ define_flag("FLAGS_sanitizer", False,
             "and lockset-empty shared accesses (Eraser-style), and "
             "export a lock-wait graph for watchdog hang dumps; zero "
             "overhead when off (plain threading primitives)")
+define_flag("FLAGS_serving_quant", "",
+            "weight-only quantized serving: 'int8' or 'int4' converts "
+            "the dense checkpoint at engine construction "
+            "(serving/quantize.quantize_state: per-projection matmul "
+            "weights become QuantizedWeight leaves, embeddings/norms/"
+            "lm_head stay dense) and serves it through the "
+            "weight_only_matmul decode path on any tp; empty (the "
+            "default) leaves the state untouched — zero behavior "
+            "change")
+define_flag("FLAGS_serving_kv_quant", False,
+            "int8 KV pages: the serving runner's paged KV pools store "
+            "int8 with per-(page-row, head) f32 scales, quantized on "
+            "write inside the jitted step and dequantized fused into "
+            "the attention gather; spill/restore move the quantized "
+            "bytes, roughly halving page traffic at f32 checkpoints; "
+            "off (the default) keeps the dense pools byte-identical")

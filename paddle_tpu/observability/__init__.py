@@ -51,7 +51,8 @@ __all__ = ["AlertRule", "Counter", "DiagnosticCapture",
            "SamplingProfiler", "Series",
            "Span", "SpanContext", "TenantTable", "TimeSeriesStore",
            "Tracer", "UsageMeter",
-           "active_capture", "active_profiler", "active_usage",
+           "active_capture", "active_profiler", "active_quant",
+           "active_usage",
            "bucket_quantiles", "merge_series_buckets",
            "quantile_from_buckets",
            "default_registry", "default_rules", "counter", "gauge",
@@ -62,7 +63,21 @@ __all__ = ["AlertRule", "Counter", "DiagnosticCapture",
            "merge_usage", "request_ledger",
            "resource_tracker", "serving_sources",
            "set_active_capture", "set_active_profiler",
-           "set_active_usage", "tracer"]
+           "set_active_quant", "set_active_usage", "tracer"]
+
+# the quantized-serving provider: dump() writes quant.json from its
+# quant_snapshot() (last engine built wins, like the profiler/usage
+# holders — but plain module state here, no dedicated subsystem module)
+_active_quant = None
+
+
+def set_active_quant(provider):
+    global _active_quant
+    _active_quant = provider
+
+
+def active_quant():
+    return _active_quant
 
 
 def counter(name, help_="", labelnames=()):
@@ -158,6 +173,7 @@ def reset():
     set_active_profiler(None)
     set_active_capture(None)
     set_active_usage(None)
+    set_active_quant(None)
 
 
 def dump(dir_=None) -> str | None:
@@ -167,10 +183,10 @@ def dump(dir_=None) -> str | None:
     programmatic consumers), the flight-recorder ring as
     ``flight.json``, and the resource tracker's snapshot as
     ``resources.json`` into ``dir_`` (default: ``FLAGS_metrics_dir``).
-    When a continuous profiler / diagnostic capture / usage meter is
-    active, adds ``profile.json`` / ``captures.json`` /
-    ``usage.json``.  Returns the directory, or None when no directory
-    is configured."""
+    When a continuous profiler / diagnostic capture / usage meter /
+    quantized engine is active, adds ``profile.json`` /
+    ``captures.json`` / ``usage.json`` / ``quant.json``.  Returns the
+    directory, or None when no directory is configured."""
     if dir_ is None:
         from ..flags import FLAGS
         dir_ = FLAGS.get("FLAGS_metrics_dir") or None
@@ -212,6 +228,10 @@ def dump(dir_=None) -> str | None:
     if meter is not None:
         with open(os.path.join(dir_, "usage.json"), "w") as f:
             json.dump(meter.snapshot(), f, indent=2)
+    quant = active_quant()
+    if quant is not None:
+        with open(os.path.join(dir_, "quant.json"), "w") as f:
+            json.dump(quant.quant_snapshot(), f, indent=2)
     return dir_
 
 

@@ -32,7 +32,8 @@ import numpy as np
 from .flash_attention import NUM_LANES
 
 __all__ = ["paged_attention", "PagedPool", "select_paged_attention",
-           "gather_kv_pages"]
+           "gather_kv_pages", "quantize_kv_rows", "gather_scale_pages",
+           "gather_kv_pages_quant", "paged_attention_quant"]
 
 _INTERPRET = False
 
@@ -188,6 +189,78 @@ def paged_attention_xla(q, kpool, vpool, table, lens):
     # [B, W*ps, kvh, D] -> [B, kvh, W*ps, D]
     kb = gather_kv_pages(kpool, table).transpose(0, 2, 1, 3)
     vb = gather_kv_pages(vpool, table).transpose(0, 2, 1, 3)
+    kq = jnp.repeat(kb, rep, axis=1)
+    vq = jnp.repeat(vb, rep, axis=1)
+    logits = jnp.einsum("bhd,bhtd->bht", q, kq,
+                        preferred_element_type=jnp.float32) / np.sqrt(d)
+    tpos = jnp.arange(kb.shape[2])
+    valid = tpos[None, None, :] < lens[:, None, None]
+    logits = jnp.where(valid, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bht,bhtd->bhd", probs, vq)
+
+
+# --------------------------------------------------- int8 KV page mode
+def quantize_kv_rows(x):
+    """Symmetric per-(token, head) int8 quantization of new KV rows:
+    ``x`` [..., D] float -> (q int8 [..., D], scale f32 [...]).  The
+    amax reduction runs on the FLOAT input (never over int8 — a
+    narrow-int reduction would promote under x64 and silently clip
+    without it; see the dtype_flow lint rule), the scale is floored so
+    all-zero rows divide cleanly, and values round into [-127, 127].
+    Runs inside the jitted decode/prefill step, so the scale update
+    costs no extra host sync."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax / 127.0, 1e-8)
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127.0, 127.0)
+    return q.astype(jnp.int8), scale
+
+
+def gather_scale_pages(scale, table):
+    """Scale-pool mirror of :func:`gather_kv_pages`: ``scale``
+    [P, kvH, page_size] f32 per-(page-row, head) scales, ``table``
+    [..., W] int32 -> [..., W * page_size, kvH] token-major."""
+    kvh, ps = scale.shape[1:]
+    g = scale[table]                           # [..., W, kvh, ps]
+    g = jnp.swapaxes(g, -2, -1)                # [..., W, ps, kvh]
+    return g.reshape(table.shape[:-1] + (table.shape[-1] * ps, kvh))
+
+
+def gather_kv_pages_quant(pool, scale, table, dtype=jnp.float32):
+    """Dequantizing gather: int8 ``pool`` + per-row ``scale`` ->
+    float token-major [..., W * page_size, kvH, D].  The dequant is
+    fused into the gather (one elementwise multiply on the gathered
+    block), so downstream attention sees the same layout the dense
+    :func:`gather_kv_pages` produces."""
+    g = gather_kv_pages(pool, table).astype(jnp.float32)
+    s = gather_scale_pages(scale, table)
+    return (g * s[..., None]).astype(dtype)
+
+
+def paged_attention_quant(q, kpool, vpool, kscale, vscale, table, lens,
+                          tp_axis=None):
+    """Paged attention over int8 KV pools with per-(page-row, head) f32
+    scales: the dense-gather formulation of :func:`paged_attention_xla`
+    with dequantization fused into the page gather.  ``tp_axis`` marks
+    a head-parallel caller inside a ``shard_map`` (pools sharded on the
+    KV-head axis); like the dense chooser it only validates the local
+    head grouping — attention itself needs no collective."""
+    if tp_axis is not None:
+        nh_l, kvh_l = q.shape[1], kpool.shape[1]
+        if kvh_l == 0 or nh_l % kvh_l:
+            raise ValueError(
+                f"head-parallel paged attention: local q heads {nh_l} "
+                f"do not group onto local KV heads {kvh_l} — the tp "
+                "size must divide both head counts")
+    b, nh, d = q.shape
+    kvh = kpool.shape[1]
+    rep = nh // kvh
+    # [B, W*ps, kvh, D] -> [B, kvh, W*ps, D], dequantized at the gather
+    kb = gather_kv_pages_quant(kpool, kscale, table,
+                               q.dtype).transpose(0, 2, 1, 3)
+    vb = gather_kv_pages_quant(vpool, vscale, table,
+                               q.dtype).transpose(0, 2, 1, 3)
     kq = jnp.repeat(kb, rep, axis=1)
     vq = jnp.repeat(vb, rep, axis=1)
     logits = jnp.einsum("bhd,bhtd->bht", q, kq,

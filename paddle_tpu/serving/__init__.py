@@ -38,6 +38,11 @@ Modules:
   * engine.py        — the prefill/decode driver (host scheduling,
                        deferred host sync, chunked prefill, preempted-
                        KV spill/restore) over a parallel.ModelRunner
+  * quantize.py      — dense checkpoint -> quantized serving state
+                       (int8/int4 QuantizedWeight per projection;
+                       embeddings/norms/lm_head stay dense); pairs
+                       with ``create_engine(quant=..., kv_quant=...)``
+                       for int8 KV pages with per-page scales
   * spec.py          — speculative decoding: prompt-lookup (n-gram)
                        drafter + acceptance bookkeeping; the runner's
                        verify program scores k+1 positions per step
@@ -89,6 +94,7 @@ from .engine import (  # noqa: F401
 from .faults import (  # noqa: F401
     FaultPlan, InjectedFault, fault_plan_from_flags)
 from .parallel import ModelRunner, parse_mesh  # noqa: F401
+from .quantize import quantize_state  # noqa: F401
 from .request import GenerationConfig, Request, RequestState  # noqa: F401
 from .router import (  # noqa: F401
     NoReplicaAvailable, Replica, Router, RouterServer)
@@ -108,4 +114,4 @@ __all__ = ["BackpressureError", "BlockManager", "DrainingError", "Engine",
            "SLOConfig", "SLOTracker", "Scheduler", "ServingClient",
            "ServingHTTPError", "ServingServer", "SpecStats", "Watchdog",
            "create_engine", "fault_plan_from_flags", "parse_mesh",
-           "serve"]
+           "quantize_state", "serve"]

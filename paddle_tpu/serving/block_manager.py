@@ -552,17 +552,22 @@ class BlockManager:
             plan.append((page, digest))
         return plan
 
-    def host_put(self, digest: str, k, v):
-        """Park one page's KV in the host tier (LRU-bounded)."""
-        k = np.asarray(k)
-        v = np.asarray(v)
-        self._host[digest] = (k, v)
+    def host_put(self, digest: str, *arrays):
+        """Park one page's KV in the host tier (LRU-bounded).
+
+        Variadic: dense pages park ``(k, v)``; int8 KV pages park
+        ``(k, v, kscale, vscale)`` — the quantized bytes plus their f32
+        scales, never a dequantized copy, which is what makes
+        ``spill_bytes`` genuinely shrink under ``kv_quant``.  The byte
+        ledger sums the actual itemsize of whatever was parked."""
+        arrays = tuple(np.asarray(a) for a in arrays)
+        self._host[digest] = arrays
         self._host.move_to_end(digest)
         while len(self._host) > self.host_pages:
             dropped, _ = self._host.popitem(last=False)
             if self.usage is not None:
                 self.usage.on_host_evict(dropped)
-        nbytes = k.nbytes + v.nbytes
+        nbytes = sum(a.nbytes for a in arrays)
         self.spilled_pages += 1
         self.spill_bytes += nbytes
         _M_SPILLED.inc()
@@ -578,7 +583,8 @@ class BlockManager:
         return len(self._host)
 
     def host_get(self, digest: str):
-        """The parked ``(k, v)`` for ``digest`` (LRU-touched), or None."""
+        """The parked array tuple for ``digest`` (LRU-touched), or
+        None — ``(k, v)`` dense, ``(k, v, kscale, vscale)`` int8."""
         entry = self._host.get(digest)
         if entry is not None:
             self._host.move_to_end(digest)
@@ -732,25 +738,32 @@ class BlockManager:
                 "cached_tokens": self.cached_tokens}
 
     def pool_bytes(self, *, num_layers: int, num_kv_heads: int,
-                   head_dim: int, dtype_itemsize: int,
-                   tp: int = 1) -> dict:
+                   head_dim: int, dtype_itemsize: int, tp: int = 1,
+                   kv_quant: bool = False) -> dict:
         """KV pool sizing for the engine's pool arrays, head-sharded
         over a tp-way mesh.  The pool the runner builds is
         ``2 * [L, num_pages+1, kvh, page_size, hd]`` (k + v, one extra
         dump row); sharding along the head axis divides exactly that by
         ``tp`` per device, while the page table (and this manager's
         whole accounting) stays host-side and mesh-agnostic — the same
-        page ids address every shard."""
+        page ids address every shard.  ``kv_quant`` sizes the int8 page
+        mode: 1-byte KV elements plus the two f32 scale pools
+        (``2 * [L, rows, kvh, page_size]``)."""
         if tp < 1 or num_kv_heads % tp:
             raise ValueError(
                 f"tp={tp} must be >= 1 and divide num_kv_heads="
                 f"{num_kv_heads} (the pool shards along the head axis)")
         rows = self.num_pages + 1           # + dump page
-        total = (2 * num_layers * rows * num_kv_heads * self.page_size
-                 * head_dim * dtype_itemsize)
+        elems = (2 * num_layers * rows * num_kv_heads * self.page_size
+                 * head_dim)
+        if kv_quant:
+            total = elems + (2 * num_layers * rows * num_kv_heads
+                             * self.page_size * 4)
+        else:
+            total = elems * dtype_itemsize
         return {"total_bytes": total,
                 "per_device_bytes": total // tp,
-                "rows": rows, "tp": tp}
+                "rows": rows, "tp": tp, "kv_quant": bool(kv_quant)}
 
     def _reclaimable(self) -> int:
         """Parked LRU pages an allocator under pressure could actually

@@ -145,7 +145,9 @@ class Engine:
                  sync_interval: int = 1, clock=time.monotonic,
                  slo=None, mesh=None, spec_k: int | None = None,
                  prefill_chunk: int | None = None,
-                 preempt: bool | None = None, faults=None, usage=None):
+                 preempt: bool | None = None, faults=None, usage=None,
+                 quant: str | None = None,
+                 kv_quant: bool | None = None):
         if model is not None:
             from ..framework.tensor import Tensor
             config = model.config
@@ -153,6 +155,23 @@ class Engine:
                      for k, v in model.functional_state().items()}
         if config is None or state is None:
             raise ValueError("pass a model, or both config= and state=")
+        # quantized serving: convert the dense checkpoint at
+        # construction (embeddings/norms/lm_head stay dense, so the
+        # dtype read below still sees the checkpoint dtype).  quant off
+        # (the default) leaves the state untouched — zero behavior
+        # change, same guard style as faults/sanitizer.
+        if quant is None:
+            quant = str(FLAGS.get("FLAGS_serving_quant") or "")
+        if kv_quant is None:
+            kv_quant = bool(FLAGS.get("FLAGS_serving_kv_quant"))
+        if quant not in ("", "int8", "int4"):
+            raise ValueError(
+                f"quant must be '', 'int8', or 'int4', got {quant!r}")
+        self.quant = quant
+        self.kv_quant = bool(kv_quant)
+        if self.quant:
+            from .quantize import quantize_state
+            state = quantize_state(state, kind=self.quant)
         self.config = config
         self.state = state
         self.max_slots = int(max_slots)
@@ -242,11 +261,13 @@ class Engine:
         L = config.num_hidden_layers
         kvh, hd = config.num_key_value_heads, config.head_dim
         dtype = state["llama.embed_tokens.weight"].dtype
+        self._embed_itemsize = int(np.dtype(dtype).itemsize)
         # head-sharded pool sizing: the BlockManager knows how many
         # bytes each mesh position holds, the runner reports it
         sizing = self.blocks.pool_bytes(
             num_layers=L, num_kv_heads=kvh, head_dim=hd,
-            dtype_itemsize=int(np.dtype(dtype).itemsize), tp=self.tp)
+            dtype_itemsize=int(np.dtype(dtype).itemsize), tp=self.tp,
+            kv_quant=self.kv_quant)
         # the device half: mesh, weight placement, pools, decode state,
         # and every jitted program live behind the runner seam.  The
         # kwargs are kept so recover() can rebuild an identical runner
@@ -258,6 +279,7 @@ class Engine:
             dump_page=self.blocks.dump_page,
             sync_interval=self.sync_interval,
             emit_logits=self.emit_logits, spec_k=self.spec_k,
+            kv_quant=self.kv_quant,
             per_device_pool_bytes=sizing["per_device_bytes"])
         self.runner = ModelRunner(config, state, **self._runner_kw)
 
@@ -331,6 +353,31 @@ class Engine:
             device_kind = None
         resource_tracker().set_model(n_params=n_params,
                                      device_kind=device_kind)
+
+        # quantized-serving metric surface: registered only when quant
+        # is on, so a dense engine exports exactly the pre-quant set
+        if self.quant or self.kv_quant:
+            _obs.gauge(
+                "serving_quant_weight_bits",
+                "weight-only quantization width of the serving state "
+                "(8 = int8, 4 = nibble-packed int4, 0 = dense weights)"
+            ).set({"int8": 8, "int4": 4}.get(self.quant, 0))
+            _obs.gauge(
+                "serving_quant_kv_page_bits",
+                "KV pool element width: 8 under the int8 page mode "
+                "(per-(page-row, head) f32 scales ride separately), "
+                "else the checkpoint dtype width"
+            ).set(8 if self.kv_quant
+                  else int(np.dtype(dtype).itemsize) * 8)
+            _obs.gauge(
+                "serving_quant_kv_page_bytes",
+                "bytes one KV page pair (k + v + scales) occupies — "
+                "what each spill/restore moves and what pool sizing "
+                "charges per page"
+            ).set(self._page_bytes())
+            # quant.json provider for obs.dump() (last engine wins,
+            # like the profiler/usage holders)
+            _obs.set_active_quant(self)
 
     # ------------------------------------------------ runner delegation
     # python-side mirror of serving_decode_step_traces_total: counted at
@@ -723,12 +770,14 @@ class Engine:
                             slot=slot, page=page,
                             parked_dropped=len(parked))
                 return False
-            k, v = self.runner.read_page(page)
-            self.blocks.host_put(digest, k, v)
+            arrays = self.runner.read_page(page)
+            self.blocks.host_put(digest, *arrays)
             # ledger: charged per page parked, mirroring host_put's
-            # global counters (an abort on a LATER page keeps both)
+            # global counters (an abort on a LATER page keeps both) —
+            # int8 pages park (k, v, kscale, vscale) and the byte sum
+            # reflects the quantized footprint
             req.spilled_pages += 1
-            req.spill_bytes += k.nbytes + v.nbytes
+            req.spill_bytes += sum(a.nbytes for a in arrays)
             if self.usage is not None:
                 self.usage.on_host_park(req, digest)
             parked.append(digest)
@@ -804,8 +853,7 @@ class Engine:
                     self.runner.write_page(int(row[c]), *entry)
                     self.blocks.note_restored()
                     req.restored_pages += 1
-                    req.restore_bytes += (entry[0].nbytes
-                                          + entry[1].nbytes)
+                    req.restore_bytes += sum(a.nbytes for a in entry)
                     restored += 1
                     cached += ps
         except Exception as e:
@@ -1374,6 +1422,8 @@ class Engine:
             "spill_bytes": b.spill_bytes,
             "host_parked_pages": b.host_parked,
             "mesh_tp": self.tp,
+            "quant": self.quant,
+            "kv_quant": self.kv_quant,
             "timings": {k: round(v, 6) for k, v in self.timings.items()},
             "progress": self.progress,
             "slo": self.slo.stats() if self.slo is not None else None,
@@ -1382,6 +1432,36 @@ class Engine:
             "replayed_requests": self.replayed_requests,
             "faults_injected": (dict(self.faults.injected)
                                 if self.faults is not None else {}),
+        }
+
+    def _page_bytes(self, *, dense: bool = False) -> int:
+        """Bytes one KV page pair (k + v, full heads) occupies — the
+        unit every spill/restore moves.  Under ``kv_quant`` that is the
+        int8 elements plus the per-(page-row, head) f32 scale rows;
+        ``dense=True`` prices the same page at the checkpoint dtype
+        (the savings baseline)."""
+        cfg = self.config
+        rows = (cfg.num_hidden_layers * cfg.num_key_value_heads
+                * self.page_size)
+        elems = rows * cfg.head_dim
+        if self.kv_quant and not dense:
+            return 2 * elems + 2 * rows * 4
+        return 2 * elems * self._embed_itemsize
+
+    def quant_snapshot(self) -> dict:
+        """The ``quant.json`` side-file: what is quantized, the
+        per-page byte math, and the spill-tier savings vs what the same
+        traffic would have moved with dense pages."""
+        b = self.blocks
+        dense_page = self._page_bytes(dense=True)
+        return {
+            "weight_kind": self.quant or "dense",
+            "kv_quant": self.kv_quant,
+            "page_bytes": self._page_bytes(),
+            "dense_page_bytes": dense_page,
+            "spilled_pages": b.spilled_pages,
+            "spill_bytes": b.spill_bytes,
+            "spill_bytes_dense_estimate": b.spilled_pages * dense_page,
         }
 
     def resource_snapshot(self) -> dict:
@@ -1452,7 +1532,8 @@ def create_engine(model, *, max_slots: int = 4, page_size: int = 64,
                   spec_k: int | None = None,
                   prefill_chunk: int | None = None,
                   preempt: bool | None = None, faults=None,
-                  usage=None) -> Engine:
+                  usage=None, quant: str | None = None,
+                  kv_quant: bool | None = None) -> Engine:
     """`create_predictor`-style entry point: build a continuous-batching
     engine over a LlamaForCausalLM (or any model exposing ``config`` and
     ``functional_state()`` with the llama state-dict layout).
@@ -1488,6 +1569,21 @@ def create_engine(model, *, max_slots: int = 4, page_size: int = 64,
     against ``tp=1``.  For CPU testing export
     ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` first.
 
+    ``quant`` (default ``FLAGS_serving_quant``) turns on weight-only
+    quantized serving: ``'int8'`` or ``'int4'`` converts the dense
+    checkpoint at construction via
+    :func:`paddle_tpu.serving.quantize_state` (per-projection matmul
+    weights only; embeddings/norms/lm_head stay dense) and composes
+    with any ``tp``.  ``kv_quant`` (default
+    ``FLAGS_serving_kv_quant``) switches the paged KV pools to int8
+    with per-(page-row, head) f32 scales — quantize-on-write inside
+    the jitted step, dequant fused into the attention gather, and
+    spill/restore moving the quantized bytes.  Both default off, and
+    off means the dense programs are byte-identical to a build without
+    these knobs; greedy outputs under quant match dense within a small
+    token tolerance (pinned by the ``quant_decode`` perf-gate
+    scenario).
+
     Example::
 
         engine = create_engine(model, max_slots=8, page_size=64,
@@ -1502,4 +1598,5 @@ def create_engine(model, *, max_slots: int = 4, page_size: int = 64,
                   enable_prefix_cache=enable_prefix_cache,
                   sync_interval=sync_interval, clock=clock, slo=slo,
                   mesh=mesh, spec_k=spec_k, prefill_chunk=prefill_chunk,
-                  preempt=preempt, faults=faults, usage=usage)
+                  preempt=preempt, faults=faults, usage=usage,
+                  quant=quant, kv_quant=kv_quant)

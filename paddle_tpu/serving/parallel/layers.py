@@ -14,35 +14,51 @@ written to run inside a ``shard_map`` over the ``tp`` mesh axis:
     all-reduce points per layer (``psum`` over ``tp``), exactly where
     Megatron-style TP places them.
 
-Fused/quantized weight paths are intentionally absent: the runner
-rejects fused states for ``tp>1`` up front, so these bodies only see
-plain per-projection arrays.
+FUSED weight paths are intentionally absent (the runner rejects fused
+states for ``tp>1`` up front), but every matmul routes through
+``models.generation._mm``: per-projection ``QuantizedWeight`` shards
+(int8/int4 + per-output-channel scale) take the weight-only matmul
+path, and plain arrays lower to the identical ``@`` the bodies always
+used — the dense jaxpr is unchanged.
+
+The ``*_quant`` bodies are the int8-KV-page mirrors: pools are int8
+with per-(page-row, head) f32 scale arrays, new KV quantizes on write
+inside the same traced step, and attention dequantizes fused into the
+page gather.  They serve BOTH construction modes (``axis=None`` is the
+single-chip runner; an axis name marks the shard_map context), so the
+dense bodies stay byte-identical when quantization is off.
 """
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
 
+from ...models.generation import _ffn, _mm, _qkv_proj
 from ...models.llama import _rotate_half
 from ...models.llama_hybrid import _rms
-from ...ops.pallas.paged_attention import gather_kv_pages, \
-    select_paged_attention
+from ...ops.pallas.paged_attention import (gather_kv_pages,
+                                           gather_kv_pages_quant,
+                                           paged_attention_quant,
+                                           quantize_kv_rows,
+                                           select_paged_attention)
 
 __all__ = ["decode_layer_paged_tp", "prefill_layer_tp",
-           "prefill_layer_cached_tp"]
+           "prefill_layer_cached_tp", "decode_layer_paged_quant",
+           "prefill_layer_cached_quant"]
 
 
 def _local_qkv(w, h, hd):
     """Project with the local weight shards; head counts are derived
     from the shard widths (``nh_local = nh / tp`` etc.)."""
-    q, k, v = h @ w["q"], h @ w["k"], h @ w["v"]
+    q, k, v = _mm(h, w["q"]), _mm(h, w["k"]), _mm(h, w["v"])
     return q, k, v, q.shape[-1] // hd, k.shape[-1] // hd
 
 
 def _ffn_tp(w, h, axis):
     """Column-sharded gate/up, row-sharded down: the partial down
     product is one of the layer's two all-reduces."""
-    part = (jax.nn.silu(h @ w["gate"]) * (h @ w["up"])) @ w["down"]
+    part = _mm(jax.nn.silu(_mm(h, w["gate"])) * _mm(h, w["up"]),
+               w["down"])
     return jax.lax.psum(part, axis)
 
 
@@ -73,7 +89,7 @@ def decode_layer_paged_tp(w, x, kpool, vpool, table, cos1, sin1, pos,
 
     attn = select_paged_attention(tp_axis=axis)(
         q, kpool, vpool, table, pos + 1).reshape(b, nh_l * hd)
-    x = x + jax.lax.psum(attn @ w["o"], axis)
+    x = x + jax.lax.psum(_mm(attn, w["o"]), axis)
     h = _rms(x[:, None], w["ln2"], cfg.rms_norm_eps)[:, 0]
     return x + _ffn_tp(w, h, axis), kpool, vpool
 
@@ -96,7 +112,7 @@ def prefill_layer_tp(w, x, cos, sin, mask, cfg, axis):
     from ...ops.pallas.flash_attention import sdpa
     attn = sdpa(q, k, v, attn_mask=mask[:, None, None, :],
                 is_causal=True).reshape(b, s, nh_l * hd)
-    x = x + jax.lax.psum(attn @ w["o"], axis)
+    x = x + jax.lax.psum(_mm(attn, w["o"]), axis)
     h = _rms(x, w["ln2"], cfg.rms_norm_eps)
     return x + _ffn_tp(w, h, axis), k, v
 
@@ -127,6 +143,103 @@ def prefill_layer_cached_tp(w, x, kpool, vpool, row, cos_s, sin_s, mask,
     vcat = jnp.concatenate([vpre.astype(v.dtype), v], axis=1)
     attn = sdpa(q, kcat, vcat, attn_mask=mask,
                 is_causal=False).reshape(b, s, nh_l * hd)
-    x = x + jax.lax.psum(attn @ w["o"], axis)
+    x = x + jax.lax.psum(_mm(attn, w["o"]), axis)
     h = _rms(x, w["ln2"], cfg.rms_norm_eps)
     return x + _ffn_tp(w, h, axis), k, v
+
+
+# ------------------------------------------------- int8 KV page bodies
+def _proj_qkv(w, h, cfg, axis):
+    """(q, k, v, nh_local, kvh_local) for either construction mode:
+    single-chip (``axis=None``) goes through ``_qkv_proj`` so fused
+    quantized states keep their one-GEMV path; per-shard derives local
+    head counts from the shard widths like ``_local_qkv``."""
+    hd = cfg.head_dim
+    if axis is None:
+        qp, kp, vp = _qkv_proj(w, h, cfg.num_attention_heads,
+                               cfg.num_key_value_heads, hd)
+    else:
+        qp, kp, vp = _mm(h, w["q"]), _mm(h, w["k"]), _mm(h, w["v"])
+    return qp, kp, vp, qp.shape[-1] // hd, kp.shape[-1] // hd
+
+
+def _out_reduce(part, axis):
+    """Row-sharded output projection: psum inside a shard_map, identity
+    on the single-chip path."""
+    return part if axis is None else jax.lax.psum(part, axis)
+
+
+def _ffn_quant(w, h, axis):
+    if axis is None:
+        return _ffn(w, h)
+    return _ffn_tp(w, h, axis)
+
+
+def decode_layer_paged_quant(w, x, kpool, vpool, kscale, vscale, table,
+                             cos1, sin1, pos, cfg, axis=None):
+    """Paged decode layer over int8 KV pools: quantize this token's
+    k/v rows on write (per-(token, head) scale into the scale pools —
+    same traced step, no extra host sync), attend through the
+    dequantizing gather.  ``axis=None`` is the tp=1 runner; an axis
+    name runs the same body per-shard with the o/down all-reduces.
+    Returns (out, kpool, vpool, kscale, vscale)."""
+    b = x.shape[0]
+    hd = cfg.head_dim
+    ps = kpool.shape[2]
+    h = _rms(x[:, None], w["ln1"], cfg.rms_norm_eps)[:, 0]
+    qp, kp, vp, nh_l, kvh_l = _proj_qkv(w, h, cfg, axis)
+    q = qp.reshape(b, nh_l, hd)
+    k = kp.reshape(b, kvh_l, hd)
+    v = vp.reshape(b, kvh_l, hd)
+    cos_c = cos1[:, None, :].astype(q.dtype)
+    sin_c = sin1[:, None, :].astype(q.dtype)
+    q = q * cos_c + _rotate_half(q) * sin_c
+    k = k * cos_c + _rotate_half(k) * sin_c
+
+    page = jnp.take_along_axis(table, (pos // ps)[:, None], axis=1)[:, 0]
+    off = pos % ps
+    heads = jnp.arange(kvh_l)
+    qk, sk = quantize_kv_rows(k)
+    qv, sv = quantize_kv_rows(v)
+    idx = (page[:, None], heads[None, :], off[:, None])
+    kpool = kpool.at[idx].set(qk)
+    vpool = vpool.at[idx].set(qv)
+    kscale = kscale.at[idx].set(sk)
+    vscale = vscale.at[idx].set(sv)
+
+    attn = paged_attention_quant(
+        q, kpool, vpool, kscale, vscale, table, pos + 1,
+        tp_axis=axis).reshape(b, nh_l * hd)
+    x = x + _out_reduce(_mm(attn, w["o"]), axis)
+    h = _rms(x[:, None], w["ln2"], cfg.rms_norm_eps)[:, 0]
+    return (x + _ffn_quant(w, h, axis), kpool, vpool, kscale, vscale)
+
+
+def prefill_layer_cached_quant(w, x, kpool, vpool, kscale, vscale, row,
+                               cos_s, sin_s, mask, cfg, axis=None):
+    """Cached-suffix prefill layer over int8 KV pools: the resident
+    prefix dequantizes through the scale-aware gather; the suffix's own
+    k/v stay float here (the runner quantizes them at the pool write).
+    Returns (out, k_suffix, v_suffix) like the dense mirrors."""
+    b, s, _ = x.shape
+    hd = cfg.head_dim
+    h = _rms(x, w["ln1"], cfg.rms_norm_eps)
+    qp, kp, vp, nh_l, kvh_l = _proj_qkv(w, h, cfg, axis)
+    q = qp.reshape(b, s, nh_l, hd)
+    k = kp.reshape(b, s, kvh_l, hd)
+    v = vp.reshape(b, s, kvh_l, hd)
+    cos_c = cos_s[None, :, None, :].astype(q.dtype)
+    sin_c = sin_s[None, :, None, :].astype(q.dtype)
+    q = q * cos_c + _rotate_half(q) * sin_c
+    k = k * cos_c + _rotate_half(k) * sin_c
+
+    kpre = gather_kv_pages_quant(kpool, kscale, row, k.dtype)[None]
+    vpre = gather_kv_pages_quant(vpool, vscale, row, v.dtype)[None]
+    from ...ops.pallas.flash_attention import sdpa
+    kcat = jnp.concatenate([kpre, k], axis=1)
+    vcat = jnp.concatenate([vpre, v], axis=1)
+    attn = sdpa(q, kcat, vcat, attn_mask=mask,
+                is_causal=False).reshape(b, s, nh_l * hd)
+    x = x + _out_reduce(_mm(attn, w["o"]), axis)
+    h = _rms(x, w["ln2"], cfg.rms_norm_eps)
+    return x + _ffn_quant(w, h, axis), k, v

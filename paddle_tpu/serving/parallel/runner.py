@@ -47,8 +47,11 @@ from ...models.generation import (_decode_layer_paged, _ffn,
                                   _qkv_proj, _rope_at)
 from ...models.llama import _rope_tables, _rotate_half
 from ...models.llama_hybrid import _rms
-from ...ops.pallas.paged_attention import gather_kv_pages
-from .layers import (decode_layer_paged_tp, prefill_layer_cached_tp,
+from ...ops.pallas.paged_attention import (gather_kv_pages,
+                                           quantize_kv_rows)
+from ...ops.pallas.quant_matmul import QuantizedWeight
+from .layers import (decode_layer_paged_quant, decode_layer_paged_tp,
+                     prefill_layer_cached_quant, prefill_layer_cached_tp,
                      prefill_layer_tp)
 from .mesh import TP_AXIS, mesh_devices, validate_tp
 
@@ -78,6 +81,19 @@ _ROW_SHARDED = ("self_attn.o_proj.weight", "mlp.down_proj.weight")
 _FUSED_KEYS = ("self_attn.qkv_fused.weight", "mlp.gateup_fused.weight")
 
 
+def _leaf_bytes(v) -> int:
+    """Device bytes of one weight leaf: QuantizedWeight counts its int8
+    (or nibble-packed int4) values plus the f32 scale vector; dense
+    arrays count shape * itemsize; shapeless leaves count 0."""
+    if isinstance(v, QuantizedWeight):
+        return (int(np.prod(v.q.shape)) * jnp.dtype(v.q.dtype).itemsize
+                + int(np.prod(v.scale.shape))
+                * jnp.dtype(v.scale.dtype).itemsize)
+    if hasattr(v, "shape"):
+        return int(np.prod(v.shape)) * jnp.dtype(v.dtype).itemsize
+    return 0
+
+
 class ModelRunner:
     """Device-side serving runner (see module docstring).
 
@@ -90,6 +106,7 @@ class ModelRunner:
                  max_slots: int, page_size: int, table_width: int,
                  num_pages: int, dump_page: int, sync_interval: int = 1,
                  emit_logits: bool = False, spec_k: int = 0,
+                 kv_quant: bool = False,
                  per_device_pool_bytes: int | None = None):
         self.config = config
         self.tp = int(tp)
@@ -101,13 +118,22 @@ class ModelRunner:
         self.sync_interval = int(sync_interval)
         self.emit_logits = bool(emit_logits)
         self.spec_k = int(spec_k)
+        self.kv_quant = bool(kv_quant)
         validate_tp(config, self.tp)
+        self._validate_quantized_state(state)
 
         L = config.num_hidden_layers
         kvh, hd = config.num_key_value_heads, config.head_dim
         dtype = state["llama.embed_tokens.weight"].dtype
         pool_rows = self.num_pages + 1               # + dump page
         pool_shape = (L, pool_rows, kvh, self.page_size, hd)
+        # int8 KV page mode: pools store int8, one f32 scale per
+        # (layer, page row, head, slot) rides in separate scale pools.
+        # Dense mode keeps EXACTLY the old arrays — the scale members
+        # become empty tuples, which contribute zero pytree leaves to
+        # every jitted signature, so the dense jaxprs are unchanged.
+        pool_dtype = jnp.int8 if self.kv_quant else dtype
+        scale_shape = (L, pool_rows, kvh, self.page_size)
         self._rope_len = self.table_width * self.page_size
         cos, sin = _rope_tables(self._rope_len, hd, config.rope_theta)
         cos = cos.astype(jnp.float32)
@@ -126,8 +152,13 @@ class ModelRunner:
             self.mesh = None
             self.devices = list(jax.devices()[:1]) if jax.devices() else []
             self.state = state
-            self.kpool = jnp.zeros(pool_shape, dtype)
-            self.vpool = jnp.zeros(pool_shape, dtype)
+            self.kpool = jnp.zeros(pool_shape, pool_dtype)
+            self.vpool = jnp.zeros(pool_shape, pool_dtype)
+            if self.kv_quant:
+                self.kscale = jnp.zeros(scale_shape, jnp.float32)
+                self.vscale = jnp.zeros(scale_shape, jnp.float32)
+            else:
+                self.kscale = self.vscale = ()
             self._cos, self._sin = cos, sin
             self._table_dev = jnp.asarray(table0)
             self._pos_dev = jnp.zeros((self.max_slots,), jnp.int32)
@@ -142,16 +173,22 @@ class ModelRunner:
             self.mesh = Mesh(np.asarray(self.devices), (TP_AXIS,))
             self._pool_pspec = PartitionSpec(
                 None, None, TP_AXIS, None, None)
+            self._scale_pspec = PartitionSpec(None, None, TP_AXIS, None)
             rep = NamedSharding(self.mesh, PartitionSpec())
-            self.state = {
-                k: jax.device_put(
-                    v, NamedSharding(self.mesh, self._spec_for(k)))
-                for k, v in state.items()}
+            self.state = {k: self._place(k, v) for k, v in state.items()}
             pool_sh = NamedSharding(self.mesh, self._pool_pspec)
-            self.kpool = jax.device_put(jnp.zeros(pool_shape, dtype),
+            self.kpool = jax.device_put(jnp.zeros(pool_shape, pool_dtype),
                                         pool_sh)
-            self.vpool = jax.device_put(jnp.zeros(pool_shape, dtype),
+            self.vpool = jax.device_put(jnp.zeros(pool_shape, pool_dtype),
                                         pool_sh)
+            if self.kv_quant:
+                scale_sh = NamedSharding(self.mesh, self._scale_pspec)
+                self.kscale = jax.device_put(
+                    jnp.zeros(scale_shape, jnp.float32), scale_sh)
+                self.vscale = jax.device_put(
+                    jnp.zeros(scale_shape, jnp.float32), scale_sh)
+            else:
+                self.kscale = self.vscale = ()
             self._cos = jax.device_put(cos, rep)
             self._sin = jax.device_put(sin, rep)
             self._table_dev = jax.device_put(jnp.asarray(table0), rep)
@@ -179,18 +216,18 @@ class ModelRunner:
         # per-device footprint estimates + mesh-position registration for
         # the resource snapshot (CPU devices export no memory_stats, so
         # /debug/resources reports these alongside whatever stats exist)
-        itemsize = jnp.dtype(dtype).itemsize
+        itemsize = jnp.dtype(pool_dtype).itemsize
         pool_total = 2 * int(np.prod(pool_shape)) * itemsize
+        if self.kv_quant:           # + the f32 scale pools
+            pool_total += 2 * int(np.prod(scale_shape)) * 4
         self._pool_bytes_per_device = (
             int(per_device_pool_bytes) if per_device_pool_bytes
             else pool_total // self.tp)
         sharded = sum(
-            int(np.prod(v.shape)) * jnp.dtype(v.dtype).itemsize
-            for k, v in state.items()
+            _leaf_bytes(v) for k, v in state.items()
             if k.endswith(_COL_SHARDED) or k.endswith(_ROW_SHARDED))
-        replicated = sum(
-            int(np.prod(v.shape)) * jnp.dtype(v.dtype).itemsize
-            for k, v in state.items() if hasattr(v, "shape")) - sharded
+        replicated = sum(_leaf_bytes(v)
+                         for v in state.values()) - sharded
         self._weight_bytes_per_device = sharded // self.tp + replicated
         resource_tracker().set_mesh({
             f"{d.platform}:{d.id}": {TP_AXIS: i}
@@ -206,37 +243,138 @@ class ModelRunner:
             return PartitionSpec(TP_AXIS, None)
         return PartitionSpec()      # embeddings / norms / lm_head
 
+    @staticmethod
+    def _validate_quantized_state(state: dict):
+        """Loud construction-time rejection of MALFORMED quantized
+        leaves (both tp modes): a broken QuantizedWeight would otherwise
+        surface as an opaque shape error deep inside the first trace."""
+        for key, v in state.items():
+            if not isinstance(v, QuantizedWeight):
+                continue
+            if v.kind not in ("int8", "int4"):
+                raise ValueError(
+                    f"state[{key!r}]: unsupported quant kind {v.kind!r}"
+                    " (expected 'int8' or 'int4')")
+            if not (hasattr(v.q, "shape") and hasattr(v.scale, "shape")):
+                raise ValueError(
+                    f"state[{key!r}]: QuantizedWeight q/scale must be "
+                    "arrays (missing scale?)")
+            if v.q.ndim != 2:
+                raise ValueError(
+                    f"state[{key!r}]: quantized values must be 2-D, "
+                    f"got shape {tuple(v.q.shape)}")
+            if v.scale.ndim != 1 or v.scale.shape[0] != v.q.shape[1]:
+                raise ValueError(
+                    f"state[{key!r}]: scale shape "
+                    f"{tuple(v.scale.shape)} does not match one scale "
+                    f"per output channel (expected ({v.q.shape[1]},))")
+            rows = v.k // 2 if v.kind == "int4" else v.k
+            if v.q.shape[0] != rows:
+                raise ValueError(
+                    f"state[{key!r}]: {v.kind} values have "
+                    f"{v.q.shape[0]} rows, expected {rows} for "
+                    f"K={v.k}")
+
     def _check_state_shardable(self, state: dict):
         for k, v in state.items():
             if k.endswith(_FUSED_KEYS):
                 raise ValueError(
-                    f"state has fused weight {k!r}: fused/quantized "
-                    "serving states are single-chip only (tp=1) — the "
-                    "tp>1 runner shards the per-projection q/k/v and "
+                    f"state has fused weight {k!r}: fused serving "
+                    "states are single-chip only (tp=1) — the tp>1 "
+                    "runner shards the per-projection q/k/v and "
                     "gate/up weights individually")
+            if isinstance(v, QuantizedWeight):
+                if k.endswith(_ROW_SHARDED):
+                    if v.q.shape[0] % self.tp:
+                        raise ValueError(
+                            f"state[{k!r}]: quantized K rows "
+                            f"{v.q.shape[0]} not divisible by tp="
+                            f"{self.tp}" + (
+                                " (int4 packs two K rows per int8 "
+                                "byte — K/2 must divide)"
+                                if v.kind == "int4" else ""))
+                elif k.endswith(_COL_SHARDED):
+                    if v.q.shape[1] % self.tp:
+                        raise ValueError(
+                            f"state[{k!r}]: quantized N columns "
+                            f"{v.q.shape[1]} not divisible by tp="
+                            f"{self.tp}")
+                continue
             if not isinstance(v, (np.ndarray, jnp.ndarray)):
                 raise ValueError(
-                    f"state[{k!r}] is {type(v).__name__}, not an array: "
-                    "quantized weights cannot be head-sharded; serve "
-                    "them with tp=1")
+                    f"state[{k!r}] is {type(v).__name__}, not an array "
+                    "or QuantizedWeight — cannot be head-sharded")
+
+    def _quant_specs(self, key: str, v: QuantizedWeight):
+        """(q_spec, scale_spec, local_k) for one quantized leaf.
+
+        Column-sharded projections split q and the per-output-channel
+        scale along N and keep the global K.  Row-sharded projections
+        split q along K — each shard's ``weight_only_matmul`` K-check
+        must see the LOCAL contraction length, so the placed leaf's aux
+        ``k`` becomes ``k // tp`` — while the per-N scale replicates
+        (it multiplies the partial products before the psum, which is
+        linear, so scaling per shard is exact)."""
+        from jax.sharding import PartitionSpec
+        if key.endswith(_COL_SHARDED):
+            return (PartitionSpec(None, TP_AXIS),
+                    PartitionSpec(TP_AXIS), v.k)
+        if key.endswith(_ROW_SHARDED):
+            return (PartitionSpec(TP_AXIS, None), PartitionSpec(),
+                    v.k // self.tp)
+        return PartitionSpec(), PartitionSpec(), v.k
+
+    def _place(self, key: str, v):
+        """device_put one weight leaf with its tp sharding."""
+        from jax.sharding import NamedSharding
+        if isinstance(v, QuantizedWeight):
+            qspec, sspec, k_local = self._quant_specs(key, v)
+            q = jax.device_put(jnp.asarray(v.q),
+                               NamedSharding(self.mesh, qspec))
+            scale = jax.device_put(jnp.asarray(v.scale),
+                                   NamedSharding(self.mesh, sspec))
+            return QuantizedWeight(q, scale, kind=v.kind, k=k_local)
+        return jax.device_put(
+            jnp.asarray(v), NamedSharding(self.mesh, self._spec_for(key)))
 
     def _state_specs(self):
-        return {k: self._spec_for(k) for k in self.state}
+        """Pytree of shard_map in_specs mirroring the placed state:
+        QuantizedWeight leaves become QuantizedWeight-of-PartitionSpecs
+        whose aux (kind, k) copies the PLACED leaf — row shards already
+        carry the local k — so the spec tree and the argument tree
+        flatten identically."""
+        specs = {}
+        for k, v in self.state.items():
+            if isinstance(v, QuantizedWeight):
+                qspec, sspec, _ = self._quant_specs(k, v)
+                specs[k] = QuantizedWeight(qspec, sspec, kind=v.kind,
+                                           k=v.k)
+            else:
+                specs[k] = self._spec_for(k)
+        return specs
 
     # ------------------------------------------------------- jitted bodies
+    # Every jitted signature threads (kscale, vscale) right after the
+    # pools.  Dense mode passes the empty tuples stored at construction:
+    # zero pytree leaves, so the flattened argument list — and therefore
+    # the jaxpr — is byte-identical to the pre-quant program.  The
+    # shard_map specs use P() for those positions (a pspec broadcasts
+    # over an empty subtree).
     def _make_step_fn(self):
         if self.tp == 1:
             return jax.jit(self._build_step(),
-                           donate_argnums=(1, 2, 4, 5, 7, 8))
+                           donate_argnums=(1, 2, 3, 4, 6, 7, 9, 10))
         from jax.sharding import PartitionSpec as P
         pool = self._pool_pspec
+        sspec = self._scale_pspec if self.kv_quant else P()
         mapped = jax.shard_map(
             self._build_step_tp(), mesh=self.mesh,
-            in_specs=(self._state_specs(), pool, pool, P(), P(), P(),
-                      P(), P(), P(), P(), P()),
-            out_specs=(pool, pool, P(), P(), P(), P(), P()),
+            in_specs=(self._state_specs(), pool, pool, sspec, sspec,
+                      P(), P(), P(), P(), P(), P(), P(), P()),
+            out_specs=(pool, pool, sspec, sspec, P(), P(), P(), P(),
+                       P()),
             check_vma=False)
-        return jax.jit(mapped, donate_argnums=(1, 2, 4, 5, 7, 8))
+        return jax.jit(mapped, donate_argnums=(1, 2, 3, 4, 6, 7, 9, 10))
 
     def _build_step(self):
         cfg = self.config
@@ -244,10 +382,11 @@ class ModelRunner:
         emit_logits = self.emit_logits
         rope_len = self._rope_len
         wide_ring = self.spec_k > 0
+        kv_quant = self.kv_quant
         runner = self
 
-        def step(state, kpool, vpool, table, pos, tok, active, ring,
-                 ridx, cos, sin):
+        def step(state, kpool, vpool, kscale, vscale, table, pos, tok,
+                 active, ring, ridx, cos, sin):
             # python body runs at trace time only: a second execution of
             # this line means an admission/eviction re-traced the step
             runner.decode_traces += 1
@@ -261,16 +400,26 @@ class ModelRunner:
                            axis=0)
             cos1, sin1 = _rope_at(cos, sin, posc)
             h = emb
-            kps, vps = [], []
+            kps, vps, kss, vss = [], [], [], []
             for i in range(L):
                 w = _layer_weights(state, i)
-                h, kp_, vp_ = _decode_layer_paged(
-                    w, h, kpool[i], vpool[i], table, cos1, sin1, posc,
-                    cfg)
+                if kv_quant:
+                    h, kp_, vp_, ks_, vs_ = decode_layer_paged_quant(
+                        w, h, kpool[i], vpool[i], kscale[i], vscale[i],
+                        table, cos1, sin1, posc, cfg)
+                    kss.append(ks_)
+                    vss.append(vs_)
+                else:
+                    h, kp_, vp_ = _decode_layer_paged(
+                        w, h, kpool[i], vpool[i], table, cos1, sin1,
+                        posc, cfg)
                 kps.append(kp_)
                 vps.append(vp_)
             kpool = jnp.stack(kps)
             vpool = jnp.stack(vps)
+            if kv_quant:
+                kscale = jnp.stack(kss)
+                vscale = jnp.stack(vss)
             h = _rms(h[:, None], state["llama.norm.weight"],
                      cfg.rms_norm_eps)[:, 0]
             logits = _logits_of(state, h).astype(jnp.float32)
@@ -281,8 +430,8 @@ class ModelRunner:
             ring2 = (ring.at[ridx, :, 0].set(nxt) if wide_ring
                      else ring.at[ridx].set(nxt))
             ridx2 = (ridx + 1) % ring.shape[0]
-            return (kpool, vpool, pos2, tok2, ring2, ridx2,
-                    logits if emit_logits
+            return (kpool, vpool, kscale, vscale, pos2, tok2, ring2,
+                    ridx2, logits if emit_logits
                     else jnp.zeros((), jnp.float32))
 
         return step
@@ -297,10 +446,11 @@ class ModelRunner:
         emit_logits = self.emit_logits
         rope_len = self._rope_len
         wide_ring = self.spec_k > 0
+        kv_quant = self.kv_quant
         runner = self
 
-        def step(state, kpool, vpool, table, pos, tok, active, ring,
-                 ridx, cos, sin):
+        def step(state, kpool, vpool, kscale, vscale, table, pos, tok,
+                 active, ring, ridx, cos, sin):
             runner.decode_traces += 1
             _M_STEP_TRACES.inc()
             posc = jnp.minimum(pos, rope_len - 1)
@@ -308,16 +458,26 @@ class ModelRunner:
                            axis=0)
             cos1, sin1 = _rope_at(cos, sin, posc)
             h = emb
-            kps, vps = [], []
+            kps, vps, kss, vss = [], [], [], []
             for i in range(L):
                 w = _layer_weights(state, i)
-                h, kp_, vp_ = decode_layer_paged_tp(
-                    w, h, kpool[i], vpool[i], table, cos1, sin1, posc,
-                    cfg, TP_AXIS)
+                if kv_quant:
+                    h, kp_, vp_, ks_, vs_ = decode_layer_paged_quant(
+                        w, h, kpool[i], vpool[i], kscale[i], vscale[i],
+                        table, cos1, sin1, posc, cfg, TP_AXIS)
+                    kss.append(ks_)
+                    vss.append(vs_)
+                else:
+                    h, kp_, vp_ = decode_layer_paged_tp(
+                        w, h, kpool[i], vpool[i], table, cos1, sin1,
+                        posc, cfg, TP_AXIS)
                 kps.append(kp_)
                 vps.append(vp_)
             kpool = jnp.stack(kps)
             vpool = jnp.stack(vps)
+            if kv_quant:
+                kscale = jnp.stack(kss)
+                vscale = jnp.stack(vss)
             h = _rms(h[:, None], state["llama.norm.weight"],
                      cfg.rms_norm_eps)[:, 0]
             logits = _logits_of(state, h).astype(jnp.float32)
@@ -328,8 +488,8 @@ class ModelRunner:
             ring2 = (ring.at[ridx, :, 0].set(nxt) if wide_ring
                      else ring.at[ridx].set(nxt))
             ridx2 = (ridx + 1) % ring.shape[0]
-            return (kpool, vpool, pos2, tok2, ring2, ridx2,
-                    logits if emit_logits
+            return (kpool, vpool, kscale, vscale, pos2, tok2, ring2,
+                    ridx2, logits if emit_logits
                     else jnp.zeros((), jnp.float32))
 
         return step
@@ -337,16 +497,18 @@ class ModelRunner:
     def _make_verify_fn(self):
         if self.tp == 1:
             return jax.jit(self._build_verify(tp=False),
-                           donate_argnums=(1, 2, 4, 5, 7, 8))
+                           donate_argnums=(1, 2, 3, 4, 6, 7, 9, 10))
         from jax.sharding import PartitionSpec as P
         pool = self._pool_pspec
+        sspec = self._scale_pspec if self.kv_quant else P()
         mapped = jax.shard_map(
             self._build_verify(tp=True), mesh=self.mesh,
-            in_specs=(self._state_specs(), pool, pool, P(), P(), P(),
-                      P(), P(), P(), P(), P(), P(), P()),
-            out_specs=(pool, pool, P(), P(), P(), P()),
+            in_specs=(self._state_specs(), pool, pool, sspec, sspec,
+                      P(), P(), P(), P(), P(), P(), P(), P(), P(),
+                      P()),
+            out_specs=(pool, pool, sspec, sspec, P(), P(), P(), P()),
             check_vma=False)
-        return jax.jit(mapped, donate_argnums=(1, 2, 4, 5, 7, 8))
+        return jax.jit(mapped, donate_argnums=(1, 2, 3, 4, 6, 7, 9, 10))
 
     def _build_verify(self, *, tp: bool):
         """The speculative verify program: score ``k+1`` candidate
@@ -379,10 +541,11 @@ class ModelRunner:
         rope_len = self._rope_len
         k = self.spec_k
         M = k + 1
+        kv_quant = self.kv_quant
         runner = self
 
-        def verify(state, kpool, vpool, table, pos, tok, active, ring,
-                   ridx, draft, dlen, cos, sin):
+        def verify(state, kpool, vpool, kscale, vscale, table, pos,
+                   tok, active, ring, ridx, draft, dlen, cos, sin):
             # trace-time counters, exactly like the plain step body
             runner.decode_traces += 1
             runner.verify_traces += 1
@@ -401,10 +564,17 @@ class ModelRunner:
                            axis=0)
             cos1, sin1 = _rope_at(cos, sin, posc)
             h = emb
-            kps, vps = [], []
+            kps, vps, kss, vss = [], [], [], []
             for i in range(L):
                 w = _layer_weights(state, i)
-                if tp:
+                if kv_quant:
+                    h, kp_, vp_, ks_, vs_ = decode_layer_paged_quant(
+                        w, h, kpool[i], vpool[i], kscale[i], vscale[i],
+                        table_f, cos1, sin1, posc, cfg,
+                        TP_AXIS if tp else None)
+                    kss.append(ks_)
+                    vss.append(vs_)
+                elif tp:
                     h, kp_, vp_ = decode_layer_paged_tp(
                         w, h, kpool[i], vpool[i], table_f, cos1, sin1,
                         posc, cfg, TP_AXIS)
@@ -416,6 +586,9 @@ class ModelRunner:
                 vps.append(vp_)
             kpool = jnp.stack(kps)
             vpool = jnp.stack(vps)
+            if kv_quant:
+                kscale = jnp.stack(kss)
+                vscale = jnp.stack(vss)
             h = _rms(h[:, None], state["llama.norm.weight"],
                      cfg.rms_norm_eps)[:, 0]
             logits = _logits_of(state, h).astype(jnp.float32)
@@ -434,27 +607,35 @@ class ModelRunner:
             tok2 = jnp.where(active.astype(bool), tok_new, tok)
             ring2 = ring.at[ridx].set(y)
             ridx2 = (ridx + 1) % ring.shape[0]
-            return kpool, vpool, pos2, tok2, ring2, ridx2
+            return (kpool, vpool, kscale, vscale, pos2, tok2, ring2,
+                    ridx2)
 
         return verify
 
     def _make_copy_page_fn(self):
+        kv_quant = self.kv_quant
+
+        def copy(kp, vp, ks, vs, src, dst):
+            kp2 = kp.at[:, dst].set(kp[:, src])
+            vp2 = vp.at[:, dst].set(vp[:, src])
+            if kv_quant:        # scale rows travel with their page
+                ks = ks.at[:, dst].set(ks[:, src])
+                vs = vs.at[:, dst].set(vs[:, src])
+            return kp2, vp2, ks, vs
+
         if self.tp == 1:
             # CoW page copy: src/dst are data — one trace for the engine
-            return jax.jit(
-                lambda kp, vp, src, dst: (kp.at[:, dst].set(kp[:, src]),
-                                          vp.at[:, dst].set(vp[:, src])),
-                donate_argnums=(0, 1))
+            return jax.jit(copy, donate_argnums=(0, 1, 2, 3))
         from jax.sharding import PartitionSpec as P
         pool = self._pool_pspec
+        sspec = self._scale_pspec if kv_quant else P()
         # per-shard copy: a page holds every local head's rows, so the
         # CoW duplicate is collective-free
         mapped = jax.shard_map(
-            lambda kp, vp, src, dst: (kp.at[:, dst].set(kp[:, src]),
-                                      vp.at[:, dst].set(vp[:, src])),
-            mesh=self.mesh, in_specs=(pool, pool, P(), P()),
-            out_specs=(pool, pool), check_vma=False)
-        return jax.jit(mapped, donate_argnums=(0, 1))
+            copy, mesh=self.mesh,
+            in_specs=(pool, pool, sspec, sspec, P(), P()),
+            out_specs=(pool, pool, sspec, sspec), check_vma=False)
+        return jax.jit(mapped, donate_argnums=(0, 1, 2, 3))
 
     def _prefill_fn(self, bucket: int):
         fn = self._prefill_fns.get(bucket)
@@ -465,9 +646,10 @@ class ModelRunner:
         ps = self.page_size
         n_pages = bucket // ps
         tp = self.tp
+        kv_quant = self.kv_quant
 
-        def prefill(state, ids, length, table_row, kpool, vpool, cos,
-                    sin):
+        def prefill(state, ids, length, table_row, kpool, vpool,
+                    kscale, vscale, cos, sin):
             _M_PREFILL_TRACES.labels(str(bucket)).inc()
             x = jnp.take(state["llama.embed_tokens.weight"], ids, axis=0)
             pmask = jnp.arange(bucket)[None, :] < length
@@ -480,31 +662,47 @@ class ModelRunner:
                     x, k, v = prefill_layer_tp(w, x, cos[:bucket],
                                                sin[:bucket], pmask, cfg,
                                                TP_AXIS)
+                if kv_quant:
+                    # quantize the whole prompt's KV once per layer,
+                    # then page the int8 rows + their scales
+                    qk, sk = quantize_kv_rows(k[0])
+                    qv, sv = quantize_kv_rows(v[0])
+                    k, v = qk[None], qv[None]
                 for p in range(n_pages):
-                    rows_k = k[0, p * ps:(p + 1) * ps].swapaxes(0, 1)
-                    rows_v = v[0, p * ps:(p + 1) * ps].swapaxes(0, 1)
-                    kpool = kpool.at[i, table_row[p]].set(rows_k)
-                    vpool = vpool.at[i, table_row[p]].set(rows_v)
+                    sl = slice(p * ps, (p + 1) * ps)
+                    rows_k = k[0, sl].swapaxes(0, 1)
+                    rows_v = v[0, sl].swapaxes(0, 1)
+                    kpool = kpool.at[i, table_row[p]].set(
+                        rows_k.astype(kpool.dtype))
+                    vpool = vpool.at[i, table_row[p]].set(
+                        rows_v.astype(vpool.dtype))
+                    if kv_quant:
+                        kscale = kscale.at[i, table_row[p]].set(
+                            sk[sl].swapaxes(0, 1))
+                        vscale = vscale.at[i, table_row[p]].set(
+                            sv[sl].swapaxes(0, 1))
             x = _rms(x, state["llama.norm.weight"], cfg.rms_norm_eps)
             last = jnp.take_along_axis(
                 x, (length - 1)[:, None, None].astype(jnp.int32),
                 axis=1)[:, 0]
             logits = _logits_of(state, last).astype(jnp.float32)
-            return kpool, vpool, logits
+            return kpool, vpool, kscale, vscale, logits
 
         # kpool/vpool donation: prefill updates the pool in place instead
         # of double-buffering the engine's whole KV footprint per admit
         if tp == 1:
-            fn = jax.jit(prefill, donate_argnums=(4, 5))
+            fn = jax.jit(prefill, donate_argnums=(4, 5, 6, 7))
         else:
             from jax.sharding import PartitionSpec as P
             pool = self._pool_pspec
+            sspec = self._scale_pspec if kv_quant else P()
             mapped = jax.shard_map(
                 prefill, mesh=self.mesh,
                 in_specs=(self._state_specs(), P(), P(), P(), pool,
-                          pool, P(), P()),
-                out_specs=(pool, pool, P()), check_vma=False)
-            fn = jax.jit(mapped, donate_argnums=(4, 5))
+                          pool, sspec, sspec, P(), P()),
+                out_specs=(pool, pool, sspec, sspec, P()),
+                check_vma=False)
+            fn = jax.jit(mapped, donate_argnums=(4, 5, 6, 7))
         self._prefill_fns[bucket] = fn
         return fn
 
@@ -525,8 +723,10 @@ class ModelRunner:
         rope_len = self._rope_len
         tp = self.tp
 
+        kv_quant = self.kv_quant
+
         def prefill(state, ids, length, cached_len, row, kpool, vpool,
-                    cos, sin):
+                    kscale, vscale, cos, sin):
             _M_PREFILL_TRACES.labels(f"cached:{bucket}").inc()
             x = jnp.take(state["llama.embed_tokens.weight"], ids, axis=0)
             j = jnp.arange(bucket)
@@ -547,8 +747,21 @@ class ModelRunner:
                                row[jnp.minimum(absp // ps, W - 1)], dump)
             off = absp % ps
             heads = jnp.arange(kvh_l)
+            widx = (page_w[:, None], heads[None, :], off[:, None])
             for i in range(L):
                 w = _layer_weights(state, i)
+                if kv_quant:
+                    x, k, v = prefill_layer_cached_quant(
+                        w, x, kpool[i], vpool[i], kscale[i], vscale[i],
+                        row, cos_s, sin_s, mask, cfg,
+                        TP_AXIS if tp > 1 else None)
+                    qk, sk = quantize_kv_rows(k[0])
+                    qv, sv = quantize_kv_rows(v[0])
+                    kpool = kpool.at[(i,) + widx].set(qk)
+                    vpool = vpool.at[(i,) + widx].set(qv)
+                    kscale = kscale.at[(i,) + widx].set(sk)
+                    vscale = vscale.at[(i,) + widx].set(sv)
+                    continue
                 if tp == 1:
                     kpre = gather_kv_pages(kpool[i], row)
                     vpre = gather_kv_pages(vpool[i], row)
@@ -568,19 +781,21 @@ class ModelRunner:
                 x, (length - 1)[:, None, None].astype(jnp.int32),
                 axis=1)[:, 0]
             logits = _logits_of(state, last).astype(jnp.float32)
-            return kpool, vpool, logits
+            return kpool, vpool, kscale, vscale, logits
 
         if tp == 1:
-            fn = jax.jit(prefill, donate_argnums=(5, 6))
+            fn = jax.jit(prefill, donate_argnums=(5, 6, 7, 8))
         else:
             from jax.sharding import PartitionSpec as P
             pool = self._pool_pspec
+            sspec = self._scale_pspec if kv_quant else P()
             mapped = jax.shard_map(
                 prefill, mesh=self.mesh,
                 in_specs=(self._state_specs(), P(), P(), P(), P(), pool,
-                          pool, P(), P()),
-                out_specs=(pool, pool, P()), check_vma=False)
-            fn = jax.jit(mapped, donate_argnums=(5, 6))
+                          pool, sspec, sspec, P(), P()),
+                out_specs=(pool, pool, sspec, sspec, P()),
+                check_vma=False)
+            fn = jax.jit(mapped, donate_argnums=(5, 6, 7, 8))
         self._prefill_cached_fns[bucket] = fn
         return fn
 
@@ -592,11 +807,13 @@ class ModelRunner:
         ledger."""
         traces_before = self.decode_traces
         t0 = time.perf_counter()
-        (self.kpool, self.vpool, self._pos_dev, self._tok_dev,
-         self._ring_dev, self._ridx_dev, logits) = self._step_fn(
-            self.state, self.kpool, self.vpool, self._table_dev,
-            self._pos_dev, self._tok_dev, self._active_dev,
-            self._ring_dev, self._ridx_dev, self._cos, self._sin)
+        (self.kpool, self.vpool, self.kscale, self.vscale,
+         self._pos_dev, self._tok_dev, self._ring_dev, self._ridx_dev,
+         logits) = self._step_fn(
+            self.state, self.kpool, self.vpool, self.kscale,
+            self.vscale, self._table_dev, self._pos_dev, self._tok_dev,
+            self._active_dev, self._ring_dev, self._ridx_dev,
+            self._cos, self._sin)
         if self.decode_traces != traces_before:
             sig = f"slots={self.max_slots} ring={self.sync_interval}"
             if self.tp > 1:
@@ -617,11 +834,12 @@ class ModelRunner:
                                "verify program")
         traces_before = self.verify_traces
         t0 = time.perf_counter()
-        (self.kpool, self.vpool, self._pos_dev, self._tok_dev,
-         self._ring_dev, self._ridx_dev) = self._verify_fn(
-            self.state, self.kpool, self.vpool, self._table_dev,
-            self._pos_dev, self._tok_dev, self._active_dev,
-            self._ring_dev, self._ridx_dev,
+        (self.kpool, self.vpool, self.kscale, self.vscale,
+         self._pos_dev, self._tok_dev, self._ring_dev,
+         self._ridx_dev) = self._verify_fn(
+            self.state, self.kpool, self.vpool, self.kscale,
+            self.vscale, self._table_dev, self._pos_dev, self._tok_dev,
+            self._active_dev, self._ring_dev, self._ridx_dev,
             jnp.asarray(draft, jnp.int32), jnp.asarray(dlen, jnp.int32),
             self._cos, self._sin)
         if self.verify_traces != traces_before:
@@ -639,11 +857,13 @@ class ModelRunner:
         fresh = bucket not in self._prefill_fns
         fn = self._prefill_fn(bucket)
         t0 = time.perf_counter()
-        self.kpool, self.vpool, logits = fn(
+        (self.kpool, self.vpool, self.kscale, self.vscale,
+         logits) = fn(
             self.state, jnp.asarray(ids),
             jnp.asarray([plen], jnp.int32),
             jnp.asarray(row[:bucket // self.page_size]),
-            self.kpool, self.vpool, self._cos, self._sin)
+            self.kpool, self.vpool, self.kscale, self.vscale,
+            self._cos, self._sin)
         if fresh:
             record_compile(f"prefill[{bucket}]", t0,
                            signature=f"ids=[1,{bucket}]")
@@ -656,11 +876,13 @@ class ModelRunner:
         fresh = bucket not in self._prefill_cached_fns
         fn = self._prefill_cached_fn(bucket)
         t0 = time.perf_counter()
-        self.kpool, self.vpool, logits = fn(
+        (self.kpool, self.vpool, self.kscale, self.vscale,
+         logits) = fn(
             self.state, jnp.asarray(ids),
             jnp.asarray([suffix_len], jnp.int32),
             jnp.asarray(cached_len, jnp.int32), jnp.asarray(row),
-            self.kpool, self.vpool, self._cos, self._sin)
+            self.kpool, self.vpool, self.kscale, self.vscale,
+            self._cos, self._sin)
         if fresh:
             record_compile(f"prefill_cached[{bucket}]", t0,
                            signature=f"ids=[1,{bucket}]")
@@ -670,9 +892,10 @@ class ModelRunner:
         """Copy-on-write page duplicate (head-local on the mesh)."""
         fresh = not self._copy_page_compiled
         t0 = time.perf_counter()
-        self.kpool, self.vpool = self._copy_page_fn(
-            self.kpool, self.vpool, jnp.asarray(src, jnp.int32),
-            jnp.asarray(dst, jnp.int32))
+        (self.kpool, self.vpool, self.kscale,
+         self.vscale) = self._copy_page_fn(
+            self.kpool, self.vpool, self.kscale, self.vscale,
+            jnp.asarray(src, jnp.int32), jnp.asarray(dst, jnp.int32))
         if fresh:
             self._copy_page_compiled = True
             record_compile("copy_page", t0,
@@ -681,12 +904,20 @@ class ModelRunner:
     def read_page(self, page: int):
         """Device -> host copy of one KV page: ``(k, v)`` numpy arrays
         of shape [L, kvh, page_size, hd] (full heads — shards gather
-        transparently on the mesh).  Preemption-spill only: this is a
-        host sync per call, never on the steady decode path."""
+        transparently on the mesh), plus ``(kscale, vscale)``
+        [L, kvh, page_size] f32 when the pools are int8 — the spill
+        tier moves the quantized bytes, never a dequantized copy.
+        Preemption-spill only: this is a host sync per call, never on
+        the steady decode path."""
+        if self.kv_quant:
+            return (np.asarray(self.kpool[:, page]),
+                    np.asarray(self.vpool[:, page]),
+                    np.asarray(self.kscale[:, page]),
+                    np.asarray(self.vscale[:, page]))
         return (np.asarray(self.kpool[:, page]),
                 np.asarray(self.vpool[:, page]))
 
-    def write_page(self, page: int, k, v):
+    def write_page(self, page: int, k, v, kscale=None, vscale=None):
         """Host -> device copy of one KV page (preempted-request resume
         unparking a host-tier copy).  Eager per-call dispatch is fine —
         this runs once per restored page at admission, not per step."""
@@ -694,6 +925,15 @@ class ModelRunner:
             jnp.asarray(k, self.kpool.dtype))
         vpool = self.vpool.at[:, page].set(
             jnp.asarray(v, self.vpool.dtype))
+        if self.kv_quant:
+            if kscale is None or vscale is None:
+                raise ValueError(
+                    "int8 KV pages restore with their scales: "
+                    "write_page(page, k, v, kscale, vscale)")
+            kscale_p = self.kscale.at[:, page].set(
+                jnp.asarray(kscale, jnp.float32))
+            vscale_p = self.vscale.at[:, page].set(
+                jnp.asarray(vscale, jnp.float32))
         if self.mesh is not None:
             # pin the result back to the head-sharded pool layout so the
             # next shard_map program sees the sharding it was traced for
@@ -701,8 +941,15 @@ class ModelRunner:
             sh = NamedSharding(self.mesh, self._pool_pspec)
             kpool = jax.device_put(kpool, sh)
             vpool = jax.device_put(vpool, sh)
+            if self.kv_quant:
+                ssh = NamedSharding(self.mesh, self._scale_pspec)
+                kscale_p = jax.device_put(kscale_p, ssh)
+                vscale_p = jax.device_put(vscale_p, ssh)
         self.kpool = kpool
         self.vpool = vpool
+        if self.kv_quant:
+            self.kscale = kscale_p
+            self.vscale = vscale_p
 
     def push_slot(self, slot: int, row: np.ndarray, pos: int, tok: int,
                   active: int):
@@ -751,7 +998,8 @@ class ModelRunner:
                 entry["peak_bytes_in_use"] = int(
                     stats["peak_bytes_in_use"])
             devices.append(entry)
-        return {"tp": self.tp, "axis": TP_AXIS, "devices": devices}
+        return {"tp": self.tp, "axis": TP_AXIS,
+                "kv_quant": self.kv_quant, "devices": devices}
 
 
 def _prefill_layer_cached(w, x, kpre, vpre, cos_s, sin_s, mask, cfg):

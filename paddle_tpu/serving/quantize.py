@@ -1,0 +1,70 @@
+"""Dense checkpoint -> quantized serving state conversion.
+
+Reference analog: running a deploy model through ``weight_quantize``
+(python/paddle/nn/quant) before handing it to the predictor — the
+serving engine consumes the converted state directly.
+
+Unlike :func:`paddle_tpu.models.generation.quantize_state` (the
+single-chip generate-path converter, which ALSO emits fused
+``qkv_fused``/``gateup_fused`` keys and quantizes ``lm_head``), this
+converter targets the serving runner:
+
+  * only the per-projection matmul weights (q/k/v/o, gate/up/down)
+    become :class:`~paddle_tpu.ops.pallas.quant_matmul.QuantizedWeight`
+    leaves — the ``tp > 1`` runner shards each projection individually
+    (columns + per-output-channel scale for q/k/v and gate/up, rows
+    with a replicated scale for o/down), and fused keys cannot be
+    head-sharded;
+  * embeddings and norms stay dense (gathers and elementwise ops, not
+    matmuls) and so does ``lm_head`` — its logits feed the greedy
+    argmax, where weight error moves emitted tokens the most.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["quantize_state"]
+
+# the per-projection matmul weights the serving runner knows how to
+# shard; everything else (embeddings, norms, lm_head) stays dense
+_MATMUL_KEYS = ("self_attn.q_proj.weight", "self_attn.k_proj.weight",
+                "self_attn.v_proj.weight", "self_attn.o_proj.weight",
+                "mlp.gate_proj.weight", "mlp.up_proj.weight",
+                "mlp.down_proj.weight")
+
+
+def quantize_state(state: dict, kind: str = "int8", skip=()) -> dict:
+    """Convert a dense llama state dict into a quantized serving state.
+
+    Every per-projection matmul weight becomes a ``QuantizedWeight``
+    (``kind="int8"``: int8 values + per-output-channel f32 scale;
+    ``kind="int4"``: the same, nibble-packed ``[K/2, N]`` — a quarter
+    of the dense HBM footprint).  ``skip`` names key suffixes to keep
+    dense (e.g. ``skip=("mlp.down_proj.weight",)``).  Leaves that are
+    already ``QuantizedWeight`` pass through untouched, so the
+    conversion is idempotent.  The returned dict drops nothing: it is
+    a drop-in replacement for the dense state at ``create_engine`` /
+    ``ModelRunner`` construction, for any ``tp``.
+    """
+    from ..nn.quant import weight_quantize
+    from ..ops.pallas.quant_matmul import QuantizedWeight
+
+    if kind not in ("int8", "int4"):
+        raise ValueError(
+            f"quant kind must be 'int8' or 'int4', got {kind!r}")
+    algo = f"weight_only_{kind}"
+    skip = tuple(skip)
+    out = {}
+    for name, arr in state.items():
+        if (not name.endswith(_MATMUL_KEYS)
+                or (skip and name.endswith(skip))
+                or isinstance(arr, QuantizedWeight)):
+            out[name] = arr
+            continue
+        if kind == "int4" and arr.shape[0] % 2:
+            raise ValueError(
+                f"{name!r}: int4 nibble packing needs an even K, got "
+                f"{arr.shape[0]}")
+        q, scale = weight_quantize.__op_body__(jnp.asarray(arr), algo)
+        out[name] = QuantizedWeight(q, scale, kind=kind, k=arr.shape[0])
+    return out

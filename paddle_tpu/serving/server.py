@@ -1124,6 +1124,15 @@ def _main(argv=None):
                     "default FLAGS_serving_mesh_tp.  CPU testing: "
                     "export XLA_FLAGS=--xla_force_host_platform_"
                     "device_count=N first")
+    ap.add_argument("--quant", choices=("none", "int8", "int4"),
+                    default="none",
+                    help="weight-only quantized serving (default "
+                    "FLAGS_serving_quant): int8/int4 QuantizedWeight "
+                    "shards, embeddings/norms/lm_head stay dense")
+    ap.add_argument("--kv-quant",
+                    action=argparse.BooleanOptionalAction, default=None,
+                    help="int8 KV pages with per-(page-row, head) f32 "
+                    "scales (default FLAGS_serving_kv_quant)")
     args = ap.parse_args(argv)
 
     import paddle_tpu as paddle
@@ -1146,7 +1155,9 @@ def _main(argv=None):
                    sync_interval=args.sync_interval, mesh=args.mesh,
                    spec_k=args.spec_k,
                    prefill_chunk=args.prefill_chunk,
-                   preempt=args.preempt, start=False)
+                   preempt=args.preempt,
+                   quant=(None if args.quant == "none" else args.quant),
+                   kv_quant=args.kv_quant, start=False)
     server.install_signal_handlers()
     server.start()
     print(f"serving on http://{server.address} "

@@ -1,0 +1,17 @@
+"""BAD variant: int8 KV-page reduction (ISSUE 18 quantized pages).
+
+Lifted from the quantized-serving hazard: once KV rows are cast to
+int8 page bytes, any reduction over them (here a debug occupancy sum)
+promotes to int64 under ``jax_enable_x64`` and shifts the traced avals
+between hosts.  The quantizer itself must reduce (amax) over the FLOAT
+rows BEFORE the cast, and anything summing the int8 bytes afterwards
+must cast back explicitly.
+"""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def page_occupancy(kpool, scale):
+    q = jnp.clip(jnp.round(kpool / scale), -127, 127).astype(jnp.int8)
+    return q.sum(axis=-1)               # int64 under x64

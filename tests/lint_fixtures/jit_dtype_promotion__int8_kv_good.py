@@ -1,0 +1,9 @@
+"""GOOD twin: the int8 page reduction casts back to int32 explicitly."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def page_occupancy(kpool, scale):
+    q = jnp.clip(jnp.round(kpool / scale), -127, 127).astype(jnp.int8)
+    return q.sum(axis=-1).astype(jnp.int32)

@@ -1,0 +1,404 @@
+"""Quantized serving (ISSUE 18): int8/int4 weight shards + int8 KV pages.
+
+Covers the serving-level quantization seams end to end on the CPU
+XLA-fallback path: the dense-checkpoint converter
+(serving.quantize.quantize_state), weight_only_matmul parity on the
+fallback, the runner construction matrix (dense/int8/int4 x tp{1,2}),
+continuous-batching greedy parity-within-tolerance vs dense across
+prefix-cache on/off, preempt->spill->resume with int8 pages (halved
+spill bytes, leak-free, exact census), and the loud construction-time
+rejection of MALFORMED quantized states.  The kernel itself (interpret
++ Mosaic paths) is covered by tests/test_quant_matmul.py — this file
+owns the serving integration.
+
+XLA_FLAGS is set HERE (not only in conftest) so the tp=2 cases are
+self-contained: ``pytest tests/test_serving_quant.py`` works without
+the harness, as long as it runs before jax initializes its backends.
+"""
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+from paddle_tpu.ops.pallas import quant_matmul as QM
+from paddle_tpu.serving import (GenerationConfig, ModelRunner,
+                                RequestState, create_engine)
+from paddle_tpu.serving.quantize import quantize_state
+
+needs_mesh = pytest.mark.skipif(
+    jax.device_count() < 2,
+    reason="needs >=2 local devices "
+           "(XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    # 8 KV heads / hidden 64 -> head_dim 8, everything divisible by
+    # tp=2 (including the int4-packed K/2 rows of every projection)
+    paddle.seed(11)
+    cfg = llama_tiny(vocab_size=128, hidden_size=64,
+                     intermediate_size=128, num_attention_heads=8,
+                     num_key_value_heads=8)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+@pytest.fixture(scope="module")
+def swap_model():
+    # the test_overload spill-tier shape: 2 layers / 2 KV heads keep
+    # the preempt-and-swap churn fast on CPU
+    paddle.seed(0)
+    cfg = llama_tiny(vocab_size=64, hidden_size=32,
+                     intermediate_size=64, num_hidden_layers=2,
+                     num_attention_heads=4, num_key_value_heads=2,
+                     max_position_embeddings=128)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def _dense_state(model):
+    from paddle_tpu.framework.tensor import Tensor
+    return {k: (v._data if isinstance(v, Tensor) else v)
+            for k, v in model.functional_state().items()}
+
+
+def _run(model, prompts, n_new, **kw):
+    eng = create_engine(model, **kw)
+    reqs = [eng.submit(p, GenerationConfig(max_new_tokens=n))
+            for p, n in zip(prompts, n_new)]
+    eng.run_until_complete(max_steps=500)
+    assert all(r.state == RequestState.DONE for r in reqs)
+    return eng, [list(r.output_tokens) for r in reqs]
+
+
+def _token_match(a_lists, b_lists):
+    match = sum(int(a == b) for da, qa in zip(a_lists, b_lists)
+                for a, b in zip(da, qa))
+    total = sum(min(len(da), len(qa))
+                for da, qa in zip(a_lists, b_lists))
+    return match, total
+
+
+# ------------------------------------------------------- quantize_state
+class TestQuantizeState:
+    def test_converts_matmuls_only(self, tiny_model):
+        state = _dense_state(tiny_model)
+        qstate = quantize_state(state, kind="int8")
+        assert set(qstate) == set(state)
+        for name, v in qstate.items():
+            if name.endswith((".q_proj.weight", ".k_proj.weight",
+                              ".v_proj.weight", ".o_proj.weight",
+                              ".gate_proj.weight", ".up_proj.weight",
+                              ".down_proj.weight")):
+                assert isinstance(v, QM.QuantizedWeight), name
+                assert v.q.dtype == jnp.int8
+                assert v.k == state[name].shape[0]
+            else:
+                # embeddings / norms / lm_head stay dense
+                assert not isinstance(v, QM.QuantizedWeight), name
+                assert v.dtype == state[name].dtype
+
+    def test_int4_packs_half_the_rows(self, tiny_model):
+        state = _dense_state(tiny_model)
+        qstate = quantize_state(state, kind="int4")
+        name = "llama.layers.0.mlp.down_proj.weight"
+        w = qstate[name]
+        assert w.kind == "int4"
+        assert w.q.shape[0] == state[name].shape[0] // 2
+
+    def test_skip_keeps_named_projections_dense(self, tiny_model):
+        state = _dense_state(tiny_model)
+        qstate = quantize_state(state, kind="int8",
+                                skip=("mlp.down_proj.weight",))
+        for name, v in qstate.items():
+            if name.endswith("mlp.down_proj.weight"):
+                assert not isinstance(v, QM.QuantizedWeight), name
+            elif name.endswith("self_attn.q_proj.weight"):
+                assert isinstance(v, QM.QuantizedWeight), name
+
+    def test_idempotent(self, tiny_model):
+        state = _dense_state(tiny_model)
+        once = quantize_state(state, kind="int8")
+        twice = quantize_state(once, kind="int8")
+        for name in once:
+            if isinstance(once[name], QM.QuantizedWeight):
+                assert twice[name] is once[name], name
+
+    def test_bad_kind_rejected(self, tiny_model):
+        with pytest.raises(ValueError, match="int8.*int4"):
+            quantize_state(_dense_state(tiny_model), kind="fp8")
+
+    def test_int4_odd_k_rejected(self):
+        state = {"llama.layers.0.mlp.down_proj.weight":
+                 jnp.ones((63, 32), jnp.float32)}
+        with pytest.raises(ValueError, match="even K"):
+            quantize_state(state, kind="int4")
+
+
+# ------------------------------------- weight_only_matmul XLA fallback
+class TestQuantMatmulFallback:
+    """The serving decode path hits weight_only_matmul's XLA fallback on
+    CPU tier-1 — pin its parity against the dequantized reference for
+    both widths at decode shapes (m=1 GEMV and an m=8 verify batch)."""
+
+    @pytest.mark.parametrize("kind", ["int8", "int4"])
+    @pytest.mark.parametrize("m", [1, 8])
+    def test_fallback_parity(self, kind, m):
+        rng = np.random.RandomState(3)
+        k, n = 64, 96
+        x = jnp.asarray(rng.randn(m, k) * 0.3, jnp.float32)
+        bound = 127 if kind == "int8" else 7
+        q = jnp.asarray(rng.randint(-bound, bound + 1, (k, n)), jnp.int8)
+        s = jnp.asarray(rng.rand(n).astype(np.float32) * 0.02 + 1e-3)
+        if kind == "int4":
+            w = QM.QuantizedWeight(QM.pack_int4(q), s, kind="int4", k=k)
+        else:
+            w = QM.QuantizedWeight(q, s, kind="int8", k=k)
+        ref = x @ (q.astype(jnp.float32) * s)
+        out = jax.jit(QM.weight_only_matmul)(x, w)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
+# --------------------------------------------- runner construction matrix
+class TestRunnerMatrix:
+    """dense/int8/int4 x tp{1,2}: every combination constructs and
+    serves a short greedy request with ONE decode trace."""
+
+    @pytest.mark.parametrize("quant", [None, "int8", "int4"])
+    def test_tp1(self, tiny_model, quant):
+        eng, out = _run(tiny_model, [np.arange(1, 7, dtype=np.int32)],
+                        [4], max_slots=2, page_size=8, max_model_len=64,
+                        quant=quant, kv_quant=bool(quant))
+        assert len(out[0]) == 4
+        assert eng.decode_traces == 1
+        assert eng.stats()["quant"] == (quant or "")
+        assert eng.stats()["kv_quant"] is bool(quant)
+
+    @needs_mesh
+    @pytest.mark.parametrize("quant", [None, "int8", "int4"])
+    def test_tp2(self, tiny_model, quant):
+        eng, out = _run(tiny_model, [np.arange(1, 7, dtype=np.int32)],
+                        [4], max_slots=2, page_size=8, max_model_len=64,
+                        mesh=2, quant=quant, kv_quant=bool(quant))
+        assert len(out[0]) == 4
+        assert eng.decode_traces == 1
+        info = eng.runner.mesh_info()
+        assert info["kv_quant"] is bool(quant)
+
+    @needs_mesh
+    def test_tp2_int8_matches_tp1_int8(self, tiny_model):
+        """Quantization composes with TP: the sharded quantized matmuls
+        recombine to the replicated activations bit-for-bit on the
+        deterministic CPU backend, so tp=2 int8 is token-exact with
+        tp=1 int8 (the tolerance is dense-vs-quant, never tp-vs-tp)."""
+        rng = np.random.default_rng(5)
+        prompts = [rng.integers(1, 128, int(n)).astype(np.int32)
+                   for n in (4, 9, 14)]
+        n_new = [8, 6, 8]
+        kw = dict(max_slots=4, page_size=8, max_model_len=64,
+                  quant="int8", kv_quant=True)
+        _, ref = _run(tiny_model, prompts, n_new, **kw)
+        eng, got = _run(tiny_model, prompts, n_new, mesh=2, **kw)
+        assert got == ref
+        assert eng.decode_traces == 1
+
+
+# ------------------------------------------ continuous-batching parity
+class TestBatchingParity:
+    """Greedy int8 serving tracks dense within tolerance — quantization
+    perturbs logits, so a divergence can compound after the first
+    differing token; >=75% aggregate token match on a tiny random model
+    is the pinned floor (perf_gate's quant_decode pins the same bar)."""
+
+    @pytest.mark.parametrize("prefix_cache", [False, True])
+    def test_int8_parity_within_tolerance(self, tiny_model,
+                                          prefix_cache):
+        rng = np.random.default_rng(7)
+        shared = rng.integers(1, 128, 8).astype(np.int32)
+        prompts = [np.concatenate(
+            [shared, rng.integers(1, 128, int(rng.integers(3, 9)))
+             .astype(np.int32)]) for _ in range(5)]
+        n_new = [int(rng.integers(4, 9)) for _ in range(5)]
+        kw = dict(max_slots=3, page_size=8, max_model_len=64,
+                  enable_prefix_cache=prefix_cache)
+        _, dense = _run(tiny_model, prompts, n_new, **kw)
+        eng, qout = _run(tiny_model, prompts, n_new,
+                         quant="int8", kv_quant=True, **kw)
+        match, total = _token_match(dense, qout)
+        assert total > 0
+        assert match >= 0.75 * total, f"{match}/{total}"
+        assert eng.decode_traces == 1
+        assert eng.blocks.pool_accounting()["leak"] == 0
+
+    def test_quant_snapshot_page_math(self, tiny_model):
+        """page_bytes follows the (hd+4)/(4*hd) quant/dense ratio —
+        the counter perf_gate pins as pages_per_token_x1000."""
+        eng, _ = _run(tiny_model, [np.arange(1, 9, dtype=np.int32)],
+                      [4], max_slots=2, page_size=8, max_model_len=64,
+                      quant="int8", kv_quant=True)
+        snap = eng.quant_snapshot()
+        hd = tiny_model.config.head_dim
+        assert snap["weight_kind"] == "int8"
+        assert snap["kv_quant"] is True
+        assert snap["page_bytes"] * 4 * hd == \
+            snap["dense_page_bytes"] * (hd + 4)
+        # int8 pages: the pool allocation itself shrinks
+        assert eng.runner.kpool.dtype == jnp.int8
+        assert eng.runner.kscale.dtype == jnp.float32
+
+
+# ------------------------------------------- preempt / spill / restore
+class TestPreemptSpillQuant:
+    def _overload(self, model, **kw):
+        eng = create_engine(model, max_slots=2, page_size=4,
+                            sync_interval=1, max_model_len=128,
+                            preempt=True, **kw)
+        lo_a = eng.submit([1, 2, 3, 4, 5, 6],
+                          GenerationConfig(max_new_tokens=8))
+        lo_b = eng.submit([3, 4, 5, 6, 7, 8],
+                          GenerationConfig(max_new_tokens=8))
+        for _ in range(4):
+            eng.step()
+        hi = eng.submit([5, 6, 7, 8, 9, 10],
+                        GenerationConfig(max_new_tokens=8), priority=1)
+        eng.run_until_complete(max_steps=600)
+        return eng, [lo_a, lo_b, hi]
+
+    def test_spill_restore_int8_pages(self, swap_model):
+        """Preempted int8 pages spill as int8 bytes + scales (not a
+        dense re-expansion): spill traffic genuinely halves, the
+        resumed request is token-for-token identical with the dense
+        run, and the pool census stays exact."""
+        eng_d, reqs_d = self._overload(swap_model)
+        eng_q, reqs_q = self._overload(swap_model, quant="int8",
+                                       kv_quant=True)
+        assert eng_q.preemptions >= 1
+        assert eng_q.blocks.spilled_pages >= 1
+        assert eng_q.blocks.spilled_pages == eng_d.blocks.spilled_pages
+        # int8 page pair + f32 scales vs dense f32: (hd+4)/(4*hd)
+        hd = swap_model.config.head_dim
+        assert eng_q.blocks.spill_bytes * 4 * hd == \
+            eng_d.blocks.spill_bytes * (hd + 4)
+        assert eng_q.blocks.spill_bytes < eng_d.blocks.spill_bytes / 2
+        assert [r.output_tokens for r in reqs_q] == \
+            [r.output_tokens for r in reqs_d]
+        assert eng_q.blocks.restored_pages == eng_q.blocks.spilled_pages
+        census = eng_q.blocks.pool_accounting()
+        assert census["leak"] == 0
+        assert census["live"] + census["cached"] + census["free"] == \
+            census["total"]
+        assert eng_q.decode_traces == 1
+        # the per-request ledger saw the quantized byte counts too
+        assert sum(r.spill_bytes for r in reqs_q) == \
+            eng_q.blocks.spill_bytes
+
+    def test_read_write_page_roundtrip(self, swap_model):
+        """The spill seam itself: read_page returns the 4-tuple
+        (k, v, kscale, vscale) under kv_quant and write_page restores
+        it bit-exactly; writing without scales is rejected loudly."""
+        eng, _ = self._overload(swap_model, quant="int8", kv_quant=True)
+        entry = eng.runner.read_page(1)
+        assert len(entry) == 4
+        k, v, ks, vs = entry
+        assert k.dtype == np.int8 and v.dtype == np.int8
+        assert ks.dtype == np.float32 and vs.dtype == np.float32
+        eng.runner.write_page(1, *entry)
+        back = eng.runner.read_page(1)
+        for a, b in zip(entry, back):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        with pytest.raises(ValueError, match="scales"):
+            eng.runner.write_page(1, k, v)
+
+
+# -------------------------------------------- malformed-state rejection
+class TestMalformedStateRejection:
+    """The old loud guard regression: a broken quantized state must
+    fail at construction with a pointed message, not as an opaque
+    shape error deep inside the first trace."""
+
+    def _runner_kw(self):
+        return dict(max_slots=2, page_size=8, table_width=8,
+                    num_pages=16, dump_page=16)
+
+    def _qstate(self, model):
+        return quantize_state(_dense_state(model), kind="int8")
+
+    @pytest.mark.parametrize("tp", [1, pytest.param(2, marks=needs_mesh)])
+    def test_missing_scale(self, tiny_model, tp):
+        state = self._qstate(tiny_model)
+        key = "llama.layers.0.self_attn.q_proj.weight"
+        w = state[key]
+        state[key] = QM.QuantizedWeight(w.q, None, kind="int8", k=w.k)
+        with pytest.raises(ValueError, match="missing scale"):
+            ModelRunner(tiny_model.config, state, tp=tp,
+                        **self._runner_kw())
+
+    @pytest.mark.parametrize("tp", [1, pytest.param(2, marks=needs_mesh)])
+    def test_scale_shape_mismatch(self, tiny_model, tp):
+        state = self._qstate(tiny_model)
+        key = "llama.layers.0.mlp.gate_proj.weight"
+        w = state[key]
+        state[key] = QM.QuantizedWeight(w.q, w.scale[:-1],
+                                        kind="int8", k=w.k)
+        with pytest.raises(ValueError, match="scale shape"):
+            ModelRunner(tiny_model.config, state, tp=tp,
+                        **self._runner_kw())
+
+    def test_bad_kind(self, tiny_model):
+        state = self._qstate(tiny_model)
+        key = "llama.layers.0.mlp.up_proj.weight"
+        w = state[key]
+        state[key] = QM.QuantizedWeight(w.q, w.scale, kind="fp8", k=w.k)
+        with pytest.raises(ValueError, match="unsupported quant kind"):
+            ModelRunner(tiny_model.config, state, **self._runner_kw())
+
+    def test_wrong_row_count_for_k(self, tiny_model):
+        state = self._qstate(tiny_model)
+        key = "llama.layers.0.self_attn.o_proj.weight"
+        w = state[key]
+        state[key] = QM.QuantizedWeight(w.q[:-1], w.scale,
+                                        kind="int8", k=w.k)
+        with pytest.raises(ValueError, match="rows"):
+            ModelRunner(tiny_model.config, state, **self._runner_kw())
+
+    @needs_mesh
+    def test_non_array_leaf_still_rejected_at_tp(self, tiny_model):
+        state = self._qstate(tiny_model)
+        state["llama.layers.0.self_attn.q_proj.weight"] = (1, 2)
+        with pytest.raises(ValueError,
+                           match="not an array or QuantizedWeight"):
+            ModelRunner(tiny_model.config, state, tp=2,
+                        **self._runner_kw())
+
+    @needs_mesh
+    def test_unsplittable_quantized_shard_rejected(self, tiny_model):
+        """Row-sharding splits the PACKED int4 rows: a K whose packed
+        K/2 doesn't divide tp must be refused with the packing hint."""
+        state = self._qstate(tiny_model)
+        key = "llama.layers.0.mlp.down_proj.weight"
+        w = state[key]
+        # 65 rows: valid as a standalone QW (k=65 int8) but 65 % 2 != 0
+        q = jnp.concatenate([w.q, w.q[:1]], axis=0)
+        state[key] = QM.QuantizedWeight(q, w.scale, kind="int8",
+                                        k=w.k + 1)
+        with pytest.raises(ValueError, match="not divisible by tp"):
+            ModelRunner(tiny_model.config, state, tp=2,
+                        **self._runner_kw())
+
+    def test_engine_rejects_unknown_quant_flag(self, tiny_model):
+        with pytest.raises(ValueError, match="int8.*int4"):
+            create_engine(tiny_model, quant="fp8", max_slots=2,
+                          page_size=8, max_model_len=64)

@@ -57,8 +57,9 @@ def _load(path):
     profile = _read_json(os.path.join(dir_, "profile.json"))
     captures = _read_json(os.path.join(dir_, "captures.json"))
     usage = _read_json(os.path.join(dir_, "usage.json"))
+    quant = _read_json(os.path.join(dir_, "quant.json"))
     return (metrics, retraces, trace, flight, resources, profile,
-            captures, usage, prom_path)
+            captures, usage, quant, prom_path)
 
 
 def _fmt_value(v):
@@ -746,8 +747,39 @@ def _usage_section(usage):
 EVICTED_TENANT = "(evicted)"
 
 
+def _quant_section(quant):
+    """Quantized-serving summary from quant.json — dumps from dense
+    engines (or older builds) have no file and produce no section."""
+    if not isinstance(quant, dict):
+        return None
+    lines = ["Quantization"]
+    lines.append(f"  weights: {quant.get('weight_kind', 'dense')}")
+    page = quant.get("page_bytes")
+    dense = quant.get("dense_page_bytes")
+    kv = "int8 pages" if quant.get("kv_quant") else "dense pages"
+    if page and dense:
+        lines.append(
+            f"  KV pages: {kv}, {_fmt_bytes(page)}/page pair vs "
+            f"{_fmt_bytes(dense)} dense "
+            f"({100.0 * float(page) / float(dense):.1f}% of dense — "
+            f"pages-per-token cost scales the same way)")
+    else:
+        lines.append(f"  KV pages: {kv}")
+    spilled = quant.get("spilled_pages", 0)
+    if spilled:
+        moved = float(quant.get("spill_bytes") or 0)
+        est = float(quant.get("spill_bytes_dense_estimate") or 0)
+        line = (f"  spill tier: {spilled} pages parked, "
+                f"{_fmt_bytes(moved)} moved")
+        if est > moved:
+            line += (f" (dense would have moved {_fmt_bytes(est)} — "
+                     f"{_fmt_bytes(est - moved)} saved)")
+        lines.append(line)
+    return "\n".join(lines)
+
+
 def report(metrics, retraces, trace=None, flight=None, resources=None,
-           profile=None, captures=None, usage=None):
+           profile=None, captures=None, usage=None, quant=None):
     simple_rows = {"counter": [], "gauge": []}
     hist_blocks = []
     for name, entry in sorted(metrics.items()):
@@ -796,6 +828,9 @@ def report(metrics, retraces, trace=None, flight=None, resources=None,
     use = _usage_section(usage)
     if use:
         out += [use, ""]
+    q = _quant_section(quant)
+    if q:
+        out += [q, ""]
     if retraces and retraces.get("entries"):
         entries = sorted(retraces["entries"],
                          key=lambda e: (-e["count"], e["op"]))
@@ -819,7 +854,7 @@ def main(argv=None):
                     help="print the raw Prometheus text export")
     args = ap.parse_args(argv)
     (metrics, retraces, trace, flight, resources, profile, captures,
-     usage, prom_path) = _load(args.path)
+     usage, quant, prom_path) = _load(args.path)
     if args.prom:
         if not os.path.exists(prom_path):
             sys.exit(f"metrics_report: no metrics.prom at {prom_path!r}")
@@ -827,7 +862,7 @@ def main(argv=None):
             print(f.read(), end="")
         return 0
     print(report(metrics, retraces, trace, flight, resources,
-                 profile, captures, usage))
+                 profile, captures, usage, quant))
     return 0
 
 

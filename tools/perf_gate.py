@@ -696,6 +696,56 @@ def scenario_usage_meter() -> dict:
     }
 
 
+def scenario_quant_decode() -> dict:
+    """Quantized serving (int8 weights + int8 KV pages) vs the dense
+    reference on the identical two-wave workload, counters only.
+
+    Gates: ONE decode trace with quantized weights and pools, greedy
+    parity within tolerance (>= 75% token match on the tiny random
+    model — int8 weight error may flip a late low-margin argmax, so
+    exact parity would be flaky by construction while genuine breakage
+    lands far below the floor), the KV page byte cost pinned at the
+    closed-form ratio ``(hd + 4) / (4 * hd)`` of dense (the pages-per-
+    token byte cost under ``--kv-quant``; 375/1000 at head_dim=8), the
+    spill tier moving the same reduced bytes (read_page parks int8 +
+    scales, never a dequantized copy), and the quant-off control: the
+    dense run beside it must show zero extra host syncs and zero extra
+    decode traces, the zero-overhead-off pin every scenario carries."""
+
+    def drive(quant, kv_quant):
+        eng = _engine(max_slots=2, page_size=4, sync_interval=1,
+                      quant=quant, kv_quant=kv_quant)
+        reqs = [eng.submit([1, 2, 3, 4, 5, 6], _gen(8)),
+                eng.submit([3, 4, 5, 6, 7, 8], _gen(8))]
+        eng.run_until_complete(max_steps=400)
+        reqs.append(eng.submit([5, 6, 7, 8, 9, 10, 11], _gen(8)))
+        eng.run_until_complete(max_steps=400)
+        return eng, reqs
+
+    eng_off, ref_reqs = drive(None, None)
+    eng, reqs = drive("int8", True)
+    match = total = 0
+    for r, rr in zip(reqs, ref_reqs):
+        a, b = r.output_tokens, rr.output_tokens
+        total += max(len(a), len(b))
+        match += sum(int(x == y) for x, y in zip(a, b))
+    snap = eng.quant_snapshot()
+    dense_page = sum(a.nbytes for a in eng_off.runner.read_page(0))
+    quant_page = sum(a.nbytes for a in eng.runner.read_page(0))
+    return {
+        "decode_traces": eng.decode_traces,
+        "quant_parity_within_tol": int(match >= 0.75 * max(total, 1)),
+        "pages_per_token_x1000": round(
+            1000 * snap["page_bytes"] / snap["dense_page_bytes"]),
+        "spill_bytes_ratio_vs_dense_x1000": round(
+            1000 * quant_page / dense_page),
+        "host_syncs_delta_vs_off": eng.host_syncs - eng_off.host_syncs,
+        "decode_traces_delta_vs_off": (eng.decode_traces
+                                       - eng_off.decode_traces),
+        "goodput_ratio": _goodput(reqs),
+    }
+
+
 SCENARIOS = {
     "steady_decode": scenario_steady_decode,
     "prefix_cache": scenario_prefix_cache,
@@ -708,6 +758,7 @@ SCENARIOS = {
     "overload_degrade": scenario_overload_degrade,
     "profiling": scenario_profiling,
     "usage_meter": scenario_usage_meter,
+    "quant_decode": scenario_quant_decode,
 }
 
 

@@ -295,7 +295,10 @@ def run_bench(args):
                            prefill_chunk=getattr(args, "prefill_chunk",
                                                  None),
                            preempt=getattr(args, "preempt", None),
-                           usage=usage_meter)
+                           usage=usage_meter,
+                           quant=(None if getattr(args, "quant", "none")
+                                  == "none" else args.quant),
+                           kv_quant=getattr(args, "kv_quant", None))
 
     # --chaos SEED: seed a probabilistic fault plan (poisoned steps,
     # synthetic OOM, slow steps) and drive through the self-healing
@@ -612,6 +615,9 @@ def run_http_bench(args):
                      enable_prefix_cache=args.prefix_cache,
                      sync_interval=args.sync_interval,
                      spec_k=args.spec_k,
+                     quant=(None if args.quant == "none"
+                            else args.quant),
+                     kv_quant=args.kv_quant,
                      model_name=f"replica-{i}", **_replica_kw())
                for i in range(args.replicas)]
     router = Router([s.address for s in servers],
@@ -825,6 +831,20 @@ def _build_parser() -> argparse.ArgumentParser:
                          "priority resident's KV to host RAM to admit "
                          "a higher class (default FLAGS_serving_"
                          "preempt)")
+    ap.add_argument("--quant", choices=("none", "int8", "int4"),
+                    default="none",
+                    help="weight-only quantized serving: convert the "
+                         "checkpoint to int8 or int4 QuantizedWeight "
+                         "shards at engine construction (embeddings/"
+                         "norms/lm_head stay dense; default "
+                         "FLAGS_serving_quant)")
+    ap.add_argument("--kv-quant",
+                    action=argparse.BooleanOptionalAction, default=None,
+                    help="int8 KV pages: pools store int8 with per-"
+                         "(page-row, head) f32 scales — quantize on "
+                         "write, dequant fused into the attention "
+                         "gather, spill/restore move the quantized "
+                         "bytes (default FLAGS_serving_kv_quant)")
     ap.add_argument("--overload-baseline", action="store_true",
                     help="after the configured run, re-run the "
                          "identical workload on an FCFS engine "
