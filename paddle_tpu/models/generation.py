@@ -150,24 +150,42 @@ def _layer_weights(state, i):
     return w
 
 
-def _qkv_proj(w, h, nh, kvh, hd):
+def _qkv_proj(w, h, nh, kvh, hd, lora=(), aidx=None, li=0):
     """(q, k, v) projections — one fused GEMV when the quantized state
-    provides it, three matmuls otherwise."""
+    provides it, three matmuls otherwise.  A non-empty ``lora`` bank
+    adds each slot's rank-r adapter delta on top (``aidx`` indexes the
+    bank per row; ``lora=()`` is the dense path, byte-identical jaxpr
+    — zero extra pytree leaves, no traced ops)."""
     if "qkv" in w:
         qkv = _mm(h, w["qkv"])
-        return (qkv[..., :nh * hd], qkv[..., nh * hd:(nh + kvh) * hd],
-                qkv[..., (nh + kvh) * hd:])
-    return _mm(h, w["q"]), _mm(h, w["k"]), _mm(h, w["v"])
+        q, k, v = (qkv[..., :nh * hd], qkv[..., nh * hd:(nh + kvh) * hd],
+                   qkv[..., (nh + kvh) * hd:])
+    else:
+        q, k, v = _mm(h, w["q"]), _mm(h, w["k"]), _mm(h, w["v"])
+    if lora:
+        from ..ops.pallas.lora_matmul import lora_delta
+        q = q + lora_delta(lora, "q", li, h, aidx)
+        k = k + lora_delta(lora, "k", li, h, aidx)
+        v = v + lora_delta(lora, "v", li, h, aidx)
+    return q, k, v
 
 
-def _ffn(w, h):
+def _ffn(w, h, lora=(), aidx=None, li=0):
     if "gateup" in w:
         gu = _mm(h, w["gateup"])
         half = gu.shape[-1] // 2
-        return _mm(jax.nn.silu(gu[..., :half]) * gu[..., half:],
-                   w["down"])
-    return _mm(jax.nn.silu(_mm(h, w["gate"])) * _mm(h, w["up"]),
-               w["down"])
+        g, u = gu[..., :half], gu[..., half:]
+    else:
+        g, u = _mm(h, w["gate"]), _mm(h, w["up"])
+    if lora:
+        from ..ops.pallas.lora_matmul import lora_delta
+        g = g + lora_delta(lora, "gate", li, h, aidx)
+        u = u + lora_delta(lora, "up", li, h, aidx)
+    act = jax.nn.silu(g) * u
+    out = _mm(act, w["down"])
+    if lora:
+        out = out + lora_delta(lora, "down", li, act, aidx)
+    return out
 
 
 def _rope_at(cos, sin, pos):
@@ -176,13 +194,14 @@ def _rope_at(cos, sin, pos):
 
 
 # ---------------------------------------------------------------- prefill
-def _prefill_layer(w, x, cos, sin, mask, cfg: LlamaConfig):
+def _prefill_layer(w, x, cos, sin, mask, cfg: LlamaConfig, lora=(),
+                   aidx=None, li=0):
     """x: [B, S, H]; returns (out, k_cache, v_cache [B, S, kvH, D])."""
     b, s, _ = x.shape
     nh, kvh, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
                    cfg.head_dim)
     h = _rms(x, w["ln1"], cfg.rms_norm_eps)
-    qp, kp, vp = _qkv_proj(w, h, nh, kvh, hd)
+    qp, kp, vp = _qkv_proj(w, h, nh, kvh, hd, lora, aidx, li)
     q = qp.reshape(b, s, nh, hd)
     k = kp.reshape(b, s, kvh, hd)
     v = vp.reshape(b, s, kvh, hd)
@@ -196,9 +215,13 @@ def _prefill_layer(w, x, cos, sin, mask, cfg: LlamaConfig):
     from ..ops.pallas.flash_attention import sdpa
     attn = sdpa(q, k, v, attn_mask=mask[:, None, None, :],
                 is_causal=True).reshape(b, s, nh * hd)
-    x = x + _mm(attn, w["o"])
+    o = _mm(attn, w["o"])
+    if lora:
+        from ..ops.pallas.lora_matmul import lora_delta
+        o = o + lora_delta(lora, "o", li, attn, aidx)
+    x = x + o
     h = _rms(x, w["ln2"], cfg.rms_norm_eps)
-    return (x + _ffn(w, h), k, v)
+    return (x + _ffn(w, h, lora, aidx, li), k, v)
 
 
 # ------------------------------------------------------------ decode step
@@ -238,7 +261,7 @@ def _decode_layer(w, x, kcache, vcache, cos1, sin1, pos, cfg: LlamaConfig):
 
 # ------------------------------------------------------- paged decode step
 def _decode_layer_paged(w, x, kpool, vpool, table, cos1, sin1, pos,
-                        cfg: LlamaConfig):
+                        cfg: LlamaConfig, lora=(), aidx=None, li=0):
     """Paged-cache decode layer: pools [P, kvH, ps, D], table
     [B, max_pages]; pos [B] is the CURRENT token's position.  The
     write targets page table[b, pos // ps] slot pos % ps — always a
@@ -249,7 +272,7 @@ def _decode_layer_paged(w, x, kpool, vpool, table, cos1, sin1, pos,
                    cfg.head_dim)
     ps = kpool.shape[2]
     h = _rms(x[:, None], w["ln1"], cfg.rms_norm_eps)[:, 0]
-    qp, kp, vp = _qkv_proj(w, h, nh, kvh, hd)
+    qp, kp, vp = _qkv_proj(w, h, nh, kvh, hd, lora, aidx, li)
     q = qp.reshape(b, nh, hd)
     k = kp.reshape(b, kvh, hd)
     v = vp.reshape(b, kvh, hd)
@@ -267,11 +290,22 @@ def _decode_layer_paged(w, x, kpool, vpool, table, cos1, sin1, pos,
     from ..ops.pallas.paged_attention import select_paged_attention
     attn = select_paged_attention()(
         q, kpool, vpool, table, pos + 1).reshape(b, nh * hd)
-    x = x + _mm(attn, w["o"])
+    o = _mm(attn, w["o"])
+    if lora:
+        from ..ops.pallas.lora_matmul import lora_delta
+        o = o + lora_delta(lora, "o", li, attn, aidx)
+    x = x + o
     h = _rms(x[:, None], w["ln2"], cfg.rms_norm_eps)[:, 0]
-    return (x + _mm(jax.nn.silu(_mm(h, w["gate"])) * _mm(h, w["up"]),
-                    w["down"]),
-            kpool, vpool)
+    g = _mm(h, w["gate"])
+    u = _mm(h, w["up"])
+    if lora:
+        g = g + lora_delta(lora, "gate", li, h, aidx)
+        u = u + lora_delta(lora, "up", li, h, aidx)
+    act = jax.nn.silu(g) * u
+    d = _mm(act, w["down"])
+    if lora:
+        d = d + lora_delta(lora, "down", li, act, aidx)
+    return (x + d, kpool, vpool)
 
 
 # --------------------------------------------------------------- sampling

@@ -62,6 +62,7 @@ __all__ = ["AlertRule", "Counter", "DiagnosticCapture",
            "format_traceparent", "parse_traceparent",
            "merge_usage", "request_ledger",
            "resource_tracker", "serving_sources",
+           "active_lora", "set_active_lora",
            "set_active_capture", "set_active_profiler",
            "set_active_quant", "set_active_usage", "tracer"]
 
@@ -78,6 +79,20 @@ def set_active_quant(provider):
 
 def active_quant():
     return _active_quant
+
+
+# the multi-LoRA provider: dump() writes lora.json from its
+# lora_snapshot() (same last-engine-wins contract as the quant holder)
+_active_lora = None
+
+
+def set_active_lora(provider):
+    global _active_lora
+    _active_lora = provider
+
+
+def active_lora():
+    return _active_lora
 
 
 def counter(name, help_="", labelnames=()):
@@ -174,6 +189,7 @@ def reset():
     set_active_capture(None)
     set_active_usage(None)
     set_active_quant(None)
+    set_active_lora(None)
 
 
 def dump(dir_=None) -> str | None:
@@ -184,9 +200,10 @@ def dump(dir_=None) -> str | None:
     ``flight.json``, and the resource tracker's snapshot as
     ``resources.json`` into ``dir_`` (default: ``FLAGS_metrics_dir``).
     When a continuous profiler / diagnostic capture / usage meter /
-    quantized engine is active, adds ``profile.json`` /
-    ``captures.json`` / ``usage.json`` / ``quant.json``.  Returns the
-    directory, or None when no directory is configured."""
+    quantized engine / LoRA-serving engine is active, adds
+    ``profile.json`` / ``captures.json`` / ``usage.json`` /
+    ``quant.json`` / ``lora.json``.  Returns the directory, or None
+    when no directory is configured."""
     if dir_ is None:
         from ..flags import FLAGS
         dir_ = FLAGS.get("FLAGS_metrics_dir") or None
@@ -232,6 +249,10 @@ def dump(dir_=None) -> str | None:
     if quant is not None:
         with open(os.path.join(dir_, "quant.json"), "w") as f:
             json.dump(quant.quant_snapshot(), f, indent=2)
+    lora = active_lora()
+    if lora is not None:
+        with open(os.path.join(dir_, "lora.json"), "w") as f:
+            json.dump(lora.lora_snapshot(), f, indent=2)
     return dir_
 
 

@@ -87,6 +87,11 @@ _M_SHED = _REG.counter(
     "serving_usage_shed_total",
     "requests shed at admission, attributed per tenant",
     ("tenant",))
+_M_ADAPTER_TOKENS = _REG.counter(
+    "serving_usage_adapter_tokens_total",
+    "decode tokens attributed per tenant and LoRA adapter (series "
+    "exist only for requests that named an adapter; cardinality is "
+    "bounded by the adapter registry)", ("tenant", "adapter"))
 _M_TENANTS = _REG.gauge(
     "serving_usage_tenants",
     "distinct tenants currently tracked (LRU-bounded by "
@@ -103,7 +108,8 @@ EVICTED_TENANT = "(evicted)"
 
 # metric families carrying a tenant label; eviction folds their series
 _TENANT_METRICS = (_M_TOKENS, _M_REQS, _M_PAGE_SECONDS, _M_QUEUE_SECONDS,
-                   _M_SPILL_BYTES, _M_PREEMPT, _M_SLO, _M_SHED)
+                   _M_SPILL_BYTES, _M_PREEMPT, _M_SLO, _M_SHED,
+                   _M_ADAPTER_TOKENS)
 
 _AGG_INT_FIELDS = (
     "requests", "finished", "goodput_requests",
@@ -121,6 +127,7 @@ def _zero_row() -> dict:
     for f in _AGG_FLOAT_FIELDS:
         row[f] = 0.0
     row["slo"] = {}
+    row["adapters"] = {}
     return row
 
 
@@ -159,6 +166,7 @@ def request_ledger(req) -> dict:
         "restore_bytes": req.restore_bytes,
         "preemptions": req.preemptions,
         "replays": req.replays,
+        "adapter": getattr(req, "adapter", None),
     }
 
 
@@ -331,6 +339,14 @@ class UsageMeter:
                 req.prefill_cached_tokens)
             _M_TOKENS.labels(tenant, "decode").inc(req.num_generated)
             _M_QUEUE_SECONDS.labels(tenant).inc(req.queue_seconds)
+            adapter = getattr(req, "adapter", None)
+            if adapter:
+                cell = row["adapters"].setdefault(
+                    str(adapter), {"requests": 0, "decode_tokens": 0})
+                cell["requests"] += 1
+                cell["decode_tokens"] += req.num_generated
+                _M_ADAPTER_TOKENS.labels(tenant, str(adapter)).inc(
+                    req.num_generated)
             if req.spill_bytes:
                 _M_SPILL_BYTES.labels(tenant).inc(req.spill_bytes)
             if req.preemptions:
@@ -566,6 +582,8 @@ class UsageMeter:
                         for k, v in row.items()}
                 copy["slo"] = {d: dict(c)
                                for d, c in row["slo"].items()}
+                copy["adapters"] = {a: dict(c)
+                                    for a, c in row["adapters"].items()}
                 tenants[name] = copy
             for seq, (tenant, req) in self._live.items():
                 dst = tenants.setdefault(tenant, _zero_row())

@@ -47,6 +47,13 @@ Modules:
                        drafter + acceptance bookkeeping; the runner's
                        verify program scores k+1 positions per step
                        with bit-identical greedy outputs
+  * lora/            — multi-LoRA serving: AdapterStore (LRU device
+                       bank + host parking, per-request row pinning),
+                       batched gather-LoRA matmul over the seven
+                       projections (``submit(adapter=...)``), and the
+                       offline batch lane (BatchJob JSONL drip-feed at
+                       ``BATCH_PRIORITY``, ``POST /v1/batches``);
+                       ``lora=None`` keeps dense jaxprs byte-identical
   * parallel/        — mesh-aware ModelRunner: tensor-parallel weight
                        placement, head-sharded KV pools, and every
                        jitted program (tp=1 == exact single-chip path)
@@ -93,6 +100,9 @@ from .engine import (  # noqa: F401
     Engine, NonFiniteLogitsError, create_engine)
 from .faults import (  # noqa: F401
     FaultPlan, InjectedFault, fault_plan_from_flags)
+from .lora import (  # noqa: F401
+    AdapterStore, BATCH_PRIORITY, BatchJob, merge_adapter,
+    random_adapter)
 from .parallel import ModelRunner, parse_mesh  # noqa: F401
 from .quantize import quantize_state  # noqa: F401
 from .request import GenerationConfig, Request, RequestState  # noqa: F401
@@ -106,12 +116,13 @@ from .spec import NgramProposer, SpecStats  # noqa: F401
 from .supervisor import EngineSupervisor  # noqa: F401
 from .watchdog import Watchdog  # noqa: F401
 
-__all__ = ["BackpressureError", "BlockManager", "DrainingError", "Engine",
+__all__ = ["AdapterStore", "BATCH_PRIORITY", "BackpressureError",
+           "BatchJob", "BlockManager", "DrainingError", "Engine",
            "EngineSupervisor", "EngineWorker", "FaultPlan",
            "GenerationConfig", "InjectedFault", "ModelRunner",
            "NgramProposer", "NoReplicaAvailable", "NonFiniteLogitsError",
            "Replica", "Request", "RequestState", "Router", "RouterServer",
            "SLOConfig", "SLOTracker", "Scheduler", "ServingClient",
            "ServingHTTPError", "ServingServer", "SpecStats", "Watchdog",
-           "create_engine", "fault_plan_from_flags", "parse_mesh",
-           "quantize_state", "serve"]
+           "create_engine", "fault_plan_from_flags", "merge_adapter",
+           "parse_mesh", "quantize_state", "random_adapter", "serve"]

@@ -155,19 +155,23 @@ class ServingClient:
     # ----------------------------------------------------- completions
     def completion(self, prompt, *, max_tokens: int = 16,
                    stream: bool = False, timeout: float | None = None,
-                   tenant: str | None = None, **gen_kw):
+                   tenant: str | None = None,
+                   adapter: str | None = None, **gen_kw):
         """POST /v1/completions.  Blocking: the parsed response dict.
         ``stream=True``: a generator of parsed SSE events (one token
         per event; closing the generator drops the connection, which
         cancels the request server-side).  ``tenant`` tags the request
         for the server's usage meter (body field; the X-Tenant header
-        overrides it at the server)."""
+        overrides it at the server).  ``adapter`` selects a registered
+        LoRA adapter by name (body field; X-Adapter overrides)."""
         body = {"prompt": [int(t) for t in prompt],
                 "max_tokens": int(max_tokens), "stream": bool(stream)}
         if timeout is not None:
             body["timeout"] = float(timeout)
         if tenant is not None:
             body["tenant"] = str(tenant)
+        if adapter is not None:
+            body["adapter"] = str(adapter)
         body.update(gen_kw)
         # every completion opens a "client.completion" span (nesting
         # under the caller's current span, e.g. router.request) and
@@ -239,6 +243,24 @@ class ServingClient:
         """Blocking completion, returning just the generated token ids."""
         out = self.completion(prompt, **kw)
         return list(out["choices"][0]["token_ids"])
+
+    # ---------------------------------------------------------- batches
+    def submit_batch(self, *, records=None, input_path: str | None = None,
+                     **kw) -> dict:
+        """``POST /v1/batches``: start an offline batch job from inline
+        ``records`` or a server-side ``input_path`` JSONL file.  ``kw``
+        passes through (window / max_tokens / tenant / adapter /
+        output_path).  Returns the job's initial progress dict."""
+        body = dict(kw)
+        if records is not None:
+            body["records"] = list(records)
+        if input_path is not None:
+            body["input_path"] = str(input_path)
+        return self.request("POST", "/v1/batches", body)
+
+    def batch_status(self, job_id: str) -> dict:
+        """``GET /v1/batches/<id>`` — one job's progress."""
+        return self.request("GET", f"/v1/batches/{job_id}")
 
     # ------------------------------------------------------- utilities
     def healthz(self) -> dict:

@@ -147,7 +147,7 @@ class Engine:
                  prefill_chunk: int | None = None,
                  preempt: bool | None = None, faults=None, usage=None,
                  quant: str | None = None,
-                 kv_quant: bool | None = None):
+                 kv_quant: bool | None = None, lora=None):
         if model is not None:
             from ..framework.tensor import Tensor
             config = model.config
@@ -172,6 +172,18 @@ class Engine:
         if self.quant:
             from .quantize import quantize_state
             state = quantize_state(state, kind=self.quant)
+        # multi-LoRA serving: an AdapterStore sizes the runner's packed
+        # adapter bank (rows x rank fixed at construction — the
+        # no-retrace contract extends to the bank shape).  lora=None
+        # (the default) passes empty tuples through every jitted
+        # program: the dense jaxprs are byte-identical to a build
+        # without the knob, same guard style as quant/kv_quant.
+        self.lora = lora
+        if lora is not None and lora.rank is None:
+            raise ValueError(
+                "the AdapterStore has no adapters and no explicit "
+                "rank= — the runner cannot size the bank (register "
+                "one adapter first, or pass AdapterStore(rank=...))")
         self.config = config
         self.state = state
         self.max_slots = int(max_slots)
@@ -280,8 +292,15 @@ class Engine:
             sync_interval=self.sync_interval,
             emit_logits=self.emit_logits, spec_k=self.spec_k,
             kv_quant=self.kv_quant,
+            lora_slots=(self.lora.capacity if self.lora is not None
+                        else 0),
+            lora_rank=(self.lora.rank if self.lora is not None else 0),
             per_device_pool_bytes=sizing["per_device_bytes"])
         self.runner = ModelRunner(config, state, **self._runner_kw)
+        if self.lora is not None:
+            # bind the store to the bank: resident adapters (if any)
+            # upload now; later acquires patch single rows in place
+            self.lora.attach(self.runner)
 
         # host-side mirrors of the slot state (bookkeeping + targeted
         # device patches on admit/evict; NEVER re-uploaded per step)
@@ -290,6 +309,9 @@ class Engine:
         self._pos = np.zeros((self.max_slots,), np.int32)
         self._tok = np.zeros((self.max_slots,), np.int32)
         self._active = np.zeros((self.max_slots,), np.int32)
+        # per-slot adapter bank row (0 = the permanently-zero no-adapter
+        # row); patched on admit/evict alongside the other mirrors
+        self._aidx = np.zeros((self.max_slots,), np.int32)
         self._ring_cursor = 0           # host mirror of the ring index
         # ring rows the host has not consumed yet, in decode order:
         # [(ring row, [(slot, request), ...], drafts-or-None), ...] —
@@ -379,6 +401,17 @@ class Engine:
             # like the profiler/usage holders)
             _obs.set_active_quant(self)
 
+        # multi-LoRA metric surface: registered only when a store is
+        # attached, so a dense engine exports exactly the pre-LoRA set
+        if self.lora is not None:
+            _obs.gauge(
+                "serving_lora_bank_bytes",
+                "device bytes the packed adapter bank occupies "
+                "(all rows, every projection, + the scale vector)"
+            ).set(self.runner.lora_bank_bytes())
+            # lora.json provider for obs.dump() (last engine wins)
+            _obs.set_active_lora(self)
+
     # ------------------------------------------------ runner delegation
     # python-side mirror of serving_decode_step_traces_total: counted at
     # trace time inside the runner's step body (the no-retrace contract)
@@ -406,7 +439,8 @@ class Engine:
     def submit(self, prompt, gen: GenerationConfig | None = None, *,
                deadline: float | None = None, on_token=None,
                arrival_time: float | None = None, trace=None,
-               priority: int = 0, tenant: str | None = None) -> Request:
+               priority: int = 0, tenant: str | None = None,
+               adapter: str | None = None) -> Request:
         """``trace`` is an optional tracing.SpanContext (or Span) the
         request's root span is parented under — the server passes the
         extracted ``traceparent`` here so the engine-side spans join the
@@ -415,11 +449,19 @@ class Engine:
         sets the scheduling class: higher admits first and (with
         preemption enabled) may preempt lower-priority residents.
         ``tenant`` is the billing dimension for the usage meter
-        (HTTP ``X-Tenant`` / body field; default ``"anon"``)."""
+        (HTTP ``X-Tenant`` / body field; default ``"anon"``).
+        ``adapter`` names a LoRA adapter registered with the engine's
+        :class:`~paddle_tpu.serving.lora.AdapterStore` (HTTP
+        ``X-Adapter`` / body field); unknown names are rejected here,
+        before any page or span is held."""
         req = Request(prompt, gen, deadline=deadline, on_token=on_token,
-                      priority=priority, tenant=tenant,
+                      priority=priority, tenant=tenant, adapter=adapter,
                       arrival_time=(self._clock() if arrival_time is None
                                     else arrival_time))
+        if req.adapter is not None and self.lora is None:
+            raise ValueError(
+                f"request names adapter {req.adapter!r} but the engine "
+                "was built without lora= (pass an AdapterStore)")
         total = req.prompt.size + req.gen.max_new_tokens
         if total > self.max_model_len:
             raise ValueError(
@@ -437,6 +479,12 @@ class Engine:
             raise ValueError(
                 "do_sample requests need an engine built with "
                 "emit_logits=True (host-side sampling reads the logits)")
+        # pin the adapter's bank row for the request's whole lifetime
+        # (submit -> _finalize): preemption parks KV, never the adapter,
+        # so a resume re-enters decode on the same row.  Unknown names
+        # KeyError here; a full bank (every row pinned) RuntimeErrors.
+        req._adapter_row = (self.lora.acquire(req.adapter)
+                            if self.lora is not None else 0)
         req._engine = self
         # spans only after every validation — a rejected submit must not
         # leave dangling open spans
@@ -462,7 +510,10 @@ class Engine:
             self.scheduler.submit(req)
         except BaseException:
             # a rejected submit (queue full, shutdown race) must not
-            # leave the request's spans open in the tracer ring
+            # leave the request's spans open in the tracer ring — nor
+            # its adapter row pinned
+            if self.lora is not None and req.adapter is not None:
+                self.lora.release(req.adapter)
             req.queue_span.end()
             req.root_span.end()
             raise
@@ -569,14 +620,16 @@ class Engine:
                 bucket = -(-plen // ps) * ps
                 ids = np.zeros((1, bucket), np.int32)
                 ids[0, :plen] = req.prompt
-                logits = self.runner.prefill(ids, plen, row)
+                logits = self.runner.prefill(
+                    ids, plen, row, adapter_row=req._adapter_row)
             else:
                 suffix = plen - cached
                 bucket = -(-suffix // ps) * ps
                 ids = np.zeros((1, bucket), np.int32)
                 ids[0, :suffix] = req.prompt[cached:]
-                logits = self.runner.prefill_cached(ids, suffix, cached,
-                                                    row)
+                logits = self.runner.prefill_cached(
+                    ids, suffix, cached, row,
+                    adapter_row=req._adapter_row)
             req.num_cached_tokens = cached
             req.prefill_cached_tokens += cached
             req.prefill_computed_tokens += plen - cached
@@ -615,6 +668,7 @@ class Engine:
         self._pos[slot] = plen
         self._tok[slot] = tok
         self._active[slot] = 1
+        self._aidx[slot] = req._adapter_row
         self._push_slot(slot)
         req.state = RequestState.DECODE
         if self._proposer is not None:
@@ -670,10 +724,13 @@ class Engine:
             ids = np.zeros((1, bucket), np.int32)
             ids[0, :this] = ids_all[done:done + this]
             if done == 0:
-                logits = self.runner.prefill(ids, this, st["row"])
+                logits = self.runner.prefill(
+                    ids, this, st["row"],
+                    adapter_row=getattr(req, "_adapter_row", 0))
             else:
-                logits = self.runner.prefill_cached(ids, this, done,
-                                                    st["row"])
+                logits = self.runner.prefill_cached(
+                    ids, this, done, st["row"],
+                    adapter_row=getattr(req, "_adapter_row", 0))
             st["chunks"] += 1
             self.prefill_chunks += 1
             req.prefill_chunks += 1
@@ -738,6 +795,7 @@ class Engine:
         self._pos[slot] = pos
         self._tok[slot] = tok
         self._active[slot] = 1
+        self._aidx[slot] = req._adapter_row
         self._push_slot(slot)
         req.state = RequestState.DECODE
         if req.root_span is not None:
@@ -881,9 +939,12 @@ class Engine:
                 ids = np.zeros((1, bucket), np.int32)
                 ids[0, :suffix] = ids_all[cached:]
                 if cached == 0:
-                    self.runner.prefill(ids, suffix, row)
+                    self.runner.prefill(ids, suffix, row,
+                                        adapter_row=req._adapter_row)
                 else:
-                    self.runner.prefill_cached(ids, suffix, cached, row)
+                    self.runner.prefill_cached(
+                        ids, suffix, cached, row,
+                        adapter_row=req._adapter_row)
                 self._note_gap(suffix)
             # the resume logits are discarded (the last token is
             # already known) — no host sync happens here
@@ -1176,6 +1237,7 @@ class Engine:
         self._pos[slot] = 0
         self._tok[slot] = 0
         self._active[slot] = 0
+        self._aidx[slot] = 0
         self._push_slot(slot)
 
     def _push_slot(self, slot: int):
@@ -1183,7 +1245,8 @@ class Engine:
         the host mirrors (admission / eviction only — never per step)."""
         self.runner.push_slot(slot, self.table[slot],
                               int(self._pos[slot]), int(self._tok[slot]),
-                              int(self._active[slot]))
+                              int(self._active[slot]),
+                              adapter_row=int(self._aidx[slot]))
 
     # --------------------------------------------------------- sampling
     def _pick_token(self, req: Request, logits: np.ndarray) -> int:
@@ -1230,6 +1293,10 @@ class Engine:
         req.state = RequestState.CANCELLED \
             if reason in ("cancelled", "deadline") else RequestState.DONE
         req.finished_at = now
+        if self.lora is not None and req.adapter is not None:
+            # unpin the bank row (acquired at submit); the weights stay
+            # resident until LRU pressure evicts them
+            self.lora.release(req.adapter)
         self._rngs.pop(req.id, None)
         if self._proposer is not None:
             self._proposer.drop(req.id)
@@ -1309,6 +1376,10 @@ class Engine:
         flushed = self.blocks.flush_prefix_cache()
         self.runner = ModelRunner(self.config, self.state,
                                   **self._runner_kw)
+        if self.lora is not None:
+            # the fresh runner's bank is zeroed — re-upload every
+            # resident adapter before any replayed prefill reads it
+            self.lora.attach(self.runner)
         replayed = 0
         for slot, req in enumerate(self.scheduler.slots):
             if req is None:
@@ -1354,17 +1425,19 @@ class Engine:
         req.prefill_computed_tokens += n - cached
         row = self.blocks.table_row(req.id, self.table_width)
         ps = self.page_size
+        arow = getattr(req, "_adapter_row", 0)
         if cached == 0:
             bucket = -(-n // ps) * ps
             ids = np.zeros((1, bucket), np.int32)
             ids[0, :n] = ids_all
-            self.runner.prefill(ids, n, row)
+            self.runner.prefill(ids, n, row, adapter_row=arow)
         else:
             suffix = n - cached
             bucket = -(-suffix // ps) * ps
             ids = np.zeros((1, bucket), np.int32)
             ids[0, :suffix] = ids_all[cached:]
-            self.runner.prefill_cached(ids, suffix, cached, row)
+            self.runner.prefill_cached(ids, suffix, cached, row,
+                                       adapter_row=arow)
         # the replay's logits are discarded (the last token is already
         # known), so no host sync happens here
         drift = self.blocks.committed_tokens(req.id) - len(tokens)
@@ -1376,6 +1449,7 @@ class Engine:
         self._pos[slot] = n
         self._tok[slot] = tokens[-1]
         self._active[slot] = 1
+        self._aidx[slot] = getattr(req, "_adapter_row", 0)
         self._push_slot(slot)
         self._note_phase("prefill", time.perf_counter() - t0)
         _obs.tracer().record_span(
@@ -1424,6 +1498,8 @@ class Engine:
             "mesh_tp": self.tp,
             "quant": self.quant,
             "kv_quant": self.kv_quant,
+            "lora": (self.lora.snapshot()
+                     if self.lora is not None else None),
             "timings": {k: round(v, 6) for k, v in self.timings.items()},
             "progress": self.progress,
             "slo": self.slo.stats() if self.slo is not None else None,
@@ -1464,6 +1540,13 @@ class Engine:
             "spill_bytes_dense_estimate": b.spilled_pages * dense_page,
         }
 
+    def lora_snapshot(self) -> dict:
+        """The ``lora.json`` side-file: the adapter store's residency
+        census plus the device bank footprint."""
+        snap = self.lora.snapshot() if self.lora is not None else {}
+        snap["bank_bytes_device"] = self.runner.lora_bank_bytes()
+        return snap
+
     def resource_snapshot(self) -> dict:
         """Engine-local half of ``GET /debug/resources``: the exact
         pool census (live/cached/free with a leak check), per-resident-
@@ -1489,6 +1572,8 @@ class Engine:
             "pool": pool,
             "requests": requests,
             "mesh": self.runner.mesh_info(),
+            "lora": (self.lora_snapshot()
+                     if self.lora is not None else None),
             "timings": {k: round(v, 6) for k, v in self.timings.items()},
             "counters": {
                 "decode_steps": self.decode_steps,
@@ -1533,7 +1618,7 @@ def create_engine(model, *, max_slots: int = 4, page_size: int = 64,
                   prefill_chunk: int | None = None,
                   preempt: bool | None = None, faults=None,
                   usage=None, quant: str | None = None,
-                  kv_quant: bool | None = None) -> Engine:
+                  kv_quant: bool | None = None, lora=None) -> Engine:
     """`create_predictor`-style entry point: build a continuous-batching
     engine over a LlamaForCausalLM (or any model exposing ``config`` and
     ``functional_state()`` with the llama state-dict layout).
@@ -1584,6 +1669,16 @@ def create_engine(model, *, max_slots: int = 4, page_size: int = 64,
     token tolerance (pinned by the ``quant_decode`` perf-gate
     scenario).
 
+    ``lora`` attaches a :class:`~paddle_tpu.serving.lora.AdapterStore`:
+    the runner allocates a packed ``capacity + 1``-row adapter bank
+    beside the base weights (row 0 stays zero — the no-adapter row),
+    ``submit(..., adapter='name')`` pins the adapter's row for the
+    request's lifetime, and every slot in the shared decode step
+    gathers its own adapter's (A, B) pair — mixed-adapter batches run
+    in the single jitted program.  ``lora=None`` (the default) passes
+    empty pytrees through every program: the dense jaxprs are
+    byte-identical to a build without the knob.
+
     Example::
 
         engine = create_engine(model, max_slots=8, page_size=64,
@@ -1599,4 +1694,4 @@ def create_engine(model, *, max_slots: int = 4, page_size: int = 64,
                   sync_interval=sync_interval, clock=clock, slo=slo,
                   mesh=mesh, spec_k=spec_k, prefill_chunk=prefill_chunk,
                   preempt=preempt, faults=faults, usage=usage,
-                  quant=quant, kv_quant=kv_quant)
+                  quant=quant, kv_quant=kv_quant, lora=lora)

@@ -1,0 +1,18 @@
+"""Multi-LoRA adapter serving + the offline batch lane.
+
+| Module  | Role |
+|---------|------|
+| store   | AdapterStore: host parking, loud validation, LRU device residency over the runner's packed bank |
+| batch   | BatchJob: lowest-priority JSONL drip-feed for `/v1/batches` |
+
+The device half lives elsewhere: the packed ``[rows, r, dim]`` bank and
+per-slot adapter-index vector ride the runner's decode state
+(``serving.parallel.runner``), and the batched gather-LoRA matmul is
+``ops.pallas.lora_matmul``.
+"""
+from .batch import BATCH_PRIORITY, BatchJob
+from .store import (AdapterStore, LORA_KEYS, lora_key_dims,
+                    merge_adapter, random_adapter)
+
+__all__ = ["AdapterStore", "BatchJob", "BATCH_PRIORITY", "LORA_KEYS",
+           "lora_key_dims", "merge_adapter", "random_adapter"]

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from ...models.generation import _ffn, _mm, _qkv_proj
 from ...models.llama import _rotate_half
 from ...models.llama_hybrid import _rms
+from ...ops.pallas.lora_matmul import lora_delta
 from ...ops.pallas.paged_attention import (gather_kv_pages,
                                            gather_kv_pages_quant,
                                            paged_attention_quant,
@@ -47,23 +48,38 @@ __all__ = ["decode_layer_paged_tp", "prefill_layer_tp",
            "prefill_layer_cached_quant"]
 
 
-def _local_qkv(w, h, hd):
+def _local_qkv(w, h, hd, lora=(), aidx=None, li=0):
     """Project with the local weight shards; head counts are derived
-    from the shard widths (``nh_local = nh / tp`` etc.)."""
+    from the shard widths (``nh_local = nh / tp`` etc.).  LoRA bank B
+    tensors for q/k/v are column-sharded exactly like the base
+    weights, so the deltas land on this shard's own heads."""
     q, k, v = _mm(h, w["q"]), _mm(h, w["k"]), _mm(h, w["v"])
+    if lora:
+        q = q + lora_delta(lora, "q", li, h, aidx)
+        k = k + lora_delta(lora, "k", li, h, aidx)
+        v = v + lora_delta(lora, "v", li, h, aidx)
     return q, k, v, q.shape[-1] // hd, k.shape[-1] // hd
 
 
-def _ffn_tp(w, h, axis):
+def _ffn_tp(w, h, axis, lora=(), aidx=None, li=0):
     """Column-sharded gate/up, row-sharded down: the partial down
-    product is one of the layer's two all-reduces."""
-    part = _mm(jax.nn.silu(_mm(h, w["gate"])) * _mm(h, w["up"]),
-               w["down"])
+    product is one of the layer's two all-reduces.  The down adapter's
+    A is row-sharded like the base weight, so its partial delta joins
+    the SAME psum (contraction splits linearly) — LoRA adds zero
+    collectives."""
+    g, u = _mm(h, w["gate"]), _mm(h, w["up"])
+    if lora:
+        g = g + lora_delta(lora, "gate", li, h, aidx)
+        u = u + lora_delta(lora, "up", li, h, aidx)
+    act = jax.nn.silu(g) * u
+    part = _mm(act, w["down"])
+    if lora:
+        part = part + lora_delta(lora, "down", li, act, aidx)
     return jax.lax.psum(part, axis)
 
 
 def decode_layer_paged_tp(w, x, kpool, vpool, table, cos1, sin1, pos,
-                          cfg, axis):
+                          cfg, axis, lora=(), aidx=None, li=0):
     """Per-shard paged decode layer: ``x`` [B, H] replicated, pools
     [P, kvH/tp, ps, D] local, ``table``/``pos`` replicated.  Returns
     (out replicated, kpool, vpool local) — mirror of
@@ -72,7 +88,7 @@ def decode_layer_paged_tp(w, x, kpool, vpool, table, cos1, sin1, pos,
     hd = cfg.head_dim
     ps = kpool.shape[2]
     h = _rms(x[:, None], w["ln1"], cfg.rms_norm_eps)[:, 0]
-    qp, kp, vp, nh_l, kvh_l = _local_qkv(w, h, hd)
+    qp, kp, vp, nh_l, kvh_l = _local_qkv(w, h, hd, lora, aidx, li)
     q = qp.reshape(b, nh_l, hd)
     k = kp.reshape(b, kvh_l, hd)
     v = vp.reshape(b, kvh_l, hd)
@@ -89,18 +105,22 @@ def decode_layer_paged_tp(w, x, kpool, vpool, table, cos1, sin1, pos,
 
     attn = select_paged_attention(tp_axis=axis)(
         q, kpool, vpool, table, pos + 1).reshape(b, nh_l * hd)
-    x = x + jax.lax.psum(_mm(attn, w["o"]), axis)
+    part = _mm(attn, w["o"])
+    if lora:          # o's A is row-sharded: partial delta, same psum
+        part = part + lora_delta(lora, "o", li, attn, aidx)
+    x = x + jax.lax.psum(part, axis)
     h = _rms(x[:, None], w["ln2"], cfg.rms_norm_eps)[:, 0]
-    return x + _ffn_tp(w, h, axis), kpool, vpool
+    return x + _ffn_tp(w, h, axis, lora, aidx, li), kpool, vpool
 
 
-def prefill_layer_tp(w, x, cos, sin, mask, cfg, axis):
+def prefill_layer_tp(w, x, cos, sin, mask, cfg, axis, lora=(),
+                     aidx=None, li=0):
     """Per-shard prefill layer: ``x`` [B, S, H] replicated; returns
     (out replicated, k/v caches [B, S, kvH/tp, D] local)."""
     b, s, _ = x.shape
     hd = cfg.head_dim
     h = _rms(x, w["ln1"], cfg.rms_norm_eps)
-    qp, kp, vp, nh_l, kvh_l = _local_qkv(w, h, hd)
+    qp, kp, vp, nh_l, kvh_l = _local_qkv(w, h, hd, lora, aidx, li)
     q = qp.reshape(b, s, nh_l, hd)
     k = kp.reshape(b, s, kvh_l, hd)
     v = vp.reshape(b, s, kvh_l, hd)
@@ -112,13 +132,16 @@ def prefill_layer_tp(w, x, cos, sin, mask, cfg, axis):
     from ...ops.pallas.flash_attention import sdpa
     attn = sdpa(q, k, v, attn_mask=mask[:, None, None, :],
                 is_causal=True).reshape(b, s, nh_l * hd)
-    x = x + jax.lax.psum(_mm(attn, w["o"]), axis)
+    part = _mm(attn, w["o"])
+    if lora:
+        part = part + lora_delta(lora, "o", li, attn, aidx)
+    x = x + jax.lax.psum(part, axis)
     h = _rms(x, w["ln2"], cfg.rms_norm_eps)
-    return x + _ffn_tp(w, h, axis), k, v
+    return x + _ffn_tp(w, h, axis, lora, aidx, li), k, v
 
 
 def prefill_layer_cached_tp(w, x, kpool, vpool, row, cos_s, sin_s, mask,
-                            cfg, axis):
+                            cfg, axis, lora=(), aidx=None, li=0):
     """Per-shard cached-suffix prefill layer: suffix queries attend the
     resident prefix gathered from the LOCAL pool shard (prefix keys for
     this device's heads live on this device) concatenated with the
@@ -127,7 +150,7 @@ def prefill_layer_cached_tp(w, x, kpool, vpool, row, cos_s, sin_s, mask,
     b, s, _ = x.shape
     hd = cfg.head_dim
     h = _rms(x, w["ln1"], cfg.rms_norm_eps)
-    qp, kp, vp, nh_l, kvh_l = _local_qkv(w, h, hd)
+    qp, kp, vp, nh_l, kvh_l = _local_qkv(w, h, hd, lora, aidx, li)
     q = qp.reshape(b, s, nh_l, hd)
     k = kp.reshape(b, s, kvh_l, hd)
     v = vp.reshape(b, s, kvh_l, hd)
@@ -143,23 +166,33 @@ def prefill_layer_cached_tp(w, x, kpool, vpool, row, cos_s, sin_s, mask,
     vcat = jnp.concatenate([vpre.astype(v.dtype), v], axis=1)
     attn = sdpa(q, kcat, vcat, attn_mask=mask,
                 is_causal=False).reshape(b, s, nh_l * hd)
-    x = x + jax.lax.psum(_mm(attn, w["o"]), axis)
+    part = _mm(attn, w["o"])
+    if lora:
+        part = part + lora_delta(lora, "o", li, attn, aidx)
+    x = x + jax.lax.psum(part, axis)
     h = _rms(x, w["ln2"], cfg.rms_norm_eps)
-    return x + _ffn_tp(w, h, axis), k, v
+    return x + _ffn_tp(w, h, axis, lora, aidx, li), k, v
 
 
 # ------------------------------------------------- int8 KV page bodies
-def _proj_qkv(w, h, cfg, axis):
+def _proj_qkv(w, h, cfg, axis, lora=(), aidx=None, li=0):
     """(q, k, v, nh_local, kvh_local) for either construction mode:
     single-chip (``axis=None``) goes through ``_qkv_proj`` so fused
     quantized states keep their one-GEMV path; per-shard derives local
-    head counts from the shard widths like ``_local_qkv``."""
+    head counts from the shard widths like ``_local_qkv``.  LoRA
+    deltas stay f32/bf16 ON TOP of the weight-only matmuls — quantized
+    base weights compose with any adapter."""
     hd = cfg.head_dim
     if axis is None:
         qp, kp, vp = _qkv_proj(w, h, cfg.num_attention_heads,
-                               cfg.num_key_value_heads, hd)
+                               cfg.num_key_value_heads, hd, lora, aidx,
+                               li)
     else:
         qp, kp, vp = _mm(h, w["q"]), _mm(h, w["k"]), _mm(h, w["v"])
+        if lora:
+            qp = qp + lora_delta(lora, "q", li, h, aidx)
+            kp = kp + lora_delta(lora, "k", li, h, aidx)
+            vp = vp + lora_delta(lora, "v", li, h, aidx)
     return qp, kp, vp, qp.shape[-1] // hd, kp.shape[-1] // hd
 
 
@@ -169,14 +202,15 @@ def _out_reduce(part, axis):
     return part if axis is None else jax.lax.psum(part, axis)
 
 
-def _ffn_quant(w, h, axis):
+def _ffn_quant(w, h, axis, lora=(), aidx=None, li=0):
     if axis is None:
-        return _ffn(w, h)
-    return _ffn_tp(w, h, axis)
+        return _ffn(w, h, lora, aidx, li)
+    return _ffn_tp(w, h, axis, lora, aidx, li)
 
 
 def decode_layer_paged_quant(w, x, kpool, vpool, kscale, vscale, table,
-                             cos1, sin1, pos, cfg, axis=None):
+                             cos1, sin1, pos, cfg, axis=None, lora=(),
+                             aidx=None, li=0):
     """Paged decode layer over int8 KV pools: quantize this token's
     k/v rows on write (per-(token, head) scale into the scale pools —
     same traced step, no extra host sync), attend through the
@@ -187,7 +221,7 @@ def decode_layer_paged_quant(w, x, kpool, vpool, kscale, vscale, table,
     hd = cfg.head_dim
     ps = kpool.shape[2]
     h = _rms(x[:, None], w["ln1"], cfg.rms_norm_eps)[:, 0]
-    qp, kp, vp, nh_l, kvh_l = _proj_qkv(w, h, cfg, axis)
+    qp, kp, vp, nh_l, kvh_l = _proj_qkv(w, h, cfg, axis, lora, aidx, li)
     q = qp.reshape(b, nh_l, hd)
     k = kp.reshape(b, kvh_l, hd)
     v = vp.reshape(b, kvh_l, hd)
@@ -210,13 +244,18 @@ def decode_layer_paged_quant(w, x, kpool, vpool, kscale, vscale, table,
     attn = paged_attention_quant(
         q, kpool, vpool, kscale, vscale, table, pos + 1,
         tp_axis=axis).reshape(b, nh_l * hd)
-    x = x + _out_reduce(_mm(attn, w["o"]), axis)
+    part = _mm(attn, w["o"])
+    if lora:
+        part = part + lora_delta(lora, "o", li, attn, aidx)
+    x = x + _out_reduce(part, axis)
     h = _rms(x[:, None], w["ln2"], cfg.rms_norm_eps)[:, 0]
-    return (x + _ffn_quant(w, h, axis), kpool, vpool, kscale, vscale)
+    return (x + _ffn_quant(w, h, axis, lora, aidx, li), kpool, vpool,
+            kscale, vscale)
 
 
 def prefill_layer_cached_quant(w, x, kpool, vpool, kscale, vscale, row,
-                               cos_s, sin_s, mask, cfg, axis=None):
+                               cos_s, sin_s, mask, cfg, axis=None,
+                               lora=(), aidx=None, li=0):
     """Cached-suffix prefill layer over int8 KV pools: the resident
     prefix dequantizes through the scale-aware gather; the suffix's own
     k/v stay float here (the runner quantizes them at the pool write).
@@ -224,7 +263,7 @@ def prefill_layer_cached_quant(w, x, kpool, vpool, kscale, vscale, row,
     b, s, _ = x.shape
     hd = cfg.head_dim
     h = _rms(x, w["ln1"], cfg.rms_norm_eps)
-    qp, kp, vp, nh_l, kvh_l = _proj_qkv(w, h, cfg, axis)
+    qp, kp, vp, nh_l, kvh_l = _proj_qkv(w, h, cfg, axis, lora, aidx, li)
     q = qp.reshape(b, s, nh_l, hd)
     k = kp.reshape(b, s, kvh_l, hd)
     v = vp.reshape(b, s, kvh_l, hd)
@@ -240,6 +279,9 @@ def prefill_layer_cached_quant(w, x, kpool, vpool, kscale, vscale, row,
     vcat = jnp.concatenate([vpre, v], axis=1)
     attn = sdpa(q, kcat, vcat, attn_mask=mask,
                 is_causal=False).reshape(b, s, nh_l * hd)
-    x = x + _out_reduce(_mm(attn, w["o"]), axis)
+    part = _mm(attn, w["o"])
+    if lora:
+        part = part + lora_delta(lora, "o", li, attn, aidx)
+    x = x + _out_reduce(part, axis)
     h = _rms(x, w["ln2"], cfg.rms_norm_eps)
-    return x + _ffn_quant(w, h, axis), k, v
+    return x + _ffn_quant(w, h, axis, lora, aidx, li), k, v

@@ -79,6 +79,10 @@ _COL_SHARDED = ("self_attn.q_proj.weight", "self_attn.k_proj.weight",
                 "mlp.up_proj.weight")
 _ROW_SHARDED = ("self_attn.o_proj.weight", "mlp.down_proj.weight")
 _FUSED_KEYS = ("self_attn.qkv_fused.weight", "mlp.gateup_fused.weight")
+# LoRA bank keys whose BASE weight is row-sharded: the adapter's A
+# (which contracts the sharded input) splits with it, B replicates;
+# every other key shards B's output columns and replicates A
+_LORA_ROW_KEYS = ("o", "down")
 
 
 def _leaf_bytes(v) -> int:
@@ -106,7 +110,8 @@ class ModelRunner:
                  max_slots: int, page_size: int, table_width: int,
                  num_pages: int, dump_page: int, sync_interval: int = 1,
                  emit_logits: bool = False, spec_k: int = 0,
-                 kv_quant: bool = False,
+                 kv_quant: bool = False, lora_slots: int = 0,
+                 lora_rank: int = 0,
                  per_device_pool_bytes: int | None = None):
         self.config = config
         self.tp = int(tp)
@@ -119,6 +124,17 @@ class ModelRunner:
         self.emit_logits = bool(emit_logits)
         self.spec_k = int(spec_k)
         self.kv_quant = bool(kv_quant)
+        # LoRA adapter bank: lora_slots usable rows + the zeroed
+        # no-adapter row 0, one static rank axis.  lora_slots == 0 is
+        # the off mode: the bank and the per-slot index vector are
+        # empty tuples — zero pytree leaves in every jitted signature,
+        # so the dense jaxprs stay byte-identical (the kv_quant trick).
+        self.lora_slots = int(lora_slots)
+        self.lora_rank = int(lora_rank)
+        if self.lora_slots and self.lora_rank < 1:
+            raise ValueError(
+                f"lora_slots={self.lora_slots} requires lora_rank >= 1,"
+                f" got {self.lora_rank}")
         validate_tp(config, self.tp)
         self._validate_quantized_state(state)
 
@@ -159,6 +175,11 @@ class ModelRunner:
                 self.vscale = jnp.zeros(scale_shape, jnp.float32)
             else:
                 self.kscale = self.vscale = ()
+            if self.lora_slots:
+                self.lora = self._build_lora_bank()
+                self._aidx_dev = jnp.zeros((self.max_slots,), jnp.int32)
+            else:
+                self.lora = self._aidx_dev = ()
             self._cos, self._sin = cos, sin
             self._table_dev = jnp.asarray(table0)
             self._pos_dev = jnp.zeros((self.max_slots,), jnp.int32)
@@ -189,6 +210,22 @@ class ModelRunner:
                     jnp.zeros(scale_shape, jnp.float32), scale_sh)
             else:
                 self.kscale = self.vscale = ()
+            if self.lora_slots:
+                specs = self._lora_pspecs()
+                bank = self._build_lora_bank()
+                self.lora = {
+                    "a": {k: jax.device_put(
+                        v, NamedSharding(self.mesh, specs["a"][k]))
+                        for k, v in bank["a"].items()},
+                    "b": {k: jax.device_put(
+                        v, NamedSharding(self.mesh, specs["b"][k]))
+                        for k, v in bank["b"].items()},
+                    "scale": jax.device_put(bank["scale"], rep),
+                }
+                self._aidx_dev = jax.device_put(
+                    jnp.zeros((self.max_slots,), jnp.int32), rep)
+            else:
+                self.lora = self._aidx_dev = ()
             self._cos = jax.device_put(cos, rep)
             self._sin = jax.device_put(sin, rep)
             self._table_dev = jax.device_put(jnp.asarray(table0), rep)
@@ -229,6 +266,19 @@ class ModelRunner:
         replicated = sum(_leaf_bytes(v)
                          for v in state.values()) - sharded
         self._weight_bytes_per_device = sharded // self.tp + replicated
+        if self.lora_slots:
+            # bank halves shard like their base weights: A for the
+            # row-sharded projections, B for the column-sharded ones
+            lora_sharded = sum(
+                _leaf_bytes(self.lora["a"][k]) for k in _LORA_ROW_KEYS
+            ) + sum(_leaf_bytes(self.lora["b"][k])
+                    for k in self.lora["b"] if k not in _LORA_ROW_KEYS)
+            lora_total = sum(_leaf_bytes(v) for v in
+                             jax.tree_util.tree_leaves(self.lora))
+            self._lora_bytes_per_device = (
+                lora_sharded // self.tp + (lora_total - lora_sharded))
+        else:
+            self._lora_bytes_per_device = 0
         resource_tracker().set_mesh({
             f"{d.platform}:{d.id}": {TP_AXIS: i}
             for i, d in enumerate(self.devices)})
@@ -353,11 +403,90 @@ class ModelRunner:
                 specs[k] = self._spec_for(k)
         return specs
 
+    # ---------------------------------------------------------- LoRA bank
+    def _build_lora_bank(self):
+        """Zeroed packed bank ``{"a": {key: [L, rows, r, in]}, "b":
+        {key: [L, rows, r, out]}, "scale": [rows]}`` — row 0 stays all
+        zero forever (the no-adapter row), so a mixed batch indexes one
+        bank in ONE traced program.  f32 regardless of base dtype: the
+        delta matmuls accumulate in f32 anyway and the bank is tiny."""
+        from ..lora.store import lora_key_dims
+        dims = lora_key_dims(self.config)
+        L = self.config.num_hidden_layers
+        rows, r = self.lora_slots + 1, self.lora_rank
+        return {
+            "a": {k: jnp.zeros((L, rows, r, ind), jnp.float32)
+                  for k, (ind, _) in dims.items()},
+            "b": {k: jnp.zeros((L, rows, r, outd), jnp.float32)
+                  for k, (_, outd) in dims.items()},
+            "scale": jnp.zeros((rows,), jnp.float32),
+        }
+
+    def _lora_pspecs(self):
+        """shard_map/placement specs mirroring the bank pytree: B
+        column-sharded for q/k/v/gate/up, A row-sharded for o/down
+        (both on the trailing dim axis of [L, rows, r, dim]), scale
+        replicated — the existing o/down psums stay the only
+        collectives.  Off mode collapses to one P() broadcast over the
+        empty tuple."""
+        from jax.sharding import PartitionSpec as P
+        if not self.lora_slots:
+            return P()
+        from ..lora.store import lora_key_dims
+        keys = list(lora_key_dims(self.config))
+        col = P(None, None, None, TP_AXIS)
+        return {
+            "a": {k: (col if k in _LORA_ROW_KEYS else P())
+                  for k in keys},
+            "b": {k: (P() if k in _LORA_ROW_KEYS else col)
+                  for k in keys},
+            "scale": P(),
+        }
+
+    def load_adapter(self, row: int, a: dict, b: dict, scale: float):
+        """Write one adapter into bank row ``row`` (eager ``.at[].set``
+        per leaf — admission-path, never per step).  ``a``/``b`` map
+        each projection key to its full [L, r, dim] host tensor; on a
+        mesh the updated leaves re-pin to their bank sharding so the
+        next traced step sees the layout it was traced for."""
+        if not self.lora_slots:
+            raise RuntimeError(
+                "runner built with lora_slots=0 has no adapter bank")
+        if not 1 <= int(row) <= self.lora_slots:
+            raise ValueError(
+                f"bank row {row} out of range 1..{self.lora_slots} "
+                "(row 0 is the reserved no-adapter row)")
+        new_a = {k: v.at[:, row].set(jnp.asarray(a[k], jnp.float32))
+                 for k, v in self.lora["a"].items()}
+        new_b = {k: v.at[:, row].set(jnp.asarray(b[k], jnp.float32))
+                 for k, v in self.lora["b"].items()}
+        scale_arr = self.lora["scale"].at[row].set(float(scale))
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding
+            specs = self._lora_pspecs()
+            new_a = {k: jax.device_put(
+                v, NamedSharding(self.mesh, specs["a"][k]))
+                for k, v in new_a.items()}
+            new_b = {k: jax.device_put(
+                v, NamedSharding(self.mesh, specs["b"][k]))
+                for k, v in new_b.items()}
+            scale_arr = jax.device_put(
+                scale_arr, NamedSharding(self.mesh, specs["scale"]))
+        self.lora = {"a": new_a, "b": new_b, "scale": scale_arr}
+
+    def lora_bank_bytes(self) -> int:
+        """Total device bytes of the adapter bank (0 when off)."""
+        if not self.lora_slots:
+            return 0
+        return sum(_leaf_bytes(v)
+                   for v in jax.tree_util.tree_leaves(self.lora))
+
     # ------------------------------------------------------- jitted bodies
     # Every jitted signature threads (kscale, vscale) right after the
-    # pools.  Dense mode passes the empty tuples stored at construction:
-    # zero pytree leaves, so the flattened argument list — and therefore
-    # the jaxpr — is byte-identical to the pre-quant program.  The
+    # pools, and (lora, aidx) at the tail.  Off modes pass the empty
+    # tuples stored at construction: zero pytree leaves, so the
+    # flattened argument list — and therefore the jaxpr — is
+    # byte-identical to the pre-quant / pre-LoRA program.  The
     # shard_map specs use P() for those positions (a pspec broadcasts
     # over an empty subtree).
     def _make_step_fn(self):
@@ -370,7 +499,8 @@ class ModelRunner:
         mapped = jax.shard_map(
             self._build_step_tp(), mesh=self.mesh,
             in_specs=(self._state_specs(), pool, pool, sspec, sspec,
-                      P(), P(), P(), P(), P(), P(), P(), P()),
+                      P(), P(), P(), P(), P(), P(), P(), P(),
+                      self._lora_pspecs(), P()),
             out_specs=(pool, pool, sspec, sspec, P(), P(), P(), P(),
                        P()),
             check_vma=False)
@@ -386,7 +516,7 @@ class ModelRunner:
         runner = self
 
         def step(state, kpool, vpool, kscale, vscale, table, pos, tok,
-                 active, ring, ridx, cos, sin):
+                 active, ring, ridx, cos, sin, lora, aidx):
             # python body runs at trace time only: a second execution of
             # this line means an admission/eviction re-traced the step
             runner.decode_traces += 1
@@ -406,13 +536,14 @@ class ModelRunner:
                 if kv_quant:
                     h, kp_, vp_, ks_, vs_ = decode_layer_paged_quant(
                         w, h, kpool[i], vpool[i], kscale[i], vscale[i],
-                        table, cos1, sin1, posc, cfg)
+                        table, cos1, sin1, posc, cfg, None, lora, aidx,
+                        i)
                     kss.append(ks_)
                     vss.append(vs_)
                 else:
                     h, kp_, vp_ = _decode_layer_paged(
                         w, h, kpool[i], vpool[i], table, cos1, sin1,
-                        posc, cfg)
+                        posc, cfg, lora, aidx, i)
                 kps.append(kp_)
                 vps.append(vp_)
             kpool = jnp.stack(kps)
@@ -450,7 +581,7 @@ class ModelRunner:
         runner = self
 
         def step(state, kpool, vpool, kscale, vscale, table, pos, tok,
-                 active, ring, ridx, cos, sin):
+                 active, ring, ridx, cos, sin, lora, aidx):
             runner.decode_traces += 1
             _M_STEP_TRACES.inc()
             posc = jnp.minimum(pos, rope_len - 1)
@@ -464,13 +595,14 @@ class ModelRunner:
                 if kv_quant:
                     h, kp_, vp_, ks_, vs_ = decode_layer_paged_quant(
                         w, h, kpool[i], vpool[i], kscale[i], vscale[i],
-                        table, cos1, sin1, posc, cfg, TP_AXIS)
+                        table, cos1, sin1, posc, cfg, TP_AXIS, lora,
+                        aidx, i)
                     kss.append(ks_)
                     vss.append(vs_)
                 else:
                     h, kp_, vp_ = decode_layer_paged_tp(
                         w, h, kpool[i], vpool[i], table, cos1, sin1,
-                        posc, cfg, TP_AXIS)
+                        posc, cfg, TP_AXIS, lora, aidx, i)
                 kps.append(kp_)
                 vps.append(vp_)
             kpool = jnp.stack(kps)
@@ -505,7 +637,7 @@ class ModelRunner:
             self._build_verify(tp=True), mesh=self.mesh,
             in_specs=(self._state_specs(), pool, pool, sspec, sspec,
                       P(), P(), P(), P(), P(), P(), P(), P(), P(),
-                      P()),
+                      P(), self._lora_pspecs(), P()),
             out_specs=(pool, pool, sspec, sspec, P(), P(), P(), P()),
             check_vma=False)
         return jax.jit(mapped, donate_argnums=(1, 2, 3, 4, 6, 7, 9, 10))
@@ -545,7 +677,8 @@ class ModelRunner:
         runner = self
 
         def verify(state, kpool, vpool, kscale, vscale, table, pos,
-                   tok, active, ring, ridx, draft, dlen, cos, sin):
+                   tok, active, ring, ridx, draft, dlen, cos, sin,
+                   lora, aidx):
             # trace-time counters, exactly like the plain step body
             runner.decode_traces += 1
             runner.verify_traces += 1
@@ -560,6 +693,11 @@ class ModelRunner:
             posc = jnp.minimum(pos_f, rope_len - 1)
             tok_f = grid.reshape(-1)
             table_f = jnp.repeat(table, M, axis=0)
+            # every candidate row of a slot shares its adapter; `lora`
+            # is a pytree whose STRUCTURE (empty vs non-empty tuple)
+            # carries the on/off bit — truthiness is trace-time static
+            # tpu-lint: disable=jit-traced-branch
+            aidx_f = jnp.repeat(aidx, M) if lora else aidx
             emb = jnp.take(state["llama.embed_tokens.weight"], tok_f,
                            axis=0)
             cos1, sin1 = _rope_at(cos, sin, posc)
@@ -571,17 +709,17 @@ class ModelRunner:
                     h, kp_, vp_, ks_, vs_ = decode_layer_paged_quant(
                         w, h, kpool[i], vpool[i], kscale[i], vscale[i],
                         table_f, cos1, sin1, posc, cfg,
-                        TP_AXIS if tp else None)
+                        TP_AXIS if tp else None, lora, aidx_f, i)
                     kss.append(ks_)
                     vss.append(vs_)
                 elif tp:
                     h, kp_, vp_ = decode_layer_paged_tp(
                         w, h, kpool[i], vpool[i], table_f, cos1, sin1,
-                        posc, cfg, TP_AXIS)
+                        posc, cfg, TP_AXIS, lora, aidx_f, i)
                 else:
                     h, kp_, vp_ = _decode_layer_paged(
                         w, h, kpool[i], vpool[i], table_f, cos1, sin1,
-                        posc, cfg)
+                        posc, cfg, lora, aidx_f, i)
                 kps.append(kp_)
                 vps.append(vp_)
             kpool = jnp.stack(kps)
@@ -649,7 +787,7 @@ class ModelRunner:
         kv_quant = self.kv_quant
 
         def prefill(state, ids, length, table_row, kpool, vpool,
-                    kscale, vscale, cos, sin):
+                    kscale, vscale, cos, sin, lora, aidx):
             _M_PREFILL_TRACES.labels(str(bucket)).inc()
             x = jnp.take(state["llama.embed_tokens.weight"], ids, axis=0)
             pmask = jnp.arange(bucket)[None, :] < length
@@ -657,11 +795,12 @@ class ModelRunner:
                 w = _layer_weights(state, i)
                 if tp == 1:
                     x, k, v = _prefill_layer(w, x, cos[:bucket],
-                                             sin[:bucket], pmask, cfg)
+                                             sin[:bucket], pmask, cfg,
+                                             lora, aidx, i)
                 else:
                     x, k, v = prefill_layer_tp(w, x, cos[:bucket],
                                                sin[:bucket], pmask, cfg,
-                                               TP_AXIS)
+                                               TP_AXIS, lora, aidx, i)
                 if kv_quant:
                     # quantize the whole prompt's KV once per layer,
                     # then page the int8 rows + their scales
@@ -699,7 +838,8 @@ class ModelRunner:
             mapped = jax.shard_map(
                 prefill, mesh=self.mesh,
                 in_specs=(self._state_specs(), P(), P(), P(), pool,
-                          pool, sspec, sspec, P(), P()),
+                          pool, sspec, sspec, P(), P(),
+                          self._lora_pspecs(), P()),
                 out_specs=(pool, pool, sspec, sspec, P()),
                 check_vma=False)
             fn = jax.jit(mapped, donate_argnums=(4, 5, 6, 7))
@@ -726,7 +866,7 @@ class ModelRunner:
         kv_quant = self.kv_quant
 
         def prefill(state, ids, length, cached_len, row, kpool, vpool,
-                    kscale, vscale, cos, sin):
+                    kscale, vscale, cos, sin, lora, aidx):
             _M_PREFILL_TRACES.labels(f"cached:{bucket}").inc()
             x = jnp.take(state["llama.embed_tokens.weight"], ids, axis=0)
             j = jnp.arange(bucket)
@@ -754,7 +894,7 @@ class ModelRunner:
                     x, k, v = prefill_layer_cached_quant(
                         w, x, kpool[i], vpool[i], kscale[i], vscale[i],
                         row, cos_s, sin_s, mask, cfg,
-                        TP_AXIS if tp > 1 else None)
+                        TP_AXIS if tp > 1 else None, lora, aidx, i)
                     qk, sk = quantize_kv_rows(k[0])
                     qv, sv = quantize_kv_rows(v[0])
                     kpool = kpool.at[(i,) + widx].set(qk)
@@ -767,11 +907,11 @@ class ModelRunner:
                     vpre = gather_kv_pages(vpool[i], row)
                     x, k, v = _prefill_layer_cached(
                         w, x, kpre[None], vpre[None], cos_s, sin_s,
-                        mask, cfg)
+                        mask, cfg, lora, aidx, i)
                 else:
                     x, k, v = prefill_layer_cached_tp(
                         w, x, kpool[i], vpool[i], row, cos_s, sin_s,
-                        mask, cfg, TP_AXIS)
+                        mask, cfg, TP_AXIS, lora, aidx, i)
                 kpool = kpool.at[i, page_w[:, None], heads[None, :],
                                  off[:, None]].set(k[0])
                 vpool = vpool.at[i, page_w[:, None], heads[None, :],
@@ -792,7 +932,8 @@ class ModelRunner:
             mapped = jax.shard_map(
                 prefill, mesh=self.mesh,
                 in_specs=(self._state_specs(), P(), P(), P(), P(), pool,
-                          pool, sspec, sspec, P(), P()),
+                          pool, sspec, sspec, P(), P(),
+                          self._lora_pspecs(), P()),
                 out_specs=(pool, pool, sspec, sspec, P()),
                 check_vma=False)
             fn = jax.jit(mapped, donate_argnums=(5, 6, 7, 8))
@@ -813,7 +954,7 @@ class ModelRunner:
             self.state, self.kpool, self.vpool, self.kscale,
             self.vscale, self._table_dev, self._pos_dev, self._tok_dev,
             self._active_dev, self._ring_dev, self._ridx_dev,
-            self._cos, self._sin)
+            self._cos, self._sin, self.lora, self._aidx_dev)
         if self.decode_traces != traces_before:
             sig = f"slots={self.max_slots} ring={self.sync_interval}"
             if self.tp > 1:
@@ -841,7 +982,7 @@ class ModelRunner:
             self.vscale, self._table_dev, self._pos_dev, self._tok_dev,
             self._active_dev, self._ring_dev, self._ridx_dev,
             jnp.asarray(draft, jnp.int32), jnp.asarray(dlen, jnp.int32),
-            self._cos, self._sin)
+            self._cos, self._sin, self.lora, self._aidx_dev)
         if self.verify_traces != traces_before:
             sig = (f"slots={self.max_slots} k={self.spec_k} "
                    f"ring={self.sync_interval}")
@@ -849,7 +990,16 @@ class ModelRunner:
                 sig += f" tp={self.tp}"
             record_compile("verify_step", t0, signature=sig)
 
-    def prefill(self, ids: np.ndarray, plen: int, row: np.ndarray):
+    def _prefill_aidx(self, adapter_row: int):
+        """Scalar bank index for a whole-prompt prefill (one request =
+        one adapter); the empty tuple in off mode keeps the jitted
+        signature leaf-free."""
+        if not self.lora_slots:
+            return ()
+        return jnp.asarray(int(adapter_row), jnp.int32)
+
+    def prefill(self, ids: np.ndarray, plen: int, row: np.ndarray,
+                adapter_row: int = 0):
         """Full-prompt prefill: pages the prompt's KV into the pool and
         returns the last-token logits handle.  ``ids`` is the
         [1, bucket] padded prompt."""
@@ -863,14 +1013,16 @@ class ModelRunner:
             jnp.asarray([plen], jnp.int32),
             jnp.asarray(row[:bucket // self.page_size]),
             self.kpool, self.vpool, self.kscale, self.vscale,
-            self._cos, self._sin)
+            self._cos, self._sin, self.lora,
+            self._prefill_aidx(adapter_row))
         if fresh:
             record_compile(f"prefill[{bucket}]", t0,
                            signature=f"ids=[1,{bucket}]")
         return logits
 
     def prefill_cached(self, ids: np.ndarray, suffix_len: int,
-                       cached_len: int, row: np.ndarray):
+                       cached_len: int, row: np.ndarray,
+                       adapter_row: int = 0):
         """Cached-suffix prefill against the resident prefix pages."""
         bucket = ids.shape[1]
         fresh = bucket not in self._prefill_cached_fns
@@ -882,7 +1034,8 @@ class ModelRunner:
             jnp.asarray([suffix_len], jnp.int32),
             jnp.asarray(cached_len, jnp.int32), jnp.asarray(row),
             self.kpool, self.vpool, self.kscale, self.vscale,
-            self._cos, self._sin)
+            self._cos, self._sin, self.lora,
+            self._prefill_aidx(adapter_row))
         if fresh:
             record_compile(f"prefill_cached[{bucket}]", t0,
                            signature=f"ids=[1,{bucket}]")
@@ -952,13 +1105,16 @@ class ModelRunner:
             self.vscale = vscale_p
 
     def push_slot(self, slot: int, row: np.ndarray, pos: int, tok: int,
-                  active: int):
+                  active: int, adapter_row: int = 0):
         """Patch ONE slot's row of the device-resident decode state
         (admission / eviction only — never per step)."""
         self._table_dev = self._table_dev.at[slot].set(jnp.asarray(row))
         self._pos_dev = self._pos_dev.at[slot].set(int(pos))
         self._tok_dev = self._tok_dev.at[slot].set(int(tok))
         self._active_dev = self._active_dev.at[slot].set(int(active))
+        if self.lora_slots:
+            self._aidx_dev = self._aidx_dev.at[slot].set(
+                int(adapter_row))
 
     def fetch_ring(self) -> np.ndarray:
         """The host sync: ONE [sync_interval, slots] int32 transfer."""
@@ -987,6 +1143,7 @@ class ModelRunner:
                 "device": f"{d.platform}:{d.id}", TP_AXIS: i,
                 "kv_pool_bytes": self._pool_bytes_per_device,
                 "weight_bytes": self._weight_bytes_per_device,
+                "lora_bank_bytes": self._lora_bytes_per_device,
             }
             try:
                 stats = d.memory_stats() or {}
@@ -1002,7 +1159,8 @@ class ModelRunner:
                 "kv_quant": self.kv_quant, "devices": devices}
 
 
-def _prefill_layer_cached(w, x, kpre, vpre, cos_s, sin_s, mask, cfg):
+def _prefill_layer_cached(w, x, kpre, vpre, cos_s, sin_s, mask, cfg,
+                          lora=(), aidx=None, li=0):
     """One transformer layer of suffix prefill against a resident
     prefix: ``x`` [1, S, H] suffix hidden, ``kpre``/``vpre``
     [1, Tpre, kvH, D] prefix KV gathered from the pool (keys already
@@ -1013,7 +1171,7 @@ def _prefill_layer_cached(w, x, kpre, vpre, cos_s, sin_s, mask, cfg):
     nh, kvh, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
                    cfg.head_dim)
     h = _rms(x, w["ln1"], cfg.rms_norm_eps)
-    qp, kp, vp = _qkv_proj(w, h, nh, kvh, hd)
+    qp, kp, vp = _qkv_proj(w, h, nh, kvh, hd, lora, aidx, li)
     q = qp.reshape(b, s, nh, hd)
     k = kp.reshape(b, s, kvh, hd)
     v = vp.reshape(b, s, kvh, hd)
@@ -1027,9 +1185,15 @@ def _prefill_layer_cached(w, x, kpre, vpre, cos_s, sin_s, mask, cfg):
     vcat = jnp.concatenate([vpre.astype(v.dtype), v], axis=1)
     attn = sdpa(q, kcat, vcat, attn_mask=mask,
                 is_causal=False).reshape(b, s, nh * hd)
-    x = x + _mm(attn, w["o"])
+    o = _mm(attn, w["o"])
+    # `lora` pytree structure (empty tuple = off) is trace-time static
+    # tpu-lint: disable=jit-traced-branch
+    if lora:
+        from ...ops.pallas.lora_matmul import lora_delta
+        o = o + lora_delta(lora, "o", li, attn, aidx)
+    x = x + o
     h = _rms(x, w["ln2"], cfg.rms_norm_eps)
-    return (x + _ffn(w, h), k, v)
+    return (x + _ffn(w, h, lora, aidx, li), k, v)
 
 
 def _logits_of(state, h):

@@ -46,7 +46,7 @@ class Request:
     def __init__(self, prompt, gen: GenerationConfig | None = None, *,
                  deadline: float | None = None, on_token=None,
                  arrival_time: float | None = None, priority: int = 0,
-                 tenant: str | None = None):
+                 tenant: str | None = None, adapter: str | None = None):
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
@@ -86,6 +86,11 @@ class Request:
         # Billing tenant (HTTP X-Tenant header / body field / submit
         # kwarg; "" and None canonicalize to "anon").
         self.tenant = str(tenant).strip() if tenant else "anon"
+        # LoRA adapter id (HTTP X-Adapter header / body field / submit
+        # kwarg; None = dense base model).  Resolved to a bank row by
+        # the engine's AdapterStore at submit; the row is re-acquired on
+        # preemption resume so the id, not the row, is durable state.
+        self.adapter = str(adapter).strip() if adapter else None
         self.queue_seconds = 0.0          # admission + resume re-queues
         self.prefill_computed_tokens = 0  # prompt tokens run on device
         self.prefill_cached_tokens = 0    # skipped via prefix cache/CoW

@@ -9,7 +9,9 @@ The scaling layer above ``server.py``: N independent engine replicas
     scale-out (a shared system prompt's KV pages stay hot on ONE
     replica instead of being rebuilt on all of them).  Prompts shorter
     than a page, or whose affinity target is down, fall back to the
-    least-loaded replica.
+    least-loaded replica.  Requests naming a LoRA adapter salt the
+    rendezvous key with the adapter name, so each adapter's traffic
+    concentrates where its bank row is already resident.
   * **Health probing + circuit breaking** — a prober hits each
     replica's ``/healthz``; ``fail_threshold`` consecutive failures
     open the circuit (replica leaves rotation), and the replica is
@@ -147,13 +149,22 @@ class Router:
         self.failovers = 0          # mirror of router_failovers_total
 
     # ------------------------------------------------------- selection
-    def _affinity_key(self, prompt) -> bytes | None:
+    def _affinity_key(self, prompt, adapter: str | None = None) \
+            -> bytes | None:
+        """Rendezvous key: the prompt's page-aligned leading chunk,
+        salted with the adapter name when one is set — adapter traffic
+        sticks to one replica (its bank row stays loaded there), and
+        two adapters over the same shared prompt can land on different
+        replicas instead of thrashing one bank.  Dense requests keep
+        the exact pre-LoRA key bytes."""
         ids = np.asarray(prompt, np.int32).reshape(-1)
         aligned = (ids.size // self.page_size) * self.page_size
         take = min(aligned, self.affinity_pages * self.page_size)
-        if take <= 0:
+        chunk = ids[:take].tobytes() if take > 0 else b""
+        if not chunk and adapter is None:
             return None
-        return hashlib.sha1(ids[:take].tobytes()).digest()
+        tag = b"" if adapter is None else adapter.encode() + b"\x00"
+        return hashlib.sha1(tag + chunk).digest()
 
     @staticmethod
     def _rendezvous_score(key: bytes, address: str) -> int:
@@ -174,9 +185,11 @@ class Router:
                          and float(kw.get("temperature") or 0.0) > 0.0)
         return (not do_sample) or (kw.get("seed") is not None)
 
-    def pick(self, prompt, exclude=()) -> Replica:
-        """Choose a replica for this prompt.  Raises
-        :class:`NoReplicaAvailable` when nothing is in rotation."""
+    def pick(self, prompt, exclude=(),
+             adapter: str | None = None) -> Replica:
+        """Choose a replica for this prompt (and adapter, when the
+        request names one).  Raises :class:`NoReplicaAvailable` when
+        nothing is in rotation."""
         now = self._clock()
         with self._lock:
             avail = [r for r in self.replicas
@@ -188,7 +201,7 @@ class Router:
                                 f"(fails={r.fails}, "
                                 f"excluded={r in exclude})"
                                 for r in self.replicas))
-            key = self._affinity_key(prompt)
+            key = self._affinity_key(prompt, adapter)
             if key is not None:
                 # rendezvous over the FULL replica set (stable as
                 # replicas flap), honored only while the winner is up
@@ -285,9 +298,10 @@ class Router:
     def _completion_traced(self, span, prompt, *, stream, **kw):
         tried: list[Replica] = []
         last_exc: BaseException | None = None
+        adapter = kw.get("adapter")
         for attempt in range(self.max_retries + 1):
             try:
-                rep = self.pick(prompt, exclude=tried)
+                rep = self.pick(prompt, exclude=tried, adapter=adapter)
             except NoReplicaAvailable:
                 if last_exc is None:
                     raise
@@ -408,7 +422,8 @@ class Router:
                 while failovers_left > 0 and not switched:
                     failovers_left -= 1
                     try:
-                        nxt = self.pick(resume_prompt, exclude=tried)
+                        nxt = self.pick(resume_prompt, exclude=tried,
+                                        adapter=kw.get("adapter"))
                     except NoReplicaAvailable:
                         break
                     client = ServingClient(
@@ -451,13 +466,19 @@ class Router:
         return hashlib.sha1(
             ids[:self.page_size].tobytes()).hexdigest()[:16]
 
-    def prefix_hit_estimate(self, prompt) -> dict:
-        """Per-replica expected-prefix-hit-rate estimate for a prompt:
-        1.0 when the prompt's root chunk digest appears in the
-        replica's published prefix digest, else the replica's observed
-        hit rate as a prior (0.0 with no summary).  This is the routing
-        signal cluster-scale KV scheduling consumes; estimates are also
-        recorded on ``router_expected_prefix_hit_rate{replica}``."""
+    def prefix_hit_estimate(self, prompt,
+                            adapter: str | None = None) -> dict:
+        """Per-replica expected-hit-rate estimate for a prompt: 1.0
+        when the prompt's root chunk digest appears in the replica's
+        published prefix digest, else the replica's observed hit rate
+        as a prior (0.0 with no summary).  When the request names an
+        ``adapter``, the estimate blends in adapter-bank residency
+        (the replica's fleet summary publishes its resident adapter
+        names): a replica that would have to LRU-load the adapter
+        before serving averages its prefix estimate with 0.  This is
+        the routing signal cluster-scale KV scheduling consumes;
+        estimates are also recorded on
+        ``router_expected_prefix_hit_rate{replica}``."""
         digest = self._root_chunk_digest(prompt)
         out = {}
         for rep in self.replicas:
@@ -469,6 +490,10 @@ class Router:
                 est = 1.0
             else:
                 est = float(prefix.get("hit_rate") or 0.0)
+            if adapter is not None:
+                resident = ((rep.fleet or {}).get("adapters")
+                            or {}).get("resident") or []
+                est = (est + (1.0 if adapter in resident else 0.0)) / 2.0
             out[rep.address] = round(est, 6)
             _M_EXPECTED_HIT.labels(rep.address).set(est)
         return out
@@ -763,16 +788,22 @@ class _RouterHandler(BaseHTTPRequestHandler):
         upstream_headers = {
             "Content-Type": "application/json",
             "traceparent": _obs.format_traceparent(span.context)}
-        # gateway tags ride through to the replica (priority class and
-        # usage-meter billing tenant)
-        for key in ("X-Priority", "X-Tenant"):
+        # gateway tags ride through to the replica (priority class,
+        # usage-meter billing tenant, LoRA adapter selection)
+        for key in ("X-Priority", "X-Tenant", "X-Adapter"):
             if self.headers.get(key):
                 upstream_headers[key] = self.headers[key]
+        # the adapter influences routing too (affinity-keyed so a
+        # tenant's adapter stays loaded on one replica); header wins
+        # over the body field, matching the replica's precedence
+        adapter = (self.headers.get("X-Adapter") or "").strip() \
+            or (str(body.get("adapter")).strip()
+                if body.get("adapter") else None) or None
         tried: list[Replica] = []
         last_exc: BaseException | None = None
         for attempt in range(router.max_retries + 1):
             try:
-                rep = router.pick(prompt, exclude=tried)
+                rep = router.pick(prompt, exclude=tried, adapter=adapter)
             except NoReplicaAvailable as e:
                 span.set_attribute("status", 503)
                 return self._json(
@@ -949,7 +980,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
                     failovers_left -= 1
                     try:
                         nxt = router.pick(resume["prompt"],
-                                          exclude=tried)
+                                          exclude=tried,
+                                          adapter=resume.get("adapter"))
                     except NoReplicaAvailable:
                         break
                     host, _, port = nxt.address.rpartition(":")

@@ -13,6 +13,10 @@ service with zero new dependencies (stdlib ``http.server`` only):
     answers ``429`` with a ``Retry-After`` header (backpressure is a
     protocol answer, never a hang or a 500); while draining it answers
     ``503``.
+  * ``POST /v1/batches`` — the offline lane: a JSONL job (inline
+    records or a server-side file) drip-fed at the ``"batch"``
+    priority class, preempted by interactive traffic, with
+    ``GET /v1/batches/<id>`` progress and a JSONL output file.
   * ``GET /healthz`` (engine stats + drain state), ``GET /metrics``
     (the observability registry's Prometheus export),
     ``GET /debug/resources`` (resource-tracker snapshot + engine pool
@@ -48,6 +52,7 @@ from .. import observability as _obs
 from ..flags import FLAGS
 from ..sanitizer import make_condition, make_rlock
 from .engine import Engine
+from .lora.batch import BATCH_PRIORITY, BatchJob
 from .request import GenerationConfig, Request
 from .supervisor import EngineSupervisor
 from .watchdog import Watchdog
@@ -75,8 +80,11 @@ _M_SLO_SHED = _obs.counter(
     "classes <= FLAGS_serving_shed_max_priority are shed)", ("class",))
 
 # wire-level priority classes <-> scheduler integers; arbitrary ints
-# are also accepted in request bodies for finer-grained fleets
-_PRIORITY_NAMES = {"low": -1, "normal": 0, "high": 1}
+# are also accepted in request bodies for finer-grained fleets.
+# "batch" is the offline lane: below every interactive class, so batch
+# residents lose every admission race and preempt first.
+_PRIORITY_NAMES = {"low": -1, "normal": 0, "high": 1,
+                   "batch": BATCH_PRIORITY}
 _PRIORITY_CLASS = {v: k for k, v in _PRIORITY_NAMES.items()}
 
 
@@ -126,6 +134,14 @@ class EngineWorker:
         self._idle_wait = float(idle_wait)
         # recent Request objects, newest last (introspection + tests)
         self.requests: deque[Request] = deque(maxlen=512)
+        # offline batch jobs by id: pumped by the worker thread between
+        # steps, introspected by GET /v1/batches/<id>
+        self.batches: dict[str, BatchJob] = {}
+        # take over the engine's lora.json provider slot so the dump
+        # also carries batch-job progress (engine registers itself at
+        # construction; the worker wraps it — last writer wins)
+        if engine.lora is not None:
+            _obs.set_active_lora(self)
         # burn-rate sheds by priority class (mirror of
         # serving_slo_shed_total; /debug/fleet's scheduling block)
         self.shed_by_class: dict[str, int] = {}
@@ -159,6 +175,14 @@ class EngineWorker:
                     # freezes, which is exactly the watchdog's trigger
                     self._wake.wait(min(self._stall_until - now, 0.05))
                     continue
+                # the offline lane: top every live job's window back up
+                # before stepping — batch submissions land at
+                # BATCH_PRIORITY, so interactive arrivals still win the
+                # admission race inside the scheduler pass
+                if self.batches and not self.engine.scheduler.draining:
+                    for job in list(self.batches.values()):
+                        if not job.done:
+                            job.pump(self.engine.submit)
                 if not self.engine.scheduler.has_work():
                     self._wake.wait(self._idle_wait)
                     continue
@@ -181,7 +205,8 @@ class EngineWorker:
     def submit(self, prompt, gen: GenerationConfig | None = None, *,
                timeout_s: float | None = None, on_token=None,
                trace=None, priority: int = 0,
-               tenant: str | None = None) -> Request:
+               tenant: str | None = None,
+               adapter: str | None = None) -> Request:
         """Thread-safe admission with backpressure: raises
         :class:`DrainingError` / :class:`BackpressureError` instead of
         queueing unboundedly; ``timeout_s`` becomes an absolute engine
@@ -195,7 +220,9 @@ class EngineWorker:
         ``tenant`` is the usage-meter billing dimension; with
         ``FLAGS_serving_fair_share`` set and a meter wired, burn-rate
         shedding only refuses the heaviest-page-second tenant's
-        requests within the shedable classes."""
+        requests within the shedable classes.  ``adapter`` names a
+        registered LoRA adapter (unknown names reject with 400 at the
+        HTTP layer via the engine's KeyError)."""
         priority = int(priority)
         with self._wake:
             if self.engine.scheduler.draining:
@@ -229,10 +256,36 @@ class EngineWorker:
                         else self.engine._clock() + float(timeout_s))
             req = self.engine.submit(prompt, gen, deadline=deadline,
                                      on_token=on_token, trace=trace,
-                                     priority=priority, tenant=tenant)
+                                     priority=priority, tenant=tenant,
+                                     adapter=adapter)
             self.requests.append(req)
             self._wake.notify_all()
         return req
+
+    def submit_batch(self, job: BatchJob) -> BatchJob:
+        """Register an offline batch job: the worker thread drip-feeds
+        its records at BATCH_PRIORITY between engine steps (first
+        window tops up at the next loop iteration)."""
+        with self._wake:
+            if self.engine.scheduler.draining:
+                raise DrainingError(
+                    "server is draining; not accepting batch jobs")
+            self.batches[job.id] = job
+            # batch lane works on dense engines too — make sure the
+            # lora.json provider is wired so the dump carries the jobs
+            _obs.set_active_lora(self)
+            self._wake.notify_all()
+        return job
+
+    def lora_snapshot(self) -> dict:
+        """``lora.json`` provider: the engine's adapter census plus
+        every offline batch job's progress (the engine alone cannot
+        see the jobs — they live on the worker)."""
+        snap = self.engine.lora_snapshot()
+        with self._wake:
+            snap["batch_jobs"] = {jid: j.progress()
+                                  for jid, j in self.batches.items()}
+        return snap
 
     def _should_shed(self, tenant) -> bool:
         """Fair-share gate for burn-rate shedding: with
@@ -327,9 +380,21 @@ def _parse_tenant(value) -> str | None:
     return value.strip() or None
 
 
+def _parse_adapter(value) -> str | None:
+    """LoRA adapter name from a body field or the X-Adapter header:
+    any non-empty string (whitespace-stripped); None / "" mean the
+    dense base model."""
+    if value is None:
+        return None
+    if not isinstance(value, str):
+        raise ValueError(
+            f"invalid 'adapter' {value!r}: must be a string")
+    return value.strip() or None
+
+
 def _parse_completion(body: dict):
     """Validate a /v1/completions body -> (prompt, gen, stream,
-    timeout_s, priority, tenant).  Raises ValueError with a
+    timeout_s, priority, tenant, adapter).  Raises ValueError with a
     client-facing message."""
     if not isinstance(body, dict):
         raise ValueError("request body must be a JSON object")
@@ -364,8 +429,9 @@ def _parse_completion(body: dict):
             raise ValueError("'timeout' must be > 0 seconds")
     priority = _parse_priority(body.get("priority", 0))
     tenant = _parse_tenant(body.get("tenant"))
+    adapter = _parse_adapter(body.get("adapter"))
     return prompt, gen, bool(body.get("stream", False)), timeout_s, \
-        priority, tenant
+        priority, tenant, adapter
 
 
 _FINISH_REASON = {"length": "length", "eos": "stop",
@@ -389,7 +455,10 @@ def _usage_json(req: Request) -> dict:
             "total_tokens": plen + req.num_generated,
             "prompt_tokens_cached": req.num_cached_tokens,
             "queue_ms": round(req.queue_seconds * 1e3, 3),
-            "spec_accepted_tokens": req.spec_accepted_tokens}
+            "spec_accepted_tokens": req.spec_accepted_tokens,
+            # adapter label only when one served the request, so dense
+            # responses keep their exact pre-LoRA shape
+            **({"adapter": req.adapter} if req.adapter else {})}
 
 
 def _completion_json(model_name: str, req: Request) -> dict:
@@ -610,6 +679,13 @@ class ServingServer(ThreadingHTTPServer):
                           "shed_by_class": dict(worker.shed_by_class)}
             usage = (eng.usage.snapshot()
                      if eng.usage is not None else None)
+            # adapter residency census: the router folds this into its
+            # expected-hit-rate score so adapter traffic sticks to
+            # replicas already holding the weights
+            adapters = (eng.lora_snapshot()
+                        if eng.lora is not None else None)
+            batches = {jid: j.progress()
+                       for jid, j in worker.batches.items()}
             draining = eng.scheduler.draining
         # raw cumulative latency buckets, not quantiles: consumers
         # (dashboard, router) merge buckets ACROSS replicas and then
@@ -632,7 +708,8 @@ class ServingServer(ThreadingHTTPServer):
                 "pool": pool, "prefix": prefix, "slots": slots,
                 "queue": queue, "slo": slo, "spec": spec,
                 "recovery": recovery, "scheduling": scheduling,
-                "usage": usage, "latency": latency,
+                "usage": usage, "adapters": adapters,
+                "batches": batches, "latency": latency,
                 "watchdog": self.watchdog.state(),
                 "alerts": ({"firing": ts.firing(),
                             "fired_total": ts.alerts_fired,
@@ -769,6 +846,22 @@ class _Handler(BaseHTTPRequestHandler):
                     snap = meter.snapshot()
                 self._json(200, dict(snap, kind="replica"),
                            "/debug/usage")
+        elif self.path == "/v1/batches":
+            worker = self.server.worker
+            with worker.lock:
+                jobs = {jid: j.progress()
+                        for jid, j in worker.batches.items()}
+            self._json(200, {"jobs": jobs}, "/v1/batches")
+        elif self.path.startswith("/v1/batches/"):
+            jid = self.path[len("/v1/batches/"):]
+            worker = self.server.worker
+            with worker.lock:
+                job = worker.batches.get(jid)
+                prog = job.progress() if job is not None else None
+            if prog is None:
+                self._error(404, f"no batch job {jid!r}", "/v1/batches")
+            else:
+                self._json(200, prog, "/v1/batches")
         elif self.path in ("/debug", "/debug/"):
             self._json(200, {"endpoints": _DEBUG_INDEX}, "/debug/")
         else:
@@ -828,6 +921,8 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         if self.path == "/v1/completions":
             self._completions()
+        elif self.path == "/v1/batches":
+            self._batches()
         elif self.path == "/drain":
             try:
                 body = self._read_body()
@@ -840,6 +935,53 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(200, {"resumed": True}, "/resume")
         else:
             self._error(404, f"no route {self.path}", self.path)
+
+    # ----------------------------------------------------------- batches
+    def _batches(self):
+        """``POST /v1/batches``: start an offline batch job.  Body:
+        ``{"records": [{"prompt": [ids], ...}, ...]}`` for inline
+        records or ``{"input_path": "file.jsonl"}`` for a server-side
+        JSONL file; optional ``window`` / ``max_tokens`` / ``tenant`` /
+        ``adapter`` / ``output_path``.  The job drip-feeds at the
+        "batch" priority class (below every interactive name) and
+        ``GET /v1/batches/<id>`` reports progress."""
+        route = "/v1/batches"
+        try:
+            body = self._read_body()
+        except (ValueError, json.JSONDecodeError):
+            return self._error(400, "invalid JSON body", route)
+        try:
+            if not isinstance(body, dict):
+                raise ValueError("request body must be a JSON object")
+            kw = {"window": int(body.get("window", 2)),
+                  "max_tokens": int(body.get("max_tokens", 16)),
+                  "tenant": _parse_tenant(body.get("tenant")),
+                  "adapter": _parse_adapter(body.get("adapter"))}
+            if body.get("output_path") is not None:
+                kw["output_path"] = str(body["output_path"])
+            path = body.get("input_path")
+            if path is not None:
+                job = BatchJob.from_jsonl(str(path), **kw)
+            elif isinstance(body.get("records"), list):
+                job = BatchJob(body["records"], **kw)
+            else:
+                raise ValueError(
+                    "pass 'records' (a list of {'prompt': [ids]} "
+                    "objects) or 'input_path' (a server-side JSONL "
+                    "file)")
+        except OSError as e:
+            return self._error(400, f"cannot read input_path: {e}",
+                               route)
+        except (ValueError, TypeError) as e:
+            return self._error(400, str(e), route)
+        try:
+            self.server.worker.submit_batch(job)
+        except DrainingError as e:
+            return self._error(503, str(e), route,
+                               etype="overloaded_error")
+        _obs.flight("server", "batch_submit", job=job.id,
+                    records=len(job.records))
+        self._json(200, job.progress(), route)
 
     # ------------------------------------------------------- completions
     def _completions(self):
@@ -873,17 +1015,20 @@ class _Handler(BaseHTTPRequestHandler):
             span.set_attribute("status", 400)
             return self._error(400, "invalid JSON body", route)
         try:
-            prompt, gen, stream, timeout_s, priority, tenant = \
+            prompt, gen, stream, timeout_s, priority, tenant, adapter = \
                 _parse_completion(body)
-            # the X-Priority / X-Tenant headers override the body
-            # (gateways tag traffic classes and billing dimensions
-            # without rewriting payloads)
+            # the X-Priority / X-Tenant / X-Adapter headers override
+            # the body (gateways tag traffic classes, billing
+            # dimensions, and adapter routes without rewriting payloads)
             hdr = self.headers.get("X-Priority")
             if hdr is not None:
                 priority = _parse_priority(hdr)
             hdr = self.headers.get("X-Tenant")
             if hdr is not None:
                 tenant = _parse_tenant(hdr) or tenant
+            hdr = self.headers.get("X-Adapter")
+            if hdr is not None:
+                adapter = _parse_adapter(hdr) or adapter
         except (ValueError, TypeError) as e:
             _M_HTTP_REJECT.labels("invalid").inc()
             span.set_attribute("status", 400)
@@ -893,12 +1038,14 @@ class _Handler(BaseHTTPRequestHandler):
             span.set_attribute("priority", priority)
         if tenant:
             span.set_attribute("tenant", tenant)
+        if adapter:
+            span.set_attribute("adapter", adapter)
 
         toks: queue.Queue = queue.Queue()
         try:
             req = self.server.worker.submit(
                 prompt, gen, timeout_s=timeout_s, trace=span.context,
-                priority=priority, tenant=tenant,
+                priority=priority, tenant=tenant, adapter=adapter,
                 on_token=lambda r, t: toks.put(int(t)))
         except DrainingError as e:
             _M_HTTP_REJECT.labels("draining").inc()
@@ -912,10 +1059,14 @@ class _Handler(BaseHTTPRequestHandler):
             return self._error(
                 429, str(e), route, etype="overloaded_error",
                 headers=[("Retry-After", f"{self.server.retry_after_s:g}")])
-        except (ValueError, TypeError) as e:   # engine-side validation
+        except (ValueError, TypeError, KeyError) as e:
+            # engine-side validation; KeyError is an unknown adapter
+            # name from the AdapterStore
             _M_HTTP_REJECT.labels("invalid").inc()
             span.set_attribute("status", 400)
-            return self._error(400, str(e), route)
+            msg = e.args[0] if isinstance(e, KeyError) and e.args \
+                else str(e)
+            return self._error(400, str(msg), route)
         span.set_attribute("req", req.id)
 
         hard_deadline = t0 + (timeout_s or self.server.hard_timeout_s) \

@@ -1,0 +1,617 @@
+"""Multi-LoRA serving + offline batch lane (ISSUE 19).
+
+The acceptance contracts asserted here:
+  * greedy adapter outputs are token-for-token identical to a dense
+    engine running the merged checkpoint ``W + (alpha/r) A^T B`` —
+    across tp{1,2}, prefix-cache on/off, and mixed batches where
+    different adapters (and dense requests) share ONE decode step;
+  * the AdapterStore validates loudly, LRU-parks idle residents on
+    host without losing them, and pins a live request's bank row so
+    preempt->spill->resume keeps token-for-token parity;
+  * ``lora=None`` / the unused-store control change nothing (same
+    tokens, one decode trace — the perf gate pins the jaxpr-level
+    zero deltas);
+  * the HTTP layer carries ``adapter`` in the body with ``X-Adapter``
+    winning, and ``POST /v1/batches`` runs a JSONL job at the lowest
+    priority without displacing interactive traffic;
+  * the router salts its rendezvous key per adapter (dense keys are
+    byte-identical to the pre-LoRA scheme) and blends bank residency
+    into the expected-hit estimate.
+
+XLA_FLAGS is set HERE (not only in conftest) so the tp=2 cases are
+self-contained, as long as this runs before jax initializes backends.
+"""
+import hashlib
+import json
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+from paddle_tpu.serving import (AdapterStore, BATCH_PRIORITY, BatchJob,
+                                GenerationConfig, Router, ServingClient,
+                                ServingHTTPError, merge_adapter,
+                                random_adapter, serve)
+from paddle_tpu.serving.engine import Engine
+from paddle_tpu.serving.lora.store import lora_key_dims
+
+needs_mesh = pytest.mark.skipif(
+    jax.device_count() < 2,
+    reason="needs >=2 local devices "
+           "(XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PAGE = 8
+RANK = 4
+ALPHA = 8.0
+
+
+def _load_tool(name):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def cfg_state():
+    # 2 layers / 4 heads / 2 KV heads: everything divisible by tp=2,
+    # fast enough for the merged-reference engines this file builds
+    paddle.seed(11)
+    cfg = llama_tiny(vocab_size=64, hidden_size=32,
+                     intermediate_size=64, num_hidden_layers=2,
+                     num_attention_heads=4, num_key_value_heads=2,
+                     max_position_embeddings=128)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    from paddle_tpu.framework.tensor import Tensor
+    state = {k: (v._data if isinstance(v, Tensor) else v)
+             for k, v in model.functional_state().items()}
+    return cfg, state
+
+
+@pytest.fixture(scope="module")
+def adapters(cfg_state):
+    cfg, _ = cfg_state
+    return {"alpha": random_adapter(cfg, RANK, seed=7),
+            "beta": random_adapter(cfg, RANK, seed=8)}
+
+
+def _store(cfg, adapters, capacity=2):
+    store = AdapterStore(cfg, capacity=capacity)
+    for name, w in adapters.items():
+        store.register(name, w, alpha=ALPHA)
+    return store
+
+
+def _engine(cfg, state, **kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("page_size", PAGE)
+    return Engine(config=cfg, state=dict(state), **kw)
+
+
+def _run(eng, prompt, n=8, adapter=None, priority=0):
+    req = eng.submit(list(prompt), GenerationConfig(max_new_tokens=n),
+                     adapter=adapter, priority=priority)
+    eng.run_until_complete(max_steps=600)
+    assert req.finish_reason == "length"
+    return list(req.output_tokens)
+
+
+@pytest.fixture(scope="module")
+def reference_tokens(cfg_state, adapters):
+    """Greedy ground truth on [1,2,3,4]: the dense engine and one
+    merged-checkpoint engine per adapter (what the bank path must
+    reproduce token-for-token)."""
+    cfg, state = cfg_state
+    out = {"dense": _run(_engine(cfg, state), [1, 2, 3, 4])}
+    for name, w in adapters.items():
+        merged = merge_adapter(state, cfg, w, alpha=ALPHA)
+        out[name] = _run(_engine(cfg, merged), [1, 2, 3, 4])
+    assert out["alpha"] != out["dense"] != out["beta"]
+    assert out["alpha"] != out["beta"]
+    return out
+
+
+# ===================================================== AdapterStore units
+class TestAdapterStore:
+    def test_register_rejects_missing_and_extra_keys(self, cfg_state,
+                                                     adapters):
+        cfg, _ = cfg_state
+        store = AdapterStore(cfg)
+        broken = dict(adapters["alpha"])
+        broken["bogus"] = broken.pop("down")
+        with pytest.raises(ValueError, match="missing.*down"):
+            store.register("x", broken)
+
+    def test_register_rejects_wrong_layer_count(self, cfg_state,
+                                                adapters):
+        cfg, _ = cfg_state
+        store = AdapterStore(cfg)
+        broken = {k: (a[:1], b) for k, (a, b)
+                  in adapters["alpha"].items()}
+        with pytest.raises(ValueError, match="A shape"):
+            store.register("x", broken)
+
+    def test_register_rejects_rank_mismatch(self, cfg_state, adapters):
+        cfg, _ = cfg_state
+        store = AdapterStore(cfg)
+        store.register("a4", adapters["alpha"], alpha=ALPHA)
+        with pytest.raises(ValueError, match="rank 2 != store rank 4"):
+            store.register("a2", random_adapter(cfg, 2, seed=3))
+
+    def test_register_rejects_bad_alpha_and_name(self, cfg_state,
+                                                 adapters):
+        cfg, _ = cfg_state
+        store = AdapterStore(cfg)
+        with pytest.raises(ValueError, match="alpha"):
+            store.register("x", adapters["alpha"], alpha=0.0)
+        with pytest.raises(ValueError, match="non-empty"):
+            store.register("  ", adapters["alpha"])
+
+    def test_register_rejects_non_floating(self, cfg_state):
+        cfg, _ = cfg_state
+        store = AdapterStore(cfg)
+        L = cfg.num_hidden_layers
+        ints = {k: (np.ones((L, RANK, ind), np.int32),
+                    np.ones((L, RANK, outd), np.int32))
+                for k, (ind, outd) in lora_key_dims(cfg).items()}
+        with pytest.raises(ValueError, match="floating"):
+            store.register("x", ints)
+
+    def test_acquire_unknown_is_keyerror(self, cfg_state, adapters):
+        cfg, _ = cfg_state
+        store = _store(cfg, adapters)
+        with pytest.raises(KeyError, match="unknown adapter"):
+            store.acquire("nope")
+
+    def test_lru_eviction_parks_and_restores(self, cfg_state, adapters):
+        cfg, _ = cfg_state
+        store = _store(cfg, adapters, capacity=1)
+        assert store.acquire("alpha") == 1
+        store.release("alpha")
+        # idle resident is the victim; parking keeps the host copy
+        assert store.acquire("beta") == 1
+        snap = store.snapshot()
+        assert snap["resident"] == ["beta"]
+        assert snap["parked"] == ["alpha"]
+        assert snap["loads"] == 2 and snap["evictions"] == 1
+        store.release("beta")
+        assert store.acquire("alpha") == 1   # reloads from the parking
+        assert store.loads == 3
+
+    def test_pinned_rows_never_evict(self, cfg_state, adapters):
+        cfg, _ = cfg_state
+        store = _store(cfg, adapters, capacity=1)
+        store.acquire("alpha")               # pinned by a live request
+        with pytest.raises(RuntimeError, match="pinned"):
+            store.acquire("beta")
+        store.release("alpha")
+        assert store.acquire("beta") == 1    # evictable once idle
+
+    def test_release_without_acquire_raises(self, cfg_state, adapters):
+        cfg, _ = cfg_state
+        store = _store(cfg, adapters)
+        with pytest.raises(RuntimeError, match="without a matching"):
+            store.release("alpha")
+        store.release(None)                  # the no-adapter row is free
+
+    def test_snapshot_and_bank_bytes(self, cfg_state, adapters):
+        cfg, _ = cfg_state
+        store = _store(cfg, adapters, capacity=3)
+        store.acquire("alpha")
+        snap = store.snapshot()
+        assert snap["capacity"] == 3 and snap["rank"] == RANK
+        assert snap["registered"] == ["alpha", "beta"]
+        assert snap["pinned"] == {"alpha": 1}
+        assert snap["requests"]["alpha"] == 1
+        # (capacity + 1 zero row) x layers x rank x sum(in + out) f32
+        per_row = sum(i + o for i, o in lora_key_dims(cfg).values())
+        assert snap["bank_bytes"] == (
+            cfg.num_hidden_layers * 4 * RANK * per_row * 4 + 4 * 4)
+
+
+# ================================================== engine greedy parity
+class TestEngineParity:
+    def test_adapter_matches_merged_checkpoint(self, cfg_state, adapters,
+                                               reference_tokens):
+        cfg, state = cfg_state
+        store = _store(cfg, adapters)
+        eng = _engine(cfg, state, lora=store)
+        assert _run(eng, [1, 2, 3, 4],
+                    adapter="alpha") == reference_tokens["alpha"]
+        assert _run(eng, [1, 2, 3, 4],
+                    adapter="beta") == reference_tokens["beta"]
+        # row 0 (no adapter) through the SAME bank-armed programs
+        assert _run(eng, [1, 2, 3, 4]) == reference_tokens["dense"]
+        assert eng.decode_traces == 1
+        assert store.loads >= 2
+        assert eng.lora_snapshot()["bank_bytes_device"] > 0
+
+    def test_parity_with_prefix_cache(self, cfg_state, adapters,
+                                      reference_tokens):
+        cfg, state = cfg_state
+        eng = _engine(cfg, state, lora=_store(cfg, adapters),
+                      enable_prefix_cache=True)
+        first = _run(eng, list(range(1, 1 + 2 * PAGE)), adapter="alpha")
+        # second identical prompt rides cached KV pages; the adapter
+        # correction must not depend on who prefilled them
+        assert _run(eng, list(range(1, 1 + 2 * PAGE)),
+                    adapter="alpha") == first
+        assert _run(eng, [1, 2, 3, 4],
+                    adapter="alpha") == reference_tokens["alpha"]
+        assert eng.decode_traces == 1
+
+    @needs_mesh
+    def test_parity_tp2(self, cfg_state, adapters):
+        cfg, state = cfg_state
+        merged = merge_adapter(state, cfg, adapters["alpha"],
+                               alpha=ALPHA)
+        ref = _run(_engine(cfg, merged, mesh=2), [1, 2, 3, 4])
+        eng = _engine(cfg, state, lora=_store(cfg, adapters), mesh=2)
+        assert _run(eng, [1, 2, 3, 4], adapter="alpha") == ref
+        assert _run(eng, [1, 2, 3, 4], adapter=None) == \
+            _run(_engine(cfg, state, mesh=2), [1, 2, 3, 4])
+        assert eng.decode_traces == 1
+
+    def test_composes_with_int8_weights(self, cfg_state, adapters):
+        """The correction applies to the dequantized base matmul: the
+        no-adapter row through a quantized bank-armed engine stays
+        exactly the quantized dense output, and a named adapter moves
+        it."""
+        cfg, state = cfg_state
+        quant_dense = _run(_engine(cfg, state, quant="int8"),
+                           [1, 2, 3, 4])
+        eng = _engine(cfg, state, quant="int8",
+                      lora=_store(cfg, adapters))
+        assert _run(eng, [1, 2, 3, 4]) == quant_dense
+        assert _run(eng, [1, 2, 3, 4], adapter="alpha") != quant_dense
+        assert eng.decode_traces == 1
+
+    def test_mixed_batch_one_trace(self, cfg_state, adapters,
+                                   reference_tokens):
+        cfg, state = cfg_state
+        eng = _engine(cfg, state, lora=_store(cfg, adapters))
+        reqs = [eng.submit([1, 2, 3, 4],
+                           GenerationConfig(max_new_tokens=8),
+                           adapter=ad)
+                for ad in ("alpha", "beta", None)]
+        eng.run_until_complete(max_steps=600)
+        got = [list(r.output_tokens) for r in reqs]
+        assert got == [reference_tokens["alpha"],
+                       reference_tokens["beta"],
+                       reference_tokens["dense"]]
+        assert eng.decode_traces == 1
+
+    def test_armed_but_unused_store_changes_nothing(self, cfg_state,
+                                                    adapters,
+                                                    reference_tokens):
+        cfg, state = cfg_state
+        store = _store(cfg, adapters)
+        eng = _engine(cfg, state, lora=store)
+        assert _run(eng, [1, 2, 3, 4]) == reference_tokens["dense"]
+        assert eng.decode_traces == 1
+        assert store.loads == 0 and store.snapshot()["resident"] == []
+
+    def test_preempt_spill_resume_parity(self, cfg_state, adapters):
+        """An adapter request preempted to the host KV tier resumes
+        token-for-token: the bank row stays pinned (never evicted
+        under the parked request)."""
+        cfg, state = cfg_state
+        ref = _engine(cfg, state, lora=_store(cfg, adapters),
+                      max_slots=3)
+        ref_reqs = [ref.submit(p, GenerationConfig(max_new_tokens=8),
+                               adapter=a)
+                    for p, a in (([1, 2, 3, 4, 5, 6], "alpha"),
+                                 ([3, 4, 5, 6, 7, 8], "alpha"),
+                                 ([5, 6, 7, 8, 9, 10], None))]
+        ref.run_until_complete(max_steps=600)
+
+        store = _store(cfg, adapters)
+        eng = _engine(cfg, state, lora=store, max_slots=2,
+                      preempt=True)
+        lo = [eng.submit(p, GenerationConfig(max_new_tokens=8),
+                         adapter="alpha")
+              for p in ([1, 2, 3, 4, 5, 6], [3, 4, 5, 6, 7, 8])]
+        for _ in range(4):
+            eng.step()
+        # mid-flight the adapter is pinned by both low-priority reqs
+        assert store.snapshot()["pinned"] == {"alpha": 2}
+        hi = eng.submit([5, 6, 7, 8, 9, 10],
+                        GenerationConfig(max_new_tokens=8), priority=1)
+        eng.run_until_complete(max_steps=600)
+        assert eng.preemptions == 1
+        assert sorted(r.preemptions for r in lo + [hi]) == [0, 0, 1]
+        assert [list(r.output_tokens) for r in lo + [hi]] == \
+            [list(r.output_tokens) for r in ref_reqs]
+        assert eng.blocks.pool_accounting()["leak"] == 0
+        assert store.snapshot()["pinned"] == {}
+        assert eng.decode_traces == 1
+
+    def test_submit_rejections_leave_no_pin(self, cfg_state, adapters):
+        cfg, state = cfg_state
+        store = _store(cfg, adapters)
+        eng = _engine(cfg, state, lora=store)
+        with pytest.raises(KeyError, match="unknown adapter"):
+            eng.submit([1, 2], GenerationConfig(max_new_tokens=2),
+                       adapter="nope")
+        assert store.snapshot()["pinned"] == {}
+        dense = _engine(cfg, state)
+        with pytest.raises(ValueError, match="without lora="):
+            dense.submit([1, 2], GenerationConfig(max_new_tokens=2),
+                         adapter="alpha")
+
+    def test_empty_store_needs_rank(self, cfg_state):
+        cfg, state = cfg_state
+        with pytest.raises(ValueError, match="rank"):
+            _engine(cfg, state, lora=AdapterStore(cfg))
+        # explicit rank sizes the bank with zero adapters registered
+        eng = _engine(cfg, state, lora=AdapterStore(cfg, rank=RANK))
+        assert _run(eng, [1, 2, 3, 4], n=4)
+
+
+# ======================================================= offline batches
+class TestBatchLane:
+    def _jsonl(self, tmp_path, records):
+        path = str(tmp_path / "job.jsonl")
+        with open(path, "w") as f:
+            for rec in records:
+                f.write(json.dumps(rec) + "\n")
+        return path
+
+    def test_record_validation(self):
+        with pytest.raises(ValueError, match="no records"):
+            BatchJob([])
+        with pytest.raises(ValueError, match="token ids"):
+            BatchJob([{"prompt": ["a", "b"]}])
+        with pytest.raises(ValueError, match="max_tokens"):
+            BatchJob([{"prompt": [1], "max_tokens": 0}])
+        with pytest.raises(ValueError, match="window"):
+            BatchJob([{"prompt": [1]}], window=0)
+
+    def test_e2e_with_preemption_and_parity(self, cfg_state, adapters,
+                                            reference_tokens, tmp_path):
+        cfg, state = cfg_state
+        path = self._jsonl(tmp_path, [
+            {"prompt": [1, 2, 3, 4], "max_tokens": 6,
+             "adapter": "alpha", "id": f"r{i}"} for i in range(6)])
+        job = BatchJob.from_jsonl(path, window=4)
+        assert job.output_path == path + ".out.jsonl"
+        eng = _engine(cfg, state, lora=_store(cfg, adapters),
+                      max_slots=2, preempt=True)
+        interactive, steps = [], 0
+        while job.pump(eng.submit) or eng.scheduler.has_work():
+            if steps == 3:
+                interactive = [
+                    eng.submit([5, 6, 7],
+                               GenerationConfig(max_new_tokens=4))
+                    for _ in range(4)]
+            eng.step()
+            steps += 1
+            assert steps < 2000
+        prog = job.progress()
+        assert prog["status"] == "completed"
+        assert prog["completed"] == 6 and prog["failed"] == 0
+        # interactive traffic (class 0 > BATCH_PRIORITY) displaced
+        # batch residents and still finished
+        assert BATCH_PRIORITY < 0 < eng.preemptions
+        assert prog["preemptions"] == eng.preemptions
+        assert all(r.finish_reason == "length" for r in interactive)
+        rows = [json.loads(ln) for ln in open(job.output_path)]
+        assert [r["id"] for r in rows] == [f"r{i}" for i in range(6)]
+        # preempted-and-resumed rows are token-for-token the adapter
+        # ground truth
+        assert all(r["tokens"] == reference_tokens["alpha"][:6]
+                   and r["adapter"] == "alpha" for r in rows)
+        assert eng.blocks.pool_accounting()["leak"] == 0
+        assert eng.decode_traces == 1
+
+    def test_bad_record_fails_row_keeps_job(self, cfg_state, adapters):
+        cfg, state = cfg_state
+        eng = _engine(cfg, state, lora=_store(cfg, adapters))
+        job = BatchJob([{"prompt": [1, 2, 3]},
+                        {"prompt": [1, 2], "adapter": "nope"},
+                        {"prompt": [2, 3, 4]}],
+                       max_tokens=4, output_path=None)
+        steps = 0
+        while job.pump(eng.submit) or eng.scheduler.has_work():
+            eng.step()
+            steps += 1
+            assert steps < 500
+        prog = job.progress()
+        assert prog["completed"] == 2 and prog["failed"] == 1
+        assert "nope" in prog["error"]
+
+
+# ============================================================ HTTP layer
+@pytest.fixture(scope="module")
+def lora_server(cfg_state, adapters):
+    cfg, state = cfg_state
+    paddle.seed(11)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    srv = serve(model, max_slots=4, page_size=PAGE, preempt=True,
+                lora=_store(cfg, adapters))
+    yield srv
+    srv.stop(drain_timeout=5.0)
+
+
+class TestHTTP:
+    def _direct(self, srv, prompt, n=6, adapter=None):
+        eng = srv.worker.engine
+        lora = eng.lora
+        ref = _engine(eng.config, eng.state, lora=None)
+        if adapter is not None:
+            host = lora._host[adapter]
+            merged = merge_adapter(
+                eng.state, eng.config,
+                {k: (a, b) for k, (a, b) in host.items()},
+                alpha=lora._alpha[adapter])
+            ref = _engine(eng.config, merged)
+        return _run(ref, prompt, n=n)
+
+    def test_adapter_body_field(self, lora_server):
+        client = ServingClient(lora_server.address)
+        got = client.completion_tokens([1, 2, 3, 4], max_tokens=6,
+                                       adapter="alpha")
+        assert got == self._direct(lora_server, [1, 2, 3, 4],
+                                   adapter="alpha")
+        out = client.completion([1, 2, 3, 4], max_tokens=6,
+                                adapter="alpha")
+        assert out["usage"]["adapter"] == "alpha"
+        # dense responses keep their exact pre-LoRA usage shape
+        dense = client.completion([1, 2, 3, 4], max_tokens=6)
+        assert "adapter" not in dense["usage"]
+
+    def test_header_wins_over_body(self, lora_server):
+        client = ServingClient(lora_server.address)
+        out = client.request(
+            "POST", "/v1/completions",
+            {"prompt": [1, 2, 3, 4], "max_tokens": 6,
+             "adapter": "alpha"},
+            headers={"X-Adapter": "beta"})
+        assert out["usage"]["adapter"] == "beta"
+        assert out["choices"][0]["token_ids"] == \
+            self._direct(lora_server, [1, 2, 3, 4], adapter="beta")
+
+    def test_unknown_adapter_is_400(self, lora_server):
+        client = ServingClient(lora_server.address)
+        with pytest.raises(ServingHTTPError) as ei:
+            client.completion([1, 2, 3], max_tokens=2, adapter="nope")
+        assert ei.value.status == 400
+
+    def test_batches_endpoint(self, lora_server):
+        client = ServingClient(lora_server.address)
+        job = client.submit_batch(
+            records=[{"prompt": [1, 2, 3, 4], "max_tokens": 4}
+                     for _ in range(3)],
+            window=2, adapter="alpha")
+        assert job["total"] == 3
+        deadline = 200
+        while True:
+            prog = client.batch_status(job["id"])
+            if prog["status"] == "completed":
+                break
+            deadline -= 1
+            assert deadline > 0, prog
+            import time
+            time.sleep(0.05)
+        assert prog["completed"] == 3 and prog["failed"] == 0
+        listed = client.request("GET", "/v1/batches")
+        assert job["id"] in listed["jobs"]
+        # the fleet summary publishes the adapter census + jobs (what
+        # the dashboard's adapter line and the router residency
+        # blending consume)
+        fleet = client.request("GET", "/debug/fleet")
+        assert "alpha" in (fleet["adapters"]["resident"]
+                           + fleet["adapters"]["parked"])
+        assert job["id"] in fleet["batches"]
+
+
+# ================================================== router adapter salt
+class TestRouterAffinity:
+    def _router(self, n=3):
+        return Router([f"127.0.0.1:{7000 + i}" for i in range(n)],
+                      page_size=PAGE)
+
+    def test_dense_keys_unchanged_adapter_keys_salted(self):
+        r = self._router()
+        prompt = list(range(PAGE))
+        chunk = np.asarray(prompt, np.int32)[:PAGE].tobytes()
+        # dense requests hash exactly the pre-LoRA way
+        assert r._affinity_key(prompt) == hashlib.sha1(chunk).digest()
+        ka = r._affinity_key(prompt, adapter="a")
+        kb = r._affinity_key(prompt, adapter="b")
+        assert len({r._affinity_key(prompt), ka, kb}) == 3
+        # sub-page prompts have no dense key but DO route by adapter
+        assert r._affinity_key([1, 2, 3]) is None
+        assert r._affinity_key([1, 2, 3], adapter="a") is not None
+        assert r._affinity_key([], adapter="a") is not None
+
+    def test_adapter_stickiness_and_split(self):
+        r = self._router()
+        prompt = list(range(PAGE))
+        picks = {}
+        for name in "abcdefgh":
+            rep = r.pick(prompt, adapter=name)
+            assert r.pick(prompt, adapter=name) is rep   # sticky
+            picks[name] = rep.address
+        # rendezvous spreads adapters over replicas instead of piling
+        # every adapter onto the dense prompt's target
+        assert len(set(picks.values())) >= 2
+
+    def test_prefix_hit_estimate_blends_residency(self):
+        r = self._router(n=2)
+        a, b = r.replicas
+        a.fleet = {"adapters": {"resident": ["sum"]},
+                   "prefix": {"page_size": PAGE, "hit_rate": 0.5,
+                              "roots": []}}
+        b.fleet = {"adapters": {"resident": []},
+                   "prefix": {"page_size": PAGE, "hit_rate": 0.5,
+                              "roots": []}}
+        est = r.prefix_hit_estimate([1, 2, 3], adapter="sum")
+        assert est[a.address] == pytest.approx(0.75)  # (0.5 + 1) / 2
+        assert est[b.address] == pytest.approx(0.25)  # (0.5 + 0) / 2
+        dense = r.prefix_hit_estimate([1, 2, 3])
+        assert dense[a.address] == dense[b.address] == 0.5
+
+
+# ================================================= usage + tooling seams
+class TestObservability:
+    def test_usage_meter_adapter_rows(self, cfg_state, adapters):
+        from paddle_tpu.observability.usage import UsageMeter
+        cfg, state = cfg_state
+        eng = _engine(cfg, state, lora=_store(cfg, adapters),
+                      usage=UsageMeter())
+        req = eng.submit([1, 2, 3, 4], GenerationConfig(max_new_tokens=4),
+                         tenant="acme", adapter="alpha")
+        eng.submit([1, 2, 3, 4], GenerationConfig(max_new_tokens=4),
+                   tenant="acme")
+        eng.run_until_complete(max_steps=400)
+        row = eng.usage.snapshot()["tenants"]["acme"]
+        assert row["adapters"] == {
+            "alpha": {"requests": 1,
+                      "decode_tokens": req.num_generated}}
+
+    def test_metrics_report_lora_section(self):
+        mod = _load_tool("metrics_report")
+        lora = {"capacity": 2, "rank": RANK, "resident": ["alpha"],
+                "parked": ["beta"], "pinned": {}, "bank_bytes": 4096,
+                "bank_bytes_device": 8192, "loads": 3, "evictions": 1,
+                "requests": {"alpha": 5},
+                "batch_jobs": {"batch-0": {
+                    "status": "completed", "total": 6, "completed": 6,
+                    "failed": 0, "preemptions": 2, "output_tokens": 36,
+                    "output_path": "/tmp/o.jsonl"}}}
+        text = mod.report({}, None, lora=lora)
+        assert "Adapters / batch lane" in text
+        assert "1/2 rows resident" in text
+        assert "batch batch-0: completed 6/6 rows" in text
+        # old dumps (no lora.json) render without the section
+        assert "Adapters" not in mod.report({}, None)
+
+    def test_fleet_dashboard_adapter_line(self):
+        mod = _load_tool("fleet_dashboard")
+        payload = {"kind": "replica", "address": "x:1", "model": "m",
+                   "adapters": {"capacity": 2, "rank": RANK,
+                                "resident": ["alpha"], "parked": [],
+                                "loads": 1, "evictions": 0},
+                   "batches": {"batch-0": {"status": "completed",
+                                           "completed": 6}}}
+        text = mod.render(payload)
+        assert "adapters: 1/2 resident" in text
+        assert "batch jobs 1/1 completed" in text
+        dense = dict(payload)
+        dense.pop("adapters"), dense.pop("batches")
+        assert "adapters:" not in mod.render(dense)

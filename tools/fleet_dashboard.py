@@ -209,6 +209,32 @@ def _diagnostics_line(fl, indent: str = "  ") -> list[str]:
     return [indent + "diagnostics: " + ", ".join(parts)] if parts else []
 
 
+def _adapters_line(fl, indent: str = "  ") -> list[str]:
+    """Multi-LoRA + offline-lane line from a replica's fleet summary
+    ("adapters" / "batches" keys).  Dense replicas with no adapter
+    store and no batch jobs publish neither and produce no line."""
+    ad = (fl or {}).get("adapters") or {}
+    jobs = (fl or {}).get("batches") or {}
+    parts = []
+    if ad:
+        resident = ad.get("resident") or []
+        parts.append(
+            f"{len(resident)}/{_fmt(ad.get('capacity'))} resident "
+            f"(rank {_fmt(ad.get('rank'))}, "
+            f"{_fmt(ad.get('loads'))} loads / "
+            f"{_fmt(ad.get('evictions'))} evictions, "
+            f"{len(ad.get('parked') or [])} parked)")
+    if jobs:
+        done = sum(1 for j in jobs.values()
+                   if isinstance(j, dict)
+                   and j.get("status") == "completed")
+        rows = sum(int((j or {}).get("completed") or 0)
+                   for j in jobs.values())
+        parts.append(f"batch jobs {done}/{len(jobs)} completed "
+                     f"({_fmt(rows)} rows out)")
+    return [indent + "adapters: " + ", ".join(parts)] if parts else []
+
+
 def _merge_usage(snaps):
     """Raw-merge per-replica usage snapshots: per-tenant counters sum,
     nested dicts (the slo verdict table) recurse, never averaging — a
@@ -343,10 +369,12 @@ def render_router(payload) -> str:
         out += [""] + use
     for addr, entry in sorted(replicas.items()):
         fl = entry.get("summary") or {}
+        adapters = _adapters_line(fl)
         diag = _diagnostics_line(fl)
         hist = _series_lines(fl.get("series"))
-        if diag or hist:
-            out += ["", f"[{addr}]"] + diag + (hist[1:] if hist else [])
+        if adapters or diag or hist:
+            out += (["", f"[{addr}]"] + adapters + diag
+                    + (hist[1:] if hist else []))
     return "\n".join(out)
 
 
@@ -371,6 +399,7 @@ def render_replica(payload) -> str:
         out.append(f"  recovery: {_fmt(rec.get('recoveries'))} rebuilds,"
                    f" {_fmt(rec.get('quarantines'))} quarantines,"
                    f" {_fmt(rec.get('replayed_requests'))} replays")
+    out += _adapters_line(payload)
     out += _diagnostics_line(payload)
     sched = payload.get("scheduling") or {}
     if any(v for k, v in sched.items() if k != "prefill_chunk"):

@@ -5,13 +5,14 @@ Usage:
     python tools/metrics_report.py <dump-dir | metrics.json> [--prom]
 
 Reads metrics.json (+ retraces.json / trace.json / flight.json /
-resources.json / profile.json / captures.json / usage.json when
-present) from the dump directory FLAGS_metrics_dir pointed at, and
-renders counters, gauges, histograms, SLO verdicts, fault-tolerance
-events, finish reasons, the span-trace summary, the sampling-profiler
-+ diagnostic-capture summary, the per-tenant usage ledger, and the
-retrace log as aligned tables.  --prom cats the raw Prometheus text
-instead (what a scraper would see).
+resources.json / profile.json / captures.json / usage.json /
+quant.json / lora.json when present) from the dump directory
+FLAGS_metrics_dir pointed at, and renders counters, gauges,
+histograms, SLO verdicts, fault-tolerance events, finish reasons, the
+span-trace summary, the sampling-profiler + diagnostic-capture
+summary, the per-tenant usage ledger, the multi-LoRA adapter census +
+offline batch lane, and the retrace log as aligned tables.  --prom
+cats the raw Prometheus text instead (what a scraper would see).
 
 Every section is optional: a dump produced by an older build (no SLO
 counters, no trace.json) renders the sections it has and silently
@@ -58,8 +59,9 @@ def _load(path):
     captures = _read_json(os.path.join(dir_, "captures.json"))
     usage = _read_json(os.path.join(dir_, "usage.json"))
     quant = _read_json(os.path.join(dir_, "quant.json"))
+    lora = _read_json(os.path.join(dir_, "lora.json"))
     return (metrics, retraces, trace, flight, resources, profile,
-            captures, usage, quant, prom_path)
+            captures, usage, quant, lora, prom_path)
 
 
 def _fmt_value(v):
@@ -778,8 +780,65 @@ def _quant_section(quant):
     return "\n".join(lines)
 
 
+def _lora_section(lora, metrics):
+    """Multi-LoRA adapter census + offline batch lane from lora.json
+    (engine / serving-worker ``lora_snapshot()``) with the per-adapter
+    decode-token counter from metrics.json folded in.  Dense dumps
+    (or older builds) have no file and produce no section."""
+    if not isinstance(lora, dict):
+        return None
+    lines = ["Adapters / batch lane"]
+    if lora.get("capacity") is not None:
+        resident = lora.get("resident") or []
+        parked = lora.get("parked") or []
+        pinned = lora.get("pinned") or {}
+        lines.append(
+            f"  bank: {len(resident)}/{_fmt_value(lora['capacity'])} "
+            f"rows resident (rank {_fmt_value(lora.get('rank', '?'))}), "
+            f"{len(parked)} parked on host, "
+            f"{_fmt_value(lora.get('loads', 0))} loads / "
+            f"{_fmt_value(lora.get('evictions', 0))} evictions")
+        device = lora.get("bank_bytes_device")
+        lines.append(
+            f"  bank bytes: {_fmt_bytes(lora.get('bank_bytes', 0))} "
+            f"packed" + (f", {_fmt_bytes(device)} on device"
+                         if device else ""))
+        # decode tokens per adapter from the usage counter family —
+        # absent when no request named an adapter (or no meter ran)
+        tokens: dict = {}
+        entry = (metrics or {}).get(
+            "serving_usage_adapter_tokens_total") or {}
+        for s in entry.get("series", []):
+            name = (s.get("labels") or {}).get("adapter", "?")
+            tokens[name] = tokens.get(name, 0) + (s.get("value") or 0)
+        reqs = lora.get("requests") or {}
+        if reqs or tokens:
+            rows = [(name, _fmt_value(reqs.get(name, 0)),
+                     _fmt_value(tokens.get(name, 0)),
+                     "resident" if name in resident else "parked",
+                     _fmt_value(pinned.get(name, 0)))
+                    for name in sorted(set(reqs) | set(tokens))]
+            lines.append(_table(rows, ("adapter", "reqs", "decode",
+                                       "state", "pinned")))
+    jobs = lora.get("batch_jobs") or {}
+    for jid, prog in sorted(jobs.items()):
+        if not isinstance(prog, dict):
+            continue
+        total = prog.get("total", 0)
+        lines.append(
+            f"  batch {jid}: {prog.get('status', '?')} "
+            f"{_fmt_value(prog.get('completed', 0))}/"
+            f"{_fmt_value(total)} rows "
+            f"({_fmt_value(prog.get('failed', 0))} failed, "
+            f"{_fmt_value(prog.get('preemptions', 0))} preemptions, "
+            f"{_fmt_value(prog.get('output_tokens', 0))} tokens) -> "
+            f"{prog.get('output_path') or '-'}")
+    return "\n".join(lines) if len(lines) > 1 else None
+
+
 def report(metrics, retraces, trace=None, flight=None, resources=None,
-           profile=None, captures=None, usage=None, quant=None):
+           profile=None, captures=None, usage=None, quant=None,
+           lora=None):
     simple_rows = {"counter": [], "gauge": []}
     hist_blocks = []
     for name, entry in sorted(metrics.items()):
@@ -831,6 +890,9 @@ def report(metrics, retraces, trace=None, flight=None, resources=None,
     q = _quant_section(quant)
     if q:
         out += [q, ""]
+    lr = _lora_section(lora, metrics)
+    if lr:
+        out += [lr, ""]
     if retraces and retraces.get("entries"):
         entries = sorted(retraces["entries"],
                          key=lambda e: (-e["count"], e["op"]))
@@ -854,7 +916,7 @@ def main(argv=None):
                     help="print the raw Prometheus text export")
     args = ap.parse_args(argv)
     (metrics, retraces, trace, flight, resources, profile, captures,
-     usage, quant, prom_path) = _load(args.path)
+     usage, quant, lora, prom_path) = _load(args.path)
     if args.prom:
         if not os.path.exists(prom_path):
             sys.exit(f"metrics_report: no metrics.prom at {prom_path!r}")
@@ -862,7 +924,7 @@ def main(argv=None):
             print(f.read(), end="")
         return 0
     print(report(metrics, retraces, trace, flight, resources,
-                 profile, captures, usage, quant))
+                 profile, captures, usage, quant, lora))
     return 0
 
 

@@ -128,6 +128,27 @@ DIRECTIONS = {
     "host_page_seconds_conservation_delta": "exact",
     "tenants_tracked": "exact",
     "usage_parity_vs_off": "exact",
+    # multi-LoRA serving: two live adapters in one mixed batch must
+    # share the ONE decode trace, match the merged-weights dense
+    # reference token-for-token, actually diverge from the base model,
+    # and an armed-but-unused store must cost exactly nothing (dense
+    # parity, zero extra host syncs / decode traces)
+    "adapters_resident": "exact",
+    "lora_loads": "exact",
+    "lora_evictions": "exact",
+    "lora_parity_vs_merged": "exact",
+    "lora_off_parity_vs_dense": "exact",
+    "adapter_divergence": "exact",
+    # offline batch lane: the job must complete every row with zero
+    # failures while interactive arrivals preempt its residents, the
+    # preempted rows must resume token-for-token (row parity vs an
+    # idle engine), interactive outputs must be untouched, and the
+    # pool must balance
+    "batch_rows_completed": "exact",
+    "batch_rows_failed": "exact",
+    "batch_job_done": "exact",
+    "batch_row_parity": "exact",
+    "interactive_parity_vs_idle": "exact",
 }
 
 
@@ -162,6 +183,24 @@ def _engine(**kw):
                       num_attention_heads=4, num_key_value_heads=2,
                       max_position_embeddings=128)
     return create_engine(LlamaForCausalLM(cfg), **kw)
+
+
+def _tiny_state():
+    """The gate's tiny config + its generation-state dict — scenarios
+    that transform the checkpoint (the merged-weight LoRA reference)
+    build Engines from state directly instead of through a model."""
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.tensor import Tensor
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                      intermediate_size=64, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=128)
+    model = LlamaForCausalLM(cfg)
+    state = {k: (v._data if isinstance(v, Tensor) else v)
+             for k, v in model.functional_state().items()}
+    return cfg, state
 
 
 def _gen(max_new_tokens):
@@ -746,6 +785,133 @@ def scenario_quant_decode() -> dict:
     }
 
 
+def scenario_lora_decode() -> dict:
+    """Multi-LoRA serving vs the merged-weights dense reference,
+    counters only.
+
+    A mixed batch (adapter 'a' on one slot, adapter 'b' on the other)
+    must run in ONE decode trace with both adapters live in the bank,
+    and each request's greedy tokens must equal a dense engine built
+    from ``W + (alpha/r) A^T B`` merged weights — token-for-token, the
+    gather-from-bank path against the fold-into-checkpoint ground
+    truth.  The adapters must also actually change the outputs (a zero
+    delta would make the parity vacuous).  The off half pins the
+    zero-overhead contract: an engine with the store ATTACHED but only
+    dense requests must produce bit-identical tokens and exactly zero
+    extra host syncs / decode traces vs a store-less engine."""
+    from paddle_tpu.serving.engine import Engine
+    from paddle_tpu.serving.lora import (AdapterStore, merge_adapter,
+                                         random_adapter)
+
+    cfg, state = _tiny_state()
+    rank, alpha = 4, 8.0
+    wa = random_adapter(cfg, rank, seed=1)
+    wb = random_adapter(cfg, rank, seed=2)
+    prompts = ([1, 2, 3, 4, 5, 6], [3, 4, 5, 6, 7, 8])
+
+    def store():
+        s = AdapterStore(cfg, capacity=2)
+        s.register("a", wa, alpha=alpha)
+        s.register("b", wb, alpha=alpha)
+        return s
+
+    def drive(st=None, lora=None, adapters=(None, None)):
+        eng = Engine(config=cfg,
+                     state=dict(state if st is None else st),
+                     max_slots=2, page_size=4, sync_interval=1,
+                     lora=lora)
+        reqs = [eng.submit(list(p), _gen(8), adapter=ad)
+                for p, ad in zip(prompts, adapters)]
+        eng.run_until_complete(max_steps=400)
+        return eng, [list(r.output_tokens) for r in reqs]
+
+    dense_eng, dense_out = drive()
+    off_eng, off_out = drive(lora=store())      # armed, requests dense
+    live = store()
+    eng, out = drive(lora=live, adapters=("a", "b"))
+    _, merged_a = drive(st=merge_adapter(state, cfg, wa, alpha=alpha))
+    _, merged_b = drive(st=merge_adapter(state, cfg, wb, alpha=alpha))
+    snap = live.snapshot()
+    return {
+        "decode_traces": eng.decode_traces,
+        "adapters_resident": len(snap["resident"]),
+        "lora_loads": snap["loads"],
+        "lora_evictions": snap["evictions"],
+        "lora_parity_vs_merged": int(out == [merged_a[0], merged_b[1]]),
+        "adapter_divergence": int(out[0] != dense_out[0]
+                                  and out[1] != dense_out[1]),
+        "lora_off_parity_vs_dense": int(off_out == dense_out),
+        "host_syncs_delta_vs_off": off_eng.host_syncs
+        - dense_eng.host_syncs,
+        "decode_traces_delta_vs_off": (off_eng.decode_traces
+                                       - dense_eng.decode_traces),
+        "leaked_pages": eng.blocks.pool_accounting()["leak"],
+    }
+
+
+def scenario_batch_lane() -> dict:
+    """Offline batch lane under interactive pressure, counters only.
+
+    A 6-row JSONL job drip-feeds through a 2-slot preemptive engine
+    with a 2-request window; two interactive priority-0 requests land
+    mid-job and must preempt the batch residents (preemptions is
+    pinned exact — the lane runs at priority -2, below every
+    interactive class).  Gates: the job completes every row with zero
+    failures, each preempted row resumes token-for-token (row outputs
+    equal an idle engine's run of the same prompt), the interactive
+    outputs equal an idle engine's (the lane never perturbs them), the
+    whole dance reuses the ONE decode trace, and the pool balances."""
+    import json as _json
+    import tempfile
+    from paddle_tpu.serving.lora import BatchJob
+
+    eng = _engine(max_slots=2, page_size=4, sync_interval=1,
+                  enable_prefix_cache=False, preempt=True)
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "batch.jsonl")
+        with open(path, "w") as f:
+            for i in range(6):
+                f.write(_json.dumps({"prompt": [1, 2, 3, 4],
+                                     "max_tokens": 6,
+                                     "id": f"r{i}"}) + "\n")
+        job = BatchJob.from_jsonl(path, window=2)
+        interactive = []
+        steps = 0
+        while (job.pump(eng.submit) or eng.scheduler.has_work()) \
+                and steps < 2000:
+            if steps == 3:
+                interactive += [eng.submit([5, 6, 7], _gen(4)),
+                                eng.submit([6, 7, 8], _gen(4))]
+            eng.step()
+            steps += 1
+        prog = job.progress()
+        with open(prog["output_path"]) as f:
+            rows = [_json.loads(line) for line in f]
+
+    ref = _engine(max_slots=2, page_size=4, sync_interval=1,
+                  enable_prefix_cache=False)
+    ref_reqs = [ref.submit([5, 6, 7], _gen(4)),
+                ref.submit([6, 7, 8], _gen(4))]
+    batch_ref = ref.submit([1, 2, 3, 4], _gen(6))
+    ref.run_until_complete(max_steps=200)
+    batch_tokens = list(batch_ref.output_tokens)
+    return {
+        "batch_job_done": int(prog["status"] == "completed"),
+        "batch_rows_completed": prog["completed"],
+        "batch_rows_failed": prog["failed"],
+        "batch_row_parity": int(
+            len(rows) == 6
+            and all(r.get("tokens") == batch_tokens for r in rows)),
+        "interactive_parity_vs_idle": int(
+            [list(r.output_tokens) for r in interactive]
+            == [list(r.output_tokens) for r in ref_reqs]),
+        "preemptions": eng.preemptions,
+        "leaked_pages": eng.blocks.pool_accounting()["leak"],
+        "decode_traces": eng.decode_traces,
+        "goodput_ratio": _goodput(interactive),
+    }
+
+
 SCENARIOS = {
     "steady_decode": scenario_steady_decode,
     "prefix_cache": scenario_prefix_cache,
@@ -759,6 +925,8 @@ SCENARIOS = {
     "profiling": scenario_profiling,
     "usage_meter": scenario_usage_meter,
     "quant_decode": scenario_quant_decode,
+    "lora_decode": scenario_lora_decode,
+    "batch_lane": scenario_batch_lane,
 }
 
 

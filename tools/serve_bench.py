@@ -44,6 +44,19 @@ page-seconds conservation check.  Works in both the in-process and
 ``--http`` modes (the HTTP path carries the tenant in the request body
 and merges the per-replica tables).
 
+``--adapters sum:0.4,cls:0.3,none:0.3`` registers one random LoRA
+adapter per named class (rank ``--lora-rank``) in an AdapterStore
+wired into the engine and draws an adapter per request from the
+weights (the reserved names ``none``/``-`` mean dense base-model
+requests); the report adds a per-adapter p50/p99 TTFT/TPOT table —
+the multi-tenant adapter-serving overhead view.
+
+``--batch-file FILE`` drip-feeds an offline JSONL batch job (one
+``{"prompt": [...]}`` record per line) through the engine at the
+batch priority lane while the interactive workload runs, and reports
+the interactive-vs-batch goodput split plus the preemptions the
+interactive traffic inflicted on the lane (in-process mode only).
+
 ``--shared-prefix-len N`` prepends one common N-token prefix to every
 prompt (the system-prompt / few-shot pattern prefix caching targets);
 with ``--prefix-cache`` (default on) the report adds the prefix-cache
@@ -228,10 +241,10 @@ def _per_class_latency(samples):
     return out
 
 
-def _print_per_class(per_class):
+def _print_per_class(per_class, kind="class"):
     for label in sorted(per_class):
         d = per_class[label]
-        line = f"  class {label:<8} n={d['requests']}"
+        line = f"  {kind} {label:<8} n={d['requests']}"
         if d["ttft_s"]:
             line += (f"  TTFT p50/p99 "
                      f"{_percentile(d['ttft_s'], 0.5) * 1e3:.2f}/"
@@ -285,6 +298,20 @@ def run_bench(args):
         from paddle_tpu.observability.usage import UsageMeter
         usage_meter = UsageMeter()
 
+    # --adapters sum:0.4,none:0.6: random rank-r adapters registered in
+    # an AdapterStore; the reserved names none/- mean dense requests
+    adapter_mix = _parse_tenant_mix(getattr(args, "adapters", ""))
+    lora_store = None
+    if adapter_mix:
+        from paddle_tpu.serving.lora import AdapterStore, random_adapter
+        names = [n for n, _ in adapter_mix if n not in ("none", "-")]
+        lora_store = AdapterStore(cfg, capacity=max(1, len(names)),
+                                  rank=args.lora_rank)
+        for j, nm in enumerate(names):
+            lora_store.register(
+                nm, random_adapter(cfg, args.lora_rank,
+                                   seed=args.seed + j))
+
     engine = create_engine(model, max_slots=args.max_slots,
                            page_size=args.page_size,
                            num_pages=args.num_pages,
@@ -295,10 +322,17 @@ def run_bench(args):
                            prefill_chunk=getattr(args, "prefill_chunk",
                                                  None),
                            preempt=getattr(args, "preempt", None),
-                           usage=usage_meter,
+                           usage=usage_meter, lora=lora_store,
                            quant=(None if getattr(args, "quant", "none")
                                   == "none" else args.quant),
                            kv_quant=getattr(args, "kv_quant", None))
+
+    # --batch-file FILE: an offline JSONL job rides the batch priority
+    # lane, drip-fed between interactive admissions
+    batch_job = None
+    if getattr(args, "batch_file", ""):
+        from paddle_tpu.serving.lora import BatchJob
+        batch_job = BatchJob.from_jsonl(args.batch_file)
 
     # --chaos SEED: seed a probabilistic fault plan (poisoned steps,
     # synthetic OOM, slow steps) and drive through the self-healing
@@ -331,19 +365,26 @@ def run_bench(args):
     mix = _parse_priority_mix(getattr(args, "priority_mix", ""))
     priorities = _assign_priorities(mix, rng, len(workload))
     tenants = _assign_tenants(tenant_mix, rng, len(workload))
+    adapters = [None if a in (None, "none", "-") else a
+                for a in _assign_tenants(adapter_mix, rng,
+                                         len(workload))]
 
     t0 = time.monotonic()
     pending = list(enumerate(workload))
     reqs = []
     # open-loop driver: submit what has "arrived", run one iteration,
     # repeat — admissions interleave with decode exactly as in a server
-    while pending or engine.scheduler.has_work():
+    while (pending or engine.scheduler.has_work()
+           or (batch_job is not None and not batch_job.done)):
+        if batch_job is not None and not batch_job.done:
+            batch_job.pump(engine.submit)
         now = time.monotonic() - t0
         while pending and pending[0][1][0] <= now:
             i, (_, prompt, n_new) = pending.pop(0)
             reqs.append(engine.submit(
                 prompt, GenerationConfig(max_new_tokens=n_new),
-                priority=priorities[i], tenant=tenants[i]))
+                priority=priorities[i], tenant=tenants[i],
+                adapter=adapters[i]))
         if not step() and pending:
             time.sleep(min(1e-3, max(0.0, pending[0][1][0] - now)))
     wall = time.monotonic() - t0
@@ -417,6 +458,31 @@ def run_bench(args):
               f"{stats['spilled_pages']}/{stats['restored_pages']} pages "
               f"spilled/restored ({stats['spill_bytes']} bytes)")
 
+    per_adapter = {}
+    if adapter_mix:
+        per_adapter = _per_class_latency(
+            (getattr(r, "adapter", None) or "(dense)", ttft, tpot)
+            for (_, ttft, tpot), r in zip(_req_samples(), reqs))
+        _print_per_class(per_adapter, kind="adapter")
+        print(f"  adapter bank         "
+              f"{engine.lora_snapshot()['bank_bytes_device']} device "
+              f"bytes, {lora_store.loads} loads, "
+              f"{lora_store.evictions} evictions")
+
+    batch_out = {}
+    if batch_job is not None:
+        prog = batch_job.progress()
+        print(f"  batch lane           job {prog['id']}: "
+              f"{prog['completed']}/{prog['total']} rows "
+              f"({prog['failed']} failed), {prog['output_tokens']} "
+              f"tokens -> {prog['output_path']}")
+        print(f"  goodput split        interactive {toks} tok "
+              f"({toks / wall:.1f} tok/s) vs batch "
+              f"{prog['output_tokens']} tok "
+              f"({prog['output_tokens'] / wall:.1f} tok/s), "
+              f"{stats['preemptions']} preemptions")
+        batch_out = {"batch": prog}
+
     usage_out = {}
     if usage_meter is not None:
         snap = usage_meter.snapshot()
@@ -473,14 +539,14 @@ def run_bench(args):
             "pages_saved": stats["prefix_hits"],
             "host_syncs": stats["host_syncs"],
             "logit_fetches": stats["logit_fetches"],
-            "per_class": per_class,
+            "per_class": per_class, "per_adapter": per_adapter,
             "prefill_chunks": stats["prefill_chunks"],
             "max_prefill_gap": stats["max_prefill_gap"],
             "preemptions": stats["preemptions"],
             "spill_aborts": stats["spill_aborts"],
             "spilled_pages": stats["spilled_pages"],
             "restored_pages": stats["restored_pages"],
-            **usage_out, **chaos_out}
+            **batch_out, **usage_out, **chaos_out}
 
 
 def run_overload_compare(args):
@@ -820,6 +886,23 @@ def _build_parser() -> argparse.ArgumentParser:
                          "engine and prints the per-tenant cost table "
                          "(page-seconds ledger) with the conservation "
                          "check")
+    ap.add_argument("--adapters", default="", metavar="SPEC",
+                    help="per-request LoRA adapters drawn from a "
+                         "weighted spec, e.g. sum:0.4,cls:0.3,none:0.3 "
+                         "(none/- = dense); registers one random "
+                         "rank=--lora-rank adapter per name and adds a "
+                         "per-adapter p50/p99 TTFT/TPOT table "
+                         "(in-process mode only)")
+    ap.add_argument("--lora-rank", type=int, default=4,
+                    help="rank of the random adapters --adapters "
+                         "registers")
+    ap.add_argument("--batch-file", default="", metavar="FILE",
+                    help="drip-feed this JSONL file (one "
+                         "{'prompt': [...]} record per line) as an "
+                         "offline batch job on the lowest-priority "
+                         "lane while the interactive workload runs; "
+                         "reports the interactive-vs-batch goodput "
+                         "split (in-process mode only)")
     ap.add_argument("--prefill-chunk", type=int, default=None,
                     help="split admission prefill into chunks of this "
                          "many tokens, interleaved with decode steps "
