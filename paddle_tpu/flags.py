@@ -239,6 +239,20 @@ define_flag("FLAGS_serving_usage_max_tenants", 64,
             "seen tenant's aggregates and metric series into the "
             "(evicted) rollup, so hostile clients cycling X-Tenant "
             "values cannot explode the metrics registry")
+define_flag("FLAGS_serving_request_log", False,
+            "tail-latency forensics: build a RequestLog "
+            "(observability/requestlog.py) that records per-request "
+            "lifecycle timelines on the engine clock, folds them into "
+            "critical-path attribution buckets that sum exactly to the "
+            "measured E2E, and keeps worst-K SLO-violation exemplars — "
+            "behind GET /debug/requests/<id>, GET /debug/exemplars, "
+            "and serving_latency_attribution_seconds_total; off (the "
+            "default) builds no log and the serving path pays only "
+            "is-not-None tests")
+define_flag("FLAGS_serving_exemplars_k", 8,
+            "worst-K reservoir depth per SLO dimension "
+            "(ttft/tpot/e2e/error) for the request log's exemplar "
+            "store (requires FLAGS_serving_request_log)")
 define_flag("FLAGS_serving_fair_share", False,
             "fair-share admission/preemption bias: when burn-rate "
             "shedding fires, only the heaviest-page-second tenant's "

@@ -44,15 +44,19 @@ from .capture import (  # noqa: F401
 from .usage import (  # noqa: F401
     TenantTable, UsageMeter, active_usage, merge_usage, request_ledger,
     set_active_usage)
+from .requestlog import (  # noqa: F401
+    ExemplarStore, RequestLog, RequestTimeline, active_requestlog,
+    merge_exemplars, set_active_requestlog)
 
 __all__ = ["AlertRule", "Counter", "DiagnosticCapture",
-           "FlightRecorder", "Gauge",
-           "Histogram", "MetricsRegistry", "ResourceTracker",
+           "ExemplarStore", "FlightRecorder", "Gauge",
+           "Histogram", "MetricsRegistry", "RequestLog",
+           "RequestTimeline", "ResourceTracker",
            "SamplingProfiler", "Series",
            "Span", "SpanContext", "TenantTable", "TimeSeriesStore",
            "Tracer", "UsageMeter",
            "active_capture", "active_profiler", "active_quant",
-           "active_usage",
+           "active_requestlog", "active_usage",
            "bucket_quantiles", "merge_series_buckets",
            "quantile_from_buckets",
            "default_registry", "default_rules", "counter", "gauge",
@@ -60,11 +64,12 @@ __all__ = ["AlertRule", "Counter", "DiagnosticCapture",
            "dump", "reset", "flight", "enable_event_sampling",
            "chrome_counter_events", "flight_recorder",
            "format_traceparent", "parse_traceparent",
-           "merge_usage", "request_ledger",
+           "merge_exemplars", "merge_usage", "request_ledger",
            "resource_tracker", "serving_sources",
            "active_lora", "set_active_lora",
            "set_active_capture", "set_active_profiler",
-           "set_active_quant", "set_active_usage", "tracer"]
+           "set_active_quant", "set_active_requestlog",
+           "set_active_usage", "tracer"]
 
 # the quantized-serving provider: dump() writes quant.json from its
 # quant_snapshot() (last engine built wins, like the profiler/usage
@@ -190,6 +195,7 @@ def reset():
     set_active_usage(None)
     set_active_quant(None)
     set_active_lora(None)
+    set_active_requestlog(None)
 
 
 def dump(dir_=None) -> str | None:
@@ -200,10 +206,10 @@ def dump(dir_=None) -> str | None:
     ``flight.json``, and the resource tracker's snapshot as
     ``resources.json`` into ``dir_`` (default: ``FLAGS_metrics_dir``).
     When a continuous profiler / diagnostic capture / usage meter /
-    quantized engine / LoRA-serving engine is active, adds
-    ``profile.json`` / ``captures.json`` / ``usage.json`` /
-    ``quant.json`` / ``lora.json``.  Returns the directory, or None
-    when no directory is configured."""
+    quantized engine / LoRA-serving engine / request log is active,
+    adds ``profile.json`` / ``captures.json`` / ``usage.json`` /
+    ``quant.json`` / ``lora.json`` / ``exemplars.json``.  Returns the
+    directory, or None when no directory is configured."""
     if dir_ is None:
         from ..flags import FLAGS
         dir_ = FLAGS.get("FLAGS_metrics_dir") or None
@@ -253,6 +259,10 @@ def dump(dir_=None) -> str | None:
     if lora is not None:
         with open(os.path.join(dir_, "lora.json"), "w") as f:
             json.dump(lora.lora_snapshot(), f, indent=2)
+    rlog = active_requestlog()
+    if rlog is not None:
+        with open(os.path.join(dir_, "exemplars.json"), "w") as f:
+            json.dump(rlog.snapshot(), f, indent=2)
     return dir_
 
 

@@ -147,7 +147,8 @@ class Engine:
                  prefill_chunk: int | None = None,
                  preempt: bool | None = None, faults=None, usage=None,
                  quant: str | None = None,
-                 kv_quant: bool | None = None, lora=None):
+                 kv_quant: bool | None = None, lora=None,
+                 requestlog=None):
         if model is not None:
             from ..framework.tensor import Tensor
             config = model.config
@@ -269,6 +270,20 @@ class Engine:
             # the process-active meter: obs.dump() writes usage.json
             # from it (last engine built wins, like the profiler)
             _obs.set_active_usage(usage)
+        # tail-latency forensics (observability.requestlog): per-
+        # request lifecycle timelines + critical-path attribution +
+        # SLO-violation exemplars.  Same zero-overhead-off contract:
+        # every seam below is a single ``is not None`` test when no
+        # log is attached (pinned by the tail_forensics gate scenario)
+        self.requestlog = requestlog
+        if requestlog is not None:
+            if slo is not None:
+                # violation exemplars ride the tracker's verdicts; the
+                # usage meter's verdict_hook is untouched — the two
+                # subsystems compose through separate hooks
+                slo.exemplar_hook = requestlog.slo_verdict
+            # the process-active log: obs.dump() writes exemplars.json
+            _obs.set_active_requestlog(requestlog)
 
         L = config.num_hidden_layers
         kvh, hd = config.num_key_value_heads, config.head_dim
@@ -499,6 +514,10 @@ class Engine:
             req.root_span = tr.start_span("request", attributes=attrs)
         req.queue_span = tr.start_span("scheduler.queue_wait",
                                        parent=req.root_span)
+        if self.requestlog is not None:
+            # after the root span exists so the timeline carries the
+            # trace id (the /debug/trace <-> /debug/exemplars join)
+            self.requestlog.attach(req)
         try:
             _obs.flight("engine", "submit", req=req.id,
                         prompt_len=int(req.prompt.size),
@@ -514,6 +533,8 @@ class Engine:
             # its adapter row pinned
             if self.lora is not None and req.adapter is not None:
                 self.lora.release(req.adapter)
+            if self.requestlog is not None:
+                self.requestlog.discard(req.id)
             req.queue_span.end()
             req.root_span.end()
             raise
@@ -580,6 +601,14 @@ class Engine:
             req.queue_seconds += max(
                 0.0, req.admitted_at - req._queued_since)
             req._queued_since = req.admitted_at
+            if req.timeline is not None:
+                # a re-queue wait after preemption charges to the
+                # preempted bucket — the request would not have waited
+                # had it not been preempted
+                req.timeline.note(
+                    "preempted" if req.num_generated else "queue",
+                    req.admitted_at, event="admit", slot=slot,
+                    then="prefill_compute")
         if req.num_generated:
             # re-admission of a preempted request: rebuild device KV
             # from the prefix cache + host spill tier + a re-prefill of
@@ -651,6 +680,9 @@ class Engine:
         now = self._clock()
         self._ttft.observe(now - req.arrival_time)
         self._note_phase("prefill", time.perf_counter() - t0)
+        if req.timeline is not None:
+            req.timeline.note_prefill(now, cached=cached,
+                                      computed=plen - cached, slot=slot)
         _obs.tracer().record_span(
             "engine.prefill", t0, time.perf_counter(),
             parent=req.root_span,
@@ -711,6 +743,11 @@ class Engine:
         stalls resident TPOT nor trips the watchdog."""
         st = self._chunking[slot]
         req = st["req"]
+        if req.timeline is not None:
+            # time since the last chunk (decode steps for other slots
+            # ran in between) is this request's chunk-gap cost
+            req.timeline.note("chunk_gap", self._clock(),
+                              then="prefill_compute")
         ids_all = st["ids"]
         n = int(ids_all.size)
         done = st["done"]
@@ -739,6 +776,11 @@ class Engine:
             if not last:
                 st["done"] = done + this
                 self._note_phase("prefill", time.perf_counter() - t0)
+                if req.timeline is not None:
+                    req.timeline.note(
+                        "prefill_compute", self._clock(), event="chunk",
+                        slot=slot, done=done + this, total=n,
+                        then="chunk_gap")
                 _obs.flight("engine", "prefill_chunk", req=req.id,
                             slot=slot, done=done + this, total=n)
                 return
@@ -768,6 +810,10 @@ class Engine:
         self.blocks.publish_seq(req.id, ids_all)
         now = self._clock()
         self._note_phase("prefill", time.perf_counter() - t0)
+        if req.timeline is not None:
+            req.timeline.note("prefill_compute", now, event="chunk",
+                              slot=slot, done=n, total=n,
+                              chunks=st["chunks"], then="decode")
         _obs.tracer().record_span(
             "engine.prefill", st["t0"], time.perf_counter(),
             parent=req.root_span,
@@ -816,6 +862,11 @@ class Engine:
         if req is None or req.state != RequestState.DECODE:
             return False
         t0 = time.perf_counter()
+        if req.timeline is not None:
+            # decoding ends here; the spill loop below (and, if the
+            # preemption lands, the re-queue wait and the restore)
+            # charges to the preempted bucket
+            req.timeline.note("decode", self._clock(), then="preempted")
         tokens = req.resume_tokens()
         parked: list[str] = []
         for page, digest in self.blocks.spill_plan(req.id, tokens):
@@ -824,6 +875,12 @@ class Engine:
                                           page=page) is not None):
                 self.blocks.host_discard(parked)
                 self.spill_aborts += 1
+                if req.timeline is not None:
+                    # the aborted spill attempt was still preemption
+                    # cost; the request goes back to decoding
+                    req.timeline.note("preempted", self._clock(),
+                                      event="spill_abort", slot=slot,
+                                      then="decode")
                 _obs.flight("engine", "spill_abort", req=req.id,
                             slot=slot, page=page,
                             parked_dropped=len(parked))
@@ -845,6 +902,10 @@ class Engine:
         # back to the queue: the ledger's queue-wait anchor restarts so
         # queue_seconds sums this wait too
         req._queued_since = self._clock()
+        if req.timeline is not None:
+            req.timeline.note("preempted", req._queued_since,
+                              event="preempt", slot=slot,
+                              pages=len(parked), then="preempted")
         if self._proposer is not None:
             self._proposer.drop(req.id)  # resume re-registers history
         if req.decode_span is not None:
@@ -927,6 +988,13 @@ class Engine:
             # a long replay suffix chunks exactly like a long prompt —
             # resumes must not reintroduce the TPOT stall either
             self._note_phase("prefill", time.perf_counter() - t0)
+            if req.timeline is not None:
+                # restore work so far charges to preempted; the chunked
+                # re-prefill accounts like any chunked admission
+                req.timeline.note("preempted", self._clock(),
+                                  event="resume", slot=slot,
+                                  restored=restored, cached=cached,
+                                  chunked=True, then="prefill_compute")
             _obs.flight("engine", "resume", req=req.id, slot=slot,
                         tokens=n, cached=cached, restored=restored,
                         chunked=True)
@@ -958,6 +1026,10 @@ class Engine:
         self.blocks.publish_seq(req.id, ids_all)
         now = self._clock()
         self._note_phase("prefill", time.perf_counter() - t0)
+        if req.timeline is not None:
+            req.timeline.note("preempted", now, event="resume",
+                              slot=slot, restored=restored,
+                              cached=cached, then="decode")
         self._enter_decode(slot, req, row, n, tok, now)
         if self._proposer is not None:
             self._proposer.register(req.id, tokens)
@@ -1118,6 +1190,20 @@ class Engine:
         logits_np = None
         now = self._clock()
         n_rows = len(self._pending)
+        if self.requestlog is not None:
+            # one timeline charge per live request per sync: decode
+            # dispatch up to the blocking ring fetch, then the sync
+            # itself — overlapping requests each experience the full
+            # wall interval, so per-request conservation still holds
+            seen: set[int] = set()
+            for _, entries, _ in self._pending:
+                for _slot, _req in entries:
+                    if (_req.id in seen or _req.is_finished()
+                            or _req.state != RequestState.DECODE
+                            or _req.timeline is None):
+                        continue
+                    seen.add(_req.id)
+                    _req.timeline.note_sync(now, sync_s)
         corrections = []
         for row_i, (ridx, entries, drafts) in enumerate(self._pending):
             for slot, req in entries:
@@ -1209,6 +1295,8 @@ class Engine:
 
     def _emit(self, slot: int, req: Request, tok: int, now: float,
               charge: bool = True):
+        if req.timeline is not None and req.first_token_at is None:
+            req.timeline.mark("first_token", now)   # the TTFT moment
         req._emit(tok, now)
         _M_TOKENS.inc()
         resource_tracker().note_tokens(1)
@@ -1304,6 +1392,11 @@ class Engine:
         _M_REQUESTS.labels(reason).inc()
         _M_FINISH.labels(reason).inc()
         resource_tracker().note_finish(reason, req.num_generated)
+        if self.requestlog is not None:
+            # close the timeline (residual charge + conservation check)
+            # BEFORE slo.observe, so a violation exemplar snapshots the
+            # finished attribution, not a half-charged one
+            self.requestlog.on_finish(req, reason, now)
         if self.slo is not None:
             self.slo.observe(req, now)
         if self.usage is not None:
@@ -1452,6 +1545,12 @@ class Engine:
         self._aidx[slot] = getattr(req, "_adapter_row", 0)
         self._push_slot(slot)
         self._note_phase("prefill", time.perf_counter() - t0)
+        if req.timeline is not None:
+            # everything since the last charge — the poisoned step, the
+            # runner rebuild's share, and this replay — was recovery
+            req.timeline.note("recovery", self._clock(), event="replay",
+                              slot=slot, tokens=n, cached=cached,
+                              then="decode")
         _obs.tracer().record_span(
             "engine.replay", t0, time.perf_counter(),
             parent=req.root_span,
@@ -1618,7 +1717,8 @@ def create_engine(model, *, max_slots: int = 4, page_size: int = 64,
                   prefill_chunk: int | None = None,
                   preempt: bool | None = None, faults=None,
                   usage=None, quant: str | None = None,
-                  kv_quant: bool | None = None, lora=None) -> Engine:
+                  kv_quant: bool | None = None, lora=None,
+                  requestlog=None) -> Engine:
     """`create_predictor`-style entry point: build a continuous-batching
     engine over a LlamaForCausalLM (or any model exposing ``config`` and
     ``functional_state()`` with the llama state-dict layout).
@@ -1679,6 +1779,16 @@ def create_engine(model, *, max_slots: int = 4, page_size: int = 64,
     empty pytrees through every program: the dense jaxprs are
     byte-identical to a build without the knob.
 
+    ``requestlog`` attaches a
+    :class:`~paddle_tpu.observability.requestlog.RequestLog` for
+    tail-latency forensics: per-request lifecycle timelines whose
+    critical-path attribution buckets sum exactly to the measured E2E,
+    plus a worst-K SLO-violation exemplar reservoir (behind
+    ``GET /debug/requests/<id>`` and ``GET /debug/exemplars``).
+    ``requestlog=None`` (the default, or ``FLAGS_serving_request_log``
+    unset under ``serve()``) records nothing and every seam costs one
+    ``is not None`` test.
+
     Example::
 
         engine = create_engine(model, max_slots=8, page_size=64,
@@ -1694,4 +1804,5 @@ def create_engine(model, *, max_slots: int = 4, page_size: int = 64,
                   sync_interval=sync_interval, clock=clock, slo=slo,
                   mesh=mesh, spec_k=spec_k, prefill_chunk=prefill_chunk,
                   preempt=preempt, faults=faults, usage=usage,
-                  quant=quant, kv_quant=kv_quant, lora=lora)
+                  quant=quant, kv_quant=kv_quant, lora=lora,
+                  requestlog=requestlog)

@@ -116,6 +116,10 @@ class Request:
         self.root_span = None
         self.queue_span = None
         self.decode_span = None
+        # tail-latency forensics (observability.requestlog): the
+        # engine's RequestLog attaches a RequestTimeline at submit;
+        # None when forensics is off — every engine seam guards on it
+        self.timeline = None
 
         # timing (engine clock): TTFT = first_token_at - arrival_time
         self.arrival_time = time.monotonic() if arrival_time is None \

@@ -627,6 +627,14 @@ _ROUTER_DEBUG_INDEX = {
                        "diagnostic-capture indexes per replica",
     "/debug/usage": "per-tenant usage table raw-merged across the "
                     "replicas' last collected summaries",
+    "/debug/exemplars": "worst-K SLO-violation exemplars raw-merged "
+                        "(worst-first re-rank, counters sum) across "
+                        "every replica's bounded exemplar store",
+    "/debug/requests/<id>": "per-request lifecycle waterfall fanned "
+                            "out to every replica (the one that "
+                            "served the request answers); "
+                            "?format=chrome returns the found trace "
+                            "verbatim for chrome://tracing",
 }
 
 
@@ -682,6 +690,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
                                  "/debug/captures")})
         elif self.path == "/debug/usage":
             self._json(200, router.usage())
+        elif self.path == "/debug/exemplars":
+            self._merged_exemplars()
+        elif self.path.split("?", 1)[0].startswith("/debug/requests/"):
+            self._fanout_request()
         elif self.path in ("/debug", "/debug/"):
             self._json(200, {"endpoints": _ROUTER_DEBUG_INDEX})
         else:
@@ -703,6 +715,47 @@ class _RouterHandler(BaseHTTPRequestHandler):
             except Exception as e:
                 results[rep.address] = {"error": repr(e)}
         return results
+
+    def _merged_exemplars(self):
+        """Fan ``/debug/exemplars`` out to every replica and raw-merge
+        the worst-K tables: concatenate, re-rank worst-first, sum the
+        offered/kept counters — never average (the usage-merge rule).
+        A dead or forensics-off replica degrades to an error record in
+        ``replicas`` and is skipped by the merge, so a stale table
+        never pollutes the cluster view."""
+        from ..observability.requestlog import merge_exemplars
+        results = self._fanout_get("/debug/exemplars")
+        merged = merge_exemplars(
+            r.get("exemplars") if isinstance(r, dict) else None
+            for r in results.values())
+        self._json(200, {"kind": "router", "replicas": results,
+                         "merged": merged})
+
+    def _fanout_request(self):
+        """Forward ``/debug/requests/<id>`` (query string included) to
+        every replica.  Exactly one replica served the request, so at
+        most one answers with a timeline; the rest 404 into error
+        records.  JSON asks get the found waterfall plus the
+        per-replica map; ``?format=chrome`` relays the found trace
+        verbatim so the payload loads straight into chrome://tracing."""
+        from urllib.parse import parse_qs, urlparse
+        results = self._fanout_get(self.path)
+        found = next((r for r in results.values()
+                      if isinstance(r, dict) and "error" not in r), None)
+        fmt = parse_qs(urlparse(self.path).query).get(
+            "format", ["json"])[0]
+        if fmt == "chrome":
+            if found is None:
+                self._json(404, {"error": {
+                    "message": "no replica holds a timeline for "
+                               + self.path.split("?", 1)[0],
+                    "code": 404}})
+                return
+            self._json(200, found)
+            return
+        self._json(200 if found is not None else 404,
+                   {"kind": "router", "found": found,
+                    "replicas": results})
 
     def _fanout_profile(self):
         """``GET /debug/profile?seconds=N``: each replica blocks for

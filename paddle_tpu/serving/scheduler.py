@@ -266,6 +266,10 @@ class Scheduler:
         if self._on_evict is not None:
             self._on_evict(slot)
         _M_EVICTED.labels(reason).inc()
+        if req.timeline is not None and reason != "finished":
+            # non-finish evictions (cancel/deadline/error) mark the
+            # waterfall — the reason a timeline ends mid-lifecycle
+            req.timeline.mark("evict", now, slot=slot, reason=reason)
         _obs.flight("scheduler", "evict", req=req.id, slot=slot,
                     reason=reason, generated=req.num_generated)
         if req.root_span is not None:

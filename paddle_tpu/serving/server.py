@@ -679,6 +679,10 @@ class ServingServer(ThreadingHTTPServer):
                           "shed_by_class": dict(worker.shed_by_class)}
             usage = (eng.usage.snapshot()
                      if eng.usage is not None else None)
+            # tail forensics: dominant latency cause + worst exemplar
+            # (age on the engine clock) for the dashboard's tail line
+            tail = (eng.requestlog.tail_summary(now=eng._clock())
+                    if eng.requestlog is not None else None)
             # adapter residency census: the router folds this into its
             # expected-hit-rate score so adapter traffic sticks to
             # replicas already holding the weights
@@ -708,7 +712,7 @@ class ServingServer(ThreadingHTTPServer):
                 "pool": pool, "prefix": prefix, "slots": slots,
                 "queue": queue, "slo": slo, "spec": spec,
                 "recovery": recovery, "scheduling": scheduling,
-                "usage": usage, "adapters": adapters,
+                "usage": usage, "tail": tail, "adapters": adapters,
                 "batches": batches, "latency": latency,
                 "watchdog": self.watchdog.state(),
                 "alerts": ({"firing": ts.firing(),
@@ -737,6 +741,11 @@ _DEBUG_INDEX = {
                        "retained evidence bundles",
     "/debug/usage": "per-tenant usage table (tokens, page-seconds, "
                     "goodput) + the page-seconds conservation check",
+    "/debug/requests/<id>": "one request's lifecycle waterfall + "
+                            "critical-path attribution "
+                            "(?format=chrome for chrome://tracing)",
+    "/debug/exemplars": "worst-K SLO-violation exemplars per dimension "
+                        "+ the attribution conservation census",
 }
 
 
@@ -846,6 +855,21 @@ class _Handler(BaseHTTPRequestHandler):
                     snap = meter.snapshot()
                 self._json(200, dict(snap, kind="replica"),
                            "/debug/usage")
+        elif self.path == "/debug/exemplars":
+            worker = self.server.worker
+            log = worker.engine.requestlog
+            if log is None:
+                self._error(
+                    404, "request log disabled (set "
+                    "FLAGS_serving_request_log or pass requestlog= to "
+                    "the engine)", "/debug/exemplars")
+            else:
+                with worker.lock:
+                    snap = log.snapshot()
+                self._json(200, dict(snap, kind="replica"),
+                           "/debug/exemplars")
+        elif self.path.split("?", 1)[0].startswith("/debug/requests/"):
+            self._request_waterfall()
         elif self.path == "/v1/batches":
             worker = self.server.worker
             with worker.lock:
@@ -866,6 +890,46 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(200, {"endpoints": _DEBUG_INDEX}, "/debug/")
         else:
             self._error(404, f"no route {self.path}", self.path)
+
+    def _request_waterfall(self):
+        """``GET /debug/requests/<id>[?format=chrome]``: one request's
+        lifecycle waterfall — the event list + the critical-path
+        attribution whose buckets sum to its measured E2E — or the
+        chrome://tracing-loadable export of the same timeline."""
+        from urllib.parse import parse_qs, urlparse
+        u = urlparse(self.path)
+        route = "/debug/requests"         # bounded metric label
+        worker = self.server.worker
+        log = worker.engine.requestlog
+        if log is None:
+            self._error(404, "request log disabled (set "
+                        "FLAGS_serving_request_log or pass requestlog= "
+                        "to the engine)", route)
+            return
+        rid_s = u.path[len("/debug/requests/"):]
+        try:
+            rid = int(rid_s)
+        except ValueError:
+            self._error(400, "request id must be an integer, got "
+                        f"{rid_s!r}", route)
+            return
+        fmt = parse_qs(u.query).get("format", ["json"])[0]
+        if fmt not in ("json", "chrome"):
+            self._error(400, f"unknown format {fmt!r} (json | chrome)",
+                        route)
+            return
+        with worker.lock:
+            tl = log.get(rid)
+            doc = None if tl is None else (
+                tl.chrome_trace() if fmt == "chrome" else tl.to_dict())
+        if doc is None:
+            self._error(404, f"no timeline for request {rid} (never "
+                        "submitted here, or evicted from the bounded "
+                        "log)", route)
+        else:
+            if fmt != "chrome":
+                doc = dict(doc, kind="replica")
+            self._json(200, doc, route)
 
     def _profile(self):
         """``GET /debug/profile?seconds=N[&format=...]``: sample a
@@ -1217,6 +1281,11 @@ def serve(model=None, *, engine: Engine | None = None,
             from ..observability.usage import UsageMeter
             engine_kw["usage"] = UsageMeter(max_tenants=int(
                 FLAGS.get("FLAGS_serving_usage_max_tenants") or 64))
+        if "requestlog" not in engine_kw \
+                and FLAGS.get("FLAGS_serving_request_log"):
+            from ..observability.requestlog import RequestLog
+            engine_kw["requestlog"] = RequestLog(
+                k=int(FLAGS.get("FLAGS_serving_exemplars_k") or 8))
         engine = create_engine(model, **engine_kw)
     elif engine_kw:
         raise ValueError(f"engine= given; unexpected {sorted(engine_kw)}")

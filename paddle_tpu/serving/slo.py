@@ -85,6 +85,12 @@ class SLOTracker:
         # alongside each _check — the usage meter wires it to attribute
         # SLO verdicts to the request's tenant (None = off)
         self.verdict_hook = None
+        # optional violation-exemplar hook, called as (req, dimension,
+        # ok, measured_seconds) — the request log wires it to snapshot
+        # the violating request's timeline + attribution, carrying its
+        # trace id so /debug/trace and /debug/exemplars join on one id
+        # (None = off; measured is None when no first token landed)
+        self.exemplar_hook = None
 
     def observe(self, req, now: float):
         cfg = self.config
@@ -99,16 +105,20 @@ class SLOTracker:
         if cfg.ttft_s > 0:
             # no first token at all = the request never met ANY bar
             self._verdict(req, "ttft",
-                          ttft is not None and ttft <= cfg.ttft_s)
+                          ttft is not None and ttft <= cfg.ttft_s,
+                          ttft)
         if cfg.tpot_s > 0 and tpot is not None:
-            self._verdict(req, "tpot", tpot <= cfg.tpot_s)
+            self._verdict(req, "tpot", tpot <= cfg.tpot_s, tpot)
         if cfg.e2e_s > 0:
-            self._verdict(req, "e2e", e2e <= cfg.e2e_s)
+            self._verdict(req, "e2e", e2e <= cfg.e2e_s, e2e)
 
-    def _verdict(self, req, dim: str, ok: bool):
+    def _verdict(self, req, dim: str, ok: bool,
+                 value: float | None = None):
         self._check(dim, ok)
         if self.verdict_hook is not None:
             self.verdict_hook(req, dim, ok)
+        if self.exemplar_hook is not None:
+            self.exemplar_hook(req, dim, ok, value)
 
     def _check(self, dim: str, ok: bool):
         budget = max(1.0 - self.config.objective, 1e-9)

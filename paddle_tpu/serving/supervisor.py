@@ -117,6 +117,11 @@ class EngineSupervisor:
         _obs.tracer().record_span(
             "supervisor.recover", t0, time.perf_counter(),
             attributes={"kind": kind, **result})
+        log = getattr(self.engine, "requestlog", None)
+        if log is not None:
+            # forensics: count the sweep (per-request replay seconds
+            # already landed in each timeline's recovery bucket)
+            log.note_recovery(result)
 
     def _escalate(self, err):
         """Restart budget exhausted: stop admitting, fail what is in

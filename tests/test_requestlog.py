@@ -1,0 +1,738 @@
+"""Tail-latency forensics (ISSUE 20): per-request lifecycle timelines,
+critical-path attribution, and SLO-violation exemplars.
+
+The acceptance contracts asserted here:
+  * attribution conservation — every finished request's bucket seconds
+    telescope EXACTLY (round 6) to its measured E2E, by the
+    advancing-cursor construction, across plain decode, chunked
+    prefill, and preempt->spill->resume;
+  * the exemplar store keeps a bounded worst-K per SLO dimension plus
+    errored requests, each record carrying the trace id for the
+    /debug/trace join;
+  * ``GET /debug/requests/<id>`` (waterfall + chrome trace) and
+    ``GET /debug/exemplars`` are live on the replica AND the router
+    (fan-out + merge, worst-first, counters summed);
+  * forensics off is the default: ``requestlog=None`` leaves
+    ``req.timeline`` None and the debug routes 404 (the perf gate pins
+    the zero host-sync / decode-trace deltas);
+  * the tooling renders the same rounded-6 numbers end to end:
+    ``serve_bench --explain-tail`` / ``--record``, ``obs.dump()`` ->
+    ``exemplars.json`` -> ``metrics_report`` / ``request_report``, and
+    the fleet dashboard's tail line.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+from paddle_tpu.observability.requestlog import (
+    BUCKETS, ExemplarStore, RequestLog, RequestTimeline,
+    merge_exemplars)
+from paddle_tpu.serving import (EngineSupervisor, FaultPlan,
+                                GenerationConfig, Router, ServingClient,
+                                ServingHTTPError, SLOConfig, SLOTracker,
+                                create_engine, serve)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PAGE = 4
+PROMPT = [1, 2, 3, 4, 5, 6, 7, 8]
+
+# any measured latency violates nanosecond targets, so every finished
+# request lands in the exemplar store once per dimension
+TINY_SLO = dict(ttft_s=1e-9, tpot_s=1e-9, e2e_s=1e-9)
+
+
+def _load_tool(name):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _model():
+    paddle.seed(0)
+    cfg = llama_tiny(vocab_size=64, hidden_size=32,
+                     intermediate_size=64, num_attention_heads=4,
+                     num_key_value_heads=2,
+                     max_position_embeddings=128)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _engine(**kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("page_size", PAGE)
+    kw.setdefault("sync_interval", 1)
+    return create_engine(_model(), **kw)
+
+
+def _gen(n):
+    return GenerationConfig(max_new_tokens=n)
+
+
+class _Span:
+    trace_id = "0af7651916cd43dd8448eb211c80319c"
+
+
+class _Req:
+    """Minimal request stand-in for the timeline unit tests — the real
+    seams are covered by the engine-level tests below."""
+
+    def __init__(self, rid=1, arrival=100.0, tenant="acme",
+                 adapter=None, priority=0, span=None):
+        self.id = rid
+        self.root_span = span
+        self.tenant = tenant
+        self.adapter = adapter
+        self.priority = priority
+        self.arrival_time = arrival
+        self.prompt = np.asarray([1, 2, 3], np.int32)
+        self.timeline = None
+
+
+# ====================================================== timeline units
+class TestRequestTimeline:
+    def test_conservation_by_construction(self):
+        tl = RequestTimeline(_Req(arrival=100.0))
+        tl.note("queue", 100.5, event="admit", slot=0,
+                then="prefill_compute")
+        tl.note_prefill(101.0, cached=4, computed=12)
+        tl.note_sync(101.5, 0.2)
+        tl.finish("length", 102.0)
+        a = tl.attribution()
+        assert a["queue"] == pytest.approx(0.5)
+        # prefill wall splits by token share: 4/16 cached, 12/16 compute
+        assert a["prefill_cached"] == pytest.approx(0.125)
+        assert a["prefill_compute"] == pytest.approx(0.375)
+        # sync interval splits at t - sync_s
+        assert a["host_sync"] == pytest.approx(0.2)
+        # decode = 0.3 from the sync split + the 0.5 residual at finish
+        assert a["decode"] == pytest.approx(0.8)
+        assert tl.e2e_s == pytest.approx(2.0)
+        assert sum(a.values()) == pytest.approx(2.0)
+        assert tl.conservation_delta() == 0.0
+        assert tl.finished and tl.finish_reason == "length"
+
+    def test_cursor_never_rewinds(self):
+        tl = RequestTimeline(_Req(arrival=100.0))
+        tl.note("queue", 101.0)
+        before = tl.attribution()
+        tl.note("decode", 100.2)        # stale clock: charges nothing
+        assert tl.attribution() == before
+        tl.finish("length", 101.0)
+        assert tl.conservation_delta() == 0.0
+
+    def test_then_names_the_residual_bucket(self):
+        tl = RequestTimeline(_Req(arrival=10.0))
+        tl.note("decode", 11.0, then="preempted")
+        tl.finish("cancelled", 12.0)
+        assert tl.attribution()["preempted"] == pytest.approx(1.0)
+        assert tl.conservation_delta() == 0.0
+
+    def test_event_bound_drops_events_not_seconds(self):
+        tl = RequestTimeline(_Req(arrival=0.0), max_events=3)
+        for i in range(10):
+            tl.note("decode", float(i + 1), event="tick")
+        tl.finish("length", 11.0)
+        assert len(tl.events) == 3          # submit + 2 ticks
+        assert tl.events_dropped == 9       # 8 ticks + finish
+        # bucket seconds are complete regardless
+        assert sum(tl.attribution().values()) == pytest.approx(11.0)
+        assert tl.conservation_delta() == 0.0
+        assert tl.to_dict()["events_dropped"] == 9
+
+    def test_mark_is_free(self):
+        tl = RequestTimeline(_Req(arrival=0.0))
+        tl.mark("first_token", 0.5, token=42)
+        assert sum(tl.attribution().values()) == 0.0
+        ev = tl.events[-1]
+        assert ev["event"] == "first_token" and ev["dur"] == 0.0
+        assert ev["token"] == 42 and "bucket" not in ev
+
+    def test_trace_id_and_identity_fields(self):
+        tl = RequestTimeline(_Req(rid=7, tenant="t1", adapter="a",
+                                  priority=1, span=_Span()))
+        assert tl.trace_id == _Span.trace_id
+        d = tl.to_dict()
+        assert (d["request"], d["tenant"], d["adapter"],
+                d["priority"]) == (7, "t1", "a", 1)
+        assert d["trace_id"] == _Span.trace_id
+        # first event is the submit stamp with the prompt length
+        assert d["events"][0]["event"] == "submit"
+        assert d["events"][0]["prompt_len"] == 3
+
+    def test_chrome_trace_export(self):
+        tl = RequestTimeline(_Req(rid=3, arrival=50.0, span=_Span()))
+        tl.note("queue", 50.25, event="admit", slot=1)
+        tl.finish("length", 51.0)
+        doc = tl.chrome_trace()
+        assert doc["request"] == 3
+        assert doc["trace_id"] == _Span.trace_id
+        evs = doc["traceEvents"]
+        assert [e["ph"] for e in evs] == ["X"] * len(evs)
+        admit = next(e for e in evs if e["name"] == "admit")
+        # complete events span [t - dur, t] in µs from arrival
+        assert admit["ts"] == pytest.approx(0.0, abs=1.0)
+        assert admit["dur"] == pytest.approx(0.25e6)
+        assert admit["args"]["slot"] == 1
+        assert all(e["tid"] == 3 for e in evs)
+
+
+class TestExemplarStore:
+    def _tl(self, rid, e2e=1.0):
+        tl = RequestTimeline(_Req(rid=rid, arrival=0.0))
+        tl.finish("length", e2e)
+        return tl
+
+    def test_k_validation(self):
+        with pytest.raises(ValueError, match="k must be"):
+            ExemplarStore(k=0)
+
+    def test_worst_k_ranking_and_counters(self):
+        store = ExemplarStore(k=2)
+        for rid, score in ((1, 1.0), (2, 3.0), (3, 2.0), (4, 0.5)):
+            store.offer("ttft", score, self._tl(rid))
+        snap = store.snapshot()
+        recs = snap["by_dimension"]["ttft"]
+        assert [r["request"] for r in recs] == [2, 3]   # worst first
+        assert [r["score_s"] for r in recs] == [3.0, 2.0]
+        assert snap["offered"] == 4
+        assert snap["kept"] == 3        # request 4 never ranked
+        # each record snapshots the full timeline for later rendering
+        assert recs[0]["timeline"]["request"] == 2
+        assert recs[0]["timeline"]["finished"] is True
+
+    def test_merge_is_rerank_not_average(self):
+        a = ExemplarStore(k=2)
+        b = ExemplarStore(k=2)
+        a.offer("e2e", 5.0, self._tl(1))
+        a.offer("e2e", 1.0, self._tl(2))
+        b.offer("e2e", 3.0, self._tl(3))
+        b.offer("ttft", 9.0, self._tl(4))
+        merged = merge_exemplars([a.snapshot(), b.snapshot(), None,
+                                  {"bogus": 1}])
+        # None / shapeless entries are skipped (stale-replica nulling)
+        assert merged["replicas_merged"] == 2
+        assert merged["offered"] == 4 and merged["kept"] == 4
+        assert [r["request"] for r in merged["by_dimension"]["e2e"]] \
+            == [1, 3]                   # re-ranked worst-first, cap 2
+        assert [r["request"] for r in merged["by_dimension"]["ttft"]] \
+            == [4]
+        assert merge_exemplars([]) == {
+            "k": 1, "offered": 0, "kept": 0, "replicas_merged": 0,
+            "by_dimension": {d: [] for d in ExemplarStore.DIMENSIONS}}
+
+
+# ==================================================== engine-level seams
+class TestEngineForensics:
+    def test_off_by_default(self):
+        eng = _engine()
+        req = eng.submit(list(PROMPT), _gen(4))
+        eng.run_until_complete(max_steps=200)
+        assert eng.requestlog is None
+        assert req.timeline is None
+
+    def test_every_finished_request_conserves(self):
+        log = RequestLog()
+        eng = _engine(requestlog=log)
+        reqs = [eng.submit(list(PROMPT), _gen(6), tenant="t0"),
+                eng.submit([2, 3, 4, 5], _gen(6), tenant="t1")]
+        eng.run_until_complete(max_steps=400)
+        reqs.append(eng.submit([5, 6, 7], _gen(4)))
+        eng.run_until_complete(max_steps=400)
+        assert log.finished == 3
+        total_e2e = 0.0
+        for r in reqs:
+            tl = log.get(r.id)
+            assert tl is not None and tl.finished
+            assert tl.conservation_delta() == 0.0
+            assert tl.e2e_s > 0.0
+            total_e2e += tl.e2e_s
+            kinds = [e["event"] for e in tl.events]
+            assert kinds[0] == "submit" and kinds[-1] == "finish"
+            assert "first_token" in kinds
+        snap = log.snapshot()
+        assert snap["conservation_max_delta"] == 0.0
+        assert snap["requests_tracked"] == 3
+        assert sum(snap["attribution_totals_s"].values()) \
+            == pytest.approx(total_e2e, abs=1e-4)
+        # in-process requests never pay the router bucket
+        assert snap["attribution_totals_s"]["network"] == 0.0
+
+    def test_preempt_spill_resume_parity_and_attribution(self):
+        def drive(log):
+            eng = _engine(enable_prefix_cache=False, preempt=True,
+                          requestlog=log)
+            lo = [eng.submit([1, 2, 3, 4, 5, 6], _gen(8)),
+                  eng.submit([3, 4, 5, 6, 7, 8], _gen(8))]
+            for _ in range(4):          # both residents mid-decode
+                eng.step()
+            hi = eng.submit([5, 6, 7, 8, 9, 10], _gen(8), priority=1)
+            eng.run_until_complete(max_steps=400)
+            return eng, lo + [hi]
+
+        _, ref_reqs = drive(None)
+        log = RequestLog()
+        eng, reqs = drive(log)
+        assert eng.preemptions == 1
+        # forensics on is invisible to the tokens
+        assert [list(r.output_tokens) for r in reqs] \
+            == [list(r.output_tokens) for r in ref_reqs]
+        victim = next(r for r in reqs if r.preemptions == 1)
+        tl = log.get(victim.id)
+        kinds = [e["event"] for e in tl.events]
+        assert "preempt" in kinds and "resume" in kinds
+        assert tl.attribution()["preempted"] > 0.0
+        # the conservation identity survives the spill round-trip
+        assert tl.conservation_delta() == 0.0
+        assert log.snapshot()["conservation_max_delta"] == 0.0
+
+    def test_chunked_prefill_attribution(self):
+        log = RequestLog()
+        eng = _engine(enable_prefix_cache=False, prefill_chunk=8,
+                      requestlog=log)
+        short = eng.submit([1, 2, 3, 4, 5, 6], _gen(16))
+        for _ in range(3):              # short request is decoding
+            eng.step()
+        chunked = eng.submit(list(range(1, 41)), _gen(4))
+        eng.run_until_complete(max_steps=400)
+        del short
+        tl = log.get(chunked.id)
+        chunks = [e for e in tl.events if e["event"] == "chunk"]
+        assert len(chunks) == eng.prefill_chunks == 5
+        assert chunks[-1]["done"] == chunks[-1]["total"] == 40
+        assert tl.attribution()["prefill_compute"] > 0.0
+        assert tl.conservation_delta() == 0.0
+
+    def test_error_request_becomes_exemplar(self):
+        plan = FaultPlan(seed=0)
+        plan.add("nan_logits", at=1, slot=0, phase="prefill")
+        log = RequestLog()
+        eng = _engine(faults=plan, requestlog=log)
+        sup = EngineSupervisor(eng, max_recoveries=3)
+        reqs = [eng.submit(list(PROMPT) + [20], _gen(8)),
+                eng.submit(list(PROMPT) + [25], _gen(8))]
+        steps = 0
+        while not all(r.is_finished() for r in reqs) and steps < 400:
+            sup.step()
+            steps += 1
+        errored = [r for r in reqs if r.finish_reason == "error"]
+        assert len(errored) == 1
+        recs = log.exemplars.snapshot()["by_dimension"]["error"]
+        assert [r["request"] for r in recs] == [errored[0].id]
+        tl = log.get(errored[0].id)
+        assert tl.finish_reason == "error"
+        assert tl.conservation_delta() == 0.0
+
+    def test_slo_violations_fill_the_reservoir(self):
+        log = RequestLog(k=8)
+        eng = _engine(slo=SLOTracker(SLOConfig(**TINY_SLO)),
+                      requestlog=log)
+        eng.submit(list(PROMPT), _gen(6), tenant="acme")
+        eng.submit([2, 3, 4, 5], _gen(6), tenant="zeta")
+        eng.run_until_complete(max_steps=400)
+        snap = log.snapshot()["exemplars"]
+        # 2 finished requests x 3 violated dimensions
+        assert snap["offered"] == snap["kept"] == 6
+        for dim in ("ttft", "tpot", "e2e"):
+            recs = snap["by_dimension"][dim]
+            assert len(recs) == 2
+            scores = [r["score_s"] for r in recs]
+            assert scores == sorted(scores, reverse=True)
+            assert {r["tenant"] for r in recs} == {"acme", "zeta"}
+            for r in recs:
+                assert r["score_s"] > 0.0
+                assert "trace_id" in r      # the /debug/trace join key
+        tail = log.tail_summary(now=1e12)
+        assert tail["finished"] == 2
+        assert tail["top_cause"] in BUCKETS
+        assert tail["worst_exemplar"]["age_s"] >= 0.0
+
+    def test_timeline_map_is_bounded(self):
+        log = RequestLog(max_requests=2)
+        eng = _engine(requestlog=log)
+        reqs = [eng.submit([1 + i, 2 + i, 3 + i], _gen(2))
+                for i in range(3)]
+        eng.run_until_complete(max_steps=400)
+        snap = log.snapshot()
+        assert snap["requests_tracked"] == 2
+        assert snap["evicted_timelines"] == 1
+        assert log.get(reqs[0].id) is None      # oldest fell off
+        assert log.tail_summary() is not None
+
+    def test_dump_writes_exemplars_json(self, tmp_path):
+        log = RequestLog()
+        eng = _engine(slo=SLOTracker(SLOConfig(**TINY_SLO)),
+                      requestlog=log)
+        eng.submit(list(PROMPT), _gen(4))
+        eng.run_until_complete(max_steps=200)
+        out = obs.dump(str(tmp_path))
+        with open(os.path.join(out, "exemplars.json")) as f:
+            doc = json.load(f)
+        assert doc["finished"] == 1
+        assert doc["conservation_max_delta"] == 0.0
+        assert doc["exemplars"]["kept"] >= 1
+        assert set(doc["attribution_totals_s"]) == set(BUCKETS)
+
+
+# ======================================================== HTTP surfaces
+def _serve(**kw):
+    kw.setdefault("slo", SLOTracker(SLOConfig(**TINY_SLO)))
+    kw.setdefault("requestlog", RequestLog())
+    return serve(_model(), max_slots=2, page_size=PAGE, num_pages=64,
+                 watchdog_s=0, enable_prefix_cache=True, **kw)
+
+
+@pytest.fixture(scope="module")
+def forensic_fleet():
+    s1, s2 = _serve(), _serve()
+    router = Router([s1.address, s2.address], page_size=PAGE)
+    rs = router.serve()
+    # seed one finished (and, under the nanosecond SLO, violating)
+    # request per replica so every debug surface has content
+    ServingClient(s1.address).completion_tokens(PROMPT, max_tokens=4)
+    ServingClient(s2.address).completion_tokens([2, 3, 4, 5],
+                                                max_tokens=4)
+    yield router, rs, s1, s2
+    rs.stop()
+    s1.stop(drain_timeout=5.0)
+    s2.stop(drain_timeout=5.0)
+
+
+class TestHTTPForensics:
+    def _rid(self, srv):
+        return srv.worker.engine.requestlog.timelines()[0].req_id
+
+    def test_debug_index_lists_forensics(self, forensic_fleet):
+        _, rs, s1, _ = forensic_fleet
+        for addr in (s1.address, rs.address):
+            idx = ServingClient(addr).request("GET", "/debug/")
+            eps = idx["endpoints"]
+            assert {"/debug/exemplars", "/debug/requests/<id>"} \
+                <= set(eps)
+            assert all(isinstance(v, str) and v for v in eps.values())
+
+    def test_replica_waterfall_json(self, forensic_fleet):
+        _, _, s1, _ = forensic_fleet
+        rid = self._rid(s1)
+        doc = ServingClient(s1.address).request(
+            "GET", f"/debug/requests/{rid}")
+        assert doc["kind"] == "replica" and doc["request"] == rid
+        assert doc["finished"] is True
+        assert doc["conservation_delta"] == 0.0
+        assert sum(doc["attribution"].values()) \
+            == pytest.approx(doc["e2e_s"], abs=1e-5)
+        kinds = [e["event"] for e in doc["events"]]
+        assert kinds[0] == "submit" and kinds[-1] == "finish"
+
+    def test_replica_waterfall_chrome(self, forensic_fleet):
+        _, _, s1, _ = forensic_fleet
+        rid = self._rid(s1)
+        doc = ServingClient(s1.address).request(
+            "GET", f"/debug/requests/{rid}?format=chrome")
+        assert doc["request"] == rid
+        assert doc["traceEvents"]
+        assert all(e["ph"] == "X" for e in doc["traceEvents"])
+
+    def test_replica_waterfall_errors(self, forensic_fleet):
+        _, _, s1, _ = forensic_fleet
+        c = ServingClient(s1.address)
+        for path, status in ((f"/debug/requests/{self._rid(s1)}"
+                              "?format=svg", 400),
+                             ("/debug/requests/nope", 400),
+                             ("/debug/requests/999999", 404)):
+            with pytest.raises(ServingHTTPError) as ei:
+                c.request("GET", path)
+            assert ei.value.status == status
+
+    def test_forensics_off_routes_404(self):
+        srv = serve(_model(), max_slots=2, page_size=PAGE,
+                    watchdog_s=0)
+        try:
+            c = ServingClient(srv.address)
+            for path in ("/debug/exemplars", "/debug/requests/1"):
+                with pytest.raises(ServingHTTPError) as ei:
+                    c.request("GET", path)
+                assert ei.value.status == 404
+                assert "FLAGS_serving_request_log" in str(ei.value)
+        finally:
+            srv.stop(drain_timeout=5.0)
+
+    def test_replica_exemplars_payload(self, forensic_fleet):
+        _, _, s1, _ = forensic_fleet
+        snap = ServingClient(s1.address).request(
+            "GET", "/debug/exemplars")
+        assert snap["kind"] == "replica"
+        assert snap["finished"] >= 1
+        assert snap["conservation_max_delta"] == 0.0
+        assert snap["exemplars"]["kept"] >= 3    # ttft + tpot + e2e
+
+    def test_router_merges_exemplars(self, forensic_fleet):
+        _, rs, s1, s2 = forensic_fleet
+        view = ServingClient(rs.address).request(
+            "GET", "/debug/exemplars")
+        assert view["kind"] == "router"
+        assert set(view["replicas"]) == {s1.address, s2.address}
+        merged = view["merged"]
+        assert merged["replicas_merged"] == 2
+        assert merged["kept"] == sum(
+            view["replicas"][a]["exemplars"]["kept"]
+            for a in view["replicas"])
+        # worst-first re-rank across replicas, never averaged
+        for recs in merged["by_dimension"].values():
+            scores = [r["score_s"] for r in recs]
+            assert scores == sorted(scores, reverse=True)
+
+    def test_router_request_fanout(self, forensic_fleet):
+        _, rs, s1, _ = forensic_fleet
+        rid = self._rid(s1)
+        c = ServingClient(rs.address)
+        view = c.request("GET", f"/debug/requests/{rid}")
+        assert view["kind"] == "router"
+        assert view["found"]["request"] == rid
+        assert view["found"]["conservation_delta"] == 0.0
+        assert len(view["replicas"]) == 2
+        chrome = c.request("GET",
+                           f"/debug/requests/{rid}?format=chrome")
+        assert chrome["request"] == rid and chrome["traceEvents"]
+
+    def test_router_request_miss_is_404(self, forensic_fleet):
+        _, rs, _, _ = forensic_fleet
+        with pytest.raises(ServingHTTPError) as ei:
+            ServingClient(rs.address).request(
+                "GET", "/debug/requests/987654")
+        assert ei.value.status == 404
+
+    def test_fleet_summary_publishes_tail(self, forensic_fleet):
+        router, _, s1, _ = forensic_fleet
+        fl = ServingClient(s1.address).request("GET", "/debug/fleet")
+        tail = fl["tail"]
+        assert tail["top_cause"] in BUCKETS
+        assert tail["finished"] >= 1
+        assert tail["conservation_max_delta"] == 0.0
+        assert tail["worst_exemplar"]["age_s"] >= 0.0
+        # the router's cluster view carries each replica's tail block
+        router.probe_once()
+        view = router.fleet()
+        assert view["replicas"][s1.address]["summary"]["tail"][
+            "top_cause"] == tail["top_cause"]
+
+
+# ===================================================== tooling surfaces
+class TestServeBenchForensics:
+    def _args(self, **over):
+        # bench_args() builds defaults from the REAL parser, so this
+        # helper can never silently miss a newly added bench flag
+        mod = _load_tool("serve_bench")
+        base = dict(requests=4, max_slots=2, page_size=4, num_pages=64,
+                    arrival_gap_ms=1.0, prompt_len=(4, 8),
+                    new_tokens=(2, 4), prefix_cache=False, layers=1,
+                    hidden=32, vocab=64, max_model_len=64)
+        base.update(over)
+        return mod.bench_args(**base)
+
+    def test_explain_tail_result_block(self, capsys):
+        mod = _load_tool("serve_bench")
+        res = mod.run_bench(self._args(explain_tail=True))
+        tail = res["tail"]
+        assert tail["finished"] == 4
+        assert tail["conservation_max_delta"] == 0.0
+        assert sum(tail["attribution_totals_s"].values()) > 0.0
+        assert tail["p99_ttft_cohort"]["requests"] >= 1
+        out = capsys.readouterr().out
+        assert "tail attribution" in out
+        assert "latency attribution" in out
+        assert "max |sum(buckets) - e2e| = 0" in out
+
+    def test_off_run_has_no_tail_block(self):
+        mod = _load_tool("serve_bench")
+        assert "tail" not in mod.run_bench(self._args())
+
+    def test_record_artifact(self, tmp_path, capsys):
+        mod = _load_tool("serve_bench")
+        path = str(tmp_path / "bench.json")
+        rc = mod.main(["--requests", "4", "--max-slots", "2",
+                       "--page-size", "4", "--prompt-len", "4", "8",
+                       "--new-tokens", "2", "4", "--layers", "1",
+                       "--hidden", "32", "--vocab", "64",
+                       "--max-model-len", "64", "--no-prefix-cache",
+                       "--explain-tail", "--record", path])
+        assert rc == 0
+        assert path in capsys.readouterr().out
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["tool"] == "serve_bench"
+        assert doc["requests"] == 4 and doc["tokens"] > 0
+        assert doc["ttft_s"]["n"] == 4
+        assert doc["ttft_s"]["p99"] >= doc["ttft_s"]["p50"] > 0.0
+        assert doc["tokens_per_s"] > 0.0
+        assert doc["scenario"]["requests"] == 4
+        assert doc["scenario"]["prompt_len"] == [4, 8]
+        assert doc["tail"]["conservation_max_delta"] == 0.0
+
+    def test_record_without_explain_tail(self, tmp_path):
+        mod = _load_tool("serve_bench")
+        path = str(tmp_path / "bench.json")
+        res = mod.run_bench(self._args())
+        mod._write_record(self._args(record=path), res)
+        with open(path) as f:
+            assert json.load(f)["tail"] is None
+
+    def test_bench_dump_matches_request_report(self, tmp_path):
+        """The ISSUE parity contract: serve_bench --explain-tail and
+        tools/request_report.py render the SAME rounded-6 attribution
+        numbers from one run's dump."""
+        bench = _load_tool("serve_bench")
+        rr = _load_tool("request_report")
+        dump = str(tmp_path / "dump")
+        res = bench.run_bench(self._args(explain_tail=True,
+                                         metrics_dir=dump))
+        with open(os.path.join(dump, "exemplars.json")) as f:
+            doc = json.load(f)
+        assert doc["attribution_totals_s"] \
+            == res["tail"]["attribution_totals_s"]
+        assert doc["conservation_max_delta"] \
+            == res["tail"]["conservation_max_delta"] == 0.0
+        text = rr.report(rr._load(dump))
+        for cause, v in doc["attribution_totals_s"].items():
+            if v > 0:
+                assert cause in text and f"{v:.6g}" in text
+        assert "must be 0" in text
+
+
+class TestRequestReport:
+    def _waterfall(self):
+        log = RequestLog()
+        eng = _engine(requestlog=log)
+        req = eng.submit(list(PROMPT), _gen(4), tenant="acme")
+        eng.run_until_complete(max_steps=200)
+        return log.get(req.id).to_dict(), log.snapshot()
+
+    def test_waterfall_rendering(self):
+        mod = _load_tool("request_report")
+        doc, _ = self._waterfall()
+        text = mod.report(doc)
+        assert f"request {doc['request']}" in text
+        assert "tenant=acme" in text
+        assert "finished" in text and "submit" in text
+        assert "delta 0, must be 0" in text.replace("(", "").replace(
+            ")", "")
+
+    def test_router_payload_unwraps_found(self):
+        mod = _load_tool("request_report")
+        doc, _ = self._waterfall()
+        wrapped = {"kind": "router", "found": doc,
+                   "replicas": {"a:1": doc, "b:2": {"error": "down"}}}
+        assert mod.report(wrapped) == mod.report(doc)
+
+    def test_exemplar_summary_and_request_expansion(self):
+        mod = _load_tool("request_report")
+        log = RequestLog()
+        eng = _engine(slo=SLOTracker(SLOConfig(**TINY_SLO)),
+                      requestlog=log)
+        req = eng.submit(list(PROMPT), _gen(4), tenant="acme")
+        eng.run_until_complete(max_steps=200)
+        snap = log.snapshot()
+        text = mod.report(snap)
+        assert "Tail-latency attribution" in text
+        assert "Exemplars" in text and "acme" in text
+        # --request ID expands the snapshotted timeline
+        text = mod.report(snap, request_id=req.id)
+        assert f"request {req.id}" in text and "waterfall" in text
+        with pytest.raises(SystemExit):
+            mod.report(snap, request_id=999999)
+
+    def test_unrecognized_input_exits(self):
+        mod = _load_tool("request_report")
+        with pytest.raises(SystemExit):
+            mod.report({"random": "junk"})
+
+    def test_dump_dir_without_exemplars_exits(self, tmp_path):
+        mod = _load_tool("request_report")
+        with pytest.raises(SystemExit):
+            mod._load(str(tmp_path))
+
+
+class TestMetricsReportTail:
+    def _snapshot(self):
+        log = RequestLog()
+        eng = _engine(slo=SLOTracker(SLOConfig(**TINY_SLO)),
+                      requestlog=log)
+        eng.submit(list(PROMPT), _gen(4), tenant="acme")
+        eng.run_until_complete(max_steps=200)
+        return log.snapshot()
+
+    def test_tail_section_renders(self):
+        mod = _load_tool("metrics_report")
+        snap = self._snapshot()
+        text = mod.report({}, None, exemplars=snap)
+        assert "Tail latency" in text
+        assert "worst ttft" in text and "tenant=acme" in text
+        assert "3 kept of 3 violations offered" in text
+        assert "max |sum(buckets) - e2e| = 0 over 1 finished" in text
+
+    def test_old_dumps_have_no_section(self):
+        # dumps produced before this PR carry no exemplars.json; the
+        # report must render without the section, never crash
+        mod = _load_tool("metrics_report")
+        assert "Tail latency" not in mod.report({}, None)
+        assert "Tail latency" not in mod.report(
+            {}, None, exemplars={"attribution_totals_s": {},
+                                 "finished": 0})
+
+    def test_loader_reads_exemplars_json(self, tmp_path):
+        mod = _load_tool("metrics_report")
+        with open(tmp_path / "metrics.json", "w") as f:
+            json.dump({}, f)
+        snap = self._snapshot()
+        with open(tmp_path / "exemplars.json", "w") as f:
+            json.dump(snap, f)
+        loaded = mod._load(str(tmp_path))
+        assert loaded[10] == snap
+
+
+class TestFleetDashboardTail:
+    _TAIL = {"finished": 7, "top_cause": "queue", "top_cause_s": 1.25,
+             "attribution_totals_s": {"queue": 1.25, "decode": 0.5},
+             "conservation_max_delta": 0.0,
+             "worst_exemplar": {"dimension": "ttft", "score_s": 0.75,
+                                "request": 3, "trace_id": "t",
+                                "tenant": "acme", "adapter": None,
+                                "captured_at": 10.0, "age_s": 2.0}}
+
+    def test_replica_frame_tail_line(self):
+        mod = _load_tool("fleet_dashboard")
+        payload = {"kind": "replica", "address": "x:1", "model": "m",
+                   "tail": self._TAIL}
+        text = mod.render(payload)
+        assert "tail: top cause queue (1.25s over 7 finished)" in text
+        assert "worst ttft 0.75s req=3 (2s ago)" in text
+        plain = dict(payload)
+        plain.pop("tail")
+        assert "tail:" not in mod.render(plain)
+
+    def test_router_frame_tail_line(self):
+        mod = _load_tool("fleet_dashboard")
+        view = {"kind": "router",
+                "cluster": {"replicas": 1, "up": 1, "summaries": 1},
+                "replicas": {"x:1": {"up": True,
+                                     "summary": {"tail": self._TAIL}}}}
+        text = mod.render(view)
+        assert "[x:1]" in text
+        assert "tail: top cause queue" in text
+
+    def test_once_frame_against_live_replica(self, forensic_fleet,
+                                             capsys):
+        _, _, s1, _ = forensic_fleet
+        mod = _load_tool("fleet_dashboard")
+        assert mod.main([s1.address, "--once"]) == 0
+        out = capsys.readouterr().out
+        assert "REPLICA" in out
+        assert "tail: top cause" in out

@@ -567,6 +567,72 @@ class TestRouterAffinity:
         assert dense[a.address] == dense[b.address] == 0.5
 
 
+# =================================================== adapter-scale churn
+class TestAdapterScale:
+    """Registry / LRU behavior at realistic adapter counts.  The store
+    is driven standalone (no runner attached, so ``_load`` is pure
+    bookkeeping): the churn measures the registry + eviction machinery
+    itself, not device copies — the device path is already pinned
+    token-for-token by TestEngineParity on a small bank."""
+
+    def _churn(self, cfg, n, capacity):
+        store = AdapterStore(cfg, capacity=capacity, rank=RANK)
+        w = random_adapter(cfg, RANK, seed=5)
+        for i in range(n):
+            # register() copies the arrays, so one weight set serves
+            # every name — churn cost stays in the store, not the rng
+            store.register(f"ad{i:05d}", w, alpha=ALPHA)
+        for i in range(n):
+            name = f"ad{i:05d}"
+            row = store.acquire(name)
+            assert 1 <= row <= capacity      # row 0 is the zeroed one
+            store.release(name)
+        snap = store.snapshot()
+        assert len(snap["registered"]) == n
+        assert snap["resident"] == [f"ad{i:05d}"
+                                    for i in range(n - capacity, n)]
+        # each acquire past the first `capacity` evicted exactly one
+        # idle LRU resident; the census identity must balance
+        assert snap["loads"] == n
+        assert snap["evictions"] == n - capacity
+        assert snap["loads"] - snap["evictions"] == \
+            len(snap["resident"])
+        assert len(snap["parked"]) == n - capacity
+        assert snap["pinned"] == {}
+        assert snap["requests"] == {f"ad{i:05d}": 1 for i in range(n)}
+        return store
+
+    def test_64_adapters_capacity_4(self, cfg_state):
+        cfg, _ = cfg_state
+        store = self._churn(cfg, 64, 4)
+        # a second pass over the resident tail is hit-only: no loads,
+        # no evictions
+        snap = store.snapshot()
+        before = (store.loads, store.evictions)
+        for name in snap["resident"]:
+            store.acquire(name)
+            store.release(name)
+        assert (store.loads, store.evictions) == before
+
+    @pytest.mark.slow
+    def test_2000_adapter_churn(self, cfg_state):
+        cfg, _ = cfg_state
+        store = self._churn(cfg, 2000, 4)
+        # pin the whole bank: the next cold acquire must refuse loudly
+        # instead of evicting under a live request
+        tail = store.snapshot()["resident"]
+        for name in tail:
+            store.acquire(name)
+        with pytest.raises(RuntimeError, match="pinned"):
+            store.acquire("ad00000")
+        for name in tail:
+            store.release(name)
+        assert store.snapshot()["pinned"] == {}
+        # and once idle the bank churns again
+        assert store.acquire("ad00000") >= 1
+        store.release("ad00000")
+
+
 # ================================================= usage + tooling seams
 class TestObservability:
     def test_usage_meter_adapter_rows(self, cfg_state, adapters):

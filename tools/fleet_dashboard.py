@@ -22,6 +22,9 @@ kind "replica" is one server's own census).  Renders:
     and alert-triggered capture tallies (requires
     ``FLAGS_obs_profile_interval_s`` /
     ``FLAGS_obs_timeseries_interval_s`` on the replicas);
+  * a tail-latency line per replica: the top latency-attribution
+    cause across finished requests plus the worst SLO-violation
+    exemplar (requires ``FLAGS_serving_request_log`` on the replicas);
   * sparkline history from each replica's recent time-series windows
     (requires ``FLAGS_obs_timeseries_interval_s`` on the replicas).
 
@@ -235,6 +238,29 @@ def _adapters_line(fl, indent: str = "  ") -> list[str]:
     return [indent + "adapters: " + ", ".join(parts)] if parts else []
 
 
+def _tail_line(fl, indent: str = "  ") -> list[str]:
+    """Tail-latency forensics line from a replica's fleet summary
+    ("tail" key, published when ``FLAGS_serving_request_log`` is on):
+    the top latency cause across finished requests plus the worst
+    SLO-violation exemplar.  Forensics-off replicas — and older
+    builds — publish no key and produce no line."""
+    tail = (fl or {}).get("tail") or {}
+    if not tail:
+        return []
+    parts = [f"top cause {tail.get('top_cause', '?')} "
+             f"({_fmt(tail.get('top_cause_s'))}s over "
+             f"{_fmt(tail.get('finished'))} finished)"]
+    worst = tail.get("worst_exemplar") or {}
+    if worst:
+        part = (f"worst {worst.get('dimension', '?')} "
+                f"{_fmt(worst.get('score_s'))}s "
+                f"req={worst.get('request')}")
+        if worst.get("age_s") is not None:
+            part += f" ({_fmt(worst.get('age_s'))}s ago)"
+        parts.append(part)
+    return [indent + "tail: " + ", ".join(parts)]
+
+
 def _merge_usage(snaps):
     """Raw-merge per-replica usage snapshots: per-tenant counters sum,
     nested dicts (the slo verdict table) recurse, never averaging — a
@@ -371,9 +397,10 @@ def render_router(payload) -> str:
         fl = entry.get("summary") or {}
         adapters = _adapters_line(fl)
         diag = _diagnostics_line(fl)
+        tail = _tail_line(fl)
         hist = _series_lines(fl.get("series"))
-        if adapters or diag or hist:
-            out += (["", f"[{addr}]"] + adapters + diag
+        if adapters or diag or tail or hist:
+            out += (["", f"[{addr}]"] + adapters + diag + tail
                     + (hist[1:] if hist else []))
     return "\n".join(out)
 
@@ -401,6 +428,7 @@ def render_replica(payload) -> str:
                    f" {_fmt(rec.get('replayed_requests'))} replays")
     out += _adapters_line(payload)
     out += _diagnostics_line(payload)
+    out += _tail_line(payload)
     sched = payload.get("scheduling") or {}
     if any(v for k, v in sched.items() if k != "prefill_chunk"):
         line = (f"  overload: {_fmt(sched.get('prefill_chunks'))} "

@@ -6,13 +6,15 @@ Usage:
 
 Reads metrics.json (+ retraces.json / trace.json / flight.json /
 resources.json / profile.json / captures.json / usage.json /
-quant.json / lora.json when present) from the dump directory
-FLAGS_metrics_dir pointed at, and renders counters, gauges,
+quant.json / lora.json / exemplars.json when present) from the dump
+directory FLAGS_metrics_dir pointed at, and renders counters, gauges,
 histograms, SLO verdicts, fault-tolerance events, finish reasons, the
 span-trace summary, the sampling-profiler + diagnostic-capture
 summary, the per-tenant usage ledger, the multi-LoRA adapter census +
-offline batch lane, and the retrace log as aligned tables.  --prom
-cats the raw Prometheus text instead (what a scraper would see).
+offline batch lane, the tail-latency attribution table + worst
+SLO-violation exemplars, and the retrace log as aligned tables.
+--prom cats the raw Prometheus text instead (what a scraper would
+see).
 
 Every section is optional: a dump produced by an older build (no SLO
 counters, no trace.json) renders the sections it has and silently
@@ -60,8 +62,9 @@ def _load(path):
     usage = _read_json(os.path.join(dir_, "usage.json"))
     quant = _read_json(os.path.join(dir_, "quant.json"))
     lora = _read_json(os.path.join(dir_, "lora.json"))
+    exemplars = _read_json(os.path.join(dir_, "exemplars.json"))
     return (metrics, retraces, trace, flight, resources, profile,
-            captures, usage, quant, lora, prom_path)
+            captures, usage, quant, lora, exemplars, prom_path)
 
 
 def _fmt_value(v):
@@ -836,9 +839,55 @@ def _lora_section(lora, metrics):
     return "\n".join(lines) if len(lines) > 1 else None
 
 
+def _tail_section(exemplars):
+    """Tail-latency forensics from exemplars.json (the request log's
+    snapshot: latency attribution totals by cause, worst-K
+    SLO-violation exemplars per dimension, and the conservation
+    check).  Dumps produced without ``FLAGS_serving_request_log`` —
+    or by older builds — have no file and produce no section."""
+    if not isinstance(exemplars, dict):
+        return None
+    lines = ["Tail latency"]
+    totals = exemplars.get("attribution_totals_s") or {}
+    spent = sum(float(v or 0) for v in totals.values())
+    if spent:
+        rows = [(cause, f"{float(v or 0):.6g}",
+                 f"{100.0 * float(v or 0) / spent:.1f}%")
+                for cause, v in sorted(
+                    totals.items(), key=lambda kv: -float(kv[1] or 0))
+                if float(v or 0)]
+        lines.append(_table(rows, ("cause", "seconds", "share")))
+    store = exemplars.get("exemplars") or {}
+    for dim, recs in sorted((store.get("by_dimension") or {}).items()):
+        recs = [r for r in (recs or []) if isinstance(r, dict)]
+        if not recs:
+            continue
+        worst = recs[0]
+        lines.append(
+            f"  worst {dim}: {float(worst.get('score_s') or 0):.6g}s "
+            f"request={worst.get('request')} "
+            f"tenant={worst.get('tenant') or '-'} "
+            f"adapter={worst.get('adapter') or '-'} "
+            f"trace={worst.get('trace_id') or '-'} "
+            f"({len(recs)} kept)")
+    if store:
+        lines.append(
+            f"  exemplars: {_fmt_value(store.get('kept', 0))} kept of "
+            f"{_fmt_value(store.get('offered', 0))} violations offered "
+            f"(worst-{_fmt_value(store.get('k', 0))} per dimension)")
+    finished = exemplars.get("finished", 0)
+    if finished:
+        lines.append(
+            f"  attribution conservation: max |sum(buckets) - e2e| = "
+            f"{_fmt_value(exemplars.get('conservation_max_delta', 0))} "
+            f"over {_fmt_value(finished)} finished requests "
+            f"(must be 0; bucket seconds telescope to measured E2E)")
+    return "\n".join(lines) if len(lines) > 1 else None
+
+
 def report(metrics, retraces, trace=None, flight=None, resources=None,
            profile=None, captures=None, usage=None, quant=None,
-           lora=None):
+           lora=None, exemplars=None):
     simple_rows = {"counter": [], "gauge": []}
     hist_blocks = []
     for name, entry in sorted(metrics.items()):
@@ -893,6 +942,9 @@ def report(metrics, retraces, trace=None, flight=None, resources=None,
     lr = _lora_section(lora, metrics)
     if lr:
         out += [lr, ""]
+    tail = _tail_section(exemplars)
+    if tail:
+        out += [tail, ""]
     if retraces and retraces.get("entries"):
         entries = sorted(retraces["entries"],
                          key=lambda e: (-e["count"], e["op"]))
@@ -916,7 +968,7 @@ def main(argv=None):
                     help="print the raw Prometheus text export")
     args = ap.parse_args(argv)
     (metrics, retraces, trace, flight, resources, profile, captures,
-     usage, quant, lora, prom_path) = _load(args.path)
+     usage, quant, lora, exemplars, prom_path) = _load(args.path)
     if args.prom:
         if not os.path.exists(prom_path):
             sys.exit(f"metrics_report: no metrics.prom at {prom_path!r}")
@@ -924,7 +976,7 @@ def main(argv=None):
             print(f.read(), end="")
         return 0
     print(report(metrics, retraces, trace, flight, resources,
-                 profile, captures, usage, quant, lora))
+                 profile, captures, usage, quant, lora, exemplars))
     return 0
 
 

@@ -149,6 +149,21 @@ DIRECTIONS = {
     "batch_job_done": "exact",
     "batch_row_parity": "exact",
     "interactive_parity_vs_idle": "exact",
+    # tail-latency forensics: every finished timeline's bucket seconds
+    # must telescope exactly to its measured E2E (the conservation
+    # identity pinned at 0), the event / exemplar counts are exact
+    # under the nanosecond SLO (every request violates every
+    # dimension, so the reservoir census is arithmetic, not timing),
+    # greedy outputs are bit-identical to the forensics-off run, and
+    # arming the RequestLog adds ZERO host syncs / decode traces (the
+    # zero-overhead-off contract of the ``requestlog is not None``
+    # seams)
+    "requests_tracked": "exact",
+    "requests_finished": "exact",
+    "timeline_events": "exact",
+    "attribution_conservation_max_delta": "exact",
+    "exemplars_captured": "exact",
+    "forensics_parity_vs_off": "exact",
 }
 
 
@@ -912,6 +927,89 @@ def scenario_batch_lane() -> dict:
     }
 
 
+def scenario_tail_forensics() -> dict:
+    """Tail-latency forensics, counters only.
+
+    The overload workload (the preempt-and-swap half plus the chunked-
+    prefill half of overload_degrade) runs twice — bare, and with a
+    RequestLog attached behind an always-violating SLOTracker
+    (nanosecond targets: every finished request trips every dimension,
+    so the exemplar census is arithmetic, not timing).  Gates: every
+    finished timeline's bucket seconds telescope exactly to its
+    measured E2E (attribution_conservation_max_delta pinned at 0 —
+    the advancing-cursor construction, checked against wall clocks),
+    the lifecycle event count is exact across preemption / spill /
+    resume / chunked admission, the reservoir keeps exactly one
+    exemplar per request per dimension, greedy outputs are
+    bit-identical to the forensics-off run, and arming the log adds
+    ZERO host syncs / decode traces (the zero-overhead-off contract
+    of the ``requestlog is not None`` seams)."""
+    from paddle_tpu.observability.requestlog import RequestLog
+    from paddle_tpu.serving.slo import SLOConfig, SLOTracker
+
+    def slo():
+        # nanosecond targets: any measured latency violates, so every
+        # finished request lands in the exemplar store exactly once
+        # per dimension (ttft, tpot, e2e)
+        return SLOTracker(SLOConfig(ttft_s=1e-9, tpot_s=1e-9,
+                                    e2e_s=1e-9))
+
+    def drive(with_log):
+        # --- preempt-and-swap half (decode -> preempted -> resume) ---
+        log1 = RequestLog(k=8) if with_log else None
+        eng = _engine(max_slots=2, page_size=4, sync_interval=1,
+                      enable_prefix_cache=False, preempt=True,
+                      slo=slo(), requestlog=log1)
+        lo_a = eng.submit([1, 2, 3, 4, 5, 6], _gen(8))
+        lo_b = eng.submit([3, 4, 5, 6, 7, 8], _gen(8))
+        for _ in range(4):              # both residents mid-decode
+            eng.step()
+        hi = eng.submit([5, 6, 7, 8, 9, 10], _gen(8), priority=1)
+        eng.run_until_complete(max_steps=400)
+
+        # --- chunked-prefill half (chunk_gap attribution) ---
+        log2 = RequestLog(k=8) if with_log else None
+        eng2 = _engine(max_slots=2, page_size=4, sync_interval=1,
+                       enable_prefix_cache=False, prefill_chunk=8,
+                       slo=slo(), requestlog=log2)
+        short = eng2.submit([1, 2, 3, 4, 5, 6], _gen(16))
+        for _ in range(3):              # short request is decoding
+            eng2.step()
+        chunked = eng2.submit(list(range(1, 41)), _gen(4))
+        eng2.run_until_complete(max_steps=400)
+        return (eng, eng2, [lo_a, lo_b, hi, short, chunked],
+                log1, log2)
+
+    e_off, e2_off, ref_reqs, _, _ = drive(False)
+    e_on, e2_on, reqs, log1, log2 = drive(True)
+    s1, s2 = log1.snapshot(), log2.snapshot()
+    return {
+        "requests_tracked": (s1["requests_tracked"]
+                             + s2["requests_tracked"]),
+        "requests_finished": s1["finished"] + s2["finished"],
+        "timeline_events": s1["events_total"] + s2["events_total"],
+        "attribution_conservation_max_delta": max(
+            s1["conservation_max_delta"],
+            s2["conservation_max_delta"]),
+        "exemplars_captured": (s1["exemplars"]["kept"]
+                               + s2["exemplars"]["kept"]),
+        "preemptions": e_on.preemptions,
+        "prefill_chunks": e2_on.prefill_chunks,
+        "forensics_parity_vs_off": int(
+            [r.output_tokens for r in reqs]
+            == [r.output_tokens for r in ref_reqs]),
+        "leaked_pages": (e_on.blocks.pool_accounting()["leak"]
+                         + e2_on.blocks.pool_accounting()["leak"]),
+        "host_syncs_delta_vs_off": (
+            e_on.host_syncs + e2_on.host_syncs
+            - e_off.host_syncs - e2_off.host_syncs),
+        "decode_traces_delta_vs_off": (
+            e_on.decode_traces + e2_on.decode_traces
+            - e_off.decode_traces - e2_off.decode_traces),
+        "goodput_ratio": _goodput(reqs),
+    }
+
+
 SCENARIOS = {
     "steady_decode": scenario_steady_decode,
     "prefix_cache": scenario_prefix_cache,
@@ -927,6 +1025,7 @@ SCENARIOS = {
     "quant_decode": scenario_quant_decode,
     "lora_decode": scenario_lora_decode,
     "batch_lane": scenario_batch_lane,
+    "tail_forensics": scenario_tail_forensics,
 }
 
 

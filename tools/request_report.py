@@ -1,0 +1,229 @@
+#!/usr/bin/env python
+"""Render per-request lifecycle waterfalls + tail-latency attribution.
+
+Usage:
+    python tools/request_report.py <dump-dir | exemplars.json | waterfall.json>
+        [--request ID]
+
+Input is any of:
+
+  * an observability dump directory (``obs.dump()`` output) — reads
+    its ``exemplars.json`` (written when ``FLAGS_serving_request_log``
+    armed a RequestLog);
+  * an ``exemplars.json`` file directly (a ``RequestLog.snapshot()``:
+    attribution totals, conservation check, worst-K exemplars per SLO
+    dimension);
+  * a single waterfall JSON saved from ``GET /debug/requests/<id>``
+    (replica response, or the router's fan-out response — the
+    ``found`` entry is unwrapped automatically).
+
+Default output is the attribution table by cause (the same rounded-6
+seconds ``serve_bench --explain-tail`` prints), the conservation line,
+and one line per kept exemplar (dimension, score, tenant/adapter,
+trace id).  ``--request ID`` renders the full ASCII waterfall of that
+request's timeline — from the exemplar store when given a snapshot, or
+of the single-waterfall input itself.
+
+Works standalone — no paddle_tpu / jax import, so it runs against
+artifacts copied off a serving host.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_BAR_WIDTH = 40
+
+
+def _load(path):
+    """Resolve the input to a JSON document; dump dirs resolve to
+    their exemplars.json."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "exemplars.json")
+        if not os.path.exists(path):
+            sys.exit(f"request_report: no exemplars.json in the dump "
+                     f"dir (run with FLAGS_serving_request_log=true, "
+                     f"or pass a waterfall JSON): {path!r}")
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        sys.exit(f"request_report: cannot read {path!r}: {e}")
+
+
+def _fmt(v):
+    if isinstance(v, float):
+        if v == int(v) and abs(v) < 1e12:
+            return str(int(v))
+        return f"{v:.6g}"
+    return str(v)
+
+
+def _table(rows, headers):
+    widths = [max(len(str(r[i])) for r in rows + [headers])
+              for i in range(len(headers))]
+
+    def line(r):
+        return "  ".join(str(c).ljust(w)
+                         for c, w in zip(r, widths)).rstrip()
+
+    return "\n".join([line(headers),
+                      line(["-" * w for w in widths])]
+                     + [line(r) for r in rows])
+
+
+def attribution_lines(attribution, e2e_s=None, delta=None,
+                      finished=None):
+    """The per-cause table + conservation line — identical numbers to
+    ``serve_bench --explain-tail`` (both render the rounded-6 seconds
+    the RequestLog snapshots)."""
+    causes = {c: float(v or 0) for c, v in (attribution or {}).items()}
+    spent = sum(causes.values())
+    lines = []
+    if spent > 0:
+        rows = [(c, f"{v:.6g}", f"{100.0 * v / spent:.1f}%")
+                for c, v in sorted(causes.items(), key=lambda kv:
+                                   (-kv[1], kv[0])) if v > 0]
+        lines.append(_table(rows, ("cause", "seconds", "share")))
+    else:
+        lines.append("  no attributed seconds")
+    if e2e_s is not None:
+        # prefer the recorded delta (computed on unrounded seconds);
+        # re-deriving from the rounded-6 buckets can drift by 1e-6
+        d = (round(spent - float(e2e_s), 6) if delta is None
+             else float(delta))
+        lines.append(f"  conservation: sum(buckets)={spent:.6g}s vs "
+                     f"e2e={float(e2e_s):.6g}s (delta {_fmt(d)}, "
+                     f"must be 0)")
+    elif delta is not None:
+        line = (f"  conservation: max |sum(buckets) - e2e| = "
+                f"{_fmt(delta)} (must be 0)")
+        if finished is not None:
+            line += f" over {_fmt(finished)} finished requests"
+        lines.append(line)
+    return lines
+
+
+def waterfall_lines(doc):
+    """ASCII waterfall of one request's timeline (the
+    ``GET /debug/requests/<id>`` payload): one bar per charged event,
+    offset from arrival, plus the attribution table."""
+    events = doc.get("events") or []
+    lines = [f"request {doc.get('request')} "
+             f"trace={doc.get('trace_id') or '-'} "
+             f"tenant={doc.get('tenant') or '-'} "
+             f"adapter={doc.get('adapter') or '-'} "
+             f"priority={doc.get('priority', 0)}"]
+    status = ("finished" if doc.get("finished") else "in flight")
+    lines.append(f"  {status}"
+                 + (f" reason={doc.get('finish_reason')}"
+                    if doc.get("finish_reason") else "")
+                 + (f" e2e={float(doc['e2e_s']):.6g}s"
+                    if doc.get("e2e_s") is not None else ""))
+    span = max([float(e.get("t") or 0) for e in events] + [0.0])
+    scale = _BAR_WIDTH / span if span > 0 else 0.0
+    rows = []
+    for ev in events:
+        t = float(ev.get("t") or 0)
+        dur = float(ev.get("dur") or 0)
+        start = max(t - dur, 0.0)
+        pad = int(start * scale)
+        fill = max(1, int(dur * scale)) if dur > 0 else 0
+        bar = " " * pad + ("#" * fill if fill else "|")
+        attrs = ", ".join(f"{k}={v}" for k, v in sorted(ev.items())
+                          if k not in ("event", "t", "dur", "bucket"))
+        rows.append((ev.get("event", "?"),
+                     ev.get("bucket") or "-",
+                     f"{start:.6g}", f"{dur:.6g}",
+                     bar[:_BAR_WIDTH + 1], attrs))
+    if rows:
+        lines.append(_table(rows, ("event", "bucket", "start_s",
+                                   "dur_s", "waterfall", "attrs")))
+    if doc.get("events_dropped"):
+        lines.append(f"  ({_fmt(doc['events_dropped'])} events dropped "
+                     f"by the bound — bucket seconds are complete)")
+    lines += attribution_lines(doc.get("attribution"),
+                               e2e_s=doc.get("e2e_s"),
+                               delta=doc.get("conservation_delta"))
+    return lines
+
+
+def exemplar_lines(snapshot, request_id=None):
+    """Render a RequestLog snapshot (``exemplars.json`` /
+    ``GET /debug/exemplars``): attribution totals, conservation, and
+    the kept exemplars; ``request_id`` expands one exemplar's
+    snapshotted timeline into a full waterfall."""
+    lines = ["Tail-latency attribution (all finished requests)"]
+    lines += attribution_lines(
+        snapshot.get("attribution_totals_s"),
+        delta=snapshot.get("conservation_max_delta"),
+        finished=snapshot.get("finished"))
+    store = snapshot.get("exemplars") or snapshot
+    by_dim = store.get("by_dimension") or {}
+    records = [r for lst in by_dim.values() for r in (lst or [])
+               if isinstance(r, dict)]
+    if request_id is not None:
+        hits = [r for r in records
+                if r.get("request") == request_id
+                and isinstance(r.get("timeline"), dict)]
+        if not hits:
+            sys.exit(f"request_report: request {request_id} is not in "
+                     f"the exemplar store (only SLO-violating / "
+                     f"errored requests are kept — fetch the live "
+                     f"waterfall from /debug/requests/{request_id})")
+        return lines + [""] + waterfall_lines(hits[0]["timeline"])
+    rows = []
+    for dim in sorted(by_dim):
+        for rank, r in enumerate(x for x in (by_dim[dim] or [])
+                                 if isinstance(x, dict)):
+            rows.append((dim, rank,
+                         f"{float(r.get('score_s') or 0):.6g}",
+                         r.get("request"),
+                         r.get("tenant") or "-",
+                         r.get("adapter") or "-",
+                         r.get("trace_id") or "-"))
+    if rows:
+        lines += ["", "Exemplars (worst-K per dimension; --request ID "
+                      "renders the waterfall)",
+                  _table(rows, ("dimension", "rank", "score_s",
+                                "request", "tenant", "adapter",
+                                "trace"))]
+        lines.append(f"  {_fmt(store.get('kept', len(rows)))} kept of "
+                     f"{_fmt(store.get('offered', 0))} violations "
+                     f"offered (worst-{_fmt(store.get('k', 0))})")
+    else:
+        lines += ["", "no exemplars captured (no SLO violations or "
+                      "errors this run)"]
+    return lines
+
+
+def report(doc, request_id=None):
+    if isinstance(doc, dict) and isinstance(doc.get("found"), dict):
+        doc = doc["found"]      # router fan-out response: unwrap
+    if isinstance(doc, dict) and "events" in doc:
+        return "\n".join(waterfall_lines(doc))
+    if isinstance(doc, dict) and ("attribution_totals_s" in doc
+                                  or "by_dimension" in doc
+                                  or "exemplars" in doc):
+        return "\n".join(exemplar_lines(doc, request_id))
+    sys.exit("request_report: unrecognized input — expected a "
+             "/debug/requests/<id> waterfall, an exemplars.json, or "
+             "a dump directory")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path",
+                    help="dump dir, exemplars.json, or waterfall JSON")
+    ap.add_argument("--request", type=int, default=None, metavar="ID",
+                    help="render this exemplar request's full "
+                         "waterfall instead of the summary")
+    args = ap.parse_args(argv)
+    print(report(_load(args.path), args.request))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -79,6 +79,18 @@ prefill_chunk / decode / verify / host_sync / idle) and writes folded
 stacks — flamegraph.pl / speedscope input, rendered by
 ``tools/profile_report.py``.
 
+``--explain-tail`` wires a per-request lifecycle log
+(observability.requestlog.RequestLog) into the engine and prints the
+critical-path attribution of the p99-TTFT cohort ("p99 TTFT is 71%
+queue, 18% chunk_gap, ...") plus the overall per-cause totals and the
+conservation check — the numbers match what ``tools/request_report.py``
+renders from the run's ``exemplars.json`` dump (in-process mode only).
+
+``--record OUT.json`` writes a machine-readable bench artifact after
+the run: tok/s, TTFT/TPOT p50/p95/p99, the scenario knobs, and (with
+``--explain-tail``) the tail attribution — the input for regression
+dashboards and A/B diffs.
+
 The model is a randomly initialized tiny llama (this benchmarks the
 ENGINE — scheduling, paging, dispatch — not the matmuls); sizes are
 flags so the same harness scales up on real hardware.
@@ -312,6 +324,13 @@ def run_bench(args):
                 nm, random_adapter(cfg, args.lora_rank,
                                    seed=args.seed + j))
 
+    # --explain-tail: per-request lifecycle timelines + critical-path
+    # attribution (requestlog=None keeps the zero-overhead-off default)
+    requestlog = None
+    if getattr(args, "explain_tail", False):
+        from paddle_tpu.observability.requestlog import RequestLog
+        requestlog = RequestLog(max_requests=max(512, args.requests))
+
     engine = create_engine(model, max_slots=args.max_slots,
                            page_size=args.page_size,
                            num_pages=args.num_pages,
@@ -325,7 +344,8 @@ def run_bench(args):
                            usage=usage_meter, lora=lora_store,
                            quant=(None if getattr(args, "quant", "none")
                                   == "none" else args.quant),
-                           kv_quant=getattr(args, "kv_quant", None))
+                           kv_quant=getattr(args, "kv_quant", None),
+                           requestlog=requestlog)
 
     # --batch-file FILE: an offline JSONL job rides the batch priority
     # lane, drip-fed between interactive admissions
@@ -489,6 +509,10 @@ def run_bench(args):
         _print_tenant_table(snap)
         usage_out = {"usage": snap}
 
+    tail_out = {}
+    if requestlog is not None:
+        tail_out = {"tail": _explain_tail(requestlog, reqs, ttfts)}
+
     chaos_out = {}
     if supervisor is not None:
         ok = sum(1 for r in reqs if r.finish_reason in ("length", "eos"))
@@ -546,7 +570,97 @@ def run_bench(args):
             "spill_aborts": stats["spill_aborts"],
             "spilled_pages": stats["spilled_pages"],
             "restored_pages": stats["restored_pages"],
-            **batch_out, **usage_out, **chaos_out}
+            **batch_out, **usage_out, **tail_out, **chaos_out}
+
+
+def _explain_tail(requestlog, reqs, ttfts):
+    """--explain-tail report: critical-path attribution of the
+    p99-TTFT cohort (every request whose TTFT reached the p99
+    estimate) plus the run-wide per-cause totals and the conservation
+    check.  Seconds are rounded to 6 decimals — identical to what the
+    run's exemplars.json dump carries, so tools/request_report.py
+    renders the same numbers."""
+    snap = requestlog.snapshot()
+    totals = snap["attribution_totals_s"]
+
+    thresh = _percentile(ttfts, 0.99) if ttfts else float("inf")
+    cohort = []
+    for r in reqs:
+        if r.first_token_at is None:
+            continue
+        if r.first_token_at - r.arrival_time >= thresh:
+            tl = requestlog.get(r.id)
+            if tl is not None:
+                cohort.append(tl)
+    cohort_s: dict = {}
+    for tl in cohort:
+        for cause, v in tl.attribution().items():
+            cohort_s[cause] = cohort_s.get(cause, 0.0) + v
+    cohort_s = {c: round(v, 6) for c, v in cohort_s.items()}
+
+    def shares(by_cause):
+        spent = sum(by_cause.values())
+        if spent <= 0:
+            return "no attributed seconds"
+        top = sorted(by_cause.items(), key=lambda kv: -kv[1])
+        return ", ".join(f"{100.0 * v / spent:.0f}% {c}"
+                         for c, v in top if v > 0)
+
+    if cohort_s:
+        print(f"  tail attribution     p99 TTFT cohort "
+              f"({len(cohort)} req): {shares(cohort_s)}")
+    print(f"  latency attribution  {shares(totals)} "
+          f"over {snap['finished']} finished requests")
+    print(f"  conservation         max |sum(buckets) - e2e| = "
+          f"{snap['conservation_max_delta']} (must be 0)")
+    return {"attribution_totals_s": totals,
+            "p99_ttft_cohort": {"requests": len(cohort),
+                                "attribution_s": cohort_s},
+            "finished": snap["finished"],
+            "conservation_max_delta": snap["conservation_max_delta"],
+            "exemplars": snap["exemplars"]}
+
+
+# scenario knobs --record captures alongside the results — enough to
+# reproduce the run (with --seed) and to group artifacts in dashboards
+_RECORD_KNOBS = (
+    "requests", "max_slots", "page_size", "num_pages", "arrival_gap_ms",
+    "arrival", "prompt_len", "new_tokens", "shared_prefix_len",
+    "sync_interval", "spec_k", "prefix_cache", "prefill_chunk",
+    "preempt", "priority_mix", "tenants", "adapters", "lora_rank",
+    "quant", "kv_quant", "http", "replicas", "layers", "hidden",
+    "vocab", "heads", "kv_heads", "max_model_len", "seed")
+
+
+def _write_record(args, res):
+    """--record OUT.json: machine-readable bench artifact (throughput,
+    latency percentiles, scenario knobs, and — with --explain-tail —
+    the p99-cohort attribution)."""
+    import json
+
+    def pcts(vals):
+        if not vals:
+            return None
+        return {"p50": _percentile(vals, 0.5),
+                "p95": _percentile(vals, 0.95),
+                "p99": _percentile(vals, 0.99),
+                "mean": sum(vals) / len(vals), "n": len(vals)}
+
+    doc = {"tool": "serve_bench",
+           "scenario": {k: (list(v) if isinstance(v, tuple) else v)
+                        for k in _RECORD_KNOBS
+                        for v in [getattr(args, k, None)]},
+           "requests": res.get("requests"),
+           "tokens": res.get("tokens"),
+           "wall_s": res.get("wall_s"),
+           "tokens_per_s": res.get("throughput"),
+           "ttft_s": pcts(res.get("ttft_s") or []),
+           "tpot_s": pcts(res.get("tpot_s") or []),
+           "tail": res.get("tail")}
+    with open(args.record, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"  record               {args.record}")
 
 
 def run_overload_compare(args):
@@ -934,6 +1048,16 @@ def _build_parser() -> argparse.ArgumentParser:
                          "(prefill-chunk 0, no preemption) and print "
                          "a per-class tail-latency comparison "
                          "(in-process mode only)")
+    ap.add_argument("--explain-tail", action="store_true",
+                    help="wire a per-request lifecycle log into the "
+                         "engine and print the critical-path "
+                         "attribution of the p99-TTFT cohort plus the "
+                         "run-wide per-cause totals and conservation "
+                         "check (in-process mode only)")
+    ap.add_argument("--record", default="", metavar="OUT.json",
+                    help="write a machine-readable bench artifact "
+                         "(tok/s, TTFT/TPOT p50/p95/p99, scenario "
+                         "knobs, tail attribution) to this path")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--chaos", type=int, default=None, metavar="SEED",
                     help="inject a seeded probabilistic fault plan "
@@ -969,11 +1093,13 @@ def bench_args(**overrides) -> argparse.Namespace:
 def main(argv=None):
     args = _build_parser().parse_args(argv)
     if args.http:
-        run_http_bench(args)
+        res = run_http_bench(args)
     elif args.overload_baseline:
-        run_overload_compare(args)
+        res, _ = run_overload_compare(args)
     else:
-        run_bench(args)
+        res = run_bench(args)
+    if args.record:
+        _write_record(args, res)
     return 0
 
 
